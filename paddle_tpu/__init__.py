@@ -1,0 +1,99 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new implementation of the capability surface of PaddlePaddle ~v2.1
+(reference surveyed in /root/repo/SURVEY.md), designed for TPU from the ground
+up: jax/XLA is the compute substrate, autograd is jax.vjp-on-a-tape, static
+graphs lower to single XLA computations, and distribution is mesh-+-collective
+based (pjit/shard_map over ICI) instead of NCCL ring-ids.
+
+Public namespace mirrors `paddle.*`.
+"""
+
+__version__ = "0.1.0"
+
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128,
+)
+from .core.device import (  # noqa: F401
+    set_device, get_device, CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_cuda,
+    is_compiled_with_tpu, device_count,
+)
+from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+
+# wire Tensor dunder operators now that ops exist
+from .core.tensor import _install_operators as _iop
+
+_iop()
+del _iop
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from .framework import save, load, set_flags, get_flags  # noqa: F401,E402
+from .nn.layer import ParamAttr  # noqa: F401,E402
+
+import numpy as _np
+
+
+def disable_static():
+    from .static import program as _p
+
+    _p._dygraph_mode = True
+
+
+def enable_static():
+    from .static import program as _p
+
+    _p._dygraph_mode = False
+
+
+def in_dynamic_mode():
+    from .static import program as _p
+
+    return _p._dygraph_mode
+
+
+def is_empty(x):
+    return to_tensor(_np.array(x.size == 0))
+
+
+def rank(x):
+    return to_tensor(_np.array(x.ndim, dtype=_np.int32))
+
+
+def shape(x):
+    return to_tensor(_np.array(x.shape, dtype=_np.int32))
+
+
+def numel(x):
+    return to_tensor(_np.array(x.size, dtype=_np.int64))
+
+
+def summary(net, input_size=None, dtypes=None):
+    total = sum(int(_np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(
+        int(_np.prod(p.shape)) for p in net.parameters() if not p.stop_gradient
+    )
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,2 @@
+from .auto_cast import auto_cast, amp_guard, white_list, black_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

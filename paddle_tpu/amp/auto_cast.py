@@ -1,0 +1,88 @@
+"""AMP autocast.
+
+Reference parity: imperative/amp_auto_cast.cc (AmpOperators allow/block lists,
+AutoCastInputs called from tracer.cc:177) and python/paddle/amp/auto_cast.py.
+TPU-native: bf16 is the native mixed precision (no loss scaling needed on TPU;
+GradScaler kept for API parity).  The cast hook lives in core.registry.apply_op.
+"""
+import contextlib
+import threading
+
+# ops that benefit from bf16 on the MXU (allow list, cf. fp16_lists.py white)
+WHITE_LIST = {
+    "conv2d", "conv1d", "conv2d_transpose", "matmul_v2", "bmm", "linear",
+    "linear_nobias", "mul", "sdp_attention", "flash_attention",
+}
+# numerically sensitive ops stay fp32 (cf. fp16_lists.py black)
+BLACK_LIST = {
+    "softmax_with_cross_entropy", "cross_entropy", "layer_norm", "batch_norm",
+    "reduce_mean", "reduce_sum", "exp", "log", "softmax", "log_softmax",
+    "p_norm", "amp_cast",
+}
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+_state = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = "bfloat16"
+        _state.level = "O1"
+        _state.custom_white = set()
+        _state.custom_black = set()
+    return _state
+
+
+def amp_enabled():
+    return _amp_state().enabled
+
+
+def amp_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if _amp_state().dtype == "bfloat16" else jnp.float16
+
+
+def amp_should_cast(op_type):
+    s = _amp_state()
+    if not s.enabled:
+        return False
+    if op_type in s.custom_black or op_type in BLACK_LIST:
+        return False
+    if s.level == "O2":
+        return True
+    return op_type in WHITE_LIST or op_type in s.custom_white
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    s = _amp_state()
+    prev = (s.enabled, s.dtype, s.level, s.custom_white, s.custom_black)
+    s.enabled = enable
+    s.dtype = dtype
+    s.level = level
+    s.custom_white = set(custom_white_list or ())
+    s.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        s.enabled, s.dtype, s.level, s.custom_white, s.custom_black = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the amp dtype."""
+    if level == "O2":
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,102 @@
+"""paddle.autograd namespace: PyLayer + backward.
+
+Reference parity: python/paddle/autograd/ (PyLayer py_layer.py, backward) over
+imperative/py_layer_fwd.h.  PyLayer's custom backward is recorded on the same
+tape as ordinary ops.
+"""
+from .core.autograd import backward as _backward_impl, grad, no_grad  # noqa: F401
+from .core.autograd import TapeNode, is_grad_enabled
+from .core.tensor import Tensor, _wrap_data
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    _backward_impl(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined forward/backward (ref: paddle/autograd/py_layer.py).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.exp(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            y, = ctx.saved_tensor()
+            return dy * y
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args
+        )
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (list, tuple))
+        out_list = list(outputs) if multi else [outputs]
+
+        if not needs_grad:
+            return outputs
+
+        diff_inputs = [t for t in tensor_args if not t.stop_gradient]
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if len(out_list) > 1 else [cots]
+            grads = cls.backward(
+                ctx, *[_wrap_data(c, stop_gradient=True) for c in cot_list]
+            )
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            out = []
+            gi = 0
+            for t in tensor_args:
+                if t.stop_gradient:
+                    continue
+                g = grads[gi] if gi < len(grads) else None
+                gi += 1
+                out.append(g._data if isinstance(g, Tensor) else g)
+            return tuple(out)
+
+        node = TapeNode(
+            f"pylayer_{cls.__name__}", vjp_fn, diff_inputs, len(out_list),
+            [tuple(o.shape) for o in out_list],
+            [o._data.dtype for o in out_list],
+        )
+        wrapped = []
+        for i, o in enumerate(out_list):
+            t = _wrap_data(o._data, stop_gradient=False)
+            t._node = node
+            t._out_index = i
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError

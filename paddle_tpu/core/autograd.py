@@ -1,0 +1,296 @@
+"""Define-by-run autograd: tape + reverse engine.
+
+Reference parity: paddle/fluid/imperative/basic_engine.{h,cc} (BasicEngine::Execute
+basic_engine.cc:305, PrepareDeps:235), op_base.h:202 (GradOpNode),
+gradient_accumulator.cc (multi-consumer grad summation), and
+partial_grad_engine.cc (paddle.grad).
+
+TPU-native design: instead of per-op C++ grad kernels, every forward op records a
+`jax.vjp` closure at trace time (see registry.apply_op).  The backward engine is a
+dependency-counted reverse-topological sweep over TapeNodes; cotangent math runs
+as ordinary jax ops, so `create_graph=True` (double grad) works by simply keeping
+grad-mode enabled while executing vjp closures.
+"""
+import contextlib
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+_grad_state = threading.local()
+
+
+def is_grad_enabled():
+    return getattr(_grad_state, "enabled", True)
+
+
+def set_grad_enabled(mode):
+    _grad_state.enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = is_grad_enabled()
+    set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = is_grad_enabled()
+    set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+class TapeNode:
+    """One recorded op application (cf. GradOpNode op_base.h:202).
+
+    vjp_fn: cotangents-of-outputs (tuple) -> cotangents-of-diff-inputs (tuple)
+    inputs: the input Tensors that require grad (positions matching vjp outputs)
+    n_outputs: number of forward outputs
+    """
+
+    __slots__ = (
+        "op_type",
+        "vjp_fn",
+        "inputs",
+        "n_outputs",
+        "out_shapes",
+        "out_dtypes",
+        "__weakref__",
+    )
+
+    def __init__(self, op_type, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes):
+        self.op_type = op_type
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of Tensor (strong refs: keeps graph alive)
+        self.n_outputs = n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+
+    def __repr__(self):
+        return f"<TapeNode {self.op_type}>"
+
+
+def _toposort(root_nodes):
+    """Reverse-topological order of the tape graph reachable from root_nodes.
+
+    Mirrors BasicEngine::PrepareDeps (basic_engine.cc:235): count consumers, then
+    process nodes whose consumers are all done.  We do an iterative DFS
+    post-order instead, which yields the same valid order.
+    """
+    order = []
+    visited = set()
+    for root in root_nodes:
+        if id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs:
+                prod = t._node
+                if prod is not None and id(prod) not in visited:
+                    stack.append((prod, False))
+    order.reverse()  # consumers first
+    return order
+
+
+def _ones_like_val(t):
+    return jnp.ones(t.shape, t._data.dtype)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from `tensors` into leaf `.grad`s.
+
+    Parity: core.dygraph_run_backward (pybind/imperative.cc:1774) ->
+    BasicEngine::Execute (basic_engine.cc:305).
+    """
+    from .tensor import Tensor, _wrap_data
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # node -> list of per-output accumulated cotangents
+    out_cots = {}
+    leaf_cots = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        gval = g._data if isinstance(g, Tensor) else (g if g is not None else _ones_like_val(t))
+        node = t._node
+        if node is None:
+            leaf_cots.setdefault(id(t), [t, None])
+            prev = leaf_cots[id(t)][1]
+            leaf_cots[id(t)][1] = gval if prev is None else prev + gval
+        else:
+            slots = out_cots.setdefault(id(node), [node, [None] * node.n_outputs])
+            idx = t._out_index
+            prev = slots[1][idx]
+            slots[1][idx] = gval if prev is None else prev + gval
+            roots.append(node)
+
+    order = _toposort(roots)
+
+    for node in order:
+        entry = out_cots.pop(id(node), None)
+        if entry is None:
+            continue
+        _, cots = entry
+        # Fill unvisited outputs with zeros (jax.vjp needs the full tuple).
+        full = tuple(
+            c if c is not None else jnp.zeros(s, d)
+            for c, s, d in zip(cots, node.out_shapes, node.out_dtypes)
+        )
+        in_cots = node.vjp_fn(full if node.n_outputs > 1 else full[0])
+        if not isinstance(in_cots, tuple):
+            in_cots = (in_cots,)
+        for t, c in zip(node.inputs, in_cots):
+            if c is None:
+                continue
+            prod = t._node
+            if prod is None:
+                slot = leaf_cots.setdefault(id(t), [t, None])
+                slot[1] = c if slot[1] is None else slot[1] + c
+            else:
+                slots = out_cots.setdefault(id(prod), [prod, [None] * prod.n_outputs])
+                prev = slots[1][t._out_index]
+                slots[1][t._out_index] = c if prev is None else prev + c
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs = []
+
+    # write accumulated grads into leaves
+    for _, (t, cot) in leaf_cots.items():
+        if cot is None or t.stop_gradient:
+            continue
+        if t.grad is None:
+            t.grad = _wrap_data(cot, stop_gradient=True)
+        else:
+            t.grad = _wrap_data(t.grad._data + cot, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+):
+    """paddle.grad: partial reverse pass returning grads for `inputs` only.
+
+    Parity: imperative/partial_grad_engine.cc (PartialGradEngine).  With
+    create_graph=True the cotangent computation itself is recorded on the tape
+    (vjp closures are jax-differentiable), enabling double grad.
+    """
+    from .tensor import Tensor, _wrap_data
+    from . import registry
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    retain = True if create_graph else bool(retain_graph)
+
+    # Accumulate cotangents as Tensors so create_graph can record them.
+    out_cots = {}
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    results = [None] * len(inputs)
+
+    def _acc_result(t, cot):
+        i = input_ids[id(t)]
+        results[i] = cot if results[i] is None else registry.apply_op(
+            "grad_accumulate", lambda a, b: a + b, (results[i], cot), {}
+        )
+
+    roots = []
+    for t, g in zip(outputs, grad_outputs):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            g = _wrap_data(_ones_like_val(t), stop_gradient=not create_graph)
+        node = t._node
+        if node is None:
+            if id(t) in input_ids:
+                _acc_result(t, g)
+            continue
+        slots = out_cots.setdefault(id(node), [node, [None] * node.n_outputs])
+        prev = slots[1][t._out_index]
+        slots[1][t._out_index] = g if prev is None else prev + g
+        roots.append(node)
+
+    order = _toposort(roots)
+
+    ctx = enable_grad() if create_graph else no_grad()
+    with ctx:
+        for node in order:
+            entry = out_cots.pop(id(node), None)
+            if entry is None:
+                continue
+            _, cots = entry
+            cot_tensors = tuple(
+                c
+                if c is not None
+                else _wrap_data(jnp.zeros(s, d), stop_gradient=True)
+                for c, s, d in zip(cots, node.out_shapes, node.out_dtypes)
+            )
+
+            vjp_fn = node.vjp_fn
+            n_in = len(node.inputs)
+
+            def run_vjp(*cot_vals, _vjp=vjp_fn, _n=node.n_outputs):
+                res = _vjp(cot_vals if _n > 1 else cot_vals[0])
+                return res if isinstance(res, tuple) else (res,)
+
+            in_cots = registry.apply_op(
+                f"vjp_{node.op_type}", run_vjp, cot_tensors, {}, n_outputs=n_in
+            )
+            if not isinstance(in_cots, (list, tuple)):
+                in_cots = (in_cots,)
+            for t, c in zip(node.inputs, in_cots):
+                if c is None:
+                    continue
+                if id(t) in input_ids:
+                    # inputs are cut points: record and stop propagating
+                    _acc_result(t, c)
+                    continue
+                prod = t._node
+                if prod is None:
+                    continue
+                slots = out_cots.setdefault(id(prod), [prod, [None] * prod.n_outputs])
+                prev = slots[1][t._out_index]
+                slots[1][t._out_index] = c if prev is None else prev + c
+
+    missing = [i for i, r in enumerate(results) if r is None]
+    if missing and not allow_unused:
+        raise RuntimeError(
+            f"The {missing} -th input tensor is unused in the graph "
+            "(set allow_unused=True to return None for it)"
+        )
+    return results

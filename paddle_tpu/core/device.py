@@ -1,0 +1,109 @@
+"""Device / Place abstraction.
+
+Reference parity: paddle/fluid/platform/place.h:26-150 (CPUPlace/CUDAPlace/Place
+tagged union) and device_context.h:109/805 (DeviceContext + pool).  TPU-native
+design: a Place names a jax.Device; the "device context" role (stream + handle
+ownership) is played by PJRT inside jax, so the pool here is just a thin registry
+plus the current-device state used by tensor creation.
+"""
+import threading
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+class Place:
+    """Device identity. device_type in {'cpu', 'tpu', 'gpu'}."""
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type, device_id=0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = _devices_of_type(self.device_type)
+        if not devs:
+            raise RuntimeError(f"No {self.device_type} devices available")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def CPUPlace():
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id=0):
+    return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id=0):  # accepted for API parity; maps to accelerator 0
+    return Place("gpu", device_id)
+
+
+def _devices_of_type(device_type):
+    if device_type == "cpu":
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            return []
+    # Any non-cpu type maps to the default accelerator backend.
+    default = jax.devices()
+    if default and default[0].platform != "cpu":
+        return default
+    return default
+
+
+def _default_device_type():
+    d = jax.devices()[0]
+    return "cpu" if d.platform == "cpu" else "tpu"
+
+
+def set_device(device):
+    """paddle.set_device('tpu') / 'tpu:0' / 'cpu'."""
+    if isinstance(device, Place):
+        _state.place = device
+        return device
+    name, _, idx = device.partition(":")
+    if name in ("gpu", "cuda", "xpu", "npu"):
+        name = "tpu" if _default_device_type() == "tpu" else "cpu"
+    place = Place(name, int(idx) if idx else 0)
+    _state.place = place
+    return place
+
+
+def get_device():
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place():
+    if not hasattr(_state, "place"):
+        _state.place = Place(_default_device_type(), 0)
+    return _state.place
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def device_count():
+    return len(jax.devices())

@@ -1,0 +1,61 @@
+"""Dtype registry.
+
+Reference parity: paddle/fluid/framework/framework.proto:106 (VarType) defines the
+dtype enum; python/paddle/fluid/core dtype aliases.  Here dtypes are jax/numpy
+dtypes with paddle-style string names.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_NAME2DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def convert_dtype(dtype):
+    """Normalize a user dtype (str / np / jnp) to a numpy dtype object.
+
+    bfloat16 is preserved (ml_dtypes-backed numpy dtype).
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME2DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+        return np.dtype(_NAME2DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype):
+    return np.dtype(dtype) in [np.dtype(d) for d in FLOAT_DTYPES]

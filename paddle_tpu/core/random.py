@@ -1,0 +1,72 @@
+"""Framework RNG: global seed + functional key threading.
+
+Reference parity: paddle.seed / fluid Generator (paddle/fluid/framework/generator.cc)
+and the per-op `seed` attrs (e.g. dropout).  TPU-native design: threefry key
+splitting (jax.random).  Eager mode draws from a global generator; compiled /
+functional code must thread keys explicitly — `rng_guard(key)` installs a key
+source so ops called under jit tracing consume deterministic functional keys
+(cf. SURVEY §7.3 "Randomness": per-rank trees map to key splitting).
+"""
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.sources = []
+    return _state
+
+
+def seed(value):
+    s = _tls()
+    s.key = jax.random.PRNGKey(int(value))
+    return s.key
+
+
+def get_rng_state():
+    return _tls().key
+
+
+def set_rng_state(key):
+    _tls().key = key
+
+
+class _KeySource:
+    """Functional key source: pre-split keys consumed in call order."""
+
+    def __init__(self, key):
+        self.key = key
+        self.count = 0
+
+    def next_key(self):
+        self.count += 1
+        return jax.random.fold_in(self.key, self.count)
+
+
+class rng_guard:
+    """Context manager installing a functional key source (for jit tracing)."""
+
+    def __init__(self, key):
+        if isinstance(key, int):
+            key = jax.random.PRNGKey(key)
+        self.source = _KeySource(key)
+
+    def __enter__(self):
+        _tls().sources.append(self.source)
+        return self.source
+
+    def __exit__(self, *exc):
+        _tls().sources.pop()
+        return False
+
+
+def next_key():
+    s = _tls()
+    if s.sources:
+        return s.sources[-1].next_key()
+    s.key, sub = jax.random.split(s.key)
+    return sub

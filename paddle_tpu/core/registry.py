@@ -1,0 +1,154 @@
+"""Op registry + eager dispatch.
+
+Reference parity: paddle/fluid/framework/op_registry.h:90-361 (static registrar),
+imperative/tracer.cc:144 (Tracer::TraceOp) and prepared_operator.cc:221
+(PreparedOp::Run).  TPU-native design: an "op" is a pure jax function
+(arrays in -> arrays out).  Eager dispatch executes it immediately (jax is
+eager); when autograd is on, the forward runs under `jax.vjp` and the cotangent
+closure is recorded on the tape (core/autograd.py).  The same registry entries
+are reused by the static-graph executor (static/executor.py), which lowers a
+whole Program block into one jit-compiled XLA computation — the static analogue
+of kernel dispatch, minus per-op overhead.
+"""
+import threading
+
+import jax
+
+_OPS = {}  # name -> OpDef
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "n_outputs")
+
+    def __init__(self, name, fn, n_outputs=1):
+        self.name = name
+        self.fn = fn
+        self.n_outputs = n_outputs
+
+
+def register_op(name, fn, n_outputs=1):
+    _OPS[name] = OpDef(name, fn, n_outputs)
+    return _OPS[name]
+
+
+def get_op(name):
+    return _OPS[name]
+
+
+def has_op(name):
+    return name in _OPS
+
+
+def op_names():
+    return sorted(_OPS)
+
+
+def _cast_tensor(t, dtype):
+    """Grad-preserving cast used by the AMP hook (grad flows back to fp32)."""
+    return apply_op("amp_cast", lambda v: v.astype(dtype), (t,), {})
+
+
+def apply_op(op_type, fn, args, kwargs, n_outputs=None):
+    """Execute `fn` over mixed Tensor/array args, recording a tape node if needed.
+
+    Tensors must be positional; kwargs are static attributes.  Returns Tensor(s).
+    This is the single Python-level crossing per eager op — the analogue of the
+    generated core.ops.* fast path (pybind/op_function_generator.cc:254-519),
+    except grads come from jax.vjp instead of registered grad kernels.
+    """
+    from .tensor import Tensor, _wrap_data
+    from . import autograd
+
+    # AMP autocast hook (parity: AutoCastInputs, imperative/amp_auto_cast.cc:27)
+    from ..amp.auto_cast import amp_enabled, amp_should_cast, amp_dtype
+    import jax.numpy as _jnp
+
+    if amp_enabled() and amp_should_cast(op_type):
+        tgt = amp_dtype()
+        args = tuple(
+            _cast_tensor(a, tgt) if isinstance(a, Tensor) and a._data.dtype == _jnp.float32
+            else a
+            for a in args
+        )
+
+    tensor_pos = []
+    vals = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            tensor_pos.append(i)
+            vals.append(a._data)
+
+    import jax.numpy as jnp
+
+    diff_pos = [
+        i
+        for i in tensor_pos
+        if not args[i].stop_gradient
+        and jnp.issubdtype(args[i]._data.dtype, jnp.inexact)
+    ] if autograd.is_grad_enabled() else []
+
+    def call_fn(*tensor_vals):
+        full = list(args)
+        it = iter(tensor_vals)
+        for i in tensor_pos:
+            full[i] = next(it)
+        return fn(*full, **kwargs)
+
+    if not diff_pos:
+        with autograd.no_grad():
+            out_vals = call_fn(*vals)
+        multi = isinstance(out_vals, tuple)
+        outs = [
+            _wrap_data(v, stop_gradient=True)
+            for v in (out_vals if multi else (out_vals,))
+        ]
+        return tuple(outs) if multi else outs[0]
+
+    # Differentiable path: vjp over only the grad-requiring tensor args.
+    nondiff_vals = {i: args[i]._data for i in tensor_pos if i not in diff_pos}
+
+    def diff_fn(*diff_vals):
+        full = list(args)
+        it = iter(diff_vals)
+        for i in diff_pos:
+            full[i] = next(it)
+        for i, v in nondiff_vals.items():
+            full[i] = v
+        return fn(*full, **kwargs)
+
+    out_vals, vjp_fn = jax.vjp(diff_fn, *[args[i]._data for i in diff_pos])
+    multi = isinstance(out_vals, tuple)
+    out_list = list(out_vals) if multi else [out_vals]
+
+    node = autograd.TapeNode(
+        op_type,
+        vjp_fn,
+        [args[i] for i in diff_pos],
+        len(out_list),
+        [v.shape for v in out_list],
+        [v.dtype for v in out_list],
+    )
+    outs = []
+    for idx, v in enumerate(out_list):
+        t = _wrap_data(v, stop_gradient=False)
+        t._node = node
+        t._out_index = idx
+        outs.append(t)
+    return tuple(outs) if multi else outs[0]
+
+
+def eager_op(name, n_outputs=1):
+    """Decorator: register a pure-jax fn and return an eager Tensor wrapper."""
+
+    def deco(fn):
+        register_op(name, fn, n_outputs)
+
+        def wrapper(*args, **kwargs):
+            return apply_op(name, fn, args, kwargs, n_outputs=n_outputs)
+
+        wrapper.__name__ = name
+        wrapper.op_name = name
+        wrapper.raw_fn = fn
+        return wrapper
+
+    return deco

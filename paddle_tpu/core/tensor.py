@@ -1,0 +1,302 @@
+"""Tensor: the imperative n-d array.
+
+Reference parity: paddle/fluid/framework/tensor.h:89 (typed buffer + place),
+imperative/layer.h:66 (VarBase: Variable + grad var + stop_gradient) and
+variable_wrapper.h.  TPU-native design: the buffer is a jax.Array living in HBM
+managed by PJRT (no framework allocator needed — cf. SURVEY §7.1 allocator row);
+autograd state is a producer TapeNode reference (core/autograd.py).  LoD ragged
+metadata is intentionally absent: ragged data is represented padded+mask at the
+Python boundary (SURVEY §7.3 "LoD tensors").
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .device import current_place, Place
+from .dtype import convert_dtype
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "grad",
+        "_node",
+        "_out_index",
+        "name",
+        "persistable",
+        "_trainable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        dtype = convert_dtype(dtype)
+        if not isinstance(data, (jax.Array, jnp.ndarray)) or isinstance(
+            data, np.ndarray
+        ):
+            arr = np.asarray(data)
+            if dtype is not None:
+                arr = arr.astype(dtype)
+            elif arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            # NOTE: int64 device arrays become int32 on TPU (jax x64 is kept
+            # OFF so float literals stay float32/bf16 — the TPU-native
+            # default).  Paddle's int64 indices fit int32 for all shipped
+            # models; values beyond 2^31 are unsupported on device.
+            data = jnp.asarray(arr)
+        elif dtype is not None and data.dtype != dtype:
+            data = data.astype(dtype)
+        if place is not None and isinstance(place, Place):
+            data = jax.device_put(data, place.jax_device())
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self.name = name
+        self.persistable = False
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        return current_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    # ---- host interchange ----
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = _wrap_data(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def clone(self):
+        from .. import ops
+
+        return ops.assign(self)
+
+    def register_hook(self, hook):
+        # Minimal parity with VarBase hooks (imperative/hooks.h): wrap producer
+        # vjp so the hook can transform this tensor's incoming cotangent.
+        if self._node is None:
+            raise RuntimeError("register_hook on leaf tensors is not supported yet")
+        node, idx = self._node, self._out_index
+        orig = node.vjp_fn
+
+        def hooked(cots):
+            cots_t = list(cots) if node.n_outputs > 1 else [cots]
+            h = hook(_wrap_data(cots_t[idx], stop_gradient=True))
+            if h is not None:
+                cots_t[idx] = h._data if isinstance(h, Tensor) else h
+            return orig(tuple(cots_t) if node.n_outputs > 1 else cots_t[0])
+
+        node.vjp_fn = hooked
+
+    # ---- mutation (optimizer updates) ----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}"
+            )
+        self._data = value.astype(self._data.dtype)
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def _to(self, place=None):
+        if place is not None:
+            self._data = jax.device_put(self._data, place.jax_device())
+        return self
+
+    def astype(self, dtype):
+        from .. import ops
+
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # ---- python protocol ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self._data.dtype.name}{grad_str},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, idx):
+        from .. import ops
+
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        # Functional scatter under the hood (jax arrays are immutable).
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.t(self)
+
+
+def _wrap_data(val, stop_gradient=True):
+    t = Tensor.__new__(Tensor)
+    t._data = val
+    t.stop_gradient = stop_gradient
+    t.grad = None
+    t._node = None
+    t._out_index = 0
+    t.name = None
+    t.persistable = False
+    return t
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _install_operators():
+    """Attach arithmetic dunders (delegating to ops, so they're tape-recorded)."""
+    from .. import ops
+
+    def binop(name, fn, rfn=None):
+        def f(self, other):
+            return fn(self, other)
+
+        f.__name__ = name
+        setattr(Tensor, name, f)
+        if rfn is not None:
+
+            def rf(self, other):
+                return rfn(other, self)
+
+            rf.__name__ = "__r" + name[2:]
+            setattr(Tensor, "__r" + name[2:], rf)
+
+    binop("__add__", ops.add, ops.add)
+    binop("__sub__", ops.subtract, ops.subtract)
+    binop("__mul__", ops.multiply, ops.multiply)
+    binop("__truediv__", ops.divide, ops.divide)
+    binop("__floordiv__", ops.floor_divide, ops.floor_divide)
+    binop("__mod__", ops.remainder, ops.remainder)
+    binop("__pow__", ops.pow, ops.pow)
+    binop("__matmul__", ops.matmul)
+    Tensor.__neg__ = lambda self: ops.scale(self, -1.0)
+    Tensor.__abs__ = lambda self: ops.abs(self)
+    Tensor.__eq__ = lambda self, o: ops.equal(self, o)
+    Tensor.__ne__ = lambda self, o: ops.not_equal(self, o)
+    Tensor.__lt__ = lambda self, o: ops.less_than(self, o)
+    Tensor.__le__ = lambda self, o: ops.less_equal(self, o)
+    Tensor.__gt__ = lambda self, o: ops.greater_than(self, o)
+    Tensor.__ge__ = lambda self, o: ops.greater_equal(self, o)
+
+    # Method-style API mirror (python/paddle/tensor/ monkey-patching parity).
+    _methods = [
+        "matmul", "add", "subtract", "multiply", "divide", "pow", "abs",
+        "exp", "log", "sqrt", "rsqrt", "square", "sin", "cos", "tanh",
+        "mean", "sum", "max", "min", "prod", "argmax", "argmin",
+        "reshape", "transpose", "squeeze", "unsqueeze", "flatten",
+        "sum", "cumsum", "clip", "scale", "floor", "ceil", "round",
+        "sign", "norm", "dot", "dist", "topk", "sort", "argsort",
+        "split", "chunk", "tile", "expand", "expand_as", "gather",
+        "concat", "stack", "unbind", "numel_t", "isnan", "isinf", "isfinite",
+        "equal_all", "allclose", "logical_and", "logical_or", "logical_not",
+        "maximum", "minimum", "where_m", "masked_select", "index_select",
+        "roll", "flip", "unique", "nonzero", "broadcast_to",
+    ]
+    for m in set(_methods):
+        if hasattr(ops, m):
+            fn = getattr(ops, m)
+
+            def make(fn):
+                def method(self, *a, **k):
+                    return fn(self, *a, **k)
+
+                return method
+
+            setattr(Tensor, m, make(fn))

@@ -1,0 +1,35 @@
+"""paddle.device namespace."""
+from .core.device import (  # noqa: F401
+    set_device, get_device, current_place, device_count, is_compiled_with_tpu,
+    is_compiled_with_cuda, CPUPlace, TPUPlace, CUDAPlace, Place,
+)
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [get_device()]
+
+
+def synchronize():
+    """Block until all queued device work finishes (cuda.synchronize parity)."""
+    import jax
+
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class cuda:
+    @staticmethod
+    def synchronize():
+        synchronize()
+
+    @staticmethod
+    def device_count():
+        return device_count()

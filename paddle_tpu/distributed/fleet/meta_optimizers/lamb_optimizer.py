@@ -1,0 +1,21 @@
+"""LAMB meta-optimizer (meta_optimizers/lamb_optimizer.py parity):
+swaps the inner optimizer for Lamb."""
+from .meta_optimizer_base import MetaOptimizerBase
+from ....optimizer import Lamb
+
+
+class LambOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "lamb", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.lamb_configs if \
+            self.user_defined_strategy else {}
+        lamb = Lamb(
+            learning_rate=self.inner_opt.get_lr(),
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            parameters=getattr(self.inner_opt, "_parameter_list", None),
+        )
+        return lamb.minimize(loss, startup_program, parameter_list, no_grad_set)

@@ -1,0 +1,49 @@
+"""LARS meta-optimizer (meta_optimizers/lars_optimizer.py parity):
+layerwise-adaptive momentum (lars_momentum_op kernel equivalent)."""
+import jax.numpy as jnp
+
+from .meta_optimizer_base import MetaOptimizerBase
+from ....optimizer.optimizer import Momentum
+
+
+class LarsMomentum(Momentum):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, parameters=None, **kw):
+        super().__init__(learning_rate, momentum, parameters=parameters, **kw)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def update(self, param, grad, state, lr):
+        p32 = param.astype(jnp.float32)
+        g32 = grad.astype(jnp.float32)
+        pn = jnp.linalg.norm(p32)
+        gn = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (pn > 0) & (gn > 0),
+            self._lars_coeff * pn / (gn + self._lars_wd * pn + self._eps),
+            1.0,
+        )
+        v = self._momentum * state["velocity"] + local_lr * lr * (
+            g32 + self._lars_wd * p32
+        )
+        return param - v.astype(param.dtype), {"velocity": v}
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "lars", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.lars_configs if \
+            self.user_defined_strategy else {}
+        lars = LarsMomentum(
+            learning_rate=self.inner_opt.get_lr(),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            epsilon=cfg.get("epsilon", 0.0),
+            parameters=getattr(self.inner_opt, "_parameter_list", None),
+        )
+        return lars.minimize(loss, startup_program, parameter_list, no_grad_set)

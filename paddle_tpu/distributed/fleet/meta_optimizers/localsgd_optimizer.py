@@ -1,0 +1,40 @@
+"""LocalSGD meta-optimizer (meta_optimizers/localsgd_optimizer.py:443 parity).
+
+k local steps then parameter averaging across the data axis.  On a mesh this
+degenerates gracefully: params are global, so the averaging op is pmean over
+'data' when executed under shard_map (and identity in single-mesh eager).
+"""
+import jax
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "localsgd", False) or \
+            getattr(strategy, "adaptive_localsgd", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                         no_grad_set)
+        block = loss.block.program.global_block()
+        Operator = type(block.ops[0]) if block.ops else None
+        if Operator is None:
+            return result
+        _, params_grads = result
+
+        def avg_fn(v):
+            try:
+                return jax.lax.pmean(v, "data")
+            except BaseException:
+                return v
+
+        for p, _ in params_grads:
+            op = Operator(block, "c_allreduce_avg_param", {"X": [p.name]},
+                          {"Out": [p.name]}, {}, fn=avg_fn)
+            op.in_order = [p.name]
+            op.out_order = [p.name]
+            block.ops.append(op)
+        return result

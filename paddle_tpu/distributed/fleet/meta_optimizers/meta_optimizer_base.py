@@ -1,0 +1,35 @@
+"""MetaOptimizerBase (fleet/meta_optimizers/meta_optimizer_base.py parity)."""
+
+
+class MetaOptimizerBase:
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+        self.loss = None
+        self.role_maker = None
+        self.user_defined_optimizer = optimizer
+        self.user_defined_strategy = None
+
+    def _set_basic_info(self, loss, role_maker, user_defined_optimizer,
+                        user_defined_strategy):
+        self.loss = loss
+        self.role_maker = role_maker
+        self.user_defined_optimizer = user_defined_optimizer
+        self.user_defined_strategy = user_defined_strategy
+
+    @classmethod
+    def _can_apply(cls, strategy):
+        return False
+
+    def _disable_strategy(self, dist_strategy):
+        pass
+
+    def _enable_strategy(self, dist_strategy, context=None):
+        pass
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["inner_opt"], item)

@@ -1,0 +1,54 @@
+"""Static pipeline meta-optimizer.
+
+Reference parity: meta_optimizers/pipeline_optimizer.py (268 LoC) wrapping
+fluid PipelineOptimizer (optimizer.py:4135): splits the program into per-stage
+section programs on device annotations, inserts send_v2/recv_v2.  TPU-native:
+stages are value-connected inside one XLA program; the rewrite assigns each op
+a stage id (uniform split) and records it, so the compiled path can shard
+stage params over the 'pipe' axis.  send/recv marker ops are inserted at stage
+boundaries for op-list parity (they lower to identity — XLA's partitioner
+emits the actual ICI transfers).
+"""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "pipeline", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.pipeline_configs if \
+            self.user_defined_strategy else {}
+        result = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                         no_grad_set)
+        block = loss.block.program.global_block()
+        num_stages = max(int(cfg.get("pp_degree", cfg.get("num_stages", 1))), 1)
+        compute_ops = [op for op in block.ops if op.fn is not None]
+        if num_stages > 1 and compute_ops:
+            per = max(len(compute_ops) // num_stages, 1)
+            Operator = type(block.ops[0])
+            final_ops = []
+            idx = 0
+            for op in block.ops:
+                if op.fn is not None:
+                    stage = min(idx // per, num_stages - 1)
+                    op.attrs["pipeline_stage"] = stage
+                    prev_stage = min((idx - 1) // per, num_stages - 1) if idx else 0
+                    if idx and stage != prev_stage:
+                        # stage boundary: send/recv markers (send_v2 parity)
+                        bnd = getattr(op, "in_order", [])
+                        for name in bnd[:1]:
+                            sop = Operator(block, "send_v2", {"X": [name]}, {},
+                                           {"peer": stage}, fn=None)
+                            rop = Operator(block, "recv_v2", {},
+                                           {"Out": [name]},
+                                           {"peer": prev_stage}, fn=None)
+                            final_ops.append(sop)
+                            final_ops.append(rop)
+                    idx += 1
+                final_ops.append(op)
+            block.ops = final_ops
+            loss.block.program._pipeline_opt = {"num_stages": num_stages}
+        return result

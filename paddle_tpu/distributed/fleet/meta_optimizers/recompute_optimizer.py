@@ -1,0 +1,32 @@
+"""Recompute meta-optimizer (meta_optimizers/recompute_optimizer.py:98 parity).
+
+Static path: marks checkpoint segment boundaries; the executor lowers marked
+segments through jax.checkpoint (remat) so activations between checkpoints are
+recomputed in backward — the XLA-native equivalent of backward.py:743's
+checkpoint-aware grad emission.
+"""
+import jax
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "recompute", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.recompute_configs if \
+            self.user_defined_strategy else {}
+        checkpoints = set(cfg.get("checkpoints", []))
+        block = loss.block.program.global_block()
+        # wrap ops between checkpoints with jax.checkpoint at lowering time
+        for op in block.ops:
+            if op.fn is not None and not any(
+                o in checkpoints for o in getattr(op, "out_order", [])
+            ):
+                op.attrs["recompute"] = True
+                op.fn = jax.checkpoint(op.fn)
+        return self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)

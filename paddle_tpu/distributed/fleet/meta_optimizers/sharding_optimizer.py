@@ -1,0 +1,107 @@
+"""Sharding (ZeRO) meta-optimizer.
+
+Reference parity: meta_optimizers/sharding_optimizer.py (1437 LoC) + sharding/
+(Shard.global_param2device sharding/shard.py:22-36 owner assignment,
+_split_program:503 segmentation, _add_broadcast_allreduce:746).  TPU-native
+design: parameter ownership maps to a PartitionSpec over the 'sharding' mesh
+axis — the broadcast-before-use / reduce-to-owner pattern is exactly what XLA
+emits for weight-sharded matmuls (all-gather param, reduce-scatter grad), so
+the static rewrite here (1) assigns owners with the reference's round-robin-
+by-size rule, (2) inserts `c_broadcast` / `c_reduce_sum` ops for op-list
+parity, and (3) records `dist_spec` metadata the compiled path consumes.
+"""
+import numpy as np
+
+from .meta_optimizer_base import MetaOptimizerBase
+from ....static.backward import GRAD_SUFFIX
+
+
+class Shard:
+    """sharding/shard.py parity."""
+
+    def __init__(self):
+        self.global_params = set()
+        self.worker_idx = -1
+        self.worker_num = -1
+        self.global_param2device = {}
+
+    def setup(self, params_grads, worker_idx, worker_num):
+        self.worker_idx = worker_idx
+        self.worker_num = worker_num
+        self.global_params = {p.name for p, _ in params_grads}
+        self.global_param2device = self._split_params(params_grads, worker_num)
+
+    def _split_params(self, params_grads, worker_num):
+        """Greedy smallest-bucket assignment (shard.py:22-36 rule)."""
+        mem = [0.0] * worker_num
+        param2device = {}
+        for p, _ in sorted(params_grads,
+                           key=lambda pg: -int(np.prod(pg[0].shape or [1]))):
+            device = int(np.argmin(mem))
+            param2device[p.name] = device
+            mem[device] += float(np.prod(p.shape or [1]))
+        return param2device
+
+    def has_param(self, name):
+        return self.global_param2device.get(name) == self.worker_idx
+
+    def device(self, name):
+        return self.global_param2device.get(name, -1)
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "sharding", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.sharding_configs if \
+            self.user_defined_strategy else {}
+        sharding_degree = int(cfg.get("sharding_degree", 8))
+        worker_idx = self.role_maker.worker_index() if self.role_maker else 0
+
+        result = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                         no_grad_set)
+        _, params_grads = result if isinstance(result, tuple) else (None, [])
+        block = loss.block.program.global_block()
+
+        self._shard = Shard()
+        self._shard.setup(params_grads, worker_idx % max(sharding_degree, 1),
+                          max(sharding_degree, 1))
+
+        from jax.sharding import PartitionSpec as P
+
+        Operator = type(block.ops[0]) if block.ops else None
+        final_ops = []
+        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
+                        "adagrad", "adadelta", "adamax"}
+        inserted = False
+        for op in block.ops:
+            if not inserted and op.type in update_types and Operator:
+                # broadcast params from owners + reduce grads to owners
+                for p, g in params_grads:
+                    dev = self._shard.device(p.name)
+                    bop = Operator(block, "c_broadcast", {"X": [p.name]},
+                                   {"Out": [p.name]},
+                                   {"root": dev, "ring_id": 0},
+                                   fn=lambda v: v)
+                    bop.in_order = [p.name]
+                    bop.out_order = [p.name]
+                    final_ops.append(bop)
+                    rop = Operator(block, "c_reduce_sum", {"X": [g.name]},
+                                   {"Out": [g.name]},
+                                   {"root_id": dev, "ring_id": 0},
+                                   fn=lambda v: v)
+                    rop.in_order = [g.name]
+                    rop.out_order = [g.name]
+                    final_ops.append(rop)
+                    # TPU-native: opt-state sharding spec for the compiled path
+                    pv = block.vars.get(p.name)
+                    if pv is not None:
+                        pv.opt_state_spec = P("sharding")
+                        pv.shard_owner = dev
+                inserted = True
+            final_ops.append(op)
+        block.ops = final_ops
+        return result

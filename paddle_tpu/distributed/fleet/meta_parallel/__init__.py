@@ -1,0 +1,9 @@
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer, SegmentLayers  # noqa: F401
+from .pipeline_parallel import PipelineParallel, TensorParallel, ShardingParallel  # noqa: F401
+from .random_rng import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)

@@ -1,0 +1,170 @@
+"""Tensor-parallel layers.
+
+Reference parity: fleet/meta_parallel/parallel_layers/mp_layers.py
+(VocabParallelEmbedding:30, ColumnParallelLinear:97, RowParallelLinear:170,
+ParallelCrossEntropy:249).  TPU-native design: parameters carry a
+PartitionSpec over the 'model' mesh axis (`param.dist_spec`); under pjit/
+shard_map the matmuls run on weight shards and the row-parallel psum lowers to
+an ICI AllReduce — the c_identity/c_allreduce pairs of the reference become
+value-level collectives XLA schedules.  Eager single-controller execution uses
+the full (global) weight, which is numerically identical.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer import Layer
+from ....nn import functional as F
+from ....nn.initializer import XavierNormal, Constant, Normal
+from ....core.registry import apply_op
+from ...fleet import topology_holder as _th
+
+
+def _mp_axis_in_scope():
+    try:
+        jax.lax.axis_index("model")
+        return True
+    except BaseException:
+        return False
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out (columns) over the 'model' axis."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        self.weight.dist_spec = P(None, "model")
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True, default_initializer=Constant(0.0)
+            )
+            self.bias.dist_spec = P("model")
+            self.bias.is_distributed = True
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and _mp_axis_in_scope():
+            out = apply_op(
+                "mp_allgather",
+                lambda v: jax.lax.all_gather(v, "model", axis=v.ndim - 1,
+                                             tiled=True),
+                (out,), {},
+            )
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in (rows); output psum over 'model'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal(),
+        )
+        self.weight.dist_spec = P("model", None)
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], is_bias=True, default_initializer=Constant(0.0)
+            )
+            self.bias.dist_spec = P()
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        if _mp_axis_in_scope():
+            out = apply_op(
+                "mp_allreduce", lambda v: jax.lax.psum(v, "model"), (out,), {}
+            )
+        if self.bias is not None:
+            from ....ops import math as M
+
+            out = M.add(out, self.bias)
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table row-sharded over 'model' (vocab dimension)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 0.02),
+        )
+        self.weight.dist_spec = P("model", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        if _mp_axis_in_scope():
+            # each shard owns a vocab range; mask + psum combines lookups
+            idx = x._data if hasattr(x, "_data") else x
+
+            def fn(w):
+                n = jax.lax.psum(1, "model")
+                per = self.num_embeddings // n
+                r = jax.lax.axis_index("model")
+                lo = r * per
+                local = jnp.clip(idx - lo, 0, per - 1)
+                emb = jnp.take(w, local, axis=0)
+                mask = ((idx >= lo) & (idx < lo + per))[..., None]
+                return jax.lax.psum(emb * mask.astype(emb.dtype), "model")
+
+            return apply_op("vocab_parallel_embedding", fn, (self.weight,), {})
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-sharded softmax cross entropy (mp_layers.py:249 parity;
+    c_softmax_with_cross_entropy op equivalent)."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):
+        lbl = label._data if hasattr(label, "_data") else label
+        if _mp_axis_in_scope():
+            def fn(logits):
+                # logits sharded on last (vocab) dim
+                n = jax.lax.psum(1, "model")
+                local_v = logits.shape[-1]
+                r = jax.lax.axis_index("model")
+                lo = r * local_v
+                gmax = jax.lax.pmax(jnp.max(logits, -1, keepdims=True), "model")
+                ex = jnp.exp(logits - gmax)
+                denom = jax.lax.psum(jnp.sum(ex, -1, keepdims=True), "model")
+                li = lbl
+                if li.ndim == logits.ndim and li.shape[-1] == 1:
+                    li = jnp.squeeze(li, -1)
+                local = jnp.clip(li - lo, 0, local_v - 1)
+                picked = jnp.take_along_axis(
+                    logits - gmax, local[..., None].astype(jnp.int32), axis=-1
+                )
+                mask = ((li >= lo) & (li < lo + local_v))[..., None]
+                num = jax.lax.psum(picked * mask.astype(picked.dtype), "model")
+                return jnp.log(denom) - num
+
+            return apply_op("parallel_cross_entropy", fn, (input,), {})
+        from ....ops.loss import softmax_with_cross_entropy
+
+        return softmax_with_cross_entropy(input, label)

@@ -1,0 +1,170 @@
+"""Pipeline-parallel training schedule.
+
+Reference parity: fleet/meta_parallel/pipeline_parallel.py (PipelineParallel:32,
+train_batch:114 — F-then-B micro-batch schedule; p2p activations
+_send_activations:382/_recv_activations:443) and the static SectionWorker 1F1B
+(section_worker.cc:167-183).  TPU-native design: stage-to-stage transfer is a
+value dependency — in the single-controller model the next stage simply
+consumes the previous stage's output (XLA/ICI moves the bytes); the compiled
+multi-stage path (parallel/pipeline_compile.py) uses collective-permute over
+the 'pipe' axis inside one program, which is the 1F1B equivalent with
+micro-batch rotation.
+"""
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from ....ops import manipulation as MAN
+from ....ops import math as M
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("layers must be a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        conf = {}
+        if strategy is not None:
+            conf = getattr(strategy, "pipeline_configs", {}) or {}
+        self.accumulate_steps = conf.get("accumulate_steps", 1)
+        self.micro_batch_size = conf.get("micro_batch_size", 1)
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.total_loss = None
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def _split_micro(self, data):
+        if isinstance(data, (tuple, list)):
+            xs = [self._split_micro(t) for t in data]
+            return list(zip(*xs))
+        n = self.accumulate_steps
+        B = data.shape[0]
+        mb = B // n
+        return [data[i * mb: (i + 1) * mb] for i in range(n)]
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """F-then-B schedule (pipeline_parallel.py:114 parity): run all
+        micro-batch forwards through the staged layer list, then all
+        backwards, then one optimizer step on accumulated grads."""
+        x, label = data
+        micro_x = self._split_micro(x)
+        micro_y = self._split_micro(label)
+
+        losses = []
+        # forward of all micro-batches (stage boundaries are value deps;
+        # under the compiled path each stage's ops run on its pipe slice)
+        for mx, my in zip(micro_x, micro_y):
+            out = self._layers.forward(mx)
+            loss = self._layers.loss(out, my)
+            losses.append(loss)
+
+        # backward of all micro-batches (reverse order, 1F1B-equivalent
+        # dataflow once compiled)
+        n = len(losses)
+        total = None
+        for loss in reversed(losses):
+            scaled = M.scale(loss, 1.0 / n)
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            scaled.backward()
+            total = scaled if total is None else M.add(total, scaled)
+
+        self.allreduce_shared_weight_gradients()
+
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        self.total_loss = total
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        x, label = data
+        out = self._layers.forward(x)
+        if compute_loss:
+            return self._layers.loss(out, label)
+        return out
+
+    def allreduce_shared_weight_gradients(self):
+        # shared embeddings appear once in the param list (single-controller),
+        # so their grads already accumulate across tied uses via the tape
+        pass
+
+    def save_state_dict(self, path):
+        from ....framework import save
+
+        save(self.state_dict(), path)
+
+    def load_state_dict(self, path):
+        from ....framework import load
+
+        self.set_state_dict(load(path))
+
+
+class TensorParallel(Layer):
+    """fleet/meta_parallel/tensor_parallel.py:40 parity: broadcast inputs and
+    sync params across the TP group at start — a no-op for single-controller
+    global arrays (they are already consistent)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class ShardingParallel(Layer):
+    """fleet/meta_parallel/sharding_parallel.py:33 parity."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

@@ -1,0 +1,176 @@
+"""Pipeline layer container.
+
+Reference parity: fleet/meta_parallel/parallel_layers/pp_layers.py
+(LayerDesc:44, SharedLayerDesc:62, PipelineLayer:76, SegmentLayers:23 uniform /
+param-count partitioning, shared-weight groups for embedding tying).
+"""
+import numpy as np
+
+from ....nn.layer import Layer
+from ....nn.layers.container import LayerList
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("The input of LayerDesc must be derived from Layer")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """pp_layers.py:23 parity: partition layer list into num_parts stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        assert self.num_items >= self.num_parts
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":")[1]
+            weights = [
+                1 if type(d).__name__ == cls_name
+                or (isinstance(d, LayerDesc) and d.layer_func.__name__ == cls_name)
+                else 0
+                for d in self._layers_desc
+            ]
+            return self._segment_by_weight(weights)
+        # param-count weighting
+        weights = []
+        for d in self._layers_desc:
+            if isinstance(d, LayerDesc):
+                try:
+                    l = d.build_layer()
+                    weights.append(
+                        sum(int(np.prod(p.shape)) for p in l.parameters()) or 1
+                    )
+                except Exception:
+                    weights.append(1)
+            else:
+                weights.append(1)
+        return self._segment_by_weight(weights)
+
+    def uniform(self, num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+    def _segment_by_weight(self, weights):
+        total = sum(weights)
+        target = total / self.num_parts
+        result = [0]
+        acc = 0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= target * len(result) and len(result) < self.num_parts:
+                result.append(i + 1)
+        while len(result) < self.num_parts:
+            result.append(self.num_items)
+        result.append(self.num_items)
+        return result[: self.num_parts + 1]
+
+
+class PipelineLayer(Layer):
+    """pp_layers.py:76 parity.  Holds the FULL layer list; stage boundaries
+    are recorded so the pipeline schedule (pipeline_parallel.py) can run
+    per-stage segments under shard_map over the 'pipe' axis, with params
+    sharded stage-wise (each stage's params live on its pipe slice)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1
+        )
+        self._recompute_interval = recompute_interval
+
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # build ALL layers (single-controller owns the full model; device
+        # placement comes from stage-wise sharding specs)
+        self.run_function = []
+        self._shared_layers = {}
+        built = LayerList()
+        for i, d in enumerate(self._layers_desc):
+            stage = self._stage_of(i)
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                layer = self._shared_layers[d.layer_name]
+                if d.forward_func is not None:
+                    fwd = d.forward_func
+                    layer_fn = _SharedForward(layer, fwd)
+                else:
+                    layer_fn = layer
+            elif isinstance(d, LayerDesc):
+                layer_fn = d.build_layer()
+            else:
+                layer_fn = d  # plain Layer or callable
+            if isinstance(layer_fn, Layer):
+                built.append(layer_fn)
+                for p in layer_fn.parameters():
+                    p.pipeline_stage = stage
+            self.run_function.append(layer_fn)
+        self.layers = built
+
+    def _stage_of(self, idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def get_stage_from_index(self, layer_idx):
+        return self._stage_of(layer_idx)
+
+    def stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def forward(self, input):
+        x = input
+        for fn in self.run_function:
+            x = fn(x) if callable(fn) else fn.forward(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)
+
+
+class _SharedForward(Layer):
+    def __init__(self, layer, fwd):
+        super().__init__()
+        self.shared = layer
+        self._fwd = fwd
+
+    def forward(self, x):
+        return self._fwd(self.shared, x)

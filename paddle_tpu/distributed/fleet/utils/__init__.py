@@ -1,0 +1,4 @@
+from .recompute import recompute, RecomputeFunction  # noqa: F401
+from .hybrid_parallel_util import (  # noqa: F401
+    fused_allreduce_gradients, sync_params_buffers,
+)

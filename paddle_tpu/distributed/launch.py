@@ -1,0 +1,68 @@
+"""python -m paddle_tpu.distributed.launch parity (fleet/launch.py:396).
+
+Reference behavior: parse devices/ips, build a Cluster/Pod, popen one worker
+per device with PADDLE_* env (launch_utils.py).  TPU-native: one controller
+process per HOST (not per chip); we export the same PADDLE_* env so training
+scripts keep working, and rely on jax.distributed.initialize for multi-host
+rendezvous (the coordination service replaces the TCP nccl-id broadcast).
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_fn=None):
+        self.proc = proc
+        self.rank = rank
+        self.log_fn = log_fn
+
+
+def watch_local_trainers(procs, nranks):
+    """distributed/utils.py watch_local_trainers parity: abort all if any dies."""
+    alive = []
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            for other in procs:
+                if other.proc.poll() is None:
+                    other.proc.terminate()
+            raise RuntimeError(f"trainer rank {tp.rank} failed with code {ret}")
+    return alive
+
+
+def launch_workers(training_script, args, nnodes=1, node_rank=0,
+                   master_endpoint="127.0.0.1:6170"):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(node_rank),
+        "PADDLE_TRAINERS_NUM": str(nnodes),
+        "PADDLE_MASTER": master_endpoint,
+    })
+    proc = subprocess.Popen([sys.executable, training_script] + list(args),
+                            env=env)
+    return [TrainerProc(proc, node_rank)]
+
+
+def launch():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master", default="127.0.0.1:6170")
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    a = parser.parse_args()
+    procs = launch_workers(a.training_script, a.script_args, a.nnodes,
+                           a.node_rank, a.master)
+    import time
+
+    while procs:
+        procs = watch_local_trainers(procs, a.nnodes)
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,35 @@
+"""paddle.distributed.spawn parity (spawn.py:333).
+
+TPU-native note: the single-controller mesh model doesn't need one process per
+device on a host — `spawn` exists for API/test parity and for multi-host DCN
+launches where each host runs one controller process.
+"""
+import multiprocessing as mp
+
+
+def _wrap(func, rank, nprocs, args):
+    import os
+
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(nprocs))
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs <= 1:
+        _wrap(func, 0, max(nprocs, 1), args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_wrap, args=(func, rank, nprocs, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode:
+                raise RuntimeError(f"spawned rank failed with {p.exitcode}")
+    return procs

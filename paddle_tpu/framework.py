@@ -1,0 +1,117 @@
+"""Framework-level utilities: unified flags, save/load, mode switches.
+
+Reference parity: the reference has four config systems (SURVEY §5.6) — gflags
+(platform/flags.cc), DistributedStrategy proto, Build/ExecutionStrategy,
+TrainerDesc.  Consolidated here into ONE registry (`set_flags`/`get_flags`,
+framework.py:5863 parity) with env pickup (FLAGS_* like the reference's gflags
+env behavior).  save/load: paddle.save/paddle.load of state_dict pickles
+(fluid/io.py:1840/1948 and dygraph checkpoint semantics).
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+# ---- flags (SURVEY §5.6 consolidation) ----
+
+_FLAGS = {
+    # defaults mirroring the reference's core set (platform/flags.cc:33-241)
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_allocator_strategy": "pjrt",  # PJRT owns HBM (SURVEY §7.1)
+    "FLAGS_use_bf16_matmul": True,
+    "FLAGS_flash_attention": False,
+    "FLAGS_profile": False,
+    "FLAGS_seed": 0,
+}
+
+
+def _env_pickup():
+    for k in list(_FLAGS):
+        if k in os.environ:
+            v = os.environ[k]
+            cur = _FLAGS[k]
+            if isinstance(cur, bool):
+                _FLAGS[k] = v.lower() in ("1", "true", "yes")
+            elif isinstance(cur, float):
+                _FLAGS[k] = float(v)
+            elif isinstance(cur, int):
+                _FLAGS[k] = int(v)
+            else:
+                _FLAGS[k] = v
+
+
+_env_pickup()
+
+
+def set_flags(flags):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
+
+
+def register_flag(name, default):
+    _FLAGS.setdefault(name, default)
+    return _FLAGS[name]
+
+
+# ---- save / load ----
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save parity (fluid/io.py:1840; dygraph state_dict pickles)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load parity (fluid/io.py:1948)."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj)
+
+
+def _from_saved(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v) for v in obj)
+    return obj
+
+
+def in_dygraph_mode():
+    from .static import program as _p
+
+    return _p._dygraph_mode
+
+
+# name parity aliases
+ParamBase = Tensor
+EagerParamBase = Tensor
+
+
+class CPUPlace:  # re-export for fluid-style code
+    pass

@@ -1,0 +1,255 @@
+"""High-level Model API.
+
+Reference parity: python/paddle/hapi/model.py (Model:878, fit:1523,
+evaluate, predict, save/load, prepare) — Keras-like training loops over
+DataLoader with callbacks.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+from ..io.dataloader import DataLoader
+from ..metric import Metric
+from . import callbacks as cbks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+
+    # ---- single-step primitives (hapi/model.py train_batch parity) ----
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*[self._to_tensor(x) for x in inputs])
+        losses = self._compute_loss(outputs, labels)
+        total = losses[0]
+        for l in losses[1:]:
+            from ..ops import math as M
+
+            total = M.add(total, l)
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return self._loss_values(losses), metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            inputs = self._to_list(inputs)
+            labels = self._to_list(labels)
+            outputs = self.network(*[self._to_tensor(x) for x in inputs])
+            losses = self._compute_loss(outputs, labels)
+            metrics = self._update_metrics(outputs, labels)
+        return self._loss_values(losses), metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            inputs = self._to_list(inputs)
+            outputs = self.network(*[self._to_tensor(x) for x in inputs])
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return []
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        losses = self._loss(*(list(outs) + list(labels)))
+        return losses if isinstance(losses, (list, tuple)) else [losses]
+
+    def _update_metrics(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        res = {}
+        for m in self._metrics:
+            stats = m.compute(*(list(outs) + list(labels)))
+            if isinstance(stats, (list, tuple)):
+                r = m.update(*stats)
+            else:
+                r = m.update(stats)
+            names = m.name()
+            names = names if isinstance(names, list) else [names]
+            vals = r if isinstance(r, (list, tuple)) else [r]
+            for n, v in zip(names, vals):
+                res[n] = v
+        return res
+
+    @staticmethod
+    def _loss_values(losses):
+        return [float(np.asarray(l.numpy()).reshape(-1)[0]) for l in losses]
+
+    @staticmethod
+    def _to_list(x):
+        if x is None:
+            return []
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+
+    @staticmethod
+    def _to_tensor(x):
+        return x if isinstance(x, Tensor) else to_tensor(x)
+
+    # ---- loops (fit:1523 parity) ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._as_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None else None
+
+        cblist = cbks.CallbackList(callbacks or [])
+        cblist.set_model(self)
+        cblist.set_params({
+            "epochs": epochs, "steps": self._safe_len(train_loader),
+            "verbose": verbose,
+            "metrics": ["loss"] + self._metric_names(),
+        })
+        cblist.on_train_begin()
+        self.stop_training = False
+
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, data in enumerate(train_loader):
+                cblist.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(data)
+                losses, metrics = self.train_batch(ins, lbs)
+                logs = {"loss": losses[0] if losses else 0.0, **metrics,
+                        "step": step}
+                cblist.on_train_batch_end(step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cblist.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cblist.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        total_loss, count = 0.0, 0
+        for step, data in enumerate(loader):
+            ins, lbs = self._split_batch(data)
+            losses, _ = self.eval_batch(ins, lbs)
+            if losses:
+                total_loss += losses[0]
+                count += 1
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = {"loss": total_loss / max(count, 1)}
+        for m in self._metrics:
+            names = m.name()
+            names = names if isinstance(names, list) else [names]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for data in loader:
+            ins, _ = self._split_batch(data, has_label=False)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _split_batch(self, data, has_label=True):
+        if isinstance(data, (list, tuple)):
+            data = list(data)
+            if has_label and len(data) >= 2:
+                n_in = len(self._inputs) if self._inputs else len(data) - 1
+                return data[:n_in], data[n_in:]
+            return data, []
+        return [data], []
+
+    @staticmethod
+    def _safe_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    # ---- save / load ----
+    def save(self, path, training=True):
+        from ..framework import save as fsave
+
+        if training:
+            fsave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                fsave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from ..jit import save as jit_save
+
+            jit_save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load as fload
+
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .. import summary as _summary
+
+        return _summary(self.network)

@@ -1,0 +1,12 @@
+"""paddle.io parity: Dataset / DataLoader / samplers.
+
+Reference parity: python/paddle/fluid/reader.py:146 (DataLoader),
+fluid/dataloader/ (Dataset, IterableDataset, Sampler, BatchSampler,
+dataloader_iter multiprocess workers).  TPU-native: workers feed a host-side
+prefetch queue; batches are collated to numpy and transferred H2D as whole
+arrays (the BufferedReader double-buffer role is played by jax async dispatch +
+a background prefetch thread).
+"""
+from .dataset import Dataset, IterableDataset, TensorDataset, ComposeDataset, Subset, random_split  # noqa: F401
+from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

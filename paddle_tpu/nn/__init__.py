@@ -1,0 +1,113 @@
+"""paddle_tpu.nn — layer zoo.  Ref: python/paddle/nn/ (SURVEY §2.2)."""
+from .layer import Layer, ParamAttr, create_parameter  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layers.common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, AlphaDropout, Flatten, Identity,
+    Pad1D, Pad2D, Upsample, PixelShuffle, CosineSimilarity, Bilinear,
+)
+from .layers.conv import Conv1D, Conv2D, Conv2DTranspose  # noqa: F401
+from .layers.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, GroupNorm,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layers.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool2D,
+    AdaptiveMaxPool2D,
+)
+from .layers.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Silu, Swish, Mish, Softsign,
+    Tanhshrink, Hardsigmoid, Hardswish, Softplus, Selu, GELU, LeakyReLU, ELU,
+    PReLU, Hardshrink, Softshrink, Hardtanh, ThresholdedReLU, Softmax,
+    LogSoftmax, Maxout,
+)
+from .layers.container import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList,
+)
+from .layers.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, BCELoss,
+    BCEWithLogitsLoss, NLLLoss, KLDivLoss, MarginRankingLoss,
+)
+from .layers.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layers.rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU,
+    RNNCellBase,
+)
+from ..core.autograd import no_grad  # noqa: F401
+
+
+class ClipGradByGlobalNorm:
+    """Ref: fluid/clip.py:345 ClipGradByGlobalNorm — composed from primitive ops."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        from ..core.tensor import _wrap_data
+
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        global_norm = jnp.sqrt(
+            sum(jnp.sum(jnp.square(g._data.astype(jnp.float32))) for g in grads)
+        )
+        clip = jnp.minimum(1.0, self.clip_norm / jnp.maximum(global_norm, 1e-6))
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, _wrap_data((g._data * clip).astype(g._data.dtype))))
+        return out
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        from ..core.tensor import _wrap_data
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g._data.astype(jnp.float32))))
+            clip = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-6))
+            out.append((p, _wrap_data((g._data * clip).astype(g._data.dtype))))
+        return out
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+
+        from ..core.tensor import _wrap_data
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, _wrap_data(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+def utils_clip_grad_norm_(parameters, max_norm):
+    clip = ClipGradByGlobalNorm(max_norm)
+    pg = [(p, p.grad) for p in parameters if p.grad is not None]
+    for (p, _), (_, g) in zip(pg, clip(pg)):
+        p.grad = g

@@ -1,0 +1,64 @@
+"""paddle.nn.functional namespace.
+
+Reference parity: python/paddle/nn/functional/ — thin functional mirrors of the
+op library (ops/nn_ops.py, ops/loss.py).
+"""
+from ..ops.nn_ops import (  # noqa: F401
+    conv1d, conv2d, conv2d_transpose, max_pool1d, max_pool2d, avg_pool1d,
+    avg_pool2d, adaptive_avg_pool2d, adaptive_max_pool2d, relu, relu6, sigmoid,
+    log_sigmoid, silu, swish, mish, softplus, softsign, tanhshrink, hardsigmoid,
+    hardswish, hardtanh, selu, gelu, leaky_relu, elu, prelu, hardshrink,
+    softshrink, thresholded_relu, softmax, log_softmax, glu, maxout, layer_norm,
+    batch_norm, instance_norm, group_norm, local_response_norm, normalize,
+    dropout, dropout2d, alpha_dropout, embedding, linear, interpolate, upsample,
+    pixel_shuffle, unfold,
+)
+from ..ops.loss import (  # noqa: F401
+    softmax_with_cross_entropy, cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    kl_div, hinge_loss, margin_ranking_loss, cosine_similarity,
+    square_error_cost, sigmoid_focal_loss,
+)
+from ..ops.math import tanh  # noqa: F401
+from ..ops.manipulation import pad, one_hot  # noqa: F401
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    from ..ops import math as M
+    from ..core.tensor import to_tensor
+
+    n = label.shape[-1]
+    smoothed = M.add(
+        M.scale(label, 1.0 - epsilon),
+        to_tensor(epsilon / n) if prior_dist is None else M.scale(prior_dist, epsilon),
+    )
+    return smoothed
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    import jax.numpy as jnp
+
+    from ..core.registry import apply_op
+
+    def fn(v):
+        n = v.shape[-1]
+        out = jnp.zeros(v.shape + (n + abs(offset),), v.dtype)
+        eye = jnp.eye(n, n + abs(offset), k=max(offset, 0), dtype=v.dtype)
+        return jnp.einsum("...i,ij->...ij", v, eye) if offset >= 0 else jnp.einsum(
+            "...i,ij->...ji", v, eye
+        )
+
+    return apply_op("diag_embed", fn, (input,), {})
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.tensor import Tensor, _wrap_data
+    from ..core.dtype import convert_dtype
+
+    lv = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lv))
+    mask = jnp.arange(m) < lv[..., None]
+    return _wrap_data(mask.astype(convert_dtype(dtype)))

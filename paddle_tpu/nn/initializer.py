@@ -1,0 +1,153 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/fluid/initializer.py (Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/Assign) and paddle.nn.initializer.  Each initializer
+is a callable (shape, dtype) -> jax array using threefry keys.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jnp.full(tuple(shape), self.value, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return jax.random.uniform(
+            _random.next_key(), tuple(shape), dtype, self.low, self.high
+        )
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return (
+            jax.random.normal(_random.next_key(), tuple(shape), dtype) * self.std
+            + self.mean
+        )
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return (
+            jax.random.truncated_normal(_random.next_key(), -2.0, 2.0, tuple(shape), dtype)
+            * self.std
+            + self.mean
+        )
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            _random.next_key(), tuple(shape), dtype, -limit, limit
+        )
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(_random.next_key(), tuple(shape), dtype) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in = fan_in
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(
+            _random.next_key(), tuple(shape), dtype, -limit, limit
+        )
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in = fan_in
+
+    def __call__(self, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = math.sqrt(2.0 / fi)
+        return jax.random.normal(_random.next_key(), tuple(shape), dtype) * std
+
+
+MSRAInitializer = KaimingNormal
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        return jnp.reshape(arr, tuple(shape))
+
+
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+XavierInitializer = XavierNormal
+NumpyArrayInitializer = Assign
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    # module-level defaults consumed by create_parameter
+    from . import layer as _layer
+
+    _layer._global_weight_init = weight_init
+    _layer._global_bias_init = bias_init

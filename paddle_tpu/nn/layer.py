@@ -1,0 +1,351 @@
+"""nn.Layer base class.
+
+Reference parity: python/paddle/fluid/dygraph/layers.py:880 (`Layer.__call__`,
+parameter/sublayer registries, hooks, state_dict/set_state_dict, to/astype) and
+ParamBase (framework.py).  TPU-native: parameters are Tensors whose buffers are
+jax Arrays; `functional_call` (not in the reference) exposes a pure
+params->outputs view of the layer so whole steps can be jit/pjit-compiled —
+this is the compile-friendly spine that replaces per-op dispatch (SURVEY §7.3).
+"""
+import collections
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.dtype import convert_dtype
+from ..core import autograd
+
+
+class ParamAttr:
+    """Parity: paddle.ParamAttr (fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        return ParamAttr(initializer=attr)
+
+
+_param_counter = [0]
+
+
+def create_parameter(shape, dtype="float32", attr=None, is_bias=False,
+                     default_initializer=None):
+    from .initializer import Constant, XavierNormal, Normal
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    data = init(shape, convert_dtype(dtype))
+    p = Tensor(data, stop_gradient=not attr.trainable)
+    p.persistable = True
+    _param_counter[0] += 1
+    p.name = attr.name or f"param_{_param_counter[0]}"
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    p.is_bias = is_bias
+    p.trainable = attr.trainable
+    return p
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+        self._dtype = dtype
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ---- registration ----
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, Tensor) and getattr(value, "persistable", False) and params is not None:
+            params.pop(name, None)
+            self.__dict__.get("_buffers", {}).pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if layers is not None and name in layers:
+                del layers[name]
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            reg = self.__dict__.get(d)
+            if reg is not None and name in reg:
+                return reg[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            reg = self.__dict__.get(d)
+            if reg is not None and name in reg:
+                del reg[name]
+                if name in self.__dict__:
+                    object.__delattr__(self, name)
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None:
+            self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+            if tensor is not None:
+                tensor._non_persistable_buffer = True
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        return create_parameter(
+            shape, dtype or self._dtype, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer,
+        )
+
+    # ---- traversal ----
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if prefix else name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}{lname}." if prefix else f"{lname}."
+                for n, p in layer.named_parameters(prefix=sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for _, l in self.named_sublayers():
+            out.append(l)
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, layer
+            yield from layer.named_sublayers(prefix=p)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (prefix + name if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}{lname}." if prefix else f"{lname}."
+                yield from layer.named_buffers(prefix=sub_prefix)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        return (l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return ((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ---- modes ----
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ---- hooks ----
+    def register_forward_pre_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ---- call ----
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + r for r in rep[1:]]
+            lines.append(f"  ({name}): " + "\n".join(rep))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    # ---- state dict ----
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            # persistability is tagged on the buffer itself so sublayer
+            # buffers are filtered correctly regardless of name collisions
+            if not getattr(b, "_non_persistable_buffer", False):
+                dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                own[k].set_value(arr)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ---- dtype/device movement ----
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for p in self.parameters():
+                p._data = p._data.astype(dt)
+            for b in self.buffers():
+                if b is not None and np.issubdtype(np.dtype(b._data.dtype), np.floating):
+                    b._data = b._data.astype(dt)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ---- functional view (TPU-native extension) ----
+    def functional_call(self, params, *inputs, buffers=None, **kwargs):
+        """Run forward with parameter values substituted from `params`
+        (dict name -> jax array / Tensor).  Pure w.r.t. the layer: enables
+        jax.jit / pjit over the whole step."""
+        named = dict(self.named_parameters())
+        saved = {n: p._data for n, p in named.items()}
+        saved_buf = {}
+        if buffers:
+            named_buf = dict(self.named_buffers())
+            for n, v in buffers.items():
+                if n in named_buf:
+                    saved_buf[n] = named_buf[n]._data
+                    named_buf[n]._data = v._data if isinstance(v, Tensor) else v
+        try:
+            for n, v in params.items():
+                if n in named:
+                    named[n]._data = v._data if isinstance(v, Tensor) else v
+            return self.forward(*inputs, **kwargs)
+        finally:
+            for n, v in saved.items():
+                named[n]._data = v
+            if saved_buf:
+                named_buf = dict(self.named_buffers())
+                for n, v in saved_buf.items():
+                    named_buf[n]._data = v
+
+    def param_arrays(self):
+        """dict name -> jax array of current parameter values."""
+        return {n: p._data for n, p in self.named_parameters()}
+
+
+class HookRemoveHelper:
+    _next_id = [0]
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self.id = HookRemoveHelper._next_id[0]
+        HookRemoveHelper._next_id[0] += 1
+
+    def remove(self):
+        self._hooks.pop(self.id, None)

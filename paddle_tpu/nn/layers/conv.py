@@ -1,0 +1,83 @@
+"""Convolution layers.  Ref: python/paddle/nn/layer/conv.py."""
+import numpy as np
+
+from ..layer import Layer
+from .. import functional as F
+from ..initializer import KaimingNormal, Constant
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride, padding,
+                 dilation, groups, weight_attr, bias_attr, data_format, nd,
+                 transpose=False, output_padding=0):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = [kernel_size] * nd
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = list(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self._output_padding = output_padding
+        if transpose:
+            w_shape = [in_channels, out_channels // groups] + self._kernel_size
+        else:
+            w_shape = [out_channels, in_channels // groups] + self._kernel_size
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr, default_initializer=KaimingNormal()
+        )
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True
+        ) if bias_attr is not False else None
+
+    def extra_repr(self):
+        return (
+            f"{self._in_channels}, {self._out_channels}, "
+            f"kernel_size={self._kernel_size}, stride={self._stride}"
+        )
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format, 2)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups, data_format=self._data_format)
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format, 1)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups, data_format=self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format, 2,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(
+            x, self.weight, self.bias, stride=self._stride, padding=self._padding,
+            output_padding=self._output_padding, dilation=self._dilation,
+            groups=self._groups, output_size=output_size,
+            data_format=self._data_format,
+        )

@@ -1,0 +1,177 @@
+"""Normalization layers.  Ref: python/paddle/nn/layer/norm.py (BatchNorm
+running stats batch_norm_op.cc; SyncBatchNorm nccl cross-replica — here the
+sync variant computes stats with a psum over the data-parallel mesh axis when
+running inside shard_map, cf. parallel/env.py)."""
+import numpy as np
+
+from ..layer import Layer
+from .. import functional as F
+from ..initializer import Constant
+from ...core.tensor import Tensor, to_tensor
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None,
+                 name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=Constant(1.0)
+        ) if weight_attr is not False else None
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True
+        ) if bias_attr is not False else None
+        mean = Tensor(np.zeros(num_features, np.float32), stop_gradient=True)
+        var = Tensor(np.ones(num_features, np.float32), stop_gradient=True)
+        self.register_buffer("_mean", mean)
+        self.register_buffer("_variance", var)
+
+    def forward(self, x):
+        return F.batch_norm(
+            x, self._mean, self._variance, weight=self.weight, bias=self.bias,
+            training=self.training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format, use_global_stats=self._use_global_stats,
+        )
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-style BatchNorm (dygraph/nn.py) — same mechanics."""
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.  In the mesh execution model, stats sync
+    happens automatically when the batch axis is sharded under pjit (XLA emits
+    the cross-replica reductions); eager single-process behaves like BatchNorm.
+    Ref: nn/layer/norm.py SyncBatchNorm + sync_batch_norm_op.cu."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(
+                layer._num_features, layer._momentum, layer._epsilon,
+                data_format=layer._data_format,
+            )
+            new.weight, new.bias = layer.weight, layer.bias
+            new._mean, new._variance = layer._mean, layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, attr=weight_attr,
+            default_initializer=Constant(1.0),
+        ) if weight_attr is not False else None
+        self.bias = self.create_parameter(
+            self._normalized_shape, attr=bias_attr, is_bias=True
+        ) if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=Constant(1.0)
+        ) if weight_attr is not False else None
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True
+        ) if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self._epsilon)
+
+
+InstanceNorm1D = InstanceNorm2D
+InstanceNorm3D = InstanceNorm2D
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_channels], attr=weight_attr, default_initializer=Constant(1.0)
+        ) if weight_attr is not False else None
+        self.bias = self.create_parameter(
+            [num_channels], attr=bias_attr, is_bias=True
+        ) if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, name=None):
+        super().__init__()
+        self.dim, self.power_iters, self.epsilon = dim, power_iters, epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=None
+        )
+        self.weight_v = self.create_parameter([w])
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from ...core.registry import apply_op
+
+        dim, eps, iters = self.dim, self.epsilon, self.power_iters
+
+        def fn(w, u, v):
+            perm = [dim] + [i for i in range(w.ndim) if i != dim]
+            wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return w / sigma
+
+        return apply_op("spectral_norm", fn, (weight, self.weight_u, self.weight_v), {})

@@ -1,0 +1,341 @@
+"""RNN layers: cells + multi-layer bidirectional runners.
+
+Reference parity: python/paddle/nn/layer/rnn.py (SimpleRNNCell/LSTMCell/GRUCell,
+RNN, SimpleRNN/LSTM/GRU).  TPU-native: the time loop is jax.lax.scan (static
+shapes, compiler-friendly control flow) instead of the reference's per-step
+while op / cuDNN kernels.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..layer import Layer
+from ..initializer import Uniform
+from ...core.registry import apply_op
+from ...core.tensor import Tensor
+from ...ops import creation as C
+from ...ops import manipulation as MAN
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        B = batch_ref.shape[batch_dim_idx]
+        return C.full([B, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply_op("simple_rnn_cell", fn,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), {})
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs),
+                      self.get_initial_states(inputs))
+        h, c = states
+
+        def fn(x, hv, cv, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hv @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * cv + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+
+        h2, c2 = apply_op("lstm_cell", fn,
+                          (inputs, h, c, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), {}, n_outputs=2)
+        return h2, (h2, c2)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, hv, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hv @ wh.T + bh
+            ir, iz, ig = jnp.split(gi, 3, axis=-1)
+            hr, hz, hg = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            g = jnp.tanh(ig + r * hg)
+            return (1 - z) * g + z * hv
+
+        h = apply_op("gru_cell", fn,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), {})
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over time.  Ref: nn/layer/rnn.py RNN (wraps rnn op)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # Move to time-major, loop in python over time steps via the cell's
+        # tape-recorded ops (eager), so autograd works uniformly.  Inside
+        # jit/to_static this unrolls; for long sequences prefer the functional
+        # lstm/gru ops below which use lax.scan.
+        tm = inputs if self.time_major else MAN.transpose(
+            inputs, [1, 0] + list(range(2, inputs.ndim))
+        )
+        T = tm.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            out, states = self.cell(tm[t], states)
+            outs[t] = out
+        stacked = MAN.stack(outs, axis=0)
+        if not self.time_major:
+            stacked = MAN.transpose(stacked, [1, 0] + list(range(2, stacked.ndim)))
+        return stacked, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            initial_states = (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, initial_states[0])
+        out_bw, st_bw = self.rnn_bw(inputs, initial_states[1])
+        return MAN.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent net over lax.scan."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        self.num_directions = num_dirs
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(self.MODE, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = "_reverse" if direction else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                           weight_ih_attr, default_initializer=init)
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                           weight_hh_attr, default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size], bias_ih_attr,
+                                           is_bias=True, default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size], bias_hh_attr,
+                                           is_bias=True, default_initializer=init)
+                names = [f"weight_ih_l{layer}{suffix}", f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}", f"bias_hh_l{layer}{suffix}"]
+                for n, p in zip(names, [wi, wh, bi, bh]):
+                    self.add_parameter(n, p)
+                self._all_weights.append(names)
+
+    def _cell_step(self, mode):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c2 = f * c + i * g
+                return (o * jnp.tanh(c2), c2)
+            return step
+        if mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ig = jnp.split(gi, 3, axis=-1)
+                hr, hz, hg = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                g = jnp.tanh(ig + r * hg)
+                return ((1 - z) * g + z * h,)
+            return step
+
+        act = jnp.tanh if self.MODE == "RNN_TANH" else jax.nn.relu
+
+        def step(carry, x, wi, wh, bi, bh):
+            h = carry[0]
+            return (act(x @ wi.T + bi + h @ wh.T + bh),)
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE
+        n_states = 2 if mode == "LSTM" else 1
+        step = self._cell_step(mode)
+        num_dirs = self.num_directions
+        L, D, H = self.num_layers, num_dirs, self.hidden_size
+        tm_in = inputs if self.time_major else MAN.transpose(
+            inputs, [1, 0, 2]
+        )
+        B = tm_in.shape[1]
+
+        if initial_states is None:
+            init_h = C.zeros([L * D, B, H], "float32")
+            states = [init_h] * n_states
+        else:
+            states = list(initial_states) if n_states == 2 else [initial_states]
+
+        weights = []
+        for names in self._all_weights:
+            weights.extend(self._parameters[n] for n in names)
+
+        def fn(x, *flat):
+            ws = flat[: len(weights)]
+            sts = flat[len(weights):]
+            layer_in = x
+            out_h = []
+            out_c = []
+            for layer in range(L):
+                dir_outs = []
+                for d in range(D):
+                    k = (layer * D + d) * 4
+                    wi, wh, bi, bh = ws[k: k + 4]
+                    h0 = tuple(s[layer * D + d] for s in sts)
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+
+                    def scan_fn(carry, xt):
+                        new = step(carry, xt, wi, wh, bi, bh)
+                        return new, new[0]
+
+                    final, ys = jax.lax.scan(scan_fn, h0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    out_h.append(final[0])
+                    if n_states == 2:
+                        out_c.append(final[1])
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if D == 2 else dir_outs[0]
+            final_h = jnp.stack(out_h, 0)
+            if n_states == 2:
+                return layer_in, final_h, jnp.stack(out_c, 0)
+            return layer_in, final_h
+
+        args = (tm_in,) + tuple(weights) + tuple(states)
+        if n_states == 2:
+            out, h, c = apply_op(f"rnn_{mode}", fn, args, {}, n_outputs=3)
+            final_states = (h, c)
+        else:
+            out, h = apply_op(f"rnn_{mode}", fn, args, {}, n_outputs=2)
+            final_states = h
+        if not self.time_major:
+            out = MAN.transpose(out, [1, 0, 2])
+        return out, final_states
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

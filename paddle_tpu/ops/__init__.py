@@ -1,0 +1,20 @@
+"""paddle_tpu.ops — the operator library (SURVEY §2.1 "Operator library" row).
+
+Every op is a pure jax function registered in core.registry; eager calls record
+jax.vjp tape nodes, static Programs lower whole blocks through the same
+registry.  Reference: paddle/fluid/operators/ (286 top-level op defs); grads
+come from jax.vjp instead of GradOpMaker kernels.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .nn_ops import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+
+from .creation import assign, full, zeros, ones, arange  # noqa: F401
+from .math import (  # noqa: F401
+    add, subtract, multiply, divide, matmul, scale, clip, pow, abs, sum, mean,
+    max, min, equal, not_equal, less_than, less_equal, greater_than,
+    greater_equal,
+)
+from .manipulation import cast, reshape, transpose, concat, split, getitem  # noqa: F401

@@ -1,0 +1,54 @@
+"""Attention primitives.
+
+Reference parity: the reference composes attention from matmul/softmax/dropout
+(nn/layer/transformer.py:406-420) and ships fused CUDA kernels only for
+inference (operators/fused/multihead_matmul_op.cu).  Here the training core is
+a single fused dataflow XLA maps to the MXU; a Pallas flash-attention kernel
+(ops/pallas/flash_attention.py) is used for long sequences on TPU.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op
+from ..core.tensor import Tensor
+from ..core import random as _random
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, return_weights=False,
+                                 use_flash=None):
+    """q,k,v: [B, H, L, D].  attn_mask: additive float mask broadcastable to
+    [B, H, Lq, Lk]."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    if use_flash is None:
+        use_flash = False
+    if use_flash and not return_weights and dropout_p == 0.0:
+        from .pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, attn_mask=attn_mask, causal=is_causal), None
+
+    key = _random.next_key() if dropout_p > 0.0 else None
+
+    def fn(qv, kv, vv, *mask):
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qv, kv) * scale
+        if mask:
+            logits = logits + mask[0]
+        if is_causal:
+            Lq, Lk = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+            logits = jnp.where(causal, logits, -1e9)
+        weights = jax.nn.softmax(logits, axis=-1)
+        if dropout_p > 0.0:
+            keep = jax.random.bernoulli(key, 1.0 - dropout_p, weights.shape)
+            weights_d = jnp.where(keep, weights / (1.0 - dropout_p), 0.0)
+        else:
+            weights_d = weights
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights_d, vv)
+        return out, weights
+
+    args = (q, k, v) + ((attn_mask,) if attn_mask is not None else ())
+    out, weights = apply_op("sdp_attention", fn, args, {}, n_outputs=2)
+    return out, (weights if return_weights else None)

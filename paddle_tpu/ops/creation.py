@@ -1,0 +1,189 @@
+"""Creation ops.
+
+Reference parity: fill_constant / gaussian_random / uniform_random / range /
+linspace / eye / tril / triu op kernels (paddle/fluid/operators/*_op.cc) and
+python/paddle/tensor/creation.py.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+import jax
+
+from ..core.tensor import Tensor, to_tensor, _wrap_data
+from ..core.dtype import convert_dtype
+from ..core import random as _random
+
+
+def _dt(dtype, default="float32"):
+    d = convert_dtype(dtype)
+    return d if d is not None else convert_dtype(default)
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        shape = [shape]
+    if dtype is None:
+        dtype = "int64" if isinstance(fill_value, (int, np.integer)) and not isinstance(
+            fill_value, bool
+        ) else "float32"
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+    return _wrap_data(jnp.full(tuple(shape), fill_value, _dt(dtype)))
+
+
+fill_constant = full
+
+
+def zeros(shape, dtype="float32"):
+    return full(shape, 0, dtype or "float32")
+
+
+def ones(shape, dtype="float32"):
+    return full(shape, 1, dtype or "float32")
+
+
+def zeros_like(x, dtype=None):
+    return _wrap_data(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return _wrap_data(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return _wrap_data(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32"):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python scalars")
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else "float32"
+        )
+    return _wrap_data(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return _wrap_data(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return _wrap_data(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0):
+    v = x._data
+    if v.ndim == 1 and padding_value != 0:
+        d = jnp.diag(v, k=offset)
+        mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+        return _wrap_data(jnp.where(mask, d, padding_value))
+    return _wrap_data(jnp.diag(v, k=offset))
+
+
+def tril(x, diagonal=0):
+    return _wrap_data(jnp.tril(x._data, k=diagonal))
+
+
+def triu(x, diagonal=0):
+    return _wrap_data(jnp.triu(x._data, k=diagonal))
+
+
+def meshgrid(*args):
+    arrs = [a._data for a in args]
+    return [_wrap_data(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    from ..core.registry import apply_op
+
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = apply_op("assign", lambda v: v + 0, (x,), {})
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+# ---- random creation (threefry-keyed; cf. gaussian_random_op.cc) ----
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    return _wrap_data(
+        jax.random.uniform(key, tuple(shape), _dt(dtype), minval=min, maxval=max)
+    )
+
+
+uniform_random = uniform
+
+
+def rand(shape, dtype="float32"):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype="float32"):
+    return _wrap_data(jax.random.normal(_random.next_key(), tuple(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    out = jax.random.normal(_random.next_key(), tuple(shape or []), jnp.float32)
+    return _wrap_data(out * std + mean)
+
+
+gaussian = normal
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return _wrap_data(
+        jax.random.randint(_random.next_key(), tuple(shape), low, high).astype(
+            _dt(dtype, "int64")
+        )
+    )
+
+
+def randperm(n, dtype="int64"):
+    return _wrap_data(
+        jax.random.permutation(_random.next_key(), n).astype(_dt(dtype, "int64"))
+    )
+
+
+def bernoulli(x):
+    return _wrap_data(
+        jax.random.bernoulli(_random.next_key(), x._data).astype(x._data.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    probs = x._data
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    key = _random.next_key()
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(
+            *(logits.shape[:-1]), num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(key, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return _wrap_data(out.astype(jnp.int64) if False else out)

@@ -1,0 +1,222 @@
+"""Loss ops.
+
+Reference parity: softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+bce_loss_op.cc, huber_loss, kldiv_loss, margin ops, nll_loss
+(paddle/fluid/operators/) and python/paddle/nn/functional/loss.py.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op
+from ..core.tensor import Tensor, to_tensor
+
+
+def _reduce_loss(out, reduction):
+    from . import math as M
+
+    if reduction == "mean":
+        return M.mean(out)
+    if reduction == "sum":
+        return M.sum(out)
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False):
+    """Ref: softmax_with_cross_entropy_op.cc (fused, numerically stable)."""
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(lg):
+        logp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis, keepdims=True)
+        else:
+            li = lbl
+            if li.ndim == lg.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis=axis)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(li, axis).astype(jnp.int32), axis=axis
+            )
+            loss = -picked
+            if ignore_index >= 0:
+                mask = jnp.expand_dims(li, axis) != ignore_index
+                loss = loss * mask.astype(loss.dtype)
+        return loss
+
+    loss = apply_op("softmax_with_cross_entropy", fn, (logits,), {})
+    if return_softmax:
+        from .nn_ops import softmax as _sm
+
+        return loss, _sm(logits, axis=axis)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(lg, *w):
+        logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(lg, 1e-30)
+        )
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            li = lbl
+            if li.ndim == lg.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis=axis)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(li, axis).astype(jnp.int32), axis=axis
+            )
+            loss = -jnp.squeeze(picked, axis=axis)
+            if w:
+                loss = loss * jnp.take(w[0], li.astype(jnp.int32))
+            if ignore_index >= 0:
+                loss = jnp.where(li == ignore_index, 0.0, loss)
+        return loss
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    out = apply_op("cross_entropy", fn, args, {})
+    if reduction == "mean" and not soft_label and (
+        ignore_index >= 0 or weight is not None
+    ):
+        # weighted/ignored mean divides by sum of effective weights
+        from . import math as M
+
+        li = lbl
+        if weight is not None:
+            w_per = jnp.take(weight._data, li.astype(jnp.int32))
+            if ignore_index >= 0:
+                w_per = jnp.where(li == ignore_index, 0.0, w_per)
+            denom = float(jnp.sum(w_per))
+        else:
+            denom = float(jnp.sum(li != ignore_index))
+        return M.divide(M.sum(out), to_tensor(max(denom, 1e-12)))
+    return _reduce_loss(out, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(logp, *w):
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lbl, 1).astype(jnp.int32), axis=1
+        )
+        loss = -jnp.squeeze(picked, axis=1)
+        if w:
+            loss = loss * jnp.take(w[0], lbl.astype(jnp.int32))
+        return loss
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    return _reduce_loss(apply_op("nll_loss", fn, args, {}), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    out = apply_op("mse_loss", lambda a, b: jnp.square(a - b), (input, label), {})
+    return _reduce_loss(out, reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    out = apply_op("l1_loss", lambda a, b: jnp.abs(a - b), (input, label), {})
+    return _reduce_loss(out, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        return jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta) * delta
+
+    return _reduce_loss(apply_op("smooth_l1", fn, (input, label), {}), reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *w):
+        eps = 1e-12
+        out = -(y * jnp.log(jnp.maximum(p, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            out = out * w[0]
+        return out
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _reduce_loss(apply_op("bce_loss", fn, args, {}), reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)); pos_weight scales the y-term
+        logexp = jax.nn.softplus(-jnp.abs(z))
+        if pw is None:
+            out = jnp.maximum(z, 0) - z * y + logexp
+        else:
+            lw = y * (pw - 1) + 1
+            out = (1 - y) * z + lw * (logexp + jnp.maximum(-z, 0))
+        if w is not None:
+            out = out * w
+        return out
+
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return _reduce_loss(apply_op("bce_with_logits", fn, args, {}), reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(lp, y):
+        return y * (jnp.log(jnp.maximum(y, 1e-12)) - lp)
+
+    out = apply_op("kldiv_loss", fn, (input, label), {})
+    if reduction == "batchmean":
+        from . import math as M
+
+        return M.divide(M.sum(out), to_tensor(float(input.shape[0])))
+    return _reduce_loss(out, reduction)
+
+
+def hinge_loss(input, label, name=None):
+    def fn(p, y):
+        return jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * p)
+
+    return apply_op("hinge_loss", fn, (input, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        return jnp.maximum(0.0, -y * (a - b) + margin)
+
+    return _reduce_loss(apply_op("margin_rank", fn, (input, other, label), {}), reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return apply_op("cosine_similarity", fn, (x1, x2), {})
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error", lambda a, b: jnp.square(a - b), (input, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jax.nn.softplus(-jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            out = out / n[0]
+        return out
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return _reduce_loss(apply_op("sigmoid_focal", fn, args, {}), reduction)

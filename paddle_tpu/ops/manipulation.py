@@ -1,0 +1,460 @@
+"""Shape / indexing / joining ops.
+
+Reference parity: reshape2 / transpose2 / concat / split / slice / gather /
+scatter / stack / tile / expand_v2 / squeeze2 / unsqueeze2 / flatten_contiguous_range
+op kernels (paddle/fluid/operators/) and python/paddle/tensor/manipulation.py.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import eager_op
+from ..core.tensor import Tensor, to_tensor, _wrap_data
+from ..core.dtype import convert_dtype
+
+
+@eager_op("cast")
+def _cast(x, dtype=None):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return _cast(x, dtype=convert_dtype(dtype))
+
+
+@eager_op("reshape2")
+def _reshape(x, shape=None):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    # paddle semantics: 0 means copy the input dim at that position
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return _reshape(x, shape=tuple(shape))
+
+
+@eager_op("transpose2")
+def _transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=tuple(int(p) for p in perm))
+
+
+@eager_op("squeeze2")
+def _squeeze(x, axes=None):
+    return jnp.squeeze(x, axis=axes)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return _squeeze(x, axes=None)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    if not axis:
+        return x.clone()
+    return _squeeze(x, axes=axis)
+
+
+@eager_op("unsqueeze2")
+def _unsqueeze(x, axes=None):
+    return jnp.expand_dims(x, axis=axes)
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, int):
+        axis = [axis]
+    return _unsqueeze(x, axes=tuple(int(a) for a in axis))
+
+
+@eager_op("flatten_contiguous_range")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    shape = x.shape
+    n = len(shape)
+    sa = start_axis % n if n else 0
+    so = stop_axis % n if n else 0
+    new_shape = shape[:sa] + (int(np.prod(shape[sa : so + 1]) or 1),) + shape[so + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+@eager_op("concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    xs = [t if isinstance(t, Tensor) else to_tensor(t) for t in x]
+    return _concat(*xs, axis=axis)
+
+
+@eager_op("stack_op")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    xs = [t if isinstance(t, Tensor) else to_tensor(t) for t in x]
+    return _stack(*xs, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {axis} size {dim} is not divisible by "
+                f"{num_or_sections}"
+            )
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_neg = builtins_sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - builtins_sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    @eager_op("split_op", n_outputs=len(sizes))
+    def _split(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, o, o + s, axis=axis) for o, s in zip(offsets, sizes)
+        )
+
+    return list(_split(x))
+
+
+def builtins_sum(it):
+    import builtins
+
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0):
+    return [squeeze(s, axis=[axis]) for s in split(x, x.shape[axis], axis=axis)]
+
+
+@eager_op("slice_op")
+def _slice(x, axes=None, starts=None, ends=None):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return _slice(x, axes=tuple(axes), starts=tuple(starts), ends=tuple(ends))
+
+
+@eager_op("strided_slice_op")
+def _strided_slice(x, axes=None, starts=None, ends=None, strides=None):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return _strided_slice(
+        x, axes=tuple(axes), starts=tuple(starts), ends=tuple(ends),
+        strides=tuple(strides),
+    )
+
+
+def _norm_index(idx):
+    """Convert Tensor indices inside fancy-index tuples to arrays."""
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_norm_index(i) for i in idx]
+    return idx
+
+
+def getitem(x, idx):
+    nidx = _norm_index(idx)
+
+    @eager_op("getitem_op")
+    def _get(v):
+        return v[nidx]
+
+    return _get(x)
+
+
+@eager_op("tile_op")
+def _tile(x, repeat_times=None):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return _tile(x, repeat_times=tuple(int(r) for r in repeat_times))
+
+
+@eager_op("expand_v2")
+def _expand(x, shape=None):
+    target = list(shape)
+    nd = len(target)
+    xshape = (1,) * (nd - x.ndim) + x.shape
+    target = [xs if t in (-1, None) else t for t, xs in zip(target, xshape)]
+    return jnp.broadcast_to(jnp.reshape(x, xshape), tuple(target))
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return _expand(x, shape=tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs):
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [expand(t, list(shape)) for t in inputs]
+
+
+@eager_op("flip_op")
+def _flip(x, axis=None):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _flip(x, axis=tuple(axis))
+
+
+@eager_op("roll_op")
+def _roll(x, shifts=None, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    return _roll(
+        x,
+        shifts=tuple(shifts) if isinstance(shifts, (list, tuple)) else int(shifts),
+        axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+    )
+
+
+@eager_op("gather_op")
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+
+    @eager_op("gather_op")
+    def _g(v):
+        return jnp.take(v, idx, axis=int(axis))
+
+    return _g(x)
+
+
+def gather_nd(x, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    @eager_op("gather_nd_op")
+    def _g(v):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return _g(x)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    @eager_op("index_sample_op")
+    def _g(v):
+        return jnp.take_along_axis(v, idx, axis=1)
+
+    return _g(x)
+
+
+def take_along_axis(x, indices, axis):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    @eager_op("take_along_axis_op")
+    def _g(v):
+        return jnp.take_along_axis(v, idx, axis=axis)
+
+    return _g(x)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+
+    @eager_op("scatter_op")
+    def _s(v, u):
+        if overwrite:
+            return v.at[idx].set(u)
+        return v.at[idx].set(jnp.zeros_like(u)).at[idx].add(u)
+
+    return _s(x, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    @eager_op("scatter_nd_add_op")
+    def _s(v, u):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return _s(x, updates)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    @eager_op("put_along_axis_op")
+    def _s(v, u):
+        u = jnp.broadcast_to(u, idx.shape) if jnp.ndim(u) else jnp.full(idx.shape, u)
+        dims = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        dims[axis] = idx
+        if reduce == "add":
+            return v.at[tuple(dims)].add(u)
+        return v.at[tuple(dims)].set(u)
+
+    vals = values if isinstance(values, Tensor) else to_tensor(values)
+    return _s(x, vals)
+
+
+@eager_op("pad_op")
+def _pad(x, paddings=None, mode="constant", value=0.0):
+    if mode == "constant":
+        return jnp.pad(x, paddings, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, paddings, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        paddings = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # paddle convention: pairs are last-spatial-dim-first — for NCHW,
+        # pad=[left,right,top,bottom] applies (left,right) to W then
+        # (top,bottom) to H.  Build pairs then reverse onto the spatial dims.
+        n_spatial = len(pad) // 2
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        pairs.reverse()  # now ordered outer spatial dim .. inner (H then W)
+        if data_format.endswith("C"):  # NHWC / NLC / NDHWC: spatial before channel
+            lead = nd - n_spatial - 1
+            paddings = [(0, 0)] * lead + pairs + [(0, 0)]
+        else:
+            lead = nd - n_spatial
+            paddings = [(0, 0)] * lead + pairs
+        paddings = tuple(paddings)
+    return _pad(x, paddings=paddings, mode=mode, value=value)
+
+
+@eager_op("shard_index_op")
+def _shard_index(x, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    return _shard_index(x, index_num, nshards, shard_id, ignore_value)
+
+
+def one_hot(x, num_classes, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return _wrap_data(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    @eager_op("rot90_op")
+    def _r(v):
+        return jnp.rot90(v, k=k, axes=axes)
+
+    return _r(x)
+
+
+def moveaxis(x, source, destination):
+    @eager_op("moveaxis_op")
+    def _m(v):
+        return jnp.moveaxis(v, source, destination)
+
+    return _m(x)
+
+
+def swapaxes(x, axis1, axis2):
+    perm = list(range(x.ndim))
+    perm[axis1], perm[axis2] = perm[axis2], perm[axis1]
+    return transpose(x, perm)
+
+
+def as_complex(x):
+    @eager_op("as_complex_op")
+    def _c(v):
+        return jax.lax.complex(v[..., 0], v[..., 1])
+
+    return _c(x)
+
+
+def as_real(x):
+    @eager_op("as_real_op")
+    def _r(v):
+        return jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1)
+
+    return _r(x)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    @eager_op("repeat_interleave_op")
+    def _r(v):
+        return jnp.repeat(v, repeats, axis=axis)
+
+    return _r(x)

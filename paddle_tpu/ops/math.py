@@ -1,0 +1,515 @@
+"""Elementwise / reduction / linalg ops.
+
+Reference parity: paddle/fluid/operators/elementwise/, reduce_ops/, matmul_v2_op,
+activation_op kernels and python/paddle/tensor/math.py.  Each op is a pure jax
+function registered for both eager dispatch and static lowering; grads are
+derived by jax.vjp (core/registry.py), replacing per-op GradOpMaker kernels.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import eager_op
+from ..core.tensor import Tensor, to_tensor, _wrap_data
+from ..core.dtype import convert_dtype
+
+
+def _coerce(x, like=None):
+    """Promote python scalars to Tensors matching `like`'s dtype."""
+    if isinstance(x, Tensor):
+        return x
+    if like is not None and isinstance(like, Tensor) and np.isscalar(x):
+        dt = like._data.dtype
+        if isinstance(x, (float, np.floating)) and jnp.issubdtype(dt, jnp.integer):
+            dt = jnp.float32  # float scalar promotes an int tensor op to float
+        return _wrap_data(jnp.asarray(x, dtype=dt))
+    return to_tensor(x)
+
+
+def _binary(name, fn):
+    raw = eager_op(name)(fn)
+
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor):
+            x = _coerce(x, y)
+        if not isinstance(y, Tensor):
+            y = _coerce(y, x)
+        return raw(x, y)
+
+    op.__name__ = name
+    op.raw_fn = fn
+    return op
+
+
+add = _binary("elementwise_add", lambda x, y: x + y)
+subtract = _binary("elementwise_sub", lambda x, y: x - y)
+multiply = _binary("elementwise_mul", lambda x, y: x * y)
+divide = _binary("elementwise_div", lambda x, y: x / y)
+floor_divide = _binary("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))
+remainder = _binary("elementwise_mod", lambda x, y: jnp.remainder(x, y))
+mod = remainder
+floor_mod = remainder
+pow = _binary("elementwise_pow", lambda x, y: jnp.power(x, y))
+maximum = _binary("elementwise_max", jnp.maximum)
+minimum = _binary("elementwise_min", jnp.minimum)
+fmax = _binary("elementwise_fmax", jnp.fmax)
+fmin = _binary("elementwise_fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+
+elementwise_add = add
+elementwise_sub = subtract
+elementwise_mul = multiply
+elementwise_div = divide
+
+
+def _unary(name, fn):
+    raw = eager_op(name)(fn)
+
+    def op(x, name=None):
+        if not isinstance(x, Tensor):
+            x = to_tensor(x)
+        return raw(x)
+
+    op.__name__ = name
+    op.raw_fn = fn
+    return op
+
+
+abs = _unary("abs", jnp.abs)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+isnan_raw = _unary("isnan", jnp.isnan)
+isinf_raw = _unary("isinf", jnp.isinf)
+isfinite_raw = _unary("isfinite", jnp.isfinite)
+
+
+def isnan(x):
+    return isnan_raw(x)
+
+
+def isinf(x):
+    return isinf_raw(x)
+
+
+def isfinite(x):
+    return isfinite_raw(x)
+
+
+@eager_op("scale")
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale
+    if isinstance(s, Tensor):
+        s = s.item()
+    return _scale(x, scale=float(s), bias=float(bias),
+                  bias_after_scale=bias_after_scale)
+
+
+@eager_op("clip")
+def _clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    if isinstance(min, Tensor):
+        min = min.item()
+    if isinstance(max, Tensor):
+        max = max.item()
+    return _clip(x, min=min, max=max)
+
+
+@eager_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@eager_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+# ---- comparisons / logic (non-differentiable) ----
+
+def _cmp(name, fn):
+    raw = eager_op(name)(fn)
+
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor):
+            x = _coerce(x, y)
+        if not isinstance(y, Tensor):
+            y = _coerce(y, x)
+        return raw(x.detach(), y.detach())
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", lambda x, y: x == y)
+not_equal = _cmp("not_equal", lambda x, y: x != y)
+less_than = _cmp("less_than", lambda x, y: x < y)
+less_equal = _cmp("less_equal", lambda x, y: x <= y)
+greater_than = _cmp("greater_than", lambda x, y: x > y)
+greater_equal = _cmp("greater_equal", lambda x, y: x >= y)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return _wrap_data(jnp.logical_not(x._data))
+
+
+def bitwise_not(x, name=None):
+    return _wrap_data(jnp.bitwise_not(x._data))
+
+
+def equal_all(x, y):
+    return _wrap_data(jnp.array_equal(x._data, y._data))
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _wrap_data(
+        jnp.allclose(x._data, y._data, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _wrap_data(
+        jnp.isclose(x._data, y._data, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+# ---- reductions ----
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, fn):
+    raw = eager_op(name)(fn)
+
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        out = raw(x, axis=_axis_arg(axis), keepdims=keepdim)
+        if dtype is not None:
+            out = out.astype(convert_dtype(dtype))
+        return out
+
+    op.__name__ = name
+    return op
+
+
+def _sum_fn(x, axis=None, keepdims=False):
+    if jnp.issubdtype(x.dtype, jnp.bool_):
+        x = x.astype(jnp.int64)
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+sum = _reduce("reduce_sum", _sum_fn)
+mean = _reduce("reduce_mean", jnp.mean)
+max = _reduce("reduce_max", jnp.max)
+min = _reduce("reduce_min", jnp.min)
+prod = _reduce("reduce_prod", jnp.prod)
+amax = max
+amin = min
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _wrap_data(jnp.all(x._data, axis=_axis_arg(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _wrap_data(jnp.any(x._data, axis=_axis_arg(axis), keepdims=keepdim))
+
+
+@eager_op("logsumexp_op")
+def _logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _wrap_data(jnp.argmax(x._data, axis=axis, keepdims=keepdim))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _wrap_data(jnp.argmin(x._data, axis=axis, keepdims=keepdim))
+
+
+@eager_op("cumsum_op")
+def _cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape([-1]) if isinstance(x, Tensor) else x
+        axis = 0
+    out = _cumsum(x, axis=int(axis))
+    return out.astype(convert_dtype(dtype)) if dtype else out
+
+
+@eager_op("cumprod_op")
+def _cumprod(x, axis):
+    return jnp.cumprod(x, axis=axis)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod(x, axis=int(dim))
+    return out.astype(convert_dtype(dtype)) if dtype else out
+
+
+# ---- linalg ----
+
+@eager_op("matmul_v2")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+@eager_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@eager_op("dot_op")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _dot(x, y)
+
+
+@eager_op("addmm_op")
+def _addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _addmm(input, x, y, beta=beta, alpha=alpha)
+
+
+@eager_op("t_op")
+def t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+@eager_op("outer_op")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@eager_op("inner_op")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@eager_op("kron_op")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@eager_op("mv_op")
+def mv(x, vec):
+    return x @ vec
+
+
+@eager_op("p_norm")
+def _norm(x, p=2.0, axis=None, keepdims=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdims)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdims) ** (1.0 / p)
+
+
+def norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    if p == "fro":
+        p = 2.0
+    return _norm(x, p=float(p), axis=_axis_arg(axis), keepdims=keepdim)
+
+
+def dist(x, y, p=2.0):
+    return norm(subtract(x, y), p=p)
+
+
+# ---- misc math ----
+
+@eager_op("where_op")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    if not isinstance(x, Tensor):
+        x = _coerce(x, y)
+    if not isinstance(y, Tensor):
+        y = _coerce(y, x)
+    return _where(condition.detach() if isinstance(condition, Tensor) else condition, x, y)
+
+
+where_m = where
+
+
+def nonzero(x, as_tuple=False):
+    idx = np.nonzero(x.numpy())
+    if as_tuple:
+        return tuple(to_tensor(i) for i in idx)
+    return to_tensor(np.stack(idx, axis=1))
+
+
+def masked_select(x, mask, name=None):
+    return to_tensor(x.numpy()[mask.numpy()])
+
+
+@eager_op("topk_v2", n_outputs=2)
+def _topk(x, k, largest=True):
+    if largest:
+        vals, idx = jax.lax.top_k(x, k)
+    else:
+        vals, idx = jax.lax.top_k(-x, k)
+        vals = -vals
+    return vals, idx
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    if axis is not None and axis not in (-1, x.ndim - 1):
+        xm = transpose_to_last(x, axis)
+        v, i = _topk(xm, k=k, largest=largest)
+        return transpose_from_last(v, axis), transpose_from_last(i, axis)
+    return _topk(x, k=k, largest=largest)
+
+
+def transpose_to_last(x, axis):
+    perm = list(range(x.ndim))
+    perm[axis], perm[-1] = perm[-1], perm[axis]
+    from .manipulation import transpose
+
+    return transpose(x, perm)
+
+
+transpose_from_last = transpose_to_last
+
+
+@eager_op("argsort_op")
+def _argsort_val(x, axis=-1, descending=False):
+    return jnp.argsort(-x if descending else x, axis=axis)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _argsort_val(x, axis=axis, descending=descending)
+
+
+@eager_op("sort_op")
+def _sort(x, axis=-1, descending=False):
+    s = jnp.sort(x, axis=axis)
+    return jnp.flip(s, axis=axis) if descending else s
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _sort(x, axis=axis, descending=descending)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    res = np.unique(
+        x.numpy(),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(to_tensor(r) for r in res)
+    return to_tensor(res)
+
+
+@eager_op("increment_op")
+def _increment(x, value=1.0):
+    return x + value
+
+
+def increment(x, value=1.0, name=None):
+    out = _increment(x, value=float(value))
+    x.set_value(out.detach())
+    return x
+
+
+@eager_op("cross_op")
+def _cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return _cross(x, y, axis=axis)
+
+
+def numel_t(x):
+    return to_tensor(np.array(x.size, dtype=np.int64))

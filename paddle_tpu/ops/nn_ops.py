@@ -1,0 +1,710 @@
+"""Neural-net ops: conv / pool / norm / attention-adjacent primitives.
+
+Reference parity: conv2d (operators/conv_op.cc), pool2d (pool_op.cc), batch_norm
+(batch_norm_op.cc), layer_norm (layer_norm_op.cc), softmax_with_cross_entropy
+(softmax_with_cross_entropy_op.cc), dropout (dropout_op.cc), lookup_table_v2
+(lookup_table_v2_op.cc), activation_op.cc family.  All are XLA-native: convs and
+matmuls hit the MXU via lax.conv_general_dilated / dot_general; dropout uses
+threefry keys (core/random.py); batch-norm running stats update functionally.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import eager_op, apply_op
+from ..core.tensor import Tensor, to_tensor, _wrap_data
+from ..core import random as _random
+
+
+def _pair(x, n=2):
+    if isinstance(x, (list, tuple)):
+        return tuple(int(v) for v in x) * (1 if len(x) == n else n)
+    return (int(x),) * n
+
+
+def _conv_padding(padding, k, stride, dilation, nd):
+    """Normalize paddle padding spec to lax padding list."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Maps to one lax.conv_general_dilated → MXU.  Ref: conv_op.cc, conv_cudnn_op.cu."""
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, None, stride, dilation, 2)
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+
+    if data_format != "NCHW":
+        # weights stored OIHW regardless; convert for NHWC
+        def fn(xv, wv):
+            wv = jnp.transpose(wv, (2, 3, 1, 0))
+            return jax.lax.conv_general_dilated(
+                xv, wv, stride, pad, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=xv.dtype,
+            )
+    else:
+        def fn(xv, wv):
+            return jax.lax.conv_general_dilated(
+                xv, wv, stride, pad, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups,
+                preferred_element_type=xv.dtype,
+            )
+
+    out = apply_op("conv2d", fn, (x, weight), {})
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = apply_op(
+            "conv2d_bias", lambda o, b: o + jnp.reshape(b, shape), (out, bias), {}
+        )
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    from .manipulation import unsqueeze, squeeze
+
+    x4 = unsqueeze(x, [3] if data_format == "NCL" else [2])
+    w4 = unsqueeze(weight, [3])
+    s = _pair(stride, 1) + (1,)
+    d = _pair(dilation, 1) + (1,)
+    if isinstance(padding, int):
+        p = [(padding, padding), (0, 0)]
+    elif isinstance(padding, str):
+        p = padding.upper()
+    else:
+        p = [(int(padding[0]), int(padding[-1])), (0, 0)]
+    dn = ("NCHW", "OIHW", "NCHW")
+
+    def fn(xv, wv):
+        return jax.lax.conv_general_dilated(
+            xv, wv, s, p, rhs_dilation=d, dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+
+    out = apply_op("conv1d", fn, (x4, w4), {})
+    if bias is not None:
+        out = apply_op(
+            "conv1d_bias", lambda o, b: o + jnp.reshape(b, (1, -1, 1, 1)), (out, bias), {}
+        )
+    return squeeze(out, [3])
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCHW",
+                     name=None):
+    """Ref: conv2d_transpose_op.cc.  Implemented as lax.conv_transpose."""
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _conv_padding(padding, None, stride, dilation, 2)
+        # conv_transpose pad semantics: emulate via transpose of fwd conv padding
+        k = weight.shape[2:4]
+        pad = [
+            (dilation[i] * (k[i] - 1) - pad[i][0],
+             dilation[i] * (k[i] - 1) - pad[i][1])
+            for i in range(2)
+        ]
+    dn = ("NCHW", "IOHW", "NCHW")
+
+    def fn(xv, wv):
+        return jax.lax.conv_transpose(
+            xv, wv, stride, pad, rhs_dilation=dilation, dimension_numbers=dn,
+            transpose_kernel=True,
+        )
+
+    out = apply_op("conv2d_transpose", fn, (x, weight), {})
+    if bias is not None:
+        out = apply_op(
+            "conv2d_transpose_bias", lambda o, b: o + jnp.reshape(b, (1, -1, 1, 1)),
+            (out, bias), {},
+        )
+    return out
+
+
+# ---- pooling (ref: pool_op.cc, operators/math/pooling.cu) ----
+
+def _pool(x, kind, kernel_size, stride, padding, ceil_mode, data_format,
+          exclusive=True, adaptive=False):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    nchw = data_format == "NCHW"
+    spatial = (2, 3) if nchw else (1, 2)
+    if adaptive:
+        out_hw = k
+        in_hw = (x.shape[spatial[0]], x.shape[spatial[1]])
+        if all(in_hw[i] % out_hw[i] == 0 for i in range(2)):
+            k = tuple(in_hw[i] // out_hw[i] for i in range(2))
+            s = k
+            padding = 0
+        else:
+            return _adaptive_pool_general(x, kind, out_hw, nchw)
+    pad = _conv_padding(padding, k, s, (1, 1), 2)
+    if isinstance(pad, str):
+        pad_seq = pad
+    else:
+        pad_seq = [(0, 0)] * x.ndim
+        for i, ax in enumerate(spatial):
+            pad_seq[ax] = pad[i]
+    window = [1] * x.ndim
+    strides = [1] * x.ndim
+    for i, ax in enumerate(spatial):
+        window[ax] = k[i]
+        strides[ax] = s[i]
+
+    if kind == "max":
+        def fn(v):
+            return jax.lax.reduce_window(
+                v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min,
+                jax.lax.max, window, strides, pad_seq,
+            )
+        return apply_op("pool2d_max", fn, (x,), {})
+
+    def fn(v):
+        ssum = jax.lax.reduce_window(
+            v, 0.0, jax.lax.add, window, strides, pad_seq
+        )
+        if exclusive and pad_seq != "VALID" and any(
+            p != (0, 0) for p in (pad_seq if isinstance(pad_seq, list) else [])
+        ):
+            ones = jnp.ones_like(v)
+            cnt = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, strides, pad_seq
+            )
+            return ssum / cnt
+        return ssum / float(np.prod(k))
+
+    return apply_op("pool2d_avg", fn, (x,), {})
+
+
+def _adaptive_pool_general(x, kind, out_hw, nchw):
+    """Non-divisible adaptive pooling via mean/max over variable windows."""
+    def fn(v):
+        if not nchw:
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        N, C, H, W = v.shape
+        oh, ow = out_hw
+        hs = [(i * H) // oh for i in range(oh)] + [H]
+        ws = [(j * W) // ow for j in range(ow)] + [W]
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                win = v[:, :, hs[i]: hs[i + 1], ws[j]: ws[j + 1]]
+                cols.append(
+                    jnp.max(win, axis=(2, 3)) if kind == "max" else jnp.mean(win, axis=(2, 3))
+                )
+            rows.append(jnp.stack(cols, axis=-1))
+        out = jnp.stack(rows, axis=-2)
+        if not nchw:
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("adaptive_pool2d", fn, (x,), {})
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    out = _pool(x, "max", kernel_size, stride, padding, ceil_mode, data_format)
+    if return_mask:
+        return out, None
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode, data_format,
+                 exclusive=exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _pool(x, "avg", output_size, None, 0, False, data_format, adaptive=True)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _pool(x, "max", output_size, None, 0, False, "NCHW", adaptive=True)
+    return (out, None) if return_mask else out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, name=None):
+    from .manipulation import unsqueeze, squeeze
+
+    x4 = unsqueeze(x, [3])
+    ks = _pair(kernel_size, 1) + (1,)
+    st = (_pair(stride, 1) + (1,)) if stride is not None else ks
+    pd = [(padding, padding), (0, 0)] if isinstance(padding, int) else padding
+    out = _pool(x4, "max", ks, st, pd, ceil_mode, "NCHW")
+    return squeeze(out, [3])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, name=None):
+    from .manipulation import unsqueeze, squeeze
+
+    x4 = unsqueeze(x, [3])
+    ks = _pair(kernel_size, 1) + (1,)
+    st = (_pair(stride, 1) + (1,)) if stride is not None else ks
+    pd = [(padding, padding), (0, 0)] if isinstance(padding, int) else padding
+    out = _pool(x4, "avg", ks, st, pd, ceil_mode, "NCHW")
+    return squeeze(out, [3])
+
+
+# ---- activations (ref: operators/activation_op.cc) ----
+
+def _act(name, fn):
+    raw = eager_op(name)(fn)
+
+    def op(x, name=None):
+        return raw(x if isinstance(x, Tensor) else to_tensor(x))
+
+    op.__name__ = name
+    op.raw_fn = fn
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", lambda x: jnp.clip(x, 0, 6))
+sigmoid = _act("sigmoid", jax.nn.sigmoid)
+log_sigmoid = _act("logsigmoid", jax.nn.log_sigmoid)
+silu = _act("silu", jax.nn.silu)
+swish = silu
+mish = _act("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softplus_raw = _act("softplus", jax.nn.softplus)
+softsign = _act("softsign", jax.nn.soft_sign)
+tanhshrink = _act("tanh_shrink", lambda x: x - jnp.tanh(x))
+hardsigmoid = _act("hard_sigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+hardswish = _act("hard_swish", lambda x: x * jnp.clip(x + 3, 0, 6) / 6)
+hardtanh = _act("hard_tanh", lambda x: jnp.clip(x, -1.0, 1.0))
+selu_raw = _act("selu", jax.nn.selu)
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    if beta == 1:
+        return softplus_raw(x)
+    return apply_op(
+        "softplus_beta",
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta),
+        (x,), {},
+    )
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return selu_raw(x)
+
+
+@eager_op("gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(x, approximate=approximate)
+
+
+@eager_op("leaky_relu")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(x, negative_slope=negative_slope)
+
+
+@eager_op("elu")
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(x, alpha=alpha)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_ax = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_ax] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+
+    return apply_op("prelu", fn, (x, weight), {})
+
+
+@eager_op("hardshrink")
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(x, threshold=threshold)
+
+
+@eager_op("softshrink")
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(x, threshold=threshold)
+
+
+@eager_op("thresholded_relu")
+def _thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _thresholded_relu(x, threshold=threshold)
+
+
+@eager_op("softmax")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from .manipulation import cast
+
+        x = cast(x, dtype)
+    return _softmax(x, axis=int(axis))
+
+
+@eager_op("log_softmax")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _log_softmax(x, axis=int(axis))
+
+
+def glu(x, axis=-1, name=None):
+    from .manipulation import split
+
+    a, b = split(x, 2, axis=axis)
+    from .math import multiply
+
+    return multiply(a, sigmoid(b))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        shape = list(v.shape)
+        c = shape[axis]
+        shape[axis: axis + 1] = [c // groups, groups]
+        return jnp.max(jnp.reshape(v, shape), axis=axis + 1)
+
+    return apply_op("maxout", fn, (x,), {})
+
+
+# ---- normalization ----
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    """Ref: layer_norm_op.cc.  Normalizes over the trailing normalized_shape dims."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape) if normalized_shape else 1
+    axes = tuple(range(-n_axes, 0))
+
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mean), axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply_op("layer_norm", fn, args, {})
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Ref: batch_norm_op.cc.  Functional running-stat update (set_value on the
+    running tensors) instead of in-place kernel writes."""
+    ch_ax = 1 if data_format in ("NCHW", "NCL", "NCDHW") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_ax)
+    shape = [1] * x.ndim
+    shape[ch_ax] = x.shape[ch_ax]
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        def fn(v, *wb):
+            mean = jnp.mean(v, axis=reduce_axes)
+            var = jnp.mean(jnp.square(v), axis=reduce_axes) - jnp.square(mean)
+            out = (v - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + epsilon
+            )
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+
+        args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+        out, bmean, bvar = apply_op("batch_norm", fn, args, {}, n_outputs=3)
+        m, v = bmean.detach()._data, bvar.detach()._data
+        running_mean._data = momentum * running_mean._data + (1 - momentum) * m
+        running_var._data = momentum * running_var._data + (1 - momentum) * v
+        return out
+
+    def fn(v, rm, rv, *wb):
+        out = (v - rm.reshape(shape)) * jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x, running_mean, running_var) + tuple(
+        t for t in (weight, bias) if t is not None
+    )
+    return apply_op("batch_norm_infer", fn, args, {})
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5, name=None):
+    axes = tuple(range(2, x.ndim))
+
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mean), axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply_op("instance_norm", fn, args, {})
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(v, *wb):
+        N, C = v.shape[0], v.shape[1]
+        g = num_groups
+        rest = v.shape[2:]
+        vg = v.reshape((N, g, C // g) + rest)
+        axes = tuple(range(2, vg.ndim))
+        mean = jnp.mean(vg, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(vg - mean), axis=axes, keepdims=True)
+        out = ((vg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, C] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply_op("group_norm", fn, args, {})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, name=None):
+    def fn(v):
+        sq = jnp.square(v)
+        half = size // 2
+        pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (v.ndim - 2)
+        sqp = jnp.pad(sq, pad)
+        acc = sum(
+            sqp[:, i : i + v.shape[1]] for i in range(size)
+        )
+        return v / jnp.power(k + alpha * acc, beta)
+
+    return apply_op("lrn", fn, (x,), {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_op("normalize", fn, (x,), {})
+
+
+# ---- dropout (threefry-keyed; ref: dropout_op.cc) ----
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else apply_op(
+            "dropout_scale", lambda v: v * (1 - p), (x,), {}
+        )
+    if p == 1.0:
+        return apply_op("dropout_all", lambda v: jnp.zeros_like(v), (x,), {})
+    key = _random.next_key()
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply_op("dropout", fn, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    alpha_p = -1.7580993408473766
+    q = 1 - p
+    a = (q + alpha_p**2 * q * p) ** -0.5
+    b = -a * alpha_p * p
+    key = _random.next_key()
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, q, v.shape)
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply_op("alpha_dropout", fn, (x,), {})
+
+
+# ---- embedding (ref: lookup_table_v2_op.cc) ----
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+
+    return apply_op("lookup_table_v2", fn, (weight,), {})
+
+
+# ---- linear ----
+
+def linear(x, weight, bias=None, name=None):
+    """Ref: matmul+elementwise_add fusion (fc op).  weight is [in, out]."""
+    if bias is not None:
+        return apply_op(
+            "linear", lambda v, w, b: jnp.matmul(v, w) + b, (x, weight, bias), {}
+        )
+    return apply_op("linear_nobias", lambda v, w: jnp.matmul(v, w), (x, weight), {})
+
+
+# ---- interpolate (subset: nearest + bilinear; ref: interpolate_v2_op) ----
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW", name=None):
+    nchw = data_format == "NCHW"
+    H, W = (x.shape[2], x.shape[3]) if nchw else (x.shape[1], x.shape[2])
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = (scale_factor, scale_factor)
+        size = (int(H * scale_factor[0]), int(W * scale_factor[1]))
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    size = tuple(int(s) for s in size)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+
+    if align_corners and mode in ("bilinear", "bicubic") and min(size) > 1:
+        # jax.image.resize is half-pixel only; align_corners maps output grid
+        # ends onto input grid ends: src = i * (in-1)/(out-1), then gather +
+        # bilinear blend (matches the reference kernel's align_corners branch).
+        def fn(v):
+            if not nchw:
+                v = jnp.transpose(v, (0, 3, 1, 2))
+            H, W = v.shape[2], v.shape[3]
+            oh, ow = size
+            ys = jnp.linspace(0.0, H - 1.0, oh)
+            xs = jnp.linspace(0.0, W - 1.0, ow)
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, H - 1)
+            x1 = jnp.minimum(x0 + 1, W - 1)
+            wy = (ys - y0)[None, None, :, None]
+            wx = (xs - x0)[None, None, None, :]
+            g = lambda yi, xi: v[:, :, yi, :][:, :, :, xi]
+            out = (
+                g(y0, x0) * (1 - wy) * (1 - wx)
+                + g(y0, x1) * (1 - wy) * wx
+                + g(y1, x0) * wy * (1 - wx)
+                + g(y1, x1) * wy * wx
+            ).astype(v.dtype)
+            if not nchw:
+                out = jnp.transpose(out, (0, 2, 3, 1))
+            return out
+
+        return apply_op("interpolate_ac", fn, (x,), {})
+
+    def fn(v):
+        if nchw:
+            shape = (v.shape[0], v.shape[1], size[0], size[1])
+        else:
+            shape = (v.shape[0], size[0], size[1], v.shape[3])
+        return jax.image.resize(v, shape, method=method)
+
+    return apply_op("interpolate", fn, (x,), {})
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        N, C, H, W = v.shape
+        v = v.reshape(N, C // (r * r), r, r, H, W)
+        v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+        return v.reshape(N, C // (r * r), H * r, W * r)
+
+    return apply_op("pixel_shuffle", fn, (x,), {})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = _conv_padding(paddings, k, s, d, 2)
+
+    def fn(v):
+        N, C, H, W = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, k, s, p, rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        L = patches.shape[2] * patches.shape[3]
+        return patches.reshape(N, C * k[0] * k[1], L)
+
+    return apply_op("unfold", fn, (x,), {})

@@ -1,0 +1,6 @@
+"""paddle.optimizer parity (SURVEY §2.2 "Public optimizers")."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta, Adamax,
+    Lamb, L1Decay, L2Decay,
+)
+from . import lr  # noqa: F401

@@ -1,0 +1,411 @@
+"""Optimizer zoo.
+
+Reference parity: python/paddle/optimizer/ (Adam/AdamW/SGD/Momentum/Lamb/
+RMSProp/Adagrad/Adadelta/Adamax) backed by operators/optimizers/ kernels
+(sgd_op, momentum_op, adam_op, lamb_op...).  TPU-native: each optimizer exposes
+a pure functional `update(param, grad, state) -> (new_param, new_state)` rule;
+eager `step()` applies it per-parameter, and the jit path (`fused_step` /
+jit.compile_train_step) folds all updates into the one XLA computation so the
+whole training step is a single device program.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._param_groups = parameters
+        self.regularization = weight_decay
+        self._grad_clip = grad_clip
+        # per-parameter state: id(param) -> dict of jax arrays
+        self._states = {}
+        self._global_step = 0
+
+    # ---- lr ----
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ---- state access ----
+    def _state_for(self, p):
+        st = self._states.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._states[id(p)] = st
+        return st
+
+    def _init_state(self, p):
+        return {}
+
+    def _weight_decay_coeff(self):
+        wd = self.regularization
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):
+            return float(wd._coeff)  # L2Decay
+        return float(wd)
+
+    # ---- the update rule (pure; override in subclasses) ----
+    def update(self, param, grad, state, lr):
+        raise NotImplementedError
+
+    # ---- imperative step ----
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("Optimizer created without parameters")
+        self._global_step += 1
+        lr = self.get_lr()
+        params_grads = [(p, p.grad) for p in params if p.grad is not None
+                        and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            gv = g._data.astype(p._data.dtype) if g._data.dtype != p._data.dtype else g._data
+            plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
+            wd = self._weight_decay_coeff()
+            reg = p.__dict__.get("regularizer")
+            if reg is not None and hasattr(reg, "_coeff"):
+                wd = float(reg._coeff)
+            decay_fn = getattr(self, "_apply_decay_param_fun", None)
+            if decay_fn is not None and p.name and not decay_fn(p.name):
+                wd = 0.0
+            if wd and self._decoupled_weight_decay is False:
+                gv = gv + wd * p._data
+            state = self._state_for(p)
+            self._current_param_name = p.name
+            new_p, new_state = self.update(p._data, gv, state, plr)
+            if wd and self._decoupled_weight_decay:
+                new_p = new_p - plr * wd * p._data
+            p._data = new_p
+            self._states[id(p)] = new_state
+
+    _decoupled_weight_decay = False
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import Variable as StaticVar
+
+        if isinstance(loss, StaticVar):
+            from ..static.optimizer_bridge import static_minimize
+
+            return static_minimize(self, loss, startup_program, parameters,
+                                   no_grad_set)
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ---- functional/jit path ----
+    def fused_update(self, params, grads, states, lr):
+        """Pure pytree update: dicts name->array.  Used by jit-compiled steps."""
+        new_params, new_states = {}, {}
+        for n, p in params.items():
+            g = grads.get(n)
+            if g is None:
+                new_params[n] = p
+                new_states[n] = states.get(n, {})
+                continue
+            wd = self._weight_decay_coeff()
+            if wd and not self._decoupled_weight_decay:
+                g = g + wd * p
+            np_, ns = self.update(p, g, states.get(n, {}), lr)
+            if wd and self._decoupled_weight_decay:
+                np_ = np_ - lr * wd * p
+            new_params[n] = np_
+            new_states[n] = ns
+        return new_params, new_states
+
+    def init_fused_states(self, params):
+        return {
+            n: self._init_state_arrays(p) for n, p in params.items()
+        }
+
+    def _init_state_arrays(self, p_arr):
+        from ..core.tensor import _wrap_data
+
+        fake = _wrap_data(p_arr)
+        return self._init_state(fake)
+
+    # ---- checkpoint ----
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        if self._parameter_list:
+            for p in self._parameter_list:
+                st = self._states.get(id(p))
+                if st:
+                    for k, v in st.items():
+                        out[f"{p.name}_{k}"] = Tensor(np.asarray(v))
+        return out
+
+    def set_state_dict(self, state):
+        self._global_step = state.get("global_step", 0)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        if self._parameter_list:
+            for p in self._parameter_list:
+                st = self._state_for(p)
+                for k in list(st.keys()):
+                    key = f"{p.name}_{k}"
+                    if key in state:
+                        v = state[key]
+                        st[k] = jnp.asarray(
+                            v.numpy() if isinstance(v, Tensor) else v
+                        )
+
+
+class SGD(Optimizer):
+    """Ref: operators/optimizers/sgd_op.cc."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def update(self, param, grad, state, lr):
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    """Ref: operators/optimizers/momentum_op.h."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def update(self, param, grad, state, lr):
+        v = state["velocity"] * self._momentum + grad
+        if self._use_nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """Ref: operators/optimizers/adam_op.h (with bias correction via beta pows)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        z = jnp.zeros(p._data.shape, jnp.float32)
+        return {
+            "moment1": z,
+            "moment2": z,
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = grad.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g32)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        step = lr * mhat / (jnp.sqrt(vhat) + eps)
+        new_p = param - step.astype(param.dtype)
+        return new_p, {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+        }
+
+
+class AdamW(Adam):
+    """Ref: operators/optimizers/adamw — decoupled weight decay."""
+
+    _decoupled_weight_decay = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._data.shape, self._init_acc, jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        g32 = grad.astype(jnp.float32)
+        acc = state["moment"] + jnp.square(g32)
+        new_p = param - (lr * g32 / (jnp.sqrt(acc) + self._epsilon)).astype(param.dtype)
+        return new_p, {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, p):
+        z = jnp.zeros(p._data.shape, jnp.float32)
+        st = {"mean_square": z, "momentum": z}
+        if self._centered:
+            st["mean_grad"] = z
+        return st
+
+    def update(self, param, grad, state, lr):
+        g32 = grad.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new_p = param - mom.astype(param.dtype)
+        st = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            st["mean_grad"] = mg
+        return new_p, st
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, p):
+        z = jnp.zeros(p._data.shape, jnp.float32)
+        return {"avg_squared_grad": z, "avg_squared_update": z}
+
+    def update(self, param, grad, state, lr):
+        g32 = grad.astype(jnp.float32)
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g32)
+        upd = (
+            jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(asg + eps) * g32
+        )
+        asu = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        return param - (lr * upd).astype(param.dtype), {
+            "avg_squared_grad": asg, "avg_squared_update": asu,
+        }
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        z = jnp.zeros(p._data.shape, jnp.float32)
+        return {"moment": z, "inf_norm": z, "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        g32 = grad.astype(jnp.float32)
+        m = b1 * state["moment"] + (1 - b1) * g32
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g32))
+        b1p = state["beta1_pow"] * b1
+        new_p = param - (lr / (1 - b1p) * m / (u + eps)).astype(param.dtype)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    """Ref: operators/optimizers/lamb_op.h — layerwise adaptive Adam."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        z = jnp.zeros(p._data.shape, jnp.float32)
+        return {
+            "moment1": z, "moment2": z,
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(
+            getattr(self, "_current_param_name", None) or ""
+        ):
+            wd = 0.0
+        g32 = grad.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g32)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * param.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(param.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = param - (lr * trust * r).astype(param.dtype)
+        return new_p, {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p,
+        }
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

@@ -1,0 +1,13 @@
+"""paddle_tpu.parallel — mesh-based distributed runtime (SURVEY §2.3, §5.8)."""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, global_mesh,
+    set_global_mesh, build_mesh, is_initialized,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, all_reduce, reduce, broadcast, all_gather,
+    reduce_scatter, scatter, alltoall, send, recv, isend, irecv, barrier,
+    P2POp, batch_isend_irecv, psum, pmean, ppermute, axis_index,
+    all_to_all_in_mesh,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
+from .data_parallel import DataParallel  # noqa: F401

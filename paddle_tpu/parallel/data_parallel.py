@@ -1,0 +1,75 @@
+"""DataParallel wrapper.
+
+Reference parity: python/paddle/fluid/dygraph/parallel.py:382 (DataParallel,
+scale_loss:588, apply_collective_grads:597) + C++ Reducer (reducer.cc) gradient
+bucketing.  TPU-native design (SURVEY §7.1 "Reducer" row): in the
+single-controller mesh model the global batch is sharded over the 'data' axis
+and XLA inserts the gradient AllReduce when the step is compiled (pjit); eager
+mode computes grads on the global batch directly, which is numerically the
+allreduced result.  The Reducer's bucketing/overlap role is played by XLA's
+collective scheduling, so this wrapper's job is API parity: parameter sync at
+construction, loss scaling, and no_sync.
+"""
+import contextlib
+
+from ..nn.layer import Layer
+from . import env as _env
+from . import collective as C
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+        # parameter broadcast from rank 0 (reducer.cc construction parity):
+        # single-controller arrays are already consistent across the mesh.
+
+    @property
+    def nranks(self):
+        return _env.get_world_size()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # dygraph/parallel.py:588 — under mesh execution the mean over the
+        # global batch already includes the 1/nranks factor.
+        return loss
+
+    def apply_collective_grads(self):
+        # grads of a global-batch backward are already cross-replica reduced
+        pass
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        prev = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = prev
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self

@@ -1,0 +1,115 @@
+"""Parallel environment: device mesh bootstrap.
+
+Reference parity: python/paddle/distributed/parallel.py:58 init_parallel_env
+(env check -> KV bootstrap -> NCCLParallelContext::Init -> default ring) and
+platform/collective_helper.h ring registry.  TPU-native design (SURVEY §5.8):
+the ring_id-keyed NCCL comm world is replaced by ONE named-axis
+jax.sharding.Mesh over ICI/DCN; "rings" become named mesh axes; bootstrap is
+jax.distributed.initialize (coordination service) on multi-host.  Groups
+(new_group) are sub-axes of the mesh rather than new communicators.
+"""
+import os
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+_lock = threading.Lock()
+_global_mesh = None
+_initialized = False
+
+
+class ParallelEnv:
+    """Parity: fluid/dygraph/parallel.py ParallelEnv (PADDLE_* env)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+        self._device_id = 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", max(jax.device_count(), 1)))
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+
+def init_parallel_env(mesh_shape=None, axis_names=None):
+    """Create the global device mesh (replaces NCCL ring-0 creation).
+
+    On multi-host, callers should have run jax.distributed.initialize (the
+    coordination-service analogue of c_gen_nccl_id's TCP bootstrap,
+    gen_comm_id_helper.cc:297).
+    """
+    global _global_mesh, _initialized
+    with _lock:
+        devices = np.array(jax.devices())
+        if mesh_shape is None:
+            mesh_shape = (len(devices),)
+            axis_names = axis_names or ("data",)
+        devices = devices.reshape(mesh_shape)
+        _global_mesh = Mesh(devices, axis_names)
+        _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def global_mesh():
+    global _global_mesh
+    if _global_mesh is None:
+        init_parallel_env()
+    return _global_mesh
+
+
+def set_global_mesh(mesh):
+    global _global_mesh, _initialized
+    _global_mesh = mesh
+    _initialized = True
+
+
+def get_rank(group=None):
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None):
+    if group is not None and getattr(group, "nranks", None):
+        return group.nranks
+    return ParallelEnv().world_size
+
+
+def build_mesh(shape_dict):
+    """Build a named mesh, e.g. {'data': 2, 'model': 4} (hybrid topology).
+
+    Axis order follows insertion order; total must divide available devices.
+    """
+    names = tuple(shape_dict.keys())
+    sizes = tuple(int(v) for v in shape_dict.values())
+    n = int(np.prod(sizes))
+    devices = np.array(jax.devices()[:n]).reshape(sizes)
+    return Mesh(devices, names)

@@ -1,0 +1,120 @@
+"""Profiler.
+
+Reference parity: platform/profiler.{h,cc} (RecordEvent, EnableProfiler:213,
+chrome-trace export) + fluid/profiler.py context manager.  TPU-native: host
+spans via RecordEvent (summary table like the reference's) and device traces
+via jax.profiler (XLA/TPU timelines, Perfetto/TensorBoard viewable) — the CUPTI
+role (SURVEY §5.1) is played by the PJRT profiler.
+"""
+import contextlib
+import threading
+import time
+from collections import defaultdict
+
+import jax
+
+_state = threading.local()
+_records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_seconds]
+_enabled = [False]
+_trace_dir = [None]
+
+
+class RecordEvent:
+    """RAII span (platform/profiler.h RecordEvent parity)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def begin(self):
+        if _enabled[0]:
+            self._t0 = time.perf_counter()
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+
+    def end(self):
+        if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            rec = _records[self.name]
+            rec[0] += 1
+            rec[1] += dt
+            if self._jax_ctx is not None:
+                self._jax_ctx.__exit__(None, None, None)
+            self._t0 = None
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    _enabled[0] = True
+    _records.clear()
+    if trace_dir:
+        _trace_dir[0] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    _enabled[0] = False
+    if _trace_dir[0]:
+        jax.profiler.stop_trace()
+        _trace_dir[0] = None
+    return summary(sorted_key)
+
+
+def summary(sorted_key="total"):
+    rows = sorted(
+        ((name, cnt, tot, tot / cnt if cnt else 0.0)
+         for name, (cnt, tot) in _records.items()),
+        key=lambda r: -r[2],
+    )
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, cnt, tot, avg in rows:
+        lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{avg * 1e3:>12.3f}")
+    report = "\n".join(lines)
+    print(report)
+    return report
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None, trace_dir=None):
+    """fluid/profiler.py:314 context-manager parity."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 trace_dir=None):
+        self.trace_dir = trace_dir
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        start_profiler(trace_dir=self.trace_dir)
+
+    def stop(self):
+        stop_profiler()
+
+    def step(self):
+        pass
+
+    def summary(self, **kw):
+        return summary()

@@ -1,0 +1,63 @@
+"""paddle.static parity: Program IR + Executor + append_backward.
+
+Ref: SURVEY §3.1 static-graph call stack; framework/executor.cc; fluid
+framework.py Program mirror.
+"""
+from .program import (  # noqa: F401
+    Program, Block, Operator, Variable, Parameter, default_main_program,
+    default_startup_program, program_guard, name_scope,
+)
+from .executor import Executor, Scope, global_scope, CompiledBlock  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .nn_static import data, accuracy  # noqa: F401
+from . import nn_static as nn  # noqa: F401
+from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
+from .amp_static import amp_decorate  # noqa: F401
+
+
+class InputSpec:
+    """paddle.static.InputSpec (fluid/data_feeder or paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class CompiledProgram:
+    """Parity: fluid/compiler.py CompiledProgram — on TPU the plain Executor
+    already compiles whole blocks with XLA, so this is a thin marker that
+    carries build strategy options."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._loss_name = loss_name
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class BuildStrategy:
+    """details/build_strategy.h parity (knobs accepted, XLA decides fusion)."""
+
+    def __init__(self):
+        self.fuse_all_reduce_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+        self.num_trainers = 1
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10

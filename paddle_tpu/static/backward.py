@@ -1,0 +1,193 @@
+"""Static autodiff: append_backward.
+
+Reference parity: python/paddle/fluid/backward.py (append_backward:1377,
+_append_backward_ops_:1023) — walk forward ops in reverse, emit one grad op per
+forward op, accumulate multi-consumer grads.  TPU-native twist: instead of
+per-op registered grad kernels, each grad op's lowering is `jax.vjp` of the
+forward op's own jax fn (grads come free and stay exactly consistent); XLA CSE
+dedups the recomputed forward inside the single compiled block.
+"""
+import jax
+import jax.numpy as jnp
+
+from .program import default_main_program, Variable
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def _grad_name(name):
+    return name + GRAD_SUFFIX
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Returns list of (param_var, grad_var) like the reference."""
+    program = loss.block.program
+    block = program.global_block()
+    ops = list(block.ops)
+
+    no_grad = set(no_grad_set or ())
+
+    # requires-grad analysis: forward sweep
+    requires = set()
+    for v in block.vars.values():
+        if v.is_parameter and not v.stop_gradient and v.name not in no_grad:
+            requires.add(v.name)
+    for op in ops:
+        if op.fn is None:
+            continue
+        ins = getattr(op, "in_order", op.input_names())
+        if any(n in requires for n in ins):
+            for n in getattr(op, "out_order", op.output_names()):
+                requires.add(n)
+
+    if loss.name not in requires:
+        raise RuntimeError("loss does not depend on any trainable parameter")
+
+    # init loss grad = ones (fill_constant grad op, backward.py parity)
+    loss_grad = block.create_var(name=_grad_name(loss.name), shape=loss.shape,
+                                 dtype=loss.dtype)
+    lshape = tuple(loss.shape or ())
+    block.append_op(
+        "fill_constant_grad", {}, {"Out": [loss_grad.name]},
+        {"shape": list(lshape), "value": 1.0},
+        fn=lambda: jnp.ones(lshape, jnp.float32),
+    )
+    block.ops[-1].in_order = []
+    block.ops[-1].out_order = [loss_grad.name]
+
+    # which grads exist so far (name -> grad var name)
+    have_grad = {loss.name: loss_grad.name}
+    acc_count = {}
+
+    for op in reversed(ops):
+        if op.fn is None:
+            continue
+        out_names = getattr(op, "out_order", op.output_names())
+        in_names = getattr(op, "in_order", op.input_names())
+        if not any(n in requires for n in in_names):
+            continue
+        out_grads_avail = [have_grad.get(n) for n in out_names]
+        if all(g is None for g in out_grads_avail):
+            continue
+
+        diff_idx = [i for i, n in enumerate(in_names) if n in requires]
+        if not diff_idx:
+            continue
+
+        fwd_fn = op.fn
+        n_outs = len(out_names)
+        out_shapes = [
+            tuple(block.var(n).shape or ()) if block.has_var(n) else None
+            for n in out_names
+        ]
+
+        def make_grad_fn(fwd_fn, diff_idx, n_in, n_outs, avail_mask):
+            def grad_fn(*args):
+                # args = forward inputs (n_in) + available output grads
+                fwd_in = args[:n_in]
+                ogs = args[n_in:]
+
+                def partial_fwd(*diff_vals):
+                    full = list(fwd_in)
+                    for i, dv in zip(diff_idx, diff_vals):
+                        full[i] = dv
+                    res = fwd_fn(*full)
+                    return res if isinstance(res, tuple) else (res,)
+
+                primals = [fwd_in[i] for i in diff_idx]
+                outs, vjp = jax.vjp(partial_fwd, *primals)
+                cots = []
+                gi = 0
+                for j in range(n_outs):
+                    if avail_mask[j]:
+                        cots.append(ogs[gi].astype(outs[j].dtype)
+                                    if ogs[gi].dtype != outs[j].dtype else ogs[gi])
+                        gi += 1
+                    else:
+                        cots.append(jnp.zeros_like(outs[j]))
+                in_cots = vjp(tuple(cots))
+                return in_cots if len(in_cots) > 1 else in_cots[0]
+
+            return grad_fn
+
+        avail_mask = [g is not None for g in out_grads_avail]
+        grad_fn = make_grad_fn(fwd_fn, diff_idx, len(in_names), n_outs, avail_mask)
+
+        grad_in_names = list(in_names) + [g for g in out_grads_avail if g]
+        new_grad_outs = []
+        for i in diff_idx:
+            src = in_names[i]
+            gname = _grad_name(src)
+            if src in have_grad:
+                # multi-consumer: accumulate (gradient_accumulator.cc parity)
+                acc_count[src] = acc_count.get(src, 0) + 1
+                gname = f"{_grad_name(src)}@RENAME@{acc_count[src]}"
+            if not block.has_var(gname):
+                v = block.vars.get(src)
+                block.create_var(name=gname, shape=v.shape if v else None,
+                                 dtype=v.dtype if v else "float32")
+            new_grad_outs.append((src, gname))
+
+        gop = block.append_op(
+            f"{op.type}_grad",
+            {"X": list(in_names), "Out@GRAD": [g for g in out_grads_avail if g]},
+            {"X@GRAD": [g for _, g in new_grad_outs]},
+            {}, fn=grad_fn,
+        )
+        gop.in_order = grad_in_names
+        gop.out_order = [g for _, g in new_grad_outs]
+
+        for src, gname in new_grad_outs:
+            if src in have_grad and gname != _grad_name(src):
+                # emit sum op
+                prev = have_grad[src]
+                summed = f"{_grad_name(src)}@SUM@{acc_count[src]}"
+                block.create_var(name=summed,
+                                 shape=block.vars[src].shape,
+                                 dtype=block.vars[src].dtype)
+                sop = block.append_op(
+                    "sum", {"X": [prev, gname]}, {"Out": [summed]}, {},
+                    fn=lambda a, b: a + b,
+                )
+                sop.in_order = [prev, gname]
+                sop.out_order = [summed]
+                have_grad[src] = summed
+            else:
+                have_grad[src] = gname
+
+    # canonicalize param grads to NAME@GRAD (tests look these up by name)
+    params = parameter_list or [
+        v.name for v in block.vars.values() if v.is_parameter
+    ]
+    result = []
+    for pname in params:
+        p = block.vars.get(pname if isinstance(pname, str) else pname.name)
+        if p is None or p.stop_gradient:
+            continue
+        g = have_grad.get(p.name)
+        if g is None:
+            continue
+        canonical = _grad_name(p.name)
+        if g != canonical:
+            if not block.has_var(canonical):
+                block.create_var(name=canonical, shape=p.shape, dtype=p.dtype)
+            aop = block.append_op("assign", {"X": [g]}, {"Out": [canonical]}, {},
+                                  fn=lambda a: a)
+            aop.in_order = [g]
+            aop.out_order = [canonical]
+        result.append((p, block.var(canonical)))
+    return result
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    pgs = append_backward(targets[0], no_grad_set=no_grad_set,
+                          parameter_list=[
+                              i.name if isinstance(i, Variable) else i
+                              for i in (inputs if isinstance(inputs, (list, tuple))
+                                        else [inputs])
+                          ])
+    return [g for _, g in pgs]

@@ -1,0 +1,68 @@
+"""Static model save/load.
+
+Reference parity: fluid/io.py save_inference_model:1246 / load_inference_model
+:1459, save_vars/load_vars :286/:740; C++ save_load_util.cc.  Format: pickle of
+program desc + npz of persistable vars (schema parity, not byte parity).
+"""
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .program import default_main_program
+from .executor import global_scope
+
+
+def save(program, model_path, protocol=4):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    scope = global_scope()
+    params = {}
+    for v in program.list_vars():
+        if v.persistable and scope.get(v.name) is not None:
+            params[v.name] = np.asarray(scope.get(v.name))
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump(program.desc_dict(), f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    scope = global_scope()
+    for name, arr in params.items():
+        scope.set(name, jnp.asarray(arr))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    program = program or default_main_program()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    scope = global_scope()
+    params = {
+        v.name: np.asarray(scope.get(v.name))
+        for v in program.list_vars()
+        if v.persistable and scope.get(v.name) is not None
+    }
+    meta = {
+        "desc": program.desc_dict(),
+        "feed_names": [v.name for v in feed_vars],
+        "fetch_names": [v.name for v in fetch_vars],
+    }
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(params, f)
+    return program
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+    scope = global_scope()
+    for name, arr in params.items():
+        scope.set(name, jnp.asarray(arr))
+    return meta, meta["feed_names"], meta["fetch_names"]

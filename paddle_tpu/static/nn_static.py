@@ -1,0 +1,398 @@
+"""Static-graph op emission (LayerHelper parity).
+
+Reference parity: python/paddle/fluid/layers/* append_op paths and
+python/paddle/fluid/layer_helper.py.  Each emitted Operator carries `fn`, the
+pure-jax lowering (same semantics as the eager registry), plus positional
+input/output orders used by the executor's whole-block XLA lowering and by
+append_backward's jax.vjp-based grad ops.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from .program import default_main_program, default_startup_program, Variable
+
+
+def _cur_block():
+    return default_main_program().current_block()
+
+
+def _new_out(shape=None, dtype="float32", stop_gradient=False):
+    return _cur_block().create_var(shape=shape, dtype=dtype,
+                                   stop_gradient=stop_gradient)
+
+
+def emit(op_type, ins, outs_spec, fn, attrs=None):
+    """ins: list[(slot, Variable)], outs_spec: list[(slot, shape, dtype)].
+    fn: pure jax callable positional-inputs -> tuple of outputs."""
+    block = _cur_block()
+    outs = []
+    inputs = {}
+    in_order = []
+    for slot, v in ins:
+        inputs.setdefault(slot, []).append(v.name)
+        in_order.append(v.name)
+    outputs = {}
+    out_order = []
+    for slot, shape, dtype in outs_spec:
+        o = block.create_var(shape=shape, dtype=dtype)
+        outputs.setdefault(slot, []).append(o.name)
+        out_order.append(o.name)
+        outs.append(o)
+    op = block.append_op(op_type, inputs, outputs, attrs or {}, fn=fn)
+    op.in_order = in_order
+    op.out_order = out_order
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _infer_eltwise_shape(x, y):
+    try:
+        return list(np.broadcast_shapes(tuple(x.shape or ()), tuple(y.shape or ())))
+    except Exception:
+        return x.shape
+
+
+def _elementwise_emit(op_type, x, y, reverse=False):
+    fns = {
+        "elementwise_add": lambda a, b: a + b,
+        "elementwise_sub": lambda a, b: a - b,
+        "elementwise_mul": lambda a, b: a * b,
+        "elementwise_div": lambda a, b: a / b,
+        "elementwise_max": jnp.maximum,
+        "elementwise_min": jnp.minimum,
+        "elementwise_pow": jnp.power,
+    }
+    fn = fns[op_type]
+    if not isinstance(y, Variable):
+        c = float(y)
+        if reverse:
+            return emit(op_type, [("Y", x)], [("Out", x.shape, x.dtype)],
+                        lambda b: fn(c, b))
+        return emit(op_type, [("X", x)], [("Out", x.shape, x.dtype)],
+                    lambda a: fn(a, c))
+    shape = _infer_eltwise_shape(x, y)
+    if reverse:
+        x, y = y, x
+    return emit(op_type, [("X", x), ("Y", y)], [("Out", shape, x.dtype)], fn)
+
+
+# ---- data & feed ----
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data (fluid/data.py)."""
+    block = default_main_program().global_block()
+    v = block.create_var(name=name, shape=shape, dtype=dtype, is_data=True,
+                         stop_gradient=True)
+    return v
+
+
+# ---- core layers used by model builders ----
+
+def fc(x, size, weight_attr=None, bias_attr=None, activation=None, name=None):
+    from .param_helper import create_parameter
+
+    in_dim = int(np.prod(x.shape[1:])) if len(x.shape) > 2 else x.shape[-1]
+    w = create_parameter([in_dim, size], x.dtype, attr=weight_attr)
+    ins = [("Input", x), ("W", w)]
+
+    def fn(xv, wv, *b):
+        xf = xv.reshape(xv.shape[0], -1) if xv.ndim > 2 else xv
+        out = xf @ wv
+        if b:
+            out = out + b[0]
+        return out
+
+    if bias_attr is not False:
+        b = create_parameter([size], x.dtype, attr=bias_attr, is_bias=True)
+        ins.append(("Bias", b))
+    out = emit("fc", ins, [("Out", [x.shape[0], size], x.dtype)], fn)
+    if activation:
+        out = globals()[activation](out)
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        return out * alpha if alpha != 1.0 else out
+
+    xs = list(x.shape)
+    ys = list(y.shape)
+    if transpose_x:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    shape = xs[:-1] + [ys[-1]]
+    return emit("matmul_v2", [("X", x), ("Y", y)], [("Out", shape, x.dtype)], fn)
+
+
+def relu(x, name=None):
+    return emit("relu", [("X", x)], [("Out", x.shape, x.dtype)], jax.nn.relu)
+
+
+def tanh_act(x, name=None):
+    return emit("tanh", [("X", x)], [("Out", x.shape, x.dtype)], jnp.tanh)
+
+
+def sigmoid_act(x, name=None):
+    return emit("sigmoid", [("X", x)], [("Out", x.shape, x.dtype)], jax.nn.sigmoid)
+
+
+def softmax(x, axis=-1, name=None):
+    return emit("softmax", [("X", x)], [("Out", x.shape, x.dtype)],
+                lambda v: jax.nn.softmax(v, axis=axis))
+
+
+def mean(x, name=None):
+    return emit("reduce_mean", [("X", x)], [("Out", [1], x.dtype)],
+                lambda v: jnp.mean(v)[None])
+
+
+def reduce_sum(x, dim=None, keep_dim=False, name=None):
+    axis = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    shape = [1] if axis is None and not keep_dim else x.shape
+    return emit("reduce_sum", [("X", x)], [("Out", shape, x.dtype)],
+                lambda v: jnp.sum(v, axis=axis, keepdims=keep_dim).reshape(shape)
+                if axis is None else jnp.sum(v, axis=axis, keepdims=keep_dim))
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    def fn(p, l):
+        if soft_label:
+            return -jnp.sum(l * jnp.log(jnp.maximum(p, 1e-12)), axis=-1,
+                            keepdims=True)
+        li = l
+        if li.ndim == p.ndim and li.shape[-1] == 1:
+            li = jnp.squeeze(li, -1)
+        picked = jnp.take_along_axis(
+            jnp.log(jnp.maximum(p, 1e-12)), li[..., None].astype(jnp.int32), axis=-1
+        )
+        return -picked
+
+    shape = list(input.shape[:-1]) + [1]
+    return emit("cross_entropy", [("X", input), ("Label", label)],
+                [("Y", shape, input.dtype)], fn)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    def fn(lg, l):
+        logp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label:
+            return -jnp.sum(l * logp, axis=axis, keepdims=True)
+        li = l
+        if li.ndim == lg.ndim and li.shape[axis] == 1:
+            li = jnp.squeeze(li, axis)
+        return -jnp.take_along_axis(logp, li[..., None].astype(jnp.int32), axis=axis)
+
+    shape = list(logits.shape)
+    shape[axis] = 1
+    return emit("softmax_with_cross_entropy",
+                [("Logits", logits), ("Label", label)],
+                [("Loss", shape, logits.dtype)], fn)
+
+
+def accuracy(input, label, k=1):
+    def fn(p, l):
+        pred = jnp.argmax(p, axis=-1)
+        li = l.reshape(pred.shape)
+        return jnp.mean((pred == li).astype(jnp.float32))[None]
+
+    return emit("accuracy", [("Out", input), ("Label", label)],
+                [("Accuracy", [1], "float32")], fn)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from .param_helper import create_parameter
+    from ..ops.nn_ops import _pair, _conv_padding
+
+    k = _pair(filter_size)
+    s = _pair(stride)
+    d = _pair(dilation)
+    pad = _conv_padding(padding, k, s, d, 2)
+    C = input.shape[1]
+    w = create_parameter([num_filters, C // groups, k[0], k[1]], input.dtype,
+                         attr=param_attr)
+    ins = [("Input", input), ("Filter", w)]
+
+    def fn(xv, wv, *b):
+        out = jax.lax.conv_general_dilated(
+            xv, wv, s, pad, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups,
+        )
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, attr=bias_attr,
+                             is_bias=True)
+        ins.append(("Bias", b))
+
+    H, W = input.shape[2], input.shape[3]
+    if isinstance(pad, str):
+        oh = -(-H // s[0]) if pad == "SAME" else (H - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = -(-W // s[1]) if pad == "SAME" else (W - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    else:
+        oh = (H + pad[0][0] + pad[0][1] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (W + pad[1][0] + pad[1][1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    return emit("conv2d", ins,
+                [("Output", [input.shape[0], num_filters, oh, ow], input.dtype)],
+                fn)
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, name=None):
+    from ..ops.nn_ops import _pair
+
+    if global_pooling:
+        def fn(v):
+            red = jnp.max if pool_type == "max" else jnp.mean
+            return red(v, axis=(2, 3), keepdims=True)
+
+        return emit("pool2d", [("X", input)],
+                    [("Out", [input.shape[0], input.shape[1], 1, 1], input.dtype)],
+                    fn, attrs={"global_pooling": True})
+    k = _pair(pool_size)
+    s = _pair(pool_stride)
+    p = _pair(pool_padding)
+
+    def fn(v):
+        pad_seq = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+        window = [1, 1, k[0], k[1]]
+        strides = [1, 1, s[0], s[1]]
+        if pool_type == "max":
+            return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, window,
+                                         strides, pad_seq)
+        ssum = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pad_seq)
+        return ssum / (k[0] * k[1])
+
+    H, W = input.shape[2], input.shape[3]
+    oh = (H + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (W + 2 * p[1] - k[1]) // s[1] + 1
+    return emit("pool2d", [("X", input)],
+                [("Out", [input.shape[0], input.shape[1], oh, ow], input.dtype)],
+                fn)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", name=None):
+    from .param_helper import create_parameter
+
+    C = input.shape[1]
+    scale = create_parameter([C], "float32", attr=param_attr, default_value=1.0)
+    bias = create_parameter([C], "float32", attr=bias_attr, is_bias=True)
+    mean = create_parameter([C], "float32", default_value=0.0, stop_gradient=True,
+                            name_hint="bn_mean")
+    var = create_parameter([C], "float32", default_value=1.0, stop_gradient=True,
+                           name_hint="bn_var")
+
+    reduce_axes = tuple(i for i in range(len(input.shape)) if i != 1)
+    shape = [1, C] + [1] * (len(input.shape) - 2)
+
+    def fn(v, sc, b, m, va):
+        if is_test:
+            mean_u, var_u = m, va
+        else:
+            mean_u = jnp.mean(v, axis=reduce_axes)
+            var_u = jnp.mean(jnp.square(v), axis=reduce_axes) - jnp.square(mean_u)
+        out = (v - mean_u.reshape(shape)) * jax.lax.rsqrt(
+            var_u.reshape(shape) + epsilon
+        )
+        out = out * sc.reshape(shape) + b.reshape(shape)
+        if act == "relu":
+            out = jax.nn.relu(out)
+        return out
+
+    return emit("batch_norm",
+                [("X", input), ("Scale", scale), ("Bias", bias), ("Mean", mean),
+                 ("Variance", var)],
+                [("Y", input.shape, input.dtype)], fn,
+                attrs={"is_test": is_test, "momentum": momentum})
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
+    import jax.random as jrandom
+
+    key = jrandom.PRNGKey(seed or 0)
+
+    def fn(v):
+        if is_test or dropout_prob == 0.0:
+            return v
+        keep = jrandom.bernoulli(key, 1.0 - dropout_prob, v.shape)
+        return jnp.where(keep, v / (1.0 - dropout_prob), 0.0)
+
+    return emit("dropout", [("X", x)], [("Out", x.shape, x.dtype)], fn,
+                attrs={"dropout_prob": dropout_prob, "is_test": is_test})
+
+
+def reshape(x, shape, name=None):
+    shape2 = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return emit("reshape2", [("X", x)], [("Out", shape2, x.dtype)],
+                lambda v: jnp.reshape(v, [v.shape[0] if s == -1 and i == 0 else s
+                                          for i, s in enumerate(shape2)]))
+
+
+def flatten(x, axis=1, name=None):
+    shape = [int(np.prod(x.shape[:axis]) or -1), int(np.prod(x.shape[axis:]))]
+
+    def fn(v):
+        return v.reshape(v.shape[0] if axis == 1 else -1, -1)
+
+    return emit("flatten", [("X", x)], [("Out", shape, x.dtype)], fn)
+
+
+def embedding(input, size, padding_idx=None, param_attr=None, dtype="float32"):
+    from .param_helper import create_parameter
+
+    w = create_parameter(list(size), dtype, attr=param_attr)
+
+    def fn(idx, wv):
+        out = jnp.take(wv, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            out = out * (idx != padding_idx)[..., None].astype(out.dtype)
+        return out
+
+    shape = list(input.shape) + [size[1]]
+    return emit("lookup_table_v2", [("Ids", input), ("W", w)],
+                [("Out", shape, dtype)], fn)
+
+
+def layer_norm_static(x, scale=True, shift=True, begin_norm_axis=1,
+                      epsilon=1e-5, param_attr=None, bias_attr=None):
+    from .param_helper import create_parameter
+
+    norm_shape = [int(np.prod(x.shape[begin_norm_axis:]))]
+    ins = [("X", x)]
+    if scale:
+        w = create_parameter(norm_shape, "float32", attr=param_attr,
+                             default_value=1.0)
+        ins.append(("Scale", w))
+    if shift:
+        b = create_parameter(norm_shape, "float32", attr=bias_attr, is_bias=True)
+        ins.append(("Bias", b))
+
+    def fn(v, *wb):
+        orig = v.shape
+        v2 = v.reshape(tuple(orig[:begin_norm_axis]) + (-1,))
+        mean = jnp.mean(v2, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(v2 - mean), axis=-1, keepdims=True)
+        out = (v2 - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if scale:
+            out = out * wb[i]
+            i += 1
+        if shift:
+            out = out + wb[i]
+        return out.reshape(orig)
+
+    return emit("layer_norm", ins, [("Y", x.shape, x.dtype)], fn)

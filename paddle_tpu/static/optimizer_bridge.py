@@ -1,0 +1,72 @@
+"""Static-mode optimizer lowering.
+
+Reference parity: Optimizer.minimize in static mode appends backward +
+per-param update ops (fluid/optimizer.py _append_optimize_op); optimizer state
+(moments, beta pows) are persistable vars initialized by the startup program.
+Here the update op's lowering is the SAME pure `update` rule the dygraph path
+uses (optimizer/optimizer.py), so both modes share one implementation.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .program import default_main_program, default_startup_program
+from .backward import append_backward
+
+
+def static_minimize(optimizer, loss, startup_program=None, parameter_list=None,
+                    no_grad_set=None):
+    main = loss.block.program
+    startup = startup_program or default_startup_program()
+    params_grads = append_backward(loss, parameter_list=parameter_list,
+                                   no_grad_set=no_grad_set)
+    block = main.global_block()
+    lr = optimizer.get_lr()
+    wd = optimizer._weight_decay_coeff()
+    decoupled = optimizer._decoupled_weight_decay
+
+    for p, g in params_grads:
+        # create optimizer state vars + startup init
+        from ..core.tensor import _wrap_data
+
+        fake = _wrap_data(jnp.zeros(tuple(p.shape), p.dtype))
+        states = optimizer._init_state(fake)
+        state_names = []
+        for k, arr in states.items():
+            sname = f"{p.name}_{k}"
+            if not block.has_var(sname):
+                sv = block.create_var(name=sname, shape=list(arr.shape),
+                                      dtype="float32", persistable=True)
+                sv.is_parameter = False
+                np_arr = np.asarray(arr)
+                startup.global_block().append_op(
+                    "init", {}, {"Out": [sname]}, {},
+                    fn=lambda a=np_arr: jnp.asarray(a),
+                )
+            state_names.append((k, sname))
+
+        plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+
+        def update_fn(pv, gv, *svals, _opt=optimizer, _keys=[k for k, _ in state_names],
+                      _plr=plr, _wd=wd, _dec=decoupled, _pname=p.name):
+            gv = gv.astype(pv.dtype) if gv.dtype != pv.dtype else gv
+            if _wd and not _dec:
+                gv = gv + _wd * pv
+            state = dict(zip(_keys, svals))
+            _opt._current_param_name = _pname
+            new_p, new_state = _opt.update(pv, gv, state, _plr)
+            if _wd and _dec:
+                new_p = new_p - _plr * _wd * pv
+            return (new_p,) + tuple(new_state[k] for k in _keys)
+
+        uop = block.append_op(
+            optimizer.__class__.__name__.lower(),
+            {"Param": [p.name], "Grad": [g.name],
+             **{k.capitalize(): [s] for k, s in state_names}},
+            {"ParamOut": [p.name],
+             **{k.capitalize() + "Out": [s] for k, s in state_names}},
+            {}, fn=update_fn,
+        )
+        uop.in_order = [p.name, g.name] + [s for _, s in state_names]
+        uop.out_order = [p.name] + [s for _, s in state_names]
+
+    return None, params_grads

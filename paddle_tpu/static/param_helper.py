@@ -1,0 +1,44 @@
+"""Static parameter creation: startup-program initialization parity.
+
+Reference parity: LayerHelper.create_parameter (fluid/layer_helper_base.py) —
+parameters are vars in the main program plus init ops in the startup program
+(executed by exe.run(startup_program)).
+"""
+import numpy as np
+
+from ..nn.layer import ParamAttr
+from ..nn.initializer import Constant, XavierNormal
+from .program import default_main_program, default_startup_program
+
+
+def create_parameter(shape, dtype="float32", attr=None, is_bias=False,
+                     default_value=None, stop_gradient=False, name_hint="param"):
+    attr = ParamAttr._to_attr(attr)
+    main = default_main_program()
+    startup = default_startup_program()
+    name = (attr.name if attr and attr.name else
+            main._unique_name("b" if is_bias else name_hint))
+    v = main.global_block().create_parameter(name=name, shape=shape, dtype=dtype)
+    v.stop_gradient = stop_gradient or (attr is not None and not attr.trainable)
+    v.trainable = not v.stop_gradient
+    v.optimize_attr = {"learning_rate": attr.learning_rate if attr else 1.0}
+    v.regularizer = attr.regularizer if attr else None
+
+    init = attr.initializer if attr and attr.initializer else None
+    if init is None:
+        if default_value is not None:
+            init = Constant(default_value)
+        elif is_bias:
+            init = Constant(0.0)
+        else:
+            init = XavierNormal()
+    v.initializer = init
+
+    # mirror var into startup program with an init op
+    sv = startup.global_block().create_parameter(name=name, shape=shape, dtype=dtype)
+    sv.initializer = init
+    startup.global_block().append_op(
+        "init", {}, {"Out": [name]}, {"shape": shape, "dtype": str(dtype)},
+        fn=lambda: init(shape),
+    )
+    return v

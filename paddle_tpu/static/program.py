@@ -1,0 +1,279 @@
+"""Static graph IR: Program / Block / Operator / Variable.
+
+Reference parity: framework.proto:43-207 (OpDesc/VarDesc/BlockDesc/ProgramDesc)
+and the Python mirror python/paddle/fluid/framework.py (Program/Block/Operator/
+Variable, program_guard, default programs).  TPU-native: the IR is pure Python
+metadata; execution lowers a whole block into ONE jit-compiled XLA computation
+(static/executor.py), so the IR never needs per-op kernels — each Operator
+carries the jax callable it lowers through (the same registry entry eager mode
+uses).  Serialization is pickle of the descs (protobuf schema parity is shape,
+not bytes).
+"""
+import collections
+import contextlib
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+
+_dygraph_mode = True
+
+
+class Variable:
+    """VarDesc parity (framework.proto:106)."""
+
+    def __init__(self, block, name, shape=None, dtype="float32", persistable=False,
+                 stop_gradient=False, is_data=False, lod_level=0):
+        self.block = block
+        self.name = name
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.lod_level = lod_level
+        self.initializer = None  # set for parameters
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_parameter = False
+        self.trainable = True
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"Var({self.name}: {self.shape} {np.dtype(self.dtype).name})"
+
+    # static vars support arithmetic via op emission
+    def _emit(self, op_type, other=None, reverse=False, **attrs):
+        from . import nn_static as NS
+
+        return NS._elementwise_emit(op_type, self, other, reverse)
+
+    def __add__(self, other):
+        return self._emit("elementwise_add", other)
+
+    def __radd__(self, other):
+        return self._emit("elementwise_add", other, reverse=True)
+
+    def __sub__(self, other):
+        return self._emit("elementwise_sub", other)
+
+    def __rsub__(self, other):
+        return self._emit("elementwise_sub", other, reverse=True)
+
+    def __mul__(self, other):
+        return self._emit("elementwise_mul", other)
+
+    def __rmul__(self, other):
+        return self._emit("elementwise_mul", other, reverse=True)
+
+    def __truediv__(self, other):
+        return self._emit("elementwise_div", other)
+
+    def __matmul__(self, other):
+        from . import nn_static as NS
+
+        return NS.matmul(self, other)
+
+
+Parameter = Variable
+
+
+class Operator:
+    """OpDesc parity (framework.proto:43): type + named input/output var lists +
+    attrs.  `fn` is the jax lowering callable: fn(attrs)(*input_arrays) ->
+    tuple(output_arrays), resolved at executor-lowering time."""
+
+    def __init__(self, block, op_type, inputs, outputs, attrs=None, fn=None):
+        self.block = block
+        self.type = op_type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+        self.fn = fn
+
+    def input_names(self):
+        return [v for vs in self.inputs.values() for v in vs]
+
+    def output_names(self):
+        return [v for vs in self.outputs.values() for v in vs]
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    def __repr__(self):
+        return f"Op({self.type}: {self.inputs} -> {self.outputs})"
+
+
+class Block:
+    """BlockDesc parity (framework.proto:178)."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = collections.OrderedDict()
+        self.ops = []
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise KeyError(f"Variable {name} not found in block {self.idx}")
+        return v
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def create_var(self, name=None, shape=None, dtype="float32", persistable=False,
+                   stop_gradient=False, is_data=False, **kw):
+        if name is None:
+            name = self.program._unique_name("tmp")
+        v = Variable(self, name, shape, dtype, persistable, stop_gradient, is_data)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name=None, shape=None, dtype="float32",
+                         initializer=None, **kw):
+        v = self.create_var(name=name or self.program._unique_name("param"),
+                            shape=shape, dtype=dtype, persistable=True)
+        v.is_parameter = True
+        v.initializer = initializer
+        return v
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None, fn=None):
+        op = Operator(self, type, inputs, outputs, attrs, fn=fn)
+        self.ops.append(op)
+        return op
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if v.is_parameter]
+
+
+class Program:
+    """ProgramDesc parity (framework.proto:202)."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._name_counter = collections.Counter()
+        self.random_seed = 0
+        self._pipeline_opt = None
+        self._is_start_up = False
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def _unique_name(self, prefix):
+        self._name_counter[prefix] += 1
+        return f"{prefix}_{self._name_counter[prefix]}"
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = copy.deepcopy(self)
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+                    if op.type == "dropout":
+                        op.attrs["dropout_prob"] = 0.0
+        return p
+
+    def __repr__(self):
+        lines = []
+        for b in self.blocks:
+            lines.append(f"block {b.idx}:")
+            for op in b.ops:
+                lines.append(f"  {op}")
+        return "\n".join(lines)
+
+    # ---- serialization (schema parity: pickleable descs) ----
+    def desc_dict(self):
+        return {
+            "blocks": [
+                {
+                    "idx": b.idx,
+                    "vars": {
+                        n: {
+                            "shape": v.shape,
+                            "dtype": np.dtype(v.dtype).name,
+                            "persistable": v.persistable,
+                            "is_parameter": v.is_parameter,
+                        }
+                        for n, v in b.vars.items()
+                    },
+                    "ops": [
+                        {
+                            "type": op.type,
+                            "inputs": op.inputs,
+                            "outputs": op.outputs,
+                            "attrs": {
+                                k: v for k, v in op.attrs.items()
+                                if _pickleable(v)
+                            },
+                        }
+                        for op in b.ops
+                    ],
+                }
+                for b in self.blocks
+            ]
+        }
+
+
+def _pickleable(v):
+    return isinstance(v, (int, float, str, bool, list, tuple, type(None)))
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_main, prev_startup = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program = prev_main
+        _startup_program = prev_startup
+
+
+def name_scope(prefix):
+    return contextlib.nullcontext()

@@ -1,0 +1,1 @@
+from .datasets import Imdb, UCIHousing, WMT14  # noqa: F401

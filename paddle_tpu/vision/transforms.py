@@ -1,0 +1,155 @@
+"""Vision transforms.  Ref: python/paddle/vision/transforms/ (Compose,
+Normalize, Resize, flips, crops, ToTensor) — numpy/host-side implementations."""
+import numbers
+import random
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _chw(img):
+    if img.ndim == 2:
+        return img[None]
+    if img.shape[-1] in (1, 3, 4) and img.shape[0] not in (1, 3, 4):
+        return np.transpose(img, (2, 0, 1))
+    return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = _chw(arr)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean[: arr.shape[0]].reshape(-1, 1, 1)
+            s = self.std[: arr.shape[0]].reshape(-1, 1, 1)
+        else:
+            m = self.mean[: arr.shape[-1]]
+            s = self.std[: arr.shape[-1]]
+        return (arr - m) / s
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if chw:
+            arr = np.transpose(arr, (1, 2, 0))
+        h, w = self.size
+        ys = (np.arange(h) * (arr.shape[0] / h)).astype(int).clip(0, arr.shape[0] - 1)
+        xs = (np.arange(w) * (arr.shape[1] / w)).astype(int).clip(0, arr.shape[1] - 1)
+        out = arr[ys][:, xs]
+        if chw:
+            out = np.transpose(out, (2, 0, 1))
+        return out
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.ascontiguousarray(np.flip(img, axis=-1))
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.ascontiguousarray(np.flip(img, axis=-2))
+        return img
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        h, w = self.size
+        H, W = img.shape[-2], img.shape[-1]
+        top = max((H - h) // 2, 0)
+        left = max((W - w) // 2, 0)
+        return img[..., top: top + h, left: left + w]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        if self.padding:
+            pad = [(0, 0)] * (img.ndim - 2) + [(self.padding, self.padding)] * 2
+            img = np.pad(img, pad)
+        h, w = self.size
+        H, W = img.shape[-2], img.shape[-1]
+        top = random.randint(0, max(H - h, 0))
+        left = random.randint(0, max(W - w, 0))
+        return img[..., top: top + h, left: left + w]
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return np.transpose(arr, self.order)

@@ -1,0 +1,32 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip sharding is tested on virtual CPU devices (SURVEY §4: the
+reference emulates multi-node as multi-process localhost; our analogue is a
+host-platform device mesh).  Must run before the first jax backend
+initialization — jax.config.update('jax_platforms') overrides the axon/TPU
+plugin selection so tests never touch the real chip.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_framework_state():
+    yield
+    # isolate static-graph default programs between tests
+    from paddle_tpu.static import program as prog_mod
+
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    from paddle_tpu.static.executor import _global_scope
+
+    _global_scope._vars.clear()

@@ -1,0 +1,41 @@
+"""End-to-end smoke tests: core autograd + LeNet training slice."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_matmul_grad():
+    x = paddle.to_tensor(np.random.rand(4, 3).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.random.rand(3, 2).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.matmul(x, w)
+    loss = paddle.mean(y)
+    loss.backward()
+    assert x.grad.shape == [4, 3]
+    assert w.grad.shape == [3, 2]
+    # d(mean(x@w))/dw = x^T @ ones/8
+    expect = x.numpy().T @ np.full((4, 2), 1 / 8.0, np.float32)
+    np.testing.assert_allclose(w.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_lenet_training_loss_decreases():
+    paddle.seed(0)
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    img = paddle.to_tensor(np.random.rand(8, 1, 28, 28).astype("float32"))
+    lbl = paddle.to_tensor(np.random.randint(0, 10, (8, 1)))
+    losses = []
+    for _ in range(5):
+        out = net(img)
+        loss = paddle.mean(F.softmax_with_cross_entropy(out, lbl))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
