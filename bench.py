@@ -1,0 +1,77 @@
+"""Benchmark: BERT-base pretraining step throughput on one TPU chip.
+
+BASELINE.md config 3 (single-chip slice): BERT-base, bf16 autocast, fused
+compiled train step.  Prints ONE json line.  The reference publishes no
+numbers (BASELINE.json "published": {}), so vs_baseline is reported as 1.0
+by convention.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertForPretraining, BertConfig
+    from paddle_tpu.parallel.env import build_mesh
+    from paddle_tpu.parallel.hybrid import CompiledTrainStep
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    # full BERT-base on TPU; a slimmer proxy on CPU so the script stays
+    # runnable anywhere (config printed in the metric name only for TPU)
+    if on_tpu:
+        cfg = BertConfig(dropout=0.1)
+        batch, seq = 32, 128
+        warmup, iters = 3, 10
+    else:
+        cfg = BertConfig(num_layers=2, hidden_size=128, num_heads=2,
+                         ffn_hidden=512, dropout=0.1)
+        batch, seq = 8, 64
+        warmup, iters = 1, 3
+
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = build_mesh({"data": len(jax.devices())})
+    trainer = CompiledTrainStep(
+        model,
+        lambda m, ids, labels: m.loss(ids, labels),
+        opt, mesh, amp_dtype=jnp.bfloat16, zero_shard_states=False,
+    )
+
+    rng = np.random.RandomState(0)
+    B = batch * max(mesh.shape.get("data", 1), 1)
+    ids = rng.randint(0, cfg.vocab_size, (B, seq)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (B, seq)).astype(np.int32)
+    t_ids, t_labels = paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+    for _ in range(warmup):
+        loss = trainer.step(t_ids, t_labels)
+    jax.block_until_ready(loss._data)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(t_ids, t_labels)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = B * iters / dt
+    per_chip = samples_per_sec / len(jax.devices())
+    print(json.dumps({
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip"
+        if on_tpu else "bert_proxy_cpu_samples_per_sec",
+        "value": round(per_chip, 2),
+        "unit": "samples/s/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

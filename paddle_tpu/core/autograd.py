@@ -64,16 +64,26 @@ class TapeNode:
         "n_outputs",
         "out_shapes",
         "out_dtypes",
+        "diff_fn",
+        "tuple_out",
         "__weakref__",
     )
 
-    def __init__(self, op_type, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes):
+    def __init__(self, op_type, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes,
+                 diff_fn=None, tuple_out=None):
         self.op_type = op_type
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of Tensor (strong refs: keeps graph alive)
         self.n_outputs = n_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
+        # pure fn over the diff primals (non-diff args closed over) — used by
+        # grad(create_graph=True) to re-linearize so second-order grads see
+        # the primal dependency
+        self.diff_fn = diff_fn
+        # whether the forward returned a tuple (a 1-tuple's vjp expects a
+        # 1-tuple cotangent, not a bare array)
+        self.tuple_out = tuple_out if tuple_out is not None else n_outputs > 1
 
     def __repr__(self):
         return f"<TapeNode {self.op_type}>"
@@ -161,7 +171,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             c if c is not None else jnp.zeros(s, d)
             for c, s, d in zip(cots, node.out_shapes, node.out_dtypes)
         )
-        in_cots = node.vjp_fn(full if node.n_outputs > 1 else full[0])
+        in_cots = node.vjp_fn(full if node.tuple_out else full[0])
         if not isinstance(in_cots, tuple):
             in_cots = (in_cots,)
         for t, c in zip(node.inputs, in_cots):
@@ -178,6 +188,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         if not retain_graph:
             node.vjp_fn = None
             node.inputs = []
+            node.diff_fn = None  # closure retains the primal graph
 
     # write accumulated grads into leaves
     for _, (t, cot) in leaf_cots.items():
@@ -261,15 +272,32 @@ def grad(
                 for c, s, d in zip(cots, node.out_shapes, node.out_dtypes)
             )
 
-            vjp_fn = node.vjp_fn
             n_in = len(node.inputs)
 
-            def run_vjp(*cot_vals, _vjp=vjp_fn, _n=node.n_outputs):
-                res = _vjp(cot_vals if _n > 1 else cot_vals[0])
-                return res if isinstance(res, tuple) else (res,)
+            if create_graph and node.diff_fn is not None:
+                # re-linearize with primals as explicit args so the recorded
+                # tape node connects d(cotangent-out)/d(primal) — required
+                # for double grad
+                def run_vjp(*args, _fn=node.diff_fn, _np=n_in,
+                            _t=node.tuple_out):
+                    primals = args[:_np]
+                    cots = args[_np:]
+                    import jax as _jax
+
+                    _, vjp = _jax.vjp(_fn, *primals)
+                    res = vjp(tuple(cots) if _t else cots[0])
+                    return res if isinstance(res, tuple) else (res,)
+
+                op_args = tuple(node.inputs) + cot_tensors
+            else:
+                def run_vjp(*cot_vals, _vjp=node.vjp_fn, _t=node.tuple_out):
+                    res = _vjp(cot_vals if _t else cot_vals[0])
+                    return res if isinstance(res, tuple) else (res,)
+
+                op_args = cot_tensors
 
             in_cots = registry.apply_op(
-                f"vjp_{node.op_type}", run_vjp, cot_tensors, {}, n_outputs=n_in
+                f"vjp_{node.op_type}", run_vjp, op_args, {}, n_outputs=n_in
             )
             if not isinstance(in_cots, (list, tuple)):
                 in_cots = (in_cots,)

@@ -127,6 +127,8 @@ def apply_op(op_type, fn, args, kwargs, n_outputs=None):
         len(out_list),
         [v.shape for v in out_list],
         [v.dtype for v in out_list],
+        diff_fn=diff_fn,
+        tuple_out=multi,
     )
     outs = []
     for idx, v in enumerate(out_list):

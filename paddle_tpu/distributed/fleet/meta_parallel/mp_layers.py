@@ -28,6 +28,75 @@ def _mp_axis_in_scope():
         return False
 
 
+@jax.custom_vjp
+def _copy_to_mp(x):
+    """Identity forward / psum backward at the TP-region entry (the conjugate
+    of the output psum — Megatron's copy_to_tensor_parallel_region; the
+    reference's c_identity op with its allreduce grad)."""
+    return x
+
+
+def _copy_fwd(x):
+    return x, None
+
+
+def _copy_bwd(_, g):
+    return (jax.lax.psum(g, "model"),)
+
+
+_copy_to_mp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@jax.custom_vjp
+def _reduce_from_mp(x):
+    """psum forward / identity backward — the other Megatron conjugate pair
+    (reduce_from_tensor_parallel_region; the reference's c_allreduce_sum in
+    forward with identity grad).  Needed because shard_map(check_rep=False)
+    transposes psum to psum, which would scale gradients by mp."""
+    return jax.lax.psum(x, "model")
+
+
+def _reduce_fwd(x):
+    return jax.lax.psum(x, "model"), None
+
+
+def _reduce_bwd(_, g):
+    return (g,)
+
+
+_reduce_from_mp.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@jax.custom_vjp
+def _gather_from_mp(x):
+    """all_gather on the last dim forward / local-slice backward (Megatron's
+    gather_from_tensor_parallel_region).  Raw all_gather would transpose to
+    psum_scatter under check_rep=False and scale grads by mp."""
+    return jax.lax.all_gather(x, "model", axis=x.ndim - 1, tiled=True)
+
+
+def _gather_fwd(x):
+    return _gather_from_mp(x), x.shape[-1]
+
+
+def _gather_bwd(local_dim, g):
+    r = jax.lax.axis_index("model")
+    return (jax.lax.dynamic_slice_in_dim(g, r * local_dim, local_dim,
+                                         axis=g.ndim - 1),)
+
+
+_gather_from_mp.defvjp(_gather_fwd, _gather_bwd)
+
+
+def copy_to_model_parallel(x):
+    """Public entry marker for a TP region: identity forward, psum backward.
+    Apply to any replicated activation that feeds a model-sharded matmul
+    outside the provided layers (e.g. a tied LM head)."""
+    if _mp_axis_in_scope():
+        return apply_op("c_identity", _copy_to_mp, (x,), {})
+    return x
+
+
 class ColumnParallelLinear(Layer):
     """Weight [in, out] sharded on out (columns) over the 'model' axis."""
 
@@ -53,14 +122,11 @@ class ColumnParallelLinear(Layer):
             self.bias.is_distributed = True
 
     def forward(self, x):
+        if _mp_axis_in_scope():
+            x = apply_op("c_identity", _copy_to_mp, (x,), {})
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output and _mp_axis_in_scope():
-            out = apply_op(
-                "mp_allgather",
-                lambda v: jax.lax.all_gather(v, "model", axis=v.ndim - 1,
-                                             tiled=True),
-                (out,), {},
-            )
+            out = apply_op("mp_allgather", _gather_from_mp, (out,), {})
         return out
 
 
@@ -90,9 +156,7 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, None)
         if _mp_axis_in_scope():
-            out = apply_op(
-                "mp_allreduce", lambda v: jax.lax.psum(v, "model"), (out,), {}
-            )
+            out = apply_op("mp_allreduce", _reduce_from_mp, (out,), {})
         if self.bias is not None:
             from ....ops import math as M
 
@@ -128,7 +192,7 @@ class VocabParallelEmbedding(Layer):
                 local = jnp.clip(idx - lo, 0, per - 1)
                 emb = jnp.take(w, local, axis=0)
                 mask = ((idx >= lo) & (idx < lo + per))[..., None]
-                return jax.lax.psum(emb * mask.astype(emb.dtype), "model")
+                return _reduce_from_mp(emb * mask.astype(emb.dtype))
 
             return apply_op("vocab_parallel_embedding", fn, (self.weight,), {})
         return F.embedding(x, self.weight)
@@ -150,9 +214,14 @@ class ParallelCrossEntropy(Layer):
                 local_v = logits.shape[-1]
                 r = jax.lax.axis_index("model")
                 lo = r * local_v
-                gmax = jax.lax.pmax(jnp.max(logits, -1, keepdims=True), "model")
+                # stability shift only — sever BEFORE pmax (pmax has no grad
+                # rule; the shift cancels in the CE gradient anyway)
+                gmax = jax.lax.pmax(
+                    jax.lax.stop_gradient(jnp.max(logits, -1, keepdims=True)),
+                    "model",
+                )
                 ex = jnp.exp(logits - gmax)
-                denom = jax.lax.psum(jnp.sum(ex, -1, keepdims=True), "model")
+                denom = _reduce_from_mp(jnp.sum(ex, -1, keepdims=True))
                 li = lbl
                 if li.ndim == logits.ndim and li.shape[-1] == 1:
                     li = jnp.squeeze(li, -1)
@@ -161,7 +230,7 @@ class ParallelCrossEntropy(Layer):
                     logits - gmax, local[..., None].astype(jnp.int32), axis=-1
                 )
                 mask = ((li >= lo) & (li < lo + local_v))[..., None]
-                num = jax.lax.psum(picked * mask.astype(picked.dtype), "model")
+                num = _reduce_from_mp(picked * mask.astype(picked.dtype))
                 return jnp.log(denom) - num
 
             return apply_op("parallel_cross_entropy", fn, (input,), {})

@@ -51,6 +51,7 @@ def recompute(function, *args, **kwargs):
     node = TapeNode(
         "recompute", vjp_fn, diff_inputs, len(out_list),
         [v.shape for v in out_list], [v.dtype for v in out_list],
+        tuple_out=multi,
     )
     outs = []
     for i, v in enumerate(out_list):

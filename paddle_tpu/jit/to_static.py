@@ -39,9 +39,17 @@ class StaticFunction:
             inputs = [_wrap_data(v) for v in input_vals]
             with autograd.no_grad(), _random.rng_guard(key):
                 if layer is not None:
+                    # substitute param values, call the ORIGINAL forward
+                    # (layer.forward now points at this StaticFunction)
                     named = dict(layer.named_parameters())
-                    params = dict(zip(named.keys(), param_vals))
-                    out = layer.functional_call(params, *inputs)
+                    saved = {n: p._data for n, p in named.items()}
+                    try:
+                        for n, v in zip(named.keys(), param_vals):
+                            named[n]._data = v
+                        out = fn(*inputs)
+                    finally:
+                        for n, v in saved.items():
+                            named[n]._data = v
                 else:
                     out = fn(*inputs)
             flat, treedef = jax.tree_util.tree_flatten(

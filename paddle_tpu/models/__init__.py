@@ -1,0 +1,3 @@
+"""Flagship model zoo (NLP).  Vision zoo lives in paddle_tpu.vision.models."""
+from .gpt import GPTModel, GPTForPretraining, gpt_tiny, gpt2_small, gpt2_medium  # noqa: F401
+from .bert import BertModel, BertForPretraining, bert_base, bert_tiny  # noqa: F401

@@ -1,0 +1,125 @@
+"""BERT — encoder flagship (BASELINE.md config 3: BERT-base dygraph+AMP).
+
+Built from the framework's own transformer layers (nn/layers/transformer.py),
+so it exercises the same MultiHeadAttention/TransformerEncoder stack the
+reference's nn/layer/transformer.py provides.
+"""
+import numpy as np
+
+from ..nn import (
+    Layer, Embedding, LayerNorm, Dropout, Linear, Tanh,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+from ..nn import functional as F
+from ..ops import manipulation as MAN
+from ..ops import math as M
+from ..ops.creation import arange
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden=3072, max_seq_len=512,
+                 type_vocab_size=2, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden = ffn_hidden
+        self.max_seq_len = max_seq_len
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                      num_heads=4, ffn_hidden=128, max_seq_len=128,
+                      dropout=0.0, **kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(config.max_seq_len,
+                                             config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        B, L = input_ids.shape
+        pos = MAN.expand(MAN.reshape(arange(L, dtype="int32"), [1, L]), [B, L])
+        emb = M.add(self.word_embeddings(input_ids),
+                    self.position_embeddings(pos))
+        if token_type_ids is not None:
+            emb = M.add(emb, self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_heads, config.ffn_hidden,
+            dropout=config.dropout, activation="gelu",
+        )
+        self.encoder = TransformerEncoder(enc_layer, config.num_layers)
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+        self.pooler_act = Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B, L] 1/0 -> additive [B, 1, 1, L]
+            am = MAN.reshape(attention_mask,
+                             [attention_mask.shape[0], 1, 1,
+                              attention_mask.shape[1]])
+            x = self.encoder(x, src_mask=am)
+        else:
+            x = self.encoder(x)
+        pooled = self.pooler_act(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (pretraining loss parity)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.config = config
+        h = config.hidden_size
+        self.mlm_transform = Linear(h, h)
+        self.mlm_norm = LayerNorm(h)
+        self.nsp_head = Linear(h, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        mlm_h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        mlm_logits = M.matmul(
+            mlm_h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True,
+        )
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+    def loss(self, input_ids, mlm_labels, nsp_labels=None,
+             token_type_ids=None):
+        from ..ops.loss import softmax_with_cross_entropy
+
+        mlm_logits, nsp_logits = self.forward(input_ids, token_type_ids)
+        mlm_loss = M.mean(softmax_with_cross_entropy(
+            mlm_logits, MAN.reshape(mlm_labels,
+                                    list(mlm_labels.shape) + [1])))
+        if nsp_labels is None:
+            return mlm_loss
+        nsp_loss = M.mean(softmax_with_cross_entropy(
+            nsp_logits, MAN.reshape(nsp_labels, [-1, 1])))
+        return M.add(mlm_loss, nsp_loss)

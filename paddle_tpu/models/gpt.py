@@ -1,0 +1,190 @@
+"""GPT — the flagship decoder-only transformer.
+
+Capability parity: the reference trains GPT-2/ERNIE-class models via fleet
+sharding + pipeline (BASELINE.md config 5); its building blocks are
+nn/layer/transformer.py + meta_parallel TP layers.  This implementation is
+TPU-first: TP-aware layers carry PartitionSpecs over the ('data','model') mesh
+(consumed by parallel/hybrid.py's pjit step), attention lowers to one fused
+MXU dataflow (ops/attention.py) with an optional Pallas flash path, and
+sequence-parallel activation sharding is annotated with
+with_sharding_constraint.
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import Layer, LayerList, LayerNorm, Dropout, Embedding, Linear
+from ..nn import functional as F
+from ..nn.initializer import Normal, Constant
+from ..ops import manipulation as MAN
+from ..ops import math as M
+from ..ops.attention import scaled_dot_product_attention
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from ..parallel.sharding_annotations import shard_activation
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden=None, max_seq_len=1024,
+                 dropout=0.1, use_flash=False, remat=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden = ffn_hidden or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.use_flash = use_flash
+        self.remat = remat
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0, **kw)
+
+
+def gpt2_small(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt2_medium(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+class GPTAttention(Layer):
+    """Causal self-attention: column-parallel QKV, row-parallel output."""
+
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_heads
+        self.head_dim = h // config.num_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
+        self.dropout = config.dropout
+        self.use_flash = config.use_flash
+
+    def forward(self, x):
+        B, L, _ = x.shape
+        qkv = self.qkv(x)
+        # HEAD-MAJOR qkv layout: columns grouped per head as (q,k,v) triples,
+        # so a contiguous tensor-parallel column shard carries whole heads
+        # (head count below is -1 = local heads; head_dim is invariant)
+        qkv = MAN.reshape(qkv, [B, L, -1, 3, self.head_dim])
+        qkv = MAN.transpose(qkv, [3, 0, 2, 1, 4])  # [3, B, H_local, L, D]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out, _ = scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout if self.training else 0.0,
+            use_flash=self.use_flash,
+        )
+        out = MAN.transpose(out, [0, 2, 1, 3])
+        out = MAN.reshape(out, [B, L, -1])  # merges the LOCAL head shard
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.fc_in = ColumnParallelLinear(config.hidden_size,
+                                          config.ffn_hidden,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(config.ffn_hidden, config.hidden_size,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.ln1 = LayerNorm(config.hidden_size)
+        self.attn = GPTAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size)
+        self.mlp = GPTMLP(config)
+        self.drop = Dropout(config.dropout)
+
+    def forward(self, x):
+        x = M.add(x, self.drop(self.attn(self.ln1(x))))
+        x = M.add(x, self.drop(self.mlp(self.ln2(x))))
+        return shard_activation(x, P("data", None, None))
+
+
+class GPTModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size,
+                                          config.hidden_size)
+        self.wpe = Embedding(config.max_seq_len, config.hidden_size,
+                             weight_attr=None)
+        self.wpe.weight.dist_spec = P()
+        self.drop = Dropout(config.dropout)
+        self.blocks = LayerList([GPTBlock(config)
+                                 for _ in range(config.num_layers)])
+        for i, blk in enumerate(self.blocks):
+            for p in blk.parameters():
+                p.pipeline_stage_hint = i  # stage assignment input for pp
+        self.ln_f = LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        B, L = input_ids.shape
+        pos = MAN.cast(
+            MAN.reshape(
+                MAN.expand(
+                    MAN.reshape(_arange_t(L), [1, L]), [B, L]
+                ), [B, L]
+            ), "int32",
+        )
+        x = M.add(self.wte(input_ids), self.wpe(pos))
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+def _arange_t(n):
+    from ..ops.creation import arange
+
+    return arange(n, dtype="int32")
+
+
+class GPTForPretraining(Layer):
+    """LM head tied to the token embedding (weight sharing, the reference's
+    SharedLayerDesc embedding-tying pattern, pp_layers.py:62)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        # logits = h @ wte^T (tied weights); wte is vocab-sharded under TP so
+        # this is a column-parallel matmul — mark the TP-region entry so the
+        # backward sums the per-shard cotangents of h
+        from ..distributed.fleet.meta_parallel.mp_layers import (
+            copy_to_model_parallel,
+        )
+
+        logits = M.matmul(copy_to_model_parallel(h), self.gpt.wte.weight,
+                          transpose_y=True)
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self.forward(input_ids)
+        from ..distributed.fleet.meta_parallel.mp_layers import (
+            ParallelCrossEntropy,
+        )
+
+        # vocab-parallel CE under tensor parallelism (logits are sharded on
+        # the vocab dim inside the mesh program); plain fused CE otherwise
+        loss = ParallelCrossEntropy()(
+            logits, MAN.reshape(labels, list(labels.shape) + [1])
+        )
+        return M.mean(loss)

@@ -17,6 +17,9 @@ from ..core.tensor import Tensor, to_tensor, _wrap_data
 from ..core import random as _random
 
 
+_bn_trace_warned = False
+
+
 def _pair(x, n=2):
     if isinstance(x, (list, tuple)):
         return tuple(int(v) for v in x) * (1 if len(x) == n else n)
@@ -466,8 +469,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
         args = (x,) + tuple(t for t in (weight, bias) if t is not None)
         out, bmean, bvar = apply_op("batch_norm", fn, args, {}, n_outputs=3)
         m, v = bmean.detach()._data, bvar.detach()._data
-        running_mean._data = momentum * running_mean._data + (1 - momentum) * m
-        running_var._data = momentum * running_var._data + (1 - momentum) * v
+        if not isinstance(m, jax.core.Tracer) and not isinstance(
+            running_mean._data, jax.core.Tracer
+        ):
+            # eager: functional running-stat update
+            running_mean._data = (
+                momentum * running_mean._data + (1 - momentum) * m
+            )
+            running_var._data = momentum * running_var._data + (1 - momentum) * v
+        else:
+            # Under jit tracing a traced value must not escape to host state,
+            # so the running stats are NOT updated here.  Compiled BN training
+            # must thread stats explicitly (functional_call(buffers=...)) —
+            # warn once so eval-time wrong-stats bugs aren't silent.
+            global _bn_trace_warned
+            if not _bn_trace_warned:
+                _bn_trace_warned = True
+                import warnings
+
+                warnings.warn(
+                    "batch_norm running statistics are not updated inside "
+                    "jit-compiled training (trace-time). Thread stats via "
+                    "functional_call(buffers=...) or train BN models eagerly.",
+                    stacklevel=2,
+                )
         return out
 
     def fn(v, rm, rv, *wb):

@@ -14,7 +14,24 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+import inspect as _inspect
+
+_SM_PARAMS = set(_inspect.signature(_shard_map_raw).parameters)
+_SM_NOCHECK = (
+    {"check_rep": False} if "check_rep" in _SM_PARAMS
+    else {"check_vma": False} if "check_vma" in _SM_PARAMS else {}
+)
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+    kw.pop("check_rep", None)
+    return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **_SM_NOCHECK)
 
 from ..core.tensor import Tensor, _wrap_data
 from . import env as _env
@@ -110,17 +127,9 @@ def _over_mesh(fn, x, group):
     def body(v):
         return fn(v, axis)
 
-    try:
-        result = shard_map(
-            body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
-            check_rep=False,
-        )(x)
-    except TypeError:
-        result = shard_map(
-            body, mesh, in_specs=(in_spec,), out_specs=out_spec,
-            check_rep=False,
-        )(x)
-    return result
+    return shard_map(
+        body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+    )(x)
 
 
 _REDUCERS = {

@@ -1,0 +1,58 @@
+"""Activation sharding annotations (sequence/data parallel inside pjit).
+
+TPU-native building block with no reference analogue op: under pjit tracing,
+`with_sharding_constraint` pins an intermediate's layout so GSPMD places the
+collectives where the model author intends (e.g. sequence-parallel layernorm
+regions).  Outside a mesh context it is the identity.
+"""
+import jax
+from jax.sharding import PartitionSpec, NamedSharding
+
+from ..core.registry import apply_op
+from ..core.tensor import Tensor
+
+_active_mesh = []
+
+
+class mesh_context:
+    """Installs the mesh consulted by shard_activation during tracing."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _active_mesh.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _active_mesh.pop()
+        return False
+
+
+def current_mesh():
+    return _active_mesh[-1] if _active_mesh else None
+
+
+def shard_activation(x, spec):
+    """Annotate activation sharding (identity when no mesh is active)."""
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    names = set(mesh.axis_names)
+    clean = PartitionSpec(*(
+        axis if (axis is None or (isinstance(axis, str) and axis in names)
+                 or (isinstance(axis, tuple) and all(a in names for a in axis)))
+        else None
+        for axis in spec
+    ))
+
+    def fn(v):
+        if v.ndim < len([s for s in clean]):
+            return v
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(mesh, clean)
+        )
+
+    if isinstance(x, Tensor):
+        return apply_op("shard_activation", fn, (x,), {})
+    return fn(x)

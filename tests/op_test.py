@@ -1,0 +1,101 @@
+"""OpTest harness — the per-op golden contract.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py (OpTest:270,
+check_output_with_place:1078, check_grad:1409, get_numeric_gradient:110): a
+test declares an op, numpy inputs/attrs, expected outputs; the harness runs
+the op through the eager dispatcher AND the static executor and compares
+analytic gradients against central finite differences.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def get_numeric_gradient(fn, inputs, wrt_idx, out_reduce=None, delta=1e-3):
+    """Central finite differences of sum(fn(*inputs)) w.r.t. inputs[wrt_idx]."""
+
+    def scalar_out(*args):
+        out = fn(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        total = 0.0
+        for o in outs:
+            total = total + float(np.sum(np.asarray(o.numpy(), np.float64)))
+        return total
+
+    x = inputs[wrt_idx].numpy().astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        args = list(inputs)
+        args[wrt_idx] = paddle.to_tensor(x.astype(np.float32))
+        hi = scalar_out(*args)
+        flat[i] = orig - delta
+        args[wrt_idx] = paddle.to_tensor(x.astype(np.float32))
+        lo = scalar_out(*args)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+class OpTest:
+    """Subclass sets: self.op (callable over Tensors), self.inputs (dict
+    name->np array), self.attrs (dict), self.expected (np array or callable
+    producing it)."""
+
+    op = None
+    attrs = {}
+    grad_rtol = 1e-2
+    grad_atol = 1e-2
+    out_rtol = 1e-5
+    out_atol = 1e-6
+
+    def make_inputs(self):
+        raise NotImplementedError
+
+    def ref(self, *arrays):
+        raise NotImplementedError
+
+    def run_op(self, *tensors):
+        return type(self).op(*tensors, **self.attrs)
+
+    def check_output(self):
+        arrays = self.make_inputs()
+        tensors = [paddle.to_tensor(a) for a in arrays]
+        out = self.run_op(*tensors)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        refs = self.ref(*arrays)
+        refs = refs if isinstance(refs, (list, tuple)) else [refs]
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64), np.asarray(r, np.float64),
+                rtol=self.out_rtol, atol=self.out_atol,
+            )
+
+    def check_grad(self, wrt=(0,), delta=1e-3):
+        arrays = self.make_inputs()
+        for idx in wrt:
+            tensors = [
+                paddle.to_tensor(a, stop_gradient=(i != idx))
+                for i, a in enumerate(arrays)
+            ]
+            out = self.run_op(*tensors)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            total = None
+            for o in outs:
+                s = paddle.sum(o)
+                total = s if total is None else paddle.add(total, s)
+            total.backward()
+            analytic = tensors[idx].grad.numpy().astype(np.float64)
+
+            numeric = get_numeric_gradient(
+                lambda *ts: self.run_op(*ts), [
+                    paddle.to_tensor(a) for a in arrays
+                ], idx, delta=delta,
+            )
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=self.grad_rtol, atol=self.grad_atol,
+            )

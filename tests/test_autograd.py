@@ -1,0 +1,117 @@
+"""Autograd: accumulation, paddle.grad, double grad, PyLayer, hooks,
+recompute, no_grad (imperative/tests parity — basic_engine + partial_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_grad_accumulation_diamond():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    a = paddle.multiply(x, x)       # x^2
+    b = paddle.add(a, x)            # x^2 + x
+    c = paddle.add(a, b)            # 2x^2 + x
+    loss = paddle.sum(c)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4 * x.numpy() + 1, rtol=1e-6)
+
+
+def test_backward_accumulates_across_calls():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    for _ in range(2):
+        y = paddle.sum(paddle.multiply(x, x))
+        y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4 * np.ones(3), rtol=1e-6)
+
+
+def test_paddle_grad_basic():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    y = paddle.multiply(x, x)
+    (gx,) = paddle.grad(paddle.sum(y), x)
+    np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-6)
+    assert x.grad is None  # grad() must not write .grad
+
+
+def test_double_grad():
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = paddle.multiply(paddle.multiply(x, x), x)  # x^3
+    (g1,) = paddle.grad(y, x, create_graph=True)   # 3x^2
+    np.testing.assert_allclose(g1.numpy(), [27.0], rtol=1e-5)
+    (g2,) = paddle.grad(g1, x)                     # 6x
+    np.testing.assert_allclose(g2.numpy(), [18.0], rtol=1e-5)
+
+
+def test_pylayer_custom_backward():
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.exp(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return paddle.multiply(dy, y)
+
+    x = paddle.to_tensor(np.array([0.5, 1.0], np.float32),
+                         stop_gradient=False)
+    y = Exp.apply(x)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.exp(x.numpy()), rtol=1e-6)
+
+
+def test_register_hook_scales_grad():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = paddle.multiply(x, paddle.to_tensor(np.array([2.0, 2.0], np.float32)))
+    y.register_hook(lambda g: paddle.scale(g, 10.0))
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0], rtol=1e-6)
+
+
+def test_no_grad_blocks_tape():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = paddle.multiply(x, x)
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = paddle.multiply(x, x).detach()
+    z = paddle.multiply(y, y)
+    assert z.stop_gradient
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    paddle.seed(7)
+    w = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+
+    def block(inp):
+        return paddle.tanh(paddle.matmul(inp, w))
+
+    # plain
+    loss = paddle.sum(block(x))
+    loss.backward()
+    g_plain = w.grad.numpy().copy()
+    w.clear_grad()
+
+    # recomputed
+    out = recompute(block, x)
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(w.grad.numpy(), g_plain, rtol=1e-6)
+
+
+def test_stop_gradient_pruning():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    frozen = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=True)
+    y = paddle.add(paddle.multiply(x, x), paddle.multiply(frozen, frozen))
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0], rtol=1e-6)
+    assert frozen.grad is None

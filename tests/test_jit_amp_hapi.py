@@ -1,0 +1,155 @@
+"""jit.to_static, AMP autocast/GradScaler, hapi Model.fit, DataLoader."""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_to_static_matches_eager_and_backprops():
+    paddle.seed(0)
+    net = TinyNet()
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    eager = net(x).numpy()
+
+    snet = paddle.jit.to_static(TinyNet())
+    snet.set_state_dict(net.state_dict())
+    out = snet(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5)
+
+    # gradients flow through the compiled segment
+    loss = paddle.mean(out)
+    loss.backward()
+    g = snet.fc1.weight.grad
+    assert g is not None and np.abs(g.numpy()).sum() > 0
+
+
+def test_to_static_compile_cache():
+    net = paddle.jit.to_static(TinyNet())
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    net(x)
+    sf = net.forward
+    assert len(sf._cache) == 1
+    net(x)
+    assert len(sf._cache) == 1  # same signature, cached
+    net(paddle.to_tensor(np.random.rand(5, 4).astype(np.float32)))
+    assert len(sf._cache) == 2  # new shape, new entry
+
+
+def test_amp_autocast_bf16_matmul():
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+        a = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        c = paddle.matmul(a, b)
+        assert c.dtype == jnp.bfloat16
+        # blacklisted op stays fp32
+        s = paddle.mean(a)
+        assert s.dtype == jnp.float32
+    # outside autocast
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == jnp.float32
+
+
+def test_amp_grad_flows_to_fp32_master():
+    net = TinyNet()
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    with paddle.amp.auto_cast(enable=True):
+        loss = paddle.mean(net(x))
+    loss.backward()
+    assert net.fc1.weight.grad is not None
+    assert net.fc1.weight.dtype == jnp.float32
+
+
+def test_grad_scaler_skips_on_inf():
+    net = TinyNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    w_before = net.fc1.weight.numpy().copy()
+    # poison a grad with inf
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    loss = paddle.mean(net(x))
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    from paddle_tpu.core.tensor import _wrap_data
+
+    net.fc1.weight.grad = _wrap_data(
+        jnp.full_like(net.fc1.weight.grad._data, jnp.inf))
+    scaler.step(opt)
+    np.testing.assert_allclose(net.fc1.weight.numpy(), w_before)
+    assert scaler._scale < 2.0  # dynamic scale decreased
+
+
+def test_hapi_model_fit(tmp_path):
+    from paddle_tpu.io import TensorDataset
+
+    paddle.seed(0)
+    X = np.random.rand(64, 4).astype(np.float32)
+    W = np.random.rand(4, 2).astype(np.float32)
+    Y = np.argmax(X @ W, axis=1).astype(np.int64)[:, None]
+    ds = TensorDataset([X, Y])
+
+    model = paddle.Model(TinyNet())
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=model.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy(),
+    )
+    model.fit(ds, epochs=3, batch_size=16, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.6
+    model.save(str(tmp_path / "ckpt"))
+    assert os.path.exists(str(tmp_path / "ckpt") + ".pdparams")
+
+    m2 = paddle.Model(TinyNet())
+    m2.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.05, parameters=m2.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=paddle.metric.Accuracy())
+    m2.load(str(tmp_path / "ckpt"))
+    logs2 = m2.evaluate(ds, batch_size=16, verbose=0)
+    assert abs(logs2["acc"] - logs["acc"]) < 1e-6
+
+
+def test_dataloader_multiprocess_order_and_content():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Squares(Dataset):
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            return np.array([i * i], np.float32)
+
+    loader = DataLoader(Squares(), batch_size=4, num_workers=2, shuffle=False)
+    batches = [b.numpy() for b in loader]
+    got = np.concatenate(batches).reshape(-1)
+    np.testing.assert_allclose(got, np.arange(20.0) ** 2)
+
+
+def test_lr_scheduler_with_optimizer():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    p = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    p.persistable = True
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for _ in range(4):
+        lrs.append(opt.get_lr())
+        sched.step()
+    assert lrs == [0.1, 0.1, 0.05, 0.05]
