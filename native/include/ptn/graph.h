@@ -1,0 +1,58 @@
+// Native graph IR: ProgramDesc / BlockDesc / OpDesc / VarDesc equivalents.
+//
+// Reference parity (structure, not translation): paddle/fluid/framework/
+// framework.proto:43-207 (OpDesc/VarDesc/BlockDesc/ProgramDesc) and
+// program_desc.h:31.  TPU-native design: the native IR carries *topology only*
+// (ops, var def/use edges, persistability) — kernels, dtypes and shapes live in
+// the XLA computation the Python layer lowers a block into.  The native side
+// owns what a compiler-adjacent runtime should own: dependency analysis,
+// pruning, scheduling, liveness and buffer-reuse planning (scheduler.h).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace ptn {
+
+using VarId = int32_t;
+using OpId = int32_t;
+
+struct VarDesc {
+  std::string name;
+  bool persistable = false;  // parameters / fetch targets: never freed/reused
+  VarId id = -1;
+};
+
+struct OpDesc {
+  std::string type;
+  std::vector<VarId> inputs;
+  std::vector<VarId> outputs;
+  OpId id = -1;
+  // Ops with side effects (collectives, save/load, prints) survive pruning
+  // even when no fetch depends on them.
+  bool has_side_effect = false;
+};
+
+struct BlockDesc {
+  int32_t idx = 0;
+  int32_t parent_idx = -1;
+  std::vector<VarDesc> vars;
+  std::vector<OpDesc> ops;
+  std::unordered_map<std::string, VarId> var_index;
+
+  VarId AddVar(const std::string& name, bool persistable);
+  OpId AddOp(const std::string& type, const std::vector<VarId>& inputs,
+             const std::vector<VarId>& outputs, bool side_effect);
+  VarId FindVar(const std::string& name) const;  // -1 if absent
+};
+
+struct ProgramDesc {
+  std::vector<BlockDesc> blocks;
+  ProgramDesc() { blocks.emplace_back(); }
+  BlockDesc& block(int32_t i) { return blocks.at(static_cast<size_t>(i)); }
+  int32_t AddBlock(int32_t parent);
+};
+
+}  // namespace ptn

@@ -1,0 +1,44 @@
+// Execution planner: pruning, topological scheduling, liveness analysis and
+// buffer-slot reuse over a BlockDesc.
+//
+// Reference parity (role, not translation): framework/executor_gc_helper.*
+// (eager deletion: free each var after its last reader),
+// ir/memory_optimize_pass/ (reference_count_pass, buffer_shared_inplace) and
+// the dep-counted scheduling of details/fast_threaded_ssa_graph_executor.h:32.
+// TPU-native: XLA owns on-device memory *within* a compiled block, so the plan
+// feeds (a) lowering order, (b) which feed buffers are safe to donate to the
+// computation (donation = XLA's input-output aliasing, the inplace-pass
+// analogue), and (c) host-side staging-buffer reuse slots.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "ptn/graph.h"
+
+namespace ptn {
+
+struct ExecutionPlan {
+  // Ops that remain after backward-slicing from the fetch set, in a
+  // deterministic dependency-respecting order.
+  std::vector<OpId> order;
+  // dead_after[i] = vars whose last use is order[i] (eager-deletion plan).
+  std::vector<std::vector<VarId>> dead_after;
+  // slot_of[var] = reuse slot (-1 for persistable / unused vars). Vars with
+  // disjoint live intervals share slots (greedy interval allocation).
+  std::vector<int32_t> slot_of;
+  int32_t num_slots = 0;
+  // feeds whose buffer is consumed before any other reader → donatable.
+  std::vector<VarId> donatable_feeds;
+  // waves[i] = number of ops in the i-th dependency level (all mutually
+  // independent); exposes the parallelism profile of the block.
+  std::vector<int32_t> wave_sizes;
+  bool has_cycle = false;
+};
+
+// Builds the plan for `block`. `fetch` vars (plus side-effect ops) root the
+// pruning; `feed` vars are treated as externally produced.
+ExecutionPlan BuildPlan(const BlockDesc& block, const std::vector<VarId>& feeds,
+                        const std::vector<VarId>& fetches);
+
+}  // namespace ptn

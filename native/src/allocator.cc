@@ -1,0 +1,135 @@
+// Host staging allocator: chunked best-fit with free-block coalescing.
+//
+// Reference parity (role): memory/allocation/auto_growth_best_fit_allocator.cc
+// — the strategy-selectable host-memory arena behind memory::Alloc.  On TPU
+// the device HBM is owned by PJRT/XLA, so the native allocator's job is the
+// *host* side: pinned-style staging buffers for the dataloader prefetch path
+// and any native scratch memory, with O(log n) best-fit and coalescing so
+// steady-state training does zero mallocs.
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace ptn {
+
+class HostAllocator {
+ public:
+  explicit HostAllocator(uint64_t chunk_size) : chunk_size_(chunk_size) {}
+
+  ~HostAllocator() {
+    for (void* c : chunks_) std::free(c);
+  }
+
+  void* Alloc(uint64_t size) {
+    if (size == 0) size = kAlign;
+    size = (size + kAlign - 1) / kAlign * kAlign;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_by_size_.lower_bound({size, nullptr});
+    if (it == free_by_size_.end()) {
+      Grow(size);
+      it = free_by_size_.lower_bound({size, nullptr});
+      if (it == free_by_size_.end()) return nullptr;
+    }
+    char* base = it->first.second;
+    uint64_t block = it->first.first;
+    free_by_size_.erase(it);
+    free_by_addr_.erase(base);
+    if (block > size + kAlign) {  // split remainder back to free list
+      char* rest = base + size;
+      InsertFree(rest, block - size);
+      block = size;
+    }
+    allocated_[base] = block;
+    in_use_ += block;
+    peak_ = in_use_ > peak_ ? in_use_ : peak_;
+    ++alloc_count_;
+    return base;
+  }
+
+  void Free(void* p) {
+    if (p == nullptr) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = allocated_.find(static_cast<char*>(p));
+    if (it == allocated_.end()) return;
+    char* base = it->first;
+    uint64_t size = it->second;
+    allocated_.erase(it);
+    in_use_ -= size;
+    // coalesce with right neighbor
+    auto right = free_by_addr_.find(base + size);
+    if (right != free_by_addr_.end()) {
+      size += right->second;
+      free_by_size_.erase({right->second, right->first});
+      free_by_addr_.erase(right);
+    }
+    // coalesce with left neighbor
+    auto left = free_by_addr_.lower_bound(base);
+    if (left != free_by_addr_.begin()) {
+      --left;
+      if (left->first + left->second == base) {
+        base = left->first;
+        size += left->second;
+        free_by_size_.erase({left->second, left->first});
+        free_by_addr_.erase(left);
+      }
+    }
+    InsertFree(base, size);
+  }
+
+  void Stats(uint64_t out[5]) const {
+    std::lock_guard<std::mutex> g(mu_);
+    out[0] = in_use_;
+    out[1] = reserved_;
+    out[2] = peak_;
+    out[3] = alloc_count_;
+    out[4] = static_cast<uint64_t>(chunks_.size());
+  }
+
+ private:
+  static constexpr uint64_t kAlign = 64;  // cacheline
+
+  void Grow(uint64_t at_least) {
+    uint64_t sz = at_least > chunk_size_ ? at_least : chunk_size_;
+    sz = (sz + kAlign - 1) / kAlign * kAlign;
+    void* c = std::aligned_alloc(kAlign, sz);
+    if (c == nullptr) return;
+    chunks_.push_back(c);
+    reserved_ += sz;
+    InsertFree(static_cast<char*>(c), sz);
+  }
+
+  void InsertFree(char* base, uint64_t size) {
+    free_by_size_.insert({{size, base}, 0});
+    free_by_addr_[base] = size;
+  }
+
+  uint64_t chunk_size_;
+  mutable std::mutex mu_;
+  std::vector<void*> chunks_;
+  std::map<std::pair<uint64_t, char*>, char> free_by_size_;
+  std::map<char*, uint64_t> free_by_addr_;
+  std::unordered_map<char*, uint64_t> allocated_;
+  uint64_t in_use_ = 0, reserved_ = 0, peak_ = 0, alloc_count_ = 0;
+};
+
+}  // namespace ptn
+
+extern "C" {
+void* ptn_alloc_create(uint64_t chunk_size) {
+  return new (std::nothrow) ptn::HostAllocator(chunk_size ? chunk_size : (64ull << 20));
+}
+void* ptn_alloc_malloc(void* a, uint64_t size) {
+  return static_cast<ptn::HostAllocator*>(a)->Alloc(size);
+}
+void ptn_alloc_free(void* a, void* p) {
+  static_cast<ptn::HostAllocator*>(a)->Free(p);
+}
+void ptn_alloc_stats(void* a, uint64_t out[5]) {
+  static_cast<ptn::HostAllocator*>(a)->Stats(out);
+}
+void ptn_alloc_destroy(void* a) { delete static_cast<ptn::HostAllocator*>(a); }
+}

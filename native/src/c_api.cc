@@ -1,0 +1,112 @@
+// C ABI over the native graph IR + planner, consumed from Python via ctypes
+// (the pybind/op_function_generator role of the reference is not needed: the
+// TPU build's per-op fast path is jax itself; what crosses the boundary here
+// is whole-graph topology, once per program, not per-op calls).
+#include <cstdint>
+#include <cstring>
+#include <new>
+#include <string>
+
+#include "ptn/graph.h"
+#include "ptn/scheduler.h"
+
+using ptn::BlockDesc;
+using ptn::ExecutionPlan;
+using ptn::ProgramDesc;
+
+extern "C" {
+
+// ---------- program building ----------
+void* ptn_program_new() { return new (std::nothrow) ProgramDesc(); }
+void ptn_program_free(void* p) { delete static_cast<ProgramDesc*>(p); }
+
+int32_t ptn_program_add_block(void* p, int32_t parent) {
+  return static_cast<ProgramDesc*>(p)->AddBlock(parent);
+}
+
+int32_t ptn_block_add_var(void* p, int32_t block, const char* name,
+                          int32_t persistable) {
+  return static_cast<ProgramDesc*>(p)->block(block).AddVar(name,
+                                                           persistable != 0);
+}
+
+int32_t ptn_block_find_var(void* p, int32_t block, const char* name) {
+  return static_cast<ProgramDesc*>(p)->block(block).FindVar(name);
+}
+
+int32_t ptn_block_add_op(void* p, int32_t block, const char* type,
+                         const int32_t* inputs, int32_t n_in,
+                         const int32_t* outputs, int32_t n_out,
+                         int32_t side_effect) {
+  std::vector<int32_t> in(inputs, inputs + n_in);
+  std::vector<int32_t> out(outputs, outputs + n_out);
+  return static_cast<ProgramDesc*>(p)->block(block).AddOp(type, in, out,
+                                                          side_effect != 0);
+}
+
+int32_t ptn_block_num_ops(void* p, int32_t block) {
+  return static_cast<int32_t>(
+      static_cast<ProgramDesc*>(p)->block(block).ops.size());
+}
+
+int32_t ptn_block_num_vars(void* p, int32_t block) {
+  return static_cast<int32_t>(
+      static_cast<ProgramDesc*>(p)->block(block).vars.size());
+}
+
+// ---------- planning ----------
+void* ptn_plan_build(void* p, int32_t block, const int32_t* feeds,
+                     int32_t n_feeds, const int32_t* fetches,
+                     int32_t n_fetches) {
+  std::vector<int32_t> fd(feeds, feeds + n_feeds);
+  std::vector<int32_t> ft(fetches, fetches + n_fetches);
+  auto* plan = new (std::nothrow) ExecutionPlan(
+      ptn::BuildPlan(static_cast<ProgramDesc*>(p)->block(block), fd, ft));
+  return plan;
+}
+void ptn_plan_free(void* pl) { delete static_cast<ExecutionPlan*>(pl); }
+
+int32_t ptn_plan_num_ops(void* pl) {
+  return static_cast<int32_t>(static_cast<ExecutionPlan*>(pl)->order.size());
+}
+int32_t ptn_plan_op_at(void* pl, int32_t i) {
+  return static_cast<ExecutionPlan*>(pl)->order[static_cast<size_t>(i)];
+}
+int32_t ptn_plan_has_cycle(void* pl) {
+  return static_cast<ExecutionPlan*>(pl)->has_cycle ? 1 : 0;
+}
+int32_t ptn_plan_num_slots(void* pl) {
+  return static_cast<ExecutionPlan*>(pl)->num_slots;
+}
+int32_t ptn_plan_slot_of(void* pl, int32_t var) {
+  auto* plan = static_cast<ExecutionPlan*>(pl);
+  if (var < 0 || static_cast<size_t>(var) >= plan->slot_of.size()) return -1;
+  return plan->slot_of[static_cast<size_t>(var)];
+}
+// writes up to cap var ids dying after step i; returns count
+int32_t ptn_plan_dead_after(void* pl, int32_t i, int32_t* out, int32_t cap) {
+  auto* plan = static_cast<ExecutionPlan*>(pl);
+  const auto& dead = plan->dead_after[static_cast<size_t>(i)];
+  int32_t n = static_cast<int32_t>(dead.size());
+  int32_t w = n < cap ? n : cap;
+  std::memcpy(out, dead.data(), static_cast<size_t>(w) * sizeof(int32_t));
+  return n;
+}
+int32_t ptn_plan_num_waves(void* pl) {
+  return static_cast<int32_t>(
+      static_cast<ExecutionPlan*>(pl)->wave_sizes.size());
+}
+int32_t ptn_plan_wave_size(void* pl, int32_t i) {
+  return static_cast<ExecutionPlan*>(pl)->wave_sizes[static_cast<size_t>(i)];
+}
+int32_t ptn_plan_donatable(void* pl, int32_t* out, int32_t cap) {
+  auto* plan = static_cast<ExecutionPlan*>(pl);
+  int32_t n = static_cast<int32_t>(plan->donatable_feeds.size());
+  int32_t w = n < cap ? n : cap;
+  std::memcpy(out, plan->donatable_feeds.data(),
+              static_cast<size_t>(w) * sizeof(int32_t));
+  return n;
+}
+
+const char* ptn_version() { return "ptn-0.1"; }
+}

@@ -1,0 +1,45 @@
+#include "ptn/graph.h"
+
+namespace ptn {
+
+VarId BlockDesc::AddVar(const std::string& name, bool persistable) {
+  auto it = var_index.find(name);
+  if (it != var_index.end()) {
+    if (persistable) vars[static_cast<size_t>(it->second)].persistable = true;
+    return it->second;
+  }
+  VarDesc v;
+  v.name = name;
+  v.persistable = persistable;
+  v.id = static_cast<VarId>(vars.size());
+  var_index.emplace(name, v.id);
+  vars.push_back(std::move(v));
+  return static_cast<VarId>(vars.size()) - 1;
+}
+
+OpId BlockDesc::AddOp(const std::string& type, const std::vector<VarId>& inputs,
+                      const std::vector<VarId>& outputs, bool side_effect) {
+  OpDesc op;
+  op.type = type;
+  op.inputs = inputs;
+  op.outputs = outputs;
+  op.has_side_effect = side_effect;
+  op.id = static_cast<OpId>(ops.size());
+  ops.push_back(std::move(op));
+  return static_cast<OpId>(ops.size()) - 1;
+}
+
+VarId BlockDesc::FindVar(const std::string& name) const {
+  auto it = var_index.find(name);
+  return it == var_index.end() ? -1 : it->second;
+}
+
+int32_t ProgramDesc::AddBlock(int32_t parent) {
+  BlockDesc b;
+  b.idx = static_cast<int32_t>(blocks.size());
+  b.parent_idx = parent;
+  blocks.push_back(std::move(b));
+  return static_cast<int32_t>(blocks.size()) - 1;
+}
+
+}  // namespace ptn

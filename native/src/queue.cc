@@ -1,0 +1,125 @@
+// Bounded blocking MPMC byte-batch queue for dataloader prefetch.
+//
+// Reference parity (role): operators/reader/buffered_reader.h:36 (double-
+// buffered H2D prefetch) + the LoDTensorBlockingQueue behind pybind/
+// reader_py.cc that multiprocess DataLoader workers feed.  TPU-native: worker
+// threads/processes push serialized batches; the trainer thread pops the next
+// batch while the previous one is on device — Python callers release the GIL
+// during the blocking ctypes call, so producers and the consumer overlap.
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+
+namespace ptn {
+
+class ByteQueue {
+ public:
+  explicit ByteQueue(uint32_t capacity) : cap_(capacity ? capacity : 2) {}
+
+  ~ByteQueue() {
+    for (auto& b : q_) std::free(b.data);
+  }
+
+  // Copies `size` bytes in. Blocks while full. Returns 0 ok, -1 closed,
+  // -2 timeout, -3 oom.
+  int Push(const void* data, uint64_t size, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!Wait(lk, timeout_ms, [&] { return closed_ || q_.size() < cap_; }))
+      return -2;
+    if (closed_) return -1;
+    void* buf = std::malloc(size ? size : 1);
+    if (buf == nullptr) return -3;
+    std::memcpy(buf, data, size);
+    q_.push_back({buf, size});
+    bytes_in_ += size;
+    lk.unlock();
+    cv_.notify_all();
+    return 0;
+  }
+
+  // Returns malloc-owned buffer (caller frees via ptn_bytes_free) or nullptr
+  // when timed out (*size==0) or closed-and-drained (*size==UINT64_MAX).
+  void* Pop(uint64_t* size, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!Wait(lk, timeout_ms, [&] { return closed_ || !q_.empty(); })) {
+      *size = 0;
+      return nullptr;
+    }
+    if (q_.empty()) {  // closed and drained
+      *size = UINT64_MAX;
+      return nullptr;
+    }
+    Item it = q_.front();
+    q_.pop_front();
+    lk.unlock();
+    cv_.notify_all();
+    *size = it.size;
+    return it.data;
+  }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      closed_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  uint64_t Size() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return q_.size();
+  }
+
+  uint64_t BytesIn() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return bytes_in_;
+  }
+
+ private:
+  struct Item {
+    void* data;
+    uint64_t size;
+  };
+
+  template <class Pred>
+  bool Wait(std::unique_lock<std::mutex>& lk, int64_t timeout_ms, Pred p) {
+    if (timeout_ms < 0) {
+      while (!p()) cv_.wait(lk);
+      return true;
+    }
+    return cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), p);
+  }
+
+  uint32_t cap_;
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Item> q_;
+  bool closed_ = false;
+  uint64_t bytes_in_ = 0;
+};
+
+}  // namespace ptn
+
+extern "C" {
+void* ptn_queue_create(uint32_t capacity) {
+  return new (std::nothrow) ptn::ByteQueue(capacity);
+}
+int ptn_queue_push(void* q, const void* data, uint64_t size, int64_t timeout_ms) {
+  return static_cast<ptn::ByteQueue*>(q)->Push(data, size, timeout_ms);
+}
+void* ptn_queue_pop(void* q, uint64_t* size, int64_t timeout_ms) {
+  return static_cast<ptn::ByteQueue*>(q)->Pop(size, timeout_ms);
+}
+void ptn_queue_close(void* q) { static_cast<ptn::ByteQueue*>(q)->Close(); }
+uint64_t ptn_queue_size(void* q) { return static_cast<ptn::ByteQueue*>(q)->Size(); }
+uint64_t ptn_queue_bytes(void* q) {
+  return static_cast<ptn::ByteQueue*>(q)->BytesIn();
+}
+void ptn_queue_destroy(void* q) { delete static_cast<ptn::ByteQueue*>(q); }
+void ptn_bytes_free(void* p) { std::free(p); }
+}

@@ -1,0 +1,208 @@
+#include "ptn/scheduler.h"
+
+#include <algorithm>
+#include <queue>
+#include <unordered_set>
+
+namespace ptn {
+namespace {
+
+// Dependency edges honoring RAW, WAR and WAW hazards over named vars — the
+// same hazard model the reference's SSA-graph builder applies when it converts
+// a program into op handles (multi_devices_graph_pass), built here by a single
+// program-order scan.
+void BuildEdges(const BlockDesc& block, std::vector<std::vector<OpId>>* deps,
+                std::vector<OpId>* final_writer) {
+  const size_t n_ops = block.ops.size();
+  const size_t n_vars = block.vars.size();
+  deps->assign(n_ops, {});
+  final_writer->assign(n_vars, -1);
+  std::vector<OpId> last_writer(n_vars, -1);
+  std::vector<std::vector<OpId>> readers(n_vars);
+
+  for (size_t j = 0; j < n_ops; ++j) {
+    const OpDesc& op = block.ops[j];
+    auto& dj = (*deps)[j];
+    for (VarId v : op.inputs) {
+      if (last_writer[static_cast<size_t>(v)] >= 0)
+        dj.push_back(last_writer[static_cast<size_t>(v)]);  // RAW
+    }
+    for (VarId v : op.outputs) {
+      size_t vi = static_cast<size_t>(v);
+      if (last_writer[vi] >= 0) dj.push_back(last_writer[vi]);  // WAW
+      for (OpId r : readers[vi])
+        if (r != static_cast<OpId>(j)) dj.push_back(r);  // WAR
+    }
+    std::sort(dj.begin(), dj.end());
+    dj.erase(std::unique(dj.begin(), dj.end()), dj.end());
+
+    for (VarId v : op.inputs) readers[static_cast<size_t>(v)].push_back(j);
+    for (VarId v : op.outputs) {
+      size_t vi = static_cast<size_t>(v);
+      last_writer[vi] = static_cast<OpId>(j);
+      readers[vi].clear();
+    }
+  }
+  *final_writer = last_writer;
+}
+
+}  // namespace
+
+ExecutionPlan BuildPlan(const BlockDesc& block, const std::vector<VarId>& feeds,
+                        const std::vector<VarId>& fetches) {
+  ExecutionPlan plan;
+  const size_t n_ops = block.ops.size();
+  const size_t n_vars = block.vars.size();
+
+  std::vector<std::vector<OpId>> deps;
+  std::vector<OpId> final_writer;
+  BuildEdges(block, &deps, &final_writer);
+
+  // ---- prune: backward slice from fetch writers + side-effect ops ----
+  // (role of framework/prune.cc — unreached ops never lower into the XLA
+  // computation)
+  std::vector<char> keep(n_ops, 0);
+  std::vector<OpId> stack;
+  for (VarId f : fetches) {
+    OpId w = (f >= 0 && static_cast<size_t>(f) < n_vars)
+                 ? final_writer[static_cast<size_t>(f)]
+                 : -1;
+    if (w >= 0 && !keep[static_cast<size_t>(w)]) {
+      keep[static_cast<size_t>(w)] = 1;
+      stack.push_back(w);
+    }
+  }
+  for (size_t j = 0; j < n_ops; ++j) {
+    if (block.ops[j].has_side_effect && !keep[j]) {
+      keep[j] = 1;
+      stack.push_back(static_cast<OpId>(j));
+    }
+  }
+  while (!stack.empty()) {
+    OpId j = stack.back();
+    stack.pop_back();
+    for (OpId d : deps[static_cast<size_t>(j)]) {
+      if (!keep[static_cast<size_t>(d)]) {
+        keep[static_cast<size_t>(d)] = 1;
+        stack.push_back(d);
+      }
+    }
+  }
+
+  size_t n_keep = 0;
+  for (char k : keep) n_keep += static_cast<size_t>(k);
+
+  // ---- Kahn topo over kept ops, level-set waves, op-id tie-break ----
+  std::vector<int32_t> indeg(n_ops, 0);
+  std::vector<std::vector<OpId>> succ(n_ops);
+  for (size_t j = 0; j < n_ops; ++j) {
+    if (!keep[j]) continue;
+    for (OpId d : deps[j]) {
+      if (keep[static_cast<size_t>(d)]) {
+        indeg[j]++;
+        succ[static_cast<size_t>(d)].push_back(static_cast<OpId>(j));
+      }
+    }
+  }
+  std::vector<OpId> frontier;
+  for (size_t j = 0; j < n_ops; ++j)
+    if (keep[j] && indeg[j] == 0) frontier.push_back(static_cast<OpId>(j));
+
+  plan.order.reserve(n_keep);
+  while (!frontier.empty()) {
+    std::sort(frontier.begin(), frontier.end());
+    plan.wave_sizes.push_back(static_cast<int32_t>(frontier.size()));
+    std::vector<OpId> next;
+    for (OpId j : frontier) {
+      plan.order.push_back(j);
+      for (OpId s : succ[static_cast<size_t>(j)])
+        if (--indeg[static_cast<size_t>(s)] == 0) next.push_back(s);
+    }
+    frontier.swap(next);
+  }
+  if (plan.order.size() != n_keep) {
+    plan.has_cycle = true;  // fall back to program order of kept ops
+    plan.order.clear();
+    plan.wave_sizes.clear();
+    for (size_t j = 0; j < n_ops; ++j)
+      if (keep[j]) plan.order.push_back(static_cast<OpId>(j));
+  }
+
+  // ---- liveness: last use position per var → eager-deletion plan ----
+  std::vector<int32_t> pos_of(n_ops, -1);
+  for (size_t p = 0; p < plan.order.size(); ++p)
+    pos_of[static_cast<size_t>(plan.order[p])] = static_cast<int32_t>(p);
+
+  std::vector<int32_t> birth(n_vars, -2), death(n_vars, -2);
+  std::unordered_set<VarId> feed_set(feeds.begin(), feeds.end());
+  std::unordered_set<VarId> fetch_set(fetches.begin(), fetches.end());
+  for (VarId f : feed_set)
+    if (f >= 0 && static_cast<size_t>(f) < n_vars)
+      birth[static_cast<size_t>(f)] = -1;
+
+  for (size_t p = 0; p < plan.order.size(); ++p) {
+    const OpDesc& op = block.ops[static_cast<size_t>(plan.order[p])];
+    for (VarId v : op.outputs) {
+      size_t vi = static_cast<size_t>(v);
+      if (birth[vi] == -2) birth[vi] = static_cast<int32_t>(p);
+      death[vi] = static_cast<int32_t>(p);
+    }
+    for (VarId v : op.inputs) death[static_cast<size_t>(v)] = static_cast<int32_t>(p);
+  }
+
+  plan.dead_after.assign(plan.order.size(), {});
+  for (size_t v = 0; v < n_vars; ++v) {
+    const VarDesc& vd = block.vars[v];
+    if (vd.persistable || fetch_set.count(static_cast<VarId>(v))) continue;
+    if (death[v] >= 0 && birth[v] != -2)
+      plan.dead_after[static_cast<size_t>(death[v])].push_back(
+          static_cast<VarId>(v));
+  }
+
+  // ---- greedy interval slot allocation (buffer_shared_inplace role) ----
+  plan.slot_of.assign(n_vars, -1);
+  struct Interval {
+    VarId v;
+    int32_t b, d;
+  };
+  std::vector<Interval> ivs;
+  for (size_t v = 0; v < n_vars; ++v) {
+    const VarDesc& vd = block.vars[v];
+    if (vd.persistable || birth[v] == -2 || death[v] < 0) continue;
+    int32_t d = fetch_set.count(static_cast<VarId>(v))
+                    ? static_cast<int32_t>(plan.order.size())  // lives past end
+                    : death[v];
+    ivs.push_back({static_cast<VarId>(v), birth[v], d});
+  }
+  std::sort(ivs.begin(), ivs.end(), [](const Interval& a, const Interval& b) {
+    return a.b != b.b ? a.b < b.b : a.v < b.v;
+  });
+  // min-heap of (free_at, slot)
+  std::priority_queue<std::pair<int32_t, int32_t>,
+                      std::vector<std::pair<int32_t, int32_t>>,
+                      std::greater<std::pair<int32_t, int32_t>>>
+      free_heap;
+  int32_t next_slot = 0;
+  for (const Interval& iv : ivs) {
+    int32_t slot;
+    if (!free_heap.empty() && free_heap.top().first <= iv.b) {
+      slot = free_heap.top().second;
+      free_heap.pop();
+    } else {
+      slot = next_slot++;
+    }
+    plan.slot_of[static_cast<size_t>(iv.v)] = slot;
+    free_heap.push({iv.d + 1, slot});
+  }
+  plan.num_slots = next_slot;
+
+  // ---- donation: feed buffers XLA may alias to outputs ----
+  for (VarId f : feeds) {
+    if (f < 0 || static_cast<size_t>(f) >= n_vars) continue;
+    const VarDesc& vd = block.vars[static_cast<size_t>(f)];
+    if (!vd.persistable && !fetch_set.count(f)) plan.donatable_feeds.push_back(f);
+  }
+  return plan;
+}
+
+}  // namespace ptn

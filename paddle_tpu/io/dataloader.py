@@ -75,6 +75,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
+        self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -125,7 +126,75 @@ class DataLoader:
             yield self._to_tensors(collate_np(samples, self.collate_fn))
 
     def _iter_single(self):
-        # background thread prefetch (BufferedReader parity)
+        # background prefetch (BufferedReader parity). With the native runtime
+        # available, batches flow through the C++ bounded byte-queue
+        # (native/src/queue.cc) — blocking push/pop release the GIL, so the
+        # producer thread collates the next batch while the consumer's batch
+        # is being transferred/consumed on device.
+        if self.use_buffer_reader:
+            PrefetchQueue = None
+            try:
+                from ..native import PrefetchQueue, available
+
+                if not available():
+                    PrefetchQueue = None
+            except Exception:
+                PrefetchQueue = None
+            if PrefetchQueue is not None:
+                yield from self._iter_single_native(PrefetchQueue)
+                return
+        yield from self._iter_single_py()
+
+    def _iter_single_native(self, PrefetchQueue):
+        import pickle
+
+        q = PrefetchQueue(capacity=max(2, self.prefetch_factor))
+
+        def producer():
+            try:
+                for indices in self.batch_sampler:
+                    samples = [self.dataset[i] for i in indices]
+                    payload = pickle.dumps(
+                        (None, collate_np(samples, self.collate_fn)),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+                    if not q.push(payload):
+                        return  # consumer gone
+            except Exception as e:
+                try:
+                    payload = pickle.dumps((e, None),
+                                           protocol=pickle.HIGHEST_PROTOCOL)
+                except Exception:  # non-picklable exception: keep the message
+                    payload = pickle.dumps(
+                        (RuntimeError(f"DataLoader worker failed: {e!r}"), None),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+                try:
+                    q.push(payload)
+                except Exception:
+                    pass
+            finally:
+                q.shutdown()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                try:
+                    payload = q.pop()
+                except EOFError:
+                    return
+                if payload is None:
+                    continue
+                err, batch = pickle.loads(payload)
+                if err is not None:
+                    raise err
+                yield self._to_tensors(batch)
+        finally:
+            q.shutdown()       # wake a blocked producer; push returns "closed"
+            t.join(timeout=5)  # producer must exit before the queue is freed
+            if not t.is_alive():
+                q.close()
+
+    def _iter_single_py(self):
         q = queue.Queue(maxsize=self.prefetch_factor)
         stop = object()
 

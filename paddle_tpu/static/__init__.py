@@ -10,6 +10,7 @@ from .program import (  # noqa: F401
 from .executor import Executor, Scope, global_scope, CompiledBlock  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .nn_static import data, accuracy  # noqa: F401
+from .param_helper import create_parameter  # noqa: F401
 from . import nn_static as nn  # noqa: F401
 from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
 from .amp_static import amp_decorate  # noqa: F401

@@ -52,7 +52,13 @@ def global_scope():
 
 
 class CompiledBlock:
-    """One lowered block: pure function (feeds, params) -> fetches."""
+    """One lowered block: pure function (feeds, params) -> fetches.
+
+    Lowering order, dead-op pruning and feed-donation decisions come from the
+    native planner (native/src/scheduler.cc — the executor_gc_helper /
+    memory_optimize_pass role); XLA then owns scheduling and memory *inside*
+    the compiled computation.
+    """
 
     def __init__(self, program, feed_names, fetch_names, scope):
         self.program = program
@@ -63,14 +69,68 @@ class CompiledBlock:
             n for n, v in block.vars.items()
             if v.persistable and scope.get(n) is not None
         ]
-        self._jitted = jax.jit(self._run_block)
+        self._op_order, donate_feeds = self._plan(block)
+        if donate_feeds:
+            # feed arrays are fresh device uploads each run — safe to let XLA
+            # alias their buffers into outputs (inplace-pass analogue)
+            self._jitted = jax.jit(self._run_block, donate_argnums=(0,))
+        else:
+            self._jitted = jax.jit(self._run_block)
+
+    def _plan(self, block):
+        """Native pruning + scheduling; graceful pure-Python fallback."""
+        ops = list(block.ops)
+        try:
+            from ..native import NativeProgram, available
+
+            if not available():
+                raise RuntimeError("native runtime unavailable")
+            nprog = NativeProgram()
+            var_ids = {}
+
+            def vid(name):
+                if name not in var_ids:
+                    v = block.vars.get(name)
+                    persistable = bool(v is not None and v.persistable)
+                    var_ids[name] = nprog.add_var(name, persistable)
+                return var_ids[name]
+
+            side_effect_ops = {
+                "c_allreduce_sum", "c_broadcast", "c_allgather", "barrier",
+                "send_v2", "recv_v2", "save", "load", "print",
+            }
+            for op in ops:
+                in_names = getattr(op, "in_order", op.input_names())
+                out_names = getattr(op, "out_order", op.output_names())
+                # writers of persistable state (optimizer updates, BN running
+                # stats) are roots: they matter even when only loss is fetched
+                writes_state = any(
+                    (v := block.vars.get(n)) is not None and v.persistable
+                    for n in out_names)
+                nprog.add_op(op.type, [vid(n) for n in in_names],
+                             [vid(n) for n in out_names],
+                             side_effect=op.type in side_effect_ops
+                             or writes_state)
+            feed_ids = [vid(n) for n in self.feed_names]
+            fetch_ids = [var_ids[n] for n in self.fetch_names if n in var_ids]
+            plan = nprog.build_plan(feed_ids, fetch_ids)
+            order = plan.order
+            donatable = set(plan.donatable_feeds)
+            donate = bool(feed_ids) and all(f in donatable for f in feed_ids)
+            if plan.has_cycle:
+                return list(range(len(ops))), False
+            return order, donate
+        except Exception:
+            return list(range(len(ops))), False
 
     def _run_block(self, feeds, params):
         env = {}
         env.update(params)
         env.update(feeds)
         block = self.program.global_block()
-        for op in block.ops:
+        all_ops = list(block.ops)
+        for idx in self._op_order:
+            op = all_ops[idx]
             if op.fn is None:
                 continue  # structural ops (feed/fetch/init markers)
             in_names = getattr(op, "in_order", op.input_names())

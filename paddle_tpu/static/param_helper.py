@@ -11,13 +11,14 @@ from ..nn.initializer import Constant, XavierNormal
 from .program import default_main_program, default_startup_program
 
 
-def create_parameter(shape, dtype="float32", attr=None, is_bias=False,
-                     default_value=None, stop_gradient=False, name_hint="param"):
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_value=None, stop_gradient=False,
+                     name_hint="param", default_initializer=None):
     attr = ParamAttr._to_attr(attr)
     main = default_main_program()
     startup = default_startup_program()
-    name = (attr.name if attr and attr.name else
-            main._unique_name("b" if is_bias else name_hint))
+    name = (name or (attr.name if attr and attr.name else None)
+            or main._unique_name("b" if is_bias else name_hint))
     v = main.global_block().create_parameter(name=name, shape=shape, dtype=dtype)
     v.stop_gradient = stop_gradient or (attr is not None and not attr.trainable)
     v.trainable = not v.stop_gradient
@@ -25,6 +26,8 @@ def create_parameter(shape, dtype="float32", attr=None, is_bias=False,
     v.regularizer = attr.regularizer if attr else None
 
     init = attr.initializer if attr and attr.initializer else None
+    if init is None:
+        init = default_initializer  # non-mutating: attr may be shared
     if init is None:
         if default_value is not None:
             init = Constant(default_value)
