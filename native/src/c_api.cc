@@ -70,7 +70,9 @@ int32_t ptn_plan_num_ops(void* pl) {
   return static_cast<int32_t>(static_cast<ExecutionPlan*>(pl)->order.size());
 }
 int32_t ptn_plan_op_at(void* pl, int32_t i) {
-  return static_cast<ExecutionPlan*>(pl)->order[static_cast<size_t>(i)];
+  auto* plan = static_cast<ExecutionPlan*>(pl);
+  if (i < 0 || static_cast<size_t>(i) >= plan->order.size()) return -1;
+  return plan->order[static_cast<size_t>(i)];
 }
 int32_t ptn_plan_has_cycle(void* pl) {
   return static_cast<ExecutionPlan*>(pl)->has_cycle ? 1 : 0;
@@ -83,9 +85,10 @@ int32_t ptn_plan_slot_of(void* pl, int32_t var) {
   if (var < 0 || static_cast<size_t>(var) >= plan->slot_of.size()) return -1;
   return plan->slot_of[static_cast<size_t>(var)];
 }
-// writes up to cap var ids dying after step i; returns count
+// writes up to cap var ids dying after step i; returns count (0 if i invalid)
 int32_t ptn_plan_dead_after(void* pl, int32_t i, int32_t* out, int32_t cap) {
   auto* plan = static_cast<ExecutionPlan*>(pl);
+  if (i < 0 || static_cast<size_t>(i) >= plan->dead_after.size()) return 0;
   const auto& dead = plan->dead_after[static_cast<size_t>(i)];
   int32_t n = static_cast<int32_t>(dead.size());
   int32_t w = n < cap ? n : cap;
@@ -97,7 +100,9 @@ int32_t ptn_plan_num_waves(void* pl) {
       static_cast<ExecutionPlan*>(pl)->wave_sizes.size());
 }
 int32_t ptn_plan_wave_size(void* pl, int32_t i) {
-  return static_cast<ExecutionPlan*>(pl)->wave_sizes[static_cast<size_t>(i)];
+  auto* plan = static_cast<ExecutionPlan*>(pl);
+  if (i < 0 || static_cast<size_t>(i) >= plan->wave_sizes.size()) return 0;
+  return plan->wave_sizes[static_cast<size_t>(i)];
 }
 int32_t ptn_plan_donatable(void* pl, int32_t* out, int32_t cap) {
   auto* plan = static_cast<ExecutionPlan*>(pl);

@@ -130,7 +130,10 @@ class DataLoader:
         # available, batches flow through the C++ bounded byte-queue
         # (native/src/queue.cc) — blocking push/pop release the GIL, so the
         # producer thread collates the next batch while the consumer's batch
-        # is being transferred/consumed on device.
+        # is being transferred/consumed on device.  The sampler is
+        # materialized once so the python fallback can resume mid-epoch at an
+        # exact batch index (matters under shuffle).
+        batches = list(self.batch_sampler)
         if self.use_buffer_reader:
             PrefetchQueue = None
             try:
@@ -141,31 +144,40 @@ class DataLoader:
             except Exception:
                 PrefetchQueue = None
             if PrefetchQueue is not None:
-                yield from self._iter_single_native(PrefetchQueue)
+                yield from self._iter_single_native(PrefetchQueue, batches)
                 return
-        yield from self._iter_single_py()
+        yield from self._iter_single_py(batches, start=0)
 
-    def _iter_single_native(self, PrefetchQueue):
+    def _iter_single_native(self, PrefetchQueue, batches):
         import pickle
 
         q = PrefetchQueue(capacity=max(2, self.prefetch_factor))
 
         def producer():
             try:
-                for indices in self.batch_sampler:
+                for bi, indices in enumerate(batches):
                     samples = [self.dataset[i] for i in indices]
-                    payload = pickle.dumps(
-                        (None, collate_np(samples, self.collate_fn)),
-                        protocol=pickle.HIGHEST_PROTOCOL)
+                    batch = collate_np(samples, self.collate_fn)
+                    try:
+                        payload = pickle.dumps(("batch", None, batch),
+                                               protocol=pickle.HIGHEST_PROTOCOL)
+                    except Exception:
+                        # batch not picklable: hand off to the python path
+                        # from this exact index — behavior users had before
+                        # the native queue existed
+                        q.push(pickle.dumps(("fallback", bi, None)))
+                        return
                     if not q.push(payload):
                         return  # consumer gone
             except Exception as e:
                 try:
-                    payload = pickle.dumps((e, None),
+                    payload = pickle.dumps(("error", e, None),
                                            protocol=pickle.HIGHEST_PROTOCOL)
                 except Exception:  # non-picklable exception: keep the message
                     payload = pickle.dumps(
-                        (RuntimeError(f"DataLoader worker failed: {e!r}"), None),
+                        ("error",
+                         RuntimeError(f"DataLoader worker failed: {e!r}"),
+                         None),
                         protocol=pickle.HIGHEST_PROTOCOL)
                 try:
                     q.push(payload)
@@ -176,31 +188,37 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        resume_at = None
         try:
             while True:
                 try:
                     payload = q.pop()
                 except EOFError:
-                    return
+                    break
                 if payload is None:
                     continue
-                err, batch = pickle.loads(payload)
-                if err is not None:
-                    raise err
+                kind, info, batch = pickle.loads(payload)
+                if kind == "error":
+                    raise info
+                if kind == "fallback":
+                    resume_at = info
+                    break
                 yield self._to_tensors(batch)
         finally:
             q.shutdown()       # wake a blocked producer; push returns "closed"
             t.join(timeout=5)  # producer must exit before the queue is freed
             if not t.is_alive():
                 q.close()
+        if resume_at is not None:
+            yield from self._iter_single_py(batches, start=resume_at)
 
-    def _iter_single_py(self):
+    def _iter_single_py(self, batches, start=0):
         q = queue.Queue(maxsize=self.prefetch_factor)
         stop = object()
 
         def producer():
             try:
-                for indices in self.batch_sampler:
+                for indices in batches[start:]:
                     samples = [self.dataset[i] for i in indices]
                     q.put(collate_np(samples, self.collate_fn))
             except Exception as e:

@@ -28,21 +28,15 @@ _lock = threading.Lock()
 
 
 def _build_and_load():
+    """Build via native/Makefile (single source of truth for sources/flags);
+    make's own mtime tracking decides whether a rebuild is needed."""
     global _lib, _lib_err
     so_path = os.path.join(_BUILD_DIR, "libptn.so")
-    srcs = [os.path.join(_NATIVE_DIR, "src", f)
-            for f in ("graph.cc", "scheduler.cc", "allocator.cc",
-                      "queue.cc", "c_api.cc")]
     try:
-        newest_src = max(os.path.getmtime(s) for s in srcs + [
-            os.path.join(_NATIVE_DIR, "include", "ptn", "graph.h"),
-            os.path.join(_NATIVE_DIR, "include", "ptn", "scheduler.h")])
-        if not os.path.exists(so_path) or os.path.getmtime(so_path) < newest_src:
-            os.makedirs(_BUILD_DIR, exist_ok=True)
-            cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                   "-Wall", "-I", os.path.join(_NATIVE_DIR, "include"),
-                   "-o", so_path] + srcs
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, f"OUT={so_path}"],
+            check=True, capture_output=True, text=True)
         lib = ctypes.CDLL(so_path)
     except (OSError, ValueError, subprocess.CalledProcessError) as e:
         _lib_err = e

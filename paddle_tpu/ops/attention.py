@@ -24,11 +24,19 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     scale = 1.0 / math.sqrt(q.shape[-1])
 
     if use_flash is None:
-        use_flash = False
-    if use_flash and not return_weights and dropout_p == 0.0:
-        from .pallas.flash_attention import flash_attention
+        from ..framework import get_flags
 
-        return flash_attention(q, k, v, attn_mask=attn_mask, causal=is_causal), None
+        use_flash = bool(get_flags("FLAGS_flash_attention")
+                         .get("FLAGS_flash_attention"))
+    if use_flash and not return_weights and dropout_p == 0.0:
+        # import only on the flash path: environments without pallas still
+        # run the composite path fine
+        from .pallas.flash_attention import (flash_attention,
+                                             mask_is_flash_compatible)
+
+        if mask_is_flash_compatible(attn_mask):
+            return flash_attention(q, k, v, attn_mask=attn_mask,
+                                   causal=is_causal), None
 
     key = _random.next_key() if dropout_p > 0.0 else None
 

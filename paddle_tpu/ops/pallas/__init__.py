@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference ships fused CUDA kernels under operators/fused/ (e.g.
+multihead_matmul_op.cu, fused_attention) — here the fused fast path is
+written in Pallas against the TPU memory hierarchy (HBM -> VMEM -> MXU),
+with interpret-mode execution on CPU so tests run anywhere.
+"""
+from .flash_attention import flash_attention  # noqa: F401
