@@ -130,10 +130,10 @@ class DataLoader:
         # available, batches flow through the C++ bounded byte-queue
         # (native/src/queue.cc) — blocking push/pop release the GIL, so the
         # producer thread collates the next batch while the consumer's batch
-        # is being transferred/consumed on device.  The sampler is
-        # materialized once so the python fallback can resume mid-epoch at an
-        # exact batch index (matters under shuffle).
-        batches = list(self.batch_sampler)
+        # is being transferred/consumed on device.  The sampler is consumed
+        # LAZILY (a streaming/infinite custom batch_sampler must work); on a
+        # native-path fallback the live iterator is handed to the python path.
+        batch_iter = iter(self.batch_sampler)
         if self.use_buffer_reader:
             PrefetchQueue = None
             try:
@@ -144,18 +144,21 @@ class DataLoader:
             except Exception:
                 PrefetchQueue = None
             if PrefetchQueue is not None:
-                yield from self._iter_single_native(PrefetchQueue, batches)
+                yield from self._iter_single_native(PrefetchQueue, batch_iter)
                 return
-        yield from self._iter_single_py(batches, start=0)
+        yield from self._iter_single_py(batch_iter)
 
-    def _iter_single_native(self, PrefetchQueue, batches):
+    def _iter_single_native(self, PrefetchQueue, batch_iter):
         import pickle
 
         q = PrefetchQueue(capacity=max(2, self.prefetch_factor))
+        # on unpicklable-batch fallback the producer parks the failed batch's
+        # indices here; the python path re-loads it and continues batch_iter
+        leftover = []
 
         def producer():
             try:
-                for bi, indices in enumerate(batches):
+                for indices in batch_iter:
                     samples = [self.dataset[i] for i in indices]
                     batch = collate_np(samples, self.collate_fn)
                     try:
@@ -163,9 +166,10 @@ class DataLoader:
                                                protocol=pickle.HIGHEST_PROTOCOL)
                     except Exception:
                         # batch not picklable: hand off to the python path
-                        # from this exact index — behavior users had before
+                        # from this exact batch — behavior users had before
                         # the native queue existed
-                        q.push(pickle.dumps(("fallback", bi, None)))
+                        leftover.append(indices)
+                        q.push(pickle.dumps(("fallback", None, None)))
                         return
                     if not q.push(payload):
                         return  # consumer gone
@@ -188,7 +192,7 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        resume_at = None
+        fallback = False
         try:
             while True:
                 try:
@@ -201,7 +205,7 @@ class DataLoader:
                 if kind == "error":
                     raise info
                 if kind == "fallback":
-                    resume_at = info
+                    fallback = True
                     break
                 yield self._to_tensors(batch)
         finally:
@@ -209,16 +213,17 @@ class DataLoader:
             t.join(timeout=5)  # producer must exit before the queue is freed
             if not t.is_alive():
                 q.close()
-        if resume_at is not None:
-            yield from self._iter_single_py(batches, start=resume_at)
+        if fallback:
+            yield from self._iter_single_py(
+                itertools.chain(leftover, batch_iter))
 
-    def _iter_single_py(self, batches, start=0):
+    def _iter_single_py(self, batch_iter):
         q = queue.Queue(maxsize=self.prefetch_factor)
         stop = object()
 
         def producer():
             try:
-                for indices in batches[start:]:
+                for indices in batch_iter:
                     samples = [self.dataset[i] for i in indices]
                     q.put(collate_np(samples, self.collate_fn))
             except Exception as e:
@@ -249,31 +254,37 @@ class DataLoader:
             workers.append(w)
             index_queues.append(iq)
 
-        batches = list(self.batch_sampler)
-        n = len(batches)
-        outstanding = 0
-        next_dispatch = 0
+        batch_iter = iter(self.batch_sampler)  # lazy: infinite samplers work
+        state = {"next_dispatch": 0, "exhausted": False}
         buffered = {}
         next_yield = 0
+
+        def dispatch():
+            if state["exhausted"]:
+                return False
+            try:
+                indices = next(batch_iter)
+            except StopIteration:
+                state["exhausted"] = True
+                return False
+            i = state["next_dispatch"]
+            index_queues[i % self.num_workers].put((i, indices))
+            state["next_dispatch"] = i + 1
+            return True
+
         try:
             # keep prefetch_factor batches in flight per worker
-            while next_dispatch < n and outstanding < self.num_workers * self.prefetch_factor:
-                index_queues[next_dispatch % self.num_workers].put(
-                    (next_dispatch, batches[next_dispatch]))
-                next_dispatch += 1
-                outstanding += 1
-            while next_yield < n:
+            limit = self.num_workers * self.prefetch_factor
+            while (state["next_dispatch"] - next_yield) < limit and dispatch():
+                pass
+            while not (state["exhausted"]
+                       and next_yield == state["next_dispatch"]):
                 while next_yield not in buffered:
                     seq, payload = data_queue.get()
                     if isinstance(payload, Exception):
                         raise payload
                     buffered[seq] = payload
-                    outstanding -= 1
-                    if next_dispatch < n:
-                        index_queues[next_dispatch % self.num_workers].put(
-                            (next_dispatch, batches[next_dispatch]))
-                        next_dispatch += 1
-                        outstanding += 1
+                    dispatch()
                 yield self._to_tensors(buffered.pop(next_yield))
                 next_yield += 1
         finally:

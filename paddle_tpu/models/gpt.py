@@ -30,7 +30,7 @@ from ..parallel.sharding_annotations import shard_activation
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden=None, max_seq_len=1024,
-                 dropout=0.1, use_flash=False, remat=False):
+                 dropout=0.1, use_flash=False, remat=False, cp_mode="ring"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -40,6 +40,9 @@ class GPTConfig:
         self.dropout = dropout
         self.use_flash = use_flash
         self.remat = remat
+        # context parallelism ('ring' | 'ulysses'), active automatically when
+        # a 'seq' mesh axis is in scope (parallel/context_parallel.py)
+        self.cp_mode = cp_mode
 
 
 def gpt_tiny(**kw):
@@ -67,6 +70,7 @@ class GPTAttention(Layer):
         self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
         self.dropout = config.dropout
         self.use_flash = config.use_flash
+        self.cp_mode = config.cp_mode
 
     def forward(self, x):
         B, L, _ = x.shape
@@ -77,11 +81,32 @@ class GPTAttention(Layer):
         qkv = MAN.reshape(qkv, [B, L, -1, 3, self.head_dim])
         qkv = MAN.transpose(qkv, [3, 0, 2, 1, 4])  # [3, B, H_local, L, D]
         q, k, v = qkv[0], qkv[1], qkv[2]
-        out, _ = scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.dropout if self.training else 0.0,
-            use_flash=self.use_flash,
+        from ..parallel.context_parallel import (
+            seq_axis_in_scope, context_parallel_attention,
         )
+
+        if seq_axis_in_scope():
+            # sequence sharded over the 'seq' mesh axis: ring/Ulysses
+            # attention over ICI (attention-weight dropout not supported
+            # on this path, matching the flash kernel's contract)
+            if self.dropout and self.training:
+                import warnings
+
+                warnings.warn(
+                    "attention-weight dropout is skipped under sequence "
+                    "parallelism (residual/MLP dropout still applies)",
+                    stacklevel=2,
+                )
+            out = context_parallel_attention(
+                q, k, v, mode=self.cp_mode, causal=True,
+                use_flash=self.use_flash,
+            )
+        else:
+            out, _ = scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.dropout if self.training else 0.0,
+                use_flash=self.use_flash,
+            )
         out = MAN.transpose(out, [0, 2, 1, 3])
         out = MAN.reshape(out, [B, L, -1])  # merges the LOCAL head shard
         return self.out_proj(out)
@@ -141,6 +166,14 @@ class GPTModel(Layer):
                 ), [B, L]
             ), "int32",
         )
+        from ..parallel.context_parallel import (
+            seq_axis_in_scope, seq_chunk_offset,
+        )
+
+        if seq_axis_in_scope():
+            # L is the LOCAL chunk length under sequence parallelism;
+            # positions are global: rank * L + local arange
+            pos = MAN.cast(M.add(pos, seq_chunk_offset(L)), "int32")
         x = M.add(self.wte(input_ids), self.wpe(pos))
         x = self.drop(x)
         for blk in self.blocks:

@@ -86,6 +86,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, lse_ref,
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         alpha = jnp.exp(m_prev - m_cur)            # rescale of old state
         p = jnp.exp(s - m_cur[:, None])            # [block_q, block_k]
+        # fully-masked rows saturate at s == m_cur == NEG_INF, where exp(0)
+        # would leak weight 1 per key; re-mask so l stays 0 for them
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -169,6 +172,7 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         if causal:
             s = _causal_mask(s, qb, kb, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])              # [block_q, block_k]
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)    # see fwd kernel note
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -212,6 +216,7 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         if causal:
             s = _causal_mask(s, qb, kb, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)    # see fwd kernel note
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])

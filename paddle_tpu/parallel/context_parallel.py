@@ -1,0 +1,185 @@
+"""Sequence / context parallelism: ring attention + Ulysses all-to-all.
+
+TPU-native extension with no reference analogue (SURVEY §2.3 "Absent in
+reference" row, §5.7): the reference's longest-sequence story is recompute +
+pipeline micro-batching; here long sequences shard over a 'seq' mesh axis so
+activation memory scales 1/S per chip and attention runs as ICI collectives:
+
+- **ring attention**: K/V chunks rotate around the 'seq' ring via
+  `lax.ppermute` while each chip accumulates online-softmax partial results
+  for its local Q chunk.  S steps, each an [Lq/S x Lk/S] block matmul on the
+  MXU; peak score memory is L^2/S^2 per step instead of L^2.
+- **Ulysses**: `lax.all_to_all` re-shards [B, H, L/S, D] -> [B, H/S, L, D]
+  (heads scatter, sequence gathers), runs dense/flash attention on full
+  sequence with the local head group, and all-to-alls back.  Two collectives
+  per call; attention itself can use the Pallas flash kernel.
+
+Both are pure-jax and differentiable (ppermute/all_to_all transpose to their
+inverses under vjp), so they compose with jax.checkpoint, bf16 autocast and
+the fused hybrid step in parallel/hybrid.py.  Causal masking uses *global*
+positions derived from `axis_index('seq')`.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op
+
+NEG_INF = -1e30
+SEQ_AXIS = "seq"
+
+
+def seq_axis_in_scope(axis_name=SEQ_AXIS):
+    """True when called under shard_map/pmap tracing with `axis_name` bound."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except BaseException:
+        return False
+
+
+def seq_chunk_offset(local_len, axis_name=SEQ_AXIS, dtype="int32"):
+    """Tensor scalar: this chip's global sequence offset (rank * local_len);
+    0 outside a seq-parallel region.  Used for global position ids."""
+    if not seq_axis_in_scope(axis_name):
+        from ..ops.creation import zeros
+
+        return zeros([], dtype=dtype)
+
+    def fn():
+        return (jax.lax.axis_index(axis_name) * local_len).astype(dtype)
+
+    return apply_op("seq_chunk_offset", fn, (), {})
+
+
+# ------------------------------ ring attention ---------------------------
+
+
+def _ring_attention_raw(q, k, v, axis_name, causal):
+    """q,k,v: [B, H, Lq_local, D] local chunks of a sequence sharded over
+    `axis_name`.  Returns [B, H, Lq_local, D]."""
+    S = jax.lax.psum(1, axis_name)          # static axis size
+    rank = jax.lax.axis_index(axis_name)
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    scale = 1.0 / math.sqrt(D)
+    qs = (q * scale).astype(jnp.float32)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def one_block(qs, kc, vc, src):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            qpos = rank * Lq + jnp.arange(Lq)
+            kpos = src * Lk + jnp.arange(Lk)
+            msk = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(msk, s, NEG_INF)
+        return s
+
+    def step(carry, _):
+        acc, m, l, kc, vc, i = carry
+        src = (rank - i) % S               # global chunk id currently held
+        s = one_block(qs, kc, vc, src)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # rows with nothing visible yet keep m=NEG_INF; exp(s-m) with both at
+        # NEG_INF would be 1, so re-mask p explicitly
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        # m <= m_new always, so alpha in (0, 1]; when both are NEG_INF
+        # (row saw nothing yet) alpha=1 but acc and l are still 0
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        kc2 = jax.lax.ppermute(kc, axis_name, perm)
+        vc2 = jax.lax.ppermute(vc, axis_name, perm)
+        return (acc_new, m_new, l_new, kc2, vc2, i + 1), None
+
+    init = (
+        jnp.zeros((B, H, Lq, D), jnp.float32),
+        jnp.full((B, H, Lq), NEG_INF, jnp.float32),
+        jnp.zeros((B, H, Lq), jnp.float32),
+        k, v, jnp.int32(0),
+    )
+    # remat the step so the backward recomputes block scores instead of
+    # saving S score tensors
+    (acc, m, l, _, _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), init, None, length=S)
+    safe_l = jnp.where(l > 0.0, l, 1.0)
+    return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+# ------------------------------ Ulysses ----------------------------------
+
+
+def _ulysses_attention_raw(q, k, v, axis_name, causal, use_flash):
+    """All-to-all sequence parallelism: [B,H,L/S,D] -> heads sharded,
+    sequence gathered -> local attention -> inverse all-to-all."""
+    S = jax.lax.psum(1, axis_name)
+    H = q.shape[1]
+    if H % S != 0:
+        raise ValueError(
+            f"ulysses requires heads ({H}) divisible by seq-axis size ({S})")
+
+    def fwd_a2a(x):   # [B, H, Lloc, D] -> [B, H/S, L, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def inv_a2a(x):   # [B, H/S, L, D] -> [B, H, Lloc, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qg, kg, vg = fwd_a2a(q), fwd_a2a(k), fwd_a2a(v)
+    scale = 1.0 / math.sqrt(qg.shape[-1])
+    if use_flash:
+        from ..ops.pallas.flash_attention import _flash
+
+        b, h, lq, d = qg.shape
+        lk = kg.shape[2]
+        out = _flash(
+            (qg * scale).reshape(b * h, lq, d),
+            kg.reshape(b * h, lk, d), vg.reshape(b * h, lk, d),
+            jnp.zeros((1, lk), jnp.float32), causal, h, False,
+        ).reshape(b, h, lq, d)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", (qg * scale).astype(jnp.float32),
+                       kg.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            Lq, Lk = s.shape[-2], s.shape[-1]
+            cm = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+            s = jnp.where(cm, s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32)
+                         ).astype(qg.dtype)
+    return inv_a2a(out)
+
+
+# ------------------------------ public entry ------------------------------
+
+
+def context_parallel_attention(q, k, v, mode="ring", axis_name=SEQ_AXIS,
+                               causal=True, use_flash=False):
+    """Tensor-level sequence-parallel attention.  q,k,v: [B, H, Lloc, D]
+    Tensors holding this chip's sequence chunk.  Falls back to dense
+    attention when no `axis_name` mesh axis is in scope."""
+    if not seq_axis_in_scope(axis_name):
+        from ..ops.attention import scaled_dot_product_attention
+
+        out, _ = scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                              use_flash=use_flash)
+        return out
+
+    if mode == "ring":
+        def fn(qv, kv, vv):
+            return _ring_attention_raw(qv, kv, vv, axis_name, causal)
+    elif mode == "ulysses":
+        def fn(qv, kv, vv):
+            return _ulysses_attention_raw(qv, kv, vv, axis_name, causal,
+                                          use_flash)
+    else:
+        raise ValueError(f"unknown context-parallel mode: {mode!r}")
+
+    return apply_op(f"{mode}_attention", fn, (q, k, v), {})

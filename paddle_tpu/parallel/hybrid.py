@@ -68,6 +68,13 @@ class CompiledTrainStep:
         self._batch_specs = batch_specs
         self._step_count = 0
         self.dp_axis = "data" if "data" in mesh.axis_names else None
+        # context parallelism: a 'seq' mesh axis shards the sequence dim of
+        # the batch; params are replicated over it, so grads get one extra
+        # pmean (parallel/context_parallel.py provides the attention)
+        self.seq_axis = (
+            "seq" if "seq" in mesh.axis_names and mesh.shape["seq"] > 1
+            else None
+        )
         self.zero = (
             zero_shard_states and self.dp_axis is not None
             and mesh.shape[self.dp_axis] > 1
@@ -124,6 +131,7 @@ class CompiledTrainStep:
         mesh = self.mesh
         amp_dtype = self.amp_dtype
         dp_axis = self.dp_axis
+        seq_axis = self.seq_axis
         zero = self.zero
         dp = mesh.shape[dp_axis] if dp_axis else 1
         pad = self._pad
@@ -160,10 +168,16 @@ class CompiledTrainStep:
         def spmd_step(params, flat_state, batch_vals, key, lr):
             if dp_axis is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+            if seq_axis is not None:
+                key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
             loss, grads = jax.value_and_grad(local_loss)(
                 params, batch_vals, key
             )
             gflat, _ = ravel_pytree(grads)
+            if seq_axis is not None:
+                # params replicated over 'seq': average the per-chunk grads
+                gflat = jax.lax.pmean(gflat, seq_axis)
+                loss = jax.lax.pmean(loss, seq_axis)
             pflat, unravel_local = ravel_pytree(params)
             if pad:
                 zpad_g = jnp.zeros((pad,), gflat.dtype)
@@ -223,9 +237,32 @@ class CompiledTrainStep:
                 v.ndim and self.dp_axis
                 and v.shape[0] % self.mesh.shape[self.dp_axis] == 0
             ):
-                out.append(P(*([self.dp_axis] + [None] * (v.ndim - 1))))
+                axes = [self.dp_axis] + [None] * (v.ndim - 1)
+                # token-id style [B, L] inputs also shard the sequence dim
+                # when a 'seq' axis is present (pass batch_specs to override)
+                if (
+                    self.seq_axis and v.ndim == 2
+                    and jnp.issubdtype(v.dtype, jnp.integer)
+                    and v.shape[1] % self.mesh.shape[self.seq_axis] == 0
+                ):
+                    axes[1] = self.seq_axis
+                out.append(P(*axes))
             else:
                 out.append(P())
+        def _uses_seq(spec):
+            return any(
+                a == self.seq_axis
+                or (isinstance(a, tuple) and self.seq_axis in a)
+                for a in spec
+            )
+
+        if self.seq_axis is not None and not any(_uses_seq(s) for s in out):
+            raise ValueError(
+                "mesh has a 'seq' axis but no batch input is sharded on it; "
+                "the model would run ring/Ulysses attention over replicated "
+                "full sequences and compute garbage. Shard a batch dim on "
+                "'seq' via batch_specs, or drop the axis from the mesh."
+            )
         return tuple(out)
 
     # ---- public API ----
