@@ -157,7 +157,8 @@ class GPTModel(Layer):
                 p.pipeline_stage_hint = i  # stage assignment input for pp
         self.ln_f = LayerNorm(config.hidden_size)
 
-    def forward(self, input_ids):
+    def embed(self, input_ids):
+        """Token + position embedding (the pre-block pipeline stage-0 part)."""
         B, L = input_ids.shape
         pos = MAN.cast(
             MAN.reshape(
@@ -175,7 +176,10 @@ class GPTModel(Layer):
             # positions are global: rank * L + local arange
             pos = MAN.cast(M.add(pos, seq_chunk_offset(L)), "int32")
         x = M.add(self.wte(input_ids), self.wpe(pos))
-        x = self.drop(x)
+        return self.drop(x)
+
+    def forward(self, input_ids):
+        x = self.embed(input_ids)
         for blk in self.blocks:
             x = blk(x)
         return self.ln_f(x)
@@ -196,8 +200,10 @@ class GPTForPretraining(Layer):
         self.gpt = GPTModel(config)
         self.config = config
 
-    def forward(self, input_ids):
-        h = self.gpt(input_ids)
+    def lm_logits(self, h):
+        """Final-norm + tied LM head over post-block hidden states (the
+        last pipeline stage's part)."""
+        h = self.gpt.ln_f(h)
         # logits = h @ wte^T (tied weights); wte is vocab-sharded under TP so
         # this is a column-parallel matmul — mark the TP-region entry so the
         # backward sums the per-shard cotangents of h
@@ -205,12 +211,21 @@ class GPTForPretraining(Layer):
             copy_to_model_parallel,
         )
 
-        logits = M.matmul(copy_to_model_parallel(h), self.gpt.wte.weight,
-                          transpose_y=True)
-        return logits
+        return M.matmul(copy_to_model_parallel(h), self.gpt.wte.weight,
+                        transpose_y=True)
 
-    def loss(self, input_ids, labels):
-        logits = self.forward(input_ids)
+    def _hidden(self, input_ids):
+        x = self.gpt.embed(input_ids)
+        for blk in self.gpt.blocks:
+            x = blk(x)
+        return x
+
+    def forward(self, input_ids):
+        return self.lm_logits(self._hidden(input_ids))
+
+    def head_loss(self, h, labels):
+        """Loss from post-block hidden states (pipeline last stage)."""
+        logits = self.lm_logits(h)
         from ..distributed.fleet.meta_parallel.mp_layers import (
             ParallelCrossEntropy,
         )
@@ -221,3 +236,6 @@ class GPTForPretraining(Layer):
             logits, MAN.reshape(labels, list(labels.shape) + [1])
         )
         return M.mean(loss)
+
+    def loss(self, input_ids, labels):
+        return self.head_loss(self._hidden(input_ids), labels)

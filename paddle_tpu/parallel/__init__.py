@@ -11,3 +11,10 @@ from .collective import (  # noqa: F401
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401
+from .hybrid import CompiledTrainStep  # noqa: F401
+from .pipeline_compile import (  # noqa: F401
+    PipelinedTrainStep, GPTPipeAdapter, PipeStagePlan,
+)
+from .context_parallel import (  # noqa: F401
+    context_parallel_attention, seq_axis_in_scope, seq_chunk_offset,
+)

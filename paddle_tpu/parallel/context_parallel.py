@@ -31,11 +31,12 @@ SEQ_AXIS = "seq"
 
 
 def seq_axis_in_scope(axis_name=SEQ_AXIS):
-    """True when called under shard_map/pmap tracing with `axis_name` bound."""
+    """True when called under shard_map/pmap tracing with `axis_name` bound
+    to a non-trivial (size > 1) axis — matching CompiledTrainStep, which
+    ignores a size-1 'seq' placeholder axis."""
     try:
-        jax.lax.axis_index(axis_name)
-        return True
-    except BaseException:
+        return jax.lax.psum(1, axis_name) > 1
+    except (NameError, KeyError, ValueError):
         return False
 
 
@@ -67,7 +68,7 @@ def _ring_attention_raw(q, k, v, axis_name, causal):
     qs = (q * scale).astype(jnp.float32)
     perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def one_block(qs, kc, vc, src):
+    def one_block(qs, kc, src):
         s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc.astype(jnp.float32),
                        preferred_element_type=jnp.float32)
         if causal:
@@ -80,7 +81,7 @@ def _ring_attention_raw(q, k, v, axis_name, causal):
     def step(carry, _):
         acc, m, l, kc, vc, i = carry
         src = (rank - i) % S               # global chunk id currently held
-        s = one_block(qs, kc, vc, src)
+        s = one_block(qs, kc, src)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # rows with nothing visible yet keep m=NEG_INF; exp(s-m) with both at
         # NEG_INF would be 1, so re-mask p explicitly

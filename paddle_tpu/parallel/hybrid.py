@@ -174,9 +174,12 @@ class CompiledTrainStep:
                 params, batch_vals, key
             )
             gflat, _ = ravel_pytree(grads)
-            if seq_axis is not None:
-                # params replicated over 'seq': average the per-chunk grads
+            if seq_axis is not None and (zero or dp_axis is None):
+                # params replicated over 'seq': average the per-chunk grads.
+                # (In the plain-DP branch below this fuses with the 'data'
+                # pmean into one collective instead.)
                 gflat = jax.lax.pmean(gflat, seq_axis)
+            if seq_axis is not None:
                 loss = jax.lax.pmean(loss, seq_axis)
             pflat, unravel_local = ravel_pytree(params)
             if pad:
@@ -204,8 +207,11 @@ class CompiledTrainStep:
             else:
                 if dp_axis is not None:
                     # fused DP allreduce: ONE collective for ALL grads
-                    # (reducer.cc fused-bucket parity)
-                    gflat = jax.lax.pmean(gflat, dp_axis)
+                    # (reducer.cc fused-bucket parity), folding in the
+                    # 'seq' reduction when context parallelism is active
+                    axes = ((seq_axis, dp_axis) if seq_axis is not None
+                            else dp_axis)
+                    gflat = jax.lax.pmean(gflat, axes)
                 pflat_new, new_flat_state = fused_update(
                     pflat, gflat, flat_state, lr
                 )

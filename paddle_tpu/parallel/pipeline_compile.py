@@ -1,0 +1,367 @@
+"""Compiled pipeline parallelism over a 'pipe' mesh axis.
+
+Reference parity: the static PipelineOptimizer + SectionWorker micro-batch
+schedules (optimizer.py:4135; section_worker.cc:134 F-then-B, :167 1F1B) and
+the dygraph PipelineParallel.train_batch (pipeline_parallel.py:114).
+TPU-native design — one jitted SPMD program instead of per-stage processes:
+
+- the transformer's homogeneous block stack is STACKED along a leading layer
+  axis and sharded over 'pipe', so each chip holds `layers/S` blocks;
+- a `lax.scan` over `M + S - 1` ticks rotates micro-batch activations around
+  the ring with `ppermute` (stage s processes micro-batch t-s at tick t) —
+  the GPipe/1F1B dataflow expressed as a collective-permute pipeline, which
+  XLA overlaps with the per-stage compute on ICI;
+- embedding/head ("other") params are replicated over 'pipe'; only the
+  owning stage's compute contributes their grads, so a psum over 'pipe'
+  recovers exact gradients (embedding-tying just works: stage 0's embed grad
+  and the last stage's head grad sum);
+- composes with 'data' (batch) and 'model' (tensor-parallel) mesh axes, grads
+  pmean over 'data'; remat wraps each block for activation memory.
+
+Per-chip flat param/opt-state buffers follow the hybrid-step convention
+(device-local buffers carried with replicated out-specs, parallel/hybrid.py).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from .collective import shard_map as _shard_map
+from .hybrid import _clean_spec, _FunctionalModel
+from ..core.tensor import Tensor, _wrap_data
+from ..core import autograd, random as _random
+
+
+class PipeStagePlan:
+    """Splits a model's params into a stacked homogeneous block group
+    (sharded over 'pipe') and the replicated remainder.
+
+    `block_param_prefix` is the common prefix of per-layer param names, e.g.
+    'gpt.blocks.' for names like 'gpt.blocks.3.ln1.weight'."""
+
+    def __init__(self, model, block_param_prefix):
+        self.model = model
+        self.prefix = block_param_prefix
+        named = dict(model.named_parameters())
+        per_layer = {}
+        other = {}
+        for n, p in named.items():
+            if n.startswith(self.prefix):
+                rest = n[len(self.prefix):]
+                idx, rel = rest.split(".", 1)
+                per_layer.setdefault(int(idx), {})[rel] = p
+            else:
+                other[n] = p
+        self.num_layers = len(per_layer)
+        if self.num_layers == 0:
+            raise ValueError(f"no params under prefix {self.prefix!r}")
+        self.rel_names = sorted(per_layer[0])
+        for i in range(self.num_layers):
+            if sorted(per_layer[i]) != self.rel_names:
+                raise ValueError("pipeline blocks must be homogeneous")
+        self.per_layer = per_layer
+        self.other = other
+
+    def stacked_block_arrays(self):
+        return {
+            rel: jnp.stack([self.per_layer[i][rel]._data
+                            for i in range(self.num_layers)])
+            for rel in self.rel_names
+        }
+
+    def unstack_into_model(self, stacked):
+        for rel, arr in stacked.items():
+            for i in range(self.num_layers):
+                self.per_layer[i][rel]._data = arr[i]
+
+
+class GPTPipeAdapter:
+    """Binds GPTForPretraining's embed / block / head pieces to raw-array
+    functions usable inside the SPMD pipeline program."""
+
+    def __init__(self, model):
+        self.model = model
+        self.plan = PipeStagePlan(model, "gpt.blocks.")
+        self.template_block = model.gpt.blocks[0]
+
+    def _swap(self, params, fn):
+        named = dict(self.model.named_parameters())
+        saved = {n: p._data for n, p in named.items()}
+        try:
+            for n, v in params.items():
+                if n in named:
+                    named[n]._data = v
+            return fn()
+        finally:
+            for n, v in saved.items():
+                named[n]._data = v
+
+    def embed(self, other_params, ids):
+        return self._swap(
+            other_params,
+            lambda: self.model.gpt.embed(_wrap_data(ids))._data,
+        )
+
+    def block(self, rel_params, x):
+        return self.template_block.functional_call(
+            {k: _wrap_data(v) for k, v in rel_params.items()},
+            _wrap_data(x),
+        )._data
+
+    def head_loss(self, other_params, h, labels):
+        return self._swap(
+            other_params,
+            lambda: self.model.head_loss(
+                _wrap_data(h), _wrap_data(labels))._data,
+        )
+
+
+class PipelinedTrainStep:
+    """Build once, call `.step(ids, labels)` per global batch.
+
+    mesh must have a 'pipe' axis; 'data' and 'model' axes compose.  The
+    global batch B splits into `num_micro` micro-batches of B/num_micro
+    (further sharded over 'data')."""
+
+    def __init__(self, adapter, optimizer, mesh, num_micro,
+                 amp_dtype=None, remat=True, donate=True):
+        self.adapter = adapter
+        self.plan = adapter.plan
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.num_micro = num_micro
+        self.amp_dtype = amp_dtype
+        self.remat = remat
+        self.donate = donate
+        if "pipe" not in mesh.axis_names:
+            raise ValueError("mesh needs a 'pipe' axis")
+        self.S = mesh.shape["pipe"]
+        if self.plan.num_layers % self.S != 0:
+            raise ValueError(
+                f"{self.plan.num_layers} layers not divisible by "
+                f"pipe={self.S}")
+        self.dp_axis = "data" if "data" in mesh.axis_names else None
+        self._step_count = 0
+        self._jit_step = None
+
+        # other (replicated-over-pipe) params keep their own specs
+        self.other_specs = {
+            n: _clean_spec(getattr(p, "dist_spec", None), mesh, p._data.shape)
+            for n, p in self.plan.other.items()
+        }
+        self.other_params = {
+            n: jax.device_put(p._data,
+                              NamedSharding(mesh, self.other_specs[n]))
+            for n, p in self.plan.other.items()
+        }
+        # stacked blocks: leading layer dim sharded over 'pipe', the rest
+        # follows the block param's own (e.g. tensor-parallel) spec
+        tmpl = {n: p for n, p in
+                self.adapter.template_block.named_parameters()}
+        self.block_specs = {}
+        stacked = self.plan.stacked_block_arrays()
+        for rel, arr in stacked.items():
+            inner = _clean_spec(getattr(tmpl[rel], "dist_spec", None), mesh,
+                                arr.shape[1:])
+            self.block_specs[rel] = P("pipe", *inner)
+        self.block_params = {
+            rel: jax.device_put(arr,
+                                NamedSharding(mesh, self.block_specs[rel]))
+            for rel, arr in stacked.items()
+        }
+
+        # fused flat optimizer state per group (device-local convention)
+        def local_len(specs, shapes):
+            total = 0
+            for n, shape in shapes.items():
+                shape = list(shape)
+                for i, ax in enumerate(list(specs[n])):
+                    if ax is None:
+                        continue
+                    size = (mesh.shape[ax] if isinstance(ax, str)
+                            else int(np.prod([mesh.shape[a] for a in ax])))
+                    shape[i] //= size
+                total += int(np.prod(shape)) if shape else 1
+            return total
+
+        n_other = local_len(self.other_specs,
+                            {n: p._data.shape
+                             for n, p in self.plan.other.items()})
+        n_block = local_len(self.block_specs,
+                            {r: a.shape for r, a in stacked.items()})
+        self._opt_state = {}
+        self._state_template = {}
+        for group, ln in (("other", n_other), ("block", n_block)):
+            fake = _wrap_data(jnp.zeros((ln,), jnp.float32))
+            tpl = optimizer._init_state(fake)
+            self._state_template[group] = tpl
+            self._opt_state[group] = {
+                k: jax.device_put(jnp.array(v), NamedSharding(mesh, P()))
+                for k, v in tpl.items()
+            }
+
+    # ---- SPMD program ----
+    def _build(self, ids_aval, labels_aval):
+        adapter, optimizer = self.adapter, self.optimizer
+        mesh, amp_dtype = self.mesh, self.amp_dtype
+        S, M = self.S, self.num_micro
+        dp_axis = self.dp_axis
+
+        def cast(params):
+            if amp_dtype is None:
+                return params
+            return {
+                n: v.astype(amp_dtype)
+                if jnp.issubdtype(v.dtype, jnp.floating) and v.ndim > 1
+                else v
+                for n, v in params.items()
+            }
+
+        def stage_apply(block_params_local, x, key):
+            # run this chip's layers/S blocks in order; each layer gets its
+            # own folded rng key so dropout masks decorrelate across layers
+            per = jax.tree_util.tree_leaves(block_params_local)[0].shape[0]
+
+            def one(x, xs):
+                rel_params, li = xs
+                k = jax.random.fold_in(key, li)
+                with _random.rng_guard(k), autograd.no_grad():
+                    return adapter.block(cast(rel_params), x).astype(
+                        x.dtype), None
+
+            if self.remat:
+                one = jax.checkpoint(one)
+            out, _ = jax.lax.scan(one, x,
+                                  (block_params_local, jnp.arange(per)))
+            return out
+
+        def local_loss(other, blocks, ids_mb, labels_mb, key):
+            """Full pipelined forward: returns summed micro losses (nonzero
+            only on the last stage)."""
+            stage = jax.lax.axis_index("pipe")
+            ids_m = ids_mb.reshape((M, -1) + ids_mb.shape[1:])
+            lbl_m = labels_mb.reshape((M, -1) + labels_mb.shape[1:])
+            mb = ids_m.shape[1]
+            co = cast(other)
+
+            with autograd.no_grad(), _random.rng_guard(key):
+                e_shape = adapter.embed(co, ids_m[0]).shape
+            x0 = jnp.zeros(e_shape, amp_dtype or jnp.float32)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                x_in, loss_acc = carry
+                kt = jax.random.fold_in(key, t)
+                with _random.rng_guard(kt), autograd.no_grad():
+                    ti = jnp.clip(t, 0, M - 1)
+                    emb = adapter.embed(
+                        co, jax.lax.dynamic_index_in_dim(
+                            ids_m, ti, 0, keepdims=False))
+                    inp = jnp.where(stage == 0, emb.astype(x_in.dtype), x_in)
+                    out = stage_apply(blocks, inp, kt).astype(x_in.dtype)
+                    mi = t - (S - 1)
+                    lbl = jax.lax.dynamic_index_in_dim(
+                        lbl_m, jnp.clip(mi, 0, M - 1), 0, keepdims=False)
+                    l = adapter.head_loss(co, out, lbl).astype(jnp.float32)
+                    l = jnp.where((stage == S - 1) & (mi >= 0), l, 0.0)
+                    x_next = jax.lax.ppermute(out, "pipe", perm)
+                return (x_next, loss_acc + l), None
+
+            (x_last, loss_sum), _ = jax.lax.scan(
+                tick, (x0, jnp.float32(0.0)), jnp.arange(M + S - 1))
+            return loss_sum / M
+
+        wd = optimizer._weight_decay_coeff()
+        decoupled = optimizer._decoupled_weight_decay
+
+        def fused_update(pflat, gflat, state, lr):
+            if wd and not decoupled:
+                gflat = gflat + wd * pflat
+            new_p, new_state = optimizer.update(pflat, gflat, state, lr)
+            if wd and decoupled:
+                new_p = new_p - lr * wd * pflat
+            return new_p, new_state
+
+        def spmd_step(other, blocks, st_other, st_block, ids, labels, key,
+                      lr):
+            key = jax.random.fold_in(key, jax.lax.axis_index("pipe"))
+            if dp_axis is not None:
+                key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+            loss, grads = jax.value_and_grad(local_loss, argnums=(0, 1))(
+                other, blocks, ids, labels, key)
+            g_other, g_blocks = grads
+            # 'other' params: only the owning stage produced nonzero grads
+            g_other = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, "pipe"), g_other)
+            loss = jax.lax.psum(loss, "pipe")
+            if dp_axis is not None:
+                g_other = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, dp_axis), g_other)
+                g_blocks = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, dp_axis), g_blocks)
+                loss = jax.lax.pmean(loss, dp_axis)
+
+            new_params = []
+            new_states = []
+            for group, (params, gtree, state) in {
+                "other": (other, g_other, st_other),
+                "block": (blocks, g_blocks, st_block),
+            }.items():
+                pflat, unravel = ravel_pytree(params)
+                gflat, _ = ravel_pytree(gtree)
+                pnew, snew = fused_update(pflat, gflat, state, lr)
+                new_params.append(unravel(pnew))
+                new_states.append(snew)
+            return loss, new_params[0], new_params[1], new_states[0], \
+                new_states[1]
+
+        state_spec = {k: P() for k in self._state_template["other"]}
+        bstate_spec = {k: P() for k in self._state_template["block"]}
+        batch_axes = [None]
+        if dp_axis and ids_aval.shape[0] % (
+                self.num_micro * mesh.shape[dp_axis]) == 0:
+            batch_axes = [dp_axis]
+        bspec = P(*batch_axes)
+        in_specs = (self.other_specs, self.block_specs, state_spec,
+                    bstate_spec, bspec, bspec, P(), P())
+        out_specs = (P(), self.other_specs, self.block_specs, state_spec,
+                     bstate_spec)
+        fn = _shard_map(spmd_step, mesh, in_specs, out_specs)
+        donate = (0, 1, 2, 3) if self.donate else ()
+        return jax.jit(fn, donate_argnums=donate)
+
+    # ---- public API ----
+    def step(self, ids, labels):
+        iv = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        lv = labels._data if isinstance(labels, Tensor) else \
+            jnp.asarray(labels)
+        if iv.shape[0] % self.num_micro != 0:
+            raise ValueError(
+                f"batch {iv.shape[0]} not divisible by "
+                f"num_micro={self.num_micro}")
+        if self._jit_step is None:
+            self._jit_step = self._build(iv, lv)
+        self._step_count += 1
+        key = jax.random.fold_in(_random.get_rng_state(), self._step_count)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        (loss, self.other_params, self.block_params,
+         self._opt_state["other"], self._opt_state["block"]) = \
+            self._jit_step(self.other_params, self.block_params,
+                           self._opt_state["other"],
+                           self._opt_state["block"], iv, lv, key, lr)
+        from ..optimizer.lr import LRScheduler
+
+        if isinstance(self.optimizer._lr, LRScheduler):
+            self.optimizer._lr.step()
+        return _wrap_data(loss)
+
+    def sync_to_model(self):
+        for n, v in self.other_params.items():
+            self.plan.other[n]._data = v
+        self.plan.unstack_into_model(
+            {r: jnp.asarray(a) for r, a in self.block_params.items()})
+
+    def state_dict(self):
+        self.sync_to_model()
+        return self.adapter.model.state_dict()
