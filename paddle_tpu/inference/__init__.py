@@ -1,0 +1,302 @@
+"""paddle.inference parity — the standalone inference engine.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:82
+(`AnalysisPredictor`), paddle_inference_api.h (`Config`/`Predictor`/
+`PredictorPool`), api/details zero-copy tensors.
+
+TPU-native design: instead of a ProgramDesc + IR-pass pipeline + NaiveExecutor,
+the deployable artifact is a `jax.export` serialized StableHLO module with the
+weights folded in as constants (the analysis passes' constant-folding /
+fusion role is played by XLA itself at AOT-compile time).  `Predictor.run`
+executes the deserialized module; input/output handles give the zero-copy
+copy_from_cpu / copy_to_cpu API of the reference.
+
+Artifacts are produced by `paddle_tpu.jit.save(..., input_spec=...)` or
+`paddle_tpu.static.save_inference_model(...)`, both of which write
+`<prefix>.pdexported` next to the params/meta files.
+"""
+import os
+import pickle
+
+import numpy as np
+
+__all__ = [
+    "Config", "Predictor", "PredictorPool", "create_predictor",
+    "InferTensor", "PlaceType",
+]
+
+
+class PlaceType:
+    """Ref: paddle_inference_api PaddlePlace."""
+    kUNK = -1
+    kCPU = 0
+    kTPU = 4
+
+
+class Config:
+    """AnalysisConfig parity (inference/api/paddle_analysis_config.h).
+
+    Device/optimization knobs that have no TPU meaning (MKLDNN, TensorRT,
+    GPU memory pool) are accepted and recorded so reference configs run
+    unchanged; XLA owns fusion and memory planning.
+    """
+
+    def __init__(self, model_dir=None, params_file=None):
+        if model_dir and params_file:
+            # two-file form: (model_file, params_file) prefixes
+            self._prefix = model_dir[:-len(".pdmodel")] if \
+                model_dir.endswith(".pdmodel") else model_dir
+        else:
+            self._prefix = model_dir
+        self._device = "tpu"
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_threads = 1
+        self._settings = {}
+
+    # --- model location ---
+    def set_model(self, model_path, params_path=None):
+        self._prefix = model_path[:-len(".pdmodel")] if \
+            model_path.endswith(".pdmodel") else model_path
+
+    def model_dir(self):
+        return self._prefix
+
+    def set_prog_file(self, path):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # --- device selection ---
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # GPU request maps to the accelerator we actually have
+        self._device = "tpu"
+
+    def use_gpu(self):
+        return False
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = int(n)
+
+    # --- optimization toggles (XLA decides; recorded for parity) ---
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = bool(flag)
+
+    def switch_use_feed_fetch_ops(self, flag=False):
+        self._settings["use_feed_fetch_ops"] = bool(flag)
+
+    def switch_specify_input_names(self, flag=True):
+        self._settings["specify_input_names"] = bool(flag)
+
+    def enable_mkldnn(self):
+        self._settings["mkldnn"] = True
+
+    def enable_tensorrt_engine(self, **kwargs):
+        self._settings["tensorrt"] = kwargs
+
+    def summary(self):
+        return {
+            "model": self._prefix, "device": self._device,
+            "ir_optim": self._ir_optim, **self._settings,
+        }
+
+
+def _fix_model_path(config):
+    if isinstance(config, str):
+        c = Config(config)
+        return c
+    return config
+
+
+class InferTensor:
+    """Zero-copy input/output handle.
+
+    Ref: paddle_infer::Tensor (inference/api/paddle_tensor.h) —
+    copy_from_cpu / copy_to_cpu / reshape / shape / type.
+    """
+
+    def __init__(self, name, aval=None):
+        self.name = name
+        self._aval = aval
+        self._value = None
+
+    def reshape(self, shape):
+        # kept for API parity; the exported module has static shapes, so
+        # the reshape must match the exported aval (checked at run time)
+        self._shape = tuple(shape)
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def share_external_data(self, arr):
+        self._value = arr
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        if self._value is not None:
+            return list(np.asarray(self._value).shape)
+        return list(self._aval.shape) if self._aval is not None else []
+
+    def type(self):
+        if self._aval is not None:
+            return np.dtype(self._aval.dtype).name
+        return None
+
+
+class Predictor:
+    """AnalysisPredictor parity: load artifact → AOT module → run.
+
+    Loading order:
+      1. `<prefix>.pdexported` — jax.export bytes (weights inlined): the
+         deployable path.
+      2. `<prefix>.pdiparams` + a Layer class via `layer_cls=` — rebuild
+         and jit the forward (development convenience).
+    """
+
+    def __init__(self, config, layer_cls=None, layer_args=None):
+        config = _fix_model_path(config)
+        self._config = config
+        prefix = config.model_dir()
+        self._exported = None
+        self._layer = None
+        meta = {}
+        if prefix and os.path.exists(prefix + ".pdmodel"):
+            with open(prefix + ".pdmodel", "rb") as f:
+                meta = pickle.load(f)
+        self._meta = meta
+        if prefix and os.path.exists(prefix + ".pdexported"):
+            from jax import export as jax_export
+
+            with open(prefix + ".pdexported", "rb") as f:
+                self._exported = jax_export.deserialize(bytearray(f.read()))
+            self._in_names = meta.get(
+                "feed_names",
+                [f"x{i}" for i in range(len(self._exported.in_avals))])
+            self._out_names = meta.get(
+                "fetch_names",
+                [f"out{i}" for i in range(len(self._exported.out_avals))])
+            self._in_avals = list(self._exported.in_avals)
+        elif layer_cls is not None:
+            import jax
+
+            from ..core.tensor import _wrap_data
+            from ..core import autograd
+
+            layer = layer_cls(*(layer_args or ()))
+            with open(prefix + ".pdiparams", "rb") as f:
+                state = pickle.load(f)
+            layer.set_state_dict(state)
+            layer.eval()
+            self._layer = layer
+            params = layer.param_arrays()
+
+            def fwd(*xs):
+                with autograd.no_grad():
+                    out = layer.functional_call(params,
+                                                *[_wrap_data(x) for x in xs])
+                if isinstance(out, (list, tuple)):
+                    return tuple(o._data for o in out)
+                return (out._data,)
+
+            self._jitted = jax.jit(fwd)
+            n_in = len(meta.get("input_shapes", [1]))
+            self._in_names = meta.get("feed_names",
+                                      [f"x{i}" for i in range(n_in)])
+            self._out_names = meta.get("fetch_names", ["out0"])
+            self._in_avals = [None] * len(self._in_names)
+        else:
+            raise RuntimeError(
+                f"no loadable inference artifact at prefix {prefix!r}: "
+                f"need {prefix}.pdexported (from jit.save / "
+                f"save_inference_model) or a layer_cls to rebuild from params")
+        self._inputs = {n: InferTensor(n, a)
+                        for n, a in zip(self._in_names, self._in_avals)}
+        self._outputs = {n: InferTensor(n) for n in self._out_names}
+
+    # --- reference API ---
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Execute.  With `inputs` (list of arrays) returns outputs directly;
+        otherwise uses the copy_from_cpu'd input handles (reference calling
+        convention) and fills the output handles."""
+        if inputs is not None:
+            args = [np.asarray(a) for a in inputs]
+        else:
+            args = []
+            for n in self._in_names:
+                v = self._inputs[n]._value
+                if v is None:
+                    raise RuntimeError(
+                        f"input {n!r} not set; call "
+                        f"get_input_handle({n!r}).copy_from_cpu(...)")
+                args.append(np.asarray(v))
+        if self._exported is not None:
+            outs = self._exported.call(*args)
+        else:
+            outs = self._jitted(*args)
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        res = [np.asarray(o) for o in outs]
+        for n, o in zip(self._out_names, res):
+            self._outputs[n]._value = o
+        return res if inputs is not None else True
+
+    def clone(self):
+        p = Predictor.__new__(Predictor)
+        p.__dict__.update(self.__dict__)
+        p._inputs = {n: InferTensor(n, a)
+                     for n, a in zip(self._in_names, self._in_avals)}
+        p._outputs = {n: InferTensor(n) for n in self._out_names}
+        return p
+
+
+def create_predictor(config, **kwargs):
+    """Ref: CreatePaddlePredictor analysis_predictor.h:62."""
+    return Predictor(config, **kwargs)
+
+
+class PredictorPool:
+    """Pool of cloned predictors (api/paddle_inference_api.h).  As in the
+    reference, each slot is owned by one caller thread: retrieve a distinct
+    index per thread; the predictors share the loaded module but have
+    independent input/output handles."""
+
+    def __init__(self, config, size=1):
+        base = Predictor(config)
+        self._preds = [base] + [base.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+    def size(self):
+        return len(self._preds)

@@ -16,6 +16,55 @@ from ..core.tensor import Tensor, to_tensor, _wrap_data
 from ..nn.layer import Layer
 
 
+def build_input_avals(shapes, dtypes):
+    """ShapeDtypeStructs for export; -1/None dims become jax.export symbolic
+    dims so the AOT module stays batch-polymorphic.  Returns (avals, dynamic)
+    where dynamic says whether any symbolic dim was used."""
+    from jax import export as jax_export
+
+    avals, n_sym, dynamic = [], 0, False
+    for shape, dtype in zip(shapes, dtypes):
+        dims = []
+        for d in shape:
+            if d is None or (isinstance(d, int) and d < 0):
+                (sym,) = jax_export.symbolic_shape(f"_d{n_sym}")
+                n_sym += 1
+                dims.append(sym)
+                dynamic = True
+            else:
+                dims.append(int(d))
+        avals.append(jax.ShapeDtypeStruct(
+            tuple(dims), np.dtype(dtype if isinstance(dtype, str) else dtype)))
+    return avals, dynamic
+
+
+def write_exported(fn, avals, prefix):
+    """AOT-export `fn` at `avals` and atomically write `<prefix>.pdexported`.
+
+    Returns None on success, else the error string.  A failed export removes
+    any stale artifact at the prefix so a Predictor can never silently load
+    a previous save's weights.
+    """
+    from jax import export as jax_export
+
+    target = prefix + ".pdexported"
+    try:
+        try:
+            exp = jax_export.export(
+                jax.jit(fn), platforms=["cpu", "tpu"])(*avals)
+        except Exception:
+            exp = jax_export.export(jax.jit(fn))(*avals)
+        tmp = target + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(exp.serialize())
+        os.replace(tmp, target)
+        return None
+    except Exception as e:
+        if os.path.exists(target):
+            os.remove(target)
+        return str(e)
+
+
 def save(layer, path, input_spec=None, **configs):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
@@ -44,19 +93,41 @@ def save(layer, path, input_spec=None, **configs):
                     return tuple(o._data for o in out)
                 return out._data
 
-            shaped = [
+            shaped, _ = build_input_avals(
+                [s.shape for s in specs], [s.dtype for s in specs])
+            concrete = [
                 jax.ShapeDtypeStruct(
-                    tuple(abs(d) if d and d > 0 else 1 for d in s.shape),
-                    np.dtype(s.dtype if isinstance(s.dtype, str) else s.dtype),
-                )
+                    tuple(d if isinstance(d, int) and d > 0 else 1
+                          for d in s.shape),
+                    np.dtype(s.dtype if isinstance(s.dtype, str) else s.dtype))
                 for s in specs
             ]
             params_sd = {k: jax.ShapeDtypeStruct(v._data.shape, v._data.dtype)
                          for k, v in named.items()}
-            lowered = jax.jit(pure).lower(params_sd, *shaped)
+            lowered = jax.jit(pure).lower(params_sd, *concrete)
             meta["stablehlo"] = lowered.as_text()
             meta["input_shapes"] = [list(s.shape) for s in specs]
             meta["input_dtypes"] = [str(s.dtype) for s in specs]
+
+            # deployable AOT artifact for paddle_tpu.inference.Predictor:
+            # weights folded in as constants, inputs are the spec tensors
+            params_live = {k: v._data for k, v in named.items()}
+
+            def deploy(*xs):
+                return pure(params_live, *xs)
+
+            err = write_exported(deploy, shaped, path)
+            if err is not None:
+                # symbolic-dim export can fail on shape-dependent models;
+                # retry with dynamic dims pinned to 1
+                err = write_exported(deploy, concrete, path)
+                if err is None:
+                    meta["pinned_dynamic_dims"] = True
+                else:
+                    meta["export_error"] = err
+            meta["feed_names"] = [
+                getattr(s, "name", None) or f"x{i}"
+                for i, s in enumerate(specs)]
         except Exception as e:  # export is best-effort; params always saved
             meta["export_error"] = str(e)
     with open(path + ".pdmodel", "wb") as f:

@@ -29,6 +29,24 @@ from ..core import autograd, random as _random
 from .sharding_annotations import mesh_context
 
 
+def make_fused_update(optimizer):
+    """Flat-param optimizer update with the weight-decay convention baked in
+    (L2-style grad add for coupled decay, AdamW post-update subtract for
+    decoupled).  Shared by the hybrid and pipeline compiled steps."""
+    wd = optimizer._weight_decay_coeff()
+    decoupled = optimizer._decoupled_weight_decay
+
+    def fused_update(pflat, gflat, state, lr):
+        if wd and not decoupled:
+            gflat = gflat + wd * pflat
+        new_p, new_state = optimizer.update(pflat, gflat, state, lr)
+        if wd and decoupled:
+            new_p = new_p - lr * wd * pflat
+        return new_p, new_state
+
+    return fused_update
+
+
 def _clean_spec(spec, mesh, shape):
     """Validate a dist spec against the mesh: unknown axes or non-divisible
     dims fall back to replication."""
@@ -154,16 +172,7 @@ class CompiledTrainStep:
         if self.remat:
             local_loss = jax.checkpoint(local_loss)
 
-        wd = optimizer._weight_decay_coeff()
-        decoupled = optimizer._decoupled_weight_decay
-
-        def fused_update(pflat, gflat, state, lr):
-            if wd and not decoupled:
-                gflat = gflat + wd * pflat
-            new_p, new_state = optimizer.update(pflat, gflat, state, lr)
-            if wd and decoupled:
-                new_p = new_p - lr * wd * pflat
-            return new_p, new_state
+        fused_update = make_fused_update(optimizer)
 
         def spmd_step(params, flat_state, batch_vals, key, lr):
             if dp_axis is not None:

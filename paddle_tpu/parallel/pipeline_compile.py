@@ -272,16 +272,9 @@ class PipelinedTrainStep:
                 tick, (x0, jnp.float32(0.0)), jnp.arange(M + S - 1))
             return loss_sum / M
 
-        wd = optimizer._weight_decay_coeff()
-        decoupled = optimizer._decoupled_weight_decay
+        from .hybrid import make_fused_update
 
-        def fused_update(pflat, gflat, state, lr):
-            if wd and not decoupled:
-                gflat = gflat + wd * pflat
-            new_p, new_state = optimizer.update(pflat, gflat, state, lr)
-            if wd and decoupled:
-                new_p = new_p - lr * wd * pflat
-            return new_p, new_state
+        fused_update = make_fused_update(optimizer)
 
         def spmd_step(other, blocks, st_other, st_block, ids, labels, key,
                       lr):

@@ -54,6 +54,40 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
         pickle.dump(meta, f)
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump(params, f)
+
+    # deployable AOT artifact (paddle_tpu.inference.Predictor): the lowered
+    # block with params folded in as constants — the analysis-pass +
+    # NaiveExecutor role of the reference collapses into one XLA AOT module
+    try:
+        from .executor import CompiledBlock
+        from ..jit.save_load import build_input_avals, write_exported
+
+        feed_names = meta["feed_names"]
+        cb = CompiledBlock(program, feed_names, meta["fetch_names"], scope)
+        params_live = {n: jnp.asarray(scope.get(n)) for n in cb.param_names}
+
+        def deploy(*xs):
+            outs, _ = cb._run_block(dict(zip(feed_names, xs)), params_live)
+            return outs
+
+        shaped, dynamic = build_input_avals(
+            [v.shape for v in feed_vars], [v.dtype for v in feed_vars])
+        err = write_exported(deploy, shaped, path_prefix)
+        if err is not None and dynamic:
+            concrete, _ = build_input_avals(
+                [[d if isinstance(d, int) and d > 0 else 1 for d in v.shape]
+                 for v in feed_vars],
+                [v.dtype for v in feed_vars])
+            err = write_exported(deploy, concrete, path_prefix)
+            if err is None:
+                meta["pinned_dynamic_dims"] = True
+        if err is not None:
+            meta["export_error"] = err
+    except Exception as e:  # params+desc always saved; AOT is best-effort
+        meta["export_error"] = str(e)
+    if "export_error" in meta or "pinned_dynamic_dims" in meta:
+        with open(path_prefix + ".pdmodel", "wb") as f:
+            pickle.dump(meta, f)
     return program
 
 

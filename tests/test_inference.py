@@ -1,0 +1,127 @@
+"""Inference engine tests: Config/Predictor/PredictorPool over artifacts from
+jit.save (dygraph) and save_inference_model (static).
+
+Ref test strategy: the reference exercises AnalysisPredictor via
+save_inference_model round-trips (SURVEY §3.6).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_predictor_from_jit_save(tmp_path):
+    paddle.seed(0)
+    net = TinyNet()
+    net.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8).astype("float32"))
+    want = net(x).numpy()
+
+    prefix = str(tmp_path / "tiny")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([2, 8], "float32", name="x")])
+
+    config = inference.Config(prefix)
+    config.enable_memory_optim()
+    pred = inference.create_predictor(config)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x.numpy())
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    # direct run(list) convenience
+    out2 = pred.run([x.numpy()])[0]
+    np.testing.assert_allclose(out2, want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_from_static_save_inference_model(tmp_path):
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data(name="x", shape=[3, 8], dtype="float32")
+            y = static.nn.fc(x, size=4)
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(1).randn(3, 8).astype("float32")
+        want = exe.run(main, feed={"x": xv}, fetch_list=[y])[0]
+
+        prefix = str(tmp_path / "stat")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+    finally:
+        paddle.disable_static()
+
+    pred = inference.Predictor(inference.Config(prefix))
+    assert pred.get_input_names() == ["x"]
+    out = pred.run([xv])[0]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_pool_and_clone(tmp_path):
+    paddle.seed(1)
+    net = TinyNet()
+    net.eval()
+    prefix = str(tmp_path / "pool")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([1, 8], "float32")])
+    pool = inference.PredictorPool(inference.Config(prefix), size=3)
+    assert pool.size() == 3
+    xv = np.ones((1, 8), np.float32)
+    outs = [pool.retrieve(i).run([xv])[0] for i in range(3)]
+    np.testing.assert_allclose(outs[0], outs[1])
+    np.testing.assert_allclose(outs[0], outs[2])
+
+
+def test_dynamic_batch_dim(tmp_path):
+    """-1 dims export as symbolic: one artifact serves any batch size."""
+    paddle.seed(2)
+    net = TinyNet()
+    net.eval()
+    prefix = str(tmp_path / "dyn")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([-1, 8], "float32", name="x")])
+    pred = inference.Predictor(inference.Config(prefix))
+    for b in (1, 5, 32):
+        xv = np.random.RandomState(b).randn(b, 8).astype("float32")
+        out = pred.run([xv])[0]
+        want = net(paddle.to_tensor(xv)).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_layer_cls_fallback(tmp_path):
+    """With only params on disk (no .pdexported), a layer_cls rebuilds."""
+    paddle.seed(3)
+    net = TinyNet()
+    net.eval()
+    prefix = str(tmp_path / "fb")
+    paddle.jit.save(net, prefix)  # no input_spec -> no AOT artifact
+    import os
+    assert not os.path.exists(prefix + ".pdexported")
+    pred = inference.Predictor(inference.Config(prefix), layer_cls=TinyNet)
+    xv = np.random.RandomState(7).randn(2, 8).astype("float32")
+    out = pred.run([xv])[0]
+    want = net(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_missing_artifact_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="no loadable inference artifact"):
+        inference.Predictor(inference.Config(str(tmp_path / "nope")))
