@@ -159,21 +159,35 @@ class Fleet:
         save_inference_model(os.path.join(dirname, "model"), feed_vars,
                              target_vars, executor, program=prog)
 
+    # ---- parameter-server lifecycle (fleet_base.py:533-607) ----
+    @property
+    def _ps_runtime(self):
+        if getattr(self, "_ps_runtime_obj", None) is None:
+            from ..ps import TheOnePSRuntime
+
+            self._ps_runtime_obj = TheOnePSRuntime(
+                self._role_maker, self._strategy)
+        return self._ps_runtime_obj
+
     def init_worker(self):
-        pass
+        return self._ps_runtime.init_worker()
 
     def init_server(self, *args, **kwargs):
-        pass
+        return self._ps_runtime.init_server(*args, **kwargs)
 
     def run_server(self):
-        raise NotImplementedError(
-            "brpc parameter-server mode is intentionally absent in the "
-            "TPU-native build (SURVEY §5.8: no brpc parity needed for v1; "
-            "use mesh data parallelism instead)"
-        )
+        self._ps_runtime.run_server()
 
     def stop_worker(self):
-        pass
+        self._ps_runtime.stop_worker()
+
+    @property
+    def communicator(self):
+        return self._ps_runtime.communicator
+
+    @property
+    def ps_client(self):
+        return self._ps_runtime.client
 
     @property
     def util(self):

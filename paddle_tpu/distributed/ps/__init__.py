@@ -1,0 +1,65 @@
+"""Parameter-server mode (CPU-cluster training / giant embeddings).
+
+Reference: paddle/fluid/distributed/ (brpc PS core), fleet/runtime/the_one_ps.py
+(TheOnePSRuntime).  See table.py / service.py / communicator.py for the
+TPU-native design notes.
+"""
+from .table import DenseTable, SparseTable, BarrierTable  # noqa: F401
+from .service import PSServer, PSClient  # noqa: F401
+from .communicator import Communicator  # noqa: F401
+from .embedding import DistributedEmbedding  # noqa: F401
+
+
+class TheOnePSRuntime:
+    """fleet/runtime/the_one_ps.py:434 parity: materialize the server or the
+    worker side of PS mode from the fleet role."""
+
+    def __init__(self, role_maker, strategy=None):
+        self.role_maker = role_maker
+        self.strategy = strategy
+        self.server = None
+        self.client = None
+        self.communicator = None
+
+    def _server_endpoints(self):
+        return self.role_maker.get_pserver_endpoints()
+
+    def init_server(self, *args, **kwargs):
+        eps = self._server_endpoints()
+        idx = self.role_maker.server_index()
+        self.server = PSServer(
+            eps[idx], server_index=idx, num_servers=len(eps),
+            trainers=self.role_maker.worker_num())
+        return self.server
+
+    def run_server(self):
+        self.server.start(block=False)
+        self.server.wait()
+
+    def init_worker(self):
+        eps = self._server_endpoints()
+        mode = "async"
+        if self.strategy is not None:
+            a_sync = getattr(self.strategy, "a_sync", True)
+            k = (getattr(self.strategy, "a_sync_configs", None)
+                 or {}).get("k_steps", 0)
+            mode = "geo" if (a_sync and k > 0) else (
+                "async" if a_sync else "sync")
+            geo_k = max(int(k), 1)
+        else:
+            geo_k = 4
+        self.client = PSClient(eps)
+        self.client.ping()
+        self.communicator = Communicator(
+            self.client, mode=mode,
+            n_workers=self.role_maker.worker_num(), geo_k=geo_k)
+        return self.communicator
+
+    def stop_worker(self):
+        if self.communicator is not None:
+            self.communicator.flush()
+            self.communicator.stop()
+        if self.client is not None:
+            if self.role_maker.is_first_worker():
+                self.client.stop_server()
+            self.client.close()

@@ -1,0 +1,119 @@
+"""Client-side gradient communicator: sync / async / geo modes.
+
+Reference: paddle/fluid/distributed/service/communicator.h —
+`AsyncCommunicator` (background send queue), `SyncCommunicator`
+(push-barrier-apply-pull per step), `GeoCommunicator` (push param deltas
+every k steps).  The a_sync / a_sync_configs knobs of
+DistributedStrategy (distributed_strategy.proto:159) select the mode.
+"""
+import threading
+import queue as _queue
+
+import numpy as np
+
+
+class Communicator:
+    """Drives a PSClient for one worker's dense params.
+
+    mode: "sync"  — push grads, barrier, server applies avg, pull fresh
+          "async" — push grads (server applies immediately), pull fresh;
+                    pushes ride a background thread (send_queue)
+          "geo"   — train locally; every `geo_k` steps push (local - synced)
+                    delta scaled by 1/n_workers and pull the merged global
+    """
+
+    def __init__(self, client, mode="async", n_workers=1, geo_k=4):
+        assert mode in ("sync", "async", "geo")
+        self.client = client
+        self.mode = mode
+        self.n_workers = n_workers
+        self.geo_k = geo_k
+        self._step = 0
+        self._synced = {}  # geo: name -> param snapshot at last sync
+        self._send_q = _queue.Queue()
+        self._sender = None
+        self._stop = threading.Event()
+        if mode == "async":
+            self._sender = threading.Thread(target=self._send_loop,
+                                            daemon=True)
+            self._sender.start()
+
+    # --- param lifecycle ---
+    def init_params(self, params, lr=0.01, optimizer="sgd", trainer_id=0):
+        """Create tables; trainer 0 seeds initial values; everyone pulls."""
+        for name, value in params.items():
+            value = np.asarray(value)
+            self.client.create_dense_table(
+                name, value.shape, dtype=str(value.dtype), lr=lr,
+                optimizer=optimizer)
+            if trainer_id == 0:
+                self.client.set_dense(name, value)
+        self.client.barrier()
+        fresh = {n: self.client.pull_dense(n) for n in params}
+        if self.mode == "geo":
+            self._synced = {n: v.copy() for n, v in fresh.items()}
+        return fresh
+
+    # --- per-step ---
+    def push_and_pull(self, grads=None, local_params=None):
+        """One training step's communication.  Returns fresh params to use
+        (None means keep training on local params — geo off-sync steps)."""
+        self._step += 1
+        if self.mode == "sync":
+            for n, g in grads.items():
+                self.client.push_dense(n, g, apply_now=False)
+            if not self.client.barrier():
+                raise RuntimeError("sync-mode barrier timed out: a worker "
+                                   "is missing or stalled")
+            for n in grads:
+                # every worker calls apply; the accumulator is cleared by the
+                # first, later calls are no-ops (server-side idempotent)
+                self.client.apply_dense(n, self.n_workers)
+            if not self.client.barrier():
+                raise RuntimeError("sync-mode barrier timed out: a worker "
+                                   "is missing or stalled")
+            return {n: self.client.pull_dense(n) for n in grads}
+        if self.mode == "async":
+            for n, g in grads.items():
+                self._send_q.put((n, np.asarray(g)))
+            return {n: self.client.pull_dense(n) for n in grads}
+        # geo
+        assert local_params is not None, "geo mode needs local params"
+        if self._step % self.geo_k != 0:
+            return None
+        fresh = {}
+        for n, p in local_params.items():
+            delta = np.asarray(p) - self._synced[n]
+            self.client.push_dense_delta(n, delta, 1.0 / self.n_workers)
+            fresh[n] = self.client.pull_dense(n)
+            self._synced[n] = fresh[n].copy()
+        return fresh
+
+    def _send_loop(self):
+        while not self._stop.is_set():
+            try:
+                n, g = self._send_q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            try:
+                self.client.push_dense(n, g, apply_now=True)
+            except (RuntimeError, ConnectionError, OSError) as e:
+                # record and keep consuming: flush() must never deadlock on
+                # a dead sender, and the training loop gets the error there
+                if not self._stop.is_set():
+                    self._error = e
+            finally:
+                self._send_q.task_done()
+
+    def flush(self):
+        if self.mode == "async":
+            self._send_q.join()
+            err = getattr(self, "_error", None)
+            if err is not None:
+                self._error = None
+                raise RuntimeError(f"async gradient push failed: {err}")
+
+    def stop(self):
+        self._stop.set()
+        if self._sender is not None:
+            self._sender.join(timeout=5)

@@ -1,0 +1,61 @@
+"""Distributed (PS-resident) embedding lookup.
+
+Reference: operators/pscore/distributed_lookup_table op +
+`paddle.static.nn.sparse_embedding` — the embedding table lives on the
+parameter servers; each step pulls the touched rows, computes on-device, and
+pushes the row gradients back.
+
+TPU-native shape: the pulled rows enter the jax graph as a leaf tensor, so
+the on-device backward produces a dense [n_ids, dim] row-gradient that
+`push_grad()` ships to the servers (the host<->PS transfer stays off the
+accelerator's critical path).
+"""
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+
+class DistributedEmbedding(Layer):
+    """Layer API over a PS sparse table.
+
+    Usage per step:
+        out = emb(ids)           # pulls rows, differentiable
+        loss.backward()
+        emb.push_grad()          # ships row grads to the PS
+    """
+
+    def __init__(self, client, table_name, emb_dim, lr=0.01,
+                 optimizer="adagrad"):
+        super().__init__()
+        self.client = client
+        self.table_name = table_name
+        self.emb_dim = int(emb_dim)
+        client.create_sparse_table(table_name, emb_dim, lr=lr,
+                                   optimizer=optimizer)
+        self._last = None  # (ids, rows_tensor)
+
+    def forward(self, ids):
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64)
+        flat = ids_np.ravel()
+        rows = self.client.pull_sparse(self.table_name, flat)
+        t = Tensor(rows.astype(np.float32), stop_gradient=False)
+        self._last = (flat, t)
+        # route gradients through the pulled-rows leaf
+        from ...ops.manipulation import reshape
+
+        return reshape(t, list(ids_np.shape) + [self.emb_dim])
+
+    def push_grad(self):
+        """Push the row gradients recorded by the last backward."""
+        if self._last is None:
+            return
+        flat, t = self._last
+        g = t.grad
+        if g is not None:
+            self.client.push_sparse(
+                self.table_name, flat,
+                np.asarray(g.numpy() if isinstance(g, Tensor) else g,
+                           np.float32).reshape(len(flat), self.emb_dim))
+        self._last = None

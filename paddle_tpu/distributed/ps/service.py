@@ -1,0 +1,346 @@
+"""PS RPC service: server + client over TCP.
+
+Reference: paddle/fluid/distributed/service/ — `BrpcPsServer`
+(brpc_ps_server.h), `BrpcPsClient` (brpc_ps_client.h), `sendrecv.proto`.
+TPU-native transport: length-prefixed pickled frames over stdlib TCP
+(numpy arrays ride pickle protocol 5 buffers); brpc's thread-pool server
+role is played by one thread per connection — the PS is a host-side
+control-plane service, the accelerator data plane never touches it.
+"""
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .table import BarrierTable, DenseTable, SparseTable
+
+_HDR = struct.Struct(">I")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class PSServer:
+    """One PS shard.  Handles table CRUD + barrier + save/load.
+
+    Dense params are sharded across servers by table (each dense table lives
+    whole on one shard, round-robin by name hash); sparse tables are sharded
+    by id range (`id % num_servers == server_index`), matching the
+    reference's table-sharding scheme (common_sparse_table.h).
+    """
+
+    def __init__(self, endpoint, server_index=0, num_servers=1, trainers=1):
+        self.host, port = endpoint.rsplit(":", 1)
+        self.port = int(port)
+        self.server_index = server_index
+        self.num_servers = num_servers
+        self.trainers = trainers
+        self._dense = {}
+        self._sparse = {}
+        self._barrier = BarrierTable(trainers)
+        self._lock = threading.Lock()
+        self._server = None
+        self._thread = None
+        self._stopped = threading.Event()
+
+    # --- table management (server side of init_params) ---
+    def _get_dense(self, name, create_args=None):
+        with self._lock:
+            t = self._dense.get(name)
+            if t is None and create_args is not None:
+                t = DenseTable(name, **create_args)
+                self._dense[name] = t
+            return t
+
+    def _get_sparse(self, name, create_args=None):
+        with self._lock:
+            t = self._sparse.get(name)
+            if t is None and create_args is not None:
+                t = SparseTable(name, **create_args)
+                self._sparse[name] = t
+            return t
+
+    def _handle(self, msg):
+        cmd = msg[0]
+        if cmd == "ping":
+            return ("ok", self.server_index)
+        if cmd == "create_dense":
+            _, name, args = msg
+            self._get_dense(name, args)
+            return ("ok",)
+        if cmd == "create_sparse":
+            _, name, args = msg
+            self._get_sparse(name, args)
+            return ("ok",)
+        if cmd == "set_dense":
+            _, name, value = msg
+            self._get_dense(name, {"shape": np.shape(value)}).set(value)
+            return ("ok",)
+        if cmd == "pull_dense":
+            _, name = msg
+            t = self._get_dense(name)
+            return ("ok", t.pull() if t else None)
+        if cmd == "push_dense":
+            _, name, grad, apply_now = msg
+            self._get_dense(name, {"shape": np.shape(grad)}).push(
+                grad, apply=apply_now)
+            return ("ok",)
+        if cmd == "push_dense_delta":
+            _, name, delta, scale = msg
+            self._get_dense(name, {"shape": np.shape(delta)}).add_delta(
+                delta, scale)
+            return ("ok",)
+        if cmd == "apply_dense":
+            _, name, n_workers = msg
+            t = self._get_dense(name)
+            if t is not None:
+                t.apply_accumulated(n_workers)
+            return ("ok",)
+        if cmd == "pull_sparse":
+            _, name, ids = msg
+            t = self._get_sparse(name)
+            return ("ok", t.pull(ids) if t else None)
+        if cmd == "push_sparse":
+            _, name, ids, grads = msg
+            t = self._get_sparse(name)
+            if t is not None:
+                t.push(ids, grads)
+            return ("ok",)
+        if cmd == "barrier":
+            ok = self._barrier.wait()
+            return ("ok", ok)
+        if cmd == "save":
+            _, dirname = msg
+            self.save(dirname)
+            return ("ok",)
+        if cmd == "load":
+            _, dirname = msg
+            self.load(dirname)
+            return ("ok",)
+        if cmd == "stop":
+            self._stopped.set()
+            return ("ok",)
+        return ("err", f"unknown cmd {cmd!r}")
+
+    # --- persistence (ssd_sparse_table / fleet.save_persistables role) ---
+    def save(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        with self._lock:
+            dense = {n: t.pull() for n, t in self._dense.items()}
+            sparse = {n: t.state_dict() for n, t in self._sparse.items()}
+        with open(os.path.join(
+                dirname, f"shard{self.server_index}.pkl"), "wb") as f:
+            pickle.dump({"dense": dense, "sparse": sparse}, f)
+
+    def load(self, dirname):
+        path = os.path.join(dirname, f"shard{self.server_index}.pkl")
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        for n, v in blob["dense"].items():
+            self._get_dense(n, {"shape": np.shape(v)}).set(v)
+        for n, rows in blob["sparse"].items():
+            dim = len(next(iter(rows.values()))) if rows else 8
+            self._get_sparse(n, {"emb_dim": dim}).load_state_dict(rows)
+
+    # --- lifecycle ---
+    def start(self, block=False):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        _send_msg(self.request, outer._handle(msg))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        if block:
+            self._stopped.wait()
+            self.shutdown()
+
+    def wait(self):
+        self._stopped.wait()
+        self.shutdown()
+
+    def shutdown(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class PSClient:
+    """BrpcPsClient parity: one connection per server shard.
+
+    Sharding rules mirror the server's: dense by name-hash, sparse ids by
+    `id % num_servers`.
+    """
+
+    def __init__(self, endpoints, connect_retries=100, retry_delay=0.1):
+        self.endpoints = list(endpoints)
+        self._socks = []
+        self._locks = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            last = None
+            for _ in range(connect_retries):
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=60)
+                    break
+                except OSError as e:  # server not up yet
+                    last = e
+                    time.sleep(retry_delay)
+            else:
+                raise ConnectionError(f"cannot reach ps server {ep}: {last}")
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+
+    @property
+    def num_servers(self):
+        return len(self.endpoints)
+
+    def _call(self, idx, *msg):
+        with self._locks[idx]:
+            _send_msg(self._socks[idx], msg)
+            resp = _recv_msg(self._socks[idx])
+        if resp[0] != "ok":
+            raise RuntimeError(f"ps error from {self.endpoints[idx]}: {resp}")
+        return resp[1] if len(resp) > 1 else None
+
+    def _dense_shard(self, name):
+        # stable across processes (hash() is salted per process)
+        return zlib.crc32(name.encode()) % self.num_servers
+
+    # --- dense ---
+    def create_dense_table(self, name, shape, **kwargs):
+        args = {"shape": tuple(shape), **kwargs}
+        self._call(self._dense_shard(name), "create_dense", name, args)
+
+    def set_dense(self, name, value):
+        self._call(self._dense_shard(name), "set_dense", name,
+                   np.asarray(value))
+
+    def pull_dense(self, name):
+        return self._call(self._dense_shard(name), "pull_dense", name)
+
+    def push_dense(self, name, grad, apply_now=False):
+        self._call(self._dense_shard(name), "push_dense", name,
+                   np.asarray(grad), apply_now)
+
+    def push_dense_delta(self, name, delta, scale=1.0):
+        self._call(self._dense_shard(name), "push_dense_delta", name,
+                   np.asarray(delta), scale)
+
+    def apply_dense(self, name, n_workers=None):
+        self._call(self._dense_shard(name), "apply_dense", name, n_workers)
+
+    # --- sparse ---
+    def create_sparse_table(self, name, emb_dim, **kwargs):
+        args = {"emb_dim": int(emb_dim), **kwargs}
+        for i in range(self.num_servers):
+            self._call(i, "create_sparse", name, args)
+
+    def pull_sparse(self, name, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        if self.num_servers == 1:
+            return self._call(0, "pull_sparse", name, ids)
+        out = np.zeros((len(ids),), object)
+        for s in range(self.num_servers):
+            mask = (ids % self.num_servers) == s
+            if not mask.any():
+                continue
+            rows = self._call(s, "pull_sparse", name, ids[mask])
+            out[np.nonzero(mask)[0]] = list(rows)
+        return np.stack(list(out))
+
+    def push_sparse(self, name, ids, grads):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32)
+        if self.num_servers == 1:
+            self._call(0, "push_sparse", name, ids, grads)
+            return
+        for s in range(self.num_servers):
+            mask = (ids % self.num_servers) == s
+            if mask.any():
+                self._call(s, "push_sparse", name, ids[mask], grads[mask])
+
+    # --- control ---
+    def barrier(self):
+        threads = []
+        results = [None] * self.num_servers
+
+        def one(i):
+            results[i] = self._call(i, "barrier")
+
+        for i in range(self.num_servers):
+            t = threading.Thread(target=one, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return all(results)
+
+    def save(self, dirname):
+        for i in range(self.num_servers):
+            self._call(i, "save", dirname)
+
+    def load(self, dirname):
+        for i in range(self.num_servers):
+            self._call(i, "load", dirname)
+
+    def stop_server(self):
+        for i in range(self.num_servers):
+            try:
+                self._call(i, "stop")
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def ping(self, retries=50, delay=0.1):
+        for i in range(self.num_servers):
+            for _ in range(retries):
+                try:
+                    self._call(i, "ping")
+                    break
+                except (ConnectionError, OSError):
+                    time.sleep(delay)
+        return True

@@ -1,0 +1,197 @@
+"""Parameter-server tables.
+
+Reference: paddle/fluid/distributed/table/ — `common_dense_table` (dense
+params + SGD/Adam appliers), `common_sparse_table` (sharded embedding rows,
+lazy-init), `barrier_table`.  TPU-native role: the PS is a CPU-side store for
+huge embedding tables and async CPU-cluster training; tables are numpy-backed
+(device compute stays on the chip, tables live in host memory exactly as the
+reference keeps them on the CPU server).
+"""
+import threading
+
+import numpy as np
+
+
+class _SGDApplier:
+    def __init__(self, lr):
+        self.lr = lr
+
+    def apply(self, param, grad):
+        param -= self.lr * grad
+        return param
+
+
+class _AdagradApplier:
+    """common_sparse_table's default accessor family (adagrad)."""
+
+    def __init__(self, lr, eps=1e-6):
+        self.lr = lr
+        self.eps = eps
+        self.g2 = None
+
+    def apply(self, param, grad):
+        if self.g2 is None or self.g2.shape != param.shape:
+            self.g2 = np.zeros_like(param)
+        self.g2 += grad * grad
+        param -= self.lr * grad / (np.sqrt(self.g2) + self.eps)
+        return param
+
+
+def _make_applier(optimizer, lr):
+    if optimizer == "adagrad":
+        return _AdagradApplier(lr)
+    return _SGDApplier(lr)
+
+
+class DenseTable:
+    """common_dense_table parity: one dense param block + grad accumulator.
+
+    sync mode: push accumulates; `apply_accumulated(n)` averages over the n
+    workers and applies once per step (the reference's sync communicator).
+    async/geo: `push(..., apply=True)` applies immediately.
+    """
+
+    def __init__(self, name, shape, dtype="float32", lr=0.01,
+                 optimizer="sgd", initializer=None):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        if initializer is not None:
+            self.param = np.asarray(initializer, dtype=self.dtype).reshape(
+                self.shape)
+        else:
+            self.param = np.zeros(self.shape, self.dtype)
+        self._applier = _make_applier(optimizer, lr)
+        self._acc = None
+        self._acc_count = 0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        with self._lock:
+            self.param = np.asarray(value, dtype=self.dtype).reshape(
+                self.shape)
+
+    def pull(self):
+        with self._lock:
+            return self.param.copy()
+
+    def push(self, grad, apply=False):
+        grad = np.asarray(grad, dtype=self.dtype).reshape(self.shape)
+        with self._lock:
+            if apply:
+                self.param = self._applier.apply(self.param, grad)
+            else:
+                if self._acc is None:
+                    self._acc = np.zeros(self.shape, self.dtype)
+                self._acc += grad
+                self._acc_count += 1
+
+    def apply_accumulated(self, n_workers=None):
+        with self._lock:
+            if self._acc is None or self._acc_count == 0:
+                return
+            n = n_workers or self._acc_count
+            self.param = self._applier.apply(self.param, self._acc / n)
+            self._acc = None
+            self._acc_count = 0
+
+    def add_delta(self, delta, scale=1.0):
+        """geo-SGD merge: param += scale * delta (communicator geo mode)."""
+        with self._lock:
+            self.param += scale * np.asarray(delta, self.dtype).reshape(
+                self.shape)
+
+
+class SparseTable:
+    """common_sparse_table parity: id -> embedding row, lazy-initialized.
+
+    Rows materialize on first pull (the reference's create-on-pull accessor);
+    per-row adagrad state keeps hot and cold ids on independent schedules.
+    """
+
+    def __init__(self, name, emb_dim, lr=0.01, optimizer="adagrad",
+                 init_scale=0.01, seed=0):
+        self.name = name
+        self.emb_dim = int(emb_dim)
+        self.lr = lr
+        self.optimizer = optimizer
+        self.init_scale = init_scale
+        self._rng = np.random.RandomState(seed)
+        self._rows = {}
+        self._g2 = {}
+        self._lock = threading.Lock()
+
+    def _row(self, i):
+        r = self._rows.get(i)
+        if r is None:
+            r = (self._rng.randn(self.emb_dim) * self.init_scale).astype(
+                np.float32)
+            self._rows[i] = r
+        return r
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads, apply=True):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.emb_dim)
+        # aggregate duplicate ids before applying (reference: merge_add)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        agg = np.zeros((len(uniq), self.emb_dim), np.float32)
+        np.add.at(agg, inv, grads)
+        with self._lock:
+            for k, i in enumerate(uniq):
+                i = int(i)
+                row = self._row(i)
+                g = agg[k]
+                if self.optimizer == "adagrad":
+                    g2 = self._g2.get(i)
+                    if g2 is None:
+                        g2 = np.zeros(self.emb_dim, np.float32)
+                    g2 += g * g
+                    self._g2[i] = g2
+                    row -= self.lr * g / (np.sqrt(g2) + 1e-6)
+                else:
+                    row -= self.lr * g
+
+    def size(self):
+        with self._lock:
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._lock:
+            return {int(k): v.copy() for k, v in self._rows.items()}
+
+    def load_state_dict(self, rows):
+        with self._lock:
+            self._rows = {int(k): np.asarray(v, np.float32)
+                          for k, v in rows.items()}
+
+
+class BarrierTable:
+    """barrier_table parity: blocks until `trainers` workers arrive."""
+
+    def __init__(self, trainers):
+        self.trainers = trainers
+        self._cond = threading.Condition()
+        self._count = 0
+        self._generation = 0
+
+    def wait(self, timeout=60.0):
+        with self._cond:
+            gen = self._generation
+            self._count += 1
+            if self._count >= self.trainers:
+                self._count = 0
+                self._generation += 1
+                self._cond.notify_all()
+                return True
+            ok = self._cond.wait_for(
+                lambda: self._generation != gen, timeout=timeout)
+            if not ok:
+                # withdraw from the round so a late arrival can't release a
+                # barrier with fewer live participants than `trainers`
+                self._count = max(self._count - 1, 0)
+            return ok

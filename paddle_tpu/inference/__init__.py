@@ -42,12 +42,11 @@ class Config:
     """
 
     def __init__(self, model_dir=None, params_file=None):
-        if model_dir and params_file:
-            # two-file form: (model_file, params_file) prefixes
-            self._prefix = model_dir[:-len(".pdmodel")] if \
-                model_dir.endswith(".pdmodel") else model_dir
-        else:
-            self._prefix = model_dir
+        self._prefix = model_dir[:-len(".pdmodel")] if \
+            (model_dir or "").endswith(".pdmodel") else model_dir
+        # two-file form: an independent params path (reference allows the
+        # params file to live anywhere)
+        self._params_path = params_file
         self._device = "tpu"
         self._ir_optim = True
         self._memory_optim = True
@@ -58,6 +57,8 @@ class Config:
     def set_model(self, model_path, params_path=None):
         self._prefix = model_path[:-len(".pdmodel")] if \
             model_path.endswith(".pdmodel") else model_path
+        if params_path is not None:
+            self._params_path = params_path
 
     def model_dir(self):
         return self._prefix
@@ -70,7 +71,7 @@ class Config:
         return (self._prefix or "") + ".pdmodel"
 
     def params_file(self):
-        return (self._prefix or "") + ".pdiparams"
+        return self._params_path or (self._prefix or "") + ".pdiparams"
 
     # --- device selection ---
     def enable_tpu(self):
@@ -138,9 +139,9 @@ class InferTensor:
         self._value = None
 
     def reshape(self, shape):
-        # kept for API parity; the exported module has static shapes, so
-        # the reshape must match the exported aval (checked at run time)
-        self._shape = tuple(shape)
+        # API parity only: the exported module's shapes are fixed at export
+        # time; actual validation happens against the aval in Predictor.run
+        pass
 
     def copy_from_cpu(self, arr):
         self._value = np.ascontiguousarray(arr)
@@ -202,7 +203,7 @@ class Predictor:
             from ..core import autograd
 
             layer = layer_cls(*(layer_args or ()))
-            with open(prefix + ".pdiparams", "rb") as f:
+            with open(config.params_file(), "rb") as f:
                 state = pickle.load(f)
             layer.set_state_dict(state)
             layer.eval()
@@ -261,6 +262,20 @@ class Predictor:
                         f"get_input_handle({n!r}).copy_from_cpu(...)")
                 args.append(np.asarray(v))
         if self._exported is not None:
+            for n, aval, a in zip(self._in_names, self._in_avals, args):
+                if aval is None:
+                    continue
+                want = aval.shape
+                got = a.shape
+                ok = len(want) == len(got) and all(
+                    not isinstance(w, int) or w == g
+                    for w, g in zip(want, got))
+                if not ok:
+                    raise ValueError(
+                        f"input {n!r} has shape {got}, but the exported "
+                        f"module expects {want} (symbolic dims accept any "
+                        f"size; re-save with -1 dims in the InputSpec for "
+                        f"batch polymorphism)")
             outs = self._exported.call(*args)
         else:
             outs = self._jitted(*args)

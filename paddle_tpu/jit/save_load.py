@@ -67,6 +67,10 @@ def write_exported(fn, avals, prefix):
 
 def save(layer, path, input_spec=None, **configs):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # a save that doesn't (re-)export must not leave an older AOT artifact
+    # behind — Predictor prefers .pdexported over fresh params
+    if os.path.exists(path + ".pdexported"):
+        os.remove(path + ".pdexported")
     state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
     meta = {
         "class_name": type(layer).__name__,
@@ -93,7 +97,7 @@ def save(layer, path, input_spec=None, **configs):
                     return tuple(o._data for o in out)
                 return out._data
 
-            shaped, _ = build_input_avals(
+            shaped, dynamic = build_input_avals(
                 [s.shape for s in specs], [s.dtype for s in specs])
             concrete = [
                 jax.ShapeDtypeStruct(
@@ -117,14 +121,14 @@ def save(layer, path, input_spec=None, **configs):
                 return pure(params_live, *xs)
 
             err = write_exported(deploy, shaped, path)
-            if err is not None:
+            if err is not None and dynamic:
                 # symbolic-dim export can fail on shape-dependent models;
                 # retry with dynamic dims pinned to 1
                 err = write_exported(deploy, concrete, path)
                 if err is None:
                     meta["pinned_dynamic_dims"] = True
-                else:
-                    meta["export_error"] = err
+            if err is not None:
+                meta["export_error"] = err
             meta["feed_names"] = [
                 getattr(s, "name", None) or f"x{i}"
                 for i, s in enumerate(specs)]

@@ -58,6 +58,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     # deployable AOT artifact (paddle_tpu.inference.Predictor): the lowered
     # block with params folded in as constants — the analysis-pass +
     # NaiveExecutor role of the reference collapses into one XLA AOT module
+    if os.path.exists(path_prefix + ".pdexported"):
+        os.remove(path_prefix + ".pdexported")  # never serve stale weights
     try:
         from .executor import CompiledBlock
         from ..jit.save_load import build_input_avals, write_exported
