@@ -14,6 +14,13 @@ from .param_helper import create_parameter  # noqa: F401
 from . import nn_static as nn  # noqa: F401
 from .io import save_inference_model, load_inference_model, save, load  # noqa: F401
 from .amp_static import amp_decorate  # noqa: F401
+from .controlflow import cond, while_loop, switch_case, case  # noqa: F401
+
+# reference exposes control flow under paddle.static.nn as well
+nn.cond = cond
+nn.while_loop = while_loop
+nn.switch_case = switch_case
+nn.case = case
 
 
 class InputSpec:

@@ -34,6 +34,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     for v in block.vars.values():
         if v.is_parameter and not v.stop_gradient and v.name not in no_grad:
             requires.add(v.name)
+    # explicit targets (paddle.static.gradients wrt arbitrary vars)
+    for p in parameter_list or ():
+        name = p.name if isinstance(p, Variable) else p
+        if name not in no_grad:
+            requires.add(name)
     for op in ops:
         if op.fn is None:
             continue

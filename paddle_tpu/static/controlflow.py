@@ -1,0 +1,260 @@
+"""Static-graph control flow: cond / while_loop / switch_case / case.
+
+Reference: paddle/fluid/operators/controlflow/ — `conditional_block_op`
+(two sub-blocks selected by a scalar pred), `while_op` (sub-block run until
+cond var is false), `switch/case` Python sugar (fluid/layers/control_flow.py).
+
+TPU-native lowering: each branch/body is recorded into a nested BlockDesc of
+the same Program (parity with the reference's sub-block representation), then
+the single emitted parent op lowers the sub-block to a pure jax function and
+dispatches with `lax.cond` / `lax.while_loop` / `lax.switch` — compiled,
+trace-once control flow instead of the reference's host-side sub-scope
+execution (SURVEY §7.1: compiler-friendly control flow).
+"""
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from .program import Block, Variable, default_main_program
+from .nn_static import emit
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+@contextlib.contextmanager
+def _sub_block(program=None):
+    """Append a nested block and make it current while building a branch."""
+    program = program or default_main_program()
+    parent_idx = program.current_block_idx
+    blk = Block(program, len(program.blocks), parent_idx=parent_idx)
+    program.blocks.append(blk)
+    program.current_block_idx = blk.idx
+    try:
+        yield blk
+    finally:
+        program.current_block_idx = parent_idx
+
+
+def _block_fn(blk, out_names, cap_names):
+    """Lower a recorded sub-block to: captures-tuple -> outputs-tuple."""
+    ops = list(blk.ops)
+
+    def run(cap_vals):
+        env = dict(zip(cap_names, cap_vals))
+        for op in ops:
+            if op.fn is None:
+                continue
+            args = [env[n] for n in op.in_order]
+            res = op.fn(*args)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for n, v in zip(op.out_order, res):
+                env[n] = v
+        return tuple(env[n] for n in out_names)
+
+    return run
+
+
+def _captures(blk):
+    """Names a sub-block consumes but does not produce — the parent-scope
+    values the lowered branch closes over (conditional_block's input list)."""
+    produced, caps = set(), []
+    for op in blk.ops:
+        for n in op.in_order:
+            if n not in produced and n not in caps:
+                caps.append(n)
+        produced.update(op.out_order)
+    return caps
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _build_branch(fn, args=()):
+    """Record `fn` into a fresh sub-block; returns (block, out_vars)."""
+    with _sub_block() as blk:
+        outs = _as_list(fn(*args))
+        for o in outs:
+            if not isinstance(o, Variable):
+                raise TypeError(
+                    "control-flow branch functions must return static "
+                    f"Variables, got {type(o).__name__}")
+    return blk, outs
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """paddle.static.nn.cond: both branches trace into sub-blocks, one
+    `conditional_block` op dispatches via lax.cond."""
+    t_blk, t_outs = _build_branch(true_fn)
+    f_blk, f_outs = _build_branch(false_fn)
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches must return the same number of outputs "
+            f"({len(t_outs)} vs {len(f_outs)})")
+    block = default_main_program().current_block()
+    cap_names = []
+    for n in _captures(t_blk) + _captures(f_blk):
+        if n not in cap_names:
+            cap_names.append(n)
+    t_run = _block_fn(t_blk, [o.name for o in t_outs], cap_names)
+    f_run = _block_fn(f_blk, [o.name for o in f_outs], cap_names)
+
+    def fn(pred_val, *caps):
+        flag = jnp.reshape(pred_val, ()).astype(bool)
+        return jax.lax.cond(flag, t_run, f_run, caps)
+
+    ins = [("Cond", pred)] + [("Input", block.var(n) if block.has_var(n)
+                               else _parent_var(block, n))
+                              for n in cap_names]
+    outs_spec = [("Out", o.shape, o.dtype) for o in t_outs]
+    res = emit("conditional_block", ins, outs_spec, fn,
+               attrs={"sub_block_true": t_blk.idx,
+                      "sub_block_false": f_blk.idx})
+    return res
+
+
+def _parent_var(block, name):
+    b = block
+    while b is not None:
+        if b.has_var(name):
+            return b.vars[name]
+        b = (b.program.block(b.parent_idx)
+             if b.parent_idx >= 0 else None)
+    raise KeyError(f"captured variable {name!r} not found in any "
+                   f"enclosing block")
+
+
+def while_loop(cond_fn, body_fn, loop_vars, name=None):
+    """paddle.static.nn.while_loop (while_op parity): state threads through
+    lax.while_loop; non-loop captures ride as closure constants."""
+    loop_vars = _as_list(loop_vars)
+    state_names = [v.name for v in loop_vars]
+    c_blk, c_outs = _build_branch(cond_fn, loop_vars)
+    if len(c_outs) != 1:
+        raise ValueError("while_loop cond must return a single boolean")
+    b_blk, b_outs = _build_branch(body_fn, loop_vars)
+    if len(b_outs) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body must return one value per loop var "
+            f"({len(b_outs)} vs {len(loop_vars)})")
+    cap_names = []
+    for n in _captures(c_blk) + _captures(b_blk):
+        if n not in cap_names and n not in state_names:
+            cap_names.append(n)
+    c_run = _block_fn(c_blk, [c_outs[0].name], state_names + cap_names)
+    b_run = _block_fn(b_blk, [o.name for o in b_outs],
+                      state_names + cap_names)
+
+    def fn(*vals):
+        state0 = tuple(vals[:len(state_names)])
+        caps = tuple(vals[len(state_names):])
+
+        def cond_f(state):
+            (flag,) = c_run(state + caps)
+            return jnp.reshape(flag, ()).astype(bool)
+
+        def body_f(state):
+            return b_run(state + caps)
+
+        return jax.lax.while_loop(cond_f, body_f, state0)
+
+    block = default_main_program().current_block()
+    ins = [("X", v) for v in loop_vars] + \
+          [("Captured", _parent_var(block, n)) for n in cap_names]
+    outs_spec = [("Out", v.shape, v.dtype) for v in loop_vars]
+    res = emit("while", ins, outs_spec, fn,
+               attrs={"sub_block_cond": c_blk.idx,
+                      "sub_block_body": b_blk.idx})
+    return res if isinstance(res, list) else [res]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case: lax.switch over traced branches.
+
+    branch_fns: list of callables or list of (index, callable) pairs.
+    """
+    if isinstance(branch_fns, (list, tuple)) and branch_fns and \
+            isinstance(branch_fns[0], (list, tuple)):
+        pairs = sorted(branch_fns, key=lambda kv: kv[0])
+        keys = [k for k, _ in pairs]
+        fns = [f for _, f in pairs]
+    else:
+        fns = list(branch_fns)
+        keys = list(range(len(fns)))
+    if default is not None:
+        fns = fns + [default]
+    blocks, outs = zip(*(_build_branch(f) for f in fns))
+    n_out = len(outs[0])
+    for o in outs[1:]:
+        if len(o) != n_out:
+            raise ValueError("switch_case branches must return the same "
+                             "number of outputs")
+    cap_names = []
+    for blk in blocks:
+        for n in _captures(blk):
+            if n not in cap_names:
+                cap_names.append(n)
+    runs = [_block_fn(blk, [o.name for o in outs_i], cap_names)
+            for blk, outs_i in zip(blocks, outs)]
+    keys_arr = jnp.asarray(keys, jnp.int32)
+
+    def fn(idx_val, *caps):
+        idx = jnp.reshape(idx_val, ()).astype(jnp.int32)
+        # map branch keys to positions; unmatched keys take the default
+        # (last) branch when present, else clamp to valid range
+        pos = jnp.argmax(keys_arr == idx)
+        matched = jnp.any(keys_arr == idx)
+        n_branches = len(runs)
+        if default is not None:
+            pos = jnp.where(matched, pos, n_branches - 1)
+        else:
+            pos = jnp.where(matched, pos, 0)
+        return jax.lax.switch(pos, runs, caps)
+
+    block = default_main_program().current_block()
+    ins = [("Index", branch_index)] + \
+          [("Input", _parent_var(block, n)) for n in cap_names]
+    outs_spec = [("Out", o.shape, o.dtype) for o in outs[0]]
+    return emit("switch_case", ins, outs_spec, fn,
+                attrs={"keys": keys})
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case: first true pred wins (control_flow.py case)."""
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+        fns = fns[:-1]
+        preds = preds[:-1]
+        if not preds:
+            raise ValueError("case needs at least one (pred, fn) plus a "
+                             "default (or two pairs)")
+    blocks, outs = zip(*(_build_branch(f) for f in list(fns) + [default]))
+    cap_names = []
+    for blk in blocks:
+        for n in _captures(blk):
+            if n not in cap_names:
+                cap_names.append(n)
+    runs = [_block_fn(blk, [o.name for o in outs_i], cap_names)
+            for blk, outs_i in zip(blocks, outs)]
+
+    def fn(*vals):
+        pred_vals = vals[:len(preds)]
+        caps = vals[len(preds):]
+        flags = jnp.stack(
+            [jnp.reshape(p, ()).astype(bool) for p in pred_vals])
+        first = jnp.argmax(flags)  # index of first True
+        any_true = jnp.any(flags)
+        pos = jnp.where(any_true, first, len(runs) - 1)
+        return jax.lax.switch(pos, runs, caps)
+
+    block = default_main_program().current_block()
+    ins = [("Pred", p) for p in preds] + \
+          [("Input", _parent_var(block, n)) for n in cap_names]
+    outs_spec = [("Out", o.shape, o.dtype) for o in outs[0]]
+    return emit("case", ins, outs_spec, fn, attrs={})

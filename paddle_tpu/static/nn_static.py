@@ -79,6 +79,41 @@ def _elementwise_emit(op_type, x, y, reverse=False):
     return emit(op_type, [("X", x), ("Y", y)], [("Out", shape, x.dtype)], fn)
 
 
+def _compare_emit(op_type, x, y):
+    """Comparison ops (operators/controlflow/compare_op.cc): bool outputs."""
+    fns = {
+        "less_than": lambda a, b: a < b,
+        "less_equal": lambda a, b: a <= b,
+        "greater_than": lambda a, b: a > b,
+        "greater_equal": lambda a, b: a >= b,
+        "equal": lambda a, b: a == b,
+        "not_equal": lambda a, b: a != b,
+    }
+    fn = fns[op_type]
+    if not isinstance(y, Variable):
+        c = float(y)
+        return emit(op_type, [("X", x)], [("Out", x.shape, "bool")],
+                    lambda a: fn(a, c))
+    shape = _infer_eltwise_shape(x, y)
+    return emit(op_type, [("X", x), ("Y", y)], [("Out", shape, "bool")], fn)
+
+
+def less_than(x, y):
+    return _compare_emit("less_than", x, y)
+
+
+def greater_than(x, y):
+    return _compare_emit("greater_than", x, y)
+
+
+def equal(x, y):
+    return _compare_emit("equal", x, y)
+
+
+def not_equal(x, y):
+    return _compare_emit("not_equal", x, y)
+
+
 # ---- data & feed ----
 
 def data(name, shape, dtype="float32", lod_level=0):

@@ -79,6 +79,23 @@ class Variable:
 
         return NS.matmul(self, other)
 
+    def _compare(self, op_type, other):
+        from . import nn_static as NS
+
+        return NS._compare_emit(op_type, self, other)
+
+    def __lt__(self, other):
+        return self._compare("less_than", other)
+
+    def __le__(self, other):
+        return self._compare("less_equal", other)
+
+    def __gt__(self, other):
+        return self._compare("greater_than", other)
+
+    def __ge__(self, other):
+        return self._compare("greater_equal", other)
+
 
 Parameter = Variable
 

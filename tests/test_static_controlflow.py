@@ -1,0 +1,146 @@
+"""Static control-flow tests: cond / while_loop / switch_case / case lower to
+lax primitives inside the compiled block.
+
+Ref: operators/controlflow/ + fluid/layers/control_flow.py tests
+(test_cond.py, test_while_loop_op.py in the reference suite).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _run(main, startup, feed, fetch):
+    exe = static.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_cond_branches():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data(name="x", shape=[4], dtype="float32")
+            p = static.data(name="p", shape=[1], dtype="float32")
+            out = static.nn.cond(p, lambda: x * 2.0, lambda: x - 1.0)
+        xv = np.arange(4, dtype=np.float32)
+        (hi,) = _run(main, startup, {"x": xv, "p": np.ones(1, np.float32)},
+                     [out])
+        np.testing.assert_allclose(hi, xv * 2)
+        (lo,) = _run(main, startup, {"x": xv, "p": np.zeros(1, np.float32)},
+                     [out])
+        np.testing.assert_allclose(lo, xv - 1)
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_captures_params():
+    """Branches that close over a parameter created outside the branch."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data(name="x", shape=[2, 3], dtype="float32")
+            y = static.nn.fc(x, size=3)
+            p = static.data(name="p", shape=[1], dtype="float32")
+            out = static.nn.cond(p, lambda: y + 1.0, lambda: y * 0.0)
+        xv = np.ones((2, 3), np.float32)
+        (a,) = _run(main, startup, {"x": xv, "p": np.ones(1, np.float32)},
+                    [out])
+        (b,) = _run(main, startup, {"x": xv, "p": np.zeros(1, np.float32)},
+                    [out])
+        np.testing.assert_allclose(b, np.zeros((2, 3)), atol=1e-6)
+        assert np.all(a != 0)  # fc + 1 with nonzero bias-free weights
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_counts():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            i = static.data(name="i", shape=[1], dtype="float32")
+            s = static.data(name="s", shape=[1], dtype="float32")
+            limit = static.data(name="limit", shape=[1], dtype="float32")
+            iv, sv = static.nn.while_loop(
+                lambda i, s: i < limit,
+                lambda i, s: [i + 1.0, s + i],
+                [i, s])
+        (fi, fs) = _run(
+            main, startup,
+            {"i": np.zeros(1, np.float32), "s": np.zeros(1, np.float32),
+             "limit": np.full(1, 5.0, np.float32)},
+            [iv, sv])
+        assert float(fi[0]) == 5.0
+        assert float(fs[0]) == 0 + 1 + 2 + 3 + 4
+    finally:
+        paddle.disable_static()
+
+
+def test_switch_case_and_default():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            idx = static.data(name="idx", shape=[1], dtype="int32")
+            x = static.data(name="x", shape=[3], dtype="float32")
+            out = static.nn.switch_case(
+                idx,
+                [lambda: x + 10.0, lambda: x * 2.0],
+                default=lambda: x * 0.0)
+        xv = np.arange(3, dtype=np.float32)
+        for i, want in [(0, xv + 10), (1, xv * 2), (7, xv * 0)]:
+            (got,) = _run(main, startup,
+                          {"idx": np.full(1, i, np.int32), "x": xv}, [out])
+            np.testing.assert_allclose(got, want)
+    finally:
+        paddle.disable_static()
+
+
+def test_case_first_true_wins():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            a = static.data(name="a", shape=[1], dtype="float32")
+            x = static.data(name="x", shape=[2], dtype="float32")
+            out = static.case(
+                [(a > 2.0, lambda: x + 100.0), (a > 0.0, lambda: x + 1.0)],
+                default=lambda: x - 1.0)
+        xv = np.zeros(2, np.float32)
+        for av, want in [(5.0, xv + 100), (1.0, xv + 1), (-3.0, xv - 1)]:
+            (got,) = _run(main, startup,
+                          {"a": np.full(1, av, np.float32), "x": xv}, [out])
+            np.testing.assert_allclose(got, want)
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_backward():
+    """append_backward differentiates through lax.cond."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data(name="x", shape=[3], dtype="float32")
+            x.stop_gradient = False
+            p = static.data(name="p", shape=[1], dtype="float32")
+            y = static.nn.cond(p, lambda: x * 3.0, lambda: x * 5.0)
+            loss = paddle.static.nn.reduce_sum(y) if hasattr(
+                paddle.static.nn, "reduce_sum") else None
+            if loss is None:
+                from paddle_tpu.static.nn_static import reduce_sum
+
+                loss = reduce_sum(y)
+            grads = static.gradients([loss], [x])
+        xv = np.ones(3, np.float32)
+        (g,) = _run(main, startup,
+                    {"x": xv, "p": np.ones(1, np.float32)}, [grads[0]])
+        np.testing.assert_allclose(g, np.full(3, 3.0))
+        (g2,) = _run(main, startup,
+                     {"x": xv, "p": np.zeros(1, np.float32)}, [grads[0]])
+        np.testing.assert_allclose(g2, np.full(3, 5.0))
+    finally:
+        paddle.disable_static()
