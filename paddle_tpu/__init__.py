@@ -48,6 +48,7 @@ from . import framework  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .framework import save, load, set_flags, get_flags  # noqa: F401,E402

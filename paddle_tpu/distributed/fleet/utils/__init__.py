@@ -2,3 +2,5 @@ from .recompute import recompute, RecomputeFunction  # noqa: F401
 from .hybrid_parallel_util import (  # noqa: F401
     fused_allreduce_gradients, sync_params_buffers,
 )
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+from .http_server import KVServer, KVClient  # noqa: F401

@@ -1,0 +1,201 @@
+"""Filesystem clients: LocalFS + HDFS-shaped interface.
+
+Reference: python/paddle/distributed/fleet/utils/fs.py — `FS` abstract base,
+`LocalFS`, `HDFSClient` (shells out to `hadoop fs`).  The TPU deployment
+stores checkpoints on mounted/object storage exposed as a local path, so
+`LocalFS` is the working implementation; `HDFSClient` keeps the reference
+API surface and raises unless a hadoop binary is actually present.
+"""
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """fs.py LocalFS parity: thin os/shutil wrapper with the same API."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, e))
+             else files).append(e)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.replace(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        os.replace(src_path, dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        os.makedirs(os.path.dirname(fs_path) or ".", exist_ok=True)
+        with open(fs_path, "w"):
+            pass
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """Reference HDFSClient API; functional only when `hadoop` exists on
+    PATH (the TPU image has none — checkpoints go to mounted storage via
+    LocalFS instead)."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin/hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        self._configs = configs or {}
+        if self._hadoop is None or not os.path.exists(self._hadoop):
+            raise ExecuteError(
+                "hadoop binary not found; on TPU deployments use LocalFS "
+                "over mounted/object storage (fs.py reference parity note)")
+
+    def _run(self, *args):
+        cmd = [self._hadoop, "fs"] + list(args)
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise ExecuteError(f"{cmd}: {r.stderr}")
+        return r.stdout
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False, test_exists=False):
+        if overwrite:
+            self._run("-mv", "-f", src, dst)
+        else:
+            self._run("-mv", src, dst)
+
+    def need_upload_download(self):
+        return True
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files

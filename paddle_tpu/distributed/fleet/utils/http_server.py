@@ -1,0 +1,122 @@
+"""In-process HTTP KV store for rendezvous.
+
+Reference: python/paddle/distributed/fleet/utils/http_server.py — a tiny
+KV server (`KVServer`) used by gloo rendezvous (role_maker.py:120-174) and
+`init_parallel_env`'s bootstrap; workers GET/PUT keys under scope paths.
+Same role here: host-side coordination for multi-process launches (the
+device-side collectives bootstrap through jax.distributed instead).
+"""
+import http.client
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KVHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):
+        with self.server.kv_lock:
+            value = self.server.kv.get(self.path)
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with self.server.kv_lock:
+            self.server.kv[self.path] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        with self.server.kv_lock:
+            self.server.kv.pop(self.path, None)
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    """Reference KVServer parity: start/stop + scoped size queries."""
+
+    def __init__(self, port, host="0.0.0.0"):
+        self.host = host
+        self.port = port
+        self._server = ThreadingHTTPServer((host, port), KVHandler)
+        self._server.kv = {}
+        self._server.kv_lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def get_deleted_size(self, scope):  # reference API compat
+        return 0
+
+    def size(self, scope=""):
+        prefix = "/" + scope.strip("/")
+        with self._server.kv_lock:
+            return sum(1 for k in self._server.kv if k.startswith(prefix))
+
+
+class KVClient:
+    """GET/PUT/DELETE against a KVServer endpoint (ip:port)."""
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+
+    def _conn(self):
+        host, port = self.endpoint.rsplit(":", 1)
+        return http.client.HTTPConnection(host, int(port), timeout=30)
+
+    def get(self, key):
+        c = self._conn()
+        try:
+            c.request("GET", "/" + key.strip("/"))
+            r = c.getresponse()
+            if r.status != 200:
+                return None
+            return r.read()
+        finally:
+            c.close()
+
+    def put(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        c = self._conn()
+        try:
+            c.request("PUT", "/" + key.strip("/"), body=value)
+            return c.getresponse().status == 200
+        finally:
+            c.close()
+
+    def delete(self, key):
+        c = self._conn()
+        try:
+            c.request("DELETE", "/" + key.strip("/"))
+            return c.getresponse().status == 200
+        finally:
+            c.close()
+
+    def wait(self, key, timeout=60.0, interval=0.1):
+        import time
+
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        return None

@@ -1,0 +1,2 @@
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import train_epoch_range, AutoCheckpointChecker  # noqa: F401

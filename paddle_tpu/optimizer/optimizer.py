@@ -157,22 +157,35 @@ class Optimizer:
         if isinstance(self._lr, LRScheduler):
             out["LR_Scheduler"] = self._lr.state_dict()
         if self._parameter_list:
-            for p in self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
                 st = self._states.get(id(p))
                 if st:
                     for k, v in st.items():
-                        out[f"{p.name}_{k}"] = Tensor(np.asarray(v))
+                        t = Tensor(np.asarray(v))
+                        out[f"{p.name}_{k}"] = t
+                        # positional alias (same object — pickle memoization
+                        # keeps the checkpoint single-copy): auto-generated
+                        # param names don't survive a process restart, the
+                        # parameter order does
+                        out[f"@pos{i}_{k}"] = t
         return out
 
     def set_state_dict(self, state):
-        self._global_step = state.get("global_step", 0)
+        gs = state.get("global_step", 0)
+        self._global_step = int(np.asarray(
+            gs.numpy() if isinstance(gs, Tensor) else gs))
         if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
             self._lr.set_state_dict(state["LR_Scheduler"])
         if self._parameter_list:
-            for p in self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
                 st = self._state_for(p)
                 for k in list(st.keys()):
-                    key = f"{p.name}_{k}"
+                    # positional key first: within one optimizer the order is
+                    # ground truth, while an auto-generated name can collide
+                    # with a *different* param's name from the saving run
+                    key = f"@pos{i}_{k}"
+                    if key not in state:
+                        key = f"{p.name}_{k}"
                     if key in state:
                         v = state[key]
                         st[k] = jnp.asarray(

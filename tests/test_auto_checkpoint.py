@@ -1,0 +1,138 @@
+"""Auto-checkpoint + fs/http KV utils tests.
+
+Ref: incubate/checkpoint/auto_checkpoint.py TrainEpochRange (resume-after-
+restart is simulated by constructing a fresh loop over the same dir, the way
+the reference's test restarts the epoch range), fleet/utils/fs.py,
+fleet/utils/http_server.py.
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.utils import KVClient, KVServer, LocalFS
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import TrainEpochRange
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a/b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == []
+    fs.mv(f, os.path.join(d, "y.txt"))
+    assert not fs.is_exist(f)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_kv_server_client():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = KVServer(port, host="127.0.0.1")
+    srv.start()
+    try:
+        c = KVClient(f"127.0.0.1:{port}")
+        assert c.get("missing") is None
+        assert c.put("scope/rank0", b"ep0")
+        assert c.get("scope/rank0") == b"ep0"
+        assert srv.size("scope") == 1
+        assert c.wait("scope/rank0", timeout=1) == b"ep0"
+        assert c.delete("scope/rank0")
+        assert c.get("scope/rank0") is None
+    finally:
+        srv.stop()
+
+
+def _make_net():
+    paddle.seed(42)
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    return net, opt
+
+
+def _train_one(net, opt):
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def test_train_epoch_range_resume(tmp_path):
+    root = str(tmp_path / "ckpt")
+
+    # run 1: simulate preemption during epoch 2 of 6.  The save for an epoch
+    # runs after its body completes (start of the next iteration), so the
+    # interrupted epoch is lost and will be re-run — epoch 1 is the last
+    # durable state.
+    net, opt = _make_net()
+    r1 = TrainEpochRange(6, "job", objs={"model": net, "opt": opt},
+                         checkpoint_path=root, save_checkpoint_inter=0)
+    done = []
+    w_saved = None
+    for epoch in r1.get():
+        _train_one(net, opt)
+        done.append(epoch)
+        if epoch == 1:
+            w_saved = net.state_dict()["weight"].numpy().copy()
+        if epoch == 2:
+            break  # "preempted" mid-epoch-2
+    assert done == [0, 1, 2]
+
+    # run 2 ("restarted process"): fresh objects resume from epoch 2
+    net2, opt2 = _make_net()
+    r2 = TrainEpochRange(6, "job", objs={"model": net2, "opt": opt2},
+                         checkpoint_path=root, save_checkpoint_inter=0)
+    assert r2.restored_from == 1
+    np.testing.assert_allclose(net2.state_dict()["weight"].numpy(),
+                               w_saved, rtol=1e-6)
+    remaining = list(r2.get())
+    assert remaining == [2, 3, 4, 5]
+
+    # run 3: everything finished -> nothing to do
+    net3, opt3 = _make_net()
+    r3 = TrainEpochRange(6, "job", objs={"model": net3, "opt": opt3},
+                         checkpoint_path=root, save_checkpoint_inter=0)
+    assert list(r3.get()) == []
+
+
+def test_train_epoch_range_optimizer_state_resumes(tmp_path):
+    """Adam moments survive the restart: one more step after resume equals
+    the uninterrupted run."""
+    root1 = str(tmp_path / "c1")
+
+    # uninterrupted: 3 epochs
+    net_a, opt_a = _make_net()
+    for epoch in TrainEpochRange(3, "t", objs={"m": net_a, "o": opt_a},
+                                 checkpoint_path=root1,
+                                 save_checkpoint_inter=0).get():
+        _train_one(net_a, opt_a)
+
+    # interrupted after 2, resumed for the 3rd
+    root2 = str(tmp_path / "c2")
+    net_b, opt_b = _make_net()
+    for epoch in TrainEpochRange(3, "t", objs={"m": net_b, "o": opt_b},
+                                 checkpoint_path=root2,
+                                 save_checkpoint_inter=0).get():
+        _train_one(net_b, opt_b)
+        if epoch == 1:
+            break
+    net_c, opt_c = _make_net()
+    r = TrainEpochRange(3, "t", objs={"m": net_c, "o": opt_c},
+                        checkpoint_path=root2, save_checkpoint_inter=0)
+    for epoch in r.get():
+        _train_one(net_c, opt_c)
+    np.testing.assert_allclose(net_c.state_dict()["weight"].numpy(),
+                               net_a.state_dict()["weight"].numpy(),
+                               rtol=1e-5, atol=1e-6)
