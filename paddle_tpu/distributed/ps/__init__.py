@@ -60,6 +60,12 @@ class TheOnePSRuntime:
             self.communicator.flush()
             self.communicator.stop()
         if self.client is not None:
+            # all workers rendezvous before anyone tears the service down —
+            # a fast worker must not kill the servers under a slow one
+            try:
+                self.client.barrier()
+            except (RuntimeError, ConnectionError, OSError):
+                pass
             if self.role_maker.is_first_worker():
                 self.client.stop_server()
             self.client.close()

@@ -22,6 +22,34 @@ from .table import BarrierTable, DenseTable, SparseTable
 
 _HDR = struct.Struct(">I")
 
+# Frames cross a trust boundary (any peer that can reach the port), so
+# deserialization must never execute attacker-chosen callables.  This
+# unpickler admits only the numpy internals needed to rebuild ndarrays and
+# rejects every other global (brpc's protobuf parsing plays this role in the
+# reference).
+_ALLOWED_GLOBALS = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden global in PS frame: {module}.{name}")
+
+
+def _loads(payload):
+    import io
+
+    return _SafeUnpickler(io.BytesIO(payload)).load()
+
 
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=4)
@@ -40,7 +68,7 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    return _loads(_recv_exact(sock, n))
 
 
 class PSServer:
@@ -130,7 +158,9 @@ class PSServer:
                 t.push(ids, grads)
             return ("ok",)
         if cmd == "barrier":
-            ok = self._barrier.wait()
+            # keep the barrier timeout under the client socket timeout (60s)
+            # so a missing worker surfaces as ok=False, not a dead connection
+            ok = self._barrier.wait(timeout=30.0)
             return ("ok", ok)
         if cmd == "save":
             _, dirname = msg
@@ -174,8 +204,12 @@ class PSServer:
                 try:
                     while True:
                         msg = _recv_msg(self.request)
-                        _send_msg(self.request, outer._handle(msg))
-                except (ConnectionError, OSError):
+                        try:
+                            resp = outer._handle(msg)
+                        except Exception as e:  # bad request != dead conn
+                            resp = ("err", f"{type(e).__name__}: {e}")
+                        _send_msg(self.request, resp)
+                except (ConnectionError, OSError, pickle.UnpicklingError):
                     pass
 
         class Server(socketserver.ThreadingTCPServer):
@@ -335,12 +369,33 @@ class PSClient:
             except OSError:
                 pass
 
+    def _reconnect(self, idx):
+        host, port = self.endpoints[idx].rsplit(":", 1)
+        try:
+            self._socks[idx].close()
+        except OSError:
+            pass
+        s = socket.create_connection((host, int(port)), timeout=60)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._socks[idx] = s
+
     def ping(self, retries=50, delay=0.1):
+        """Health-check every shard; raises if any stays unreachable."""
         for i in range(self.num_servers):
+            last = None
             for _ in range(retries):
                 try:
                     self._call(i, "ping")
+                    last = None
                     break
-                except (ConnectionError, OSError):
+                except (RuntimeError, ConnectionError, OSError) as e:
+                    last = e
                     time.sleep(delay)
+                    try:
+                        self._reconnect(i)
+                    except OSError as e2:
+                        last = e2
+            if last is not None:
+                raise ConnectionError(
+                    f"ps server {self.endpoints[i]} unreachable: {last}")
         return True

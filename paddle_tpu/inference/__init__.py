@@ -57,8 +57,9 @@ class Config:
     def set_model(self, model_path, params_path=None):
         self._prefix = model_path[:-len(".pdmodel")] if \
             model_path.endswith(".pdmodel") else model_path
-        if params_path is not None:
-            self._params_path = params_path
+        # single-arg form means the conventional <prefix>.pdiparams pair;
+        # never keep a previous model's params path
+        self._params_path = params_path
 
     def model_dir(self):
         return self._prefix
