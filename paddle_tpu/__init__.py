@@ -49,6 +49,9 @@ from . import device  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
+from . import quant  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .framework import save, load, set_flags, get_flags  # noqa: F401,E402

@@ -17,6 +17,9 @@ from .pipeline_optimizer import PipelineOptimizer
 from .localsgd_optimizer import LocalSGDOptimizer
 from .lamb_optimizer import LambOptimizer
 from .lars_optimizer import LarsOptimizer
+from .dgc_optimizer import DGCOptimizer
+from .fp16_allreduce_optimizer import FP16AllReduceOptimizer
+from .asp_optimizer import ASPOptimizer
 from .dygraph_optimizer import HybridParallelOptimizer, DygraphShardingOptimizer  # noqa: F401
 
 META_OPTIMIZERS = [
@@ -28,6 +31,9 @@ META_OPTIMIZERS = [
     TensorParallelOptimizer,
     PipelineOptimizer,
     LocalSGDOptimizer,
+    DGCOptimizer,
+    FP16AllReduceOptimizer,
+    ASPOptimizer,
     LambOptimizer,
     LarsOptimizer,
     RawProgramOptimizer,

@@ -1,0 +1,30 @@
+"""ASP meta-optimizer: 2:4 sparsity masks enforced through fleet.
+
+Reference: meta_optimizers/asp_optimizer.py — wraps the inner optimizer so
+pruned weights stay pruned during distributed fine-tuning (masks from
+paddle_tpu.incubate.asp.prune_model).
+"""
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class ASPOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "asp", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        import jax.numpy as jnp
+
+        from ....incubate import asp as asp_mod
+
+        result = self.inner_opt.minimize(loss, startup_program,
+                                         parameter_list, no_grad_set)
+        # re-mask eager params after the update (OptimizerWithSparsity-
+        # Guarantee semantics); static programs re-mask via asp.decorate
+        # around the training loop
+        for p in getattr(self.inner_opt, "_parameter_list", None) or ():
+            mask = asp_mod._masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask)
+        return result

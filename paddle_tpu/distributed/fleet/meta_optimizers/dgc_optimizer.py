@@ -1,0 +1,97 @@
+"""DGC: deep gradient compression (top-k sparsified allreduce + residual).
+
+Reference: meta_optimizers/dgc_optimizer.py + operators/optimizers/
+dgc_momentum_op — local top-k selection, residual accumulation of the
+unsent mass, momentum correction.  TPU note: ICI bandwidth makes DGC
+rarely profitable intra-pod (SURVEY §7.2 item 10 allows documenting it as
+such); it still pays across DCN-connected slices, so the transform is
+implemented: each grad op becomes u = g + residual; send top-k(|u|);
+residual' = u - sent; grad' = psum(sent).
+
+The residual is a persistable block var seeded into the global scope, so
+the compiled block threads it across steps like optimizer state.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .meta_optimizer_base import MetaOptimizerBase
+from ....static.backward import GRAD_SUFFIX
+
+
+def _dgc_fn(sparsity):
+    keep = max(1.0 - float(sparsity), 1e-3)
+
+    def fn(g, residual):
+        u = g + residual
+        flat = jnp.abs(u).ravel()
+        k = max(int(flat.size * keep), 1)
+        # kth largest magnitude as threshold (top_k on TPU sorts on the VPU)
+        thresh = jax.lax.top_k(flat, k)[0][-1]
+        mask = (jnp.abs(u) >= thresh).astype(u.dtype)
+        send = u * mask
+        new_residual = u - send
+        try:
+            red = jax.lax.psum(send, "data")
+        except BaseException:
+            red = send
+        return red, new_residual
+
+    return fn
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    @classmethod
+    def _can_apply(cls, strategy):
+        return getattr(strategy, "dgc", False)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self.inner_opt.minimize(loss, startup_program,
+                                         parameter_list, no_grad_set)
+        block = loss.block.program.global_block()
+        cfg = getattr(self.user_defined_strategy, "dgc_configs", None) or {}
+        sparsity = cfg.get("sparsity", [0.75])
+        sparsity = sparsity[-1] if isinstance(sparsity, (list, tuple)) \
+            else sparsity
+        self._insert_ops(block, sparsity)
+        return result
+
+    def _insert_ops(self, block, sparsity):
+        from ....static.executor import global_scope
+
+        Operator = type(block.ops[0]) if block.ops else None
+        if Operator is None:
+            return
+        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
+                        "adagrad", "adadelta", "adamax"}
+        grads = []
+        for op in block.ops:
+            for out in getattr(op, "out_order", []):
+                if out.endswith(GRAD_SUFFIX) and "@" not in out[:-len(GRAD_SUFFIX)]:
+                    grads.append(out)
+        scope = global_scope()
+        final_ops = []
+        inserted = False
+        for op in block.ops:
+            if not inserted and op.type in update_types:
+                for g in grads:
+                    gvar = block.vars.get(g)
+                    shape = tuple(d for d in (gvar.shape or ())
+                                  if isinstance(d, int) and d > 0) \
+                        if gvar is not None else ()
+                    rname = f"{g}@DGC_RESIDUAL"
+                    rv = block.create_var(name=rname, shape=list(shape),
+                                          dtype=gvar.dtype if gvar else
+                                          "float32", persistable=True)
+                    scope.set(rname, jnp.zeros(shape, jnp.float32))
+                    dop = Operator(block, "dgc", {"U": [g], "V": [rname]},
+                                   {"Out": [g], "VOut": [rname]},
+                                   {"sparsity": float(sparsity)},
+                                   fn=_dgc_fn(sparsity))
+                    dop.in_order = [g, rname]
+                    dop.out_order = [g, rname]
+                    final_ops.append(dop)
+                inserted = True
+            final_ops.append(op)
+        block.ops[:] = final_ops

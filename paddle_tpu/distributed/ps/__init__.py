@@ -62,10 +62,8 @@ class TheOnePSRuntime:
         if self.client is not None:
             # all workers rendezvous before anyone tears the service down —
             # a fast worker must not kill the servers under a slow one
-            try:
-                self.client.barrier()
-            except (RuntimeError, ConnectionError, OSError):
-                pass
+            # (returns False on dead shards; shutdown proceeds either way)
+            self.client.barrier()
             if self.role_maker.is_first_worker():
                 self.client.stop_server()
             self.client.close()

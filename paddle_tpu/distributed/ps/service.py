@@ -7,6 +7,7 @@ TPU-native transport: length-prefixed pickled frames over stdlib TCP
 role is played by one thread per connection — the PS is a host-side
 control-plane service, the accelerator data plane never touches it.
 """
+import io
 import os
 import pickle
 import socket
@@ -46,8 +47,6 @@ class _SafeUnpickler(pickle.Unpickler):
 
 
 def _loads(payload):
-    import io
-
     return _SafeUnpickler(io.BytesIO(payload)).load()
 
 
@@ -337,7 +336,10 @@ class PSClient:
         results = [None] * self.num_servers
 
         def one(i):
-            results[i] = self._call(i, "barrier")
+            try:
+                results[i] = self._call(i, "barrier")
+            except (RuntimeError, ConnectionError, OSError):
+                results[i] = False  # dead shard = failed barrier, not a crash
 
         for i in range(self.num_servers):
             t = threading.Thread(target=one, args=(i,))
@@ -371,13 +373,14 @@ class PSClient:
 
     def _reconnect(self, idx):
         host, port = self.endpoints[idx].rsplit(":", 1)
-        try:
-            self._socks[idx].close()
-        except OSError:
-            pass
-        s = socket.create_connection((host, int(port)), timeout=60)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._socks[idx] = s
+        with self._locks[idx]:  # never yank a socket out from under _call
+            try:
+                self._socks[idx].close()
+            except OSError:
+                pass
+            s = socket.create_connection((host, int(port)), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[idx] = s
 
     def ping(self, retries=50, delay=0.1):
         """Health-check every shard; raises if any stays unreachable."""

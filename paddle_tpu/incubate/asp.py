@@ -1,0 +1,106 @@
+"""ASP: automatic structured (2:4) sparsity.
+
+Reference: python/paddle/fluid/contrib/sparsity/ — `prune_model` computes
+2:4 masks (keep the 2 largest-magnitude weights in every group of 4 along
+the reduction dim, sparsity/utils.py get_mask_2d_*), `decorate(optimizer)`
+re-applies masks after each step (asp.py OptimizerWithSparsityGuarantee),
+`calculate_density`.
+
+TPU note: XLA does not execute 2:4 sparse kernels the way sparse tensor
+cores do, but the pruning/fine-tuning workflow (train dense -> prune ->
+fine-tune masked) is hardware-independent, and exported 2:4-sparse weights
+deploy onto hardware that does accelerate them.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["prune_model", "decorate", "calculate_density",
+           "create_mask", "check_sparsity", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_masks = {}  # id(param) -> mask array
+_excluded = set()
+
+
+def set_excluded_layers(main_program=None, param_names=()):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def create_mask(weight, n=2, m=4):
+    """2:4 mask along the last axis groups (sparsity/utils.py
+    get_mask_1d/2d_greedy): keep the n largest |w| of every m."""
+    w = np.asarray(weight)
+    if w.ndim < 2 or w.shape[-1] % m != 0:
+        return np.ones_like(w, dtype=w.dtype)
+    flat = np.abs(w).reshape(-1, m)
+    order = np.argsort(-flat, axis=1)
+    mask = np.zeros_like(flat)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = 1
+    return mask.reshape(w.shape).astype(w.dtype)
+
+
+def calculate_density(x):
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / max(x.size, 1)
+
+
+def check_sparsity(x, n=2, m=4):
+    x = np.asarray(x)
+    if x.ndim < 2 or x.shape[-1] % m != 0:
+        return False
+    groups = (x.reshape(-1, m) != 0).sum(axis=1)
+    return bool(np.all(groups <= n))
+
+
+def _prunable(name, p):
+    if name in _excluded or p is None:
+        return False
+    shape = tuple(np.shape(p.numpy() if isinstance(p, Tensor) else p))
+    return len(shape) >= 2 and shape[-1] % 4 == 0
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable weight of `model` (a Layer).
+
+    Returns {param_name: mask}.  Masks are retained so `decorate`d
+    optimizers keep enforcing them through fine-tuning.
+    """
+    assert isinstance(model, Layer), "prune_model expects a Layer"
+    out = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p.numpy(), n=n, m=m)
+        p._data = p._data * jnp.asarray(mask)
+        _masks[id(p)] = mask
+        out[name] = mask
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """asp.py parity: step() then re-mask so pruned weights stay zero."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._parameter_list or ():
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,37 @@
+"""paddle.onnx.export parity.
+
+Reference: python/paddle/onnx/export.py — delegates to the external
+`paddle2onnx` converter.  This environment has no onnx/paddle2onnx package
+(zero egress), so the portable-interchange role is filled by the StableHLO
+AOT artifact (`jax.export` serialization, the MLIR-based equivalent that
+TPU/GPU/CPU runtimes consume directly); when an `onnx` package is present
+at runtime we fail loudly rather than emit an invalid .onnx file.
+"""
+import os
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` for interchange.  Writes <path>.pdexported (StableHLO
+    with weights) + .pdmodel/.pdiparams via jit.save; returns the artifact
+    prefix.  `path` may end in '.onnx' (reference convention) — the suffix
+    is stripped."""
+    prefix = path[:-len(".onnx")] if path.endswith(".onnx") else path
+    try:
+        import onnx  # noqa: F401
+
+        raise NotImplementedError(
+            "true ONNX protobuf emission requires paddle2onnx, which is not "
+            "bundled; the StableHLO artifact written alongside "
+            f"({prefix}.pdexported) is the supported interchange format")
+    except ImportError:
+        pass
+    from ..jit import save as jit_save
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export needs input_spec to trace the "
+                         "forward (reference requires the same)")
+    jit_save(layer, prefix, input_spec=input_spec)
+    if not os.path.exists(prefix + ".pdexported"):
+        raise RuntimeError("export failed: no AOT artifact produced; see "
+                           f"{prefix}.pdmodel export_error")
+    return prefix

@@ -1,0 +1,22 @@
+"""Quantization: QAT (fake-quant training) + PTQ (calibration).
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+`ImperativeQuantAware` (imperative/qat.py) swaps Linear/Conv2D sublayers for
+quantized wrappers with fake-quant on weights and activations;
+`ImperativePTQ` collects activation ranges on calibration data.
+python/paddle/nn/quant holds the fake-quant layers.
+
+TPU-native notes: int8 inference on TPU runs through XLA's native int8
+matmul/convolution; training-time fake-quant here simulates that pipeline in
+float with straight-through gradients (q = x + stop_grad(quant(x) - x)), so
+the whole quantized model still jits into one XLA computation.
+"""
+from .qat import (  # noqa: F401
+    FakeQuantAbsMax,
+    FakeQuantMovingAverageAbsMax,
+    ImperativePTQ,
+    ImperativeQuantAware,
+    QuantedConv2D,
+    QuantedLinear,
+    quant_dequant,
+)

@@ -1,0 +1,191 @@
+"""Fake-quant layers + imperative QAT/PTQ drivers.
+
+Reference: slim/quantization/imperative/qat.py (`ImperativeQuantAware`:
+quantize() walks sublayers and swaps in quantized versions), ptq.py
+(`ImperativePTQ`), quant_layers (FakeQuantMovingAverageAbsMax et al.,
+python/paddle/nn/quant/quant_layers.py).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _wrap_data
+from ..nn.layer import Layer
+from ..nn import functional as F
+from ..nn.layers.common import Linear
+from ..nn.layers.conv import Conv2D
+
+
+def quant_dequant(x, scale, bits=8):
+    """Simulated symmetric quantization with straight-through gradients.
+
+    Ref kernel: operators/fake_quantize_op.cc (fake_quantize_dequantize_
+    moving_average_abs_max).  STE: forward rounds, backward is identity.
+    """
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-call abs-max scale (weights): scale = max|w| each forward."""
+
+    def __init__(self, bits=8):
+        super().__init__()
+        self.bits = bits
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else x
+        scale = jax.lax.stop_gradient(jnp.max(jnp.abs(data)))
+        return _apply_qdq(x, scale, self.bits)
+
+
+def _apply_qdq(x, scale, bits):
+    """Route quant_dequant through the eager tape so grads flow (STE)."""
+    from ..core.registry import apply_op
+
+    if isinstance(x, Tensor):
+        return apply_op("fake_quantize_dequantize",
+                        lambda a: quant_dequant(a, scale, bits), (x,), {})
+    return quant_dequant(x, scale, bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation observer: EMA of abs-max (quant_layers.py parity)."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.scale = Tensor(np.zeros((), np.float32), stop_gradient=True)
+        self.register_buffer("scale", self.scale)
+
+    def forward(self, x):
+        data = x._data if isinstance(x, Tensor) else x
+        if self.training:
+            cur = jnp.max(jnp.abs(data)).astype(jnp.float32)
+            r = self.moving_rate
+            # scale==0 marks "not yet observed" (survives checkpoints, unlike
+            # a Python flag)
+            prev = self.scale._data
+            self.scale._data = jnp.where(
+                prev == 0, cur, r * prev + (1 - r) * cur)
+        return _apply_qdq(x, jax.lax.stop_gradient(self.scale._data),
+                          self.bits)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on weight + input activation."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.weight_bits = weight_bits
+        self._act_quant = FakeQuantMovingAverageAbsMax(
+            activation_bits, moving_rate)
+        self.add_sublayer("_act_quant", self._act_quant)
+        self.add_sublayer("_inner", layer)
+
+    def forward(self, x):
+        x = self._act_quant(x)
+        w_scale = jax.lax.stop_gradient(jnp.max(jnp.abs(self.weight._data)))
+        w = _apply_qdq(self.weight, w_scale, self.weight_bits)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.weight_bits = weight_bits
+        self._act_quant = FakeQuantMovingAverageAbsMax(
+            activation_bits, moving_rate)
+        self.add_sublayer("_act_quant", self._act_quant)
+        self.add_sublayer("_inner", layer)
+
+    def forward(self, x):
+        x = self._act_quant(x)
+        w_scale = jax.lax.stop_gradient(jnp.max(jnp.abs(self.weight._data)))
+        w = _apply_qdq(self.weight, w_scale, self.weight_bits)
+        inner = self._inner
+        return F.conv2d(x, w, self.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+_QUANT_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class ImperativeQuantAware:
+    """qat.py ImperativeQuantAware parity: in-place sublayer swap."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.types = set(quantizable_layer_type)
+
+    def _wrap(self, layer):
+        for cls, qcls in _QUANT_MAP.items():
+            if type(layer) is cls and cls.__name__ in self.types:
+                return qcls(layer, self.weight_bits, self.activation_bits,
+                            self.moving_rate)
+        return None
+
+    def quantize(self, model):
+        """Replace quantizable sublayers recursively; returns the model."""
+        for name, sub in list(model._sub_layers.items()):
+            if sub is None:
+                continue
+            q = self._wrap(sub)
+            if q is not None:
+                model._sub_layers[name] = q
+                if hasattr(model, name):
+                    setattr(model, name, q)
+            else:
+                self.quantize(sub)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        """jit-save the fake-quant model (scales ride as constants)."""
+        from ..jit import save as jit_save
+
+        model.eval()
+        jit_save(model, path, input_spec=input_spec)
+
+
+class ImperativePTQ:
+    """ptq.py parity: observe activation ranges on calibration batches,
+    then freeze scales (the quantized layers simply stop updating EMA when
+    eval() flips training off)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9):
+        self._qat = ImperativeQuantAware(weight_bits, activation_bits,
+                                         moving_rate)
+
+    def quantize(self, model):
+        return self._qat.quantize(model)
+
+    def calibrate(self, model, data_iter, max_batches=32):
+        model.train()
+        from ..core import autograd
+
+        with autograd.no_grad():
+            for i, batch in enumerate(data_iter):
+                if i >= max_batches:
+                    break
+                model(*batch if isinstance(batch, (tuple, list)) else (batch,))
+        model.eval()
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        self._qat.save_quantized_model(model, path, input_spec)

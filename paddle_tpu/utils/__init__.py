@@ -1,0 +1,2 @@
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import load, register_custom_op  # noqa: F401

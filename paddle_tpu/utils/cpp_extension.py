@@ -1,0 +1,164 @@
+"""Custom-op extension: out-of-tree ops in Python or C/C++.
+
+Reference: paddle/fluid/extension/ (stable C++ op ABI: ext_op_meta_info.h,
+PD_BUILD_OP) + python/paddle/utils/cpp_extension/ (`load` JIT-builds a
+shared lib and auto-generates Python wrappers; custom_operator.cc registers
+into the main op registry).
+
+TPU-native split:
+  * `register_custom_op` — the common path: a pure-jax forward (optionally a
+    custom backward) registers into the eager tape and is jit/export
+    compatible; this is what the reference's C++ CUDA custom kernels become
+    on TPU (XLA compiles the jax body).
+  * `load` — real C/C++ host kernels: compiles sources with the system
+    toolchain into a shared lib and wraps exported symbols as host
+    callbacks (`jax.pure_callback`), the analogue of a CPU-place custom
+    kernel in the reference.  Device-side custom kernels on TPU are written
+    as Pallas kernels in Python instead (ops/pallas/), so no device ABI
+    exists to expose here.
+"""
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.registry import apply_op
+
+_REGISTRY = {}
+
+
+def register_custom_op(op_type, forward, backward=None, infer_shape=None):
+    """Register `op_type` with a pure-jax `forward(*arrays) -> array/tuple`.
+
+    With `backward(grad_out, *arrays) -> grads tuple`, a custom VJP replaces
+    the autodiff of `forward` (GradOpMaker parity); otherwise jax.vjp of the
+    forward is used.  Returns the eager-callable op; it is also retrievable
+    via `get_custom_op(op_type)`.
+    """
+    fn = forward
+    if backward is not None:
+        @jax.custom_vjp
+        def fn(*args):
+            return forward(*args)
+
+        def fwd(*args):
+            return forward(*args), args
+
+        def bwd(saved, g):
+            grads = backward(g, *saved)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            return grads
+
+        fn.defvjp(fwd, bwd)
+
+    def op(*args, **kwargs):
+        return apply_op(op_type, fn, args, kwargs)
+
+    op.__name__ = op_type
+    op.raw_fn = fn
+    op.infer_shape = infer_shape
+    _REGISTRY[op_type] = op
+    return op
+
+
+def get_custom_op(op_type):
+    return _REGISTRY[op_type]
+
+
+# ---------------------------------------------------------------------------
+# C/C++ host-kernel path
+# ---------------------------------------------------------------------------
+
+_C_SIG = """
+Exported symbol contract (one per op):
+    void <name>(const float* in, float* out, long long n);
+elementwise over n floats; richer signatures wrap via `symbol_signature`.
+"""
+
+
+class _LoadedModule:
+    def __init__(self, lib, lib_path):
+        self._lib = lib
+        self._path = lib_path
+        self._ops = {}
+
+    def register(self, symbol, backward_symbol=None):
+        """Wrap the exported C symbol as a tape-recorded op.
+
+        The host function runs inside jit via jax.pure_callback (a
+        host-callback custom kernel, like a CPU-place custom op in the
+        reference).  `backward_symbol` optionally provides the grad kernel
+        with the same signature taking (grad_in, grad_out, n).
+        """
+        cfunc = getattr(self._lib, symbol)
+        cfunc.restype = None
+        cfunc.argtypes = [ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_float),
+                          ctypes.c_longlong]
+
+        def host_call(x):
+            x = np.ascontiguousarray(np.asarray(x, np.float32))
+            out = np.empty_like(x)
+            cfunc(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  ctypes.c_longlong(x.size))
+            return out
+
+        def jax_fn(x):
+            return jax.pure_callback(
+                host_call, jax.ShapeDtypeStruct(x.shape, jnp.float32), x)
+
+        backward = None
+        if backward_symbol is not None:
+            bfunc = getattr(self._lib, backward_symbol)
+            bfunc.restype = None
+            bfunc.argtypes = cfunc.argtypes
+
+            def host_grad(g):
+                g = np.ascontiguousarray(np.asarray(g, np.float32))
+                out = np.empty_like(g)
+                bfunc(g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      ctypes.c_longlong(g.size))
+                return out
+
+            def backward(g, x):
+                gx = jax.pure_callback(
+                    host_grad, jax.ShapeDtypeStruct(x.shape, jnp.float32), x)
+                return (g * gx,)
+
+        op = register_custom_op(symbol, jax_fn, backward=backward)
+        self._ops[symbol] = op
+        return op
+
+    def __getattr__(self, item):
+        if item in self._ops:
+            return self._ops[item]
+        raise AttributeError(item)
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """cpp_extension.load parity: compile `sources` -> shared lib -> module
+    of wrapped ops.  Ops must be registered with `module.register(symbol)`
+    (the reference auto-discovers PD_BUILD_OP entries; the C contract here
+    is explicit symbols — see _C_SIG)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"lib{name}.so")
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-o", lib_path]
+    cmd += list(extra_cxx_cflags or [])
+    cmd += [os.path.abspath(s) for s in sources]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(f"extension build failed: {r.stderr}")
+    if verbose:
+        print(f"built {lib_path}")
+    return _LoadedModule(ctypes.CDLL(lib_path), lib_path)

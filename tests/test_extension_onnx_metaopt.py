@@ -1,0 +1,157 @@
+"""Custom-op extension, onnx export, and new meta-optimizers (dgc /
+fp16_allreduce / asp) tests.
+
+Ref: custom-op tests (custom_op/test_custom_relu_op_setup.py style: build a
+C op, compare against native), fleet meta-optimizer rewrite assertions
+(SURVEY §4.4: check the op list of the rewritten program).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.utils import cpp_extension
+
+
+def test_register_custom_op_with_custom_grad():
+    import jax.numpy as jnp
+
+    def fwd(x):
+        return jnp.square(x)
+
+    def bwd(g, x):
+        return (g * 3.0 * x,)  # deliberately not the true grad (2x)
+
+    op = cpp_extension.register_custom_op("my_square", fwd, backward=bwd)
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [1.0, 4.0])
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 6.0])  # custom vjp used
+
+
+def test_load_c_extension(tmp_path):
+    src = tmp_path / "my_ops.cc"
+    src.write_text(r"""
+extern "C" void cube_forward(const float* in, float* out, long long n) {
+    for (long long i = 0; i < n; ++i) out[i] = in[i] * in[i] * in[i];
+}
+extern "C" void cube_backward(const float* in, float* out, long long n) {
+    for (long long i = 0; i < n; ++i) out[i] = 3.0f * in[i] * in[i];
+}
+""")
+    mod = cpp_extension.load("myext", [str(src)],
+                             build_directory=str(tmp_path / "build"))
+    op = mod.register("cube_forward", backward_symbol="cube_backward")
+    x = paddle.to_tensor(np.array([1.0, 2.0, -2.0], np.float32),
+                         stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [1.0, 8.0, -8.0])
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0, 12.0])
+
+
+def test_onnx_export_writes_stablehlo(tmp_path):
+    from paddle_tpu.static import InputSpec
+
+    net = paddle.nn.Linear(4, 2)
+    prefix = paddle.onnx.export(
+        net, str(tmp_path / "lin.onnx"),
+        input_spec=[InputSpec([2, 4], "float32")])
+    assert os.path.exists(prefix + ".pdexported")
+
+
+def _build_sgd_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data(name="x", shape=[4, 8], dtype="float32")
+        y = static.nn.fc(x, size=2)
+        from paddle_tpu.static.nn_static import mean
+
+        loss = mean(y * y)
+    return main, startup, loss
+
+
+def _fleet_minimize(strategy_flags, loss):
+    from paddle_tpu.distributed.fleet.distributed_strategy import (
+        DistributedStrategy,
+    )
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        apply_meta_optimizers,
+    )
+    from paddle_tpu.distributed.fleet import Fleet
+
+    strategy = DistributedStrategy()
+    for k, v in strategy_flags.items():
+        setattr(strategy, k, v)
+    f = Fleet()
+    f.init(is_collective=True, strategy=strategy)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    return apply_meta_optimizers(opt, strategy, loss, None, f)
+
+
+def test_dgc_rewrite_inserts_ops():
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_sgd_program()
+        with static.program_guard(main, startup):
+            _fleet_minimize({"dgc": True}, loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "dgc" in types
+        # residual var materialized + persistable
+        res_vars = [n for n in main.global_block().vars
+                    if n.endswith("@DGC_RESIDUAL")]
+        assert res_vars
+        assert all(main.global_block().vars[n].persistable for n in res_vars)
+        # program still runs and updates params
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+        l0 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        for _ in range(5):
+            l1 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
+    finally:
+        paddle.disable_static()
+
+
+def test_fp16_allreduce_rewrite():
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_sgd_program()
+        with static.program_guard(main, startup):
+            _fleet_minimize({"fp16_allreduce": True}, loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "c_allreduce_sum_fp16" in types
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+        l0 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        for _ in range(5):
+            l1 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
+    finally:
+        paddle.disable_static()
+
+
+def test_op_bench_harness_runs():
+    import subprocess
+    import sys
+    import json
+
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "op_bench.py"),
+         "--op", "elementwise_add", "--shape", "64x64,64x64",
+         "--repeat", "3"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["op"] == "elementwise_add" and rec["eager_us"] > 0

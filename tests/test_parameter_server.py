@@ -147,16 +147,19 @@ def test_communicator_geo_delta_merge():
     (ep,) = _free_endpoints(1)
     server = PSServer(ep, trainers=1)
     server.start()
-    c = PSClient([ep])
-    c.ping()
-    comm = Communicator(c, mode="geo", n_workers=1, geo_k=2)
-    params = comm.init_params({"w": np.zeros(3, np.float32)}, trainer_id=0)
-    local = {"w": params["w"] + 1.0}
-    assert comm.push_and_pull(local_params=local) is None  # step 1: local
-    fresh = comm.push_and_pull(local_params=local)  # step 2: sync
-    np.testing.assert_allclose(fresh["w"], np.ones(3), rtol=1e-6)
-    c.close()
-    server.shutdown()
+    try:
+        c = PSClient([ep])
+        c.ping()
+        comm = Communicator(c, mode="geo", n_workers=1, geo_k=2)
+        params = comm.init_params({"w": np.zeros(3, np.float32)},
+                                  trainer_id=0)
+        local = {"w": params["w"] + 1.0}
+        assert comm.push_and_pull(local_params=local) is None  # step 1
+        fresh = comm.push_and_pull(local_params=local)  # step 2: sync
+        np.testing.assert_allclose(fresh["w"], np.ones(3), rtol=1e-6)
+        c.close()
+    finally:
+        server.shutdown()
 
 
 def test_distributed_embedding_train(ps_cluster):

@@ -1,0 +1,125 @@
+"""QAT / PTQ / ASP sparsity tests.
+
+Ref: slim quantization tests (test_imperative_qat.py) check that the
+quantized model still trains and that quantized outputs approximate fp32;
+sparsity tests (test_asp_*) check mask structure and that masks survive
+optimizer steps.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+from paddle_tpu.quant import (
+    ImperativePTQ, ImperativeQuantAware, QuantedConv2D, QuantedLinear,
+    quant_dequant,
+)
+
+
+def test_quant_dequant_values_and_ste():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.linspace(-1, 1, 11, dtype=np.float32))
+    q = quant_dequant(x, jnp.float32(1.0), bits=8)
+    # max |err| bounded by half a quantization step
+    assert float(jnp.max(jnp.abs(q - x))) <= 0.5 / 127 + 1e-6
+    # straight-through: gradient of sum(q) wrt x is all ones
+    g = jax.grad(lambda v: jnp.sum(quant_dequant(v, jnp.float32(1.0))))(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones(11), rtol=1e-6)
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.fc = nn.Linear(4 * 4 * 4, 8)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.conv(x))
+        h = paddle.reshape(h, [h.shape[0], -1])
+        return self.fc(h)
+
+
+def test_qat_swaps_layers_and_trains():
+    paddle.seed(0)
+    net = SmallNet()
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    assert isinstance(net.conv, QuantedConv2D)
+    assert isinstance(net.fc, QuantedLinear)
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 1, 4, 4)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 8, (8, 1)))
+    losses = []
+    for _ in range(10):
+        loss = paddle.mean(
+            paddle.nn.functional.softmax_with_cross_entropy(net(x), y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # activation observers saw data
+    assert float(net.fc._act_quant.scale.numpy()) > 0
+
+
+def test_qat_close_to_fp32():
+    paddle.seed(1)
+    net = SmallNet()
+    net.eval()
+    x = paddle.to_tensor(np.random.RandomState(2).randn(4, 1, 4, 4)
+                         .astype("float32"))
+    with paddle.no_grad():
+        ref = net(x).numpy()
+    ImperativeQuantAware().quantize(net)
+    net.train()
+    with paddle.no_grad():
+        net(x)  # one observation pass
+    net.eval()
+    with paddle.no_grad():
+        q = net(x).numpy()
+    assert np.max(np.abs(q - ref)) < 0.15 * (np.abs(ref).max() + 1e-6)
+
+
+def test_ptq_calibration():
+    paddle.seed(3)
+    net = SmallNet()
+    ptq = ImperativePTQ()
+    ptq.quantize(net)
+    data = [(paddle.to_tensor(np.random.RandomState(i).randn(4, 1, 4, 4)
+                              .astype("float32")),) for i in range(4)]
+    ptq.calibrate(net, data)
+    assert not net.training
+    assert float(net.fc._act_quant.scale.numpy()) > 0
+
+
+def test_asp_mask_structure_and_decorate():
+    paddle.seed(4)
+    net = nn.Linear(8, 8)
+    masks = asp.prune_model(net)
+    assert len(masks) == 1
+    w = net.weight.numpy()
+    assert asp.check_sparsity(w)
+    np.testing.assert_allclose(asp.calculate_density(w), 0.5, atol=1e-6)
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    # mask survives the update
+    assert asp.check_sparsity(net.weight.numpy())
+
+
+def test_asp_excludes_bias_and_odd_shapes():
+    paddle.seed(5)
+    net = nn.Linear(8, 6)  # out=6 not divisible by 4 -> last axis is 6
+    masks = asp.prune_model(net)
+    # weight [8, 6]: last dim 6 % 4 != 0 -> not pruned; bias 1-d -> skipped
+    assert masks == {}
