@@ -24,7 +24,7 @@ class ASPOptimizer(MetaOptimizerBase):
         # Guarantee semantics); static programs re-mask via asp.decorate
         # around the training loop
         for p in getattr(self.inner_opt, "_parameter_list", None) or ():
-            mask = asp_mod._masks.get(id(p))
+            mask = asp_mod.get_mask(p)
             if mask is not None:
                 p._data = p._data * jnp.asarray(mask)
         return result

@@ -59,39 +59,35 @@ class DGCOptimizer(MetaOptimizerBase):
 
     def _insert_ops(self, block, sparsity):
         from ....static.executor import global_scope
+        from .meta_optimizer_base import (
+            collect_param_grad_names, insert_before_first_update,
+        )
 
         Operator = type(block.ops[0]) if block.ops else None
         if Operator is None:
             return
-        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
-                        "adagrad", "adadelta", "adamax"}
-        grads = []
-        for op in block.ops:
-            for out in getattr(op, "out_order", []):
-                if out.endswith(GRAD_SUFFIX) and "@" not in out[:-len(GRAD_SUFFIX)]:
-                    grads.append(out)
+        grads = collect_param_grad_names(block)
         scope = global_scope()
-        final_ops = []
-        inserted = False
-        for op in block.ops:
-            if not inserted and op.type in update_types:
-                for g in grads:
-                    gvar = block.vars.get(g)
-                    shape = tuple(d for d in (gvar.shape or ())
-                                  if isinstance(d, int) and d > 0) \
-                        if gvar is not None else ()
-                    rname = f"{g}@DGC_RESIDUAL"
-                    rv = block.create_var(name=rname, shape=list(shape),
-                                          dtype=gvar.dtype if gvar else
-                                          "float32", persistable=True)
-                    scope.set(rname, jnp.zeros(shape, jnp.float32))
-                    dop = Operator(block, "dgc", {"U": [g], "V": [rname]},
-                                   {"Out": [g], "VOut": [rname]},
-                                   {"sparsity": float(sparsity)},
-                                   fn=_dgc_fn(sparsity))
-                    dop.in_order = [g, rname]
-                    dop.out_order = [g, rname]
-                    final_ops.append(dop)
-                inserted = True
-            final_ops.append(op)
-        block.ops[:] = final_ops
+
+        def build():
+            ops = []
+            for g in grads:
+                # param shapes are static, so the grad/residual shape is the
+                # parameter's shape (grad vars may carry -1 batch dims from
+                # inference-shape inference, the param never does)
+                base = block.vars.get(g[:-len(GRAD_SUFFIX)])
+                shape = tuple(base.shape or ())
+                rname = f"{g}@DGC_RESIDUAL"
+                block.create_var(name=rname, shape=list(shape),
+                                 dtype=base.dtype, persistable=True)
+                scope.set(rname, jnp.zeros(shape, jnp.float32))
+                dop = Operator(block, "dgc", {"U": [g], "V": [rname]},
+                               {"Out": [g], "VOut": [rname]},
+                               {"sparsity": float(sparsity)},
+                               fn=_dgc_fn(sparsity))
+                dop.in_order = [g, rname]
+                dop.out_order = [g, rname]
+                ops.append(dop)
+            return ops
+
+        insert_before_first_update(block, build)

@@ -35,29 +35,26 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
         return result
 
     def _insert_ops(self, block):
-        """Insert fused cast-allreduce-cast on each produced grad, before
+        """Insert fused cast-allreduce-cast on each parameter grad, before
         the first optimizer update op (fp16_allreduce_optimizer.py:61)."""
+        from .meta_optimizer_base import (
+            collect_param_grad_names, insert_before_first_update,
+        )
+
         Operator = type(block.ops[0]) if block.ops else None
         if Operator is None:
             return
-        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
-                        "adagrad", "adadelta", "adamax"}
-        grad_names = []
-        for op in block.ops:
-            for out in getattr(op, "out_order", []):
-                if out.endswith(GRAD_SUFFIX) and "@" not in out[:-len(GRAD_SUFFIX)]:
-                    grad_names.append(out)
-        final_ops = []
-        inserted = False
-        for op in block.ops:
-            if not inserted and op.type in update_types:
-                for g in grad_names:
-                    ar = Operator(block, "c_allreduce_sum_fp16",
-                                  {"X": [g]}, {"Out": [g]}, {},
-                                  fn=_fp16_allreduce_fn)
-                    ar.in_order = [g]
-                    ar.out_order = [g]
-                    final_ops.append(ar)
-                inserted = True
-            final_ops.append(op)
-        block.ops[:] = final_ops
+        grad_names = collect_param_grad_names(block)
+
+        def build():
+            ops = []
+            for g in grad_names:
+                ar = Operator(block, "c_allreduce_sum_fp16",
+                              {"X": [g]}, {"Out": [g]}, {},
+                              fn=_fp16_allreduce_fn)
+                ar.in_order = [g]
+                ar.out_order = [g]
+                ops.append(ar)
+            return ops
+
+        insert_before_first_update(block, build)

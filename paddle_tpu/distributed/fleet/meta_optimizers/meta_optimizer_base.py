@@ -1,5 +1,39 @@
 """MetaOptimizerBase (fleet/meta_optimizers/meta_optimizer_base.py parity)."""
 
+from ....static.backward import GRAD_SUFFIX
+
+UPDATE_OP_TYPES = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
+                   "adagrad", "adadelta", "adamax"}
+
+
+def collect_param_grad_names(block):
+    """Grad vars whose base var is a parameter — the only grads that cross
+    replicas (activation grads are replica-local and dead after backward)."""
+    names = []
+    for op in block.ops:
+        for out in getattr(op, "out_order", []):
+            if not out.endswith(GRAD_SUFFIX) or out in names:
+                continue
+            base = block.vars.get(out[:-len(GRAD_SUFFIX)])
+            if base is not None and base.is_parameter:
+                names.append(out)
+    return names
+
+
+def insert_before_first_update(block, build_ops):
+    """Rebuild the op list with `build_ops()` results spliced in right
+    before the first optimizer-update op (raw_program_optimizer.py:158
+    insertion point)."""
+    final_ops = []
+    inserted = False
+    for op in block.ops:
+        if not inserted and op.type in UPDATE_OP_TYPES:
+            final_ops.extend(build_ops())
+            inserted = True
+        final_ops.append(op)
+    block.ops[:] = final_ops
+    return inserted
+
 
 class MetaOptimizerBase:
     def __init__(self, optimizer):

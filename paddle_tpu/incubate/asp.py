@@ -21,8 +21,14 @@ __all__ = ["prune_model", "decorate", "calculate_density",
            "create_mask", "check_sparsity", "reset_excluded_layers",
            "set_excluded_layers"]
 
-_masks = {}  # id(param) -> mask array
+_MASK_ATTR = "_asp_mask"  # mask lives on the param Tensor itself, so its
+# lifetime is the parameter's (an id()-keyed registry would leak and could
+# hit a recycled id)
 _excluded = set()
+
+
+def get_mask(param):
+    return getattr(param, _MASK_ATTR, None)
 
 
 def set_excluded_layers(main_program=None, param_names=()):
@@ -80,7 +86,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
             continue
         mask = create_mask(p.numpy(), n=n, m=m)
         p._data = p._data * jnp.asarray(mask)
-        _masks[id(p)] = mask
+        setattr(p, _MASK_ATTR, mask)
         out[name] = mask
     return out
 
@@ -94,7 +100,7 @@ class OptimizerWithSparsityGuarantee:
     def step(self):
         self._inner.step()
         for p in self._inner._parameter_list or ():
-            mask = _masks.get(id(p))
+            mask = get_mask(p)
             if mask is not None:
                 p._data = p._data * jnp.asarray(mask)
 

@@ -1,0 +1,16 @@
+"""dy2static: AST transpilation of Python control flow to compiled control
+flow.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ (9.1k LoC) —
+`ProgramTranslator` (program_translator.py:759) AST-rewrites if/while/for/
+bool-ops into graph ops (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py) via `convert_xxx` runtime shims
+(convert_operators.py).
+
+TPU-native: the same two-stage design, but the convert shims dispatch to
+`lax.cond` / `lax.while_loop` when the condition is a traced value and fall
+back to plain Python otherwise, so one transformed source runs correctly in
+both eager and jit modes.
+"""
+from .transformer import transform_function  # noqa: F401
+from . import convert_ops  # noqa: F401

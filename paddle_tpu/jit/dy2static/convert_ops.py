@@ -1,0 +1,201 @@
+"""Runtime conversion shims the transformed AST calls into.
+
+Reference: dygraph_to_static/convert_operators.py — `convert_ifelse`,
+`convert_while_loop`, `convert_logical_{and,or,not}`, `convert_len`.  Each
+shim checks whether the condition is a traced tensor: tensor conditions
+lower to lax control-flow primitives, Python conditions run as plain Python
+(so the same transformed source serves eager and compiled execution).
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+class _Undefined:
+    """Placeholder for names not yet bound (reference: UndefinedVar)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name=""):
+        self.name = name
+
+    def __repr__(self):
+        return f"UNDEF({self.name})"
+
+
+UNDEF = _Undefined()
+
+
+def _is_traced(x):
+    if isinstance(x, Tensor):
+        return isinstance(x._data, jax.core.Tracer)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _to_bool_scalar(pred):
+    return jnp.reshape(_raw(pred), ()).astype(bool)
+
+
+def _wrap_like(template, val):
+    if isinstance(template, Tensor):
+        t = Tensor.__new__(Tensor)
+        t._data = val
+        t.stop_gradient = template.stop_gradient
+        t.grad = None
+        t._node = None
+        t._out_index = 0
+        t.name = getattr(template, "name", None)
+        t.persistable = getattr(template, "persistable", False)
+        return t
+    return val
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
+    """Transformed `if` dispatch (convert_operators.py convert_ifelse).
+
+    true_fn/false_fn mutate the enclosing frame via nonlocal; get_args/
+    set_args snapshot and restore the branch-written names.
+    """
+    if not _is_traced(pred):
+        p = _raw(pred)
+        try:
+            flag = bool(p)
+        except Exception:
+            flag = bool(jnp.any(p))
+        (true_fn if flag else false_fn)()
+        return
+
+    init = get_args()
+
+    def run(branch_fn):
+        def f(_):
+            set_args(init)
+            branch_fn()
+            outs = get_args()
+            for n, v in zip(names, outs):
+                if isinstance(v, _Undefined):
+                    raise ValueError(
+                        f"variable {n!r} must be assigned in both branches "
+                        f"of a tensor-condition `if` (it is undefined in "
+                        f"one branch)")
+            return tuple(_raw(v) for v in outs)
+
+        return f
+
+    out = jax.lax.cond(_to_bool_scalar(pred), run(true_fn), run(false_fn),
+                       0)
+    # re-wrap: keep Tensor-ness of the pre-branch value when known,
+    # else wrap arrays as Tensors (branch-created values)
+    final = []
+    for i, o in zip(init, out):
+        if isinstance(i, Tensor):
+            final.append(_wrap_like(i, o))
+        elif isinstance(i, _Undefined):
+            final.append(Tensor(o, stop_gradient=True))
+        else:
+            final.append(o)
+    set_args(tuple(final))
+
+
+def convert_while_loop(cond_fn, body_fn, get_args, set_args, names):
+    """Transformed `while` dispatch (convert_operators.py
+    convert_while_loop).
+
+    Limitation vs the reference's while_op: XLA cannot reverse-differentiate
+    a dynamic-trip-count loop (lax.while_loop transpose is undefined), so a
+    tensor-condition `while` is forward/inference-only; training loops need
+    a static trip count (python ints — unrolled) or `lax.scan`-style fixed
+    lengths.  jax raises a descriptive error if grads are requested.
+    """
+    # probe the condition once with current state to pick the mode
+    first = cond_fn()
+    if not _is_traced(first):
+        while bool(_raw(cond_fn())):
+            body_fn()
+        return
+
+    init = get_args()
+    for n, v in zip(names, init):
+        if isinstance(v, _Undefined):
+            raise ValueError(
+                f"loop variable {n!r} must be defined before a "
+                f"tensor-condition `while`")
+    templates = list(init)
+
+    def c(vals):
+        set_args(tuple(_wrap_like(t, v) if isinstance(t, Tensor) else v
+                       for t, v in zip(templates, vals)))
+        return _to_bool_scalar(cond_fn())
+
+    def b(vals):
+        set_args(tuple(_wrap_like(t, v) if isinstance(t, Tensor) else v
+                       for t, v in zip(templates, vals)))
+        body_fn()
+        return tuple(_raw(v) for v in get_args())
+
+    out = jax.lax.while_loop(c, b, tuple(_raw(v) for v in init))
+    set_args(tuple(_wrap_like(t, v) if isinstance(t, Tensor) else v
+                   for t, v in zip(templates, out)))
+
+
+def _value_semantics_possible(lraw, rraw):
+    """Python and/or return an operand, not a bool.  That is reproducible
+    under tracing only for size-1 operands of equal shape/dtype (truthiness
+    of larger tensors is ambiguous, exactly as in eager mode)."""
+    import numpy as _np
+
+    return (getattr(lraw, "size", None) == 1
+            and getattr(rraw, "shape", None) == getattr(lraw, "shape", None)
+            and getattr(rraw, "dtype", None) == getattr(lraw, "dtype", None)
+            and lraw.dtype != _np.dtype(bool))
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs and rhs_fn()  # preserve Python short-circuit
+    rhs = rhs_fn()
+    lraw, rraw = _raw(lhs), _raw(rhs)
+    try:
+        if _value_semantics_possible(lraw, rraw):
+            # python `a and b` yields b when a is truthy, else a
+            return _wrap_like(lhs, jnp.where(
+                jnp.reshape(lraw, ()).astype(bool), rraw, lraw))
+    except Exception:
+        pass
+    return _wrap_like(lhs, jnp.logical_and(
+        lraw.astype(bool), _raw(rhs).astype(bool)))
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs or rhs_fn()
+    rhs = rhs_fn()
+    lraw, rraw = _raw(lhs), _raw(rhs)
+    try:
+        if _value_semantics_possible(lraw, rraw):
+            # python `a or b` yields a when a is truthy, else b
+            return _wrap_like(lhs, jnp.where(
+                jnp.reshape(lraw, ()).astype(bool), lraw, rraw))
+    except Exception:
+        pass
+    return _wrap_like(lhs, jnp.logical_or(
+        lraw.astype(bool), _raw(rhs).astype(bool)))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not x
+    return _wrap_like(x, jnp.logical_not(_raw(x).astype(bool)))
+
+
+def convert_len(x):
+    if isinstance(x, Tensor):
+        return x.shape[0]
+    return len(x)

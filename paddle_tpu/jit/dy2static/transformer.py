@@ -1,0 +1,267 @@
+"""AST transformer: rewrite if/while/bool-ops into convert_ops shims.
+
+Reference: dygraph_to_static/ifelse_transformer.py (branch bodies hoisted to
+local functions over get_args/set_args closures), loop_transformer.py,
+logical_transformer.py, and program_translator.py's source round-trip
+(inspect.getsource -> transform -> exec in the original globals).
+"""
+import ast
+import functools
+import inspect
+import textwrap
+
+_PT = "_paddle_tpu_d2s"  # name the shims are bound to in the exec namespace
+
+
+def _store_names(nodes):
+    """Names assigned anywhere in the statement list (reference:
+    get_name_ids on Store contexts)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                if node.id not in out:
+                    out.append(node.id)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):  # don't descend into nested defs
+            if node.name not in out:
+                out.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_AugAssign(self, node):
+            t = node.target
+            if isinstance(t, ast.Name) and t.id not in out:
+                out.append(t.id)
+            self.generic_visit(node)
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _has_return(nodes):
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return v.found
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+        self.failed = None
+
+    def _uid(self):
+        self._counter += 1
+        return self._counter
+
+    # --- helpers building the get/set/nonlocal scaffolding ---
+    def _scaffold(self, names, uid):
+        names_tuple = ", ".join(names) + ("," if len(names) == 1 else "")
+        get_src = (f"def _pt_get_{uid}():\n"
+                   + (f"    nonlocal {', '.join(names)}\n" if names else "")
+                   + f"    return ({names_tuple})\n")
+        set_src = (f"def _pt_set_{uid}(_pt_vals):\n"
+                   + (f"    nonlocal {', '.join(names)}\n" if names else "")
+                   + (f"    ({names_tuple}) = _pt_vals\n" if names
+                      else "    pass\n"))
+        return get_src, set_src
+
+    def _init_undefined(self, names):
+        """`try: x\nexcept NameError: x = UNDEF` per name, so the nonlocal
+        declarations in the scaffolding always have a binding (reference:
+        create_undefined_var)."""
+        stmts = []
+        for n in names:
+            src = (f"try:\n    {n}\nexcept (NameError, UnboundLocalError):\n"
+                   f"    {n} = {_PT}.UNDEF")
+            stmts.extend(ast.parse(src).body)
+        return stmts
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        # `if` with returns inside is left as plain Python (the reference
+        # rewrites returns too; tensor-cond + return raises in convert shim
+        # when it would matter because the branch fn yields no value)
+        if _has_return(node.body) or _has_return(node.orelse):
+            return node
+        # break/continue/yield can't cross the hoisted-function boundary
+        for sub in ast.walk(ast.Module(body=node.body + node.orelse,
+                                       type_ignores=[])):
+            if isinstance(sub, (ast.Break, ast.Continue, ast.Yield,
+                                ast.YieldFrom)):
+                return node
+        uid = self._uid()
+        names = sorted(set(_store_names(node.body))
+                       | set(_store_names(node.orelse)))
+        names = [n for n in names if not n.startswith("_pt_")]
+        get_src, set_src = self._scaffold(names, uid)
+        nl = f"    nonlocal {', '.join(names)}\n" if names else ""
+        true_def = ast.parse(f"def _pt_true_{uid}():\n{nl}    pass").body[0]
+        true_def.body = true_def.body[:-1] + node.body if names else node.body
+        false_def = ast.parse(f"def _pt_false_{uid}():\n{nl}    pass").body[0]
+        false_body = node.orelse or [ast.Pass()]
+        false_def.body = false_def.body[:-1] + false_body if names \
+            else false_body
+        call = ast.parse(
+            f"{_PT}.convert_ifelse(_pt_cond_{uid}, _pt_true_{uid}, "
+            f"_pt_false_{uid}, _pt_get_{uid}, _pt_set_{uid}, "
+            f"{names!r})").body[0]
+        cond_assign = ast.parse(f"_pt_cond_{uid} = 0").body[0]
+        cond_assign.value = node.test
+        out = self._init_undefined(names)
+        out.append(cond_assign)
+        out.extend(ast.parse(get_src).body)
+        out.extend(ast.parse(set_src).body)
+        out.append(true_def)
+        out.append(false_def)
+        out.append(call)
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in out]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_return(node.body) or node.orelse:
+            return node
+        # break/continue/yield can't cross the hoisted-function boundary
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, (ast.Break, ast.Continue, ast.Yield,
+                                ast.YieldFrom)):
+                return node
+        uid = self._uid()
+        # loop vars = names assigned in the body; names the condition reads
+        # but the body never writes are loop-invariant and ride the closure
+        names = [n for n in _store_names(node.body)
+                 if not n.startswith("_pt_")]
+        names = sorted(names)
+        get_src, set_src = self._scaffold(names, uid)
+        nl = f"    nonlocal {', '.join(names)}\n" if names else ""
+        cond_def = ast.parse(
+            f"def _pt_wcond_{uid}():\n{nl}    return 0").body[0]
+        ret = cond_def.body[-1]
+        ret.value = node.test
+        body_def = ast.parse(f"def _pt_wbody_{uid}():\n{nl}    pass").body[0]
+        body_def.body = body_def.body[:-1] + node.body if names \
+            else node.body
+        call = ast.parse(
+            f"{_PT}.convert_while_loop(_pt_wcond_{uid}, _pt_wbody_{uid}, "
+            f"_pt_get_{uid}, _pt_set_{uid}, {names!r})").body[0]
+        out = self._init_undefined(names)
+        out.extend(ast.parse(get_src).body)
+        out.extend(ast.parse(set_src).body)
+        out.append(cond_def)
+        out.append(body_def)
+        out.append(call)
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in out]
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        shim = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[0]
+        for nxt in node.values[1:]:
+            lhs_lam = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=expr)
+            rhs_lam = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=nxt)
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_PT, ctx=ast.Load()),
+                    attr=shim, ctx=ast.Load()),
+                args=[lhs_lam, rhs_lam], keywords=[])
+        return ast.fix_missing_locations(ast.copy_location(expr, node))
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            call = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_PT, ctx=ast.Load()),
+                    attr="convert_logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+            return ast.fix_missing_locations(ast.copy_location(call, node))
+        return node
+
+
+def _has_control_flow(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While, ast.BoolOp)):
+            return True
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return True
+    return False
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_source(source, filename, freevars):
+    tree = ast.parse(source)
+    fn_def = tree.body[0]
+    if not _has_control_flow(fn_def):
+        return None, fn_def.name  # nothing to rewrite — keep the original
+    # strip decorators: the transformed def must not re-apply @to_static
+    fn_def.decorator_list = []
+    t = _ControlFlowTransformer()
+    new_tree = t.visit(tree)
+    ast.fix_missing_locations(new_tree)
+    # wrap in a factory taking the original freevars, so the re-exec'd def
+    # regains real closure cells — zero-arg super() needs the `__class__`
+    # cell, and closures must see live values (reference: the
+    # function-scope cache in program_translator)
+    factory = ast.parse(
+        f"def _pt_factory({', '.join(freevars) if freevars else ''}):\n"
+        f"    return None").body[0]
+    factory.body = new_tree.body + [ast.parse(
+        f"return {fn_def.name}").body[0]]
+    mod = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    return compile(mod, filename=filename, mode="exec"), fn_def.name
+
+
+def transform_function(fn):
+    """Source-rewrite `fn`; returns the transformed function, or `fn`
+    unchanged when there is no control flow to rewrite, the source is
+    unavailable (lambdas, REPL) or the transform fails (reference falls
+    back the same way)."""
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+        freevars = tuple(fn.__code__.co_freevars)
+        code, name = _transform_source(
+            source, f"<dy2static {getattr(fn, '__qualname__', fn)}>",
+            freevars)
+        if code is None:
+            return fn
+        cells = []
+        for var, cell in zip(freevars, fn.__closure__ or ()):
+            try:
+                cells.append(cell.cell_contents)
+            except ValueError:
+                return fn  # unfillable cell — keep the original
+        namespace = dict(fn.__globals__)
+        from . import convert_ops
+
+        namespace[_PT] = convert_ops
+        exec(code, namespace)
+        new_fn = namespace["_pt_factory"](*cells)
+        new_fn.__wrapped_original__ = fn
+        return new_fn
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn

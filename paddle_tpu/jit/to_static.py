@@ -24,6 +24,20 @@ from ..nn.layer import Layer
 
 class StaticFunction:
     def __init__(self, fn, layer=None, input_spec=None):
+        self._original_fn = fn
+        if not getattr(fn, "_not_to_static", False):
+            # dy2static AST pass: rewrite data-dependent Python control flow
+            # into lax.cond/while via convert shims (falls back to the
+            # unmodified fn when the source can't be transformed)
+            from .dy2static import transform_function
+
+            fn = transform_function(fn)
+            if layer is not None and fn is not self._original_fn:
+                # transformed source lost its bound instance
+                _unbound = fn
+
+                def fn(*args, **kwargs):
+                    return _unbound(layer, *args, **kwargs)
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
@@ -96,7 +110,7 @@ class StaticFunction:
     def code(self):
         import inspect
 
-        return inspect.getsource(self._fn)
+        return inspect.getsource(self._original_fn)
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):

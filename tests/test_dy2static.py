@@ -1,0 +1,192 @@
+"""dy2static AST transpiler tests: data-dependent Python control flow
+compiles under jit via the convert shims.
+
+Ref: dygraph_to_static tests (test_ifelse.py, test_loop.py,
+test_logical.py) — the reference asserts dygraph == transformed-static
+outputs; same oracle here.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import transform_function
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_transform_if_on_tensor():
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    g = transform_function(f)
+    assert g is not f
+    # eager semantics preserved (concrete values -> plain python if)
+    np.testing.assert_allclose(g(_t([1.0, 2.0])).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose(g(_t([-1.0, -2.0])).numpy(), [-2.0, -3.0])
+
+
+def test_jit_with_data_dependent_if():
+    """Under @to_static the tensor-cond `if` must compile (lax.cond), which
+    plain tracing cannot do."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x * 2.0
+        else:
+            y = x * -1.0
+        return y
+
+    pos = f(_t([1.0, 3.0]))
+    np.testing.assert_allclose(pos.numpy(), [2.0, 6.0])
+    neg = f(_t([-1.0, -3.0]))  # same shapes -> same cached computation
+    np.testing.assert_allclose(neg.numpy(), [1.0, 3.0])
+
+
+def test_jit_while_loop():
+    @paddle.jit.to_static
+    def f(x, n):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            x = x + 1.0
+            i = i + 1.0
+        return x
+
+    out = f(_t([0.0, 10.0]), _t(5.0))
+    np.testing.assert_allclose(out.numpy(), [5.0, 15.0])
+
+
+def test_logical_ops_traced_and_python():
+    def f(x, flag):
+        if flag and paddle.mean(x) > 0:
+            return x + 100.0
+        return x
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t([1.0]), True).numpy(), [101.0])
+    np.testing.assert_allclose(g(_t([1.0]), False).numpy(), [1.0])
+
+
+def test_branch_var_must_exist_in_both():
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x + 1.0
+        else:
+            z = x - 1.0  # different name: y undefined in this branch
+        return x
+
+    with pytest.raises(ValueError, match="both branches"):
+        f(_t([1.0]))
+
+
+def test_layer_forward_with_control_flow():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if paddle.mean(h) > 0:
+                out = h * 2.0
+            else:
+                out = h * 0.5
+            return out
+
+    paddle.seed(0)
+    net = Gate()
+    x = _t(np.random.RandomState(0).randn(2, 4))
+    with paddle.no_grad():
+        want = net(x).numpy()  # eager reference before wrapping
+    paddle.jit.to_static(net)
+    got = net(x)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-5)
+
+
+def test_value_semantics_or_and_traced():
+    """Python and/or return operands; the traced scalar path must too."""
+
+    @paddle.jit.to_static
+    def f(x, y):
+        return (x or y) + 1.0, (x and y) + 1.0
+
+    x, y = _t(3.0), _t(5.0)
+    o, a = f(x, y)
+    np.testing.assert_allclose(o.numpy(), 4.0)  # x truthy -> x
+    np.testing.assert_allclose(a.numpy(), 6.0)  # x truthy -> y
+    z = _t(0.0)
+    o2, a2 = f(z, y)
+    np.testing.assert_allclose(o2.numpy(), 6.0)  # x falsy -> y
+    np.testing.assert_allclose(a2.numpy(), 1.0)  # x falsy -> x
+
+
+def test_super_and_control_flow():
+    """Zero-arg super() keeps its __class__ cell through the re-exec."""
+
+    class Base(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    class Child(Base):
+        def forward(self, x):
+            h = super().forward(x)
+            if paddle.mean(h) > 1e9:
+                h = h * 0.0
+            return h + 1.0
+
+    paddle.seed(0)
+    net = Child()
+    x = _t(np.ones((2, 4)))
+    with paddle.no_grad():
+        want = net(x).numpy()
+    paddle.jit.to_static(net)
+    got = net(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_break_inside_if_falls_back_cleanly():
+    """A python-loop `if ... break` must not kill the whole transform."""
+
+    def f(x):
+        total = x * 0.0
+        for i in range(5):
+            if i == 3:
+                break
+            total = total + x
+        if paddle.mean(x) > 0:  # this if still gets transformed
+            total = total + 100.0
+        else:
+            total = total - 100.0
+        return total
+
+    g = transform_function(f)
+    assert g is not f  # transform succeeded despite the break
+    np.testing.assert_allclose(g(_t([1.0])).numpy(), [103.0])
+
+
+def test_python_control_flow_unchanged():
+    """Non-tensor conditions keep exact Python semantics (incl. loops over
+    python ints)."""
+
+    def f(xs, k):
+        total = 0.0
+        i = 0
+        while i < k:  # python ints: stays a python loop
+            total = total + xs[i]
+            i = i + 1
+        return total
+
+    g = transform_function(f)
+    assert g([1.0, 2.0, 3.0], 2) == 3.0
