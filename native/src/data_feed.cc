@@ -1,0 +1,217 @@
+// Native data feed: threaded file readers + parsers for the dataset path.
+//
+// Reference: paddle/fluid/framework/data_feed.{h,cc} (1703 LoC) —
+// MultiSlotDataFeed parses "slot:nums v v v ..." text records on reader
+// threads; data_set.cc shards files across channels.  TPU-native role: the
+// same host-side parse/batch pipeline feeding the device via the prefetch
+// queue (queue.cc); device transfer stays in Python (jax.device_put).
+//
+// Formats:
+//   * CSV  — one sample per line, float fields, optional int label column.
+//   * MultiSlot — reference text format: per line, repeated
+//       "<num> v1 ... vnum" groups, one group per slot (data_feed.cc
+//       MultiSlotDataFeed::ParseOneInstance).
+//
+// C ABI (ctypes): a reader owns worker threads that parse file shards into
+// a bounded batch queue; ptn_feed_next_batch pops one contiguous
+// float32/int64 batch (caller frees via ptn_bytes_free).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace ptn {
+
+struct Batch {
+  std::vector<float> values;  // [batch, feature_dim] row-major
+  std::vector<int64_t> labels;
+  int rows = 0;
+  int cols = 0;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<std::string> files, int batch_size, int num_threads,
+           int label_col, int queue_cap, bool multislot)
+      : files_(std::move(files)),
+        batch_size_(batch_size),
+        label_col_(label_col),
+        queue_cap_(queue_cap),
+        multislot_(multislot) {
+    next_file_.store(0);
+    // count workers BEFORE spawning: a consumer that calls Next() first
+    // must not mistake "threads not scheduled yet" for "drained"
+    live_workers_ = num_threads;
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { Run(); });
+    }
+  }
+
+  ~DataFeed() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_pop_.notify_all();
+    cv_push_.notify_all();
+    for (auto& t : workers_) {
+      if (t.joinable()) t.join();
+    }
+  }
+
+  // Pops one batch; returns false when all files are drained.
+  bool Next(Batch* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [this] {
+      return !queue_.empty() || (live_workers_ == 0) || stop_;
+    });
+    if (queue_.empty()) return false;
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    cv_push_.notify_one();
+    return true;
+  }
+
+ private:
+  bool Stopped() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return stop_;
+  }
+
+  void Run() {
+    Batch cur;
+    for (;;) {
+      if (Stopped()) break;
+      size_t idx = next_file_.fetch_add(1);
+      if (idx >= files_.size()) break;
+      std::ifstream in(files_[idx]);
+      if (!in) continue;
+      std::string line;
+      int checked = 0;
+      while (std::getline(in, line)) {
+        // destroy() must not wait for the rest of the dataset to parse
+        if (((++checked) & 1023) == 0 && Stopped()) return;
+        if (line.empty()) continue;
+        if (!ParseLine(line, &cur)) {
+          // column-count change (new file width): flush the pending
+          // partial batch and retry so the new file isn't silently lost
+          if (cur.rows > 0) {
+            Flush(&cur);
+            ParseLine(line, &cur);
+          }
+          continue;
+        }
+        if (cur.rows == batch_size_) Flush(&cur);
+      }
+    }
+    if (cur.rows > 0) Flush(&cur);
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--live_workers_ == 0) cv_pop_.notify_all();
+  }
+
+  bool ParseLine(const std::string& line, Batch* cur) {
+    std::istringstream ss(line);
+    std::vector<float> vals;
+    int64_t label = -1;
+    if (multislot_) {
+      // "<num> v..." repeated; all slots concatenate into the feature row
+      int num;
+      while (ss >> num) {
+        for (int i = 0; i < num; ++i) {
+          float v;
+          if (!(ss >> v)) return false;
+          vals.push_back(v);
+        }
+      }
+    } else {
+      std::string field;
+      int col = 0;
+      while (std::getline(ss, field, ',')) {
+        if (col == label_col_) {
+          label = std::strtoll(field.c_str(), nullptr, 10);
+        } else {
+          vals.push_back(std::strtof(field.c_str(), nullptr));
+        }
+        ++col;
+      }
+    }
+    if (vals.empty()) return false;
+    if (cur->rows == 0) cur->cols = static_cast<int>(vals.size());
+    if (static_cast<int>(vals.size()) != cur->cols) return false;  // ragged
+    cur->values.insert(cur->values.end(), vals.begin(), vals.end());
+    cur->labels.push_back(label);
+    ++cur->rows;
+    return true;
+  }
+
+  void Flush(Batch* cur) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [this] {
+      return static_cast<int>(queue_.size()) < queue_cap_ || stop_;
+    });
+    if (stop_) {
+      *cur = Batch{};
+      return;
+    }
+    queue_.push_back(std::move(*cur));
+    *cur = Batch{};
+    cv_pop_.notify_one();
+  }
+
+  std::vector<std::string> files_;
+  int batch_size_;
+  int label_col_;
+  int queue_cap_;
+  bool multislot_;
+  std::atomic<size_t> next_file_;
+  std::vector<std::thread> workers_;
+  std::deque<Batch> queue_;
+  std::mutex mu_;
+  std::condition_variable cv_pop_, cv_push_;
+  int live_workers_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace ptn
+
+extern "C" {
+
+void* ptn_feed_create(const char** files, int n_files, int batch_size,
+                      int num_threads, int label_col, int queue_cap,
+                      int multislot) {
+  std::vector<std::string> fs(files, files + n_files);
+  return new ptn::DataFeed(std::move(fs), batch_size,
+                           num_threads > 0 ? num_threads : 1, label_col,
+                           queue_cap > 0 ? queue_cap : 8, multislot != 0);
+}
+
+// Returns 1 and fills outputs on success, 0 when drained.  values is
+// rows*cols float32, labels is rows int64; both freed by ptn_bytes_free.
+int ptn_feed_next_batch(void* handle, float** values, int64_t** labels,
+                        int* rows, int* cols) {
+  ptn::Batch b;
+  if (!static_cast<ptn::DataFeed*>(handle)->Next(&b)) return 0;
+  *rows = b.rows;
+  *cols = b.cols;
+  *values = static_cast<float*>(
+      std::malloc(sizeof(float) * b.values.size()));
+  std::memcpy(*values, b.values.data(), sizeof(float) * b.values.size());
+  *labels = static_cast<int64_t*>(
+      std::malloc(sizeof(int64_t) * b.labels.size()));
+  std::memcpy(*labels, b.labels.data(), sizeof(int64_t) * b.labels.size());
+  return 1;
+}
+
+void ptn_feed_destroy(void* handle) {
+  delete static_cast<ptn::DataFeed*>(handle);
+}
+
+}  // extern "C"

@@ -10,3 +10,4 @@ a background prefetch thread).
 from .dataset import Dataset, IterableDataset, TensorDataset, ComposeDataset, Subset, random_split  # noqa: F401
 from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .file_feed import FileDataFeed  # noqa: F401

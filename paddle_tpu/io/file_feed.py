@@ -1,0 +1,36 @@
+"""File-backed dataset over the native C++ data feed.
+
+Reference: framework/data_feed.cc MultiSlotDataFeed + fleet
+dataset/dataset.py (InMemoryDataset/QueueDataset) — files shard across C++
+reader threads, parsed batches flow through a bounded queue.  TPU-native:
+the iterator yields host numpy batches; callers (or DataLoader) device_put
+them, keeping parse off the Python GIL.
+"""
+from ..native import NativeDataFeed, available
+from .dataset import IterableDataset
+
+
+class FileDataFeed(IterableDataset):
+    """Iterable dataset of (features, labels) batches parsed natively.
+
+    format: "csv" (one sample per line, float fields, `label_col` the int
+    label column) or "multislot" (the reference's slot text format).
+    """
+
+    def __init__(self, files, batch_size, fmt="csv", num_threads=2,
+                 label_col=-1, queue_cap=8):
+        if not available():
+            raise RuntimeError(
+                "native runtime unavailable; FileDataFeed needs the C++ "
+                "data feed (see paddle_tpu/native)")
+        self._args = dict(files=list(files), batch_size=batch_size,
+                          num_threads=num_threads, label_col=label_col,
+                          queue_cap=queue_cap,
+                          multislot=(fmt == "multislot"))
+
+    def __iter__(self):
+        from ..core.tensor import to_tensor
+
+        feed = NativeDataFeed(**self._args)
+        for feats, labels in feed:
+            yield to_tensor(feats), to_tensor(labels)

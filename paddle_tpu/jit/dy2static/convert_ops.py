@@ -43,12 +43,9 @@ def _to_bool_scalar(pred):
 
 def _wrap_like(template, val):
     if isinstance(template, Tensor):
-        t = Tensor.__new__(Tensor)
-        t._data = val
-        t.stop_gradient = template.stop_gradient
-        t.grad = None
-        t._node = None
-        t._out_index = 0
+        from ...core.tensor import _wrap_data
+
+        t = _wrap_data(val, stop_gradient=template.stop_gradient)
         t.name = getattr(template, "name", None)
         t.persistable = getattr(template, "persistable", False)
         return t
@@ -62,12 +59,9 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
     set_args snapshot and restore the branch-written names.
     """
     if not _is_traced(pred):
-        p = _raw(pred)
-        try:
-            flag = bool(p)
-        except Exception:
-            flag = bool(jnp.any(p))
-        (true_fn if flag else false_fn)()
+        # bool() raises on multi-element tensors exactly like untransformed
+        # eager code — the transform must not change truthiness semantics
+        (true_fn if bool(_raw(pred)) else false_fn)()
         return
 
     init = get_args()
@@ -112,11 +106,14 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args, names):
     a static trip count (python ints — unrolled) or `lax.scan`-style fixed
     lengths.  jax raises a descriptive error if grads are requested.
     """
-    # probe the condition once with current state to pick the mode
+    # probe the condition once with current state to pick the mode; the
+    # probe result drives the first iteration (conditions may side-effect)
     first = cond_fn()
     if not _is_traced(first):
-        while bool(_raw(cond_fn())):
+        flag = bool(_raw(first))
+        while flag:
             body_fn()
+            flag = bool(_raw(cond_fn()))
         return
 
     init = get_args()
@@ -169,7 +166,7 @@ def convert_logical_and(lhs_fn, rhs_fn):
     except Exception:
         pass
     return _wrap_like(lhs, jnp.logical_and(
-        lraw.astype(bool), _raw(rhs).astype(bool)))
+        jnp.asarray(lraw).astype(bool), jnp.asarray(rraw).astype(bool)))
 
 
 def convert_logical_or(lhs_fn, rhs_fn):
@@ -186,7 +183,7 @@ def convert_logical_or(lhs_fn, rhs_fn):
     except Exception:
         pass
     return _wrap_like(lhs, jnp.logical_or(
-        lraw.astype(bool), _raw(rhs).astype(bool)))
+        jnp.asarray(lraw).astype(bool), jnp.asarray(rraw).astype(bool)))
 
 
 def convert_logical_not(x):

@@ -84,6 +84,12 @@ def _declare(lib):
         "ptn_queue_bytes": (u64, [p]),
         "ptn_queue_destroy": (None, [p]),
         "ptn_bytes_free": (None, [p]),
+        "ptn_feed_create": (p, [c.POINTER(cp), i32, i32, i32, i32, i32,
+                                i32]),
+        "ptn_feed_next_batch": (c.c_int, [p, c.POINTER(c.POINTER(c.c_float)),
+                                          c.POINTER(c.POINTER(i64)),
+                                          c.POINTER(i32), c.POINTER(i32)]),
+        "ptn_feed_destroy": (None, [p]),
         "ptn_version": (cp, []),
     }
     for name, (restype, argtypes) in sigs.items():
@@ -283,3 +289,64 @@ class PrefetchQueue:
 
     def qsize(self):
         return self._lib.ptn_queue_size(self._h) if self._h else 0
+
+
+class NativeDataFeed:
+    """Threaded C++ file reader/parser (framework/data_feed.cc parity).
+
+    Iterates (features float32 [rows, cols], labels int64 [rows]) batches
+    parsed off the GIL on C++ worker threads.  CSV (`label_col` selects the
+    int label column) or the reference's MultiSlot text format
+    (`multislot=True`, slots concatenated into the feature row).
+    """
+
+    def __init__(self, files, batch_size, num_threads=2, label_col=-1,
+                 queue_cap=8, multislot=False):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_lib_err}")
+        self._files = [os.fsencode(f) for f in files]
+        arr = (ctypes.c_char_p * len(self._files))(*self._files)
+        self._h = self._lib.ptn_feed_create(
+            arr, len(self._files), int(batch_size), int(num_threads),
+            int(label_col), int(queue_cap), 1 if multislot else 0)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import numpy as np
+
+        if self._h is None:
+            raise StopIteration
+        vals = ctypes.POINTER(ctypes.c_float)()
+        labs = ctypes.POINTER(ctypes.c_int64)()
+        rows = ctypes.c_int32()
+        cols = ctypes.c_int32()
+        ok = self._lib.ptn_feed_next_batch(
+            self._h, ctypes.byref(vals), ctypes.byref(labs),
+            ctypes.byref(rows), ctypes.byref(cols))
+        if not ok:
+            self.close()
+            raise StopIteration
+        r, c = rows.value, cols.value
+        try:
+            feats = np.ctypeslib.as_array(vals, shape=(r, c)).copy()
+            labels = np.ctypeslib.as_array(labs, shape=(r,)).copy()
+        finally:
+            self._lib.ptn_bytes_free(
+                ctypes.cast(vals, ctypes.c_void_p))
+            self._lib.ptn_bytes_free(
+                ctypes.cast(labs, ctypes.c_void_p))
+        return feats, labels
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ptn_feed_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -123,24 +123,51 @@ class CompiledTrainStep:
                     shape[i] //= size
             local_flat += int(np.prod(shape)) if shape else 1
         self._local_flat = local_flat
-        self._pad = (-local_flat) % dp
+        # pad the fused flat buffer to a multiple of lcm(dp, 1024): dp for
+        # the ZeRO shard split, 1024 (= 8x128 TPU tile) so XLA's layout
+        # factorization of the 1-D buffer lands on tile boundaries — an odd
+        # length factors as [N/2, 2] and tile-pads the trailing dim 2->128,
+        # a 64x HBM blowup that OOMs BERT-base at compile time
+        align = int(np.lcm(dp, 1024))
+        self._pad = (-local_flat) % align
         padded = local_flat + self._pad
         shard_len = padded // dp
         from ..core.tensor import _wrap_data as _w
 
-        fake = _w(jnp.zeros((shard_len if self.zero else padded,), jnp.float32))
-        self._flat_state_template = optimizer._init_state(fake)
-        self.flat_opt_state = {
-            # jnp.array copy: state entries may alias one buffer (e.g. Adam's
-            # two zero moments) and donation forbids duplicate buffers
-            k: jax.device_put(
-                jnp.array(jnp.tile(v, dp) if self.zero and v.ndim else v),
-                NamedSharding(
-                    mesh, P(self.dp_axis) if self.zero and v.ndim else P(),
-                ),
-            )
-            for k, v in self._flat_state_template.items()
-        }
+        if self.zero:
+            # ZeRO-1 keeps the FUSED flat buffer: it range-shards evenly
+            # over 'data' regardless of param boundaries
+            fake = _w(jnp.zeros((shard_len,), jnp.float32))
+            self._flat_state_template = optimizer._init_state(fake)
+            self.flat_opt_state = {
+                # jnp.array copy: state entries may alias one buffer (e.g.
+                # Adam's two zero moments) and donation forbids duplicates
+                k: jax.device_put(
+                    jnp.array(jnp.tile(v, dp) if v.ndim else v),
+                    NamedSharding(mesh, P(self.dp_axis) if v.ndim else P()),
+                )
+                for k, v in self._flat_state_template.items()
+            }
+        else:
+            # per-leaf optimizer state, sharded exactly like its param —
+            # no raveled mega-buffer (a 100M+-element 1-D array makes the
+            # TPU backend pick a catastrophic tiled layout, and XLA's
+            # all-reduce combiner already buckets the per-leaf grad
+            # reductions, which is the Reducer-fusion parity)
+            self._flat_state_template = None
+            self._tree_state_specs = {}
+            self.flat_opt_state = {}
+            for n, p in named.items():
+                st = optimizer._init_state_arrays(p._data)
+                specs, vals = {}, {}
+                for k, v in st.items():
+                    spec = self.param_specs[n] if v.ndim == p._data.ndim \
+                        and v.ndim > 0 else P()
+                    specs[k] = spec
+                    vals[k] = jax.device_put(
+                        jnp.array(v), NamedSharding(mesh, spec))
+                self._tree_state_specs[n] = specs
+                self.flat_opt_state[n] = vals
         self._jit_step = None
 
     # ---- step construction ----
@@ -174,7 +201,7 @@ class CompiledTrainStep:
 
         fused_update = make_fused_update(optimizer)
 
-        def spmd_step(params, flat_state, batch_vals, key, lr):
+        def spmd_step(params, opt_state, batch_vals, key, lr):
             if dp_axis is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
             if seq_axis is not None:
@@ -182,22 +209,20 @@ class CompiledTrainStep:
             loss, grads = jax.value_and_grad(local_loss)(
                 params, batch_vals, key
             )
-            gflat, _ = ravel_pytree(grads)
-            if seq_axis is not None and (zero or dp_axis is None):
-                # params replicated over 'seq': average the per-chunk grads.
-                # (In the plain-DP branch below this fuses with the 'data'
-                # pmean into one collective instead.)
-                gflat = jax.lax.pmean(gflat, seq_axis)
             if seq_axis is not None:
                 loss = jax.lax.pmean(loss, seq_axis)
-            pflat, unravel_local = ravel_pytree(params)
-            if pad:
-                zpad_g = jnp.zeros((pad,), gflat.dtype)
-                zpad_p = jnp.zeros((pad,), pflat.dtype)
-                gflat = jnp.concatenate([gflat, zpad_g])
-                pflat = jnp.concatenate([pflat, zpad_p])
-            local_size = pflat.shape[0] - pad
             if zero:
+                gflat, _ = ravel_pytree(grads)
+                if seq_axis is not None:
+                    # params replicated over 'seq': average per-chunk grads
+                    gflat = jax.lax.pmean(gflat, seq_axis)
+                pflat, unravel_local = ravel_pytree(params)
+                if pad:
+                    gflat = jnp.concatenate(
+                        [gflat, jnp.zeros((pad,), gflat.dtype)])
+                    pflat = jnp.concatenate(
+                        [pflat, jnp.zeros((pad,), pflat.dtype)])
+                local_size = pflat.shape[0] - pad
                 # ZeRO-1: ONE reduce_scatter of the fused grad buffer; each
                 # data rank updates its slice, then one all_gather of params
                 shard_len = pflat.shape[0] // dp
@@ -209,30 +234,40 @@ class CompiledTrainStep:
                 pshard = jax.lax.dynamic_slice_in_dim(
                     pflat, idx * shard_len, shard_len
                 )
-                new_p, new_flat_state = fused_update(
-                    pshard, gshard, flat_state, lr
+                new_p, new_state = fused_update(
+                    pshard, gshard, opt_state, lr
                 )
                 pflat_new = jax.lax.all_gather(new_p, dp_axis, tiled=True)
+                new_params_tree = unravel_local(pflat_new[:local_size])
             else:
-                if dp_axis is not None:
-                    # fused DP allreduce: ONE collective for ALL grads
-                    # (reducer.cc fused-bucket parity), folding in the
-                    # 'seq' reduction when context parallelism is active
-                    axes = ((seq_axis, dp_axis) if seq_axis is not None
-                            else dp_axis)
-                    gflat = jax.lax.pmean(gflat, axes)
-                pflat_new, new_flat_state = fused_update(
-                    pflat, gflat, flat_state, lr
-                )
-            new_params_tree = unravel_local(pflat_new[:local_size])
+                # per-leaf grads + update; XLA's all-reduce combiner fuses
+                # the per-leaf pmeans into bucketed collectives (the
+                # reducer.cc fused-bucket parity), folding in the 'seq'
+                # reduction when context parallelism is active
+                axes = None
+                if dp_axis is not None and seq_axis is not None:
+                    axes = (seq_axis, dp_axis)
+                elif dp_axis is not None:
+                    axes = dp_axis
+                elif seq_axis is not None:
+                    axes = seq_axis
+                if axes is not None:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: jax.lax.pmean(g, axes), grads)
+                new_params_tree, new_state = optimizer.fused_update(
+                    params, grads, opt_state, lr)
             if dp_axis is not None:
                 loss = jax.lax.pmean(loss, dp_axis)
-            return loss, new_params_tree, new_flat_state
+            return loss, new_params_tree, new_state
 
+        if self.zero:
+            state_specs = {k: (P(dp_axis) if v.ndim else P())
+                           for k, v in self._flat_state_template.items()}
+        else:
+            state_specs = self._tree_state_specs
         in_specs = (
             {n: s for n, s in self.param_specs.items()},
-            {k: (P(dp_axis) if self.zero and v.ndim else P())
-             for k, v in self._flat_state_template.items()},
+            state_specs,
             self._batch_pspecs(batch_avals),
             P(),
             P(),

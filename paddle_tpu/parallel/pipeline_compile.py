@@ -190,6 +190,12 @@ class PipelinedTrainStep:
                              for n, p in self.plan.other.items()})
         n_block = local_len(self.block_specs,
                             {r: a.shape for r, a in stacked.items()})
+        # fused flat buffers align to the 8x128 TPU tile (see hybrid.py:
+        # odd lengths factor into a tile-padded [N/k, k] layout, blowing
+        # up HBM at compile time)
+        self._pads = {"other": (-n_other) % 1024, "block": (-n_block) % 1024}
+        n_other += self._pads["other"]
+        n_block += self._pads["block"]
         self._opt_state = {}
         self._state_template = {}
         for group, ln in (("other", n_other), ("block", n_block)):
@@ -207,6 +213,7 @@ class PipelinedTrainStep:
         mesh, amp_dtype = self.mesh, self.amp_dtype
         S, M = self.S, self.num_micro
         dp_axis = self.dp_axis
+        pads = self._pads
 
         def cast(params):
             if amp_dtype is None:
@@ -303,7 +310,15 @@ class PipelinedTrainStep:
             }.items():
                 pflat, unravel = ravel_pytree(params)
                 gflat, _ = ravel_pytree(gtree)
+                padn = pads[group]
+                if padn:
+                    pflat = jnp.concatenate(
+                        [pflat, jnp.zeros((padn,), pflat.dtype)])
+                    gflat = jnp.concatenate(
+                        [gflat, jnp.zeros((padn,), gflat.dtype)])
                 pnew, snew = fused_update(pflat, gflat, state, lr)
+                if padn:
+                    pnew = pnew[:-padn]
                 new_params.append(unravel(pnew))
                 new_states.append(snew)
             return loss, new_params[0], new_params[1], new_states[0], \

@@ -1,0 +1,97 @@
+"""Native C++ data feed tests (framework/data_feed.cc parity).
+
+Ref test strategy: the reference's data_feed tests write temp MultiSlot
+files and assert parsed batch contents; same here, plus CSV and the
+training-loop integration.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.native import available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native toolchain unavailable")
+
+
+def _write_csv(path, rows, cols, label_col=None, seed=0):
+    rng = np.random.RandomState(seed)
+    data = rng.randn(rows, cols).astype(np.float32)
+    labels = rng.randint(0, 10, rows)
+    with open(path, "w") as f:
+        for i in range(rows):
+            fields = [f"{v:.6f}" for v in data[i]]
+            if label_col is not None:
+                fields.insert(label_col, str(labels[i]))
+            f.write(",".join(fields) + "\n")
+    return data, labels
+
+
+def test_csv_feed_batches(tmp_path):
+    from paddle_tpu.native import NativeDataFeed
+
+    f1 = str(tmp_path / "a.csv")
+    f2 = str(tmp_path / "b.csv")
+    d1, l1 = _write_csv(f1, 10, 4, label_col=0, seed=1)
+    d2, l2 = _write_csv(f2, 6, 4, label_col=0, seed=2)
+    feed = NativeDataFeed([f1, f2], batch_size=4, num_threads=2, label_col=0)
+    rows, all_feats, all_labels = 0, [], []
+    for feats, labels in feed:
+        assert feats.shape[1] == 4
+        assert feats.shape[0] == labels.shape[0] <= 4
+        rows += feats.shape[0]
+        all_feats.append(feats)
+        all_labels.append(labels)
+    assert rows == 16
+    # content check: every parsed row appears in the source data
+    src = np.concatenate([d1, d2])
+    got = np.concatenate(all_feats)
+    for r in got:
+        assert np.isclose(src, r, atol=1e-4).all(axis=1).any()
+
+
+def test_multislot_feed(tmp_path):
+    from paddle_tpu.native import NativeDataFeed
+
+    p = str(tmp_path / "slots.txt")
+    # reference format: "<num> v..." per slot; 2 slots of 2 and 3 values
+    with open(p, "w") as f:
+        f.write("2 1.0 2.0 3 10.0 20.0 30.0\n")
+        f.write("2 4.0 5.0 3 40.0 50.0 60.0\n")
+    feed = NativeDataFeed([p], batch_size=2, multislot=True)
+    feats, labels = next(iter(feed))
+    assert feats.shape == (2, 5)
+    np.testing.assert_allclose(feats[0], [1, 2, 10, 20, 30])
+    np.testing.assert_allclose(feats[1], [4, 5, 40, 50, 60])
+
+
+def test_file_datafeed_trains(tmp_path):
+    """FileDataFeed feeds a real training loop end to end."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io import FileDataFeed
+
+    # learnable mapping: label = argmax of first 3 features
+    rng = np.random.RandomState(0)
+    path = str(tmp_path / "train.csv")
+    with open(path, "w") as f:
+        for _ in range(256):
+            x = rng.randn(8).astype(np.float32)
+            y = int(np.argmax(x[:3]))
+            f.write(str(y) + "," + ",".join(f"{v:.5f}" for v in x) + "\n")
+
+    paddle.seed(0)
+    net = paddle.nn.Linear(8, 3)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    ds = FileDataFeed([path], batch_size=32, label_col=0)
+    losses = []
+    for epoch in range(3):
+        for feats, labels in ds:
+            logits = net(feats)
+            loss = paddle.mean(
+                paddle.nn.functional.softmax_with_cross_entropy(
+                    logits, paddle.reshape(labels.astype("int32"), [-1, 1])))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7
