@@ -19,10 +19,16 @@ from .core.dtype import (  # noqa: F401
 )
 from .core.device import (  # noqa: F401
     set_device, get_device, CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_cuda,
-    is_compiled_with_tpu, device_count,
+    is_compiled_with_tpu, device_count, CUDAPinnedPlace, XPUPlace, NPUPlace,
+    is_compiled_with_xpu, is_compiled_with_npu, is_compiled_with_rocm,
+    get_cudnn_version,
 )
 from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# CUDA rng aliases (reference get/set_cuda_rng_state: the accelerator rng)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
 
 from .ops import *  # noqa: F401,F403
 from . import ops  # noqa: F401
@@ -102,3 +108,88 @@ def summary(net, input_size=None, dtypes=None):
     print(f"Total params: {total}")
     print(f"Trainable params: {trainable}")
     return {"total_params": total, "trainable_params": trainable}
+
+
+# ---- tensor-API long tail + framework compat (reference top-level) ----
+from .ops.linalg_extra import (  # noqa: F401,E402
+    add_n, broadcast_shape, cholesky, conj, imag, real, inverse, histogram,
+    median, multiplex, diagflat, diagonal, trace, std, var, standard_normal,
+    reverse, crop, scatter_nd, tolist, is_tensor, reshape_, scatter_,
+    squeeze_, tanh_, unsqueeze_,
+)
+from .parallel import DataParallel  # noqa: F401,E402
+from .core import dtype as dtype  # noqa: F401,E402
+from .static.param_helper import create_parameter  # noqa: F401,E402
+
+__git_commit__ = "unknown"
+
+_default_dtype = ["float32"]
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype (framework.py): float32/float64/float16."""
+    _default_dtype[0] = str(_dtype_mod.convert_dtype(d) or d)
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy paddle.batch (fluid reader decorator)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def check_shape(shape):
+    for s in shape:
+        if isinstance(s, int) and s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops (hapi/dynamic_flops.py role): rough MAC count from
+    parameter shapes — conv/linear dominate, which param shapes capture."""
+    total = 0
+    for p in net.parameters():
+        shp = p.shape
+        if len(shp) >= 2:
+            total += int(_np.prod(shp))
+    mult = int(_np.prod(input_size[:1])) if input_size else 1
+    est = total * 2 * mult
+    if print_detail:
+        print(f"FLOPs (estimate): {est}")
+    return est
+
+
+def monkey_patch_math_varbase():  # the operators are installed at import
+    return None
+
+
+def monkey_patch_variable():
+    return None

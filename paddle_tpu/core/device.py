@@ -107,3 +107,33 @@ def is_compiled_with_tpu():
 
 def device_count():
     return len(jax.devices())
+
+
+def CUDAPinnedPlace():
+    """Pinned host memory place (place.h:89); host arrays are already
+    transfer-staged under PJRT, so this is the CPU place."""
+    return Place("cpu", 0)
+
+
+def XPUPlace(device_id=0):
+    return Place("tpu", device_id)  # accelerator alias, like CUDAPlace
+
+
+def NPUPlace(device_id=0):
+    return Place("tpu", device_id)
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in a TPU build (API parity)

@@ -1,0 +1,185 @@
+"""Tensor-API long tail: linalg, statistics, manipulation extras, inplace
+variants, and framework compat shims.
+
+Reference: python/paddle/tensor/{linalg,math,stat,manipulation,creation}.py —
+the remaining `paddle.*` symbols the main op modules don't cover
+(SURVEY §2.2 "Tensor ops API" row).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..core.registry import eager_op
+from .math import _unary
+
+__all__ = [
+    "add_n", "broadcast_shape", "cholesky", "conj", "imag", "real",
+    "inverse", "histogram", "median", "multiplex", "diagflat", "diagonal",
+    "trace", "std", "var", "standard_normal", "reverse", "crop",
+    "scatter_nd", "tolist", "is_tensor", "reshape_", "scatter_", "squeeze_",
+    "tanh_", "unsqueeze_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _raws(xs):
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+
+
+def add_n(inputs, name=None):
+    """Ref: sum_op.cc (paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    raw = eager_op("add_n")(lambda *xs: jnp.sum(jnp.stack(xs), axis=0))
+    return raw(*[_t(x) for x in inputs])
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+cholesky_raw = eager_op("cholesky")(
+    lambda x, upper=False: (jnp.linalg.cholesky(x).swapaxes(-1, -2)
+                            if upper else jnp.linalg.cholesky(x)))
+
+
+def cholesky(x, upper=False, name=None):
+    return cholesky_raw(_t(x), upper=upper)
+
+
+conj = _unary("conj", jnp.conj)
+imag = _unary("imag", jnp.imag)
+real = _unary("real", jnp.real)
+inverse = _unary("inverse", jnp.linalg.inv)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = _t(input)._data
+    lo, hi = (min, max) if (min != 0 or max != 0) else \
+        (jnp.min(x), jnp.max(x))
+    h, _ = jnp.histogram(x.ravel(), bins=bins, range=(lo, hi))
+    return Tensor(h, stop_gradient=True)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    raw = eager_op("median")(
+        lambda v: jnp.median(v, axis=axis, keepdims=keepdim))
+    return raw(_t(x))
+
+
+def multiplex(inputs, index, name=None):
+    """Ref: multiplex_op.cc — row i of output = row i of inputs[index[i]]."""
+    stacked = jnp.stack(_raws(inputs))  # [K, B, ...]
+    idx = _t(index)._data.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(idx.shape[0])
+    return Tensor(stacked[idx, rows], stop_gradient=True)
+
+
+def diagflat(x, offset=0, name=None):
+    raw = eager_op("diagflat")(lambda v: jnp.diagflat(v, k=offset))
+    return raw(_t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    raw = eager_op("diagonal")(
+        lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2))
+    return raw(_t(x))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    raw = eager_op("trace")(
+        lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2))
+    return raw(_t(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    raw = eager_op("std")(lambda v: jnp.std(
+        v, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+    return raw(_t(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    raw = eager_op("var")(lambda v: jnp.var(
+        v, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+    return raw(_t(x))
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    from ..core import random as _random
+
+    key = _random.next_key()
+    return Tensor(jax.random.normal(key, tuple(shape)).astype(dtype),
+                  stop_gradient=True)
+
+
+def reverse(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    raw = eager_op("reverse")(lambda v: jnp.flip(v, axis=tuple(axes)))
+    return raw(_t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Ref: crop_tensor_op.cc."""
+    t = _t(x)
+    shp = [int(s) for s in (shape or t.shape)]
+    offs = [int(o) for o in (offsets or [0] * len(shp))]
+    raw = eager_op("crop")(
+        lambda v: jax.lax.dynamic_slice(v, offs, shp))
+    return raw(t)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Ref: scatter_nd_op — zeros of `shape` scatter-added at `index`."""
+    idx = _t(index)._data
+    upd = _t(updates)._data
+    zeros = jnp.zeros(tuple(shape), upd.dtype)
+    raw = eager_op("scatter_nd")(
+        lambda u: zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u))
+    return raw(_t(updates))
+
+
+def tolist(x):
+    return np.asarray(_t(x).numpy()).tolist()
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+# ---- inplace variants (reference *_ ops mutate the VarBase buffer) ----
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, [int(s) for s in shape])
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    idx = _t(index)._data.astype(jnp.int32)
+    upd = _t(updates)._data
+    x._data = (x._data.at[idx].set(upd) if overwrite
+               else x._data.at[idx].add(upd))
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    x._data = (jnp.squeeze(x._data) if axis is None
+               else jnp.squeeze(x._data, axis=axis))
+    return x
+
+
+def tanh_(x, name=None):
+    x._data = jnp.tanh(x._data)
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    d = x._data
+    for a in sorted(axes):
+        d = jnp.expand_dims(d, a)
+    x._data = d
+    return x

@@ -1,0 +1,490 @@
+"""3-D / 1-D conv-pool family + functional long tail.
+
+Reference: operators/conv_op.cc (3D variants), pool_op.cc, affine_grid_op,
+grid_sampler_op, bilinear_tensor_product_op, ctc ops, temporal_shift_op,
+gather_tree_op — the remaining paddle.nn.functional surface.
+All lower to lax primitives (conv_general_dilated / reduce_window handle
+any spatial rank on the MXU/VPU).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "conv3d", "conv3d_transpose", "conv1d_transpose",
+    "max_pool3d", "avg_pool3d", "adaptive_avg_pool3d", "adaptive_max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_max_pool1d",
+    "affine_grid", "grid_sample", "bilinear", "dice_loss", "log_loss",
+    "npair_loss", "temporal_shift", "gather_tree", "ctc_loss",
+    "hsigmoid_loss", "dropout3d", "selu", "pairwise_distance", "unfold",
+    "spectral_norm_apply",
+]
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    s, d = _triple(stride), _triple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _triple(padding)
+        pad = [(p[0], p[0]), (p[1], p[1]), (p[2], p[2])]
+    dn = ("NCDHW", "OIDHW", "NCDHW")
+
+    def fn(xv, wv):
+        return jax.lax.conv_general_dilated(
+            xv, wv, s, pad, rhs_dilation=d, dimension_numbers=dn,
+            feature_group_count=groups, preferred_element_type=xv.dtype)
+
+    out = apply_op("conv3d", fn, (x, weight), {})
+    if bias is not None:
+        out = apply_op("conv3d_bias",
+                       lambda o, b: o + jnp.reshape(b, (1, -1, 1, 1, 1)),
+                       (out, bias), {})
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    s, d = _triple(stride), _triple(dilation)
+    p = _triple(padding) if not isinstance(padding, str) else padding
+
+    def fn(xv, wv):
+        # IODHW weight (paddle transpose-conv convention: [in, out, *k])
+        wv_t = jnp.transpose(wv, (1, 0, 2, 3, 4))
+        pads = ([(k - 1 - pp, k - 1 - pp) for k, pp in
+                 zip(wv.shape[2:], p)] if not isinstance(p, str) else p)
+        return jax.lax.conv_general_dilated(
+            xv, jnp.flip(wv_t, axis=(2, 3, 4)), (1, 1, 1), pads,
+            lhs_dilation=s, rhs_dilation=d,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            feature_group_count=groups)
+
+    out = apply_op("conv3d_transpose", fn, (x, weight), {})
+    if bias is not None:
+        out = apply_op("conv3d_transpose_bias",
+                       lambda o, b: o + jnp.reshape(b, (1, -1, 1, 1, 1)),
+                       (out, bias), {})
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", output_size=None, name=None):
+    from .manipulation import unsqueeze, squeeze
+    from .nn_ops import conv2d_transpose
+
+    x4 = unsqueeze(x, [3])
+    w4 = unsqueeze(weight, [3])
+    st = (stride, 1) if isinstance(stride, int) else tuple(stride) + (1,)
+    pd = (padding, 0) if isinstance(padding, int) else tuple(padding) + (0,)
+    out = conv2d_transpose(x4, w4, bias=bias, stride=st, padding=pd,
+                           dilation=(dilation, 1) if isinstance(dilation, int)
+                           else tuple(dilation) + (1,), groups=groups)
+    return squeeze(out, [3])
+
+
+def _pool3d(x, kind, kernel_size, stride, padding, exclusive=True):
+    k = _triple(kernel_size)
+    s = _triple(stride) if stride is not None else k
+    p = _triple(padding)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+
+    if kind == "max":
+        def fn(v):
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window,
+                                         strides, pads)
+        return apply_op("pool3d_max", fn, (x,), {})
+
+    def fn(v):
+        ssum = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                     pads)
+        if exclusive and any(pp != (0, 0) for pp in pads):
+            cnt = jax.lax.reduce_window(jnp.ones_like(v), 0.0, jax.lax.add,
+                                        window, strides, pads)
+            return ssum / cnt
+        return ssum / float(np.prod(k))
+
+    return apply_op("pool3d_avg", fn, (x,), {})
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    out = _pool3d(x, "max", kernel_size, stride, padding)
+    return (out, None) if return_mask else out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool3d(x, "avg", kernel_size, stride, padding, exclusive)
+
+
+def _adaptive_nd(x, kind, out_sizes, spatial_offset=2):
+    """Adaptive pooling over any spatial rank via variable windows."""
+    def fn(v):
+        spatial = v.shape[spatial_offset:]
+        outs = _ntuple(out_sizes, len(spatial))
+
+        def bounds(n, o):
+            return [(i * n) // o for i in range(o)] + [n]
+
+        bss = [bounds(n, o) for n, o in zip(spatial, outs)]
+
+        # result dims [N, C, o1..on]: stack each output dim in place
+        def build(dim, index):
+            if dim == len(outs):
+                sl = (slice(None), slice(None)) + tuple(
+                    slice(bss[d][i], bss[d][i + 1])
+                    for d, i in enumerate(index))
+                win = v[sl]
+                axes = tuple(range(spatial_offset,
+                                   spatial_offset + len(outs)))
+                return (jnp.max(win, axis=axes) if kind == "max"
+                        else jnp.mean(win, axis=axes))
+            return jnp.stack([build(dim + 1, index + (i,))
+                              for i in range(outs[dim])], axis=2 + dim)
+
+        return build(0, ())
+
+    return apply_op(f"adaptive_pool_{kind}", fn, (x,), {})
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_nd(x, "avg", output_size)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_nd(x, "max", output_size)
+    return (out, None) if return_mask else out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_nd(x, "avg", output_size)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_nd(x, "max", output_size)
+    return (out, None) if return_mask else out
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Ref: affine_grid_op.cc — [N,2,3] thetas -> [N,H,W,2] sample grid."""
+    def fn(th):
+        N = th.shape[0]
+        H, W = int(out_shape[-2]), int(out_shape[-1])
+        if align_corners:
+            ys = jnp.linspace(-1, 1, H)
+            xs = jnp.linspace(-1, 1, W)
+        else:
+            ys = (jnp.arange(H) + 0.5) / H * 2 - 1
+            xs = (jnp.arange(W) + 0.5) / W * 2 - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [HW, 3]
+        grid = jnp.einsum("hk,nok->nho", base, th)  # [N, HW, 2]
+        return grid.reshape(N, H, W, 2)
+
+    return apply_op("affine_grid", fn, (theta,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Ref: grid_sampler_op.cc — bilinear sampling of NCHW by [N,H,W,2]."""
+    def fn(v, g):
+        N, C, H, W = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = fx - x0
+        wy = fy - y0
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            idx_n = jnp.arange(N).reshape(N, 1, 1)
+            vals = v[idx_n, :, yi, xi]  # [N, Ho, Wo, C]
+            if padding_mode == "zeros":
+                inb = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                       & (xx <= W - 1))[..., None]
+                vals = jnp.where(inb, vals, 0.0)
+            return vals
+
+        out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+               + gather(y0, x0 + 1) * (wx * (1 - wy))[..., None]
+               + gather(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
+               + gather(y0 + 1, x0 + 1) * (wx * wy)[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return apply_op("grid_sample", fn, (x, grid), {})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Ref: bilinear_tensor_product_op.cc: out[n,o] = x1 W_o x2 + b."""
+    def fn(a, b, w):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        return out
+
+    out = apply_op("bilinear", fn, (x1, x2, weight), {})
+    if bias is not None:
+        out = apply_op("bilinear_bias", lambda o, bb: o + bb, (out, bias), {})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * y1, axis=-1)
+        union = jnp.sum(p, axis=-1) + jnp.sum(y1, axis=-1)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op("dice_loss", fn, (input, label), {})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply_op("log_loss", fn, (input, label), {})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y):
+        logits = a @ p.T
+        same = (y.reshape(-1, 1) == y.reshape(1, -1)).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(logits, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg
+
+    return apply_op("npair_loss", fn, (anchor, positive, labels), {})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None, data_format="NCHW"):
+    """Ref: temporal_shift_op.cc — shift channels across the time axis."""
+    def fn(v):
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v5 = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        bwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        rest = v5[:, :, c2:]
+        return jnp.concatenate([fwd, bwd, rest], axis=2).reshape(NT, C, H, W)
+
+    return apply_op("temporal_shift", fn, (x,), {})
+
+
+def gather_tree(ids, parents):
+    """Ref: gather_tree_op.cc — back-trace beam-search parent pointers."""
+    ids_v = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+    par_v = parents._data if isinstance(parents, Tensor) else jnp.asarray(parents)
+    T = ids_v.shape[0]
+
+    def step(carry, t):
+        beams = carry  # [batch, beam] current beam index per slot
+        tok = jnp.take_along_axis(ids_v[t], beams, axis=1)
+        nxt = jnp.take_along_axis(par_v[t], beams, axis=1)
+        return nxt, tok
+
+    init = jnp.tile(jnp.arange(ids_v.shape[2])[None, :],
+                    (ids_v.shape[1], 1))
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return Tensor(jnp.flip(toks, axis=0), stop_gradient=True)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Ref: warpctc_op.cc.  Forward-algorithm CTC in log space via
+    lax.scan over time — runs entirely on device (no warpctc dlopen)."""
+    lp = log_probs._data if isinstance(log_probs, Tensor) else jnp.asarray(log_probs)
+    lab = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+    ilen = (input_lengths._data if isinstance(input_lengths, Tensor)
+            else jnp.asarray(input_lengths)).astype(jnp.int32)
+    llen = (label_lengths._data if isinstance(label_lengths, Tensor)
+            else jnp.asarray(label_lengths)).astype(jnp.int32)
+    if lp.ndim == 3 and lp.shape[0] != lab.shape[0]:
+        lp = jnp.transpose(lp, (1, 0, 2))  # [T,B,C] -> [B,T,C]
+    lp = jax.nn.log_softmax(lp, axis=-1)
+    B, T, C = lp.shape
+    S = lab.shape[1]
+    L = 2 * S + 1
+    NEG = -1e30
+
+    # extended label seq: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, L), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+    same_as_prevprev = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def fwd_fn(lp_b, ext_b, same_b, Tn, Ln):
+        alpha0 = jnp.full((L,), NEG)
+        alpha0 = alpha0.at[0].set(lp_b[0, ext_b[0]])
+        alpha0 = alpha0.at[1].set(jnp.where(Ln > 0, lp_b[0, ext_b[1]], NEG))
+
+        def step(alpha, t):
+            a_shift1 = jnp.concatenate([jnp.array([NEG]), alpha[:-1]])
+            a_shift2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+            a_shift2 = jnp.where(same_b, NEG, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            new = merged + lp_b[t, ext_b]
+            return jnp.where(t < Tn, new, alpha), None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end = 2 * Ln
+        ll = jnp.logaddexp(alphaT[end], alphaT[jnp.maximum(end - 1, 0)])
+        return -ll
+
+    def fn(lp_all):
+        losses = jax.vmap(fwd_fn)(lp_all, ext, same_as_prevprev, ilen, llen)
+        if reduction == "mean":
+            return jnp.mean(losses / jnp.maximum(llen, 1))
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return apply_op("ctc_loss", fn, (to_tensor(lp),), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Ref: hierarchical_sigmoid_op.cc (default complete-tree mode)."""
+    def fn(x, w, y):
+        # default tree: logits over (num_classes-1) internal nodes
+        logits = x @ w.T  # [B, num_classes-1]
+        # complete binary tree code/path for each class
+        codes = []
+        paths = []
+        for c in range(num_classes):
+            node = c + num_classes - 1  # leaf index in heap order
+            path, code = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                code.append(1.0 if node == 2 * parent + 2 else 0.0)
+                path.append(parent)
+                node = parent
+            paths.append(path[::-1])
+            codes.append(code[::-1])
+        maxlen = max(len(p) for p in paths)
+        pt = np.zeros((num_classes, maxlen), np.int32)
+        ct = np.zeros((num_classes, maxlen), np.float32)
+        mask = np.zeros((num_classes, maxlen), np.float32)
+        for c in range(num_classes):
+            pt[c, :len(paths[c])] = paths[c]
+            ct[c, :len(codes[c])] = codes[c]
+            mask[c, :len(paths[c])] = 1.0
+        ptj, ctj, mj = jnp.asarray(pt), jnp.asarray(ct), jnp.asarray(mask)
+        yv = y.reshape(-1).astype(jnp.int32)
+        sel_logits = logits[jnp.arange(x.shape[0])[:, None], ptj[yv]]
+        code_sel = ctj[yv]
+        m = mj[yv]
+        # binary cross entropy per node
+        per = (jax.nn.softplus(sel_logits) - code_sel * sel_logits) * m
+        return jnp.mean(jnp.sum(per, axis=1))
+
+    out = apply_op("hsigmoid_loss", fn, (input, weight, label), {})
+    return out
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    from .nn_ops import dropout
+
+    if not training or p == 0.0:
+        return x
+    # channel-wise mask (whole D,H,W planes), matching Dropout3D semantics
+    def fn(v, key_holder=[None]):
+        from ..core import random as _random
+
+        key = _random.next_key()
+        N, C = v.shape[0], v.shape[1]
+        keep = jax.random.bernoulli(key, 1 - p, (N, C, 1, 1, 1))
+        return jnp.where(keep, v / (1 - p), 0.0)
+
+    return apply_op("dropout3d", fn, (x,), {})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    def fn(v):
+        return scale * jnp.where(v > 0, v, alpha * (jnp.exp(v) - 1))
+
+    return apply_op("selu", fn, (x,), {})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+    return apply_op("pairwise_distance", fn, (x, y), {})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """Ref: unfold_op.cc (im2col as an op)."""
+    k = _ntuple(kernel_sizes, 2)
+    s = _ntuple(strides, 2)
+    p = _ntuple(paddings, 2)
+    d = _ntuple(dilations, 2)
+
+    def fn(v):
+        N, C, H, W = v.shape
+        vp = jnp.pad(v, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        oh = (H + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (W + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = vp[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                           j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, axis=2)  # [N, C, k*k, oh, ow]
+        return out.reshape(N, C * k[0] * k[1], oh * ow)
+
+    return apply_op("unfold", fn, (x,), {})
+
+
+def spectral_norm_apply(weight, n_power_iterations=1, eps=1e-12, dim=0):
+    """Power-iteration spectral normalization (spectral_norm_op.cc)."""
+    def fn(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / np.sqrt(wm.shape[0])
+        for _ in range(max(n_power_iterations, 1)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+
+    return apply_op("spectral_norm", fn, (weight,), {})
