@@ -94,10 +94,18 @@ def apply_op(op_type, fn, args, kwargs, n_outputs=None):
             full[i] = next(it)
         return fn(*full, **kwargs)
 
+    from ..framework import _FLAGS
+    check_nan = _FLAGS.get("FLAGS_check_nan_inf")
+
     if not diff_pos:
         with autograd.no_grad():
             out_vals = call_fn(*vals)
         multi = isinstance(out_vals, tuple)
+        if check_nan:
+            from . import sanitizer
+
+            for v in (out_vals if multi else (out_vals,)):
+                sanitizer.check_value(v, op_type)
         outs = [
             _wrap_data(v, stop_gradient=True)
             for v in (out_vals if multi else (out_vals,))
@@ -119,6 +127,11 @@ def apply_op(op_type, fn, args, kwargs, n_outputs=None):
     out_vals, vjp_fn = jax.vjp(diff_fn, *[args[i]._data for i in diff_pos])
     multi = isinstance(out_vals, tuple)
     out_list = list(out_vals) if multi else [out_vals]
+    if check_nan:
+        from . import sanitizer
+
+        for v in out_list:
+            sanitizer.check_value(v, op_type)
 
     node = autograd.TapeNode(
         op_type,

@@ -1,0 +1,53 @@
+"""Numerical sanitizer behind FLAGS_check_nan_inf.
+
+Reference parity: after-kernel NaN/Inf scan (operator.cc:1183 ->
+framework/details/nan_inf_utils.h:39, dygraph variant
+CheckOpHasNanOrInfInDygraph).  TPU-native design: eager concrete outputs are
+scanned host-side; traced outputs (ops running inside a jit region) raise
+through `jax.debug.callback`, which XLA surfaces at the next sync point; the
+static executor instead threads a per-op finite-mask through the compiled
+block and raises fetch-side with the offending op's name (value-semantic —
+no side-effecting check ops inside the XLA program).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def enabled():
+    from ..framework import _FLAGS
+
+    return bool(_FLAGS.get("FLAGS_check_nan_inf"))
+
+
+def _describe(arr):
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    return f"{n_nan} nan / {n_inf} inf in {arr.shape} {arr.dtype}"
+
+
+def check_value(value, label):
+    """Scan one op output; raise FloatingPointError naming the op."""
+    if not jnp.issubdtype(jnp.result_type(value), jnp.inexact):
+        return
+    if isinstance(value, jax.core.Tracer):
+        def _cb(v, _label=label):
+            a = np.asarray(v)
+            if not np.isfinite(a).all():
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: op '{_label}' produced "
+                    f"{_describe(a)}")
+
+        jax.debug.callback(_cb, value)
+        return
+    arr = np.asarray(value)
+    if not np.isfinite(arr).all():
+        raise FloatingPointError(
+            f"FLAGS_check_nan_inf: op '{label}' produced {_describe(arr)}")
+
+
+def nonfinite_flag(value):
+    """Traced bool: does value contain nan/inf?  (fetch-side mask path)"""
+    if not jnp.issubdtype(jnp.result_type(value), jnp.inexact):
+        return jnp.asarray(False)
+    return ~jnp.isfinite(value).all()

@@ -40,13 +40,55 @@ META_OPTIMIZERS = [
 ]
 
 
+# Mutual exclusions (strategy_compiler.py + each meta-opt's
+# _disable_strategy in the reference): when the key optimizer is selected,
+# the listed strategies are force-disabled on the DistributedStrategy and
+# their meta-opts dropped from the chain.
+_EXCLUSIONS = {
+    ShardingOptimizer: {
+        # sharding owns grad placement: whole-grad compression/merge
+        # rewrites would race its reduce-to-owner placement
+        DGCOptimizer: "dgc",
+        FP16AllReduceOptimizer: "fp16_allreduce",
+        LocalSGDOptimizer: "localsgd",
+        RawProgramOptimizer: "without_graph_optimization",
+    },
+    PipelineOptimizer: {
+        # pipeline inserts its own inter-stage DP allreduce
+        # (_insert_allreduce_ops pipeline_optimizer.py:228)
+        RawProgramOptimizer: "without_graph_optimization",
+        LocalSGDOptimizer: "localsgd",
+    },
+    LocalSGDOptimizer: {
+        DGCOptimizer: "dgc",
+        FP16AllReduceOptimizer: "fp16_allreduce",
+    },
+}
+
+
 class StrategyCompiler:
-    """strategy_compiler.py parity: pick applicable meta-opts, order them."""
+    """strategy_compiler.py parity: pick applicable meta-opts, order them by
+    the canonical rank (amp -> recompute -> ... -> raw_program), and apply
+    mutual-exclusion rules, flipping losers' strategy bits off the way the
+    reference's _disable_strategy hooks do."""
 
     def generate_optimizer(self, loss, role_maker, optimizer, strategy,
                            meta_optimizers):
-        applicable = [m for m in meta_optimizers if m._can_apply(strategy)]
-        return applicable
+        rank = {cls: i for i, cls in enumerate(META_OPTIMIZERS)}
+        applicable = sorted(
+            (m for m in meta_optimizers if m._can_apply(strategy)),
+            key=lambda m: rank.get(type(m), len(rank)))
+        selected_types = {type(m) for m in applicable}
+        dropped = set()
+        for winner, losers in _EXCLUSIONS.items():
+            if winner not in selected_types:
+                continue
+            for loser_cls, flag in losers.items():
+                if loser_cls in selected_types:
+                    dropped.add(loser_cls)
+                    if strategy is not None and hasattr(strategy, flag):
+                        setattr(strategy, flag, False)
+        return [m for m in applicable if type(m) not in dropped]
 
 
 def apply_meta_optimizers(optimizer, strategy, loss, startup_program, fleet_obj):

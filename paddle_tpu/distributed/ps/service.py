@@ -23,6 +23,10 @@ from .table import BarrierTable, DenseTable, SparseTable
 
 _HDR = struct.Struct(">I")
 
+# Frame cap: the 4-byte header could claim up to 4 GiB, letting a peer
+# exhaust server memory before deserialization is even attempted.
+_MAX_FRAME = int(os.environ.get("PTN_PS_MAX_FRAME_MB", "512")) * (1 << 20)
+
 # Frames cross a trust boundary (any peer that can reach the port), so
 # deserialization must never execute attacker-chosen callables.  This
 # unpickler admits only the numpy internals needed to rebuild ndarrays and
@@ -67,6 +71,10 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if n > _MAX_FRAME:
+        raise pickle.UnpicklingError(
+            f"PS frame of {n} bytes exceeds the {_MAX_FRAME}-byte cap "
+            "(PTN_PS_MAX_FRAME_MB)")
     return _loads(_recv_exact(sock, n))
 
 
@@ -79,12 +87,19 @@ class PSServer:
     reference's table-sharding scheme (common_sparse_table.h).
     """
 
-    def __init__(self, endpoint, server_index=0, num_servers=1, trainers=1):
+    def __init__(self, endpoint, server_index=0, num_servers=1, trainers=1,
+                 checkpoint_root=None):
         self.host, port = endpoint.rsplit(":", 1)
         self.port = int(port)
         self.server_index = server_index
         self.num_servers = num_servers
         self.trainers = trainers
+        # Network-initiated save/load only ever touches paths under this
+        # server-configured root; unset = those commands are refused.  A
+        # peer must never choose where the server reads/writes pickles.
+        self.checkpoint_root = (
+            os.path.realpath(checkpoint_root)
+            if checkpoint_root is not None else None)
         self._dense = {}
         self._sparse = {}
         self._barrier = BarrierTable(trainers)
@@ -163,16 +178,30 @@ class PSServer:
             return ("ok", ok)
         if cmd == "save":
             _, dirname = msg
-            self.save(dirname)
+            self.save(self._resolve_ckpt(dirname))
             return ("ok",)
         if cmd == "load":
             _, dirname = msg
-            self.load(dirname)
+            self.load(self._resolve_ckpt(dirname))
             return ("ok",)
         if cmd == "stop":
             self._stopped.set()
             return ("ok",)
         return ("err", f"unknown cmd {cmd!r}")
+
+    def _resolve_ckpt(self, dirname):
+        """Confine a network-supplied checkpoint dir to checkpoint_root."""
+        if self.checkpoint_root is None:
+            raise PermissionError(
+                "server has no checkpoint_root configured; network "
+                "save/load refused")
+        path = os.path.realpath(
+            os.path.join(self.checkpoint_root, str(dirname)))
+        if (path != self.checkpoint_root
+                and not path.startswith(self.checkpoint_root + os.sep)):
+            raise PermissionError(
+                f"checkpoint path {dirname!r} escapes checkpoint_root")
+        return path
 
     # --- persistence (ssd_sparse_table / fleet.save_persistables role) ---
     def save(self, dirname):
@@ -185,9 +214,12 @@ class PSServer:
             pickle.dump({"dense": dense, "sparse": sparse}, f)
 
     def load(self, dirname):
+        """Checkpoint shards parse through the same allowlist unpickler as
+        network frames: the file may have been planted/overwritten by a
+        peer (e.g. via 'save'), so it is untrusted input too."""
         path = os.path.join(dirname, f"shard{self.server_index}.pkl")
         with open(path, "rb") as f:
-            blob = pickle.load(f)
+            blob = _SafeUnpickler(f).load()
         for n, v in blob["dense"].items():
             self._get_dense(n, {"shape": np.shape(v)}).set(v)
         for n, rows in blob["sparse"].items():

@@ -196,3 +196,141 @@ def convert_len(x):
     if isinstance(x, Tensor):
         return x.shape[0]
     return len(x)
+
+
+class _TensorRange:
+    """range() over tensor bounds (reference: loop_transformer converts
+    `for i in range(tensor)` into a while op; here it lowers to
+    lax.while_loop with the index in the carry)."""
+
+    __slots__ = ("start", "stop", "step")
+
+    def __init__(self, start, stop, step):
+        self.start = start
+        self.stop = stop
+        self.step = step
+
+
+def convert_range(*args):
+    if not any(isinstance(a, Tensor) or isinstance(a, jax.core.Tracer)
+               for a in args):
+        return range(*args)
+    if len(args) == 1:
+        return _TensorRange(0, args[0], 1)
+    if len(args) == 2:
+        return _TensorRange(args[0], args[1], 1)
+    return _TensorRange(*args[:3])
+
+
+def _scalar_i64(x):
+    return jnp.reshape(jnp.asarray(_raw(x)), ()).astype(jnp.int32)
+
+
+def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
+                     names):
+    """Transformed `for` dispatch (reference: loop_transformer.py converts
+    for-range / for-iter into while ops).
+
+    Modes:
+    - python iterable: plain loop (eager semantics preserved);
+    - concrete tensor range bounds: plain loop over ints;
+    - traced range bounds (`for i in range(t)`): dynamic trip count ->
+      lax.while_loop with (index, loop-vars) carry — forward-only, like
+      the reference's while op under a dynamic bound;
+    - tensor iteration (`for row in t`): static leading dim -> lax.scan
+      over rows, which IS reverse-differentiable (training loops work).
+    """
+    from ...core.tensor import _wrap_data
+
+    if isinstance(iter_obj, _TensorRange):
+        traced = any(_is_traced(x)
+                     for x in (iter_obj.start, iter_obj.stop, iter_obj.step))
+        if not traced:
+            start = int(jnp.asarray(_raw(iter_obj.start)))
+            stop = int(jnp.asarray(_raw(iter_obj.stop)))
+            step = int(jnp.asarray(_raw(iter_obj.step)))
+            for k in range(start, stop, step):
+                assign_fn(k)
+                body_fn()
+            return
+        start = _scalar_i64(iter_obj.start)
+        stop = _scalar_i64(iter_obj.stop)
+        step = _scalar_i64(iter_obj.step)
+        # bind the loop target to a prototype value so the carry has a
+        # concrete type for every name (zero-trip loops keep it — a static
+        # shape constraint, documented deviation from python's "unbound")
+        assign_fn(_wrap_data(start))
+        init = get_args()
+        for n, v in zip(names, init):
+            if isinstance(v, _Undefined):
+                raise ValueError(
+                    f"loop variable {n!r} must be defined before a "
+                    f"tensor-range `for` loop")
+        templates = list(init)
+
+        def restore(vals):
+            set_args(tuple(
+                _wrap_like(t, v) if isinstance(t, Tensor) else v
+                for t, v in zip(templates, vals)))
+
+        def c(state):
+            i, _ = state
+            return jnp.where(step > 0, i < stop, i > stop)
+
+        def b(state):
+            i, vals = state
+            restore(vals)
+            assign_fn(_wrap_data(i))
+            body_fn()
+            return (i + step, tuple(_raw(v) for v in get_args()))
+
+        _, out = jax.lax.while_loop(c, b,
+                                    (start, tuple(_raw(v) for v in init)))
+        restore(out)
+        return
+
+    if isinstance(iter_obj, (Tensor, jax.core.Tracer)) or (
+            hasattr(iter_obj, "shape") and hasattr(iter_obj, "dtype")
+            and not isinstance(iter_obj, (list, tuple))):
+        raw = _raw(iter_obj)
+        if not getattr(raw, "shape", None):
+            raise TypeError("cannot iterate a 0-d tensor")
+        n = raw.shape[0]
+        if not _is_traced(iter_obj):
+            # eager: row-wise python loop; index through Tensor.__getitem__
+            # so tape autograd flows back to the iterated tensor
+            for k in range(n):
+                assign_fn(iter_obj[k] if isinstance(iter_obj, Tensor)
+                          else raw[k])
+                body_fn()
+            return
+        if n == 0:
+            return
+        assign_fn(_wrap_data(raw[0]))
+        init = get_args()
+        for nm, v in zip(names, init):
+            if isinstance(v, _Undefined):
+                raise ValueError(
+                    f"loop variable {nm!r} must be defined before a "
+                    f"tensor-iteration `for` loop")
+        templates = list(init)
+
+        def restore(vals):
+            set_args(tuple(
+                _wrap_like(t, v) if isinstance(t, Tensor) else v
+                for t, v in zip(templates, vals)))
+
+        def body(vals, row):
+            restore(vals)
+            assign_fn(_wrap_data(row))
+            body_fn()
+            return tuple(_raw(v) for v in get_args()), None
+
+        out, _ = jax.lax.scan(body, tuple(_raw(v) for v in init), raw)
+        restore(out)
+        return
+
+    # plain python iterable
+    for v in iter_obj:
+        assign_fn(v)
+        body_fn()

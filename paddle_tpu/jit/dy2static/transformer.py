@@ -169,6 +169,51 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [ast.fix_missing_locations(ast.copy_location(s, node))
                 for s in out]
 
+    def visit_For(self, node):
+        """`for target in iter: body` -> convert_for_loop shim (reference:
+        loop_transformer.py for-range / for-iter -> while op)."""
+        self.generic_visit(node)
+        if node.orelse or _has_return(node.body):
+            return node
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, (ast.Break, ast.Continue, ast.Yield,
+                                ast.YieldFrom)):
+                return node
+        uid = self._uid()
+        tnames = sorted({n.id for n in ast.walk(node.target)
+                         if isinstance(n, ast.Name)})
+        names = sorted(set(_store_names(node.body)) | set(tnames))
+        names = [n for n in names if not n.startswith("_pt_")]
+        get_src, set_src = self._scaffold(names, uid)
+        nl = f"    nonlocal {', '.join(names)}\n" if names else ""
+        tnl = f"    nonlocal {', '.join(tnames)}\n" if tnames else ""
+        assign_def = ast.parse(
+            f"def _pt_assign_{uid}(_pt_val):\n{tnl}    pass").body[0]
+        assign_def.body = assign_def.body[:-1] + [ast.Assign(
+            targets=[node.target],
+            value=ast.Name(id="_pt_val", ctx=ast.Load()))]
+        body_def = ast.parse(f"def _pt_fbody_{uid}():\n{nl}    pass").body[0]
+        body_def.body = body_def.body[:-1] + node.body if names \
+            else node.body
+        # range(...) in the iterable becomes convert_range so tensor
+        # bounds survive (python's range() rejects tensors)
+        iter_expr = _RangeRewriter().visit(node.iter)
+        iter_assign = ast.parse(f"_pt_iter_{uid} = 0").body[0]
+        iter_assign.value = iter_expr
+        call = ast.parse(
+            f"{_PT}.convert_for_loop(_pt_iter_{uid}, _pt_assign_{uid}, "
+            f"_pt_fbody_{uid}, _pt_get_{uid}, _pt_set_{uid}, "
+            f"{names!r})").body[0]
+        out = [iter_assign]
+        out.extend(self._init_undefined(names))
+        out.extend(ast.parse(get_src).body)
+        out.extend(ast.parse(set_src).body)
+        out.append(assign_def)
+        out.append(body_def)
+        out.append(call)
+        return [ast.fix_missing_locations(ast.copy_location(s, node))
+                for s in out]
+
     def visit_BoolOp(self, node):
         self.generic_visit(node)
         shim = ("convert_logical_and" if isinstance(node.op, ast.And)
@@ -202,9 +247,21 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return node
 
 
+class _RangeRewriter(ast.NodeTransformer):
+    """Rewrite bare `range(...)` calls to the convert_range shim."""
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id == "range":
+            node.func = ast.Attribute(
+                value=ast.Name(id=_PT, ctx=ast.Load()),
+                attr="convert_range", ctx=ast.Load())
+        return node
+
+
 def _has_control_flow(tree):
     for node in ast.walk(tree):
-        if isinstance(node, (ast.If, ast.While, ast.BoolOp)):
+        if isinstance(node, (ast.If, ast.While, ast.For, ast.BoolOp)):
             return True
         if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
             return True

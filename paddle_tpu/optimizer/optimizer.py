@@ -180,17 +180,27 @@ class Optimizer:
             for i, p in enumerate(self._parameter_list):
                 st = self._state_for(p)
                 for k in list(st.keys()):
-                    # positional key first: within one optimizer the order is
-                    # ground truth, while an auto-generated name can collide
-                    # with a *different* param's name from the saving run
-                    key = f"@pos{i}_{k}"
-                    if key not in state:
-                        key = f"{p.name}_{k}"
-                    if key in state:
+                    # exact name key first (the reference's name-keyed
+                    # checkpoint format); the positional alias is only a
+                    # fallback for auto-generated names that didn't survive
+                    # a process restart, and must shape-match the param
+                    candidates = [f"{p.name}_{k}", f"@pos{i}_{k}"]
+                    want = getattr(st[k], "shape", None)
+                    for key in candidates:
+                        if key not in state:
+                            continue
                         v = state[key]
-                        st[k] = jnp.asarray(
+                        arr = jnp.asarray(
                             v.numpy() if isinstance(v, Tensor) else v
                         )
+                        # shape-validate BOTH key kinds: a name collision
+                        # (same auto-name, different param) is as wrong as
+                        # a stale positional entry
+                        if (arr.ndim and want is not None
+                                and tuple(arr.shape) != tuple(want)):
+                            continue
+                        st[k] = arr
+                        break
 
 
 class SGD(Optimizer):

@@ -334,6 +334,14 @@ class CompiledTrainStep:
         loss, self.params, self.flat_opt_state = self._jit_step(
             self.params, self.flat_opt_state, vals, key, lr
         )
+        from ..framework import _FLAGS
+
+        if _FLAGS.get("FLAGS_check_nan_inf"):
+            lv = np.asarray(loss)
+            if not np.isfinite(lv).all():
+                raise FloatingPointError(
+                    "FLAGS_check_nan_inf: non-finite loss "
+                    f"{float(lv):.6g} at step {self._step_count}")
         from ..optimizer.lr import LRScheduler
 
         if isinstance(self.optimizer._lr, LRScheduler):

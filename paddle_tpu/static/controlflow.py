@@ -177,6 +177,8 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     branch_fns: list of callables or list of (index, callable) pairs.
     """
+    if isinstance(branch_fns, dict):
+        branch_fns = list(branch_fns.items())
     if isinstance(branch_fns, (list, tuple)) and branch_fns and \
             isinstance(branch_fns[0], (list, tuple)):
         pairs = sorted(branch_fns, key=lambda kv: kv[0])
@@ -209,10 +211,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         pos = jnp.argmax(keys_arr == idx)
         matched = jnp.any(keys_arr == idx)
         n_branches = len(runs)
-        if default is not None:
-            pos = jnp.where(matched, pos, n_branches - 1)
-        else:
-            pos = jnp.where(matched, pos, 0)
+        # no default: unmatched indices dispatch to the max-key branch
+        # (keys are sorted, so it's last), matching the reference's
+        # fluid/layers/control_flow.py:3592 semantics
+        pos = jnp.where(matched, pos, n_branches - 1)
         return jax.lax.switch(pos, runs, caps)
 
     block = default_main_program().current_block()

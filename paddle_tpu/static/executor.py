@@ -69,6 +69,14 @@ class CompiledBlock:
             n for n, v in block.vars.items()
             if v.persistable and scope.get(n) is not None
         ]
+        from ..framework import _FLAGS
+
+        # FLAGS_check_nan_inf (operator.cc:1183 parity): thread a per-op
+        # finite-mask through the compiled block; run() raises fetch-side
+        # with the op name.  Captured at compile time (Executor.run's cache
+        # key includes the flag, so flips build a fresh CompiledBlock).
+        self._check_nan = bool(_FLAGS.get("FLAGS_check_nan_inf"))
+        self._checked_ops = []
         self._op_order, donate_feeds = self._plan(block)
         if donate_feeds:
             # feed arrays are fresh device uploads each run — safe to let XLA
@@ -95,8 +103,11 @@ class CompiledBlock:
                     var_ids[name] = nprog.add_var(name, persistable)
                 return var_ids[name]
 
+            # NOTE: c_broadcast is intentionally NOT here — param broadcasts
+            # survive pruning via writes_state, and TP input broadcasts must
+            # stay dead-code-prunable for partial-feed runs
             side_effect_ops = {
-                "c_allreduce_sum", "c_broadcast", "c_allgather", "barrier",
+                "c_allreduce_sum", "c_allgather", "barrier",
                 "send_v2", "recv_v2", "save", "load", "print",
             }
             for op in ops:
@@ -129,6 +140,11 @@ class CompiledBlock:
         env.update(feeds)
         block = self.program.global_block()
         all_ops = list(block.ops)
+        nonfinite = []
+        if self._check_nan:
+            from ..core import sanitizer
+
+            self._checked_ops = []
         for idx in self._op_order:
             op = all_ops[idx]
             if op.fn is None:
@@ -141,9 +157,13 @@ class CompiledBlock:
                 res = (res,)
             for n, v in zip(out_names, res):
                 env[n] = v
+                if self._check_nan:
+                    nonfinite.append(sanitizer.nonfinite_flag(v))
+                    self._checked_ops.append((op.type, n))
+        mask = jnp.stack(nonfinite) if nonfinite else jnp.zeros((0,), bool)
         return tuple(env[n] for n in self.fetch_names), {
             n: env[n] for n in self.param_names if n in env
-        }
+        }, mask
 
     def run(self, feed, scope):
         feeds = {}
@@ -153,7 +173,17 @@ class CompiledBlock:
                 v = v._data
             feeds[n] = jnp.asarray(np.asarray(v))
         params = {n: scope.get(n) for n in self.param_names}
-        outs, updated = self._jitted(feeds, params)
+        outs, updated, nonfinite = self._jitted(feeds, params)
+        if self._check_nan:
+            mask = np.asarray(nonfinite)
+            if mask.any():
+                bad = [f"{op}->{var}"
+                       for (op, var), hit in zip(self._checked_ops, mask)
+                       if hit]
+                raise FloatingPointError(
+                    "FLAGS_check_nan_inf: non-finite outputs in compiled "
+                    f"block from op(s): {', '.join(bad[:8])}"
+                    + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""))
         # write back persistable updates (e.g. optimizer/global-stat vars)
         for n, v in updated.items():
             scope.set(n, v)
@@ -184,7 +214,10 @@ class Executor:
             tuple(np.asarray(v.numpy() if isinstance(v, Tensor) else v).shape)
             for _, v in sorted(feed.items())
         )
-        key = (id(program), feed_names, tuple(fetch_names), shapes)
+        from ..framework import _FLAGS
+
+        key = (id(program), feed_names, tuple(fetch_names), shapes,
+               bool(_FLAGS.get("FLAGS_check_nan_inf")))
         cb = self._cache.get(key)
         if cb is None:
             cb = CompiledBlock(program, feed.keys(), fetch_names, scope)

@@ -69,7 +69,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
         params_live = {n: jnp.asarray(scope.get(n)) for n in cb.param_names}
 
         def deploy(*xs):
-            outs, _ = cb._run_block(dict(zip(feed_names, xs)), params_live)
+            outs, _, _ = cb._run_block(dict(zip(feed_names, xs)),
+                                       params_live)
             return outs
 
         shaped, dynamic = build_input_avals(

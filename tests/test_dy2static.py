@@ -190,3 +190,114 @@ def test_python_control_flow_unchanged():
 
     g = transform_function(f)
     assert g([1.0, 2.0, 3.0], 2) == 3.0
+
+
+# ---- for-loop transform (VERDICT r1 item 5; loop_transformer.py parity) ----
+
+def test_for_range_python_int_unchanged():
+    """Static python range keeps plain-loop semantics eagerly and under
+    to_static (unrolls during trace)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        for i in range(3):
+            x = x + float(i)
+        return x
+
+    np.testing.assert_allclose(f(_t([1.0])).numpy(), [4.0])
+
+
+def test_for_range_tensor_eager():
+    def f(x, n):
+        s = paddle.to_tensor(np.float32(0.0))
+        for i in range(n):
+            s = s + x
+        return s
+
+    g = transform_function(f)
+    assert g is not f
+    out = g(_t(2.0), paddle.to_tensor(np.int32(4)))
+    np.testing.assert_allclose(out.numpy(), 8.0)
+
+
+def test_for_range_tensor_jit():
+    """`for i in range(tensor)` compiles to a lax while_loop: the same
+    compiled fn handles different trip counts."""
+
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0.0
+        for i in range(n):
+            s = s + x + paddle.cast(i, "float32") * 0.0
+        return s
+
+    a = f(_t([2.0, 3.0]), paddle.to_tensor(np.int32(4)))
+    np.testing.assert_allclose(a.numpy(), [8.0, 12.0])
+    b = f(_t([2.0, 3.0]), paddle.to_tensor(np.int32(2)))
+    np.testing.assert_allclose(b.numpy(), [4.0, 6.0])
+
+
+def test_for_iter_tensor_eager_and_jit():
+    """`for row in tensor` iterates rows: eager = python loop over rows,
+    traced = lax.scan over the leading dim."""
+
+    def f(xs):
+        s = paddle.to_tensor(np.zeros(2, np.float32))
+        for row in xs:
+            s = s + row * 2.0
+        return s
+
+    xs = _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    g = transform_function(f)
+    np.testing.assert_allclose(g(xs).numpy(), [18.0, 24.0])
+
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(xs).numpy(), [18.0, 24.0])
+
+
+def test_for_loop_carried_mutation_jit():
+    """Loop-carried mutation of several names, incl. the loop target
+    surviving after the loop."""
+
+    @paddle.jit.to_static
+    def f(xs):
+        total = paddle.to_tensor(np.float32(0.0))
+        last = paddle.to_tensor(np.zeros(2, np.float32))
+        for row in xs:
+            total = total + paddle.sum(row)
+            last = row
+        return total, last
+
+    xs = _t([[1.0, 2.0], [3.0, 4.0]])
+    total, last = f(xs)
+    np.testing.assert_allclose(total.numpy(), 10.0)
+    np.testing.assert_allclose(last.numpy(), [3.0, 4.0])
+
+
+def test_for_iter_tensor_grad():
+    """lax.scan lowering is reverse-differentiable: grads flow through a
+    tensor-iteration training loop (the dynamic-while path is fwd-only)."""
+
+    def f(xs):
+        s = paddle.to_tensor(np.float32(0.0))
+        for row in xs:
+            s = s + paddle.sum(row * row)
+        return s
+
+    g = transform_function(f)
+    xs = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32),
+                          stop_gradient=False)
+    loss = g(xs)
+    loss.backward()
+    np.testing.assert_allclose(xs.grad.numpy(),
+                               2 * np.array([[1.0, 2.0], [3.0, 4.0]]))
+
+
+def test_for_plain_python_iterable_unchanged():
+    def f(items, x):
+        for v in items:
+            x = x + v
+        return x
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g([1.0, 2.0], _t([0.0])).numpy(), [3.0])

@@ -1,0 +1,243 @@
+"""Meta-optimizer program-rewrite assertions (the reference's key dist-test
+trick: fleet_meta_optimizer_base.py builds a program, applies
+fleet.minimize with a strategy, then asserts on the rewritten op list —
+no devices needed).  VERDICT r1 item 9.
+
+Ref: test_fleet_sharding_meta_optimizer.py, strategy_compiler.py:1.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _build_program(hidden=16):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, hidden])
+        y = static.nn.fc(x, hidden)
+        y = static.nn.relu(y)
+        out = static.nn.fc(y, 1)
+        loss = static.nn.mean(out * out)
+    return main, startup, loss
+
+
+def _fleet_minimize(strategy_flags, loss, opt=None):
+    from paddle_tpu.distributed.fleet.distributed_strategy import (
+        DistributedStrategy,
+    )
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        apply_meta_optimizers,
+    )
+    from paddle_tpu.distributed.fleet import Fleet
+
+    strategy = DistributedStrategy()
+    for k, v in strategy_flags.items():
+        setattr(strategy, k, v)
+    f = Fleet()
+    f.init(is_collective=True, strategy=strategy)
+    opt = opt or paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    return strategy, apply_meta_optimizers(opt, strategy, loss, None, f)
+
+
+# ---- tensor parallel: specs from call sites, not guessed ----
+
+def test_static_split_column_then_row_specs():
+    """collective.split call sites attach the correct specs regardless of
+    layer order (the r1 alternation heuristic would mislabel col,col)."""
+    from jax.sharding import PartitionSpec as P
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8])
+            h1 = paddle.distributed.split(x, (8, 16), "linear", axis=1,
+                                          gather_out=False)
+            h2 = paddle.distributed.split(h1, (16, 8), "linear", axis=0)
+            loss = static.nn.mean(h2 * h2)
+            _fleet_minimize(
+                {"tensor_parallel": True,
+                 "tensor_parallel_configs": {"tensor_parallel_degree": 2}},
+                loss)
+        block = main.global_block()
+        specs = {n: v.dist_spec for n, v in block.vars.items()
+                 if getattr(v, "dist_spec", None) is not None
+                 and v.is_parameter and len(v.shape) == 2}
+        assert len(specs) == 2
+        col = [s for s in specs.values() if s == P(None, "model")]
+        row = [s for s in specs.values() if s == P("model", None)]
+        assert len(col) == 1 and len(row) == 1
+        types = [op.type for op in block.ops]
+        assert "c_identity" in types       # column input marker
+        assert "c_allreduce_sum" in types  # row output reduce
+        assert "c_broadcast" in types      # input broadcast at start
+        # rewritten program still trains
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+        l0 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        for _ in range(5):
+            l1 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
+    finally:
+        paddle.disable_static()
+
+
+def test_tp_without_call_sites_does_not_guess():
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_program()
+        with static.program_guard(main, startup):
+            _fleet_minimize(
+                {"tensor_parallel": True,
+                 "tensor_parallel_configs": {"tensor_parallel_degree": 2}},
+                loss)
+        block = main.global_block()
+        assert not any(getattr(v, "dist_spec", None) is not None
+                       for v in block.vars.values() if v.is_parameter)
+        assert "c_broadcast" not in [op.type for op in block.ops]
+    finally:
+        paddle.disable_static()
+
+
+def test_tp_degree_divisibility_validated():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 6])
+            h = paddle.distributed.split(x, (6, 9), "linear", axis=1)
+            loss = static.nn.mean(h * h)
+            with pytest.raises(ValueError, match="not divisible"):
+                _fleet_minimize(
+                    {"tensor_parallel": True,
+                     "tensor_parallel_configs": {"tensor_parallel_degree": 2}},
+                    loss)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_vocab_parallel_embedding():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            ids = static.data("ids", [4, 3], dtype="int64")
+            emb = paddle.distributed.split(ids, (32, 8), "embedding")
+            loss = static.nn.mean(emb * emb)
+        types = [op.type for op in main.global_block().ops]
+        assert "c_embedding" in types and "c_allreduce_sum" in types
+        exe = static.Executor()
+        exe.run(startup)
+        out = exe.run(main,
+                      feed={"ids": np.array([[0, 1, 2]] * 4, np.int64)},
+                      fetch_list=[emb])
+        assert out[0].shape == (4, 3, 8)
+    finally:
+        paddle.disable_static()
+
+
+# ---- StrategyCompiler ordering / exclusion ----
+
+def test_strategy_compiler_orders_and_stacks():
+    from paddle_tpu.distributed.fleet.distributed_strategy import (
+        DistributedStrategy,
+    )
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        META_OPTIMIZERS, StrategyCompiler, AMPOptimizer, RecomputeOptimizer,
+        ShardingOptimizer,
+    )
+
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.recompute = True
+    strategy.sharding = True
+    opt = paddle.optimizer.Momentum(learning_rate=0.1)
+    metas = [cls(opt) for cls in META_OPTIMIZERS]
+    chain = StrategyCompiler().generate_optimizer(None, None, opt, strategy,
+                                                  metas)
+    kinds = [type(m) for m in chain]
+    assert kinds.index(AMPOptimizer) < kinds.index(RecomputeOptimizer) \
+        < kinds.index(ShardingOptimizer)
+
+
+def test_strategy_compiler_sharding_disables_dgc():
+    from paddle_tpu.distributed.fleet.distributed_strategy import (
+        DistributedStrategy,
+    )
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        META_OPTIMIZERS, StrategyCompiler, DGCOptimizer, ShardingOptimizer,
+    )
+
+    strategy = DistributedStrategy()
+    strategy.sharding = True
+    strategy.dgc = True
+    strategy.fp16_allreduce = True
+    opt = paddle.optimizer.Momentum(learning_rate=0.1)
+    metas = [cls(opt) for cls in META_OPTIMIZERS]
+    chain = StrategyCompiler().generate_optimizer(None, None, opt, strategy,
+                                                  metas)
+    kinds = [type(m) for m in chain]
+    assert ShardingOptimizer in kinds
+    assert DGCOptimizer not in kinds
+    assert strategy.dgc is False            # _disable_strategy parity
+    assert strategy.fp16_allreduce is False
+
+
+def test_sharding_rewrite_op_list():
+    """test_fleet_sharding_meta_optimizer.py parity: c_broadcast +
+    c_reduce_sum inserted before the update ops."""
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_program()
+        with static.program_guard(main, startup):
+            _fleet_minimize(
+                {"sharding": True,
+                 "sharding_configs": {"sharding_degree": 2}}, loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "c_broadcast" in types
+        assert "c_reduce_sum" in types
+        # broadcast/reduce come before the first update op
+        first_update = min(i for i, t in enumerate(types) if t == "momentum")
+        assert max(i for i, t in enumerate(types)
+                   if t in ("c_broadcast", "c_reduce_sum")) < first_update
+    finally:
+        paddle.disable_static()
+
+
+def test_tp_broadcast_keeps_partial_feed_prunable():
+    """Input broadcasts must not force unfed vars: fetching only the
+    forward output with label unfed still runs (broadcast + loss ops
+    prune away)."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8])
+            label = static.data("label", [4, 1])
+            h = paddle.distributed.split(x, (8, 4), "linear", axis=1)
+            out = static.nn.fc(h, 1)
+            diff = out - label
+            loss = static.nn.mean(diff * diff)
+            _fleet_minimize(
+                {"tensor_parallel": True,
+                 "tensor_parallel_configs": {"tensor_parallel_degree": 2}},
+                loss, opt=_NoMinimizeOpt())
+        exe = static.Executor()
+        exe.run(startup)
+        res = exe.run(main, feed={"x": np.ones((4, 8), np.float32)},
+                      fetch_list=[out])
+        assert res[0].shape == (4, 1)
+    finally:
+        paddle.disable_static()
+
+
+class _NoMinimizeOpt:
+    """Inner optimizer stub: no update ops, so the program stays
+    inference-shaped (partial feed is meaningful)."""
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return None, []

@@ -1,0 +1,124 @@
+"""FLAGS_check_nan_inf sanitizer (nan_inf_utils.h:39 parity).
+
+VERDICT r1 item 6: the flag existed but was never consumed.  Three paths:
+eager concrete outputs, eager-ops-under-jit (debug callback), and the
+static executor's fetch-side finite-mask.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.framework import set_flags
+
+
+@pytest.fixture
+def nan_flag():
+    set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_eager_op_trips_with_op_name(nan_flag):
+    x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+    with pytest.raises(FloatingPointError, match="log"):
+        paddle.log(x)  # log(-1) = nan
+
+
+def test_eager_div_by_zero_inf(nan_flag):
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    z = paddle.to_tensor(np.zeros(3, np.float32))
+    with pytest.raises(FloatingPointError, match="divide|div"):
+        paddle.divide(x, z)
+
+
+def test_eager_clean_path_unaffected(nan_flag):
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    y = paddle.log(paddle.exp(x))
+    np.testing.assert_allclose(y.numpy(), np.ones(3), rtol=1e-6)
+
+
+def test_static_executor_fetch_side_mask(nan_flag):
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [3])
+            from paddle_tpu.static.nn_static import emit
+            import jax.numpy as jnp
+
+            bad = emit("log", [("X", x)], [("Out", [3], "float32")],
+                       lambda v: jnp.log(v))
+        exe = static.Executor()
+        exe.run(startup)
+        with pytest.raises(FloatingPointError, match="log"):
+            exe.run(main, feed={"x": np.array([-1.0, 1.0, 2.0], np.float32)},
+                    fetch_list=[bad])
+        # clean input passes through the same compiled block
+        out = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                      fetch_list=[bad])
+        np.testing.assert_allclose(out[0], np.zeros(3), atol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_static_flag_off_no_error():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2])
+            from paddle_tpu.static.nn_static import emit
+            import jax.numpy as jnp
+
+            bad = emit("log", [("X", x)], [("Out", [2], "float32")],
+                       lambda v: jnp.log(v))
+        exe = static.Executor()
+        exe.run(startup)
+        out = exe.run(main, feed={"x": np.array([-1.0, 1.0], np.float32)},
+                      fetch_list=[bad])
+        assert np.isnan(out[0][0])  # nan flows through silently
+    finally:
+        paddle.disable_static()
+
+
+def test_under_jit_callback_trips(nan_flag):
+    """Eager ops traced inside jit raise via jax.debug.callback at sync."""
+    import jax
+
+    from paddle_tpu.core.tensor import _wrap_data
+
+    def f(v):
+        return paddle.log(_wrap_data(v))._data
+
+    jf = jax.jit(f)
+    with pytest.raises(Exception, match="log"):
+        np.asarray(jf(np.array([-1.0], np.float32)))
+
+
+def test_compiled_train_step_loss_check(nan_flag):
+    """CompiledTrainStep raises on a non-finite loss."""
+    import jax
+
+    from paddle_tpu.parallel.env import build_mesh
+    from paddle_tpu.parallel.hybrid import CompiledTrainStep
+    from paddle_tpu.nn import Linear
+
+    model = Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=1e30,
+                               parameters=model.parameters())
+    mesh = build_mesh({"data": 1})
+
+    def loss_fn(m, x, y):
+        p = m(x)
+        d = paddle.subtract(p, y)
+        return paddle.mean(paddle.multiply(d, d))
+
+    trainer = CompiledTrainStep(model, loss_fn, opt, mesh,
+                                zero_shard_states=False)
+    x = paddle.to_tensor(np.full((2, 4), 1e20, np.float32))
+    y = paddle.to_tensor(np.zeros((2, 1), np.float32))
+    # either the per-op debug callback (traced eager op) or the step's
+    # loss check trips first; both carry the flag's name
+    with pytest.raises(Exception, match="check_nan_inf"):
+        trainer.step(x, y)

@@ -70,10 +70,11 @@ def test_barrier_table_threads():
 
 
 @pytest.fixture
-def ps_cluster():
+def ps_cluster(tmp_path):
     """2 server shards + client factory; torn down after the test."""
     eps = _free_endpoints(2)
-    servers = [PSServer(eps[i], server_index=i, num_servers=2, trainers=2)
+    servers = [PSServer(eps[i], server_index=i, num_servers=2, trainers=2,
+                        checkpoint_root=str(tmp_path))
                for i in range(2)]
     for s in servers:
         s.start()
@@ -217,3 +218,92 @@ def test_fleet_ps_lifecycle(monkeypatch):
         f_wrk.ps_client.pull_dense("w"), np.full(2, 0.9), rtol=1e-6)
     f_wrk.stop_worker()
     server.shutdown()
+
+
+def test_network_save_load_confined_to_root(tmp_path):
+    """ADVICE r1 (high): peer-chosen save/load paths must be confined to the
+    server-configured checkpoint root; no root configured = refused."""
+    from paddle_tpu.distributed.ps.service import PSServer, PSClient
+
+    # no checkpoint_root: network save refused
+    (ep,) = _free_endpoints(1)
+    server = PSServer(ep, trainers=1)
+    server.start()
+    try:
+        c = PSClient([ep])
+        c.ping()
+        with pytest.raises(RuntimeError, match="checkpoint_root"):
+            c.save(str(tmp_path / "anywhere"))
+        c.close()
+    finally:
+        server.shutdown()
+
+    # with a root: relative paths work, escapes are refused
+    (ep,) = _free_endpoints(1)
+    root = tmp_path / "root"
+    root.mkdir()
+    server = PSServer(ep, trainers=1, checkpoint_root=str(root))
+    server.start()
+    try:
+        c = PSClient([ep])
+        c.ping()
+        c.create_dense_table("w", (2,), lr=0.1)
+        c.set_dense("w", np.ones(2, np.float32))
+        c.save("ck")
+        assert (root / "ck" / "shard0.pkl").exists()
+        with pytest.raises(RuntimeError, match="escapes"):
+            c.save("../outside")
+        with pytest.raises(RuntimeError, match="escapes"):
+            c.load(str(tmp_path))  # absolute path outside the root
+        c.load("ck")
+        np.testing.assert_allclose(c.pull_dense("w"), np.ones(2))
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_checkpoint_load_rejects_malicious_pickle(tmp_path):
+    """Planted checkpoint shards must go through the allowlist unpickler."""
+    import pickle
+
+    from paddle_tpu.distributed.ps.service import PSServer
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    with open(ck / "shard0.pkl", "wb") as f:
+        pickle.dump({"dense": {"w": Evil()}, "sparse": {}}, f)
+    (ep,) = _free_endpoints(1)
+    server = PSServer(ep, trainers=1, checkpoint_root=str(tmp_path))
+    with pytest.raises(pickle.UnpicklingError, match="forbidden global"):
+        server.load(str(ck))
+
+
+def test_oversized_frame_rejected(monkeypatch):
+    """ADVICE r1 (low): a header claiming a huge frame must not allocate."""
+    import socket
+    import struct
+
+    from paddle_tpu.distributed.ps.service import PSServer, PSClient
+
+    (ep,) = _free_endpoints(1)
+    server = PSServer(ep, trainers=1)
+    server.start()
+    try:
+        host, port = ep.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10)
+        s.sendall(struct.pack(">I", 0xFFFFFFFF))  # claim ~4 GiB
+        s.sendall(b"x" * 64)
+        # server must drop the connection without reading 4 GiB
+        s.settimeout(10)
+        assert s.recv(1) == b""  # closed
+        s.close()
+        # server still healthy for well-behaved clients
+        c = PSClient([ep])
+        c.ping()
+        c.close()
+    finally:
+        server.shutdown()

@@ -144,3 +144,24 @@ def test_cond_backward():
         np.testing.assert_allclose(g2, np.full(3, 5.0))
     finally:
         paddle.disable_static()
+
+
+def test_switch_case_no_default_dispatches_max_key():
+    """ADVICE r1 (medium): unmatched index with no default must run the
+    max-key branch (control_flow.py:3592), not branch position 0; and the
+    dict branch_fns form must be accepted."""
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            idx = static.data(name="idx", shape=[1], dtype="int32")
+            x = static.data(name="x", shape=[3], dtype="float32")
+            out = static.nn.switch_case(
+                idx, {3: lambda: x + 10.0, 1: lambda: x * 2.0})
+        xv = np.arange(3, dtype=np.float32)
+        for i, want in [(1, xv * 2), (3, xv + 10), (99, xv + 10)]:
+            (got,) = _run(main, startup,
+                          {"idx": np.full(1, i, np.int32), "x": xv}, [out])
+            np.testing.assert_allclose(got, want)
+    finally:
+        paddle.disable_static()
