@@ -105,7 +105,11 @@ class Fleet:
             from .meta_optimizers.dygraph_optimizer import HybridParallelOptimizer
 
             return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
-        return optimizer
+        # the returned object's .minimize must route through the fleet
+        # meta-optimizer chain (reference usage:
+        # `opt = fleet.distributed_optimizer(opt); opt.minimize(loss)`) —
+        # returning the raw optimizer would silently skip every rewrite
+        return _FleetOptimizerProxy(self, optimizer)
 
     def distributed_model(self, model):
         """fleet_base.py:836: wrap by parallel mode."""
@@ -194,6 +198,24 @@ class Fleet:
         from .utils.fleet_util import UtilBase
 
         return UtilBase(self._role_maker)
+
+
+class _FleetOptimizerProxy:
+    """Delegates to the inner optimizer, except .minimize which runs the
+    fleet meta-optimizer chain (fleet_base.py:783 returns an object whose
+    minimize is _minimize_impl)."""
+
+    def __init__(self, fleet_obj, inner):
+        self._fleet = fleet_obj
+        self._inner = inner
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._fleet.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner"], item)
 
 
 fleet = Fleet()

@@ -80,8 +80,12 @@ class StrategyCompiler:
             key=lambda m: rank.get(type(m), len(rank)))
         selected_types = {type(m) for m in applicable}
         dropped = set()
-        for winner, losers in _EXCLUSIONS.items():
-            if winner not in selected_types:
+        # rank order, and a winner that was itself dropped by a
+        # higher-ranked one loses its veto (its conflicts are moot)
+        for winner in META_OPTIMIZERS:
+            losers = _EXCLUSIONS.get(winner)
+            if losers is None or winner not in selected_types \
+                    or winner in dropped:
                 continue
             for loser_cls, flag in losers.items():
                 if loser_cls in selected_types:

@@ -47,6 +47,25 @@ def make_fused_update(optimizer):
     return fused_update
 
 
+def zero_shard_update(gflat, state, lr, dp_axis, dp, shard_len,
+                      fused_update, pflat=None, pshard=None):
+    """Shared ZeRO core (used by both CompiledTrainStep and
+    PipelinedTrainStep): ONE reduce-scatter of the padded fused grad
+    buffer over `dp_axis` (the reduce-to-owner placement), then a local
+    update of this rank's range shard.  The shard source is either a
+    dynamic slice of the padded full buffer `pflat` (stages 1/2) or the
+    persistent shard `pshard` itself (stage 3).  Gathering updated params
+    back — or not, for stage 3 — is the caller's business."""
+    gshard = jax.lax.psum_scatter(
+        gflat.reshape(dp, shard_len), dp_axis,
+        scatter_dimension=0, tiled=False) / dp
+    if pshard is None:
+        idx = jax.lax.axis_index(dp_axis)
+        pshard = jax.lax.dynamic_slice_in_dim(
+            pflat, idx * shard_len, shard_len)
+    return fused_update(pshard, gshard, state, lr)
+
+
 def _clean_spec(spec, mesh, shape):
     """Validate a dist spec against the mesh: unknown axes or non-divisible
     dims fall back to replication."""
@@ -71,11 +90,28 @@ def _clean_spec(spec, mesh, shape):
 
 
 class CompiledTrainStep:
-    """Build once, call per step.  loss_fn(model_view, *batch) -> scalar."""
+    """Build once, call per step.  loss_fn(model_view, *batch) -> scalar.
+
+    zero_stage (sharding_optimizer.py:479-746 compiled analogue):
+    - 0: no ZeRO; per-leaf optimizer state sharded like its param.
+    - 1/2: optimizer state range-sharded over 'data'; the step does ONE
+      reduce-scatter of the fused grad buffer, a local shard update, and
+      one all-gather of params.  Stages 1 and 2 coincide here by
+      construction: gradients are values inside one XLA computation, never
+      persistent storage, so the full reduced gradient is never
+      materialized (the psum_scatter IS the reduce-to-owner placement).
+    - 3: parameters are *stored* range-sharded over 'data' too (persistent
+      param memory drops by dp); the step all-gathers params before use —
+      the compiled analogue of _add_broadcast_allreduce's
+      broadcast-before-use — reduce-scatters grads, and updates only the
+      local shard.  Transient peak still materializes the gathered params
+      inside the step (XLA owns the schedule); the persistent-state win is
+      what stage 3 buys.
+    """
 
     def __init__(self, model, loss_fn, optimizer, mesh, batch_specs=None,
                  amp_dtype=None, remat=False, donate=True,
-                 zero_shard_states=True):
+                 zero_shard_states=None, zero_stage=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -93,25 +129,34 @@ class CompiledTrainStep:
             "seq" if "seq" in mesh.axis_names and mesh.shape["seq"] > 1
             else None
         )
-        self.zero = (
-            zero_shard_states and self.dp_axis is not None
-            and mesh.shape[self.dp_axis] > 1
-        )
+        if zero_stage is None:
+            zero_stage = 1 if (zero_shard_states is None or zero_shard_states) \
+                else 0
+        dp_live = self.dp_axis is not None and mesh.shape[self.dp_axis] > 1
+        self.zero_stage = int(zero_stage) if dp_live else 0
+        self.zero = self.zero_stage >= 1
 
         named = dict(model.named_parameters())
+        self._named = named
         self.param_specs = {
             n: _clean_spec(getattr(p, "dist_spec", None), mesh, p._data.shape)
             for n, p in named.items()
         }
-        self.params = {
-            n: jax.device_put(p._data, NamedSharding(mesh, self.param_specs[n]))
-            for n, p in named.items()
-        }
-        # Optimizer state for the FUSED flat parameter space.  Inside
-        # shard_map each device sees its LOCAL param shards, so the flat
-        # buffer length is the sum of local sizes.  ZeRO-1 range-shards that
-        # buffer over 'data' (each rank updates one slice).
+        # ZeRO state buffers carry one leading dim per mesh axis the flat
+        # param space varies over: 'data' (the range shard) plus every
+        # param-sharding axis (TP 'model' shards make the local flat
+        # CONTENT differ per model rank — a buffer declared replicated
+        # over 'model' would be inconsistent).  'seq' never shards params.
+        self._buf_axes = tuple(
+            ax for ax in mesh.axis_names
+            if ax == self.dp_axis
+            or any(ax == a or (isinstance(a, tuple) and ax in a)
+                   for spec in self.param_specs.values() for a in spec)
+        )
         dp = mesh.shape[self.dp_axis] if self.dp_axis else 1
+
+        self._local_shapes = {}
+        self._param_dtypes = {}
         local_flat = 0
         for n, p in named.items():
             shape = list(p._data.shape)
@@ -121,6 +166,8 @@ class CompiledTrainStep:
                         np.prod([mesh.shape[a] for a in ax])
                     )
                     shape[i] //= size
+            self._local_shapes[n] = tuple(shape)
+            self._param_dtypes[n] = p._data.dtype
             local_flat += int(np.prod(shape)) if shape else 1
         self._local_flat = local_flat
         # pad the fused flat buffer to a multiple of lcm(dp, 1024): dp for
@@ -132,19 +179,35 @@ class CompiledTrainStep:
         self._pad = (-local_flat) % align
         padded = local_flat + self._pad
         shard_len = padded // dp
+        self._shard_len = shard_len
         from ..core.tensor import _wrap_data as _w
 
+        if self.zero_stage >= 3:
+            self._param_buf_spec = P(*self._buf_axes, None)
+            self.params = jax.device_put(
+                self._build_param_buffer(),
+                NamedSharding(mesh, self._param_buf_spec))
+        else:
+            self.params = {
+                n: jax.device_put(p._data,
+                                  NamedSharding(mesh, self.param_specs[n]))
+                for n, p in named.items()
+            }
         if self.zero:
-            # ZeRO-1 keeps the FUSED flat buffer: it range-shards evenly
+            # ZeRO keeps the FUSED flat buffer: it range-shards evenly
             # over 'data' regardless of param boundaries
             fake = _w(jnp.zeros((shard_len,), jnp.float32))
             self._flat_state_template = optimizer._init_state(fake)
+            buf_dims = tuple(mesh.shape[a] for a in self._buf_axes)
             self.flat_opt_state = {
                 # jnp.array copy: state entries may alias one buffer (e.g.
                 # Adam's two zero moments) and donation forbids duplicates
                 k: jax.device_put(
-                    jnp.array(jnp.tile(v, dp) if v.ndim else v),
-                    NamedSharding(mesh, P(self.dp_axis) if v.ndim else P()),
+                    jnp.array(jnp.broadcast_to(v, buf_dims + v.shape))
+                    if v.ndim else jnp.array(v),
+                    NamedSharding(
+                        mesh,
+                        P(*self._buf_axes, None) if v.ndim else P()),
                 )
                 for k, v in self._flat_state_template.items()
             }
@@ -169,6 +232,88 @@ class CompiledTrainStep:
                 self._tree_state_specs[n] = specs
                 self.flat_opt_state[n] = vals
         self._jit_step = None
+
+    # ---- ZeRO-3 param buffer (host-side pack/unpack) ----
+    def _extra_axes(self):
+        return [a for a in self._buf_axes if a != self.dp_axis]
+
+    def _local_tree_np(self, combo, extra_axes):
+        """Local (TP-shard) param values for the given extra-axis ranks."""
+        tree = {}
+        for n, p in self._named.items():
+            arr = np.asarray(p._data)
+            for dim, ax in enumerate(list(self.param_specs[n])):
+                if ax is None:
+                    continue
+                if isinstance(ax, tuple):
+                    raise NotImplementedError(
+                        "zero_stage=3 with tuple-axis param specs")
+                if ax == self.dp_axis or ax == self.seq_axis:
+                    raise NotImplementedError(
+                        f"zero_stage=3 with param sharded on {ax!r}")
+                j = combo[extra_axes.index(ax)]
+                w = arr.shape[dim] // self.mesh.shape[ax]
+                arr = np.take(arr, range(j * w, (j + 1) * w), axis=dim)
+            tree[n] = arr
+        return tree
+
+    def _build_param_buffer(self):
+        """(buf_dims..., shard_len) ndarray: for every extra-axis rank
+        combo, the padded local flat params split into dp range shards."""
+        import itertools
+
+        dp = self.mesh.shape[self.dp_axis]
+        extra_axes = self._extra_axes()
+        extra_sizes = [self.mesh.shape[a] for a in extra_axes]
+        buf_dims = tuple(self.mesh.shape[a] for a in self._buf_axes)
+        full = None
+        for combo in itertools.product(*[range(s) for s in extra_sizes]):
+            tree = self._local_tree_np(combo, extra_axes)
+            flat, _ = ravel_pytree(
+                {n: jnp.asarray(v) for n, v in tree.items()})
+            flat = np.asarray(flat)
+            if self._pad:
+                flat = np.concatenate(
+                    [flat, np.zeros(self._pad, flat.dtype)])
+            flat2d = flat.reshape(dp, self._shard_len)
+            if full is None:
+                full = np.zeros(buf_dims + (self._shard_len,), flat.dtype)
+            idx = tuple(
+                slice(None) if a == self.dp_axis
+                else combo[extra_axes.index(a)]
+                for a in self._buf_axes)
+            full[idx] = flat2d
+        return full
+
+    def _unpack_param_buffer(self, buf):
+        """Inverse of _build_param_buffer: full (unsharded) param dict."""
+        import itertools
+
+        extra_axes = self._extra_axes()
+        extra_sizes = [self.mesh.shape[a] for a in extra_axes]
+        template = {n: jnp.zeros(self._local_shapes[n],
+                                 self._param_dtypes[n])
+                    for n in self._named}
+        _, unravel = ravel_pytree(template)
+        out = {n: np.zeros(p._data.shape, self._param_dtypes[n])
+               for n, p in self._named.items()}
+        for combo in itertools.product(*[range(s) for s in extra_sizes]):
+            idx = tuple(
+                slice(None) if a == self.dp_axis
+                else combo[extra_axes.index(a)]
+                for a in self._buf_axes)
+            flat = np.asarray(buf)[idx].reshape(-1)[: self._local_flat]
+            tree = unravel(jnp.asarray(flat))
+            for n, v in tree.items():
+                tgt = [slice(None)] * v.ndim
+                for dim, ax in enumerate(list(self.param_specs[n])):
+                    if ax is None:
+                        continue
+                    j = combo[extra_axes.index(ax)]
+                    w = v.shape[dim]
+                    tgt[dim] = slice(j * w, (j + 1) * w)
+                out[n][tuple(tgt)] = np.asarray(v)
+        return out
 
     # ---- step construction ----
     def _build(self, batch_avals):
@@ -201,13 +346,31 @@ class CompiledTrainStep:
 
         fused_update = make_fused_update(optimizer)
 
+        zero3 = self.zero_stage >= 3
+        local_shapes = dict(self._local_shapes)
+        param_dtypes = dict(self._param_dtypes)
+        local_size = self._local_flat
+        n_buf_dims = len(self._buf_axes)
+        shard_len_s = self._shard_len
+
         def spmd_step(params, opt_state, batch_vals, key, lr):
             if dp_axis is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
             if seq_axis is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
+            if zero3:
+                # stage 3: params live range-sharded; gather before use
+                # (the _add_broadcast_allreduce broadcast-before-use)
+                pshard0 = params.reshape(-1)
+                pflat = jax.lax.all_gather(pshard0, dp_axis, tiled=True)
+                template = {n: jnp.zeros(local_shapes[n], param_dtypes[n])
+                            for n in local_shapes}
+                _, unravel_local = ravel_pytree(template)
+                params_tree = unravel_local(pflat[:local_size])
+            else:
+                params_tree = params
             loss, grads = jax.value_and_grad(local_loss)(
-                params, batch_vals, key
+                params_tree, batch_vals, key
             )
             if seq_axis is not None:
                 loss = jax.lax.pmean(loss, seq_axis)
@@ -216,29 +379,39 @@ class CompiledTrainStep:
                 if seq_axis is not None:
                     # params replicated over 'seq': average per-chunk grads
                     gflat = jax.lax.pmean(gflat, seq_axis)
-                pflat, unravel_local = ravel_pytree(params)
                 if pad:
                     gflat = jnp.concatenate(
                         [gflat, jnp.zeros((pad,), gflat.dtype)])
-                    pflat = jnp.concatenate(
-                        [pflat, jnp.zeros((pad,), pflat.dtype)])
-                local_size = pflat.shape[0] - pad
-                # ZeRO-1: ONE reduce_scatter of the fused grad buffer; each
-                # data rank updates its slice, then one all_gather of params
-                shard_len = pflat.shape[0] // dp
-                gshard = jax.lax.psum_scatter(
-                    gflat.reshape(dp, shard_len), dp_axis,
-                    scatter_dimension=0, tiled=False,
-                ) / dp
-                idx = jax.lax.axis_index(dp_axis)
-                pshard = jax.lax.dynamic_slice_in_dim(
-                    pflat, idx * shard_len, shard_len
+                shard_len = shard_len_s
+                if not zero3:
+                    pflat, unravel_local = ravel_pytree(params_tree)
+                    if pad:
+                        pflat = jnp.concatenate(
+                            [pflat, jnp.zeros((pad,), pflat.dtype)])
+                # state buffers arrive as (1,...,1,shard_len) local blocks
+                local_state = {
+                    k: v.reshape(-1) if v.ndim else v
+                    for k, v in opt_state.items()
+                }
+                new_p, new_state = zero_shard_update(
+                    gflat, local_state, lr, dp_axis, dp, shard_len,
+                    fused_update,
+                    pflat=None if zero3 else pflat,
+                    pshard=pshard0 if zero3 else None,
                 )
-                new_p, new_state = fused_update(
-                    pshard, gshard, opt_state, lr
-                )
-                pflat_new = jax.lax.all_gather(new_p, dp_axis, tiled=True)
-                new_params_tree = unravel_local(pflat_new[:local_size])
+                new_state = {
+                    k: v.reshape((1,) * n_buf_dims + (shard_len,))
+                    if v.ndim else v
+                    for k, v in new_state.items()
+                }
+                if zero3:
+                    # stage 3: only the shard persists — no gather-back
+                    new_params_tree = new_p.reshape(
+                        (1,) * n_buf_dims + (shard_len,))
+                else:
+                    pflat_new = jax.lax.all_gather(new_p, dp_axis,
+                                                   tiled=True)
+                    new_params_tree = unravel_local(pflat_new[:local_size])
             else:
                 # per-leaf grads + update; XLA's all-reduce combiner fuses
                 # the per-leaf pmeans into bucketed collectives (the
@@ -261,12 +434,15 @@ class CompiledTrainStep:
             return loss, new_params_tree, new_state
 
         if self.zero:
-            state_specs = {k: (P(dp_axis) if v.ndim else P())
-                           for k, v in self._flat_state_template.items()}
+            state_specs = {
+                k: (P(*self._buf_axes, None) if v.ndim else P())
+                for k, v in self._flat_state_template.items()}
         else:
             state_specs = self._tree_state_specs
+        param_specs = (self._param_buf_spec if self.zero_stage >= 3
+                       else {n: s for n, s in self.param_specs.items()})
         in_specs = (
-            {n: s for n, s in self.param_specs.items()},
+            param_specs,
             state_specs,
             self._batch_pspecs(batch_avals),
             P(),
@@ -350,6 +526,10 @@ class CompiledTrainStep:
 
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
+        if self.zero_stage >= 3:
+            for n, v in self._unpack_param_buffer(self.params).items():
+                named[n]._data = jnp.asarray(v)
+            return
         for n, v in self.params.items():
             named[n]._data = v
 

@@ -27,19 +27,24 @@ def _make_model_and_data(seed=0):
     return cfg, model, ids, labels
 
 
-def _run_compiled(mesh_dims, zero, n_steps=3, amp=None):
+def _run_compiled(mesh_dims, zero, n_steps=3, amp=None, zero_stage=None,
+                  return_trainer=False):
     cfg, model, ids, labels = _make_model_and_data()
     opt = paddle.optimizer.SGD(learning_rate=0.1,
                                parameters=model.parameters())
     mesh = build_mesh(mesh_dims)
     tr = CompiledTrainStep(
         model, lambda m, i, l: m.loss(i, l), opt, mesh,
-        amp_dtype=amp, zero_shard_states=zero,
+        amp_dtype=amp,
+        **({"zero_stage": zero_stage} if zero_stage is not None
+           else {"zero_shard_states": zero}),
     )
     losses = []
     for _ in range(n_steps):
         loss = tr.step(paddle.to_tensor(ids), paddle.to_tensor(labels))
         losses.append(float(np.asarray(loss._data)))
+    if return_trainer:
+        return losses, tr
     return losses
 
 
@@ -80,3 +85,54 @@ def test_losses_decrease_under_amp_bf16():
     losses = _run_compiled({"data": 2, "model": 2}, zero=True, n_steps=4,
                            amp=jnp.bfloat16)
     assert losses[-1] < losses[0]
+
+
+# ---- ZeRO stages 2/3 (VERDICT r1 item 3; sharding_optimizer.py:479-746) ----
+
+def test_zero_stage2_matches_single_device():
+    ref = _run_eager()
+    z2 = _run_compiled({"data": 8}, zero=None, zero_stage=2)
+    np.testing.assert_allclose(z2, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_zero_stage3_matches_single_device():
+    """Params stored range-sharded over 'data', gathered before use."""
+    ref = _run_eager()
+    z3 = _run_compiled({"data": 8}, zero=None, zero_stage=3)
+    np.testing.assert_allclose(z3, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_zero_stage3_with_tp_matches():
+    ref = _run_eager()
+    z3 = _run_compiled({"data": 4, "model": 2}, zero=None, zero_stage=3)
+    np.testing.assert_allclose(z3, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_zero_stage3_param_storage_is_sharded():
+    """The persistent param buffer holds 1/dp per data rank, and
+    sync_to_model reconstructs full params that keep training."""
+    losses, tr = _run_compiled({"data": 4, "model": 2}, zero=None,
+                               zero_stage=3, return_trainer=True)
+    import jax as _jax
+
+    # storage: one (1,1,shard_len) block per (data, model) rank pair
+    assert tr.params.ndim == 3
+    assert tr.params.shape[0] == 4 and tr.params.shape[1] == 2
+    for shard in tr.params.addressable_shards:
+        assert shard.data.shape[0] == 1 and shard.data.shape[1] == 1
+    # reconstruction round-trips: stage-3 state == eager-trained weights
+    tr.sync_to_model()
+    ref_losses = _run_eager()
+    named = dict(tr.model.named_parameters())
+    cfg, model, ids, labels = _make_model_and_data()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    for _ in range(3):
+        loss = model.loss(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for n, p in model.named_parameters():
+        np.testing.assert_allclose(
+            np.asarray(named[n]._data), np.asarray(p._data),
+            rtol=3e-4, atol=3e-4)
