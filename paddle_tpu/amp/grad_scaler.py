@@ -39,8 +39,17 @@ class AmpScaler:
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
         found = False
+        from ..core.indexed_slices import IndexedSlices
+
         for p in params:
             if p.grad is None:
+                continue
+            if isinstance(p.grad, IndexedSlices):
+                # sparse rows unscale in place and STAY sparse
+                vals = p.grad.values * inv
+                found = found or bool(jnp.any(~jnp.isfinite(vals)))
+                p.grad = IndexedSlices(p.grad.indices, vals,
+                                       p.grad.dense_shape)
                 continue
             g = p.grad._data * inv
             found = found or bool(jnp.any(~jnp.isfinite(g)))

@@ -191,11 +191,38 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             node.diff_fn = None  # closure retains the primal graph
 
     # write accumulated grads into leaves
+    from .indexed_slices import IndexedSlices
+
     for _, (t, cot) in leaf_cots.items():
         if cot is None or t.stop_gradient:
             continue
+        hooks = [h for st, h in getattr(t, "_leaf_hooks", [])
+                 if st["active"]]
+        if hooks:
+            # leaf hooks see (and may replace) the accumulated cotangent
+            # before it lands in .grad (hooks.h leaf-accumulation hooks)
+            if isinstance(cot, IndexedSlices):
+                cot = cot.to_dense()
+            for h in hooks:
+                out = h(_wrap_data(cot, stop_gradient=True))
+                if out is not None:
+                    cot = out._data if isinstance(out, Tensor) else out
+        if isinstance(cot, IndexedSlices):
+            # sparse rows stay sparse on the leaf (SelectedRows grad var);
+            # accumulation with an existing dense grad densifies
+            prev = t.grad
+            if prev is None:
+                t.grad = cot
+            elif isinstance(prev, IndexedSlices):
+                t.grad = prev + cot
+            else:
+                t.grad = _wrap_data(prev._data + cot.to_dense(),
+                                    stop_gradient=True)
+            continue
         if t.grad is None:
             t.grad = _wrap_data(cot, stop_gradient=True)
+        elif isinstance(t.grad, IndexedSlices):
+            t.grad = _wrap_data(t.grad.to_dense() + cot, stop_gradient=True)
         else:
             t.grad = _wrap_data(t.grad._data + cot, stop_gradient=True)
 
@@ -292,7 +319,15 @@ def grad(
             else:
                 def run_vjp(*cot_vals, _vjp=node.vjp_fn, _t=node.tuple_out):
                     res = _vjp(cot_vals if _t else cot_vals[0])
-                    return res if isinstance(res, tuple) else (res,)
+                    res = res if isinstance(res, tuple) else (res,)
+                    # grad() returns explicit tensors to the caller, so a
+                    # sparse (IndexedSlices) cotangent densifies here —
+                    # backward() is the engine that keeps leaf grads sparse
+                    from .indexed_slices import IndexedSlices as _IS
+
+                    return tuple(
+                        r.to_dense() if isinstance(r, _IS) else r
+                        for r in res)
 
                 op_args = cot_tensors
 

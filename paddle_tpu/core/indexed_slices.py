@@ -1,0 +1,68 @@
+"""IndexedSlices: sparse row-gradients for embedding tables.
+
+Reference parity: SelectedRows (paddle/fluid/framework/selected_rows.h:1) —
+the first-class sparse-rows type threaded from lookup_table grad kernels
+into the optimizers' sparse update paths.  TPU-native design: the EAGER
+tape produces an IndexedSlices cotangent for `embedding(..., sparse=True)`
+weights instead of scatter-adding into a dense vocab-size buffer; the
+optimizer's sparse fast path updates only the touched rows.  Compiled
+(jit/shard_map) steps keep dense gradients — XLA fuses the scatter and
+there is no persistent grad buffer to save.
+"""
+import jax
+import jax.numpy as jnp
+
+
+class IndexedSlices:
+    """(indices, values) rows of a conceptually dense [dense_shape] grad."""
+
+    __slots__ = ("indices", "values", "dense_shape")
+
+    def __init__(self, indices, values, dense_shape):
+        self.indices = jnp.asarray(indices).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(dense_shape)
+
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):
+        return (f"IndexedSlices(nnz_rows={self.indices.shape[0]}, "
+                f"dense_shape={self.dense_shape})")
+
+    # -- accumulation (tape sums multi-consumer grads by +) --
+    def __add__(self, other):
+        if isinstance(other, IndexedSlices) \
+                and other.dense_shape == self.dense_shape:
+            return IndexedSlices(
+                jnp.concatenate([self.indices, other.indices]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        if other is None:
+            return self
+        dense = other.to_dense() if isinstance(other, IndexedSlices) else other
+        return self.to_dense() + dense
+
+    __radd__ = __add__
+
+    def coalesce(self):
+        """(unique_ids, summed_rows): duplicate ids merge (the reference's
+        MergeAdd on SelectedRows)."""
+        uniq, inv = jnp.unique(self.indices, return_inverse=True)
+        summed = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                     num_segments=uniq.shape[0])
+        return uniq, summed
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def numpy(self):  # Tensor-API convenience for tests/debugging
+        import numpy as np
+
+        return np.asarray(self.to_dense())

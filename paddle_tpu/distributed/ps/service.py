@@ -126,7 +126,11 @@ class PSServer:
             return t
 
     def _handle(self, msg):
+        from ...profiler.monitor import stat_add
+
         cmd = msg[0]
+        # monitor.h STAT_ADD parity: the PS stack maintains named gauges
+        stat_add(f"ps_server_{cmd}_count")
         if cmd == "ping":
             return ("ok", self.server_index)
         if cmd == "create_dense":

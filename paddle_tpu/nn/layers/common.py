@@ -55,8 +55,11 @@ class Embedding(Layer):
 
             self.weight._data = self.weight._data.at[self._padding_idx].set(0.0)
 
+        self._sparse = sparse
+
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
 
 class Dropout(Layer):

@@ -97,11 +97,15 @@ def diag(x, offset=0, padding_value=0):
 
 
 def tril(x, diagonal=0):
-    return _wrap_data(jnp.tril(x._data, k=diagonal))
+    from ..core.registry import apply_op
+
+    return apply_op("tril_triu", lambda v: jnp.tril(v, k=diagonal), (x,), {})
 
 
 def triu(x, diagonal=0):
-    return _wrap_data(jnp.triu(x._data, k=diagonal))
+    from ..core.registry import apply_op
+
+    return apply_op("tril_triu", lambda v: jnp.triu(v, k=diagonal), (x,), {})
 
 
 def meshgrid(*args):

@@ -634,7 +634,50 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = out * mask.astype(out.dtype)
         return out
 
+    if sparse:
+        return _sparse_embedding(idx, weight, padding_idx, fn)
     return apply_op("lookup_table_v2", fn, (weight,), {})
+
+
+def _sparse_embedding(idx, weight, padding_idx, fn):
+    """sparse=True eager path (selected_rows.h parity): the weight
+    cotangent is an IndexedSlices of the looked-up rows, never a dense
+    vocab-size buffer.  Under jit tracing (compiled steps) the weight grad
+    must stay a dense array, so tracing falls back to the dense vjp."""
+    from ..core import autograd
+    from ..core.tensor import _wrap_data
+    from ..core.indexed_slices import IndexedSlices
+
+    needs_grad = (
+        autograd.is_grad_enabled()
+        and isinstance(weight, Tensor)
+        and not weight.stop_gradient
+        and not isinstance(weight._data, jax.core.Tracer)
+        and not isinstance(idx, jax.core.Tracer)
+    )
+    if not needs_grad:
+        return apply_op("lookup_table_v2", fn, (weight,), {})
+
+    with autograd.no_grad():
+        out_val = fn(weight._data)
+    dim_shape = weight._data.shape[1:]
+    flat_idx = idx.reshape(-1)
+
+    def vjp_fn(cot):
+        vals = cot.reshape((flat_idx.shape[0],) + dim_shape)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (flat_idx != padding_idx)[..., None]
+            vals = vals * mask.astype(vals.dtype)
+        return (IndexedSlices(flat_idx, vals, weight._data.shape),)
+
+    node = autograd.TapeNode(
+        "lookup_table_v2_sparse", vjp_fn, [weight], 1,
+        [out_val.shape], [out_val.dtype], tuple_out=False,
+    )
+    out = _wrap_data(out_val, stop_gradient=False)
+    out._node = node
+    out._out_index = 0
+    return out
 
 
 # ---- linear ----

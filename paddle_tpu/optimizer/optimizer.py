@@ -68,8 +68,48 @@ class Optimizer:
     def update(self, param, grad, state, lr):
         raise NotImplementedError
 
+    # ---- sparse (SelectedRows) fast path ----
+    def _sparse_step(self, p, slices, plr):
+        """Row-wise update for an IndexedSlices grad (selected_rows.h /
+        lazy-mode sparse optimizer parity): only the touched rows of the
+        param and its param-shaped state update; scalar state (e.g. Adam's
+        beta pows) advances once per step as usual."""
+        ids, rows = slices.coalesce()
+        state = self._state_for(p)
+        row_state = {
+            k: v[ids] if getattr(v, "ndim", 0) and v.shape == p._data.shape
+            else v
+            for k, v in state.items()
+        }
+        cur = p._data[ids]
+        g = rows.astype(cur.dtype) if rows.dtype != cur.dtype else rows
+        # same per-param weight-decay controls as the dense loop
+        wd = self._weight_decay_coeff()
+        reg = p.__dict__.get("regularizer")
+        if reg is not None and hasattr(reg, "_coeff"):
+            wd = float(reg._coeff)
+        decay_fn = getattr(self, "_apply_decay_param_fun", None)
+        if decay_fn is not None and p.name and not decay_fn(p.name):
+            wd = 0.0
+        self._current_param_name = p.name
+        if wd and not self._decoupled_weight_decay:
+            g = g + wd * cur
+        new_rows, new_row_state = self.update(cur, g, row_state, plr)
+        if wd and self._decoupled_weight_decay:
+            new_rows = new_rows - plr * wd * cur
+        p._data = p._data.at[ids].set(new_rows)
+        for k, v in new_row_state.items():
+            old = state.get(k)
+            if getattr(old, "ndim", 0) and old.shape == p._data.shape:
+                state[k] = old.at[ids].set(v)
+            else:
+                state[k] = v
+        self._states[id(p)] = state
+
     # ---- imperative step ----
     def step(self):
+        from ..core.indexed_slices import IndexedSlices
+
         params = self._parameter_list
         if params is None:
             raise ValueError("Optimizer created without parameters")
@@ -77,6 +117,23 @@ class Optimizer:
         lr = self.get_lr()
         params_grads = [(p, p.grad) for p in params if p.grad is not None
                         and not p.stop_gradient]
+        sparse = [(p, g) for p, g in params_grads
+                  if isinstance(g, IndexedSlices)]
+        params_grads = [(p, g) for p, g in params_grads
+                        if not isinstance(g, IndexedSlices)]
+        if self._grad_clip is not None and sparse:
+            # global-norm clipping needs every grad: densify (documented
+            # trade-off; the reference merges SelectedRows the same way)
+            from ..core.tensor import _wrap_data
+
+            params_grads += [(p, _wrap_data(g.to_dense(),
+                                            stop_gradient=True))
+                             for p, g in sparse]
+            sparse = []
+        for p, g in sparse:
+            plr = lr * p.__dict__.get("optimize_attr", {}).get(
+                "learning_rate", 1.0)
+            self._sparse_step(p, g, plr)
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         for p, g in params_grads:

@@ -118,3 +118,8 @@ class Profiler:
 
     def summary(self, **kw):
         return summary()
+
+
+from .monitor import (  # noqa: E402,F401  (monitor.h StatRegistry parity)
+    Stat, StatRegistry, stat_add, stat_sub, stat_get,
+)

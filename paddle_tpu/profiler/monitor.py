@@ -1,0 +1,84 @@
+"""Monitor gauges: named int/float counters.
+
+Reference parity: platform/monitor.{h,cc} — `StatRegistry` (monitor.h:77)
+with the `STAT_ADD`/`STAT_SUB` macros (monitor.h:130), used by the PS stack
+for push/pull counters.  TPU-native: a process-local thread-safe registry;
+readers snapshot via stats()/get.
+"""
+import threading
+
+
+class Stat:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name, value=0):
+        self.name = name
+        self._value = value
+        self._lock = threading.Lock()
+
+    def increase(self, v=1):
+        with self._lock:
+            self._value += v
+            return self._value
+
+    def decrease(self, v=1):
+        return self.increase(-v)
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+    def get(self):
+        with self._lock:
+            return self._value
+
+
+class StatRegistry:
+    """monitor.h:77 parity (singleton via instance())."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._stats = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls):
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def get_stat(self, name):
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = Stat(name)
+                self._stats[name] = st
+            return st
+
+    def stats(self):
+        """Snapshot: {name: value}."""
+        with self._lock:
+            items = list(self._stats.items())
+        return {n: s.get() for n, s in items}
+
+    def reset_all(self):
+        with self._lock:
+            items = list(self._stats.values())
+        for s in items:
+            s.reset()
+
+
+def stat_add(name, value=1):
+    """STAT_ADD macro parity (monitor.h:130)."""
+    return StatRegistry.instance().get_stat(name).increase(value)
+
+
+def stat_sub(name, value=1):
+    return StatRegistry.instance().get_stat(name).decrease(value)
+
+
+def stat_get(name):
+    return StatRegistry.instance().get_stat(name).get()

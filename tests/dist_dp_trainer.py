@@ -1,0 +1,89 @@
+"""2-process data-parallel trainer used by the launch/spawn dist tests.
+
+check_with_place contract (reference test_dist_base.py:1266): per-step
+distributed losses must match the single-process run.  Each process owns
+one CPU device; jax.distributed.initialize is the coordination-service
+analogue of the reference's TCP nccl-id broadcast
+(gen_comm_id_helper.cc:297).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def train_dp(out_path=None):
+    # exactly one local device per process: the parent test env carries an
+    # 8-device XLA_FLAGS, so override rather than setdefault
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n > 1:
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PADDLE_MASTER"],
+            num_processes=n, process_id=rank)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P, NamedSharding
+
+    from paddle_tpu.parallel.env import init_parallel_env, global_mesh
+    from paddle_tpu.parallel.collective import shard_map
+
+    init_parallel_env()
+    mesh = global_mesh()
+
+    # deterministic fit-a-line data, global batch 8 sharded over ranks
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 3).astype(np.float32)
+    Wt = rng.rand(3, 1).astype(np.float32)
+    Y = X @ Wt + 0.1
+    per = 8 // n
+    Xl, Yl = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+    sh = NamedSharding(mesh, P("data", None))
+    if n > 1:
+        xs = jax.make_array_from_process_local_data(sh, Xl)
+        ys = jax.make_array_from_process_local_data(sh, Yl)
+    else:
+        xs = jax.device_put(X, sh)
+        ys = jax.device_put(Y, sh)
+
+    def local_step(w, b, x, y):
+        def loss_fn(w, b):
+            pred = x @ w + b
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+        loss = jax.lax.pmean(loss, "data")
+        gw, gb = (jax.lax.pmean(g, "data") for g in grads)
+        return loss, w - 0.5 * gw, b - 0.5 * gb
+
+    step = jax.jit(shard_map(
+        local_step, mesh,
+        in_specs=(P(), P(), P("data", None), P("data", None)),
+        out_specs=(P(), P(), P())))
+    w = jnp.zeros((3, 1), jnp.float32)
+    b = jnp.zeros((1,), jnp.float32)
+    losses = []
+    for _ in range(3):
+        loss, w, b = step(w, b, xs, ys)
+        losses.append(float(np.asarray(loss)))
+    if out_path and rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    print("LOSSES " + json.dumps(losses), flush=True)
+    return losses
+
+
+def spawn_entry(out_dir):
+    """Entry for paddle.distributed.spawn (rank env set by _wrap)."""
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    train_dp(os.path.join(out_dir, "spawn_losses.json")
+             if rank == "0" else None)
+
+
+if __name__ == "__main__":
+    train_dp(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -2,9 +2,10 @@
 
 Reference parity: python/paddle/fluid/tests/unittests/op_test.py (OpTest:270,
 check_output_with_place:1078, check_grad:1409, get_numeric_gradient:110): a
-test declares an op, numpy inputs/attrs, expected outputs; the harness runs
-the op through the eager dispatcher AND the static executor and compares
-analytic gradients against central finite differences.
+test declares an op, numpy inputs/attrs, expected outputs; check_output runs
+the op through the eager dispatcher AND the static Program/Executor (the op
+is emitted into a program and executed through the compiled-block path), and
+check_grad compares analytic gradients against central finite differences.
 """
 import numpy as np
 
@@ -74,6 +75,63 @@ class OpTest:
                 np.asarray(o.numpy(), np.float64), np.asarray(r, np.float64),
                 rtol=self.out_rtol, atol=self.out_atol,
             )
+        self.check_output_static(arrays, refs)
+
+    def check_output_static(self, arrays=None, refs=None):
+        """Run the op through the static Program/Executor path: the op is
+        emitted as a program op and executed via the compiled block
+        (Program IR -> planner -> jit lowering -> feed/fetch), mirroring
+        the reference's check_output_with_place static leg."""
+        import paddle_tpu.static as static
+        from paddle_tpu.static.nn_static import emit
+        from paddle_tpu.core import autograd
+        from paddle_tpu.core.tensor import _wrap_data
+
+        if arrays is None:
+            arrays = self.make_inputs()
+        if refs is None:
+            refs = self.ref(*arrays)
+            refs = refs if isinstance(refs, (list, tuple)) else [refs]
+        refs = [np.asarray(r) for r in refs]
+
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                feed_vars = [
+                    static.data(f"x{i}", list(a.shape), dtype=str(a.dtype))
+                    for i, a in enumerate(arrays)
+                ]
+
+                def fn(*vals):
+                    with autograd.no_grad():
+                        out = self.run_op(*[_wrap_data(v) for v in vals])
+                    if isinstance(out, (list, tuple)):
+                        return tuple(o._data for o in out)
+                    return out._data
+
+                outs_spec = [(f"Out{i}", list(r.shape), str(r.dtype))
+                             for i, r in enumerate(refs)]
+                out_vars = emit(type(self).__name__,
+                                [(f"X{i}", v) for i, v in
+                                 enumerate(feed_vars)],
+                                outs_spec, fn)
+                if not isinstance(out_vars, list):
+                    out_vars = [out_vars]
+            exe = static.Executor()
+            exe.run(startup)
+            res = exe.run(main,
+                          feed={f"x{i}": a for i, a in enumerate(arrays)},
+                          fetch_list=out_vars)
+            for got, r in zip(res, refs):
+                np.testing.assert_allclose(
+                    np.asarray(got, np.float64),
+                    np.asarray(r, np.float64),
+                    rtol=self.out_rtol, atol=self.out_atol,
+                    err_msg=f"{type(self).__name__}: static path mismatch",
+                )
+        finally:
+            paddle.disable_static()
 
     def check_grad(self, wrt=(0,), delta=1e-3):
         arrays = self.make_inputs()

@@ -1,0 +1,143 @@
+"""Real multi-process distributed tests (VERDICT r1 item 7).
+
+The reference's dist-test contract is multi-process-localhost
+(test_dist_base.py check_with_place:1266): fork trainer processes, pipe out
+losses, assert dist losses == single-process losses step-by-step.  These
+tests exercise distributed/launch.py, distributed/spawn.py and
+fleet/elastic.py as real process managers, with jax.distributed over
+localhost CPU as the comm backend.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "dist_dp_trainer.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_losses(tmp_path):
+    out = str(tmp_path / "single.json")
+    env = dict(os.environ)
+    env.update({"PADDLE_TRAINER_ID": "0", "PADDLE_TRAINERS_NUM": "1"})
+    subprocess.run([sys.executable, TRAINER, out], env=env, check=True,
+                   cwd=REPO, capture_output=True, timeout=300)
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_launch_two_process_dp_matches_single(tmp_path):
+    """distributed/launch.py forks one worker per node rank; 2-process DP
+    losses must match the single-process run (check_with_place)."""
+    from paddle_tpu.distributed.launch import (
+        launch_workers, watch_local_trainers,
+    )
+
+    master = f"127.0.0.1:{_free_port()}"
+    out = str(tmp_path / "dist.json")
+    procs = []
+    for rank in range(2):
+        procs += launch_workers(TRAINER, [out] if rank == 0 else ["-"],
+                                nnodes=2, node_rank=rank,
+                                master_endpoint=master)
+    deadline = time.time() + 300
+    alive = procs
+    while alive and time.time() < deadline:
+        alive = watch_local_trainers(alive, 2)
+        time.sleep(0.5)
+    assert not alive, "trainers did not finish in time"
+    with open(out) as f:
+        dist_losses = json.load(f)
+    ref = _single_process_losses(tmp_path)
+    np.testing.assert_allclose(dist_losses, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_launch_watchdog_aborts_all_on_failure(tmp_path):
+    """watch_local_trainers must kill surviving ranks when one dies
+    (distributed/utils.py watchdog contract)."""
+    from paddle_tpu.distributed.launch import (
+        TrainerProc, watch_local_trainers,
+    )
+
+    ok = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    bad = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    bad.wait()
+    procs = [TrainerProc(ok, 0), TrainerProc(bad, 1)]
+    with pytest.raises(RuntimeError, match="rank 1 failed"):
+        watch_local_trainers(procs, 2)
+    ok.wait(timeout=10)
+    assert ok.poll() is not None  # survivor was terminated
+
+
+def test_spawn_two_process_dp_matches_single(tmp_path):
+    """paddle.distributed.spawn forks fresh interpreters per rank."""
+    from paddle_tpu.distributed.spawn import spawn
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from dist_dp_trainer import spawn_entry
+
+    master = f"127.0.0.1:{_free_port()}"
+    old = {k: os.environ.get(k)
+           for k in ("PADDLE_MASTER", "PADDLE_TRAINERS_NUM",
+                     "PADDLE_TRAINER_ID")}
+    os.environ["PADDLE_MASTER"] = master
+    os.environ["PADDLE_TRAINERS_NUM"] = "2"
+    os.environ.pop("PADDLE_TRAINER_ID", None)
+    try:
+        spawn(spawn_entry, args=(str(tmp_path),), nprocs=2, join=True)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    with open(tmp_path / "spawn_losses.json") as f:
+        dist_losses = json.load(f)
+    ref = _single_process_losses(tmp_path)
+    np.testing.assert_allclose(dist_losses, ref, rtol=1e-6, atol=1e-7)
+
+
+# ---- elastic (mocked-store contract, test_fleet_elastic_manager.py) ----
+
+def test_elastic_membership_and_restart_on_scale_change():
+    from paddle_tpu.distributed.fleet.elastic import (
+        ElasticManager, ElasticStatus, MemoryStore,
+    )
+
+    store = MemoryStore()
+    m1 = ElasticManager(store=store, np=2, host="10.0.0.1", job_id="j1")
+    m2 = ElasticManager(store=store, np=2, host="10.0.0.2", job_id="j1")
+    m1.register()
+    assert not m1._match()
+    m2.register()
+    assert m1.wait(timeout=5)
+    assert m1.hosts() == ["10.0.0.1", "10.0.0.2"]
+
+    # launcher supervises a real local process to completion
+    m1.launcher.launch([sys.executable, "-c", "print('ok')"])
+    deadline = time.time() + 30
+    status = ElasticStatus.HOLD
+    while status == ElasticStatus.HOLD and time.time() < deadline:
+        status = m1.launcher.watch()
+        time.sleep(0.2)
+    assert status == ElasticStatus.COMPLETED
+
+    # membership change triggers RESTART: member 2 leaves
+    m1.launcher.launch([sys.executable, "-c", "import time; time.sleep(60)"])
+    m2.exit()
+    assert m1.watch() == ElasticStatus.RESTART
+    assert m1.launcher.procs == []  # trainers were torn down
+    m1.exit()

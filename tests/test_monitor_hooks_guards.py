@@ -1,0 +1,75 @@
+"""VERDICT r1 small items: StatRegistry gauges (monitor.h:77), leaf
+register_hook (hooks.h), int64 range guard."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_stat_registry_gauges():
+    from paddle_tpu.profiler import StatRegistry, stat_add, stat_get
+
+    reg = StatRegistry.instance()
+    reg.reset_all()
+    stat_add("test_gauge", 5)
+    stat_add("test_gauge")
+    assert stat_get("test_gauge") == 6
+    assert reg.stats()["test_gauge"] == 6
+    reg.get_stat("test_gauge").reset()
+    assert stat_get("test_gauge") == 0
+
+
+def test_ps_service_increments_gauges(tmp_path):
+    from paddle_tpu.distributed.ps.service import PSServer, PSClient
+    from paddle_tpu.profiler import StatRegistry, stat_get
+    import socket
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"; s.close()
+    StatRegistry.instance().reset_all()
+    server = PSServer(ep, trainers=1)
+    server.start()
+    try:
+        c = PSClient([ep]); c.ping()
+        c.create_dense_table("w", (2,), lr=0.1)
+        c.pull_dense("w"); c.pull_dense("w")
+        assert stat_get("ps_server_pull_dense_count") == 2
+        assert stat_get("ps_server_ping_count") >= 1
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_register_hook_on_leaf():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    seen = []
+    handle = x.register_hook(lambda g: (seen.append(g.numpy().copy()),
+                                        paddle.scale(g, 2.0))[1])
+    y = paddle.sum(paddle.multiply(x, x))
+    y.backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), 2 * 2 * np.ones(3))  # doubled
+
+    # removed handle: hook no longer fires
+    handle.remove()
+    x.clear_grad()
+    y2 = paddle.sum(paddle.multiply(x, x))
+    y2.backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones(3))
+
+
+def test_register_hook_non_leaf_still_works():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    h = paddle.scale(x, 3.0)
+    h.register_hook(lambda g: paddle.scale(g, 10.0))
+    paddle.sum(h).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 30 * np.ones(3))
+
+
+def test_int64_overflow_rejected():
+    paddle.to_tensor(np.array([2**31 - 1], np.int64))  # max ok
+    with pytest.raises(OverflowError, match="int32 range"):
+        paddle.to_tensor(np.array([2**31], np.int64))
+    with pytest.raises(OverflowError, match="int32 range"):
+        paddle.to_tensor(np.array([-2**31 - 1], np.int64))

@@ -1,0 +1,266 @@
+"""Wide table-driven golden op coverage (VERDICT r1 item 10: >= 60 ops
+through the OpTest harness, eager + static executor legs, numeric-grad
+oracle).  Priority order follows SURVEY §7.4 call-site counts.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTest
+
+
+def f32(shape, seed=0, lo=0.05, hi=1.0):
+    def make():
+        r = np.random.RandomState(seed)
+        return (r.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+    return make
+
+
+def sf32(shape, seed=0, scale=1.0):  # signed
+    def make():
+        return (np.random.RandomState(seed).randn(*shape)
+                * scale).astype(np.float32)
+    return make
+
+
+def i64(shape, seed=0, hi=5):
+    def make():
+        return np.random.RandomState(seed).randint(
+            0, hi, shape).astype(np.int64)
+    return make
+
+
+def boolean(shape, seed=0):
+    def make():
+        return np.random.RandomState(seed).rand(*shape) > 0.5
+    return make
+
+
+def case(name, op, ins, ref, wrt=(0,), attrs=None, static=True,
+         out_rtol=1e-5, out_atol=1e-6, grad_rtol=1e-2, grad_atol=1e-2):
+    return dict(name=name, op=op, ins=ins, ref=ref, wrt=wrt,
+                attrs=attrs or {}, static=static, out_rtol=out_rtol,
+                out_atol=out_atol, grad_rtol=grad_rtol, grad_atol=grad_atol)
+
+
+_sp = lambda x: x * (1.0 / (1.0 + np.exp(-x)))  # silu ref
+
+CASES = [
+    # ---- unary float (output + grad) ----
+    case("relu", F.relu, [sf32((3, 4), 1)], lambda x: np.maximum(x, 0)),
+    case("tanh", paddle.tanh, [sf32((3, 4), 2)], np.tanh),
+    case("sigmoid", paddle.sigmoid, [sf32((3, 4), 3)],
+         lambda x: 1 / (1 + np.exp(-x))),
+    case("exp", paddle.exp, [sf32((3, 4), 4)], np.exp),
+    case("log", paddle.log, [f32((3, 4), 5, 0.2, 2.0)], np.log),
+    case("sqrt", paddle.sqrt, [f32((3, 4), 6, 0.2, 2.0)], np.sqrt),
+    case("rsqrt", paddle.rsqrt, [f32((3, 4), 7, 0.2, 2.0)],
+         lambda x: 1 / np.sqrt(x)),
+    case("abs", paddle.abs, [sf32((3, 4), 8)], np.abs),
+    case("square", paddle.square, [sf32((3, 4), 9)], np.square),
+    case("sin", paddle.sin, [sf32((3, 4), 10)], np.sin),
+    case("cos", paddle.cos, [sf32((3, 4), 11)], np.cos),
+    case("erf", paddle.erf, [sf32((3, 4), 12)],
+         lambda x: np.vectorize(__import__("math").erf)(x).astype(
+             np.float64)),
+    case("log1p", paddle.log1p, [f32((3, 4), 13)], np.log1p),
+    case("expm1", paddle.expm1, [sf32((3, 4), 14, 0.5)], np.expm1),
+    case("reciprocal", paddle.reciprocal, [f32((3, 4), 15, 0.3, 2.0)],
+         lambda x: 1 / x),
+    case("atan", paddle.atan, [sf32((3, 4), 16)], np.arctan),
+    case("sinh", paddle.sinh, [sf32((3, 4), 17, 0.5)], np.sinh),
+    case("cosh", paddle.cosh, [sf32((3, 4), 18, 0.5)], np.cosh),
+    case("silu", F.silu, [sf32((3, 4), 19)], _sp),
+    case("leaky_relu", F.leaky_relu, [sf32((3, 4), 20)],
+         lambda x: np.where(x > 0, x, 0.01 * x)),
+    case("elu", F.elu, [sf32((3, 4), 21)],
+         lambda x: np.where(x > 0, x, np.exp(np.minimum(x, 0)) - 1)),
+    case("softplus", F.softplus, [sf32((3, 4), 22)],
+         lambda x: np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)),
+    case("log_softmax", F.log_softmax, [sf32((3, 4), 23)],
+         lambda x: x - x.max(-1, keepdims=True)
+         - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(
+             -1, keepdims=True))),
+    case("logsumexp", paddle.logsumexp, [sf32((3, 4), 24)],
+         lambda x: np.log(np.exp(x - x.max()).sum()) + x.max()),
+    # ---- binary (grads wrt both) ----
+    case("subtract", paddle.subtract, [sf32((3, 4), 25), sf32((4,), 26)],
+         lambda x, y: x - y, wrt=(0, 1)),
+    case("divide", paddle.divide,
+         [sf32((3, 4), 27), f32((3, 4), 28, 0.5, 2.0)],
+         lambda x, y: x / y, wrt=(0, 1)),
+    case("maximum", paddle.maximum, [sf32((3, 4), 29), sf32((3, 4), 30)],
+         np.maximum, wrt=(0, 1)),
+    case("minimum", paddle.minimum, [sf32((3, 4), 31), sf32((3, 4), 32)],
+         np.minimum, wrt=(0, 1)),
+    case("pow", paddle.pow, [f32((3, 4), 33, 0.3, 1.5)],
+         lambda x: np.power(x, 2.5), attrs={"y": 2.5}),
+    case("mod", paddle.mod, [f32((3, 4), 34, 1.0, 5.0),
+                             f32((3, 4), 35, 1.0, 2.0)],
+         lambda x, y: np.mod(x, y), wrt=()),
+    case("floor_divide", paddle.floor_divide,
+         [f32((3, 4), 36, 1.0, 9.0), f32((3, 4), 37, 1.0, 3.0)],
+         lambda x, y: np.floor_divide(x, y), wrt=()),
+    case("dot", paddle.dot, [sf32((5,), 38), sf32((5,), 39)],
+         lambda x, y: np.dot(x, y), wrt=(0, 1)),
+    case("bmm", paddle.bmm, [sf32((2, 3, 4), 40), sf32((2, 4, 5), 41)],
+         lambda x, y: x @ y, wrt=(0, 1)),
+    case("outer", paddle.outer, [sf32((3,), 42), sf32((4,), 43)],
+         np.outer, wrt=(0, 1)),
+    case("lerp", paddle.lerp,
+         [sf32((3, 4), 44), sf32((3, 4), 45), f32((3, 4), 46)],
+         lambda x, y, w: x + w * (y - x), wrt=(0, 1)),
+    case("cross", paddle.cross, [sf32((4, 3), 47), sf32((4, 3), 48)],
+         lambda x, y: np.cross(x, y), wrt=(0, 1)),
+    case("addmm", paddle.addmm,
+         [sf32((3, 5), 49), sf32((3, 4), 50), sf32((4, 5), 51)],
+         lambda i, x, y: i + x @ y, wrt=(0, 1, 2)),
+    # ---- reductions ----
+    case("reduce_max", paddle.max, [sf32((3, 4), 52)],
+         lambda x: x.max(), wrt=()),
+    case("reduce_min", paddle.min, [sf32((3, 4), 53)],
+         lambda x: x.min(), wrt=()),
+    case("reduce_prod", paddle.prod, [f32((2, 3), 54, 0.5, 1.5)],
+         lambda x: x.prod()),
+    case("var", paddle.var, [sf32((3, 4), 55)],
+         lambda x: x.var(ddof=1)),
+    case("std", paddle.std, [sf32((3, 4), 56)],
+         lambda x: x.std(ddof=1)),
+    case("cumsum", paddle.cumsum, [sf32((3, 4), 57)],
+         lambda x: x.reshape(-1).cumsum(), wrt=(0,)),
+    case("cumprod", paddle.cumprod, [f32((3, 4), 58, 0.5, 1.5)],
+         lambda x: x.cumprod(axis=1), attrs={"dim": 1}),
+    case("amax_axis", paddle.amax, [sf32((3, 4), 59)],
+         lambda x: x.max(axis=1), attrs={"axis": 1}, wrt=()),
+    case("amin_axis", paddle.amin, [sf32((3, 4), 60)],
+         lambda x: x.min(axis=1), attrs={"axis": 1}, wrt=()),
+    # ---- shape / data movement (grad through) ----
+    case("stack", lambda x, y: paddle.stack([x, y]),
+         [sf32((3, 4), 61), sf32((3, 4), 62)],
+         lambda x, y: np.stack([x, y]), wrt=(0, 1)),
+    case("squeeze", paddle.squeeze, [sf32((3, 1, 4), 63)],
+         lambda x: x.squeeze(1), attrs={"axis": 1}),
+    case("unsqueeze", paddle.unsqueeze, [sf32((3, 4), 64)],
+         lambda x: x[:, None, :], attrs={"axis": 1}),
+    case("flatten", paddle.flatten, [sf32((2, 3, 4), 65)],
+         lambda x: x.reshape(-1)),
+    case("expand", paddle.expand, [sf32((1, 4), 66)],
+         lambda x: np.broadcast_to(x, (3, 4)), attrs={"shape": [3, 4]}),
+    case("tile", paddle.tile, [sf32((2, 3), 67)],
+         lambda x: np.tile(x, (2, 2)), attrs={"repeat_times": [2, 2]}),
+    case("flip", paddle.flip, [sf32((3, 4), 68)],
+         lambda x: x[:, ::-1], attrs={"axis": 1}),
+    case("roll", paddle.roll, [sf32((3, 4), 69)],
+         lambda x: np.roll(x.reshape(-1), 2).reshape(3, 4),
+         attrs={"shifts": 2}),
+    case("tril", paddle.tril, [sf32((4, 4), 70)], np.tril),
+    case("triu", paddle.triu, [sf32((4, 4), 71)], np.triu),
+    case("trace", paddle.trace, [sf32((4, 4), 72)], np.trace),
+    case("gather", paddle.gather, [sf32((6, 3), 73), i64((4,), 74, 6)],
+         lambda x, i: x[i], wrt=(0,)),
+    case("index_select", paddle.index_select,
+         [sf32((6, 3), 75), i64((4,), 76, 6)],
+         lambda x, i: x[i], wrt=(0,)),
+    case("where", paddle.where,
+         [boolean((3, 4), 77), sf32((3, 4), 78), sf32((3, 4), 79)],
+         lambda c, x, y: np.where(c, x, y), wrt=(1, 2)),
+    case("clip", paddle.clip, [sf32((3, 4), 80)],
+         lambda x: np.clip(x, -0.5, 0.5),
+         attrs={"min": -0.5, "max": 0.5}),
+    # ---- comparison / logical / discrete (output only) ----
+    case("argmax", paddle.argmax, [sf32((3, 4), 81)],
+         lambda x: x.reshape(-1).argmax(), wrt=()),
+    case("argmin", paddle.argmin, [sf32((3, 4), 82)],
+         lambda x: x.reshape(-1).argmin(), wrt=()),
+    case("equal", paddle.equal, [i64((3, 4), 83), i64((3, 4), 84)],
+         lambda x, y: x == y, wrt=()),
+    case("greater_than", paddle.greater_than,
+         [sf32((3, 4), 85), sf32((3, 4), 86)],
+         lambda x, y: x > y, wrt=()),
+    case("less_than", paddle.less_than,
+         [sf32((3, 4), 87), sf32((3, 4), 88)],
+         lambda x, y: x < y, wrt=()),
+    case("logical_and", paddle.logical_and,
+         [boolean((3, 4), 89), boolean((3, 4), 90)],
+         np.logical_and, wrt=()),
+    case("logical_not", paddle.logical_not, [boolean((3, 4), 91)],
+         np.logical_not, wrt=()),
+    case("sign", paddle.sign, [sf32((3, 4), 92)], np.sign, wrt=()),
+    case("floor", paddle.floor, [sf32((3, 4), 93, 3.0)], np.floor,
+         wrt=()),
+    case("ceil", paddle.ceil, [sf32((3, 4), 94, 3.0)], np.ceil, wrt=()),
+    case("round", paddle.round, [sf32((3, 4), 95, 3.0)], np.round,
+         wrt=()),
+    case("one_hot", paddle.one_hot, [i64((5,), 96, 4)],
+         lambda x: np.eye(4)[x], attrs={"num_classes": 4}, wrt=()),
+    case("cast", paddle.cast, [sf32((3, 4), 97)],
+         lambda x: x.astype(np.float64), attrs={"dtype": "float64"},
+         wrt=()),
+    case("sort", paddle.sort, [sf32((3, 4), 98)],
+         lambda x: np.sort(x, axis=-1), wrt=()),
+]
+
+
+def _make_optest(c):
+    class _T(OpTest):
+        op = staticmethod(c["op"])
+        attrs = c["attrs"]
+        out_rtol = c["out_rtol"]
+        out_atol = c["out_atol"]
+        grad_rtol = c["grad_rtol"]
+        grad_atol = c["grad_atol"]
+
+        def make_inputs(self):
+            return [m() for m in c["ins"]]
+
+        def ref(self, *arrays):
+            return c["ref"](*arrays)
+
+        def check_output_static(self, arrays=None, refs=None):
+            if not c["static"]:
+                return
+            super().check_output_static(arrays, refs)
+
+    _T.__name__ = f"Golden_{c['name']}"
+    return _T()
+
+
+@pytest.mark.parametrize("c", CASES, ids=[c["name"] for c in CASES])
+def test_golden_wide(c):
+    t = _make_optest(c)
+    t.check_output()
+    if c["wrt"]:
+        t.check_grad(wrt=c["wrt"])
+
+
+def test_topk_multi_output():
+    x = np.random.RandomState(99).randn(3, 6).astype(np.float32)
+    vals, idx = paddle.topk(paddle.to_tensor(x), k=2)
+    np.testing.assert_allclose(
+        vals.numpy(), np.sort(x, axis=-1)[:, ::-1][:, :2], rtol=1e-6)
+    ref_idx = np.argsort(-x, axis=-1)[:, :2]
+    np.testing.assert_array_equal(idx.numpy(), ref_idx)
+
+
+def test_split_and_chunk_grads_flow():
+    x = paddle.to_tensor(
+        np.random.RandomState(100).randn(4, 6).astype(np.float32),
+        stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and tuple(parts[0].shape) == (4, 2)
+    loss = paddle.sum(paddle.multiply(parts[0], parts[0]))
+    loss.backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g[:, :2], 2 * x.numpy()[:, :2], rtol=1e-5)
+    np.testing.assert_allclose(g[:, 2:], np.zeros((4, 4)), atol=1e-7)
+
+
+def test_coverage_counts_sixty_ops():
+    """The golden surface (this file + test_ops_golden.py classes) must
+    cover >= 60 distinct ops."""
+    import test_ops_golden as g1
+
+    classic = [n for n in dir(g1) if n.startswith("Test")]
+    assert len(CASES) + len(classic) + 2 >= 60, (
+        len(CASES), len(classic))

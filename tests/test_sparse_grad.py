@@ -1,0 +1,214 @@
+"""Sparse embedding gradients (VERDICT r1 item 8; selected_rows.h parity).
+
+`embedding(..., sparse=True)` produces an IndexedSlices weight gradient on
+the eager tape; optimizers apply a row-wise lazy update.  Includes the
+large-vocab case where a dense gradient would blow the test memory budget.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.indexed_slices import IndexedSlices
+
+
+def _loss(emb, ids):
+    out = emb(paddle.to_tensor(ids))
+    return paddle.mean(out * out)
+
+
+def test_sparse_grad_is_indexed_slices():
+    paddle.seed(0)
+    emb = nn.Embedding(100, 8, sparse=True)
+    ids = np.array([[1, 2], [3, 1]], np.int64)
+    loss = _loss(emb, ids)
+    loss.backward()
+    g = emb.weight.grad
+    assert isinstance(g, IndexedSlices)
+    assert g.dense_shape == (100, 8)
+    assert g.indices.shape[0] == 4  # one row per looked-up id (pre-merge)
+    # matches the dense-path gradient
+    paddle.seed(0)
+    emb_d = nn.Embedding(100, 8, sparse=False)
+    loss_d = _loss(emb_d, ids)
+    loss_d.backward()
+    np.testing.assert_allclose(g.numpy(), emb_d.weight.grad.numpy(),
+                               rtol=1e-6)
+
+
+def test_sparse_duplicate_ids_merge():
+    paddle.seed(0)
+    emb = nn.Embedding(50, 4, sparse=True)
+    ids = np.array([7, 7, 7], np.int64)
+    loss = _loss(emb, ids)
+    loss.backward()
+    uniq, rows = emb.weight.grad.coalesce()
+    assert uniq.shape[0] == 1 and int(uniq[0]) == 7
+    # merged row = sum of the three per-lookup rows
+    np.testing.assert_allclose(
+        np.asarray(rows[0]), np.asarray(emb.weight.grad.numpy()[7]),
+        rtol=1e-6)
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (paddle.optimizer.SGD, {}),
+    (paddle.optimizer.Momentum, {"momentum": 0.9}),
+    (paddle.optimizer.Adam, {}),
+    (paddle.optimizer.AdamW, {"weight_decay": 0.01}),
+])
+def test_sparse_step_matches_dense_on_touched_rows(opt_cls, kw):
+    """Row-wise sparse update == dense update on touched rows; untouched
+    rows must not move (lazy-mode contract)."""
+    ids = np.array([[1, 2], [3, 1]], np.int64)
+
+    paddle.seed(0)
+    emb_s = nn.Embedding(100, 8, sparse=True)
+    w0 = np.asarray(emb_s.weight.numpy()).copy()
+    opt_s = opt_cls(learning_rate=0.1, parameters=emb_s.parameters(), **kw)
+    _loss(emb_s, ids).backward()
+    opt_s.step()
+
+    paddle.seed(0)
+    emb_d = nn.Embedding(100, 8, sparse=False)
+    opt_d = opt_cls(learning_rate=0.1, parameters=emb_d.parameters(), **kw)
+    _loss(emb_d, ids).backward()
+    opt_d.step()
+
+    ws = np.asarray(emb_s.weight.numpy())
+    wd = np.asarray(emb_d.weight.numpy())
+    touched = [1, 2, 3]
+    np.testing.assert_allclose(ws[touched], wd[touched], rtol=2e-5,
+                               atol=1e-6)
+    untouched = [i for i in range(100) if i not in touched]
+    np.testing.assert_allclose(ws[untouched], w0[untouched])  # bitwise
+
+
+def test_sparse_training_converges():
+    paddle.seed(0)
+    emb = nn.Embedding(1000, 16, sparse=True)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=emb.parameters())
+    ids = np.arange(32, dtype=np.int64).reshape(4, 8)
+    l0 = float(_loss(emb, ids).numpy())
+    for _ in range(10):
+        loss = _loss(emb, ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(_loss(emb, ids).numpy()) < 0.5 * l0
+
+
+def test_large_vocab_grad_stays_sparse():
+    """2M x 128 table: the dense grad would be 1 GB per step; the sparse
+    grad holds only the looked-up rows."""
+    paddle.seed(0)
+    emb = nn.Embedding(2_000_000, 128, sparse=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=emb.parameters())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 2_000_000, (8, 16)).astype(np.int64)
+    loss = _loss(emb, ids)
+    loss.backward()
+    g = emb.weight.grad
+    assert isinstance(g, IndexedSlices)
+    assert g.values.shape == (128, 128)  # 8*16 rows, never 2M
+    before = np.asarray(emb.weight.numpy()[ids[0, 0]]).copy()
+    opt.step()
+    after = np.asarray(emb.weight.numpy()[ids[0, 0]])
+    assert not np.allclose(before, after)
+
+
+def test_padding_idx_rows_get_zero_grad():
+    paddle.seed(0)
+    emb = nn.Embedding(50, 4, padding_idx=0, sparse=True)
+    ids = np.array([[0, 3], [0, 5]], np.int64)
+    _loss(emb, ids).backward()
+    dense = emb.weight.grad.numpy()
+    np.testing.assert_allclose(dense[0], np.zeros(4))
+    assert np.abs(dense[[3, 5]]).sum() > 0
+
+
+def test_sparse_under_jit_falls_back_dense():
+    """Compiled steps must keep dense grads: tracing the sparse embedding
+    falls back to the generic vjp (no tracer leaks)."""
+    import jax
+
+    paddle.seed(0)
+    emb = nn.Embedding(64, 8, sparse=True)
+    ids = np.array([[1, 2]], np.int64)
+    w = emb.weight._data
+
+    def f(wv):
+        from paddle_tpu.core.tensor import _wrap_data
+        from paddle_tpu.nn import functional as F
+
+        out = F.embedding(paddle.to_tensor(ids),
+                          _wrap_data(wv), sparse=True)
+        return (out * out)._data.mean()
+
+    gfn = jax.jit(jax.grad(f))
+    g = np.asarray(gfn(w))
+    assert g.shape == (64, 8)
+    assert np.abs(g[[1, 2]]).sum() > 0
+
+
+def test_grad_scaler_unscales_sparse():
+    """AMP GradScaler must unscale IndexedSlices grads, keeping them
+    sparse (review finding: unscale_ dereferenced p.grad._data)."""
+    paddle.seed(0)
+    emb = nn.Embedding(100, 8, sparse=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=emb.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+    ids = np.array([[1, 2]], np.int64)
+    loss = _loss(emb, ids)
+    scaler.scale(loss).backward()
+    assert isinstance(emb.weight.grad, IndexedSlices)
+    w_before = np.asarray(emb.weight.numpy()).copy()
+    scaler.step(opt)
+    # unscaled sparse update matches a plain (no-scaler) run
+    paddle.seed(0)
+    emb2 = nn.Embedding(100, 8, sparse=True)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=emb2.parameters())
+    _loss(emb2, ids).backward()
+    opt2.step()
+    np.testing.assert_allclose(np.asarray(emb.weight.numpy()),
+                               np.asarray(emb2.weight.numpy()), rtol=1e-6)
+    assert not np.allclose(w_before[[1, 2]],
+                           np.asarray(emb.weight.numpy())[[1, 2]])
+
+
+def test_paddle_grad_densifies_sparse():
+    """autograd.grad() returns dense tensors even for sparse embeddings."""
+    from paddle_tpu.core import autograd
+
+    paddle.seed(0)
+    emb = nn.Embedding(64, 4, sparse=True)
+    ids = np.array([[1, 2]], np.int64)
+    out = emb(paddle.to_tensor(ids))
+    loss = paddle.mean(out * out)
+    (g,) = autograd.grad([loss], [emb.weight])
+    arr = g.numpy()
+    assert arr.shape == (64, 4)
+    assert np.abs(arr[[1, 2]]).sum() > 0
+
+
+def test_adamw_decay_param_fun_respected_for_sparse():
+    """apply_decay_param_fun must gate decay in the sparse path too."""
+    ids = np.array([[1, 2]], np.int64)
+
+    def run(decay_fn):
+        paddle.seed(0)
+        emb = nn.Embedding(100, 8, sparse=True)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, weight_decay=0.5,
+            parameters=emb.parameters(),
+            apply_decay_param_fun=decay_fn)
+        _loss(emb, ids).backward()
+        opt.step()
+        return np.asarray(emb.weight.numpy())
+
+    w_decay = run(lambda n: True)
+    w_nodecay = run(lambda n: False)
+    assert not np.allclose(w_decay[[1, 2]], w_nodecay[[1, 2]])
