@@ -24,6 +24,7 @@ from .core.device import (  # noqa: F401
     get_cudnn_version,
 )
 from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .core import errors  # noqa: F401 (enforce.h typed error codes)
 from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
 
 # CUDA rng aliases (reference get/set_cuda_rng_state: the accelerator rng)

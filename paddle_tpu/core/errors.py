@@ -1,0 +1,76 @@
+"""Typed framework errors.
+
+Reference parity: platform/enforce.h PADDLE_ENFORCE* + errors.{h,cc} +
+error_codes.proto — every framework error carries a typed code and an
+op-attributed message.  TPU-native: Python exception classes, one per
+error code, plus an `enforce` helper; the eager dispatcher and executor
+attach the op/var context to the message (the reference's
+AppendErrorOpHint role).
+"""
+
+
+class PaddleError(Exception):
+    """Base: carries the error_codes.proto code name."""
+
+    code = "LEGACY"
+
+    def __init__(self, message, op=None):
+        if op:
+            message = f"{message} [operator < {op} > error]"
+        super().__init__(f"({self.code}) {message}")
+        self.op = op
+
+
+class InvalidArgumentError(PaddleError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(PaddleError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(PaddleError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(PaddleError):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(PaddleError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(PaddleError):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(PaddleError):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(PaddleError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(PaddleError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(PaddleError):
+    code = "UNAVAILABLE"
+
+
+class FatalError(PaddleError):
+    code = "FATAL"
+
+
+class ExternalError(PaddleError):
+    code = "EXTERNAL"
+
+
+def enforce(condition, message, err_cls=InvalidArgumentError, op=None):
+    """PADDLE_ENFORCE parity: raise a typed error when condition fails."""
+    if not condition:
+        raise err_cls(message, op=op)
+    return True

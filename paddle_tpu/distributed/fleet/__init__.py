@@ -248,3 +248,5 @@ from .distributed_strategy import DistributedStrategy  # noqa: F401,E402 (re-exp
 from .launch import launch  # noqa: F401,E402
 from .elastic import ElasticManager  # noqa: F401,E402
 from .utils import recompute  # noqa: F401,E402
+from . import data_generator  # noqa: F401,E402
+from .data_generator import DataGenerator, MultiSlotDataGenerator  # noqa: F401,E402

@@ -1,0 +1,83 @@
+"""fleet data_generator API.
+
+Reference parity: python/paddle/distributed/fleet/data_generator/
+data_generator.py — users subclass DataGenerator, implement
+`generate_sample(line)` yielding [(slot_name, [values]), ...]; the base
+class serializes samples into the MultiSlot text format ("<num> v1..vnum"
+groups, one per slot) consumed by the C++ data feed
+(framework/data_feed.cc; here native/src/data_feed.cc's multislot parser).
+"""
+import sys
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # ---- user hooks ----
+    def generate_sample(self, line):
+        """Return a generator yielding one or more samples for `line`,
+        each a list of (slot_name, list_of_values)."""
+        raise NotImplementedError(
+            "subclasses must implement generate_sample")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook (default: passthrough)."""
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    # ---- serialization (MultiSlot text lines) ----
+    def _gen_str(self, sample):
+        if sample is None:
+            raise ValueError(
+                "generate_sample yielded None; yield a list of "
+                "(slot_name, values) pairs")
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+    def run_from_stdin(self):
+        """Pipe mode: one input line -> MultiSlot lines on stdout (the
+        reference's hadoop-streaming style)."""
+        batch_samples = []
+        for line in sys.stdin:
+            for sample in self.generate_sample(line):
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    for s in self.generate_batch(batch_samples)():
+                        sys.stdout.write(self._gen_str(s))
+                    batch_samples = []
+        if batch_samples:
+            for s in self.generate_batch(batch_samples)():
+                sys.stdout.write(self._gen_str(s))
+
+    def run_from_memory(self, lines=None):
+        """Return the MultiSlot text lines for `lines` (or for a single
+        synthetic record when the generator ignores its input)."""
+        out = []
+        batch_samples = []
+        for line in (lines if lines is not None else [None]):
+            for sample in self.generate_sample(line):
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    out.extend(self._gen_str(s)
+                               for s in self.generate_batch(batch_samples)())
+                    batch_samples = []
+        if batch_samples:
+            out.extend(self._gen_str(s)
+                       for s in self.generate_batch(batch_samples)())
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Name parity with the reference's MultiSlot variant (the base class
+    already serializes MultiSlot)."""

@@ -7,6 +7,9 @@ from ....optimizer.optimizer import Momentum
 
 
 class LarsMomentum(Momentum):
+    # layerwise trust ratio needs whole-parameter norms: sparse densifies
+    _sparse_safe = False
+
     def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, epsilon=0.0, parameters=None, **kw):
         super().__init__(learning_rate, momentum, parameters=parameters, **kw)

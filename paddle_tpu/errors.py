@@ -1,0 +1,8 @@
+"""paddle.errors-style namespace: re-export of the typed error codes
+(core/errors.py; enforce.h + error_codes.proto parity)."""
+from .core.errors import (  # noqa: F401
+    PaddleError, InvalidArgumentError, NotFoundError, OutOfRangeError,
+    AlreadyExistsError, ResourceExhaustedError, PreconditionNotMetError,
+    PermissionDeniedError, ExecutionTimeoutError, UnimplementedError,
+    UnavailableError, FatalError, ExternalError, enforce,
+)

@@ -3,31 +3,35 @@ from .layer import Layer, ParamAttr, create_parameter  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layers.common import (  # noqa: F401
-    Linear, Embedding, Dropout, Dropout2D, AlphaDropout, Flatten, Identity,
-    Pad1D, Pad2D, Upsample, PixelShuffle, CosineSimilarity, Bilinear,
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Identity, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample,
+    UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
+    CosineSimilarity, Bilinear, Unfold, Fold,
 )
-from .layers.conv import Conv1D, Conv2D, Conv2DTranspose  # noqa: F401
+from .layers.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
 from .layers.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, GroupNorm,
     LocalResponseNorm, SpectralNorm,
 )
 from .layers.pooling import (  # noqa: F401
-    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool2D,
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool2D,
 )
 from .layers.activation import (  # noqa: F401
     ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Silu, Swish, Mish, Softsign,
     Tanhshrink, Hardsigmoid, Hardswish, Softplus, Selu, GELU, LeakyReLU, ELU,
     PReLU, Hardshrink, Softshrink, Hardtanh, ThresholdedReLU, Softmax,
-    LogSoftmax, Maxout,
+    LogSoftmax, Maxout, SELU, CELU, GLU,
 )
 from .layers.container import (  # noqa: F401
     Sequential, LayerList, LayerDict, ParameterList,
 )
 from .layers.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, BCELoss,
-    BCEWithLogitsLoss, NLLLoss, KLDivLoss, MarginRankingLoss,
+    BCEWithLogitsLoss, NLLLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
+    PairwiseDistance,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -38,6 +42,19 @@ from .layers.rnn import (  # noqa: F401
     RNNCellBase,
 )
 from ..core.autograd import no_grad  # noqa: F401
+
+
+def _densify_sparse_grads(params_grads):
+    """IndexedSlices grads densify before clipping (the reference merges
+    SelectedRows the same way in GradientClipBy*)."""
+    from ..core.indexed_slices import IndexedSlices
+    from ..core.tensor import _wrap_data
+
+    return [
+        (p, _wrap_data(g.to_dense(), stop_gradient=True)
+         if isinstance(g, IndexedSlices) else g)
+        for p, g in params_grads
+    ]
 
 
 class ClipGradByGlobalNorm:
@@ -51,6 +68,7 @@ class ClipGradByGlobalNorm:
 
         from ..core.tensor import _wrap_data
 
+        params_grads = _densify_sparse_grads(params_grads)
         grads = [g for _, g in params_grads if g is not None]
         if not grads:
             return params_grads
@@ -76,6 +94,7 @@ class ClipGradByNorm:
 
         from ..core.tensor import _wrap_data
 
+        params_grads = _densify_sparse_grads(params_grads)
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
@@ -97,6 +116,7 @@ class ClipGradByValue:
 
         from ..core.tensor import _wrap_data
 
+        params_grads = _densify_sparse_grads(params_grads)
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):

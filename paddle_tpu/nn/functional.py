@@ -19,6 +19,13 @@ from ..ops.loss import (  # noqa: F401
     kl_div, hinge_loss, margin_ranking_loss, cosine_similarity,
     square_error_cost, sigmoid_focal_loss,
 )
+from ..ops.nn_extra import (  # noqa: F401
+    conv3d, conv3d_transpose, conv1d_transpose, max_pool3d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool3d, adaptive_max_pool1d,
+    adaptive_max_pool3d, dropout3d, celu, fold, ctc_loss,
+    pairwise_distance, affine_grid, grid_sample, temporal_shift,
+    gather_tree, hsigmoid_loss, dice_loss, log_loss, npair_loss,
+)
 from ..ops.math import tanh  # noqa: F401
 from ..ops.manipulation import pad, one_hot  # noqa: F401
 

@@ -187,3 +187,87 @@ class Bilinear(Layer):
             return out + bias
 
         return apply_op("bilinear", fn, (x1, x2, self.weight, self.bias), {})
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 4
+        self.padding, self.df = padding, data_format
+
+    def forward(self, x):
+        return MAN.pad(x, self.padding, mode="constant", value=0.0,
+                       data_format=self.df)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 6
+        self.padding, self.mode, self.value = padding, mode, value
+        self.df = data_format
+
+    def forward(self, x):
+        return MAN.pad(x, self.padding, mode=self.mode, value=self.value,
+                       data_format=self.df)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode="nearest")
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.k, self.s, self.p, self.d = (kernel_sizes, strides, paddings,
+                                          dilations)
+
+    def forward(self, x):
+        return F.unfold(x, self.k, strides=self.s, paddings=self.p,
+                        dilations=self.d)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.o, self.k, self.s, self.p, self.d = (
+            output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, self.o, self.k, strides=self.s, paddings=self.p,
+                      dilations=self.d)

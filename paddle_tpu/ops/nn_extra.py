@@ -100,13 +100,24 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     return squeeze(out, [3])
 
 
-def _pool3d(x, kind, kernel_size, stride, padding, exclusive=True):
+def _pool3d(x, kind, kernel_size, stride, padding, exclusive=True,
+            ceil_mode=False, divisor_override=None):
     k = _triple(kernel_size)
     s = _triple(stride) if stride is not None else k
     p = _triple(padding)
     window = (1, 1) + k
     strides = (1, 1) + s
-    pads = [(0, 0), (0, 0)] + [(pp, pp) for pp in p]
+    spatial = tuple(int(d) for d in x.shape[2:])
+    # ceil_mode: pad the high side so the last partial window is kept
+    # (out = ceil((L + 2p - k)/s) + 1); reduce_window pads with the init
+    # value, which the exclusive count window correctly ignores
+    extra = [0, 0, 0]
+    if ceil_mode:
+        for i, (L, ki, si, pi) in enumerate(zip(spatial, k, s, p)):
+            out_ceil = -(-(L + 2 * pi - ki) // si) + 1
+            extra[i] = max((out_ceil - 1) * si + ki - (L + 2 * pi), 0)
+    pads = [(0, 0), (0, 0)] + [
+        (pp, pp + e) for pp, e in zip(p, extra)]
 
     if kind == "max":
         def fn(v):
@@ -119,7 +130,9 @@ def _pool3d(x, kind, kernel_size, stride, padding, exclusive=True):
     def fn(v):
         ssum = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
                                      pads)
-        if exclusive and any(pp != (0, 0) for pp in pads):
+        if divisor_override is not None:
+            return ssum / float(divisor_override)
+        if exclusive and any(pp != (0, 0) for pp in pads[2:]):
             cnt = jax.lax.reduce_window(jnp.ones_like(v), 0.0, jax.lax.add,
                                         window, strides, pads)
             return ssum / cnt
@@ -128,16 +141,51 @@ def _pool3d(x, kind, kernel_size, stride, padding, exclusive=True):
     return apply_op("pool3d_avg", fn, (x,), {})
 
 
+def _max_pool3d_index(x, k, s, p, ceil_mode):
+    """Flattened-spatial argmax indices per window (pool_with_index
+    kernels' mask output)."""
+    k3, s3, p3 = _triple(k), _triple(s), _triple(p)
+
+    def fn(v):
+        N, C, D, H, W = v.shape
+        idx_map = jnp.broadcast_to(
+            jnp.arange(D * H * W, dtype=jnp.float32).reshape(1, 1, D, H, W),
+            v.shape)
+        pads = [(pp, pp) for pp in p3]
+        patches = jax.lax.conv_general_dilated_patches(
+            v, k3, s3, pads,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        ipatches = jax.lax.conv_general_dilated_patches(
+            idx_map, k3, s3, pads,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        KK = int(np.prod(k3))
+        od, oh, ow = patches.shape[2:]
+        pv = patches.reshape(N, C, KK, od, oh, ow)
+        iv = ipatches.reshape(N, C, KK, od, oh, ow)
+        arg = jnp.argmax(pv, axis=2, keepdims=True)
+        return jnp.take_along_axis(iv, arg, axis=2)[:, :, 0].astype(
+            jnp.int32)
+
+    return apply_op("max_pool3d_index", fn, (x,), {})
+
+
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    out = _pool3d(x, "max", kernel_size, stride, padding)
-    return (out, None) if return_mask else out
+    out = _pool3d(x, "max", kernel_size, stride, padding,
+                  ceil_mode=ceil_mode)
+    if return_mask:
+        mask = _max_pool3d_index(x, kernel_size,
+                                 stride if stride is not None
+                                 else kernel_size, padding, ceil_mode)
+        return out, mask
+    return out
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
-    return _pool3d(x, "avg", kernel_size, stride, padding, exclusive)
+    return _pool3d(x, "avg", kernel_size, stride, padding, exclusive,
+                   ceil_mode=ceil_mode, divisor_override=divisor_override)
 
 
 def _adaptive_nd(x, kind, out_sizes, spatial_offset=2):
@@ -147,7 +195,9 @@ def _adaptive_nd(x, kind, out_sizes, spatial_offset=2):
         outs = _ntuple(out_sizes, len(spatial))
 
         def bounds(n, o):
-            return [(i * n) // o for i in range(o)] + [n]
+            # paddle adaptive windows: start=floor(i*n/o), end=ceil((i+1)*n/o)
+            # — adjacent windows may OVERLAP for non-divisible sizes
+            return [((i * n) // o, -(-((i + 1) * n) // o)) for i in range(o)]
 
         bss = [bounds(n, o) for n, o in zip(spatial, outs)]
 
@@ -155,15 +205,18 @@ def _adaptive_nd(x, kind, out_sizes, spatial_offset=2):
         def build(dim, index):
             if dim == len(outs):
                 sl = (slice(None), slice(None)) + tuple(
-                    slice(bss[d][i], bss[d][i + 1])
+                    slice(*bss[d][i])
                     for d, i in enumerate(index))
                 win = v[sl]
                 axes = tuple(range(spatial_offset,
                                    spatial_offset + len(outs)))
                 return (jnp.max(win, axis=axes) if kind == "max"
                         else jnp.mean(win, axis=axes))
+            # children carry shape [N, C, outs[dim+1], ...]; stacking at
+            # axis=2 at EVERY level yields [N, C, outs[dim], ...] (a fixed
+            # 2+dim axis runs out of bounds beyond 1 spatial dim)
             return jnp.stack([build(dim + 1, index + (i,))
-                              for i in range(outs[dim])], axis=2 + dim)
+                              for i in range(outs[dim])], axis=2)
 
         return build(0, ())
 
@@ -328,16 +381,17 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """Ref: warpctc_op.cc.  Forward-algorithm CTC in log space via
     lax.scan over time — runs entirely on device (no warpctc dlopen)."""
-    lp = log_probs._data if isinstance(log_probs, Tensor) else jnp.asarray(log_probs)
+    lp_in = log_probs if isinstance(log_probs, Tensor) else \
+        to_tensor(log_probs)
     lab = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
     ilen = (input_lengths._data if isinstance(input_lengths, Tensor)
             else jnp.asarray(input_lengths)).astype(jnp.int32)
     llen = (label_lengths._data if isinstance(label_lengths, Tensor)
             else jnp.asarray(label_lengths)).astype(jnp.int32)
-    if lp.ndim == 3 and lp.shape[0] != lab.shape[0]:
-        lp = jnp.transpose(lp, (1, 0, 2))  # [T,B,C] -> [B,T,C]
-    lp = jax.nn.log_softmax(lp, axis=-1)
-    B, T, C = lp.shape
+    lp_shape = tuple(lp_in.shape)
+    need_t = len(lp_shape) == 3 and lp_shape[0] != lab.shape[0]
+    B, T, C = ((lp_shape[1], lp_shape[0], lp_shape[2]) if need_t
+               else lp_shape)
     S = lab.shape[1]
     L = 2 * S + 1
     NEG = -1e30
@@ -366,15 +420,26 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         ll = jnp.logaddexp(alphaT[end], alphaT[jnp.maximum(end - 1, 0)])
         return -ll
 
-    def fn(lp_all):
+    def fn(lp_raw):
+        # transform INSIDE the op fn so the tape differentiates back to
+        # the caller's logits (wrapping a detached to_tensor(lp) here
+        # silently severed the gradient)
+        if need_t:
+            lp_raw = jnp.transpose(lp_raw, (1, 0, 2))
+        lp_all = jax.nn.log_softmax(lp_raw, axis=-1)
         losses = jax.vmap(fwd_fn)(lp_all, ext, same_as_prevprev, ilen, llen)
+        if norm_by_times:
+            # warpctc norm_by_times: scale each sequence by 1/T (the
+            # reference normalizes the gradient by the timestep count;
+            # scaling the loss is the value-level equivalent)
+            losses = losses / jnp.maximum(ilen.astype(losses.dtype), 1)
         if reduction == "mean":
             return jnp.mean(losses / jnp.maximum(llen, 1))
         if reduction == "sum":
             return jnp.sum(losses)
         return losses
 
-    return apply_op("ctc_loss", fn, (to_tensor(lp),), {})
+    return apply_op("ctc_loss", fn, (lp_in,), {})
 
 
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
@@ -488,3 +553,44 @@ def spectral_norm_apply(weight, n_power_iterations=1, eps=1e-12, dim=0):
         return w / sigma
 
     return apply_op("spectral_norm", fn, (weight,), {})
+
+
+def celu(x, alpha=1.0, name=None):
+    """Ref: activation_op.cc celu."""
+    def fn(v):
+        return jnp.maximum(v, 0.0) + jnp.minimum(
+            0.0, alpha * (jnp.exp(v / alpha) - 1.0))
+
+    return apply_op("celu", fn, (x,), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (fold): [N, C*kh*kw, L] -> [N, C, H, W] by summing
+    overlapping patches — the exact adjoint of unfold (math/im2col.cc)."""
+    oh_img, ow_img = _ntuple(output_sizes, 2)
+    kh, kw = _ntuple(kernel_sizes, 2)
+    sh, sw = _ntuple(strides, 2)
+    ph, pw = _ntuple(paddings, 2)
+    dh, dw = _ntuple(dilations, 2)
+
+    def fn(v):
+        N, CKK, L = v.shape
+        C = CKK // (kh * kw)
+        OH = (oh_img + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        OW = (ow_img + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        cols = v.reshape(N, C, kh, kw, OH, OW)
+        out = jnp.zeros((N, C, oh_img + 2 * ph, ow_img + 2 * pw), v.dtype)
+        # static small loops over kernel positions: each scatters a strided
+        # block-add; XLA fuses them (col2im adjoint)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi: hi + sh * OH: sh,
+                             wj: wj + sw * OW: sw].add(cols[:, :, i, j])
+        if ph or pw:
+            out = out[:, :, ph: ph + oh_img, pw: pw + ow_img]
+        return out
+
+    return apply_op("fold", fn, (x,), {})

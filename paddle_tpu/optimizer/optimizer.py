@@ -68,12 +68,23 @@ class Optimizer:
     def update(self, param, grad, state, lr):
         raise NotImplementedError
 
+    # Optimizers whose update rule needs WHOLE-parameter statistics
+    # (e.g. Lamb/Lars trust ratios over ||w||, ||update||) must not see a
+    # row subset — they fall back to a dense update in _sparse_step.
+    _sparse_safe = True
+
     # ---- sparse (SelectedRows) fast path ----
     def _sparse_step(self, p, slices, plr):
         """Row-wise update for an IndexedSlices grad (selected_rows.h /
         lazy-mode sparse optimizer parity): only the touched rows of the
         param and its param-shaped state update; scalar state (e.g. Adam's
         beta pows) advances once per step as usual."""
+        if not self._sparse_safe:
+            from ..core.tensor import _wrap_data
+
+            dense = _wrap_data(slices.to_dense(), stop_gradient=True)
+            self._dense_param_step(p, dense, plr)
+            return
         ids, rows = slices.coalesce()
         state = self._state_for(p)
         row_state = {
@@ -137,24 +148,30 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         for p, g in params_grads:
-            gv = g._data.astype(p._data.dtype) if g._data.dtype != p._data.dtype else g._data
-            plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
-            wd = self._weight_decay_coeff()
-            reg = p.__dict__.get("regularizer")
-            if reg is not None and hasattr(reg, "_coeff"):
-                wd = float(reg._coeff)
-            decay_fn = getattr(self, "_apply_decay_param_fun", None)
-            if decay_fn is not None and p.name and not decay_fn(p.name):
-                wd = 0.0
-            if wd and self._decoupled_weight_decay is False:
-                gv = gv + wd * p._data
-            state = self._state_for(p)
-            self._current_param_name = p.name
-            new_p, new_state = self.update(p._data, gv, state, plr)
-            if wd and self._decoupled_weight_decay:
-                new_p = new_p - plr * wd * p._data
-            p._data = new_p
-            self._states[id(p)] = new_state
+            plr = lr * p.__dict__.get("optimize_attr", {}).get(
+                "learning_rate", 1.0)
+            self._dense_param_step(p, g, plr)
+
+    def _dense_param_step(self, p, g, plr):
+        """One parameter's dense update (the body of step()'s loop)."""
+        gv = g._data.astype(p._data.dtype) \
+            if g._data.dtype != p._data.dtype else g._data
+        wd = self._weight_decay_coeff()
+        reg = p.__dict__.get("regularizer")
+        if reg is not None and hasattr(reg, "_coeff"):
+            wd = float(reg._coeff)
+        decay_fn = getattr(self, "_apply_decay_param_fun", None)
+        if decay_fn is not None and p.name and not decay_fn(p.name):
+            wd = 0.0
+        if wd and self._decoupled_weight_decay is False:
+            gv = gv + wd * p._data
+        state = self._state_for(p)
+        self._current_param_name = p.name
+        new_p, new_state = self.update(p._data, gv, state, plr)
+        if wd and self._decoupled_weight_decay:
+            new_p = new_p - plr * wd * p._data
+        p._data = new_p
+        self._states[id(p)] = new_state
 
     _decoupled_weight_decay = False
 
@@ -440,6 +457,9 @@ class Adamax(Optimizer):
 
 class Lamb(Optimizer):
     """Ref: operators/optimizers/lamb_op.h — layerwise adaptive Adam."""
+
+    # trust ratio needs whole-parameter norms: sparse grads densify
+    _sparse_safe = False
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,

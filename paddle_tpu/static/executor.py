@@ -168,12 +168,25 @@ class CompiledBlock:
     def run(self, feed, scope):
         feeds = {}
         for n in self.feed_names:
+            if n not in feed:
+                from ..core.errors import NotFoundError
+
+                raise NotFoundError(
+                    f"feed variable {n!r} missing from feed dict "
+                    f"(declared feeds: {self.feed_names})")
             v = feed[n]
             if isinstance(v, Tensor):
                 v = v._data
             feeds[n] = jnp.asarray(np.asarray(v))
         params = {n: scope.get(n) for n in self.param_names}
-        outs, updated, nonfinite = self._jitted(feeds, params)
+        try:
+            outs, updated, nonfinite = self._jitted(feeds, params)
+        except KeyError as e:
+            from ..core.errors import NotFoundError
+
+            raise NotFoundError(
+                f"variable {e.args[0]!r} is needed by the fetch targets "
+                "but was neither fed nor produced by any op") from e
         if self._check_nan:
             mask = np.asarray(nonfinite)
             if mask.any():

@@ -142,7 +142,10 @@ class Block:
     def var(self, name):
         v = self.vars.get(name)
         if v is None:
-            raise KeyError(f"Variable {name} not found in block {self.idx}")
+            from ..core.errors import NotFoundError
+
+            raise NotFoundError(
+                f"Variable {name} not found in block {self.idx}")
         return v
 
     def has_var(self, name):

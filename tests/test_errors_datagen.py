@@ -1,0 +1,99 @@
+"""Typed error codes (enforce.h parity) + fleet data_generator API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def test_typed_error_codes():
+    from paddle_tpu.errors import (
+        InvalidArgumentError, NotFoundError, PaddleError, enforce,
+    )
+
+    with pytest.raises(InvalidArgumentError, match="INVALID_ARGUMENT"):
+        enforce(False, "bad shape")
+    err = NotFoundError("no such var", op="matmul")
+    assert "NOT_FOUND" in str(err) and "matmul" in str(err)
+    assert isinstance(err, PaddleError)
+
+
+def test_block_var_not_found_is_typed():
+    from paddle_tpu.errors import NotFoundError
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with pytest.raises(NotFoundError, match="nope"):
+            main.global_block().var("nope")
+    finally:
+        paddle.disable_static()
+
+
+def test_executor_missing_feed_is_typed():
+    from paddle_tpu.errors import NotFoundError
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3])
+            y = static.nn.relu(x)
+        exe = static.Executor()
+        exe.run(startup)
+        with pytest.raises(NotFoundError, match="'x'"):
+            exe.run(main, feed={}, fetch_list=[y])
+    finally:
+        paddle.disable_static()
+
+
+def test_data_generator_multislot_lines(tmp_path):
+    """DataGenerator emits MultiSlot lines the native feed parses back."""
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def reader():
+                a, b = line
+                yield [("feat", [a, a + 1.0]), ("label", [b])]
+
+            return reader()
+
+    gen = Gen()
+    gen.set_batch(2)
+    lines = gen.run_from_memory([(1.0, 0.0), (3.0, 1.0), (5.0, 0.0)])
+    assert len(lines) == 3
+    assert lines[0].split() == ["2", "1.0", "2.0", "1", "0.0"]
+
+    # round-trip through the native multislot feed
+    from paddle_tpu.native import available
+
+    if available():
+        p = tmp_path / "part-0"
+        p.write_text("".join(lines))
+        from paddle_tpu.io.file_feed import FileDataFeed
+
+        feed = FileDataFeed([str(p)], batch_size=3, fmt="multislot",
+                            num_threads=1)
+        feats, labels = next(iter(feed))
+        assert tuple(feats.shape)[0] == 3
+
+
+def test_data_generator_stdin_pipe(tmp_path, monkeypatch, capsys):
+    import io as _io
+    import sys
+
+    from paddle_tpu.distributed.fleet import DataGenerator
+
+    class Gen(DataGenerator):
+        def generate_sample(self, line):
+            def reader():
+                vals = [float(v) for v in line.split()]
+                yield [("feat", vals)]
+
+            return reader()
+
+    monkeypatch.setattr(sys, "stdin", _io.StringIO("1 2\n3 4\n"))
+    Gen().run_from_stdin()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == ["2 1.0 2.0", "2 3.0 4.0"]

@@ -1,0 +1,231 @@
+"""New nn-zoo breadth: 3-D conv/pool family, CTC, fold/unfold, pads,
+upsampling, long-tail activations (closing the SURVEY §2.2 nn-layer gap).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a, stop_gradient=True):
+    return paddle.to_tensor(np.asarray(a, np.float32),
+                            stop_gradient=stop_gradient)
+
+
+def test_conv3d_matches_manual():
+    paddle.seed(0)
+    conv = nn.Conv3D(2, 3, kernel_size=2)
+    x = _t(np.random.RandomState(0).randn(1, 2, 4, 4, 4), False)
+    y = conv(x)
+    assert tuple(y.shape) == (1, 3, 3, 3, 3)
+    # grads flow to weight and input
+    paddle.sum(y).backward()
+    assert conv.weight.grad is not None and x.grad is not None
+
+
+def test_pool3d():
+    x = _t(np.arange(2 * 8, dtype=np.float32).reshape(1, 1, 2, 2, 4))
+    mx = nn.MaxPool3D(2)(x)
+    av = nn.AvgPool3D(2)(x)
+    assert tuple(mx.shape) == (1, 1, 1, 1, 2)
+    v = np.arange(16).reshape(2, 2, 4)
+    np.testing.assert_allclose(
+        mx.numpy()[0, 0, 0, 0],
+        [v[:, :, :2].max(), v[:, :, 2:].max()])
+    np.testing.assert_allclose(
+        av.numpy()[0, 0, 0, 0],
+        [v[:, :, :2].mean(), v[:, :, 2:].mean()])
+
+
+def test_adaptive_pools_1d_3d():
+    x1 = _t(np.arange(12, dtype=np.float32).reshape(1, 1, 12))
+    y1 = nn.AdaptiveAvgPool1D(3)(x1)
+    np.testing.assert_allclose(
+        y1.numpy()[0, 0], np.arange(12).reshape(3, 4).mean(1))
+    # non-divisible case
+    x2 = _t(np.arange(10, dtype=np.float32).reshape(1, 1, 10))
+    y2 = nn.AdaptiveAvgPool1D(4)(x2)
+    assert tuple(y2.shape) == (1, 1, 4)
+    x3 = _t(np.random.RandomState(0).rand(1, 2, 4, 4, 4))
+    y3 = nn.AdaptiveAvgPool3D(2)(x3)
+    assert tuple(y3.shape) == (1, 2, 2, 2, 2)
+    np.testing.assert_allclose(
+        float(y3.numpy()[0, 0, 0, 0, 0]),
+        x3.numpy()[0, 0, :2, :2, :2].mean(), rtol=1e-6)
+
+
+def test_activations_selu_celu_glu():
+    x = _t([[-1.0, 0.5, 2.0, -0.2]])
+    s = nn.SELU()(x).numpy()
+    assert s[0, 1] > 0 and s[0, 0] < 0
+    c = nn.CELU(alpha=1.0)(x).numpy()
+    np.testing.assert_allclose(
+        c[0], np.where(x.numpy()[0] > 0, x.numpy()[0],
+                       np.exp(x.numpy()[0]) - 1), rtol=1e-5)
+    g = nn.GLU()(x)
+    assert tuple(g.shape) == (1, 2)
+    xv = x.numpy()[0]
+    np.testing.assert_allclose(
+        g.numpy()[0], xv[:2] * (1 / (1 + np.exp(-xv[2:]))), rtol=1e-5)
+
+
+def test_pads_and_upsampling():
+    x = _t(np.ones((1, 1, 2, 2)))
+    z = nn.ZeroPad2D(1)(x)
+    assert tuple(z.shape) == (1, 1, 4, 4)
+    assert z.numpy()[0, 0, 0, 0] == 0 and z.numpy()[0, 0, 1, 1] == 1
+    x3 = _t(np.ones((1, 1, 2, 2, 2)))
+    p3 = nn.Pad3D(1)(x3)
+    assert tuple(p3.shape) == (1, 1, 4, 4, 4)
+    up_n = nn.UpsamplingNearest2D(scale_factor=2)(x)
+    assert tuple(up_n.shape) == (1, 1, 4, 4)
+    up_b = nn.UpsamplingBilinear2D(size=[4, 4])(x)
+    assert tuple(up_b.shape) == (1, 1, 4, 4)
+    np.testing.assert_allclose(up_b.numpy(), np.ones((1, 1, 4, 4)),
+                               rtol=1e-6)
+
+
+def test_dropout3d_channel_granularity():
+    paddle.seed(0)
+    layer = nn.Dropout3D(p=0.5)
+    layer.train()
+    x = _t(np.ones((2, 8, 2, 2, 2)))
+    y = layer(x).numpy()
+    # whole channels drop together
+    for n in range(2):
+        for c in range(8):
+            ch = y[n, c]
+            assert (ch == 0).all() or (ch != 0).all()
+
+
+def test_unfold_fold_roundtrip():
+    """fold(unfold(x)) == x * overlap_count (the adjoint contract)."""
+    x = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
+    cols = F.unfold(_t(x), 2, strides=2)
+    assert tuple(cols.shape) == (1, 2 * 4, 4)
+    back = F.fold(cols, 4, 2, strides=2)  # non-overlapping: exact inverse
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    # overlapping windows: each pixel scaled by its window count
+    cols2 = F.unfold(_t(x), 3, strides=1, paddings=1)
+    back2 = F.fold(cols2, 4, 3, strides=1, paddings=1)
+    ones = F.fold(F.unfold(_t(np.ones_like(x)), 3, strides=1, paddings=1),
+                  4, 3, strides=1, paddings=1)
+    np.testing.assert_allclose(back2.numpy(), x * ones.numpy(), rtol=1e-5)
+
+
+def test_ctc_loss_learns_alignment():
+    """CTC trains a tiny classifier to emit the target label sequence."""
+    paddle.seed(0)
+    T, N, C, S = 8, 2, 5, 3
+    rng = np.random.RandomState(0)
+    feats = paddle.to_tensor(rng.randn(T, N, 4).astype(np.float32))
+    labels = paddle.to_tensor(
+        rng.randint(1, C, (N, S)).astype(np.int32))
+    in_len = paddle.to_tensor(np.full(N, T, np.int32))
+    lab_len = paddle.to_tensor(np.full(N, S, np.int32))
+    proj = nn.Linear(4, C)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=proj.parameters())
+    crit = nn.CTCLoss(blank=0)
+
+    def loss_fn():
+        return crit(proj(feats), labels, in_len, lab_len)
+
+    l0 = float(loss_fn().numpy())
+    for _ in range(20):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    l1 = float(loss_fn().numpy())
+    assert np.isfinite(l0) and l1 < 0.5 * l0
+
+
+def test_pairwise_distance():
+    x = _t([[1.0, 0.0], [0.0, 0.0]])
+    y = _t([[0.0, 0.0], [3.0, 4.0]])
+    d = nn.PairwiseDistance()(x, y).numpy()
+    np.testing.assert_allclose(d, [1.0, 5.0], rtol=1e-4)
+
+
+# ---- review-findings regressions ----
+
+def test_avg_pool3d_exclusive_padding():
+    """Padded border windows divide by the REAL element count
+    (exclusive=True default), not the kernel volume."""
+    x = _t(np.ones((1, 1, 2, 2, 2)))
+    y = F.avg_pool3d(x, kernel_size=2, stride=2, padding=1)
+    np.testing.assert_allclose(y.numpy(), np.ones_like(y.numpy()))
+    # divisor_override wins when given
+    y2 = F.avg_pool3d(x, kernel_size=2, stride=2, padding=1,
+                      divisor_override=8)
+    np.testing.assert_allclose(y2.numpy(),
+                               np.full_like(y2.numpy(), 1.0 / 8))
+
+
+def test_pool3d_ceil_mode_shapes():
+    x = _t(np.random.RandomState(0).rand(1, 1, 5, 5, 5))
+    floor = F.max_pool3d(x, 2, stride=2)
+    ceil = F.max_pool3d(x, 2, stride=2, ceil_mode=True)
+    assert tuple(floor.shape)[2:] == (2, 2, 2)
+    assert tuple(ceil.shape)[2:] == (3, 3, 3)
+    # last ceil window = max of the single trailing element slab
+    np.testing.assert_allclose(
+        ceil.numpy()[0, 0, 2, 2, 2], x.numpy()[0, 0, 4, 4, 4])
+
+
+def test_adaptive_pool_overlapping_windows():
+    """paddle windows: start=floor(i*L/o), end=ceil((i+1)*L/o) — they
+    OVERLAP for non-divisible sizes."""
+    x = _t(np.arange(5, dtype=np.float32).reshape(1, 1, 5))
+    y = F.adaptive_avg_pool1d(x, 3)
+    np.testing.assert_allclose(y.numpy()[0, 0], [0.5, 2.0, 3.5])
+
+
+def test_max_pool3d_return_mask_indices():
+    v = np.zeros((1, 1, 2, 2, 2), np.float32)
+    v[0, 0, 1, 0, 1] = 9.0  # flat spatial index 1*4 + 0*2 + 1 = 5
+    out, mask = F.max_pool3d(_t(v), 2, return_mask=True)
+    assert float(out.numpy()) == 9.0
+    assert int(mask.numpy()) == 5
+
+
+def test_clip_global_norm_handles_sparse():
+    from paddle_tpu import nn as _nn
+
+    paddle.seed(0)
+    emb = _nn.Embedding(100, 8, sparse=True)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=emb.parameters(),
+        grad_clip=_nn.ClipGradByGlobalNorm(0.01))
+    ids = np.array([[1, 2]], np.int64)
+    out = emb(paddle.to_tensor(ids))
+    loss = paddle.mean(out * out)
+    loss.backward()
+    w0 = np.asarray(emb.weight.numpy()).copy()
+    opt.step()  # must not crash; clipped update is tiny but nonzero
+    delta = np.asarray(emb.weight.numpy()) - w0
+    l2 = float(np.sqrt((delta ** 2).sum()))
+    assert 0 < l2 <= 0.1 * 0.01 * 1.05  # lr * clip_norm (+5% slack)
+
+
+def test_lamb_sparse_falls_back_dense():
+    """Lamb's trust ratio needs whole-param norms: sparse grads densify
+    and match a dense-embedding Lamb run exactly."""
+    from paddle_tpu import nn as _nn
+
+    ids = np.array([[1, 2], [3, 1]], np.int64)
+
+    def run(sparse):
+        paddle.seed(0)
+        emb = _nn.Embedding(50, 8, sparse=sparse)
+        opt = paddle.optimizer.Lamb(learning_rate=0.1,
+                                    parameters=emb.parameters())
+        out = emb(paddle.to_tensor(ids))
+        paddle.mean(out * out).backward()
+        opt.step()
+        return np.asarray(emb.weight.numpy())
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
