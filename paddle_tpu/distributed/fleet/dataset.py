@@ -1,0 +1,106 @@
+"""fleet dataset facade: InMemoryDataset / QueueDataset.
+
+Reference parity: fleet/dataset/dataset.py over the C++ Dataset/DataFeed
+(framework/data_set.cc, data_feed.cc).  TPU-native: the native multislot
+feed (native/src/data_feed.cc) does threaded parsing; InMemoryDataset
+buffers + shuffles host-side, QueueDataset streams.
+"""
+import random
+
+import numpy as np
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._use_vars = []
+        self._fmt = "multislot"
+        self._label_col = -1
+
+    # ---- reference config surface ----
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        """Program vars the feed's columns map to, in feed order
+        (features, then label for the csv/multislot formats)."""
+        self._use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):  # accepted for API parity
+        self._pipe_command = cmd
+
+    def set_format(self, fmt, label_col=-1):
+        self._fmt = fmt
+        self._label_col = label_col
+
+    # ---- iteration ----
+    def _raw_batches(self):
+        from ...io.file_feed import FileDataFeed
+
+        feed = FileDataFeed(self._filelist, self._batch_size,
+                            fmt=self._fmt, num_threads=self._thread_num,
+                            label_col=self._label_col)
+        for batch in feed:
+            yield batch
+
+    def _iter_batches(self):
+        return self._raw_batches()
+
+
+class QueueDataset(DatasetBase):
+    """Streaming mode: batches flow straight from the reader threads."""
+
+
+class InMemoryDataset(DatasetBase):
+    """Buffered mode with local_shuffle (data_set.cc InMemoryDataset)."""
+
+    def __init__(self):
+        super().__init__()
+        self._buffer = None
+        self._shuffled = False
+
+    def load_into_memory(self):
+        self._buffer = list(self._raw_batches())
+
+    def local_shuffle(self, seed=0):
+        if self._buffer is None:
+            self.load_into_memory()
+        rng = random.Random(seed)
+        # shuffle SAMPLES across the buffered batches, then re-batch
+        feats = np.concatenate([np.asarray(f.numpy()) for f, _ in
+                                self._buffer])
+        labels = np.concatenate([np.asarray(l.numpy()) for _, l in
+                                 self._buffer])
+        order = list(range(len(feats)))
+        rng.shuffle(order)
+        feats, labels = feats[order], labels[order]
+        from ...core.tensor import to_tensor
+
+        b = self._batch_size
+        # keep the tail partial batch: the native feed flushes partial
+        # batches too, and silently dropping samples skews every epoch
+        self._buffer = [
+            (to_tensor(feats[i:i + b]), to_tensor(labels[i:i + b]))
+            for i in range(0, len(feats), b)
+        ]
+        self._shuffled = True
+
+    def release_memory(self):
+        self._buffer = None
+
+    def get_memory_data_size(self):
+        return sum(int(np.asarray(f.numpy()).shape[0])
+                   for f, _ in (self._buffer or []))
+
+    def _iter_batches(self):
+        if self._buffer is None:
+            self.load_into_memory()
+        return iter(self._buffer)

@@ -20,12 +20,14 @@ from .lars_optimizer import LarsOptimizer
 from .dgc_optimizer import DGCOptimizer
 from .fp16_allreduce_optimizer import FP16AllReduceOptimizer
 from .asp_optimizer import ASPOptimizer
+from .parameter_server_optimizer import ParameterServerOptimizer
 from .dygraph_optimizer import HybridParallelOptimizer, DygraphShardingOptimizer  # noqa: F401
 
 META_OPTIMIZERS = [
     # ordered like strategy_compiler ranking
     AMPOptimizer,
     RecomputeOptimizer,
+    ParameterServerOptimizer,
     GradientMergeOptimizer,
     ShardingOptimizer,
     TensorParallelOptimizer,
@@ -45,6 +47,16 @@ META_OPTIMIZERS = [
 # the listed strategies are force-disabled on the DistributedStrategy and
 # their meta-opts dropped from the chain.
 _EXCLUSIONS = {
+    ParameterServerOptimizer: {
+        # PS mode (a_sync) is the CPU-cluster path: collective grad
+        # rewrites don't apply (reference keeps PS and collective
+        # strategies disjoint)
+        RawProgramOptimizer: "without_graph_optimization",
+        DGCOptimizer: "dgc",
+        FP16AllReduceOptimizer: "fp16_allreduce",
+        LocalSGDOptimizer: "localsgd",
+        ShardingOptimizer: "sharding",
+    },
     ShardingOptimizer: {
         # sharding owns grad placement: whole-grad compression/merge
         # rewrites would race its reduce-to-owner placement

@@ -1,0 +1,139 @@
+"""Parameter-server meta-optimizer (static PS program rewrite).
+
+Reference parity: meta_optimizers/parameter_server_optimizer.py (352 LoC) +
+operators/pscore/ (`send`, `recv`, `listen_and_serv`,
+`distributed_lookup_table` ops gluing programs to the PS runtime).
+TPU-native: the trainer program's update ops are REPLACED by `send` ops
+(grads stream to the PS shard that owns the param) and `recv` ops pull
+fresh params before use; when a live Communicator is attached the ops
+call it host-side through io_callback (the accelerator stays on the
+data path only for the forward/backward math, like the reference's
+CPU-PS design); without one they are inert markers so program-rewrite
+assertions (SURVEY §4.4) hold without a cluster.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .meta_optimizer_base import MetaOptimizerBase, UPDATE_OP_TYPES
+from ....static.backward import GRAD_SUFFIX
+
+# live communicator the send/recv op fns talk to (set by attach_communicator)
+_RUNTIME = {"comm": None}
+
+
+def attach_communicator(comm):
+    """Wire a ps.Communicator into the rewritten program's send/recv ops."""
+    _RUNTIME["comm"] = comm
+
+
+def _send_fn(param_name):
+    """ordered io_callback: a pure_callback whose output feeds nothing
+    gets dead-code-eliminated, silently dropping the push; ordered
+    callbacks also guarantee send-before-recv within one step."""
+    from jax.experimental import io_callback
+
+    def fn(g):
+        def cb(gv):
+            comm = _RUNTIME["comm"]
+            if comm is not None:
+                comm.client.push_dense(param_name, np.asarray(gv),
+                                       apply_now=True)
+            return np.asarray(gv)
+
+        return io_callback(cb, jax.ShapeDtypeStruct(g.shape, g.dtype), g,
+                           ordered=True)
+
+    return fn
+
+
+def _recv_fn(param_name):
+    from jax.experimental import io_callback
+
+    def fn(p):
+        def cb(pv):
+            comm = _RUNTIME["comm"]
+            if comm is None:
+                return np.asarray(pv)
+            fresh = comm.client.pull_dense(param_name)
+            return (np.asarray(fresh, np.asarray(pv).dtype)
+                    if fresh is not None else np.asarray(pv))
+
+        return io_callback(cb, jax.ShapeDtypeStruct(p.shape, p.dtype), p,
+                           ordered=True)
+
+    return fn
+
+
+class ParameterServerOptimizer(MetaOptimizerBase):
+    def _can_apply(self, strategy):
+        """PS mode needs a_sync AND an actual parameter-server role —
+        DistributedStrategy defaults a_sync=True (proto parity), so the
+        flag alone must not hijack collective runs (the reference gates
+        on the role maker the same way)."""
+        if not getattr(strategy, "a_sync", False):
+            return False
+        rm = self.role_maker
+        if rm is None or getattr(rm, "_is_collective", False):
+            return False
+        try:
+            return bool(rm.get_pserver_endpoints())
+        except Exception:
+            return False
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self.inner_opt.minimize(loss, startup_program,
+                                         parameter_list, no_grad_set)
+        block = loss.block.program.global_block()
+        if not block.ops:
+            return result
+        Operator = type(block.ops[0])
+
+        params = [n for n, v in block.vars.items()
+                  if v.is_parameter and not getattr(v, "stop_gradient", False)]
+        param_set = set(params)
+
+        final_ops = []
+        sent = set()
+        for op in block.ops:
+            # the PS applies updates server-side: local update ops drop
+            # (the reference deletes the optimize ops from the trainer
+            # program), replaced by send(grad) -> recv(param)
+            if op.type in UPDATE_OP_TYPES:
+                touched = [n for n in getattr(op, "in_order",
+                                              op.input_names())
+                           if n in param_set]
+                for pname in touched:
+                    gname = pname + GRAD_SUFFIX
+                    if gname not in block.vars or pname in sent:
+                        continue
+                    sent.add(pname)
+                    sop = Operator(block, "send", {"X": [gname]},
+                                   {"Out": [gname]},
+                                   {"table_name": pname},
+                                   fn=_send_fn(pname))
+                    sop.in_order = [gname]
+                    sop.out_order = [gname]
+                    final_ops.append(sop)
+                    rop = Operator(block, "recv", {"X": [pname]},
+                                   {"Out": [pname]},
+                                   {"table_name": pname},
+                                   fn=_recv_fn(pname))
+                    rop.in_order = [pname]
+                    rop.out_order = [pname]
+                    final_ops.append(rop)
+                continue
+            final_ops.append(op)
+        block.ops[:] = final_ops
+
+        # startup side: listen_and_serv marker (the server program's root
+        # op in the reference; the real server runs via fleet.run_server)
+        if startup_program is not None:
+            sb = startup_program.global_block()
+            lop_cls = Operator
+            lop = lop_cls(sb, "listen_and_serv", {}, {}, {}, fn=None)
+            lop.in_order = []
+            lop.out_order = []
+            sb.ops.append(lop)
+        return result

@@ -7,17 +7,7 @@ inserted op is a psum over the 'data' mesh axis when the block is compiled
 under shard_map/pjit; in single-mesh eager execution the global-batch gradient
 is already the reduced value, so the op is the identity scale.
 """
-import jax
-
 from .meta_optimizer_base import MetaOptimizerBase
-from ....static.backward import GRAD_SUFFIX
-
-
-def _allreduce_fn(v):
-    try:
-        return jax.lax.psum(v, "data")
-    except NameError:  # unbound axis: single-device execution
-        return v
 
 
 class RawProgramOptimizer(MetaOptimizerBase):
@@ -29,35 +19,10 @@ class RawProgramOptimizer(MetaOptimizerBase):
                  no_grad_set=None):
         result = self.inner_opt.minimize(loss, startup_program, parameter_list,
                                          no_grad_set)
-        block = loss.block.program.global_block()
-        self._insert_allreduce_ops(block)
-        return result
+        # the rewrite lives in the pass framework (ir/pass.h parity):
+        # meta-opts are thin drivers over registered program passes
+        from ....static.passes import get_pass
 
-    def _insert_allreduce_ops(self, block):
-        """raw_program_optimizer.py:158 parity: c_allreduce_sum after each grad
-        production, before optimizer update ops."""
-        new_ops = []
-        grad_names = set()
-        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
-                        "adagrad", "adadelta", "adamax"}
-        for op in block.ops:
-            new_ops.append(op)
-            for out in getattr(op, "out_order", []):
-                if out.endswith(GRAD_SUFFIX) and not out.startswith("c_"):
-                    grad_names.add(out)
-        # rebuild: insert allreduce right before first update op
-        final_ops = []
-        inserted = False
-        for op in new_ops:
-            if not inserted and op.type in update_types:
-                for g in sorted(grad_names):
-                    arop = type(op)(block, "c_allreduce_sum",
-                                    {"X": [g]}, {"Out": [g]},
-                                    {"ring_id": 0, "use_calc_stream": True},
-                                    fn=_allreduce_fn)
-                    arop.in_order = [g]
-                    arop.out_order = [g]
-                    final_ops.append(arop)
-                inserted = True
-            final_ops.append(op)
-        block.ops = final_ops
+        get_pass("insert_data_parallel_allreduce").apply(
+            loss.block.program)
+        return result

@@ -143,20 +143,32 @@ def _pool3d(x, kind, kernel_size, stride, padding, exclusive=True,
 
 def _max_pool3d_index(x, k, s, p, ceil_mode):
     """Flattened-spatial argmax indices per window (pool_with_index
-    kernels' mask output)."""
+    kernels' mask output).  Value patches are padded with -inf by
+    pre-padding (conv_general_dilated_patches pads 0, which would win the
+    argmax for all-negative windows), and ceil_mode adds the same
+    high-side padding as the value path so out/mask shapes agree."""
     k3, s3, p3 = _triple(k), _triple(s), _triple(p)
 
     def fn(v):
         N, C, D, H, W = v.shape
+        spatial = (D, H, W)
+        extra = [0, 0, 0]
+        if ceil_mode:
+            for i, (L, ki, si, pi) in enumerate(zip(spatial, k3, s3, p3)):
+                out_ceil = -(-(L + 2 * pi - ki) // si) + 1
+                extra[i] = max((out_ceil - 1) * si + ki - (L + 2 * pi), 0)
+        widths = [(0, 0), (0, 0)] + [
+            (pp, pp + e) for pp, e in zip(p3, extra)]
         idx_map = jnp.broadcast_to(
             jnp.arange(D * H * W, dtype=jnp.float32).reshape(1, 1, D, H, W),
             v.shape)
-        pads = [(pp, pp) for pp in p3]
+        vp = jnp.pad(v, widths, constant_values=-jnp.inf)
+        ip = jnp.pad(idx_map, widths, constant_values=-1.0)
         patches = jax.lax.conv_general_dilated_patches(
-            v, k3, s3, pads,
+            vp, k3, s3, [(0, 0)] * 3,
             dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
         ipatches = jax.lax.conv_general_dilated_patches(
-            idx_map, k3, s3, pads,
+            ip, k3, s3, [(0, 0)] * 3,
             dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
         KK = int(np.prod(k3))
         od, oh, ow = patches.shape[2:]

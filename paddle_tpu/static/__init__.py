@@ -69,3 +69,9 @@ class ExecutionStrategy:
     def __init__(self):
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 10
+from .passes import Pass, PassManager, register_pass, get_pass, pass_names  # noqa: F401,E402
+from .trainer import TrainerDesc, TrainerFactory, MultiTrainer  # noqa: F401,E402
+from .desc import (  # noqa: F401,E402 (ProgramDesc serialization)
+    program_to_desc, desc_to_program, save_program, load_program,
+    register_op_builder,
+)

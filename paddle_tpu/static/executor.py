@@ -108,7 +108,8 @@ class CompiledBlock:
             # stay dead-code-prunable for partial-feed runs
             side_effect_ops = {
                 "c_allreduce_sum", "c_allgather", "barrier",
-                "send_v2", "recv_v2", "save", "load", "print",
+                "send_v2", "recv_v2", "send", "recv", "listen_and_serv",
+                "save", "load", "print",
             }
             for op in ops:
                 in_names = getattr(op, "in_order", op.input_names())
@@ -240,6 +241,44 @@ class Executor:
             return outs
         return [Tensor(o) for o in outs]
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-path training (executor.py:1402 _run_from_dataset ->
+        TrainerFactory -> MultiTrainer over the native DataFeed)."""
+        from .trainer import TrainerDesc, TrainerFactory
+
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        desc = TrainerDesc()
+        if thread:
+            desc.set_thread(thread)
+            dataset.set_thread(thread)
+        desc.set_debug(debug)
+        desc.set_fetch_var_and_info(fetch_list, fetch_info, print_period)
+        trainer = TrainerFactory().create_trainer(desc)
+        trainer.set_program(program or default_main_program())
+        trainer.set_dataset(dataset)
+        steps, last = trainer.run(self, scope or _global_scope)
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Like train_from_dataset but parameters never update (the
+        device worker's infer flag): backward/update/PS ops are stripped
+        from a cloned program before the batch loop."""
+        from .trainer import inference_program
+
+        program = program or default_main_program()
+        prog = program.__dict__.get("_infer_clone")
+        if prog is None:  # cache: the executor compiles per program object
+            prog = inference_program(program)
+            program.__dict__["_infer_clone"] = prog
+        return self.train_from_dataset(prog, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     def _run_startup(self, program, scope):
         block = program.global_block()
         for op in block.ops:
@@ -254,5 +293,7 @@ class Executor:
 
 def _is_startup(program):
     ops = program.global_block().ops
-    return bool(ops) and all(op.type in ("init", "c_comm_init", "c_gen_nccl_id")
-                             for op in ops)
+    return bool(ops) and all(
+        op.type in ("init", "c_comm_init", "c_gen_nccl_id",
+                    "listen_and_serv")  # PS bootstrap marker (pscore)
+        for op in ops)

@@ -52,6 +52,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     }
     with open(path_prefix + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
+    # JSON ProgramDesc (framework.proto role): the feed->fetch forward
+    # slice — a trained program's grad/update closures have no desc
+    # builders, so the prune is what makes the artifact loadable
+    from .desc import prune_forward, save_program
+
+    save_program(prune_forward(program, meta["feed_names"],
+                               meta["fetch_names"]),
+                 path_prefix + ".pdmodel.json")
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump(params, f)
 

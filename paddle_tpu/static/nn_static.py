@@ -70,9 +70,9 @@ def _elementwise_emit(op_type, x, y, reverse=False):
         c = float(y)
         if reverse:
             return emit(op_type, [("Y", x)], [("Out", x.shape, x.dtype)],
-                        lambda b: fn(c, b))
+                        lambda b: fn(c, b), attrs={"scalar": c, "reverse": True})
         return emit(op_type, [("X", x)], [("Out", x.shape, x.dtype)],
-                    lambda a: fn(a, c))
+                    lambda a: fn(a, c), attrs={"scalar": c, "reverse": False})
     shape = _infer_eltwise_shape(x, y)
     if reverse:
         x, y = y, x
@@ -93,7 +93,7 @@ def _compare_emit(op_type, x, y):
     if not isinstance(y, Variable):
         c = float(y)
         return emit(op_type, [("X", x)], [("Out", x.shape, "bool")],
-                    lambda a: fn(a, c))
+                    lambda a: fn(a, c), attrs={"scalar": c})
     shape = _infer_eltwise_shape(x, y)
     return emit(op_type, [("X", x), ("Y", y)], [("Out", shape, "bool")], fn)
 
@@ -165,7 +165,9 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     if transpose_y:
         ys[-1], ys[-2] = ys[-2], ys[-1]
     shape = xs[:-1] + [ys[-1]]
-    return emit("matmul_v2", [("X", x), ("Y", y)], [("Out", shape, x.dtype)], fn)
+    return emit("matmul_v2", [("X", x), ("Y", y)], [("Out", shape, x.dtype)], fn,
+                attrs={"trans_x": transpose_x, "trans_y": transpose_y,
+                       "alpha": alpha})
 
 
 def relu(x, name=None):
@@ -182,7 +184,8 @@ def sigmoid_act(x, name=None):
 
 def softmax(x, axis=-1, name=None):
     return emit("softmax", [("X", x)], [("Out", x.shape, x.dtype)],
-                lambda v: jax.nn.softmax(v, axis=axis))
+                lambda v: jax.nn.softmax(v, axis=axis),
+                attrs={"axis": axis})
 
 
 def mean(x, name=None):
@@ -195,7 +198,9 @@ def reduce_sum(x, dim=None, keep_dim=False, name=None):
     shape = [1] if axis is None and not keep_dim else x.shape
     return emit("reduce_sum", [("X", x)], [("Out", shape, x.dtype)],
                 lambda v: jnp.sum(v, axis=axis, keepdims=keep_dim).reshape(shape)
-                if axis is None else jnp.sum(v, axis=axis, keepdims=keep_dim))
+                if axis is None else jnp.sum(v, axis=axis, keepdims=keep_dim),
+                attrs={"dim": list(axis) if isinstance(axis, tuple) else axis,
+                       "keep_dim": keep_dim})
 
 
 def cross_entropy(input, label, soft_label=False, ignore_index=-100):
@@ -213,7 +218,8 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
     shape = list(input.shape[:-1]) + [1]
     return emit("cross_entropy", [("X", input), ("Label", label)],
-                [("Y", shape, input.dtype)], fn)
+                [("Y", shape, input.dtype)], fn,
+                attrs={"soft_label": soft_label})
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
@@ -230,7 +236,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
     shape[axis] = 1
     return emit("softmax_with_cross_entropy",
                 [("Logits", logits), ("Label", label)],
-                [("Loss", shape, logits.dtype)], fn)
+                [("Loss", shape, logits.dtype)], fn,
+                attrs={"soft_label": soft_label, "axis": axis})
 
 
 def accuracy(input, label, k=1):
@@ -282,7 +289,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         ow = (W + pad[1][0] + pad[1][1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
     return emit("conv2d", ins,
                 [("Output", [input.shape[0], num_filters, oh, ow], input.dtype)],
-                fn)
+                fn, attrs={"strides": list(s), "paddings": pad,
+                           "dilations": list(d), "groups": groups})
 
 
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
@@ -296,7 +304,8 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
 
         return emit("pool2d", [("X", input)],
                     [("Out", [input.shape[0], input.shape[1], 1, 1], input.dtype)],
-                    fn, attrs={"global_pooling": True})
+                    fn, attrs={"global_pooling": True,
+                               "pooling_type": pool_type})
     k = _pair(pool_size)
     s = _pair(pool_stride)
     p = _pair(pool_padding)
@@ -316,7 +325,9 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
     ow = (W + 2 * p[1] - k[1]) // s[1] + 1
     return emit("pool2d", [("X", input)],
                 [("Out", [input.shape[0], input.shape[1], oh, ow], input.dtype)],
-                fn)
+                fn, attrs={"global_pooling": False, "pooling_type": pool_type,
+                           "ksize": list(k), "strides": list(s),
+                           "paddings": list(p)})
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
@@ -352,7 +363,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                 [("X", input), ("Scale", scale), ("Bias", bias), ("Mean", mean),
                  ("Variance", var)],
                 [("Y", input.shape, input.dtype)], fn,
-                attrs={"is_test": is_test, "momentum": momentum})
+                attrs={"is_test": is_test, "momentum": momentum,
+                       "epsilon": epsilon, "act": act})
 
 
 def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
@@ -374,7 +386,8 @@ def reshape(x, shape, name=None):
     shape2 = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
     return emit("reshape2", [("X", x)], [("Out", shape2, x.dtype)],
                 lambda v: jnp.reshape(v, [v.shape[0] if s == -1 and i == 0 else s
-                                          for i, s in enumerate(shape2)]))
+                                          for i, s in enumerate(shape2)]),
+                attrs={"shape": list(shape2)})
 
 
 def flatten(x, axis=1, name=None):
@@ -383,7 +396,8 @@ def flatten(x, axis=1, name=None):
     def fn(v):
         return v.reshape(v.shape[0] if axis == 1 else -1, -1)
 
-    return emit("flatten", [("X", x)], [("Out", shape, x.dtype)], fn)
+    return emit("flatten", [("X", x)], [("Out", shape, x.dtype)], fn,
+                attrs={"axis": axis})
 
 
 def embedding(input, size, padding_idx=None, param_attr=None, dtype="float32"):
@@ -399,7 +413,8 @@ def embedding(input, size, padding_idx=None, param_attr=None, dtype="float32"):
 
     shape = list(input.shape) + [size[1]]
     return emit("lookup_table_v2", [("Ids", input), ("W", w)],
-                [("Out", shape, dtype)], fn)
+                [("Out", shape, dtype)], fn,
+                attrs={"padding_idx": padding_idx})
 
 
 def layer_norm_static(x, scale=True, shift=True, begin_norm_axis=1,
@@ -430,4 +445,6 @@ def layer_norm_static(x, scale=True, shift=True, begin_norm_axis=1,
             out = out + wb[i]
         return out.reshape(orig)
 
-    return emit("layer_norm", ins, [("Y", x.shape, x.dtype)], fn)
+    return emit("layer_norm", ins, [("Y", x.shape, x.dtype)], fn,
+                attrs={"begin_norm_axis": begin_norm_axis,
+                       "epsilon": epsilon, "scale": scale, "shift": shift})

@@ -1,0 +1,171 @@
+"""Program-rewrite pass framework.
+
+Reference parity: framework/ir/ (Graph ir/graph.h:79, Pass ir/pass.h:43,
+PassRegistry ir/pass.h:193, 128 registered passes).  TPU-native scope:
+XLA owns kernel fusion and memory planning INSIDE the compiled block
+(SURVEY §7.1), so the pass surface here is program-level rewrites — the
+role the reference's multi_devices / quant / inference-analysis passes
+play above the kernel fusions.  Meta-optimizers route their rewrites
+through registered passes so pass application is inspectable and
+ordered (PassManager).
+"""
+
+_PASSES = {}
+
+
+class Pass:
+    """ir/pass.h:43 parity: name + apply(program, **ctx)."""
+
+    name = None
+
+    def apply(self, program, **ctx):
+        raise NotImplementedError
+
+    def __call__(self, program, **ctx):
+        return self.apply(program, **ctx)
+
+
+def register_pass(name):
+    """ir/pass.h:193 PassRegistry parity (decorator form)."""
+
+    def deco(cls_or_fn):
+        if isinstance(cls_or_fn, type):
+            inst = cls_or_fn()
+            inst.name = name
+        else:
+            inst = _FnPass(name, cls_or_fn)
+        _PASSES[name] = inst
+        return cls_or_fn
+
+    return deco
+
+
+class _FnPass(Pass):
+    def __init__(self, name, fn):
+        self.name = name
+        self._fn = fn
+
+    def apply(self, program, **ctx):
+        return self._fn(program, **ctx)
+
+
+def get_pass(name):
+    if name not in _PASSES:
+        raise KeyError(f"no pass registered under {name!r}; "
+                       f"known: {sorted(_PASSES)}")
+    return _PASSES[name]
+
+
+def pass_names():
+    return sorted(_PASSES)
+
+
+class PassManager:
+    """Ordered application (the PassBuilder/apply-loop role)."""
+
+    def __init__(self, names):
+        self.passes = [get_pass(n) for n in names]
+
+    def apply(self, program, **ctx):
+        for p in self.passes:
+            program = p.apply(program, **ctx) or program
+        return program
+
+
+# ---- built-in passes ----
+
+@register_pass("fuse_bn_act")
+def _fuse_bn_act(program, **ctx):
+    """conv_bn-fuse-pass family parity: a relu directly (and solely)
+    consuming a batch_norm output folds into the bn op's fn."""
+    import jax
+
+    block = program.global_block()
+    consumers = {}
+    for op in block.ops:
+        for n in getattr(op, "in_order", op.input_names()):
+            consumers.setdefault(n, []).append(op)
+    drop = set()
+    for op in block.ops:
+        if op.type != "batch_norm" or op in drop:
+            continue
+        outs = getattr(op, "out_order", op.output_names())
+        if len(outs) != 1:
+            continue
+        cs = consumers.get(outs[0], [])
+        if len(cs) == 1 and cs[0].type == "relu" and cs[0] not in drop:
+            relu_op = cs[0]
+            old_fn = op.fn
+
+            def fused(*a, _f=old_fn):
+                pre = _f(*a)
+                return pre, jax.nn.relu(pre)
+
+            op.fn = fused
+            op.type = "batch_norm_act"
+            # the fused op writes BOTH the pre-activation var (it may be
+            # a fetch target) and the relu's output; unused ones prune
+            relu_outs = list(getattr(relu_op, "out_order",
+                                     relu_op.output_names()))
+            op.out_order = [outs[0]] + relu_outs
+            merged = dict(op.outputs)
+            for k, v in relu_op.outputs.items():
+                merged.setdefault(k, [])
+                merged[k] = list(merged[k]) + list(v)
+            op.outputs = merged
+            drop.add(relu_op)
+    if drop:
+        block.ops[:] = [op for op in block.ops if op not in drop]
+    return program
+
+
+@register_pass("delete_dropout_inference")
+def _delete_dropout(program, **ctx):
+    """inference-analysis parity (identity_scale/delete_dropout passes):
+    dropout ops become identities for deployment programs."""
+    block = program.global_block()
+    for op in block.ops:
+        if op.type in ("dropout", "dropout2d", "dropout3d"):
+            op.type = "scale"  # identity scale, the reference's rewrite
+            op.fn = lambda v, *rest: v
+            ins = getattr(op, "in_order", op.input_names())
+            op.in_order = ins[:1]
+    return program
+
+
+@register_pass("insert_data_parallel_allreduce")
+def _insert_dp_allreduce(program, **ctx):
+    """raw_program_optimizer.py:158 as a pass: c_allreduce_sum on every
+    param grad, right before the first optimizer-update op."""
+    import jax
+
+    from ..distributed.fleet.meta_optimizers.meta_optimizer_base import (
+        collect_param_grad_names, insert_before_first_update,
+    )
+
+    def _allreduce_fn(v):
+        try:
+            return jax.lax.psum(v, "data")
+        except NameError:  # unbound axis: single-device execution
+            return v
+
+    block = program.global_block()
+    if not block.ops:
+        return program
+    grad_names = collect_param_grad_names(block)
+    Operator = type(block.ops[0])
+
+    def build_ops():
+        ops = []
+        for g in sorted(grad_names):
+            arop = Operator(block, "c_allreduce_sum", {"X": [g]},
+                            {"Out": [g]},
+                            {"ring_id": 0, "use_calc_stream": True},
+                            fn=_allreduce_fn)
+            arop.in_order = [g]
+            arop.out_order = [g]
+            ops.append(arop)
+        return ops
+
+    insert_before_first_update(block, build_ops)
+    return program

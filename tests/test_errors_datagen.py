@@ -97,3 +97,119 @@ def test_data_generator_stdin_pipe(tmp_path, monkeypatch, capsys):
     Gen().run_from_stdin()
     out = capsys.readouterr().out.strip().splitlines()
     assert out == ["2 1.0 2.0", "2 3.0 4.0"]
+
+
+def test_train_from_dataset_end_to_end(tmp_path):
+    """TrainerDesc/MultiTrainer over the fleet dataset facade: a csv
+    dataset trains a static program via exe.train_from_dataset
+    (trainer.h:57/102 + _run_from_dataset parity)."""
+    from paddle_tpu.native import available
+
+    if not available():
+        pytest.skip("native data feed unavailable")
+    from paddle_tpu.distributed.fleet.dataset import (
+        InMemoryDataset, QueueDataset,
+    )
+
+    # csv: 3 features + int label column
+    rng = np.random.RandomState(0)
+    w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    lines = []
+    X = rng.rand(64, 3).astype(np.float32)
+    Y = (X @ w_true).ravel()
+    for i in range(64):
+        lines.append(",".join(f"{v:.6f}" for v in X[i]) + f",{Y[i]:.6f}")
+    p = tmp_path / "part-0"
+    p.write_text("\n".join(lines) + "\n")
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 3])
+            y = static.data("y", [8, 1])
+            pred = static.nn.fc(x, 1)
+            diff = pred - y
+            loss = static.nn.mean(diff * diff)
+            opt = paddle.optimizer.SGD(learning_rate=0.2)
+            opt.minimize(loss)
+
+        ds = QueueDataset()
+        ds.set_batch_size(8)
+        ds.set_filelist([str(p)])
+        ds.set_format("csv", label_col=3)
+        ds.set_use_var([x, y])
+
+        exe = static.Executor()
+        exe.run(startup)
+        first = exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                       print_period=10**9)
+        l0 = float(np.asarray(first[0]).ravel()[0])
+        for _ in range(6):
+            last = exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                          print_period=10**9)
+        l1 = float(np.asarray(last[0]).ravel()[0])
+        assert l1 < l0, (l0, l1)
+
+        # InMemoryDataset buffers + shuffles without losing samples
+        ds2 = InMemoryDataset()
+        ds2.set_batch_size(8)
+        ds2.set_filelist([str(p)])
+        ds2.set_format("csv", label_col=3)
+        ds2.set_use_var([x, y])
+        ds2.load_into_memory()
+        n0 = ds2.get_memory_data_size()
+        ds2.local_shuffle(seed=1)
+        assert ds2.get_memory_data_size() == n0 == 64
+        exe.train_from_dataset(main, ds2, fetch_list=[loss],
+                               print_period=10**9)
+    finally:
+        paddle.disable_static()
+
+
+def test_infer_from_dataset_does_not_update_params(tmp_path):
+    """Review finding: infer mode must never mutate parameters."""
+    from paddle_tpu.native import available
+
+    if not available():
+        pytest.skip("native data feed unavailable")
+    from paddle_tpu.distributed.fleet.dataset import QueueDataset
+
+    rng = np.random.RandomState(0)
+    lines = [",".join(f"{v:.5f}" for v in rng.rand(4)) for _ in range(16)]
+    p = tmp_path / "part-0"
+    p.write_text("\n".join(lines) + "\n")
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 3])
+            y = static.data("y", [8, 1])
+            pred = static.nn.fc(x, 1)
+            diff = pred - y
+            loss = static.nn.mean(diff * diff)
+            paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        ds = QueueDataset()
+        ds.set_batch_size(8)
+        ds.set_filelist([str(p)])
+        ds.set_format("csv", label_col=3)
+        ds.set_use_var([x, y])
+        exe = static.Executor()
+        exe.run(startup)
+        from paddle_tpu.static.executor import global_scope
+
+        block = main.global_block()
+        pname = [n for n, v in block.vars.items()
+                 if v.is_parameter and len(v.shape) == 2][0]
+        w0 = np.asarray(global_scope().get(pname)).copy()
+        exe.infer_from_dataset(main, ds, fetch_list=[loss],
+                               print_period=10**9)
+        np.testing.assert_array_equal(
+            np.asarray(global_scope().get(pname)), w0)
+        exe.train_from_dataset(main, ds, fetch_list=[loss],
+                               print_period=10**9)
+        assert not np.array_equal(
+            np.asarray(global_scope().get(pname)), w0)
+    finally:
+        paddle.disable_static()

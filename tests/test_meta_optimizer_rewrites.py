@@ -23,7 +23,10 @@ def _build_program(hidden=16):
     return main, startup, loss
 
 
-def _fleet_minimize(strategy_flags, loss, opt=None):
+def _fleet_minimize(strategy_flags, loss, opt=None, startup=None,
+                    ps_mode=False):
+    import os
+
     from paddle_tpu.distributed.fleet.distributed_strategy import (
         DistributedStrategy,
     )
@@ -36,9 +39,26 @@ def _fleet_minimize(strategy_flags, loss, opt=None):
     for k, v in strategy_flags.items():
         setattr(strategy, k, v)
     f = Fleet()
-    f.init(is_collective=True, strategy=strategy)
-    opt = opt or paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
-    return strategy, apply_meta_optimizers(opt, strategy, loss, None, f)
+    saved = {}
+    if ps_mode:
+        # PS role env (the role maker gates the PS meta-optimizer)
+        for k, v in {"TRAINING_ROLE": "TRAINER", "PADDLE_TRAINER_ID": "0",
+                     "PADDLE_PSERVER_ENDPOINTS": "127.0.0.1:1",
+                     "PADDLE_TRAINERS_NUM": "1"}.items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+    try:
+        f.init(is_collective=not ps_mode, strategy=strategy)
+        opt = opt or paddle.optimizer.Momentum(learning_rate=0.1,
+                                               momentum=0.9)
+        return strategy, apply_meta_optimizers(opt, strategy, loss, startup,
+                                               f)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 # ---- tensor parallel: specs from call sites, not guessed ----
@@ -241,3 +261,83 @@ class _NoMinimizeOpt:
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         return None, []
+
+
+def test_parameter_server_rewrite_op_list():
+    """pscore parity: a_sync strategy replaces local update ops with
+    send(grad)/recv(param) and plants listen_and_serv in startup."""
+    paddle.enable_static()
+    try:
+        main, startup, loss = _build_program()
+        with static.program_guard(main, startup):
+            _fleet_minimize({"a_sync": True}, loss, startup=startup,
+                            ps_mode=True)
+        types = [op.type for op in main.global_block().ops]
+        assert "send" in types and "recv" in types
+        assert "momentum" not in types  # update ops dropped
+        assert "listen_and_serv" in [op.type
+                                     for op in startup.global_block().ops]
+    finally:
+        paddle.disable_static()
+
+
+def test_parameter_server_program_trains_against_live_server():
+    """The rewritten program's send/recv ops drive a real PSServer via
+    host callbacks: params update server-side only."""
+    import socket
+
+    from paddle_tpu.distributed.ps.service import PSServer, PSClient
+    from paddle_tpu.distributed.ps.communicator import Communicator
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        parameter_server_optimizer as pso,
+    )
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    server = PSServer(ep, trainers=1)
+    server.start()
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8])
+            y = static.nn.fc(x, 1)
+            loss = static.nn.mean(y * y)
+            _fleet_minimize(
+                {"a_sync": True}, loss,
+                opt=paddle.optimizer.SGD(learning_rate=0.1),
+                startup=startup, ps_mode=True)
+        exe = static.Executor()
+        exe.run(startup)
+
+        client = PSClient([ep])
+        client.ping()
+        comm = Communicator(client, mode="async", n_workers=1)
+        pso.attach_communicator(comm)
+        # seed server tables from the initialized scope
+        from paddle_tpu.static.executor import global_scope
+
+        block = main.global_block()
+        for n, v in block.vars.items():
+            if v.is_parameter:
+                val = np.asarray(global_scope().get(n))
+                client.create_dense_table(n, val.shape, lr=0.1)
+                client.set_dense(n, val)
+
+        xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+        l0 = float(exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+        for _ in range(8):
+            l1 = float(exe.run(main, feed={"x": xv}, fetch_list=[loss])[0])
+        assert l1 < l0, (l0, l1)
+        # and the fresh values live server-side
+        w_server = client.pull_dense(
+            [n for n, v in block.vars.items() if v.is_parameter
+             and len(v.shape) == 2][0])
+        assert np.isfinite(w_server).all()
+        client.close()
+    finally:
+        pso.attach_communicator(None)
+        paddle.disable_static()
+        server.shutdown()

@@ -229,3 +229,16 @@ def test_lamb_sparse_falls_back_dense():
         return np.asarray(emb.weight.numpy())
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_max_pool3d_mask_ceil_and_negative_windows():
+    """Mask shape tracks ceil_mode output and -inf padding keeps padded
+    slots from winning the argmax (review finding)."""
+    x = -np.ones((1, 1, 3, 3, 3), np.float32)
+    x[0, 0, 0, 0, 0] = -0.5
+    out, mask = F.max_pool3d(_t(x), 2, stride=2, ceil_mode=True,
+                             return_mask=True)
+    assert tuple(out.shape)[2:] == (2, 2, 2) == tuple(mask.shape)[2:]
+    # all-negative corner window: the real element wins, not pad-0
+    assert int(mask.numpy()[0, 0, 0, 0, 0]) == 0
+    assert float(out.numpy()[0, 0, 0, 0, 0]) == -0.5

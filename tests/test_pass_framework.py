@@ -1,0 +1,111 @@
+"""IR pass framework (ir/pass.h:43 / PassRegistry:193 parity): registered
+program-rewrite passes + PassManager ordering; meta-opts route through it."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static.passes import (
+    PassManager, get_pass, pass_names, register_pass,
+)
+
+
+def test_registry_and_custom_pass():
+    assert "fuse_bn_act" in pass_names()
+    assert "insert_data_parallel_allreduce" in pass_names()
+
+    calls = []
+
+    @register_pass("test_noop_pass")
+    def _noop(program, **ctx):
+        calls.append(program)
+        return program
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        PassManager(["test_noop_pass"]).apply(main)
+        assert calls == [main]
+        with pytest.raises(KeyError, match="no pass registered"):
+            get_pass("nonexistent_pass")
+    finally:
+        paddle.disable_static()
+
+
+def test_fuse_bn_act_pass_preserves_numerics():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3, 8, 8])
+            y = static.nn.conv2d(x, 4, 3, padding=1)
+            y = static.nn.batch_norm(y)
+            out = static.nn.relu(y)
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+        before = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+
+        types0 = [op.type for op in main.global_block().ops]
+        assert "relu" in types0
+        get_pass("fuse_bn_act").apply(main)
+        types1 = [op.type for op in main.global_block().ops]
+        assert "batch_norm_act" in types1 and "relu" not in types1
+
+        exe2 = static.Executor()  # fresh cache: compiled block changed
+        after = exe2.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_delete_dropout_inference_pass():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8])
+            h = static.nn.fc(x, 8)
+            h = static.nn.dropout(h, dropout_prob=0.5)
+            out = static.nn.relu(h)
+        get_pass("delete_dropout_inference").apply(main)
+        types = [op.type for op in main.global_block().ops]
+        assert "dropout" not in types
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.ones((4, 8), np.float32)
+        a = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        b = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        np.testing.assert_allclose(a, b)  # deterministic now
+    finally:
+        paddle.disable_static()
+
+
+def test_raw_program_meta_opt_routes_through_pass():
+    """The DP meta-opt is a thin driver over the registered pass."""
+    from paddle_tpu.distributed.fleet.distributed_strategy import (
+        DistributedStrategy,
+    )
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        apply_meta_optimizers,
+    )
+    from paddle_tpu.distributed.fleet import Fleet
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3])
+            pred = static.nn.fc(x, 1)
+            loss = static.nn.mean(pred * pred)
+            strategy = DistributedStrategy()
+            strategy.without_graph_optimization = True
+            f = Fleet()
+            f.init(is_collective=True, strategy=strategy)
+            apply_meta_optimizers(
+                paddle.optimizer.SGD(learning_rate=0.1), strategy, loss,
+                None, f)
+        types = [op.type for op in main.global_block().ops]
+        assert "c_allreduce_sum" in types
+    finally:
+        paddle.disable_static()
