@@ -87,15 +87,33 @@ def apply_op(op_type, fn, args, kwargs, n_outputs=None):
         and jnp.issubdtype(args[i]._data.dtype, jnp.inexact)
     ] if autograd.is_grad_enabled() else []
 
+    from ..framework import _FLAGS
+    check_nan = _FLAGS.get("FLAGS_check_nan_inf")
+    if _FLAGS.get("FLAGS_profile"):
+        # FLAGS_profile (flags.cc / profiler.h): per-op host spans, the
+        # RecordEvent the reference pushes around every kernel
+        from ..profiler import RecordEvent, start_profiler, _enabled
+
+        if not _enabled[0]:
+            start_profiler()
+        with RecordEvent(f"op::{op_type}"):
+            return _apply_op_impl(op_type, fn, args, kwargs, tensor_pos,
+                                  vals, diff_pos, check_nan)
+    return _apply_op_impl(op_type, fn, args, kwargs, tensor_pos, vals,
+                          diff_pos, check_nan)
+
+
+def _apply_op_impl(op_type, fn, args, kwargs, tensor_pos, vals, diff_pos,
+                   check_nan):
+    from .tensor import Tensor, _wrap_data
+    from . import autograd
+
     def call_fn(*tensor_vals):
         full = list(args)
         it = iter(tensor_vals)
         for i in tensor_pos:
             full[i] = next(it)
         return fn(*full, **kwargs)
-
-    from ..framework import _FLAGS
-    check_nan = _FLAGS.get("FLAGS_check_nan_inf")
 
     if not diff_pos:
         with autograd.no_grad():
