@@ -96,6 +96,25 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
     set_args(tuple(final))
 
 
+def _default_flags(names, init, set_args):
+    """Transform-generated break/continue flags may be UNDEF when an inner
+    loop's flag rides an outer loop's carry (it is always re-assigned
+    before use inside the body): default them to False so the carry has a
+    concrete type."""
+    if not any(isinstance(v, _Undefined)
+               and (n.startswith("_break_flag_")
+                    or n.startswith("_cont_flag_"))
+               for n, v in zip(names, init)):
+        return init
+    fixed = tuple(
+        False if isinstance(v, _Undefined)
+        and (n.startswith("_break_flag_") or n.startswith("_cont_flag_"))
+        else v
+        for n, v in zip(names, init))
+    set_args(fixed)
+    return fixed
+
+
 def convert_while_loop(cond_fn, body_fn, get_args, set_args, names):
     """Transformed `while` dispatch (convert_operators.py
     convert_while_loop).
@@ -116,7 +135,7 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args, names):
             flag = bool(_raw(cond_fn()))
         return
 
-    init = get_args()
+    init = _default_flags(names, get_args(), set_args)
     for n, v in zip(names, init):
         if isinstance(v, _Undefined):
             raise ValueError(
@@ -226,8 +245,25 @@ def _scalar_i64(x):
     return jnp.reshape(jnp.asarray(_raw(x)), ()).astype(jnp.int32)
 
 
+def _flag_value(names, get_args, break_flag):
+    """Concrete truthiness of this loop's break flag (None if traced)."""
+    if break_flag is None or break_flag not in names:
+        return False
+    v = get_args()[names.index(break_flag)]
+    if isinstance(v, Tensor):
+        v = v._data
+    if isinstance(v, (_Undefined, type(None))):
+        return False
+    if isinstance(v, jax.core.Tracer):
+        return None  # unknowable eagerly
+    import numpy as _np
+
+    return bool(_np.asarray(v).reshape(-1)[0]) if getattr(
+        v, "shape", None) else bool(v)
+
+
 def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
-                     names):
+                     names, break_flag=None):
     """Transformed `for` dispatch (reference: loop_transformer.py converts
     for-range / for-iter into while ops).
 
@@ -252,6 +288,8 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
             for k in range(start, stop, step):
                 assign_fn(k)
                 body_fn()
+                if _flag_value(names, get_args, break_flag):
+                    break
             return
         start = _scalar_i64(iter_obj.start)
         stop = _scalar_i64(iter_obj.stop)
@@ -260,7 +298,7 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
         # concrete type for every name (zero-trip loops keep it — a static
         # shape constraint, documented deviation from python's "unbound")
         assign_fn(_wrap_data(start))
-        init = get_args()
+        init = _default_flags(names, get_args(), set_args)
         for n, v in zip(names, init):
             if isinstance(v, _Undefined):
                 raise ValueError(
@@ -273,9 +311,18 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
                 _wrap_like(t, v) if isinstance(t, Tensor) else v
                 for t, v in zip(templates, vals)))
 
+        brk_idx = (names.index(break_flag)
+                   if break_flag is not None and break_flag in names
+                   else None)
+
         def c(state):
-            i, _ = state
-            return jnp.where(step > 0, i < stop, i > stop)
+            i, vals = state
+            in_range = jnp.where(step > 0, i < stop, i > stop)
+            if brk_idx is not None:
+                # unlike lax.scan, while_loop CAN exit early on break
+                flag = jnp.reshape(jnp.asarray(vals[brk_idx]), ())
+                in_range = in_range & jnp.logical_not(flag.astype(bool))
+            return in_range
 
         def b(state):
             i, vals = state
@@ -303,11 +350,13 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
                 assign_fn(iter_obj[k] if isinstance(iter_obj, Tensor)
                           else raw[k])
                 body_fn()
+                if _flag_value(names, get_args, break_flag):
+                    break
             return
         if n == 0:
             return
         assign_fn(_wrap_data(raw[0]))
-        init = get_args()
+        init = _default_flags(names, get_args(), set_args)
         for nm, v in zip(names, init):
             if isinstance(v, _Undefined):
                 raise ValueError(
@@ -330,7 +379,10 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
         restore(out)
         return
 
-    # plain python iterable
+    # plain python iterable: honor the break flag so infinite
+    # generators terminate (the lowering removed the native `break`)
     for v in iter_obj:
         assign_fn(v)
         body_fn()
+        if _flag_value(names, get_args, break_flag):
+            break

@@ -133,14 +133,133 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [ast.fix_missing_locations(ast.copy_location(s, node))
                 for s in out]
 
+    # --- break/continue lowering (break_continue_transformer.py parity) ---
+    @staticmethod
+    def _has_yield(body):
+        for sub in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                return True
+        return False
+
+    def _own_break_continue(self, body):
+        """break/continue statements belonging to THIS loop (not to a
+        source-level nested loop)."""
+        found = []
+
+        class V(ast.NodeVisitor):
+            def visit_For(self, n):
+                pass  # nested loop owns its own break/continue
+
+            def visit_While(self, n):
+                pass
+
+            def visit_FunctionDef(self, n):
+                pass
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Break(self, n):
+                found.append(n)
+
+            def visit_Continue(self, n):
+                found.append(n)
+
+        for s in body:
+            V().visit(s)
+        return found
+
+    def _lower_break_continue(self, body, uid):
+        """Rewrite break/continue into guard flags: `break` sets
+        _pt_brk_N, `continue` sets _pt_cont_N, and every statement gains
+        an `if not (brk or cont):` guard so later statements skip once a
+        flag is up (the flags trace as tensor bools when the
+        break/continue sat under a tensor condition).  Returns
+        (new_body, bflag) — the loop condition must AND with `not bflag`.
+        """
+        # NOT _pt_-prefixed: the scaffolding filter drops _pt_ names,
+        # and the flags must ride the nonlocal get/set machinery
+        bflag, cflag = f"_break_flag_{uid}", f"_cont_flag_{uid}"
+
+        class BC(ast.NodeTransformer):
+            def visit_For(self, n):
+                return n
+
+            def visit_While(self, n):
+                return n
+
+            def visit_FunctionDef(self, n):
+                return n
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Break(self, n):
+                return ast.copy_location(
+                    ast.parse(f"{bflag} = True").body[0], n)
+
+            def visit_Continue(self, n):
+                return ast.copy_location(
+                    ast.parse(f"{cflag} = True").body[0], n)
+
+        new_body = [BC().visit(s) for s in body]
+
+        def guard(stmts):
+            out = []
+            for s in stmts:
+                if isinstance(s, ast.If):
+                    s.body = guard(s.body)
+                    s.orelse = guard(s.orelse)
+                elif isinstance(s, (ast.With, ast.AsyncWith)):
+                    s.body = guard(s.body)
+                elif isinstance(s, ast.Try):
+                    s.body = guard(s.body)
+                    s.orelse = guard(s.orelse)
+                    s.finalbody = guard(s.finalbody)
+                    for h in s.handlers:
+                        h.body = guard(h.body)
+                g = ast.parse(
+                    f"if not ({bflag} or {cflag}):\n    pass").body[0]
+                g.body = [s]
+                out.append(ast.copy_location(ast.fix_missing_locations(g),
+                                             s))
+            return out
+
+        guarded = guard(new_body)
+        reset = ast.parse(f"{cflag} = False").body[0]
+        return [reset] + guarded, (bflag, cflag)
+
     def visit_While(self, node):
+        # eligibility FIRST: a loop we will leave as plain Python must not
+        # be half-lowered (flags referenced but never initialized)
+        eligible = not (_has_return(node.body) or node.orelse
+                        or self._has_yield(node.body))
+        bflag = cflag = None
+        if eligible and self._own_break_continue(node.body):
+            uid_bc = self._uid()
+            node.body, (bflag, cflag) = self._lower_break_continue(
+                node.body, uid_bc)
+            node.test = ast.BoolOp(
+                op=ast.And(),
+                values=[node.test,
+                        ast.UnaryOp(op=ast.Not(),
+                                    operand=ast.Name(id=bflag,
+                                                     ctx=ast.Load()))])
+            ast.fix_missing_locations(node)
         self.generic_visit(node)
-        if _has_return(node.body) or node.orelse:
+        if not eligible:
             return node
-        # break/continue/yield can't cross the hoisted-function boundary
+        # residual break/continue: a nested loop fell back to plain Python
+        # and still holds one — keep this loop plain too, but the lowered
+        # flags (now referenced in test/body) need their inits
         for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
             if isinstance(sub, (ast.Break, ast.Continue, ast.Yield,
                                 ast.YieldFrom)):
+                if bflag is not None:
+                    inits = [
+                        ast.fix_missing_locations(
+                            ast.copy_location(st, node))
+                        for st in ast.parse(
+                            f"{bflag} = False\n{cflag} = False").body]
+                    return inits + [node]
                 return node
         uid = self._uid()
         # loop vars = names assigned in the body; names the condition reads
@@ -160,7 +279,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = ast.parse(
             f"{_PT}.convert_while_loop(_pt_wcond_{uid}, _pt_wbody_{uid}, "
             f"_pt_get_{uid}, _pt_set_{uid}, {names!r})").body[0]
-        out = self._init_undefined(names)
+        out = []
+        if bflag is not None:
+            # both flags must be real Falses BEFORE the loop: UNDEF reads
+            # truthy in the condition, and carried loop vars need concrete
+            # values at entry
+            out.extend(ast.parse(f"{bflag} = False\n{cflag} = False").body)
+        out.extend(self._init_undefined(names))
         out.extend(ast.parse(get_src).body)
         out.extend(ast.parse(set_src).body)
         out.append(cond_def)
@@ -171,13 +296,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         """`for target in iter: body` -> convert_for_loop shim (reference:
-        loop_transformer.py for-range / for-iter -> while op)."""
+        loop_transformer.py for-range / for-iter -> while op).  break/
+        continue lower to guard flags first; once the break flag is up the
+        remaining iterations are guarded no-ops (a lax.scan cannot
+        early-exit; values are identical, trailing iterations idle)."""
+        eligible = not (_has_return(node.body) or node.orelse
+                        or self._has_yield(node.body))
+        bflag = cflag = None
+        if eligible and self._own_break_continue(node.body):
+            uid_bc = self._uid()
+            node.body, (bflag, cflag) = self._lower_break_continue(
+                node.body, uid_bc)
+            ast.fix_missing_locations(node)
         self.generic_visit(node)
-        if node.orelse or _has_return(node.body):
+        if not eligible:
             return node
         for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
             if isinstance(sub, (ast.Break, ast.Continue, ast.Yield,
                                 ast.YieldFrom)):
+                if bflag is not None:
+                    inits = [
+                        ast.fix_missing_locations(
+                            ast.copy_location(st, node))
+                        for st in ast.parse(
+                            f"{bflag} = False\n{cflag} = False").body]
+                    return inits + [node]
                 return node
         uid = self._uid()
         tnames = sorted({n.id for n in ast.walk(node.target)
@@ -203,8 +346,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = ast.parse(
             f"{_PT}.convert_for_loop(_pt_iter_{uid}, _pt_assign_{uid}, "
             f"_pt_fbody_{uid}, _pt_get_{uid}, _pt_set_{uid}, "
-            f"{names!r})").body[0]
+            f"{names!r}, break_flag={bflag!r})").body[0]
         out = [iter_assign]
+        if bflag is not None:
+            out.extend(ast.parse(f"{bflag} = False\n{cflag} = False").body)
         out.extend(self._init_undefined(names))
         out.extend(ast.parse(get_src).body)
         out.extend(ast.parse(set_src).body)

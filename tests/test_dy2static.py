@@ -301,3 +301,175 @@ def test_for_plain_python_iterable_unchanged():
 
     g = transform_function(f)
     np.testing.assert_allclose(g([1.0, 2.0], _t([0.0])).numpy(), [3.0])
+
+
+# ---- break/continue lowering (break_continue_transformer.py parity) ----
+
+def test_while_break_on_tensor_cond_jit():
+    @paddle.jit.to_static
+    def f(x, limit):
+        i = paddle.to_tensor(np.float32(0.0))
+        s = x * 0.0
+        while i < 100.0:
+            if i >= limit:
+                break
+            s = s + x
+            i = i + 1.0
+        return s
+
+    out = f(_t([1.0, 2.0]), _t(3.0))
+    np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+    out2 = f(_t([1.0, 2.0]), _t(5.0))
+    np.testing.assert_allclose(out2.numpy(), [5.0, 10.0])
+
+
+def test_while_continue_skips_work():
+    def f(n):
+        i = paddle.to_tensor(np.float32(0.0))
+        s = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            i = i + 1.0
+            if paddle.mean(i) % 2.0 == 0.0:
+                continue
+            s = s + i  # odd values only
+        return s
+
+    g = transform_function(f)
+    assert g is not f
+    # eager: 1+3+5 = 9
+    np.testing.assert_allclose(g(_t(6.0)).numpy(), 9.0)
+    # jit
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(_t(6.0)).numpy(), 9.0)
+
+
+def test_for_break_guarded_iterations():
+    """After break, remaining scan iterations are guarded no-ops."""
+
+    @paddle.jit.to_static
+    def f(xs, stop_at):
+        total = paddle.to_tensor(np.float32(0.0))
+        for row in xs:
+            if paddle.sum(row) > stop_at:
+                break
+            total = total + paddle.sum(row)
+        return total
+
+    xs = _t([[1.0], [2.0], [10.0], [3.0]])
+    out = f(xs, _t(5.0))
+    np.testing.assert_allclose(out.numpy(), 3.0)  # 1+2, stop before 10
+
+
+def test_for_continue_python_range_unchanged():
+    @paddle.jit.to_static
+    def f(x):
+        s = x * 0.0
+        for i in range(5):
+            if i % 2 == 1:
+                continue
+            s = s + x
+        return s
+
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])  # i=0,2,4
+
+
+def test_nested_loop_break_is_local():
+    def f(x):
+        total = paddle.to_tensor(np.float32(0.0))
+        i = paddle.to_tensor(np.float32(0.0))
+        j = paddle.to_tensor(np.float32(0.0))  # carried: pre-loop binding
+        while i < 3.0:
+            j = j * 0.0  # reset each outer iteration
+            while j < 10.0:
+                if j >= 2.0:
+                    break  # inner only
+                total = total + x
+                j = j + 1.0
+            i = i + 1.0
+        return total
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t(1.0)).numpy(), 6.0)  # 3 outer * 2 inner
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(_t(1.0)).numpy(), 6.0)
+
+
+# ---- review regressions: break/continue edge cases ----
+
+def test_break_plus_return_stays_plain_python():
+    """A loop with both break and return falls back to plain Python
+    without half-lowered flags (review finding: NameError)."""
+
+    def f(x, n):
+        i = 0.0
+        while i < n:
+            if i >= 2.0:
+                break
+            if i < -1.0:
+                return x * 0.0
+            i = i + 1.0
+        return x + i
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t([1.0]), 10.0).numpy(), [3.0])
+
+
+def test_break_inside_with_block_guards_following_stmts():
+    """Statements after a break inside `with` must not run in the
+    breaking iteration (review finding: guard missed With bodies)."""
+    import contextlib
+
+    def f(x):
+        total = paddle.to_tensor(np.float32(0.0))
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 5.0:
+            with contextlib.nullcontext():
+                if i >= 1.0:
+                    break
+                total = total + x
+            i = i + 1.0
+        return total
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t(1.0)).numpy(), 1.0)
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(_t(1.0)).numpy(), 1.0)
+
+
+def test_break_terminates_infinite_generator():
+    """The plain-iterable branch must stop at break, not drain the
+    iterator (review finding: infinite generators hung)."""
+    import itertools
+
+    def f(x):
+        s = x * 0.0
+        for i in itertools.count():
+            if i >= 3:
+                break
+            s = s + x
+        return s
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t([2.0])).numpy(), [6.0])
+
+
+def test_tensor_range_break_exits_early():
+    """Traced range loops AND the break flag into the while condition:
+    iteration count is the break point, not the full range."""
+    calls = []
+
+    def probe(v):
+        calls.append(1)
+        return v
+
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0.0
+        for i in range(n):
+            if paddle.cast(i, "float32") >= 2.0:
+                break
+            s = s + x
+        return s
+
+    out = f(_t([1.0]), paddle.to_tensor(np.int32(1000)))
+    np.testing.assert_allclose(out.numpy(), [2.0])
