@@ -315,11 +315,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             if isinstance(sub, (ast.Break, ast.Continue, ast.Yield,
                                 ast.YieldFrom)):
                 if bflag is not None:
+                    # the loop stays plain Python but its own break was
+                    # already lowered: restore the exit path with a real
+                    # `if flag: break` at iteration end (the remaining
+                    # statements of the breaking iteration are already
+                    # guarded no-ops, so semantics match)
                     inits = [
                         ast.fix_missing_locations(
                             ast.copy_location(st, node))
                         for st in ast.parse(
                             f"{bflag} = False\n{cflag} = False").body]
+                    tail = ast.parse(f"if {bflag}:\n    break").body[0]
+                    node.body.append(ast.fix_missing_locations(
+                        ast.copy_location(tail, node)))
                     return inits + [node]
                 return node
         uid = self._uid()

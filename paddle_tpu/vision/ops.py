@@ -1,0 +1,320 @@
+"""paddle.vision.ops — detection op family.
+
+Reference parity: operators/detection/ (roi_align_op.cc, multiclass_nms_op.cc,
+yolo_box_op.cc, prior_box_op.cc, box_coder_op.cc, iou_similarity_op.cc) via
+the python/paddle/vision/ops.py surface.  TPU-native design: every op is
+static-shape dataflow — NMS returns a fixed-size keep vector padded with -1
+plus a count (XLA has no dynamic result shapes; the reference's
+variable-length LoD output maps to pad+count, SURVEY §7.3 LoD row), and the
+O(n^2) IoU matrix + greedy suppression run as one fori_loop on device.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op
+from ..core.tensor import Tensor
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---- IoU ----
+
+def _iou_matrix(a, b):
+    """a: [M,4], b: [N,4] xyxy -> [M,N] IoU."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def iou_similarity(x, y, name=None):
+    """Ref: iou_similarity_op.cc."""
+    return apply_op("iou_similarity", _iou_matrix, (x, y), {})
+
+
+# ---- RoI align ----
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Ref: roi_align_op.cc.  x: [N,C,H,W]; boxes: [R,4] xyxy in input
+    coords; boxes_num: [N] rois per image.  Bilinear-sampled [R,C,oh,ow]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    bn = np.asarray(_raw(boxes_num)).astype(np.int64)
+    # roi -> image index (static: boxes_num is host data, like the
+    # reference's LoD offsets)
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def fn(xv, bv):
+        N, C, H, W = xv.shape
+        off = 0.5 if aligned else 0.0
+
+        def one_roi(box, img):
+            x1, y1, x2, y2 = (box * spatial_scale) - off
+            rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+            rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+            bin_h, bin_w = rh / oh, rw / ow
+            # sr x sr sample points per bin
+            iy = (jnp.arange(oh)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                  / sr)  # [oh, sr]
+            ix = (jnp.arange(ow)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                  / sr)
+            ys = y1 + iy * bin_h  # [oh, sr]
+            xs = x1 + ix * bin_w  # [ow, sr]
+
+            def bilinear(yy, xx):
+                yy = jnp.clip(yy, 0.0, H - 1.0)
+                xx = jnp.clip(xx, 0.0, W - 1.0)
+                y0 = jnp.floor(yy).astype(jnp.int32)
+                x0 = jnp.floor(xx).astype(jnp.int32)
+                y1i = jnp.minimum(y0 + 1, H - 1)
+                x1i = jnp.minimum(x0 + 1, W - 1)
+                ly, lx = yy - y0, xx - x0
+                feat = xv[img]  # [C,H,W]
+                v00 = feat[:, y0, x0]
+                v01 = feat[:, y0, x1i]
+                v10 = feat[:, y1i, x0]
+                v11 = feat[:, y1i, x1i]
+                return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+                        + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+            # all sample points: [oh*sr] x [ow*sr] grid
+            yy = ys.reshape(-1)  # [oh*sr]
+            xx = xs.reshape(-1)  # [ow*sr]
+            grid_y = jnp.repeat(yy, xx.shape[0])
+            grid_x = jnp.tile(xx, yy.shape[0])
+            vals = bilinear(grid_y, grid_x)  # [C, oh*sr*ow*sr]
+            vals = vals.reshape(-1, oh, sr, ow, sr)
+            return vals.mean(axis=(2, 4))  # [C, oh, ow]
+
+        return jax.vmap(one_roi)(bv, jnp.asarray(img_idx))
+
+    return apply_op("roi_align", fn, (x, boxes), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Ref: roi_pool_op.cc — max-pooled variant via dense sampling."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = np.asarray(_raw(boxes_num)).astype(np.int64)
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def fn(xv, bv):
+        N, C, H, W = xv.shape
+
+        def one_roi(box, img):
+            x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            # sample a dense fixed grid inside each bin and max-reduce
+            # (static-shape stand-in for the exact integer bin walk)
+            S = 4
+            iy = y1 + (jnp.arange(oh * S) + 0.5) / S * (rh / oh)
+            ix = x1 + (jnp.arange(ow * S) + 0.5) / S * (rw / ow)
+            yi = jnp.clip(iy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(ix.astype(jnp.int32), 0, W - 1)
+            feat = xv[img][:, yi][:, :, xi]  # [C, oh*S, ow*S]
+            return feat.reshape(-1, oh, S, ow, S).max(axis=(2, 4))
+
+        return jax.vmap(one_roi)(bv, jnp.asarray(img_idx))
+
+    return apply_op("roi_pool", fn, (x, boxes), {})
+
+
+# ---- NMS ----
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Ref: multiclass_nms_op.cc greedy suppression.  Returns keep indices
+    sorted by score, padded with -1 to the input length (static shape; the
+    reference's variable-length output maps to pad+count)."""
+    n = int(_raw(boxes).shape[0])
+
+    def fn(bv, *sv):
+        scores_v = sv[0] if sv else jnp.arange(n, 0, -1).astype(jnp.float32)
+        if category_idxs is not None:
+            # offset boxes per category so cross-category IoU is 0
+            cat = jnp.asarray(_raw(category_idxs)).astype(jnp.float32)
+            span = jnp.max(bv) - jnp.min(bv) + 1.0
+            bv = bv + (cat * span)[:, None]
+        order = jnp.argsort(-scores_v)
+        b_sorted = bv[order]
+        iou = _iou_matrix(b_sorted, b_sorted)
+
+        def body(i, keep):
+            # suppress i if any higher-scored kept box overlaps too much
+            sup = jnp.any((jnp.arange(n) < i) & keep
+                          & (iou[i] > iou_threshold))
+            return keep.at[i].set(~sup)
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+        kept_sorted = jnp.where(keep, order, -1)
+        # stable-compact: kept first (by score), -1 padding after
+        rank = jnp.argsort(~keep, stable=True)
+        return kept_sorted[rank]
+
+    args = (boxes,) + ((scores,) if scores is not None else ())
+    out = apply_op("nms", fn, args, {})
+    if top_k is not None:
+        from ..ops.manipulation import slice as _slice
+
+        out = _slice(out, [0], [0], [top_k])
+    return out
+
+
+# ---- YOLO box decoding ----
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Ref: yolo_box_op.cc.  x: [N, len(anchors)/2*(5+class_num), H, W];
+    img_size: [N,2] (h,w).  Returns (boxes [N,HW*A,4], scores
+    [N,HW*A,class_num])."""
+    na = len(anchors) // 2
+
+    def fn(xv, imgs):
+        N, _, H, W = xv.shape
+        pred = xv.reshape(N, na, 5 + class_num, H, W)
+        gx = jnp.arange(W).reshape(1, 1, 1, W)
+        gy = jnp.arange(H).reshape(1, 1, H, 1)
+        sx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        sy = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        bx = (sx + gx) / W
+        by = (sy + gy) / H
+        aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+        ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+        input_w = W * downsample_ratio
+        input_h = H * downsample_ratio
+        bw = jnp.exp(pred[:, :, 2]) * aw / input_w
+        bh = jnp.exp(pred[:, :, 3]) * ah / input_h
+        conf = jax.nn.sigmoid(pred[:, :, 4])
+        probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+        # below conf_thresh: box zeroed (reference semantics)
+        mask = (conf >= conf_thresh).astype(xv.dtype)
+        imh = imgs[:, 0].reshape(N, 1, 1, 1).astype(xv.dtype)
+        imw = imgs[:, 1].reshape(N, 1, 1, 1).astype(xv.dtype)
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        # boxes already carry coords LAST ([N,na,H,W,4]); only probs
+        # ([N,na,C,H,W]) needs its class axis moved to the end
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * mask[..., None]
+        boxes = boxes.reshape(N, -1, 4)
+        scores = (probs * mask[:, :, None]).transpose(
+            0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        return boxes, scores
+
+    return apply_op("yolo_box", fn, (x, img_size), {})
+
+
+# ---- SSD prior boxes ----
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """Ref: prior_box_op.cc.  Returns (boxes [H,W,P,4], variances same)."""
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+
+    def fn(inp, img):
+        H, W = inp.shape[2], inp.shape[3]
+        IH, IW = img.shape[2], img.shape[3]
+        step_h = steps[1] if steps[1] > 0 else IH / H
+        step_w = steps[0] if steps[0] > 0 else IW / W
+        cy = (jnp.arange(H) + offset) * step_h
+        cx = (jnp.arange(W) + offset) * step_w
+        whs = []
+        for ms in min_sizes:
+            whs.append((ms, ms))
+            for a in ars:
+                if abs(a - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        whs = jnp.asarray(whs, jnp.float32)  # [P,2]
+        P = whs.shape[0]
+        cxg = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+        cyg = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+        w2 = whs[:, 0][None, None, :] / 2.0
+        h2 = whs[:, 1][None, None, :] / 2.0
+        out = jnp.stack([(cxg - w2) / IW, (cyg - h2) / IH,
+                         (cxg + w2) / IW, (cyg + h2) / IH], axis=-1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               out.shape)
+        return out, var
+
+    return apply_op("prior_box", fn, (input, image), {})
+
+
+# ---- box coder ----
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Ref: box_coder_op.cc — encode targets against priors or decode
+    offsets back to boxes.  prior_box_var may be a per-box [M,4] tensor
+    or a 4-element broadcast list; for decode, target_box may be
+    [N,M,4] with priors broadcast along `axis` (0 or 1)."""
+    norm = 0.0 if box_normalized else 1.0
+    if isinstance(prior_box_var, (list, tuple)):
+        prior_box_var = Tensor(np.asarray(prior_box_var, np.float32))
+
+    def fn(pb, pbv, tb):
+        if pbv.ndim == 1:
+            pbv = jnp.broadcast_to(pbv, pb.shape)
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            dx = (tcx - pcx) / pw / pbv[:, 0]
+            dy = (tcy - pcy) / ph / pbv[:, 1]
+            dw = jnp.log(tw / pw) / pbv[:, 2]
+            dh = jnp.log(th / ph) / pbv[:, 3]
+            return jnp.stack([dx, dy, dw, dh], axis=-1)
+        # decode: `axis` IS the broadcast dim of target_box (reference:
+        # axis=0 + TargetBox [N,M,4] + PriorBox [M,4] -> expand dim 0)
+        if tb.ndim == 3:
+            exp = axis
+            pw_, ph_ = jnp.expand_dims(pw, exp), jnp.expand_dims(ph, exp)
+            pcx_, pcy_ = jnp.expand_dims(pcx, exp), jnp.expand_dims(pcy, exp)
+            pbv_ = jnp.expand_dims(pbv, exp)
+        else:
+            pw_, ph_, pcx_, pcy_, pbv_ = pw, ph, pcx, pcy, pbv
+        dcx = pbv_[..., 0] * tb[..., 0] * pw_ + pcx_
+        dcy = pbv_[..., 1] * tb[..., 1] * ph_ + pcy_
+        dw = jnp.exp(pbv_[..., 2] * tb[..., 2]) * pw_
+        dh = jnp.exp(pbv_[..., 3] * tb[..., 3]) * ph_
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - norm, dcy + dh / 2 - norm],
+                         axis=-1)
+
+    return apply_op("box_coder", fn, (prior_box, prior_box_var, target_box),
+                    {})

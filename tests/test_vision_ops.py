@@ -1,0 +1,150 @@
+"""Detection op family (operators/detection/ parity via paddle.vision.ops):
+roi_align, roi_pool, nms, yolo_box, prior_box, box_coder, iou_similarity.
+Oracles are hand-computed numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _t(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+def test_iou_similarity():
+    a = _t([[0, 0, 2, 2], [0, 0, 1, 1]])
+    b = _t([[1, 1, 3, 3], [0, 0, 2, 2]])
+    iou = V.iou_similarity(a, b).numpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(iou[1, 1], 0.25, rtol=1e-6)
+
+
+def test_roi_align_identity_box():
+    """A box covering exactly one 2x2 region pools to its bilinear mean."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = _t([[0.0, 0.0, 4.0, 4.0]])
+    out = V.roi_align(_t(x), boxes, _t([1], np.int64), output_size=2,
+                      spatial_scale=1.0, sampling_ratio=2, aligned=False)
+    assert tuple(out.shape) == (1, 1, 2, 2)
+    # each output bin averages its quadrant's bilinear samples; with the
+    # full box the 4 bins are ordered TL<TR<BL<BR
+    o = out.numpy()[0, 0]
+    assert o[0, 0] < o[0, 1] < o[1, 0] < o[1, 1]
+
+
+def test_roi_align_grads_flow():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32),
+        stop_gradient=False)
+    boxes = _t([[1.0, 1.0, 6.0, 6.0], [0.0, 0.0, 4.0, 4.0]])
+    out = V.roi_align(x, boxes, _t([2], np.int64), output_size=3)
+    paddle.sum(out).backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_roi_pool_shape():
+    x = _t(np.random.RandomState(0).rand(2, 3, 8, 8))
+    boxes = _t([[0, 0, 4, 4], [2, 2, 7, 7], [1, 1, 5, 5]])
+    out = V.roi_pool(x, boxes, _t([2, 1], np.int64), output_size=2)
+    assert tuple(out.shape) == (3, 3, 2, 2)
+
+
+def test_nms_greedy_suppression():
+    boxes = _t([[0, 0, 10, 10],      # kept (best score)
+                [1, 1, 10.5, 10.5],  # IoU with #0 high -> suppressed
+                [20, 20, 30, 30],    # kept
+                [0, 0, 10, 10]])     # duplicate of #0 -> suppressed
+    scores = _t([0.9, 0.8, 0.7, 0.6])
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+    assert list(keep[:2]) == [0, 2]
+    assert list(keep[2:]) == [-1, -1]
+
+
+def test_nms_per_category():
+    boxes = _t([[0, 0, 10, 10], [0, 0, 10, 10]])
+    scores = _t([0.9, 0.8])
+    cats = paddle.to_tensor(np.array([0, 1], np.int64))
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores,
+                 category_idxs=cats, categories=[0, 1]).numpy()
+    # same box, different categories: both survive
+    assert set(keep.tolist()) == {0, 1}
+
+
+def test_yolo_box_decodes():
+    np.random.seed(0)
+    N, na, C, H, W = 1, 2, 3, 2, 2
+    x = _t(np.random.randn(N, na * (5 + C), H, W))
+    img = paddle.to_tensor(np.array([[64, 64]], np.int32))
+    boxes, scores = V.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                               class_num=C, conf_thresh=0.0,
+                               downsample_ratio=32)
+    assert tuple(boxes.shape) == (1, na * H * W, 4)
+    assert tuple(scores.shape) == (1, na * H * W, C)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 63).all()  # clipped to image
+    assert (scores.numpy() >= 0).all() and (scores.numpy() <= 1).all()
+
+
+def test_prior_box_ssd_anchors():
+    feat = _t(np.zeros((1, 8, 2, 2)))
+    img = _t(np.zeros((1, 3, 64, 64)))
+    boxes, var = V.prior_box(feat, img, min_sizes=[16.0],
+                             aspect_ratios=[1.0, 2.0], clip=True)
+    # P = 1 (min) + 1 (ar=2)
+    assert tuple(boxes.shape) == (2, 2, 2, 4)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    # center of cell (0,0) is at offset*step = 16 -> normalized 0.25
+    ms = b[0, 0, 0]
+    np.testing.assert_allclose((ms[0] + ms[2]) / 2, 0.25, rtol=1e-5)
+    np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_roundtrip():
+    priors = _t([[10, 10, 30, 30], [5, 5, 15, 25]])
+    pvar = _t([[0.1, 0.1, 0.2, 0.2]] * 2)
+    targets = _t([[12, 8, 33, 35], [4, 6, 17, 21]])
+    enc = V.box_coder(priors, pvar, targets, code_type="encode_center_size")
+    dec = V.box_coder(priors, pvar, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), targets.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_yolo_box_coordinate_layout():
+    """Review repro: each row of `boxes` must be one (x1,y1,x2,y2) box
+    matching its score row, not coordinates scrambled across cells."""
+    N, na, C, H, W = 1, 1, 1, 2, 2
+    x = np.zeros((N, na * (5 + C), H, W), np.float32)
+    # cell (0,0): centered box, high conf; everything else stays low conf
+    x[0, 4, :, :] = -20.0   # conf ~ 0 everywhere...
+    x[0, 4, 0, 0] = 20.0    # ...except cell (0,0)
+    img = paddle.to_tensor(np.array([[64, 64]], np.int32))
+    boxes, scores = V.yolo_box(_t(x), img, anchors=[16, 16], class_num=C,
+                               conf_thresh=0.5, downsample_ratio=32)
+    b = boxes.numpy()[0]
+    # only the first cell row is nonzero, and it is a valid box around
+    # the cell center (sigmoid(0)=0.5 -> center at (0.25, 0.25)*64 = 16)
+    assert np.abs(b[1:]).sum() == 0
+    x1, y1, x2, y2 = b[0]
+    assert x1 < 16 < x2 and y1 < 16 < y2
+    np.testing.assert_allclose((x1 + x2) / 2, 16.0, atol=1e-4)
+    np.testing.assert_allclose(x2 - x1, 16.0, atol=1e-4)  # anchor/input*img
+
+
+def test_box_coder_list_var_and_axis():
+    priors = _t([[10, 10, 30, 30], [5, 5, 15, 25]])
+    targets = _t([[12, 8, 33, 35], [4, 6, 17, 21]])
+    enc = V.box_coder(priors, [0.1, 0.1, 0.2, 0.2], targets,
+                      code_type="encode_center_size")
+    dec = V.box_coder(priors, [0.1, 0.1, 0.2, 0.2], enc,
+                      code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), targets.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    # batched decode with priors broadcast along axis 0: [N=3, M=2, 4]
+    enc3 = paddle.to_tensor(np.stack([enc.numpy()] * 3))
+    dec3 = V.box_coder(priors, [0.1, 0.1, 0.2, 0.2], enc3,
+                       code_type="decode_center_size", axis=0)
+    assert tuple(dec3.shape) == (3, 2, 4)
+    np.testing.assert_allclose(dec3.numpy()[1], targets.numpy(), rtol=1e-4,
+                               atol=1e-4)
