@@ -1,0 +1,255 @@
+"""paddle._C_ops parity: one callable per reference registry op name.
+
+Reference: pybind/op_function_generator.cc:254-519 code-generates a C fast
+path `core.ops.<op_type>` for every registered operator at BUILD time;
+python/paddle/_C_ops.py:20 re-exports them.  Dygraph functional APIs call
+these names directly.
+
+TPU-native analogue: ops are already Python (pure-jax kernels dispatched
+through core.registry.apply_op), so the "generated" surface is a binding
+table from canonical reference op names -> our public implementations.
+Names the reference spells differently (reshape2, lookup_table_v2, ...)
+alias the same callables.  Ops that are intentionally absent raise with
+the ABSENT.md rationale instead of AttributeError, so callers get a
+actionable error.
+
+The table is also the coverage manifest the op-surface test audits
+(tests/test_c_ops_surface.py): every name here must resolve to a real
+callable.
+"""
+import importlib
+
+import paddle_tpu
+
+_ALIASES = {
+    # canonical reference name -> attribute path under paddle_tpu
+    "abs": "abs", "acos": "acos", "acosh": "acosh", "addmm": "addmm",
+    "affine_channel": "affine_channel", "affine_grid": "nn.functional.affine_grid",
+    "add_position_encoding": "add_position_encoding",
+    "allclose": "allclose", "arg_max": "argmax", "arg_min": "argmin",
+    "argsort": "argsort", "asin": "asin", "asinh": "asinh",
+    "atanh": "atanh", "assign": "assign",
+    "assign_value": "assign_value", "atan": "atan", "atan2": "atan2",
+    "batch_norm": "nn.functional.batch_norm", "bce_loss": "nn.functional.binary_cross_entropy",
+    "beam_search": "beam_search", "beam_search_decode": "beam_search_decode",
+    "bernoulli": "bernoulli", "bilinear_tensor_product": "bilinear_tensor_product",
+    "bitwise_and": "bitwise_and", "bitwise_not": "bitwise_not",
+    "bitwise_or": "bitwise_or", "bitwise_xor": "bitwise_xor",
+    "bmm": "bmm", "bpr_loss": "bpr_loss",
+    "broadcast_tensors": "broadcast_tensors", "cast": "cast",
+    "ceil": "ceil", "center_loss": "center_loss", "cholesky": "cholesky",
+    "chunk_eval": "chunk_eval", "clip": "clip",
+    "clip_by_norm": "clip_by_norm", "coalesce_tensor": "coalesce_tensor",
+    "concat": "concat", "conj": "conj", "conv2d": "nn.functional.conv2d",
+    "conv3d": "nn.functional.conv3d", "conv2d_transpose": "nn.functional.conv2d_transpose",
+    "conv3d_transpose": "nn.functional.conv3d_transpose",
+    "conv_shift": "conv_shift", "cos": "cos", "cos_sim": "cos_sim",
+    "cosh": "cosh", "crf_decoding": "crf_decoding", "crop": "crop",
+    "crop_tensor": "crop", "cross": "cross",
+    "cross_entropy": "nn.functional.cross_entropy",
+    "ctc_align": "ctc_align", "cumprod": "cumprod", "cumsum": "cumsum",
+    "cvm": "cvm", "data_norm": "data_norm",
+    "deformable_conv": "deformable_conv",
+    "deformable_conv_v1": "deformable_conv",
+    "diag": "diag", "diag_v2": "diag", "diag_embed": "nn.functional.diag_embed",
+    "diagonal": "diagonal", "digamma": "digamma", "dist": "dist",
+    "dot": "dot", "dropout": "nn.functional.dropout",
+    "edit_distance": "edit_distance",
+    "elementwise_add": "elementwise_add", "elementwise_div": "elementwise_div",
+    "elementwise_floordiv": "floor_divide", "elementwise_max": "maximum",
+    "elementwise_min": "minimum", "elementwise_mod": "remainder",
+    "elementwise_mul": "elementwise_mul", "elementwise_pow": "pow",
+    "elementwise_sub": "elementwise_sub", "elu": "nn.functional.elu",
+    "empty": "empty", "equal": "equal", "equal_all": "equal_all",
+    "erf": "erf", "exp": "exp", "expand_v2": "expand",
+    "expand_as_v2": "expand_as", "expm1": "expm1", "eye": "eye",
+    "fill_any_like": "full_like", "fill_constant": "full",
+    "fill_constant_batch_size_like": "full",
+    "fill_zeros_like": "zeros_like", "flatten2": "flatten",
+    "flatten_contiguous_range": "flatten", "flip": "flip",
+    "floor": "floor", "fsp": "fsp_matrix",
+    "fused_softmax_mask_upper_triangle": "softmax_mask_fuse_upper_triangle",
+    "gather": "gather", "gather_nd": "gather_nd",
+    "gather_tree": "nn.functional.gather_tree",
+    "gaussian_random": "normal",
+    "gaussian_random_batch_size_like": "gaussian_random_batch_size_like",
+    "gelu": "nn.functional.gelu", "grid_sampler": "nn.functional.grid_sample",
+    "greater_equal": "greater_equal", "greater_than": "greater_than",
+    "group_norm": "nn.functional.group_norm", "hard_sigmoid": "nn.functional.hardsigmoid",
+    "hard_swish": "nn.functional.hardswish", "hard_tanh": "nn.functional.hardtanh",
+    "hierarchical_sigmoid": "nn.functional.hsigmoid_loss",
+    "hinge_loss": "nn.functional.hinge_loss", "histogram": "histogram",
+    "huber_loss": "huber_loss", "im2sequence": "im2sequence",
+    "imag": "imag", "increment": "increment", "index_sample": "index_sample",
+    "index_select": "index_select", "instance_norm": "nn.functional.instance_norm",
+    "interpolate": "nn.functional.interpolate",
+    "interpolate_v2": "nn.functional.interpolate",
+    "inverse": "inverse", "isfinite_v2": "isfinite", "isinf_v2": "isinf",
+    "isnan_v2": "isnan", "kldiv_loss": "nn.functional.kl_div", "kron": "kron",
+    "l1_norm": "l1_norm", "label_smooth": "nn.functional.label_smooth",
+    "layer_norm": "nn.functional.layer_norm", "leaky_relu": "nn.functional.leaky_relu",
+    "lerp": "lerp", "less_equal": "less_equal", "less_than": "less_than",
+    "lgamma": "lgamma", "linear_chain_crf": "linear_chain_crf",
+    "linspace": "linspace", "log": "log", "log10": "log10",
+    "log1p": "log1p", "log2": "log2", "log_loss": "nn.functional.log_loss",
+    "log_softmax": "nn.functional.log_softmax",
+    "logical_and": "logical_and", "logical_not": "logical_not",
+    "logical_or": "logical_or", "logical_xor": "logical_xor",
+    "logsumexp": "logsumexp", "lookup_table": "nn.functional.embedding",
+    "lookup_table_v2": "nn.functional.embedding",
+    "lrn": "nn.functional.local_response_norm",
+    "margin_rank_loss": "nn.functional.margin_ranking_loss",
+    "masked_select": "masked_select", "matmul": "matmul",
+    "matmul_v2": "matmul", "maxout": "nn.functional.maxout",
+    "mean": "mean", "mean_iou": "mean_iou", "memcpy": "memcpy",
+    "merge_selected_rows": "merge_selected_rows", "meshgrid": "meshgrid",
+    "mish": "nn.functional.mish", "modified_huber_loss": "modified_huber_loss",
+    "mul": "matmul", "multinomial": "multinomial", "multiplex": "multiplex",
+    "mv": "mv", "nce": "nce", "nll_loss": "nn.functional.nll_loss",
+    "norm": "nn.functional.normalize", "not_equal": "not_equal",
+    "one_hot": "nn.functional.one_hot", "one_hot_v2": "nn.functional.one_hot",
+    "p_norm": "norm", "pad": "nn.functional.pad", "pad2d": "nn.functional.pad",
+    "pad3d": "nn.functional.pad", "pad_constant_like": "pad_constant_like",
+    "partial_concat": "partial_concat", "partial_sum": "partial_sum",
+    "pixel_shuffle": "nn.functional.pixel_shuffle",
+    "pool2d": "nn.functional.max_pool2d", "pool3d": "nn.functional.max_pool3d",
+    "pool2d_avg": "nn.functional.avg_pool2d",
+    "max_pool2d_with_index": "max_pool2d_with_index",
+    "positive_negative_pair": "positive_negative_pair",
+    "prelu": "nn.functional.prelu", "prroi_pool": "prroi_pool",
+    "psroi_pool": "psroi_pool", "py_func": "py_func",
+    "randint": "randint", "random_crop": "random_crop",
+    "randperm": "randperm", "range": "arange", "rank_loss": "rank_loss",
+    "real": "real", "reciprocal": "reciprocal",
+    "reduce_all": "all", "reduce_any": "any", "reduce_max": "amax",
+    "reduce_mean": "mean", "reduce_min": "amin", "reduce_prod": "prod",
+    "reduce_sum": "sum", "relu": "nn.functional.relu",
+    "relu6": "nn.functional.relu6", "reshape2": "reshape",
+    "reverse": "reverse", "roi_align": "vision.ops.roi_align",
+    "roi_pool": "vision.ops.roi_pool", "roll": "roll",
+    "row_conv": "row_conv", "rsqrt": "rsqrt", "sample_logits": "sample_logits",
+    "sampling_id": "sampling_id", "scale": "scale", "scatter": "scatter",
+    "scatter_nd_add": "scatter_nd_add", "seed": "seed",
+    "segment_pool": "segment_pool", "selu": "nn.functional.selu",
+    "sequence_conv": "sequence_conv", "sequence_expand": "sequence_expand",
+    "sequence_mask": "nn.functional.sequence_mask",
+    "sequence_pad": "sequence_pad", "sequence_pool": "sequence_pool",
+    "sequence_reverse": "sequence_reverse",
+    "sequence_softmax": "sequence_softmax", "sequence_unpad": "sequence_unpad",
+    "shape": "shape", "shard_index": "shard_index",
+    "share_data": "share_data", "shuffle_channel": "shuffle_channel",
+    "sigmoid": "nn.functional.sigmoid",
+    "sigmoid_cross_entropy_with_logits":
+        "nn.functional.binary_cross_entropy_with_logits",
+    "sign": "sign", "sin": "sin", "sinh": "sinh", "size": "size",
+    "slice": "slice", "smooth_l1_loss": "nn.functional.smooth_l1_loss",
+    "softmax": "nn.functional.softmax",
+    "softmax_with_cross_entropy": "nn.functional.softmax_with_cross_entropy",
+    "softplus": "nn.functional.softplus", "softshrink": "nn.functional.softshrink",
+    "softsign": "nn.functional.softsign", "space_to_depth": "space_to_depth",
+    "spectral_norm": "ops.nn_extra.spectral_norm_apply",
+    "split": "split", "spp": "spp", "sqrt": "sqrt", "square": "square",
+    "squared_l2_distance": "squared_l2_distance",
+    "squared_l2_norm": "squared_l2_norm", "squeeze2": "squeeze",
+    "stack": "stack", "stanh": "stanh", "strided_slice": "strided_slice",
+    "sum": "add_n", "t": "t", "tan": "tan", "tanh": "tanh",
+    "tanh_shrink": "nn.functional.tanhshrink",
+    "teacher_student_sigmoid_loss": "teacher_student_sigmoid_loss",
+    "temporal_shift": "nn.functional.temporal_shift",
+    "tile": "tile", "top_k": "topk", "top_k_v2": "topk", "trace": "trace",
+    "transpose2": "transpose", "tril_triu": "tril", "trunc": "trunc",
+    "truncated_gaussian_random": "normal", "unbind": "unbind",
+    "unfold": "nn.functional.unfold",
+    "uniform_random": "uniform",
+    "uniform_random_batch_size_like": "uniform_random_batch_size_like",
+    "unique": "unique", "unique_with_counts": "unique_with_counts",
+    "unpool": "max_unpool2d", "unsqueeze2": "unsqueeze",
+    "unstack": "unstack", "warpctc": "nn.functional.ctc_loss",
+    "where": "where", "where_index": "nonzero",
+}
+
+# intentionally-absent reference ops -> one-line rationale (docs/ABSENT.md)
+_ABSENT = {
+    "ascend_trigger": "Ascend NPU backend is out of scope (ABSENT.md)",
+    "pull_box_sparse": "BoxPS CTR embedding service is out of scope",
+    "pull_box_extended_sparse": "BoxPS CTR embedding service is out of scope",
+    "pull_sparse": "pslib sparse-table pull; ps/embedding.py is the analogue",
+    "pull_sparse_v2": "pslib sparse-table pull; ps/embedding.py is the analogue",
+    "push_dense": "pslib dense push; ps/communicator.py is the analogue",
+    "tdm_child": "tree-based deep-match CTR ops are out of scope",
+    "tdm_sampler": "tree-based deep-match CTR ops are out of scope",
+    "pyramid_hash": "pyramid-hash text matching is out of scope",
+    "filter_by_instag": "instag filtering (CTR pipelines) is out of scope",
+    "shuffle_batch": "PS-side batch shuffling; io.dataset shuffles host-side",
+    "rank_attention": "CTR GPU-specific attention is out of scope",
+    "batch_fc": "CTR GPU batched-fc is out of scope",
+    "hash": "CPU murmur-hash embedding trick is out of scope",
+    "lookup_table_dequant": "int8 dequant embedding is out of scope (quant/qat.py covers QAT)",
+    "match_matrix_tensor": "legacy pyramid text-matching op",
+    "var_conv_2d": "legacy pyramid text-matching op",
+    "tree_conv": "tree convolution is out of scope",
+    "bilateral_slice": "HDRNet CUDA op is out of scope",
+    "correlation": "optical-flow correlation CUDA op is out of scope",
+    "inplace_abn": "CUDA in-place activated BN; use batch_norm (XLA fuses)",
+    "attention_lstm": "legacy fused CPU LSTM; nn.LSTM is the path",
+    "lstmp": "projection LSTM fused CPU kernel; compose nn.LSTM + Linear",
+    "fusion_lstm": "legacy fused CPU LSTM",
+    "lod_reset": "LoD lives at the Python boundary (sequence_pad/unpad)",
+    "lod_rank_table": "LoD machinery absent by design (SURVEY §7.3)",
+    "lod_tensor_to_array": "LoD tensor-array machinery absent by design",
+    "array_to_lod_tensor": "LoD tensor-array machinery absent by design",
+    "merge_lod_tensor": "LoD machinery absent by design",
+    "split_lod_tensor": "LoD machinery absent by design",
+    "reorder_lod_tensor_by_rank": "LoD machinery absent by design",
+    "max_sequence_len": "LoD machinery absent by design",
+    "lod_array_length": "LoD machinery absent by design",
+    "shrink_rnn_memory": "dynamic-RNN memory shrink; StaticRNN/lax.scan path",
+    "rnn_memory_helper": "recurrent-op plumbing; StaticRNN/lax.scan path",
+    "copy_cross_scope": "Ascend pipeline scope copy; XLA dataflow instead",
+    "marker": "profiler marker is paddle_tpu.marker (host RecordEvent)",
+    "decode_jpeg": "GPU nvjpeg decode; vision.transforms decodes host-side",
+    "read_file": "raw-bytes file read op; io.dataset reads host-side",
+    "similarity_focus": "legacy attention visualization op",
+    "teacher_student_sigmoid_loss": None,  # implemented — keep out of absent
+    "dgc": "DGC momentum is the fleet dgc meta-optimizer",
+    "dgc_clip_by_norm": "DGC momentum is the fleet dgc meta-optimizer",
+    "dequantize": "MKLDNN int8 path; quant/qat.py fake-quant is the analogue",
+    "requantize": "MKLDNN int8 path",
+    "quantize": "MKLDNN int8 path; quant/qat.py fake-quant is the analogue",
+    "dequantize_abs_max": "int8 inference dequant; quant/qat.py",
+    "dequantize_log": "int8 inference dequant",
+    "get_tensor_from_selected_rows": None,  # implemented
+    "delete_var": "executor GC owns variable lifetime (native planner)",
+    "average_accumulates": None,  # implemented (incubate.ModelAverage)
+}
+_ABSENT = {k: v for k, v in _ABSENT.items() if v is not None}
+
+
+def _resolve(path):
+    obj = paddle_tpu
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def __getattr__(name):
+    if name in _ALIASES:
+        return _resolve(_ALIASES[name])
+    if name in _ABSENT:
+        raise NotImplementedError(
+            f"_C_ops.{name} is intentionally absent: {_ABSENT[name]}")
+    raise AttributeError(f"_C_ops has no op {name!r}")
+
+
+def __dir__():
+    return sorted(_ALIASES)
+
+
+def op_names():
+    """Every canonical reference op name this namespace serves."""
+    return sorted(_ALIASES)
+
+
+def absent_ops():
+    """Reference ops intentionally not served, with rationale."""
+    return dict(_ABSENT)

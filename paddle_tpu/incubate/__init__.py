@@ -1,2 +1,6 @@
 from . import checkpoint  # noqa: F401
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import (  # noqa: F401
+    LookAhead, ModelAverage, ExponentialMovingAverage,
+)

@@ -1,0 +1,195 @@
+"""Wrapper optimizers: LookAhead, ModelAverage, ExponentialMovingAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py (LookAhead:30),
+python/paddle/incubate/optimizer/modelaverage.py (ModelAverage:31, the
+average_accumulates op pair operators/average_accumulates_op.cc), and
+fluid/optimizer.py ExponentialMovingAverage:3345.
+
+TPU-native design: each wrapper keeps host-side slow/accumulator state as
+plain jax arrays keyed by parameter name and applies its update rule
+after the inner optimizer's step() — the same "extra accumulators +
+periodic restore" contract as the reference, without per-op kernels (the
+elementwise updates fuse under jit when used inside a compiled step).
+"""
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: slow weights interpolate toward fast weights every
+    k inner steps (lookahead.py:30; slow_w += alpha*(fast_w - slow_w))."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self._parameters:
+            slow = self._slow.get(p.name)
+            if slow is None:
+                slow = p._data
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[p.name] = slow
+            p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, parameters=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._step_num
+        for k, v in self._slow.items():
+            sd[f"@slow_{k}"] = np.asarray(v)
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_num = int(sd.pop("@lookahead_step", 0))
+        for k in [k for k in sd if k.startswith("@slow_")]:
+            self._slow[k[len("@slow_"):]] = jnp.asarray(sd.pop(k))
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Running parameter average applied at eval time
+    (modelaverage.py:31 / average_accumulates_op.cc).
+
+    Accumulates sum_1/sum_2/sum_3 with the reference's windowed scheme
+    (min_average_window..max_average_window), exposes apply()/restore()
+    context management.
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._parameters = list(parameters or [])
+        self._sum1 = {p.name: jnp.zeros_like(p._data)
+                      for p in self._parameters}
+        self._sum2 = {p.name: jnp.zeros_like(p._data)
+                      for p in self._parameters}
+        self._sum3 = {p.name: jnp.zeros_like(p._data)
+                      for p in self._parameters}
+        self._num_accum = 0     # accumulates since the window last closed
+        self._old_num = 0       # accumulates inside the closed window
+        self._num_updates = 0
+        self._saved = None
+
+    _MAX_FOLD = 16384  # kMaxNumAccumulates (average_accumulates_op.h)
+
+    def accumulate(self):
+        """Record current parameter values — the exact
+        average_accumulates_op.h update rule."""
+        self._num_updates += 1
+        self._num_accum += 1
+        fold = self._num_updates % self._MAX_FOLD == 0
+        close = (self._num_accum >= self.min_w
+                 and self._num_accum >= min(self.max_w,
+                                            self._num_updates * self.rate))
+        for p in self._parameters:
+            n = p.name
+            self._sum1[n] = self._sum1[n] + p._data
+            if fold:
+                self._sum2[n] = self._sum2[n] + self._sum1[n]
+                self._sum1[n] = jnp.zeros_like(p._data)
+            if close:
+                self._sum3[n] = self._sum1[n] + self._sum2[n]
+                self._sum1[n] = jnp.zeros_like(p._data)
+                self._sum2[n] = jnp.zeros_like(p._data)
+        if close:
+            self._old_num = self._num_accum
+            self._num_accum = 0
+
+    # the reference calls accumulate from minimize(); keep both spellings
+    def step(self):
+        self.accumulate()
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap params to their windowed average inside the context."""
+        self._saved = {p.name: p._data for p in self._parameters}
+        total = self._num_accum + self._old_num
+        for p in self._parameters:
+            n = p.name
+            acc = self._sum1[n] + self._sum2[n] + self._sum3[n]
+            if total:
+                p._data = acc / float(total)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._saved:
+            for p in self._parameters:
+                if p.name in self._saved:
+                    p._data = self._saved[p.name]
+            self._saved = None
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters with bias correction
+    (fluid/optimizer.py ExponentialMovingAverage:3345):
+    ema = decay*ema + (1-decay)*param; apply() swaps in
+    ema / (1 - decay^t)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 name=None):
+        self.decay = float(decay)
+        self._parameters = list(parameters or [])
+        # zero-init accumulator: the bias correction in apply() divides by
+        # (1 - decay^t), which only de-biases a ZERO start (the reference's
+        # scheme); seeding with live params would inflate applied weights
+        # by decay^t/(1-decay^t) * p0
+        self._ema = {p.name: jnp.zeros_like(p._data)
+                     for p in self._parameters}
+        self._t = 0
+        self._saved = None
+
+    def update(self):
+        self._t += 1
+        d = self.decay
+        for p in self._parameters:
+            n = p.name
+            self._ema[n] = d * self._ema[n] + (1.0 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._saved = {p.name: p._data for p in self._parameters}
+        corr = 1.0 - self.decay ** max(self._t, 1)
+        for p in self._parameters:
+            p._data = self._ema[p.name] / corr
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._saved:
+            for p in self._parameters:
+                if p.name in self._saved:
+                    p._data = self._saved[p.name]
+            self._saved = None

@@ -10,6 +10,10 @@ from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .nn_ops import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .loss_extra import *  # noqa: F401,F403
+from .sequence_ops import *  # noqa: F401,F403
+from .vision_extra import *  # noqa: F401,F403
+from .framework_ops import *  # noqa: F401,F403
 
 from .creation import assign, full, zeros, ones, arange  # noqa: F401
 from .math import (  # noqa: F401

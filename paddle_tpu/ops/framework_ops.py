@@ -1,0 +1,227 @@
+"""Framework-glue ops: identity/copy markers, fused buffers, queues,
+sparse-rows conversions, host callbacks.
+
+Reference: operators/assign_value_op.cc, memcpy_op.cc, share_data_op.cc,
+nop_op.cc / marker_op.cc, coalesce_tensor_op.cc (fused flat grad buffer),
+operators/controlflow/op variants enqueue/dequeue + queue_generator_op.cc,
+merge_selected_rows_op.cc, get_tensor_from_selected_rows_op.cc,
+py_func_op.cc (python-callback op), size_op.cc.
+
+TPU-native notes: memcpy/share_data are true no-ops under XLA (PJRT owns
+placement; the executor's donation plan does buffer reuse), but they are
+registered so program rewrites and serialized descs round-trip.  The
+queue ops bind the native C++ prefetch queue (native/src/queue.cc).
+py_func lowers to jax.pure_callback so it stays usable inside jit.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op, register_op
+from ..core.tensor import Tensor, to_tensor
+from ..core.indexed_slices import IndexedSlices
+
+__all__ = [
+    "assign_value", "size", "numel_op", "memcpy", "share_data", "nop",
+    "marker", "coalesce_tensor", "queue_generator", "enqueue", "dequeue",
+    "merge_selected_rows", "get_tensor_from_selected_rows", "py_func",
+]
+
+
+def assign_value(shape, dtype, values, name=None):
+    """Materialize a host constant (assign_value_op.cc)."""
+    from ..core.dtype import convert_dtype
+
+    arr = np.asarray(values, dtype=convert_dtype(dtype)).reshape(shape)
+    out = to_tensor(arr)
+    out.stop_gradient = True
+    return out
+
+
+def _size(x):
+    return jnp.asarray(int(np.prod(x.shape)) if x.ndim else 1, jnp.int64)
+
+
+register_op("size", _size)
+
+
+def size(x, name=None):
+    """Element count as a 0-d tensor (size_op.cc)."""
+    out = apply_op("size", _size, (x,), {})
+    out.stop_gradient = True
+    return out
+
+
+numel_op = size
+
+
+def _identity(x):
+    return x
+
+
+register_op("memcpy", _identity)
+register_op("share_data", _identity)
+
+
+def memcpy(x, dst_place_type=None, name=None):
+    """Placement copy (memcpy_op.cc).  PJRT owns placement on TPU, so the
+    dataflow value is returned as-is; the op exists for desc parity."""
+    return apply_op("memcpy", _identity, (x,), {})
+
+
+def share_data(x, name=None):
+    """Aliased view (share_data_op.cc); XLA donation handles real aliasing."""
+    return apply_op("share_data", _identity, (x,), {})
+
+
+def nop(*xs):
+    """Scheduling placeholder (nop_op.cc): returns inputs untouched."""
+    return xs if len(xs) != 1 else xs[0]
+
+
+def marker(marker_role="forward", marker_pos="B", name=None):
+    """Profiler marker (marker_op.cc) -> a host RecordEvent span."""
+    from ..profiler import RecordEvent
+
+    ev = RecordEvent(f"marker::{marker_role}::{marker_pos}")
+    ev.__enter__()
+    ev.__exit__(None, None, None)
+
+
+def coalesce_tensor(inputs, dtype=None, name=None):
+    """Fuse tensors into one flat buffer; returns (views, fused)
+    (coalesce_tensor_op.cc — the fused-allreduce grad buffer).  The views
+    are slices of the fused value, so a collective over `fused` is a
+    collective over every input, which is exactly how the compiled DP path
+    fuses its grad psum (parallel/hybrid.py flat pmean)."""
+    sizes = [int(np.prod(t.shape)) for t in inputs]
+    shapes = [tuple(t.shape) for t in inputs]
+
+    def fn(*vals):
+        flat = jnp.concatenate([v.reshape(-1) for v in vals])
+        outs = []
+        off = 0
+        for s, shp in zip(sizes, shapes):
+            outs.append(flat[off:off + s].reshape(shp))
+            off += s
+        return tuple(outs) + (flat,)
+
+    res = apply_op("coalesce_tensor", fn, tuple(inputs), {},
+                   n_outputs=len(inputs) + 1)
+    return list(res[:-1]), res[-1]
+
+
+_QUEUES = {}
+
+
+def queue_generator(names, capacity=2):
+    """Create named native byte queues (queue_generator_op.cc ->
+    native/src/queue.cc)."""
+    from .. import native
+
+    for n in ([names] if isinstance(names, str) else names):
+        if n not in _QUEUES:
+            _QUEUES[n] = native.PrefetchQueue(capacity=capacity)
+    return [_QUEUES[n] for n in
+            ([names] if isinstance(names, str) else names)]
+
+
+def enqueue(x, queue_name, timeout_ms=-1):
+    """Push a tensor's host bytes into a named queue (enqueue op)."""
+    import pickle
+
+    q = _QUEUES[queue_name]
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    payload = pickle.dumps((arr.dtype.str, arr.shape, arr.tobytes()),
+                           protocol=4)
+    return q.push(payload, timeout_ms=timeout_ms)
+
+
+def dequeue(queue_name, timeout_ms=-1):
+    """Pop a tensor from a named queue (dequeue op)."""
+    import pickle
+
+    q = _QUEUES[queue_name]
+    payload = q.pop(timeout_ms=timeout_ms)
+    if payload is None:
+        return None
+    dt, shape, raw = pickle.loads(payload)
+    out = to_tensor(np.frombuffer(raw, dtype=np.dtype(dt)).reshape(shape)
+                    .copy())
+    out.stop_gradient = True
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """Coalesce duplicate rows of an IndexedSlices by summation
+    (merge_selected_rows_op.cc)."""
+    if not isinstance(x, IndexedSlices):
+        raise TypeError("merge_selected_rows expects IndexedSlices")
+    uniq, summed = x.coalesce()
+    return IndexedSlices(uniq, summed, x.dense_shape)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """Densify an IndexedSlices (get_tensor_from_selected_rows_op.cc)."""
+    if not isinstance(x, IndexedSlices):
+        raise TypeError("get_tensor_from_selected_rows expects IndexedSlices")
+    return to_tensor(np.asarray(x.to_dense()))
+
+
+def py_func(func, x, out_shapes, out_dtypes, backward_func=None, name=None):
+    """Call arbitrary Python on tensor values (py_func_op.cc).
+
+    Lowered via jax.pure_callback so the op survives jit tracing; an
+    optional backward_func supplies the custom VJP the reference wires
+    through its grad-op maker.  out_shapes/out_dtypes describe the
+    callback results (single spec or lists).
+    """
+    from ..core.dtype import convert_dtype
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    single = not isinstance(out_shapes[0], (list, tuple)) \
+        if out_shapes else True
+    shapes = [out_shapes] if single else list(out_shapes)
+    dtypes = [out_dtypes] if isinstance(out_dtypes, str) else list(out_dtypes)
+    specs = tuple(jax.ShapeDtypeStruct(tuple(s), convert_dtype(d))
+                  for s, d in zip(shapes, dtypes))
+
+    def host(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else (res,)
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, specs))
+
+    if backward_func is None:
+        def fn(*vals):
+            out = jax.pure_callback(host, specs, *vals)
+            return out if len(specs) != 1 else out[0]
+    else:
+        @jax.custom_vjp
+        def _core(*vals):
+            out = jax.pure_callback(host, specs, *vals)
+            return out if len(specs) != 1 else out[0]
+
+        def _fwd(*vals):
+            return _core(*vals), vals
+
+        def _bwd(vals, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                             for v in vals)
+
+            def bhost(*args):
+                n = len(vals)
+                res = backward_func(*[np.asarray(a) for a in args])
+                res = res if isinstance(res, (list, tuple)) else (res,)
+                return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                             for r, s in zip(res, in_specs))
+
+            return jax.pure_callback(bhost, in_specs, *(vals + gs))
+
+        _core.defvjp(_fwd, _bwd)
+        fn = _core
+
+    n_out = len(specs)
+    return apply_op("py_func", fn, tuple(xs), {},
+                    n_outputs=n_out if n_out > 1 else None)

@@ -1,0 +1,469 @@
+"""Loss / similarity / ranking-metric long tail.
+
+Reference: operators/huber_loss_op.h (piecewise quadratic), rank_loss_op.h
+(pairwise logistic), bpr_loss_op.h (Bayesian personalized ranking),
+modified_huber_loss_op.h, teacher_student_sigmoid_loss_op.h (CTR
+distillation, 4-way label encoding), center_loss_op.h (feature-center
+pull + running center update), squared_l2_distance_op.h,
+squared_l2_norm_op.h, l1_norm_op.h, clip_by_norm_op.h, cos_sim_op.h,
+mean_iou_op.h, edit_distance_op.h, ctc_align_op.h,
+positive_negative_pair_op.h, chunk_eval_op.h.
+
+TPU-native design: every differentiable loss is a pure jnp expression
+(grads via jax.vjp); the sequence metrics (edit_distance, chunk_eval,
+positive_negative_pair) are host-side numpy — they are evaluation ops the
+reference also runs on CPU, and their ragged/dynamic outputs don't belong
+under jit.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op, eager_op, register_op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "huber_loss", "rank_loss", "bpr_loss", "modified_huber_loss",
+    "teacher_student_sigmoid_loss", "center_loss", "squared_l2_distance",
+    "squared_l2_norm", "l1_norm", "clip_by_norm", "cos_sim", "mean_iou",
+    "edit_distance", "ctc_align", "positive_negative_pair", "chunk_eval",
+]
+
+
+def _softplus_stable(x):
+    # log(1 + exp(x)) = max(x, 0) + log(1 + exp(-|x|))
+    return jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _huber(x, y, delta=1.0):
+    r = y - x
+    a = jnp.abs(r)
+    return jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+
+
+register_op("huber_loss", _huber)
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    """Piecewise-quadratic robust regression loss (huber_loss_op.h:29)."""
+    return apply_op("huber_loss", _huber, (input, label), {"delta": delta})
+
+
+def _rank_loss(label, left, right):
+    o = left - right
+    return _softplus_stable(o) - label * o
+
+
+register_op("rank_loss", _rank_loss)
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss: log(1+e^(l-r)) - t*(l-r) (rank_loss_op.h)."""
+    return apply_op("rank_loss", _rank_loss, (label, left, right), {})
+
+
+def _bpr_loss(x, label):
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label.reshape(n, 1).astype(jnp.int32), axis=1)
+    # -sum_{j != y} log(sigmoid(pos - neg)) / (C-1); note log(sigmoid(d))
+    # = -log(1 + exp(-d)) with d = pos - x_j
+    d = pos - x
+    per = _softplus_stable(-d)  # = log(1 + exp(x_j - pos))
+    mask = 1.0 - jax.nn.one_hot(label.reshape(-1), c, dtype=x.dtype)
+    return jnp.sum(per * mask, axis=1, keepdims=True) / (c - 1)
+
+
+register_op("bpr_loss", _bpr_loss)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian Personalized Ranking loss (bpr_loss_op.h:Compute)."""
+    return apply_op("bpr_loss", _bpr_loss, (input, label), {})
+
+
+def _modified_huber(x, y):
+    # y in {0,1} -> s in {-1,1}; z = s*x
+    z = (2.0 * y - 1.0) * x
+    return jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+
+
+register_op("modified_huber_loss", _modified_huber)
+
+
+def modified_huber_loss(input, label, name=None):
+    """Classification huber (modified_huber_loss_op.h:ForwardFunctor)."""
+    return apply_op("modified_huber_loss", _modified_huber,
+                    (input, label), {})
+
+
+def _ts_sigmoid_loss(x, label, soft_max_up_bound=15.0,
+                     soft_max_lower_bound=-15.0):
+    xs = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    sp = _softplus_stable(xs)
+    # label encoding (teacher_student_sigmoid_loss_op.h:40-60):
+    #   < -1: no teacher, clk=0          -> log(1+e^x)
+    #   < 0 : no teacher, clk=1          -> log(1+e^x) - x
+    #   < 1 : teacher z'=label, clk=0    -> log(1+e^x) + log(1+e^x) - x*z'
+    #  >= 1 : teacher z'=label-1, clk=1  -> log(1+e^x) - x + log(1+e^x) - x*z'
+    return jnp.where(
+        label < -1.0, sp,
+        jnp.where(label < 0.0, sp - xs,
+                  jnp.where(label < 1.0, 2.0 * sp - xs * label,
+                            2.0 * sp - xs - xs * (label - 1.0))))
+
+
+register_op("teacher_student_sigmoid_loss", _ts_sigmoid_loss)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    """CTR distillation loss with 4-way label encoding (op .h:40-60)."""
+    return apply_op("teacher_student_sigmoid_loss", _ts_sigmoid_loss,
+                    (input, label),
+                    {"soft_max_up_bound": float(soft_max_up_bound),
+                     "soft_max_lower_bound": float(soft_max_lower_bound)})
+
+
+def center_loss(input, label, centers, alpha=0.1, update_centers=True,
+                name=None):
+    """Center loss (center_loss_op.h): pulls features to per-class centers.
+
+    Returns (loss, centers_out).  The center update is the reference's
+    running rule: delta_c = sum(c_y - x) / (1 + count(y)), applied only
+    when update_centers.  The update itself is non-differentiable state
+    (stop_gradient), matching the reference's separate CentersOut output.
+    """
+    def fn(x, c):
+        lbl = label._data.astype(jnp.int32) if isinstance(label, Tensor) \
+            else jnp.asarray(label, jnp.int32)
+        lbl = lbl.reshape(-1)
+        cx = c[lbl]
+        diff = x - cx
+        loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+        return loss
+
+    loss = apply_op("center_loss", fn, (input, centers), {})
+    if update_centers:
+        x = input._data
+        c = centers._data
+        lbl = (label._data if isinstance(label, Tensor)
+               else jnp.asarray(label)).astype(jnp.int32).reshape(-1)
+        diff = c[lbl] - x
+        cnt = jnp.zeros((c.shape[0],), x.dtype).at[lbl].add(1.0)
+        acc = jnp.zeros_like(c).at[lbl].add(diff)
+        c_new = c - alpha * acc / (1.0 + cnt)[:, None]
+        centers_out = to_tensor(np.asarray(c_new))
+        centers_out.stop_gradient = True
+    else:
+        centers_out = centers
+    return loss, centers_out
+
+
+def _squared_l2_distance(x, y):
+    sub = x - y
+    return jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)))
+
+
+register_op("squared_l2_distance", _squared_l2_distance)
+
+
+def squared_l2_distance(x, y, name=None):
+    """Row-wise ||x-y||^2 (squared_l2_distance_op.h)."""
+    return apply_op("squared_l2_distance", _squared_l2_distance, (x, y), {})
+
+
+def _squared_l2_norm(x):
+    return jnp.sum(jnp.square(x)).reshape((1,))
+
+
+register_op("squared_l2_norm", _squared_l2_norm)
+
+
+def squared_l2_norm(x, name=None):
+    return apply_op("squared_l2_norm", _squared_l2_norm, (x,), {})
+
+
+def _l1_norm(x):
+    return jnp.sum(jnp.abs(x)).reshape((1,))
+
+
+register_op("l1_norm", _l1_norm)
+
+
+def l1_norm(x, name=None):
+    return apply_op("l1_norm", _l1_norm, (x,), {})
+
+
+def _clip_by_norm(x, max_norm=1.0):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return x * scale
+
+
+register_op("clip_by_norm", _clip_by_norm)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so its L2 norm never exceeds max_norm (clip_by_norm_op.h)."""
+    return apply_op("clip_by_norm", _clip_by_norm, (x,),
+                    {"max_norm": float(max_norm)})
+
+
+def _cos_sim(x, y):
+    # y may be a single row broadcast against all rows of x (cos_sim_op.h)
+    if y.shape[0] == 1 and x.shape[0] != 1:
+        y = jnp.broadcast_to(y, x.shape)
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    prod = jnp.sum(x * y, axis=1, keepdims=True)
+    return prod / jnp.maximum(xn * yn, 1e-12)
+
+
+register_op("cos_sim", _cos_sim)
+
+
+def cos_sim(x, y, name=None):
+    """Row-wise cosine similarity with row-broadcast y (cos_sim_op.h)."""
+    return apply_op("cos_sim", _cos_sim, (x, y), {})
+
+
+def _mean_iou(pred, label, num_classes=2):
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    inter = jnp.zeros((num_classes,), jnp.float32).at[
+        jnp.where(p == l, p, num_classes)].add(1.0, mode="drop")
+    pred_cnt = jnp.zeros((num_classes,), jnp.float32).at[p].add(1.0)
+    lbl_cnt = jnp.zeros((num_classes,), jnp.float32).at[l].add(1.0)
+    union = pred_cnt + lbl_cnt - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    wrong = (pred_cnt - inter).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    return miou, wrong, correct
+
+
+register_op("mean_iou", _mean_iou, n_outputs=3)
+
+
+def mean_iou(pred, label, num_classes, name=None):
+    """Segmentation mean-IoU; returns (miou, out_wrong, out_correct)
+    (mean_iou_op.h)."""
+    return apply_op("mean_iou", _mean_iou, (pred, label),
+                    {"num_classes": int(num_classes)}, n_outputs=3)
+
+
+def _levenshtein(a, b):
+    la, lb = len(a), len(b)
+    if la == 0:
+        return lb
+    if lb == 0:
+        return la
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        prev = cur
+    return prev[lb]
+
+
+def edit_distance(input, label, input_length=None, label_length=None,
+                  normalized=True, name=None):
+    """Levenshtein distance per sequence pair (edit_distance_op.h).
+
+    Host-side numpy metric op: inputs are (B, T) id matrices with optional
+    per-row lengths; returns (distances (B,1) float32, sequence_num (1,)).
+    Ragged dynamic programming has no XLA-friendly fixed shape, and the
+    reference also treats this as a CPU metric op.
+    """
+    inp = np.asarray(input._data if isinstance(input, Tensor) else input)
+    lbl = np.asarray(label._data if isinstance(label, Tensor) else label)
+    in_len = (np.asarray(input_length._data
+                         if isinstance(input_length, Tensor)
+                         else input_length).reshape(-1)
+              if input_length is not None else
+              np.full((inp.shape[0],), inp.shape[1], np.int64))
+    lb_len = (np.asarray(label_length._data
+                         if isinstance(label_length, Tensor)
+                         else label_length).reshape(-1)
+              if label_length is not None else
+              np.full((lbl.shape[0],), lbl.shape[1], np.int64))
+    out = np.zeros((inp.shape[0], 1), np.float32)
+    for i in range(inp.shape[0]):
+        a = list(inp[i, :int(in_len[i])])
+        b = list(lbl[i, :int(lb_len[i])])
+        d = float(_levenshtein(a, b))
+        if normalized:
+            d = d / max(len(b), 1)
+        out[i, 0] = d
+    dist = to_tensor(out)
+    dist.stop_gradient = True
+    seq_num = to_tensor(np.array([inp.shape[0]], np.int64))
+    seq_num.stop_gradient = True
+    return dist, seq_num
+
+
+def ctc_align(input, blank=0, merge_repeated=True, padding_value=0,
+              input_length=None, name=None):
+    """CTC best-path decode: merge repeats then drop blanks
+    (ctc_align_op.h).  Padded (B, T) in -> padded (B, T) out, right-filled
+    with padding_value; also returns output lengths (B, 1)."""
+    inp = np.asarray(input._data if isinstance(input, Tensor) else input)
+    B, T = inp.shape
+    in_len = (np.asarray(input_length._data
+                         if isinstance(input_length, Tensor)
+                         else input_length).reshape(-1)
+              if input_length is not None else np.full((B,), T, np.int64))
+    out = np.full((B, T), padding_value, inp.dtype)
+    out_len = np.zeros((B, 1), np.int64)
+    for i in range(B):
+        prev = None
+        k = 0
+        for t in range(int(in_len[i])):
+            tok = inp[i, t]
+            if merge_repeated and prev is not None and tok == prev:
+                continue
+            prev = tok
+            if tok != blank:
+                out[i, k] = tok
+                k += 1
+        out_len[i, 0] = k
+    res = to_tensor(out)
+    res.stop_gradient = True
+    lens = to_tensor(out_len)
+    lens.stop_gradient = True
+    return res, lens
+
+
+def positive_negative_pair(score, label, query_id, name=None):
+    """Ranking metric: within each query, count score-ordered pairs that
+    agree/disagree with label order (positive_negative_pair_op.h).
+    Returns (positive, negative, neutral) float32 scalars."""
+    s = np.asarray(score._data if isinstance(score, Tensor)
+                   else score).reshape(-1)
+    l = np.asarray(label._data if isinstance(label, Tensor)
+                   else label).reshape(-1)
+    q = np.asarray(query_id._data if isinstance(query_id, Tensor)
+                   else query_id).reshape(-1)
+    pos = neg = neu = 0.0
+    for qid in np.unique(q):
+        idx = np.where(q == qid)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if l[i] == l[j]:
+                    continue
+                ds = s[i] - s[j]
+                dl = l[i] - l[j]
+                if ds == 0:
+                    neu += 1
+                elif (ds > 0) == (dl > 0):
+                    pos += 1
+                else:
+                    neg += 1
+    mk = lambda v: to_tensor(np.array([v], np.float32))
+    p, n, u = mk(pos), mk(neg), mk(neu)
+    for t in (p, n, u):
+        t.stop_gradient = True
+    return p, n, u
+
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded=()):
+    """Decode (type, begin, end) chunks from an integer tag sequence.
+
+    Tag layout follows chunk_eval_op.h: for scheme 'IOB' tag = type*2 +
+    {0:B,1:I}; 'IOE' type*2 + {0:I,1:E}; 'IOBES' type*4 + {0:B,1:I,2:E,
+    3:S}; 'plain' tag = type.  num_chunk_types*tag_num is the 'outside'
+    tag.
+    """
+    chunks = []
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    outside = num_chunk_types * n_tag
+    start = None
+    cur_type = None
+
+    def flush(end):
+        nonlocal start, cur_type
+        if start is not None and cur_type not in excluded:
+            chunks.append((cur_type, start, end))
+        start, cur_type = None, None
+
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t >= outside or t < 0:
+            flush(i)
+            continue
+        ctype, pos = divmod(t, n_tag)
+        if scheme == "plain":
+            if cur_type != ctype:
+                flush(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOB":
+            if pos == 0 or cur_type != ctype:
+                flush(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOE":
+            if cur_type != ctype:
+                flush(i)
+                start, cur_type = i, ctype
+            if pos == 1:
+                flush(i + 1)
+        else:  # IOBES
+            if pos == 0:  # B
+                flush(i)
+                start, cur_type = i, ctype
+            elif pos == 1:  # I
+                if cur_type != ctype:
+                    flush(i)
+                    start, cur_type = i, ctype
+            elif pos == 2:  # E
+                if cur_type != ctype:
+                    flush(i)
+                    start, cur_type = i, ctype
+                flush(i + 1)
+            else:  # S
+                flush(i)
+                if ctype not in excluded:
+                    chunks.append((ctype, i, i + 1))
+    flush(len(tags))
+    return set(chunks)
+
+
+def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1,
+               excluded_chunk_types=None, seq_length=None, name=None):
+    """Chunking precision/recall/F1 (NER-style), chunk_eval_op.h.
+
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) — host numpy metric op over padded (B, T) tags.
+    """
+    excluded = tuple(excluded_chunk_types or ())
+    inf = np.asarray(input._data if isinstance(input, Tensor) else input)
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label)
+    if inf.ndim == 1:
+        inf, lab = inf[None, :], lab[None, :]
+    B, T = inf.shape
+    lens = (np.asarray(seq_length._data if isinstance(seq_length, Tensor)
+                       else seq_length).reshape(-1)
+            if seq_length is not None else np.full((B,), T, np.int64))
+    n_inf = n_lab = n_cor = 0
+    for i in range(B):
+        ci = _extract_chunks(inf[i, :int(lens[i])], chunk_scheme,
+                             num_chunk_types, excluded)
+        cl = _extract_chunks(lab[i, :int(lens[i])], chunk_scheme,
+                             num_chunk_types, excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    mkf = lambda v: to_tensor(np.array([v], np.float32))
+    mki = lambda v: to_tensor(np.array([v], np.int64))
+    outs = (mkf(prec), mkf(rec), mkf(f1), mki(n_inf), mki(n_lab), mki(n_cor))
+    for t in outs:
+        t.stop_gradient = True
+    return outs

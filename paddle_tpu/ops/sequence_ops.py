@@ -1,0 +1,602 @@
+"""Sequence-family + classic-NLP ops over the padded+length representation.
+
+Reference: operators/linear_chain_crf_op.{cc,h} (forward/backward CRF
+recursions), crf_decoding_op.h (Viterbi), operators/sequence_ops/ (the
+sequence_* family over LoDTensors), nce_op.h, sample_logits_op.h,
+sampling_id_op.h, beam_search_op.h, beam_search_decode_op.h,
+add_position_encoding_op.h, im2sequence_op.h, row_conv_op.h,
+conv_shift_op.h, segment_pool_op.h.
+
+TPU-native design (SURVEY §7.3 "LoD"): ragged sequences are carried as
+(padded (B, T, ...) data, per-row int lengths) pairs — LoD offsets exist
+only at the Python boundary (sequence_pad/sequence_unpad are exactly that
+boundary).  All recursions (CRF alpha/viterbi, beam step) are lax.scan
+loops with static shapes, so every op jit-compiles; nothing here does a
+per-timestep host round-trip.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op, register_op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "nce", "sample_logits",
+    "sampling_id", "beam_search", "beam_search_decode",
+    "add_position_encoding", "im2sequence", "row_conv", "conv_shift",
+    "segment_pool", "segment_sum", "segment_mean", "segment_max",
+    "segment_min", "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_pad", "sequence_unpad", "sequence_expand", "sequence_conv",
+    "sequence_first_step", "sequence_last_step",
+]
+
+
+def _len_mask(length, T, dtype=jnp.float32):
+    """(B,) lengths -> (B, T) {1,0} validity mask."""
+    return (jnp.arange(T)[None, :] < length[:, None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Linear-chain CRF
+# ---------------------------------------------------------------------------
+
+def _crf_ll(emission, transition, label, length):
+    """Per-sequence log-likelihood (linear_chain_crf_op.h:188-222).
+
+    emission (B, T, N); transition (N+2, N) with rows 0/1 = start/stop;
+    label (B, T) int; length (B,) int.  Masked logsumexp forward recursion
+    under lax.scan — the XLA-native form of the reference's per-sequence
+    alpha loop.
+    """
+    B, T, N = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    lab = label.astype(jnp.int32)
+    lens = length.astype(jnp.int32)
+
+    alpha0 = start[None, :] + emission[:, 0, :]  # (B, N)
+
+    def step(alpha, t):
+        # logsumexp over previous tag
+        scores = alpha[:, :, None] + trans[None, :, :]  # (B, N_prev, N)
+        new = jax.scipy.special.logsumexp(scores, axis=1) + emission[:, t, :]
+        alive = (t < lens)[:, None]
+        return jnp.where(alive, new, alpha), None
+
+    alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T)) if T > 1 \
+        else (alpha0, None)
+    log_z = jax.scipy.special.logsumexp(alphaT + stop[None, :], axis=1)
+
+    # gold score: start + sum_t emission[t, y_t] + sum_t trans[y_{t-1}, y_t]
+    # + stop[y_last]
+    t_idx = jnp.arange(T)[None, :]
+    valid = (t_idx < lens[:, None])
+    em_gold = jnp.take_along_axis(emission, lab[:, :, None], axis=2)[..., 0]
+    em_sum = jnp.sum(jnp.where(valid, em_gold, 0.0), axis=1)
+    prev_lab = lab[:, :-1]
+    next_lab = lab[:, 1:]
+    tr_gold = trans[prev_lab, next_lab]  # (B, T-1)
+    tr_valid = (t_idx[:, 1:] < lens[:, None])
+    tr_sum = jnp.sum(jnp.where(tr_valid, tr_gold, 0.0), axis=1) if T > 1 \
+        else jnp.zeros((B,), emission.dtype)
+    first_lab = lab[:, 0]
+    last_lab = jnp.take_along_axis(lab, (lens - 1)[:, None], axis=1)[:, 0]
+    gold = start[first_lab] + em_sum + tr_sum + stop[last_lab]
+    return (gold - log_z)[:, None]
+
+
+register_op("linear_chain_crf", _crf_ll)
+
+
+def linear_chain_crf(input, transition, label, length, name=None):
+    """Log-likelihood (B, 1) of gold tag paths under a linear-chain CRF.
+    Negate and mean for a training loss (the reference's book usage)."""
+    return apply_op("linear_chain_crf", _crf_ll,
+                    (input, transition, label, length), {})
+
+
+def _viterbi(emission, transition, length):
+    B, T, N = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    lens = length.astype(jnp.int32)
+    alpha0 = start[None, :] + emission[:, 0, :]
+
+    def step(alpha, t):
+        scores = alpha[:, :, None] + trans[None, :, :]  # (B, prev, cur)
+        best_prev = jnp.argmax(scores, axis=1)  # (B, N)
+        new = jnp.max(scores, axis=1) + emission[:, t, :]
+        alive = (t < lens)[:, None]
+        return jnp.where(alive, new, alpha), \
+            jnp.where(alive, best_prev, jnp.arange(N)[None, :])
+
+    if T > 1:
+        alphaT, back = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        back = jnp.moveaxis(back, 0, 1)  # (B, T-1, N)
+    else:
+        alphaT = alpha0
+        back = jnp.zeros((B, 0, N), jnp.int32)
+    final = alphaT + stop[None, :]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)  # (B,)
+
+    # backtrack from position lens-1 down to 0
+    def bt_step(tag, t):
+        # pointer at time t+1 tells the best tag at time t
+        ptr = back[:, t, :]  # (B, N) backpointer for transition t -> t+1
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+        # only meaningful while t+1 < lens; else keep current tag
+        keep = (t + 1) < lens
+        return jnp.where(keep, prev.astype(jnp.int32), tag), \
+            jnp.where(keep, prev.astype(jnp.int32), tag)
+
+    ts = jnp.arange(T - 2, -1, -1) if T > 1 else jnp.zeros((0,), jnp.int32)
+    _, path_rev = jax.lax.scan(bt_step, last_tag, ts)
+    if T > 1:
+        path = jnp.concatenate(
+            [jnp.flip(jnp.moveaxis(path_rev, 0, 1), axis=1),
+             last_tag[:, None]], axis=1)  # (B, T)
+    else:
+        path = last_tag[:, None]
+    # zero out the padding tail (reference pads decoded LoD at boundary)
+    return jnp.where(_len_mask(lens, T, jnp.bool_), path, 0).astype(jnp.int64)
+
+
+register_op("crf_decoding", _viterbi)
+
+
+def crf_decoding(input, transition, length, name=None):
+    """Viterbi decode (B, T) best tag paths (crf_decoding_op.h)."""
+    out = apply_op("crf_decoding", _viterbi, (input, transition, length), {})
+    out.stop_gradient = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sampled-softmax family
+# ---------------------------------------------------------------------------
+
+def _log_uniform_sample(key, num_samples, vocab):
+    """Log-uniform (Zipf) class sampler, the reference NCE default."""
+    u = jax.random.uniform(key, (num_samples,))
+    ids = (jnp.exp(u * jnp.log(vocab + 1.0)) - 1.0).astype(jnp.int32)
+    return jnp.clip(ids, 0, vocab - 1)
+
+
+def _log_uniform_log_prob(ids, vocab):
+    """log P(k) under the log-uniform sampler:
+    P(k) = (log(k+2) - log(k+1)) / log(V+1) (math/sampler.cc
+    LogUniformSampler::Probability)."""
+    k = ids.astype(jnp.float32)
+    return jnp.log(jnp.log((k + 2.0) / (k + 1.0))) \
+        - jnp.log(jnp.log(vocab + 1.0))
+
+
+def nce(input, weight, label, bias=None, num_total_classes=None,
+        num_neg_samples=10, sampler="uniform", seed=0, name=None):
+    """Noise-contrastive estimation loss (nce_op.h).
+
+    input (B, D); weight (V, D); label (B,) or (B, L) true classes.
+    Returns (B, 1) per-sample NCE cost over shared negative samples.
+    """
+    V = num_total_classes or weight.shape[0]
+
+    def fn(x, w, lbl, *maybe_bias):
+        b = maybe_bias[0] if maybe_bias else None
+        key = jax.random.PRNGKey(seed)
+        if sampler == "log_uniform":
+            neg = _log_uniform_sample(key, num_neg_samples, V)
+        else:
+            neg = jax.random.randint(key, (num_neg_samples,), 0, V)
+        lbl2 = lbl.reshape(lbl.shape[0], -1).astype(jnp.int32)  # (B, L)
+        pos_w = w[lbl2]  # (B, L, D)
+        pos_logit = jnp.einsum("bd,bld->bl", x, pos_w)
+        neg_logit = x @ w[neg].T  # (B, S)
+        if b is not None:
+            pos_logit = pos_logit + b[lbl2]
+            neg_logit = neg_logit + b[neg][None, :]
+        # NCE prices each class by its own sampler probability
+        # (nce_op.h: sampler->Probability per sampled/true class)
+        if sampler == "log_uniform":
+            log_q_pos = _log_uniform_log_prob(lbl2, V)       # (B, L)
+            log_q_neg = _log_uniform_log_prob(neg, V)[None]  # (1, S)
+        else:
+            log_q = -jnp.log(jnp.asarray(float(V), x.dtype))
+            log_q_pos = log_q
+            log_q_neg = log_q
+        pos_cost = -jax.nn.log_sigmoid(pos_logit - log_q_pos)
+        neg_cost = -jax.nn.log_sigmoid(-(neg_logit - log_q_neg))
+        return (jnp.sum(pos_cost, axis=1)
+                + jnp.sum(neg_cost, axis=1))[:, None]
+
+    args = (input, weight, label) + ((bias,) if bias is not None else ())
+    return apply_op("nce", fn, args, {})
+
+
+def sample_logits(logits, label, num_samples, seed=0, name=None):
+    """Sampled-softmax helper (sample_logits_op.h): draws shared negative
+    classes, gathers their logits next to the true-label logits.
+    Returns (sampled_logits (B, L+S), sampled_label (B, L+S))."""
+    def fn(lg, lbl):
+        B, V = lg.shape
+        lbl2 = lbl.reshape(B, -1).astype(jnp.int32)
+        L = lbl2.shape[1]
+        key = jax.random.PRNGKey(seed)
+        neg = _log_uniform_sample(key, num_samples, V)  # (S,)
+        ids = jnp.concatenate(
+            [lbl2, jnp.broadcast_to(neg[None, :], (B, num_samples))], axis=1)
+        picked = jnp.take_along_axis(lg, ids, axis=1)
+        return picked, ids.astype(jnp.int64)
+
+    out = apply_op("sample_logits", fn, (logits, label), {}, n_outputs=2)
+    out[1].stop_gradient = True
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, name=None):
+    """Sample one column index per row of a probability matrix
+    (sampling_id_op.h)."""
+    def fn(p):
+        key = jax.random.PRNGKey(seed if seed else 0)
+        return jax.random.categorical(key, jnp.log(
+            jnp.maximum(p, 1e-20)), axis=1).astype(jnp.int64)
+
+    out = apply_op("sampling_id", fn, (x,), {})
+    out.stop_gradient = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Beam search (dense (batch, beam) layout; LoD layout stays at the boundary)
+# ---------------------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None):
+    """One beam-search expansion step (beam_search_op.h).
+
+    Dense layout: pre_ids/pre_scores (batch*beam, 1); ids/scores
+    (batch*beam, K) candidate tokens and their (accumulated) scores.
+    Returns (selected_ids (batch*beam, 1), selected_scores (batch*beam, 1),
+    parent_idx (batch*beam,) int — the flat beam row each winner came
+    from, feedable to gather_tree).  Finished beams (pre_id == end_id)
+    propagate with their score frozen, matching the reference semantics.
+    """
+    def fn(p_ids, p_scores, cand_ids, cand_scores):
+        BB, K = cand_scores.shape
+        batch = BB // beam_size
+        finished = (p_ids.reshape(-1) == end_id)
+        acc = cand_scores if is_accumulated \
+            else p_scores.reshape(-1, 1) + jnp.log(
+                jnp.maximum(cand_scores, 1e-20))
+        neg_inf = jnp.asarray(-1e9, acc.dtype)
+        # a finished beam contributes exactly one candidate: itself
+        keep_score = jnp.where(
+            jnp.arange(K)[None, :] == 0, p_scores.reshape(-1, 1), neg_inf)
+        acc = jnp.where(finished[:, None], keep_score, acc)
+        keep_ids = jnp.where(
+            jnp.arange(K)[None, :] == 0, p_ids.reshape(-1, 1),
+            jnp.asarray(end_id, cand_ids.dtype))
+        cand = jnp.where(finished[:, None], keep_ids, cand_ids)
+        flat = acc.reshape(batch, beam_size * K)
+        top_score, top_pos = jax.lax.top_k(flat, beam_size)
+        src_beam = top_pos // K  # (batch, beam) beam row within the batch
+        parent = (src_beam
+                  + jnp.arange(batch)[:, None] * beam_size).reshape(-1)
+        sel_ids = cand.reshape(batch, beam_size * K)
+        sel_ids = jnp.take_along_axis(sel_ids, top_pos, axis=1).reshape(-1, 1)
+        return (sel_ids.astype(jnp.int64), top_score.reshape(-1, 1),
+                parent.astype(jnp.int64))
+
+    out = apply_op("beam_search", fn, (pre_ids, pre_scores, ids, scores),
+                   {}, n_outputs=3)
+    for t in (out[0], out[2]):
+        t.stop_gradient = True
+    return out
+
+
+def beam_search_decode(step_ids, step_parents, beam_size, end_id, name=None):
+    """Backtrack stacked per-step (batch*beam, 1) selections into full
+    sequences (beam_search_decode_op.h) via gather_tree.
+    step_ids/step_parents: lists (or (T, batch*beam) arrays)."""
+    from .nn_extra import gather_tree
+
+    def stack(xs):
+        if isinstance(xs, (list, tuple)):
+            arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                    for x in xs]
+            return jnp.stack([a.reshape(-1) for a in arrs])  # (T, BB)
+        return (xs._data if isinstance(xs, Tensor)
+                else jnp.asarray(xs)).reshape(len(xs), -1)
+
+    ids = stack(step_ids)
+    parents = stack(step_parents)
+    T, BB = ids.shape
+    batch = BB // beam_size
+    ids3 = ids.reshape(T, batch, beam_size)
+    par3 = parents.reshape(T, batch, beam_size) % beam_size
+    out = gather_tree(to_tensor(np.asarray(ids3)),
+                      to_tensor(np.asarray(par3)))
+    out.stop_gradient = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Positional / sliding-window ops
+# ---------------------------------------------------------------------------
+
+def _add_pos_enc(x, alpha=1.0, beta=1.0):
+    B, T, D = x.shape
+    half = D // 2
+    pos = jnp.arange(T, dtype=x.dtype)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / half)
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return alpha * x + beta * enc[None, :, :]
+
+
+register_op("add_position_encoding", _add_pos_enc)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """alpha*x + beta*sinusoid(T, D) (add_position_encoding_op.h)."""
+    return apply_op("add_position_encoding", _add_pos_enc, (input,),
+                    {"alpha": float(alpha), "beta": float(beta)})
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """NCHW image -> (B*out_h*out_w, C*kh*kw) patch sequence
+    (im2sequence_op.h).  unfold + transpose; the LoD offsets the reference
+    attaches become the implicit row grouping."""
+    from .nn_extra import unfold
+
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cols = unfold(input, list(k), strides=stride, paddings=padding)
+
+    def fn(c):
+        B, CKK, L = c.shape
+        return jnp.transpose(c, (0, 2, 1)).reshape(B * L, CKK)
+
+    return apply_op("im2sequence", fn, (cols,), {})
+
+
+def _row_conv(x, w):
+    # x (B, T, D); w (k, D) lookahead filter: y[t] = sum_j w[j] * x[t+j]
+    k = w.shape[0]
+    T = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        out = out + xp[:, j:j + T, :] * w[j][None, None, :]
+    return out
+
+
+register_op("row_conv", _row_conv)
+
+
+def row_conv(input, weight, name=None):
+    """Lookahead row convolution (row_conv_op.h, DeepSpeech2)."""
+    return apply_op("row_conv", _row_conv, (input, weight), {})
+
+
+def _conv_shift(x, y):
+    # circular correlation (conv_shift_op.h): out[i,j] =
+    # sum_k x[i, (j + k - W//2) mod N] * y[i, k]
+    B, N = x.shape
+    W = y.shape[1]
+    shifts = jnp.arange(W) - W // 2
+    idx = (jnp.arange(N)[None, :] + shifts[:, None]) % N  # (W, N)
+    gath = x[:, idx]  # (B, W, N)
+    return jnp.einsum("bwn,bw->bn", gath, y)
+
+
+register_op("conv_shift", _conv_shift)
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution/correlation (conv_shift_op.h, NTM addressing)."""
+    return apply_op("conv_shift", _conv_shift, (x, y), {})
+
+
+# ---------------------------------------------------------------------------
+# Segment + sequence pooling family
+# ---------------------------------------------------------------------------
+
+def segment_pool(x, segment_ids, pool_type="SUM", name=None):
+    """Pool rows of x by contiguous segment ids (segment_pool_op.h).
+    num_segments is taken as max(id)+1 at trace time (host-read of the
+    eager ids, the boundary where ragged meets XLA)."""
+    ids_arr = segment_ids._data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    n_seg = int(np.asarray(ids_arr).max()) + 1 if ids_arr.size else 0
+    kind = pool_type.upper()
+
+    def fn(v, ids):
+        ids = ids.astype(jnp.int32)
+        if kind == "SUM":
+            return jax.ops.segment_sum(v, ids, num_segments=n_seg)
+        if kind == "MEAN":
+            s = jax.ops.segment_sum(v, ids, num_segments=n_seg)
+            c = jax.ops.segment_sum(jnp.ones((v.shape[0],), v.dtype), ids,
+                                    num_segments=n_seg)
+            return s / jnp.maximum(c, 1.0).reshape(
+                (-1,) + (1,) * (v.ndim - 1))
+        if kind == "MAX":
+            return jax.ops.segment_max(v, ids, num_segments=n_seg)
+        if kind == "MIN":
+            return jax.ops.segment_min(v, ids, num_segments=n_seg)
+        raise ValueError(f"unknown segment pool {pool_type}")
+
+    return apply_op(f"segment_{kind.lower()}", fn, (x, segment_ids), {})
+
+
+def segment_sum(x, segment_ids, name=None):
+    return segment_pool(x, segment_ids, "SUM")
+
+
+def segment_mean(x, segment_ids, name=None):
+    return segment_pool(x, segment_ids, "MEAN")
+
+
+def segment_max(x, segment_ids, name=None):
+    return segment_pool(x, segment_ids, "MAX")
+
+
+def segment_min(x, segment_ids, name=None):
+    return segment_pool(x, segment_ids, "MIN")
+
+
+def _seq_pool(x, length, pool_type="average"):
+    B, T = x.shape[0], x.shape[1]
+    mask = _len_mask(length.astype(jnp.int32), T, x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    kind = pool_type.lower()
+    if kind == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if kind in ("average", "mean"):
+        return jnp.sum(x * mask, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1), 1.0)
+    if kind == "sqrt":
+        return jnp.sum(x * mask, axis=1) / jnp.sqrt(jnp.maximum(
+            jnp.sum(mask, axis=1), 1.0))
+    if kind == "max":
+        neg = jnp.asarray(-3.4e38, x.dtype)
+        return jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    if kind == "last":
+        idx = (length.astype(jnp.int32) - 1).reshape(
+            (B,) + (1,) * (x.ndim - 1))
+        return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+    if kind == "first":
+        return x[:, 0]
+    raise ValueError(f"unknown sequence pool {pool_type}")
+
+
+register_op("sequence_pool", _seq_pool)
+
+
+def sequence_pool(input, length, pool_type="average", name=None):
+    """Pool each padded row over its valid prefix (sequence_pool_op.h)."""
+    return apply_op("sequence_pool", _seq_pool, (input, length),
+                    {"pool_type": pool_type})
+
+
+def sequence_first_step(input, length, name=None):
+    return sequence_pool(input, length, "first")
+
+
+def sequence_last_step(input, length, name=None):
+    return sequence_pool(input, length, "last")
+
+
+def _seq_softmax(x, length):
+    mask = _len_mask(length.astype(jnp.int32), x.shape[1], jnp.bool_)
+    neg = jnp.asarray(-1e9, x.dtype)
+    out = jax.nn.softmax(jnp.where(mask, x, neg), axis=1)
+    return jnp.where(mask, out, 0.0)
+
+
+register_op("sequence_softmax", _seq_softmax)
+
+
+def sequence_softmax(input, length, name=None):
+    """Masked softmax over the time axis (sequence_softmax_op.h)."""
+    return apply_op("sequence_softmax", _seq_softmax, (input, length), {})
+
+
+def _seq_reverse(x, length):
+    T = x.shape[1]
+    lens = length.astype(jnp.int32)[:, None]
+    idx = jnp.arange(T)[None, :]
+    src = jnp.where(idx < lens, lens - 1 - idx, idx)  # reverse valid prefix
+    src = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, jnp.broadcast_to(src, x.shape), axis=1)
+
+
+register_op("sequence_reverse", _seq_reverse)
+
+
+def sequence_reverse(x, length, name=None):
+    """Reverse each row's valid prefix, keep padding in place
+    (sequence_reverse_op.h)."""
+    return apply_op("sequence_reverse", _seq_reverse, (x, length), {})
+
+
+def sequence_pad(x, lengths, pad_value=0.0, maxlen=None, name=None):
+    """Concatenated (sum_len, D) rows + lengths -> (B, T, D) padded batch
+    (sequence_pad_op.h) — the LoD -> dense boundary conversion."""
+    lens = np.asarray(lengths._data if isinstance(lengths, Tensor)
+                      else lengths).astype(np.int64).reshape(-1)
+    offsets = np.concatenate([[0], np.cumsum(lens)])
+    T = int(maxlen or (lens.max() if lens.size else 0))
+
+    def fn(v):
+        rows = []
+        for i in range(len(lens)):
+            seg = v[offsets[i]:offsets[i + 1]]
+            pad_n = T - int(lens[i])
+            pad_width = [(0, pad_n)] + [(0, 0)] * (v.ndim - 1)
+            rows.append(jnp.pad(seg, pad_width,
+                                constant_values=pad_value))
+        return jnp.stack(rows)
+
+    out = apply_op("sequence_pad", fn, (x,), {})
+    len_t = to_tensor(lens)
+    len_t.stop_gradient = True
+    return out, len_t
+
+
+def sequence_unpad(x, length, name=None):
+    """(B, T, D) padded -> concatenated (sum_len, D) valid rows
+    (sequence_unpad_op.h), the dense -> LoD boundary."""
+    lens = np.asarray(length._data if isinstance(length, Tensor)
+                      else length).astype(np.int64).reshape(-1)
+
+    def fn(v):
+        return jnp.concatenate([v[i, :int(lens[i])] for i in range(len(lens))])
+
+    return apply_op("sequence_unpad", fn, (x,), {})
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Repeat row i of x ref_lengths[i] times (sequence_expand_op.h with
+    ref_level row granularity)."""
+    lens = np.asarray(ref_lengths._data if isinstance(ref_lengths, Tensor)
+                      else ref_lengths).astype(np.int64).reshape(-1)
+    idx = np.repeat(np.arange(len(lens)), lens)
+
+    def fn(v):
+        return v[jnp.asarray(idx)]
+
+    return apply_op("sequence_expand", fn, (x,), {})
+
+
+def _seq_conv(x, w, length, context_start):
+    # x (B, T, D), w (ctx*D, M): gather the context window per step then
+    # one big matmul (MXU-friendly im2col form of sequence_conv_op.h)
+    B, T, D = x.shape
+    ctx = w.shape[0] // D
+    cols = []
+    for j in range(ctx):
+        off = context_start + j
+        if off < 0:
+            seg = jnp.pad(x[:, :max(T + off, 0)],
+                          ((0, 0), (min(-off, T), 0), (0, 0)))
+        else:
+            seg = jnp.pad(x[:, off:], ((0, 0), (0, min(off, T)), (0, 0)))
+        cols.append(seg)
+    stacked = jnp.concatenate(cols, axis=2)  # (B, T, ctx*D)
+    out = stacked @ w  # (B, T, M)
+    mask = _len_mask(length.astype(jnp.int32), T, x.dtype)[:, :, None]
+    return out * mask
+
+
+register_op("sequence_conv", _seq_conv)
+
+
+def sequence_conv(input, weight, length, context_length=None,
+                  context_start=None, name=None):
+    """Context-window sequence convolution (sequence_conv_op.h).
+    weight is (context_length*D, M); context_start defaults to
+    -(context_length-1)//2 like the reference."""
+    D = input.shape[2]
+    ctx = context_length or weight.shape[0] // D
+    start = context_start if context_start is not None else -(ctx - 1) // 2
+    return apply_op("sequence_conv", _seq_conv, (input, weight, length),
+                    {"context_start": int(start)})

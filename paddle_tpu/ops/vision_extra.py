@@ -1,0 +1,537 @@
+"""Vision / image-manipulation op family.
+
+Reference: operators/affine_channel_op.cc, shuffle_channel_op.h,
+space_to_depth_op.cc, spp_op.h (spatial pyramid pooling), unpool_op.h,
+pool_with_index (max_pool2d_with_index kernels in math/pooling.cc),
+psroi_pool_op.h, prroi_pool_op.h, deformable_conv_op.h/.cu,
+random_crop_op.h, pad_constant_like_op.cc, partial_concat_op.cc,
+partial_sum_op.cc, fsp_op.h, data_norm_op.cc, cvm_op.h,
+fused/fused_softmax_mask_upper_triangle_op.cu,
+bilinear_tensor_product_op.h, unique_with_counts_op.h,
+*_batch_size_like ops.
+
+TPU-native design: window/ROI gathers become dense take_along_axis /
+one-hot matmuls that XLA tiles onto the VPU/MXU; deformable sampling is
+a vectorized bilinear gather (no per-pixel loops); the dynamic-shape
+unique ops run eagerly (they are host/boundary ops, same as the
+reference's CPU-only kernels).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import apply_op, register_op
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "affine_channel", "shuffle_channel", "space_to_depth", "spp",
+    "max_pool2d_with_index", "max_unpool2d", "psroi_pool", "prroi_pool",
+    "deformable_conv", "random_crop", "pad_constant_like",
+    "partial_concat", "partial_sum", "fsp_matrix", "data_norm", "cvm",
+    "softmax_mask_fuse_upper_triangle", "bilinear_tensor_product",
+    "unique_with_counts", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like",
+]
+
+
+def _affine_channel(x, scale, bias):
+    return x * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+
+
+register_op("affine_channel", _affine_channel)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """Per-channel scale+shift, the frozen-BN replacement
+    (affine_channel_op.cc)."""
+    if data_format == "NHWC":
+        return apply_op("affine_channel_nhwc",
+                        lambda v, s, b: v * s.reshape(1, 1, 1, -1)
+                        + b.reshape(1, 1, 1, -1), (x, scale, bias), {})
+    return apply_op("affine_channel", _affine_channel, (x, scale, bias), {})
+
+
+def _shuffle_channel(x, group=1):
+    B, C, H, W = x.shape
+    return x.reshape(B, group, C // group, H, W).transpose(
+        0, 2, 1, 3, 4).reshape(B, C, H, W)
+
+
+register_op("shuffle_channel", _shuffle_channel)
+
+
+def shuffle_channel(x, group, name=None):
+    """ShuffleNet channel shuffle (shuffle_channel_op.h)."""
+    return apply_op("shuffle_channel", _shuffle_channel, (x,),
+                    {"group": int(group)})
+
+
+def _space_to_depth(x, blocksize=2):
+    B, C, H, W = x.shape
+    bs = blocksize
+    y = x.reshape(B, C, H // bs, bs, W // bs, bs)
+    return y.transpose(0, 3, 5, 1, 2, 4).reshape(
+        B, C * bs * bs, H // bs, W // bs)
+
+
+register_op("space_to_depth", _space_to_depth)
+
+
+def space_to_depth(x, blocksize, name=None):
+    """Rearrange spatial blocks into channels (space_to_depth_op.cc)."""
+    return apply_op("space_to_depth", _space_to_depth, (x,),
+                    {"blocksize": int(blocksize)})
+
+
+def spp(x, pyramid_height=2, pool_type="max", name=None):
+    """Spatial pyramid pooling (spp_op.h): concat flattened 2^l x 2^l
+    adaptive pools for l in [0, pyramid_height)."""
+    from .nn_ops import adaptive_avg_pool2d, adaptive_max_pool2d
+    from .manipulation import concat, reshape
+
+    outs = []
+    B, C = x.shape[0], x.shape[1]
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        pooled = (adaptive_max_pool2d(x, bins) if pool_type == "max"
+                  else adaptive_avg_pool2d(x, bins))
+        outs.append(reshape(pooled, [B, C * bins * bins]))
+    return concat(outs, axis=1)
+
+
+def _window_patches(x, kh, kw, sh, sw, ph, pw, pad_val):
+    """(B, C, Ho, Wo, kh*kw) patch tensor + matching flat input indices."""
+    B, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=pad_val)
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    rows = jnp.arange(Ho) * sh
+    cols = jnp.arange(Wo) * sw
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(xp[:, :, rows[:, None] + i, cols[None, :] + j])
+    return jnp.stack(patches, axis=-1), Ho, Wo
+
+
+def _max_pool_with_index(x, kernel=(2, 2), stride=(2, 2), padding=(0, 0)):
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = padding
+    B, C, H, W = x.shape
+    neg = jnp.asarray(-3.4e38, x.dtype)
+    pat, Ho, Wo = _window_patches(x, kh, kw, sh, sw, ph, pw, neg)
+    amax = jnp.argmax(pat, axis=-1)  # (B, C, Ho, Wo) in [0, kh*kw)
+    out = jnp.max(pat, axis=-1)
+    ki, kj = amax // kw, amax % kw
+    rows = (jnp.arange(Ho) * sh).reshape(1, 1, Ho, 1) + ki - ph
+    cols = (jnp.arange(Wo) * sw).reshape(1, 1, 1, Wo) + kj - pw
+    idx = rows * W + cols  # flat index into the unpadded H*W plane
+    return out, idx.astype(jnp.int32)
+
+
+register_op("max_pool2d_with_index", _max_pool_with_index, n_outputs=2)
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    """Max pool returning the reference's flat H*W argmax indices
+    (pool_with_index, math/pooling.cc MaxPool2dWithIndex)."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    return apply_op("max_pool2d_with_index", _max_pool_with_index, (x,),
+                    {"kernel": k, "stride": s, "padding": p}, n_outputs=2)
+
+
+def _max_unpool2d(x, indices, out_h, out_w):
+    B, C, Ho, Wo = x.shape
+    flat = jnp.zeros((B, C, out_h * out_w), x.dtype)
+    idx = indices.reshape(B, C, Ho * Wo).astype(jnp.int32)
+    vals = x.reshape(B, C, Ho * Wo)
+    bi = jnp.arange(B).reshape(B, 1, 1)
+    ci = jnp.arange(C).reshape(1, C, 1)
+    flat = flat.at[bi, ci, idx].add(vals)
+    return flat.reshape(B, C, out_h, out_w)
+
+
+register_op("unpool", _max_unpool2d)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    """Scatter pooled values back to their argmax positions (unpool_op.h)."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    p = _pair(padding)
+    if output_size is not None:
+        out_h, out_w = output_size[-2], output_size[-1]
+    else:
+        Ho, Wo = x.shape[2], x.shape[3]
+        out_h = (Ho - 1) * s[0] - 2 * p[0] + k[0]
+        out_w = (Wo - 1) * s[1] - 2 * p[1] + k[1]
+    return apply_op("unpool", _max_unpool2d, (x, indices),
+                    {"out_h": int(out_h), "out_w": int(out_w)})
+
+
+def _bilinear_at(x, ys, xs):
+    """Sample x (C, H, W) at float coords ys/xs (...) with zero padding."""
+    C, H, W = x.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def tap(yy, xx):
+        ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = x[:, yc, xc]  # (C, ...)
+        return jnp.where(ok[None], v, 0.0)
+
+    return (tap(y0, x0) * ((1 - wy) * (1 - wx))[None]
+            + tap(y0, x0 + 1) * ((1 - wy) * wx)[None]
+            + tap(y0 + 1, x0) * (wy * (1 - wx))[None]
+            + tap(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+
+def psroi_pool(x, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """Position-sensitive ROI average pooling (psroi_pool_op.h): input
+    channel (c, ph, pw) feeds output channel c at bin (ph, pw).
+    rois: (R, 4) [x1, y1, x2, y2] boxes in image coords; all assigned to
+    batch item 0 unless rois_num gives a per-image split."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+    rois_arr = np.asarray(rois._data if isinstance(rois, Tensor) else rois,
+                          np.float32)
+    splits = (np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                         else rois_num, np.int64).reshape(-1)
+              if rois_num is not None else
+              np.array([rois_arr.shape[0]], np.int64))
+    batch_of = np.repeat(np.arange(len(splits)), splits)
+
+    def fn(xv, rv):
+        H, W = xv.shape[2], xv.shape[3]
+
+        def one_roi(roi, b):
+            x1, y1, x2, y2 = [r * spatial_scale for r in
+                              (roi[0], roi[1], roi[2], roi[3])]
+            rh = jnp.maximum(y2 - y1, 0.1)
+            rw = jnp.maximum(x2 - x1, 0.1)
+            bin_h, bin_w = rh / ph, rw / pw
+            # average over a fixed 2x2 sample grid per bin (dense, jit-able)
+            sy = (jnp.arange(ph)[:, None] * bin_h + y1
+                  + (jnp.arange(2)[None, :] + 0.5) * bin_h / 2)  # (ph, 2)
+            sx = (jnp.arange(pw)[:, None] * bin_w + x1
+                  + (jnp.arange(2)[None, :] + 0.5) * bin_w / 2)  # (pw, 2)
+            gy = jnp.broadcast_to(sy[:, None, :, None], (ph, pw, 2, 2))
+            gx = jnp.broadcast_to(sx[None, :, None, :], (ph, pw, 2, 2))
+            samp = _bilinear_at(xv[b], gy, gx)  # (C, ph, pw, 2, 2)
+            pooled = jnp.mean(samp, axis=(-2, -1))  # (C, ph, pw)
+            # position-sensitive: channel block (c*ph*pw + iy*pw + ix)
+            ps = pooled.reshape(oc, ph, pw, ph, pw)
+            iy = jnp.arange(ph)[:, None]
+            ix = jnp.arange(pw)[None, :]
+            return ps[:, iy, ix, iy, ix]  # (oc, ph, pw)
+
+        outs = [one_roi(rv[i], int(batch_of[i]))
+                for i in range(rv.shape[0])]
+        return jnp.stack(outs)
+
+    return apply_op("psroi_pool", fn, (x, rois), {})
+
+
+def prroi_pool(x, rois, pooled_height, pooled_width, spatial_scale=1.0,
+               rois_num=None, name=None):
+    """Precise ROI pooling (prroi_pool_op.h): continuous average over each
+    bin.  Approximated by a dense 4x4 bilinear sample grid per bin — the
+    integral limit the reference computes analytically."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    rois_arr = np.asarray(rois._data if isinstance(rois, Tensor) else rois,
+                          np.float32)
+    splits = (np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                         else rois_num, np.int64).reshape(-1)
+              if rois_num is not None else
+              np.array([rois_arr.shape[0]], np.int64))
+    batch_of = np.repeat(np.arange(len(splits)), splits)
+    S = 4
+
+    def fn(xv, rv):
+        def one_roi(roi, b):
+            x1, y1, x2, y2 = [r * spatial_scale for r in
+                              (roi[0], roi[1], roi[2], roi[3])]
+            bin_h = (y2 - y1) / ph
+            bin_w = (x2 - x1) / pw
+            gy = (y1 + jnp.arange(ph)[:, None, None, None] * bin_h
+                  + (jnp.arange(S)[None, None, :, None] + 0.5) * bin_h / S)
+            gx = (x1 + jnp.arange(pw)[None, :, None, None] * bin_w
+                  + (jnp.arange(S)[None, None, None, :] + 0.5) * bin_w / S)
+            gy = jnp.broadcast_to(gy, (ph, pw, S, S))
+            gx = jnp.broadcast_to(gx, (ph, pw, S, S))
+            samp = _bilinear_at(xv[b], gy, gx)
+            return jnp.mean(samp, axis=(-2, -1))  # (C, ph, pw)
+
+        return jnp.stack([one_roi(rv[i], int(batch_of[i]))
+                          for i in range(rv.shape[0])])
+
+    return apply_op("prroi_pool", fn, (x, rois), {})
+
+
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1, im2col_step=1,
+                    bias=None, name=None):
+    """Deformable convolution v1/v2 (deformable_conv_op.h).
+
+    offset (B, 2*dg*kh*kw, Ho, Wo) shifts each kernel tap's sampling
+    point; v2 adds a per-tap modulation mask.  Lowered as: bilinear-gather
+    all taps into an im2col tensor, then one MXU matmul — no per-pixel
+    scalar loops.
+    """
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def fn(xv, off, wv, *rest):
+        mk = rest[0] if rest else None
+        B, C, H, W = xv.shape
+        M, Cg, kh, kw = wv.shape
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        off = off.reshape(B, dg, kh * kw, 2, Ho, Wo)
+
+        base_y = (jnp.arange(Ho) * sh - ph)[:, None]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, :]
+        cols = []  # per tap: (B, C, Ho, Wo)
+        for t in range(kh * kw):
+            i, j = divmod(t, kw)
+            # offset layout (deformable_conv_op kernels): (..., [dy, dx])
+            dy = off[:, :, t, 0]  # (B, dg, Ho, Wo)
+            dx = off[:, :, t, 1]
+            ys = base_y[None, None] + i * dh + dy
+            xs = base_x[None, None] + j * dw + dx
+
+            def samp_b(xb, yb, xbx):
+                # xb (C,H,W); yb/xbx (dg,Ho,Wo) -> (C,Ho,Wo) w/ channel
+                # groups mapped to their deformable group
+                per_g = []
+                cpg = C // dg
+                for g in range(dg):
+                    per_g.append(_bilinear_at(xb[g * cpg:(g + 1) * cpg],
+                                              yb[g], xbx[g]))
+                return jnp.concatenate(per_g, axis=0)
+
+            tap = jax.vmap(samp_b)(xv, ys, xs)  # (B, C, Ho, Wo)
+            if mk is not None:
+                m = mk.reshape(B, dg, kh * kw, Ho, Wo)[:, :, t]
+                m = jnp.repeat(m, C // dg, axis=1)
+                tap = tap * m
+            cols.append(tap)
+        col = jnp.stack(cols, axis=2)  # (B, C, kh*kw, Ho, Wo)
+        col = col.reshape(B, C * kh * kw, Ho * Wo)
+        wmat = wv.reshape(M, Cg * kh * kw)
+        if groups == 1:
+            out = jnp.einsum("mk,bkl->bml", wmat, col)
+        else:
+            cpg = C // groups
+            mpg = M // groups
+            col_g = col.reshape(B, groups, cpg * kh * kw, Ho * Wo)
+            w_g = wmat.reshape(groups, mpg, Cg * kh * kw)
+            out = jnp.einsum("gmk,bgkl->bgml", w_g, col_g).reshape(
+                B, M, Ho * Wo)
+        out = out.reshape(B, M, Ho, Wo)
+        if rest[1:]:
+            out = out + rest[1].reshape(1, -1, 1, 1)
+        return out
+
+    args = (x, offset, weight)
+    if mask is not None:
+        args = args + (mask,)
+    if bias is not None:
+        if mask is None:
+            raise ValueError("bias without mask unsupported; pass mask")
+        args = args + (bias,)
+    return apply_op("deformable_conv", fn, args, {})
+
+
+def random_crop(x, shape, seed=0, name=None):
+    """Random spatial crop to `shape` (random_crop_op.h); seeded threefry,
+    same crop for every sample feature dim left of the cropped dims."""
+    def fn(v):
+        key = jax.random.PRNGKey(seed)
+        starts = []
+        nd = len(shape)
+        for d in range(nd):
+            full = v.shape[v.ndim - nd + d]
+            key, sub = jax.random.split(key)
+            starts.append(jax.random.randint(sub, (), 0,
+                                             max(full - shape[d], 0) + 1))
+        out = jax.lax.dynamic_slice(
+            v, [0] * (v.ndim - nd) + [s for s in starts],
+            list(v.shape[:v.ndim - nd]) + list(shape))
+        return out
+
+    return apply_op("random_crop", fn, (x,), {})
+
+
+def _pad_constant_like(x, y, pad_value=0.0):
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+register_op("pad_constant_like", _pad_constant_like)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with pad_value (pad_constant_like_op.cc)."""
+    return apply_op("pad_constant_like", _pad_constant_like, (x, y),
+                    {"pad_value": float(pad_value)})
+
+
+def partial_concat(inputs, start_index=0, length=-1, name=None):
+    """Concat the [start, start+length) column slice of every input
+    (partial_concat_op.cc)."""
+    def fn(*vs):
+        outs = []
+        for v in vs:
+            end = v.shape[1] if length < 0 else start_index + length
+            outs.append(v[:, start_index:end])
+        return jnp.concatenate(outs, axis=1)
+
+    return apply_op("partial_concat", fn, tuple(inputs), {})
+
+
+def partial_sum(inputs, start_index=0, length=-1, name=None):
+    """Sum the [start, start+length) column slice of every input
+    (partial_sum_op.cc)."""
+    def fn(*vs):
+        acc = None
+        for v in vs:
+            end = v.shape[1] if length < 0 else start_index + length
+            s = v[:, start_index:end]
+            acc = s if acc is None else acc + s
+        return acc
+
+    return apply_op("partial_sum", fn, tuple(inputs), {})
+
+
+def _fsp(x, y):
+    hw = x.shape[2] * x.shape[3]
+    return jnp.einsum("bihw,bjhw->bij", x, y) / hw
+
+
+register_op("fsp", _fsp)
+
+
+def fsp_matrix(x, y, name=None):
+    """Flow-of-solution-procedure matrix for distillation (fsp_op.h)."""
+    return apply_op("fsp", _fsp, (x, y), {})
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4,
+              name=None):
+    """Stats-table normalization (data_norm_op.cc): means/scales derive
+    from accumulated (count, sum, sum-of-squares) rows, no batch stats.
+    Returns (normalized, means, scales)."""
+    def fn(v, bs, bsum, bsq):
+        means = bsum / bs
+        var = bsq / bs - jnp.square(means)
+        scales = 1.0 / jnp.sqrt(var + epsilon)
+        return (v - means[None, :]) * scales[None, :], means, scales
+
+    return apply_op("data_norm", fn,
+                    (x, batch_size, batch_sum, batch_square_sum), {},
+                    n_outputs=3)
+
+
+def cvm(x, use_cvm=True, name=None):
+    """Click-value-model feature transform (cvm_op.h): first two columns
+    are (show, click); use_cvm log-transforms them in place, else they are
+    dropped."""
+    def fn(v):
+        if use_cvm:
+            show = jnp.log(v[:, 0:1] + 1.0)
+            click = jnp.log(v[:, 1:2] + 1.0) - show
+            return jnp.concatenate([show, click, v[:, 2:]], axis=1)
+        return v[:, 2:]
+
+    return apply_op("cvm", fn, (x,), {})
+
+
+def _softmax_mask_ut(x):
+    T1, T2 = x.shape[-2], x.shape[-1]
+    mask = jnp.tril(jnp.ones((T1, T2), jnp.bool_))
+    neg = jnp.asarray(-1e9, x.dtype)
+    return jax.nn.softmax(jnp.where(mask, x, neg), axis=-1)
+
+
+register_op("fused_softmax_mask_upper_triangle", _softmax_mask_ut)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle-masked) softmax
+    (fused_softmax_mask_upper_triangle_op.cu) — XLA fuses mask+softmax
+    into one kernel; the Pallas flash path covers the full attention."""
+    return apply_op("fused_softmax_mask_upper_triangle", _softmax_mask_ut,
+                    (x,), {})
+
+
+def _bilinear_tp(x, y, w, *rest):
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if rest:
+        out = out + rest[0]
+    return out
+
+
+register_op("bilinear_tensor_product", _bilinear_tp)
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """out_k = x W_k y^T (+ b) (bilinear_tensor_product_op.h)."""
+    args = (x, y, weight) + ((bias,) if bias is not None else ())
+    return apply_op("bilinear_tensor_product", _bilinear_tp, args, {})
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """(unique values, index-of-each-input, counts) — eager/host op like
+    the reference's CPU-only kernel (unique_with_counts_op.h)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    vals, inv, counts = np.unique(arr, return_inverse=True,
+                                  return_counts=True)
+    mk = lambda a: to_tensor(np.asarray(a))
+    out, index, cnt = mk(vals), mk(inv.astype(dtype)), mk(
+        counts.astype(dtype))
+    for t in (out, index, cnt):
+        t.stop_gradient = True
+    return out, index, cnt
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   seed=0, dtype="float32", name=None):
+    """Uniform sample whose output_dim_idx dim copies input's
+    input_dim_idx (uniform_random_batch_size_like op)."""
+    from .creation import uniform
+
+    shp = list(shape)
+    src = input.shape[input_dim_idx] if isinstance(input, Tensor) \
+        else np.asarray(input).shape[input_dim_idx]
+    shp[output_dim_idx] = src
+    return uniform(shp, min=min, max=max, seed=seed, dtype=dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    seed=0, dtype="float32", name=None):
+    from .creation import normal
+
+    shp = list(shape)
+    src = input.shape[input_dim_idx] if isinstance(input, Tensor) \
+        else np.asarray(input).shape[input_dim_idx]
+    shp[output_dim_idx] = src
+    return normal(mean=mean, std=std, shape=shp)

@@ -16,11 +16,22 @@ from .io import save_inference_model, load_inference_model, save, load  # noqa: 
 from .amp_static import amp_decorate  # noqa: F401
 from .controlflow import cond, while_loop, switch_case, case  # noqa: F401
 
+from .extras import (  # noqa: F401
+    Print, Assert, py_func, select_input, select_output, assign_value,
+    StaticRNN,
+)
+
 # reference exposes control flow under paddle.static.nn as well
 nn.cond = cond
 nn.while_loop = while_loop
 nn.switch_case = switch_case
 nn.case = case
+nn.Print = Print
+nn.Assert = Assert
+nn.py_func = py_func
+nn.select_input = select_input
+nn.select_output = select_output
+nn.StaticRNN = StaticRNN
 
 
 class InputSpec:

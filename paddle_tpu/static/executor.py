@@ -109,7 +109,7 @@ class CompiledBlock:
             side_effect_ops = {
                 "c_allreduce_sum", "c_allgather", "barrier",
                 "send_v2", "recv_v2", "send", "recv", "listen_and_serv",
-                "save", "load", "print",
+                "save", "load", "print", "assert", "py_func",
             }
             for op in ops:
                 in_names = getattr(op, "in_order", op.input_names())

@@ -1,0 +1,317 @@
+"""Static-graph utility ops: Print / Assert / py_func / select_input /
+select_output / assign_value, and the StaticRNN (recurrent op) builder.
+
+Reference: operators/print_op.cc, assert_op.cc, py_func_op.cc,
+controlflow/select_input_op.cc + select_output_op.cc,
+assign_value_op.cc, recurrent_op.cc (+ fluid/layers/control_flow.py
+StaticRNN:477 — the step-block builder API).
+
+TPU-native lowering: Print uses jax.debug.print (works inside the
+compiled block); Assert raises from a host callback; the recurrent op's
+step block is recorded as a nested BlockDesc (same shape as cond/while)
+and lowered to ONE lax.scan over the time axis — the whole unrolled RNN
+compiles to a single XLA while loop with stacked outputs, instead of the
+reference's per-step sub-scope execution (recurrent_op.cc:270).
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .program import Variable, default_main_program
+from .nn_static import emit
+from .controlflow import _sub_block, _block_fn, _captures, _parent_var
+
+__all__ = ["Print", "Assert", "py_func", "select_input", "select_output",
+           "assign_value", "StaticRNN"]
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both", name=None):
+    """Debug-print a variable's value at execution time (print_op.cc).
+    Passes the value through so downstream ops keep their dataflow edge."""
+    msg = message or ""
+    tag = f"{msg}{input.name if print_tensor_name else ''}"
+
+    def fn(v):
+        jax.debug.print(tag + " = {v}", v=v)
+        return v
+
+    return emit("print", [("In", input)],
+                [("Out", input.shape, input.dtype)], fn,
+                attrs={"message": msg})
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """Abort execution when cond is false (assert_op.cc).  The check runs
+    as a host callback so it fires under jit too."""
+    data_vars = list(data or [])
+
+    def fn(c, *vals):
+        def host_check(cv, *dv):
+            if not bool(np.all(np.asarray(cv))):
+                detail = ", ".join(str(np.asarray(d)[:summarize])
+                                   for d in dv)
+                raise RuntimeError(
+                    f"Assert failed{': ' + detail if detail else ''}")
+            return np.zeros((), np.int32)
+
+        from jax.experimental import io_callback
+
+        # io_callback(ordered=True) is not dead-code-eliminable, so the
+        # check fires even when the token output is never fetched (the op
+        # is also in the executor's side_effect set for plan pruning)
+        token = io_callback(
+            host_check, jax.ShapeDtypeStruct((), jnp.int32), c, *vals,
+            ordered=True)
+        return token
+
+    ins = [("Cond", cond)] + [("Data", d) for d in data_vars]
+    return emit("assert", ins, [("Out", [], "int32")], fn,
+                attrs={"summarize": summarize})
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """Static py_func (py_func_op.cc): call host Python over tensor values
+    through jax.pure_callback; `out` declares result Variables."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    from ..core.dtype import convert_dtype
+
+    specs = tuple(jax.ShapeDtypeStruct(tuple(o.shape), convert_dtype(o.dtype))
+                  for o in outs)
+
+    def host(*arrs):
+        res = func(*[np.asarray(a) for a in arrs])
+        res = res if isinstance(res, (list, tuple)) else (res,)
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, specs))
+
+    if backward_func is None:
+        def fn(*vals):
+            r = jax.pure_callback(host, specs, *vals)
+            return r if len(specs) != 1 else r[0]
+    else:
+        # same custom_vjp wiring as the eager op (ops/framework_ops.py
+        # py_func): backward_func(*inputs, *out_grads) -> input grads
+        @jax.custom_vjp
+        def _core(*vals):
+            r = jax.pure_callback(host, specs, *vals)
+            return r if len(specs) != 1 else r[0]
+
+        def _fwd(*vals):
+            return _core(*vals), vals
+
+        def _bwd(vals, g):
+            gs = g if isinstance(g, tuple) else (g,)
+            in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                             for v in vals)
+
+            def bhost(*args):
+                res = backward_func(*[np.asarray(a) for a in args])
+                res = res if isinstance(res, (list, tuple)) else (res,)
+                return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                             for r, s in zip(res, in_specs))
+
+            return jax.pure_callback(bhost, in_specs, *(vals + gs))
+
+        _core.defvjp(_fwd, _bwd)
+        fn = _core
+
+    return emit("py_func", [("X", v) for v in xs],
+                [("Out", o.shape, o.dtype) for o in outs], fn)
+
+
+def select_input(inputs, mask):
+    """Route one of N inputs forward by a runtime index
+    (controlflow/select_input_op.cc).  All inputs must share shape/dtype
+    (the XLA value-semantic form of the reference's variable passthrough)."""
+    def fn(m, *vals):
+        idx = jnp.clip(jnp.reshape(m, ()).astype(jnp.int32), 0,
+                       len(vals) - 1)
+        return jax.lax.switch(idx, [lambda v=v: v for v in vals])
+
+    x0 = inputs[0]
+    return emit("select_input", [("Mask", mask)] + [("X", v)
+                                                    for v in inputs],
+                [("Out", x0.shape, x0.dtype)], fn)
+
+
+def select_output(input, outputs, mask):
+    """Scatter input to the mask-selected output branch; unselected
+    branches receive zeros (select_output_op.cc — value-semantic form)."""
+    n = len(outputs)
+
+    def fn(m, v):
+        idx = jnp.reshape(m, ()).astype(jnp.int32)
+        return tuple(jnp.where(idx == i, v, jnp.zeros_like(v))
+                     for i in range(n))
+
+    return emit("select_output", [("Mask", mask), ("X", input)],
+                [("Out", input.shape, input.dtype) for _ in range(n)], fn)
+
+
+def assign_value(shape, dtype, values, name=None):
+    """Emit a host constant into the program (assign_value_op.cc)."""
+    from ..core.dtype import convert_dtype
+
+    arr = np.asarray(values, dtype=convert_dtype(dtype)).reshape(shape)
+
+    def fn():
+        return jnp.asarray(arr)
+
+    return emit("assign_value", [], [("Out", list(arr.shape), dtype)], fn,
+                attrs={"shape": list(arr.shape), "dtype": dtype})
+
+
+class StaticRNN:
+    """Step-block RNN builder (fluid/layers/control_flow.py StaticRNN:477,
+    recurrent_op.cc).
+
+    Usage parity with the reference::
+
+        rnn = StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x)          # x is (T, B, D) time-major
+            prev = rnn.memory(init=h0)        # carried state
+            hidden = static.nn.fc(...)        # ops recorded in step block
+            rnn.update_memory(prev, hidden)
+            rnn.step_output(hidden)
+        outs = rnn()                          # (T, B, H) stacked steps
+
+    The recorded step block lowers to one lax.scan: memories are the
+    carry, step inputs are scanned leading-axis slices, step outputs are
+    stacked — a single compiled XLA loop replaces the reference's
+    per-step scope creation.
+    """
+
+    def __init__(self, name=None):
+        self._blk = None
+        self._step_inputs = []   # (step_var, full_var)
+        self._memories = []      # (mem_var, init_var)
+        self._updates = {}       # mem var name -> new var name
+        self._outputs = []       # step-scope Variables
+        self._result = None
+        self._in_step = False
+
+    @contextlib.contextmanager
+    def step(self):
+        with _sub_block() as blk:
+            self._blk = blk
+            self._in_step = True
+            try:
+                yield self
+            finally:
+                self._in_step = False
+        self._emit()
+
+    def _require_step(self):
+        if not self._in_step:
+            raise RuntimeError("StaticRNN.* must be called inside "
+                               "`with rnn.step():`")
+
+    def step_input(self, x):
+        """Declare a (T, ...) sequence; returns its per-step slice var."""
+        self._require_step()
+        v = self._blk.create_var(shape=list(x.shape[1:]), dtype=x.dtype)
+        self._step_inputs.append((v, x))
+        return v
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               dtype="float32"):
+        """Declare carried state from an init Variable (or a filled shape
+        whose batch dim copies batch_ref)."""
+        self._require_step()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init= or shape=+batch_ref=")
+            full = [batch_ref.shape[0] if d == -1 else d for d in shape]
+            parent = default_main_program().block(self._blk.parent_idx)
+            from .nn_static import emit as parent_emit  # same helper
+
+            cur = default_main_program().current_block_idx
+            default_main_program().current_block_idx = parent.idx
+            try:
+                init = parent_emit(
+                    "fill_constant", [],
+                    [("Out", full, dtype)],
+                    lambda: jnp.full(tuple(full), value,
+                                     _jnp_dtype(dtype)))
+            finally:
+                default_main_program().current_block_idx = cur
+        v = self._blk.create_var(shape=list(init.shape), dtype=init.dtype)
+        self._memories.append((v, init))
+        return v
+
+    def update_memory(self, mem, new):
+        self._require_step()
+        self._updates[mem.name] = new.name
+
+    def step_output(self, o):
+        self._require_step()
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _emit(self):
+        if not self._step_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        if not self._outputs:
+            raise ValueError("StaticRNN needs at least one step_output")
+        for mem_v, _ in self._memories:
+            if mem_v.name not in self._updates:
+                raise ValueError(
+                    f"memory {mem_v.name!r} was never update_memory()-ed")
+        blk = self._blk
+        step_names = [v.name for v, _ in self._step_inputs]
+        mem_names = [v.name for v, _ in self._memories]
+        out_names = [o.name for o in self._outputs]
+        new_names = [self._updates[n] for n in mem_names]
+        cap_names = [n for n in _captures(blk)
+                     if n not in step_names and n not in mem_names]
+        run = _block_fn(blk, new_names + out_names,
+                        mem_names + step_names + cap_names)
+        n_mem = len(mem_names)
+        n_step = len(step_names)
+
+        def fn(*vals):
+            seqs = vals[:n_step]
+            inits = vals[n_step:n_step + n_mem]
+            caps = vals[n_step + n_mem:]
+
+            def body(carry, xs_t):
+                res = run(tuple(carry) + tuple(xs_t) + tuple(caps))
+                new_mems = res[:n_mem]
+                outs_t = res[n_mem:]
+                return new_mems, outs_t
+
+            _, stacked = jax.lax.scan(body, tuple(inits), tuple(seqs))
+            return stacked if len(out_names) != 1 else stacked[0]
+
+        block = default_main_program().current_block()
+        ins = ([("X", full) for _, full in self._step_inputs]
+               + [("Mem", init) for _, init in self._memories]
+               + [("Captured", _parent_var(block, n)) for n in cap_names])
+        T = self._step_inputs[0][1].shape[0]
+        outs_spec = [("Out", [T] + list(o.shape), o.dtype)
+                     for o in self._outputs]
+        res = emit("recurrent", ins, outs_spec, fn,
+                   attrs={"sub_block": blk.idx})
+        self._result = res if isinstance(res, list) else [res]
+
+    def __call__(self):
+        if self._result is None:
+            raise RuntimeError("StaticRNN block not built yet")
+        return self._result if len(self._result) != 1 else self._result[0]
+
+
+def _jnp_dtype(dtype):
+    from ..core.dtype import convert_dtype
+
+    return convert_dtype(dtype)

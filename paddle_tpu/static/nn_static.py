@@ -145,7 +145,7 @@ def fc(x, size, weight_attr=None, bias_attr=None, activation=None, name=None):
         ins.append(("Bias", b))
     out = emit("fc", ins, [("Out", [x.shape[0], size], x.dtype)], fn)
     if activation:
-        out = globals()[activation](out)
+        out = _act_emitter(activation)(out)
     return out
 
 
@@ -168,6 +168,17 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
     return emit("matmul_v2", [("X", x), ("Y", y)], [("Out", shape, x.dtype)], fn,
                 attrs={"trans_x": transpose_x, "trans_y": transpose_y,
                        "alpha": alpha})
+
+
+def _act_emitter(name):
+    """Map a reference activation attr string to its static emitter
+    (LayerHelper.append_activation parity)."""
+    table = {"relu": relu, "tanh": tanh_act, "sigmoid": sigmoid_act,
+             "softmax": softmax}
+    if name not in table:
+        raise ValueError(f"unsupported activation attr {name!r}; "
+                         f"one of {sorted(table)}")
+    return table[name]
 
 
 def relu(x, name=None):
@@ -330,10 +341,17 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
                            "paddings": list(p)})
 
 
+_BN_ACTS = {"relu": jax.nn.relu, "tanh": jnp.tanh,
+            "sigmoid": jax.nn.sigmoid}
+
+
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW", name=None):
     from .param_helper import create_parameter
 
+    if act is not None and act not in _BN_ACTS:
+        raise ValueError(f"batch_norm act={act!r} unsupported; "
+                         f"one of {sorted(_BN_ACTS)} or None")
     C = input.shape[1]
     scale = create_parameter([C], "float32", attr=param_attr, default_value=1.0)
     bias = create_parameter([C], "float32", attr=bias_attr, is_bias=True)
@@ -355,8 +373,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
             var_u.reshape(shape) + epsilon
         )
         out = out * sc.reshape(shape) + b.reshape(shape)
-        if act == "relu":
-            out = jax.nn.relu(out)
+        if act:
+            out = _BN_ACTS[act](out)
         return out
 
     return emit("batch_norm",

@@ -1,0 +1,197 @@
+"""Tests for framework-glue ops (ops/framework_ops.py) and static utility
+ops incl. StaticRNN (static/extras.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.core.indexed_slices import IndexedSlices
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_assign_value_size_identity_ops():
+    v = paddle.assign_value([2, 2], "float32", [1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(_np(v), [[1.0, 2.0], [3.0, 4.0]])
+    assert int(_np(paddle.size(v))) == 4
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(_np(paddle.memcpy(x)), 1.0)
+    np.testing.assert_allclose(_np(paddle.share_data(x)), 1.0)
+    assert paddle.nop(x) is x
+
+
+def test_coalesce_tensor_views_and_grad():
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    b = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+    a.stop_gradient = False
+    b.stop_gradient = False
+    views, fused = paddle.coalesce_tensor([a, b])
+    assert list(fused.shape) == [7]
+    np.testing.assert_allclose(_np(views[0]), 1.0)
+    np.testing.assert_allclose(_np(views[1]), 2.0)
+    paddle.sum(fused * fused).backward()
+    np.testing.assert_allclose(np.asarray(a.grad._data), 2.0)
+    np.testing.assert_allclose(np.asarray(b.grad._data), 4.0)
+
+
+def test_queue_ops_roundtrip():
+    try:
+        paddle.queue_generator(["q_test"], capacity=4)
+    except Exception:
+        pytest.skip("native queue unavailable")
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert paddle.enqueue(x, "q_test")
+    y = paddle.dequeue("q_test")
+    np.testing.assert_allclose(_np(y), _np(x))
+
+
+def test_selected_rows_ops():
+    sl = IndexedSlices(np.array([1, 1, 3]),
+                       np.array([[1.0], [2.0], [4.0]], np.float32), (5, 1))
+    merged = paddle.merge_selected_rows(sl)
+    dense = paddle.get_tensor_from_selected_rows(merged)
+    want = np.zeros((5, 1), np.float32)
+    want[1], want[3] = 3.0, 4.0
+    np.testing.assert_allclose(_np(dense), want)
+
+
+def test_py_func_eager_with_backward():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    out = paddle.py_func(lambda v: v * 3.0, x, [2], "float32",
+                         backward_func=lambda v, g: g * 3.0)
+    np.testing.assert_allclose(_np(out), [3.0, 6.0])
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), 3.0)
+
+
+def test_static_print_assert_pyfunc_select():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2], dtype="float32")
+        p = static.Print(x, message="dbg:")
+        y = static.py_func(lambda v: v + 1.0, p, [
+            main.current_block().create_var(shape=[2], dtype="float32")])
+        mask = static.data("mask", [1], dtype="int32")
+        sel = static.select_input([p, y], mask)
+    exe = static.Executor()
+    out, = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32),
+                               "mask": np.array([1], np.int32)},
+                   fetch_list=[sel])
+    np.testing.assert_allclose(out, [2.0, 3.0])
+    out0, = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32),
+                                "mask": np.array([0], np.int32)},
+                    fetch_list=[sel])
+    np.testing.assert_allclose(out0, [1.0, 2.0])
+
+
+def test_static_assert_raises():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2], dtype="float32")
+        cond_v = static.nn.reduce_sum(x)
+        gate = static.nn.less_than(
+            cond_v, static.nn.fill_constant([1], "float32", 10.0)) \
+            if hasattr(static.nn, "fill_constant") else None
+        tok = static.Assert(cond_v, data=[x])
+    exe = static.Executor()
+    # nonzero sum -> truthy -> passes
+    exe.run(main, feed={"x": np.array([1.0, 1.0], np.float32)},
+            fetch_list=[tok])
+    with pytest.raises(Exception):
+        exe.run(main, feed={"x": np.array([0.0, 0.0], np.float32)},
+                fetch_list=[tok])
+
+
+def test_static_assert_fires_even_when_unfetched():
+    """The assert op must not be dead-code-eliminated when only another
+    var is fetched (side_effect plan root + ordered io_callback)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2], dtype="float32")
+        s = static.nn.reduce_sum(x)
+        static.Assert(s, data=[x])
+        y = static.nn.relu(x)
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.array([1.0, 1.0], np.float32)},
+            fetch_list=[y])
+    with pytest.raises(Exception):
+        exe.run(main, feed={"x": np.array([0.0, 0.0], np.float32)},
+                fetch_list=[y])
+
+
+def test_static_pyfunc_backward():
+    """Static py_func with backward_func participates in append_backward."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2], dtype="float32")
+        w = static.create_parameter([2], "float32")
+        xw = x * w
+        out_var = main.current_block().create_var(shape=[2], dtype="float32")
+        y = static.py_func(lambda v: v * 2.0, xw, [out_var],
+                           backward_func=lambda v, g: g * 2.0)
+        loss = static.nn.reduce_sum(y)
+        static.append_backward(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    blk = main.current_block()
+    g_name = w.name + "@GRAD"
+    assert g_name in blk.vars, "py_func blocked gradient flow to the param"
+    res = exe.run(main, feed={"x": np.array([1.0, 3.0], np.float32)},
+                  fetch_list=[blk.vars[g_name]])
+    # d loss/d w = 2 * x
+    np.testing.assert_allclose(res[0], [2.0, 6.0])
+
+
+def test_static_rnn_cumsum():
+    """StaticRNN computing a running sum equals np.cumsum."""
+    T, B, D = 4, 2, 3
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [T, B, D], dtype="float32")
+        h0 = static.data("h0", [B, D], dtype="float32")
+        rnn = static.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            nxt = prev + xt
+            rnn.update_memory(prev, nxt)
+            rnn.step_output(nxt)
+        out = rnn()
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(T, B, D).astype(np.float32)
+    res, = exe.run(main, feed={"x": xv, "h0": np.zeros((B, D), np.float32)},
+                   fetch_list=[out])
+    np.testing.assert_allclose(res, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_with_fc_trains():
+    """A StaticRNN step that uses a learned projection + backward."""
+    T, B, D = 3, 2, 4
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [T, B, D], dtype="float32")
+        h0 = static.data("h0", [B, D], dtype="float32")
+        rnn = static.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            cat = prev + xt
+            hid = static.nn.fc(cat, D, activation="tanh")
+            rnn.update_memory(prev, hid)
+            rnn.step_output(hid)
+        out = rnn()
+        loss = static.nn.mean(out)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).rand(T, B, D).astype(np.float32)
+    h0v = np.zeros((B, D), np.float32)
+    l1, = exe.run(main, feed={"x": xv, "h0": h0v}, fetch_list=[loss])
+    for _ in range(5):
+        l2, = exe.run(main, feed={"x": xv, "h0": h0v}, fetch_list=[loss])
+    assert np.isfinite(l1).all() and np.isfinite(l2).all()
+    assert float(l2) < float(l1)  # SGD on mean() decreases it

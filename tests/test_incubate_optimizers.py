@@ -1,0 +1,73 @@
+"""Tests for LookAhead / ModelAverage / ExponentialMovingAverage
+(incubate/optimizer.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _make_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    lin = paddle.nn.Linear(4, 1)
+    x = paddle.to_tensor(rng.rand(16, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.rand(16, 1).astype(np.float32))
+
+    def loss_fn():
+        return paddle.mean(paddle.square(lin(x) - y))
+
+    return lin, loss_fn
+
+
+def test_lookahead_trains_and_interpolates():
+    lin, loss_fn = _make_problem()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    l0 = float(np.asarray(loss_fn()._data))
+    for _ in range(10):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    l1 = float(np.asarray(loss_fn()._data))
+    assert l1 < l0
+    sd = opt.state_dict()
+    assert sd["@lookahead_step"] == 10
+    opt2 = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    opt2.set_state_dict(sd)
+    assert opt2._step_num == 10
+
+
+def test_model_average_apply_restore():
+    lin, _ = _make_problem(1)
+    p = lin.parameters()[0]
+    ma = paddle.incubate.ModelAverage(0.15, parameters=lin.parameters(),
+                                      min_average_window=2,
+                                      max_average_window=4)
+    vals = []
+    for i in range(3):
+        p._data = p._data * 0.0 + float(i + 1)
+        ma.accumulate()
+        vals.append(float(i + 1))
+    before = np.asarray(p._data).copy()
+    with ma.apply():
+        avg = np.asarray(p._data)
+        np.testing.assert_allclose(avg, np.mean(vals), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p._data), before)
+
+
+def test_ema_apply_restore_bias_corrected():
+    lin, _ = _make_problem(2)
+    p = lin.parameters()[0]
+    ema = paddle.incubate.ExponentialMovingAverage(
+        decay=0.5, parameters=lin.parameters())
+    p._data = p._data * 0.0 + 2.0
+    ema.update()
+    p._data = p._data * 0.0 + 4.0
+    ema.update()
+    before = np.asarray(p._data).copy()
+    with ema.apply():
+        applied = np.asarray(p._data)
+        # zero-init accumulator: ema = .5*(.5*0 + .5*2) + .5*4 = 2.5;
+        # bias-corrected by (1 - 0.5^2): 2.5 / 0.75
+        np.testing.assert_allclose(applied, 2.5 / 0.75, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p._data), before)

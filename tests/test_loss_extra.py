@@ -1,0 +1,164 @@
+"""Golden tests for the loss/metric long tail (ops/loss_extra.py).
+
+Oracle: straight numpy re-derivations of the reference kernel formulas
+(huber_loss_op.h, rank_loss_op.h, bpr_loss_op.h, modified_huber_loss_op.h,
+teacher_student_sigmoid_loss_op.h, mean_iou_op.h, edit_distance_op.h,
+ctc_align_op.h, chunk_eval_op.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_huber_loss_values_and_grad():
+    x = paddle.to_tensor(np.array([0.0, 1.0, 4.0], np.float32))
+    y = paddle.to_tensor(np.array([0.5, 0.0, 0.0], np.float32))
+    x.stop_gradient = False
+    out = paddle.huber_loss(x, y, delta=1.0)
+    r = np.array([0.5, -1.0, -4.0], np.float32)
+    want = np.where(np.abs(r) <= 1.0, 0.5 * r * r, np.abs(r) - 0.5)
+    np.testing.assert_allclose(_np(out), want, rtol=1e-6)
+    loss = paddle.sum(out)
+    loss.backward()
+    # d/dx: -r if |r|<=delta else -delta*sign(r)
+    np.testing.assert_allclose(np.asarray(x.grad._data),
+                               np.array([-0.5, 1.0, 1.0], np.float32),
+                               rtol=1e-6)
+
+
+def test_rank_loss():
+    lbl = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    left = paddle.to_tensor(np.array([2.0, 0.5], np.float32))
+    right = paddle.to_tensor(np.array([1.0, 1.5], np.float32))
+    out = paddle.rank_loss(lbl, left, right)
+    o = np.array([1.0, -1.0])
+    want = np.log1p(np.exp(o)) - np.array([1.0, 0.0]) * o
+    np.testing.assert_allclose(_np(out), want.astype(np.float32), rtol=1e-6)
+
+
+def test_bpr_loss():
+    x = np.array([[2.0, 1.0, 0.0], [0.0, 1.0, 3.0]], np.float32)
+    lbl = np.array([0, 2], np.int64)
+    out = paddle.bpr_loss(paddle.to_tensor(x), paddle.to_tensor(lbl))
+    want = np.zeros((2, 1), np.float32)
+    for i in range(2):
+        pos = x[i, lbl[i]]
+        s = 0.0
+        for j in range(3):
+            if j == lbl[i]:
+                continue
+            s += -np.log(1.0 / (1.0 + np.exp(-(pos - x[i, j]))))
+        want[i, 0] = s / 2
+    np.testing.assert_allclose(_np(out), want, rtol=1e-5)
+
+
+def test_modified_huber_loss():
+    x = paddle.to_tensor(np.array([-2.0, 0.5, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32))
+    out = paddle.modified_huber_loss(x, y)
+    np.testing.assert_allclose(_np(out), np.array([8.0, 0.25, 0.0], np.float32),
+                               rtol=1e-6)
+
+
+def test_teacher_student_sigmoid_loss():
+    x = np.array([0.3, -0.7, 1.2, 0.4], np.float32)
+    lbl = np.array([-2.0, -1.0, 0.6, 1.4], np.float32)
+    out = paddle.teacher_student_sigmoid_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lbl))
+    sp = np.log1p(np.exp(x))
+    want = np.array([sp[0],
+                     sp[1] - x[1],
+                     2 * sp[2] - x[2] * 0.6,
+                     2 * sp[3] - x[3] - x[3] * 0.4], np.float32)
+    np.testing.assert_allclose(_np(out), want, rtol=1e-5)
+
+
+def test_center_loss_updates_centers():
+    x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    centers = np.zeros((3, 2), np.float32)
+    lbl = np.array([0, 0], np.int64)
+    loss, c_out = paddle.center_loss(
+        paddle.to_tensor(x), paddle.to_tensor(lbl),
+        paddle.to_tensor(centers), alpha=0.5)
+    np.testing.assert_allclose(_np(loss).reshape(-1), [0.5, 0.5], rtol=1e-6)
+    # diff sum for class 0 = (0-1,0-0)+(0-0,0-1) = (-1,-1); count 2
+    # c0 -= 0.5 * (-1,-1)/(1+2)
+    np.testing.assert_allclose(_np(c_out)[0], [1.0 / 6, 1.0 / 6], rtol=1e-5)
+    np.testing.assert_allclose(_np(c_out)[1:], 0.0)
+
+
+def test_norm_family():
+    x = np.array([[3.0, 4.0]], np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(_np(paddle.squared_l2_norm(t)), [25.0])
+    np.testing.assert_allclose(_np(paddle.l1_norm(t)), [7.0])
+    np.testing.assert_allclose(_np(paddle.clip_by_norm(t, 1.0)),
+                               [[0.6, 0.8]], rtol=1e-6)
+    np.testing.assert_allclose(_np(paddle.clip_by_norm(t, 10.0)), x)
+    y = paddle.to_tensor(np.array([[1.0, 0.0]], np.float32))
+    np.testing.assert_allclose(_np(paddle.cos_sim(t, y)), [[0.6]], rtol=1e-6)
+    d = paddle.squared_l2_distance(t, y)
+    np.testing.assert_allclose(_np(d), [20.0], rtol=1e-6)
+
+
+def test_mean_iou():
+    pred = paddle.to_tensor(np.array([0, 1, 1, 2], np.int32))
+    lbl = paddle.to_tensor(np.array([0, 1, 2, 2], np.int32))
+    miou, wrong, correct = paddle.mean_iou(pred, lbl, 3)
+    # class0: i=1,u=1 -> 1; class1: i=1,u=2 -> .5; class2: i=1,u=2 -> .5
+    np.testing.assert_allclose(float(_np(miou)), (1 + 0.5 + 0.5) / 3,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(_np(correct), [1, 1, 1])
+    np.testing.assert_array_equal(_np(wrong), [0, 1, 0])
+
+
+def test_edit_distance():
+    inp = paddle.to_tensor(np.array([[1, 2, 3, 0]], np.int64))
+    lbl = paddle.to_tensor(np.array([[1, 3, 3, 0]], np.int64))
+    d, n = paddle.edit_distance(inp, lbl,
+                                input_length=np.array([3]),
+                                label_length=np.array([3]),
+                                normalized=False)
+    np.testing.assert_allclose(_np(d), [[1.0]])
+    assert int(_np(n)[0]) == 1
+    d2, _ = paddle.edit_distance(inp, lbl,
+                                 input_length=np.array([3]),
+                                 label_length=np.array([3]))
+    np.testing.assert_allclose(_np(d2), [[1.0 / 3]], rtol=1e-6)
+
+
+def test_ctc_align():
+    inp = paddle.to_tensor(np.array([[1, 1, 0, 2, 2, 0, 3]], np.int32))
+    out, lens = paddle.ctc_align(inp, blank=0)
+    np.testing.assert_array_equal(_np(out)[0, :3], [1, 2, 3])
+    assert int(_np(lens)[0, 0]) == 3
+
+
+def test_positive_negative_pair():
+    score = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32))
+    lbl = paddle.to_tensor(np.array([1.0, 0.0, 2.0], np.float32))
+    qid = paddle.to_tensor(np.array([0, 0, 0], np.int64))
+    p, n, u = paddle.positive_negative_pair(score, lbl, qid)
+    # pairs: (0,1): s+ l+ ok; (0,2): s+ l- wrong; (1,2): s- l- ok
+    assert float(_np(p)[0]) == 2.0
+    assert float(_np(n)[0]) == 1.0
+    assert float(_np(u)[0]) == 0.0
+
+
+def test_chunk_eval_iob():
+    # tags: type0 B=0 I=1, outside=2
+    inf = np.array([[0, 1, 2, 0]], np.int64)
+    lab = np.array([[0, 1, 2, 2]], np.int64)
+    prec, rec, f1, ni, nl, nc = paddle.chunk_eval(
+        paddle.to_tensor(inf), paddle.to_tensor(lab),
+        chunk_scheme="IOB", num_chunk_types=1)
+    assert int(_np(ni)[0]) == 2 and int(_np(nl)[0]) == 1
+    assert int(_np(nc)[0]) == 1
+    np.testing.assert_allclose(float(_np(prec)[0]), 0.5)
+    np.testing.assert_allclose(float(_np(rec)[0]), 1.0)
+    np.testing.assert_allclose(float(_np(f1)[0]), 2 * 0.5 / 1.5, rtol=1e-6)

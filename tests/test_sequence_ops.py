@@ -1,0 +1,247 @@
+"""Golden tests for sequence-family + classic-NLP ops (ops/sequence_ops.py).
+
+Oracles: brute-force numpy dynamic programs (CRF enumeration over all tag
+paths, Viterbi by enumeration, circular conv by definition) on tiny shapes.
+"""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _crf_brute(emission, transition, label, length):
+    """Enumerate all paths: log p(gold) - log Z."""
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    B, T, N = emission.shape
+    out = np.zeros((B, 1), np.float64)
+    for b in range(B):
+        L = int(length[b])
+
+        def score(path):
+            s = start[path[0]] + emission[b, 0, path[0]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]] + emission[b, t, path[t]]
+            return s + stop[path[L - 1]]
+
+        z = np.logaddexp.reduce(
+            [score(p) for p in itertools.product(range(N), repeat=L)])
+        out[b, 0] = score([int(v) for v in label[b, :L]]) - z
+    return out
+
+
+def test_linear_chain_crf_matches_enumeration():
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 3, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    tr = rng.randn(N + 2, N).astype(np.float32)
+    lbl = rng.randint(0, N, (B, T)).astype(np.int64)
+    lens = np.array([3, 2], np.int64)
+    ll = paddle.linear_chain_crf(
+        paddle.to_tensor(em), paddle.to_tensor(tr),
+        paddle.to_tensor(lbl), paddle.to_tensor(lens))
+    want = _crf_brute(em.astype(np.float64), tr.astype(np.float64),
+                      lbl, lens)
+    np.testing.assert_allclose(_np(ll), want, rtol=1e-4, atol=1e-5)
+
+
+def test_crf_training_improves_likelihood():
+    rng = np.random.RandomState(1)
+    B, T, N = 4, 5, 3
+    em_t = paddle.to_tensor(rng.randn(B, T, N).astype(np.float32) * 0.1)
+    tr = paddle.to_tensor(rng.randn(N + 2, N).astype(np.float32) * 0.1)
+    tr.stop_gradient = False
+    lbl = paddle.to_tensor(rng.randint(0, N, (B, T)).astype(np.int64))
+    lens = paddle.to_tensor(np.full((B,), T, np.int64))
+    opt_losses = []
+    for _ in range(20):
+        ll = paddle.linear_chain_crf(em_t, tr, lbl, lens)
+        loss = -paddle.mean(ll)
+        loss.backward()
+        tr._data = tr._data - 0.5 * tr.grad._data
+        tr.clear_grad()
+        opt_losses.append(float(_np(loss)))
+    assert opt_losses[-1] < opt_losses[0]
+
+
+def test_crf_decoding_matches_enumeration():
+    rng = np.random.RandomState(2)
+    B, T, N = 2, 4, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    tr = rng.randn(N + 2, N).astype(np.float32)
+    lens = np.array([4, 3], np.int64)
+    path = paddle.crf_decoding(paddle.to_tensor(em), paddle.to_tensor(tr),
+                               paddle.to_tensor(lens))
+    got = _np(path)
+    start, stop, trans = tr[0], tr[1], tr[2:]
+    for b in range(B):
+        L = int(lens[b])
+        best, best_s = None, -np.inf
+        for p in itertools.product(range(N), repeat=L):
+            s = start[p[0]] + em[b, 0, p[0]]
+            for t in range(1, L):
+                s += trans[p[t - 1], p[t]] + em[b, t, p[t]]
+            s += stop[p[L - 1]]
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(got[b, :L], best)
+        assert (got[b, L:] == 0).all()
+
+
+def test_nce_and_sample_logits_and_sampling_id():
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(20, 8).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(20).astype(np.float32))
+    lbl = paddle.to_tensor(rng.randint(0, 20, (4,)).astype(np.int64))
+    x.stop_gradient = False
+    cost = paddle.nce(x, w, lbl, bias=b, num_total_classes=20,
+                      num_neg_samples=5)
+    assert cost.shape == [4, 1]
+    paddle.sum(cost).backward()
+    assert x.grad is not None
+
+    logits = paddle.to_tensor(rng.randn(4, 20).astype(np.float32))
+    picked, ids = paddle.sample_logits(logits, lbl, num_samples=6)
+    assert list(picked.shape) == [4, 7] and list(ids.shape) == [4, 7]
+    np.testing.assert_array_equal(_np(ids)[:, 0], _np(lbl).reshape(-1))
+    lg, iid = _np(logits), _np(ids)
+    np.testing.assert_allclose(
+        _np(picked), np.take_along_axis(lg, iid.astype(np.int64), axis=1))
+
+    probs = paddle.to_tensor(np.array([[0.0, 1.0, 0.0]], np.float32))
+    sid = paddle.sampling_id(probs)
+    assert int(_np(sid)[0]) == 1
+
+
+def test_beam_search_step_and_decode():
+    # batch=1, beam=2, K=2 candidates per beam
+    pre_ids = paddle.to_tensor(np.array([[5], [6]], np.int64))
+    pre_scores = paddle.to_tensor(np.array([[0.0], [-1.0]], np.float32))
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+    scores = paddle.to_tensor(
+        np.array([[0.5, 0.1], [2.0, -3.0]], np.float32))
+    sel_ids, sel_scores, parent = paddle.beam_search(
+        pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0)
+    # best two accumulated: 2.0 (beam1,id3), 0.5 (beam0,id1)
+    np.testing.assert_array_equal(_np(sel_ids).reshape(-1), [3, 1])
+    np.testing.assert_allclose(_np(sel_scores).reshape(-1), [2.0, 0.5])
+    np.testing.assert_array_equal(_np(parent), [1, 0])
+
+    # finished beam (pre_id == end_id) propagates itself with frozen score
+    pre_ids2 = paddle.to_tensor(np.array([[0], [6]], np.int64))
+    s2, sc2, p2 = paddle.beam_search(
+        pre_ids2, pre_scores, ids, scores, beam_size=2, end_id=0)
+    got = list(_np(s2).reshape(-1))
+    assert 0 in got  # the finished beam survived as end_id
+
+    # decode: T=2 steps, batch=1, beam=2
+    step_ids = [paddle.to_tensor(np.array([[7], [8]], np.int64)), sel_ids]
+    step_parents = [paddle.to_tensor(np.array([[0], [1]], np.int64)),
+                    parent]
+    seqs = paddle.beam_search_decode(step_ids, step_parents, beam_size=2,
+                                     end_id=0)
+    out = _np(seqs)  # (T, batch, beam)
+    assert out.shape == (2, 1, 2)
+    # winner beam0 at final step came from parent 1 -> token 8 then 3
+    np.testing.assert_array_equal(out[:, 0, 0], [8, 3])
+
+
+def test_add_position_encoding():
+    x = np.zeros((1, 3, 4), np.float32)
+    out = _np(paddle.add_position_encoding(paddle.to_tensor(x),
+                                           alpha=1.0, beta=1.0))
+    # position 0: sin(0)=0, cos(0)=1
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 1.0, 1.0], atol=1e-6)
+    assert abs(out[0, 1, 0] - np.sin(1.0)) < 1e-5
+    assert abs(out[0, 1, 2] - np.cos(1.0)) < 1e-5
+
+
+def test_im2sequence_row_conv_conv_shift():
+    x = paddle.to_tensor(
+        np.arange(16).reshape(1, 1, 4, 4).astype(np.float32))
+    seq = paddle.im2sequence(x, filter_size=2, stride=2)
+    assert list(seq.shape) == [4, 4]
+    np.testing.assert_allclose(_np(seq)[0], [0, 1, 4, 5])
+
+    xr = paddle.to_tensor(np.ones((1, 3, 2), np.float32))
+    wr = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = _np(paddle.row_conv(xr, wr))
+    # t=0: x[0]+x[1] = 2; t=2: only x[2] -> 1
+    np.testing.assert_allclose(out[0, :, 0], [2.0, 2.0, 1.0])
+
+    xs = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    ys = np.array([[0.0, 1.0, 0.0]], np.float32)  # identity kernel
+    got = _np(paddle.conv_shift(paddle.to_tensor(xs), paddle.to_tensor(ys)))
+    np.testing.assert_allclose(got, xs, rtol=1e-6)
+
+
+def test_segment_pool():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [4.0], [8.0]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+    np.testing.assert_allclose(_np(paddle.segment_sum(x, ids)),
+                               [[3.0], [12.0]])
+    np.testing.assert_allclose(_np(paddle.segment_mean(x, ids)),
+                               [[1.5], [6.0]])
+    np.testing.assert_allclose(_np(paddle.segment_max(x, ids)),
+                               [[2.0], [8.0]])
+    np.testing.assert_allclose(_np(paddle.segment_min(x, ids)),
+                               [[1.0], [4.0]])
+
+
+def test_sequence_pool_softmax_reverse():
+    x = np.array([[1.0, 2.0, 9.0], [3.0, 9.0, 9.0]], np.float32)
+    lens = np.array([2, 1], np.int64)
+    xt, lt = paddle.to_tensor(x[..., None]), paddle.to_tensor(lens)
+    np.testing.assert_allclose(
+        _np(paddle.sequence_pool(xt, lt, "sum")).reshape(-1), [3.0, 3.0])
+    np.testing.assert_allclose(
+        _np(paddle.sequence_pool(xt, lt, "average")).reshape(-1), [1.5, 3.0])
+    np.testing.assert_allclose(
+        _np(paddle.sequence_pool(xt, lt, "max")).reshape(-1), [2.0, 3.0])
+    np.testing.assert_allclose(
+        _np(paddle.sequence_last_step(xt, lt)).reshape(-1), [2.0, 3.0])
+    np.testing.assert_allclose(
+        _np(paddle.sequence_first_step(xt, lt)).reshape(-1), [1.0, 3.0])
+
+    sm = _np(paddle.sequence_softmax(paddle.to_tensor(x), lt))
+    e = np.exp([1.0, 2.0])
+    np.testing.assert_allclose(sm[0], list(e / e.sum()) + [0.0], rtol=1e-6)
+    np.testing.assert_allclose(sm[1], [1.0, 0.0, 0.0], atol=1e-7)
+
+    rv = _np(paddle.sequence_reverse(paddle.to_tensor(x), lt))
+    np.testing.assert_allclose(rv[0], [2.0, 1.0, 9.0])
+    np.testing.assert_allclose(rv[1], [3.0, 9.0, 9.0])
+
+
+def test_sequence_pad_unpad_expand_roundtrip():
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lens = np.array([3, 2], np.int64)
+    padded, out_lens = paddle.sequence_pad(paddle.to_tensor(flat), lens,
+                                           pad_value=-1.0)
+    assert list(padded.shape) == [2, 3, 2]
+    np.testing.assert_allclose(_np(padded)[1, 2], [-1.0, -1.0])
+    back = paddle.sequence_unpad(padded, out_lens)
+    np.testing.assert_allclose(_np(back), flat)
+
+    ex = paddle.sequence_expand(paddle.to_tensor(flat[:2]),
+                                np.array([2, 1], np.int64))
+    np.testing.assert_allclose(_np(ex), flat[[0, 0, 1]])
+
+
+def test_sequence_conv_identity_window():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    # context_length=1, context_start=0 with identity weight = masked copy
+    w = np.eye(3, dtype=np.float32)
+    lens = np.array([4, 2], np.int64)
+    out = _np(paddle.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                                   paddle.to_tensor(lens),
+                                   context_length=1, context_start=0))
+    np.testing.assert_allclose(out[0], x[0], rtol=1e-6)
+    np.testing.assert_allclose(out[1, :2], x[1, :2], rtol=1e-6)
+    np.testing.assert_allclose(out[1, 2:], 0.0)

@@ -1,0 +1,205 @@
+"""Golden tests for the vision/image op family (ops/vision_extra.py).
+
+Oracles: direct numpy constructions (block rearrangement, scatter,
+bilinear interpolation by hand on aligned grid points).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_affine_channel():
+    x = np.ones((1, 2, 2, 2), np.float32)
+    s = np.array([2.0, 3.0], np.float32)
+    b = np.array([0.5, -0.5], np.float32)
+    out = _np(paddle.affine_channel(paddle.to_tensor(x), paddle.to_tensor(s),
+                                    paddle.to_tensor(b)))
+    np.testing.assert_allclose(out[0, 0], 2.5)
+    np.testing.assert_allclose(out[0, 1], 2.5)
+
+
+def test_shuffle_channel():
+    x = np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)
+    out = _np(paddle.shuffle_channel(paddle.to_tensor(x), group=2))
+    # groups [0,1],[2,3] -> interleave: 0,2,1,3
+    np.testing.assert_array_equal(out[0, :, 0, 0], [0, 4, 2, 6])
+
+
+def test_space_to_depth():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = _np(paddle.space_to_depth(paddle.to_tensor(x), 2))
+    assert out.shape == (1, 4, 2, 2)
+    # channel 0 = top-left of each 2x2 block
+    np.testing.assert_array_equal(out[0, 0], [[0, 2], [8, 10]])
+
+
+def test_spp():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 8, 8)
+                         .astype(np.float32))
+    out = paddle.spp(x, pyramid_height=2, pool_type="max")
+    assert list(out.shape) == [2, 3 * (1 + 4)]
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    x = np.array([[[[1.0, 2.0, 5.0, 3.0],
+                    [4.0, 0.0, 1.0, 1.0],
+                    [0.0, 7.0, 2.0, 9.0],
+                    [6.0, 1.0, 3.0, 0.0]]]], np.float32)
+    t = paddle.to_tensor(x)
+    out, idx = paddle.max_pool2d_with_index(t, 2)
+    np.testing.assert_allclose(_np(out)[0, 0], [[4.0, 5.0], [7.0, 9.0]])
+    # flat H*W indices of those maxima
+    np.testing.assert_array_equal(_np(idx)[0, 0], [[4, 2], [9, 11]])
+    up = paddle.max_unpool2d(out, idx, 2)
+    want = np.zeros_like(x)
+    want[0, 0, 1, 0] = 4.0
+    want[0, 0, 0, 2] = 5.0
+    want[0, 0, 2, 1] = 7.0
+    want[0, 0, 2, 3] = 9.0
+    np.testing.assert_allclose(_np(up), want)
+
+
+def test_max_pool_with_index_grad():
+    x = paddle.to_tensor(np.random.RandomState(1).rand(1, 1, 4, 4)
+                         .astype(np.float32))
+    x.stop_gradient = False
+    out, idx = paddle.max_pool2d_with_index(x, 2)
+    paddle.sum(out).backward()
+    g = np.asarray(x.grad._data)
+    assert g.sum() == 4.0 and ((g == 0) | (g == 1)).all()
+
+
+def test_psroi_pool():
+    # C = oc*ph*pw = 1*2*2 = 4; constant planes make averaging exact
+    planes = np.stack([np.full((8, 8), v, np.float32)
+                       for v in [1.0, 2.0, 3.0, 4.0]])
+    x = paddle.to_tensor(planes[None])
+    rois = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], np.float32))
+    out = paddle.psroi_pool(x, rois, output_channels=1, spatial_scale=1.0,
+                            pooled_height=2, pooled_width=2)
+    # bin (iy,ix) reads channel iy*2+ix -> [[1,2],[3,4]]
+    np.testing.assert_allclose(_np(out)[0, 0], [[1.0, 2.0], [3.0, 4.0]],
+                               rtol=1e-5)
+
+
+def test_prroi_pool_constant():
+    x = paddle.to_tensor(np.full((1, 2, 6, 6), 5.0, np.float32))
+    rois = paddle.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], np.float32))
+    out = paddle.prroi_pool(x, rois, 2, 2, spatial_scale=1.0)
+    np.testing.assert_allclose(_np(out), 5.0, rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 2, 5, 5).astype(np.float32)
+    w = rng.rand(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 3, 3), np.float32)
+    got = _np(paddle.deformable_conv(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w)))
+    import paddle_tpu.nn.functional as F
+
+    want = _np(F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_v2_mask_scales():
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 1, 4, 4).astype(np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    mask = np.full((1, 1, 4, 4), 0.5, np.float32)
+    got = _np(paddle.deformable_conv(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        mask=paddle.to_tensor(mask)))
+    np.testing.assert_allclose(got[0, 0], x[0, 0] * 0.5, rtol=1e-6)
+
+
+def test_random_crop_shape_and_content():
+    x = paddle.to_tensor(np.arange(36, dtype=np.float32).reshape(1, 6, 6))
+    out = paddle.random_crop(x, [3, 3], seed=7)
+    assert list(out.shape) == [1, 3, 3]
+    big = _np(x)[0]
+    win = _np(out)[0]
+    found = any(np.array_equal(big[i:i + 3, j:j + 3], win)
+                for i in range(4) for j in range(4))
+    assert found
+
+
+def test_pad_constant_like_partial_ops():
+    x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    y = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = _np(paddle.pad_constant_like(x, y, pad_value=9.0))
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out[:2, :2], 1.0)
+    np.testing.assert_allclose(out[2:, :], 9.0)
+
+    a = paddle.to_tensor(np.array([[1.0, 2.0, 3.0]], np.float32))
+    b = paddle.to_tensor(np.array([[4.0, 5.0, 6.0]], np.float32))
+    pc = _np(paddle.partial_concat([a, b], start_index=1, length=2))
+    np.testing.assert_allclose(pc, [[2.0, 3.0, 5.0, 6.0]])
+    ps = _np(paddle.partial_sum([a, b], start_index=0, length=2))
+    np.testing.assert_allclose(ps, [[5.0, 7.0]])
+
+
+def test_fsp_matrix():
+    x = np.ones((1, 2, 2, 2), np.float32)
+    y = np.full((1, 3, 2, 2), 2.0, np.float32)
+    out = _np(paddle.fsp_matrix(paddle.to_tensor(x), paddle.to_tensor(y)))
+    assert out.shape == (1, 2, 3)
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_data_norm_and_cvm():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    bs = np.array([2.0, 2.0], np.float32)
+    bsum = np.array([4.0, 6.0], np.float32)
+    bsq = np.array([10.0, 20.0], np.float32)
+    out, means, scales = paddle.data_norm(
+        paddle.to_tensor(x), paddle.to_tensor(bs), paddle.to_tensor(bsum),
+        paddle.to_tensor(bsq))
+    np.testing.assert_allclose(_np(means), [2.0, 3.0])
+    want_scale = 1.0 / np.sqrt(np.array([1.0, 1.0]) + 1e-4)
+    np.testing.assert_allclose(_np(scales), want_scale, rtol=1e-5)
+
+    feat = np.array([[3.0, 1.0, 7.0]], np.float32)
+    out = _np(paddle.cvm(paddle.to_tensor(feat), use_cvm=True))
+    np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], np.log(2.0) - np.log(4.0),
+                               rtol=1e-6)
+    out2 = _np(paddle.cvm(paddle.to_tensor(feat), use_cvm=False))
+    np.testing.assert_allclose(out2, [[7.0]])
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    x = paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32))
+    out = _np(paddle.softmax_mask_fuse_upper_triangle(x))[0, 0]
+    np.testing.assert_allclose(out[0], [1.0, 0.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(out[2], [1 / 3] * 3, rtol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    x = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))
+    y = paddle.to_tensor(np.array([[3.0, 4.0]], np.float32))
+    w = paddle.to_tensor(np.ones((2, 2, 2), np.float32))
+    b = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+    out = _np(paddle.bilinear_tensor_product(x, y, w, b))
+    # x W y^T = (1+2)(3+4) = 21
+    np.testing.assert_allclose(out, [[21.5, 20.5]])
+
+
+def test_unique_with_counts_and_batch_size_like():
+    x = paddle.to_tensor(np.array([2, 3, 3, 1, 5, 3], np.int64))
+    vals, index, counts = paddle.unique_with_counts(x)
+    np.testing.assert_array_equal(_np(vals), [1, 2, 3, 5])
+    np.testing.assert_array_equal(_np(counts), [1, 1, 3, 1])
+    np.testing.assert_array_equal(_np(index), [1, 2, 2, 0, 3, 2])
+
+    ref = paddle.to_tensor(np.zeros((5, 7), np.float32))
+    u = paddle.uniform_random_batch_size_like(ref, [1, 3])
+    assert list(u.shape) == [5, 3]
+    g = paddle.gaussian_random_batch_size_like(ref, [1, 3])
+    assert list(g.shape) == [5, 3]
