@@ -284,8 +284,10 @@ def bench_gpt_zero(jax, on_tpu):
 
     paddle.seed(0)
     if on_tpu:
+        # flash attention needs attn_dropout=0 (residual/MLP dropout stays)
         cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
-                        num_heads=12, max_seq_len=512, dropout=0.1)
+                        num_heads=12, max_seq_len=512, dropout=0.1,
+                        attn_dropout=0.0, use_flash=True)
         B, L, warmup, iters = 8, 512, 3, 10
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2,

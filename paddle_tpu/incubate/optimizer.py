@@ -177,8 +177,13 @@ class ExponentialMovingAverage:
 
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
+        if self._t == 0:
+            # no update() yet: the accumulator is still zero — swapping it
+            # in would silently evaluate an all-zero model
+            yield
+            return
         self._saved = {p.name: p._data for p in self._parameters}
-        corr = 1.0 - self.decay ** max(self._t, 1)
+        corr = 1.0 - self.decay ** self._t
         for p in self._parameters:
             p._data = self._ema[p.name] / corr
         try:

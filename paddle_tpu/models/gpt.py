@@ -30,7 +30,8 @@ from ..parallel.sharding_annotations import shard_activation
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden=None, max_seq_len=1024,
-                 dropout=0.1, use_flash=False, remat=False, cp_mode="ring"):
+                 dropout=0.1, attn_dropout=None, use_flash=False,
+                 remat=False, cp_mode="ring"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -38,6 +39,9 @@ class GPTConfig:
         self.ffn_hidden = ffn_hidden or 4 * hidden_size
         self.max_seq_len = max_seq_len
         self.dropout = dropout
+        # attention-weight dropout; 0.0 keeps the Pallas flash path eligible
+        # while residual/MLP dropout stays on (the flash kernel contract)
+        self.attn_dropout = dropout if attn_dropout is None else attn_dropout
         self.use_flash = use_flash
         self.remat = remat
         # context parallelism ('ring' | 'ulysses'), active automatically when
@@ -68,7 +72,7 @@ class GPTAttention(Layer):
         self.head_dim = h // config.num_heads
         self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
         self.out_proj = RowParallelLinear(h, h, input_is_parallel=True)
-        self.dropout = config.dropout
+        self.dropout = config.attn_dropout
         self.use_flash = config.use_flash
         self.cp_mode = config.cp_mode
 

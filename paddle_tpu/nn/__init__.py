@@ -8,7 +8,10 @@ from .layers.common import (  # noqa: F401
     UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
     CosineSimilarity, Bilinear, Unfold, Fold,
 )
-from .layers.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
+from .layers.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose,
+    Conv3DTranspose,
+)
 from .layers.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, GroupNorm,
@@ -17,7 +20,7 @@ from .layers.norm import (  # noqa: F401
 from .layers.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
-    AdaptiveMaxPool2D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
 )
 from .layers.activation import (  # noqa: F401
     ReLU, ReLU6, Sigmoid, LogSigmoid, Tanh, Silu, Swish, Mish, Softsign,
@@ -31,7 +34,7 @@ from .layers.container import (  # noqa: F401
 from .layers.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, BCELoss,
     BCEWithLogitsLoss, NLLLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
-    PairwiseDistance,
+    PairwiseDistance, HSigmoidLoss,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -41,6 +44,7 @@ from .layers.rnn import (  # noqa: F401
     SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU,
     RNNCellBase,
 )
+from .layers.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from ..core.autograd import no_grad  # noqa: F401
 
 

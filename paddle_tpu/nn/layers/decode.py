@@ -1,0 +1,135 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/fluid/layers/rnn.py BeamSearchDecoder:58 (step
+expansion, finished-beam freezing, end-token forcing) and dynamic_decode
+:58/:1003 (step loop + gather_tree finalize); operators/beam_search_op.h
+and gather_tree_op.cc do the per-step selection/backtrack.
+
+TPU-native design: beams ride a flattened (batch*beam) leading axis so
+the wrapped cell runs one batched step per timestep (MXU-friendly); the
+per-step top-k expansion reuses ops.sequence_ops.beam_search and the
+final backtrack is gather_tree — the same two kernels the reference's
+static decoder emits.  The loop itself is an eager Python loop (dygraph
+parity; the reference's dygraph path loops in Python too).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _tile_beam(t, beam_size):
+    """(B, ...) -> (B*beam, ...) by repeating each row beam_size times
+    (BeamSearchDecoder.tile_beam_merge_with_batch)."""
+    v = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+    v = jnp.repeat(v, beam_size, axis=0)
+    out = to_tensor(np.asarray(v))
+    out.stop_gradient = True
+    return out
+
+
+def _map_state(state, fn):
+    if isinstance(state, (list, tuple)):
+        return type(state)(_map_state(s, fn) for s in state)
+    return fn(state)
+
+
+class BeamSearchDecoder:
+    """Beam-search wrapper over an RNN cell (fluid/layers/rnn.py:58).
+
+    embedding_fn maps (B*beam,) int ids -> cell inputs; output_fn maps
+    cell outputs -> vocab logits.  Both default to identity like the
+    reference (then the cell must accept ids / emit logits itself).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        return _tile_beam(x, beam_size)
+
+    def initialize(self, initial_cell_states):
+        """Tile cell states over beams; first beam active, rest -inf."""
+        K = self.beam_size
+        states = _map_state(initial_cell_states,
+                            lambda s: _tile_beam(s, K))
+        some = initial_cell_states
+        while isinstance(some, (list, tuple)):
+            some = some[0]
+        B = some.shape[0]
+        ids = to_tensor(np.full((B * K, 1), self.start_token, np.int64))
+        log_probs = np.full((B, K), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        scores = to_tensor(log_probs.reshape(B * K, 1))
+        return ids, scores, states
+
+    def step(self, ids, scores, cell_states):
+        """One expansion: embed -> cell -> logits -> top-k over beams.
+        Returns (sel_ids, sel_scores, parent_idx, gathered_states)."""
+        from ...ops.sequence_ops import beam_search
+        from ...ops import manipulation as M
+
+        inputs = ids.reshape([-1]) if self.embedding_fn is None \
+            else self.embedding_fn(ids.reshape([-1]))
+        out, new_states = self.cell(inputs, cell_states)
+        logits = out if self.output_fn is None else self.output_fn(out)
+        V = logits.shape[-1]
+        import jax
+
+        logp = to_tensor(np.asarray(
+            jax.nn.log_softmax(logits._data, axis=-1)))
+        # accumulated candidate scores: (B*K, V)
+        acc = to_tensor(np.asarray(scores._data + logp._data))
+        cand_ids = to_tensor(
+            np.tile(np.arange(V, dtype=np.int64)[None, :],
+                    (acc.shape[0], 1)))
+        sel_ids, sel_scores, parent = beam_search(
+            ids, scores, cand_ids, acc, beam_size=self.beam_size,
+            end_id=self.end_token, is_accumulated=True)
+        par = np.asarray(parent._data).astype(np.int64)
+        gathered = _map_state(
+            new_states,
+            lambda s: to_tensor(np.asarray(s._data[par])))
+        return sel_ids, sel_scores, parent, gathered
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   return_length=False, **kwargs):
+    """Run the decoder until every beam emits end_token or max_step_num
+    (fluid/layers/rnn.py dynamic_decode).  Returns (ids (B, T, beam),
+    scores) [+ lengths], backtracked through gather_tree."""
+    from ...ops.sequence_ops import beam_search_decode
+
+    if max_step_num is None:
+        max_step_num = 32
+    ids, scores, states = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    for _ in range(int(max_step_num)):
+        ids, scores, parent, states = decoder.step(ids, scores, states)
+        step_ids.append(ids)
+        step_parents.append(parent)
+        arr = np.asarray(ids._data).reshape(-1)
+        if (arr == decoder.end_token).all():
+            break
+    seqs = beam_search_decode(step_ids, step_parents,
+                              beam_size=decoder.beam_size,
+                              end_id=decoder.end_token)  # (T, B, beam)
+    out = seqs if output_time_major else to_tensor(
+        np.transpose(np.asarray(seqs._data), (1, 0, 2)))
+    out.stop_gradient = True
+    if return_length:
+        arr = np.asarray(seqs._data)  # (T, B, K)
+        not_end = arr != decoder.end_token
+        lengths = to_tensor(not_end.sum(axis=0).astype(np.int64))
+        lengths.stop_gradient = True
+        return out, scores, lengths
+    return out, scores

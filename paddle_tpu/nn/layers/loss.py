@@ -123,3 +123,26 @@ class PairwiseDistance(Layer):
     def forward(self, x, y):
         return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
                                    keepdim=self.keepdim)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid softmax (ref: nn/layer/loss.py HSigmoidLoss
+    over hierarchical_sigmoid_op.cc); holds the (num_classes-1, D) path
+    weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias)

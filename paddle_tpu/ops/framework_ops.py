@@ -168,6 +168,49 @@ def get_tensor_from_selected_rows(x, name=None):
     return to_tensor(np.asarray(x.to_dense()))
 
 
+def make_pyfunc_fn(func, specs, backward_func=None):
+    """Shared py_func lowering (py_func_op.cc): a host callback via
+    jax.pure_callback, optionally wrapped in custom_vjp when the caller
+    supplies backward_func(*inputs, *out_grads) -> input grads.  Used by
+    both the eager op below and static.py_func."""
+    def host(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else (res,)
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, specs))
+
+    if backward_func is None:
+        def fn(*vals):
+            out = jax.pure_callback(host, specs, *vals)
+            return out if len(specs) != 1 else out[0]
+
+        return fn
+
+    @jax.custom_vjp
+    def _core(*vals):
+        out = jax.pure_callback(host, specs, *vals)
+        return out if len(specs) != 1 else out[0]
+
+    def _fwd(*vals):
+        return _core(*vals), vals
+
+    def _bwd(vals, g):
+        gs = g if isinstance(g, tuple) else (g,)
+        in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for v in vals)
+
+        def bhost(*args):
+            res = backward_func(*[np.asarray(a) for a in args])
+            res = res if isinstance(res, (list, tuple)) else (res,)
+            return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                         for r, s in zip(res, in_specs))
+
+        return jax.pure_callback(bhost, in_specs, *(vals + gs))
+
+    _core.defvjp(_fwd, _bwd)
+    return _core
+
+
 def py_func(func, x, out_shapes, out_dtypes, backward_func=None, name=None):
     """Call arbitrary Python on tensor values (py_func_op.cc).
 
@@ -185,43 +228,7 @@ def py_func(func, x, out_shapes, out_dtypes, backward_func=None, name=None):
     dtypes = [out_dtypes] if isinstance(out_dtypes, str) else list(out_dtypes)
     specs = tuple(jax.ShapeDtypeStruct(tuple(s), convert_dtype(d))
                   for s, d in zip(shapes, dtypes))
-
-    def host(*vals):
-        res = func(*[np.asarray(v) for v in vals])
-        res = res if isinstance(res, (list, tuple)) else (res,)
-        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
-                     for r, s in zip(res, specs))
-
-    if backward_func is None:
-        def fn(*vals):
-            out = jax.pure_callback(host, specs, *vals)
-            return out if len(specs) != 1 else out[0]
-    else:
-        @jax.custom_vjp
-        def _core(*vals):
-            out = jax.pure_callback(host, specs, *vals)
-            return out if len(specs) != 1 else out[0]
-
-        def _fwd(*vals):
-            return _core(*vals), vals
-
-        def _bwd(vals, g):
-            gs = g if isinstance(g, tuple) else (g,)
-            in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
-                             for v in vals)
-
-            def bhost(*args):
-                n = len(vals)
-                res = backward_func(*[np.asarray(a) for a in args])
-                res = res if isinstance(res, (list, tuple)) else (res,)
-                return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
-                             for r, s in zip(res, in_specs))
-
-            return jax.pure_callback(bhost, in_specs, *(vals + gs))
-
-        _core.defvjp(_fwd, _bwd)
-        fn = _core
-
+    fn = make_pyfunc_fn(func, specs, backward_func)
     n_out = len(specs)
     return apply_op("py_func", fn, tuple(xs), {},
                     n_outputs=n_out if n_out > 1 else None)

@@ -62,19 +62,22 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCDHW", output_size=None, name=None):
+    from .nn_ops import _conv_transpose_nd
+
     s, d = _triple(stride), _triple(dilation)
-    p = _triple(padding) if not isinstance(padding, str) else padding
+    op = _triple(output_padding)
+    p = _triple(padding) if not isinstance(padding, str) else (0, 0, 0)
+    pad = [(pp, pp) for pp in p]
+    if output_size is not None:
+        k = weight.shape[2:]
+        op = tuple(
+            int(output_size[i])
+            - ((x.shape[2 + i] - 1) * s[i] - pad[i][0] - pad[i][1]
+               + d[i] * (k[i] - 1) + 1)
+            for i in range(3))
 
     def fn(xv, wv):
-        # IODHW weight (paddle transpose-conv convention: [in, out, *k])
-        wv_t = jnp.transpose(wv, (1, 0, 2, 3, 4))
-        pads = ([(k - 1 - pp, k - 1 - pp) for k, pp in
-                 zip(wv.shape[2:], p)] if not isinstance(p, str) else p)
-        return jax.lax.conv_general_dilated(
-            xv, jnp.flip(wv_t, axis=(2, 3, 4)), (1, 1, 1), pads,
-            lhs_dilation=s, rhs_dilation=d,
-            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-            feature_group_count=groups)
+        return _conv_transpose_nd(xv, wv, s, pad, d, groups, op, 3)
 
     out = apply_op("conv3d_transpose", fn, (x, weight), {})
     if bias is not None:
@@ -94,7 +97,11 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     w4 = unsqueeze(weight, [3])
     st = (stride, 1) if isinstance(stride, int) else tuple(stride) + (1,)
     pd = (padding, 0) if isinstance(padding, int) else tuple(padding) + (0,)
+    opd = (output_padding, 0) if isinstance(output_padding, int) \
+        else tuple(output_padding) + (0,)
+    osz = None if output_size is None else list(output_size) + [1]
     out = conv2d_transpose(x4, w4, bias=bias, stride=st, padding=pd,
+                           output_padding=opd, output_size=osz,
                            dilation=(dilation, 1) if isinstance(dilation, int)
                            else tuple(dilation) + (1,), groups=groups)
     return squeeze(out, [3])

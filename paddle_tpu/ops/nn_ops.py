@@ -104,30 +104,64 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return squeeze(out, [3])
 
 
+def _conv_transpose_nd(xv, wv, stride, pad_lo_hi, dilation, groups,
+                       output_padding, nd):
+    """Grouped n-d transposed conv as the gradient-of-conv formulation:
+    lhs-dilate by stride, convolve with the spatially-flipped, I/O-swapped
+    kernel (conv2d_transpose_op.cc semantics; verified against the torch
+    conv_transpose oracle incl. groups and output_padding).
+
+    wv: paddle layout (Cin, Cout/groups, *k).  pad_lo_hi: per-dim forward
+    pads (lo, hi); output_padding extends the hi side.
+    """
+    k = wv.shape[2:]
+    cin = wv.shape[0]
+    cog = wv.shape[1]
+    # (Cin, Cout/g, *k) -> (g, Cin/g, Cout/g, *k) -> (g, Cout/g, Cin/g, *k)
+    # -> (Cout, Cin/g, *k): OIHW for a grouped forward conv
+    wg = wv.reshape((groups, cin // groups, cog) + k)
+    wg = jnp.swapaxes(wg, 1, 2).reshape((groups * cog, cin // groups) + k)
+    wg = jnp.flip(wg, axis=tuple(range(2, 2 + nd)))
+    pads = [
+        (dilation[i] * (k[i] - 1) - pad_lo_hi[i][0],
+         dilation[i] * (k[i] - 1) - pad_lo_hi[i][1] + output_padding[i])
+        for i in range(nd)
+    ]
+    spec = "NC" + "DHW"[3 - nd:]
+    return jax.lax.conv_general_dilated(
+        xv, wg, (1,) * nd, pads, lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=(spec, "OI" + "DHW"[3 - nd:], spec),
+        feature_group_count=groups)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None, data_format="NCHW",
                      name=None):
-    """Ref: conv2d_transpose_op.cc.  Implemented as lax.conv_transpose."""
+    """Ref: conv2d_transpose_op.cc.  Gradient-of-conv lowering with full
+    groups / output_padding / output_size support."""
     stride = _pair(stride)
     dilation = _pair(dilation)
+    opad = _pair(output_padding)
     if isinstance(padding, str):
-        pad = padding.upper()
+        p = padding.upper()
+        pad = [(0, 0), (0, 0)] if p == "VALID" else None
+        if pad is None:
+            raise ValueError("conv2d_transpose supports int/list or 'VALID' "
+                             "padding")
     else:
         pad = _conv_padding(padding, None, stride, dilation, 2)
-        # conv_transpose pad semantics: emulate via transpose of fwd conv padding
+    if output_size is not None:
+        # derive output_padding so the result hits the requested size
         k = weight.shape[2:4]
-        pad = [
-            (dilation[i] * (k[i] - 1) - pad[i][0],
-             dilation[i] * (k[i] - 1) - pad[i][1])
-            for i in range(2)
-        ]
-    dn = ("NCHW", "IOHW", "NCHW")
+        opad = tuple(
+            int(output_size[i])
+            - ((x.shape[2 + i] - 1) * stride[i] - pad[i][0] - pad[i][1]
+               + dilation[i] * (k[i] - 1) + 1)
+            for i in range(2))
 
     def fn(xv, wv):
-        return jax.lax.conv_transpose(
-            xv, wv, stride, pad, rhs_dilation=dilation, dimension_numbers=dn,
-            transpose_kernel=True,
-        )
+        return _conv_transpose_nd(xv, wv, stride, pad, dilation, groups,
+                                  opad, 2)
 
     out = apply_op("conv2d_transpose", fn, (x, weight), {})
     if bias is not None:

@@ -20,6 +20,14 @@ import jax.numpy as jnp
 
 from ..core.registry import apply_op, register_op
 from ..core.tensor import Tensor, to_tensor
+from ..core import random as _random
+
+
+def _op_key(seed):
+    """seed=0 means nondeterministic (fresh key from the global threefry
+    stream), matching ops/creation.py's convention and the reference's
+    seed-attr semantics."""
+    return jax.random.PRNGKey(seed) if seed else _random.next_key()
 
 __all__ = [
     "linear_chain_crf", "crf_decoding", "nce", "sample_logits",
@@ -179,9 +187,10 @@ def nce(input, weight, label, bias=None, num_total_classes=None,
     """
     V = num_total_classes or weight.shape[0]
 
+    key = _op_key(seed)
+
     def fn(x, w, lbl, *maybe_bias):
         b = maybe_bias[0] if maybe_bias else None
-        key = jax.random.PRNGKey(seed)
         if sampler == "log_uniform":
             neg = _log_uniform_sample(key, num_neg_samples, V)
         else:
@@ -215,11 +224,12 @@ def sample_logits(logits, label, num_samples, seed=0, name=None):
     """Sampled-softmax helper (sample_logits_op.h): draws shared negative
     classes, gathers their logits next to the true-label logits.
     Returns (sampled_logits (B, L+S), sampled_label (B, L+S))."""
+    key = _op_key(seed)
+
     def fn(lg, lbl):
         B, V = lg.shape
         lbl2 = lbl.reshape(B, -1).astype(jnp.int32)
         L = lbl2.shape[1]
-        key = jax.random.PRNGKey(seed)
         neg = _log_uniform_sample(key, num_samples, V)  # (S,)
         ids = jnp.concatenate(
             [lbl2, jnp.broadcast_to(neg[None, :], (B, num_samples))], axis=1)
@@ -234,8 +244,9 @@ def sample_logits(logits, label, num_samples, seed=0, name=None):
 def sampling_id(x, min=0.0, max=1.0, seed=0, name=None):
     """Sample one column index per row of a probability matrix
     (sampling_id_op.h)."""
+    key = _op_key(seed)
+
     def fn(p):
-        key = jax.random.PRNGKey(seed if seed else 0)
         return jax.random.categorical(key, jnp.log(
             jnp.maximum(p, 1e-20)), axis=1).astype(jnp.int64)
 
@@ -324,10 +335,12 @@ def beam_search_decode(step_ids, step_parents, beam_size, end_id, name=None):
 
 def _add_pos_enc(x, alpha=1.0, beta=1.0):
     B, T, D = x.shape
-    half = D // 2
+    # first ceil(D/2) channels sin, remaining floor(D/2) cos (odd D safe)
+    half = (D + 1) // 2
     pos = jnp.arange(T, dtype=x.dtype)[:, None]
     div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / half)
-    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    enc = jnp.concatenate(
+        [jnp.sin(pos / div), jnp.cos(pos / div)[:, :D - half]], axis=1)
     return alpha * x + beta * enc[None, :, :]
 
 

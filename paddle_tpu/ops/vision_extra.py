@@ -362,8 +362,12 @@ def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
 def random_crop(x, shape, seed=0, name=None):
     """Random spatial crop to `shape` (random_crop_op.h); seeded threefry,
     same crop for every sample feature dim left of the cropped dims."""
+    from ..core import random as _random
+
+    key0 = jax.random.PRNGKey(seed) if seed else _random.next_key()
+
     def fn(v):
-        key = jax.random.PRNGKey(seed)
+        key = key0
         starts = []
         nd = len(shape)
         for d in range(nd):

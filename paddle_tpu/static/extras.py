@@ -81,47 +81,11 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
     from ..core.dtype import convert_dtype
+    from ..ops.framework_ops import make_pyfunc_fn
 
     specs = tuple(jax.ShapeDtypeStruct(tuple(o.shape), convert_dtype(o.dtype))
                   for o in outs)
-
-    def host(*arrs):
-        res = func(*[np.asarray(a) for a in arrs])
-        res = res if isinstance(res, (list, tuple)) else (res,)
-        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
-                     for r, s in zip(res, specs))
-
-    if backward_func is None:
-        def fn(*vals):
-            r = jax.pure_callback(host, specs, *vals)
-            return r if len(specs) != 1 else r[0]
-    else:
-        # same custom_vjp wiring as the eager op (ops/framework_ops.py
-        # py_func): backward_func(*inputs, *out_grads) -> input grads
-        @jax.custom_vjp
-        def _core(*vals):
-            r = jax.pure_callback(host, specs, *vals)
-            return r if len(specs) != 1 else r[0]
-
-        def _fwd(*vals):
-            return _core(*vals), vals
-
-        def _bwd(vals, g):
-            gs = g if isinstance(g, tuple) else (g,)
-            in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
-                             for v in vals)
-
-            def bhost(*args):
-                res = backward_func(*[np.asarray(a) for a in args])
-                res = res if isinstance(res, (list, tuple)) else (res,)
-                return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
-                             for r, s in zip(res, in_specs))
-
-            return jax.pure_callback(bhost, in_specs, *(vals + gs))
-
-        _core.defvjp(_fwd, _bwd)
-        fn = _core
-
+    fn = make_pyfunc_fn(func, specs, backward_func)
     return emit("py_func", [("X", v) for v in xs],
                 [("Out", o.shape, o.dtype) for o in outs], fn)
 

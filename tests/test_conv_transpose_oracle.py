@@ -1,0 +1,81 @@
+"""conv{1,2,3}d_transpose vs the torch oracle: groups, output_padding,
+dilation, output_size, in!=out channels (regression for the IOHW/OIHW
+dimension-number bug and the ignored groups/output_padding args)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(stride=2, padding=1, output_padding=1, groups=2),
+    dict(stride=1, padding=0, groups=1),
+    dict(stride=3, padding=2, output_padding=2, groups=1, dilation=2),
+    dict(stride=2, padding=0, groups=4),
+])
+def test_conv2d_transpose_matches_torch(kwargs):
+    rng = np.random.RandomState(0)
+    g = kwargs.get("groups", 1)
+    out_per_group = 2 if g == 4 else 3
+    x = rng.rand(2, 4, 8, 8).astype(np.float32)
+    w = rng.rand(4, out_per_group, 3, 3).astype(np.float32)
+    want = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), **kwargs).numpy()
+    got = _np(F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 **kwargs))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_conv2d_transpose_output_size():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 4, 8, 8).astype(np.float32)
+    w = rng.rand(4, 1, 3, 3).astype(np.float32)
+    y = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                           stride=2, output_size=[16, 16])
+    assert list(y.shape) == [2, 1, 16, 16]
+
+
+def test_conv3d_transpose_groups_output_padding():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 4, 4, 4, 4).astype(np.float32)
+    w = rng.rand(4, 2, 2, 2, 2).astype(np.float32)
+    want = torch.nn.functional.conv_transpose3d(
+        torch.tensor(x), torch.tensor(w), stride=2, groups=2,
+        output_padding=1).numpy()
+    got = _np(F.conv3d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, groups=2, output_padding=1))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_conv1d_transpose_output_padding():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 3, 8).astype(np.float32)
+    w = rng.rand(3, 5, 3).astype(np.float32)
+    want = torch.nn.functional.conv_transpose1d(
+        torch.tensor(x), torch.tensor(w), stride=2,
+        output_padding=1).numpy()
+    got = _np(F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, output_padding=1))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_conv2d_transpose_grad_flows():
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.rand(1, 2, 4, 4).astype(np.float32))
+    w = paddle.to_tensor(rng.rand(2, 3, 3, 3).astype(np.float32))
+    x.stop_gradient = False
+    w.stop_gradient = False
+    out = F.conv2d_transpose(x, w, stride=2, output_padding=1)
+    paddle.sum(out).backward()
+    assert x.grad is not None and w.grad is not None
+    assert np.isfinite(np.asarray(w.grad._data)).all()

@@ -1,0 +1,88 @@
+"""Tests for the last nn-zoo layers (Conv1D/3DTranspose, AdaptiveMaxPool
+1D/3D, HSigmoidLoss) and BeamSearchDecoder + dynamic_decode."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_conv_transpose_layers():
+    x1 = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 8)
+                          .astype(np.float32))
+    c1 = nn.Conv1DTranspose(3, 5, 3, stride=2)
+    y1 = c1(x1)
+    assert y1.shape[0] == 2 and y1.shape[1] == 5 and y1.shape[2] > 8
+
+    x3 = paddle.to_tensor(np.random.RandomState(1).rand(1, 2, 4, 4, 4)
+                          .astype(np.float32))
+    c3 = nn.Conv3DTranspose(2, 3, 2, stride=2)
+    y3 = c3(x3)
+    assert list(y3.shape) == [1, 3, 8, 8, 8]
+
+
+def test_adaptive_max_pools():
+    x = paddle.to_tensor(np.random.RandomState(2).rand(2, 3, 16)
+                         .astype(np.float32))
+    assert list(nn.AdaptiveMaxPool1D(4)(x).shape) == [2, 3, 4]
+    x3 = paddle.to_tensor(np.random.RandomState(3).rand(1, 2, 8, 8, 8)
+                          .astype(np.float32))
+    assert list(nn.AdaptiveMaxPool3D(2)(x3).shape) == [1, 2, 2, 2, 2]
+
+
+def test_hsigmoid_loss_layer_trains():
+    rng = np.random.RandomState(4)
+    layer = nn.HSigmoidLoss(8, 6)
+    x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+    lbl = paddle.to_tensor(rng.randint(0, 6, (4,)).astype(np.int64))
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=layer.parameters())
+    l0 = None
+    for i in range(8):
+        loss = paddle.mean(layer(x, lbl))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if i == 0:
+            l0 = float(_np(loss))
+    assert float(_np(loss)) < l0
+
+
+class _ToyLMCell(nn.RNNCellBase):
+    """Deterministic 'LM': next-token logits prefer id (prev+1) % V."""
+
+    def __init__(self, vocab):
+        super().__init__()
+        self.vocab = vocab
+
+    def forward(self, ids, states):
+        import jax.numpy as jnp
+        from paddle_tpu.core.registry import apply_op
+
+        def fn(s):
+            return s
+
+        v = self.vocab
+        prev = _np(ids).astype(np.int64).reshape(-1)
+        logits = np.full((prev.shape[0], v), -5.0, np.float32)
+        logits[np.arange(prev.shape[0]), (prev + 1) % v] = 5.0
+        out = paddle.to_tensor(logits)
+        return out, states
+
+
+def test_beam_search_decoder_dynamic_decode():
+    V, B, K = 6, 2, 3
+    cell = _ToyLMCell(V)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                               beam_size=K)
+    h0 = paddle.to_tensor(np.zeros((B, 4), np.float32))
+    out, scores = nn.dynamic_decode(dec, inits=(h0,), max_step_num=10)
+    arr = _np(out)  # (B, T, K)
+    assert arr.shape[0] == B and arr.shape[2] == K
+    # greedy chain from start 0: 1,2,3,4,5(end) -> top beam follows it
+    np.testing.assert_array_equal(arr[0, :5, 0], [1, 2, 3, 4, 5])
+    # once finished, the top beam stays frozen on the end token
+    assert (arr[0, 5:, 0] == V - 1).all()
