@@ -57,6 +57,8 @@ from . import profiler  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import _C_ops  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
 from . import quant  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

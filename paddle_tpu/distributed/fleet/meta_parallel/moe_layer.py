@@ -1,0 +1,177 @@
+"""Mixture-of-Experts with expert parallelism over an 'expert' mesh axis.
+
+Reference status: the reference snapshot has NO MoE/expert parallelism
+(SURVEY §2.3 "Absent in reference" row) — this is a TPU-first extension
+in the same spirit as ring attention: GShard/Switch-style top-k routing
+(Lepikhin et al. 2020, Fedus et al. 2021; see PAPERS.md) expressed as
+dense one-hot einsums + a single `jax.lax.all_to_all` pair, the canonical
+XLA-SPMD formulation.
+
+Design:
+- Gating, capacity bookkeeping and combine/dispatch tensors are dense
+  one-hot einsums (MXU-friendly; no dynamic shapes, no sorting).
+- Expert weights live as full (E, ...) params annotated with
+  dist_spec P('expert') — CompiledTrainStep shards them like any TP
+  param; inside shard_map each device holds E/ep local experts.
+- Token exchange is all_to_all over the 'expert' axis (ICI), fully
+  differentiable (its transpose is the reverse all_to_all).
+- Outside any mesh (eager single chip) the same math runs with the full
+  expert stack and no collectives.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from ....core.registry import apply_op
+from ....nn.layer import Layer
+from ....nn.initializer import XavierNormal, Constant
+
+EXPERT_AXIS = "expert"
+
+__all__ = ["MoELayer", "expert_axis_in_scope", "EXPERT_AXIS"]
+
+
+def expert_axis_in_scope(axis_name=EXPERT_AXIS):
+    """True under shard_map tracing with a non-trivial 'expert' axis."""
+    try:
+        return jax.lax.psum(1, axis_name) > 1
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def _top2_dispatch(logits, capacity):
+    """GShard top-2 routing: returns (combine (N, E, C), dispatch bool
+    (N, E, C), aux_loss scalar).  Dense one-hot construction; tokens over
+    capacity are dropped (their combine rows are zero)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)                      # (N,)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=logits.dtype)    # (N, E)
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=logits.dtype)
+
+    # load-balance aux loss (GShard eq.4): E * sum_e mean(gate_e)*mean(mask1_e)
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * (E * E) / E
+
+    # position of each token within its expert's buffer (running count)
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1               # (N, E)
+    pos1_tok = jnp.sum(pos1 * mask1, axis=1)               # (N,)
+    keep1 = pos1_tok < capacity
+    # second choice queues behind ALL first choices of that expert
+    count1 = jnp.sum(mask1, axis=0, keepdims=True)         # (1, E)
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + count1
+    pos2_tok = jnp.sum(pos2 * mask2, axis=1)
+    keep2 = pos2_tok < capacity
+
+    g1 = jnp.sum(probs * mask1, axis=1)                    # (N,)
+    g2 = jnp.sum(probs * mask2, axis=1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    cap_oh1 = jax.nn.one_hot(pos1_tok.astype(jnp.int32), capacity,
+                             dtype=logits.dtype)           # (N, C)
+    cap_oh2 = jax.nn.one_hot(pos2_tok.astype(jnp.int32), capacity,
+                             dtype=logits.dtype)
+    combine = (
+        (g1 * keep1)[:, None, None] * mask1[:, :, None] * cap_oh1[:, None, :]
+        + (g2 * keep2)[:, None, None] * mask2[:, :, None] * cap_oh2[:, None, :]
+    )                                                      # (N, E, C)
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class MoELayer(Layer):
+    """Top-2 gated mixture of expert FFNs.
+
+    Drop-in for a transformer MLP block: forward(x (B, S, H)) ->
+    (out (B, S, H)); the load-balance aux loss of the last forward is in
+    `self.aux_loss` (add `aux_weight * layer.aux_loss` to the train loss).
+    """
+
+    def __init__(self, hidden_size, ffn_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, aux_weight=0.01, name=None):
+        super().__init__()
+        if top_k != 2:
+            raise ValueError("MoELayer implements GShard top-2 gating")
+        self.hidden_size = hidden_size
+        self.ffn_hidden = ffn_hidden
+        self.num_experts = int(num_experts)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_weight = float(aux_weight)
+        self.aux_loss = None
+
+        self.gate_weight = self.create_parameter(
+            [hidden_size, self.num_experts],
+            default_initializer=XavierNormal())
+        e = self.num_experts
+        self.w1 = self.create_parameter([e, hidden_size, ffn_hidden],
+                                        default_initializer=XavierNormal())
+        self.b1 = self.create_parameter([e, ffn_hidden], is_bias=True,
+                                        default_initializer=Constant(0.0))
+        self.w2 = self.create_parameter([e, ffn_hidden, hidden_size],
+                                        default_initializer=XavierNormal())
+        self.b2 = self.create_parameter([e, hidden_size], is_bias=True,
+                                        default_initializer=Constant(0.0))
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            p.dist_spec = P(EXPERT_AXIS)
+            p.is_distributed = True
+
+    def forward(self, x):
+        E = self.num_experts
+        cf = self.capacity_factor
+        holder = {}
+
+        def fn(xv, gw, w1, b1, w2, b2):
+            B, S, H = xv.shape
+            N = B * S
+            tokens = xv.reshape(N, H)
+            logits = tokens @ gw
+            capacity = max(int(np.ceil(2 * N / E * cf)), 4)
+            combine, dispatch, aux = _top2_dispatch(
+                logits.astype(jnp.float32), capacity)
+            combine = combine.astype(xv.dtype)
+            expert_in = jnp.einsum("nec,nh->ech",
+                                   dispatch.astype(xv.dtype), tokens)
+
+            if expert_axis_in_scope():
+                ep = jax.lax.psum(1, EXPERT_AXIS)
+                e_local = w1.shape[0]  # E // ep local experts per device
+                # (E, C, H) -> (ep, e_local, C, H); all_to_all swaps the
+                # leading ep-sized dim with the device axis: afterwards this
+                # device holds its local experts' tokens from EVERY peer
+                buf = expert_in.reshape(ep, e_local, capacity, H)
+                buf = jax.lax.all_to_all(buf, EXPERT_AXIS, split_axis=0,
+                                         concat_axis=0, tiled=False)
+                # (ep, e_local, C, H) -> (e_local, ep*C, H)
+                buf = jnp.swapaxes(buf, 0, 1).reshape(
+                    e_local, ep * capacity, H)
+                h1 = jax.nn.gelu(
+                    jnp.einsum("ech,ehf->ecf", buf, w1) + b1[:, None, :])
+                out = jnp.einsum("ecf,efh->ech", h1, w2) + b2[:, None, :]
+                # inverse exchange back to token owners
+                out = out.reshape(e_local, ep, capacity, H)
+                out = jnp.swapaxes(out, 0, 1)  # (ep, e_local, C, H)
+                out = jax.lax.all_to_all(out, EXPERT_AXIS, split_axis=0,
+                                         concat_axis=0, tiled=False)
+                expert_out = out.reshape(E, capacity, H)
+            else:
+                h1 = jax.nn.gelu(
+                    jnp.einsum("ech,ehf->ecf", expert_in, w1)
+                    + b1[:, None, :])
+                expert_out = jnp.einsum("ecf,efh->ech", h1, w2) \
+                    + b2[:, None, :]
+
+            out = jnp.einsum("nec,ech->nh", combine, expert_out)
+            return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+        out, aux = apply_op("moe_layer", fn,
+                            (x, self.gate_weight, self.w1, self.b1,
+                             self.w2, self.b2), {}, n_outputs=2)
+        self.aux_loss = aux
+        return out

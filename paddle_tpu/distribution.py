@@ -1,0 +1,190 @@
+"""paddle.distribution parity: Distribution / Uniform / Normal /
+Categorical.
+
+Reference: python/paddle/distribution.py:42/169/391/641 — sample,
+entropy, log_prob, probs, kl_divergence with broadcasting over
+batch-shaped parameters.
+
+TPU-native design: every method is a pure jnp expression dispatched
+through apply_op (differentiable wrt the distribution parameters, grads
+via jax.vjp); sampling draws from the global threefry stream unless a
+nonzero seed pins it, the same convention as ops/creation.py.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.registry import apply_op
+from .core.tensor import Tensor, to_tensor
+from .core import random as _random
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_tensor(v, dtype=np.float32):
+    if isinstance(v, Tensor):
+        return v
+    return to_tensor(np.asarray(v, dtype))
+
+
+def _key(seed):
+    return jax.random.PRNGKey(seed) if seed else _random.next_key()
+
+
+class Distribution:
+    """Abstract base (distribution.py:42)."""
+
+    def sample(self, shape=(), seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        import paddle_tpu as paddle
+
+        return paddle.exp(self.log_prob(value))
+
+
+class Uniform(Distribution):
+    """U[low, high) (distribution.py:169)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+
+    def sample(self, shape=(), seed=0):
+        key = _key(seed)
+        batch = tuple(np.broadcast_shapes(tuple(self.low.shape),
+                                          tuple(self.high.shape)))
+        shp = tuple(shape) + batch
+
+        def fn(lo, hi):
+            u = jax.random.uniform(key, shp, lo.dtype)
+            return lo + u * (hi - lo)
+
+        out = apply_op("uniform_sample", fn, (self.low, self.high), {})
+        out.stop_gradient = True
+        return out
+
+    def entropy(self):
+        return apply_op("uniform_entropy",
+                        lambda lo, hi: jnp.log(hi - lo),
+                        (self.low, self.high), {})
+
+    def log_prob(self, value):
+        def fn(lo, hi, v):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return apply_op("uniform_log_prob", fn,
+                        (self.low, self.high, _as_tensor(value)), {})
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) (distribution.py:391)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = _key(seed)
+        batch = tuple(np.broadcast_shapes(tuple(self.loc.shape),
+                                          tuple(self.scale.shape)))
+        shp = tuple(shape) + batch
+
+        def fn(mu, sig):
+            return mu + sig * jax.random.normal(key, shp, mu.dtype)
+
+        out = apply_op("normal_sample", fn, (self.loc, self.scale), {})
+        out.stop_gradient = True
+        return out
+
+    def entropy(self):
+        def fn(mu, sig):
+            return 0.5 + 0.5 * np.log(2 * np.pi) + jnp.log(
+                jnp.broadcast_to(sig, jnp.broadcast_shapes(mu.shape,
+                                                           sig.shape)))
+
+        return apply_op("normal_entropy", fn, (self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        def fn(mu, sig, v):
+            var = jnp.square(sig)
+            return (-jnp.square(v - mu) / (2 * var)
+                    - jnp.log(sig) - 0.5 * np.log(2 * np.pi))
+
+        return apply_op("normal_log_prob", fn,
+                        (self.loc, self.scale, _as_tensor(value)), {})
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (distribution.py:598)."""
+        def fn(mu1, s1, mu2, s2):
+            ratio = jnp.square(s1 / s2)
+            return (0.5 * (ratio + jnp.square(mu1 - mu2) / jnp.square(s2)
+                           - 1.0 - jnp.log(ratio)))
+
+        return apply_op("normal_kl", fn,
+                        (self.loc, self.scale, other.loc, other.scale), {})
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (distribution.py:641)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+
+    def _log_pmf(self):
+        def fn(lg):
+            return jax.nn.log_softmax(lg, axis=-1)
+
+        return apply_op("categorical_log_pmf", fn, (self.logits,), {})
+
+    def sample(self, shape=(), seed=0):
+        key = _key(seed)
+        n = int(np.prod(shape)) if shape else 1
+
+        def fn(lg):
+            draws = jax.random.categorical(key, lg, axis=-1,
+                                           shape=(n,) + lg.shape[:-1])
+            return draws.reshape(tuple(shape) + lg.shape[:-1])
+
+        out = apply_op("categorical_sample", fn, (self.logits,), {})
+        out.stop_gradient = True
+        return out
+
+    def entropy(self):
+        def fn(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return apply_op("categorical_entropy", fn, (self.logits,), {})
+
+    def log_prob(self, value):
+        lp = self._log_pmf()
+
+        def fn(l, v):
+            idx = v.astype(jnp.int32)
+            return jnp.take_along_axis(l, idx[..., None], axis=-1)[..., 0]
+
+        return apply_op("categorical_log_prob", fn,
+                        (lp, _as_tensor(value, np.int64)), {})
+
+    def probs(self, value):
+        import paddle_tpu as paddle
+
+        return paddle.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        def fn(a, b):
+            la = jax.nn.log_softmax(a, axis=-1)
+            lb = jax.nn.log_softmax(b, axis=-1)
+            return jnp.sum(jnp.exp(la) * (la - lb), axis=-1)
+
+        return apply_op("categorical_kl", fn,
+                        (self.logits, other.logits), {})

@@ -42,6 +42,12 @@ class GPTConfig:
         # attention-weight dropout; 0.0 keeps the Pallas flash path eligible
         # while residual/MLP dropout stays on (the flash kernel contract)
         self.attn_dropout = dropout if attn_dropout is None else attn_dropout
+        # mixture-of-experts (TPU-first extension; 0 = dense MLP): every
+        # block's MLP becomes a top-2 MoE with num_experts experts sharded
+        # over an 'expert' mesh axis when one is present
+        self.num_experts = 0
+        self.moe_capacity_factor = 1.25
+        self.moe_aux_weight = 0.01
         self.use_flash = use_flash
         self.remat = remat
         # context parallelism ('ring' | 'ulysses'), active automatically when
@@ -135,7 +141,15 @@ class GPTBlock(Layer):
         self.ln1 = LayerNorm(config.hidden_size)
         self.attn = GPTAttention(config)
         self.ln2 = LayerNorm(config.hidden_size)
-        self.mlp = GPTMLP(config)
+        if getattr(config, "num_experts", 0):
+            from ..distributed.fleet.meta_parallel.moe_layer import MoELayer
+
+            self.mlp = MoELayer(config.hidden_size, config.ffn_hidden,
+                                config.num_experts,
+                                capacity_factor=config.moe_capacity_factor,
+                                aux_weight=config.moe_aux_weight)
+        else:
+            self.mlp = GPTMLP(config)
         self.drop = Dropout(config.dropout)
 
     def forward(self, x):
@@ -242,4 +256,14 @@ class GPTForPretraining(Layer):
         return M.mean(loss)
 
     def loss(self, input_ids, labels):
-        return self.head_loss(self._hidden(input_ids), labels)
+        out = self.head_loss(self._hidden(input_ids), labels)
+        # MoE load-balance aux losses collected from the blocks of the
+        # forward that just ran (zero when the model is dense)
+        aux = None
+        for blk in self.gpt.blocks:
+            a = getattr(blk.mlp, "aux_loss", None)
+            if a is not None:
+                w = blk.mlp.aux_weight
+                term = M.scale(a, w)
+                aux = term if aux is None else M.add(aux, term)
+        return out if aux is None else M.add(out, aux)

@@ -114,7 +114,8 @@ class Conv1DTranspose(_ConvNd):
         return F.conv1d_transpose(
             x, self.weight, self.bias, stride=self._stride,
             padding=self._padding, output_padding=self._output_padding,
-            dilation=self._dilation, groups=self._groups)
+            dilation=self._dilation, groups=self._groups,
+            output_size=output_size)
 
 
 class Conv3DTranspose(_ConvNd):
@@ -132,4 +133,5 @@ class Conv3DTranspose(_ConvNd):
         return F.conv3d_transpose(
             x, self.weight, self.bias, stride=self._stride,
             padding=self._padding, output_padding=self._output_padding,
-            dilation=self._dilation, groups=self._groups)
+            dilation=self._dilation, groups=self._groups,
+            output_size=output_size)

@@ -110,7 +110,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
     from ...ops.sequence_ops import beam_search_decode
 
     if max_step_num is None:
-        max_step_num = 32
+        # reference semantics: loop until every beam finishes; hard safety
+        # cap so a decoder that never emits end_token still terminates
+        max_step_num = 1024
     ids, scores, states = decoder.initialize(inits)
     step_ids, step_parents = [], []
     for _ in range(int(max_step_num)):

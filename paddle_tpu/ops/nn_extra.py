@@ -66,8 +66,22 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
 
     s, d = _triple(stride), _triple(dilation)
     op = _triple(output_padding)
-    p = _triple(padding) if not isinstance(padding, str) else (0, 0, 0)
-    pad = [(pp, pp) for pp in p]
+    if isinstance(padding, str):
+        pk = padding.upper()
+        k3 = weight.shape[2:]
+        if pk == "VALID":
+            pad = [(0, 0)] * 3
+        elif pk == "SAME":
+            pad = []
+            for i in range(3):
+                total = max(d[i] * (k3[i] - 1) + 1 - s[i], 0)
+                pad.append((total // 2, total - total // 2))
+        else:
+            raise ValueError("conv3d_transpose padding string must be "
+                             "'SAME' or 'VALID'")
+    else:
+        p = _triple(padding)
+        pad = [(pp, pp) for pp in p]
     if output_size is not None:
         k = weight.shape[2:]
         op = tuple(

@@ -144,10 +144,19 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     opad = _pair(output_padding)
     if isinstance(padding, str):
         p = padding.upper()
-        pad = [(0, 0), (0, 0)] if p == "VALID" else None
-        if pad is None:
-            raise ValueError("conv2d_transpose supports int/list or 'VALID' "
-                             "padding")
+        k = weight.shape[2:4]
+        if p == "VALID":
+            pad = [(0, 0), (0, 0)]
+        elif p == "SAME":
+            # SAME transpose-conv: out = in * stride; forward-equivalent
+            # total pad = dilation*(k-1) + 1 - stride (clipped at 0)
+            pad = []
+            for i in range(2):
+                total = max(dilation[i] * (k[i] - 1) + 1 - stride[i], 0)
+                pad.append((total // 2, total - total // 2))
+        else:
+            raise ValueError("conv2d_transpose padding string must be "
+                             "'SAME' or 'VALID'")
     else:
         pad = _conv_padding(padding, None, stride, dilation, 2)
     if output_size is not None:

@@ -1,0 +1,6 @@
+"""paddle.regularizer parity (python/paddle/regularizer.py): L1Decay /
+L2Decay weight-decay descriptors consumed by optimizers (per-param
+`regularizer=` in ParamAttr or optimizer-level `weight_decay=`)."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -110,3 +110,35 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.users)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (ref: text/datasets/imikolov.py);
+    sample = n-gram id window.  Synthetic fallback (no egress)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, synthetic_size=2000):
+        rng = np.random.RandomState(13)
+        self.window = int(window_size)
+        self.data = rng.randint(0, 2074, (synthetic_size, self.window)) \
+            .astype(np.int64)
+
+    def __getitem__(self, idx):
+        return tuple(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT16(WMT14):
+    """WMT16 en-de (ref: text/datasets/wmt16.py); same sample layout as
+    WMT14 with a bpe-sized vocab."""
+
+    DICT_SIZE = 10000
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", synthetic_size=1000,
+                 seq_len=32):
+        super().__init__(mode=mode, dict_size=min(src_dict_size,
+                                                  trg_dict_size),
+                         synthetic_size=synthetic_size, seq_len=seq_len)

@@ -124,3 +124,31 @@ class Flowers(Dataset):
 
     def __len__(self):
         return len(self.images)
+
+
+class VOC2012(Dataset):
+    """Segmentation dataset (ref: vision/datasets/voc2012.py); sample =
+    (image uint8 CHW, segmentation mask HW int64).  Synthetic fallback
+    (no egress): blocky random masks with 21 PASCAL classes."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or 128
+        rng = np.random.RandomState(12)
+        self.images = rng.randint(0, 256, (n, 3, 64, 64)).astype(np.uint8)
+        # blocky masks: upsample an 8x8 class grid
+        small = rng.randint(0, self.NUM_CLASSES, (n, 8, 8))
+        self.masks = np.repeat(np.repeat(small, 8, axis=1), 8,
+                               axis=2).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -318,3 +318,97 @@ def box_coder(prior_box, prior_box_var, target_box,
 
     return apply_op("box_coder", fn, (prior_box, prior_box_var, target_box),
                     {})
+
+
+def detection_map(detect_res, gt_label, gt_box, detect_splits=None,
+                  gt_splits=None, class_num=None, overlap_threshold=0.5,
+                  evaluate_difficult=True, ap_version="integral"):
+    """VOC mean-average-precision metric (detection_map_op.cc).
+
+    Host-side numpy metric op (like edit_distance/chunk_eval — the
+    reference also runs it on CPU):
+
+    - detect_res: (D, 6) rows [label, score, x1, y1, x2, y2]
+    - gt_label: (G,) int labels; gt_box: (G, 4) boxes
+    - detect_splits / gt_splits: per-image row counts (the LoD offsets of
+      the reference); one image when omitted
+    - ap_version: "integral" (VOC2010 AUC) or "11point"
+
+    Returns a scalar float32 Tensor (the mAP in [0, 1]).
+    """
+    from ..core.tensor import Tensor, to_tensor
+
+    det = np.asarray(_raw(detect_res), np.float64).reshape(-1, 6)
+    gl = np.asarray(_raw(gt_label)).reshape(-1).astype(np.int64)
+    gb = np.asarray(_raw(gt_box), np.float64).reshape(-1, 4)
+    d_splits = (np.asarray(_raw(detect_splits)).reshape(-1).astype(int)
+                if detect_splits is not None else np.array([det.shape[0]]))
+    g_splits = (np.asarray(_raw(gt_splits)).reshape(-1).astype(int)
+                if gt_splits is not None else np.array([gb.shape[0]]))
+    d_off = np.concatenate([[0], np.cumsum(d_splits)])
+    g_off = np.concatenate([[0], np.cumsum(g_splits)])
+    n_img = len(d_splits)
+    classes = (range(class_num) if class_num is not None
+               else sorted(set(gl.tolist())))
+
+    def iou(a, b):
+        ix1 = max(a[0], b[0])
+        iy1 = max(a[1], b[1])
+        ix2 = min(a[2], b[2])
+        iy2 = min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    aps = []
+    for c in classes:
+        # gather per-image detections/gts of class c
+        scores, tps = [], []
+        n_pos = 0
+        for i in range(n_img):
+            gt_rows = [j for j in range(g_off[i], g_off[i + 1])
+                       if gl[j] == c]
+            n_pos += len(gt_rows)
+            dets = [j for j in range(d_off[i], d_off[i + 1])
+                    if int(det[j, 0]) == c]
+            dets.sort(key=lambda j: -det[j, 1])
+            matched = set()
+            for j in dets:
+                best, best_iou = None, overlap_threshold
+                for g in gt_rows:
+                    v = iou(det[j, 2:6], gb[g])
+                    if v >= best_iou:
+                        best, best_iou = g, v
+                scores.append(det[j, 1])
+                if best is not None and best not in matched:
+                    matched.add(best)
+                    tps.append(1.0)
+                else:
+                    tps.append(0.0)
+        if n_pos == 0:
+            continue
+        order = np.argsort(-np.asarray(scores)) if scores else []
+        tp = np.asarray(tps)[order] if len(tps) else np.zeros((0,))
+        cum_tp = np.cumsum(tp)
+        recall = cum_tp / n_pos
+        precision = cum_tp / (np.arange(len(tp)) + 1) if len(tp) \
+            else np.zeros((0,))
+        if ap_version == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if (recall >= t).any() \
+                    else 0.0
+                ap += p / 11.0
+        else:  # integral: sum precision deltas at each TP
+            ap = 0.0
+            prev_r = 0.0
+            for k in range(len(tp)):
+                if tp[k]:
+                    ap += precision[k] * (recall[k] - prev_r)
+                    prev_r = recall[k]
+        aps.append(ap)
+    out = to_tensor(np.asarray(np.mean(aps) if aps else 0.0, np.float32))
+    out.stop_gradient = True
+    return out

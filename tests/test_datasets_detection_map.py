@@ -1,0 +1,54 @@
+"""Tests for the last dataset kits (VOC2012, Imikolov, WMT16) and the
+detection_map metric op."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.datasets import VOC2012
+from paddle_tpu.text.datasets import Imikolov, WMT16
+from paddle_tpu.vision.ops import detection_map
+
+
+def test_voc2012_shapes():
+    ds = VOC2012(synthetic_size=8)
+    img, mask = ds[0]
+    assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+    assert mask.dtype == np.int64 and mask.max() < VOC2012.NUM_CLASSES
+    assert len(ds) == 8
+
+
+def test_imikolov_wmt16():
+    ds = Imikolov(synthetic_size=10, window_size=5)
+    assert len(ds[0]) == 5 and len(ds) == 10
+    wmt = WMT16(synthetic_size=6, seq_len=16)
+    src, trg_in, trg_out = wmt[0]
+    assert src.shape == (16,) and trg_in.shape == (15,)
+    np.testing.assert_array_equal(trg_out[:-1], trg_in[1:])
+
+
+def test_detection_map_perfect_and_miss():
+    # one image, two gt boxes of class 0; detections match both exactly
+    gt_box = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    gt_label = np.array([0, 0], np.int64)
+    det = np.array([[0, 0.9, 0, 0, 10, 10],
+                    [0, 0.8, 20, 20, 30, 30]], np.float32)
+    m = detection_map(paddle.to_tensor(det), paddle.to_tensor(gt_label),
+                      paddle.to_tensor(gt_box))
+    assert abs(float(np.asarray(m._data)) - 1.0) < 1e-6
+
+    # second detection misses -> AP = 0.5 (one of two gts found)
+    det2 = np.array([[0, 0.9, 0, 0, 10, 10],
+                     [0, 0.8, 50, 50, 60, 60]], np.float32)
+    m2 = detection_map(paddle.to_tensor(det2), paddle.to_tensor(gt_label),
+                       paddle.to_tensor(gt_box))
+    assert abs(float(np.asarray(m2._data)) - 0.5) < 1e-6
+
+
+def test_detection_map_11point_and_multiclass():
+    gt_box = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+    gt_label = np.array([0, 1], np.int64)
+    det = np.array([[0, 0.9, 0, 0, 10, 10],
+                    [1, 0.7, 20, 20, 30, 30]], np.float32)
+    m = detection_map(paddle.to_tensor(det), paddle.to_tensor(gt_label),
+                      paddle.to_tensor(gt_box), ap_version="11point")
+    # both classes perfectly detected: 11-point AP = 1.0 each
+    assert abs(float(np.asarray(m._data)) - 1.0) < 1e-6

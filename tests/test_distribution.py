@@ -1,0 +1,71 @@
+"""paddle.distribution tests: moments/entropy/log_prob against closed
+forms, sampling statistics, gradient flow through parameters."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Uniform, Normal, Categorical
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_uniform():
+    u = Uniform(1.0, 3.0)
+    s = u.sample([2000], seed=5)
+    arr = _np(s)
+    assert arr.min() >= 1.0 and arr.max() < 3.0
+    assert abs(arr.mean() - 2.0) < 0.1
+    np.testing.assert_allclose(float(_np(u.entropy())), np.log(2.0),
+                               rtol=1e-6)
+    lp = u.log_prob(paddle.to_tensor(np.array([2.0, 5.0], np.float32)))
+    np.testing.assert_allclose(_np(lp)[0], -np.log(2.0), rtol=1e-6)
+    assert _np(lp)[1] == -np.inf
+
+
+def test_normal_and_kl():
+    n = Normal(0.0, 2.0)
+    s = _np(n.sample([4000], seed=6))
+    assert abs(s.mean()) < 0.2 and abs(s.std() - 2.0) < 0.2
+    want_ent = 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0)
+    np.testing.assert_allclose(float(_np(n.entropy())), want_ent, rtol=1e-6)
+    lp = float(_np(n.log_prob(paddle.to_tensor(np.float32(0.0)))))
+    np.testing.assert_allclose(lp, -np.log(2.0) - 0.5 * np.log(2 * np.pi),
+                               rtol=1e-6)
+    kl = float(_np(n.kl_divergence(Normal(0.0, 2.0))))
+    assert abs(kl) < 1e-6
+    kl2 = float(_np(n.kl_divergence(Normal(1.0, 2.0))))
+    np.testing.assert_allclose(kl2, 0.5 * 1.0 / 4.0, rtol=1e-5)
+
+
+def test_normal_param_grad():
+    loc = paddle.to_tensor(np.float32(0.5))
+    loc.stop_gradient = False
+    n = Normal(loc, 1.0)
+    lp = n.log_prob(paddle.to_tensor(np.float32(1.5)))
+    lp.backward()
+    # d/dmu log N = (v - mu)/var = 1.0
+    np.testing.assert_allclose(float(np.asarray(loc.grad._data)), 1.0,
+                               rtol=1e-5)
+
+
+def test_categorical():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = Categorical(logits)
+    ent = float(_np(c.entropy()))
+    want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+    np.testing.assert_allclose(ent, want, rtol=1e-5)
+    p = _np(c.probs(paddle.to_tensor(np.array(2, np.int64))))
+    np.testing.assert_allclose(float(p), 0.5, rtol=1e-5)
+    s = _np(c.sample([3000], seed=7))
+    frac2 = (s == 2).mean()
+    assert abs(frac2 - 0.5) < 0.05
+    kl = float(_np(c.kl_divergence(Categorical(logits))))
+    assert abs(kl) < 1e-6
+
+
+def test_regularizer_module():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+
+    assert L2Decay(1e-4)._coeff == 1e-4
+    assert L1Decay(1e-3)._coeff == 1e-3

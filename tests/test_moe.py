@@ -1,0 +1,105 @@
+"""MoE / expert-parallel tests (meta_parallel/moe_layer.py).
+
+Eager correctness (routing respects capacity, combine weights normalize,
+gradient flows), then loss-parity of the expert-parallel compiled path
+against single-device eager on the virtual CPU mesh.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.meta_parallel.moe_layer import MoELayer
+from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+from paddle_tpu.parallel.env import build_mesh
+from paddle_tpu.parallel.hybrid import CompiledTrainStep
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_moe_eager_forward_and_grad():
+    paddle.seed(0)
+    layer = MoELayer(hidden_size=16, ffn_hidden=32, num_experts=4)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 8, 16).astype(np.float32))
+    x.stop_gradient = False
+    out = layer(x)
+    assert list(out.shape) == [2, 8, 16]
+    assert layer.aux_loss is not None
+    assert np.isfinite(float(_np(layer.aux_loss)))
+    total = paddle.mean(out) + paddle.scale(layer.aux_loss, 0.01)
+    total.backward()
+    for name in ("gate_weight", "w1", "w2"):
+        g = getattr(layer, name).grad
+        assert g is not None and np.isfinite(np.asarray(g._data)).all(), name
+
+
+def test_moe_expert_params_annotated():
+    layer = MoELayer(hidden_size=8, ffn_hidden=16, num_experts=4)
+    assert tuple(layer.w1.dist_spec)[0] == "expert"
+    # gate is replicated (no dist_spec annotation)
+    assert getattr(layer.gate_weight, "dist_spec", None) is None
+
+
+def test_moe_capacity_drops_overflow():
+    """With capacity factor tiny, combine rows of dropped tokens are 0 and
+    outputs for those tokens are 0 (residual carries them)."""
+    paddle.seed(1)
+    layer = MoELayer(hidden_size=8, ffn_hidden=16, num_experts=2,
+                     capacity_factor=0.01)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, 32, 8).astype(np.float32))
+    out = layer(x)
+    arr = _np(out).reshape(32, 8)
+    # capacity = max(ceil(2*32/2*0.01), 4) = 4 slots/expert -> most dropped
+    dropped = np.sum(np.all(arr == 0.0, axis=1))
+    assert dropped >= 32 - 2 * 4 * 2
+
+
+def test_moe_gpt_trains_eager():
+    paddle.seed(2)
+    cfg = gpt_tiny()
+    cfg.num_experts = 4
+    model = GPTForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    rng = np.random.RandomState(2)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16))
+                           .astype(np.int32))
+    losses = []
+    for _ in range(4):
+        loss = model.loss(ids, ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_expert_parallel_compiled_parity():
+    """dp x ep compiled MoE-GPT step vs single-device eager: same loss at
+    step 1 and finite after an update."""
+    paddle.seed(3)
+    cfg = gpt_tiny()
+    cfg.num_experts = 4
+    cfg.dropout = 0.0
+    model = GPTForPretraining(cfg)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    t_ids = paddle.to_tensor(ids)
+
+    with paddle.no_grad():
+        eager_loss = float(_np(model.loss(t_ids, t_ids)))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    mesh = build_mesh({"data": 2, "expert": 2})
+    tr = CompiledTrainStep(model, lambda m, i, l: m.loss(i, l), opt, mesh)
+    l1 = float(_np(tr.step(t_ids, t_ids)))
+    # routing/copy order is identical (same params, same tokens): the
+    # sharded step must reproduce the eager loss
+    np.testing.assert_allclose(l1, eager_loss, rtol=2e-3)
+    l2 = float(_np(tr.step(t_ids, t_ids)))
+    assert np.isfinite(l2) and l2 < l1
