@@ -409,6 +409,7 @@ def _build_record(bert, resnet, lenet, gpt, on_tpu):
         "value": round(bert["samples_per_sec_per_chip"], 2) if bert else 0.0,
         "unit": "samples/s/chip",
         "vs_baseline": 1.0 if bert else 0.0,
+        "platform": "tpu" if on_tpu else "cpu-fallback",
     }
     if bert:
         record["mfu"] = round(bert["mfu"], 4) if bert["mfu"] else None

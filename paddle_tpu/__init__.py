@@ -123,7 +123,27 @@ from .ops.linalg_extra import (  # noqa: F401,E402
 )
 from .parallel import DataParallel  # noqa: F401,E402
 from .core import dtype as dtype  # noqa: F401,E402
-from .static.param_helper import create_parameter  # noqa: F401,E402
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None, **kw):
+    """Mode-aware parameter creation (paddle.create_parameter): an eager
+    Tensor parameter in dygraph mode, a startup-initialized Program
+    parameter under paddle.enable_static() (fluid layers.create_parameter)."""
+    if in_dynamic_mode():
+        if kw:
+            raise TypeError(f"create_parameter: unsupported kwargs in "
+                            f"dygraph mode: {sorted(kw)}")
+        from .nn.layer import create_parameter as _eager_cp
+
+        p = _eager_cp(shape, dtype=dtype, attr=attr, is_bias=is_bias,
+                      default_initializer=default_initializer)
+        if p is not None and name:
+            p.name = name
+        return p
+    from .static.param_helper import create_parameter as _static_cp
+
+    return _static_cp(shape, dtype=dtype, name=name, attr=attr,
+                      is_bias=is_bias,
+                      default_initializer=default_initializer, **kw)
 
 __git_commit__ = "unknown"
 

