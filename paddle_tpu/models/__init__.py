@@ -1,3 +1,7 @@
 """Flagship model zoo (NLP).  Vision zoo lives in paddle_tpu.vision.models."""
 from .gpt import GPTModel, GPTForPretraining, gpt_tiny, gpt2_small, gpt2_medium  # noqa: F401
 from .bert import BertModel, BertForPretraining, bert_base, bert_tiny  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieModel, ErnieForPretraining, ErnieForSequenceClassification,
+    ernie_base, ernie_tiny, apply_knowledge_mask,
+)

@@ -36,9 +36,7 @@ def test_book_fit_a_line():
         x = static.data("x", [64, 13])
         y = static.data("y", [64, 1])
         pred = static.nn.fc(x, 1)
-        loss = static.nn.mean(static.nn.square_error_cost(pred, y)) \
-            if hasattr(static.nn, "square_error_cost") else \
-            static.nn.mean((pred - y) * (pred - y))
+        loss = static.nn.mean((pred - y) * (pred - y))
         opt = paddle.optimizer.SGD(learning_rate=0.1)
         opt.minimize(loss)
     exe = static.Executor()
@@ -145,16 +143,13 @@ def test_book_rnn_encoder_decoder():
         with rnn.step():
             xt = rnn.step_input(x)
             prev = rnn.memory(init=h0)
-            e = static.nn.embedding_lookup(emb_w, xt) \
-                if hasattr(static.nn, "embedding_lookup") else None
-            if e is None:
-                from paddle_tpu.static.nn_static import emit
-                import jax.numpy as jnp
+            from paddle_tpu.static.nn_static import emit
+            import jax.numpy as jnp
 
-                e = emit("lookup_table_v2",
-                         [("W", emb_w), ("Ids", xt)],
-                         [("Out", [B, D], "float32")],
-                         lambda w, ids: w[ids.astype(jnp.int32)])
+            e = emit("lookup_table_v2",
+                     [("W", emb_w), ("Ids", xt)],
+                     [("Out", [B, D], "float32")],
+                     lambda w, ids: w[ids.astype(jnp.int32)])
             nxt = static.nn.fc(e + prev, D, activation="tanh")
             rnn.update_memory(prev, nxt)
             rnn.step_output(nxt)

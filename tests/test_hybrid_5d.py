@@ -7,9 +7,6 @@ analogue of the reference's multi-process `check_with_place` contract
 (test_dist_base.py:1266).
 """
 import numpy as np
-import jax.numpy as jnp
-import pytest
-
 import paddle_tpu as paddle
 from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
 from paddle_tpu.parallel.env import build_mesh

@@ -194,6 +194,103 @@ CASES = [
          wrt=()),
     case("one_hot", paddle.one_hot, [i64((5,), 96, 4)],
          lambda x: np.eye(4)[x], attrs={"num_classes": 4}, wrt=()),
+    # ---- round-2 op families (loss/sequence/vision/framework) ----
+    case("huber_loss", paddle.huber_loss,
+         [sf32((3, 4), 201), sf32((3, 4), 202)],
+         lambda x, y: np.where(np.abs(y - x) <= 1.0,
+                               0.5 * np.square(y - x),
+                               np.abs(y - x) - 0.5)),
+    case("rank_loss", paddle.rank_loss,
+         [f32((3, 1), 203, 0.0, 1.0), sf32((3, 1), 204),
+          sf32((3, 1), 205)],
+         lambda t, l, r: np.log1p(np.exp(l - r)) - t * (l - r),
+         wrt=(1, 2)),
+    case("modified_huber_loss", paddle.modified_huber_loss,
+         [sf32((3, 4), 206), i64((3, 4), 207, 2)],
+         lambda x, y: np.where((2 * y - 1) * x < -1, -4 * (2 * y - 1) * x,
+                               np.where((2 * y - 1) * x < 1,
+                                        np.square(1 - (2 * y - 1) * x),
+                                        0.0)),
+         wrt=()),
+    case("squared_l2_norm", paddle.squared_l2_norm, [sf32((3, 4), 208)],
+         lambda x: np.array([np.sum(x * x)])),
+    case("l1_norm", paddle.l1_norm, [sf32((3, 4), 209)],
+         lambda x: np.array([np.sum(np.abs(x))])),
+    case("clip_by_norm", paddle.clip_by_norm, [sf32((3, 4), 210, 2.0)],
+         lambda x: x * min(1.0, 1.0 / np.sqrt((x * x).sum())),
+         attrs={"max_norm": 1.0}),
+    case("cos_sim", paddle.cos_sim, [sf32((3, 4), 211), sf32((3, 4), 212)],
+         lambda x, y: (np.sum(x * y, 1, keepdims=True)
+                       / (np.linalg.norm(x, axis=1, keepdims=True)
+                          * np.linalg.norm(y, axis=1, keepdims=True)))),
+    case("squared_l2_distance", paddle.squared_l2_distance,
+         [sf32((3, 4), 213), sf32((3, 4), 214)],
+         lambda x, y: np.sum(np.square(x - y), axis=1)),
+    case("affine_channel", paddle.affine_channel,
+         [sf32((2, 3, 4, 4), 215), sf32((3,), 216), sf32((3,), 217)],
+         lambda x, s, b: x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1)),
+    case("shuffle_channel", paddle.shuffle_channel,
+         [sf32((1, 4, 2, 2), 218)],
+         lambda x: x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4)
+         .reshape(1, 4, 2, 2), attrs={"group": 2}),
+    case("space_to_depth", paddle.space_to_depth,
+         [sf32((1, 1, 4, 4), 219)],
+         lambda x: x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 3, 5, 1, 2, 4)
+         .reshape(1, 4, 2, 2), attrs={"blocksize": 2}),
+    case("pad_constant_like", paddle.pad_constant_like,
+         [sf32((3, 4), 220), sf32((2, 3), 221)],
+         lambda x, y: np.pad(y, [(0, 1), (0, 1)]), wrt=(1,)),
+    case("fsp_matrix", paddle.fsp_matrix,
+         [sf32((1, 2, 3, 3), 222), sf32((1, 4, 3, 3), 223)],
+         lambda x, y: np.einsum("bihw,bjhw->bij", x, y) / 9.0),
+    case("bilinear_tensor_product", paddle.bilinear_tensor_product,
+         [sf32((2, 3), 224), sf32((2, 4), 225), sf32((5, 3, 4), 226)],
+         lambda x, y, w: np.einsum("bi,kij,bj->bk", x, w, y)),
+    case("conv_shift", paddle.conv_shift,
+         [sf32((2, 5), 227), sf32((2, 3), 228)],
+         lambda x, y: np.stack([
+             np.array([sum(x[b, (j + k - 1) % 5] * y[b, k]
+                           for k in range(3)) for j in range(5)])
+             for b in range(2)])),
+    case("row_conv", paddle.row_conv,
+         [sf32((1, 4, 2), 229), sf32((2, 2), 230)],
+         lambda x, w: np.stack([
+             sum(np.pad(x[0], [(0, 1), (0, 0)])[t + j] * w[j]
+                 for j in range(2)) for t in range(4)])[None]),
+    case("add_position_encoding", paddle.add_position_encoding,
+         [sf32((1, 3, 4), 231)],
+         lambda x: x + np.concatenate([
+             np.sin(np.arange(3)[:, None]
+                    / np.power(10000.0, np.arange(2) / 2)),
+             np.cos(np.arange(3)[:, None]
+                    / np.power(10000.0, np.arange(2) / 2))], axis=1)[None],
+         out_rtol=1e-4, out_atol=1e-5),
+    case("sequence_softmax", paddle.sequence_softmax,
+         [sf32((2, 3), 232),
+          lambda: np.array([3, 2], np.int64)],
+         lambda x, l: np.stack([
+             np.exp(x[i, :l[i]]).sum() and np.concatenate([
+                 np.exp(x[i, :l[i]]) / np.exp(x[i, :l[i]]).sum(),
+                 np.zeros(3 - l[i], np.float32)])
+             for i in range(2)]),
+         wrt=(0,), out_rtol=1e-4, out_atol=1e-5),
+    # static=False: num_segments derives from the ids VALUES (a
+    # data-dependent shape), so segment_pool is an eager/boundary op
+    case("segment_sum", paddle.segment_sum,
+         [sf32((4, 2), 233), lambda: np.array([0, 0, 1, 1], np.int32)],
+         lambda x, ids: np.stack([x[:2].sum(0), x[2:].sum(0)]),
+         wrt=(0,), static=False),
+    case("size", paddle.size, [sf32((3, 4), 236)],
+         lambda x: np.array(12, np.int64), wrt=()),
+    case("memcpy", paddle.memcpy, [sf32((3, 4), 237)], lambda x: x),
+    case("softmax_mask_fuse_ut", paddle.softmax_mask_fuse_upper_triangle,
+         [sf32((1, 1, 3, 3), 239)],
+         lambda x: np.array([[[
+             np.concatenate([np.exp(x[0, 0, i, :i + 1])
+                             / np.exp(x[0, 0, i, :i + 1]).sum(),
+                             np.zeros(2 - i, np.float32)])
+             for i in range(3)]]]),
+         out_rtol=1e-4, out_atol=1e-5),
     case("cast", paddle.cast, [sf32((3, 4), 97)],
          lambda x: x.astype(np.float64), attrs={"dtype": "float64"},
          wrt=()),
