@@ -129,12 +129,21 @@ class ErnieForPretraining(Layer):
         return mlm_logits, sop_logits
 
     def loss(self, input_ids, mlm_labels, sop_labels=None, sent_ids=None):
+        """MLM averaged over NON-ignored positions (-100 labels from
+        apply_knowledge_mask contribute zero loss and zero weight)."""
         from ..ops.loss import softmax_with_cross_entropy
 
         mlm_logits, sop_logits = self.forward(input_ids, sent_ids)
-        mlm_loss = M.mean(softmax_with_cross_entropy(
+        per_pos = softmax_with_cross_entropy(
             mlm_logits,
-            MAN.reshape(mlm_labels, list(mlm_labels.shape) + [1])))
+            MAN.reshape(mlm_labels, list(mlm_labels.shape) + [1]))
+        valid = MAN.cast(
+            M.not_equal(mlm_labels, M.scale(mlm_labels, 0.0) - 100),
+            "float32")
+        valid = MAN.reshape(valid, list(mlm_labels.shape) + [1])
+        n_valid = M.sum(valid)
+        denom = M.maximum(n_valid, M.scale(n_valid, 0.0) + 1.0)
+        mlm_loss = M.sum(per_pos * valid) / denom
         if sop_labels is None:
             return mlm_loss
         sop_loss = M.mean(softmax_with_cross_entropy(
@@ -156,6 +165,9 @@ class ErnieForSequenceClassification(Layer):
         return self.classifier(self.dropout(pooled))
 
 
+_MASK_RNG = np.random.RandomState(0)
+
+
 def apply_knowledge_mask(input_ids, spans, mask_id, rng=None,
                          mask_prob=0.15):
     """ERNIE knowledge masking (host-side data transform): whole
@@ -164,7 +176,9 @@ def apply_knowledge_mask(input_ids, spans, mask_id, rng=None,
     each span is selected for masking with mask_prob.  Returns
     (masked_ids, mlm_labels) where unmasked positions carry label
     ignore (-100 convention)."""
-    rng = rng or np.random.RandomState(0)
+    # default to the module-level stream so per-batch calls make fresh
+    # masking decisions (a per-call RandomState(0) would repeat them)
+    rng = rng or _MASK_RNG
     ids = np.array(input_ids, copy=True)
     labels = np.full_like(ids, -100)
     for b, row_spans in enumerate(spans):

@@ -34,13 +34,15 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
             li = lbl
             if li.ndim == lg.ndim and li.shape[axis] == 1:
                 li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            ignored = jnp.expand_dims(li, axis) == ignore_index
+            # clamp BEFORE the gather: an ignore_index like the default
+            # -100 must not index the class axis (negative wraps silently)
+            safe = jnp.clip(li, 0, lg.shape[axis] - 1)
             picked = jnp.take_along_axis(
-                logp, jnp.expand_dims(li, axis).astype(jnp.int32), axis=axis
+                logp, jnp.expand_dims(safe, axis), axis=axis
             )
-            loss = -picked
-            if ignore_index >= 0:
-                mask = jnp.expand_dims(li, axis) != ignore_index
-                loss = loss * mask.astype(loss.dtype)
+            loss = jnp.where(ignored, 0.0, -picked)
         return loss
 
     loss = apply_op("softmax_with_cross_entropy", fn, (logits,), {})

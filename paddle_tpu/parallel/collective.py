@@ -69,6 +69,7 @@ class Group:
 
 _default_group = None
 _group_counter = [0]
+_GROUPS = {}
 
 
 def _get_default_group():
@@ -91,6 +92,7 @@ def new_group(ranks=None, backend=None, timeout=None):
     n = len(ranks) if ranks else _env.get_world_size()
     g = Group(_env.get_rank(), n, id=_group_counter[0], ranks=ranks, mesh=mesh,
               axis=mesh.axis_names[0])
+    _GROUPS[g.id] = g
     return g
 
 
@@ -314,3 +316,21 @@ def ppermute(x, axis_name, perm):
 
 def axis_index(axis_name):
     return jax.lax.axis_index(axis_name)
+
+
+def get_group(id=0):
+    """collective.py get_group parity: the Group registered under id, the
+    default world group for id 0, None for an unknown id (fail fast
+    rather than silently widening a subgroup collective to the world)."""
+    if id == 0:
+        return _get_default_group()
+    return _GROUPS.get(id)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """collective.py wait / c_sync_*_stream parity: XLA collectives are
+    value-semantic dataflow, so ordering is already guaranteed; a device
+    sync is the only observable effect."""
+    if hasattr(tensor, "_data"):
+        tensor._data.block_until_ready()
+    return tensor

@@ -18,6 +18,15 @@ TPU-native design — one jitted SPMD program instead of per-stage processes:
 - composes with 'data' (batch) and 'model' (tensor-parallel) mesh axes, grads
   pmean over 'data'; remat wraps each block for activation memory.
 
+Why there is no separate "1F1B" schedule flag: in this compiled SPMD
+formulation the backward pass is jax.vjp's reverse scan over the same
+ring, and XLA already overlaps each tick's ppermute with compute — the
+bubble fraction equals 1F1B's ((S-1)/(M+S-1)).  1F1B's remaining benefit
+over GPipe is peak activation memory (depth S instead of M); here remat
+(per-block jax.checkpoint) provides the same bound compiler-side, so a
+hand-written interleaved adjoint schedule would add complexity without
+changing the bubble or the memory ceiling (section_worker.cc:167 context).
+
 Per-chip flat param/opt-state buffers follow the hybrid-step convention
 (device-local buffers carried with replicated out-specs, parallel/hybrid.py).
 """

@@ -29,6 +29,31 @@ def test_knowledge_mask_spans():
     assert (masked[1, 2:4] == 3).all()
 
 
+def test_ernie_masked_loss_ignores_minus100():
+    """Regression: -100 labels from apply_knowledge_mask must contribute
+    ZERO loss (softmax_with_cross_entropy ignore_index default) and the
+    MLM mean must average only over masked positions."""
+    paddle.seed(23)
+    cfg = ernie_tiny()
+    model = ErnieForPretraining(cfg)
+    rng = np.random.RandomState(23)
+    ids = rng.randint(5, cfg.vocab_size, (2, 12)).astype(np.int64)
+    spans = [[(0, 3)], [(4, 6)]]
+    masked, labels = apply_knowledge_mask(
+        ids, spans, mask_id=3, rng=np.random.RandomState(1), mask_prob=1.0)
+    loss_all = model.loss(paddle.to_tensor(masked.astype(np.int32)),
+                          paddle.to_tensor(labels))
+    v = float(_np(loss_all))
+    assert np.isfinite(v)
+    # an all-ignored label matrix gives exactly zero MLM loss
+    all_ign = np.full_like(labels, -100)
+    z = float(_np(model.loss(paddle.to_tensor(masked.astype(np.int32)),
+                             paddle.to_tensor(all_ign))))
+    assert z == 0.0
+    # ~ -log(1/V) scale, not diluted by the 19 unmasked positions
+    assert v > 0.5 * np.log(cfg.vocab_size)
+
+
 def test_ernie_pretrain_loss_decreases():
     paddle.seed(20)
     cfg = ernie_tiny()
@@ -63,8 +88,6 @@ def test_ernie_classifier_and_task_ids():
 def test_ernie_zero2_compiled():
     """config 5 ERNIE leg: ZeRO-2 sharded compiled step, loss parity with
     eager."""
-    import jax.numpy as jnp
-
     paddle.seed(22)
     cfg = ernie_tiny()
     model = ErnieForPretraining(cfg)

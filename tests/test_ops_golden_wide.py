@@ -269,7 +269,7 @@ CASES = [
          [sf32((2, 3), 232),
           lambda: np.array([3, 2], np.int64)],
          lambda x, l: np.stack([
-             np.exp(x[i, :l[i]]).sum() and np.concatenate([
+             np.concatenate([
                  np.exp(x[i, :l[i]]) / np.exp(x[i, :l[i]]).sum(),
                  np.zeros(3 - l[i], np.float32)])
              for i in range(2)]),
