@@ -29,6 +29,7 @@ _ALIASES = {
     "allclose": "allclose", "arg_max": "argmax", "arg_min": "argmin",
     "argsort": "argsort", "asin": "asin", "asinh": "asinh",
     "atanh": "atanh", "assign": "assign",
+    "average_accumulates": "incubate.optimizer.average_accumulates",
     "assign_value": "assign_value", "atan": "atan", "atan2": "atan2",
     "batch_norm": "nn.functional.batch_norm", "bce_loss": "nn.functional.binary_cross_entropy",
     "beam_search": "beam_search", "beam_search_decode": "beam_search_decode",
@@ -51,6 +52,7 @@ _ALIASES = {
     "cvm": "cvm", "data_norm": "data_norm",
     "deformable_conv": "deformable_conv",
     "deformable_conv_v1": "deformable_conv",
+    "deformable_psroi_pooling": "deformable_psroi_pooling",
     "diag": "diag", "diag_v2": "diag", "diag_embed": "nn.functional.diag_embed",
     "diagonal": "diagonal", "digamma": "digamma", "dist": "dist",
     "dot": "dot", "dropout": "nn.functional.dropout",
@@ -70,6 +72,7 @@ _ALIASES = {
     "floor": "floor", "fsp": "fsp_matrix",
     "fused_softmax_mask_upper_triangle": "softmax_mask_fuse_upper_triangle",
     "gather": "gather", "gather_nd": "gather_nd",
+    "get_tensor_from_selected_rows": "get_tensor_from_selected_rows",
     "gather_tree": "nn.functional.gather_tree",
     "gaussian_random": "normal",
     "gaussian_random_batch_size_like": "gaussian_random_batch_size_like",
@@ -156,6 +159,7 @@ _ALIASES = {
     "tanh_shrink": "nn.functional.tanhshrink",
     "teacher_student_sigmoid_loss": "teacher_student_sigmoid_loss",
     "temporal_shift": "nn.functional.temporal_shift",
+    "tensor_array_to_tensor": "tensor_array_to_tensor",
     "tile": "tile", "top_k": "topk", "top_k_v2": "topk", "trace": "trace",
     "transpose2": "transpose", "tril_triu": "tril", "trunc": "trunc",
     "truncated_gaussian_random": "normal", "unbind": "unbind",
@@ -218,9 +222,7 @@ _ABSENT = {
     "quantize": "MKLDNN int8 path; quant/qat.py fake-quant is the analogue",
     "dequantize_abs_max": "int8 inference dequant; quant/qat.py",
     "dequantize_log": "int8 inference dequant",
-    "get_tensor_from_selected_rows": None,  # implemented
     "delete_var": "executor GC owns variable lifetime (native planner)",
-    "average_accumulates": None,  # implemented (incubate.ModelAverage)
 }
 _ABSENT = {k: v for k, v in _ABSENT.items() if v is not None}
 

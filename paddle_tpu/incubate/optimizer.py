@@ -69,6 +69,45 @@ class LookAhead:
         self.inner_optimizer.set_state_dict(sd)
 
 
+_MAX_NUM_ACCUMULATES = 16384  # kMaxNumAccumulates (average_accumulates_op.h)
+
+
+def average_accumulates(param, in_sum_1, in_sum_2, in_sum_3,
+                        num_accumulates, old_num_accumulates, num_updates,
+                        average_window, max_average_window,
+                        min_average_window):
+    """The average_accumulates op (average_accumulates_op.h:38): one
+    accumulation step of the windowed parameter-average scheme.  Returns
+    (out_sum_1, out_sum_2, out_sum_3, num_accumulates,
+    old_num_accumulates, num_updates).  sum_1 folds into sum_2 every
+    kMaxNumAccumulates steps to bound fp error; when the window closes
+    (num_accumulates reaches min(max_window, num_updates*rate), at least
+    min_window) sums collapse into sum_3 and the window counters reset."""
+    if min_average_window > max_average_window:
+        raise ValueError(
+            f"min_average_window {min_average_window} > max_average_window"
+            f" {max_average_window}")
+    p = param._data if hasattr(param, "_data") else jnp.asarray(param)
+    s1 = in_sum_1._data if hasattr(in_sum_1, "_data") else jnp.asarray(in_sum_1)
+    s2 = in_sum_2._data if hasattr(in_sum_2, "_data") else jnp.asarray(in_sum_2)
+    s3 = in_sum_3._data if hasattr(in_sum_3, "_data") else jnp.asarray(in_sum_3)
+    num_updates = int(num_updates) + 1
+    num_accumulates = int(num_accumulates) + 1
+    s1 = s1 + p
+    if num_updates % _MAX_NUM_ACCUMULATES == 0:
+        s2 = s2 + s1
+        s1 = jnp.zeros_like(s1)
+    if (num_accumulates >= min_average_window
+            and num_accumulates >= min(max_average_window,
+                                       num_updates * average_window)):
+        s3 = s1 + s2
+        s1 = jnp.zeros_like(s1)
+        s2 = jnp.zeros_like(s2)
+        old_num_accumulates = num_accumulates
+        num_accumulates = 0
+    return s1, s2, s3, num_accumulates, old_num_accumulates, num_updates
+
+
 class ModelAverage:
     """Running parameter average applied at eval time
     (modelaverage.py:31 / average_accumulates_op.cc).
@@ -96,30 +135,19 @@ class ModelAverage:
         self._num_updates = 0
         self._saved = None
 
-    _MAX_FOLD = 16384  # kMaxNumAccumulates (average_accumulates_op.h)
-
     def accumulate(self):
-        """Record current parameter values — the exact
-        average_accumulates_op.h update rule."""
-        self._num_updates += 1
-        self._num_accum += 1
-        fold = self._num_updates % self._MAX_FOLD == 0
-        close = (self._num_accum >= self.min_w
-                 and self._num_accum >= min(self.max_w,
-                                            self._num_updates * self.rate))
+        """Record current parameter values via the average_accumulates
+        op (one call per parameter, shared counters)."""
+        na, on, nu = (self._num_accum + 1, self._old_num,
+                      self._num_updates + 1)
         for p in self._parameters:
             n = p.name
-            self._sum1[n] = self._sum1[n] + p._data
-            if fold:
-                self._sum2[n] = self._sum2[n] + self._sum1[n]
-                self._sum1[n] = jnp.zeros_like(p._data)
-            if close:
-                self._sum3[n] = self._sum1[n] + self._sum2[n]
-                self._sum1[n] = jnp.zeros_like(p._data)
-                self._sum2[n] = jnp.zeros_like(p._data)
-        if close:
-            self._old_num = self._num_accum
-            self._num_accum = 0
+            (self._sum1[n], self._sum2[n], self._sum3[n],
+             na, on, nu) = average_accumulates(
+                p._data, self._sum1[n], self._sum2[n], self._sum3[n],
+                self._num_accum, self._old_num, self._num_updates,
+                self.rate, self.max_w, self.min_w)
+        self._num_accum, self._old_num, self._num_updates = na, on, nu
 
     # the reference calls accumulate from minimize(); keep both spellings
     def step(self):

@@ -19,7 +19,7 @@ from ..nn import functional as F
 from ..nn.layers.transformer import TransformerEncoder, TransformerEncoderLayer
 from ..ops import math as M
 from ..ops import manipulation as MAN
-from ..ops.creation import arange
+from ..ops.creation import arange, full_like
 
 
 class ErnieConfig:
@@ -138,11 +138,11 @@ class ErnieForPretraining(Layer):
             mlm_logits,
             MAN.reshape(mlm_labels, list(mlm_labels.shape) + [1]))
         valid = MAN.cast(
-            M.not_equal(mlm_labels, M.scale(mlm_labels, 0.0) - 100),
+            M.not_equal(mlm_labels, full_like(mlm_labels, -100)),
             "float32")
         valid = MAN.reshape(valid, list(mlm_labels.shape) + [1])
         n_valid = M.sum(valid)
-        denom = M.maximum(n_valid, M.scale(n_valid, 0.0) + 1.0)
+        denom = M.maximum(n_valid, full_like(n_valid, 1.0))
         mlm_loss = M.sum(per_pos * valid) / denom
         if sop_labels is None:
             return mlm_loss

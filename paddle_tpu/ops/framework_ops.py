@@ -24,7 +24,8 @@ from ..core.indexed_slices import IndexedSlices
 __all__ = [
     "assign_value", "size", "numel_op", "memcpy", "share_data", "nop",
     "marker", "coalesce_tensor", "queue_generator", "enqueue", "dequeue",
-    "merge_selected_rows", "get_tensor_from_selected_rows", "py_func",
+    "merge_selected_rows", "get_tensor_from_selected_rows",
+    "tensor_array_to_tensor", "py_func",
 ]
 
 
@@ -166,6 +167,27 @@ def get_tensor_from_selected_rows(x, name=None):
     if not isinstance(x, IndexedSlices):
         raise TypeError("get_tensor_from_selected_rows expects IndexedSlices")
     return to_tensor(np.asarray(x.to_dense()))
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
+    """Fuse a tensor array (Python list of Tensors — the LoDTensorArray
+    analogue, see docs/ABSENT.md on LoD) into one tensor
+    (tensor_array_to_tensor_op.cc).  Returns (out, out_index) where
+    out_index records each element's extent along `axis` (all 1s when
+    stacking), matching the reference's OutIndex output."""
+    if not isinstance(input, (list, tuple)) or not input:
+        raise TypeError("tensor_array_to_tensor expects a non-empty list")
+    if use_stack:
+        fn = lambda *xs: jnp.stack(xs, axis=axis)
+        index = np.ones(len(input), np.int32)
+    else:
+        fn = lambda *xs: jnp.concatenate(xs, axis=axis)
+        index = np.array([(t._data if isinstance(t, Tensor)
+                           else np.asarray(t)).shape[axis]
+                          for t in input], np.int32)
+    out = apply_op("tensor_array_to_tensor",
+                   fn, tuple(input), {})
+    return out, to_tensor(index)
 
 
 def make_pyfunc_fn(func, specs, backward_func=None):

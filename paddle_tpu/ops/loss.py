@@ -67,29 +67,30 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             li = lbl
             if li.ndim == lg.ndim and li.shape[axis] == 1:
                 li = jnp.squeeze(li, axis=axis)
+            ignored = li == ignore_index
+            # ignore_index (default -100) must not index the class axis
+            safe = jnp.clip(li.astype(jnp.int32), 0, lg.shape[axis] - 1)
             picked = jnp.take_along_axis(
-                logp, jnp.expand_dims(li, axis).astype(jnp.int32), axis=axis
+                logp, jnp.expand_dims(safe, axis), axis=axis
             )
             loss = -jnp.squeeze(picked, axis=axis)
             if w:
-                loss = loss * jnp.take(w[0], li.astype(jnp.int32))
-            if ignore_index >= 0:
-                loss = jnp.where(li == ignore_index, 0.0, loss)
+                loss = loss * jnp.take(w[0], safe)
+            loss = jnp.where(ignored, 0.0, loss)
         return loss
 
     args = (input,) + ((weight,) if weight is not None else ())
     out = apply_op("cross_entropy", fn, args, {})
-    if reduction == "mean" and not soft_label and (
-        ignore_index >= 0 or weight is not None
-    ):
-        # weighted/ignored mean divides by sum of effective weights
+    if reduction == "mean" and not soft_label:
+        # masked/weighted mean divides by the sum of effective weights
         from . import math as M
 
         li = lbl
         if weight is not None:
-            w_per = jnp.take(weight._data, li.astype(jnp.int32))
-            if ignore_index >= 0:
-                w_per = jnp.where(li == ignore_index, 0.0, w_per)
+            safe = jnp.clip(li.astype(jnp.int32), 0,
+                            weight._data.shape[0] - 1)
+            w_per = jnp.where(li == ignore_index, 0.0,
+                              jnp.take(weight._data, safe))
             denom = float(jnp.sum(w_per))
         else:
             denom = float(jnp.sum(li != ignore_index))

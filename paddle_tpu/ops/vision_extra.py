@@ -26,6 +26,7 @@ from ..core.tensor import Tensor, to_tensor
 __all__ = [
     "affine_channel", "shuffle_channel", "space_to_depth", "spp",
     "max_pool2d_with_index", "max_unpool2d", "psroi_pool", "prroi_pool",
+    "deformable_psroi_pooling", "deformable_roi_pooling",
     "deformable_conv", "random_crop", "pad_constant_like",
     "partial_concat", "partial_sum", "fsp_matrix", "data_norm", "cvm",
     "softmax_mask_fuse_upper_triangle", "bilinear_tensor_product",
@@ -244,6 +245,129 @@ def psroi_pool(x, rois, output_channels, spatial_scale, pooled_height,
         return jnp.stack(outs)
 
     return apply_op("psroi_pool", fn, (x, rois), {})
+
+
+def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
+                             spatial_scale=1.0, group_size=(1, 1),
+                             pooled_height=1, pooled_width=1,
+                             output_dim=None, part_size=None,
+                             sample_per_part=1, trans_std=0.1,
+                             position_sensitive=False, rois_num=None,
+                             name=None):
+    """Deformable PS-ROI pooling (deformable_psroi_pooling_op.h, the
+    fluid.layers.deformable_roi_pooling surface): each output bin's sample
+    window is shifted by a learned per-part offset `trans` (scaled by
+    trans_std and the ROI extent) before bilinear-average pooling; with
+    position_sensitive=True the input channel feeding output channel c at
+    bin (gh, gw) is (c*group_h + gh)*group_w + gw.
+
+    input: (N, C, H, W); rois: (R, 4) [x1, y1, x2, y2] image coords;
+    trans: (R, 2*num_classes, part_h, part_w) offsets or None.
+    """
+    ph, pw = int(pooled_height), int(pooled_width)
+    gh, gw = int(group_size[0]), int(group_size[1])
+    spp = int(sample_per_part)
+    rois_arr = np.asarray(rois._data if isinstance(rois, Tensor) else rois,
+                          np.float32)
+    splits = (np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                         else rois_num, np.int64).reshape(-1)
+              if rois_num is not None else
+              np.array([rois_arr.shape[0]], np.int64))
+    batch_of = np.repeat(np.arange(len(splits)), splits)
+    C = input.shape[1]
+    if output_dim is None:
+        output_dim = C // (gh * gw) if position_sensitive else C
+    oc = int(output_dim)
+    if part_size is None:
+        part_size = (ph, pw)
+    pth, ptw = int(part_size[0]), int(part_size[1])
+    use_trans = not no_trans and trans is not None
+    n_classes = 1
+    if use_trans:
+        n_classes = (trans.shape[1] if isinstance(trans, Tensor)
+                     else np.asarray(trans).shape[1]) // 2
+    ch_per_class = max(oc // n_classes, 1)
+
+    # host-precomputed static index grids (bin -> part cell / group cell)
+    part_iy = np.minimum((np.arange(ph) * pth) // ph, pth - 1)
+    part_ix = np.minimum((np.arange(pw) * ptw) // pw, ptw - 1)
+    grp_iy = np.clip((np.arange(ph) * gh) // ph, 0, gh - 1)
+    grp_ix = np.clip((np.arange(pw) * gw) // pw, 0, gw - 1)
+    class_of = np.minimum(np.arange(oc) // ch_per_class, n_classes - 1)
+
+    def fn(xv, rv, tv):
+        H, W = xv.shape[2], xv.shape[3]
+
+        def one_roi(roi, b, t_roi):
+            # reference rounds the box then recenters by half a pixel
+            x1 = jnp.round(roi[0]) * spatial_scale - 0.5
+            y1 = jnp.round(roi[1]) * spatial_scale - 0.5
+            x2 = (jnp.round(roi[2]) + 0.5) * spatial_scale - 0.5
+            y2 = (jnp.round(roi[3]) + 0.5) * spatial_scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_h, bin_w = rh / ph, rw / pw
+            sub_h, sub_w = bin_h / spp, bin_w / spp
+            if use_trans:
+                # trans channel 0 is the x-offset, channel 1 the y-offset
+                # (deformable_psroi_pooling_op.h:101-117 bottom_trans layout)
+                t = t_roi.reshape(n_classes, 2, pth, ptw) * trans_std
+                off_x = t[:, 0][:, part_iy][:, :, part_ix] * rw
+                off_y = t[:, 1][:, part_iy][:, :, part_ix] * rh
+            else:
+                off_x = jnp.zeros((n_classes, ph, pw))
+                off_y = jnp.zeros((n_classes, ph, pw))
+            # sample grid per class: (classes, ph, pw, spp, spp)
+            base_y = y1 + jnp.arange(ph)[:, None] * bin_h  # (ph, 1)
+            base_x = x1 + jnp.arange(pw)[None, :] * bin_w  # (1, pw)
+            sy = (base_y[None, :, :, None, None] + off_y[..., None, None]
+                  + jnp.arange(spp)[None, None, None, :, None] * sub_h)
+            sx = (base_x[None, :, :, None, None] + off_x[..., None, None]
+                  + jnp.arange(spp)[None, None, None, None, :] * sub_w)
+            ok = ((sy > -0.5) & (sy < H - 0.5)
+                  & (sx > -0.5) & (sx < W - 0.5))
+            yc = jnp.clip(sy, 0, H - 1)
+            xc = jnp.clip(sx, 0, W - 1)
+            samp = _bilinear_at(xv[b], yc, xc)  # (C, cls, ph, pw, s, s)
+            samp = jnp.where(ok[None], samp, 0.0)
+            n_ok = jnp.maximum(jnp.sum(ok, axis=(-2, -1)), 1)  # (cls,ph,pw)
+            pooled = jnp.sum(samp, axis=(-2, -1)) / n_ok[None]
+            # pick each output channel's input channel + its class plane
+            if position_sensitive:
+                cin = ((np.arange(oc)[:, None, None] * gh
+                        + grp_iy[None, :, None]) * gw
+                       + grp_ix[None, None, :])  # (oc, ph, pw)
+            else:
+                cin = np.broadcast_to(
+                    np.arange(oc)[:, None, None], (oc, ph, pw))
+            iy = np.arange(ph)[None, :, None]
+            ix = np.arange(pw)[None, None, :]
+            return pooled[cin, class_of[:, None, None], iy, ix]
+
+        outs = [one_roi(rv[i], int(batch_of[i]),
+                        tv[i] if use_trans else None)
+                for i in range(rv.shape[0])]
+        return jnp.stack(outs)
+
+    args = (input, rois, trans) if use_trans else (input, rois)
+    if not use_trans:
+        return apply_op("deformable_psroi_pooling",
+                        lambda xv, rv: fn(xv, rv, None), args, {})
+    return apply_op("deformable_psroi_pooling", fn, args, {})
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """fluid.layers.deformable_roi_pooling parity wrapper."""
+    return deformable_psroi_pooling(
+        input, rois, trans, no_trans=no_trans, spatial_scale=spatial_scale,
+        group_size=group_size, pooled_height=pooled_height,
+        pooled_width=pooled_width, part_size=part_size,
+        sample_per_part=sample_per_part, trans_std=trans_std,
+        position_sensitive=position_sensitive, name=name)
 
 
 def prroi_pool(x, rois, pooled_height, pooled_width, spatial_scale=1.0,

@@ -90,5 +90,5 @@ def test_surface_coverage_ratchet():
         else:
             missing.append(n)
     frac = covered / len(names)
-    assert frac >= 0.95, (
+    assert frac >= 1.0, (
         f"op-surface coverage regressed: {frac:.2%}; missing {missing}")

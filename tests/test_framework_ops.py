@@ -195,3 +195,21 @@ def test_static_rnn_with_fc_trains():
         l2, = exe.run(main, feed={"x": xv, "h0": h0v}, fetch_list=[loss])
     assert np.isfinite(l1).all() and np.isfinite(l2).all()
     assert float(l2) < float(l1)  # SGD on mean() decreases it
+
+
+def test_tensor_array_to_tensor():
+    """tensor_array_to_tensor_op.cc: concat fuses the array along axis and
+    OutIndex records each element's extent; use_stack stacks instead."""
+    import paddle_tpu as paddle
+    from paddle_tpu import _C_ops
+
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    b = paddle.to_tensor(np.full((4, 3), 2.0, np.float32))
+    out, idx = _C_ops.tensor_array_to_tensor([a, b], axis=0)
+    assert list(out.shape) == [6, 3]
+    np.testing.assert_array_equal(np.asarray(idx._data), [2, 4])
+    np.testing.assert_allclose(np.asarray(out._data)[2:], 2.0)
+
+    out, idx = paddle.tensor_array_to_tensor([a, a], axis=1, use_stack=True)
+    assert list(out.shape) == [2, 2, 3]
+    np.testing.assert_array_equal(np.asarray(idx._data), [1, 1])

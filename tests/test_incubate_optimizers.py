@@ -71,3 +71,27 @@ def test_ema_apply_restore_bias_corrected():
         # bias-corrected by (1 - 0.5^2): 2.5 / 0.75
         np.testing.assert_allclose(applied, 2.5 / 0.75, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(p._data), before)
+
+
+def test_average_accumulates_op_windowing():
+    """average_accumulates_op.h: sums accumulate the param; the window
+    closes (sum_3 <- sum_1 + sum_2, counters reset) once num_accumulates
+    reaches min(max_window, num_updates * rate) and min_window."""
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.optimizer import average_accumulates
+
+    p = jnp.full((3,), 2.0)
+    s1 = s2 = s3 = jnp.zeros(3)
+    na = on = nu = 0
+    # rate=1.0, min_window=2: first step must NOT close the window
+    s1, s2, s3, na, on, nu = average_accumulates(
+        p, s1, s2, s3, na, on, nu, 1.0, 100, 2)
+    np.testing.assert_allclose(np.asarray(s1), 2.0)
+    assert (na, on, nu) == (1, 0, 1)
+    # second step closes it: s3 = 2 steps of p, s1/s2 reset
+    s1, s2, s3, na, on, nu = average_accumulates(
+        p, s1, s2, s3, na, on, nu, 1.0, 100, 2)
+    np.testing.assert_allclose(np.asarray(s3), 4.0)
+    np.testing.assert_allclose(np.asarray(s1), 0.0)
+    np.testing.assert_allclose(np.asarray(s2), 0.0)
+    assert (na, on, nu) == (0, 2, 2)

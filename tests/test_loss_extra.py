@@ -162,3 +162,34 @@ def test_chunk_eval_iob():
     np.testing.assert_allclose(float(_np(prec)[0]), 0.5)
     np.testing.assert_allclose(float(_np(rec)[0]), 1.0)
     np.testing.assert_allclose(float(_np(f1)[0]), 2 * 0.5 / 1.5, rtol=1e-6)
+
+
+def test_cross_entropy_negative_ignore_index():
+    """F.cross_entropy must honor the default ignore_index=-100: ignored
+    positions contribute zero loss AND leave the mean denominator (torch /
+    reference softmax_with_cross_entropy convention for hard labels)."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    logits_np = rng.rand(4, 5).astype(np.float32)
+    logits = paddle.to_tensor(logits_np)
+    labels = paddle.to_tensor(np.array([1, -100, 3, -100], np.int64))
+    loss = float(np.asarray(
+        F.cross_entropy(logits, labels, reduction="mean")._data))
+    # oracle: mean over the two non-ignored rows only
+    lp = logits_np - np.log(
+        np.exp(logits_np).sum(-1, keepdims=True))
+    want = (-lp[0, 1] - lp[2, 3]) / 2
+    np.testing.assert_allclose(loss, want, rtol=1e-5)
+
+    # sum/none reductions: ignored rows are exactly zero
+    per = np.asarray(F.cross_entropy(
+        logits, labels, reduction="none")._data).reshape(-1)
+    assert per[1] == 0.0 and per[3] == 0.0
+
+    # weighted mean: denominator is the sum of non-ignored class weights
+    w = paddle.to_tensor(np.array([1, 2, 1, 4, 1], np.float32))
+    lw = float(np.asarray(F.cross_entropy(
+        logits, labels, weight=w, reduction="mean")._data))
+    want_w = (2 * -lp[0, 1] + 4 * -lp[2, 3]) / (2 + 4)
+    np.testing.assert_allclose(lw, want_w, rtol=1e-5)

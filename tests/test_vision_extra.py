@@ -203,3 +203,53 @@ def test_unique_with_counts_and_batch_size_like():
     assert list(u.shape) == [5, 3]
     g = paddle.gaussian_random_batch_size_like(ref, [1, 3])
     assert list(g.shape) == [5, 3]
+
+
+def test_deformable_psroi_pooling_matches_psroi_at_zero_offset():
+    """With zero trans + position_sensitive, deformable PS-ROI pooling is
+    plain PS-ROI pooling (deformable_psroi_pooling_op.h degenerates when
+    trans_x = trans_y = 0); also check the offset path moves the samples
+    and gradients flow to the offsets."""
+    rng = np.random.RandomState(0)
+    # C = oc * gh * gw = 2*2*2 = 8
+    x = paddle.to_tensor(rng.rand(1, 8, 10, 10).astype(np.float32))
+    rois = np.array([[1.0, 1.0, 8.0, 8.0]], np.float32)
+    zero_trans = paddle.to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+    out_z = _np(paddle.deformable_psroi_pooling(
+        x, rois, zero_trans, group_size=(2, 2), pooled_height=2,
+        pooled_width=2, part_size=(2, 2), sample_per_part=4,
+        position_sensitive=True))
+    out_n = _np(paddle.deformable_psroi_pooling(
+        x, rois, None, no_trans=True, group_size=(2, 2), pooled_height=2,
+        pooled_width=2, part_size=(2, 2), sample_per_part=4,
+        position_sensitive=True))
+    np.testing.assert_allclose(out_z, out_n, rtol=1e-6)
+    assert out_z.shape == (1, 2, 2, 2)
+
+    # non-zero offsets change the pooled values
+    trans = paddle.to_tensor(
+        rng.uniform(-1, 1, (1, 2, 2, 2)).astype(np.float32))
+    trans.stop_gradient = False
+    out_t = paddle.deformable_psroi_pooling(
+        x, rois, trans, group_size=(2, 2), pooled_height=2, pooled_width=2,
+        part_size=(2, 2), sample_per_part=4, position_sensitive=True)
+    assert not np.allclose(_np(out_t), out_z)
+    paddle.mean(out_t).backward()
+    assert trans.grad is not None
+    assert np.abs(_np(trans.grad)).sum() > 0
+
+
+def test_deformable_roi_pooling_plain_channels():
+    """position_sensitive=False: every output channel reads its own input
+    channel; a constant-per-channel input pools to that constant."""
+    vals = np.arange(3, dtype=np.float32)
+    x = paddle.to_tensor(
+        np.broadcast_to(vals[None, :, None, None], (1, 3, 8, 8)).copy())
+    rois = np.array([[0.0, 0.0, 6.0, 6.0]], np.float32)
+    out = _np(paddle.deformable_roi_pooling(
+        x, rois, None, no_trans=True, pooled_height=2, pooled_width=2,
+        sample_per_part=2))
+    assert out.shape == (1, 3, 2, 2)
+    np.testing.assert_allclose(
+        out, np.broadcast_to(vals[None, :, None, None], (1, 3, 2, 2)),
+        rtol=1e-6)
