@@ -60,20 +60,24 @@ def _probe_platform():
     import subprocess
 
     timeout = float(os.environ.get("PTN_BENCH_PROBE_TIMEOUT", "240"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        sys.stderr.write("bench: backend probe timed out; forcing CPU\n")
-        return None
-    for line in proc.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1].strip()
-    sys.stderr.write(
-        f"bench: backend probe failed (rc={proc.returncode}): "
-        f"{proc.stderr[-500:]}\n")
+    retries = int(os.environ.get("PTN_BENCH_PROBE_RETRIES", "2"))
+    for attempt in range(retries):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"bench: backend probe timed out (attempt {attempt + 1})\n")
+            continue
+        for line in proc.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1].strip()
+        sys.stderr.write(
+            f"bench: backend probe failed (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}\n")
+    sys.stderr.write("bench: all probes failed; forcing CPU\n")
     return None
 
 

@@ -179,12 +179,14 @@ def tensor_array_to_tensor(input, axis=0, use_stack=False, name=None):
         raise TypeError("tensor_array_to_tensor expects a non-empty list")
     if use_stack:
         fn = lambda *xs: jnp.stack(xs, axis=axis)
-        index = np.ones(len(input), np.int32)
     else:
         fn = lambda *xs: jnp.concatenate(xs, axis=axis)
-        index = np.array([(t._data if isinstance(t, Tensor)
-                           else np.asarray(t)).shape[axis]
-                          for t in input], np.int32)
+    # OutIndex records each element's extent along axis in BOTH modes
+    # (tensor_array_to_tensor_op.cc:115-119 writes inx_dims[axis]
+    # unconditionally)
+    index = np.array([(t._data if isinstance(t, Tensor)
+                       else np.asarray(t)).shape[axis]
+                      for t in input], np.int32)
     out = apply_op("tensor_array_to_tensor",
                    fn, tuple(input), {})
     return out, to_tensor(index)

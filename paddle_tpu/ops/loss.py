@@ -82,8 +82,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     args = (input,) + ((weight,) if weight is not None else ())
     out = apply_op("cross_entropy", fn, args, {})
     if reduction == "mean" and not soft_label:
-        # masked/weighted mean divides by the sum of effective weights
+        # masked/weighted mean divides by the sum of effective weights.
+        # Keep the denominator traced (no float()/host sync): labels are
+        # tracers when this runs under jit.to_static / compiled steps.
         from . import math as M
+        from ..core.tensor import _wrap_data
 
         li = lbl
         if weight is not None:
@@ -91,10 +94,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                             weight._data.shape[0] - 1)
             w_per = jnp.where(li == ignore_index, 0.0,
                               jnp.take(weight._data, safe))
-            denom = float(jnp.sum(w_per))
+            denom = jnp.sum(w_per)
         else:
-            denom = float(jnp.sum(li != ignore_index))
-        return M.divide(M.sum(out), to_tensor(max(denom, 1e-12)))
+            denom = jnp.sum(li != ignore_index).astype(out._data.dtype)
+        return M.divide(M.sum(out),
+                        _wrap_data(jnp.maximum(denom, 1e-12)))
     return _reduce_loss(out, reduction)
 
 

@@ -302,8 +302,8 @@ def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
             # reference rounds the box then recenters by half a pixel
             x1 = jnp.round(roi[0]) * spatial_scale - 0.5
             y1 = jnp.round(roi[1]) * spatial_scale - 0.5
-            x2 = (jnp.round(roi[2]) + 0.5) * spatial_scale - 0.5
-            y2 = (jnp.round(roi[3]) + 0.5) * spatial_scale - 0.5
+            x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+            y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
             rw = jnp.maximum(x2 - x1, 0.1)
             rh = jnp.maximum(y2 - y1, 0.1)
             bin_h, bin_w = rh / ph, rw / pw
@@ -324,8 +324,10 @@ def deformable_psroi_pooling(input, rois, trans=None, no_trans=False,
                   + jnp.arange(spp)[None, None, None, :, None] * sub_h)
             sx = (base_x[None, :, :, None, None] + off_x[..., None, None]
                   + jnp.arange(spp)[None, None, None, None, :] * sub_w)
-            ok = ((sy > -0.5) & (sy < H - 0.5)
-                  & (sx > -0.5) & (sx < W - 0.5))
+            # boundary samples (exactly ±0.5 outside) are kept, as the
+            # reference does, and clamped into range before interpolation
+            ok = ((sy >= -0.5) & (sy <= H - 0.5)
+                  & (sx >= -0.5) & (sx <= W - 0.5))
             yc = jnp.clip(sy, 0, H - 1)
             xc = jnp.clip(sx, 0, W - 1)
             samp = _bilinear_at(xv[b], yc, xc)  # (C, cls, ph, pw, s, s)

@@ -210,6 +210,9 @@ def test_tensor_array_to_tensor():
     np.testing.assert_array_equal(np.asarray(idx._data), [2, 4])
     np.testing.assert_allclose(np.asarray(out._data)[2:], 2.0)
 
+    # stack mode still reports each element's extent along axis
+    # (tensor_array_to_tensor_op.cc:115-119 records inx_dims[axis]
+    # unconditionally, both modes)
     out, idx = paddle.tensor_array_to_tensor([a, a], axis=1, use_stack=True)
     assert list(out.shape) == [2, 2, 3]
-    np.testing.assert_array_equal(np.asarray(idx._data), [1, 1])
+    np.testing.assert_array_equal(np.asarray(idx._data), [3, 3])

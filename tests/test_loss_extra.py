@@ -193,3 +193,19 @@ def test_cross_entropy_negative_ignore_index():
         logits, labels, weight=w, reduction="mean")._data))
     want_w = (2 * -lp[0, 1] + 4 * -lp[2, 3]) / (2 + 4)
     np.testing.assert_allclose(lw, want_w, rtol=1e-5)
+
+
+def test_cross_entropy_mean_traces_under_jit():
+    """The masked-mean denominator must stay traced: labels are tracers
+    under jit.to_static, so a concretizing float() would raise."""
+    import paddle_tpu.nn.functional as F
+
+    @paddle.jit.to_static
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits, labels, reduction="mean")
+
+    logits = paddle.to_tensor(
+        np.random.RandomState(0).rand(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([1, -100, 3, 2], np.int64))
+    out = float(np.asarray(loss_fn(logits, labels)._data))
+    assert np.isfinite(out) and out > 0

@@ -253,3 +253,19 @@ def test_deformable_roi_pooling_plain_channels():
     np.testing.assert_allclose(
         out, np.broadcast_to(vals[None, :, None, None], (1, 3, 2, 2)),
         rtol=1e-6)
+
+
+def test_deformable_psroi_pooling_reference_geometry():
+    """Reference ROI geometry (deformable_psroi_pooling_op.h:76-87):
+    start = round(r)*scale - 0.5, end = (round(r)+1)*scale - 0.5.  With
+    rois=[[0,0,3,3]], pooled 1x1, sample_per_part=1, the single sample
+    lands exactly on (-0.5, -0.5) — on-boundary, so it is KEPT and
+    clamped to pixel (0, 0): output == x[:, :, 0, 0]."""
+    rng = np.random.RandomState(3)
+    x_np = rng.rand(1, 3, 6, 6).astype(np.float32)
+    x = paddle.to_tensor(x_np)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = _np(paddle.deformable_psroi_pooling(
+        x, rois, None, no_trans=True, pooled_height=1, pooled_width=1,
+        sample_per_part=1))
+    np.testing.assert_allclose(out[0, :, 0, 0], x_np[0, :, 0, 0], rtol=1e-6)

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Retry bench.py against the (intermittently available) TPU pool until a
+# real TPU number lands, then stop.  Probes first — the full bench (and
+# its CPU fallback) only runs when the tunnel answers.  One jax client at
+# a time: the axon tunnel is single-client and concurrent probes wedge it.
+# Usage: tools/tpu_bench_loop.sh [max_attempts] [sleep_s]
+set -u
+MAX=${1:-20}
+SLEEP=${2:-600}
+OUT=${TPU_BENCH_OUT:-/tmp/bench_tpu_attempt.json}
+for i in $(seq 1 "$MAX"); do
+  echo "[tpu-bench-loop] attempt $i/$MAX $(date -u +%H:%M:%S)"
+  plat=$(timeout 150 python -c \
+    "import jax; print('PLATFORM=' + jax.devices()[0].platform)" \
+    2>/dev/null | grep PLATFORM= | cut -d= -f2)
+  if [ "$plat" != "tpu" ]; then
+    echo "[tpu-bench-loop] pool unreachable (got '${plat:-none}'); sleeping ${SLEEP}s"
+    sleep "$SLEEP"
+    continue
+  fi
+  echo "[tpu-bench-loop] pool up — running bench"
+  line=$(PTN_BENCH_PROBE_TIMEOUT=150 PTN_BENCH_BUDGET_S=1500 \
+         timeout 1800 python bench.py 2>"$OUT.stderr" | tail -1)
+  echo "$line" > "$OUT.last"
+  if echo "$line" | grep -q '"platform": "tpu"' \
+     && ! echo "$line" | grep -q '"value": 0.0'; then
+    echo "$line" > "$OUT"
+    echo "[tpu-bench-loop] SUCCESS on attempt $i"
+    exit 0
+  fi
+  echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
+  sleep "$SLEEP"
+done
+echo "[tpu-bench-loop] exhausted $MAX attempts without a TPU number"
+exit 1
