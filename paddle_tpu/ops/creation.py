@@ -9,6 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 import jax
 
+from ..core.registry import apply_op
 from ..core.tensor import Tensor, to_tensor, _wrap_data
 from ..core.dtype import convert_dtype
 from ..core import random as _random
@@ -88,22 +89,23 @@ def eye(num_rows, num_columns=None, dtype="float32"):
 
 
 def diag(x, offset=0, padding_value=0):
-    v = x._data
-    if v.ndim == 1 and padding_value != 0:
+
+    def fn(v):
         d = jnp.diag(v, k=offset)
-        mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
-        return _wrap_data(jnp.where(mask, d, padding_value))
-    return _wrap_data(jnp.diag(v, k=offset))
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+            return jnp.where(mask, d, padding_value)
+        return d
+
+    return apply_op("diag_v2", fn, (x,), {})
 
 
 def tril(x, diagonal=0):
-    from ..core.registry import apply_op
 
     return apply_op("tril_triu", lambda v: jnp.tril(v, k=diagonal), (x,), {})
 
 
 def triu(x, diagonal=0):
-    from ..core.registry import apply_op
 
     return apply_op("tril_triu", lambda v: jnp.triu(v, k=diagonal), (x,), {})
 
@@ -114,7 +116,6 @@ def meshgrid(*args):
 
 
 def assign(x, output=None):
-    from ..core.registry import apply_op
 
     if not isinstance(x, Tensor):
         x = to_tensor(x)

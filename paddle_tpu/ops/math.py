@@ -10,7 +10,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import eager_op
+from ..core.registry import apply_op, eager_op
 from ..core.tensor import Tensor, to_tensor, _wrap_data
 from ..core.dtype import convert_dtype
 
@@ -423,7 +423,21 @@ def nonzero(x, as_tuple=False):
 
 
 def masked_select(x, mask, name=None):
-    return to_tensor(x.numpy()[mask.numpy()])
+    """Output size is data-dependent: resolve the mask host-side (eager
+    boundary op, like the reference's CPU-side shape infer) but keep the
+    gather on-tape so gradients scatter back into x."""
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask, bool)
+    xshape = tuple(int(s) for s in x._data.shape)
+    try:
+        m = np.broadcast_to(m, xshape)  # mask must broadcast to x's shape
+    except ValueError:
+        raise ValueError(
+            f"masked_select: mask shape {m.shape} is not broadcastable "
+            f"to x shape {xshape}")
+    idx = jnp.asarray(np.nonzero(m.reshape(-1))[0])
+
+    return apply_op("masked_select",
+                    lambda v: v.reshape(-1)[idx], (x,), {})
 
 
 @eager_op("topk_v2", n_outputs=2)
