@@ -109,7 +109,9 @@ def bench_bert(jax, on_tpu):
     from paddle_tpu.parallel.hybrid import CompiledTrainStep
 
     if on_tpu:
-        cfg = BertConfig(dropout=0.1)
+        # scan_layers: depth-constant HLO -> fast first compile over the
+        # remote TPU tunnel (nn/scan_stack.py)
+        cfg = BertConfig(dropout=0.1, scan_layers=True)
         batch, seq, warmup, iters = 64, 128, 3, 10
     else:
         cfg = BertConfig(num_layers=2, hidden_size=128, num_heads=2,
@@ -291,7 +293,7 @@ def bench_gpt_zero(jax, on_tpu):
         # flash attention needs attn_dropout=0 (residual/MLP dropout stays)
         cfg = GPTConfig(vocab_size=50257, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=512, dropout=0.1,
-                        attn_dropout=0.0, use_flash=True)
+                        attn_dropout=0.0, use_flash=True, scan_layers=True)
         B, L, warmup, iters = 8, 512, 3, 10
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2,

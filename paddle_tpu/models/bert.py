@@ -19,7 +19,7 @@ from ..ops.creation import arange
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden=3072, max_seq_len=512,
-                 type_vocab_size=2, dropout=0.1):
+                 type_vocab_size=2, dropout=0.1, scan_layers=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -28,6 +28,8 @@ class BertConfig:
         self.max_seq_len = max_seq_len
         self.type_vocab_size = type_vocab_size
         self.dropout = dropout
+        # scan-over-layers (nn/scan_stack.py): compile time constant in depth
+        self.scan_layers = scan_layers
 
 
 def bert_base(**kw):
@@ -70,7 +72,9 @@ class BertModel(Layer):
             config.hidden_size, config.num_heads, config.ffn_hidden,
             dropout=config.dropout, activation="gelu",
         )
-        self.encoder = TransformerEncoder(enc_layer, config.num_layers)
+        self.encoder = TransformerEncoder(
+            enc_layer, config.num_layers,
+            scan_layers=getattr(config, "scan_layers", False))
         self.pooler = Linear(config.hidden_size, config.hidden_size)
         self.pooler_act = Tanh()
 

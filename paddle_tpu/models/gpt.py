@@ -31,7 +31,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden=None, max_seq_len=1024,
                  dropout=0.1, attn_dropout=None, use_flash=False,
-                 remat=False, cp_mode="ring"):
+                 remat=False, cp_mode="ring", scan_layers=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -50,6 +50,9 @@ class GPTConfig:
         self.moe_aux_weight = 0.01
         self.use_flash = use_flash
         self.remat = remat
+        # scan-over-layers (nn/scan_stack.py): one traced block + lax.scan
+        # over stacked per-block params — compile time constant in depth
+        self.scan_layers = scan_layers
         # context parallelism ('ring' | 'ulysses'), active automatically when
         # a 'seq' mesh axis is in scope (parallel/context_parallel.py)
         self.cp_mode = cp_mode
@@ -196,11 +199,26 @@ class GPTModel(Layer):
         x = M.add(self.wte(input_ids), self.wpe(pos))
         return self.drop(x)
 
-    def forward(self, input_ids):
-        x = self.embed(input_ids)
+    def run_blocks(self, x):
+        """Apply every transformer block — the single dispatch point for
+        the sequential loop vs the scan-over-layers path."""
+        if (getattr(self.config, "scan_layers", False)
+                and not getattr(self.config, "num_experts", 0)
+                and len(self.blocks) > 1):
+            # MoE blocks are excluded: MoELayer stashes aux-loss state on
+            # the module, which a scanned body must not mutate per slice
+            from ..nn.scan_stack import scan_layer_stack
+
+            return scan_layer_stack(
+                list(self.blocks), x,
+                remat=getattr(self.config, "remat", False),
+                op_type="gpt_blocks_scan")
         for blk in self.blocks:
             x = blk(x)
-        return self.ln_f(x)
+        return x
+
+    def forward(self, input_ids):
+        return self.ln_f(self.run_blocks(self.embed(input_ids)))
 
 
 def _arange_t(n):
@@ -233,10 +251,7 @@ class GPTForPretraining(Layer):
                         transpose_y=True)
 
     def _hidden(self, input_ids):
-        x = self.gpt.embed(input_ids)
-        for blk in self.gpt.blocks:
-            x = blk(x)
-        return x
+        return self.gpt.run_blocks(self.gpt.embed(input_ids))
 
     def forward(self, input_ids):
         return self.lm_logits(self._hidden(input_ids))

@@ -154,7 +154,8 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer, num_layers, norm=None):
+    def __init__(self, encoder_layer, num_layers, norm=None,
+                 scan_layers=False):
         super().__init__()
         import copy
 
@@ -163,16 +164,26 @@ class TransformerEncoder(Layer):
         )
         self.num_layers = num_layers
         self.norm = norm
+        # scan_layers (TPU-first extension, see nn/scan_stack.py): lax.scan
+        # over stacked per-layer params — HLO size constant in depth
+        self.scan_layers = scan_layers
 
     def forward(self, src, src_mask=None, cache=None):
         output = src
         new_caches = []
-        for i, mod in enumerate(self.layers):
-            if cache is None:
-                output = mod(output, src_mask=src_mask)
-            else:
-                output, new_cache = mod(output, src_mask=src_mask, cache=cache[i])
-                new_caches.append(new_cache)
+        if self.scan_layers and cache is None and self.num_layers > 1:
+            from ..scan_stack import scan_layer_stack
+
+            output = scan_layer_stack(list(self.layers), output,
+                                      mask=src_mask,
+                                      op_type="transformer_encoder_scan")
+        else:
+            for i, mod in enumerate(self.layers):
+                if cache is None:
+                    output = mod(output, src_mask=src_mask)
+                else:
+                    output, new_cache = mod(output, src_mask=src_mask, cache=cache[i])
+                    new_caches.append(new_cache)
         if self.norm is not None:
             output = self.norm(output)
         return output if cache is None else (output, new_caches)
