@@ -11,3 +11,4 @@ from .dataset import Dataset, IterableDataset, TensorDataset, ComposeDataset, Su
 from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .file_feed import FileDataFeed  # noqa: F401
+from .sharded_ckpt import save_train_state, load_train_state  # noqa: F401

@@ -1,0 +1,106 @@
+"""Sharded checkpointing for compiled train steps via Orbax.
+
+Reference role: the fleet sharding stage's checkpoint path saves each
+rank's parameter shard (sharding_optimizer.py save/load of the sharded
+program state) so a ZeRO-sharded model never gathers to one host.
+TPU-native: `CompiledTrainStep.params` / `.flat_opt_state` (or
+`PipelinedTrainStep.other_params` / `.block_params` / `._opt_state`)
+are jax arrays laid out by the mesh sharding (ZeRO-3 keeps params
+range-sharded over 'data'); Orbax's PyTreeCheckpointer writes each
+shard from the device holding it and restores with the same sharding —
+no host gather, no resharding round-trip.  Host-side training state
+(step counter, LR-scheduler state, global rng key) rides along so a
+resumed run continues the exact trajectory.  `paddle.save`/
+`paddle.load` remain the single-host pickle path for plain state_dicts.
+"""
+import json
+import os
+
+import jax
+import numpy as np
+
+from ..core import random as _random
+
+
+def _device_tree(trainer):
+    if hasattr(trainer, "params"):  # CompiledTrainStep
+        # params: dict of per-name arrays (stages 0-2) or ONE flat
+        # range-sharded buffer array (ZeRO-3); both are pytrees as-is
+        return {"params": trainer.params,
+                "opt_state": trainer.flat_opt_state}
+    # PipelinedTrainStep (pipeline_compile.py:167,182,236)
+    return {"other_params": trainer.other_params,
+            "block_params": trainer.block_params,
+            "opt_state": trainer._opt_state}
+
+
+def _host_state(trainer):
+    key = _random.get_rng_state()
+    if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key_data, typed = np.asarray(jax.random.key_data(key)), True
+    else:  # raw uint32 key (jax default PRNGKey)
+        key_data, typed = np.asarray(key), False
+    state = {"step_count": int(trainer._step_count),
+             "rng_key": key_data.tolist(), "rng_key_typed": typed}
+    lr = getattr(trainer.optimizer, "_lr", None)
+    if hasattr(lr, "state_dict"):
+        state["lr_scheduler"] = {
+            k: (float(v) if isinstance(v, (int, float, np.floating))
+                else v)
+            for k, v in lr.state_dict().items()}
+    return state
+
+
+def save_train_state(trainer, path):
+    """Save a CompiledTrainStep/PipelinedTrainStep's device state with its
+    shardings (via Orbax) plus the host-side step/LR/rng state."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(path, _device_tree(trainer), force=True)
+    with open(os.path.join(path, "host_state.json"), "w") as f:
+        json.dump(_host_state(trainer), f)
+    return path
+
+
+def load_train_state(trainer, path):
+    """Restore in place with the trainer's CURRENT shardings: each leaf is
+    restored directly onto the devices that own its shards.  Also restores
+    the step counter, LR-scheduler state, and global rng key."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    tpl = _device_tree(trainer)
+    shardings = jax.tree_util.tree_map(
+        lambda v: getattr(v, "sharding", None), tpl)
+    restore_args = jax.tree_util.tree_map(
+        lambda v, s: ocp.ArrayRestoreArgs(sharding=s, dtype=v.dtype)
+        if hasattr(v, "dtype") and s is not None else ocp.RestoreArgs(),
+        tpl, shardings)
+    ckpt = ocp.PyTreeCheckpointer()
+    restored = ckpt.restore(path, restore_args=restore_args)
+    if hasattr(trainer, "params"):
+        trainer.params = restored["params"]
+        trainer.flat_opt_state = restored["opt_state"]
+    else:
+        trainer.other_params = restored["other_params"]
+        trainer.block_params = restored["block_params"]
+        trainer._opt_state = restored["opt_state"]
+
+    host_path = os.path.join(path, "host_state.json")
+    if os.path.exists(host_path):
+        with open(host_path) as f:
+            host = json.load(f)
+        trainer._step_count = int(host["step_count"])
+        key_data = np.asarray(host["rng_key"], np.uint32)
+        if host.get("rng_key_typed"):
+            _random.set_rng_state(jax.random.wrap_key_data(key_data))
+        else:
+            import jax.numpy as jnp
+
+            _random.set_rng_state(jnp.asarray(key_data))
+        lr = getattr(trainer.optimizer, "_lr", None)
+        if hasattr(lr, "set_state_dict") and "lr_scheduler" in host:
+            lr.set_state_dict(host["lr_scheduler"])
+    return trainer

@@ -26,7 +26,7 @@ class ErnieConfig:
     def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden=3072, max_seq_len=512,
                  type_vocab_size=2, task_type_vocab_size=3, dropout=0.1,
-                 use_task_id=False):
+                 use_task_id=False, scan_layers=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -37,6 +37,8 @@ class ErnieConfig:
         self.task_type_vocab_size = task_type_vocab_size
         self.dropout = dropout
         self.use_task_id = use_task_id
+        # scan-over-layers (nn/scan_stack.py): compile time constant in depth
+        self.scan_layers = scan_layers
 
 
 def ernie_base(**kw):
@@ -87,7 +89,9 @@ class ErnieModel(Layer):
         enc_layer = TransformerEncoderLayer(
             config.hidden_size, config.num_heads, config.ffn_hidden,
             dropout=config.dropout, activation="gelu")
-        self.encoder = TransformerEncoder(enc_layer, config.num_layers)
+        self.encoder = TransformerEncoder(
+            enc_layer, config.num_layers,
+            scan_layers=getattr(config, "scan_layers", False))
         self.pooler = Linear(config.hidden_size, config.hidden_size)
         self.pooler_act = Tanh()
 

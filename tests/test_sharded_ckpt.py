@@ -1,0 +1,99 @@
+"""Orbax sharded checkpoint of CompiledTrainStep state: save a ZeRO-3
+dp x tp run mid-training, clobber the state, restore, and the loss
+trajectory continues identically — shards restored onto their devices.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_zero3_save_restore_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPTForPretraining, GPTConfig
+    from paddle_tpu.parallel.env import build_mesh
+    from paddle_tpu.parallel.hybrid import CompiledTrainStep
+    from paddle_tpu.io.sharded_ckpt import save_train_state, load_train_state
+
+    kw = dict(vocab_size=256, hidden_size=32, num_layers=2, num_heads=2,
+              max_seq_len=32, dropout=0.0)
+    paddle.seed(11)
+    model = GPTForPretraining(GPTConfig(**kw))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    mesh = build_mesh({"data": 4, "model": 2})
+    tr = CompiledTrainStep(model, lambda m, i, l: m.loss(i, l), opt, mesh,
+                           zero_stage=3)
+    ids = paddle.to_tensor(np.random.RandomState(5).randint(
+        0, 256, (8, 16)).astype(np.int32))
+
+    for _ in range(2):
+        tr.step(ids, ids)
+    save_train_state(tr, str(tmp_path / "ckpt"))
+    want = [float(np.asarray(tr.step(ids, ids)._data)) for _ in range(2)]
+
+    # clobber: re-run two extra steps so params/opt drift, then restore
+    for _ in range(2):
+        tr.step(ids, ids)
+    load_train_state(tr, str(tmp_path / "ckpt"))
+    got = [float(np.asarray(tr.step(ids, ids)._data)) for _ in range(2)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lr_scheduler_and_rng_restored(tmp_path):
+    """Resume must continue the LR schedule (not restart warm-up) and the
+    rng stream: a decayed-LR run saved at step 2 and restored later keeps
+    the step-2 scheduler state."""
+    from paddle_tpu.models.gpt import GPTForPretraining, GPTConfig
+    from paddle_tpu.parallel.env import build_mesh
+    from paddle_tpu.parallel.hybrid import CompiledTrainStep
+    from paddle_tpu.io.sharded_ckpt import save_train_state, load_train_state
+
+    paddle.seed(23)
+    model = GPTForPretraining(GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=32, dropout=0.0))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=model.parameters())
+    tr = CompiledTrainStep(model, lambda m, i, l: m.loss(i, l), opt,
+                           build_mesh({"data": 2}))
+    ids = paddle.to_tensor(np.random.RandomState(9).randint(
+        0, 128, (4, 16)).astype(np.int32))
+    tr.step(ids, ids)
+    tr.step(ids, ids)
+    lr_at_save = opt.get_lr()
+    save_train_state(tr, str(tmp_path / "ck"))
+    tr.step(ids, ids)
+    assert opt.get_lr() < lr_at_save  # schedule advanced past the save
+    load_train_state(tr, str(tmp_path / "ck"))
+    np.testing.assert_allclose(opt.get_lr(), lr_at_save, rtol=1e-9)
+    assert tr._step_count == 2
+
+
+def test_pipeline_trainer_roundtrip(tmp_path):
+    """PipelinedTrainStep state (other/block params + grouped opt state)
+    saves and restores through the same API."""
+    from paddle_tpu.models.gpt import GPTForPretraining, gpt_tiny
+    from paddle_tpu.parallel.env import build_mesh
+    from paddle_tpu.parallel.pipeline_compile import (
+        PipelinedTrainStep, GPTPipeAdapter,
+    )
+    from paddle_tpu.io.sharded_ckpt import save_train_state, load_train_state
+
+    paddle.seed(31)
+    model = GPTForPretraining(gpt_tiny())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    tr = PipelinedTrainStep(GPTPipeAdapter(model), opt,
+                            build_mesh({"pipe": 2, "data": 2}), num_micro=2)
+    ids = paddle.to_tensor(np.random.RandomState(4).randint(
+        0, model.config.vocab_size, (4, 16)).astype(np.int32))
+    tr.step(ids, ids)
+    save_train_state(tr, str(tmp_path / "ck"))
+    want = float(np.asarray(tr.step(ids, ids)._data))
+    tr.step(ids, ids)
+    load_train_state(tr, str(tmp_path / "ck"))
+    got = float(np.asarray(tr.step(ids, ids)._data))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
