@@ -1,0 +1,11 @@
+"""paddle.callbacks namespace (python/paddle/callbacks.py): re-exports."""
+from .hapi.callbacks import Callback  # noqa: F401
+from .hapi.callbacks import ProgBarLogger  # noqa: F401
+from .hapi.callbacks import ModelCheckpoint  # noqa: F401
+from .hapi.callbacks import VisualDL  # noqa: F401
+from .hapi.callbacks import LRScheduler  # noqa: F401
+from .hapi.callbacks import EarlyStopping  # noqa: F401
+from .hapi.callbacks import ReduceLROnPlateau  # noqa: F401
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]

@@ -1,0 +1,19 @@
+"""paddle.dataset legacy namespace (python/paddle/dataset/): reader-creator
+API over the modern dataset classes.  Deprecated in the reference in favor
+of paddle.io.DataLoader (each reference function carries a @deprecated to
+the paddle.vision/text.datasets class); kept for API parity.  Zero-egress:
+the underlying datasets fall back to deterministic synthetic data when the
+real files are absent.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401

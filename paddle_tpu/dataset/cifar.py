@@ -1,0 +1,26 @@
+"""dataset.cifar: reader creators over vision.datasets.Cifar10/100.
+Samples: (flat float32[3072] in [0,1], int label)."""
+from ..vision.datasets import Cifar10, Cifar100
+
+
+def _creator(cls, mode):
+    def reader():
+        for img, lbl in cls(mode=mode):
+            yield img.reshape(-1), int(lbl[0])
+    return reader
+
+
+def train10(cycle=False):
+    return _creator(Cifar10, "train")
+
+
+def test10(cycle=False):
+    return _creator(Cifar10, "test")
+
+
+def train100():
+    return _creator(Cifar100, "train")
+
+
+def test100():
+    return _creator(Cifar100, "test")

@@ -1,0 +1,31 @@
+"""dataset.common (dataset/common.py): cache-dir + download helpers."""
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Return the cached path if the file exists; this build has no network
+    egress, so a missing file raises with the synthetic-fallback pointer."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split("/")[-1])
+    if os.path.exists(filename):
+        return filename
+    raise RuntimeError(
+        f"{filename} not present and downloads are disabled (zero egress); "
+        "use the paddle_tpu.vision/text dataset classes, which fall back "
+        "to synthetic data")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    raise NotImplementedError("cluster dataset splitting is out of scope")

@@ -1,0 +1,27 @@
+"""dataset.conll05: SRL reader creators over text.datasets.Conll05st."""
+from ..text.datasets import Conll05st
+
+_WORD_DICT_LEN = 44068
+_VERB_DICT_LEN = 3162
+_LABEL_DICT_LEN = 67
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — synthetic id-keyed vocabs
+    matching the reference dict sizes (conll05.py word/verb/label)."""
+    return ({f"w{i}": i for i in range(_WORD_DICT_LEN)},
+            {f"v{i}": i for i in range(_VERB_DICT_LEN)},
+            {f"l{i}": i for i in range(_LABEL_DICT_LEN)})
+
+
+def get_embedding():
+    raise NotImplementedError(
+        "pretrained emb download needs egress; initialize embeddings "
+        "with paddle_tpu.nn.initializer instead")
+
+
+def test():
+    def reader():
+        for sample in Conll05st():
+            yield tuple(sample)
+    return reader

@@ -1,0 +1,21 @@
+"""dataset.flowers: reader creators over vision.datasets.Flowers."""
+from ..vision.datasets import Flowers
+
+
+def _creator(mode):
+    def reader():
+        for img, lbl in Flowers(mode=mode):
+            yield img.reshape(-1), int(lbl[0])
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator("test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator("valid")

@@ -1,0 +1,22 @@
+"""dataset.imdb: reader creators over text.datasets.Imdb.
+Samples: ([word ids], 0/1 label)."""
+from ..text.datasets import Imdb
+
+
+def word_dict():
+    return Imdb(mode="train").word_idx
+
+
+def _creator(mode):
+    def reader():
+        for ids, lbl in Imdb(mode=mode):
+            yield list(ids), int(lbl)
+    return reader
+
+
+def train(word_idx=None):
+    return _creator("train")
+
+
+def test(word_idx=None):
+    return _creator("test")

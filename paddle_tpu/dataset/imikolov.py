@@ -1,0 +1,22 @@
+"""dataset.imikolov: n-gram reader creators over
+text.datasets.Imikolov."""
+from ..text.datasets import Imikolov
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(2074)}
+
+
+def _creator(mode, n):
+    def reader():
+        for sample in Imikolov(mode=mode, window_size=n):
+            yield tuple(sample)
+    return reader
+
+
+def train(word_idx=None, n=5, data_type="NGRAM"):
+    return _creator("train", n)
+
+
+def test(word_idx=None, n=5, data_type="NGRAM"):
+    return _creator("test", n)

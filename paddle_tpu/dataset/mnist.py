@@ -1,0 +1,20 @@
+"""dataset.mnist (dataset/mnist.py): reader creators over
+vision.datasets.MNIST (the reference deprecates this module to that
+class).  Samples: (flat float32[784] in [-1,1], int label)."""
+from ..vision.datasets import MNIST
+
+
+def _creator(mode):
+    def reader():
+        ds = MNIST(mode=mode)
+        for img, lbl in ds:
+            yield img.reshape(-1) * 2.0 - 1.0, int(lbl[0])
+    return reader
+
+
+def train():
+    return _creator("train")
+
+
+def test():
+    return _creator("test")

@@ -1,0 +1,18 @@
+"""dataset.movielens: reader creators over text.datasets.Movielens
+(sample = (user id, movie id, rating))."""
+from ..text.datasets import Movielens
+
+
+def _creator():
+    def reader():
+        for sample in Movielens():
+            yield tuple(sample)
+    return reader
+
+
+def train():
+    return _creator()
+
+
+def test():
+    return _creator()

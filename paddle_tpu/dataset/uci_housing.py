@@ -1,0 +1,18 @@
+"""dataset.uci_housing: reader creators over text.datasets.UCIHousing.
+Samples: (float32[13] features, float32[1] price)."""
+from ..text.datasets import UCIHousing
+
+
+def _creator(mode):
+    def reader():
+        for feat, price in UCIHousing(mode=mode):
+            yield feat, price
+    return reader
+
+
+def train():
+    return _creator("train")
+
+
+def test():
+    return _creator("test")

@@ -1,0 +1,22 @@
+"""dataset.voc2012: segmentation reader creators over
+vision.datasets.VOC2012."""
+from ..vision.datasets import VOC2012
+
+
+def _creator(mode):
+    def reader():
+        for img, lbl in VOC2012(mode=mode):
+            yield img, lbl
+    return reader
+
+
+def train():
+    return _creator("train")
+
+
+def test():
+    return _creator("test")
+
+
+def val():
+    return _creator("valid")

@@ -1,0 +1,18 @@
+"""dataset.wmt14: translation reader creators over
+text.datasets.WMT14."""
+from ..text.datasets import WMT14
+
+
+def _creator(mode):
+    def reader():
+        for sample in WMT14(mode=mode):
+            yield tuple(sample)
+    return reader
+
+
+def train(dict_size=30000):
+    return _creator("train")
+
+
+def test(dict_size=30000):
+    return _creator("test")

@@ -1,0 +1,18 @@
+"""dataset.wmt16: translation reader creators over
+text.datasets.WMT16."""
+from ..text.datasets import WMT16
+
+
+def _creator(mode):
+    def reader():
+        for sample in WMT16(mode=mode):
+            yield tuple(sample)
+    return reader
+
+
+def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return _creator("train")
+
+
+def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return _creator("test")

@@ -197,3 +197,74 @@ class JSONLLogger(Callback):
 
 
 VisualDL = JSONLLogger
+
+
+class ReduceLROnPlateau(Callback):
+    """Scale the LR by `factor` after `patience` epochs without improvement
+    of `monitor` (hapi/callbacks.py ReduceLROnPlateau parity).  Works with
+    both plain-float LRs (set_lr) and LRScheduler-driven optimizers (the
+    scheduler's base learning rate is scaled)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0,
+                 verbose=1):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def _improved(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        # eval metrics publish as 'eval_<name>' (model.py epoch-end logs),
+        # same fallback EarlyStopping uses
+        cur = logs.get(self.monitor, logs.get("eval_" + self.monitor))
+        if cur is None:
+            return
+        cur = float(np.asarray(cur).reshape(-1)[0])
+        if self._improved(cur):
+            self._best = cur
+            self._wait = 0
+            return
+        if self._cooldown_left > 0:
+            # epochs inside the cooldown window never count toward
+            # patience (Keras/reference semantics)
+            self._cooldown_left -= 1
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait < self.patience:
+            return
+        opt = self.model._optimizer
+        if opt is None:
+            return
+        from ..optimizer.lr import LRScheduler as Sched
+
+        if isinstance(opt._lr, Sched):
+            new = max(opt._lr.base_lr * self.factor, self.min_lr)
+            opt._lr.base_lr = new
+            opt._lr.last_lr = max(opt._lr.last_lr * self.factor,
+                                  self.min_lr)
+        else:
+            new = max(float(opt.get_lr()) * self.factor, self.min_lr)
+            opt.set_lr(new)
+        if self.verbose:
+            print(f"ReduceLROnPlateau: epoch {epoch}: lr -> {new:.3e}")
+        self._wait = 0
+        self._cooldown_left = self.cooldown

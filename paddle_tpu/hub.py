@@ -1,0 +1,65 @@
+"""paddle.hub (python/paddle/hapi/hub.py): load models from a repo's
+hubconf.py.  source='local' is fully supported (import hubconf.py from a
+directory and call its entrypoints); 'github'/'gitee' need network egress
+and raise with that rationale — publish the repo to a mounted path and
+load it locally instead.
+"""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(os.path.expanduser(repo_dir), MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, os.path.dirname(path))
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    deps = getattr(mod, VAR_DEPENDENCY, [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"hubconf dependencies not installed: {missing}")
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; clone the repo "
+            "to a local path and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Call an entrypoint and return its model object."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir}")
+    return getattr(mod, model)(**kwargs)

@@ -1,0 +1,6 @@
+"""paddle.linalg namespace (python/paddle/linalg.py): re-exports."""
+from .ops.linalg_extra import cholesky  # noqa: F401
+from .ops.math import norm  # noqa: F401
+from .ops.linalg_extra import inverse as inv  # noqa: F401
+
+__all__ = ["cholesky", "norm", "inv"]

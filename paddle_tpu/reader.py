@@ -1,0 +1,187 @@
+"""Legacy reader-decorator API (python/paddle/reader/decorator.py): pure
+composition utilities over "reader creators" (zero-arg callables returning
+a sample generator).  Host-side only — the TPU data path feeds batches via
+io.DataLoader / the native C++ feed; these exist for API parity with code
+written against paddle.reader.
+"""
+import itertools
+import queue
+import random as _pyrandom
+import threading
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "xmap_readers",
+]
+
+
+def cache(reader):
+    """Cache the FIRST full pass in memory; later passes replay it."""
+    all_data = []
+    filled = [False]
+
+    def creator():
+        if not filled[0]:
+            for item in reader():
+                all_data.append(item)
+                yield item
+            filled[0] = True
+        else:
+            for item in all_data:
+                yield item
+
+    return creator
+
+
+def map_readers(func, *readers):
+    """Element-wise map over zipped readers (map_readers:92)."""
+
+    def creator():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return creator
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (shuffle:134): fill buf_size, emit shuffled."""
+
+    def creator():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _pyrandom.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _pyrandom.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return creator
+
+
+def chain(*readers):
+    """Concatenate readers sequentially (chain:183)."""
+
+    def creator():
+        return itertools.chain(*[r() for r in readers])
+
+    return creator
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples (compose:248).  check_alignment
+    raises if the readers end at different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def creator():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise ValueError("readers have different lengths")
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return creator
+
+
+def buffered(reader, size):
+    """Read-ahead thread with a bounded queue (buffered:308)."""
+    _end = object()
+
+    def creator():
+        q = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _end:
+                break
+            yield item
+
+    return creator
+
+
+def firstn(reader, n):
+    """First n samples (firstn:367)."""
+
+    def creator():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return creator
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map via threads (xmap_readers:412; thread-based here — the
+    mapper typically releases the GIL in numpy/IO, and TPU feeding is not
+    CPU-bound the way the reference's decode pipelines were)."""
+    _end = object()
+
+    def creator():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(_end)
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is _end:
+                    out_q.put(_end)
+                    break
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        if order:
+            pending, want = {}, 0
+            while done < process_num:
+                got = out_q.get()
+                if got is _end:
+                    done += 1
+                    continue
+                i, item = got
+                pending[i] = item
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                got = out_q.get()
+                if got is _end:
+                    done += 1
+                    continue
+                yield got[1]
+
+    return creator

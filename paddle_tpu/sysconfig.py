@@ -1,0 +1,18 @@
+"""paddle.sysconfig (python/paddle/sysconfig.py): include/lib dirs for
+building extensions against the framework — here the native C++ runtime
+(native/src headers, libptn.so)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include():
+    """Directory of the native runtime's C/C++ headers."""
+    return os.path.join(_ROOT, "native", "include")
+
+
+def get_lib():
+    """Directory containing libptn.so (the ctypes-loaded native core)."""
+    return os.path.join(_ROOT, "native", "_build")

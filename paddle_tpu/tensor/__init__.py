@@ -1,0 +1,18 @@
+"""paddle.tensor namespace (python/paddle/tensor/): the functional Tensor
+API grouped by family.  Our op implementations live in paddle_tpu.ops;
+this package re-exports them under the reference's module layout so
+`paddle.tensor.math.add`-style imports keep working.
+"""
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.linalg_extra import *  # noqa: F401,F403
+
+from . import creation  # noqa: F401
+from . import linalg  # noqa: F401
+from . import logic  # noqa: F401
+from . import manipulation  # noqa: F401
+from . import math  # noqa: F401
+from . import random  # noqa: F401
+from . import search  # noqa: F401
+from . import stat  # noqa: F401

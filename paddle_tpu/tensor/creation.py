@@ -1,0 +1,2 @@
+"""paddle.tensor.creation: tensor creation ops (re-export)."""
+from ..ops.creation import *  # noqa: F401,F403

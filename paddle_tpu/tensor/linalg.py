@@ -1,0 +1,3 @@
+"""paddle.tensor.linalg: matmul/cholesky/norm family (re-export)."""
+from ..ops.linalg_extra import *  # noqa: F401,F403
+from ..ops.math import matmul, norm, dot, mv, bmm, addmm, kron, t  # noqa: F401

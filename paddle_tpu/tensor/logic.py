@@ -1,0 +1,7 @@
+"""paddle.tensor.logic: comparisons and boolean ops (re-export)."""
+from ..ops.math import (  # noqa: F401
+    equal, not_equal, less_than, less_equal, greater_than, greater_equal,
+    logical_and, logical_or, logical_xor, logical_not,
+    bitwise_and, bitwise_or, bitwise_xor, bitwise_not,
+    equal_all, allclose, isclose,
+)

@@ -1,0 +1,2 @@
+"""paddle.tensor.manipulation: reshape/concat/split family (re-export)."""
+from ..ops.manipulation import *  # noqa: F401,F403

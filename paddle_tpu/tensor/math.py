@@ -1,0 +1,2 @@
+"""paddle.tensor.math: elementwise/reduction math (re-export)."""
+from ..ops.math import *  # noqa: F401,F403

@@ -1,0 +1,4 @@
+"""paddle.tensor.random: rng creation ops (re-export)."""
+from ..ops.creation import (  # noqa: F401
+    uniform, rand, randn, normal, randint, randperm, bernoulli, multinomial,
+)

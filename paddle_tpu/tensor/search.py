@@ -1,0 +1,5 @@
+"""paddle.tensor.search: argmax/topk/where family (re-export)."""
+from ..ops.math import (  # noqa: F401
+    argmax, argmin, argsort, sort, topk, where, nonzero, masked_select,
+)
+from ..ops.manipulation import index_select, index_sample  # noqa: F401

@@ -1,0 +1,4 @@
+"""paddle.tensor.stat: mean/std/var family (re-export)."""
+from ..ops.math import mean  # noqa: F401
+from ..ops.linalg_extra import std, var, median  # noqa: F401
+from ..ops.math import numel_t as numel  # noqa: F401

@@ -1,0 +1,140 @@
+"""Top-level namespace parity: paddle.tensor / linalg / callbacks / hub /
+dataset / reader / sysconfig — the thin re-export and legacy modules the
+reference exposes (python/paddle/{tensor,linalg.py,callbacks.py,hub.py,
+dataset/,reader/,sysconfig.py}).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_tensor_namespace_modules():
+    import paddle_tpu.tensor as T
+
+    x = paddle.to_tensor(np.array([[4.0, 1.0]], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(T.math.add(x, x)._data), [[8.0, 2.0]])
+    vals, idx = T.search.topk(x, k=1)
+    assert float(np.asarray(vals._data)) == 4.0
+    assert T.linalg.matmul is paddle.matmul
+    assert float(np.asarray(T.stat.mean(x)._data)) == 2.5
+    assert bool(np.asarray(T.logic.equal_all(x, x)._data))
+    assert T.random.randn([2, 2]).shape == [2, 2]
+    out = T.creation.full([2], 3.0)
+    np.testing.assert_allclose(np.asarray(out._data), [3.0, 3.0])
+
+
+def test_linalg_namespace():
+    import paddle_tpu.linalg as L
+
+    a = np.array([[4.0, 0.0], [0.0, 9.0]], np.float32)
+    c = np.asarray(L.cholesky(paddle.to_tensor(a))._data)
+    np.testing.assert_allclose(c, [[2.0, 0.0], [0.0, 3.0]], atol=1e-6)
+    inv = np.asarray(L.inv(paddle.to_tensor(a))._data)
+    np.testing.assert_allclose(inv @ a, np.eye(2), atol=1e-5)
+
+
+def test_callbacks_namespace_and_reduce_lr_on_plateau():
+    import paddle_tpu.callbacks as C
+
+    assert C.ModelCheckpoint and C.EarlyStopping and C.VisualDL
+
+    # ReduceLROnPlateau drops the LR after `patience` stagnant epochs
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    model._optimizer = opt
+    cb = C.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                             verbose=0)
+    cb.model = model
+    cb.on_epoch_end(0, {"loss": 1.0})
+    cb.on_epoch_end(1, {"loss": 1.0})
+    assert abs(opt.get_lr() - 0.1) < 1e-9  # patience not yet exhausted
+    cb.on_epoch_end(2, {"loss": 1.0})
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_hub_local_repo(tmp_path):
+    import paddle_tpu.hub as hub
+
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny(n=2):\n"
+        "    'A tiny model entrypoint.'\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(n, n)\n")
+    names = hub.list(str(tmp_path))
+    assert "tiny" in names
+    assert "tiny model" in hub.help(str(tmp_path), "tiny")
+    layer = hub.load(str(tmp_path), "tiny", n=3)
+    assert layer.weight.shape == [3, 3]
+    with pytest.raises(NotImplementedError):
+        hub.load("owner/repo", "tiny", source="github")
+
+
+def test_dataset_reader_creators_and_decorators():
+    import paddle_tpu.dataset as D
+    import paddle_tpu.reader as R
+
+    img, lbl = next(D.mnist.train()())
+    assert img.shape == (784,) and -1.001 <= img.min() and 0 <= lbl < 10
+    feat, _ = next(D.uci_housing.test()())
+    assert np.asarray(feat).shape == (13,)
+
+    five = list(R.firstn(D.mnist.train(), 5)())
+    assert len(five) == 5
+    pairs = next(R.compose(D.mnist.train(), D.mnist.train())())
+    assert len(pairs) == 4  # (img, lbl) + (img, lbl)
+    mapped = next(R.map_readers(lambda s: s[1], D.mnist.train())())
+    assert mapped in range(10)
+    buffered = list(R.firstn(R.buffered(D.mnist.test(), 4), 3)())
+    assert len(buffered) == 3
+    shuffled = list(R.firstn(R.shuffle(D.mnist.train(), 16), 8)())
+    assert len(shuffled) == 8
+    cached = R.cache(R.firstn(D.mnist.train(), 4))
+    assert len(list(cached())) == len(list(cached())) == 4
+    ordered = list(R.firstn(
+        R.xmap_readers(lambda s: s[1], D.mnist.train(), 2, 8, order=True),
+        6)())
+    direct = [s[1] for s in R.firstn(D.mnist.train(), 6)()]
+    assert ordered == direct
+
+
+def test_sysconfig_paths():
+    import paddle_tpu.sysconfig as sc
+
+    assert os.path.isdir(sc.get_include())
+    assert os.path.exists(os.path.join(sc.get_lib(), "libptn.so"))
+
+
+def test_reduce_lr_on_plateau_cooldown_and_eval_prefix():
+    """Cooldown epochs freeze both reductions and the patience counter;
+    an eval_-prefixed metric is found via the same fallback EarlyStopping
+    uses."""
+    import paddle_tpu.callbacks as C
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+
+    net = nn.Linear(2, 2)
+    model = Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=net.parameters())
+    model._optimizer = opt
+    cb = C.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                             cooldown=2, verbose=0)
+    cb.model = model
+    cb.on_epoch_end(0, {"eval_loss": 1.0})  # baseline via eval_ prefix
+    cb.on_epoch_end(1, {"eval_loss": 1.0})  # stagnant -> reduce, cooldown=2
+    assert abs(opt.get_lr() - 0.5) < 1e-9
+    cb.on_epoch_end(2, {"eval_loss": 1.0})  # cooldown epoch: frozen
+    cb.on_epoch_end(3, {"eval_loss": 1.0})  # cooldown epoch: frozen
+    assert abs(opt.get_lr() - 0.5) < 1e-9
+    cb.on_epoch_end(4, {"eval_loss": 1.0})  # patience restarts cleanly
+    assert abs(opt.get_lr() - 0.25) < 1e-9
