@@ -100,3 +100,14 @@ class PyLayer(metaclass=PyLayerMeta):
     @staticmethod
     def backward(ctx, *args):
         raise NotImplementedError
+
+
+# reference layout parity: paddle.autograd.backward_mode.backward
+import sys as _sys
+import types as _types
+
+backward_mode = _types.ModuleType(__name__ + ".backward_mode")
+backward_mode.backward = backward
+backward_mode.__doc__ = ("autograd/backward_mode.py parity: module "
+                         "namespace for the reverse-mode entry point.")
+_sys.modules[backward_mode.__name__] = backward_mode

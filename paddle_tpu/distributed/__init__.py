@@ -4,13 +4,31 @@ Reference parity: python/paddle/distributed/ (SURVEY §2.2 L9 rows).
 """
 from ..parallel import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, ReduceOp, Group,
-    new_group, all_reduce, reduce, broadcast, all_gather, reduce_scatter,
-    scatter, alltoall, send, recv, isend, irecv, barrier, P2POp,
-    batch_isend_irecv, global_mesh, build_mesh, set_global_mesh,
+    new_group, get_group, wait, all_reduce, reduce, broadcast, all_gather,
+    reduce_scatter, scatter, alltoall, send, recv, isend, irecv, barrier,
+    P2POp, batch_isend_irecv, global_mesh, build_mesh, set_global_mesh,
     CommunicateTopology, HybridCommunicateGroup, ParallelMode, DataParallel,
     is_initialized,
 )
 from . import fleet  # noqa: F401
+from . import utils  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from .fleet.dataset import (  # noqa: F401
+    InMemoryDataset, QueueDataset,
+)
+from .entry_attr import (  # noqa: F401
+    ProbabilityEntry, CountFilterEntry,
+)
+
+
+class BoxPSDataset:
+    """BoxPS CTR embedding-service dataset: intentionally absent
+    (docs/ABSENT.md; same rationale as _C_ops.pull_box_sparse)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "BoxPSDataset (BoxPS CTR embedding service) is out of scope; "
+            "use InMemoryDataset/QueueDataset")
 from .spawn import spawn  # noqa: F401
 from .launch import launch  # noqa: F401
 

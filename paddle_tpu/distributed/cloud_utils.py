@@ -1,0 +1,20 @@
+"""Cluster bring-up helpers (python/paddle/distributed/cloud_utils.py):
+derive the trainer cluster from PADDLE_* environment variables — the
+launch/spawn machinery (distributed/launch.py) consumes the same env.
+"""
+import os
+
+__all__ = ["get_cluster_and_pod"]
+
+
+def _get_trainer_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return [e for e in eps.split(",") if e]
+
+
+def get_cluster_and_pod(args=None):
+    """(endpoints, current_rank): the flat cluster view the launch utils
+    use; device topology is mesh-owned (parallel/env.py), not pod-owned."""
+    endpoints = _get_trainer_endpoints()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    return endpoints, rank

@@ -1,0 +1,36 @@
+"""Sparse-table entry policies (python/paddle/distributed/entry_attr.py):
+admission rules for rows of a distributed embedding table.  Used as the
+`entry` argument of PS sparse tables (distributed/ps/table.py); the
+policies gate which feature ids get a row created.
+"""
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry"]
+
+
+class EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new feature id with the given probability."""
+
+    def __init__(self, probability):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id only after it has been seen count_filter times."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"

@@ -1,0 +1,55 @@
+"""paddle.distributed.utils (python/paddle/distributed/utils.py): the
+process-management helpers launch/spawn share.
+"""
+import os
+import signal
+import socket
+
+from .launch import TrainerProc, watch_local_trainers, launch_workers  # noqa: F401
+
+__all__ = ["get_cluster", "terminate_local_procs", "watch_local_trainers",
+           "find_free_ports", "TrainerProc"]
+
+
+def find_free_ports(num):
+    """num free localhost ports (utils.py find_free_ports parity)."""
+    socks, ports = [], []
+    try:
+        for _ in range(num):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def get_cluster(node_ips=None, node_ip=None, trainer_endpoints=None,
+                device_mode=None, devices_per_proc=None):
+    """Flat endpoints view from env/args (mesh topology is owned by
+    parallel/env.py, not a pod object)."""
+    if trainer_endpoints:
+        return list(trainer_endpoints)
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return [e for e in eps.split(",") if e]
+
+
+def terminate_local_procs(procs):
+    """Best-effort SIGTERM then kill of launch-started trainer procs."""
+    for p in procs:
+        proc = getattr(p, "proc", p)
+        try:
+            proc.terminate()
+        except Exception:
+            pass
+    for p in procs:
+        proc = getattr(p, "proc", p)
+        try:
+            proc.wait(timeout=5)
+        except Exception:
+            try:
+                proc.send_signal(signal.SIGKILL)
+            except Exception:
+                pass

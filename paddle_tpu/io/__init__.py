@@ -7,8 +7,9 @@ prefetch queue; batches are collated to numpy and transferred H2D as whole
 arrays (the BufferedReader double-buffer role is played by jax async dispatch +
 a background prefetch thread).
 """
-from .dataset import Dataset, IterableDataset, TensorDataset, ComposeDataset, Subset, random_split  # noqa: F401
+from .dataset import Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset, Subset, random_split  # noqa: F401
 from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .file_feed import FileDataFeed  # noqa: F401
 from .sharded_ckpt import save_train_state, load_train_state  # noqa: F401
+from .dataloader import get_worker_info  # noqa: F401

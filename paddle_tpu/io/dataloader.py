@@ -35,7 +35,29 @@ def default_collate_fn(batch):
     return batch
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+class WorkerInfo:
+    """get_worker_info() payload (io/dataloader worker_info parity):
+    available inside a DataLoader worker process, None elsewhere."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """The current worker's WorkerInfo inside a DataLoader worker process;
+    None in the main process."""
+    return _worker_info
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn,
+                 worker_id=0, num_workers=1):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     while True:
         item = index_queue.get()
         if item is None:
@@ -245,10 +267,11 @@ class DataLoader:
         index_queues = []
         data_queue = ctx.Queue()
         workers = []
-        for _ in range(self.num_workers):
+        for wid in range(self.num_workers):
             iq = ctx.Queue()
             w = ctx.Process(target=_worker_loop,
-                            args=(self.dataset, iq, data_queue, self.collate_fn),
+                            args=(self.dataset, iq, data_queue,
+                                  self.collate_fn, wid, self.num_workers),
                             daemon=True)
             w.start()
             workers.append(w)

@@ -151,3 +151,25 @@ def set_global_initializer(weight_init, bias_init=None):
 
     _layer._global_weight_init = weight_init
     _layer._global_bias_init = bias_init
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel initializer (initializer.py Bilinear):
+    fills a (C_out, C_in, K, K) transposed-conv weight with the bilinear
+    interpolation kernel so conv_transpose starts as exact upsampling."""
+
+    def __call__(self, shape, dtype=jnp.float32):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        C_out, C_in, kh, kw = (int(s) for s in shape)
+        if kh != kw:
+            raise ValueError("Bilinear initializer needs square kernels")
+        f = (kh + 1) // 2
+        center = f - 1 if kh % 2 == 1 else f - 0.5
+        og = jnp.arange(kh, dtype=jnp.float32)
+        filt = (1 - jnp.abs(og - center) / f)
+        kernel = filt[:, None] * filt[None, :]
+        # the reference writes the kernel into EVERY (i, j) filter slot —
+        # the canonical use is grouped conv_transpose with C_in==1
+        return jnp.broadcast_to(kernel.astype(dtype),
+                                (C_out, C_in, kh, kw))

@@ -36,7 +36,9 @@ __all__ = [
     "segment_pool", "segment_sum", "segment_mean", "segment_max",
     "segment_min", "sequence_pool", "sequence_softmax", "sequence_reverse",
     "sequence_pad", "sequence_unpad", "sequence_expand", "sequence_conv",
-    "sequence_first_step", "sequence_last_step",
+    "sequence_first_step", "sequence_last_step", "sequence_concat",
+    "sequence_enumerate", "sequence_expand_as", "sequence_reshape",
+    "sequence_scatter", "sequence_slice",
 ]
 
 
@@ -500,6 +502,7 @@ def sequence_last_step(input, length, name=None):
 
 def _seq_softmax(x, length):
     mask = _len_mask(length.astype(jnp.int32), x.shape[1], jnp.bool_)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
     neg = jnp.asarray(-1e9, x.dtype)
     out = jax.nn.softmax(jnp.where(mask, x, neg), axis=1)
     return jnp.where(mask, out, 0.0)
@@ -613,3 +616,144 @@ def sequence_conv(input, weight, length, context_length=None,
     start = context_start if context_start is not None else -(ctx - 1) // 2
     return apply_op("sequence_conv", _seq_conv, (input, weight, length),
                     {"context_start": int(start)})
+
+
+def _lens_of(length):
+    arr = length._data if isinstance(length, Tensor) else jnp.asarray(length)
+    return arr.reshape(-1).astype(jnp.int32)
+
+
+def sequence_concat(inputs, lengths, name=None):
+    """Per-row concatenation of padded sequences (sequence_concat_op.h):
+    out row b = x0[b,:l0[b]] ++ x1[b,:l1[b]] ++ ...  Returns (out, out_len)
+    with out maxlen = sum of input maxlens."""
+    lens = [_lens_of(l) for l in lengths]
+    Ts = [int(x.shape[1]) for x in inputs]
+    T_out = sum(Ts)
+    trailing = tuple(int(s) for s in inputs[0].shape[2:])
+
+    def fn(*vals):
+        B = vals[0].shape[0]
+        out = jnp.zeros((B, T_out) + trailing, vals[0].dtype)
+
+        def write_row(out_b, x_b, off_b):
+            start = (off_b,) + (0,) * (out_b.ndim - 1)
+            return jax.lax.dynamic_update_slice(out_b, x_b, start)
+
+        offsets = jnp.zeros((B,), jnp.int32)
+        # segments written in order: a later segment starts at the running
+        # valid length, overwriting the previous segment's pad region
+        for i, v in enumerate(vals):
+            out = jax.vmap(write_row)(out, v.astype(out.dtype), offsets)
+            offsets = offsets + lens[i]
+        return out
+
+    out = apply_op("sequence_concat", fn, tuple(inputs), {})
+    from ..core.tensor import _wrap_data
+    total = sum(lens[i] for i in range(len(lens)))
+    len_t = _wrap_data(jnp.asarray(total))
+    len_t.stop_gradient = True
+    return out, len_t
+
+
+def sequence_enumerate(x, length, win_size, pad_value=0, name=None):
+    """Sliding windows of ids (sequence_enumerate_op.h): out[b, t] =
+    [x[b,t], ..., x[b,t+win-1]], entries past the row's length filled with
+    pad_value."""
+    lens = _lens_of(length)
+    T = int(x.shape[1])
+
+    def fn(v):
+        cols = []
+        for k in range(win_size):
+            shifted = jnp.pad(v[:, k:], [(0, 0), (0, k)],
+                              constant_values=pad_value)
+            idx = jnp.arange(T)[None, :] + k
+            valid = idx < lens[:, None]
+            cols.append(jnp.where(valid, shifted, pad_value))
+        return jnp.stack(cols, axis=-1)
+
+    return apply_op("sequence_enumerate", fn, (x,), {})
+
+
+def sequence_expand_as(x, ref_length, maxlen=None, name=None):
+    """Broadcast each single-step row x[b] over its reference sequence
+    length (sequence_expand_as_op.h): out[b, t] = x[b] for t <
+    ref_length[b], zero-padded beyond.  maxlen fixes the padded width
+    (required when ref_length is traced — e.g. the static executor)."""
+    lens = _lens_of(ref_length)
+    T = int(maxlen) if maxlen is not None else (
+        int(jnp.max(lens)) if lens.shape[0] else 0)
+
+    def fn(v):
+        out = jnp.broadcast_to(v[:, None], (v.shape[0], T) + v.shape[1:])
+        mask = (jnp.arange(T)[None, :] < lens[:, None])
+        mask = mask.reshape(mask.shape + (1,) * (v.ndim - 1))
+        return jnp.where(mask, out, 0).astype(v.dtype)
+
+    return apply_op("sequence_expand_as", fn, (x,), {})
+
+
+def sequence_reshape(x, length, new_dim, name=None):
+    """Reinterpret each row's valid region with a new trailing width
+    (sequence_reshape_op.h).  Valid data is a row prefix, so the padded
+    reshape is exact: (B, T, D) -> (B, T*D/new_dim, new_dim); out lengths
+    scale by D/new_dim."""
+    B, T, D = (int(s) for s in x.shape)
+    if (T * D) % new_dim:
+        raise ValueError(f"T*D={T * D} not divisible by new_dim={new_dim}")
+    lens = _lens_of(length)
+    if (D % new_dim) and (new_dim % D):
+        raise ValueError("new_dim must divide or be divisible by D")
+
+    def fn(v):
+        return v.reshape(B, (T * D) // new_dim, new_dim)
+
+    out = apply_op("sequence_reshape", fn, (x,), {})
+    from ..core.tensor import _wrap_data
+    len_t = _wrap_data((lens * D) // new_dim)
+    len_t.stop_gradient = True
+    return out, len_t
+
+
+def sequence_scatter(x, index, updates, length, name=None):
+    """Scatter-add sequence updates into a dense tensor
+    (sequence_scatter_op.h): for each row b and valid position j,
+    out[index[b, j]] += updates[b, j]."""
+    lens = _lens_of(length)
+
+    def fn(xv, iv, uv):
+        T = iv.shape[1]
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+        flat_idx = jnp.where(valid, iv, 0).reshape(-1)
+        flat_upd = jnp.where(valid, uv, 0).reshape(-1)
+        return xv.at[flat_idx].add(flat_upd.astype(xv.dtype))
+
+    return apply_op("sequence_scatter", fn, (x, index, updates), {})
+
+
+def sequence_slice(x, length, offset, slice_length, name=None):
+    """Per-row subsequence (sequence_slice_op.h): out[b] =
+    x[b, offset[b] : offset[b]+slice_length[b]], padded to the input
+    maxlen.  Returns (out, out_len=slice_length)."""
+    offs = _lens_of(offset)
+    sl = _lens_of(slice_length)
+    T = int(x.shape[1])
+
+    def fn(v):
+        def row(v_b, o_b, n_b):
+            start = (o_b,) + (0,) * (v_b.ndim - 1)
+            shifted = jax.lax.dynamic_slice(
+                jnp.pad(v_b, [(0, T)] + [(0, 0)] * (v_b.ndim - 1)),
+                start, v_b.shape)
+            mask = jnp.arange(T) < n_b
+            mask = mask.reshape((T,) + (1,) * (v_b.ndim - 1))
+            return jnp.where(mask, shifted, 0).astype(v_b.dtype)
+
+        return jax.vmap(row)(v, offs, sl)
+
+    out = apply_op("sequence_slice", fn, (x,), {})
+    from ..core.tensor import _wrap_data
+    len_t = _wrap_data(jnp.asarray(sl))
+    len_t.stop_gradient = True
+    return out, len_t

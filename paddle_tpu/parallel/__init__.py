@@ -4,10 +4,10 @@ from .env import (  # noqa: F401
     set_global_mesh, build_mesh, is_initialized,
 )
 from .collective import (  # noqa: F401
-    ReduceOp, Group, new_group, all_reduce, reduce, broadcast, all_gather,
-    reduce_scatter, scatter, alltoall, send, recv, isend, irecv, barrier,
-    P2POp, batch_isend_irecv, psum, pmean, ppermute, axis_index,
-    all_to_all_in_mesh,
+    ReduceOp, Group, new_group, get_group, wait, all_reduce, reduce,
+    broadcast, all_gather, reduce_scatter, scatter, alltoall, send, recv,
+    isend, irecv, barrier, P2POp, batch_isend_irecv, psum, pmean, ppermute,
+    axis_index, all_to_all_in_mesh,
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401

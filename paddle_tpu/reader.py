@@ -15,20 +15,28 @@ __all__ = [
 ]
 
 
+class _ReaderError:
+    """Queue envelope carrying a producer/mapper exception to the consumer
+    (a plain type check — samples can be arbitrary values, including
+    tuples of ndarrays, so no tag-comparison is safe)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def cache(reader):
-    """Cache the FIRST full pass in memory; later passes replay it."""
-    all_data = []
-    filled = [False]
+    """Cache one full pass in memory; every iteration replays it.  The
+    pass is read EAGERLY on first use (the reference caches at decoration
+    time) so a partially-consumed first iterator can never corrupt the
+    cache."""
+    state = {"data": None}
 
     def creator():
-        if not filled[0]:
-            for item in reader():
-                all_data.append(item)
-                yield item
-            filled[0] = True
-        else:
-            for item in all_data:
-                yield item
+        if state["data"] is None:
+            state["data"] = tuple(reader())
+        return iter(state["data"])
 
     return creator
 
@@ -96,7 +104,9 @@ def compose(*readers, **kwargs):
 
 
 def buffered(reader, size):
-    """Read-ahead thread with a bounded queue (buffered:308)."""
+    """Read-ahead thread with a bounded queue (buffered:308).  A producer
+    exception is forwarded through the queue and re-raised in the
+    consumer instead of silently truncating the stream."""
     _end = object()
 
     def creator():
@@ -106,6 +116,8 @@ def buffered(reader, size):
             try:
                 for item in reader():
                     q.put(item)
+            except BaseException as e:  # forward to the consumer
+                q.put(_ReaderError(e))
             finally:
                 q.put(_end)
 
@@ -115,6 +127,8 @@ def buffered(reader, size):
             item = q.get()
             if item is _end:
                 break
+            if isinstance(item, _ReaderError):
+                raise item.exc
             yield item
 
     return creator
@@ -155,11 +169,23 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(_end)
                     break
                 i, item = got
-                out_q.put((i, mapper(item)))
+                try:
+                    out_q.put((i, mapper(item)))
+                except BaseException as e:
+                    # forward mapper errors; the sentinel still follows so
+                    # the consumer's done-count converges (no deadlock)
+                    out_q.put(_ReaderError(e))
+                    out_q.put(_end)
+                    break
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=work, daemon=True).start()
+
+        def check_err(got):
+            if isinstance(got, _ReaderError):
+                raise got.exc
+            return got
 
         done = 0
         if order:
@@ -169,7 +195,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if got is _end:
                     done += 1
                     continue
-                i, item = got
+                i, item = check_err(got)
                 pending[i] = item
                 while want in pending:
                     yield pending.pop(want)
@@ -182,6 +208,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if got is _end:
                     done += 1
                     continue
-                yield got[1]
+                yield check_err(got)[1]
 
     return creator

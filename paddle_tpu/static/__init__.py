@@ -32,6 +32,7 @@ nn.py_func = py_func
 nn.select_input = select_input
 nn.select_output = select_output
 nn.StaticRNN = StaticRNN
+nn.create_parameter = create_parameter
 
 
 class InputSpec:
@@ -86,3 +87,57 @@ from .desc import (  # noqa: F401,E402 (ProgramDesc serialization)
     program_to_desc, desc_to_program, save_program, load_program,
     register_op_builder,
 )
+
+
+from .compat import (  # noqa: F401,E402
+    cpu_places, cuda_places, xpu_places, scope_guard, device_guard,
+    create_global_var, save_vars, load_vars, save_persistables,
+    load_persistables, load_program_state, set_program_state,
+    serialize_program, deserialize_program, serialize_persistables,
+    deserialize_persistables, save_to_file, load_from_file,
+    normalize_program,
+)
+
+from .. import amp  # noqa: F401,E402  (paddle.static.amp alias role)
+from ..nn.layer import ParamAttr as _ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """param_attr marker requesting weight normalization (fluid/param_attr
+    WeightNormParamAttr): dim is carried for the spectral/weight-norm
+    rewrite; initialization behaves like a plain ParamAttr."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+ParallelExecutor = CompiledProgram  # pe role == compiled program on TPU
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """fluid.layers.auc: streaming ROC-AUC over score thresholds.  Emits
+    one op producing (auc_value, batch_auc); the streaming statistics the
+    reference keeps in stat vars are internal to the metric op here."""
+    import jax.numpy as jnp
+
+    from .nn_static import _eager_emit
+    from ..core.tensor import _wrap_data
+
+    def run(xv, lv):
+        scores = xv._data[:, 1] if xv._data.ndim == 2 \
+            and xv._data.shape[1] == 2 else xv._data.reshape(-1)
+        y = lv._data.reshape(-1).astype(jnp.float32)
+        thr = jnp.linspace(0.0, 1.0, num_thresholds)
+        pred_pos = scores[None, :] >= thr[:, None]
+        tp = jnp.sum(pred_pos * y[None, :], axis=1)
+        fp = jnp.sum(pred_pos * (1 - y)[None, :], axis=1)
+        pos = jnp.maximum(jnp.sum(y), 1.0)
+        neg = jnp.maximum(jnp.sum(1 - y), 1.0)
+        tpr = tp / pos
+        fpr = fp / neg
+        a = -jnp.trapezoid(tpr, fpr)
+        return _wrap_data(a), _wrap_data(a)
+
+    return _eager_emit("auc", run, [("Predict", input), ("Label", label)])

@@ -1,0 +1,224 @@
+"""paddle.static long-tail surface (python/paddle/static/__init__.py):
+scope/device guards, place lists, global vars, var/program-state IO, and
+program (de)serialization over the JSON ProgramDesc (static/desc.py).
+"""
+import contextlib
+import os
+import pickle
+
+import numpy as np
+
+from .program import default_main_program, Variable
+from .executor import Scope, global_scope
+from . import desc as _desc
+
+
+# ---- places ----
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """The accelerator places.  On this framework the accelerator is the
+    TPU: returns TPUPlace list (the reference's CUDAPlace role)."""
+    from ..core.device import TPUPlace
+
+    if device_ids is None:
+        try:
+            import jax
+
+            device_ids = range(len(jax.devices()))
+        except Exception:
+            device_ids = [0]
+    return [TPUPlace(i) for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("XPU backend is out of scope (docs/ABSENT.md); "
+                       "the accelerator here is TPU (cuda_places role)")
+
+
+# ---- guards ----
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Swap the global scope (executor.py global_scope) inside the with."""
+    import paddle_tpu.static.executor as ex
+
+    old = ex._global_scope
+    ex._global_scope = scope
+    try:
+        yield
+    finally:
+        ex._global_scope = old
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference device_guard pins ops to a device inside one program; XLA
+    compiles whole blocks for one device, so this is an accepted no-op
+    marker (kept so programs carrying it still build)."""
+    yield
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A persistable filled variable in the startup+main programs
+    (layers/tensor.py create_global_var)."""
+    from .param_helper import create_parameter
+
+    var = create_parameter(list(shape), dtype, name=name,
+                           default_value=float(value),
+                           stop_gradient=True, name_hint="global_var")
+    var.persistable = persistable
+    return var
+
+
+# ---- var / program-state IO (io.py save_vars/load_vars + *_program_state) ----
+
+def _program_param_names(program):
+    names = []
+    for block in program.blocks:
+        for var in block.vars.values():
+            if getattr(var, "persistable", False) or hasattr(var, "_init"):
+                names.append(var.name)
+    return sorted(set(names))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    scope = global_scope()
+    names = ([v.name if isinstance(v, Variable) else v for v in vars]
+             if vars else _program_param_names(main_program))
+    if predicate:
+        names = [n for n in names
+                 if predicate(main_program.global_block().var(n))]
+    state = {}
+    for n in names:
+        val = scope.find_var(n)
+        if val is not None:
+            state[n] = np.asarray(val)
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            pickle.dump(state, f, protocol=4)
+    else:
+        for n, v in state.items():
+            np.save(os.path.join(dirname, n.replace("/", "_") + ".npy"), v)
+    return sorted(state)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    scope = global_scope()
+    if filename:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            state = pickle.load(f)
+        names = ([v.name if isinstance(v, Variable) else v for v in vars]
+                 if vars else sorted(state))
+        for n in names:
+            if n in state:
+                scope.set(n, state[n])
+        return sorted(n for n in names if n in state)
+    names = ([v.name if isinstance(v, Variable) else v for v in vars]
+             if vars else _program_param_names(main_program))
+    loaded = []
+    for n in names:
+        p = os.path.join(dirname, n.replace("/", "_") + ".npy")
+        if os.path.exists(p):
+            scope.set(n, np.load(p))
+            loaded.append(n)
+    return loaded
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_program_state(model_path, var_list=None):
+    """state-dict-style program state from a save() artifact or a
+    save_vars dir (io.py load_program_state)."""
+    if os.path.isfile(model_path) or os.path.isfile(model_path + ".pdparams"):
+        path = model_path if os.path.isfile(model_path) \
+            else model_path + ".pdparams"
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    state = {}
+    if os.path.isdir(model_path):
+        for fn in os.listdir(model_path):
+            if fn.endswith(".npy"):
+                state[fn[:-4]] = np.load(os.path.join(model_path, fn))
+    return state
+
+
+def set_program_state(program, state_dict):
+    scope = global_scope()
+    applied = 0
+    for n, v in state_dict.items():
+        scope.set(n, np.asarray(v))
+        applied += 1
+    return applied
+
+
+# ---- program (de)serialization over the JSON desc ----
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    import json
+
+    program = program or default_main_program()
+    feed_names = [v.name for v in (feed_vars or [])]
+    fetch_names = [v.name for v in (fetch_vars or [])]
+    pruned = _desc.prune_forward(program, feed_names, fetch_names) \
+        if feed_names and fetch_names else program
+    return json.dumps(_desc.program_to_desc(pruned)).encode()
+
+
+def deserialize_program(data):
+    import json
+
+    return _desc.desc_to_program(json.loads(
+        data.decode() if isinstance(data, bytes) else data))
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    program = program or default_main_program()
+    scope = global_scope()
+    state = {}
+    for n in _program_param_names(program):
+        v = scope.find_var(n)
+        if v is not None:
+            state[n] = np.asarray(v)
+    return pickle.dumps(state, protocol=4)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    return set_program_state(program, state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Pruned inference program (io.py normalize_program role)."""
+    return _desc.prune_forward(program,
+                               [v.name for v in feed_vars],
+                               [v.name for v in fetch_vars])

@@ -466,3 +466,599 @@ def layer_norm_static(x, scale=True, shift=True, begin_norm_axis=1,
     return emit("layer_norm", ins, [("Y", x.shape, x.dtype)], fn,
                 attrs={"begin_norm_axis": begin_norm_axis,
                        "epsilon": epsilon, "scale": scale, "shift": shift})
+
+
+# ---------------------------------------------------------------------------
+# generic eager-bridge emitter + the wider fluid.layers surface
+# (paddle/static/nn/__init__.py export list)
+# ---------------------------------------------------------------------------
+
+def _eager_emit(op_type, eager_fn, tensor_ins, attrs=None):
+    """Emit an op whose body is an existing eager kernel; output specs are
+    inferred with jax.eval_shape over the input Variables' avals (no
+    per-op shape math).  tensor_ins: [(slot, Variable), ...]."""
+    from ..core.tensor import _wrap_data
+    from ..core import autograd
+
+    def fn(*vals):
+        with autograd.no_grad():
+            out = eager_fn(*[_wrap_data(v) for v in vals])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    avals = [
+        jax.ShapeDtypeStruct(
+            tuple(1 if int(s) < 0 else int(s) for s in v.shape),
+            convert_dtype(v.dtype))
+        for _, v in tensor_ins
+    ]
+    shapes = jax.eval_shape(fn, *avals)
+    multi = isinstance(shapes, (list, tuple))
+    if not multi:
+        shapes = [shapes]
+    # restore batch polymorphism: a leading -1 on any input that eval_shape
+    # saw as 1 stays -1 on outputs whose leading dim came out as 1
+    dyn_batch = any(int(v.shape[0]) < 0 for _, v in tensor_ins
+                    if len(v.shape))
+    outs_spec = []
+    for i, s in enumerate(shapes):
+        shape = list(s.shape)
+        if dyn_batch and shape and shape[0] == 1:
+            shape[0] = -1
+        outs_spec.append((f"Out{i}" if multi or i else "Out",
+                          shape, str(np.dtype(s.dtype))))
+    return emit(op_type, tensor_ins, outs_spec, fn, attrs=attrs or {})
+
+
+def _norm_param(C, dtype, attr, is_bias=False):
+    from .param_helper import create_parameter
+
+    if attr is False:
+        return None
+    return create_parameter([C], dtype, attr=attr, is_bias=is_bias)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    out = layer_norm_static(input, scale=scale, shift=shift,
+                            begin_norm_axis=begin_norm_axis,
+                            epsilon=epsilon, param_attr=param_attr,
+                            bias_attr=bias_attr)
+    return _maybe_act(out, act)
+
+
+def _maybe_act(out, act):
+    if act == "relu":
+        return relu(out)
+    if act == "tanh":
+        return tanh_act(out)
+    if act == "sigmoid":
+        return sigmoid_act(out)
+    if act:
+        raise ValueError(f"unsupported act {act!r}")
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..nn import functional as F
+
+    C = int(input.shape[1])
+    w = _norm_param(C, input.dtype, param_attr)
+    b = _norm_param(C, input.dtype, bias_attr, is_bias=True)
+    ins = [("X", input)] + ([("Scale", w)] if w is not None else []) \
+        + ([("Bias", b)] if b is not None else [])
+
+    def run(xv, *rest):
+        wv = rest[0] if w is not None else None
+        bv = rest[1] if w is not None and b is not None else (
+            rest[0] if w is None and b is not None else None)
+        return F.group_norm(xv, groups, epsilon, wv, bv)
+
+    return _maybe_act(_eager_emit("group_norm", run, ins,
+                                  attrs={"groups": groups}), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import functional as F
+
+    C = int(input.shape[1])
+    w = _norm_param(C, input.dtype, param_attr)
+    b = _norm_param(C, input.dtype, bias_attr, is_bias=True)
+    ins = [("X", input)] + ([("Scale", w)] if w is not None else []) \
+        + ([("Bias", b)] if b is not None else [])
+
+    def run(xv, *rest):
+        wv = rest[0] if w is not None else None
+        bv = rest[-1] if b is not None else None
+        return F.instance_norm(xv, wv, bv, epsilon)
+
+    return _eager_emit("instance_norm", run, ins)
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None, name=None,
+              **kwargs):
+    from .param_helper import create_parameter
+    from ..ops.vision_extra import data_norm as _dn
+
+    C = int(input.shape[-1])
+    bsz = create_parameter([C], input.dtype, default_value=1e4,
+                           name_hint="batch_size")
+    bsum = create_parameter([C], input.dtype, default_value=0.0,
+                            name_hint="batch_sum")
+    bsq = create_parameter([C], input.dtype, default_value=1e4,
+                           name_hint="batch_square_sum")
+    out = _eager_emit(
+        "data_norm",
+        lambda xv, a, s, q: _dn(xv, a, s, q, epsilon),
+        [("X", input), ("BatchSize", bsz), ("BatchSum", bsum),
+         ("BatchSquareSum", bsq)])
+    return _maybe_act(out, act)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from .param_helper import create_parameter
+    from ..nn import functional as F
+
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [int(x.shape[1])]
+    elif mode == "element":
+        shape = [1] + [int(s) for s in x.shape[1:]]
+    else:
+        raise ValueError(f"bad prelu mode {mode!r}")
+    alpha = create_parameter(shape, x.dtype, attr=param_attr,
+                             default_value=0.25, name_hint="prelu_alpha")
+    return _eager_emit("prelu", lambda xv, av: F.prelu(xv, av),
+                       [("X", x), ("Alpha", alpha)])
+
+
+def _conv_weight_shape(nd, transpose, C, num_filters, k, groups):
+    if transpose:
+        return [C, num_filters // groups] + list(k)
+    return [num_filters, C // groups] + list(k)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    from .param_helper import create_parameter
+    from ..nn import functional as F
+    from ..ops.nn_ops import _pair
+
+    k = _pair(filter_size, 3)
+    C = int(input.shape[1])
+    w = create_parameter([num_filters, C // groups] + list(k), input.dtype,
+                         attr=param_attr)
+    ins = [("Input", input), ("Filter", w)]
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, attr=bias_attr,
+                             is_bias=True)
+        ins.append(("Bias", b))
+
+    def run(xv, wv, *rest):
+        return F.conv3d(xv, wv, rest[0] if rest else None, stride, padding,
+                        dilation, groups)
+
+    return _maybe_act(_eager_emit("conv3d", run, ins), act)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    from .param_helper import create_parameter
+    from ..nn import functional as F
+    from ..ops.nn_ops import _pair
+
+    k = _pair(filter_size)
+    C = int(input.shape[1])
+    w = create_parameter([C, num_filters // groups] + list(k), input.dtype,
+                         attr=param_attr)
+    ins = [("Input", input), ("Filter", w)]
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, attr=bias_attr,
+                             is_bias=True)
+        ins.append(("Bias", b))
+
+    def run(xv, wv, *rest):
+        return F.conv2d_transpose(xv, wv, rest[0] if rest else None, stride,
+                                  padding, 0, dilation, groups, output_size)
+
+    return _maybe_act(_eager_emit("conv2d_transpose", run, ins), act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    from .param_helper import create_parameter
+    from ..nn import functional as F
+    from ..ops.nn_ops import _pair
+
+    k = _pair(filter_size, 3)
+    C = int(input.shape[1])
+    w = create_parameter([C, num_filters // groups] + list(k), input.dtype,
+                         attr=param_attr)
+    ins = [("Input", input), ("Filter", w)]
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input.dtype, attr=bias_attr,
+                             is_bias=True)
+        ins.append(("Bias", b))
+
+    def run(xv, wv, *rest):
+        return F.conv3d_transpose(xv, wv, rest[0] if rest else None, stride,
+                                  padding, 0, groups, dilation, "NCDHW",
+                                  output_size)
+
+    return _maybe_act(_eager_emit("conv3d_transpose", run, ins), act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    from .param_helper import create_parameter
+    from ..ops.vision_extra import deformable_conv
+    from ..ops.nn_ops import _pair
+
+    k = _pair(filter_size)
+    C = int(x.shape[1])
+    w = create_parameter([num_filters, C // groups] + list(k), x.dtype,
+                         attr=weight_attr)
+    ins = [("Input", x), ("Offset", offset), ("Filter", w)]
+    if mask is not None:
+        ins.insert(2, ("Mask", mask))
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], x.dtype, attr=bias_attr,
+                             is_bias=True)
+        ins.append(("Bias", b))
+
+    def run(xv, ov, *rest):
+        rest = list(rest)
+        mv = rest.pop(0) if mask is not None else None
+        wv = rest.pop(0)
+        bv = rest.pop(0) if b is not None else None
+        return deformable_conv(xv, ov, wv, mv, stride, padding, dilation,
+                               deformable_groups, groups, im2col_step, bv)
+
+    return _eager_emit("deformable_conv", run, ins)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    from .param_helper import create_parameter
+    from ..ops.vision_extra import bilinear_tensor_product as _btp
+
+    w = create_parameter([size, int(x.shape[1]), int(y.shape[1])], x.dtype,
+                         attr=param_attr)
+    ins = [("X", x), ("Y", y), ("Weight", w)]
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([size], x.dtype, attr=bias_attr, is_bias=True)
+        ins.append(("Bias", b))
+
+    def run(xv, yv, wv, *rest):
+        return _btp(xv, yv, wv, rest[0] if rest else None)
+
+    return _maybe_act(_eager_emit("bilinear_tensor_product", run, ins), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    from .param_helper import create_parameter
+    from ..ops.sequence_ops import row_conv as _rc
+
+    D = int(input.shape[-1])
+    w = create_parameter([future_context_size + 1, D], input.dtype,
+                         attr=param_attr)
+    return _maybe_act(
+        _eager_emit("row_conv", lambda xv, wv: _rc(xv, wv),
+                    [("X", input), ("Filter", w)]), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..ops.nn_extra import spectral_norm_apply
+
+    return _eager_emit(
+        "spectral_norm",
+        lambda wv: spectral_norm_apply(wv, power_iters, eps, dim),
+        [("Weight", weight)])
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    from .param_helper import create_parameter
+    from ..ops.sequence_ops import nce as _nce
+
+    D = int(input.shape[-1])
+    w = create_parameter([num_total_classes, D], input.dtype,
+                         attr=param_attr)
+    ins = [("Input", input), ("Label", label), ("Weight", w)]
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_total_classes], input.dtype,
+                             attr=bias_attr, is_bias=True)
+        ins.append(("Bias", b))
+
+    def run(xv, lv, wv, *rest):
+        return _nce(xv, wv, lv, rest[0] if rest else None,
+                    num_total_classes, num_neg_samples, sampler, seed)
+
+    return _eager_emit("nce", run, ins)
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """fluid.layers.crf_decoding: viterbi path under the CRF transition
+    parameter (created/owned by linear_chain_crf's param_attr)."""
+    from ..ops.sequence_ops import crf_decoding as _crf
+
+    ins = [("Emission", input), ("Transition", param_attr),
+           ("Length", length)]
+    return _eager_emit("crf_decoding",
+                       lambda ev, tv, lv: _crf(ev, tv, lv), ins)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32"):
+    """fleet sparse embedding (static): same lookup as embedding; the
+    sparse-grad path is the eager IndexedSlices machinery, and `entry`
+    admission policies apply on the PS table side."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1,
+                   name=None, **kwargs):
+    """SSD detection head (fluid/layers/detection.py multi_box_head): per
+    feature map, prior boxes + conv loc/conf predictions, concatenated."""
+    from ..vision.ops import prior_box as _prior_box
+
+    n = len(inputs)
+    if min_sizes is None:
+        min_ratio, max_ratio = int(min_ratio), int(max_ratio)
+        step = int((max_ratio - min_ratio) / max(n - 2, 1))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        mn = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = max_sizes[i] if isinstance(max_sizes[i], (list, tuple)) \
+            else [max_sizes[i]]
+        n_priors = len(mn) * (len(ar) * (2 if flip else 1) + 1) + len(mx)
+        loc = conv2d(feat, n_priors * 4, kernel_size, stride=stride,
+                     padding=pad, bias_attr=None)
+        conf = conv2d(feat, n_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad, bias_attr=None)
+        B = int(feat.shape[0])
+        locs.append(reshape(transpose_nchw_nhwc(loc), [B, -1, 4]))
+        confs.append(reshape(transpose_nchw_nhwc(conf),
+                             [B, -1, num_classes]))
+        pb = _eager_emit(
+            "prior_box",
+            lambda fv, iv, _mn=mn, _mx=mx, _ar=list(ar),
+            _st=(steps[i] if steps else 0.0): _prior_box(
+                fv, iv, min_sizes=_mn, max_sizes=_mx, aspect_ratios=_ar,
+                flip=flip, clip=clip, steps=[_st, _st], offset=offset),
+            [("Input", feat), ("Image", image)])
+        boxes_all.append(reshape(pb[0], [-1, 4]))
+        vars_all.append(reshape(pb[1], [-1, 4]))
+    mbox_locs = concat_static(locs, axis=1)
+    mbox_confs = concat_static(confs, axis=1)
+    boxes = concat_static(boxes_all, axis=0)
+    variances = concat_static(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def transpose_nchw_nhwc(x):
+    return _eager_emit(
+        "transpose2",
+        lambda v: __import__("paddle_tpu").transpose(v, [0, 2, 3, 1]),
+        [("X", x)])
+
+
+def concat_static(xs, axis=0):
+    from .. import concat as _concat
+
+    return _eager_emit("concat",
+                       lambda *vs: _concat(__import__("builtins").list(vs),
+                                           axis=axis),
+                       [(f"X{i}", v) for i, v in enumerate(xs)])
+
+
+# sequence family (padded + explicit-length boundary, ops/sequence_ops.py)
+
+
+def sequence_pool(input, length, pool_type="average"):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit("sequence_pool",
+                       lambda xv, lv: S.sequence_pool(xv, lv, pool_type),
+                       [("X", input), ("Length", length)])
+
+
+def sequence_first_step(input, length):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit("sequence_first_step", S.sequence_first_step,
+                       [("X", input), ("Length", length)])
+
+
+def sequence_last_step(input, length):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit("sequence_last_step", S.sequence_last_step,
+                       [("X", input), ("Length", length)])
+
+
+def sequence_softmax(input, length):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit("sequence_softmax", S.sequence_softmax,
+                       [("X", input), ("Length", length)])
+
+
+def sequence_reverse(x, length, name=None):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit("sequence_reverse", S.sequence_reverse,
+                       [("X", x), ("Length", length)])
+
+
+def sequence_conv(input, length, num_filters, filter_size=3,
+                  filter_stride=1, padding=True, padding_start=None,
+                  param_attr=None, bias_attr=None, act=None, name=None):
+    from .param_helper import create_parameter
+    from ..ops import sequence_ops as S
+
+    D = int(input.shape[-1])
+    w = create_parameter([filter_size * D, num_filters], input.dtype,
+                         attr=param_attr)
+
+    def run(xv, lv, wv):
+        return S.sequence_conv(xv, wv, lv, context_length=filter_size,
+                               context_start=padding_start)
+
+    return _maybe_act(_eager_emit(
+        "sequence_conv", run,
+        [("X", input), ("Length", length), ("Filter", w)]), act)
+
+
+def sequence_concat(inputs, lengths, name=None):
+    from ..ops import sequence_ops as S
+
+    n = len(inputs)
+
+    def run(*vals):
+        return S.sequence_concat(__import__("builtins").list(vals[:n]),
+                                 __import__("builtins").list(vals[n:]))
+
+    return _eager_emit(
+        "sequence_concat", run,
+        [(f"X{i}", v) for i, v in enumerate(inputs)]
+        + [(f"Len{i}", v) for i, v in enumerate(lengths)])
+
+
+def sequence_enumerate(input, length, win_size, pad_value=0, name=None):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit(
+        "sequence_enumerate",
+        lambda xv, lv: S.sequence_enumerate(xv, lv, win_size, pad_value),
+        [("X", input), ("Length", length)])
+
+
+def sequence_expand(x, ref_lengths, name=None):
+    """Output row count is data-dependent (sum of ref_lengths), which XLA
+    static shapes cannot express: ref_lengths must be host values (list /
+    ndarray), not a program Variable."""
+    from ..ops import sequence_ops as S
+
+    if isinstance(ref_lengths, Variable):
+        raise TypeError(
+            "static sequence_expand needs host lengths (list/ndarray): the "
+            "output shape is data-dependent under XLA static shapes")
+    return _eager_emit(
+        "sequence_expand", lambda xv: S.sequence_expand(xv, ref_lengths),
+        [("X", x)])
+
+
+def sequence_expand_as(x, y, ref_length, name=None):
+    """out width comes from y's (static) time dim; ref_length masks."""
+    from ..ops import sequence_ops as S
+
+    T = int(y.shape[1])
+    return _eager_emit(
+        "sequence_expand_as",
+        lambda xv, yv, lv: S.sequence_expand_as(xv, lv, maxlen=T),
+        [("X", x), ("Y", y), ("RefLen", ref_length)])
+
+
+def sequence_reshape(input, length, new_dim, name=None):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit(
+        "sequence_reshape",
+        lambda xv, lv: S.sequence_reshape(xv, lv, new_dim),
+        [("X", input), ("Length", length)])
+
+
+def sequence_scatter(input, index, updates, length, name=None):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit(
+        "sequence_scatter",
+        lambda xv, iv, uv, lv: S.sequence_scatter(xv, iv, uv, lv),
+        [("X", input), ("Ids", index), ("Updates", updates),
+         ("Length", length)])
+
+
+def sequence_slice(input, length, offset, slice_length, name=None):
+    from ..ops import sequence_ops as S
+
+    return _eager_emit(
+        "sequence_slice",
+        lambda xv, lv, ov, sv: S.sequence_slice(xv, lv, ov, sv),
+        [("X", input), ("Length", length), ("Offset", offset),
+         ("SliceLen", slice_length)])
+
+
+def sequence_pad(x, lengths, pad_value=0.0, maxlen=None, name=None):
+    """Traced pad: rows are carved out of the concatenated input with
+    dynamic slices, so lengths may be a fed Variable; maxlen must be
+    static (defaults to the total row count)."""
+    T = int(maxlen or x.shape[0])
+
+    def run(xv, lv):
+        from ..core.tensor import _wrap_data
+
+        lens = lv._data.reshape(-1).astype(jnp.int32)
+        v = xv._data
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens)[:-1]])
+        vp = jnp.pad(v, [(0, T)] + [(0, 0)] * (v.ndim - 1),
+                     constant_values=pad_value)
+
+        def row(off, n):
+            seg = jax.lax.dynamic_slice(
+                vp, (off,) + (0,) * (v.ndim - 1), (T,) + v.shape[1:])
+            mask = (jnp.arange(T) < n).reshape(
+                (T,) + (1,) * (v.ndim - 1))
+            return jnp.where(mask, seg, pad_value)
+
+        return _wrap_data(jax.vmap(row)(offsets, lens)), _wrap_data(lens)
+
+    return _eager_emit("sequence_pad", run,
+                       [("X", x), ("Length", lengths)])
+
+
+def sequence_unpad(x, length, name=None):
+    """Output row count is data-dependent: length must be host values
+    (list/ndarray), not a program Variable (same constraint as
+    sequence_expand)."""
+    from ..ops import sequence_ops as S
+
+    if isinstance(length, Variable):
+        raise TypeError(
+            "static sequence_unpad needs host lengths (list/ndarray): the "
+            "output shape is data-dependent under XLA static shapes")
+    return _eager_emit(
+        "sequence_unpad", lambda xv: S.sequence_unpad(xv, length),
+        [("X", x)])

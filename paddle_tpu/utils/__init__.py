@@ -1,2 +1,8 @@
 from . import cpp_extension  # noqa: F401
 from .cpp_extension import load, register_custom_op  # noqa: F401
+
+from .lazy_helpers import (  # noqa: F401
+    deprecated, try_import, require_version, run_check, unique_name,
+    download, Profiler, ProfilerOptions, get_profiler,
+    OpLastCheckpointChecker, image_util,
+)

@@ -1,0 +1,185 @@
+"""paddle.utils surface (python/paddle/utils/__init__.py): decorators,
+version checks, name generation, the download shim, and profiler/
+checkpoint re-exports.
+"""
+import functools
+import importlib
+import os
+import threading
+import warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """utils/deprecated.py parity: warn (level<=1) or raise (level>1) at
+    call time, and prepend a deprecation note to the docstring."""
+
+    def decorator(func):
+        note = (f"Deprecated since {since or 'unknown'}; "
+                + (f"use {update_to} instead. " if update_to else "")
+                + (reason or ""))
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level > 1:
+                raise RuntimeError(f"{func.__name__} is deprecated: {note}")
+            warnings.warn(f"{func.__name__}: {note}", DeprecationWarning,
+                          stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = f"[Deprecated] {note}\n\n{func.__doc__ or ''}"
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """utils/lazy_import.py: import or raise with an actionable message."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+            "(installs are disabled in this environment)") from e
+
+
+def require_version(min_version, max_version=None):
+    """utils/install_check-style version gate against this package."""
+    import paddle_tpu
+
+    ver = getattr(paddle_tpu, "__version__", "0.0.0")
+
+    def as_tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    if as_tuple(ver) < as_tuple(min_version):
+        raise RuntimeError(
+            f"paddle_tpu>={min_version} required, found {ver}")
+    if max_version and as_tuple(ver) > as_tuple(max_version):
+        raise RuntimeError(
+            f"paddle_tpu<={max_version} required, found {ver}")
+    return True
+
+
+def run_check():
+    """utils/install_check.py run_check: one tiny compile+execute on the
+    default device, printing the verdict."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = paddle.matmul(x, x)
+    ok = float(np.asarray(y._data).sum()) == 8.0
+    dev = paddle.get_device() if hasattr(paddle, "get_device") else "unknown"
+    print(f"paddle_tpu is installed successfully! device={dev} check="
+          f"{'ok' if ok else 'FAILED'}")
+    return ok
+
+
+class _UniqueNameGenerator:
+    """fluid/unique_name.py: thread-safe monotonically-suffixed names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def __call__(self, key="tmp"):
+        with self._lock:
+            n = self._counts.get(key, 0)
+            self._counts[key] = n + 1
+        return f"{key}_{n}"
+
+
+class _UniqueNameModule:
+    """Module-like facade: unique_name.generate / guard / switch."""
+
+    def __init__(self):
+        self._gen = _UniqueNameGenerator()
+
+    def generate(self, key="tmp"):
+        return self._gen(key)
+
+    def switch(self, new_generator=None):
+        old = self._gen
+        self._gen = new_generator or _UniqueNameGenerator()
+        return old
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            old = self.switch(new_generator)
+            try:
+                yield
+            finally:
+                self._gen = old
+
+        return _guard()
+
+
+unique_name = _UniqueNameModule()
+
+
+def download(url, module_name="paddle_tpu", md5sum=None, save_name=None):
+    """utils/download.py role: resolve from the local cache; network egress
+    is disabled, so a cache miss raises with the synthetic-data pointer."""
+    from ..dataset.common import download as _dl
+
+    return _dl(url, module_name, md5sum, save_name)
+
+
+# profiler re-exports (utils/profiler.py names over our profiler package)
+from ..profiler import Profiler, RecordEvent  # noqa: F401,E402
+
+
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = dict(options or {})
+
+    def get(self, key, default=None):
+        return self.options.get(key, default)
+
+
+def get_profiler(options=None):
+    return Profiler()
+
+
+class OpLastCheckpointChecker:
+    """utils checkpoint inspector: surfaces the newest auto-checkpoint
+    epoch recorded under the configured checkpoint root."""
+
+    def __init__(self, checkpoint_path=None):
+        self.path = checkpoint_path or os.environ.get(
+            "PADDLE_CHECKPOINT_PATH", "")
+
+    def get_latest(self):
+        if not self.path or not os.path.isdir(self.path):
+            return None
+        epochs = [d for d in os.listdir(self.path) if d.startswith("epoch_")]
+        return max(epochs, default=None)
+
+
+class _ImageUtil:
+    """utils image helpers (minimal): resize/center-crop via the vision
+    transforms functional API."""
+
+    @staticmethod
+    def resize_short(img, target_size):
+        import numpy as np
+
+        from ..vision import transforms as T
+
+        h, w = np.asarray(img).shape[:2]
+        scale = target_size / min(h, w)
+        return T.resize(img, (int(round(h * scale)),
+                              int(round(w * scale))))
+
+    @staticmethod
+    def center_crop(img, size):
+        from ..vision import transforms as T
+
+        return T.center_crop(img, size)
+
+
+image_util = _ImageUtil()

@@ -153,3 +153,343 @@ class Transpose(BaseTransform):
         if arr.ndim == 2:
             arr = arr[..., None]
         return np.transpose(arr, self.order)
+
+
+# ---- functional API (python/paddle/vision/transforms/functional.py) ----
+# numpy/host-side; images are HWC or CHW float/uint8 arrays.
+
+def _hwc(img):
+    """to HWC (returns array + was_chw flag)."""
+    arr = np.asarray(img)
+    if arr.ndim == 3 and arr.shape[0] in (1, 3, 4) \
+            and arr.shape[-1] not in (1, 3, 4):
+        return np.transpose(arr, (1, 2, 0)), True
+    return arr, False
+
+
+def _restore(arr, was_chw):
+    return np.transpose(arr, (2, 0, 1)) if was_chw else arr
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
+
+
+def hflip(img):
+    arr, chw = _hwc(img)
+    return _restore(arr[:, ::-1].copy(), chw)
+
+
+def vflip(img):
+    arr, chw = _hwc(img)
+    return _restore(arr[::-1].copy(), chw)
+
+
+def crop(img, top, left, height, width):
+    arr, chw = _hwc(img)
+    return _restore(arr[top:top + height, left:left + width].copy(), chw)
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr, chw = _hwc(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return _restore(arr[top:top + th, left:left + tw].copy(), chw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    arr, chw = _hwc(img)
+    spec = [(top, bottom), (left, right)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return _restore(np.pad(arr, spec, mode=mode, **kw), chw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by `angle` degrees counter-clockwise (inverse-map nearest /
+    bilinear sampling; functional.rotate parity)."""
+    arr, chw = _hwc(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[..., None]
+    h, w = arr.shape[:2]
+    rad = np.deg2rad(angle)
+    c, s = np.cos(rad), np.sin(rad)
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if expand:
+        nh = int(round(abs(h * c) + abs(w * s)))
+        nw = int(round(abs(w * c) + abs(h * s)))
+    else:
+        nh, nw = h, w
+    oy, ox = (nh - 1) / 2.0, (nw - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    # inverse rotation of output coords into input space; positive angle
+    # rotates counter-clockwise visually (y axis points down)
+    ys = s * (xx - ox) + c * (yy - oy) + cy
+    xs = c * (xx - ox) - s * (yy - oy) + cx
+    if interpolation == "bilinear":
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        wy, wx = ys - y0, xs - x0
+        out = np.zeros((nh, nw, arr.shape[2]), np.float32)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yi = y0 + dy
+                xi = x0 + dx
+                ok = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                wgt = ((wy if dy else 1 - wy) * (wx if dx else 1 - wx))
+                v = arr[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+                out += np.where(ok[..., None], v * wgt[..., None], 0.0)
+        oob = ~((ys >= -0.5) & (ys < h - 0.5) & (xs >= -0.5) & (xs < w - 0.5))
+    else:
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        oob = (yi < 0) | (yi >= h) | (xi < 0) | (xi >= w)
+        out = arr[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)].astype(
+            np.float32)
+    out = np.where(oob[..., None], np.float32(fill), out).astype(arr.dtype)
+    if squeeze:
+        out = out[..., 0]
+    return _restore(out, chw)
+
+
+def _rgb_weights(dtype):
+    return np.asarray([0.299, 0.587, 0.114], dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, chw = _hwc(img)
+    gray = (arr[..., :3].astype(np.float32)
+            @ _rgb_weights(np.float32)).astype(arr.dtype)
+    gray = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _restore(gray, chw)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, chw = _hwc(img)
+    hi = 255 if arr.dtype == np.uint8 else None
+    out = arr.astype(np.float32) * brightness_factor
+    out = np.clip(out, 0, hi) if hi else out
+    return _restore(out.astype(arr.dtype), chw)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, chw = _hwc(img)
+    f = arr.astype(np.float32)
+    mean = (f[..., :3] @ _rgb_weights(np.float32)).mean() if f.ndim == 3 \
+        else f.mean()
+    out = mean + contrast_factor * (f - mean)
+    hi = 255 if arr.dtype == np.uint8 else None
+    out = np.clip(out, 0, hi) if hi else out
+    return _restore(out.astype(arr.dtype), chw)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr, chw = _hwc(img)
+    f = arr.astype(np.float32)
+    gray = (f[..., :3] @ _rgb_weights(np.float32))[..., None]
+    out = gray + saturation_factor * (f - gray)
+    hi = 255 if arr.dtype == np.uint8 else None
+    out = np.clip(out, 0, hi) if hi else out
+    return _restore(out.astype(arr.dtype), chw)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via RGB->HSV->RGB."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, chw = _hwc(img)
+    scale = 255.0 if arr.dtype == np.uint8 else 1.0
+    f = arr.astype(np.float32) / scale
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx = f[..., :3].max(-1)
+    mn = f[..., :3].min(-1)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    nz = d > 1e-8
+    rmax = nz & (mx == r)
+    gmax = nz & (mx == g) & ~rmax
+    bmax = nz & ~rmax & ~gmax
+    dd = np.where(nz, d, 1.0)
+    h = np.where(rmax, ((g - b) / dd) % 6, h)
+    h = np.where(gmax, (b - r) / dd + 2, h)
+    h = np.where(bmax, (r - g) / dd + 4, h)
+    h = (h / 6.0 + hue_factor) % 1.0
+    v = mx
+    sat = np.where(mx > 1e-8, d / np.maximum(mx, 1e-8), 0.0)
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    fpart = h * 6.0 - i
+    p = v * (1 - sat)
+    q = v * (1 - fpart * sat)
+    t = v * (1 - (1 - fpart) * sat)
+    i = i.astype(int) % 6
+    choices = [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+               (v, p, q)]
+    out = np.stack([
+        np.select([i == k for k in range(6)], [ch[j] for ch in choices])
+        for j in range(3)], axis=-1)
+    if f.shape[-1] > 3:
+        out = np.concatenate([out, f[..., 3:]], axis=-1)
+    out = (out * scale).astype(arr.dtype)
+    return _restore(out, chw)
+
+
+# ---- transform classes over the functional API ----
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, factor)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly-ordered brightness/contrast/saturation/hue jitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop then resize (RandomResizedCrop parity)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr, chw = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = np.exp(random.uniform(*np.log(self.ratio)))
+            cw = int(round(np.sqrt(target * aspect)))
+            ch = int(round(np.sqrt(target / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                cropped = arr[top:top + ch, left:left + cw]
+                return Resize(self.size, self.interpolation)(
+                    _restore(cropped, chw))
+        return Resize(self.size, self.interpolation)(
+            center_crop(img, min(h, w)))

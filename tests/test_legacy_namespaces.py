@@ -138,3 +138,35 @@ def test_reduce_lr_on_plateau_cooldown_and_eval_prefix():
     assert abs(opt.get_lr() - 0.5) < 1e-9
     cb.on_epoch_end(4, {"eval_loss": 1.0})  # patience restarts cleanly
     assert abs(opt.get_lr() - 0.25) < 1e-9
+
+
+def test_vision_transforms_color_and_geometry():
+    """New transforms: functional color/geometry ops and their classes
+    (vision/transforms functional.py + transforms.py parity)."""
+    import paddle_tpu.vision.transforms as T
+
+    rng = np.random.RandomState(0)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    assert T.pad(img, 2).shape == (12, 12, 3)
+    assert T.crop(img, 1, 1, 4, 5).shape == (4, 5, 3)
+    assert T.center_crop(img, 4).shape == (4, 4, 3)
+    # 90-degree rotate about the center maps (y, x) -> (x, H-1-y)
+    sq = np.zeros((5, 5), np.float32)
+    sq[0, 1] = 1.0
+    rot = T.rotate(sq, 90)
+    assert rot[3, 0] == 1.0
+    # identity-ish color ops
+    np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+    assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                  - img.astype(int)).max() <= 1
+    g = T.to_grayscale(img, 3)
+    assert g.shape == img.shape and np.ptp(g, axis=-1).max() == 0
+    # classes compose
+    out = T.Compose([T.ColorJitter(0.1, 0.1, 0.1, 0.05),
+                     T.RandomRotation(10), T.Grayscale(),
+                     T.Pad(1), T.RandomResizedCrop(6),
+                     T.ToTensor()])(img)
+    assert out.shape == (1, 6, 6)

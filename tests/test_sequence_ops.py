@@ -245,3 +245,51 @@ def test_sequence_conv_identity_window():
     np.testing.assert_allclose(out[0], x[0], rtol=1e-6)
     np.testing.assert_allclose(out[1, :2], x[1, :2], rtol=1e-6)
     np.testing.assert_allclose(out[1, 2:], 0.0)
+
+
+def test_sequence_concat_enumerate_expand_as():
+    """New family members (sequence_{concat,enumerate,expand_as}_op.h)."""
+    x1 = paddle.to_tensor(np.array([[1, 2, 0], [3, 0, 0]], np.float32))
+    l1 = paddle.to_tensor(np.array([2, 1]))
+    x2 = paddle.to_tensor(np.array([[5, 0], [6, 7]], np.float32))
+    l2 = paddle.to_tensor(np.array([1, 2]))
+    out, ol = paddle.sequence_concat([x1, x2], [l1, l2])
+    np.testing.assert_allclose(np.asarray(out._data),
+                               [[1, 2, 5, 0, 0], [3, 6, 7, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(ol._data), [3, 3])
+
+    e = paddle.sequence_enumerate(
+        paddle.to_tensor(np.array([[1, 2, 3, 0]], np.int64)),
+        paddle.to_tensor(np.array([3])), 2)
+    np.testing.assert_array_equal(
+        np.asarray(e._data)[0], [[1, 2], [2, 3], [3, 0], [0, 0]])
+
+    ea = paddle.sequence_expand_as(
+        paddle.to_tensor(np.array([[9.0], [8.0]], np.float32)),
+        paddle.to_tensor(np.array([2, 3])))
+    np.testing.assert_allclose(np.asarray(ea._data)[..., 0],
+                               [[9, 9, 0], [8, 8, 8]])
+
+
+def test_sequence_reshape_scatter_slice():
+    r, rl = paddle.sequence_reshape(
+        paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 3, 2)),
+        paddle.to_tensor(np.array([2, 3])), 1)
+    assert list(r.shape) == [2, 6, 1]
+    np.testing.assert_array_equal(np.asarray(rl._data), [4, 6])
+
+    s = paddle.sequence_scatter(
+        paddle.to_tensor(np.zeros(6, np.float32)),
+        paddle.to_tensor(np.array([[1, 3], [2, 0]], np.int64)),
+        paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)),
+        paddle.to_tensor(np.array([2, 1])))
+    np.testing.assert_allclose(np.asarray(s._data), [0, 1, 3, 2, 0, 0])
+
+    sl, sll = paddle.sequence_slice(
+        paddle.to_tensor(np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.float32)),
+        paddle.to_tensor(np.array([4, 4])),
+        paddle.to_tensor(np.array([1, 0])),
+        paddle.to_tensor(np.array([2, 3])))
+    np.testing.assert_allclose(np.asarray(sl._data),
+                               [[2, 3, 0, 0], [5, 6, 7, 0]])
+    np.testing.assert_array_equal(np.asarray(sll._data), [2, 3])

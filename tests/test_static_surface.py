@@ -1,0 +1,157 @@
+"""Static long-tail surface: the paddle.static/{nn} exports added for
+reference parity — norm/conv/prelu emitters over the eager bridge, the
+sequence family, auc, scope/place helpers, var IO and program
+(de)serialization (python/paddle/static/__init__.py export list).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _run(main, startup, feed, fetch):
+    exe = static.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_norm_conv_prelu_emitters_match_eager():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(2, 4, 6, 6).astype(np.float32)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 4, 6, 6])
+        gn = static.nn.group_norm(x, groups=2, param_attr=False,
+                                  bias_attr=False)
+        inorm = static.nn.instance_norm(x, param_attr=False,
+                                        bias_attr=False)
+        pr = static.nn.prelu(x, mode="all")
+        loss = static.nn.mean(gn + inorm + pr)
+    out, = _run(main, startup, {"x": xv}, [loss])
+    paddle.disable_static()
+    import paddle_tpu.nn.functional as F
+
+    t = paddle.to_tensor(xv)
+    want = float(np.asarray(paddle.mean(
+        F.group_norm(t, 2) + F.instance_norm(t)
+        + F.prelu(t, paddle.to_tensor(np.full((1,), 0.25, np.float32)))
+    )._data))
+    paddle.enable_static()
+    np.testing.assert_allclose(float(out), want, rtol=1e-5)
+
+
+def test_static_sequence_family():
+    rng = np.random.RandomState(1)
+    xv = rng.rand(2, 4, 3).astype(np.float32)
+    lens = np.array([3, 2], np.int64)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 4, 3])
+        ln = static.data("len", [2], dtype="int64")
+        pool = static.nn.sequence_pool(x, ln, "sum")
+        rev = static.nn.sequence_reverse(x, ln)
+        sm = static.nn.sequence_softmax(x, ln)
+        first = static.nn.sequence_first_step(x, ln)
+    pool_v, rev_v, sm_v, first_v = _run(
+        main, startup, {"x": xv, "len": lens}, [pool, rev, sm, first])
+    # oracles
+    want_pool = np.stack([xv[0, :3].sum(0), xv[1, :2].sum(0)])
+    np.testing.assert_allclose(pool_v, want_pool, rtol=1e-5)
+    np.testing.assert_allclose(rev_v[0, :3], xv[0, :3][::-1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sm_v)[0, :3].sum(0),
+                               np.ones(3), rtol=1e-5)
+    np.testing.assert_allclose(first_v, xv[:, 0], rtol=1e-6)
+
+
+def test_static_sequence_pad_enumerate_slice():
+    main, startup = static.Program(), static.Program()
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lens = np.array([3, 2], np.int64)
+    with static.program_guard(main, startup):
+        x = static.data("x", [5, 2])
+        ln = static.data("len", [2], dtype="int64")
+        padded = static.nn.sequence_pad(x, ln, maxlen=3)
+        ids = static.data("ids", [2, 3], dtype="int64")
+        enum = static.nn.sequence_enumerate(ids, ln, 2)
+    out = _run(main, startup,
+               {"x": flat, "len": lens,
+                "ids": np.array([[1, 2, 3], [4, 5, 0]], np.int64)},
+               [padded[0], padded[1], enum])
+    pad_v, len_v, enum_v = out
+    np.testing.assert_allclose(pad_v[0], flat[:3], rtol=1e-6)
+    np.testing.assert_allclose(pad_v[1, :2], flat[3:5], rtol=1e-6)
+    np.testing.assert_array_equal(len_v, lens)
+    np.testing.assert_array_equal(enum_v[0, 0], [1, 2])
+
+
+def test_auc_op():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        pred = static.data("pred", [6, 2])
+        lbl = static.data("lbl", [6, 1], dtype="int64")
+        auc_val, batch_auc = static.auc(pred, lbl, num_thresholds=200)
+    scores = np.array([0.1, 0.2, 0.8, 0.9, 0.3, 0.7], np.float32)
+    preds = np.stack([1 - scores, scores], axis=1)
+    labels = np.array([[0], [0], [1], [1], [0], [1]], np.int64)
+    v, _ = _run(main, startup, {"pred": preds, "lbl": labels},
+                [auc_val, batch_auc])
+    np.testing.assert_allclose(float(v), 1.0, atol=0.02)  # separable
+
+
+def test_var_io_and_program_state(tmp_path):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 3])
+        y = static.nn.fc(x, 4)
+        loss = static.nn.mean(y)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 3), np.float32)
+    before, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    saved = static.save_vars(exe, str(tmp_path / "vars"), main)
+    assert saved
+    state = static.load_program_state(str(tmp_path / "vars"))
+    assert set(state) == set(saved)
+    # clobber the scope then restore
+    for n in saved:
+        static.global_scope().set(n, np.zeros_like(state[n]))
+    zero, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    assert abs(float(zero)) < 1e-6
+    static.set_program_state(main, state)
+    after, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    np.testing.assert_allclose(float(after), float(before), rtol=1e-6)
+
+
+def test_program_serialization_roundtrip():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 3])
+        y = static.nn.fc(x, 4)
+    blob = static.serialize_program([x], [y], program=main)
+    prog2 = static.deserialize_program(blob)
+    assert isinstance(blob, bytes) and prog2.global_block().ops
+    pers = static.serialize_persistables([x], [y], program=main)
+    assert static.deserialize_persistables(main, pers) >= 0
+
+
+def test_scope_and_places():
+    assert len(static.cpu_places(3)) == 3
+    sc = static.Scope()
+    sc.set("v", np.ones(2))
+    with static.scope_guard(sc):
+        assert static.global_scope() is sc
+    assert static.global_scope() is not sc
+    with static.device_guard("cpu"):
+        pass
+    g = static.create_global_var([2], 1.5, "float32")
+    assert g.shape == [2]
+    with pytest.raises(RuntimeError):
+        static.xpu_places()
