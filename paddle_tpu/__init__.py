@@ -217,3 +217,5 @@ def monkey_patch_math_varbase():  # the operators are installed at import
 
 def monkey_patch_variable():
     return None
+
+from .parallel import ParallelEnv  # noqa: E402,F401  (device.py re-export parity)

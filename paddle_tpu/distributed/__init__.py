@@ -20,15 +20,7 @@ from .entry_attr import (  # noqa: F401
     ProbabilityEntry, CountFilterEntry,
 )
 
-
-class BoxPSDataset:
-    """BoxPS CTR embedding-service dataset: intentionally absent
-    (docs/ABSENT.md; same rationale as _C_ops.pull_box_sparse)."""
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "BoxPSDataset (BoxPS CTR embedding service) is out of scope; "
-            "use InMemoryDataset/QueueDataset")
+from .fleet.dataset import BoxPSDataset  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .launch import launch  # noqa: F401
 

@@ -250,3 +250,14 @@ from .elastic import ElasticManager  # noqa: F401,E402
 from .utils import recompute  # noqa: F401,E402
 from . import data_generator  # noqa: F401,E402
 from .data_generator import DataGenerator, MultiSlotDataGenerator  # noqa: F401,E402
+
+from .dataset import (  # noqa: E402,F401
+    DatasetBase, InMemoryDataset, QueueDataset, FileInstantDataset,
+    BoxPSDataset,
+)
+from .role_maker import Role  # noqa: E402,F401
+from .data_generator import (  # noqa: E402,F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .util_base import UtilBase  # noqa: E402,F401
+from . import metrics  # noqa: E402,F401

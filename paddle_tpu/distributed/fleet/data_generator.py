@@ -81,3 +81,15 @@ class DataGenerator:
 class MultiSlotDataGenerator(DataGenerator):
     """Name parity with the reference's MultiSlot variant (the base class
     already serializes MultiSlot)."""
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String-slot variant (data_generator.py:239): slot values are
+    emitted verbatim as strings instead of parsed numerics."""
+
+    def _gen_str(self, line):
+        out = []
+        for name, values in line:
+            vals = [str(v) for v in values]
+            out.append(f"{len(vals)} " + " ".join(vals))
+        return " ".join(out) + "\n"

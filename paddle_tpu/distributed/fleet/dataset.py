@@ -104,3 +104,25 @@ class InMemoryDataset(DatasetBase):
         if self._buffer is None:
             self.load_into_memory()
         return iter(self._buffer)
+
+
+class FileInstantDataset(DatasetBase):
+    """File-at-a-time streaming dataset (dataset.py FileInstantDataset):
+    like QueueDataset but samples stream straight from the file list
+    without the in-memory stage."""
+
+    def _iter_batches(self):
+        from ...io.file_feed import FileDataFeed
+
+        feed = FileDataFeed(self._filelist, self._batch_size)
+        return iter(feed)
+
+
+class BoxPSDataset(DatasetBase):
+    """BoxPS CTR embedding-service dataset: intentionally absent
+    (docs/ABSENT.md, same rationale as _C_ops.pull_box_sparse)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "BoxPSDataset (BoxPS CTR embedding service) is out of scope; "
+            "use InMemoryDataset/QueueDataset")

@@ -1,0 +1,70 @@
+"""fleet.metrics (fleet/metrics/metric.py): distributed metric reductions
+— each worker passes its local statistic, the helpers all-reduce over the
+data axis and return the global value.
+"""
+import numpy as np
+
+
+def _allred(value, op="sum"):
+    from .. import fleet as _fleet  # noqa: F401  (init side effects)
+    from ... import distributed as dist
+    from ...core.tensor import to_tensor
+
+    t = to_tensor(np.asarray(value, np.float64))
+    mode = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+            "min": dist.ReduceOp.MIN}[op]
+    dist.all_reduce(t, op=mode)
+    return np.asarray(t.numpy())
+
+
+def sum(input, scope=None, util=None):
+    return _allred(input, "sum")
+
+
+def max(input, scope=None, util=None):
+    return _allred(input, "max")
+
+
+def min(input, scope=None, util=None):
+    return _allred(input, "min")
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """global mean-absolute-error from per-worker (sum_abs_err, count)."""
+    return float(_allred(abserr, "sum") / np.maximum(
+        _allred(total_ins_num, "sum"), 1.0))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(_allred(sqrerr, "sum") / np.maximum(
+        _allred(total_ins_num, "sum"), 1.0)))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(_allred(sqrerr, "sum") / np.maximum(
+        _allred(total_ins_num, "sum"), 1.0))
+
+
+def acc(correct, total, scope=None, util=None):
+    return float(_allred(correct, "sum") / np.maximum(
+        _allred(total, "sum"), 1.0))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-worker positive/negative histograms over score
+    buckets (fleet/metrics auc): reduce the histograms, then integrate."""
+    pos = _allred(np.asarray(stat_pos, np.float64), "sum").reshape(-1)
+    neg = _allred(np.asarray(stat_neg, np.float64), "sum").reshape(-1)
+    # walk buckets from high score to low accumulating TP/FP
+    tot_pos = pos.sum()
+    tot_neg = neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    return float(area / (tot_pos * tot_neg))

@@ -1,0 +1,53 @@
+"""fleet.util (base/util_factory.py UtilBase): small cross-worker
+utilities — collective reductions over python scalars, file ops, and
+barrier — over our collective API.
+"""
+import numpy as np
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _reduce(self, input, mode):
+        from ... import distributed as dist
+        from ...core.tensor import to_tensor
+
+        t = to_tensor(np.asarray(input, np.float64))
+        op = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+              "min": dist.ReduceOp.MIN}[mode]
+        dist.all_reduce(t, op=op)
+        return np.asarray(t.numpy())
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        return self._reduce(input, mode)
+
+    def barrier(self, comm_world="worker"):
+        from ... import distributed as dist
+
+        dist.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ... import distributed as dist
+        from ...core.tensor import to_tensor
+
+        out = []
+        dist.all_gather(out, to_tensor(np.asarray([input], np.float64)))
+        return [float(np.asarray(t.numpy()).reshape(-1)[0]) for t in out]
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over trainers (util_factory
+        get_file_shard)."""
+        from ... import distributed as dist
+
+        rank = dist.get_rank()
+        n = dist.get_world_size() or 1
+        per, rem = divmod(len(files), n)
+        start = rank * per + min(rank, rem)
+        return files[start:start + per + (1 if rank < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ... import distributed as dist
+
+        if dist.get_rank() == rank_id:
+            print(message)

@@ -10,15 +10,18 @@ through apply_op (differentiable wrt the distribution parameters, grads
 via jax.vjp); sampling draws from the global threefry stream unless a
 nonzero seed pins it, the same convention as ops/creation.py.
 """
+import math
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .core.registry import apply_op
-from .core.tensor import Tensor, to_tensor
+from .core.tensor import Tensor, to_tensor, _wrap_data
 from .core import random as _random
 
-__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag", "sampling_id"]
 
 
 def _as_tensor(v, dtype=np.float32):
@@ -183,3 +186,52 @@ class Categorical(Distribution):
 
         return apply_op("categorical_kl", fn,
                         (self.logits, other.logits), {})
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)^2) (fluid/layers/distributions.py
+    MultivariateNormalDiag): factorized multivariate normal."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)  # (..., D) diagonal stddevs
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        from .core import random as _random
+
+        key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+        base = jax.random.normal(
+            key, tuple(shape) + tuple(self.loc._data.shape))
+        return _wrap_data(self.loc._data + base * self.scale._data)
+
+    def entropy(self):
+        d = self.loc._data.shape[-1]
+        log_det = jnp.sum(jnp.log(self.scale._data ** 2), axis=-1)
+        return _wrap_data(
+            0.5 * (d * (1.0 + math.log(2 * math.pi)) + log_det))
+
+    def log_prob(self, value):
+        v = _as_tensor(value)._data
+        var = self.scale._data ** 2
+        log_det = jnp.sum(jnp.log(var), axis=-1)
+        quad = jnp.sum((v - self.loc._data) ** 2 / var, axis=-1)
+        d = self.loc._data.shape[-1]
+        return _wrap_data(
+            -0.5 * (quad + d * math.log(2 * math.pi) + log_det))
+
+    def kl_divergence(self, other):
+        var_a = self.scale._data ** 2
+        var_b = other.scale._data ** 2
+        diff = other.loc._data - self.loc._data
+        return _wrap_data(0.5 * jnp.sum(
+            var_a / var_b + diff ** 2 / var_b - 1.0
+            + jnp.log(var_b) - jnp.log(var_a), axis=-1))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0):
+    """fluid.layers.sampling_id re-export at the distribution surface."""
+    from .ops.sequence_ops import sampling_id as _impl
+
+    return _impl(x, min=min, max=max, seed=seed)

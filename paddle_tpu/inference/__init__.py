@@ -316,3 +316,44 @@ class PredictorPool:
 
     def size(self):
         return len(self._preds)
+
+
+class DataType:
+    """analysis_config data types (inference/api/paddle_api.h DataType)."""
+    FLOAT32 = "float32"
+    INT64 = "int64"
+    INT32 = "int32"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+
+
+class PrecisionType:
+    """inference precision modes (paddle_api.h Precision)."""
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+_DTYPE_BYTES = {"float32": 4, "int64": 8, "int32": 4, "uint8": 1,
+                "int8": 1, "float16": 2, "bfloat16": 2, "float64": 8}
+
+
+def get_num_bytes_of_data_type(dtype):
+    key = getattr(dtype, "lower", lambda: dtype)()
+    if key not in _DTYPE_BYTES:
+        raise ValueError(f"unknown data type {dtype!r}")
+    return _DTYPE_BYTES[key]
+
+
+def get_version():
+    import paddle_tpu
+
+    return f"paddle_tpu inference {getattr(paddle_tpu, '__version__', '0')}"
+
+
+# handle type exposed by Predictor.get_input_handle (the handles ARE the
+# inference Tensors in the reference C API)
+Tensor = InferTensor

@@ -1,2 +1,71 @@
 from .to_static import to_static, TracedLayer, not_to_static  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+
+# -- dy2static compat surface (jit/__init__.py of the reference) --
+from . import dy2static  # noqa: F401,E402
+
+declarative = to_static  # legacy alias (fluid.dygraph.jit.declarative)
+
+_CODE_LEVEL = [0]
+_VERBOSITY = [0]
+
+
+def set_code_level(level=100):
+    """dy2static debugging: log the transformed code at/under this level
+    (our transformer logs via the `ptn.dy2static` logger)."""
+    import logging
+
+    _CODE_LEVEL[0] = level
+    logging.getLogger("ptn.dy2static").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import logging
+
+    _VERBOSITY[0] = level
+    lg = logging.getLogger("ptn.dy2static")
+    lg.setLevel(logging.DEBUG if level else logging.WARNING)
+    if also_to_stdout and not lg.handlers:
+        import sys
+
+        lg.addHandler(logging.StreamHandler(sys.stdout))
+
+
+class ProgramTranslator:
+    """dygraph_to_static/program_translator.py singleton facade: global
+    enable/disable switch for to_static conversion + code inspection."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        type(self).enable_to_static = bool(enable_to_static)
+
+    def get_code(self, dygraph_func):
+        import ast
+        import inspect
+        import textwrap
+
+        from .dy2static.transformer import (
+            _ControlFlowTransformer, _has_control_flow,
+        )
+
+        source = textwrap.dedent(inspect.getsource(dygraph_func))
+        tree = ast.parse(source)
+        if not _has_control_flow(tree.body[0]):
+            return source
+        tree.body[0].decorator_list = []
+        new = _ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(new)
+        return ast.unparse(new)
+
+    def get_func(self, dygraph_func):
+        return to_static(dygraph_func)

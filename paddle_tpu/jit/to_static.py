@@ -76,6 +76,15 @@ class StaticFunction:
         return pure_fn
 
     def __call__(self, *args, **kwargs):
+        from . import ProgramTranslator
+
+        if not ProgramTranslator.enable_to_static:
+            # global switch (program_translator.py enable): run the
+            # ORIGINAL dygraph function eagerly, unconverted and unjitted
+            fn = self._original_fn
+            if self._layer is not None and not hasattr(fn, "__self__"):
+                return fn(self._layer, *args, **kwargs)
+            return fn(*args, **kwargs)
         if kwargs:
             return self._fn(*args, **kwargs)  # fall back to eager for kwargs
         tensors = [a if isinstance(a, Tensor) else Tensor(np.asarray(a))

@@ -135,3 +135,8 @@ def utils_clip_grad_norm_(parameters, max_norm):
     pg = [(p, p.grad) for p in parameters if p.grad is not None]
     for (p, _), (_, g) in zip(pg, clip(pg)):
         p.grad = g
+
+from . import utils  # noqa: E402,F401
+from .utils import spectral_norm  # noqa: E402,F401
+from .layers import loss  # noqa: E402,F401
+from .. import quant  # noqa: E402,F401  (paddle.nn.quant alias role)

@@ -1,0 +1,149 @@
+"""paddle.nn.utils (nn/utils/weight_norm_hook.py + spectral_norm_hook.py):
+weight/spectral normalization as forward-pre-hooks that recompute the
+layer's weight from its reparameterized pieces before every call.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except_dim(w, dim):
+    """L2 norm over all axes except `dim` (dim=-1: global norm)."""
+    v = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    if dim == -1:
+        return jnp.sqrt(jnp.sum(v * v)).reshape(1)
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes))
+
+
+
+
+class WeightNorm:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute_weight(self, layer):
+        from ..core.registry import apply_op
+
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        dim = self.dim
+
+        def fn(gv, vv):
+            if dim == -1:
+                n = jnp.sqrt(jnp.sum(vv * vv))
+                return vv * (gv.reshape(()) / jnp.maximum(n, 1e-12))
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            n = jnp.sqrt(jnp.sum(vv * vv, axis=axes))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv * ((gv / jnp.maximum(n, 1e-12)).reshape(shape))
+
+        return apply_op("weight_norm", fn, (g, v), {})
+
+    @staticmethod
+    def apply(layer, name, dim):
+        for hook in layer._forward_pre_hooks.values():
+            if isinstance(hook, WeightNorm) and hook.name == name:
+                raise RuntimeError(
+                    f"weight_norm already registered on {name}")
+        w = layer._parameters[name]
+        rank = len(w.shape)
+        if dim is None:
+            dim = -1
+        if not (-rank <= dim < rank):
+            raise ValueError(f"dim {dim} out of range for rank {rank}")
+        if dim != -1:
+            dim = dim % rank
+        fn = WeightNorm(name, dim)
+        del layer._parameters[name]
+        g_val = _norm_except_dim(w, dim)
+        v = layer.create_parameter(list(w._data.shape),
+                                   dtype=str(w._data.dtype))
+        layer.add_parameter(name + "_v", v)
+        g = layer.create_parameter(list(g_val.shape),
+                                   dtype=str(g_val.dtype))
+        layer.add_parameter(name + "_g", g)
+        v._data = w._data
+        g._data = g_val
+        object.__setattr__(layer, name, fn.compute_weight(layer))
+        fn._handle = layer.register_forward_pre_hook(fn)
+        return fn
+
+    def remove(self, layer):
+        w_val = self.compute_weight(layer)._data
+        del layer._parameters[self.name + "_g"]
+        del layer._parameters[self.name + "_v"]
+        if hasattr(layer, self.name + "_g"):
+            object.__delattr__(layer, self.name + "_g")
+        w = layer.create_parameter(list(w_val.shape),
+                                   dtype=str(w_val.dtype))
+        layer.add_parameter(self.name, w)
+        w._data = w_val
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute_weight(layer))
+        return inputs
+
+
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Replace layer.<name> with g * v/||v|| computed per forward
+    (weight_norm_hook.py:155).  Adds <name>_g and <name>_v parameters."""
+    WeightNorm.apply(layer, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold the current normalized weight back into one parameter and
+    remove the hook (weight_norm_hook.py:202)."""
+    for hid, hook in list(layer._forward_pre_hooks.items()):
+        if isinstance(hook, WeightNorm) and hook.name == name:
+            hook.remove(layer)
+            del layer._forward_pre_hooks[hid]
+            return layer
+    raise ValueError(f"weight_norm of '{name}' not found in {layer}")
+
+
+class SpectralNorm:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n_power_iterations = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def compute_weight(self, layer):
+        from ..ops.nn_extra import spectral_norm_apply
+
+        w = getattr(layer, self.name + "_orig")
+        return spectral_norm_apply(w, self.n_power_iterations, self.eps,
+                                   self.dim)
+
+    @staticmethod
+    def apply(layer, name, n_power_iterations, eps, dim):
+        fn = SpectralNorm(name, n_power_iterations, eps, dim)
+        w = layer._parameters[name]
+        del layer._parameters[name]
+        layer.add_parameter(name + "_orig", w)
+        object.__setattr__(layer, name, fn.compute_weight(layer))
+        layer.register_forward_pre_hook(fn)
+        return fn
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute_weight(layer))
+        return inputs
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide layer.<name> by its largest singular value, estimated by
+    power iteration per forward (spectral_norm_hook.py:131)."""
+    if dim is None:
+        dim = 0
+    SpectralNorm.apply(layer, name, n_power_iterations, eps, dim)
+    return layer

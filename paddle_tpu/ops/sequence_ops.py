@@ -641,10 +641,14 @@ def sequence_concat(inputs, lengths, name=None):
             return jax.lax.dynamic_update_slice(out_b, x_b, start)
 
         offsets = jnp.zeros((B,), jnp.int32)
-        # segments written in order: a later segment starts at the running
-        # valid length, overwriting the previous segment's pad region
+        # each segment is masked to its valid prefix before writing, so
+        # input pad contents never leak into the output's pad region
         for i, v in enumerate(vals):
-            out = jax.vmap(write_row)(out, v.astype(out.dtype), offsets)
+            T_i = v.shape[1]
+            m = (jnp.arange(T_i)[None, :] < lens[i][:, None])
+            m = m.reshape(m.shape + (1,) * (v.ndim - 2))
+            v = jnp.where(m, v, 0).astype(out.dtype)
+            out = jax.vmap(write_row)(out, v, offsets)
             offsets = offsets + lens[i]
         return out
 
@@ -705,6 +709,17 @@ def sequence_reshape(x, length, new_dim, name=None):
     lens = _lens_of(length)
     if (D % new_dim) and (new_dim % D):
         raise ValueError("new_dim must divide or be divisible by D")
+    try:  # concrete (eager) lengths: reject rows whose valid data would
+        # be truncated ((len*D) % new_dim != 0); traced lengths cannot be
+        # validated host-side and are the caller's contract
+        bad = np.asarray((lens * D) % new_dim)
+        if bad.any():
+            raise ValueError(
+                "sequence_reshape would drop data: per-row valid sizes "
+                f"{np.asarray(lens * D).tolist()} not divisible by "
+                f"new_dim={new_dim}")
+    except jax.errors.TracerArrayConversionError:
+        pass
 
     def fn(v):
         return v.reshape(B, (T * D) // new_dim, new_dim)

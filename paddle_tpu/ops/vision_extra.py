@@ -422,8 +422,12 @@ def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
     ph, pw = _pair(padding)
     dh, dw = _pair(dilation)
 
+    has_mask = mask is not None
+    has_bias = bias is not None
+
     def fn(xv, off, wv, *rest):
-        mk = rest[0] if rest else None
+        mk = rest[0] if has_mask else None
+        bv = (rest[1] if has_mask else rest[0]) if has_bias else None
         B, C, H, W = xv.shape
         M, Cg, kh, kw = wv.shape
         Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
@@ -471,16 +475,14 @@ def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
             out = jnp.einsum("gmk,bgkl->bgml", w_g, col_g).reshape(
                 B, M, Ho * Wo)
         out = out.reshape(B, M, Ho, Wo)
-        if rest[1:]:
-            out = out + rest[1].reshape(1, -1, 1, 1)
+        if bv is not None:
+            out = out + bv.reshape(1, -1, 1, 1)
         return out
 
     args = (x, offset, weight)
     if mask is not None:
         args = args + (mask,)
     if bias is not None:
-        if mask is None:
-            raise ValueError("bias without mask unsupported; pass mask")
         args = args + (bias,)
     return apply_op("deformable_conv", fn, args, {})
 

@@ -1,1 +1,3 @@
-from .datasets import Imdb, UCIHousing, WMT14  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb, UCIHousing, WMT14, WMT16, Conll05st, Imikolov, Movielens,
+)

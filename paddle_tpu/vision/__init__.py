@@ -3,3 +3,43 @@ from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from . import ops  # noqa: F401 (detection op family)
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend):
+    """vision/image.py set_image_backend: 'pil'/'cv2' in the reference —
+    here 'numpy' is the native zero-dependency backend; 'pil' is accepted
+    when Pillow is importable."""
+    global _image_backend
+    if backend not in ("numpy", "pil", "cv2"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file to an array (vision/image.py image_load)."""
+    backend = backend or _image_backend
+    if backend == "pil":
+        from PIL import Image  # noqa: F401
+
+        import numpy as _np
+
+        return _np.asarray(Image.open(path))
+    import numpy as _np
+
+    # numpy backend: npy/npz natively; defer to PIL if available for
+    # encoded formats
+    if str(path).endswith(".npy"):
+        return _np.load(path)
+    try:
+        from PIL import Image
+
+        return _np.asarray(Image.open(path))
+    except Exception as e:
+        raise RuntimeError(
+            f"cannot decode {path!r} with the numpy backend: {e}") from e

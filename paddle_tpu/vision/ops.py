@@ -412,3 +412,182 @@ def detection_map(detect_res, gt_label, gt_box, detect_splits=None,
     out = to_tensor(np.asarray(np.mean(aps) if aps else 0.0, np.float32))
     out.stop_gradient = True
     return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """paddle.vision.ops.deform_conv2d (DCNv1 when mask is None, DCNv2
+    with mask): re-export of the deformable_conv kernel."""
+    from ..ops.vision_extra import deformable_conv
+
+    return deformable_conv(x, offset, weight, mask, stride, padding,
+                           dilation, deformable_groups, groups, 1, bias)
+
+
+def _deform_conv2d_layer_cls():
+    from ..nn.layer import Layer
+
+    class _DeformConv2D(Layer):
+        """Layer form of deform_conv2d (vision/ops.py DeformConv2D); the
+        caller supplies offset (and optional mask) at forward time."""
+
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, dilation=1,
+                     deformable_groups=1, groups=1, weight_attr=None,
+                     bias_attr=None):
+            super().__init__()
+            from ..ops.nn_ops import _pair
+
+            k = _pair(kernel_size)
+            self._stride = stride
+            self._padding = padding
+            self._dilation = dilation
+            self._deformable_groups = deformable_groups
+            self._groups = groups
+            self.weight = self.create_parameter(
+                [out_channels, in_channels // groups, k[0], k[1]],
+                attr=weight_attr)
+            self.bias = (None if bias_attr is False else
+                         self.create_parameter([out_channels],
+                                               attr=bias_attr,
+                                               is_bias=True))
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(
+                x, offset, self.weight, self.bias, self._stride,
+                self._padding, self._dilation, self._deformable_groups,
+                self._groups, mask)
+
+    return _DeformConv2D
+
+
+class DeformConv2D:
+    def __new__(cls, *args, **kwargs):
+        return _deform_conv2d_layer_cls()(*args, **kwargs)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (yolov3_loss_op.cc): decode predictions on the
+    grid, match ground-truth boxes to best-IoU anchors, and sum the
+    localization + objectness + classification terms per image."""
+    import jax.numpy as jnp
+
+    from ..core.registry import apply_op
+
+    an = [(anchors[i], anchors[i + 1]) for i in range(0, len(anchors), 2)]
+    mask_an = [an[i] for i in anchor_mask]
+    A = len(mask_an)
+
+    def fn(xv, gb, gl, *gs):
+        N, C, H, W = xv.shape
+        att = 5 + class_num
+        p = xv.reshape(N, A, att, H, W)
+        tx, ty = p[:, :, 0], p[:, :, 1]
+        tw, th = p[:, :, 2], p[:, :, 3]
+        tobj = p[:, :, 4]
+        tcls = p[:, :, 5:]
+        gx = jnp.arange(W).reshape(1, 1, 1, W)
+        gy = jnp.arange(H).reshape(1, 1, H, 1)
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        # decoded centers/sizes in [0,1] image units
+        px = (jax.nn.sigmoid(tx) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gx) / W
+        py = (jax.nn.sigmoid(ty) * scale_x_y
+              - 0.5 * (scale_x_y - 1.0) + gy) / H
+        aw = jnp.asarray([a[0] for a in mask_an]).reshape(1, A, 1, 1)
+        ah = jnp.asarray([a[1] for a in mask_an]).reshape(1, A, 1, 1)
+        pw = jnp.exp(jnp.clip(tw, -10, 10)) * aw / in_w
+        ph = jnp.exp(jnp.clip(th, -10, 10)) * ah / in_h
+
+        B = gb.shape[1]
+        score = gs[0] if gs else None  # per-gt mixup weights
+        losses = jnp.zeros((N,), xv.dtype)
+        obj_target = jnp.zeros_like(tobj)
+        obj_mask = jnp.ones_like(tobj)
+        for b in range(B):
+            bx, by, bw, bh = gb[:, b, 0], gb[:, b, 1], gb[:, b, 2], gb[:, b, 3]
+            valid = (bw > 1e-6).astype(xv.dtype)  # padded gt rows
+            # best anchor for this gt by IoU of (w, h) against anchors
+            ious = []
+            for (w_a, h_a) in an:
+                wa, ha = w_a / in_w, h_a / in_h
+                inter = jnp.minimum(bw, wa) * jnp.minimum(bh, ha)
+                union = bw * bh + wa * ha - inter
+                ious.append(inter / jnp.maximum(union, 1e-10))
+            best = jnp.argmax(jnp.stack(ious), axis=0)  # (N,)
+            gi = jnp.clip((bx * W).astype(jnp.int32), 0, W - 1)
+            gj = jnp.clip((by * H).astype(jnp.int32), 0, H - 1)
+            for ai, a_global in enumerate(anchor_mask):
+                sel = (best == a_global).astype(xv.dtype) * valid  # (N,)
+                if score is not None:
+                    sel = sel * score[:, b]
+                tx_t = bx * W - gi
+                ty_t = by * H - gj
+                tw_t = jnp.log(jnp.maximum(
+                    bw * in_w / an[a_global][0], 1e-9))
+                th_t = jnp.log(jnp.maximum(
+                    bh * in_h / an[a_global][1], 1e-9))
+                nidx = jnp.arange(N)
+                scale = 2.0 - bw * bh  # small boxes weigh more
+                px_b = jax.nn.sigmoid(tx[nidx, ai, gj, gi])
+                py_b = jax.nn.sigmoid(ty[nidx, ai, gj, gi])
+                loc = (jnp.square(px_b - tx_t) + jnp.square(py_b - ty_t)
+                       + jnp.square(tw[nidx, ai, gj, gi] - tw_t)
+                       + jnp.square(th[nidx, ai, gj, gi] - th_t))
+                losses = losses + sel * scale * loc
+                cls_logit = tcls[nidx, ai, :, gj, gi]
+                onehot = jax.nn.one_hot(gl[:, b], class_num)
+                if use_label_smooth:
+                    delta = 1.0 / max(class_num, 1)
+                    onehot = onehot * (1 - delta) + delta / class_num
+                bce = jnp.sum(
+                    jnp.maximum(cls_logit, 0) - cls_logit * onehot
+                    + jnp.log1p(jnp.exp(-jnp.abs(cls_logit))), axis=-1)
+                losses = losses + sel * bce
+                obj_target = obj_target.at[nidx, ai, gj, gi].max(sel)
+                # ignore high-IoU non-best predictions
+                iou_pred = _box_iou_single(
+                    px[nidx, ai, gj, gi], py[nidx, ai, gj, gi],
+                    pw[nidx, ai, gj, gi], ph[nidx, ai, gj, gi],
+                    bx, by, bw, bh)
+                ignore = ((iou_pred > ignore_thresh) * (1 - sel) * valid)
+                obj_mask = obj_mask.at[nidx, ai, gj, gi].min(1 - ignore)
+        obj_bce = (jnp.maximum(tobj, 0) - tobj * obj_target
+                   + jnp.log1p(jnp.exp(-jnp.abs(tobj))))
+        keep = jnp.maximum(obj_mask, obj_target)
+        losses = losses + jnp.sum(obj_bce * keep, axis=(1, 2, 3))
+        return losses
+
+    args = (x, gt_box, gt_label) + ((gt_score,)
+                                    if gt_score is not None else ())
+    return apply_op("yolov3_loss", fn, args, {})
+
+
+def _box_iou_single(x1, y1, w1, h1, x2, y2, w2, h2):
+    import jax.numpy as jnp
+
+    l1, r1 = x1 - w1 / 2, x1 + w1 / 2
+    t1, b1 = y1 - h1 / 2, y1 + h1 / 2
+    l2, r2 = x2 - w2 / 2, x2 + w2 / 2
+    t2, b2 = y2 - h2 / 2, y2 + h2 / 2
+    iw = jnp.maximum(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0.0)
+    ih = jnp.maximum(jnp.minimum(b1, b2) - jnp.maximum(t1, t2), 0.0)
+    inter = iw * ih
+    return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    raise NotImplementedError(
+        "decode_jpeg is GPU-nvjpeg in the reference and intentionally "
+        "absent (docs/ABSENT.md); decode host-side via "
+        "paddle_tpu.vision.image_load")
+
+
+def read_file(filename, name=None):
+    raise NotImplementedError(
+        "read_file is intentionally absent (docs/ABSENT.md); read bytes "
+        "host-side (io.dataset reads files directly)")
