@@ -259,5 +259,5 @@ from .role_maker import Role  # noqa: E402,F401
 from .data_generator import (  # noqa: E402,F401
     MultiSlotDataGenerator, MultiSlotStringDataGenerator,
 )
-from .util_base import UtilBase  # noqa: E402,F401
+from .utils.fleet_util import UtilBase  # noqa: E402,F401
 from . import metrics  # noqa: E402,F401

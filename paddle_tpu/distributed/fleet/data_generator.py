@@ -84,12 +84,6 @@ class MultiSlotDataGenerator(DataGenerator):
 
 
 class MultiSlotStringDataGenerator(DataGenerator):
-    """String-slot variant (data_generator.py:239): slot values are
-    emitted verbatim as strings instead of parsed numerics."""
-
-    def _gen_str(self, line):
-        out = []
-        for name, values in line:
-            vals = [str(v) for v in values]
-            out.append(f"{len(vals)} " + " ".join(vals))
-        return " ".join(out) + "\n"
+    """String-slot variant (data_generator.py:239).  The base serializer
+    already emits `len v1..vn` with str(v) per slot — verbatim for string
+    values — so only the name differs."""

@@ -109,13 +109,8 @@ class InMemoryDataset(DatasetBase):
 class FileInstantDataset(DatasetBase):
     """File-at-a-time streaming dataset (dataset.py FileInstantDataset):
     like QueueDataset but samples stream straight from the file list
-    without the in-memory stage."""
-
-    def _iter_batches(self):
-        from ...io.file_feed import FileDataFeed
-
-        feed = FileDataFeed(self._filelist, self._batch_size)
-        return iter(feed)
+    without the in-memory stage — the base streaming path already does
+    exactly that with the configured fmt/threads/label column."""
 
 
 class BoxPSDataset(DatasetBase):

@@ -142,8 +142,11 @@ class SpectralNorm:
 def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                   dim=None):
     """Divide layer.<name> by its largest singular value, estimated by
-    power iteration per forward (spectral_norm_hook.py:131)."""
+    power iteration per forward (spectral_norm_hook.py:131).  dim=None
+    resolves to 1 for Linear / transposed convs (whose out axis is dim 1,
+    the reference's rule) and 0 otherwise."""
     if dim is None:
-        dim = 0
+        cls = type(layer).__name__
+        dim = 1 if ("Linear" in cls or "Transpose" in cls) else 0
     SpectralNorm.apply(layer, name, n_power_iterations, eps, dim)
     return layer

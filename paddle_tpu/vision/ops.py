@@ -462,9 +462,28 @@ def _deform_conv2d_layer_cls():
     return _DeformConv2D
 
 
-class DeformConv2D:
-    def __new__(cls, *args, **kwargs):
-        return _deform_conv2d_layer_cls()(*args, **kwargs)
+_DEFORM_CLS = None
+
+
+def _get_deform_cls():
+    global _DEFORM_CLS
+    if _DEFORM_CLS is None:
+        _DEFORM_CLS = _deform_conv2d_layer_cls()
+        _DEFORM_CLS.__name__ = "DeformConv2D"
+    return _DEFORM_CLS
+
+
+class _DeformMeta(type):
+    def __call__(cls, *args, **kwargs):
+        return _get_deform_cls()(*args, **kwargs)
+
+    def __instancecheck__(cls, obj):
+        return isinstance(obj, _get_deform_cls())
+
+
+class DeformConv2D(metaclass=_DeformMeta):
+    """Stable public type: instances share ONE lazily-built Layer
+    subclass, so type(a) is type(b) and isinstance checks work."""
 
 
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,

@@ -79,7 +79,10 @@ class Resize(BaseTransform):
 
     def _apply_image(self, img):
         arr = np.asarray(img, np.float32)
-        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        # CHW only when the LAST dim cannot be a channel count (otherwise
+        # a short HWC image, e.g. a (4, W, 1) random crop, is misread)
+        chw = (arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+               and arr.shape[-1] not in (1, 3, 4))
         if chw:
             arr = np.transpose(arr, (1, 2, 0))
         h, w = self.size

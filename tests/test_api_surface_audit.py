@@ -21,16 +21,33 @@ _INTERNAL = {
     "check_variable_and_dtype", "Variable", "Normal", "arange",
     "elementwise_mul", "sampling_id", "dygraph_only", "deprecated",
     "Tensor", "paddle", "np", "functools", "collections", "warnings",
-    "six", "utils", "layers_utils",
+    "six", "utils", "layers_utils", "check_dtype", "check_type", "layers",
+    "concat", "elementwise_add", "elementwise_div", "elementwise_sub",
+    "gather_nd", "multinomial", "models_LeNet",
 }
 
 
 def _ref_exports(path):
+    """Every name a module's top-level `from X import ...` pulls in plus
+    its __all__ entries — handling comma lists, parenthesized multi-line
+    imports, `as` renames, and either quote style."""
     src = open(path).read()
-    names = set(re.findall(r"^from [\w.]+ import (\w+)", src, re.M))
+    names = set()
+    # single-line and parenthesized import lists
+    for m in re.finditer(
+            r"^from [\w.]+ import \(([^)]*)\)|^from [\w.]+ import ([^(\n]+)",
+            src, re.M):
+        body = m.group(1) or m.group(2) or ""
+        body = re.sub(r"#.*", "", body)
+        for item in body.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            # `x as y` exports y
+            names.add(item.split(" as ")[-1].strip())
     for block in re.findall(r"__all__ \+?= \[(.*?)\]", src, re.S):
-        names |= set(re.findall(r"'(\w+)'", block))
-    return {n for n in names if not n.startswith("_")}
+        names |= set(re.findall(r"['\"](\w+)['\"]", block))
+    return {n for n in names if n.isidentifier() and not n.startswith("_")}
 
 
 def _modules():
