@@ -4,31 +4,67 @@ import numpy as np
 
 
 class UtilBase:
+    """base/util_factory.py UtilBase: cross-worker scalar reductions,
+    barrier, and file sharding.  When a collective env is live (mesh
+    initialized) the reductions ride real XLA collectives; in PS mode
+    (role_maker only, no mesh) they fall back to the role-math
+    simulation the PS tests rely on."""
+
     def __init__(self, role_maker=None):
         self.role_maker = role_maker
 
+    def _collective_live(self):
+        try:
+            from .... import distributed as dist
+
+            return dist.is_initialized()
+        except Exception:
+            return False
+
     def all_reduce(self, input, mode="sum", comm_world="worker"):
         arr = np.asarray(input)
-        # single-process worker world: identity (N ranks with same value would
-        # multiply by world size for sum)
+        if self._collective_live():
+            from .... import distributed as dist
+            from ....core.tensor import to_tensor
+
+            t = to_tensor(np.asarray(arr, np.float64))
+            op = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+                  "min": dist.ReduceOp.MIN}[mode]
+            dist.all_reduce(t, op=op)
+            return np.asarray(t.numpy())
         n = self.role_maker.worker_num() if self.role_maker else 1
         if mode == "sum":
             return arr * n if n > 1 else arr
         return arr
 
     def barrier(self, comm_world="worker"):
-        pass
+        if self._collective_live():
+            from .... import distributed as dist
+
+            dist.barrier()
 
     def all_gather(self, input, comm_world="worker"):
+        if self._collective_live():
+            from .... import distributed as dist
+            from ....core.tensor import to_tensor
+
+            out = []
+            dist.all_gather(out, to_tensor(np.asarray([input], np.float64)))
+            return [float(np.asarray(t.numpy()).reshape(-1)[0])
+                    for t in out]
         n = self.role_maker.worker_num() if self.role_maker else 1
         return [input] * n
 
     def get_file_shard(self, files):
+        """Contiguous blocks with the remainder spread over the first
+        ranks (util_factory get_file_shard)."""
         if self.role_maker is None:
-            return files
+            return list(files)
         n = self.role_maker.worker_num()
         i = self.role_maker.worker_index()
-        return files[i::n]
+        per, rem = divmod(len(files), n)
+        start = i * per + min(i, rem)
+        return list(files[start:start + per + (1 if i < rem else 0)])
 
     def print_on_rank(self, message, rank_id=0):
         if self.role_maker is None or self.role_maker.worker_index() == rank_id:

@@ -146,7 +146,15 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     resolves to 1 for Linear / transposed convs (whose out axis is dim 1,
     the reference's rule) and 0 otherwise."""
     if dim is None:
-        cls = type(layer).__name__
-        dim = 1 if ("Linear" in cls or "Transpose" in cls) else 0
+        from .layers.common import Linear
+        try:
+            from .layers.conv import (
+                Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+            )
+
+            transposed = (Conv1DTranspose, Conv2DTranspose, Conv3DTranspose)
+        except ImportError:
+            transposed = ()
+        dim = 1 if isinstance(layer, (Linear,) + transposed) else 0
     SpectralNorm.apply(layer, name, n_power_iterations, eps, dim)
     return layer

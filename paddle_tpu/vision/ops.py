@@ -475,10 +475,15 @@ def _get_deform_cls():
 
 class _DeformMeta(type):
     def __call__(cls, *args, **kwargs):
-        return _get_deform_cls()(*args, **kwargs)
+        if cls is DeformConv2D:
+            return _get_deform_cls()(*args, **kwargs)
+        return super().__call__(*args, **kwargs)  # subclasses construct
+        # themselves normally
 
     def __instancecheck__(cls, obj):
-        return isinstance(obj, _get_deform_cls())
+        if cls is DeformConv2D:
+            return isinstance(obj, _get_deform_cls())
+        return type.__instancecheck__(cls, obj)
 
 
 class DeformConv2D(metaclass=_DeformMeta):

@@ -98,18 +98,23 @@ def bench_one(cfg):
     eager_us = (time.perf_counter() - t0) / repeat * 1e6
 
     jit_us = None
+    jit_error = None
     try:
         jax.block_until_ready(jitted(*arrs))  # compile
         t0 = time.perf_counter()
         for _ in range(repeat):
             jax.block_until_ready(jitted(*arrs))
         jit_us = (time.perf_counter() - t0) / repeat * 1e6
-    except Exception:
-        pass  # host-side/untraceable op: eager timing only
+    except Exception as e:  # host-side/untraceable op: eager timing only,
+        # but record WHY so kernel regressions stay distinguishable
+        jit_error = f"{type(e).__name__}: {e}"[:200]
 
-    return {"op": cfg["op"], "shapes": cfg["shapes"], "dtype": dtype,
-            "repeat": repeat, "eager_us": round(eager_us, 2),
-            "jit_us": round(jit_us, 2) if jit_us is not None else None}
+    rec = {"op": cfg["op"], "shapes": cfg["shapes"], "dtype": dtype,
+           "repeat": repeat, "eager_us": round(eager_us, 2),
+           "jit_us": round(jit_us, 2) if jit_us is not None else None}
+    if jit_error:
+        rec["jit_error"] = jit_error
+    return rec
 
 
 def main():
