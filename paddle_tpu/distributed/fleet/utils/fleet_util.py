@@ -5,25 +5,36 @@ import numpy as np
 
 class UtilBase:
     """base/util_factory.py UtilBase: cross-worker scalar reductions,
-    barrier, and file sharding.  When a collective env is live (mesh
-    initialized) the reductions ride real XLA collectives; in PS mode
-    (role_maker only, no mesh) they fall back to the role-math
-    simulation the PS tests rely on."""
+    barrier, and file sharding.  The worker world is the set of trainer
+    PROCESSES (reference comm_world='worker'): with one process every
+    worker shares this value, so reductions are exact role-math; with
+    jax.distributed multi-process, they ride real collectives."""
 
     def __init__(self, role_maker=None):
         self.role_maker = role_maker
 
-    def _collective_live(self):
+    def _worker_num(self):
+        if self.role_maker is not None:
+            return self.role_maker.worker_num()
         try:
-            from .... import distributed as dist
+            import jax
 
-            return dist.is_initialized()
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def _multi_process(self):
+        try:
+            import jax
+
+            return jax.process_count() > 1
         except Exception:
             return False
 
     def all_reduce(self, input, mode="sum", comm_world="worker"):
         arr = np.asarray(input)
-        if self._collective_live():
+        n = self._worker_num()
+        if self._multi_process():
             from .... import distributed as dist
             from ....core.tensor import to_tensor
 
@@ -31,20 +42,23 @@ class UtilBase:
             op = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
                   "min": dist.ReduceOp.MIN}[mode]
             dist.all_reduce(t, op=op)
-            return np.asarray(t.numpy())
-        n = self.role_maker.worker_num() if self.role_maker else 1
+            out = np.asarray(t.numpy())
+            # transport is f32 (jax x64 off): keep integer callers integer
+            return out.astype(arr.dtype) \
+                if np.issubdtype(arr.dtype, np.integer) else out
+        # single process: every worker holds this same value — exact
         if mode == "sum":
             return arr * n if n > 1 else arr
         return arr
 
     def barrier(self, comm_world="worker"):
-        if self._collective_live():
+        if self._multi_process():
             from .... import distributed as dist
 
             dist.barrier()
 
     def all_gather(self, input, comm_world="worker"):
-        if self._collective_live():
+        if self._multi_process():
             from .... import distributed as dist
             from ....core.tensor import to_tensor
 
@@ -52,8 +66,7 @@ class UtilBase:
             dist.all_gather(out, to_tensor(np.asarray([input], np.float64)))
             return [float(np.asarray(t.numpy()).reshape(-1)[0])
                     for t in out]
-        n = self.role_maker.worker_num() if self.role_maker else 1
-        return [input] * n
+        return [input] * self._worker_num()
 
     def get_file_shard(self, files):
         """Contiguous blocks with the remainder spread over the first

@@ -103,13 +103,57 @@ def get_world_size(group=None):
     return ParallelEnv().world_size
 
 
-def build_mesh(shape_dict):
+def build_mesh(shape_dict, dcn_shape_dict=None):
     """Build a named mesh, e.g. {'data': 2, 'model': 4} (hybrid topology).
 
-    Axis order follows insertion order; total must divide available devices.
+    Axis order follows insertion order; total must divide available
+    devices.  On real TPUs the device layout comes from
+    jax.experimental.mesh_utils so trailing (fast-varying) axes land on
+    ICI-adjacent chips; `dcn_shape_dict` (same keys, per-axis slice
+    counts) places those factors across slices over DCN
+    (create_hybrid_device_mesh) — the multi-slice recipe.  On CPU (the
+    virtual test mesh) the layout is a plain reshape, byte-stable for
+    the parity tests.
     """
     names = tuple(shape_dict.keys())
     sizes = tuple(int(v) for v in shape_dict.values())
     n = int(np.prod(sizes))
-    devices = np.array(jax.devices()[:n]).reshape(sizes)
+    devs = jax.devices()
+    if dcn_shape_dict is not None:
+        unknown = set(dcn_shape_dict) - set(names)
+        if unknown:
+            raise ValueError(
+                f"dcn_shape_dict keys {sorted(unknown)} are not mesh "
+                f"axes {list(names)}")
+        dcn_sizes = tuple(int(dcn_shape_dict.get(k, 1)) for k in names)
+        for k, s, d in zip(names, sizes, dcn_sizes):
+            if d <= 0 or s % d:
+                raise ValueError(
+                    f"DCN factor {d} does not divide axis {k!r} size {s}")
+        ici_sizes = tuple(s // d for s, d in zip(sizes, dcn_sizes))
+        if all(hasattr(d, "slice_index") for d in devs[:n]):
+            from jax.experimental import mesh_utils
+
+            devices = mesh_utils.create_hybrid_device_mesh(
+                ici_sizes, dcn_sizes, devices=devs)
+        else:
+            # no slice topology (CPU test mesh / single slice): manual
+            # slice-major layout — DCN factors are the slowest-varying
+            # dims of each axis, the same placement the hybrid helper
+            # produces modulo intra-slice ICI optimization
+            arr = np.array(devs[:n]).reshape(dcn_sizes + ici_sizes)
+            k = len(names)
+            order = [i for pair in ((d, d + k) for d in range(k))
+                     for i in pair]
+            devices = arr.transpose(order).reshape(sizes)
+        return Mesh(devices, names)
+    if devs and devs[0].platform == "tpu" and n == len(devs):
+        try:
+            from jax.experimental import mesh_utils
+
+            devices = mesh_utils.create_device_mesh(sizes, devices=devs)
+            return Mesh(devices, names)
+        except Exception:
+            pass  # odd topologies: fall through to the plain reshape
+    devices = np.array(devs[:n]).reshape(sizes)
     return Mesh(devices, names)

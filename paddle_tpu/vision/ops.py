@@ -474,21 +474,30 @@ def _get_deform_cls():
 
 
 class _DeformMeta(type):
+    def __new__(mcls, name, bases, ns):
+        # subclassing the facade swaps in the REAL layer class as the
+        # base, so user subclasses are ordinary Layer subclasses with
+        # their own overrides intact
+        if any(getattr(b, "_is_deform_facade", False) for b in bases):
+            real_bases = tuple(
+                _get_deform_cls() if getattr(b, "_is_deform_facade", False)
+                else b for b in bases)
+            return type(name, real_bases, ns)
+        return super().__new__(mcls, name, bases, ns)
+
     def __call__(cls, *args, **kwargs):
-        if cls is DeformConv2D:
-            return _get_deform_cls()(*args, **kwargs)
-        return super().__call__(*args, **kwargs)  # subclasses construct
-        # themselves normally
+        return _get_deform_cls()(*args, **kwargs)
 
     def __instancecheck__(cls, obj):
-        if cls is DeformConv2D:
-            return isinstance(obj, _get_deform_cls())
-        return type.__instancecheck__(cls, obj)
+        return isinstance(obj, _get_deform_cls())
 
 
 class DeformConv2D(metaclass=_DeformMeta):
     """Stable public type: instances share ONE lazily-built Layer
-    subclass, so type(a) is type(b) and isinstance checks work."""
+    subclass, so type(a) is type(b) and isinstance checks work;
+    subclassing substitutes the real layer class as the base."""
+
+    _is_deform_facade = True
 
 
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,

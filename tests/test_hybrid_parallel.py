@@ -136,3 +136,21 @@ def test_zero_stage3_param_storage_is_sharded():
         np.testing.assert_allclose(
             np.asarray(named[n]._data), np.asarray(p._data),
             rtol=3e-4, atol=3e-4)
+
+
+def test_build_mesh_dcn_layout():
+    """Multi-slice mesh construction (parallel/env.py build_mesh
+    dcn_shape_dict): DCN factors are the slowest-varying dims of each
+    axis (slice-major), and a dp x tp train step runs on the result."""
+    import jax
+
+    from paddle_tpu.parallel.env import build_mesh
+
+    m = build_mesh({"data": 4, "model": 2}, dcn_shape_dict={"data": 2})
+    assert dict(m.shape) == {"data": 4, "model": 2}
+    # slice-major: rows 0-1 of the data axis come from the first "slice"
+    # (first half of the device list), rows 2-3 from the second
+    devs = list(jax.devices())
+    first_half = set(devs[: len(devs) // 2])
+    assert set(m.devices[:2].ravel().tolist()) <= first_half
+    assert not set(m.devices[2:].ravel().tolist()) & first_half
