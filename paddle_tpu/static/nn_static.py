@@ -298,10 +298,12 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     else:
         oh = (H + pad[0][0] + pad[0][1] - d[0] * (k[0] - 1) - 1) // s[0] + 1
         ow = (W + pad[1][0] + pad[1][1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
-    return emit("conv2d", ins,
-                [("Output", [input.shape[0], num_filters, oh, ow], input.dtype)],
-                fn, attrs={"strides": list(s), "paddings": pad,
-                           "dilations": list(d), "groups": groups})
+    out = emit("conv2d", ins,
+               [("Output", [input.shape[0], num_filters, oh, ow],
+                 input.dtype)],
+               fn, attrs={"strides": list(s), "paddings": pad,
+                          "dilations": list(d), "groups": groups})
+    return _maybe_act(out, act)
 
 
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,

@@ -195,3 +195,68 @@ def test_book_recommender_system():
         opt.clear_grad()
         losses.append(float(_np(loss)))
     assert losses[-1] < 0.8 * losses[0]
+
+
+def test_book_image_classification():
+    """test_image_classification.py: a small VGG-style conv net on
+    CIFAR-shaped data through the STATIC Program/Executor with
+    batch_norm + dropout + Momentum — the config-2 subsystem stack."""
+    paddle.seed(7)
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            img = static.data("img", [8, 3, 32, 32])
+            lbl = static.data("lbl", [8, 1], dtype="int64")
+            x = static.nn.conv2d(img, 16, 3, padding=1, act="relu")
+            x = static.nn.batch_norm(x, act="relu")
+            x = static.nn.pool2d(x, pool_size=2, pool_type="max",
+                                 pool_stride=2)
+            x = static.nn.conv2d(x, 32, 3, padding=1, act="relu")
+            x = static.nn.pool2d(x, global_pooling=True, pool_type="avg")
+            x = static.nn.flatten(x, axis=1)
+            x = static.nn.dropout(x, dropout_prob=0.1)
+            logits = static.nn.fc(x, 10)
+            loss = static.nn.mean(
+                static.nn.softmax_with_cross_entropy(logits, lbl))
+            paddle.optimizer.Momentum(learning_rate=0.05,
+                                      momentum=0.9).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.rand(8, 3, 32, 32).astype(np.float32),
+                "lbl": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+        losses = [float(np.ravel(
+                      exe.run(main, feed=feed, fetch_list=[loss])[0])[0])
+                  for _ in range(25)]
+        assert losses[-1] < 0.6 * losses[0], losses[::6]
+    finally:
+        paddle.disable_static()
+
+
+def test_book_understand_sentiment_lstm():
+    """test_understand_sentiment (book chapter): embedding -> LSTM ->
+    sequence-last pooling -> classifier, eager + Adam on Imdb-shaped
+    data — the recurrent-stack book leg."""
+    paddle.seed(11)
+    rng = np.random.RandomState(1)
+    B, T, V, H = 8, 16, 200, 32
+    ids = paddle.to_tensor(rng.randint(1, V, (B, T)).astype(np.int64))
+    lbl = paddle.to_tensor(rng.randint(0, 2, (B, 1)).astype(np.int64))
+
+    emb = nn.Embedding(V, H)
+    lstm = nn.LSTM(H, H)
+    head = nn.Linear(H, 2)
+    params = (list(emb.parameters()) + list(lstm.parameters())
+              + list(head.parameters()))
+    opt = paddle.optimizer.Adam(learning_rate=2e-3, parameters=params)
+    losses = []
+    for _ in range(20):
+        h, _ = lstm(emb(ids))
+        logits = head(h[:, -1])
+        loss = paddle.mean(F.softmax_with_cross_entropy(logits, lbl))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < 0.6 * losses[0], losses[::5]

@@ -155,3 +155,20 @@ def test_scope_and_places():
     assert g.shape == [2]
     with pytest.raises(RuntimeError):
         static.xpu_places()
+
+
+def test_conv2d_act_is_applied():
+    """static.nn.conv2d(act='relu') must actually rectify (it was once a
+    silently-ignored parameter)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [1, 1, 4, 4])
+        y = static.nn.conv2d(x, 2, 3, padding=1, act="relu",
+                             bias_attr=False)
+    exe = static.Executor()
+    exe.run(startup)
+    out, = exe.run(main,
+                   feed={"x": np.random.RandomState(0)
+                         .randn(1, 1, 4, 4).astype(np.float32) * 10},
+                   fetch_list=[y])
+    assert (np.asarray(out) >= 0).all()
