@@ -269,6 +269,33 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
 
 
+def make_inplace(fn, name=None):
+    """Trailing-underscore "inplace" contract shared by Tensor.<op>_ and
+    nn.functional's relu_ family: compute out of place, then rebind the
+    tensor's data AND tape node.  The op is recorded against a SNAPSHOT
+    of the input's tape identity (the tape stores parent tensor objects,
+    so mutating the input itself would make its node its own parent's
+    node — a cycle).  In-place on a grad-requiring leaf raises (torch/
+    reference parity: the pre-op value would be lost to autograd)."""
+
+    def method(self, *a, **k):
+        if not self.stop_gradient and self._node is None:
+            raise RuntimeError(
+                f"{name or fn.__name__}_ cannot be applied in-place to a "
+                "leaf Tensor that requires grad")
+        old = _wrap_data(self._data, stop_gradient=self.stop_gradient)
+        old._node = self._node
+        old._out_index = self._out_index
+        out = fn(old, *a, **k)
+        self._data = out._data
+        self._node = out._node
+        self._out_index = out._out_index
+        return self
+
+    method.__name__ = (name or fn.__name__) + "_"
+    return method
+
+
 def _install_operators():
     """Attach arithmetic dunders (delegating to ops, so they're tape-recorded)."""
     from .. import ops
@@ -318,6 +345,22 @@ def _install_operators():
         "maximum", "minimum", "where_m", "masked_select", "index_select",
         "roll", "flip", "unique", "nonzero", "broadcast_to",
     ]
+    # the wider monkey-patched surface (tensor/__init__.py
+    # tensor_method_func): every functional with a natural method form
+    _methods += [
+        "acos", "asin", "atan", "sinh", "cosh", "add_n", "addmm", "all",
+        "any", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bmm", "broadcast_tensors", "cholesky", "conj", "cross",
+        "diagonal", "digamma", "equal", "erf", "floor_divide",
+        "floor_mod", "gather_nd", "greater_equal", "greater_than",
+        "histogram", "imag", "increment", "index_sample", "inverse",
+        "kron", "less_equal", "less_than", "lgamma", "log10", "log1p",
+        "log2", "logical_xor", "logsumexp", "median", "mod", "multiplex",
+        "mv", "neg", "not_equal", "real", "reciprocal", "remainder",
+        "reverse", "scatter", "scatter_nd_add", "shard_index", "slice",
+        "stanh", "std", "strided_slice", "t", "trace", "trunc",
+        "unstack", "var", "where",
+    ]
     for m in set(_methods):
         if hasattr(ops, m):
             fn = getattr(ops, m)
@@ -329,3 +372,57 @@ def _install_operators():
                 return method
 
             setattr(Tensor, m, make(fn))
+
+    # bitwise dunders
+    Tensor.__and__ = lambda self, o: ops.bitwise_and(self, o)
+    Tensor.__or__ = lambda self, o: ops.bitwise_or(self, o)
+    Tensor.__xor__ = lambda self, o: ops.bitwise_xor(self, o)
+    Tensor.__invert__ = lambda self: ops.bitwise_not(self)
+
+    Tensor.mm = lambda self, o: ops.matmul(self, o)
+
+    def _rank_method(self):
+        import paddle_tpu
+
+        return paddle_tpu.rank(self)
+
+    Tensor.rank = _rank_method
+    Tensor.is_tensor = lambda self: True
+
+    def _is_empty_method(self):
+        import paddle_tpu
+
+        return paddle_tpu.is_empty(self)
+
+    Tensor.is_empty = _is_empty_method
+
+    def _broadcast_shape_method(self, other_shape):
+        from ..ops.linalg_extra import broadcast_shape
+
+        return broadcast_shape(list(self.shape), other_shape)
+
+    Tensor.broadcast_shape = _broadcast_shape_method
+
+    # ops living in submodules not re-exported at ops/ top level: resolve
+    # through the package root at CALL time (it is still importing when
+    # this installer runs)
+    def _make_toplevel(name):
+        def method(self, *a, **k):
+            import paddle_tpu
+
+            return getattr(paddle_tpu, name)(self, *a, **k)
+
+        return method
+
+    for m in ["add_n", "cholesky", "conj", "diagonal", "histogram",
+              "imag", "inverse", "median", "multiplex", "real",
+              "reverse", "scatter_nd", "std", "trace", "var"]:
+        if not hasattr(Tensor, m):
+            setattr(Tensor, m, _make_toplevel(m))
+
+    for base in ["add", "subtract", "clip", "scale", "ceil", "floor",
+                 "exp", "reciprocal", "round", "rsqrt", "sqrt", "tanh",
+                 "flatten", "reshape", "squeeze", "unsqueeze", "scatter"]:
+        if hasattr(ops, base):
+            setattr(Tensor, base + "_",
+                    make_inplace(getattr(ops, base), base))

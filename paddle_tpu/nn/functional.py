@@ -76,34 +76,11 @@ from ..ops.nn_extra import bilinear  # noqa: F401,E402
 
 
 def _inplace_alias(fn):
-    """The reference's trailing-underscore "inplace" variants: XLA buffers
-    are immutable, so these compute out-of-place and rebind the input
-    tensor's data in place at the Python level — the observable contract
-    (argument tensor holds the result) is preserved."""
+    """See core.tensor.make_inplace — one shared implementation of the
+    inplace data+tape rebind contract."""
+    from ..core.tensor import make_inplace
 
-    def op(x, *args, **kwargs):
-        from ..core.tensor import _wrap_data
-
-        if not x.stop_gradient and x._node is None:
-            # torch/reference parity: in-place on a grad-requiring leaf is
-            # an error (its pre-op value would be lost to autograd)
-            raise RuntimeError(
-                f"{fn.__name__}_ cannot be applied in-place to a leaf "
-                "Tensor that requires grad")
-        # record the op against a SNAPSHOT of x's tape identity — the tape
-        # stores parent tensor objects, so mutating x itself would create
-        # a cycle (x's node becoming its own parent's node)
-        old = _wrap_data(x._data, stop_gradient=x.stop_gradient)
-        old._node = x._node
-        old._out_index = x._out_index
-        out = fn(old, *args, **kwargs)
-        x._data = out._data
-        x._node = out._node
-        x._out_index = out._out_index
-        return x
-
-    op.__name__ = fn.__name__ + "_"
-    return op
+    return make_inplace(fn)
 
 
 relu_ = _inplace_alias(relu)

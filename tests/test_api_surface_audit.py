@@ -95,3 +95,17 @@ def test_every_reference_public_export_exists():
         if missing:
             report[name] = missing
     assert not report, f"public-API exports missing: {report}"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference unavailable")
+def test_tensor_method_surface():
+    """Every reference tensor_method_func name (the monkey-patched Tensor
+    method surface) exists on our Tensor, including inplace variants and
+    bitwise dunders."""
+    import numpy as np
+
+    src = open(f"{REF}/tensor/__init__.py").read()
+    names = set(re.findall(r"'(\w+)'", src.split("tensor_method_func")[1]))
+    t = p.to_tensor(np.ones((2, 2), np.float32))
+    missing = sorted(n for n in names if not hasattr(t, n))
+    assert not missing, f"Tensor methods missing: {missing}"
