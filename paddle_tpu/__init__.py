@@ -104,14 +104,16 @@ def numel(x):
     return to_tensor(_np.array(x.size, dtype=_np.int64))
 
 
-def summary(net, input_size=None, dtypes=None):
-    total = sum(int(_np.prod(p.shape)) for p in net.parameters())
-    trainable = sum(
-        int(_np.prod(p.shape)) for p in net.parameters() if not p.stop_gradient
-    )
-    print(f"Total params: {total}")
-    print(f"Trainable params: {trainable}")
-    return {"total_params": total, "trainable_params": trainable}
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.model_summary import summary as _impl
+
+    return _impl(net, input_size, dtypes, input)
+
+
+def flops(net, input_size=None, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _impl
+
+    return _impl(net, input_size, custom_ops, print_detail)
 
 
 # ---- tensor-API long tail + framework compat (reference top-level) ----
@@ -196,19 +198,8 @@ def check_shape(shape):
             raise ValueError(f"invalid dim {s} in shape {shape}")
 
 
-def flops(net, input_size, custom_ops=None, print_detail=False):
-    """paddle.flops (hapi/dynamic_flops.py role): rough MAC count from
-    parameter shapes — conv/linear dominate, which param shapes capture."""
-    total = 0
-    for p in net.parameters():
-        shp = p.shape
-        if len(shp) >= 2:
-            total += int(_np.prod(shp))
-    mult = int(_np.prod(input_size[:1])) if input_size else 1
-    est = total * 2 * mult
-    if print_detail:
-        print(f"FLOPs (estimate): {est}")
-    return est
+# paddle.flops: the hook-driven per-layer counter (hapi/dynamic_flops.py)
+# defined above
 
 
 def monkey_patch_math_varbase():  # the operators are installed at import

@@ -1,2 +1,35 @@
 from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
+
+from .model_summary import summary, summary_string  # noqa: E402,F401
+from .dynamic_flops import flops, static_flops  # noqa: E402,F401
+from .. import hub  # noqa: E402,F401  (hapi.hub alias)
+from . import callbacks as logger  # noqa: E402,F401  (logger shim: the
+# reference hapi.logger backs ProgBarLogger; our callbacks own logging)
+
+
+class ProgressBar:
+    """hapi/progressbar.py: minimal terminal progress meter used by
+    ProgBarLogger."""
+
+    def __init__(self, num=None, width=30, verbose=1, file=None):
+        self.num = num
+        self.width = width
+        self._seen = 0
+
+    def update(self, current_num, values=None):
+        self._seen = current_num
+        if self.num:
+            frac = min(current_num / self.num, 1.0)
+            bar = "=" * int(frac * self.width)
+            metrics = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in (values or []))
+            print(f"\r{current_num}/{self.num} [{bar:<{self.width}}] "
+                  f"{metrics}", end="", flush=True)
+
+    def start(self):
+        pass
+
+
+progressbar = ProgressBar

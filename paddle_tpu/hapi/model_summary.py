@@ -1,0 +1,98 @@
+"""paddle.summary (hapi/model_summary.py): per-layer table of output
+shapes and parameter counts, collected with forward hooks on a dry-run
+forward pass.
+"""
+import numpy as np
+
+__all__ = ["summary", "summary_string"]
+
+
+def _num_params(layer):
+    """(total, trainable) over the parameters registered directly on
+    this layer (leaves only — sublayers report their own rows)."""
+    return sum(int(np.prod(p.shape)) for p in layer._parameters.values()), \
+        sum(int(np.prod(p.shape)) for p in layer._parameters.values()
+            if not p.stop_gradient)
+
+
+def _shape_of(out):
+    if hasattr(out, "shape"):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)):
+        return [_shape_of(o) for o in out if o is not None][:2]
+    return []
+
+
+def summary_string(net, input_size=None, dtypes=None, input=None):
+    """(text, stats) form of summary()."""
+    from ..core.tensor import to_tensor
+
+    rows = []
+    handles = []
+
+    def hook_for(name, layer):
+        def hook(lyr, inputs, outputs):
+            total, trainable = _num_params(lyr)
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}",
+                         name, _shape_of(outputs), total))
+
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=True):
+        if not layer._sub_layers:  # leaves only, like the reference table
+            handles.append(layer.register_forward_post_hook(
+                hook_for(name, layer)))
+
+    try:
+        if input is not None:
+            net(*input if isinstance(input, (list, tuple)) else (input,))
+        elif input_size is not None:
+            sizes = input_size if isinstance(input_size, list) \
+                and isinstance(input_size[0], (list, tuple)) \
+                else [input_size]
+            dts = dtypes or ["float32"] * len(sizes)
+            args = [to_tensor(np.zeros(
+                [1 if d is None or int(d) < 0 else int(d) for d in s],
+                np.dtype(dt) if dt != "float32" else np.float32))
+                for s, dt in zip(sizes, dts)]
+            net(*args)
+    finally:
+        for h in handles:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    w_layer = max([len(r[0]) for r in rows] + [12]) + 2
+    w_shape = max([len(str(r[2])) for r in rows] + [12]) + 2
+    lines = ["-" * (w_layer + w_shape + 14),
+             f"{'Layer (type)':<{w_layer}}{'Output Shape':<{w_shape}}"
+             f"{'Param #':>12}",
+             "=" * (w_layer + w_shape + 14)]
+    for tag, _, shape, n in rows:
+        lines.append(f"{tag:<{w_layer}}{str(shape):<{w_shape}}{n:>12,}")
+    lines += ["=" * (w_layer + w_shape + 14),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * (w_layer + w_shape + 14)]
+    stats = {"total_params": total, "trainable_params": trainable}
+    return "\n".join(lines), stats
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print the per-layer table; returns {total_params, trainable_params}
+    (model_summary.py:28 contract).  Works with either an input_size
+    (zeros dry run) or concrete `input` tensors; with neither, prints
+    parameter totals only."""
+    if input_size is None and input is None:
+        total = sum(int(np.prod(p.shape)) for p in net.parameters())
+        trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                        if not p.stop_gradient)
+        print(f"Total params: {total:,}")
+        print(f"Trainable params: {trainable:,}")
+        return {"total_params": total, "trainable_params": trainable}
+    text, stats = summary_string(net, input_size, dtypes, input)
+    print(text)
+    return stats

@@ -4,3 +4,8 @@ from . import optimizer  # noqa: F401
 from .optimizer import (  # noqa: F401
     LookAhead, ModelAverage, ExponentialMovingAverage,
 )
+
+from .checkpoint import auto_checkpoint  # noqa: E402,F401
+from ..ops.vision_extra import (  # noqa: E402,F401
+    softmax_mask_fuse_upper_triangle,
+)

@@ -140,3 +140,5 @@ from . import utils  # noqa: E402,F401
 from .utils import spectral_norm  # noqa: E402,F401
 from .layers import loss  # noqa: E402,F401
 from .. import quant  # noqa: E402,F401  (paddle.nn.quant alias role)
+
+from .layers import container, rnn, transformer  # noqa: E402,F401

@@ -20,3 +20,47 @@ from .qat import (  # noqa: F401
     QuantedLinear,
     quant_dequant,
 )
+
+
+class QuantStub:
+    """nn/quant/quant_layers.py QuantStub: marks a quantization entry
+    point; identity at float training time (QAT observers attach here)."""
+
+    def __init__(self, *a, **k):
+        pass
+
+    def __call__(self, x):
+        return x
+
+    forward = __call__
+
+
+class FloatFunctionalLayer:
+    """nn/quant/functional_layers.py: functional ops as layers so the
+    quant passes can observe their inputs/outputs."""
+
+    def __init__(self):
+        pass
+
+
+def _functional_layer(op_name):
+    import paddle_tpu
+
+    class _L(FloatFunctionalLayer):
+        def forward(self, x, y=None, *a, **k):
+            fn = getattr(paddle_tpu, op_name)
+            return fn(x, *a, **k) if y is None else fn(x, y, *a, **k)
+
+        __call__ = forward
+
+    _L.__name__ = op_name
+    return _L
+
+
+add = _functional_layer("add")
+subtract = _functional_layer("subtract")
+multiply = _functional_layer("multiply")
+divide = _functional_layer("divide")
+reshape = _functional_layer("reshape")
+transpose = _functional_layer("transpose")
+flatten = _functional_layer("flatten")

@@ -170,3 +170,42 @@ def test_vision_transforms_color_and_geometry():
                      T.Pad(1), T.RandomResizedCrop(6),
                      T.ToTensor()])(img)
     assert out.shape == (1, 6, 6)
+
+
+def test_summary_and_flops():
+    """paddle.summary prints a per-layer table with correct totals;
+    paddle.flops counts conv/linear FLOPs layer by layer
+    (hapi/model_summary.py + dynamic_flops.py)."""
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet()
+    stats = paddle.summary(net, (1, 1, 28, 28))
+    want = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert stats["total_params"] == want == 61610
+
+    f = paddle.flops(net, (1, 1, 28, 28))
+    # exact: conv 2*4704*9 + 2*1600*150, fc 2*(400*120 + 120*84 + 84*10)
+    assert f == 84672 + 480000 + 96000 + 20160 + 1680
+
+    # custom op counters extend the table
+    from paddle_tpu.nn.layers.pooling import MaxPool2D
+
+    f2 = paddle.flops(net, (1, 1, 28, 28),
+                      custom_ops={MaxPool2D: lambda l, i, o:
+                                  int(np.prod(o.shape))})
+    assert f2 > f
+
+
+def test_incubate_hapi_quant_namespace_closure():
+    import paddle_tpu.incubate as inc
+    import paddle_tpu.hapi as hapi
+    import paddle_tpu.quant as quant
+
+    assert inc.auto_checkpoint and inc.softmax_mask_fuse_upper_triangle
+    assert hapi.summary and hapi.flops and hapi.static_flops
+    q = quant.QuantStub()
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    assert q(x) is x
+    add_layer = quant.add()
+    np.testing.assert_allclose(np.asarray(add_layer(x, x)._data), 2.0)
+    assert paddle.nn.container and paddle.nn.rnn and paddle.nn.transformer
