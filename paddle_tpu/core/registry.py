@@ -89,6 +89,26 @@ def apply_op(op_type, fn, args, kwargs, n_outputs=None):
 
     from ..framework import _FLAGS
     check_nan = _FLAGS.get("FLAGS_check_nan_inf")
+    if _FLAGS.get("FLAGS_benchmark"):
+        # benchmark mode (reference FLAGS_benchmark: DeviceContext::Wait
+        # after every kernel): fence each eager op so per-op wall times
+        # are attributable.  Composes with FLAGS_profile — the fence
+        # wraps the (possibly RecordEvent-spanned) dispatch.
+        out = _dispatch_maybe_profiled(op_type, fn, args, kwargs,
+                                       tensor_pos, vals, diff_pos,
+                                       check_nan)
+        jax.block_until_ready(
+            tuple(o._data for o in out) if isinstance(out, tuple)
+            else out._data)
+        return out
+    return _dispatch_maybe_profiled(op_type, fn, args, kwargs, tensor_pos,
+                                    vals, diff_pos, check_nan)
+
+
+def _dispatch_maybe_profiled(op_type, fn, args, kwargs, tensor_pos, vals,
+                             diff_pos, check_nan):
+    from ..framework import _FLAGS
+
     if _FLAGS.get("FLAGS_profile"):
         # FLAGS_profile (flags.cc / profiler.h): per-op host spans, the
         # RecordEvent the reference pushes around every kernel

@@ -20,13 +20,33 @@ _FLAGS = {
     # defaults mirroring the reference's core set (platform/flags.cc:33-241)
     "FLAGS_check_nan_inf": False,
     "FLAGS_benchmark": False,
+    # accepted no-ops under PJRT-owned HBM (SURVEY §7.1): buffer
+    # lifetime is XLA liveness + donation, not a GC threshold/strategy
     "FLAGS_eager_delete_tensor_gb": 0.0,
-    "FLAGS_allocator_strategy": "pjrt",  # PJRT owns HBM (SURVEY §7.1)
+    "FLAGS_allocator_strategy": "pjrt",
     "FLAGS_use_bf16_matmul": True,
     "FLAGS_flash_attention": False,
     "FLAGS_profile": False,
     "FLAGS_seed": 0,
 }
+
+
+def _apply_flag_side_effects(k, v):
+    """Effects of EXPLICITLY-set flags (set_flags or FLAGS_* env vars) —
+    defaults apply no side effect: bf16 matmul is already the TPU
+    backend's native default, and seeding only happens on request."""
+    if k == "FLAGS_use_bf16_matmul":
+        # matmul input precision: bf16 (MXU-native) vs float32 (3-pass
+        # emulation, slower but exact)
+        import jax
+
+        jax.config.update("jax_default_matmul_precision",
+                          "bfloat16" if v else "float32")
+    elif k == "FLAGS_seed":
+        # any explicitly-set integer (including 0) reseeds
+        from .core import random as _random
+
+        _random.seed(int(v))
 
 
 def _env_pickup():
@@ -42,6 +62,7 @@ def _env_pickup():
                 _FLAGS[k] = int(v)
             else:
                 _FLAGS[k] = v
+            _apply_flag_side_effects(k, _FLAGS[k])
 
 
 _env_pickup()
@@ -50,6 +71,7 @@ _env_pickup()
 def set_flags(flags):
     for k, v in flags.items():
         _FLAGS[k] = v
+        _apply_flag_side_effects(k, v)
 
 
 def get_flags(flags):
