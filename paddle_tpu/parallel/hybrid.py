@@ -524,6 +524,32 @@ class CompiledTrainStep:
             self.optimizer._lr.step()
         return _wrap_data(loss)
 
+    def cost_analysis(self, *batch):
+        """XLA cost analysis of the compiled step (the reference's
+        operators/benchmark/op_tester.cc role, but for the whole fused
+        step).  Returns the lowered computation's stats dict (keys like
+        'flops', 'bytes accessed') or None when the backend can't say.
+        Measured FLOPs from here beat hand 2*N*tokens models: embedding
+        lookups aren't counted as matmuls and remat FLOPs are included.
+        """
+        try:
+            vals = tuple(
+                b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch
+            )
+            if self._jit_step is None:
+                self._jit_step = self._build(vals)
+            key = jax.random.fold_in(_random.get_rng_state(), 0)
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            lowered = self._jit_step.lower(
+                self.params, self.flat_opt_state, vals, key, lr)
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            return dict(ca) if ca else None
+        except Exception:
+            return None
+
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
         if self.zero_stage >= 3:

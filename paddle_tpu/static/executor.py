@@ -77,10 +77,34 @@ class CompiledBlock:
         # key includes the flag, so flips build a fresh CompiledBlock).
         self._check_nan = bool(_FLAGS.get("FLAGS_check_nan_inf"))
         self._checked_ops = []
-        self._op_order, donate_feeds = self._plan(block)
-        if donate_feeds:
-            # feed arrays are fresh device uploads each run — safe to let XLA
-            # alias their buffers into outputs (inplace-pass analogue)
+        self._op_order, self._donate_feeds = self._plan(block)
+        self._jitted = None
+
+    def _ensure_jitted(self, feeds, params):
+        """Build the jitted callable on first run, when concrete feed/param
+        avals are known.  Feeds are donated (inplace-pass analogue) only
+        when every feed buffer can actually be aliased into some output —
+        XLA warns on (and on TPU double-allocates for) donations it can't
+        use, so a shape/dtype multiset check gates the donation plan."""
+        if self._jitted is not None:
+            return
+        donate = False
+        if self._donate_feeds and feeds:
+            try:
+                out_sds = jax.eval_shape(self._run_block, feeds, params)
+                avail = collections.Counter(
+                    (tuple(s.shape), str(s.dtype))
+                    for s in jax.tree_util.tree_leaves(out_sds))
+                donate = True
+                for v in feeds.values():
+                    k = (tuple(v.shape), str(v.dtype))
+                    if avail.get(k, 0) <= 0:
+                        donate = False
+                        break
+                    avail[k] -= 1
+            except Exception:
+                donate = False
+        if donate:
             self._jitted = jax.jit(self._run_block, donate_argnums=(0,))
         else:
             self._jitted = jax.jit(self._run_block)
@@ -180,6 +204,7 @@ class CompiledBlock:
                 v = v._data
             feeds[n] = jnp.asarray(np.asarray(v))
         params = {n: scope.get(n) for n in self.param_names}
+        self._ensure_jitted(feeds, params)
         try:
             outs, updated, nonfinite = self._jitted(feeds, params)
         except KeyError as e:
@@ -203,6 +228,26 @@ class CompiledBlock:
             scope.set(n, v)
         return [np.asarray(o) for o in outs]
 
+    def cost_analysis(self, feed, scope):
+        """XLA cost analysis of the compiled block ('flops', 'bytes
+        accessed', ...) or None; bench.py uses this instead of a hand
+        FLOPs model (op_tester.cc role)."""
+        feeds = {}
+        for n in self.feed_names:
+            v = feed[n]
+            if isinstance(v, Tensor):
+                v = v._data
+            feeds[n] = jnp.asarray(np.asarray(v))
+        params = {n: scope.get(n) for n in self.param_names}
+        self._ensure_jitted(feeds, params)
+        try:
+            ca = self._jitted.lower(feeds, params).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            return dict(ca) if ca else None
+        except Exception:
+            return None
+
 
 class Executor:
     def __init__(self, place=None):
@@ -220,9 +265,13 @@ class Executor:
             self._run_startup(program, scope)
             return []
 
-        fetch_names = [
-            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
-        ]
+        cb = self._get_block(program, feed, fetch_list, scope)
+        outs = cb.run(feed, scope)
+        if return_numpy:
+            return outs
+        return [Tensor(o) for o in outs]
+
+    def _cache_key(self, program, feed, fetch_names):
         feed_names = tuple(sorted(feed.keys()))
         shapes = tuple(
             tuple(np.asarray(v.numpy() if isinstance(v, Tensor) else v).shape)
@@ -230,16 +279,30 @@ class Executor:
         )
         from ..framework import _FLAGS
 
-        key = (id(program), feed_names, tuple(fetch_names), shapes,
-               bool(_FLAGS.get("FLAGS_check_nan_inf")))
+        return (id(program), feed_names, tuple(fetch_names), shapes,
+                bool(_FLAGS.get("FLAGS_check_nan_inf")))
+
+    def _get_block(self, program, feed, fetch_list, scope):
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f)
+            for f in (fetch_list or [])
+        ]
+        key = self._cache_key(program, feed, fetch_names)
         cb = self._cache.get(key)
         if cb is None:
             cb = CompiledBlock(program, feed.keys(), fetch_names, scope)
             self._cache[key] = cb
-        outs = cb.run(feed, scope)
-        if return_numpy:
-            return outs
-        return [Tensor(o) for o in outs]
+        return cb
+
+    def cost_analysis(self, program=None, feed=None, fetch_list=None,
+                      scope=None):
+        """Cost stats of the block run() would execute for these args
+        (compiles it if this exact (program, feed, fetch) wasn't run yet)."""
+        program = program or default_main_program()
+        feed = feed or {}
+        scope = scope or _global_scope
+        cb = self._get_block(program, feed, fetch_list, scope)
+        return cb.cost_analysis(feed, scope)
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
