@@ -460,11 +460,21 @@ def _prev_tpu_value():
     if _PREV_TPU:
         return _PREV_TPU[0]
     import glob
+    import re
 
     here = os.path.dirname(os.path.abspath(__file__))
+
+    def _round_no(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
     best = None
-    for p in (sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
-              + [os.path.join(here, "BENCH_TPU_SESSION.json")]):
+    # numeric round order; the per-session landing file only counts when no
+    # driver round artifact carries a TPU number (it is the same round's
+    # record, pre-copy)
+    rounds = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                    key=_round_no)
+    for p in [os.path.join(here, "BENCH_TPU_SESSION.json")] + rounds:
         try:
             with open(p) as f:
                 rec = json.load(f)

@@ -137,3 +137,17 @@ def is_compiled_with_rocm():
 
 def get_cudnn_version():
     return None  # no cuDNN in a TPU build (API parity)
+
+
+def lowered_cost_stats(lowered):
+    """Normalize jax.stages.Lowered.cost_analysis() across jax versions
+    (dict, list-of-dicts, or unavailable) into a plain dict or None.
+    Shared by the compiled-train-step and static-executor cost hooks
+    (the reference op_tester.cc FLOPs-accounting role)."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if ca else None

@@ -35,8 +35,9 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     call site implies — the TensorParallelOptimizer derives its rewrite from
     THESE specs instead of guessing (VERDICT r1 weak-4)."""
     from .. import in_dynamic_mode
+    from ..static.program import Variable as StaticVar
 
-    if not in_dynamic_mode():
+    if isinstance(x, StaticVar) or not in_dynamic_mode():
         if operation == "linear":
             return _static_parallel_linear(
                 x, size[0], size[1], axis=axis, gather_out=gather_out,

@@ -20,6 +20,20 @@ def collect_param_grad_names(block):
     return names
 
 
+def record_mesh_axis(program, axis, degree):
+    """Ask the static Executor to compile this program's block under a
+    device mesh containing `axis` (degree None = fill with the devices no
+    other axis claims).  The Executor resolves the axes against
+    jax.devices() and jits the whole block with GSPMD shardings
+    (in_shardings/out_shardings from each var's dist_spec), so the fleet
+    rewrite EXECUTES distributed instead of being op-list parity only —
+    the TPU-native counterpart of ParallelExecutor running the rewritten
+    program on devices (parallel_executor.h:51)."""
+    axes = dict(getattr(program, "_mesh_axes", None) or {})
+    axes[axis] = degree
+    program._mesh_axes = axes
+
+
 def insert_before_first_update(block, build_ops):
     """Rebuild the op list with `build_ops()` results spliced in right
     before the first optimizer-update op (raw_program_optimizer.py:158

@@ -2,12 +2,15 @@
 
 Reference parity: meta_optimizers/pipeline_optimizer.py (268 LoC) wrapping
 fluid PipelineOptimizer (optimizer.py:4135): splits the program into per-stage
-section programs on device annotations, inserts send_v2/recv_v2.  TPU-native:
-stages are value-connected inside one XLA program; the rewrite assigns each op
-a stage id (uniform split) and records it, so the compiled path can shard
-stage params over the 'pipe' axis.  send/recv marker ops are inserted at stage
-boundaries for op-list parity (they lower to identity — XLA's partitioner
-emits the actual ICI transfers).
+section programs on device annotations, inserts send_v2/recv_v2.  TPU-native
+status, stated plainly: this static rewrite is OP-LIST PARITY ONLY — the
+stage ids and send/recv markers are recorded but the static Executor runs
+the block as one single-program XLA computation (numerically identical to
+the unsplit program; the markers are fn=None structural ops).  Real
+pipelined execution — per-stage compiled programs, micro-batch schedule,
+ppermute stage transfers, ZeRO-sharded opt state — lives in the compiled
+path (parallel/pipeline_compile.py PipelinedTrainStep), which is what
+fleet's dygraph PipelineParallel wrapper and the dryrun pipeline leg use.
 """
 from .meta_optimizer_base import MetaOptimizerBase
 

@@ -2,12 +2,16 @@
 
 Reference parity: meta_optimizers/raw_program_optimizer.py (442 LoC):
 after inner minimize, insert `c_allreduce_sum` on every gradient
-(_insert_allreduce_ops:158) + comm init in startup.  TPU-native lowering: the
-inserted op is a psum over the 'data' mesh axis when the block is compiled
-under shard_map/pjit; in single-mesh eager execution the global-batch gradient
-is already the reduced value, so the op is the identity scale.
+(_insert_allreduce_ops:158) + comm init in startup.  TPU-native lowering:
+the rewrite records a 'data' mesh axis on the program; the static
+Executor then compiles the whole block under GSPMD with the feed batch
+dim sharded over that axis, and XLA inserts the actual gradient
+all-reduces over ICI (the inserted `c_allreduce_sum` markers lower to
+identity inside the globally-semantic program — the psum is the
+partitioner's, exactly where the markers sit).  Under a degree-1 mesh or
+on a single device the program is unchanged single-device execution.
 """
-from .meta_optimizer_base import MetaOptimizerBase
+from .meta_optimizer_base import MetaOptimizerBase, record_mesh_axis
 
 
 class RawProgramOptimizer(MetaOptimizerBase):
@@ -25,4 +29,5 @@ class RawProgramOptimizer(MetaOptimizerBase):
 
         get_pass("insert_data_parallel_allreduce").apply(
             loss.block.program)
+        record_mesh_axis(loss.block.program, "data", None)
         return result

@@ -5,14 +5,21 @@ Reference parity: meta_optimizers/sharding_optimizer.py (1437 LoC) + sharding/
 _split_program:503 segmentation, _add_broadcast_allreduce:746).  TPU-native
 design: parameter ownership maps to a PartitionSpec over the 'sharding' mesh
 axis — the broadcast-before-use / reduce-to-owner pattern is exactly what XLA
-emits for weight-sharded matmuls (all-gather param, reduce-scatter grad), so
-the static rewrite here (1) assigns owners with the reference's round-robin-
+emits for weight-sharded matmuls (all-gather param, reduce-scatter grad).  The
+static rewrite here (1) assigns owners with the reference's round-robin-
 by-size rule, (2) inserts `c_broadcast` / `c_reduce_sum` ops for op-list
-parity, and (3) records `dist_spec` metadata the compiled path consumes.
+parity, and (3) shards param + optimizer-state vars over a 'sharding' mesh
+axis via `dist_spec` and records the axis on the program — the static
+Executor compiles the block under GSPMD with those shardings, so the
+persistent param/opt-state storage IS range-sharded across devices and XLA
+emits the all-gather-before-use / reduce-to-owner collectives the markers
+stand for (the executing counterpart of sharding_optimizer.py:746).  Owner
+assignment (which rank owns which param) is kept for reference parity and
+checkpoint compat; the mesh layout supersedes it for placement.
 """
 import numpy as np
 
-from .meta_optimizer_base import MetaOptimizerBase
+from .meta_optimizer_base import MetaOptimizerBase, record_mesh_axis
 from ....static.backward import GRAD_SUFFIX
 
 
@@ -101,7 +108,33 @@ class ShardingOptimizer(MetaOptimizerBase):
                     if pv is not None:
                         pv.opt_state_spec = P("sharding")
                         pv.shard_owner = dev
+                        self._shard_var_specs(block, pv)
                 inserted = True
             final_ops.append(op)
         block.ops = final_ops
+        record_mesh_axis(loss.block.program, "sharding", sharding_degree)
         return result
+
+    @staticmethod
+    def _shard_var_specs(block, pv):
+        """Range-shard the param and its optimizer-state vars on dim 0 over
+        the 'sharding' axis (dist_spec consumed by the mesh-aware static
+        Executor).  A dim already sharded by TP keeps its axis; scalars and
+        dim-0-sharded-elsewhere vars stay as they are."""
+        from jax.sharding import PartitionSpec as P
+
+        if not pv.shape:
+            return
+        spec = list(getattr(pv, "dist_spec", None) or ())
+        spec += [None] * (len(pv.shape) - len(spec))
+        if spec[0] is None:
+            spec[0] = "sharding"
+            pv.dist_spec = P(*spec)
+        # optimizer state vars are named f"{param}_{state_key}"
+        # (static/optimizer_bridge.py) and share the param's shape
+        prefix = pv.name + "_"
+        for n, v in block.vars.items():
+            if (n.startswith(prefix) and not v.is_parameter
+                    and v.persistable and list(v.shape or ()) ==
+                    list(pv.shape)):
+                v.dist_spec = P(*spec)

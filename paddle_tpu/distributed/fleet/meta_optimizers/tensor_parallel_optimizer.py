@@ -10,7 +10,7 @@ input c_broadcast markers the reference inserts, and (3) does NOT guess
 specs for params without call sites (VERDICT r1 weak-4: blind col/row
 alternation is wrong for any layer order other than col,row,col,row).
 """
-from .meta_optimizer_base import MetaOptimizerBase
+from .meta_optimizer_base import MetaOptimizerBase, record_mesh_axis
 
 
 class TensorParallelOptimizer(MetaOptimizerBase):
@@ -45,6 +45,11 @@ class TensorParallelOptimizer(MetaOptimizerBase):
             tp_params[name] = spec
         if not tp_params:
             return result  # no parallel call sites — nothing to rewrite
+        if degree > 1:
+            # mesh-aware Executor compiles the block with these weights
+            # sharded over 'model'; XLA inserts the TP collectives the
+            # c_identity/c_allreduce_sum markers stand for
+            record_mesh_axis(loss.block.program, "model", degree)
 
         # 2. broadcast inputs across the model group at program start
         #    (reference: _broadcast_params + input sync in the TP rewrite).

@@ -541,12 +541,10 @@ class CompiledTrainStep:
                 self._jit_step = self._build(vals)
             key = jax.random.fold_in(_random.get_rng_state(), 0)
             lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-            lowered = self._jit_step.lower(
-                self.params, self.flat_opt_state, vals, key, lr)
-            ca = lowered.cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else None
-            return dict(ca) if ca else None
+            from ..core.device import lowered_cost_stats
+
+            return lowered_cost_stats(self._jit_step.lower(
+                self.params, self.flat_opt_state, vals, key, lr))
         except Exception:
             return None
 

@@ -49,8 +49,11 @@ class InputSpec:
 
 class CompiledProgram:
     """Parity: fluid/compiler.py CompiledProgram — on TPU the plain Executor
-    already compiles whole blocks with XLA, so this is a thin marker that
-    carries build strategy options."""
+    already compiles whole blocks with XLA, so this carries build-strategy
+    knobs; `with_data_parallel` (compiler.py:164) records a 'data' mesh
+    axis on the program, which makes the Executor compile the block over
+    all visible devices with the feed batch sharded (the ParallelExecutor
+    SSA-graph role, parallel_executor.h:51)."""
 
     def __init__(self, program, build_strategy=None):
         self._program = program
@@ -59,6 +62,15 @@ class CompiledProgram:
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
         self._loss_name = loss_name
+        from ..distributed.fleet.meta_optimizers.meta_optimizer_base import (
+            record_mesh_axis,
+        )
+
+        # record on the WRAPPER (instance attr wins over __getattr__
+        # delegation): running the bare program afterwards stays
+        # single-device, matching the reference where only the
+        # CompiledProgram handle is data-parallel (compiler.py:164)
+        record_mesh_axis(self, "data", len(places) if places else None)
         return self
 
     def __getattr__(self, item):

@@ -60,10 +60,17 @@ class CompiledBlock:
     the compiled computation.
     """
 
-    def __init__(self, program, feed_names, fetch_names, scope):
+    def __init__(self, program, feed_names, fetch_names, scope, mesh=None):
         self.program = program
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        # GSPMD mode (ParallelExecutor role, parallel_executor.h:51): with a
+        # mesh, the block jits with in/out shardings from each var's
+        # dist_spec + batch-sharded feeds; XLA partitions the global-
+        # semantics program and inserts the ICI collectives the fleet
+        # marker ops (c_allreduce_sum/c_broadcast/...) stand for.
+        self.mesh = mesh
+        self._in_shardings = None
         block = program.global_block()
         self.param_names = [
             n for n, v in block.vars.items()
@@ -88,6 +95,12 @@ class CompiledBlock:
         use, so a shape/dtype multiset check gates the donation plan."""
         if self._jitted is not None:
             return
+        if self.mesh is not None:
+            in_sh, out_sh = self._build_shardings(feeds, params)
+            self._in_shardings = in_sh
+            self._jitted = jax.jit(self._run_block, in_shardings=in_sh,
+                                   out_shardings=out_sh)
+            return
         donate = False
         if self._donate_feeds and feeds:
             try:
@@ -108,6 +121,38 @@ class CompiledBlock:
             self._jitted = jax.jit(self._run_block, donate_argnums=(0,))
         else:
             self._jitted = jax.jit(self._run_block)
+
+    def _build_shardings(self, feeds, params):
+        """GSPMD placement: feeds shard their batch dim over the data-like
+        axes; every persistable var follows its dist_spec (TP column/row
+        specs from `distributed.split` call sites, ZeRO range-sharding from
+        the sharding meta-opt); fetches come back replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.hybrid import _clean_spec
+
+        mesh = self.mesh
+        batch_axes = tuple(a for a in ("data", "sharding")
+                           if a in mesh.axis_names and mesh.shape[a] > 1)
+        bsize = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+            if batch_axes else 1
+        block = self.program.global_block()
+        feed_sh = {}
+        for n, v in feeds.items():
+            if batch_axes and v.ndim >= 1 and v.shape[0] % bsize == 0:
+                spec = P(batch_axes if len(batch_axes) > 1 else batch_axes[0])
+            else:
+                spec = P()
+            feed_sh[n] = NamedSharding(mesh, spec)
+        param_sh = {}
+        for n, v in params.items():
+            var = block.vars.get(n)
+            spec = _clean_spec(getattr(var, "dist_spec", None), mesh,
+                               tuple(getattr(v, "shape", ())))
+            param_sh[n] = NamedSharding(mesh, spec)
+        rep = NamedSharding(mesh, P())
+        out_sh = (tuple(rep for _ in self.fetch_names), dict(param_sh), rep)
+        return (feed_sh, param_sh), out_sh
 
     def _plan(self, block):
         """Native pruning + scheduling; graceful pure-Python fallback."""
@@ -190,7 +235,7 @@ class CompiledBlock:
             n: env[n] for n in self.param_names if n in env
         }, mask
 
-    def run(self, feed, scope):
+    def _coerce_feeds(self, feed):
         feeds = {}
         for n in self.feed_names:
             if n not in feed:
@@ -203,8 +248,20 @@ class CompiledBlock:
             if isinstance(v, Tensor):
                 v = v._data
             feeds[n] = jnp.asarray(np.asarray(v))
+        return feeds
+
+    def run(self, feed, scope):
+        feeds = self._coerce_feeds(feed)
         params = {n: scope.get(n) for n in self.param_names}
         self._ensure_jitted(feeds, params)
+        if self._in_shardings is not None:
+            # place inputs on the mesh (committed single-device arrays from
+            # startup would otherwise conflict with the jit's in_shardings)
+            feed_sh, param_sh = self._in_shardings
+            feeds = {n: jax.device_put(v, feed_sh[n])
+                     for n, v in feeds.items()}
+            params = {n: jax.device_put(v, param_sh[n])
+                      for n, v in params.items()}
         try:
             outs, updated, nonfinite = self._jitted(feeds, params)
         except KeyError as e:
@@ -232,19 +289,13 @@ class CompiledBlock:
         """XLA cost analysis of the compiled block ('flops', 'bytes
         accessed', ...) or None; bench.py uses this instead of a hand
         FLOPs model (op_tester.cc role)."""
-        feeds = {}
-        for n in self.feed_names:
-            v = feed[n]
-            if isinstance(v, Tensor):
-                v = v._data
-            feeds[n] = jnp.asarray(np.asarray(v))
+        from ..core.device import lowered_cost_stats
+
+        feeds = self._coerce_feeds(feed)
         params = {n: scope.get(n) for n in self.param_names}
         self._ensure_jitted(feeds, params)
         try:
-            ca = self._jitted.lower(feeds, params).cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0] if ca else None
-            return dict(ca) if ca else None
+            return lowered_cost_stats(self._jitted.lower(feeds, params))
         except Exception:
             return None
 
@@ -253,6 +304,42 @@ class Executor:
     def __init__(self, place=None):
         self.place = place or current_place()
         self._cache = {}
+        self._meshes = {}
+
+    def _resolve_mesh(self, program):
+        """Build the device mesh a fleet-rewritten program asked for
+        (`program._mesh_axes`, set via record_mesh_axis).  Degree-None
+        axes absorb the devices no fixed axis claims.  When the fixed
+        degrees don't fit the visible devices the program degrades to
+        single-device execution — the math is global-semantics either
+        way, only the partitioning changes."""
+        axes = getattr(program, "_mesh_axes", None)
+        if not axes:
+            return None
+        n = len(jax.devices())
+        fixed = {k: int(v) for k, v in axes.items() if v}
+        prod = int(np.prod(list(fixed.values()))) if fixed else 1
+        if prod > n or n % prod:
+            return None
+        resolved = dict(fixed)
+        free = [k for k, v in axes.items() if not v]
+        if free:
+            resolved[free[0]] = n // prod
+            for k in free[1:]:
+                resolved[k] = 1
+        if int(np.prod(list(resolved.values()))) <= 1:
+            return None
+        key = tuple(sorted(resolved.items()))
+        mesh = self._meshes.get(key)
+        if mesh is None:
+            from ..parallel.env import build_mesh
+
+            # batch-like axes lead so model/pipe land on adjacent chips
+            rank = {"data": 0, "sharding": 1, "pipe": 2, "model": 3}
+            order = sorted(resolved, key=lambda k: (rank.get(k, 4), k))
+            mesh = build_mesh({k: resolved[k] for k in order})
+            self._meshes[key] = mesh
+        return mesh
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
@@ -287,10 +374,13 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f)
             for f in (fetch_list or [])
         ]
-        key = self._cache_key(program, feed, fetch_names)
+        mesh = self._resolve_mesh(program)
+        key = self._cache_key(program, feed, fetch_names) + (
+            tuple(mesh.shape.items()) if mesh is not None else None,)
         cb = self._cache.get(key)
         if cb is None:
-            cb = CompiledBlock(program, feed.keys(), fetch_names, scope)
+            cb = CompiledBlock(program, feed.keys(), fetch_names, scope,
+                               mesh=mesh)
             self._cache[key] = cb
         return cb
 
