@@ -524,6 +524,18 @@ class CompiledTrainStep:
             self.optimizer._lr.step()
         return _wrap_data(loss)
 
+    def _lowered(self, *batch):
+        vals = tuple(
+            b._data if isinstance(b, Tensor) else jnp.asarray(b)
+            for b in batch
+        )
+        if self._jit_step is None:
+            self._jit_step = self._build(vals)
+        key = jax.random.fold_in(_random.get_rng_state(), 0)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        return self._jit_step.lower(
+            self.params, self.flat_opt_state, vals, key, lr)
+
     def cost_analysis(self, *batch):
         """XLA cost analysis of the compiled step (the reference's
         operators/benchmark/op_tester.cc role, but for the whole fused
@@ -531,20 +543,17 @@ class CompiledTrainStep:
         'flops', 'bytes accessed') or None when the backend can't say.
         Measured FLOPs from here beat hand 2*N*tokens models: embedding
         lookups aren't counted as matmuls and remat FLOPs are included.
-        """
-        try:
-            vals = tuple(
-                b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                for b in batch
-            )
-            if self._jit_step is None:
-                self._jit_step = self._build(vals)
-            key = jax.random.fold_in(_random.get_rng_state(), 0)
-            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-            from ..core.device import lowered_cost_stats
+        Build errors (bad mesh/spec) propagate — they would fail step()
+        identically."""
+        from ..core.device import lowered_cost_stats
 
-            return lowered_cost_stats(self._jit_step.lower(
-                self.params, self.flat_opt_state, vals, key, lr))
+        return lowered_cost_stats(self._lowered(*batch))
+
+    def memory_analysis(self, *batch):
+        """CompiledMemoryStats of the fused step (peak/temp HBM), or None
+        when the backend can't report it."""
+        try:
+            return self._lowered(*batch).compile().memory_analysis()
         except Exception:
             return None
 

@@ -454,6 +454,37 @@ class PipelinedTrainStep:
             self.optimizer._lr.step()
         return _wrap_data(loss)
 
+    def _lowered(self, ids, labels):
+        iv = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        lv = labels._data if isinstance(labels, Tensor) else \
+            jnp.asarray(labels)
+        if self._jit_step is None:
+            self._jit_step = self._build(iv, lv)
+        key = jax.random.fold_in(_random.get_rng_state(), 0)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        return self._jit_step.lower(
+            self.other_params, self.block_params, self._opt_state["other"],
+            self._opt_state["block"], iv, lv, key, lr)
+
+    def cost_analysis(self, ids, labels):
+        """XLA cost stats of the compiled pipelined step, or None."""
+        from ..core.device import lowered_cost_stats
+
+        try:
+            return lowered_cost_stats(self._lowered(ids, labels))
+        except Exception:
+            return None
+
+    def memory_analysis(self, ids, labels):
+        """CompiledMemoryStats of the pipelined step; temp_size_in_bytes is
+        the activation+workspace footprint — the quantity the GPipe+remat
+        vs 1F1B tradeoff is about (section_worker.cc:167-183 context; the
+        measured numbers live in docs/PERF.md)."""
+        try:
+            return self._lowered(ids, labels).compile().memory_analysis()
+        except Exception:
+            return None
+
     def sync_to_model(self):
         for n, v in self.other_params.items():
             self.plan.other[n]._data = v

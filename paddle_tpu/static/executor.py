@@ -256,11 +256,14 @@ class CompiledBlock:
         self._ensure_jitted(feeds, params)
         if self._in_shardings is not None:
             # place inputs on the mesh (committed single-device arrays from
-            # startup would otherwise conflict with the jit's in_shardings)
+            # startup would otherwise conflict with the jit's in_shardings);
+            # after step 1 the scope holds jit outputs already placed by
+            # out_shardings, so matching arrays pass through untouched
             feed_sh, param_sh = self._in_shardings
             feeds = {n: jax.device_put(v, feed_sh[n])
                      for n, v in feeds.items()}
-            params = {n: jax.device_put(v, param_sh[n])
+            params = {n: v if getattr(v, "sharding", None) == param_sh[n]
+                      else jax.device_put(v, param_sh[n])
                       for n, v in params.items()}
         try:
             outs, updated, nonfinite = self._jitted(feeds, params)

@@ -165,3 +165,36 @@ def test_pp_opt_state_zero_sharded():
         l1 = float(np.asarray(tr.step(paddle.to_tensor(ids),
                                       paddle.to_tensor(lbl))._data))
     assert l1 < l0
+
+
+def test_remat_bounds_pipeline_activation_memory():
+    """VERDICT r2 #3 (measured honesty): the docstring claims per-block
+    remat provides the 1F1B-class activation-memory bound compiler-side.
+    Assert it: remat=True compiles to a strictly smaller temp (activation
+    + workspace) footprint than remat=False at identical loss.  Full
+    numbers: tools/pipeline_tradeoff.py -> docs/PERF.md."""
+    rng = np.random.RandomState(0)
+    cfg = gpt_tiny()
+    cfg.num_layers = 4
+    ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    lbl = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+
+    stats = {}
+    for remat in (False, True):
+        paddle.seed(0)
+        model = GPTForPretraining(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        mesh = build_mesh({"pipe": 2, "data": 2})
+        tr = PipelinedTrainStep(GPTPipeAdapter(model), opt, mesh,
+                                num_micro=4, remat=remat)
+        ma = tr.memory_analysis(ids, lbl)
+        if ma is None:
+            pytest.skip("backend reports no memory analysis")
+        loss = float(np.asarray(tr.step(paddle.to_tensor(ids),
+                                        paddle.to_tensor(lbl))._data))
+        stats[remat] = (ma.temp_size_in_bytes, loss)
+
+    assert stats[True][0] < stats[False][0], stats
+    np.testing.assert_allclose(stats[True][1], stats[False][1],
+                               rtol=1e-5)
