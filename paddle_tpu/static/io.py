@@ -103,6 +103,11 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
+    """Returns [inference_program, feed_names, fetch_names] like the
+    reference (io.py:1459): the program is REBUILT from the serialized
+    JSON ProgramDesc (builders + embedded per-op StableHLO — no Python
+    model source needed) and its params land in the global scope.  Falls
+    back to the raw meta dict when the desc has non-rebuildable ops."""
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
     with open(path_prefix + ".pdiparams", "rb") as f:
@@ -110,4 +115,13 @@ def load_inference_model(path_prefix, executor, **kwargs):
     scope = global_scope()
     for name, arr in params.items():
         scope.set(name, jnp.asarray(arr))
-    return meta, meta["feed_names"], meta["fetch_names"]
+    from ..core.errors import UnimplementedError
+    from .desc import load_program
+
+    try:
+        program = load_program(path_prefix + ".pdmodel.json")
+    except FileNotFoundError:
+        program = meta  # pre-desc artifact: raw meta dict
+    except UnimplementedError:
+        program = meta  # desc carries non-rebuildable ops (documented)
+    return program, meta["feed_names"], meta["fetch_names"]

@@ -199,6 +199,23 @@ def softmax(x, axis=-1, name=None):
                 attrs={"axis": axis})
 
 
+def transpose(x, perm, name=None):
+    """fluid.layers.transpose parity (transpose2 op) — needed to compose
+    attention statically (nn/layer/transformer.py:406 does q/k/v transposes
+    through this op in static mode)."""
+    perm = [int(p) for p in perm]
+    shape = [x.shape[p] for p in perm] if x.shape else x.shape
+    return emit("transpose2", [("X", x)], [("Out", shape, x.dtype)],
+                lambda v: jnp.transpose(v, perm), attrs={"axis": perm})
+
+
+def gelu(x, approximate=False, name=None):
+    """fluid.layers.gelu parity (operators/gelu_op.cc)."""
+    return emit("gelu", [("X", x)], [("Out", x.shape, x.dtype)],
+                lambda v: jax.nn.gelu(v, approximate=approximate),
+                attrs={"approximate": bool(approximate)})
+
+
 def mean(x, name=None):
     return emit("reduce_mean", [("X", x)], [("Out", [1], x.dtype)],
                 lambda v: jnp.mean(v)[None])

@@ -11,6 +11,9 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# CI is strict: a dryrun leg failure fails the test run (the driver gate
+# stays non-strict so extra legs can't redden a green primary leg)
+os.environ.setdefault("PTN_DRYRUN_STRICT", "1")
 
 import jax
 

@@ -1,0 +1,165 @@
+"""Full-program desc serialization round-trips (VERDICT r2 missing #4).
+
+The reference serializes every op (framework.proto:43-207).  Here any op
+whose fn traces with concrete shapes serializes — builders for the core
+set, embedded per-op StableHLO for the rest (incl. vjp grad closures and
+optimizer updates).  The done-bar: ResNet-50 and an ERNIE-style encoder
+round-trip save_inference_model -> load -> run IN A FRESH PROCESS with no
+Python model source, outputs bit-equal.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static.desc import program_to_desc, desc_to_program
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_FRESH_RUNNER = r"""
+import sys, json
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import paddle_tpu.static as static
+
+prefix, feed_npz, out_npy = sys.argv[1], sys.argv[2], sys.argv[3]
+exe = static.Executor()
+program, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+assert isinstance(program, static.Program), type(program)
+feeds = dict(np.load(feed_npz))
+outs = exe.run(program, feed={{n: feeds[n] for n in feed_names}},
+               fetch_list=fetch_names)
+np.save(out_npy, outs[0])
+print("FRESH OK")
+"""
+
+
+def _roundtrip_fresh_process(tmp_path, main, startup, feed_vars, fetch_vars,
+                             feeds):
+    exe = static.Executor()
+    exe.run(startup)
+    # save BEFORE the reference run: a training program's update ops mutate
+    # params during the run, and the artifact must match the weights the
+    # expected forward used
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, feed_vars, fetch_vars, exe,
+                                program=main)
+    expected = exe.run(main, feed=feeds,
+                       fetch_list=[v.name for v in fetch_vars])[0]
+    feed_npz = str(tmp_path / "feeds.npz")
+    out_npy = str(tmp_path / "out.npy")
+    np.savez(feed_npz, **feeds)
+    proc = subprocess.run(
+        [sys.executable, "-c", _FRESH_RUNNER.format(repo=REPO),
+         prefix, feed_npz, out_npy],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    got = np.load(out_npy)
+    np.testing.assert_array_equal(got, expected)  # bit-equal
+
+
+def _ernie_encoder(x_ids, hidden=32, heads=2, seq=8, vocab=64):
+    """ERNIE-style encoder block, statically composed (embedding + MHA via
+    transpose/matmul/softmax + gelu FFN + layer_norm residuals) — the op
+    mix whose desc rebuild rides embedded StableHLO (transpose2, gelu)
+    alongside builders (embedding, layer_norm, matmul, softmax, fc)."""
+    nn = static.nn
+    from paddle_tpu.static import create_parameter
+
+    def proj(t, dout):
+        # per-token projection (fc flattens trailing dims, paddle-style)
+        w = create_parameter([int(t.shape[-1]), dout], "float32")
+        return nn.matmul(t, w)
+
+    h = nn.embedding(x_ids, size=[vocab, hidden])
+    q, k, v = proj(h, hidden), proj(h, hidden), proj(h, hidden)
+
+    def split_heads(t):
+        t = nn.reshape(t, [-1, seq, heads, hidden // heads])
+        return nn.transpose(t, [0, 2, 1, 3])
+
+    qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+    scores = nn.matmul(qh, kh, transpose_y=True,
+                       alpha=1.0 / (hidden // heads) ** 0.5)
+    probs = nn.softmax(scores, axis=-1)
+    ctx = nn.matmul(probs, vh)
+    ctx = nn.transpose(ctx, [0, 2, 1, 3])
+    ctx = nn.reshape(ctx, [-1, seq, hidden])
+    attn_out = proj(ctx, hidden)
+    h = nn.layer_norm(h + attn_out, begin_norm_axis=2)
+    ffn = proj(nn.gelu(proj(h, hidden * 4)), hidden)
+    h = nn.layer_norm(h + ffn, begin_norm_axis=2)
+    return nn.tanh_act(proj(h, hidden))
+
+
+def test_training_program_roundtrips_bit_equal():
+    """Grad + optimizer-update closures serialize via embedded StableHLO:
+    a rebuilt TRAINING program steps bit-identically to the original."""
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 16])
+        y = static.data("y", [8, 1])
+        h = static.nn.relu(static.nn.fc(x, 16))
+        out = static.nn.fc(h, 1)
+        loss = static.nn.mean((out - y) * (out - y))
+        paddle.optimizer.Momentum(learning_rate=0.1,
+                                  momentum=0.9).minimize(loss)
+    desc = program_to_desc(main)
+    assert all(o["rebuildable"] for o in desc["ops"]), [
+        o["type"] for o in desc["ops"] if not o["rebuildable"]]
+    prog2 = desc_to_program(desc)
+
+    exe = static.Executor()
+    s1, s2 = static.Scope(), static.Scope()
+    exe.run(startup, scope=s1)
+    for n in s1.names():
+        s2.set(n, s1.get(n))
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 16).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    for _ in range(3):
+        l1 = exe.run(main, feed=feed, fetch_list=[loss], scope=s1)[0]
+        l2 = exe.run(prog2, feed=feed, fetch_list=[loss.name], scope=s2)[0]
+        np.testing.assert_array_equal(l1, l2)
+
+
+def test_resnet50_inference_roundtrip_fresh_process(tmp_path):
+    from bench import _build_static_resnet50
+
+    paddle.seed(0)
+    main, startup, loss, _ = _build_static_resnet50(static, batch=2)
+    block = main.global_block()
+    img = block.vars["image"]
+    # fetch the logits producer (pre-loss), the inference output
+    rng = np.random.RandomState(0)
+    feeds = {"image": rng.rand(2, 3, 224, 224).astype(np.float32),
+             "label": rng.randint(0, 1000, (2, 1)).astype(np.int64)}
+    _roundtrip_fresh_process(tmp_path, main, startup,
+                             [img, block.vars["label"]], [loss], feeds)
+
+
+def test_ernie_style_inference_roundtrip_fresh_process(tmp_path):
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [4, 8], dtype="int64")
+        pooled = _ernie_encoder(ids)
+    desc = program_to_desc(main)
+    # the MHA transposes + gelu have no builders: embedded HLO must carry
+    hlo_types = {o["type"] for o in desc["ops"] if "hlo" in o}
+    assert "transpose2" in hlo_types and "gelu" in hlo_types, hlo_types
+    assert all(o["rebuildable"] for o in desc["ops"]), [
+        o["type"] for o in desc["ops"] if not o["rebuildable"]]
+    rng = np.random.RandomState(0)
+    feeds = {"ids": rng.randint(0, 64, (4, 8)).astype(np.int64)}
+    _roundtrip_fresh_process(tmp_path, main, startup,
+                             [main.global_block().vars["ids"]], [pooled],
+                             feeds)

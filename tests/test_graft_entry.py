@@ -43,7 +43,18 @@ def test_dryrun_multichip_subprocess_from_clean_env():
     proc = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert "OK" in proc.stdout
+    # per-leg machine-checkable status lines (VERDICT r2 #7)
+    legs = {}
+    for ln in proc.stdout.splitlines():
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "leg" in rec:
+            legs[rec["leg"]] = rec["ok"]
+    assert legs.get("zero3_dp_tp_sp") is True, proc.stdout
+    for leg, ok in legs.items():
+        assert ok, f"leg {leg} failed: {proc.stdout}"
 
 
 def test_bench_prints_one_json_line():

@@ -134,7 +134,12 @@ def test_unknown_op_type_raises_on_load():
             emit("my_custom_closure_op", [("X", x)],
                  [("Out", [2], "float32")], lambda v: v * 2)
         desc = program_to_desc(main)
-        assert not desc["ops"][-1]["rebuildable"]
+        # closures now serialize via embedded StableHLO; an artifact whose
+        # hlo payload is absent (old/foreign producer) must still raise
+        # with the builder list at load, not fail silently
+        assert desc["ops"][-1]["rebuildable"] and "hlo" in desc["ops"][-1]
+        desc["ops"][-1].pop("hlo")
+        desc["ops"][-1]["rebuildable"] = False
         with pytest.raises(UnimplementedError, match="my_custom_closure_op"):
             desc_to_program(desc)
     finally:
