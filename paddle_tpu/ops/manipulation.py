@@ -10,6 +10,10 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import eager_op
+
+# the public paddle.slice below shadows the builtin in this module's
+# namespace; keep a handle for index construction
+_pyslice = slice
 from ..core.tensor import Tensor, to_tensor, _wrap_data
 from ..core.dtype import convert_dtype
 
@@ -156,9 +160,9 @@ def unbind(x, axis=0):
 
 @eager_op("slice_op")
 def _slice(x, axes=None, starts=None, ends=None):
-    idx = [slice(None)] * x.ndim
+    idx = [_pyslice(None)] * x.ndim
     for ax, st, en in zip(axes, starts, ends):
-        idx[ax] = slice(st, en)
+        idx[ax] = _pyslice(st, en)
     return x[tuple(idx)]
 
 
@@ -170,9 +174,9 @@ def slice(x, axes, starts, ends):
 
 @eager_op("strided_slice_op")
 def _strided_slice(x, axes=None, starts=None, ends=None, strides=None):
-    idx = [slice(None)] * x.ndim
+    idx = [_pyslice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
-        idx[ax] = slice(st, en, sd)
+        idx[ax] = _pyslice(st, en, sd)
     return x[tuple(idx)]
 
 

@@ -12,10 +12,11 @@ op (framework.proto:43-207).  TPU-native, two rebuild mechanisms:
    grad/update closures from append_backward and the whole static.nn
    emitter surface are desc-rebuildable too, and a loaded program
    trains/infers bit-equal with no Python model source (VERDICT r2
-   missing #4).  Unknown (-1) dims export as ONE shared symbolic dim
-   ('b' — paddle programs use -1 to mean the batch), so batch-polymorphic
-   forwards serialize; an op whose fn cannot trace (and has no builder)
-   is the only thing that still raises at load, with the builder list.
+   missing #4).  Unknown (-1) leading dims share one symbolic dim 'b'
+   (the batch), so batch-polymorphic forwards serialize; other unknown
+   dims get per-position symbols.  An op whose fn cannot trace under
+   those symbols (and has no builder) stays non-rebuildable and raises
+   at load with the builder list.
 """
 import base64
 import json
@@ -112,29 +113,41 @@ def _try_export_op(op, block):
 
     from ..core.dtype import convert_dtype
 
-    sym = None
+    syms = {}
+    scope = []  # one SymbolicScope per op: symbols must share it
+
+    def _sym(key):
+        if key not in syms:
+            if not scope:
+                scope.append(jax_export.SymbolicScope())
+            (syms[key],) = jax_export.symbolic_shape(key, scope=scope[0])
+        return syms[key]
+
     avals = []
-    for n in getattr(op, "in_order", op.input_names()):
-        v = block.vars.get(n)
-        if v is None:
-            return None
-        shape = list(v.shape) if v.shape else []
-        dims = []
-        for d in shape:
-            if isinstance(d, (int, np.integer)) and d > 0:
-                dims.append(int(d))
-            else:
-                if sym is None:
-                    try:
-                        (sym,) = jax_export.symbolic_shape("b")
-                    except Exception:
-                        return None
-                dims.append(sym)
-        try:
+    try:
+        for vi, n in enumerate(getattr(op, "in_order", op.input_names())):
+            v = block.vars.get(n)
+            if v is None:
+                return None
+            shape = list(v.shape) if v.shape else []
+            dims = []
+            for di, d in enumerate(shape):
+                if isinstance(d, (int, np.integer)) and d > 0:
+                    dims.append(int(d))
+                elif di == 0:
+                    # leading unknown dims are the batch and must agree
+                    # across inputs: one shared symbol
+                    dims.append(_sym("b"))
+                else:
+                    # non-leading unknown dims get their own symbol; ops
+                    # that require them equal fail the export below and
+                    # stay honestly non-rebuildable instead of baking a
+                    # false equality into the artifact
+                    dims.append(_sym(f"d{vi}_{di}"))
             dt = np.dtype(convert_dtype(v.dtype))
-        except Exception:
-            return None
-        avals.append(jax.ShapeDtypeStruct(tuple(dims), dt))
+            avals.append(jax.ShapeDtypeStruct(tuple(dims), dt))
+    except Exception:
+        return None
     try:
         try:
             exp = jax_export.export(jax.jit(op.fn),

