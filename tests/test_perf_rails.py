@@ -1,0 +1,65 @@
+"""CPU perf rails regression gate (VERDICT r2 #6).
+
+BENCH_CPU_RAILS.json (committed, refreshed via tools/cpu_rails.py) holds
+jitted op latencies and compile-time rails measured on CPU.  This test
+re-measures and fails on >2x regressions — the perf signal that works
+when the TPU pool is down.  Margins: jitted op latencies compare against
+max(committed, 200us) to stay out of the scheduler-noise domain;
+compile rails compare directly (they are seconds-scale and stable).
+"""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RAILS = os.path.join(REPO, "BENCH_CPU_RAILS.json")
+
+
+@pytest.fixture(scope="module")
+def rails():
+    if not os.path.exists(RAILS):
+        pytest.skip("no committed rails (run tools/cpu_rails.py)")
+    with open(RAILS) as f:
+        return json.load(f)
+
+
+def test_op_latency_rails(rails):
+    from tools.cpu_rails import measure_ops
+
+    got = measure_ops(repeat_scale=0.5)
+    bad = []
+    for op, rec in rails["ops"].items():
+        want = rec.get("jit_us")
+        have = got.get(op, {}).get("jit_us")
+        if want is None or have is None:
+            continue
+        limit = 2.0 * max(want, 200.0)
+        if have > limit:
+            bad.append(f"{op}: {have:.0f}us > 2x committed {want:.0f}us")
+    assert not bad, "jitted op latency regressions: " + "; ".join(bad)
+
+
+def test_compile_time_rails(rails):
+    from tools.cpu_rails import time_to_first_step
+
+    checks = {
+        "bert12_scan_s": lambda: time_to_first_step("bert", True),
+        "bert12_noscan_s": lambda: time_to_first_step("bert", False),
+        "gpt12_scan_s": lambda: time_to_first_step("gpt", True),
+    }
+    bad = []
+    for key, fn in checks.items():
+        want = rails["compile"].get(key)
+        if want is None:
+            continue
+        have = fn()
+        # 2.5x with a 5s floor: absolute wall-clock numbers cross machines
+        # of different speeds, so the gate needs headroom beyond the 2x a
+        # same-machine regression would show
+        if have > 2.5 * max(want, 5.0):
+            bad.append(f"{key}: {have:.1f}s > 2.5x committed {want:.1f}s")
+    assert not bad, "compile-time regressions: " + "; ".join(bad)

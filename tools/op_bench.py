@@ -56,7 +56,15 @@ def _resolve(op_name):
         return lambda x, w: F.conv2d(x, w, None, padding=1)
     fn = getattr(paddle, op_name, None) or getattr(F, op_name, None)
     if fn is None:
-        raise SystemExit(f"unknown op {op_name!r}")
+        # reference registry names (reduce_sum, ...) live in _C_ops
+        from paddle_tpu import _C_ops
+
+        try:
+            fn = getattr(_C_ops, op_name)
+        except NotImplementedError as e:
+            raise SystemExit(str(e)) from e  # absent-with-rationale
+        except AttributeError:
+            raise SystemExit(f"unknown op {op_name!r}")
     return fn
 
 
