@@ -1,12 +1,18 @@
 """Profiler.
 
 Reference parity: platform/profiler.{h,cc} (RecordEvent, EnableProfiler:213,
-chrome-trace export) + fluid/profiler.py context manager.  TPU-native: host
-spans via RecordEvent (summary table like the reference's) and device traces
-via jax.profiler (XLA/TPU timelines, Perfetto/TensorBoard viewable) — the CUPTI
-role (SURVEY §5.1) is played by the PJRT profiler.
+sorted per-event summary table + chrome-trace export via profiler.proto) +
+fluid/profiler.py context manager.  TPU-native: host spans via RecordEvent
+(summary table matches the reference's columns: Calls/Total/Min/Max/Ave/
+Ratio, sorted_key in {default,calls,total,max,min,ave}), chrome-trace JSON
+written to profile_path (the reference serializes a proto; chrome://tracing
+and Perfetto load this JSON directly), and device traces via jax.profiler
+(XLA/TPU timelines) — the CUPTI role (SURVEY §5.1) is played by the PJRT
+profiler.
 """
 import contextlib
+import json
+import os
 import threading
 import time
 from collections import defaultdict
@@ -14,9 +20,13 @@ from collections import defaultdict
 import jax
 
 _state = threading.local()
-_records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_seconds]
+# name -> [count, total_s, min_s, max_s]
+_records = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+_events = []  # (name, tid, start_s, dur_s) for chrome-trace export
+_MAX_EVENTS = 200_000
 _enabled = [False]
 _trace_dir = [None]
+_t_origin = [0.0]
 
 
 class RecordEvent:
@@ -43,6 +53,11 @@ class RecordEvent:
             rec = _records[self.name]
             rec[0] += 1
             rec[1] += dt
+            rec[2] = min(rec[2], dt)
+            rec[3] = max(rec[3], dt)
+            if len(_events) < _MAX_EVENTS:
+                _events.append((self.name, threading.get_ident(),
+                                self._t0 - _t_origin[0], dt))
             if self._jax_ctx is not None:
                 self._jax_ctx.__exit__(None, None, None)
             self._t0 = None
@@ -55,35 +70,86 @@ class RecordEvent:
 def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     _enabled[0] = True
     _records.clear()
+    _events.clear()
+    _t_origin[0] = time.perf_counter()
     if trace_dir:
         _trace_dir[0] = trace_dir
         jax.profiler.start_trace(trace_dir)
 
 
-def stop_profiler(sorted_key="total", profile_path=None):
+def stop_profiler(sorted_key="default", profile_path=None):
+    """EnableProfiler teardown parity (profiler.h:213-216): print the
+    sorted summary table and, when profile_path is given, dump the span
+    timeline as chrome-trace JSON (chrome://tracing / Perfetto)."""
     _enabled[0] = False
     if _trace_dir[0]:
         jax.profiler.stop_trace()
         _trace_dir[0] = None
+    if profile_path:
+        export_chrome_trace(profile_path)
     return summary(sorted_key)
 
 
-def summary(sorted_key="total"):
-    rows = sorted(
-        ((name, cnt, tot, tot / cnt if cnt else 0.0)
-         for name, (cnt, tot) in _records.items()),
-        key=lambda r: -r[2],
-    )
-    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
-    for name, cnt, tot, avg in rows:
-        lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}{avg * 1e3:>12.3f}")
+_SORT = {
+    "default": lambda r: 0,          # insertion order, like the reference
+    "calls": lambda r: -r[1],
+    "total": lambda r: -r[2],
+    "max": lambda r: -r[4],
+    "min": lambda r: -r[3],
+    "ave": lambda r: -r[5],
+}
+
+
+def summary(sorted_key="default"):
+    """Sorted per-event table with the reference's columns
+    (platform/profiler.cc PrintProfiler): Calls, Total, Min, Max, Ave,
+    Ratio (share of the summed span time)."""
+    if sorted_key not in _SORT:
+        raise ValueError(
+            f"sorted_key must be one of {sorted(_SORT)}, got {sorted_key!r}")
+    grand = sum(r[1] for r in _records.values()) or 1.0
+    rows = [
+        (name, cnt, tot, mn if cnt else 0.0, mx,
+         tot / cnt if cnt else 0.0, tot / grand)
+        for name, (cnt, tot, mn, mx) in _records.items()
+    ]
+    rows.sort(key=_SORT[sorted_key])
+    head = (f"{'Event':<36}{'Calls':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+            f"{'Max(ms)':>10}{'Ave(ms)':>10}{'Ratio':>8}")
+    lines = ["-------------------------  Profiling Report  "
+             "-------------------------", head]
+    for name, cnt, tot, mn, mx, avg, ratio in rows:
+        lines.append(
+            f"{name:<36}{cnt:>8}{tot * 1e3:>12.3f}{mn * 1e3:>10.3f}"
+            f"{mx * 1e3:>10.3f}{avg * 1e3:>10.3f}{ratio:>8.3f}")
     report = "\n".join(lines)
     print(report)
     return report
 
 
+def export_chrome_trace(path):
+    """Write recorded spans in chrome-trace 'traceEvents' JSON (the role
+    of the reference's profiler.proto dump, directly loadable by
+    chrome://tracing and Perfetto)."""
+    trace = {
+        "traceEvents": [
+            {"name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
+             "ts": round(start * 1e6, 3), "dur": round(dur * 1e6, 3),
+             "cat": "host"}
+            for name, tid, start, dur in _events
+        ],
+        "displayTimeUnit": "ms",
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
 @contextlib.contextmanager
-def profiler(state="All", sorted_key="total", profile_path=None, trace_dir=None):
+def profiler(state="All", sorted_key="default", profile_path=None,
+             trace_dir=None):
     """fluid/profiler.py:314 context-manager parity."""
     start_profiler(state, trace_dir=trace_dir)
     try:
@@ -117,7 +183,10 @@ class Profiler:
         pass
 
     def summary(self, **kw):
-        return summary()
+        return summary(**kw)
+
+    def export_chrome_trace(self, path):
+        return export_chrome_trace(path)
 
 
 from .monitor import (  # noqa: E402,F401  (monitor.h StatRegistry parity)

@@ -22,6 +22,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # compile-rail tests run by default (they ARE the CPU perf gate) but
+    # are deselectable for quick local iteration: -m "not perf"
+    config.addinivalue_line(
+        "markers", "perf: perf-rail measurement (deselect with -m 'not perf')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_framework_state():
     yield
