@@ -30,18 +30,22 @@ def rails():
 def test_op_latency_rails(rails):
     from tools.cpu_rails import measure_ops
 
-    got = measure_ops(repeat_scale=0.5)
+    # two trials, per-op min: transient host load (bench probes, parallel
+    # jobs) inflates one trial, a real regression inflates both
+    trials = [measure_ops(repeat_scale=0.5), measure_ops(repeat_scale=0.5)]
     bad = []
     for op, rec in rails["ops"].items():
         want = rec.get("jit_us")
         if want is None:
             continue
-        have = got.get(op, {}).get("jit_us")
-        if have is None:
+        haves = [t.get(op, {}).get("jit_us") for t in trials]
+        haves = [h for h in haves if h is not None]
+        if not haves:
             # the committed rails could jit this op; losing that entirely
             # is the worst regression, not a skip
             bad.append(f"{op}: jit path broke (no measurement)")
             continue
+        have = min(haves)
         limit = 2.0 * max(want, 200.0)
         if have > limit:
             bad.append(f"{op}: {have:.0f}us > 2x committed {want:.0f}us")
