@@ -70,7 +70,11 @@ class TrainEpochRange:
     """
 
     def __init__(self, max_epoch_num, name, objs=None, checkpoint_path=None,
-                 save_checkpoint_inter=None, checker=None):
+                 save_checkpoint_inter=None, checker=None, read_only=False):
+        # read_only: restore + iterate but never persist — the non-zero
+        # ranks of a data-parallel job (state is replicated; only trainer
+        # 0 writes, the reference's save_persistables convention)
+        self._read_only = bool(read_only)
         self._checker = checker or AutoCheckpointChecker()
         self.name = name
         self.max_epoch_num = max_epoch_num
@@ -117,6 +121,8 @@ class TrainEpochRange:
 
     def save_checkpoint(self, epoch_no, force=True):
         now = time.time()
+        if self._read_only:
+            return False
         if not force and self._save_inter and \
                 now - self._last_save < self._save_inter:
             return False
@@ -151,14 +157,18 @@ class TrainEpochRange:
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None, name="ter",
-                      objs=None, checkpoint_path=None):
+                      objs=None, checkpoint_path=None, read_only=False):
     """auto_checkpoint.py:598 parity: `for epoch in train_epoch_range(N, ...)`.
 
     Extension over the reference: pass `objs={'model': m, 'opt': o}` to say
     what to snapshot (the reference hooks Executor.run globally; the eager
-    TPU path has no global executor to hook).
+    TPU path has no global executor to hook).  In a multi-rank job only
+    trainer 0 should persist: non-zero ranks pass read_only=True (they
+    restore + iterate but never write, so concurrent ranks can't race the
+    same checkpoint files).
     """
     r = TrainEpochRange(max_epoch_num, name, objs=objs,
                         checkpoint_path=checkpoint_path,
-                        save_checkpoint_inter=save_checkpoint_inter)
+                        save_checkpoint_inter=save_checkpoint_inter,
+                        read_only=read_only)
     return r.get()

@@ -13,36 +13,24 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def train_dp(out_path=None):
-    # exactly one local device per process: the parent test env carries an
-    # 8-device XLA_FLAGS, so override rather than setdefault
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+def build_fit_a_line(rank, n, mesh):
+    """Shared fixture: deterministic fit-a-line data (global batch 8,
+    sharded over ranks) + the jitted DP step.  Used by this trainer and
+    dist_preempt_trainer so the two stay one contract."""
     import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if n > 1:
-        jax.distributed.initialize(
-            coordinator_address=os.environ["PADDLE_MASTER"],
-            num_processes=n, process_id=rank)
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P, NamedSharding
 
-    from paddle_tpu.parallel.env import init_parallel_env, global_mesh
     from paddle_tpu.parallel.collective import shard_map
 
-    init_parallel_env()
-    mesh = global_mesh()
-
-    # deterministic fit-a-line data, global batch 8 sharded over ranks
     rng = np.random.RandomState(0)
     X = rng.rand(8, 3).astype(np.float32)
     Wt = rng.rand(3, 1).astype(np.float32)
     Y = X @ Wt + 0.1
     per = 8 // n
-    Xl, Yl = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+    Xl = X[rank * per:(rank + 1) * per]
+    Yl = Y[rank * per:(rank + 1) * per]
     sh = NamedSharding(mesh, P("data", None))
     if n > 1:
         xs = jax.make_array_from_process_local_data(sh, Xl)
@@ -65,6 +53,30 @@ def train_dp(out_path=None):
         local_step, mesh,
         in_specs=(P(), P(), P("data", None), P("data", None)),
         out_specs=(P(), P(), P())))
+    return xs, ys, step
+
+
+def train_dp(out_path=None):
+    # exactly one local device per process: the parent test env carries an
+    # 8-device XLA_FLAGS, so override rather than setdefault
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n > 1:
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PADDLE_MASTER"],
+            num_processes=n, process_id=rank)
+    import numpy as np
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.env import init_parallel_env, global_mesh
+
+    init_parallel_env()
+    mesh = global_mesh()
+    xs, ys, step = build_fit_a_line(rank, n, mesh)
     w = jnp.zeros((3, 1), jnp.float32)
     b = jnp.zeros((1,), jnp.float32)
     losses = []

@@ -1,0 +1,84 @@
+"""2-process DP trainer with auto-checkpoint, used by the preemption drill
+(VERDICT r2 #10: SIGKILL a worker mid-epoch, elastic restart, resume from
+checkpoint, loss continuity).
+
+Rank 0 persists state per epoch via TrainEpochRange; rank 1 participates
+read-only (replicated state, trainer-0-saves convention).  When
+PTN_KILL_AT_EPOCH is set, rank 1 SIGKILLs itself right after that epoch's
+step — after the collective, before the checkpoint — so the epoch's save
+is lost and durable state is the previous epoch.  Rank 0 appends each
+completed epoch's loss to the JSONL out file; concatenated across
+incarnations the sequence must equal an uninterrupted run's.
+"""
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _WB:
+    """state_dict holder for the fit-a-line weights."""
+
+    def __init__(self):
+        import numpy as np
+
+        self.w = np.zeros((3, 1), np.float32)
+        self.b = np.zeros((1,), np.float32)
+
+    def state_dict(self):
+        return {"w": self.w, "b": self.b}
+
+    def set_state_dict(self, st):
+        self.w, self.b = st["w"], st["b"]
+
+
+def train(ckpt_root, out_path, epochs=6):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "2"))
+    jax.distributed.initialize(
+        coordinator_address=os.environ["PADDLE_MASTER"],
+        num_processes=n, process_id=rank)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.env import init_parallel_env, global_mesh
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import (
+        TrainEpochRange,
+    )
+    from dist_dp_trainer import build_fit_a_line
+
+    init_parallel_env()
+    mesh = global_mesh()
+    xs, ys, step = build_fit_a_line(rank, n, mesh)
+
+    wb = _WB()
+    r = TrainEpochRange(epochs, "preempt", objs={"wb": wb},
+                        checkpoint_path=ckpt_root, save_checkpoint_inter=0,
+                        read_only=(rank != 0))
+    if r.restored_from is not None:
+        print(f"RESTORED {r.restored_from}", flush=True)
+    kill_at = os.environ.get("PTN_KILL_AT_EPOCH")
+    for epoch in r.get():
+        loss, w, b = step(jnp.asarray(wb.w), jnp.asarray(wb.b), xs, ys)
+        wb.w = np.asarray(w)
+        wb.b = np.asarray(b)
+        lv = float(np.asarray(loss))
+        if rank == 0 and out_path:
+            with open(out_path, "a") as f:
+                f.write(json.dumps({"epoch": epoch, "loss": lv}) + "\n")
+                f.flush()
+        if kill_at is not None and rank == 1 and epoch == int(kill_at):
+            # preemption: after the collective, before this epoch's save
+            os.kill(os.getpid(), signal.SIGKILL)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    train(sys.argv[1], sys.argv[2])

@@ -30,26 +30,31 @@ def rails():
 def test_op_latency_rails(rails):
     from tools.cpu_rails import measure_ops
 
-    # two trials, per-op min: transient host load (bench probes, parallel
-    # jobs) inflates one trial, a real regression inflates both
-    trials = [measure_ops(repeat_scale=0.5), measure_ops(repeat_scale=0.5)]
-    bad = []
-    for op, rec in rails["ops"].items():
-        want = rec.get("jit_us")
-        if want is None:
-            continue
-        haves = [t.get(op, {}).get("jit_us") for t in trials]
-        haves = [h for h in haves if h is not None]
-        if not haves:
-            # the committed rails could jit this op; losing that entirely
-            # is the worst regression, not a skip
-            bad.append(f"{op}: jit path broke (no measurement)")
-            continue
-        have = min(haves)
-        limit = 2.0 * max(want, 200.0)
-        if have > limit:
-            bad.append(f"{op}: {have:.0f}us > 2x committed {want:.0f}us")
-    assert not bad, "jitted op latency regressions: " + "; ".join(bad)
+    def violations(got):
+        bad = {}
+        for op, rec in rails["ops"].items():
+            want = rec.get("jit_us")
+            if want is None:
+                continue
+            have = got.get(op, {}).get("jit_us")
+            if have is None:
+                # the committed rails could jit this op; losing that
+                # entirely is the worst regression, not a skip
+                bad[op] = f"{op}: jit path broke (no measurement)"
+            elif have > 2.0 * max(want, 200.0):
+                bad[op] = (f"{op}: {have:.0f}us > 2x committed "
+                           f"{want:.0f}us")
+        return bad
+
+    bad = violations(measure_ops(repeat_scale=0.5))
+    if bad:
+        # one retry for the suspects only: transient host load (bench
+        # probes, parallel jobs) inflates a single trial, a real
+        # regression survives both
+        confirm = violations(measure_ops(repeat_scale=0.5))
+        bad = {op: msg for op, msg in bad.items() if op in confirm}
+    assert not bad, \
+        "jitted op latency regressions: " + "; ".join(bad.values())
 
 
 @pytest.mark.perf

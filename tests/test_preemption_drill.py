@@ -1,0 +1,109 @@
+"""End-to-end preemption drill (VERDICT r2 #10): SIGKILL a DP worker
+mid-epoch, detect it with the elastic launcher watchdog, tear down the
+survivors, relaunch, auto-resume from the checkpoint, and assert loss
+continuity — the §5.3 (elastic/failure) + §5.4 (checkpoint) story
+demonstrated as one flow instead of per-component.
+
+Ref anchors: fleet/elastic.py:99 (ElasticManager/LauncherInterface),
+incubate/checkpoint/auto_checkpoint.py:265 (TrainEpochRange).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "dist_preempt_trainer.py")
+
+
+from test_dist_multiprocess import _free_port  # noqa: E402 (shared helper)
+
+
+def _launch_pair(launcher, ckpt, out, kill_at=None):
+    master = f"127.0.0.1:{_free_port()}"
+    for rank in range(2):
+        env = {
+            # a leaked job id would move the checkpoint dir the test
+            # asserts on; empty string reads as unset (checker uses `or`)
+            "PADDLE_JOB_ID": "",
+            "PADDLE_ELASTIC_JOB_ID": "",
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "JAX_PLATFORMS": "cpu",
+        }
+        if kill_at is not None:
+            env["PTN_KILL_AT_EPOCH"] = str(kill_at)
+        launcher.launch([sys.executable, TRAINER, ckpt, out], env=env)
+
+
+def _watch(launcher, want, timeout=300):
+    from paddle_tpu.distributed.fleet.elastic import ElasticStatus
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = launcher.watch()
+        if status == want:
+            return status
+        if status not in (ElasticStatus.HOLD, want):
+            return status
+        time.sleep(0.5)
+    raise AssertionError(f"launcher never reached {want}")
+
+
+def _epoch_losses(out):
+    last = {}
+    with open(out) as f:
+        for line in f:
+            rec = json.loads(line)
+            last[rec["epoch"]] = rec["loss"]
+    return last
+
+
+def test_preemption_drill(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import (
+        ElasticStatus, LauncherInterface,
+    )
+
+    # reference run: uninterrupted 2-process DP
+    ref_launcher = LauncherInterface()
+    ref_out = str(tmp_path / "ref.jsonl")
+    _launch_pair(ref_launcher, str(tmp_path / "ref_ckpt"), ref_out)
+    assert _watch(ref_launcher, ElasticStatus.COMPLETED) == \
+        ElasticStatus.COMPLETED
+    ref = _epoch_losses(ref_out)
+    assert sorted(ref) == list(range(6))
+
+    # drilled run, incarnation 1: rank 1 SIGKILLs itself after epoch 2's
+    # step (before the epoch-2 checkpoint lands for it; rank 0's save of
+    # epoch 2 does land, making epoch 2 the durable state)
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "drill.jsonl")
+    launcher = LauncherInterface()
+    _launch_pair(launcher, ckpt, out, kill_at=2)
+    status = _watch(launcher, ElasticStatus.ERROR)
+    assert status == ElasticStatus.ERROR  # watchdog saw the SIGKILL
+    launcher.stop()  # elastic teardown of the blocked survivor
+    assert launcher.procs == []
+
+    # incarnation 2: relaunch, resume from checkpoint, run to completion
+    launcher2 = LauncherInterface()
+    _launch_pair(launcher2, ckpt, out)
+    assert _watch(launcher2, ElasticStatus.COMPLETED) == \
+        ElasticStatus.COMPLETED
+
+    got = _epoch_losses(out)
+    assert sorted(got) == list(range(6)), got
+    # loss continuity: every epoch's loss equals the uninterrupted run's
+    for e in range(6):
+        np.testing.assert_allclose(got[e], ref[e], rtol=1e-6, atol=1e-7,
+                                   err_msg=f"epoch {e} diverged")
+    # and the resume really came from the epoch-2 checkpoint
+    meta = json.load(open(os.path.join(
+        ckpt, "default_job__preempt", "meta.json")))
+    assert meta["epoch_no"] == 5
