@@ -1,18 +1,24 @@
 """Static pipeline meta-optimizer.
 
 Reference parity: meta_optimizers/pipeline_optimizer.py (268 LoC) wrapping
-fluid PipelineOptimizer (optimizer.py:4135): splits the program into per-stage
-section programs on device annotations, inserts send_v2/recv_v2.  TPU-native
-status, stated plainly: this static rewrite is OP-LIST PARITY ONLY — the
-stage ids and send/recv markers are recorded but the static Executor runs
-the block as one single-program XLA computation (numerically identical to
-the unsplit program; the markers are fn=None structural ops).  Real
-pipelined execution — per-stage compiled programs, micro-batch schedule,
-ppermute stage transfers, ZeRO-sharded opt state — lives in the compiled
-path (parallel/pipeline_compile.py PipelinedTrainStep), which is what
-fleet's dygraph PipelineParallel wrapper and the dryrun pipeline leg use.
+fluid PipelineOptimizer (optimizer.py:4135): splits the program into
+per-stage section programs on device annotations, inserts send_v2/recv_v2,
+and SectionWorker runs the sections on their devices with a micro-batch
+schedule (section_worker.cc:104).  TPU-native execution: the annotations
+this rewrite produces are CONSUMED by the static Executor's
+PipelinedBlock (static/pipeline_exec.py) — per-stage chunks jit
+separately, run with inputs committed to the stage's device (the
+device_put between chunks is the send/recv transfer), micro-batches
+accumulate param grads, updates run once per batch on each param's
+owning stage.  Stage assignment: forward ops split uniformly (the
+reference's device-annotation role); each grad op takes the stage of the
+forward op it differentiates; each update op takes its param's stage —
+so backward really runs on the stages, not wherever index order put it.
 """
-from .meta_optimizer_base import MetaOptimizerBase
+from .meta_optimizer_base import (
+    MetaOptimizerBase, UPDATE_OP_TYPES,
+)
+from ....static.backward import GRAD_SUFFIX
 
 
 class PipelineOptimizer(MetaOptimizerBase):
@@ -26,32 +32,83 @@ class PipelineOptimizer(MetaOptimizerBase):
             self.user_defined_strategy else {}
         result = self.inner_opt.minimize(loss, startup_program, parameter_list,
                                          no_grad_set)
-        block = loss.block.program.global_block()
+        program = loss.block.program
+        block = program.global_block()
         num_stages = max(int(cfg.get("pp_degree", cfg.get("num_stages", 1))), 1)
-        compute_ops = [op for op in block.ops if op.fn is not None]
-        if num_stages > 1 and compute_ops:
-            per = max(len(compute_ops) // num_stages, 1)
-            Operator = type(block.ops[0])
-            final_ops = []
-            idx = 0
-            for op in block.ops:
-                if op.fn is not None:
-                    stage = min(idx // per, num_stages - 1)
-                    op.attrs["pipeline_stage"] = stage
-                    prev_stage = min((idx - 1) // per, num_stages - 1) if idx else 0
-                    if idx and stage != prev_stage:
-                        # stage boundary: send/recv markers (send_v2 parity)
-                        bnd = getattr(op, "in_order", [])
-                        for name in bnd[:1]:
-                            sop = Operator(block, "send_v2", {"X": [name]}, {},
-                                           {"peer": stage}, fn=None)
-                            rop = Operator(block, "recv_v2", {},
-                                           {"Out": [name]},
-                                           {"peer": prev_stage}, fn=None)
-                            final_ops.append(sop)
-                            final_ops.append(rop)
-                    idx += 1
-                final_ops.append(op)
-            block.ops = final_ops
-            loss.block.program._pipeline_opt = {"num_stages": num_stages}
+        if num_stages > 1:
+            self._annotate(block, num_stages)
+            program._pipeline_opt = {
+                "num_stages": num_stages,
+                "accumulate_steps": max(
+                    int(cfg.get("accumulate_steps", 1)), 1),
+            }
         return result
+
+    @staticmethod
+    def _annotate(block, num_stages):
+        Operator = type(block.ops[0]) if block.ops else None
+
+        def is_grad(op):
+            return any(n.endswith(GRAD_SUFFIX)
+                       for n in getattr(op, "out_order", op.output_names()))
+
+        compute = [op for op in block.ops if op.fn is not None]
+        fwd = [op for op in compute
+               if not is_grad(op) and op.type not in UPDATE_OP_TYPES]
+        per = max((len(fwd) + num_stages - 1) // num_stages, 1)
+
+        # forward: uniform split (the reference's device annotations);
+        # var_stage records where each value/param lives
+        var_stage = {}
+        for i, op in enumerate(fwd):
+            stage = min(i // per, num_stages - 1)
+            op.attrs["pipeline_stage"] = stage
+            for n in getattr(op, "out_order", op.output_names()):
+                var_stage[n] = stage
+            for n in getattr(op, "in_order", op.input_names()):
+                v = block.vars.get(n)
+                if v is not None and getattr(v, "is_parameter", False):
+                    var_stage[n] = stage
+
+        # backward: the stage of the forward op being differentiated =
+        # the stage that produced (or consumes, for params) the primal
+        # of each grad output; update ops follow their param
+        for op in compute:
+            if op in fwd:
+                continue
+            if op.type in UPDATE_OP_TYPES:
+                ins = getattr(op, "in_order", op.input_names())
+                op.attrs["pipeline_stage"] = var_stage.get(
+                    ins[0] if ins else "", num_stages - 1)
+                continue
+            stages = [
+                var_stage[n[:-len(GRAD_SUFFIX)]]
+                for n in getattr(op, "out_order", op.output_names())
+                if n.endswith(GRAD_SUFFIX)
+                and n[:-len(GRAD_SUFFIX)] in var_stage
+            ]
+            op.attrs["pipeline_stage"] = max(stages) if stages \
+                else num_stages - 1
+
+        # send/recv markers at forward stage boundaries (send_v2 parity)
+        if Operator is None:
+            return
+        final_ops = []
+        prev_stage = None
+        for op in block.ops:
+            stage = op.attrs.get("pipeline_stage") \
+                if getattr(op, "attrs", None) and op.fn is not None else None
+            if (stage is not None and prev_stage is not None
+                    and stage == prev_stage + 1 and op in fwd):
+                bnd = getattr(op, "in_order", [])
+                for name in bnd[:1]:
+                    sop = Operator(block, "send_v2", {"X": [name]}, {},
+                                   {"peer": stage}, fn=None)
+                    rop = Operator(block, "recv_v2", {}, {"Out": [name]},
+                                   {"peer": prev_stage}, fn=None)
+                    final_ops.append(sop)
+                    final_ops.append(rop)
+            if stage is not None:
+                prev_stage = stage
+            final_ops.append(op)
+        block.ops = final_ops

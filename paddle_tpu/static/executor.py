@@ -51,6 +51,24 @@ def global_scope():
     return _global_scope
 
 
+def coerce_feeds(feed_names, feed):
+    """Validate + convert a feed dict to jnp arrays (shared by the
+    whole-block and pipelined execution paths)."""
+    feeds = {}
+    for n in feed_names:
+        if n not in feed:
+            from ..core.errors import NotFoundError
+
+            raise NotFoundError(
+                f"feed variable {n!r} missing from feed dict "
+                f"(declared feeds: {list(feed_names)})")
+        v = feed[n]
+        if isinstance(v, Tensor):
+            v = v._data
+        feeds[n] = jnp.asarray(np.asarray(v))
+    return feeds
+
+
 class CompiledBlock:
     """One lowered block: pure function (feeds, params) -> fetches.
 
@@ -236,19 +254,7 @@ class CompiledBlock:
         }, mask
 
     def _coerce_feeds(self, feed):
-        feeds = {}
-        for n in self.feed_names:
-            if n not in feed:
-                from ..core.errors import NotFoundError
-
-                raise NotFoundError(
-                    f"feed variable {n!r} missing from feed dict "
-                    f"(declared feeds: {self.feed_names})")
-            v = feed[n]
-            if isinstance(v, Tensor):
-                v = v._data
-            feeds[n] = jnp.asarray(np.asarray(v))
-        return feeds
+        return coerce_feeds(self.feed_names, feed)
 
     def run(self, feed, scope):
         feeds = self._coerce_feeds(feed)
@@ -377,6 +383,20 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f)
             for f in (fetch_list or [])
         ]
+        popt = getattr(program, "_pipeline_opt", None)
+        if popt and int(popt.get("num_stages", 1)) > 1 \
+                and len(jax.local_devices()) >= int(popt["num_stages"]):
+            # pipelined path (executor.py:1134 _run_pipeline role): stage
+            # chunks on their own devices + micro-batch schedule
+            from .pipeline_exec import PipelinedBlock
+
+            key = self._cache_key(program, feed, fetch_names) + ("pipe",)
+            cb = self._cache.get(key)
+            if cb is None:
+                cb = PipelinedBlock(program, feed.keys(), fetch_names,
+                                    scope)
+                self._cache[key] = cb
+            return cb
         mesh = self._resolve_mesh(program)
         key = self._cache_key(program, feed, fetch_names) + (
             tuple(mesh.shape.items()) if mesh is not None else None,)

@@ -1,0 +1,258 @@
+"""Pipelined static execution — the SectionWorker analogue.
+
+Reference parity: PipelineTrainer/SectionWorker (pipeline_trainer.cc,
+section_worker.cc:104): per-stage section programs run on their own
+devices, micro-batches flow between them via send_v2/recv_v2, gradients
+accumulate across micro-batches, and the optimizer update runs once per
+global batch.  TPU-native mapping:
+
+- the meta-opt's `pipeline_stage` op annotations partition the block into
+  CONTIGUOUS same-stage chunks (fwd 0..S-1 then bwd S-1..0, preserving
+  program order, so chunked execution is semantically identical to the
+  whole-block run);
+- each chunk jits once and executes with its inputs committed to the
+  stage's device — `jax.device_put` between chunks IS the send_v2/recv_v2
+  transfer, and each stage's params/optimizer state live only on its
+  device (the per-device section-program memory model);
+- micro-batch loop: feeds split along dim 0 into `accumulate_steps`
+  micro-batches; param grads (`*@GRAD` of parameters) accumulate across
+  micro-batches; update ops run once on the averaged grads.  Mean-loss
+  programs with equal micro-batches make this bit-for-math equal to the
+  full-batch step (grad of the mean = mean of micro-grads).
+
+Fetched scalars are averaged over micro-batches (the loss view the
+reference's section program reports); batch-dim fetches concatenate.
+"""
+import numpy as np
+import jax
+
+from .backward import GRAD_SUFFIX
+# one shared set with the annotating meta-opt: a new optimizer op type
+# must change phase in BOTH places at once
+from ..distributed.fleet.meta_optimizers.meta_optimizer_base import (
+    UPDATE_OP_TYPES as _UPDATE_OP_TYPES,
+)
+
+
+class PipelinedBlock:
+    """Compiled pipelined program: chunks of same-stage ops, each pinned
+    to its stage's device, plus a grad-accumulating micro-batch driver."""
+
+    def __init__(self, program, feed_names, fetch_names, scope):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        popt = getattr(program, "_pipeline_opt", {}) or {}
+        self.num_stages = int(popt.get("num_stages", 1))
+        self.num_micro = max(int(popt.get("accumulate_steps", 1)), 1)
+        block = program.global_block()
+        self.param_names = [
+            n for n, v in block.vars.items()
+            if v.persistable and scope.get(n) is not None
+        ]
+        devs = jax.local_devices()  # stages must be addressable
+        if len(devs) < self.num_stages:
+            raise ValueError(
+                f"pipeline needs {self.num_stages} local devices, have "
+                f"{len(devs)}")
+        self.stage_device = devs[: self.num_stages]
+        # fetch classification from STATIC shapes: a fetch whose leading
+        # dim matches the feed batch is per-sample (concat over micros);
+        # everything else (losses, metrics) averages.  Runtime shapes
+        # cannot tell the two apart when the micro batch is 1.
+        feed_batch = {
+            int(v.shape[0])
+            for n, v in block.vars.items()
+            if n in self.feed_names and v.shape
+            and isinstance(v.shape[0], (int, np.integer)) and v.shape[0] > 0
+        }
+        self._fetch_batchlike = {}
+        for n in self.fetch_names:
+            v = block.vars.get(n)
+            self._fetch_batchlike[n] = bool(
+                v is not None and v.shape
+                and isinstance(v.shape[0], (int, np.integer))
+                and v.shape[0] in feed_batch)
+
+        # param grads to accumulate across micro-batches
+        self.param_grads = {
+            p + GRAD_SUFFIX
+            for p in self.param_names
+            if (v := block.vars.get(p)) is not None and v.is_parameter
+        }
+
+        # split ops into compute chunks (contiguous same-stage runs) and
+        # the update phase, preserving program order
+        self.chunks = []  # [(stage, [ops])]
+        self.update_ops = []  # [(stage, op)]
+        for op in block.ops:
+            if op.fn is None:
+                continue  # send/recv markers + structural ops
+            if op.type in _UPDATE_OP_TYPES:
+                pstage = self._op_stage(op)
+                self.update_ops.append((pstage, op))
+                continue
+            stage = self._op_stage(op)
+            if self.chunks and self.chunks[-1][0] == stage:
+                self.chunks[-1][1].append(op)
+            else:
+                self.chunks.append((stage, [op]))
+        self._chunk_fns = [None] * len(self.chunks)
+        self._chunk_ios = [self._chunk_io(i) for i in range(len(self.chunks))]
+        # persistable vars written by compute ops (running stats etc.):
+        # CompiledBlock writes these back; so must the pipelined path
+        self._persist_compute_outs = [
+            n
+            for _, ops in self.chunks
+            for op in ops
+            for n in getattr(op, "out_order", op.output_names())
+            if (v := block.vars.get(n)) is not None and v.persistable
+        ]
+        self._update_fn = None
+        # which param each stage owns (for placement)
+        self.param_stage = {}
+        for stage, ops in self.chunks:
+            for op in ops:
+                for n in getattr(op, "in_order", op.input_names()):
+                    v = block.vars.get(n)
+                    if v is not None and v.persistable \
+                            and n not in self.param_stage:
+                        self.param_stage[n] = stage
+        for pstage, op in self.update_ops:
+            for n in getattr(op, "in_order", op.input_names()):
+                self.param_stage.setdefault(n, pstage)
+
+    def _op_stage(self, op):
+        return int(op.attrs.get("pipeline_stage", 0)) \
+            if getattr(op, "attrs", None) else 0
+
+    # ---- compilation ----
+    def _make_chunk_fn(self, ops):
+        def run(env):
+            out = {}
+            for op in ops:
+                ins = getattr(op, "in_order", op.input_names())
+                outs = getattr(op, "out_order", op.output_names())
+                args = [out.get(n, env.get(n)) for n in ins]
+                res = op.fn(*args)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                for n, v in zip(outs, res):
+                    out[n] = v
+            return out
+
+        return jax.jit(run)
+
+    def _chunk_io(self, idx):
+        """(inputs, outputs) var names for chunk idx: inputs = consumed
+        but not produced inside; outputs = produced and needed later."""
+        stage, ops = self.chunks[idx]
+        produced, consumed = [], []
+        for op in ops:
+            consumed += list(getattr(op, "in_order", op.input_names()))
+            produced += list(getattr(op, "out_order", op.output_names()))
+        later_needed = set(self.fetch_names) | set(self.param_grads) \
+            | set(self.param_names)
+        for j in range(idx + 1, len(self.chunks)):
+            for op in self.chunks[j][1]:
+                later_needed.update(getattr(op, "in_order",
+                                            op.input_names()))
+        for _, op in self.update_ops:
+            later_needed.update(getattr(op, "in_order", op.input_names()))
+        inputs = [n for n in dict.fromkeys(consumed) if n not in produced]
+        outputs = [n for n in dict.fromkeys(produced) if n in later_needed]
+        return inputs, outputs
+
+    # ---- execution ----
+    def run(self, feed, scope):
+        from .executor import coerce_feeds
+
+        M = self.num_micro
+        feeds = coerce_feeds(self.feed_names, feed)
+        for n, v in feeds.items():
+            if v.ndim and v.shape[0] % M:
+                raise ValueError(
+                    f"feed {n!r} batch {v.shape} not divisible by "
+                    f"accumulate_steps={M}")
+        params = {
+            n: jax.device_put(
+                scope.get(n),
+                self.stage_device[self.param_stage.get(n, 0)])
+            for n in self.param_names
+        }
+
+        acc_grads = {}
+        fetch_acc = {n: [] for n in self.fetch_names}
+        # scalar feeds broadcast to every micro-batch; batched feeds split
+        per = {n: v.shape[0] // M for n, v in feeds.items() if v.ndim}
+        env = {}
+        for m in range(M):
+            env = dict(params)
+            for n, v in feeds.items():
+                env[n] = v[m * per[n]:(m + 1) * per[n]] if v.ndim else v
+            for idx, (stage, ops) in enumerate(self.chunks):
+                if self._chunk_fns[idx] is None:
+                    self._chunk_fns[idx] = self._make_chunk_fn(ops)
+                ins, outs = self._chunk_ios[idx]
+                dev = self.stage_device[stage]
+                # inter-stage transfer: commit chunk inputs to its device
+                chunk_env = {n: jax.device_put(env[n], dev) for n in ins
+                             if n in env}
+                produced = self._chunk_fns[idx](chunk_env)
+                for n in outs:
+                    if n in produced:
+                        env[n] = produced[n]
+            for g in self.param_grads:
+                if g in env:
+                    acc_grads[g] = env[g] if g not in acc_grads \
+                        else acc_grads[g] + jax.device_put(
+                            env[g], acc_grads[g].devices().pop())
+            for n in self.fetch_names:
+                if n in env:
+                    fetch_acc[n].append(env[n])
+
+        # update phase: averaged grads, once per global batch
+        upd_env = dict(params)
+        # persistable vars a compute op wrote (BN running stats, counters)
+        # carry their last-micro value into the update phase + scope
+        for n in self._persist_compute_outs:
+            if n in env:
+                upd_env[n] = env[n]
+        for g, v in acc_grads.items():
+            upd_env[g] = v / M
+        for pstage, op in self.update_ops:
+            ins = getattr(op, "in_order", op.input_names())
+            outs = getattr(op, "out_order", op.output_names())
+            dev = self.stage_device[pstage]
+            args = [jax.device_put(upd_env[n], dev) for n in ins]
+            res = op.fn(*args)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for n, v in zip(outs, res):
+                upd_env[n] = v
+        for n in self.param_names:
+            if n in upd_env:
+                scope.set(n, upd_env[n])
+
+        outs = []
+        for n in self.fetch_names:
+            vals = fetch_acc[n]
+            if not vals:
+                raise KeyError(n)
+            if self._fetch_batchlike.get(n) and vals[0].ndim:
+                outs.append(np.concatenate(
+                    [np.asarray(v) for v in vals], axis=0))
+            else:
+                # loss/metric view: mean over micro-batches (the section
+                # program's reported loss, section_worker.cc)
+                outs.append(np.mean([np.asarray(v) for v in vals], axis=0))
+        return [np.asarray(o) for o in outs]
+
+    def cost_analysis(self, feed, scope):
+        """Per-chunk cost stats are not aggregated yet; the whole-block
+        view is available by running the same program without
+        _pipeline_opt (numerically identical)."""
+        return None
+
+    def stage_of_param(self, name):
+        return self.param_stage.get(name)

@@ -1,0 +1,150 @@
+"""Static pipeline EXECUTION (SectionWorker analogue).
+
+The fleet pipeline meta-opt's stage annotations now drive real execution:
+per-stage chunks jit separately and run with inputs committed to the
+stage's device (the inter-stage device_put is the send_v2/recv_v2
+transfer), micro-batches accumulate param grads, and the update phase
+runs once per global batch on each param's owning stage.  Parity bar:
+losses equal the plain single-device whole-block run, step by step.
+
+Ref: section_worker.cc:104 (micro-batch loop), pipeline_trainer.cc
+(per-stage sections), meta_optimizers/pipeline_optimizer.py:228.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed.fleet import Fleet
+from paddle_tpu.distributed.fleet.distributed_strategy import (
+    DistributedStrategy,
+)
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    apply_meta_optimizers,
+)
+
+STEPS = 4
+RNG = np.random.RandomState(0)
+XS = [RNG.rand(8, 16).astype(np.float32) for _ in range(STEPS)]
+YS = [RNG.rand(8, 1).astype(np.float32) for _ in range(STEPS)]
+
+
+def _build(pp_degree=None, accumulate_steps=1):
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 16])
+        y = static.data("y", [8, 1])
+        h = static.nn.relu(static.nn.fc(x, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        out = static.nn.fc(h, 1)
+        loss = static.nn.mean((out - y) * (out - y))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        if pp_degree is None:
+            opt.minimize(loss)
+        else:
+            strategy = DistributedStrategy()
+            strategy.pipeline = True
+            strategy.pipeline_configs = {
+                "pp_degree": pp_degree,
+                "accumulate_steps": accumulate_steps,
+            }
+            f = Fleet()
+            f.init(is_collective=True, strategy=strategy)
+            apply_meta_optimizers(opt, strategy, loss, startup, f)
+    return main, startup, loss
+
+
+def _train(pp_degree=None, accumulate_steps=1):
+    main, startup, loss = _build(pp_degree, accumulate_steps)
+    scope = static.Scope()
+    exe = static.Executor()
+    exe.run(startup, scope=scope)
+    losses = []
+    for xv, yv in zip(XS, YS):
+        out = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                      scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    return losses, exe, scope, main
+
+
+def test_static_pipeline_executes_with_loss_parity():
+    base, *_ = _train()
+    got, exe, scope, main = _train(pp_degree=2)
+    assert main._pipeline_opt["num_stages"] == 2
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=1e-6)
+    # the block really ran pipelined: a PipelinedBlock served it and the
+    # stages' params live on different devices
+    from paddle_tpu.static.pipeline_exec import PipelinedBlock
+
+    pbs = [cb for cb in exe._cache.values()
+           if isinstance(cb, PipelinedBlock)]
+    assert pbs, "executor did not route to the pipelined path"
+    pb = pbs[0]
+    stages = {pb.stage_of_param(n) for n in pb.param_names
+              if pb.stage_of_param(n) is not None}
+    assert stages == {0, 1}
+    devs = {list(scope.get(n).devices())[0] for n in pb.param_names
+            if hasattr(scope.get(n), "devices")}
+    assert len(devs) == 2  # param storage split across stage devices
+
+
+def test_static_pipeline_microbatch_grad_accumulation_parity():
+    """accumulate_steps=4: micro-batch grad accumulation must equal the
+    full-batch step (mean loss, equal micro sizes)."""
+    base, *_ = _train()
+    got, exe, _, main = _train(pp_degree=2, accumulate_steps=4)
+    assert main._pipeline_opt["accumulate_steps"] == 4
+    np.testing.assert_allclose(got, base, rtol=2e-5, atol=1e-6)
+
+
+def test_static_pipeline_backward_ops_annotated_by_forward_stage():
+    """Grad/update ops carry the stage of their forward counterpart, not
+    an index-uniform split (the round-2 annotation put all backward ops
+    in the last stage)."""
+    main, _, _ = _build(pp_degree=2)
+    block = main.global_block()
+    stages = {}
+    for op in block.ops:
+        if op.fn is None:
+            continue
+        stages.setdefault(op.type, []).append(
+            op.attrs.get("pipeline_stage"))
+    # the first fc's update must be on stage 0, the head fc's on stage 1
+    assert 0 in stages.get("momentum", []) and 1 in stages.get(
+        "momentum", [])
+    # grad ops span both stages too
+    grad_stages = [s for t, ss in stages.items() if t.endswith("_grad")
+                   for s in ss]
+    assert 0 in grad_stages and 1 in grad_stages
+
+
+def test_static_pipeline_batchlike_fetch_concats_scalar_averages():
+    """A per-sample fetch concatenates over micro-batches; the scalar loss
+    averages — classification comes from static shapes, so a micro batch
+    of 1 cannot be mistaken for a scalar."""
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 16])
+        y = static.data("y", [8, 1])
+        h = static.nn.relu(static.nn.fc(x, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        out = static.nn.fc(h, 1)
+        loss = static.nn.mean((out - y) * (out - y))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        strategy = DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs = {"pp_degree": 2, "accumulate_steps": 8}
+        f = Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        apply_meta_optimizers(opt, strategy, loss, startup, f)
+    scope = static.Scope()
+    exe = static.Executor()
+    exe.run(startup, scope=scope)
+    preds, lv = exe.run(main, feed={"x": XS[0], "y": YS[0]},
+                        fetch_list=[out, loss], scope=scope)
+    assert preds.shape == (8, 1)  # concatenated, micro batch was 1
+    assert np.asarray(lv).size == 1  # averaged loss view
