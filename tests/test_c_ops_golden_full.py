@@ -478,14 +478,24 @@ CASES["maxout"] = C(lambda: [F((1, 4, 2, 2), 1)],
                     kwargs={"groups": 2},
                     check=lambda got, args: got[0].shape == (1, 2, 2, 2),
                     static=False)
-CASES["prelu"] = finite(lambda: [F((1, 2, 2, 2), 1), F((2,), 2, 0.1, 0.3)])
+CASES["prelu"] = C(
+    lambda: [F((1, 2, 2, 2), 1), F((2,), 2, 0.1, 0.3)],
+    ref=lambda x, w: np.where(x > 0, x, x * w.reshape(1, 2, 1, 1)))
 CASES["softmax"] = C(lambda: [F((2, 4), 1)], ref=lambda a: _np_softmax(a),
                      grad=(0,))
 CASES["log_softmax"] = C(lambda: [F((2, 4), 1)],
                          ref=lambda a: np.log(_np_softmax(
                              a.astype(np.float64))), grad=(0,))
-CASES["sequence_softmax"] = finite(
-    lambda: [F((2, 3, 2), 1), np.array([3, 2], np.int64)])
+def _seq_sm_ref(x, L):
+    out = np.zeros_like(x)
+    for i, n in enumerate(L):
+        out[i, :n] = _np_softmax(x[i, :n])
+    return out
+
+
+CASES["sequence_softmax"] = C(
+    lambda: [F((2, 4), 1), np.array([3, 2], np.int64)],
+    ref=_seq_sm_ref)
 CASES["fused_softmax_mask_upper_triangle"] = C(
     lambda: [F((1, 1, 4, 4), 1)],
     check=lambda got, args: np.allclose(
@@ -709,7 +719,10 @@ CASES["batch_norm"] = finite(
     lambda: [F((2, 3, 2, 2), 1), np.zeros(3, np.float32),
              np.ones(3, np.float32), np.ones(3, np.float32),
              np.zeros(3, np.float32)])
-CASES["instance_norm"] = finite(lambda: [F((2, 3, 2, 2), 1)])
+CASES["instance_norm"] = C(
+    lambda: [F((2, 3, 2, 2), 1)],
+    ref=lambda x: (x - x.mean(axis=(2, 3), keepdims=True))
+    / np.sqrt(x.var(axis=(2, 3), keepdims=True) + 1e-5), rtol=1e-3)
 CASES["group_norm"] = finite(lambda: [F((2, 4, 2, 2), 1), 2])
 CASES["layer_norm"] = C(
     lambda: [F((2, 4), 1)], kwargs={"normalized_shape": 4},
@@ -738,7 +751,19 @@ CASES["shuffle_channel"] = C(
 CASES["space_to_depth"] = C(
     lambda: [F((1, 1, 4, 4), 1)], kwargs={"blocksize": 2},
     check=lambda got, args: got[0].shape == (1, 4, 2, 2), static=False)
-CASES["temporal_shift"] = finite(lambda: [F((4, 4, 2, 2), 1), 2])
+def _tshift_ref(x, seg):
+    n = x.shape[0] // seg
+    xr = x.reshape(n, seg, *x.shape[1:])
+    fold = x.shape[1] // 4
+    out = np.zeros_like(xr)
+    out[:, :-1, :fold] = xr[:, 1:, :fold]
+    out[:, 1:, fold:2 * fold] = xr[:, :-1, fold:2 * fold]
+    out[:, :, 2 * fold:] = xr[:, :, 2 * fold:]
+    return out.reshape(x.shape)
+
+
+CASES["temporal_shift"] = C(lambda: [F((4, 4, 2, 2), 1), 2],
+                            ref=_tshift_ref)
 CASES["interpolate"] = C(
     lambda: [F((1, 1, 2, 2), 1)], kwargs={"size": [4, 4]},
     check=lambda got, args: got[0].shape == (1, 1, 4, 4), static=False)
@@ -781,23 +806,42 @@ CASES["softmax_with_cross_entropy"] = C(
     grad=(0,))
 CASES["sigmoid_cross_entropy_with_logits"] = finite(
     lambda: [F((2, 3), 1), (F((2, 3), 2) > 0).astype(np.float32)])
-CASES["bce_loss"] = finite(
-    lambda: [F((2, 3), 1, 0.1, 0.9), (F((2, 3), 2) > 0).astype(np.float32)])
-CASES["nll_loss"] = finite(lambda: [np.log(_SM(F((3, 4), 1))), I((3,), 4, 2)])
-CASES["kldiv_loss"] = finite(
-    lambda: [np.log(_SM(F((2, 4), 1))), _SM(F((2, 4), 2)).astype(np.float32)])
-CASES["log_loss"] = finite(
-    lambda: [F((3, 1), 1, 0.1, 0.9), (F((3, 1), 2) > 0).astype(np.float32)])
-CASES["hinge_loss"] = finite(
-    lambda: [F((3, 1), 1), (F((3, 1), 2) > 0).astype(np.float32)])
-CASES["huber_loss"] = finite(lambda: [F((3, 1), 1), F((3, 1), 2)])
-CASES["smooth_l1_loss"] = finite(lambda: [F((3, 2), 1), F((3, 2), 2)])
-CASES["margin_rank_loss"] = finite(
+CASES["bce_loss"] = C(
+    lambda: [F((2, 3), 1, 0.1, 0.9), (F((2, 3), 2) > 0).astype(np.float32)],
+    ref=lambda pv, l: np.mean(-l * np.log(pv) - (1 - l) * np.log(1 - pv)),
+    rtol=1e-3)
+CASES["nll_loss"] = C(
+    lambda: [np.log(_SM(F((3, 4), 1))), I((3,), 4, 2)],
+    ref=lambda lp, l: -np.mean(np.take_along_axis(
+        lp.astype(np.float64), l[:, None], 1)))
+CASES["kldiv_loss"] = C(
+    lambda: [np.log(_SM(F((2, 4), 1))), _SM(F((2, 4), 2)).astype(np.float32)],
+    ref=lambda lp, l: np.mean(l * (np.log(l) - lp)), rtol=1e-3)
+CASES["log_loss"] = C(
+    lambda: [F((3, 1), 1, 0.1, 0.9), (F((3, 1), 2) > 0).astype(np.float32)],
+    ref=lambda pv, l: -l * np.log(pv + 1e-4)
+    - (1 - l) * np.log(1 - pv + 1e-4), rtol=1e-3)
+CASES["hinge_loss"] = C(
+    lambda: [F((3, 1), 1), (F((3, 1), 2) > 0).astype(np.float32)],
+    ref=lambda x, l: np.maximum(0.0, 1 - (2 * l - 1) * x))
+CASES["huber_loss"] = C(
+    lambda: [F((3, 1), 1), F((3, 1), 2)],
+    ref=lambda x, y: np.where(np.abs(x - y) <= 1.0, 0.5 * (x - y) ** 2,
+                              np.abs(x - y) - 0.5))
+CASES["smooth_l1_loss"] = C(
+    lambda: [F((3, 2), 1), F((3, 2), 2)],
+    ref=lambda x, y: np.mean(np.where(np.abs(x - y) < 1.0,
+                                      0.5 * (x - y) ** 2,
+                                      np.abs(x - y) - 0.5)))
+CASES["margin_rank_loss"] = C(
     lambda: [F((3, 1), 1), F((3, 1), 2),
-             np.sign(F((3, 1), 3)).astype(np.float32)])
-CASES["rank_loss"] = finite(
+             np.sign(F((3, 1), 3)).astype(np.float32)],
+    ref=lambda a, b, l: np.mean(np.maximum(0.0, -l * (a - b))))
+CASES["rank_loss"] = C(
     lambda: [(F((3, 1), 1) > 0).astype(np.float32), F((3, 1), 2),
-             F((3, 1), 3)])
+             F((3, 1), 3)],
+    ref=lambda l, a, b: np.log1p(np.exp(a - b)) - l * (a - b),
+    rtol=1e-3)
 CASES["bpr_loss"] = finite(lambda: [F((3, 4), 1), I((3, 1), 4, 2)])
 CASES["center_loss"] = finite(
     lambda: [F((3, 4), 1), I((3,), 5, 2), F((5, 4), 3)])
@@ -872,10 +916,20 @@ CASES["sequence_pad"] = finite(
     lambda: [F((5, 2), 1), np.array([2, 3], np.int64)], min_outputs=1)
 CASES["sequence_unpad"] = finite(
     lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
-CASES["sequence_pool"] = finite(
-    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
-CASES["sequence_reverse"] = finite(
-    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
+CASES["sequence_pool"] = C(
+    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)],
+    ref=lambda x, L: np.stack([x[i, :n].mean(0)
+                               for i, n in enumerate(L)]))
+def _seq_rev_ref(x, L):
+    out = x.copy()
+    for i, n in enumerate(L):
+        out[i, :n] = x[i, :n][::-1]
+    return out
+
+
+CASES["sequence_reverse"] = C(
+    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)],
+    ref=_seq_rev_ref)
 CASES["sequence_expand"] = finite(
     lambda: [F((2, 3), 1), np.array([2, 1], np.int64)])
 CASES["sequence_conv"] = finite(
