@@ -36,9 +36,11 @@ class PlaceType:
 class Config:
     """AnalysisConfig parity (inference/api/paddle_analysis_config.h).
 
-    Device/optimization knobs that have no TPU meaning (MKLDNN, TensorRT,
-    GPU memory pool) are accepted and recorded so reference configs run
-    unchanged; XLA owns fusion and memory planning.
+    REAL knobs: device selection (disable_gpu pins execution to a host
+    CPU device — exports carry cpu+tpu platforms) and enable_profile
+    (RecordEvent spans around Predictor.run).  Knobs with no TPU meaning
+    (MKLDNN, TensorRT, GPU memory pool) are accepted and recorded so
+    reference configs run unchanged; XLA owns fusion and memory planning.
     """
 
     def __init__(self, model_dir=None, params_file=None):
@@ -90,6 +92,16 @@ class Config:
 
     def set_cpu_math_library_num_threads(self, n):
         self._cpu_threads = int(n)
+
+    # --- profiling (EnableProfile, paddle_analysis_config.h) ---
+    def enable_profile(self):
+        """REAL effect: Predictor.run wraps each execution in a
+        RecordEvent span ('inference::run'), so paddle.profiler's summary
+        table and chrome trace cover serving calls."""
+        self._settings["profile"] = True
+
+    def profile_enabled(self):
+        return bool(self._settings.get("profile"))
 
     # --- optimization toggles (XLA decides; recorded for parity) ---
     def switch_ir_optim(self, flag=True):
@@ -277,15 +289,41 @@ class Predictor:
                         f"module expects {want} (symbolic dims accept any "
                         f"size; re-save with -1 dims in the InputSpec for "
                         f"batch polymorphism)")
-            outs = self._exported.call(*args)
+            outs = self._run_module(self._exported.call, args)
         else:
-            outs = self._jitted(*args)
+            outs = self._run_module(self._jitted, args)
         if not isinstance(outs, (list, tuple)):
             outs = (outs,)
         res = [np.asarray(o) for o in outs]
         for n, o in zip(self._out_names, res):
             self._outputs[n]._value = o
         return res if inputs is not None else True
+
+    def _run_module(self, fn, args):
+        """Execute honoring the REAL config knobs: disable_gpu() pins the
+        computation to a host CPU device (exports carry cpu+tpu
+        platforms); enable_profile() wraps the call in a RecordEvent span
+        for the profiler's summary/chrome-trace output."""
+        import contextlib
+
+        import jax
+
+        ctx = contextlib.nullcontext()
+        if self._config._device == "cpu":
+            try:
+                cpus = jax.devices("cpu") if jax.default_backend() != "cpu" \
+                    else jax.devices()
+            except RuntimeError:
+                cpus = []  # cpu platform unavailable (pinned platform list)
+            if cpus:
+                ctx = jax.default_device(cpus[0])
+        if self._config.profile_enabled():
+            from ..profiler import RecordEvent
+
+            with ctx, RecordEvent("inference::run"):
+                return fn(*args)
+        with ctx:
+            return fn(*args)
 
     def clone(self):
         p = Predictor.__new__(Predictor)

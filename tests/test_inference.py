@@ -125,3 +125,35 @@ def test_predictor_layer_cls_fallback(tmp_path):
 def test_missing_artifact_raises(tmp_path):
     with pytest.raises(RuntimeError, match="no loadable inference artifact"):
         inference.Predictor(inference.Config(str(tmp_path / "nope")))
+
+
+def test_config_profile_and_cpu_device_knobs_are_real(tmp_path):
+    """enable_profile must surface serving spans in the profiler summary;
+    disable_gpu must pin execution to a host CPU device."""
+    import numpy as np
+    import paddle_tpu.static as static
+    from paddle_tpu import inference, profiler
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data(name="x", shape=[2, 4], dtype="float32")
+            y = static.nn.fc(x, 3)
+        exe = static.Executor()
+        exe.run(startup)
+        prefix = str(tmp_path / "m")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+    finally:
+        paddle.disable_static()
+
+    cfg = inference.Config(prefix)
+    cfg.disable_gpu()
+    cfg.enable_profile()
+    assert cfg.profile_enabled()
+    pred = inference.Predictor(cfg)
+    profiler.start_profiler()
+    out = pred.run([np.ones((2, 4), np.float32)])[0]
+    report = profiler.stop_profiler()
+    assert out.shape == (2, 3)
+    assert "inference::run" in report
