@@ -441,8 +441,7 @@ CASES["dist"] = C(lambda: [F((2, 3), 1), F((2, 3), 2)],
                       (a - b).astype(np.float64)).sum()))
 CASES["fsp"] = C(
     lambda: [F((1, 2, 3, 3), 1), F((1, 4, 3, 3), 2)],
-    check=lambda got, args: got[0].shape == (1, 2, 4)
-    and np.isfinite(got[0]).all(), static=False)
+    ref=lambda x, y: np.einsum("nchw,ndhw->ncd", x, y) / 9.0, rtol=1e-3)
 CASES["bilinear_tensor_product"] = finite(
     lambda: [F((2, 3), 1), F((2, 4), 2), F((5, 3, 4), 3)])
 
@@ -596,7 +595,9 @@ CASES["meshgrid"] = C(
     ref=lambda a, b: list(np.meshgrid(a, b, indexing="ij")), static=False)
 CASES["multiplex"] = C(
     lambda: [[F((2, 3), 1), F((2, 3), 2)], np.array([[0], [1]], np.int64)],
-    check=lambda got, args: got[0].shape == (2, 3), static=False)
+    ref=lambda ins, idx: np.stack([ins[i[0]][r]
+                                   for r, i in enumerate(idx)]),
+    static=False)
 CASES["crop"] = C(lambda: [F((3, 4), 1)],
                   kwargs={"shape": [2, 2], "offsets": [1, 1]},
                   ref=lambda a: a[1:3, 1:3])
@@ -705,8 +706,9 @@ CASES["pool2d"] = C(lambda: [F((1, 1, 4, 4), 1)], kwargs={"kernel_size": 2},
 CASES["pool2d_avg"] = C(lambda: [F((1, 1, 4, 4), 1)],
                         kwargs={"kernel_size": 2},
                         ref=lambda x: _np_avgpool2(x), grad=(0,))
-CASES["pool3d"] = finite(lambda: [F((1, 1, 2, 2, 2), 1)],
-                         kwargs={"kernel_size": 2})
+CASES["pool3d"] = C(lambda: [F((1, 1, 2, 2, 2), 1)],
+                    kwargs={"kernel_size": 2},
+                    ref=lambda x: x.max().reshape(1, 1, 1, 1, 1))
 CASES["max_pool2d_with_index"] = C(
     lambda: [F((1, 1, 4, 4), 1)], kwargs={"kernel_size": 2},
     check=lambda got, args: got[0].shape == (1, 1, 2, 2)
@@ -715,22 +717,38 @@ CASES["unpool"] = finite(
     lambda: [F((1, 1, 2, 2), 1), I((1, 1, 2, 2), 16, 2),
              2])
 CASES["spp"] = finite(lambda: [F((1, 2, 4, 4), 1)])
-CASES["batch_norm"] = finite(
+CASES["batch_norm"] = C(
     lambda: [F((2, 3, 2, 2), 1), np.zeros(3, np.float32),
              np.ones(3, np.float32), np.ones(3, np.float32),
-             np.zeros(3, np.float32)])
+             np.zeros(3, np.float32)],
+    ref=lambda x, rm, rv, w, b: x / np.sqrt(1 + 1e-5), rtol=1e-3)
 CASES["instance_norm"] = C(
     lambda: [F((2, 3, 2, 2), 1)],
     ref=lambda x: (x - x.mean(axis=(2, 3), keepdims=True))
     / np.sqrt(x.var(axis=(2, 3), keepdims=True) + 1e-5), rtol=1e-3)
-CASES["group_norm"] = finite(lambda: [F((2, 4, 2, 2), 1), 2])
+def _gn_ref(x, g):
+    xr = x.reshape(x.shape[0], g, -1)
+    m = xr.mean(axis=2, keepdims=True)
+    v = xr.var(axis=2, keepdims=True)
+    return ((xr - m) / np.sqrt(v + 1e-5)).reshape(x.shape)
+
+
+CASES["group_norm"] = C(lambda: [F((2, 4, 2, 2), 1), 2], ref=_gn_ref,
+                        rtol=1e-3)
 CASES["layer_norm"] = C(
     lambda: [F((2, 4), 1)], kwargs={"normalized_shape": 4},
     ref=lambda a: (a - a.mean(-1, keepdims=True)) / np.sqrt(
         a.var(-1, keepdims=True) + 1e-5), rtol=1e-3, grad=(0,))
-CASES["data_norm"] = finite(
+def _data_norm_ref(x, bs, bsum, bsq):
+    means = bsum / bs
+    scales = 1.0 / np.sqrt(bsq / bs - means ** 2 + 1e-4)
+    return (x - means[None]) * scales[None]
+
+
+CASES["data_norm"] = C(
     lambda: [F((2, 3), 1), np.full((3,), 4.0, np.float32),
-             F((3,), 2), np.full((3,), 4.0, np.float32)])
+             F((3,), 2), np.full((3,), 6.0, np.float32)],
+    ref=_data_norm_ref, rtol=1e-3)
 CASES["lrn"] = finite(lambda: [F((1, 4, 2, 2), 1), 3])
 CASES["dropout"] = C(lambda: [F((2, 3), 1)], kwargs={"p": 0.0},
                      ref=lambda a: a, grad=(0,), static=False)
@@ -875,8 +893,10 @@ CASES["sample_logits"] = finite(
 # --- metrics / eval
 CASES["chunk_eval"] = finite(
     lambda: [I((1, 6), 3, 1), I((1, 6), 3, 2)], min_outputs=1)
-CASES["edit_distance"] = finite(
-    lambda: [I((2, 4), 5, 1), I((2, 4), 5, 2)], min_outputs=1)
+CASES["edit_distance"] = C(
+    lambda: [np.array([[1, 2, 3, 4]], np.int64),
+             np.array([[1, 3, 3, 3]], np.int64)],
+    ref=lambda a, b: np.array([[0.5]]), static=False)  # 2 edits / len 4
 CASES["positive_negative_pair"] = finite(
     lambda: [F((4, 1), 1, 0.0, 1.0), (F((4, 1), 2) > 0).astype(np.float32),
              np.zeros((4, 1), np.int64)], min_outputs=1)
@@ -914,8 +934,10 @@ CASES["sequence_mask"] = C(
     atol=0)
 CASES["sequence_pad"] = finite(
     lambda: [F((5, 2), 1), np.array([2, 3], np.int64)], min_outputs=1)
-CASES["sequence_unpad"] = finite(
-    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)])
+CASES["sequence_unpad"] = C(
+    lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)],
+    ref=lambda x, L: np.concatenate([x[i, :n] for i, n in enumerate(L)]),
+    static=False)
 CASES["sequence_pool"] = C(
     lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)],
     ref=lambda x, L: np.stack([x[i, :n].mean(0)
@@ -930,8 +952,9 @@ def _seq_rev_ref(x, L):
 CASES["sequence_reverse"] = C(
     lambda: [F((2, 4, 3), 1), np.array([2, 3], np.int64)],
     ref=_seq_rev_ref)
-CASES["sequence_expand"] = finite(
-    lambda: [F((2, 3), 1), np.array([2, 1], np.int64)])
+CASES["sequence_expand"] = C(
+    lambda: [F((2, 3), 1), np.array([2, 1], np.int64)],
+    ref=lambda x, r: np.repeat(x, r, axis=0), static=False)
 CASES["sequence_conv"] = finite(
     lambda: [F((2, 4, 3), 1), F((9, 5), 2), np.array([2, 3], np.int64)])
 CASES["segment_pool"] = C(
@@ -948,7 +971,10 @@ CASES["beam_search_decode"] = finite(
 CASES["gather_tree"] = C(
     lambda: [I((3, 1, 2), 5, 1), np.zeros((3, 1, 2), np.int64)],
     check=lambda got, args: got[0].shape == (3, 1, 2), static=False)
-CASES["ctc_align"] = finite(lambda: [I((2, 5), 4, 1)], min_outputs=1)
+CASES["ctc_align"] = C(
+    lambda: [np.array([[1, 1, 0, 2, 2], [0, 3, 0, 0, 1]], np.int64)],
+    ref=lambda x: [np.array([[1, 2, 0, 0, 0], [3, 1, 0, 0, 0]]),
+                   np.array([[2], [2]])], atol=0, static=False)
 CASES["linear_chain_crf"] = finite(
     lambda: [F((2, 4, 3), 1), F((5, 3), 2), I((2, 4), 3, 3),
              np.array([3, 4], np.int64)], min_outputs=1)
@@ -977,7 +1003,11 @@ CASES["deformable_psroi_pooling"] = finite(
              np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)],
     kwargs={"no_trans": True, "output_dim": 2, "pooled_height": 2,
             "pooled_width": 2, "group_size": (2, 2)})
-CASES["cvm"] = finite(lambda: [F((2, 4), 1)])
+CASES["cvm"] = C(
+    lambda: [F((2, 4), 1, 0.1, 1.0)],
+    ref=lambda x: np.concatenate(
+        [np.log(x[:, :1] + 1), np.log(x[:, 1:2] + 1) - np.log(x[:, :1] + 1),
+         x[:, 2:]], axis=1), rtol=1e-3)
 CASES["fused_elemwise_placeholder"] = None
 del CASES["fused_elemwise_placeholder"]
 
