@@ -567,13 +567,14 @@ def fsp_matrix(x, y, name=None):
 
 def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4,
               name=None):
-    """Stats-table normalization (data_norm_op.cc): means/scales derive
-    from accumulated (count, sum, sum-of-squares) rows, no batch stats.
-    Returns (normalized, means, scales)."""
+    """Stats-table normalization (data_norm_op.cc:303): means =
+    batch_sum / batch_size, scales = sqrt(batch_size / batch_square_sum)
+    — the reference's exact formula (batch_square_sum accumulates squared
+    DEVIATIONS, so no mean^2 subtraction); epsilon only guards the
+    division.  Returns (normalized, means, scales)."""
     def fn(v, bs, bsum, bsq):
         means = bsum / bs
-        var = bsq / bs - jnp.square(means)
-        scales = 1.0 / jnp.sqrt(var + epsilon)
+        scales = jnp.sqrt(bs / jnp.maximum(bsq, epsilon))
         return (v - means[None, :]) * scales[None, :], means, scales
 
     return apply_op("data_norm", fn,

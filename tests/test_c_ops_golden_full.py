@@ -718,10 +718,14 @@ CASES["unpool"] = finite(
              2])
 CASES["spp"] = finite(lambda: [F((1, 2, 4, 4), 1)])
 CASES["batch_norm"] = C(
-    lambda: [F((2, 3, 2, 2), 1), np.zeros(3, np.float32),
-             np.ones(3, np.float32), np.ones(3, np.float32),
-             np.zeros(3, np.float32)],
-    ref=lambda x, rm, rv, w, b: x / np.sqrt(1 + 1e-5), rtol=1e-3)
+    lambda: [F((2, 3, 2, 2), 1),
+             np.array([0.1, -0.2, 0.3], np.float32),
+             np.array([0.5, 2.0, 1.2], np.float32),
+             np.array([1.5, 0.7, -1.0], np.float32),
+             np.array([-0.2, 0.4, 0.0], np.float32)],
+    ref=lambda x, rm, rv, w, b: (x - rm.reshape(1, 3, 1, 1))
+    / np.sqrt(rv.reshape(1, 3, 1, 1) + 1e-5) * w.reshape(1, 3, 1, 1)
+    + b.reshape(1, 3, 1, 1), rtol=1e-3)
 CASES["instance_norm"] = C(
     lambda: [F((2, 3, 2, 2), 1)],
     ref=lambda x: (x - x.mean(axis=(2, 3), keepdims=True))
@@ -740,9 +744,8 @@ CASES["layer_norm"] = C(
     ref=lambda a: (a - a.mean(-1, keepdims=True)) / np.sqrt(
         a.var(-1, keepdims=True) + 1e-5), rtol=1e-3, grad=(0,))
 def _data_norm_ref(x, bs, bsum, bsq):
-    means = bsum / bs
-    scales = 1.0 / np.sqrt(bsq / bs - means ** 2 + 1e-4)
-    return (x - means[None]) * scales[None]
+    # data_norm_op.cc:303: scales = sqrt(batch_size / batch_square_sum)
+    return (x - (bsum / bs)[None]) * np.sqrt(bs / bsq)[None]
 
 
 CASES["data_norm"] = C(

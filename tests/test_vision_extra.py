@@ -162,7 +162,8 @@ def test_data_norm_and_cvm():
         paddle.to_tensor(x), paddle.to_tensor(bs), paddle.to_tensor(bsum),
         paddle.to_tensor(bsq))
     np.testing.assert_allclose(_np(means), [2.0, 3.0])
-    want_scale = 1.0 / np.sqrt(np.array([1.0, 1.0]) + 1e-4)
+    # data_norm_op.cc:303: scales = sqrt(batch_size / batch_square_sum)
+    want_scale = np.sqrt(bs / bsq)
     np.testing.assert_allclose(_np(scales), want_scale, rtol=1e-5)
 
     feat = np.array([[3.0, 1.0, 7.0]], np.float32)
