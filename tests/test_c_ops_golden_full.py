@@ -697,7 +697,19 @@ CASES["conv2d_transpose"] = finite(lambda: [F((1, 1, 2, 2), 1),
                                             F((1, 1, 2, 2), 2)])
 CASES["conv3d_transpose"] = finite(lambda: [F((1, 1, 2, 2, 2), 1),
                                             F((1, 1, 2, 2, 2), 2)])
-CASES["conv_shift"] = finite(lambda: [F((2, 5), 1), F((2, 3), 2)])
+def _conv_shift_ref(x, y):
+    # conv_shift_op.cc:125: circular Out[i] = sum_j X_{i+j} Y_j
+    n = y.shape[1]
+    half = (n - 1) // 2
+    out = np.zeros_like(x)
+    for i in range(x.shape[1]):
+        for j in range(-half, half + 1):
+            out[:, i] += x[:, (i + j) % x.shape[1]] * y[:, j + half]
+    return out
+
+
+CASES["conv_shift"] = C(lambda: [F((2, 5), 1), F((2, 3), 2)],
+                        ref=_conv_shift_ref, rtol=1e-3)
 CASES["deformable_conv"] = finite(
     lambda: [F((1, 1, 3, 3), 1), F((1, 8, 2, 2), 2), F((1, 1, 2, 2), 3)])
 CASES["deformable_conv_v1"] = CASES["deformable_conv"]
@@ -752,7 +764,17 @@ CASES["data_norm"] = C(
     lambda: [F((2, 3), 1), np.full((3,), 4.0, np.float32),
              F((3,), 2), np.full((3,), 6.0, np.float32)],
     ref=_data_norm_ref, rtol=1e-3)
-CASES["lrn"] = finite(lambda: [F((1, 4, 2, 2), 1), 3])
+def _lrn_ref(x, size):
+    sq = np.zeros_like(x)
+    c_all = x.shape[1]
+    for c in range(c_all):
+        lo, hi = max(0, c - size // 2), min(c_all, c + size // 2 + 1)
+        sq[:, c] = (x[:, lo:hi] ** 2).sum(1)
+    return x / (1.0 + 1e-4 * sq) ** 0.75
+
+
+CASES["lrn"] = C(lambda: [F((1, 4, 2, 2), 1), 3], ref=_lrn_ref,
+                 rtol=1e-3)
 CASES["dropout"] = C(lambda: [F((2, 3), 1)], kwargs={"p": 0.0},
                      ref=lambda a: a, grad=(0,), static=False)
 CASES["lookup_table"] = C(
@@ -796,8 +818,11 @@ CASES["affine_grid"] = shape_is(
 CASES["affine_channel"] = C(
     lambda: [F((1, 2, 2, 2), 1), F((2,), 2), F((2,), 3)],
     ref=lambda x, s, b: x * s.reshape(1, 2, 1, 1) + b.reshape(1, 2, 1, 1))
-CASES["im2sequence"] = finite(
-    lambda: [F((1, 1, 4, 4), 1)], kwargs={"filter_size": 2, "stride": 2})
+CASES["im2sequence"] = C(
+    lambda: [F((1, 1, 4, 4), 1)], kwargs={"filter_size": 2, "stride": 2},
+    ref=lambda x: np.stack([x[0, 0, r:r + 2, c:c + 2].reshape(-1)
+                            for r in (0, 2) for c in (0, 2)]),
+    static=False)
 CASES["spectral_norm"] = prop(
     lambda: [F((4, 3), 1)],
     lambda got, args: np.isfinite(got[0]).all()
@@ -964,7 +989,18 @@ CASES["segment_pool"] = C(
     lambda: [F((4, 2), 1), np.array([0, 0, 1, 1], np.int64)],
     ref=lambda x, s: np.stack([x[:2].sum(0), x[2:].sum(0)]),
     kwargs={"pool_type": "SUM"}, static=False)
-CASES["row_conv"] = finite(lambda: [F((2, 4, 3), 1), F((2, 3), 2)])
+def _row_conv_ref(x, w):
+    # row_conv_op.cc:197: out[k] += x[k+w] * filt[w] (future context)
+    out = np.zeros_like(x)
+    for k in range(x.shape[1]):
+        for j in range(w.shape[0]):
+            if k + j < x.shape[1]:
+                out[:, k] += x[:, k + j] * w[j]
+    return out
+
+
+CASES["row_conv"] = C(lambda: [F((2, 4, 3), 1), F((3, 3), 2)],
+                      ref=_row_conv_ref, rtol=1e-3)
 CASES["beam_search"] = finite(
     lambda: [I((2, 1), 5, 1), F((2, 1), 2, 0.0, 1.0), I((2, 2), 5, 3),
              F((2, 2), 4, 0.0, 1.0), 2, 0], min_outputs=1)
