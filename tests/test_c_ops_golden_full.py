@@ -473,10 +473,10 @@ CASES["mish"] = unary(
     lambda a: a * np.tanh(np.log1p(np.exp(a))), rtol=1e-3)
 CASES["swish_placeholder"] = None
 del CASES["swish_placeholder"]
-CASES["maxout"] = C(lambda: [F((1, 4, 2, 2), 1)],
-                    kwargs={"groups": 2},
-                    check=lambda got, args: got[0].shape == (1, 2, 2, 2),
-                    static=False)
+CASES["maxout"] = C(
+    lambda: [F((1, 4, 2, 2), 1)], kwargs={"groups": 2},
+    # maxouting.cc:44: out[c] = max over ADJACENT in[c*groups + ph]
+    ref=lambda x: x.reshape(1, 2, 2, 2, 2).max(axis=2))
 CASES["prelu"] = C(
     lambda: [F((1, 2, 2, 2), 1), F((2,), 2, 0.1, 0.3)],
     ref=lambda x, w: np.where(x > 0, x, x * w.reshape(1, 2, 1, 1)))
@@ -790,10 +790,19 @@ CASES["pixel_shuffle"] = C(
     check=lambda got, args: got[0].shape == (1, 1, 4, 4), static=False)
 CASES["shuffle_channel"] = C(
     lambda: [F((1, 4, 2, 2), 1)], kwargs={"group": 2},
-    check=lambda got, args: got[0].shape == (1, 4, 2, 2), static=False)
+    # shuffle_channel_op.h:46: out[j*g+i] = in[i*(C/g)+j]
+    ref=lambda x: x.reshape(1, 2, 2, 2, 2).transpose(
+        0, 2, 1, 3, 4).reshape(1, 4, 2, 2))
+def _s2d_ref(x, bs=2):
+    # space_to_depth_op.h:48: offset-major out channel = offset*C + c
+    B, C, H, W = x.shape
+    y = x.reshape(B, C, H // bs, bs, W // bs, bs)
+    return y.transpose(0, 3, 5, 1, 2, 4).reshape(
+        B, C * bs * bs, H // bs, W // bs)
+
+
 CASES["space_to_depth"] = C(
-    lambda: [F((1, 1, 4, 4), 1)], kwargs={"blocksize": 2},
-    check=lambda got, args: got[0].shape == (1, 4, 2, 2), static=False)
+    lambda: [F((1, 2, 4, 4), 1)], kwargs={"blocksize": 2}, ref=_s2d_ref)
 def _tshift_ref(x, seg):
     n = x.shape[0] // seg
     xr = x.reshape(n, seg, *x.shape[1:])
