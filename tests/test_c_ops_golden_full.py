@@ -788,17 +788,29 @@ CASES["one_hot_v2"] = CASES["one_hot"]
 CASES["pixel_shuffle"] = C(
     lambda: [F((1, 4, 2, 2), 1)], kwargs={"upscale_factor": 2},
     check=lambda got, args: got[0].shape == (1, 1, 4, 4), static=False)
+def _shufflech_ref(x, g=2):
+    # shuffle_channel_op.h:46: out[j*g + i] = in[i*(C/g) + j]
+    out = np.empty_like(x)
+    cpg = x.shape[1] // g
+    for i in range(g):
+        for j in range(cpg):
+            out[:, j * g + i] = x[:, i * cpg + j]
+    return out
+
+
+# non-square split (g=2, C/g=3) so the transpose direction is pinned
 CASES["shuffle_channel"] = C(
-    lambda: [F((1, 4, 2, 2), 1)], kwargs={"group": 2},
-    # shuffle_channel_op.h:46: out[j*g+i] = in[i*(C/g)+j]
-    ref=lambda x: x.reshape(1, 2, 2, 2, 2).transpose(
-        0, 2, 1, 3, 4).reshape(1, 4, 2, 2))
+    lambda: [F((1, 6, 2, 2), 1)], kwargs={"group": 2}, ref=_shufflech_ref)
 def _s2d_ref(x, bs=2):
-    # space_to_depth_op.h:48: offset-major out channel = offset*C + c
+    # space_to_depth_op.h:48-51 index math, written as explicit loops so
+    # the oracle is independent of the kernel's reshape/transpose recipe:
+    # out[b, offset*C + c, h, w] = x[b, c, h*bs + offset//bs, w*bs + offset%bs]
     B, C, H, W = x.shape
-    y = x.reshape(B, C, H // bs, bs, W // bs, bs)
-    return y.transpose(0, 3, 5, 1, 2, 4).reshape(
-        B, C * bs * bs, H // bs, W // bs)
+    out = np.empty((B, C * bs * bs, H // bs, W // bs), x.dtype)
+    for off in range(bs * bs):
+        for c in range(C):
+            out[:, off * C + c] = x[:, c, off // bs::bs, off % bs::bs]
+    return out
 
 
 CASES["space_to_depth"] = C(
