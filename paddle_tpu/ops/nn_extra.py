@@ -301,7 +301,8 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
-    """Ref: grid_sampler_op.cc — bilinear sampling of NCHW by [N,H,W,2]."""
+    """Ref: grid_sampler_op.cc — bilinear or nearest (round(),
+    grid_sampler_op.h:228) sampling of NCHW by [N,H,W,2]."""
     def fn(v, g):
         N, C, H, W = v.shape
         gx, gy = g[..., 0], g[..., 1]
@@ -327,10 +328,17 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 vals = jnp.where(inb, vals, 0.0)
             return vals
 
-        out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
-               + gather(y0, x0 + 1) * (wx * (1 - wy))[..., None]
-               + gather(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
-               + gather(y0 + 1, x0 + 1) * (wx * wy)[..., None])
+        if mode == "nearest":
+            # C round() = half away from zero (grid_sampler_op.h:228);
+            # jnp.round is half-to-even and picks the other pixel at
+            # exact .5 coordinates (e.g. the grid center on even sizes)
+            r = lambda f: jnp.sign(f) * jnp.floor(jnp.abs(f) + 0.5)
+            out = gather(r(fy), r(fx))
+        else:
+            out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+                   + gather(y0, x0 + 1) * (wx * (1 - wy))[..., None]
+                   + gather(y0 + 1, x0) * ((1 - wx) * wy)[..., None]
+                   + gather(y0 + 1, x0 + 1) * (wx * wy)[..., None])
         return jnp.transpose(out, (0, 3, 1, 2))
 
     return apply_op("grid_sample", fn, (x, grid), {})

@@ -756,17 +756,91 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     size = tuple(int(s) for s in size)
     method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
 
-    if align_corners and mode in ("bilinear", "bicubic") and min(size) > 1:
+    def _src(n_in, n_out):
+        """Float source coordinates per interpolate_v2_op.h: align_corners
+        spreads output ends onto input ends (ratio 0 when n_out==1, so
+        index 0); otherwise half-pixel centers.  One definition for the
+        nearest/bilinear/bicubic branches so the edge cases can't drift."""
+        k = jnp.arange(n_out, dtype=jnp.float32)
+        if align_corners:
+            r = (n_in - 1.0) / (n_out - 1.0) if n_out > 1 else 0.0
+            return k * r
+        return (k + 0.5) * (n_in / n_out) - 0.5
+
+    if mode == "nearest":
+        # interpolate_v2_op.h:98-103: align_corners -> round on the
+        # (in-1)/(out-1) grid; else floor on the in/out grid (jax.image's
+        # nearest is 'nearest-exact' rounding — NOT the reference's)
+        def fn(v):
+            if not nchw:
+                v = jnp.transpose(v, (0, 3, 1, 2))
+            ih, iw = v.shape[2], v.shape[3]
+            oh, ow = size
+
+            def idx(n_in, n_out):
+                if align_corners:
+                    i = jnp.floor(_src(n_in, n_out) + 0.5)
+                else:
+                    k = jnp.arange(n_out, dtype=jnp.float32)
+                    i = jnp.floor(k * (n_in / n_out))
+                return jnp.clip(i.astype(jnp.int32), 0, n_in - 1)
+
+            out = v[:, :, idx(ih, oh), :][:, :, :, idx(iw, ow)]
+            if not nchw:
+                out = jnp.transpose(out, (0, 2, 3, 1))
+            return out
+
+        return apply_op("interpolate_nearest", fn, (x,), {})
+
+    if mode == "bicubic":
+        # interpolate_v2_op.h:464: cubic convolution with A = -0.75
+        # (jax.image's cubic uses A=-0.5 — different pixels); separable
+        # 4-tap gather with border-replicated taps
+        def fn(v):
+            if not nchw:
+                v = jnp.transpose(v, (0, 3, 1, 2))
+
+            def axis_resize(u, n_in, n_out, axis):
+                s = _src(n_in, n_out)
+                x1 = jnp.floor(s)
+                t = s - x1
+                A = -0.75
+                d0, d1, d2, d3 = 1.0 + t, t, 1.0 - t, 2.0 - t
+                ws = [
+                    A * d0**3 - 5 * A * d0**2 + 8 * A * d0 - 4 * A,
+                    (A + 2) * d1**3 - (A + 3) * d1**2 + 1,
+                    (A + 2) * d2**3 - (A + 3) * d2**2 + 1,
+                    A * d3**3 - 5 * A * d3**2 + 8 * A * d3 - 4 * A,
+                ]
+                acc = 0.0
+                for off, w in zip((-1, 0, 1, 2), ws):
+                    ii = jnp.clip(x1.astype(jnp.int32) + off, 0, n_in - 1)
+                    tap = jnp.take(u, ii, axis=axis)
+                    shape = [1] * u.ndim
+                    shape[axis] = n_out
+                    acc = acc + tap * w.reshape(shape)
+                return acc
+
+            out = axis_resize(v.astype(jnp.float32), v.shape[2], size[0], 2)
+            out = axis_resize(out, v.shape[3], size[1], 3).astype(v.dtype)
+            if not nchw:
+                out = jnp.transpose(out, (0, 2, 3, 1))
+            return out
+
+        return apply_op("interpolate_bicubic", fn, (x,), {})
+
+    if align_corners and mode == "bilinear":
         # jax.image.resize is half-pixel only; align_corners maps output grid
-        # ends onto input grid ends: src = i * (in-1)/(out-1), then gather +
-        # bilinear blend (matches the reference kernel's align_corners branch).
+        # ends onto input grid ends via _src, then gather + bilinear blend
+        # (matches the reference kernel's align_corners branch; n_out==1
+        # degenerates to index 0 like the reference's ratio=0).
         def fn(v):
             if not nchw:
                 v = jnp.transpose(v, (0, 3, 1, 2))
             H, W = v.shape[2], v.shape[3]
             oh, ow = size
-            ys = jnp.linspace(0.0, H - 1.0, oh)
-            xs = jnp.linspace(0.0, W - 1.0, ow)
+            ys = _src(H, oh)
+            xs = _src(W, ow)
             y0 = jnp.floor(ys).astype(jnp.int32)
             x0 = jnp.floor(xs).astype(jnp.int32)
             y1 = jnp.minimum(y0 + 1, H - 1)

@@ -1,0 +1,175 @@
+"""Hard-kernel oracles from torch (CPU) — an independent reference
+implementation for the kernels whose semantics are too intricate for
+hand-written numpy (conv stride/pad/dilation/groups, transposed convs,
+grid_sample, interpolate, ctc_loss, unpool, unfold, affine_grid).
+
+The reference's op_test uses numpy oracles; for these kernels numpy
+reimplementations would just mirror our own code, so torch's
+battle-tested CPU kernels serve as the disinterested referee instead
+(same NCHW conventions as the reference).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+def _tt(a):
+    return torch.from_numpy(np.asarray(a))
+
+
+R = np.random.RandomState(0)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1),
+    (2, 1, 1, 1),
+    (1, 2, 2, 1),
+    (1, 1, 1, 2),
+])
+def test_conv2d_matches_torch(stride, padding, dilation, groups):
+    x = R.randn(2, 4, 9, 9).astype(np.float32)
+    w = R.randn(6, 4 // groups, 3, 3).astype(np.float32)
+    b = R.randn(6).astype(np.float32)
+    got = _np(F.conv2d(_t(x), _t(w), _t(b), stride=stride, padding=padding,
+                       dilation=dilation, groups=groups))
+    want = TF.conv2d(_tt(x), _tt(w), _tt(b), stride=stride, padding=padding,
+                     dilation=dilation, groups=groups).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+def test_conv2d_transpose_matches_torch(stride, padding):
+    x = R.randn(2, 4, 5, 5).astype(np.float32)
+    w = R.randn(4, 3, 3, 3).astype(np.float32)  # (Cin, Cout, kh, kw)
+    got = _np(F.conv2d_transpose(_t(x), _t(w), stride=stride,
+                                 padding=padding))
+    want = TF.conv_transpose2d(_tt(x), _tt(w), stride=stride,
+                               padding=padding).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_matches_torch():
+    x = R.randn(1, 2, 5, 5, 5).astype(np.float32)
+    w = R.randn(3, 2, 2, 2, 2).astype(np.float32)
+    got = _np(F.conv3d(_t(x), _t(w), stride=2, padding=1))
+    want = TF.conv3d(_tt(x), _tt(w), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_transpose_matches_torch():
+    x = R.randn(1, 2, 3, 3, 3).astype(np.float32)
+    w = R.randn(2, 3, 2, 2, 2).astype(np.float32)
+    got = _np(F.conv3d_transpose(_t(x), _t(w), stride=2))
+    want = TF.conv_transpose3d(_tt(x), _tt(w), stride=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode,align", [
+    ("bilinear", True), ("bilinear", False), ("nearest", False),
+])
+def test_grid_sample_matches_torch(mode, align):
+    x = R.randn(1, 2, 5, 5).astype(np.float32)
+    grid = (R.rand(1, 4, 4, 2).astype(np.float32) * 2 - 1)
+    got = _np(F.grid_sample(_t(x), _t(grid), mode=mode,
+                            align_corners=align))
+    want = TF.grid_sample(_tt(x), _tt(grid), mode=mode,
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode,align,size", [
+    ("nearest", False, (7, 7)),
+    ("bilinear", False, (7, 7)),
+    ("bilinear", True, (7, 7)),
+    ("bicubic", False, (6, 6)),
+])
+def test_interpolate_matches_torch(mode, align, size):
+    x = R.randn(1, 2, 4, 4).astype(np.float32)
+    kw = {} if mode == "nearest" else {"align_corners": align}
+    got = _np(F.interpolate(_t(x), size=list(size), mode=mode, **kw))
+    want = TF.interpolate(_tt(x), size=size, mode=mode, **kw).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_affine_grid_matches_torch(align):
+    theta = R.randn(2, 2, 3).astype(np.float32)
+    got = _np(F.affine_grid(_t(theta), [2, 1, 4, 5], align_corners=align))
+    want = TF.affine_grid(_tt(theta), [2, 1, 4, 5],
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_matches_torch():
+    T_, B, C = 6, 2, 5
+    logits = R.randn(T_, B, C).astype(np.float32)
+    log_probs = np.log(np.exp(logits)
+                       / np.exp(logits).sum(-1, keepdims=True))
+    labels = np.array([[1, 2, 3], [2, 3, 4]], np.int64)
+    in_len = np.array([6, 6], np.int64)
+    lab_len = np.array([3, 2], np.int64)
+    got = _np(F.ctc_loss(_t(log_probs.astype(np.float32)), _t(labels),
+                         _t(in_len), _t(lab_len), blank=0,
+                         reduction="none"))
+    want = TF.ctc_loss(_tt(log_probs), _tt(labels), _tt(in_len),
+                       _tt(lab_len), blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(np.ravel(got), np.ravel(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_max_unpool2d_matches_torch():
+    x = R.randn(1, 2, 6, 6).astype(np.float32)
+    tout, tidx = TF.max_pool2d(_tt(x), 2, return_indices=True)
+    got = _np(paddle.max_unpool2d(_t(tout.numpy()), _t(tidx.numpy()), 2))
+    want = TF.max_unpool2d(tout, tidx, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pixel_shuffle_matches_torch():
+    x = R.randn(1, 8, 3, 3).astype(np.float32)
+    got = _np(F.pixel_shuffle(_t(x), 2))
+    want = TF.pixel_shuffle(_tt(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_unfold_matches_torch():
+    x = R.randn(1, 2, 5, 5).astype(np.float32)
+    got = _np(F.unfold(_t(x), [2, 2], strides=2, paddings=1))
+    want = TF.unfold(_tt(x), (2, 2), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_avg_max_pool2d_padding_matches_torch():
+    x = R.randn(1, 2, 7, 7).astype(np.float32)
+    # paddle avg_pool2d defaults exclusive=True (padding not counted)
+    got = _np(F.avg_pool2d(_t(x), 3, stride=2, padding=1))
+    want = TF.avg_pool2d(_tt(x), 3, stride=2, padding=1,
+                         count_include_pad=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got = _np(F.max_pool2d(_t(x), 3, stride=2, padding=1))
+    want = TF.max_pool2d(_tt(x), 3, stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_log_softmax_gelu_silu_match_torch():
+    x = R.randn(3, 7).astype(np.float32)
+    np.testing.assert_allclose(
+        _np(F.log_softmax(_t(x), axis=-1)),
+        TF.log_softmax(_tt(x), dim=-1).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _np(F.gelu(_t(x))), TF.gelu(_tt(x)).numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        _np(F.silu(_t(x))), TF.silu(_tt(x)).numpy(), rtol=1e-5, atol=1e-6)
