@@ -113,11 +113,16 @@ def test_affine_grid_matches_torch(align):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def _log_softmax_np(logits):
+    m = logits.max(-1, keepdims=True)
+    z = logits - m
+    return (z - np.log(np.exp(z).sum(-1, keepdims=True))).astype(np.float32)
+
+
 def test_ctc_loss_matches_torch():
     T_, B, C = 6, 2, 5
     logits = R.randn(T_, B, C).astype(np.float32)
-    log_probs = np.log(np.exp(logits)
-                       / np.exp(logits).sum(-1, keepdims=True))
+    log_probs = _log_softmax_np(logits)
     labels = np.array([[1, 2, 3], [2, 3, 4]], np.int64)
     in_len = np.array([6, 6], np.int64)
     lab_len = np.array([3, 2], np.int64)
@@ -173,3 +178,125 @@ def test_log_softmax_gelu_silu_match_torch():
         _np(F.gelu(_t(x))), TF.gelu(_tt(x)).numpy(), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(
         _np(F.silu(_t(x))), TF.silu(_tt(x)).numpy(), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# gradient parity: our vjp vs torch autograd, same random cotangent
+
+
+def _grad_pair(pfn, tfn, arrays, wrt):
+    ts = [_t(a) for a in arrays]
+    for i, v in enumerate(ts):
+        v.stop_gradient = (i != wrt)
+    out = pfn(*ts)
+    co = np.asarray(np.random.RandomState(7).standard_normal(
+        _np(out).shape), np.float32)
+    (out * _t(co)).sum().backward()
+    got = _np(ts[wrt].grad)
+
+    tts = [torch.tensor(a, requires_grad=(i == wrt))
+           for i, a in enumerate(arrays)]
+    tout = tfn(*tts)
+    (tout * _tt(co)).sum().backward()
+    want = tts[wrt].grad.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("wrt", [0, 1])
+def test_conv2d_grad_matches_torch(wrt):
+    x = R.randn(2, 4, 7, 7).astype(np.float32)
+    w = R.randn(6, 2, 3, 3).astype(np.float32)
+    _grad_pair(
+        lambda xv, wv: F.conv2d(xv, wv, None, stride=1, padding=1,
+                                dilation=2, groups=2),
+        lambda xv, wv: TF.conv2d(xv, wv, None, stride=1, padding=1,
+                                 dilation=2, groups=2),
+        [x, w], wrt)
+
+
+def test_conv2d_transpose_grad_matches_torch():
+    x = R.randn(1, 3, 5, 5).astype(np.float32)
+    w = R.randn(3, 2, 3, 3).astype(np.float32)
+    _grad_pair(
+        lambda xv, wv: F.conv2d_transpose(xv, wv, stride=2, padding=1),
+        lambda xv, wv: TF.conv_transpose2d(xv, wv, stride=2, padding=1),
+        [x, w], 0)
+
+
+@pytest.mark.parametrize("wrt", [0, 1])
+def test_grid_sample_grad_matches_torch(wrt):
+    x = R.randn(1, 2, 5, 5).astype(np.float32)
+    grid = (R.rand(1, 3, 3, 2).astype(np.float32) * 1.6 - 0.8)
+    _grad_pair(
+        lambda xv, gv: F.grid_sample(xv, gv, align_corners=True),
+        lambda xv, gv: TF.grid_sample(xv, gv, align_corners=True),
+        [x, grid], wrt)
+
+
+@pytest.mark.parametrize("mode,align", [
+    ("bilinear", True), ("bilinear", False), ("bicubic", False),
+])
+def test_interpolate_grad_matches_torch(mode, align):
+    x = R.randn(1, 2, 4, 4).astype(np.float32)
+    _grad_pair(
+        lambda xv: F.interpolate(xv, size=[7, 7], mode=mode,
+                                 align_corners=align),
+        lambda xv: TF.interpolate(xv, size=(7, 7), mode=mode,
+                                  align_corners=align),
+        [x], 0)
+
+
+def test_ctc_loss_grad_matches_torch():
+    T_, B, C = 6, 2, 5
+    logits = R.randn(T_, B, C).astype(np.float32)
+    lp = _log_softmax_np(logits)
+    labels = np.array([[1, 2, 3], [2, 3, 4]], np.int64)
+    in_len = np.array([6, 6], np.int64)
+    lab_len = np.array([3, 2], np.int64)
+    _grad_pair(
+        lambda pv: F.ctc_loss(pv, _t(labels), _t(in_len), _t(lab_len),
+                              blank=0, reduction="sum"),
+        lambda pv: TF.ctc_loss(pv, _tt(labels), _tt(in_len), _tt(lab_len),
+                               blank=0, reduction="sum"),
+        [lp], 0)
+
+
+# ---------------------------------------------------------------------------
+# recurrent cells: same gate order/formulas as torch, weights copied
+
+
+def test_lstm_cell_matches_torch():
+    paddle.seed(0)
+    cell = paddle.nn.LSTMCell(4, 3)
+    tcell = torch.nn.LSTMCell(4, 3)
+    with torch.no_grad():
+        tcell.weight_ih.copy_(_tt(_np(cell.weight_ih)))
+        tcell.weight_hh.copy_(_tt(_np(cell.weight_hh)))
+        tcell.bias_ih.copy_(_tt(_np(cell.bias_ih)))
+        tcell.bias_hh.copy_(_tt(_np(cell.bias_hh)))
+    x = R.randn(2, 4).astype(np.float32)
+    h0 = R.randn(2, 3).astype(np.float32)
+    c0 = R.randn(2, 3).astype(np.float32)
+    _, (h, c) = cell(_t(x), (_t(h0), _t(c0)))
+    th, tc = tcell(_tt(x), (_tt(h0), _tt(c0)))
+    np.testing.assert_allclose(_np(h), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(_np(c), tc.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_cell_matches_torch():
+    paddle.seed(0)
+    cell = paddle.nn.GRUCell(4, 3)
+    tcell = torch.nn.GRUCell(4, 3)
+    with torch.no_grad():
+        tcell.weight_ih.copy_(_tt(_np(cell.weight_ih)))
+        tcell.weight_hh.copy_(_tt(_np(cell.weight_hh)))
+        tcell.bias_ih.copy_(_tt(_np(cell.bias_ih)))
+        tcell.bias_hh.copy_(_tt(_np(cell.bias_hh)))
+    x = R.randn(2, 4).astype(np.float32)
+    h0 = R.randn(2, 3).astype(np.float32)
+    h, _ = cell(_t(x), _t(h0))
+    th = tcell(_tt(x), _tt(h0))
+    np.testing.assert_allclose(_np(h), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
