@@ -214,13 +214,14 @@ def test_conv2d_grad_matches_torch(wrt):
         [x, w], wrt)
 
 
-def test_conv2d_transpose_grad_matches_torch():
+@pytest.mark.parametrize("wrt", [0, 1])
+def test_conv2d_transpose_grad_matches_torch(wrt):
     x = R.randn(1, 3, 5, 5).astype(np.float32)
     w = R.randn(3, 2, 3, 3).astype(np.float32)
     _grad_pair(
         lambda xv, wv: F.conv2d_transpose(xv, wv, stride=2, padding=1),
         lambda xv, wv: TF.conv_transpose2d(xv, wv, stride=2, padding=1),
-        [x, w], 0)
+        [x, w], wrt)
 
 
 @pytest.mark.parametrize("wrt", [0, 1])
@@ -300,3 +301,60 @@ def test_gru_cell_matches_torch():
     th = tcell(_tt(x), _tt(h0))
     np.testing.assert_allclose(_np(h), th.detach().numpy(), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_bidirectional_stacked_lstm_matches_torch():
+    """2-layer bidirectional LSTM over a sequence: same parameter names
+    as torch (weight_ih_l{k}[_reverse] ...), weights copied directly."""
+    paddle.seed(0)
+    net = paddle.nn.LSTM(4, 3, num_layers=2, direction="bidirect")
+    tnet = torch.nn.LSTM(4, 3, num_layers=2, bidirectional=True,
+                         batch_first=True)
+    params = dict(net.named_parameters())
+    with torch.no_grad():
+        for name, _ in tnet.named_parameters():
+            getattr(tnet, name).copy_(_tt(_np(params[name])))
+    x = R.randn(2, 5, 4).astype(np.float32)
+    out, (h, c) = net(_t(x))
+    tout, (th, tc) = tnet(_tt(x))
+    np.testing.assert_allclose(_np(out), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(h), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(c), tc.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multihead_attention_matches_torch():
+    """Our separate q/k/v projections vs torch's packed in_proj, weights
+    mapped (paddle Linear stores [in, out] = torch weight transposed)."""
+    paddle.seed(0)
+    E, H, B, L = 8, 2, 2, 5
+    mha = paddle.nn.MultiHeadAttention(E, H)
+    tmha = torch.nn.MultiheadAttention(E, H, batch_first=True)
+    qw = _np(mha.q_proj.weight).T
+    kw = _np(mha.k_proj.weight).T
+    vw = _np(mha.v_proj.weight).T
+    qb = _np(mha.q_proj.bias)
+    kb = _np(mha.k_proj.bias)
+    vb = _np(mha.v_proj.bias)
+    with torch.no_grad():
+        tmha.in_proj_weight.copy_(_tt(np.concatenate([qw, kw, vw], 0)))
+        tmha.in_proj_bias.copy_(_tt(np.concatenate([qb, kb, vb], 0)))
+        tmha.out_proj.weight.copy_(_tt(_np(mha.out_proj.weight).T))
+        tmha.out_proj.bias.copy_(_tt(_np(mha.out_proj.bias)))
+    x = R.randn(B, L, E).astype(np.float32)
+    got = _np(mha(_t(x), _t(x), _t(x)))
+    want, _ = tmha(_tt(x), _tt(x), _tt(x))
+    np.testing.assert_allclose(got, want.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_cross_entropy_grad_matches_torch():
+    logits = R.randn(4, 6).astype(np.float32)
+    labels = np.array([1, 3, 5, 0], np.int64)
+    _grad_pair(
+        lambda lv: F.softmax_with_cross_entropy(
+            lv, _t(labels.reshape(-1, 1))).sum(),
+        lambda lv: TF.cross_entropy(lv, _tt(labels), reduction="sum"),
+        [logits], 0)
