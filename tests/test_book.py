@@ -260,3 +260,81 @@ def test_book_understand_sentiment_lstm():
         opt.clear_grad()
         losses.append(float(_np(loss)))
     assert losses[-1] < 0.6 * losses[0], losses[::5]
+
+
+def test_book_machine_translation():
+    """The remaining reference book chapter (test_machine_translation.py):
+    attention seq2seq trained on a tiny reverse-copy task, then beam
+    search inference through BeamSearchDecoder + dynamic_decode."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    V, D, B, T = 16, 16, 8, 5
+    paddle.seed(0)
+    rng = np.random.RandomState(7)
+
+    emb = nn.Embedding(V, D)
+    enc = nn.GRU(D, D)
+    dec_cell = nn.GRUCell(2 * D, D)
+    out_fc = nn.Linear(D, V)
+    params = (list(emb.parameters()) + list(enc.parameters())
+              + list(dec_cell.parameters()) + list(out_fc.parameters()))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=params)
+
+    def attention(h, enc_out):
+        # dot-product attention over encoder states
+        scores = paddle.matmul(enc_out, h.unsqueeze(-1)).squeeze(-1)
+        w = F.softmax(scores, axis=-1)
+        return paddle.matmul(w.unsqueeze(1), enc_out).squeeze(1)
+
+    def step_loss(src, tgt):
+        enc_out, _ = enc(emb(src))             # (B, T, D)
+        h = enc_out[:, -1]
+        loss = 0
+        prev = paddle.to_tensor(np.zeros((B,), np.int64))  # <s>=0
+        for t in range(T):
+            ctx = attention(h, enc_out)
+            inp = paddle.concat([emb(prev), ctx], axis=-1)
+            h, _ = dec_cell(inp, h)
+            logits = out_fc(h)
+            loss = loss + paddle.mean(F.softmax_with_cross_entropy(
+                logits, tgt[:, t:t + 1]))
+            prev = tgt[:, t]                    # teacher forcing
+        return loss / T
+
+    src_np = rng.randint(1, V, (B, T)).astype(np.int64)
+    tgt_np = src_np[:, ::-1].copy()             # translation = reversal
+    src, tgt = paddle.to_tensor(src_np), paddle.to_tensor(tgt_np)
+    losses = []
+    for _ in range(25):
+        loss = step_loss(src, tgt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.ravel(loss.numpy())[0]))
+    assert losses[-1] < 0.6 * losses[0], losses[::6]
+
+    # inference: beam search over the trained decoder
+    enc_out, _ = enc(emb(src))
+    h0 = enc_out[:, -1]
+
+    class _Wrap:
+        """BeamSearchDecoder cell contract: ids + states -> logits,
+        states (state pytree mirrors the inits tuple)."""
+
+        def __call__(self, ids, states):
+            h = states[0] if isinstance(states, (list, tuple)) else states
+            ctx = attention(h, enc_out_rep)
+            inp = paddle.concat([emb(ids), ctx], axis=-1)
+            h2, _ = dec_cell(inp, h)
+            return out_fc(h2), (h2,)
+
+    K = 3
+    enc_out_rep = paddle.to_tensor(
+        np.repeat(np.asarray(enc_out._data), K, axis=0))
+    dec = nn.BeamSearchDecoder(_Wrap(), start_token=0, end_token=V - 1,
+                               beam_size=K)
+    out, scores = nn.dynamic_decode(dec, inits=(h0,), max_step_num=T)
+    arr = np.asarray(out._data)
+    assert arr.shape[0] == B and arr.shape[2] == K
+    assert np.isfinite(np.asarray(scores._data)).all()
