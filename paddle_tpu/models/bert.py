@@ -58,7 +58,13 @@ class BertEmbeddings(Layer):
         pos = MAN.expand(MAN.reshape(arange(L, dtype="int32"), [1, L]), [B, L])
         emb = M.add(self.word_embeddings(input_ids),
                     self.position_embeddings(pos))
-        if token_type_ids is not None:
+        if token_type_ids is None:
+            # default segment is type 0, NOT "no type embedding": omitting
+            # the row-0 vector would make ids-only calls compute a
+            # different network than explicit zeros (and starve that
+            # parameter of gradient)
+            emb = M.add(emb, self.token_type_embeddings.weight[0])
+        else:
             emb = M.add(emb, self.token_type_embeddings(token_type_ids))
         return self.dropout(self.layer_norm(emb))
 

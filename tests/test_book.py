@@ -73,7 +73,7 @@ def test_book_word2vec_nce():
     paddle.seed(2)
     rng = np.random.RandomState(2)
     V, D, B = 50, 16, 128
-    emb = nn.Embedding(V + 1, D)
+    emb = nn.Embedding(V, D)
     nce_w = paddle.create_parameter([V, D], "float32")
     nce_b = paddle.create_parameter([V], "float32")
     # corpus: word w is followed by (w+1) % V
@@ -104,7 +104,7 @@ def test_book_label_semantic_roles_crf():
     B, T, V, N, D = 8, 10, 40, 5, 16
     words = rng.randint(0, V, (B, T)).astype(np.int64)
     labels = (words[:, :] % N).astype(np.int64)  # learnable mapping
-    emb = nn.Embedding(V + 1, D)
+    emb = nn.Embedding(V, D)
     proj = nn.Linear(D, N)
     trans = paddle.create_parameter([N + 2, N], "float32")
     lens = paddle.to_tensor(np.full((B,), T, np.int64))
@@ -274,7 +274,7 @@ def test_book_machine_translation():
     paddle.seed(0)
     rng = np.random.RandomState(7)
 
-    emb = nn.Embedding(V + 1, D)
+    emb = nn.Embedding(V + 1, D)  # + reserved </s> row
     enc = nn.GRU(D, D)
     dec_cell = nn.GRUCell(2 * D, D)
     out_fc = nn.Linear(D, V + 1)  # logits include </s>

@@ -1,0 +1,211 @@
+"""Flagship-model oracle: our BERT encoder vs HuggingFace BertModel.
+
+The kernel- and layer-level torch oracles (test_torch_oracle.py) pin the
+pieces; this pins the COMPOSITION — embeddings (word+position+type, LN),
+N post-LN encoder blocks, pooler — by copying one set of random weights
+into both implementations and demanding the same hidden states.  HF's
+BertModel is an independent, battle-tested implementation of the same
+architecture our models/bert.py re-derives.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.bert import BertModel as OurBert, BertConfig
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+def _copy(dst_param, src):
+    with torch.no_grad():
+        dst_param.copy_(torch.from_numpy(np.ascontiguousarray(src)))
+
+
+def test_bert_matches_huggingface():
+    V, H, L_LAYERS, HEADS, FFN, MAXP = 101, 32, 3, 4, 64, 16
+    paddle.seed(0)
+    ours = OurBert(BertConfig(
+        vocab_size=V, hidden_size=H, num_layers=L_LAYERS, num_heads=HEADS,
+        ffn_hidden=FFN, max_seq_len=MAXP, type_vocab_size=2, dropout=0.0))
+    ours.eval()
+
+    hf = transformers.BertModel(transformers.BertConfig(
+        vocab_size=V, hidden_size=H, num_hidden_layers=L_LAYERS,
+        num_attention_heads=HEADS, intermediate_size=FFN,
+        max_position_embeddings=MAXP, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu", layer_norm_eps=1e-5))  # ours uses 1e-5
+    hf.eval()
+
+    # ---- copy OUR random weights into HF (torch Linear stores [out,in]:
+    # our Linear stores [in,out], so weights transpose) ----
+    emb = ours.embeddings
+    _copy(hf.embeddings.word_embeddings.weight, _np(emb.word_embeddings.weight))
+    _copy(hf.embeddings.position_embeddings.weight,
+          _np(emb.position_embeddings.weight))
+    _copy(hf.embeddings.token_type_embeddings.weight,
+          _np(emb.token_type_embeddings.weight))
+    _copy(hf.embeddings.LayerNorm.weight, _np(emb.layer_norm.weight))
+    _copy(hf.embeddings.LayerNorm.bias, _np(emb.layer_norm.bias))
+
+    for i, layer in enumerate(ours.encoder.layers):
+        hl = hf.encoder.layer[i]
+        a = layer.self_attn
+        _copy(hl.attention.self.query.weight, _np(a.q_proj.weight).T)
+        _copy(hl.attention.self.query.bias, _np(a.q_proj.bias))
+        _copy(hl.attention.self.key.weight, _np(a.k_proj.weight).T)
+        _copy(hl.attention.self.key.bias, _np(a.k_proj.bias))
+        _copy(hl.attention.self.value.weight, _np(a.v_proj.weight).T)
+        _copy(hl.attention.self.value.bias, _np(a.v_proj.bias))
+        _copy(hl.attention.output.dense.weight, _np(a.out_proj.weight).T)
+        _copy(hl.attention.output.dense.bias, _np(a.out_proj.bias))
+        _copy(hl.attention.output.LayerNorm.weight, _np(layer.norm1.weight))
+        _copy(hl.attention.output.LayerNorm.bias, _np(layer.norm1.bias))
+        _copy(hl.intermediate.dense.weight, _np(layer.linear1.weight).T)
+        _copy(hl.intermediate.dense.bias, _np(layer.linear1.bias))
+        _copy(hl.output.dense.weight, _np(layer.linear2.weight).T)
+        _copy(hl.output.dense.bias, _np(layer.linear2.bias))
+        _copy(hl.output.LayerNorm.weight, _np(layer.norm2.weight))
+        _copy(hl.output.LayerNorm.bias, _np(layer.norm2.bias))
+
+    _copy(hf.pooler.dense.weight, _np(ours.pooler.weight).T)
+    _copy(hf.pooler.dense.bias, _np(ours.pooler.bias))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, (2, 12)).astype(np.int64)
+    types = rng.randint(0, 2, (2, 12)).astype(np.int64)
+
+    seq, pooled = ours(paddle.to_tensor(ids), paddle.to_tensor(types))
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 token_type_ids=torch.from_numpy(types))
+    np.testing.assert_allclose(_np(seq), out.last_hidden_state.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(_np(pooled), out.pooler_output.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_bert_attention_mask_matches_huggingface():
+    """Padding-mask parity vs HF on the unmasked positions (ours takes an
+    additive mask; HF takes 1/0 and builds the additive form itself),
+    plus masked-position invariance on our side."""
+    V, H = 50, 16
+    paddle.seed(1)
+    ours = OurBert(BertConfig(vocab_size=V, hidden_size=H, num_layers=1,
+                              num_heads=2, ffn_hidden=32, max_seq_len=8,
+                              type_vocab_size=2, dropout=0.0))
+    ours.eval()
+    hf = transformers.BertModel(transformers.BertConfig(
+        vocab_size=V, hidden_size=H, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=8, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu", layer_norm_eps=1e-5))
+    hf.eval()
+    emb = ours.embeddings
+    _copy(hf.embeddings.word_embeddings.weight,
+          _np(emb.word_embeddings.weight))
+    _copy(hf.embeddings.position_embeddings.weight,
+          _np(emb.position_embeddings.weight))
+    _copy(hf.embeddings.token_type_embeddings.weight,
+          _np(emb.token_type_embeddings.weight))
+    _copy(hf.embeddings.LayerNorm.weight, _np(emb.layer_norm.weight))
+    _copy(hf.embeddings.LayerNorm.bias, _np(emb.layer_norm.bias))
+    layer, hl = ours.encoder.layers[0], hf.encoder.layer[0]
+    a = layer.self_attn
+    _copy(hl.attention.self.query.weight, _np(a.q_proj.weight).T)
+    _copy(hl.attention.self.query.bias, _np(a.q_proj.bias))
+    _copy(hl.attention.self.key.weight, _np(a.k_proj.weight).T)
+    _copy(hl.attention.self.key.bias, _np(a.k_proj.bias))
+    _copy(hl.attention.self.value.weight, _np(a.v_proj.weight).T)
+    _copy(hl.attention.self.value.bias, _np(a.v_proj.bias))
+    _copy(hl.attention.output.dense.weight, _np(a.out_proj.weight).T)
+    _copy(hl.attention.output.dense.bias, _np(a.out_proj.bias))
+    _copy(hl.attention.output.LayerNorm.weight, _np(layer.norm1.weight))
+    _copy(hl.attention.output.LayerNorm.bias, _np(layer.norm1.bias))
+    _copy(hl.intermediate.dense.weight, _np(layer.linear1.weight).T)
+    _copy(hl.intermediate.dense.bias, _np(layer.linear1.bias))
+    _copy(hl.output.dense.weight, _np(layer.linear2.weight).T)
+    _copy(hl.output.dense.bias, _np(layer.linear2.bias))
+    _copy(hl.output.LayerNorm.weight, _np(layer.norm2.weight))
+    _copy(hl.output.LayerNorm.bias, _np(layer.norm2.bias))
+    _copy(hf.pooler.dense.weight, _np(ours.pooler.weight).T)
+    _copy(hf.pooler.dense.bias, _np(ours.pooler.bias))
+
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, V, (1, 6)).astype(np.int64)
+    mask = np.array([[1, 1, 1, 1, 0, 0]], np.int64)
+    # additive-mask convention: 0/1 mask -> -inf on masked columns
+    add_mask = ((mask - 1) * 1e9).astype(np.float32)
+    seq_m, _ = ours(paddle.to_tensor(ids),
+                    attention_mask=paddle.to_tensor(add_mask))
+    with torch.no_grad():
+        hf_out = hf(input_ids=torch.from_numpy(ids),
+                    attention_mask=torch.from_numpy(mask))
+    np.testing.assert_allclose(
+        _np(seq_m)[0, :4], hf_out.last_hidden_state.numpy()[0, :4],
+        rtol=1e-3, atol=1e-4)
+    ids2 = ids.copy()
+    ids2[0, 4:] = (ids2[0, 4:] + 7) % V  # mutate only masked positions
+    seq_m2, _ = ours(paddle.to_tensor(ids2),
+                     attention_mask=paddle.to_tensor(add_mask))
+    np.testing.assert_allclose(_np(seq_m)[0, :4], _np(seq_m2)[0, :4],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_matches_huggingface():
+    """Flagship bench model vs HF GPT2Model: same pre-LN architecture;
+    our head-major packed qkv columns are permuted onto HF c_attn's
+    [q_all|k_all|v_all] layout (HF Conv1D stores [in, out] like our
+    Linear, so no transpose)."""
+    from paddle_tpu.models.gpt import GPTModel as OurGPT, GPTConfig
+
+    V, H, LAYERS, HEADS, FFN, MAXP = 97, 32, 2, 4, 128, 16
+    D = H // HEADS
+    paddle.seed(0)
+    ours = OurGPT(GPTConfig(vocab_size=V, hidden_size=H, num_layers=LAYERS,
+                            num_heads=HEADS, ffn_hidden=FFN,
+                            max_seq_len=MAXP, dropout=0.0))
+    ours.eval()
+    hf = transformers.GPT2Model(transformers.GPT2Config(
+        vocab_size=V, n_embd=H, n_layer=LAYERS, n_head=HEADS, n_inner=FFN,
+        n_positions=MAXP, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu"))  # exact-erf gelu, like our F.gelu
+    hf.eval()
+
+    _copy(hf.wte.weight, _np(ours.wte.weight))
+    _copy(hf.wpe.weight, _np(ours.wpe.weight))
+    # column permutation: our col (head*3 + {q,k,v})*D + d -> HF q|k|v blocks
+    tri = np.arange(3 * H).reshape(HEADS, 3, D)
+    perm = np.concatenate([tri[:, 0].ravel(), tri[:, 1].ravel(),
+                           tri[:, 2].ravel()])
+    for i, blk in enumerate(ours.blocks):
+        hl = hf.h[i]
+        _copy(hl.ln_1.weight, _np(blk.ln1.weight))
+        _copy(hl.ln_1.bias, _np(blk.ln1.bias))
+        qkv_w = _np(blk.attn.qkv.weight)  # [H, 3H], head-major triples
+        qkv_b = _np(blk.attn.qkv.bias)
+        _copy(hl.attn.c_attn.weight, qkv_w[:, perm])
+        _copy(hl.attn.c_attn.bias, qkv_b[perm])
+        _copy(hl.attn.c_proj.weight, _np(blk.attn.out_proj.weight))
+        _copy(hl.attn.c_proj.bias, _np(blk.attn.out_proj.bias))
+        _copy(hl.ln_2.weight, _np(blk.ln2.weight))
+        _copy(hl.ln_2.bias, _np(blk.ln2.bias))
+        _copy(hl.mlp.c_fc.weight, _np(blk.mlp.fc_in.weight))
+        _copy(hl.mlp.c_fc.bias, _np(blk.mlp.fc_in.bias))
+        _copy(hl.mlp.c_proj.weight, _np(blk.mlp.fc_out.weight))
+        _copy(hl.mlp.c_proj.bias, _np(blk.mlp.fc_out.bias))
+    _copy(hf.ln_f.weight, _np(ours.ln_f.weight))
+    _copy(hf.ln_f.bias, _np(ours.ln_f.bias))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, (2, 10)).astype(np.int64)
+    got = _np(ours(paddle.to_tensor(ids)))
+    with torch.no_grad():
+        want = hf(input_ids=torch.from_numpy(ids)).last_hidden_state.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
