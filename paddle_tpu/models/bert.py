@@ -87,7 +87,9 @@ class BertModel(Layer):
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
         if attention_mask is not None:
-            # [B, L] 1/0 -> additive [B, 1, 1, L]
+            # contract: an ADDITIVE mask (0 keep / -inf drop), reshaped
+            # [B, L] -> [B, 1, 1, L]; tested vs HF in
+            # tests/test_hf_bert_oracle.py
             am = MAN.reshape(attention_mask,
                              [attention_mask.shape[0], 1, 1,
                               attention_mask.shape[1]])

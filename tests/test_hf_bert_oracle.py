@@ -26,116 +26,8 @@ def _copy(dst_param, src):
         dst_param.copy_(torch.from_numpy(np.ascontiguousarray(src)))
 
 
-def test_bert_matches_huggingface():
-    V, H, L_LAYERS, HEADS, FFN, MAXP = 101, 32, 3, 4, 64, 16
-    paddle.seed(0)
-    ours = OurBert(BertConfig(
-        vocab_size=V, hidden_size=H, num_layers=L_LAYERS, num_heads=HEADS,
-        ffn_hidden=FFN, max_seq_len=MAXP, type_vocab_size=2, dropout=0.0))
-    ours.eval()
-
-    hf = transformers.BertModel(transformers.BertConfig(
-        vocab_size=V, hidden_size=H, num_hidden_layers=L_LAYERS,
-        num_attention_heads=HEADS, intermediate_size=FFN,
-        max_position_embeddings=MAXP, type_vocab_size=2,
-        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        hidden_act="gelu", layer_norm_eps=1e-5))  # ours uses 1e-5
-    hf.eval()
-
-    # ---- copy OUR random weights into HF (torch Linear stores [out,in]:
-    # our Linear stores [in,out], so weights transpose) ----
-    emb = ours.embeddings
-    _copy(hf.embeddings.word_embeddings.weight, _np(emb.word_embeddings.weight))
-    _copy(hf.embeddings.position_embeddings.weight,
-          _np(emb.position_embeddings.weight))
-    _copy(hf.embeddings.token_type_embeddings.weight,
-          _np(emb.token_type_embeddings.weight))
-    _copy(hf.embeddings.LayerNorm.weight, _np(emb.layer_norm.weight))
-    _copy(hf.embeddings.LayerNorm.bias, _np(emb.layer_norm.bias))
-
-    for i, layer in enumerate(ours.encoder.layers):
-        hl = hf.encoder.layer[i]
-        a = layer.self_attn
-        _copy(hl.attention.self.query.weight, _np(a.q_proj.weight).T)
-        _copy(hl.attention.self.query.bias, _np(a.q_proj.bias))
-        _copy(hl.attention.self.key.weight, _np(a.k_proj.weight).T)
-        _copy(hl.attention.self.key.bias, _np(a.k_proj.bias))
-        _copy(hl.attention.self.value.weight, _np(a.v_proj.weight).T)
-        _copy(hl.attention.self.value.bias, _np(a.v_proj.bias))
-        _copy(hl.attention.output.dense.weight, _np(a.out_proj.weight).T)
-        _copy(hl.attention.output.dense.bias, _np(a.out_proj.bias))
-        _copy(hl.attention.output.LayerNorm.weight, _np(layer.norm1.weight))
-        _copy(hl.attention.output.LayerNorm.bias, _np(layer.norm1.bias))
-        _copy(hl.intermediate.dense.weight, _np(layer.linear1.weight).T)
-        _copy(hl.intermediate.dense.bias, _np(layer.linear1.bias))
-        _copy(hl.output.dense.weight, _np(layer.linear2.weight).T)
-        _copy(hl.output.dense.bias, _np(layer.linear2.bias))
-        _copy(hl.output.LayerNorm.weight, _np(layer.norm2.weight))
-        _copy(hl.output.LayerNorm.bias, _np(layer.norm2.bias))
-
-    _copy(hf.pooler.dense.weight, _np(ours.pooler.weight).T)
-    _copy(hf.pooler.dense.bias, _np(ours.pooler.bias))
-
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, V, (2, 12)).astype(np.int64)
-    types = rng.randint(0, 2, (2, 12)).astype(np.int64)
-
-    seq, pooled = ours(paddle.to_tensor(ids), paddle.to_tensor(types))
-    with torch.no_grad():
-        out = hf(input_ids=torch.from_numpy(ids),
-                 token_type_ids=torch.from_numpy(types))
-    np.testing.assert_allclose(_np(seq), out.last_hidden_state.numpy(),
-                               rtol=1e-3, atol=1e-4)
-    np.testing.assert_allclose(_np(pooled), out.pooler_output.numpy(),
-                               rtol=1e-3, atol=1e-4)
-
-
-def test_bert_attention_mask_matches_huggingface():
-    """Padding-mask parity vs HF on the unmasked positions (ours takes an
-    additive mask; HF takes 1/0 and builds the additive form itself),
-    plus masked-position invariance on our side."""
-    V, H = 50, 16
-    paddle.seed(1)
-    ours = OurBert(BertConfig(vocab_size=V, hidden_size=H, num_layers=1,
-                              num_heads=2, ffn_hidden=32, max_seq_len=8,
-                              type_vocab_size=2, dropout=0.0))
-    ours.eval()
-    hf = transformers.BertModel(transformers.BertConfig(
-        vocab_size=V, hidden_size=H, num_hidden_layers=1,
-        num_attention_heads=2, intermediate_size=32,
-        max_position_embeddings=8, type_vocab_size=2,
-        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        hidden_act="gelu", layer_norm_eps=1e-5))
-    hf.eval()
-    emb = ours.embeddings
-    _copy(hf.embeddings.word_embeddings.weight,
-          _np(emb.word_embeddings.weight))
-    _copy(hf.embeddings.position_embeddings.weight,
-          _np(emb.position_embeddings.weight))
-    _copy(hf.embeddings.token_type_embeddings.weight,
-          _np(emb.token_type_embeddings.weight))
-    _copy(hf.embeddings.LayerNorm.weight, _np(emb.layer_norm.weight))
-    _copy(hf.embeddings.LayerNorm.bias, _np(emb.layer_norm.bias))
-    layer, hl = ours.encoder.layers[0], hf.encoder.layer[0]
-    a = layer.self_attn
-    _copy(hl.attention.self.query.weight, _np(a.q_proj.weight).T)
-    _copy(hl.attention.self.query.bias, _np(a.q_proj.bias))
-    _copy(hl.attention.self.key.weight, _np(a.k_proj.weight).T)
-    _copy(hl.attention.self.key.bias, _np(a.k_proj.bias))
-    _copy(hl.attention.self.value.weight, _np(a.v_proj.weight).T)
-    _copy(hl.attention.self.value.bias, _np(a.v_proj.bias))
-    _copy(hl.attention.output.dense.weight, _np(a.out_proj.weight).T)
-    _copy(hl.attention.output.dense.bias, _np(a.out_proj.bias))
-    _copy(hl.attention.output.LayerNorm.weight, _np(layer.norm1.weight))
-    _copy(hl.attention.output.LayerNorm.bias, _np(layer.norm1.bias))
-    _copy(hl.intermediate.dense.weight, _np(layer.linear1.weight).T)
-    _copy(hl.intermediate.dense.bias, _np(layer.linear1.bias))
-    _copy(hl.output.dense.weight, _np(layer.linear2.weight).T)
-    _copy(hl.output.dense.bias, _np(layer.linear2.bias))
-    _copy(hl.output.LayerNorm.weight, _np(layer.norm2.weight))
-    _copy(hl.output.LayerNorm.bias, _np(layer.norm2.bias))
-    _copy(hf.pooler.dense.weight, _np(ours.pooler.weight).T)
-    _copy(hf.pooler.dense.bias, _np(ours.pooler.bias))
+def _sync_bert_weights(ours, hf):
+    _sync_bert_weights(ours, hf)
 
     rng = np.random.RandomState(1)
     ids = rng.randint(0, V, (1, 6)).astype(np.int64)

@@ -358,3 +358,76 @@ def test_softmax_cross_entropy_grad_matches_torch():
             lv, _t(labels.reshape(-1, 1))).sum(),
         lambda lv: TF.cross_entropy(lv, _tt(labels), reduction="sum"),
         [logits], 0)
+
+
+# ---------------------------------------------------------------------------
+# optimizer trajectories: multi-step parity where paddle and torch
+# semantics coincide (Adam/AdamW bias correction, SGD momentum, global-
+# norm clipping). Paddle-specific rules (rmsprop eps-in-sqrt, lamb, ...)
+# are validated against the reference formulas in the golden suites
+# instead — torch would be the WRONG oracle there.
+
+
+def _train_pair(make_opts, steps=8, clip=None):
+    W0 = R.randn(4, 3).astype(np.float32)
+    B0 = R.randn(3).astype(np.float32)
+    X = R.randn(16, 4).astype(np.float32)
+    Y = R.randn(16, 3).astype(np.float32)
+
+    lin = paddle.nn.Linear(4, 3)
+    with paddle.no_grad():
+        lin.weight.set_value(W0)
+        lin.bias.set_value(B0)
+    tlin = torch.nn.Linear(4, 3)
+    with torch.no_grad():
+        tlin.weight.copy_(_tt(W0.T))
+        tlin.bias.copy_(_tt(B0))
+    opt, topt = make_opts(lin, tlin)
+    for _ in range(steps):
+        loss = ((lin(_t(X)) - _t(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+        tloss = ((tlin(_tt(X)) - _tt(Y)) ** 2).mean()
+        tloss.backward()
+        if clip is not None:
+            torch.nn.utils.clip_grad_norm_(tlin.parameters(), clip)
+        topt.step()
+        topt.zero_grad()
+    np.testing.assert_allclose(_np(lin.weight), tlin.weight.detach().numpy().T,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(lin.bias), tlin.bias.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adam_trajectory_matches_torch():
+    _train_pair(lambda l, tl: (
+        paddle.optimizer.Adam(learning_rate=0.05, parameters=l.parameters(),
+                              beta1=0.9, beta2=0.99, epsilon=1e-8),
+        torch.optim.Adam(tl.parameters(), lr=0.05, betas=(0.9, 0.99),
+                         eps=1e-8)))
+
+
+def test_adamw_decoupled_decay_trajectory_matches_torch():
+    _train_pair(lambda l, tl: (
+        paddle.optimizer.AdamW(learning_rate=0.05,
+                               parameters=l.parameters(),
+                               weight_decay=0.1),
+        torch.optim.AdamW(tl.parameters(), lr=0.05, weight_decay=0.1)))
+
+
+def test_momentum_trajectory_matches_torch():
+    _train_pair(lambda l, tl: (
+        paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                  parameters=l.parameters()),
+        torch.optim.SGD(tl.parameters(), lr=0.05, momentum=0.9)))
+
+
+def test_adam_with_global_norm_clip_matches_torch():
+    clip = 0.05  # small enough that clipping actually engages every step
+    _train_pair(lambda l, tl: (
+        paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=l.parameters(),
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(clip)),
+        torch.optim.Adam(tl.parameters(), lr=0.05)), clip=clip)
