@@ -38,12 +38,11 @@ class NoamDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        step = max(self.last_epoch, 1)
-        return (
-            self.base_lr
-            * self.d_model ** -0.5
-            * min(step ** -0.5, step * self.warmup_steps ** -1.5)
-        )
+        # reference lr.py NoamDecay.get_lr: a=1 at epoch 0 (so the min
+        # picks the warmup term b=0 and the first lr is exactly 0)
+        a = 1.0 if self.last_epoch == 0 else self.last_epoch ** -0.5
+        b = self.warmup_steps ** -1.5 * self.last_epoch
+        return self.base_lr * self.d_model ** -0.5 * min(a, b)
 
 
 class PiecewiseDecay(LRScheduler):

@@ -27,7 +27,9 @@ def test_noam():
     d, w, base = 64, 4, 1.0
     s = L.NoamDecay(d_model=d, warmup_steps=w, learning_rate=base)
     got = _seq(s, 8)
-    want = [base * d ** -0.5 * min((e or 1) ** -0.5, (e or 1) * w ** -1.5)
+    # reference get_lr: a=1 at epoch 0 -> first lr is exactly 0
+    want = [base * d ** -0.5 * min(1.0 if e == 0 else e ** -0.5,
+                                   e * w ** -1.5)
             for e in range(8)]
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
@@ -107,7 +109,8 @@ def test_reduce_on_plateau():
     for m in metrics:
         s.step(m)
         lrs.append(float(s.get_lr()))
-    # best=0.9 at epoch 1; epochs 3,4 exhaust patience=2 -> halve at 4
+    # best=0.9 at epoch 1; bad epochs 2,3,4 push num_bad past
+    # patience=2 -> halve at index 4
     assert lrs[3] == 1.0 and lrs[4] == 0.5
     # new best 0.5 resets; 0.6,0.7,0.8 worse -> halve again at the last
     assert lrs[-1] == 0.25
