@@ -172,3 +172,39 @@ def test_scheduler_drives_optimizer_lr():
     w2 = np.asarray(net.weight._data).copy()
     step2 = np.abs(w2 - w1).max()
     assert step2 < 0.5 * step1  # lr shrank 10x (grads comparable)
+
+
+def test_one_cycle():
+    s = L.OneCycleLR(max_learning_rate=1.0, total_steps=10,
+                     divide_factor=25.0, end_learning_rate=0.001,
+                     phase_pct=0.3)
+    got = _seq(s, 11)
+    init, up = 1.0 / 25.0, 3
+    want = []
+    for e in range(11):
+        step = min(e, 10)
+        if step <= up:
+            pct = step / up
+            want.append(init + (1.0 - init) * (1 - math.cos(math.pi * pct)) / 2)
+        else:
+            pct = (step - up) / (10 - up)
+            want.append(0.001 + (1.0 - 0.001) * (1 + math.cos(math.pi * pct)) / 2)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert abs(got[up] - 1.0) < 1e-9          # peak at end of warmup phase
+    assert abs(got[10] - 0.001) < 1e-9        # anneals to end_lr
+
+
+def test_cyclic_triangular_modes():
+    s = L.CyclicLR(base_learning_rate=0.1, max_learning_rate=1.1,
+                   step_size_up=4, step_size_down=4)
+    got = _seq(s, 9)
+    # rises 0.1 -> 1.1 over 4 steps, falls back over 4
+    np.testing.assert_allclose(
+        got[:5], [0.1, 0.35, 0.6, 0.85, 1.1], rtol=1e-6)
+    np.testing.assert_allclose(got[5:9], [0.85, 0.6, 0.35, 0.1], rtol=1e-6)
+
+    s2 = L.CyclicLR(base_learning_rate=0.1, max_learning_rate=1.1,
+                    step_size_up=2, step_size_down=2, mode="triangular2")
+    got2 = _seq(s2, 9)
+    assert abs(got2[2] - 1.1) < 1e-9          # first-cycle peak full amp
+    assert abs(got2[6] - (0.1 + 0.5)) < 1e-9  # second cycle halved amp
