@@ -1,0 +1,78 @@
+"""paddle.metric value goldens (Accuracy top-k, Precision, Recall, Auc).
+
+Ref: python/paddle/metric/metrics.py:38-593. Expected values are
+computed by hand / closed form (AUC via the Mann-Whitney rank formula),
+independent of the streaming-histogram implementations under test.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_accuracy_topk():
+    m = paddle.metric.Accuracy(topk=(1, 2))
+    preds = paddle.to_tensor(np.array([
+        [0.1, 0.7, 0.2],   # top1=1 top2={1,2}
+        [0.5, 0.3, 0.2],   # top1=0 top2={0,1}
+        [0.2, 0.3, 0.5],   # top1=2 top2={2,1}
+    ], np.float32))
+    labels = paddle.to_tensor(np.array([[1], [1], [0]], np.int64))
+    correct = m.compute(preds, labels)
+    m.update(correct)
+    acc1, acc2 = m.accumulate()
+    assert abs(acc1 - 1 / 3) < 1e-6   # only row 0 top-1 correct
+    assert abs(acc2 - 2 / 3) < 1e-6   # rows 0,1 within top-2
+    # streaming: second batch all correct shifts the average
+    preds2 = paddle.to_tensor(np.array([[0.9, 0.1, 0.0]], np.float32))
+    labels2 = paddle.to_tensor(np.array([[0]], np.int64))
+    m.update(m.compute(preds2, labels2))
+    acc1b, _ = m.accumulate()
+    assert abs(acc1b - 2 / 4) < 1e-6
+
+
+def test_precision_recall():
+    # binary preds (prob of positive); threshold 0.5
+    preds = np.array([0.9, 0.8, 0.2, 0.6, 0.1], np.float32)
+    labels = np.array([1, 0, 1, 1, 0], np.int64)
+    # predicted positive: {0,1,3} -> TP={0,3}, FP={1}; FN={2}
+    p = paddle.metric.Precision()
+    p.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    r = paddle.metric.Recall()
+    r.update(preds, labels)
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+
+def test_auc_against_rank_formula():
+    rng = np.random.RandomState(0)
+    n = 400
+    labels = rng.randint(0, 2, n)
+    # informative but noisy scores
+    preds = np.clip(labels * 0.4 + rng.rand(n) * 0.6, 0, 1)
+
+    m = paddle.metric.Auc()
+    m.update(np.stack([1 - preds, preds], 1).astype(np.float32),
+             labels.reshape(-1, 1))
+    got = m.accumulate()
+
+    # exact AUC: Mann-Whitney U / (n_pos * n_neg), ties get half credit
+    pos = preds[labels == 1]
+    neg = preds[labels == 0]
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    exact = (wins + 0.5 * ties) / (len(pos) * len(neg))
+    assert abs(got - exact) < 2e-3  # histogram discretization error only
+
+
+def test_auc_streaming_equals_one_shot():
+    rng = np.random.RandomState(1)
+    preds = rng.rand(100).astype(np.float32)
+    labels = rng.randint(0, 2, 100)
+    one = paddle.metric.Auc()
+    one.update(np.stack([1 - preds, preds], 1), labels.reshape(-1, 1))
+    two = paddle.metric.Auc()
+    for lo in range(0, 100, 10):
+        sl = slice(lo, lo + 10)
+        two.update(np.stack([1 - preds[sl], preds[sl]], 1),
+                   labels[sl].reshape(-1, 1))
+    assert abs(one.accumulate() - two.accumulate()) < 1e-9
