@@ -438,7 +438,7 @@ def test_adam_with_global_norm_clip_matches_torch():
 
 
 @pytest.mark.parametrize("causal,use_flash", [
-    (False, False), (True, False), (True, True),
+    (False, False), (True, False), (True, True), (False, True),
 ])
 def test_scaled_dot_product_attention_matches_torch(causal, use_flash):
     from paddle_tpu.ops.attention import scaled_dot_product_attention
@@ -447,11 +447,9 @@ def test_scaled_dot_product_attention_matches_torch(causal, use_flash):
     q = R.randn(B, H, Lq, D).astype(np.float32)
     k = R.randn(B, H, Lq, D).astype(np.float32)
     v = R.randn(B, H, Lq, D).astype(np.float32)
-    out = scaled_dot_product_attention(_t(q), _t(k), _t(v),
-                                       is_causal=causal,
-                                       use_flash=use_flash)
-    if isinstance(out, tuple):
-        out = out[0]
+    out, _ = scaled_dot_product_attention(_t(q), _t(k), _t(v),
+                                          is_causal=causal,
+                                          use_flash=use_flash)
     want = TF.scaled_dot_product_attention(
         _tt(q), _tt(k), _tt(v), is_causal=causal).numpy()
     np.testing.assert_allclose(_np(out), want, rtol=1e-3, atol=2e-4)
@@ -465,10 +463,9 @@ def test_sdpa_additive_mask_matches_torch():
     k = R.randn(B, H, L, D).astype(np.float32)
     v = R.randn(B, H, L, D).astype(np.float32)
     mask = np.where(R.rand(1, 1, L, L) > 0.3, 0.0, -1e9).astype(np.float32)
-    out = scaled_dot_product_attention(_t(q), _t(k), _t(v),
-                                       attn_mask=_t(mask), use_flash=False)
-    if isinstance(out, tuple):
-        out = out[0]
+    out, _ = scaled_dot_product_attention(_t(q), _t(k), _t(v),
+                                          attn_mask=_t(mask),
+                                          use_flash=False)
     want = TF.scaled_dot_product_attention(
         _tt(q), _tt(k), _tt(v), attn_mask=_tt(mask)).numpy()
     np.testing.assert_allclose(_np(out), want, rtol=1e-3, atol=2e-4)
@@ -482,9 +479,8 @@ def test_sdpa_causal_grad_matches_torch(wrt):
     arrs = [R.randn(B, H, L, D).astype(np.float32) for _ in range(3)]
 
     def pfn(qv, kv, vv):
-        o = scaled_dot_product_attention(qv, kv, vv, is_causal=True,
-                                         use_flash=False)
-        return o[0] if isinstance(o, tuple) else o
+        return scaled_dot_product_attention(qv, kv, vv, is_causal=True,
+                                            use_flash=False)[0]
 
     _grad_pair(
         pfn,
@@ -496,9 +492,6 @@ def test_sdpa_causal_grad_matches_torch(wrt):
 def test_flash_attention_grad_matches_plain():
     """The Pallas blockwise custom_vjp must produce the same grads as the
     straightforward softmax attention (its contract)."""
-    import jax
-    import jax.numpy as jnp
-
     from paddle_tpu.ops.attention import scaled_dot_product_attention
 
     B, H, L, D = 1, 2, 32, 8
@@ -512,9 +505,8 @@ def test_flash_attention_grad_matches_plain():
         ts = [_t(a) for a in (q, k, v)]
         for t_ in ts:
             t_.stop_gradient = False
-        o = scaled_dot_product_attention(*ts, is_causal=True,
-                                         use_flash=use_flash)
-        o = o[0] if isinstance(o, tuple) else o
+        o, _ = scaled_dot_product_attention(*ts, is_causal=True,
+                                            use_flash=use_flash)
         (o * _t(co)).sum().backward()
         return [_np(t_.grad) for t_ in ts]
 
