@@ -514,3 +514,44 @@ def test_flash_attention_grad_matches_plain():
     g_flash = run(True)
     for a, b in zip(g_plain, g_flash):
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-3)
+
+
+def test_batchnorm_training_running_stats_match_torch():
+    """Train-mode BN: normalized outputs match torch (both normalize by
+    BIASED batch stats); the running-variance buffer does NOT — torch
+    blends the UNBIASED batch variance while the reference blends the
+    biased one (batch_norm_op.cc:367 divides by N*sample_size, :398
+    feeds it straight into the running update), so the buffers are
+    checked against the reference formula instead.  paddle momentum=0.9
+    corresponds to torch momentum=0.1 (opposite naming)."""
+    paddle.seed(0)
+    bn = paddle.nn.BatchNorm2D(3, momentum=0.9)
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1)
+    with torch.no_grad():
+        tbn.weight.copy_(_tt(_np(bn.weight)))
+        tbn.bias.copy_(_tt(_np(bn.bias)))
+    bn.train()
+    tbn.train()
+    ref_mean = np.zeros(3, np.float64)
+    ref_var = np.ones(3, np.float64)
+    for i in range(3):
+        x = R.randn(4, 3, 5, 5).astype(np.float32) * (i + 1) + i
+        got = _np(bn(_t(x)))
+        want = tbn(_tt(x)).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))  # biased, reference semantics
+        ref_mean = 0.9 * ref_mean + 0.1 * bm
+        ref_var = 0.9 * ref_var + 0.1 * bv
+    np.testing.assert_allclose(_np(bn._mean), ref_mean, rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(_np(bn._variance), ref_var, rtol=1e-3,
+                               atol=1e-4)
+    # eval mode applies the (reference-semantics) running stats
+    bn.eval()
+    x = R.randn(2, 3, 5, 5).astype(np.float32)
+    want = ((x - ref_mean.reshape(1, 3, 1, 1))
+            / np.sqrt(ref_var.reshape(1, 3, 1, 1) + 1e-5)
+            * _np(bn.weight).reshape(1, 3, 1, 1)
+            + _np(bn.bias).reshape(1, 3, 1, 1))
+    np.testing.assert_allclose(_np(bn(_t(x))), want, rtol=1e-3, atol=1e-4)
