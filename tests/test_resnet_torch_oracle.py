@@ -1,0 +1,105 @@
+"""ResNet-18 composition oracle: our vision model vs a hand-built torch
+twin with identical parameter names, weights copied both ways.
+
+The conv/bn/pool kernels are individually torch-validated in
+test_torch_oracle.py; this pins the COMPOSITION — stem, four stages of
+BasicBlocks with downsample shortcuts, global pool, fc — in eval mode
+(running stats) and train mode (batch stats).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+class TBasicBlock(tnn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.relu = tnn.ReLU()
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return self.relu(out + idn)
+
+
+class TResNet18(tnn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU()
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        cfg = [(64, 64, 1), (64, 128, 2), (128, 256, 2), (256, 512, 2)]
+        for i, (cin, cout, s) in enumerate(cfg, start=1):
+            setattr(self, f"layer{i}", tnn.Sequential(
+                TBasicBlock(cin, cout, s), TBasicBlock(cout, cout, 1)))
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(512, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for i in range(1, 5):
+            x = getattr(self, f"layer{i}")(x)
+        x = torch.flatten(self.avgpool(x), 1)
+        return self.fc(x)
+
+
+def _sync(ours, tmodel):
+    tparams = dict(tmodel.named_parameters())
+    tbufs = dict(tmodel.named_buffers())
+    with torch.no_grad():
+        for name, p in ours.named_parameters():
+            src = _np(p)
+            if name == "fc.weight":
+                src = src.T  # our Linear stores [in, out]
+            tparams[name].copy_(torch.from_numpy(np.ascontiguousarray(src)))
+        for name, v in ours.state_dict().items():
+            if name.endswith("._mean"):
+                tbufs[name.replace("._mean", ".running_mean")].copy_(
+                    torch.from_numpy(np.ascontiguousarray(_np(v))))
+            elif name.endswith("._variance"):
+                tbufs[name.replace("._variance", ".running_var")].copy_(
+                    torch.from_numpy(np.ascontiguousarray(_np(v))))
+
+
+def test_resnet18_matches_handbuilt_torch():
+    paddle.seed(0)
+    ours = paddle.vision.models.resnet18(num_classes=10)
+    tmodel = TResNet18(num_classes=10)
+    _sync(ours, tmodel)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 64, 64).astype(np.float32)
+
+    ours.eval()
+    tmodel.eval()
+    got = _np(ours(paddle.to_tensor(x)))
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    # train mode normalizes by batch stats instead
+    ours.train()
+    tmodel.train()
+    got_t = _np(ours(paddle.to_tensor(x)))
+    want_t = tmodel(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got_t, want_t, rtol=1e-3, atol=1e-3)
+    assert not np.allclose(got, got_t, atol=1e-3)  # modes really differ
