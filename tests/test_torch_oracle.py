@@ -38,11 +38,13 @@ R = np.random.RandomState(0)
     (2, 1, 1, 1),
     (1, 2, 2, 1),
     (1, 1, 1, 2),
+    (1, 1, 1, 4),  # depthwise (groups == channels, the MobileNet path)
 ])
 def test_conv2d_matches_torch(stride, padding, dilation, groups):
     x = R.randn(2, 4, 9, 9).astype(np.float32)
-    w = R.randn(6, 4 // groups, 3, 3).astype(np.float32)
-    b = R.randn(6).astype(np.float32)
+    cout = 8 if groups == 4 else 6
+    w = R.randn(cout, 4 // groups, 3, 3).astype(np.float32)
+    b = R.randn(cout).astype(np.float32)
     got = _np(F.conv2d(_t(x), _t(w), _t(b), stride=stride, padding=padding,
                        dilation=dilation, groups=groups))
     want = TF.conv2d(_tt(x), _tt(w), _tt(b), stride=stride, padding=padding,
@@ -555,3 +557,16 @@ def test_batchnorm_training_running_stats_match_torch():
             * _np(bn.weight).reshape(1, 3, 1, 1)
             + _np(bn.bias).reshape(1, 3, 1, 1))
     np.testing.assert_allclose(_np(bn(_t(x))), want, rtol=1e-3, atol=1e-4)
+
+
+def test_embedding_padding_idx_matches_torch():
+    """padding_idx zeroes the output row AND its gradient contribution."""
+    V, D = 10, 4
+    w = R.randn(V, D).astype(np.float32)
+    ids = np.array([[1, 3, 3, 0, 7]], np.int64)  # 3 is the padding idx
+    _grad_pair(
+        lambda wv: F.embedding(_t(ids), wv, padding_idx=3),
+        lambda wv: TF.embedding(_tt(ids), wv, padding_idx=3),
+        [w], 0)
+    out = F.embedding(_t(ids), _t(w), padding_idx=3)
+    assert np.allclose(_np(out)[0, 1], 0) and np.allclose(_np(out)[0, 2], 0)
