@@ -21,6 +21,21 @@ from .meta_optimizer_base import (
 from ....static.backward import GRAD_SUFFIX
 
 
+def _parse_schedule_mode(value):
+    if isinstance(value, str):
+        key = value.replace("-", "").replace("_", "").lower()
+        try:
+            return {"1f1b": 1, "fthenb": 0}[key]
+        except KeyError:
+            raise ValueError(
+                f"pipeline schedule_mode {value!r} not recognized; use "
+                "'1F1B', 'F-then-B', 0 or 1")
+    mode = int(value)
+    if mode not in (0, 1):
+        raise ValueError(f"pipeline schedule_mode must be 0 or 1, got {mode}")
+    return mode
+
+
 class PipelineOptimizer(MetaOptimizerBase):
     @classmethod
     def _can_apply(cls, strategy):
@@ -41,6 +56,11 @@ class PipelineOptimizer(MetaOptimizerBase):
                 "num_stages": num_stages,
                 "accumulate_steps": max(
                     int(cfg.get("accumulate_steps", 1)), 1),
+                # section_worker.cc schedule_mode: 0 F-then-B, 1 1F1B.
+                # The strategy proto spells it as a string ("1F1B" /
+                # "F-then-B", the reference default is 1F1B); ints too.
+                "schedule_mode": _parse_schedule_mode(
+                    cfg.get("schedule_mode", "1F1B")),
             }
         return result
 

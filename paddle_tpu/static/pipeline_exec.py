@@ -45,6 +45,13 @@ class PipelinedBlock:
         popt = getattr(program, "_pipeline_opt", {}) or {}
         self.num_stages = int(popt.get("num_stages", 1))
         self.num_micro = max(int(popt.get("accumulate_steps", 1)), 1)
+        # section_worker.cc schedule_mode: 0 = F-then-B per micro-batch
+        # (:134), 1 = 1F1B-style window (:167-183) — at most num_stages
+        # micro-batches in flight, so peak live activation envs are
+        # bounded by the stage count instead of accumulate_steps.  The
+        # default matches the meta-opt's (the reference defaults to 1F1B).
+        self.schedule_mode = int(popt.get("schedule_mode", 1))
+        self.last_peak_live_micros = 0
         block = program.global_block()
         self.param_names = [
             n for n, v in block.vars.items()
@@ -164,6 +171,35 @@ class PipelinedBlock:
         return inputs, outputs
 
     # ---- execution ----
+    def _schedule(self, M):
+        """(micro, chunk) dispatch order.  mode 0: each micro runs all its
+        chunks before the next starts.  mode 1: a window of at most
+        num_stages micros advances round-robin — the 1F1B property that
+        bounds in-flight activations to the pipeline depth."""
+        C = len(self.chunks)
+        if C == 0:
+            return
+        if self.schedule_mode != 1:
+            for m in range(M):
+                for c in range(C):
+                    yield m, c
+            return
+        W = max(self.num_stages, 1)
+        progress = {}
+        active = []
+        next_m = 0
+        while active or next_m < M:
+            while len(active) < W and next_m < M:
+                active.append(next_m)
+                progress[next_m] = 0
+                next_m += 1
+            for m in list(active):
+                c = progress[m]
+                yield m, c
+                progress[m] += 1
+                if progress[m] == C:
+                    active.remove(m)
+
     def run(self, feed, scope):
         from .executor import coerce_feeds
 
@@ -185,31 +221,43 @@ class PipelinedBlock:
         fetch_acc = {n: [] for n in self.fetch_names}
         # scalar feeds broadcast to every micro-batch; batched feeds split
         per = {n: v.shape[0] // M for n, v in feeds.items() if v.ndim}
+        last_chunk = len(self.chunks) - 1
+        envs = {}
         env = {}
-        for m in range(M):
-            env = dict(params)
-            for n, v in feeds.items():
-                env[n] = v[m * per[n]:(m + 1) * per[n]] if v.ndim else v
-            for idx, (stage, ops) in enumerate(self.chunks):
-                if self._chunk_fns[idx] is None:
-                    self._chunk_fns[idx] = self._make_chunk_fn(ops)
-                ins, outs = self._chunk_ios[idx]
-                dev = self.stage_device[stage]
-                # inter-stage transfer: commit chunk inputs to its device
-                chunk_env = {n: jax.device_put(env[n], dev) for n in ins
-                             if n in env}
-                produced = self._chunk_fns[idx](chunk_env)
-                for n in outs:
-                    if n in produced:
-                        env[n] = produced[n]
-            for g in self.param_grads:
-                if g in env:
-                    acc_grads[g] = env[g] if g not in acc_grads \
-                        else acc_grads[g] + jax.device_put(
-                            env[g], acc_grads[g].devices().pop())
-            for n in self.fetch_names:
-                if n in env:
-                    fetch_acc[n].append(env[n])
+        peak = 0
+        for m, idx in self._schedule(M):
+            if idx == 0:
+                env = dict(params)
+                for n, v in feeds.items():
+                    env[n] = v[m * per[n]:(m + 1) * per[n]] if v.ndim else v
+                envs[m] = env
+            env = envs[m]
+            peak = max(peak, len(envs))
+            stage, ops = self.chunks[idx]
+            if self._chunk_fns[idx] is None:
+                self._chunk_fns[idx] = self._make_chunk_fn(ops)
+            ins, outs = self._chunk_ios[idx]
+            dev = self.stage_device[stage]
+            # inter-stage transfer: commit chunk inputs to its device
+            chunk_env = {n: jax.device_put(env[n], dev) for n in ins
+                         if n in env}
+            produced = self._chunk_fns[idx](chunk_env)
+            for n in outs:
+                if n in produced:
+                    env[n] = produced[n]
+            if idx == last_chunk:
+                for g in self.param_grads:
+                    if g in env:
+                        acc_grads[g] = env[g] if g not in acc_grads \
+                            else acc_grads[g] + jax.device_put(
+                                env[g], acc_grads[g].devices().pop())
+                for n in self.fetch_names:
+                    if n in env:
+                        fetch_acc[n].append(env[n])
+                if m != M - 1:
+                    del envs[m]  # retire: frees the micro's activations
+        self.last_peak_live_micros = peak
+        env = envs.get(M - 1, env)  # the final micro's env survives
 
         # update phase: averaged grads, once per global batch
         upd_env = dict(params)

@@ -148,3 +148,45 @@ def test_static_pipeline_batchlike_fetch_concats_scalar_averages():
                         fetch_list=[out, loss], scope=scope)
     assert preds.shape == (8, 1)  # concatenated, micro batch was 1
     assert np.asarray(lv).size == 1  # averaged loss view
+
+
+def test_static_pipeline_1f1b_schedule_parity_and_memory_bound():
+    """schedule_mode=1 (section_worker.cc:167-183): identical losses to
+    F-then-B and to the single-device run, with in-flight micro-batch
+    envs bounded by the stage count instead of accumulate_steps."""
+    base, *_ = _train()
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 16])
+        y = static.data("y", [8, 1])
+        h = static.nn.relu(static.nn.fc(x, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        h = static.nn.relu(static.nn.fc(h, 16))
+        out = static.nn.fc(h, 1)
+        loss = static.nn.mean((out - y) * (out - y))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        strategy = DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs = {"pp_degree": 2, "accumulate_steps": 8,
+                                     "schedule_mode": 1}
+        f = Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        apply_meta_optimizers(opt, strategy, loss, startup, f)
+    assert main._pipeline_opt["schedule_mode"] == 1
+    scope = static.Scope()
+    exe = static.Executor()
+    exe.run(startup, scope=scope)
+    losses = []
+    for xv, yv in zip(XS, YS):
+        outv = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                       scope=scope)
+        losses.append(float(np.asarray(outv[0]).reshape(())))
+    np.testing.assert_allclose(losses, base, rtol=2e-5, atol=1e-6)
+    from paddle_tpu.static.pipeline_exec import PipelinedBlock
+
+    pb = [c for c in exe._cache.values() if isinstance(c, PipelinedBlock)][0]
+    # 8 micro-batches, 2 stages: at most 2 envs ever live under 1F1B
+    assert pb.num_micro == 8
+    assert pb.last_peak_live_micros == 2
