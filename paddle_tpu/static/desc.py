@@ -421,6 +421,7 @@ def _b_pool2d(attrs, ctx):
 def _b_batch_norm(attrs, ctx):
     is_test = attrs.get("is_test", False)
     eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
     act = attrs.get("act")
     rank = len(ctx["in_shapes"][0]) if ctx["in_shapes"] else 4
     reduce_axes = tuple(i for i in range(rank) if i != 1)
@@ -436,9 +437,18 @@ def _b_batch_norm(attrs, ctx):
         out = (v - mean_u.reshape(shape)) * jax.lax.rsqrt(
             var_u.reshape(shape) + eps)
         out = out * sc.reshape(shape) + b.reshape(shape)
+        # mirror nn_static._BN_ACTS, not just relu
         if act == "relu":
             out = jax.nn.relu(out)
-        return out
+        elif act == "tanh":
+            out = jnp.tanh(out)
+        elif act == "sigmoid":
+            out = jax.nn.sigmoid(out)
+        if is_test:
+            return out
+        # mirror the emitter: training updates running stats in place
+        return (out, m * momentum + mean_u * (1.0 - momentum),
+                va * momentum + var_u * (1.0 - momentum))
 
     return fn
 

@@ -26,8 +26,11 @@ def _new_out(shape=None, dtype="float32", stop_gradient=False):
 
 
 def emit(op_type, ins, outs_spec, fn, attrs=None):
-    """ins: list[(slot, Variable)], outs_spec: list[(slot, shape, dtype)].
-    fn: pure jax callable positional-inputs -> tuple of outputs."""
+    """ins: list[(slot, Variable)].  outs_spec entries are either
+    (slot, shape, dtype) — a fresh output var — or (slot, Variable) —
+    an IN-PLACE alias (the op writes back into an existing var, the
+    MeanOut/ParamOut pattern).  fn: pure jax callable
+    positional-inputs -> tuple of outputs."""
     block = _cur_block()
     outs = []
     inputs = {}
@@ -37,9 +40,13 @@ def emit(op_type, ins, outs_spec, fn, attrs=None):
         in_order.append(v.name)
     outputs = {}
     out_order = []
-    for slot, shape, dtype in outs_spec:
-        o = block.create_var(shape=shape, dtype=dtype)
-        outputs.setdefault(slot, []).append(o.name)
+    for spec in outs_spec:
+        if len(spec) == 2 and isinstance(spec[1], Variable):
+            o = spec[1]  # alias: write back in place
+        else:
+            slot, shape, dtype = spec
+            o = block.create_var(shape=shape, dtype=dtype)
+        outputs.setdefault(spec[0], []).append(o.name)
         out_order.append(o.name)
         outs.append(o)
     op = block.append_op(op_type, inputs, outputs, attrs or {}, fn=fn)
@@ -394,14 +401,28 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
         out = out * sc.reshape(shape) + b.reshape(shape)
         if act:
             out = _BN_ACTS[act](out)
-        return out
+        if is_test:
+            return out
+        # training also updates the running stats IN PLACE (MeanOut /
+        # VarianceOut alias Mean/Variance, batch_norm_op.cc:396-398) —
+        # without this, a static-trained model would serve with its
+        # initial 0/1 stats
+        new_m = m * momentum + mean_u * (1.0 - momentum)
+        new_v = va * momentum + var_u * (1.0 - momentum)
+        return out, new_m, new_v
 
-    return emit("batch_norm",
-                [("X", input), ("Scale", scale), ("Bias", bias), ("Mean", mean),
-                 ("Variance", var)],
-                [("Y", input.shape, input.dtype)], fn,
-                attrs={"is_test": is_test, "momentum": momentum,
-                       "epsilon": epsilon, "act": act})
+    ins = [("X", input), ("Scale", scale), ("Bias", bias), ("Mean", mean),
+           ("Variance", var)]
+    attrs = {"is_test": is_test, "momentum": momentum,
+             "epsilon": epsilon, "act": act}
+    if is_test:
+        return emit("batch_norm", ins, [("Y", input.shape, input.dtype)],
+                    fn, attrs=attrs)
+    out, _, _ = emit("batch_norm", ins,
+                     [("Y", input.shape, input.dtype),
+                      ("MeanOut", mean), ("VarianceOut", var)],
+                     fn, attrs=attrs)
+    return out
 
 
 def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):

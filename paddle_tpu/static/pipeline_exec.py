@@ -151,13 +151,21 @@ class PipelinedBlock:
         return jax.jit(run)
 
     def _chunk_io(self, idx):
-        """(inputs, outputs) var names for chunk idx: inputs = consumed
-        but not produced inside; outputs = produced and needed later."""
+        """(inputs, outputs) var names for chunk idx.  A name consumed by
+        op i is a chunk INPUT unless some op before i produced it — an op
+        that both reads and writes a var (in-place running stats like
+        batch_norm's Mean/Variance) still needs it fed in."""
         stage, ops = self.chunks[idx]
-        produced, consumed = [], []
+        produced_before = set()
+        inputs = []
+        produced = []
         for op in ops:
-            consumed += list(getattr(op, "in_order", op.input_names()))
-            produced += list(getattr(op, "out_order", op.output_names()))
+            for n in getattr(op, "in_order", op.input_names()):
+                if n not in produced_before and n not in inputs:
+                    inputs.append(n)
+            for n in getattr(op, "out_order", op.output_names()):
+                produced_before.add(n)
+                produced.append(n)
         later_needed = set(self.fetch_names) | set(self.param_grads) \
             | set(self.param_names)
         for j in range(idx + 1, len(self.chunks)):
@@ -166,7 +174,6 @@ class PipelinedBlock:
                                             op.input_names()))
         for _, op in self.update_ops:
             later_needed.update(getattr(op, "in_order", op.input_names()))
-        inputs = [n for n in dict.fromkeys(consumed) if n not in produced]
         outputs = [n for n in dict.fromkeys(produced) if n in later_needed]
         return inputs, outputs
 

@@ -157,3 +157,36 @@ def test_static_save_load_roundtrip(tmp_path):
     static.load(main, path)
     after = exe.run(main, feed=feed, fetch_list=[pred])[0]
     np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_static_batch_norm_updates_running_stats():
+    """Training-mode static BN must blend batch stats into the running
+    Mean/Variance vars in place (batch_norm_op.cc:396-398) so a trained
+    program serves correctly with is_test=True."""
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 3, 4, 4])
+        y = static.nn.batch_norm(x, momentum=0.9)
+        out = static.nn.mean(y)
+    exe = static.Executor()
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    mean_name = next(n for n in scope.names() if "bn_mean" in n)
+    var_name = next(n for n in scope.names() if "bn_var" in n)
+    rng = np.random.RandomState(0)
+    ref_mean = np.zeros(3, np.float64)
+    ref_var = np.ones(3, np.float64)
+    for i in range(3):
+        xv = (rng.rand(8, 3, 4, 4) * (i + 1)).astype(np.float32)
+        exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+        bm = xv.mean(axis=(0, 2, 3))
+        bv = xv.var(axis=(0, 2, 3))
+        ref_mean = 0.9 * ref_mean + 0.1 * bm
+        ref_var = 0.9 * ref_var + 0.1 * bv
+    np.testing.assert_allclose(np.asarray(scope.get(mean_name)), ref_mean,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(scope.get(var_name)), ref_var,
+                               rtol=1e-4, atol=1e-5)

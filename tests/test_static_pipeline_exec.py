@@ -190,3 +190,37 @@ def test_static_pipeline_1f1b_schedule_parity_and_memory_bound():
     # 8 micro-batches, 2 stages: at most 2 envs ever live under 1F1B
     assert pb.num_micro == 8
     assert pb.last_peak_live_micros == 2
+
+
+def test_static_pipeline_with_batch_norm_running_stats():
+    """In-place BN running stats flow through pipelined chunks (an op that
+    reads AND writes the same var must still get it fed into its chunk)."""
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 16])
+        y = static.data("y", [8, 1])
+        h = static.nn.relu(static.nn.fc(x, 16))
+        h = static.nn.reshape(h, [-1, 16, 1, 1])
+        h = static.nn.batch_norm(h, momentum=0.9)
+        h = static.nn.reshape(h, [-1, 16])
+        h = static.nn.relu(static.nn.fc(h, 16))
+        out = static.nn.fc(h, 1)
+        loss = static.nn.mean((out - y) * (out - y))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        strategy = DistributedStrategy()
+        strategy.pipeline = True
+        strategy.pipeline_configs = {"pp_degree": 2, "accumulate_steps": 2}
+        f = Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        apply_meta_optimizers(opt, strategy, loss, startup, f)
+    scope = static.Scope()
+    exe = static.Executor()
+    exe.run(startup, scope=scope)
+    mean_name = next(n for n in scope.names() if "bn_mean" in n)
+    before = np.asarray(scope.get(mean_name)).copy()
+    for xv, yv in zip(XS[:2], YS[:2]):
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                scope=scope)
+    after = np.asarray(scope.get(mean_name))
+    assert not np.allclose(before, after)  # stats really updated
