@@ -90,24 +90,27 @@ def _fuse_bn_act(program, **ctx):
         if op.type != "batch_norm" or op in drop:
             continue
         outs = getattr(op, "out_order", op.output_names())
-        if len(outs) != 1:
-            continue
+        # Y is the first output; training-mode BN also writes
+        # MeanOut/VarianceOut in place — the fusion keeps them
         cs = consumers.get(outs[0], [])
         if len(cs) == 1 and cs[0].type == "relu" and cs[0] not in drop:
             relu_op = cs[0]
             old_fn = op.fn
 
             def fused(*a, _f=old_fn):
-                pre = _f(*a)
-                return pre, jax.nn.relu(pre)
+                res = _f(*a)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                return res + (jax.nn.relu(res[0]),)
 
             op.fn = fused
             op.type = "batch_norm_act"
-            # the fused op writes BOTH the pre-activation var (it may be
-            # a fetch target) and the relu's output; unused ones prune
+            # the fused op writes the pre-activation var (it may be a
+            # fetch target), any in-place stat outputs, and the relu's
+            # output; unused ones prune
             relu_outs = list(getattr(relu_op, "out_order",
                                      relu_op.output_names()))
-            op.out_order = [outs[0]] + relu_outs
+            op.out_order = list(outs) + relu_outs
             merged = dict(op.outputs)
             for k, v in relu_op.outputs.items():
                 merged.setdefault(k, [])

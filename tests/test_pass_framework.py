@@ -109,3 +109,34 @@ def test_raw_program_meta_opt_routes_through_pass():
         assert "c_allreduce_sum" in types
     finally:
         paddle.disable_static()
+
+
+def test_fuse_bn_act_keeps_running_stat_updates():
+    """Training-mode BN+relu fusion must keep the in-place MeanOut/
+    VarianceOut writes — the invariant the training-BN form added."""
+    import paddle_tpu as paddle
+    from paddle_tpu.static.passes import get_pass
+
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3, 6, 6])
+        y = static.nn.batch_norm(x, momentum=0.9)
+        out = static.nn.mean(static.nn.relu(y))
+    get_pass("fuse_bn_act").apply(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "batch_norm_act" in types and "relu" not in types
+    fused = next(op for op in main.global_block().ops
+                 if op.type == "batch_norm_act")
+    assert sum(1 for n in fused.out_order if "bn_mean" in n) == 1
+    assert sum(1 for n in fused.out_order if "bn_var" in n) == 1
+
+    exe = static.Executor()
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    mean_name = next(n for n in scope.names() if "bn_mean" in n)
+    xv = (np.random.RandomState(0).rand(4, 3, 6, 6) + 1).astype("float32")
+    exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+    got = np.asarray(scope.get(mean_name))
+    want = 0.1 * xv.mean(axis=(0, 2, 3))  # 0.9*0 + 0.1*batch mean
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
