@@ -100,7 +100,8 @@ def program_to_desc(program):
                 od["hlo"] = hlo
                 od["rebuildable"] = True
         ops_desc.append(od)
-    return {"version": 1, "vars": vars_desc, "ops": ops_desc}
+    return {"version": 1, "vars": vars_desc, "ops": ops_desc,
+            "rng_step_vars": list(getattr(program, "_rng_step_vars", []))}
 
 
 def _try_export_op(op, block):
@@ -249,6 +250,8 @@ def desc_to_program(desc):
                              fn=fn)
         op.in_order = list(od["in_order"])
         op.out_order = list(od["out_order"])
+    if desc.get("rng_step_vars"):
+        program._rng_step_vars = list(desc["rng_step_vars"])
     return program
 
 
@@ -459,11 +462,18 @@ def _b_dropout(attrs, ctx):
 
     prob = attrs.get("dropout_prob", 0.5)
     is_test = attrs.get("is_test", False)
-    key = jrandom.PRNGKey(0)
+    base = attrs.get("seed", 0)
 
-    def fn(v):
-        if is_test or prob == 0.0:
-            return v
+    if is_test or prob == 0.0:
+        return lambda v: v
+
+    # mirror the emitter: the persistable step counter (advanced by the
+    # executor, constant within a run) folds into the key.  Descs saved
+    # before the counter existed have no Seed input: c defaults so
+    # 1-arg calls keep the old fixed-key behavior instead of crashing.
+    def fn(v, c=None):
+        step = 0 if c is None else c.astype(jnp.int32)[0]
+        key = jrandom.fold_in(jrandom.PRNGKey(base), step)
         keep = jrandom.bernoulli(key, 1.0 - prob, v.shape)
         return jnp.where(keep, v / (1.0 - prob), 0.0)
 

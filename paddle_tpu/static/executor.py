@@ -363,6 +363,13 @@ class Executor:
 
         cb = self._get_block(program, feed, fetch_list, scope)
         outs = cb.run(feed, scope)
+        # advance RNG step counters (dropout masks etc.) once per run —
+        # host-side so the value is CONSTANT within a run and the vjp
+        # grad replay reconstructs the exact forward randomness
+        for n in getattr(program, "_rng_step_vars", ()):
+            v = scope.get(n)
+            if v is not None:
+                scope.set(n, v + 1)
         if return_numpy:
             return outs
         return [Tensor(o) for o in outs]

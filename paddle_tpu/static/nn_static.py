@@ -426,18 +426,49 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
 
 
 def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
+    import zlib
+
     import jax.random as jrandom
 
-    key = jrandom.PRNGKey(seed or 0)
+    if is_test or dropout_prob == 0.0:
+        return emit("dropout", [("X", x)], [("Out", x.shape, x.dtype)],
+                    lambda v: v,
+                    attrs={"dropout_prob": dropout_prob,
+                           "is_test": is_test, "seed": seed or 0})
 
-    def fn(v):
-        if is_test or dropout_prob == 0.0:
-            return v
+    # A fixed key would reuse ONE mask for every run of the compiled
+    # block (the compile-once trap).  A persistable step counter folds
+    # into the key instead; the EXECUTOR advances it once per run
+    # (program._rng_step_vars) so it is constant within a run — the vjp
+    # grad replay therefore reconstructs the exact forward mask.  The
+    # base key mixes paddle.seed (global generator, core/random.py) with
+    # the counter var's name so stacked layers draw independent masks.
+    from .param_helper import create_parameter
+    from ..core import random as _random
+
+    ctr = create_parameter([1], "int32", default_value=0,
+                           stop_gradient=True, name_hint="dropout_step")
+    if seed is not None:
+        base = int(seed)
+    else:
+        gkey = int(np.asarray(
+            jax.random.key_data(_random.get_rng_state())).ravel()[-1])
+        base = (gkey ^ zlib.crc32(ctr.name.encode())) & 0x7FFFFFFF
+    prog = default_main_program()
+    if not hasattr(prog, "_rng_step_vars"):
+        prog._rng_step_vars = []
+    prog._rng_step_vars.append(ctr.name)
+
+    def fn(v, c):
+        key = jrandom.fold_in(jrandom.PRNGKey(base),
+                              c.astype(jnp.int32)[0])
         keep = jrandom.bernoulli(key, 1.0 - dropout_prob, v.shape)
         return jnp.where(keep, v / (1.0 - dropout_prob), 0.0)
 
-    return emit("dropout", [("X", x)], [("Out", x.shape, x.dtype)], fn,
-                attrs={"dropout_prob": dropout_prob, "is_test": is_test})
+    return emit("dropout", [("X", x), ("Seed", ctr)],
+                [("Out", x.shape, x.dtype)], fn,
+                attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                       "seed": base})
 
 
 def reshape(x, shape, name=None):

@@ -5,7 +5,9 @@ parameters are vars in the main program plus init ops in the startup program
 (executed by exe.run(startup_program)).
 """
 import numpy as np
+import jax.numpy as jnp
 
+from ..core.dtype import convert_dtype
 from ..nn.layer import ParamAttr
 from ..nn.initializer import Constant, XavierNormal
 from .program import default_main_program, default_startup_program
@@ -42,6 +44,8 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     sv.initializer = init
     startup.global_block().append_op(
         "init", {}, {"Out": [name]}, {"shape": shape, "dtype": str(dtype)},
-        fn=lambda: init(shape),
+        # honor the DECLARED dtype: initializers default to float32, but
+        # e.g. int32 step counters must not live as floats in the scope
+        fn=lambda: jnp.asarray(init(shape), convert_dtype(dtype)),
     )
     return v

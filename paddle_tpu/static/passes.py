@@ -133,6 +133,9 @@ def _delete_dropout(program, **ctx):
             op.fn = lambda v, *rest: v
             ins = getattr(op, "in_order", op.input_names())
             op.in_order = ins[:1]
+    # inference programs must not advance training mask counters
+    if getattr(program, "_rng_step_vars", None):
+        program._rng_step_vars = []
     return program
 
 

@@ -217,11 +217,41 @@ class Program:
         p = copy.deepcopy(self)
         if for_test:
             for b in p.blocks:
+                # the reference's for_test clone PRUNES backward and
+                # optimize ops (framework.py clone docs) — an "eval"
+                # program that still runs updates would keep training.
+                # Structural rule: forward ops never touch @GRAD names;
+                # grad AND update ops (any optimizer class, incl. user
+                # subclasses) do.
+                b.ops = [
+                    op for op in b.ops
+                    if not any(
+                        "@GRAD" in n
+                        for n in (list(getattr(op, "in_order",
+                                               op.input_names()))
+                                  + list(getattr(op, "out_order",
+                                                 op.output_names()))))
+                ]
                 for op in b.ops:
                     if "is_test" in op.attrs:
                         op.attrs["is_test"] = True
-                    if op.type == "dropout":
-                        op.attrs["dropout_prob"] = 0.0
+                    if op.type in ("batch_norm", "batch_norm_act") \
+                            and len(getattr(op, "out_order", [])) > 1:
+                        # training-form BN: swap in an eval fn that uses
+                        # the RUNNING stats and stops updating them (the
+                        # closure baked in the training branch); return
+                        # arity mirrors out_order
+                        op.fn = _bn_eval_fn(
+                            op.attrs.get("epsilon", 1e-5),
+                            op.attrs.get("act"),
+                            n_out=len(op.out_order))
+            # dropout neutralization lives in ONE place: the registered
+            # inference pass (handles dropout/2d/3d)
+            from .passes import get_pass
+
+            get_pass("delete_dropout_inference").apply(p)
+            # eval runs must not advance the training mask counters
+            p._rng_step_vars = []
         return p
 
     def __repr__(self):
@@ -297,3 +327,33 @@ def program_guard(main_program, startup_program=None):
 
 def name_scope(prefix):
     return contextlib.nullcontext()
+
+
+def _bn_eval_fn(eps, act, n_out=3):
+    """Eval-mode batch_norm body for for_test clones: normalize by the
+    running stats, pass them through unchanged (no updates).  Return
+    arity follows the op's out_order: 1 = Y only; 2 = fused [Y, relu];
+    3 = training [Y, MeanOut, VarOut]; 4 = fused training."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(v, sc, b, m, va):
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(
+            va.reshape(shape) + eps)
+        out = out * sc.reshape(shape) + b.reshape(shape)
+        if act == "relu":
+            out = jax.nn.relu(out)
+        elif act == "tanh":
+            out = jnp.tanh(out)
+        elif act == "sigmoid":
+            out = jax.nn.sigmoid(out)
+        if n_out == 1:
+            return out
+        if n_out == 2:
+            return out, jax.nn.relu(out)
+        if n_out == 4:
+            return out, m, va, jax.nn.relu(out)
+        return out, m, va
+
+    return fn

@@ -190,3 +190,69 @@ def test_static_batch_norm_updates_running_stats():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(scope.get(var_name)), ref_var,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_static_dropout_fresh_mask_each_step_and_deterministic():
+    """The compile-once trap: a fixed PRNG key would reuse ONE mask for
+    every executed step. The counter-threaded dropout draws a fresh mask
+    per run, reproducibly across fresh scopes, and the inference pass
+    still strips it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.static.passes import get_pass
+
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 64])
+        y = static.nn.dropout(x, dropout_prob=0.5)
+    exe = static.Executor()
+
+    def masks(scope):
+        exe.run(startup, scope=scope)
+        xv = np.ones((4, 64), np.float32)
+        return [np.asarray(exe.run(main, feed={"x": xv}, fetch_list=[y],
+                                   scope=scope)[0]) for _ in range(2)]
+
+    m1, m2 = masks(static.Scope())
+    assert not np.array_equal(m1, m2)          # fresh mask per step
+    r1, _ = masks(static.Scope())
+    np.testing.assert_array_equal(m1, r1)      # deterministic sequence
+
+    infer = main.clone() if hasattr(main, "clone") else main
+    get_pass("delete_dropout_inference").apply(infer)
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    out = exe.run(infer, feed={"x": np.ones((4, 64), np.float32)},
+                  fetch_list=[y], scope=scope)[0]
+    np.testing.assert_array_equal(out, np.ones((4, 64), np.float32))
+
+
+def test_static_dropout_backward_uses_forward_mask():
+    """The step counter is executor-advanced (constant within a run), so
+    the vjp grad replay reconstructs the EXACT forward mask — an in-place
+    increment would hand backward a different mask (silent corruption)."""
+    import paddle_tpu as paddle
+
+    paddle.seed(3)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8])
+        h = static.nn.fc(x, 16, bias_attr=False)
+        y = static.nn.dropout(h, dropout_prob=0.5)
+        loss = static.nn.mean(y)
+        static.append_backward(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    w_name = next(n for n in scope.names() if n.startswith("param"))
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 8).astype(np.float32)
+    yv, gw = exe.run(main, feed={"x": xv},
+                     fetch_list=[y, w_name + "@GRAD"], scope=scope)
+    mask = (np.asarray(yv) != 0).astype(np.float64)
+    want = xv.T @ (mask / 0.5) / mask.size
+    np.testing.assert_allclose(np.asarray(gw), want, rtol=1e-4, atol=1e-6)
+    # and clone(for_test=True) really disables the mask (closure strip)
+    infer = main.clone(for_test=True)
+    out = exe.run(infer, feed={"x": xv}, fetch_list=[y], scope=scope)[0]
+    assert (np.asarray(out) != 0).all()
