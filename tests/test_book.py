@@ -226,10 +226,13 @@ def test_book_image_classification():
         rng = np.random.RandomState(0)
         feed = {"img": rng.rand(8, 3, 32, 32).astype(np.float32),
                 "lbl": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+        # fresh dropout masks per step (post-fix behavior) make the
+        # trajectory noisier than the old fixed-mask bug did: average
+        # the tail instead of trusting a single step
         losses = [float(np.ravel(
                       exe.run(main, feed=feed, fetch_list=[loss])[0])[0])
-                  for _ in range(25)]
-        assert losses[-1] < 0.6 * losses[0], losses[::6]
+                  for _ in range(60)]
+        assert np.mean(losses[-5:]) < 0.6 * losses[0], losses[::10]
     finally:
         paddle.disable_static()
 
