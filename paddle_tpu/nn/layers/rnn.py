@@ -7,6 +7,8 @@ while op / cuDNN kernels.
 """
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -280,9 +282,40 @@ class _RNNBase(Layer):
         for names in self._all_weights:
             weights.extend(self._parameters[n] for n in names)
 
+        # inter-layer dropout (stored-but-unapplied before round 3): one
+        # fresh key per layer boundary per forward call, training only
+        drop_keys = None
+        if self.dropout and self.training and L > 1:
+            from ...core import random as _random
+
+            base_key = _random.next_key()
+            drop_keys = [jax.random.fold_in(base_key, i)
+                         for i in range(L - 1)]
+
+        has_lens = sequence_length is not None
+
         def fn(x, *flat):
             ws = flat[: len(weights)]
-            sts = flat[len(weights):]
+            nw = len(weights)
+            if has_lens:
+                lens = flat[nw].astype(jnp.int32)
+                sts = flat[nw + 1:]
+            else:
+                lens = None
+                sts = flat[nw:]
+            T = x.shape[0]
+            t_col = jnp.arange(T)[:, None]
+            if lens is not None:
+                alive = t_col < lens[None, :]          # (T, B)
+                # valid-portion reverse: index len-1-t inside each
+                # sequence, identity on the padding (an involution, so
+                # the same gather maps outputs back)
+                rev_idx = jnp.where(alive, lens[None, :] - 1 - t_col,
+                                    t_col)
+
+            def gather_time(v, idx):
+                return jnp.take_along_axis(v, idx[:, :, None], axis=0)
+
             layer_in = x
             out_h = []
             out_c = []
@@ -292,26 +325,58 @@ class _RNNBase(Layer):
                     k = (layer * D + d) * 4
                     wi, wh, bi, bh = ws[k: k + 4]
                     h0 = tuple(s[layer * D + d] for s in sts)
-                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
-
-                    def scan_fn(carry, xt):
-                        new = step(carry, xt, wi, wh, bi, bh)
-                        return new, new[0]
-
-                    final, ys = jax.lax.scan(scan_fn, h0, seq)
                     if d == 1:
-                        ys = jnp.flip(ys, 0)
+                        seq = gather_time(layer_in, rev_idx) \
+                            if lens is not None else jnp.flip(layer_in, 0)
+                    else:
+                        seq = layer_in
+
+                    def scan_fn(carry, xt_t):
+                        xt, t = xt_t
+                        new = step(carry, xt, wi, wh, bi, bh)
+                        if lens is not None:
+                            # freeze state + zero output past the length
+                            live = (t < lens)[:, None]
+                            new = tuple(jnp.where(live, n, c)
+                                        for n, c in zip(new, carry))
+                            y = jnp.where(live, new[0], 0.0)
+                        else:
+                            y = new[0]
+                        return new, y
+
+                    final, ys = jax.lax.scan(scan_fn, h0,
+                                             (seq, jnp.arange(T)))
+                    if d == 1:
+                        ys = gather_time(ys, rev_idx) \
+                            if lens is not None else jnp.flip(ys, 0)
                     dir_outs.append(ys)
                     out_h.append(final[0])
                     if n_states == 2:
                         out_c.append(final[1])
                 layer_in = jnp.concatenate(dir_outs, axis=-1) if D == 2 else dir_outs[0]
+                if drop_keys is not None and layer < L - 1:
+                    # reference semantics: dropout between stacked layers
+                    # (not after the last), training mode only
+                    if self.dropout >= 1.0:
+                        layer_in = jnp.zeros_like(layer_in)
+                    else:
+                        keep = jax.random.bernoulli(
+                            drop_keys[layer], 1.0 - self.dropout,
+                            layer_in.shape)
+                        layer_in = jnp.where(
+                            keep, layer_in / (1.0 - self.dropout), 0.0)
             final_h = jnp.stack(out_h, 0)
             if n_states == 2:
                 return layer_in, final_h, jnp.stack(out_c, 0)
             return layer_in, final_h
 
-        args = (tm_in,) + tuple(weights) + tuple(states)
+        lens_arg = ()
+        if has_lens:
+            from ...core.tensor import to_tensor as _to_t
+
+            lens_arg = (sequence_length if isinstance(sequence_length, Tensor)
+                        else _to_t(np.asarray(sequence_length)),)
+        args = (tm_in,) + tuple(weights) + lens_arg + tuple(states)
         if n_states == 2:
             out, h, c = apply_op(f"rnn_{mode}", fn, args, {}, n_outputs=3)
             final_states = (h, c)

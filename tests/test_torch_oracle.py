@@ -570,3 +570,56 @@ def test_embedding_padding_idx_matches_torch():
         [w], 0)
     out = F.embedding(_t(ids), _t(w), padding_idx=3)
     assert np.allclose(_np(out)[0, 1], 0) and np.allclose(_np(out)[0, 2], 0)
+
+
+def test_lstm_interlayer_dropout_semantics():
+    """The stacked-RNN dropout arg must actually drop between layers in
+    train mode (it was stored-but-ignored), stay off in eval, and leave
+    single-layer nets untouched."""
+    paddle.seed(0)
+    net = paddle.nn.LSTM(4, 3, num_layers=2, dropout=0.5)
+    x = _t(np.ones((2, 5, 4), np.float32))
+    net.train()
+    o1, o2 = _np(net(x)[0]), _np(net(x)[0])
+    assert not np.array_equal(o1, o2)
+    net.eval()
+    e1, e2 = _np(net(x)[0]), _np(net(x)[0])
+    np.testing.assert_array_equal(e1, e2)
+    single = paddle.nn.LSTM(4, 3, num_layers=1, dropout=0.5)
+    single.train()
+    s1, s2 = _np(single(x)[0]), _np(single(x)[0])
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_lstm_sequence_length_matches_torch_packed():
+    """sequence_length semantics (previously silently ignored): outputs
+    zero past each length, final states from the true last step,
+    bidirectional reverse over the valid portion only — equal to torch's
+    packed-sequence behavior with copied weights."""
+    paddle.seed(0)
+    net = paddle.nn.LSTM(4, 3, num_layers=1, direction="bidirect")
+    tnet = torch.nn.LSTM(4, 3, num_layers=1, bidirectional=True,
+                         batch_first=True)
+    params = dict(net.named_parameters())
+    with torch.no_grad():
+        for name, _ in tnet.named_parameters():
+            getattr(tnet, name).copy_(_tt(_np(params[name])))
+    x = R.randn(2, 6, 4).astype(np.float32)
+    lens = np.array([6, 3], np.int64)
+    out, (h, c) = net(_t(x), sequence_length=_t(lens))
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        _tt(x), torch.from_numpy(lens), batch_first=True,
+        enforce_sorted=False)
+    tout_p, (th, tc) = tnet(packed)
+    tout, _ = torch.nn.utils.rnn.pad_packed_sequence(tout_p,
+                                                     batch_first=True)
+    o = _np(out)
+    np.testing.assert_allclose(o[0], tout.detach().numpy()[0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o[1, :3], tout.detach().numpy()[1, :3],
+                               rtol=1e-4, atol=1e-5)
+    assert np.allclose(o[1, 3:], 0)
+    np.testing.assert_allclose(_np(h), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(_np(c), tc.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
