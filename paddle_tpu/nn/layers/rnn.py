@@ -164,11 +164,36 @@ class RNN(Layer):
             inputs, [1, 0] + list(range(2, inputs.ndim))
         )
         T = tm.shape[0]
+        lens = None
+        if sequence_length is not None:
+            from ...core.tensor import to_tensor as _to_t
+            from ...ops import math as _M
+
+            lens = sequence_length if isinstance(sequence_length, Tensor) \
+                else _to_t(np.asarray(sequence_length))
         steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
         states = initial_states
         outs = [None] * T
         for t in steps:
-            out, states = self.cell(tm[t], states)
+            out, new_states = self.cell(tm[t], states)
+            if lens is not None:
+                # freeze state + zero output past each sequence's length
+                # (reverse direction: padding steps keep the initial
+                # state until the valid region starts)
+                live = (lens > t).astype(out.dtype).reshape([-1, 1])
+                out = out * live
+                if states is None:
+                    states = new_states
+                else:
+                    def _blend(new, old):
+                        if isinstance(new, (list, tuple)):
+                            return type(new)(
+                                _blend(n, o) for n, o in zip(new, old))
+                        return new * live + old * (1.0 - live)
+
+                    states = _blend(new_states, states)
+            else:
+                states = new_states
             outs[t] = out
         stacked = MAN.stack(outs, axis=0)
         if not self.time_major:
@@ -185,8 +210,10 @@ class BiRNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         if initial_states is None:
             initial_states = (None, None)
-        out_fw, st_fw = self.rnn_fw(inputs, initial_states[0])
-        out_bw, st_bw = self.rnn_bw(inputs, initial_states[1])
+        out_fw, st_fw = self.rnn_fw(inputs, initial_states[0],
+                                    sequence_length=sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, initial_states[1],
+                                    sequence_length=sequence_length)
         return MAN.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
 
 

@@ -1,0 +1,92 @@
+"""MobileNetV1 composition oracle vs a hand-built torch twin.
+
+Pins the depthwise-separable stack (3x3 depthwise groups=C + 1x1
+pointwise, each with BN+ReLU) end to end — the composition the
+kernel-level depthwise-conv oracle can't see.  Weights copied by the
+shared naming scheme.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+
+def _np(t):
+    return np.asarray(t._data if hasattr(t, "_data") else t)
+
+
+class TConvBN(tnn.Module):
+    def __init__(self, cin, cout, k, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = tnn.Conv2d(cin, cout, k, stride, padding,
+                               groups=groups, bias=False)
+        self.bn = tnn.BatchNorm2d(cout)
+        self.act = tnn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class TDWSep(tnn.Module):
+    def __init__(self, cin, c1, c2, stride):
+        super().__init__()
+        self.dw = TConvBN(cin, c1, 3, stride, 1, groups=cin)
+        self.pw = TConvBN(c1, c2, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class TMobileNetV1(tnn.Module):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = TConvBN(3, 32, 3, 2, 1)
+        cfg = [
+            (32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+            (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+            (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 512, 1), (512, 512, 512, 1),
+            (512, 512, 1024, 2), (1024, 1024, 1024, 1),
+        ]
+        self.blocks = tnn.Sequential(
+            *[TDWSep(i, a, b, s) for i, a, b, s in cfg])
+        self.pool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        x = torch.flatten(self.pool(x), 1)
+        return self.fc(x)
+
+
+def test_mobilenet_v1_matches_handbuilt_torch():
+    paddle.seed(0)
+    ours = paddle.vision.models.mobilenet_v1(num_classes=10)
+    tmodel = TMobileNetV1(num_classes=10)
+    tparams = dict(tmodel.named_parameters())
+    tbufs = dict(tmodel.named_buffers())
+    with torch.no_grad():
+        for name, p in ours.named_parameters():
+            src = _np(p)
+            if name == "fc.weight":
+                src = src.T  # our Linear stores [in, out]
+            tparams[name].copy_(torch.from_numpy(np.ascontiguousarray(src)))
+        for name, v in ours.state_dict().items():
+            if name.endswith("._mean"):
+                tbufs[name.replace("._mean", ".running_mean")].copy_(
+                    torch.from_numpy(np.ascontiguousarray(_np(v))))
+            elif name.endswith("._variance"):
+                tbufs[name.replace("._variance", ".running_var")].copy_(
+                    torch.from_numpy(np.ascontiguousarray(_np(v))))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 64, 64).astype(np.float32)
+    ours.eval()
+    tmodel.eval()
+    got = _np(ours(paddle.to_tensor(x)))
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
