@@ -244,29 +244,19 @@ def _pool(x, kind, kernel_size, stride, padding, ceil_mode, data_format,
 
 
 def _adaptive_pool_general(x, kind, out_hw, nchw):
-    """Non-divisible adaptive pooling via mean/max over variable windows."""
-    def fn(v):
-        if not nchw:
-            v = jnp.transpose(v, (0, 3, 1, 2))
-        N, C, H, W = v.shape
-        oh, ow = out_hw
-        hs = [(i * H) // oh for i in range(oh)] + [H]
-        ws = [(j * W) // ow for j in range(ow)] + [W]
-        rows = []
-        for i in range(oh):
-            cols = []
-            for j in range(ow):
-                win = v[:, :, hs[i]: hs[i + 1], ws[j]: ws[j + 1]]
-                cols.append(
-                    jnp.max(win, axis=(2, 3)) if kind == "max" else jnp.mean(win, axis=(2, 3))
-                )
-            rows.append(jnp.stack(cols, axis=-1))
-        out = jnp.stack(rows, axis=-2)
-        if not nchw:
-            out = jnp.transpose(out, (0, 2, 3, 1))
-        return out
+    """Non-divisible adaptive pooling: ONE window-math implementation
+    lives in nn_extra._adaptive_nd (floor/ceil bounds, never-empty
+    windows — this 2D copy once diverged and NaN'd on output > input);
+    here we only wrap the NHWC transpose around it."""
+    from .nn_extra import _adaptive_nd
+    from .manipulation import transpose as _tr
 
-    return apply_op("adaptive_pool2d", fn, (x,), {})
+    if not nchw:
+        x = _tr(x, [0, 3, 1, 2])
+    out = _adaptive_nd(x, kind, out_hw)
+    if not nchw:
+        out = _tr(out, [0, 2, 3, 1])
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,

@@ -103,3 +103,52 @@ def test_resnet18_matches_handbuilt_torch():
     want_t = tmodel(torch.from_numpy(x)).detach().numpy()
     np.testing.assert_allclose(got_t, want_t, rtol=1e-3, atol=1e-3)
     assert not np.allclose(got, got_t, atol=1e-3)  # modes really differ
+
+
+class TVGG11(torch.nn.Module):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        layers = []
+        cin = 3
+        for v in [64, "M", 128, "M", 256, 256, "M", 512, 512, "M",
+                  512, 512, "M"]:
+            if v == "M":
+                layers.append(torch.nn.MaxPool2d(2, 2))
+            else:
+                layers += [torch.nn.Conv2d(cin, v, 3, padding=1),
+                           torch.nn.ReLU()]
+                cin = v
+        self.features = torch.nn.Sequential(*layers)
+        self.avgpool = torch.nn.AdaptiveAvgPool2d(7)
+        self.classifier = torch.nn.Sequential(
+            torch.nn.Linear(512 * 7 * 7, 4096), torch.nn.ReLU(),
+            torch.nn.Dropout(), torch.nn.Linear(4096, 4096),
+            torch.nn.ReLU(), torch.nn.Dropout(),
+            torch.nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = torch.flatten(self.avgpool(self.features(x)), 1)
+        return self.classifier(x)
+
+
+def test_vgg11_matches_handbuilt_torch():
+    """VGG-11 composition (plain conv/relu/maxpool features + big fc
+    head), weights copied by the shared layer naming."""
+    paddle.seed(0)
+    ours = paddle.vision.models.vgg11(num_classes=10)
+    tmodel = TVGG11(num_classes=10)
+    tparams = dict(tmodel.named_parameters())
+    with torch.no_grad():
+        for name, p in ours.named_parameters():
+            src = _np(p)
+            if src.ndim == 2:
+                src = src.T  # Linear layout
+            tparams[name].copy_(torch.from_numpy(np.ascontiguousarray(src)))
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 3, 64, 64).astype(np.float32)
+    ours.eval()
+    tmodel.eval()
+    got = _np(ours(paddle.to_tensor(x)))
+    with torch.no_grad():
+        want = tmodel(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)

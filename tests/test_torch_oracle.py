@@ -623,3 +623,37 @@ def test_lstm_sequence_length_matches_torch_packed():
                                atol=1e-5)
     np.testing.assert_allclose(_np(c), tc.detach().numpy(), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_stacked_gru_matches_torch():
+    """2-layer GRU over a sequence with copied weights (LSTM's sibling)."""
+    paddle.seed(0)
+    net = paddle.nn.GRU(4, 3, num_layers=2)
+    tnet = torch.nn.GRU(4, 3, num_layers=2, batch_first=True)
+    params = dict(net.named_parameters())
+    with torch.no_grad():
+        for name, _ in tnet.named_parameters():
+            getattr(tnet, name).copy_(_tt(_np(params[name])))
+    x = R.randn(2, 5, 4).astype(np.float32)
+    out, h = net(_t(x))
+    tout, th = tnet(_tt(x))
+    np.testing.assert_allclose(_np(out), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(h), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape,out", [
+    ((1, 1, 2, 2), 7),    # output larger than input (VGG on small imgs)
+    ((1, 2, 5, 5), 3),    # non-divisible
+    ((2, 3, 7, 9), (4, 5)),
+])
+def test_adaptive_pools_match_torch(shape, out):
+    x = R.randn(*shape).astype(np.float32)
+    o = tuple(out) if isinstance(out, tuple) else (out, out)
+    np.testing.assert_allclose(
+        _np(F.adaptive_avg_pool2d(_t(x), out)),
+        TF.adaptive_avg_pool2d(_tt(x), o).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _np(F.adaptive_max_pool2d(_t(x), out)),
+        TF.adaptive_max_pool2d(_tt(x), o).numpy(), rtol=1e-5, atol=1e-6)
