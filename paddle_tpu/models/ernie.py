@@ -74,10 +74,20 @@ class ErnieEmbeddings(Layer):
                          [B, L])
         emb = M.add(self.word_embeddings(input_ids),
                     self.position_embeddings(pos))
-        if sent_ids is not None:
+        if sent_ids is None:
+            # default sentence is type 0, NOT "no sentence embedding"
+            # (same contract as BertEmbeddings: ids-only calls must
+            # compute the same network as explicit zeros)
+            emb = M.add(emb, self.sent_embeddings.weight[0])
+        else:
             emb = M.add(emb, self.sent_embeddings(sent_ids))
-        if self.task_embeddings is not None and task_ids is not None:
-            emb = M.add(emb, self.task_embeddings(task_ids))
+        if self.task_embeddings is not None:
+            if task_ids is None:
+                # same default-segment contract: task type 0, not "no
+                # task embedding" (PaddleNLP defaults task_type_ids=0)
+                emb = M.add(emb, self.task_embeddings.weight[0])
+            else:
+                emb = M.add(emb, self.task_embeddings(task_ids))
         return self.dropout(self.layer_norm(emb))
 
 

@@ -1,11 +1,11 @@
-"""Flagship-model oracle: our BERT encoder vs HuggingFace BertModel.
+"""Flagship-model oracles: our BERT/ERNIE/GPT vs HuggingFace models.
 
 The kernel- and layer-level torch oracles (test_torch_oracle.py) pin the
-pieces; this pins the COMPOSITION — embeddings (word+position+type, LN),
-N post-LN encoder blocks, pooler — by copying one set of random weights
-into both implementations and demanding the same hidden states.  HF's
-BertModel is an independent, battle-tested implementation of the same
-architecture our models/bert.py re-derives.
+pieces; these pin the COMPOSITION — embeddings, N encoder blocks,
+pooler — by copying one set of random weights into both implementations
+and demanding the same hidden states.  HF's BertModel/GPT2Model are
+independent, battle-tested implementations of the architectures
+models/bert.py, models/ernie.py and models/gpt.py re-derive.
 """
 import numpy as np
 import pytest
@@ -26,7 +26,88 @@ def _copy(dst_param, src):
         dst_param.copy_(torch.from_numpy(np.ascontiguousarray(src)))
 
 
+def _hf_bert_config(V, H, layers, heads, ffn, maxp):
+    return transformers.BertConfig(
+        vocab_size=V, hidden_size=H, num_hidden_layers=layers,
+        num_attention_heads=heads, intermediate_size=ffn,
+        max_position_embeddings=maxp, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu", layer_norm_eps=1e-5)  # ours uses eps 1e-5
+
+
 def _sync_bert_weights(ours, hf):
+    """Copy OUR random weights into HF.  torch Linear stores [out, in];
+    our Linear stores [in, out], so weights transpose."""
+    emb = ours.embeddings
+    _copy(hf.embeddings.word_embeddings.weight,
+          _np(emb.word_embeddings.weight))
+    _copy(hf.embeddings.position_embeddings.weight,
+          _np(emb.position_embeddings.weight))
+    _copy(hf.embeddings.token_type_embeddings.weight,
+          _np(emb.token_type_embeddings.weight))
+    _copy(hf.embeddings.LayerNorm.weight, _np(emb.layer_norm.weight))
+    _copy(hf.embeddings.LayerNorm.bias, _np(emb.layer_norm.bias))
+    for i, layer in enumerate(ours.encoder.layers):
+        hl = hf.encoder.layer[i]
+        a = layer.self_attn
+        _copy(hl.attention.self.query.weight, _np(a.q_proj.weight).T)
+        _copy(hl.attention.self.query.bias, _np(a.q_proj.bias))
+        _copy(hl.attention.self.key.weight, _np(a.k_proj.weight).T)
+        _copy(hl.attention.self.key.bias, _np(a.k_proj.bias))
+        _copy(hl.attention.self.value.weight, _np(a.v_proj.weight).T)
+        _copy(hl.attention.self.value.bias, _np(a.v_proj.bias))
+        _copy(hl.attention.output.dense.weight, _np(a.out_proj.weight).T)
+        _copy(hl.attention.output.dense.bias, _np(a.out_proj.bias))
+        _copy(hl.attention.output.LayerNorm.weight, _np(layer.norm1.weight))
+        _copy(hl.attention.output.LayerNorm.bias, _np(layer.norm1.bias))
+        _copy(hl.intermediate.dense.weight, _np(layer.linear1.weight).T)
+        _copy(hl.intermediate.dense.bias, _np(layer.linear1.bias))
+        _copy(hl.output.dense.weight, _np(layer.linear2.weight).T)
+        _copy(hl.output.dense.bias, _np(layer.linear2.bias))
+        _copy(hl.output.LayerNorm.weight, _np(layer.norm2.weight))
+        _copy(hl.output.LayerNorm.bias, _np(layer.norm2.bias))
+    _copy(hf.pooler.dense.weight, _np(ours.pooler.weight).T)
+    _copy(hf.pooler.dense.bias, _np(ours.pooler.bias))
+
+
+def test_bert_matches_huggingface():
+    V, H, L_LAYERS, HEADS, FFN, MAXP = 101, 32, 3, 4, 64, 16
+    paddle.seed(0)
+    ours = OurBert(BertConfig(
+        vocab_size=V, hidden_size=H, num_layers=L_LAYERS, num_heads=HEADS,
+        ffn_hidden=FFN, max_seq_len=MAXP, type_vocab_size=2, dropout=0.0))
+    ours.eval()
+    hf = transformers.BertModel(
+        _hf_bert_config(V, H, L_LAYERS, HEADS, FFN, MAXP))
+    hf.eval()
+    _sync_bert_weights(ours, hf)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, V, (2, 12)).astype(np.int64)
+    types = rng.randint(0, 2, (2, 12)).astype(np.int64)
+
+    seq, pooled = ours(paddle.to_tensor(ids), paddle.to_tensor(types))
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 token_type_ids=torch.from_numpy(types))
+    np.testing.assert_allclose(_np(seq), out.last_hidden_state.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(_np(pooled), out.pooler_output.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_bert_attention_mask_matches_huggingface():
+    """Padding-mask parity vs HF on the unmasked positions (ours takes an
+    additive mask; HF takes 1/0 and builds the additive form itself),
+    plus masked-position invariance on our side."""
+    V, H = 50, 16
+    paddle.seed(1)
+    ours = OurBert(BertConfig(vocab_size=V, hidden_size=H, num_layers=1,
+                              num_heads=2, ffn_hidden=32, max_seq_len=8,
+                              type_vocab_size=2, dropout=0.0))
+    ours.eval()
+    hf = transformers.BertModel(_hf_bert_config(V, H, 1, 2, 32, 8))
+    hf.eval()
     _sync_bert_weights(ours, hf)
 
     rng = np.random.RandomState(1)
@@ -101,3 +182,68 @@ def test_gpt_matches_huggingface():
     with torch.no_grad():
         want = hf(input_ids=torch.from_numpy(ids)).last_hidden_state.numpy()
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_ernie_matches_huggingface_bert_arch():
+    """ERNIE 1.0's encoder IS the BERT architecture (sentence embeddings
+    = token types, task embeddings off): with copied weights our
+    ErnieModel must match HF BertModel — and ids-only calls must equal
+    explicit zero sent_ids (the default-segment contract)."""
+    from paddle_tpu.models.ernie import ErnieModel, ErnieConfig
+
+    V, H, LAYERS, HEADS, FFN, MAXP = 97, 32, 2, 4, 64, 16
+    paddle.seed(2)
+    ours = ErnieModel(ErnieConfig(
+        vocab_size=V, hidden_size=H, num_layers=LAYERS, num_heads=HEADS,
+        ffn_hidden=FFN, max_seq_len=MAXP, type_vocab_size=2,
+        dropout=0.0, use_task_id=False))
+    ours.eval()
+    hf = transformers.BertModel(_hf_bert_config(V, H, LAYERS, HEADS, FFN,
+                                                MAXP))
+    hf.eval()
+
+    # reuse the BERT sync; ERNIE names sentence embeddings differently
+    from types import SimpleNamespace
+
+    _sync_bert_weights(SimpleNamespace(
+        embeddings=SimpleNamespace(
+            word_embeddings=ours.embeddings.word_embeddings,
+            position_embeddings=ours.embeddings.position_embeddings,
+            token_type_embeddings=ours.embeddings.sent_embeddings,
+            layer_norm=ours.embeddings.layer_norm),
+        encoder=ours.encoder, pooler=ours.pooler), hf)
+
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, V, (2, 10)).astype(np.int64)
+    sent = rng.randint(0, 2, (2, 10)).astype(np.int64)
+    seq, pooled = ours(paddle.to_tensor(ids), paddle.to_tensor(sent))
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 token_type_ids=torch.from_numpy(sent))
+    np.testing.assert_allclose(_np(seq), out.last_hidden_state.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(_np(pooled), out.pooler_output.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    # ids-only == explicit zero sent ids
+    a, _ = ours(paddle.to_tensor(ids))
+    b, _ = ours(paddle.to_tensor(ids),
+                paddle.to_tensor(np.zeros_like(sent)))
+    np.testing.assert_allclose(_np(a), _np(b), atol=1e-6)
+
+
+def test_ernie_task_ids_default_is_row_zero():
+    """use_task_id models: ids-only calls equal explicit zero task_ids
+    (the task embedding must not silently drop)."""
+    from paddle_tpu.models.ernie import ErnieModel, ErnieConfig
+
+    paddle.seed(3)
+    m = ErnieModel(ErnieConfig(vocab_size=40, hidden_size=16, num_layers=1,
+                               num_heads=2, ffn_hidden=32, max_seq_len=8,
+                               type_vocab_size=2, dropout=0.0,
+                               use_task_id=True))
+    m.eval()
+    ids = np.random.RandomState(3).randint(0, 40, (2, 6)).astype(np.int64)
+    a, _ = m(paddle.to_tensor(ids))
+    b, _ = m(paddle.to_tensor(ids), None,
+             paddle.to_tensor(np.zeros_like(ids)))
+    np.testing.assert_allclose(_np(a), _np(b), atol=1e-6)
