@@ -910,8 +910,11 @@ CASES["rank_loss"] = C(
     ref=lambda l, a, b: np.log1p(np.exp(a - b)) - l * (a - b),
     rtol=1e-3)
 CASES["bpr_loss"] = finite(lambda: [F((3, 4), 1), I((3, 1), 4, 2)])
-CASES["center_loss"] = finite(
-    lambda: [F((3, 4), 1), I((3,), 5, 2), F((5, 4), 3)])
+CASES["center_loss"] = C(
+    lambda: [F((3, 4), 1), I((3,), 5, 2), F((5, 4), 3)],
+    # center_loss_op.h: per-sample 0.5*||x - center_{y}||^2
+    ref=lambda x, y, c: 0.5 * ((x - c[y]) ** 2).sum(1, keepdims=True),
+    static=False)
 CASES["squared_l2_distance"] = C(
     lambda: [F((3, 4), 1), F((3, 4), 2)],
     ref=lambda a, b: np.square(a - b).sum(1))
@@ -924,9 +927,19 @@ CASES["cos_sim"] = C(
     ref=lambda a, b: ((a * b).sum(1) / (
         np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1))
     ).reshape(-1, 1), rtol=1e-3)
-CASES["mean_iou"] = finite(
+def _mean_iou_ref(pred, lab, n):
+    ious = []
+    for c in range(n):
+        tp = ((pred == c) & (lab == c)).sum()
+        denom = ((pred == c) | (lab == c)).sum()
+        if denom:
+            ious.append(tp / denom)
+    return np.float32(np.mean(ious))
+
+
+CASES["mean_iou"] = C(
     lambda: [I((4, 4), 3, 1, np.int32), I((4, 4), 3, 2, np.int32), 3],
-    min_outputs=1)
+    ref=lambda p, l, n: _mean_iou_ref(p, l, n), rtol=1e-5, static=False)
 CASES["hierarchical_sigmoid"] = finite(
     lambda: [F((3, 4), 1), I((3, 1), 6, 2), 6, F((5, 4), 3)])
 CASES["nce"] = finite(
@@ -946,9 +959,20 @@ CASES["edit_distance"] = C(
     lambda: [np.array([[1, 2, 3, 4]], np.int64),
              np.array([[1, 3, 3, 3]], np.int64)],
     ref=lambda a, b: np.array([[0.5]]), static=False)  # 2 edits / len 4
-CASES["positive_negative_pair"] = finite(
+def _pnp_ref(score, label, qid):
+    pos = score[label.ravel() > 0].ravel()
+    neg = score[label.ravel() <= 0].ravel()
+    right = (pos[:, None] > neg[None, :]).sum()
+    wrong = (pos[:, None] < neg[None, :]).sum()
+    neutral = (pos[:, None] == neg[None, :]).sum()
+    return [np.float32([right]), np.float32([wrong]),
+            np.float32([neutral])]
+
+
+CASES["positive_negative_pair"] = C(
     lambda: [F((4, 1), 1, 0.0, 1.0), (F((4, 1), 2) > 0).astype(np.float32),
-             np.zeros((4, 1), np.int64)], min_outputs=1)
+             np.zeros((4, 1), np.int64)],
+    ref=_pnp_ref, atol=0, static=False)
 CASES["histogram"] = C(
     lambda: [np.array([0.1, 0.4, 0.6, 0.9], np.float32)],
     kwargs={"bins": 2, "min": 0.0, "max": 1.0},
