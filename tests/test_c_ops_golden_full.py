@@ -939,7 +939,7 @@ def _mean_iou_ref(pred, lab, n):
 
 CASES["mean_iou"] = C(
     lambda: [I((4, 4), 3, 1, np.int32), I((4, 4), 3, 2, np.int32), 3],
-    ref=lambda p, l, n: _mean_iou_ref(p, l, n), rtol=1e-5, static=False)
+    ref=_mean_iou_ref, rtol=1e-5, static=False)
 CASES["hierarchical_sigmoid"] = finite(
     lambda: [F((3, 4), 1), I((3, 1), 6, 2), 6, F((5, 4), 3)])
 CASES["nce"] = finite(
@@ -960,6 +960,8 @@ CASES["edit_distance"] = C(
              np.array([[1, 3, 3, 3]], np.int64)],
     ref=lambda a, b: np.array([[0.5]]), static=False)  # 2 edits / len 4
 def _pnp_ref(score, label, qid):
+    # oracle valid for a single query group only (the case feeds one)
+    assert (qid == qid.ravel()[0]).all()
     pos = score[label.ravel() > 0].ravel()
     neg = score[label.ravel() <= 0].ravel()
     right = (pos[:, None] > neg[None, :]).sum()
