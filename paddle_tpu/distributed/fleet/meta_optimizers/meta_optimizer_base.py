@@ -6,6 +6,30 @@ UPDATE_OP_TYPES = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
                    "adagrad", "adadelta", "adamax"}
 
 
+def is_update_op(block, op):
+    """Structural optimizer-update test: the op consumes a parameter's
+    @GRAD and writes that parameter back (optimizer_bridge.py wires update
+    ops exactly this way).  static_minimize names the op type after the
+    optimizer subclass (``optimizer.__class__.__name__.lower()``), so a
+    user subclass like ``WarmupAdamW`` falls outside UPDATE_OP_TYPES —
+    the name set is kept only as a fast path."""
+    if op.type in UPDATE_OP_TYPES:
+        return True
+    if getattr(op, "fn", True) is None:
+        return False
+    outs = set(getattr(op, "out_order", None) or op.output_names())
+    if not outs:
+        return False
+    for n in getattr(op, "in_order", None) or op.input_names():
+        if n.endswith(GRAD_SUFFIX):
+            base = n[:-len(GRAD_SUFFIX)]
+            v = block.vars.get(base)
+            if v is not None and getattr(v, "is_parameter", False) \
+                    and base in outs:
+                return True
+    return False
+
+
 def collect_param_grad_names(block):
     """Grad vars whose base var is a parameter — the only grads that cross
     replicas (activation grads are replica-local and dead after backward)."""
@@ -41,7 +65,7 @@ def insert_before_first_update(block, build_ops):
     final_ops = []
     inserted = False
     for op in block.ops:
-        if not inserted and op.type in UPDATE_OP_TYPES:
+        if not inserted and is_update_op(block, op):
             final_ops.extend(build_ops())
             inserted = True
         final_ops.append(op)

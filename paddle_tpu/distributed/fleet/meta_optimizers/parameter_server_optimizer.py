@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .meta_optimizer_base import MetaOptimizerBase, UPDATE_OP_TYPES
+from .meta_optimizer_base import MetaOptimizerBase, is_update_op
 from ....static.backward import GRAD_SUFFIX
 
 # live communicator the send/recv op fns talk to (set by attach_communicator)
@@ -100,7 +100,7 @@ class ParameterServerOptimizer(MetaOptimizerBase):
             # the PS applies updates server-side: local update ops drop
             # (the reference deletes the optimize ops from the trainer
             # program), replaced by send(grad) -> recv(param)
-            if op.type in UPDATE_OP_TYPES:
+            if is_update_op(block, op):
                 touched = [n for n in getattr(op, "in_order",
                                               op.input_names())
                            if n in param_set]

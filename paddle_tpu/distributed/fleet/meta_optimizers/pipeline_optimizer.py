@@ -16,7 +16,7 @@ forward op it differentiates; each update op takes its param's stage —
 so backward really runs on the stages, not wherever index order put it.
 """
 from .meta_optimizer_base import (
-    MetaOptimizerBase, UPDATE_OP_TYPES,
+    MetaOptimizerBase, is_update_op,
 )
 from ....static.backward import GRAD_SUFFIX
 
@@ -74,7 +74,7 @@ class PipelineOptimizer(MetaOptimizerBase):
 
         compute = [op for op in block.ops if op.fn is not None]
         fwd = [op for op in compute
-               if not is_grad(op) and op.type not in UPDATE_OP_TYPES]
+               if not is_grad(op) and not is_update_op(block, op)]
         per = max((len(fwd) + num_stages - 1) // num_stages, 1)
 
         # forward: uniform split (the reference's device annotations);
@@ -96,7 +96,7 @@ class PipelineOptimizer(MetaOptimizerBase):
         for op in compute:
             if op in fwd:
                 continue
-            if op.type in UPDATE_OP_TYPES:
+            if is_update_op(block, op):
                 ins = getattr(op, "in_order", op.input_names())
                 op.attrs["pipeline_stage"] = var_stage.get(
                     ins[0] if ins else "", num_stages - 1)

@@ -19,7 +19,9 @@ checkpoint compat; the mesh layout supersedes it for placement.
 """
 import numpy as np
 
-from .meta_optimizer_base import MetaOptimizerBase, record_mesh_axis
+from .meta_optimizer_base import (
+    MetaOptimizerBase, is_update_op, record_mesh_axis,
+)
 from ....static.backward import GRAD_SUFFIX
 
 
@@ -81,11 +83,9 @@ class ShardingOptimizer(MetaOptimizerBase):
 
         Operator = type(block.ops[0]) if block.ops else None
         final_ops = []
-        update_types = {"sgd", "momentum", "adam", "adamw", "lamb", "rmsprop",
-                        "adagrad", "adadelta", "adamax"}
         inserted = False
         for op in block.ops:
-            if not inserted and op.type in update_types and Operator:
+            if not inserted and Operator and is_update_op(block, op):
                 # broadcast params from owners + reduce grads to owners
                 for p, g in params_grads:
                     dev = self._shard.device(p.name)
@@ -108,19 +108,41 @@ class ShardingOptimizer(MetaOptimizerBase):
                     if pv is not None:
                         pv.opt_state_spec = P("sharding")
                         pv.shard_owner = dev
-                        self._shard_var_specs(block, pv)
+                        self._shard_var_specs(block, pv,
+                                              self._opt_state_keys(pv))
                 inserted = True
             final_ops.append(op)
         block.ops = final_ops
         record_mesh_axis(loss.block.program, "sharding", sharding_degree)
         return result
 
+    def _opt_state_keys(self, pv):
+        """Exact optimizer-state keys the bridge will name vars with for
+        THIS param (static/optimizer_bridge.py: ``f"{param}_{key}"`` for
+        key in ``optimizer._init_state(...)``).  Probed with the param's
+        real shape — shape-dependent state layouts (factored states) key
+        differently per param.  Resolved through the meta-opt chain via
+        ``__getattr__`` delegation; None (→ prefix fallback) only when the
+        optimizer has no _init_state hook at all (stateless optimizers
+        return {} → no candidates, which is correct)."""
+        opt = self.user_defined_optimizer or self.inner_opt
+        if getattr(opt, "_init_state_arrays", None) is None:
+            return None
+        import jax.numpy as jnp
+
+        shape = tuple(pv.shape or ())
+        return list(opt._init_state_arrays(
+            jnp.zeros(shape, "float32")).keys())
+
     @staticmethod
-    def _shard_var_specs(block, pv):
+    def _shard_var_specs(block, pv, state_keys=None):
         """Range-shard the param and its optimizer-state vars on dim 0 over
         the 'sharding' axis (dist_spec consumed by the mesh-aware static
         Executor).  A dim already sharded by TP keeps its axis; scalars and
-        dim-0-sharded-elsewhere vars stay as they are."""
+        dim-0-sharded-elsewhere vars stay as they are.  State vars are
+        matched by the bridge's exact ``f"{param}_{key}"`` names when the
+        keys are known — a prefix+shape heuristic would also catch
+        non-state persistables like a BN stat named ``<param>_mean``."""
         from jax.sharding import PartitionSpec as P
 
         if not pv.shape:
@@ -130,11 +152,16 @@ class ShardingOptimizer(MetaOptimizerBase):
         if spec[0] is None:
             spec[0] = "sharding"
             pv.dist_spec = P(*spec)
-        # optimizer state vars are named f"{param}_{state_key}"
-        # (static/optimizer_bridge.py) and share the param's shape
-        prefix = pv.name + "_"
-        for n, v in block.vars.items():
-            if (n.startswith(prefix) and not v.is_parameter
-                    and v.persistable and list(v.shape or ()) ==
-                    list(pv.shape)):
+        if state_keys is not None:
+            candidates = [
+                v for k in state_keys
+                if (v := block.vars.get(f"{pv.name}_{k}")) is not None
+            ]
+        else:  # fallback: bridge naming convention prefix + equal shape
+            prefix = pv.name + "_"
+            candidates = [v for n, v in block.vars.items()
+                          if n.startswith(prefix)]
+        for v in candidates:
+            if (not v.is_parameter and v.persistable
+                    and list(v.shape or ()) == list(pv.shape)):
                 v.dist_spec = P(*spec)

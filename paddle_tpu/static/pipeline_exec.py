@@ -27,10 +27,10 @@ import numpy as np
 import jax
 
 from .backward import GRAD_SUFFIX
-# one shared set with the annotating meta-opt: a new optimizer op type
-# must change phase in BOTH places at once
+# one shared rule with the annotating meta-opt: structural param@GRAD-in /
+# param-out detection (UPDATE_OP_TYPES is only its fast path)
 from ..distributed.fleet.meta_optimizers.meta_optimizer_base import (
-    UPDATE_OP_TYPES as _UPDATE_OP_TYPES,
+    is_update_op as _is_update_op,
 )
 
 
@@ -65,21 +65,27 @@ class PipelinedBlock:
         self.stage_device = devs[: self.num_stages]
         # fetch classification from STATIC shapes: a fetch whose leading
         # dim matches the feed batch is per-sample (concat over micros);
-        # everything else (losses, metrics) averages.  Runtime shapes
-        # cannot tell the two apart when the micro batch is 1.
+        # everything else (losses, metrics) averages.
         feed_batch = {
             int(v.shape[0])
             for n, v in block.vars.items()
             if n in self.feed_names and v.shape
             and isinstance(v.shape[0], (int, np.integer)) and v.shape[0] > 0
         }
+        # tri-state: True/False decided statically, None = dynamic leading
+        # dim (a -1 from static.data OR propagated by a reshape(-1)) —
+        # resolved at runtime against the actual per-micro batch
         self._fetch_batchlike = {}
         for n in self.fetch_names:
             v = block.vars.get(n)
-            self._fetch_batchlike[n] = bool(
-                v is not None and v.shape
-                and isinstance(v.shape[0], (int, np.integer))
-                and v.shape[0] in feed_batch)
+            if v is None or not v.shape:
+                self._fetch_batchlike[n] = False
+                continue
+            d = v.shape[0]
+            if isinstance(d, (int, np.integer)) and d > 0:
+                self._fetch_batchlike[n] = int(d) in feed_batch
+            else:
+                self._fetch_batchlike[n] = None
 
         # param grads to accumulate across micro-batches
         self.param_grads = {
@@ -95,7 +101,7 @@ class PipelinedBlock:
         for op in block.ops:
             if op.fn is None:
                 continue  # send/recv markers + structural ops
-            if op.type in _UPDATE_OP_TYPES:
+            if _is_update_op(block, op):
                 pstage = self._op_stage(op)
                 self.update_ops.append((pstage, op))
                 continue
@@ -115,6 +121,7 @@ class PipelinedBlock:
             for n in getattr(op, "out_order", op.output_names())
             if (v := block.vars.get(n)) is not None and v.persistable
         ]
+        self._persist_set = set(self._persist_compute_outs)
         self._update_fn = None
         # which param each stage owns (for placement)
         self.param_stage = {}
@@ -225,11 +232,19 @@ class PipelinedBlock:
         }
 
         acc_grads = {}
+        # latest value of each persistable var a compute op wrote (BN
+        # running stats, counters): chunk c of micro m always runs after
+        # chunk c of micro m-1 in both schedule modes, so overlaying the
+        # most recent write into each chunk's inputs chains the stats
+        # across micro-batches exactly like the reference SectionWorker's
+        # M sequential section runs per batch
+        persist = {}
         fetch_acc = {n: [] for n in self.fetch_names}
         # scalar feeds broadcast to every micro-batch; batched feeds split
         per = {n: v.shape[0] // M for n, v in feeds.items() if v.ndim}
         last_chunk = len(self.chunks) - 1
         envs = {}
+        produced_by = {}  # micro -> names its own chunks already produced
         env = {}
         peak = 0
         for m, idx in self._schedule(M):
@@ -238,20 +253,34 @@ class PipelinedBlock:
                 for n, v in feeds.items():
                     env[n] = v[m * per[n]:(m + 1) * per[n]] if v.ndim else v
                 envs[m] = env
+                produced_by[m] = set()
             env = envs[m]
+            mine = produced_by[m]
             peak = max(peak, len(envs))
             stage, ops = self.chunks[idx]
             if self._chunk_fns[idx] is None:
                 self._chunk_fns[idx] = self._make_chunk_fn(ops)
             ins, outs = self._chunk_ios[idx]
             dev = self.stage_device[stage]
-            # inter-stage transfer: commit chunk inputs to its device
-            chunk_env = {n: jax.device_put(env[n], dev) for n in ins
-                         if n in env}
+            # inter-stage transfer: commit chunk inputs to its device.
+            # A persistable var this micro has NOT yet written reads the
+            # latest chained value (`persist`) instead of the batch-start
+            # snapshot; one this micro DID produce reads its own env value
+            # — under 1F1B a later micro's chunk 0 runs before this
+            # micro's chunk 1, so persist may already hold the later
+            # micro's write and must not leak into this micro's dataflow.
+            chunk_env = {
+                n: jax.device_put(
+                    env[n] if n in mine else persist.get(n, env[n]), dev)
+                for n in ins if n in env
+            }
             produced = self._chunk_fns[idx](chunk_env)
             for n in outs:
                 if n in produced:
                     env[n] = produced[n]
+                    mine.add(n)
+                    if n in self._persist_set:
+                        persist[n] = produced[n]
             if idx == last_chunk:
                 for g in self.param_grads:
                     if g in env:
@@ -263,16 +292,15 @@ class PipelinedBlock:
                         fetch_acc[n].append(env[n])
                 if m != M - 1:
                     del envs[m]  # retire: frees the micro's activations
+                    del produced_by[m]
         self.last_peak_live_micros = peak
         env = envs.get(M - 1, env)  # the final micro's env survives
 
         # update phase: averaged grads, once per global batch
         upd_env = dict(params)
         # persistable vars a compute op wrote (BN running stats, counters)
-        # carry their last-micro value into the update phase + scope
-        for n in self._persist_compute_outs:
-            if n in env:
-                upd_env[n] = env[n]
+        # carry their chained latest value into the update phase + scope
+        upd_env.update(persist)
         for g, v in acc_grads.items():
             upd_env[g] = v / M
         for pstage, op in self.update_ops:
@@ -290,11 +318,20 @@ class PipelinedBlock:
                 scope.set(n, upd_env[n])
 
         outs = []
+        micro_sizes = set(per.values())
         for n in self.fetch_names:
             vals = fetch_acc[n]
             if not vals:
                 raise KeyError(n)
-            if self._fetch_batchlike.get(n) and vals[0].ndim:
+            batchlike = self._fetch_batchlike.get(n)
+            if batchlike is None:
+                # runtime resolution for dynamic-dim fetches: per-sample
+                # iff the actual leading dim matches the per-micro feed
+                # batch (ambiguous only for a (1,)-leading metric at
+                # micro batch 1, where per-sample is the likelier intent)
+                batchlike = bool(vals[0].ndim and micro_sizes
+                                 and vals[0].shape[0] in micro_sizes)
+            if batchlike and vals[0].ndim:
                 outs.append(np.concatenate(
                     [np.asarray(v) for v in vals], axis=0))
             else:

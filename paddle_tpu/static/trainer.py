@@ -100,7 +100,7 @@ def inference_program(program):
     from .program import Program
     from .backward import GRAD_SUFFIX
     from ..distributed.fleet.meta_optimizers.meta_optimizer_base import (
-        UPDATE_OP_TYPES,
+        is_update_op,
     )
 
     src = program.global_block()
@@ -109,7 +109,7 @@ def inference_program(program):
     blk.vars = src.vars
     kept = []
     for op in src.ops:
-        if op.type in UPDATE_OP_TYPES or op.type in ("send", "recv"):
+        if is_update_op(src, op) or op.type in ("send", "recv"):
             continue
         outs = getattr(op, "out_order", op.output_names())
         if outs and all(o.endswith(GRAD_SUFFIX) for o in outs):

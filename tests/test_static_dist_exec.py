@@ -181,3 +181,33 @@ def test_unfittable_degree_degrades_to_single_device():
     assert main._mesh_axes == {"sharding": 3}
     assert _block(exe).mesh is None
     np.testing.assert_allclose(got, base, rtol=2e-5, atol=1e-6)
+
+
+def test_sharding_state_match_is_exact_not_prefix():
+    """Optimizer-state vars are matched by the bridge's exact
+    f'{param}_{key}' names: a non-state persistable var sharing the
+    prefix and shape (e.g. a running stat named '<param>_mean') must NOT
+    be range-sharded as if it were optimizer state."""
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [32, 16])
+        y = static.data("y", [32, 1])
+        loss = _mlp_loss(x, y)
+        block = main.global_block()
+        wname = next(n for n, v in block.vars.items()
+                     if v.is_parameter and len(v.shape or ()) == 2)
+        decoy = block.create_var(name=wname + "_mean",
+                                 shape=list(block.vars[wname].shape),
+                                 dtype="float32", persistable=True)
+        decoy.is_parameter = False
+        opt = paddle.optimizer.Adam(learning_rate=0.01)
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"sharding_degree": 8}
+        f = Fleet()
+        f.init(is_collective=True, strategy=strategy)
+        apply_meta_optimizers(opt, strategy, loss, startup, f)
+    assert getattr(decoy, "dist_spec", None) is None
+    m1 = main.global_block().vars.get(wname + "_moment1")
+    assert m1 is not None and m1.dist_spec[0] == "sharding"
