@@ -52,6 +52,254 @@ def _wrap_like(template, val):
     return val
 
 
+class ListProxy(list):
+    """List with functional-append semantics in transformed code: the AST
+    pass rewrites `x.append(v)` to `x = convert_list_append(x, v)`, so
+    growth is an assignment the carry/branch machinery propagates
+    (list_transformer.py role)."""
+
+    __slots__ = ()
+
+
+# a list SUBCLASS is a pytree LEAF to jax unless registered — ListProxy
+# must flatten like a list so it rides carries/branch outputs
+jax.tree_util.register_pytree_node(
+    ListProxy,
+    lambda lp: (list(lp), None),
+    lambda _, children: ListProxy(children))
+
+
+@jax.tree_util.register_pytree_node_class
+class _StackedBuffer:
+    """Fixed-capacity stacked tensor list — the LoDTensorArray analogue
+    for traced loops (reference list_transformer.py lowers list append
+    to array_write).  XLA needs static shapes, so a list that grows
+    inside a scan-converted loop becomes a preallocated [capacity, *elem]
+    buffer + a size counter; append writes row `size`.  At loop exit the
+    buffer unrolls back to a ListProxy of rows so downstream list code
+    (stack, len, indexing) is untouched."""
+
+    def __init__(self, buf, size, capacity):
+        self.buf = buf
+        self.size = size  # i32 scalar (may be traced)
+        self.capacity = capacity
+
+    def tree_flatten(self):
+        return (self.buf, self.size), self.capacity
+
+    @classmethod
+    def tree_unflatten(cls, capacity, children):
+        return cls(children[0], children[1], capacity)
+
+    def append(self, v):
+        raw = jnp.asarray(_raw(v))
+        buf = jax.lax.dynamic_update_index_in_dim(
+            self.buf, raw.astype(self.buf.dtype), self.size, 0)
+        return _StackedBuffer(buf, self.size + 1, self.capacity)
+
+    def pop(self, index=-1):
+        if not isinstance(index, int) or index != -1:
+            raise ValueError(
+                "list.pop inside a traced loop supports only pop() / "
+                "pop(-1); arbitrary-index pops would shift the buffer")
+        idx = self.size - 1
+        elem = jax.lax.dynamic_index_in_dim(self.buf, idx, 0,
+                                            keepdims=False)
+        return elem, _StackedBuffer(self.buf, idx, self.capacity)
+
+    def rows(self):
+        return ListProxy(self.buf[k] for k in range(self.capacity))
+
+    def __repr__(self):
+        return (f"_StackedBuffer(capacity={self.capacity}, "
+                f"size={self.size})")
+
+
+def convert_list_append(lst, v):
+    """Functional append: returns the container to rebind the name to."""
+    if isinstance(lst, _StackedBuffer):
+        return lst.append(v)
+    if isinstance(lst, _Undefined):
+        raise ValueError(
+            f"list {lst.name!r} must be bound before .append in "
+            f"converted code")
+    if isinstance(lst, list):
+        return ListProxy(list(lst) + [v])
+    lst.append(v)  # arbitrary object with .append: original semantics
+    return lst
+
+
+_PROBE_POPS = []  # non-empty while a loop-carry probe counts pops
+
+
+def convert_list_pop(lst, index=None):
+    """Functional pop: returns (popped_value, new_container).  A bare
+    `x.pop()` forwards NO index so set/deque pops keep working."""
+    if _PROBE_POPS:
+        _PROBE_POPS[-1] += 1
+    if isinstance(lst, _StackedBuffer):
+        return lst.pop(-1 if index is None else index)
+    if isinstance(lst, list):
+        new = ListProxy(lst)
+        return (new.pop() if index is None else new.pop(index)), new
+    if index is None:
+        return lst.pop(), lst
+    return lst.pop(index), lst
+
+
+def _raw_deep(x):
+    """_raw through list/tuple containers (lists ride XLA carries and
+    branch outputs as pytrees of raw arrays)."""
+    if isinstance(x, _StackedBuffer):
+        return x
+    if isinstance(x, list):
+        return ListProxy(_raw_deep(e) for e in x)
+    if isinstance(x, tuple):
+        return tuple(_raw_deep(e) for e in x)
+    return _raw(x)
+
+
+def _wrap_deep(template, val):
+    if isinstance(val, _StackedBuffer):
+        return val
+    if isinstance(template, (list, tuple)) and isinstance(
+            val, (list, tuple)) and len(template) == len(val):
+        out = [_wrap_deep(t, v) for t, v in zip(template, val)]
+        return ListProxy(out) if isinstance(template, list) else tuple(out)
+    if isinstance(template, Tensor):
+        return _wrap_like(template, val)
+    return val
+
+
+# ---- carry/branch structure promotion --------------------------------
+# The return lowering inits `_return_value_*` as scalar 0.0 (the
+# reference's create_fill_constant_node); every read is guarded by the
+# return flag, so when a traced region assigns a different structure the
+# init can be promoted to zeros of that structure — XLA control flow
+# requires structure-equal branches/carries.  The probe is a jax.eval_shape
+# of the branch/body closure: abstract, runs at trace time only.
+
+def _leaf_sig(leaf):
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        import numpy as _np
+
+        dtype = jnp.result_type(leaf)
+        shape = tuple(_np.shape(leaf))
+    return shape, str(dtype)
+
+
+def _tree_sig(x):
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    return treedef, tuple(_leaf_sig(l) for l in leaves)
+
+
+def _zeros_of(struct_tree):
+    return jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, l.dtype), struct_tree)
+
+
+def _return_value_indices(names):
+    return [i for i, n in enumerate(names)
+            if n.startswith("_return_value_")]
+
+
+def _list_indices(init):
+    return [i for i, v in enumerate(init)
+            if isinstance(v, list) and not isinstance(v, _StackedBuffer)]
+
+
+def _promote_loop_carry(names, init, set_args, probe, capacity):
+    """Probe the loop body once (jax.eval_shape — abstract, trace-time
+    only) and fix the carry:
+
+    - `_return_value_*` placeholders promote to zeros of the structure
+      the body assigns (reads are return-flag-guarded, so zeros are
+      sound);
+    - a list that grows per iteration becomes a fixed-capacity
+      _StackedBuffer when `capacity` (the trip count) is static, and
+      raises for dynamic-trip loops where no capacity exists.
+
+    Returns (init, converted_indices); converted buffers unroll back to
+    lists at loop exit."""
+    rv_idx = _return_value_indices(names)
+    li_idx = _list_indices(init)
+    if not rv_idx and not li_idx:
+        return init, set()
+    _PROBE_POPS.append(0)
+    try:
+        out_s = probe(init)
+    except Exception:
+        return init, set()  # the real trace raises the useful error
+    finally:
+        pops_per_iter = _PROBE_POPS.pop()
+    new = list(init)
+    changed = False
+    converted = set()
+    for i in rv_idx:
+        cur = _tree_sig(_raw_deep(init[i]))
+        ts = _tree_sig(out_s[i])
+        if ts != cur:
+            new[i] = _zeros_of(out_s[i])
+            changed = True
+    for i in li_idx:
+        n0 = len(init[i])
+        out_i = out_s[i]
+        ln = len(out_i) if isinstance(out_i, (list, tuple)) else n0
+        if ln == n0:
+            continue  # fixed-size list: rides the carry as a plain pytree
+        if ln < n0:
+            raise ValueError(
+                f"list {names[i]!r} shrinks inside a traced loop; "
+                "net pops across an iteration are unsupported (the "
+                "buffer capacity could not be bounded)")
+        if capacity is None:
+            raise ValueError(
+                f"list {names[i]!r} grows inside a dynamic-trip-count "
+                "loop: XLA needs a static capacity for the stacked "
+                "buffer. Iterate a tensor (`for t in x`) or a "
+                "python-int range instead of a tensor-bounded "
+                "`while`/`range`.")
+        elem = out_i[-1]
+        esig = _leaf_sig(elem)
+        for s in out_i:
+            if _leaf_sig(s) != esig:
+                raise ValueError(
+                    f"list {names[i]!r} holds mixed shapes/dtypes "
+                    f"({_leaf_sig(s)} vs {esig}); a traced loop list "
+                    "must be stackable")
+        # capacity bounds the PEAK size, not the net: each in-iteration
+        # pop may pair with an extra append beyond the net growth, so
+        # appends/iter <= net growth + pops/iter (pops counted globally
+        # per probe — other lists' pops only over-allocate, never
+        # under-allocate)
+        cap = n0 + (ln - n0 + pops_per_iter) * capacity
+        buf = jnp.zeros((cap,) + tuple(elem.shape), elem.dtype)
+        for j, e in enumerate(init[i]):
+            buf = buf.at[j].set(jnp.asarray(_raw(e)).astype(elem.dtype))
+        new[i] = _StackedBuffer(buf, jnp.asarray(n0, jnp.int32), cap)
+        changed = True
+        converted.add(i)
+    if changed:
+        init = tuple(new)
+        set_args(init)
+    return init, converted
+
+
+def _unroll_buffers(names, get_args, set_args, converted):
+    """At loop exit, unroll the buffers THIS loop created back to lists
+    (buffers that entered from an outer loop stay buffers — the outer
+    loop unrolls its own)."""
+    if not converted:
+        return
+    vals = list(get_args())
+    for i in converted:
+        if isinstance(vals[i], _StackedBuffer):
+            vals[i] = vals[i].rows()
+    set_args(tuple(vals))
+
+
 def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
     """Transformed `if` dispatch (convert_operators.py convert_ifelse).
 
@@ -66,9 +314,9 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
 
     init = get_args()
 
-    def run(branch_fn):
+    def run(branch_fn, binit):
         def f(_):
-            set_args(init)
+            set_args(binit)
             branch_fn()
             outs = get_args()
             for n, v in zip(names, outs):
@@ -77,12 +325,59 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
                         f"variable {n!r} must be assigned in both branches "
                         f"of a tensor-condition `if` (it is undefined in "
                         f"one branch)")
-            return tuple(_raw(v) for v in outs)
+            return tuple(_raw_deep(v) for v in outs)
 
         return f
 
-    out = jax.lax.cond(_to_bool_scalar(pred), run(true_fn), run(false_fn),
-                       0)
+    rv_idx = _return_value_indices(names)
+    li_idx = _list_indices(init)
+    if rv_idx or li_idx:
+        try:
+            t_s = jax.eval_shape(run(true_fn, init), 0)
+            f_s = jax.eval_shape(run(false_fn, init), 0)
+        except Exception:
+            t_s = f_s = None  # the real trace raises the useful error
+        if t_s is not None:
+            new = list(init)
+            changed = False
+            for i in rv_idx:
+                cur = _tree_sig(_raw_deep(init[i]))
+                ts, fs = _tree_sig(t_s[i]), _tree_sig(f_s[i])
+                if ts == fs:
+                    if cur != ts:
+                        new[i] = _zeros_of(t_s[i])
+                        changed = True
+                elif fs == cur:
+                    new[i] = _zeros_of(t_s[i])
+                    changed = True
+                elif ts == cur:
+                    new[i] = _zeros_of(f_s[i])
+                    changed = True
+                else:
+                    raise ValueError(
+                        "early returns under a tensor condition must "
+                        f"return matching shapes/dtypes; got {ts[1]} vs "
+                        f"{fs[1]}")
+            for i in li_idx:
+                n0 = len(init[i])
+                lt = len(t_s[i]) if isinstance(t_s[i], (list, tuple)) \
+                    else n0
+                lf = len(f_s[i]) if isinstance(f_s[i], (list, tuple)) \
+                    else n0
+                if lt != n0 or lf != n0:
+                    raise ValueError(
+                        f"list {names[i]!r} grows under a tensor "
+                        "condition: the result length would be "
+                        "data-dependent, which XLA cannot express. "
+                        "Append unconditionally and select values, or "
+                        "append inside a converted loop (where the list "
+                        "becomes a fixed-capacity buffer).")
+            if changed:
+                init = tuple(new)
+                set_args(init)
+
+    out = jax.lax.cond(_to_bool_scalar(pred), run(true_fn, init),
+                       run(false_fn, init), 0)
     # re-wrap: keep Tensor-ness of the pre-branch value when known,
     # else wrap arrays as Tensors (branch-created values)
     final = []
@@ -90,9 +385,14 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
         if isinstance(i, Tensor):
             final.append(_wrap_like(i, o))
         elif isinstance(i, _Undefined):
-            final.append(Tensor(o, stop_gradient=True))
+            # branch-created values: containers stay containers of raw
+            # arrays; bare arrays wrap as Tensors
+            if isinstance(o, (list, tuple, _StackedBuffer)):
+                final.append(o)
+            else:
+                final.append(Tensor(o, stop_gradient=True))
         else:
-            final.append(o)
+            final.append(_wrap_deep(i, o))
     set_args(tuple(final))
 
 
@@ -141,22 +441,38 @@ def convert_while_loop(cond_fn, body_fn, get_args, set_args, names):
             raise ValueError(
                 f"loop variable {n!r} must be defined before a "
                 f"tensor-condition `while`")
+
+    def mk_restore(templates):
+        def restore(vals):
+            set_args(tuple(_wrap_deep(t, v)
+                           for t, v in zip(templates, vals)))
+        return restore
+
+    def mk_body(templates):
+        restore = mk_restore(templates)
+
+        def b(vals):
+            restore(vals)
+            body_fn()
+            return tuple(_raw_deep(v) for v in get_args())
+
+        return b
+
+    init, _ = _promote_loop_carry(
+        names, init, set_args,
+        lambda ii: jax.eval_shape(mk_body(list(ii)),
+                                  tuple(_raw_deep(v) for v in ii)),
+        capacity=None)
     templates = list(init)
+    restore = mk_restore(templates)
 
     def c(vals):
-        set_args(tuple(_wrap_like(t, v) if isinstance(t, Tensor) else v
-                       for t, v in zip(templates, vals)))
+        restore(vals)
         return _to_bool_scalar(cond_fn())
 
-    def b(vals):
-        set_args(tuple(_wrap_like(t, v) if isinstance(t, Tensor) else v
-                       for t, v in zip(templates, vals)))
-        body_fn()
-        return tuple(_raw(v) for v in get_args())
-
-    out = jax.lax.while_loop(c, b, tuple(_raw(v) for v in init))
-    set_args(tuple(_wrap_like(t, v) if isinstance(t, Tensor) else v
-                   for t, v in zip(templates, out)))
+    out = jax.lax.while_loop(c, mk_body(templates),
+                             tuple(_raw_deep(v) for v in init))
+    restore(out)
 
 
 def _value_semantics_possible(lraw, rraw):
@@ -214,7 +530,74 @@ def convert_logical_not(x):
 def convert_len(x):
     if isinstance(x, Tensor):
         return x.shape[0]
+    if isinstance(x, _StackedBuffer):
+        # live element count, not capacity — traced sizes stay traced
+        # (arithmetic and convert_range both accept them)
+        if _is_traced(x.size):
+            from ...core.tensor import _wrap_data
+
+            return _wrap_data(x.size)
+        return int(x.size)
     return len(x)
+
+
+_CAST_BUILTINS = {"int": int, "float": float, "bool": bool}
+_CAST_DTYPES = {"int": jnp.int32, "float": jnp.float32, "bool": jnp.bool_}
+
+
+def convert_cast(kind, x):
+    """`int(x)` / `float(x)` / `bool(x)` on tensors (reference:
+    cast_transformer.py lowers them to a cast op).  A traced tensor
+    cannot concretize to a python scalar, so the cast yields a same-shape
+    tensor of the target dtype; concrete values keep exact python
+    builtin semantics (including bool() raising on multi-element
+    tensors)."""
+    if isinstance(x, Tensor) or isinstance(x, jax.core.Tracer):
+        raw = _raw(x)
+        if _is_traced(x):
+            return _wrap_like(x, jnp.asarray(raw).astype(_CAST_DTYPES[kind]))
+        return _CAST_BUILTINS[kind](raw)
+    return _CAST_BUILTINS[kind](x)
+
+
+def convert_print(*args, **kwargs):
+    """print() with traced arguments routes through jax.debug.print (the
+    Print-op analogue, print_transformer.py role); concrete calls are
+    plain python prints."""
+    if any(_is_traced(a) for a in args):
+        sep = kwargs.get("sep", " ")
+        fmt = sep.join("{}" for _ in args)
+        jax.debug.print(fmt, *[_raw(a) if isinstance(a, Tensor) else a
+                               for a in args])
+        return
+    print(*args, **kwargs)
+
+
+def convert_assert(cond, msg=None):
+    """`assert` on tensors (assert_transformer.py role: the reference
+    lowers to an Assert op that aborts at runtime).  Traced conditions
+    check on-host via jax.debug.callback with the concrete value —
+    all-elements semantics like the reference's Assert; concrete
+    tensors check immediately."""
+    import numpy as _np
+
+    if _is_traced(cond) or (msg is not None and _is_traced(msg)):
+        def _chk(c, m):
+            if not _np.all(_np.asarray(c)):
+                raise AssertionError(
+                    m if m is not None else "Assert failed in traced code")
+
+        jax.debug.callback(
+            _chk, jnp.asarray(_raw(cond)),
+            _raw(msg) if isinstance(msg, Tensor) else msg)
+        return
+    val = _raw(cond) if isinstance(cond, Tensor) else cond
+    ok = bool(_np.all(_np.asarray(val))) if hasattr(val, "shape") \
+        else bool(val)
+    if not ok:
+        if msg is not None:
+            raise AssertionError(msg)
+        raise AssertionError
 
 
 class _TensorRange:
@@ -304,12 +687,33 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
                 raise ValueError(
                     f"loop variable {n!r} must be defined before a "
                     f"tensor-range `for` loop")
-        templates = list(init)
 
-        def restore(vals):
-            set_args(tuple(
-                _wrap_like(t, v) if isinstance(t, Tensor) else v
-                for t, v in zip(templates, vals)))
+        def mk_restore(templates):
+            def restore(vals):
+                set_args(tuple(_wrap_deep(t, v)
+                               for t, v in zip(templates, vals)))
+            return restore
+
+        def mk_body(templates):
+            restore = mk_restore(templates)
+
+            def b(state):
+                i, vals = state
+                restore(vals)
+                assign_fn(_wrap_data(i))
+                body_fn()
+                return (i + step, tuple(_raw_deep(v) for v in get_args()))
+
+            return b
+
+        init, _ = _promote_loop_carry(
+            names, init, set_args,
+            lambda ii: jax.eval_shape(
+                mk_body(list(ii)),
+                (start, tuple(_raw_deep(v) for v in ii)))[1],
+            capacity=None)
+        templates = list(init)
+        restore = mk_restore(templates)
 
         brk_idx = (names.index(break_flag)
                    if break_flag is not None and break_flag in names
@@ -324,15 +728,9 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
                 in_range = in_range & jnp.logical_not(flag.astype(bool))
             return in_range
 
-        def b(state):
-            i, vals = state
-            restore(vals)
-            assign_fn(_wrap_data(i))
-            body_fn()
-            return (i + step, tuple(_raw(v) for v in get_args()))
-
-        _, out = jax.lax.while_loop(c, b,
-                                    (start, tuple(_raw(v) for v in init)))
+        _, out = jax.lax.while_loop(
+            c, mk_body(templates),
+            (start, tuple(_raw_deep(v) for v in init)))
         restore(out)
         return
 
@@ -362,21 +760,39 @@ def convert_for_loop(iter_obj, assign_fn, body_fn, get_args, set_args,
                 raise ValueError(
                     f"loop variable {nm!r} must be defined before a "
                     f"tensor-iteration `for` loop")
+
+        def mk_restore(templates):
+            def restore(vals):
+                set_args(tuple(_wrap_deep(t, v)
+                               for t, v in zip(templates, vals)))
+            return restore
+
+        def mk_body(templates):
+            restore = mk_restore(templates)
+
+            def body(vals, row):
+                restore(vals)
+                assign_fn(_wrap_data(row))
+                body_fn()
+                return tuple(_raw_deep(v) for v in get_args()), None
+
+            return body
+
+        # lists growing inside the scan become fixed-capacity stacked
+        # buffers (capacity = initial length + appends/iter * n rows)
+        init, converted = _promote_loop_carry(
+            names, init, set_args,
+            lambda ii: jax.eval_shape(
+                mk_body(list(ii)),
+                tuple(_raw_deep(v) for v in ii), raw[0])[0],
+            capacity=n)
         templates = list(init)
+        restore = mk_restore(templates)
 
-        def restore(vals):
-            set_args(tuple(
-                _wrap_like(t, v) if isinstance(t, Tensor) else v
-                for t, v in zip(templates, vals)))
-
-        def body(vals, row):
-            restore(vals)
-            assign_fn(_wrap_data(row))
-            body_fn()
-            return tuple(_raw(v) for v in get_args()), None
-
-        out, _ = jax.lax.scan(body, tuple(_raw(v) for v in init), raw)
+        out, _ = jax.lax.scan(mk_body(templates),
+                              tuple(_raw_deep(v) for v in init), raw)
         restore(out)
+        _unroll_buffers(names, get_args, set_args, converted)
         return
 
     # plain python iterable: honor the break flag so infinite

@@ -60,6 +60,191 @@ def _has_return(nodes):
     return v.found
 
 
+class _ReturnLowering:
+    """Lower early `return`s to flag + value form so the control-flow
+    conversion can trace them (reference: return_transformer.py — a
+    `__return` bool per function, `__return_value` accumulator, guards on
+    the statements after each return, `not __return` ANDed into loop
+    conditions, one final `return __return_value`).
+
+    The value placeholder inits as scalar 0.0 (the reference's
+    create_fill_constant_node); when a traced branch assigns a different
+    structure the convert shims promote the init to zeros of that
+    structure — sound because every read is guarded by the flag.  A
+    function that can fall off the end without returning yields the
+    placeholder instead of None (documented deviation, shared with the
+    reference's lowering)."""
+
+    def __init__(self):
+        self.flag = "_return_flag_0"
+        self.val = "_return_value_0"
+
+    def apply(self, fn_def):
+        returns = self._collect_returns(fn_def.body)
+        if not returns:
+            return False
+        if len(returns) == 1 and fn_def.body \
+                and returns[0] is fn_def.body[-1]:
+            return False  # single tail return: nothing to lower
+        new_body = self._lower_block(fn_def.body)
+        inits = ast.parse(f"{self.flag} = False\n{self.val} = 0.0").body
+        tail = ast.parse(f"return {self.val}").body[0]
+        fn_def.body = inits + new_body + [tail]
+        ast.fix_missing_locations(fn_def)
+        return True
+
+    @staticmethod
+    def _collect_returns(stmts):
+        found = []
+
+        class V(ast.NodeVisitor):
+            def visit_Return(self, node):
+                found.append(node)
+
+            def visit_FunctionDef(self, node):
+                pass  # nested defs own their returns
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_ClassDef = visit_FunctionDef
+            visit_Lambda = visit_FunctionDef
+
+        for s in stmts:
+            V().visit(s)
+        return found
+
+    def _sets_flag(self, stmt):
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and n.id == self.flag \
+                    and isinstance(n.ctx, ast.Store):
+                return True
+        return False
+
+    def _guard_list(self, stmts):
+        """After any statement that may set the return flag, wrap the
+        remaining statements in `if not flag:` (recursively — later
+        setters inside the guard body re-guard their own tails)."""
+        out = []
+        for i, s in enumerate(stmts):
+            out.append(s)
+            if self._sets_flag(s) and i + 1 < len(stmts):
+                g = ast.parse(f"if not {self.flag}:\n    pass").body[0]
+                g.body = self._guard_list(stmts[i + 1:])
+                out.append(ast.fix_missing_locations(
+                    ast.copy_location(g, s)))
+                break
+        return out
+
+    def _lower_block(self, stmts):
+        out = []
+        for s in stmts:
+            if isinstance(s, ast.Return):
+                if s.value is not None:
+                    a = ast.parse(f"{self.val} = 0").body[0]
+                    a.value = s.value
+                else:
+                    a = ast.parse(f"{self.val} = None").body[0]
+                out.append(ast.copy_location(
+                    ast.fix_missing_locations(a), s))
+                out.append(ast.copy_location(ast.fix_missing_locations(
+                    ast.parse(f"{self.flag} = True").body[0]), s))
+                continue
+            if isinstance(s, ast.If):
+                s.body = self._lower_block(s.body)
+                s.orelse = self._lower_block(s.orelse)
+            elif isinstance(s, ast.While):
+                s.body = self._lower_block(s.body)
+                if any(self._sets_flag(b) for b in s.body):
+                    # next iteration must not start once returned
+                    s.test = ast.BoolOp(
+                        op=ast.And(),
+                        values=[s.test,
+                                ast.parse(f"not {self.flag}",
+                                          mode="eval").body])
+                    if s.orelse:
+                        # python runs while-else when the condition goes
+                        # false; a real return would have skipped it
+                        g = ast.parse(
+                            f"if not {self.flag}:\n    pass").body[0]
+                        g.body = self._lower_block(s.orelse)
+                        s.orelse = [g]
+                ast.fix_missing_locations(s)
+            elif isinstance(s, ast.For):
+                s.body = self._lower_block(s.body)
+                if any(self._sets_flag(b) for b in s.body):
+                    # break exits the loop AND skips for-else, matching
+                    # what the original return did
+                    s.body.append(ast.parse(
+                        f"if {self.flag}:\n    break").body[0])
+                if s.orelse:
+                    s.orelse = self._lower_block(s.orelse)
+                ast.fix_missing_locations(s)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                s.body = self._lower_block(s.body)
+            elif isinstance(s, ast.Try):
+                s.body = self._lower_block(s.body)
+                s.orelse = self._lower_block(s.orelse)
+                s.finalbody = self._lower_block(s.finalbody)
+                for h in s.handlers:
+                    h.body = self._lower_block(h.body)
+            out.append(s)
+        return self._guard_list(out)
+
+
+class _ListRewriter(ast.NodeTransformer):
+    """`<name>.append(v)` statement -> `<name> = convert_list_append(
+    <name>, v)` so list growth is an ASSIGNMENT the carry/branch
+    machinery propagates; `<name>.pop(...)` (bare or single-target
+    assign) -> convert_list_pop the same way (list_transformer.py role:
+    the reference turns these into tensor_array ops).  Attribute targets
+    (`self.xs.append`) are left alone — rebinding an attribute would
+    change shared-object semantics."""
+
+    @staticmethod
+    def _method_on_name(call, method):
+        return (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == method
+                and isinstance(call.func.value, ast.Name))
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        call = node.value
+        if self._method_on_name(call, "append") and len(call.args) == 1 \
+                and not call.keywords:
+            name = call.func.value.id
+            new = ast.parse(
+                f"{name} = {_PT}.convert_list_append({name}, _pt_v)"
+            ).body[0]
+            new.value.args[1] = call.args[0]
+            return ast.copy_location(ast.fix_missing_locations(new), node)
+        if self._method_on_name(call, "pop") and not call.keywords \
+                and len(call.args) <= 1:
+            name = call.func.value.id
+            new = ast.parse(
+                f"_pt_popped, {name} = {_PT}.convert_list_pop({name})"
+            ).body[0]
+            new.value.args.extend(call.args)
+            return ast.copy_location(ast.fix_missing_locations(new), node)
+        return node
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        call = node.value
+        if self._method_on_name(call, "pop") and not call.keywords \
+                and len(call.args) <= 1 and len(node.targets) == 1:
+            name = call.func.value.id
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                return node  # x = x.pop() — leave degenerate form alone
+            new = ast.parse(
+                f"_pt_tmp, {name} = {_PT}.convert_list_pop({name})"
+            ).body[0]
+            new.value.args.extend(call.args)
+            new.targets[0].elts[0] = tgt
+            return ast.copy_location(ast.fix_missing_locations(new), node)
+        return node
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
@@ -412,11 +597,68 @@ class _RangeRewriter(ast.NodeTransformer):
         return node
 
 
+_BUILTIN_SHIMS = {"int": "convert_cast", "float": "convert_cast",
+                  "bool": "convert_cast", "len": "convert_len",
+                  "print": "convert_print"}
+
+
+class _BuiltinShimRewriter(ast.NodeTransformer):
+    """cast/print/assert/len transformer roles (reference:
+    cast_transformer.py, print_transformer.py, assert_transformer.py):
+    `int/float/bool(x)` -> convert_cast (traced tensors cast instead of
+    concretizing), `print` -> convert_print (jax.debug.print when
+    traced), `len` -> convert_len, `assert` -> convert_assert (host
+    callback check when traced).  All shims keep exact python semantics
+    for concrete values."""
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.func, ast.Name):
+            return node
+        fid = node.func.id
+        shim = _BUILTIN_SHIMS.get(fid)
+        if shim is None:
+            return node
+        if fid in ("int", "float", "bool", "len"):
+            if len(node.args) != 1 or node.keywords:
+                return node  # int(x, base) etc: not a cast
+            args = ([ast.Constant(value=fid)] if shim == "convert_cast"
+                    else []) + node.args
+            new = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_PT, ctx=ast.Load()),
+                                   attr=shim, ctx=ast.Load()),
+                args=args, keywords=[])
+            return ast.fix_missing_locations(ast.copy_location(new, node))
+        if any(isinstance(a, ast.Starred) for a in node.args) or any(
+                k.arg is None for k in node.keywords):
+            return node  # *args/**kwargs print: leave alone
+        new = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_PT, ctx=ast.Load()),
+                               attr="convert_print", ctx=ast.Load()),
+            args=node.args, keywords=node.keywords)
+        return ast.fix_missing_locations(ast.copy_location(new, node))
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test]
+        if node.msg is not None:
+            args.append(node.msg)
+        new = ast.Expr(value=ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_PT, ctx=ast.Load()),
+                               attr="convert_assert", ctx=ast.Load()),
+            args=args, keywords=[]))
+        return ast.fix_missing_locations(ast.copy_location(new, node))
+
+
 def _has_control_flow(tree):
     for node in ast.walk(tree):
-        if isinstance(node, (ast.If, ast.While, ast.For, ast.BoolOp)):
+        if isinstance(node, (ast.If, ast.While, ast.For, ast.BoolOp,
+                             ast.Assert)):
             return True
         if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("int", "float", "bool", "print"):
             return True
     return False
 
@@ -429,6 +671,9 @@ def _transform_source(source, filename, freevars):
         return None, fn_def.name  # nothing to rewrite — keep the original
     # strip decorators: the transformed def must not re-apply @to_static
     fn_def.decorator_list = []
+    _ReturnLowering().apply(fn_def)
+    _ListRewriter().visit(tree)
+    _BuiltinShimRewriter().visit(tree)
     t = _ControlFlowTransformer()
     new_tree = t.visit(tree)
     ast.fix_missing_locations(new_tree)

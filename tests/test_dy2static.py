@@ -396,9 +396,11 @@ def test_nested_loop_break_is_local():
 
 # ---- review regressions: break/continue edge cases ----
 
-def test_break_plus_return_stays_plain_python():
-    """A loop with both break and return falls back to plain Python
-    without half-lowered flags (review finding: NameError)."""
+def test_break_plus_return_python_floats_eager():
+    """break + early return with plain python loop vars: eager semantics
+    preserved after conversion (round 3 pinned a plain-python fallback
+    here; the return lowering converted it — see
+    test_break_plus_return_now_converts for the traced pin)."""
 
     def f(x, n):
         i = 0.0
@@ -495,3 +497,342 @@ def test_for_with_nested_ineligible_loop_still_breaks():
 
     g = transform_function(f)
     np.testing.assert_allclose(g(_t([2.0])).numpy(), [6.0])
+
+
+# ---- early-return lowering (return_transformer.py:136 role) ----
+# Early `return` under a tensor condition rewrites into a return-flag +
+# return-value pair: statements after the return are guarded, loop
+# conditions AND with `not flag`, and one final `return value` remains.
+
+def test_early_return_tensor_if_scalar_jit():
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.mean(x)
+        if s > 0:
+            return s * 2.0
+        return s - 1.0
+
+    np.testing.assert_allclose(f(_t([1.0, 3.0])).numpy(), 4.0)
+    # same shapes -> same cached computation, other branch
+    np.testing.assert_allclose(f(_t([-1.0, -3.0])).numpy(), -3.0)
+
+
+def test_early_return_tensor_if_nonscalar_promotion_jit():
+    """The return-value placeholder inits as scalar 0.0; a non-scalar
+    early return must promote it to the branch's shape/dtype (guarded
+    reads make zeros-of-any-shape sound)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            return x * 2.0
+        return x - 1.0
+
+    np.testing.assert_allclose(f(_t([1.0, 3.0])).numpy(), [2.0, 6.0])
+    np.testing.assert_allclose(f(_t([-1.0, -3.0])).numpy(), [-2.0, -4.0])
+
+
+def test_early_return_eager_python_cond_unchanged():
+    def f(x, flag):
+        if flag:  # python bool: plain-python path end to end
+            return x + 1.0
+        y = x * 2.0
+        return y
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t([1.0]), True).numpy(), [2.0])
+    np.testing.assert_allclose(g(_t([1.0]), False).numpy(), [2.0])
+
+
+def test_early_return_mid_function_guards_rest():
+    """Statements after a lowered return must not execute once the flag
+    is up (here: they would change the result)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            return x * 2.0
+        x = x * 100.0
+        return x
+
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [4.0])
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-200.0])
+
+
+def test_early_return_in_while_loop_jit():
+    def f(x, n):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            x = x + 1.0
+            if paddle.mean(x) > 4.0:
+                return x * 10.0
+            i = i + 1.0
+        return x
+
+    # eager run (concrete tensors, plain python) is the oracle
+    expect = f(_t([1.0]), _t(100.0)).numpy()
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(_t([1.0]), _t(100.0)).numpy(), expect)
+    assert expect[0] == 50.0  # x reaches 5.0, returns 50.0
+    # loop exhausts without the early return firing
+    expect2 = f(_t([-10.0]), _t(3.0)).numpy()
+    np.testing.assert_allclose(jf(_t([-10.0]), _t(3.0)).numpy(), expect2)
+
+
+def test_break_plus_return_now_converts():
+    """A loop with both break and early return CONVERTS now (round-3
+    pinned the plain-python fallback; return lowering removed the
+    blocker).  Conversion is pinned by running under jit with a traced
+    loop bound — a plain-python `while i < n` would raise on the traced
+    bool."""
+
+    def f(x, n):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            if i >= 2.0:
+                break
+            if paddle.mean(x) < -1e9:  # never taken
+                return x * 0.0
+            i = i + 1.0
+        return x + i
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t([1.0]), _t(10.0)).numpy(), [3.0])
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(_t([1.0]), _t(10.0)).numpy(), [3.0])
+
+
+# ---- list lowering (list_transformer.py role) ----
+# `xs.append(v)` rewrites to the functional `xs = convert_list_append(xs, v)`
+# so list growth is an assignment the carry/branch machinery sees; inside a
+# scan-converted loop the list becomes a preallocated stacked buffer (the
+# tensor_array analogue — XLA needs static shapes, so capacity is
+# len(initial) + trip_count * appends_per_iteration).
+
+def test_list_append_eager_unchanged():
+    def f(x):
+        ys = []
+        for t in x:
+            ys.append(t * 2.0)
+        return paddle.stack(ys)
+
+    g = transform_function(f)
+    np.testing.assert_allclose(
+        g(_t([[1.0, 2.0], [3.0, 4.0]])).numpy(), [[2.0, 4.0], [6.0, 8.0]])
+
+
+def test_list_append_scan_loop_jit():
+    @paddle.jit.to_static
+    def f(x):
+        ys = []
+        h = paddle.zeros([2])
+        for t in x:
+            h = paddle.tanh(h + t)
+            ys.append(h)
+        return paddle.stack(ys)
+
+    x = np.array([[1.0, 2.0], [0.5, -0.5], [2.0, 1.0]], np.float32)
+    # numpy oracle
+    h = np.zeros(2, np.float32)
+    rows = []
+    for r in x:
+        h = np.tanh(h + r)
+        rows.append(h)
+    np.testing.assert_allclose(f(_t(x)).numpy(), np.stack(rows), rtol=1e-6)
+
+
+def test_list_append_with_preloop_elements_jit():
+    @paddle.jit.to_static
+    def f(x):
+        first = paddle.sum(x, axis=0)
+        ys = [first]
+        for t in x:
+            ys.append(t + 1.0)
+        return paddle.stack(ys)
+
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    expect = np.stack([x.sum(0), x[0] + 1.0, x[1] + 1.0])
+    np.testing.assert_allclose(f(_t(x)).numpy(), expect, rtol=1e-6)
+
+
+def test_decoder_early_return_plus_list_append_torch_oracle():
+    """The round-4 deliverable: a decoder-style model using BOTH early
+    return and list-append converts under to_static and matches an
+    independently-built torch twin."""
+    import torch
+
+    rng = np.random.RandomState(7)
+    Wi = rng.randn(4, 8).astype(np.float32) * 0.3
+    Wh = rng.randn(8, 8).astype(np.float32) * 0.3
+    Wo = rng.randn(8, 2).astype(np.float32) * 0.3
+
+    class Decoder(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.wi = self.create_parameter([4, 8])
+            self.wh = self.create_parameter([8, 8])
+            self.wo = self.create_parameter([8, 2])
+            self.wi.set_value(Wi)
+            self.wh.set_value(Wh)
+            self.wo.set_value(Wo)
+
+        def forward(self, x):
+            h = paddle.zeros([8])
+            ys = []
+            for t in x:  # scan over steps
+                h = paddle.tanh(paddle.matmul(t, self.wi)
+                                + paddle.matmul(h, self.wh))
+                ys.append(paddle.matmul(h, self.wo))
+            out = paddle.stack(ys)
+            if paddle.mean(out) > 0:  # data-dependent early return
+                return out * 2.0
+            return out - 1.0
+
+    def torch_twin(xv):
+        h = torch.zeros(8)
+        ys = []
+        for t in torch.as_tensor(xv):
+            h = torch.tanh(t @ torch.as_tensor(Wi) + h @ torch.as_tensor(Wh))
+            ys.append(h @ torch.as_tensor(Wo))
+        out = torch.stack(ys)
+        return out * 2.0 if out.mean() > 0 else out - 1.0
+
+    x_pos = rng.randn(5, 4).astype(np.float32) + 1.0
+    x_neg = rng.randn(5, 4).astype(np.float32) - 1.0
+    dec = Decoder()
+    eager_pos = dec(_t(x_pos)).numpy()  # eager (plain python) first
+    sdec = paddle.jit.to_static(Decoder())
+    for xv in (x_pos, x_neg):
+        tw = torch_twin(xv).numpy()
+        np.testing.assert_allclose(sdec(_t(xv)).numpy(), tw,
+                                   rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(eager_pos, torch_twin(x_pos).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---- cast / print / assert transformers ----
+
+def test_cast_builtins_traced_and_concrete():
+    """int()/float()/bool() on traced tensors cast (cast_transformer.py
+    role); concrete values keep exact python semantics."""
+
+    @paddle.jit.to_static
+    def f(x):
+        k = int(x * 2.0)  # traced -> int32 cast, not concretization
+        return float(k) + 0.5
+
+    np.testing.assert_allclose(f(_t(3.4)).numpy(), 6.5)  # int(6.8)=6
+
+    def g(x):
+        if bool(x > 0):  # concrete: plain python bool
+            return int(x)
+        return 0
+
+    gg = transform_function(g)
+    assert gg(_t(5.7)) == 5
+
+
+def test_assert_traced_and_concrete():
+    def f(x):
+        assert paddle.mean(x) > 0, "mean must be positive"
+        return x * 2.0
+
+    g = transform_function(f)
+    np.testing.assert_allclose(g(_t([1.0])).numpy(), [2.0])
+    with pytest.raises(AssertionError, match="mean must be positive"):
+        g(_t([-1.0]))
+    # traced: compiles, checks via host callback
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(_t([1.0])).numpy(), [2.0])
+
+
+def test_print_traced_compiles(capsys):
+    @paddle.jit.to_static
+    def f(x):
+        y = x + 1.0
+        print("value:", y)  # traced -> jax.debug.print, must not crash
+        return y
+
+    np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+
+    def g(x, tag):
+        print(tag, 123)
+        return x
+
+    gg = transform_function(g)
+    gg(_t([1.0]), "hello")
+    assert "hello 123" in capsys.readouterr().out
+
+
+# ---- review regressions: list machinery edge cases ----
+
+def test_list_append_pop_transient_peak_capacity():
+    """Buffer capacity must bound the PEAK in-iteration size, not the
+    net growth (review finding: a clamped out-of-range write silently
+    corrupted the last row)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        ys = []
+        for t in x:
+            ys.append(t)
+            ys.append(t * 10.0)
+            ys.pop()
+        return paddle.stack(ys[:3])
+
+    out = f(_t([[1.0], [2.0], [3.0]])).numpy()
+    np.testing.assert_allclose(out.reshape(-1), [1.0, 2.0, 3.0])
+
+
+def test_len_of_growing_list_in_scan_is_live_size():
+    """len(ys) inside a converted loop is the live element count, not
+    the buffer capacity (review finding: running sums of len were 3x)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        out = paddle.zeros([])
+        ys = []
+        for t in x:
+            ys.append(t)
+            out = out + float(len(ys))
+        return out
+
+    np.testing.assert_allclose(f(_t([[1.0], [2.0], [3.0]])).numpy(), 6.0)
+
+
+def test_bare_pop_on_set_and_deque_still_works():
+    """The pop rewrite must not forward an index to containers whose
+    pop() takes none (review finding: TypeError on deque/set pop)."""
+    import collections
+
+    def f(x):
+        d = collections.deque([1, 2, 3])
+        d.pop()
+        s = {7}
+        s.pop()
+        if paddle.mean(x) > 0:  # force the transform to engage
+            x = x + float(len(d))
+        return x
+
+    g = transform_function(f)
+    assert g is not f
+    np.testing.assert_allclose(g(_t([1.0])).numpy(), [3.0])
+
+
+def test_branch_created_lists_in_both_arms():
+    """A list created inside BOTH arms of a tensor `if` (undefined
+    before) comes back as a list, not a crashing Tensor(list) wrap
+    (review finding: TracerArrayConversionError)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            ys = [x * 2.0, x + 1.0]
+        else:
+            ys = [x * -1.0, x - 1.0]
+        return paddle.stack(ys)
+
+    np.testing.assert_allclose(
+        f(_t([2.0])).numpy().reshape(-1), [4.0, 3.0])
+    np.testing.assert_allclose(
+        f(_t([-2.0])).numpy().reshape(-1), [2.0, -3.0])
