@@ -123,11 +123,23 @@ def not_equal(x, y):
 
 # ---- data & feed ----
 
-def data(name, shape, dtype="float32", lod_level=0):
-    """paddle.static.data (fluid/data.py)."""
+def data(name, shape, dtype="float32", lod_level=0, dim_names=None):
+    """paddle.static.data (fluid/data.py).
+
+    `dim_names` (extension): names for the symbolic dims of unknown (-1)
+    axes, e.g. ``("b", "s")`` — feeds sharing a name genuinely share the
+    dimension when the program serializes (static/desc.py _SymbolicEnv),
+    so seq-polymorphic NLP programs export where positional -1s could
+    not express the equality."""
     block = default_main_program().global_block()
     v = block.create_var(name=name, shape=shape, dtype=dtype, is_data=True,
                          stop_gradient=True)
+    if dim_names is not None:
+        if len(dim_names) != len(shape):
+            raise ValueError(
+                f"dim_names {dim_names!r} must match shape rank "
+                f"{len(shape)}")
+        v.dim_symbols = tuple(dim_names)
     return v
 
 
