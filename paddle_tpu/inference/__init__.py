@@ -218,6 +218,11 @@ class Predictor:
             layer = layer_cls(*(layer_args or ()))
             with open(config.params_file(), "rb") as f:
                 state = pickle.load(f)
+            from ..quant.qat import dequantize_state
+
+            # a weight-only quantized artifact stores integer weights:
+            # every .pdiparams consumer must apply the dequant factors
+            state = dequantize_state(state, meta.get("weight_quant"))
             layer.set_state_dict(state)
             layer.eval()
             self._layer = layer

@@ -65,17 +65,38 @@ def write_exported(fn, avals, prefix):
         return str(e)
 
 
-def save(layer, path, input_spec=None, **configs):
+def save(layer, path, input_spec=None, weight_quant=None, **configs):
+    """`weight_quant` ({id(param): bits}, from quant.weight_quant_map):
+    those weights store as int8 + a dequant factor — in .pdiparams AND as
+    int8 constants inside the AOT export (weight-only int8 deployment,
+    the slim quantization_pass artifact role; ~4x smaller, dequantized
+    on load / inside the module)."""
+    from ..quant.qat import quantize_weight, quant_meta_entry
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     # a save that doesn't (re-)export must not leave an older AOT artifact
     # behind — Predictor prefers .pdexported over fresh params
     if os.path.exists(path + ".pdexported"):
         os.remove(path + ".pdexported")
-    state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
+    quant_by_id = weight_quant or {}
+    qcache = {}  # id(param) -> (q, factor): quantize each weight ONCE so
+    # .pdiparams and the AOT constants are bit-identical by construction
+    quant_meta = {}
+    state = {}
+    for k, v in layer.state_dict().items():
+        bits = quant_by_id.get(id(v))
+        if bits:
+            qcache[id(v)] = qf = quantize_weight(v._data, bits)
+            state[k] = np.asarray(qf[0])
+            quant_meta[k] = quant_meta_entry(bits, qf[1], v._data.dtype)
+        else:
+            state[k] = np.asarray(v.numpy())
     meta = {
         "class_name": type(layer).__name__,
         "param_names": list(state.keys()),
     }
+    if quant_meta:
+        meta["weight_quant"] = quant_meta
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f)
 
@@ -114,11 +135,25 @@ def save(layer, path, input_spec=None, **configs):
             meta["input_dtypes"] = [str(s.dtype) for s in specs]
 
             # deployable AOT artifact for paddle_tpu.inference.Predictor:
-            # weights folded in as constants, inputs are the spec tensors
-            params_live = {k: v._data for k, v in named.items()}
+            # weights folded in as constants, inputs are the spec tensors.
+            # Quantized weights fold as integer constants + an on-the-fly
+            # dequant (weight-only quantization: the module stores the
+            # narrow integers; XLA fuses the dequant into the consuming
+            # matmul/conv)
+            from ..quant.qat import _QCONST_TAG, resolve_param_consts
+
+            params_live = {}
+            for k, v in named.items():
+                bits = quant_by_id.get(id(v))
+                if bits:
+                    q, factor = qcache[id(v)]
+                    params_live[k] = (_QCONST_TAG, q, factor,
+                                      str(v._data.dtype))
+                else:
+                    params_live[k] = v._data
 
             def deploy(*xs):
-                return pure(params_live, *xs)
+                return pure(resolve_param_consts(params_live), *xs)
 
             err = write_exported(deploy, shaped, path)
             if err is not None and dynamic:
@@ -171,4 +206,8 @@ def load(path, **configs):
     if os.path.exists(path + ".pdmodel"):
         with open(path + ".pdmodel", "rb") as f:
             meta = pickle.load(f)
+    # dequant-on-load: quantized weights expand back to their float dtype
+    from ..quant.qat import dequantize_state
+
+    state = dequantize_state(state, meta.get("weight_quant"))
     return TranslatedLayer(state, meta)

@@ -19,6 +19,12 @@ from .qat import (  # noqa: F401
     QuantedConv2D,
     QuantedLinear,
     quant_dequant,
+    quantize_weight,
+    weight_quant_map,
+)
+from .static_quant import (  # noqa: F401
+    PostTrainingQuantization,
+    quantize_inference_weights,
 )
 
 

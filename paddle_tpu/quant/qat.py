@@ -124,6 +124,79 @@ class QuantedConv2D(Layer):
 _QUANT_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
 
 
+def weight_quant_map(model):
+    """{id(param): weight_bits} for every quantized sublayer's weight —
+    the scale handoff from training-time fake-quant to deployment
+    (quantization_pass.py role: the reference rewrites the inference
+    program with the QAT scales; here the scales travel by identity so
+    jit.save can emit int8 weight constants)."""
+    out = {}
+    for sub in model.sublayers(include_self=True):
+        if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+            out[id(sub.weight)] = int(sub.weight_bits)
+    return out
+
+
+def quantize_weight(w, bits=8):
+    """(integer values, dequant factor): symmetric abs-max, the same
+    grid quant_dequant trains against — dequantized inference therefore
+    matches the QAT forward up to float association.  Storage dtype
+    follows the bit width (int8 up to 8 bits, int16 up to 16 — the
+    reference supports both)."""
+    if not 2 <= bits <= 16:
+        raise ValueError(f"weight_bits must be in [2, 16], got {bits}")
+    store = jnp.int8 if bits <= 8 else jnp.int16
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = float(jnp.max(jnp.abs(w)))
+    scale = max(scale, 1e-9)
+    q = jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(store)
+    return q, scale / qmax
+
+
+# ---- shared quantized-artifact format helpers -------------------------
+# ONE implementation for every producer/consumer of the weight_quant
+# metadata (jit.save/load, static save/load_inference_model, the static
+# PTQ rewriter, Predictor's params fallback): a format change (e.g.
+# per-channel scales) happens here or nowhere.
+
+_QCONST_TAG = "__intq__"
+
+
+def quant_param_const(w, bits):
+    """Tagged tuple for a weight held as an integer AOT constant."""
+    q, factor = quantize_weight(w, bits)
+    return (_QCONST_TAG, q, factor, str(np.asarray(w).dtype))
+
+
+def quant_meta_entry(bits, factor, dtype):
+    return {"bits": int(bits), "dequant_factor": factor,
+            "dtype": str(dtype)}
+
+
+def resolve_param_consts(params):
+    """Materialize tagged integer constants back to float arrays (the
+    on-the-fly dequant inside a deploy closure — XLA fuses it into the
+    consuming matmul/conv while the stored constant stays integer)."""
+    return {
+        k: v[1].astype(v[3]) * jnp.asarray(v[2], v[3])
+        if isinstance(v, tuple) and v and v[0] == _QCONST_TAG else v
+        for k, v in params.items()
+    }
+
+
+def dequantize_state(state, quant_meta):
+    """Dequantize a loaded .pdiparams dict per meta['weight_quant'] —
+    dequant-on-load for every consumer that serves float weights."""
+    if not quant_meta:
+        return state
+    out = dict(state)
+    for k, qm in quant_meta.items():
+        if k in out:
+            out[k] = (np.asarray(out[k]).astype(qm.get("dtype", "float32"))
+                      * qm["dequant_factor"])
+    return out
+
+
 class ImperativeQuantAware:
     """qat.py ImperativeQuantAware parity: in-place sublayer swap."""
 
@@ -155,12 +228,21 @@ class ImperativeQuantAware:
                 self.quantize(sub)
         return model
 
-    def save_quantized_model(self, model, path, input_spec=None):
-        """jit-save the fake-quant model (scales ride as constants)."""
+    def save_quantized_model(self, model, path, input_spec=None,
+                             weight_only_int8=True):
+        """Deployable quantized save (post_training_quantization.py +
+        quantization_pass.py artifact role): weights of quantized layers
+        store as int8 + dequant factors — in the params file and as int8
+        constants in the AOT export — so the artifact is ~4x smaller and
+        the Predictor output matches the QAT forward (same abs-max
+        grid).  weight_only_int8=False keeps the old fp32 fake-quant
+        save."""
         from ..jit import save as jit_save
 
         model.eval()
-        jit_save(model, path, input_spec=input_spec)
+        jit_save(model, path, input_spec=input_spec,
+                 weight_quant=weight_quant_map(model)
+                 if weight_only_int8 else None)
 
 
 class ImperativePTQ:

