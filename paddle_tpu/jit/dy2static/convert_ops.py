@@ -527,6 +527,67 @@ def convert_logical_not(x):
     return _wrap_like(x, jnp.logical_not(_raw(x).astype(bool)))
 
 
+import functools as _ft
+import types as _types
+import weakref as _weakref
+
+_CALL_CACHE = _weakref.WeakKeyDictionary()  # fn -> transformed | _CALL_SAME
+_CALL_SAME = object()  # sentinel: "transform was a no-op / fell back"
+
+# call targets whose modules never need conversion: framework/library code
+# is pure-jax (traces as-is); converting it would only add overhead/risk
+_SKIP_CALL_MODULES = {
+    "paddle_tpu", "jax", "jaxlib", "numpy", "torch", "builtins", "math",
+    "functools", "itertools", "collections", "operator", "typing", "os",
+    "re", "copy", "pickle", "warnings",
+}
+
+
+def convert_call(fn):
+    """Recursive callee conversion (reference: call_transformer.py +
+    convert_call_func.py): every call site in transformed code routes
+    through here, so a plain-python helper (or bound method) containing
+    tensor-condition control flow converts too instead of raising a
+    tracer-bool error under jit.  Library callables, builtins, classes
+    and Layer instances pass through untouched; results are cached per
+    function object (values never strongly reference their key, so the
+    weak cache really evicts).  A Layer CALLED as `self.sub(x)` is not
+    converted (its __call__/hook machinery is left intact) — convert the
+    top layer with to_static, or call `self.sub.forward(x)` to convert a
+    control-flow-bearing sublayer forward directly."""
+    if isinstance(fn, _types.MethodType):
+        inner = convert_call(fn.__func__)
+        if inner is fn.__func__:
+            return fn
+        return _types.MethodType(inner, fn.__self__)
+    if isinstance(fn, _ft.partial):
+        inner = convert_call(fn.func)
+        if inner is fn.func:
+            return fn
+        return _ft.partial(inner, *fn.args, **(fn.keywords or {}))
+    if not isinstance(fn, _types.FunctionType):
+        return fn  # builtins, classes, Layer/other callables
+    if getattr(fn, "__name__", "") == "<lambda>":
+        return fn  # getsource is unreliable for lambdas
+    mod = (getattr(fn, "__module__", "") or "").split(".", 1)[0]
+    if mod in _SKIP_CALL_MODULES:
+        return fn
+    try:
+        cached = _CALL_CACHE.get(fn)
+    except TypeError:
+        return fn
+    if cached is None:
+        from .transformer import transform_function
+
+        new_fn = transform_function(fn)  # falls back to fn on failure
+        cached = _CALL_SAME if new_fn is fn else new_fn
+        try:
+            _CALL_CACHE[fn] = cached
+        except TypeError:
+            pass
+    return fn if cached is _CALL_SAME else cached
+
+
 def convert_len(x):
     if isinstance(x, Tensor):
         return x.shape[0]

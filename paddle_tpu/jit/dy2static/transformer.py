@@ -650,15 +650,43 @@ class _BuiltinShimRewriter(ast.NodeTransformer):
         return ast.fix_missing_locations(ast.copy_location(new, node))
 
 
+class _CallRewriter(ast.NodeTransformer):
+    """call_transformer.py role: wrap every call target in
+    `convert_call(...)` so plain-python callees with tensor-condition
+    control flow convert recursively.  `super`/introspection builtins and
+    the shim namespace stay unwrapped (zero-arg super needs its calling
+    frame; `range` must stay recognizable to the for-loop lowering)."""
+
+    SKIP_NAMES = {"super", "range", "isinstance", "issubclass", "getattr",
+                  "setattr", "hasattr", "type", "locals", "globals", "vars",
+                  "eval", "exec", "__import__"}
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in self.SKIP_NAMES:
+            return node
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == _PT:
+            return node  # already a shim call
+        node.func = ast.Call(
+            func=ast.Attribute(value=ast.Name(id=_PT, ctx=ast.Load()),
+                               attr="convert_call", ctx=ast.Load()),
+            args=[f], keywords=[])
+        return ast.fix_missing_locations(node)
+
+
 def _has_control_flow(tree):
+    """Whether the transform has anything to do.  Any CALL counts: even a
+    function with no control flow of its own must wrap its call sites in
+    convert_call, or a callee's tensor-condition control flow would run
+    unconverted (the recursive chain must not break at pass-through
+    helpers)."""
     for node in ast.walk(tree):
         if isinstance(node, (ast.If, ast.While, ast.For, ast.BoolOp,
-                             ast.Assert)):
+                             ast.Assert, ast.Call)):
             return True
         if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
-            return True
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
-                and node.func.id in ("int", "float", "bool", "print"):
             return True
     return False
 
@@ -674,6 +702,7 @@ def _transform_source(source, filename, freevars):
     _ReturnLowering().apply(fn_def)
     _ListRewriter().visit(tree)
     _BuiltinShimRewriter().visit(tree)
+    _CallRewriter().visit(tree)
     t = _ControlFlowTransformer()
     new_tree = t.visit(tree)
     ast.fix_missing_locations(new_tree)
@@ -716,7 +745,11 @@ def transform_function(fn):
         namespace[_PT] = convert_ops
         exec(code, namespace)
         new_fn = namespace["_pt_factory"](*cells)
-        new_fn.__wrapped_original__ = fn
+        # weakref, not the fn: a strong back-reference would keep every
+        # convert_call WeakKeyDictionary entry alive forever
+        import weakref
+
+        new_fn.__wrapped_original__ = weakref.ref(fn)
         return new_fn
     except (OSError, TypeError, SyntaxError, IndentationError):
         return fn

@@ -836,3 +836,79 @@ def test_branch_created_lists_in_both_arms():
         f(_t([2.0])).numpy().reshape(-1), [4.0, 3.0])
     np.testing.assert_allclose(
         f(_t([-2.0])).numpy().reshape(-1), [2.0, -3.0])
+
+
+# ---- convert_call: recursive callee conversion (call_transformer.py) ----
+
+def test_nested_helper_with_tensor_cond_converts():
+    """A plain-python helper called from converted code converts too:
+    its tensor-condition `if` must compile instead of raising a
+    tracer-bool error."""
+
+    def clamp_sign(y):
+        if paddle.mean(y) > 0:  # tensor cond inside the CALLEE
+            return y * 2.0
+        return y * -1.0
+
+    @paddle.jit.to_static
+    def f(x):
+        # NOTE: no control flow of its own — the transform must still
+        # engage (any call site counts) or the recursive chain breaks
+        h = x + 1.0
+        return clamp_sign(h)
+
+    np.testing.assert_allclose(f(_t([1.0])).numpy(), [4.0])
+    np.testing.assert_allclose(f(_t([-3.0])).numpy(), [2.0])
+
+
+def test_bound_method_helper_with_loop_converts():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+
+        def _iterate(self, x, n):
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < n:  # tensor while inside a helper METHOD
+                x = x + 1.0
+                i = i + 1.0
+            return x
+
+        def forward(self, x, n):
+            h = self.fc(x)
+            if paddle.mean(h) > -1e9:
+                h = self._iterate(h, n)
+            return h
+
+    paddle.seed(0)
+    net = Net()
+    x = _t(np.ones((1, 2), np.float32))
+    with paddle.no_grad():
+        want = net(x, _t(3.0)).numpy()
+    paddle.jit.to_static(net)
+    got = net(x, _t(3.0)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_helper_chain_and_builtins_untouched():
+    """Helper-calls-helper converts down the chain; builtins/classes/np
+    pass through convert_call unchanged."""
+
+    def inner(y):
+        if paddle.mean(y) > 0:
+            return y + 10.0
+        return y - 10.0
+
+    def outer(y):
+        assert isinstance(y, type(y))  # builtins via convert_call: no-op
+        d = dict(a=1)  # class call passes through
+        return inner(y) + float(len(d)) - 1.0
+
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > -1e9:
+            x = outer(x)
+        return x
+
+    np.testing.assert_allclose(f(_t([1.0])).numpy(), [11.0])
+    np.testing.assert_allclose(f(_t([-1.0])).numpy(), [-11.0])

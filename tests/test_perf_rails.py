@@ -2,11 +2,14 @@
 
 BENCH_CPU_RAILS.json (committed, refreshed via tools/cpu_rails.py) holds
 jitted op latencies and compile-time rails measured on CPU.  This test
-re-measures and fails on >2x regressions — the perf signal that works
-when the TPU pool is down.  Margins: jitted op latencies compare against
-max(committed, 200us) to stay out of the scheduler-noise domain;
-compile rails compare directly (they are seconds-scale and stable).
-"""
+re-measures and fails on gross regressions — the perf signal that works
+when the TPU pool is down.  Margins: jitted op latencies compare at
+2.5x against max(committed, 300us): the round-4 rails refresh roughly
+halved several committed latencies (newer jax), and the tighter
+baselines need load headroom — a full-suite run measures after ~25 min
+of allocator pressure, where a 2x gate on a quiet-machine baseline
+false-positives.  Compile rails compare directly (seconds-scale,
+stable)."""
 import json
 import os
 import sys
@@ -41,8 +44,8 @@ def test_op_latency_rails(rails):
                 # the committed rails could jit this op; losing that
                 # entirely is the worst regression, not a skip
                 bad[op] = f"{op}: jit path broke (no measurement)"
-            elif have > 2.0 * max(want, 200.0):
-                bad[op] = (f"{op}: {have:.0f}us > 2x committed "
+            elif have > 2.5 * max(want, 300.0):
+                bad[op] = (f"{op}: {have:.0f}us > 2.5x committed "
                            f"{want:.0f}us")
         return bad
 
