@@ -550,11 +550,13 @@ def convert_call(fn):
     tensor-condition control flow converts too instead of raising a
     tracer-bool error under jit.  Library callables, builtins, classes
     and Layer instances pass through untouched; results are cached per
-    function object (values never strongly reference their key, so the
-    weak cache really evicts).  A Layer CALLED as `self.sub(x)` is not
-    converted (its __call__/hook machinery is left intact) — convert the
-    top layer with to_static, or call `self.sub.forward(x)` to convert a
-    control-flow-bearing sublayer forward directly."""
+    function object in a weak dict.  Cache lifetime is honest-normal: a
+    module-level function's entry lives as long as its module (the
+    transformed code shares the module's real globals, which reference
+    the original fn), while nested/closure helpers evict with their
+    cells — no globals snapshot is copied or pinned either way.  A Layer
+    CALLED as `self.sub(x)` converts through Layer.__call__'s
+    trace-scoped forward converter."""
     if isinstance(fn, _types.MethodType):
         inner = convert_call(fn.__func__)
         if inner is fn.__func__:

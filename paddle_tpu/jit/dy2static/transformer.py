@@ -724,7 +724,17 @@ def transform_function(fn):
     """Source-rewrite `fn`; returns the transformed function, or `fn`
     unchanged when there is no control flow to rewrite, the source is
     unavailable (lambdas, REPL) or the transform fails (reference falls
-    back the same way)."""
+    back the same way).
+
+    Live-semantics guarantees (review r4): the transformed function
+    executes with `fn`'s REAL `__globals__` (module-global rebinds are
+    seen on retrace and `global` writes land in the module, not a
+    discarded copy) and shares `fn`'s ORIGINAL closure cells (nonlocal
+    rebinds stay visible both ways; zero-arg super() keeps its
+    `__class__` cell)."""
+    import types
+    import weakref
+
     try:
         source = textwrap.dedent(inspect.getsource(fn))
         freevars = tuple(fn.__code__.co_freevars)
@@ -733,22 +743,34 @@ def transform_function(fn):
             freevars)
         if code is None:
             return fn
-        cells = []
-        for var, cell in zip(freevars, fn.__closure__ or ()):
-            try:
-                cells.append(cell.cell_contents)
-            except ValueError:
-                return fn  # unfillable cell — keep the original
-        namespace = dict(fn.__globals__)
         from . import convert_ops
 
+        # exec the factory into the REAL module globals so the produced
+        # code object resolves globals live; the only lasting addition
+        # is the _PT shim binding (collision-safe name)
+        namespace = fn.__globals__
         namespace[_PT] = convert_ops
         exec(code, namespace)
-        new_fn = namespace["_pt_factory"](*cells)
+        try:
+            proto = namespace["_pt_factory"](
+                *([None] * len(freevars)))  # cell VALUES are discarded —
+            # the real cells attach below
+        finally:
+            namespace.pop("_pt_factory", None)
+        # rebind the compiled code to fn's original closure cells,
+        # matched by name (the inner def may capture a subset)
+        own_cells = dict(zip(freevars, fn.__closure__ or ()))
+        proto_cells = dict(zip(proto.__code__.co_freevars,
+                               proto.__closure__ or ()))
+        closure = tuple(
+            own_cells.get(n, proto_cells.get(n))
+            for n in proto.__code__.co_freevars)
+        new_fn = types.FunctionType(proto.__code__, namespace,
+                                    fn.__name__, fn.__defaults__, closure)
+        new_fn.__kwdefaults__ = fn.__kwdefaults__
+        new_fn.__qualname__ = fn.__qualname__
         # weakref, not the fn: a strong back-reference would keep every
         # convert_call WeakKeyDictionary entry alive forever
-        import weakref
-
         new_fn.__wrapped_original__ = weakref.ref(fn)
         return new_fn
     except (OSError, TypeError, SyntaxError, IndentationError):

@@ -48,10 +48,16 @@ class StaticFunction:
         fn, layer = self._fn, self._layer
 
         def pure_fn(key, *arrays):
+            from ..nn.layer import forward_converter_scope
+            from .dy2static.convert_ops import convert_call
+
             param_vals = arrays[:n_params]
             input_vals = arrays[n_params:]
             inputs = [_wrap_data(v) for v in input_vals]
-            with autograd.no_grad(), _random.rng_guard(key):
+            # sublayer forwards convert during the trace: `self.sub(x)`
+            # with python control flow in sub.forward compiles too
+            with autograd.no_grad(), _random.rng_guard(key), \
+                    forward_converter_scope(convert_call):
                 if layer is not None:
                     # substitute param values, call the ORIGINAL forward
                     # (layer.forward now points at this StaticFunction)

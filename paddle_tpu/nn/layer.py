@@ -8,12 +8,30 @@ params->outputs view of the layer so whole steps can be jit/pjit-compiled —
 this is the compile-friendly spine that replaces per-op dispatch (SURVEY §7.3).
 """
 import collections
+import contextlib
+import contextvars
 
 import numpy as np
 
 from ..core.tensor import Tensor, to_tensor
 from ..core.dtype import convert_dtype
 from ..core import autograd
+
+# dy2static hook: while a to_static trace is active, sublayer forwards
+# route through the callee converter so python control flow inside ANY
+# layer's forward compiles (reference: convert_call converts layers too).
+# None outside traces — eager dispatch is completely untouched.
+_FORWARD_CONVERTER = contextvars.ContextVar("d2s_forward_converter",
+                                            default=None)
+
+
+@contextlib.contextmanager
+def forward_converter_scope(converter):
+    token = _FORWARD_CONVERTER.set(converter)
+    try:
+        yield
+    finally:
+        _FORWARD_CONVERTER.reset(token)
 
 
 class ParamAttr:
@@ -231,7 +249,9 @@ class Layer:
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        conv = _FORWARD_CONVERTER.get()
+        fwd = self.forward if conv is None else conv(self.forward)
+        outputs = fwd(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             out = hook(self, inputs, outputs)
             if out is not None:

@@ -912,3 +912,127 @@ def test_helper_chain_and_builtins_untouched():
 
     np.testing.assert_allclose(f(_t([1.0])).numpy(), [11.0])
     np.testing.assert_allclose(f(_t([-1.0])).numpy(), [-11.0])
+
+
+def test_sublayer_forward_control_flow_converts_via_call():
+    """`self.sub(x)` where the SUBLAYER's forward holds tensor-condition
+    control flow: Layer.__call__ consults the trace-scoped forward
+    converter, so the sublayer compiles without calling .forward
+    directly (reference: convert_call converts layers too)."""
+
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if paddle.mean(h) > 0:  # tensor cond inside the SUBLAYER
+                return h * 2.0
+            return h * -1.0
+
+    class Top(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.gate = Gate()
+
+        def forward(self, x):
+            return self.gate(x) + 1.0  # Layer __call__, not .forward
+
+    paddle.seed(0)
+    net = Top()
+    xs = [_t(np.full((2, 4), v, np.float32)) for v in (1.0, -1.0)]
+    with paddle.no_grad():
+        wants = [net(x).numpy() for x in xs]
+    paddle.jit.to_static(net)
+    for x, want in zip(xs, wants):
+        np.testing.assert_allclose(net(x).numpy(), want, rtol=1e-5)
+
+
+def test_forward_hooks_still_fire_with_converter():
+    """The converter path must not bypass pre/post forward hooks."""
+    calls = []
+
+    class Sub(nn.Layer):
+        def forward(self, x):
+            if paddle.mean(x) > -1e9:
+                x = x + 1.0
+            return x
+
+    class Top(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.sub = Sub()
+
+        def forward(self, x):
+            return self.sub(x)
+
+    net = Top()
+    net.sub.register_forward_pre_hook(
+        lambda layer, inp: calls.append("pre"))
+    net.sub.register_forward_post_hook(
+        lambda layer, inp, out: calls.append("post"))
+    paddle.jit.to_static(net)
+    out = net(_t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    assert "pre" in calls and "post" in calls
+
+
+# ---- live-semantics regressions (review r4): transformed functions must
+# see the REAL globals and SHARE closure cells, not snapshots ----
+
+_SCALE = 2.0
+_COUNT = [0]
+_GCOUNT = 0
+
+
+def _scaled(y):
+    if paddle.mean(y) > -1e9:
+        y = y * _SCALE  # module global read at CALL time, not transform time
+    return y
+
+
+def test_transformed_helper_sees_live_globals():
+    global _SCALE
+
+    @paddle.jit.to_static
+    def f(x):
+        return _scaled(x + 0.0)
+
+    _SCALE = 2.0
+    np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+    _SCALE = 5.0
+    # new shape -> retrace; the rebound global must be visible
+    np.testing.assert_allclose(f(_t([1.0, 1.0])).numpy(), [5.0, 5.0])
+
+
+def test_transformed_helper_global_write_lands():
+    global _GCOUNT
+    _GCOUNT = 0
+
+    def bump(y):
+        global _GCOUNT
+        if paddle.mean(y) > -1e9:
+            _GCOUNT += 1
+        return y
+
+    g = transform_function(bump)
+    g(_t([1.0]))
+    assert _GCOUNT == 1  # write hit the real module, not a discarded copy
+
+
+def test_transformed_closure_shares_cells():
+    state = {"calls": 0}
+    k = 1.0
+
+    def helper(y):
+        if paddle.mean(y) > -1e9:
+            y = y * k
+        state["calls"] += 1
+        return y
+
+    g = transform_function(helper)
+    np.testing.assert_allclose(g(_t([3.0])).numpy(), [3.0])
+    k = 4.0  # rebinding the cell must be visible to the transformed fn
+    np.testing.assert_allclose(g(_t([3.0])).numpy(), [12.0])
+    assert state["calls"] == 2
