@@ -22,9 +22,35 @@ from ..core import autograd, random as _random
 from ..nn.layer import Layer
 
 
+def _source_uses_grad(fn):
+    """Whether the function CALLS `grad(...)` / `*.grad(...)` — the cue
+    to trace with the tape ENABLED so paddle.grad works inside converted
+    code (grad_transformer.py role).  Tape-on tracing runs a vjp per op,
+    so it is opt-in by detection rather than always-on; detection is on
+    the AST (a docstring mentioning grad() must not trigger it), and a
+    callee hiding the grad call is not detected (documented)."""
+    import ast
+    import inspect
+    import textwrap
+
+    try:
+        target = getattr(fn, "__func__", fn)
+        tree = ast.parse(textwrap.dedent(inspect.getsource(target)))
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id == "grad") or \
+                    (isinstance(f, ast.Attribute) and f.attr == "grad"):
+                return True
+    return False
+
+
 class StaticFunction:
     def __init__(self, fn, layer=None, input_spec=None):
         self._original_fn = fn
+        self._inner_grad = _source_uses_grad(fn)
         if not getattr(fn, "_not_to_static", False):
             # dy2static AST pass: rewrite data-dependent Python control flow
             # into lax.cond/while via convert shims (falls back to the
@@ -44,8 +70,14 @@ class StaticFunction:
         self._cache = {}
         self._counter = 0
 
-    def _pure(self, n_params, n_inputs, treedef_holder):
+    def _pure(self, n_params, n_inputs, treedef_holder, input_sg=None):
         fn, layer = self._fn, self._layer
+        # paddle.grad inside the function: trace with the tape ON (vjp
+        # closures differentiate tracers fine) and keep the caller's
+        # stop_gradient flags on the wrapped inputs so the partial
+        # reverse pass can reach them
+        inner_grad = self._inner_grad
+        sg = list(input_sg) if input_sg is not None else [True] * n_inputs
 
         def pure_fn(key, *arrays):
             from ..nn.layer import forward_converter_scope
@@ -53,10 +85,16 @@ class StaticFunction:
 
             param_vals = arrays[:n_params]
             input_vals = arrays[n_params:]
-            inputs = [_wrap_data(v) for v in input_vals]
+            inputs = [_wrap_data(v, stop_gradient=s)
+                      for v, s in zip(input_vals, sg)]
+            # enable_grad, not nullcontext: the trace must not inherit an
+            # ambient paddle.no_grad() (eval-before-train would record no
+            # tape and the inner grad would see unused inputs)
+            grad_ctx = (autograd.enable_grad() if inner_grad
+                        else autograd.no_grad())
             # sublayer forwards convert during the trace: `self.sub(x)`
             # with python control flow in sub.forward compiles too
-            with autograd.no_grad(), _random.rng_guard(key), \
+            with grad_ctx, _random.rng_guard(key), \
                     forward_converter_scope(convert_call):
                 if layer is not None:
                     # substitute param values, call the ORIGINAL forward
@@ -99,11 +137,20 @@ class StaticFunction:
             [p for _, p in self._layer.named_parameters()]
             if self._layer is not None else []
         )
-        sig = tuple((tuple(t.shape), str(t._data.dtype)) for t in tensors)
+        # stop_gradient only shapes the trace when the fn uses an inner
+        # grad; keying on it otherwise would recompile identical graphs
+        # across train(sg=False)/eval(sg=True) flips
+        sig = tuple((tuple(t.shape), str(t._data.dtype))
+                    + ((bool(t.stop_gradient),) if self._inner_grad
+                       else ())
+                    for t in tensors)
         entry = self._cache.get(sig)
         if entry is None:
             holder = []
-            pure = self._pure(len(params), len(tensors), holder)
+            pure = self._pure(
+                len(params), len(tensors), holder,
+                input_sg=[bool(t.stop_gradient) for t in tensors]
+                if self._inner_grad else None)
             jitted = jax.jit(pure)
             entry = {"fn": jitted, "holder": holder}
             self._cache[sig] = entry

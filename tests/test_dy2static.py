@@ -1036,3 +1036,66 @@ def test_transformed_closure_shares_cells():
     k = 4.0  # rebinding the cell must be visible to the transformed fn
     np.testing.assert_allclose(g(_t([3.0])).numpy(), [12.0])
     assert state["calls"] == 2
+
+
+# ---- paddle.grad inside converted code (grad_transformer.py role) ----
+
+def test_grad_inside_to_static():
+    """A function whose source calls grad( traces with the tape enabled,
+    so the inner partial reverse pass compiles into the jitted step."""
+
+    def f(x):
+        y = x * x * 3.0
+        (g,) = paddle.grad([paddle.sum(y)], [x])
+        return g + x
+
+    x = _t([2.0, 3.0])
+    x.stop_gradient = False
+    want = f(x).numpy()  # eager tape: 6x + x
+    np.testing.assert_allclose(want, [14.0, 21.0])
+    jf = paddle.jit.to_static(f)
+    np.testing.assert_allclose(jf(x).numpy(), want, rtol=1e-6)
+
+
+def test_gradient_penalty_trains_under_to_static():
+    """Gradient-penalty-style objective: inner grad (create_graph=True)
+    composes with the OUTER backward of the compiled step."""
+
+    class Critic(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 1)
+
+        def forward(self, x):
+            score = self.fc2(paddle.tanh(self.fc1(x)))
+            (gx,) = paddle.grad([paddle.sum(score)], [x],
+                                create_graph=True)
+            penalty = paddle.mean(gx * gx)
+            return paddle.mean(score) + 10.0 * penalty
+
+    paddle.seed(0)
+    net = Critic()
+    rng = np.random.RandomState(0)
+    x = _t(rng.randn(6, 4).astype(np.float32))
+    x.stop_gradient = False
+    eager_loss = float(np.asarray(net(x).numpy()))
+    paddle.jit.to_static(net)
+    # FIRST compiled call under ambient no_grad (eval-before-train): the
+    # trace must still enable the tape for the inner grad
+    with paddle.no_grad():
+        ng_loss = float(np.asarray(net(x).numpy()))
+    np.testing.assert_allclose(ng_loss, eager_loss, rtol=1e-5)
+    jit_loss = float(np.asarray(net(x).numpy()))
+    np.testing.assert_allclose(jit_loss, eager_loss, rtol=1e-5)
+    # trains: outer backward differentiates through the inner grad
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=net.parameters())
+    losses = []
+    for _ in range(10):
+        loss = net(x)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.numpy())))
+    assert losses[-1] < losses[0], losses
