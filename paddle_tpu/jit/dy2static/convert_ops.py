@@ -148,14 +148,18 @@ def convert_list_pop(lst, index=None):
 
 
 def _raw_deep(x):
-    """_raw through list/tuple containers (lists ride XLA carries and
-    branch outputs as pytrees of raw arrays)."""
+    """_raw through list/tuple/dict containers (they ride XLA carries
+    and branch outputs as pytrees of raw arrays; dicts need fixed key
+    sets — a growing key set changes the pytree structure and fails
+    with jax's structure error)."""
     if isinstance(x, _StackedBuffer):
         return x
     if isinstance(x, list):
         return ListProxy(_raw_deep(e) for e in x)
     if isinstance(x, tuple):
         return tuple(_raw_deep(e) for e in x)
+    if isinstance(x, dict):
+        return {k: _raw_deep(v) for k, v in x.items()}
     return _raw(x)
 
 
@@ -166,6 +170,9 @@ def _wrap_deep(template, val):
             val, (list, tuple)) and len(template) == len(val):
         out = [_wrap_deep(t, v) for t, v in zip(template, val)]
         return ListProxy(out) if isinstance(template, list) else tuple(out)
+    if isinstance(template, dict) and isinstance(val, dict) \
+            and template.keys() == val.keys():
+        return {k: _wrap_deep(template[k], val[k]) for k in val}
     if isinstance(template, Tensor):
         return _wrap_like(template, val)
     return val

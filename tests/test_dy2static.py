@@ -1099,3 +1099,27 @@ def test_gradient_penalty_trains_under_to_static():
         opt.clear_grad()
         losses.append(float(np.asarray(loss.numpy())))
     assert losses[-1] < losses[0], losses
+
+
+def test_dict_state_carried_through_loops_and_branches():
+    """Dicts with fixed key sets ride loop carries and tensor-cond
+    branches as pytrees (the reference's dict handling in
+    list_transformer; growing key sets stay unsupported — XLA needs a
+    fixed structure)."""
+
+    @paddle.jit.to_static
+    def f(x):
+        state = {"sum": paddle.zeros([2]), "sq": paddle.zeros([2])}
+        for t in x:  # scan with a dict in the carry
+            state = {"sum": state["sum"] + t, "sq": state["sq"] + t * t}
+        if paddle.mean(state["sum"]) > 0:  # dict through lax.cond
+            state = {"sum": state["sum"] * 2.0, "sq": state["sq"]}
+        return state["sum"] + state["sq"]
+
+    x = np.array([[1.0, 2.0], [3.0, -1.0]], np.float32)
+    s, sq = x.sum(0), (x * x).sum(0)
+    want = s * 2.0 + sq  # mean(sum)>0 branch
+    np.testing.assert_allclose(f(_t(x)).numpy(), want, rtol=1e-6)
+    xn = -x
+    want_n = xn.sum(0) + (xn * xn).sum(0)
+    np.testing.assert_allclose(f(_t(xn)).numpy(), want_n, rtol=1e-6)
