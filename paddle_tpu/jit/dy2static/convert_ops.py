@@ -307,37 +307,46 @@ def _unroll_buffers(names, get_args, set_args, converted):
     set_args(tuple(vals))
 
 
-def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names,
+                   live_mask=None):
     """Transformed `if` dispatch (convert_operators.py convert_ifelse).
 
     true_fn/false_fn mutate the enclosing frame via nonlocal; get_args/
-    set_args snapshot and restore the branch-written names.
-    """
+    set_args snapshot and restore the branch-written names.  `live_mask`
+    marks names something reads AFTER the if: only those ride the cond
+    carry and must be defined in both branches — dead names (loop
+    locals, lowered flags) are isolated between branch traces by the
+    snapshot/restore and then revert to their pre-if binding, which is
+    unobservable by construction."""
     if not _is_traced(pred):
         # bool() raises on multi-element tensors exactly like untransformed
         # eager code — the transform must not change truthiness semantics
         (true_fn if bool(_raw(pred)) else false_fn)()
         return
 
+    live = list(live_mask) if live_mask is not None else [True] * len(names)
     init = get_args()
+    carried = [i for i, lv in enumerate(live) if lv]
+    c_names = [names[i] for i in carried]
 
     def run(branch_fn, binit):
         def f(_):
             set_args(binit)
             branch_fn()
             outs = get_args()
-            for n, v in zip(names, outs):
-                if isinstance(v, _Undefined):
+            for i in carried:
+                if isinstance(outs[i], _Undefined):
                     raise ValueError(
-                        f"variable {n!r} must be assigned in both branches "
-                        f"of a tensor-condition `if` (it is undefined in "
-                        f"one branch)")
-            return tuple(_raw_deep(v) for v in outs)
+                        f"variable {names[i]!r} must be assigned in both "
+                        f"branches of a tensor-condition `if` (it is "
+                        f"undefined in one branch)")
+            return tuple(_raw_deep(outs[i]) for i in carried)
 
         return f
 
-    rv_idx = _return_value_indices(names)
-    li_idx = _list_indices(init)
+    rv_idx = _return_value_indices(c_names)
+    c_init = [init[i] for i in carried]
+    li_idx = _list_indices(c_init)
     if rv_idx or li_idx:
         try:
             t_s = jax.eval_shape(run(true_fn, init), 0)
@@ -347,29 +356,31 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
         if t_s is not None:
             new = list(init)
             changed = False
-            for i in rv_idx:
+            for k in rv_idx:
+                i = carried[k]
                 cur = _tree_sig(_raw_deep(init[i]))
-                ts, fs = _tree_sig(t_s[i]), _tree_sig(f_s[i])
+                ts, fs = _tree_sig(t_s[k]), _tree_sig(f_s[k])
                 if ts == fs:
                     if cur != ts:
-                        new[i] = _zeros_of(t_s[i])
+                        new[i] = _zeros_of(t_s[k])
                         changed = True
                 elif fs == cur:
-                    new[i] = _zeros_of(t_s[i])
+                    new[i] = _zeros_of(t_s[k])
                     changed = True
                 elif ts == cur:
-                    new[i] = _zeros_of(f_s[i])
+                    new[i] = _zeros_of(f_s[k])
                     changed = True
                 else:
                     raise ValueError(
                         "early returns under a tensor condition must "
                         f"return matching shapes/dtypes; got {ts[1]} vs "
                         f"{fs[1]}")
-            for i in li_idx:
+            for k in li_idx:
+                i = carried[k]
                 n0 = len(init[i])
-                lt = len(t_s[i]) if isinstance(t_s[i], (list, tuple)) \
+                lt = len(t_s[k]) if isinstance(t_s[k], (list, tuple)) \
                     else n0
-                lf = len(f_s[i]) if isinstance(f_s[i], (list, tuple)) \
+                lf = len(f_s[k]) if isinstance(f_s[k], (list, tuple)) \
                     else n0
                 if lt != n0 or lf != n0:
                     raise ValueError(
@@ -386,20 +397,20 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names):
     out = jax.lax.cond(_to_bool_scalar(pred), run(true_fn, init),
                        run(false_fn, init), 0)
     # re-wrap: keep Tensor-ness of the pre-branch value when known,
-    # else wrap arrays as Tensors (branch-created values)
-    final = []
-    for i, o in zip(init, out):
+    # else wrap arrays as Tensors (branch-created values); dead names
+    # revert to their pre-if binding
+    final = list(init)
+    for k, o in zip(carried, out):
+        i = init[k]
         if isinstance(i, Tensor):
-            final.append(_wrap_like(i, o))
+            final[k] = _wrap_like(i, o)
         elif isinstance(i, _Undefined):
-            # branch-created values: containers stay containers of raw
-            # arrays; bare arrays wrap as Tensors
             if isinstance(o, (list, tuple, _StackedBuffer)):
-                final.append(o)
+                final[k] = o
             else:
-                final.append(Tensor(o, stop_gradient=True))
+                final[k] = Tensor(o, stop_gradient=True)
         else:
-            final.append(_wrap_deep(i, o))
+            final[k] = _wrap_deep(i, o)
     set_args(tuple(final))
 
 

@@ -245,6 +245,166 @@ class _ListRewriter(ast.NodeTransformer):
         return node
 
 
+def _expr_loads(node):
+    return {sub.id for sub in ast.walk(node)
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)}
+
+
+def _add_definite_stores(st, assigned):
+    """Names DEFINITELY bound after `st` runs (loops may run 0 times and
+    contribute nothing; an if contributes the intersection of its
+    branches)."""
+    if isinstance(st, ast.Assign):
+        for t in st.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    assigned.add(n.id)
+    elif isinstance(st, ast.AugAssign) and isinstance(st.target, ast.Name):
+        assigned.add(st.target.id)
+    elif isinstance(st, ast.AnnAssign) and st.value is not None \
+            and isinstance(st.target, ast.Name):
+        assigned.add(st.target.id)
+    elif isinstance(st, ast.If):
+        both = None
+        for blk in (st.body, st.orelse):
+            s = set()
+            for b in blk:
+                _add_definite_stores(b, s)
+            both = s if both is None else (both & s)
+        assigned |= both or set()
+    elif isinstance(st, (ast.With, ast.AsyncWith)):
+        for b in st.body:  # with-bodies always run
+            _add_definite_stores(b, assigned)
+    elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        assigned.add(st.name)
+
+
+def _exposed_loads(node, assigned):
+    """Upward-exposed reads: names `node` may read from bindings that
+    existed BEFORE it ran — a read preceded by a definite store on its
+    path does not count (so a sibling loop's reads of its OWN target are
+    not reads of a conditionally-created name upstream).  The compact
+    static_analysis.py slice the liveness filter needs."""
+    if isinstance(node, list):
+        exposed = set()
+        assigned = set(assigned)
+        for st in node:
+            exposed |= _exposed_loads(st, assigned)
+            _add_definite_stores(st, assigned)
+        return exposed
+    if isinstance(node, ast.Assign):
+        ex = _expr_loads(node.value)
+        # subscript/attribute targets READ their base and indices
+        # (`tgt[i] = v` loads tgt and i — only bare Name targets are
+        # pure stores)
+        for t in node.targets:
+            ex |= _expr_loads(t)
+        return ex - assigned
+    if isinstance(node, ast.AugAssign):
+        ex = _expr_loads(node.value) | _expr_loads(node.target)
+        if isinstance(node.target, ast.Name):
+            ex = ex | {node.target.id}
+        return ex - assigned
+    if isinstance(node, ast.If):
+        ex = _expr_loads(node.test) - assigned
+        ex |= _exposed_loads(node.body, assigned)
+        ex |= _exposed_loads(node.orelse, assigned)
+        return ex
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        ex = _expr_loads(node.iter) - assigned
+        a2 = set(assigned) | {n.id for n in ast.walk(node.target)
+                              if isinstance(n, ast.Name)}
+        ex |= _exposed_loads(node.body, a2)
+        ex |= _exposed_loads(node.orelse, assigned)
+        return ex
+    if isinstance(node, ast.While):
+        ex = _expr_loads(node.test) - assigned
+        ex |= _exposed_loads(node.body, assigned)
+        ex |= _exposed_loads(node.orelse, assigned)
+        return ex
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        ex = set()
+        for item in node.items:
+            ex |= _expr_loads(item.context_expr) - assigned
+        ex |= _exposed_loads(node.body, assigned)
+        return ex
+    if isinstance(node, ast.Try):
+        ex = _exposed_loads(node.body, assigned)
+        for h in node.handlers:
+            ex |= _exposed_loads(h.body, assigned)
+        ex |= _exposed_loads(node.orelse, assigned)
+        ex |= _exposed_loads(node.finalbody, assigned)
+        return ex
+    # default (expressions, returns, nested defs whose closure reads
+    # happen later): every load in the subtree
+    return _expr_loads(node) - assigned
+
+
+def _walk_liveness(stmts, outer_after, loop_extra):
+    """Annotate every If (and loop) in `stmts` with `_live_after`: the
+    names possibly read from ITS bindings after it — upward-exposed uses
+    of the following statements, plus everything an enclosing loop may
+    read on a later iteration."""
+    compound = (ast.If, ast.While, ast.For, ast.With, ast.AsyncWith,
+                ast.Try)
+    for idx, st in enumerate(stmts):
+        if not isinstance(st, compound):
+            continue  # my_after is only consumed by compound statements
+        rest = stmts[idx + 1:]
+        my_after = (_exposed_loads(rest, set()) | outer_after
+                    | loop_extra)
+        if isinstance(st, ast.If):
+            st._live_after = my_after
+            _walk_liveness(st.body, my_after, loop_extra)
+            _walk_liveness(st.orelse, my_after, loop_extra)
+        elif isinstance(st, (ast.While, ast.For)):
+            st._live_after = my_after  # consumed by re-annotation after
+            # break-lowering introduces flag reads into the loop
+            extra = loop_extra | _expr_loads(st)  # wrap-around reads
+            _walk_liveness(st.body, my_after, extra)
+            _walk_liveness(st.orelse, my_after, loop_extra)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            _walk_liveness(st.body, my_after, loop_extra)
+        elif isinstance(st, ast.Try):
+            # a name bound in try.body may be read by handlers/orelse/
+            # finalbody; handlers and orelse flow into finalbody
+            fin_ex = _exposed_loads(st.finalbody, set())
+            handler_ex = set()
+            for h in st.handlers:
+                handler_ex |= _exposed_loads(h.body, set())
+            orelse_ex = _exposed_loads(st.orelse, set())
+            _walk_liveness(st.body,
+                           my_after | handler_ex | orelse_ex | fin_ex,
+                           loop_extra)
+            for h in st.handlers:
+                _walk_liveness(h.body, my_after | fin_ex, loop_extra)
+            _walk_liveness(st.orelse, my_after | fin_ex, loop_extra)
+            _walk_liveness(st.finalbody, my_after, loop_extra)
+
+
+def _reannotate_lowered_loop(loop_node):
+    """Break/continue lowering rewrote this loop's body (flag stores,
+    guard ifs, flag reads in the test): the liveness annotations inside
+    must be recomputed so the new flags count as live exactly where the
+    machinery reads them — inside their loop — and nowhere else."""
+    after = getattr(loop_node, "_live_after", None)
+    if after is None:
+        return  # no annotation context (loop created mid-transform)
+    _walk_liveness(loop_node.body, after,
+                   _expr_loads(loop_node))
+
+
+def _annotate_if_liveness(fn_def):
+    """Liveness for If nodes (reference: ifelse_transformer +
+    static_analysis modified-name liveness).  visit_If drops stored
+    names that are NOT live from the branch carry, so conditionally-
+    created locals (loop targets, accumulators, lowered break flags that
+    never escape their loop) don't force a defined-in-both-branches
+    error."""
+    _walk_liveness(fn_def.body, set(), set())
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
@@ -294,6 +454,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         names = sorted(set(_store_names(node.body))
                        | set(_store_names(node.orelse)))
         names = [n for n in names if not n.startswith("_pt_")]
+        # ALL stored names stay in the nonlocal scaffolding (an in-branch
+        # assignment without nonlocal would become an uninitialized
+        # local), but only names something reads AFTER the if ride the
+        # cond carry — conditionally-created locals (loop targets,
+        # accumulators, lowered flags) must not force both-branch
+        # definition
+        live = getattr(node, "_live_after", None)
+        live_mask = [True] * len(names) if live is None \
+            else [n in live for n in names]
         get_src, set_src = self._scaffold(names, uid)
         nl = f"    nonlocal {', '.join(names)}\n" if names else ""
         true_def = ast.parse(f"def _pt_true_{uid}():\n{nl}    pass").body[0]
@@ -305,7 +474,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = ast.parse(
             f"{_PT}.convert_ifelse(_pt_cond_{uid}, _pt_true_{uid}, "
             f"_pt_false_{uid}, _pt_get_{uid}, _pt_set_{uid}, "
-            f"{names!r})").body[0]
+            f"{names!r}, live_mask={live_mask!r})").body[0]
         cond_assign = ast.parse(f"_pt_cond_{uid} = 0").body[0]
         cond_assign.value = node.test
         out = self._init_undefined(names)
@@ -429,6 +598,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                     operand=ast.Name(id=bflag,
                                                      ctx=ast.Load()))])
             ast.fix_missing_locations(node)
+            _reannotate_lowered_loop(node)
         self.generic_visit(node)
         if not eligible:
             return node
@@ -493,6 +663,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             node.body, (bflag, cflag) = self._lower_break_continue(
                 node.body, uid_bc)
             ast.fix_missing_locations(node)
+            _reannotate_lowered_loop(node)
         self.generic_visit(node)
         if not eligible:
             return node
@@ -703,6 +874,7 @@ def _transform_source(source, filename, freevars):
     _ListRewriter().visit(tree)
     _BuiltinShimRewriter().visit(tree)
     _CallRewriter().visit(tree)
+    _annotate_if_liveness(fn_def)
     t = _ControlFlowTransformer()
     new_tree = t.visit(tree)
     ast.fix_missing_locations(new_tree)
