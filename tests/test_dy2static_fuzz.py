@@ -32,7 +32,7 @@ def _compile_fn(src):
 
 def _gen_block(rng, depth, lines, indent):
     pad = "    " * indent
-    kind = rng.randint(0, 7)
+    kind = rng.randint(0, 9)
     a = round(float(rng.uniform(0.5, 1.5)), 3)
     b = round(float(rng.uniform(-1.0, 1.0)), 3)
     t = round(float(rng.uniform(-0.5, 0.5)), 3)
@@ -70,6 +70,18 @@ def _gen_block(rng, depth, lines, indent):
     elif kind == 5:  # early return under tensor cond
         lines.append(f"{pad}if paddle.mean(acc) > {t + 2.0}:")
         lines.append(f"{pad}    return acc * {a}")
+    elif kind == 7:  # tensor-bounded while (forward-only dynamic trip)
+        k = int(rng.randint(1, 4))
+        lines.append(f"{pad}cnt = paddle.mean(x) * 0.0")
+        lines.append(f"{pad}while cnt < {k}.0:")
+        lines.append(f"{pad}    acc = acc * {a} + {b}")
+        lines.append(f"{pad}    cnt = cnt + 1.0")
+    elif kind == 8:  # early return from INSIDE a loop
+        k = int(rng.randint(2, 4))
+        lines.append(f"{pad}for i in range({k}):")
+        lines.append(f"{pad}    acc = acc + {b}")
+        lines.append(f"{pad}    if paddle.mean(acc) > {t + 2.5}:")
+        lines.append(f"{pad}        return acc * {a}")
     else:  # nested tensor-cond if
         if depth < 2:
             lines.append(f"{pad}if paddle.mean(acc) < {t}:")
