@@ -32,7 +32,7 @@ def _compile_fn(src):
 
 def _gen_block(rng, depth, lines, indent):
     pad = "    " * indent
-    kind = rng.randint(0, 9)
+    kind = rng.randint(0, 11)
     a = round(float(rng.uniform(0.5, 1.5)), 3)
     b = round(float(rng.uniform(-1.0, 1.0)), 3)
     t = round(float(rng.uniform(-0.5, 0.5)), 3)
@@ -82,6 +82,18 @@ def _gen_block(rng, depth, lines, indent):
         lines.append(f"{pad}    acc = acc + {b}")
         lines.append(f"{pad}    if paddle.mean(acc) > {t + 2.5}:")
         lines.append(f"{pad}        return acc * {a}")
+    elif kind == 9:  # dict state through a scan + branch
+        lines.append(f"{pad}st = {{'s': acc * 0.0, 'q': acc * 0.0}}")
+        lines.append(f"{pad}for row in x:")
+        lines.append(f"{pad}    st = {{'s': st['s'] + paddle.mean(row),"
+                     f" 'q': st['q'] + {a}}}")
+        lines.append(f"{pad}if paddle.mean(st['s']) > {t}:")
+        lines.append(f"{pad}    acc = acc + st['q']")
+        lines.append(f"{pad}else:")
+        lines.append(f"{pad}    acc = acc + st['s']")
+    elif kind == 10:  # int()/float() casts + bool guard in the mix
+        lines.append(f"{pad}k2 = int(paddle.mean(acc) * 2.0)")
+        lines.append(f"{pad}acc = acc + float(k2) * {b}")
     else:  # nested tensor-cond if
         if depth < 2:
             lines.append(f"{pad}if paddle.mean(acc) < {t}:")
