@@ -353,7 +353,10 @@ class CompiledTrainStep:
         n_buf_dims = len(self._buf_axes)
         shard_len_s = self._shard_len
 
-        def spmd_step(params, opt_state, batch_vals, key, lr):
+        def spmd_step(params, opt_state, batch_vals, key, step, lr):
+            # the step folds INSIDE the compiled fn: an eager fold_in per
+            # step was most of the per-step host overhead
+            key = jax.random.fold_in(key, step)
             if dp_axis is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
             if seq_axis is not None:
@@ -447,6 +450,7 @@ class CompiledTrainStep:
             self._batch_pspecs(batch_avals),
             P(),
             P(),
+            P(),
         )
         out_specs = (P(), in_specs[0], in_specs[1])
         fn = _shard_map(spmd_step, mesh, in_specs, out_specs)
@@ -500,15 +504,18 @@ class CompiledTrainStep:
         if self._jit_step is None:
             self._jit_step = self._build(vals)
         self._step_count += 1
-        key = jax.random.fold_in(_random.get_rng_state(), self._step_count)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.get_rng_state()
+        # numpy scalars: jit converts at dispatch, skipping two eager
+        # device ops per step
+        step = np.uint32(self._step_count)
+        lr = np.float32(self.optimizer.get_lr())
         pspecs = self._batch_pspecs(vals)
         vals = tuple(
             jax.device_put(v, NamedSharding(self.mesh, s))
             for v, s in zip(vals, pspecs)
         )
         loss, self.params, self.flat_opt_state = self._jit_step(
-            self.params, self.flat_opt_state, vals, key, lr
+            self.params, self.flat_opt_state, vals, key, step, lr
         )
         from ..framework import _FLAGS
 
@@ -531,10 +538,10 @@ class CompiledTrainStep:
         )
         if self._jit_step is None:
             self._jit_step = self._build(vals)
-        key = jax.random.fold_in(_random.get_rng_state(), 0)
+        key = _random.get_rng_state()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         return self._jit_step.lower(
-            self.params, self.flat_opt_state, vals, key, lr)
+            self.params, self.flat_opt_state, vals, key, jnp.uint32(0), lr)
 
     def cost_analysis(self, *batch):
         """XLA cost analysis of the compiled step (the reference's
