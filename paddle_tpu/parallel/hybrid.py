@@ -455,7 +455,14 @@ class CompiledTrainStep:
         out_specs = (P(), in_specs[0], in_specs[1])
         fn = _shard_map(spmd_step, mesh, in_specs, out_specs)
         donate = (0, 1) if self.donate else ()
-        return jax.jit(fn, donate_argnums=donate)
+        # declare batch shardings on the jit itself: host arrays place
+        # directly at dispatch instead of an eager device_put per value
+        # per step (params/state already live committed-sharded)
+        batch_sh = tuple(NamedSharding(mesh, sp)
+                         for sp in self._batch_pspecs(batch_avals))
+        scalar_sh = NamedSharding(mesh, P())
+        in_sh = (None, None, batch_sh, scalar_sh, scalar_sh, scalar_sh)
+        return jax.jit(fn, donate_argnums=donate, in_shardings=in_sh)
 
     def _batch_pspecs(self, batch_avals):
         out = []
@@ -506,14 +513,10 @@ class CompiledTrainStep:
         self._step_count += 1
         key = _random.get_rng_state()
         # numpy scalars: jit converts at dispatch, skipping two eager
-        # device ops per step
+        # device ops per step; batch placement rides the jit's declared
+        # in_shardings instead of an eager per-value device_put
         step = np.uint32(self._step_count)
         lr = np.float32(self.optimizer.get_lr())
-        pspecs = self._batch_pspecs(vals)
-        vals = tuple(
-            jax.device_put(v, NamedSharding(self.mesh, s))
-            for v, s in zip(vals, pspecs)
-        )
         loss, self.params, self.flat_opt_state = self._jit_step(
             self.params, self.flat_opt_state, vals, key, step, lr
         )
