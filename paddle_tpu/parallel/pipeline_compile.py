@@ -351,7 +351,9 @@ class PipelinedTrainStep:
         buf_axes = dict(self._buf_axes)
 
         def spmd_step(other, blocks, st_other, st_block, ids, labels, key,
-                      lr):
+                      step, lr):
+            # step folds in-graph (same host-overhead fix as hybrid.py)
+            key = jax.random.fold_in(key, step)
             key = jax.random.fold_in(key, jax.lax.axis_index("pipe"))
             if dp_axis is not None:
                 key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
@@ -422,7 +424,7 @@ class PipelinedTrainStep:
             batch_axes = [dp_axis]
         bspec = P(*batch_axes)
         in_specs = (self.other_specs, self.block_specs, state_spec,
-                    bstate_spec, bspec, bspec, P(), P())
+                    bstate_spec, bspec, bspec, P(), P(), P())
         out_specs = (P(), self.other_specs, self.block_specs, state_spec,
                      bstate_spec)
         fn = _shard_map(spmd_step, mesh, in_specs, out_specs)
@@ -441,13 +443,14 @@ class PipelinedTrainStep:
         if self._jit_step is None:
             self._jit_step = self._build(iv, lv)
         self._step_count += 1
-        key = jax.random.fold_in(_random.get_rng_state(), self._step_count)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.get_rng_state()
+        step = np.uint32(self._step_count)
+        lr = np.float32(self.optimizer.get_lr())
         (loss, self.other_params, self.block_params,
          self._opt_state["other"], self._opt_state["block"]) = \
             self._jit_step(self.other_params, self.block_params,
                            self._opt_state["other"],
-                           self._opt_state["block"], iv, lv, key, lr)
+                           self._opt_state["block"], iv, lv, key, step, lr)
         from ..optimizer.lr import LRScheduler
 
         if isinstance(self.optimizer._lr, LRScheduler):
@@ -460,11 +463,11 @@ class PipelinedTrainStep:
             jnp.asarray(labels)
         if self._jit_step is None:
             self._jit_step = self._build(iv, lv)
-        key = jax.random.fold_in(_random.get_rng_state(), 0)
+        key = _random.get_rng_state()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         return self._jit_step.lower(
             self.other_params, self.block_params, self._opt_state["other"],
-            self._opt_state["block"], iv, lv, key, lr)
+            self._opt_state["block"], iv, lv, key, jnp.uint32(0), lr)
 
     def cost_analysis(self, ids, labels):
         """XLA cost stats of the compiled pipelined step, or None."""

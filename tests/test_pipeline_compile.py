@@ -130,7 +130,7 @@ def test_pp_embed_head_cond_gated():
         lambda *a: tr._jit_step(*a))(
         tr.other_params, tr.block_params, tr._opt_state["other"],
         tr._opt_state["block"], ids, lbl, _jax.random.PRNGKey(0),
-        np.float32(0.1))
+        np.uint32(0), np.float32(0.1))
     assert count_conds(traced.jaxpr) >= 2  # embed gate + head gate
 
 
