@@ -66,11 +66,12 @@ def write_exported(fn, avals, prefix):
 
 
 def save(layer, path, input_spec=None, weight_quant=None, **configs):
-    """`weight_quant` ({id(param): bits}, from quant.weight_quant_map):
-    those weights store as int8 + a dequant factor — in .pdiparams AND as
-    int8 constants inside the AOT export (weight-only int8 deployment,
-    the slim quantization_pass artifact role; ~4x smaller, dequantized
-    on load / inside the module)."""
+    """`weight_quant` ({id(param): bits | (bits, channel_axis)}, from
+    quant.weight_quant_map): those weights store as narrow integers +
+    dequant factor(s) — in .pdiparams AND as integer constants inside
+    the AOT export (weight-only quantized deployment, the slim
+    quantization_pass artifact role; ~4x smaller, dequantized on load /
+    inside the module; channel_axis selects per-channel factors)."""
     from ..quant.qat import quantize_weight, quant_meta_entry
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -84,11 +85,13 @@ def save(layer, path, input_spec=None, weight_quant=None, **configs):
     quant_meta = {}
     state = {}
     for k, v in layer.state_dict().items():
-        bits = quant_by_id.get(id(v))
-        if bits:
-            qcache[id(v)] = qf = quantize_weight(v._data, bits)
+        spec = quant_by_id.get(id(v))
+        if spec:
+            bits, axis = spec if isinstance(spec, tuple) else (spec, None)
+            qcache[id(v)] = qf = quantize_weight(v._data, bits, axis)
             state[k] = np.asarray(qf[0])
-            quant_meta[k] = quant_meta_entry(bits, qf[1], v._data.dtype)
+            quant_meta[k] = quant_meta_entry(bits, qf[1], v._data.dtype,
+                                             axis)
         else:
             state[k] = np.asarray(v.numpy())
     meta = {
@@ -140,15 +143,16 @@ def save(layer, path, input_spec=None, weight_quant=None, **configs):
             # dequant (weight-only quantization: the module stores the
             # narrow integers; XLA fuses the dequant into the consuming
             # matmul/conv)
-            from ..quant.qat import _QCONST_TAG, resolve_param_consts
+            from ..quant.qat import quant_const_tuple, resolve_param_consts
 
             params_live = {}
             for k, v in named.items():
-                bits = quant_by_id.get(id(v))
-                if bits:
+                spec = quant_by_id.get(id(v))
+                if spec:
+                    axis = spec[1] if isinstance(spec, tuple) else None
                     q, factor = qcache[id(v)]
-                    params_live[k] = (_QCONST_TAG, q, factor,
-                                      str(v._data.dtype))
+                    params_live[k] = quant_const_tuple(
+                        q, factor, v._data.dtype, axis)
                 else:
                     params_live[k] = v._data
 

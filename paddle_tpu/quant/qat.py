@@ -75,16 +75,30 @@ class FakeQuantMovingAverageAbsMax(Layer):
                           self.bits)
 
 
+def _weight_scale(w, channel_axis):
+    """stop_gradient abs-max scale: scalar, or per-channel broadcastable
+    (channel_wise_abs_max — the same grid quantize_weight exports)."""
+    if channel_axis is None:
+        return jax.lax.stop_gradient(jnp.max(jnp.abs(w)))
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    s = jnp.max(jnp.abs(w), axis=axes)
+    s = s.reshape(_bcast_shape(w.ndim, channel_axis, s.shape[0]))
+    return jax.lax.stop_gradient(s)
+
+
 class QuantedLinear(Layer):
     """Linear with fake-quant on weight + input activation."""
 
+    weight_channel_axis = 1  # [in, out]: one scale per output feature
+
     def __init__(self, layer, weight_bits=8, activation_bits=8,
-                 moving_rate=0.9):
+                 moving_rate=0.9, channel_wise=False):
         super().__init__()
         self._inner = layer
         self.weight = layer.weight
         self.bias = layer.bias
         self.weight_bits = weight_bits
+        self.channel_wise = channel_wise
         self._act_quant = FakeQuantMovingAverageAbsMax(
             activation_bits, moving_rate)
         self.add_sublayer("_act_quant", self._act_quant)
@@ -92,19 +106,24 @@ class QuantedLinear(Layer):
 
     def forward(self, x):
         x = self._act_quant(x)
-        w_scale = jax.lax.stop_gradient(jnp.max(jnp.abs(self.weight._data)))
+        w_scale = _weight_scale(
+            self.weight._data,
+            self.weight_channel_axis if self.channel_wise else None)
         w = _apply_qdq(self.weight, w_scale, self.weight_bits)
         return F.linear(x, w, self.bias)
 
 
 class QuantedConv2D(Layer):
+    weight_channel_axis = 0  # OIHW: one scale per output channel
+
     def __init__(self, layer, weight_bits=8, activation_bits=8,
-                 moving_rate=0.9):
+                 moving_rate=0.9, channel_wise=False):
         super().__init__()
         self._inner = layer
         self.weight = layer.weight
         self.bias = layer.bias
         self.weight_bits = weight_bits
+        self.channel_wise = channel_wise
         self._act_quant = FakeQuantMovingAverageAbsMax(
             activation_bits, moving_rate)
         self.add_sublayer("_act_quant", self._act_quant)
@@ -112,7 +131,9 @@ class QuantedConv2D(Layer):
 
     def forward(self, x):
         x = self._act_quant(x)
-        w_scale = jax.lax.stop_gradient(jnp.max(jnp.abs(self.weight._data)))
+        w_scale = _weight_scale(
+            self.weight._data,
+            self.weight_channel_axis if self.channel_wise else None)
         w = _apply_qdq(self.weight, w_scale, self.weight_bits)
         inner = self._inner
         return F.conv2d(x, w, self.bias, stride=inner._stride,
@@ -125,32 +146,48 @@ _QUANT_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
 
 
 def weight_quant_map(model):
-    """{id(param): weight_bits} for every quantized sublayer's weight —
-    the scale handoff from training-time fake-quant to deployment
-    (quantization_pass.py role: the reference rewrites the inference
-    program with the QAT scales; here the scales travel by identity so
-    jit.save can emit int8 weight constants)."""
+    """{id(param): (weight_bits, channel_axis)} for every quantized
+    sublayer's weight — the scale handoff from training-time fake-quant
+    to deployment (quantization_pass.py role: the reference rewrites the
+    inference program with the QAT scales; here the scales travel by
+    identity so jit.save can emit int8 weight constants)."""
     out = {}
     for sub in model.sublayers(include_self=True):
         if isinstance(sub, (QuantedLinear, QuantedConv2D)):
-            out[id(sub.weight)] = int(sub.weight_bits)
+            axis = sub.weight_channel_axis if sub.channel_wise else None
+            out[id(sub.weight)] = (int(sub.weight_bits), axis)
     return out
 
 
-def quantize_weight(w, bits=8):
+def _bcast_shape(ndim, axis, n):
+    return tuple(n if i == axis else 1 for i in range(ndim))
+
+
+def quantize_weight(w, bits=8, channel_axis=None):
     """(integer values, dequant factor): symmetric abs-max, the same
     grid quant_dequant trains against — dequantized inference therefore
     matches the QAT forward up to float association.  Storage dtype
     follows the bit width (int8 up to 8 bits, int16 up to 16 — the
-    reference supports both)."""
+    reference supports both).  `channel_axis` selects channel-wise
+    abs-max (the reference's channel_wise_abs_max: one scale per output
+    channel — conv OIHW axis 0, linear [in, out] axis 1); the dequant
+    factor is then a per-channel vector."""
     if not 2 <= bits <= 16:
         raise ValueError(f"weight_bits must be in [2, 16], got {bits}")
     store = jnp.int8 if bits <= 8 else jnp.int16
     qmax = float(2 ** (bits - 1) - 1)
-    scale = float(jnp.max(jnp.abs(w)))
-    scale = max(scale, 1e-9)
+    w = jnp.asarray(w)
+    if channel_axis is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-9)
+        factor = float(scale) / qmax
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=axes), 1e-9)
+        factor = np.asarray(scale, np.float64) / qmax
+        scale = scale.reshape(_bcast_shape(w.ndim, channel_axis,
+                                           scale.shape[0]))
     q = jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(store)
-    return q, scale / qmax
+    return q, factor
 
 
 # ---- shared quantized-artifact format helpers -------------------------
@@ -162,26 +199,49 @@ def quantize_weight(w, bits=8):
 _QCONST_TAG = "__intq__"
 
 
-def quant_param_const(w, bits):
+def quant_const_tuple(q, factor, dtype, channel_axis=None):
+    """THE tagged-tuple layout for a weight held as an integer AOT
+    constant — every producer must build it here so a format change
+    happens in one place."""
+    return (_QCONST_TAG, q, factor, str(dtype), channel_axis)
+
+
+def quant_param_const(w, bits, channel_axis=None):
     """Tagged tuple for a weight held as an integer AOT constant."""
-    q, factor = quantize_weight(w, bits)
-    return (_QCONST_TAG, q, factor, str(np.asarray(w).dtype))
+    q, factor = quantize_weight(w, bits, channel_axis)
+    return quant_const_tuple(q, factor, np.asarray(w).dtype, channel_axis)
 
 
-def quant_meta_entry(bits, factor, dtype):
-    return {"bits": int(bits), "dequant_factor": factor,
-            "dtype": str(dtype)}
+def quant_meta_entry(bits, factor, dtype, channel_axis=None):
+    entry = {"bits": int(bits),
+             "dequant_factor": (factor if np.isscalar(factor)
+                                else np.asarray(factor).tolist()),
+             "dtype": str(dtype)}
+    if channel_axis is not None:
+        entry["channel_axis"] = int(channel_axis)
+    return entry
+
+
+def _factor_bcast(factor, ndim, channel_axis):
+    f = np.asarray(factor)
+    if channel_axis is None or f.ndim == 0:
+        return f
+    return f.reshape(_bcast_shape(ndim, channel_axis, f.shape[0]))
 
 
 def resolve_param_consts(params):
     """Materialize tagged integer constants back to float arrays (the
     on-the-fly dequant inside a deploy closure — XLA fuses it into the
     consuming matmul/conv while the stored constant stays integer)."""
-    return {
-        k: v[1].astype(v[3]) * jnp.asarray(v[2], v[3])
-        if isinstance(v, tuple) and v and v[0] == _QCONST_TAG else v
-        for k, v in params.items()
-    }
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, tuple) and v and v[0] == _QCONST_TAG:
+            _, q, factor, dt, axis = v
+            f = jnp.asarray(_factor_bcast(factor, q.ndim, axis), dt)
+            out[k] = q.astype(dt) * f
+        else:
+            out[k] = v
+    return out
 
 
 def dequantize_state(state, quant_meta):
@@ -192,26 +252,41 @@ def dequantize_state(state, quant_meta):
     out = dict(state)
     for k, qm in quant_meta.items():
         if k in out:
-            out[k] = (np.asarray(out[k]).astype(qm.get("dtype", "float32"))
-                      * qm["dequant_factor"])
+            arr = np.asarray(out[k])
+            f = _factor_bcast(qm["dequant_factor"], arr.ndim,
+                              qm.get("channel_axis"))
+            out[k] = (arr.astype(qm.get("dtype", "float32"))
+                      * f.astype(qm.get("dtype", "float32")))
     return out
 
 
 class ImperativeQuantAware:
-    """qat.py ImperativeQuantAware parity: in-place sublayer swap."""
+    """qat.py ImperativeQuantAware parity: in-place sublayer swap.
+
+    `weight_quantize_type`: 'abs_max' (one scale per weight, default) or
+    'channel_wise_abs_max' (one scale per output channel — conv OIHW
+    axis 0, linear axis 1; tighter grids for skewed channel ranges)."""
 
     def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
-                 quantizable_layer_type=("Linear", "Conv2D")):
+                 quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_quantize_type="abs_max"):
+        if weight_quantize_type not in ("abs_max",
+                                        "channel_wise_abs_max"):
+            raise ValueError(
+                f"weight_quantize_type {weight_quantize_type!r} not "
+                "supported; use 'abs_max' or 'channel_wise_abs_max'")
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.moving_rate = moving_rate
         self.types = set(quantizable_layer_type)
+        self.channel_wise = weight_quantize_type == "channel_wise_abs_max"
 
     def _wrap(self, layer):
         for cls, qcls in _QUANT_MAP.items():
             if type(layer) is cls and cls.__name__ in self.types:
                 return qcls(layer, self.weight_bits, self.activation_bits,
-                            self.moving_rate)
+                            self.moving_rate,
+                            channel_wise=self.channel_wise)
         return None
 
     def quantize(self, model):

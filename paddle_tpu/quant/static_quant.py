@@ -20,14 +20,19 @@ import jax.numpy as jnp
 
 
 _QUANT_WEIGHT_OPS = {"fc", "matmul_v2", "conv2d", "mul"}
+# channel_wise_abs_max axes per op kind: conv OIHW output channels are
+# dim 0; matmul-class weights [in, out] scale per output column
+_CHANNEL_AXES = {"conv2d": 0, "fc": 1, "matmul_v2": 1, "mul": 1}
 
 
-def _weight_names_from_desc(desc):
-    """Param vars consumed as the weight operand of matmul-class ops."""
-    names = set()
+def _weight_names_from_desc(desc, channel_wise=False):
+    """{param: channel_axis|None} for vars consumed as the weight operand
+    of matmul-class ops."""
+    names = {}
     vars_d = desc.get("vars", {})
     for od in desc.get("ops", []):
-        if od.get("type") not in _QUANT_WEIGHT_OPS:
+        op_t = od.get("type")
+        if op_t not in _QUANT_WEIGHT_OPS:
             continue
         order = od.get("in_order", [])
         for n in order[1:]:  # operand 0 is the activation
@@ -35,17 +40,19 @@ def _weight_names_from_desc(desc):
             if (vd and vd.get("is_parameter")
                     and len(vd.get("shape", [])) >= 2
                     and "float" in str(vd.get("dtype", ""))):
-                names.add(n)
+                names[n] = _CHANNEL_AXES[op_t] if channel_wise else None
     return names
 
 
-def quantize_inference_weights(path_prefix, save_path=None, weight_bits=8):
+def quantize_inference_weights(path_prefix, save_path=None, weight_bits=8,
+                               weight_quantize_type="abs_max"):
     """Rewrite a `save_inference_model` artifact with weight-only int8:
     int8 .pdiparams + dequant factors in the meta + a re-exported AOT
     module whose weight constants are int8.  Returns (save_path,
     quantized weight names)."""
-    from .qat import (dequantize_state, quant_meta_entry, quantize_weight,
-                      _QCONST_TAG, resolve_param_consts)
+    from .qat import (dequantize_state, quant_const_tuple,
+                      quant_meta_entry, quantize_weight,
+                      resolve_param_consts)
     from ..static.desc import load_program
     from ..static.executor import CompiledBlock, Scope
     from ..jit.save_load import build_input_avals, write_exported
@@ -60,15 +67,17 @@ def quantize_inference_weights(path_prefix, save_path=None, weight_bits=8):
     with open(path_prefix + ".pdmodel.json") as f:
         desc = json.load(f)
 
-    weight_names = _weight_names_from_desc(desc)
+    weight_names = _weight_names_from_desc(
+        desc, channel_wise=weight_quantize_type == "channel_wise_abs_max")
     quant_meta = {}
     out_params = {}
     for k, v in params.items():
         if k in weight_names:
-            q, factor = quantize_weight(jnp.asarray(v), weight_bits)
+            axis = weight_names[k]
+            q, factor = quantize_weight(jnp.asarray(v), weight_bits, axis)
             out_params[k] = np.asarray(q)
             quant_meta[k] = quant_meta_entry(weight_bits, factor,
-                                             np.asarray(v).dtype)
+                                             np.asarray(v).dtype, axis)
         else:
             out_params[k] = v
     meta = dict(meta)
@@ -95,8 +104,9 @@ def quantize_inference_weights(path_prefix, save_path=None, weight_bits=8):
         for n in cb.param_names:
             if n in quant_meta:
                 qm = quant_meta[n]
-                params_live[n] = (_QCONST_TAG, jnp.asarray(out_params[n]),
-                                  qm["dequant_factor"], qm["dtype"])
+                params_live[n] = quant_const_tuple(
+                    jnp.asarray(out_params[n]), qm["dequant_factor"],
+                    qm["dtype"], qm.get("channel_axis"))
             else:
                 params_live[n] = jnp.asarray(scope.get(n))
 
@@ -142,7 +152,7 @@ class PostTrainingQuantization:
 
     def __init__(self, executor, model_dir, sample_generator=None,
                  batch_nums=8, weight_bits=8, activation_bits=8,
-                 algo="abs_max"):
+                 algo="abs_max", weight_quantize_type="abs_max"):
         if algo != "abs_max":
             raise NotImplementedError(
                 f"calibration algo {algo!r} not implemented; only "
@@ -153,6 +163,7 @@ class PostTrainingQuantization:
         self._samples = sample_generator
         self._batch_nums = batch_nums
         self._weight_bits = weight_bits
+        self._weight_quantize_type = weight_quantize_type
         self._activation_bits = activation_bits
         self._act_abs_max = {}
         self._program = None
@@ -201,7 +212,8 @@ class PostTrainingQuantization:
 
     def save_quantized_model(self, save_model_path, **kwargs):
         save_path, names = quantize_inference_weights(
-            self._prefix, save_model_path, self._weight_bits)
+            self._prefix, save_model_path, self._weight_bits,
+            self._weight_quantize_type)
         if self._act_abs_max:
             with open(save_path + ".pdmodel", "rb") as f:
                 meta = pickle.load(f)

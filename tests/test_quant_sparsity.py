@@ -308,3 +308,104 @@ def test_int16_weight_storage_and_predictor_fallback(tmp_path):
                                layer_cls=make_quantized_net)
     got = pred.run([xv])[0]
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_quantize_weight_torch_referee():
+    """Independent oracle: our symmetric abs-max grid must match
+    torch.quantize_per_tensor / per_channel with the same scale and
+    zero_point=0 (int8 values AND dequantized values)."""
+    import torch
+
+    rng = np.random.RandomState(3)
+    w = (rng.randn(16, 8) * np.array([0.01, 3.0] * 4)).astype(np.float32)
+    from paddle_tpu.quant import quantize_weight
+
+    # per-tensor
+    q, factor = quantize_weight(w, 8)
+    tq = torch.quantize_per_tensor(torch.from_numpy(w), scale=factor,
+                                   zero_point=0, dtype=torch.qint8)
+    np.testing.assert_array_equal(np.asarray(q),
+                                  tq.int_repr().numpy())
+    np.testing.assert_allclose(np.asarray(q).astype(np.float32) * factor,
+                               tq.dequantize().numpy(), rtol=1e-6)
+
+    # per-channel over the output axis (linear [in, out] -> axis 1)
+    qc, factors = quantize_weight(w, 8, channel_axis=1)
+    tqc = torch.quantize_per_channel(
+        torch.from_numpy(w), scales=torch.tensor(factors),
+        zero_points=torch.zeros(w.shape[1], dtype=torch.int64), axis=1,
+        dtype=torch.qint8)
+    np.testing.assert_array_equal(np.asarray(qc),
+                                  tqc.int_repr().numpy())
+    np.testing.assert_allclose(
+        np.asarray(qc).astype(np.float32) * np.asarray(factors)[None, :],
+        tqc.dequantize().numpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_channel_wise_beats_per_tensor_on_skewed_scales():
+    """The point of channel_wise_abs_max: with per-channel dynamic
+    ranges differing by 100x, per-channel grids reconstruct far more
+    accurately than one global grid."""
+    from paddle_tpu.quant import quantize_weight
+
+    rng = np.random.RandomState(0)
+    scales = np.logspace(-2, 1, 32)  # 0.01 .. 10 per output channel
+    w = (rng.randn(64, 32) * scales[None, :]).astype(np.float32)
+
+    q_t, f_t = quantize_weight(w, 8)
+    err_t = np.abs(np.asarray(q_t).astype(np.float64) * f_t - w).mean()
+    q_c, f_c = quantize_weight(w, 8, channel_axis=1)
+    deq_c = np.asarray(q_c).astype(np.float64) * np.asarray(f_c)[None, :]
+    err_c = np.abs(deq_c - w).mean()
+    assert err_c < err_t / 3, (err_c, err_t)  # 5.7x measured
+
+
+def test_channel_wise_qat_int8_deployment_roundtrip(tmp_path):
+    """End to end: channel-wise QAT -> int8 artifact (per-channel
+    factors in the meta) -> Predictor parity with the QAT forward, and
+    dequant-on-load via every consumer path."""
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.quant import ImperativeQuantAware
+
+    paddle.seed(2)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(1, 8, 3)
+            self.fc = nn.Linear(8 * 6 * 6, 4)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.conv(x))
+            return self.fc(paddle.reshape(h, [x.shape[0], -1]))
+
+    net = Net()
+    qat = ImperativeQuantAware(
+        weight_quantize_type="channel_wise_abs_max")
+    qat.quantize(net)
+    net.eval()
+    rng = np.random.RandomState(2)
+    xv = rng.rand(2, 1, 8, 8).astype("float32")
+    want = net(paddle.to_tensor(xv)).numpy()
+
+    prefix = str(tmp_path / "cw")
+    qat.save_quantized_model(
+        net, prefix, input_spec=[InputSpec([2, 1, 8, 8], "float32",
+                                           name="x")])
+    import pickle
+
+    with open(prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    axes = {qm.get("channel_axis") for qm in meta["weight_quant"].values()}
+    assert axes == {0, 1}  # conv axis 0, linear axis 1
+    assert any(isinstance(qm["dequant_factor"], list)
+               for qm in meta["weight_quant"].values())
+
+    pred = inference.Predictor(inference.Config(prefix))
+    got = pred.run([xv])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    loaded = paddle.jit.load(prefix)  # dequant-on-load path
+    assert all(np.asarray(v.numpy()).dtype == np.float32
+               for v in loaded.state_dict().values())
