@@ -26,6 +26,10 @@ from .static_quant import (  # noqa: F401
     PostTrainingQuantization,
     quantize_inference_weights,
 )
+from .static_qat import (  # noqa: F401
+    convert,
+    quant_aware,
+)
 
 
 class QuantStub:

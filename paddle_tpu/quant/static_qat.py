@@ -1,0 +1,233 @@
+"""Static-graph quantization-aware training.
+
+Reference parity: slim/quantization/quantization_pass.py —
+QuantizationTransformPass (insert fake-quant ops on the weights and
+activation inputs of quantizable ops) + QuantizationFreezePass (freeze
+trained scales, fold weight fake-quant into the params) — driven as a
+program pass (static/passes.py) instead of an IR graph walk.
+
+Flow (reference order):
+    quant_aware(main, startup)      # BEFORE optimizer.minimize
+    opt.minimize(loss); train...    # STE fake-quant in fwd, EMA act scales
+    convert(main, scope)            # freeze: test-mode act ops, weights
+                                    # snapped to their quant grid
+    save_inference_model(...)       # then quantize_inference_weights for
+                                    # the int8 artifact (exact same grid)
+
+TPU-native notes: the fake-quant fns are pure jax (STE via
+stop_gradient), so the QAT program still jits whole-block; the
+activation scale is a persistable var updated IN PLACE by its op (the
+batch_norm running-stat pattern — the executor writes persistable op
+outputs back to the scope, pipelined execution chains them across
+micro-batches).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .qat import _weight_scale, quant_dequant
+from ..static.passes import register_pass
+
+_QUANT_OPS = {"fc": 1, "matmul_v2": 1, "conv2d": 0, "mul": 1}
+# op type -> channel axis of its weight operand under channel_wise;
+# user-supplied quantizable_op_types outside this table fall back to
+# per-tensor scales even under channel_wise_abs_max
+_SCALE_UID = [0]  # per-quant_aware-call suffix: scale names must be
+# process-unique or two QAT programs sharing the global scope would
+# alias each other's persistable scales
+
+
+def _weight_qdq_fn(bits, channel_axis):
+    def fn(w):
+        # the SAME grid the imperative layers train against (qat.py)
+        return quant_dequant(w, _weight_scale(w, channel_axis), bits)
+
+    return fn
+
+
+def _act_qdq_train_fn(bits, moving_rate):
+    def fn(x, scale):
+        cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        # scale==0 marks "not yet observed" (survives checkpoints)
+        new_scale = jnp.where(scale == 0.0, cur,
+                              moving_rate * scale
+                              + (1.0 - moving_rate) * cur)
+        out = quant_dequant(x, jax.lax.stop_gradient(new_scale), bits)
+        return out, new_scale
+
+    return fn
+
+
+def _act_qdq_test_fn(bits):
+    def fn(x, scale):
+        # frozen scale; a never-observed scale of 0 degrades to identity
+        # via the 1e-9 floor inside quant_dequant only if forced — guard
+        # explicitly so an uncalibrated path passes through unchanged
+        return jnp.where(scale > 0.0,
+                         quant_dequant(x, scale, bits), x)
+
+    return fn
+
+
+def quant_aware(program, startup_program=None, scope=None, weight_bits=8,
+                activation_bits=8, moving_rate=0.9,
+                weight_quantize_type="abs_max",
+                quantizable_op_types=None):
+    """QuantizationTransformPass role: rewrite `program` in place so
+    every quantizable op consumes a fake-quantized weight and activation.
+    Call BEFORE optimizer.minimize so append_backward differentiates
+    through the STE.  Returns the list of inserted op types."""
+    if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+        raise ValueError(
+            f"weight_quantize_type {weight_quantize_type!r} not "
+            "supported; use 'abs_max' or 'channel_wise_abs_max'")
+    channel_wise = weight_quantize_type == "channel_wise_abs_max"
+    op_types = set(quantizable_op_types or _QUANT_OPS)
+    _SCALE_UID[0] += 1
+    uid = _SCALE_UID[0]
+    block = program.global_block()
+    Operator = type(block.ops[0]) if block.ops else None
+    if Operator is None:
+        return []
+    inserted = []
+    new_ops = []
+    quantized_acts = {}  # input var -> its qdq output var (reuse)
+    for op in block.ops:
+        if op.type not in op_types or op.fn is None:
+            new_ops.append(op)
+            continue
+        ins = list(getattr(op, "in_order", op.input_names()))
+        if len(ins) < 2:
+            new_ops.append(op)
+            continue
+        x_name, w_name = ins[0], ins[1]
+        wv = block.vars.get(w_name)
+        if wv is None or not getattr(wv, "is_parameter", False):
+            new_ops.append(op)
+            continue
+
+        # --- weight fake-quant (abs-max each call: FakeQuantAbsMax) ---
+        axis = _QUANT_OPS.get(op.type) if channel_wise else None
+        wq_name = w_name + ".quantized"
+        if not block.has_var(wq_name):
+            block.create_var(name=wq_name, shape=list(wv.shape or []),
+                             dtype=wv.dtype)
+            wq_op = Operator(
+                block, "fake_quantize_dequantize_abs_max",
+                {"X": [w_name]}, {"Out": [wq_name]},
+                {"bit_length": weight_bits, "channel_axis": axis},
+                fn=_weight_qdq_fn(weight_bits, axis))
+            wq_op.in_order = [w_name]
+            wq_op.out_order = [wq_name]
+            new_ops.append(wq_op)
+            inserted.append(wq_op.type)
+
+        # --- activation fake-quant (EMA abs-max with persistable scale,
+        # updated in place like batch_norm running stats) ---
+        xq_name = quantized_acts.get(x_name)
+        if xq_name is None:
+            xq_name = x_name + ".quantized"
+            xv = block.vars.get(x_name)
+            block.create_var(name=xq_name,
+                             shape=list(getattr(xv, "shape", []) or []),
+                             dtype=getattr(xv, "dtype", "float32"))
+            scale_name = f"{x_name}.quant_scale_{uid}"
+            sv = block.create_var(name=scale_name, shape=[],
+                                  dtype="float32", persistable=True)
+            sv.is_parameter = False
+            sv.stop_gradient = True
+            if startup_program is not None:
+                startup_program.global_block().append_op(
+                    "init", {}, {"Out": [scale_name]}, {},
+                    fn=lambda: jnp.zeros((), jnp.float32))
+            if scope is not None:
+                scope.set(scale_name, jnp.zeros((), jnp.float32))
+            aq_op = Operator(
+                block, "fake_quantize_dequantize_moving_average_abs_max",
+                {"X": [x_name], "InScale": [scale_name]},
+                {"Out": [xq_name], "OutScale": [scale_name]},
+                {"bit_length": activation_bits,
+                 "moving_rate": moving_rate},
+                fn=_act_qdq_train_fn(activation_bits, moving_rate))
+            aq_op.in_order = [x_name, scale_name]
+            aq_op.out_order = [xq_name, scale_name]
+            new_ops.append(aq_op)
+            inserted.append(aq_op.type)
+            quantized_acts[x_name] = xq_name
+
+        # rewire the consumer onto the quantized views
+        op.in_order = [xq_name if n == x_name else
+                       (wq_name if n == w_name else n) for n in ins]
+        for k, vs in op.inputs.items():
+            op.inputs[k] = [xq_name if n == x_name else
+                            (wq_name if n == w_name else n) for n in vs]
+        new_ops.append(op)
+    block.ops = new_ops
+    program._quant_aware = True
+    program._version = getattr(program, "_version", 0) + 1
+    return inserted
+
+
+def convert(program, scope):
+    """QuantizationFreezePass role: finalize a QAT program for
+    deployment IN PLACE — activation fake-quant ops freeze to their
+    trained scales (no more EMA updates), and weight fake-quant ops are
+    REMOVED with the scope weights snapped onto their quant grid (the
+    grid's max is a grid point, so a later int8 export via
+    quantize_inference_weights reproduces the exact same values)."""
+    block = program.global_block()
+    new_ops = []
+    for op in block.ops:
+        if op.type == "fake_quantize_dequantize_abs_max":
+            w_name = op.in_order[0]
+            wq_name = op.out_order[0]
+            bits = op.attrs.get("bit_length", 8)
+            axis = op.attrs.get("channel_axis")
+            w = scope.get(w_name)
+            if w is not None:
+                scope.set(w_name,
+                          jnp.asarray(_weight_qdq_fn(bits, axis)(
+                              jnp.asarray(w))))
+            # rewire consumers back onto the (now grid-snapped) param
+            for other in block.ops:
+                if other is op:
+                    continue
+                order = getattr(other, "in_order", None)
+                if order and wq_name in order:
+                    other.in_order = [w_name if n == wq_name else n
+                                      for n in order]
+                    for k, vs in other.inputs.items():
+                        other.inputs[k] = [w_name if n == wq_name else n
+                                           for n in vs]
+            continue  # drop the op
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            bits = op.attrs.get("bit_length", 8)
+            op.fn = _act_qdq_test_fn(bits)
+            op.attrs["is_test"] = True
+            # frozen: scale is read-only now
+            op.out_order = [op.out_order[0]]
+            op.outputs = {"Out": [op.out_order[0]]}
+        new_ops.append(op)
+    block.ops = new_ops
+    program._quant_converted = True
+    # compiled blocks cache by (id(program), _version): the in-place
+    # rewrite must invalidate them or a previously-run executor keeps
+    # EMA-updating the 'frozen' scale
+    program._version = getattr(program, "_version", 0) + 1
+    return program
+
+
+@register_pass("quantization_transform_pass")
+def _quant_transform_pass(program, **ctx):
+    quant_aware(program, **{k: v for k, v in ctx.items()
+                            if k in ("startup_program", "scope",
+                                     "weight_bits", "activation_bits",
+                                     "moving_rate",
+                                     "weight_quantize_type",
+                                     "quantizable_op_types")})
+    return program
+
+
+@register_pass("quantization_freeze_pass")
+def _quant_freeze_pass(program, **ctx):
+    return convert(program, ctx["scope"])

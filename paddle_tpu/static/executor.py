@@ -382,7 +382,10 @@ class Executor:
         )
         from ..framework import _FLAGS
 
-        return (id(program), feed_names, tuple(fetch_names), shapes,
+        # _version: program-rewriting passes that mutate ops in place
+        # (quant convert, ...) bump it so stale compiled blocks miss
+        return (id(program), getattr(program, "_version", 0), feed_names,
+                tuple(fetch_names), shapes,
                 bool(_FLAGS.get("FLAGS_check_nan_inf")))
 
     def _get_block(self, program, feed, fetch_list, scope):

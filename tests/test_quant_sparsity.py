@@ -409,3 +409,140 @@ def test_channel_wise_qat_int8_deployment_roundtrip(tmp_path):
     loaded = paddle.jit.load(prefix)  # dequant-on-load path
     assert all(np.asarray(v.numpy()).dtype == np.float32
                for v in loaded.state_dict().values())
+
+
+# ---- static-graph QAT (quantization_pass.py roles) ----
+
+def test_static_qat_train_convert_int8_roundtrip(tmp_path):
+    """quant_aware -> minimize -> train -> convert -> int8 artifact:
+    the full static QAT deployment flow.  The freeze snaps weights onto
+    their quant grid, so the int8 export reproduces the converted
+    program's outputs near-exactly."""
+    import paddle_tpu.static as static
+    from paddle_tpu import inference
+    from paddle_tpu.quant import quant_aware, convert, \
+        quantize_inference_weights
+
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 16])
+            y = static.data("y", [8, 1])
+            h = static.nn.relu(static.nn.fc(x, 32))
+            out = static.nn.fc(h, 1)
+            loss = static.nn.mean((out - y) * (out - y))
+            inserted = quant_aware(main, startup)
+            assert "fake_quantize_dequantize_abs_max" in inserted
+            assert ("fake_quantize_dequantize_moving_average_abs_max"
+                    in inserted)
+            paddle.optimizer.Momentum(learning_rate=0.05,
+                                      momentum=0.9).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(12):
+            xv = rng.rand(8, 16).astype(np.float32)
+            yv = (xv.sum(axis=1, keepdims=True) / 8.0).astype(np.float32)
+            lv = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[loss])[0]
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert losses[-1] < losses[0], losses  # trains through the STE
+        scope = static.global_scope()
+        scale_names = [n for n in scope.names()
+                       if ".quant_scale_" in n]
+        assert scale_names and all(
+            float(np.asarray(scope.get(n))) > 0 for n in scale_names)
+
+        # freeze for deployment
+        infer = main.clone(for_test=True)
+        convert(infer, scope)
+        assert not any(op.type == "fake_quantize_dequantize_abs_max"
+                       for op in infer.global_block().ops)
+        xv = rng.rand(8, 16).astype(np.float32)
+        want = exe.run(infer, feed={"x": xv}, fetch_list=[out])[0]
+
+        prefix = str(tmp_path / "sqat")
+        static.save_inference_model(prefix, [x], [out], exe,
+                                    program=infer)
+        q_prefix, names = quantize_inference_weights(prefix)
+        assert names  # fc weights went int8
+    finally:
+        paddle.disable_static()
+
+    pred = inference.Predictor(inference.Config(q_prefix))
+    got = pred.run([xv])[0]
+    # same grid as the QAT sim: near-exact
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_static_qat_channel_wise_and_pass_registry():
+    """channel_wise static QAT + the passes are registered under the
+    reference pass names."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static.passes import get_pass
+    from paddle_tpu.quant import quant_aware
+
+    assert get_pass("quantization_transform_pass") is not None
+    assert get_pass("quantization_freeze_pass") is not None
+
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 16])
+            out = static.nn.fc(static.nn.relu(static.nn.fc(x, 32)), 2)
+            quant_aware(main, startup,
+                        weight_quantize_type="channel_wise_abs_max")
+        wq = [op for op in main.global_block().ops
+              if op.type == "fake_quantize_dequantize_abs_max"]
+        assert wq and all(op.attrs["channel_axis"] == 1 for op in wq)
+        # PRIVATE scope: global-scope param-name collisions with other
+        # tests must not leak stale tensors into this program
+        scope = static.Scope()
+        exe = static.Executor()
+        exe.run(startup, scope=scope)
+        got = exe.run(main, feed={"x": np.ones((4, 16), np.float32)},
+                      fetch_list=[out], scope=scope)[0]
+        got = np.asarray(got)
+        assert got.shape == (4, 2), got.shape
+        assert np.isfinite(got).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_convert_invalidates_executor_cache():
+    """convert() rewrites the program in place; an Executor that already
+    compiled it must NOT keep running the stale train-mode block (review
+    finding: the 'frozen' EMA scale kept updating)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.quant import quant_aware, convert
+
+    paddle.enable_static()
+    try:
+        paddle.seed(0)
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8])
+            out = static.nn.fc(x, 2)
+            quant_aware(main, startup)
+        scope = static.Scope()
+        exe = static.Executor()
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        exe.run(main, feed={"x": rng.rand(4, 8).astype(np.float32)},
+                fetch_list=[out], scope=scope)  # compiles TRAIN mode
+        convert(main, scope)
+        sname = next(n for n in scope.names() if ".quant_scale_" in n)
+        frozen = float(np.asarray(scope.get(sname)))
+        assert frozen > 0
+        # very different input magnitude: a live EMA would move the scale
+        exe.run(main, feed={"x": 100.0 * rng.rand(4, 8).astype(
+            np.float32)}, fetch_list=[out], scope=scope)
+        after = float(np.asarray(scope.get(sname)))
+        np.testing.assert_allclose(after, frozen, rtol=0)  # truly frozen
+    finally:
+        paddle.disable_static()
