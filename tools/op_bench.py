@@ -40,6 +40,13 @@ DEFAULT_SUITE = [
     {"op": "layer_norm", "shapes": [[256, 1024]], "repeat": 30},
     {"op": "conv2d", "shapes": [[8, 64, 56, 56], [64, 64, 3, 3]],
      "repeat": 10},
+    # attention-shaped batched matmul (scores: [B*H, S, d] x [B*H, d, S])
+    {"op": "matmul", "shapes": [[96, 512, 64], [96, 64, 512]],
+     "repeat": 20},
+    {"op": "gelu", "shapes": [[4096, 1024]], "repeat": 50},
+    {"op": "tanh", "shapes": [[4096, 1024]], "repeat": 50},
+    {"op": "transpose", "shapes": [[64, 12, 128, 64]], "repeat": 30,
+     "kwargs": {"perm": [0, 2, 1, 3]}},
 ]
 
 
@@ -76,15 +83,16 @@ def bench_one(cfg):
     op = _resolve(cfg["op"])
     rng = np.random.RandomState(0)
     dtype = cfg.get("dtype", "float32")
+    kwargs = dict(cfg.get("kwargs", {}))
     args = [paddle.to_tensor(rng.randn(*s).astype(dtype))
             for s in cfg["shapes"]]
     repeat = int(cfg.get("repeat", 30))
 
     def run_eager():
-        out = op(*args)
+        out = op(*args, **kwargs)
         jax.block_until_ready(out._data if hasattr(out, "_data") else out)
 
-    raw = getattr(op, "raw_fn", None)
+    raw = None if kwargs else getattr(op, "raw_fn", None)
     if raw is None:
         # wrapper ops without a registered raw kernel: jit the whole
         # eager call over raw arrays (Tensors wrap tracers fine)
@@ -93,7 +101,7 @@ def bench_one(cfg):
 
         def raw(*vs):
             with autograd.no_grad():
-                out = op(*[_wrap_data(v) for v in vs])
+                out = op(*[_wrap_data(v) for v in vs], **kwargs)
             return out._data if hasattr(out, "_data") else out
 
     arrs = [a._data for a in args]
