@@ -105,7 +105,8 @@ def _gen_block(rng, depth, lines, indent):
 def _gen_program(seed):
     rng = np.random.RandomState(seed)
     lines = ["def f(x):", "    acc = paddle.mean(x) * 0.0 + 1.0"]
-    if rng.randint(0, 2):
+    helper_kind = rng.randint(0, 3)
+    if helper_kind == 1:
         # route part of the math through a helper (convert_call path)
         lines = [
             "def helper(v):",
@@ -114,9 +115,21 @@ def _gen_program(seed):
             "    return v - 0.25",
             "",
         ] + lines
+    elif helper_kind == 2:
+        # helper CONTAINING a loop + early return: convert_call must
+        # recursively convert loop machinery inside callees
+        lines = [
+            "def helper(v):",
+            "    for i in range(3):",
+            "        v = v + 0.125",
+            "        if paddle.mean(v) > 3.0:",
+            "            return v * 0.5",
+            "    return v",
+            "",
+        ] + lines
     for _ in range(int(rng.randint(2, 5))):
         _gen_block(rng, 0, lines, 1)
-    if "def helper" in lines[0]:
+    if lines and lines[0].startswith("def helper"):
         lines.append("    acc = helper(acc)")
     lines.append("    return acc")
     return "\n".join(lines) + "\n"
