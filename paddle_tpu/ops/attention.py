@@ -32,9 +32,11 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         # import only on the flash path: environments without pallas still
         # run the composite path fine
         from .pallas.flash_attention import (flash_attention,
-                                             mask_is_flash_compatible)
+                                             mask_is_flash_compatible,
+                                             shapes_are_flash_compatible)
 
-        if mask_is_flash_compatible(attn_mask):
+        if (mask_is_flash_compatible(attn_mask)
+                and shapes_are_flash_compatible(q.shape[-2], k.shape[-2])):
             return flash_attention(q, k, v, attn_mask=attn_mask,
                                    causal=is_causal), None
 

@@ -5,4 +5,5 @@ multihead_matmul_op.cu, fused_attention) — here the fused fast path is
 written in Pallas against the TPU memory hierarchy (HBM -> VMEM -> MXU),
 with interpret-mode execution on CPU so tests run anywhere.
 """
+from . import flash_attention as flash_attention_kernels  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401

@@ -29,11 +29,39 @@ def _interpret():
     return jax.default_backend() == "cpu"
 
 
+_DEFAULT_BLOCK = 512  # swept on v5e: 512 beats 128 ~2x (fewer grid steps)
+
+
 def _choose_block(n):
-    for b in (128, 64, 32, 16, 8):
-        if n % b == 0:
-            return min(b, n)
+    # Tile-legal by construction: a 128-multiple block, or one block spanning
+    # the whole axis (a block equal to the array dim is always legal, even
+    # when the dim is not an (8,128) multiple — Mosaic pads it).  Reads
+    # _DEFAULT_BLOCK at call time so tests/benches can override it.
+    for b in (_DEFAULT_BLOCK, 256, 128):
+        if b <= _DEFAULT_BLOCK and n % b == 0:
+            return b
     return n
+
+
+def _check_mosaic_specs(specs, shapes, where):
+    """Static Mosaic tiling check, run on EVERY backend (so interpret-mode
+    CPU tests cannot mask a violation the real TPU lowering would reject).
+
+    Rule (f32-class dtypes): for rank>=2 blocks, the last block dim must be
+    a multiple of 128 or equal to the full array dim, and the second-to-last
+    a multiple of 8 or equal to the full array dim.  This is the check that
+    round-4's lse out_spec (1, block_q) over (bh, lq) failed on hardware.
+    """
+    for idx, (spec, shape) in enumerate(zip(specs, shapes)):
+        blk = spec.block_shape
+        if blk is None or len(blk) < 2:
+            continue
+        ok_last = blk[-1] % 128 == 0 or blk[-1] == shape[-1]
+        ok_sub = blk[-2] % 8 == 0 or blk[-2] == shape[-2]
+        if not (ok_last and ok_sub):
+            raise ValueError(
+                f"flash_attention {where}[{idx}]: block {tuple(blk)} over "
+                f"array {tuple(shape)} violates Mosaic (8,128) tiling")
 
 
 def _causal_mask(s, qb, kb, block_q, block_k, offset):
@@ -58,6 +86,9 @@ def _causal_block_runs(qb, kb, block_q, block_k, offset):
 def _fwd_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, causal, block_q, block_k,
                 n_kb, have_mask, offset):
+    # m/l scratch are (block_q, 128) with every lane holding the row value
+    # (broadcast-write, max-read): full-width vector ops only, no strided
+    # single-lane stores, matching the Mosaic-proven layout.
     qb = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -72,69 +103,83 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kmask_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
-        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
-        v = v_ref[0].astype(jnp.float32)
+        # matmuls run in the NATIVE input dtype with f32 accumulation: the
+        # MXU takes bf16 operands at full rate, while pre-casting to f32
+        # forces multi-pass f32 matmuls (~3x slower, measured on v5e)
+        q = q_ref[0]                               # [block_q, d]
+        k = k_ref[0]                               # [block_k, d]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if have_mask:
-            s = s + kmask_ref[0].astype(jnp.float32)[None, :]
+            s = s + kmask_ref[0, 0].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, qb, kb, block_q, block_k, offset)
 
-        m_prev = m_ref[:, 0]                       # [block_q]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_prev = jnp.max(m_ref[...], axis=1, keepdims=True)   # [block_q, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)            # rescale of old state
-        p = jnp.exp(s - m_cur[:, None])            # [block_q, block_k]
+        p = jnp.exp(s - m_cur)                     # [block_q, block_k]
         # fully-masked rows saturate at s == m_cur == NEG_INF, where exp(0)
         # would leak weight 1 per key; re-mask so l stays 0 for them
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+        l_prev = jnp.max(l_ref[...], axis=1, keepdims=True)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_ref[:, 0] = m_cur
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
-        l = l_ref[:, 0]
+        l = jnp.max(l_ref[...], axis=1, keepdims=True)        # [block_q, 1]
         # fully-masked rows (padding): emit zeros, lse -> NEG_INF
         safe_l = jnp.where(l > 0.0, l, 1.0)
-        o_ref[0] = (acc_ref[...] / safe_l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(l > 0.0, m_ref[:, 0] + jnp.log(safe_l), NEG_INF)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        m_fin = jnp.max(m_ref[...], axis=1, keepdims=True)
+        lse_ref[0] = jnp.where(l > 0.0, m_fin + jnp.log(safe_l), NEG_INF)
 
 
 def _flash_fwd_call(qs, k, v, km, causal, heads, have_mask):
+    # km is [Bm, 1, Lk] (Bm = batch or 1): the middle singleton keeps every
+    # 2-D-per-row operand rank-3 so its (1, 1, block) BlockSpec is Mosaic
+    # tile-legal regardless of the leading dim (round-4 TPU crash class).
     bh, lq, d = qs.shape
     _, lk, _ = k.shape
     block_q, block_k = _choose_block(lq), _choose_block(lk)
     n_qb, n_kb = lq // block_q, lk // block_k
 
-    km_index = (lambda b, i, j: (b // heads, j)) if have_mask else (
-        lambda b, i, j: (0, j))
+    km_index = (lambda b, i, j: (b // heads, 0, j)) if have_mask else (
+        lambda b, i, j: (0, 0, j))
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, 1, block_k), km_index),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, lq, d), qs.dtype),
+        jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+    ]
+    _check_mosaic_specs(in_specs, [a.shape for a in (qs, k, v, km)], "in")
+    _check_mosaic_specs(out_specs, [s.shape for s in out_shape], "out")
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, n_kb=n_kb, have_mask=have_mask,
                           offset=lk - lq),
         grid=(bh, n_qb, n_kb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k), km_index),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), qs.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
     )(qs, k, v, km)
@@ -159,28 +204,29 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        # native-dtype matmul operands, f32 accumulation (see fwd kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                           # [block_q, 1]
+        delta = delta_ref[0]                       # [block_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if have_mask:
-            s = s + kmask_ref[0].astype(jnp.float32)[None, :]
+            s = s + kmask_ref[0, 0].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, qb, kb, block_q, block_k, offset)
-        p = jnp.exp(s - lse[:, None])              # [block_q, block_k]
+        p = jnp.exp(s - lse)                       # [block_q, block_k]
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)    # see fwd kernel note
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qb == n_qb - 1)
@@ -203,25 +249,26 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        # native-dtype matmul operands, f32 accumulation (see fwd kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                           # [block_q, 1]
+        delta = delta_ref[0]                       # [block_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if have_mask:
-            s = s + kmask_ref[0].astype(jnp.float32)[None, :]
+            s = s + kmask_ref[0, 0].astype(jnp.float32)[None, :]
         if causal:
             s = _causal_mask(s, qb, kb, block_q, block_k, offset)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)    # see fwd kernel note
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == n_kb - 1)
@@ -230,60 +277,70 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _flash_bwd_call(qs, k, v, km, out, lse, do, causal, heads, have_mask):
+    # lse/delta ride as [bh, Lq, 1] columns and km as [Bm, 1, Lk] rows so
+    # every BlockSpec satisfies Mosaic's (8, 128) tiling (see fwd call).
     bh, lq, d = qs.shape
     _, lk, _ = k.shape
     block_q, block_k = _choose_block(lq), _choose_block(lk)
     n_qb, n_kb = lq // block_q, lk // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
 
-    km_idx_kq = (lambda b, j, i: (b // heads, j)) if have_mask else (
-        lambda b, j, i: (0, j))
+    km_idx_kq = (lambda b, j, i: (b // heads, 0, j)) if have_mask else (
+        lambda b, j, i: (0, 0, j))
+    in_specs_kq = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, 1, block_k), km_idx_kq),
+    ]
+    out_specs_kq = [
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+    ]
+    operands = (qs, do, lse, delta, k, v, km)
+    _check_mosaic_specs(in_specs_kq, [a.shape for a in operands], "bwd-in")
+    _check_mosaic_specs(out_specs_kq, [k.shape, v.shape], "bwd-out")
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, n_qb=n_qb, have_mask=have_mask,
                           offset=lk - lq),
         grid=(bh, n_kb, n_qb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k), km_idx_kq),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-        ],
+        in_specs=in_specs_kq,
+        out_specs=out_specs_kq,
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
-    )(qs, do, lse, delta, k, v, km)
+    )(*operands)
 
-    km_idx_qk = (lambda b, i, j: (b // heads, j)) if have_mask else (
-        lambda b, i, j: (0, j))
+    km_idx_qk = (lambda b, i, j: (b // heads, 0, j)) if have_mask else (
+        lambda b, i, j: (0, 0, j))
+    in_specs_qk = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, 1, block_k), km_idx_qk),
+    ]
+    _check_mosaic_specs(in_specs_qk, [a.shape for a in operands], "bwd-in")
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, block_q=block_q,
                           block_k=block_k, n_kb=n_kb, have_mask=have_mask,
                           offset=lk - lq),
         grid=(bh, n_qb, n_kb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k), km_idx_qk),
-        ],
+        in_specs=in_specs_qk,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qs.shape, qs.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(qs, do, lse, delta, k, v, km)
+    )(*operands)
     return dq, dk, dv
 
 
@@ -337,14 +394,23 @@ def flash_attention(q, k, v, attn_mask=None, causal=False):
             m = mask[0]
             km = jnp.broadcast_to(
                 m, (b,) + tuple(m.shape[1:])).reshape(b, -1)
-            km = km[:, -lk:].astype(jnp.float32)
+            km = km[:, -lk:].astype(jnp.float32).reshape(b, 1, lk)
         else:
-            km = jnp.zeros((1, lk), jnp.float32)
+            km = jnp.zeros((1, 1, lk), jnp.float32)
         out = _flash(qs, kf, vf, km, causal, h, have_mask)
         return out.reshape(b, h, lq, dh)
 
     args = (q, k, v) + ((attn_mask,) if attn_mask is not None else ())
     return apply_op("flash_attention", fn, args, {})
+
+
+def shapes_are_flash_compatible(lq, lk):
+    """Sequence lengths the kernel handles within VMEM: non-128-multiple
+    axes run as one full-axis block, so bound the f32 score block
+    (block_q x block_k) the kernel would materialize.  4 MB leaves room for
+    the q/k/v blocks and scratch within a v5e core's ~16 MB VMEM."""
+    bq, bk = _choose_block(lq), _choose_block(lk)
+    return bq * bk * 4 <= 4 * 1024 * 1024
 
 
 def mask_is_flash_compatible(attn_mask):

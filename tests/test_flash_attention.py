@@ -38,7 +38,7 @@ def _make(bh=4, l=64, d=32, seed=0, dtype=np.float32):
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_forward_matches_reference(causal):
     q, k, v = _make()
-    km = jnp.zeros((1, 64), jnp.float32)
+    km = jnp.zeros((1, 1, 64), jnp.float32)
     out = _flash(q, k, v, km, causal, 2, False)
     ref = _ref(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -48,7 +48,7 @@ def test_flash_forward_matches_reference(causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_grads_match_reference(causal):
     q, k, v = _make(bh=2, l=32, d=16)
-    km = jnp.zeros((1, 32), jnp.float32)
+    km = jnp.zeros((1, 1, 32), jnp.float32)
 
     def loss_flash(q, k, v):
         return (_flash(q, k, v, km, causal, 1, False) ** 2).sum()
@@ -71,7 +71,7 @@ def test_flash_key_padding_mask():
     km = np.zeros((b, l), np.float32)
     km[0, -8:] = -1e30
     km = jnp.asarray(km)
-    out = _flash(q, k, v, km, False, heads, True)
+    out = _flash(q, k, v, km.reshape(b, 1, l), False, heads, True)
     km_full = jnp.repeat(km, heads, axis=0)  # per (b,h) row
     ref = _ref(q, k, v, km=km_full)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -79,19 +79,49 @@ def test_flash_key_padding_mask():
 
 
 def test_flash_uneven_block_sizes():
-    # L=48 -> block 16; exercises multi-wave online softmax with small blocks
+    # L=48 is not a 128-multiple -> runs as one full-axis (tile-padded) block
     q, k, v = _make(bh=2, l=48, d=16, seed=3)
-    km = jnp.zeros((1, 48), jnp.float32)
+    km = jnp.zeros((1, 1, 48), jnp.float32)
     out = _flash(q, k, v, km, True, 1, False)
     ref = _ref(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_multiblock_carry(causal):
+    # L=1024 -> two 512-blocks per axis: exercises the cross-k-block online
+    # softmax carry (alpha rescale, m/l scratch) and, under causal, the
+    # _causal_block_runs skip — the paths single-block tests never touch
+    import paddle_tpu.ops.pallas.flash_attention as _pkgattr  # noqa: F401
+    import sys
+
+    fa = sys.modules["paddle_tpu.ops.pallas.flash_attention"]
+    q, k, v = _make(bh=2, l=1024, d=16, seed=5)
+    km = jnp.zeros((1, 1, 1024), jnp.float32)
+    assert fa._choose_block(1024) == 512  # guards the multi-block premise
+    out = _flash(q, k, v, km, causal, 1, False)
+    ref = _ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_flash_vmem_shape_gate():
+    from paddle_tpu.ops.pallas.flash_attention import (
+        shapes_are_flash_compatible)
+
+    assert shapes_are_flash_compatible(512, 512)
+    assert shapes_are_flash_compatible(4096, 4096)   # 128-multiples: blocked
+    assert shapes_are_flash_compatible(48, 48)
+    # non-128-multiple long axes run full-length: score block must fit VMEM
+    assert not shapes_are_flash_compatible(2000, 2000)
+    assert not shapes_are_flash_compatible(512, 5000)
+
+
 def test_flash_bf16_inputs():
     q, k, v = _make(bh=2, l=32, d=16)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
-    km = jnp.zeros((1, 32), jnp.float32)
+    km = jnp.zeros((1, 1, 32), jnp.float32)
     out = _flash(qb, kb, vb, km, False, 1, False)
     assert out.dtype == jnp.bfloat16
     ref = _ref(q, k, v)
@@ -107,7 +137,7 @@ def test_flash_causal_decode_shape():
     q = jnp.asarray(rng.randn(bh, lq, d).astype(np.float32)) / math.sqrt(d)
     k = jnp.asarray(rng.randn(bh, lk, d).astype(np.float32))
     v = jnp.asarray(rng.randn(bh, lk, d).astype(np.float32))
-    km = jnp.zeros((1, lk), jnp.float32)
+    km = jnp.zeros((1, 1, lk), jnp.float32)
     out = _flash(q, k, v, km, True, 1, False)
     s = jnp.einsum("bqd,bkd->bqk", q, k)
     s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq), s, -1e30)
