@@ -110,7 +110,9 @@ from .compat import (  # noqa: F401,E402
     normalize_program,
 )
 
-from .. import amp  # noqa: F401,E402  (paddle.static.amp alias role)
+# paddle.static.amp IS the program-rewrite mixed-precision module in the
+# reference (python/paddle/static/amp -> fluid/contrib/mixed_precision)
+from . import amp_static as amp  # noqa: F401,E402
 from ..nn.layer import ParamAttr as _ParamAttr  # noqa: E402
 
 

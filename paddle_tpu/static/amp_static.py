@@ -10,7 +10,21 @@ bf16 inside the single compiled block (XLA inserts the converts).
 def amp_decorate(optimizer, amp_lists=None, init_loss_scaling=2**15,
                  use_dynamic_loss_scaling=True, use_pure_fp16=False,
                  use_fp16_guard=None):
+    """Tags the program at minimize() time; the Executor's CompiledBlock
+    then applies the bf16 cast policy (static/executor.py _amp_cast_args)
+    while tracing the block.  Loss scaling is intentionally absent: bf16
+    shares f32's exponent range (the reference's fp16 machinery at
+    decorator.py:37 exists to work around fp16's narrow range)."""
     optimizer._amp_enabled = True
+    orig_minimize = optimizer.minimize
+
+    def minimize(loss, *args, **kwargs):
+        prog = getattr(getattr(loss, "block", None), "program", None)
+        if prog is not None:
+            prog._amp_bf16 = True
+        return orig_minimize(loss, *args, **kwargs)
+
+    optimizer.minimize = minimize
     return optimizer
 
 

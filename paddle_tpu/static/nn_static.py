@@ -402,17 +402,23 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     shape = [1, C] + [1] * (len(input.shape) - 2)
 
     def fn(v, sc, b, m, va):
+        # statistics and normalization in f32 even for bf16 inputs (AMP):
+        # the converts fuse into the reduce/normalize kernels, so HBM
+        # traffic stays in the input dtype while accumulation is exact
+        vf = v.astype(jnp.float32) if v.dtype != jnp.float32 else v
         if is_test:
             mean_u, var_u = m, va
         else:
-            mean_u = jnp.mean(v, axis=reduce_axes)
-            var_u = jnp.mean(jnp.square(v), axis=reduce_axes) - jnp.square(mean_u)
-        out = (v - mean_u.reshape(shape)) * jax.lax.rsqrt(
+            mean_u = jnp.mean(vf, axis=reduce_axes)
+            var_u = jnp.mean(jnp.square(vf), axis=reduce_axes) \
+                - jnp.square(mean_u)
+        out = (vf - mean_u.reshape(shape)) * jax.lax.rsqrt(
             var_u.reshape(shape) + epsilon
         )
         out = out * sc.reshape(shape) + b.reshape(shape)
         if act:
             out = _BN_ACTS[act](out)
+        out = out.astype(v.dtype)
         if is_test:
             return out
         # training also updates the running stats IN PLACE (MeanOut /
