@@ -36,7 +36,8 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                              shapes_are_flash_compatible)
 
         if (mask_is_flash_compatible(attn_mask)
-                and shapes_are_flash_compatible(q.shape[-2], k.shape[-2])):
+                and shapes_are_flash_compatible(q.shape[-2], k.shape[-2],
+                                                d=q.shape[-1])):
             return flash_attention(q, k, v, attn_mask=attn_mask,
                                    causal=is_causal), None
 

@@ -404,13 +404,24 @@ def flash_attention(q, k, v, attn_mask=None, causal=False):
     return apply_op("flash_attention", fn, args, {})
 
 
-def shapes_are_flash_compatible(lq, lk):
-    """Sequence lengths the kernel handles within VMEM: non-128-multiple
-    axes run as one full-axis block, so bound the f32 score block
-    (block_q x block_k) the kernel would materialize.  4 MB leaves room for
-    the q/k/v blocks and scratch within a v5e core's ~16 MB VMEM."""
+def shapes_are_flash_compatible(lq, lk, d=None):
+    """Shapes the kernel handles within VMEM: non-128-multiple axes run as
+    one full-axis block, so bound what the kernel would actually resident —
+    the f32 score block (block_q x block_k) plus, when the head dim is
+    known, the d-dependent blocks: q/out/acc (block_q x d), k/v and the
+    backward's dk/dv scratch (block_k x d), and the online-softmax state
+    (block_q x 128 x 2), all f32 and doubled for Mosaic's input
+    double-buffering.  The combined budget is half of a v5e core's ~16 MB
+    VMEM; large-d shapes that blow it fall back to the composite path
+    instead of over-allocating VMEM at compile time."""
     bq, bk = _choose_block(lq), _choose_block(lk)
-    return bq * bk * 4 <= 4 * 1024 * 1024
+    score = bq * bk * 4
+    if d is None:
+        # legacy seq-only bound: 4 MB leaves room for typical (d<=128)
+        # q/k/v blocks and scratch
+        return score <= 4 * 1024 * 1024
+    d_blocks = 4 * (3 * bq * d + 4 * bk * d + 2 * bq * 128) * 2
+    return score + d_blocks <= 8 * 1024 * 1024
 
 
 def mask_is_flash_compatible(attn_mask):

@@ -28,6 +28,13 @@ class Stat:
         with self._lock:
             self._value = 0
 
+    def set(self, v):
+        """Gauge semantics (queue depth, percentiles): overwrite instead of
+        accumulate, atomically under the same lock increase() takes."""
+        with self._lock:
+            self._value = v
+            return self._value
+
     def get(self):
         with self._lock:
             return self._value

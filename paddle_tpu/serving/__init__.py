@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — dynamically-batched TPU serving runtime.
+
+The production layer above `paddle_tpu.inference`: where a Predictor is
+one request / one shape / one thread, the serving runtime batches
+concurrent requests into shape-bucketed TPU dispatches with AOT compile
+reuse, bounded queueing, per-request deadlines and first-class metrics.
+See docs/SERVING.md for the full contract.
+
+Quick start::
+
+    from paddle_tpu import inference, serving
+
+    pred = inference.Predictor(inference.Config(prefix))   # -1 batch export
+    engine = serving.ServingEngine(
+        pred, serving.ServingConfig(batch_buckets=(1, 2, 4, 8),
+                                    max_batch_delay_ms=2,
+                                    queue_depth=64))
+    fut = engine.submit({"x": features}, timeout_ms=50)
+    outputs = fut.result()          # or engine.infer(...) to block
+    engine.shutdown()
+"""
+from .admission import (AdmissionQueue, DeadlineExceededError, Request,
+                        RequestTooLargeError, ServerBusyError, ServingError)
+from .batcher import DynamicBatcher
+from .bucketing import CompiledModelCache, ShapeBucketer
+from .engine import ServingConfig, ServingEngine, create_serving_engine
+from .metrics import LatencyReservoir, ServingMetrics
+
+__all__ = [
+    "ServingEngine", "ServingConfig", "create_serving_engine",
+    "DynamicBatcher", "AdmissionQueue", "Request",
+    "ShapeBucketer", "CompiledModelCache",
+    "ServingMetrics", "LatencyReservoir",
+    "ServingError", "ServerBusyError", "DeadlineExceededError",
+    "RequestTooLargeError",
+]

@@ -1,0 +1,171 @@
+"""ServingEngine: the dynamically-batched TPU serving runtime.
+
+Composes the subsystem end to end::
+
+    client -> submit() -> AdmissionQueue -> DynamicBatcher(worker thread)
+           <- Future   <-  scatter      <- CompiledModelCache[bucket] <- pad
+
+The model can be an `inference.Predictor` (the deployable jax.export
+artifact — export with ``InputSpec([-1, ...])`` so one module serves
+every bucket), or any positional callable over arrays.  Per-request
+outputs are sliced back out of the padded batch, so callers see exactly
+what an unbatched `Predictor.run` would have returned.
+
+Overload behavior is explicit: a full queue raises ServerBusyError at
+submit; a request whose deadline lapses in queue or while its batch
+forms resolves with DeadlineExceededError; nothing ever waits unbounded.
+"""
+import concurrent.futures
+import time
+
+import numpy as np
+
+from .admission import AdmissionQueue, Request, ServingError
+from .batcher import DynamicBatcher
+from .bucketing import CompiledModelCache, ShapeBucketer
+from .metrics import ServingMetrics
+
+
+class ServingConfig:
+    """Serving knobs; every default is safe for a small CPU demo and the
+    fields map 1:1 to the docs in docs/SERVING.md."""
+
+    def __init__(self, batch_buckets=(1, 2, 4, 8), length_buckets=None,
+                 max_batch_size=None, max_batch_delay_ms=2.0,
+                 queue_depth=64, default_timeout_ms=None, pad_value=0):
+        self.batch_buckets = tuple(batch_buckets)
+        self.length_buckets = (None if length_buckets is None
+                               else tuple(length_buckets))
+        self.max_batch_size = max_batch_size
+        self.max_batch_delay_ms = float(max_batch_delay_ms)
+        self.queue_depth = int(queue_depth)
+        self.default_timeout_ms = default_timeout_ms
+        self.pad_value = pad_value
+
+
+def _model_fn(model):
+    """(fn, in_names) from whatever the caller serves.
+
+    inference.Predictor carries either a deserialized jax.export module
+    (`_exported.call`) or a rebuilt jitted forward (`_jitted`) — both are
+    positional array fns, exactly what the bucket cache AOT-compiles."""
+    exported = getattr(model, "_exported", None)
+    if exported is not None:
+        return exported.call, list(model.get_input_names())
+    jitted = getattr(model, "_jitted", None)
+    if jitted is not None:
+        return jitted, list(model.get_input_names())
+    if callable(model):
+        return model, None
+    raise TypeError(
+        f"cannot serve {type(model).__name__}: need an inference.Predictor "
+        f"or a positional callable over arrays")
+
+
+class ServingEngine:
+    """Dynamically-batched, shape-bucketed inference server core."""
+
+    def __init__(self, model, config=None, metrics=None):
+        self.config = config or ServingConfig()
+        self._fn, self._in_names = _model_fn(model)
+        self.metrics = metrics or ServingMetrics()
+        self.bucketer = ShapeBucketer(self.config.batch_buckets,
+                                      self.config.length_buckets,
+                                      self.config.pad_value)
+        self.cache = CompiledModelCache(self._fn, metrics=self.metrics)
+        self.queue = AdmissionQueue(self.config.queue_depth,
+                                    metrics=self.metrics)
+        self.batcher = DynamicBatcher(
+            self.cache, self.queue, self.bucketer,
+            max_batch_size=self.config.max_batch_size,
+            max_batch_delay_ms=self.config.max_batch_delay_ms,
+            metrics=self.metrics)
+        self._closed = False
+
+    # --- client API ---
+    def _normalize(self, feeds):
+        if isinstance(feeds, dict):
+            if self._in_names is None:
+                raise ValueError(
+                    "dict feeds need a Predictor-backed engine (input "
+                    "names unknown for a bare callable); pass a list")
+            missing = [n for n in self._in_names if n not in feeds]
+            if missing:
+                raise ValueError(f"missing feeds: {missing}")
+            arrays = [np.asarray(feeds[n]) for n in self._in_names]
+        else:
+            arrays = [np.asarray(a) for a in feeds]
+        if not arrays:
+            raise ValueError("empty feed")
+        rows = int(arrays[0].shape[0]) if arrays[0].ndim else 1
+        for a in arrays:
+            if a.ndim == 0 or int(a.shape[0]) != rows:
+                raise ValueError(
+                    "every input needs the same leading batch dim "
+                    f"(got {[tuple(np.asarray(x).shape) for x in arrays]})")
+        return arrays, rows
+
+    def submit(self, feeds, timeout_ms=None):
+        """Enqueue one request; returns a concurrent.futures.Future whose
+        result is the list of per-request output arrays.  Raises
+        ServerBusyError synchronously when the queue is full and
+        RequestTooLargeError when rows exceed the largest bucket."""
+        if self._closed:
+            raise ServingError("engine is shut down")
+        arrays, rows = self._normalize(feeds)
+        self.bucketer.batch_bucket(rows)  # RequestTooLargeError past menu
+        arrays = self.bucketer.pad_request(arrays)
+        timeout_ms = (self.config.default_timeout_ms
+                      if timeout_ms is None else timeout_ms)
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1e3)
+        fut = concurrent.futures.Future()
+        req = Request(arrays, rows, fut, deadline=deadline,
+                      bucket_key=self.bucketer.bucket_key(arrays))
+        self.queue.offer(req)  # ServerBusyError when full
+        self.metrics.count_request()
+        return fut
+
+    def infer(self, feeds, timeout_ms=None):
+        """Blocking convenience: submit + wait.  The engine's deadline
+        machinery resolves the future with DeadlineExceededError, so the
+        host-side wait below is only a backstop (2x the deadline)."""
+        fut = self.submit(feeds, timeout_ms=timeout_ms)
+        wait = (None if timeout_ms is None
+                else max(0.1, 2.0 * float(timeout_ms) / 1e3))
+        return fut.result(timeout=wait)
+
+    def warmup(self, sample_feeds=None):
+        """Pre-compile every batch bucket for the given sample request (or
+        per-input trailing shapes from the first real request otherwise)."""
+        if sample_feeds is None:
+            return
+        arrays, _ = self._normalize(sample_feeds)
+        arrays = self.bucketer.pad_request(arrays)
+        for b in self.bucketer.batch_buckets:
+            batch = [np.broadcast_to(
+                a[:1], (b,) + tuple(a.shape[1:])).copy() for a in arrays]
+            self.cache.get(batch)
+
+    def stats(self):
+        """Serving metrics snapshot (the StatRegistry serving.* slice)."""
+        return self.metrics.snapshot()
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.batcher.shutdown()
+        self.queue.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def create_serving_engine(model, **kwargs):
+    """Convenience factory mirroring inference.create_predictor."""
+    return ServingEngine(model, config=ServingConfig(**kwargs))

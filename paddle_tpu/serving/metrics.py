@@ -1,0 +1,143 @@
+"""Serving metrics: first-class gauges/counters in the profiler registry.
+
+Every number the runtime tracks lands in the existing
+`profiler/monitor.py` `StatRegistry` (the platform/monitor.h STAT_* role),
+so `paddle_tpu.profiler.monitor.StatRegistry.instance().stats()` — and
+anything already scraping it — sees serving internals with no new
+plumbing.  Latency percentiles come from a bounded reservoir recomputed on
+record (serving batches are the slow path; a sort over <=2048 floats is
+noise next to a TPU dispatch).
+
+Metric names (all under the ``serving.`` prefix):
+
+- ``serving.requests_total``        submitted requests (accepted)
+- ``serving.rejected_busy``         admission rejections (queue full)
+- ``serving.rejected_deadline``     deadline-expired rejections
+- ``serving.queue_depth``           gauge: requests waiting right now
+- ``serving.batches_total``         TPU dispatches
+- ``serving.batch_rows_total``      real rows dispatched
+- ``serving.batch_padded_rows_total`` padding rows dispatched
+- ``serving.batch_fill_pct``        gauge: last batch's real/bucket %
+- ``serving.cache_hits`` / ``serving.cache_misses``  bucket-executable cache
+- ``serving.compiles_total``        AOT compiles (== distinct buckets)
+- ``serving.latency_p50_us`` / ``serving.latency_p99_us``  gauges
+"""
+import bisect
+import threading
+
+from ..profiler.monitor import StatRegistry
+
+PREFIX = "serving."
+
+REQUESTS_TOTAL = PREFIX + "requests_total"
+REJECTED_BUSY = PREFIX + "rejected_busy"
+REJECTED_DEADLINE = PREFIX + "rejected_deadline"
+QUEUE_DEPTH = PREFIX + "queue_depth"
+BATCHES_TOTAL = PREFIX + "batches_total"
+BATCH_ROWS_TOTAL = PREFIX + "batch_rows_total"
+BATCH_PADDED_ROWS_TOTAL = PREFIX + "batch_padded_rows_total"
+BATCH_FILL_PCT = PREFIX + "batch_fill_pct"
+CACHE_HITS = PREFIX + "cache_hits"
+CACHE_MISSES = PREFIX + "cache_misses"
+COMPILES_TOTAL = PREFIX + "compiles_total"
+LATENCY_P50_US = PREFIX + "latency_p50_us"
+LATENCY_P99_US = PREFIX + "latency_p99_us"
+
+
+class LatencyReservoir:
+    """Bounded sliding window of request latencies with exact percentiles
+    over the window (a sorted shadow list keeps the percentile read
+    O(1) and the insert O(window) — fine at serving rates)."""
+
+    def __init__(self, window=2048):
+        self._window = int(window)
+        self._ring = []
+        self._sorted = []
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def record(self, value):
+        with self._lock:
+            if len(self._ring) < self._window:
+                self._ring.append(value)
+            else:
+                old = self._ring[self._next]
+                self._ring[self._next] = value
+                self._next = (self._next + 1) % self._window
+                del self._sorted[bisect.bisect_left(self._sorted, old)]
+            bisect.insort(self._sorted, value)
+
+    def percentile(self, q):
+        """Nearest-rank percentile (exact over the window)."""
+        import math
+
+        with self._lock:
+            if not self._sorted:
+                return 0.0
+            idx = max(0, min(len(self._sorted) - 1,
+                             math.ceil(q / 100.0 * len(self._sorted)) - 1))
+            return self._sorted[idx]
+
+    def count(self):
+        with self._lock:
+            return len(self._ring)
+
+
+class ServingMetrics:
+    """One instance per ServingEngine; all writes go straight to the
+    process StatRegistry so concurrent engines aggregate (the reference's
+    STAT_ADD counters are process-global too)."""
+
+    def __init__(self, registry=None, window=2048):
+        self._reg = registry or StatRegistry.instance()
+        self._lat = LatencyReservoir(window)
+
+    def _stat(self, name):
+        return self._reg.get_stat(name)
+
+    # --- counters ---
+    def count_request(self):
+        self._stat(REQUESTS_TOTAL).increase()
+
+    def count_rejected_busy(self):
+        self._stat(REJECTED_BUSY).increase()
+
+    def count_rejected_deadline(self, n=1):
+        self._stat(REJECTED_DEADLINE).increase(n)
+
+    def count_cache(self, hit):
+        self._stat(CACHE_HITS if hit else CACHE_MISSES).increase()
+
+    def count_compile(self):
+        self._stat(COMPILES_TOTAL).increase()
+
+    # --- gauges ---
+    def set_queue_depth(self, depth):
+        self._stat(QUEUE_DEPTH).set(int(depth))
+
+    def observe_batch(self, rows, bucket_rows):
+        self._stat(BATCHES_TOTAL).increase()
+        self._stat(BATCH_ROWS_TOTAL).increase(int(rows))
+        self._stat(BATCH_PADDED_ROWS_TOTAL).increase(
+            int(bucket_rows) - int(rows))
+        if bucket_rows:
+            self._stat(BATCH_FILL_PCT).set(
+                round(100.0 * rows / bucket_rows, 1))
+
+    def observe_latency(self, seconds):
+        us = seconds * 1e6
+        self._lat.record(us)
+        self._stat(LATENCY_P50_US).set(round(self._lat.percentile(50), 1))
+        self._stat(LATENCY_P99_US).set(round(self._lat.percentile(99), 1))
+
+    # --- reads ---
+    def snapshot(self):
+        """All serving.* stats currently in the registry."""
+        return {k: v for k, v in self._reg.stats().items()
+                if k.startswith(PREFIX)}
+
+    def cache_hit_rate(self):
+        s = self._reg.stats()
+        hits = s.get(CACHE_HITS, 0)
+        total = hits + s.get(CACHE_MISSES, 0)
+        return (hits / total) if total else 0.0

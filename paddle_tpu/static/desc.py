@@ -80,7 +80,7 @@ class _SymbolicEnv:
     attention, residual adds over [b, s, h]) export where per-op fresh
     symbols could not."""
 
-    def __init__(self, block):
+    def __init__(self, block, amp_bf16=False):
         from jax import export as jax_export
 
         self.scope = jax_export.SymbolicScope()
@@ -88,6 +88,10 @@ class _SymbolicEnv:
         self._auto = 0
         self.avals = {}
         self.block = block
+        # static-AMP programs execute each op on _amp_cast_args-converted
+        # inputs; propagation must mirror that or the embedded HLO gets
+        # traced at dtypes the runtime never feeds it
+        self.amp_bf16 = bool(amp_bf16)
 
     def _sym(self, name):
         from jax import export as jax_export
@@ -154,6 +158,10 @@ class _SymbolicEnv:
             if a is None:
                 return None
             in_avals.append(a)
+        if self.amp_bf16:
+            in_avals = _amp_adjust_avals(op.type, in_avals)
+            if in_avals is None:
+                return None
         try:
             res = jax.eval_shape(op.fn, *in_avals)
         except Exception:
@@ -163,6 +171,22 @@ class _SymbolicEnv:
         for n, r in zip(outs, res):
             self.avals[n] = jax.ShapeDtypeStruct(r.shape, r.dtype)
         return in_avals
+
+
+def _amp_adjust_avals(op_type, avals):
+    """Dtype-map input avals through the executor's static-AMP cast policy
+    (`_amp_cast_args`): the runtime casts f32 >=2-D operands of bf16-listed
+    ops to bf16 (and bf16 operands of f32-listed ops back) BEFORE calling
+    op.fn, so propagation and embedded-HLO tracing must see the post-cast
+    dtypes or the export rejects the very arrays the executor feeds it."""
+    from .executor import _amp_cast_args
+
+    try:
+        res = jax.eval_shape(
+            lambda *a: tuple(_amp_cast_args(op_type, list(a))), *avals)
+        return [jax.ShapeDtypeStruct(r.shape, r.dtype) for r in res]
+    except Exception:
+        return None
 
 
 def program_to_desc(program):
@@ -187,7 +211,8 @@ def program_to_desc(program):
                 "state": _jsonable(dict(init.__dict__)),
             }
         vars_desc[n] = vd
-    env = _SymbolicEnv(block)
+    amp_bf16 = bool(getattr(program, "_amp_bf16", False))
+    env = _SymbolicEnv(block, amp_bf16=amp_bf16)
     ops_desc = []
     for op in block.ops:
         in_avals = env.infer_op(op)  # propagate even for builder ops
@@ -202,16 +227,17 @@ def program_to_desc(program):
             or op.type in _STRUCTURAL or op.fn is None,
         }
         if not od["rebuildable"]:
-            hlo = _try_export_op(op, block, in_avals)
+            hlo = _try_export_op(op, block, in_avals, amp_bf16=amp_bf16)
             if hlo is not None:
                 od["hlo"] = hlo
                 od["rebuildable"] = True
         ops_desc.append(od)
     return {"version": 1, "vars": vars_desc, "ops": ops_desc,
-            "rng_step_vars": list(getattr(program, "_rng_step_vars", []))}
+            "rng_step_vars": list(getattr(program, "_rng_step_vars", [])),
+            "amp_bf16": amp_bf16}
 
 
-def _try_export_op(op, block, in_avals=None):
+def _try_export_op(op, block, in_avals=None, amp_bf16=False):
     """Serialize an op's pure-jax fn as a portable StableHLO module (the
     generic desc-rebuild path for the ~300 static emitters + the vjp grad
     and optimizer-update closures).  Preferred avals come from the
@@ -258,6 +284,10 @@ def _try_export_op(op, block, in_avals=None):
                 avals.append(jax.ShapeDtypeStruct(tuple(dims), dt))
         except Exception:
             return None
+        if amp_bf16:
+            avals = _amp_adjust_avals(op.type, avals)
+            if avals is None:
+                return None
     try:
         try:
             exp = jax_export.export(jax.jit(op.fn),
@@ -307,6 +337,11 @@ def prune_forward(program, feed_names, fetch_names):
     blk = clone.global_block()
     blk.vars = src.vars
     blk.ops = list(reversed(kept_rev))
+    # execution-semantics flags ride along with the slice: without them a
+    # pruned AMP program would serialize (and serve) in pure f32
+    for attr in ("_amp_bf16", "_rng_step_vars"):
+        if hasattr(program, attr):
+            setattr(clone, attr, getattr(program, attr))
     return clone
 
 
@@ -363,6 +398,10 @@ def desc_to_program(desc):
         op.out_order = list(od["out_order"])
     if desc.get("rng_step_vars"):
         program._rng_step_vars = list(desc["rng_step_vars"])
+    if desc.get("amp_bf16"):
+        # the executor re-applies the cast policy; embedded HLO was traced
+        # at the post-cast dtypes, so both rebuild paths line up
+        program._amp_bf16 = True
     return program
 
 
@@ -542,13 +581,16 @@ def _b_batch_norm(attrs, ctx):
 
     def fn(v, sc, b, m, va):
         shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        # mirror the emitter: stats and normalization in f32 even for
+        # bf16 inputs (AMP), output cast back to the input dtype
+        vf = v.astype(jnp.float32) if v.dtype != jnp.float32 else v
         if is_test:
             mean_u, var_u = m, va
         else:
-            mean_u = jnp.mean(v, axis=reduce_axes)
-            var_u = jnp.mean(jnp.square(v), axis=reduce_axes) \
+            mean_u = jnp.mean(vf, axis=reduce_axes)
+            var_u = jnp.mean(jnp.square(vf), axis=reduce_axes) \
                 - jnp.square(mean_u)
-        out = (v - mean_u.reshape(shape)) * jax.lax.rsqrt(
+        out = (vf - mean_u.reshape(shape)) * jax.lax.rsqrt(
             var_u.reshape(shape) + eps)
         out = out * sc.reshape(shape) + b.reshape(shape)
         # mirror nn_static._BN_ACTS, not just relu
@@ -558,6 +600,7 @@ def _b_batch_norm(attrs, ctx):
             out = jnp.tanh(out)
         elif act == "sigmoid":
             out = jax.nn.sigmoid(out)
+        out = out.astype(v.dtype)
         if is_test:
             return out
         # mirror the emitter: training updates running stats in place

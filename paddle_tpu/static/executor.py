@@ -149,6 +149,7 @@ class CompiledBlock:
         self._checked_ops = []
         self._op_order, self._donate_feeds = self._plan(block)
         self._jitted = None
+        self._donated = False
 
     def _ensure_jitted(self, feeds, params):
         """Build the jitted callable on first run, when concrete feed/param
@@ -182,6 +183,7 @@ class CompiledBlock:
                 donate = False
         if donate:
             self._jitted = jax.jit(self._run_block, donate_argnums=(0,))
+            self._donated = True
         else:
             self._jitted = jax.jit(self._run_block)
 
@@ -303,21 +305,42 @@ class CompiledBlock:
     def _coerce_feeds(self, feed):
         return coerce_feeds(self.feed_names, feed)
 
+    @staticmethod
+    def _caller_owned(v):
+        """True for feeds handed to us as live device arrays: donating
+        those buffers would invalidate the CALLER's array (deleted-buffer
+        errors on the next use), unlike the fresh arrays jnp.asarray makes
+        from host feeds."""
+        if isinstance(v, Tensor):
+            v = v._data
+        return isinstance(v, jax.Array)
+
+    def _place_inputs(self, feeds, params):
+        """Place inputs on the mesh (committed single-device arrays from
+        startup would otherwise conflict with the jit's in_shardings);
+        after step 1 the scope holds jit outputs already placed by
+        out_shardings, so matching arrays pass through untouched."""
+        if self._in_shardings is None:
+            return feeds, params
+        feed_sh, param_sh = self._in_shardings
+        feeds = {n: jax.device_put(v, feed_sh[n])
+                 for n, v in feeds.items()}
+        params = {n: v if getattr(v, "sharding", None) == param_sh[n]
+                  else jax.device_put(v, param_sh[n])
+                  for n, v in params.items()}
+        return feeds, params
+
     def run(self, feed, scope):
         feeds = self._coerce_feeds(feed)
         params = {n: scope.get(n) for n in self.param_names}
         self._ensure_jitted(feeds, params)
-        if self._in_shardings is not None:
-            # place inputs on the mesh (committed single-device arrays from
-            # startup would otherwise conflict with the jit's in_shardings);
-            # after step 1 the scope holds jit outputs already placed by
-            # out_shardings, so matching arrays pass through untouched
-            feed_sh, param_sh = self._in_shardings
-            feeds = {n: jax.device_put(v, feed_sh[n])
+        if self._donated:
+            # the donation plan aliases feed buffers into outputs; give it
+            # an on-device copy of caller-owned arrays so the caller's
+            # buffers stay alive (host feeds are already private copies)
+            feeds = {n: jnp.copy(v) if self._caller_owned(feed[n]) else v
                      for n, v in feeds.items()}
-            params = {n: v if getattr(v, "sharding", None) == param_sh[n]
-                      else jax.device_put(v, param_sh[n])
-                      for n, v in params.items()}
+        feeds, params = self._place_inputs(feeds, params)
         try:
             outs, updated, nonfinite = self._jitted(feeds, params)
         except KeyError as e:
@@ -368,8 +391,21 @@ class CompiledBlock:
                     body, params, None, length=n_steps)
                 return outs, last_p, masks
 
-            jitted = jax.jit(multi, donate_argnums=(1,))
+            if self.mesh is not None:
+                # GSPMD programs keep their partitioning across the chain:
+                # same in-shardings as run(); fetches stack over steps but
+                # stay replicated, and params keep their dist_spec layout,
+                # so out_shardings carries over structurally unchanged
+                in_sh, out_sh = self._build_shardings(feeds, params)
+                self._in_shardings = self._in_shardings or in_sh
+                jitted = jax.jit(multi, in_shardings=in_sh,
+                                 out_shardings=out_sh,
+                                 donate_argnums=(1,))
+            else:
+                jitted = jax.jit(multi, donate_argnums=(1,))
             self._chained[n_steps] = jitted
+        if self.mesh is not None:
+            feeds, params = self._place_inputs(feeds, params)
         outs, last_p, masks = jitted(feeds, params)
         if self._check_nan:
             mask = np.asarray(masks).any(axis=0)
@@ -478,7 +514,15 @@ class Executor:
             outs = None
             for _ in range(int(n_steps)):
                 outs = cb.run(feed, scope)
-            return outs
+                # per-step RNG bump, as the scan path does in its carry —
+                # otherwise every chained step reuses one dropout mask
+                for n in getattr(program, "_rng_step_vars", ()):
+                    v = scope.get(n)
+                    if v is not None:
+                        scope.set(n, v + 1)
+            if return_numpy:
+                return outs
+            return [Tensor(o) for o in outs]
         outs = cb.run_chained(feed, scope, int(n_steps))
         if return_numpy:
             return outs

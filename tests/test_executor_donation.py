@@ -63,6 +63,27 @@ def test_donation_still_happens_when_aliasable():
         assert info.donate_argnums == (0,)
 
 
+def test_device_array_feeds_survive_donation():
+    """Caller-owned jax.Array feeds must not be invalidated by the feed
+    donation plan: the SAME jnp feed dict runs twice, bit-identically, and
+    the caller's array is still readable afterwards (regression: the
+    second run raised 'buffer has been deleted or donated')."""
+    import jax.numpy as jnp
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 8])
+        y = x * 2.0 + 1.0
+    exe = static.Executor()
+    arr = jnp.ones((8, 8), jnp.float32)
+    feed = {"x": arr}
+    out1 = exe.run(main, feed=feed, fetch_list=[y])[0]
+    out2 = exe.run(main, feed=feed, fetch_list=[y])[0]
+    np.testing.assert_array_equal(out1, out2)
+    np.testing.assert_array_equal(np.asarray(arr),
+                                  np.ones((8, 8), np.float32))
+
+
 def test_executor_cost_analysis_reports_flops():
     paddle.seed(0)
     main, startup, loss = _build_train_prog()

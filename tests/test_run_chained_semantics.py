@@ -1,0 +1,105 @@
+"""Executor.run_chained semantics: GSPMD partitioning and per-step RNG.
+
+Two contracts the scan path already kept but the other paths lost:
+- a mesh-annotated (GSPMD) program keeps its partitioning through
+  run_chained (CompiledBlock.run_chained jits with the same in/out
+  shardings run() uses, instead of silently single-devicing the chain);
+- the pipelined host-loop fallback advances `program._rng_step_vars`
+  once per chained step, so dropout draws a fresh mask each step exactly
+  like the scan carry does.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _train_prog():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 16])
+        y = static.data("y", [8, 1])
+        h = static.nn.relu(static.nn.fc(x, 16))
+        out = static.nn.fc(h, 1)
+        loss = static.nn.mean((out - y) * (out - y))
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _losses(chained, mesh=False, n_steps=3):
+    paddle.seed(0)
+    main, startup, loss = _train_prog()
+    if mesh:
+        from paddle_tpu.distributed.fleet.meta_optimizers \
+            .meta_optimizer_base import record_mesh_axis
+
+        record_mesh_axis(main, "data", None)  # absorb all visible devices
+    scope = static.Scope()
+    exe = static.Executor()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 16).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    if chained:
+        outs = exe.run_chained(main, feed=feed, fetch_list=[loss],
+                               n_steps=n_steps, scope=scope)
+        return np.asarray(outs[0]).reshape(n_steps), exe, scope, main
+    vals = [float(np.asarray(
+        exe.run(main, feed=feed, fetch_list=[loss], scope=scope)[0]))
+        for _ in range(n_steps)]
+    return np.asarray(vals), exe, scope, main
+
+
+def test_run_chained_honors_mesh():
+    """run_chained on a mesh-annotated program must (a) still be served by
+    a mesh CompiledBlock, (b) keep params living with their jit-placed
+    sharding, and (c) match the per-step run() losses."""
+    ref, *_ = _losses(chained=False, mesh=True)
+    got, exe, scope, main = _losses(chained=True, mesh=True)
+    cbs = [cb for cb in exe._cache.values() if getattr(cb, "mesh", None)]
+    assert cbs, "mesh program was not served by a GSPMD block"
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+    # a param written back by the chain is still a committed mesh array
+    cb = cbs[0]
+    p = scope.get(cb.param_names[0])
+    assert hasattr(p, "sharding")
+
+
+def test_run_chained_matches_stepped_runs_single_device():
+    ref, *_ = _losses(chained=False, mesh=False)
+    got, *_ = _losses(chained=True, mesh=False)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+
+def test_run_chained_fallback_advances_rng(monkeypatch):
+    """Blocks without run_chained (the pipelined path) fall back to a host
+    loop in Executor.run_chained; that loop must bump the dropout step
+    counters per step or every chained step reuses ONE mask."""
+    paddle.seed(0)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 64])
+        h = static.nn.dropout(x, 0.5)
+    assert getattr(main, "_rng_step_vars", None), "dropout registered no counter"
+    (ctr,) = main._rng_step_vars
+    exe = static.Executor()
+    scope = static.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((4, 64), np.float32)}
+    cb = exe._get_block(main, feed, [h], scope)
+
+    class NoChain:  # PipelinedBlock stand-in: run() only
+        def run(self, feed, scope):
+            return cb.run(feed, scope)
+
+    monkeypatch.setattr(exe, "_get_block", lambda *a, **k: NoChain())
+    start = int(np.asarray(scope.get(ctr)).reshape(()))
+    first = exe.run_chained(main, feed=feed, fetch_list=[h], n_steps=1,
+                            scope=scope)[0]
+    second = exe.run_chained(main, feed=feed, fetch_list=[h], n_steps=1,
+                             scope=scope)[0]
+    end = int(np.asarray(scope.get(ctr)).reshape(()))
+    assert end == start + 2, (start, end)
+    # fresh counter value => fresh mask
+    assert not np.array_equal(first, second)
