@@ -56,6 +56,7 @@ from . import device  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
+from . import generation  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import _C_ops  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402

@@ -1,0 +1,48 @@
+"""paddle_tpu.generation — paged-KV continuous-batching decode engine.
+
+The autoregressive layer above `paddle_tpu.serving`: where serving
+batches fixed-shape one-shot forward passes, generation runs the LLM
+inference loop — a paged KV cache (page pool + per-sequence page
+tables), paged decode attention (Pallas TPU kernel with a pure-jnp
+reference), a continuous-batching scheduler with a prefill/decode split
+over fixed slots, and a sampling engine with per-request streaming.
+See docs/GENERATION.md for layouts, the step diagram, and the oracle
+strategy.
+
+Quick start::
+
+    from paddle_tpu import generation
+
+    model = generation.TinyCausalLM(vocab_size=64)   # or any protocol model
+    engine = generation.GenerationEngine(
+        model, generation.GenerationConfig(max_decode_slots=8,
+                                           num_pages=256, page_size=16))
+    handle = engine.submit([1, 2, 3], max_new_tokens=32,
+                           sampling=generation.SamplingParams(temperature=0.8,
+                                                              top_p=0.95,
+                                                              seed=7))
+    for token in handle.tokens():        # streams as sampled
+        print(token)
+    result = handle.result()             # GenerationResult
+    engine.shutdown()
+"""
+from .decode_attention import (dense_causal_reference,
+                               paged_decode_attention,
+                               paged_decode_attention_reference)
+from .engine import (GenerationConfig, GenerationEngine, GenerationHandle,
+                     GenerationResult)
+from .kv_cache import OutOfPagesError, PagedKVCache
+from .metrics import GenerationMetrics
+from .model import TinyCausalLM
+from .sampling import SamplingParams, sample_token
+from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
+                        SequenceState)
+
+__all__ = [
+    "GenerationEngine", "GenerationConfig", "GenerationHandle",
+    "GenerationResult", "PagedKVCache", "OutOfPagesError",
+    "paged_decode_attention", "paged_decode_attention_reference",
+    "dense_causal_reference", "ContinuousBatchingScheduler",
+    "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",
+    "GenerationMetrics", "TinyCausalLM",
+]

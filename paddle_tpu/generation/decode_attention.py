@@ -1,0 +1,91 @@
+"""Paged decode attention: one query token per sequence over a paged KV
+cache, with two interchangeable implementations:
+
+- the Pallas TPU kernel (ops/pallas/paged_attention.py) — page-table DMA
+  via scalar prefetch, online softmax across the page axis;
+- a pure-jnp gather reference — gathers each sequence's pages into a
+  padded [B, Kmax, H, D] view and runs masked dense attention.
+
+The reference is not just a fallback: it IS the correctness oracle.  Its
+masking is built so that padded positions contribute *exactly* zero
+(``exp(NEG_INF - m)`` underflows to 0.0, and ``x + 0.0 == x`` in floats),
+which makes its fp32 output bit-comparable to a dense causal
+full-recompute over the real tokens — the property
+tests/test_generation.py asserts.  Tier-1 CPU tests therefore exercise
+the same semantics the TPU kernel implements.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
+                                     seq_lens, scale=None):
+    """Pure-jnp paged decode attention.
+
+    q: [B, H, D] — the single query token per sequence.
+    k_pool, v_pool: [P, page_size, H, D] (one layer's pool).
+    page_tables: [B, max_pages] int32, unused slots padded with 0.
+    seq_lens: [B] int32 live token counts.
+    Returns [B, H, D].
+    """
+    q = jnp.asarray(q)
+    k_pool = jnp.asarray(k_pool)
+    v_pool = jnp.asarray(v_pool)
+    pt = jnp.asarray(page_tables, jnp.int32)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    b, h, d = q.shape
+    page_size = k_pool.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # gather pages: [B, max_pages, page_size, H, D] -> [B, Kmax, H, D]
+    k = k_pool[pt].reshape(b, -1, h, d)
+    v = v_pool[pt].reshape(b, -1, h, d)
+    kmax = k.shape[1]
+    logits = jnp.einsum("bhd,bkhd->bhk", q, k) * scale
+    live = jnp.arange(kmax, dtype=jnp.int32)[None, :] < lens[:, None]
+    logits = jnp.where(live[:, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    # an empty sequence (len 0) has every key masked: softmax over the
+    # all-NEG_INF row is uniform garbage — emit zeros instead, matching
+    # the kernel's safe_l guard (where() selects, so len>0 rows keep
+    # their weights bitwise)
+    weights = jnp.where(lens[:, None, None] > 0, weights, 0.0)
+    return jnp.einsum("bhk,bkhd->bhd", weights, v)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
+                           scale=None, use_kernel=None, interpret=None):
+    """Dispatch: the Pallas kernel on TPU (or when forced, e.g. interpret
+    mode in tests), the jnp reference elsewhere."""
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    if not use_kernel:
+        return paged_decode_attention_reference(
+            q, k_pool, v_pool, page_tables, seq_lens, scale=scale)
+    from ..ops.pallas.paged_attention import paged_decode_attention_kernel
+
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    return paged_decode_attention_kernel(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        page_tables, seq_lens, scale, interpret=interpret)
+
+
+def dense_causal_reference(q, k, v, scale=None):
+    """Dense causal full-recompute attention — the oracle the paged path
+    is measured against.  q, k, v: [T, H, D] for ONE sequence; returns
+    [T, H, D] where row t attends over keys [0, t]."""
+    q = jnp.asarray(q)
+    t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("qhd,khd->hqk", q, jnp.asarray(k)) * scale
+    causal = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+    logits = jnp.where(causal[None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", weights, jnp.asarray(v))

@@ -1,0 +1,409 @@
+"""GenerationEngine: paged-KV continuous-batching autoregressive decode.
+
+Composes the subsystem end to end::
+
+    submit(prompt) -> AdmissionQueue -> scheduler slots -> PREFILL (dense
+       <- GenerationHandle (stream)                        causal, KV -> pages)
+             ^                                          -> DECODE steps
+             |   token-by-token                            (paged attention,
+             +---------------------------------------------sample, stream)
+
+The model is anything implementing the decode protocol below; the engine
+owns the KV pages, the schedule, sampling, and metrics.  Greedy decode
+through this engine is TOKEN-IDENTICAL to naive sequential full-recompute
+generation — continuous batching and paging change the cost of a token,
+never its value (the oracle tests/test_generation.py enforces).
+
+Model protocol (duck-typed)::
+
+    model.num_layers, model.num_heads, model.head_dim, model.vocab_size
+    model.prefill(tokens[T])  -> (last_logits [V], k [L,T,H,D], v [L,T,H,D])
+    model.decode(tokens[B], positions[B], attend) -> logits [B, V]
+        # calls, per layer:  attend(layer, q[B,H,D], k[B,H,D], v[B,H,D])
+        #                      -> attention output [B,H,D]
+        # the engine's attend() appends k/v to the paged cache and runs
+        # paged decode attention over each sequence's page table
+
+Overload behavior is inherited from serving: a full queue raises
+ServerBusyError at submit, lapsed deadlines resolve handles with
+DeadlineExceededError, and page exhaustion preempts the youngest
+sequences (recompute-style) before ever failing a request.
+"""
+import queue
+import threading
+import time
+
+import concurrent.futures
+
+import numpy as np
+
+from ..serving.admission import ServingError
+from .decode_attention import paged_decode_attention
+from .kv_cache import OutOfPagesError, PagedKVCache
+from .metrics import GenerationMetrics, StepTimer
+from .sampling import SamplingParams, sample_token
+from .scheduler import ContinuousBatchingScheduler, GenerationRequest
+
+
+class GenerationConfig:
+    """Engine knobs; defaults suit a small CPU demo (docs/GENERATION.md
+    documents each)."""
+
+    def __init__(self, max_decode_slots=8, num_pages=256, page_size=16,
+                 queue_depth=64, default_timeout_ms=None,
+                 default_max_new_tokens=16, use_kernel=None,
+                 kv_dtype=np.float32):
+        self.max_decode_slots = int(max_decode_slots)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.queue_depth = int(queue_depth)
+        self.default_timeout_ms = default_timeout_ms
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.use_kernel = use_kernel  # None: auto (Pallas on TPU)
+        self.kv_dtype = kv_dtype
+
+
+class GenerationResult:
+    """Final outcome of one request."""
+
+    __slots__ = ("token_ids", "finish_reason", "prompt_len", "preemptions")
+
+    def __init__(self, token_ids, finish_reason, prompt_len, preemptions):
+        self.token_ids = list(token_ids)
+        self.finish_reason = finish_reason  # "stop" | "length"
+        self.prompt_len = prompt_len
+        self.preemptions = preemptions
+
+    def __repr__(self):
+        return (f"GenerationResult(tokens={self.token_ids}, "
+                f"finish_reason={self.finish_reason!r})")
+
+
+class GenerationHandle:
+    """Per-request streaming future.
+
+    `result(timeout)` blocks for the final GenerationResult;
+    `tokens(timeout)` iterates token ids AS THEY ARE SAMPLED (ends on
+    completion; raises the typed error on failure).  Duck-types the
+    Future surface the AdmissionQueue touches (done/set_exception), so
+    queue-side deadline reaping resolves the stream too."""
+
+    _DONE = object()
+
+    def __init__(self):
+        self._fut = concurrent.futures.Future()
+        self._events = queue.SimpleQueue()
+
+    # --- engine side ---
+    def _push_token(self, token):
+        self._events.put(int(token))
+
+    def _finish(self, result):
+        if not self._fut.done():
+            self._fut.set_result(result)
+        self._events.put(self._DONE)
+
+    def set_exception(self, exc):
+        try:
+            self._fut.set_exception(exc)
+        except concurrent.futures.InvalidStateError:
+            return
+        self._events.put(self._DONE)
+
+    # --- client side ---
+    def done(self):
+        return self._fut.done()
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def exception(self, timeout=None):
+        return self._fut.exception(timeout)
+
+    def tokens(self, timeout=None):
+        """Yield token ids as they stream; `timeout` bounds the wait for
+        EACH token (queue.Empty on a stall)."""
+        while True:
+            ev = self._events.get(timeout=timeout)
+            if ev is self._DONE:
+                break
+            yield ev
+        # surface the typed failure to stream consumers as well
+        exc = self._fut.exception(timeout=0)
+        if exc is not None:
+            raise exc
+
+
+class GenerationEngine:
+    """Paged-KV continuous-batching decode engine over a protocol model."""
+
+    _IDLE_POLL_S = 0.02
+
+    def __init__(self, model, config=None, metrics=None, start=True):
+        self.model = model
+        self.config = config or GenerationConfig()
+        self.metrics = metrics or GenerationMetrics()
+        self.cache = PagedKVCache(
+            model.num_layers, model.num_heads, model.head_dim,
+            num_pages=self.config.num_pages,
+            page_size=self.config.page_size,
+            dtype=self.config.kv_dtype)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.cache, num_slots=self.config.max_decode_slots,
+            queue_depth=self.config.queue_depth, metrics=self.metrics)
+        self._lock = threading.Lock()  # one stepper at a time
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self.start()
+
+    # --------------------------- client API -------------------------
+    def submit(self, prompt, max_new_tokens=None, sampling=None,
+               stop_tokens=(), timeout_ms=None):
+        """Enqueue one prompt; returns a GenerationHandle immediately.
+        Raises ServerBusyError (queue full) / RequestTooLargeError
+        (prompt can never fit the page pool) synchronously."""
+        if self._closed:
+            raise ServingError("generation engine is shut down")
+        if max_new_tokens is None:
+            max_new_tokens = self.config.default_max_new_tokens
+        if sampling is None:
+            sampling = SamplingParams()
+        timeout_ms = (self.config.default_timeout_ms
+                      if timeout_ms is None else timeout_ms)
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1e3)
+        max_pos = getattr(self.model, "max_positions", None)
+        if max_pos is not None and len(prompt) + max_new_tokens > max_pos:
+            from ..serving.admission import RequestTooLargeError
+
+            raise RequestTooLargeError(
+                f"prompt of {len(prompt)} + max_new_tokens="
+                f"{max_new_tokens} exceeds the model's max_positions="
+                f"{max_pos}")
+        handle = GenerationHandle()
+        req = GenerationRequest(prompt, handle, sampling,
+                                max_new_tokens=max_new_tokens,
+                                stop_tokens=stop_tokens, deadline=deadline)
+        self.scheduler.submit(req)
+        self.metrics.count_request()
+        return handle
+
+    def generate(self, prompt, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, **kw).result()
+
+    def stats(self):
+        """generation.* metrics snapshot + live cache stats."""
+        snap = self.metrics.snapshot()
+        snap.update({"cache." + k: v for k, v in self.cache.stats().items()})
+        return snap
+
+    # --------------------------- stepping ---------------------------
+    def step(self):
+        """One scheduler step: admit+prefill, then one decode step for
+        every active sequence.  Returns the number of sequences that
+        advanced (0 == idle).  Thread-safe; the background worker uses
+        exactly this."""
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self):
+        from ..profiler import RecordEvent
+
+        for state in self.scheduler.admit():
+            self._prefill(state)
+        self._reap_deadlines()
+        active = self.scheduler.active()
+        if not active:
+            self._observe_occupancy()
+            return 0
+        with StepTimer() as timer:
+            with RecordEvent("generation::decode_step"):
+                active = self._ensure_step_capacity(active)
+                if not active:
+                    return 0
+                logits = self._decode(active)
+                for state, row in zip(active, logits):
+                    self._on_logits(state, row)
+        self.metrics.observe_step(len(active), timer.seconds)
+        self._observe_occupancy()
+        return len(active)
+
+    def run_until_idle(self, max_steps=100000):
+        """Drive step() until queue+slots drain (tests/benchmarks)."""
+        steps = 0
+        while (self.scheduler.active() or self.scheduler.pending_count()):
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"not idle after {max_steps} steps")
+        return steps
+
+    # --------------------------- internals --------------------------
+    def _prefill(self, state):
+        from ..profiler import RecordEvent
+
+        try:
+            with RecordEvent("generation::prefill"):
+                tokens = np.asarray(state.tokens, np.int32)
+                last_logits, k, v = self.model.prefill(tokens)
+                self.cache.append_prefill(state.seq_id, k, v)
+        except OutOfPagesError as e:
+            # a lone sequence that outgrew the whole pool: typed failure
+            self.scheduler.retire(state)
+            state.handle.set_exception(e)
+            return
+        self.metrics.count_prefill(len(state.tokens))
+        # prefill's last-position logits ARE the next-token logits: new
+        # prompts sample their first token here, and a preempted sequence
+        # resumes exactly where its decode left off
+        self._on_logits(state, last_logits)
+
+    def _reap_deadlines(self):
+        now = time.monotonic()
+        for state in self.scheduler.active():
+            if state.request.expired(now):
+                self.scheduler.retire(state)
+                state.request.reject_expired()
+                self.metrics.count_rejected_deadline()
+
+    def _ensure_step_capacity(self, active):
+        """Reserve-ability check for one token per active sequence;
+        preempts youngest-first, ONE victim at a time with the shortfall
+        recomputed after each (a victim's own page need leaves the books
+        with it — a batchwide shortfall computed up front would preempt
+        too much or give up while preemption could still succeed).
+        Returns the surviving active list (slot order)."""
+        while True:
+            active = self.scheduler.active()
+            if not active:
+                return active
+            need = sum(self.cache.pages_needed(s.seq_id, 1) for s in active)
+            if need <= self.cache.num_free_pages:
+                return active
+            victim = self.scheduler.preempt_youngest()
+            if victim is not None:
+                self.metrics.count_preempted()
+                continue
+            # a lone sequence the pool cannot grow: typed failure
+            lone = active[0]
+            self.scheduler.retire(lone)
+            lone.handle.set_exception(OutOfPagesError(
+                f"sequence of {len(lone.tokens)} tokens needs another "
+                f"page and the pool ({self.cache.num_pages} pages of "
+                f"{self.cache.page_size}) has none free even with every "
+                f"other sequence preempted"))
+
+    def _decode(self, active):
+        seq_ids = [s.seq_id for s in active]
+        positions = np.asarray(
+            [self.cache.reserve(s.seq_id, 1) for s in active], np.int32)
+        tokens = np.asarray([s.tokens[-1] for s in active], np.int32)
+        # page tables/lengths cannot change within the step (every page
+        # this step touches was just reserved): build them once, not per
+        # layer
+        pt, lens = self.cache.gather_block_tables(seq_ids)
+
+        def attend(layer, q, k_new, v_new):
+            k_new = np.asarray(k_new)
+            v_new = np.asarray(v_new)
+            for i, sid in enumerate(seq_ids):
+                self.cache.write_token(sid, layer, int(positions[i]),
+                                       k_new[i], v_new[i])
+            return paged_decode_attention(
+                q, self.cache.k_pool[layer], self.cache.v_pool[layer],
+                pt, lens, use_kernel=self.config.use_kernel)
+
+        return np.asarray(self.model.decode(tokens, positions, attend))
+
+    def _on_logits(self, state, logits_row):
+        """Sample the next token for `state`, stream it, and finish the
+        sequence when a stop condition fires."""
+        from ..profiler import RecordEvent
+
+        req = state.request
+        if state.n_generated >= req.max_new_tokens:
+            self._finish(state, "length")
+            return
+        with RecordEvent("generation::sample"):
+            token = sample_token(np.asarray(logits_row), req.params,
+                                 state.rng)
+        if token in req.stop_tokens:
+            self._finish(state, "stop")
+            return
+        state.tokens.append(token)
+        state.n_generated += 1
+        state.handle._push_token(token)
+        self.metrics.count_token()
+        if state.n_generated >= req.max_new_tokens:
+            self._finish(state, "length")
+
+    def _finish(self, state, reason):
+        self.scheduler.retire(state)
+        req = state.request
+        result = GenerationResult(
+            state.tokens[len(req.prompt):], reason, len(req.prompt),
+            state.preemptions)
+        state.handle._finish(result)
+        self.metrics.count_finished()
+
+    def _observe_occupancy(self):
+        self.metrics.observe_occupancy(
+            len(self.scheduler.active()), self.scheduler.num_slots,
+            self.cache.utilization())
+
+    # --------------------------- lifecycle --------------------------
+    def start(self):
+        """Start the background stepping worker (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name="generation-engine", daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                advanced = self.step()
+            except Exception as e:  # noqa: BLE001 — a poisoned step must
+                # not strand clients on a dead worker: the batch fails as
+                # a unit (DynamicBatcher._dispatch semantics) and the
+                # loop keeps draining the queue with typed errors.  The
+                # cleanup takes the step lock: a client thread may be
+                # driving step() concurrently (supported), and retiring
+                # under its feet would free pages mid-step.
+                with self._lock:
+                    for state in self.scheduler.active():
+                        self.scheduler.retire(state)
+                        state.handle.set_exception(e)
+                continue
+            if advanced == 0 and not self.scheduler.pending_count():
+                time.sleep(self._IDLE_POLL_S)
+
+    def shutdown(self, timeout=5.0):
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # fail live slots before the queued backlog so errors are typed.
+        # Under the step lock: a step outliving the join timeout (or a
+        # client-driven step()) must finish before its pages are freed —
+        # retiring mid-step would make attend() write into freed pages.
+        with self._lock:
+            for state in self.scheduler.active():
+                self.scheduler.retire(state)
+                state.handle.set_exception(ServingError(
+                    "generation engine shut down mid-decode"))
+        self.scheduler.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,0 +1,206 @@
+"""PagedKVCache: a preallocated page pool with per-sequence page tables.
+
+The TPU-native KV cache shape (Ragged Paged Attention, arxiv 2604.15464):
+instead of one contiguous [B, L_max, H, D] buffer per sequence — whose
+batch slots pin worst-case length forever — the cache is a single pool of
+fixed-size pages per layer, ``[num_pages, page_size, H, D]``, and every
+sequence owns an ordered list of page ids (its page table).  Appending a
+token touches at most one page; freeing a finished sequence returns whole
+pages to the free list, so memory utilization tracks the *actual* token
+count across ragged sequence lengths instead of ``B * L_max``.
+
+Pools live as host numpy arrays updated in place (the host-managed page
+table of a real serving stack); the decode kernel consumes them as device
+arrays together with the ``[B, max_pages]`` page-table / ``[B]`` seq-len
+tensors built by ``gather_block_tables``.  On-device pools with donated
+``dynamic_update_slice`` appends are the TPU production follow-up (see
+docs/GENERATION.md).
+"""
+import math
+
+import numpy as np
+
+
+class OutOfPagesError(RuntimeError):
+    """The page pool is exhausted: no free page for a required append.
+    The scheduler catches this to preempt (or reject) a sequence rather
+    than corrupting another sequence's pages."""
+
+
+class PagedKVCache:
+    """Paged KV storage for `num_layers` attention layers.
+
+    Layout per pool (one K pool and one V pool):
+        ``[num_layers, num_pages, page_size, num_heads, head_dim]``
+
+    Per sequence:
+        ``page_table``: ordered page ids; position `t` of the sequence
+        lives at ``page_table[t // page_size]``, row ``t % page_size``.
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, num_pages=256,
+                 page_size=16, dtype=np.float32):
+        if num_pages < 1 or page_size < 1:
+            raise ValueError("num_pages and page_size must be >= 1")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.dtype = np.dtype(dtype)
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k_pool = np.zeros(shape, self.dtype)
+        self.v_pool = np.zeros(shape, self.dtype)
+        # LIFO free list: a just-freed (cache-warm) page is reused first
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._tables = {}    # seq_id -> [page ids]
+        self._lens = {}      # seq_id -> token count
+
+    # ------------------------- allocation ---------------------------
+    def allocate(self, seq_id):
+        """Register an empty sequence (no pages until tokens land)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        self._tables[seq_id] = []
+        self._lens[seq_id] = 0
+
+    def free(self, seq_id):
+        """Return every page of `seq_id` to the pool."""
+        pages = self._tables.pop(seq_id)
+        del self._lens[seq_id]
+        self._free.extend(reversed(pages))
+
+    def has(self, seq_id):
+        return seq_id in self._tables
+
+    def _take_page(self):
+        if not self._free:
+            raise OutOfPagesError(
+                f"page pool exhausted ({self.num_pages} pages of "
+                f"{self.page_size} tokens all in use)")
+        return self._free.pop()
+
+    def pages_needed(self, seq_id, new_tokens):
+        """Pages an append of `new_tokens` to `seq_id` would allocate."""
+        length = self._lens[seq_id]
+        return (math.ceil((length + new_tokens) / self.page_size)
+                - len(self._tables[seq_id]))
+
+    def reserve(self, seq_id, new_tokens=1):
+        """Grow `seq_id`'s page table to hold `new_tokens` more tokens and
+        advance its length; returns the first new position.  All-or-
+        nothing: on OutOfPagesError nothing is allocated or advanced."""
+        need = self.pages_needed(seq_id, new_tokens)
+        if need > len(self._free):
+            raise OutOfPagesError(
+                f"need {need} pages for {new_tokens} tokens of "
+                f"{seq_id!r}, only {len(self._free)} free")
+        table = self._tables[seq_id]
+        for _ in range(need):
+            table.append(self._take_page())
+        start = self._lens[seq_id]
+        self._lens[seq_id] = start + new_tokens
+        return start
+
+    # --------------------------- writes -----------------------------
+    def write_token(self, seq_id, layer, pos, k, v):
+        """Write one token's K/V for one layer at position `pos` (already
+        reserved).  k, v: ``[num_heads, head_dim]``."""
+        if pos >= self._lens[seq_id]:
+            raise IndexError(
+                f"position {pos} not reserved for {seq_id!r} "
+                f"(len={self._lens[seq_id]})")
+        page = self._tables[seq_id][pos // self.page_size]
+        row = pos % self.page_size
+        self.k_pool[layer, page, row] = np.asarray(k, self.dtype)
+        self.v_pool[layer, page, row] = np.asarray(v, self.dtype)
+
+    def append(self, seq_id, k, v):
+        """Append one token across every layer.  k, v:
+        ``[num_layers, num_heads, head_dim]``.  Returns the position."""
+        pos = self.reserve(seq_id, 1)
+        page = self._tables[seq_id][pos // self.page_size]
+        row = pos % self.page_size
+        self.k_pool[:, page, row] = np.asarray(k, self.dtype)
+        self.v_pool[:, page, row] = np.asarray(v, self.dtype)
+        return pos
+
+    def append_prefill(self, seq_id, k, v):
+        """Append a whole prompt's K/V across every layer.  k, v:
+        ``[num_layers, T, num_heads, head_dim]``."""
+        k = np.asarray(k, self.dtype)
+        v = np.asarray(v, self.dtype)
+        n = k.shape[1]
+        start = self.reserve(seq_id, n)
+        table = self._tables[seq_id]
+        t = 0
+        while t < n:
+            pos = start + t
+            page = table[pos // self.page_size]
+            row = pos % self.page_size
+            take = min(self.page_size - row, n - t)
+            self.k_pool[:, page, row:row + take] = k[:, t:t + take]
+            self.v_pool[:, page, row:row + take] = v[:, t:t + take]
+            t += take
+        return start
+
+    # --------------------------- reads ------------------------------
+    def seq_len(self, seq_id):
+        return self._lens[seq_id]
+
+    def page_table(self, seq_id):
+        return tuple(self._tables[seq_id])
+
+    def gather_block_tables(self, seq_ids, max_pages=None):
+        """Batch the page tables for the decode kernel: returns
+        ``(page_tables [B, max_pages] int32, seq_lens [B] int32)``.
+        Unused slots are padded with page id 0 — always a valid DMA
+        target; the kernel's length mask zeroes their contribution."""
+        tables = [self._tables[s] for s in seq_ids]
+        if max_pages is None:
+            max_pages = max((len(t) for t in tables), default=1) or 1
+        pt = np.zeros((len(seq_ids), max_pages), np.int32)
+        for i, t in enumerate(tables):
+            if len(t) > max_pages:
+                raise ValueError(
+                    f"sequence {seq_ids[i]!r} spans {len(t)} pages > "
+                    f"max_pages={max_pages}")
+            pt[i, :len(t)] = t
+        lens = np.asarray([self._lens[s] for s in seq_ids], np.int32)
+        return pt, lens
+
+    # --------------------------- stats ------------------------------
+    @property
+    def num_free_pages(self):
+        return len(self._free)
+
+    @property
+    def pages_in_use(self):
+        return self.num_pages - len(self._free)
+
+    def utilization(self):
+        """Fraction of the pool's pages currently owned by sequences."""
+        return self.pages_in_use / self.num_pages
+
+    def token_utilization(self):
+        """Fraction of allocated page *rows* actually holding tokens —
+        the internal-fragmentation view (last page of each sequence is
+        partially full)."""
+        used = self.pages_in_use * self.page_size
+        if not used:
+            return 0.0
+        return sum(self._lens.values()) / used
+
+    def stats(self):
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "pages_in_use": self.pages_in_use,
+            "pages_free": self.num_free_pages,
+            "sequences": len(self._tables),
+            "tokens": int(sum(self._lens.values())),
+            "utilization_pct": round(100.0 * self.utilization(), 1),
+            "token_utilization_pct":
+                round(100.0 * self.token_utilization(), 1),
+        }

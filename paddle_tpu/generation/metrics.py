@@ -1,0 +1,121 @@
+"""Generation metrics: `generation.*` counters/gauges in the profiler
+StatRegistry (the serving.* pattern from serving/metrics.py, applied to
+the decode engine).
+
+Exposes the same three methods AdmissionQueue calls on its metrics
+object (`set_queue_depth`, `count_rejected_busy`,
+`count_rejected_deadline`), so the generation scheduler reuses the
+serving AdmissionQueue unchanged — bounded admission with typed
+busy/deadline rejection lands in `generation.*` instead of `serving.*`.
+
+Metric names:
+
+- ``generation.requests_total``       accepted generation requests
+- ``generation.rejected_busy``        admission rejections (queue full)
+- ``generation.rejected_deadline``    deadline-expired rejections
+- ``generation.queue_depth``          gauge: requests waiting
+- ``generation.steps_total``          engine decode steps
+- ``generation.prefill_tokens_total`` prompt tokens prefilled
+- ``generation.tokens_total``         tokens generated (sampled)
+- ``generation.finished_total``       sequences completed
+- ``generation.preempted_total``      sequences preempted (pages reclaimed)
+- ``generation.tokens_per_s``         gauge: decode throughput (EWMA)
+- ``generation.slot_occupancy_pct``   gauge: active / decode slots
+- ``generation.page_utilization_pct`` gauge: pool pages in use
+"""
+import time
+
+from ..profiler.monitor import StatRegistry
+
+PREFIX = "generation."
+
+REQUESTS_TOTAL = PREFIX + "requests_total"
+REJECTED_BUSY = PREFIX + "rejected_busy"
+REJECTED_DEADLINE = PREFIX + "rejected_deadline"
+QUEUE_DEPTH = PREFIX + "queue_depth"
+STEPS_TOTAL = PREFIX + "steps_total"
+PREFILL_TOKENS_TOTAL = PREFIX + "prefill_tokens_total"
+TOKENS_TOTAL = PREFIX + "tokens_total"
+FINISHED_TOTAL = PREFIX + "finished_total"
+PREEMPTED_TOTAL = PREFIX + "preempted_total"
+TOKENS_PER_S = PREFIX + "tokens_per_s"
+SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
+PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
+
+
+class GenerationMetrics:
+    """Writes generation.* to the process StatRegistry (STAT_ADD
+    parity: concurrent engines aggregate)."""
+
+    _EWMA = 0.3  # tokens/s smoothing: jittery host steps, stable gauge
+
+    def __init__(self, registry=None):
+        self._reg = registry or StatRegistry.instance()
+        self._rate = 0.0
+
+    def _stat(self, name):
+        return self._reg.get_stat(name)
+
+    # --- AdmissionQueue metrics interface ---
+    def set_queue_depth(self, depth):
+        self._stat(QUEUE_DEPTH).set(int(depth))
+
+    def count_rejected_busy(self):
+        self._stat(REJECTED_BUSY).increase()
+
+    def count_rejected_deadline(self, n=1):
+        self._stat(REJECTED_DEADLINE).increase(n)
+
+    # --- counters ---
+    def count_request(self):
+        self._stat(REQUESTS_TOTAL).increase()
+
+    def count_prefill(self, tokens):
+        self._stat(PREFILL_TOKENS_TOTAL).increase(int(tokens))
+
+    def count_finished(self):
+        self._stat(FINISHED_TOTAL).increase()
+
+    def count_token(self):
+        """One sampled-and-emitted token (prefill's first token and
+        decode tokens alike)."""
+        self._stat(TOKENS_TOTAL).increase()
+
+    def count_preempted(self, n=1):
+        self._stat(PREEMPTED_TOTAL).increase(n)
+
+    # --- per-step observation ---
+    def observe_step(self, tokens, step_seconds):
+        """One decode step that advanced `tokens` sequences (the token
+        counter itself is kept by count_token at the sampling site)."""
+        self._stat(STEPS_TOTAL).increase()
+        if step_seconds > 0:
+            inst = tokens / step_seconds
+            self._rate = (inst if self._rate == 0.0 else
+                          self._EWMA * inst + (1 - self._EWMA) * self._rate)
+            self._stat(TOKENS_PER_S).set(round(self._rate, 1))
+
+    def observe_occupancy(self, active, slots, page_utilization):
+        if slots:
+            self._stat(SLOT_OCCUPANCY_PCT).set(
+                round(100.0 * active / slots, 1))
+        self._stat(PAGE_UTILIZATION_PCT).set(
+            round(100.0 * page_utilization, 1))
+
+    # --- reads ---
+    def snapshot(self):
+        """All generation.* stats currently in the registry."""
+        return {k: v for k, v in self._reg.stats().items()
+                if k.startswith(PREFIX)}
+
+
+class StepTimer:
+    """Tiny helper: `with StepTimer() as t: ...; t.seconds`."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self._t0
+        return False

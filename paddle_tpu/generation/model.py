@@ -1,0 +1,154 @@
+"""TinyCausalLM: a small pure-jnp causal transformer implementing the
+GenerationEngine decode protocol — the reference model for tests,
+benchmarks, and the docs walkthrough.
+
+Two forward paths over the SAME weights:
+
+- `prefill(tokens)` — dense causal attention over the whole prefix
+  (full recompute), returning the last position's logits plus every
+  position's per-layer K/V for the paged cache;
+- `decode(tokens, positions, attend)` — one token per sequence, with
+  attention delegated to the engine's paged-KV callback.
+
+Both paths compute each position with identical math (same einsums, same
+masked-softmax construction — see decode_attention.py on why the masking
+is exact), which is what makes the engine's oracle meaningful: greedy
+decode through the paged path must reproduce full-recompute generation
+token for token.
+"""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .decode_attention import dense_causal_reference
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * scale + bias
+
+
+class TinyCausalLM:
+    """Pre-LN transformer decoder: emb -> [attn + MLP] x L -> LN -> head.
+
+    Deterministic per (seed, shape): weights come from one seeded
+    np.random.Generator, so tests and benches reproduce exactly.
+    """
+
+    def __init__(self, vocab_size=64, num_layers=2, num_heads=2,
+                 head_dim=8, mlp_ratio=2, max_positions=512, seed=0):
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.d_model = self.num_heads * self.head_dim
+        self.max_positions = int(max_positions)
+        rng = np.random.default_rng(seed)
+        d = self.d_model
+
+        def w(*shape, scale=None):
+            scale = scale or 1.0 / math.sqrt(shape[0])
+            return jnp.asarray(
+                rng.standard_normal(shape, np.float32) * scale)
+
+        self.tok_emb = w(self.vocab_size, d, scale=0.5)
+        self.pos_emb = w(self.max_positions, d, scale=0.1)
+        self.blocks = []
+        for _ in range(self.num_layers):
+            self.blocks.append({
+                "ln1_s": jnp.ones((d,), jnp.float32),
+                "ln1_b": jnp.zeros((d,), jnp.float32),
+                "wq": w(d, d), "wk": w(d, d), "wv": w(d, d), "wo": w(d, d),
+                "ln2_s": jnp.ones((d,), jnp.float32),
+                "ln2_b": jnp.zeros((d,), jnp.float32),
+                "w1": w(d, mlp_ratio * d), "b1": jnp.zeros(
+                    (mlp_ratio * d,), jnp.float32),
+                "w2": w(mlp_ratio * d, d), "b2": jnp.zeros((d,),
+                                                           jnp.float32),
+            })
+        self.ln_f_s = jnp.ones((d,), jnp.float32)
+        self.ln_f_b = jnp.zeros((d,), jnp.float32)
+        self.head = w(d, self.vocab_size)
+
+    # ----------------------- shared per-position math ----------------
+    def _embed(self, tokens, positions):
+        # loud failure over jnp's silent out-of-bounds gather clamp:
+        # position max_positions would reuse row max_positions-1 and
+        # generate wrong logits with no error
+        if int(jnp.max(positions)) >= self.max_positions:
+            raise ValueError(
+                f"position {int(jnp.max(positions))} >= max_positions="
+                f"{self.max_positions}")
+        return self.tok_emb[tokens] + self.pos_emb[positions]
+
+    def _qkv(self, blk, x):
+        """x: [N, d_model] -> q, k, v each [N, H, D]."""
+        n = x.shape[0]
+        h, dd = self.num_heads, self.head_dim
+        q = (x @ blk["wq"]).reshape(n, h, dd)
+        k = (x @ blk["wk"]).reshape(n, h, dd)
+        v = (x @ blk["wv"]).reshape(n, h, dd)
+        return q, k, v
+
+    def _mlp(self, blk, x):
+        hlay = jnp.maximum(x @ blk["w1"] + blk["b1"], 0.0)
+        return hlay @ blk["w2"] + blk["b2"]
+
+    def _logits(self, x):
+        return _layer_norm(x, self.ln_f_s, self.ln_f_b) @ self.head
+
+    # ----------------------------- prefill ---------------------------
+    def prefill(self, tokens):
+        """tokens: [T] ints.  Returns (last_logits [V],
+        k [L, T, H, D], v [L, T, H, D])."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        t = tokens.shape[0]
+        x = self._embed(tokens, jnp.arange(t, dtype=jnp.int32))
+        ks, vs = [], []
+        for blk in self.blocks:
+            hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+            q, k, v = self._qkv(blk, hn)
+            ks.append(k)
+            vs.append(v)
+            attn = dense_causal_reference(q, k, v)     # [T, H, D]
+            x = x + attn.reshape(t, self.d_model) @ blk["wo"]
+            x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                               blk["ln2_b"]))
+        logits = self._logits(x[t - 1:t])[0]
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    # ----------------------------- decode ----------------------------
+    def decode(self, tokens, positions, attend):
+        """tokens, positions: [B] ints.  attend(layer, q, k, v) performs
+        paged attention (engine-owned KV).  Returns logits [B, V]."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        positions = jnp.asarray(positions, jnp.int32)
+        b = tokens.shape[0]
+        x = self._embed(tokens, positions)
+        for li, blk in enumerate(self.blocks):
+            hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+            q, k, v = self._qkv(blk, hn)
+            attn = jnp.asarray(attend(li, q, k, v))    # [B, H, D]
+            x = x + attn.reshape(b, self.d_model) @ blk["wo"]
+            x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                               blk["ln2_b"]))
+        return self._logits(x)
+
+    # ------------------------ reference decode ------------------------
+    def greedy_reference(self, prompt, max_new_tokens, stop_tokens=()):
+        """Naive sequential generation, FULL recompute each step (the
+        oracle the engine is measured against): re-runs prefill over the
+        whole prefix for every token, no KV cache at all."""
+        stop = frozenset(int(s) for s in stop_tokens)
+        tokens = [int(t) for t in prompt]
+        out = []
+        for _ in range(max_new_tokens):
+            logits, _, _ = self.prefill(np.asarray(tokens, np.int32))
+            nxt = int(np.argmax(np.asarray(logits)))
+            if nxt in stop:
+                break
+            tokens.append(nxt)
+            out.append(nxt)
+        return out

@@ -1,0 +1,70 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+Host-side numpy on one [V] logits row per sequence per step — the
+sampler is never the bottleneck next to a TPU decode dispatch, and numpy
+keeps it deterministic per request: each request carries its own
+``np.random.Generator`` seeded from ``SamplingParams.seed``, so a given
+(model, prompt, params) pair replays the same tokens regardless of which
+other sequences share its batch.  That independence is what lets the
+continuous-batching oracle demand token-identical output.
+"""
+import numpy as np
+
+
+class SamplingParams:
+    """Per-request sampling knobs.
+
+    temperature == 0 means greedy (argmax; top_k/top_p ignored).
+    top_k: keep the k highest-probability tokens (None/0 disables).
+    top_p: smallest prefix of the sorted distribution with cumulative
+        probability >= top_p (nucleus; None/1.0 disables).
+    seed: per-request RNG seed (None draws one from the global RNG —
+        still recorded on the params so a run can be replayed).
+    """
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=0.0, top_k=None, top_p=None, seed=None):
+        self.temperature = float(temperature)
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        self.top_k = None if not top_k else int(top_k)
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.top_p = None if top_p is None else float(top_p)
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if seed is None:
+            seed = int(np.random.default_rng().integers(0, 2**31 - 1))
+        self.seed = int(seed)
+
+    @property
+    def greedy(self):
+        return self.temperature == 0.0
+
+    def make_rng(self):
+        return np.random.default_rng(self.seed)
+
+
+def sample_token(logits, params, rng):
+    """One token id from a [V] float logits row."""
+    logits = np.asarray(logits, np.float64).reshape(-1)
+    if params.greedy:
+        return int(np.argmax(logits))
+    logits = logits / params.temperature
+    if params.top_k is not None and params.top_k < logits.size:
+        kth = np.partition(logits, -params.top_k)[-params.top_k]
+        logits = np.where(logits >= kth, logits, -np.inf)
+    probs = np.exp(logits - np.max(logits))
+    probs /= probs.sum()
+    if params.top_p is not None and params.top_p < 1.0:
+        order = np.argsort(-probs, kind="stable")
+        csum = np.cumsum(probs[order])
+        # smallest prefix reaching top_p: keep ranks whose cumulative
+        # sum up to and including them hasn't passed top_p before them
+        keep_n = int(np.searchsorted(csum, params.top_p) + 1)
+        mask = np.zeros_like(probs, bool)
+        mask[order[:keep_n]] = True
+        probs = np.where(mask, probs, 0.0)
+        probs /= probs.sum()
+    return int(rng.choice(probs.size, p=probs))

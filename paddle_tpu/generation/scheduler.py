@@ -1,0 +1,226 @@
+"""Continuous-batching scheduler: prefill/decode split over fixed slots.
+
+Static batching pads every request to the longest sequence and holds the
+whole batch until the slowest member finishes; continuous batching
+(the Ragged Paged Attention serving model) instead keeps a fixed set of
+decode SLOTS and lets sequences join and leave every step:
+
+    submit() -> AdmissionQueue -> [pending] -> slot: PREFILL -> DECODE loop
+                 (bounded,                      (page capacity              \
+                  typed busy/deadline           gated)                       -> retire: free pages
+                  rejection)                                                /   + slot
+                                   preempt (pages exhausted): pages freed,
+                                   sequence re-queued for RE-PREFILL
+
+Admission reuses the serving subsystem's AdmissionQueue verbatim — a
+full queue rejects with ServerBusyError at submit, deadline-expired
+requests resolve with DeadlineExceededError on any scan — with the
+counters landing under `generation.*` (GenerationMetrics implements the
+queue's metrics interface).
+
+Preemption is recompute-style: the victim's pages return to the pool and
+its tokens-so-far become a new prefill when capacity returns.  Because
+sampling state is per-request (seeded RNG) and prefill logits at the
+last position equal the decode logits for the same prefix, a preempted
+sequence resumes token-identically — preemption changes WHEN tokens are
+computed, never WHICH.
+"""
+import collections
+import math
+
+from ..serving.admission import (AdmissionQueue, DeadlineExceededError,
+                                 Request, RequestTooLargeError, ServingError)
+from .kv_cache import OutOfPagesError
+
+
+class GenerationRequest(Request):
+    """One generation request riding the serving AdmissionQueue.
+
+    `args` carries the prompt token ids; `future` is the streaming
+    GenerationHandle (duck-typed: done()/set_exception(), so the queue's
+    deadline reaping resolves it with the typed error)."""
+
+    __slots__ = ("prompt", "max_new_tokens", "stop_tokens", "params")
+
+    def __init__(self, prompt, handle, params, max_new_tokens=16,
+                 stop_tokens=(), deadline=None):
+        super().__init__(list(prompt), 1, handle, deadline=deadline)
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("prompt must contain at least one token")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 0:
+            raise ValueError(
+                f"max_new_tokens must be >= 0, got {max_new_tokens}")
+        self.stop_tokens = frozenset(int(t) for t in stop_tokens)
+        self.params = params
+
+
+class SequenceState:
+    """One sequence occupying a decode slot (or awaiting re-admission
+    after preemption).  `tokens` is prompt + everything sampled so far;
+    the KV cache holds entries for exactly `tokens[:cache_len]`."""
+
+    __slots__ = ("seq_id", "request", "tokens", "n_generated", "rng",
+                 "slot", "preemptions")
+
+    def __init__(self, seq_id, request):
+        self.seq_id = seq_id
+        self.request = request
+        self.tokens = list(request.prompt)
+        self.n_generated = 0
+        self.rng = request.params.make_rng()
+        self.slot = None
+        self.preemptions = 0
+
+    @property
+    def handle(self):
+        return self.request.future
+
+
+class ContinuousBatchingScheduler:
+    """Owns the admission queue, the decode slots, and the page-capacity
+    admission gate.  The engine drives it: admit() -> prefill work,
+    active() -> the decode batch, retire()/preempt_for_pages() on exit
+    paths."""
+
+    def __init__(self, cache, num_slots=8, queue_depth=64, metrics=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.cache = cache
+        self.num_slots = int(num_slots)
+        self.queue = AdmissionQueue(queue_depth, metrics=metrics)
+        self._metrics = metrics
+        self.slots = [None] * self.num_slots
+        # polled-but-not-yet-placed work: new requests waiting for pages,
+        # and preempted SequenceStates waiting to re-prefill (these take
+        # priority — they already consumed steps)
+        self._pending = collections.deque()
+        self._next_seq = 0
+
+    # ------------------------- submission ---------------------------
+    def submit(self, request):
+        """Bounded admission; raises ServerBusyError when full and
+        RequestTooLargeError when the prompt can never fit the pool."""
+        need = self._pages_for(len(request.prompt) + 1)
+        if need > self.cache.num_pages:
+            raise RequestTooLargeError(
+                f"prompt of {len(request.prompt)} tokens needs {need} "
+                f"pages; the pool only has {self.cache.num_pages}")
+        self.queue.offer(request)
+
+    def _pages_for(self, tokens):
+        return math.ceil(tokens / self.cache.page_size)
+
+    # ------------------------- admission ----------------------------
+    def free_slots(self):
+        return sum(1 for s in self.slots if s is None)
+
+    def active(self):
+        """Sequences currently holding decode slots, slot order."""
+        return [s for s in self.slots if s is not None]
+
+    def _place(self, state):
+        for i, s in enumerate(self.slots):
+            if s is None:
+                state.slot = i
+                self.slots[i] = state
+                return
+        raise AssertionError("no free slot (checked by caller)")
+
+    def admit(self):
+        """Move work into free slots while pages allow; returns the newly
+        placed SequenceStates (each needs a prefill over state.tokens).
+        Head-of-line on capacity: admission stops at the first item that
+        doesn't fit, preserving arrival order."""
+        admitted = []
+        committed = 0  # pages promised to THIS call's earlier admits
+        # (their prefills run after admit() returns, so num_free_pages
+        # alone would let several admits all claim the same free pages)
+        while self.free_slots() > 0:
+            item = self._pending.popleft() if self._pending else \
+                self.queue.poll(timeout=0)
+            if item is None:
+                break
+            if isinstance(item, SequenceState):
+                state, req = item, item.request
+            else:
+                state, req = None, item
+            if req.expired():
+                req.reject_expired()
+                if self._metrics is not None:
+                    self._metrics.count_rejected_deadline()
+                continue
+            tokens = len(state.tokens if state else req.prompt)
+            # +1: room for the first decode append after prefill
+            need = self._pages_for(tokens + 1)
+            if need > self.cache.num_free_pages - committed \
+                    and (self.active() or self._pending or admitted):
+                # not enough pages now, but retiring sequences will free
+                # some — wait in line rather than rejecting
+                self._pending.appendleft(item)
+                break
+            committed += need
+            if state is None:
+                state = SequenceState(self._next_seq, req)
+                self._next_seq += 1
+            self.cache.allocate(state.seq_id)
+            self._place(state)
+            admitted.append(state)
+        return admitted
+
+    # ------------------------- exit paths ---------------------------
+    def retire(self, state):
+        """Sequence left the batch (finished or failed): free its slot
+        and every page it owns."""
+        if state.slot is not None:
+            self.slots[state.slot] = None
+            state.slot = None
+        if self.cache.has(state.seq_id):
+            self.cache.free(state.seq_id)
+
+    def preempt(self, state):
+        """Recompute-preempt: free pages + slot, queue for re-prefill at
+        the FRONT of the pending line (it has seniority over new work)."""
+        self.retire(state)
+        state.preemptions += 1
+        self._pending.appendleft(state)
+
+    def preempt_youngest(self):
+        """Preempt the single youngest active sequence (most recently
+        admitted = least sunk cost) and return it — unless it is the
+        only one, in which case return None: the batch must keep making
+        progress, so the lone/oldest sequence is never preempted.  The
+        caller re-evaluates capacity after every single preemption (a
+        victim's own page need leaves the books with it, so a batchwide
+        shortfall computed up front would over-preempt or give up too
+        early)."""
+        active = self.active()
+        if len(active) < 2:
+            return None
+        victim = max(active, key=lambda s: s.seq_id)
+        self.preempt(victim)
+        return victim
+
+    def pending_count(self):
+        return len(self._pending) + len(self.queue)
+
+    def close(self):
+        """Reject everything still queued (typed shutdown error)."""
+        self.queue.close()
+        while self._pending:
+            item = self._pending.popleft()
+            fut = item.handle if isinstance(item, SequenceState) else \
+                item.future
+            if not fut.done():
+                try:
+                    fut.set_exception(ServingError(
+                        "generation engine shut down with request queued"))
+                except Exception:
+                    pass
+
+
+__all__ = [
+    "ContinuousBatchingScheduler", "GenerationRequest", "SequenceState",
+    "DeadlineExceededError", "OutOfPagesError",
+]

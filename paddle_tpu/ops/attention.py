@@ -23,6 +23,16 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     [B, H, Lq, Lk]."""
     scale = 1.0 / math.sqrt(q.shape[-1])
 
+    if q.shape[-2] == 1:
+        # decode fast path (Lq == 1, the KV-cache autoregressive step):
+        # a single query row attends to every key — tril(k=Lk-1) over one
+        # row is all-True — so the causal-mask build is dead weight, and
+        # the flash gate is skipped outright (one [1, Lk] score row is a
+        # single small gemm; a Pallas dispatch only adds launch cost, and
+        # paged decode has its own kernel in ops/pallas/paged_attention).
+        is_causal = False
+        use_flash = False
+
     if use_flash is None:
         from ..framework import get_flags
 

@@ -7,3 +7,4 @@ with interpret-mode execution on CPU so tests run anywhere.
 """
 from . import flash_attention as flash_attention_kernels  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
+from .paged_attention import paged_decode_attention_kernel  # noqa: F401

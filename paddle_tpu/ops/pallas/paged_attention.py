@@ -1,0 +1,120 @@
+"""Paged decode attention (Lq == 1) as a Pallas TPU kernel.
+
+The decode-side counterpart of flash_attention.py: one query token per
+sequence attends over a paged KV cache (Ragged Paged Attention, arxiv
+2604.15464).  The kernel never materializes a per-sequence contiguous KV
+copy — the page table rides in as a scalar-prefetch operand and the
+BlockSpec index_map DMAs each sequence's pages straight out of the pool:
+
+    grid = (B, H, max_pages)          # pages innermost, sequential
+    k block = pool_t[h, page_table[b, i]]       # [1, 1, page_size, D]
+
+Online softmax state (m, l, acc) lives in VMEM scratch across the page
+axis exactly like the flash forward kernel.  Pages past a sequence's
+length are skipped via @pl.when on the prefetched seq_lens (ragged
+sequences pay for the pages they own, not the batch max); the page table
+pads unused slots with page 0, which is always a valid DMA target.
+
+Layouts are chosen Mosaic tile-legal by construction: pools transpose to
+[H, P, page_size, D] so every block's trailing two dims are full array
+dims (page_size, D); q/out ride as [B, H, 1, D] with (1, 1, 1, D) blocks.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import NEG_INF, _interpret
+
+_STATE_ROWS = 8  # scratch rows; every row holds the same value so all
+# scratch traffic is full-width vector ops (the Mosaic-proven layout)
+
+
+def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, page_size, n_pages):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq_len = sl_ref[b]
+    # page i covers positions [i*page_size, (i+1)*page_size): it runs iff
+    # its first position is live; later positions are masked below
+    @pl.when(i * page_size < seq_len)
+    def _compute():
+        q = q_ref[0, 0]                            # [1, D] (scale folded)
+        k = k_ref[0, 0]                            # [page_size, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)   # ragged tail of page
+        m_prev = jnp.max(m_ref[...])
+        m_cur = jnp.maximum(m_prev, jnp.max(s))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                     # [1, page_size]
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)    # masked rows: exactly 0
+        l_cur = jnp.max(l_ref[...]) * alpha + jnp.sum(p)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.broadcast_to(
+            pv, acc_ref.shape)
+        m_ref[...] = jnp.full_like(m_ref, m_cur)
+        l_ref[...] = jnp.full_like(l_ref, l_cur)
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        l = jnp.max(l_ref[...])
+        safe_l = jnp.where(l > 0.0, l, 1.0)        # empty sequence: zeros
+        o_ref[0, 0] = (acc_ref[...] / safe_l)[0:1].astype(o_ref.dtype)
+
+
+def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
+                                  scale, interpret=None):
+    """q: [B, H, D].  k_pool/v_pool: [P, page_size, H, D] (one layer).
+    page_tables: [B, max_pages] int32 (pad with 0).  seq_lens: [B] int32.
+    Returns [B, H, D] attention output."""
+    b, h, d = q.shape
+    _, page_size, _, _ = k_pool.shape
+    n_pages = page_tables.shape[1]
+    qs = (q * scale).astype(q.dtype).reshape(b, h, 1, d)
+    # [P, ps, H, D] -> [H, P, ps, D]: trailing block dims are full dims
+    kt = jnp.transpose(k_pool, (2, 0, 1, 3))
+    vt = jnp.transpose(v_pool, (2, 0, 1, 3))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, i, pt, sl:
+                         (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda b_, h_, i, pt, sl:
+                         (h_, pt[b_, i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda b_, h_, i, pt, sl:
+                         (h_, pt[b_, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d), lambda b_, h_, i, pt, sl:
+                               (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((_STATE_ROWS, d), jnp.float32),
+            pltpu.VMEM((_STATE_ROWS, 128), jnp.float32),
+            pltpu.VMEM((_STATE_ROWS, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size,
+                          n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(jnp.asarray(page_tables, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
+      qs, kt, vt)
+    return out.reshape(b, h, d)

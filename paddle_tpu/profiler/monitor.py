@@ -77,6 +77,25 @@ class StatRegistry:
         for s in items:
             s.reset()
 
+    def stats_snapshot(self, prefix=None, path=None):
+        """BENCH_*-style JSON export of the registry: a sorted
+        ``{"ts": unix_seconds, "stats": {name: value}}`` dict, optionally
+        filtered to names starting with `prefix` (e.g. "serving." or
+        "generation.") and optionally written to `path` as one JSON
+        document.  Returns the dict either way."""
+        import json
+        import time
+
+        stats = self.stats()
+        if prefix:
+            stats = {k: v for k, v in stats.items() if k.startswith(prefix)}
+        snap = {"ts": round(time.time(), 3),
+                "stats": dict(sorted(stats.items()))}
+        if path:
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=2, sort_keys=True)
+        return snap
+
 
 def stat_add(name, value=1):
     """STAT_ADD macro parity (monitor.h:130)."""

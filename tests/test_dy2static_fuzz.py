@@ -32,7 +32,7 @@ def _compile_fn(src):
 
 def _gen_block(rng, depth, lines, indent):
     pad = "    " * indent
-    kind = rng.randint(0, 11)
+    kind = rng.randint(0, 13)
     a = round(float(rng.uniform(0.5, 1.5)), 3)
     b = round(float(rng.uniform(-1.0, 1.0)), 3)
     t = round(float(rng.uniform(-0.5, 0.5)), 3)
@@ -70,6 +70,13 @@ def _gen_block(rng, depth, lines, indent):
     elif kind == 5:  # early return under tensor cond
         lines.append(f"{pad}if paddle.mean(acc) > {t + 2.0}:")
         lines.append(f"{pad}    return acc * {a}")
+    elif kind == 6:  # tensor-cond branch INSIDE a python for body
+        k = int(rng.randint(2, 4))
+        lines.append(f"{pad}for i in range({k}):")
+        lines.append(f"{pad}    if paddle.mean(acc) > {t}:")
+        lines.append(f"{pad}        acc = acc * {a}")
+        lines.append(f"{pad}    else:")
+        lines.append(f"{pad}        acc = acc - {b}")
     elif kind == 7:  # tensor-bounded while (forward-only dynamic trip)
         k = int(rng.randint(1, 4))
         lines.append(f"{pad}cnt = paddle.mean(x) * 0.0")
@@ -94,6 +101,25 @@ def _gen_block(rng, depth, lines, indent):
     elif kind == 10:  # int()/float() casts + bool guard in the mix
         lines.append(f"{pad}k2 = int(paddle.mean(acc) * 2.0)")
         lines.append(f"{pad}acc = acc + float(k2) * {b}")
+    elif kind == 11:  # tensor-cond if/elif/else chain
+        lines.append(f"{pad}if paddle.mean(acc) > {t + 1.0}:")
+        lines.append(f"{pad}    acc = acc * {a}")
+        lines.append(f"{pad}elif paddle.mean(acc) > {t}:")
+        lines.append(f"{pad}    acc = acc + {b}")
+        lines.append(f"{pad}else:")
+        lines.append(f"{pad}    acc = acc - {b}")
+    elif kind == 12:  # scan append where each row's value is tensor-cond
+        lines.append(f"{pad}ys = []")
+        # scan carries must pre-exist before a tensor-iteration loop
+        # (documented shape-constraint deviation in convert_ops)
+        lines.append(f"{pad}y = x[0] * 0.0")
+        lines.append(f"{pad}for row in x:")
+        lines.append(f"{pad}    if paddle.mean(row) > {t}:")
+        lines.append(f"{pad}        y = row * {a}")
+        lines.append(f"{pad}    else:")
+        lines.append(f"{pad}        y = row + {b}")
+        lines.append(f"{pad}    ys.append(y)")
+        lines.append(f"{pad}acc = acc + paddle.mean(paddle.stack(ys))")
     else:  # nested tensor-cond if
         if depth < 2:
             lines.append(f"{pad}if paddle.mean(acc) < {t}:")

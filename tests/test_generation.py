@@ -1,0 +1,563 @@
+"""paddle_tpu.generation — paged KV cache, paged decode attention,
+continuous batching, sampling, streaming, metrics.
+
+The acceptance oracles (all CPU, conftest forces the backend):
+
+1. paged decode attention numerically matches dense causal
+   full-recompute attention — EXACT in fp32 (zero tolerance): padding
+   pages/positions contribute exactly zero by construction;
+2. continuous-batched greedy generation is token-identical to
+   sequential per-request generation — including under forced
+   preemption (a page pool sized to thrash);
+3. pages are freed on completion: pool utilization returns to zero.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving.admission import (DeadlineExceededError,
+                                          RequestTooLargeError,
+                                          ServerBusyError, ServingError)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    """generation.* stats are process-global (STAT_ADD parity)."""
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _engine(model, *, slots=4, pages=64, page_size=4, start=False, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size, **kw)
+    return gen.GenerationEngine(model, cfg, start=start)
+
+
+# ---------------------------- PagedKVCache ------------------------------
+
+
+def test_kv_cache_page_table_layout():
+    c = gen.PagedKVCache(2, 2, 8, num_pages=8, page_size=4)
+    c.allocate("s")
+    k = np.arange(2 * 6 * 2 * 8, dtype=np.float32).reshape(2, 6, 2, 8)
+    c.append_prefill("s", k, -k)
+    assert c.seq_len("s") == 6
+    table = c.page_table("s")
+    assert len(table) == 2  # ceil(6/4)
+    # token t lives at page_table[t//4], row t%4
+    for t in range(6):
+        np.testing.assert_array_equal(
+            c.k_pool[:, table[t // 4], t % 4], k[:, t])
+        np.testing.assert_array_equal(
+            c.v_pool[:, table[t // 4], t % 4], -k[:, t])
+
+
+def test_kv_cache_append_crosses_page_boundary():
+    c = gen.PagedKVCache(1, 1, 4, num_pages=4, page_size=2)
+    c.allocate(0)
+    for t in range(5):
+        pos = c.append(0, np.full((1, 1, 4), t, np.float32),
+                       np.zeros((1, 1, 4), np.float32))
+        assert pos == t
+    assert len(c.page_table(0)) == 3  # ceil(5/2)
+    assert c.pages_in_use == 3
+
+
+def test_kv_cache_free_returns_pages_and_reuses():
+    c = gen.PagedKVCache(1, 1, 4, num_pages=4, page_size=2)
+    c.allocate("a")
+    c.reserve("a", 6)
+    pages_a = set(c.page_table("a"))
+    assert c.num_free_pages == 1
+    c.free("a")
+    assert c.num_free_pages == 4 and c.utilization() == 0.0
+    c.allocate("b")
+    c.reserve("b", 2)
+    # LIFO free list: a just-freed page is reused first
+    assert set(c.page_table("b")) <= pages_a
+
+
+def test_kv_cache_out_of_pages_is_atomic():
+    c = gen.PagedKVCache(1, 1, 4, num_pages=2, page_size=2)
+    c.allocate(0)
+    c.reserve(0, 3)  # 2 pages
+    with pytest.raises(gen.OutOfPagesError):
+        c.reserve(0, 2)  # needs a 3rd page
+    # nothing advanced or leaked on the failed reserve
+    assert c.seq_len(0) == 3 and c.num_free_pages == 0
+
+
+def test_kv_cache_gather_block_tables_pads_with_valid_page():
+    c = gen.PagedKVCache(1, 2, 4, num_pages=8, page_size=2)
+    for sid, n in (("a", 5), ("b", 1)):
+        c.allocate(sid)
+        c.reserve(sid, n)
+    pt, lens = c.gather_block_tables(["a", "b"])
+    assert pt.shape == (2, 3) and pt.dtype == np.int32
+    assert lens.tolist() == [5, 1]
+    assert (pt >= 0).all() and (pt < 8).all()  # padding is DMA-safe
+
+
+def test_kv_cache_utilization_stats():
+    c = gen.PagedKVCache(1, 1, 4, num_pages=4, page_size=4)
+    c.allocate(0)
+    c.reserve(0, 5)  # 2 pages, 5 of 8 rows
+    s = c.stats()
+    assert s["pages_in_use"] == 2 and s["utilization_pct"] == 50.0
+    assert s["token_utilization_pct"] == round(100 * 5 / 8, 1)
+
+
+# ----------------------- paged decode attention -------------------------
+
+
+def _fill_cache(rng, L, H, D, lens, page_size=4, num_pages=64):
+    c = gen.PagedKVCache(L, H, D, num_pages=num_pages, page_size=page_size)
+    ks, vs = [], []
+    for i, t in enumerate(lens):
+        k = rng.standard_normal((L, t, H, D)).astype(np.float32)
+        v = rng.standard_normal((L, t, H, D)).astype(np.float32)
+        c.allocate(i)
+        c.append_prefill(i, k, v)
+        ks.append(k)
+        vs.append(v)
+    return c, ks, vs
+
+
+@pytest.mark.parametrize("lens", [[7], [13, 5, 24], [1, 9]])
+def test_paged_decode_matches_dense_causal_exact_fp32(lens):
+    """Acceptance oracle 1: the jnp reference over gathered pages equals
+    dense causal full-recompute at the last position, EXACTLY in fp32."""
+    rng = np.random.default_rng(0)
+    L, H, D = 2, 2, 8
+    c, ks, vs = _fill_cache(rng, L, H, D, lens)
+    q = rng.standard_normal((len(lens), H, D)).astype(np.float32)
+    pt, sl = c.gather_block_tables(range(len(lens)))
+    for layer in range(L):
+        out = np.asarray(gen.paged_decode_attention_reference(
+            q, c.k_pool[layer], c.v_pool[layer], pt, sl))
+        for i, t in enumerate(lens):
+            # dense causal over the real tokens, query at the last row
+            full_q = np.concatenate(
+                [np.zeros((t - 1, H, D), np.float32), q[i:i + 1]])
+            dense = np.asarray(gen.dense_causal_reference(
+                full_q, ks[i][layer], vs[i][layer]))[-1]
+            np.testing.assert_array_equal(out[i], dense)
+
+
+def test_paged_decode_kernel_interpret_matches_reference():
+    """The Pallas kernel (interpret mode on CPU) implements the same
+    semantics; online softmax reassociates, so small float tolerance."""
+    rng = np.random.default_rng(1)
+    L, H, D = 1, 2, 128
+    c, _, _ = _fill_cache(rng, L, H, D, [13, 5, 24], page_size=8,
+                          num_pages=16)
+    q = rng.standard_normal((3, H, D)).astype(np.float32)
+    pt, sl = c.gather_block_tables([0, 1, 2])
+    ref = np.asarray(gen.paged_decode_attention_reference(
+        q, c.k_pool[0], c.v_pool[0], pt, sl))
+    ker = np.asarray(gen.paged_decode_attention(
+        q, c.k_pool[0], c.v_pool[0], pt, sl, use_kernel=True,
+        interpret=True))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_decode_empty_sequence_returns_zeros_both_paths():
+    """len 0 (all keys masked): both implementations agree on exact
+    zeros rather than softmax-of-garbage."""
+    rng = np.random.default_rng(5)
+    c = gen.PagedKVCache(1, 2, 128, num_pages=4, page_size=8)
+    c.allocate(0)
+    q = rng.standard_normal((1, 2, 128)).astype(np.float32)
+    pt = np.zeros((1, 1), np.int32)
+    sl = np.zeros((1,), np.int32)
+    ref = np.asarray(gen.paged_decode_attention_reference(
+        q, c.k_pool[0], c.v_pool[0], pt, sl))
+    ker = np.asarray(gen.paged_decode_attention(
+        q, c.k_pool[0], c.v_pool[0], pt, sl, use_kernel=True,
+        interpret=True))
+    np.testing.assert_array_equal(ref, np.zeros_like(ref))
+    np.testing.assert_array_equal(ker, np.zeros_like(ker))
+
+
+def test_paged_decode_dispatch_defaults_to_reference_on_cpu():
+    rng = np.random.default_rng(2)
+    c, _, _ = _fill_cache(rng, 1, 1, 8, [3])
+    q = rng.standard_normal((1, 1, 8)).astype(np.float32)
+    pt, sl = c.gather_block_tables([0])
+    auto = np.asarray(gen.paged_decode_attention(
+        q, c.k_pool[0], c.v_pool[0], pt, sl))
+    ref = np.asarray(gen.paged_decode_attention_reference(
+        q, c.k_pool[0], c.v_pool[0], pt, sl))
+    np.testing.assert_array_equal(auto, ref)
+
+
+# ------------------- ops/attention Lq==1 fast path ----------------------
+
+
+@pytest.mark.parametrize("b,h,lk,d", [
+    (1, 1, 5, 8), (2, 3, 17, 16), (1, 2, 128, 64), (2, 1, 256, 32),
+])
+def test_sdp_decode_fast_path_shape_coverage(b, h, lk, d):
+    """Lq == 1 skips the tril build and the flash gate; causal over one
+    query row is all-visible, so it must equal the full causal result's
+    last row — across shapes including flash-eligible (128-multiple)
+    ones with use_flash forced on."""
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+    rng = np.random.default_rng(b * 100 + lk)
+    q = rng.standard_normal((b, h, lk, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, lk, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, lk, d)).astype(np.float32)
+    full, _ = scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True, use_flash=False)
+    fast, _ = scaled_dot_product_attention(
+        paddle.to_tensor(q[:, :, -1:]), paddle.to_tensor(k),
+        paddle.to_tensor(v), is_causal=True, use_flash=True)
+    np.testing.assert_allclose(
+        np.asarray(fast.numpy())[:, :, 0], np.asarray(full.numpy())[:, :, -1],
+        atol=1e-6, rtol=1e-6)
+
+
+def test_sdp_decode_fast_path_respects_additive_mask():
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+    rng = np.random.default_rng(9)
+    q = rng.standard_normal((2, 2, 1, 8)).astype(np.float32)
+    k = rng.standard_normal((2, 2, 6, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 2, 6, 8)).astype(np.float32)
+    mask = np.zeros((2, 1, 1, 6), np.float32)
+    mask[:, :, :, -2:] = -1e9  # hide the last two keys
+    out, _ = scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        attn_mask=paddle.to_tensor(mask), is_causal=True)
+    ref, _ = scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k[:, :, :-2]),
+        paddle.to_tensor(v[:, :, :-2]), is_causal=False, use_flash=False)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()),
+                               atol=1e-6, rtol=1e-6)
+
+
+# ------------------------------ sampling --------------------------------
+
+
+def test_sampling_greedy_is_argmax():
+    logits = np.array([0.1, 3.0, -1.0, 2.9], np.float32)
+    p = gen.SamplingParams()  # temperature 0
+    assert p.greedy
+    assert gen.sample_token(logits, p, p.make_rng()) == 1
+
+
+def test_sampling_top_k_restricts_support():
+    logits = np.array([5.0, 4.0, -50.0, -60.0], np.float32)
+    p = gen.SamplingParams(temperature=1.0, top_k=2, seed=0)
+    rng = p.make_rng()
+    draws = {gen.sample_token(logits, p, rng) for _ in range(64)}
+    assert draws <= {0, 1} and len(draws) == 2
+
+
+def test_sampling_top_p_nucleus():
+    # probs ~ [0.85, 0.10, 0.05]: top_p=0.8 keeps only token 0
+    logits = np.log(np.array([0.85, 0.10, 0.05], np.float64))
+    p = gen.SamplingParams(temperature=1.0, top_p=0.8, seed=1)
+    rng = p.make_rng()
+    assert {gen.sample_token(logits, p, rng) for _ in range(32)} == {0}
+
+
+def test_sampling_seeded_reproducible():
+    logits = np.random.default_rng(3).standard_normal(32)
+    a = [gen.sample_token(logits, gen.SamplingParams(temperature=1.3,
+                                                     top_k=8, seed=7),
+                          gen.SamplingParams(seed=7).make_rng())
+         for _ in range(4)]
+    b = [gen.sample_token(logits, gen.SamplingParams(temperature=1.3,
+                                                     top_k=8, seed=7),
+                          gen.SamplingParams(seed=7).make_rng())
+         for _ in range(4)]
+    assert a == b
+
+
+def test_sampling_param_validation():
+    with pytest.raises(ValueError):
+        gen.SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError):
+        gen.SamplingParams(top_k=-1, temperature=1.0)
+    with pytest.raises(ValueError):
+        gen.SamplingParams(top_p=1.5, temperature=1.0)
+
+
+# --------------------------- engine oracles -----------------------------
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+def test_continuous_batched_greedy_token_identical_to_sequential(model):
+    """Acceptance oracles 2 + 3: batched == sequential, pages freed."""
+    eng = _engine(model)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        res = h.result(timeout=5)
+        assert res.token_ids == model.greedy_reference(p, 12)
+        assert res.finish_reason == "length"
+    # oracle 3: every page returned to the pool
+    assert eng.cache.utilization() == 0.0
+    assert eng.cache.num_free_pages == eng.cache.num_pages
+    eng.shutdown()
+
+
+def test_generation_token_identical_under_forced_preemption(model):
+    """A pool too small for 4 concurrent sequences forces recompute
+    preemption — which must not change a single token."""
+    eng = _engine(model, pages=9)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == model.greedy_reference(p, 12)
+    assert sum(r.preemptions for r in results) > 0  # the pool did thrash
+    assert eng.metrics.snapshot()["generation.preempted_total"] > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_generation_one_slot_serializes_but_tokens_identical(model):
+    """A 1-slot engine serves the same prompts strictly one at a time;
+    batch composition is invisible to results."""
+    eng = _engine(model, slots=1, pages=16)
+    handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == model.greedy_reference(p, 6)
+    eng.shutdown()
+
+
+def test_generation_stop_tokens_and_finish_reasons(model):
+    eng = _engine(model)
+    # discover the greedy stream, then stop on its 3rd token
+    free = model.greedy_reference([1, 2, 3], 8)
+    stop = free[2]
+    h = eng.submit([1, 2, 3], max_new_tokens=8, stop_tokens=(stop,))
+    eng.run_until_idle()
+    res = h.result(timeout=5)
+    assert res.finish_reason == "stop"
+    assert res.token_ids == free[:2]  # stop token itself excluded
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_generation_max_new_tokens_zero_and_exact(model):
+    eng = _engine(model)
+    h0 = eng.submit([1, 2], max_new_tokens=0)
+    h3 = eng.submit([1, 2], max_new_tokens=3)
+    eng.run_until_idle()
+    assert h0.result(timeout=5).token_ids == []
+    assert h0.result().finish_reason == "length"
+    assert len(h3.result(timeout=5).token_ids) == 3
+    eng.shutdown()
+
+
+def test_generation_streaming_tokens_match_result(model):
+    eng = _engine(model, start=True)
+    try:
+        h = eng.submit([5, 6, 7], max_new_tokens=8)
+        streamed = list(h.tokens(timeout=30))
+        assert streamed == h.result(timeout=5).token_ids
+        assert len(streamed) == 8
+    finally:
+        eng.shutdown()
+
+
+def test_generation_busy_rejection_typed(model):
+    eng = _engine(model, queue_depth=2)  # not started: queue fills
+    eng.submit([1], max_new_tokens=1)
+    eng.submit([2], max_new_tokens=1)
+    with pytest.raises(ServerBusyError):
+        eng.submit([3], max_new_tokens=1)
+    stats = eng.metrics.snapshot()
+    assert stats["generation.rejected_busy"] == 1
+    eng.run_until_idle()
+    eng.shutdown()
+
+
+def test_generation_prompt_too_large_typed(model):
+    eng = _engine(model, pages=2, page_size=4)
+    with pytest.raises(RequestTooLargeError):
+        eng.submit(list(range(1, 20)), max_new_tokens=1)  # > 8 rows
+    eng.shutdown()
+
+
+def test_generation_deadline_rejection_typed(model):
+    eng = _engine(model)  # not started
+    h = eng.submit([1, 2], max_new_tokens=4, timeout_ms=1.0)
+    time.sleep(0.02)  # lapse in queue
+    eng.step()
+    with pytest.raises(DeadlineExceededError):
+        h.result(timeout=1)
+    # the stream surfaces the same typed error
+    with pytest.raises(DeadlineExceededError):
+        list(h.tokens(timeout=1))
+    assert eng.metrics.snapshot()["generation.rejected_deadline"] >= 1
+    eng.shutdown()
+
+
+def test_generation_shutdown_rejects_queued(model):
+    eng = _engine(model, queue_depth=8)
+    h = eng.submit([1, 2], max_new_tokens=4)
+    eng.shutdown()
+    with pytest.raises(ServingError):
+        h.result(timeout=1)
+    with pytest.raises(ServingError):
+        eng.submit([3], max_new_tokens=1)
+
+
+def test_generation_temperature_sampling_deterministic_per_seed(model):
+    eng = _engine(model)
+    mk = lambda: gen.SamplingParams(temperature=0.9, top_k=10, top_p=0.9,
+                                    seed=42)
+    h1 = eng.submit([3, 1], max_new_tokens=6, sampling=mk())
+    h2 = eng.submit([3, 1], max_new_tokens=6, sampling=mk())
+    eng.run_until_idle()
+    assert h1.result(timeout=5).token_ids == h2.result(timeout=5).token_ids
+    eng.shutdown()
+
+
+def test_generation_metrics_and_snapshot_export(model, tmp_path):
+    eng = _engine(model)
+    handles = [eng.submit(p, max_new_tokens=5) for p in PROMPTS[:2]]
+    eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=5)
+    stats = eng.metrics.snapshot()
+    assert stats["generation.requests_total"] == 2
+    assert stats["generation.finished_total"] == 2
+    assert stats["generation.tokens_total"] == 10
+    assert stats["generation.prefill_tokens_total"] == \
+        len(PROMPTS[0]) + len(PROMPTS[1])
+    assert stats["generation.steps_total"] >= 4
+    # stats_snapshot: BENCH-style JSON artifact (satellite)
+    out = tmp_path / "gen_stats.json"
+    snap = StatRegistry.instance().stats_snapshot("generation.",
+                                                  path=str(out))
+    assert set(snap) == {"ts", "stats"}
+    assert all(k.startswith("generation.") for k in snap["stats"])
+    import json
+
+    on_disk = json.loads(out.read_text())
+    assert on_disk["stats"] == snap["stats"]
+    eng.shutdown()
+
+
+def test_generation_record_event_spans(model):
+    """enable_profile-style runs see generation internals."""
+    from paddle_tpu import profiler
+
+    eng = _engine(model)
+    profiler.start_profiler()
+    try:
+        eng.submit([1, 2], max_new_tokens=3)
+        eng.run_until_idle()
+    finally:
+        report = profiler.stop_profiler()
+    for span in ("generation::prefill", "generation::decode_step",
+                 "generation::sample"):
+        assert span in report
+    eng.shutdown()
+
+
+def test_generation_tight_pool_all_sequences_hit_boundary_together(model):
+    """Review-found corner: every sequence crosses a page boundary in
+    the SAME step with zero free pages.  Single-victim preemption with
+    the shortfall recomputed after each must let the survivors (and
+    later the victims) finish — no request may be hard-failed, and every
+    preemption must be counted."""
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 40, 15).tolist() for _ in range(4)]
+    eng = _engine(model, slots=4, pages=4, page_size=16)
+    handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]  # none may raise
+    for res, p in zip(results, prompts):
+        assert res.token_ids == model.greedy_reference(p, 8)
+    assert sum(r.preemptions for r in results) > 0
+    stats = eng.metrics.snapshot()
+    assert stats["generation.preempted_total"] == \
+        sum(r.preemptions for r in results)
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_generation_max_positions_typed_rejection(model):
+    eng = _engine(model)
+    assert model.max_positions == 512
+    with pytest.raises(RequestTooLargeError):
+        eng.submit([1] * 500, max_new_tokens=20)
+    eng.shutdown()
+
+
+def test_generation_worker_survives_model_error(model):
+    """A model exception must fail the affected handles with the real
+    error (batch-fails-as-a-unit, DynamicBatcher semantics) — never
+    strand clients on a dead worker thread."""
+
+    class Poisoned:
+        num_layers = model.num_layers
+        num_heads = model.num_heads
+        head_dim = model.head_dim
+        vocab_size = model.vocab_size
+
+        def prefill(self, tokens):
+            raise RuntimeError("poisoned prefill")
+
+        def decode(self, tokens, positions, attend):
+            raise RuntimeError("poisoned decode")
+
+    eng = gen.GenerationEngine(
+        Poisoned(), gen.GenerationConfig(max_decode_slots=2, num_pages=16,
+                                         page_size=4), start=True)
+    try:
+        h1 = eng.submit([1, 2], max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="poisoned"):
+            h1.result(timeout=10)
+        # the worker is still alive and keeps draining with typed errors
+        h2 = eng.submit([3], max_new_tokens=2)
+        with pytest.raises(RuntimeError, match="poisoned"):
+            h2.result(timeout=10)
+    finally:
+        eng.shutdown()
+    assert eng.cache.utilization() == 0.0
+
+
+def test_generation_background_worker_end_to_end(model):
+    """Worker-thread path: submit from multiple client threads, no
+    manual stepping anywhere."""
+    import concurrent.futures as cf
+
+    eng = _engine(model, start=True)
+    try:
+        with cf.ThreadPoolExecutor(4) as pool:
+            futs = [pool.submit(
+                lambda p=p: eng.submit(p, max_new_tokens=8).result(
+                    timeout=60)) for p in PROMPTS]
+            results = [f.result(timeout=60) for f in futs]
+        for res, p in zip(results, PROMPTS):
+            assert res.token_ids == model.greedy_reference(p, 8)
+    finally:
+        eng.shutdown()
+    assert eng.cache.utilization() == 0.0

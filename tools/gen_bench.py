@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Decode microbench: tokens/s across batch x context for the
+paddle_tpu.generation engine (BENCH-style JSON to stdout).
+
+Measures the paged-KV continuous-batching decode loop end to end —
+prefill, paged decode attention (Pallas kernel on TPU, jnp reference on
+CPU), sampling, scheduling — with the `generation.*` StatRegistry
+snapshot embedded in the artifact (the stats_snapshot() export), so a
+TPU-window run leaves the same evidence trail as BENCH_TPU_SESSION.json.
+
+Usage:
+    python tools/gen_bench.py                    # default grid
+    python tools/gen_bench.py --batches 1,4,8 --contexts 32,128 \
+        --new-tokens 32 --out BENCH_GEN.json
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable as `python tools/gen_bench.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# honor JAX_PLATFORMS=cpu *before* backend init (see op_bench.py)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
+
+def bench_cell(model, batch, context, new_tokens, num_pages, page_size):
+    from paddle_tpu import generation as g
+
+    eng = g.GenerationEngine(
+        model,
+        g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
+                           page_size=page_size, queue_depth=batch * 2),
+        start=False)
+    rng = np.random.default_rng(batch * 1000 + context)
+    prompts = [rng.integers(0, model.vocab_size, context).tolist()
+               for _ in range(batch)]
+    t0 = time.perf_counter()
+    handles = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+    eng.run_until_idle()
+    dt = time.perf_counter() - t0
+    results = [h.result(timeout=1) for h in handles]
+    generated = sum(len(r.token_ids) for r in results)
+    eng.shutdown()
+    return {
+        "batch": batch,
+        "context": context,
+        "new_tokens": new_tokens,
+        "generated": generated,
+        "wall_s": round(dt, 4),
+        "tokens_per_s": round(generated / dt, 2) if dt > 0 else 0.0,
+        "preemptions": sum(r.preemptions for r in results),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="1,4,8")
+    ap.add_argument("--contexts", default="32,128")
+    ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--head-dim", type=int, default=32)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON document to this path")
+    args = ap.parse_args()
+
+    import jax
+
+    from paddle_tpu import generation as g
+    from paddle_tpu.profiler.monitor import StatRegistry
+
+    batches = [int(b) for b in args.batches.split(",")]
+    contexts = [int(c) for c in args.contexts.split(",")]
+    model = g.TinyCausalLM(vocab_size=args.vocab, num_layers=args.layers,
+                           num_heads=args.heads, head_dim=args.head_dim,
+                           max_positions=max(contexts) + args.new_tokens + 1,
+                           seed=0)
+    grid = []
+    for b in batches:
+        for ctx in contexts:
+            # pool sized to fit the cell without preemption noise
+            pages = ((ctx + args.new_tokens) // args.page_size + 2) * b
+            grid.append(bench_cell(model, b, ctx, args.new_tokens,
+                                   pages, args.page_size))
+    doc = {
+        "bench": "generation_decode",
+        "platform": jax.devices()[0].platform,
+        "model": {"vocab": args.vocab, "layers": args.layers,
+                  "heads": args.heads, "head_dim": args.head_dim},
+        "grid": grid,
+        "stats": StatRegistry.instance().stats_snapshot("generation."),
+    }
+    line = json.dumps(doc)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,11 @@ for i in $(seq 1 "$MAX"); do
     timeout 900 python tools/op_bench.py > "${OUT%.json}_ops.jsonl" \
       2>/dev/null \
       && echo "[tpu-bench-loop] op table -> ${OUT%.json}_ops.jsonl"
+    # and the decode microbench (tokens/s grid + generation.* stats
+    # snapshot embedded via StatRegistry.stats_snapshot)
+    timeout 900 python tools/gen_bench.py --out "${OUT%.json}_gen.json" \
+      >/dev/null 2>&1 \
+      && echo "[tpu-bench-loop] gen bench -> ${OUT%.json}_gen.json"
     exit 0
   fi
   echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
