@@ -31,7 +31,8 @@ from .decode_attention import (dense_causal_reference,
                                paged_decode_attention_reference)
 from .engine import (GenerationConfig, GenerationEngine, GenerationHandle,
                      GenerationResult)
-from .kv_cache import OutOfPagesError, PagedKVCache
+from .kv_cache import (DeviceKVPool, OutOfPagesError, PagedKVCache,
+                       UnknownSequenceError)
 from .metrics import GenerationMetrics
 from .model import TinyCausalLM
 from .sampling import SamplingParams, sample_token
@@ -40,7 +41,8 @@ from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
 
 __all__ = [
     "GenerationEngine", "GenerationConfig", "GenerationHandle",
-    "GenerationResult", "PagedKVCache", "OutOfPagesError",
+    "GenerationResult", "PagedKVCache", "DeviceKVPool",
+    "OutOfPagesError", "UnknownSequenceError",
     "paged_decode_attention", "paged_decode_attention_reference",
     "dense_causal_reference", "ContinuousBatchingScheduler",
     "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",

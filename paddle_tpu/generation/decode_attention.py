@@ -13,6 +13,14 @@ which makes its fp32 output bit-comparable to a dense causal
 full-recompute over the real tokens — the property
 tests/test_generation.py asserts.  Tier-1 CPU tests therefore exercise
 the same semantics the TPU kernel implements.
+
+Both paths take the pools AS-IS: a host numpy pool is uploaded whole
+(the O(pool) cost PagedKVCache.layer_pools charges), while a
+DeviceKVPool hands its resident jax.Arrays straight through —
+``jnp.asarray`` on a device array is a no-op, so nothing is re-uploaded
+and a decode step's transfer cost is O(tokens).  Low-precision pools
+(``kv_dtype=bfloat16``) are upcast to the query dtype after the gather:
+storage saves HBM, the softmax math stays fp32.
 """
 import math
 
@@ -41,9 +49,11 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
     page_size = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    # gather pages: [B, max_pages, page_size, H, D] -> [B, Kmax, H, D]
-    k = k_pool[pt].reshape(b, -1, h, d)
-    v = v_pool[pt].reshape(b, -1, h, d)
+    # gather pages: [B, max_pages, page_size, H, D] -> [B, Kmax, H, D];
+    # the upcast (bf16 pools) happens on the gathered O(tokens) view,
+    # never on the whole pool
+    k = k_pool[pt].reshape(b, -1, h, d).astype(q.dtype)
+    v = v_pool[pt].reshape(b, -1, h, d).astype(q.dtype)
     kmax = k.shape[1]
     logits = jnp.einsum("bhd,bkhd->bhk", q, k) * scale
     live = jnp.arange(kmax, dtype=jnp.int32)[None, :] < lens[:, None]

@@ -18,6 +18,11 @@ Model protocol (duck-typed)::
 
     model.num_layers, model.num_heads, model.head_dim, model.vocab_size
     model.prefill(tokens[T])  -> (last_logits [V], k [L,T,H,D], v [L,T,H,D])
+    model.prefill_batch(tokens[B,T], lengths[B])          # optional
+        -> (last_logits [B,V], k [B,L,T,H,D], v [B,L,T,H,D])
+        # enables bucketed batched prefill: prompts are length-padded to
+        # a ShapeBucketer menu so prefill compiles once per bucket;
+        # models without it prefill one sequence at a time
     model.decode(tokens[B], positions[B], attend) -> logits [B, V]
         # calls, per layer:  attend(layer, q[B,H,D], k[B,H,D], v[B,H,D])
         #                      -> attention output [B,H,D]
@@ -37,9 +42,10 @@ import concurrent.futures
 
 import numpy as np
 
-from ..serving.admission import ServingError
+from ..serving.admission import RequestTooLargeError, ServingError
+from ..serving.bucketing import CompiledModelCache, ShapeBucketer
 from .decode_attention import paged_decode_attention
-from .kv_cache import OutOfPagesError, PagedKVCache
+from .kv_cache import DeviceKVPool, OutOfPagesError, PagedKVCache
 from .metrics import GenerationMetrics, StepTimer
 from .sampling import SamplingParams, sample_token
 from .scheduler import ContinuousBatchingScheduler, GenerationRequest
@@ -47,12 +53,29 @@ from .scheduler import ContinuousBatchingScheduler, GenerationRequest
 
 class GenerationConfig:
     """Engine knobs; defaults suit a small CPU demo (docs/GENERATION.md
-    documents each)."""
+    documents each).
+
+    kv_backend: "host" (numpy pools, whole pool shipped per step),
+        "device" (DeviceKVPool: HBM-resident pools, donated scatter
+        appends, O(tokens) transfer per step), or None = auto (device
+        on TPU, host elsewhere).
+    max_prefill_batch: waiting requests admitted+prefilled together per
+        step (batched prefill); 1 restores one-at-a-time prefill.
+    prefill_length_buckets: padded-length menu for batched prefill
+        (shared semantics with serving.ShapeBucketer); None = auto, a
+        geometric menu covering every admissible prompt.
+    jit_prefill: AOT-compile one prefill executable per (batch, length)
+        bucket; None = auto (on TPU only — XLA fusion drifts floats at
+        the ulp level, and the CPU tier-1 oracle demands bitwise token
+        identity, so CPU defaults to the eager exact path; the bucket
+        cache still bounds and counts shape signatures either way).
+    """
 
     def __init__(self, max_decode_slots=8, num_pages=256, page_size=16,
                  queue_depth=64, default_timeout_ms=None,
                  default_max_new_tokens=16, use_kernel=None,
-                 kv_dtype=np.float32):
+                 kv_dtype=np.float32, kv_backend=None, max_prefill_batch=4,
+                 prefill_length_buckets=None, jit_prefill=None):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -61,6 +84,16 @@ class GenerationConfig:
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.use_kernel = use_kernel  # None: auto (Pallas on TPU)
         self.kv_dtype = kv_dtype
+        if kv_backend not in (None, "host", "device"):
+            raise ValueError(
+                f"kv_backend must be 'host', 'device' or None (auto), "
+                f"got {kv_backend!r}")
+        self.kv_backend = kv_backend
+        self.max_prefill_batch = int(max_prefill_batch)
+        if self.max_prefill_batch < 1:
+            raise ValueError("max_prefill_batch must be >= 1")
+        self.prefill_length_buckets = prefill_length_buckets
+        self.jit_prefill = jit_prefill
 
 
 class GenerationResult:
@@ -140,10 +173,15 @@ class GenerationEngine:
     _IDLE_POLL_S = 0.02
 
     def __init__(self, model, config=None, metrics=None, start=True):
+        import jax
+
         self.model = model
         self.config = config or GenerationConfig()
         self.metrics = metrics or GenerationMetrics()
-        self.cache = PagedKVCache(
+        on_tpu = jax.default_backend() == "tpu"
+        backend = self.config.kv_backend or ("device" if on_tpu else "host")
+        cache_cls = DeviceKVPool if backend == "device" else PagedKVCache
+        self.cache = cache_cls(
             model.num_layers, model.num_heads, model.head_dim,
             num_pages=self.config.num_pages,
             page_size=self.config.page_size,
@@ -151,12 +189,53 @@ class GenerationEngine:
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, num_slots=self.config.max_decode_slots,
             queue_depth=self.config.queue_depth, metrics=self.metrics)
+        self._bucketer = self._build_bucketer()
+        jit_prefill = (self.config.jit_prefill if self.config.jit_prefill
+                       is not None else on_tpu)
+        # one prefill "executable" per (batch, length) bucket — AOT-
+        # compiled when jit_prefill, the raw eager fn otherwise (bitwise
+        # parity with the sequential oracle); either way the signature
+        # cache is the compile-count probe
+        self.prefill_cache = None
+        if hasattr(model, "prefill_batch"):
+            self.prefill_cache = CompiledModelCache(
+                model.prefill_batch, metrics=self.metrics, aot=jit_prefill)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
         self._thread = None
         if start:
             self.start()
+
+    def _build_bucketer(self):
+        """The prefill shape menu: batch buckets up to max_prefill_batch,
+        length buckets from config or a geometric auto-menu covering
+        every admissible prompt (capped so a padded bucket can never
+        exceed the model's max_positions)."""
+        cfg = self.config
+        batch = []
+        b = 1
+        while b < cfg.max_prefill_batch:
+            batch.append(b)
+            b *= 2
+        batch.append(cfg.max_prefill_batch)
+        max_pos = getattr(self.model, "max_positions", None)
+        lengths = cfg.prefill_length_buckets
+        if lengths is None:
+            limit = cfg.num_pages * cfg.page_size
+            if max_pos is not None:
+                limit = min(limit, int(max_pos))
+            menu = [x for x in ShapeBucketer.geometric_menu(limit)
+                    if x < limit]
+            lengths = tuple(menu) + (limit,)
+        elif max_pos is not None:
+            # a padded bucket may never exceed what the model can embed:
+            # clip oversized explicit entries to max_positions (buckets
+            # beyond the POOL are fine — padding is dropped, not written)
+            lengths = tuple(sorted({min(int(b), int(max_pos))
+                                    for b in lengths}))
+        return ShapeBucketer(batch_buckets=tuple(sorted(set(batch))),
+                             length_buckets=lengths)
 
     # --------------------------- client API -------------------------
     def submit(self, prompt, max_new_tokens=None, sampling=None,
@@ -176,8 +255,6 @@ class GenerationEngine:
                     else time.monotonic() + float(timeout_ms) / 1e3)
         max_pos = getattr(self.model, "max_positions", None)
         if max_pos is not None and len(prompt) + max_new_tokens > max_pos:
-            from ..serving.admission import RequestTooLargeError
-
             raise RequestTooLargeError(
                 f"prompt of {len(prompt)} + max_new_tokens="
                 f"{max_new_tokens} exceeds the model's max_positions="
@@ -212,11 +289,15 @@ class GenerationEngine:
     def _step_locked(self):
         from ..profiler import RecordEvent
 
-        for state in self.scheduler.admit():
-            self._prefill(state)
+        # bounded prefill work per step: at most one batched-prefill
+        # chunk's worth of admissions, so queued prompts cannot starve
+        # the decode batch of a whole step
+        admitted = self.scheduler.admit(limit=self.config.max_prefill_batch)
+        self._prefill_admitted(admitted)
         self._reap_deadlines()
         active = self.scheduler.active()
         if not active:
+            self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
             self._observe_occupancy()
             return 0
         with StepTimer() as timer:
@@ -228,6 +309,7 @@ class GenerationEngine:
                 for state, row in zip(active, logits):
                     self._on_logits(state, row)
         self.metrics.observe_step(len(active), timer.seconds)
+        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
         return len(active)
 
@@ -242,6 +324,79 @@ class GenerationEngine:
         return steps
 
     # --------------------------- internals --------------------------
+    def _prefill_admitted(self, states):
+        """Prefill newly admitted sequences, batched: group by padded-
+        length bucket, then run chunks of <= max_prefill_batch through
+        one model call each.  Models without `prefill_batch` fall back
+        to the per-sequence path."""
+        if not states:
+            return
+        if self.prefill_cache is None:
+            for state in states:
+                self._prefill(state)
+            return
+        groups = {}
+        for state in states:
+            try:
+                bucket = self._bucketer.length_bucket(len(state.tokens))
+            except RequestTooLargeError:
+                # beyond the explicit length menu — a long prompt, or an
+                # accepted sequence that GREW past the top bucket and is
+                # re-prefilling after preemption.  Serve it unbatched at
+                # its exact shape (one-off compile) rather than failing:
+                # admission is the only rejection point, and preemption
+                # must never change a request's outcome
+                self._prefill(state)
+                continue
+            groups.setdefault(bucket, []).append(state)
+        size = self.config.max_prefill_batch
+        for bucket in sorted(groups):
+            group = groups[bucket]
+            for i in range(0, len(group), size):
+                self._prefill_chunk(group[i:i + size])
+
+    def _prefill_chunk(self, states):
+        """One batched prefill: reserve every span, pad prompts to the
+        (batch, length) bucket, one model call, scatter the K/V spans
+        into the pool (padding positions are dropped, never written),
+        and sample each sequence's first token from its own row."""
+        from ..profiler import RecordEvent
+
+        ready = []
+        for state in states:
+            try:
+                start = self.cache.reserve(state.seq_id, len(state.tokens))
+            except OutOfPagesError as e:
+                # a lone sequence that outgrew the whole pool: typed
+                # failure (admit() covers every other capacity case)
+                self.scheduler.retire(state)
+                state.handle.set_exception(e)
+                continue
+            ready.append((state, start))
+        if not ready:
+            return
+        with RecordEvent("generation::prefill"):
+            tokens, lengths = self._bucketer.pad_token_batch(
+                [state.tokens for state, _ in ready])
+            b_real = len(ready)
+            # padded batch rows prefill a 1-token dummy (row 0 gather
+            # stays in bounds); their K/V and logits are discarded
+            lengths_padded = np.ones((tokens.shape[0],), np.int32)
+            lengths_padded[:b_real] = lengths
+            exe = self.prefill_cache.get([tokens, lengths_padded])
+            last_logits, k, v = exe(tokens, lengths_padded)
+            self.cache.write_prefill_batch(
+                [state.seq_id for state, _ in ready],
+                [start for _, start in ready], lengths,
+                k[:b_real], v[:b_real])
+        last_logits = np.asarray(last_logits)  # one device->host transfer
+        for i, (state, _) in enumerate(ready):
+            self.metrics.count_prefill(len(state.tokens))
+            # prefill's last-position logits ARE the next-token logits:
+            # new prompts sample their first token here, and a preempted
+            # sequence resumes exactly where its decode left off
+            self._on_logits(state, last_logits[i])
+
     def _prefill(self, state):
         from ..profiler import RecordEvent
 
@@ -307,14 +462,17 @@ class GenerationEngine:
         pt, lens = self.cache.gather_block_tables(seq_ids)
 
         def attend(layer, q, k_new, v_new):
-            k_new = np.asarray(k_new)
-            v_new = np.asarray(v_new)
-            for i, sid in enumerate(seq_ids):
-                self.cache.write_token(sid, layer, int(positions[i]),
-                                       k_new[i], v_new[i])
+            # one batched write per layer: host backend copies to numpy,
+            # DeviceKVPool runs a single donated scatter (O(B) tokens)
+            self.cache.write_decode_tokens(seq_ids, positions, layer,
+                                           k_new, v_new)
+            # layer_pools hands device-resident pools straight through —
+            # the host backend uploads O(pool) here, which is exactly
+            # what generation.kv_bytes_moved makes visible
+            k_pool, v_pool = self.cache.layer_pools(layer)
             return paged_decode_attention(
-                q, self.cache.k_pool[layer], self.cache.v_pool[layer],
-                pt, lens, use_kernel=self.config.use_kernel)
+                q, k_pool, v_pool, pt, lens,
+                use_kernel=self.config.use_kernel)
 
         return np.asarray(self.model.decode(tokens, positions, attend))
 

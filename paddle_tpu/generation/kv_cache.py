@@ -9,12 +9,23 @@ token touches at most one page; freeing a finished sequence returns whole
 pages to the free list, so memory utilization tracks the *actual* token
 count across ragged sequence lengths instead of ``B * L_max``.
 
-Pools live as host numpy arrays updated in place (the host-managed page
-table of a real serving stack); the decode kernel consumes them as device
-arrays together with the ``[B, max_pages]`` page-table / ``[B]`` seq-len
-tensors built by ``gather_block_tables``.  On-device pools with donated
-``dynamic_update_slice`` appends are the TPU production follow-up (see
-docs/GENERATION.md).
+Two storage backends share the bookkeeping (page tables, free list,
+reservation logic — always host-side):
+
+- ``PagedKVCache`` — host numpy pools updated in place.  Every decode
+  step must ship the WHOLE pool host->device for the attention call, so
+  the per-token cost scales with the pool (`layer_pools` counts those
+  bytes).
+- ``DeviceKVPool`` — the pools are device-resident ``jax.Array``s (HBM
+  on TPU), appended with jitted donated scatters (the batched form of
+  ``dynamic_update_slice``: XLA updates the donated buffer in place).
+  A decode step moves one token per sequence, not the pool — O(tokens)
+  bytes instead of O(pool) (docs/GENERATION.md "Device-resident pools").
+
+Both expose the same surface (``reserve`` / ``append`` /
+``append_prefill`` / ``gather_block_tables`` / the batched
+``write_decode_tokens`` / ``write_prefill_batch``), so the scheduler and
+the token-identity oracle never see the difference.
 """
 import math
 
@@ -25,6 +36,23 @@ class OutOfPagesError(RuntimeError):
     """The page pool is exhausted: no free page for a required append.
     The scheduler catches this to preempt (or reject) a sequence rather
     than corrupting another sequence's pages."""
+
+
+class UnknownSequenceError(KeyError):
+    """A cache operation named a seq_id the cache does not hold — never
+    allocated, already freed, or double-freed.  Typed (and loud) so a
+    scheduler bug fails the call instead of silently corrupting another
+    sequence's pages; subclasses KeyError so legacy handlers still
+    catch it."""
+
+    def __init__(self, seq_id, live_count):
+        super().__init__(seq_id)
+        self.seq_id = seq_id
+        self.live_count = live_count
+
+    def __str__(self):
+        return (f"unknown sequence {self.seq_id!r}: not allocated or "
+                f"already freed ({self.live_count} live sequence(s))")
 
 
 class PagedKVCache:
@@ -48,14 +76,25 @@ class PagedKVCache:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.dtype = np.dtype(dtype)
-        shape = (self.num_layers, self.num_pages, self.page_size,
-                 self.num_heads, self.head_dim)
-        self.k_pool = np.zeros(shape, self.dtype)
-        self.v_pool = np.zeros(shape, self.dtype)
         # LIFO free list: a just-freed (cache-warm) page is reused first
         self._free = list(range(self.num_pages - 1, -1, -1))
         self._tables = {}    # seq_id -> [page ids]
         self._lens = {}      # seq_id -> token count
+        self._bytes_moved = 0  # host<->device KV bytes (take_bytes_moved)
+        self._init_pools()
+
+    def _init_pools(self):
+        shape = (self.num_layers, self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        self.k_pool = np.zeros(shape, self.dtype)
+        self.v_pool = np.zeros(shape, self.dtype)
+
+    def _table(self, seq_id):
+        """The page table of a LIVE sequence; typed failure otherwise."""
+        try:
+            return self._tables[seq_id]
+        except KeyError:
+            raise UnknownSequenceError(seq_id, len(self._tables)) from None
 
     # ------------------------- allocation ---------------------------
     def allocate(self, seq_id):
@@ -66,8 +105,12 @@ class PagedKVCache:
         self._lens[seq_id] = 0
 
     def free(self, seq_id):
-        """Return every page of `seq_id` to the pool."""
-        pages = self._tables.pop(seq_id)
+        """Return every page of `seq_id` to the pool.  A double free (or
+        a free of a never-allocated id) raises UnknownSequenceError —
+        an explicit error, never a silent second release of pages that
+        may already belong to another sequence."""
+        pages = self._table(seq_id)
+        del self._tables[seq_id]
         del self._lens[seq_id]
         self._free.extend(reversed(pages))
 
@@ -83,9 +126,10 @@ class PagedKVCache:
 
     def pages_needed(self, seq_id, new_tokens):
         """Pages an append of `new_tokens` to `seq_id` would allocate."""
+        table = self._table(seq_id)
         length = self._lens[seq_id]
         return (math.ceil((length + new_tokens) / self.page_size)
-                - len(self._tables[seq_id]))
+                - len(table))
 
     def reserve(self, seq_id, new_tokens=1):
         """Grow `seq_id`'s page table to hold `new_tokens` more tokens and
@@ -103,27 +147,49 @@ class PagedKVCache:
         self._lens[seq_id] = start + new_tokens
         return start
 
-    # --------------------------- writes -----------------------------
-    def write_token(self, seq_id, layer, pos, k, v):
-        """Write one token's K/V for one layer at position `pos` (already
-        reserved).  k, v: ``[num_heads, head_dim]``."""
+    def _locate(self, seq_id, pos):
+        """(page, row) of an already-reserved position; typed errors."""
+        table = self._table(seq_id)
         if pos >= self._lens[seq_id]:
             raise IndexError(
                 f"position {pos} not reserved for {seq_id!r} "
                 f"(len={self._lens[seq_id]})")
-        page = self._tables[seq_id][pos // self.page_size]
-        row = pos % self.page_size
+        return table[pos // self.page_size], pos % self.page_size
+
+    def _count_write_payload(self, tokens, layers):
+        """K+V bytes a write pulls across the host<->device boundary —
+        the model computes K/V on device, so host-pool writes download
+        the payload (and DeviceKVPool scatters count the same bound)."""
+        self._bytes_moved += (2 * tokens * layers * self.num_heads *
+                              self.head_dim * self.dtype.itemsize)
+
+    # --------------------------- writes -----------------------------
+    def write_token(self, seq_id, layer, pos, k, v):
+        """Write one token's K/V for one layer at position `pos` (already
+        reserved).  k, v: ``[num_heads, head_dim]``."""
+        page, row = self._locate(seq_id, pos)
         self.k_pool[layer, page, row] = np.asarray(k, self.dtype)
         self.v_pool[layer, page, row] = np.asarray(v, self.dtype)
+        self._count_write_payload(1, 1)
+
+    def write_decode_tokens(self, seq_ids, positions, layer, k, v):
+        """Write one decode step's new tokens for one layer: sequence i's
+        token lands at its (already reserved) ``positions[i]``.  k, v:
+        ``[B, num_heads, head_dim]`` (any array-like; the host backend
+        copies to numpy)."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        for i, sid in enumerate(seq_ids):
+            self.write_token(sid, layer, int(positions[i]), k[i], v[i])
 
     def append(self, seq_id, k, v):
         """Append one token across every layer.  k, v:
         ``[num_layers, num_heads, head_dim]``.  Returns the position."""
         pos = self.reserve(seq_id, 1)
-        page = self._tables[seq_id][pos // self.page_size]
-        row = pos % self.page_size
+        page, row = self._locate(seq_id, pos)
         self.k_pool[:, page, row] = np.asarray(k, self.dtype)
         self.v_pool[:, page, row] = np.asarray(v, self.dtype)
+        self._count_write_payload(1, self.num_layers)
         return pos
 
     def append_prefill(self, seq_id, k, v):
@@ -143,21 +209,79 @@ class PagedKVCache:
             self.k_pool[:, page, row:row + take] = k[:, t:t + take]
             self.v_pool[:, page, row:row + take] = v[:, t:t + take]
             t += take
+        self._count_write_payload(n, self.num_layers)
         return start
 
+    def _check_span(self, seq_id, start, n):
+        """Typed validation that [start, start+n) is reserved."""
+        self._table(seq_id)
+        if int(start) + n > self._lens[seq_id]:
+            raise IndexError(
+                f"prefill span [{start}, {start + n}) not reserved "
+                f"for {seq_id!r} (len={self._lens[seq_id]})")
+
+    def write_prefill_batch(self, seq_ids, starts, lengths, k, v):
+        """Write a batch of (possibly length-padded) prefill K/V spans.
+        Sequence i's real tokens ``[:lengths[i]]`` land at positions
+        ``starts[i]:starts[i]+lengths[i]`` (already reserved); padded
+        positions ``lengths[i]:`` are dropped, NEVER written — padding
+        to a shape bucket must not touch pages the table doesn't own.
+        k, v: ``[B, num_layers, T_padded, num_heads, head_dim]``."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        for i, sid in enumerate(seq_ids):
+            n = int(lengths[i])
+            self._check_span(sid, int(starts[i]), n)
+            self._write_span(sid, int(starts[i]), k[i][:, :n], v[i][:, :n])
+
+    def _write_span(self, seq_id, start, k, v):
+        """Page-by-page copy of one reserved span (k, v: [L, n, H, D])."""
+        k = np.asarray(k, self.dtype)
+        v = np.asarray(v, self.dtype)
+        table = self._table(seq_id)
+        n = k.shape[1]
+        t = 0
+        while t < n:
+            pos = start + t
+            page = table[pos // self.page_size]
+            row = pos % self.page_size
+            take = min(self.page_size - row, n - t)
+            self.k_pool[:, page, row:row + take] = k[:, t:t + take]
+            self.v_pool[:, page, row:row + take] = v[:, t:t + take]
+            t += take
+        self._count_write_payload(n, self.num_layers)
+
     # --------------------------- reads ------------------------------
+    def layer_pools(self, layer):
+        """One layer's ``(k, v)`` pools for the attention call, counted
+        as host->device traffic: host-resident pools must ship the WHOLE
+        pool to the device every step — the O(pool) cost DeviceKVPool
+        exists to remove."""
+        k = self.k_pool[layer]
+        v = self.v_pool[layer]
+        self._bytes_moved += k.nbytes + v.nbytes
+        return k, v
+
+    def take_bytes_moved(self):
+        """Host<->device KV bytes accumulated since the last take — the
+        engine drains this once per decode step into
+        ``generation.kv_bytes_moved``."""
+        n, self._bytes_moved = self._bytes_moved, 0
+        return n
+
     def seq_len(self, seq_id):
+        self._table(seq_id)
         return self._lens[seq_id]
 
     def page_table(self, seq_id):
-        return tuple(self._tables[seq_id])
+        return tuple(self._table(seq_id))
 
     def gather_block_tables(self, seq_ids, max_pages=None):
         """Batch the page tables for the decode kernel: returns
         ``(page_tables [B, max_pages] int32, seq_lens [B] int32)``.
         Unused slots are padded with page id 0 — always a valid DMA
         target; the kernel's length mask zeroes their contribution."""
-        tables = [self._tables[s] for s in seq_ids]
+        tables = [self._table(s) for s in seq_ids]
         if max_pages is None:
             max_pages = max((len(t) for t in tables), default=1) or 1
         pt = np.zeros((len(seq_ids), max_pages), np.int32)
@@ -204,3 +328,175 @@ class PagedKVCache:
             "token_utilization_pct":
                 round(100.0 * self.token_utilization(), 1),
         }
+
+
+# ----------------------- device-resident backend ------------------------
+
+
+def _scatter_kv(k_pool, v_pool, pages, rows, k, v):
+    """Scatter `k[i]` / `v[i]` into `(pages[i], rows[i])` of one layer's
+    pools.  Donated: XLA performs the update in place, so an append
+    moves the token payload, never the pool.  Out-of-range page ids
+    (the padding sentinel ``num_pages``) are DROPPED — length-padded
+    prefill positions can never write past a sequence's page table."""
+    return (k_pool.at[pages, rows].set(k, mode="drop"),
+            v_pool.at[pages, rows].set(v, mode="drop"))
+
+
+def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v):
+    """Every layer's scatter in ONE dispatch (the indices are identical
+    across layers): k_pools/v_pools are length-L lists (all donated),
+    k/v are ``[L, n, H, D]``.  Prefill latency stays flat in depth
+    instead of paying L dispatches per chunk."""
+    return ([kp.at[pages, rows].set(k[i], mode="drop")
+             for i, kp in enumerate(k_pools)],
+            [vp.at[pages, rows].set(v[i], mode="drop")
+             for i, vp in enumerate(v_pools)])
+
+
+class DeviceKVPool(PagedKVCache):
+    """PagedKVCache whose pools live on the device (HBM on TPU).
+
+    Bookkeeping (page tables, free list, reservation) is inherited
+    unchanged and stays host-side; only the storage moves: per-layer
+    ``jax.Array`` pools ``[num_pages, page_size, H, D]`` appended with
+    jitted, buffer-donated scatters.  ``layer_pools`` hands the live
+    device arrays straight to the attention call — zero host->device
+    re-upload, which is the whole point: a decode step's KV traffic is
+    O(batch x layers x heads x head_dim), independent of the pool size.
+
+    The arrays returned by ``layer_pools`` are invalidated by the next
+    write (donation): read between writes, as the engine's step does.
+    ``k_pool`` / ``v_pool`` are DEBUG host copies, not the hot path.
+    """
+
+    def _init_pools(self):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        shape = (self.num_pages, self.page_size,
+                 self.num_heads, self.head_dim)
+        self._k = [jnp.zeros(shape, self.dtype)
+                   for _ in range(self.num_layers)]
+        self._v = [jnp.zeros(shape, self.dtype)
+                   for _ in range(self.num_layers)]
+        self._scatter, self._scatter_all = _jitted_scatter()
+
+    # --------------------------- writes -----------------------------
+    def _scatter_layer(self, layer, pages, rows, k, v, real_tokens):
+        jnp = self._jnp
+        kp, vp = self._k[layer], self._v[layer]
+        k = jnp.asarray(k).astype(self.dtype)
+        v = jnp.asarray(v).astype(self.dtype)
+        self._k[layer], self._v[layer] = self._scatter(
+            kp, vp, jnp.asarray(pages, jnp.int32),
+            jnp.asarray(rows, jnp.int32), k, v)
+        self._count_write_payload(real_tokens, 1)
+
+    def write_token(self, seq_id, layer, pos, k, v):
+        page, row = self._locate(seq_id, pos)
+        self._scatter_layer(layer, [page], [row],
+                            self._jnp.asarray(k)[None],
+                            self._jnp.asarray(v)[None], 1)
+
+    def write_decode_tokens(self, seq_ids, positions, layer, k, v):
+        pages, rows = [], []
+        for i, sid in enumerate(seq_ids):
+            page, row = self._locate(sid, int(positions[i]))
+            pages.append(page)
+            rows.append(row)
+        self._scatter_layer(layer, pages, rows, k, v, len(seq_ids))
+
+    def _scatter_layers_once(self, pages, rows, k, v, real_tokens):
+        """One donated dispatch covering every layer; k, v: [L, n, H, D]
+        (indices are the same per layer, so there is no reason to pay
+        num_layers dispatch latencies)."""
+        jnp = self._jnp
+        self._k, self._v = self._scatter_all(
+            self._k, self._v, jnp.asarray(pages, jnp.int32),
+            jnp.asarray(rows, jnp.int32),
+            jnp.asarray(k).astype(self.dtype),
+            jnp.asarray(v).astype(self.dtype))
+        self._count_write_payload(real_tokens, self.num_layers)
+
+    def append(self, seq_id, k, v):
+        pos = self.reserve(seq_id, 1)
+        page, row = self._locate(seq_id, pos)
+        k = self._jnp.asarray(k)[:, None]   # [L, 1, H, D]
+        v = self._jnp.asarray(v)[:, None]
+        self._scatter_layers_once([page], [row], k, v, 1)
+        return pos
+
+    def _span_pages_rows(self, seq_id, start, n, pad_to=None):
+        """(pages, rows) int32 for positions [start, start+n), padded to
+        `pad_to` entries with the DROP sentinel (page id num_pages)."""
+        table = self._table(seq_id)
+        pad_to = n if pad_to is None else pad_to
+        pages = np.full((pad_to,), self.num_pages, np.int32)
+        rows = np.zeros((pad_to,), np.int32)
+        pos = start + np.arange(n)
+        pages[:n] = np.asarray(table, np.int32)[pos // self.page_size]
+        rows[:n] = pos % self.page_size
+        return pages, rows
+
+    def append_prefill(self, seq_id, k, v):
+        k = self._jnp.asarray(k)                # [L, T, H, D]
+        v = self._jnp.asarray(v)
+        n = k.shape[1]
+        start = self.reserve(seq_id, n)
+        pages, rows = self._span_pages_rows(seq_id, start, n)
+        self._scatter_layers_once(pages, rows, k, v, n)
+        return start
+
+    def write_prefill_batch(self, seq_ids, starts, lengths, k, v):
+        k = self._jnp.asarray(k)
+        v = self._jnp.asarray(v)
+        b, _, t_pad = k.shape[:3]
+        all_pages = np.empty((b, t_pad), np.int32)
+        all_rows = np.empty((b, t_pad), np.int32)
+        for i, sid in enumerate(seq_ids):
+            n = int(lengths[i])
+            self._check_span(sid, int(starts[i]), n)
+            all_pages[i], all_rows[i] = self._span_pages_rows(
+                sid, int(starts[i]), n, pad_to=t_pad)
+        real = int(np.sum(np.asarray(lengths)))
+        h, d = self.num_heads, self.head_dim
+        # [B, L, Tp, H, D] -> [L, B*Tp, H, D]: one flattened scatter
+        # covering the whole chunk across every layer
+        lk = self._jnp.transpose(k, (1, 0, 2, 3, 4)).reshape(
+            self.num_layers, b * t_pad, h, d)
+        lv = self._jnp.transpose(v, (1, 0, 2, 3, 4)).reshape(
+            self.num_layers, b * t_pad, h, d)
+        self._scatter_layers_once(all_pages.reshape(-1),
+                                  all_rows.reshape(-1), lk, lv, real)
+
+    # --------------------------- reads ------------------------------
+    def layer_pools(self, layer):
+        """The live device arrays — nothing crosses the host<->device
+        boundary here, unlike the host backend's O(pool) upload."""
+        return self._k[layer], self._v[layer]
+
+    @property
+    def k_pool(self):
+        """Host copy ``[L, P, page_size, H, D]`` (debug/tests only)."""
+        return np.stack([np.asarray(p) for p in self._k])
+
+    @property
+    def v_pool(self):
+        return np.stack([np.asarray(p) for p in self._v])
+
+
+def _jitted_scatter():
+    """The shared jitted donated scatters (module-level cache: every
+    pool instance reuses the same executables per shape signature)."""
+    global _SCATTER_JIT
+    if _SCATTER_JIT is None:
+        import jax
+
+        _SCATTER_JIT = (jax.jit(_scatter_kv, donate_argnums=(0, 1)),
+                        jax.jit(_scatter_kv_all_layers,
+                                donate_argnums=(0, 1)))
+    return _SCATTER_JIT
+
+
+_SCATTER_JIT = None

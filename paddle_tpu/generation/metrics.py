@@ -19,6 +19,14 @@ Metric names:
 - ``generation.tokens_total``         tokens generated (sampled)
 - ``generation.finished_total``       sequences completed
 - ``generation.preempted_total``      sequences preempted (pages reclaimed)
+- ``generation.kv_bytes_moved``       KV bytes across the host<->device
+                                      boundary (host pools: the whole pool
+                                      per layer per step; DeviceKVPool:
+                                      just the appended token payload —
+                                      the O(pool) vs O(tokens) A/B)
+- ``generation.prefill_compiles_total``  batched-prefill executables built
+                                      (== (batch, length) buckets touched)
+- ``generation.prefill_cache_hits`` / ``_misses``  prefill bucket cache
 - ``generation.tokens_per_s``         gauge: decode throughput (EWMA)
 - ``generation.slot_occupancy_pct``   gauge: active / decode slots
 - ``generation.page_utilization_pct`` gauge: pool pages in use
@@ -38,6 +46,10 @@ PREFILL_TOKENS_TOTAL = PREFIX + "prefill_tokens_total"
 TOKENS_TOTAL = PREFIX + "tokens_total"
 FINISHED_TOTAL = PREFIX + "finished_total"
 PREEMPTED_TOTAL = PREFIX + "preempted_total"
+KV_BYTES_MOVED = PREFIX + "kv_bytes_moved"
+PREFILL_COMPILES_TOTAL = PREFIX + "prefill_compiles_total"
+PREFILL_CACHE_HITS = PREFIX + "prefill_cache_hits"
+PREFILL_CACHE_MISSES = PREFIX + "prefill_cache_misses"
 TOKENS_PER_S = PREFIX + "tokens_per_s"
 SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
 PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
@@ -83,6 +95,20 @@ class GenerationMetrics:
 
     def count_preempted(self, n=1):
         self._stat(PREEMPTED_TOTAL).increase(n)
+
+    def count_kv_bytes(self, n):
+        """KV bytes the cache moved (or would move) host<->device this
+        step — the engine drains PagedKVCache.take_bytes_moved() here."""
+        if n:
+            self._stat(KV_BYTES_MOVED).increase(int(n))
+
+    # --- CompiledModelCache metrics interface (prefill bucket cache) ---
+    def count_cache(self, hit):
+        self._stat(PREFILL_CACHE_HITS if hit
+                   else PREFILL_CACHE_MISSES).increase()
+
+    def count_compile(self):
+        self._stat(PREFILL_COMPILES_TOTAL).increase()
 
     # --- per-step observation ---
     def observe_step(self, tokens, step_seconds):

@@ -7,6 +7,14 @@ Two forward paths over the SAME weights:
 - `prefill(tokens)` — dense causal attention over the whole prefix
   (full recompute), returning the last position's logits plus every
   position's per-layer K/V for the paged cache;
+- `prefill_batch(tokens, lengths)` — the bucketed-batch variant: B
+  length-padded prompts in one dense causal pass.  Causality makes the
+  padding invisible (a padded position only ever sits AFTER every real
+  position it could have influenced), and the batched einsums evaluate
+  each sequence's rows with the same reduction order as the single-
+  sequence path, so real rows are BITWISE equal to `prefill` — the
+  property that lets the engine batch prefills under the zero-tolerance
+  token-identity oracle;
 - `decode(tokens, positions, attend)` — one token per sequence, with
   attention delegated to the engine's paged-KV callback.
 
@@ -18,9 +26,11 @@ token for token.
 """
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import decode_attention
 from .decode_attention import dense_causal_reference
 
 
@@ -118,6 +128,47 @@ class TinyCausalLM:
                                                blk["ln2_b"]))
         logits = self._logits(x[t - 1:t])[0]
         return logits, jnp.stack(ks), jnp.stack(vs)
+
+    # -------------------------- batched prefill -----------------------
+    def prefill_batch(self, tokens, lengths):
+        """tokens: [B, T] ints, length-padded (pad ids are real vocab
+        rows — harmless, their K/V and logits are discarded); lengths:
+        [B] real token counts.  Returns (last_logits [B, V] taken at
+        each sequence's lengths-1, k [B, L, T, H, D], v [B, L, T, H, D]).
+
+        Bounds are checked via the STATIC padded length (jit-safe), so
+        this lowers cleanly when the engine AOT-compiles per bucket."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        b, t = tokens.shape
+        if t > self.max_positions:
+            raise ValueError(
+                f"padded length {t} > max_positions={self.max_positions}")
+        h, dd = self.num_heads, self.head_dim
+        scale = 1.0 / math.sqrt(dd)
+        x = self.tok_emb[tokens] + self.pos_emb[
+            jnp.arange(t, dtype=jnp.int32)][None]
+        causal = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        ks, vs = [], []
+        for blk in self.blocks:
+            hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+            q = (hn @ blk["wq"]).reshape(b, t, h, dd)
+            k = (hn @ blk["wk"]).reshape(b, t, h, dd)
+            v = (hn @ blk["wv"]).reshape(b, t, h, dd)
+            ks.append(k)
+            vs.append(v)
+            # dense_causal_reference with a batch axis: same einsum
+            # contraction order per sequence, so bitwise-equal rows
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            logits = jnp.where(causal[None, None], logits,
+                               decode_attention.NEG_INF)
+            weights = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+            x = x + attn.reshape(b, t, self.d_model) @ blk["wo"]
+            x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                               blk["ln2_b"]))
+        last = x[jnp.arange(b), lengths - 1]
+        return self._logits(last), jnp.stack(ks, 1), jnp.stack(vs, 1)
 
     # ----------------------------- decode ----------------------------
     def decode(self, tokens, positions, attend):

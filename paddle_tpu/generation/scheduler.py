@@ -30,7 +30,7 @@ import math
 
 from ..serving.admission import (AdmissionQueue, DeadlineExceededError,
                                  Request, RequestTooLargeError, ServingError)
-from .kv_cache import OutOfPagesError
+from .kv_cache import OutOfPagesError, UnknownSequenceError
 
 
 class GenerationRequest(Request):
@@ -128,16 +128,21 @@ class ContinuousBatchingScheduler:
                 return
         raise AssertionError("no free slot (checked by caller)")
 
-    def admit(self):
+    def admit(self, limit=None):
         """Move work into free slots while pages allow; returns the newly
         placed SequenceStates (each needs a prefill over state.tokens).
         Head-of-line on capacity: admission stops at the first item that
-        doesn't fit, preserving arrival order."""
+        doesn't fit, preserving arrival order.  `limit` caps admissions
+        per call — the engine passes its prefill batch size, so one
+        step's prefill work is one batched chunk, never a whole queue
+        (prefill/decode interleaving keeps time-to-next-token bounded
+        for sequences already decoding)."""
         admitted = []
         committed = 0  # pages promised to THIS call's earlier admits
         # (their prefills run after admit() returns, so num_free_pages
         # alone would let several admits all claim the same free pages)
-        while self.free_slots() > 0:
+        while self.free_slots() > 0 and (limit is None
+                                         or len(admitted) < limit):
             item = self._pending.popleft() if self._pending else \
                 self.queue.poll(timeout=0)
             if item is None:
@@ -222,5 +227,5 @@ class ContinuousBatchingScheduler:
 
 __all__ = [
     "ContinuousBatchingScheduler", "GenerationRequest", "SequenceState",
-    "DeadlineExceededError", "OutOfPagesError",
+    "DeadlineExceededError", "OutOfPagesError", "UnknownSequenceError",
 ]

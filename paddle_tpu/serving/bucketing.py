@@ -49,6 +49,19 @@ class ShapeBucketer:
             _check_buckets("length_buckets", length_buckets)
         self.pad_value = pad_value
 
+    @staticmethod
+    def geometric_menu(limit, start=8):
+        """A power-of-two bucket menu covering [1, limit]: (start,
+        2*start, ..., first power >= limit).  log2(limit) buckets bound
+        the compile count while wasting at most 2x padding — the
+        standard serving trade (docs/SERVING.md)."""
+        limit = max(int(limit), 1)
+        start = max(int(start), 1)
+        menu = [start]
+        while menu[-1] < limit:
+            menu.append(menu[-1] * 2)
+        return tuple(menu)
+
     @property
     def max_batch(self):
         return self.batch_buckets[-1]
@@ -100,6 +113,21 @@ class ShapeBucketer:
             out.append(a)
         return out
 
+    def pad_token_batch(self, seqs, dtype=np.int32):
+        """Pad ragged token-id sequences into one bucketed batch:
+        returns ``(tokens [batch_bucket, length_bucket], lengths [B])``
+        — the prefill-side entry point (generation's batched prefill
+        and any token-in serving model share this menu)."""
+        lens = np.asarray([len(s) for s in seqs], np.int32)
+        if len(seqs) == 0:
+            raise ValueError("pad_token_batch needs at least one sequence")
+        bb = self.batch_bucket(len(seqs))
+        lb = self.length_bucket(int(lens.max()))
+        out = np.full((bb, lb), self.pad_value, dtype)
+        for i, s in enumerate(seqs):
+            out[i, :len(s)] = s
+        return out, lens
+
     def pad_batch(self, arrays, rows):
         """Pad axis 0 from `rows` to the batch bucket; returns (padded
         arrays, bucket_rows)."""
@@ -136,11 +164,21 @@ class CompiledModelCache:
     `serving.compiles_total`); every later request is a cache hit that
     goes straight to the executable — the compile-reuse contract the
     bucket menu exists to enable.
+
+    ``aot=False`` keeps the per-signature cache and its counters but
+    skips jax.jit: every signature "compiles" to the raw fn, dispatched
+    eagerly.  Callers needing BITWISE parity with an unbatched eager
+    path use this — XLA whole-program fusion reassociates float
+    reductions at the ulp level, which generation's zero-tolerance
+    token-identity oracle cannot absorb (docs/GENERATION.md).
+    compile_count then still means "distinct shape signatures
+    dispatched" — the number the bucket menu exists to bound.
     """
 
-    def __init__(self, fn, metrics=None):
+    def __init__(self, fn, metrics=None, aot=True):
         self._fn = fn
         self._metrics = metrics or ServingMetrics()
+        self._aot = bool(aot)
         self._cache = {}
         self._lock = threading.Lock()
         self.compile_count = 0
@@ -154,6 +192,8 @@ class CompiledModelCache:
 
         from ..profiler import RecordEvent
 
+        if not self._aot:
+            return self._fn
         avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
         with RecordEvent("serving::compile"):
             try:

@@ -1,0 +1,448 @@
+"""Device-resident paged KV cache + bucketed batched prefill.
+
+Acceptance oracles for the DeviceKVPool tentpole (all CPU; jax arrays on
+the CPU backend behave identically to TPU HBM for correctness):
+
+1. DeviceKVPool is a drop-in PagedKVCache: identical pool contents for
+   identical op sequences, same typed errors, same bookkeeping.
+2. Greedy continuous-batched decode through DeviceKVPool + batched
+   prefill is TOKEN-IDENTICAL to the sequential full-recompute oracle —
+   including under forced preemption.
+3. generation.kv_bytes_moved per decode step is O(batch x layers x
+   heads x head_dim) for the device backend — INDEPENDENT of num_pages —
+   while the host backend pays O(pool) per step.
+4. Batched prefill compiles (dispatches) at most one executable per
+   (batch, length) bucket — the ShapeBucketer menu bounds the signature
+   count (the serving compile-reuse contract, applied to prefill).
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving.admission import RequestTooLargeError
+from paddle_tpu.serving.bucketing import ShapeBucketer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _engine(model, *, slots=4, pages=64, page_size=4, backend="device",
+            start=False, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size, kv_backend=backend,
+                               **kw)
+    return gen.GenerationEngine(model, cfg, start=start)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+# ------------------------- DeviceKVPool parity ---------------------------
+
+
+def test_device_pool_is_dropin_for_host_pool():
+    """Same op sequence -> bitwise-identical pool contents on both
+    backends (append_prefill, append, write_decode_tokens)."""
+    rng = np.random.default_rng(0)
+    host = gen.PagedKVCache(2, 2, 8, num_pages=8, page_size=4)
+    dev = gen.DeviceKVPool(2, 2, 8, num_pages=8, page_size=4)
+    for c in (host, dev):
+        c.allocate("s")
+        c.allocate("t")
+    k = rng.standard_normal((2, 6, 2, 8)).astype(np.float32)
+    tok = rng.standard_normal((2, 2, 8)).astype(np.float32)
+    step = rng.standard_normal((2, 2, 8)).astype(np.float32)
+    for c in (host, dev):
+        c.append_prefill("s", k, -k)
+        c.append("t", tok, -tok)
+        c.reserve("s", 1)
+        c.reserve("t", 1)
+        c.write_decode_tokens(["s", "t"], [6, 1], 0, step, -step)
+    np.testing.assert_array_equal(host.k_pool, dev.k_pool)
+    np.testing.assert_array_equal(host.v_pool, dev.v_pool)
+    assert host.page_table("s") == dev.page_table("s")
+    assert host.num_free_pages == dev.num_free_pages
+
+
+def test_device_pool_prefill_batch_padding_never_writes_past_table():
+    """Length-padded prefill spans drop their padding positions: pages
+    the table doesn't own stay untouched (the sentinel-page guarantee,
+    degenerate-pool satellite)."""
+    rng = np.random.default_rng(1)
+    dev = gen.DeviceKVPool(1, 1, 4, num_pages=4, page_size=2)
+    dev.allocate(0)
+    dev.reserve(0, 3)  # 2 pages of 4
+    # padded to 8 positions >> the 3 reserved
+    k = rng.standard_normal((1, 1, 8, 1, 4)).astype(np.float32)
+    dev.write_prefill_batch([0], [0], [3], k, -k)
+    pool = dev.k_pool
+    owned = set(dev.page_table(0))
+    for page in range(4):
+        if page not in owned:
+            np.testing.assert_array_equal(pool[:, page], 0.0)
+    # and the written rows match the unpadded span
+    for t in range(3):
+        np.testing.assert_array_equal(
+            pool[0, dev.page_table(0)[t // 2], t % 2], k[0, 0, t])
+
+
+def test_device_pool_page_size_one_layout():
+    dev = gen.DeviceKVPool(1, 1, 4, num_pages=8, page_size=1)
+    dev.allocate("a")
+    k = np.arange(5 * 4, dtype=np.float32).reshape(1, 5, 1, 4)
+    dev.append_prefill("a", k, -k)
+    assert len(dev.page_table("a")) == 5  # one page per token
+    for t in range(5):
+        np.testing.assert_array_equal(
+            dev.k_pool[0, dev.page_table("a")[t], 0], k[0, t])
+
+
+# ----------------------- typed sequence errors ---------------------------
+
+
+@pytest.mark.parametrize("cls", [gen.PagedKVCache, gen.DeviceKVPool])
+def test_unknown_sequence_typed_errors(cls):
+    c = cls(1, 1, 4, num_pages=4, page_size=2)
+    with pytest.raises(gen.UnknownSequenceError, match="'ghost'"):
+        c.free("ghost")
+    with pytest.raises(gen.UnknownSequenceError):
+        c.seq_len("ghost")
+    with pytest.raises(gen.UnknownSequenceError):
+        c.page_table("ghost")
+    with pytest.raises(gen.UnknownSequenceError):
+        c.reserve("ghost", 1)
+
+
+@pytest.mark.parametrize("cls", [gen.PagedKVCache, gen.DeviceKVPool])
+def test_double_free_is_loud_never_corrupting(cls):
+    """A double free raises (with the live count in the message) and
+    does NOT return pages twice — the free list stays consistent."""
+    c = cls(1, 1, 4, num_pages=4, page_size=2)
+    c.allocate("a")
+    c.allocate("b")
+    c.reserve("a", 4)
+    c.free("a")
+    assert c.num_free_pages == 4
+    with pytest.raises(gen.UnknownSequenceError, match="1 live"):
+        c.free("a")
+    assert c.num_free_pages == 4  # no second release
+    # the error subclasses KeyError for legacy handlers
+    assert issubclass(gen.UnknownSequenceError, KeyError)
+
+
+# ------------------- engine oracles on the device pool -------------------
+
+
+def test_device_backend_token_identical_to_sequential(model):
+    """Acceptance: device pool + batched prefill == sequential
+    full-recompute, token for token; every page returns."""
+    eng = _engine(model)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        res = h.result(timeout=5)
+        assert res.token_ids == model.greedy_reference(p, 12)
+    assert eng.cache.utilization() == 0.0
+    assert eng.cache.num_free_pages == eng.cache.num_pages
+    eng.shutdown()
+
+
+def test_device_backend_token_identical_under_forced_preemption(model):
+    """Acceptance: a thrashing pool forces preemption; victims re-enter
+    through BATCHED prefill and still reproduce the oracle exactly."""
+    eng = _engine(model, pages=9)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == model.greedy_reference(p, 12)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_device_backend_background_worker(model):
+    eng = _engine(model, start=True)
+    try:
+        h = eng.submit([5, 6, 7], max_new_tokens=8)
+        assert list(h.tokens(timeout=30)) == model.greedy_reference(
+            [5, 6, 7], 8)
+    finally:
+        eng.shutdown()
+
+
+def test_page_size_one_engine_end_to_end(model):
+    eng = _engine(model, pages=80, page_size=1)
+    handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == model.greedy_reference(p, 8)
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_pool_smaller_than_top_length_bucket_preempts_or_rejects(model):
+    """Degenerate pool: the top prefill bucket (64) pads far past the
+    12-row pool.  Admissible prompts must still finish exactly (padding
+    positions are dropped, never written); prompts that can NEVER fit
+    are rejected typed at submit."""
+    eng = _engine(model, pages=3, page_size=4,
+                  prefill_length_buckets=(64,))
+    with pytest.raises(RequestTooLargeError):
+        eng.submit(list(range(1, 14)), max_new_tokens=1)  # 13 > 12 rows
+    handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS[:2]]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS[:2]):
+        assert h.result(timeout=5).token_ids == model.greedy_reference(p, 6)
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_prompt_beyond_explicit_length_menu_falls_back_unbatched(model):
+    """A prompt past the explicit menu's top bucket is served UNBATCHED
+    at its exact shape (one-off compile) — admission is the only
+    rejection point, so the menu bounds compiled shapes, never
+    outcomes."""
+    eng = _engine(model, pages=16, page_size=4,
+                  prefill_length_buckets=(8,))
+    long_prompt = list(range(1, 11))  # 10 > bucket 8
+    h = eng.submit(long_prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    assert h.result(timeout=5).token_ids == \
+        model.greedy_reference(long_prompt, 4)
+    assert eng.prefill_cache.compile_count == 0  # bypassed the cache
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_preempted_sequence_outgrowing_top_bucket_still_finishes(model):
+    """Review-found corner: an accepted request whose tokens GROW past
+    the largest explicit bucket must survive preemption — re-prefill
+    falls back to the unbatched path instead of raising
+    RequestTooLargeError mid-generation (preemption changes WHEN tokens
+    are computed, never WHICH)."""
+    prompts = [[1, 2, 3, 4, 5], [6, 7, 8, 9, 10]]
+    eng = _engine(model, slots=2, pages=4, page_size=4,
+                  prefill_length_buckets=(8,))
+    handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]  # none may raise
+    for res, p in zip(results, prompts):
+        assert res.token_ids == model.greedy_reference(p, 8)
+    assert sum(r.preemptions for r in results) > 0  # 5+8 > 8: did thrash
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_explicit_bucket_beyond_max_positions_is_clamped(model):
+    """Review-found corner: an explicit bucket larger than the model's
+    max_positions is clipped at engine build — a valid prompt must not
+    poison the step with an untyped padded-length error."""
+    assert model.max_positions == 512
+    eng = _engine(model, pages=256, page_size=4,
+                  prefill_length_buckets=(8, 1024))
+    assert eng._bucketer.length_buckets == (8, 512)
+    p = list(range(1, 11))
+    h = eng.submit(p, max_new_tokens=3)
+    eng.run_until_idle()
+    assert h.result(timeout=5).token_ids == model.greedy_reference(p, 3)
+    eng.shutdown()
+
+
+# ----------------------------- bf16 pools --------------------------------
+
+
+def test_bf16_pool_reserve_append_attention_reference():
+    """kv_dtype=bfloat16 end to end at the cache level: reserve ->
+    append -> paged attention reference, on BOTH backends, equals dense
+    attention over the bf16-rounded K/V (storage rounds, math is fp32)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    L, H, D, T = 1, 2, 8, 10
+    k = rng.standard_normal((L, T, H, D)).astype(np.float32)
+    v = rng.standard_normal((L, T, H, D)).astype(np.float32)
+    q = rng.standard_normal((1, H, D)).astype(np.float32)
+    outs = []
+    for cls in (gen.PagedKVCache, gen.DeviceKVPool):
+        c = cls(L, H, D, num_pages=8, page_size=4, dtype=jnp.bfloat16)
+        c.allocate(0)
+        c.append_prefill(0, k[:, :-1], v[:, :-1])
+        c.append(0, k[:, -1], v[:, -1])
+        assert c.seq_len(0) == T
+        pt, sl = c.gather_block_tables([0])
+        kp, vp = c.layer_pools(0)
+        outs.append(np.asarray(gen.paged_decode_attention_reference(
+            q, kp, vp, pt, sl)))
+    # dense over the SAME bf16-rounded tensors, fp32 math
+    kr = np.asarray(jnp.asarray(k[0]).astype(jnp.bfloat16), np.float32)
+    vr = np.asarray(jnp.asarray(v[0]).astype(jnp.bfloat16), np.float32)
+    full_q = np.concatenate([np.zeros((T - 1, H, D), np.float32), q])
+    dense = np.asarray(gen.dense_causal_reference(full_q, kr, vr))[-1]
+    for out in outs:
+        np.testing.assert_allclose(out[0], dense, atol=1e-6, rtol=1e-6)
+    np.testing.assert_array_equal(outs[0], outs[1])  # backends agree
+
+
+def test_bf16_pool_engine_host_device_token_parity(model):
+    """Both backends round K/V at storage identically (RNE), so whole
+    generations agree token for token even in bf16."""
+    import jax.numpy as jnp
+
+    toks = {}
+    for backend in ("host", "device"):
+        eng = _engine(model, backend=backend, kv_dtype=jnp.bfloat16)
+        handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+        eng.run_until_idle()
+        toks[backend] = [h.result(timeout=5).token_ids for h in handles]
+        assert eng.cache.utilization() == 0.0
+        eng.shutdown()
+    assert toks["host"] == toks["device"]
+
+
+# ------------------------ kv_bytes_moved accounting ----------------------
+
+
+def _steady_decode_bytes(model, backend, pages):
+    """Per-step kv_bytes_moved deltas for pure-decode steps (prefill
+    already drained), plus the engine geometry."""
+    eng = _engine(model, slots=4, pages=pages, page_size=4,
+                  backend=backend)
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=10)
+    stat = eng.metrics._stat(gmetrics.KV_BYTES_MOVED)
+    eng.step()  # admit + prefill + first decode
+    deltas = []
+    for _ in range(4):
+        before = stat.get()
+        advanced = eng.step()
+        assert advanced == 4  # all slots decoding
+        deltas.append(stat.get() - before)
+    eng.run_until_idle()
+    eng.shutdown()
+    return deltas
+
+
+def test_kv_bytes_device_is_o_tokens_independent_of_pool(model):
+    """Acceptance: device-pool bytes per decode step are bounded by
+    O(batch x layers x heads x head_dim) and do NOT grow with
+    num_pages; host-pool bytes DO scale with the pool."""
+    b, lyr, h, d = 4, model.num_layers, model.num_heads, model.head_dim
+    small = _steady_decode_bytes(model, "device", pages=32)
+    big = _steady_decode_bytes(model, "device", pages=256)
+    assert small == big  # pool size invisible to the device backend
+    payload = 2 * b * lyr * h * d * 4  # k+v token payload, fp32
+    for delta in small:
+        assert 0 < delta <= payload
+    # host backend: every step re-ships both pools per layer, plus the
+    # same O(tokens) write payload the device backend pays
+    host_small = _steady_decode_bytes(model, "host", pages=32)[0]
+    host_big = _steady_decode_bytes(model, "host", pages=256)[0]
+
+    def pool_ship(pages):
+        return lyr * 2 * pages * 4 * h * d * 4  # per layer: k+v pools
+
+    assert host_small == pool_ship(32) + payload
+    assert host_big == pool_ship(256) + payload  # O(pool) per step
+    assert host_big > 100 * max(small)  # the A/B the bench makes visible
+
+
+def test_kv_bytes_visible_in_stats_snapshot(model):
+    eng = _engine(model)
+    eng.submit(PROMPTS[0], max_new_tokens=4)
+    eng.run_until_idle()
+    snap = StatRegistry.instance().stats_snapshot("generation.")
+    assert snap["stats"]["generation.kv_bytes_moved"] > 0
+    assert eng.stats()["generation.kv_bytes_moved"] > 0
+    eng.shutdown()
+
+
+# --------------------- batched prefill compile probe ---------------------
+
+
+def test_batched_prefill_compiles_once_per_bucket_pair(model):
+    """Acceptance: the prefill executable cache sees at most ONE entry
+    per (batch, length) bucket — re-traffic into a seen bucket never
+    compiles again (serving's compile-count probe, applied here)."""
+    eng = _engine(model, slots=4, pages=64, max_prefill_batch=4,
+                  prefill_length_buckets=(8, 16))
+    rng = np.random.default_rng(11)
+
+    def burst(lengths):
+        handles = [eng.submit(rng.integers(1, 40, n).tolist(),
+                              max_new_tokens=2) for n in lengths]
+        eng.run_until_idle()
+        for handle in handles:
+            handle.result(timeout=5)
+
+    burst([3, 5, 2, 7])       # one chunk: (batch 4, length 8)
+    assert eng.prefill_cache.compile_count == 1
+    burst([4, 6, 1, 3])       # same buckets -> pure cache hits
+    assert eng.prefill_cache.compile_count == 1
+    burst([12, 14])           # (batch 2, length 16)
+    assert eng.prefill_cache.compile_count == 2
+    burst([13, 15])
+    assert eng.prefill_cache.compile_count == 2
+    stats = eng.metrics.snapshot()
+    assert stats["generation.prefill_compiles_total"] == 2
+    assert stats["generation.prefill_cache_hits"] > 0
+    eng.shutdown()
+
+
+def test_batched_prefill_jit_mode_compiles_once_and_matches(model):
+    """jit_prefill=True (the TPU default): AOT executables per bucket,
+    same compile bound; greedy tokens still match the oracle on the
+    test seeds."""
+    eng = _engine(model, jit_prefill=True,
+                  prefill_length_buckets=(8,), max_prefill_batch=4)
+    handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == model.greedy_reference(p, 8)
+    assert eng.prefill_cache.compile_count == 1
+    eng.shutdown()
+
+
+def test_prefill_batch_model_matches_single_prefill_bitwise(model):
+    """The protocol contract batched prefill rests on: prefill_batch's
+    real rows are BITWISE equal to per-sequence prefill (padding is
+    invisible under causal attention + identical reduction order)."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 40, n).tolist() for n in (13, 5, 24, 1)]
+    tokens, lengths = ShapeBucketer(
+        batch_buckets=(4,), length_buckets=(32,)).pad_token_batch(prompts)
+    logits_b, k_b, v_b = model.prefill_batch(tokens, lengths)
+    for i, p in enumerate(prompts):
+        logits_1, k_1, v_1 = model.prefill(np.asarray(p, np.int32))
+        t = len(p)
+        np.testing.assert_array_equal(np.asarray(logits_1),
+                                      np.asarray(logits_b)[i])
+        np.testing.assert_array_equal(np.asarray(k_1),
+                                      np.asarray(k_b)[i, :, :t])
+        np.testing.assert_array_equal(np.asarray(v_1),
+                                      np.asarray(v_b)[i, :, :t])
+
+
+def test_bucketer_geometric_menu_and_token_padding():
+    menu = ShapeBucketer.geometric_menu(100, start=8)
+    assert menu == (8, 16, 32, 64, 128)
+    bk = ShapeBucketer(batch_buckets=(1, 2, 4), length_buckets=menu)
+    tokens, lengths = bk.pad_token_batch([[1, 2, 3], [4]])
+    assert tokens.shape == (2, 8) and lengths.tolist() == [3, 1]
+    assert tokens[0, :3].tolist() == [1, 2, 3] and tokens[0, 3:].sum() == 0
+    tokens, _ = bk.pad_token_batch([[1]] * 3)
+    assert tokens.shape == (4, 8)  # batch padded to the 4-bucket

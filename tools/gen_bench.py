@@ -31,25 +31,40 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     _jax.config.update("jax_platforms", "cpu")
 
 
-def bench_cell(model, batch, context, new_tokens, num_pages, page_size):
+def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
+               pool):
     from paddle_tpu import generation as g
+    from paddle_tpu.generation import metrics as gmetrics
+    from paddle_tpu.profiler.monitor import StatRegistry
 
     eng = g.GenerationEngine(
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
-                           page_size=page_size, queue_depth=batch * 2),
+                           page_size=page_size, queue_depth=batch * 2,
+                           kv_backend=pool),
         start=False)
     rng = np.random.default_rng(batch * 1000 + context)
     prompts = [rng.integers(0, model.vocab_size, context).tolist()
                for _ in range(batch)]
+    reg = StatRegistry.instance()
+    kv_stat = reg.get_stat(gmetrics.KV_BYTES_MOVED)
+    pf_stat = reg.get_stat(gmetrics.PREFILL_TOKENS_TOTAL)
+    kv_before, pf_before = kv_stat.get(), pf_stat.get()
     t0 = time.perf_counter()
     handles = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
     eng.run_until_idle()
     dt = time.perf_counter() - t0
     results = [h.result(timeout=1) for h in handles]
     generated = sum(len(r.token_ids) for r in results)
+    kv_bytes = int(kv_stat.get() - kv_before)
+    # prefill writes (incl. preemption re-prefills) are exactly the
+    # prefill token count x K+V payload; subtracting them leaves the
+    # decode-side traffic the O(pool)-vs-O(tokens) A/B is about
+    prefill_bytes = (int(pf_stat.get() - pf_before) * 2 * model.num_layers
+                     * model.num_heads * model.head_dim * 4)
     eng.shutdown()
     return {
+        "pool": pool,
         "batch": batch,
         "context": context,
         "new_tokens": new_tokens,
@@ -57,6 +72,13 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size):
         "wall_s": round(dt, 4),
         "tokens_per_s": round(generated / dt, 2) if dt > 0 else 0.0,
         "preemptions": sum(r.preemptions for r in results),
+        "kv_bytes_moved": kv_bytes,          # total, prefill included
+        "kv_prefill_bytes": prefill_bytes,
+        # decode-side bytes per generated token: O(pool) for host pools,
+        # O(batch x layers x heads x head_dim) for DeviceKVPool —
+        # context-independent by construction for the device backend
+        "kv_decode_bytes_per_token": round(
+            (kv_bytes - prefill_bytes) / max(generated, 1), 1),
     }
 
 
@@ -66,6 +88,12 @@ def main():
     ap.add_argument("--contexts", default="32,128")
     ap.add_argument("--new-tokens", type=int, default=32)
     ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--pool", choices=("host", "device", "both"),
+                    default="both",
+                    help="KV backend A/B: host numpy pools vs "
+                         "device-resident DeviceKVPool (donated "
+                         "scatter appends); 'both' emits one tokens/s "
+                         "series per backend")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
@@ -85,20 +113,31 @@ def main():
                            num_heads=args.heads, head_dim=args.head_dim,
                            max_positions=max(contexts) + args.new_tokens + 1,
                            seed=0)
+    pools = (("host", "device") if args.pool == "both" else (args.pool,))
     grid = []
-    for b in batches:
-        for ctx in contexts:
-            # pool sized to fit the cell without preemption noise
-            pages = ((ctx + args.new_tokens) // args.page_size + 2) * b
-            grid.append(bench_cell(model, b, ctx, args.new_tokens,
-                                   pages, args.page_size))
+    stats_by_pool = {}
+    reg = StatRegistry.instance()
+    for pool in pools:
+        # per-pool snapshot: reset generation.* so each backend's stats
+        # (kv_bytes_moved above all) land separately in the artifact
+        for name in list(reg.stats()):
+            if name.startswith("generation."):
+                reg.get_stat(name).reset()
+        for b in batches:
+            for ctx in contexts:
+                # pool sized to fit the cell without preemption noise
+                pages = ((ctx + args.new_tokens) // args.page_size + 2) * b
+                grid.append(bench_cell(model, b, ctx, args.new_tokens,
+                                       pages, args.page_size, pool))
+        stats_by_pool[pool] = reg.stats_snapshot("generation.")
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
         "model": {"vocab": args.vocab, "layers": args.layers,
                   "heads": args.heads, "head_dim": args.head_dim},
+        "pools": list(pools),
         "grid": grid,
-        "stats": StatRegistry.instance().stats_snapshot("generation."),
+        "stats": stats_by_pool,
     }
     line = json.dumps(doc)
     print(line)

@@ -32,10 +32,12 @@ for i in $(seq 1 "$MAX"); do
       2>/dev/null \
       && echo "[tpu-bench-loop] op table -> ${OUT%.json}_ops.jsonl"
     # and the decode microbench (tokens/s grid + generation.* stats
-    # snapshot embedded via StatRegistry.stats_snapshot)
-    timeout 900 python tools/gen_bench.py --out "${OUT%.json}_gen.json" \
-      >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench -> ${OUT%.json}_gen.json"
+    # snapshot embedded via StatRegistry.stats_snapshot); --pool both
+    # lands the host-vs-device KV pool A/B (kv_bytes_moved per token:
+    # O(pool) host pools vs O(tokens) DeviceKVPool) in the same artifact
+    timeout 900 python tools/gen_bench.py --pool both \
+      --out "${OUT%.json}_gen.json" >/dev/null 2>&1 \
+      && echo "[tpu-bench-loop] gen bench (host/device A/B) -> ${OUT%.json}_gen.json"
     exit 0
   fi
   echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
