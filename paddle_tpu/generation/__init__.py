@@ -31,11 +31,12 @@ from .decode_attention import (dense_causal_reference,
                                paged_decode_attention_reference)
 from .engine import (GenerationConfig, GenerationEngine, GenerationHandle,
                      GenerationResult)
+from .fused import FusedDecodeStep, decode_batch_menu
 from .kv_cache import (DeviceKVPool, OutOfPagesError, PagedKVCache,
                        UnknownSequenceError)
 from .metrics import GenerationMetrics
 from .model import TinyCausalLM
-from .sampling import SamplingParams, sample_token
+from .sampling import SamplingParams, sample_token, sample_tokens_batch
 from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
                         SequenceState)
 
@@ -46,5 +47,6 @@ __all__ = [
     "paged_decode_attention", "paged_decode_attention_reference",
     "dense_causal_reference", "ContinuousBatchingScheduler",
     "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",
-    "GenerationMetrics", "TinyCausalLM",
+    "sample_tokens_batch", "GenerationMetrics", "TinyCausalLM",
+    "FusedDecodeStep", "decode_batch_menu",
 ]

@@ -30,12 +30,28 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _gather_pool(pool, pt, b, h, d, layout, dtype):
+    """Gather a [B, Kmax, H, D] contiguous view of each sequence's pages
+    from either pool layout.  The kernel layout's gathered view is
+    transposed AFTER the gather — a value-preserving permutation of the
+    O(tokens) view, never the pool — so the downstream einsums see
+    byte-identical operands in both layouts (the bitwise re-proof
+    tests/test_fused_decode.py pins)."""
+    if layout == "kernel":
+        # pool [H, P, ps, D] -> gather [H, B, MP, ps, D] -> [B, K, H, D]
+        g = jnp.transpose(pool[:, pt], (1, 2, 3, 0, 4))
+        return g.reshape(b, -1, h, d).astype(dtype)
+    # pool [P, ps, H, D] -> gather [B, MP, ps, H, D] -> [B, K, H, D]
+    return pool[pt].reshape(b, -1, h, d).astype(dtype)
+
+
 def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
-                                     seq_lens, scale=None):
+                                     seq_lens, scale=None, layout="token"):
     """Pure-jnp paged decode attention.
 
     q: [B, H, D] — the single query token per sequence.
-    k_pool, v_pool: [P, page_size, H, D] (one layer's pool).
+    k_pool, v_pool: one layer's pool — [P, page_size, H, D] for the
+        token layout, [H, P, page_size, D] for layout="kernel".
     page_tables: [B, max_pages] int32, unused slots padded with 0.
     seq_lens: [B] int32 live token counts.
     Returns [B, H, D].
@@ -46,14 +62,12 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
     pt = jnp.asarray(page_tables, jnp.int32)
     lens = jnp.asarray(seq_lens, jnp.int32)
     b, h, d = q.shape
-    page_size = k_pool.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    # gather pages: [B, max_pages, page_size, H, D] -> [B, Kmax, H, D];
-    # the upcast (bf16 pools) happens on the gathered O(tokens) view,
-    # never on the whole pool
-    k = k_pool[pt].reshape(b, -1, h, d).astype(q.dtype)
-    v = v_pool[pt].reshape(b, -1, h, d).astype(q.dtype)
+    # gather pages into [B, Kmax, H, D]; the upcast (bf16 pools) happens
+    # on the gathered O(tokens) view, never on the whole pool
+    k = _gather_pool(k_pool, pt, b, h, d, layout, q.dtype)
+    v = _gather_pool(v_pool, pt, b, h, d, layout, q.dtype)
     kmax = k.shape[1]
     logits = jnp.einsum("bhd,bkhd->bhk", q, k) * scale
     live = jnp.arange(kmax, dtype=jnp.int32)[None, :] < lens[:, None]
@@ -68,14 +82,19 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
-                           scale=None, use_kernel=None, interpret=None):
+                           scale=None, use_kernel=None, interpret=None,
+                           layout="token"):
     """Dispatch: the Pallas kernel on TPU (or when forced, e.g. interpret
-    mode in tests), the jnp reference elsewhere."""
+    mode in tests), the jnp reference elsewhere.  `layout` names the
+    pool storage layout ("token" or "kernel", see DeviceKVPool) — with
+    layout="kernel" the Pallas path consumes the pools as stored, with
+    no per-call whole-pool transpose."""
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
     if not use_kernel:
         return paged_decode_attention_reference(
-            q, k_pool, v_pool, page_tables, seq_lens, scale=scale)
+            q, k_pool, v_pool, page_tables, seq_lens, scale=scale,
+            layout=layout)
     from ..ops.pallas.paged_attention import paged_decode_attention_kernel
 
     d = q.shape[-1]
@@ -83,7 +102,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
         scale = 1.0 / math.sqrt(d)
     return paged_decode_attention_kernel(
         jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
-        page_tables, seq_lens, scale, interpret=interpret)
+        page_tables, seq_lens, scale, interpret=interpret, layout=layout)
 
 
 def dense_causal_reference(q, k, v, scale=None):

@@ -28,6 +28,16 @@ Model protocol (duck-typed)::
         #                      -> attention output [B,H,D]
         # the engine's attend() appends k/v to the paged cache and runs
         # paged decode attention over each sequence's page table
+    model.decode_params() -> pytree                       # optional
+    model.decode_step_fn(page_size, num_pages, use_kernel=...,
+                         pool_layout=..., greedy=...) -> pure fn
+        # optional pair enabling the FUSED decode path (fused.py): the
+        # fn runs the WHOLE decode step — embed, every layer's paged
+        # scatter-append + attention, final logits — as one traceable
+        # body over (params, tokens, positions, k_pools, v_pools,
+        # page_tables, lens), jitted with the pools donated and
+        # dispatched ONCE per step; rows with lens == 0 are padding and
+        # must never write a pool page (sentinel + mode="drop")
 
 Overload behavior is inherited from serving: a full queue raises
 ServerBusyError at submit, lapsed deadlines resolve handles with
@@ -47,7 +57,7 @@ from ..serving.bucketing import CompiledModelCache, ShapeBucketer
 from .decode_attention import paged_decode_attention
 from .kv_cache import DeviceKVPool, OutOfPagesError, PagedKVCache
 from .metrics import GenerationMetrics, StepTimer
-from .sampling import SamplingParams, sample_token
+from .sampling import SamplingParams, sample_token, sample_tokens_batch
 from .scheduler import ContinuousBatchingScheduler, GenerationRequest
 
 
@@ -69,13 +79,29 @@ class GenerationConfig:
         the ulp level, and the CPU tier-1 oracle demands bitwise token
         identity, so CPU defaults to the eager exact path; the bucket
         cache still bounds and counts shape signatures either way).
+    decode: "eager" (per-layer attend callbacks, the exact oracle
+        path), "fused" (FusedDecodeStep: the whole step as ONE jitted
+        pool-donating dispatch, requires the device KV backend and a
+        model with decode_step_fn), or None = auto — fused on TPU when
+        the model supports it, eager elsewhere (same reasoning as
+        jit_prefill: the CPU tier-1 oracle stays anchored on the
+        bitwise-exact eager path).
+    decode_batch_buckets: padded-batch menu for the fused decode step;
+        None = auto (powers of two up to max_decode_slots).
+    pool_layout: DeviceKVPool storage layout — "token"
+        ([P, page_size, H, D], append-natural) or "kernel"
+        ([H, P, page_size, D], what the Pallas decode kernel consumes:
+        scatters write the kernel layout so the kernel path skips its
+        per-call whole-pool transpose).  None = "token".  Device
+        backend only.
     """
 
     def __init__(self, max_decode_slots=8, num_pages=256, page_size=16,
                  queue_depth=64, default_timeout_ms=None,
                  default_max_new_tokens=16, use_kernel=None,
                  kv_dtype=np.float32, kv_backend=None, max_prefill_batch=4,
-                 prefill_length_buckets=None, jit_prefill=None):
+                 prefill_length_buckets=None, jit_prefill=None,
+                 decode=None, decode_batch_buckets=None, pool_layout=None):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -94,6 +120,17 @@ class GenerationConfig:
             raise ValueError("max_prefill_batch must be >= 1")
         self.prefill_length_buckets = prefill_length_buckets
         self.jit_prefill = jit_prefill
+        if decode not in (None, "eager", "fused"):
+            raise ValueError(
+                f"decode must be 'eager', 'fused' or None (auto), got "
+                f"{decode!r}")
+        self.decode = decode
+        self.decode_batch_buckets = decode_batch_buckets
+        if pool_layout not in (None, "token", "kernel"):
+            raise ValueError(
+                f"pool_layout must be 'token', 'kernel' or None, got "
+                f"{pool_layout!r}")
+        self.pool_layout = pool_layout
 
 
 class GenerationResult:
@@ -180,12 +217,23 @@ class GenerationEngine:
         self.metrics = metrics or GenerationMetrics()
         on_tpu = jax.default_backend() == "tpu"
         backend = self.config.kv_backend or ("device" if on_tpu else "host")
-        cache_cls = DeviceKVPool if backend == "device" else PagedKVCache
-        self.cache = cache_cls(
-            model.num_layers, model.num_heads, model.head_dim,
-            num_pages=self.config.num_pages,
-            page_size=self.config.page_size,
-            dtype=self.config.kv_dtype)
+        pool_layout = self.config.pool_layout or "token"
+        if backend == "device":
+            self.cache = DeviceKVPool(
+                model.num_layers, model.num_heads, model.head_dim,
+                num_pages=self.config.num_pages,
+                page_size=self.config.page_size,
+                dtype=self.config.kv_dtype, pool_layout=pool_layout)
+        else:
+            if pool_layout == "kernel":
+                raise ValueError(
+                    "pool_layout='kernel' requires kv_backend='device' "
+                    "(host numpy pools only store the token layout)")
+            self.cache = PagedKVCache(
+                model.num_layers, model.num_heads, model.head_dim,
+                num_pages=self.config.num_pages,
+                page_size=self.config.page_size,
+                dtype=self.config.kv_dtype)
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, num_slots=self.config.max_decode_slots,
             queue_depth=self.config.queue_depth, metrics=self.metrics)
@@ -200,6 +248,42 @@ class GenerationEngine:
         if hasattr(model, "prefill_batch"):
             self.prefill_cache = CompiledModelCache(
                 model.prefill_batch, metrics=self.metrics, aot=jit_prefill)
+        # decode path: fused (one jitted pool-donating dispatch per step)
+        # mirrors jit_prefill's auto policy — TPU default, eager-exact
+        # stays the CPU tier-1 default so the zero-tolerance oracle is
+        # anchored on the unfused path
+        self._use_kernel = (self.config.use_kernel
+                            if self.config.use_kernel is not None
+                            else on_tpu)
+        fusable = (backend == "device"
+                   and hasattr(model, "decode_step_fn")
+                   and hasattr(model, "decode_params"))
+        decode = self.config.decode
+        if decode is None:
+            decode = "fused" if (on_tpu and fusable) else "eager"
+        elif decode == "fused" and not fusable:
+            raise ValueError(
+                "decode='fused' needs kv_backend='device' and a model "
+                "implementing decode_step_fn/decode_params "
+                f"(backend={backend!r}, model={type(model).__name__})")
+        self.decode_mode = decode
+        self._fused = None
+        if decode == "fused":
+            from .fused import FusedDecodeStep, decode_batch_menu
+
+            buckets = (self.config.decode_batch_buckets
+                       or decode_batch_menu(self.config.max_decode_slots))
+            if max(buckets) < self.config.max_decode_slots:
+                # surface the misconfiguration at build, not as a
+                # load-dependent RequestTooLargeError poisoning every
+                # in-flight request the first time all slots fill
+                raise ValueError(
+                    f"decode_batch_buckets top bucket {max(buckets)} < "
+                    f"max_decode_slots={self.config.max_decode_slots}: "
+                    f"a full decode batch could never be padded")
+            self._fused = FusedDecodeStep(
+                model, self.cache, self.metrics,
+                use_kernel=self._use_kernel, batch_buckets=buckets)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
@@ -212,13 +296,10 @@ class GenerationEngine:
         length buckets from config or a geometric auto-menu covering
         every admissible prompt (capped so a padded bucket can never
         exceed the model's max_positions)."""
+        from .fused import decode_batch_menu
+
         cfg = self.config
-        batch = []
-        b = 1
-        while b < cfg.max_prefill_batch:
-            batch.append(b)
-            b *= 2
-        batch.append(cfg.max_prefill_batch)
+        batch = decode_batch_menu(cfg.max_prefill_batch)
         max_pos = getattr(self.model, "max_positions", None)
         lengths = cfg.prefill_length_buckets
         if lengths is None:
@@ -305,9 +386,15 @@ class GenerationEngine:
                 active = self._ensure_step_capacity(active)
                 if not active:
                     return 0
-                logits = self._decode(active)
-                for state, row in zip(active, logits):
-                    self._on_logits(state, row)
+                if self._fused is not None:
+                    all_greedy, out = self._decode_fused(active)
+                    if all_greedy:
+                        self._apply_tokens(active, out)
+                    else:
+                        self._apply_logits_batch(active, out)
+                else:
+                    logits = self._decode(active)
+                    self._apply_logits_batch(active, logits)
         self.metrics.observe_step(len(active), timer.seconds)
         self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
@@ -390,12 +477,14 @@ class GenerationEngine:
                 [start for _, start in ready], lengths,
                 k[:b_real], v[:b_real])
         last_logits = np.asarray(last_logits)  # one device->host transfer
-        for i, (state, _) in enumerate(ready):
+        for state, _ in ready:
             self.metrics.count_prefill(len(state.tokens))
-            # prefill's last-position logits ARE the next-token logits:
-            # new prompts sample their first token here, and a preempted
-            # sequence resumes exactly where its decode left off
-            self._on_logits(state, last_logits[i])
+        # prefill's last-position logits ARE the next-token logits: new
+        # prompts sample their first token here (vectorized greedy
+        # argmax), and a preempted sequence resumes exactly where its
+        # decode left off
+        self._apply_logits_batch([state for state, _ in ready],
+                                 last_logits[:b_real])
 
     def _prefill(self, state):
         from ..profiler import RecordEvent
@@ -451,34 +540,68 @@ class GenerationEngine:
                 f"{self.cache.page_size}) has none free even with every "
                 f"other sequence preempted"))
 
-    def _decode(self, active):
+    def _decode_inputs(self, active):
+        """Reserve this step's token per sequence and batch the step
+        inputs (page tables/lengths cannot change within the step —
+        every page it touches was just reserved)."""
         seq_ids = [s.seq_id for s in active]
         positions = np.asarray(
             [self.cache.reserve(s.seq_id, 1) for s in active], np.int32)
         tokens = np.asarray([s.tokens[-1] for s in active], np.int32)
-        # page tables/lengths cannot change within the step (every page
-        # this step touches was just reserved): build them once, not per
-        # layer
         pt, lens = self.cache.gather_block_tables(seq_ids)
+        return seq_ids, tokens, positions, pt, lens
+
+    def _decode(self, active):
+        seq_ids, tokens, positions, pt, lens = self._decode_inputs(active)
+        on_device = isinstance(self.cache, DeviceKVPool)
+        counts = {"dispatches": 0, "syncs": 0}
 
         def attend(layer, q, k_new, v_new):
-            # one batched write per layer: host backend copies to numpy,
-            # DeviceKVPool runs a single donated scatter (O(B) tokens)
+            # one batched write per layer: host backend copies to numpy
+            # (a device->host fetch of the step's K/V), DeviceKVPool
+            # runs a single donated scatter dispatch (O(B) tokens)
             self.cache.write_decode_tokens(seq_ids, positions, layer,
                                            k_new, v_new)
+            if on_device:
+                counts["dispatches"] += 1
+            else:
+                counts["syncs"] += 1
             # layer_pools hands device-resident pools straight through —
             # the host backend uploads O(pool) here, which is exactly
             # what generation.kv_bytes_moved makes visible
             k_pool, v_pool = self.cache.layer_pools(layer)
+            counts["dispatches"] += 1
             return paged_decode_attention(
                 q, k_pool, v_pool, pt, lens,
-                use_kernel=self.config.use_kernel)
+                use_kernel=self._use_kernel,
+                layout=self.cache.pool_layout)
 
-        return np.asarray(self.model.decode(tokens, positions, attend))
+        logits = np.asarray(self.model.decode(tokens, positions, attend))
+        counts["syncs"] += 1  # the [B, V] logits fetch
+        self.metrics.observe_decode_step(counts["dispatches"],
+                                         counts["syncs"])
+        return logits
+
+    def _decode_fused(self, active):
+        """One fused dispatch for the whole step: returns
+        ``(all_greedy, out)`` where `out` is [B] int32 token ids when
+        every live request is greedy (argmax ran on device) else the
+        [B, V] logits block."""
+        _, tokens, positions, pt, lens = self._decode_inputs(active)
+        all_greedy = all(s.request.params.greedy for s in active)
+        out = self._fused.step(tokens, positions, pt, lens, all_greedy)
+        # the scatter ran inside the dispatch; keep the O(tokens) write
+        # bound visible in kv_bytes_moved (comparable across paths)
+        self.cache.count_fused_append(len(active))
+        self.metrics.observe_decode_step(self._fused.last_dispatches,
+                                         self._fused.last_syncs)
+        return all_greedy, out
 
     def _on_logits(self, state, logits_row):
         """Sample the next token for `state`, stream it, and finish the
-        sequence when a stop condition fires."""
+        sequence when a stop condition fires (the per-row path: single
+        prefill and one-off fallbacks; batches go through
+        _apply_logits_batch)."""
         from ..profiler import RecordEvent
 
         req = state.request
@@ -488,6 +611,11 @@ class GenerationEngine:
         with RecordEvent("generation::sample"):
             token = sample_token(np.asarray(logits_row), req.params,
                                  state.rng)
+        self._apply_token(state, token)
+
+    def _apply_token(self, state, token):
+        """Stream one already-sampled token and retire on stop/length."""
+        req = state.request
         if token in req.stop_tokens:
             self._finish(state, "stop")
             return
@@ -497,6 +625,40 @@ class GenerationEngine:
         self.metrics.count_token()
         if state.n_generated >= req.max_new_tokens:
             self._finish(state, "length")
+
+    def _apply_logits_batch(self, states, logits):
+        """Sample + apply one token per row of a [B, V] logits block.
+        Greedy rows share ONE vectorized argmax (sample_tokens_batch);
+        stochastic rows keep their per-request RNGs — token-identical
+        to the per-row path by construction."""
+        from ..profiler import RecordEvent
+
+        logits = np.asarray(logits)
+        live = []
+        for i, state in enumerate(states):
+            # length-finish before sampling (max_new_tokens == 0 lands
+            # here straight from prefill)
+            if state.n_generated >= state.request.max_new_tokens:
+                self._finish(state, "length")
+            else:
+                live.append((i, state))
+        if not live:
+            return
+        with RecordEvent("generation::sample"):
+            tokens = sample_tokens_batch(
+                logits[[i for i, _ in live]],
+                [s.request.params for _, s in live],
+                [s.rng for _, s in live])
+        for (_, state), token in zip(live, tokens):
+            self._apply_token(state, token)
+
+    def _apply_tokens(self, states, tokens):
+        """Apply device-sampled (fused all-greedy argmax) token ids."""
+        for state, token in zip(states, tokens):
+            if state.n_generated >= state.request.max_new_tokens:
+                self._finish(state, "length")
+                continue
+            self._apply_token(state, int(token))
 
     def _finish(self, state, reason):
         self.scheduler.retire(state)

@@ -1,0 +1,174 @@
+"""FusedDecodeStep: the whole decode step as ONE jitted dispatch.
+
+The eager decode loop is correct but chatty: per token it issues ~2
+device calls per layer (scatter-append + paged attention) plus the
+model's own eager projection chain, then syncs the full [B, V] logits
+block to host and samples row by row.  On TPU that dispatch/sync
+overhead — not FLOPs — bounds tokens/s at small batch (the gap "Ragged
+Paged Attention" closes by keeping the decode step inside one compiled
+program).
+
+This module collapses the step to one executable::
+
+    tokens[B], positions[B], page_tables[B,MP], lens[B]
+        -> embed -> L x (donated scatter-append + paged attention)
+        -> logits [B, V]   (or argmax'd tokens [B] for all-greedy)
+
+traced ONCE per shape bucket and dispatched ONCE per decode step.  The
+KV pools ride through as donated arguments (`DeviceKVPool.take_pools` /
+`put_pools`): XLA updates the pool buffers in place and returns the
+same storage, so per-step host work collapses to argument upload plus
+one small fetch.
+
+Shape stability comes from decode-batch bucketing: the live batch B
+(sequences join and finish every step) is padded to a small
+ShapeBucketer menu with masked DUMMY rows — lens == 0, so their K/V
+write is routed to the out-of-range sentinel page (dropped on device,
+mode="drop") and their attention row is zero-length (exact zeros) —
+and the page-table axis is padded to a power-of-two pages bucket.  One
+executable per (batch bucket, pages bucket, greedy) signature, built
+through serving's CompiledModelCache (donate_argnums), so steady-state
+decode never traces again and the compile count is bounded by the menu.
+
+The model opts in via the optional protocol methods::
+
+    model.decode_params() -> pytree of weights
+    model.decode_step_fn(page_size, num_pages, use_kernel=...,
+                         pool_layout=..., greedy=...) -> pure fn
+        fn(params, tokens, positions, k_pools, v_pools, page_tables,
+           lens) -> (logits_or_tokens, k_pools', v_pools')
+
+Policy mirrors jit_prefill: fused is the TPU auto-default, the
+eager-exact path stays the CPU tier-1 default (XLA whole-program fusion
+reassociates floats at the ulp level; the zero-tolerance token-identity
+oracle is anchored on eager).  Forced fused on CPU is the acceptance
+probe: exactly 1 dispatch, <=1 host sync per decode step
+(tests/test_fused_decode.py).
+"""
+import numpy as np
+
+from ..serving.bucketing import CompiledModelCache, ShapeBucketer
+from .metrics import DecodeCacheMetrics
+
+
+def decode_batch_menu(max_slots):
+    """Power-of-two batch buckets up to (and always including) the cap —
+    the one batch-menu builder for both the fused decode step and the
+    engine's prefill bucketer."""
+    menu, b = [], 1
+    while b < max_slots:
+        menu.append(b)
+        b *= 2
+    menu.append(int(max_slots))
+    return tuple(sorted(set(menu)))
+
+
+class FusedDecodeStep:
+    """Owns the per-bucket fused executables and the donation chain.
+
+    One instance per engine; `step()` is the engine's whole decode
+    device interaction: pad to buckets, donate the pools in, install
+    the returned pools, fetch the (sliced) result.  `last_dispatches` /
+    `last_syncs` are the instrumented per-call counts the
+    generation.decode_*_per_step gauges are set from — counted at the
+    actual call sites, not estimated."""
+
+    def __init__(self, model, cache, metrics, use_kernel=False,
+                 batch_buckets=None):
+        import jax
+
+        self._jax = jax
+        self._cache = cache
+        self._num_layers = int(cache.num_layers)
+        self._param_leaves, self._param_tree = jax.tree_util.tree_flatten(
+            model.decode_params())
+        if not batch_buckets:
+            raise ValueError("batch_buckets is required (the engine "
+                             "passes its decode-batch menu)")
+        menu_b = tuple(int(b) for b in batch_buckets)
+        pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
+        self._bucketer = ShapeBucketer(batch_buckets=menu_b,
+                                       length_buckets=pages_menu)
+        cache_metrics = DecodeCacheMetrics(metrics)
+        # pools are wrapper args 4 .. 4+2L: donated so XLA updates the
+        # KV storage in place instead of copying the pool every token
+        donate = tuple(range(4, 4 + 2 * self._num_layers))
+        self._exec = {}
+        for greedy in (False, True):
+            fn = model.decode_step_fn(
+                cache.page_size, cache.num_pages, use_kernel=use_kernel,
+                pool_layout=cache.pool_layout, greedy=greedy)
+            self._exec[greedy] = CompiledModelCache(
+                self._wrap(fn), metrics=cache_metrics, aot=True,
+                donate_argnums=donate)
+        self.last_dispatches = 0
+        self.last_syncs = 0
+
+    def _wrap(self, fn):
+        """Flatten the pytree signature to the positional-array calling
+        convention CompiledModelCache keys and compiles on: (tokens,
+        positions, page_tables, lens, *k_pools, *v_pools, *params)."""
+        num_layers = self._num_layers
+        tree = self._param_tree
+        unflatten = self._jax.tree_util.tree_unflatten
+
+        def step(tokens, positions, page_tables, lens, *leaves):
+            k_pools = list(leaves[:num_layers])
+            v_pools = list(leaves[num_layers:2 * num_layers])
+            params = unflatten(tree, leaves[2 * num_layers:])
+            out, k_out, v_out = fn(params, tokens, positions, k_pools,
+                                   v_pools, page_tables, lens)
+            return (out, *k_out, *v_out)
+
+        return step
+
+    @property
+    def compile_count(self):
+        """Distinct (batch, pages, greedy) signatures compiled — the
+        bucket menu bounds this (tests assert it stays put under
+        repeated traffic)."""
+        return sum(c.compile_count for c in self._exec.values())
+
+    def cached_buckets(self):
+        return {greedy: c.cached_buckets()
+                for greedy, c in self._exec.items()}
+
+    def step(self, tokens, positions, page_tables, lens, greedy):
+        """One fused decode step for `len(tokens)` live sequences.
+
+        Pads every input to its bucket (dummy rows: lens 0, page table
+        all zeros — kernel-DMA-safe; their write is killed in-trace via
+        the sentinel), runs the ONE compiled dispatch with the pools
+        donated, installs the returned pools, and fetches the result in
+        the ONE host sync.  Returns the real rows: [B] int32 token ids
+        when greedy, else [B, V] logits."""
+        b_real = len(tokens)
+        bucket_b = self._bucketer.batch_bucket(b_real)
+        bucket_p = self._bucketer.length_bucket(page_tables.shape[1])
+        tok = np.zeros((bucket_b,), np.int32)
+        tok[:b_real] = tokens
+        pos = np.zeros((bucket_b,), np.int32)
+        pos[:b_real] = positions
+        ln = np.zeros((bucket_b,), np.int32)
+        ln[:b_real] = lens
+        pt = np.zeros((bucket_b, bucket_p), np.int32)
+        pt[:b_real, :page_tables.shape[1]] = page_tables
+        k_pools, v_pools = self._cache.take_pools()
+        args = [tok, pos, pt, ln, *k_pools, *v_pools, *self._param_leaves]
+        exe = self._exec[bool(greedy)].get(args)
+        try:
+            outs = exe(*args)                  # the single dispatch
+            pools = outs[1:]
+            self._cache.put_pools(pools[:self._num_layers],
+                                  pools[self._num_layers:])
+        except BaseException:
+            # the dispatch donated (invalidated) the live pool buffers
+            # and died before handing replacements back; leave the cache
+            # on fresh storage so the engine's fail-the-batch-and-keep-
+            # serving recovery (engine._worker) actually keeps serving
+            self._cache.reset_pools()
+            raise
+        host = np.asarray(outs[0])             # the single host sync
+        self.last_dispatches = 1
+        self.last_syncs = 1
+        return host[:b_real]

@@ -66,6 +66,10 @@ class PagedKVCache:
         lives at ``page_table[t // page_size]``, row ``t % page_size``.
     """
 
+    # storage layout of layer_pools() arrays; DeviceKVPool can store the
+    # kernel layout instead (see its pool_layout)
+    pool_layout = "token"
+
     def __init__(self, num_layers, num_heads, head_dim, num_pages=256,
                  page_size=16, dtype=np.float32):
         if num_pages < 1 or page_size < 1:
@@ -262,6 +266,15 @@ class PagedKVCache:
         self._bytes_moved += k.nbytes + v.nbytes
         return k, v
 
+    def count_fused_append(self, tokens):
+        """Account a fused-decode-step write of `tokens` new tokens across
+        every layer.  The fused path scatters inside the jitted step — the
+        payload never crosses the host<->device boundary at all — but the
+        O(tokens) bound is counted anyway so ``generation.kv_bytes_moved``
+        stays comparable across decode paths (it has always meant "bytes
+        the write moves or would move", see _count_write_payload)."""
+        self._count_write_payload(int(tokens), self.num_layers)
+
     def take_bytes_moved(self):
         """Host<->device KV bytes accumulated since the last take — the
         engine drains this once per decode step into
@@ -333,24 +346,41 @@ class PagedKVCache:
 # ----------------------- device-resident backend ------------------------
 
 
-def _scatter_kv(k_pool, v_pool, pages, rows, k, v):
+def scatter_pool_update(pool, pages, rows, x, layout):
+    """Scatter token payload `x` into `(pages[i], rows[i])` of one pool,
+    layout-aware.  Out-of-range page ids (the padding sentinel
+    ``num_pages``) are DROPPED — length-padded positions can never write
+    past a sequence's page table.  Shared by the eager scatter dispatches
+    below and the fused decode step's in-trace append (fused.py), so both
+    write paths have identical semantics by construction.
+
+    token layout:  pool [P, page_size, H, D], x [n, H, D]
+    kernel layout: pool [H, P, page_size, D], x [n, H, D] (swapped in)
+    """
+    if layout == "kernel":
+        import jax.numpy as jnp
+
+        return pool.at[:, pages, rows].set(jnp.swapaxes(x, 0, 1),
+                                           mode="drop")
+    return pool.at[pages, rows].set(x, mode="drop")
+
+
+def _scatter_kv(k_pool, v_pool, pages, rows, k, v, *, layout):
     """Scatter `k[i]` / `v[i]` into `(pages[i], rows[i])` of one layer's
     pools.  Donated: XLA performs the update in place, so an append
-    moves the token payload, never the pool.  Out-of-range page ids
-    (the padding sentinel ``num_pages``) are DROPPED — length-padded
-    prefill positions can never write past a sequence's page table."""
-    return (k_pool.at[pages, rows].set(k, mode="drop"),
-            v_pool.at[pages, rows].set(v, mode="drop"))
+    moves the token payload, never the pool."""
+    return (scatter_pool_update(k_pool, pages, rows, k, layout),
+            scatter_pool_update(v_pool, pages, rows, v, layout))
 
 
-def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v):
+def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v, *, layout):
     """Every layer's scatter in ONE dispatch (the indices are identical
     across layers): k_pools/v_pools are length-L lists (all donated),
     k/v are ``[L, n, H, D]``.  Prefill latency stays flat in depth
     instead of paying L dispatches per chunk."""
-    return ([kp.at[pages, rows].set(k[i], mode="drop")
+    return ([scatter_pool_update(kp, pages, rows, k[i], layout)
              for i, kp in enumerate(k_pools)],
-            [vp.at[pages, rows].set(v[i], mode="drop")
+            [scatter_pool_update(vp, pages, rows, v[i], layout)
              for i, vp in enumerate(v_pools)])
 
 
@@ -359,28 +389,55 @@ class DeviceKVPool(PagedKVCache):
 
     Bookkeeping (page tables, free list, reservation) is inherited
     unchanged and stays host-side; only the storage moves: per-layer
-    ``jax.Array`` pools ``[num_pages, page_size, H, D]`` appended with
-    jitted, buffer-donated scatters.  ``layer_pools`` hands the live
-    device arrays straight to the attention call — zero host->device
-    re-upload, which is the whole point: a decode step's KV traffic is
-    O(batch x layers x heads x head_dim), independent of the pool size.
+    ``jax.Array`` pools appended with jitted, buffer-donated scatters.
+    ``layer_pools`` hands the live device arrays straight to the
+    attention call — zero host->device re-upload, which is the whole
+    point: a decode step's KV traffic is O(batch x layers x heads x
+    head_dim), independent of the pool size.
+
+    pool_layout picks the storage layout of each per-layer pool:
+
+    - ``"token"`` (default): ``[num_pages, page_size, H, D]`` — the
+      append-natural layout (one token's K is one contiguous row).
+    - ``"kernel"``: ``[H, num_pages, page_size, D]`` — the layout the
+      Pallas decode kernel consumes.  Scatters write INTO this layout,
+      so the kernel path skips its per-call whole-pool transpose — the
+      O(pool) HBM traffic per layer per step the token layout forces
+      on it (the ROADMAP-flagged gap).  The jnp reference gathers
+      either layout bitwise-identically (decode_attention.py).
 
     The arrays returned by ``layer_pools`` are invalidated by the next
     write (donation): read between writes, as the engine's step does.
-    ``k_pool`` / ``v_pool`` are DEBUG host copies, not the hot path.
+    ``k_pool`` / ``v_pool`` are DEBUG host copies in the CANONICAL
+    token layout regardless of pool_layout, not the hot path.
     """
+
+    def __init__(self, num_layers, num_heads, head_dim, num_pages=256,
+                 page_size=16, dtype=np.float32, pool_layout="token"):
+        if pool_layout not in ("token", "kernel"):
+            raise ValueError(
+                f"pool_layout must be 'token' or 'kernel', got "
+                f"{pool_layout!r}")
+        self.pool_layout = pool_layout
+        super().__init__(num_layers, num_heads, head_dim,
+                         num_pages=num_pages, page_size=page_size,
+                         dtype=dtype)
 
     def _init_pools(self):
         import jax.numpy as jnp
 
         self._jnp = jnp
-        shape = (self.num_pages, self.page_size,
-                 self.num_heads, self.head_dim)
+        if self.pool_layout == "kernel":
+            shape = (self.num_heads, self.num_pages, self.page_size,
+                     self.head_dim)
+        else:
+            shape = (self.num_pages, self.page_size,
+                     self.num_heads, self.head_dim)
         self._k = [jnp.zeros(shape, self.dtype)
                    for _ in range(self.num_layers)]
         self._v = [jnp.zeros(shape, self.dtype)
                    for _ in range(self.num_layers)]
-        self._scatter, self._scatter_all = _jitted_scatter()
+        self._scatter, self._scatter_all = _jitted_scatter(self.pool_layout)
 
     # --------------------------- writes -----------------------------
     def _scatter_layer(self, layer, pages, rows, k, v, real_tokens):
@@ -476,27 +533,72 @@ class DeviceKVPool(PagedKVCache):
         boundary here, unlike the host backend's O(pool) upload."""
         return self._k[layer], self._v[layer]
 
+    def take_pools(self):
+        """Hand the live per-layer pool lists to a fused decode step for
+        DONATION: the caller passes them into a donate_argnums
+        executable (which invalidates them) and must give back the
+        returned pools via ``put_pools`` before anything else reads the
+        cache.  Returns ``(k_pools, v_pools)`` — length-L lists."""
+        return list(self._k), list(self._v)
+
+    def put_pools(self, k_pools, v_pools):
+        """Install the pools a fused step returned (the donation chain's
+        other half — same storage, updated in place by XLA)."""
+        if len(k_pools) != self.num_layers or \
+                len(v_pools) != self.num_layers:
+            raise ValueError(
+                f"expected {self.num_layers} pools per side, got "
+                f"{len(k_pools)}/{len(v_pools)}")
+        self._k = list(k_pools)
+        self._v = list(v_pools)
+
+    def reset_pools(self):
+        """Reallocate zeroed pool storage after a donating dispatch died
+        mid-flight (the donated buffers are invalid and no replacement
+        was returned).  KV content is lost by construction — the engine
+        fails every in-flight sequence on a poisoned step, so fresh
+        zeroed storage is exactly the state later requests expect."""
+        jnp = self._jnp
+        shape = self._k[0].shape
+        self._k = [jnp.zeros(shape, self.dtype)
+                   for _ in range(self.num_layers)]
+        self._v = [jnp.zeros(shape, self.dtype)
+                   for _ in range(self.num_layers)]
+
+    def _canonical(self, pool):
+        """[H, P, ps, D] -> [P, ps, H, D] for kernel-layout pools."""
+        pool = np.asarray(pool)
+        if self.pool_layout == "kernel":
+            pool = pool.transpose(1, 2, 0, 3)
+        return pool
+
     @property
     def k_pool(self):
-        """Host copy ``[L, P, page_size, H, D]`` (debug/tests only)."""
-        return np.stack([np.asarray(p) for p in self._k])
+        """Host copy ``[L, P, page_size, H, D]`` in the canonical token
+        layout whatever pool_layout is (debug/tests only)."""
+        return np.stack([self._canonical(p) for p in self._k])
 
     @property
     def v_pool(self):
-        return np.stack([np.asarray(p) for p in self._v])
+        return np.stack([self._canonical(p) for p in self._v])
 
 
-def _jitted_scatter():
-    """The shared jitted donated scatters (module-level cache: every
-    pool instance reuses the same executables per shape signature)."""
-    global _SCATTER_JIT
-    if _SCATTER_JIT is None:
+def _jitted_scatter(layout):
+    """The shared jitted donated scatters, one pair per pool layout
+    (module-level cache: every pool instance reuses the same
+    executables per shape signature)."""
+    import functools
+
+    if layout not in _SCATTER_JIT:
         import jax
 
-        _SCATTER_JIT = (jax.jit(_scatter_kv, donate_argnums=(0, 1)),
-                        jax.jit(_scatter_kv_all_layers,
-                                donate_argnums=(0, 1)))
-    return _SCATTER_JIT
+        _SCATTER_JIT[layout] = (
+            jax.jit(functools.partial(_scatter_kv, layout=layout),
+                    donate_argnums=(0, 1)),
+            jax.jit(functools.partial(_scatter_kv_all_layers,
+                                      layout=layout),
+                    donate_argnums=(0, 1)))
+    return _SCATTER_JIT[layout]
 
 
-_SCATTER_JIT = None
+_SCATTER_JIT = {}

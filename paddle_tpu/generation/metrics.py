@@ -27,6 +27,23 @@ Metric names:
 - ``generation.prefill_compiles_total``  batched-prefill executables built
                                       (== (batch, length) buckets touched)
 - ``generation.prefill_cache_hits`` / ``_misses``  prefill bucket cache
+- ``generation.decode_dispatches_per_step``  gauge: engine-issued device
+                                      program invocations in the last
+                                      decode step (fused: exactly 1;
+                                      eager: one scatter + one attention
+                                      per layer on the device backend —
+                                      model-internal eager ops are not
+                                      visible to the engine, so the eager
+                                      number is a lower bound)
+- ``generation.decode_host_syncs_per_step``  gauge: blocking device->host
+                                      fetches in the last decode step
+                                      (fused: the single logits/token
+                                      fetch; host pools add a K/V
+                                      download per layer)
+- ``generation.decode_compiles_total``  fused decode executables built
+                                      (== (batch, pages, greedy) bucket
+                                      signatures touched)
+- ``generation.decode_cache_hits`` / ``_misses``  fused bucket cache
 - ``generation.tokens_per_s``         gauge: decode throughput (EWMA)
 - ``generation.slot_occupancy_pct``   gauge: active / decode slots
 - ``generation.page_utilization_pct`` gauge: pool pages in use
@@ -50,6 +67,11 @@ KV_BYTES_MOVED = PREFIX + "kv_bytes_moved"
 PREFILL_COMPILES_TOTAL = PREFIX + "prefill_compiles_total"
 PREFILL_CACHE_HITS = PREFIX + "prefill_cache_hits"
 PREFILL_CACHE_MISSES = PREFIX + "prefill_cache_misses"
+DECODE_DISPATCHES_PER_STEP = PREFIX + "decode_dispatches_per_step"
+DECODE_HOST_SYNCS_PER_STEP = PREFIX + "decode_host_syncs_per_step"
+DECODE_COMPILES_TOTAL = PREFIX + "decode_compiles_total"
+DECODE_CACHE_HITS = PREFIX + "decode_cache_hits"
+DECODE_CACHE_MISSES = PREFIX + "decode_cache_misses"
 TOKENS_PER_S = PREFIX + "tokens_per_s"
 SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
 PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
@@ -110,7 +132,22 @@ class GenerationMetrics:
     def count_compile(self):
         self._stat(PREFILL_COMPILES_TOTAL).increase()
 
+    # --- fused decode bucket cache (CompiledModelCache interface via
+    # the DecodeCacheMetrics adapter below) ---
+    def count_decode_cache(self, hit):
+        self._stat(DECODE_CACHE_HITS if hit
+                   else DECODE_CACHE_MISSES).increase()
+
+    def count_decode_compile(self):
+        self._stat(DECODE_COMPILES_TOTAL).increase()
+
     # --- per-step observation ---
+    def observe_decode_step(self, dispatches, host_syncs):
+        """Per-decode-step dispatch/sync gauges — the fused path's
+        acceptance numbers (1 and <=1) and the eager A/B baseline."""
+        self._stat(DECODE_DISPATCHES_PER_STEP).set(int(dispatches))
+        self._stat(DECODE_HOST_SYNCS_PER_STEP).set(int(host_syncs))
+
     def observe_step(self, tokens, step_seconds):
         """One decode step that advanced `tokens` sequences (the token
         counter itself is kept by count_token at the sampling site)."""
@@ -133,6 +170,22 @@ class GenerationMetrics:
         """All generation.* stats currently in the registry."""
         return {k: v for k, v in self._reg.stats().items()
                 if k.startswith(PREFIX)}
+
+
+class DecodeCacheMetrics:
+    """Adapter giving the fused decode step's CompiledModelCache the
+    metrics interface it expects (`count_cache` / `count_compile`) while
+    landing the counts under generation.decode_* instead of the prefill
+    names the GenerationMetrics methods of those names write."""
+
+    def __init__(self, generation_metrics):
+        self._gm = generation_metrics
+
+    def count_cache(self, hit):
+        self._gm.count_decode_cache(hit)
+
+    def count_compile(self):
+        self._gm.count_decode_compile()
 
 
 class StepTimer:

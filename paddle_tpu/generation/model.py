@@ -187,6 +187,92 @@ class TinyCausalLM:
                                                blk["ln2_b"]))
         return self._logits(x)
 
+    # -------------------------- fused decode --------------------------
+    def decode_params(self):
+        """The weights as a jit-traceable pytree — the `params` argument
+        of the pure function `decode_step_fn` returns.  Passed as an
+        argument (not closed over) so the fused executable doesn't bake
+        the weights in as constants."""
+        return {
+            "tok_emb": self.tok_emb, "pos_emb": self.pos_emb,
+            "blocks": self.blocks,
+            "ln_f_s": self.ln_f_s, "ln_f_b": self.ln_f_b,
+            "head": self.head,
+        }
+
+    def decode_step_fn(self, page_size, num_pages, use_kernel=False,
+                       pool_layout="token", greedy=False):
+        """Build the PURE whole-decode-step function the engine's fused
+        path jits: embed -> L x (scatter-append K/V into the pools +
+        paged decode attention) -> logits, in one traceable body.
+
+            fn(params, tokens, positions, k_pools, v_pools,
+               page_tables, lens) -> (out, k_pools', v_pools')
+
+        tokens/positions: [B] int32 (B = padded batch bucket).
+        k_pools/v_pools: length-L lists of pool arrays (donated by the
+        caller; returned updated).  page_tables: [B, MP] int32 padded
+        with page 0.  lens: [B] int32 — live token counts INCLUDING the
+        token being decoded; 0 marks a DUMMY padding row, whose K/V
+        write is routed to the out-of-range sentinel page `num_pages`
+        (dropped by the scatter, mode="drop") and whose attention row is
+        zero-length (masked to exact zeros).  `out` is logits [B, V], or
+        argmax'd token ids [B] when greedy=True (the all-greedy batch
+        fetches B ints instead of B x V floats).
+
+        Per-position math is IDENTICAL to the eager decode()/attend()
+        path — same helpers, same scatter semantics
+        (kv_cache.scatter_pool_update), same attention reference — so
+        fused-vs-eager differences are only whatever XLA whole-program
+        fusion does to float association (why eager stays the CPU
+        tier-1 default, docs/GENERATION.md)."""
+        from .kv_cache import scatter_pool_update
+
+        page_size = int(page_size)
+        num_pages = int(num_pages)
+
+        def step(params, tokens, positions, k_pools, v_pools,
+                 page_tables, lens):
+            tokens = jnp.asarray(tokens, jnp.int32)
+            positions = jnp.asarray(positions, jnp.int32)
+            pt = jnp.asarray(page_tables, jnp.int32)
+            lens = jnp.asarray(lens, jnp.int32)
+            b = tokens.shape[0]
+            # no host-side bounds check in-trace: the engine guarantees
+            # positions < max_positions (enforced typed at submit)
+            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
+            # dummy rows (lens == 0) write to the sentinel page, which
+            # the drop-mode scatter discards on device
+            pages = jnp.where(
+                lens > 0,
+                pt[jnp.arange(b), positions // page_size], num_pages)
+            rows = positions % page_size
+            k_out, v_out = [], []
+            for li, blk in enumerate(params["blocks"]):
+                hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+                q, k, v = self._qkv(blk, hn)
+                kp = scatter_pool_update(
+                    k_pools[li], pages, rows,
+                    k.astype(k_pools[li].dtype), pool_layout)
+                vp = scatter_pool_update(
+                    v_pools[li], pages, rows,
+                    v.astype(v_pools[li].dtype), pool_layout)
+                k_out.append(kp)
+                v_out.append(vp)
+                attn = decode_attention.paged_decode_attention(
+                    q, kp, vp, pt, lens, use_kernel=use_kernel,
+                    layout=pool_layout)
+                x = x + attn.reshape(b, self.d_model) @ blk["wo"]
+                x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                                   blk["ln2_b"]))
+            logits = _layer_norm(x, params["ln_f_s"],
+                                 params["ln_f_b"]) @ params["head"]
+            out = (jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                   if greedy else logits)
+            return out, k_out, v_out
+
+        return step
+
     # ------------------------ reference decode ------------------------
     def greedy_reference(self, prompt, max_new_tokens, stop_tokens=()):
         """Naive sequential generation, FULL recompute each step (the

@@ -46,6 +46,31 @@ class SamplingParams:
         return np.random.default_rng(self.seed)
 
 
+def sample_tokens_batch(logits, params_list, rngs):
+    """One token id per row of a [B, V] logits block.
+
+    Greedy rows are sampled with ONE vectorized ``argmax(..., axis=-1)``
+    over the whole greedy sub-block instead of B separate sample_token
+    calls — the host-side per-row loop was decode-step overhead once the
+    device work collapsed to a single dispatch.  Stochastic rows keep
+    their per-request numpy RNGs and go through sample_token unchanged,
+    so every row's token is IDENTICAL to the per-row path: the greedy
+    argmax is over the same float64 view sample_token casts to (an exact,
+    order-preserving cast), and numpy's first-max tie rule is the same
+    either way."""
+    logits = np.asarray(logits)
+    out = [None] * len(params_list)
+    greedy_rows = [i for i, p in enumerate(params_list) if p.greedy]
+    if greedy_rows:
+        block = logits[greedy_rows].astype(np.float64)
+        for i, t in zip(greedy_rows, np.argmax(block, axis=-1)):
+            out[i] = int(t)
+    for i, p in enumerate(params_list):
+        if out[i] is None:
+            out[i] = sample_token(logits[i], p, rngs[i])
+    return out
+
+
 def sample_token(logits, params, rng):
     """One token id from a [V] float logits row."""
     logits = np.asarray(logits, np.float64).reshape(-1)

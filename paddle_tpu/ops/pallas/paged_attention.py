@@ -78,17 +78,28 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
-                                  scale, interpret=None):
-    """q: [B, H, D].  k_pool/v_pool: [P, page_size, H, D] (one layer).
+                                  scale, interpret=None, layout="token"):
+    """q: [B, H, D].  k_pool/v_pool: one layer's pool —
+    [P, page_size, H, D] (layout="token") or [H, P, page_size, D]
+    (layout="kernel", DeviceKVPool's kernel-layout storage).
     page_tables: [B, max_pages] int32 (pad with 0).  seq_lens: [B] int32.
-    Returns [B, H, D] attention output."""
+    Returns [B, H, D] attention output.
+
+    The kernel itself always consumes [H, P, page_size, D].  Token-layout
+    pools are transposed here per call — O(pool) HBM traffic per layer
+    per step, which is exactly why kernel-layout pools exist: scattering
+    into [H, P, page_size, D] on write makes this call transpose-free."""
     b, h, d = q.shape
-    _, page_size, _, _ = k_pool.shape
-    n_pages = page_tables.shape[1]
     qs = (q * scale).astype(q.dtype).reshape(b, h, 1, d)
-    # [P, ps, H, D] -> [H, P, ps, D]: trailing block dims are full dims
-    kt = jnp.transpose(k_pool, (2, 0, 1, 3))
-    vt = jnp.transpose(v_pool, (2, 0, 1, 3))
+    if layout == "kernel":
+        page_size = k_pool.shape[2]
+        kt, vt = k_pool, v_pool          # stored kernel-ready: no copy
+    else:
+        page_size = k_pool.shape[1]
+        # [P, ps, H, D] -> [H, P, ps, D]: trailing block dims full dims
+        kt = jnp.transpose(k_pool, (2, 0, 1, 3))
+        vt = jnp.transpose(v_pool, (2, 0, 1, 3))
+    n_pages = page_tables.shape[1]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -175,10 +175,14 @@ class CompiledModelCache:
     dispatched" — the number the bucket menu exists to bound.
     """
 
-    def __init__(self, fn, metrics=None, aot=True):
+    def __init__(self, fn, metrics=None, aot=True, donate_argnums=()):
         self._fn = fn
         self._metrics = metrics or ServingMetrics()
         self._aot = bool(aot)
+        # buffer-donation plan forwarded to jax.jit: generation's fused
+        # decode step donates its KV pool arguments so XLA updates them
+        # in place (ignored when aot=False — the raw fn never donates)
+        self._donate = tuple(donate_argnums)
         self._cache = {}
         self._lock = threading.Lock()
         self.compile_count = 0
@@ -197,7 +201,8 @@ class CompiledModelCache:
         avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
         with RecordEvent("serving::compile"):
             try:
-                exe = jax.jit(self._fn).lower(*avals).compile()
+                exe = jax.jit(self._fn, donate_argnums=self._donate) \
+                    .lower(*avals).compile()
             except Exception:
                 # fns that resist lowering (host callbacks, non-jax code)
                 # still serve, just without the AOT guarantee

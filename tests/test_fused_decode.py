@@ -1,0 +1,498 @@
+"""Fused single-dispatch decode step + decode-batch bucketing.
+
+Acceptance oracles (all CPU, fused path FORCED — on CPU the engine
+defaults to the eager-exact path, which is what keeps the zero-tolerance
+token-identity oracle anchored):
+
+1. With decode="fused", a decode step performs exactly ONE jitted
+   dispatch and at most ONE host sync — asserted via the instrumented
+   generation.decode_dispatches_per_step / decode_host_syncs_per_step
+   gauges, not estimated.
+2. Fused greedy decode is token-identical to the eager sequential
+   full-recompute oracle across varying live batch sizes — joins,
+   finishes, forced preemption.
+3. Dummy padding rows (the batch bucket's unfilled tail) NEVER write a
+   pool page: their scatter is routed to the out-of-range sentinel and
+   dropped on device.
+4. The decode bucket cache compiles at most one executable per
+   (batch bucket, pages bucket, greedy) signature — repeat traffic adds
+   zero compiles.
+
+Plus the kernel-layout pool satellite (pool_layout="kernel": scatters
+write [H, P, page_size, D] so the Pallas kernel skips its per-call
+whole-pool transpose; the jnp reference gather is re-proven BITWISE) and
+the vectorized host-sampling satellite (one argmax for all greedy rows;
+stochastic rows keep their per-request RNGs).
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.profiler.monitor import StatRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+_REFS = {}
+
+
+def _ref(model, prompt, n):
+    """Memoized greedy_reference: the sequential full-recompute oracle is
+    O(n) prefills over growing prefixes — several tests compare against
+    identical (prompt, n) pairs, no need to pay it repeatedly."""
+    key = (tuple(prompt), n)
+    if key not in _REFS:
+        _REFS[key] = model.greedy_reference(prompt, n)
+    return _REFS[key]
+
+
+def _engine(model, *, slots=4, pages=64, page_size=4, decode="fused",
+            start=False, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size, kv_backend="device",
+                               decode=decode, **kw)
+    return gen.GenerationEngine(model, cfg, start=start)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+# ------------------- acceptance: one dispatch, one sync -------------------
+
+
+def test_fused_step_is_one_dispatch_one_sync(model):
+    """Acceptance oracle 1: pure-decode steps on the fused path set the
+    instrumented gauges to exactly (1, 1); the eager path on the same
+    workload issues 2 device calls per layer."""
+    eng = _engine(model)
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=8)
+    eng.step()  # admit + prefill + first decode
+    stats = eng.metrics.snapshot()
+    for _ in range(3):
+        eng.step()  # pure decode steps
+        stats = eng.metrics.snapshot()
+        assert stats["generation.decode_dispatches_per_step"] == 1
+        assert stats["generation.decode_host_syncs_per_step"] <= 1
+    eng.run_until_idle()
+    eng.shutdown()
+
+    eager = _engine(model, decode="eager")
+    for p in PROMPTS:
+        eager.submit(p, max_new_tokens=4)
+    eager.step()
+    eager.step()
+    stats = eager.metrics.snapshot()
+    # eager device backend: one scatter + one attention per layer
+    assert stats["generation.decode_dispatches_per_step"] == \
+        2 * model.num_layers
+    assert stats["generation.decode_host_syncs_per_step"] == 1
+    eager.run_until_idle()
+    eager.shutdown()
+
+
+def test_fused_all_greedy_uses_device_argmax_variant(model):
+    """An all-greedy batch compiles (only) the greedy executable — the
+    step's host fetch is [B] token ids, not [B, V] logits."""
+    eng = _engine(model)
+    handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+    eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=5)
+    assert eng._fused._exec[True].compile_count >= 1
+    assert eng._fused._exec[False].compile_count == 0
+    eng.shutdown()
+
+
+# --------------------- token identity vs the oracle ----------------------
+
+
+def test_fused_greedy_token_identical_to_oracle(model):
+    eng = _engine(model)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == \
+            _ref(model, p, 12)
+    assert eng.cache.utilization() == 0.0
+    assert eng.cache.num_free_pages == eng.cache.num_pages
+    eng.shutdown()
+
+
+def test_fused_token_identical_under_forced_preemption(model):
+    """Acceptance oracle 2 (preemption): a pool sized to thrash forces
+    recompute preemption mid-fused-decode; victims re-prefill and every
+    token still matches."""
+    eng = _engine(model, pages=9)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 12)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_fused_token_identity_across_joins_and_finishes(model):
+    """Acceptance oracle 2 (ragged batches): sequences join mid-stream
+    and finish at different steps, so the live batch size B (and with it
+    the padded batch bucket) changes across the run."""
+    eng = _engine(model)
+    h1 = eng.submit([1, 2, 3], max_new_tokens=15)
+    h2 = eng.submit([7, 5], max_new_tokens=3)       # finishes early
+    for _ in range(5):
+        eng.step()
+    h3 = eng.submit([9, 9, 9, 4, 2], max_new_tokens=8)  # joins mid-stream
+    h4 = eng.submit([11], max_new_tokens=1)
+    eng.run_until_idle()
+    for h, p, n in ((h1, [1, 2, 3], 15), (h2, [7, 5], 3),
+                    (h3, [9, 9, 9, 4, 2], 8), (h4, [11], 1)):
+        assert h.result(timeout=5).token_ids == _ref(model, p, n)
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_fused_background_worker_end_to_end(model):
+    eng = _engine(model, start=True)
+    try:
+        h = eng.submit([5, 6, 7], max_new_tokens=8)
+        assert list(h.tokens(timeout=30)) == \
+            _ref(model, [5, 6, 7], 8)
+    finally:
+        eng.shutdown()
+
+
+def test_failed_fused_dispatch_resets_pools_engine_keeps_serving(model):
+    """A dispatch that dies AFTER consuming its donated pool buffers
+    must not zombie the engine: the cache is reset to fresh storage, the
+    poisoned step fails its batch (engine._worker contract), and later
+    requests decode correctly on the zeroed pools."""
+    eng = _engine(model, start=True)
+    try:
+        fused = eng._fused
+        num_layers = fused._num_layers
+
+        class _DyingExec:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def get(self, args):
+                self._inner.get(args)  # real compile path
+
+                def boom(*a):
+                    for pool in a[4:4 + 2 * num_layers]:
+                        pool.delete()  # donation consumed the buffers
+                    raise RuntimeError("device fell over mid-dispatch")
+                return boom
+
+        real = dict(fused._exec)
+        fused._exec = {k: _DyingExec(v) for k, v in real.items()}
+        h = eng.submit([1, 2, 3], max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="mid-dispatch"):
+            h.result(timeout=30)
+        fused._exec = real
+
+        h2 = eng.submit([1, 2, 3], max_new_tokens=6)
+        assert list(h2.tokens(timeout=30)) == _ref(model, [1, 2, 3], 6)
+    finally:
+        eng.shutdown()
+
+
+def test_fused_bf16_pool_matches_eager_device(model):
+    """Low-precision pools through the fused path: the in-trace scatter
+    casts at storage exactly like the eager scatter, so fused bf16
+    tokens equal eager-device bf16 tokens."""
+    import jax.numpy as jnp
+
+    toks = {}
+    for decode in ("eager", "fused"):
+        eng = _engine(model, decode=decode, kv_dtype=jnp.bfloat16)
+        handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+        eng.run_until_idle()
+        toks[decode] = [h.result(timeout=5).token_ids for h in handles]
+        eng.shutdown()
+    assert toks["fused"] == toks["eager"]
+
+
+# ------------------------ dummy padding rows -----------------------------
+
+
+def test_fused_dummy_rows_never_write_a_pool_page(model):
+    """Acceptance oracle 3: with 3 live sequences padded to the 4-batch
+    bucket, every step carries one dummy row whose position would alias
+    page 0 row 0 — mid-flight, every page outside the live page tables
+    must still be exactly zero."""
+    eng = _engine(model, slots=4, pages=16)
+    handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS[:3]]
+    eng.step()            # prefill + first sample
+    for _ in range(3):
+        eng.step()        # fused decode with a dummy 4th row
+        owned = set()
+        for s in eng.scheduler.active():
+            owned |= set(eng.cache.page_table(s.seq_id))
+        pool_k, pool_v = eng.cache.k_pool, eng.cache.v_pool
+        for page in range(eng.cache.num_pages):
+            if page not in owned:
+                np.testing.assert_array_equal(pool_k[:, page], 0.0)
+                np.testing.assert_array_equal(pool_v[:, page], 0.0)
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS[:3]):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    eng.shutdown()
+
+
+# ----------------------- bucket cache compile bounds ----------------------
+
+
+def test_fused_compile_count_bounded_by_bucket_menu(model):
+    """Acceptance oracle 4: repeat traffic through seen (batch, pages)
+    buckets never compiles again; the count equals the distinct cached
+    signatures and lands in generation.decode_compiles_total."""
+    eng = _engine(model, slots=4, pages=64)
+
+    def burst():
+        handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+        eng.run_until_idle()
+        for h in handles:
+            h.result(timeout=5)
+
+    burst()
+    first = eng._fused.compile_count
+    assert first >= 1
+    burst()                      # identical shapes: all cache hits
+    assert eng._fused.compile_count == first
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_total"] == first
+    assert stats["generation.decode_cache_hits"] > 0
+    cached = sum(len(v) for v in eng._fused.cached_buckets().values())
+    assert cached == first
+    eng.shutdown()
+
+
+def test_fused_requires_device_backend_and_protocol(model):
+    with pytest.raises(ValueError, match="fused"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            kv_backend="host", decode="fused"), start=False)
+
+    class NoFuse:
+        num_layers, num_heads, head_dim, vocab_size = 1, 1, 4, 8
+
+        def prefill(self, tokens):
+            raise NotImplementedError
+
+        def decode(self, tokens, positions, attend):
+            raise NotImplementedError
+
+    with pytest.raises(ValueError, match="decode_step_fn"):
+        gen.GenerationEngine(NoFuse(), gen.GenerationConfig(
+            kv_backend="device", decode="fused"), start=False)
+    with pytest.raises(ValueError):
+        gen.GenerationConfig(decode="warp")
+
+
+# ------------------------- kernel-layout pools ----------------------------
+
+
+def test_kernel_layout_pool_is_dropin_bitwise():
+    """Same op sequence -> bitwise-identical canonical pool contents in
+    both layouts, across every write path."""
+    rng = np.random.default_rng(0)
+    tok = gen.DeviceKVPool(2, 2, 8, num_pages=8, page_size=4)
+    ker = gen.DeviceKVPool(2, 2, 8, num_pages=8, page_size=4,
+                           pool_layout="kernel")
+    k = rng.standard_normal((2, 6, 2, 8)).astype(np.float32)
+    step = rng.standard_normal((2, 2, 8)).astype(np.float32)
+    for c in (tok, ker):
+        c.allocate("s")
+        c.allocate("t")
+        c.append_prefill("s", k, -k)
+        c.append("t", k[:, 0], -k[:, 0])
+        c.reserve("s", 1)
+        c.reserve("t", 1)
+        c.write_decode_tokens(["s", "t"], [6, 1], 0, step, -step)
+    np.testing.assert_array_equal(tok.k_pool, ker.k_pool)
+    np.testing.assert_array_equal(tok.v_pool, ker.v_pool)
+    # raw storage really is the kernel layout: [H, P, page_size, D]
+    kp, _ = ker.layer_pools(0)
+    assert kp.shape == (2, 8, 4, 8)
+
+
+def test_kernel_layout_reference_gather_bitwise():
+    """The satellite's re-proof: the jnp reference over kernel-layout
+    pools is BITWISE equal to the token-layout reference (the gather
+    permutation is value-preserving and the einsums see identical
+    operands)."""
+    tok = gen.DeviceKVPool(1, 2, 8, num_pages=16, page_size=4)
+    ker = gen.DeviceKVPool(1, 2, 8, num_pages=16, page_size=4,
+                           pool_layout="kernel")
+    rng = np.random.default_rng(2)
+    spans = [rng.standard_normal((1, t, 2, 8)).astype(np.float32)
+             for t in (13, 5, 24)]
+    for c in (tok, ker):
+        for i, kv in enumerate(spans):
+            c.allocate(i)
+            c.append_prefill(i, kv, -kv)
+    q = np.random.default_rng(3).standard_normal((3, 2, 8)) \
+        .astype(np.float32)
+    pt, sl = tok.gather_block_tables([0, 1, 2])
+    ref_tok = np.asarray(gen.paged_decode_attention_reference(
+        q, *tok.layer_pools(0), pt, sl))
+    ref_ker = np.asarray(gen.paged_decode_attention_reference(
+        q, *ker.layer_pools(0), pt, sl, layout="kernel"))
+    np.testing.assert_array_equal(ref_tok, ref_ker)
+
+
+def test_kernel_layout_pallas_interpret_matches_reference():
+    """The Pallas kernel consumes kernel-layout pools as stored (no
+    transpose) and still matches the reference semantics."""
+    rng = np.random.default_rng(4)
+    ker = gen.DeviceKVPool(1, 2, 128, num_pages=16, page_size=8,
+                           pool_layout="kernel")
+    for i, t in enumerate((13, 5, 24)):
+        kv = rng.standard_normal((1, t, 2, 128)).astype(np.float32)
+        ker.allocate(i)
+        ker.append_prefill(i, kv, -kv)
+    q = rng.standard_normal((3, 2, 128)).astype(np.float32)
+    pt, sl = ker.gather_block_tables([0, 1, 2])
+    kp, vp = ker.layer_pools(0)
+    ref = np.asarray(gen.paged_decode_attention_reference(
+        q, kp, vp, pt, sl, layout="kernel"))
+    out = np.asarray(gen.paged_decode_attention(
+        q, kp, vp, pt, sl, use_kernel=True, interpret=True,
+        layout="kernel"))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("decode", ["eager", "fused"])
+def test_kernel_layout_engine_token_identical(model, decode):
+    """End to end on the kernel layout, both decode paths: tokens match
+    the oracle, including under forced preemption."""
+    eng = _engine(model, pages=9, decode=decode, pool_layout="kernel")
+    handles = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 10)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_kernel_layout_rejected_on_host_backend(model):
+    with pytest.raises(ValueError, match="kernel"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            kv_backend="host", pool_layout="kernel"), start=False)
+    with pytest.raises(ValueError):
+        gen.DeviceKVPool(1, 1, 4, pool_layout="sideways")
+
+
+# ---------------------- vectorized host sampling --------------------------
+
+
+def test_sample_tokens_batch_matches_per_row():
+    """The vectorized sampler is row-for-row identical to sample_token:
+    greedy rows share one argmax, stochastic rows replay their RNGs."""
+    rng = np.random.default_rng(8)
+    logits = rng.standard_normal((6, 32)).astype(np.float32)
+    params = [gen.SamplingParams(),                       # greedy
+              gen.SamplingParams(temperature=1.1, seed=1),
+              gen.SamplingParams(),                       # greedy
+              gen.SamplingParams(temperature=0.7, top_k=5, seed=2),
+              gen.SamplingParams(temperature=1.3, top_p=0.9, seed=3),
+              gen.SamplingParams()]                       # greedy
+    batch = gen.sample_tokens_batch(
+        logits, params, [p.make_rng() for p in params])
+    single = [gen.sample_token(logits[i], p, p.make_rng())
+              for i, p in enumerate(params)]
+    assert batch == single
+
+
+def test_eager_mixed_batch_sampling_regression(model):
+    """Regression for the engine's vectorized decode sampling: a mixed
+    greedy/stochastic batch reproduces the same streams as the same
+    requests served alone (per-request RNG independence survives the
+    batch argmax split)."""
+    stoch = dict(max_new_tokens=10,
+                 sampling=gen.SamplingParams(temperature=0.9, top_k=10,
+                                             seed=42))
+
+    def run(prompts_with_kw):
+        eng = _engine(model, decode="eager")
+        handles = [eng.submit(p, **kw) for p, kw in prompts_with_kw]
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in handles]
+        eng.shutdown()
+        return out
+
+    together = run([([1, 2, 3], dict(max_new_tokens=10)),
+                    ([7, 5], dict(stoch)),
+                    ([9, 4], dict(max_new_tokens=10))])
+    alone = [run([([1, 2, 3], dict(max_new_tokens=10))])[0],
+             run([([7, 5], dict(stoch))])[0],
+             run([([9, 4], dict(max_new_tokens=10))])[0]]
+    assert together == alone
+    assert together[0] == _ref(model, [1, 2, 3], 10)
+
+
+def test_fused_mixed_batch_matches_eager(model):
+    """A mixed batch forces the fused logits variant (host sampling);
+    tokens match the eager path seed for seed."""
+    def run(decode):
+        eng = _engine(model, decode=decode)
+        hs = [eng.submit([1, 2, 3], max_new_tokens=10),
+              eng.submit([7, 5], max_new_tokens=10,
+                         sampling=gen.SamplingParams(temperature=0.9,
+                                                     top_k=10, seed=42)),
+              eng.submit([9, 4], max_new_tokens=10,
+                         sampling=gen.SamplingParams(temperature=1.2,
+                                                     top_p=0.9, seed=7))]
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        eng.shutdown()
+        return out
+
+    assert run("fused") == run("eager")
+
+
+# ------------------------- kv bytes on the fused path ---------------------
+
+
+def test_fused_kv_bytes_stay_o_tokens(model):
+    """The fused scatter happens inside the dispatch, but the counted
+    write bound stays O(batch x layers x heads x head_dim) per step,
+    independent of pool size — comparable with the eager A/B."""
+    def steady_deltas(pages):
+        eng = _engine(model, slots=4, pages=pages)
+        for p in PROMPTS:
+            eng.submit(p, max_new_tokens=10)
+        stat = eng.metrics._stat(gmetrics.KV_BYTES_MOVED)
+        eng.step()
+        deltas = []
+        for _ in range(4):
+            before = stat.get()
+            assert eng.step() == 4
+            deltas.append(stat.get() - before)
+        eng.run_until_idle()
+        eng.shutdown()
+        return deltas
+
+    small, big = steady_deltas(32), steady_deltas(256)
+    assert small == big
+    payload = 2 * 4 * model.num_layers * model.num_heads * model.head_dim * 4
+    for delta in small:
+        assert 0 < delta <= payload

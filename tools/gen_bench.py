@@ -12,6 +12,11 @@ Usage:
     python tools/gen_bench.py                    # default grid
     python tools/gen_bench.py --batches 1,4,8 --contexts 32,128 \
         --new-tokens 32 --out BENCH_GEN.json
+    python tools/gen_bench.py --pool device --decode both
+        # eager vs fused single-dispatch decode A/B: steady-state
+        # steps/s + tokens/s per cell with per-step dispatch/sync
+        # counts; compile/warmup wall time in the separate warmup_s
+        # column, never folded into the rate
 """
 import argparse
 import json
@@ -32,7 +37,7 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
 
 
 def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
-               pool):
+               pool, decode):
     from paddle_tpu import generation as g
     from paddle_tpu.generation import metrics as gmetrics
     from paddle_tpu.profiler.monitor import StatRegistry
@@ -41,36 +46,61 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
                            page_size=page_size, queue_depth=batch * 2,
-                           kv_backend=pool),
+                           kv_backend=pool, decode=decode),
         start=False)
     rng = np.random.default_rng(batch * 1000 + context)
     prompts = [rng.integers(0, model.vocab_size, context).tolist()
                for _ in range(batch)]
+
+    def run_once():
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        return dt, [h.result(timeout=1) for h in handles]
+
+    # warmup pass: same shapes as the measured pass, so it pays every
+    # trace/compile (fused decode buckets, jit_prefill buckets) exactly
+    # once — compile time is REPORTED, never folded into the
+    # steady-state rate below
+    warmup_s, _ = run_once()
     reg = StatRegistry.instance()
     kv_stat = reg.get_stat(gmetrics.KV_BYTES_MOVED)
     pf_stat = reg.get_stat(gmetrics.PREFILL_TOKENS_TOTAL)
+    steps_stat = reg.get_stat(gmetrics.STEPS_TOTAL)
     kv_before, pf_before = kv_stat.get(), pf_stat.get()
-    t0 = time.perf_counter()
-    handles = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
-    eng.run_until_idle()
-    dt = time.perf_counter() - t0
-    results = [h.result(timeout=1) for h in handles]
+    steps_before = steps_stat.get()
+    dt, results = run_once()
     generated = sum(len(r.token_ids) for r in results)
+    steps = int(steps_stat.get() - steps_before)
     kv_bytes = int(kv_stat.get() - kv_before)
     # prefill writes (incl. preemption re-prefills) are exactly the
     # prefill token count x K+V payload; subtracting them leaves the
     # decode-side traffic the O(pool)-vs-O(tokens) A/B is about
     prefill_bytes = (int(pf_stat.get() - pf_before) * 2 * model.num_layers
                      * model.num_heads * model.head_dim * 4)
+    snap = eng.metrics.snapshot()
     eng.shutdown()
     return {
         "pool": pool,
+        "decode": decode,
         "batch": batch,
         "context": context,
         "new_tokens": new_tokens,
         "generated": generated,
         "wall_s": round(dt, 4),
+        "warmup_s": round(warmup_s, 4),      # compile+trace, separate
         "tokens_per_s": round(generated / dt, 2) if dt > 0 else 0.0,
+        "steps": steps,
+        "steps_per_s": round(steps / dt, 2) if dt > 0 else 0.0,
+        # per-step gauges from the steady-state pass: the fused-vs-eager
+        # dispatch-collapse A/B per cell (fused: 1 and 1)
+        "dispatches_per_step": snap.get(
+            "generation.decode_dispatches_per_step", 0),
+        "host_syncs_per_step": snap.get(
+            "generation.decode_host_syncs_per_step", 0),
+        "decode_compiles": snap.get("generation.decode_compiles_total", 0),
         "preemptions": sum(r.preemptions for r in results),
         "kv_bytes_moved": kv_bytes,          # total, prefill included
         "kv_prefill_bytes": prefill_bytes,
@@ -94,6 +124,14 @@ def main():
                          "device-resident DeviceKVPool (donated "
                          "scatter appends); 'both' emits one tokens/s "
                          "series per backend")
+    ap.add_argument("--decode", choices=("eager", "fused", "both"),
+                    default="eager",
+                    help="decode-path A/B: eager per-layer attend "
+                         "callbacks vs the fused single-dispatch "
+                         "FusedDecodeStep (device pools only — "
+                         "host-pool fused cells are skipped); steps/s "
+                         "is steady-state with compile/warmup time in "
+                         "the separate warmup_s column")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
@@ -114,30 +152,39 @@ def main():
                            max_positions=max(contexts) + args.new_tokens + 1,
                            seed=0)
     pools = (("host", "device") if args.pool == "both" else (args.pool,))
+    decodes = (("eager", "fused") if args.decode == "both"
+               else (args.decode,))
     grid = []
-    stats_by_pool = {}
+    stats_by_series = {}
     reg = StatRegistry.instance()
     for pool in pools:
-        # per-pool snapshot: reset generation.* so each backend's stats
-        # (kv_bytes_moved above all) land separately in the artifact
-        for name in list(reg.stats()):
-            if name.startswith("generation."):
-                reg.get_stat(name).reset()
-        for b in batches:
-            for ctx in contexts:
-                # pool sized to fit the cell without preemption noise
-                pages = ((ctx + args.new_tokens) // args.page_size + 2) * b
-                grid.append(bench_cell(model, b, ctx, args.new_tokens,
-                                       pages, args.page_size, pool))
-        stats_by_pool[pool] = reg.stats_snapshot("generation.")
+        for decode in decodes:
+            if decode == "fused" and pool != "device":
+                continue  # fused requires donated device pools
+            # per-series snapshot: reset generation.* so each
+            # (pool, decode) combo's stats land separately
+            for name in list(reg.stats()):
+                if name.startswith("generation."):
+                    reg.get_stat(name).reset()
+            for b in batches:
+                for ctx in contexts:
+                    # pool sized to fit the cell w/o preemption noise
+                    pages = ((ctx + args.new_tokens) // args.page_size
+                             + 2) * b
+                    grid.append(bench_cell(model, b, ctx,
+                                           args.new_tokens, pages,
+                                           args.page_size, pool, decode))
+            stats_by_series[f"{pool}/{decode}"] = \
+                reg.stats_snapshot("generation.")
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
         "model": {"vocab": args.vocab, "layers": args.layers,
                   "heads": args.heads, "head_dim": args.head_dim},
         "pools": list(pools),
+        "decodes": list(decodes),
         "grid": grid,
-        "stats": stats_by_pool,
+        "stats": stats_by_series,
     }
     line = json.dumps(doc)
     print(line)

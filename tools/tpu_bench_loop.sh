@@ -34,10 +34,13 @@ for i in $(seq 1 "$MAX"); do
     # and the decode microbench (tokens/s grid + generation.* stats
     # snapshot embedded via StatRegistry.stats_snapshot); --pool both
     # lands the host-vs-device KV pool A/B (kv_bytes_moved per token:
-    # O(pool) host pools vs O(tokens) DeviceKVPool) in the same artifact
-    timeout 900 python tools/gen_bench.py --pool both \
+    # O(pool) host pools vs O(tokens) DeviceKVPool) and --decode both
+    # lands the eager-vs-fused single-dispatch A/B (steps/s +
+    # dispatches_per_step per cell, warmup/compile time separate) in
+    # the same artifact
+    timeout 900 python tools/gen_bench.py --pool both --decode both \
       --out "${OUT%.json}_gen.json" >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (host/device A/B) -> ${OUT%.json}_gen.json"
+      && echo "[tpu-bench-loop] gen bench (pool + decode A/B) -> ${OUT%.json}_gen.json"
     exit 0
   fi
   echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
