@@ -26,12 +26,14 @@ Quick start::
     result = handle.result()             # GenerationResult
     engine.shutdown()
 """
-from .decode_attention import (dense_causal_reference,
+from .decode_attention import (chunk_prefill_attention,
+                               chunk_prefill_attention_reference,
+                               dense_causal_reference,
                                paged_decode_attention,
                                paged_decode_attention_reference)
-from .engine import (GenerationConfig, GenerationEngine, GenerationHandle,
-                     GenerationResult)
-from .fused import FusedDecodeStep, decode_batch_menu
+from .engine import (DEFAULT_PREFILL_CHUNK_TOKENS, GenerationConfig,
+                     GenerationEngine, GenerationHandle, GenerationResult)
+from .fused import ChunkedPrefillStep, FusedDecodeStep, decode_batch_menu
 from .kv_cache import (DeviceKVPool, OutOfPagesError, PagedKVCache,
                        UnknownSequenceError)
 from .metrics import GenerationMetrics
@@ -48,5 +50,7 @@ __all__ = [
     "dense_causal_reference", "ContinuousBatchingScheduler",
     "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",
     "sample_tokens_batch", "GenerationMetrics", "TinyCausalLM",
-    "FusedDecodeStep", "decode_batch_menu",
+    "FusedDecodeStep", "ChunkedPrefillStep", "decode_batch_menu",
+    "chunk_prefill_attention", "chunk_prefill_attention_reference",
+    "DEFAULT_PREFILL_CHUNK_TOKENS",
 ]

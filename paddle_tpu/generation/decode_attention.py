@@ -105,6 +105,84 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
         page_tables, seq_lens, scale, interpret=interpret, layout=layout)
 
 
+def chunk_prefill_attention_reference(q, k, v, start, scale=None):
+    """Causal attention for ONE prefill chunk over prefix + chunk keys.
+
+    q: [n, H, D] — the chunk's queries; row i sits at global position
+        ``start + i``.
+    k, v: [K, H, D] — keys/values in position order: the already-written
+        prefix occupies rows [0, start), the chunk's own keys rows
+        [start, start + n).  K may exceed start + n (a padded gather);
+        rows past a query's position are masked and contribute exactly
+        zero, so padding never changes a value.
+    Returns [n, H, D].
+
+    Exactness: the masking construction is the decode oracle's (masked
+    logits are NEG_INF, ``exp(NEG_INF - m)`` underflows to exactly 0.0,
+    and ``x + 0.0 == x``), so masked keys contribute EXACTLY zero and
+    padding the key axis never changes which values enter a row's
+    reductions.  What chunking does change is einsum SHAPES (n query
+    rows instead of the full prefix), and XLA picks reduction strategies
+    per shape — values agree with full prefill at the reassociation ulp
+    level (~1e-7 fp32), not bit for bit.  The oracle contract is
+    therefore TOKEN identity: chunked prefill must reproduce full
+    prefill token for token, greedy and seeded-stochastic, which
+    tests/test_chunked_prefill.py pins — the same standard the fused
+    decode step is held to (fused.py).
+    Low-precision K/V (bf16 pools) are upcast to the query dtype before
+    the einsums, exactly like the paged decode reference.
+    """
+    q = jnp.asarray(q)
+    k = jnp.asarray(k).astype(q.dtype)
+    v = jnp.asarray(v).astype(q.dtype)
+    n, _, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    visible = (jnp.arange(k.shape[0], dtype=jnp.int32)[None, :]
+               <= (start + jnp.arange(n, dtype=jnp.int32))[:, None])
+    logits = jnp.where(visible[None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", weights, v)
+
+
+def chunk_prefill_attention(q, k_pool, v_pool, page_table, start,
+                            scale=None, use_kernel=None, interpret=None,
+                            layout="token"):
+    """Paged chunked-prefill attention for ONE sequence: the chunk's K/V
+    have ALREADY been scattered into the pools (positions
+    [start, start + n)), so every key — prefix and chunk alike — is read
+    through the page table.  Dispatch mirrors paged_decode_attention:
+    the Pallas kernel on TPU (or when forced), the jnp gather reference
+    elsewhere.
+
+    q: [n, H, D]; k_pool/v_pool: one layer's pool (either layout);
+    page_table: [max_pages] int32 (pad with 0); start: the chunk's first
+    global position (prefix length).  Rows of q past the chunk's real
+    length are bucket padding — their output is garbage-but-finite and
+    the caller discards it.
+    """
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    q = jnp.asarray(q)
+    n, h, d = q.shape
+    pt = jnp.asarray(page_table, jnp.int32)
+    if not use_kernel:
+        k = _gather_pool(jnp.asarray(k_pool), pt[None], 1, h, d, layout,
+                         q.dtype)[0]
+        v = _gather_pool(jnp.asarray(v_pool), pt[None], 1, h, d, layout,
+                         q.dtype)[0]
+        return chunk_prefill_attention_reference(q, k, v, start,
+                                                 scale=scale)
+    from ..ops.pallas.paged_attention import chunk_prefill_attention_kernel
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    return chunk_prefill_attention_kernel(
+        q, jnp.asarray(k_pool), jnp.asarray(v_pool), pt, start, scale,
+        interpret=interpret, layout=layout)
+
+
 def dense_causal_reference(q, k, v, scale=None):
     """Dense causal full-recompute attention — the oracle the paged path
     is measured against.  q, k, v: [T, H, D] for ONE sequence; returns

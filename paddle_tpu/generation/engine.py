@@ -38,12 +38,27 @@ Model protocol (duck-typed)::
         # page_tables, lens), jitted with the pools donated and
         # dispatched ONCE per step; rows with lens == 0 are padding and
         # must never write a pool page (sentinel + mode="drop")
+    model.prefill_chunk(tokens[n], start, attend) -> last_logits [V]
+        # optional, enables CHUNKED prefill (eager): tokens are the
+        # prompt slice at global positions start..start+n-1; per layer
+        # attend(layer, q[n,H,D], k[n,H,D], v[n,H,D]) -> [n,H,D]
+        # appends the chunk's K/V to the paged cache and runs causal
+        # attention over prefix + chunk
+    model.prefill_chunk_fn(page_size, num_pages, use_kernel=...,
+                           pool_layout=...) -> pure fn      # optional
+        # the jitted chunk variant (fused.ChunkedPrefillStep): the fn
+        # runs one whole chunk — embed, per-layer donated scatter of
+        # the chunk's K/V, paged prefix+chunk attention, last-position
+        # logits — over (params, tokens[C], start, length, k_pools,
+        # v_pools, page_table); rows >= length are bucket padding
+        # (sentinel + mode="drop", logits never read)
 
 Overload behavior is inherited from serving: a full queue raises
 ServerBusyError at submit, lapsed deadlines resolve handles with
 DeadlineExceededError, and page exhaustion preempts the youngest
 sequences (recompute-style) before ever failing a request.
 """
+import math
 import queue
 import threading
 import time
@@ -59,6 +74,11 @@ from .kv_cache import DeviceKVPool, OutOfPagesError, PagedKVCache
 from .metrics import GenerationMetrics, StepTimer
 from .sampling import SamplingParams, sample_token, sample_tokens_batch
 from .scheduler import ContinuousBatchingScheduler, GenerationRequest
+
+# auto chunk size for chunked prefill on TPU (GenerationConfig
+# .prefill_chunk_tokens=None): a multiple of 8 so the chunk-query axis
+# is Mosaic-sublane-aligned for the Pallas chunk kernel
+DEFAULT_PREFILL_CHUNK_TOKENS = 64
 
 
 class GenerationConfig:
@@ -94,6 +114,30 @@ class GenerationConfig:
         scatters write the kernel layout so the kernel path skips its
         per-call whole-pool transpose).  None = "token".  Device
         backend only.
+    prefill_chunk_tokens: CHUNKED prefill — split every admitted prompt
+        into fixed-size chunks of this many tokens and stream them in
+        one chunk per engine step, interleaved with decode, instead of
+        one monolithic (batch, length)-bucketed prefill call that
+        blocks every decode slot for the whole prompt.  0 disables
+        (full prefill); None = auto, mirroring the decode auto policy:
+        chunked (DEFAULT_PREFILL_CHUNK_TOKENS) on TPU when the JITTED
+        chunk path is available (device pools + model.prefill_chunk_fn
+        + jit_prefill — the eager per-layer chunk loop would regress
+        TTFT there, so it stays explicit opt-in), full prefill
+        elsewhere — the CPU tier-1 oracle stays anchored on the
+        one-shot path, and chunked-vs-full
+        token identity is itself oracle-tested (greedy AND
+        seeded-stochastic, incl. preemption re-prefill).  With
+        reduced-precision pools (kv_dtype=bfloat16) the prefix is
+        re-read at storage precision — like decode — so tokens may
+        differ from one-shot prefill at the storage-rounding level.
+    step_token_budget: per-step token budget for prefill/decode
+        interleaving — one prefill chunk (<= prefill_chunk_tokens) plus
+        one token per decode row must fit, else the decode batch is
+        deferred at most ONE step (the decode-owed starvation guard,
+        generation.decode_stall_steps).  None = auto:
+        prefill_chunk_tokens + max_decode_slots, which always fits both
+        so decode never stalls.  Chunked mode only.
     """
 
     def __init__(self, max_decode_slots=8, num_pages=256, page_size=16,
@@ -101,7 +145,8 @@ class GenerationConfig:
                  default_max_new_tokens=16, use_kernel=None,
                  kv_dtype=np.float32, kv_backend=None, max_prefill_batch=4,
                  prefill_length_buckets=None, jit_prefill=None,
-                 decode=None, decode_batch_buckets=None, pool_layout=None):
+                 decode=None, decode_batch_buckets=None, pool_layout=None,
+                 prefill_chunk_tokens=None, step_token_budget=None):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -131,6 +176,18 @@ class GenerationConfig:
                 f"pool_layout must be 'token', 'kernel' or None, got "
                 f"{pool_layout!r}")
         self.pool_layout = pool_layout
+        if prefill_chunk_tokens is not None and int(prefill_chunk_tokens) < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0 (0 disables chunking) "
+                f"or None (auto), got {prefill_chunk_tokens}")
+        self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
+                                     else int(prefill_chunk_tokens))
+        if step_token_budget is not None and int(step_token_budget) < 1:
+            raise ValueError(
+                f"step_token_budget must be >= 1 or None (auto), got "
+                f"{step_token_budget}")
+        self.step_token_budget = (None if step_token_budget is None
+                                  else int(step_token_budget))
 
 
 class GenerationResult:
@@ -163,9 +220,16 @@ class GenerationHandle:
     def __init__(self):
         self._fut = concurrent.futures.Future()
         self._events = queue.SimpleQueue()
+        # time-to-first-token probes (monotonic seconds): submit() stamps
+        # submitted_s, the first sampled token stamps first_token_s —
+        # tools/gen_bench.py's chunked-prefill TTFT A/B reads both
+        self.submitted_s = None
+        self.first_token_s = None
 
     # --- engine side ---
     def _push_token(self, token):
+        if self.first_token_s is None:
+            self.first_token_s = time.monotonic()
         self._events.put(int(token))
 
     def _finish(self, result):
@@ -284,6 +348,51 @@ class GenerationEngine:
             self._fused = FusedDecodeStep(
                 model, self.cache, self.metrics,
                 use_kernel=self._use_kernel, batch_buckets=buckets)
+        # chunked prefill policy mirrors jit_prefill/decode: auto picks
+        # chunking on TPU when the model implements the chunk protocol;
+        # the CPU tier-1 default stays the one-shot prefill the
+        # zero-tolerance oracle is anchored on (chunked-vs-full identity
+        # is itself oracle-tested, tests/test_chunked_prefill.py)
+        chunk_jitable = (backend == "device"
+                        and hasattr(model, "prefill_chunk_fn")
+                        and hasattr(model, "decode_params"))
+        chunk_eager_ok = hasattr(model, "prefill_chunk")
+        chunk = self.config.prefill_chunk_tokens
+        if chunk is None:
+            # auto only picks the JITTED chunk path (device pools +
+            # prefill_chunk_fn + jit_prefill), mirroring the decode auto
+            # policy: on TPU the fast path or nothing — the per-layer
+            # eager chunk loop would REGRESS TTFT vs one jitted full
+            # prefill, so eager chunking stays explicit opt-in (it is
+            # the CPU oracle path).  jit_prefill=False must degrade to
+            # full prefill, never raise on a config the user didn't
+            # write.
+            chunk = (DEFAULT_PREFILL_CHUNK_TOKENS
+                     if on_tpu and chunk_jitable and jit_prefill else 0)
+        elif chunk and not (chunk_jitable or chunk_eager_ok):
+            raise ValueError(
+                f"prefill_chunk_tokens={chunk} needs a model implementing "
+                f"prefill_chunk (eager) or prefill_chunk_fn + "
+                f"decode_params with kv_backend='device' "
+                f"({type(model).__name__} has neither)")
+        self.prefill_chunk_tokens = chunk
+        self._chunk_step = None
+        if chunk and jit_prefill and chunk_jitable:
+            from .fused import ChunkedPrefillStep
+
+            self._chunk_step = ChunkedPrefillStep(
+                model, self.cache, self.metrics, chunk,
+                use_kernel=self._use_kernel)
+        elif chunk and not chunk_eager_ok:
+            raise ValueError(
+                "chunked prefill without jit_prefill + kv_backend="
+                "'device' runs the eager chunk path, which needs "
+                f"model.prefill_chunk ({type(model).__name__} lacks it)")
+        self.step_token_budget = (
+            self.config.step_token_budget
+            if self.config.step_token_budget is not None
+            else (chunk + self.config.max_decode_slots if chunk else None))
+        self._stall_run = 0  # consecutive decode-stalled steps (gauge)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
@@ -341,6 +450,7 @@ class GenerationEngine:
                 f"{max_new_tokens} exceeds the model's max_positions="
                 f"{max_pos}")
         handle = GenerationHandle()
+        handle.submitted_s = time.monotonic()
         req = GenerationRequest(prompt, handle, sampling,
                                 max_new_tokens=max_new_tokens,
                                 stop_tokens=stop_tokens, deadline=deadline)
@@ -370,35 +480,74 @@ class GenerationEngine:
     def _step_locked(self):
         from ..profiler import RecordEvent
 
+        if self.prefill_chunk_tokens:
+            return self._step_chunked()
         # bounded prefill work per step: at most one batched-prefill
         # chunk's worth of admissions, so queued prompts cannot starve
         # the decode batch of a whole step
         admitted = self.scheduler.admit(limit=self.config.max_prefill_batch)
         self._prefill_admitted(admitted)
         self._reap_deadlines()
-        active = self.scheduler.active()
+        active = self.scheduler.decode_ready()
         if not active:
             self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
             self._observe_occupancy()
             return 0
         with StepTimer() as timer:
             with RecordEvent("generation::decode_step"):
-                active = self._ensure_step_capacity(active)
+                active = self._ensure_step_capacity()
                 if not active:
                     return 0
-                if self._fused is not None:
-                    all_greedy, out = self._decode_fused(active)
-                    if all_greedy:
-                        self._apply_tokens(active, out)
-                    else:
-                        self._apply_logits_batch(active, out)
-                else:
-                    logits = self._decode(active)
-                    self._apply_logits_batch(active, logits)
+                self._decode_batch(active)
         self.metrics.observe_step(len(active), timer.seconds)
         self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
         return len(active)
+
+    def _decode_batch(self, active):
+        """One decode dispatch (fused or eager) + sampling for `active`."""
+        if self._fused is not None:
+            all_greedy, out = self._decode_fused(active)
+            if all_greedy:
+                self._apply_tokens(active, out)
+            else:
+                self._apply_logits_batch(active, out)
+        else:
+            logits = self._decode(active)
+            self._apply_logits_batch(active, logits)
+
+    def _step_chunked(self):
+        """One token-budgeted chunked-prefill step: admit, at most ONE
+        prefill-chunk dispatch (the oldest mid-prefill sequence), then
+        the decode batch — unless the budget says decode waits, which
+        the decode-owed guard bounds to a single consecutive step
+        (generation.decode_stall_steps)."""
+        from ..profiler import RecordEvent
+
+        self.scheduler.admit(limit=self.config.max_prefill_batch)
+        self._reap_deadlines()
+        chunk_state, chunk_len, decode, stalled = \
+            self.scheduler.plan_step(self.prefill_chunk_tokens,
+                                     self.step_token_budget)
+        advanced = 0
+        if chunk_state is not None:
+            if self._prefill_chunk_step(chunk_state, chunk_len):
+                advanced += 1
+        decoding = self.scheduler.decode_ready() if decode else []
+        if decoding:
+            with StepTimer() as timer:
+                with RecordEvent("generation::decode_step"):
+                    decoding = self._ensure_step_capacity()
+                    if decoding:
+                        self._decode_batch(decoding)
+            if decoding:
+                self.metrics.observe_step(len(decoding), timer.seconds)
+                advanced += len(decoding)
+        self._stall_run = self._stall_run + 1 if stalled else 0
+        self.metrics.observe_decode_stall(self._stall_run)
+        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+        self._observe_occupancy()
+        return advanced
 
     def run_until_idle(self, max_steps=100000):
         """Drive step() until queue+slots drain (tests/benchmarks)."""
@@ -478,6 +627,8 @@ class GenerationEngine:
                 k[:b_real], v[:b_real])
         last_logits = np.asarray(last_logits)  # one device->host transfer
         for state, _ in ready:
+            state.prefilling = False
+            state.prefill_pos = len(state.tokens)
             self.metrics.count_prefill(len(state.tokens))
         # prefill's last-position logits ARE the next-token logits: new
         # prompts sample their first token here (vectorized greedy
@@ -499,11 +650,127 @@ class GenerationEngine:
             self.scheduler.retire(state)
             state.handle.set_exception(e)
             return
+        state.prefilling = False
+        state.prefill_pos = len(state.tokens)
         self.metrics.count_prefill(len(state.tokens))
         # prefill's last-position logits ARE the next-token logits: new
         # prompts sample their first token here, and a preempted sequence
         # resumes exactly where its decode left off
         self._on_logits(state, last_logits)
+
+    # ------------------------ chunked prefill -----------------------
+    def _prefill_chunk_step(self, state, n):
+        """Dispatch ONE prefill chunk for `state`: reserve `n` tokens
+        (incremental reservation growth — preempting youngest-others on
+        page shortage), run the chunk through the jitted
+        ChunkedPrefillStep or the eager attend path, and on the FINAL
+        chunk sample the first token from the chunk's last-position
+        logits (they ARE the next-token logits, exactly as in full
+        prefill).  Returns True when the chunk ran."""
+        from ..profiler import RecordEvent
+
+        while True:
+            try:
+                start = self.cache.reserve(state.seq_id, n)
+                break
+            except OutOfPagesError as e:
+                victim = self.scheduler.preempt_youngest(exclude=state)
+                if victim is not None:
+                    self.metrics.count_preempted()
+                    continue
+                # even with every other sequence preempted the pool
+                # cannot hold this prefix: typed failure
+                self.scheduler.retire(state)
+                state.handle.set_exception(e)
+                return False
+        assert start == state.prefill_pos, \
+            "cache length diverged from prefill progress"
+        tokens = state.tokens[start:start + n]
+        with RecordEvent("generation::prefill"):
+            if self._chunk_step is not None:
+                logits_last = self._chunk_step.run(state.seq_id, tokens,
+                                                   start)
+                # the jitted chunk scatters in-trace; count the O(tokens)
+                # write bound anyway so kv_bytes_moved / kv_prefill_bytes
+                # stay comparable across prefill paths (same contract as
+                # the fused decode step)
+                self.cache.count_fused_append(n)
+            else:
+                logits_last = self._prefill_chunk_eager(state, tokens,
+                                                        start)
+        state.prefill_pos += n
+        self.metrics.count_prefill(n)
+        self.metrics.count_chunk()
+        self._prewarm_decode(state)
+        if state.prefill_pos == len(state.tokens):
+            state.prefilling = False
+            # the ONLY chunk logits ever materialized: mid-prompt chunks
+            # return unmaterialized device values (ChunkedPrefillStep),
+            # so a streaming prompt costs zero host syncs until here
+            self._on_logits(state, np.asarray(logits_last))
+        return True
+
+    def _prefill_chunk_eager(self, state, tokens, start):
+        """The eager chunk path (the bitwise oracle, mirrors _decode):
+        the model projects the chunk, the engine's attend callback
+        writes its K/V span into the paged pool (per layer) and attends
+        over prefix + chunk read back through the cache — so the jitted
+        path's scatter-then-gather semantics hold here too (reduced-
+        precision pools round the chunk keys at storage in BOTH
+        paths)."""
+        from .decode_attention import chunk_prefill_attention_reference
+
+        seq_id = state.seq_id
+        n = len(tokens)
+
+        def attend(layer, q, k_new, v_new):
+            self.cache.write_prefill_tokens(seq_id, start, layer,
+                                            k_new, v_new)
+            k_all, v_all = self.cache.gather_prefix(seq_id, layer,
+                                                    start + n)
+            return chunk_prefill_attention_reference(q, k_all, v_all,
+                                                     start)
+
+        return np.asarray(
+            self.model.prefill_chunk(np.asarray(tokens, np.int32),
+                                     start, attend))
+
+    def prewarm_decode(self, batch_rows, pages_cols, greedy=True):
+        """Pre-compile the fused decode executable for a (batch, pages,
+        greedy) signature without dispatching anything — benchmarks use
+        this to move bucket compiles OUT of the measured window
+        (tools/gen_bench.py), and the chunked-prefill path calls the
+        same machinery automatically for the bucket a mid-prefill
+        sequence will land in.  No-op on the eager decode path.
+        Returns True when this call actually compiled (counted in
+        decode_compiles_total with the `prewarm` tag,
+        decode_compiles_prewarm)."""
+        if self._fused is None:
+            return False
+        try:
+            compiled = self._fused.prewarm(batch_rows, pages_cols, greedy)
+        except RequestTooLargeError:
+            return False  # past the bucket menu: nothing to pre-warm
+        if compiled:
+            self.metrics.count_decode_prewarm()
+        return compiled
+
+    def _prewarm_decode(self, state):
+        """Decode-bucket pre-warm: while `state` is mid-prefill, compile
+        the fused decode executable for the (batch bucket, pages bucket,
+        greedy) signature it will land in, so its first decode step pays
+        no retrace.  At most once per prefill."""
+        if self._fused is None or state.prewarmed or not state.prefilling:
+            return
+        state.prewarmed = True
+        decoding = self.scheduler.decode_ready()
+        batch_rows = len(decoding) + 1
+        pages = [len(self.cache.page_table(s.seq_id)) for s in decoding]
+        pages.append(math.ceil((len(state.tokens) + 1)
+                               / self.cache.page_size))
+        greedy = (state.request.params.greedy
+                  and all(s.request.params.greedy for s in decoding))
+        self.prewarm_decode(batch_rows, max(pages), greedy)
 
     def _reap_deadlines(self):
         now = time.monotonic()
@@ -513,15 +780,17 @@ class GenerationEngine:
                 state.request.reject_expired()
                 self.metrics.count_rejected_deadline()
 
-    def _ensure_step_capacity(self, active):
-        """Reserve-ability check for one token per active sequence;
-        preempts youngest-first, ONE victim at a time with the shortfall
-        recomputed after each (a victim's own page need leaves the books
-        with it — a batchwide shortfall computed up front would preempt
-        too much or give up while preemption could still succeed).
-        Returns the surviving active list (slot order)."""
+    def _ensure_step_capacity(self):
+        """Reserve-ability check for one token per decode-ready
+        sequence; preempts youngest-first (mid-prefill slot-holders are
+        preemption candidates too — their pages are the cheapest to
+        reclaim), ONE victim at a time with the shortfall recomputed
+        after each (a victim's own page need leaves the books with it —
+        a batchwide shortfall computed up front would preempt too much
+        or give up while preemption could still succeed).  Returns the
+        surviving decode batch (slot order)."""
         while True:
-            active = self.scheduler.active()
+            active = self.scheduler.decode_ready()
             if not active:
                 return active
             need = sum(self.cache.pages_needed(s.seq_id, 1) for s in active)

@@ -51,6 +51,51 @@ from ..serving.bucketing import CompiledModelCache, ShapeBucketer
 from .metrics import DecodeCacheMetrics
 
 
+def _wrap_donating(num_layers, tree, jax_mod, call):
+    """Flatten a pool-donating step fn to the positional-array calling
+    convention CompiledModelCache keys and compiles on:
+    ``(*fixed4, *k_pools, *v_pools, *param_leaves)``.  `call(params,
+    fixed, k_pools, v_pools)` adapts to the inner fn's own argument
+    order and returns ``(out, k_out, v_out)``."""
+    unflatten = jax_mod.tree_util.tree_unflatten
+
+    def step(*flat):
+        fixed, leaves = flat[:4], flat[4:]
+        k_pools = list(leaves[:num_layers])
+        v_pools = list(leaves[num_layers:2 * num_layers])
+        params = unflatten(tree, leaves[2 * num_layers:])
+        out, k_out, v_out = call(params, fixed, k_pools, v_pools)
+        return (out, *k_out, *v_out)
+
+    return step
+
+
+# pools sit at wrapper args 4 .. 4+2L in that convention: donated so XLA
+# updates the KV storage in place instead of copying the pool every call
+def _pool_donate_plan(num_layers):
+    return tuple(range(4, 4 + 2 * num_layers))
+
+
+def _dispatch_donating(cache, exec_cache, args, num_layers):
+    """Run ONE compiled pool-donating dispatch: compile/fetch the
+    executable for `args`' signature, dispatch, install the returned
+    pools.  On ANY failure past the dispatch the donated pool buffers
+    are gone — leave the cache on fresh storage so the engine's
+    fail-the-batch-and-keep-serving recovery (engine._worker) actually
+    keeps serving.  This recovery contract lives HERE, once, for both
+    the fused decode step and the chunked prefill step.  Returns the
+    non-pool output, unmaterialized (no host sync)."""
+    exe = exec_cache.get(args)
+    try:
+        outs = exe(*args)
+        pools = outs[1:]
+        cache.put_pools(pools[:num_layers], pools[num_layers:])
+    except BaseException:
+        cache.reset_pools()
+        raise
+    return outs[0]
+
+
 def decode_batch_menu(max_slots):
     """Power-of-two batch buckets up to (and always including) the cap —
     the one batch-menu builder for both the fused decode step and the
@@ -90,37 +135,21 @@ class FusedDecodeStep:
         self._bucketer = ShapeBucketer(batch_buckets=menu_b,
                                        length_buckets=pages_menu)
         cache_metrics = DecodeCacheMetrics(metrics)
-        # pools are wrapper args 4 .. 4+2L: donated so XLA updates the
-        # KV storage in place instead of copying the pool every token
-        donate = tuple(range(4, 4 + 2 * self._num_layers))
         self._exec = {}
         for greedy in (False, True):
             fn = model.decode_step_fn(
                 cache.page_size, cache.num_pages, use_kernel=use_kernel,
                 pool_layout=cache.pool_layout, greedy=greedy)
+            # fixed args: (tokens, positions, page_tables, lens)
+            wrapped = _wrap_donating(
+                self._num_layers, self._param_tree, jax,
+                lambda params, f, k, v, fn=fn: fn(params, f[0], f[1],
+                                                  k, v, f[2], f[3]))
             self._exec[greedy] = CompiledModelCache(
-                self._wrap(fn), metrics=cache_metrics, aot=True,
-                donate_argnums=donate)
+                wrapped, metrics=cache_metrics, aot=True,
+                donate_argnums=_pool_donate_plan(self._num_layers))
         self.last_dispatches = 0
         self.last_syncs = 0
-
-    def _wrap(self, fn):
-        """Flatten the pytree signature to the positional-array calling
-        convention CompiledModelCache keys and compiles on: (tokens,
-        positions, page_tables, lens, *k_pools, *v_pools, *params)."""
-        num_layers = self._num_layers
-        tree = self._param_tree
-        unflatten = self._jax.tree_util.tree_unflatten
-
-        def step(tokens, positions, page_tables, lens, *leaves):
-            k_pools = list(leaves[:num_layers])
-            v_pools = list(leaves[num_layers:2 * num_layers])
-            params = unflatten(tree, leaves[2 * num_layers:])
-            out, k_out, v_out = fn(params, tokens, positions, k_pools,
-                                   v_pools, page_tables, lens)
-            return (out, *k_out, *v_out)
-
-        return step
 
     @property
     def compile_count(self):
@@ -132,6 +161,31 @@ class FusedDecodeStep:
     def cached_buckets(self):
         return {greedy: c.cached_buckets()
                 for greedy, c in self._exec.items()}
+
+    def prewarm(self, batch_rows, pages_cols, greedy):
+        """AOT-compile the (batch bucket, pages bucket, greedy) decode
+        executable WITHOUT running it — the mid-prefill pre-warm: while
+        a prompt is still streaming chunks in, the engine predicts the
+        decode signature it will land in and compiles it here, so the
+        first decode step after prefill pays no retrace.  Pure
+        ShapeDtypeStructs through the signature cache (get() only
+        lowers+compiles; nothing is dispatched, so donation never
+        consumes a live pool).  Returns True when this call actually
+        compiled (False: the bucket was already cached)."""
+        bucket_b = self._bucketer.batch_bucket(
+            min(max(int(batch_rows), 1), self._bucketer.max_batch))
+        bucket_p = self._bucketer.length_bucket(max(int(pages_cols), 1))
+        sds = self._jax.ShapeDtypeStruct
+        i32 = np.dtype(np.int32)
+        pool = self._cache.layer_pools(0)[0]
+        args = [sds((bucket_b,), i32), sds((bucket_b,), i32),
+                sds((bucket_b, bucket_p), i32), sds((bucket_b,), i32)]
+        args += [sds(tuple(pool.shape), pool.dtype)] * (2 * self._num_layers)
+        args += [sds(tuple(p.shape), p.dtype) for p in self._param_leaves]
+        cache = self._exec[bool(greedy)]
+        before = cache.compile_count
+        cache.get(args)
+        return cache.compile_count > before
 
     def step(self, tokens, positions, page_tables, lens, greedy):
         """One fused decode step for `len(tokens)` live sequences.
@@ -155,20 +209,91 @@ class FusedDecodeStep:
         pt[:b_real, :page_tables.shape[1]] = page_tables
         k_pools, v_pools = self._cache.take_pools()
         args = [tok, pos, pt, ln, *k_pools, *v_pools, *self._param_leaves]
-        exe = self._exec[bool(greedy)].get(args)
-        try:
-            outs = exe(*args)                  # the single dispatch
-            pools = outs[1:]
-            self._cache.put_pools(pools[:self._num_layers],
-                                  pools[self._num_layers:])
-        except BaseException:
-            # the dispatch donated (invalidated) the live pool buffers
-            # and died before handing replacements back; leave the cache
-            # on fresh storage so the engine's fail-the-batch-and-keep-
-            # serving recovery (engine._worker) actually keeps serving
-            self._cache.reset_pools()
-            raise
-        host = np.asarray(outs[0])             # the single host sync
+        out = _dispatch_donating(self._cache, self._exec[bool(greedy)],
+                                 args, self._num_layers)
+        host = np.asarray(out)                 # the single host sync
         self.last_dispatches = 1
         self.last_syncs = 1
         return host[:b_real]
+
+
+class ChunkedPrefillStep:
+    """One jitted pool-donating dispatch per prefill CHUNK (the prefill
+    analogue of FusedDecodeStep).
+
+    Monolithic bucketed prefill compiles one executable per
+    (batch, length) bucket — O(log max_prompt) shapes, each blocking
+    every decode slot for the whole prompt's forward pass.  Chunking
+    fixes the token axis at `chunk_tokens` forever: every chunk of every
+    prompt runs the SAME executable (per pages bucket — the page-table
+    axis still grows geometrically), the chunk's K/V is scattered into
+    the donated pools in-trace (`model.prefill_chunk_fn`, the same
+    drop-mode sentinel semantics as the fused decode step), and the
+    compile menu is O(log num_pages) — independent of prompt length,
+    which is the acceptance bound tests/test_chunked_prefill.py pins on
+    `generation.prefill_compiles_total`.
+
+    Mid-prompt chunks never sync the host: `run` hands the [V]
+    last-position logits back UNMATERIALIZED, and the engine fetches
+    only the FINAL chunk's (they ARE the first-token logits) — so a
+    long prompt streams in with zero dispatch-pipeline bubbles between
+    its chunks and the interleaved decode steps."""
+
+    def __init__(self, model, cache, metrics, chunk_tokens,
+                 use_kernel=False):
+        import jax
+
+        self._cache = cache
+        self._chunk = int(chunk_tokens)
+        if self._chunk < 1:
+            raise ValueError("chunk_tokens must be >= 1")
+        self._num_layers = int(cache.num_layers)
+        self._param_leaves, self._param_tree = jax.tree_util.tree_flatten(
+            model.decode_params())
+        pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
+        self._bucketer = ShapeBucketer(batch_buckets=(1,),
+                                       length_buckets=pages_menu)
+        fn = model.prefill_chunk_fn(
+            cache.page_size, cache.num_pages, use_kernel=use_kernel,
+            pool_layout=cache.pool_layout)
+        # fixed args: (tokens, start, length, page_table); pools donated
+        # exactly like the fused decode step; compiles/hits land under
+        # the PREFILL cache metrics (a chunk executable IS a prefill
+        # executable)
+        wrapped = _wrap_donating(
+            self._num_layers, self._param_tree, jax,
+            lambda params, f, k, v: fn(params, f[0], f[1], f[2],
+                                       k, v, f[3]))
+        self._exec = CompiledModelCache(
+            wrapped, metrics=metrics, aot=True,
+            donate_argnums=_pool_donate_plan(self._num_layers))
+
+    @property
+    def compile_count(self):
+        """Distinct (pages bucket) signatures compiled — O(log
+        num_pages), independent of prompt length."""
+        return self._exec.compile_count
+
+    def run(self, seq_id, tokens, start):
+        """Dispatch one chunk: `tokens` (<= chunk_tokens of them, already
+        reserved at positions [start, start+len)) are padded to the
+        fixed chunk shape, the sequence's page table to its pages
+        bucket, pools donated in, returned pools installed.  Returns the
+        chunk's last-position logits [V] UNMATERIALIZED — no host sync;
+        the engine fetches only the final chunk's (mid-prompt chunks
+        stay fully async)."""
+        n = len(tokens)
+        if n > self._chunk:
+            raise ValueError(f"chunk of {n} tokens > chunk_tokens="
+                             f"{self._chunk}")
+        tok = np.zeros((self._chunk,), np.int32)
+        tok[:n] = tokens
+        pt_row, _ = self._cache.gather_block_tables([seq_id])
+        bucket_p = self._bucketer.length_bucket(pt_row.shape[1])
+        pt = np.zeros((bucket_p,), np.int32)
+        pt[:pt_row.shape[1]] = pt_row[0]
+        k_pools, v_pools = self._cache.take_pools()
+        args = [tok, np.int32(start), np.int32(n), pt,
+                *k_pools, *v_pools, *self._param_leaves]
+        return _dispatch_donating(self._cache, self._exec, args,
+                                  self._num_layers)

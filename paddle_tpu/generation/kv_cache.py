@@ -186,6 +186,18 @@ class PagedKVCache:
         for i, sid in enumerate(seq_ids):
             self.write_token(sid, layer, int(positions[i]), k[i], v[i])
 
+    def write_prefill_tokens(self, seq_id, start, layer, k, v):
+        """Write one prefill CHUNK's K/V for ONE layer: positions
+        ``[start, start + n)`` (already reserved — chunked prefill grows
+        the reservation incrementally, one chunk at a time).  k, v:
+        ``[n, num_heads, head_dim]``.  The per-layer sibling of
+        ``write_decode_tokens``, used by the eager chunked-prefill
+        attend callback (engine._prefill_chunk_eager)."""
+        k = np.asarray(k)
+        self._check_span(seq_id, int(start), k.shape[0])
+        self._write_span(seq_id, int(start), k[None], np.asarray(v)[None],
+                         layers=slice(layer, layer + 1))
+
     def append(self, seq_id, k, v):
         """Append one token across every layer.  k, v:
         ``[num_layers, num_heads, head_dim]``.  Returns the position."""
@@ -199,21 +211,8 @@ class PagedKVCache:
     def append_prefill(self, seq_id, k, v):
         """Append a whole prompt's K/V across every layer.  k, v:
         ``[num_layers, T, num_heads, head_dim]``."""
-        k = np.asarray(k, self.dtype)
-        v = np.asarray(v, self.dtype)
-        n = k.shape[1]
-        start = self.reserve(seq_id, n)
-        table = self._tables[seq_id]
-        t = 0
-        while t < n:
-            pos = start + t
-            page = table[pos // self.page_size]
-            row = pos % self.page_size
-            take = min(self.page_size - row, n - t)
-            self.k_pool[:, page, row:row + take] = k[:, t:t + take]
-            self.v_pool[:, page, row:row + take] = v[:, t:t + take]
-            t += take
-        self._count_write_payload(n, self.num_layers)
+        start = self.reserve(seq_id, np.shape(k)[1])
+        self._write_span(seq_id, start, k, v)
         return start
 
     def _check_span(self, seq_id, start, n):
@@ -238,8 +237,10 @@ class PagedKVCache:
             self._check_span(sid, int(starts[i]), n)
             self._write_span(sid, int(starts[i]), k[i][:, :n], v[i][:, :n])
 
-    def _write_span(self, seq_id, start, k, v):
-        """Page-by-page copy of one reserved span (k, v: [L, n, H, D])."""
+    def _write_span(self, seq_id, start, k, v, layers=slice(None)):
+        """Page-by-page copy of one reserved span (k, v: [L, n, H, D],
+        landing in pool rows `layers` — every layer by default; the
+        chunked-prefill per-layer write passes a single-layer slice)."""
         k = np.asarray(k, self.dtype)
         v = np.asarray(v, self.dtype)
         table = self._table(seq_id)
@@ -250,10 +251,10 @@ class PagedKVCache:
             page = table[pos // self.page_size]
             row = pos % self.page_size
             take = min(self.page_size - row, n - t)
-            self.k_pool[:, page, row:row + take] = k[:, t:t + take]
-            self.v_pool[:, page, row:row + take] = v[:, t:t + take]
+            self.k_pool[layers, page, row:row + take] = k[:, t:t + take]
+            self.v_pool[layers, page, row:row + take] = v[:, t:t + take]
             t += take
-        self._count_write_payload(n, self.num_layers)
+        self._count_write_payload(n, k.shape[0])
 
     # --------------------------- reads ------------------------------
     def layer_pools(self, layer):
@@ -263,6 +264,28 @@ class PagedKVCache:
         exists to remove."""
         k = self.k_pool[layer]
         v = self.v_pool[layer]
+        self._bytes_moved += k.nbytes + v.nbytes
+        return k, v
+
+    def gather_prefix(self, seq_id, layer, length):
+        """One layer's K/V for positions ``[0, length)`` of `seq_id`, in
+        position order — the chunked-prefill prefix read.  Returns
+        ``(k [length, H, D], v [length, H, D])``, EXACT copies of the
+        stored rows (no padding: the view is sliced to the live token
+        count, which is what keeps the chunked oracle bitwise).  Host
+        pools count the gathered bytes as host->device traffic — the
+        attention math runs on device, so the prefix view ships every
+        chunk; DeviceKVPool overrides with a resident-array gather that
+        never crosses the boundary."""
+        self._check_span(seq_id, 0, int(length))
+        table = self._table(seq_id)
+        length = int(length)
+        pages = np.asarray(table, np.int32)[
+            :math.ceil(length / self.page_size)]
+        k = self.k_pool[layer, pages].reshape(
+            -1, self.num_heads, self.head_dim)[:length]
+        v = self.v_pool[layer, pages].reshape(
+            -1, self.num_heads, self.head_dim)[:length]
         self._bytes_moved += k.nbytes + v.nbytes
         return k, v
 
@@ -527,11 +550,43 @@ class DeviceKVPool(PagedKVCache):
         self._scatter_layers_once(all_pages.reshape(-1),
                                   all_rows.reshape(-1), lk, lv, real)
 
+    def write_prefill_tokens(self, seq_id, start, layer, k, v):
+        """One chunk's span for one layer as a single donated scatter
+        (the per-layer sibling of write_decode_tokens)."""
+        k = self._jnp.asarray(k)
+        v = self._jnp.asarray(v)
+        n = k.shape[0]
+        self._check_span(seq_id, int(start), n)
+        pages, rows = self._span_pages_rows(seq_id, int(start), n)
+        self._scatter_layer(layer, pages, rows, k, v, n)
+
     # --------------------------- reads ------------------------------
     def layer_pools(self, layer):
         """The live device arrays — nothing crosses the host<->device
         boundary here, unlike the host backend's O(pool) upload."""
         return self._k[layer], self._v[layer]
+
+    def gather_prefix(self, seq_id, layer, length):
+        """Device-resident prefix gather: rows come straight out of the
+        live pool arrays (same values as the host override — the stored
+        dtype is the stored dtype), nothing crosses the host<->device
+        boundary."""
+        self._check_span(seq_id, 0, int(length))
+        table = self._table(seq_id)
+        length = int(length)
+        jnp = self._jnp
+        pages = jnp.asarray(
+            np.asarray(table, np.int32)[:math.ceil(length
+                                                   / self.page_size)])
+        kp, vp = self._k[layer], self._v[layer]
+        if self.pool_layout == "kernel":
+            # [H, P, ps, D] -> [n_pages, ps, H, D] view of owned pages
+            k = jnp.transpose(kp[:, pages], (1, 2, 0, 3))
+            v = jnp.transpose(vp[:, pages], (1, 2, 0, 3))
+        else:
+            k, v = kp[pages], vp[pages]
+        shape = (-1, self.num_heads, self.head_dim)
+        return k.reshape(shape)[:length], v.reshape(shape)[:length]
 
     def take_pools(self):
         """Hand the live per-layer pool lists to a fused decode step for

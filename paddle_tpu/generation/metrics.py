@@ -44,6 +44,17 @@ Metric names:
                                       (== (batch, pages, greedy) bucket
                                       signatures touched)
 - ``generation.decode_cache_hits`` / ``_misses``  fused bucket cache
+- ``generation.prefill_chunks_total``  chunked-prefill dispatches (one
+                                      chunk of one prompt each)
+- ``generation.decode_stall_steps``   gauge: consecutive steps where live
+                                      decode slots emitted no token
+                                      because prefill spent the step's
+                                      token budget (the scheduler's
+                                      decode-owed guard bounds it at 1)
+- ``generation.decode_compiles_prewarm``  fused decode executables built
+                                      by the mid-prefill pre-warm path
+                                      (the `prewarm` tag on
+                                      decode_compiles_total)
 - ``generation.tokens_per_s``         gauge: decode throughput (EWMA)
 - ``generation.slot_occupancy_pct``   gauge: active / decode slots
 - ``generation.page_utilization_pct`` gauge: pool pages in use
@@ -72,6 +83,9 @@ DECODE_HOST_SYNCS_PER_STEP = PREFIX + "decode_host_syncs_per_step"
 DECODE_COMPILES_TOTAL = PREFIX + "decode_compiles_total"
 DECODE_CACHE_HITS = PREFIX + "decode_cache_hits"
 DECODE_CACHE_MISSES = PREFIX + "decode_cache_misses"
+PREFILL_CHUNKS_TOTAL = PREFIX + "prefill_chunks_total"
+DECODE_STALL_STEPS = PREFIX + "decode_stall_steps"
+DECODE_COMPILES_PREWARM = PREFIX + "decode_compiles_prewarm"
 TOKENS_PER_S = PREFIX + "tokens_per_s"
 SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
 PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
@@ -132,6 +146,18 @@ class GenerationMetrics:
     def count_compile(self):
         self._stat(PREFILL_COMPILES_TOTAL).increase()
 
+    def count_chunk(self):
+        """One chunked-prefill dispatch (a chunk of one prompt)."""
+        self._stat(PREFILL_CHUNKS_TOTAL).increase()
+
+    def count_decode_prewarm(self):
+        """One fused-decode executable compiled by the PRE-WARM path
+        (built while its sequence was still mid-prefill, so the first
+        decode after prefill pays no retrace).  The compile also lands
+        in decode_compiles_total through the normal cache metrics; this
+        counter is the `prewarm` tag splitting it out."""
+        self._stat(DECODE_COMPILES_PREWARM).increase()
+
     # --- fused decode bucket cache (CompiledModelCache interface via
     # the DecodeCacheMetrics adapter below) ---
     def count_decode_cache(self, hit):
@@ -147,6 +173,14 @@ class GenerationMetrics:
         acceptance numbers (1 and <=1) and the eager A/B baseline."""
         self._stat(DECODE_DISPATCHES_PER_STEP).set(int(dispatches))
         self._stat(DECODE_HOST_SYNCS_PER_STEP).set(int(host_syncs))
+
+    def observe_decode_stall(self, consecutive):
+        """Gauge: CONSECUTIVE engine steps in which live decode slots
+        emitted no token because the step's token budget went to
+        prefill.  The scheduler's decode-owed guard bounds it at 1 —
+        a stalled step forces the next step to decode first
+        (tests/test_chunked_prefill.py pins the bound)."""
+        self._stat(DECODE_STALL_STEPS).set(int(consecutive))
 
     def observe_step(self, tokens, step_seconds):
         """One decode step that advanced `tokens` sequences (the token

@@ -55,6 +55,7 @@ class TinyCausalLM:
         self.head_dim = int(head_dim)
         self.d_model = self.num_heads * self.head_dim
         self.max_positions = int(max_positions)
+        self.seed = seed  # weights are deterministic per (seed, shape)
         rng = np.random.default_rng(seed)
         d = self.d_model
 
@@ -169,6 +170,102 @@ class TinyCausalLM:
                                                blk["ln2_b"]))
         last = x[jnp.arange(b), lengths - 1]
         return self._logits(last), jnp.stack(ks, 1), jnp.stack(vs, 1)
+
+    # ------------------------- chunked prefill ------------------------
+    def prefill_chunk(self, tokens, start, attend):
+        """One prefill CHUNK (the eager path, mirrors `decode`): tokens
+        [n] are the prompt slice at global positions
+        ``start .. start + n - 1``.  Per layer, ``attend(layer, q, k, v)``
+        (each [n, H, D]) appends the chunk's K/V to the engine-owned
+        paged cache and returns causal attention over prefix + chunk.
+        Returns the chunk's LAST position logits [V] — for the final
+        chunk these ARE the next-token logits, exactly like `prefill`.
+
+        Row math is identical to `prefill` (same helpers, same einsums;
+        the key source — cached fp32 prefix rows — is an exact copy),
+        so the only divergence from full prefill is XLA's per-shape
+        reduction strategy: values agree at the reassociation ulp
+        level, and the oracle contract is TOKEN identity
+        (tests/test_chunked_prefill.py), the fused-decode standard."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        n = tokens.shape[0]
+        positions = start + jnp.arange(n, dtype=jnp.int32)
+        x = self._embed(tokens, positions)
+        for li, blk in enumerate(self.blocks):
+            hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+            q, k, v = self._qkv(blk, hn)
+            attn = jnp.asarray(attend(li, q, k, v))    # [n, H, D]
+            x = x + attn.reshape(n, self.d_model) @ blk["wo"]
+            x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                               blk["ln2_b"]))
+        return self._logits(x[n - 1:n])[0]
+
+    def prefill_chunk_fn(self, page_size, num_pages, use_kernel=False,
+                         pool_layout="token"):
+        """Build the PURE whole-chunk function the engine's jitted
+        chunked-prefill path compiles (mirrors `decode_step_fn`)::
+
+            fn(params, tokens, start, length, k_pools, v_pools,
+               page_table) -> (last_logits [V], k_pools', v_pools')
+
+        tokens: [C] int32, the chunk padded to the fixed chunk shape;
+        start: int32 scalar, the chunk's first global position (== the
+        tokens already in the cache); length: int32 scalar, real chunk
+        tokens (rows >= length are bucket padding: their K/V write is
+        routed to the OOB sentinel page and dropped, their logits are
+        never read).  k_pools/v_pools: length-L lists of pool arrays
+        (donated by the caller; returned updated).  page_table:
+        [max_pages] int32 for THIS sequence, padded with page 0.  Each
+        layer scatters the chunk's K/V into the pool, then attends over
+        the page table — prefix and chunk through one paged read
+        (decode_attention.chunk_prefill_attention), so the executable's
+        shape depends only on (chunk, pages bucket), never the prompt."""
+        from .kv_cache import scatter_pool_update
+
+        page_size = int(page_size)
+        num_pages = int(num_pages)
+
+        def step(params, tokens, start, length, k_pools, v_pools,
+                 page_table):
+            tokens = jnp.asarray(tokens, jnp.int32)
+            start = jnp.asarray(start, jnp.int32)
+            length = jnp.asarray(length, jnp.int32)
+            pt = jnp.asarray(page_table, jnp.int32)
+            c = tokens.shape[0]
+            idx = jnp.arange(c, dtype=jnp.int32)
+            live = idx < length
+            # padding rows embed position 0 (in bounds by construction);
+            # their K/V is dropped and their logits are never read
+            positions = jnp.where(live, start + idx, 0)
+            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
+            pages = jnp.where(
+                live, pt[jnp.clip((start + idx) // page_size, 0,
+                                  pt.shape[0] - 1)], num_pages)
+            rows = (start + idx) % page_size
+            k_out, v_out = [], []
+            for li, blk in enumerate(params["blocks"]):
+                hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+                q, k, v = self._qkv(blk, hn)
+                kp = scatter_pool_update(
+                    k_pools[li], pages, rows,
+                    k.astype(k_pools[li].dtype), pool_layout)
+                vp = scatter_pool_update(
+                    v_pools[li], pages, rows,
+                    v.astype(v_pools[li].dtype), pool_layout)
+                k_out.append(kp)
+                v_out.append(vp)
+                attn = decode_attention.chunk_prefill_attention(
+                    q, kp, vp, pt, start, use_kernel=use_kernel,
+                    layout=pool_layout)
+                x = x + attn.reshape(c, self.d_model) @ blk["wo"]
+                x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                                   blk["ln2_b"]))
+            last = jnp.take(x, length - 1, axis=0)[None]
+            logits = (_layer_norm(last, params["ln_f_s"],
+                                  params["ln_f_b"]) @ params["head"])[0]
+            return logits, k_out, v_out
+
+        return step
 
     # ----------------------------- decode ----------------------------
     def decode(self, tokens, positions, attend):

@@ -59,10 +59,21 @@ class GenerationRequest(Request):
 class SequenceState:
     """One sequence occupying a decode slot (or awaiting re-admission
     after preemption).  `tokens` is prompt + everything sampled so far;
-    the KV cache holds entries for exactly `tokens[:cache_len]`."""
+    the KV cache holds entries for exactly `tokens[:cache_len]`.
+
+    `prefilling` / `prefill_pos` track the prefill→decode transition:
+    a freshly admitted (or preempted-and-readmitted) sequence is
+    `prefilling` with `prefill_pos` tokens already written to the cache;
+    chunked prefill advances `prefill_pos` one chunk per step, full
+    prefill jumps it to the whole prompt in one go.  Only sequences
+    with `prefilling == False` join the decode batch.  `prewarmed`
+    remembers that the fused-decode executable this sequence will land
+    in was already pre-compiled mid-prefill (at most one pre-warm per
+    prefill)."""
 
     __slots__ = ("seq_id", "request", "tokens", "n_generated", "rng",
-                 "slot", "preemptions")
+                 "slot", "preemptions", "prefilling", "prefill_pos",
+                 "prewarmed")
 
     def __init__(self, seq_id, request):
         self.seq_id = seq_id
@@ -72,6 +83,9 @@ class SequenceState:
         self.rng = request.params.make_rng()
         self.slot = None
         self.preemptions = 0
+        self.prefilling = True
+        self.prefill_pos = 0
+        self.prewarmed = False
 
     @property
     def handle(self):
@@ -97,6 +111,9 @@ class ContinuousBatchingScheduler:
         # priority — they already consumed steps)
         self._pending = collections.deque()
         self._next_seq = 0
+        # token-budget bookkeeping (plan_step): a step that skipped the
+        # decode batch OWES it — the next step decodes first, no chunk
+        self._decode_owed = False
 
     # ------------------------- submission ---------------------------
     def submit(self, request):
@@ -119,6 +136,62 @@ class ContinuousBatchingScheduler:
     def active(self):
         """Sequences currently holding decode slots, slot order."""
         return [s for s in self.slots if s is not None]
+
+    def decode_ready(self):
+        """Slot-holders whose prefill is complete — the decode batch.
+        Mid-prefill sequences hold their slot (they will decode there)
+        but never join a decode dispatch."""
+        return [s for s in self.slots
+                if s is not None and not s.prefilling]
+
+    def prefilling(self):
+        """Slot-holders mid-prefill, oldest (smallest seq_id) first —
+        chunked prefill serves them FIFO, one chunk per step."""
+        return sorted((s for s in self.slots
+                       if s is not None and s.prefilling),
+                      key=lambda s: s.seq_id)
+
+    def plan_step(self, chunk_tokens, budget=None):
+        """Token-budgeted prefill/decode interleave plan for one engine
+        step.  Returns ``(chunk_state, chunk_len, decode, stalled)``:
+
+        - `chunk_state` / `chunk_len`: the single prefill chunk this
+          step may dispatch (the OLDEST mid-prefill sequence, at most
+          `chunk_tokens` tokens, clipped to the budget) — or (None, 0);
+        - `decode`: True when the decode batch runs this step;
+        - `stalled`: True when live decode slots were skipped because
+          the chunk spent the budget.
+
+        The starvation guard: a stalled step sets the decode-owed flag,
+        and an owed step plans NO chunk and decodes unconditionally
+        (even past the budget — the batch must make progress), so
+        consecutive stalled steps can never exceed 1.  The owed flag
+        only suppresses the chunk while a decode batch actually exists:
+        if the stall's creditors have since been preempted or reaped,
+        withholding the chunk would make the step fully idle with a
+        prompt still mid-prefill.  With the default auto budget
+        (chunk_tokens + decode slots) a stall never happens at all; a
+        tight explicit budget trades decode cadence for prefill
+        throughput one alternating step at a time."""
+        prefilling = self.prefilling()
+        decoding = self.decode_ready()
+        chunk_state, chunk_len = None, 0
+        if prefilling and not (self._decode_owed and decoding):
+            cand = prefilling[0]
+            n = min(int(chunk_tokens),
+                    len(cand.tokens) - cand.prefill_pos)
+            if budget is not None:
+                n = min(n, int(budget))
+            if n > 0:
+                chunk_state, chunk_len = cand, n
+        stalled = False
+        decode = bool(decoding)
+        if (decoding and not self._decode_owed and budget is not None
+                and chunk_len and chunk_len + len(decoding) > budget):
+            decode = False
+            stalled = True
+        self._decode_owed = stalled
+        return chunk_state, chunk_len, decode, stalled
 
     def _place(self, state):
         for i, s in enumerate(self.slots):
@@ -186,12 +259,18 @@ class ContinuousBatchingScheduler:
 
     def preempt(self, state):
         """Recompute-preempt: free pages + slot, queue for re-prefill at
-        the FRONT of the pending line (it has seniority over new work)."""
+        the FRONT of the pending line (it has seniority over new work).
+        A mid-prefill victim restarts its prefill from position 0 — its
+        pages are gone, and chunked prefill re-chunks the whole prefix
+        on re-admission (the preemption oracle covers this)."""
         self.retire(state)
         state.preemptions += 1
+        state.prefilling = True
+        state.prefill_pos = 0
+        state.prewarmed = False
         self._pending.appendleft(state)
 
-    def preempt_youngest(self):
+    def preempt_youngest(self, exclude=None):
         """Preempt the single youngest active sequence (most recently
         admitted = least sunk cost) and return it — unless it is the
         only one, in which case return None: the batch must keep making
@@ -199,9 +278,11 @@ class ContinuousBatchingScheduler:
         caller re-evaluates capacity after every single preemption (a
         victim's own page need leaves the books with it, so a batchwide
         shortfall computed up front would over-preempt or give up too
-        early)."""
-        active = self.active()
-        if len(active) < 2:
+        early).  `exclude` shields one sequence (the one whose prefill
+        chunk needs the pages — preempting it to feed itself would free
+        nothing it can keep)."""
+        active = [s for s in self.active() if s is not exclude]
+        if not active or (exclude is None and len(active) < 2):
             return None
         victim = max(active, key=lambda s: s.seq_id)
         self.preempt(victim)

@@ -77,6 +77,112 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[...] / safe_l)[0:1].astype(o_ref.dtype)
 
 
+def _chunk_kernel(pt_ref, info_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size, n_pages, n_rows):
+    """Chunked-prefill attention for ONE sequence: n_rows chunk queries
+    (query row r at global position start + r) attend over every key the
+    page table holds — the already-written prefix AND the chunk's own
+    freshly scattered keys — with a per-row causal mask.  Online-softmax
+    state is [n_rows, ...] (the decode kernel's, grown from 1 query row
+    to the chunk), accumulated across the page axis."""
+    i = pl.program_id(1)
+    start = info_ref[0]
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # page i covers positions [i*page_size, (i+1)*page_size): it runs iff
+    # its first position is visible to SOME query (the last row sees the
+    # most: positions <= start + n_rows - 1)
+    @pl.when(i * page_size <= start + n_rows - 1)
+    def _compute():
+        q = q_ref[0]                               # [n_rows, D]
+        k = k_ref[0, 0]                            # [page_size, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rows, page_size), 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rows, page_size), 0)
+        s = jnp.where(pos <= qpos, s, NEG_INF)     # causal, per query row
+        m_prev = jnp.max(m_ref[...], axis=1, keepdims=True)   # [n, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                     # [n, page_size]
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)    # masked keys: exactly 0
+        l_prev = jnp.max(l_ref[...], axis=1, keepdims=True)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        l = jnp.max(l_ref[...], axis=1, keepdims=True)
+        safe_l = jnp.where(l > 0.0, l, 1.0)  # fully masked pad rows
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
+                                   scale, interpret=None, layout="token"):
+    """q: [n, H, D] — one sequence's prefill-chunk queries (row r at
+    global position start + r; rows past the real chunk length are
+    bucket padding whose output the caller discards).  k_pool/v_pool:
+    one layer's pool, already holding the chunk's scattered K/V —
+    [P, page_size, H, D] (layout="token") or [H, P, page_size, D]
+    (layout="kernel").  page_table: [max_pages] int32 (pad with 0).
+    start: int32 scalar (traced OK — rides as a scalar-prefetch
+    operand).  Returns [n, H, D].
+
+    Same layout reasoning as the decode kernel: token-layout pools are
+    transposed per call, kernel-layout pools are consumed as stored."""
+    n, h, d = q.shape
+    qs = jnp.transpose((q * scale).astype(q.dtype), (1, 0, 2))  # [H, n, D]
+    if layout == "kernel":
+        page_size = k_pool.shape[2]
+        kt, vt = k_pool, v_pool
+    else:
+        page_size = k_pool.shape[1]
+        kt = jnp.transpose(k_pool, (2, 0, 1, 3))
+        vt = jnp.transpose(v_pool, (2, 0, 1, 3))
+    n_pages = page_table.shape[0]
+    info = jnp.asarray(start, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(h, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, n, d), lambda h_, i, pt, nfo: (h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda h_, i, pt, nfo:
+                         (h_, pt[i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda h_, i, pt, nfo:
+                         (h_, pt[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n, d), lambda h_, i, pt, nfo:
+                               (h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n, d), jnp.float32),
+            pltpu.VMEM((n, 128), jnp.float32),
+            pltpu.VMEM((n, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_chunk_kernel, page_size=page_size,
+                          n_pages=n_pages, n_rows=n),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, n, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(jnp.asarray(page_table, jnp.int32), info, qs, kt, vt)
+    return jnp.transpose(out, (1, 0, 2))
+
+
 def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
                                   scale, interpret=None, layout="token"):
     """q: [B, H, D].  k_pool/v_pool: one layer's pool —

@@ -1,0 +1,25 @@
+"""Process-wide memoized greedy_reference oracle for the generation
+suites.
+
+The sequential full-recompute reference is the most expensive thing
+these suites do: O(n) eager prefills over growing prefixes, each a pile
+of tiny jnp dispatches.  test_generation, test_fused_decode, and
+test_chunked_prefill all compare against the SAME (model config,
+prompt, n) pairs — per-module caches re-pay the oracle once per file.
+TinyCausalLM weights are deterministic per (seed, shape), so the
+constructor signature is a sound cross-module cache key and the oracle
+is computed exactly once per distinct comparison in the whole tier-1
+run.
+"""
+
+_REFS = {}
+
+
+def greedy_oracle(model, prompt, n, stop_tokens=()):
+    key = (type(model).__name__, model.seed, model.vocab_size,
+           model.num_layers, model.num_heads, model.head_dim,
+           model.max_positions, tuple(int(t) for t in prompt), int(n),
+           tuple(int(s) for s in stop_tokens))
+    if key not in _REFS:
+        _REFS[key] = model.greedy_reference(prompt, n, stop_tokens)
+    return _REFS[key]

@@ -1,0 +1,695 @@
+"""Chunked prefill with token-budgeted prefill/decode interleaving.
+
+Acceptance oracles (all CPU, conftest forces the backend):
+
+1. TOKEN IDENTITY: chunked prefill (eager AND forced-jit) reproduces
+   full-prefill generation token for token — greedy and
+   seeded-stochastic batches, chunk sizes that don't divide the prompt
+   length, and forced-preemption re-prefill (a victim re-prefills
+   THROUGH CHUNKS).  The chunk-attention masking contributes exactly
+   zero for masked keys (pinned below); end-to-end values differ from
+   full prefill only by XLA's per-shape reduction association, the same
+   standard the fused decode step is held to.
+2. COMPILE MENU COLLAPSE: under chunking, prefill_compiles_total is
+   O(1) in prompt length (one executable per pages bucket, chunk shape
+   fixed) — new prompt lengths add ZERO compiles, while the full-prefill
+   path compiles one executable per length bucket.
+3. STARVATION GUARD: the per-step token budget bounds consecutive
+   decode-stall steps at <= 1 (decode-owed scheduling), even for a
+   pathological 8k-token prompt against a full decode batch.
+4. DECODE PRE-WARM: the fused decode executable a mid-prefill sequence
+   will land in is compiled before its first decode step (counted with
+   the `prewarm` tag), so the prefill->decode seam never retraces.
+
+Plus the gen_bench interleave satellite: decode tokens/s during a
+concurrent long-prompt prefill is strictly better chunked than full.
+"""
+import importlib.util
+import math
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.generation.decode_attention import (
+    chunk_prefill_attention, chunk_prefill_attention_reference,
+    dense_causal_reference)
+from paddle_tpu.profiler.monitor import StatRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402  cross-module memo
+
+
+def _engine(model, *, slots=4, pages=64, page_size=4, chunk=3, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size,
+                               prefill_chunk_tokens=chunk, **kw)
+    return gen.GenerationEngine(model, cfg, start=False)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+# ----------------------- chunk attention math ---------------------------
+
+
+def test_chunk_attention_masked_keys_contribute_exactly_zero():
+    """The exactness anchor: keys past a query's position (causal tail
+    AND gather padding) contribute EXACTLY zero — swapping the masked
+    tail values for garbage changes nothing, bit for bit.  Both calls
+    use the SAME shapes (the contract is per-shape: changing the query
+    count changes XLA's reduction strategy at the ulp level, which is
+    exactly why the end-to-end oracle is token identity, not bitwise)."""
+    rng = np.random.default_rng(0)
+    T, H, D = 13, 2, 8
+    k = rng.standard_normal((T, H, D)).astype(np.float32)
+    v = rng.standard_normal((T, H, D)).astype(np.float32)
+    q = rng.standard_normal((4, H, D)).astype(np.float32)
+    start = 5
+    out = np.asarray(chunk_prefill_attention_reference(
+        q, k[:9], v[:9], start))
+    k2, v2 = k.copy(), v.copy()
+    k2[6:], v2[6:] = 1e6, -1e6  # garbage where row 0 (pos 5) can't look
+    out2 = np.asarray(chunk_prefill_attention_reference(
+        q, k2[:9], v2[:9], start))
+    # row 0 (pos 5) sees only keys 0..5: bit-identical despite garbage
+    np.testing.assert_array_equal(out[:1], out2[:1])
+    # rows 1..3 CAN see the garbage keys: they must have moved, or the
+    # mask is over-wide and the garbage never entered anything
+    assert not np.array_equal(out[1:], out2[1:])
+
+
+@pytest.mark.parametrize("start,n", [(0, 5), (5, 4), (6, 7), (4, 1),
+                                     (0, 13), (12, 1)])
+def test_chunk_attention_rows_match_dense_causal(start, n):
+    """Chunk rows equal the corresponding dense-causal full-recompute
+    rows to reduction-reassociation precision (ulp-level: XLA picks the
+    reduction strategy per shape; the VALUES entering each row's
+    reductions are identical by the masking construction)."""
+    rng = np.random.default_rng(start * 17 + n)
+    T, H, D = 13, 2, 8
+    q = rng.standard_normal((T, H, D)).astype(np.float32)
+    k = rng.standard_normal((T, H, D)).astype(np.float32)
+    v = rng.standard_normal((T, H, D)).astype(np.float32)
+    full = np.asarray(dense_causal_reference(q, k, v))
+    out = np.asarray(chunk_prefill_attention_reference(
+        q[start:start + n], k[:start + n], v[:start + n], start))
+    np.testing.assert_allclose(out, full[start:start + n],
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_chunk_attention_paged_gather_matches_concat_reference():
+    """The paged entry point (pool + page table, the jitted chunk path's
+    read) agrees with the concat reference; the padded gather tail is
+    masked to exact zeros."""
+    rng = np.random.default_rng(1)
+    H, D, ps = 2, 8, 4
+    pool = gen.DeviceKVPool(1, H, D, num_pages=16, page_size=ps)
+    kv = rng.standard_normal((1, 21, H, D)).astype(np.float32)
+    pool.allocate(0)
+    pool.append_prefill(0, kv, -kv)
+    pt, _ = pool.gather_block_tables([0])
+    start, n = 13, 8
+    q = rng.standard_normal((n, H, D)).astype(np.float32)
+    paged = np.asarray(chunk_prefill_attention(
+        q, *pool.layer_pools(0), pt[0], start, use_kernel=False))
+    ref = np.asarray(chunk_prefill_attention_reference(
+        q, kv[0], -kv[0], start))
+    np.testing.assert_allclose(paged, ref, atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_chunk_attention_pallas_interpret_matches_reference(layout):
+    """The Pallas chunk kernel (interpret mode on CPU) implements the
+    same semantics over either pool layout; online softmax reassociates,
+    so small float tolerance."""
+    rng = np.random.default_rng(2)
+    H, D, ps = 2, 128, 8
+    pool = gen.DeviceKVPool(1, H, D, num_pages=16, page_size=ps,
+                            pool_layout=layout)
+    kv = rng.standard_normal((1, 21, H, D)).astype(np.float32)
+    pool.allocate(0)
+    pool.append_prefill(0, kv, -kv)
+    pt, _ = pool.gather_block_tables([0])
+    start, n = 13, 8
+    q = rng.standard_normal((n, H, D)).astype(np.float32)
+    kp, vp = pool.layer_pools(0)
+    ref = np.asarray(chunk_prefill_attention(
+        q, kp, vp, pt[0], start, use_kernel=False, layout=layout))
+    ker = np.asarray(chunk_prefill_attention(
+        q, kp, vp, pt[0], start, use_kernel=True, interpret=True,
+        layout=layout))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_chunk_attention_pallas_first_chunk_empty_prefix():
+    """start == 0 (nothing cached yet): purely causal over the chunk's
+    own keys, no zero-length softmax garbage."""
+    rng = np.random.default_rng(3)
+    H, D, ps = 1, 128, 8
+    pool = gen.DeviceKVPool(1, H, D, num_pages=4, page_size=ps)
+    kv = rng.standard_normal((1, 8, H, D)).astype(np.float32)
+    pool.allocate(0)
+    pool.append_prefill(0, kv, -kv)
+    pt, _ = pool.gather_block_tables([0])
+    q = rng.standard_normal((8, H, D)).astype(np.float32)
+    kp, vp = pool.layer_pools(0)
+    ref = np.asarray(chunk_prefill_attention(q, kp, vp, pt[0], 0,
+                                             use_kernel=False))
+    ker = np.asarray(chunk_prefill_attention(q, kp, vp, pt[0], 0,
+                                             use_kernel=True,
+                                             interpret=True))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+# ------------------------- cache chunk surface ---------------------------
+
+
+@pytest.mark.parametrize("cls", [gen.PagedKVCache, gen.DeviceKVPool])
+def test_cache_write_prefill_tokens_and_gather_prefix_roundtrip(cls):
+    """Per-layer chunk span writes + exact prefix gathers on both
+    backends, spans crossing page boundaries; incremental reservation
+    growth (reserve per chunk, not per prompt)."""
+    c = cls(2, 2, 8, num_pages=8, page_size=4)
+    c.allocate("s")
+    rng = np.random.default_rng(4)
+    full_k = rng.standard_normal((2, 11, 2, 8)).astype(np.float32)
+    written = 0
+    for n in (3, 5, 3):  # 11 tokens in chunks, crossing pages
+        start = c.reserve("s", n)
+        assert start == written
+        for layer in range(2):
+            c.write_prefill_tokens("s", start, layer,
+                                   full_k[layer, start:start + n],
+                                   -full_k[layer, start:start + n])
+        written += n
+        for layer in range(2):
+            k, v = c.gather_prefix("s", layer, written)
+            np.testing.assert_array_equal(np.asarray(k),
+                                          full_k[layer, :written])
+            np.testing.assert_array_equal(np.asarray(v),
+                                          -full_k[layer, :written])
+    assert c.seq_len("s") == 11
+
+
+def test_cache_gather_prefix_typed_errors():
+    c = gen.PagedKVCache(1, 1, 4, num_pages=4, page_size=2)
+    with pytest.raises(gen.UnknownSequenceError):
+        c.gather_prefix("nope", 0, 1)
+    c.allocate("s")
+    c.reserve("s", 3)
+    with pytest.raises(IndexError):
+        c.gather_prefix("s", 0, 4)  # beyond the reservation
+    with pytest.raises(IndexError):
+        c.write_prefill_tokens("s", 2, 0, np.zeros((2, 1, 4)),
+                               np.zeros((2, 1, 4)))
+
+
+# ---------------------- token identity oracles ---------------------------
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 3])
+def test_chunked_greedy_token_identical_to_oracle(model, chunk):
+    """Oracle 1: chunk sizes that don't divide the prompt lengths, all
+    prompts, greedy — token identical to sequential full recompute."""
+    eng = _engine(model, chunk=chunk)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 12)
+    stats = eng.metrics.snapshot()
+    expected_chunks = sum(math.ceil(len(p) / chunk) for p in PROMPTS)
+    assert stats["generation.prefill_chunks_total"] == expected_chunks
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_chunked_stochastic_token_identical_to_full(model):
+    """Oracle 1 (stochastic): seeded temperature/top-k/top-p streams are
+    identical chunked vs full prefill."""
+    def run(chunk):
+        eng = _engine(model, chunk=chunk)
+        hs = [eng.submit(p, max_new_tokens=10,
+                         sampling=gen.SamplingParams(
+                             temperature=0.9, top_k=10, top_p=0.9,
+                             seed=41 + i))
+              for i, p in enumerate(PROMPTS)]
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        eng.shutdown()
+        return out
+
+    assert run(3) == run(0) == run(2)
+
+
+def test_chunked_token_identical_under_forced_preemption(model):
+    """Oracle 1 (preemption): a pool sized to thrash — victims (decoding
+    AND mid-prefill) re-prefill THROUGH CHUNKS and every token still
+    matches; mid-prefill victims restart from position 0."""
+    eng = _engine(model, pages=9, chunk=2)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 12)
+    assert sum(r.preemptions for r in results) > 0
+    # re-prefills ran through the chunk path: more chunks than one clean
+    # pass over every prompt would need
+    clean = sum(math.ceil(len(p) / 2) for p in PROMPTS)
+    stats = eng.metrics.snapshot()
+    assert stats["generation.prefill_chunks_total"] > clean
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_chunked_device_backend_token_identical(model):
+    eng = _engine(model, chunk=3, kv_backend="device")
+    handles = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 10)
+    eng.shutdown()
+
+
+def test_chunked_jit_path_token_identical(model):
+    """The jitted donated-pool chunk dispatch (ChunkedPrefillStep,
+    forced on CPU like the fused decode tests): token identity incl.
+    preemption re-prefill."""
+    eng = _engine(model, chunk=3, pages=9, kv_backend="device",
+                  jit_prefill=True)
+    assert eng._chunk_step is not None
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 12)
+    assert sum(r.preemptions for r in results) > 0
+    eng.shutdown()
+
+
+def test_chunked_jit_fused_decode_token_identical(model):
+    """Chunked jit prefill + fused single-dispatch decode together —
+    the full TPU-shaped pipeline, CPU-forced."""
+    eng = _engine(model, chunk=3, kv_backend="device", jit_prefill=True,
+                  decode="fused")
+    handles = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 10)
+    eng.shutdown()
+
+
+def test_chunked_max_new_tokens_zero_and_stop_tokens(model):
+    eng = _engine(model, chunk=2)
+    free = _ref(model, [1, 2, 3], 8)
+    h0 = eng.submit([1, 2], max_new_tokens=0)
+    hs = eng.submit([1, 2, 3], max_new_tokens=8, stop_tokens=(free[2],))
+    eng.run_until_idle()
+    assert h0.result(timeout=5).token_ids == []
+    assert h0.result().finish_reason == "length"
+    res = hs.result(timeout=5)
+    assert res.finish_reason == "stop" and res.token_ids == free[:2]
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_chunked_background_worker_end_to_end(model):
+    eng = _engine(model, chunk=2)
+    eng.start()
+    try:
+        h = eng.submit([5, 6, 7], max_new_tokens=8)
+        assert list(h.tokens(timeout=30)) == _ref(model, [5, 6, 7], 8)
+    finally:
+        eng.shutdown()
+
+
+# -------------------- compile-menu collapse (oracle 2) -------------------
+
+
+def test_chunked_prefill_compiles_constant_in_prompt_length(model):
+    """Oracle 2: new prompt lengths add ZERO chunk compiles (the chunk
+    shape is fixed; only pages buckets compile), while the full-prefill
+    path compiles one executable per length bucket it meets."""
+    lengths_a = [18, 21, 24]
+    lengths_b = [19, 22, 26, 28, 30]  # new lengths, same pages ballpark
+    menu = tuple(range(17, 33))       # one length bucket per length
+
+    def run(chunked, lengths):
+        # the compiles stat is process-global (StatRegistry singleton):
+        # four engines run inside this one test, so count the DELTA
+        stat = StatRegistry.instance().get_stat(
+            gmetrics.PREFILL_COMPILES_TOTAL)
+        before = stat.get()
+        eng = _engine(model, chunk=4 if chunked else 0, pages=64,
+                      page_size=16, kv_backend="device",
+                      jit_prefill=True,
+                      prefill_length_buckets=menu)
+        rng = np.random.default_rng(7)
+        for n in lengths:
+            h = eng.submit(rng.integers(1, 40, n).tolist(),
+                           max_new_tokens=1)
+            eng.run_until_idle()
+            h.result(timeout=5)
+        compiles = stat.get() - before
+        eng.shutdown()
+        return compiles
+
+    chunked_a = run(True, lengths_a)
+    chunked_ab = run(True, lengths_a + lengths_b)
+    full_a = run(False, lengths_a)
+    full_ab = run(False, lengths_a + lengths_b)
+    # chunked: O(1) in prompt length — extra lengths, zero new compiles
+    assert chunked_ab == chunked_a
+    # full prefill: every new length bucket pays a compile
+    assert full_ab == full_a + len(lengths_b)
+    assert chunked_ab < full_ab
+
+
+def test_chunked_repeat_traffic_no_recompiles(model):
+    eng = _engine(model, chunk=3, kv_backend="device", jit_prefill=True)
+
+    def burst():
+        hs = [eng.submit(p, max_new_tokens=4) for p in PROMPTS]
+        eng.run_until_idle()
+        for h in hs:
+            h.result(timeout=5)
+
+    burst()
+    first = eng._chunk_step.compile_count
+    assert first >= 1
+    burst()
+    assert eng._chunk_step.compile_count == first
+    stats = eng.metrics.snapshot()
+    assert stats["generation.prefill_compiles_total"] == first
+    assert stats["generation.prefill_cache_hits"] > 0
+    eng.shutdown()
+
+
+# ------------------ token budget + starvation guard ----------------------
+
+
+def test_plan_step_budget_and_decode_owed_guard(model):
+    """Scheduler unit: a chunk that busts the budget stalls decode for
+    exactly one step; the owed step plans no chunk and decodes."""
+    eng = _engine(model, chunk=4, slots=4)
+    sched = eng.scheduler
+    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS[:3]]
+    for _ in range(6):
+        eng.step()
+    assert len(sched.decode_ready()) == 3
+    eng.submit([1] * 20, max_new_tokens=1)
+    sched.admit(limit=4)
+    # budget 4: the 4-token chunk alone fills it -> decode stalls
+    chunk_state, chunk_len, decode, stalled = sched.plan_step(4, budget=4)
+    assert chunk_state is not None and chunk_len == 4
+    assert not decode and stalled
+    # owed step: no chunk, decode unconditionally
+    chunk_state, chunk_len, decode, stalled = sched.plan_step(4, budget=4)
+    assert chunk_state is None and decode and not stalled
+    # generous budget: chunk + decode coexist
+    chunk_state, chunk_len, decode, stalled = sched.plan_step(4, budget=8)
+    assert chunk_state is not None and decode and not stalled
+    eng.run_until_idle()
+    for h, p in zip(hs, PROMPTS[:3]):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    eng.shutdown()
+
+
+def test_decode_owed_step_still_chunks_without_decode_batch(model):
+    """A stalled step's debt is only collectible while a decode batch
+    exists: if the creditors were preempted or reaped before the owed
+    step, withholding the chunk too would make the step fully idle with
+    a prompt mid-prefill."""
+    eng = _engine(model, chunk=4)
+    eng.submit([1] * 8, max_new_tokens=1)
+    eng.scheduler.admit(limit=4)
+    eng.scheduler._decode_owed = True  # creditors gone
+    state, n, decode, stalled = eng.scheduler.plan_step(4, budget=4)
+    assert state is not None and n == 4
+    assert not decode and not stalled
+    eng.run_until_idle()
+    eng.shutdown()
+
+
+def test_chunked_oldest_prefill_served_first(model):
+    eng = _engine(model, chunk=2, slots=4)
+    eng.submit([1] * 6, max_new_tokens=1)
+    eng.submit([2] * 6, max_new_tokens=1)
+    eng.scheduler.admit(limit=4)
+    first = eng.scheduler.prefilling()
+    assert [s.seq_id for s in first] == sorted(s.seq_id for s in first)
+    state, n, _, _ = eng.scheduler.plan_step(2, budget=None)
+    assert state is first[0] and n == 2
+    eng.run_until_idle()
+    eng.shutdown()
+
+
+def test_decode_stall_bounded_for_8k_prompt_against_full_batch():
+    """Oracle 3, the pathological case from the issue: an 8192-token
+    prompt streams in against a FULL decode batch under a tight token
+    budget (budget == chunk, so every chunk step stalls decode).  The
+    decode-owed guard bounds consecutive stalls at 1, every decode
+    stream stays token-identical, and the long prompt's first token is
+    the full-prefill argmax."""
+    model = gen.TinyCausalLM(vocab_size=32, num_layers=1, num_heads=1,
+                             head_dim=8, max_positions=8300, seed=5)
+    chunk = 1024
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=4, num_pages=135, page_size=64,
+        prefill_chunk_tokens=chunk, step_token_budget=chunk),
+        start=False)
+    shorts = [[1, 2, 3], [7, 5], [9, 4]]
+    hs = [eng.submit(p, max_new_tokens=24) for p in shorts]
+    for _ in range(4):
+        eng.step()
+    assert len(eng.scheduler.decode_ready()) == 3  # the full decode batch
+    rng = np.random.default_rng(6)
+    long_prompt = rng.integers(0, 32, 8192).tolist()
+    h_long = eng.submit(long_prompt, max_new_tokens=1)
+    max_stall, stalls = 0, 0
+    stat = eng.metrics._stat(gmetrics.DECODE_STALL_STEPS)
+    for _ in range(64):
+        eng.step()
+        g = stat.get()
+        max_stall = max(max_stall, g)
+        stalls += g > 0
+        if not eng.scheduler.prefilling():
+            break
+    assert stalls >= 4          # the tight budget really did alternate
+    assert max_stall <= 1       # ...but never starved two steps running
+    eng.run_until_idle()
+    for h, p in zip(hs, shorts):
+        assert h.result(timeout=5).token_ids == \
+            model.greedy_reference(p, 24)
+    import jax.numpy as jnp
+
+    logits, _, _ = model.prefill(jnp.asarray(long_prompt, jnp.int32))
+    assert h_long.result(timeout=5).token_ids == \
+        [int(np.argmax(np.asarray(logits)))]
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_auto_budget_never_stalls(model):
+    """Default budget (chunk + slots) always fits one chunk plus the
+    whole decode batch: decode_stall_steps stays 0."""
+    eng = _engine(model, chunk=2, slots=4)
+    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    stat = eng.metrics._stat(gmetrics.DECODE_STALL_STEPS)
+    for _ in range(40):
+        eng.step()
+        assert stat.get() == 0
+        if not (eng.scheduler.active() or eng.scheduler.pending_count()):
+            break
+    for h, p in zip(hs, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    eng.shutdown()
+
+
+# ------------------------- decode pre-warm -------------------------------
+
+
+def test_decode_bucket_prewarmed_during_prefill(model):
+    """Oracle 4: with pages pinned to one bucket, the (batch, pages,
+    greedy) executable the joining sequence lands in is compiled DURING
+    its prefill (the prewarm tag), and the join itself adds zero
+    compiles."""
+    cfg = gen.GenerationConfig(max_decode_slots=4, num_pages=8,
+                               page_size=64, prefill_chunk_tokens=4,
+                               kv_backend="device", decode="fused",
+                               jit_prefill=True)
+    eng = gen.GenerationEngine(model, cfg, start=False)
+    h1 = eng.submit([1, 2, 3], max_new_tokens=24)
+    for _ in range(4):
+        eng.step()
+    long_p = [int(t) for t in
+              np.random.default_rng(5).integers(1, 40, 14)]
+    h2 = eng.submit(long_p, max_new_tokens=4)
+    eng.step()  # first chunk of h2: prewarm of (batch 2, pages 1) fires
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_prewarm"] >= 1
+    compiles_mid = stats["generation.decode_compiles_total"]
+    eng.run_until_idle()
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_total"] == compiles_mid, \
+        "the first decode after prefill retraced its bucket"
+    assert h1.result(timeout=5).token_ids == _ref(model, [1, 2, 3], 24)
+    assert h2.result(timeout=5).token_ids == \
+        model.greedy_reference(long_p, 4)
+    eng.shutdown()
+
+
+def test_prewarm_decode_public_api_counts_tag(model):
+    eng = _engine(model, chunk=0, kv_backend="device", decode="fused")
+    assert eng.prewarm_decode(2, 1, greedy=True) is True
+    assert eng.prewarm_decode(2, 1, greedy=True) is False  # cached
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_prewarm"] == 1
+    assert stats["generation.decode_compiles_total"] == 1
+    eng.shutdown()
+
+    eager = _engine(model, chunk=0)
+    assert eager.prewarm_decode(2, 1) is False  # no-op without fused
+    eager.shutdown()
+
+
+# --------------------------- config policy -------------------------------
+
+
+def test_chunked_config_validation(model):
+    with pytest.raises(ValueError):
+        gen.GenerationConfig(prefill_chunk_tokens=-1)
+    with pytest.raises(ValueError):
+        gen.GenerationConfig(step_token_budget=0)
+
+    class NoChunk:
+        num_layers, num_heads, head_dim, vocab_size = 1, 1, 4, 8
+
+        def prefill(self, tokens):
+            raise NotImplementedError
+
+        def decode(self, tokens, positions, attend):
+            raise NotImplementedError
+
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        gen.GenerationEngine(NoChunk(), gen.GenerationConfig(
+            prefill_chunk_tokens=4), start=False)
+    # auto on CPU: chunking off, full prefill stays the tier-1 default
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(), start=False)
+    assert eng.prefill_chunk_tokens == 0
+    eng.shutdown()
+
+
+class _JitOnlyChunkModel:
+    """Implements the jit chunk protocol (prefill_chunk_fn +
+    decode_params) but NOT the eager prefill_chunk."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        if name == "prefill_chunk":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+def test_auto_chunk_policy_requires_servable_jit_path(model, monkeypatch):
+    """Auto (prefill_chunk_tokens=None) picks chunking ONLY when the
+    jitted chunk path can actually serve it: jit_prefill=False must
+    degrade to full prefill (never raise on a config the user didn't
+    write), and an eager-only chunk protocol never auto-enables on TPU
+    (the per-layer eager loop would regress TTFT vs one jitted
+    prefill — eager chunking is explicit opt-in)."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    eng = gen.GenerationEngine(
+        _JitOnlyChunkModel(model),
+        gen.GenerationConfig(jit_prefill=False, use_kernel=False),
+        start=False)
+    assert eng.prefill_chunk_tokens == 0 and eng._chunk_step is None
+    eng.shutdown()
+    # host pools make the jit path unavailable; the eager protocol
+    # (TinyCausalLM.prefill_chunk) alone must not auto-enable
+    eng = gen.GenerationEngine(
+        model, gen.GenerationConfig(kv_backend="host", use_kernel=False),
+        start=False)
+    assert eng.prefill_chunk_tokens == 0
+    eng.shutdown()
+    # with the full jit path available, auto DOES chunk on TPU
+    eng = gen.GenerationEngine(
+        model, gen.GenerationConfig(kv_backend="device", use_kernel=False),
+        start=False)
+    assert eng.prefill_chunk_tokens == gen.DEFAULT_PREFILL_CHUNK_TOKENS
+    assert eng._chunk_step is not None
+    eng.shutdown()
+
+
+# ------------------- gen_bench interleave satellite ----------------------
+
+
+def _load_gen_bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "gen_bench.py")
+    spec = importlib.util.spec_from_file_location("gen_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gen_bench_interleave_chunked_beats_full_decode_throughput():
+    """The acceptance A/B: decode tokens/s (and raw token count) during
+    a concurrent long-prompt prefill is strictly better chunked than
+    full — full prefill head-of-line-blocks the decode batch for the
+    whole prompt, chunking interleaves."""
+    gb = _load_gen_bench()
+    model = gen.TinyCausalLM(vocab_size=64, num_layers=2, num_heads=2,
+                             head_dim=8, max_positions=256, seed=0)
+    cells = {
+        mode: gb.bench_interleave(model, batch=4, context=8,
+                                  long_context=96, new_tokens=16,
+                                  page_size=8, pool="host",
+                                  decode="eager", prefill=mode,
+                                  chunk_tokens=8)
+        for mode in ("full", "chunked")
+    }
+    full, chunked = cells["full"], cells["chunked"]
+    assert chunked["prefill_chunks"] == 12  # 96 / 8
+    assert full["prefill_chunks"] == 0
+    assert chunked["decode_tokens_during_prefill"] > \
+        full["decode_tokens_during_prefill"]
+    assert chunked["decode_tps_during_prefill"] > \
+        full["decode_tps_during_prefill"]
+    # steady state: the measured pass compiles nothing in either mode
+    assert full["measured_prefill_compiles"] == 0
+    assert chunked["measured_prefill_compiles"] == 0
+
+
+def test_gen_bench_cell_reports_measured_compiles(model):
+    """Satellite: pre-warm moves bucket compiles out of the measured
+    window — the steady-state cell reports measured_compiles == 0 on
+    the fused decode path."""
+    gb = _load_gen_bench()
+    cell = gb.bench_cell(model, batch=4, context=8, new_tokens=8,
+                         num_pages=32, page_size=8, pool="device",
+                         decode="fused")
+    assert cell["measured_compiles"] == 0
+    assert cell["dispatches_per_step"] == 1
+    assert cell["warmup_s"] > 0

@@ -47,17 +47,7 @@ def model():
                             head_dim=8, seed=3)
 
 
-_REFS = {}
-
-
-def _ref(model, prompt, n):
-    """Memoized greedy_reference: the sequential full-recompute oracle is
-    O(n) prefills over growing prefixes — several tests compare against
-    identical (prompt, n) pairs, no need to pay it repeatedly."""
-    key = (tuple(prompt), n)
-    if key not in _REFS:
-        _REFS[key] = model.greedy_reference(prompt, n)
-    return _REFS[key]
+from gen_oracle import greedy_oracle as _ref  # noqa: E402  cross-module memo
 
 
 def _engine(model, *, slots=4, pages=64, page_size=4, decode="fused",

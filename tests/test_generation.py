@@ -20,6 +20,8 @@ import paddle_tpu as paddle
 from paddle_tpu import generation as gen
 from paddle_tpu.generation import metrics as gmetrics
 from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle  # cross-module memoized oracle
 from paddle_tpu.serving.admission import (DeadlineExceededError,
                                           RequestTooLargeError,
                                           ServerBusyError, ServingError)
@@ -314,7 +316,7 @@ def test_continuous_batched_greedy_token_identical_to_sequential(model):
     eng.run_until_idle()
     for h, p in zip(handles, PROMPTS):
         res = h.result(timeout=5)
-        assert res.token_ids == model.greedy_reference(p, 12)
+        assert res.token_ids == greedy_oracle(model, p, 12)
         assert res.finish_reason == "length"
     # oracle 3: every page returned to the pool
     assert eng.cache.utilization() == 0.0
@@ -330,7 +332,7 @@ def test_generation_token_identical_under_forced_preemption(model):
     eng.run_until_idle()
     results = [h.result(timeout=5) for h in handles]
     for res, p in zip(results, PROMPTS):
-        assert res.token_ids == model.greedy_reference(p, 12)
+        assert res.token_ids == greedy_oracle(model, p, 12)
     assert sum(r.preemptions for r in results) > 0  # the pool did thrash
     assert eng.metrics.snapshot()["generation.preempted_total"] > 0
     assert eng.cache.utilization() == 0.0
@@ -344,14 +346,14 @@ def test_generation_one_slot_serializes_but_tokens_identical(model):
     handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
     eng.run_until_idle()
     for h, p in zip(handles, PROMPTS):
-        assert h.result(timeout=5).token_ids == model.greedy_reference(p, 6)
+        assert h.result(timeout=5).token_ids == greedy_oracle(model, p, 6)
     eng.shutdown()
 
 
 def test_generation_stop_tokens_and_finish_reasons(model):
     eng = _engine(model)
     # discover the greedy stream, then stop on its 3rd token
-    free = model.greedy_reference([1, 2, 3], 8)
+    free = greedy_oracle(model, [1, 2, 3], 8)
     stop = free[2]
     h = eng.submit([1, 2, 3], max_new_tokens=8, stop_tokens=(stop,))
     eng.run_until_idle()
@@ -494,7 +496,7 @@ def test_generation_tight_pool_all_sequences_hit_boundary_together(model):
     eng.run_until_idle()
     results = [h.result(timeout=5) for h in handles]  # none may raise
     for res, p in zip(results, prompts):
-        assert res.token_ids == model.greedy_reference(p, 8)
+        assert res.token_ids == greedy_oracle(model, p, 8)
     assert sum(r.preemptions for r in results) > 0
     stats = eng.metrics.snapshot()
     assert stats["generation.preempted_total"] == \
@@ -557,7 +559,7 @@ def test_generation_background_worker_end_to_end(model):
                     timeout=60)) for p in PROMPTS]
             results = [f.result(timeout=60) for f in futs]
         for res, p in zip(results, PROMPTS):
-            assert res.token_ids == model.greedy_reference(p, 8)
+            assert res.token_ids == greedy_oracle(model, p, 8)
     finally:
         eng.shutdown()
     assert eng.cache.utilization() == 0.0

@@ -17,6 +17,19 @@ Usage:
         # steps/s + tokens/s per cell with per-step dispatch/sync
         # counts; compile/warmup wall time in the separate warmup_s
         # column, never folded into the rate
+    python tools/gen_bench.py --prefill both --chunk-tokens 32
+        # full vs CHUNKED prefill A/B: every series gains an
+        # "interleave" cell — batch-1 short requests decode while one
+        # long prompt streams in — reporting time-to-first-token per
+        # request, decode tokens/s DURING the long prefill, and the
+        # prefill compile count (chunked: O(1) in prompt length)
+
+Steady-state accounting: every cell pre-warms its decode buckets (and
+pays its prefill/chunk compiles in a full warmup pass) BEFORE the
+measured window; compile wall time lands in `warmup_s`, and the cell's
+`measured_compiles` field records any executable built inside the timed
+region (0 in the steady state — a nonzero value means the bucket menu
+was exercised mid-run and the rate is polluted).
 """
 import argparse
 import json
@@ -36,8 +49,27 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     _jax.config.update("jax_platforms", "cpu")
 
 
+def _prewarm_decode_buckets(eng, batch, context, new_tokens, page_size):
+    """Pre-compile every fused-decode bucket the run can touch (all
+    batch buckets <= batch x all pages buckets up to the final context)
+    OUTSIDE the measured window — a new bucket appearing mid-run (batch
+    decay on finishes, pages growth as sequences lengthen) otherwise
+    lands its compile wall time in the timed region.  No-op on the
+    eager path.  Returns elapsed seconds (reported under warmup_s)."""
+    t0 = time.perf_counter()
+    max_pages = -(-(context + new_tokens + 1) // page_size)
+    pages = 1
+    while True:
+        for b in range(1, batch + 1):
+            eng.prewarm_decode(b, pages, greedy=True)
+        if pages >= max_pages:
+            break
+        pages *= 2
+    return time.perf_counter() - t0
+
+
 def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
-               pool, decode):
+               pool, decode, prefill="full", chunk_tokens=0):
     from paddle_tpu import generation as g
     from paddle_tpu.generation import metrics as gmetrics
     from paddle_tpu.profiler.monitor import StatRegistry
@@ -46,7 +78,10 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
                            page_size=page_size, queue_depth=batch * 2,
-                           kv_backend=pool, decode=decode),
+                           kv_backend=pool, decode=decode,
+                           prefill_chunk_tokens=(chunk_tokens
+                                                 if prefill == "chunked"
+                                                 else 0)),
         start=False)
     rng = np.random.default_rng(batch * 1000 + context)
     prompts = [rng.integers(0, model.vocab_size, context).tolist()
@@ -63,15 +98,24 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
     # warmup pass: same shapes as the measured pass, so it pays every
     # trace/compile (fused decode buckets, jit_prefill buckets) exactly
     # once — compile time is REPORTED, never folded into the
-    # steady-state rate below
+    # steady-state rate below.  The explicit bucket pre-warm then covers
+    # signatures the warmup pass may have missed (scheduling jitter can
+    # shift which buckets a pass touches).
     warmup_s, _ = run_once()
+    warmup_s += _prewarm_decode_buckets(eng, batch, context, new_tokens,
+                                        page_size)
     reg = StatRegistry.instance()
     kv_stat = reg.get_stat(gmetrics.KV_BYTES_MOVED)
     pf_stat = reg.get_stat(gmetrics.PREFILL_TOKENS_TOTAL)
     steps_stat = reg.get_stat(gmetrics.STEPS_TOTAL)
+    pfc_stat = reg.get_stat(gmetrics.PREFILL_COMPILES_TOTAL)
+    dcc_stat = reg.get_stat(gmetrics.DECODE_COMPILES_TOTAL)
     kv_before, pf_before = kv_stat.get(), pf_stat.get()
     steps_before = steps_stat.get()
+    compiles_before = pfc_stat.get() + dcc_stat.get()
     dt, results = run_once()
+    measured_compiles = int(pfc_stat.get() + dcc_stat.get()
+                            - compiles_before)
     generated = sum(len(r.token_ids) for r in results)
     steps = int(steps_stat.get() - steps_before)
     kv_bytes = int(kv_stat.get() - kv_before)
@@ -85,12 +129,16 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
     return {
         "pool": pool,
         "decode": decode,
+        "prefill": prefill,
         "batch": batch,
         "context": context,
         "new_tokens": new_tokens,
         "generated": generated,
         "wall_s": round(dt, 4),
-        "warmup_s": round(warmup_s, 4),      # compile+trace, separate
+        "warmup_s": round(warmup_s, 4),      # compile+trace+prewarm
+        # executables built INSIDE the timed region (steady state: 0 —
+        # pre-warm moved every bucket compile into warmup_s)
+        "measured_compiles": measured_compiles,
         "tokens_per_s": round(generated / dt, 2) if dt > 0 else 0.0,
         "steps": steps,
         "steps_per_s": round(steps / dt, 2) if dt > 0 else 0.0,
@@ -110,6 +158,128 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         "kv_decode_bytes_per_token": round(
             (kv_bytes - prefill_bytes) / max(generated, 1), 1),
     }
+
+
+def bench_interleave(model, batch, context, long_context, new_tokens,
+                     page_size, pool, decode, prefill, chunk_tokens):
+    """The chunked-prefill A/B scenario: `batch - 1` short requests
+    decode while ONE long prompt streams in.  Reports time-to-first-
+    token per request and the decode tokens/s the short requests
+    sustained DURING the long prompt's prefill window — the
+    head-of-line stall full prefill causes and chunking removes.
+
+    Measured on the second pass (the first pays every compile); the
+    prefill window is [long submit, long first token], probed via the
+    GenerationHandle submitted_s/first_token_s monotonic stamps."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.generation import metrics as gmetrics
+    from paddle_tpu.profiler.monitor import StatRegistry
+
+    pages = (-(-(long_context + new_tokens) // page_size) + 2) * batch
+    eng = g.GenerationEngine(
+        model,
+        g.GenerationConfig(max_decode_slots=batch, num_pages=pages,
+                           page_size=page_size, queue_depth=batch * 2,
+                           kv_backend=pool, decode=decode,
+                           prefill_chunk_tokens=(chunk_tokens
+                                                 if prefill == "chunked"
+                                                 else 0)),
+        start=False)
+    rng = np.random.default_rng(batch * 7 + context)
+    shorts = [rng.integers(0, model.vocab_size, context).tolist()
+              for _ in range(batch - 1)]
+    long_prompt = rng.integers(0, model.vocab_size, long_context).tolist()
+    reg = StatRegistry.instance()
+    tok_stat = reg.get_stat(gmetrics.TOKENS_TOTAL)
+    chunk_stat = reg.get_stat(gmetrics.PREFILL_CHUNKS_TOTAL)
+
+    def run_once():
+        hs = [eng.submit(p, max_new_tokens=new_tokens) for p in shorts]
+        # get every short request decoding before the long prompt lands;
+        # chunked mode streams ONE chunk per step FIFO, so the cap must
+        # cover every short's whole prefill or the measured window would
+        # silently include leftover short-prefill chunks
+        warm_cap = 64 + len(shorts) * (
+            -(-context // max(chunk_tokens, 1))
+            if prefill == "chunked" else 1)
+        for _ in range(warm_cap):
+            eng.step()
+            if all(h.first_token_s is not None for h in hs):
+                break
+        if not all(h.first_token_s is not None for h in hs):
+            raise RuntimeError(
+                "interleave warm-up did not finish the short requests' "
+                "prefills; the window metrics would be mis-scoped")
+        tokens_before = tok_stat.get()
+        chunks_before = chunk_stat.get()
+        h_long = eng.submit(long_prompt, max_new_tokens=new_tokens)
+        # count short-request tokens from steps that finished BEFORE the
+        # long prompt's first token: the snapshot taken before the step
+        # that produced it excludes that step's own decode output, which
+        # lands after the window closes in both prefill modes
+        before_step = tok_stat.get()
+        # capped like the warm-up loop: if the long prompt can never
+        # yield a first token (page exhaustion resolves its handle with
+        # an exception, pathological config), fail THIS cell instead of
+        # spinning until the harness timeout kills the whole artifact
+        window_cap = 256 + 4 * (
+            -(-long_context // max(chunk_tokens, 1))
+            if prefill == "chunked" else 1)
+        for _ in range(window_cap):
+            if h_long.first_token_s is not None:
+                break
+            before_step = tok_stat.get()
+            eng.step()
+        if h_long.first_token_s is None:
+            raise RuntimeError(
+                "interleave cell: the long prompt produced no first "
+                "token within the step cap (config cannot fit it?)")
+        decode_tokens = int(before_step - tokens_before)
+        # chunks dispatched inside the window belong to the long prompt
+        # alone (the shorts finished prefilling in the loop above):
+        # ceil(long_context / chunk_tokens) when chunked, 0 when full
+        window_chunks = int(chunk_stat.get() - chunks_before)
+        eng.run_until_idle()
+        for h in hs:
+            h.result(timeout=1)
+        h_long.result(timeout=1)
+        window = h_long.first_token_s - h_long.submitted_s
+        return {
+            "ttft_long_s": round(window, 4),
+            "ttft_short_avg_s": round(
+                sum(h.first_token_s - h.submitted_s for h in hs)
+                / max(len(hs), 1), 4),
+            "decode_tokens_during_prefill": decode_tokens,
+            "decode_tps_during_prefill": round(
+                decode_tokens / window, 2) if window > 0 else 0.0,
+            "prefill_chunks": window_chunks,
+        }
+
+    run_once()                                   # compile/trace pass
+    warm_t0 = time.perf_counter()
+    _prewarm_decode_buckets(eng, batch, long_context, new_tokens,
+                            page_size)
+    warmup_s = time.perf_counter() - warm_t0
+    pfc = reg.get_stat(gmetrics.PREFILL_COMPILES_TOTAL)
+    pfc_before = pfc.get()
+    cell = run_once()                            # measured pass
+    cell.update({
+        "scenario": "interleave",
+        "pool": pool,
+        "decode": decode,
+        "prefill": prefill,
+        "batch": batch,
+        "context": context,
+        "long_context": long_context,
+        "new_tokens": new_tokens,
+        "warmup_s": round(warmup_s, 4),
+        # compile reuse across passes: 0 new prefill executables in the
+        # measured pass for BOTH modes; the absolute count per series
+        # is in the stats snapshot (chunked: O(1) in prompt length)
+        "measured_prefill_compiles": int(pfc.get() - pfc_before),
+    })
+    eng.shutdown()
+    return cell
 
 
 def main():
@@ -132,6 +302,20 @@ def main():
                          "host-pool fused cells are skipped); steps/s "
                          "is steady-state with compile/warmup time in "
                          "the separate warmup_s column")
+    ap.add_argument("--prefill", choices=("full", "chunked", "both"),
+                    default="full",
+                    help="prefill-path A/B: one monolithic bucketed "
+                         "prefill per prompt vs CHUNKED prefill "
+                         "(fixed-size chunks interleaved with decode "
+                         "under the step token budget); each series "
+                         "adds an 'interleave' cell measuring TTFT and "
+                         "decode tokens/s while a long prompt streams "
+                         "in")
+    ap.add_argument("--chunk-tokens", type=int, default=32,
+                    help="chunk size for --prefill chunked/both")
+    ap.add_argument("--long-context", type=int, default=None,
+                    help="long-prompt length for the interleave cell "
+                         "(default: 8x the largest --contexts entry)")
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
@@ -147,13 +331,17 @@ def main():
 
     batches = [int(b) for b in args.batches.split(",")]
     contexts = [int(c) for c in args.contexts.split(",")]
+    long_ctx = args.long_context or max(contexts) * 8
     model = g.TinyCausalLM(vocab_size=args.vocab, num_layers=args.layers,
                            num_heads=args.heads, head_dim=args.head_dim,
-                           max_positions=max(contexts) + args.new_tokens + 1,
+                           max_positions=(max(max(contexts), long_ctx)
+                                          + args.new_tokens + 1),
                            seed=0)
     pools = (("host", "device") if args.pool == "both" else (args.pool,))
     decodes = (("eager", "fused") if args.decode == "both"
                else (args.decode,))
+    prefills = (("full", "chunked") if args.prefill == "both"
+                else (args.prefill,))
     grid = []
     stats_by_series = {}
     reg = StatRegistry.instance()
@@ -161,21 +349,33 @@ def main():
         for decode in decodes:
             if decode == "fused" and pool != "device":
                 continue  # fused requires donated device pools
-            # per-series snapshot: reset generation.* so each
-            # (pool, decode) combo's stats land separately
-            for name in list(reg.stats()):
-                if name.startswith("generation."):
-                    reg.get_stat(name).reset()
-            for b in batches:
-                for ctx in contexts:
-                    # pool sized to fit the cell w/o preemption noise
-                    pages = ((ctx + args.new_tokens) // args.page_size
-                             + 2) * b
-                    grid.append(bench_cell(model, b, ctx,
-                                           args.new_tokens, pages,
-                                           args.page_size, pool, decode))
-            stats_by_series[f"{pool}/{decode}"] = \
-                reg.stats_snapshot("generation.")
+            for prefill in prefills:
+                # per-series snapshot: reset generation.* so each
+                # (pool, decode, prefill) combo's stats land separately
+                for name in list(reg.stats()):
+                    if name.startswith("generation."):
+                        reg.get_stat(name).reset()
+                for b in batches:
+                    for ctx in contexts:
+                        # pool sized to fit the cell w/o preemption noise
+                        pages = ((ctx + args.new_tokens)
+                                 // args.page_size + 2) * b
+                        grid.append(bench_cell(
+                            model, b, ctx, args.new_tokens, pages,
+                            args.page_size, pool, decode, prefill,
+                            args.chunk_tokens))
+                # the prefill/decode-interleave cell: decode throughput
+                # while a long prompt streams in (the chunked-prefill
+                # headline number)
+                ib = max(batches)
+                if ib > 1:
+                    grid.append(bench_interleave(
+                        model, ib, min(contexts), long_ctx,
+                        args.new_tokens, args.page_size, pool, decode,
+                        prefill, args.chunk_tokens))
+                series = f"{pool}/{decode}/{prefill}"
+                stats_by_series[series] = \
+                    reg.stats_snapshot("generation.")
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
@@ -183,6 +383,8 @@ def main():
                   "heads": args.heads, "head_dim": args.head_dim},
         "pools": list(pools),
         "decodes": list(decodes),
+        "prefills": list(prefills),
+        "chunk_tokens": args.chunk_tokens,
         "grid": grid,
         "stats": stats_by_series,
     }

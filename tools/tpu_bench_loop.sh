@@ -34,13 +34,15 @@ for i in $(seq 1 "$MAX"); do
     # and the decode microbench (tokens/s grid + generation.* stats
     # snapshot embedded via StatRegistry.stats_snapshot); --pool both
     # lands the host-vs-device KV pool A/B (kv_bytes_moved per token:
-    # O(pool) host pools vs O(tokens) DeviceKVPool) and --decode both
+    # O(pool) host pools vs O(tokens) DeviceKVPool), --decode both
     # lands the eager-vs-fused single-dispatch A/B (steps/s +
-    # dispatches_per_step per cell, warmup/compile time separate) in
-    # the same artifact
-    timeout 900 python tools/gen_bench.py --pool both --decode both \
-      --out "${OUT%.json}_gen.json" >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode A/B) -> ${OUT%.json}_gen.json"
+    # dispatches_per_step per cell, warmup/compile time separate) and
+    # --prefill both lands the full-vs-chunked prefill A/B (TTFT +
+    # decode tokens/s during a long-prompt prefill via the interleave
+    # cell, prefill compile counts) in the same artifact
+    timeout 1200 python tools/gen_bench.py --pool both --decode both \
+      --prefill both --out "${OUT%.json}_gen.json" >/dev/null 2>&1 \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill A/B) -> ${OUT%.json}_gen.json"
     exit 0
   fi
   echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
