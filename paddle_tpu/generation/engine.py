@@ -138,6 +138,18 @@ class GenerationConfig:
         generation.decode_stall_steps).  None = auto:
         prefill_chunk_tokens + max_decode_slots, which always fits both
         so decode never stalls.  Chunked mode only.
+    mesh: a ``jax.sharding.Mesh`` (parallel.tp_mesh builds one) turning
+        on TENSOR-PARALLEL sharded decode: KV pools, attention, and the
+        per-layer QKV/MLP weights shard over the HEAD axis with
+        NamedSharding, and each fused decode step stays ONE GSPMD
+        dispatch whose collectives XLA inserts from the annotations
+        (docs/GENERATION.md "Sharded decode").  Requires the device KV
+        backend, the fused decode path (auto resolves both), a model
+        whose num_heads divides by the mesh axis, and — for now — the
+        jnp attention path (use_kernel=True raises: the Pallas kernels
+        are single-device programs until the shard_map follow-on).
+    tp_axis: the mesh axis name to shard heads over; None = the mesh's
+        first axis.  Only meaningful with `mesh`.
     """
 
     def __init__(self, max_decode_slots=8, num_pages=256, page_size=16,
@@ -146,7 +158,8 @@ class GenerationConfig:
                  kv_dtype=np.float32, kv_backend=None, max_prefill_batch=4,
                  prefill_length_buckets=None, jit_prefill=None,
                  decode=None, decode_batch_buckets=None, pool_layout=None,
-                 prefill_chunk_tokens=None, step_token_budget=None):
+                 prefill_chunk_tokens=None, step_token_budget=None,
+                 mesh=None, tp_axis=None):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -188,6 +201,23 @@ class GenerationConfig:
                 f"{step_token_budget}")
         self.step_token_budget = (None if step_token_budget is None
                                   else int(step_token_budget))
+        if mesh is not None:
+            names = tuple(getattr(mesh, "axis_names", ()))
+            if not names:
+                raise ValueError(
+                    f"mesh must be a jax.sharding.Mesh with named axes, "
+                    f"got {type(mesh).__name__}")
+            if tp_axis is None:
+                tp_axis = names[0]
+            elif tp_axis not in names:
+                raise ValueError(
+                    f"tp_axis {tp_axis!r} is not an axis of the mesh "
+                    f"{names}")
+        elif tp_axis is not None:
+            raise ValueError(
+                f"tp_axis={tp_axis!r} without a mesh makes no sense")
+        self.mesh = mesh
+        self.tp_axis = tp_axis
 
 
 class GenerationResult:
@@ -280,14 +310,28 @@ class GenerationEngine:
         self.config = config or GenerationConfig()
         self.metrics = metrics or GenerationMetrics()
         on_tpu = jax.default_backend() == "tpu"
-        backend = self.config.kv_backend or ("device" if on_tpu else "host")
+        # tensor-parallel mesh: sharded decode is device-pool + fused
+        # by construction, so the mesh flips both auto policies
+        mesh = self.config.mesh
+        tp_axis = self.config.tp_axis
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.tp_degree = (int(mesh.shape[tp_axis])
+                          if mesh is not None else 1)
+        backend = self.config.kv_backend or (
+            "device" if (on_tpu or mesh is not None) else "host")
+        if mesh is not None and backend != "device":
+            raise ValueError(
+                "mesh-sharded generation requires kv_backend='device': "
+                "host numpy pools cannot carry a NamedSharding")
         pool_layout = self.config.pool_layout or "token"
         if backend == "device":
             self.cache = DeviceKVPool(
                 model.num_layers, model.num_heads, model.head_dim,
                 num_pages=self.config.num_pages,
                 page_size=self.config.page_size,
-                dtype=self.config.kv_dtype, pool_layout=pool_layout)
+                dtype=self.config.kv_dtype, pool_layout=pool_layout,
+                mesh=mesh, tp_axis=tp_axis)
         else:
             if pool_layout == "kernel":
                 raise ValueError(
@@ -318,13 +362,31 @@ class GenerationEngine:
         # anchored on the unfused path
         self._use_kernel = (self.config.use_kernel
                             if self.config.use_kernel is not None
-                            else on_tpu)
+                            else (on_tpu and mesh is None))
+        if mesh is not None and self._use_kernel:
+            raise ValueError(
+                "use_kernel=True under a mesh is not supported: the "
+                "Pallas kernels are single-device programs (running one "
+                "inside a GSPMD dispatch would compute over a shard as "
+                "if it were the whole pool) — sharded decode uses the "
+                "jnp attention path, which GSPMD partitions over heads; "
+                "a shard_map'd kernel is the tracked follow-on "
+                "(ROADMAP)")
         fusable = (backend == "device"
                    and hasattr(model, "decode_step_fn")
                    and hasattr(model, "decode_params"))
         decode = self.config.decode
         if decode is None:
-            decode = "fused" if (on_tpu and fusable) else "eager"
+            decode = ("fused" if ((on_tpu or mesh is not None) and fusable)
+                      else "eager")
+        if mesh is not None and decode != "fused":
+            raise ValueError(
+                "mesh-sharded decode runs only on the fused path (one "
+                "GSPMD dispatch per step); decode='eager' under a mesh "
+                "is not supported — the eager single-chip path is the "
+                "oracle sharded decode is measured against.  The model "
+                "must implement decode_step_fn/decode_params "
+                f"({type(model).__name__})")
         elif decode == "fused" and not fusable:
             raise ValueError(
                 "decode='fused' needs kv_backend='device' and a model "
@@ -347,7 +409,8 @@ class GenerationEngine:
                     f"a full decode batch could never be padded")
             self._fused = FusedDecodeStep(
                 model, self.cache, self.metrics,
-                use_kernel=self._use_kernel, batch_buckets=buckets)
+                use_kernel=self._use_kernel, batch_buckets=buckets,
+                mesh=mesh, tp_axis=tp_axis)
         # chunked prefill policy mirrors jit_prefill/decode: auto picks
         # chunking on TPU when the model implements the chunk protocol;
         # the CPU tier-1 default stays the one-shot prefill the
@@ -382,7 +445,7 @@ class GenerationEngine:
 
             self._chunk_step = ChunkedPrefillStep(
                 model, self.cache, self.metrics, chunk,
-                use_kernel=self._use_kernel)
+                use_kernel=self._use_kernel, mesh=mesh, tp_axis=tp_axis)
         elif chunk and not chunk_eager_ok:
             raise ValueError(
                 "chunked prefill without jit_prefill + kv_backend="
@@ -393,6 +456,7 @@ class GenerationEngine:
             if self.config.step_token_budget is not None
             else (chunk + self.config.max_decode_slots if chunk else None))
         self._stall_run = 0  # consecutive decode-stalled steps (gauge)
+        self.metrics.set_mesh_devices(self.tp_degree)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
@@ -695,6 +759,8 @@ class GenerationEngine:
                 # stay comparable across prefill paths (same contract as
                 # the fused decode step)
                 self.cache.count_fused_append(n)
+                self.metrics.observe_collective_bytes(
+                    self._chunk_step.last_collective_bytes)
             else:
                 logits_last = self._prefill_chunk_eager(state, tokens,
                                                         start)
@@ -864,6 +930,8 @@ class GenerationEngine:
         self.cache.count_fused_append(len(active))
         self.metrics.observe_decode_step(self._fused.last_dispatches,
                                          self._fused.last_syncs)
+        self.metrics.observe_collective_bytes(
+            self._fused.last_collective_bytes)
         return all_greedy, out
 
     def _on_logits(self, state, logits_row):

@@ -76,6 +76,51 @@ def _pool_donate_plan(num_layers):
     return tuple(range(4, 4 + 2 * num_layers))
 
 
+def _shard_params(model, mesh, tp_axis, jax_mod):
+    """Flatten decode_params(), committing each leaf to its
+    NamedSharding when a mesh is given: the model's decode_param_specs
+    names the head-sharded layout (Megatron column/row split); a model
+    without specs runs fully replicated (pools still shard — correct,
+    just with gather traffic the spec'd layout avoids).  Committed
+    leaves are what make the AOT signature stable: CompiledModelCache
+    lowers against exactly these shardings."""
+    leaves, tree = jax_mod.tree_util.tree_flatten(model.decode_params())
+    if mesh is None:
+        return leaves, tree
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if hasattr(model, "decode_param_specs"):
+        specs = jax_mod.tree_util.tree_leaves(
+            model.decode_param_specs(tp_axis),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        if len(specs) != len(leaves):
+            raise ValueError(
+                f"decode_param_specs yields {len(specs)} specs for "
+                f"{len(leaves)} decode_params leaves — the trees must "
+                f"mirror each other")
+    else:
+        specs = [PartitionSpec()] * len(leaves)
+    return [jax_mod.device_put(p, NamedSharding(mesh, s))
+            for p, s in zip(leaves, specs)], tree
+
+
+def _collective_bytes_estimate(num_layers, rows, d_model, tp_degree,
+                               itemsize=4):
+    """Estimated on-wire allreduce bytes of ONE sharded dispatch — the
+    profile hook EQuARX-style quantized collectives will be judged
+    against (generation.collective_bytes_per_step).  The sharded step
+    has two allreduces per layer (after wo and after w2), each over the
+    [rows, d_model] fp32 activation block; a ring allreduce moves
+    2*(N-1)/N of the payload per device.  `rows` is the PADDED batch
+    (or chunk) actually dispatched — padding rows ride the collective
+    whether live or not.  Zero when unsharded."""
+    if tp_degree <= 1:
+        return 0
+    payload = int(rows) * int(d_model) * int(itemsize)
+    return int(2 * num_layers * payload * 2 * (tp_degree - 1)
+               / tp_degree)
+
+
 def _dispatch_donating(cache, exec_cache, args, num_layers):
     """Run ONE compiled pool-donating dispatch: compile/fetch the
     executable for `args`' signature, dispatch, install the returned
@@ -119,14 +164,18 @@ class FusedDecodeStep:
     actual call sites, not estimated."""
 
     def __init__(self, model, cache, metrics, use_kernel=False,
-                 batch_buckets=None):
+                 batch_buckets=None, mesh=None, tp_axis=None):
         import jax
 
         self._jax = jax
         self._cache = cache
         self._num_layers = int(cache.num_layers)
-        self._param_leaves, self._param_tree = jax.tree_util.tree_flatten(
-            model.decode_params())
+        self._mesh = mesh
+        self._tp_axis = tp_axis
+        self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
+        self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._param_leaves, self._param_tree = _shard_params(
+            model, mesh, tp_axis, jax)
         if not batch_buckets:
             raise ValueError("batch_buckets is required (the engine "
                              "passes its decode-batch menu)")
@@ -135,11 +184,15 @@ class FusedDecodeStep:
         self._bucketer = ShapeBucketer(batch_buckets=menu_b,
                                        length_buckets=pages_menu)
         cache_metrics = DecodeCacheMetrics(metrics)
+        # mesh kwargs only reach mesh-aware models: the unsharded path
+        # keeps working against the original decode_step_fn protocol
+        step_kw = ({"mesh": mesh, "tp_axis": tp_axis}
+                   if mesh is not None else {})
         self._exec = {}
         for greedy in (False, True):
             fn = model.decode_step_fn(
                 cache.page_size, cache.num_pages, use_kernel=use_kernel,
-                pool_layout=cache.pool_layout, greedy=greedy)
+                pool_layout=cache.pool_layout, greedy=greedy, **step_kw)
             # fixed args: (tokens, positions, page_tables, lens)
             wrapped = _wrap_donating(
                 self._num_layers, self._param_tree, jax,
@@ -150,6 +203,7 @@ class FusedDecodeStep:
                 donate_argnums=_pool_donate_plan(self._num_layers))
         self.last_dispatches = 0
         self.last_syncs = 0
+        self.last_collective_bytes = 0
 
     @property
     def compile_count(self):
@@ -170,8 +224,13 @@ class FusedDecodeStep:
         first decode step after prefill pays no retrace.  Pure
         ShapeDtypeStructs through the signature cache (get() only
         lowers+compiles; nothing is dispatched, so donation never
-        consumes a live pool).  Returns True when this call actually
-        compiled (False: the bucket was already cached)."""
+        consumes a live pool).  Under a mesh the structs CARRY the pool
+        and param NamedShardings — without them the pre-warmed
+        executable would be lowered single-device, miss the real sharded
+        signature, and the first decode after prefill would silently
+        retrace (and the pre-warm compile would be garbage).  Returns
+        True when this call actually compiled (False: the bucket was
+        already cached)."""
         bucket_b = self._bucketer.batch_bucket(
             min(max(int(batch_rows), 1), self._bucketer.max_batch))
         bucket_p = self._bucketer.length_bucket(max(int(pages_cols), 1))
@@ -180,8 +239,17 @@ class FusedDecodeStep:
         pool = self._cache.layer_pools(0)[0]
         args = [sds((bucket_b,), i32), sds((bucket_b,), i32),
                 sds((bucket_b, bucket_p), i32), sds((bucket_b,), i32)]
-        args += [sds(tuple(pool.shape), pool.dtype)] * (2 * self._num_layers)
-        args += [sds(tuple(p.shape), p.dtype) for p in self._param_leaves]
+        if self._mesh is not None:
+            pool_sds = sds(tuple(pool.shape), pool.dtype,
+                           sharding=self._cache.pool_sharding)
+            args += [pool_sds] * (2 * self._num_layers)
+            args += [sds(tuple(p.shape), p.dtype, sharding=p.sharding)
+                     for p in self._param_leaves]
+        else:
+            args += [sds(tuple(pool.shape), pool.dtype)] * \
+                (2 * self._num_layers)
+            args += [sds(tuple(p.shape), p.dtype)
+                     for p in self._param_leaves]
         cache = self._exec[bool(greedy)]
         before = cache.compile_count
         cache.get(args)
@@ -214,6 +282,8 @@ class FusedDecodeStep:
         host = np.asarray(out)                 # the single host sync
         self.last_dispatches = 1
         self.last_syncs = 1
+        self.last_collective_bytes = _collective_bytes_estimate(
+            self._num_layers, bucket_b, self._d_model, self._tp)
         return host[:b_real]
 
 
@@ -240,7 +310,7 @@ class ChunkedPrefillStep:
     its chunks and the interleaved decode steps."""
 
     def __init__(self, model, cache, metrics, chunk_tokens,
-                 use_kernel=False):
+                 use_kernel=False, mesh=None, tp_axis=None):
         import jax
 
         self._cache = cache
@@ -248,14 +318,19 @@ class ChunkedPrefillStep:
         if self._chunk < 1:
             raise ValueError("chunk_tokens must be >= 1")
         self._num_layers = int(cache.num_layers)
-        self._param_leaves, self._param_tree = jax.tree_util.tree_flatten(
-            model.decode_params())
+        self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
+        self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._param_leaves, self._param_tree = _shard_params(
+            model, mesh, tp_axis, jax)
         pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
         self._bucketer = ShapeBucketer(batch_buckets=(1,),
                                        length_buckets=pages_menu)
+        chunk_kw = ({"mesh": mesh, "tp_axis": tp_axis}
+                    if mesh is not None else {})
         fn = model.prefill_chunk_fn(
             cache.page_size, cache.num_pages, use_kernel=use_kernel,
-            pool_layout=cache.pool_layout)
+            pool_layout=cache.pool_layout, **chunk_kw)
+        self.last_collective_bytes = 0
         # fixed args: (tokens, start, length, page_table); pools donated
         # exactly like the fused decode step; compiles/hits land under
         # the PREFILL cache metrics (a chunk executable IS a prefill
@@ -295,5 +370,7 @@ class ChunkedPrefillStep:
         k_pools, v_pools = self._cache.take_pools()
         args = [tok, np.int32(start), np.int32(n), pt,
                 *k_pools, *v_pools, *self._param_leaves]
+        self.last_collective_bytes = _collective_bytes_estimate(
+            self._num_layers, self._chunk, self._d_model, self._tp)
         return _dispatch_donating(self._cache, self._exec, args,
                                   self._num_layers)

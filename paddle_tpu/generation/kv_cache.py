@@ -369,6 +369,17 @@ class PagedKVCache:
 # ----------------------- device-resident backend ------------------------
 
 
+def _pin_sharding(pool, sharding):
+    """Anchor a pool result to its NamedSharding (identity when the pool
+    is unsharded).  Every write path routes its result through this, so
+    GSPMD can never drift a pool off its head-axis layout mid-chain."""
+    if sharding is None:
+        return pool
+    import jax
+
+    return jax.lax.with_sharding_constraint(pool, sharding)
+
+
 def scatter_pool_update(pool, pages, rows, x, layout):
     """Scatter token payload `x` into `(pages[i], rows[i])` of one pool,
     layout-aware.  Out-of-range page ids (the padding sentinel
@@ -388,22 +399,29 @@ def scatter_pool_update(pool, pages, rows, x, layout):
     return pool.at[pages, rows].set(x, mode="drop")
 
 
-def _scatter_kv(k_pool, v_pool, pages, rows, k, v, *, layout):
+def _scatter_kv(k_pool, v_pool, pages, rows, k, v, *, layout,
+                sharding=None):
     """Scatter `k[i]` / `v[i]` into `(pages[i], rows[i])` of one layer's
     pools.  Donated: XLA performs the update in place, so an append
-    moves the token payload, never the pool."""
-    return (scatter_pool_update(k_pool, pages, rows, k, layout),
-            scatter_pool_update(v_pool, pages, rows, v, layout))
+    moves the token payload, never the pool.  `sharding` pins the
+    result for mesh-sharded pools (head-axis NamedSharding)."""
+    return (_pin_sharding(scatter_pool_update(k_pool, pages, rows, k,
+                                              layout), sharding),
+            _pin_sharding(scatter_pool_update(v_pool, pages, rows, v,
+                                              layout), sharding))
 
 
-def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v, *, layout):
+def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v, *, layout,
+                           sharding=None):
     """Every layer's scatter in ONE dispatch (the indices are identical
     across layers): k_pools/v_pools are length-L lists (all donated),
     k/v are ``[L, n, H, D]``.  Prefill latency stays flat in depth
     instead of paying L dispatches per chunk."""
-    return ([scatter_pool_update(kp, pages, rows, k[i], layout)
+    return ([_pin_sharding(scatter_pool_update(kp, pages, rows, k[i],
+                                               layout), sharding)
              for i, kp in enumerate(k_pools)],
-            [scatter_pool_update(vp, pages, rows, v[i], layout)
+            [_pin_sharding(scatter_pool_update(vp, pages, rows, v[i],
+                                               layout), sharding)
              for i, vp in enumerate(v_pools)])
 
 
@@ -433,18 +451,76 @@ class DeviceKVPool(PagedKVCache):
     write (donation): read between writes, as the engine's step does.
     ``k_pool`` / ``v_pool`` are DEBUG host copies in the CANONICAL
     token layout regardless of pool_layout, not the hot path.
+
+    mesh / tp_axis: tensor-parallel sharding — each per-layer pool is a
+    single GSPMD ``jax.Array`` sharded over the HEAD axis of `mesh`'s
+    `tp_axis` (NamedSharding via parallel.kv_pool_spec), so every device
+    holds ``num_heads / tp_degree`` heads of every page: per-device KV
+    memory is 1/tp_degree of the unsharded pool, and the head axis is
+    exactly the axis the sharded fused decode step partitions attention
+    over (docs/GENERATION.md "Sharded decode").  Bookkeeping stays
+    host-global — page tables and the free list are replicated logic,
+    only the storage is split.  ``reset_pools`` re-materializes with the
+    SAME sharding, so poisoned-dispatch recovery never silently degrades
+    to a single-device layout.
     """
 
     def __init__(self, num_layers, num_heads, head_dim, num_pages=256,
-                 page_size=16, dtype=np.float32, pool_layout="token"):
+                 page_size=16, dtype=np.float32, pool_layout="token",
+                 mesh=None, tp_axis=None):
         if pool_layout not in ("token", "kernel"):
             raise ValueError(
                 f"pool_layout must be 'token' or 'kernel', got "
                 f"{pool_layout!r}")
         self.pool_layout = pool_layout
+        self.mesh = mesh
+        self.tp_axis = None
+        self.tp_degree = 1
+        self._sharding = None
+        if mesh is not None:
+            from ..parallel.sharding_annotations import (kv_pool_spec,
+                                                         named_sharding)
+
+            names = tuple(mesh.axis_names)
+            self.tp_axis = tp_axis if tp_axis is not None else names[0]
+            if self.tp_axis not in names:
+                raise ValueError(
+                    f"tp_axis {self.tp_axis!r} is not an axis of the "
+                    f"mesh {names}")
+            self.tp_degree = int(mesh.shape[self.tp_axis])
+            if int(num_heads) % self.tp_degree:
+                raise ValueError(
+                    f"num_heads={num_heads} is not divisible by "
+                    f"tp_degree={self.tp_degree} (axis {self.tp_axis!r} "
+                    f"of the mesh): the head axis is the shard axis")
+            self._sharding = named_sharding(
+                mesh, *kv_pool_spec(pool_layout, self.tp_axis))
         super().__init__(num_layers, num_heads, head_dim,
                          num_pages=num_pages, page_size=page_size,
                          dtype=dtype)
+
+    @property
+    def pool_sharding(self):
+        """The pools' NamedSharding (None when unsharded) — what the
+        fused step's prewarm ShapeDtypeStructs must carry."""
+        return self._sharding
+
+    def _materialize_pools(self, shape):
+        """Fresh zeroed per-layer pool storage in the pool's sharding —
+        shared by construction and reset_pools so recovery re-creates
+        the exact device layout it lost."""
+        import jax
+
+        jnp = self._jnp
+
+        def zeros():
+            z = jnp.zeros(shape, self.dtype)
+            if self._sharding is not None:
+                z = jax.device_put(z, self._sharding)
+            return z
+
+        self._k = [zeros() for _ in range(self.num_layers)]
+        self._v = [zeros() for _ in range(self.num_layers)]
 
     def _init_pools(self):
         import jax.numpy as jnp
@@ -456,11 +532,9 @@ class DeviceKVPool(PagedKVCache):
         else:
             shape = (self.num_pages, self.page_size,
                      self.num_heads, self.head_dim)
-        self._k = [jnp.zeros(shape, self.dtype)
-                   for _ in range(self.num_layers)]
-        self._v = [jnp.zeros(shape, self.dtype)
-                   for _ in range(self.num_layers)]
-        self._scatter, self._scatter_all = _jitted_scatter(self.pool_layout)
+        self._materialize_pools(shape)
+        self._scatter, self._scatter_all = _jitted_scatter(
+            self.pool_layout, self._sharding)
 
     # --------------------------- writes -----------------------------
     def _scatter_layer(self, layer, pages, rows, k, v, real_tokens):
@@ -612,13 +686,12 @@ class DeviceKVPool(PagedKVCache):
         mid-flight (the donated buffers are invalid and no replacement
         was returned).  KV content is lost by construction — the engine
         fails every in-flight sequence on a poisoned step, so fresh
-        zeroed storage is exactly the state later requests expect."""
-        jnp = self._jnp
-        shape = self._k[0].shape
-        self._k = [jnp.zeros(shape, self.dtype)
-                   for _ in range(self.num_layers)]
-        self._v = [jnp.zeros(shape, self.dtype)
-                   for _ in range(self.num_layers)]
+        zeroed storage is exactly the state later requests expect.
+        Goes through _materialize_pools, so a mesh-sharded pool comes
+        back in its NamedSharding — a recovery that silently rebuilt
+        single-device pools would poison every later sharded dispatch
+        (the AOT executables are lowered against the sharded layout)."""
+        self._materialize_pools(self._k[0].shape)
 
     def _canonical(self, pool):
         """[H, P, ps, D] -> [P, ps, H, D] for kernel-layout pools."""
@@ -638,22 +711,26 @@ class DeviceKVPool(PagedKVCache):
         return np.stack([self._canonical(p) for p in self._v])
 
 
-def _jitted_scatter(layout):
-    """The shared jitted donated scatters, one pair per pool layout
-    (module-level cache: every pool instance reuses the same
+def _jitted_scatter(layout, sharding=None):
+    """The shared jitted donated scatters, one pair per (pool layout,
+    pool sharding) — NamedSharding is hashable, so sharded pools get
+    their own cached executables with the output pinned to the pool's
+    sharding (module-level cache: every pool instance reuses the same
     executables per shape signature)."""
     import functools
 
-    if layout not in _SCATTER_JIT:
+    key = (layout, sharding)
+    if key not in _SCATTER_JIT:
         import jax
 
-        _SCATTER_JIT[layout] = (
-            jax.jit(functools.partial(_scatter_kv, layout=layout),
+        _SCATTER_JIT[key] = (
+            jax.jit(functools.partial(_scatter_kv, layout=layout,
+                                      sharding=sharding),
                     donate_argnums=(0, 1)),
             jax.jit(functools.partial(_scatter_kv_all_layers,
-                                      layout=layout),
+                                      layout=layout, sharding=sharding),
                     donate_argnums=(0, 1)))
-    return _SCATTER_JIT[layout]
+    return _SCATTER_JIT[key]
 
 
 _SCATTER_JIT = {}

@@ -58,6 +58,17 @@ Metric names:
 - ``generation.tokens_per_s``         gauge: decode throughput (EWMA)
 - ``generation.slot_occupancy_pct``   gauge: active / decode slots
 - ``generation.page_utilization_pct`` gauge: pool pages in use
+- ``generation.mesh_devices``         gauge: tensor-parallel degree of
+                                      the engine's mesh (1 unsharded)
+- ``generation.collective_bytes_per_step``  gauge: estimated on-wire
+                                      allreduce bytes of the last
+                                      sharded dispatch (2 allreduces
+                                      per layer over the [rows,
+                                      d_model] fp32 activation x the
+                                      ring factor 2(N-1)/N; 0 when
+                                      unsharded) — the profile hook the
+                                      EQuARX-style quantized-collective
+                                      follow-on is measured against
 """
 import time
 
@@ -89,6 +100,8 @@ DECODE_COMPILES_PREWARM = PREFIX + "decode_compiles_prewarm"
 TOKENS_PER_S = PREFIX + "tokens_per_s"
 SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
 PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
+MESH_DEVICES = PREFIX + "mesh_devices"
+COLLECTIVE_BYTES_PER_STEP = PREFIX + "collective_bytes_per_step"
 
 
 class GenerationMetrics:
@@ -173,6 +186,20 @@ class GenerationMetrics:
         acceptance numbers (1 and <=1) and the eager A/B baseline."""
         self._stat(DECODE_DISPATCHES_PER_STEP).set(int(dispatches))
         self._stat(DECODE_HOST_SYNCS_PER_STEP).set(int(host_syncs))
+
+    def set_mesh_devices(self, n):
+        """Gauge: the engine's tensor-parallel degree (mesh axis size;
+        1 when unsharded) — set once at engine construction so every
+        stats_snapshot carries the topology its numbers were measured
+        on."""
+        self._stat(MESH_DEVICES).set(int(n))
+
+    def observe_collective_bytes(self, n):
+        """Gauge: estimated allreduce bytes of the last sharded
+        dispatch (fused decode step or jitted prefill chunk) —
+        fused._collective_bytes_estimate documents the formula.  0 on
+        every unsharded path."""
+        self._stat(COLLECTIVE_BYTES_PER_STEP).set(int(n))
 
     def observe_decode_stall(self, consecutive):
         """Gauge: CONSECUTIVE engine steps in which live decode slots

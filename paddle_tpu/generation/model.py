@@ -201,7 +201,7 @@ class TinyCausalLM:
         return self._logits(x[n - 1:n])[0]
 
     def prefill_chunk_fn(self, page_size, num_pages, use_kernel=False,
-                         pool_layout="token"):
+                         pool_layout="token", mesh=None, tp_axis=None):
         """Build the PURE whole-chunk function the engine's jitted
         chunked-prefill path compiles (mirrors `decode_step_fn`)::
 
@@ -219,11 +219,19 @@ class TinyCausalLM:
         layer scatters the chunk's K/V into the pool, then attends over
         the page table — prefix and chunk through one paged read
         (decode_attention.chunk_prefill_attention), so the executable's
-        shape depends only on (chunk, pages bucket), never the prompt."""
+        shape depends only on (chunk, pages bucket), never the prompt.
+
+        mesh / tp_axis: the same tensor-parallel sharding contract as
+        decode_step_fn — chunk q/k/v sharded over heads, pools pinned to
+        the pool sharding through the donation chain, last-position
+        logits pinned replicated."""
+        from ..parallel.sharding_annotations import constrain, kv_pool_spec
         from .kv_cache import scatter_pool_update
 
         page_size = int(page_size)
         num_pages = int(num_pages)
+        pool_spec = (kv_pool_spec(pool_layout, tp_axis)
+                     if mesh is not None else None)
 
         def step(params, tokens, start, length, k_pools, v_pools,
                  page_table):
@@ -246,12 +254,18 @@ class TinyCausalLM:
             for li, blk in enumerate(params["blocks"]):
                 hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
                 q, k, v = self._qkv(blk, hn)
+                q = constrain(q, mesh, None, tp_axis, None)
+                k = constrain(k, mesh, None, tp_axis, None)
+                v = constrain(v, mesh, None, tp_axis, None)
                 kp = scatter_pool_update(
                     k_pools[li], pages, rows,
                     k.astype(k_pools[li].dtype), pool_layout)
                 vp = scatter_pool_update(
                     v_pools[li], pages, rows,
                     v.astype(v_pools[li].dtype), pool_layout)
+                if pool_spec is not None:
+                    kp = constrain(kp, mesh, *pool_spec)
+                    vp = constrain(vp, mesh, *pool_spec)
                 k_out.append(kp)
                 v_out.append(vp)
                 attn = decode_attention.chunk_prefill_attention(
@@ -263,7 +277,7 @@ class TinyCausalLM:
             last = jnp.take(x, length - 1, axis=0)[None]
             logits = (_layer_norm(last, params["ln_f_s"],
                                   params["ln_f_b"]) @ params["head"])[0]
-            return logits, k_out, v_out
+            return constrain(logits, mesh), k_out, v_out
 
         return step
 
@@ -297,8 +311,36 @@ class TinyCausalLM:
             "head": self.head,
         }
 
+    def decode_param_specs(self, tp_axis):
+        """PartitionSpec pytree matching decode_params(), sharding the
+        per-layer projection weights over the HEAD axis (the Megatron
+        column/row split, SNIPPETS.md [3]'s NamedSharding-over-model
+        pattern):
+
+        - wq/wk/wv ``[d, H*D]``: columns sharded (head-major reshape, so
+          each device's column block IS its heads' projections);
+        - wo ``[H*D, d]``: rows sharded — the contraction over the
+          sharded axis yields partial sums, and XLA inserts the layer's
+          allreduce exactly there;
+        - MLP w1/b1 column-sharded, w2 row-sharded (second allreduce);
+        - embeddings, layernorm scales, and the LM head replicated —
+          activations between layers are replicated, so the final
+          logits need NO collective of their own.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        col, row, rep = P(None, tp_axis), P(tp_axis, None), P()
+        blk = {"ln1_s": rep, "ln1_b": rep,
+               "wq": col, "wk": col, "wv": col, "wo": row,
+               "ln2_s": rep, "ln2_b": rep,
+               "w1": col, "b1": P(tp_axis), "w2": row, "b2": rep}
+        return {"tok_emb": rep, "pos_emb": rep,
+                "blocks": [dict(blk) for _ in self.blocks],
+                "ln_f_s": rep, "ln_f_b": rep, "head": rep}
+
     def decode_step_fn(self, page_size, num_pages, use_kernel=False,
-                       pool_layout="token", greedy=False):
+                       pool_layout="token", greedy=False, mesh=None,
+                       tp_axis=None):
         """Build the PURE whole-decode-step function the engine's fused
         path jits: embed -> L x (scatter-append K/V into the pools +
         paged decode attention) -> logits, in one traceable body.
@@ -322,11 +364,23 @@ class TinyCausalLM:
         (kv_cache.scatter_pool_update), same attention reference — so
         fused-vs-eager differences are only whatever XLA whole-program
         fusion does to float association (why eager stays the CPU
-        tier-1 default, docs/GENERATION.md)."""
+        tier-1 default, docs/GENERATION.md).
+
+        mesh / tp_axis: tensor-parallel sharding.  The body stays the
+        same trace; sharding constraints pin the GSPMD solution the
+        decode_param_specs layout implies — q/k/v (and the pool
+        scatters) sharded over heads, pools pinned to the pool sharding
+        so the donation chain round-trips, `out` pinned replicated so
+        the engine's single host fetch is legal.  XLA inserts the two
+        per-layer allreduces (after wo and w2) from the row-sharded
+        contractions; nothing here issues a collective by hand."""
+        from ..parallel.sharding_annotations import constrain, kv_pool_spec
         from .kv_cache import scatter_pool_update
 
         page_size = int(page_size)
         num_pages = int(num_pages)
+        pool_spec = (kv_pool_spec(pool_layout, tp_axis)
+                     if mesh is not None else None)
 
         def step(params, tokens, positions, k_pools, v_pools,
                  page_tables, lens):
@@ -348,12 +402,21 @@ class TinyCausalLM:
             for li, blk in enumerate(params["blocks"]):
                 hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
                 q, k, v = self._qkv(blk, hn)
+                # head-sharded activations: each device projects and
+                # attends over ITS heads only; the scatter below is then
+                # fully local (sharded update into the sharded pool)
+                q = constrain(q, mesh, None, tp_axis, None)
+                k = constrain(k, mesh, None, tp_axis, None)
+                v = constrain(v, mesh, None, tp_axis, None)
                 kp = scatter_pool_update(
                     k_pools[li], pages, rows,
                     k.astype(k_pools[li].dtype), pool_layout)
                 vp = scatter_pool_update(
                     v_pools[li], pages, rows,
                     v.astype(v_pools[li].dtype), pool_layout)
+                if pool_spec is not None:
+                    kp = constrain(kp, mesh, *pool_spec)
+                    vp = constrain(vp, mesh, *pool_spec)
                 k_out.append(kp)
                 v_out.append(vp)
                 attn = decode_attention.paged_decode_attention(
@@ -366,6 +429,10 @@ class TinyCausalLM:
                                  params["ln_f_b"]) @ params["head"]
             out = (jnp.argmax(logits, axis=-1).astype(jnp.int32)
                    if greedy else logits)
+            # replicated output: the engine fetches it in ONE host sync,
+            # which a sharded-out array would turn into a cross-device
+            # gather on the host's side of the fence
+            out = constrain(out, mesh)  # bare spec == fully replicated
             return out, k_out, v_out
 
         return step

@@ -32,6 +32,32 @@ _STATE_ROWS = 8  # scratch rows; every row holds the same value so all
 # scratch traffic is full-width vector ops (the Mosaic-proven layout)
 
 
+def _reject_mesh_sharded_pool(pool):
+    """Loud failure over silent corruption: a Pallas kernel is a
+    single-device program — handed a pool committed to a multi-device
+    NamedSharding (the tensor-parallel generation mesh), pallas_call
+    would either fail opaquely or compute over one shard as if it were
+    the whole pool.  The sharded engine routes around the kernels (the
+    jnp references ARE GSPMD-partitionable; engine.py forces
+    use_kernel=False under a mesh); this guard catches direct callers.
+    Tracers (pools inside a jit trace) pass through untouched — the
+    in-trace caller's own sharding machinery governs there."""
+    try:
+        sharding = getattr(pool, "sharding", None)
+    except Exception:
+        return  # tracer without a committed sharding: not our problem
+    from jax.sharding import NamedSharding
+
+    if (isinstance(sharding, NamedSharding)
+            and len(sharding.device_set) > 1):
+        raise NotImplementedError(
+            "Pallas paged attention over a mesh-sharded KV pool is not "
+            "supported: the kernel is a single-device program (a "
+            "shard_map'd variant is the tracked follow-on, ROADMAP).  "
+            "Use the jnp reference path (use_kernel=False) — GSPMD "
+            "partitions it over the head axis.")
+
+
 def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, page_size, n_pages):
     b = pl.program_id(0)
@@ -143,6 +169,7 @@ def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
 
     Same layout reasoning as the decode kernel: token-layout pools are
     transposed per call, kernel-layout pools are consumed as stored."""
+    _reject_mesh_sharded_pool(k_pool)
     n, h, d = q.shape
     qs = jnp.transpose((q * scale).astype(q.dtype), (1, 0, 2))  # [H, n, D]
     if layout == "kernel":
@@ -195,6 +222,7 @@ def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
     pools are transposed here per call — O(pool) HBM traffic per layer
     per step, which is exactly why kernel-layout pools exist: scattering
     into [H, P, page_size, D] on write makes this call transpose-free."""
+    _reject_mesh_sharded_pool(k_pool)
     b, h, d = q.shape
     qs = (q * scale).astype(q.dtype).reshape(b, h, 1, d)
     if layout == "kernel":

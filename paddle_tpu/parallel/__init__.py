@@ -1,7 +1,10 @@
 """paddle_tpu.parallel — mesh-based distributed runtime (SURVEY §2.3, §5.8)."""
 from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, global_mesh,
-    set_global_mesh, build_mesh, is_initialized,
+    set_global_mesh, build_mesh, is_initialized, tp_mesh,
+)
+from .sharding_annotations import (  # noqa: F401
+    named_sharding, kv_pool_spec, constrain, shard_activation, mesh_context,
 )
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, wait, all_reduce, reduce,

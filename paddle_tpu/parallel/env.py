@@ -157,3 +157,21 @@ def build_mesh(shape_dict, dcn_shape_dict=None):
             pass  # odd topologies: fall through to the plain reshape
     devices = np.array(devs[:n]).reshape(sizes)
     return Mesh(devices, names)
+
+
+def tp_mesh(tp_degree=None, axis_name="model"):
+    """A 1-D tensor-parallel mesh over the first `tp_degree` devices —
+    the mesh the sharded generation engine takes (GenerationConfig.mesh;
+    docs/GENERATION.md "Sharded decode").  Defaults to every visible
+    device.  Goes through build_mesh, so on real TPUs the devices come
+    ICI-ordered from mesh_utils and on CPU (the forced-host-device test
+    mesh, ``--xla_force_host_platform_device_count=N``) it is a plain
+    stable reshape."""
+    n = len(jax.devices()) if tp_degree is None else int(tp_degree)
+    if n < 1:
+        raise ValueError(f"tp_degree must be >= 1, got {tp_degree}")
+    if n > len(jax.devices()):
+        raise ValueError(
+            f"tp_degree={n} exceeds the {len(jax.devices())} visible "
+            f"device(s)")
+    return build_mesh({axis_name: n})

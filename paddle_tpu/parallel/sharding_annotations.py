@@ -33,6 +33,38 @@ def current_mesh():
     return _active_mesh[-1] if _active_mesh else None
 
 
+def named_sharding(mesh, *axes):
+    """``NamedSharding(mesh, PartitionSpec(*axes))`` — the one-liner the
+    generation engine and pool builders use everywhere."""
+    return NamedSharding(mesh, PartitionSpec(*axes))
+
+
+def kv_pool_spec(pool_layout, tp_axis):
+    """PartitionSpec sharding one KV pool's HEAD axis over `tp_axis`.
+
+    The head axis is the tensor-parallel shard axis of the whole decode
+    stack (each device owns num_heads / tp_degree heads of every page),
+    so the spec depends only on where the layout stores heads:
+
+    - ``"token"``:  ``[P, page_size, H, D]`` -> P(None, None, tp, None)
+    - ``"kernel"``: ``[H, P, page_size, D]`` -> P(tp, None, None, None)
+    """
+    if pool_layout == "kernel":
+        return PartitionSpec(tp_axis, None, None, None)
+    return PartitionSpec(None, None, tp_axis, None)
+
+
+def constrain(x, mesh, *axes):
+    """`with_sharding_constraint` under `mesh` (identity when mesh is
+    None) — the in-trace pin the sharded decode step uses to anchor
+    GSPMD propagation (pools keep the pool sharding across the donation
+    chain, logits come back replicated so the host fetch is legal)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*axes)))
+
+
 def shard_activation(x, spec):
     """Annotate activation sharding (identity when no mesh is active)."""
     mesh = current_mesh()

@@ -198,7 +198,24 @@ class CompiledModelCache:
 
         if not self._aot:
             return self._fn
-        avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
+
+        from jax.sharding import NamedSharding
+
+        def aval(a):
+            # mesh-sharded callers (generation's sharded fused decode)
+            # hand committed NamedSharding arrays — or prewarm
+            # ShapeDtypeStructs carrying the same shardings — and the
+            # AOT executable must be lowered against those shardings or
+            # it would reject the very arrays it is dispatched with.
+            # Plain numpy args (and single-device jax arrays) keep the
+            # historical sharding-free aval: placement stays the
+            # compiler's choice, exactly as before.
+            sh = getattr(a, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        avals = [aval(a) for a in args]
         with RecordEvent("serving::compile"):
             try:
                 exe = jax.jit(self._fn, donate_argnums=self._donate) \

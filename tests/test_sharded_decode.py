@@ -1,0 +1,439 @@
+"""Tensor-parallel sharded decode over a head-sharded mesh.
+
+The generation engine under `GenerationConfig.mesh`: KV pools, attention,
+and the per-layer QKV/MLP weights shard over the HEAD axis of a
+`jax.sharding.Mesh` (NamedSharding), and each fused decode step stays ONE
+GSPMD dispatch whose collectives XLA inserts from the annotations.  All
+on the conftest-forced multi-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), a 4-device slice.
+
+Acceptance oracles:
+
+1. Sharded fused decode is TOKEN-IDENTICAL to the single-chip eager
+   oracle — greedy AND seeded stochastic, under forced preemption, under
+   chunked prefill, with bf16 pools.
+2. One dispatch, at most one host sync per decode step — same
+   instrumented gauges as the unsharded fused acceptance.
+3. Per-device KV pool memory is 1/tp_degree of the unsharded pool (shard
+   shape assertions on the committed arrays, both pool layouts).
+4. The sharding survives every edge of the pool lifecycle: the
+   take/donate/put chain, prewarm (ShapeDtypeStructs carry shardings, so
+   the pre-warmed executable IS the dispatched one), and reset_pools
+   after a poisoned dispatch.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.parallel import kv_pool_spec, named_sharding, tp_mesh
+from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402  cross-module memo
+
+TP = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= TP, "conftest forces 8 host devices"
+    return tp_mesh(TP)
+
+
+@pytest.fixture(scope="module")
+def model():
+    # num_heads divisible by TP: the head axis is the shard axis
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=4,
+                            head_dim=8, seed=3)
+
+
+def _engine(model, *, mesh=None, slots=4, pages=64, page_size=4, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size, mesh=mesh, **kw)
+    return gen.GenerationEngine(model, cfg, start=False)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+# --------------------------- mesh plumbing -------------------------------
+
+
+def test_tp_mesh_builds_named_mesh():
+    m = tp_mesh(TP)
+    assert m.axis_names == ("model",)
+    assert m.shape["model"] == TP
+    custom = tp_mesh(2, axis_name="tp")
+    assert custom.shape["tp"] == 2
+    with pytest.raises(ValueError):
+        tp_mesh(0)
+    with pytest.raises(ValueError):
+        tp_mesh(len(jax.devices()) + 1)
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_sharded_pool_per_device_memory_is_one_over_tp(mesh, layout):
+    """Acceptance: each device holds num_heads/tp heads of every page —
+    per-device pool bytes are exactly 1/tp_degree of the whole pool."""
+    pool = gen.DeviceKVPool(2, 4, 8, num_pages=16, page_size=4,
+                            pool_layout=layout, mesh=mesh)
+    want = named_sharding(mesh, *kv_pool_spec(layout, "model"))
+    kp, vp = pool.layer_pools(0)
+    for arr in (kp, vp):
+        assert arr.sharding.is_equivalent_to(want, arr.ndim)
+        shard = arr.addressable_shards[0].data
+        if layout == "kernel":           # [H, P, ps, D] heads split
+            assert shard.shape == (1, 16, 4, 8)
+        else:                            # [P, ps, H, D] heads split
+            assert shard.shape == (16, 4, 1, 8)
+        assert shard.nbytes * TP == arr.nbytes
+    assert pool.tp_degree == TP
+    assert pool.pool_sharding.is_equivalent_to(want, kp.ndim)
+
+
+def test_sharded_pool_requires_divisible_heads(mesh):
+    with pytest.raises(ValueError, match="divisible"):
+        gen.DeviceKVPool(1, 3, 8, mesh=mesh)
+    with pytest.raises(ValueError, match="axis"):
+        gen.DeviceKVPool(1, 4, 8, mesh=mesh, tp_axis="warp")
+
+
+def test_sharded_pool_writes_preserve_sharding(mesh):
+    """Every write path — prefill span, single append, batched decode
+    scatter — returns pools still committed to the head sharding."""
+    pool = gen.DeviceKVPool(2, 4, 8, num_pages=16, page_size=4, mesh=mesh)
+    want = pool.pool_sharding
+    rng = np.random.default_rng(0)
+    kv = rng.standard_normal((2, 6, 4, 8)).astype(np.float32)
+    pool.allocate("s")
+    pool.append_prefill("s", kv, -kv)
+    pool.append("s", kv[:, 0], -kv[:, 0])
+    pool.reserve("s", 1)
+    pool.write_decode_tokens(["s"], [7], 0, kv[:1, 0], -kv[:1, 0])
+    for layer in range(2):
+        for arr in pool.layer_pools(layer):
+            assert arr.sharding.is_equivalent_to(want, arr.ndim)
+    # values match an unsharded pool doing the same ops bitwise
+    plain = gen.DeviceKVPool(2, 4, 8, num_pages=16, page_size=4)
+    plain.allocate("s")
+    plain.append_prefill("s", kv, -kv)
+    plain.append("s", kv[:, 0], -kv[:, 0])
+    plain.reserve("s", 1)
+    plain.write_decode_tokens(["s"], [7], 0, kv[:1, 0], -kv[:1, 0])
+    np.testing.assert_array_equal(pool.k_pool, plain.k_pool)
+    np.testing.assert_array_equal(pool.v_pool, plain.v_pool)
+
+
+def test_reset_pools_rematerializes_the_sharding(mesh):
+    """The poisoned-dispatch recovery path must hand back SHARDED fresh
+    storage — single-device pools would be rejected by every AOT
+    executable lowered against the sharded signature."""
+    pool = gen.DeviceKVPool(2, 4, 8, num_pages=16, page_size=4, mesh=mesh)
+    want = pool.pool_sharding
+    pool.reset_pools()
+    kp, vp = pool.layer_pools(1)
+    assert kp.sharding.is_equivalent_to(want, kp.ndim)
+    assert kp.addressable_shards[0].data.shape == (16, 4, 1, 8)
+    np.testing.assert_array_equal(np.asarray(kp), 0.0)
+    np.testing.assert_array_equal(np.asarray(vp), 0.0)
+
+
+# ---------------------- token identity vs the oracle ---------------------
+
+
+def test_sharded_greedy_token_identical_to_oracle(model, mesh):
+    """Acceptance oracle 1: sharded fused greedy decode on the 4-device
+    mesh reproduces the sequential full-recompute reference token for
+    token."""
+    eng = _engine(model, mesh=mesh)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 12)
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_sharded_token_identical_under_forced_preemption(model, mesh):
+    """A pool sized to thrash: victims re-prefill through the sharded
+    path and every token still matches."""
+    eng = _engine(model, mesh=mesh, pages=9)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 12)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_sharded_stochastic_matches_eager_single_chip(model, mesh):
+    """Seeded stochastic sampling (mixed with greedy rows) through the
+    sharded logits path reproduces the eager single-chip streams seed
+    for seed."""
+    def run(cfg_kw):
+        eng = _engine(model, **cfg_kw)
+        hs = [eng.submit([1, 2, 3], max_new_tokens=10),
+              eng.submit([7, 5], max_new_tokens=10,
+                         sampling=gen.SamplingParams(temperature=0.9,
+                                                     top_k=10, seed=42)),
+              eng.submit([9, 4], max_new_tokens=10,
+                         sampling=gen.SamplingParams(temperature=1.2,
+                                                     top_p=0.9, seed=7))]
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        eng.shutdown()
+        return out
+
+    assert run(dict(mesh=mesh)) == run(dict(decode="eager"))
+
+
+def test_sharded_chunked_prefill_token_identical(model, mesh):
+    """Chunked prefill through the sharded jitted chunk path (pool-
+    donating GSPMD dispatch per chunk), non-dividing chunk size, decode
+    interleaved — tokens match the oracle."""
+    eng = _engine(model, mesh=mesh, jit_prefill=True,
+                  prefill_chunk_tokens=3)
+    assert eng._chunk_step is not None  # the jitted sharded chunk path
+    long_p = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11]
+    hs = [eng.submit(long_p, max_new_tokens=8),
+          eng.submit([7, 5], max_new_tokens=8)]
+    eng.run_until_idle()
+    assert hs[0].result(timeout=5).token_ids == _ref(model, long_p, 8)
+    assert hs[1].result(timeout=5).token_ids == _ref(model, [7, 5], 8)
+    assert eng.metrics.snapshot()["generation.prefill_chunks_total"] >= 4
+    eng.shutdown()
+
+
+def test_sharded_chunked_prefill_under_preemption(model, mesh):
+    """Chunked + sharded + a thrashing pool: mid-prefill preemption and
+    re-prefill through chunks, still token-identical."""
+    eng = _engine(model, mesh=mesh, pages=9, jit_prefill=True,
+                  prefill_chunk_tokens=3)
+    handles = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 10)
+    assert sum(r.preemptions for r in results) > 0
+    eng.shutdown()
+
+
+def test_sharded_bf16_pools_match_unsharded_fused(model, mesh):
+    """bf16 pools: the sharded scatter casts at storage exactly like the
+    unsharded one, so sharded bf16 tokens equal unsharded fused bf16
+    tokens."""
+    import jax.numpy as jnp
+
+    toks = {}
+    for name, kw in (("sharded", dict(mesh=mesh)),
+                     ("fused", dict(kv_backend="device", decode="fused"))):
+        eng = _engine(model, kv_dtype=jnp.bfloat16, **kw)
+        handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+        eng.run_until_idle()
+        toks[name] = [h.result(timeout=5).token_ids for h in handles]
+        eng.shutdown()
+    assert toks["sharded"] == toks["fused"]
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_sharded_engine_both_pool_layouts(model, mesh, layout):
+    """The kernel storage layout shards over its head axis (axis 0) and
+    stays a drop-in: end-to-end token identity in both layouts."""
+    eng = _engine(model, mesh=mesh, pool_layout=layout)
+    handles = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    eng.shutdown()
+
+
+# ------------------- one dispatch, bounded compiles ----------------------
+
+
+def test_sharded_step_is_one_dispatch_one_sync(model, mesh):
+    """Acceptance oracle 2: the sharded step is still ONE device program
+    invocation — the collectives live INSIDE the GSPMD executable, not
+    as engine-issued dispatches."""
+    eng = _engine(model, mesh=mesh)
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=8)
+    eng.step()  # admit + prefill + first decode
+    for _ in range(3):
+        eng.step()
+        stats = eng.metrics.snapshot()
+        assert stats["generation.decode_dispatches_per_step"] == 1
+        assert stats["generation.decode_host_syncs_per_step"] <= 1
+    eng.run_until_idle()
+    eng.shutdown()
+
+
+def test_sharded_compile_count_bounded_by_bucket_menu(model, mesh):
+    """Repeat sharded traffic through seen (batch, pages) buckets never
+    compiles again — the sharded signatures cache exactly like the
+    single-chip ones."""
+    eng = _engine(model, mesh=mesh)
+
+    def burst():
+        handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+        eng.run_until_idle()
+        for h in handles:
+            h.result(timeout=5)
+
+    burst()
+    first = eng._fused.compile_count
+    assert first >= 1
+    burst()
+    assert eng._fused.compile_count == first
+    eng.shutdown()
+
+
+def test_sharded_prewarm_carries_shardings(model, mesh):
+    """Satellite: prewarm's ShapeDtypeStructs carry the pool and param
+    NamedShardings, so the pre-warmed executable IS the one the real
+    sharded dispatch runs — the burst after prewarm adds ZERO
+    compiles (a sharding-less prewarm would lower a single-device
+    executable and the first real step would recompile)."""
+    eng = _engine(model, mesh=mesh)
+    # warm every pages bucket the burst can touch (the page-table axis
+    # grows as sequences lengthen, so the run crosses bucket edges)
+    need = max(-(-(len(p) + 6) // eng.cache.page_size) for p in PROMPTS)
+    pages = 1
+    while True:
+        eng.prewarm_decode(len(PROMPTS), pages, greedy=True)
+        if pages >= need:
+            break
+        pages *= 2
+    warmed = eng._fused.compile_count
+    assert warmed >= 1
+    handles = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+    eng.run_until_idle()
+    for h in handles:
+        h.result(timeout=5)
+    assert eng._fused.compile_count == warmed
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_prewarm"] == warmed
+    eng.shutdown()
+
+
+def test_sharded_failed_dispatch_recovery_keeps_serving(model, mesh):
+    """The reset_pools recovery under a mesh: a dispatch dying after
+    consuming its donated SHARDED buffers leaves the cache on fresh
+    sharded storage, and later sharded requests decode correctly."""
+    eng = _engine(model, mesh=mesh)
+    eng.start()
+    try:
+        fused = eng._fused
+        num_layers = fused._num_layers
+
+        class _DyingExec:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def get(self, args):
+                self._inner.get(args)
+
+                def boom(*a):
+                    for pool in a[4:4 + 2 * num_layers]:
+                        pool.delete()
+                    raise RuntimeError("device fell over mid-dispatch")
+                return boom
+
+        real = dict(fused._exec)
+        fused._exec = {k: _DyingExec(v) for k, v in real.items()}
+        h = eng.submit([1, 2, 3], max_new_tokens=4)
+        with pytest.raises(RuntimeError, match="mid-dispatch"):
+            h.result(timeout=30)
+        fused._exec = real
+
+        kp, _ = eng.cache.layer_pools(0)
+        assert kp.sharding.is_equivalent_to(eng.cache.pool_sharding,
+                                            kp.ndim)
+        h2 = eng.submit([1, 2, 3], max_new_tokens=6)
+        assert list(h2.tokens(timeout=30)) == _ref(model, [1, 2, 3], 6)
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------ metrics ----------------------------------
+
+
+def test_mesh_metrics_in_snapshot(model, mesh):
+    """Satellite: generation.mesh_devices and
+    generation.collective_bytes_per_step land in the StatRegistry
+    snapshot — the formula matches fused._collective_bytes_estimate
+    (2 allreduces/layer over the PADDED [B, d_model] fp32 block, ring
+    factor 2(N-1)/N)."""
+    eng = _engine(model, mesh=mesh)
+    for p in PROMPTS:
+        eng.submit(p, max_new_tokens=6)
+    eng.step()
+    eng.step()
+    stats = eng.metrics.snapshot()
+    assert stats["generation.mesh_devices"] == TP
+    d_model = model.num_heads * model.head_dim
+    want = int(2 * model.num_layers * (4 * d_model * 4) * 2 * (TP - 1)
+               / TP)
+    assert stats["generation.collective_bytes_per_step"] == want
+    eng.run_until_idle()
+    eng.shutdown()
+
+    # unsharded engines report the topology too: 1 device, 0 bytes
+    plain = _engine(model, kv_backend="device", decode="fused")
+    plain.submit([1, 2], max_new_tokens=3)
+    plain.run_until_idle()
+    stats = plain.metrics.snapshot()
+    assert stats["generation.mesh_devices"] == 1
+    assert stats["generation.collective_bytes_per_step"] == 0
+    plain.shutdown()
+
+
+# --------------------------- config validation ---------------------------
+
+
+def test_sharded_config_validation(model, mesh):
+    with pytest.raises(ValueError, match="kv_backend='device'"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            mesh=mesh, kv_backend="host"), start=False)
+    with pytest.raises(ValueError, match="fused"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            mesh=mesh, decode="eager"), start=False)
+    with pytest.raises(ValueError, match="use_kernel"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            mesh=mesh, use_kernel=True), start=False)
+    with pytest.raises(ValueError, match="tp_axis"):
+        gen.GenerationConfig(mesh=mesh, tp_axis="warp")
+    with pytest.raises(ValueError, match="without a mesh"):
+        gen.GenerationConfig(tp_axis="model")
+    # heads not divisible by the mesh axis: typed at engine build
+    odd = gen.TinyCausalLM(vocab_size=16, num_layers=1, num_heads=3,
+                           head_dim=4, seed=0)
+    with pytest.raises(ValueError, match="divisible"):
+        gen.GenerationEngine(odd, gen.GenerationConfig(mesh=mesh),
+                             start=False)
+
+
+def test_pallas_kernel_rejects_mesh_sharded_pool(mesh):
+    """ops/pallas guard: handing a multi-device-sharded pool to the
+    single-device Pallas kernel fails loudly instead of computing over
+    one shard as if it were the whole pool."""
+    pool = gen.DeviceKVPool(1, 4, 8, num_pages=8, page_size=4, mesh=mesh)
+    kp, vp = pool.layer_pools(0)
+    q = np.zeros((1, 4, 8), np.float32)
+    pt = np.zeros((1, 2), np.int32)
+    lens = np.ones((1,), np.int32)
+    with pytest.raises(NotImplementedError, match="mesh-sharded"):
+        gen.paged_decode_attention(q, kp, vp, pt, lens, use_kernel=True,
+                                   interpret=True)

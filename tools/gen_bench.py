@@ -23,6 +23,17 @@ Usage:
         # long prompt streams in — reporting time-to-first-token per
         # request, decode tokens/s DURING the long prefill, and the
         # prefill compile count (chunked: O(1) in prompt length)
+    python tools/gen_bench.py --mesh both
+        # single-chip vs TENSOR-PARALLEL sharded decode A/B: the same
+        # grid run unsharded (tp_degree 1) and over a head-sharded
+        # mesh of every visible device (GenerationConfig.mesh, fused
+        # decode only) — tokens/s and dispatches/step vs tp_degree,
+        # plus generation.collective_bytes_per_step and mesh_devices
+        # in each cell; GSPMD compile wall stays in warmup_s.  On CPU
+        # an --xla_force_host_platform_device_count=8 mesh is forced
+        # automatically when XLA_FLAGS doesn't already carry one
+        # (collectives over loopback: a semantics/dispatch A/B, not a
+        # speedup).  --mesh also takes an explicit tp_degree integer.
 
 Steady-state accounting: every cell pre-warms its decode buckets (and
 pays its prefill/chunk compiles in a full warmup pass) BEFORE the
@@ -69,16 +80,18 @@ def _prewarm_decode_buckets(eng, batch, context, new_tokens, page_size):
 
 
 def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
-               pool, decode, prefill="full", chunk_tokens=0):
+               pool, decode, prefill="full", chunk_tokens=0, tp=1):
     from paddle_tpu import generation as g
     from paddle_tpu.generation import metrics as gmetrics
+    from paddle_tpu.parallel import tp_mesh
     from paddle_tpu.profiler.monitor import StatRegistry
 
+    mesh = tp_mesh(tp) if tp > 1 else None
     eng = g.GenerationEngine(
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
                            page_size=page_size, queue_depth=batch * 2,
-                           kv_backend=pool, decode=decode,
+                           kv_backend=pool, decode=decode, mesh=mesh,
                            prefill_chunk_tokens=(chunk_tokens
                                                  if prefill == "chunked"
                                                  else 0)),
@@ -130,6 +143,13 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         "pool": pool,
         "decode": decode,
         "prefill": prefill,
+        # tensor-parallel degree of the cell's mesh (1 = unsharded) and
+        # the per-dispatch allreduce estimate — the tokens/s-vs-tp A/B
+        # plus the collective-cost baseline the EQuARX-style quantized
+        # allreduce follow-on is measured against
+        "tp_degree": tp,
+        "collective_bytes_per_step": snap.get(
+            "generation.collective_bytes_per_step", 0),
         "batch": batch,
         "context": context,
         "new_tokens": new_tokens,
@@ -313,6 +333,14 @@ def main():
                          "in")
     ap.add_argument("--chunk-tokens", type=int, default=32,
                     help="chunk size for --prefill chunked/both")
+    ap.add_argument("--mesh", default="1",
+                    help="tensor-parallel A/B: '1' (unsharded), 'N' "
+                         "(head-sharded over every visible device), "
+                         "'both', or an explicit tp_degree.  Sharded "
+                         "cells run device pools + fused decode "
+                         "(GenerationConfig.mesh — ONE GSPMD dispatch "
+                         "per step) and report tp_degree + "
+                         "collective_bytes_per_step per cell")
     ap.add_argument("--long-context", type=int, default=None,
                     help="long-prompt length for the interleave cell "
                          "(default: 8x the largest --contexts entry)")
@@ -323,6 +351,16 @@ def main():
     ap.add_argument("--out", default=None,
                     help="also write the JSON document to this path")
     args = ap.parse_args()
+
+    # a multi-device CPU mesh needs forced host devices, and the flag
+    # must land before the backend initializes (no devices have been
+    # touched yet — the top-of-module import only sets jax_platforms)
+    if (args.mesh != "1" and os.environ.get("JAX_PLATFORMS") == "cpu"
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
 
@@ -342,40 +380,67 @@ def main():
                else (args.decode,))
     prefills = (("full", "chunked") if args.prefill == "both"
                 else (args.prefill,))
-    grid = []
-    stats_by_series = {}
-    reg = StatRegistry.instance()
+    ndev = len(jax.devices())
+
+    def shardable(n):
+        # the head axis is the shard axis: the auto degree is the
+        # largest device count that divides --heads (an explicit
+        # integer skips this and fails loudly in the engine instead)
+        while n > 1 and args.heads % n:
+            n -= 1
+        return n
+
+    if args.mesh == "both":
+        tps = sorted({1, shardable(ndev)})
+    elif args.mesh == "N":
+        tps = [shardable(ndev)]
+    else:
+        tps = [int(args.mesh)]
+    combos = []
     for pool in pools:
         for decode in decodes:
             if decode == "fused" and pool != "device":
                 continue  # fused requires donated device pools
             for prefill in prefills:
-                # per-series snapshot: reset generation.* so each
-                # (pool, decode, prefill) combo's stats land separately
-                for name in list(reg.stats()):
-                    if name.startswith("generation."):
-                        reg.get_stat(name).reset()
-                for b in batches:
-                    for ctx in contexts:
-                        # pool sized to fit the cell w/o preemption noise
-                        pages = ((ctx + args.new_tokens)
-                                 // args.page_size + 2) * b
-                        grid.append(bench_cell(
-                            model, b, ctx, args.new_tokens, pages,
-                            args.page_size, pool, decode, prefill,
-                            args.chunk_tokens))
-                # the prefill/decode-interleave cell: decode throughput
-                # while a long prompt streams in (the chunked-prefill
-                # headline number)
-                ib = max(batches)
-                if ib > 1:
-                    grid.append(bench_interleave(
-                        model, ib, min(contexts), long_ctx,
-                        args.new_tokens, args.page_size, pool, decode,
-                        prefill, args.chunk_tokens))
-                series = f"{pool}/{decode}/{prefill}"
-                stats_by_series[series] = \
-                    reg.stats_snapshot("generation.")
+                for tp in tps:
+                    if tp > 1 and (pool, decode) != ("device", "fused"):
+                        continue  # sharded decode IS device + fused
+                    combos.append((pool, decode, prefill, tp))
+    if max(tps) > 1 and not any(tp > 1 for *_, tp in combos):
+        # the mesh A/B must not silently vanish because the requested
+        # --pool/--decode combo can't shard: force the one that can
+        combos += [("device", "fused", prefill, tp)
+                   for prefill in prefills for tp in tps if tp > 1]
+    grid = []
+    stats_by_series = {}
+    reg = StatRegistry.instance()
+    for pool, decode, prefill, tp in combos:
+        # per-series snapshot: reset generation.* so each
+        # (pool, decode, prefill, tp) combo's stats land separately
+        for name in list(reg.stats()):
+            if name.startswith("generation."):
+                reg.get_stat(name).reset()
+        for b in batches:
+            for ctx in contexts:
+                # pool sized to fit the cell w/o preemption noise
+                pages = ((ctx + args.new_tokens)
+                         // args.page_size + 2) * b
+                grid.append(bench_cell(
+                    model, b, ctx, args.new_tokens, pages,
+                    args.page_size, pool, decode, prefill,
+                    args.chunk_tokens, tp=tp))
+        # the prefill/decode-interleave cell: decode throughput
+        # while a long prompt streams in (the chunked-prefill
+        # headline number; unsharded — the mesh A/B is the grid's)
+        ib = max(batches)
+        if ib > 1 and tp == 1:
+            grid.append(bench_interleave(
+                model, ib, min(contexts), long_ctx,
+                args.new_tokens, args.page_size, pool, decode,
+                prefill, args.chunk_tokens))
+        series = f"{pool}/{decode}/{prefill}" + (
+            f"/tp{tp}" if tp > 1 else "")
+        stats_by_series[series] = reg.stats_snapshot("generation.")
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
@@ -384,6 +449,7 @@ def main():
         "pools": list(pools),
         "decodes": list(decodes),
         "prefills": list(prefills),
+        "tp_degrees": list(tps),
         "chunk_tokens": args.chunk_tokens,
         "grid": grid,
         "stats": stats_by_series,

@@ -36,13 +36,18 @@ for i in $(seq 1 "$MAX"); do
     # lands the host-vs-device KV pool A/B (kv_bytes_moved per token:
     # O(pool) host pools vs O(tokens) DeviceKVPool), --decode both
     # lands the eager-vs-fused single-dispatch A/B (steps/s +
-    # dispatches_per_step per cell, warmup/compile time separate) and
+    # dispatches_per_step per cell, warmup/compile time separate),
     # --prefill both lands the full-vs-chunked prefill A/B (TTFT +
     # decode tokens/s during a long-prompt prefill via the interleave
-    # cell, prefill compile counts) in the same artifact
-    timeout 1200 python tools/gen_bench.py --pool both --decode both \
-      --prefill both --out "${OUT%.json}_gen.json" >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill A/B) -> ${OUT%.json}_gen.json"
+    # cell, prefill compile counts) and --mesh both lands the
+    # single-chip-vs-tensor-parallel sharded decode A/B (tokens/s and
+    # dispatches/step vs tp_degree over the real multi-chip mesh, plus
+    # collective_bytes_per_step — the first hardware number for the
+    # GSPMD decode collectives) in the same artifact
+    timeout 1800 python tools/gen_bench.py --pool both --decode both \
+      --prefill both --mesh both --out "${OUT%.json}_gen.json" \
+      >/dev/null 2>&1 \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh A/B) -> ${OUT%.json}_gen.json"
     exit 0
   fi
   echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
