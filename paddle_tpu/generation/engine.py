@@ -150,6 +150,25 @@ class GenerationConfig:
         are single-device programs until the shard_map follow-on).
     tp_axis: the mesh axis name to shard heads over; None = the mesh's
         first axis.  Only meaningful with `mesh`.
+    prefix_cache: PREFIX CACHING — refcounted copy-on-write page
+        sharing across sequences (docs/GENERATION.md "Prefix
+        caching").  Full pages of every completed prompt are indexed
+        by a token chain; admission aliases the longest cached run
+        into the new sequence's page table and prefill resumes at the
+        first unmatched token, so N users of one system prompt pay its
+        prefill once and hold one physical copy.  Freed prompt pages
+        stay resident as an LRU cache evicted only under pool
+        pressure, before any preemption.  Requires a prefill path
+        that can resume MID-prompt: chunked prefill
+        (prefill_chunk_tokens), or a model implementing the eager
+        `prefill_chunk` protocol for the one-shot-prefill engine
+        modes.  None = auto, mirroring the other policies: on on TPU
+        when CHUNKED prefill is active (the jitted resume path —
+        eager-only suffix resume would regress warm TTFT there, so it
+        stays explicit opt-in, exactly like eager chunking), off
+        elsewhere (the CPU tier-1 oracle stays anchored on the cold
+        path; warm-vs-cold token identity is itself oracle-tested,
+        tests/test_prefix_cache.py).
     """
 
     def __init__(self, max_decode_slots=8, num_pages=256, page_size=16,
@@ -159,7 +178,7 @@ class GenerationConfig:
                  prefill_length_buckets=None, jit_prefill=None,
                  decode=None, decode_batch_buckets=None, pool_layout=None,
                  prefill_chunk_tokens=None, step_token_budget=None,
-                 mesh=None, tp_axis=None):
+                 mesh=None, tp_axis=None, prefix_cache=None):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -218,6 +237,11 @@ class GenerationConfig:
                 f"tp_axis={tp_axis!r} without a mesh makes no sense")
         self.mesh = mesh
         self.tp_axis = tp_axis
+        if prefix_cache not in (None, True, False):
+            raise ValueError(
+                f"prefix_cache must be True, False or None (auto), got "
+                f"{prefix_cache!r}")
+        self.prefix_cache = prefix_cache
 
 
 class GenerationResult:
@@ -255,6 +279,10 @@ class GenerationHandle:
         # tools/gen_bench.py's chunked-prefill TTFT A/B reads both
         self.submitted_s = None
         self.first_token_s = None
+        # prompt tokens served by the prefix cache at FIRST admission
+        # (0 = cold, None = not admitted yet): the per-request warm/cold
+        # signal the serving tier (and future SLO routing) reads
+        self.prefix_hit_tokens = None
 
     # --- engine side ---
     def _push_token(self, token):
@@ -451,6 +479,33 @@ class GenerationEngine:
                 "chunked prefill without jit_prefill + kv_backend="
                 "'device' runs the eager chunk path, which needs "
                 f"model.prefill_chunk ({type(model).__name__} lacks it)")
+        # prefix caching: a warm hit resumes prefill MID-prompt, which
+        # only a chunk-capable path can do — the chunked-prefill loop
+        # resumes at prefill_pos natively, and the one-shot modes fall
+        # back to one eager prefill_chunk call over the suffix.  Auto
+        # mirrors the other policies: on on TPU when supported, off on
+        # CPU so the tier-1 oracle stays anchored cold (warm-vs-cold
+        # identity is itself oracle-tested, tests/test_prefix_cache.py).
+        prefix_ok = bool(chunk) or chunk_eager_ok
+        prefix = self.config.prefix_cache
+        if prefix is None:
+            # auto requires chunked prefill to actually be ON, not just
+            # an eager chunk protocol: with chunking off, a warm hit's
+            # suffix runs the per-layer eager loop — the path the chunk
+            # auto policy itself refuses on TPU for regressing TTFT
+            # (a 16-token hit on an 8k prompt must not trade one jitted
+            # prefill for thousands of eager dispatches).  Eager-only
+            # warm resume stays explicit opt-in, like eager chunking.
+            prefix = on_tpu and bool(chunk)
+        elif prefix and not prefix_ok:
+            raise ValueError(
+                "prefix_cache=True needs a prefill path that can resume "
+                "mid-prompt: chunked prefill (prefill_chunk_tokens) or "
+                "a model implementing prefill_chunk — one-shot "
+                f"model.prefill always starts at token 0 "
+                f"({type(model).__name__})")
+        self.prefix_cache_enabled = bool(prefix)
+        self.scheduler.prefix_cache = self.prefix_cache_enabled
         self.step_token_budget = (
             self.config.step_token_budget
             if self.config.step_token_budget is not None
@@ -628,7 +683,16 @@ class GenerationEngine:
         """Prefill newly admitted sequences, batched: group by padded-
         length bucket, then run chunks of <= max_prefill_batch through
         one model call each.  Models without `prefill_batch` fall back
-        to the per-sequence path."""
+        to the per-sequence path.  WARM sequences (a prefix-cache hit
+        advanced prefill_pos at admission) cannot ride the one-shot
+        paths — those always start at token 0 — so they take the
+        suffix-resume path instead."""
+        if not states:
+            return
+        warm = [s for s in states if s.prefill_pos > 0]
+        for state in warm:
+            self._prefill_suffix(state)
+        states = [s for s in states if s.prefill_pos == 0]
         if not states:
             return
         if self.prefill_cache is None:
@@ -694,6 +758,7 @@ class GenerationEngine:
             state.prefilling = False
             state.prefill_pos = len(state.tokens)
             self.metrics.count_prefill(len(state.tokens))
+            self._register_prefix(state)
         # prefill's last-position logits ARE the next-token logits: new
         # prompts sample their first token here (vectorized greedy
         # argmax), and a preempted sequence resumes exactly where its
@@ -717,10 +782,55 @@ class GenerationEngine:
         state.prefilling = False
         state.prefill_pos = len(state.tokens)
         self.metrics.count_prefill(len(state.tokens))
+        self._register_prefix(state)
         # prefill's last-position logits ARE the next-token logits: new
         # prompts sample their first token here, and a preempted sequence
         # resumes exactly where its decode left off
         self._on_logits(state, last_logits)
+
+    def _prefill_suffix(self, state):
+        """Warm-start prefill: positions [0, prefill_pos) are ALIASED
+        cached pages (adopted at admission, zero bytes moved); only the
+        divergent suffix is computed, as one eager prefill_chunk call
+        attending over aliased prefix + suffix through the page table.
+        The suffix's last-position logits ARE the next-token logits,
+        exactly as in full prefill — a warm hit changes how much
+        prefill runs, never what the sequence samples.  (The chunked
+        engine mode never lands here: its chunk loop resumes at
+        prefill_pos natively.)"""
+        from ..profiler import RecordEvent
+
+        n = len(state.tokens) - state.prefill_pos
+        try:
+            # reserve may copy-on-write the clipped tail page (counted
+            # in pages_needed) — after this every written page is
+            # private, which _check_span enforces
+            start = self.cache.reserve(state.seq_id, n)
+        except OutOfPagesError as e:
+            self.scheduler.retire(state)
+            state.handle.set_exception(e)
+            return
+        assert start == state.prefill_pos, \
+            "cache length diverged from matched prefix"
+        with RecordEvent("generation::prefill"):
+            logits_last = self._prefill_chunk_eager(
+                state, state.tokens[start:], start)
+        state.prefilling = False
+        state.prefill_pos = len(state.tokens)
+        self.metrics.count_prefill(n)
+        self._register_prefix(state)
+        self._on_logits(state, logits_last)
+
+    def _register_prefix(self, state):
+        """Index the completed prompt's full pages for future matches
+        (no-op when prefix caching is off).  Only PROMPT tokens are
+        indexed: a post-preemption re-prefill covers generated tokens
+        too, but indexing those would grow the cache with content no
+        other request has asked for — decode-tail indexing is the
+        tracked ROADMAP follow-on."""
+        if self.prefix_cache_enabled:
+            self.cache.register_prefix(
+                state.seq_id, state.tokens[:len(state.request.prompt)])
 
     # ------------------------ chunked prefill -----------------------
     def _prefill_chunk_step(self, state, n):
@@ -770,6 +880,7 @@ class GenerationEngine:
         self._prewarm_decode(state)
         if state.prefill_pos == len(state.tokens):
             state.prefilling = False
+            self._register_prefix(state)
             # the ONLY chunk logits ever materialized: mid-prompt chunks
             # return unmaterialized device values (ChunkedPrefillStep),
             # so a streaming prompt costs zero host syncs until here
@@ -860,7 +971,11 @@ class GenerationEngine:
             if not active:
                 return active
             need = sum(self.cache.pages_needed(s.seq_id, 1) for s in active)
-            if need <= self.cache.num_free_pages:
+            # available = free + evictable cached prefix runs: reserve()
+            # evicts refcount-0 cache pages (LRU) before failing, so a
+            # resident prefix cache is never a reason to preempt a live
+            # sequence
+            if need <= self.cache.available_pages:
                 return active
             victim = self.scheduler.preempt_youngest()
             if victim is not None:
@@ -882,6 +997,13 @@ class GenerationEngine:
         seq_ids = [s.seq_id for s in active]
         positions = np.asarray(
             [self.cache.reserve(s.seq_id, 1) for s in active], np.int32)
+        # COW-safe donation chain (fused path): the in-trace scatter
+        # must never land in a prefix-shared page — reserve() just
+        # privatized each tail page, verified host-side here.  Only
+        # meaningful (and only paid) when sharing can exist at all
+        if self.prefix_cache_enabled:
+            for sid, pos in zip(seq_ids, positions):
+                self.cache.check_span_writable(sid, int(pos), 1)
         tokens = np.asarray([s.tokens[-1] for s in active], np.int32)
         pt, lens = self.cache.gather_block_tables(seq_ids)
         return seq_ids, tokens, positions, pt, lens
@@ -1010,6 +1132,16 @@ class GenerationEngine:
         self.metrics.observe_occupancy(
             len(self.scheduler.active()), self.scheduler.num_slots,
             self.cache.utilization())
+        # prefix-cache observability: per-step shared-page gauge plus
+        # the cache-internal COW/eviction counters drained like
+        # take_bytes_moved.  Skipped entirely when the feature is off —
+        # nothing registers or shares pages then, and shared_pages
+        # scans the per-page refcounts
+        if self.prefix_cache_enabled:
+            cow, evictions = self.cache.take_prefix_counters()
+            self.metrics.count_cow(cow)
+            self.metrics.count_prefix_evictions(evictions)
+            self.metrics.observe_shared_pages(self.cache.shared_pages)
 
     # --------------------------- lifecycle --------------------------
     def start(self):

@@ -307,7 +307,15 @@ class ChunkedPrefillStep:
     last-position logits back UNMATERIALIZED, and the engine fetches
     only the FINAL chunk's (they ARE the first-token logits) — so a
     long prompt streams in with zero dispatch-pipeline bubbles between
-    its chunks and the interleaved decode steps."""
+    its chunks and the interleaved decode steps.
+
+    Prefix caching composes for free: a warm hit advances prefill_pos
+    past the matched span at admission, so fully-matched chunks are
+    simply never planned — the first dispatched chunk starts at the
+    first unmatched token, reading the aliased prefix pages through
+    the page table like any other prefix.  The only new obligation is
+    COW safety: the donated in-trace scatter must never write a shared
+    page (see the pre-dispatch guard in `run`)."""
 
     def __init__(self, model, cache, metrics, chunk_tokens,
                  use_kernel=False, mesh=None, tp_axis=None):
@@ -361,6 +369,13 @@ class ChunkedPrefillStep:
         if n > self._chunk:
             raise ValueError(f"chunk of {n} tokens > chunk_tokens="
                              f"{self._chunk}")
+        # COW-safe donation chain: the scatter below runs IN-TRACE on
+        # donated pools, where a write to a prefix-shared page would
+        # silently corrupt every sequence (and cached run) aliasing it.
+        # reserve() privatized the span via copy-on-write before this
+        # chunk was planned; verify host-side, pre-dispatch, while the
+        # pools are still alive
+        self._cache.check_span_writable(seq_id, start, n)
         tok = np.zeros((self._chunk,), np.int32)
         tok[:n] = tokens
         pt_row, _ = self._cache.gather_block_tables([seq_id])

@@ -26,6 +26,18 @@ Both expose the same surface (``reserve`` / ``append`` /
 ``append_prefill`` / ``gather_block_tables`` / the batched
 ``write_decode_tokens`` / ``write_prefill_batch``), so the scheduler and
 the token-identity oracle never see the difference.
+
+Prefix caching (refcounted copy-on-write page sharing) also lives in the
+shared bookkeeping: full pages of prompt token ids are CHAIN-KEYED into
+a prefix index (``register_prefix``), admission looks up the longest
+cached page run (``match_prefix``) and aliases those physical pages into
+a new sequence's page table (``adopt_prefix``) so a thousand users of
+one system prompt hold ONE physical copy and pay its prefill once.
+Every page carries a refcount; ``free`` decrefs instead of releasing,
+shared pages are read-only with copy-on-write on the first divergent
+append (``reserve`` swaps in a private copy before any write can land),
+and refcount-0 runs stay RESIDENT as an LRU cache evicted only under
+pool pressure — docs/GENERATION.md "Prefix caching".
 """
 import math
 
@@ -53,6 +65,31 @@ class UnknownSequenceError(KeyError):
     def __str__(self):
         return (f"unknown sequence {self.seq_id!r}: not allocated or "
                 f"already freed ({self.live_count} live sequence(s))")
+
+
+class _PrefixNode:
+    """One full page of prompt tokens in the prefix index.
+
+    Nodes form a trie over PAGES: a node is keyed by (parent node id,
+    the page's token tuple), so two prompts share a chain exactly as
+    far as their token streams agree page for page.  The key stores the
+    literal tokens (not a hash of them), so a colliding hash can never
+    alias two different prefixes — lookup is dict-hash fast but
+    equality-exact.  `page` is the physical page holding the K/V for
+    these tokens (valid for ANY sequence whose prefix matches: causal
+    attention makes a position's K/V a function of the token prefix
+    alone).  `last_use` orders LRU eviction; `children` counts cached
+    child nodes so eviction can peel leaves first."""
+
+    __slots__ = ("page", "key", "parent", "ident", "children", "last_use")
+
+    def __init__(self, page, key, parent, ident):
+        self.page = page
+        self.key = key
+        self.parent = parent
+        self.ident = ident
+        self.children = 0
+        self.last_use = 0
 
 
 class PagedKVCache:
@@ -85,6 +122,21 @@ class PagedKVCache:
         self._tables = {}    # seq_id -> [page ids]
         self._lens = {}      # seq_id -> token count
         self._bytes_moved = 0  # host<->device KV bytes (take_bytes_moved)
+        # ---- prefix cache state (dormant until register_prefix) ----
+        self._refs = {}       # page -> live sequence refcount (0 = page
+        #                       resident only as a cached prefix run)
+        self._nodes = {}      # (parent ident, token tuple) -> _PrefixNode
+        self._page_node = {}  # page -> its _PrefixNode (indexed pages)
+        self._next_node_id = 1   # 0 is the trie root
+        self._clock = 0          # LRU recency counter
+        self._cow_copies = 0         # drained by take_prefix_counters
+        self._prefix_evictions = 0   # drained by take_prefix_counters
+        # incrementally-maintained counts (every _refs transition runs
+        # through _incref/_decref/_take_owned_page/_drop_node/flush),
+        # so the per-step gauges and capacity checks stay O(1) instead
+        # of scanning the refcount dict
+        self._n_shared = 0   # pages with refcount > 1
+        self._n_cached = 0   # refcount-0 registered residents
         self._init_pools()
 
     def _init_pools(self):
@@ -109,14 +161,20 @@ class PagedKVCache:
         self._lens[seq_id] = 0
 
     def free(self, seq_id):
-        """Return every page of `seq_id` to the pool.  A double free (or
-        a free of a never-allocated id) raises UnknownSequenceError —
-        an explicit error, never a silent second release of pages that
-        may already belong to another sequence."""
+        """Release `seq_id`'s hold on its pages — a DECREF per page, not
+        an unconditional release: a page aliased by other sequences
+        stays theirs, and a page registered in the prefix index stays
+        RESIDENT at refcount 0 (an evictable cached run) instead of
+        returning to the free list.  Exclusive unindexed pages return to
+        the pool exactly as before.  A double free (or a free of a
+        never-allocated id) raises UnknownSequenceError — an explicit
+        error, never a silent second release of pages that may already
+        belong to another sequence."""
         pages = self._table(seq_id)
         del self._tables[seq_id]
         del self._lens[seq_id]
-        self._free.extend(reversed(pages))
+        for page in reversed(pages):   # reversed: LIFO warm reuse
+            self._decref(page)
 
     def has(self, seq_id):
         return seq_id in self._tables
@@ -129,36 +187,345 @@ class PagedKVCache:
         return self._free.pop()
 
     def pages_needed(self, seq_id, new_tokens):
-        """Pages an append of `new_tokens` to `seq_id` would allocate."""
+        """Pages an append of `new_tokens` to `seq_id` would allocate —
+        including the copy-on-write page when the append's first token
+        lands mid-page in a SHARED page (the private copy `reserve`
+        swaps in costs one fresh page)."""
         table = self._table(seq_id)
         length = self._lens[seq_id]
-        return (math.ceil((length + new_tokens) / self.page_size)
+        need = (math.ceil((length + new_tokens) / self.page_size)
                 - len(table))
+        if new_tokens > 0 and self._cow_page_index(seq_id) is not None:
+            need += 1
+        return need
 
     def reserve(self, seq_id, new_tokens=1):
         """Grow `seq_id`'s page table to hold `new_tokens` more tokens and
         advance its length; returns the first new position.  All-or-
-        nothing: on OutOfPagesError nothing is allocated or advanced."""
+        nothing: on OutOfPagesError nothing is allocated or advanced.
+        Under pool pressure, refcount-0 cached prefix runs are EVICTED
+        (LRU) before the error is raised — the cache gives pages back
+        before any live sequence is preempted for them.  If the append
+        starts mid-page in a shared page, that page is copy-on-write
+        replaced with a private copy first, so the coming write can
+        never touch storage another sequence (or the prefix index)
+        still reads."""
         need = self.pages_needed(seq_id, new_tokens)
+        if need > len(self._free):
+            self._evict_prefix(need - len(self._free))
         if need > len(self._free):
             raise OutOfPagesError(
                 f"need {need} pages for {new_tokens} tokens of "
                 f"{seq_id!r}, only {len(self._free)} free")
         table = self._tables[seq_id]
-        for _ in range(need):
-            table.append(self._take_page())
+        if new_tokens > 0:
+            self._cow_if_shared(seq_id)
+        while len(table) < math.ceil(
+                (self._lens[seq_id] + new_tokens) / self.page_size):
+            table.append(self._take_owned_page())
         start = self._lens[seq_id]
         self._lens[seq_id] = start + new_tokens
         return start
 
+    # ------------------------ prefix caching ------------------------
+    def _tick(self):
+        self._clock += 1
+        return self._clock
+
+    def _page_shared(self, page):
+        """A page this sequence must NOT write through: aliased by more
+        than one page table, or pinned read-only by the prefix index
+        (future matches alias its content)."""
+        return self._refs.get(page, 0) > 1 or page in self._page_node
+
+    def _take_owned_page(self):
+        page = self._take_page()
+        self._refs[page] = 1
+        return page
+
+    def _incref(self, page):
+        """Pin one more alias on `page` (adoption): a cached resident
+        leaves the evictable set, a second alias makes it shared."""
+        old = self._refs.get(page, 0)
+        if old == 0:
+            self._n_cached -= 1
+        self._refs[page] = old + 1
+        if old == 1:
+            self._n_shared += 1
+
+    def _decref(self, page):
+        n = self._refs.get(page, 1) - 1
+        if n == 1:
+            self._n_shared -= 1
+        if n > 0:
+            self._refs[page] = n
+            return
+        node = self._page_node.get(page)
+        if node is not None:
+            # last live reference gone but the run is cached: stay
+            # resident at refcount 0, evictable under pool pressure
+            self._refs[page] = 0
+            self._n_cached += 1
+            node.last_use = self._tick()
+        else:
+            self._refs.pop(page, None)
+            self._free.append(page)
+
+    def _cow_page_index(self, seq_id):
+        """Index into `seq_id`'s table of the page a next append would
+        write MID-PAGE while it is shared — the page `reserve` must
+        copy-on-write — or None.  Only the tail page can qualify:
+        appends always start at the current length, so a non-boundary
+        start writes into exactly one existing page."""
+        length = self._lens[seq_id]
+        if length % self.page_size == 0:
+            return None
+        idx = length // self.page_size
+        table = self._tables[seq_id]
+        if idx >= len(table) or not self._page_shared(table[idx]):
+            return None
+        return idx
+
+    def _cow_if_shared(self, seq_id):
+        """Swap the shared tail page for a private copy before a write
+        can land in it (caller pre-checked capacity via pages_needed).
+        The copy is storage-level — host: one numpy slice copy; device:
+        one donated in-trace page copy per pool list (see
+        `_copy_kv_pages`) — and the old page is decref'd: other aliases
+        and the prefix index keep reading the ORIGINAL bytes."""
+        idx = self._cow_page_index(seq_id)
+        if idx is None:
+            return
+        table = self._tables[seq_id]
+        old = table[idx]
+        new = self._take_owned_page()
+        self._copy_page_storage(old, new)
+        table[idx] = new
+        self._decref(old)
+        self._cow_copies += 1
+
+    def _copy_page_storage(self, src, dst):
+        """Copy one physical page's K/V across every layer (the COW
+        copy).  Host backend: in-place numpy; DeviceKVPool overrides
+        with a single donated dispatch."""
+        self.k_pool[:, dst] = self.k_pool[:, src]
+        self.v_pool[:, dst] = self.v_pool[:, src]
+
+    def match_prefix(self, tokens):
+        """Longest cached page run matching a strict prefix of `tokens`.
+
+        Walks the trie one FULL page at a time (partial pages are never
+        indexed) and returns ``(pages, matched_tokens)`` ready for
+        `adopt_prefix`.  `matched_tokens` is clipped to
+        ``len(tokens) - 1``: at least one token must remain for the
+        suffix prefill, whose last-position logits ARE the first-token
+        logits — a fully-aliased prompt would have nothing to sample
+        from.  When the clip cuts into the final matched page, that
+        page is still aliased (its rows up to the clip are valid) and
+        the suffix prefill's first write triggers its copy-on-write.
+        Touches each matched node's LRU recency."""
+        n = len(tokens)
+        ps = self.page_size
+        pages = []
+        parent_ident = 0
+        i = 0
+        while (i + 1) * ps <= n:
+            key = (parent_ident,
+                   tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+            node = self._nodes.get(key)
+            if node is None:
+                break
+            node.last_use = self._tick()
+            pages.append(node.page)
+            parent_ident = node.ident
+            i += 1
+        matched = min(len(pages) * ps, n - 1)
+        if matched <= 0:
+            return (), 0
+        return tuple(pages[:math.ceil(matched / ps)]), matched
+
+    def adopt_prefix(self, seq_id, pages, matched_tokens):
+        """Alias a matched page run into a freshly allocated sequence:
+        the pages join `seq_id`'s page table with their refcounts
+        bumped — ZERO bytes move — and the sequence's length starts at
+        `matched_tokens`, so prefill resumes at the first unmatched
+        position.  Must run in the same scheduling step as the
+        `match_prefix` that produced `pages` (an incref is what pins
+        them against eviction)."""
+        table = self._table(seq_id)
+        if table or self._lens[seq_id]:
+            raise ValueError(
+                f"adopt_prefix on non-empty sequence {seq_id!r} "
+                f"(len={self._lens[seq_id]})")
+        if not (len(pages) - 1) * self.page_size < int(matched_tokens) \
+                <= len(pages) * self.page_size:
+            raise ValueError(
+                f"matched_tokens={matched_tokens} does not land in the "
+                f"last of {len(pages)} pages of {self.page_size}")
+        for page in pages:
+            self._incref(page)
+        table.extend(int(p) for p in pages)
+        self._lens[seq_id] = int(matched_tokens)
+
+    def register_prefix(self, seq_id, tokens):
+        """Index `seq_id`'s fully-written prompt pages for future
+        matches.  Every FULL page of `tokens` (which must all be in the
+        cache for `seq_id`) becomes a trie node mapping its chain key
+        to the physical page; pages whose chain key is already indexed
+        are skipped — the first writer wins, and a later identical
+        prefill keeps its private pages (freed normally on decref).
+        The engine calls this at prefill completion, when the pages are
+        final: indexed pages are read-only from here on (writes would
+        corrupt what future matches alias), enforced by the shared-page
+        write guard.  Returns the number of NEW pages indexed."""
+        table = self._table(seq_id)
+        ps = self.page_size
+        n_full = min(len(tokens), self._lens[seq_id]) // ps
+        parent, parent_ident = None, 0
+        added = 0
+        for i in range(n_full):
+            key = (parent_ident,
+                   tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+            node = self._nodes.get(key)
+            if node is None:
+                page = table[i]
+                if page in self._page_node:
+                    # already indexed under another chain — impossible
+                    # by construction (a page has one content history),
+                    # but never double-index if it somehow happens
+                    break
+                node = _PrefixNode(page, key, parent, self._next_node_id)
+                self._next_node_id += 1
+                self._nodes[key] = node
+                self._page_node[page] = node
+                if parent is not None:
+                    parent.children += 1
+                added += 1
+            node.last_use = self._tick()
+            parent, parent_ident = node, node.ident
+        return added
+
+    def _evict_prefix(self, n_pages):
+        """Evict up to `n_pages` refcount-0 cached pages to the free
+        list, least-recently-used LEAF nodes first (a refcount-0 node's
+        descendants are refcount-0 too — any sequence aliasing a child
+        aliases the parent — so peeling leaves always makes progress).
+        One scan seeds a min-heap of evictable leaves; dropping a leaf
+        pushes its parent when that became an evictable leaf in turn —
+        O(nodes + K log K) for a K-page eviction, not K rescans.
+        Returns pages actually freed."""
+        import heapq
+
+        if self._n_cached == 0:
+            # nothing evictable (every indexed page is pinned by a live
+            # sequence): skip the trie scan — this branch runs on every
+            # pressured reserve, per decode token, under exactly the
+            # warm steady-state load the cache targets
+            return 0
+        heap = [(nd.last_use, nd.ident, nd) for nd in self._nodes.values()
+                if nd.children == 0 and self._refs.get(nd.page, 1) == 0]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < n_pages and heap:
+            _, _, node = heapq.heappop(heap)
+            if self._nodes.get(node.key) is not node or node.children \
+                    or self._refs.get(node.page, 1) != 0:
+                continue  # stale entry
+            parent = node.parent
+            self._drop_node(node)
+            freed += 1
+            if parent is not None and parent.children == 0 \
+                    and self._refs.get(parent.page, 1) == 0:
+                heapq.heappush(heap,
+                               (parent.last_use, parent.ident, parent))
+        return freed
+
+    def _drop_node(self, node):
+        del self._nodes[node.key]
+        del self._page_node[node.page]
+        if node.parent is not None:
+            node.parent.children -= 1
+        del self._refs[node.page]     # refcount 0 (eviction precondition)
+        self._n_cached -= 1
+        self._free.append(node.page)
+        self._prefix_evictions += 1
+
+    def flush_prefix_cache(self):
+        """Drop the whole prefix index: refcount-0 pages return to the
+        free list; pages still aliased by live sequences are merely
+        unindexed (they free normally on their last decref).  Returns
+        pages freed.  After draining every sequence, a flush restores
+        the pool to all-free — the refcount-leak invariant the tests
+        pin.  Flush-freed pages do NOT count into prefix_evictions:
+        that counter means pressure-driven LRU eviction, and a
+        recovery/operator flush spiking it would mimic pool-pressure
+        thrash that never happened."""
+        freed = 0
+        for node in list(self._nodes.values()):
+            if self._refs.get(node.page, 1) == 0:
+                del self._refs[node.page]
+                self._n_cached -= 1
+                self._free.append(node.page)
+                freed += 1
+        self._nodes.clear()
+        self._page_node.clear()
+        return freed
+
+    def take_prefix_counters(self):
+        """(cow_copies, prefix_evictions) since the last take — the
+        engine drains these into generation.* counters each step."""
+        out = (self._cow_copies, self._prefix_evictions)
+        self._cow_copies = 0
+        self._prefix_evictions = 0
+        return out
+
+    @property
+    def shared_pages(self):
+        """Physical pages aliased by MORE than one page table — the
+        bytes-deduplicated view N users of one system prompt produce.
+        O(1): maintained at every refcount transition."""
+        return self._n_shared
+
+    @property
+    def prefix_cached_pages(self):
+        """Resident refcount-0 pages held only by the prefix index —
+        reclaimable without touching any live sequence.  O(1):
+        maintained at every refcount transition."""
+        return self._n_cached
+
+    @property
+    def available_pages(self):
+        """Free pages plus evictable cached pages — what admission and
+        preemption decisions must compare against (a cached run is
+        never a reason to preempt a live sequence)."""
+        return len(self._free) + self.prefix_cached_pages
+
+    def evictable_pages_in(self, pages):
+        """How many of `pages` are refcount-0 cached residents RIGHT
+        NOW — pages an adoption would pin, removing them from
+        available_pages.  The admission gate subtracts this so a warm
+        match can never double-count its own pages as both 'aliased
+        for free' and 'evictable for the suffix'."""
+        return sum(1 for p in pages if self._refs.get(p, 1) == 0)
+
     def _locate(self, seq_id, pos):
-        """(page, row) of an already-reserved position; typed errors."""
+        """(page, row) of an already-reserved position, for a WRITE;
+        typed errors, including the shared-page guard: every write path
+        (eager scatters AND the host-side index computation feeding the
+        fused in-trace scatters) funnels through here or _check_span, so
+        a missed copy-on-write fails loudly instead of corrupting
+        storage other sequences alias."""
         table = self._table(seq_id)
         if pos >= self._lens[seq_id]:
             raise IndexError(
                 f"position {pos} not reserved for {seq_id!r} "
                 f"(len={self._lens[seq_id]})")
-        return table[pos // self.page_size], pos % self.page_size
+        page = table[pos // self.page_size]
+        if self._page_shared(page):
+            raise RuntimeError(
+                f"write at position {pos} of {seq_id!r} targets shared "
+                f"page {page} — copy-on-write was missed")
+        return page, pos % self.page_size
 
     def _count_write_payload(self, tokens, layers):
         """K+V bytes a write pulls across the host<->device boundary —
@@ -194,7 +561,7 @@ class PagedKVCache:
         ``write_decode_tokens``, used by the eager chunked-prefill
         attend callback (engine._prefill_chunk_eager)."""
         k = np.asarray(k)
-        self._check_span(seq_id, int(start), k.shape[0])
+        self._check_span_writable(seq_id, int(start), k.shape[0])
         self._write_span(seq_id, int(start), k[None], np.asarray(v)[None],
                          layers=slice(layer, layer + 1))
 
@@ -211,17 +578,45 @@ class PagedKVCache:
     def append_prefill(self, seq_id, k, v):
         """Append a whole prompt's K/V across every layer.  k, v:
         ``[num_layers, T, num_heads, head_dim]``."""
-        start = self.reserve(seq_id, np.shape(k)[1])
+        n = np.shape(k)[1]
+        start = self.reserve(seq_id, n)
+        self._check_span_writable(seq_id, start, n)
         self._write_span(seq_id, start, k, v)
         return start
 
     def _check_span(self, seq_id, start, n):
-        """Typed validation that [start, start+n) is reserved."""
+        """Typed validation that [start, start+n) is reserved (reads
+        and writes alike — reads may legitimately span SHARED pages;
+        writes go through _check_span_writable)."""
         self._table(seq_id)
         if int(start) + n > self._lens[seq_id]:
             raise IndexError(
                 f"prefill span [{start}, {start + n}) not reserved "
                 f"for {seq_id!r} (len={self._lens[seq_id]})")
+
+    def _check_span_writable(self, seq_id, start, n):
+        """Reserved AND writable: no page under the span may be shared
+        (aliased or prefix-indexed) — reserve's copy-on-write must have
+        privatized the tail page before any write lands (the fused
+        dispatches run the same check pre-dispatch, so a donated
+        in-trace scatter can never touch a shared page either)."""
+        self._check_span(seq_id, start, n)
+        if n <= 0:
+            return
+        table = self._tables[seq_id]
+        for idx in range(int(start) // self.page_size,
+                         (int(start) + n - 1) // self.page_size + 1):
+            if idx < len(table) and self._page_shared(table[idx]):
+                raise RuntimeError(
+                    f"write span [{start}, {start + n}) of {seq_id!r} "
+                    f"overlaps shared page {table[idx]} — copy-on-write "
+                    f"was missed")
+
+    def check_span_writable(self, seq_id, start, n):
+        """Public pre-dispatch guard for in-trace writers (the jitted
+        chunk and fused decode steps): the span must be reserved and
+        privately owned."""
+        self._check_span_writable(seq_id, int(start), int(n))
 
     def write_prefill_batch(self, seq_ids, starts, lengths, k, v):
         """Write a batch of (possibly length-padded) prefill K/V spans.
@@ -234,7 +629,7 @@ class PagedKVCache:
         v = np.asarray(v)
         for i, sid in enumerate(seq_ids):
             n = int(lengths[i])
-            self._check_span(sid, int(starts[i]), n)
+            self._check_span_writable(sid, int(starts[i]), n)
             self._write_span(sid, int(starts[i]), k[i][:, :n], v[i][:, :n])
 
     def _write_span(self, seq_id, start, k, v, layers=slice(None)):
@@ -340,17 +735,47 @@ class PagedKVCache:
         return self.num_pages - len(self._free)
 
     def utilization(self):
-        """Fraction of the pool's pages currently owned by sequences."""
-        return self.pages_in_use / self.num_pages
+        """Fraction of the pool PINNED by live sequences.  Refcount-0
+        cached prefix residents are excluded: they are instantly
+        reclaimable (admission counts them available), so a warm but
+        idle server reads ~0 here, not ~100 — the exported
+        page_utilization_pct gauge must agree with the admission
+        decisions, not contradict them.  `pages_in_use` stays the
+        physical occupancy; stats() reports the resident-vs-pinned
+        split."""
+        return ((self.pages_in_use - self.prefix_cached_pages)
+                / self.num_pages)
+
+    def unique_tokens(self):
+        """Token rows held across DISTINCT physical pages — the
+        deduplicated occupancy.  Summing per-sequence lengths counts a
+        shared page once per alias (N users of one system prompt would
+        'hold' N copies that physically exist once); here each physical
+        page contributes its deepest-written row count exactly once,
+        and refcount-0 cached pages contribute their full page (they
+        are always full prompt pages)."""
+        rows = {}
+        for seq_id, table in self._tables.items():
+            length = self._lens[seq_id]
+            for i, page in enumerate(table):
+                used = min(self.page_size, length - i * self.page_size)
+                if used > 0:
+                    rows[page] = max(rows.get(page, 0), used)
+        for page, refs in self._refs.items():
+            if refs == 0:
+                rows.setdefault(page, self.page_size)
+        return int(sum(rows.values()))
 
     def token_utilization(self):
         """Fraction of allocated page *rows* actually holding tokens —
         the internal-fragmentation view (last page of each sequence is
-        partially full)."""
+        partially full).  Counts physically UNIQUE rows: with prefix
+        sharing, the logical sum of sequence lengths can exceed the
+        physical pool, but utilization never exceeds 1."""
         used = self.pages_in_use * self.page_size
         if not used:
             return 0.0
-        return sum(self._lens.values()) / used
+        return self.unique_tokens() / used
 
     def stats(self):
         return {
@@ -359,7 +784,12 @@ class PagedKVCache:
             "pages_in_use": self.pages_in_use,
             "pages_free": self.num_free_pages,
             "sequences": len(self._tables),
+            # logical tokens (per-sequence sum: shared pages count once
+            # per alias) vs the physically-unique row count
             "tokens": int(sum(self._lens.values())),
+            "unique_tokens": self.unique_tokens(),
+            "shared_pages": self.shared_pages,
+            "prefix_cached_pages": self.prefix_cached_pages,
             "utilization_pct": round(100.0 * self.utilization(), 1),
             "token_utilization_pct":
                 round(100.0 * self.token_utilization(), 1),
@@ -423,6 +853,41 @@ def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v, *, layout,
             [_pin_sharding(scatter_pool_update(vp, pages, rows, v[i],
                                                layout), sharding)
              for i, vp in enumerate(v_pools)])
+
+
+def _copy_kv_pages(k_pools, v_pools, src, dst, *, layout, sharding=None):
+    """Copy physical page `src` -> `dst` in every layer's pools — the
+    copy-on-write body, ONE donated dispatch for all layers (the page
+    axis is never the shard axis, so under a mesh the copy is fully
+    local per device).  Same donation/sharding contract as the scatter
+    dispatches above."""
+    def copy(pool):
+        if layout == "kernel":          # [H, P, page_size, D]
+            out = pool.at[:, dst].set(pool[:, src])
+        else:                           # [P, page_size, H, D]
+            out = pool.at[dst].set(pool[src])
+        return _pin_sharding(out, sharding)
+
+    return [copy(p) for p in k_pools], [copy(p) for p in v_pools]
+
+
+def _jitted_page_copy(layout, sharding=None):
+    """Cached jitted donated page-copy per (layout, sharding) — the COW
+    sibling of _jitted_scatter."""
+    import functools
+
+    key = (layout, sharding)
+    if key not in _PAGE_COPY_JIT:
+        import jax
+
+        _PAGE_COPY_JIT[key] = jax.jit(
+            functools.partial(_copy_kv_pages, layout=layout,
+                              sharding=sharding),
+            donate_argnums=(0, 1))
+    return _PAGE_COPY_JIT[key]
+
+
+_PAGE_COPY_JIT = {}
 
 
 class DeviceKVPool(PagedKVCache):
@@ -598,6 +1063,7 @@ class DeviceKVPool(PagedKVCache):
         v = self._jnp.asarray(v)
         n = k.shape[1]
         start = self.reserve(seq_id, n)
+        self._check_span_writable(seq_id, start, n)
         pages, rows = self._span_pages_rows(seq_id, start, n)
         self._scatter_layers_once(pages, rows, k, v, n)
         return start
@@ -610,7 +1076,7 @@ class DeviceKVPool(PagedKVCache):
         all_rows = np.empty((b, t_pad), np.int32)
         for i, sid in enumerate(seq_ids):
             n = int(lengths[i])
-            self._check_span(sid, int(starts[i]), n)
+            self._check_span_writable(sid, int(starts[i]), n)
             all_pages[i], all_rows[i] = self._span_pages_rows(
                 sid, int(starts[i]), n, pad_to=t_pad)
         real = int(np.sum(np.asarray(lengths)))
@@ -630,9 +1096,18 @@ class DeviceKVPool(PagedKVCache):
         k = self._jnp.asarray(k)
         v = self._jnp.asarray(v)
         n = k.shape[0]
-        self._check_span(seq_id, int(start), n)
+        self._check_span_writable(seq_id, int(start), n)
         pages, rows = self._span_pages_rows(seq_id, int(start), n)
         self._scatter_layer(layer, pages, rows, k, v, n)
+
+    def _copy_page_storage(self, src, dst):
+        """The COW page copy as ONE donated in-trace dispatch across
+        every layer — the payload never crosses the host<->device
+        boundary (page-to-page inside the resident pools)."""
+        jnp = self._jnp
+        fn = _jitted_page_copy(self.pool_layout, self._sharding)
+        self._k, self._v = fn(self._k, self._v, jnp.int32(src),
+                              jnp.int32(dst))
 
     # --------------------------- reads ------------------------------
     def layer_pools(self, layer):
@@ -690,8 +1165,13 @@ class DeviceKVPool(PagedKVCache):
         Goes through _materialize_pools, so a mesh-sharded pool comes
         back in its NamedSharding — a recovery that silently rebuilt
         single-device pools would poison every later sharded dispatch
-        (the AOT executables are lowered against the sharded layout)."""
+        (the AOT executables are lowered against the sharded layout).
+        The prefix index is FLUSHED with the storage: its nodes alias
+        pages whose bytes were just zeroed, and a later warm hit
+        against them would silently generate from garbage — stale
+        cache entries must die with the content they indexed."""
         self._materialize_pools(self._k[0].shape)
+        self.flush_prefix_cache()
 
     def _canonical(self, pool):
         """[H, P, ps, D] -> [P, ps, H, D] for kernel-layout pools."""

@@ -58,6 +58,20 @@ Metric names:
 - ``generation.tokens_per_s``         gauge: decode throughput (EWMA)
 - ``generation.slot_occupancy_pct``   gauge: active / decode slots
 - ``generation.page_utilization_pct`` gauge: pool pages in use
+- ``generation.prefix_cache_hit_tokens``  prompt tokens served from the
+                                      prefix cache (aliased pages) at
+                                      admission instead of re-prefilled
+- ``generation.prefix_cache_hit_rate``  gauge: cumulative hit tokens /
+                                      prompt tokens looked up (0..1)
+- ``generation.shared_pages``         gauge: physical pages aliased by
+                                      >1 page table right now (N users
+                                      of one system prompt, ONE copy)
+- ``generation.cow_copies``           copy-on-write page copies (first
+                                      divergent append into a shared
+                                      page)
+- ``generation.prefix_evictions``     cached refcount-0 pages evicted
+                                      back to the free list under pool
+                                      pressure (LRU, before preemption)
 - ``generation.mesh_devices``         gauge: tensor-parallel degree of
                                       the engine's mesh (1 unsharded)
 - ``generation.collective_bytes_per_step``  gauge: estimated on-wire
@@ -102,6 +116,11 @@ SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
 PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
 MESH_DEVICES = PREFIX + "mesh_devices"
 COLLECTIVE_BYTES_PER_STEP = PREFIX + "collective_bytes_per_step"
+PREFIX_CACHE_HIT_TOKENS = PREFIX + "prefix_cache_hit_tokens"
+PREFIX_CACHE_HIT_RATE = PREFIX + "prefix_cache_hit_rate"
+SHARED_PAGES = PREFIX + "shared_pages"
+COW_COPIES = PREFIX + "cow_copies"
+PREFIX_EVICTIONS = PREFIX + "prefix_evictions"
 
 
 class GenerationMetrics:
@@ -113,6 +132,10 @@ class GenerationMetrics:
     def __init__(self, registry=None):
         self._reg = registry or StatRegistry.instance()
         self._rate = 0.0
+        # prefix-cache hit-rate accumulators (per-engine: the gauge is
+        # this engine's cumulative warm fraction, not a fleet mix)
+        self._prefix_hit_cum = 0
+        self._prefix_lookup_cum = 0
 
     def _stat(self, name):
         return self._reg.get_stat(name)
@@ -162,6 +185,36 @@ class GenerationMetrics:
     def count_chunk(self):
         """One chunked-prefill dispatch (a chunk of one prompt)."""
         self._stat(PREFILL_CHUNKS_TOTAL).increase()
+
+    # --- prefix cache ---
+    def count_prefix_lookup(self, hit_tokens, prompt_tokens):
+        """One admission-time prefix lookup over a `prompt_tokens`-long
+        token list, of which `hit_tokens` were served by aliasing
+        cached pages (0 = cold).  Maintains the cumulative hit-rate
+        gauge alongside the hit-token counter."""
+        if hit_tokens:
+            self._stat(PREFIX_CACHE_HIT_TOKENS).increase(int(hit_tokens))
+        self._prefix_hit_cum += int(hit_tokens)
+        self._prefix_lookup_cum += int(prompt_tokens)
+        if self._prefix_lookup_cum:
+            self._stat(PREFIX_CACHE_HIT_RATE).set(
+                round(self._prefix_hit_cum / self._prefix_lookup_cum, 3))
+
+    def observe_shared_pages(self, n):
+        """Gauge: physical pages currently aliased by more than one
+        page table (the engine samples the cache every step)."""
+        self._stat(SHARED_PAGES).set(int(n))
+
+    def count_cow(self, n=1):
+        # touch the stat even at 0 so every snapshot carries the key
+        stat = self._stat(COW_COPIES)
+        if n:
+            stat.increase(int(n))
+
+    def count_prefix_evictions(self, n=1):
+        stat = self._stat(PREFIX_EVICTIONS)
+        if n:
+            stat.increase(int(n))
 
     def count_decode_prewarm(self):
         """One fused-decode executable compiled by the PRE-WARM path

@@ -98,13 +98,20 @@ class ContinuousBatchingScheduler:
     active() -> the decode batch, retire()/preempt_for_pages() on exit
     paths."""
 
-    def __init__(self, cache, num_slots=8, queue_depth=64, metrics=None):
+    def __init__(self, cache, num_slots=8, queue_depth=64, metrics=None,
+                 prefix_cache=False):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.cache = cache
         self.num_slots = int(num_slots)
         self.queue = AdmissionQueue(queue_depth, metrics=metrics)
         self._metrics = metrics
+        # prefix caching: admission looks up the longest cached page
+        # run for every placed sequence and aliases it (the engine
+        # flips this after resolving its prefill-path policy — a warm
+        # hit resumes prefill MID-prompt, which needs a chunk-capable
+        # prefill path)
+        self.prefix_cache = bool(prefix_cache)
         self.slots = [None] * self.num_slots
         # polled-but-not-yet-placed work: new requests waiting for pages,
         # and preempted SequenceStates waiting to re-prefill (these take
@@ -209,10 +216,23 @@ class ContinuousBatchingScheduler:
         per call — the engine passes its prefill batch size, so one
         step's prefill work is one batched chunk, never a whole queue
         (prefill/decode interleaving keeps time-to-next-token bounded
-        for sequences already decoding)."""
+        for sequences already decoding).
+
+        With the prefix cache on, each placement first looks up the
+        longest cached page run for the sequence's tokens and ALIASES it
+        (adopt_prefix — zero bytes moved, refcounts bumped, prefill_pos
+        advanced past the matched span), so the page-need accounting
+        charges only the divergent suffix: total pages minus aliased
+        pages, plus one copy-on-write page when the match was clipped
+        mid-page.  The capacity gate compares against available_pages
+        (free + evictable cached runs): a resident cache can always be
+        reclaimed for admission, so it never blocks the front of the
+        line.  Preempted sequences re-match on re-admission — their own
+        prompt's cached run typically survives them, turning a
+        recompute-preemption re-prefill into a warm resume."""
         admitted = []
         committed = 0  # pages promised to THIS call's earlier admits
-        # (their prefills run after admit() returns, so num_free_pages
+        # (their prefills run after admit() returns, so available pages
         # alone would let several admits all claim the same free pages)
         while self.free_slots() > 0 and (limit is None
                                          or len(admitted) < limit):
@@ -229,10 +249,27 @@ class ContinuousBatchingScheduler:
                 if self._metrics is not None:
                     self._metrics.count_rejected_deadline()
                 continue
-            tokens = len(state.tokens if state else req.prompt)
-            # +1: room for the first decode append after prefill
-            need = self._pages_for(tokens + 1)
-            if need > self.cache.num_free_pages - committed \
+            readmitted = state is not None
+            token_list = state.tokens if state else req.prompt
+            tokens = len(token_list)
+            match_pages, match_tokens = ((), 0)
+            if self.prefix_cache:
+                match_pages, match_tokens = \
+                    self.cache.match_prefix(token_list)
+            # +1: room for the first decode append after prefill;
+            # aliased pages are free of charge, a clipped match owes
+            # its tail page's copy-on-write
+            need = self._pages_for(tokens + 1) - len(match_pages)
+            if match_tokens % self.cache.page_size:
+                need += 1
+            # matched refcount-0 pages leave the evictable set the
+            # moment adoption pins them: they must not count as BOTH
+            # aliased-for-free (excluded from need) and evictable (in
+            # available_pages), or the suffix reserve could fail after
+            # the gate passed instead of waiting in line
+            avail = (self.cache.available_pages
+                     - self.cache.evictable_pages_in(match_pages))
+            if need > avail - committed \
                     and (self.active() or self._pending or admitted):
                 # not enough pages now, but retiring sequences will free
                 # some — wait in line rather than rejecting
@@ -243,6 +280,25 @@ class ContinuousBatchingScheduler:
                 state = SequenceState(self._next_seq, req)
                 self._next_seq += 1
             self.cache.allocate(state.seq_id)
+            if match_tokens:
+                # same-step adoption: the incref pins the matched pages
+                # before any later reserve() could evict them
+                self.cache.adopt_prefix(state.seq_id, match_pages,
+                                        match_tokens)
+                state.prefill_pos = match_tokens
+            handle = state.handle
+            if getattr(handle, "prefix_hit_tokens", 0) is None:
+                # first admission stamps the handle: the serving tier
+                # reads warm-vs-cold per request, not per re-admission
+                handle.prefix_hit_tokens = match_tokens
+            if self.prefix_cache and self._metrics is not None \
+                    and not readmitted:
+                # hit counters measure CROSS-REQUEST sharing, so only
+                # first admissions count: a preempted re-admission
+                # re-matching its own run (prompt + generated tokens)
+                # would inflate the rate without any sharing — its
+                # savings are already visible in prefill_tokens_total
+                self._metrics.count_prefix_lookup(match_tokens, tokens)
             self._place(state)
             admitted.append(state)
         return admitted

@@ -1,0 +1,619 @@
+"""Prefix caching: refcounted copy-on-write page sharing across
+sequences.
+
+Acceptance oracles (all CPU, conftest forces the backend and the
+8-device host mesh):
+
+1. TOKEN IDENTITY: warm-cache generation (admission aliases cached
+   prefix pages, prefill resumes at the first unmatched token) is
+   token-identical to a cold-cache run — greedy AND seeded stochastic,
+   under forced preemption, under chunked prefill (eager and jitted),
+   with bf16 pools, both DeviceKVPool layouts, and on the 4-device CPU
+   mesh.  A warm hit changes how much prefill runs, never what the
+   sequence samples.
+2. SHARING IS PHYSICAL: N concurrent users of one system prompt hold
+   ONE physical copy of its pages (shared_pages > 0, pool occupancy far
+   below N full copies), and stats()/token_utilization() count unique
+   rows, never once per alias.
+3. REFCOUNT HYGIENE: free() is a decref; a drained engine plus a
+   flushed prefix cache returns the pool to ALL-free (the leak
+   invariant); double free stays the typed UnknownSequenceError.
+4. COW: the first divergent append into a shared page swaps in a
+   private copy — the donor's bytes never move; a missed COW is a loud
+   RuntimeError, not a silent corruption.
+5. EVICTION ORDER: refcount-0 cached runs are evicted (LRU) under pool
+   pressure BEFORE any live sequence is preempted.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.generation.kv_cache import (DeviceKVPool, PagedKVCache,
+                                            UnknownSequenceError)
+from paddle_tpu.parallel import tp_mesh
+from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402  cross-module memo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _engine(model, *, slots=4, pages=64, page_size=4, prefix=True, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size, prefix_cache=prefix,
+                               **kw)
+    return gen.GenerationEngine(model, cfg, start=False)
+
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]   # 3 full pages @ ps=4
+PROMPTS = [SYSTEM + [7, 7], SYSTEM + [1], SYSTEM + [9, 9, 9], SYSTEM]
+
+
+def _generate(eng, prompts, n=8, sampling=None, seeds=None):
+    hs = []
+    for i, p in enumerate(prompts):
+        s = sampling
+        if seeds is not None:
+            s = gen.SamplingParams(temperature=0.9, top_k=10, top_p=0.9,
+                                   seed=seeds[i])
+        hs.append(eng.submit(p, max_new_tokens=n, sampling=s))
+        eng.run_until_idle()   # sequential: later submits see the cache
+    return [h.result(timeout=5).token_ids for h in hs], hs
+
+
+# ------------------------- cache-level mechanics -------------------------
+
+
+def _seeded_cache(cls=PagedKVCache, num_pages=16, page_size=4, **kw):
+    """A cache with SYSTEM's 3 full pages prefilled+registered by a
+    donor sequence."""
+    c = cls(2, 2, 4, num_pages=num_pages, page_size=page_size, **kw)
+    rng = np.random.default_rng(0)
+    c.allocate("donor")
+    n = len(SYSTEM)
+    k = rng.standard_normal((2, n, 2, 4)).astype(np.float32)
+    v = rng.standard_normal((2, n, 2, 4)).astype(np.float32)
+    c.append_prefill("donor", k, v)
+    assert c.register_prefix("donor", SYSTEM) == 3
+    return c
+
+
+def test_match_requires_full_pages():
+    c = _seeded_cache()
+    # fewer tokens than a page: nothing to match
+    assert c.match_prefix(SYSTEM[:3]) == ((), 0)
+    # divergence inside the first page: no chain entry
+    assert c.match_prefix([99] + SYSTEM[1:]) == ((), 0)
+
+
+def test_match_longest_run_and_clip():
+    c = _seeded_cache()
+    donor_pages = c.page_table("donor")
+    # prompt extends past the cached run: all 3 full pages match
+    pages, m = c.match_prefix(SYSTEM + [7, 7])
+    assert pages == donor_pages and m == 12
+    # divergence in page 2: only the first two pages match
+    pages, m = c.match_prefix(SYSTEM[:8] + [99, 99, 99, 99, 5])
+    assert pages == donor_pages[:2] and m == 8
+    # prompt EQUALS the cached run: clipped to len-1, the tail page
+    # still aliased (its rows up to the clip are valid; first write
+    # triggers its copy-on-write)
+    pages, m = c.match_prefix(SYSTEM)
+    assert pages == donor_pages and m == 11
+
+
+def test_adopt_aliases_pages_zero_copy_and_refcounts():
+    c = _seeded_cache()
+    donor_pages = c.page_table("donor")
+    pages, m = c.match_prefix(SYSTEM + [7])
+    c.allocate("warm")
+    c.adopt_prefix("warm", pages, m)
+    # physically the SAME pages — aliasing, not copying
+    assert c.page_table("warm") == donor_pages
+    assert c.seq_len("warm") == 12
+    assert c.shared_pages == 3
+    # adopt on a non-empty sequence is a loud error
+    with pytest.raises(ValueError):
+        c.adopt_prefix("warm", pages, m)
+
+
+def test_free_is_decref_and_cached_runs_stay_resident():
+    c = _seeded_cache()
+    pages, m = c.match_prefix(SYSTEM + [7])
+    c.allocate("warm")
+    c.adopt_prefix("warm", pages, m)
+    c.free("donor")
+    # donor gone but the aliased pages survive for "warm"
+    assert c.shared_pages == 0           # refcount 1 each now
+    assert c.prefix_cached_pages == 0    # all still referenced
+    c.free("warm")
+    # last decref: registered pages stay RESIDENT at refcount 0
+    assert c.prefix_cached_pages == 3
+    assert c.num_free_pages == 16 - 3
+    # and they still match
+    assert c.match_prefix(SYSTEM + [7])[1] == 12
+
+
+def test_refcount_leak_invariant_pool_all_free_after_drain_and_flush():
+    c = _seeded_cache()
+    for i in range(3):
+        pages, m = c.match_prefix(SYSTEM + [7, i])
+        c.allocate(i)
+        c.adopt_prefix(i, pages, m)
+        c.reserve(i, 2)
+    c.free("donor")
+    for i in range(3):
+        c.free(i)
+    assert c.num_free_pages < c.num_pages   # cache still resident
+    c.flush_prefix_cache()
+    assert c.num_free_pages == c.num_pages  # the leak invariant
+    assert c.shared_pages == 0 and c.prefix_cached_pages == 0
+
+
+def test_double_free_raises_unknown_sequence_after_decref():
+    c = _seeded_cache()
+    pages, m = c.match_prefix(SYSTEM + [7])
+    c.allocate("warm")
+    c.adopt_prefix("warm", pages, m)
+    c.free("warm")
+    with pytest.raises(UnknownSequenceError):
+        c.free("warm")
+    # the double free must not have released the donor's pages: they
+    # are still intact and matchable
+    assert c.page_table("donor") == pages
+    assert c.match_prefix(SYSTEM + [7]) == (pages, 12)
+
+
+@pytest.mark.parametrize("cls", [PagedKVCache, DeviceKVPool])
+def test_cow_on_partial_page_divergence(cls):
+    """Adopting a clipped full match leaves the sequence mid-page in a
+    SHARED page; the suffix write swaps in a private copy carrying the
+    original rows, and the donor's bytes never change."""
+    c = _seeded_cache(cls)
+    donor_pool = np.asarray(c.k_pool).copy()
+    pages, m = c.match_prefix(SYSTEM)          # clipped: 11 of 12
+    c.allocate("warm")
+    c.adopt_prefix("warm", pages, m)
+    assert c.pages_needed("warm", 1) == 1      # the COW page
+    start = c.reserve("warm", 1)
+    assert start == 11
+    table = c.page_table("warm")
+    assert table[:2] == pages[:2] and table[2] != pages[2]
+    # the private copy carries the original page's rows (the clip kept
+    # rows 0..2 of it valid)
+    np.testing.assert_array_equal(np.asarray(c.k_pool)[:, table[2]],
+                                  donor_pool[:, pages[2]])
+    c.write_token("warm", 0, 11, np.full((2, 4), 7.0), np.full((2, 4), 7.0))
+    c.write_token("warm", 1, 11, np.full((2, 4), 7.0), np.full((2, 4), 7.0))
+    # donor storage untouched by the divergent write
+    np.testing.assert_array_equal(np.asarray(c.k_pool)[:, pages[2]],
+                                  donor_pool[:, pages[2]])
+    assert c.take_prefix_counters()[0] == 1
+
+
+def test_missed_cow_write_is_a_loud_error():
+    c = _seeded_cache()
+    pages, m = c.match_prefix(SYSTEM)
+    c.allocate("warm")
+    c.adopt_prefix("warm", pages, m)
+    # force the illegal state: a write landing in a shared page without
+    # reserve's COW (bypass reserve by faking the length)
+    c._lens["warm"] = 12
+    with pytest.raises(RuntimeError, match="copy-on-write"):
+        c.write_token("warm", 0, 11, np.zeros((2, 4)), np.zeros((2, 4)))
+    with pytest.raises(RuntimeError, match="copy-on-write"):
+        c.check_span_writable("warm", 11, 1)
+
+
+def test_eviction_lru_order_and_only_under_pressure():
+    c = PagedKVCache(2, 2, 4, num_pages=8, page_size=4)
+    rng = np.random.default_rng(1)
+
+    def seed_run(seq, toks):
+        c.allocate(seq)
+        k = rng.standard_normal((2, len(toks), 2, 4)).astype(np.float32)
+        c.append_prefill(seq, k, k)
+        c.register_prefix(seq, toks)
+        c.free(seq)
+
+    run_a, run_b = [1] * 4, [2] * 4
+    seed_run("a", run_a)
+    seed_run("b", run_b)
+    c.match_prefix(run_a + [9])   # touch A: B becomes the LRU run
+    assert c.prefix_cached_pages == 2 and c.num_free_pages == 6
+    c.allocate("big")
+    c.reserve("big", 26)          # needs 7 pages: must evict ONE run
+    assert c.prefix_cached_pages == 1
+    assert c.take_prefix_counters()[1] == 1
+    # LRU held: A (recently matched) survived, B was evicted
+    assert c.match_prefix(run_a + [9])[1] == 4
+    assert c.match_prefix(run_b + [9])[1] == 0
+
+
+def test_available_pages_counts_evictable_runs():
+    c = _seeded_cache()
+    c.free("donor")
+    assert c.num_free_pages == 16 - 3
+    assert c.available_pages == 16
+
+
+def test_stats_do_not_double_count_shared_pages():
+    c = _seeded_cache()
+    for i in range(3):
+        pages, m = c.match_prefix(SYSTEM + [7])
+        c.allocate(i)
+        c.adopt_prefix(i, pages, m)
+    s = c.stats()
+    # logical tokens: donor 12 + 3x12 aliased = 48; physical rows: 12
+    assert s["tokens"] == 48
+    assert s["unique_tokens"] == 12
+    assert s["shared_pages"] == 3
+    assert s["token_utilization_pct"] <= 100.0
+    assert c.token_utilization() == 1.0   # 3 pages, all rows unique-full
+
+
+# --------------------------- engine oracles ------------------------------
+
+
+def _warm_engine_run(model, prompts, n=8, seeds=None, **kw):
+    """Seed the cache with a cold pass of prompts[0], then run every
+    prompt against the warm cache; returns (tokens per prompt, handles,
+    snapshot)."""
+    eng = _engine(model, **kw)
+    _generate(eng, [prompts[0]], n=n,
+              seeds=None if seeds is None else [seeds[0]])
+    out, hs = _generate(eng, prompts, n=n, seeds=seeds)
+    snap = eng.metrics.snapshot()
+    eng.shutdown()
+    return out, hs, snap
+
+
+def test_warm_greedy_token_identical_to_cold_oracle(model):
+    """Warm-cache greedy == the sequential full-recompute reference for
+    every prompt sharing the system prefix."""
+    out, hs, snap = _warm_engine_run(model, PROMPTS)
+    for p, toks in zip(PROMPTS, out):
+        assert toks == _ref(model, p, 8)
+    # every post-seed request actually hit the cache
+    assert all(h.prefix_hit_tokens > 0 for h in hs)
+    assert snap["generation.prefix_cache_hit_tokens"] > 0
+
+
+def test_warm_hit_skips_prefill_tokens(model):
+    """The warm request prefills ONLY the divergent suffix: the
+    prefill-token counter grows by len(prompt) - matched, not
+    len(prompt)."""
+    eng = _engine(model)
+    reg = StatRegistry.instance()
+    stat = reg.get_stat(gmetrics.PREFILL_TOKENS_TOTAL)
+    _generate(eng, [SYSTEM + [7, 7]])
+    before = stat.get()
+    _, hs = _generate(eng, [SYSTEM + [8, 8, 8]])
+    assert hs[0].prefix_hit_tokens == 12
+    assert stat.get() - before == 3      # suffix only
+    eng.shutdown()
+
+
+def test_warm_stochastic_token_identical_to_cold(model):
+    """Seeded temperature/top-k/top-p streams are identical warm vs
+    cold — sampling state is per-request; the cache only changes where
+    K/V bytes come from."""
+    seeds = [41 + i for i in range(len(PROMPTS))]
+    cold = _engine(model, prefix=False)
+    cold_out, _ = _generate(cold, PROMPTS, seeds=seeds)
+    cold.shutdown()
+    warm_out, _, _ = _warm_engine_run(model, PROMPTS, seeds=seeds)
+    assert warm_out == cold_out
+
+
+def test_warm_token_identical_under_chunked_prefill(model):
+    """Chunked engine mode: warm sequences resume the chunk loop at the
+    first unmatched token (fully-matched chunks are never dispatched),
+    eager and forced-jit chunk paths alike."""
+    for kw in ({"prefill_chunk_tokens": 3},
+               {"prefill_chunk_tokens": 3, "kv_backend": "device",
+                "jit_prefill": True}):
+        out, hs, snap = _warm_engine_run(model, PROMPTS, **kw)
+        for p, toks in zip(PROMPTS, out):
+            assert toks == _ref(model, p, 8)
+        assert all(h.prefix_hit_tokens > 0 for h in hs)
+
+
+def test_warm_chunked_skips_chunk_dispatches(model):
+    """A fully-cached prefix costs ZERO chunk dispatches: the warm
+    request's chunk count covers only the divergent suffix."""
+    reg = StatRegistry.instance()
+    chunks = reg.get_stat(gmetrics.PREFILL_CHUNKS_TOTAL)
+    eng = _engine(model, prefill_chunk_tokens=3)
+    _generate(eng, [SYSTEM + [7, 7]])    # cold: ceil(14/3) = 5 chunks
+    before = chunks.get()
+    _, hs = _generate(eng, [SYSTEM + [9, 9, 9]])
+    assert hs[0].prefix_hit_tokens == 12
+    assert chunks.get() - before == 1    # 3-token suffix -> one chunk
+    eng.shutdown()
+
+
+def test_warm_token_identical_under_forced_preemption(model):
+    """A tight pool forces preemption mid-decode; victims re-match
+    their own cached prefix on re-admission and still reproduce the
+    reference stream."""
+    eng = _engine(model, pages=14, page_size=4)
+    outs = {}
+    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle()
+    preempted = 0
+    for p, h in zip(PROMPTS, hs):
+        r = h.result(timeout=5)
+        outs[tuple(p)] = r.token_ids
+        preempted += r.preemptions
+    for p in PROMPTS:
+        assert outs[tuple(p)] == _ref(model, p, 8)
+    assert preempted > 0, "pool was not tight enough to force preemption"
+    eng.shutdown()
+
+
+def test_warm_bf16_pools_match_cold_bf16(model):
+    """bf16 storage: warm aliases the SAME rounded bytes a cold prefill
+    would store — engine-vs-engine identity at storage precision."""
+    cold = _engine(model, prefix=False, kv_dtype="bfloat16")
+    cold_out, _ = _generate(cold, PROMPTS)
+    cold.shutdown()
+    out, hs, _ = _warm_engine_run(model, PROMPTS, kv_dtype="bfloat16")
+    assert out == cold_out
+    assert all(h.prefix_hit_tokens > 0 for h in hs)
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_warm_device_pools_both_layouts(model, layout):
+    """DeviceKVPool sharing is pure page-table aliasing and the COW is
+    one in-trace donated page copy — both storage layouts."""
+    out, hs, _ = _warm_engine_run(
+        model, [SYSTEM, SYSTEM], kv_backend="device", pool_layout=layout)
+    for toks in out:
+        assert toks == _ref(model, SYSTEM, 8)
+    # the exact-multiple prompt forces the clip + COW path
+    assert hs[-1].prefix_hit_tokens == len(SYSTEM) - 1
+
+
+def test_warm_fused_decode_token_identical(model):
+    """Fused single-dispatch decode over aliased pages (forced on CPU):
+    the page table carries shared pages; the scatter only ever touches
+    the private tail."""
+    out, hs, _ = _warm_engine_run(model, PROMPTS, kv_backend="device",
+                                  decode="fused")
+    for p, toks in zip(PROMPTS, out):
+        assert toks == _ref(model, p, 8)
+    assert all(h.prefix_hit_tokens > 0 for h in hs)
+
+
+def test_warm_token_identical_on_mesh(model):
+    """The 4-device CPU mesh: tensor-parallel sharded decode +
+    chunked prefill over a warm cache reproduces the single-chip
+    reference."""
+    assert len(jax.devices()) >= 4, "conftest forces 8 host devices"
+    mesh = tp_mesh(4)
+    model4 = gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=4,
+                              head_dim=8, seed=3)
+    out, hs, _ = _warm_engine_run(model4, PROMPTS, mesh=mesh,
+                                  prefill_chunk_tokens=3,
+                                  jit_prefill=True)
+    for p, toks in zip(PROMPTS, out):
+        assert toks == _ref(model4, p, 8)
+    assert all(h.prefix_hit_tokens > 0 for h in hs)
+
+
+# ----------------------- sharing & eviction, engine-level ----------------
+
+
+def test_shared_system_prompt_holds_one_physical_copy(model):
+    """N concurrent users of one system prompt: the system pages exist
+    ONCE; per-user cost is the suffix only."""
+    eng = _engine(model, slots=4, pages=64)
+    _generate(eng, [SYSTEM + [99]])      # seed the cache
+    base = eng.cache.pages_in_use
+    hs = [eng.submit(SYSTEM + [50 + i], max_new_tokens=4)
+          for i in range(4)]
+    # step until every prompt is admitted+prefilled (decode pending)
+    for _ in range(64):
+        eng.step()
+        if all(h.first_token_s is not None for h in hs):
+            break
+    assert eng.cache.shared_pages >= 3   # the 3 system pages, aliased
+    snap = eng.metrics.snapshot()
+    assert snap["generation.shared_pages"] >= 3
+    # 4 users added far fewer pages than 4 full copies would
+    added = eng.cache.pages_in_use - base
+    full_copy = -(-len(SYSTEM + [50]) // 4)
+    assert added < 4 * full_copy
+    eng.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    eng.shutdown()
+
+
+def test_engine_pool_all_free_after_drain_and_flush(model):
+    """The engine-level leak invariant: drain everything, flush the
+    cache, pool returns to all-free."""
+    eng = _engine(model)
+    _generate(eng, PROMPTS)
+    _generate(eng, PROMPTS)              # warm second wave
+    assert eng.cache.pages_in_use > 0    # cached runs resident
+    assert eng.cache.prefix_cached_pages == eng.cache.pages_in_use
+    eng.cache.flush_prefix_cache()
+    assert eng.cache.num_free_pages == eng.cache.num_pages
+    eng.shutdown()
+
+
+def test_eviction_under_pool_pressure_before_preemption(model):
+    """A resident cache is never a reason to preempt: when a new
+    admission needs pages the cache holds, refcount-0 runs are evicted
+    and no live sequence is preempted."""
+    eng = _engine(model, slots=2, pages=10, page_size=4)
+    reg = StatRegistry.instance()
+    preempt = reg.get_stat(gmetrics.PREEMPTED_TOTAL)
+    evict = reg.get_stat(gmetrics.PREFIX_EVICTIONS)
+    _generate(eng, [SYSTEM])             # 3 pages stay cached
+    assert eng.cache.prefix_cached_pages == 3
+    before_p, before_e = preempt.get(), evict.get()
+    # a divergent long prompt that cannot fit alongside the cache
+    out, _ = _generate(eng, [[40, 41, 42, 43, 44, 45, 46, 47] * 3])
+    assert evict.get() - before_e > 0
+    assert preempt.get() - before_p == 0
+    eng.shutdown()
+
+
+def test_handle_prefix_hit_tokens_cold_and_warm(model):
+    """Per-request warm/cold observability on the handle: cold = 0,
+    warm = matched token count, stamped at FIRST admission."""
+    eng = _engine(model)
+    h_cold = eng.submit(SYSTEM + [7], max_new_tokens=2)
+    eng.run_until_idle()
+    h_warm = eng.submit(SYSTEM + [8], max_new_tokens=2)
+    eng.run_until_idle()
+    assert h_cold.prefix_hit_tokens == 0
+    assert h_warm.prefix_hit_tokens == 12
+    h_cold.result(timeout=5), h_warm.result(timeout=5)
+    eng.shutdown()
+
+
+def test_prefix_metrics_in_snapshot(model):
+    """All five prefix metrics land in the generation.* snapshot."""
+    out, _, snap = _warm_engine_run(model, [SYSTEM, SYSTEM])
+    # seed pass is cold; both measured prompts then hit len-1 each
+    assert snap["generation.prefix_cache_hit_tokens"] == \
+        2 * (len(SYSTEM) - 1)
+    assert 0 < snap["generation.prefix_cache_hit_rate"] < 1
+    assert snap["generation.cow_copies"] >= 1     # the clipped match
+    assert "generation.shared_pages" in snap
+    assert "generation.prefix_evictions" in snap
+
+
+def test_prefix_cache_off_is_inert(model):
+    """prefix_cache=False: no hits, no sharing, identical output — the
+    cold path is untouched."""
+    eng = _engine(model, prefix=False)
+    out1, hs = _generate(eng, [SYSTEM, SYSTEM])
+    assert out1[0] == out1[1] == _ref(model, SYSTEM, 8)
+    assert all(h.prefix_hit_tokens == 0 for h in hs)
+    assert eng.cache.shared_pages == 0
+    # drained pool returns to all-free with no flush needed
+    assert eng.cache.num_free_pages == eng.cache.num_pages
+    eng.shutdown()
+
+
+def test_prefix_cache_requires_resume_capable_path():
+    """prefix_cache=True without any mid-prompt prefill path is a loud
+    config error, not a silent no-op."""
+
+    class NoChunkModel(gen.TinyCausalLM):
+        prefill_chunk = property()       # hide the chunk protocol
+
+    m = NoChunkModel(vocab_size=32, num_layers=1, num_heads=2, head_dim=4)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        gen.GenerationEngine(m, gen.GenerationConfig(
+            prefix_cache=True, prefill_chunk_tokens=0), start=False)
+    # but chunked prefill makes it legal even without eager chunks
+    eng = gen.GenerationEngine(m, gen.GenerationConfig(
+        prefix_cache=True, prefill_chunk_tokens=2, kv_backend="device",
+        jit_prefill=True), start=False)
+    assert eng.prefix_cache_enabled
+    eng.shutdown()
+
+
+def test_warm_admission_waits_for_pages_instead_of_failing(model):
+    """The admission gate must not double-count a match's own cached
+    pages: they are excluded from the page need (aliased for free) AND
+    leave the evictable set the moment adoption pins them.  When the
+    divergent suffix cannot fit after pinning, the request WAITS IN
+    LINE — and completes once a live sequence retires — rather than
+    passing the gate and then hard-failing its reserve with
+    OutOfPagesError."""
+    eng = _engine(model, slots=2, pages=8, page_size=4)
+    _generate(eng, [SYSTEM])                 # 3 pages cached (refs 0)
+    other = [30 + i for i in range(12)]
+    h_a = eng.submit(other, max_new_tokens=4)
+    for _ in range(32):                      # prefill A (3 pages)...
+        eng.step()
+        if h_a.first_token_s is not None:
+            break
+    eng.step()                               # ...and start decode: page 4
+    # free = 1, evictable = 3 (the match's own pages): B needs 2 fresh
+    # pages for its suffix, so it must wait for A, not fail
+    suffix = [21, 22, 23, 24, 25, 26]
+    h_b = eng.submit(SYSTEM + suffix, max_new_tokens=3)
+    eng.run_until_idle()
+    assert h_a.result(timeout=5).token_ids == _ref(model, other, 4)
+    assert h_b.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + suffix, 3)
+    assert h_b.prefix_hit_tokens == len(SYSTEM)
+    eng.shutdown()
+
+
+def test_hit_rate_counts_first_admissions_only(model):
+    """The hit-rate gauge measures CROSS-REQUEST sharing: a preempted
+    sequence re-matching its own cached run must not inflate it."""
+    eng = _engine(model, slots=4, pages=14, page_size=4)
+    reg = StatRegistry.instance()
+    hit = reg.get_stat(gmetrics.PREFIX_CACHE_HIT_TOKENS)
+    # four prompts sharing NO full page with each other: any hit could
+    # only come from a re-admission re-matching its own run
+    prompts = [[10 + i] * 12 for i in range(4)]
+    hs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run_until_idle()
+    preempted = sum(h.result(timeout=5).preemptions for h in hs)
+    assert preempted > 0, "pool was not tight enough to force preemption"
+    # every prompt was COLD at first admission (nothing cached before
+    # the wave): re-admission warm resumes must not count as hits
+    assert hit.get() == 0
+    eng.shutdown()
+
+
+def test_reset_pools_flushes_the_prefix_index():
+    """Poisoned-dispatch recovery: reset_pools re-zeroes the storage,
+    so every cached run indexed against the OLD bytes must die with it
+    — a stale index entry would let a later warm hit silently generate
+    from zeroed pages."""
+    c = _seeded_cache(DeviceKVPool, num_pages=16)
+    c.free("donor")
+    assert c.match_prefix(SYSTEM + [7])[1] == 12
+    c.reset_pools()
+    assert c.match_prefix(SYSTEM + [7]) == ((), 0)
+    assert c.num_free_pages == c.num_pages
+    assert c.prefix_cached_pages == 0
+
+
+def test_preempted_sequence_warm_resumes_from_its_own_run(model):
+    """Recompute preemption composes with the cache: the victim's
+    prompt pages survive it (cached), so its re-prefill is a warm
+    resume instead of a full recompute."""
+    eng = _engine(model, slots=2, pages=16, page_size=4)
+    reg = StatRegistry.instance()
+    pf = reg.get_stat(gmetrics.PREFILL_TOKENS_TOTAL)
+    _generate(eng, [SYSTEM])             # cache the system pages
+    h1 = eng.submit(SYSTEM + [7], max_new_tokens=10)
+    h2 = eng.submit(SYSTEM + [8], max_new_tokens=10)
+    eng.run_until_idle()
+    r1, r2 = h1.result(timeout=5), h2.result(timeout=5)
+    assert r1.token_ids == _ref(model, SYSTEM + [7], 10)
+    assert r2.token_ids == _ref(model, SYSTEM + [8], 10)
+    # total prefill tokens stayed far below the cold bill (every
+    # admission, including any preemption re-prefill, was warm)
+    cold_bill = len(SYSTEM) + 2 * (len(SYSTEM) + 1)
+    assert pf.get() < cold_bill
+    eng.shutdown()

@@ -23,6 +23,13 @@ Usage:
         # long prompt streams in — reporting time-to-first-token per
         # request, decode tokens/s DURING the long prefill, and the
         # prefill compile count (chunked: O(1) in prompt length)
+    python tools/gen_bench.py --prefix both
+        # prefix-cache A/B: a shared-system-prompt workload (N users,
+        # one long system prefix, distinct short suffixes) run with
+        # the cache off and on — per-cell prefix hit tokens, cold vs
+        # warm TTFT, prefill tokens computed, live shared_pages and
+        # COW copies; warm cells pay prefill only for the divergent
+        # suffix
     python tools/gen_bench.py --mesh both
         # single-chip vs TENSOR-PARALLEL sharded decode A/B: the same
         # grid run unsharded (tp_degree 1) and over a head-sharded
@@ -302,6 +309,97 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
     return cell
 
 
+def bench_prefix(model, users, sys_tokens, user_tokens, new_tokens,
+                 page_size, pool, prefix_on, chunk_tokens):
+    """The prefix-cache A/B scenario: `users` requests share one
+    `sys_tokens`-token system prompt with distinct `user_tokens`-token
+    suffixes — the production shape (system prompts, few-shot
+    templates, multi-turn history re-sent per request).  Reports the
+    cold TTFT (the request that seeds the cache), the warm-wave TTFT
+    average, prefill tokens computed for the warm wave (warm: suffix
+    only), per-request hit tokens, and the LIVE shared-page count
+    while every user holds its slot — the one-physical-copy proof.
+
+    Compile/trace cost is paid by a throwaway request with the same
+    shapes but disjoint tokens (it can never warm the measured
+    prompts), so cold-vs-warm TTFT is prefill work, not compile
+    wall."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.generation import metrics as gmetrics
+    from paddle_tpu.profiler.monitor import StatRegistry
+
+    total = sys_tokens + user_tokens + new_tokens
+    pages = (-(-total // page_size) + 2) * (users + 1)
+    eng = g.GenerationEngine(
+        model,
+        g.GenerationConfig(max_decode_slots=users, num_pages=pages,
+                           page_size=page_size, queue_depth=users * 2,
+                           kv_backend=pool, prefix_cache=prefix_on,
+                           prefill_chunk_tokens=chunk_tokens),
+        start=False)
+    rng = np.random.default_rng(sys_tokens * 31 + users)
+    half = model.vocab_size // 2
+    system = rng.integers(0, half, sys_tokens).tolist()
+    suffixes = [rng.integers(0, half, user_tokens).tolist()
+                for _ in range(users)]
+    # throwaway: same shapes, tokens from the other half of the vocab
+    # (disjoint from `system`, so it cannot pre-warm the measured wave)
+    throwaway = rng.integers(half, model.vocab_size, total
+                             - new_tokens).tolist()
+    eng.submit(throwaway, max_new_tokens=new_tokens)
+    eng.run_until_idle()
+    reg = StatRegistry.instance()
+    pf_stat = reg.get_stat(gmetrics.PREFILL_TOKENS_TOTAL)
+    # cold request: seeds the cache (when on) and is the cold baseline
+    pf_before = pf_stat.get()
+    h_cold = eng.submit(system + suffixes[0], max_new_tokens=new_tokens)
+    eng.run_until_idle()
+    h_cold.result(timeout=5)
+    cold_prefill = int(pf_stat.get() - pf_before)
+    # warm wave: every user shares the system prompt
+    pf_before = pf_stat.get()
+    hs = [eng.submit(system + sfx, max_new_tokens=new_tokens)
+          for sfx in suffixes[1:]]
+    shared_live = 0
+    for _ in range(64 + users * (-(-total // max(chunk_tokens, 1)))):
+        eng.step()
+        shared_live = max(shared_live, eng.cache.shared_pages)
+        if all(h.first_token_s is not None for h in hs):
+            break
+    eng.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    warm_prefill = int(pf_stat.get() - pf_before)
+    snap = eng.metrics.snapshot()
+    eng.shutdown()
+    return {
+        "scenario": "prefix",
+        "prefix": "on" if prefix_on else "off",
+        "pool": pool,
+        "users": users,
+        "sys_tokens": sys_tokens,
+        "user_tokens": user_tokens,
+        "new_tokens": new_tokens,
+        "ttft_cold_s": round(h_cold.first_token_s - h_cold.submitted_s, 4),
+        "ttft_warm_avg_s": round(
+            sum(h.first_token_s - h.submitted_s for h in hs)
+            / max(len(hs), 1), 4),
+        # prefill tokens computed: cold pays the whole prompt; a warm
+        # hit pays only the divergent suffix
+        "cold_prefill_tokens": cold_prefill,
+        "warm_prefill_tokens": warm_prefill,
+        "warm_prefill_tokens_per_user": round(
+            warm_prefill / max(len(hs), 1), 1),
+        "hit_tokens": sum(h.prefix_hit_tokens or 0 for h in hs),
+        "hit_rate": snap.get("generation.prefix_cache_hit_rate", 0.0),
+        # one physical copy: peak pages aliased by >1 sequence while
+        # the whole wave held slots
+        "shared_pages_live": shared_live,
+        "cow_copies": snap.get("generation.cow_copies", 0),
+        "prefix_evictions": snap.get("generation.prefix_evictions", 0),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="1,4,8")
@@ -333,6 +431,17 @@ def main():
                          "in")
     ap.add_argument("--chunk-tokens", type=int, default=32,
                     help="chunk size for --prefill chunked/both")
+    ap.add_argument("--prefix", choices=("off", "on", "both"),
+                    default="off",
+                    help="prefix-cache A/B: a shared-system-prompt "
+                         "workload (one long system prefix, distinct "
+                         "short user suffixes) per pool backend — warm "
+                         "vs cold TTFT, prefill tokens computed, hit "
+                         "tokens, live shared_pages, COW copies; "
+                         "'both' emits an off and an on cell")
+    ap.add_argument("--prefix-users", type=int, default=8,
+                    help="concurrent users sharing the system prompt "
+                         "in the --prefix scenario")
     ap.add_argument("--mesh", default="1",
                     help="tensor-parallel A/B: '1' (unsharded), 'N' "
                          "(head-sharded over every visible device), "
@@ -441,6 +550,25 @@ def main():
         series = f"{pool}/{decode}/{prefill}" + (
             f"/tp{tp}" if tp > 1 else "")
         stats_by_series[series] = reg.stats_snapshot("generation.")
+    if args.prefix != "off":
+        # the shared-system-prompt A/B: chunked prefill (warm hits
+        # resume mid-prompt through the chunk loop), one cell per
+        # (pool, cache mode); system prompt 2x the largest context
+        modes = (("off", "on") if args.prefix == "both"
+                 else (args.prefix,))
+        sys_tokens = max(contexts) * 2
+        for pool in pools:
+            for mode in modes:
+                for name in list(reg.stats()):
+                    if name.startswith("generation."):
+                        reg.get_stat(name).reset()
+                grid.append(bench_prefix(
+                    model, args.prefix_users, sys_tokens, 8,
+                    args.new_tokens, args.page_size, pool,
+                    prefix_on=(mode == "on"),
+                    chunk_tokens=args.chunk_tokens))
+                stats_by_series[f"{pool}/prefix-{mode}"] = \
+                    reg.stats_snapshot("generation.")
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
@@ -451,6 +579,7 @@ def main():
         "prefills": list(prefills),
         "tp_degrees": list(tps),
         "chunk_tokens": args.chunk_tokens,
+        "prefix": args.prefix,
         "grid": grid,
         "stats": stats_by_series,
     }

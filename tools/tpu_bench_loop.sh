@@ -39,15 +39,23 @@ for i in $(seq 1 "$MAX"); do
     # dispatches_per_step per cell, warmup/compile time separate),
     # --prefill both lands the full-vs-chunked prefill A/B (TTFT +
     # decode tokens/s during a long-prompt prefill via the interleave
-    # cell, prefill compile counts) and --mesh both lands the
+    # cell, prefill compile counts), --mesh both lands the
     # single-chip-vs-tensor-parallel sharded decode A/B (tokens/s and
     # dispatches/step vs tp_degree over the real multi-chip mesh, plus
     # collective_bytes_per_step — the first hardware number for the
-    # GSPMD decode collectives) in the same artifact
-    timeout 1800 python tools/gen_bench.py --pool both --decode both \
-      --prefill both --mesh both --out "${OUT%.json}_gen.json" \
+    # GSPMD decode collectives), and --prefix both lands the
+    # prefix-cache A/B (shared-system-prompt workload: warm vs cold
+    # TTFT, prefill tokens computed, hit tokens, live shared_pages)
+    # in the same artifact
+    # budget grew with the prefix A/B cells: a timeout kill here drops
+    # the WHOLE gen artifact (mesh/prefill numbers included), so the
+    # cap tracks the scenario count and a kill at least says so
+    timeout 2700 python tools/gen_bench.py --pool both --decode both \
+      --prefill both --mesh both --prefix both \
+      --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh A/B) -> ${OUT%.json}_gen.json"
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix A/B) -> ${OUT%.json}_gen.json" \
+      || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
   echo "[tpu-bench-loop] bench ran but no TPU number (tail: ${line:0:120}); sleeping ${SLEEP}s"
