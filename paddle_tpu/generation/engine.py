@@ -312,6 +312,16 @@ class GenerationHandle:
     def exception(self, timeout=None):
         return self._fut.exception(timeout)
 
+    def add_done_callback(self, fn):
+        """Run ``fn(handle)`` once the request resolves — result OR
+        typed failure (concurrent.futures callback semantics: called
+        immediately if already done).  The fleet tier hangs its
+        route-confirmation hook here: prefix_hit_tokens is stamped at
+        first admission, so a completed handle tells the router whether
+        a prefix-affinity bet actually paid (docs/SERVING.md "Fleet
+        tier")."""
+        self._fut.add_done_callback(lambda _f: fn(self))
+
     def tokens(self, timeout=None):
         """Yield token ids as they stream; `timeout` bounds the wait for
         EACH token (queue.Empty on a stall)."""
@@ -548,10 +558,19 @@ class GenerationEngine:
 
     # --------------------------- client API -------------------------
     def submit(self, prompt, max_new_tokens=None, sampling=None,
-               stop_tokens=(), timeout_ms=None):
+               stop_tokens=(), timeout_ms=None, handle=None):
         """Enqueue one prompt; returns a GenerationHandle immediately.
         Raises ServerBusyError (queue full) / RequestTooLargeError
-        (prompt can never fit the page pool) synchronously."""
+        (prompt can never fit the page pool) synchronously.
+
+        `handle` lets a CALLER supply the handle object the engine
+        drives (anything duck-typing the engine-side surface:
+        _push_token/_finish/set_exception/done plus the submitted_s /
+        first_token_s / prefix_hit_tokens attributes) — the hook the
+        fleet tier uses so one client-held handle can survive a
+        drain-migration cold resubmit on a sibling replica
+        (serving/fleet.py).  submitted_s is stamped only when unset, so
+        a resubmitted request keeps its original TTFT clock."""
         if self._closed:
             raise ServingError("generation engine is shut down")
         if max_new_tokens is None:
@@ -568,8 +587,10 @@ class GenerationEngine:
                 f"prompt of {len(prompt)} + max_new_tokens="
                 f"{max_new_tokens} exceeds the model's max_positions="
                 f"{max_pos}")
-        handle = GenerationHandle()
-        handle.submitted_s = time.monotonic()
+        if handle is None:
+            handle = GenerationHandle()
+        if handle.submitted_s is None:
+            handle.submitted_s = time.monotonic()
         req = GenerationRequest(prompt, handle, sampling,
                                 max_new_tokens=max_new_tokens,
                                 stop_tokens=stop_tokens, deadline=deadline)
@@ -586,6 +607,32 @@ class GenerationEngine:
         snap = self.metrics.snapshot()
         snap.update({"cache." + k: v for k, v in self.cache.stats().items()})
         return snap
+
+    def evacuate(self, include_active=False):
+        """Atomically extract unfinished work for a fleet-tier drain
+        (serving/fleet.py): every NOT-YET-PLACED request (admission
+        queue + the pending re-prefill line) always, plus — when
+        `include_active` — every live slot-holder, which is retired
+        here (slot and pages freed) WITHOUT resolving its handle.
+        Returns ``[(GenerationRequest, n_emitted)]``; the caller owns
+        resubmitting each request (sampling is seeded per request, so a
+        cold resubmit replays the identical stream and the first
+        `n_emitted` tokens — already streamed to the client — can be
+        skipped by a relay handle).  Runs under the step lock, so no
+        token can land on an extracted request after this returns.
+        Expired requests are reaped with the typed deadline error
+        instead of being returned."""
+        with self._lock:
+            out = self.scheduler.take_pending()
+            if include_active:
+                for state in self.scheduler.active():
+                    self.scheduler.retire(state)
+                    if state.request.expired():
+                        state.request.reject_expired()
+                        self.metrics.count_rejected_deadline()
+                        continue
+                    out.append((state.request, state.n_generated))
+            return out
 
     # --------------------------- stepping ---------------------------
     def step(self):
@@ -823,14 +870,32 @@ class GenerationEngine:
 
     def _register_prefix(self, state):
         """Index the completed prompt's full pages for future matches
-        (no-op when prefix caching is off).  Only PROMPT tokens are
-        indexed: a post-preemption re-prefill covers generated tokens
-        too, but indexing those would grow the cache with content no
-        other request has asked for — decode-tail indexing is the
-        tracked ROADMAP follow-on."""
+        (no-op when prefix caching is off).  Registration happens at
+        prefill COMPLETION — not retire — so concurrent requests
+        sharing the prompt alias it while this sequence still decodes.
+        Only PROMPT tokens are indexed here; the decode tail joins the
+        index at retire (_register_decode_tail), when the generated
+        pages are final."""
         if self.prefix_cache_enabled:
-            self.cache.register_prefix(
-                state.seq_id, state.tokens[:len(state.request.prompt)])
+            self.metrics.count_prefix_registered(self.cache.register_prefix(
+                state.seq_id, state.tokens[:len(state.request.prompt)]))
+
+    def _register_decode_tail(self, state):
+        """Decode-tail indexing: at retire, extend the sequence's
+        cached run over full pages of GENERATED tokens too.  A
+        multi-turn client that re-sends the assistant turn verbatim
+        (prompt_2 = prompt_1 + answer_1 + user_2) then warm-hits past
+        the old prompt into the answer it was just streamed — the
+        ROADMAP decode-tail follow-on.  Valid for the same reason
+        prompt pages are: causal attention makes a position's K/V a
+        function of the token prefix alone, and a retired sequence's
+        pages are final.  register_prefix clips to full pages AND to
+        the cache length, so the newest sampled token (never decoded,
+        so never written) and a stop-finish's unappended stop token
+        are naturally excluded."""
+        if self.prefix_cache_enabled and self.cache.has(state.seq_id):
+            self.metrics.count_prefix_registered(
+                self.cache.register_prefix(state.seq_id, state.tokens))
 
     # ------------------------ chunked prefill -----------------------
     def _prefill_chunk_step(self, state, n):
@@ -1120,6 +1185,7 @@ class GenerationEngine:
             self._apply_token(state, int(token))
 
     def _finish(self, state, reason):
+        self._register_decode_tail(state)
         self.scheduler.retire(state)
         req = state.request
         result = GenerationResult(

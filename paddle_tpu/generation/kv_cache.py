@@ -39,6 +39,7 @@ append (``reserve`` swaps in a private copy before any write can land),
 and refcount-0 runs stay RESIDENT as an LRU cache evicted only under
 pool pressure — docs/GENERATION.md "Prefix caching".
 """
+import heapq
 import math
 
 import numpy as np
@@ -79,9 +80,13 @@ class _PrefixNode:
     these tokens (valid for ANY sequence whose prefix matches: causal
     attention makes a position's K/V a function of the token prefix
     alone).  `last_use` orders LRU eviction; `children` counts cached
-    child nodes so eviction can peel leaves first."""
+    child nodes so eviction can peel leaves first; `queued` marks a
+    live entry in the evictable-leaf heap (at most one per node — the
+    dedup that keeps the heap bounded by the trie size, not by the
+    adopt/free churn of the warm steady state)."""
 
-    __slots__ = ("page", "key", "parent", "ident", "children", "last_use")
+    __slots__ = ("page", "key", "parent", "ident", "children", "last_use",
+                 "queued")
 
     def __init__(self, page, key, parent, ident):
         self.page = page
@@ -90,6 +95,7 @@ class _PrefixNode:
         self.ident = ident
         self.children = 0
         self.last_use = 0
+        self.queued = False
 
 
 class PagedKVCache:
@@ -137,6 +143,13 @@ class PagedKVCache:
         # of scanning the refcount dict
         self._n_shared = 0   # pages with refcount > 1
         self._n_cached = 0   # refcount-0 registered residents
+        # incrementally-maintained min-heap of evictable LEAF nodes,
+        # entries (last_use_at_push, ident, node): pushed at the exact
+        # refcount/trie transitions that make a node evictable (last
+        # decref to 0; dropping a node's last child), validated lazily
+        # at pop — so a pressured reserve pays O(log n) per evicted
+        # page instead of re-seeding a heap with a full trie scan
+        self._evict_heap = []
         self._init_pools()
 
     def _init_pools(self):
@@ -267,6 +280,11 @@ class PagedKVCache:
             self._refs[page] = 0
             self._n_cached += 1
             node.last_use = self._tick()
+            if node.children == 0:
+                # the node just became an evictable LEAF — queue it at
+                # its current recency (interior refcount-0 nodes queue
+                # later, when _drop_node peels their last child)
+                self._push_evictable(node)
         else:
             self._refs.pop(page, None)
             self._free.append(page)
@@ -405,46 +423,68 @@ class PagedKVCache:
             parent, parent_ident = node, node.ident
         return added
 
+    def _push_evictable(self, node):
+        """Queue an evictable leaf at its current recency.  `queued`
+        dedups: a node holds at most ONE live heap entry, so the warm
+        steady state's adopt/free churn (decref-to-0 per request, the
+        regime that never triggers eviction to drain the heap) cannot
+        grow the heap past the trie size.  Entries are validated (and
+        stale recencies re-queued) lazily at pop, so a node that is
+        touched, re-adopted, or evicted after the push costs one
+        discarded heap entry, never a scan."""
+        if node.queued:
+            return
+        node.queued = True
+        heapq.heappush(self._evict_heap,
+                       (node.last_use, node.ident, node))
+
     def _evict_prefix(self, n_pages):
         """Evict up to `n_pages` refcount-0 cached pages to the free
         list, least-recently-used LEAF nodes first (a refcount-0 node's
         descendants are refcount-0 too — any sequence aliasing a child
         aliases the parent — so peeling leaves always makes progress).
-        One scan seeds a min-heap of evictable leaves; dropping a leaf
-        pushes its parent when that became an evictable leaf in turn —
-        O(nodes + K log K) for a K-page eviction, not K rescans.
+        The evictable-leaf heap is maintained INCREMENTALLY at the
+        refcount/trie transitions (_decref to 0, _drop_node peeling a
+        parent), so a K-page eviction round is O(K log n) pops — never
+        the O(nodes) trie rescan a large half-warm index used to pay on
+        every pressured reserve.  Entries are validated at pop: nodes
+        since re-adopted, grown a child, or dropped are discarded, and
+        a node merely TOUCHED since its push (match_prefix recency) is
+        re-queued at its current last_use so LRU order holds exactly.
         Returns pages actually freed."""
-        import heapq
-
         if self._n_cached == 0:
             # nothing evictable (every indexed page is pinned by a live
-            # sequence): skip the trie scan — this branch runs on every
-            # pressured reserve, per decode token, under exactly the
-            # warm steady-state load the cache targets
+            # sequence): this branch runs on every pressured reserve,
+            # per decode token, under exactly the warm steady-state
+            # load the cache targets
             return 0
-        heap = [(nd.last_use, nd.ident, nd) for nd in self._nodes.values()
-                if nd.children == 0 and self._refs.get(nd.page, 1) == 0]
-        heapq.heapify(heap)
+        heap = self._evict_heap
         freed = 0
         while freed < n_pages and heap:
-            _, _, node = heapq.heappop(heap)
+            last_use, _, node = heapq.heappop(heap)
+            node.queued = False   # its one live entry just left the heap
             if self._nodes.get(node.key) is not node or node.children \
                     or self._refs.get(node.page, 1) != 0:
-                continue  # stale entry
-            parent = node.parent
+                continue  # stale entry: evicted, re-adopted, or grew
+            if last_use != node.last_use:
+                # touched since queued: re-queue at its true recency so
+                # a recently-matched run outlives a colder sibling
+                self._push_evictable(node)
+                continue
             self._drop_node(node)
             freed += 1
-            if parent is not None and parent.children == 0 \
-                    and self._refs.get(parent.page, 1) == 0:
-                heapq.heappush(heap,
-                               (parent.last_use, parent.ident, parent))
         return freed
 
     def _drop_node(self, node):
         del self._nodes[node.key]
         del self._page_node[node.page]
-        if node.parent is not None:
-            node.parent.children -= 1
+        parent = node.parent
+        if parent is not None:
+            parent.children -= 1
+            if parent.children == 0 \
+                    and self._refs.get(parent.page, 1) == 0:
+                # the parent just became an evictable leaf in turn
+                self._push_evictable(parent)
         del self._refs[node.page]     # refcount 0 (eviction precondition)
         self._n_cached -= 1
         self._free.append(node.page)
@@ -469,6 +509,7 @@ class PagedKVCache:
                 freed += 1
         self._nodes.clear()
         self._page_node.clear()
+        self._evict_heap = []   # every queued node is gone with the trie
         return freed
 
     def take_prefix_counters(self):

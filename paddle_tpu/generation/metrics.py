@@ -72,6 +72,12 @@ Metric names:
 - ``generation.prefix_evictions``     cached refcount-0 pages evicted
                                       back to the free list under pool
                                       pressure (LRU, before preemption)
+- ``generation.prefix_pages_registered``  pages newly indexed into the
+                                      prefix trie — prompt pages at
+                                      prefill completion plus the
+                                      decode-tail pages indexed at
+                                      retire (generated tokens a
+                                      multi-turn client re-sends)
 - ``generation.mesh_devices``         gauge: tensor-parallel degree of
                                       the engine's mesh (1 unsharded)
 - ``generation.collective_bytes_per_step``  gauge: estimated on-wire
@@ -121,6 +127,7 @@ PREFIX_CACHE_HIT_RATE = PREFIX + "prefix_cache_hit_rate"
 SHARED_PAGES = PREFIX + "shared_pages"
 COW_COPIES = PREFIX + "cow_copies"
 PREFIX_EVICTIONS = PREFIX + "prefix_evictions"
+PREFIX_PAGES_REGISTERED = PREFIX + "prefix_pages_registered"
 
 
 class GenerationMetrics:
@@ -213,6 +220,13 @@ class GenerationMetrics:
 
     def count_prefix_evictions(self, n=1):
         stat = self._stat(PREFIX_EVICTIONS)
+        if n:
+            stat.increase(int(n))
+
+    def count_prefix_registered(self, n):
+        """Pages newly indexed into the prefix trie (prompt pages at
+        prefill completion, decode-tail pages at retire)."""
+        stat = self._stat(PREFIX_PAGES_REGISTERED)
         if n:
             stat.increase(int(n))
 

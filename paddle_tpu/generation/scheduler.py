@@ -347,6 +347,36 @@ class ContinuousBatchingScheduler:
     def pending_count(self):
         return len(self._pending) + len(self.queue)
 
+    def take_pending(self):
+        """Pull every NOT-YET-PLACED item out of the scheduler — the
+        pending line first (preempted sequences have seniority), then
+        the admission queue in FIFO order — for a fleet-tier drain
+        (engine.evacuate): the caller resubmits each request elsewhere.
+        Returns ``[(GenerationRequest, n_emitted)]`` where `n_emitted`
+        is how many tokens the request has already streamed (nonzero
+        only for preempted SequenceStates; their pages were freed at
+        preemption, so nothing else needs releasing).  Expired requests
+        are reaped with the typed deadline error on the way, exactly as
+        a queue poll would."""
+        out = []
+        while self._pending:
+            item = self._pending.popleft()
+            if isinstance(item, SequenceState):
+                if item.request.expired():
+                    item.request.reject_expired()
+                    if self._metrics is not None:
+                        self._metrics.count_rejected_deadline()
+                    continue
+                out.append((item.request, item.n_generated))
+            else:
+                out.append((item, 0))
+        while True:
+            req = self.queue.poll(timeout=0)   # reaps expired itself
+            if req is None:
+                break
+            out.append((req, 0))
+        return out
+
     def close(self):
         """Reject everything still queued (typed shutdown error)."""
         self.queue.close()

@@ -24,6 +24,7 @@ from .admission import (AdmissionQueue, DeadlineExceededError, Request,
 from .batcher import DynamicBatcher
 from .bucketing import CompiledModelCache, ShapeBucketer
 from .engine import ServingConfig, ServingEngine, create_serving_engine
+from .fleet import FleetConfig, FleetMetrics, FleetRouter, ReplicaSpec
 from .metrics import LatencyReservoir, ServingMetrics
 
 __all__ = [
@@ -31,6 +32,7 @@ __all__ = [
     "DynamicBatcher", "AdmissionQueue", "Request",
     "ShapeBucketer", "CompiledModelCache",
     "ServingMetrics", "LatencyReservoir",
+    "FleetRouter", "FleetConfig", "FleetMetrics", "ReplicaSpec",
     "ServingError", "ServerBusyError", "DeadlineExceededError",
     "RequestTooLargeError",
 ]

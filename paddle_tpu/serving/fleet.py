@@ -1,0 +1,644 @@
+"""Fleet tier: multi-replica generation serving with prefix-affinity
+and SLO-aware routing.
+
+Everything below `serving/` and `generation/` batches inside ONE
+process: a single `GenerationEngine` owns one KV pool, one prefix
+index, one admission queue.  Heavy traffic needs N engine replicas —
+possibly heterogeneous (a long-context replica and a low-latency
+replica behind one API) — and a front door that makes page-locality
+decisions an engine cannot see: which replica already holds a session's
+warm pages, which one likely has a prompt's system prefix indexed,
+which one has slack.  The FleetRouter is that front door::
+
+    submit(prompt, session=...) ── routing ladder ──> replica engine
+         <- GenerationHandle           │                (its own pools,
+            (same streaming            │                 prefix index,
+             contract)                 │                 AdmissionQueue)
+                                       ▼
+          1. SESSION AFFINITY   a session id pins follow-up turns to
+                                the replica holding their warm pages
+          2. PREFIX AFFINITY    hash of the prompt's leading page-
+                                aligned tokens prefers the replica
+                                whose prefix index LIKELY holds it —
+                                measured, not assumed: the router
+                                confirms every prefix bet against the
+                                handle's prefix_hit_tokens stamp
+          3. LEAST LOADED       queue depth + resident pages
+          spill                 a full first choice falls through the
+                                remaining candidates by load
+          shed                  every candidate's admission gate
+                                closed -> fleet.shed_total +
+                                ServerBusyError (typed, synchronous)
+
+Per-replica admission is the serving AdmissionQueue unchanged (typed
+ServerBusyError / DeadlineExceededError); the fleet only ADDS the
+cross-replica hop, so a fleet of one behaves exactly like a bare
+engine.
+
+Drain (`drain(name)`) stops admissions to a replica, migrates its
+not-yet-finished work to siblings as COLD RESUBMITS — sampling is
+seeded per request, so a resubmit replays the identical stream, and a
+relay handle skips the tokens the client already received — lets
+anything kept behind finish, then joins the worker.  `restart(name)`
+rebuilds the replica from its spec (fresh pools, empty prefix index);
+stale prefix-affinity bets against it are caught by the confirmation
+loop, not assumed away.
+
+Token-identity oracle (tests/test_fleet.py): whatever the routing
+outcome — affinity hit, prefix spill, shed-and-retry, mid-stream drain
+with resubmit — every completed request's tokens are identical to a
+single-replica cold run of the same prompt, greedy and seeded
+stochastic alike; and `fleet.shed_total` only increments when every
+replica's admission gate is closed.
+
+Docs: docs/SERVING.md "Fleet tier".
+"""
+import math
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..generation.engine import (GenerationEngine, GenerationHandle)
+from ..generation.metrics import GenerationMetrics
+from ..profiler.monitor import StatRegistry
+from .admission import (RequestTooLargeError, ServerBusyError,
+                        ServingError)
+
+PREFIX = "fleet."
+
+ROUTED_AFFINITY = PREFIX + "routed_affinity"
+ROUTED_PREFIX = PREFIX + "routed_prefix"
+ROUTED_BALANCE = PREFIX + "routed_balance"
+ROUTED_RANDOM = PREFIX + "routed_random"
+ROUTED_SPILL = PREFIX + "routed_spill"
+SHED_TOTAL = PREFIX + "shed_total"
+MIGRATED_TOTAL = PREFIX + "migrated_total"
+PREFIX_ROUTED_CONFIRMED = PREFIX + "prefix_routed_confirmed"
+PREFIX_ROUTED_MISSED = PREFIX + "prefix_routed_missed"
+REPLICA_QUEUE_DEPTH = PREFIX + "replica_queue_depth"
+
+
+class FleetMetrics:
+    """fleet.* counters/gauges in the profiler StatRegistry (the
+    serving./generation. pattern one tier up).  Routing counters split
+    by the rung that actually placed the request; the per-replica
+    queue-depth gauges land under ``fleet.replica_queue_depth.<name>``
+    with the bare name carrying the fleet-wide MAX (the saturation
+    signal load shedding is about)."""
+
+    def __init__(self, registry=None):
+        self._reg = registry or StatRegistry.instance()
+        # touch every counter so the very first snapshot carries the
+        # complete schema (shed_total == 0 is a statement, not a gap)
+        for name in (ROUTED_AFFINITY, ROUTED_PREFIX, ROUTED_BALANCE,
+                     ROUTED_RANDOM, ROUTED_SPILL, SHED_TOTAL,
+                     MIGRATED_TOTAL, PREFIX_ROUTED_CONFIRMED,
+                     PREFIX_ROUTED_MISSED, REPLICA_QUEUE_DEPTH):
+            self._reg.get_stat(name)
+
+    def _stat(self, name):
+        return self._reg.get_stat(name)
+
+    def count_routed(self, rung):
+        self._stat({"affinity": ROUTED_AFFINITY, "prefix": ROUTED_PREFIX,
+                    "balance": ROUTED_BALANCE,
+                    "random": ROUTED_RANDOM}[rung]).increase()
+
+    def count_spill(self):
+        self._stat(ROUTED_SPILL).increase()
+
+    def count_shed(self):
+        self._stat(SHED_TOTAL).increase()
+
+    def count_migrated(self, n=1):
+        if n:
+            self._stat(MIGRATED_TOTAL).increase(n)
+
+    def count_prefix_confirmed(self, hit):
+        self._stat(PREFIX_ROUTED_CONFIRMED if hit
+                   else PREFIX_ROUTED_MISSED).increase()
+
+    def set_replica_queue_depth(self, name, depth):
+        self._stat(f"{REPLICA_QUEUE_DEPTH}.{name}").set(int(depth))
+
+    def set_max_queue_depth(self, depth):
+        self._stat(REPLICA_QUEUE_DEPTH).set(int(depth))
+
+    def snapshot(self):
+        return {k: v for k, v in self._reg.stats().items()
+                if k.startswith(PREFIX)}
+
+
+class ReplicaSpec:
+    """One replica's build recipe: a protocol model plus its OWN
+    GenerationConfig — heterogeneous fleets (long-context next to
+    low-latency) are just different specs behind one router.  The
+    router keeps the spec so `restart(name)` can rebuild the engine
+    after a drain."""
+
+    __slots__ = ("name", "model", "config")
+
+    def __init__(self, name, model, config=None):
+        self.name = str(name)
+        self.model = model
+        self.config = config
+
+
+class _MigrationRelay:
+    """Engine-side handle adapter for a drain-migrated request.
+
+    The sibling replica re-runs the prompt COLD; because sampling is
+    seeded per request, the resubmitted stream is token-identical to
+    the original, so this relay swallows the first `skip` tokens (the
+    client already streamed them from the draining replica) and
+    forwards the rest into the client's untouched handle — the client
+    observes one continuous, gap-free, duplicate-free stream.  TTFT
+    probes and the prefix_hit_tokens stamp stay the CLIENT handle's:
+    first admission wins, exactly as for preemption re-admission."""
+
+    __slots__ = ("_client", "_skip", "_skip0", "_pushed", "submitted_s",
+                 "first_token_s")
+
+    def __init__(self, client, skip):
+        self._client = client
+        self._skip = int(skip)
+        self._skip0 = int(skip)
+        self._pushed = 0
+        self.submitted_s = None      # own clock; client keeps original
+        self.first_token_s = None
+
+    @property
+    def prefix_hit_tokens(self):
+        return self._client.prefix_hit_tokens
+
+    @prefix_hit_tokens.setter
+    def prefix_hit_tokens(self, v):
+        self._client.prefix_hit_tokens = v
+
+    def client_and_delivered(self):
+        """(client handle, stream tokens the client has received) — the
+        skip count a SECOND migration of the same request needs."""
+        return self._client, max(self._skip0, self._pushed)
+
+    def _push_token(self, token):
+        if self.first_token_s is None:
+            self.first_token_s = time.monotonic()
+        self._pushed += 1
+        if self._skip > 0:
+            self._skip -= 1
+            return
+        self._client._push_token(token)
+
+    def _finish(self, result):
+        # the replayed result IS the request's result: token_ids cover
+        # the whole stream, already delivered + newly forwarded
+        self._client._finish(result)
+
+    def set_exception(self, exc):
+        self._client.set_exception(exc)
+
+    def done(self):
+        return self._client.done()
+
+
+class _Replica:
+    """One live replica: engine + its own metrics registry (per-replica
+    generation.* stats stay separable for the fleet snapshot) + the
+    admission state the router flips."""
+
+    def __init__(self, spec, start):
+        self.spec = spec
+        self.state = "stopped"
+        self.registry = None
+        self.engine = None
+        self.build(start)
+
+    def build(self, start):
+        self.registry = StatRegistry()
+        self.engine = GenerationEngine(
+            self.spec.model, self.spec.config,
+            metrics=GenerationMetrics(registry=self.registry),
+            start=start)
+        self.state = "serving"
+
+    @property
+    def name(self):
+        return self.spec.name
+
+    @property
+    def accepting(self):
+        return self.state == "serving"
+
+    def can_fit(self, prompt_len, max_new):
+        """Could this replica EVER hold the request (pool + positions)?
+        The capacity pre-filter that makes heterogeneous fleets work:
+        a long prompt routes straight to the long-context replica
+        instead of bouncing off a small one's typed rejection."""
+        cfg = self.engine.config
+        if math.ceil((prompt_len + 1) / cfg.page_size) > cfg.num_pages:
+            return False
+        max_pos = getattr(self.engine.model, "max_positions", None)
+        mn = (cfg.default_max_new_tokens if max_new is None
+              else int(max_new))
+        return max_pos is None or prompt_len + mn <= max_pos
+
+    def load(self):
+        """Queue depth + live slots + resident-page fraction — what
+        'least loaded' compares.  Pages enter as a FRACTION so queue
+        position dominates and pool residency breaks ties (a replica
+        with warm pages but an empty queue still reads near-idle)."""
+        eng = self.engine
+        return (eng.scheduler.pending_count()
+                + len(eng.scheduler.active())
+                + eng.cache.pages_in_use / max(1, eng.cache.num_pages))
+
+    def queue_depth(self):
+        return self.engine.scheduler.pending_count()
+
+
+class FleetConfig:
+    """Router knobs.
+
+    routing: "affinity" (the session → prefix → least-loaded ladder)
+        or "random" (uniform choice — the A/B baseline
+        tools/gen_bench.py --replicas measures the ladder against).
+    affinity_block_tokens: page alignment of the prefix-affinity hash —
+        the prompt's leading ``floor((len-1)/block)*block`` tokens are
+        hashed (matching match_prefix's full-page, clip-to-len-1
+        semantics so the hash covers exactly what a warm hit could
+        alias).  None = auto: the smallest page_size in the fleet.
+    start: start each replica engine's background worker (tests drive
+        steps themselves via run_until_idle and pass False).
+    seed: the random-routing RNG seed (reproducible A/B benches).
+    """
+
+    def __init__(self, routing="affinity", affinity_block_tokens=None,
+                 start=True, seed=None):
+        if routing not in ("affinity", "random"):
+            raise ValueError(
+                f"routing must be 'affinity' or 'random', got {routing!r}")
+        self.routing = routing
+        if affinity_block_tokens is not None \
+                and int(affinity_block_tokens) < 1:
+            raise ValueError(
+                f"affinity_block_tokens must be >= 1 or None (auto), "
+                f"got {affinity_block_tokens}")
+        self.affinity_block_tokens = (
+            None if affinity_block_tokens is None
+            else int(affinity_block_tokens))
+        self.start = bool(start)
+        self.seed = seed
+
+
+class FleetRouter:
+    """N GenerationEngine replicas behind one `submit()` with the same
+    streaming GenerationHandle contract as a single engine."""
+
+    def __init__(self, specs, config=None, metrics=None):
+        if not specs:
+            raise ValueError("a fleet needs at least one ReplicaSpec")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self.config = config or FleetConfig()
+        self.metrics = metrics or FleetMetrics()
+        self._replicas = {s.name: _Replica(s, self.config.start)
+                          for s in specs}
+        block = self.config.affinity_block_tokens
+        if block is None:
+            block = min(r.engine.config.page_size
+                        for r in self._replicas.values())
+        self._block = int(block)
+        self._sessions = {}          # session id -> replica name
+        self._rng = np.random.default_rng(self.config.seed)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # --------------------------- routing ----------------------------
+    def _prefix_key(self, prompt):
+        """CRC over the prompt's leading page-aligned tokens (clipped
+        to len-1, mirroring match_prefix: the last token always
+        prefills).  None when no full block fits — nothing a prefix
+        index could hold."""
+        n = (len(prompt) - 1) // self._block * self._block
+        if n <= 0:
+            return None
+        return zlib.crc32(np.asarray(prompt[:n], np.int64).tobytes())
+
+    def _candidates(self, prompt_len, max_new):
+        return [r for r in self._replicas.values()
+                if r.accepting and r.can_fit(prompt_len, max_new)]
+
+    def _ladder(self, session, key, candidates):
+        """The ordered (rung, replica) preference list.  Position 0 is
+        the ROUTE; everything after it is the spill path (remaining
+        candidates, least loaded first)."""
+        if self.config.routing == "random":
+            order = list(candidates)
+            self._rng.shuffle(order)
+            return [("random", r) for r in order]
+        by_load = sorted(candidates, key=lambda r: r.load())
+        prefs, seen = [], set()
+
+        def push(rung, rep):
+            if rep is not None and rep.name not in seen:
+                prefs.append((rung, rep))
+                seen.add(rep.name)
+
+        cand_names = {r.name: r for r in candidates}
+        if session is not None:
+            push("affinity", cand_names.get(self._sessions.get(session)))
+        if key is not None and len(candidates) > 0:
+            # stateless hash preference over the STABLE name order, so
+            # every request carrying the same leading tokens converges
+            # on one replica — whose index then actually holds the
+            # prefix.  Walk forward past non-candidates so a drained
+            # replica's keys spread deterministically over survivors.
+            stable = sorted(self._replicas.values(), key=lambda r: r.name)
+            for off in range(len(stable)):
+                rep = stable[(key + off) % len(stable)]
+                if rep.name in cand_names:
+                    push("prefix", rep)
+                    break
+        for rep in by_load:
+            push("balance", rep)
+        return prefs
+
+    def _confirm_prefix(self, handle):
+        """The measurement half of prefix routing: once the request
+        resolves, its first-admission prefix_hit_tokens stamp says
+        whether the bet paid.  A first-of-its-prefix request is
+        recorded as a MISS — it seeded the cache, the bet didn't pay
+        yet — so the confirmed/missed ratio reads as the real warm
+        fraction of prefix-routed traffic, not an assumption."""
+        hit = handle.prefix_hit_tokens
+        if hit is not None:
+            self.metrics.count_prefix_confirmed(hit > 0)
+
+    def _route_and_submit(self, prompt, kwargs, handle, session,
+                          exclude=None):
+        """Run the ladder, count the rung that actually placed the
+        request, and return (handle, replica).  Raises ServerBusyError
+        (shed — every candidate's gate closed) or RequestTooLargeError
+        (no candidate could EVER hold it) synchronously."""
+        prompt = list(prompt)
+        with self._lock:
+            if self._closed:
+                raise ServingError("fleet router is shut down")
+            candidates = [r for r in self._candidates(
+                len(prompt), kwargs.get("max_new_tokens"))
+                if exclude is None or r.name != exclude]
+            if not candidates:
+                if any(r.accepting for r in self._replicas.values()
+                       if exclude is None or r.name != exclude):
+                    raise RequestTooLargeError(
+                        f"no replica can hold a {len(prompt)}-token "
+                        f"prompt (+{kwargs.get('max_new_tokens')} new)")
+                raise ServingError(
+                    "no accepting replica (fleet drained or shut down)")
+            key = self._prefix_key(prompt)
+            prefs = self._ladder(session, key, candidates)
+            last_busy = None
+            for i, (rung, rep) in enumerate(prefs):
+                try:
+                    rep.engine.submit(prompt, handle=handle, **kwargs)
+                except ServerBusyError as e:
+                    last_busy = e
+                    continue
+                except RequestTooLargeError:
+                    continue   # per-replica edge the pre-filter missed
+                if i == 0:
+                    self.metrics.count_routed(rung)
+                else:
+                    self.metrics.count_spill()
+                if rung == "prefix" and i == 0:
+                    client = (handle.client_and_delivered()[0]
+                              if isinstance(handle, _MigrationRelay)
+                              else handle)
+                    # hook the confirmation ONLY when this submission
+                    # is the one whose admission will stamp the handle
+                    # (stamp still None), and at most once per client —
+                    # a drain-migrated request re-routed by prefix must
+                    # not fire a second callback against the ORIGINAL
+                    # replica's stamp and double-count a bet the new
+                    # replica never won.  (A started worker can admit
+                    # and stamp between submit and this check; that
+                    # rare race under-counts one confirmation, never
+                    # mis-attributes one.)
+                    if client.prefix_hit_tokens is None and not getattr(
+                            client, "_prefix_confirm_hooked", False):
+                        client._prefix_confirm_hooked = True
+                        client.add_done_callback(self._confirm_prefix)
+                if session is not None:
+                    self._sessions[session] = rep.name
+                self.metrics.set_replica_queue_depth(rep.name,
+                                                     rep.queue_depth())
+                return handle, rep
+            # every candidate's admission gate is closed: fleet-level
+            # load shed — the ONLY place shed_total increments
+            self.metrics.count_shed()
+            raise ServerBusyError(
+                f"fleet saturated: all {len(prefs)} routable replicas "
+                f"rejected admission") from last_busy
+
+    # --------------------------- client API -------------------------
+    def submit(self, prompt, max_new_tokens=None, sampling=None,
+               stop_tokens=(), timeout_ms=None, session=None):
+        """Route one prompt to a replica; returns a GenerationHandle
+        with the engine's exact streaming contract.  `session` pins
+        this and follow-up submits carrying the same id to one replica
+        (whose pools hold the conversation's warm pages); without it,
+        routing falls to prefix affinity, then least-loaded."""
+        handle = GenerationHandle()
+        handle, _ = self._route_and_submit(
+            prompt,
+            dict(max_new_tokens=max_new_tokens, sampling=sampling,
+                 stop_tokens=stop_tokens, timeout_ms=timeout_ms),
+            handle, session)
+        return handle
+
+    def generate(self, prompt, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, **kw).result()
+
+    def replica_of(self, handle_or_session):
+        """Debug/test introspection: the replica name a session is
+        pinned to (None when unpinned)."""
+        return self._sessions.get(handle_or_session)
+
+    # ------------------------- drain / restart ----------------------
+    def drain(self, name, migrate=True, timeout=60.0):
+        """Take replica `name` out of service: stop admissions, move
+        its unfinished work to siblings, join the worker.
+
+        Queued (never-admitted) requests ALWAYS migrate — as cold
+        resubmits with their original seeded sampling, so their streams
+        are untouched.  With `migrate=True` (default) live slot-holders
+        preempt-migrate too: their prompt is resubmitted cold on a
+        sibling and a relay skips the tokens the client already
+        received — seeded sampling replays the identical stream, so the
+        client sees one continuous stream (the mid-stream-drain half of
+        the fleet oracle).  With `migrate=False` residents finish on
+        the draining replica first — but a resident that outlives
+        `timeout` is preempt-migrated anyway (seeded sampling keeps the
+        replay identical), so a drain always CONVERGES to "stopped"
+        instead of wedging the replica in a half-drained state no later
+        drain() or restart() could touch.  A migrated request that
+        finds every sibling's gate closed resolves its handle with the
+        typed ServerBusyError (counted in fleet.shed_total — the
+        draining gate is administratively closed, so every gate really
+        was closed).  Sessions pinned here unpin; their next turn
+        re-routes and re-pins."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if rep.state != "serving":
+                raise ServingError(
+                    f"replica {name!r} is {rep.state}, not serving")
+            rep.state = "draining"
+            for sess in [s for s, n in self._sessions.items()
+                         if n == name]:
+                del self._sessions[sess]
+        moved = rep.engine.evacuate(include_active=migrate)
+        for req, emitted in moved:
+            self._migrate(req, emitted, exclude=name)
+        self.metrics.count_migrated(len(moved))
+        deadline = time.monotonic() + float(timeout)
+        eng = rep.engine
+        while eng.scheduler.active() or eng.scheduler.pending_count():
+            if time.monotonic() > deadline:
+                # stragglers outlived the drain budget: preempt-migrate
+                # them (replay stays identical) rather than raising with
+                # the replica wedged in 'draining' — a state no later
+                # drain() or restart() could recover
+                leftover = eng.evacuate(include_active=True)
+                for req, emitted in leftover:
+                    self._migrate(req, emitted, exclude=name)
+                self.metrics.count_migrated(len(leftover))
+                break
+            if eng._thread is not None and eng._thread.is_alive():
+                time.sleep(0.005)
+            else:
+                eng.step()   # stepped mode: the drain drives residents
+        eng.shutdown()
+        rep.state = "stopped"
+
+    def _migrate(self, req, emitted, exclude):
+        """Cold-resubmit one evacuated request on a sibling, preserving
+        the client's handle and stream position."""
+        handle = req.future
+        if isinstance(handle, _MigrationRelay):   # second migration
+            client, delivered = handle.client_and_delivered()
+        else:
+            client, delivered = handle, int(emitted)
+        engine_handle = (_MigrationRelay(client, delivered)
+                         if delivered else client)
+        timeout_ms = None
+        if req.deadline is not None:
+            timeout_ms = max(0.0,
+                             (req.deadline - time.monotonic()) * 1e3)
+        try:
+            self._route_and_submit(
+                req.prompt,
+                dict(max_new_tokens=req.max_new_tokens,
+                     sampling=req.params,
+                     stop_tokens=req.stop_tokens, timeout_ms=timeout_ms),
+                engine_handle, session=None, exclude=exclude)
+        except ServingError as e:
+            # nowhere to go (typed: busy/too-large/drained) — the
+            # client holds the handle, so the error lands there
+            client.set_exception(e)
+
+    def restart(self, name):
+        """Bring a drained replica back: a FRESH engine from its spec —
+        new pools, empty prefix index, empty queue.  Prefix-affinity
+        bets against the old index self-correct through the
+        confirmation loop (first request misses, seeds, re-warms)."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if rep.state != "stopped":
+                raise ServingError(
+                    f"replica {name!r} is {rep.state}; drain it first")
+            rep.build(self.config.start)
+
+    # --------------------------- lifecycle --------------------------
+    def run_until_idle(self, max_steps=100000):
+        """Drive every live replica until queues and slots drain —
+        stepped replicas are stepped here (tests/benchmarks); replicas
+        with background workers are simply waited on."""
+        steps = 0
+        while True:
+            busy = False
+            for rep in self._replicas.values():
+                if rep.state == "stopped":
+                    continue
+                eng = rep.engine
+                if eng.scheduler.active() or eng.scheduler.pending_count():
+                    busy = True
+                    if eng._thread is not None and eng._thread.is_alive():
+                        time.sleep(0.002)
+                    else:
+                        eng.step()
+            if not busy:
+                return steps
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"fleet not idle after {max_steps} "
+                                   f"steps")
+
+    def stats_snapshot(self):
+        """Fleet-level capacity-planning export: every replica's
+        generation.* snapshot + live cache stats keyed by replica name,
+        plus the fleet.* routing/shed counters and per-replica queue-
+        depth gauges (refreshed here)."""
+        replicas = {}
+        depths = []
+        for name, rep in self._replicas.items():
+            if rep.state == "stopped":
+                # a stopped replica queues nothing: zero its gauge so a
+                # dashboard never shows pre-drain depth on a dead slot
+                self.metrics.set_replica_queue_depth(name, 0)
+                replicas[name] = {"state": rep.state}
+                continue
+            depth = rep.queue_depth()
+            depths.append(depth)
+            self.metrics.set_replica_queue_depth(name, depth)
+            replicas[name] = {
+                "state": rep.state,
+                "queue_depth": depth,
+                "active": len(rep.engine.scheduler.active()),
+                "load": round(rep.load(), 3),
+                "generation":
+                    rep.registry.stats_snapshot("generation.")["stats"],
+                "cache": rep.engine.cache.stats(),
+            }
+        self.metrics.set_max_queue_depth(max(depths, default=0))
+        return {"fleet": self.metrics.snapshot(), "replicas": replicas}
+
+    def shutdown(self):
+        """Stop every replica (typed rejection for anything queued)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for rep in self._replicas.values():
+            if rep.state != "stopped":
+                rep.engine.shutdown()
+                rep.state = "stopped"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+__all__ = [
+    "FleetRouter", "FleetConfig", "FleetMetrics", "ReplicaSpec",
+]

@@ -1,0 +1,451 @@
+"""Fleet tier: multi-replica generation serving with prefix-affinity
+and SLO-aware routing (serving/fleet.py).
+
+Acceptance oracles (all CPU, thread-friendly stepped replicas, small
+models and tight token counts — the tier-1 wall budget):
+
+1. TOKEN IDENTITY under ANY routing outcome: affinity hit, prefix
+   spill, shed-and-retry, and mid-stream drain with resubmit all
+   produce streams identical to a single-replica cold run of the same
+   prompt — greedy AND seeded stochastic.  The fleet moves work, never
+   changes it.
+2. SHED DISCIPLINE: `fleet.shed_total` only increments when EVERY
+   replica's admission gate is closed; one open gate means a spill, not
+   a shed.
+3. ROUTING LADDER: session affinity pins follow-up turns to the replica
+   holding their warm pages, prefix affinity converges same-system-
+   prompt traffic on one replica (and is MEASURED: every prefix-routed
+   request's prefix_hit_tokens stamp is confirmed), least-loaded
+   catches the rest.
+4. DRAIN CONTRACT: drain stops admissions, migrates unfinished work to
+   siblings as cold resubmits (a relay skips already-streamed tokens),
+   lets kept residents finish, and joins the worker; restart rebuilds
+   the replica from its spec.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.admission import (DeadlineExceededError,
+                                          RequestTooLargeError,
+                                          ServerBusyError)
+from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                      ReplicaSpec)
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]   # 3 full pages @ ps=4
+PROMPTS = [SYSTEM + [7, 7], SYSTEM + [1], SYSTEM + [9, 9, 9], SYSTEM]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # same signature as test_prefix_cache's model: the process-wide
+    # greedy_oracle memo shares reference streams across both suites
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _cfg(**kw):
+    base = dict(max_decode_slots=4, num_pages=64, page_size=4,
+                prefix_cache=True)
+    base.update(kw)
+    return gen.GenerationConfig(**base)
+
+
+def _fleet(model, n=2, routing="affinity", cfgs=None, start=False,
+           **cfg_kw):
+    cfgs = cfgs or [_cfg(**cfg_kw) for _ in range(n)]
+    specs = [ReplicaSpec(f"r{i}", model, c) for i, c in enumerate(cfgs)]
+    return FleetRouter(specs, FleetConfig(routing=routing, start=start,
+                                          seed=0))
+
+
+def _stat(name):
+    return StatRegistry.instance().get_stat(name).get()
+
+
+def _requests_per_replica(fl):
+    snap = fl.stats_snapshot()
+    return {n: r.get("generation", {}).get("generation.requests_total", 0)
+            for n, r in snap["replicas"].items() if "generation" in r}
+
+
+# --------------------------- routing ladder ------------------------------
+
+
+def test_submit_streams_and_matches_single_replica_oracle(model):
+    """The basic fleet contract: N replicas behind one submit(), every
+    stream identical to the cold single-replica reference."""
+    fl = _fleet(model)
+    hs = []
+    for p in PROMPTS:
+        hs.append(fl.submit(p, max_new_tokens=8))
+        fl.run_until_idle()
+    for p, h in zip(PROMPTS, hs):
+        r = h.result(timeout=5)
+        assert r.token_ids == _ref(model, p, 8)
+    # the streaming surface is the same handle contract as the engine
+    streamed = list(hs[-1].tokens(timeout=1))
+    assert streamed == hs[-1].result().token_ids
+    fl.shutdown()
+
+
+def test_prefix_affinity_converges_same_system_prompt(model):
+    """Requests sharing a system prompt hash to ONE replica, whose
+    prefix index then actually serves them — confirmed, not assumed."""
+    fl = _fleet(model)
+    hs = []
+    for p in PROMPTS[:3]:
+        hs.append(fl.submit(p, max_new_tokens=8))
+        fl.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    counts = _requests_per_replica(fl)
+    assert sorted(counts.values()) == [0, 3], counts
+    assert _stat(fleet_mod.ROUTED_PREFIX) == 3
+    # first of the key seeded the cache (a recorded miss); the rest hit
+    assert all(h.prefix_hit_tokens > 0 for h in hs[1:])
+    assert _stat(fleet_mod.PREFIX_ROUTED_MISSED) == 1
+    assert _stat(fleet_mod.PREFIX_ROUTED_CONFIRMED) == 2
+    fl.shutdown()
+
+
+def test_session_affinity_pins_multi_turn_conversation(model):
+    """Turn 2 re-sends turn 1's prompt + answer under the same session
+    id: it lands on the SAME replica and warm-hits past the old prompt
+    into the answer pages (decode-tail indexing)."""
+    fl = _fleet(model)
+    p1 = SYSTEM + [7, 7]
+    h1 = fl.submit(p1, max_new_tokens=8, session="s1")
+    fl.run_until_idle()
+    answer = h1.result(timeout=5).token_ids
+    assert answer == _ref(model, p1, 8)
+    pinned = fl.replica_of("s1")
+    assert pinned is not None
+    p2 = p1 + answer + [2, 4]
+    h2 = fl.submit(p2, max_new_tokens=8, session="s1")
+    fl.run_until_idle()
+    assert h2.result(timeout=5).token_ids == _ref(model, p2, 8)
+    assert fl.replica_of("s1") == pinned
+    assert _stat(fleet_mod.ROUTED_AFFINITY) == 1
+    # the warm hit reaches GENERATED pages, not just the old prompt
+    assert h2.prefix_hit_tokens > len(p1)
+    fl.shutdown()
+
+
+def test_short_prompts_route_least_loaded(model):
+    """No session, no full affinity block: the balance rung spreads
+    cold work to the least-loaded replica."""
+    fl = _fleet(model)
+    fl.submit([1, 2, 3], max_new_tokens=2)     # < one page: no key
+    fl.submit([4, 5, 6], max_new_tokens=2)
+    assert _stat(fleet_mod.ROUTED_BALANCE) == 2
+    snap = fl.stats_snapshot()
+    depths = [r["queue_depth"] for r in snap["replicas"].values()]
+    assert sorted(depths) == [1, 1]            # one each, not both on one
+    fl.run_until_idle()
+    fl.shutdown()
+
+
+def test_spill_then_shed_only_when_every_gate_closed(model):
+    """One full replica spills to its sibling (no shed); both full
+    sheds with the typed busy error; after the backlog drains, the
+    retry completes token-identically (shed-and-retry oracle)."""
+    fl = _fleet(model, queue_depth=1)
+    p = SYSTEM + [7, 7]
+    fl.submit(p, max_new_tokens=4)             # fills the prefix home
+    h2 = fl.submit(SYSTEM + [1], max_new_tokens=4)   # spill: home full
+    assert _stat(fleet_mod.ROUTED_SPILL) == 1
+    assert _stat(fleet_mod.SHED_TOTAL) == 0
+    with pytest.raises(ServerBusyError):
+        fl.submit(SYSTEM + [9, 9, 9], max_new_tokens=4)  # both gates shut
+    assert _stat(fleet_mod.SHED_TOTAL) == 1
+    fl.run_until_idle()
+    h3 = fl.submit(SYSTEM + [9, 9, 9], max_new_tokens=4)   # the retry
+    fl.run_until_idle()
+    assert h3.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [9, 9, 9], 4)
+    h2.result(timeout=5)
+    fl.shutdown()
+
+
+def test_prefix_routing_is_measured_not_assumed(model):
+    """Flush the home replica's index behind the router's back: the
+    next prefix-routed request MISSES and the confirmation counter
+    records it — the router's bet is checked against
+    prefix_hit_tokens, never trusted."""
+    fl = _fleet(model)
+    h1 = fl.submit(SYSTEM + [7], max_new_tokens=4)
+    fl.run_until_idle()
+    h1.result(timeout=5)
+    home = max(_requests_per_replica(fl).items(), key=lambda kv: kv[1])[0]
+    fl._replicas[home].engine.cache.flush_prefix_cache()
+    missed_before = _stat(fleet_mod.PREFIX_ROUTED_MISSED)
+    h2 = fl.submit(SYSTEM + [8], max_new_tokens=4)
+    fl.run_until_idle()
+    assert h2.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [8], 4)
+    assert h2.prefix_hit_tokens == 0           # the bet did not pay
+    assert _stat(fleet_mod.PREFIX_ROUTED_MISSED) == missed_before + 1
+    fl.shutdown()
+
+
+def test_random_routing_is_the_ablation_baseline(model):
+    """routing='random' bypasses the whole ladder (the gen_bench A/B
+    baseline) but keeps the token-identity and typed-error contract."""
+    fl = _fleet(model, routing="random")
+    hs = []
+    for p in PROMPTS[:2]:
+        hs.append(fl.submit(p, max_new_tokens=8, session="sx"))
+        fl.run_until_idle()
+    for p, h in zip(PROMPTS, hs):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    assert _stat(fleet_mod.ROUTED_AFFINITY) == 0
+    assert _stat(fleet_mod.ROUTED_PREFIX) == 0
+    assert _stat(fleet_mod.ROUTED_RANDOM) == 2
+    fl.shutdown()
+
+
+# ------------------------- heterogeneous fleets --------------------------
+
+
+def test_heterogeneous_fleet_routes_by_capacity(model):
+    """A long prompt routes straight to the replica that can hold it;
+    a prompt no replica fits is the typed RequestTooLargeError."""
+    small = _cfg(num_pages=4)                   # 16-token pool
+    large = _cfg(num_pages=64)
+    fl = _fleet(model, cfgs=[small, large])
+    long_prompt = list(np.random.default_rng(0).integers(0, 48, 40))
+    h = fl.submit(long_prompt, max_new_tokens=4)
+    fl.run_until_idle()
+    assert h.result(timeout=5).token_ids == \
+        _ref(model, long_prompt, 4)
+    counts = _requests_per_replica(fl)
+    assert counts["r1"] == 1 and counts["r0"] == 0
+    with pytest.raises(RequestTooLargeError):
+        fl.submit([1] * 300, max_new_tokens=4)
+    fl.shutdown()
+
+
+# --------------------------- drain / restart -----------------------------
+
+
+def test_drain_migrates_queued_requests_cold(model):
+    """Queued (never-admitted) requests migrate wholesale: handles
+    survive, streams equal the cold reference, the drained replica
+    stops."""
+    fl = _fleet(model)
+    hs = []
+    for p in PROMPTS[:3]:
+        hs.append(fl.submit(p, max_new_tokens=8))   # all queue on home
+    home = max(fl.stats_snapshot()["replicas"].items(),
+               key=lambda kv: kv[1].get("queue_depth", 0))[0]
+    fl.drain(home)
+    assert _stat(fleet_mod.MIGRATED_TOTAL) == 3
+    fl.run_until_idle()
+    for p, h in zip(PROMPTS, hs):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    assert fl.stats_snapshot()["replicas"][home] == {"state": "stopped"}
+    # new work keeps flowing through the survivor
+    h = fl.submit(SYSTEM, max_new_tokens=4)
+    fl.run_until_idle()
+    assert h.result(timeout=5).token_ids == _ref(model, SYSTEM, 4)
+    fl.shutdown()
+
+
+def test_midstream_drain_resubmit_token_identity(model):
+    """THE drain oracle: requests drained MID-STREAM (greedy and seeded
+    stochastic) resubmit cold on a sibling; the client sees one
+    continuous stream identical to a single-replica cold run — no
+    duplicates, no gaps, no divergence."""
+    fl = _fleet(model)
+    p_greedy, p_stoch = SYSTEM + [7, 7], SYSTEM + [1]
+    sp = gen.SamplingParams(temperature=0.9, top_k=10, top_p=0.9,
+                            seed=123)
+    hg = fl.submit(p_greedy, max_new_tokens=10, session="s1")
+    hs = fl.submit(p_stoch, max_new_tokens=10, sampling=sp, session="s1")
+    home = fl.replica_of("s1")
+    eng = fl._replicas[home].engine
+    for _ in range(8):                      # stream a few tokens...
+        eng.step()
+    assert any(s.n_generated > 0 for s in eng.scheduler.active())
+    fl.drain(home, migrate=True)            # ...then pull the replica
+    fl.run_until_idle()
+    rg, rs = hg.result(timeout=5), hs.result(timeout=5)
+    assert rg.token_ids == _ref(model, p_greedy, 10)
+    # seeded stochastic cold reference from a fresh single engine
+    cold = gen.GenerationEngine(model, _cfg(), start=False)
+    hc = cold.submit(p_stoch, max_new_tokens=10,
+                     sampling=gen.SamplingParams(temperature=0.9,
+                                                 top_k=10, top_p=0.9,
+                                                 seed=123))
+    cold.run_until_idle()
+    assert rs.token_ids == hc.result(timeout=5).token_ids
+    cold.shutdown()
+    # the streamed event sequence is gap- and duplicate-free
+    assert list(hg.tokens(timeout=1)) == rg.token_ids
+    assert list(hs.tokens(timeout=1)) == rs.token_ids
+    assert _stat(fleet_mod.MIGRATED_TOTAL) >= 2
+    fl.shutdown()
+
+
+def test_drain_without_migration_lets_residents_finish(model):
+    """migrate=False: the live slot-holder completes on the draining
+    replica (the drain drives it), then the worker joins."""
+    fl = _fleet(model)
+    h = fl.submit(SYSTEM + [7, 7], max_new_tokens=8, session="s1")
+    home = fl.replica_of("s1")
+    eng = fl._replicas[home].engine
+    for _ in range(3):
+        eng.step()
+    fl.drain(home, migrate=False)
+    assert h.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [7, 7], 8)
+    assert _stat(fleet_mod.MIGRATED_TOTAL) == 0
+    assert fl._replicas[home].state == "stopped"
+    fl.shutdown()
+
+
+def test_drain_timeout_migrates_stragglers_instead_of_wedging(model):
+    """A resident that outlives the drain budget is preempt-migrated
+    (replay stays identical) rather than leaving the replica wedged in
+    'draining' — a state no later drain() or restart() could touch.
+    timeout=0 makes every resident a straggler deterministically."""
+    fl = _fleet(model)
+    h = fl.submit(SYSTEM + [7, 7], max_new_tokens=8, session="s1")
+    home = fl.replica_of("s1")
+    eng = fl._replicas[home].engine
+    for _ in range(3):
+        eng.step()                       # mid-stream when drain lands
+    fl.drain(home, migrate=False, timeout=0)
+    assert fl._replicas[home].state == "stopped"   # converged, not wedged
+    fl.run_until_idle()
+    assert h.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [7, 7], 8)
+    assert _stat(fleet_mod.MIGRATED_TOTAL) == 1
+    fl.restart(home)                     # and the slot is recoverable
+    assert fl._replicas[home].state == "serving"
+    fl.shutdown()
+
+
+def test_restart_rebuilds_replica_from_spec(model):
+    """restart() brings a drained replica back with fresh pools and an
+    empty prefix index; it serves again immediately."""
+    fl = _fleet(model)
+    fl.drain("r0")
+    fl.restart("r0")
+    assert fl._replicas["r0"].state == "serving"
+    fl.drain("r1")                          # only r0 accepts now
+    h = fl.submit(SYSTEM, max_new_tokens=4)
+    fl.run_until_idle()
+    assert h.result(timeout=5).token_ids == _ref(model, SYSTEM, 4)
+    assert _requests_per_replica(fl)["r0"] == 1
+    fl.shutdown()
+
+
+# ------------------------ contract / observability -----------------------
+
+
+def test_deadline_error_passes_through_the_fleet(model):
+    """Per-request deadlines keep the engine's typed reaping: an
+    expired request resolves with DeadlineExceededError."""
+    fl = _fleet(model)
+    h = fl.submit(SYSTEM, max_new_tokens=4, timeout_ms=0)
+    fl.run_until_idle()
+    with pytest.raises(DeadlineExceededError):
+        h.result(timeout=1)
+    fl.shutdown()
+
+
+def test_stats_snapshot_schema(model):
+    """The capacity-planning export: fleet.* counters + per-replica
+    generation/cache stats + queue-depth gauges."""
+    fl = _fleet(model)
+    # two short (keyless) prompts: the balance rung gives each replica
+    # one, so both registries carry real generation.* counters
+    hs = [fl.submit([1, 2, 3], max_new_tokens=2),
+          fl.submit([4, 5, 6], max_new_tokens=2)]
+    fl.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    snap = fl.stats_snapshot()
+    assert fleet_mod.SHED_TOTAL in snap["fleet"]
+    assert fleet_mod.REPLICA_QUEUE_DEPTH in snap["fleet"]
+    for name in ("r0", "r1"):
+        rep = snap["replicas"][name]
+        assert rep["state"] == "serving"
+        assert "queue_depth" in rep and "load" in rep
+        assert "generation.requests_total" in rep["generation"]
+        assert "pages_in_use" in rep["cache"]
+        assert f"{fleet_mod.REPLICA_QUEUE_DEPTH}.{name}" in snap["fleet"]
+    fl.shutdown()
+
+
+def test_thread_based_replicas_with_started_workers(model):
+    """The production mode: every replica runs its background stepping
+    worker; the fleet just routes."""
+    fl = _fleet(model, start=True)
+    hs = [fl.submit(p, max_new_tokens=4, session=f"w{i}")
+          for i, p in enumerate(PROMPTS[:2])]
+    for p, h in zip(PROMPTS, hs):
+        assert h.result(timeout=30).token_ids == _ref(model, p, 4)
+    fl.shutdown()
+
+
+# ------------------------- engine-side drain hooks -----------------------
+
+
+def test_engine_evacuate_extracts_queue_then_actives(model):
+    """The drain hook's contract: evacuate() pulls unadmitted work
+    (emitted=0) and — with include_active — live slot-holders with
+    their emitted-token counts, freeing pages without resolving
+    handles."""
+    eng = gen.GenerationEngine(
+        model, gen.GenerationConfig(max_decode_slots=1, num_pages=64,
+                                    page_size=4), start=False)
+    h1 = eng.submit(SYSTEM + [7, 7], max_new_tokens=8)
+    h2 = eng.submit(SYSTEM + [1], max_new_tokens=8)
+    h3 = eng.submit(SYSTEM, max_new_tokens=8)
+    for _ in range(4):                       # h1 takes the slot, streams
+        eng.step()
+    queued = eng.evacuate(include_active=False)
+    assert [r.prompt for r, _ in queued] == [SYSTEM + [1], SYSTEM]
+    assert all(emitted == 0 for _, emitted in queued)
+    assert len(eng.scheduler.active()) == 1  # the slot-holder stayed
+    active = eng.evacuate(include_active=True)
+    assert len(active) == 1
+    req, emitted = active[0]
+    assert req.prompt == SYSTEM + [7, 7] and emitted > 0
+    assert not eng.scheduler.active()
+    assert eng.cache.pages_in_use == 0       # pages freed, handles live
+    assert not (h1.done() or h2.done() or h3.done())
+    eng.shutdown()
+
+
+def test_engine_submit_accepts_caller_handle(model):
+    """The fleet handle hook: a caller-supplied handle is driven by the
+    engine, and a preset submitted_s (a migrated request's original
+    TTFT clock) is preserved."""
+    eng = gen.GenerationEngine(
+        model, gen.GenerationConfig(num_pages=64, page_size=4),
+        start=False)
+    h = gen.GenerationHandle()
+    h.submitted_s = 123.0
+    out = eng.submit(SYSTEM, max_new_tokens=4, handle=h)
+    assert out is h
+    eng.run_until_idle()
+    assert h.result(timeout=5).token_ids == _ref(model, SYSTEM, 4)
+    assert h.submitted_s == 123.0
+    eng.shutdown()

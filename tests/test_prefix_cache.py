@@ -468,8 +468,9 @@ def test_eviction_under_pool_pressure_before_preemption(model):
     reg = StatRegistry.instance()
     preempt = reg.get_stat(gmetrics.PREEMPTED_TOTAL)
     evict = reg.get_stat(gmetrics.PREFIX_EVICTIONS)
-    _generate(eng, [SYSTEM])             # 3 pages stay cached
-    assert eng.cache.prefix_cached_pages == 3
+    # 3 prompt pages + 1 decode-tail page stay cached
+    _generate(eng, [SYSTEM])
+    assert eng.cache.prefix_cached_pages == 4
     before_p, before_e = preempt.get(), evict.get()
     # a divergent long prompt that cannot fit alongside the cache
     out, _ = _generate(eng, [[40, 41, 42, 43, 44, 45, 46, 47] * 3])
@@ -596,6 +597,141 @@ def test_reset_pools_flushes_the_prefix_index():
     assert c.match_prefix(SYSTEM + [7]) == ((), 0)
     assert c.num_free_pages == c.num_pages
     assert c.prefix_cached_pages == 0
+
+
+# ------------------------- decode-tail indexing --------------------------
+
+
+def test_decode_tail_indexed_at_retire(model):
+    """Full pages of GENERATED tokens join the index when a sequence
+    retires: a later prompt re-sending prompt + answer matches past the
+    prompt into the answer pages."""
+    eng = _engine(model)
+    h1 = eng.submit(SYSTEM, max_new_tokens=8)
+    eng.run_until_idle()
+    answer = h1.result(timeout=5).token_ids
+    # cache length at retire is prompt + generated - 1 (the newest
+    # sampled token was never decoded, so never written): only full
+    # pages of THAT are indexable
+    cached = (len(SYSTEM) + len(answer) - 1) // 4 * 4
+    _, m = eng.cache.match_prefix(SYSTEM + answer + [9])
+    assert m == cached and cached > len(SYSTEM)
+    eng.shutdown()
+
+
+def test_two_turn_conversation_warm_equals_cold(model):
+    """The multi-turn production shape: turn 2 re-sends turn 1's prompt
+    + streamed answer verbatim plus new user text — it warm-hits INTO
+    the generated pages (impossible under prompt-only indexing) and
+    still reproduces the cold reference token for token."""
+    eng = _engine(model)
+    p1 = SYSTEM + [7, 7]
+    h1 = eng.submit(p1, max_new_tokens=8)
+    eng.run_until_idle()
+    answer = h1.result(timeout=5).token_ids
+    p2 = p1 + answer + [2, 4]
+    h2 = eng.submit(p2, max_new_tokens=8)
+    eng.run_until_idle()
+    assert h2.result(timeout=5).token_ids == _ref(model, p2, 8)
+    assert h2.prefix_hit_tokens > len(p1)    # reached the decode tail
+    eng.shutdown()
+
+
+def test_prefix_pages_registered_counts_prompt_and_tail(model):
+    """The registration counter splits nothing silently: 3 prompt pages
+    at prefill completion + 1 decode-tail page at retire."""
+    reg = StatRegistry.instance()
+    stat = reg.get_stat(gmetrics.PREFIX_PAGES_REGISTERED)
+    eng = _engine(model)
+    before = stat.get()
+    h = eng.submit(SYSTEM, max_new_tokens=8)   # 12 prompt, 19 cached
+    eng.run_until_idle()
+    h.result(timeout=5)
+    assert stat.get() - before == 4
+    eng.shutdown()
+
+
+# ---------------------- incremental (O(log n)) eviction ------------------
+
+
+class _ScanCounting(dict):
+    """A _nodes stand-in that counts full-trie iterations — the scan
+    the incremental evictable-leaf heap exists to eliminate."""
+
+    def __init__(self, *a):
+        super().__init__(*a)
+        self.scans = 0
+
+    def values(self):
+        self.scans += 1
+        return super().values()
+
+
+def test_eviction_is_incremental_not_a_trie_rescan():
+    """A large half-warm index (hundreds of nodes, half pinned by live
+    sequences) pays O(log n) per evicted page: the pressured reserve's
+    eviction round never iterates the trie, and the heap persists
+    across rounds instead of being re-seeded per call."""
+    c = PagedKVCache(1, 1, 2, num_pages=600, page_size=1)
+    rng = np.random.default_rng(0)
+    for i in range(16):                     # 16 runs x 32 pages
+        toks = [i] * 32
+        c.allocate(i)
+        k = rng.standard_normal((1, 32, 1, 2)).astype(np.float32)
+        c.append_prefill(i, k, k)
+        assert c.register_prefix(i, toks) == 32
+        if i % 2:
+            c.free(i)                       # 8 runs stay pinned
+    assert c.prefix_cached_pages == 256
+    counting = _ScanCounting(c._nodes)
+    c._nodes = counting
+    heap = c._evict_heap
+    c.allocate("big")
+    c.reserve("big", 100)                   # free=88: must evict 12
+    assert c.prefix_cached_pages == 256 - 12
+    assert counting.scans == 0              # no full-trie pass
+    assert c._evict_heap is heap            # maintained, not re-seeded
+    # chains evict leaf-upward: the heap holds O(runs) entries, never
+    # one per node
+    assert len(heap) <= 16
+
+
+def test_evict_heap_bounded_under_warm_churn():
+    """The warm steady state — adopt + free per request, never any pool
+    pressure to drain the heap — must not grow it: at most ONE live
+    entry per evictable node, however many times the run is re-adopted
+    and re-freed (the `queued` dedup flag)."""
+    c = _seeded_cache()
+    c.free("donor")
+    for i in range(50):
+        pages, m = c.match_prefix(SYSTEM + [7])
+        c.allocate(i)
+        c.adopt_prefix(i, pages, m)
+        c.free(i)
+    assert len(c._evict_heap) <= 3      # per node, not per churn cycle
+    # and the entries still work: pressure evicts the whole run
+    assert c._evict_prefix(3) == 3
+    assert c.prefix_cached_pages == 0
+
+
+def test_evictable_heap_tracks_refcount_transitions():
+    """The heap follows the exact transitions: pinned runs are never
+    evicted (the fast path), re-adoption un-queues lazily, the LRU
+    leaf-first order survives touches."""
+    c = _seeded_cache()
+    assert c._evict_prefix(3) == 0          # all pinned: fast path
+    c.free("donor")
+    assert c.prefix_cached_pages == 3
+    pages, m = c.match_prefix(SYSTEM + [7])   # touch recency
+    c.allocate("warm")
+    c.adopt_prefix("warm", pages, m)          # re-pin everything
+    assert c._evict_prefix(3) == 0          # pinned again: no eviction
+    c.free("warm")
+    assert c._evict_prefix(1) == 1          # deepest leaf goes first
+    assert c.match_prefix(SYSTEM + [7])[1] == 8
+    assert c._evict_prefix(8) == 2          # the rest of the chain
+    assert c.prefix_cached_pages == 0
+    assert c.num_free_pages == c.num_pages
 
 
 def test_preempted_sequence_warm_resumes_from_its_own_run(model):

@@ -30,6 +30,13 @@ Usage:
         # warm TTFT, prefill tokens computed, live shared_pages and
         # COW copies; warm cells pay prefill only for the divergent
         # suffix
+    python tools/gen_bench.py --replicas both
+        # fleet-tier A/B: a shared-system-prompt multi-turn session
+        # workload through serving.FleetRouter at 1 and N replicas,
+        # with the affinity routing ladder (session -> prefix ->
+        # least-loaded) against a random-routing baseline — per-replica
+        # prefix hit rate, shed rate, TTFT p50/p95, and the
+        # prefix-routing confirmation split per cell
     python tools/gen_bench.py --mesh both
         # single-chip vs TENSOR-PARALLEL sharded decode A/B: the same
         # grid run unsharded (tp_degree 1) and over a head-sharded
@@ -400,6 +407,127 @@ def bench_prefix(model, users, sys_tokens, user_tokens, new_tokens,
     }
 
 
+def bench_fleet(model, n_replicas, sessions, sys_tokens, user_tokens,
+                new_tokens, page_size, routing, chunk_tokens, turns=2):
+    """The fleet-tier A/B scenario: `sessions` multi-turn sessions share
+    one system prompt; each session's turn 2 re-sends turn 1's prompt
+    PLUS the streamed answer (the production multi-turn shape that
+    decode-tail indexing warm-hits).  Run once per routing mode —
+    'affinity' (session -> prefix -> least-loaded ladder) vs 'random'
+    (uniform baseline) — reporting per-replica prefix hit rate, shed
+    rate, and TTFT p50/p95: affinity keeps a session's warm pages and a
+    prompt's prefix index on ONE replica, random splits them and pays
+    cold prefills per replica."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.profiler.monitor import StatRegistry
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                          ReplicaSpec)
+
+    # reset fleet.* so each cell's routing counters stand alone (the
+    # per-replica generation.* registries are fresh per FleetRouter)
+    reg = StatRegistry.instance()
+
+    def reset_fleet_stats():
+        for name in list(reg.stats()):
+            if name.startswith(fleet_mod.PREFIX):
+                reg.get_stat(name).reset()
+
+    reset_fleet_stats()
+    total = sys_tokens + turns * (user_tokens + new_tokens)
+    pages = (-(-total // page_size) + 2) * (sessions + 1)
+    specs = [
+        ReplicaSpec(
+            f"r{i}", model,
+            g.GenerationConfig(max_decode_slots=4, num_pages=pages,
+                               page_size=page_size,
+                               queue_depth=sessions * turns + 4,
+                               prefix_cache=True,
+                               prefill_chunk_tokens=chunk_tokens))
+        for i in range(n_replicas)]
+    fl = FleetRouter(specs, FleetConfig(routing=routing, start=False,
+                                        seed=7))
+    rng = np.random.default_rng(sys_tokens * 17 + sessions)
+    half = model.vocab_size // 2
+
+    def run_waves(system, tag, lo, hi):
+        """`turns` waves of `sessions` multi-turn requests.  Each wave
+        submits CONCURRENTLY (queues build, the least-loaded rung sees
+        real depths, TTFT includes queueing) and drains once per turn —
+        the barrier only exists because turn t+1 needs turn t's
+        answers."""
+        handles, history = [], {}
+        for turn in range(turns):
+            wave = []
+            for sess in range(sessions):
+                sfx = rng.integers(lo, hi, user_tokens).tolist()
+                prompt = history.get(sess, list(system)) + sfx
+                h = fl.submit(prompt, max_new_tokens=new_tokens,
+                              session=f"{tag}{sess}")
+                wave.append((sess, prompt, h))
+            fl.run_until_idle()
+            for sess, prompt, h in wave:
+                history[sess] = prompt + h.result(timeout=10).token_ids
+                handles.append(h)
+        return handles
+
+    # warmup: the EXACT measured structure (same wave shapes, batched
+    # prefill buckets included) with tokens from the other half of the
+    # vocab, so every per-shape op warm-up is paid before the timed
+    # waves and nothing it registers can warm the measured prompts.
+    # Then flush the residue and reset the counters: measured waves
+    # start cold with clean books.
+    run_waves(rng.integers(half, model.vocab_size, sys_tokens).tolist(),
+              "w", half, model.vocab_size)
+    for rep in fl._replicas.values():
+        rep.engine.cache.flush_prefix_cache()
+        rep.registry.reset_all()
+    reset_fleet_stats()
+    system = rng.integers(0, half, sys_tokens).tolist()
+    handles = run_waves(system, "s", 0, half)
+    ttfts = sorted(h.first_token_s - h.submitted_s for h in handles)
+    snap = fl.stats_snapshot()
+    per_replica = {}
+    for name, rep in snap["replicas"].items():
+        gstats = rep.get("generation", {})
+        per_replica[name] = {
+            "requests": gstats.get("generation.requests_total", 0),
+            "hit_tokens":
+                gstats.get("generation.prefix_cache_hit_tokens", 0),
+            "hit_rate":
+                gstats.get("generation.prefix_cache_hit_rate", 0.0),
+            "prefill_tokens":
+                gstats.get("generation.prefill_tokens_total", 0),
+        }
+    fl.shutdown()
+    fsnap = snap["fleet"]
+    n_requests = len(handles)
+    return {
+        "scenario": "fleet",
+        "replicas": n_replicas,
+        "routing": routing,
+        "sessions": sessions,
+        "turns": turns,
+        "sys_tokens": sys_tokens,
+        "user_tokens": user_tokens,
+        "new_tokens": new_tokens,
+        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
+        "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 4),
+        "hit_tokens": sum(h.prefix_hit_tokens or 0 for h in handles),
+        "shed_total": fsnap.get("fleet.shed_total", 0),
+        "shed_rate": round(fsnap.get("fleet.shed_total", 0)
+                           / max(n_requests, 1), 3),
+        "routed_affinity": fsnap.get("fleet.routed_affinity", 0),
+        "routed_prefix": fsnap.get("fleet.routed_prefix", 0),
+        "routed_spill": fsnap.get("fleet.routed_spill", 0),
+        "prefix_routed_confirmed":
+            fsnap.get("fleet.prefix_routed_confirmed", 0),
+        "prefix_routed_missed":
+            fsnap.get("fleet.prefix_routed_missed", 0),
+        "per_replica": per_replica,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="1,4,8")
@@ -442,6 +570,19 @@ def main():
     ap.add_argument("--prefix-users", type=int, default=8,
                     help="concurrent users sharing the system prompt "
                          "in the --prefix scenario")
+    ap.add_argument("--replicas", default="0",
+                    help="fleet-tier A/B: '1' (single-replica "
+                         "baseline), 'N' (a 2-replica fleet), 'both', "
+                         "or an explicit replica count; '0' (default) "
+                         "skips the scenario.  Multi-replica cells run "
+                         "TWICE — affinity routing (session -> prefix "
+                         "-> least-loaded) vs random — over a "
+                         "shared-system-prompt multi-turn session "
+                         "workload, reporting per-replica hit rate, "
+                         "shed rate, and TTFT p50/p95")
+    ap.add_argument("--fleet-sessions", type=int, default=8,
+                    help="concurrent sessions in the --replicas "
+                         "scenario (each runs 2 turns)")
     ap.add_argument("--mesh", default="1",
                     help="tensor-parallel A/B: '1' (unsharded), 'N' "
                          "(head-sharded over every visible device), "
@@ -569,6 +710,23 @@ def main():
                     chunk_tokens=args.chunk_tokens))
                 stats_by_series[f"{pool}/prefix-{mode}"] = \
                     reg.stats_snapshot("generation.")
+    if args.replicas != "0":
+        # the fleet-tier A/B: multi-turn sessions over a shared system
+        # prompt, affinity vs random routing per replica count
+        if args.replicas == "both":
+            counts = [1, 2]
+        elif args.replicas == "N":
+            counts = [2]
+        else:
+            counts = [int(args.replicas)]
+        sys_tokens = max(contexts)
+        for n in counts:
+            routings = ("affinity",) if n == 1 else ("affinity", "random")
+            for routing in routings:
+                grid.append(bench_fleet(
+                    model, n, args.fleet_sessions, sys_tokens, 8,
+                    args.new_tokens, args.page_size, routing,
+                    args.chunk_tokens))
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
@@ -580,6 +738,7 @@ def main():
         "tp_degrees": list(tps),
         "chunk_tokens": args.chunk_tokens,
         "prefix": args.prefix,
+        "replicas": args.replicas,
         "grid": grid,
         "stats": stats_by_series,
     }

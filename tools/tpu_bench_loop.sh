@@ -46,15 +46,19 @@ for i in $(seq 1 "$MAX"); do
     # GSPMD decode collectives), and --prefix both lands the
     # prefix-cache A/B (shared-system-prompt workload: warm vs cold
     # TTFT, prefill tokens computed, hit tokens, live shared_pages)
-    # in the same artifact
-    # budget grew with the prefix A/B cells: a timeout kill here drops
-    # the WHOLE gen artifact (mesh/prefill numbers included), so the
-    # cap tracks the scenario count and a kill at least says so
-    timeout 2700 python tools/gen_bench.py --pool both --decode both \
-      --prefill both --mesh both --prefix both \
+    # in the same artifact, and --replicas both lands the fleet-tier
+    # A/B (multi-replica FleetRouter over a shared-system-prompt
+    # multi-turn session workload: per-replica hit rate, shed rate,
+    # TTFT p50/p95 with the affinity routing ladder vs random)
+    # budget grew with the prefix + fleet A/B cells: a timeout kill
+    # here drops the WHOLE gen artifact (mesh/prefill numbers
+    # included), so the cap tracks the scenario count and a kill at
+    # least says so
+    timeout 3000 python tools/gen_bench.py --pool both --decode both \
+      --prefill both --mesh both --prefix both --replicas both \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
