@@ -30,10 +30,13 @@ from .decode_attention import (chunk_prefill_attention,
                                chunk_prefill_attention_reference,
                                dense_causal_reference,
                                paged_decode_attention,
-                               paged_decode_attention_reference)
+                               paged_decode_attention_reference,
+                               ragged_paged_attention,
+                               ragged_paged_attention_reference)
 from .engine import (DEFAULT_PREFILL_CHUNK_TOKENS, GenerationConfig,
                      GenerationEngine, GenerationHandle, GenerationResult)
-from .fused import ChunkedPrefillStep, FusedDecodeStep, decode_batch_menu
+from .fused import (ChunkedPrefillStep, FusedDecodeStep, RaggedStep,
+                    decode_batch_menu)
 from .kv_cache import (DeviceKVPool, OutOfPagesError, PagedKVCache,
                        UnknownSequenceError)
 from .metrics import GenerationMetrics
@@ -50,7 +53,9 @@ __all__ = [
     "dense_causal_reference", "ContinuousBatchingScheduler",
     "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",
     "sample_tokens_batch", "GenerationMetrics", "TinyCausalLM",
-    "FusedDecodeStep", "ChunkedPrefillStep", "decode_batch_menu",
+    "FusedDecodeStep", "ChunkedPrefillStep", "RaggedStep",
+    "decode_batch_menu",
     "chunk_prefill_attention", "chunk_prefill_attention_reference",
+    "ragged_paged_attention", "ragged_paged_attention_reference",
     "DEFAULT_PREFILL_CHUNK_TOKENS",
 ]

@@ -105,6 +105,94 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
         page_tables, seq_lens, scale, interpret=interpret, layout=layout)
 
 
+def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
+                                     starts, lens, kv_lens, scale=None,
+                                     layout="token"):
+    """Pure-jnp RAGGED paged attention: one mixed batch of variable-
+    length query runs — decode rows (1 query) and prefill chunks (many)
+    — packed into ONE token axis, attending through per-sequence page
+    tables (the Ragged Paged Attention serving model, PAPERS.md).
+
+    q: [T, H, D] — the packed query rows of every sequence in the step,
+        sequence s owning rows ``[starts[s], starts[s] + lens[s])``.
+    k_pool, v_pool: one layer's pool — [P, page_size, H, D] for the
+        token layout, [H, P, page_size, D] for layout="kernel".
+    page_tables: [S, max_pages] int32, unused slots padded with 0.
+    starts, lens: [S] int32 — each descriptor's query-row span in the
+        packed axis; ``lens[s] == 0`` marks an UNUSED descriptor
+        (skipped entirely).
+    kv_lens: [S] int32 — tokens resident in the cache for sequence s
+        AFTER this step's writes, so query row r of sequence s sits at
+        global position ``kv_lens[s] - lens[s] + r`` and attends keys
+        ``[0, position]`` (per-row causal).
+    Returns [T, H, D]; rows owned by no descriptor come back exactly 0.
+
+    Exactness follows the decode reference's construction: masked keys
+    are NEG_INF, ``exp(NEG_INF - m)`` underflows to exactly 0.0, and a
+    row's weights are zeroed post-softmax only where already exactly 0
+    — so padding the key axis or the descriptor axis never changes a
+    live row's values.  Like the chunk reference, the end-to-end oracle
+    contract is TOKEN identity against the eager path (XLA picks
+    reduction strategies per shape), the fused-decode standard.
+    """
+    q = jnp.asarray(q)
+    t, h, d = q.shape
+    pt = jnp.asarray(page_tables, jnp.int32)
+    starts = jnp.asarray(starts, jnp.int32)
+    lens = jnp.asarray(lens, jnp.int32)
+    kv_lens = jnp.asarray(kv_lens, jnp.int32)
+    s_n = pt.shape[0]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # gather each descriptor's pages into [S, Kmax, H, D]; bf16 pools
+    # upcast on the gathered view, never the pool
+    k = _gather_pool(jnp.asarray(k_pool), pt, s_n, h, d, layout, q.dtype)
+    v = _gather_pool(jnp.asarray(v_pool), pt, s_n, h, d, layout, q.dtype)
+    kmax = k.shape[1]
+    logits = jnp.einsum("thd,skhd->sthk", q, k) * scale
+    row = jnp.arange(t, dtype=jnp.int32)[None, :]            # [1, T]
+    mine = (row >= starts[:, None]) & (row < (starts + lens)[:, None])
+    # global position of row r within its owner: kv_len - len + (r-start)
+    qpos = (kv_lens - lens)[:, None] + (row - starts[:, None])
+    col = jnp.arange(kmax, dtype=jnp.int32)[None, None, :]   # [1, 1, K]
+    visible = mine[:, :, None] & (col <= qpos[:, :, None])
+    logits = jnp.where(visible[:, :, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    # rows a descriptor doesn't own softmax over all-NEG_INF (uniform
+    # garbage): zero them post-softmax.  Owned rows' masked entries are
+    # already exactly 0, so where() is bitwise-neutral there — the same
+    # safe-row construction as the decode reference's empty-sequence
+    # guard.
+    weights = jnp.where(visible[:, :, None, :], weights, 0.0)
+    # each packed row is owned by at most one descriptor: summing over
+    # the descriptor axis selects its one live contribution
+    return jnp.einsum("sthk,skhd->thd", weights, v)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
+                           kv_lens, scale=None, use_kernel=None,
+                           interpret=None, layout="token"):
+    """Dispatch for the ragged mixed-batch path: the Pallas kernel on
+    TPU (or when forced), the jnp gather reference elsewhere — the
+    exact contract of paged_decode_attention, grown from one query row
+    per sequence to a ragged run of rows per descriptor."""
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    if not use_kernel:
+        return ragged_paged_attention_reference(
+            q, k_pool, v_pool, page_tables, starts, lens, kv_lens,
+            scale=scale, layout=layout)
+    from ..ops.pallas.paged_attention import ragged_paged_attention_kernel
+
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    return ragged_paged_attention_kernel(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        page_tables, starts, lens, kv_lens, scale, interpret=interpret,
+        layout=layout)
+
+
 def chunk_prefill_attention_reference(q, k, v, start, scale=None):
     """Causal attention for ONE prefill chunk over prefix + chunk keys.
 

@@ -131,13 +131,32 @@ class GenerationConfig:
         reduced-precision pools (kv_dtype=bfloat16) the prefix is
         re-read at storage precision — like decode — so tokens may
         differ from one-shot prefill at the storage-rounding level.
-    step_token_budget: per-step token budget for prefill/decode
-        interleaving — one prefill chunk (<= prefill_chunk_tokens) plus
-        one token per decode row must fit, else the decode batch is
-        deferred at most ONE step (the decode-owed starvation guard,
-        generation.decode_stall_steps).  None = auto:
-        prefill_chunk_tokens + max_decode_slots, which always fits both
-        so decode never stalls.  Chunked mode only.
+    step_token_budget: the per-step token capacity — the RAGGED step's
+        fixed packed token axis (decode rows + the prefill chunk pack
+        into exactly this many slots; the executable's token shape, so
+        it never retraces).  None = auto: prefill_chunk_tokens +
+        max_decode_slots (max_decode_slots alone when chunking is off),
+        which always holds the full decode batch plus a whole chunk.
+        A tighter explicit budget clips the CHUNK to the room left
+        after the decode rows (decode never stalls; with chunking on
+        the budget must leave at least one prefill row past the decode
+        batch so prompts cannot starve).  The legacy chunked path no
+        longer budgets at all — every step runs one chunk plus the
+        whole decode batch; the old decode-owed stall dance died with
+        the two-dispatch step it arbitrated (docs/GENERATION.md
+        "Ragged mixed-batch step").
+    step_mode: "ragged" (RaggedStep: the decode batch AND the step's
+        prefill chunk packed into ONE pool-donating mixed-batch
+        dispatch — one executable per pages bucket TOTAL, no dummy
+        decode rows), "legacy" (the FusedDecodeStep /
+        ChunkedPrefillStep pair, or the eager path per `decode`), or
+        None = auto — ragged on TPU when the model implements
+        ragged_step_fn with device pools, legacy elsewhere (the CPU
+        tier-1 oracle stays anchored on the eager legacy path;
+        ragged-vs-legacy token identity is itself oracle-tested,
+        tests/test_ragged_step.py).  step_mode="ragged" replaces the
+        decode and jitted-chunk dispatch paths entirely, so it
+        rejects an explicit `decode=` setting.
     mesh: a ``jax.sharding.Mesh`` (parallel.tp_mesh builds one) turning
         on TENSOR-PARALLEL sharded decode: KV pools, attention, and the
         per-layer QKV/MLP weights shard over the HEAD axis with
@@ -178,7 +197,8 @@ class GenerationConfig:
                  prefill_length_buckets=None, jit_prefill=None,
                  decode=None, decode_batch_buckets=None, pool_layout=None,
                  prefill_chunk_tokens=None, step_token_budget=None,
-                 mesh=None, tp_axis=None, prefix_cache=None):
+                 mesh=None, tp_axis=None, prefix_cache=None,
+                 step_mode=None):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -242,6 +262,16 @@ class GenerationConfig:
                 f"prefix_cache must be True, False or None (auto), got "
                 f"{prefix_cache!r}")
         self.prefix_cache = prefix_cache
+        if step_mode not in (None, "legacy", "ragged"):
+            raise ValueError(
+                f"step_mode must be 'legacy', 'ragged' or None (auto), "
+                f"got {step_mode!r}")
+        if step_mode == "ragged" and decode is not None:
+            raise ValueError(
+                "step_mode='ragged' replaces the decode dispatch path "
+                "(one mixed-batch executable serves decode AND prefill "
+                f"chunks); decode={decode!r} makes no sense with it")
+        self.step_mode = step_mode
 
 
 class GenerationResult:
@@ -413,17 +443,37 @@ class GenerationEngine:
         fusable = (backend == "device"
                    and hasattr(model, "decode_step_fn")
                    and hasattr(model, "decode_params"))
+        # ragged mixed-batch step: ONE pool-donating dispatch serves the
+        # decode batch and the step's prefill chunk — auto on TPU when
+        # the model implements the ragged protocol, legacy elsewhere
+        # (the CPU tier-1 oracle stays anchored on the eager legacy
+        # path; ragged-vs-legacy identity is itself oracle-tested)
+        ragged_capable = (backend == "device"
+                         and hasattr(model, "ragged_step_fn")
+                         and hasattr(model, "decode_params"))
+        step_mode = self.config.step_mode
+        if step_mode is None:
+            step_mode = "ragged" if (on_tpu and ragged_capable) else \
+                "legacy"
+        if step_mode == "ragged" and not ragged_capable:
+            raise ValueError(
+                "step_mode='ragged' needs kv_backend='device' and a "
+                "model implementing ragged_step_fn/decode_params "
+                f"(backend={backend!r}, model={type(model).__name__})")
+        self.step_mode = step_mode
         decode = self.config.decode
-        if decode is None:
+        if step_mode == "ragged":
+            decode = "ragged"
+        elif decode is None:
             decode = ("fused" if ((on_tpu or mesh is not None) and fusable)
                       else "eager")
-        if mesh is not None and decode != "fused":
+        if mesh is not None and decode not in ("fused", "ragged"):
             raise ValueError(
-                "mesh-sharded decode runs only on the fused path (one "
-                "GSPMD dispatch per step); decode='eager' under a mesh "
-                "is not supported — the eager single-chip path is the "
-                "oracle sharded decode is measured against.  The model "
-                "must implement decode_step_fn/decode_params "
+                "mesh-sharded decode runs only on the fused or ragged "
+                "path (one GSPMD dispatch per step); decode='eager' "
+                "under a mesh is not supported — the eager single-chip "
+                "path is the oracle sharded decode is measured against."
+                "  The model must implement decode_step_fn/decode_params "
                 f"({type(model).__name__})")
         elif decode == "fused" and not fusable:
             raise ValueError(
@@ -432,6 +482,7 @@ class GenerationEngine:
                 f"(backend={backend!r}, model={type(model).__name__})")
         self.decode_mode = decode
         self._fused = None
+        self._ragged = None
         if decode == "fused":
             from .fused import FusedDecodeStep, decode_batch_menu
 
@@ -460,25 +511,32 @@ class GenerationEngine:
         chunk_eager_ok = hasattr(model, "prefill_chunk")
         chunk = self.config.prefill_chunk_tokens
         if chunk is None:
-            # auto only picks the JITTED chunk path (device pools +
-            # prefill_chunk_fn + jit_prefill), mirroring the decode auto
-            # policy: on TPU the fast path or nothing — the per-layer
-            # eager chunk loop would REGRESS TTFT vs one jitted full
-            # prefill, so eager chunking stays explicit opt-in (it is
-            # the CPU oracle path).  jit_prefill=False must degrade to
-            # full prefill, never raise on a config the user didn't
-            # write.
+            # auto only picks a JITTED chunk path, mirroring the decode
+            # auto policy: on TPU the fast path or nothing — the
+            # per-layer eager chunk loop would REGRESS TTFT vs one
+            # jitted full prefill, so eager chunking stays explicit
+            # opt-in (it is the CPU oracle path).  The ragged step IS a
+            # jitted chunk path (chunks ride the one mixed-batch
+            # dispatch); otherwise device pools + prefill_chunk_fn +
+            # jit_prefill are required, and jit_prefill=False must
+            # degrade to full prefill, never raise on a config the user
+            # didn't write.
             chunk = (DEFAULT_PREFILL_CHUNK_TOKENS
-                     if on_tpu and chunk_jitable and jit_prefill else 0)
-        elif chunk and not (chunk_jitable or chunk_eager_ok):
+                     if (step_mode == "ragged"
+                         or (on_tpu and chunk_jitable and jit_prefill))
+                     else 0)
+        elif chunk and not (chunk_jitable or chunk_eager_ok
+                            or step_mode == "ragged"):
             raise ValueError(
                 f"prefill_chunk_tokens={chunk} needs a model implementing "
                 f"prefill_chunk (eager) or prefill_chunk_fn + "
-                f"decode_params with kv_backend='device' "
-                f"({type(model).__name__} has neither)")
+                f"decode_params with kv_backend='device', or the ragged "
+                f"step ({type(model).__name__} has none)")
         self.prefill_chunk_tokens = chunk
         self._chunk_step = None
-        if chunk and jit_prefill and chunk_jitable:
+        if step_mode == "ragged":
+            pass  # chunks ride the ragged dispatch; no separate step
+        elif chunk and jit_prefill and chunk_jitable:
             from .fused import ChunkedPrefillStep
 
             self._chunk_step = ChunkedPrefillStep(
@@ -516,11 +574,32 @@ class GenerationEngine:
                 f"({type(model).__name__})")
         self.prefix_cache_enabled = bool(prefix)
         self.scheduler.prefix_cache = self.prefix_cache_enabled
+        slots = self.config.max_decode_slots
         self.step_token_budget = (
             self.config.step_token_budget
             if self.config.step_token_budget is not None
-            else (chunk + self.config.max_decode_slots if chunk else None))
-        self._stall_run = 0  # consecutive decode-stalled steps (gauge)
+            else (chunk + slots if chunk
+                  else (slots if step_mode == "ragged" else None)))
+        if step_mode == "ragged":
+            # the budget IS the ragged executable's packed token axis:
+            # it must hold the full decode batch, plus at least one
+            # prefill row when chunking is on (a full batch that never
+            # finished would otherwise starve prompts forever)
+            need = slots + (1 if chunk else 0)
+            if self.step_token_budget < need:
+                raise ValueError(
+                    f"step_token_budget={self.step_token_budget} < "
+                    f"{need}: the ragged step's packed token axis must "
+                    f"hold every decode slot"
+                    + (" plus at least one prefill-chunk row"
+                       if chunk else ""))
+            from .fused import RaggedStep
+
+            self._ragged = RaggedStep(
+                model, self.cache, self.metrics,
+                max_tokens=self.step_token_budget,
+                max_seqs=slots + 1, use_kernel=self._use_kernel,
+                mesh=mesh, tp_axis=tp_axis)
         self.metrics.set_mesh_devices(self.tp_degree)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
@@ -646,6 +725,8 @@ class GenerationEngine:
     def _step_locked(self):
         from ..profiler import RecordEvent
 
+        if self._ragged is not None:
+            return self._step_ragged()
         if self.prefill_chunk_tokens:
             return self._step_chunked()
         # bounded prefill work per step: at most one batched-prefill
@@ -666,9 +747,29 @@ class GenerationEngine:
                     return 0
                 self._decode_batch(active)
         self.metrics.observe_step(len(active), timer.seconds)
+        self._observe_step_rows(len(active))
         self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
         return len(active)
+
+    def _observe_step_rows(self, decode_rows, chunk_useful=0,
+                           chunk_dispatched=0):
+        """Emit the step's row accounting (legacy paths): the decode
+        dispatch's useful/padded rows — the fused step's bucket padding
+        is exactly the masked dummy work padded_token_waste counts; the
+        eager path pads nothing — plus whatever chunk dispatch the
+        caller ran.  The ragged path emits its own (waste 0 by
+        construction)."""
+        if self._fused is not None and decode_rows:
+            useful = self._fused.last_rows_useful
+            dispatched = self._fused.last_rows_dispatched
+        else:
+            useful = dispatched = decode_rows
+        useful += chunk_useful
+        dispatched += chunk_dispatched
+        if dispatched:
+            self.metrics.observe_step_rows(useful, dispatched,
+                                           dispatched - useful)
 
     def _decode_batch(self, active):
         """One decode dispatch (fused or eager) + sampling for `active`."""
@@ -683,23 +784,32 @@ class GenerationEngine:
             self._apply_logits_batch(active, logits)
 
     def _step_chunked(self):
-        """One token-budgeted chunked-prefill step: admit, at most ONE
-        prefill-chunk dispatch (the oldest mid-prefill sequence), then
-        the decode batch — unless the budget says decode waits, which
-        the decode-owed guard bounds to a single consecutive step
-        (generation.decode_stall_steps)."""
+        """One legacy chunked-prefill step: admit, at most ONE prefill-
+        chunk dispatch (the oldest mid-prefill sequence), plus the
+        whole decode batch — every step.  There is no token-budget
+        competition anymore: the decode-owed stall dance existed to
+        arbitrate the two dispatches a tight budget couldn't afford
+        together, and it died when the ragged step put both in ONE
+        dispatch; the legacy path keeps its two dispatches but simply
+        runs both (decode never stalls)."""
         from ..profiler import RecordEvent
 
         self.scheduler.admit(limit=self.config.max_prefill_batch)
         self._reap_deadlines()
-        chunk_state, chunk_len, decode, stalled = \
-            self.scheduler.plan_step(self.prefill_chunk_tokens,
-                                     self.step_token_budget)
+        chunk_state, chunk_len = self.scheduler.plan_step(
+            self.prefill_chunk_tokens)
         advanced = 0
+        chunk_u = chunk_d = chunk_dispatched = 0
         if chunk_state is not None:
             if self._prefill_chunk_step(chunk_state, chunk_len):
                 advanced += 1
-        decoding = self.scheduler.decode_ready() if decode else []
+                if self._chunk_step is not None:
+                    chunk_u = self._chunk_step.last_rows_useful
+                    chunk_d = self._chunk_step.last_rows_dispatched
+                    chunk_dispatched = 1   # the jitted chunk dispatch
+                else:
+                    chunk_u = chunk_d = chunk_len  # eager: exact rows
+        decoding = self.scheduler.decode_ready()
         if decoding:
             with StepTimer() as timer:
                 with RecordEvent("generation::decode_step"):
@@ -709,11 +819,161 @@ class GenerationEngine:
             if decoding:
                 self.metrics.observe_step(len(decoding), timer.seconds)
                 advanced += len(decoding)
-        self._stall_run = self._stall_run + 1 if stalled else 0
-        self.metrics.observe_decode_stall(self._stall_run)
+        if chunk_dispatched:
+            # the step really issued TWO device programs (chunk +
+            # decode) — the gauge must say so, or the legacy-vs-ragged
+            # dispatches-per-step A/B reads a false 1 vs 1.  A
+            # chunk-only step is the chunk's one dispatch (its host
+            # sync, if any, is the final chunk's logits fetch).
+            if decoding:
+                self.metrics.count_step_extra_dispatches(1)
+            else:
+                self.metrics.observe_decode_step(
+                    1, 0 if chunk_state.prefilling else 1)
+        self._observe_step_rows(len(decoding), chunk_u, chunk_d)
         self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
         return advanced
+
+    # --------------------------- ragged step -------------------------
+    def _step_ragged(self):
+        """One RAGGED mixed-batch step: the decode batch's single-token
+        rows AND the step's prefill chunk packed into ONE pool-donating
+        dispatch (fused.RaggedStep) — no dummy decode rows, no separate
+        chunk dispatch, one executable per pages bucket TOTAL.
+
+        Order mirrors the legacy chunked step: plan and reserve the
+        chunk FIRST (its reservation may preempt youngest decode
+        sequences — they simply drop out of the decode batch), then the
+        decode capacity check (which may preempt the chunker — its
+        freed rows drop out of the pack)."""
+        from ..profiler import RecordEvent
+
+        admitted = self.scheduler.admit(limit=self.config.max_prefill_batch)
+        if not self.prefill_chunk_tokens:
+            # no chunking: prompts take the one-shot prefill paths and
+            # only decode rides the ragged dispatch
+            self._prefill_admitted(admitted)
+        self._reap_deadlines()
+        chunk_state, chunk_len, chunk_start = None, 0, 0
+        if self.prefill_chunk_tokens:
+            room = self.step_token_budget - \
+                len(self.scheduler.decode_ready())
+            chunk_state, chunk_len = self.scheduler.plan_step(
+                self.prefill_chunk_tokens, max_chunk=room)
+            if chunk_state is not None:
+                chunk_start = self._reserve_chunk(chunk_state, chunk_len)
+                if chunk_start is None:
+                    chunk_state, chunk_len = None, 0
+        decoding = self.scheduler.decode_ready()
+        if decoding:
+            decoding = self._ensure_step_capacity()
+        if chunk_state is not None and (chunk_state.slot is None
+                                        or not chunk_state.prefilling):
+            # the decode capacity check preempted the chunker: its
+            # reserved span died with its pages — drop it from the pack
+            chunk_state, chunk_len = None, 0
+        if not decoding and chunk_state is None:
+            self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+            self._observe_occupancy()
+            return 0
+        with StepTimer() as timer:
+            with RecordEvent("generation::ragged_step"):
+                advanced, sampled = self._dispatch_ragged(
+                    decoding, chunk_state, chunk_len, chunk_start)
+        if sampled:
+            self.metrics.observe_step(sampled, timer.seconds)
+        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+        self._observe_occupancy()
+        return advanced
+
+    def _dispatch_ragged(self, decoding, chunk_state, chunk_len,
+                         chunk_start):
+        """Pack, dispatch, sample: rows [0, B) are the decode batch
+        (slot order, one new token each), rows [B, B + C) the prefill
+        chunk; descriptor i covers decode sequence i (len 1), and
+        descriptor B the chunk (len C).  Returns ``(advanced,
+        sampled)``."""
+        b, c = len(decoding), chunk_len
+        seq_ids, d_tokens, positions = self._reserve_decode_rows(decoding)
+        tokens = list(d_tokens)
+        desc_ids = list(seq_ids)
+        if c:
+            # COW-safe donation chain for the chunk span, mirroring the
+            # decode rows' guard in _reserve_decode_rows
+            self.cache.check_span_writable(chunk_state.seq_id,
+                                           chunk_start, c)
+            tokens += chunk_state.tokens[chunk_start:chunk_start + c]
+            desc_ids.append(chunk_state.seq_id)
+        # kv_lens straight off the cache: a decode row's length already
+        # includes its reserved token, the chunk's its whole span —
+        # and pt row i IS descriptor i's table, so the scatter targets
+        # below index it directly (one table walk per step, not two)
+        pt, kv_lens = self.cache.gather_block_tables(desc_ids)
+        t_real = b + c
+        pos_all = np.zeros((t_real,), np.int32)
+        pages = np.empty((t_real,), np.int32)
+        rows = np.empty((t_real,), np.int32)
+        ps = self.cache.page_size
+        if b:
+            pos_all[:b] = positions
+            pages[:b] = pt[np.arange(b), positions // ps]
+            rows[:b] = positions % ps
+        if c:
+            span = np.arange(chunk_start, chunk_start + c)
+            pos_all[b:] = span
+            pages[b:] = pt[b, span // ps]
+            rows[b:] = span % ps
+        starts = np.arange(len(desc_ids), dtype=np.int32)
+        lens = np.ones((len(desc_ids),), np.int32)
+        if c:
+            starts[-1] = b
+            lens[-1] = c
+        ids_dev, logits_dev = self._ragged.step(
+            np.asarray(tokens, np.int32), pos_all, pages, rows, pt,
+            starts, lens, kv_lens)
+        # the scatter ran inside the dispatch; keep the O(tokens) write
+        # bound visible in kv_bytes_moved (comparable across paths)
+        self.cache.count_fused_append(t_real)
+        finishing = None
+        if c:
+            chunk_state.prefill_pos += c
+            self.metrics.count_prefill(c)
+            self.metrics.count_chunk()
+            self._prewarm_decode(chunk_state)
+            if chunk_state.prefill_pos == len(chunk_state.tokens):
+                chunk_state.prefilling = False
+                self._register_prefix(chunk_state)
+                finishing = chunk_state
+        # samplers: every decode row, plus the chunk's last row when it
+        # just completed its prompt (those logits ARE the first-token
+        # logits).  A mid-prompt chunk-only step fetches NOTHING — zero
+        # host syncs, exactly like the legacy unmaterialized chunks.
+        samplers = list(decoding)
+        rows_idx = list(range(b))
+        if finishing is not None:
+            samplers.append(finishing)
+            rows_idx.append(b)
+        syncs = 0
+        if samplers:
+            syncs = 1
+            if all(s.request.params.greedy for s in samplers):
+                ids_h = np.asarray(ids_dev)      # the single host sync
+                self._apply_tokens(samplers, ids_h[rows_idx])
+            else:
+                logits_h = np.asarray(logits_dev)
+                self._apply_logits_batch(samplers, logits_h[rows_idx])
+        self.metrics.observe_decode_step(self._ragged.last_dispatches,
+                                         syncs)
+        self.metrics.observe_collective_bytes(
+            self._ragged.last_collective_bytes)
+        # zero padded_token_waste by construction: descriptors cover
+        # exactly the packed rows; the fixed axis's inert slots are
+        # reported by step_row_utilization, not counted as dummy work
+        self.metrics.observe_step_rows(self._ragged.last_rows_useful,
+                                       self._ragged.last_rows_dispatched,
+                                       0)
+        return b + (1 if c else 0), len(samplers)
 
     def run_until_idle(self, max_steps=100000):
         """Drive step() until queue+slots drain (tests/benchmarks)."""
@@ -898,16 +1158,14 @@ class GenerationEngine:
                 self.cache.register_prefix(state.seq_id, state.tokens))
 
     # ------------------------ chunked prefill -----------------------
-    def _prefill_chunk_step(self, state, n):
-        """Dispatch ONE prefill chunk for `state`: reserve `n` tokens
-        (incremental reservation growth — preempting youngest-others on
-        page shortage), run the chunk through the jitted
-        ChunkedPrefillStep or the eager attend path, and on the FINAL
-        chunk sample the first token from the chunk's last-position
-        logits (they ARE the next-token logits, exactly as in full
-        prefill).  Returns True when the chunk ran."""
-        from ..profiler import RecordEvent
-
+    def _reserve_chunk(self, state, n):
+        """Grow `state`'s reservation by its next `n` chunk tokens,
+        preempting youngest-others on page shortage (never the chunker
+        itself — preempting it to feed itself would free nothing it can
+        keep).  Returns the span start, or None after a typed failure
+        retired the sequence (the pool cannot hold its prefix even
+        alone).  Shared by the legacy chunk dispatch and the ragged
+        step's chunk packing."""
         while True:
             try:
                 start = self.cache.reserve(state.seq_id, n)
@@ -921,9 +1179,24 @@ class GenerationEngine:
                 # cannot hold this prefix: typed failure
                 self.scheduler.retire(state)
                 state.handle.set_exception(e)
-                return False
+                return None
         assert start == state.prefill_pos, \
             "cache length diverged from prefill progress"
+        return start
+
+    def _prefill_chunk_step(self, state, n):
+        """Dispatch ONE prefill chunk for `state`: reserve `n` tokens
+        (incremental reservation growth — preempting youngest-others on
+        page shortage), run the chunk through the jitted
+        ChunkedPrefillStep or the eager attend path, and on the FINAL
+        chunk sample the first token from the chunk's last-position
+        logits (they ARE the next-token logits, exactly as in full
+        prefill).  Returns True when the chunk ran."""
+        from ..profiler import RecordEvent
+
+        start = self._reserve_chunk(state, n)
+        if start is None:
+            return False
         tokens = state.tokens[start:start + n]
         with RecordEvent("generation::prefill"):
             if self._chunk_step is not None:
@@ -986,7 +1259,18 @@ class GenerationEngine:
         sequence will land in.  No-op on the eager decode path.
         Returns True when this call actually compiled (counted in
         decode_compiles_total with the `prewarm` tag,
-        decode_compiles_prewarm)."""
+        decode_compiles_prewarm).  On the ragged path the pages bucket
+        is the WHOLE signature — batch_rows and greedy are ignored
+        (the one executable serves every batch size and sampling
+        mix)."""
+        if self._ragged is not None:
+            try:
+                compiled = self._ragged.prewarm(pages_cols)
+            except RequestTooLargeError:
+                return False
+            if compiled:
+                self.metrics.count_decode_prewarm()
+            return compiled
         if self._fused is None:
             return False
         try:
@@ -999,10 +1283,13 @@ class GenerationEngine:
 
     def _prewarm_decode(self, state):
         """Decode-bucket pre-warm: while `state` is mid-prefill, compile
-        the fused decode executable for the (batch bucket, pages bucket,
-        greedy) signature it will land in, so its first decode step pays
-        no retrace.  At most once per prefill."""
-        if self._fused is None or state.prewarmed or not state.prefilling:
+        the executable its first decode step will land in, so the
+        prefill->decode seam pays no retrace — the fused (batch bucket,
+        pages bucket, greedy) signature, or on the ragged path the
+        pages bucket alone (the only signature axis).  At most once per
+        prefill."""
+        if (self._fused is None and self._ragged is None) \
+                or state.prewarmed or not state.prefilling:
             return
         state.prewarmed = True
         decoding = self.scheduler.decode_ready()
@@ -1055,21 +1342,29 @@ class GenerationEngine:
                 f"{self.cache.page_size}) has none free even with every "
                 f"other sequence preempted"))
 
-    def _decode_inputs(self, active):
-        """Reserve this step's token per sequence and batch the step
-        inputs (page tables/lengths cannot change within the step —
-        every page it touches was just reserved)."""
+    def _reserve_decode_rows(self, active):
+        """Reserve this step's token per decode sequence and gather the
+        per-row inputs (seq ids, last tokens, positions) — ONE home for
+        the reserve + COW-guard + token-gather contract, shared by the
+        legacy decode paths and the ragged pack.  The COW guard: the
+        in-trace scatter must never land in a prefix-shared page —
+        reserve() just privatized each tail page, verified host-side
+        here (only meaningful, and only paid, when sharing can exist
+        at all)."""
         seq_ids = [s.seq_id for s in active]
         positions = np.asarray(
             [self.cache.reserve(s.seq_id, 1) for s in active], np.int32)
-        # COW-safe donation chain (fused path): the in-trace scatter
-        # must never land in a prefix-shared page — reserve() just
-        # privatized each tail page, verified host-side here.  Only
-        # meaningful (and only paid) when sharing can exist at all
         if self.prefix_cache_enabled:
             for sid, pos in zip(seq_ids, positions):
                 self.cache.check_span_writable(sid, int(pos), 1)
         tokens = np.asarray([s.tokens[-1] for s in active], np.int32)
+        return seq_ids, tokens, positions
+
+    def _decode_inputs(self, active):
+        """Reserve this step's token per sequence and batch the step
+        inputs (page tables/lengths cannot change within the step —
+        every page it touches was just reserved)."""
+        seq_ids, tokens, positions = self._reserve_decode_rows(active)
         pt, lens = self.cache.gather_block_tables(seq_ids)
         return seq_ids, tokens, positions, pt, lens
 

@@ -51,29 +51,33 @@ from ..serving.bucketing import CompiledModelCache, ShapeBucketer
 from .metrics import DecodeCacheMetrics
 
 
-def _wrap_donating(num_layers, tree, jax_mod, call):
+def _wrap_donating(num_layers, tree, jax_mod, call, n_fixed=4, n_out=1):
     """Flatten a pool-donating step fn to the positional-array calling
     convention CompiledModelCache keys and compiles on:
-    ``(*fixed4, *k_pools, *v_pools, *param_leaves)``.  `call(params,
+    ``(*fixed, *k_pools, *v_pools, *param_leaves)``.  `call(params,
     fixed, k_pools, v_pools)` adapts to the inner fn's own argument
-    order and returns ``(out, k_out, v_out)``."""
+    order and returns ``(out, k_out, v_out)`` — `out` a single array
+    when n_out == 1, else a tuple of n_out arrays (the ragged step's
+    ids + logits)."""
     unflatten = jax_mod.tree_util.tree_unflatten
 
     def step(*flat):
-        fixed, leaves = flat[:4], flat[4:]
+        fixed, leaves = flat[:n_fixed], flat[n_fixed:]
         k_pools = list(leaves[:num_layers])
         v_pools = list(leaves[num_layers:2 * num_layers])
         params = unflatten(tree, leaves[2 * num_layers:])
         out, k_out, v_out = call(params, fixed, k_pools, v_pools)
-        return (out, *k_out, *v_out)
+        outs = (out,) if n_out == 1 else tuple(out)
+        return (*outs, *k_out, *v_out)
 
     return step
 
 
-# pools sit at wrapper args 4 .. 4+2L in that convention: donated so XLA
-# updates the KV storage in place instead of copying the pool every call
-def _pool_donate_plan(num_layers):
-    return tuple(range(4, 4 + 2 * num_layers))
+# pools sit at wrapper args n_fixed .. n_fixed+2L in that convention:
+# donated so XLA updates the KV storage in place instead of copying the
+# pool every call
+def _pool_donate_plan(num_layers, n_fixed=4):
+    return tuple(range(n_fixed, n_fixed + 2 * num_layers))
 
 
 def _shard_params(model, mesh, tp_axis, jax_mod):
@@ -121,24 +125,25 @@ def _collective_bytes_estimate(num_layers, rows, d_model, tp_degree,
                / tp_degree)
 
 
-def _dispatch_donating(cache, exec_cache, args, num_layers):
+def _dispatch_donating(cache, exec_cache, args, num_layers, n_out=1):
     """Run ONE compiled pool-donating dispatch: compile/fetch the
     executable for `args`' signature, dispatch, install the returned
     pools.  On ANY failure past the dispatch the donated pool buffers
     are gone — leave the cache on fresh storage so the engine's
     fail-the-batch-and-keep-serving recovery (engine._worker) actually
-    keeps serving.  This recovery contract lives HERE, once, for both
-    the fused decode step and the chunked prefill step.  Returns the
-    non-pool output, unmaterialized (no host sync)."""
+    keeps serving.  This recovery contract lives HERE, once, for every
+    pool-donating step (fused decode, chunked prefill, ragged).
+    Returns the non-pool output (a tuple when n_out > 1),
+    unmaterialized (no host sync)."""
     exe = exec_cache.get(args)
     try:
         outs = exe(*args)
-        pools = outs[1:]
+        pools = outs[n_out:]
         cache.put_pools(pools[:num_layers], pools[num_layers:])
     except BaseException:
         cache.reset_pools()
         raise
-    return outs[0]
+    return outs[0] if n_out == 1 else tuple(outs[:n_out])
 
 
 def decode_batch_menu(max_slots):
@@ -282,6 +287,10 @@ class FusedDecodeStep:
         host = np.asarray(out)                 # the single host sync
         self.last_dispatches = 1
         self.last_syncs = 1
+        # padding-waste accounting: bucket_b - b_real DUMMY rows ran the
+        # whole masked step (generation.padded_token_waste)
+        self.last_rows_useful = b_real
+        self.last_rows_dispatched = bucket_b
         self.last_collective_bytes = _collective_bytes_estimate(
             self._num_layers, bucket_b, self._d_model, self._tp)
         return host[:b_real]
@@ -387,5 +396,180 @@ class ChunkedPrefillStep:
                 *k_pools, *v_pools, *self._param_leaves]
         self.last_collective_bytes = _collective_bytes_estimate(
             self._num_layers, self._chunk, self._d_model, self._tp)
+        # chunk-axis padding rows (chunk - n) are masked dummy work
+        # inside this sequence's dispatch (generation.padded_token_waste)
+        self.last_rows_useful = n
+        self.last_rows_dispatched = self._chunk
         return _dispatch_donating(self._cache, self._exec, args,
                                   self._num_layers)
+
+
+class RaggedStep:
+    """ONE mixed-batch executable per engine step — the Ragged Paged
+    Attention serving model (PAPERS.md): the decode batch's single-token
+    rows AND the step's prefill chunk ride one PACKED token axis of
+    fixed size `max_tokens`, described by per-sequence
+    ``[start, len, kv_len]`` descriptors, through one pool-donating
+    dispatch.
+
+    This collapses the legacy pair (FusedDecodeStep + ChunkedPrefillStep
+    = one executable per (decode-batch bucket, pages bucket, greedy)
+    signature PLUS one per pages bucket) into ONE executable per pages
+    bucket TOTAL:
+
+    - the token axis is fixed at `max_tokens` forever, so batch size,
+      chunk length, and the decode/prefill mix never retrace;
+    - the descriptor axis is fixed at `max_seqs`;
+    - greedy is folded in: the trace computes BOTH the on-device argmax
+      ids [S] and the logits [S, V] and returns them unmaterialized —
+      the engine fetches ids for an all-greedy step, logits when any
+      sampler is stochastic, and nothing for a mid-prompt chunk-only
+      step, so every step stays at exactly 1 dispatch and <= 1 host
+      sync.
+
+    No dummy sequences exist in this design: every descriptor is a real
+    sequence and packed slots past the real rows belong to none — no
+    pool write (sentinel page), no attention (descriptor-skipped), no
+    logits row.  That is the zero of `generation.padded_token_waste`;
+    the inert-slot fraction of the fixed axis is reported honestly by
+    `generation.step_row_utilization` instead.
+
+    Compiles/hits land under the DECODE cache metrics — the ragged
+    executable IS the step executable (the prefill counters keep
+    meaning what they always did on the legacy path)."""
+
+    def __init__(self, model, cache, metrics, max_tokens, max_seqs,
+                 use_kernel=False, mesh=None, tp_axis=None):
+        import jax
+
+        self._jax = jax
+        self._cache = cache
+        self._num_layers = int(cache.num_layers)
+        self.max_tokens = int(max_tokens)
+        self.max_seqs = int(max_seqs)
+        if self.max_tokens < 1 or self.max_seqs < 1:
+            raise ValueError("max_tokens and max_seqs must be >= 1")
+        self._mesh = mesh
+        self._tp_axis = tp_axis
+        self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
+        self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._param_leaves, self._param_tree = _shard_params(
+            model, mesh, tp_axis, jax)
+        pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
+        self._bucketer = ShapeBucketer(batch_buckets=(1,),
+                                       length_buckets=pages_menu)
+        step_kw = ({"mesh": mesh, "tp_axis": tp_axis}
+                   if mesh is not None else {})
+        fn = model.ragged_step_fn(
+            cache.page_size, cache.num_pages, use_kernel=use_kernel,
+            pool_layout=cache.pool_layout, **step_kw)
+        # fixed args: (tokens, positions, pages, rows, page_tables,
+        #              starts, lens, kv_lens); pools donated after them
+        self._n_fixed = 8
+        wrapped = _wrap_donating(
+            self._num_layers, self._param_tree, jax,
+            lambda params, f, k, v: fn(params, *f, k, v),
+            n_fixed=self._n_fixed, n_out=2)
+        self._exec = CompiledModelCache(
+            wrapped, metrics=DecodeCacheMetrics(metrics), aot=True,
+            donate_argnums=_pool_donate_plan(self._num_layers,
+                                             self._n_fixed))
+        self.last_dispatches = 0
+        self.last_collective_bytes = 0
+        self.last_rows_useful = 0
+        self.last_rows_dispatched = 0
+
+    @property
+    def compile_count(self):
+        """Distinct signatures compiled — exactly the pages buckets
+        touched, independent of batch size, chunk length, and greedy
+        (the acceptance bound tests/test_ragged_step.py pins)."""
+        return self._exec.compile_count
+
+    def cached_buckets(self):
+        return self._exec.cached_buckets()
+
+    def _fixed_structs(self, bucket_p):
+        sds = self._jax.ShapeDtypeStruct
+        i32 = np.dtype(np.int32)
+        t, s = self.max_tokens, self.max_seqs
+        return [sds((t,), i32), sds((t,), i32), sds((t,), i32),
+                sds((t,), i32), sds((s, bucket_p), i32),
+                sds((s,), i32), sds((s,), i32), sds((s,), i32)]
+
+    def prewarm(self, pages_cols):
+        """AOT-compile the executable for a pages bucket WITHOUT
+        dispatching (pure ShapeDtypeStructs; under a mesh they carry
+        the pool and param NamedShardings, exactly like
+        FusedDecodeStep.prewarm).  The ragged menu has no batch or
+        greedy axis, so this is the WHOLE pre-warm surface.  Returns
+        True when this call actually compiled."""
+        bucket_p = self._bucketer.length_bucket(max(int(pages_cols), 1))
+        sds = self._jax.ShapeDtypeStruct
+        pool = self._cache.layer_pools(0)[0]
+        args = self._fixed_structs(bucket_p)
+        if self._mesh is not None:
+            pool_sds = sds(tuple(pool.shape), pool.dtype,
+                           sharding=self._cache.pool_sharding)
+            args += [pool_sds] * (2 * self._num_layers)
+            args += [sds(tuple(p.shape), p.dtype, sharding=p.sharding)
+                     for p in self._param_leaves]
+        else:
+            args += [sds(tuple(pool.shape), pool.dtype)] * \
+                (2 * self._num_layers)
+            args += [sds(tuple(p.shape), p.dtype)
+                     for p in self._param_leaves]
+        before = self._exec.compile_count
+        self._exec.get(args)
+        return self._exec.compile_count > before
+
+    def step(self, tokens, positions, pages, rows, page_tables, starts,
+             lens, kv_lens):
+        """Dispatch one packed mixed-batch step.  All inputs are the
+        PACKED host arrays (the engine built them at exact sizes);
+        this pads the token axis to `max_tokens` with inert slots
+        (sentinel page, position 0), the descriptor axis to `max_seqs`
+        with len-0 descriptors, and the page-table axis to its pages
+        bucket — then runs the ONE donated dispatch.  Returns
+        ``(ids [S], logits [S, V])`` UNMATERIALIZED: the caller fetches
+        at most one of them (its single host sync)."""
+        t_real = len(tokens)
+        s_real = len(starts)
+        if t_real > self.max_tokens:
+            raise ValueError(
+                f"{t_real} packed rows > max_tokens={self.max_tokens}")
+        if s_real > self.max_seqs:
+            raise ValueError(
+                f"{s_real} descriptors > max_seqs={self.max_seqs}")
+        t, s = self.max_tokens, self.max_seqs
+        tok = np.zeros((t,), np.int32)
+        tok[:t_real] = tokens
+        pos = np.zeros((t,), np.int32)
+        pos[:t_real] = positions
+        pg = np.full((t,), self._cache.num_pages, np.int32)  # sentinel
+        pg[:t_real] = pages
+        rw = np.zeros((t,), np.int32)
+        rw[:t_real] = rows
+        page_tables = np.asarray(page_tables, np.int32)
+        bucket_p = self._bucketer.length_bucket(
+            max(page_tables.shape[1] if page_tables.size else 1, 1))
+        pt = np.zeros((s, bucket_p), np.int32)
+        if page_tables.size:
+            pt[:s_real, :page_tables.shape[1]] = page_tables
+        st = np.zeros((s,), np.int32)
+        st[:s_real] = starts
+        ln = np.zeros((s,), np.int32)
+        ln[:s_real] = lens
+        kv = np.zeros((s,), np.int32)
+        kv[:s_real] = kv_lens
+        k_pools, v_pools = self._cache.take_pools()
+        args = [tok, pos, pg, rw, pt, st, ln, kv,
+                *k_pools, *v_pools, *self._param_leaves]
+        ids, logits = _dispatch_donating(
+            self._cache, self._exec, args, self._num_layers, n_out=2)
+        self.last_dispatches = 1
+        self.last_rows_useful = t_real
+        self.last_rows_dispatched = t
+        self.last_collective_bytes = _collective_bytes_estimate(
+            self._num_layers, t, self._d_model, self._tp)
+        return ids, logits

@@ -45,12 +45,37 @@ Metric names:
                                       signatures touched)
 - ``generation.decode_cache_hits`` / ``_misses``  fused bucket cache
 - ``generation.prefill_chunks_total``  chunked-prefill dispatches (one
-                                      chunk of one prompt each)
-- ``generation.decode_stall_steps``   gauge: consecutive steps where live
-                                      decode slots emitted no token
-                                      because prefill spent the step's
-                                      token budget (the scheduler's
-                                      decode-owed guard bounds it at 1)
+                                      chunk of one prompt each; the
+                                      ragged step counts its packed
+                                      chunk here too)
+- ``generation.step_rows_useful``     real token rows the step's fused
+                                      dispatches computed (decode rows
+                                      + prefill-chunk rows)
+- ``generation.step_rows_dispatched``  total row slots those dispatches
+                                      carried (legacy: decode batch
+                                      bucket + the fixed chunk axis;
+                                      ragged: the fixed packed axis) —
+                                      the denominator of the padding
+                                      reclaim A/B
+- ``generation.step_row_utilization``  gauge: last step's useful /
+                                      dispatched rows (0..1)
+- ``generation.padded_token_waste``   rows of MASKED DUMMY WORK: rows
+                                      dispatched as (part of) a
+                                      sequence that are pure padding —
+                                      legacy decode's fabricated dummy
+                                      sequences (full transformer +
+                                      zero-length attention + sampled
+                                      logits per row) and the legacy
+                                      chunk's masked token-axis padding
+                                      inside a real sequence's
+                                      dispatch.  The RAGGED step has
+                                      none by construction (descriptors
+                                      cover exactly the packed rows;
+                                      slots past them belong to no
+                                      sequence: no pool write, no
+                                      attention, no logits row — their
+                                      inert fraction is what
+                                      step_row_utilization reports)
 - ``generation.decode_compiles_prewarm``  fused decode executables built
                                       by the mid-prefill pre-warm path
                                       (the `prewarm` tag on
@@ -115,7 +140,10 @@ DECODE_COMPILES_TOTAL = PREFIX + "decode_compiles_total"
 DECODE_CACHE_HITS = PREFIX + "decode_cache_hits"
 DECODE_CACHE_MISSES = PREFIX + "decode_cache_misses"
 PREFILL_CHUNKS_TOTAL = PREFIX + "prefill_chunks_total"
-DECODE_STALL_STEPS = PREFIX + "decode_stall_steps"
+STEP_ROWS_USEFUL = PREFIX + "step_rows_useful"
+STEP_ROWS_DISPATCHED = PREFIX + "step_rows_dispatched"
+STEP_ROW_UTILIZATION = PREFIX + "step_row_utilization"
+PADDED_TOKEN_WASTE = PREFIX + "padded_token_waste"
 DECODE_COMPILES_PREWARM = PREFIX + "decode_compiles_prewarm"
 TOKENS_PER_S = PREFIX + "tokens_per_s"
 SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
@@ -249,10 +277,19 @@ class GenerationMetrics:
 
     # --- per-step observation ---
     def observe_decode_step(self, dispatches, host_syncs):
-        """Per-decode-step dispatch/sync gauges — the fused path's
-        acceptance numbers (1 and <=1) and the eager A/B baseline."""
+        """Per-step dispatch/sync gauges — the ragged path's acceptance
+        numbers (1 and <=1) and the eager/fused A/B baselines."""
         self._stat(DECODE_DISPATCHES_PER_STEP).set(int(dispatches))
         self._stat(DECODE_HOST_SYNCS_PER_STEP).set(int(host_syncs))
+
+    def count_step_extra_dispatches(self, n):
+        """Fold extra device dispatches the step issued OUTSIDE the
+        decode call into the per-step gauge — the legacy chunked step's
+        jitted chunk dispatch, so the legacy-vs-ragged
+        dispatches-per-step A/B reads its true 2 vs 1 (the decode paths
+        SET the gauge; this adds on top, called after them)."""
+        stat = self._stat(DECODE_DISPATCHES_PER_STEP)
+        stat.set(int(stat.get()) + int(n))
 
     def set_mesh_devices(self, n):
         """Gauge: the engine's tensor-parallel degree (mesh axis size;
@@ -268,13 +305,22 @@ class GenerationMetrics:
         every unsharded path."""
         self._stat(COLLECTIVE_BYTES_PER_STEP).set(int(n))
 
-    def observe_decode_stall(self, consecutive):
-        """Gauge: CONSECUTIVE engine steps in which live decode slots
-        emitted no token because the step's token budget went to
-        prefill.  The scheduler's decode-owed guard bounds it at 1 —
-        a stalled step forces the next step to decode first
-        (tests/test_chunked_prefill.py pins the bound)."""
-        self._stat(DECODE_STALL_STEPS).set(int(consecutive))
+    def observe_step_rows(self, useful, dispatched, waste):
+        """Row accounting for one engine step's fused dispatches:
+        `useful` real token rows out of `dispatched` row slots, of
+        which `waste` rows were MASKED DUMMY WORK (fabricated dummy
+        sequences / in-sequence padding — see the module docstring;
+        the ragged step's structural zero).  Touches every stat so the
+        schema is complete from the first snapshot — padded_token_waste
+        == 0 is a statement, not a gap."""
+        self._stat(STEP_ROWS_USEFUL).increase(int(useful))
+        self._stat(STEP_ROWS_DISPATCHED).increase(int(dispatched))
+        stat = self._stat(PADDED_TOKEN_WASTE)
+        if waste:
+            stat.increase(int(waste))
+        if dispatched:
+            self._stat(STEP_ROW_UTILIZATION).set(
+                round(useful / dispatched, 3))
 
     def observe_step(self, tokens, step_seconds):
         """One decode step that advanced `tokens` sequences (the token

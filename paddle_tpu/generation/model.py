@@ -437,6 +437,103 @@ class TinyCausalLM:
 
         return step
 
+    # -------------------------- ragged step ---------------------------
+    def ragged_step_fn(self, page_size, num_pages, use_kernel=False,
+                       pool_layout="token", mesh=None, tp_axis=None):
+        """Build the PURE mixed-batch RAGGED step function the engine's
+        one-dispatch-per-step path jits (fused.RaggedStep)::
+
+            fn(params, tokens, positions, pages, rows, page_tables,
+               starts, lens, kv_lens, k_pools, v_pools)
+              -> ((token_ids [S], logits [S, V]), k_pools', v_pools')
+
+        tokens/positions: [T] int32 — the step's PACKED token axis:
+        every decode sequence's single new token followed by the
+        prefill chunk's tokens, no dummy rows between them (slots past
+        the packed count are inert padding of the fixed axis).  pages/
+        rows: [T] int32 scatter targets, host-computed from the page
+        tables; inert slots carry the OOB sentinel page `num_pages`
+        (dropped in-trace, mode="drop" — exactly the fused-decode dummy
+        -row contract).  page_tables: [S, MP] int32.  starts/lens/
+        kv_lens: [S] int32 descriptors — descriptor s owns packed rows
+        [starts[s], starts[s]+lens[s]) and has kv_lens[s] cache-
+        resident tokens after this step's writes; lens == 0 marks an
+        unused descriptor.
+
+        One trace serves decode-only, chunk-only, and combined steps,
+        greedy and stochastic alike: logits are taken at each
+        descriptor's LAST packed row (a decode row's own position; a
+        chunk's last position — the first-token logits when the chunk
+        completes its prompt) and BOTH the [S] on-device argmax ids and
+        the [S, V] logits come back unmaterialized; the engine fetches
+        whichever its samplers need (ids for all-greedy, logits
+        otherwise, nothing for a mid-prompt chunk-only step).
+
+        mesh / tp_axis: the decode_step_fn sharding contract — q/k/v
+        and the pool scatters sharded over heads, pools pinned through
+        the donation chain, ids/logits pinned replicated for the single
+        host fetch."""
+        from ..parallel.sharding_annotations import constrain, kv_pool_spec
+        from .kv_cache import scatter_pool_update
+
+        pool_spec = (kv_pool_spec(pool_layout, tp_axis)
+                     if mesh is not None else None)
+
+        def step(params, tokens, positions, pages, rows, page_tables,
+                 starts, lens, kv_lens, k_pools, v_pools):
+            tokens = jnp.asarray(tokens, jnp.int32)
+            positions = jnp.asarray(positions, jnp.int32)
+            pages = jnp.asarray(pages, jnp.int32)
+            rows = jnp.asarray(rows, jnp.int32)
+            pt = jnp.asarray(page_tables, jnp.int32)
+            starts = jnp.asarray(starts, jnp.int32)
+            lens = jnp.asarray(lens, jnp.int32)
+            kv_lens = jnp.asarray(kv_lens, jnp.int32)
+            t = tokens.shape[0]
+            # inert slots embed token 0 at position 0 (in bounds by
+            # construction); their K/V rides the sentinel page and their
+            # attention rows belong to no descriptor (exact zeros)
+            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
+            k_out, v_out = [], []
+            for li, blk in enumerate(params["blocks"]):
+                hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+                q, k, v = self._qkv(blk, hn)
+                q = constrain(q, mesh, None, tp_axis, None)
+                k = constrain(k, mesh, None, tp_axis, None)
+                v = constrain(v, mesh, None, tp_axis, None)
+                kp = scatter_pool_update(
+                    k_pools[li], pages, rows,
+                    k.astype(k_pools[li].dtype), pool_layout)
+                vp = scatter_pool_update(
+                    v_pools[li], pages, rows,
+                    v.astype(v_pools[li].dtype), pool_layout)
+                if pool_spec is not None:
+                    kp = constrain(kp, mesh, *pool_spec)
+                    vp = constrain(vp, mesh, *pool_spec)
+                k_out.append(kp)
+                v_out.append(vp)
+                attn = decode_attention.ragged_paged_attention(
+                    q, kp, vp, pt, starts, lens, kv_lens,
+                    use_kernel=use_kernel, layout=pool_layout)
+                x = x + attn.reshape(t, self.d_model) @ blk["wo"]
+                x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
+                                                   blk["ln2_b"]))
+            # per-descriptor sampling rows: the last packed row each
+            # descriptor owns (padding descriptors read row 0 — garbage
+            # the engine never fetches a token from)
+            sample_rows = jnp.clip(starts + lens - 1, 0, t - 1)
+            xs = x[sample_rows]                              # [S, d]
+            logits = (_layer_norm(xs, params["ln_f_s"],
+                                  params["ln_f_b"]) @ params["head"])
+            ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # replicated outputs: the engine's single host fetch reads
+            # ONE of them without a cross-device gather
+            ids = constrain(ids, mesh)
+            logits = constrain(logits, mesh)
+            return (ids, logits), k_out, v_out
+
+        return step
+
     # ------------------------ reference decode ------------------------
     def greedy_reference(self, prompt, max_new_tokens, stop_tokens=()):
         """Naive sequential generation, FULL recompute each step (the

@@ -118,9 +118,6 @@ class ContinuousBatchingScheduler:
         # priority — they already consumed steps)
         self._pending = collections.deque()
         self._next_seq = 0
-        # token-budget bookkeeping (plan_step): a step that skipped the
-        # decode batch OWES it — the next step decodes first, no chunk
-        self._decode_owed = False
 
     # ------------------------- submission ---------------------------
     def submit(self, request):
@@ -158,47 +155,33 @@ class ContinuousBatchingScheduler:
                        if s is not None and s.prefilling),
                       key=lambda s: s.seq_id)
 
-    def plan_step(self, chunk_tokens, budget=None):
-        """Token-budgeted prefill/decode interleave plan for one engine
-        step.  Returns ``(chunk_state, chunk_len, decode, stalled)``:
+    def plan_step(self, chunk_tokens, max_chunk=None):
+        """Prefill plan for one engine step: the single chunk this step
+        dispatches — the OLDEST mid-prefill sequence's next
+        ``min(chunk_tokens, remaining prompt, max_chunk)`` tokens — as
+        ``(chunk_state, chunk_len)``, or ``(None, 0)``.
 
-        - `chunk_state` / `chunk_len`: the single prefill chunk this
-          step may dispatch (the OLDEST mid-prefill sequence, at most
-          `chunk_tokens` tokens, clipped to the budget) — or (None, 0);
-        - `decode`: True when the decode batch runs this step;
-        - `stalled`: True when live decode slots were skipped because
-          the chunk spent the budget.
-
-        The starvation guard: a stalled step sets the decode-owed flag,
-        and an owed step plans NO chunk and decodes unconditionally
-        (even past the budget — the batch must make progress), so
-        consecutive stalled steps can never exceed 1.  The owed flag
-        only suppresses the chunk while a decode batch actually exists:
-        if the stall's creditors have since been preempted or reaped,
-        withholding the chunk would make the step fully idle with a
-        prompt still mid-prefill.  With the default auto budget
-        (chunk_tokens + decode slots) a stall never happens at all; a
-        tight explicit budget trades decode cadence for prefill
-        throughput one alternating step at a time."""
+        The decode batch ALWAYS runs alongside; there is no token-budget
+        competition and no decode-owed debt anymore.  The old dance
+        existed because the legacy step paid two dispatches (chunk +
+        decode) whose combined token work a tight budget had to
+        arbitrate by stalling one of them; the ragged step put both in
+        ONE dispatch whose token axis is sized for the full decode batch
+        plus a chunk by construction, and the legacy chunked path
+        inherits the same simple plan (every step: one chunk + the whole
+        decode batch — decode never stalls).  `max_chunk` clips the
+        chunk to the packed-axis room left after the decode rows (the
+        ragged caller passes it; None = unclipped)."""
         prefilling = self.prefilling()
-        decoding = self.decode_ready()
-        chunk_state, chunk_len = None, 0
-        if prefilling and not (self._decode_owed and decoding):
-            cand = prefilling[0]
-            n = min(int(chunk_tokens),
-                    len(cand.tokens) - cand.prefill_pos)
-            if budget is not None:
-                n = min(n, int(budget))
-            if n > 0:
-                chunk_state, chunk_len = cand, n
-        stalled = False
-        decode = bool(decoding)
-        if (decoding and not self._decode_owed and budget is not None
-                and chunk_len and chunk_len + len(decoding) > budget):
-            decode = False
-            stalled = True
-        self._decode_owed = stalled
-        return chunk_state, chunk_len, decode, stalled
+        if not prefilling:
+            return None, 0
+        cand = prefilling[0]
+        n = min(int(chunk_tokens), len(cand.tokens) - cand.prefill_pos)
+        if max_chunk is not None:
+            n = min(n, int(max_chunk))
+        if n <= 0:
+            return None, 0
+        return cand, n
 
     def _place(self, state):
         for i, s in enumerate(self.slots):

@@ -156,6 +156,131 @@ def _chunk_kernel(pt_ref, info_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
 
 
+def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
+                   o_ref, acc_ref, m_ref, l_ref, *, page_size, n_pages,
+                   n_seqs, n_rows):
+    """RAGGED mixed-batch paged attention: `n_rows` packed query rows
+    (decode singletons AND prefill-chunk runs in one token axis) attend
+    through per-descriptor page tables.  Descriptor s owns packed rows
+    [st_ref[s], st_ref[s] + ln_ref[s]); row r of s sits at global
+    position kv_ref[s] - ln_ref[s] + (r - st_ref[s]) and sees keys
+    [0, position].  The grid walks (head, descriptor, page) with online-
+    softmax state [n_rows, ...] persisting across BOTH the page and the
+    descriptor axes: a descriptor's pages update only its own rows —
+    foreign rows see an all-NEG_INF score block, whose update is the
+    exact identity (alpha == exp(0) == 1, sum(p) == 0) — so one state
+    accumulation serves the whole ragged batch.  Descriptors with
+    ln == 0 (padding) and pages past kv_len are skipped entirely."""
+    s = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when((s == 0) & (i == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    start = st_ref[s]
+    ln = ln_ref[s]
+    kv_len = kv_ref[s]
+
+    # page i of descriptor s runs iff the descriptor is live and the
+    # page holds at least one resident key
+    @pl.when((ln > 0) & (i * page_size < kv_len))
+    def _compute():
+        q = q_ref[0]                               # [n_rows, D]
+        k = k_ref[0, 0]                            # [page_size, D]
+        v = v_ref[0, 0]
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        row = jax.lax.broadcasted_iota(jnp.int32, (n_rows, page_size), 0)
+        col = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rows, page_size), 1)
+        mine = (row >= start) & (row < start + ln)
+        qpos = kv_len - ln + (row - start)
+        sc = jnp.where(mine & (col <= qpos), sc, NEG_INF)
+        m_prev = jnp.max(m_ref[...], axis=1, keepdims=True)   # [n, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(sc - m_cur)                    # [n, page_size]
+        p = jnp.where(sc <= NEG_INF / 2, 0.0, p)   # masked keys: exactly 0
+        l_prev = jnp.max(l_ref[...], axis=1, keepdims=True)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when((s == n_seqs - 1) & (i == n_pages - 1))
+    def _finalize():
+        l = jnp.max(l_ref[...], axis=1, keepdims=True)
+        safe_l = jnp.where(l > 0.0, l, 1.0)  # unclaimed rows: zeros
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
+                                  lens, kv_lens, scale, interpret=None,
+                                  layout="token"):
+    """q: [T, H, D] — the step's PACKED query rows (decode rows and the
+    prefill chunk in one ragged token axis; rows owned by no descriptor
+    come back 0).  k_pool/v_pool: one layer's pool, the chunk's and the
+    decode tokens' K/V already scattered — [P, page_size, H, D]
+    (layout="token") or [H, P, page_size, D] (layout="kernel").
+    page_tables: [S, max_pages] int32 (pad with 0).  starts/lens/
+    kv_lens: [S] int32 descriptors (lens == 0 marks padding
+    descriptors; all three ride as scalar-prefetch operands so the
+    BlockSpec index_map DMAs each descriptor's pages straight out of
+    the pool).  Returns [T, H, D].
+
+    Layout handling mirrors the decode kernel: token-layout pools are
+    transposed per call, kernel-layout pools are consumed as stored."""
+    _reject_mesh_sharded_pool(k_pool)
+    t, h, d = q.shape
+    qs = jnp.transpose((q * scale).astype(q.dtype), (1, 0, 2))  # [H, T, D]
+    if layout == "kernel":
+        page_size = k_pool.shape[2]
+        kt, vt = k_pool, v_pool          # stored kernel-ready: no copy
+    else:
+        page_size = k_pool.shape[1]
+        kt = jnp.transpose(k_pool, (2, 0, 1, 3))
+        vt = jnp.transpose(v_pool, (2, 0, 1, 3))
+    n_seqs, n_pages = page_tables.shape
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(h, n_seqs, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda h_, s, i, pt, st, ln, kv:
+                         (h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda h_, s, i, pt, st, ln, kv:
+                         (h_, pt[s, i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda h_, s, i, pt, st, ln, kv:
+                         (h_, pt[s, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t, d), lambda h_, s, i, pt, st, ln, kv:
+                               (h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t, d), jnp.float32),
+            pltpu.VMEM((t, 128), jnp.float32),
+            pltpu.VMEM((t, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_kernel, page_size=page_size,
+                          n_pages=n_pages, n_seqs=n_seqs, n_rows=t),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, t, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(jnp.asarray(page_tables, jnp.int32), jnp.asarray(starts, jnp.int32),
+      jnp.asarray(lens, jnp.int32), jnp.asarray(kv_lens, jnp.int32),
+      qs, kt, vt)
+    return jnp.transpose(out, (1, 0, 2))
+
+
 def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
                                    scale, interpret=None, layout="token"):
     """q: [n, H, D] — one sequence's prefill-chunk queries (row r at

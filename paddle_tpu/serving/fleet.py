@@ -23,7 +23,10 @@ which one has slack.  The FleetRouter is that front door::
                                 measured, not assumed: the router
                                 confirms every prefix bet against the
                                 handle's prefix_hit_tokens stamp
-          3. LEAST LOADED       queue depth + resident pages
+          3. LEAST LOADED       queue depth + resident pages + measured
+                                TTFT EWMA relative to the fastest
+                                candidate (a slow replica sheds new
+                                traffic under skewed prompt lengths)
           spill                 a full first choice falls through the
                                 remaining candidates by load
           shed                  every candidate's admission gate
@@ -206,14 +209,38 @@ class _MigrationRelay:
 class _Replica:
     """One live replica: engine + its own metrics registry (per-replica
     generation.* stats stay separable for the fleet snapshot) + the
-    admission state the router flips."""
+    admission state the router flips + the measured TTFT EWMA the
+    latency-aware load score folds in."""
+
+    _TTFT_EWMA_ALPHA = 0.3   # same smoothing as generation.tokens_per_s
+    _TTFT_LOAD_CAP = 4.0     # a slow replica weighs at most like this
+    # many queued requests: bounded back-pressure, never starvation
 
     def __init__(self, spec, start):
         self.spec = spec
         self.state = "stopped"
         self.registry = None
         self.engine = None
+        # measured time-to-first-token EWMA (seconds; None = no sample
+        # yet).  Updated from handle done-callbacks, which fire on
+        # engine worker threads — the float swap is a benign last-
+        # writer-wins race for a smoothed load signal.
+        self.ttft_ewma = None
         self.build(start)
+
+    def observe_ttft(self, handle):
+        """Fold one completed request's measured TTFT into the EWMA
+        (requests that never produced a first token — typed failures,
+        sheds — carry no latency signal and are skipped)."""
+        if handle.first_token_s is None or handle.submitted_s is None:
+            return
+        ttft = handle.first_token_s - handle.submitted_s
+        if ttft < 0:
+            return
+        prev = self.ttft_ewma
+        self.ttft_ewma = (ttft if prev is None else
+                          self._TTFT_EWMA_ALPHA * ttft
+                          + (1 - self._TTFT_EWMA_ALPHA) * prev)
 
     def build(self, start):
         self.registry = StatRegistry()
@@ -222,6 +249,9 @@ class _Replica:
             metrics=GenerationMetrics(registry=self.registry),
             start=start)
         self.state = "serving"
+        # a rebuilt replica is a new process in spirit: its latency
+        # history died with the old engine
+        self.ttft_ewma = None
 
     @property
     def name(self):
@@ -244,15 +274,32 @@ class _Replica:
               else int(max_new))
         return max_pos is None or prompt_len + mn <= max_pos
 
-    def load(self):
-        """Queue depth + live slots + resident-page fraction — what
-        'least loaded' compares.  Pages enter as a FRACTION so queue
-        position dominates and pool residency breaks ties (a replica
-        with warm pages but an empty queue still reads near-idle)."""
+    def load(self, ttft_baseline=None):
+        """Queue depth + live slots + resident-page fraction + measured
+        latency — what 'least loaded' compares.  Pages enter as a
+        FRACTION so queue position dominates and pool residency breaks
+        ties (a replica with warm pages but an empty queue still reads
+        near-idle).  `ttft_baseline` (the fastest candidate's TTFT
+        EWMA) folds LATENCY in as a relative term: a replica measuring
+        k-times the baseline TTFT carries k-1 extra load — a 2x-slower
+        replica weighs like one extra queued request — CAPPED at
+        _TTFT_LOAD_CAP so one pathological sample against a
+        microsecond baseline cannot starve the replica forever: once
+        the fast sibling queues past the cap, traffic flows back, the
+        slow replica completes requests, and its EWMA decays (it only
+        updates on completions).  Under skewed prompt lengths new
+        traffic therefore drains toward the replica actually answering
+        fast, without ever wedging the slow one out of the fleet.
+        Replicas with no sample yet (or without a baseline) add
+        nothing — cold replicas are worth probing, not penalizing."""
         eng = self.engine
-        return (eng.scheduler.pending_count()
-                + len(eng.scheduler.active())
-                + eng.cache.pages_in_use / max(1, eng.cache.num_pages))
+        score = (eng.scheduler.pending_count()
+                 + len(eng.scheduler.active())
+                 + eng.cache.pages_in_use / max(1, eng.cache.num_pages))
+        if ttft_baseline and self.ttft_ewma:
+            score += min(self.ttft_ewma / ttft_baseline - 1.0,
+                         self._TTFT_LOAD_CAP)
+        return score
 
     def queue_depth(self):
         return self.engine.scheduler.pending_count()
@@ -339,7 +386,12 @@ class FleetRouter:
             order = list(candidates)
             self._rng.shuffle(order)
             return [("random", r) for r in order]
-        by_load = sorted(candidates, key=lambda r: r.load())
+        # latency-aware least-loaded: the fastest candidate's measured
+        # TTFT EWMA is the baseline every other candidate's latency is
+        # scored relative to (docs/SERVING.md "Fleet tier")
+        ewmas = [r.ttft_ewma for r in candidates if r.ttft_ewma]
+        baseline = min(ewmas) if ewmas else None
+        by_load = sorted(candidates, key=lambda r: r.load(baseline))
         prefs, seen = [], set()
 
         def push(rung, rep):
@@ -433,6 +485,14 @@ class FleetRouter:
                         client.add_done_callback(self._confirm_prefix)
                 if session is not None:
                     self._sessions[session] = rep.name
+                # latency measurement: every plainly-submitted request
+                # feeds the serving replica's TTFT EWMA at completion.
+                # Migration relays are skipped — their first_token_s
+                # clock spans two replicas and would smear the signal.
+                if not isinstance(handle, _MigrationRelay) and \
+                        not getattr(handle, "_ttft_hooked", False):
+                    handle._ttft_hooked = True
+                    handle.add_done_callback(rep.observe_ttft)
                 self.metrics.set_replica_queue_depth(rep.name,
                                                      rep.queue_depth())
                 return handle, rep
@@ -613,6 +673,8 @@ class FleetRouter:
                 "queue_depth": depth,
                 "active": len(rep.engine.scheduler.active()),
                 "load": round(rep.load(), 3),
+                "ttft_ewma_s": (None if rep.ttft_ewma is None
+                                else round(rep.ttft_ewma, 4)),
                 "generation":
                     rep.registry.stats_snapshot("generation.")["stats"],
                 "cache": rep.engine.cache.stats(),

@@ -1,0 +1,43 @@
+"""Child process of the multiprocess-collectives capability probe
+(dist_capability.py): join a 2-process jax.distributed world and run ONE
+jitted cross-process psum — exactly the mechanism the DP trainers use
+(dist_dp_trainer.py: jax.jit(shard_map(... pmean ...))).  Prints
+COLLECTIVES_OK and exits 0 iff the backend can actually execute a
+multiprocess computation; on the stock CPU backend the first dispatch
+raises "Multiprocess computations aren't implemented on the CPU
+backend", which is the pre-existing red the probe exists to detect.
+"""
+import os
+import sys
+
+# exactly one local device per process (the parent test env may carry
+# an 8-device XLA_FLAGS — override, same as the DP trainers)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    coordinator, n, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n, process_id=rank)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("data",))
+    step = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh,
+                             in_specs=P("data"), out_specs=P()))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), jnp.ones((1,), jnp.float32))
+    out = float(np.asarray(step(x))[0])
+    assert out == float(n), out
+    print("COLLECTIVES_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,4 @@
-"""Chunked prefill with token-budgeted prefill/decode interleaving.
+"""Chunked prefill with per-step prefill/decode interleaving.
 
 Acceptance oracles (all CPU, conftest forces the backend):
 
@@ -14,9 +14,11 @@ Acceptance oracles (all CPU, conftest forces the backend):
    O(1) in prompt length (one executable per pages bucket, chunk shape
    fixed) — new prompt lengths add ZERO compiles, while the full-prefill
    path compiles one executable per length bucket.
-3. STARVATION GUARD: the per-step token budget bounds consecutive
-   decode-stall steps at <= 1 (decode-owed scheduling), even for a
-   pathological 8k-token prompt against a full decode batch.
+3. NO DECODE STALLS: every step runs one chunk AND the whole decode
+   batch (the old token-budget/decode-owed dance died with the ragged
+   step — tests/test_ragged_step.py — which runs both in ONE dispatch),
+   pinned for a pathological 8k-token prompt against a full decode
+   batch.
 4. DECODE PRE-WARM: the fused decode executable a mid-prefill sequence
    will land in is compiled before its first decode step (counted with
    the `prewarm` tag), so the prefill->decode seam never retraces.
@@ -402,12 +404,15 @@ def test_chunked_repeat_traffic_no_recompiles(model):
     eng.shutdown()
 
 
-# ------------------ token budget + starvation guard ----------------------
+# ---------------------- per-step prefill plan ----------------------------
 
 
-def test_plan_step_budget_and_decode_owed_guard(model):
-    """Scheduler unit: a chunk that busts the budget stalls decode for
-    exactly one step; the owed step plans no chunk and decodes."""
+def test_plan_step_serves_chunk_and_decode_together(model):
+    """Scheduler unit: the plan is simply the oldest mid-prefill
+    sequence's next chunk — the decode batch always runs alongside.
+    The decode-owed stall dance is GONE (the ragged step runs chunk
+    and decode in one dispatch; the legacy path runs both of its
+    dispatches every step)."""
     eng = _engine(model, chunk=4, slots=4)
     sched = eng.scheduler
     hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS[:3]]
@@ -416,35 +421,18 @@ def test_plan_step_budget_and_decode_owed_guard(model):
     assert len(sched.decode_ready()) == 3
     eng.submit([1] * 20, max_new_tokens=1)
     sched.admit(limit=4)
-    # budget 4: the 4-token chunk alone fills it -> decode stalls
-    chunk_state, chunk_len, decode, stalled = sched.plan_step(4, budget=4)
+    chunk_state, chunk_len = sched.plan_step(4)
     assert chunk_state is not None and chunk_len == 4
-    assert not decode and stalled
-    # owed step: no chunk, decode unconditionally
-    chunk_state, chunk_len, decode, stalled = sched.plan_step(4, budget=4)
-    assert chunk_state is None and decode and not stalled
-    # generous budget: chunk + decode coexist
-    chunk_state, chunk_len, decode, stalled = sched.plan_step(4, budget=8)
-    assert chunk_state is not None and decode and not stalled
+    # the plan is stateless: asking again plans the same chunk
+    again, n_again = sched.plan_step(4)
+    assert again is chunk_state and n_again == 4
+    # max_chunk clips to the packed-axis room the ragged caller has
+    clipped, n_clip = sched.plan_step(4, max_chunk=3)
+    assert clipped is chunk_state and n_clip == 3
+    assert sched.plan_step(4, max_chunk=0) == (None, 0)
     eng.run_until_idle()
     for h, p in zip(hs, PROMPTS[:3]):
         assert h.result(timeout=5).token_ids == _ref(model, p, 8)
-    eng.shutdown()
-
-
-def test_decode_owed_step_still_chunks_without_decode_batch(model):
-    """A stalled step's debt is only collectible while a decode batch
-    exists: if the creditors were preempted or reaped before the owed
-    step, withholding the chunk too would make the step fully idle with
-    a prompt mid-prefill."""
-    eng = _engine(model, chunk=4)
-    eng.submit([1] * 8, max_new_tokens=1)
-    eng.scheduler.admit(limit=4)
-    eng.scheduler._decode_owed = True  # creditors gone
-    state, n, decode, stalled = eng.scheduler.plan_step(4, budget=4)
-    assert state is not None and n == 4
-    assert not decode and not stalled
-    eng.run_until_idle()
     eng.shutdown()
 
 
@@ -455,25 +443,25 @@ def test_chunked_oldest_prefill_served_first(model):
     eng.scheduler.admit(limit=4)
     first = eng.scheduler.prefilling()
     assert [s.seq_id for s in first] == sorted(s.seq_id for s in first)
-    state, n, _, _ = eng.scheduler.plan_step(2, budget=None)
+    state, n = eng.scheduler.plan_step(2)
     assert state is first[0] and n == 2
     eng.run_until_idle()
     eng.shutdown()
 
 
-def test_decode_stall_bounded_for_8k_prompt_against_full_batch():
-    """Oracle 3, the pathological case from the issue: an 8192-token
-    prompt streams in against a FULL decode batch under a tight token
-    budget (budget == chunk, so every chunk step stalls decode).  The
-    decode-owed guard bounds consecutive stalls at 1, every decode
-    stream stays token-identical, and the long prompt's first token is
-    the full-prefill argmax."""
+def test_decode_never_stalls_for_8k_prompt_against_full_batch():
+    """The pathological case the old token budget existed for: an
+    8192-token prompt streams in against a FULL decode batch.  With the
+    budget dance deleted, every step now runs one chunk AND the whole
+    decode batch — the decode streams advance every single step of the
+    long prefill window, stay token-identical, and the long prompt's
+    first token is the full-prefill argmax."""
     model = gen.TinyCausalLM(vocab_size=32, num_layers=1, num_heads=1,
                              head_dim=8, max_positions=8300, seed=5)
     chunk = 1024
     eng = gen.GenerationEngine(model, gen.GenerationConfig(
         max_decode_slots=4, num_pages=135, page_size=64,
-        prefill_chunk_tokens=chunk, step_token_budget=chunk),
+        prefill_chunk_tokens=chunk),
         start=False)
     shorts = [[1, 2, 3], [7, 5], [9, 4]]
     hs = [eng.submit(p, max_new_tokens=24) for p in shorts]
@@ -483,17 +471,17 @@ def test_decode_stall_bounded_for_8k_prompt_against_full_batch():
     rng = np.random.default_rng(6)
     long_prompt = rng.integers(0, 32, 8192).tolist()
     h_long = eng.submit(long_prompt, max_new_tokens=1)
-    max_stall, stalls = 0, 0
-    stat = eng.metrics._stat(gmetrics.DECODE_STALL_STEPS)
+    tok_stat = StatRegistry.instance().get_stat(gmetrics.TOKENS_TOTAL)
+    stall_free = True
     for _ in range(64):
+        before = tok_stat.get()
         eng.step()
-        g = stat.get()
-        max_stall = max(max_stall, g)
-        stalls += g > 0
+        if eng.scheduler.decode_ready() and tok_stat.get() == before:
+            stall_free = False   # a step with live decode slots that
+            # emitted no token — the starvation the old budget caused
         if not eng.scheduler.prefilling():
             break
-    assert stalls >= 4          # the tight budget really did alternate
-    assert max_stall <= 1       # ...but never starved two steps running
+    assert stall_free
     eng.run_until_idle()
     for h, p in zip(hs, shorts):
         assert h.result(timeout=5).token_ids == \
@@ -504,22 +492,6 @@ def test_decode_stall_bounded_for_8k_prompt_against_full_batch():
     assert h_long.result(timeout=5).token_ids == \
         [int(np.argmax(np.asarray(logits)))]
     assert eng.cache.utilization() == 0.0
-    eng.shutdown()
-
-
-def test_auto_budget_never_stalls(model):
-    """Default budget (chunk + slots) always fits one chunk plus the
-    whole decode batch: decode_stall_steps stays 0."""
-    eng = _engine(model, chunk=2, slots=4)
-    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
-    stat = eng.metrics._stat(gmetrics.DECODE_STALL_STEPS)
-    for _ in range(40):
-        eng.step()
-        assert stat.get() == 0
-        if not (eng.scheduler.active() or eng.scheduler.pending_count()):
-            break
-    for h, p in zip(hs, PROMPTS):
-        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
     eng.shutdown()
 
 
@@ -597,48 +569,63 @@ def test_chunked_config_validation(model):
     eng.shutdown()
 
 
-class _JitOnlyChunkModel:
-    """Implements the jit chunk protocol (prefill_chunk_fn +
-    decode_params) but NOT the eager prefill_chunk."""
+class _HidingModel:
+    """Delegating wrapper that hides a set of protocol attributes."""
 
-    def __init__(self, inner):
+    def __init__(self, inner, hide):
         self._inner = inner
+        self._hide = frozenset(hide)
 
     def __getattr__(self, name):
-        if name == "prefill_chunk":
+        if name in self._hide:
             raise AttributeError(name)
         return getattr(self._inner, name)
 
 
 def test_auto_chunk_policy_requires_servable_jit_path(model, monkeypatch):
-    """Auto (prefill_chunk_tokens=None) picks chunking ONLY when the
-    jitted chunk path can actually serve it: jit_prefill=False must
-    degrade to full prefill (never raise on a config the user didn't
-    write), and an eager-only chunk protocol never auto-enables on TPU
-    (the per-layer eager loop would regress TTFT vs one jitted
-    prefill — eager chunking is explicit opt-in)."""
+    """Auto (prefill_chunk_tokens=None) picks chunking ONLY when a
+    jitted chunk path can actually serve it: for a model WITHOUT the
+    ragged protocol, jit_prefill=False must degrade to full prefill
+    (never raise on a config the user didn't write), and an eager-only
+    chunk protocol never auto-enables on TPU (the per-layer eager loop
+    would regress TTFT vs one jitted prefill — eager chunking is
+    explicit opt-in).  A ragged-capable model auto-chunks through the
+    ragged dispatch instead — jit_prefill is irrelevant there."""
     import jax
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     eng = gen.GenerationEngine(
-        _JitOnlyChunkModel(model),
+        _HidingModel(model, ("prefill_chunk", "ragged_step_fn")),
         gen.GenerationConfig(jit_prefill=False, use_kernel=False),
         start=False)
     assert eng.prefill_chunk_tokens == 0 and eng._chunk_step is None
     eng.shutdown()
-    # host pools make the jit path unavailable; the eager protocol
+    # host pools make every jit path unavailable; the eager protocol
     # (TinyCausalLM.prefill_chunk) alone must not auto-enable
     eng = gen.GenerationEngine(
         model, gen.GenerationConfig(kv_backend="host", use_kernel=False),
         start=False)
     assert eng.prefill_chunk_tokens == 0
     eng.shutdown()
-    # with the full jit path available, auto DOES chunk on TPU
+    # with the legacy jit path available (ragged hidden), auto DOES
+    # chunk on TPU through ChunkedPrefillStep
     eng = gen.GenerationEngine(
-        model, gen.GenerationConfig(kv_backend="device", use_kernel=False),
+        _HidingModel(model, ("ragged_step_fn",)),
+        gen.GenerationConfig(kv_backend="device", use_kernel=False),
         start=False)
     assert eng.prefill_chunk_tokens == gen.DEFAULT_PREFILL_CHUNK_TOKENS
     assert eng._chunk_step is not None
+    eng.shutdown()
+    # a ragged-capable model auto-selects the RAGGED step on TPU:
+    # chunks ride the one mixed-batch dispatch, even with
+    # jit_prefill=False (the ragged executable needs no prefill cache)
+    eng = gen.GenerationEngine(
+        model, gen.GenerationConfig(kv_backend="device",
+                                    jit_prefill=False, use_kernel=False),
+        start=False)
+    assert eng.step_mode == "ragged" and eng._ragged is not None
+    assert eng.prefill_chunk_tokens == gen.DEFAULT_PREFILL_CHUNK_TOKENS
+    assert eng._chunk_step is None and eng._fused is None
     eng.shutdown()
 
 
@@ -693,3 +680,26 @@ def test_gen_bench_cell_reports_measured_compiles(model):
     assert cell["measured_compiles"] == 0
     assert cell["dispatches_per_step"] == 1
     assert cell["warmup_s"] > 0
+
+
+def test_legacy_interleaved_step_reports_two_dispatches(model):
+    """The legacy chunked step really issues TWO device programs when a
+    chunk and the decode batch share a step (jitted chunk + fused
+    decode): the per-step dispatch gauge must say 2 — the number the
+    ragged step's 1 is measured against (gen_bench --step A/B)."""
+    eng = _engine(model, chunk=2, kv_backend="device", jit_prefill=True,
+                  decode="fused")
+    h1 = eng.submit([1, 2, 3], max_new_tokens=16)
+    for _ in range(4):                 # h1 through prefill into decode
+        eng.step()
+    assert eng.scheduler.decode_ready()
+    h2 = eng.submit([1] * 8, max_new_tokens=1)
+    eng.scheduler.admit(limit=4)
+    assert eng.scheduler.prefilling()
+    eng.step()                         # chunk dispatch + decode dispatch
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_dispatches_per_step"] == 2
+    eng.run_until_idle()
+    h1.result(timeout=5)
+    h2.result(timeout=5)
+    eng.shutdown()

@@ -449,3 +449,93 @@ def test_engine_submit_accepts_caller_handle(model):
     assert h.result(timeout=5).token_ids == _ref(model, SYSTEM, 4)
     assert h.submitted_s == 123.0
     eng.shutdown()
+
+
+def test_latency_aware_load_prefers_fast_replica(model):
+    """Latency-aware load score unit: with queues and pools equal, a
+    replica whose measured TTFT EWMA reads slower carries extra load —
+    sessionless keyless traffic drains to the fast replica instead of
+    alternating.  The relative term is CAPPED: one pathological sample
+    can back-pressure a replica, never starve it."""
+    fl = _fleet(model)
+    r0, r1 = fl._replicas["r0"], fl._replicas["r1"]
+    # warm BOTH replicas first (two concurrent keyless submits split
+    # one-each by balance): the first request per replica pays XLA
+    # compile, which must not pollute the EWMAs this test then seeds —
+    # standalone runs would otherwise measure compile wall, not load
+    warm = [fl.submit([1, 2, 3], max_new_tokens=1),
+            fl.submit([4, 5, 6], max_new_tokens=1)]
+    fl.run_until_idle()
+    for h in warm:
+        h.result(timeout=10)
+    r0.ttft_ewma = 0.50    # measured slow (e.g. long-prompt diet)
+    r1.ttft_ewma = 0.01
+    # relative scoring: r0 carries min(0.50/0.01 - 1, cap) extra load;
+    # a sample-free replica adds nothing (probing stays free), and the
+    # cap bounds even absurd ratios
+    assert r0.load(0.01) > r1.load(0.01)
+    assert r0.load(0.01) - r1.load(0.01) <= r0._TTFT_LOAD_CAP
+    assert r0.load(None) == pytest.approx(r1.load(None))
+    before = {n: r["generation"].get("generation.requests_total", 0)
+              for n, r in fl.stats_snapshot()["replicas"].items()}
+    for _ in range(3):
+        h = fl.submit([1, 2, 3], max_new_tokens=1)   # < one page: no key
+        fl.run_until_idle()
+        h.result(timeout=10)
+        r0.ttft_ewma = 0.50    # re-pin: this unit isolates the SCORE
+        r1.ttft_ewma = 0.01    # (the e2e below measures for real)
+    after = {n: r["generation"].get("generation.requests_total", 0)
+             for n, r in fl.stats_snapshot()["replicas"].items()}
+    # every drained-queue tie broke toward the measured-fast replica
+    assert after["r0"] == before["r0"], (before, after)
+    assert after["r1"] == before["r1"] + 3, (before, after)
+    assert fl.stats_snapshot()["replicas"]["r1"]["ttft_ewma_s"] is not None
+    fl.shutdown()
+
+
+def test_slow_replica_sheds_new_traffic_under_skewed_prompts(model):
+    """The satellite e2e: one replica serves a diet of LONG prompts
+    (pinned by session), the other short ones; once both EWMAs are
+    measured, fresh sessionless traffic routes to the fast replica —
+    the slow one sheds new load it would answer late."""
+    long_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=2, head_dim=8,
+                                  max_positions=600, seed=3)
+    fl = _fleet(long_model, cfgs=[_cfg(num_pages=256,
+                                       prefix_cache=False)
+                                  for _ in range(2)])
+    rng = np.random.default_rng(5)
+    long_prompt = rng.integers(1, 40, 400).tolist()
+    # session "slow" pins to one replica; feed it long prompts so its
+    # MEASURED TTFT EWMA grows (real prefill wall, no seeded fakery)
+    h = fl.submit(long_prompt, max_new_tokens=1, session="slow")
+    fl.run_until_idle()
+    h.result(timeout=10)
+    slow_name = fl.replica_of("slow")
+    # the other replica measures a short-prompt diet — pin the session
+    # there explicitly (with only the slow replica sampled, it IS its
+    # own baseline and carries no penalty yet; the latency-driven
+    # routing claim is the sessionless phase below, once BOTH have
+    # measured EWMAs)
+    fast_name = next(n for n in fl._replicas if n != slow_name)
+    fl._sessions["fast"] = fast_name
+    h = fl.submit([1, 2], max_new_tokens=1,
+                  session="fast")
+    assert fl.replica_of("fast") == fast_name
+    fl.run_until_idle()
+    h.result(timeout=10)
+    slow, fast = fl._replicas[slow_name], fl._replicas[fast_name]
+    assert slow.ttft_ewma > fast.ttft_ewma
+    before = {n: r["generation"].get("generation.requests_total", 0)
+              for n, r in fl.stats_snapshot()["replicas"].items()}
+    # fresh sessionless, keyless traffic: all of it sheds off the slow
+    # replica onto the fast one
+    for _ in range(4):
+        h = fl.submit(rng.integers(1, 40, 3).tolist(), max_new_tokens=1)
+        fl.run_until_idle()
+        h.result(timeout=10)
+    after = {n: r["generation"].get("generation.requests_total", 0)
+             for n, r in fl.stats_snapshot()["replicas"].items()}
+    assert after[fast_name] - before[fast_name] == 4
+    assert after[slow_name] == before[slow_name]
+    fl.shutdown()

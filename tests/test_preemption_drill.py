@@ -22,6 +22,8 @@ TRAINER = os.path.join(REPO, "tests", "dist_preempt_trainer.py")
 
 
 from test_dist_multiprocess import _free_port  # noqa: E402 (shared helper)
+from dist_capability import (SKIP_REASON,  # noqa: E402 (probe helper)
+                             multiprocess_collectives_available)
 
 
 def _launch_pair(launcher, ckpt, out, kill_at=None):
@@ -65,6 +67,11 @@ def _epoch_losses(out):
     return last
 
 
+# the drill's trainers run real 2-process DP steps: same probed
+# capability gate as the test_dist_multiprocess DP tests (the
+# pre-existing CPU-backend red, dist_capability.py)
+@pytest.mark.skipif(not multiprocess_collectives_available(),
+                    reason=SKIP_REASON)
 def test_preemption_drill(tmp_path):
     from paddle_tpu.distributed.fleet.elastic import (
         ElasticStatus, LauncherInterface,

@@ -1,0 +1,668 @@
+"""Ragged paged attention: one mixed-batch kernel, zero padding.
+
+The RaggedStep path (fused.RaggedStep + model.ragged_step_fn +
+engine._step_ragged): the decode batch's single-token rows AND the
+step's prefill chunk packed into ONE pool-donating dispatch over a
+fixed token axis, described by per-sequence [start, len, kv_len]
+descriptors — no dummy decode rows, no separate chunk dispatch.
+
+Acceptance oracles (all CPU, conftest forces the backend):
+
+1. TOKEN IDENTITY: the ragged path reproduces the eager oracle token
+   for token — greedy and seeded stochastic, decode-only / chunk-only /
+   combined steps, forced preemption, prefix-cache warm starts, bf16
+   pools, both pool layouts, and the forced 4-device CPU mesh.
+2. ONE EXECUTABLE PER PAGES BUCKET TOTAL: the compile count is
+   independent of decode-batch size, sampling mix, and chunk presence —
+   vs the legacy menu of (batch bucket x pages bucket x greedy) decode
+   executables PLUS one chunk executable per pages bucket.
+3. ONE DISPATCH, <= 1 HOST SYNC per step (0 for a mid-prompt
+   chunk-only step), at generation.padded_token_waste == 0 — no row of
+   masked dummy sequence work exists in the ragged design; the fixed
+   axis's inert-slot fraction is reported by step_row_utilization.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.generation.decode_attention import (
+    chunk_prefill_attention_reference, paged_decode_attention_reference,
+    ragged_paged_attention, ragged_paged_attention_reference)
+from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402 cross-module memo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # the chunked/fused suites' signature: the process-wide greedy
+    # oracle memo (gen_oracle) is shared across files
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _engine(model, *, slots=4, pages=64, page_size=4, chunk=3, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size,
+                               prefill_chunk_tokens=chunk,
+                               kv_backend="device", step_mode="ragged",
+                               **kw)
+    return gen.GenerationEngine(model, cfg, start=False)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+# ----------------------- ragged attention math ---------------------------
+
+
+def _mixed_fixture(rng, h, d, page_size, num_pages=32, layout="token"):
+    """Three sequences in one pool: two decode rows + one 5-token chunk
+    (prefix 7), packed as rows [0, 7) of an 8-slot token axis (slot 7
+    unclaimed)."""
+    pool = gen.DeviceKVPool(1, h, d, num_pages=num_pages,
+                            page_size=page_size, pool_layout=layout)
+    totals = {"A": 13, "B": 6, "C": 12}
+    kv = {}
+    for sid, n in totals.items():
+        pool.allocate(sid)
+        arr = rng.standard_normal((1, n, h, d)).astype(np.float32)
+        pool.append_prefill(sid, arr, -arr)
+        kv[sid] = arr[0]
+    pt, _ = pool.gather_block_tables(["A", "B", "C"])
+    pt4 = np.zeros((4, pt.shape[1]), np.int32)
+    pt4[:3] = pt
+    starts = np.array([0, 1, 2, 0], np.int32)
+    lens = np.array([1, 1, 5, 0], np.int32)     # last descriptor: padding
+    kv_lens = np.array([13, 6, 12, 0], np.int32)
+    q = rng.standard_normal((8, h, d)).astype(np.float32)
+    return pool, kv, pt4, starts, lens, kv_lens, q
+
+
+def test_ragged_reference_matches_per_sequence_references():
+    """Each packed row equals its per-sequence oracle: decode rows the
+    paged decode reference, chunk rows the chunk-prefill reference, and
+    rows owned by no descriptor come back EXACTLY zero."""
+    rng = np.random.default_rng(0)
+    pool, kv, pt4, starts, lens, kv_lens, q = _mixed_fixture(
+        rng, 2, 8, 4)
+    kp, vp = pool.layer_pools(0)
+    out = np.asarray(ragged_paged_attention_reference(
+        q, kp, vp, pt4, starts, lens, kv_lens))
+    ref_a = np.asarray(paged_decode_attention_reference(
+        q[0:1], kp, vp, pt4[0:1], np.array([13], np.int32)))
+    np.testing.assert_allclose(out[0], ref_a[0], atol=1e-6, rtol=1e-6)
+    ref_b = np.asarray(paged_decode_attention_reference(
+        q[1:2], kp, vp, pt4[1:2], np.array([6], np.int32)))
+    np.testing.assert_allclose(out[1], ref_b[0], atol=1e-6, rtol=1e-6)
+    ref_c = np.asarray(chunk_prefill_attention_reference(
+        q[2:7], kv["C"], -kv["C"], 7))
+    np.testing.assert_allclose(out[2:7], ref_c, atol=1e-6, rtol=1e-6)
+    assert np.all(out[7] == 0.0)   # unclaimed slot: exact zeros
+
+
+def test_ragged_reference_padding_descriptors_are_inert():
+    """len-0 descriptors (and their garbage page-table rows) change
+    nothing, bit for bit — the fixed descriptor axis is free."""
+    rng = np.random.default_rng(1)
+    pool, _, pt4, starts, lens, kv_lens, q = _mixed_fixture(rng, 2, 8, 4)
+    kp, vp = pool.layer_pools(0)
+    base = np.asarray(ragged_paged_attention_reference(
+        q, kp, vp, pt4[:3], starts[:3], lens[:3], kv_lens[:3]))
+    # grow the descriptor axis with garbage-table padding descriptors
+    pt6 = np.concatenate([pt4, pt4[:2]], axis=0)
+    z = np.zeros((2,), np.int32)
+    out = np.asarray(ragged_paged_attention_reference(
+        q, kp, vp, pt6,
+        np.concatenate([starts, z]), np.concatenate([lens, z]),
+        np.concatenate([kv_lens, z])))
+    np.testing.assert_array_equal(out, base)
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_ragged_kernel_interpret_matches_reference(layout):
+    """The Pallas ragged kernel (interpret mode on CPU) implements the
+    same semantics over either pool layout; online softmax
+    reassociates, so small float tolerance."""
+    rng = np.random.default_rng(2)
+    pool, _, pt4, starts, lens, kv_lens, q = _mixed_fixture(
+        rng, 2, 128, 8, layout=layout)
+    kp, vp = pool.layer_pools(0)
+    ref = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=False,
+        layout=layout))
+    ker = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=True,
+        interpret=True, layout=layout))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_kernel_decode_only_and_chunk_only():
+    """Kernel shape edges: an all-decode pack (every descriptor len 1)
+    and a single-chunk pack both agree with the reference."""
+    rng = np.random.default_rng(3)
+    pool, _, pt4, _, _, _, q = _mixed_fixture(rng, 1, 128, 8)
+    kp, vp = pool.layer_pools(0)
+    # decode-only: three singleton rows
+    starts = np.array([0, 1, 2, 0], np.int32)
+    lens = np.array([1, 1, 1, 0], np.int32)
+    kv_lens = np.array([13, 6, 12, 0], np.int32)
+    ref = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=False))
+    ker = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=True,
+        interpret=True))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+    # chunk-only: descriptor 0 owns rows [0, 6) of sequence A
+    starts = np.array([0, 0, 0, 0], np.int32)
+    lens = np.array([6, 0, 0, 0], np.int32)
+    kv_lens = np.array([13, 0, 0, 0], np.int32)
+    ref = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=False))
+    ker = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=True,
+        interpret=True))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+# ---------------------- token identity oracles ---------------------------
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 3])
+def test_ragged_greedy_token_identical_to_oracle(model, chunk):
+    """Oracle 1: chunk sizes that don't divide the prompt lengths, all
+    prompts through the one ragged dispatch — token identical to
+    sequential full recompute."""
+    eng = _engine(model, chunk=chunk)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 12)
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_ragged_decode_only_mode_token_identical(model):
+    """chunk=0: prompts take the one-shot prefill paths and only decode
+    rides the ragged dispatch."""
+    eng = _engine(model, chunk=0)
+    assert eng._ragged is not None and eng.prefill_chunk_tokens == 0
+    handles = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 10)
+    eng.shutdown()
+
+
+def test_ragged_stochastic_token_identical_to_legacy(model):
+    """Seeded temperature/top-k/top-p streams are identical through the
+    ragged dispatch, the legacy path, and ragged-without-chunking —
+    mixed greedy/stochastic batches included (the one executable serves
+    both: the engine just fetches logits instead of ids)."""
+    def run(mode, chunk, greedy_mix=False):
+        cfg = gen.GenerationConfig(
+            max_decode_slots=4, num_pages=64, page_size=4,
+            prefill_chunk_tokens=chunk, kv_backend="device",
+            step_mode=mode)
+        eng = gen.GenerationEngine(model, cfg, start=False)
+        hs = []
+        for i, p in enumerate(PROMPTS):
+            sampling = (gen.SamplingParams() if greedy_mix and i % 2
+                        else gen.SamplingParams(temperature=0.9,
+                                                top_k=10, top_p=0.9,
+                                                seed=41 + i))
+            hs.append(eng.submit(p, max_new_tokens=10, sampling=sampling))
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        eng.shutdown()
+        return out
+
+    assert run("ragged", 3) == run("legacy", 0) == run("ragged", 0)
+    assert run("ragged", 2, greedy_mix=True) == \
+        run("legacy", 0, greedy_mix=True)
+
+
+def test_ragged_token_identical_under_forced_preemption(model):
+    """Oracle 1 (preemption): a pool sized to thrash — victims (decoding
+    AND mid-chunk) re-prefill through ragged chunks and every token
+    still matches."""
+    eng = _engine(model, pages=9, chunk=2)
+    handles = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in handles]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(model, p, 12)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_ragged_prefix_cache_warm_identical(model):
+    """Prefix-cache warm starts ride the ragged chunk loop (prefill
+    resumes at the first unmatched token): warm == cold, token for
+    token, with real aliasing observed."""
+    system = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def run(prefix_on):
+        eng = _engine(model, chunk=3, page_size=4,
+                      prefix_cache=prefix_on)
+        outs, hits = [], []
+        for sfx in ([7, 7], [5, 5]):
+            h = eng.submit(system + sfx, max_new_tokens=8)
+            eng.run_until_idle()
+            outs.append(h.result(timeout=5).token_ids)
+            hits.append(h.prefix_hit_tokens)
+        eng.shutdown()
+        return outs, hits
+
+    warm, warm_hits = run(True)
+    cold, cold_hits = run(False)
+    assert warm == cold
+    assert warm_hits[1] >= 8 and cold_hits == [0, 0]
+
+
+def test_ragged_bf16_pools_token_identical(model):
+    """bf16 KV pools: the ragged path matches the eager device path at
+    the same storage precision and the same chunking (both re-read the
+    prefix at storage precision)."""
+    def run(mode):
+        import jax.numpy as jnp
+
+        cfg = gen.GenerationConfig(
+            max_decode_slots=4, num_pages=64, page_size=4,
+            prefill_chunk_tokens=3, kv_backend="device", step_mode=mode,
+            kv_dtype=jnp.bfloat16)
+        eng = gen.GenerationEngine(model, cfg, start=False)
+        hs = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        eng.shutdown()
+        return out
+
+    assert run("ragged") == run("legacy")
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_ragged_pool_layouts_token_identical(model, layout):
+    """Both DeviceKVPool storage layouts through the ragged scatter +
+    ragged attention: token identity vs the oracle."""
+    eng = _engine(model, chunk=3, pool_layout=layout)
+    handles = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(handles, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 10)
+    eng.shutdown()
+
+
+def test_ragged_max_new_tokens_zero_and_stop_tokens(model):
+    eng = _engine(model, chunk=2)
+    free = _ref(model, [1, 2, 3], 8)
+    h0 = eng.submit([1, 2], max_new_tokens=0)
+    hs = eng.submit([1, 2, 3], max_new_tokens=8, stop_tokens=(free[2],))
+    eng.run_until_idle()
+    assert h0.result(timeout=5).token_ids == []
+    assert h0.result().finish_reason == "length"
+    res = hs.result(timeout=5)
+    assert res.finish_reason == "stop" and res.token_ids == free[:2]
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_ragged_background_worker_end_to_end(model):
+    eng = _engine(model, chunk=2)
+    eng.start()
+    try:
+        h = eng.submit([5, 6, 7], max_new_tokens=8)
+        assert list(h.tokens(timeout=30)) == _ref(model, [5, 6, 7], 8)
+    finally:
+        eng.shutdown()
+
+
+# -------------------- sharded (4-device CPU mesh) ------------------------
+
+
+def test_ragged_mesh_token_identical():
+    """The ragged step under a head-sharded 4-device CPU mesh: one
+    GSPMD dispatch per step, token-identical to the single-chip eager
+    oracle (greedy + seeded stochastic), per-device pools at 1/tp of
+    the unsharded bytes."""
+    import jax
+
+    from paddle_tpu.parallel import tp_mesh
+
+    assert len(jax.devices()) >= 4, "conftest forces 8 host devices"
+    mesh_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=4, head_dim=8, seed=3)
+
+    def run(mesh):
+        cfg = gen.GenerationConfig(
+            max_decode_slots=4, num_pages=64, page_size=4,
+            prefill_chunk_tokens=3, kv_backend="device",
+            step_mode="ragged", mesh=mesh)
+        eng = gen.GenerationEngine(mesh_model, cfg, start=False)
+        if mesh is not None:
+            pool = eng.cache.layer_pools(0)[0]
+            shard = next(iter(pool.addressable_shards)).data
+            assert shard.size * 4 == pool.size  # 1/tp of the pool
+        hs = [eng.submit(p, max_new_tokens=10,
+                         sampling=(gen.SamplingParams() if i % 2 else
+                                   gen.SamplingParams(temperature=0.8,
+                                                      top_k=8,
+                                                      seed=11 + i)))
+              for i, p in enumerate(PROMPTS)]
+        eng.run_until_idle()
+        snap = eng.metrics.snapshot()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        eng.shutdown()
+        return out, snap
+
+    sharded, snap = run(tp_mesh(4))
+    single, _ = run(None)
+    assert sharded == single
+    assert snap["generation.decode_dispatches_per_step"] == 1
+    assert snap["generation.decode_host_syncs_per_step"] <= 1
+    assert snap["generation.mesh_devices"] == 4
+    assert snap["generation.collective_bytes_per_step"] > 0
+
+
+# ------------------- dispatch/sync + padding accounting ------------------
+
+
+def test_ragged_one_dispatch_le_one_sync_per_step(model):
+    """Acceptance: every ragged step is exactly 1 dispatch and <= 1
+    host sync; a mid-prompt chunk-only step fetches NOTHING (0 syncs,
+    like the legacy unmaterialized chunks)."""
+    eng = _engine(model, chunk=2, slots=2)
+    h = eng.submit([1] * 9, max_new_tokens=4)   # 9 tokens / chunk 2
+    reg = StatRegistry.instance()
+    disp = reg.get_stat(gmetrics.DECODE_DISPATCHES_PER_STEP)
+    sync = reg.get_stat(gmetrics.DECODE_HOST_SYNCS_PER_STEP)
+    chunk_only_syncs = []
+    while eng.scheduler.active() or eng.scheduler.pending_count():
+        mid_prefill = bool(eng.scheduler.prefilling()) and \
+            not eng.scheduler.decode_ready()
+        advanced = eng.step()
+        if advanced:
+            assert disp.get() == 1
+            assert sync.get() <= 1
+            if mid_prefill:
+                chunk_only_syncs.append(sync.get())
+    # the 9-token prompt had mid-prompt chunk-only steps: all silent
+    assert chunk_only_syncs and all(s == 0 for s in chunk_only_syncs[:-1])
+    h.result(timeout=5)
+    eng.shutdown()
+
+
+def test_ragged_zero_padded_token_waste_legacy_nonzero(model):
+    """The padding-reclaim acceptance: the ragged path dispatches ZERO
+    rows of masked dummy sequence work (padded_token_waste == 0) while
+    the legacy fused path pays dummy decode rows for every non-bucket
+    batch size; utilization is reported honestly on both."""
+    eng = _engine(model, chunk=3, slots=5)   # batch 3 pads to bucket 4
+    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS[:3]]
+    eng.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    snap = eng.metrics.snapshot()
+    assert snap["generation.padded_token_waste"] == 0
+    assert snap["generation.step_rows_useful"] > 0
+    assert snap["generation.step_rows_dispatched"] >= \
+        snap["generation.step_rows_useful"]
+    assert 0 < snap["generation.step_row_utilization"] <= 1
+    eng.shutdown()
+
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    leg = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=5, num_pages=64, page_size=4,
+        kv_backend="device", decode="fused"), start=False)
+    hs = [leg.submit(p, max_new_tokens=8) for p in PROMPTS[:3]]
+    leg.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    snap = leg.metrics.snapshot()
+    # 3 live sequences pad to the 4-bucket: one dummy row per step
+    assert snap["generation.padded_token_waste"] > 0
+    leg.shutdown()
+
+
+# ------------------- compile-cache menu collapse -------------------------
+
+
+def test_ragged_one_executable_per_pages_bucket_total(model):
+    """THE satellite assertion: across decode-batch sizes 1..slots,
+    greedy AND stochastic sampling, chunked prompts and decode-only
+    steps, the ragged step compiles ONE executable per pages bucket
+    touched — then a context past the bucket boundary adds exactly
+    one more."""
+    eng = _engine(model, chunk=3, slots=4, pages=64, page_size=4)
+    rng = np.random.default_rng(9)
+
+    def burst(n_prompts, greedy, plen):
+        hs = []
+        for i in range(n_prompts):
+            p = rng.integers(1, 40, plen).tolist()
+            sampling = (gen.SamplingParams() if greedy else
+                        gen.SamplingParams(temperature=0.8, seed=i))
+            hs.append(eng.submit(p, max_new_tokens=6, sampling=sampling))
+        eng.run_until_idle()
+        for h in hs:
+            h.result(timeout=5)
+
+    # batch 1..4, greedy and stochastic, multi-chunk prompts: sequences
+    # grow through pages buckets 1 -> 2 -> 4 (page_size 4, up to 13
+    # tokens), so AT MOST 3 executables exist — and always exactly one
+    # per cached bucket, whatever the batch/sampling/chunk mix
+    for n, greedy in ((1, True), (4, True), (3, False), (4, False)):
+        burst(n, greedy, plen=7)
+    buckets_small = eng._ragged.compile_count
+    assert buckets_small == len(eng._ragged.cached_buckets())
+    assert buckets_small <= 3   # pages buckets 1, 2, 4
+    # same traffic again (new batch sizes included): zero new compiles
+    for n, greedy in ((2, True), (3, False)):
+        burst(n, greedy, plen=7)
+    assert eng._ragged.compile_count == buckets_small
+    # a longer context crosses into new pages buckets (8, 16): the only
+    # way the menu ever grows — and still one executable per bucket
+    burst(1, True, plen=40)
+    grown = eng._ragged.compile_count
+    assert grown == len(eng._ragged.cached_buckets())
+    assert buckets_small < grown <= buckets_small + 2
+    eng.shutdown()
+
+
+def test_ragged_compile_menu_collapses_vs_legacy(model):
+    """Ragged vs legacy compile-cache menu on the SAME mixed traffic:
+    the legacy pair compiles one decode executable per (batch bucket,
+    greedy) signature it meets plus chunk executables, the ragged step
+    one per pages bucket TOTAL — strictly fewer here."""
+    def run(mode):
+        cfg = gen.GenerationConfig(
+            max_decode_slots=4, num_pages=32, page_size=16,
+            prefill_chunk_tokens=3, kv_backend="device",
+            step_mode=mode,
+            **({} if mode == "ragged" else {"decode": "fused",
+                                            "jit_prefill": True}))
+        eng = gen.GenerationEngine(model, cfg, start=False)
+        rng = np.random.default_rng(11)
+        for n, greedy in ((1, True), (2, False), (4, True), (3, False)):
+            hs = []
+            for i in range(n):
+                p = rng.integers(1, 40, 6).tolist()
+                sampling = (gen.SamplingParams() if greedy else
+                            gen.SamplingParams(temperature=0.7, seed=i))
+                hs.append(eng.submit(p, max_new_tokens=5,
+                                     sampling=sampling))
+            eng.run_until_idle()
+            for h in hs:
+                h.result(timeout=5)
+        if mode == "ragged":
+            compiles = eng._ragged.compile_count
+        else:
+            compiles = (eng._fused.compile_count
+                        + eng._chunk_step.compile_count)
+        eng.shutdown()
+        return compiles
+
+    ragged, legacy = run("ragged"), run("legacy")
+    assert ragged < legacy, (ragged, legacy)
+    assert ragged == 1   # every sequence here fits pages bucket 1
+
+
+def test_ragged_mixed_step_identity_sweep(model):
+    """Decode-only, chunk-only, and combined steps all flow through the
+    ONE executable: drive the engine by hand through all three step
+    shapes, assert each occurred, and the streams match the oracle."""
+    eng = _engine(model, chunk=2, slots=3, pages=64, page_size=16)
+    long_p = [2, 4, 6, 8, 10, 12, 14]          # 4 chunks of 2
+    h_long = eng.submit(long_p, max_new_tokens=6)
+    shapes = set()
+    h_short = None
+    for i in range(64):
+        pre = bool(eng.scheduler.prefilling())
+        dec = bool(eng.scheduler.decode_ready())
+        if pre and dec:
+            shapes.add("combined")
+        elif pre:
+            shapes.add("chunk_only")
+        elif dec:
+            shapes.add("decode_only")
+        eng.step()
+        if i == 4 and h_short is None:
+            h_short = eng.submit([1, 2, 3], max_new_tokens=6)
+        if not (eng.scheduler.active() or eng.scheduler.pending_count()):
+            break
+    assert shapes == {"chunk_only", "decode_only", "combined"}, shapes
+    assert h_long.result(timeout=5).token_ids == _ref(model, long_p, 6)
+    assert h_short.result(timeout=5).token_ids == \
+        _ref(model, [1, 2, 3], 6)
+    # the whole sweep ran on one pages bucket -> ONE executable
+    assert eng._ragged.compile_count == 1
+    eng.shutdown()
+
+
+def test_ragged_prewarm_pages_bucket(model):
+    """prewarm_decode on the ragged path compiles the pages-bucket
+    executable without dispatching; first traffic then adds zero
+    compiles (batch and greedy are not signature axes)."""
+    eng = _engine(model, chunk=2, pages=64, page_size=4)
+    # the request below grows through pages buckets 1 and 2: pre-warm
+    # both (batch_rows/greedy are ignored on the ragged path)
+    assert eng.prewarm_decode(3, 1, greedy=True) is True
+    assert eng.prewarm_decode(1, 2, greedy=False) is True
+    assert eng.prewarm_decode(4, 2, greedy=True) is False  # cached
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_prewarm"] == 2
+    before = eng._ragged.compile_count
+    h = eng.submit([1, 2, 3], max_new_tokens=4)   # peaks at 2 pages
+    eng.run_until_idle()
+    h.result(timeout=5)
+    assert eng._ragged.compile_count == before
+    eng.shutdown()
+
+
+# --------------------------- config policy -------------------------------
+
+
+def test_ragged_config_validation(model):
+    with pytest.raises(ValueError, match="step_mode"):
+        gen.GenerationConfig(step_mode="bogus")
+    with pytest.raises(ValueError, match="replaces the decode"):
+        gen.GenerationConfig(step_mode="ragged", decode="fused")
+    with pytest.raises(ValueError, match="kv_backend='device'"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            step_mode="ragged", kv_backend="host"), start=False)
+    # the packed axis must hold every decode slot (+1 chunk row)
+    with pytest.raises(ValueError, match="packed token axis"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            step_mode="ragged", kv_backend="device", max_decode_slots=4,
+            prefill_chunk_tokens=2, step_token_budget=4), start=False)
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        step_mode="ragged", kv_backend="device", max_decode_slots=4,
+        prefill_chunk_tokens=0, step_token_budget=4), start=False)
+    assert eng._ragged.max_tokens == 4
+    eng.shutdown()
+
+    class NoRagged:
+        num_layers, num_heads, head_dim, vocab_size = 1, 1, 4, 8
+
+        def prefill(self, tokens):
+            raise NotImplementedError
+
+        def decode(self, tokens, positions, attend):
+            raise NotImplementedError
+
+    with pytest.raises(ValueError, match="ragged_step_fn"):
+        gen.GenerationEngine(NoRagged(), gen.GenerationConfig(
+            step_mode="ragged", kv_backend="device"), start=False)
+    # auto on CPU: legacy stays the tier-1 default
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(), start=False)
+    assert eng.step_mode == "legacy" and eng._ragged is None
+    eng.shutdown()
+
+
+def test_ragged_failed_dispatch_recovers_pools(model, monkeypatch):
+    """A poisoned ragged dispatch must not wedge the engine: the donated
+    pools are re-materialized (reset_pools) and later requests serve
+    normally — the fail-the-batch-and-keep-serving contract."""
+    eng = _engine(model, chunk=0)
+    h = eng.submit([1, 2, 3], max_new_tokens=6)
+    eng.step()   # prefill + first token
+
+    class Boom(RuntimeError):
+        pass
+
+    real_get = eng._ragged._exec.get
+
+    def poisoned(args):
+        exe = real_get(args)
+
+        def run(*a):
+            exe(*a)
+            raise Boom("dispatch died after donation")
+
+        return run
+
+    monkeypatch.setattr(eng._ragged._exec, "get", poisoned)
+    with pytest.raises(Boom):
+        eng.step()
+    monkeypatch.setattr(eng._ragged._exec, "get", real_get)
+    # the poisoned step's batch is failed by the worker contract; here
+    # we drive manually: retire the victim like the worker would
+    for state in eng.scheduler.active():
+        eng.scheduler.retire(state)
+        state.handle.set_exception(Boom("poisoned step"))
+    with pytest.raises(Boom):
+        h.result(timeout=5)
+    h2 = eng.submit([4, 5], max_new_tokens=6)
+    eng.run_until_idle()
+    assert h2.result(timeout=5).token_ids == _ref(model, [4, 5], 6)
+    eng.shutdown()
+
+
+def test_ragged_mid_prefill_prewarm_fires(model):
+    """The prefill->decode seam pre-warm works on the ragged path too:
+    while a long prompt streams chunks, the pages-bucket executable its
+    first decode step will land in is compiled ahead (the `prewarm`
+    tag) — the hook was a silent no-op when only the fused path was
+    checked."""
+    eng = _engine(model, chunk=2, pages=64, page_size=4)
+    h = eng.submit([1] * 10, max_new_tokens=4)   # final bucket: 4 pages
+    eng.step()   # first chunk: the mid-prefill pre-warm fires
+    stats = eng.metrics.snapshot()
+    assert stats["generation.decode_compiles_prewarm"] >= 1
+    eng.run_until_idle()
+    assert h.result(timeout=5).token_ids == _ref(model, [1] * 10, 4)
+    eng.shutdown()

@@ -23,6 +23,14 @@ Usage:
         # long prompt streams in — reporting time-to-first-token per
         # request, decode tokens/s DURING the long prefill, and the
         # prefill compile count (chunked: O(1) in prompt length)
+    python tools/gen_bench.py --step both
+        # legacy vs RAGGED mixed-batch step A/B: the FusedDecodeStep +
+        # ChunkedPrefillStep pair (dummy-padded decode buckets, two
+        # dispatches per interleaved step) vs ONE ragged dispatch
+        # packing decode rows and the prefill chunk into a fixed token
+        # axis — steady-state tokens/s, dispatches/step, measured
+        # row_utilization, padded_token_waste (ragged: 0), and a ragged
+        # TTFT-under-interleave cell
     python tools/gen_bench.py --prefix both
         # prefix-cache A/B: a shared-system-prompt workload (N users,
         # one long system prefix, distinct short suffixes) run with
@@ -94,7 +102,8 @@ def _prewarm_decode_buckets(eng, batch, context, new_tokens, page_size):
 
 
 def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
-               pool, decode, prefill="full", chunk_tokens=0, tp=1):
+               pool, decode, prefill="full", chunk_tokens=0, tp=1,
+               step="legacy"):
     from paddle_tpu import generation as g
     from paddle_tpu.generation import metrics as gmetrics
     from paddle_tpu.parallel import tp_mesh
@@ -105,7 +114,11 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
                            page_size=page_size, queue_depth=batch * 2,
-                           kv_backend=pool, decode=decode, mesh=mesh,
+                           kv_backend=pool, mesh=mesh,
+                           # the ragged step replaces the decode path:
+                           # one mixed-batch executable per pages bucket
+                           decode=(None if step == "ragged" else decode),
+                           step_mode=step,
                            prefill_chunk_tokens=(chunk_tokens
                                                  if prefill == "chunked"
                                                  else 0)),
@@ -157,6 +170,18 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         "pool": pool,
         "decode": decode,
         "prefill": prefill,
+        # legacy vs ragged step A/B: the one-dispatch mixed-batch path
+        # reports its measured packed-axis utilization and the ZERO of
+        # padded_token_waste; legacy cells report their dummy-row bill.
+        # Utilization is the CUMULATIVE useful/dispatched ratio over the
+        # cell (the per-step gauge would report whatever the drain-tail
+        # step happened to pack).
+        "step": step,
+        "row_utilization": round(
+            snap.get("generation.step_rows_useful", 0)
+            / max(snap.get("generation.step_rows_dispatched", 0), 1), 3),
+        "padded_token_waste": snap.get(
+            "generation.padded_token_waste", 0),
         # tensor-parallel degree of the cell's mesh (1 = unsharded) and
         # the per-dispatch allreduce estimate — the tokens/s-vs-tp A/B
         # plus the collective-cost baseline the EQuARX-style quantized
@@ -195,7 +220,8 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
 
 
 def bench_interleave(model, batch, context, long_context, new_tokens,
-                     page_size, pool, decode, prefill, chunk_tokens):
+                     page_size, pool, decode, prefill, chunk_tokens,
+                     step="legacy"):
     """The chunked-prefill A/B scenario: `batch - 1` short requests
     decode while ONE long prompt streams in.  Reports time-to-first-
     token per request and the decode tokens/s the short requests
@@ -214,7 +240,9 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=pages,
                            page_size=page_size, queue_depth=batch * 2,
-                           kv_backend=pool, decode=decode,
+                           kv_backend=pool,
+                           decode=(None if step == "ragged" else decode),
+                           step_mode=step,
                            prefill_chunk_tokens=(chunk_tokens
                                                  if prefill == "chunked"
                                                  else 0)),
@@ -297,11 +325,24 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
     pfc = reg.get_stat(gmetrics.PREFILL_COMPILES_TOTAL)
     pfc_before = pfc.get()
     cell = run_once()                            # measured pass
+    snap = eng.metrics.snapshot()
     cell.update({
         "scenario": "interleave",
         "pool": pool,
         "decode": decode,
         "prefill": prefill,
+        # the TTFT-under-interleave A/B rung for the ragged step, with
+        # its measured mixed-batch row utilization (decode rows + chunk
+        # rows share the packed axis, cumulative over the cell) and
+        # dummy-row bill (ragged: 0)
+        "step": step,
+        "row_utilization": round(
+            snap.get("generation.step_rows_useful", 0)
+            / max(snap.get("generation.step_rows_dispatched", 0), 1), 3),
+        "padded_token_waste": snap.get(
+            "generation.padded_token_waste", 0),
+        "dispatches_per_step": snap.get(
+            "generation.decode_dispatches_per_step", 0),
         "batch": batch,
         "context": context,
         "long_context": long_context,
@@ -559,6 +600,18 @@ def main():
                          "in")
     ap.add_argument("--chunk-tokens", type=int, default=32,
                     help="chunk size for --prefill chunked/both")
+    ap.add_argument("--step", choices=("legacy", "ragged", "both"),
+                    default="legacy",
+                    help="step-executable A/B: the legacy pair "
+                         "(FusedDecodeStep / ChunkedPrefillStep per "
+                         "--decode/--prefill) vs the RAGGED mixed-batch "
+                         "step (decode rows + the prefill chunk packed "
+                         "into ONE dispatch, one executable per pages "
+                         "bucket TOTAL, zero dummy rows); ragged cells "
+                         "run device pools, report steady-state "
+                         "tokens/s, dispatches/step, measured "
+                         "row_utilization and padded_token_waste, and "
+                         "add their own TTFT-under-interleave cell")
     ap.add_argument("--prefix", choices=("off", "on", "both"),
                     default="off",
                     help="prefix-cache A/B: a shared-system-prompt "
@@ -655,18 +708,29 @@ def main():
                 for tp in tps:
                     if tp > 1 and (pool, decode) != ("device", "fused"):
                         continue  # sharded decode IS device + fused
-                    combos.append((pool, decode, prefill, tp))
-    if max(tps) > 1 and not any(tp > 1 for *_, tp in combos):
+                    combos.append((pool, decode, prefill, tp, "legacy"))
+    if max(tps) > 1 and not any(tp > 1 for *_, tp, _ in combos):
         # the mesh A/B must not silently vanish because the requested
         # --pool/--decode combo can't shard: force the one that can
-        combos += [("device", "fused", prefill, tp)
+        combos += [("device", "fused", prefill, tp, "legacy")
                    for prefill in prefills for tp in tps if tp > 1]
+    if args.step == "legacy":
+        pass
+    else:
+        # the ragged mixed-batch step: one series per prefill mode on
+        # device pools (the ragged step's `decode` label IS 'ragged' —
+        # the one executable replaces the eager/fused choice), unsharded
+        # here (the mesh A/B stays the legacy grid's; a TPU-mesh ragged
+        # window is ROADMAP follow-on)
+        ragged = [("device", "ragged", prefill, 1, "ragged")
+                  for prefill in prefills]
+        combos = ragged if args.step == "ragged" else combos + ragged
     grid = []
     stats_by_series = {}
     reg = StatRegistry.instance()
-    for pool, decode, prefill, tp in combos:
+    for pool, decode, prefill, tp, step in combos:
         # per-series snapshot: reset generation.* so each
-        # (pool, decode, prefill, tp) combo's stats land separately
+        # (pool, decode, prefill, tp, step) combo's stats land apart
         for name in list(reg.stats()):
             if name.startswith("generation."):
                 reg.get_stat(name).reset()
@@ -678,7 +742,7 @@ def main():
                 grid.append(bench_cell(
                     model, b, ctx, args.new_tokens, pages,
                     args.page_size, pool, decode, prefill,
-                    args.chunk_tokens, tp=tp))
+                    args.chunk_tokens, tp=tp, step=step))
         # the prefill/decode-interleave cell: decode throughput
         # while a long prompt streams in (the chunked-prefill
         # headline number; unsharded — the mesh A/B is the grid's)
@@ -687,7 +751,7 @@ def main():
             grid.append(bench_interleave(
                 model, ib, min(contexts), long_ctx,
                 args.new_tokens, args.page_size, pool, decode,
-                prefill, args.chunk_tokens))
+                prefill, args.chunk_tokens, step=step))
         series = f"{pool}/{decode}/{prefill}" + (
             f"/tp{tp}" if tp > 1 else "")
         stats_by_series[series] = reg.stats_snapshot("generation.")
@@ -736,6 +800,7 @@ def main():
         "decodes": list(decodes),
         "prefills": list(prefills),
         "tp_degrees": list(tps),
+        "step": args.step,
         "chunk_tokens": args.chunk_tokens,
         "prefix": args.prefix,
         "replicas": args.replicas,

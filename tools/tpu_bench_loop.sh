@@ -49,16 +49,22 @@ for i in $(seq 1 "$MAX"); do
     # in the same artifact, and --replicas both lands the fleet-tier
     # A/B (multi-replica FleetRouter over a shared-system-prompt
     # multi-turn session workload: per-replica hit rate, shed rate,
-    # TTFT p50/p95 with the affinity routing ladder vs random)
-    # budget grew with the prefix + fleet A/B cells: a timeout kill
-    # here drops the WHOLE gen artifact (mesh/prefill numbers
-    # included), so the cap tracks the scenario count and a kill at
-    # least says so
-    timeout 3000 python tools/gen_bench.py --pool both --decode both \
+    # TTFT p50/p95 with the affinity routing ladder vs random), and
+    # --step both lands the legacy-vs-RAGGED mixed-batch step A/B
+    # (one packed dispatch serving decode + the prefill chunk:
+    # tokens/s, dispatches/step, measured row_utilization,
+    # padded_token_waste == 0, ragged TTFT under interleave — the
+    # first hardware numbers for the ragged Pallas kernel)
+    # budget grew with the prefix + fleet + ragged A/B cells: a
+    # timeout kill here drops the WHOLE gen artifact (mesh/prefill
+    # numbers included), so the cap tracks the scenario count and a
+    # kill at least says so
+    timeout 3300 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
+      --step both \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
