@@ -83,12 +83,16 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
 
 def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
                            scale=None, use_kernel=None, interpret=None,
-                           layout="token"):
+                           layout="token", mesh=None, tp_axis=None):
     """Dispatch: the Pallas kernel on TPU (or when forced, e.g. interpret
     mode in tests), the jnp reference elsewhere.  `layout` names the
     pool storage layout ("token" or "kernel", see DeviceKVPool) — with
     layout="kernel" the Pallas path consumes the pools as stored, with
-    no per-call whole-pool transpose."""
+    no per-call whole-pool transpose.  `mesh`/`tp_axis` make the kernel
+    path mesh-native: the kernel runs as a shard_map over the
+    head-sharded mesh (per-shard program = the same kernel on
+    num_heads/tp heads over that shard's pool slice); the reference
+    path ignores them — GSPMD partitions it over heads on its own."""
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
     if not use_kernel:
@@ -102,7 +106,8 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
         scale = 1.0 / math.sqrt(d)
     return paged_decode_attention_kernel(
         jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
-        page_tables, seq_lens, scale, interpret=interpret, layout=layout)
+        page_tables, seq_lens, scale, interpret=interpret, layout=layout,
+        mesh=mesh, tp_axis=tp_axis)
 
 
 def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
@@ -171,11 +176,15 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
 
 def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
                            kv_lens, scale=None, use_kernel=None,
-                           interpret=None, layout="token"):
+                           interpret=None, layout="token", mesh=None,
+                           tp_axis=None):
     """Dispatch for the ragged mixed-batch path: the Pallas kernel on
     TPU (or when forced), the jnp gather reference elsewhere — the
     exact contract of paged_decode_attention, grown from one query row
-    per sequence to a ragged run of rows per descriptor."""
+    per sequence to a ragged run of rows per descriptor.  `mesh`/
+    `tp_axis` run the kernel as a shard_map over the head-sharded mesh
+    (the reference path ignores them — GSPMD partitions it on its
+    own)."""
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
     if not use_kernel:
@@ -190,7 +199,7 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
     return ragged_paged_attention_kernel(
         jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
         page_tables, starts, lens, kv_lens, scale, interpret=interpret,
-        layout=layout)
+        layout=layout, mesh=mesh, tp_axis=tp_axis)
 
 
 def chunk_prefill_attention_reference(q, k, v, start, scale=None):
@@ -236,7 +245,7 @@ def chunk_prefill_attention_reference(q, k, v, start, scale=None):
 
 def chunk_prefill_attention(q, k_pool, v_pool, page_table, start,
                             scale=None, use_kernel=None, interpret=None,
-                            layout="token"):
+                            layout="token", mesh=None, tp_axis=None):
     """Paged chunked-prefill attention for ONE sequence: the chunk's K/V
     have ALREADY been scattered into the pools (positions
     [start, start + n)), so every key — prefix and chunk alike — is read
@@ -268,7 +277,7 @@ def chunk_prefill_attention(q, k_pool, v_pool, page_table, start,
         scale = 1.0 / math.sqrt(d)
     return chunk_prefill_attention_kernel(
         q, jnp.asarray(k_pool), jnp.asarray(v_pool), pt, start, scale,
-        interpret=interpret, layout=layout)
+        interpret=interpret, layout=layout, mesh=mesh, tp_axis=tp_axis)
 
 
 def dense_causal_reference(q, k, v, scale=None):

@@ -132,21 +132,30 @@ class GenerationConfig:
         re-read at storage precision — like decode — so tokens may
         differ from one-shot prefill at the storage-rounding level.
     step_token_budget: the per-step token capacity — the RAGGED step's
-        fixed packed token axis (decode rows + the prefill chunk pack
-        into exactly this many slots; the executable's token shape, so
+        fixed packed token axis (decode rows + the step's chunk PACK
+        fill exactly this many slots; the executable's token shape, so
         it never retraces).  None = auto: prefill_chunk_tokens +
         max_decode_slots (max_decode_slots alone when chunking is off),
         which always holds the full decode batch plus a whole chunk.
-        A tighter explicit budget clips the CHUNK to the room left
-        after the decode rows (decode never stalls; with chunking on
-        the budget must leave at least one prefill row past the decode
-        batch so prompts cannot starve).  The legacy chunked path no
-        longer budgets at all — every step runs one chunk plus the
-        whole decode batch; the old decode-owed stall dance died with
-        the two-dispatch step it arbitrated (docs/GENERATION.md
-        "Ragged mixed-batch step").
+        The room left after the decode rows is PACKED with multiple
+        prompts' chunks (scheduler.plan_pack, FIFO: the oldest
+        prompt's full chunk first, then younger prompts' chunks into
+        the leftover — short prompts stop queueing behind long ones
+        for TTFT); with chunking on the budget must leave at least one
+        prefill row past the decode batch so prompts cannot starve.
+        The legacy chunked path packs by the same rule — one chunk
+        dispatch per pack member plus the whole decode batch, every
+        step; the old decode-owed stall dance died with the
+        two-dispatch step it arbitrated (docs/GENERATION.md "Ragged
+        mixed-batch step").
+    prefill_pack: multi-prompt chunk packing (True, the default):
+        each step's leftover token room after the oldest prompt's
+        chunk is filled with MORE prompts' chunks (scheduler.plan_pack)
+        so short prompts stop queueing behind long ones for TTFT.
+        False restores one chunk per step — the ablation baseline the
+        gen_bench packing A/B measures against.
     step_mode: "ragged" (RaggedStep: the decode batch AND the step's
-        prefill chunk packed into ONE pool-donating mixed-batch
+        prefill chunk pack in ONE pool-donating mixed-batch
         dispatch — one executable per pages bucket TOTAL, no dummy
         decode rows), "legacy" (the FusedDecodeStep /
         ChunkedPrefillStep pair, or the eager path per `decode`), or
@@ -163,10 +172,14 @@ class GenerationConfig:
         NamedSharding, and each fused decode step stays ONE GSPMD
         dispatch whose collectives XLA inserts from the annotations
         (docs/GENERATION.md "Sharded decode").  Requires the device KV
-        backend, the fused decode path (auto resolves both), a model
-        whose num_heads divides by the mesh axis, and — for now — the
-        jnp attention path (use_kernel=True raises: the Pallas kernels
-        are single-device programs until the shard_map follow-on).
+        backend, the fused decode path (auto resolves both), and a
+        model whose num_heads divides by the mesh axis.  The Pallas
+        kernels are MESH-NATIVE: under a mesh, use_kernel runs each
+        kernel as a shard_map over the head-sharded mesh (per-shard
+        program = the same kernel on num_heads/tp heads over that
+        shard's pool slice; the two Megatron allreduces stay
+        XLA-placed), so the kernel path and the sharded path are no
+        longer mutually exclusive.
     tp_axis: the mesh axis name to shard heads over; None = the mesh's
         first axis.  Only meaningful with `mesh`.
     prefix_cache: PREFIX CACHING — refcounted copy-on-write page
@@ -198,7 +211,7 @@ class GenerationConfig:
                  decode=None, decode_batch_buckets=None, pool_layout=None,
                  prefill_chunk_tokens=None, step_token_budget=None,
                  mesh=None, tp_axis=None, prefix_cache=None,
-                 step_mode=None):
+                 step_mode=None, prefill_pack=True):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -272,6 +285,11 @@ class GenerationConfig:
                 "(one mixed-batch executable serves decode AND prefill "
                 f"chunks); decode={decode!r} makes no sense with it")
         self.step_mode = step_mode
+        # multi-prompt chunk packing (plan_pack): True fills each step's
+        # leftover token room with MORE prompts' chunks (the RPA packing
+        # rule — the default); False restores one chunk per step (the
+        # ablation baseline the gen_bench packing A/B measures against)
+        self.prefill_pack = bool(prefill_pack)
 
 
 class GenerationResult:
@@ -428,18 +446,15 @@ class GenerationEngine:
         # mirrors jit_prefill's auto policy — TPU default, eager-exact
         # stays the CPU tier-1 default so the zero-tolerance oracle is
         # anchored on the unfused path
+        # the kernels are mesh-native (shard_map over the head-sharded
+        # mesh, ops/pallas/paged_attention._head_shard_map), so a mesh
+        # no longer forces the jnp fallback: sharded and fast are the
+        # same path.  Genuinely unsupported combos (heads not divisible
+        # by tp) still fail loudly — at pool construction and again in
+        # the kernel wrapper.
         self._use_kernel = (self.config.use_kernel
                             if self.config.use_kernel is not None
-                            else (on_tpu and mesh is None))
-        if mesh is not None and self._use_kernel:
-            raise ValueError(
-                "use_kernel=True under a mesh is not supported: the "
-                "Pallas kernels are single-device programs (running one "
-                "inside a GSPMD dispatch would compute over a shard as "
-                "if it were the whole pool) — sharded decode uses the "
-                "jnp attention path, which GSPMD partitions over heads; "
-                "a shard_map'd kernel is the tracked follow-on "
-                "(ROADMAP)")
+                            else on_tpu)
         fusable = (backend == "device"
                    and hasattr(model, "decode_step_fn")
                    and hasattr(model, "decode_params"))
@@ -601,6 +616,12 @@ class GenerationEngine:
                 max_seqs=slots + 1, use_kernel=self._use_kernel,
                 mesh=mesh, tp_axis=tp_axis)
         self.metrics.set_mesh_devices(self.tp_degree)
+        # which attention implementation this engine's step mode
+        # dispatches — "pallas" or "jnp-reference", prefixed with the
+        # step mode — so a silent fallback to the reference path is a
+        # visible stats fact instead of an inference from timings (the
+        # bug class that hid the mesh/kernel gap for three PRs)
+        self.metrics.set_kernel_path(self.decode_mode, self._use_kernel)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
@@ -784,31 +805,49 @@ class GenerationEngine:
             self._apply_logits_batch(active, logits)
 
     def _step_chunked(self):
-        """One legacy chunked-prefill step: admit, at most ONE prefill-
-        chunk dispatch (the oldest mid-prefill sequence), plus the
-        whole decode batch — every step.  There is no token-budget
-        competition anymore: the decode-owed stall dance existed to
-        arbitrate the two dispatches a tight budget couldn't afford
-        together, and it died when the ragged step put both in ONE
-        dispatch; the legacy path keeps its two dispatches but simply
-        runs both (decode never stalls)."""
+        """One legacy chunked-prefill step: admit, a PACK of prefill-
+        chunk dispatches (the oldest mid-prefill sequence's chunk
+        first, then more prompts' chunks into the step token budget's
+        leftover room — the same packing rule as the ragged step, one
+        dispatch per chunk here), plus the whole decode batch — every
+        step.  There is no token-budget competition: the decode-owed
+        stall dance existed to arbitrate the two dispatches a tight
+        budget couldn't afford together, and it died when the ragged
+        step put both in ONE dispatch; the legacy path simply runs
+        everything (decode never stalls), and the budget only sizes
+        the pack so short prompts stop queueing behind long ones."""
         from ..profiler import RecordEvent
 
         self.scheduler.admit(limit=self.config.max_prefill_batch)
         self._reap_deadlines()
-        chunk_state, chunk_len = self.scheduler.plan_step(
-            self.prefill_chunk_tokens)
+        # the budget sizes the PACK, never the oldest prompt's chunk:
+        # pre-pack semantics ran one full chunk every step regardless,
+        # so a tight explicit budget must not starve prefill — the
+        # floor guarantees the head of the line its whole chunk and
+        # packs extras only from genuine leftover
+        room = (max(self.step_token_budget
+                    - len(self.scheduler.decode_ready()),
+                    self.prefill_chunk_tokens)
+                if self.step_token_budget else None)
+        pack = self.scheduler.plan_pack(
+            self.prefill_chunk_tokens, room=room,
+            max_seqs=None if self.config.prefill_pack else 1)
         advanced = 0
-        chunk_u = chunk_d = chunk_dispatched = 0
-        if chunk_state is not None:
-            if self._prefill_chunk_step(chunk_state, chunk_len):
+        chunk_u = chunk_d = chunk_dispatched = chunk_syncs = 0
+        for state, n in pack:
+            if state.slot is None or not state.prefilling:
+                continue  # preempted by an earlier pack reservation
+            if self._prefill_chunk_step(state, n):
                 advanced += 1
                 if self._chunk_step is not None:
-                    chunk_u = self._chunk_step.last_rows_useful
-                    chunk_d = self._chunk_step.last_rows_dispatched
-                    chunk_dispatched = 1   # the jitted chunk dispatch
+                    chunk_u += self._chunk_step.last_rows_useful
+                    chunk_d += self._chunk_step.last_rows_dispatched
+                    chunk_dispatched += 1   # one jitted chunk dispatch
                 else:
-                    chunk_u = chunk_d = chunk_len  # eager: exact rows
+                    chunk_u += n
+                    chunk_d += n  # eager: exact rows
+                if not state.prefilling:
+                    chunk_syncs += 1  # final chunk: logits materialized
         decoding = self.scheduler.decode_ready()
         if decoding:
             with StepTimer() as timer:
@@ -820,16 +859,16 @@ class GenerationEngine:
                 self.metrics.observe_step(len(decoding), timer.seconds)
                 advanced += len(decoding)
         if chunk_dispatched:
-            # the step really issued TWO device programs (chunk +
-            # decode) — the gauge must say so, or the legacy-vs-ragged
-            # dispatches-per-step A/B reads a false 1 vs 1.  A
-            # chunk-only step is the chunk's one dispatch (its host
-            # sync, if any, is the final chunk's logits fetch).
+            # the step really issued EXTRA device programs (one per
+            # packed chunk, plus decode) — the gauge must say so, or
+            # the legacy-vs-ragged dispatches-per-step A/B reads a
+            # false 1 vs 1.  A chunk-only step is the pack's dispatches
+            # (its host syncs are the final chunks' logits fetches).
             if decoding:
-                self.metrics.count_step_extra_dispatches(1)
+                self.metrics.count_step_extra_dispatches(chunk_dispatched)
             else:
-                self.metrics.observe_decode_step(
-                    1, 0 if chunk_state.prefilling else 1)
+                self.metrics.observe_decode_step(chunk_dispatched,
+                                                 chunk_syncs)
         self._observe_step_rows(len(decoding), chunk_u, chunk_d)
         self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
@@ -838,14 +877,18 @@ class GenerationEngine:
     # --------------------------- ragged step -------------------------
     def _step_ragged(self):
         """One RAGGED mixed-batch step: the decode batch's single-token
-        rows AND the step's prefill chunk packed into ONE pool-donating
-        dispatch (fused.RaggedStep) — no dummy decode rows, no separate
-        chunk dispatch, one executable per pages bucket TOTAL.
+        rows AND a PACK of prefill chunks — MULTIPLE prompts' chunks
+        filling the packed axis's leftover room, not one chunk per step
+        — in ONE pool-donating dispatch (fused.RaggedStep).  No dummy
+        decode rows, no separate chunk dispatch, one executable per
+        pages bucket TOTAL; short prompts stop queueing behind long
+        ones for TTFT (the RPA packing rule).
 
         Order mirrors the legacy chunked step: plan and reserve the
-        chunk FIRST (its reservation may preempt youngest decode
-        sequences — they simply drop out of the decode batch), then the
-        decode capacity check (which may preempt the chunker — its
+        chunks FIRST (a reservation may preempt youngest decode
+        sequences — they simply drop out of the decode batch — or even
+        a YOUNGER pack member, which then drops out of the pack), then
+        the decode capacity check (which may preempt chunkers — their
         freed rows drop out of the pack)."""
         from ..profiler import RecordEvent
 
@@ -855,62 +898,65 @@ class GenerationEngine:
             # only decode rides the ragged dispatch
             self._prefill_admitted(admitted)
         self._reap_deadlines()
-        chunk_state, chunk_len, chunk_start = None, 0, 0
+        pack = []  # [(state, n, start)] — reserved, still-alive chunks
         if self.prefill_chunk_tokens:
             room = self.step_token_budget - \
                 len(self.scheduler.decode_ready())
-            chunk_state, chunk_len = self.scheduler.plan_step(
-                self.prefill_chunk_tokens, max_chunk=room)
-            if chunk_state is not None:
-                chunk_start = self._reserve_chunk(chunk_state, chunk_len)
-                if chunk_start is None:
-                    chunk_state, chunk_len = None, 0
+            planned = self.scheduler.plan_pack(
+                self.prefill_chunk_tokens, room=room,
+                max_seqs=(self._ragged.max_seqs
+                          if self.config.prefill_pack else 1))
+            for state, n in planned:
+                if state.slot is None or not state.prefilling:
+                    continue  # preempted by an earlier pack reservation
+                start = self._reserve_chunk(state, n)
+                if start is not None:
+                    pack.append((state, n, start))
         decoding = self.scheduler.decode_ready()
         if decoding:
             decoding = self._ensure_step_capacity()
-        if chunk_state is not None and (chunk_state.slot is None
-                                        or not chunk_state.prefilling):
-            # the decode capacity check preempted the chunker: its
-            # reserved span died with its pages — drop it from the pack
-            chunk_state, chunk_len = None, 0
-        if not decoding and chunk_state is None:
+        # reservations and the capacity check preempt youngest-first —
+        # a victim's reserved span died with its pages, so it (and any
+        # pack member preempted by a LATER member's reservation) drops
+        # out of the pack here
+        pack = [(s, n, st) for s, n, st in pack
+                if s.slot is not None and s.prefilling]
+        if not decoding and not pack:
             self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
             self._observe_occupancy()
             return 0
         with StepTimer() as timer:
             with RecordEvent("generation::ragged_step"):
-                advanced, sampled = self._dispatch_ragged(
-                    decoding, chunk_state, chunk_len, chunk_start)
+                advanced, sampled = self._dispatch_ragged(decoding, pack)
         if sampled:
             self.metrics.observe_step(sampled, timer.seconds)
         self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
         self._observe_occupancy()
         return advanced
 
-    def _dispatch_ragged(self, decoding, chunk_state, chunk_len,
-                         chunk_start):
+    def _dispatch_ragged(self, decoding, pack):
         """Pack, dispatch, sample: rows [0, B) are the decode batch
-        (slot order, one new token each), rows [B, B + C) the prefill
-        chunk; descriptor i covers decode sequence i (len 1), and
-        descriptor B the chunk (len C).  Returns ``(advanced,
+        (slot order, one new token each), then each packed chunk's rows
+        consecutively; descriptor i covers decode sequence i (len 1),
+        descriptor B + j the pack's j-th chunk.  Returns ``(advanced,
         sampled)``."""
-        b, c = len(decoding), chunk_len
+        b = len(decoding)
         seq_ids, d_tokens, positions = self._reserve_decode_rows(decoding)
         tokens = list(d_tokens)
         desc_ids = list(seq_ids)
-        if c:
-            # COW-safe donation chain for the chunk span, mirroring the
+        for state, n, start in pack:
+            # COW-safe donation chain for each chunk span, mirroring the
             # decode rows' guard in _reserve_decode_rows
-            self.cache.check_span_writable(chunk_state.seq_id,
-                                           chunk_start, c)
-            tokens += chunk_state.tokens[chunk_start:chunk_start + c]
-            desc_ids.append(chunk_state.seq_id)
+            self.cache.check_span_writable(state.seq_id, start, n)
+            tokens += state.tokens[start:start + n]
+            desc_ids.append(state.seq_id)
         # kv_lens straight off the cache: a decode row's length already
-        # includes its reserved token, the chunk's its whole span —
+        # includes its reserved token, each chunk's its whole span —
         # and pt row i IS descriptor i's table, so the scatter targets
         # below index it directly (one table walk per step, not two)
         pt, kv_lens = self.cache.gather_block_tables(desc_ids)
-        t_real = b + c
+        c_total = sum(n for _, n, _ in pack)
+        t_real = b + c_total
         pos_all = np.zeros((t_real,), np.int32)
         pages = np.empty((t_real,), np.int32)
         rows = np.empty((t_real,), np.int32)
@@ -919,41 +965,43 @@ class GenerationEngine:
             pos_all[:b] = positions
             pages[:b] = pt[np.arange(b), positions // ps]
             rows[:b] = positions % ps
-        if c:
-            span = np.arange(chunk_start, chunk_start + c)
-            pos_all[b:] = span
-            pages[b:] = pt[b, span // ps]
-            rows[b:] = span % ps
         starts = np.arange(len(desc_ids), dtype=np.int32)
         lens = np.ones((len(desc_ids),), np.int32)
-        if c:
-            starts[-1] = b
-            lens[-1] = c
+        off = b
+        for j, (state, n, start) in enumerate(pack):
+            span = np.arange(start, start + n)
+            pos_all[off:off + n] = span
+            pages[off:off + n] = pt[b + j, span // ps]
+            rows[off:off + n] = span % ps
+            starts[b + j] = off
+            lens[b + j] = n
+            off += n
         ids_dev, logits_dev = self._ragged.step(
             np.asarray(tokens, np.int32), pos_all, pages, rows, pt,
             starts, lens, kv_lens)
         # the scatter ran inside the dispatch; keep the O(tokens) write
         # bound visible in kv_bytes_moved (comparable across paths)
         self.cache.count_fused_append(t_real)
-        finishing = None
-        if c:
-            chunk_state.prefill_pos += c
-            self.metrics.count_prefill(c)
+        finishing = []  # [(state, descriptor index)]
+        for j, (state, n, start) in enumerate(pack):
+            state.prefill_pos += n
+            self.metrics.count_prefill(n)
             self.metrics.count_chunk()
-            self._prewarm_decode(chunk_state)
-            if chunk_state.prefill_pos == len(chunk_state.tokens):
-                chunk_state.prefilling = False
-                self._register_prefix(chunk_state)
-                finishing = chunk_state
-        # samplers: every decode row, plus the chunk's last row when it
-        # just completed its prompt (those logits ARE the first-token
-        # logits).  A mid-prompt chunk-only step fetches NOTHING — zero
-        # host syncs, exactly like the legacy unmaterialized chunks.
+            self._prewarm_decode(state)
+            if state.prefill_pos == len(state.tokens):
+                state.prefilling = False
+                self._register_prefix(state)
+                finishing.append((state, b + j))
+        # samplers: every decode row, plus each packed chunk's last row
+        # when it just completed its prompt (those logits ARE the
+        # first-token logits).  A mid-prompt chunk-only step fetches
+        # NOTHING — zero host syncs, exactly like the legacy
+        # unmaterialized chunks.
         samplers = list(decoding)
         rows_idx = list(range(b))
-        if finishing is not None:
-            samplers.append(finishing)
-            rows_idx.append(b)
+        for state, di in finishing:
+            samplers.append(state)
+            rows_idx.append(di)
         syncs = 0
         if samplers:
             syncs = 1
@@ -973,7 +1021,12 @@ class GenerationEngine:
         self.metrics.observe_step_rows(self._ragged.last_rows_useful,
                                        self._ragged.last_rows_dispatched,
                                        0)
-        return b + (1 if c else 0), len(samplers)
+        # the query-tiling FLOP proxy: score blocks this dispatch
+        # computed vs the untiled kernel's bill on the same descriptors
+        self.metrics.count_score_blocks(
+            self._ragged.last_score_blocks,
+            self._ragged.last_score_blocks_untiled)
+        return b + len(pack), len(samplers)
 
     def run_until_idle(self, max_steps=100000):
         """Drive step() until queue+slots drain (tests/benchmarks)."""

@@ -453,6 +453,7 @@ class RaggedStep:
         self._tp_axis = tp_axis
         self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
         self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._use_kernel = bool(use_kernel)
         self._param_leaves, self._param_tree = _shard_params(
             model, mesh, tp_axis, jax)
         pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
@@ -478,6 +479,12 @@ class RaggedStep:
         self.last_collective_bytes = 0
         self.last_rows_useful = 0
         self.last_rows_dispatched = 0
+        # FLOP-proxy accounting of the query-tiled kernel (the host-side
+        # mirror of its skip rule — ops/pallas ragged_score_blocks):
+        # score blocks this dispatch computed vs what the untiled
+        # kernel would have, in the same [q_block, page_size] units
+        self.last_score_blocks = 0
+        self.last_score_blocks_untiled = 0
 
     @property
     def compile_count(self):
@@ -567,6 +574,19 @@ class RaggedStep:
                 *k_pools, *v_pools, *self._param_leaves]
         ids, logits = _dispatch_donating(
             self._cache, self._exec, args, self._num_layers, n_out=2)
+        # the FLOP proxy mirrors the TILED KERNEL's skip rule — only
+        # meaningful (and only paid) when the kernel path actually
+        # dispatched; the jnp reference computes dense masked blocks,
+        # and reporting kernel skip statistics for it would make the
+        # gen_bench /ref-vs-/kernel score_blocks column path-blind
+        if self._use_kernel:
+            from ..ops.pallas.paged_attention import ragged_score_blocks
+
+            self.last_score_blocks, self.last_score_blocks_untiled = \
+                ragged_score_blocks(st, ln, kv, self._cache.page_size,
+                                    bucket_p, t)
+        else:
+            self.last_score_blocks = self.last_score_blocks_untiled = 0
         self.last_dispatches = 1
         self.last_rows_useful = t_real
         self.last_rows_dispatched = t

@@ -103,6 +103,36 @@ Metric names:
                                       decode-tail pages indexed at
                                       retire (generated tokens a
                                       multi-turn client re-sends)
+- ``generation.kernel_path``          gauge (string): which attention
+                                      implementation the engine's step
+                                      mode dispatches —
+                                      ``"<mode>:pallas"`` or
+                                      ``"<mode>:jnp-reference"`` where
+                                      mode is ragged/fused/eager.  Set
+                                      at engine build (the dispatch
+                                      path cannot change after), so a
+                                      silent fallback to the reference
+                                      path is visible in every stats
+                                      snapshot instead of inferred
+                                      from timings
+- ``generation.step_score_blocks``    [q_block, page_size] score-block
+                                      computations per head the TILED
+                                      ragged kernel performs (the
+                                      query-axis tiling skip rule,
+                                      mirrored host-side per dispatch
+                                      — ops/pallas
+                                      ragged_score_blocks).  Emitted
+                                      ONLY when the kernel path
+                                      dispatched; 0 on the jnp
+                                      reference, which runs no tiled
+                                      kernel to proxy
+- ``generation.step_score_blocks_untiled``  what the UNTILED kernel
+                                      (full packed token axis per live
+                                      (descriptor, page) cell) would
+                                      have computed on the same
+                                      dispatches, in the same tile
+                                      units — tiled < untiled is the
+                                      measured out-of-span skip
 - ``generation.mesh_devices``         gauge: tensor-parallel degree of
                                       the engine's mesh (1 unsharded)
 - ``generation.collective_bytes_per_step``  gauge: estimated on-wire
@@ -148,6 +178,9 @@ DECODE_COMPILES_PREWARM = PREFIX + "decode_compiles_prewarm"
 TOKENS_PER_S = PREFIX + "tokens_per_s"
 SLOT_OCCUPANCY_PCT = PREFIX + "slot_occupancy_pct"
 PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
+KERNEL_PATH = PREFIX + "kernel_path"
+STEP_SCORE_BLOCKS = PREFIX + "step_score_blocks"
+STEP_SCORE_BLOCKS_UNTILED = PREFIX + "step_score_blocks_untiled"
 MESH_DEVICES = PREFIX + "mesh_devices"
 COLLECTIVE_BYTES_PER_STEP = PREFIX + "collective_bytes_per_step"
 PREFIX_CACHE_HIT_TOKENS = PREFIX + "prefix_cache_hit_tokens"
@@ -290,6 +323,22 @@ class GenerationMetrics:
         SET the gauge; this adds on top, called after them)."""
         stat = self._stat(DECODE_DISPATCHES_PER_STEP)
         stat.set(int(stat.get()) + int(n))
+
+    def set_kernel_path(self, mode, use_kernel):
+        """Gauge (string): ``"<mode>:pallas"`` / ``"<mode>:jnp-reference"``
+        — the attention implementation the engine's step mode
+        dispatches, stamped once at engine build so every snapshot says
+        which path produced its numbers."""
+        path = "pallas" if use_kernel else "jnp-reference"
+        self._stat(KERNEL_PATH).set(f"{mode}:{path}")
+
+    def count_score_blocks(self, tiled, untiled):
+        """FLOP-proxy accounting for one ragged dispatch: score blocks
+        the query-TILED kernel computes vs what the untiled kernel
+        would have (same units; ops/pallas ragged_score_blocks)."""
+        if untiled:
+            self._stat(STEP_SCORE_BLOCKS).increase(int(tiled))
+            self._stat(STEP_SCORE_BLOCKS_UNTILED).increase(int(untiled))
 
     def set_mesh_devices(self, n):
         """Gauge: the engine's tensor-parallel degree (mesh axis size;

@@ -270,7 +270,7 @@ class TinyCausalLM:
                 v_out.append(vp)
                 attn = decode_attention.chunk_prefill_attention(
                     q, kp, vp, pt, start, use_kernel=use_kernel,
-                    layout=pool_layout)
+                    layout=pool_layout, mesh=mesh, tp_axis=tp_axis)
                 x = x + attn.reshape(c, self.d_model) @ blk["wo"]
                 x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
                                                    blk["ln2_b"]))
@@ -421,7 +421,7 @@ class TinyCausalLM:
                 v_out.append(vp)
                 attn = decode_attention.paged_decode_attention(
                     q, kp, vp, pt, lens, use_kernel=use_kernel,
-                    layout=pool_layout)
+                    layout=pool_layout, mesh=mesh, tp_axis=tp_axis)
                 x = x + attn.reshape(b, self.d_model) @ blk["wo"]
                 x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
                                                    blk["ln2_b"]))
@@ -514,7 +514,8 @@ class TinyCausalLM:
                 v_out.append(vp)
                 attn = decode_attention.ragged_paged_attention(
                     q, kp, vp, pt, starts, lens, kv_lens,
-                    use_kernel=use_kernel, layout=pool_layout)
+                    use_kernel=use_kernel, layout=pool_layout,
+                    mesh=mesh, tp_axis=tp_axis)
                 x = x + attn.reshape(t, self.d_model) @ blk["wo"]
                 x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
                                                    blk["ln2_b"]))

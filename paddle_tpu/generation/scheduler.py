@@ -155,11 +155,18 @@ class ContinuousBatchingScheduler:
                        if s is not None and s.prefilling),
                       key=lambda s: s.seq_id)
 
-    def plan_step(self, chunk_tokens, max_chunk=None):
-        """Prefill plan for one engine step: the single chunk this step
-        dispatches — the OLDEST mid-prefill sequence's next
-        ``min(chunk_tokens, remaining prompt, max_chunk)`` tokens — as
-        ``(chunk_state, chunk_len)``, or ``(None, 0)``.
+    def plan_pack(self, chunk_tokens, room=None, max_seqs=None):
+        """Prefill plan for one engine step: MULTIPLE prompts' chunks
+        packed FIFO into `room` tokens (the RPA-paper packing rule —
+        short prompts stop queueing behind long ones for TTFT).
+
+        The oldest mid-prefill sequence gets its next
+        ``min(chunk_tokens, remaining prompt, room)`` tokens first —
+        exactly the old one-chunk plan — then the step's LEFTOVER room
+        goes to the next prompts in FIFO order, each clipped the same
+        way, until the room (None = unbounded), the descriptor budget
+        `max_seqs`, or the prefilling line runs out.  Returns
+        ``[(state, n), ...]`` (possibly empty).
 
         The decode batch ALWAYS runs alongside; there is no token-budget
         competition and no decode-owed debt anymore.  The old dance
@@ -168,20 +175,33 @@ class ContinuousBatchingScheduler:
         arbitrate by stalling one of them; the ragged step put both in
         ONE dispatch whose token axis is sized for the full decode batch
         plus a chunk by construction, and the legacy chunked path
-        inherits the same simple plan (every step: one chunk + the whole
-        decode batch — decode never stalls).  `max_chunk` clips the
-        chunk to the packed-axis room left after the decode rows (the
-        ragged caller passes it; None = unclipped)."""
-        prefilling = self.prefilling()
-        if not prefilling:
-            return None, 0
-        cand = prefilling[0]
-        n = min(int(chunk_tokens), len(cand.tokens) - cand.prefill_pos)
-        if max_chunk is not None:
-            n = min(n, int(max_chunk))
-        if n <= 0:
-            return None, 0
-        return cand, n
+        inherits the same plan (each packed chunk is its own
+        dispatch there, the packed-axis room its per-step prefill token
+        budget)."""
+        pack = []
+        left = None if room is None else int(room)
+        for cand in self.prefilling():
+            if left is not None and left <= 0:
+                break
+            if max_seqs is not None and len(pack) >= max_seqs:
+                break
+            n = min(int(chunk_tokens), len(cand.tokens) - cand.prefill_pos)
+            if left is not None:
+                n = min(n, left)
+            if n <= 0:
+                continue
+            pack.append((cand, n))
+            if left is not None:
+                left -= n
+        return pack
+
+    def plan_step(self, chunk_tokens, max_chunk=None):
+        """The single-chunk view of plan_pack (the oldest mid-prefill
+        sequence's next chunk, clipped to `max_chunk`), as
+        ``(chunk_state, chunk_len)`` or ``(None, 0)`` — kept for
+        callers that dispatch exactly one chunk."""
+        pack = self.plan_pack(chunk_tokens, room=max_chunk, max_seqs=1)
+        return pack[0] if pack else (None, 0)
 
     def _place(self, state):
         for i, s in enumerate(self.slots):

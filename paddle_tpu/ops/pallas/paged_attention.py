@@ -18,6 +18,17 @@ pads unused slots with page 0, which is always a valid DMA target.
 Layouts are chosen Mosaic tile-legal by construction: pools transpose to
 [H, P, page_size, D] so every block's trailing two dims are full array
 dims (page_size, D); q/out ride as [B, H, 1, D] with (1, 1, 1, D) blocks.
+
+MESH-NATIVE dispatch: every public kernel takes ``mesh`` / ``tp_axis``.
+Heads are fully independent in all three grids, so under a head-sharded
+tensor-parallel mesh the kernel runs as a ``shard_map`` whose per-shard
+program is the SAME single-device kernel on ``num_heads / tp`` heads
+over that shard's slice of the pool — q/out split on the head axis,
+pools split per ``kv_pool_spec``, page tables and descriptors
+replicated.  NO collective enters the kernel: the generation stack's
+two per-layer Megatron allreduces stay XLA-placed outside it (exactly
+where GSPMD puts them on the jnp reference path), which is the layout
+the EQuARX-style quantized-collective follow-on assumes.
 """
 import functools
 
@@ -31,17 +42,27 @@ from .flash_attention import NEG_INF, _interpret
 _STATE_ROWS = 8  # scratch rows; every row holds the same value so all
 # scratch traffic is full-width vector ops (the Mosaic-proven layout)
 
+# query-axis tile of the RAGGED kernel (RPA-paper waste fix #1): a
+# (head, descriptor, page) grid cell computes a [RAGGED_Q_BLOCK,
+# page_size] score block for ONE query tile instead of the full packed
+# [T, page_size] axis, and tiles outside the descriptor's row span are
+# skipped entirely — a 1-token decode descriptor computes 1 tile per
+# page, not T/8.  8 is the Mosaic sublane width (the flash kernels'
+# proven minor-axis tile).
+RAGGED_Q_BLOCK = 8
+
 
 def _reject_mesh_sharded_pool(pool):
-    """Loud failure over silent corruption: a Pallas kernel is a
+    """Loud failure over silent corruption: the raw kernel is a
     single-device program — handed a pool committed to a multi-device
-    NamedSharding (the tensor-parallel generation mesh), pallas_call
-    would either fail opaquely or compute over one shard as if it were
-    the whole pool.  The sharded engine routes around the kernels (the
-    jnp references ARE GSPMD-partitionable; engine.py forces
-    use_kernel=False under a mesh); this guard catches direct callers.
-    Tracers (pools inside a jit trace) pass through untouched — the
-    in-trace caller's own sharding machinery governs there."""
+    NamedSharding (the tensor-parallel generation mesh) WITHOUT the
+    matching ``mesh=`` argument, pallas_call would either fail opaquely
+    or compute over one shard as if it were the whole pool.  Passing
+    ``mesh=``/``tp_axis=`` runs the shard_map'd form instead (the
+    supported mesh path); this guard catches direct callers that forgot
+    to.  Tracers (pools inside a jit or shard_map trace) pass through
+    untouched — the in-trace caller's own sharding machinery governs
+    there."""
     try:
         sharding = getattr(pool, "sharding", None)
     except Exception:
@@ -51,11 +72,81 @@ def _reject_mesh_sharded_pool(pool):
     if (isinstance(sharding, NamedSharding)
             and len(sharding.device_set) > 1):
         raise NotImplementedError(
-            "Pallas paged attention over a mesh-sharded KV pool is not "
-            "supported: the kernel is a single-device program (a "
-            "shard_map'd variant is the tracked follow-on, ROADMAP).  "
-            "Use the jnp reference path (use_kernel=False) — GSPMD "
-            "partitions it over the head axis.")
+            "Pallas paged attention over a mesh-sharded KV pool needs "
+            "the mesh spelled out: pass mesh=/tp_axis= to run the "
+            "shard_map'd kernel (per-shard program over num_heads/tp "
+            "heads), or use the jnp reference path (use_kernel=False) — "
+            "GSPMD partitions it over the head axis.  Calling the raw "
+            "single-device kernel on a sharded pool would compute over "
+            "one shard as if it were the whole pool.")
+
+
+def _head_shard_map(body, mesh, tp_axis, layout, q, k_pool, v_pool,
+                    *scalars):
+    """Run `body` (a single-device kernel call) as a shard_map over the
+    head-sharded tensor-parallel mesh: q and the output split on their
+    head axis (axis 1 in all three kernels), the pools split per
+    ``kv_pool_spec``, page tables / descriptors / lengths replicated.
+    Heads are fully independent in every grid, so the per-shard program
+    is exactly the existing kernel on num_heads/tp heads over that
+    shard's slice of the pool — no collective is issued here or inside
+    the kernel."""
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.collective import shard_map
+    from ...parallel.sharding_annotations import kv_pool_spec
+
+    if tp_axis is None:
+        tp_axis = tuple(mesh.axis_names)[0]
+    tp = int(mesh.shape[tp_axis])
+    h = q.shape[1]
+    if h % tp:
+        raise ValueError(
+            f"num_heads={h} is not divisible by tp_degree={tp} (axis "
+            f"{tp_axis!r} of the mesh): the shard_map'd kernel splits "
+            f"the head axis, so heads must divide evenly")
+    qspec = P(None, tp_axis, None)
+    pspec = P(*kv_pool_spec(layout, tp_axis))
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(qspec, pspec, pspec) + (P(),) * len(scalars),
+                   out_specs=qspec)
+    return fn(q, k_pool, v_pool, *scalars)
+
+
+def ragged_score_blocks(starts, lens, kv_lens, page_size, n_pages, n_rows,
+                        q_block=RAGGED_Q_BLOCK):
+    """Host-side mirror of the tiled ragged kernel's skip rule — the
+    FLOP-proxy counter `generation.step_score_blocks` is set from.
+
+    Returns ``(tiled, untiled)``: the number of [q_block, page_size]
+    score-block computations per head the TILED kernel performs for
+    these descriptors, and the number the UNTILED kernel (full packed
+    token axis per live (descriptor, page) cell) would have performed,
+    expressed in the same tile units so "tiled < untiled" is the
+    measured statement that out-of-span work was skipped."""
+    import numpy as np
+
+    qb = max(1, min(int(q_block), int(n_rows)))
+    n_tiles = -(-int(n_rows) // qb)
+    ps = int(page_size)
+    starts = np.asarray(starts, np.int64)
+    lens = np.asarray(lens, np.int64)
+    kv_lens = np.asarray(kv_lens, np.int64)
+    live = (lens > 0) & (kv_lens > 0)
+    pages_live = np.minimum(-(-kv_lens // ps), int(n_pages))
+    untiled = int((n_tiles * pages_live)[live].sum())
+    tiled = 0
+    # this runs in the engine's hot step loop (once per ragged kernel
+    # dispatch): descriptors are few (<= slots + 1), so loop those, but
+    # the tile axis — the factor that grows with the packed axis — is
+    # closed-form vectorized, never a Python loop
+    for start, ln, kv in zip(starts[live], lens[live], kv_lens[live]):
+        qt = np.arange(start // qb,
+                       min((start + ln - 1) // qb, n_tiles - 1) + 1)
+        last = np.minimum((qt + 1) * qb, start + ln) - 1
+        qpos_max = kv - ln + (last - start)
+        tiled += int((qpos_max // ps + 1).sum())
+    return tiled, untiled
 
 
 def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
@@ -158,23 +249,37 @@ def _chunk_kernel(pt_ref, info_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
                    o_ref, acc_ref, m_ref, l_ref, *, page_size, n_pages,
-                   n_seqs, n_rows):
-    """RAGGED mixed-batch paged attention: `n_rows` packed query rows
-    (decode singletons AND prefill-chunk runs in one token axis) attend
-    through per-descriptor page tables.  Descriptor s owns packed rows
-    [st_ref[s], st_ref[s] + ln_ref[s]); row r of s sits at global
-    position kv_ref[s] - ln_ref[s] + (r - st_ref[s]) and sees keys
-    [0, position].  The grid walks (head, descriptor, page) with online-
-    softmax state [n_rows, ...] persisting across BOTH the page and the
-    descriptor axes: a descriptor's pages update only its own rows —
-    foreign rows see an all-NEG_INF score block, whose update is the
-    exact identity (alpha == exp(0) == 1, sum(p) == 0) — so one state
-    accumulation serves the whole ragged batch.  Descriptors with
-    ln == 0 (padding) and pages past kv_len are skipped entirely."""
+                   n_seqs, q_block):
+    """RAGGED mixed-batch paged attention, QUERY-TILED (the RPA paper's
+    kernel shape): packed query rows (decode singletons AND
+    prefill-chunk runs in one token axis) attend through per-descriptor
+    page tables.  Descriptor s owns packed rows [st_ref[s], st_ref[s] +
+    ln_ref[s]); row r of s sits at global position kv_ref[s] - ln_ref[s]
+    + (r - st_ref[s]) and sees keys [0, position].
+
+    The grid walks (head, descriptor, page, QUERY TILE) — the tile axis
+    INNERMOST, so the k/v BlockSpec index (h, pt[s, i]) is constant
+    across a page's tile sweep and Pallas elides the repeated page-
+    block DMA: the tiled kernel moves exactly the HBM bytes the untiled
+    kernel did (q/out ride whole-axis blocks fetched once per head),
+    while COMPUTE is per-tile.  A (descriptor, page, tile) cell runs
+    ONLY when the tile intersects the descriptor's row span AND the
+    page holds a key some in-span row of the tile can see — a 1-token
+    decode descriptor computes one [q_block, page_size] block per
+    visible page instead of a full [T, page_size] one, and pages past a
+    row's causal horizon are skipped too (the tile's last in-span row
+    sees the most: qpos_max = kv_len - ln + (last_row - start)).
+    Online-softmax state spans the whole (tile-padded) token axis in
+    scratch; each live cell updates ITS tile's row slice.  Rows of a
+    tile the descriptor doesn't own see an all-NEG_INF score row, whose
+    update is the exact identity (alpha == exp(0) == 1, sum(p) == 0),
+    so tiles straddling a descriptor boundary stay exact.  Descriptors
+    with ln == 0 (padding) never run."""
     s = pl.program_id(1)
     i = pl.program_id(2)
+    qt = pl.program_id(3)
 
-    @pl.when((s == 0) & (i == 0))
+    @pl.when((s == 0) & (i == 0) & (qt == 0))
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
@@ -183,37 +288,49 @@ def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
     start = st_ref[s]
     ln = ln_ref[s]
     kv_len = kv_ref[s]
+    row0 = qt * q_block
+    # the tile's last row inside the descriptor's span sees the most
+    # keys; pages past its causal horizon hold nothing any tile row can
+    # attend (qpos_max < kv_len always, so "page has resident keys" is
+    # implied)
+    last = jnp.minimum(row0 + q_block, start + ln) - 1
+    qpos_max = kv_len - ln + (last - start)
+    live = ((ln > 0) & (row0 < start + ln) & (row0 + q_block > start)
+            & (i * page_size <= qpos_max))
 
-    # page i of descriptor s runs iff the descriptor is live and the
-    # page holds at least one resident key
-    @pl.when((ln > 0) & (i * page_size < kv_len))
+    @pl.when(live)
     def _compute():
-        q = q_ref[0]                               # [n_rows, D]
+        rows_sl = pl.dslice(row0, q_block)
+        q = q_ref[0, rows_sl]                      # [q_block, D]
         k = k_ref[0, 0]                            # [page_size, D]
         v = v_ref[0, 0]
         sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        row = jax.lax.broadcasted_iota(jnp.int32, (n_rows, page_size), 0)
+        row = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, page_size), 0)
         col = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (n_rows, page_size), 1)
+            jnp.int32, (q_block, page_size), 1)
         mine = (row >= start) & (row < start + ln)
         qpos = kv_len - ln + (row - start)
         sc = jnp.where(mine & (col <= qpos), sc, NEG_INF)
-        m_prev = jnp.max(m_ref[...], axis=1, keepdims=True)   # [n, 1]
+        m_prev = jnp.max(m_ref[rows_sl], axis=1, keepdims=True)  # [qb, 1]
         m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(sc - m_cur)                    # [n, page_size]
+        p = jnp.exp(sc - m_cur)                    # [qb, page_size]
         p = jnp.where(sc <= NEG_INF / 2, 0.0, p)   # masked keys: exactly 0
-        l_prev = jnp.max(l_ref[...], axis=1, keepdims=True)
+        l_prev = jnp.max(l_ref[rows_sl], axis=1, keepdims=True)
         l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+        acc_ref[rows_sl] = acc_ref[rows_sl] * alpha + pv
+        m_ref[rows_sl] = jnp.broadcast_to(m_cur, (q_block,
+                                                  m_ref.shape[1]))
+        l_ref[rows_sl] = jnp.broadcast_to(l_cur, (q_block,
+                                                  l_ref.shape[1]))
 
-    @pl.when((s == n_seqs - 1) & (i == n_pages - 1))
+    @pl.when((s == n_seqs - 1) & (i == n_pages - 1)
+             & (qt == pl.num_programs(3) - 1))
     def _finalize():
         l = jnp.max(l_ref[...], axis=1, keepdims=True)
         safe_l = jnp.where(l > 0.0, l, 1.0)  # unclaimed rows: zeros
@@ -222,23 +339,52 @@ def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
 
 def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
                                   lens, kv_lens, scale, interpret=None,
-                                  layout="token"):
+                                  layout="token", q_block=None,
+                                  mesh=None, tp_axis=None):
     """q: [T, H, D] — the step's PACKED query rows (decode rows and the
-    prefill chunk in one ragged token axis; rows owned by no descriptor
-    come back 0).  k_pool/v_pool: one layer's pool, the chunk's and the
-    decode tokens' K/V already scattered — [P, page_size, H, D]
-    (layout="token") or [H, P, page_size, D] (layout="kernel").
-    page_tables: [S, max_pages] int32 (pad with 0).  starts/lens/
-    kv_lens: [S] int32 descriptors (lens == 0 marks padding
+    prefill chunks in one ragged token axis; rows owned by no
+    descriptor come back 0).  k_pool/v_pool: one layer's pool, the
+    chunks' and the decode tokens' K/V already scattered —
+    [P, page_size, H, D] (layout="token") or [H, P, page_size, D]
+    (layout="kernel").  page_tables: [S, max_pages] int32 (pad with 0).
+    starts/lens/kv_lens: [S] int32 descriptors (lens == 0 marks padding
     descriptors; all three ride as scalar-prefetch operands so the
     BlockSpec index_map DMAs each descriptor's pages straight out of
     the pool).  Returns [T, H, D].
 
+    q_block tiles the packed query axis (default RAGGED_Q_BLOCK):
+    (tile, descriptor, page) cells whose rows lie outside the
+    descriptor's span — or whose page no in-span row can see — are
+    skipped (see _ragged_kernel; ragged_score_blocks mirrors the rule
+    host-side for the FLOP-proxy counter).
+
+    mesh / tp_axis runs the shard_map'd form: the same kernel per shard
+    on num_heads/tp heads over that shard's pool slice (_head_shard_map).
+
     Layout handling mirrors the decode kernel: token-layout pools are
     transposed per call, kernel-layout pools are consumed as stored."""
+    if mesh is not None:
+        def body(q_, kp_, vp_, pt_, st_, ln_, kv_):
+            return ragged_paged_attention_kernel(
+                q_, kp_, vp_, pt_, st_, ln_, kv_, scale,
+                interpret=interpret, layout=layout, q_block=q_block)
+
+        return _head_shard_map(
+            body, mesh, tp_axis, layout, q, k_pool, v_pool,
+            jnp.asarray(page_tables, jnp.int32),
+            jnp.asarray(starts, jnp.int32), jnp.asarray(lens, jnp.int32),
+            jnp.asarray(kv_lens, jnp.int32))
     _reject_mesh_sharded_pool(k_pool)
     t, h, d = q.shape
+    qb = max(1, min(int(q_block or RAGGED_Q_BLOCK), t))
+    n_tiles = -(-t // qb)
+    tpad = n_tiles * qb
     qs = jnp.transpose((q * scale).astype(q.dtype), (1, 0, 2))  # [H, T, D]
+    if tpad != t:
+        # pad the token axis to whole tiles so the kernel's per-tile
+        # row slices stay in bounds; padded rows belong to no
+        # descriptor (exact zeros) and are sliced off below
+        qs = jnp.pad(qs, ((0, 0), (0, tpad - t), (0, 0)))
     if layout == "kernel":
         page_size = k_pool.shape[2]
         kt, vt = k_pool, v_pool          # stored kernel-ready: no copy
@@ -250,39 +396,46 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
-        grid=(h, n_seqs, n_pages),
+        # query tiles INNERMOST: the k/v block index is constant across
+        # a page's tile sweep, so the tiling multiplies COMPUTE cells
+        # only — the page-block DMA schedule (and q/out whole-axis
+        # blocks, fetched once per head) is exactly the untiled
+        # kernel's
+        grid=(h, n_seqs, n_pages, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda h_, s, i, pt, st, ln, kv:
-                         (h_, 0, 0)),
+            pl.BlockSpec((1, tpad, d), lambda h_, s, i, qt, pt, st, ln,
+                         kv: (h_, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
-                         lambda h_, s, i, pt, st, ln, kv:
+                         lambda h_, s, i, qt, pt, st, ln, kv:
                          (h_, pt[s, i], 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
-                         lambda h_, s, i, pt, st, ln, kv:
+                         lambda h_, s, i, qt, pt, st, ln, kv:
                          (h_, pt[s, i], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, t, d), lambda h_, s, i, pt, st, ln, kv:
+        out_specs=pl.BlockSpec((1, tpad, d),
+                               lambda h_, s, i, qt, pt, st, ln, kv:
                                (h_, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((t, d), jnp.float32),
-            pltpu.VMEM((t, 128), jnp.float32),
-            pltpu.VMEM((t, 128), jnp.float32),
+            pltpu.VMEM((tpad, d), jnp.float32),
+            pltpu.VMEM((tpad, 128), jnp.float32),
+            pltpu.VMEM((tpad, 128), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_ragged_kernel, page_size=page_size,
-                          n_pages=n_pages, n_seqs=n_seqs, n_rows=t),
+                          n_pages=n_pages, n_seqs=n_seqs, q_block=qb),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((h, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((h, tpad, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
     )(jnp.asarray(page_tables, jnp.int32), jnp.asarray(starts, jnp.int32),
       jnp.asarray(lens, jnp.int32), jnp.asarray(kv_lens, jnp.int32),
       qs, kt, vt)
-    return jnp.transpose(out, (1, 0, 2))
+    return jnp.transpose(out[:, :t], (1, 0, 2))
 
 
 def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
-                                   scale, interpret=None, layout="token"):
+                                   scale, interpret=None, layout="token",
+                                   mesh=None, tp_axis=None):
     """q: [n, H, D] — one sequence's prefill-chunk queries (row r at
     global position start + r; rows past the real chunk length are
     bucket padding whose output the caller discards).  k_pool/v_pool:
@@ -292,8 +445,21 @@ def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
     start: int32 scalar (traced OK — rides as a scalar-prefetch
     operand).  Returns [n, H, D].
 
+    mesh / tp_axis runs the shard_map'd form (heads independent, page
+    table and start replicated — _head_shard_map).
+
     Same layout reasoning as the decode kernel: token-layout pools are
     transposed per call, kernel-layout pools are consumed as stored."""
+    if mesh is not None:
+        def body(q_, kp_, vp_, pt_, st_):
+            return chunk_prefill_attention_kernel(
+                q_, kp_, vp_, pt_, st_, scale, interpret=interpret,
+                layout=layout)
+
+        return _head_shard_map(
+            body, mesh, tp_axis, layout, q, k_pool, v_pool,
+            jnp.asarray(page_table, jnp.int32),
+            jnp.asarray(start, jnp.int32))
     _reject_mesh_sharded_pool(k_pool)
     n, h, d = q.shape
     qs = jnp.transpose((q * scale).astype(q.dtype), (1, 0, 2))  # [H, n, D]
@@ -336,17 +502,31 @@ def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
 
 
 def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
-                                  scale, interpret=None, layout="token"):
+                                  scale, interpret=None, layout="token",
+                                  mesh=None, tp_axis=None):
     """q: [B, H, D].  k_pool/v_pool: one layer's pool —
     [P, page_size, H, D] (layout="token") or [H, P, page_size, D]
     (layout="kernel", DeviceKVPool's kernel-layout storage).
     page_tables: [B, max_pages] int32 (pad with 0).  seq_lens: [B] int32.
     Returns [B, H, D] attention output.
 
+    mesh / tp_axis runs the shard_map'd form (heads independent, page
+    tables and seq_lens replicated — _head_shard_map).
+
     The kernel itself always consumes [H, P, page_size, D].  Token-layout
     pools are transposed here per call — O(pool) HBM traffic per layer
     per step, which is exactly why kernel-layout pools exist: scattering
     into [H, P, page_size, D] on write makes this call transpose-free."""
+    if mesh is not None:
+        def body(q_, kp_, vp_, pt_, sl_):
+            return paged_decode_attention_kernel(
+                q_, kp_, vp_, pt_, sl_, scale, interpret=interpret,
+                layout=layout)
+
+        return _head_shard_map(
+            body, mesh, tp_axis, layout, q, k_pool, v_pool,
+            jnp.asarray(page_tables, jnp.int32),
+            jnp.asarray(seq_lens, jnp.int32))
     _reject_mesh_sharded_pool(k_pool)
     b, h, d = q.shape
     qs = (q * scale).astype(q.dtype).reshape(b, h, 1, d)

@@ -1,0 +1,500 @@
+"""Mesh-native Pallas kernels: shard_map'd dispatch, query-axis tiling,
+multi-prompt chunk packing.
+
+What this file pins (ISSUE 11 / ROADMAP "Mesh-native kernels"):
+
+1. SHARD_MAP KERNELS: all three Pallas kernels run under the
+   head-sharded tp mesh as shard_map'd per-shard programs (the same
+   kernel on num_heads/tp heads over that shard's pool slice, page
+   tables/descriptors replicated, NO collective inside the kernel) and
+   match the jnp references — so ``step_mode="ragged"`` + ``mesh`` +
+   ``use_kernel`` runs the REAL kernel instead of the jnp fallback, and
+   the mesh engine is token-identical to the single-chip eager oracle
+   at 1 dispatch / <= 1 host sync per step with
+   ``generation.kernel_path`` reporting pallas.
+2. QUERY-AXIS TILING (RPA waste #1): (tile, descriptor, page) cells
+   whose rows lie outside a descriptor's span are skipped — a
+   decode-heavy mixed batch computes strictly fewer score blocks than
+   the untiled kernel would (the host-mirrored
+   ``generation.step_score_blocks`` FLOP proxy).
+3. MULTI-PROMPT CHUNK PACKING (RPA waste #2): a short prompt admitted
+   behind a long one gets its first chunk in the very next step's
+   leftover token-axis room instead of queueing behind the whole long
+   prefill — under both the ragged and legacy-chunked step modes,
+   preemption mid-pack included.
+
+All on the conftest-forced 8-device CPU host platform (kernels in
+interpret mode).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.generation.decode_attention import (
+    chunk_prefill_attention, ragged_paged_attention,
+    ragged_paged_attention_reference)
+from paddle_tpu.ops.pallas.paged_attention import (
+    RAGGED_Q_BLOCK, ragged_score_blocks)
+from paddle_tpu.parallel import tp_mesh
+from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402 cross-module memo
+
+TP = 4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= TP, "conftest forces 8 host devices"
+    return tp_mesh(TP)
+
+
+@pytest.fixture(scope="module")
+def model():
+    # num_heads divisible by TP: the head axis is the shard axis
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=4,
+                            head_dim=8, seed=3)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+def _engine(model, *, mesh=None, slots=4, pages=64, page_size=4, chunk=3,
+            **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size,
+                               prefill_chunk_tokens=chunk,
+                               kv_backend="device", step_mode="ragged",
+                               mesh=mesh, **kw)
+    return gen.GenerationEngine(model, cfg, start=False)
+
+
+# ----------------------- shard_map'd kernel math -------------------------
+
+
+def _ragged_fixture(rng, h, d, page_size, layout="token", mesh=None):
+    pool = gen.DeviceKVPool(1, h, d, num_pages=32, page_size=page_size,
+                            pool_layout=layout, mesh=mesh)
+    kv = {}
+    for sid, n in (("A", 13), ("B", 6), ("C", 12)):
+        pool.allocate(sid)
+        arr = rng.standard_normal((1, n, h, d)).astype(np.float32)
+        pool.append_prefill(sid, arr, -arr)
+        kv[sid] = arr[0]
+    pt, _ = pool.gather_block_tables(["A", "B", "C"])
+    pt4 = np.zeros((4, pt.shape[1]), np.int32)
+    pt4[:3] = pt
+    starts = np.array([0, 1, 2, 0], np.int32)
+    lens = np.array([1, 1, 5, 0], np.int32)
+    kv_lens = np.array([13, 6, 12, 0], np.int32)
+    q = rng.standard_normal((8, h, d)).astype(np.float32)
+    return pool, pt4, starts, lens, kv_lens, q
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_shard_map_ragged_kernel_matches_reference(mesh, layout):
+    """The shard_map'd ragged kernel over mesh-SHARDED pools equals the
+    jnp reference on the same descriptors, both pool layouts — the
+    per-shard program is the single-device kernel on 1/tp of the
+    heads."""
+    rng = np.random.default_rng(7)
+    pool, pt4, starts, lens, kv_lens, q = _ragged_fixture(
+        rng, TP, 8, 4, layout=layout, mesh=mesh)
+    kp, vp = pool.layer_pools(0)
+    ref = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=False,
+        layout=layout))
+    ker = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=True,
+        interpret=True, layout=layout, mesh=mesh, tp_axis="model"))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_shard_map_chunk_kernel_matches_reference(mesh):
+    """The shard_map'd chunk-prefill kernel over a sharded pool equals
+    the jnp reference (page table + start replicated per shard)."""
+    rng = np.random.default_rng(8)
+    pool, pt4, _, _, _, _ = _ragged_fixture(rng, TP, 8, 4, mesh=mesh)
+    kp, vp = pool.layer_pools(0)
+    q = rng.standard_normal((5, TP, 8)).astype(np.float32)
+    ref = np.asarray(chunk_prefill_attention(
+        q, kp, vp, pt4[0], 7, use_kernel=False))
+    ker = np.asarray(chunk_prefill_attention(
+        q, kp, vp, pt4[0], 7, use_kernel=True, interpret=True,
+        mesh=mesh, tp_axis="model"))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_shard_map_kernel_rejects_indivisible_heads(mesh):
+    """The one genuinely unsupported combo stays loud: heads that do
+    not divide by tp cannot shard."""
+    rng = np.random.default_rng(9)
+    pool, pt4, starts, lens, kv_lens, _ = _ragged_fixture(rng, TP, 8, 4)
+    kp, vp = pool.layer_pools(0)
+    q = rng.standard_normal((8, 3, 8)).astype(np.float32)  # 3 heads
+    with pytest.raises(ValueError, match="divisible"):
+        ragged_paged_attention(q, kp[:, :, :3], vp[:, :, :3], pt4,
+                               starts, lens, kv_lens, use_kernel=True,
+                               interpret=True, mesh=mesh,
+                               tp_axis="model")
+
+
+# ------------------- engine e2e: mesh runs the kernel --------------------
+
+
+def test_ragged_mesh_kernel_token_identical_to_oracle(mesh):
+    """THE acceptance oracle: step_mode='ragged' + mesh + use_kernel
+    runs the shard_map'd Pallas kernel (interpret mode on CPU) and is
+    token-identical to the single-chip eager oracle — greedy and
+    seeded stochastic — at 1 dispatch and <= 1 host sync per step,
+    with kernel_path reporting pallas (no jnp fallback on the mesh
+    path)."""
+    mesh_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=4, head_dim=8, seed=3)
+    eng = _engine(mesh_model, mesh=mesh, chunk=3, use_kernel=True)
+    snap = eng.metrics.snapshot()
+    assert snap["generation.kernel_path"] == "ragged:pallas"
+    hs = [eng.submit(p, max_new_tokens=8,
+                     sampling=(gen.SamplingParams() if i % 2 else
+                               gen.SamplingParams(temperature=0.8,
+                                                  top_k=8, seed=11 + i)))
+          for i, p in enumerate(PROMPTS)]
+    eng.run_until_idle()
+    snap = eng.metrics.snapshot()
+    out = [h.result(timeout=5).token_ids for h in hs]
+    eng.shutdown()
+
+    ref_eng = gen.GenerationEngine(mesh_model, gen.GenerationConfig(
+        max_decode_slots=4, num_pages=64, page_size=4), start=False)
+    rs = [ref_eng.submit(p, max_new_tokens=8,
+                         sampling=(gen.SamplingParams() if i % 2 else
+                                   gen.SamplingParams(temperature=0.8,
+                                                      top_k=8,
+                                                      seed=11 + i)))
+          for i, p in enumerate(PROMPTS)]
+    ref_eng.run_until_idle()
+    ref_out = [h.result(timeout=5).token_ids for h in rs]
+    ref_eng.shutdown()
+    assert out == ref_out
+    assert snap["generation.decode_dispatches_per_step"] == 1
+    assert snap["generation.decode_host_syncs_per_step"] <= 1
+    assert snap["generation.mesh_devices"] == TP
+    assert snap["generation.kernel_path"] == "ragged:pallas"
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_ragged_mesh_kernel_layouts_and_preemption(mesh, layout):
+    """Both pool layouts through the shard_map'd ragged kernel, with a
+    pool sized to thrash: preemption victims re-prefill through the
+    kernel path and every token still matches the oracle."""
+    mesh_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=4, head_dim=8, seed=3)
+    eng = _engine(mesh_model, mesh=mesh, pages=10, chunk=2,
+                  use_kernel=True, pool_layout=layout)
+    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle()
+    results = [h.result(timeout=5) for h in hs]
+    for res, p in zip(results, PROMPTS):
+        assert res.token_ids == _ref(mesh_model, p, 8)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_ragged_mesh_kernel_prefix_warm_identical(mesh):
+    """Prefix-cache warm starts through the shard_map'd kernel path:
+    warm == cold token identity, with real aliasing observed."""
+    mesh_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=4, head_dim=8, seed=3)
+    system = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def run(prefix_on):
+        eng = _engine(mesh_model, mesh=mesh, chunk=3,
+                      use_kernel=True, prefix_cache=prefix_on)
+        outs, hits = [], []
+        for sfx in ([7, 7], [5, 5]):
+            h = eng.submit(system + sfx, max_new_tokens=6)
+            eng.run_until_idle()
+            outs.append(h.result(timeout=5).token_ids)
+            hits.append(h.prefix_hit_tokens)
+        eng.shutdown()
+        return outs, hits
+
+    warm, warm_hits = run(True)
+    cold, cold_hits = run(False)
+    assert warm == cold
+    assert warm_hits[1] >= 8 and cold_hits == [0, 0]
+
+
+def test_kernel_path_stat_in_every_snapshot(model):
+    """The silent-fallback satellite: every engine stamps which
+    attention implementation its step mode dispatches, so a fallback
+    to the reference path is a stats fact, not an inference."""
+    eng = _engine(model, chunk=0)           # CPU auto: jnp reference
+    snap = eng.stats()
+    assert snap["generation.kernel_path"] == "ragged:jnp-reference"
+    eng.shutdown()
+    leg = gen.GenerationEngine(model, gen.GenerationConfig(), start=False)
+    assert leg.stats()["generation.kernel_path"] == "eager:jnp-reference"
+    leg.shutdown()
+    ker = _engine(model, chunk=2, use_kernel=True)
+    assert ker.stats()["generation.kernel_path"] == "ragged:pallas"
+    ker.shutdown()
+
+
+# ------------------------- query-axis tiling -----------------------------
+
+
+def test_tiled_kernel_engine_e2e_token_identical(model):
+    """The query-tiled ragged kernel through the unsharded engine
+    (use_kernel forced, interpret on CPU): token-identical to the
+    eager oracle across mixed chunk/decode traffic."""
+    eng = _engine(model, chunk=3, use_kernel=True)
+    hs = [eng.submit(p, max_new_tokens=8) for p in PROMPTS]
+    eng.run_until_idle()
+    for h, p in zip(hs, PROMPTS):
+        assert h.result(timeout=5).token_ids == _ref(model, p, 8)
+    eng.shutdown()
+
+
+def test_query_tiling_skips_out_of_span_blocks():
+    """The FLOP-proxy acceptance: on a decode-heavy mixed batch the
+    tiled kernel's score-block count is STRICTLY below the untiled
+    kernel's bill, and the skip rule never changes values (tiled
+    kernel == reference on the same fixture)."""
+    rng = np.random.default_rng(10)
+    # 16 packed rows, q_block 8 -> 2 tiles; three 1-row decode
+    # descriptors + one 5-row chunk: decode descriptors touch ONE tile
+    # each instead of both
+    pool, pt4, starts, lens, kv_lens, _ = _ragged_fixture(rng, 2, 8, 4)
+    q = rng.standard_normal((16, 2, 8)).astype(np.float32)
+    kp, vp = pool.layer_pools(0)
+    tiled, untiled = ragged_score_blocks(starts, lens, kv_lens,
+                                         page_size=4, n_pages=pt4.shape[1],
+                                         n_rows=16)
+    assert tiled < untiled, (tiled, untiled)
+    ref = np.asarray(ragged_paged_attention_reference(
+        q, kp, vp, pt4, starts, lens, kv_lens))
+    ker = np.asarray(ragged_paged_attention(
+        q, kp, vp, pt4, starts, lens, kv_lens, use_kernel=True,
+        interpret=True))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_query_tiling_page_horizon_skip():
+    """Pages past a tile's causal horizon are skipped too: a chunk at
+    the START of a long sequence's pages never touches pages holding
+    only future keys."""
+    # one descriptor: a 4-row chunk at positions [0, 4) of a 32-token
+    # cache (kv_len counts tokens RESIDENT AFTER the step; here the
+    # chunk is mid-prefill so kv_len == 4 — build the horizon case
+    # directly instead: rows see at most position 3, pages 1+ skipped)
+    starts = np.array([0], np.int32)
+    lens = np.array([4], np.int32)
+    kv_lens = np.array([4], np.int32)
+    tiled, untiled = ragged_score_blocks(starts, lens, kv_lens,
+                                         page_size=4, n_pages=8,
+                                         n_rows=8, q_block=4)
+    # tile 0 sees qpos_max 3 -> 1 page; tile 1 is out of span entirely.
+    # untiled: 1 live page x 2 tiles worth of rows
+    assert tiled == 1 and untiled == 2
+
+
+def test_score_block_metrics_emitted(model):
+    """generation.step_score_blocks / _untiled land in the stats
+    snapshot when the TILED KERNEL dispatches, with the tiled count
+    strictly below the untiled bill on decode-heavy traffic (the
+    gen_bench A/B reads exactly these) — and stay 0 on the
+    jnp-reference path, which runs no tiled kernel to proxy."""
+    # chunk 16 + 6 slots -> a 22-row packed axis (3 tiles of 8): the
+    # decode-heavy steps' 1-row descriptors live in tile 0 alone, so
+    # tiles 1..2 are skipped for them — the saving the untiled kernel
+    # could not express (a single-tile axis would show tiled == untiled)
+    eng = _engine(model, slots=6, chunk=16, pages=64, page_size=4,
+                  use_kernel=True)
+    hs = [eng.submit(p, max_new_tokens=10) for p in PROMPTS]
+    eng.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    snap = eng.metrics.snapshot()
+    assert snap["generation.step_score_blocks"] > 0
+    assert snap["generation.step_score_blocks"] < \
+        snap["generation.step_score_blocks_untiled"]
+    eng.shutdown()
+
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    ref = _engine(model, slots=6, chunk=16, pages=64, page_size=4)
+    h = ref.submit(PROMPTS[0], max_new_tokens=4)
+    ref.run_until_idle()
+    h.result(timeout=5)
+    assert ref.metrics.snapshot().get(
+        "generation.step_score_blocks", 0) == 0
+    ref.shutdown()
+
+
+# --------------------- multi-prompt chunk packing ------------------------
+
+
+def _first_chunk_step(eng, long_prompt, short_prompt, chunk):
+    """Drive: submit long, let its prefill start, submit short; return
+    how many steps until the short prompt's first chunk lands."""
+    h_long = eng.submit(long_prompt, max_new_tokens=4)
+    eng.step()                       # long's first chunk dispatches
+    h_short = eng.submit(short_prompt, max_new_tokens=4)
+    short_state = None
+    steps = 0
+    while steps < 200:
+        steps += 1
+        eng.step()
+        for s in eng.scheduler.active():
+            if s.request.prompt == short_prompt:
+                short_state = s
+        if short_state is not None and short_state.prefill_pos > 0:
+            break
+    eng.run_until_idle()
+    return steps, h_long, h_short
+
+
+@pytest.mark.parametrize("mode", ["ragged", "legacy"])
+def test_short_prompt_first_chunk_next_step(model, mode):
+    """THE packing TTFT bound: a short prompt admitted behind a long
+    prompt gets its first chunk in the NEXT step (the leftover
+    token-axis room), not after the long prefill drains — under both
+    step modes."""
+    chunk = 4
+    long_prompt = ([2, 4, 6] * 30)[:80]          # 20 chunks of 4
+    short_prompt = [1, 2, 3]
+    cfg = gen.GenerationConfig(
+        max_decode_slots=4, num_pages=64, page_size=4,
+        prefill_chunk_tokens=chunk, kv_backend="device",
+        step_mode=mode, **({} if mode == "ragged"
+                           else {"jit_prefill": True}))
+    eng = gen.GenerationEngine(model, cfg, start=False)
+    steps, h_long, h_short = _first_chunk_step(eng, long_prompt,
+                                               short_prompt, chunk)
+    # one step after admission: the pack's leftover room served it
+    assert steps == 1, steps
+    assert h_short.result(timeout=5).token_ids == \
+        _ref(model, short_prompt, 4)
+    assert h_long.result(timeout=5).token_ids == \
+        _ref(model, long_prompt, 4)
+    eng.shutdown()
+
+
+def test_packing_improves_short_prompt_ttft(model):
+    """A/B on the same traffic: with packing (plan_pack, the default)
+    the short prompt's first token lands in strictly fewer engine
+    steps than single-chunk FIFO would allow — the long prompt alone
+    needs 20 steps, so a short first token before step 20 proves the
+    pack."""
+    chunk = 4
+    long_prompt = ([2, 4, 6] * 30)[:80]
+    short_prompt = [1, 2, 3]
+    eng = _engine(model, chunk=chunk, pages=64, page_size=4)
+    h_long = eng.submit(long_prompt, max_new_tokens=4)
+    eng.step()
+    h_short = eng.submit(short_prompt, max_new_tokens=4)
+    steps_to_first = 0
+    for i in range(300):
+        eng.step()
+        if h_short.first_token_s is not None:
+            steps_to_first = i + 1
+            break
+    eng.run_until_idle()
+    assert h_short.first_token_s is not None
+    # 80-token prompt / 4-token chunks = 20 steps of long prefill left;
+    # the short prompt's first token must NOT wait for them
+    assert steps_to_first < 19, steps_to_first
+    assert h_short.result(timeout=5).token_ids == \
+        _ref(model, short_prompt, 4)
+    h_long.result(timeout=5)
+    eng.shutdown()
+
+
+@pytest.mark.parametrize("mode", ["ragged", "legacy"])
+def test_preemption_mid_pack_token_identity(model, mode):
+    """Preemption DURING a pack (tight pool, several prompts
+    prefilling at once): victims drop out of the pack, re-prefill
+    through chunks on re-admission, and every stream still matches the
+    oracle."""
+    cfg = gen.GenerationConfig(
+        max_decode_slots=4, num_pages=9, page_size=4,
+        prefill_chunk_tokens=2, kv_backend="device",
+        step_mode=mode, **({} if mode == "ragged"
+                           else {"jit_prefill": True}))
+    eng = gen.GenerationEngine(model, cfg, start=False)
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [7, 5, 3], [9, 9, 9, 4, 2],
+               [11, 13]]
+    hs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    eng.run_until_idle()
+    results = [h.result(timeout=10) for h in hs]
+    for res, p in zip(results, prompts):
+        assert res.token_ids == _ref(model, p, 10)
+    assert sum(r.preemptions for r in results) > 0
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+
+
+def test_prefill_pack_ablation_knob(model):
+    """prefill_pack=False restores one chunk per step: the short
+    prompt behind the long one waits out the long prefill (strictly
+    more steps to its first chunk than the packed default's 1) — the
+    knob the gen_bench packing A/B flips."""
+    chunk = 4
+    long_prompt = ([2, 4, 6] * 30)[:80]
+    short_prompt = [1, 2, 3]
+    eng = _engine(model, chunk=chunk, pages=64, page_size=4,
+                  prefill_pack=False)
+    assert eng.config.prefill_pack is False
+    steps, h_long, h_short = _first_chunk_step(eng, long_prompt,
+                                               short_prompt, chunk)
+    # 80-token prompt at 4 tokens/chunk: ~19 chunks remain when the
+    # short is admitted, and without packing it waits for all of them
+    assert steps > 10, steps
+    assert h_short.result(timeout=5).token_ids == \
+        _ref(model, short_prompt, 4)
+    h_long.result(timeout=5)
+    eng.shutdown()
+
+
+def test_plan_pack_fifo_room_and_clipping(model):
+    """plan_pack unit surface: FIFO order, oldest's full chunk first,
+    leftover room split across younger prompts, room and max_seqs
+    clipping, and the single-chunk plan_step view unchanged."""
+    eng = _engine(model, slots=4, chunk=4, pages=64, page_size=4)
+    h1 = eng.submit([1] * 10, max_new_tokens=2)
+    eng.scheduler.admit(limit=4)
+    h2 = eng.submit([2] * 9, max_new_tokens=2)
+    h3 = eng.submit([3, 3], max_new_tokens=2)
+    eng.scheduler.admit(limit=4)
+    sched = eng.scheduler
+    pack = sched.plan_pack(4, room=7)
+    assert [(len(s.tokens), n) for s, n in pack] == [(10, 4), (9, 3)]
+    pack = sched.plan_pack(4, room=12)
+    assert [n for _, n in pack] == [4, 4, 2]
+    pack = sched.plan_pack(4, room=12, max_seqs=2)
+    assert [n for _, n in pack] == [4, 4]
+    assert sched.plan_pack(4, room=0) == []
+    state, n = sched.plan_step(4, max_chunk=3)
+    assert n == 3 and len(state.tokens) == 10
+    # unbounded: every prefilling prompt gets a chunk
+    assert [n for _, n in sched.plan_pack(4)] == [4, 4, 2]
+    eng.run_until_idle()
+    for h in (h1, h2, h3):
+        h.result(timeout=5)
+    eng.shutdown()

@@ -410,9 +410,14 @@ def test_sharded_config_validation(model, mesh):
     with pytest.raises(ValueError, match="fused"):
         gen.GenerationEngine(model, gen.GenerationConfig(
             mesh=mesh, decode="eager"), start=False)
-    with pytest.raises(ValueError, match="use_kernel"):
-        gen.GenerationEngine(model, gen.GenerationConfig(
-            mesh=mesh, use_kernel=True), start=False)
+    # use_kernel under a mesh is SUPPORTED now (the shard_map'd kernel
+    # path): the engine builds and reports the pallas kernel path
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        mesh=mesh, use_kernel=True), start=False)
+    assert eng._use_kernel is True
+    assert eng.metrics.snapshot()["generation.kernel_path"].endswith(
+        ":pallas")
+    eng.shutdown()
     with pytest.raises(ValueError, match="tp_axis"):
         gen.GenerationConfig(mesh=mesh, tp_axis="warp")
     with pytest.raises(ValueError, match="without a mesh"):
@@ -427,8 +432,9 @@ def test_sharded_config_validation(model, mesh):
 
 def test_pallas_kernel_rejects_mesh_sharded_pool(mesh):
     """ops/pallas guard: handing a multi-device-sharded pool to the
-    single-device Pallas kernel fails loudly instead of computing over
-    one shard as if it were the whole pool."""
+    single-device Pallas kernel WITHOUT spelling out the mesh fails
+    loudly instead of computing over one shard as if it were the whole
+    pool — the supported route is the shard_map'd form (mesh=)."""
     pool = gen.DeviceKVPool(1, 4, 8, num_pages=8, page_size=4, mesh=mesh)
     kp, vp = pool.layer_pools(0)
     q = np.zeros((1, 4, 8), np.float32)
@@ -437,3 +443,21 @@ def test_pallas_kernel_rejects_mesh_sharded_pool(mesh):
     with pytest.raises(NotImplementedError, match="mesh-sharded"):
         gen.paged_decode_attention(q, kp, vp, pt, lens, use_kernel=True,
                                    interpret=True)
+    # the same call WITH the mesh runs the shard_map'd kernel and
+    # matches the jnp reference (which GSPMD partitions on its own)
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((2, 4, 8)).astype(np.float32)
+    pool.allocate("a")
+    arr = rng.standard_normal((1, 7, 4, 8)).astype(np.float32)
+    pool.append_prefill("a", arr, -arr)
+    pool.allocate("b")
+    arr2 = rng.standard_normal((1, 3, 4, 8)).astype(np.float32)
+    pool.append_prefill("b", arr2, -arr2)
+    kp, vp = pool.layer_pools(0)
+    pt, lens = pool.gather_block_tables(["a", "b"])
+    ref = np.asarray(gen.paged_decode_attention(q, kp, vp, pt, lens,
+                                                use_kernel=False))
+    ker = np.asarray(gen.paged_decode_attention(
+        q, kp, vp, pt, lens, use_kernel=True, interpret=True,
+        mesh=mesh, tp_axis=mesh.axis_names[0]))
+    np.testing.assert_allclose(ker, ref, atol=2e-5, rtol=2e-5)

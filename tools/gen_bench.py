@@ -50,8 +50,12 @@ Usage:
         # grid run unsharded (tp_degree 1) and over a head-sharded
         # mesh of every visible device (GenerationConfig.mesh, fused
         # decode only) — tokens/s and dispatches/step vs tp_degree,
-        # plus generation.collective_bytes_per_step and mesh_devices
-        # in each cell; GSPMD compile wall stays in warmup_s.  On CPU
+        # plus generation.collective_bytes_per_step, mesh_devices and
+        # kernel_path in each cell; GSPMD compile wall stays in
+        # warmup_s.  Every SHARDED combo runs twice — use_kernel False
+        # (jnp reference, GSPMD-partitioned) vs True (the shard_map'd
+        # Pallas kernel: per-shard program over num_heads/tp heads) —
+        # the kernel-vs-reference A/B under the mesh.  On CPU
         # an --xla_force_host_platform_device_count=8 mesh is forced
         # automatically when XLA_FLAGS doesn't already carry one
         # (collectives over loopback: a semantics/dispatch A/B, not a
@@ -103,7 +107,7 @@ def _prewarm_decode_buckets(eng, batch, context, new_tokens, page_size):
 
 def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
                pool, decode, prefill="full", chunk_tokens=0, tp=1,
-               step="legacy"):
+               step="legacy", use_kernel=None):
     from paddle_tpu import generation as g
     from paddle_tpu.generation import metrics as gmetrics
     from paddle_tpu.parallel import tp_mesh
@@ -115,6 +119,10 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
                            page_size=page_size, queue_depth=batch * 2,
                            kv_backend=pool, mesh=mesh,
+                           # the kernel-vs-reference A/B under the mesh:
+                           # None = auto (pallas on TPU), False = jnp
+                           # reference, True = the shard_map'd kernel
+                           use_kernel=use_kernel,
                            # the ragged step replaces the decode path:
                            # one mixed-batch executable per pages bucket
                            decode=(None if step == "ragged" else decode),
@@ -150,9 +158,12 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
     steps_stat = reg.get_stat(gmetrics.STEPS_TOTAL)
     pfc_stat = reg.get_stat(gmetrics.PREFILL_COMPILES_TOTAL)
     dcc_stat = reg.get_stat(gmetrics.DECODE_COMPILES_TOTAL)
+    sb_stat = reg.get_stat(gmetrics.STEP_SCORE_BLOCKS)
+    sbu_stat = reg.get_stat(gmetrics.STEP_SCORE_BLOCKS_UNTILED)
     kv_before, pf_before = kv_stat.get(), pf_stat.get()
     steps_before = steps_stat.get()
     compiles_before = pfc_stat.get() + dcc_stat.get()
+    sb_before, sbu_before = sb_stat.get(), sbu_stat.get()
     dt, results = run_once()
     measured_compiles = int(pfc_stat.get() + dcc_stat.get()
                             - compiles_before)
@@ -189,6 +200,17 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         "tp_degree": tp,
         "collective_bytes_per_step": snap.get(
             "generation.collective_bytes_per_step", 0),
+        # which attention implementation actually dispatched — the
+        # silent-fallback tripwire (a mesh cell reporting jnp-reference
+        # when pallas was requested is a bug, not a detail)
+        "kernel_path": snap.get("generation.kernel_path", ""),
+        # the query-tiling FLOP proxy (ragged KERNEL cells; 0 when the
+        # jnp reference dispatched — the /ref-vs-/kernel tripwire):
+        # score blocks the tiled kernel computed vs the untiled bill,
+        # DELTAS over the measured pass (the counters are cumulative
+        # per series, like kv_bytes)
+        "score_blocks": int(sb_stat.get() - sb_before),
+        "score_blocks_untiled": int(sbu_stat.get() - sbu_before),
         "batch": batch,
         "context": context,
         "new_tokens": new_tokens,
@@ -221,7 +243,7 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
 
 def bench_interleave(model, batch, context, long_context, new_tokens,
                      page_size, pool, decode, prefill, chunk_tokens,
-                     step="legacy"):
+                     step="legacy", pack=True):
     """The chunked-prefill A/B scenario: `batch - 1` short requests
     decode while ONE long prompt streams in.  Reports time-to-first-
     token per request and the decode tokens/s the short requests
@@ -235,12 +257,14 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
     from paddle_tpu.generation import metrics as gmetrics
     from paddle_tpu.profiler.monitor import StatRegistry
 
-    pages = (-(-(long_context + new_tokens) // page_size) + 2) * batch
+    # one slot past the decode batch: reserved for the LATE short
+    # request the packing probe submits behind the long prompt
+    pages = (-(-(long_context + new_tokens) // page_size) + 2) * (batch + 1)
     eng = g.GenerationEngine(
         model,
-        g.GenerationConfig(max_decode_slots=batch, num_pages=pages,
-                           page_size=page_size, queue_depth=batch * 2,
-                           kv_backend=pool,
+        g.GenerationConfig(max_decode_slots=batch + 1, num_pages=pages,
+                           page_size=page_size, queue_depth=batch * 2 + 2,
+                           kv_backend=pool, prefill_pack=pack,
                            decode=(None if step == "ragged" else decode),
                            step_mode=step,
                            prefill_chunk_tokens=(chunk_tokens
@@ -250,6 +274,7 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
     rng = np.random.default_rng(batch * 7 + context)
     shorts = [rng.integers(0, model.vocab_size, context).tolist()
               for _ in range(batch - 1)]
+    late_short = rng.integers(0, model.vocab_size, context).tolist()
     long_prompt = rng.integers(0, model.vocab_size, long_context).tolist()
     reg = StatRegistry.instance()
     tok_stat = reg.get_stat(gmetrics.TOKENS_TOTAL)
@@ -275,6 +300,13 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
         tokens_before = tok_stat.get()
         chunks_before = chunk_stat.get()
         h_long = eng.submit(long_prompt, max_new_tokens=new_tokens)
+        # the multi-prompt PACKING probe: a short prompt admitted
+        # BEHIND the long one.  With chunked prefill its first chunk
+        # rides the very next step's leftover token-axis room
+        # (plan_pack), so its TTFT is a couple of steps; with full
+        # prefill it pays the long prompt's whole forward pass first —
+        # the head-of-line number packing removes
+        h_late = eng.submit(late_short, max_new_tokens=new_tokens)
         # count short-request tokens from steps that finished BEFORE the
         # long prompt's first token: the snapshot taken before the step
         # that produced it excludes that step's own decode output, which
@@ -297,20 +329,25 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
                 "interleave cell: the long prompt produced no first "
                 "token within the step cap (config cannot fit it?)")
         decode_tokens = int(before_step - tokens_before)
-        # chunks dispatched inside the window belong to the long prompt
-        # alone (the shorts finished prefilling in the loop above):
-        # ceil(long_context / chunk_tokens) when chunked, 0 when full
+        # chunks dispatched inside the window: the long prompt's plus
+        # the late short's (its pack rides the same steps when chunked)
         window_chunks = int(chunk_stat.get() - chunks_before)
         eng.run_until_idle()
         for h in hs:
             h.result(timeout=1)
         h_long.result(timeout=1)
+        h_late.result(timeout=1)
         window = h_long.first_token_s - h_long.submitted_s
         return {
             "ttft_long_s": round(window, 4),
             "ttft_short_avg_s": round(
                 sum(h.first_token_s - h.submitted_s for h in hs)
                 / max(len(hs), 1), 4),
+            # the packing headline: TTFT of the short admitted BEHIND
+            # the long prompt (chunked+packed strictly below full
+            # prefill's head-of-line wait)
+            "ttft_short_behind_long_s": round(
+                h_late.first_token_s - h_late.submitted_s, 4),
             "decode_tokens_during_prefill": decode_tokens,
             "decode_tps_during_prefill": round(
                 decode_tokens / window, 2) if window > 0 else 0.0,
@@ -319,7 +356,9 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
 
     run_once()                                   # compile/trace pass
     warm_t0 = time.perf_counter()
-    _prewarm_decode_buckets(eng, batch, long_context, new_tokens,
+    # batch + 1: the late packing probe can decode alongside the full
+    # short batch + the long prompt, one slot past the nominal batch
+    _prewarm_decode_buckets(eng, batch + 1, long_context, new_tokens,
                             page_size)
     warmup_s = time.perf_counter() - warm_t0
     pfc = reg.get_stat(gmetrics.PREFILL_COMPILES_TOTAL)
@@ -331,6 +370,10 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
         "pool": pool,
         "decode": decode,
         "prefill": prefill,
+        # multi-prompt chunk packing on (default) or the one-chunk-
+        # per-step ablation baseline — the packing TTFT A/B pairs a
+        # pack=True cell with a pack=False one on the same traffic
+        "pack": pack,
         # the TTFT-under-interleave A/B rung for the ragged step, with
         # its measured mixed-batch row utilization (decode rows + chunk
         # rows share the packed axis, cumulative over the cell) and
@@ -341,6 +384,7 @@ def bench_interleave(model, batch, context, long_context, new_tokens,
             / max(snap.get("generation.step_rows_dispatched", 0), 1), 3),
         "padded_token_waste": snap.get(
             "generation.padded_token_waste", 0),
+        "kernel_path": snap.get("generation.kernel_path", ""),
         "dispatches_per_step": snap.get(
             "generation.decode_dispatches_per_step", 0),
         "batch": batch,
@@ -643,7 +687,10 @@ def main():
                          "cells run device pools + fused decode "
                          "(GenerationConfig.mesh — ONE GSPMD dispatch "
                          "per step) and report tp_degree + "
-                         "collective_bytes_per_step per cell")
+                         "collective_bytes_per_step + kernel_path per "
+                         "cell; every sharded combo runs TWICE — jnp "
+                         "reference vs the shard_map'd Pallas kernel "
+                         "(the kernel-vs-reference A/B under the mesh)")
     ap.add_argument("--long-context", type=int, default=None,
                     help="long-prompt length for the interleave cell "
                          "(default: 8x the largest --contexts entry)")
@@ -699,6 +746,14 @@ def main():
         tps = [shardable(ndev)]
     else:
         tps = [int(args.mesh)]
+    def mesh_kernel_variants(tp):
+        # the kernel-vs-reference A/B under the mesh: every sharded
+        # combo runs TWICE — the jnp reference (GSPMD-partitioned) and
+        # the shard_map'd Pallas kernel — so the artifact carries the
+        # first sharded-kernel numbers instead of inferring them.
+        # Unsharded cells keep the auto policy (None).
+        return (False, True) if tp > 1 else (None,)
+
     combos = []
     for pool in pools:
         for decode in decodes:
@@ -708,29 +763,33 @@ def main():
                 for tp in tps:
                     if tp > 1 and (pool, decode) != ("device", "fused"):
                         continue  # sharded decode IS device + fused
-                    combos.append((pool, decode, prefill, tp, "legacy"))
-    if max(tps) > 1 and not any(tp > 1 for *_, tp, _ in combos):
+                    combos += [(pool, decode, prefill, tp, "legacy", k)
+                               for k in mesh_kernel_variants(tp)]
+    if max(tps) > 1 and not any(tp > 1 for *_, tp, _, _ in combos):
         # the mesh A/B must not silently vanish because the requested
         # --pool/--decode combo can't shard: force the one that can
-        combos += [("device", "fused", prefill, tp, "legacy")
-                   for prefill in prefills for tp in tps if tp > 1]
+        combos += [("device", "fused", prefill, tp, "legacy", k)
+                   for prefill in prefills for tp in tps if tp > 1
+                   for k in mesh_kernel_variants(tp)]
     if args.step == "legacy":
         pass
     else:
         # the ragged mixed-batch step: one series per prefill mode on
         # device pools (the ragged step's `decode` label IS 'ragged' —
-        # the one executable replaces the eager/fused choice), unsharded
-        # here (the mesh A/B stays the legacy grid's; a TPU-mesh ragged
-        # window is ROADMAP follow-on)
-        ragged = [("device", "ragged", prefill, 1, "ragged")
-                  for prefill in prefills]
+        # the one executable replaces the eager/fused choice), at every
+        # requested tp degree — the shard_map'd kernel made mesh cells
+        # real kernel cells, so sharded ragged runs the A/B too
+        ragged = [("device", "ragged", prefill, tp, "ragged", k)
+                  for prefill in prefills for tp in tps
+                  for k in mesh_kernel_variants(tp)]
         combos = ragged if args.step == "ragged" else combos + ragged
     grid = []
     stats_by_series = {}
     reg = StatRegistry.instance()
-    for pool, decode, prefill, tp, step in combos:
+    for pool, decode, prefill, tp, step, kern in combos:
         # per-series snapshot: reset generation.* so each
-        # (pool, decode, prefill, tp, step) combo's stats land apart
+        # (pool, decode, prefill, tp, step, kernel) combo's stats land
+        # apart
         for name in list(reg.stats()):
             if name.startswith("generation."):
                 reg.get_stat(name).reset()
@@ -742,7 +801,8 @@ def main():
                 grid.append(bench_cell(
                     model, b, ctx, args.new_tokens, pages,
                     args.page_size, pool, decode, prefill,
-                    args.chunk_tokens, tp=tp, step=step))
+                    args.chunk_tokens, tp=tp, step=step,
+                    use_kernel=kern))
         # the prefill/decode-interleave cell: decode throughput
         # while a long prompt streams in (the chunked-prefill
         # headline number; unsharded — the mesh A/B is the grid's)
@@ -752,8 +812,19 @@ def main():
                 model, ib, min(contexts), long_ctx,
                 args.new_tokens, args.page_size, pool, decode,
                 prefill, args.chunk_tokens, step=step))
+            if prefill == "chunked":
+                # the multi-prompt packing A/B: the same interleave
+                # traffic with packing OFF (one chunk per step) — the
+                # late short's ttft_short_behind_long_s is the paired
+                # number packing strictly improves
+                grid.append(bench_interleave(
+                    model, ib, min(contexts), long_ctx,
+                    args.new_tokens, args.page_size, pool, decode,
+                    prefill, args.chunk_tokens, step=step, pack=False))
         series = f"{pool}/{decode}/{prefill}" + (
-            f"/tp{tp}" if tp > 1 else "")
+            f"/tp{tp}" if tp > 1 else "") + (
+            "" if kern is None else
+            ("/kernel" if kern else "/ref"))
         stats_by_series[series] = reg.stats_snapshot("generation.")
     if args.prefix != "off":
         # the shared-system-prompt A/B: chunked prefill (warm hits

@@ -51,8 +51,13 @@ for i in $(seq 1 "$MAX"); do
     # multi-turn session workload: per-replica hit rate, shed rate,
     # TTFT p50/p95 with the affinity routing ladder vs random), and
     # --step both lands the legacy-vs-RAGGED mixed-batch step A/B
-    # (one packed dispatch serving decode + the prefill chunk:
-    # tokens/s, dispatches/step, measured row_utilization,
+    # (one packed dispatch serving decode + the MULTI-PROMPT chunk
+    # pack: tokens/s, dispatches/step, measured row_utilization,
+    # query-tiling score_blocks vs the untiled bill, and — on every
+    # SHARDED cell, legacy and ragged alike — the kernel-vs-reference
+    # A/B: use_kernel False (GSPMD jnp) vs True (the shard_map'd
+    # Pallas kernel) with kernel_path stamped per cell, the first
+    # hardware numbers for the mesh-native kernels;
     # padded_token_waste == 0, ragged TTFT under interleave — the
     # first hardware numbers for the ragged Pallas kernel)
     # budget grew with the prefix + fleet + ragged A/B cells: a
