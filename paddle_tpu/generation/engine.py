@@ -73,7 +73,8 @@ from .decode_attention import paged_decode_attention
 from .kv_cache import DeviceKVPool, OutOfPagesError, PagedKVCache
 from .metrics import GenerationMetrics, StepTimer
 from .sampling import SamplingParams, sample_token, sample_tokens_batch
-from .scheduler import ContinuousBatchingScheduler, GenerationRequest
+from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
+                        SequenceState)
 
 # auto chunk size for chunked prefill on TPU (GenerationConfig
 # .prefill_chunk_tokens=None): a multiple of 8 so the chunk-query axis
@@ -733,6 +734,207 @@ class GenerationEngine:
                         continue
                     out.append((state.request, state.n_generated))
             return out
+
+    # ---------------------- disaggregation hooks --------------------
+    # Live migration and the fleet page service (serving/disagg):
+    # export ships raw resident state — page BYTES, page table shape,
+    # positions, sampling RNG — and import installs it into a sibling
+    # engine so a mid-decode stream RESUMES instead of replaying, and a
+    # warm prefix run is adopted by a pool that never prefilled it.
+    # All four run under the step lock: no token can land on (or page
+    # be evicted from) state that is mid-flight.
+
+    def evacuate_for_migration(self):
+        """The live-migration drain extraction: everything evacuate()
+        moves, but live decode-phase residents leave as SEQUENCE
+        SNAPSHOTS (page bytes + decode state) instead of cold
+        resubmits.  Returns ``(cold, live)`` — `cold` is evacuate()'s
+        ``[(GenerationRequest, n_emitted)]`` (queued work plus
+        mid-prefill slot-holders, which have no finished pages worth
+        shipping), `live` a list of snapshot dicts for
+        ``import_sequence`` on a sibling (each carries the client
+        handle under "future").  Expired requests are reaped typed on
+        the way."""
+        with self._lock:
+            cold = self.scheduler.take_pending()
+            live = []
+            for state in self.scheduler.active():
+                if state.request.expired():
+                    self.scheduler.retire(state)
+                    state.request.reject_expired()
+                    self.metrics.count_rejected_deadline()
+                    continue
+                if state.prefilling or not self.cache.has(state.seq_id):
+                    self.scheduler.retire(state)
+                    cold.append((state.request, state.n_generated))
+                    continue
+                live.append(self._export_sequence(state))
+            return cold, live
+
+    def _export_sequence(self, state):
+        """Snapshot one decode-phase resident for live migration —
+        page bytes first (export_pages), THEN retire (which frees the
+        pages) — and hand back everything a sibling needs to resume
+        the stream mid-decode: tokens so far, generated count, the
+        sampling RNG (its state IS the stream position for stochastic
+        requests), and the cache length the pages cover.  The handle
+        is NOT resolved: the importer keeps pushing into it."""
+        req = state.request
+        length = self.cache.seq_len(state.seq_id)
+        k, v = self.cache.export_pages(
+            self.cache.page_table(state.seq_id))
+        snap = {
+            "prompt": list(req.prompt),
+            "max_new_tokens": int(req.max_new_tokens),
+            "stop_tokens": tuple(req.stop_tokens),
+            "sampling": req.params,
+            "deadline": req.deadline,
+            "tokens": list(state.tokens),
+            "n_generated": int(state.n_generated),
+            "preemptions": int(state.preemptions),
+            "rng": state.rng,
+            "cache_len": int(length),
+            "k": k, "v": v,
+            "future": req.future,
+        }
+        self.scheduler.retire(state)
+        return snap
+
+    def import_sequence(self, snap, handle=None):
+        """LIVE-MIGRATION import: adopt a sibling-exported mid-decode
+        resident — install its page bytes into this pool, rebuild its
+        SequenceState (tokens, RNG, counters), seat it in a free slot,
+        and let the normal step loop resume its decode with ZERO
+        replayed tokens.  Returns True when adopted; False when this
+        engine cannot hold it right now (no free slot, pool too full
+        even after eviction, or layout-incompatible pools) — the
+        caller falls back to the cold-resubmit ladder, which is always
+        correct (seeded sampling replays identically)."""
+        if handle is None:
+            handle = snap.get("future")
+        with self._lock:
+            if self._closed or self.scheduler.free_slots() == 0:
+                return False
+            try:
+                pages = self.cache.import_pages(snap["k"], snap["v"])
+            except (OutOfPagesError, ValueError):
+                return False
+            req = GenerationRequest(
+                snap["prompt"], handle, snap["sampling"],
+                max_new_tokens=snap["max_new_tokens"],
+                stop_tokens=snap["stop_tokens"],
+                deadline=snap.get("deadline"))
+            state = SequenceState(self.scheduler.next_seq_id(), req)
+            self.cache.allocate(state.seq_id)
+            self.cache.adopt_imported(state.seq_id, pages,
+                                      snap["cache_len"])
+            state.tokens = list(snap["tokens"])
+            state.n_generated = int(snap["n_generated"])
+            state.preemptions = int(snap["preemptions"])
+            state.rng = snap["rng"]
+            state.prefilling = False
+            state.prefill_pos = int(snap["cache_len"])
+            self.scheduler.place_imported(state)
+            self.metrics.count_request()
+            return True
+
+    def drain_work(self, migrate=True, live=True, timeout=60.0):
+        """The drain state machine BOTH transport halves run
+        (InprocTransport.drain and the subprocess worker's evacuate op
+        — one implementation, so the in-process oracle and the
+        process-boundary replica cannot diverge): evacuate unfinished
+        work and shut the engine down.  migrate=False lets residents
+        finish first — stepping the engine here when no worker thread
+        runs — and evacuates stragglers that outlive `timeout` (live
+        snapshots when `live`, cold resubmits otherwise) so a drain
+        always converges.  Returns ``(cold, live_snaps)``."""
+        if migrate:
+            if live:
+                cold, live_snaps = self.evacuate_for_migration()
+            else:
+                cold, live_snaps = self.evacuate(include_active=True), []
+        else:
+            cold, live_snaps = self.evacuate(include_active=False), []
+            deadline = time.monotonic() + float(timeout)
+            while self.scheduler.active() \
+                    or self.scheduler.pending_count():
+                if time.monotonic() > deadline:
+                    # stragglers outlived the drain budget: evacuate
+                    # them (resume beats replay when live is allowed)
+                    # rather than wedging the replica in 'draining'
+                    if live:
+                        c2, l2 = self.evacuate_for_migration()
+                    else:
+                        c2, l2 = self.evacuate(include_active=True), []
+                    cold += c2
+                    live_snaps += l2
+                    break
+                if self._thread is not None and self._thread.is_alive():
+                    time.sleep(0.005)
+                else:
+                    self.step()   # stepped mode: the drain drives them
+        self.shutdown()
+        return cold, live_snaps
+
+    def describe(self):
+        """Static replica facts the router's capacity pre-filter needs
+        (can_fit without an RPC) — the transport `describe` contract,
+        shared by both transport halves."""
+        import os
+
+        cfg = self.config
+        return {
+            "page_size": cfg.page_size,
+            "num_pages": cfg.num_pages,
+            "max_positions": getattr(self.model, "max_positions", None),
+            "default_max_new_tokens": cfg.default_max_new_tokens,
+            "pid": os.getpid(),
+        }
+
+    def load_info(self):
+        """Live load facts for the router's least-loaded rung — the
+        transport `load_info` contract (exact for inproc; a subprocess
+        replica reports this on every heartbeat)."""
+        sched = self.scheduler
+        return {
+            "queue_depth": sched.pending_count(),
+            "active": len(sched.active()),
+            "pages_in_use": self.cache.pages_in_use,
+            "num_pages": self.cache.num_pages,
+            "idle": not (sched.active() or sched.pending_count()),
+        }
+
+    def export_prefix_pages(self, tokens):
+        """Page-service EXPORT: the longest fully-cached page run
+        matching a prefix of `tokens`, as ``{"tokens": covered_tokens,
+        "k": ..., "v": ...}`` ready for a sibling's
+        import_prefix_pages — or None when nothing is cached (or the
+        prefix cache is off)."""
+        with self._lock:
+            if not self.prefix_cache_enabled:
+                return None
+            pages, matched = self.cache.match_prefix_full(tokens)
+            if not pages:
+                return None
+            k, v = self.cache.export_pages(pages)
+            return {"tokens": [int(t) for t in tokens[:matched]],
+                    "k": k, "v": v}
+
+    def import_prefix_pages(self, payload):
+        """Page-service IMPORT: adopt a sibling-exported prefix run
+        into this engine's pool + prefix index (read-only cached
+        resident, COW-guarded like any locally registered run).
+        Returns pages newly indexed — 0 when skipped (cache off, pool
+        pressure, or layout-incompatible payload); adoption is an
+        optimization and must never fail a request."""
+        with self._lock:
+            if not self.prefix_cache_enabled or payload is None:
+                return 0
+            try:
+                return self.cache.import_prefix_run(
+                    payload["tokens"], payload["k"], payload["v"])
+            except (OutOfPagesError, ValueError):
+                return 0
 
     # --------------------------- stepping ---------------------------
     def step(self):

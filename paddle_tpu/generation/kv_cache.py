@@ -41,8 +41,23 @@ pool pressure — docs/GENERATION.md "Prefix caching".
 """
 import heapq
 import math
+import threading
+import zlib
 
 import numpy as np
+
+
+def page_chain_hash(prev_hash, page_tokens):
+    """CRC chain hash of one FULL page of token ids on top of its
+    parent's chain hash — the fleet-level identity of a prefix run
+    (serving/disagg/page_service.py).  Unlike the trie key (which
+    stores literal tokens for equality-exactness), the chain hash is a
+    compact summary safe to gossip across replicas: a collision can at
+    worst route a request to a replica whose index then misses —
+    adoption and admission both re-verify against literal tokens, so a
+    colliding hash can never alias page CONTENT."""
+    return zlib.crc32(np.asarray(page_tokens, np.int64).tobytes(),
+                      int(prev_hash))
 
 
 class OutOfPagesError(RuntimeError):
@@ -86,9 +101,9 @@ class _PrefixNode:
     adopt/free churn of the warm steady state)."""
 
     __slots__ = ("page", "key", "parent", "ident", "children", "last_use",
-                 "queued")
+                 "queued", "chain")
 
-    def __init__(self, page, key, parent, ident):
+    def __init__(self, page, key, parent, ident, chain=0):
         self.page = page
         self.key = key
         self.parent = parent
@@ -96,6 +111,9 @@ class _PrefixNode:
         self.children = 0
         self.last_use = 0
         self.queued = False
+        # CRC chain hash of the token prefix this node completes — the
+        # fleet-level identity register/evict deltas gossip
+        self.chain = chain
 
 
 class PagedKVCache:
@@ -143,6 +161,15 @@ class PagedKVCache:
         # of scanning the refcount dict
         self._n_shared = 0   # pages with refcount > 1
         self._n_cached = 0   # refcount-0 registered residents
+        # prefix register/evict delta log for the fleet-level page
+        # service (None = disabled; a transport enables it and drains
+        # take_prefix_deltas on stats/heartbeat — serving/disagg).
+        # Its OWN tiny mutex: the drain runs on the router's submit
+        # hot path, which must never wait behind an in-flight engine
+        # step just to swap a list
+        self._prefix_deltas = None
+        self._delta_lock = threading.Lock()
+        self._import_seq = 0   # temp seq ids for import_prefix_run
         # incrementally-maintained min-heap of evictable LEAF nodes,
         # entries (last_use_at_push, ident, node): pushed at the exact
         # refcount/trie transitions that make a node evictable (last
@@ -385,6 +412,148 @@ class PagedKVCache:
         table.extend(int(p) for p in pages)
         self._lens[seq_id] = int(matched_tokens)
 
+    # -------------------- page export / import ----------------------
+    # The disaggregation hooks (serving/disagg): page BYTES move
+    # point-to-point between replica pools — for the fleet page service
+    # (a warm prefix run adopted by a replica that never prefilled it)
+    # and for live migration (a mid-decode resident's pages shipped to
+    # the sibling that resumes its stream).  Export/import speak ONE
+    # canonical payload layout, [L, n, page_size, H, D] in the pool
+    # dtype, whatever the storage layout or sharding — the importer
+    # re-scatters into its own layout, so any two replicas can trade
+    # pages (docs/GENERATION.md "Page export/import").
+
+    def match_prefix_full(self, tokens):
+        """Longest cached run of FULL pages matching a prefix of
+        `tokens`, UNCLIPPED — the page-service export view.  Where
+        match_prefix clips to ``len(tokens) - 1`` (an adopting sequence
+        must keep one token to sample from), an exported run is
+        re-REGISTERED on the importer, and the index only ever holds
+        full pages — so the full run ships.  Touches recency like any
+        other use.  Returns ``(pages, matched_tokens)``."""
+        ps = self.page_size
+        n = len(tokens)
+        pages = []
+        parent_ident = 0
+        i = 0
+        while (i + 1) * ps <= n:
+            key = (parent_ident,
+                   tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+            node = self._nodes.get(key)
+            if node is None:
+                break
+            node.last_use = self._tick()
+            pages.append(node.page)
+            parent_ident = node.ident
+            i += 1
+        return tuple(pages), len(pages) * ps
+
+    def export_pages(self, pages):
+        """Copy the given physical pages out of the pool as canonical
+        ``[L, n, page_size, H, D]`` K/V arrays (pool dtype, bitwise the
+        stored rows).  Counts the payload into bytes_moved — an export
+        crosses the replica boundary by definition."""
+        idx = np.asarray(pages, np.int64).reshape(-1)
+        k = np.ascontiguousarray(self.k_pool[:, idx])
+        v = np.ascontiguousarray(self.v_pool[:, idx])
+        self._bytes_moved += k.nbytes + v.nbytes
+        return k, v
+
+    def _check_import_payload(self, k, v):
+        want = (self.num_layers, k.shape[1], self.page_size,
+                self.num_heads, self.head_dim)
+        if k.shape != want or v.shape != want:
+            raise ValueError(
+                f"import payload shape {k.shape}/{v.shape} does not "
+                f"match this pool's [L, n, page_size, H, D] = {want} — "
+                f"pages only move between layout-compatible replicas")
+
+    def import_pages(self, k, v):
+        """Allocate fresh pages and install a canonical
+        ``[L, n, page_size, H, D]`` K/V payload into them; returns the
+        new page ids (each refcount 1, owned by the caller — hand them
+        to adopt_imported or register-and-free them).  Evicts cached
+        refcount-0 runs (LRU) under pool pressure before raising
+        OutOfPagesError, exactly like reserve."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        n = int(k.shape[1]) if k.ndim >= 2 else 0
+        if n == 0:
+            return []
+        self._check_import_payload(k, v)
+        if n > len(self._free):
+            self._evict_prefix(n - len(self._free))
+        if n > len(self._free):
+            raise OutOfPagesError(
+                f"cannot import {n} pages: only {len(self._free)} free "
+                f"even after evicting cached prefix runs")
+        pages = [self._take_owned_page() for _ in range(n)]
+        self._install_pages(pages, k, v)
+        self._bytes_moved += k.nbytes + v.nbytes
+        return pages
+
+    def _install_pages(self, pages, k, v):
+        """Write a canonical import payload into freshly-owned pages
+        (host backend: in-place numpy; DeviceKVPool overrides with one
+        donated dispatch per pool list)."""
+        idx = np.asarray(pages, np.int64)
+        self.k_pool[:, idx] = np.asarray(k, self.dtype)
+        self.v_pool[:, idx] = np.asarray(v, self.dtype)
+
+    def adopt_imported(self, seq_id, pages, length):
+        """Install freshly-imported pages as `seq_id`'s table with
+        `length` tokens resident — the live-migration install: the
+        sequence was just allocated empty, the pages just came from
+        import_pages (refcount 1 each), and decode resumes at
+        `length`."""
+        table = self._table(seq_id)
+        if table or self._lens[seq_id]:
+            raise ValueError(
+                f"adopt_imported on non-empty sequence {seq_id!r} "
+                f"(len={self._lens[seq_id]})")
+        length = int(length)
+        if not (len(pages) - 1) * self.page_size < length \
+                <= len(pages) * self.page_size:
+            raise ValueError(
+                f"length={length} does not land in the last of "
+                f"{len(pages)} pages of {self.page_size}")
+        table.extend(int(p) for p in pages)
+        self._lens[seq_id] = length
+
+    def import_prefix_run(self, tokens, k, v):
+        """Adopt a sibling-exported prefix run into THIS pool and
+        prefix index: install the page bytes (import_pages), register
+        the chain under a throwaway sequence, and free it — registered
+        pages stay RESIDENT at refcount 0 exactly like a locally
+        prefilled run (read-only, COW-guarded, LRU-evictable), and
+        pages whose chain this index already held are returned to the
+        free list (first writer wins, duplicates cost nothing).
+        `tokens` must cover every imported page (full pages of the
+        prefix the run indexes).  Returns pages newly indexed.  Raises
+        OutOfPagesError when the pool cannot hold the run even after
+        eviction — the caller skips adoption, never fails a request
+        over it."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        n = int(k.shape[1]) if k.ndim >= 2 else 0
+        if n == 0:
+            return 0
+        covered = n * self.page_size
+        if len(tokens) < covered:
+            raise ValueError(
+                f"{len(tokens)} tokens cannot cover {n} imported pages "
+                f"of {self.page_size}")
+        pages = self.import_pages(k, v)
+        sid = ("__prefix_import__", self._import_seq)
+        self._import_seq += 1
+        self.allocate(sid)
+        self.adopt_imported(sid, pages, covered)
+        added = self.register_prefix(sid, tokens[:covered])
+        # decref: indexed pages stay cached residents, duplicate-chain
+        # pages go straight back to the free list
+        self.free(sid)
+        return added
+
     def register_prefix(self, seq_id, tokens):
         """Index `seq_id`'s fully-written prompt pages for future
         matches.  Every FULL page of `tokens` (which must all be in the
@@ -401,9 +570,11 @@ class PagedKVCache:
         n_full = min(len(tokens), self._lens[seq_id]) // ps
         parent, parent_ident = None, 0
         added = 0
+        chain = 0
         for i in range(n_full):
-            key = (parent_ident,
-                   tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+            page_tokens = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            key = (parent_ident, page_tokens)
+            chain = page_chain_hash(chain, page_tokens)
             node = self._nodes.get(key)
             if node is None:
                 page = table[i]
@@ -412,16 +583,43 @@ class PagedKVCache:
                     # by construction (a page has one content history),
                     # but never double-index if it somehow happens
                     break
-                node = _PrefixNode(page, key, parent, self._next_node_id)
+                node = _PrefixNode(page, key, parent, self._next_node_id,
+                                   chain=chain)
                 self._next_node_id += 1
                 self._nodes[key] = node
                 self._page_node[page] = node
                 if parent is not None:
                     parent.children += 1
                 added += 1
+                self._log_prefix_delta("add", node)
             node.last_use = self._tick()
             parent, parent_ident = node, node.ident
         return added
+
+    def _log_prefix_delta(self, op, node):
+        """Record one register/evict transition for the fleet page
+        service (no-op until a transport enables the log)."""
+        if self._prefix_deltas is not None:
+            with self._delta_lock:
+                self._prefix_deltas.append((op, node.chain))
+
+    def enable_prefix_deltas(self):
+        """Start recording register/evict deltas for take_prefix_deltas
+        (idempotent).  The log only grows while someone drains it, so
+        it stays disabled unless a fleet transport turns it on."""
+        if self._prefix_deltas is None:
+            self._prefix_deltas = []
+
+    def take_prefix_deltas(self):
+        """Drain ``[("add"|"drop", chain_hash), ...]`` accumulated since
+        the last take — the register/evict bookkeeping a transport
+        piggybacks on stats/heartbeat so the FleetPrefixIndex tracks
+        which replica measurably holds which prefix run."""
+        if not self._prefix_deltas:
+            return []
+        with self._delta_lock:
+            out, self._prefix_deltas = self._prefix_deltas, []
+        return out
 
     def _push_evictable(self, node):
         """Queue an evictable leaf at its current recency.  `queued`
@@ -478,6 +676,7 @@ class PagedKVCache:
     def _drop_node(self, node):
         del self._nodes[node.key]
         del self._page_node[node.page]
+        self._log_prefix_delta("drop", node)
         parent = node.parent
         if parent is not None:
             parent.children -= 1
@@ -502,6 +701,7 @@ class PagedKVCache:
         thrash that never happened."""
         freed = 0
         for node in list(self._nodes.values()):
+            self._log_prefix_delta("drop", node)
             if self._refs.get(node.page, 1) == 0:
                 del self._refs[node.page]
                 self._n_cached -= 1
@@ -912,6 +1112,48 @@ def _copy_kv_pages(k_pools, v_pools, src, dst, *, layout, sharding=None):
     return [copy(p) for p in k_pools], [copy(p) for p in v_pools]
 
 
+def _import_kv_pages(k_pools, v_pools, pages, k, v, *, layout,
+                     sharding=None):
+    """Install a canonical ``[L, n, page_size, H, D]`` import payload
+    into physical pages `pages` of every layer's pools — the
+    import_pages body, ONE donated dispatch for all layers.  Kernel-
+    layout pools take the payload transposed to [H, n, ps, D]; under a
+    mesh the per-shard scatter writes each device's head slice of the
+    payload (kv_pool_spec shardings pinned), so an import round-trips
+    a sharded pool without ever materializing it unsharded."""
+    import jax.numpy as jnp
+
+    def put(pool, payload):
+        if layout == "kernel":          # pool [H, P, ps, D]
+            out = pool.at[:, pages].set(       # payload [n, ps, H, D]
+                jnp.transpose(payload, (2, 0, 1, 3)))
+        else:                           # pool [P, ps, H, D]
+            out = pool.at[pages].set(payload)
+        return _pin_sharding(out, sharding)
+
+    return ([put(kp, k[i]) for i, kp in enumerate(k_pools)],
+            [put(vp, v[i]) for i, vp in enumerate(v_pools)])
+
+
+def _jitted_import(layout, sharding=None):
+    """Cached jitted donated page-import per (layout, sharding) — the
+    disaggregation sibling of _jitted_scatter."""
+    import functools
+
+    key = (layout, sharding)
+    if key not in _IMPORT_JIT:
+        import jax
+
+        _IMPORT_JIT[key] = jax.jit(
+            functools.partial(_import_kv_pages, layout=layout,
+                              sharding=sharding),
+            donate_argnums=(0, 1))
+    return _IMPORT_JIT[key]
+
+
+_IMPORT_JIT = {}
+
+
 def _jitted_page_copy(layout, sharding=None):
     """Cached jitted donated page-copy per (layout, sharding) — the COW
     sibling of _jitted_scatter."""
@@ -1140,6 +1382,42 @@ class DeviceKVPool(PagedKVCache):
         self._check_span_writable(seq_id, int(start), n)
         pages, rows = self._span_pages_rows(seq_id, int(start), n)
         self._scatter_layer(layer, pages, rows, k, v, n)
+
+    def export_pages(self, pages):
+        """Device export: gather ONLY the requested pages per layer
+        (never the k_pool debug property's whole-pool stack) and hand
+        back canonical host arrays.  Under a mesh the gather is the
+        per-shard read GSPMD assembles — np.asarray on the sharded
+        slice collects every device's head split into the canonical
+        full-head payload."""
+        jnp = self._jnp
+        idx = jnp.asarray(np.asarray(pages, np.int32).reshape(-1))
+        ks, vs = [], []
+        for layer in range(self.num_layers):
+            kp, vp = self._k[layer], self._v[layer]
+            if self.pool_layout == "kernel":   # [H, P, ps, D]
+                k = jnp.transpose(kp[:, idx], (1, 2, 0, 3))
+                v = jnp.transpose(vp[:, idx], (1, 2, 0, 3))
+            else:                              # [P, ps, H, D]
+                k, v = kp[idx], vp[idx]
+            ks.append(np.asarray(k))
+            vs.append(np.asarray(v))
+        k = np.stack(ks)
+        v = np.stack(vs)
+        self._bytes_moved += k.nbytes + v.nbytes
+        return k, v
+
+    def _install_pages(self, pages, k, v):
+        """Device import: one donated dispatch installs the canonical
+        payload across every layer's pools, sharding pinned (a
+        mesh-sharded pool comes back in its NamedSharding — the same
+        contract as every other write path)."""
+        jnp = self._jnp
+        fn = _jitted_import(self.pool_layout, self._sharding)
+        self._k, self._v = fn(
+            self._k, self._v, jnp.asarray(np.asarray(pages, np.int32)),
+            jnp.asarray(k).astype(self.dtype),
+            jnp.asarray(v).astype(self.dtype))
 
     def _copy_page_storage(self, src, dst):
         """The COW page copy as ONE donated in-trace dispatch across

@@ -211,6 +211,20 @@ class ContinuousBatchingScheduler:
                 return
         raise AssertionError("no free slot (checked by caller)")
 
+    def next_seq_id(self):
+        """Allocate one sequence id outside the admission path — the
+        live-migration import (engine.import_sequence) builds its
+        SequenceState directly, bypassing the queue."""
+        sid = self._next_seq
+        self._next_seq += 1
+        return sid
+
+    def place_imported(self, state):
+        """Seat a live-migrated SequenceState straight into a free slot
+        (the caller verified free_slots() > 0 and installed its pages):
+        migration moves a resident, it never queues one."""
+        self._place(state)
+
     def admit(self, limit=None):
         """Move work into free slots while pages allow; returns the newly
         placed SequenceStates (each needs a prefill over state.tokens).

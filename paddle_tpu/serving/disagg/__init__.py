@@ -1,0 +1,28 @@
+"""paddle_tpu.serving.disagg — the fleet disaggregation subsystem.
+
+Three parts behind the FleetRouter API (docs/SERVING.md "Disaggregated
+fleet"):
+
+- ``transport`` — the replica PROCESS boundary: `InprocTransport`
+  (direct-object engine, the deterministic CPU oracle path) and
+  `SubprocTransport` (one OS process per replica, length-prefixed
+  pickled RPC over a UNIX socketpair, heartbeat liveness, crash
+  detection) behind one duck-typed contract.
+- ``page_service`` — `FleetPrefixIndex`: fleet-level prefix/page
+  bookkeeping (chain-hash → holders), fed by register/evict deltas
+  piggybacked on stats/heartbeat; page BYTES move point-to-point via
+  GenerationEngine.export_prefix_pages / import_prefix_pages.
+- ``rpc`` — the framing codec both transport halves speak.
+
+The worker module (``python -m paddle_tpu.serving.disagg.worker``) is
+the subprocess half: one single-process GenerationEngine per replica —
+no JAX multiprocess collectives anywhere.
+"""
+from .page_service import FleetPrefixIndex, page_chain_hashes
+from .transport import (InprocTransport, SubprocTransport,
+                        build_transport)
+
+__all__ = [
+    "FleetPrefixIndex", "page_chain_hashes",
+    "InprocTransport", "SubprocTransport", "build_transport",
+]

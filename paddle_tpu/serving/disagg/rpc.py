@@ -1,0 +1,61 @@
+"""Length-prefixed pickled frames over a UNIX socketpair — the wire
+format both halves of the replica process boundary speak.
+
+One frame is ``>I`` payload length + a pickled Python object.  Every
+RPC request carries ``{"op": ..., "rid": n}`` and is answered by
+exactly one ``{"resp": n, "ok": value}`` or ``{"resp": n, "error":
+exc}``; everything else on the wire is an EVENT frame (``{"ev": ...}``:
+streamed tokens, completions, heartbeats) that needs no reply.  The
+schema table lives in docs/SERVING.md "Disaggregated fleet".
+
+Pickle is safe here because both endpoints are the same trusted
+codebase on the same machine talking over an inherited socketpair —
+this is a process boundary, not a network protocol.
+"""
+import pickle
+import struct
+
+_HEADER = struct.Struct(">I")
+# a frame larger than this is a protocol bug, not a payload (page
+# exports are the biggest legitimate frames — tens of MB at most)
+MAX_FRAME_BYTES = 1 << 30
+
+
+class ChannelClosed(EOFError):
+    """The peer closed the socket (process exit or crash)."""
+
+
+def send_frame(sock, obj, lock=None):
+    """Pickle `obj` and write one length-prefixed frame.  `lock`
+    serializes concurrent writers (engine worker thread streaming
+    tokens vs the heartbeat thread vs RPC replies)."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(payload)} bytes exceeds "
+                         f"MAX_FRAME_BYTES={MAX_FRAME_BYTES}")
+    data = _HEADER.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ChannelClosed("peer closed the channel")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock):
+    """Read one frame; raises ChannelClosed on EOF (peer death)."""
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"incoming frame claims {length} bytes "
+                         f"(> MAX_FRAME_BYTES) — corrupt stream")
+    return pickle.loads(_recv_exact(sock, length))

@@ -1,0 +1,504 @@
+"""ReplicaTransport: the replica process boundary.
+
+The FleetRouter never touches a GenerationEngine directly anymore — it
+speaks one duck-typed transport contract with two implementations:
+
+- ``InprocTransport`` — the direct-object path (engine in this
+  process).  Zero serialization, stepped-mode capable, and therefore
+  the deterministic CPU oracle every cross-boundary behavior is
+  measured against.
+- ``SubprocTransport`` — ONE OS PROCESS per replica: a worker child
+  (``python -m paddle_tpu.serving.disagg.worker``) owns a
+  single-process GenerationEngine (no JAX multiprocess collectives
+  anywhere), and the parent speaks length-prefixed pickled RPC over an
+  inherited UNIX socketpair — submit / stream-token / cancel-by-drain
+  / stats / evacuate / restart, with a periodic heartbeat carrying
+  load + prefix register/evict deltas.  The parent keeps an IN-FLIGHT
+  LEDGER (every submitted-but-unfinished request with its delivered
+  token count): crash detection (socket EOF or a stale heartbeat)
+  marks the replica dead and hands the ledger to the fleet, which
+  remigrates queued work and resolves in-flight streams typed —
+  migrated or shed, never hung.
+
+The transport contract (duck-typed; every method the router calls):
+
+    alive() heartbeat_age() describe() load_info() stats()
+    submit(prompt, kwargs, handle) drain(migrate, live, timeout)
+    import_sequence(snap) export_prefix(tokens) import_prefix(payload)
+    take_prefix_deltas() flush_prefix() reset_stats()
+    idle() pump() stop() take_inflight()
+
+Docs: docs/SERVING.md "Disaggregated fleet" (contract + RPC schema).
+"""
+import itertools
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ...generation.engine import (GenerationEngine, GenerationResult)
+from ...generation.metrics import GenerationMetrics
+from ...generation.scheduler import GenerationRequest
+from ...profiler.monitor import StatRegistry
+from ..admission import ServingError
+from .rpc import ChannelClosed, recv_frame, send_frame
+
+HEARTBEAT_S = 0.25
+
+
+def build_transport(spec, kind, start=True):
+    """Transport factory: ``"inproc"`` or ``"proc"``."""
+    if kind == "proc":
+        return SubprocTransport(spec)
+    if kind == "inproc":
+        return InprocTransport(spec, start=start)
+    raise ValueError(f"transport must be 'inproc' or 'proc', got {kind!r}")
+
+
+class InprocTransport:
+    """The direct-object replica: today's engine-in-process path,
+    behind the transport contract — the deterministic CPU oracle the
+    subprocess boundary is proven token-identical against."""
+
+    kind = "inproc"
+
+    def __init__(self, spec, start=True):
+        self.name = spec.name
+        self.registry = StatRegistry()
+        self.engine = GenerationEngine(
+            spec.model, spec.config,
+            metrics=GenerationMetrics(registry=self.registry),
+            start=start)
+        if self.engine.prefix_cache_enabled:
+            self.engine.cache.enable_prefix_deltas()
+        self.on_death = None   # inproc replicas share our fate
+
+    # ------------------------- liveness -----------------------------
+    def alive(self):
+        return not self.engine._closed
+
+    def heartbeat_age(self):
+        """0.0 by definition: an in-process engine's liveness IS this
+        process's liveness — the gauge stays schema-complete and
+        zeroed, exactly what a dashboard should read for it."""
+        return 0.0
+
+    # ----------------------- introspection --------------------------
+    def describe(self):
+        return self.engine.describe()
+
+    def load_info(self):
+        return self.engine.load_info()
+
+    def stats(self):
+        return {
+            "generation":
+                self.registry.stats_snapshot("generation.")["stats"],
+            "cache": self.engine.cache.stats(),
+        }
+
+    # -------------------------- serving -----------------------------
+    def submit(self, prompt, kwargs, handle):
+        return self.engine.submit(prompt, handle=handle, **kwargs)
+
+    def take_inflight(self):
+        return []   # an inproc replica cannot die out from under us
+
+    # ------------------------ page service --------------------------
+    def take_prefix_deltas(self):
+        # the cache's delta log carries its own mutex, so the router's
+        # submit hot path never waits behind an in-flight engine step
+        # just to swap a list
+        return self.engine.cache.take_prefix_deltas()
+
+    def export_prefix(self, tokens):
+        return self.engine.export_prefix_pages(tokens)
+
+    def import_prefix(self, payload):
+        return self.engine.import_prefix_pages(payload)
+
+    def flush_prefix(self):
+        return self.engine.cache.flush_prefix_cache()
+
+    def reset_stats(self):
+        self.registry.reset_all()
+
+    # ----------------------- drain / migration ----------------------
+    def import_sequence(self, snap):
+        return self.engine.import_sequence(snap)
+
+    def drain(self, migrate=True, live=True, timeout=60.0):
+        """Evacuate this replica's unfinished work and shut the engine
+        down.  Returns ``(cold, live_snaps)``: cold resubmits
+        ``[(GenerationRequest, emitted)]`` plus live-migration sequence
+        snapshots.  One state machine for both transport halves:
+        engine.drain_work (migrate=False lets residents finish first,
+        stragglers past `timeout` evacuate anyway)."""
+        return self.engine.drain_work(migrate=migrate, live=live,
+                                      timeout=timeout)
+
+    # ------------------------- lifecycle ----------------------------
+    def idle(self):
+        sched = self.engine.scheduler
+        return not (sched.active() or sched.pending_count())
+
+    def pump(self):
+        eng = self.engine
+        if eng._thread is not None and eng._thread.is_alive():
+            time.sleep(0.002)
+        else:
+            eng.step()
+
+    def stop(self):
+        self.engine.shutdown()
+
+
+class SubprocTransport:
+    """One OS process per replica, length-prefixed pickled RPC over a
+    UNIX socketpair (rpc.py), heartbeat liveness, crash detection with
+    an in-flight ledger the fleet remigrates from."""
+
+    kind = "proc"
+    BUILD_TIMEOUT_S = 180.0
+    RPC_TIMEOUT_S = 60.0
+
+    def __init__(self, spec):
+        cfg = spec.config
+        if cfg is not None and getattr(cfg, "mesh", None) is not None:
+            raise ValueError(
+                "SubprocTransport replicas are single-process engines: "
+                "a jax Mesh cannot cross the process boundary (shard "
+                "INSIDE a replica with InprocTransport, or give the "
+                "subprocess replica an unsharded config)")
+        self.name = spec.name
+        self.registry = None       # stats live in the child
+        self.engine = None         # no direct-object path
+        self.on_death = None       # fleet sets: callback(transport)
+        parent, child = socket.socketpair()
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.disagg.worker",
+             str(child.fileno())],
+            pass_fds=(child.fileno(),), env=env)
+        child.close()
+        self._sock = parent
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()   # rpc waits + inflight + deltas
+        self._ids = itertools.count(1)  # rids and stream sids alike
+        self._rpc_waits = {}            # rid -> (Event, slot dict)
+        self._inflight = {}             # sid -> ledger entry
+        self._deltas = []
+        self._load = {"queue_depth": 0, "active": 0, "pages_in_use": 0,
+                      "num_pages": 1, "idle": True}
+        self._last_hb = time.monotonic()
+        self._dead = threading.Event()
+        self._closing = False
+        self._death_handled = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"replica-{spec.name}-rx",
+            daemon=True)
+        self._reader.start()
+        # the build handshake doubles as the readiness barrier: the
+        # child pays its jax import + engine build before replying.
+        # A failed build must not leak the worker: the reader thread
+        # keeps the parent socket referenced, so without an explicit
+        # kill the child would outlive this constructor forever
+        try:
+            self._describe = self._call(
+                {"op": "build", "model": spec.model, "config": cfg},
+                timeout=self.BUILD_TIMEOUT_S)
+        except BaseException:
+            self._closing = True
+            self._proc.kill()
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
+        # the liveness clock starts AFTER the handshake: the child's
+        # heartbeat thread only exists from here, and a build that took
+        # longer than heartbeat_dead_after must not read as a stale
+        # replica the reaper kills at the first submit
+        self._last_hb = time.monotonic()
+
+    # ------------------------- wire pump ----------------------------
+    def _read_loop(self):
+        try:
+            while True:
+                self._dispatch(recv_frame(self._sock))
+        except (ChannelClosed, OSError, EOFError, ValueError):
+            pass
+        except Exception:   # noqa: BLE001 — a poisoned frame is a dead
+            pass            # channel, not a crashed router
+        self._mark_dead()
+
+    def _dispatch(self, frame):
+        rid = frame.get("resp")
+        if rid is not None:
+            with self._lock:
+                wait = self._rpc_waits.pop(rid, None)
+            if wait is not None:
+                ev, slot = wait
+                slot.update(frame)
+                ev.set()
+            return
+        kind = frame.get("ev")
+        if kind == "hb":
+            self._last_hb = time.monotonic()
+            self._load = frame.get("load", self._load)
+            deltas = frame.get("deltas")
+            if deltas:
+                with self._lock:
+                    self._deltas.extend(deltas)
+            return
+        sid = frame.get("sid")
+        with self._lock:
+            entry = self._inflight.get(sid)
+        if entry is None:
+            return   # stream already resolved/migrated elsewhere
+        handle = entry["handle"]
+        if kind == "token":
+            entry["emitted"] += 1
+            handle._push_token(frame["t"])
+        elif kind == "done":
+            with self._lock:
+                self._inflight.pop(sid, None)
+            hit = frame.get("prefix_hit")
+            if hit is not None and getattr(handle, "prefix_hit_tokens",
+                                           0) is None:
+                handle.prefix_hit_tokens = hit
+            r = frame["result"]
+            handle._finish(GenerationResult(
+                r["token_ids"], r["finish_reason"], r["prompt_len"],
+                r["preemptions"]))
+        elif kind == "error":
+            with self._lock:
+                self._inflight.pop(sid, None)
+            handle.set_exception(frame["exc"])
+
+    def _mark_dead(self):
+        with self._lock:
+            if self._death_handled:
+                return
+            self._death_handled = True
+            waits = list(self._rpc_waits.values())
+            self._rpc_waits.clear()
+        self._dead.set()
+        err = ServingError(
+            f"replica {self.name!r} process died mid-call")
+        for ev, slot in waits:
+            slot["error"] = err
+            ev.set()
+        if not self._closing and self.on_death is not None:
+            # the fleet remigrates the in-flight ledger; the callback
+            # runs on the reader thread AFTER every pending RPC was
+            # failed, so a router blocked on this replica unwinds first
+            self.on_death(self)
+
+    def _call(self, msg, timeout=None):
+        if self._dead.is_set():
+            raise ServingError(
+                f"replica {self.name!r} process is dead")
+        rid = next(self._ids)
+        ev = threading.Event()
+        slot = {}
+        with self._lock:
+            self._rpc_waits[rid] = (ev, slot)
+        msg = dict(msg)
+        msg["rid"] = rid
+        try:
+            send_frame(self._sock, msg, self._wlock)
+        except OSError as e:
+            with self._lock:
+                self._rpc_waits.pop(rid, None)
+            raise ServingError(
+                f"replica {self.name!r} channel write failed") from e
+        if not ev.wait(self.RPC_TIMEOUT_S if timeout is None
+                       else float(timeout)):
+            with self._lock:
+                self._rpc_waits.pop(rid, None)
+            raise ServingError(
+                f"RPC {msg.get('op')!r} to replica {self.name!r} "
+                f"timed out")
+        if "error" in slot:
+            raise slot["error"]
+        return slot.get("ok")
+
+    # ------------------------- liveness -----------------------------
+    def alive(self):
+        return not self._dead.is_set()
+
+    def heartbeat_age(self):
+        return max(0.0, time.monotonic() - self._last_hb)
+
+    def kill(self):
+        """Hard-kill the worker process (crash-injection for tests and
+        drills): SIGKILL, no cleanup — the reader thread's EOF is the
+        detection path under test."""
+        self._proc.kill()
+
+    # ----------------------- introspection --------------------------
+    def describe(self):
+        return dict(self._describe)
+
+    def load_info(self):
+        return dict(self._load)   # heartbeat-cached (no RPC on the
+        # routing hot path; staleness is one heartbeat period)
+
+    def stats(self):
+        if self._dead.is_set():
+            return {}
+        return self._call({"op": "stats"})
+
+    # -------------------------- serving -----------------------------
+    def submit(self, prompt, kwargs, handle):
+        if getattr(handle, "submitted_s", None) is None:
+            handle.submitted_s = time.monotonic()
+        sid = next(self._ids)
+        timeout_ms = kwargs.get("timeout_ms")
+        entry = {
+            "prompt": list(prompt),
+            "kwargs": dict(kwargs),
+            "handle": handle,
+            "emitted": 0,
+            "deadline": (None if timeout_ms is None else
+                         time.monotonic() + float(timeout_ms) / 1e3),
+        }
+        with self._lock:
+            self._inflight[sid] = entry
+        try:
+            self._call({"op": "submit", "sid": sid,
+                        "prompt": list(prompt), "kwargs": dict(kwargs)})
+        except BaseException:
+            with self._lock:
+                self._inflight.pop(sid, None)
+            raise
+        return handle
+
+    def take_inflight(self):
+        """Drain the in-flight ledger — every submitted-but-unfinished
+        request with its delivered-token count.  The death path: the
+        fleet resubmits each entry elsewhere (seeded sampling replays
+        identically; a relay skips what the client already has)."""
+        with self._lock:
+            entries = list(self._inflight.values())
+            self._inflight.clear()
+        return entries
+
+    # ------------------------ page service --------------------------
+    def take_prefix_deltas(self):
+        with self._lock:
+            out, self._deltas = self._deltas, []
+        return out
+
+    def export_prefix(self, tokens):
+        return self._call({"op": "export_prefix",
+                           "tokens": [int(t) for t in tokens]})
+
+    def import_prefix(self, payload):
+        return self._call({"op": "import_prefix", "payload": payload})
+
+    def flush_prefix(self):
+        return self._call({"op": "flush_prefix"})
+
+    def reset_stats(self):
+        return self._call({"op": "reset_stats"})
+
+    # ----------------------- drain / migration ----------------------
+    def import_sequence(self, snap):
+        handle = snap.get("future")
+        sid = next(self._ids)
+        payload = {k: v for k, v in snap.items() if k != "future"}
+        entry = {
+            "prompt": list(snap["prompt"]),
+            "kwargs": {"max_new_tokens": snap["max_new_tokens"],
+                       "sampling": snap["sampling"],
+                       "stop_tokens": snap["stop_tokens"],
+                       "timeout_ms": None},
+            "handle": handle,
+            "emitted": int(snap["n_generated"]),
+            "deadline": snap.get("deadline"),
+        }
+        with self._lock:
+            self._inflight[sid] = entry
+        try:
+            ok = bool(self._call({"op": "import_seq", "sid": sid,
+                                  "snap": payload}))
+        except BaseException:
+            with self._lock:
+                self._inflight.pop(sid, None)
+            raise
+        if not ok:
+            with self._lock:
+                self._inflight.pop(sid, None)
+        return ok
+
+    def drain(self, migrate=True, live=True, timeout=60.0):
+        out = self._call(
+            {"op": "evacuate", "migrate": bool(migrate),
+             "live": bool(live), "timeout": float(timeout)},
+            timeout=float(timeout) + self.RPC_TIMEOUT_S)
+        cold, live_snaps = [], []
+        with self._lock:
+            for item in out["cold"]:
+                entry = self._inflight.pop(item["sid"], None)
+                if entry is None:
+                    continue   # resolved while the drain was in flight
+                req = GenerationRequest(
+                    item["prompt"], entry["handle"], item["sampling"],
+                    max_new_tokens=item["max_new_tokens"],
+                    stop_tokens=item["stop_tokens"],
+                    deadline=item["deadline"])
+                cold.append((req, max(int(item["emitted"]),
+                                      entry["emitted"])))
+            for snap in out["live"]:
+                entry = self._inflight.pop(snap.pop("sid"), None)
+                if entry is None:
+                    continue
+                snap["future"] = entry["handle"]
+                live_snaps.append(snap)
+        self.stop()
+        return cold, live_snaps
+
+    # ------------------------- lifecycle ----------------------------
+    def idle(self):
+        if self._dead.is_set():
+            return True
+        try:
+            load = self._call({"op": "load"}, timeout=10.0)
+        except ServingError:
+            return True
+        self._load = load
+        with self._lock:
+            busy = bool(self._inflight)
+        return bool(load.get("idle")) and not busy
+
+    def pump(self):
+        time.sleep(0.01)   # the child steps itself; just yield
+
+    def stop(self):
+        self._closing = True
+        if not self._dead.is_set():
+            try:
+                self._call({"op": "shutdown"}, timeout=10.0)
+            except ServingError:
+                pass
+        try:
+            self._proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait(timeout=10.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+__all__ = ["InprocTransport", "SubprocTransport", "build_transport",
+           "HEARTBEAT_S"]

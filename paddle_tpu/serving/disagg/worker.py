@@ -1,0 +1,226 @@
+"""Replica worker process — the subprocess half of SubprocTransport.
+
+``python -m paddle_tpu.serving.disagg.worker <fd>`` builds ONE
+single-process GenerationEngine from the pickled build spec (first RPC
+frame) and serves the transport RPC contract over the inherited
+socketpair fd: submit streams tokens back as events, evacuate ships
+cold requests and live sequence snapshots for migration, a heartbeat
+thread reports load + prefix register/evict deltas every
+``HEARTBEAT_S``.  The engine steps itself on its background worker
+thread; nothing here touches jax.distributed — a replica is exactly
+the single-process engine the CPU oracle runs, behind a socket.
+
+Frame schema: docs/SERVING.md "Disaggregated fleet".
+"""
+import socket
+import sys
+import threading
+import time
+import traceback
+
+
+class _StreamHandle:
+    """Engine-side handle that RELAYS the stream over the socket: the
+    duck-typed surface GenerationEngine drives (_push_token/_finish/
+    set_exception/done + the stamp attributes), writing one event
+    frame per transition.  The parent-side transport reassembles the
+    client's GenerationHandle from these frames."""
+
+    __slots__ = ("sid", "_sock", "_wlock", "submitted_s",
+                 "first_token_s", "prefix_hit_tokens", "_done")
+
+    def __init__(self, sid, sock, wlock):
+        self.sid = sid
+        self._sock = sock
+        self._wlock = wlock
+        self.submitted_s = None
+        self.first_token_s = None
+        self.prefix_hit_tokens = None
+        self._done = False
+
+    def _send(self, obj):
+        from .rpc import send_frame
+
+        try:
+            send_frame(self._sock, obj, self._wlock)
+        except OSError:
+            pass   # parent gone; this process is about to die anyway
+
+    def _push_token(self, token):
+        if self.first_token_s is None:
+            self.first_token_s = time.monotonic()
+        self._send({"ev": "token", "sid": self.sid, "t": int(token)})
+
+    def _finish(self, result):
+        if self._done:
+            return
+        self._done = True
+        self._send({"ev": "done", "sid": self.sid,
+                    "prefix_hit": self.prefix_hit_tokens,
+                    "result": {"token_ids": list(result.token_ids),
+                               "finish_reason": result.finish_reason,
+                               "prompt_len": result.prompt_len,
+                               "preemptions": result.preemptions}})
+
+    def set_exception(self, exc):
+        if self._done:
+            return
+        self._done = True
+        self._send({"ev": "error", "sid": self.sid, "exc": exc})
+
+    def done(self):
+        return self._done
+
+
+class _Worker:
+    def __init__(self, sock):
+        self.sock = sock
+        self.wlock = threading.Lock()
+        self.engine = None
+        self.registry = None
+        self._stop_hb = threading.Event()
+
+    # --------------------------- ops --------------------------------
+    def op_build(self, frame):
+        from ...generation.engine import GenerationEngine
+        from ...generation.metrics import GenerationMetrics
+        from ...profiler.monitor import StatRegistry
+        from .transport import HEARTBEAT_S
+
+        self.registry = StatRegistry()
+        self.engine = GenerationEngine(
+            frame["model"], frame["config"],
+            metrics=GenerationMetrics(registry=self.registry),
+            start=True)
+        if self.engine.prefix_cache_enabled:
+            self.engine.cache.enable_prefix_deltas()
+        threading.Thread(target=self._heartbeat, args=(HEARTBEAT_S,),
+                         name="replica-heartbeat", daemon=True).start()
+        return self.engine.describe()
+
+    def _heartbeat(self, interval):
+        from .rpc import send_frame
+
+        while not self._stop_hb.wait(interval):
+            try:
+                deltas = self.engine.cache.take_prefix_deltas()
+                send_frame(self.sock,
+                           {"ev": "hb", "load": self.engine.load_info(),
+                            "deltas": deltas}, self.wlock)
+            except OSError:
+                return
+            except Exception:   # noqa: BLE001 — a heartbeat must never
+                pass            # kill the worker; the next beat retries
+
+    def op_submit(self, frame):
+        handle = _StreamHandle(frame["sid"], self.sock, self.wlock)
+        self.engine.submit(frame["prompt"], handle=handle,
+                           **frame["kwargs"])
+        return True
+
+    def op_load(self, frame):
+        return self.engine.load_info()
+
+    def op_stats(self, frame):
+        return {
+            "generation":
+                self.registry.stats_snapshot("generation.")["stats"],
+            "cache": self.engine.cache.stats(),
+        }
+
+    def op_evacuate(self, frame):
+        # the same drain state machine as InprocTransport.drain —
+        # engine.drain_work, so the oracle and the process boundary
+        # cannot diverge (the child's engine always runs its worker
+        # thread, so drain_work's wait loop just sleeps here)
+        cold, live_snaps = self.engine.drain_work(
+            migrate=frame["migrate"], live=frame["live"],
+            timeout=frame["timeout"])
+        out = {"cold": [], "live": []}
+        for req, emitted in cold:
+            out["cold"].append({
+                "sid": req.future.sid,
+                "prompt": list(req.prompt),
+                "max_new_tokens": req.max_new_tokens,
+                "sampling": req.params,
+                "stop_tokens": tuple(req.stop_tokens),
+                "deadline": req.deadline,
+                "emitted": int(emitted),
+            })
+        for snap in live_snaps:
+            snap["sid"] = snap.pop("future").sid
+            out["live"].append(snap)
+        return out
+
+    def op_import_seq(self, frame):
+        snap = frame["snap"]
+        handle = _StreamHandle(frame["sid"], self.sock, self.wlock)
+        return bool(self.engine.import_sequence(snap, handle=handle))
+
+    def op_export_prefix(self, frame):
+        return self.engine.export_prefix_pages(frame["tokens"])
+
+    def op_import_prefix(self, frame):
+        return self.engine.import_prefix_pages(frame["payload"])
+
+    def op_flush_prefix(self, frame):
+        return self.engine.cache.flush_prefix_cache()
+
+    def op_reset_stats(self, frame):
+        self.registry.reset_all()
+        return True
+
+    def op_ping(self, frame):
+        return True
+
+    def op_shutdown(self, frame):
+        self._stop_hb.set()
+        if self.engine is not None:
+            self.engine.shutdown()
+        return True
+
+    # --------------------------- loop -------------------------------
+    def serve(self):
+        from ..admission import ServingError
+        from .rpc import ChannelClosed, recv_frame, send_frame
+
+        while True:
+            try:
+                frame = recv_frame(self.sock)
+            except (ChannelClosed, OSError):
+                # parent died: nothing to stream to — exit cleanly
+                self._stop_hb.set()
+                if self.engine is not None:
+                    self.engine.shutdown()
+                return
+            rid = frame.get("rid")
+            op = frame.get("op")
+            try:
+                result = getattr(self, f"op_{op}")(frame)
+                reply = {"resp": rid, "ok": result}
+            except Exception as e:   # noqa: BLE001 — typed errors ride
+                reply = {"resp": rid, "error": e}   # the wire back
+            try:
+                send_frame(self.sock, reply, self.wlock)
+            except OSError:
+                return   # parent gone
+            except Exception:   # noqa: BLE001 — unpicklable payload:
+                try:            # degrade to a typed, serializable error
+                    send_frame(self.sock,
+                               {"resp": rid, "error": ServingError(
+                                   f"op {op!r} reply not serializable: "
+                                   f"{traceback.format_exc(limit=3)}")},
+                               self.wlock)
+                except OSError:
+                    return
+            if op == "shutdown":
+                return
+
+
+def main(fd):
+    sock = socket.socket(fileno=fd)
+    _Worker(sock).serve()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]))

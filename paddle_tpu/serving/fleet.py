@@ -38,14 +38,31 @@ ServerBusyError / DeadlineExceededError); the fleet only ADDS the
 cross-replica hop, so a fleet of one behaves exactly like a bare
 engine.
 
-Drain (`drain(name)`) stops admissions to a replica, migrates its
-not-yet-finished work to siblings as COLD RESUBMITS — sampling is
-seeded per request, so a resubmit replays the identical stream, and a
-relay handle skips the tokens the client already received — lets
-anything kept behind finish, then joins the worker.  `restart(name)`
-rebuilds the replica from its spec (fresh pools, empty prefix index);
+The fleet is DISAGGREGATED (serving/disagg): every replica sits behind
+a ReplicaTransport — `InprocTransport` (direct-object engine, the
+deterministic CPU oracle) or `SubprocTransport` (one OS process per
+replica, pickled RPC over a socketpair, heartbeat liveness; a crashed
+process is detected and its in-flight ledger remigrates, streams
+resolve typed instead of hanging).  The prefix-affinity rung reads a
+fleet-level `FleetPrefixIndex` fed by register/evict deltas each
+replica's cache reports — MEASURED bookkeeping centralized in the
+router, page BYTES moved point-to-point on demand: when the index
+says a different replica holds a prompt's warm run, the router ships
+the pages so the chosen replica adopts a run it never prefilled.
+
+Drain (`drain(name)`) stops admissions to a replica and moves its
+not-yet-finished work to siblings: live decode residents as TRUE LIVE
+MIGRATIONS — page bytes + position + sampling RNG ship to a sibling
+that RESUMES the stream with zero replayed tokens — and everything
+else (plus any resident no sibling can adopt) as COLD RESUBMITS:
+sampling is seeded per request, so a resubmit replays the identical
+stream, and a relay handle skips the tokens the client already
+received (counted in fleet.migrated_replay_tokens — the live-vs-cold
+A/B).  migrate=False lets residents finish first, then joins the
+worker.  `restart(name)` rebuilds the replica from its spec (fresh
+pools, empty prefix index, a fresh process for subprocess replicas);
 stale prefix-affinity bets against it are caught by the confirmation
-loop, not assumed away.
+loop AND the fleet index drop, not assumed away.
 
 Token-identity oracle (tests/test_fleet.py): whatever the routing
 outcome — affinity hit, prefix spill, shed-and-retry, mid-stream drain
@@ -63,11 +80,14 @@ import zlib
 
 import numpy as np
 
-from ..generation.engine import (GenerationEngine, GenerationHandle)
-from ..generation.metrics import GenerationMetrics
+from ..generation.engine import GenerationHandle
+from ..generation.sampling import SamplingParams
+from ..generation.scheduler import GenerationRequest
 from ..profiler.monitor import StatRegistry
 from .admission import (RequestTooLargeError, ServerBusyError,
                         ServingError)
+from .disagg.page_service import FleetPrefixIndex
+from .disagg.transport import build_transport
 
 PREFIX = "fleet."
 
@@ -81,6 +101,14 @@ MIGRATED_TOTAL = PREFIX + "migrated_total"
 PREFIX_ROUTED_CONFIRMED = PREFIX + "prefix_routed_confirmed"
 PREFIX_ROUTED_MISSED = PREFIX + "prefix_routed_missed"
 REPLICA_QUEUE_DEPTH = PREFIX + "replica_queue_depth"
+# disaggregation tier (serving/disagg): heartbeat liveness, live
+# migration vs cold-resubmit accounting, page-service adoptions
+REPLICA_HEARTBEAT_AGE = PREFIX + "replica_heartbeat_age_s"
+REPLICA_DEAD_TOTAL = PREFIX + "replica_dead_total"
+LIVE_MIGRATED_TOTAL = PREFIX + "live_migrated_total"
+MIGRATED_REPLAY_TOKENS = PREFIX + "migrated_replay_tokens"
+PAGE_ADOPTIONS = PREFIX + "page_adoptions"
+PAGES_ADOPTED = PREFIX + "pages_adopted"
 
 
 class FleetMetrics:
@@ -98,7 +126,10 @@ class FleetMetrics:
         for name in (ROUTED_AFFINITY, ROUTED_PREFIX, ROUTED_BALANCE,
                      ROUTED_RANDOM, ROUTED_SPILL, SHED_TOTAL,
                      MIGRATED_TOTAL, PREFIX_ROUTED_CONFIRMED,
-                     PREFIX_ROUTED_MISSED, REPLICA_QUEUE_DEPTH):
+                     PREFIX_ROUTED_MISSED, REPLICA_QUEUE_DEPTH,
+                     REPLICA_HEARTBEAT_AGE, REPLICA_DEAD_TOTAL,
+                     LIVE_MIGRATED_TOTAL, MIGRATED_REPLAY_TOKENS,
+                     PAGE_ADOPTIONS, PAGES_ADOPTED):
             self._reg.get_stat(name)
 
     def _stat(self, name):
@@ -123,6 +154,34 @@ class FleetMetrics:
         self._stat(PREFIX_ROUTED_CONFIRMED if hit
                    else PREFIX_ROUTED_MISSED).increase()
 
+    def count_replica_dead(self):
+        self._stat(REPLICA_DEAD_TOTAL).increase()
+
+    def count_live_migrated(self, n=1):
+        if n:
+            self._stat(LIVE_MIGRATED_TOTAL).increase(n)
+
+    def count_replay_tokens(self, n):
+        """Stream tokens a COLD resubmit recomputes that the client
+        already streamed (the relay swallows them) — live migration's
+        structural 0 vs the cold baseline's full replay, per drain."""
+        if n:
+            self._stat(MIGRATED_REPLAY_TOKENS).increase(int(n))
+
+    def count_page_adoption(self, pages):
+        """One page-service transfer that indexed `pages` new pages on
+        the adopting replica."""
+        self._stat(PAGE_ADOPTIONS).increase()
+        if pages:
+            self._stat(PAGES_ADOPTED).increase(int(pages))
+
+    def set_heartbeat_age(self, name, age):
+        self._stat(f"{REPLICA_HEARTBEAT_AGE}.{name}").set(
+            round(float(age), 3))
+
+    def set_max_heartbeat_age(self, age):
+        self._stat(REPLICA_HEARTBEAT_AGE).set(round(float(age), 3))
+
     def set_replica_queue_depth(self, name, depth):
         self._stat(f"{REPLICA_QUEUE_DEPTH}.{name}").set(int(depth))
 
@@ -139,14 +198,25 @@ class ReplicaSpec:
     GenerationConfig — heterogeneous fleets (long-context next to
     low-latency) are just different specs behind one router.  The
     router keeps the spec so `restart(name)` can rebuild the engine
-    after a drain."""
+    after a drain.
 
-    __slots__ = ("name", "model", "config")
+    transport: "inproc" (direct-object engine, the deterministic CPU
+        oracle path) or "proc" (one OS process per replica behind the
+        SubprocTransport RPC boundary — model and config must pickle,
+        mesh configs are rejected; see serving/disagg).  A
+        FleetConfig.transport override applies to every spec."""
 
-    def __init__(self, name, model, config=None):
+    __slots__ = ("name", "model", "config", "transport")
+
+    def __init__(self, name, model, config=None, transport="inproc"):
         self.name = str(name)
         self.model = model
         self.config = config
+        if transport not in ("inproc", "proc"):
+            raise ValueError(
+                f"transport must be 'inproc' or 'proc', got "
+                f"{transport!r}")
+        self.transport = transport
 
 
 class _MigrationRelay:
@@ -207,20 +277,23 @@ class _MigrationRelay:
 
 
 class _Replica:
-    """One live replica: engine + its own metrics registry (per-replica
-    generation.* stats stay separable for the fleet snapshot) + the
-    admission state the router flips + the measured TTFT EWMA the
-    latency-aware load score folds in."""
+    """One live replica BEHIND A TRANSPORT: the router's view is the
+    duck-typed transport contract (serving/disagg/transport.py) — an
+    in-process engine and a subprocess replica look identical from
+    here — plus the admission state the router flips and the measured
+    TTFT EWMA the latency-aware load score folds in."""
 
     _TTFT_EWMA_ALPHA = 0.3   # same smoothing as generation.tokens_per_s
     _TTFT_LOAD_CAP = 4.0     # a slow replica weighs at most like this
     # many queued requests: bounded back-pressure, never starvation
 
-    def __init__(self, spec, start):
+    def __init__(self, spec, start, transport_kind, on_death=None):
         self.spec = spec
+        self.kind = transport_kind
         self.state = "stopped"
-        self.registry = None
-        self.engine = None
+        self.transport = None
+        self._describe = None
+        self._on_death = on_death
         # measured time-to-first-token EWMA (seconds; None = no sample
         # yet).  Updated from handle done-callbacks, which fire on
         # engine worker threads — the float swap is a benign last-
@@ -243,11 +316,10 @@ class _Replica:
                           + (1 - self._TTFT_EWMA_ALPHA) * prev)
 
     def build(self, start):
-        self.registry = StatRegistry()
-        self.engine = GenerationEngine(
-            self.spec.model, self.spec.config,
-            metrics=GenerationMetrics(registry=self.registry),
-            start=start)
+        self.transport = build_transport(self.spec, self.kind,
+                                         start=start)
+        self.transport.on_death = self._on_death
+        self._describe = self.transport.describe()
         self.state = "serving"
         # a rebuilt replica is a new process in spirit: its latency
         # history died with the old engine
@@ -259,18 +331,31 @@ class _Replica:
 
     @property
     def accepting(self):
-        return self.state == "serving"
+        return self.state == "serving" and self.transport.alive()
+
+    @property
+    def engine(self):
+        """The direct engine object — inproc transports only (tests
+        and the stepped oracle drive it); None across a process
+        boundary."""
+        return getattr(self.transport, "engine", None)
+
+    @property
+    def registry(self):
+        return getattr(self.transport, "registry", None)
 
     def can_fit(self, prompt_len, max_new):
         """Could this replica EVER hold the request (pool + positions)?
         The capacity pre-filter that makes heterogeneous fleets work:
         a long prompt routes straight to the long-context replica
-        instead of bouncing off a small one's typed rejection."""
-        cfg = self.engine.config
-        if math.ceil((prompt_len + 1) / cfg.page_size) > cfg.num_pages:
+        instead of bouncing off a small one's typed rejection.
+        Answered from the transport's static describe() — no RPC on
+        the routing path."""
+        d = self._describe
+        if math.ceil((prompt_len + 1) / d["page_size"]) > d["num_pages"]:
             return False
-        max_pos = getattr(self.engine.model, "max_positions", None)
-        mn = (cfg.default_max_new_tokens if max_new is None
+        max_pos = d["max_positions"]
+        mn = (d["default_max_new_tokens"] if max_new is None
               else int(max_new))
         return max_pos is None or prompt_len + mn <= max_pos
 
@@ -291,18 +376,19 @@ class _Replica:
         traffic therefore drains toward the replica actually answering
         fast, without ever wedging the slow one out of the fleet.
         Replicas with no sample yet (or without a baseline) add
-        nothing — cold replicas are worth probing, not penalizing."""
-        eng = self.engine
-        score = (eng.scheduler.pending_count()
-                 + len(eng.scheduler.active())
-                 + eng.cache.pages_in_use / max(1, eng.cache.num_pages))
+        nothing — cold replicas are worth probing, not penalizing.
+        Load reads the transport's load_info: exact for inproc,
+        heartbeat-fresh for subprocess replicas."""
+        info = self.transport.load_info()
+        score = (info["queue_depth"] + info["active"]
+                 + info["pages_in_use"] / max(1, info["num_pages"]))
         if ttft_baseline and self.ttft_ewma:
             score += min(self.ttft_ewma / ttft_baseline - 1.0,
                          self._TTFT_LOAD_CAP)
         return score
 
     def queue_depth(self):
-        return self.engine.scheduler.pending_count()
+        return self.transport.load_info()["queue_depth"]
 
 
 class FleetConfig:
@@ -319,10 +405,26 @@ class FleetConfig:
     start: start each replica engine's background worker (tests drive
         steps themselves via run_until_idle and pass False).
     seed: the random-routing RNG seed (reproducible A/B benches).
+    transport: override EVERY spec's transport — "inproc", "proc", or
+        None (each ReplicaSpec keeps its own; the gen_bench
+        --fleet-transport A/B flips this one knob).
+    live_migration: drain/crash migration ships resident sequence
+        state to a sibling that RESUMES mid-decode (True, the
+        default — migrated_replay_tokens stays 0); False restores the
+        cold-resubmit-only path (seeded replay, the ablation baseline).
+    heartbeat_dead_after: seconds without a heartbeat before a
+        subprocess replica is declared dead (hung, not crashed — a
+        crash is caught instantly by socket EOF) and its in-flight
+        ledger remigrates.  Inproc replicas never age.
+    page_service: fleet-level prefix index + point-to-point page
+        transfer (True, the default under routing="affinity"); False
+        keeps the stable-hash prefix guess only.
     """
 
     def __init__(self, routing="affinity", affinity_block_tokens=None,
-                 start=True, seed=None):
+                 start=True, seed=None, transport=None,
+                 live_migration=True, heartbeat_dead_after=10.0,
+                 page_service=True):
         if routing not in ("affinity", "random"):
             raise ValueError(
                 f"routing must be 'affinity' or 'random', got {routing!r}")
@@ -337,6 +439,14 @@ class FleetConfig:
             else int(affinity_block_tokens))
         self.start = bool(start)
         self.seed = seed
+        if transport not in (None, "inproc", "proc"):
+            raise ValueError(
+                f"transport must be 'inproc', 'proc' or None (per-spec), "
+                f"got {transport!r}")
+        self.transport = transport
+        self.live_migration = bool(live_migration)
+        self.heartbeat_dead_after = float(heartbeat_dead_after)
+        self.page_service = bool(page_service)
 
 
 class FleetRouter:
@@ -351,11 +461,16 @@ class FleetRouter:
             raise ValueError(f"duplicate replica names: {names}")
         self.config = config or FleetConfig()
         self.metrics = metrics or FleetMetrics()
-        self._replicas = {s.name: _Replica(s, self.config.start)
-                          for s in specs}
+        self._page_index = FleetPrefixIndex()
+        self._replicas = {
+            s.name: _Replica(
+                s, self.config.start,
+                self.config.transport or s.transport,
+                on_death=self._on_transport_death)
+            for s in specs}
         block = self.config.affinity_block_tokens
         if block is None:
-            block = min(r.engine.config.page_size
+            block = min(r._describe["page_size"]
                         for r in self._replicas.values())
         self._block = int(block)
         self._sessions = {}          # session id -> replica name
@@ -378,10 +493,66 @@ class FleetRouter:
         return [r for r in self._replicas.values()
                 if r.accepting and r.can_fit(prompt_len, max_new)]
 
-    def _ladder(self, session, key, candidates):
+    def _pull_prefix_deltas(self):
+        """Ingest every live replica's register/evict deltas into the
+        fleet prefix index — the measured bookkeeping that replaced
+        the CRC guess.  Subprocess replicas accumulate deltas from
+        heartbeat frames (no RPC here); inproc replicas drain their
+        cache log directly."""
+        for rep in self._replicas.values():
+            if rep.state in ("stopped", "dead"):
+                continue
+            try:
+                deltas = rep.transport.take_prefix_deltas()
+            except ServingError:
+                continue
+            if deltas:
+                self._page_index.apply(rep.name, deltas)
+
+    def _index_lookup(self, prompt):
+        """Deepest measured chain for `prompt` across the fleet's
+        page-size MENU: each replica's cache hashes chains with its
+        OWN page_size, so one lookup per distinct size — filtered to
+        the replicas that hash that way — keeps a heterogeneous fleet
+        (or an affinity_block_tokens override) fully visible to the
+        index instead of silently matching only the min-page-size
+        replicas.  Deepest matched-token count wins."""
+        sizes = {}
+        for r in self._replicas.values():
+            if r.state in ("stopped", "dead"):
+                continue
+            sizes.setdefault(r._describe["page_size"],
+                             set()).add(r.name)
+        best = None
+        for ps, names in sizes.items():
+            hit = self._page_index.lookup(prompt, ps, names=names)
+            if hit is not None and (best is None or hit[1] > best[1]):
+                best = hit
+        return best
+
+    def _reap_stale_heartbeats(self):
+        """Declare replicas whose heartbeat aged past the threshold
+        dead (a HUNG process; a crashed one is caught instantly by the
+        reader's socket EOF) and remigrate their in-flight ledgers.
+        Called outside the routing lock; inproc replicas never age."""
+        stale = [r for r in self._replicas.values()
+                 if r.state == "serving" and r.transport.alive()
+                 and r.transport.heartbeat_age()
+                 > self.config.heartbeat_dead_after]
+        for rep in stale:
+            kill = getattr(rep.transport, "kill", None)
+            if kill is not None:
+                kill()
+            self._handle_death(rep.transport)
+
+    def _ladder(self, session, key, candidates, holder=None):
         """The ordered (rung, replica) preference list.  Position 0 is
         the ROUTE; everything after it is the spill path (remaining
-        candidates, least loaded first)."""
+        candidates, least loaded first).  The prefix rung prefers the
+        replica the FLEET INDEX measured as holding the prompt's
+        deepest cached chain (`holder`); prompts no index entry covers
+        fall back to the stable-hash guess, which keeps cold traffic
+        converging on one replica so its index warms."""
         if self.config.routing == "random":
             order = list(candidates)
             self._rng.shuffle(order)
@@ -402,7 +573,11 @@ class FleetRouter:
         cand_names = {r.name: r for r in candidates}
         if session is not None:
             push("affinity", cand_names.get(self._sessions.get(session)))
-        if key is not None and len(candidates) > 0:
+        if holder is not None and holder in cand_names:
+            # measured: the fleet index says this replica's prefix
+            # index actually holds the prompt's leading pages
+            push("prefix", cand_names[holder])
+        elif key is not None and len(candidates) > 0:
             # stateless hash preference over the STABLE name order, so
             # every request carrying the same leading tokens converges
             # on one replica — whose index then actually holds the
@@ -436,6 +611,7 @@ class FleetRouter:
         (shed — every candidate's gate closed) or RequestTooLargeError
         (no candidate could EVER hold it) synchronously."""
         prompt = list(prompt)
+        self._reap_stale_heartbeats()
         with self._lock:
             if self._closed:
                 raise ServingError("fleet router is shut down")
@@ -451,16 +627,31 @@ class FleetRouter:
                 raise ServingError(
                     "no accepting replica (fleet drained or shut down)")
             key = self._prefix_key(prompt)
-            prefs = self._ladder(session, key, candidates)
+            lookup = None
+            if self.config.routing == "affinity" \
+                    and self.config.page_service:
+                self._pull_prefix_deltas()
+                lookup = self._index_lookup(prompt)
+            prefs = self._ladder(session, key, candidates,
+                                 holder=lookup[0] if lookup else None)
             last_busy = None
+            adoption_tried = False
             for i, (rung, rep) in enumerate(prefs):
+                if not adoption_tried:
+                    # hit-elsewhere: the fleet index says a DIFFERENT
+                    # replica holds this prompt's warm pages — move the
+                    # bytes point-to-point so this replica adopts a run
+                    # it never prefilled, BEFORE admission matches
+                    adoption_tried = self._maybe_adopt_pages(
+                        prompt, rep, lookup)
                 try:
-                    rep.engine.submit(prompt, handle=handle, **kwargs)
+                    rep.transport.submit(prompt, kwargs, handle)
                 except ServerBusyError as e:
                     last_busy = e
                     continue
-                except RequestTooLargeError:
-                    continue   # per-replica edge the pre-filter missed
+                except (RequestTooLargeError, ServingError):
+                    continue   # per-replica edge the pre-filter missed,
+                    # or a transport that died under the submit
                 if i == 0:
                     self.metrics.count_routed(rung)
                 else:
@@ -512,6 +703,11 @@ class FleetRouter:
         (whose pools hold the conversation's warm pages); without it,
         routing falls to prefix affinity, then least-loaded."""
         handle = GenerationHandle()
+        # materialize default sampling HERE, not in the replica engine:
+        # the params' recorded seed is what makes every later migration
+        # (drain resubmit, crash remigration, live-migration cold
+        # fallback) replay the identical stream
+        sampling = sampling if sampling is not None else SamplingParams()
         handle, _ = self._route_and_submit(
             prompt,
             dict(max_new_tokens=max_new_tokens, sampling=sampling,
@@ -529,28 +725,33 @@ class FleetRouter:
         return self._sessions.get(handle_or_session)
 
     # ------------------------- drain / restart ----------------------
-    def drain(self, name, migrate=True, timeout=60.0):
+    def drain(self, name, migrate=True, timeout=60.0, live=None):
         """Take replica `name` out of service: stop admissions, move
-        its unfinished work to siblings, join the worker.
+        its unfinished work to siblings, join the worker (or reap the
+        process).
 
         Queued (never-admitted) requests ALWAYS migrate — as cold
         resubmits with their original seeded sampling, so their streams
         are untouched.  With `migrate=True` (default) live slot-holders
-        preempt-migrate too: their prompt is resubmitted cold on a
-        sibling and a relay skips the tokens the client already
-        received — seeded sampling replays the identical stream, so the
-        client sees one continuous stream (the mid-stream-drain half of
-        the fleet oracle).  With `migrate=False` residents finish on
-        the draining replica first — but a resident that outlives
-        `timeout` is preempt-migrated anyway (seeded sampling keeps the
-        replay identical), so a drain always CONVERGES to "stopped"
-        instead of wedging the replica in a half-drained state no later
-        drain() or restart() could touch.  A migrated request that
-        finds every sibling's gate closed resolves its handle with the
-        typed ServerBusyError (counted in fleet.shed_total — the
-        draining gate is administratively closed, so every gate really
-        was closed).  Sessions pinned here unpin; their next turn
-        re-routes and re-pins."""
+        move too — as TRUE LIVE MIGRATIONS when `live`
+        (FleetConfig.live_migration default): their resident state
+        (page bytes, page table, position, sampling RNG, delivered
+        count) ships to a sibling that RESUMES the decode mid-stream,
+        so a 10k-token stream moves without replaying a single token
+        (fleet.migrated_replay_tokens stays 0).  When a sibling cannot
+        adopt (no slot, pool pressure, incompatible layout) — or with
+        live=False, the ablation baseline — the request falls back to
+        the COLD RESUBMIT ladder: seeded sampling replays the
+        identical stream and a relay skips the tokens the client
+        already received (counted into migrated_replay_tokens).  With
+        `migrate=False` residents finish on the draining replica
+        first — but a resident that outlives `timeout` is evacuated
+        anyway, so a drain always CONVERGES to "stopped" instead of
+        wedging the replica in a half-drained state.  A migrated
+        request that finds every sibling's gate closed resolves its
+        handle with the typed ServerBusyError (counted in
+        fleet.shed_total).  Sessions pinned here unpin; the fleet
+        prefix index forgets everything this replica held."""
         with self._lock:
             rep = self._replicas.get(name)
             if rep is None:
@@ -562,33 +763,56 @@ class FleetRouter:
             for sess in [s for s, n in self._sessions.items()
                          if n == name]:
                 del self._sessions[sess]
-        moved = rep.engine.evacuate(include_active=migrate)
-        for req, emitted in moved:
+        if live is None:
+            live = self.config.live_migration
+        try:
+            cold, live_snaps = rep.transport.drain(
+                migrate=migrate, live=live, timeout=timeout)
+        except ServingError:
+            # the replica died mid-drain: its in-flight ledger already
+            # remigrated through the death path
+            cold, live_snaps = [], []
+        for snap in live_snaps:
+            self._migrate_live(snap, exclude=name)
+        for req, emitted in cold:
             self._migrate(req, emitted, exclude=name)
-        self.metrics.count_migrated(len(moved))
-        deadline = time.monotonic() + float(timeout)
-        eng = rep.engine
-        while eng.scheduler.active() or eng.scheduler.pending_count():
-            if time.monotonic() > deadline:
-                # stragglers outlived the drain budget: preempt-migrate
-                # them (replay stays identical) rather than raising with
-                # the replica wedged in 'draining' — a state no later
-                # drain() or restart() could recover
-                leftover = eng.evacuate(include_active=True)
-                for req, emitted in leftover:
-                    self._migrate(req, emitted, exclude=name)
-                self.metrics.count_migrated(len(leftover))
-                break
-            if eng._thread is not None and eng._thread.is_alive():
-                time.sleep(0.005)
-            else:
-                eng.step()   # stepped mode: the drain drives residents
-        eng.shutdown()
+        self.metrics.count_migrated(len(cold) + len(live_snaps))
+        self._page_index.drop_replica(name)
         rep.state = "stopped"
+
+    def _migrate_live(self, snap, exclude):
+        """Place one exported resident on a sibling that RESUMES its
+        decode (zero replayed tokens); falls back to the cold-resubmit
+        ladder when no sibling can adopt it right now."""
+        handle = snap.get("future")
+        remaining = max(1, snap["max_new_tokens"] - snap["n_generated"])
+        with self._lock:
+            cands = sorted(
+                (r for r in self._replicas.values()
+                 if r.accepting and r.name != exclude
+                 and r.can_fit(len(snap["tokens"]), remaining)),
+                key=lambda r: r.load())
+        for rep in cands:
+            try:
+                if rep.transport.import_sequence(snap):
+                    self.metrics.count_live_migrated()
+                    return
+            except ServingError:
+                continue
+        # cold fallback: seeded sampling replays the identical stream,
+        # the relay swallows what the client already saw
+        req = GenerationRequest(
+            snap["prompt"], handle, snap["sampling"],
+            max_new_tokens=snap["max_new_tokens"],
+            stop_tokens=snap["stop_tokens"],
+            deadline=snap.get("deadline"))
+        self._migrate(req, snap["n_generated"], exclude=exclude)
 
     def _migrate(self, req, emitted, exclude):
         """Cold-resubmit one evacuated request on a sibling, preserving
-        the client's handle and stream position."""
+        the client's handle and stream position.  The skipped replay
+        is the live-migration A/B's accounting: every token the relay
+        swallows lands in fleet.migrated_replay_tokens."""
         handle = req.future
         if isinstance(handle, _MigrationRelay):   # second migration
             client, delivered = handle.client_and_delivered()
@@ -596,6 +820,7 @@ class FleetRouter:
             client, delivered = handle, int(emitted)
         engine_handle = (_MigrationRelay(client, delivered)
                          if delivered else client)
+        self.metrics.count_replay_tokens(delivered)
         timeout_ms = None
         if req.deadline is not None:
             timeout_ms = max(0.0,
@@ -612,38 +837,136 @@ class FleetRouter:
             # client holds the handle, so the error lands there
             client.set_exception(e)
 
+    def _maybe_adopt_pages(self, prompt, rep, lookup):
+        """The page service's byte-moving half: when the fleet index
+        measured a DIFFERENT replica as holding this prompt's warm
+        prefix run, export it there and import it here so `rep` serves
+        the request warm from a run it never prefilled.  Returns True
+        when a transfer was attempted (success or not — one attempt
+        per request), False when not applicable.
+
+        Runs under the routing lock, so a transfer (two RPCs carrying
+        the run's page bytes) briefly serializes admission — fine at
+        this scale; asynchronous adoption (ship after routing, warm
+        the NEXT request instead) is flagged ROADMAP residue for
+        multi-MB production runs."""
+        if lookup is None:
+            return False
+        holder_name, _depth, chain = lookup
+        if holder_name == rep.name \
+                or rep.name in self._page_index.holders_of(chain):
+            return False
+        src = self._replicas.get(holder_name)
+        if src is None or src.state != "serving" \
+                or not src.transport.alive():
+            return False
+        if src._describe["page_size"] != rep._describe["page_size"]:
+            # pages only move between layout-compatible pools; the
+            # importer would reject the payload anyway, so skip the
+            # export round-trip entirely
+            return False
+        try:
+            payload = src.transport.export_prefix(prompt)
+            if not payload:
+                return True   # evicted since the last delta pull
+            added = rep.transport.import_prefix(payload)
+        except ServingError:
+            return True
+        if added:
+            self.metrics.count_page_adoption(added)
+            # eager index update (the importer's own delta confirms on
+            # the next pull): back-to-back requests must not re-ship
+            self._page_index.apply(rep.name, [("add", chain)])
+        return True
+
+    def _handle_death(self, transport):
+        """Crash path: mark the replica dead, count it, forget its
+        index entries, unpin its sessions, and remigrate its in-flight
+        ledger — queued work resubmits on siblings, mid-stream work
+        resumes via relay replay; anything with nowhere to go resolves
+        with the typed shed.  Streams never hang on a dead process.
+        Fired by the transport reader thread on socket EOF and by the
+        stale-heartbeat reaper; idempotent per replica generation."""
+        rep = next((r for r in self._replicas.values()
+                    if r.transport is transport), None)
+        if rep is None:
+            return
+        with self._lock:
+            if rep.state != "serving":
+                return
+            rep.state = "dead"
+            for sess in [s for s, n in self._sessions.items()
+                         if n == rep.name]:
+                del self._sessions[sess]
+        self.metrics.count_replica_dead()
+        self._page_index.drop_replica(rep.name)
+        for entry in transport.take_inflight():
+            self._remigrate_entry(entry, exclude=rep.name)
+
+    def _on_transport_death(self, transport):
+        self._handle_death(transport)
+
+    def _remigrate_entry(self, entry, exclude):
+        """Resubmit one in-flight-ledger entry from a dead replica:
+        the client handle survives parent-side, seeded sampling
+        replays, a relay skips the delivered tokens."""
+        handle = entry["handle"]
+        if isinstance(handle, _MigrationRelay):
+            client, delivered = handle.client_and_delivered()
+        else:
+            client, delivered = handle, int(entry["emitted"])
+        engine_handle = (_MigrationRelay(client, delivered)
+                         if delivered else client)
+        self.metrics.count_replay_tokens(delivered)
+        kwargs = dict(entry["kwargs"])
+        if entry.get("deadline") is not None:
+            kwargs["timeout_ms"] = max(
+                0.0, (entry["deadline"] - time.monotonic()) * 1e3)
+        migrated = False
+        try:
+            self._route_and_submit(entry["prompt"], kwargs,
+                                   engine_handle, session=None,
+                                   exclude=exclude)
+            migrated = True
+        except ServingError as e:
+            client.set_exception(e)
+        if migrated:
+            self.metrics.count_migrated()
+
     def restart(self, name):
-        """Bring a drained replica back: a FRESH engine from its spec —
-        new pools, empty prefix index, empty queue.  Prefix-affinity
-        bets against the old index self-correct through the
-        confirmation loop (first request misses, seeds, re-warms)."""
+        """Bring a drained (or dead) replica back: a FRESH engine from
+        its spec — new pools, empty prefix index, empty queue, and for
+        subprocess replicas a new OS process.  Prefix-affinity bets
+        against the old index self-correct through the confirmation
+        loop (first request misses, seeds, re-warms) AND through the
+        fleet index, which forgot the old replica at drain/death."""
         with self._lock:
             rep = self._replicas.get(name)
             if rep is None:
                 raise KeyError(f"unknown replica {name!r}")
-            if rep.state != "stopped":
+            if rep.state not in ("stopped", "dead"):
                 raise ServingError(
                     f"replica {name!r} is {rep.state}; drain it first")
+            if rep.state == "dead":
+                rep.transport.stop()   # reap the corpse
             rep.build(self.config.start)
 
     # --------------------------- lifecycle --------------------------
     def run_until_idle(self, max_steps=100000):
         """Drive every live replica until queues and slots drain —
-        stepped replicas are stepped here (tests/benchmarks); replicas
-        with background workers are simply waited on."""
+        stepped inproc replicas are stepped here (tests/benchmarks);
+        replicas with background workers (and subprocess replicas,
+        which always step themselves) are simply waited on."""
         steps = 0
         while True:
             busy = False
             for rep in self._replicas.values():
-                if rep.state == "stopped":
+                if rep.state in ("stopped", "dead"):
                     continue
-                eng = rep.engine
-                if eng.scheduler.active() or eng.scheduler.pending_count():
+                t = rep.transport
+                if not t.idle():
                     busy = True
-                    if eng._thread is not None and eng._thread.is_alive():
-                        time.sleep(0.002)
-                    else:
-                        eng.step()
+                    t.pump()
             if not busy:
                 return steps
             steps += 1
@@ -654,33 +977,52 @@ class FleetRouter:
     def stats_snapshot(self):
         """Fleet-level capacity-planning export: every replica's
         generation.* snapshot + live cache stats keyed by replica name,
-        plus the fleet.* routing/shed counters and per-replica queue-
-        depth gauges (refreshed here)."""
+        plus the fleet.* routing/shed/migration counters, per-replica
+        queue-depth gauges, and the heartbeat-age liveness gauges
+        (schema-complete from the first snapshot: 0.0 for inproc
+        transports, whose liveness is this process's)."""
+        self._reap_stale_heartbeats()
+        with self._lock:
+            self._pull_prefix_deltas()
         replicas = {}
         depths = []
+        ages = []
         for name, rep in self._replicas.items():
-            if rep.state == "stopped":
-                # a stopped replica queues nothing: zero its gauge so a
-                # dashboard never shows pre-drain depth on a dead slot
+            if rep.state in ("stopped", "dead"):
+                # a stopped replica queues nothing: zero its gauges so
+                # a dashboard never shows pre-drain depth on a dead slot
                 self.metrics.set_replica_queue_depth(name, 0)
+                self.metrics.set_heartbeat_age(name, 0.0)
                 replicas[name] = {"state": rep.state}
                 continue
+            age = rep.transport.heartbeat_age()
+            ages.append(age)
+            self.metrics.set_heartbeat_age(name, age)
             depth = rep.queue_depth()
             depths.append(depth)
             self.metrics.set_replica_queue_depth(name, depth)
+            info = rep.transport.load_info()
+            try:
+                stats = rep.transport.stats()
+            except ServingError:
+                stats = {}
             replicas[name] = {
                 "state": rep.state,
+                "transport": rep.kind,
                 "queue_depth": depth,
-                "active": len(rep.engine.scheduler.active()),
+                "active": info["active"],
                 "load": round(rep.load(), 3),
                 "ttft_ewma_s": (None if rep.ttft_ewma is None
                                 else round(rep.ttft_ewma, 4)),
-                "generation":
-                    rep.registry.stats_snapshot("generation.")["stats"],
-                "cache": rep.engine.cache.stats(),
+                "heartbeat_age_s": round(age, 3),
+                "generation": stats.get("generation", {}),
+                "cache": stats.get("cache", {}),
             }
         self.metrics.set_max_queue_depth(max(depths, default=0))
-        return {"fleet": self.metrics.snapshot(), "replicas": replicas}
+        self.metrics.set_max_heartbeat_age(max(ages, default=0.0))
+        return {"fleet": self.metrics.snapshot(),
+                "prefix_index_chains": self._page_index.chains_held(),
+                "replicas": replicas}
 
     def shutdown(self):
         """Stop every replica (typed rejection for anything queued)."""
@@ -690,7 +1032,7 @@ class FleetRouter:
             self._closed = True
         for rep in self._replicas.values():
             if rep.state != "stopped":
-                rep.engine.shutdown()
+                rep.transport.stop()
                 rep.state = "stopped"
 
     def __enter__(self):

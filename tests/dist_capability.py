@@ -38,6 +38,46 @@ def _free_port():
     return port
 
 
+_SUBPROC_RESULT = None
+
+SUBPROC_SKIP_REASON = ("environment cannot spawn socketpair-connected "
+                       "subprocesses (probed: fd-inheriting child "
+                       "echo failed — sandboxed or fork-less host)")
+
+
+def subprocess_replicas_available(timeout=30.0):
+    """True iff this host can run SubprocTransport replicas: spawn a
+    python child with an inherited UNIX socketpair fd and talk over
+    it.  Same probe-once-per-process pattern as the collectives probe
+    below — the disagg tests skip fast and clean where fork/sockets
+    are unavailable, with a cheap echo child (never a full jax
+    import) paying the probe."""
+    global _SUBPROC_RESULT
+    if _SUBPROC_RESULT is not None:
+        return _SUBPROC_RESULT
+    ok = False
+    try:
+        parent, child = socket.socketpair()
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import socket, sys; "
+                 "s = socket.socket(fileno=int(sys.argv[1])); "
+                 "s.sendall(b'SUBPROC_OK'); s.close()",
+                 str(child.fileno())],
+                pass_fds=(child.fileno(),))
+            child.close()
+            parent.settimeout(timeout)
+            ok = parent.recv(16) == b"SUBPROC_OK"
+            proc.wait(timeout=timeout)
+        finally:
+            parent.close()
+    except Exception:
+        ok = False
+    _SUBPROC_RESULT = ok
+    return ok
+
+
 def multiprocess_collectives_available(timeout=90.0):
     """True iff a 2-process jax.distributed psum actually executes on
     this backend.  Probed at most once per process (both dist test

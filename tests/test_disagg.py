@@ -1,0 +1,687 @@
+"""Disaggregated fleet (serving/disagg): process-per-replica serving,
+the fleet-level KV page service, and live page migration.
+
+Acceptance oracles (ISSUE 12):
+
+1. TOKEN IDENTITY ACROSS THE PROCESS BOUNDARY: the same seeded
+   workload through SubprocTransport replicas — greedy and seeded
+   stochastic, including a mid-stream drain — is token-identical to
+   the inproc single-replica cold run, with live migration resuming
+   decode on the sibling at ``migrated_replay_tokens == 0`` and a
+   gap/dupe-free client stream.
+2. PAGE SERVICE: a warm prefix registered on replica A is adopted by
+   replica B via export/import page transfer (B never prefilled it),
+   hit confirmed in fleet counters; export/import roundtrips are
+   BITWISE across both pool layouts x bf16 x the forced 4-device CPU
+   mesh, and an imported shared run is read-only with clean COW /
+   refcount behavior.
+3. CRASH DISCIPLINE: killing a subprocess replica remigrates its
+   queued work and resolves in-flight streams typed (migrated or
+   shed) — never hung — with heartbeat/death metrics recording it.
+
+Subprocess tests reuse the dist_capability probe pattern: they skip
+fast and clean where fd-inheriting subprocesses are unavailable, and
+use stepped-mode tiny models elsewhere to stay inside the tier-1 wall
+budget.
+"""
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation.kv_cache import (DeviceKVPool, OutOfPagesError,
+                                            PagedKVCache)
+from paddle_tpu.parallel import tp_mesh
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.admission import ServingError
+from paddle_tpu.serving.disagg.page_service import (FleetPrefixIndex,
+                                                    page_chain_hashes)
+from paddle_tpu.serving.disagg.rpc import (ChannelClosed, recv_frame,
+                                           send_frame)
+from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                      ReplicaSpec)
+
+from dist_capability import (SUBPROC_SKIP_REASON,  # noqa: E402
+                             subprocess_replicas_available)
+from gen_oracle import greedy_oracle as _ref  # noqa: E402
+
+needs_subproc = pytest.mark.skipif(
+    not subprocess_replicas_available(), reason=SUBPROC_SKIP_REASON)
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]   # 3 full pages @ ps=4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # same signature as the fleet/prefix suites: the process-wide
+    # greedy_oracle memo shares reference streams across all three
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _cfg(**kw):
+    base = dict(max_decode_slots=4, num_pages=64, page_size=4,
+                prefix_cache=True)
+    base.update(kw)
+    return gen.GenerationConfig(**base)
+
+
+def _fleet(model, n=2, transport="inproc", cfgs=None, start=False,
+           **fleet_kw):
+    cfgs = cfgs or [_cfg() for _ in range(n)]
+    specs = [ReplicaSpec(f"d{i}", model, c, transport=transport)
+             for i, c in enumerate(cfgs)]
+    return FleetRouter(specs, FleetConfig(start=start, seed=0,
+                                          **fleet_kw))
+
+
+def _stat(name):
+    return StatRegistry.instance().get_stat(name).get()
+
+
+def _stoch_ref(model, prompt, n, seed):
+    """Seeded-stochastic cold single-engine reference stream."""
+    eng = gen.GenerationEngine(model, _cfg(), start=False)
+    h = eng.submit(prompt, max_new_tokens=n,
+                   sampling=gen.SamplingParams(temperature=0.9,
+                                               top_k=10, seed=seed))
+    eng.run_until_idle()
+    out = h.result(timeout=5).token_ids
+    eng.shutdown()
+    return out
+
+
+def _requests_per_replica(fl):
+    snap = fl.stats_snapshot()
+    return {n: r.get("generation", {}).get("generation.requests_total", 0)
+            for n, r in snap["replicas"].items() if "generation" in r}
+
+
+# ----------------------------- rpc framing -------------------------------
+
+
+def test_rpc_frame_roundtrip_and_eof():
+    """The wire codec: arbitrary picklable payloads (numpy arrays
+    included) roundtrip frame-exact; a closed peer reads as the typed
+    ChannelClosed, the crash-detection signal."""
+    a, b = socket.socketpair()
+    payload = {"op": "x", "arr": np.arange(12, dtype=np.float32),
+               "nested": [(1, "two"), {"three": 3}]}
+    send_frame(a, payload)
+    send_frame(a, {"second": True})
+    got = recv_frame(b)
+    assert np.array_equal(got["arr"], payload["arr"])
+    assert got["nested"] == payload["nested"]
+    assert recv_frame(b) == {"second": True}
+    a.close()
+    with pytest.raises(ChannelClosed):
+        recv_frame(b)
+    b.close()
+
+
+# ------------------------ chain hashes / fleet index ---------------------
+
+
+def test_chain_hashes_match_cache_register_deltas(model):
+    """The register/evict deltas a cache emits use EXACTLY the chain
+    hashes page_chain_hashes computes from raw tokens — the identity
+    the router's lookup depends on."""
+    cache = PagedKVCache(2, 2, 8, num_pages=16, page_size=4)
+    cache.enable_prefix_deltas()
+    cache.allocate("s")
+    k = np.zeros((2, len(SYSTEM), 2, 8), np.float32)
+    cache.append_prefill("s", k, k)
+    cache.register_prefix("s", SYSTEM)
+    deltas = cache.take_prefix_deltas()
+    expect = page_chain_hashes(SYSTEM, 4)
+    assert deltas == [("add", h) for h in expect]
+    assert cache.take_prefix_deltas() == []          # drained
+    cache.free("s")
+    flushed = cache.flush_prefix_cache()
+    assert flushed == 3
+    drops = cache.take_prefix_deltas()
+    assert sorted(h for op, h in drops if op == "drop") == sorted(expect)
+
+
+def test_fleet_prefix_index_lookup_deepest_and_drop():
+    idx = FleetPrefixIndex()
+    hashes = page_chain_hashes(SYSTEM, 4)
+    idx.apply("a", [("add", h) for h in hashes[:2]])
+    idx.apply("b", [("add", hashes[0])])
+    # deepest chain wins; holder filter respects candidates
+    name, depth, chain = idx.lookup(SYSTEM + [7], 4)
+    assert (name, depth, chain) == ("a", 8, hashes[1])
+    name, depth, _ = idx.lookup(SYSTEM + [7], 4, names={"b"})
+    assert (name, depth) == ("b", 4)
+    assert idx.holders_of(hashes[0]) == {"a", "b"}
+    # eviction delta removes one holder; drop_replica the rest
+    idx.apply("a", [("drop", hashes[1])])
+    assert idx.lookup(SYSTEM + [7], 4)[1] == 4
+    idx.drop_replica("a")
+    assert idx.holders_of(hashes[0]) == {"b"}
+    idx.drop_replica("b")
+    assert idx.lookup(SYSTEM + [7], 4) is None
+    assert idx.chains_held() == 0
+
+
+# ------------------------ page export / import ---------------------------
+
+
+def _filled_pool(cls, layout, dtype, tokens=11, heads=2, **kw):
+    """A pool of `cls` holding one sequence of `tokens` deterministic
+    K/V rows."""
+    kwargs = dict(num_pages=8, page_size=4, dtype=dtype)
+    if cls is DeviceKVPool:
+        kwargs["pool_layout"] = layout
+    kwargs.update(kw)
+    pool = cls(2, heads, 8, **kwargs)
+    rng = np.random.default_rng(5)
+    k = rng.standard_normal((2, tokens, heads, 8)).astype(np.float32)
+    v = rng.standard_normal((2, tokens, heads, 8)).astype(np.float32)
+    pool.allocate("src")
+    pool.append_prefill("src", k, v)
+    return pool
+
+
+@pytest.mark.parametrize("src_layout,dst_layout", [
+    ("token", "kernel"), ("kernel", "token"), ("kernel", "kernel")])
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+def test_export_import_roundtrip_bitwise(src_layout, dst_layout, dtype):
+    """Page bytes survive export -> import BITWISE across pool layouts
+    and dtypes: the gathered prefix of the importer equals the
+    exporter's row for row (the live-migration exactness anchor)."""
+    dtype = np.dtype(dtype)
+    src = _filled_pool(DeviceKVPool, src_layout, dtype)
+    k, v = src.export_pages(src.page_table("src"))
+    assert k.dtype == dtype and k.shape == (2, 3, 4, 2, 8)
+    dst = _filled_pool(DeviceKVPool, dst_layout, dtype, tokens=2)
+    pages = dst.import_pages(k, v)
+    dst.allocate("imp")
+    dst.adopt_imported("imp", pages, 11)
+    for layer in range(2):
+        sk, sv = src.gather_prefix("src", layer, 11)
+        dk, dv = dst.gather_prefix("imp", layer, 11)
+        assert np.array_equal(np.asarray(sk), np.asarray(dk))
+        assert np.array_equal(np.asarray(sv), np.asarray(dv))
+
+
+def test_export_import_roundtrip_host_to_device():
+    """The host numpy backend speaks the same canonical payload as the
+    device pools — a heterogeneous fleet can trade pages."""
+    src = _filled_pool(PagedKVCache, None, np.float32)
+    k, v = src.export_pages(src.page_table("src"))
+    dst = _filled_pool(DeviceKVPool, "kernel", np.float32, tokens=1)
+    pages = dst.import_pages(k, v)
+    dst.allocate("imp")
+    dst.adopt_imported("imp", pages, 11)
+    sk, _ = src.gather_prefix("src", 1, 11)
+    dk, _ = dst.gather_prefix("imp", 1, 11)
+    assert np.array_equal(np.asarray(sk), np.asarray(dk))
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_export_import_roundtrip_sharded_mesh(layout):
+    """Across the forced 4-device CPU mesh: export gathers the
+    per-shard head splits into the canonical full-head payload, import
+    re-scatters it with the kv_pool_spec sharding pinned — bitwise vs
+    the unsharded pool, and the imported pool keeps its
+    NamedSharding."""
+    mesh = tp_mesh(4)
+    plain = _filled_pool(DeviceKVPool, layout, np.float32, heads=4)
+    sharded = _filled_pool(DeviceKVPool, layout, np.float32, heads=4,
+                           mesh=mesh, tp_axis="model")
+    ks, vs = sharded.export_pages(sharded.page_table("src"))
+    kp, vp = plain.export_pages(plain.page_table("src"))
+    assert np.array_equal(ks, kp) and np.array_equal(vs, vp)
+    dst = DeviceKVPool(2, 4, 8, num_pages=8, page_size=4,
+                       pool_layout=layout, mesh=mesh, tp_axis="model")
+    pages = dst.import_pages(ks, vs)
+    dst.allocate("imp")
+    dst.adopt_imported("imp", pages, 11)
+    dk, dv = dst.gather_prefix("imp", 0, 11)
+    sk, sv = plain.gather_prefix("src", 0, 11)
+    assert np.array_equal(np.asarray(dk), np.asarray(sk))
+    assert np.array_equal(np.asarray(dv), np.asarray(sv))
+    # the donated import kept the pools in their NamedSharding
+    assert dst._k[0].sharding.is_equivalent_to(dst.pool_sharding,
+                                               dst._k[0].ndim)
+
+
+def test_import_pages_evicts_cached_runs_then_raises():
+    """Import relieves pool pressure by evicting refcount-0 cached
+    runs (LRU) like reserve does; a payload the pool cannot hold even
+    then is the typed OutOfPagesError — and nothing leaks."""
+    pool = PagedKVCache(1, 1, 2, num_pages=4, page_size=2)
+    pool.allocate("warm")
+    k = np.zeros((1, 8, 1, 2), np.float32)
+    pool.append_prefill("warm", k, k)
+    pool.register_prefix("warm", list(range(8)))
+    pool.free("warm")                      # 4 cached resident pages
+    assert pool.num_free_pages == 0 and pool.prefix_cached_pages == 4
+    payload_k = np.ones((1, 3, 2, 1, 2), np.float32)
+    pages = pool.import_pages(payload_k, payload_k)   # evicts 3
+    assert len(pages) == 3
+    too_big = np.ones((1, 5, 2, 1, 2), np.float32)
+    with pytest.raises(OutOfPagesError):
+        pool.import_pages(too_big, too_big)
+    assert pool.num_free_pages + pool.pages_in_use == pool.num_pages
+
+
+def test_imported_prefix_run_is_read_only_with_clean_refcounts(model):
+    """The COW/refcount satellite: an imported shared run is adopted
+    READ-ONLY (divergent writes copy-on-write, direct writes into the
+    shared page are the loud guard error), and decrefs cleanly — after
+    draining every adopter and flushing, the pool is all-free."""
+    src = gen.GenerationEngine(model, _cfg(), start=False)
+    h = src.submit(SYSTEM + [7], max_new_tokens=2)
+    src.run_until_idle()
+    h.result(timeout=5)
+    payload = src.export_prefix_pages(SYSTEM + [9])
+    assert payload is not None and payload["k"].shape[1] == 3
+    dst = gen.GenerationEngine(model, _cfg(), start=False)
+    assert dst.import_prefix_pages(payload) == 3
+    cache = dst.cache
+    # two adopters alias the imported run -> pages shared, read-only
+    ha = dst.submit(SYSTEM + [9], max_new_tokens=2)
+    hb = dst.submit(SYSTEM + [1, 1], max_new_tokens=2)
+    dst.run_until_idle()
+    assert ha.prefix_hit_tokens == len(SYSTEM) == hb.prefix_hit_tokens
+    assert ha.result(timeout=5).token_ids == _ref(model, SYSTEM + [9], 2)
+    assert hb.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [1, 1], 2)
+    # direct write into an indexed page is the loud COW-miss guard
+    imported_page = cache.match_prefix_full(SYSTEM)[0][0]
+    cache.allocate("probe")
+    cache._tables["probe"] = [imported_page]
+    cache._lens["probe"] = 1
+    with pytest.raises(RuntimeError, match="copy-on-write"):
+        cache._locate("probe", 0)
+    del cache._tables["probe"], cache._lens["probe"]
+    # refcount-leak invariant: drained + flushed == all free
+    cache.flush_prefix_cache()
+    assert cache.pages_in_use == 0
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_duplicate_prefix_import_frees_pages(model):
+    """First writer wins: importing a run whose chains are already
+    indexed returns 0 new pages and gives every duplicate page back."""
+    src = gen.GenerationEngine(model, _cfg(), start=False)
+    h = src.submit(SYSTEM + [7], max_new_tokens=2)
+    src.run_until_idle()
+    h.result(timeout=5)
+    payload = src.export_prefix_pages(SYSTEM + [9])
+    dst = gen.GenerationEngine(model, _cfg(), start=False)
+    assert dst.import_prefix_pages(payload) == 3
+    in_use = dst.cache.pages_in_use
+    assert dst.import_prefix_pages(payload) == 0     # duplicate
+    assert dst.cache.pages_in_use == in_use          # nothing leaked
+    src.shutdown()
+    dst.shutdown()
+
+
+# ------------------------ engine live migration --------------------------
+
+
+def test_engine_live_migration_resumes_mid_decode(model):
+    """The engine-level migration oracle: a mid-decode resident
+    exported from A and imported into B resumes EXACTLY where it
+    left off — greedy and seeded stochastic streams both equal the
+    uninterrupted cold reference, with zero re-prefill on B."""
+    p = SYSTEM + [7, 7]
+    sp = gen.SamplingParams(temperature=0.9, top_k=10, seed=123)
+    a = gen.GenerationEngine(model, _cfg(), start=False)
+    hg = a.submit(p, max_new_tokens=10)
+    hs = a.submit(SYSTEM + [1], max_new_tokens=10, sampling=sp)
+    for _ in range(6):
+        a.step()
+    assert all(s.n_generated > 0 for s in a.scheduler.active())
+    cold, live = a.evacuate_for_migration()
+    assert cold == [] and len(live) == 2
+    from paddle_tpu.generation.metrics import GenerationMetrics
+
+    breg = StatRegistry()   # B's own registry: the global one carries
+    # every other engine's counters in this process
+    b = gen.GenerationEngine(model, _cfg(),
+                             metrics=GenerationMetrics(registry=breg),
+                             start=False)
+    for snap in live:
+        assert b.import_sequence(snap)
+    b.run_until_idle()
+    assert hg.result(timeout=5).token_ids == _ref(model, p, 10)
+    assert hs.result(timeout=5).token_ids == \
+        _stoch_ref(model, SYSTEM + [1], 10, 123)
+    # B never prefilled: the import moved pages, not recompute work
+    assert breg.get_stat("generation.prefill_tokens_total").get() == 0
+    a.shutdown()
+    b.shutdown()
+
+
+def test_import_sequence_refuses_without_capacity(model):
+    """A full sibling refuses the import (False, caller falls back to
+    cold) instead of corrupting its own residents: no free slot, and
+    pool pressure even after eviction, both refuse cleanly."""
+    a = gen.GenerationEngine(model, _cfg(), start=False)
+    h = a.submit(SYSTEM + [7, 7], max_new_tokens=8)
+    for _ in range(4):
+        a.step()
+    _, live = a.evacuate_for_migration()
+    snap = live[0]
+    full = gen.GenerationEngine(model, _cfg(max_decode_slots=1),
+                                start=False)
+    hf = full.submit(SYSTEM, max_new_tokens=8)
+    for _ in range(3):
+        full.step()
+    assert full.import_sequence(dict(snap)) is False   # no free slot
+    tiny = gen.GenerationEngine(model, _cfg(num_pages=2), start=False)
+    assert tiny.import_sequence(dict(snap)) is False   # pool too small
+    # the refused snapshot still cold-resubmits fine elsewhere
+    b = gen.GenerationEngine(model, _cfg(), start=False)
+    assert b.import_sequence(snap)
+    b.run_until_idle()
+    assert snap["future"].result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [7, 7], 8)
+    full.run_until_idle()
+    hf.result(timeout=5)
+    for eng in (a, full, tiny, b):
+        eng.shutdown()
+    assert h is snap["future"]
+
+
+# ------------------------- inproc fleet tier -----------------------------
+
+
+def test_inproc_drain_live_migration_zero_replay(model):
+    """Mid-stream drain with live migration ON (the default): the
+    stream RESUMES on the sibling — fleet.migrated_replay_tokens == 0,
+    live_migrated_total counts it, and the client stream is identical
+    and gap/dupe-free (greedy + seeded stochastic)."""
+    fl = _fleet(model)
+    sp = gen.SamplingParams(temperature=0.9, top_k=10, seed=123)
+    hg = fl.submit(SYSTEM + [7, 7], max_new_tokens=10, session="s1")
+    hs = fl.submit(SYSTEM + [1], max_new_tokens=10, sampling=sp,
+                   session="s1")
+    home = fl.replica_of("s1")
+    eng = fl._replicas[home].engine
+    for _ in range(8):
+        eng.step()
+    assert any(s.n_generated > 0 for s in eng.scheduler.active())
+    fl.drain(home, migrate=True)
+    fl.run_until_idle()
+    rg, rs = hg.result(timeout=5), hs.result(timeout=5)
+    assert rg.token_ids == _ref(model, SYSTEM + [7, 7], 10)
+    assert rs.token_ids == _stoch_ref(model, SYSTEM + [1], 10, 123)
+    assert list(hg.tokens(timeout=1)) == rg.token_ids
+    assert list(hs.tokens(timeout=1)) == rs.token_ids
+    assert _stat(fleet_mod.LIVE_MIGRATED_TOTAL) == 2
+    assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == 0
+    assert _stat(fleet_mod.MIGRATED_TOTAL) == 2
+    fl.shutdown()
+
+
+def test_cold_resubmit_ablation_counts_replayed_tokens(model):
+    """live=False (the ablation baseline): the drain falls back to
+    cold resubmits — still token-identical through the relay, but
+    every already-delivered token is REPLAYED and counted, the cost
+    live migration exists to delete."""
+    fl = _fleet(model, live_migration=False)
+    h = fl.submit(SYSTEM + [7, 7], max_new_tokens=10, session="s1")
+    home = fl.replica_of("s1")
+    eng = fl._replicas[home].engine
+    for _ in range(6):
+        eng.step()
+    emitted = max(s.n_generated for s in eng.scheduler.active())
+    assert emitted > 0
+    fl.drain(home, migrate=True)
+    fl.run_until_idle()
+    r = h.result(timeout=5)
+    assert r.token_ids == _ref(model, SYSTEM + [7, 7], 10)
+    assert list(h.tokens(timeout=1)) == r.token_ids
+    assert _stat(fleet_mod.LIVE_MIGRATED_TOTAL) == 0
+    assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == emitted
+    fl.shutdown()
+
+
+def test_live_migration_falls_back_cold_when_sibling_full(model):
+    """A sibling with no free slot refuses the live import; the
+    request falls down the COLD ladder (queued, replayed via relay) —
+    degraded, never dropped."""
+    fl = _fleet(model, cfgs=[_cfg(max_decode_slots=1)
+                             for _ in range(2)])
+    blocker = fl.submit(SYSTEM, max_new_tokens=10, session="blk")
+    other = fl.replica_of("blk")
+    beng = fl._replicas[other].engine
+    for _ in range(3):
+        beng.step()                     # occupy the sibling's only slot
+    target_home = next(n for n in fl._replicas if n != other)
+    fl._sessions["tgt"] = target_home
+    h = fl.submit(SYSTEM + [7, 7], max_new_tokens=10, session="tgt")
+    eng = fl._replicas[target_home].engine
+    for _ in range(6):
+        eng.step()
+    fl.drain(target_home, migrate=True)
+    fl.run_until_idle()
+    assert h.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [7, 7], 10)
+    assert list(h.tokens(timeout=1)) == h.result().token_ids
+    assert _stat(fleet_mod.LIVE_MIGRATED_TOTAL) == 0
+    assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) > 0
+    blocker.result(timeout=5)
+    fl.shutdown()
+
+
+def test_page_service_adopts_warm_prefix_on_other_replica(model):
+    """THE page-service oracle (inproc half): replica A registers a
+    prefix; a session-pinned request for the same prefix on replica B
+    triggers a point-to-point page transfer — B serves it WARM from a
+    run it never prefilled, confirmed in fleet counters and B's own
+    hit stamp."""
+    fl = _fleet(model)
+    h1 = fl.submit(SYSTEM + [7], max_new_tokens=4)
+    fl.run_until_idle()
+    h1.result(timeout=5)
+    counts = _requests_per_replica(fl)
+    holder = max(counts, key=counts.get)
+    other = next(n for n in fl._replicas if n != holder)
+    assert counts[other] == 0                    # B never saw the prefix
+    fl._sessions["pin"] = other
+    h2 = fl.submit(SYSTEM + [9, 9], max_new_tokens=4, session="pin")
+    fl.run_until_idle()
+    assert h2.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [9, 9], 4)
+    assert h2.prefix_hit_tokens == len(SYSTEM)   # warm on B via transfer
+    assert _stat(fleet_mod.PAGE_ADOPTIONS) == 1
+    assert _stat(fleet_mod.PAGES_ADOPTED) == 3
+    # B prefilled only the divergent 2-token suffix, never the prefix
+    gstats = fl.stats_snapshot()["replicas"][other]["generation"]
+    assert gstats["generation.prefill_tokens_total"] == 2
+    fl.shutdown()
+
+
+def test_prefix_rung_follows_measured_index_after_drain(model):
+    """The measured prefix rung: after the hash-home drains, a new
+    replica seeds the run, and the fleet index routes the NEXT request
+    to the replica that actually holds it — not the stable-hash guess."""
+    fl = _fleet(model, n=3)
+    h1 = fl.submit(SYSTEM + [7], max_new_tokens=4)
+    fl.run_until_idle()
+    h1.result(timeout=5)
+    holder = max(_requests_per_replica(fl).items(),
+                 key=lambda kv: kv[1])[0]
+    fl.drain(holder)                  # the index forgets the holder
+    h2 = fl.submit(SYSTEM + [8], max_new_tokens=4)
+    fl.run_until_idle()
+    h2.result(timeout=5)
+    second = max((kv for kv in _requests_per_replica(fl).items()
+                  if kv[0] != holder), key=lambda kv: kv[1])[0]
+    # the third request must route to `second` BY MEASUREMENT (its
+    # registration deltas), wherever the stable hash would point
+    h3 = fl.submit(SYSTEM + [2], max_new_tokens=4, session=None)
+    fl.run_until_idle()
+    h3.result(timeout=5)
+    assert h3.prefix_hit_tokens == len(SYSTEM)
+    assert _requests_per_replica(fl)[second] == 2
+    fl.shutdown()
+
+
+def test_heartbeat_metrics_schema_complete_and_zeroed_inproc(model):
+    """Satellite: fleet.replica_heartbeat_age_s[.name] +
+    fleet.replica_dead_total are in the FIRST snapshot, zeroed for
+    inproc transports (their liveness is this process's), alongside
+    the migration/adoption counters."""
+    fl = _fleet(model)
+    snap = fl.stats_snapshot()["fleet"]
+    for key in (fleet_mod.REPLICA_HEARTBEAT_AGE,
+                fleet_mod.REPLICA_DEAD_TOTAL,
+                fleet_mod.LIVE_MIGRATED_TOTAL,
+                fleet_mod.MIGRATED_REPLAY_TOKENS,
+                fleet_mod.PAGE_ADOPTIONS, fleet_mod.PAGES_ADOPTED):
+        assert key in snap, key
+    for name in ("d0", "d1"):
+        assert snap[f"{fleet_mod.REPLICA_HEARTBEAT_AGE}.{name}"] == 0.0
+    assert snap[fleet_mod.REPLICA_HEARTBEAT_AGE] == 0.0
+    assert snap[fleet_mod.REPLICA_DEAD_TOTAL] == 0
+    fl.shutdown()
+
+
+def test_transport_and_config_validation(model):
+    with pytest.raises(ValueError, match="transport"):
+        ReplicaSpec("x", model, _cfg(), transport="carrier-pigeon")
+    with pytest.raises(ValueError, match="transport"):
+        FleetConfig(transport="bogus")
+    from paddle_tpu.serving.disagg.transport import SubprocTransport
+    spec = ReplicaSpec("m", model,
+                       _cfg(mesh=tp_mesh(4), kv_backend="device"))
+    with pytest.raises(ValueError, match="process boundary"):
+        SubprocTransport(spec)
+
+
+# ------------------------ subprocess fleet tier --------------------------
+
+
+@needs_subproc
+def test_subproc_fleet_token_identity_and_page_adoption(model):
+    """Acceptance 1 + 2 (process-boundary half): the same seeded
+    workload through SubprocTransport replicas is token-identical to
+    the inproc cold run, and a warm prefix registered on subprocess
+    replica A is adopted by subprocess replica B over the RPC page
+    service."""
+    fl = _fleet(model, transport="proc")
+    sp = gen.SamplingParams(temperature=0.9, top_k=10, seed=123)
+    hg = fl.submit(SYSTEM + [7, 7], max_new_tokens=8)
+    hs = fl.submit(SYSTEM + [1], max_new_tokens=8, sampling=sp)
+    fl.run_until_idle()
+    rg = hg.result(timeout=15)
+    assert rg.token_ids == _ref(model, SYSTEM + [7, 7], 8)
+    assert hs.result(timeout=15).token_ids == \
+        _stoch_ref(model, SYSTEM + [1], 8, 123)
+    assert list(hg.tokens(timeout=1)) == rg.token_ids
+    # page adoption over the process boundary: registration deltas
+    # arrive on the next heartbeat — poll the snapshot (which ingests
+    # them) until the index knows the holder
+    lookup = None
+    deadline = time.monotonic() + 10
+    while lookup is None and time.monotonic() < deadline:
+        fl.stats_snapshot()
+        lookup = fl._page_index.lookup(SYSTEM + [9], 4)
+        if lookup is None:
+            time.sleep(0.05)
+    assert lookup is not None
+    other = next(n for n in fl._replicas if n != lookup[0])
+    fl._sessions["pin"] = other
+    h3 = fl.submit(SYSTEM + [9], max_new_tokens=4, session="pin")
+    fl.run_until_idle()
+    assert h3.result(timeout=15).token_ids == \
+        _ref(model, SYSTEM + [9], 4)
+    assert h3.prefix_hit_tokens == len(SYSTEM)
+    assert _stat(fleet_mod.PAGE_ADOPTIONS) >= 1
+    snap = fl.stats_snapshot()
+    assert all(r["transport"] == "proc"
+               for r in snap["replicas"].values())
+    fl.shutdown()
+
+
+@needs_subproc
+def test_subproc_midstream_drain_live_migration_zero_replay(model):
+    """Acceptance 1 (drain half): a mid-stream drain of a subprocess
+    replica LIVE-migrates its residents — the sibling process resumes
+    decode with migrated_replay_tokens == 0 and the client streams
+    stay identical and gap/dupe-free."""
+    fl = _fleet(model, transport="proc")
+    sp = gen.SamplingParams(temperature=0.9, top_k=10, seed=77)
+    hg = fl.submit(SYSTEM + [7, 7], max_new_tokens=32, session="s1")
+    hs = fl.submit(SYSTEM + [1], max_new_tokens=32, sampling=sp,
+                   session="s1")
+    home = fl.replica_of("s1")
+    tr = fl._replicas[home].transport
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with tr._lock:
+            emitted = [e["emitted"] for e in tr._inflight.values()]
+        if emitted and min(emitted) >= 3:
+            break
+        time.sleep(0.02)
+    assert emitted and min(emitted) >= 3, "stream never started"
+    fl.drain(home, migrate=True)
+    fl.run_until_idle()
+    rg, rs = hg.result(timeout=15), hs.result(timeout=15)
+    assert rg.token_ids == _ref(model, SYSTEM + [7, 7], 32)
+    assert rs.token_ids == _stoch_ref(model, SYSTEM + [1], 32, 77)
+    assert list(hg.tokens(timeout=1)) == rg.token_ids
+    assert list(hs.tokens(timeout=1)) == rs.token_ids
+    # >= 1: a stream racing to completion before the drain lands is
+    # legal; what must NEVER happen is a replayed token
+    assert _stat(fleet_mod.LIVE_MIGRATED_TOTAL) >= 1
+    assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == 0
+    fl.shutdown()
+
+
+@needs_subproc
+def test_subproc_crash_remigrates_queued_and_inflight_typed(model):
+    """Satellite: crash a subprocess replica (SIGKILL).  Its queued
+    work remigrates to the sibling and every in-flight stream resolves
+    TYPED — migrated (identical tokens) here, shed when no sibling
+    exists — never hung; the death lands in replica_dead_total and the
+    dead slot restarts into a fresh process."""
+    fl = _fleet(model, transport="proc")
+    prompts = [SYSTEM + [7, 7], SYSTEM + [1], SYSTEM + [9, 9, 9]]
+    hs = [fl.submit(p, max_new_tokens=6) for p in prompts]
+    loads = {}
+    for name, rep in fl._replicas.items():
+        with rep.transport._lock:
+            loads[name] = len(rep.transport._inflight)
+    home = max(loads, key=loads.get)
+    assert loads[home] == 3          # prefix affinity converged them
+    fl._replicas[home].transport.kill()
+    for p, h in zip(prompts, hs):
+        assert h.result(timeout=30).token_ids == _ref(model, p, 6)
+    assert _stat(fleet_mod.REPLICA_DEAD_TOTAL) == 1
+    assert fl._replicas[home].state == "dead"
+    snap = fl.stats_snapshot()
+    assert snap["replicas"][home] == {"state": "dead"}
+    fl.restart(home)
+    assert fl._replicas[home].state == "serving"
+    h = fl.submit(SYSTEM, max_new_tokens=4)
+    fl.run_until_idle()
+    assert h.result(timeout=15).token_ids == _ref(model, SYSTEM, 4)
+    fl.shutdown()
+    # the lone-replica shed half: kill the ONLY replica -> typed error
+    fl2 = _fleet(model, n=1, transport="proc")
+    h2 = fl2.submit(SYSTEM, max_new_tokens=200)
+    fl2._replicas["d0"].transport.kill()
+    with pytest.raises(ServingError):
+        h2.result(timeout=30)
+    fl2.shutdown()

@@ -45,6 +45,15 @@ Usage:
         # least-loaded) against a random-routing baseline — per-replica
         # prefix hit rate, shed rate, TTFT p50/p95, and the
         # prefix-routing confirmation split per cell
+    python tools/gen_bench.py --replicas N --fleet-transport both
+        # DISAGGREGATED fleet A/B: the same fleet cells behind the
+        # in-process transport vs one-OS-process-per-replica
+        # (SubprocTransport pickled RPC), plus a drain-migration probe
+        # pair per transport — a mid-decode stream's replica drains
+        # and the cell reports stream-gap p95 across the drain,
+        # migrated_replay_tokens (LIVE migration must report 0 vs the
+        # cold-resubmit baseline's full replay), and the page-service
+        # adoption counters
     python tools/gen_bench.py --mesh both
         # single-chip vs TENSOR-PARALLEL sharded decode A/B: the same
         # grid run unsharded (tp_degree 1) and over a head-sharded
@@ -493,7 +502,8 @@ def bench_prefix(model, users, sys_tokens, user_tokens, new_tokens,
 
 
 def bench_fleet(model, n_replicas, sessions, sys_tokens, user_tokens,
-                new_tokens, page_size, routing, chunk_tokens, turns=2):
+                new_tokens, page_size, routing, chunk_tokens, turns=2,
+                transport="inproc"):
     """The fleet-tier A/B scenario: `sessions` multi-turn sessions share
     one system prompt; each session's turn 2 re-sends turn 1's prompt
     PLUS the streamed answer (the production multi-turn shape that
@@ -528,9 +538,11 @@ def bench_fleet(model, n_replicas, sessions, sys_tokens, user_tokens,
                                page_size=page_size,
                                queue_depth=sessions * turns + 4,
                                prefix_cache=True,
-                               prefill_chunk_tokens=chunk_tokens))
+                               prefill_chunk_tokens=chunk_tokens),
+            transport=transport)
         for i in range(n_replicas)]
-    fl = FleetRouter(specs, FleetConfig(routing=routing, start=False,
+    fl = FleetRouter(specs, FleetConfig(routing=routing,
+                                        start=(transport == "proc"),
                                         seed=7))
     rng = np.random.default_rng(sys_tokens * 17 + sessions)
     half = model.vocab_size // 2
@@ -564,9 +576,11 @@ def bench_fleet(model, n_replicas, sessions, sys_tokens, user_tokens,
     # start cold with clean books.
     run_waves(rng.integers(half, model.vocab_size, sys_tokens).tolist(),
               "w", half, model.vocab_size)
-    for rep in fl._replicas.values():
-        rep.engine.cache.flush_prefix_cache()
-        rep.registry.reset_all()
+    for name, rep in fl._replicas.items():
+        rep.transport.flush_prefix()
+        rep.transport.reset_stats()
+        rep.transport.take_prefix_deltas()   # the flush's drop deltas
+        fl._page_index.drop_replica(name)    # warmup residue forgotten
     reset_fleet_stats()
     system = rng.integers(0, half, sys_tokens).tolist()
     handles = run_waves(system, "s", 0, half)
@@ -591,6 +605,9 @@ def bench_fleet(model, n_replicas, sessions, sys_tokens, user_tokens,
         "scenario": "fleet",
         "replicas": n_replicas,
         "routing": routing,
+        "transport": transport,
+        "page_adoptions": fsnap.get("fleet.page_adoptions", 0),
+        "pages_adopted": fsnap.get("fleet.pages_adopted", 0),
         "sessions": sessions,
         "turns": turns,
         "sys_tokens": sys_tokens,
@@ -610,6 +627,93 @@ def bench_fleet(model, n_replicas, sessions, sys_tokens, user_tokens,
         "prefix_routed_missed":
             fsnap.get("fleet.prefix_routed_missed", 0),
         "per_replica": per_replica,
+    }
+
+
+def bench_drain_migration(model, transport, live, sys_tokens, new_tokens,
+                          page_size, chunk_tokens):
+    """The drain-migration probe: one long stream is mid-decode when
+    its replica drains; a consumer thread stamps every token arrival
+    so the cell reports STREAM-GAP p95 (time-to-next-token across the
+    drain) alongside `migrated_replay_tokens` — live migration must
+    report 0 (the sibling RESUMES the decode) vs the cold-resubmit
+    baseline's full replay of every already-streamed token — and the
+    page-service adoption counters.  Runs with started workers so the
+    gap measures real wall time, per transport."""
+    import threading
+
+    from paddle_tpu import generation as g
+    from paddle_tpu.profiler.monitor import StatRegistry
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                          ReplicaSpec)
+
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    total = sys_tokens + new_tokens
+    pages = (-(-total // page_size) + 2) * 3
+    specs = [
+        ReplicaSpec(
+            f"r{i}", model,
+            g.GenerationConfig(max_decode_slots=4, num_pages=pages,
+                               page_size=page_size, prefix_cache=True,
+                               prefill_chunk_tokens=chunk_tokens),
+            transport=transport)
+        for i in range(2)]
+    fl = FleetRouter(specs, FleetConfig(start=True, seed=7,
+                                        live_migration=live))
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(0, model.vocab_size, sys_tokens).tolist()
+    h = fl.submit(prompt, max_new_tokens=new_tokens, session="probe")
+    arrivals = []
+
+    def consume():
+        for _ in h.tokens(timeout=60):
+            arrivals.append(time.monotonic())
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    # let the stream establish, then pull the replica out mid-decode
+    deadline = time.monotonic() + 60
+    while len(arrivals) < max(4, new_tokens // 8) \
+            and time.monotonic() < deadline:
+        time.sleep(0.002)
+    drained_at = len(arrivals)
+    t0 = time.monotonic()
+    fl.drain(fl.replica_of("probe"), migrate=True)
+    drain_s = time.monotonic() - t0
+    consumer.join(timeout=120)
+    result = h.result(timeout=10)
+    gaps = np.diff(np.asarray(arrivals))
+    snap = fl.stats_snapshot()["fleet"]
+    fl.shutdown()
+
+    def pct(q):
+        # a starved cell (fewer than 2 arrivals before the deadline)
+        # reports null gaps instead of crashing the whole artifact
+        return (None if gaps.size == 0
+                else round(float(np.percentile(gaps, q)), 4))
+
+    return {
+        "scenario": "fleet_drain",
+        "transport": transport,
+        "migration": "live" if live else "cold-resubmit",
+        "tokens_streamed": len(result.token_ids),
+        "tokens_before_drain": drained_at,
+        "drain_wall_s": round(drain_s, 4),
+        "stream_gap_p50_s": pct(50),
+        "stream_gap_p95_s": pct(95),
+        "stream_gap_max_s": (None if gaps.size == 0
+                             else round(float(np.max(gaps)), 4)),
+        "migrated_total": snap.get("fleet.migrated_total", 0),
+        "live_migrated_total":
+            snap.get("fleet.live_migrated_total", 0),
+        "migrated_replay_tokens":
+            snap.get("fleet.migrated_replay_tokens", 0),
+        "page_adoptions": snap.get("fleet.page_adoptions", 0),
+        "pages_adopted": snap.get("fleet.pages_adopted", 0),
     }
 
 
@@ -680,6 +784,19 @@ def main():
     ap.add_argument("--fleet-sessions", type=int, default=8,
                     help="concurrent sessions in the --replicas "
                          "scenario (each runs 2 turns)")
+    ap.add_argument("--fleet-transport",
+                    choices=("inproc", "proc", "both"),
+                    default="inproc",
+                    help="replica process boundary A/B for the fleet "
+                         "cells: 'inproc' (direct-object engines), "
+                         "'proc' (one OS process per replica behind "
+                         "the SubprocTransport RPC boundary), or "
+                         "'both'.  Each transport also emits a "
+                         "DRAIN-MIGRATION probe cell pair — live "
+                         "migration vs cold resubmit — reporting "
+                         "stream-gap p95 across the drain, "
+                         "migrated_replay_tokens (live must report 0) "
+                         "and page-service adoption counters")
     ap.add_argument("--mesh", default="1",
                     help="tensor-parallel A/B: '1' (unsharded), 'N' "
                          "(head-sharded over every visible device), "
@@ -855,12 +972,25 @@ def main():
         else:
             counts = [int(args.replicas)]
         sys_tokens = max(contexts)
-        for n in counts:
-            routings = ("affinity",) if n == 1 else ("affinity", "random")
-            for routing in routings:
-                grid.append(bench_fleet(
-                    model, n, args.fleet_sessions, sys_tokens, 8,
-                    args.new_tokens, args.page_size, routing,
+        transports = (("inproc", "proc")
+                      if args.fleet_transport == "both"
+                      else (args.fleet_transport,))
+        for transport in transports:
+            for n in counts:
+                routings = ("affinity",) if n == 1 \
+                    else ("affinity", "random")
+                for routing in routings:
+                    grid.append(bench_fleet(
+                        model, n, args.fleet_sessions, sys_tokens, 8,
+                        args.new_tokens, args.page_size, routing,
+                        args.chunk_tokens, transport=transport))
+            # the drain-migration probe: live vs cold-resubmit per
+            # transport (stream-gap p95, migrated_replay_tokens — the
+            # live-migration acceptance number is the 0)
+            for live in (True, False):
+                grid.append(bench_drain_migration(
+                    model, transport, live, sys_tokens,
+                    max(32, args.new_tokens), args.page_size,
                     args.chunk_tokens))
     doc = {
         "bench": "generation_decode",
@@ -875,6 +1005,7 @@ def main():
         "chunk_tokens": args.chunk_tokens,
         "prefix": args.prefix,
         "replicas": args.replicas,
+        "fleet_transport": args.fleet_transport,
         "grid": grid,
         "stats": stats_by_series,
     }

@@ -60,16 +60,18 @@ for i in $(seq 1 "$MAX"); do
     # hardware numbers for the mesh-native kernels;
     # padded_token_waste == 0, ragged TTFT under interleave — the
     # first hardware numbers for the ragged Pallas kernel)
-    # budget grew with the prefix + fleet + ragged A/B cells: a
-    # timeout kill here drops the WHOLE gen artifact (mesh/prefill
-    # numbers included), so the cap tracks the scenario count and a
-    # kill at least says so
-    timeout 3300 python tools/gen_bench.py --pool both --decode both \
+    # budget grew with the prefix + fleet + ragged + disagg A/B cells
+    # (--fleet-transport both adds proc-replica fleets — each child
+    # process pays its own jax import — plus 4 drain-migration probe
+    # cells): a timeout kill here drops the WHOLE gen artifact
+    # (mesh/prefill numbers included), so the cap tracks the scenario
+    # count and a kill at least says so
+    timeout 3900 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
-      --step both \
+      --step both --fleet-transport both \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
