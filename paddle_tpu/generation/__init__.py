@@ -37,7 +37,8 @@ from .engine import (DEFAULT_PREFILL_CHUNK_TOKENS, GenerationConfig,
                      GenerationEngine, GenerationHandle, GenerationResult)
 from .fused import (ChunkedPrefillStep, FusedDecodeStep, RaggedStep,
                     decode_batch_menu)
-from .kv_cache import (DeviceKVPool, OutOfPagesError, PagedKVCache,
+from .kv_cache import (DeviceKVPool, KVQuantMismatchError,
+                       OutOfPagesError, PagedKVCache,
                        UnknownSequenceError)
 from .metrics import GenerationMetrics
 from .model import TinyCausalLM
@@ -48,7 +49,7 @@ from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
 __all__ = [
     "GenerationEngine", "GenerationConfig", "GenerationHandle",
     "GenerationResult", "PagedKVCache", "DeviceKVPool",
-    "OutOfPagesError", "UnknownSequenceError",
+    "OutOfPagesError", "UnknownSequenceError", "KVQuantMismatchError",
     "paged_decode_attention", "paged_decode_attention_reference",
     "dense_causal_reference", "ContinuousBatchingScheduler",
     "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",

@@ -30,23 +30,50 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _gather_pool(pool, pt, b, h, d, layout, dtype):
+def _gather_pool(pool, pt, b, h, d, layout, dtype, scale=None):
     """Gather a [B, Kmax, H, D] contiguous view of each sequence's pages
     from either pool layout.  The kernel layout's gathered view is
     transposed AFTER the gather — a value-preserving permutation of the
     O(tokens) view, never the pool — so the downstream einsums see
     byte-identical operands in both layouts (the bitwise re-proof
-    tests/test_fused_decode.py pins)."""
+    tests/test_fused_decode.py pins).
+
+    `scale` (int8 pools): the [P, H] per-page per-head abs-max scale
+    array — the gathered int8 view dequantizes elementwise with the
+    SAME ``value * (scale * 1/127)`` expression the Pallas kernels
+    apply in-block (quantized_kv.dequant_factor), so kernel and
+    reference see bitwise-equal operands, exactly like the bf16
+    upcast."""
+    if scale is None and pool.dtype == jnp.int8:
+        # raw int8 codes decoded as values are finite and
+        # plausible-looking (up to 127x wrong) — fail loudly instead
+        raise ValueError(
+            "int8 KV pool reached attention without its scale array — "
+            "thread the cache's layer_scales() through k_scale/v_scale")
+    if scale is not None and pool.dtype != jnp.int8:
+        # the converse misuse corrupts just as silently: float values
+        # multiplied by scale/127
+        raise ValueError(
+            f"k_scale/v_scale passed with a {pool.dtype} pool — scales "
+            "belong to int8 pools only")
     if layout == "kernel":
-        # pool [H, P, ps, D] -> gather [H, B, MP, ps, D] -> [B, K, H, D]
+        # pool [H, P, ps, D] -> gather [H, B, MP, ps, D] -> [B, MP, ps, H, D]
         g = jnp.transpose(pool[:, pt], (1, 2, 3, 0, 4))
-        return g.reshape(b, -1, h, d).astype(dtype)
-    # pool [P, ps, H, D] -> gather [B, MP, ps, H, D] -> [B, K, H, D]
-    return pool[pt].reshape(b, -1, h, d).astype(dtype)
+    else:
+        # pool [P, ps, H, D] -> gather [B, MP, ps, H, D]
+        g = pool[pt]
+    if scale is not None:
+        from .quantized_kv import dequant_factor
+
+        # scale[pt]: [B, MP, H] -> broadcast over page rows and D
+        g = g.astype(dtype) * dequant_factor(
+            jnp.asarray(scale)[pt][:, :, None, :, None])
+    return g.reshape(b, -1, h, d).astype(dtype)
 
 
 def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
-                                     seq_lens, scale=None, layout="token"):
+                                     seq_lens, scale=None, layout="token",
+                                     k_scale=None, v_scale=None):
     """Pure-jnp paged decode attention.
 
     q: [B, H, D] — the single query token per sequence.
@@ -54,6 +81,9 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
         token layout, [H, P, page_size, D] for layout="kernel".
     page_tables: [B, max_pages] int32, unused slots padded with 0.
     seq_lens: [B] int32 live token counts.
+    k_scale, v_scale: [P, H] per-page per-head abs-max scales for int8
+        pools (None otherwise) — the gathered view dequantizes with the
+        kernels' exact factor.
     Returns [B, H, D].
     """
     q = jnp.asarray(q)
@@ -64,10 +94,11 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
     b, h, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    # gather pages into [B, Kmax, H, D]; the upcast (bf16 pools) happens
-    # on the gathered O(tokens) view, never on the whole pool
-    k = _gather_pool(k_pool, pt, b, h, d, layout, q.dtype)
-    v = _gather_pool(v_pool, pt, b, h, d, layout, q.dtype)
+    # gather pages into [B, Kmax, H, D]; the upcast (bf16 pools) and the
+    # int8 dequant happen on the gathered O(tokens) view, never on the
+    # whole pool
+    k = _gather_pool(k_pool, pt, b, h, d, layout, q.dtype, k_scale)
+    v = _gather_pool(v_pool, pt, b, h, d, layout, q.dtype, v_scale)
     kmax = k.shape[1]
     logits = jnp.einsum("bhd,bkhd->bhk", q, k) * scale
     live = jnp.arange(kmax, dtype=jnp.int32)[None, :] < lens[:, None]
@@ -83,7 +114,8 @@ def paged_decode_attention_reference(q, k_pool, v_pool, page_tables,
 
 def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
                            scale=None, use_kernel=None, interpret=None,
-                           layout="token", mesh=None, tp_axis=None):
+                           layout="token", mesh=None, tp_axis=None,
+                           k_scale=None, v_scale=None):
     """Dispatch: the Pallas kernel on TPU (or when forced, e.g. interpret
     mode in tests), the jnp reference elsewhere.  `layout` names the
     pool storage layout ("token" or "kernel", see DeviceKVPool) — with
@@ -98,7 +130,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
     if not use_kernel:
         return paged_decode_attention_reference(
             q, k_pool, v_pool, page_tables, seq_lens, scale=scale,
-            layout=layout)
+            layout=layout, k_scale=k_scale, v_scale=v_scale)
     from ..ops.pallas.paged_attention import paged_decode_attention_kernel
 
     d = q.shape[-1]
@@ -107,12 +139,13 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, seq_lens,
     return paged_decode_attention_kernel(
         jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
         page_tables, seq_lens, scale, interpret=interpret, layout=layout,
-        mesh=mesh, tp_axis=tp_axis)
+        mesh=mesh, tp_axis=tp_axis, k_scale=k_scale, v_scale=v_scale)
 
 
 def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
                                      starts, lens, kv_lens, scale=None,
-                                     layout="token"):
+                                     layout="token", k_scale=None,
+                                     v_scale=None):
     """Pure-jnp RAGGED paged attention: one mixed batch of variable-
     length query runs — decode rows (1 query) and prefill chunks (many)
     — packed into ONE token axis, attending through per-sequence page
@@ -150,9 +183,12 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     # gather each descriptor's pages into [S, Kmax, H, D]; bf16 pools
-    # upcast on the gathered view, never the pool
-    k = _gather_pool(jnp.asarray(k_pool), pt, s_n, h, d, layout, q.dtype)
-    v = _gather_pool(jnp.asarray(v_pool), pt, s_n, h, d, layout, q.dtype)
+    # upcast (and int8 pools dequantize) on the gathered view, never
+    # the pool
+    k = _gather_pool(jnp.asarray(k_pool), pt, s_n, h, d, layout, q.dtype,
+                     k_scale)
+    v = _gather_pool(jnp.asarray(v_pool), pt, s_n, h, d, layout, q.dtype,
+                     v_scale)
     kmax = k.shape[1]
     logits = jnp.einsum("thd,skhd->sthk", q, k) * scale
     row = jnp.arange(t, dtype=jnp.int32)[None, :]            # [1, T]
@@ -177,7 +213,7 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
 def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
                            kv_lens, scale=None, use_kernel=None,
                            interpret=None, layout="token", mesh=None,
-                           tp_axis=None):
+                           tp_axis=None, k_scale=None, v_scale=None):
     """Dispatch for the ragged mixed-batch path: the Pallas kernel on
     TPU (or when forced), the jnp gather reference elsewhere — the
     exact contract of paged_decode_attention, grown from one query row
@@ -190,7 +226,7 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
     if not use_kernel:
         return ragged_paged_attention_reference(
             q, k_pool, v_pool, page_tables, starts, lens, kv_lens,
-            scale=scale, layout=layout)
+            scale=scale, layout=layout, k_scale=k_scale, v_scale=v_scale)
     from ..ops.pallas.paged_attention import ragged_paged_attention_kernel
 
     d = q.shape[-1]
@@ -199,7 +235,8 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
     return ragged_paged_attention_kernel(
         jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
         page_tables, starts, lens, kv_lens, scale, interpret=interpret,
-        layout=layout, mesh=mesh, tp_axis=tp_axis)
+        layout=layout, mesh=mesh, tp_axis=tp_axis, k_scale=k_scale,
+        v_scale=v_scale)
 
 
 def chunk_prefill_attention_reference(q, k, v, start, scale=None):
@@ -245,7 +282,8 @@ def chunk_prefill_attention_reference(q, k, v, start, scale=None):
 
 def chunk_prefill_attention(q, k_pool, v_pool, page_table, start,
                             scale=None, use_kernel=None, interpret=None,
-                            layout="token", mesh=None, tp_axis=None):
+                            layout="token", mesh=None, tp_axis=None,
+                            k_scale=None, v_scale=None):
     """Paged chunked-prefill attention for ONE sequence: the chunk's K/V
     have ALREADY been scattered into the pools (positions
     [start, start + n)), so every key — prefix and chunk alike — is read
@@ -266,9 +304,9 @@ def chunk_prefill_attention(q, k_pool, v_pool, page_table, start,
     pt = jnp.asarray(page_table, jnp.int32)
     if not use_kernel:
         k = _gather_pool(jnp.asarray(k_pool), pt[None], 1, h, d, layout,
-                         q.dtype)[0]
+                         q.dtype, k_scale)[0]
         v = _gather_pool(jnp.asarray(v_pool), pt[None], 1, h, d, layout,
-                         q.dtype)[0]
+                         q.dtype, v_scale)[0]
         return chunk_prefill_attention_reference(q, k, v, start,
                                                  scale=scale)
     from ..ops.pallas.paged_attention import chunk_prefill_attention_kernel
@@ -277,7 +315,8 @@ def chunk_prefill_attention(q, k_pool, v_pool, page_table, start,
         scale = 1.0 / math.sqrt(d)
     return chunk_prefill_attention_kernel(
         q, jnp.asarray(k_pool), jnp.asarray(v_pool), pt, start, scale,
-        interpret=interpret, layout=layout, mesh=mesh, tp_axis=tp_axis)
+        interpret=interpret, layout=layout, mesh=mesh, tp_axis=tp_axis,
+        k_scale=k_scale, v_scale=v_scale)
 
 
 def dense_causal_reference(q, k, v, scale=None):

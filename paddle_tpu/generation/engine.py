@@ -90,6 +90,24 @@ class GenerationConfig:
         "device" (DeviceKVPool: HBM-resident pools, donated scatter
         appends, O(tokens) transfer per step), or None = auto (device
         on TPU, host elsewhere).
+    kv_dtype: pool storage dtype — np.float32 (default), bfloat16
+        (half the bytes, storage-rounding), or "int8"/np.int8:
+        QUANTIZED pools with per-page per-head abs-max scales, half of
+        bf16 again (~2x resident sequences per pool byte).  int8 is
+        LOSSY: the acceptance contract shifts from bitwise identity
+        vs the fp32 oracle to the quality gate (bounded max-logit
+        drift + >=99% greedy-token agreement — generation/quality.py),
+        while int8-vs-int8 runs stay strictly token-identical across
+        engine paths, pool layouts, preemption, warm starts, and the
+        mesh (docs/GENERATION.md "Quantized KV and collectives").
+    quantized_collectives: EQuARX-style int8 allreduces — the sharded
+        step's two per-layer Megatron allreduces run as an explicit
+        quantize->psum->dequant ring (per-shard abs-max scales, placed
+        exactly where the fp32 allreduces sit), cutting
+        collective_bytes_per_step ~4x.  Lossy like int8 KV, gated by
+        the same quality harness.  Inert without a mesh (tp == 1 has
+        no collectives) — generation.collective_quantized says whether
+        it is ACTUALLY on.
     max_prefill_batch: waiting requests admitted+prefilled together per
         step (batched prefill); 1 restores one-at-a-time prefill.
     prefill_length_buckets: padded-length menu for batched prefill
@@ -212,7 +230,8 @@ class GenerationConfig:
                  decode=None, decode_batch_buckets=None, pool_layout=None,
                  prefill_chunk_tokens=None, step_token_budget=None,
                  mesh=None, tp_axis=None, prefix_cache=None,
-                 step_mode=None, prefill_pack=True):
+                 step_mode=None, prefill_pack=True,
+                 quantized_collectives=False):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -220,7 +239,10 @@ class GenerationConfig:
         self.default_timeout_ms = default_timeout_ms
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.use_kernel = use_kernel  # None: auto (Pallas on TPU)
-        self.kv_dtype = kv_dtype
+        # accepts np dtypes and names ("int8", "bfloat16"); normalized
+        # once here so every consumer compares one representation
+        self.kv_dtype = np.dtype(kv_dtype)
+        self.quantized_collectives = bool(quantized_collectives)
         if kv_backend not in (None, "host", "device"):
             raise ValueError(
                 f"kv_backend must be 'host', 'device' or None (auto), "
@@ -429,6 +451,14 @@ class GenerationEngine:
                 num_pages=self.config.num_pages,
                 page_size=self.config.page_size,
                 dtype=self.config.kv_dtype)
+        # int8 pools: every write quantizes, every read dequantizes;
+        # the scale arrays ride the donation chain and the eager attend
+        # passes them to the scale-aware attention dispatchers
+        self.kv_quant = bool(self.cache.quantized)
+        # quantized collectives are real only when collectives exist
+        # (tp > 1); the collective_quantized gauge reports the truth
+        self._quant_collectives = (self.config.quantized_collectives
+                                   and self.tp_degree > 1)
         self.scheduler = ContinuousBatchingScheduler(
             self.cache, num_slots=self.config.max_decode_slots,
             queue_depth=self.config.queue_depth, metrics=self.metrics)
@@ -515,7 +545,8 @@ class GenerationEngine:
             self._fused = FusedDecodeStep(
                 model, self.cache, self.metrics,
                 use_kernel=self._use_kernel, batch_buckets=buckets,
-                mesh=mesh, tp_axis=tp_axis)
+                mesh=mesh, tp_axis=tp_axis,
+                quant_collectives=self._quant_collectives)
         # chunked prefill policy mirrors jit_prefill/decode: auto picks
         # chunking on TPU when the model implements the chunk protocol;
         # the CPU tier-1 default stays the one-shot prefill the
@@ -557,7 +588,8 @@ class GenerationEngine:
 
             self._chunk_step = ChunkedPrefillStep(
                 model, self.cache, self.metrics, chunk,
-                use_kernel=self._use_kernel, mesh=mesh, tp_axis=tp_axis)
+                use_kernel=self._use_kernel, mesh=mesh, tp_axis=tp_axis,
+                quant_collectives=self._quant_collectives)
         elif chunk and not chunk_eager_ok:
             raise ValueError(
                 "chunked prefill without jit_prefill + kv_backend="
@@ -615,7 +647,8 @@ class GenerationEngine:
                 model, self.cache, self.metrics,
                 max_tokens=self.step_token_budget,
                 max_seqs=slots + 1, use_kernel=self._use_kernel,
-                mesh=mesh, tp_axis=tp_axis)
+                mesh=mesh, tp_axis=tp_axis,
+                quant_collectives=self._quant_collectives)
         self.metrics.set_mesh_devices(self.tp_degree)
         # which attention implementation this engine's step mode
         # dispatches — "pallas" or "jnp-reference", prefixed with the
@@ -623,6 +656,11 @@ class GenerationEngine:
         # visible stats fact instead of an inference from timings (the
         # bug class that hid the mesh/kernel gap for three PRs)
         self.metrics.set_kernel_path(self.decode_mode, self._use_kernel)
+        # precision facts, stamped once like kernel_path: what dtype
+        # the pools store, and whether the quantized ring ACTUALLY
+        # carries the allreduces (a requested-but-inert flag reads 0)
+        self.metrics.set_kv_quant_dtype(str(self.cache.dtype))
+        self.metrics.set_collective_quantized(self._quant_collectives)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
@@ -781,8 +819,13 @@ class GenerationEngine:
         is NOT resolved: the importer keeps pushing into it."""
         req = state.request
         length = self.cache.seq_len(state.seq_id)
-        k, v = self.cache.export_pages(
+        out = self.cache.export_pages(
             self.cache.page_table(state.seq_id))
+        # quantized pools export (k, v, k_scale, v_scale): the scales
+        # ARE the payload's grid and travel with it
+        k, v = out[0], out[1]
+        k_scale, v_scale = (out[2], out[3]) if len(out) == 4 \
+            else (None, None)
         snap = {
             "prompt": list(req.prompt),
             "max_new_tokens": int(req.max_new_tokens),
@@ -795,6 +838,7 @@ class GenerationEngine:
             "rng": state.rng,
             "cache_len": int(length),
             "k": k, "v": v,
+            "k_scale": k_scale, "v_scale": v_scale,
             "future": req.future,
         }
         self.scheduler.retire(state)
@@ -816,7 +860,14 @@ class GenerationEngine:
             if self._closed or self.scheduler.free_slots() == 0:
                 return False
             try:
-                pages = self.cache.import_pages(snap["k"], snap["v"])
+                # a quantization-boundary mismatch (bf16 snapshot into
+                # an int8 pool or vice versa) raises the typed
+                # KVQuantMismatchError — a ValueError, so the caller's
+                # cold-resubmit ladder handles the heterogeneous fleet
+                # gracefully instead of corrupting a pool
+                pages = self.cache.import_pages(
+                    snap["k"], snap["v"], snap.get("k_scale"),
+                    snap.get("v_scale"))
             except (OutOfPagesError, ValueError):
                 return False
             req = GenerationRequest(
@@ -916,9 +967,12 @@ class GenerationEngine:
             pages, matched = self.cache.match_prefix_full(tokens)
             if not pages:
                 return None
-            k, v = self.cache.export_pages(pages)
-            return {"tokens": [int(t) for t in tokens[:matched]],
-                    "k": k, "v": v}
+            out = self.cache.export_pages(pages)
+            payload = {"tokens": [int(t) for t in tokens[:matched]],
+                       "k": out[0], "v": out[1]}
+            if len(out) == 4:   # quantized: grid travels with bytes
+                payload["k_scale"], payload["v_scale"] = out[2], out[3]
+            return payload
 
     def import_prefix_pages(self, payload):
         """Page-service IMPORT: adopt a sibling-exported prefix run
@@ -931,8 +985,13 @@ class GenerationEngine:
             if not self.prefix_cache_enabled or payload is None:
                 return 0
             try:
+                # KVQuantMismatchError (a ValueError) lands here too:
+                # a bf16<->int8 heterogeneous adoption attempt is
+                # refused typed and skipped — adoption is an
+                # optimization, never a failure
                 return self.cache.import_prefix_run(
-                    payload["tokens"], payload["k"], payload["v"])
+                    payload["tokens"], payload["k"], payload["v"],
+                    payload.get("k_scale"), payload.get("v_scale"))
             except (OutOfPagesError, ValueError):
                 return 0
 
@@ -960,7 +1019,7 @@ class GenerationEngine:
         self._reap_deadlines()
         active = self.scheduler.decode_ready()
         if not active:
-            self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+            self._drain_kv_bytes()
             self._observe_occupancy()
             return 0
         with StepTimer() as timer:
@@ -971,7 +1030,7 @@ class GenerationEngine:
                 self._decode_batch(active)
         self.metrics.observe_step(len(active), timer.seconds)
         self._observe_step_rows(len(active))
-        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+        self._drain_kv_bytes()
         self._observe_occupancy()
         return len(active)
 
@@ -1072,7 +1131,7 @@ class GenerationEngine:
                 self.metrics.observe_decode_step(chunk_dispatched,
                                                  chunk_syncs)
         self._observe_step_rows(len(decoding), chunk_u, chunk_d)
-        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+        self._drain_kv_bytes()
         self._observe_occupancy()
         return advanced
 
@@ -1124,7 +1183,7 @@ class GenerationEngine:
         pack = [(s, n, st) for s, n, st in pack
                 if s.slot is not None and s.prefilling]
         if not decoding and not pack:
-            self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+            self._drain_kv_bytes()
             self._observe_occupancy()
             return 0
         with StepTimer() as timer:
@@ -1132,7 +1191,7 @@ class GenerationEngine:
                 advanced, sampled = self._dispatch_ragged(decoding, pack)
         if sampled:
             self.metrics.observe_step(sampled, timer.seconds)
-        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+        self._drain_kv_bytes()
         self._observe_occupancy()
         return advanced
 
@@ -1642,11 +1701,12 @@ class GenerationEngine:
             # the host backend uploads O(pool) here, which is exactly
             # what generation.kv_bytes_moved makes visible
             k_pool, v_pool = self.cache.layer_pools(layer)
+            ks, vs = self.cache.layer_scales(layer)
             counts["dispatches"] += 1
             return paged_decode_attention(
                 q, k_pool, v_pool, pt, lens,
                 use_kernel=self._use_kernel,
-                layout=self.cache.pool_layout)
+                layout=self.cache.pool_layout, k_scale=ks, v_scale=vs)
 
         logits = np.asarray(self.model.decode(tokens, positions, attend))
         counts["syncs"] += 1  # the [B, V] logits fetch
@@ -1743,6 +1803,16 @@ class GenerationEngine:
             state.preemptions)
         state.handle._finish(result)
         self.metrics.count_finished()
+
+    def _drain_kv_bytes(self):
+        """Drain the cache's byte counters into generation.* once per
+        step: kv_bytes_moved (scale bytes folded in — they are bytes
+        in flight too) plus the split-out kv_scale_bytes for quantized
+        pools."""
+        self.metrics.count_kv_bytes(self.cache.take_bytes_moved())
+        if self.kv_quant:
+            self.metrics.count_kv_scale_bytes(
+                self.cache.take_scale_bytes())
 
     def _observe_occupancy(self):
         self.metrics.observe_occupancy(

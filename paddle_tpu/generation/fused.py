@@ -14,11 +14,12 @@ This module collapses the step to one executable::
         -> embed -> L x (donated scatter-append + paged attention)
         -> logits [B, V]   (or argmax'd tokens [B] for all-greedy)
 
-traced ONCE per shape bucket and dispatched ONCE per decode step.  The
-KV pools ride through as donated arguments (`DeviceKVPool.take_pools` /
-`put_pools`): XLA updates the pool buffers in place and returns the
-same storage, so per-step host work collapses to argument upload plus
-one small fetch.
+traced ONCE per shape bucket and dispatched ONCE per decode step.
+The KV pool state rides through as donated arguments
+(`DeviceKVPool.take_pool_state` / `put_pool_state` — k/v pools, plus
+the per-layer scale arrays for int8 pools): XLA updates the buffers in
+place and returns the same storage, so per-step host work collapses to
+argument upload plus one small fetch.
 
 Shape stability comes from decode-batch bucketing: the live batch B
 (sequences join and finish every step) is padded to a small
@@ -51,33 +52,37 @@ from ..serving.bucketing import CompiledModelCache, ShapeBucketer
 from .metrics import DecodeCacheMetrics
 
 
-def _wrap_donating(num_layers, tree, jax_mod, call, n_fixed=4, n_out=1):
+def _wrap_donating(num_layers, tree, jax_mod, call, n_fixed=4, n_out=1,
+                   n_groups=2):
     """Flatten a pool-donating step fn to the positional-array calling
     convention CompiledModelCache keys and compiles on:
-    ``(*fixed, *k_pools, *v_pools, *param_leaves)``.  `call(params,
-    fixed, k_pools, v_pools)` adapts to the inner fn's own argument
-    order and returns ``(out, k_out, v_out)`` — `out` a single array
-    when n_out == 1, else a tuple of n_out arrays (the ragged step's
-    ids + logits)."""
+    ``(*fixed, *state_groups, *param_leaves)`` where the state is
+    `n_groups` length-L array groups — k/v pools (n_groups == 2), plus
+    the k/v scale arrays for quantized pools (n_groups == 4, the
+    DeviceKVPool.take_pool_state layout).  `call(params, fixed,
+    *groups)` adapts to the inner fn's own argument order and returns
+    ``(out, *groups_out)`` — `out` a single array when n_out == 1,
+    else a tuple of n_out arrays (the ragged step's ids + logits)."""
     unflatten = jax_mod.tree_util.tree_unflatten
 
     def step(*flat):
         fixed, leaves = flat[:n_fixed], flat[n_fixed:]
-        k_pools = list(leaves[:num_layers])
-        v_pools = list(leaves[num_layers:2 * num_layers])
-        params = unflatten(tree, leaves[2 * num_layers:])
-        out, k_out, v_out = call(params, fixed, k_pools, v_pools)
+        groups = [list(leaves[g * num_layers:(g + 1) * num_layers])
+                  for g in range(n_groups)]
+        params = unflatten(tree, leaves[n_groups * num_layers:])
+        out, *groups_out = call(params, fixed, *groups)
         outs = (out,) if n_out == 1 else tuple(out)
-        return (*outs, *k_out, *v_out)
+        flat_state = [a for grp in groups_out for a in grp]
+        return (*outs, *flat_state)
 
     return step
 
 
-# pools sit at wrapper args n_fixed .. n_fixed+2L in that convention:
-# donated so XLA updates the KV storage in place instead of copying the
-# pool every call
-def _pool_donate_plan(num_layers, n_fixed=4):
-    return tuple(range(n_fixed, n_fixed + 2 * num_layers))
+# the pool state sits at wrapper args n_fixed .. n_fixed+n_groups*L in
+# that convention: donated so XLA updates the KV storage (and, for int8
+# pools, the scale arrays) in place instead of copying every call
+def _pool_donate_plan(num_layers, n_fixed=4, n_groups=2):
+    return tuple(range(n_fixed, n_fixed + n_groups * num_layers))
 
 
 def _shard_params(model, mesh, tp_axis, jax_mod):
@@ -109,17 +114,27 @@ def _shard_params(model, mesh, tp_axis, jax_mod):
 
 
 def _collective_bytes_estimate(num_layers, rows, d_model, tp_degree,
-                               itemsize=4):
-    """Estimated on-wire allreduce bytes of ONE sharded dispatch — the
-    profile hook EQuARX-style quantized collectives will be judged
-    against (generation.collective_bytes_per_step).  The sharded step
-    has two allreduces per layer (after wo and after w2), each over the
-    [rows, d_model] fp32 activation block; a ring allreduce moves
+                               itemsize=4, quantized=False):
+    """Estimated on-wire allreduce bytes of ONE sharded dispatch
+    (generation.collective_bytes_per_step).  The sharded step has two
+    allreduces per layer (after wo and after w2), each over the
+    [rows, d_model] activation block; a ring allreduce moves
     2*(N-1)/N of the payload per device.  `rows` is the PADDED batch
     (or chunk) actually dispatched — padding rows ride the collective
-    whether live or not.  Zero when unsharded."""
+    whether live or not.  Zero when unsharded.
+
+    `quantized` is the EQuARX-style ring
+    (parallel.quantized_allreduce): int8 payload on every hop plus the
+    per-hop f32 scale scalars — the ~4x cut the quantized-collectives
+    acceptance criterion measures against this same estimate."""
     if tp_degree <= 1:
         return 0
+    if quantized:
+        from ..parallel.quantized_allreduce import (
+            quantized_collective_bytes)
+
+        return quantized_collective_bytes(num_layers, rows, d_model,
+                                          tp_degree)
     payload = int(rows) * int(d_model) * int(itemsize)
     return int(2 * num_layers * payload * 2 * (tp_degree - 1)
                / tp_degree)
@@ -128,22 +143,55 @@ def _collective_bytes_estimate(num_layers, rows, d_model, tp_degree,
 def _dispatch_donating(cache, exec_cache, args, num_layers, n_out=1):
     """Run ONE compiled pool-donating dispatch: compile/fetch the
     executable for `args`' signature, dispatch, install the returned
-    pools.  On ANY failure past the dispatch the donated pool buffers
+    pool state.  On ANY failure past the dispatch the donated buffers
     are gone — leave the cache on fresh storage so the engine's
     fail-the-batch-and-keep-serving recovery (engine._worker) actually
     keeps serving.  This recovery contract lives HERE, once, for every
     pool-donating step (fused decode, chunked prefill, ragged).
     Returns the non-pool output (a tuple when n_out > 1),
     unmaterialized (no host sync)."""
+    n_state = getattr(cache, "n_state_groups", 2) * num_layers
     exe = exec_cache.get(args)
     try:
         outs = exe(*args)
-        pools = outs[n_out:]
-        cache.put_pools(pools[:num_layers], pools[num_layers:])
+        cache.put_pool_state(list(outs[n_out:n_out + n_state]))
     except BaseException:
         cache.reset_pools()
         raise
     return outs[0] if n_out == 1 else tuple(outs[:n_out])
+
+
+def _param_structs(jax_mod, mesh, param_leaves):
+    """ShapeDtypeStructs of the param leaves (sharded under a mesh) —
+    the pre-warm signature tail shared by every donating step."""
+    sds = jax_mod.ShapeDtypeStruct
+    if mesh is not None:
+        return [sds(tuple(p.shape), p.dtype, sharding=p.sharding)
+                for p in param_leaves]
+    return [sds(tuple(p.shape), p.dtype) for p in param_leaves]
+
+
+def _state_structs(jax_mod, cache, mesh, num_layers, quant):
+    """ShapeDtypeStructs of the donated pool state (k/v pools, plus the
+    [P, H] scale arrays for quantized pools), sharded under a mesh so
+    pre-warm lowers the REAL signature."""
+    sds = jax_mod.ShapeDtypeStruct
+    pool = cache.layer_pools(0)[0]
+    if mesh is not None:
+        pool_sds = sds(tuple(pool.shape), pool.dtype,
+                       sharding=cache.pool_sharding)
+    else:
+        pool_sds = sds(tuple(pool.shape), pool.dtype)
+    structs = [pool_sds] * (2 * num_layers)
+    if quant:
+        sshape = (cache.num_pages, cache.num_heads)
+        if mesh is not None:
+            scale_sds = sds(sshape, np.dtype(np.float32),
+                            sharding=cache.scale_sharding)
+        else:
+            scale_sds = sds(sshape, np.dtype(np.float32))
+        structs += [scale_sds] * (2 * num_layers)
+    return structs
 
 
 def decode_batch_menu(max_slots):
@@ -169,7 +217,8 @@ class FusedDecodeStep:
     actual call sites, not estimated."""
 
     def __init__(self, model, cache, metrics, use_kernel=False,
-                 batch_buckets=None, mesh=None, tp_axis=None):
+                 batch_buckets=None, mesh=None, tp_axis=None,
+                 quant_collectives=False):
         import jax
 
         self._jax = jax
@@ -179,6 +228,9 @@ class FusedDecodeStep:
         self._tp_axis = tp_axis
         self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
         self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._quant = bool(getattr(cache, "quantized", False))
+        self._quant_collectives = bool(quant_collectives) and self._tp > 1
+        self._n_groups = 4 if self._quant else 2
         self._param_leaves, self._param_tree = _shard_params(
             model, mesh, tp_axis, jax)
         if not batch_buckets:
@@ -189,23 +241,33 @@ class FusedDecodeStep:
         self._bucketer = ShapeBucketer(batch_buckets=menu_b,
                                        length_buckets=pages_menu)
         cache_metrics = DecodeCacheMetrics(metrics)
-        # mesh kwargs only reach mesh-aware models: the unsharded path
-        # keeps working against the original decode_step_fn protocol
+        # mesh kwargs only reach mesh-aware models, and the quantized
+        # kwargs only reach quant-aware models: the plain path keeps
+        # working against the original decode_step_fn protocol
         step_kw = ({"mesh": mesh, "tp_axis": tp_axis}
                    if mesh is not None else {})
+        if self._quant:
+            step_kw["kv_quant"] = True
+        if self._quant_collectives:
+            step_kw["quant_collectives"] = True
         self._exec = {}
         for greedy in (False, True):
             fn = model.decode_step_fn(
                 cache.page_size, cache.num_pages, use_kernel=use_kernel,
                 pool_layout=cache.pool_layout, greedy=greedy, **step_kw)
-            # fixed args: (tokens, positions, page_tables, lens)
+            # fixed args: (tokens, positions, page_tables, lens); the
+            # state groups (k/v pools, plus k/v scales for quantized
+            # pools) sit contiguously in the model fn's *rest order, so
+            # one splat lambda serves both group layouts
             wrapped = _wrap_donating(
                 self._num_layers, self._param_tree, jax,
-                lambda params, f, k, v, fn=fn: fn(params, f[0], f[1],
-                                                  k, v, f[2], f[3]))
+                lambda params, f, *gs, fn=fn: fn(params, f[0], f[1],
+                                                 *gs, f[2], f[3]),
+                n_groups=self._n_groups)
             self._exec[greedy] = CompiledModelCache(
                 wrapped, metrics=cache_metrics, aot=True,
-                donate_argnums=_pool_donate_plan(self._num_layers))
+                donate_argnums=_pool_donate_plan(
+                    self._num_layers, n_groups=self._n_groups))
         self.last_dispatches = 0
         self.last_syncs = 0
         self.last_collective_bytes = 0
@@ -241,20 +303,11 @@ class FusedDecodeStep:
         bucket_p = self._bucketer.length_bucket(max(int(pages_cols), 1))
         sds = self._jax.ShapeDtypeStruct
         i32 = np.dtype(np.int32)
-        pool = self._cache.layer_pools(0)[0]
         args = [sds((bucket_b,), i32), sds((bucket_b,), i32),
                 sds((bucket_b, bucket_p), i32), sds((bucket_b,), i32)]
-        if self._mesh is not None:
-            pool_sds = sds(tuple(pool.shape), pool.dtype,
-                           sharding=self._cache.pool_sharding)
-            args += [pool_sds] * (2 * self._num_layers)
-            args += [sds(tuple(p.shape), p.dtype, sharding=p.sharding)
-                     for p in self._param_leaves]
-        else:
-            args += [sds(tuple(pool.shape), pool.dtype)] * \
-                (2 * self._num_layers)
-            args += [sds(tuple(p.shape), p.dtype)
-                     for p in self._param_leaves]
+        args += _state_structs(self._jax, self._cache, self._mesh,
+                               self._num_layers, self._quant)
+        args += _param_structs(self._jax, self._mesh, self._param_leaves)
         cache = self._exec[bool(greedy)]
         before = cache.compile_count
         cache.get(args)
@@ -280,8 +333,8 @@ class FusedDecodeStep:
         ln[:b_real] = lens
         pt = np.zeros((bucket_b, bucket_p), np.int32)
         pt[:b_real, :page_tables.shape[1]] = page_tables
-        k_pools, v_pools = self._cache.take_pools()
-        args = [tok, pos, pt, ln, *k_pools, *v_pools, *self._param_leaves]
+        state = self._cache.take_pool_state()
+        args = [tok, pos, pt, ln, *state, *self._param_leaves]
         out = _dispatch_donating(self._cache, self._exec[bool(greedy)],
                                  args, self._num_layers)
         host = np.asarray(out)                 # the single host sync
@@ -292,7 +345,8 @@ class FusedDecodeStep:
         self.last_rows_useful = b_real
         self.last_rows_dispatched = bucket_b
         self.last_collective_bytes = _collective_bytes_estimate(
-            self._num_layers, bucket_b, self._d_model, self._tp)
+            self._num_layers, bucket_b, self._d_model, self._tp,
+            quantized=self._quant_collectives)
         return host[:b_real]
 
 
@@ -327,7 +381,8 @@ class ChunkedPrefillStep:
     page (see the pre-dispatch guard in `run`)."""
 
     def __init__(self, model, cache, metrics, chunk_tokens,
-                 use_kernel=False, mesh=None, tp_axis=None):
+                 use_kernel=False, mesh=None, tp_axis=None,
+                 quant_collectives=False):
         import jax
 
         self._cache = cache
@@ -337,6 +392,9 @@ class ChunkedPrefillStep:
         self._num_layers = int(cache.num_layers)
         self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
         self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._quant = bool(getattr(cache, "quantized", False))
+        self._quant_collectives = bool(quant_collectives) and self._tp > 1
+        self._n_groups = 4 if self._quant else 2
         self._param_leaves, self._param_tree = _shard_params(
             model, mesh, tp_axis, jax)
         pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
@@ -344,21 +402,28 @@ class ChunkedPrefillStep:
                                        length_buckets=pages_menu)
         chunk_kw = ({"mesh": mesh, "tp_axis": tp_axis}
                     if mesh is not None else {})
+        if self._quant:
+            chunk_kw["kv_quant"] = True
+        if self._quant_collectives:
+            chunk_kw["quant_collectives"] = True
         fn = model.prefill_chunk_fn(
             cache.page_size, cache.num_pages, use_kernel=use_kernel,
             pool_layout=cache.pool_layout, **chunk_kw)
         self.last_collective_bytes = 0
-        # fixed args: (tokens, start, length, page_table); pools donated
-        # exactly like the fused decode step; compiles/hits land under
-        # the PREFILL cache metrics (a chunk executable IS a prefill
-        # executable)
+        # fixed args: (tokens, start, length, page_table); pool state
+        # donated exactly like the fused decode step (state groups
+        # contiguous in the model fn's *rest order); compiles/hits
+        # land under the PREFILL cache metrics (a chunk executable IS
+        # a prefill executable)
         wrapped = _wrap_donating(
             self._num_layers, self._param_tree, jax,
-            lambda params, f, k, v: fn(params, f[0], f[1], f[2],
-                                       k, v, f[3]))
+            lambda params, f, *gs: fn(params, f[0], f[1], f[2],
+                                      *gs, f[3]),
+            n_groups=self._n_groups)
         self._exec = CompiledModelCache(
             wrapped, metrics=metrics, aot=True,
-            donate_argnums=_pool_donate_plan(self._num_layers))
+            donate_argnums=_pool_donate_plan(self._num_layers,
+                                             n_groups=self._n_groups))
 
     @property
     def compile_count(self):
@@ -391,11 +456,12 @@ class ChunkedPrefillStep:
         bucket_p = self._bucketer.length_bucket(pt_row.shape[1])
         pt = np.zeros((bucket_p,), np.int32)
         pt[:pt_row.shape[1]] = pt_row[0]
-        k_pools, v_pools = self._cache.take_pools()
+        state = self._cache.take_pool_state()
         args = [tok, np.int32(start), np.int32(n), pt,
-                *k_pools, *v_pools, *self._param_leaves]
+                *state, *self._param_leaves]
         self.last_collective_bytes = _collective_bytes_estimate(
-            self._num_layers, self._chunk, self._d_model, self._tp)
+            self._num_layers, self._chunk, self._d_model, self._tp,
+            quantized=self._quant_collectives)
         # chunk-axis padding rows (chunk - n) are masked dummy work
         # inside this sequence's dispatch (generation.padded_token_waste)
         self.last_rows_useful = n
@@ -439,7 +505,8 @@ class RaggedStep:
     meaning what they always did on the legacy path)."""
 
     def __init__(self, model, cache, metrics, max_tokens, max_seqs,
-                 use_kernel=False, mesh=None, tp_axis=None):
+                 use_kernel=False, mesh=None, tp_axis=None,
+                 quant_collectives=False):
         import jax
 
         self._jax = jax
@@ -454,6 +521,9 @@ class RaggedStep:
         self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
         self._d_model = int(model.num_heads) * int(model.head_dim)
         self._use_kernel = bool(use_kernel)
+        self._quant = bool(getattr(cache, "quantized", False))
+        self._quant_collectives = bool(quant_collectives) and self._tp > 1
+        self._n_groups = 4 if self._quant else 2
         self._param_leaves, self._param_tree = _shard_params(
             model, mesh, tp_axis, jax)
         pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
@@ -461,20 +531,26 @@ class RaggedStep:
                                        length_buckets=pages_menu)
         step_kw = ({"mesh": mesh, "tp_axis": tp_axis}
                    if mesh is not None else {})
+        if self._quant:
+            step_kw["kv_quant"] = True
+        if self._quant_collectives:
+            step_kw["quant_collectives"] = True
         fn = model.ragged_step_fn(
             cache.page_size, cache.num_pages, use_kernel=use_kernel,
             pool_layout=cache.pool_layout, **step_kw)
         # fixed args: (tokens, positions, pages, rows, page_tables,
-        #              starts, lens, kv_lens); pools donated after them
+        #              starts, lens, kv_lens); pool state donated after
+        # them (scale groups trail the pools for quantized caches)
         self._n_fixed = 8
         wrapped = _wrap_donating(
             self._num_layers, self._param_tree, jax,
-            lambda params, f, k, v: fn(params, *f, k, v),
-            n_fixed=self._n_fixed, n_out=2)
+            lambda params, f, *gs: fn(params, *f, *gs),
+            n_fixed=self._n_fixed, n_out=2, n_groups=self._n_groups)
         self._exec = CompiledModelCache(
             wrapped, metrics=DecodeCacheMetrics(metrics), aot=True,
             donate_argnums=_pool_donate_plan(self._num_layers,
-                                             self._n_fixed))
+                                             self._n_fixed,
+                                             n_groups=self._n_groups))
         self.last_dispatches = 0
         self.last_collective_bytes = 0
         self.last_rows_useful = 0
@@ -512,20 +588,11 @@ class RaggedStep:
         greedy axis, so this is the WHOLE pre-warm surface.  Returns
         True when this call actually compiled."""
         bucket_p = self._bucketer.length_bucket(max(int(pages_cols), 1))
-        sds = self._jax.ShapeDtypeStruct
-        pool = self._cache.layer_pools(0)[0]
-        args = self._fixed_structs(bucket_p)
-        if self._mesh is not None:
-            pool_sds = sds(tuple(pool.shape), pool.dtype,
-                           sharding=self._cache.pool_sharding)
-            args += [pool_sds] * (2 * self._num_layers)
-            args += [sds(tuple(p.shape), p.dtype, sharding=p.sharding)
-                     for p in self._param_leaves]
-        else:
-            args += [sds(tuple(pool.shape), pool.dtype)] * \
-                (2 * self._num_layers)
-            args += [sds(tuple(p.shape), p.dtype)
-                     for p in self._param_leaves]
+        args = (self._fixed_structs(bucket_p)
+                + _state_structs(self._jax, self._cache, self._mesh,
+                                 self._num_layers, self._quant)
+                + _param_structs(self._jax, self._mesh,
+                                 self._param_leaves))
         before = self._exec.compile_count
         self._exec.get(args)
         return self._exec.compile_count > before
@@ -569,9 +636,9 @@ class RaggedStep:
         ln[:s_real] = lens
         kv = np.zeros((s,), np.int32)
         kv[:s_real] = kv_lens
-        k_pools, v_pools = self._cache.take_pools()
+        state = self._cache.take_pool_state()
         args = [tok, pos, pg, rw, pt, st, ln, kv,
-                *k_pools, *v_pools, *self._param_leaves]
+                *state, *self._param_leaves]
         ids, logits = _dispatch_donating(
             self._cache, self._exec, args, self._num_layers, n_out=2)
         # the FLOP proxy mirrors the TILED KERNEL's skip rule — only
@@ -591,5 +658,6 @@ class RaggedStep:
         self.last_rows_useful = t_real
         self.last_rows_dispatched = t
         self.last_collective_bytes = _collective_bytes_estimate(
-            self._num_layers, t, self._d_model, self._tp)
+            self._num_layers, t, self._d_model, self._tp,
+            quantized=self._quant_collectives)
         return ids, logits

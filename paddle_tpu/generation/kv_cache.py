@@ -66,6 +66,18 @@ class OutOfPagesError(RuntimeError):
     than corrupting another sequence's pages."""
 
 
+class KVQuantMismatchError(ValueError):
+    """A page payload crossed a quantization boundary: an int8 pool was
+    handed a float payload (or a payload without its scale arrays), or
+    a float pool was handed int8 pages.  Typed and LOUD — a
+    heterogeneous fleet (bf16 replica adopting an int8 replica's warm
+    run, or vice versa) must fail the transfer, never install bytes the
+    receiving pool would silently mis-decode.  Subclasses ValueError so
+    the serving tier's adoption/migration fallbacks (which already
+    catch ValueError and degrade to a cold path) stay graceful while
+    direct cache callers get the specific type."""
+
+
 class UnknownSequenceError(KeyError):
     """A cache operation named a seq_id the cache does not hold — never
     allocated, already freed, or double-freed.  Typed (and loud) so a
@@ -141,6 +153,14 @@ class PagedKVCache:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.dtype = np.dtype(dtype)
+        # int8 storage: pools carry a per-page per-head float32 abs-max
+        # scale beside the bytes (quantized_kv.py owns the math; every
+        # write path quantizes, every read path dequantizes in-kernel
+        # or at gather).  Scales are state: they reset when a page
+        # returns to the allocator, ride COW copies, and ship with
+        # exports — "quantized" gates all of it.
+        self.quantized = self.dtype == np.dtype(np.int8)
+        self._scale_bytes = 0  # scale traffic (subset of _bytes_moved)
         # LIFO free list: a just-freed (cache-warm) page is reused first
         self._free = list(range(self.num_pages - 1, -1, -1))
         self._tables = {}    # seq_id -> [page ids]
@@ -184,6 +204,44 @@ class PagedKVCache:
                  self.num_heads, self.head_dim)
         self.k_pool = np.zeros(shape, self.dtype)
         self.v_pool = np.zeros(shape, self.dtype)
+        if self.quantized:
+            sshape = (self.num_layers, self.num_pages, self.num_heads)
+            self.k_scale = np.zeros(sshape, np.float32)
+            self.v_scale = np.zeros(sshape, np.float32)
+
+    def _reset_page_scale(self, page):
+        """Zero a just-allocated page's scales: quantization grids are
+        per-page state and a reused page must quantize exactly like a
+        fresh one (a stale large scale would both coarsen the new
+        sequence's grid and make its bytes depend on pool history —
+        the determinism the int8-vs-int8 oracle pins)."""
+        self.k_scale[:, page] = 0.0
+        self.v_scale[:, page] = 0.0
+
+    def layer_scales(self, layer):
+        """One layer's ``(k_scale, v_scale)`` page-head scale arrays
+        ``[P, H]`` for the attention dequant (None pair when the pool
+        is not quantized)."""
+        if not self.quantized:
+            return None, None
+        return self.k_scale[layer], self.v_scale[layer]
+
+    def _count_scale_payload(self, n_pages, layers):
+        """Scale bytes a quantized write (or transfer) moves alongside
+        the int8 payload — scales are bytes in flight too, folded into
+        _bytes_moved AND tracked separately for the
+        generation.kv_scale_bytes counter."""
+        if not self.quantized or not n_pages:
+            return
+        b = int(2 * layers * n_pages * self.num_heads * 4)
+        self._bytes_moved += b
+        self._scale_bytes += b
+
+    def take_scale_bytes(self):
+        """Scale bytes accumulated since the last take (already folded
+        into take_bytes_moved's total)."""
+        n, self._scale_bytes = self._scale_bytes, 0
+        return n
 
     def _table(self, seq_id):
         """The page table of a LIVE sequence; typed failure otherwise."""
@@ -281,6 +339,8 @@ class PagedKVCache:
     def _take_owned_page(self):
         page = self._take_page()
         self._refs[page] = 1
+        if self.quantized:
+            self._reset_page_scale(page)
         return page
 
     def _incref(self, page):
@@ -352,9 +412,15 @@ class PagedKVCache:
     def _copy_page_storage(self, src, dst):
         """Copy one physical page's K/V across every layer (the COW
         copy).  Host backend: in-place numpy; DeviceKVPool overrides
-        with a single donated dispatch."""
+        with a single donated dispatch.  Quantized pools copy the
+        SCALE rows with the bytes — int8 content is meaningless apart
+        from its grid, so a COW copy that dropped the scales would
+        silently re-ground the private copy on a zero grid."""
         self.k_pool[:, dst] = self.k_pool[:, src]
         self.v_pool[:, dst] = self.v_pool[:, src]
+        if self.quantized:
+            self.k_scale[:, dst] = self.k_scale[:, src]
+            self.v_scale[:, dst] = self.v_scale[:, src]
 
     def match_prefix(self, tokens):
         """Longest cached page run matching a strict prefix of `tokens`.
@@ -452,14 +518,22 @@ class PagedKVCache:
         """Copy the given physical pages out of the pool as canonical
         ``[L, n, page_size, H, D]`` K/V arrays (pool dtype, bitwise the
         stored rows).  Counts the payload into bytes_moved — an export
-        crosses the replica boundary by definition."""
+        crosses the replica boundary by definition.  Quantized pools
+        return a 4-tuple ``(k, v, k_scale, v_scale)`` with the
+        ``[L, n, H]`` scale rows — int8 bytes never travel without
+        their grid."""
         idx = np.asarray(pages, np.int64).reshape(-1)
         k = np.ascontiguousarray(self.k_pool[:, idx])
         v = np.ascontiguousarray(self.v_pool[:, idx])
         self._bytes_moved += k.nbytes + v.nbytes
-        return k, v
+        if not self.quantized:
+            return k, v
+        ks = np.ascontiguousarray(self.k_scale[:, idx])
+        vs = np.ascontiguousarray(self.v_scale[:, idx])
+        self._count_scale_payload(len(idx), self.num_layers)
+        return k, v, ks, vs
 
-    def _check_import_payload(self, k, v):
+    def _check_import_payload(self, k, v, k_scale, v_scale):
         want = (self.num_layers, k.shape[1], self.page_size,
                 self.num_heads, self.head_dim)
         if k.shape != want or v.shape != want:
@@ -467,20 +541,43 @@ class PagedKVCache:
                 f"import payload shape {k.shape}/{v.shape} does not "
                 f"match this pool's [L, n, page_size, H, D] = {want} — "
                 f"pages only move between layout-compatible replicas")
+        # the quantization boundary is typed and loud: int8 bytes into
+        # a float pool (or float bytes into an int8 pool, or int8 bytes
+        # arriving scale-less) would install content the receiver
+        # mis-decodes — the heterogeneous-fleet corruption class
+        payload_q = np.dtype(k.dtype) == np.dtype(np.int8)
+        if payload_q != self.quantized:
+            raise KVQuantMismatchError(
+                f"page payload dtype {np.dtype(k.dtype)} does not match "
+                f"this pool's kv_dtype {self.dtype}: quantized and "
+                f"float replicas cannot trade pages")
+        if self.quantized and (k_scale is None or v_scale is None):
+            raise KVQuantMismatchError(
+                "int8 page payload arrived without its scale arrays — "
+                "refusing to install bytes with no grid")
+        if self.quantized:
+            swant = (self.num_layers, k.shape[1], self.num_heads)
+            if np.shape(k_scale) != swant or np.shape(v_scale) != swant:
+                raise KVQuantMismatchError(
+                    f"scale payload shape {np.shape(k_scale)}/"
+                    f"{np.shape(v_scale)} does not match [L, n, H] = "
+                    f"{swant}")
 
-    def import_pages(self, k, v):
+    def import_pages(self, k, v, k_scale=None, v_scale=None):
         """Allocate fresh pages and install a canonical
         ``[L, n, page_size, H, D]`` K/V payload into them; returns the
         new page ids (each refcount 1, owned by the caller — hand them
         to adopt_imported or register-and-free them).  Evicts cached
         refcount-0 runs (LRU) under pool pressure before raising
-        OutOfPagesError, exactly like reserve."""
+        OutOfPagesError, exactly like reserve.  Quantized pools require
+        the ``[L, n, H]`` scale payloads (KVQuantMismatchError
+        otherwise — see _check_import_payload)."""
         k = np.asarray(k)
         v = np.asarray(v)
         n = int(k.shape[1]) if k.ndim >= 2 else 0
         if n == 0:
             return []
-        self._check_import_payload(k, v)
+        self._check_import_payload(k, v, k_scale, v_scale)
         if n > len(self._free):
             self._evict_prefix(n - len(self._free))
         if n > len(self._free):
@@ -488,17 +585,23 @@ class PagedKVCache:
                 f"cannot import {n} pages: only {len(self._free)} free "
                 f"even after evicting cached prefix runs")
         pages = [self._take_owned_page() for _ in range(n)]
-        self._install_pages(pages, k, v)
+        self._install_pages(pages, k, v, k_scale, v_scale)
         self._bytes_moved += k.nbytes + v.nbytes
+        self._count_scale_payload(n, self.num_layers)
         return pages
 
-    def _install_pages(self, pages, k, v):
+    def _install_pages(self, pages, k, v, k_scale=None, v_scale=None):
         """Write a canonical import payload into freshly-owned pages
         (host backend: in-place numpy; DeviceKVPool overrides with one
-        donated dispatch per pool list)."""
+        donated dispatch per pool list).  Installing OVERWRITES the
+        pages' scales with the payload's — imported bytes keep the
+        exporter's grid bitwise."""
         idx = np.asarray(pages, np.int64)
         self.k_pool[:, idx] = np.asarray(k, self.dtype)
         self.v_pool[:, idx] = np.asarray(v, self.dtype)
+        if self.quantized:
+            self.k_scale[:, idx] = np.asarray(k_scale, np.float32)
+            self.v_scale[:, idx] = np.asarray(v_scale, np.float32)
 
     def adopt_imported(self, seq_id, pages, length):
         """Install freshly-imported pages as `seq_id`'s table with
@@ -520,7 +623,7 @@ class PagedKVCache:
         table.extend(int(p) for p in pages)
         self._lens[seq_id] = length
 
-    def import_prefix_run(self, tokens, k, v):
+    def import_prefix_run(self, tokens, k, v, k_scale=None, v_scale=None):
         """Adopt a sibling-exported prefix run into THIS pool and
         prefix index: install the page bytes (import_pages), register
         the chain under a throwaway sequence, and free it — registered
@@ -543,7 +646,7 @@ class PagedKVCache:
             raise ValueError(
                 f"{len(tokens)} tokens cannot cover {n} imported pages "
                 f"of {self.page_size}")
-        pages = self.import_pages(k, v)
+        pages = self.import_pages(k, v, k_scale, v_scale)
         sid = ("__prefix_import__", self._import_seq)
         self._import_seq += 1
         self.allocate(sid)
@@ -780,8 +883,18 @@ class PagedKVCache:
         """Write one token's K/V for one layer at position `pos` (already
         reserved).  k, v: ``[num_heads, head_dim]``."""
         page, row = self._locate(seq_id, pos)
-        self.k_pool[layer, page, row] = np.asarray(k, self.dtype)
-        self.v_pool[layer, page, row] = np.asarray(v, self.dtype)
+        if self.quantized:
+            from .quantized_kv import host_quantized_write
+
+            host_quantized_write(
+                self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                slice(layer, layer + 1), page, row,
+                np.asarray(k, np.float32)[None, None],
+                np.asarray(v, np.float32)[None, None])
+            self._count_scale_payload(1, 1)
+        else:
+            self.k_pool[layer, page, row] = np.asarray(k, self.dtype)
+            self.v_pool[layer, page, row] = np.asarray(v, self.dtype)
         self._count_write_payload(1, 1)
 
     def write_decode_tokens(self, seq_ids, positions, layer, k, v):
@@ -811,8 +924,18 @@ class PagedKVCache:
         ``[num_layers, num_heads, head_dim]``.  Returns the position."""
         pos = self.reserve(seq_id, 1)
         page, row = self._locate(seq_id, pos)
-        self.k_pool[:, page, row] = np.asarray(k, self.dtype)
-        self.v_pool[:, page, row] = np.asarray(v, self.dtype)
+        if self.quantized:
+            from .quantized_kv import host_quantized_write
+
+            host_quantized_write(
+                self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                slice(None), page, row,
+                np.asarray(k, np.float32)[:, None],
+                np.asarray(v, np.float32)[:, None])
+            self._count_scale_payload(1, self.num_layers)
+        else:
+            self.k_pool[:, page, row] = np.asarray(k, self.dtype)
+            self.v_pool[:, page, row] = np.asarray(v, self.dtype)
         self._count_write_payload(1, self.num_layers)
         return pos
 
@@ -876,20 +999,42 @@ class PagedKVCache:
     def _write_span(self, seq_id, start, k, v, layers=slice(None)):
         """Page-by-page copy of one reserved span (k, v: [L, n, H, D],
         landing in pool rows `layers` — every layer by default; the
-        chunked-prefill per-layer write passes a single-layer slice)."""
-        k = np.asarray(k, self.dtype)
-        v = np.asarray(v, self.dtype)
+        chunked-prefill per-layer write passes a single-layer slice).
+        Quantized pools route each page's slice through the shared
+        quantized write transform (scale-max, page requant, row
+        quantize — quantized_kv.host_quantized_write)."""
+        quant = self.quantized
+        if quant:
+            from .quantized_kv import host_quantized_write
+
+            k = np.asarray(k, np.float32)
+            v = np.asarray(v, np.float32)
+        else:
+            k = np.asarray(k, self.dtype)
+            v = np.asarray(v, self.dtype)
         table = self._table(seq_id)
         n = k.shape[1]
         t = 0
+        pages_touched = 0
         while t < n:
             pos = start + t
             page = table[pos // self.page_size]
             row = pos % self.page_size
             take = min(self.page_size - row, n - t)
-            self.k_pool[layers, page, row:row + take] = k[:, t:t + take]
-            self.v_pool[layers, page, row:row + take] = v[:, t:t + take]
+            if quant:
+                host_quantized_write(
+                    self.k_pool, self.v_pool, self.k_scale,
+                    self.v_scale, layers, page, row,
+                    k[:, t:t + take], v[:, t:t + take])
+            else:
+                self.k_pool[layers, page, row:row + take] = \
+                    k[:, t:t + take]
+                self.v_pool[layers, page, row:row + take] = \
+                    v[:, t:t + take]
             t += take
+            pages_touched += 1
+        if quant:
+            self._count_scale_payload(pages_touched, k.shape[0])
         self._count_write_payload(n, k.shape[0])
 
     # --------------------------- reads ------------------------------
@@ -897,10 +1042,14 @@ class PagedKVCache:
         """One layer's ``(k, v)`` pools for the attention call, counted
         as host->device traffic: host-resident pools must ship the WHOLE
         pool to the device every step — the O(pool) cost DeviceKVPool
-        exists to remove."""
+        exists to remove.  Quantized pools ship their scale arrays too
+        (layer_scales) — counted here, since the attention call cannot
+        decode the int8 bytes without them."""
         k = self.k_pool[layer]
         v = self.v_pool[layer]
         self._bytes_moved += k.nbytes + v.nbytes
+        if self.quantized:
+            self._count_scale_payload(self.num_pages, 1)
         return k, v
 
     def gather_prefix(self, seq_id, layer, length):
@@ -923,6 +1072,18 @@ class PagedKVCache:
         v = self.v_pool[layer, pages].reshape(
             -1, self.num_heads, self.head_dim)[:length]
         self._bytes_moved += k.nbytes + v.nbytes
+        if self.quantized:
+            # the chunk reference takes dense rows: hand back the
+            # DEQUANTIZED values — exactly what the in-kernel dequant
+            # computes for the same bytes (same factor, quantized_kv)
+            from .quantized_kv import dequantize_int8
+
+            ks = np.repeat(self.k_scale[layer, pages], self.page_size,
+                           axis=0)[:length][:, :, None]
+            vs = np.repeat(self.v_scale[layer, pages], self.page_size,
+                           axis=0)[:length][:, :, None]
+            self._count_scale_payload(len(pages), 1)
+            return dequantize_int8(k, ks), dequantize_int8(v, vs)
         return k, v
 
     def count_fused_append(self, tokens):
@@ -931,7 +1092,11 @@ class PagedKVCache:
         payload never crosses the host<->device boundary at all — but the
         O(tokens) bound is counted anyway so ``generation.kv_bytes_moved``
         stays comparable across decode paths (it has always meant "bytes
-        the write moves or would move", see _count_write_payload)."""
+        the write moves or would move", see _count_write_payload).
+        Quantized pools count the per-token scale-row bound too (one
+        page's scales per written row, mirroring the eager write
+        paths) so kv_scale_bytes stays comparable across paths."""
+        self._count_scale_payload(int(tokens), self.num_layers)
         self._count_write_payload(int(tokens), self.num_layers)
 
     def take_bytes_moved(self):
@@ -1022,6 +1187,7 @@ class PagedKVCache:
         return {
             "num_pages": self.num_pages,
             "page_size": self.page_size,
+            "kv_dtype": str(self.dtype),
             "pages_in_use": self.pages_in_use,
             "pages_free": self.num_free_pages,
             "sequences": len(self._tables),
@@ -1096,6 +1262,95 @@ def _scatter_kv_all_layers(k_pools, v_pools, pages, rows, k, v, *, layout,
              for i, vp in enumerate(v_pools)])
 
 
+def _scatter_kv_quantized(k_pool, v_pool, k_scale, v_scale, pages, rows,
+                          k, v, *, layout, sharding=None,
+                          scale_sharding=None):
+    """Quantized sibling of _scatter_kv: one layer's int8 pools + their
+    [P, H] scale arrays through the shared three-step quantized write
+    (quantized_kv.quantized_pool_write).  All four arrays are donated;
+    shardings pinned like every other write path."""
+    from .quantized_kv import quantized_pool_write
+
+    kp, ks = quantized_pool_write(k_pool, k_scale, pages, rows, k, layout)
+    vp, vs = quantized_pool_write(v_pool, v_scale, pages, rows, v, layout)
+    return (_pin_sharding(kp, sharding), _pin_sharding(vp, sharding),
+            _pin_sharding(ks, scale_sharding),
+            _pin_sharding(vs, scale_sharding))
+
+
+def _scatter_kv_all_layers_quantized(k_pools, v_pools, k_scales, v_scales,
+                                     pages, rows, k, v, *, layout,
+                                     sharding=None, scale_sharding=None):
+    """Every layer's quantized scatter in ONE dispatch (k/v:
+    [L, n, H, D]) — the quantized _scatter_kv_all_layers."""
+    from .quantized_kv import quantized_pool_write
+
+    k_out, v_out, ks_out, vs_out = [], [], [], []
+    for i in range(len(k_pools)):
+        kp, ks = quantized_pool_write(k_pools[i], k_scales[i], pages,
+                                      rows, k[i], layout)
+        vp, vs = quantized_pool_write(v_pools[i], v_scales[i], pages,
+                                      rows, v[i], layout)
+        k_out.append(_pin_sharding(kp, sharding))
+        v_out.append(_pin_sharding(vp, sharding))
+        ks_out.append(_pin_sharding(ks, scale_sharding))
+        vs_out.append(_pin_sharding(vs, scale_sharding))
+    return k_out, v_out, ks_out, vs_out
+
+
+def _jitted_scatter_quantized(layout, sharding=None, scale_sharding=None):
+    """Cached jitted donated quantized scatters per (layout, sharding)
+    — the int8 sibling of _jitted_scatter."""
+    import functools
+
+    key = (layout, sharding, scale_sharding)
+    if key not in _SCATTER_Q_JIT:
+        import jax
+
+        _SCATTER_Q_JIT[key] = (
+            jax.jit(functools.partial(
+                _scatter_kv_quantized, layout=layout, sharding=sharding,
+                scale_sharding=scale_sharding),
+                donate_argnums=(0, 1, 2, 3)),
+            jax.jit(functools.partial(
+                _scatter_kv_all_layers_quantized, layout=layout,
+                sharding=sharding, scale_sharding=scale_sharding),
+                donate_argnums=(0, 1, 2, 3)))
+    return _SCATTER_Q_JIT[key]
+
+
+_SCATTER_Q_JIT = {}
+
+
+def _reset_scale_rows(k_scales, v_scales, pages, *, scale_sharding=None):
+    """Zero the scale rows of freshly allocated pages across every
+    layer in ONE donated dispatch (drop-mode: the padding sentinel
+    num_pages never lands) — the device form of the page-reuse scale
+    reset."""
+    def z(s):
+        out = s.at[pages].set(0.0, mode="drop")
+        return _pin_sharding(out, scale_sharding)
+
+    return [z(s) for s in k_scales], [z(s) for s in v_scales]
+
+
+def _jitted_scale_reset(scale_sharding=None):
+    import functools
+
+    key = scale_sharding
+    if key not in _SCALE_RESET_JIT:
+        import jax
+
+        _SCALE_RESET_JIT[key] = jax.jit(
+            functools.partial(_reset_scale_rows,
+                              scale_sharding=scale_sharding),
+            donate_argnums=(0, 1))
+    return _SCALE_RESET_JIT[key]
+
+
+_SCALE_RESET_JIT = {}
+
+
 def _copy_kv_pages(k_pools, v_pools, src, dst, *, layout, sharding=None):
     """Copy physical page `src` -> `dst` in every layer's pools — the
     copy-on-write body, ONE donated dispatch for all layers (the page
@@ -1133,6 +1388,76 @@ def _import_kv_pages(k_pools, v_pools, pages, k, v, *, layout,
 
     return ([put(kp, k[i]) for i, kp in enumerate(k_pools)],
             [put(vp, v[i]) for i, vp in enumerate(v_pools)])
+
+
+def _copy_kv_pages_quantized(k_pools, v_pools, k_scales, v_scales, src,
+                             dst, *, layout, sharding=None,
+                             scale_sharding=None):
+    """Quantized COW page copy: bytes AND scale rows move together in
+    the one donated dispatch (int8 content is meaningless apart from
+    its grid)."""
+    k_out, v_out = _copy_kv_pages(k_pools, v_pools, src, dst,
+                                  layout=layout, sharding=sharding)
+
+    def cp(s):
+        return _pin_sharding(s.at[dst].set(s[src]), scale_sharding)
+
+    return k_out, v_out, [cp(s) for s in k_scales], \
+        [cp(s) for s in v_scales]
+
+
+def _jitted_page_copy_quantized(layout, sharding=None,
+                                scale_sharding=None):
+    import functools
+
+    key = (layout, sharding, scale_sharding)
+    if key not in _PAGE_COPY_Q_JIT:
+        import jax
+
+        _PAGE_COPY_Q_JIT[key] = jax.jit(
+            functools.partial(_copy_kv_pages_quantized, layout=layout,
+                              sharding=sharding,
+                              scale_sharding=scale_sharding),
+            donate_argnums=(0, 1, 2, 3))
+    return _PAGE_COPY_Q_JIT[key]
+
+
+_PAGE_COPY_Q_JIT = {}
+
+
+def _import_kv_pages_quantized(k_pools, v_pools, k_scales, v_scales,
+                               pages, k, v, ks, vs, *, layout,
+                               sharding=None, scale_sharding=None):
+    """Quantized page import: the int8 payload installs bitwise and the
+    pages' scales are OVERWRITTEN with the exporter's [L, n, H] grid in
+    the same donated dispatch."""
+    k_out, v_out = _import_kv_pages(k_pools, v_pools, pages, k, v,
+                                    layout=layout, sharding=sharding)
+
+    def put(s, payload):
+        return _pin_sharding(s.at[pages].set(payload), scale_sharding)
+
+    return (k_out, v_out,
+            [put(s, ks[i]) for i, s in enumerate(k_scales)],
+            [put(s, vs[i]) for i, s in enumerate(v_scales)])
+
+
+def _jitted_import_quantized(layout, sharding=None, scale_sharding=None):
+    import functools
+
+    key = (layout, sharding, scale_sharding)
+    if key not in _IMPORT_Q_JIT:
+        import jax
+
+        _IMPORT_Q_JIT[key] = jax.jit(
+            functools.partial(_import_kv_pages_quantized, layout=layout,
+                              sharding=sharding,
+                              scale_sharding=scale_sharding),
+            donate_argnums=(0, 1, 2, 3))
+    return _IMPORT_Q_JIT[key]
+
+
+_IMPORT_Q_JIT = {}
 
 
 def _jitted_import(layout, sharding=None):
@@ -1225,8 +1550,10 @@ class DeviceKVPool(PagedKVCache):
         self.tp_axis = None
         self.tp_degree = 1
         self._sharding = None
+        self._scale_sharding = None
         if mesh is not None:
             from ..parallel.sharding_annotations import (kv_pool_spec,
+                                                         kv_scale_spec,
                                                          named_sharding)
 
             names = tuple(mesh.axis_names)
@@ -1243,6 +1570,8 @@ class DeviceKVPool(PagedKVCache):
                     f"of the mesh): the head axis is the shard axis")
             self._sharding = named_sharding(
                 mesh, *kv_pool_spec(pool_layout, self.tp_axis))
+            self._scale_sharding = named_sharding(
+                mesh, *kv_scale_spec(self.tp_axis))
         super().__init__(num_layers, num_heads, head_dim,
                          num_pages=num_pages, page_size=page_size,
                          dtype=dtype)
@@ -1252,6 +1581,12 @@ class DeviceKVPool(PagedKVCache):
         """The pools' NamedSharding (None when unsharded) — what the
         fused step's prewarm ShapeDtypeStructs must carry."""
         return self._sharding
+
+    @property
+    def scale_sharding(self):
+        """NamedSharding of the [P, H] scale arrays (heads sharded —
+        kv_scale_spec); None when unsharded or not quantized."""
+        return self._scale_sharding
 
     def _materialize_pools(self, shape):
         """Fresh zeroed per-layer pool storage in the pool's sharding —
@@ -1269,6 +1604,20 @@ class DeviceKVPool(PagedKVCache):
 
         self._k = [zeros() for _ in range(self.num_layers)]
         self._v = [zeros() for _ in range(self.num_layers)]
+        if self.quantized:
+            def zscale():
+                z = jnp.zeros((self.num_pages, self.num_heads),
+                              jnp.float32)
+                if self._scale_sharding is not None:
+                    z = jax.device_put(z, self._scale_sharding)
+                return z
+
+            self._ks = [zscale() for _ in range(self.num_layers)]
+            self._vs = [zscale() for _ in range(self.num_layers)]
+            # pages allocated since the last device write: their scale
+            # rows must zero before the next quantized write reads them
+            # (one batched donated dispatch, not one per allocation)
+            self._pending_scale_reset = []
 
     def _init_pools(self):
         import jax.numpy as jnp
@@ -1281,18 +1630,69 @@ class DeviceKVPool(PagedKVCache):
             shape = (self.num_pages, self.page_size,
                      self.num_heads, self.head_dim)
         self._materialize_pools(shape)
-        self._scatter, self._scatter_all = _jitted_scatter(
-            self.pool_layout, self._sharding)
+        if self.quantized:
+            self._scatter, self._scatter_all = _jitted_scatter_quantized(
+                self.pool_layout, self._sharding, self._scale_sharding)
+        else:
+            self._scatter, self._scatter_all = _jitted_scatter(
+                self.pool_layout, self._sharding)
+
+    # ---------------------- quantized-scale state --------------------
+    def _reset_page_scale(self, page):
+        """Defer the zeroing: allocations happen page-at-a-time in
+        reserve(), and a dispatch per page would swamp the decode loop.
+        The pending rows are flushed in ONE donated scatter before the
+        next read or write of the scale state."""
+        self._pending_scale_reset.append(int(page))
+
+    def _flush_scale_resets(self):
+        if not self.quantized or not self._pending_scale_reset:
+            return
+        pages = self._pending_scale_reset
+        self._pending_scale_reset = []
+        # pad to a power-of-two bucket with the drop sentinel so the
+        # jitted reset compiles O(log pool) signatures, not one per
+        # allocation burst size
+        m = 1
+        while m < len(pages):
+            m *= 2
+        padded = np.full((m,), self.num_pages, np.int32)
+        padded[:len(pages)] = pages
+        fn = _jitted_scale_reset(self._scale_sharding)
+        self._ks, self._vs = fn(self._ks, self._vs,
+                                self._jnp.asarray(padded))
+
+    def layer_scales(self, layer):
+        if not self.quantized:
+            return None, None
+        self._flush_scale_resets()
+        return self._ks[layer], self._vs[layer]
 
     # --------------------------- writes -----------------------------
+    def _pages_touched(self, pages):
+        """Distinct REAL pages in a scatter target list (sentinel
+        excluded) — the scale-traffic unit of a quantized write."""
+        arr = np.asarray(pages)
+        return int(len(np.unique(arr[arr < self.num_pages])))
+
     def _scatter_layer(self, layer, pages, rows, k, v, real_tokens):
         jnp = self._jnp
         kp, vp = self._k[layer], self._v[layer]
-        k = jnp.asarray(k).astype(self.dtype)
-        v = jnp.asarray(v).astype(self.dtype)
-        self._k[layer], self._v[layer] = self._scatter(
-            kp, vp, jnp.asarray(pages, jnp.int32),
-            jnp.asarray(rows, jnp.int32), k, v)
+        pg = jnp.asarray(np.asarray(pages), jnp.int32)
+        rw = jnp.asarray(np.asarray(rows), jnp.int32)
+        if self.quantized:
+            self._flush_scale_resets()
+            k = jnp.asarray(k).astype(jnp.float32)
+            v = jnp.asarray(v).astype(jnp.float32)
+            (self._k[layer], self._v[layer], self._ks[layer],
+             self._vs[layer]) = self._scatter(
+                kp, vp, self._ks[layer], self._vs[layer], pg, rw, k, v)
+            self._count_scale_payload(self._pages_touched(pages), 1)
+        else:
+            k = jnp.asarray(k).astype(self.dtype)
+            v = jnp.asarray(v).astype(self.dtype)
+            self._k[layer], self._v[layer] = self._scatter(
+                kp, vp, pg, rw, k, v)
         self._count_write_payload(real_tokens, 1)
 
     def write_token(self, seq_id, layer, pos, k, v):
@@ -1314,11 +1714,21 @@ class DeviceKVPool(PagedKVCache):
         (indices are the same per layer, so there is no reason to pay
         num_layers dispatch latencies)."""
         jnp = self._jnp
-        self._k, self._v = self._scatter_all(
-            self._k, self._v, jnp.asarray(pages, jnp.int32),
-            jnp.asarray(rows, jnp.int32),
-            jnp.asarray(k).astype(self.dtype),
-            jnp.asarray(v).astype(self.dtype))
+        pg = jnp.asarray(np.asarray(pages), jnp.int32)
+        rw = jnp.asarray(np.asarray(rows), jnp.int32)
+        if self.quantized:
+            self._flush_scale_resets()
+            self._k, self._v, self._ks, self._vs = self._scatter_all(
+                self._k, self._v, self._ks, self._vs, pg, rw,
+                jnp.asarray(k).astype(jnp.float32),
+                jnp.asarray(v).astype(jnp.float32))
+            self._count_scale_payload(self._pages_touched(pages),
+                                      self.num_layers)
+        else:
+            self._k, self._v = self._scatter_all(
+                self._k, self._v, pg, rw,
+                jnp.asarray(k).astype(self.dtype),
+                jnp.asarray(v).astype(self.dtype))
         self._count_write_payload(real_tokens, self.num_layers)
 
     def append(self, seq_id, k, v):
@@ -1391,6 +1801,7 @@ class DeviceKVPool(PagedKVCache):
         slice collects every device's head split into the canonical
         full-head payload."""
         jnp = self._jnp
+        self._flush_scale_resets()
         idx = jnp.asarray(np.asarray(pages, np.int32).reshape(-1))
         ks, vs = [], []
         for layer in range(self.num_layers):
@@ -1405,25 +1816,56 @@ class DeviceKVPool(PagedKVCache):
         k = np.stack(ks)
         v = np.stack(vs)
         self._bytes_moved += k.nbytes + v.nbytes
-        return k, v
+        if not self.quantized:
+            return k, v
+        kss = np.stack([np.asarray(self._ks[layer][idx])
+                        for layer in range(self.num_layers)])
+        vss = np.stack([np.asarray(self._vs[layer][idx])
+                        for layer in range(self.num_layers)])
+        self._count_scale_payload(int(idx.shape[0]), self.num_layers)
+        return k, v, kss, vss
 
-    def _install_pages(self, pages, k, v):
+    def _install_pages(self, pages, k, v, k_scale=None, v_scale=None):
         """Device import: one donated dispatch installs the canonical
         payload across every layer's pools, sharding pinned (a
         mesh-sharded pool comes back in its NamedSharding — the same
-        contract as every other write path)."""
+        contract as every other write path).  Quantized pools install
+        the exporter's scale rows in the same dispatch."""
         jnp = self._jnp
+        pg = jnp.asarray(np.asarray(pages, np.int32))
+        if self.quantized:
+            self._flush_scale_resets()
+            fn = _jitted_import_quantized(self.pool_layout,
+                                          self._sharding,
+                                          self._scale_sharding)
+            self._k, self._v, self._ks, self._vs = fn(
+                self._k, self._v, self._ks, self._vs, pg,
+                jnp.asarray(np.asarray(k, np.int8)),
+                jnp.asarray(np.asarray(v, np.int8)),
+                jnp.asarray(np.asarray(k_scale, np.float32)),
+                jnp.asarray(np.asarray(v_scale, np.float32)))
+            return
         fn = _jitted_import(self.pool_layout, self._sharding)
         self._k, self._v = fn(
-            self._k, self._v, jnp.asarray(np.asarray(pages, np.int32)),
+            self._k, self._v, pg,
             jnp.asarray(k).astype(self.dtype),
             jnp.asarray(v).astype(self.dtype))
 
     def _copy_page_storage(self, src, dst):
         """The COW page copy as ONE donated in-trace dispatch across
         every layer — the payload never crosses the host<->device
-        boundary (page-to-page inside the resident pools)."""
+        boundary (page-to-page inside the resident pools).  Quantized
+        pools copy the scale rows with the bytes."""
         jnp = self._jnp
+        if self.quantized:
+            self._flush_scale_resets()
+            fn = _jitted_page_copy_quantized(self.pool_layout,
+                                             self._sharding,
+                                             self._scale_sharding)
+            self._k, self._v, self._ks, self._vs = fn(
+                self._k, self._v, self._ks, self._vs, jnp.int32(src),
+                jnp.int32(dst))
+            return
         fn = _jitted_page_copy(self.pool_layout, self._sharding)
         self._k, self._v = fn(self._k, self._v, jnp.int32(src),
                               jnp.int32(dst))
@@ -1454,26 +1896,57 @@ class DeviceKVPool(PagedKVCache):
         else:
             k, v = kp[pages], vp[pages]
         shape = (-1, self.num_heads, self.head_dim)
-        return k.reshape(shape)[:length], v.reshape(shape)[:length]
+        k = k.reshape(shape)[:length]
+        v = v.reshape(shape)[:length]
+        if self.quantized:
+            # hand back DEQUANTIZED rows — the same per-page factor the
+            # in-kernel dequant applies to the same bytes
+            from .quantized_kv import dequantize_int8
 
-    def take_pools(self):
-        """Hand the live per-layer pool lists to a fused decode step for
-        DONATION: the caller passes them into a donate_argnums
-        executable (which invalidates them) and must give back the
-        returned pools via ``put_pools`` before anything else reads the
-        cache.  Returns ``(k_pools, v_pools)`` — length-L lists."""
-        return list(self._k), list(self._v)
+            self._flush_scale_resets()
+            ks = jnp.repeat(self._ks[layer][pages], self.page_size,
+                            axis=0)[:length][:, :, None]
+            vs = jnp.repeat(self._vs[layer][pages], self.page_size,
+                            axis=0)[:length][:, :, None]
+            return (dequantize_int8(k, ks, jnp),
+                    dequantize_int8(v, vs, jnp))
+        return k, v
 
-    def put_pools(self, k_pools, v_pools):
-        """Install the pools a fused step returned (the donation chain's
-        other half — same storage, updated in place by XLA)."""
-        if len(k_pools) != self.num_layers or \
-                len(v_pools) != self.num_layers:
+    @property
+    def n_state_groups(self):
+        """Length-L array groups in the donated pool state: k + v
+        pools, plus k + v scale arrays when quantized — what
+        take_pool_state returns and the fused wrappers split on."""
+        return 4 if self.quantized else 2
+
+    def take_pool_state(self):
+        """The WHOLE donated device state as one flat list —
+        ``[*k_pools, *v_pools]`` plus ``[*k_scales, *v_scales]`` when
+        quantized (scales are written in-trace by the quantized
+        scatter, so they ride the same donation chain as the pools).
+        Pending scale resets flush first: the executable must see
+        zeroed rows for freshly allocated pages."""
+        self._flush_scale_resets()
+        state = list(self._k) + list(self._v)
+        if self.quantized:
+            state += list(self._ks) + list(self._vs)
+        return state
+
+    def put_pool_state(self, state):
+        """Install the flat state list a donating dispatch returned
+        (the donation chain's other half)."""
+        want = self.n_state_groups * self.num_layers
+        if len(state) != want:
             raise ValueError(
-                f"expected {self.num_layers} pools per side, got "
-                f"{len(k_pools)}/{len(v_pools)}")
-        self._k = list(k_pools)
-        self._v = list(v_pools)
+                f"expected {want} state arrays "
+                f"({self.n_state_groups} groups x {self.num_layers} "
+                f"layers), got {len(state)}")
+        ll = self.num_layers
+        self._k = list(state[:ll])
+        self._v = list(state[ll:2 * ll])
+        if self.quantized:
+            self._ks = list(state[2 * ll:3 * ll])
+            self._vs = list(state[3 * ll:4 * ll])
 
     def reset_pools(self):
         """Reallocate zeroed pool storage after a donating dispatch died
@@ -1508,6 +1981,18 @@ class DeviceKVPool(PagedKVCache):
     @property
     def v_pool(self):
         return np.stack([self._canonical(p) for p in self._v])
+
+    @property
+    def k_scale(self):
+        """Host copy ``[L, P, H]`` of the quantized K scales
+        (debug/tests only — mirrors the host backend's attribute)."""
+        self._flush_scale_resets()
+        return np.stack([np.asarray(s) for s in self._ks])
+
+    @property
+    def v_scale(self):
+        self._flush_scale_resets()
+        return np.stack([np.asarray(s) for s in self._vs])
 
 
 def _jitted_scatter(layout, sharding=None):

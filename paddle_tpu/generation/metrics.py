@@ -133,6 +133,27 @@ Metric names:
                                       dispatches, in the same tile
                                       units — tiled < untiled is the
                                       measured out-of-span skip
+- ``generation.kv_quant_dtype``       gauge (string): the pool storage
+                                      dtype ("float32" / "bfloat16" /
+                                      "int8") stamped at engine build —
+                                      every snapshot says what
+                                      precision its numbers were
+                                      measured at
+- ``generation.kv_scale_bytes``       int8 scale bytes in flight
+                                      (writes, exports, imports, COW)
+                                      — a SUBSET of kv_bytes_moved
+                                      (scales are folded into the
+                                      total: bytes in flight are bytes
+                                      in flight), split out so the
+                                      quantization overhead is visible
+- ``generation.collective_quantized``  gauge: 1 when the EQuARX-style
+                                      quantized ring actually carries
+                                      the two per-layer allreduces, 0
+                                      otherwise — a requested-but-
+                                      inactive flag (no mesh, tp == 1)
+                                      reads 0, so a silent fp32
+                                      fallback is a stats fact
+                                      (mirrors kernel_path)
 - ``generation.mesh_devices``         gauge: tensor-parallel degree of
                                       the engine's mesh (1 unsharded)
 - ``generation.collective_bytes_per_step``  gauge: estimated on-wire
@@ -183,6 +204,9 @@ STEP_SCORE_BLOCKS = PREFIX + "step_score_blocks"
 STEP_SCORE_BLOCKS_UNTILED = PREFIX + "step_score_blocks_untiled"
 MESH_DEVICES = PREFIX + "mesh_devices"
 COLLECTIVE_BYTES_PER_STEP = PREFIX + "collective_bytes_per_step"
+KV_QUANT_DTYPE = PREFIX + "kv_quant_dtype"
+KV_SCALE_BYTES = PREFIX + "kv_scale_bytes"
+COLLECTIVE_QUANTIZED = PREFIX + "collective_quantized"
 PREFIX_CACHE_HIT_TOKENS = PREFIX + "prefix_cache_hit_tokens"
 PREFIX_CACHE_HIT_RATE = PREFIX + "prefix_cache_hit_rate"
 SHARED_PAGES = PREFIX + "shared_pages"
@@ -339,6 +363,27 @@ class GenerationMetrics:
         if untiled:
             self._stat(STEP_SCORE_BLOCKS).increase(int(tiled))
             self._stat(STEP_SCORE_BLOCKS_UNTILED).increase(int(untiled))
+
+    def set_kv_quant_dtype(self, dtype_name):
+        """Gauge (string): the KV pool storage dtype, stamped once at
+        engine build (the pool cannot change precision after)."""
+        self._stat(KV_QUANT_DTYPE).set(str(dtype_name))
+
+    def count_kv_scale_bytes(self, n):
+        """int8 scale traffic drained from the cache each step (already
+        folded into kv_bytes_moved; this is the split-out view).
+        Touches the stat even at 0 so quantized engines always carry
+        the key."""
+        stat = self._stat(KV_SCALE_BYTES)
+        if n:
+            stat.increase(int(n))
+
+    def set_collective_quantized(self, active):
+        """Gauge: whether the quantized ring ACTUALLY carries the
+        sharded step's allreduces (flag requested AND tp > 1) — set at
+        engine build like kernel_path, so an fp32 fallback is visible
+        in every snapshot."""
+        self._stat(COLLECTIVE_QUANTIZED).set(1 if active else 0)
 
     def set_mesh_devices(self, n):
         """Gauge: the engine's tensor-parallel degree (mesh axis size;

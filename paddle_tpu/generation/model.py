@@ -107,6 +107,31 @@ class TinyCausalLM:
         hlay = jnp.maximum(x @ blk["w1"] + blk["b1"], 0.0)
         return hlay @ blk["w2"] + blk["b2"]
 
+    @staticmethod
+    def _row_matmul(mesh, tp_axis, quant_collectives):
+        """The matmul used for the two ROW-SHARDED contractions (wo,
+        w2) in the jitted step fns.  Plain ``a @ w`` normally (GSPMD
+        inserts the fp32 allreduce from the sharding); with
+        `quant_collectives` under a real mesh, the EQuARX-style
+        explicit quantized ring (parallel.quantized_allreduce) placed
+        exactly where the implicit allreduce sits."""
+        if quant_collectives and mesh is not None:
+            if tp_axis is None:
+                tp_axis = tuple(mesh.axis_names)[0]
+            if int(mesh.shape[tp_axis]) > 1:
+                from ..parallel.quantized_allreduce import (
+                    quantized_matmul_allreduce)
+
+                return quantized_matmul_allreduce(mesh, tp_axis)
+        return lambda a, w: a @ w
+
+    @staticmethod
+    def _mlp_rowmm(blk, x, rowmm):
+        """_mlp with the second (row-sharded) matmul routed through
+        `rowmm` — identical ops when rowmm is the plain matmul."""
+        hlay = jnp.maximum(x @ blk["w1"] + blk["b1"], 0.0)
+        return rowmm(hlay, blk["w2"]) + blk["b2"]
+
     def _logits(self, x):
         return _layer_norm(x, self.ln_f_s, self.ln_f_b) @ self.head
 
@@ -201,7 +226,8 @@ class TinyCausalLM:
         return self._logits(x[n - 1:n])[0]
 
     def prefill_chunk_fn(self, page_size, num_pages, use_kernel=False,
-                         pool_layout="token", mesh=None, tp_axis=None):
+                         pool_layout="token", mesh=None, tp_axis=None,
+                         kv_quant=False, quant_collectives=False):
         """Build the PURE whole-chunk function the engine's jitted
         chunked-prefill path compiles (mirrors `decode_step_fn`)::
 
@@ -224,17 +250,35 @@ class TinyCausalLM:
         mesh / tp_axis: the same tensor-parallel sharding contract as
         decode_step_fn — chunk q/k/v sharded over heads, pools pinned to
         the pool sharding through the donation chain, last-position
-        logits pinned replicated."""
-        from ..parallel.sharding_annotations import constrain, kv_pool_spec
+        logits pinned replicated.
+
+        kv_quant: int8 pools — the fn signature grows the per-layer
+        [P, H] scale arrays (``..., k_pools, v_pools, k_scales,
+        v_scales, page_table``) riding the same donation chain, writes
+        run the quantized three-step transform, and attention takes the
+        scales for in-kernel dequant.  quant_collectives: the two
+        row-sharded matmuls run the explicit quantized ring allreduce
+        (_row_matmul)."""
+        from ..parallel.sharding_annotations import (constrain,
+                                                     kv_pool_spec,
+                                                     kv_scale_spec)
         from .kv_cache import scatter_pool_update
+        from .quantized_kv import quantized_pool_write
 
         page_size = int(page_size)
         num_pages = int(num_pages)
         pool_spec = (kv_pool_spec(pool_layout, tp_axis)
                      if mesh is not None else None)
+        scale_spec = (kv_scale_spec(tp_axis)
+                      if mesh is not None else None)
+        rowmm = self._row_matmul(mesh, tp_axis, quant_collectives)
 
         def step(params, tokens, start, length, k_pools, v_pools,
-                 page_table):
+                 *rest):
+            if kv_quant:
+                k_scales, v_scales, page_table = rest
+            else:
+                (page_table,) = rest
             tokens = jnp.asarray(tokens, jnp.int32)
             start = jnp.asarray(start, jnp.int32)
             length = jnp.asarray(length, jnp.int32)
@@ -250,19 +294,33 @@ class TinyCausalLM:
                 live, pt[jnp.clip((start + idx) // page_size, 0,
                                   pt.shape[0] - 1)], num_pages)
             rows = (start + idx) % page_size
-            k_out, v_out = [], []
+            k_out, v_out, ks_out, vs_out = [], [], [], []
             for li, blk in enumerate(params["blocks"]):
                 hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
                 q, k, v = self._qkv(blk, hn)
                 q = constrain(q, mesh, None, tp_axis, None)
                 k = constrain(k, mesh, None, tp_axis, None)
                 v = constrain(v, mesh, None, tp_axis, None)
-                kp = scatter_pool_update(
-                    k_pools[li], pages, rows,
-                    k.astype(k_pools[li].dtype), pool_layout)
-                vp = scatter_pool_update(
-                    v_pools[li], pages, rows,
-                    v.astype(v_pools[li].dtype), pool_layout)
+                ks = vs = None
+                if kv_quant:
+                    kp, ks = quantized_pool_write(
+                        k_pools[li], k_scales[li], pages, rows, k,
+                        pool_layout)
+                    vp, vs = quantized_pool_write(
+                        v_pools[li], v_scales[li], pages, rows, v,
+                        pool_layout)
+                    if scale_spec is not None:
+                        ks = constrain(ks, mesh, *scale_spec)
+                        vs = constrain(vs, mesh, *scale_spec)
+                    ks_out.append(ks)
+                    vs_out.append(vs)
+                else:
+                    kp = scatter_pool_update(
+                        k_pools[li], pages, rows,
+                        k.astype(k_pools[li].dtype), pool_layout)
+                    vp = scatter_pool_update(
+                        v_pools[li], pages, rows,
+                        v.astype(v_pools[li].dtype), pool_layout)
                 if pool_spec is not None:
                     kp = constrain(kp, mesh, *pool_spec)
                     vp = constrain(vp, mesh, *pool_spec)
@@ -270,13 +328,18 @@ class TinyCausalLM:
                 v_out.append(vp)
                 attn = decode_attention.chunk_prefill_attention(
                     q, kp, vp, pt, start, use_kernel=use_kernel,
-                    layout=pool_layout, mesh=mesh, tp_axis=tp_axis)
-                x = x + attn.reshape(c, self.d_model) @ blk["wo"]
-                x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
-                                                   blk["ln2_b"]))
+                    layout=pool_layout, mesh=mesh, tp_axis=tp_axis,
+                    k_scale=ks, v_scale=vs)
+                x = x + rowmm(attn.reshape(c, self.d_model), blk["wo"])
+                x = x + self._mlp_rowmm(
+                    blk, _layer_norm(x, blk["ln2_s"], blk["ln2_b"]),
+                    rowmm)
             last = jnp.take(x, length - 1, axis=0)[None]
             logits = (_layer_norm(last, params["ln_f_s"],
                                   params["ln_f_b"]) @ params["head"])[0]
+            if kv_quant:
+                return (constrain(logits, mesh), k_out, v_out, ks_out,
+                        vs_out)
             return constrain(logits, mesh), k_out, v_out
 
         return step
@@ -340,7 +403,8 @@ class TinyCausalLM:
 
     def decode_step_fn(self, page_size, num_pages, use_kernel=False,
                        pool_layout="token", greedy=False, mesh=None,
-                       tp_axis=None):
+                       tp_axis=None, kv_quant=False,
+                       quant_collectives=False):
         """Build the PURE whole-decode-step function the engine's fused
         path jits: embed -> L x (scatter-append K/V into the pools +
         paged decode attention) -> logits, in one traceable body.
@@ -373,17 +437,33 @@ class TinyCausalLM:
         so the donation chain round-trips, `out` pinned replicated so
         the engine's single host fetch is legal.  XLA inserts the two
         per-layer allreduces (after wo and w2) from the row-sharded
-        contractions; nothing here issues a collective by hand."""
-        from ..parallel.sharding_annotations import constrain, kv_pool_spec
+        contractions; nothing here issues a collective by hand — unless
+        quant_collectives, which swaps those two matmuls for the
+        explicit EQuARX-style quantized ring (_row_matmul).
+
+        kv_quant: int8 pools — the per-layer [P, H] scale arrays join
+        the donated state (``..., k_pools, v_pools, k_scales, v_scales,
+        page_tables, lens``), writes quantize in-trace, attention
+        dequantizes in-kernel."""
+        from ..parallel.sharding_annotations import (constrain,
+                                                     kv_pool_spec,
+                                                     kv_scale_spec)
         from .kv_cache import scatter_pool_update
+        from .quantized_kv import quantized_pool_write
 
         page_size = int(page_size)
         num_pages = int(num_pages)
         pool_spec = (kv_pool_spec(pool_layout, tp_axis)
                      if mesh is not None else None)
+        scale_spec = (kv_scale_spec(tp_axis)
+                      if mesh is not None else None)
+        rowmm = self._row_matmul(mesh, tp_axis, quant_collectives)
 
-        def step(params, tokens, positions, k_pools, v_pools,
-                 page_tables, lens):
+        def step(params, tokens, positions, k_pools, v_pools, *rest):
+            if kv_quant:
+                k_scales, v_scales, page_tables, lens = rest
+            else:
+                page_tables, lens = rest
             tokens = jnp.asarray(tokens, jnp.int32)
             positions = jnp.asarray(positions, jnp.int32)
             pt = jnp.asarray(page_tables, jnp.int32)
@@ -398,7 +478,7 @@ class TinyCausalLM:
                 lens > 0,
                 pt[jnp.arange(b), positions // page_size], num_pages)
             rows = positions % page_size
-            k_out, v_out = [], []
+            k_out, v_out, ks_out, vs_out = [], [], [], []
             for li, blk in enumerate(params["blocks"]):
                 hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
                 q, k, v = self._qkv(blk, hn)
@@ -408,12 +488,26 @@ class TinyCausalLM:
                 q = constrain(q, mesh, None, tp_axis, None)
                 k = constrain(k, mesh, None, tp_axis, None)
                 v = constrain(v, mesh, None, tp_axis, None)
-                kp = scatter_pool_update(
-                    k_pools[li], pages, rows,
-                    k.astype(k_pools[li].dtype), pool_layout)
-                vp = scatter_pool_update(
-                    v_pools[li], pages, rows,
-                    v.astype(v_pools[li].dtype), pool_layout)
+                ks = vs = None
+                if kv_quant:
+                    kp, ks = quantized_pool_write(
+                        k_pools[li], k_scales[li], pages, rows, k,
+                        pool_layout)
+                    vp, vs = quantized_pool_write(
+                        v_pools[li], v_scales[li], pages, rows, v,
+                        pool_layout)
+                    if scale_spec is not None:
+                        ks = constrain(ks, mesh, *scale_spec)
+                        vs = constrain(vs, mesh, *scale_spec)
+                    ks_out.append(ks)
+                    vs_out.append(vs)
+                else:
+                    kp = scatter_pool_update(
+                        k_pools[li], pages, rows,
+                        k.astype(k_pools[li].dtype), pool_layout)
+                    vp = scatter_pool_update(
+                        v_pools[li], pages, rows,
+                        v.astype(v_pools[li].dtype), pool_layout)
                 if pool_spec is not None:
                     kp = constrain(kp, mesh, *pool_spec)
                     vp = constrain(vp, mesh, *pool_spec)
@@ -421,10 +515,12 @@ class TinyCausalLM:
                 v_out.append(vp)
                 attn = decode_attention.paged_decode_attention(
                     q, kp, vp, pt, lens, use_kernel=use_kernel,
-                    layout=pool_layout, mesh=mesh, tp_axis=tp_axis)
-                x = x + attn.reshape(b, self.d_model) @ blk["wo"]
-                x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
-                                                   blk["ln2_b"]))
+                    layout=pool_layout, mesh=mesh, tp_axis=tp_axis,
+                    k_scale=ks, v_scale=vs)
+                x = x + rowmm(attn.reshape(b, self.d_model), blk["wo"])
+                x = x + self._mlp_rowmm(
+                    blk, _layer_norm(x, blk["ln2_s"], blk["ln2_b"]),
+                    rowmm)
             logits = _layer_norm(x, params["ln_f_s"],
                                  params["ln_f_b"]) @ params["head"]
             out = (jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -433,13 +529,16 @@ class TinyCausalLM:
             # which a sharded-out array would turn into a cross-device
             # gather on the host's side of the fence
             out = constrain(out, mesh)  # bare spec == fully replicated
+            if kv_quant:
+                return out, k_out, v_out, ks_out, vs_out
             return out, k_out, v_out
 
         return step
 
     # -------------------------- ragged step ---------------------------
     def ragged_step_fn(self, page_size, num_pages, use_kernel=False,
-                       pool_layout="token", mesh=None, tp_axis=None):
+                       pool_layout="token", mesh=None, tp_axis=None,
+                       kv_quant=False, quant_collectives=False):
         """Build the PURE mixed-batch RAGGED step function the engine's
         one-dispatch-per-step path jits (fused.RaggedStep)::
 
@@ -472,15 +571,31 @@ class TinyCausalLM:
         mesh / tp_axis: the decode_step_fn sharding contract — q/k/v
         and the pool scatters sharded over heads, pools pinned through
         the donation chain, ids/logits pinned replicated for the single
-        host fetch."""
-        from ..parallel.sharding_annotations import constrain, kv_pool_spec
+        host fetch.
+
+        kv_quant / quant_collectives: exactly the decode_step_fn
+        contract — scale arrays after the pools
+        (``..., k_pools, v_pools, k_scales, v_scales``), quantized
+        in-trace writes, in-kernel dequant; and the two row-sharded
+        matmuls through the quantized ring when asked."""
+        from ..parallel.sharding_annotations import (constrain,
+                                                     kv_pool_spec,
+                                                     kv_scale_spec)
         from .kv_cache import scatter_pool_update
+        from .quantized_kv import quantized_pool_write
 
         pool_spec = (kv_pool_spec(pool_layout, tp_axis)
                      if mesh is not None else None)
+        scale_spec = (kv_scale_spec(tp_axis)
+                      if mesh is not None else None)
+        rowmm = self._row_matmul(mesh, tp_axis, quant_collectives)
 
         def step(params, tokens, positions, pages, rows, page_tables,
-                 starts, lens, kv_lens, k_pools, v_pools):
+                 starts, lens, kv_lens, k_pools, v_pools, *rest):
+            if kv_quant:
+                k_scales, v_scales = rest
+            else:
+                k_scales = v_scales = None
             tokens = jnp.asarray(tokens, jnp.int32)
             positions = jnp.asarray(positions, jnp.int32)
             pages = jnp.asarray(pages, jnp.int32)
@@ -494,19 +609,33 @@ class TinyCausalLM:
             # construction); their K/V rides the sentinel page and their
             # attention rows belong to no descriptor (exact zeros)
             x = params["tok_emb"][tokens] + params["pos_emb"][positions]
-            k_out, v_out = [], []
+            k_out, v_out, ks_out, vs_out = [], [], [], []
             for li, blk in enumerate(params["blocks"]):
                 hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
                 q, k, v = self._qkv(blk, hn)
                 q = constrain(q, mesh, None, tp_axis, None)
                 k = constrain(k, mesh, None, tp_axis, None)
                 v = constrain(v, mesh, None, tp_axis, None)
-                kp = scatter_pool_update(
-                    k_pools[li], pages, rows,
-                    k.astype(k_pools[li].dtype), pool_layout)
-                vp = scatter_pool_update(
-                    v_pools[li], pages, rows,
-                    v.astype(v_pools[li].dtype), pool_layout)
+                ks = vs = None
+                if kv_quant:
+                    kp, ks = quantized_pool_write(
+                        k_pools[li], k_scales[li], pages, rows, k,
+                        pool_layout)
+                    vp, vs = quantized_pool_write(
+                        v_pools[li], v_scales[li], pages, rows, v,
+                        pool_layout)
+                    if scale_spec is not None:
+                        ks = constrain(ks, mesh, *scale_spec)
+                        vs = constrain(vs, mesh, *scale_spec)
+                    ks_out.append(ks)
+                    vs_out.append(vs)
+                else:
+                    kp = scatter_pool_update(
+                        k_pools[li], pages, rows,
+                        k.astype(k_pools[li].dtype), pool_layout)
+                    vp = scatter_pool_update(
+                        v_pools[li], pages, rows,
+                        v.astype(v_pools[li].dtype), pool_layout)
                 if pool_spec is not None:
                     kp = constrain(kp, mesh, *pool_spec)
                     vp = constrain(vp, mesh, *pool_spec)
@@ -515,10 +644,11 @@ class TinyCausalLM:
                 attn = decode_attention.ragged_paged_attention(
                     q, kp, vp, pt, starts, lens, kv_lens,
                     use_kernel=use_kernel, layout=pool_layout,
-                    mesh=mesh, tp_axis=tp_axis)
-                x = x + attn.reshape(t, self.d_model) @ blk["wo"]
-                x = x + self._mlp(blk, _layer_norm(x, blk["ln2_s"],
-                                                   blk["ln2_b"]))
+                    mesh=mesh, tp_axis=tp_axis, k_scale=ks, v_scale=vs)
+                x = x + rowmm(attn.reshape(t, self.d_model), blk["wo"])
+                x = x + self._mlp_rowmm(
+                    blk, _layer_norm(x, blk["ln2_s"], blk["ln2_b"]),
+                    rowmm)
             # per-descriptor sampling rows: the last packed row each
             # descriptor owns (padding descriptors read row 0 — garbage
             # the engine never fetches a token from)
@@ -531,6 +661,8 @@ class TinyCausalLM:
             # ONE of them without a cross-device gather
             ids = constrain(ids, mesh)
             logits = constrain(logits, mesh)
+            if kv_quant:
+                return (ids, logits), k_out, v_out, ks_out, vs_out
             return (ids, logits), k_out, v_out
 
         return step

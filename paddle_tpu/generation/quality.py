@@ -1,0 +1,245 @@
+"""Quality-gate harness: bounded drift + token agreement vs the fp32
+oracle.
+
+Bitwise token identity — the acceptance contract of every previous
+generation perf path — cannot survive lossy storage: int8 KV pools and
+quantized collectives CHANGE values by construction.  The contract
+shifts to this harness, the quantization sibling of tests/gen_oracle.py:
+
+- ``greedy_token_agreement``: run the fp32 engine and the quantized
+  engine on the same seeded prompts and score position-wise greedy
+  agreement (the acceptance floor is >= 0.99);
+- ``teacher_forced_logit_drift``: drive an fp32 cache and a quantized
+  cache through the SAME decode trajectory (teacher-forced on the fp32
+  greedy stream, so the comparison never walks off-distribution) and
+  report the max absolute next-token-logit gap — the bounded-drift
+  number.
+
+The drift loop reuses the fake-quant machinery from ``paddle_tpu.quant``
+in its bound: ``quant_dequant`` with the page's abs-max scale is the
+idealized single-rounding fake-quant of a K/V row, and the measured
+engine-path drift is reported next to that ideal so a write-path
+regression (e.g. runaway requantization) shows up as measured >> ideal,
+not just "still under the gate".
+
+Both entry points are deterministic per (model seed, prompt seed), so
+the gate is a regression test, not a flaky statistic.  Used by
+tests/test_kv_quant.py and the gen_bench ``--kv-quant`` quality cell.
+"""
+import numpy as np
+
+
+def seeded_prompts(vocab_size, n_prompts=6, lo=5, hi=24, seed=1234):
+    """The quality-gate workload: deterministic ragged prompts."""
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab_size,
+                         int(rng.integers(lo, hi))).tolist()
+            for _ in range(n_prompts)]
+
+
+def greedy_token_agreement(model, prompts, base_config, quant_config,
+                           max_new_tokens=16):
+    """Position-wise greedy agreement between two engine configs on the
+    same prompts.  Returns ``{"agreement", "tokens_base",
+    "tokens_quant", "positions"}`` — agreement is matching positions
+    over the LONGER stream's length, so a run that stops early scores
+    its missing tail as disagreement (an early stop IS a divergence
+    the gate must see); both configs cap at `max_new_tokens`."""
+    from .engine import GenerationEngine
+
+    streams = []
+    for config in (base_config, quant_config):
+        eng = GenerationEngine(model, config, start=False)
+        try:
+            handles = [eng.submit(p, max_new_tokens=max_new_tokens)
+                       for p in prompts]
+            eng.run_until_idle()
+            streams.append([h.result(timeout=30).token_ids
+                            for h in handles])
+        finally:
+            eng.shutdown()
+    base, quant = streams
+    match = total = 0
+    for tb, tq in zip(base, quant):
+        n = max(len(tb), len(tq))
+        total += n
+        match += sum(1 for a, b in zip(tb, tq) if a == b)
+    return {
+        "agreement": (match / total) if total else 1.0,
+        "positions": total,
+        "tokens_base": base,
+        "tokens_quant": quant,
+    }
+
+
+def teacher_forced_logit_drift(model, prompts, quant_config):
+    """Max |logit_fp32 - logit_quant| along the fp32 greedy trajectory.
+
+    Builds one fp32 cache and one cache from `quant_config`'s
+    kv_dtype/backend/layout, writes the SAME model-produced K/V into
+    both (the quantized cache rounds at storage), and decodes
+    teacher-forced on the fp32 greedy stream: per step both caches
+    serve attention for the same query, so the logit gap isolates
+    exactly what quantized STORAGE changed.  Returns ``{"max_drift",
+    "mean_drift", "ideal_fake_quant_drift", "steps"}`` —
+    `ideal_fake_quant_drift` is the same trajectory replayed against
+    quant_dequant'd (single-rounding, per-page abs-max) K/V, the
+    fake-quant lower bound the engine write path should stay near."""
+    import jax.numpy as jnp
+
+    from .decode_attention import paged_decode_attention_reference
+    from .kv_cache import DeviceKVPool, PagedKVCache
+
+    cfg = quant_config
+    page_size = int(cfg.page_size)
+    num_pages = int(cfg.num_pages)
+
+    def build(dtype):
+        if (cfg.kv_backend or "host") == "device":
+            return DeviceKVPool(
+                model.num_layers, model.num_heads, model.head_dim,
+                num_pages=num_pages, page_size=page_size, dtype=dtype,
+                pool_layout=cfg.pool_layout or "token")
+        return PagedKVCache(
+            model.num_layers, model.num_heads, model.head_dim,
+            num_pages=num_pages, page_size=page_size, dtype=dtype)
+
+    drifts, ideal_drifts = [], []
+    steps = 0
+    for pi, prompt in enumerate(prompts):
+        base = build(np.float32)
+        quant = build(cfg.kv_dtype)
+        sid = ("qgate", pi)
+        for c in (base, quant):
+            c.allocate(sid)
+        tokens = list(int(t) for t in prompt)
+        logits, k, v = model.prefill(np.asarray(tokens, np.int32))
+        for c in (base, quant):
+            c.append_prefill(sid, k, v)
+        # the idealized fake-quant view: every row single-rounded
+        # against its page's abs-max — quant/qat.quant_dequant per
+        # (layer, page, head) block, the bound the engine path should
+        # track (requantization drift would widen the gap)
+        kq, vq = _fake_quant_pages(k, v, page_size, jnp)
+        for step in range(8):
+            nxt = int(np.argmax(np.asarray(logits)))
+            tokens.append(nxt)
+            pos = base.reserve(sid, 1)
+            quant.reserve(sid, 1)
+            outs = {}
+            for tag, c in (("base", base), ("quant", quant)):
+                pt, lens = c.gather_block_tables([sid])
+
+                def attend(layer, q, k_new, v_new, c=c, pt=pt,
+                           lens=lens):
+                    c.write_decode_tokens([sid], [pos], layer, k_new,
+                                          v_new)
+                    kp, vp = c.layer_pools(layer)
+                    ks, vs = c.layer_scales(layer)
+                    return paged_decode_attention_reference(
+                        q, kp, vp, pt, lens, layout=c.pool_layout,
+                        k_scale=ks, v_scale=vs)
+
+                outs[tag] = np.asarray(model.decode(
+                    np.asarray([nxt], np.int32),
+                    np.asarray([pos], np.int32), attend))[0]
+            drifts.append(float(np.max(np.abs(outs["base"]
+                                              - outs["quant"]))))
+            # idealized single-rounding drift on the SAME step: dense
+            # attention over fake-quant'd prefix K/V (positions
+            # [0, pos)) + the exact new token row
+            ideal_drifts.append(_ideal_step_drift(
+                model, tokens, pos, k, v, kq, vq, outs["base"], jnp))
+            logits = outs["base"]     # teacher-forced on fp32 greedy
+            k, v, kq, vq = _append_row(model, base, sid, pos, k, v, kq,
+                                       vq, page_size, jnp)
+            steps += 1
+    return {
+        "max_drift": max(drifts) if drifts else 0.0,
+        "mean_drift": float(np.mean(drifts)) if drifts else 0.0,
+        "ideal_fake_quant_drift": max(ideal_drifts) if ideal_drifts
+        else 0.0,
+        "steps": steps,
+    }
+
+
+def _fake_quant_pages(k, v, page_size, jnp):
+    """quant_dequant each [page, head] block of [L, T, H, D] K/V with
+    its abs-max — the idealized single-rounding fake-quant."""
+    from ..quant import quant_dequant
+
+    def fq(x):
+        x = np.asarray(x, np.float32)
+        out = np.array(x)
+        ll, t, h, _ = x.shape
+        for p0 in range(0, t, page_size):
+            blk = x[:, p0:p0 + page_size]          # [L, n, H, D]
+            scale = jnp.asarray(
+                np.max(np.abs(blk), axis=(1, 3))[:, None, :, None])
+            out[:, p0:p0 + page_size] = np.asarray(
+                quant_dequant(jnp.asarray(blk), scale))
+        return out
+
+    return fq(k), fq(v)
+
+
+def _ideal_step_drift(model, tokens, pos, k, v, kq, vq, base_logits,
+                      jnp):
+    """One teacher-forced step against the idealized fake-quant K/V:
+    dense reference attention (the eager oracle math) over exact vs
+    fake-quant prefix — the single-rounding drift floor."""
+    from .decode_attention import chunk_prefill_attention_reference
+
+    def decode_with(kk, vv):
+        def attend(layer, q, k_new, v_new):
+            k_all = np.concatenate([kk[layer][:pos],
+                                    np.asarray(k_new)], axis=0)
+            v_all = np.concatenate([vv[layer][:pos],
+                                    np.asarray(v_new)], axis=0)
+            return chunk_prefill_attention_reference(q, k_all, v_all,
+                                                     pos)
+
+        return np.asarray(model.decode(
+            np.asarray([tokens[-1]], np.int32),
+            np.asarray([pos], np.int32), attend))[0]
+
+    exact = decode_with(np.asarray(k), np.asarray(v))
+    ideal = decode_with(kq, vq)
+    return float(np.max(np.abs(exact - ideal)))
+
+
+def _append_row(model, base, sid, pos, k, v, kq, vq, page_size, jnp):
+    """Extend the tracked exact and fake-quant K/V views with the row
+    the fp32 cache just stored at `pos` (read back from the cache so
+    the views track the oracle bitwise)."""
+    ks, vs = [], []
+    for layer in range(model.num_layers):
+        kr, vr = base.gather_prefix(sid, layer, pos + 1)
+        ks.append(np.asarray(kr)[pos:pos + 1])
+        vs.append(np.asarray(vr)[pos:pos + 1])
+    k_new = np.concatenate([np.asarray(k), np.stack(ks)], axis=1)
+    v_new = np.concatenate([np.asarray(v), np.stack(vs)], axis=1)
+    kq2, vq2 = _fake_quant_pages(k_new, v_new, page_size, jnp)
+    return k_new, v_new, kq2, vq2
+
+
+def kv_quality_report(model, base_config, quant_config, prompts=None,
+                      max_new_tokens=16):
+    """The one-call quality gate: agreement + drift on the seeded
+    workload.  Returns a flat dict ready for a gen_bench cell or a
+    test assertion."""
+    if prompts is None:
+        prompts = seeded_prompts(model.vocab_size)
+    agree = greedy_token_agreement(model, prompts, base_config,
+                                   quant_config,
+                                   max_new_tokens=max_new_tokens)
+    drift = teacher_forced_logit_drift(model, prompts, quant_config)
+    return {
+        "agreement": round(agree["agreement"], 4),
+        "positions": agree["positions"],
+        "max_logit_drift": round(drift["max_drift"], 6),
+        "mean_logit_drift": round(drift["mean_drift"], 6),
+        "ideal_fake_quant_drift": round(
+            drift["ideal_fake_quant_drift"], 6),
+        "drift_steps": drift["steps"],
+    }

@@ -1,0 +1,166 @@
+"""Int8 KV quantization: per-page per-head abs-max scales, shared math.
+
+`kv_dtype=bfloat16` halved KV bytes once; int8 pools halve them again —
+~2x resident sequences per pool byte and ~2x less decode HBM traffic.
+Because int8 is a 256-level grid, storage needs a SCALE: every physical
+page carries one float32 abs-max scale per head per pool (k and v
+separately, per layer), living beside the pool storage
+(``kv_cache.PagedKVCache`` / ``DeviceKVPool``).  A stored element
+decodes as::
+
+    value = int8 * (scale[page, head] * (1 / 127))
+
+This module is the ONE home of that math.  The Pallas kernels
+(ops/pallas/paged_attention.py) and the jnp gather references
+(decode_attention.py) both dequantize with ``dequant_factor`` — the same
+elementwise expression — so kernel-vs-reference runs see bitwise-equal
+operands entering the score matmuls, exactly like the bf16 upcast path.
+
+Write semantics (every path: eager scatters, fused in-trace appends,
+chunked-prefill scatters, the ragged pack) are the deterministic
+three-step transform of ``quantized_pool_write``:
+
+1. per written row, take the per-head abs-max and scatter-MAX it into
+   the page scales (scales only grow while a page is live; they reset
+   to zero when the page returns to the allocator — kv_cache owns that
+   transition);
+2. REQUANTIZE the touched pages onto the new grid (dequant with the old
+   scale, quantize with the new) — old rows stay readable under the one
+   per-page scale, and a freshly reused page's stale bytes are
+   laundered to zero by its zero scale;
+3. quantize the new rows against the final page scale and scatter them.
+
+Step 2 writes identical bytes for duplicate page entries (the content
+it transforms predates the write), so the scatter is deterministic
+whatever order XLA picks; step 3's (page, row) targets are unique by
+construction.  The same transform runs in numpy for the host backend
+(`host_quantized_write`) — np.round and jnp.round share
+round-half-to-even, so host and device pools quantize identically.
+
+Why requantize instead of per-row scales: the kernels index ONE scalar
+per (page, head) from scalar-prefetch SMEM — per-row scales would grow
+the prefetch operand 16x and change the kernel's inner loop; per-page
+scales keep dequant one multiply per block.  The cost is bounded
+rounding drift on rows requantized as their page's scale grows (at most
+page_size re-roundings, each a half-LSB of the final grid) — which is
+exactly what the quality gate (generation/quality.py) bounds against
+the fp32 oracle.
+"""
+import numpy as np
+
+QMAX = 127.0
+INV_QMAX = np.float32(1.0 / 127.0)
+# divisor floor for all-zero pages: with scale == 0 every payload value
+# is 0 (the scale is an abs-max over a superset of the payload), so the
+# epsilon only keeps 0/0 out of the trace — it never rounds a real value
+SCALE_EPS = np.float32(1e-30)
+
+
+def dequant_factor(scale):
+    """The per-(page, head) multiplier int8 storage decodes with —
+    ``scale * (1/127)`` — used verbatim by the Pallas kernels and the
+    jnp references so both paths dequantize bitwise-identically."""
+    return scale * INV_QMAX
+
+
+def quantize_int8(x, scale, np_mod=None):
+    """Symmetric int8 quantization against an abs-max `scale`
+    (broadcastable).  Works for numpy and jnp alike (`np_mod` picks the
+    namespace; numpy by default).  round is half-to-even in both."""
+    m = np_mod if np_mod is not None else np
+    safe = m.maximum(scale.astype(m.float32) if hasattr(scale, "astype")
+                     else m.float32(scale), SCALE_EPS)
+    q = m.clip(m.round(x.astype(m.float32) * (m.float32(QMAX) / safe)),
+               -QMAX, QMAX)
+    return q.astype(m.int8)
+
+
+def dequantize_int8(q, scale, np_mod=None):
+    """int8 -> float32 with the canonical ``q * (scale/127)`` factor."""
+    m = np_mod if np_mod is not None else np
+    return q.astype(m.float32) * dequant_factor(
+        scale.astype(m.float32) if hasattr(scale, "astype")
+        else m.float32(scale))
+
+
+def _expand_scale_token(s):
+    """[n, H] page-head scales -> broadcast over [n, ps, H, D] rows."""
+    return s[:, None, :, None]
+
+
+def quantized_pool_write(pool, scale, pages, rows, x, layout):
+    """The in-trace quantized write (jnp): scatter payload rows
+    ``x[i]`` into ``(pages[i], rows[i])`` of an int8 pool with its
+    ``[P, H]`` float32 scale array, returning ``(pool', scale')``.
+
+    Drop-mode semantics match ``scatter_pool_update``: out-of-range
+    page ids (the padding sentinel ``num_pages``) never touch a pool
+    page OR a scale row.  `x` is the model-precision payload
+    ``[n, H, D]``; `layout` is the pool storage layout ("token"
+    ``[P, ps, H, D]`` or "kernel" ``[H, P, ps, D]``); the scale array
+    is ``[P, H]`` in BOTH layouts (sharded on its head axis under a
+    mesh — parallel.kv_scale_spec)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    pages = jnp.asarray(pages, jnp.int32)
+    rows = jnp.asarray(rows, jnp.int32)
+    n_pages = scale.shape[0]
+    safe_pages = jnp.clip(pages, 0, n_pages - 1)  # gather-side clamp;
+    # the scatters below keep the ORIGINAL ids so drop mode governs
+    a = jnp.max(jnp.abs(x), axis=-1)                       # [n, H]
+    s_old = scale[safe_pages]                              # [n, H]
+    scale2 = scale.at[pages].max(a, mode="drop")
+    s_new = scale2[safe_pages]                             # [n, H]
+    if layout == "kernel":
+        # pool [H, P, ps, D]; per-row page copies [H, n, ps, D]
+        old = pool[:, safe_pages]
+        so = jnp.transpose(s_old, (1, 0))[:, :, None, None]
+        sn = jnp.transpose(s_new, (1, 0))[:, :, None, None]
+        req = quantize_int8(dequantize_int8(old, so, jnp), sn, jnp)
+        pool2 = pool.at[:, pages].set(req, mode="drop")
+        q = quantize_int8(x, s_new[:, :, None], jnp)       # [n, H, D]
+        pool3 = pool2.at[:, pages, rows].set(
+            jnp.swapaxes(q, 0, 1), mode="drop")
+    else:
+        # pool [P, ps, H, D]; per-row page copies [n, ps, H, D]
+        old = pool[safe_pages]
+        req = quantize_int8(
+            dequantize_int8(old, _expand_scale_token(s_old), jnp),
+            _expand_scale_token(s_new), jnp)
+        pool2 = pool.at[pages].set(req, mode="drop")
+        q = quantize_int8(x, s_new[:, :, None], jnp)
+        pool3 = pool2.at[pages, rows].set(q, mode="drop")
+    return pool3, scale2
+
+
+def host_quantized_write(k_pool, v_pool, k_scale, v_scale, layers, page,
+                         row0, k_rows, v_rows):
+    """The host (numpy, in-place) sibling of ``quantized_pool_write``
+    for ONE page span: write rows ``[row0, row0 + n)`` of physical
+    `page` across pool rows `layers` (a slice).  k_pool/v_pool:
+    ``[L, P, ps, H, D]`` int8 (updated in place); k_scale/v_scale:
+    ``[L, P, H]`` float32; k_rows/v_rows: ``[Lsel, n, H, D]`` float32
+    payload.  Same three-step transform, same round-half-to-even."""
+    n = k_rows.shape[1]
+    for pool, sc, x in ((k_pool, k_scale, k_rows),
+                        (v_pool, v_scale, v_rows)):
+        x = np.asarray(x, np.float32)
+        a = np.max(np.abs(x), axis=(1, 3))                 # [Lsel, H]
+        s_old = sc[layers, page].copy()                    # [Lsel, H]
+        s_new = np.maximum(s_old, a)
+        sc[layers, page] = s_new
+        # Step 2 is a bitwise no-op when the page scale did not grow AND
+        # every entry is on the safe grid (>= SCALE_EPS: quantize divides
+        # by max(s, eps), so a sub-eps scale does NOT round-trip, and a
+        # zero scale must still launder reused-page stale bytes) — skip
+        # the page rewrite then; steady-state decode saturates scales
+        # after a page's first few tokens, so the hot path writes one
+        # row instead of requantizing page_size rows per layer.
+        if not (np.array_equal(s_new, s_old) and np.all(s_old >= SCALE_EPS)):
+            old = pool[layers, page]                       # [Lsel, ps, H, D]
+            old_f = dequantize_int8(old, s_old[:, None, :, None])
+            pool[layers, page] = quantize_int8(old_f,
+                                               s_new[:, None, :, None])
+        pool[layers, page, row0:row0 + n] = quantize_int8(
+            x, s_new[:, None, :, None])

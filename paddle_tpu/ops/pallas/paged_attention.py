@@ -19,6 +19,16 @@ Layouts are chosen Mosaic tile-legal by construction: pools transpose to
 [H, P, page_size, D] so every block's trailing two dims are full array
 dims (page_size, D); q/out ride as [B, H, 1, D] with (1, 1, 1, D) blocks.
 
+INT8 POOLS: every public kernel takes optional ``k_scale``/``v_scale``
+[P, H] per-page per-head abs-max arrays (generation.quantized_kv).
+They ride as two more scalar-prefetch operands, and each live grid
+cell dequantizes its page block in-kernel — ``int8 * (scale * 1/127)``
+with the exact expression the jnp gather references use, so
+kernel-vs-reference operands stay bitwise equal — before the score
+matmul.  The jnp references dequantize their gathered O(tokens) views;
+the kernels dequantize per block; nobody ever materializes a
+dequantized pool.
+
 MESH-NATIVE dispatch: every public kernel takes ``mesh`` / ``tp_axis``.
 Heads are fully independent in all three grids, so under a head-sharded
 tensor-parallel mesh the kernel runs as a ``shard_map`` whose per-shard
@@ -34,10 +44,42 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import NEG_INF, _interpret
+
+# int8 KV dequant factor: MUST stay bit-equal to
+# generation.quantized_kv.INV_QMAX — the jnp gather references multiply
+# by the same constant, which is what keeps kernel and reference
+# operands bitwise identical (kept as a literal here so the kernel
+# module never imports the generation package)
+INV_QMAX = np.float32(1.0 / 127.0)
+
+
+def _require_scales(pool, k_scale, v_scale):
+    """int8 pools MUST arrive with their [P, H] scale arrays — and only
+    int8 pools: raw int8 codes decoded as values, or float values
+    multiplied by scale/127, are both finite and plausible-looking
+    corruption, so a call site that forgot (or half-threaded, or
+    wrongly threaded) the cache's layer_scales() fails loudly here
+    instead of mis-attending."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "k_scale and v_scale must be passed together — got one "
+            "without the other (thread BOTH of the cache's "
+            "layer_scales() arrays)")
+    if k_scale is None and pool.dtype == jnp.int8:
+        raise ValueError(
+            "int8 KV pool passed to a paged-attention kernel without "
+            "k_scale/v_scale — thread the cache's layer_scales() through")
+    if k_scale is not None and pool.dtype != jnp.int8:
+        raise ValueError(
+            f"k_scale/v_scale passed with a {pool.dtype} pool — scales "
+            "belong to int8 pools only (float values would be silently "
+            "multiplied by scale/127)")
+
 
 _STATE_ROWS = 8  # scratch rows; every row holds the same value so all
 # scratch traffic is full-width vector ops (the Mosaic-proven layout)
@@ -82,7 +124,7 @@ def _reject_mesh_sharded_pool(pool):
 
 
 def _head_shard_map(body, mesh, tp_axis, layout, q, k_pool, v_pool,
-                    *scalars):
+                    *scalars, scales=None):
     """Run `body` (a single-device kernel call) as a shard_map over the
     head-sharded tensor-parallel mesh: q and the output split on their
     head axis (axis 1 in all three kernels), the pools split per
@@ -90,7 +132,12 @@ def _head_shard_map(body, mesh, tp_axis, layout, q, k_pool, v_pool,
     Heads are fully independent in every grid, so the per-shard program
     is exactly the existing kernel on num_heads/tp heads over that
     shard's slice of the pool — no collective is issued here or inside
-    the kernel."""
+    the kernel.
+
+    `scales` (int8 pools): the ``(k_scale, v_scale)`` [P, H] arrays —
+    sharded on THEIR head axis (kv_scale_spec), so each shard
+    dequantizes its own heads with its own scale slice; body then
+    receives ``(q, k_pool, v_pool, k_scale, v_scale, *scalars)``."""
     from jax.sharding import PartitionSpec as P
 
     from ...parallel.collective import shard_map
@@ -107,10 +154,15 @@ def _head_shard_map(body, mesh, tp_axis, layout, q, k_pool, v_pool,
             f"the head axis, so heads must divide evenly")
     qspec = P(None, tp_axis, None)
     pspec = P(*kv_pool_spec(layout, tp_axis))
+    args = (q, k_pool, v_pool)
+    specs = (qspec, pspec, pspec)
+    if scales is not None:
+        args += tuple(scales)
+        specs += (P(None, tp_axis),) * len(scales)
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(qspec, pspec, pspec) + (P(),) * len(scalars),
+                   in_specs=specs + (P(),) * len(scalars),
                    out_specs=qspec)
-    return fn(q, k_pool, v_pool, *scalars)
+    return fn(*args, *scalars)
 
 
 def ragged_score_blocks(starts, lens, kv_lens, page_size, n_pages, n_rows,
@@ -149,9 +201,20 @@ def ragged_score_blocks(starts, lens, kv_lens, page_size, n_pages, n_rows,
     return tiled, untiled
 
 
-def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, page_size, n_pages):
+def _decode_kernel(pt_ref, sl_ref, *refs, page_size, n_pages,
+                   quantized=False):
+    """refs: ``[ks_ref, vs_ref]`` (quantized only — [P, H] scale
+    arrays in SMEM via scalar prefetch) + q/k/v/o + the three scratch
+    buffers.  In-kernel dequant: the int8 page block multiplies by its
+    ONE per-(page, head) factor ``scale * (1/127)`` before the score
+    matmul — the same elementwise expression the jnp reference applies
+    to its gathered view."""
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
+    h = pl.program_id(1)
     i = pl.program_id(2)
 
     @pl.when(i == 0)
@@ -168,6 +231,10 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0]                            # [1, D] (scale folded)
         k = k_ref[0, 0]                            # [page_size, D]
         v = v_ref[0, 0]
+        if quantized:
+            page = pt_ref[b, i]
+            k = k.astype(jnp.float32) * (ks_ref[page, h] * INV_QMAX)
+            v = v.astype(jnp.float32) * (vs_ref[page, h] * INV_QMAX)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         pos = i * page_size + jax.lax.broadcasted_iota(
@@ -194,14 +261,21 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[...] / safe_l)[0:1].astype(o_ref.dtype)
 
 
-def _chunk_kernel(pt_ref, info_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, page_size, n_pages, n_rows):
+def _chunk_kernel(pt_ref, info_ref, *refs, page_size, n_pages, n_rows,
+                  quantized=False):
     """Chunked-prefill attention for ONE sequence: n_rows chunk queries
     (query row r at global position start + r) attend over every key the
     page table holds — the already-written prefix AND the chunk's own
     freshly scattered keys — with a per-row causal mask.  Online-softmax
     state is [n_rows, ...] (the decode kernel's, grown from 1 query row
-    to the chunk), accumulated across the page axis."""
+    to the chunk), accumulated across the page axis.  Quantized pools
+    prepend [P, H] scale refs and dequantize each page block in-kernel
+    (see _decode_kernel)."""
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    h = pl.program_id(0)
     i = pl.program_id(1)
     start = info_ref[0]
 
@@ -219,6 +293,10 @@ def _chunk_kernel(pt_ref, info_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                               # [n_rows, D]
         k = k_ref[0, 0]                            # [page_size, D]
         v = v_ref[0, 0]
+        if quantized:
+            page = pt_ref[i]
+            k = k.astype(jnp.float32) * (ks_ref[page, h] * INV_QMAX)
+            v = v.astype(jnp.float32) * (vs_ref[page, h] * INV_QMAX)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         pos = i * page_size + jax.lax.broadcasted_iota(
@@ -247,9 +325,8 @@ def _chunk_kernel(pt_ref, info_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
 
 
-def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
-                   o_ref, acc_ref, m_ref, l_ref, *, page_size, n_pages,
-                   n_seqs, q_block):
+def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, *refs, page_size,
+                   n_pages, n_seqs, q_block, quantized=False):
     """RAGGED mixed-batch paged attention, QUERY-TILED (the RPA paper's
     kernel shape): packed query rows (decode singletons AND
     prefill-chunk runs in one token axis) attend through per-descriptor
@@ -274,7 +351,14 @@ def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
     tile the descriptor doesn't own see an all-NEG_INF score row, whose
     update is the exact identity (alpha == exp(0) == 1, sum(p) == 0),
     so tiles straddling a descriptor boundary stay exact.  Descriptors
-    with ln == 0 (padding) never run."""
+    with ln == 0 (padding) never run.  Quantized pools prepend [P, H]
+    scale refs and each live cell dequantizes its page block in-kernel
+    (see _decode_kernel)."""
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    hh = pl.program_id(0)
     s = pl.program_id(1)
     i = pl.program_id(2)
     qt = pl.program_id(3)
@@ -304,6 +388,10 @@ def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
         q = q_ref[0, rows_sl]                      # [q_block, D]
         k = k_ref[0, 0]                            # [page_size, D]
         v = v_ref[0, 0]
+        if quantized:
+            page = pt_ref[s, i]
+            k = k.astype(jnp.float32) * (ks_ref[page, hh] * INV_QMAX)
+            v = v.astype(jnp.float32) * (vs_ref[page, hh] * INV_QMAX)
         sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         row = row0 + jax.lax.broadcasted_iota(
@@ -340,7 +428,8 @@ def _ragged_kernel(pt_ref, st_ref, ln_ref, kv_ref, q_ref, k_ref, v_ref,
 def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
                                   lens, kv_lens, scale, interpret=None,
                                   layout="token", q_block=None,
-                                  mesh=None, tp_axis=None):
+                                  mesh=None, tp_axis=None, k_scale=None,
+                                  v_scale=None):
     """q: [T, H, D] — the step's PACKED query rows (decode rows and the
     prefill chunks in one ragged token axis; rows owned by no
     descriptor come back 0).  k_pool/v_pool: one layer's pool, the
@@ -363,17 +452,27 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
 
     Layout handling mirrors the decode kernel: token-layout pools are
     transposed per call, kernel-layout pools are consumed as stored."""
+    _require_scales(k_pool, k_scale, v_scale)
+    quantized = k_scale is not None
     if mesh is not None:
-        def body(q_, kp_, vp_, pt_, st_, ln_, kv_):
-            return ragged_paged_attention_kernel(
-                q_, kp_, vp_, pt_, st_, ln_, kv_, scale,
-                interpret=interpret, layout=layout, q_block=q_block)
+        if quantized:
+            def body(q_, kp_, vp_, ks_, vs_, pt_, st_, ln_, kv_):
+                return ragged_paged_attention_kernel(
+                    q_, kp_, vp_, pt_, st_, ln_, kv_, scale,
+                    interpret=interpret, layout=layout, q_block=q_block,
+                    k_scale=ks_, v_scale=vs_)
+        else:
+            def body(q_, kp_, vp_, pt_, st_, ln_, kv_):
+                return ragged_paged_attention_kernel(
+                    q_, kp_, vp_, pt_, st_, ln_, kv_, scale,
+                    interpret=interpret, layout=layout, q_block=q_block)
 
         return _head_shard_map(
             body, mesh, tp_axis, layout, q, k_pool, v_pool,
             jnp.asarray(page_tables, jnp.int32),
             jnp.asarray(starts, jnp.int32), jnp.asarray(lens, jnp.int32),
-            jnp.asarray(kv_lens, jnp.int32))
+            jnp.asarray(kv_lens, jnp.int32),
+            scales=((k_scale, v_scale) if quantized else None))
     _reject_mesh_sharded_pool(k_pool)
     t, h, d = q.shape
     qb = max(1, min(int(q_block or RAGGED_Q_BLOCK), t))
@@ -394,8 +493,19 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
         vt = jnp.transpose(v_pool, (2, 0, 1, 3))
     n_seqs, n_pages = page_tables.shape
 
+    # scalar-prefetch operands: page tables + descriptors, plus the
+    # [P, H] scale arrays for int8 pools (SMEM scalars the kernel
+    # indexes per (page, head) for the in-block dequant).  index_maps
+    # take *refs so one lambda serves both operand counts.
+    prefetch = [jnp.asarray(page_tables, jnp.int32),
+                jnp.asarray(starts, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(kv_lens, jnp.int32)]
+    if quantized:
+        prefetch += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=len(prefetch),
         # query tiles INNERMOST: the k/v block index is constant across
         # a page's tile sweep, so the tiling multiplies COMPUTE cells
         # only — the page-block DMA schedule (and q/out whole-axis
@@ -403,18 +513,17 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
         # kernel's
         grid=(h, n_seqs, n_pages, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, tpad, d), lambda h_, s, i, qt, pt, st, ln,
-                         kv: (h_, 0, 0)),
+            pl.BlockSpec((1, tpad, d),
+                         lambda h_, s, i, qt, *refs: (h_, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
-                         lambda h_, s, i, qt, pt, st, ln, kv:
-                         (h_, pt[s, i], 0, 0)),
+                         lambda h_, s, i, qt, *refs:
+                         (h_, refs[0][s, i], 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
-                         lambda h_, s, i, qt, pt, st, ln, kv:
-                         (h_, pt[s, i], 0, 0)),
+                         lambda h_, s, i, qt, *refs:
+                         (h_, refs[0][s, i], 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, tpad, d),
-                               lambda h_, s, i, qt, pt, st, ln, kv:
-                               (h_, 0, 0)),
+                               lambda h_, s, i, qt, *refs: (h_, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((tpad, d), jnp.float32),
             pltpu.VMEM((tpad, 128), jnp.float32),
@@ -423,19 +532,19 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
     )
     out = pl.pallas_call(
         functools.partial(_ragged_kernel, page_size=page_size,
-                          n_pages=n_pages, n_seqs=n_seqs, q_block=qb),
+                          n_pages=n_pages, n_seqs=n_seqs, q_block=qb,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((h, tpad, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(jnp.asarray(page_tables, jnp.int32), jnp.asarray(starts, jnp.int32),
-      jnp.asarray(lens, jnp.int32), jnp.asarray(kv_lens, jnp.int32),
-      qs, kt, vt)
+    )(*prefetch, qs, kt, vt)
     return jnp.transpose(out[:, :t], (1, 0, 2))
 
 
 def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
                                    scale, interpret=None, layout="token",
-                                   mesh=None, tp_axis=None):
+                                   mesh=None, tp_axis=None, k_scale=None,
+                                   v_scale=None):
     """q: [n, H, D] — one sequence's prefill-chunk queries (row r at
     global position start + r; rows past the real chunk length are
     bucket padding whose output the caller discards).  k_pool/v_pool:
@@ -450,16 +559,25 @@ def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
 
     Same layout reasoning as the decode kernel: token-layout pools are
     transposed per call, kernel-layout pools are consumed as stored."""
+    _require_scales(k_pool, k_scale, v_scale)
+    quantized = k_scale is not None
     if mesh is not None:
-        def body(q_, kp_, vp_, pt_, st_):
-            return chunk_prefill_attention_kernel(
-                q_, kp_, vp_, pt_, st_, scale, interpret=interpret,
-                layout=layout)
+        if quantized:
+            def body(q_, kp_, vp_, ks_, vs_, pt_, st_):
+                return chunk_prefill_attention_kernel(
+                    q_, kp_, vp_, pt_, st_, scale, interpret=interpret,
+                    layout=layout, k_scale=ks_, v_scale=vs_)
+        else:
+            def body(q_, kp_, vp_, pt_, st_):
+                return chunk_prefill_attention_kernel(
+                    q_, kp_, vp_, pt_, st_, scale, interpret=interpret,
+                    layout=layout)
 
         return _head_shard_map(
             body, mesh, tp_axis, layout, q, k_pool, v_pool,
             jnp.asarray(page_table, jnp.int32),
-            jnp.asarray(start, jnp.int32))
+            jnp.asarray(start, jnp.int32),
+            scales=((k_scale, v_scale) if quantized else None))
     _reject_mesh_sharded_pool(k_pool)
     n, h, d = q.shape
     qs = jnp.transpose((q * scale).astype(q.dtype), (1, 0, 2))  # [H, n, D]
@@ -473,17 +591,21 @@ def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
     n_pages = page_table.shape[0]
     info = jnp.asarray(start, jnp.int32).reshape(1)
 
+    prefetch = [jnp.asarray(page_table, jnp.int32), info]
+    if quantized:
+        prefetch += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(h, n_pages),
         in_specs=[
-            pl.BlockSpec((1, n, d), lambda h_, i, pt, nfo: (h_, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), lambda h_, i, pt, nfo:
-                         (h_, pt[i], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), lambda h_, i, pt, nfo:
-                         (h_, pt[i], 0, 0)),
+            pl.BlockSpec((1, n, d), lambda h_, i, *refs: (h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda h_, i, *refs:
+                         (h_, refs[0][i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda h_, i, *refs:
+                         (h_, refs[0][i], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, n, d), lambda h_, i, pt, nfo:
+        out_specs=pl.BlockSpec((1, n, d), lambda h_, i, *refs:
                                (h_, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((n, d), jnp.float32),
@@ -493,17 +615,19 @@ def chunk_prefill_attention_kernel(q, k_pool, v_pool, page_table, start,
     )
     out = pl.pallas_call(
         functools.partial(_chunk_kernel, page_size=page_size,
-                          n_pages=n_pages, n_rows=n),
+                          n_pages=n_pages, n_rows=n,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((h, n, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(jnp.asarray(page_table, jnp.int32), info, qs, kt, vt)
+    )(*prefetch, qs, kt, vt)
     return jnp.transpose(out, (1, 0, 2))
 
 
 def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
                                   scale, interpret=None, layout="token",
-                                  mesh=None, tp_axis=None):
+                                  mesh=None, tp_axis=None, k_scale=None,
+                                  v_scale=None):
     """q: [B, H, D].  k_pool/v_pool: one layer's pool —
     [P, page_size, H, D] (layout="token") or [H, P, page_size, D]
     (layout="kernel", DeviceKVPool's kernel-layout storage).
@@ -517,16 +641,25 @@ def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
     pools are transposed here per call — O(pool) HBM traffic per layer
     per step, which is exactly why kernel-layout pools exist: scattering
     into [H, P, page_size, D] on write makes this call transpose-free."""
+    _require_scales(k_pool, k_scale, v_scale)
+    quantized = k_scale is not None
     if mesh is not None:
-        def body(q_, kp_, vp_, pt_, sl_):
-            return paged_decode_attention_kernel(
-                q_, kp_, vp_, pt_, sl_, scale, interpret=interpret,
-                layout=layout)
+        if quantized:
+            def body(q_, kp_, vp_, ks_, vs_, pt_, sl_):
+                return paged_decode_attention_kernel(
+                    q_, kp_, vp_, pt_, sl_, scale, interpret=interpret,
+                    layout=layout, k_scale=ks_, v_scale=vs_)
+        else:
+            def body(q_, kp_, vp_, pt_, sl_):
+                return paged_decode_attention_kernel(
+                    q_, kp_, vp_, pt_, sl_, scale, interpret=interpret,
+                    layout=layout)
 
         return _head_shard_map(
             body, mesh, tp_axis, layout, q, k_pool, v_pool,
             jnp.asarray(page_tables, jnp.int32),
-            jnp.asarray(seq_lens, jnp.int32))
+            jnp.asarray(seq_lens, jnp.int32),
+            scales=((k_scale, v_scale) if quantized else None))
     _reject_mesh_sharded_pool(k_pool)
     b, h, d = q.shape
     qs = (q * scale).astype(q.dtype).reshape(b, h, 1, d)
@@ -540,18 +673,23 @@ def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
         vt = jnp.transpose(v_pool, (2, 0, 1, 3))
     n_pages = page_tables.shape[1]
 
+    prefetch = [jnp.asarray(page_tables, jnp.int32),
+                jnp.asarray(seq_lens, jnp.int32)]
+    if quantized:
+        prefetch += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(b, h, n_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, i, pt, sl:
+            pl.BlockSpec((1, 1, 1, d), lambda b_, h_, i, *refs:
                          (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), lambda b_, h_, i, pt, sl:
-                         (h_, pt[b_, i], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), lambda b_, h_, i, pt, sl:
-                         (h_, pt[b_, i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda b_, h_, i, *refs:
+                         (h_, refs[0][b_, i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda b_, h_, i, *refs:
+                         (h_, refs[0][b_, i], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, d), lambda b_, h_, i, pt, sl:
+        out_specs=pl.BlockSpec((1, 1, 1, d), lambda b_, h_, i, *refs:
                                (b_, h_, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((_STATE_ROWS, d), jnp.float32),
@@ -561,10 +699,9 @@ def paged_decode_attention_kernel(q, k_pool, v_pool, page_tables, seq_lens,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, page_size=page_size,
-                          n_pages=n_pages),
+                          n_pages=n_pages, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(jnp.asarray(page_tables, jnp.int32), jnp.asarray(seq_lens, jnp.int32),
-      qs, kt, vt)
+    )(*prefetch, qs, kt, vt)
     return out.reshape(b, h, d)

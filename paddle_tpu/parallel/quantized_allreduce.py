@@ -1,0 +1,138 @@
+"""EQuARX-style quantized allreduce (arxiv 2506.17615, PAPERS.md).
+
+The sharded generation step has exactly two collectives per layer: the
+Megatron allreduces after the row-sharded ``wo`` and ``w2``
+contractions, each over a ``[rows, d_model]`` float32 activation block.
+On the wire a ring allreduce moves ``2 (N-1)/N`` of the payload per
+device — all of it float32 today.  EQuARX's observation: the payload
+tolerates int8 with per-hop abs-max scales at negligible quality loss,
+cutting the dominant wire bytes ~4x.
+
+XLA's implicit GSPMD allreduce cannot be quantized from the outside, so
+``quantized_matmul_allreduce`` makes the collective EXPLICIT: the
+row-sharded matmul runs inside a ``shard_map`` block placed exactly
+where the implicit allreduce sits today (between the partial-sum matmul
+and the residual add), and the reduction is a hand-rolled ring over
+``ppermute``:
+
+- reduce-scatter phase: N-1 hops; each hop quantizes the accumulated
+  chunk to int8 against its own abs-max scale (one f32 scalar per
+  chunk), ships int8 + scale, and the receiver dequantizes and adds
+  its local chunk — the quantize -> psum -> dequant block, per hop,
+  exactly the EQuARX construction;
+- all-gather phase: N-1 hops shipping each finished chunk once (int8 +
+  scale); EVERY shard — the owner included — reads the chunk through
+  the same dequant, so the output is bit-identical across shards
+  (a replicated out_spec demands it).
+
+Wire bytes per device: ``2 (N-1)/N * rows * d_model`` int8 plus
+``2 (N-1)`` f32 scale scalars — the ~4x the acceptance gauge
+(`generation.collective_bytes_per_step`) is cut by.  Quantization
+noise enters the activations once per hop; the quality-gate harness
+(generation/quality.py) bounds the resulting logit drift and token
+agreement against the fp32 oracle, the same contract as int8 KV.
+
+Pure function of its inputs and the ring order (fixed by axis index),
+so the result is deterministic — int8-vs-int8 token identity across
+transports and restarts holds exactly like every other engine path.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..generation.quantized_kv import dequantize_int8, quantize_int8
+
+
+def _quant(x):
+    """(int8, f32 scalar scale) of one chunk — per-shard abs-max,
+    rounded by the ONE quantization home (generation/quantized_kv)."""
+    s = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    return quantize_int8(x, s, jnp), s
+
+
+def _dequant(q, s):
+    return dequantize_int8(q, s, jnp)
+
+
+def quantized_ring_allreduce(x, axis_name, n):
+    """Sum `x` ([rows, d] per-shard partial) over `axis_name` (size
+    `n`, static) through the quantized ring.  Must run inside a
+    shard_map over that axis.  Returns the full sum, bit-identical on
+    every shard."""
+    if n == 1:
+        return x
+    rows, d = x.shape
+    pad = (-rows) % n
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    chunks = xp.reshape(n, -1, d)                      # [n, rows/n, d]
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # ---- reduce-scatter: hop t delivers chunk (idx - t) mod n, whose
+    # running sum gains this shard's local copy; after n-1 hops shard i
+    # holds the FULL sum of chunk (i - (n-2)) mod n.  Each hop ships
+    # int8 + its abs-max scale; the receiver dequantizes and adds.
+    acc = jnp.take(chunks, (idx + 1) % n, axis=0)      # hop-0 send
+    for t in range(n - 1):
+        q, s = _quant(acc)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        s = jax.lax.ppermute(s, axis_name, perm)
+        recv_id = (idx - t) % n
+        acc = _dequant(q, s) + jnp.take(chunks, recv_id, axis=0)
+    own_id = (idx - (n - 2)) % n                       # acc's chunk id
+
+    # ---- all-gather: quantize each finished chunk ONCE and walk it
+    # around the ring; every shard (owner included) dequantizes the
+    # same bytes, so all shards assemble the identical result.
+    out = jnp.zeros_like(chunks)
+    q, s = _quant(acc)
+    out = jax.lax.dynamic_update_index_in_dim(
+        out, _dequant(q, s), own_id, axis=0)
+    for t in range(n - 1):
+        q = jax.lax.ppermute(q, axis_name, perm)
+        s = jax.lax.ppermute(s, axis_name, perm)
+        recv_id = (own_id - 1 - t) % n
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, _dequant(q, s), recv_id, axis=0)
+    full = out.reshape(-1, d)
+    return full[:rows] if pad else full
+
+
+def quantized_matmul_allreduce(mesh, tp_axis):
+    """Build ``qmm(a, w) -> a @ w summed over the sharded contraction``
+    for a column-sharded activation `a` ``[rows, k]`` (k split over
+    `tp_axis`) against a row-sharded weight `w` ``[k, d]`` — the
+    drop-in replacement for the two Megatron matmuls whose implicit
+    GSPMD allreduce this makes explicit and quantized.  The returned
+    callable is used INSIDE the jitted step traces (shard_map under
+    jit, the same nesting as the mesh-native Pallas kernels)."""
+    from .collective import shard_map
+
+    n = int(mesh.shape[tp_axis])
+
+    def local(a_loc, w_loc):
+        part = jnp.matmul(a_loc, w_loc,
+                          preferred_element_type=jnp.float32)
+        return quantized_ring_allreduce(part, tp_axis, n)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(None, tp_axis), P(tp_axis, None)),
+                   out_specs=P(None, None))
+
+    def qmm(a, w):
+        return fn(a, w)
+
+    return qmm
+
+
+def quantized_collective_bytes(num_layers, rows, d_model, tp_degree):
+    """Estimated on-wire bytes of ONE sharded dispatch's two per-layer
+    allreduces under the quantized ring — the quantized counterpart of
+    fused._collective_bytes_estimate (int8 payload x the same ring
+    factor, plus the per-hop scale scalars)."""
+    if tp_degree <= 1:
+        return 0
+    payload = int(rows) * int(d_model)           # int8: 1 byte/elem
+    per_ar = (payload * 2 * (tp_degree - 1) / tp_degree
+              + 2 * (tp_degree - 1) * 4)         # scale scalars
+    return int(2 * num_layers * per_ar)

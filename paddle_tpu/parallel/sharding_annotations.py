@@ -54,6 +54,14 @@ def kv_pool_spec(pool_layout, tp_axis):
     return PartitionSpec(None, None, tp_axis, None)
 
 
+def kv_scale_spec(tp_axis):
+    """PartitionSpec for one pool's per-page per-head int8 scale array
+    ``[num_pages, num_heads]`` (layout-independent — the scale array is
+    ``[P, H]`` whatever the pool layout stores): heads are the
+    tensor-parallel shard axis, exactly like the pools themselves."""
+    return PartitionSpec(None, tp_axis)
+
+
 def constrain(x, mesh, *axes):
     """`with_sharding_constraint` under `mesh` (identity when mesh is
     None) — the in-trace pin the sharded decode step uses to anchor

@@ -1,0 +1,623 @@
+"""Int8 KV pools + quantized collectives: the quality-gate contract.
+
+Every previous generation perf path shipped under bitwise token
+identity vs the fp32 oracle.  int8 storage is lossy by construction, so
+the contract splits in two (docs/GENERATION.md "Quantized KV and
+collectives"):
+
+- vs the fp32 oracle: the QUALITY GATE — bounded max-logit drift and
+  >= 99% greedy-token agreement on seeded workloads
+  (generation/quality.py);
+- int8-vs-int8: strict TOKEN IDENTITY across every engine path —
+  host/device backends, both pool layouts, eager/fused/ragged,
+  kernel-vs-reference, preemption, prefix warm starts, live migration,
+  and the forced 4-device CPU mesh — quantization changes values ONCE
+  (at the write), never per path.
+
+Plus the storage facts (int8 halves bf16 pool bytes at equal page
+count, scales ride COW copies and exports) and the typed
+heterogeneous-fleet refusal (KVQuantMismatchError).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.generation as gen
+from paddle_tpu.generation.kv_cache import (DeviceKVPool,
+                                            KVQuantMismatchError,
+                                            PagedKVCache)
+from paddle_tpu.generation.quantized_kv import (dequantize_int8,
+                                                quantize_int8)
+
+L, H, D, PS = 2, 2, 8, 4
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=VOCAB, num_layers=L, num_heads=H,
+                            head_dim=D, max_positions=512, seed=0)
+
+
+@pytest.fixture(scope="module")
+def mesh_model():
+    # 4-way head sharding needs heads % 4 == 0
+    return gen.TinyCausalLM(vocab_size=VOCAB, num_layers=L, num_heads=4,
+                            head_dim=D, max_positions=512, seed=0)
+
+
+PROMPTS = [[1, 2, 3, 4, 5, 6, 7], [9, 8, 7, 6, 5],
+           [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]]
+
+
+def run_engine(model, prompts=PROMPTS, new_tokens=10, stochastic=False,
+               **cfg):
+    cfg.setdefault("max_decode_slots", 4)
+    cfg.setdefault("num_pages", 64)
+    cfg.setdefault("page_size", PS)
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(**cfg),
+                               start=False)
+    try:
+        handles = []
+        for i, p in enumerate(prompts):
+            sampling = (gen.SamplingParams(temperature=0.8, top_k=8,
+                                           seed=100 + i)
+                        if stochastic and i % 2 else gen.SamplingParams())
+            handles.append(eng.submit(p, max_new_tokens=new_tokens,
+                                      sampling=sampling))
+        eng.run_until_idle()
+        out = [h.result(timeout=30).token_ids for h in handles]
+        snap = eng.stats()
+    finally:
+        eng.shutdown()
+    return out, snap
+
+
+def fill_cache(cache, seq="s", n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    cache.allocate(seq)
+    k = rng.standard_normal((cache.num_layers, n, cache.num_heads,
+                             cache.head_dim)).astype(np.float32)
+    v = rng.standard_normal((cache.num_layers, n, cache.num_heads,
+                             cache.head_dim)).astype(np.float32)
+    cache.append_prefill(seq, k, v)
+    return k, v
+
+
+# --------------------------- storage facts ---------------------------
+
+def test_int8_pool_halves_bytes_vs_bf16():
+    """The acceptance arithmetic: int8 pools (scales included) hold the
+    same pages in ~half the bf16 bytes, for the host backend and both
+    device layouts."""
+    def pool_bytes(cache):
+        b = cache.k_pool.nbytes + cache.v_pool.nbytes
+        if cache.quantized:
+            b += cache.k_scale.nbytes + cache.v_scale.nbytes
+        return b
+
+    for build in (
+        lambda dt: PagedKVCache(L, H, D, num_pages=32, page_size=PS,
+                                dtype=dt),
+        lambda dt: DeviceKVPool(L, H, D, num_pages=32, page_size=PS,
+                                dtype=dt),
+        lambda dt: DeviceKVPool(L, H, D, num_pages=32, page_size=PS,
+                                dtype=dt, pool_layout="kernel"),
+    ):
+        q = build(np.int8)
+        b16 = build("bfloat16")
+        assert q.dtype.itemsize == 1 and q.quantized
+        ratio = pool_bytes(q) / pool_bytes(b16)
+        assert ratio <= 0.6, f"int8 pool is {ratio:.2f}x bf16 bytes"
+
+
+def test_quantized_write_matches_fake_quant():
+    """A one-span page write is EXACTLY the single-rounding fake-quant
+    of the payload against the page's per-head abs-max — the
+    paddle_tpu.quant.quant_dequant grid (the same machinery the
+    quality harness reuses)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.quant import quant_dequant
+
+    cache = PagedKVCache(L, H, D, num_pages=8, page_size=PS,
+                         dtype=np.int8)
+    k, v = fill_cache(cache, n=PS)         # exactly one full page
+    stored = dequantize_int8(cache.k_pool[:, cache.page_table("s")[0]],
+                             cache.k_scale[:, cache.page_table("s")[0]]
+                             [:, None, :, None])
+    scale = np.max(np.abs(k[:, :PS]), axis=(1, 3))[:, None, :, None]
+    ideal = np.asarray(quant_dequant(jnp.asarray(k[:, :PS]),
+                                     jnp.asarray(scale)))
+    # quant_dequant computes q * scale / 127, our dequant
+    # q * (scale * 1/127): same grid, ulp-different expression order
+    np.testing.assert_allclose(stored, ideal, rtol=0, atol=1e-6)
+
+
+def test_write_roundtrip_error_bound():
+    """gather_prefix hands back dequantized rows within half an LSB of
+    the page grid (scale / 127 / 2) of the original payload."""
+    for cache in (
+        PagedKVCache(L, H, D, num_pages=16, page_size=PS,
+                     dtype=np.int8),
+        DeviceKVPool(L, H, D, num_pages=16, page_size=PS,
+                     dtype=np.int8, pool_layout="kernel"),
+    ):
+        k, _ = fill_cache(cache, n=11)
+        got = np.asarray(cache.gather_prefix("s", 0, 11)[0])
+        bound = np.max(np.abs(k[0])) / 127 * 0.51 + 1e-7
+        assert np.max(np.abs(got - k[0])) <= bound
+
+
+def test_page_scale_resets_on_reuse():
+    """A freed page's scale must not poison the next owner: after a
+    large-magnitude sequence frees its pages, a small-magnitude
+    sequence quantizes on a FRESH grid (pool history cannot change
+    bytes — the determinism int8-vs-int8 identity rests on)."""
+    for cache in (
+        PagedKVCache(L, H, D, num_pages=4, page_size=PS, dtype=np.int8),
+        DeviceKVPool(L, H, D, num_pages=4, page_size=PS, dtype=np.int8),
+    ):
+        rng = np.random.default_rng(0)
+        big = rng.standard_normal((L, PS, H, D)).astype(np.float32) * 100
+        cache.allocate("big")
+        cache.append_prefill("big", big, big)
+        cache.free("big")
+        small = rng.standard_normal((L, PS, H, D)).astype(np.float32)
+        cache.allocate("small")
+        cache.append_prefill("small", small, small)
+        got = np.asarray(cache.gather_prefix("small", 0, PS)[0])
+        bound = np.max(np.abs(small[0])) / 127 * 0.51 + 1e-7
+        assert np.max(np.abs(got - small[0])) <= bound
+        # and the scale rows themselves reflect the SMALL payload
+        page = cache.page_table("small")[0]
+        assert np.max(cache.k_scale[:, page]) <= np.max(np.abs(small))
+
+
+def test_host_device_quantize_bitwise():
+    """The host numpy transform and the in-trace device transform
+    produce bit-identical int8 pools and scales (round-half-to-even in
+    both) — both layouts."""
+    caches = [
+        PagedKVCache(L, H, D, num_pages=16, page_size=PS,
+                     dtype=np.int8),
+        DeviceKVPool(L, H, D, num_pages=16, page_size=PS,
+                     dtype=np.int8),
+        DeviceKVPool(L, H, D, num_pages=16, page_size=PS,
+                     dtype=np.int8, pool_layout="kernel"),
+    ]
+    rng = np.random.default_rng(3)
+    extra_k = rng.standard_normal((L, H, D)).astype(np.float32)
+    extra_v = rng.standard_normal((L, H, D)).astype(np.float32)
+    for c in caches:
+        fill_cache(c, n=10, seed=7)
+        c.append("s", extra_k, extra_v)     # decode-style append
+    ref = caches[0]
+    for c in caches[1:]:
+        assert np.array_equal(ref.k_pool, c.k_pool)
+        assert np.array_equal(ref.v_pool, c.v_pool)
+        assert np.array_equal(ref.k_scale, c.k_scale)
+        assert np.array_equal(ref.v_scale, c.v_scale)
+
+
+# ----------------------- export / import / COW -----------------------
+
+def test_export_import_bitwise_roundtrip():
+    """int8 pages + scales roundtrip bitwise through the canonical
+    export payload, across backend/layout combinations."""
+    builders = [
+        lambda: PagedKVCache(L, H, D, num_pages=16, page_size=PS,
+                             dtype=np.int8),
+        lambda: DeviceKVPool(L, H, D, num_pages=16, page_size=PS,
+                             dtype=np.int8),
+        lambda: DeviceKVPool(L, H, D, num_pages=16, page_size=PS,
+                             dtype=np.int8, pool_layout="kernel"),
+    ]
+    for src_build in builders:
+        src = src_build()
+        fill_cache(src, n=9, seed=5)
+        payload = src.export_pages(src.page_table("s"))
+        assert len(payload) == 4 and payload[0].dtype == np.int8
+        for dst_build in builders:
+            dst = dst_build()
+            pages = dst.import_pages(*payload)
+            again = dst.export_pages(pages)
+            for a, b in zip(payload, again):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_import_bitwise_mesh():
+    """A head-sharded int8 pool exports the same canonical payload as
+    an unsharded one, and a donated import re-installs it bitwise with
+    the sharding (and scale sharding) preserved."""
+    from paddle_tpu.parallel import tp_mesh
+
+    mesh = tp_mesh(4)
+    plain = DeviceKVPool(L, 4, D, num_pages=16, page_size=PS,
+                         dtype=np.int8)
+    sharded = DeviceKVPool(L, 4, D, num_pages=16, page_size=PS,
+                           dtype=np.int8, mesh=mesh)
+    for c in (plain, sharded):
+        fill_cache(c, n=9, seed=5)
+    pp = plain.export_pages(plain.page_table("s"))
+    sp = sharded.export_pages(sharded.page_table("s"))
+    for a, b in zip(pp, sp):
+        assert np.array_equal(a, b)
+    pages = sharded.import_pages(*pp)
+    again = sharded.export_pages(pages)
+    for a, b in zip(pp, again):
+        assert np.array_equal(a, b)
+    # the installed scale arrays keep their NamedSharding
+    assert sharded._ks[0].sharding == sharded.scale_sharding
+
+
+def test_import_quant_mismatch_typed():
+    """The heterogeneous-fleet boundary is typed and loud: int8 bytes
+    into a float pool, float bytes into an int8 pool, and scale-less
+    int8 payloads all raise KVQuantMismatchError (a ValueError, so the
+    serving fallbacks stay graceful)."""
+    q = PagedKVCache(L, H, D, num_pages=16, page_size=PS, dtype=np.int8)
+    f = PagedKVCache(L, H, D, num_pages=16, page_size=PS,
+                     dtype="bfloat16")
+    fill_cache(q, n=6, seed=1)
+    fill_cache(f, n=6, seed=1)
+    qpay = q.export_pages(q.page_table("s"))
+    fpay = f.export_pages(f.page_table("s"))
+    with pytest.raises(KVQuantMismatchError):
+        f.import_pages(*qpay)               # int8 -> bf16 pool
+    with pytest.raises(KVQuantMismatchError):
+        q.import_pages(*fpay)               # bf16 -> int8 pool
+    with pytest.raises(KVQuantMismatchError):
+        q.import_pages(qpay[0], qpay[1])    # int8 without its grid
+    assert issubclass(KVQuantMismatchError, ValueError)
+
+
+def test_heterogeneous_fleet_adoption_degrades_typed(model):
+    """Engine level: an int8 replica's exported state offered to a
+    bf16 replica is refused (False / 0), never installed — the
+    cold-resubmit / skip-adoption ladders handle the heterogeneous
+    fleet."""
+    src = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=2, num_pages=64, page_size=PS,
+        kv_backend="device", kv_dtype="int8", prefill_chunk_tokens=4,
+        prefix_cache=True), start=False)
+    dst = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=2, num_pages=64, page_size=PS,
+        kv_backend="device", kv_dtype="bfloat16",
+        prefill_chunk_tokens=4, prefix_cache=True), start=False)
+    try:
+        h = src.submit(PROMPTS[0], max_new_tokens=6)
+        for _ in range(40):
+            if h.done():
+                break
+            src.step()
+        h.result(timeout=5)
+        payload = src.export_prefix_pages(PROMPTS[0])
+        assert payload is not None and "k_scale" in payload
+        assert dst.import_prefix_pages(payload) == 0
+        # live-migration snapshot refused the same way
+        h2 = src.submit(PROMPTS[2], max_new_tokens=8)
+        for _ in range(6):
+            src.step()
+        cold, live = src.evacuate_for_migration()
+        assert live, "expected a live decode-phase snapshot"
+        assert dst.import_sequence(live[0]) is False
+        live[0]["future"].set_exception(RuntimeError("test drain"))
+        for req, _ in cold:
+            req.future.set_exception(RuntimeError("test drain"))
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def test_cow_privatization_copies_scales(model):
+    """Prefix-cache COW at int8: the private copy carries the donor's
+    bytes AND scale rows; the donor page stays pinned bitwise; and the
+    refcount-leak invariant holds (drain + flush == all free)."""
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=4, num_pages=64, page_size=PS,
+        kv_backend="device", kv_dtype="int8", prefill_chunk_tokens=4,
+        prefix_cache=True), start=False)
+    try:
+        cache = eng.cache
+        warm = [5] * (2 * PS + 2)           # full shared pages + tail
+        h1 = eng.submit(warm, max_new_tokens=4)
+        eng.run_until_idle()
+        h1.result(timeout=10)
+        donor_pages = cache.match_prefix(warm + [9])[0]
+        assert donor_pages
+        donor_k = cache.k_pool[:, list(donor_pages)].copy()
+        donor_ks = cache.k_scale[:, list(donor_pages)].copy()
+        cow_before = cache._cow_copies
+        # same prefix, divergent suffix -> aliases pages, COWs the tail
+        h2 = eng.submit(warm[:2 * PS + 1] + [9, 9, 9],
+                        max_new_tokens=4)
+        eng.run_until_idle()
+        h2.result(timeout=10)
+        assert cache._cow_copies + \
+            eng.metrics.snapshot().get("generation.cow_copies", 0) \
+            >= cow_before   # COW path exercised (counter drained)
+        # donor pages: bytes and scales pinned bitwise
+        assert np.array_equal(cache.k_pool[:, list(donor_pages)],
+                              donor_k)
+        assert np.array_equal(cache.k_scale[:, list(donor_pages)],
+                              donor_ks)
+        # refcount-leak invariant at int8
+        assert cache.pages_in_use > 0
+        cache.flush_prefix_cache()
+        assert cache.num_free_pages == cache.num_pages
+    finally:
+        eng.shutdown()
+
+
+# ----------------------- int8-vs-int8 identity -----------------------
+
+def test_int8_host_vs_device_identity(model):
+    base, _ = run_engine(model, kv_dtype="int8", kv_backend="host",
+                         stochastic=True)
+    for layout in ("token", "kernel"):
+        out, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                            pool_layout=layout, stochastic=True)
+        assert out == base
+
+
+def test_int8_fused_vs_eager_identity(model):
+    base, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                         stochastic=True)
+    out, snap = run_engine(model, kv_dtype="int8", kv_backend="device",
+                           decode="fused", stochastic=True)
+    assert out == base
+    assert snap.get("generation.kv_quant_dtype") == "int8"
+
+
+def test_int8_ragged_vs_eager_identity(model):
+    base, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                         prefill_chunk_tokens=4, stochastic=True)
+    out, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                        step_mode="ragged", prefill_chunk_tokens=4,
+                        stochastic=True)
+    assert out == base
+
+
+def test_int8_kernel_vs_reference_identity(model):
+    """In-kernel dequant (interpret mode on CPU) reproduces the
+    reference path token for token — decode, chunk, and ragged
+    kernels, both layouts."""
+    for layout in ("token", "kernel"):
+        ref, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                            step_mode="ragged", prefill_chunk_tokens=4,
+                            pool_layout=layout, use_kernel=False)
+        ker, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                            step_mode="ragged", prefill_chunk_tokens=4,
+                            pool_layout=layout, use_kernel=True)
+        assert ker == ref
+    ref, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                        decode="fused", use_kernel=False)
+    ker, _ = run_engine(model, kv_dtype="int8", kv_backend="device",
+                        decode="fused", use_kernel=True)
+    assert ker == ref
+
+
+def test_int8_preemption_token_identity(model):
+    """Forced preemption (tight pool) replays re-prefill through the
+    same quantized write history — tokens identical to the roomy
+    run."""
+    roomy, _ = run_engine(model, num_pages=64, kv_dtype="int8",
+                          kv_backend="device", stochastic=True)
+    tight, snap = run_engine(model, num_pages=11, kv_dtype="int8",
+                             kv_backend="device", stochastic=True)
+    assert snap.get("generation.preempted_total", 0) > 0, \
+        "the tight pool was expected to force preemption"
+    assert tight == roomy
+
+
+def test_int8_prefix_warm_vs_cold_identity(model):
+    """Warm starts at int8: the suffix run after aliasing cached int8
+    pages generates the same tokens as the cold run."""
+    prompt = [5] * (2 * PS) + [1, 2, 3]
+    cold, _ = run_engine(model, prompts=[prompt], kv_dtype="int8",
+                         kv_backend="device", prefill_chunk_tokens=4,
+                         prefix_cache=False)
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=4, num_pages=64, page_size=PS,
+        kv_backend="device", kv_dtype="int8", prefill_chunk_tokens=4,
+        prefix_cache=True), start=False)
+    try:
+        h1 = eng.submit(prompt, max_new_tokens=10)
+        eng.run_until_idle()
+        first = h1.result(timeout=10).token_ids
+        h2 = eng.submit(prompt, max_new_tokens=10)
+        eng.run_until_idle()
+        warm = h2.result(timeout=10).token_ids
+        assert h2.prefix_hit_tokens and h2.prefix_hit_tokens > 0
+    finally:
+        eng.shutdown()
+    assert first == cold[0]
+    assert warm == cold[0]
+
+
+def test_int8_mesh_token_identity(mesh_model):
+    """The forced 4-device CPU mesh (ragged + shard_map'd kernels,
+    scales head-sharded) is token-identical to the single-chip int8
+    eager oracle."""
+    from paddle_tpu.parallel import tp_mesh
+
+    base, _ = run_engine(mesh_model, kv_dtype="int8",
+                         kv_backend="device", stochastic=True)
+    out, snap = run_engine(mesh_model, kv_dtype="int8",
+                           kv_backend="device", mesh=tp_mesh(4),
+                           step_mode="ragged", prefill_chunk_tokens=4,
+                           use_kernel=True, stochastic=True)
+    assert out == base
+    assert snap.get("generation.mesh_devices") == 4
+
+
+def test_int8_live_migration_resume(model):
+    """Mid-stream drain at int8: the sibling imports page bytes +
+    scales and RESUMES — the stitched stream equals the uninterrupted
+    run."""
+    cfg = dict(max_decode_slots=2, num_pages=64, page_size=PS,
+               kv_backend="device", kv_dtype="int8")
+    full, _ = run_engine(model, prompts=[PROMPTS[0]], new_tokens=12,
+                         **cfg)
+    a = gen.GenerationEngine(model, gen.GenerationConfig(**cfg),
+                             start=False)
+    b = gen.GenerationEngine(model, gen.GenerationConfig(**cfg),
+                             start=False)
+    try:
+        h = a.submit(PROMPTS[0], max_new_tokens=12)
+        for _ in range(5):
+            a.step()
+        cold, live = a.evacuate_for_migration()
+        assert not cold and len(live) == 1
+        assert live[0]["k_scale"] is not None
+        assert b.import_sequence(live[0])
+        b.run_until_idle()
+        assert h.result(timeout=10).token_ids == full[0]
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+# --------------------------- quality gate ----------------------------
+
+def test_quality_gate_drift_and_agreement(model):
+    """The acceptance contract vs the fp32 oracle: >= 99% greedy-token
+    agreement and bounded max-logit drift that tracks the idealized
+    single-rounding fake-quant floor."""
+    from paddle_tpu.generation.quality import kv_quality_report
+
+    mk = lambda **kw: gen.GenerationConfig(  # noqa: E731
+        max_decode_slots=4, num_pages=64, page_size=PS,
+        kv_backend="device", **kw)
+    rep = kv_quality_report(model, mk(), mk(kv_dtype="int8"),
+                            max_new_tokens=12)
+    assert rep["agreement"] >= 0.99, rep
+    assert rep["max_logit_drift"] < 0.25, rep
+    # the engine write path must track the single-rounding ideal: a
+    # runaway-requantization regression would blow this envelope
+    assert rep["max_logit_drift"] <= \
+        rep["ideal_fake_quant_drift"] * 4 + 0.05, rep
+
+
+# ------------------------ quantized collectives ----------------------
+
+def test_quantized_ring_allreduce_exact_enough():
+    import jax
+
+    from paddle_tpu.parallel import tp_mesh
+    from paddle_tpu.parallel.quantized_allreduce import (
+        quantized_matmul_allreduce)
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((6, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 12)).astype(np.float32)
+    exact = a @ w
+    for n in (2, 4):
+        mesh = tp_mesh(n)
+        qmm = jax.jit(quantized_matmul_allreduce(
+            mesh, mesh.axis_names[0]))
+        out = np.asarray(qmm(a, w))
+        rel = np.max(np.abs(out - exact)) / np.max(np.abs(exact))
+        assert rel < 0.05, (n, rel)
+        # deterministic: the ring order is fixed, re-running is bitwise
+        assert np.array_equal(out, np.asarray(qmm(a, w)))
+
+
+def test_quantized_collective_bytes_estimate():
+    from paddle_tpu.generation.fused import _collective_bytes_estimate
+
+    fp32 = _collective_bytes_estimate(2, 16, 64, 4)
+    q = _collective_bytes_estimate(2, 16, 64, 4, quantized=True)
+    assert fp32 / q >= 3.0, (fp32, q)
+    assert _collective_bytes_estimate(2, 16, 64, 1, quantized=True) == 0
+
+
+def test_quantized_collectives_engine(mesh_model):
+    """The 4-device CPU mesh cell: the flag cuts
+    collective_bytes_per_step >= 3x, stamps collective_quantized=1,
+    and passes the same token-agreement gate vs its fp32-collective
+    sibling."""
+    from paddle_tpu.parallel import tp_mesh
+
+    mesh = tp_mesh(4)
+    kw = dict(kv_dtype="int8", kv_backend="device", mesh=mesh,
+              step_mode="ragged", prefill_chunk_tokens=4,
+              use_kernel=True)
+    base, snap_fp = run_engine(mesh_model, **kw)
+    quant, snap_q = run_engine(mesh_model, quantized_collectives=True,
+                               **kw)
+    assert snap_fp.get("generation.collective_quantized") == 0
+    assert snap_q.get("generation.collective_quantized") == 1
+    fp_bytes = snap_fp.get("generation.collective_bytes_per_step")
+    q_bytes = snap_q.get("generation.collective_bytes_per_step")
+    assert fp_bytes / q_bytes >= 3.0, (fp_bytes, q_bytes)
+    total = sum(len(t) for t in base)
+    agree = sum(x == y for tb, tq in zip(base, quant)
+                for x, y in zip(tb, tq))
+    assert agree / total >= 0.99, (agree, total, base, quant)
+
+
+def test_quantized_collectives_inert_without_mesh(model):
+    """The flag without collectives to quantize is visible as a stats
+    fact, not a silent pretend-on."""
+    _, snap = run_engine(model, kv_dtype="int8", kv_backend="device",
+                         quantized_collectives=True)
+    assert snap.get("generation.collective_quantized") == 0
+
+
+# ------------------------------ metrics ------------------------------
+
+def test_kv_quant_metrics_and_stats(model):
+    out, snap = run_engine(model, kv_dtype="int8", kv_backend="device",
+                           decode="fused")
+    assert snap.get("generation.kv_quant_dtype") == "int8"
+    scale_bytes = snap.get("generation.kv_scale_bytes", 0)
+    assert scale_bytes > 0
+    # folded: scales are a subset of the total bytes in flight
+    assert snap.get("generation.kv_bytes_moved", 0) >= scale_bytes
+    assert snap.get("cache.kv_dtype") == "int8"
+    # fp32 engines stamp their dtype too (schema-complete snapshots)
+    _, snap32 = run_engine(model, kv_backend="device")
+    assert snap32.get("generation.kv_quant_dtype") == "float32"
+
+
+def test_config_accepts_dtype_names():
+    cfg = gen.GenerationConfig(kv_dtype="int8")
+    assert cfg.kv_dtype == np.dtype(np.int8)
+    assert gen.GenerationConfig().kv_dtype == np.dtype(np.float32)
+
+
+def test_int8_pool_without_scales_fails_loudly():
+    """An int8 pool reaching attention without its scale arrays must
+    fail typed instead of mis-decoding raw codes as values — the same
+    silent-corruption class KVQuantMismatchError guards at the import
+    boundary, caught at the reference gather and the kernel wrappers."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.generation import decode_attention as da
+    from paddle_tpu.ops.pallas import paged_attention as pk
+
+    q = jnp.zeros((1, H, D), jnp.float32)
+    pool = jnp.zeros((4, PS, H, D), jnp.int8)
+    fpool = jnp.zeros((4, PS, H, D), jnp.float32)
+    sc = jnp.ones((4, H), jnp.float32)
+    pt = jnp.zeros((1, 2), jnp.int32)
+    lens = jnp.ones((1,), jnp.int32)
+    with pytest.raises(ValueError, match="scale"):
+        da.paged_decode_attention_reference(q, pool, pool, pt, lens)
+    with pytest.raises(ValueError, match="scale"):
+        pk.paged_decode_attention_kernel(q, pool, pool, pt, lens,
+                                         scale=1.0, interpret=True)
+    # the adjacent misuses fail just as loudly: half-threaded scales,
+    # and scales alongside a non-int8 pool (silent scale/127 corruption)
+    with pytest.raises(ValueError, match="together"):
+        pk.paged_decode_attention_kernel(q, pool, pool, pt, lens,
+                                         scale=1.0, interpret=True,
+                                         k_scale=sc)
+    with pytest.raises(ValueError, match="int8 pools only"):
+        pk.paged_decode_attention_kernel(q, fpool, fpool, pt, lens,
+                                         scale=1.0, interpret=True,
+                                         k_scale=sc, v_scale=sc)
+    with pytest.raises(ValueError, match="int8 pools only"):
+        da.paged_decode_attention_reference(q, fpool, fpool, pt, lens,
+                                            k_scale=sc, v_scale=sc)

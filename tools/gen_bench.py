@@ -114,15 +114,48 @@ def _prewarm_decode_buckets(eng, batch, context, new_tokens, page_size):
     return time.perf_counter() - t0
 
 
+def _pool_byte_facts(model, num_pages, page_size, context, new_tokens,
+                     kv_dtype):
+    """Pool-capacity arithmetic for the kv-quant A/B: bytes per page at
+    this dtype (scales included for int8), and the resident-sequence
+    capacity a FIXED byte budget (the bf16 pool at this page count)
+    buys — the "~2x resident sequences per pool byte" headline."""
+    import numpy as np
+
+    ll, h, d = model.num_layers, model.num_heads, model.head_dim
+
+    def page_bytes(dt):
+        b = 2 * ll * page_size * h * d * np.dtype(dt).itemsize
+        if np.dtype(dt) == np.dtype(np.int8):
+            b += 2 * ll * h * 4            # [P, H] f32 scales per pool
+        return b
+
+    budget = page_bytes("bfloat16") * num_pages
+    pages_at_budget = budget // page_bytes(kv_dtype)
+    pages_per_seq = -(-(context + new_tokens) // page_size)
+    return {
+        "kv_page_bytes": int(page_bytes(kv_dtype)),
+        "kv_pool_bytes": int(page_bytes(kv_dtype) * num_pages),
+        "pool_byte_budget": int(budget),
+        "pages_at_fixed_budget": int(pages_at_budget),
+        "resident_seqs_at_fixed_budget": int(pages_at_budget
+                                             // pages_per_seq),
+    }
+
+
 def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
                pool, decode, prefill="full", chunk_tokens=0, tp=1,
-               step="legacy", use_kernel=None):
+               step="legacy", use_kernel=None, kv_dtype=None,
+               quant_collectives=False):
     from paddle_tpu import generation as g
     from paddle_tpu.generation import metrics as gmetrics
     from paddle_tpu.parallel import tp_mesh
     from paddle_tpu.profiler.monitor import StatRegistry
 
     mesh = tp_mesh(tp) if tp > 1 else None
+    kv_kwargs = {}
+    if kv_dtype is not None:
+        kv_kwargs["kv_dtype"] = kv_dtype
     eng = g.GenerationEngine(
         model,
         g.GenerationConfig(max_decode_slots=batch, num_pages=num_pages,
@@ -136,9 +169,11 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
                            # one mixed-batch executable per pages bucket
                            decode=(None if step == "ragged" else decode),
                            step_mode=step,
+                           quantized_collectives=quant_collectives,
                            prefill_chunk_tokens=(chunk_tokens
                                                  if prefill == "chunked"
-                                                 else 0)),
+                                                 else 0),
+                           **kv_kwargs),
         start=False)
     rng = np.random.default_rng(batch * 1000 + context)
     prompts = [rng.integers(0, model.vocab_size, context).tolist()
@@ -180,10 +215,13 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
     steps = int(steps_stat.get() - steps_before)
     kv_bytes = int(kv_stat.get() - kv_before)
     # prefill writes (incl. preemption re-prefills) are exactly the
-    # prefill token count x K+V payload; subtracting them leaves the
-    # decode-side traffic the O(pool)-vs-O(tokens) A/B is about
+    # prefill token count x K+V payload at the POOL itemsize (the cache
+    # counts writes at storage precision — int8 cells write 1-byte
+    # payloads); subtracting them leaves the decode-side traffic the
+    # O(pool)-vs-O(tokens) A/B is about
     prefill_bytes = (int(pf_stat.get() - pf_before) * 2 * model.num_layers
-                     * model.num_heads * model.head_dim * 4)
+                     * model.num_heads * model.head_dim
+                     * np.dtype(kv_dtype or np.float32).itemsize)
     snap = eng.metrics.snapshot()
     eng.shutdown()
     return {
@@ -213,6 +251,21 @@ def bench_cell(model, batch, context, new_tokens, num_pages, page_size,
         # silent-fallback tripwire (a mesh cell reporting jnp-reference
         # when pallas was requested is a bug, not a detail)
         "kernel_path": snap.get("generation.kernel_path", ""),
+        # precision facts: the pool storage dtype this cell measured,
+        # the split-out scale traffic (int8: scales are bytes in flight
+        # too, already folded into kv_bytes_moved), and whether the
+        # EQuARX-style quantized ring actually carried the allreduces
+        # (a silent fp32 fallback is a stats fact, like kernel_path)
+        "kv_quant_dtype": snap.get("generation.kv_quant_dtype", ""),
+        "kv_scale_bytes": snap.get("generation.kv_scale_bytes", 0),
+        "collective_quantized": snap.get(
+            "generation.collective_quantized", 0),
+        # fixed-pool-byte capacity arithmetic (the int8 headline:
+        # ~2x resident sequences vs bf16 at the same byte budget)
+        **_pool_byte_facts(model, num_pages, page_size, context,
+                           new_tokens,
+                           kv_dtype if kv_dtype is not None
+                           else "float32"),
         # the query-tiling FLOP proxy (ragged KERNEL cells; 0 when the
         # jnp reference dispatched — the /ref-vs-/kernel tripwire):
         # score blocks the tiled kernel computed vs the untiled bill,
@@ -808,6 +861,26 @@ def main():
                          "cell; every sharded combo runs TWICE — jnp "
                          "reference vs the shard_map'd Pallas kernel "
                          "(the kernel-vs-reference A/B under the mesh)")
+    ap.add_argument("--kv-quant", choices=("off", "bf16", "int8", "both"),
+                    default="off",
+                    help="KV storage precision A/B on device pools: "
+                         "bf16 vs INT8 pools (per-page per-head abs-max "
+                         "scales, in-kernel dequant) — per-cell "
+                         "tokens/s, kv_bytes_moved (+ split-out "
+                         "kv_scale_bytes), and resident-sequence "
+                         "capacity at a FIXED pool byte budget "
+                         "(resident_seqs_at_fixed_budget: int8 ~2x "
+                         "bf16).  'both' runs the pair; int8 also "
+                         "emits a kv_quality cell (max-logit drift + "
+                         "greedy-token agreement vs the fp32 oracle — "
+                         "the quality gate the lossy path ships under)")
+    ap.add_argument("--quant-collectives", action="store_true",
+                    help="EQuARX-style quantized-allreduce A/B: every "
+                         "SHARDED (tp > 1) combo runs an extra cell "
+                         "with quantized_collectives=True — same grid, "
+                         "collective_bytes_per_step ~4x lower, "
+                         "collective_quantized=1 stamped — paired "
+                         "against its fp32-collective sibling")
     ap.add_argument("--long-context", type=int, default=None,
                     help="long-prompt length for the interleave cell "
                          "(default: 8x the largest --contexts entry)")
@@ -903,13 +976,17 @@ def main():
     grid = []
     stats_by_series = {}
     reg = StatRegistry.instance()
+
+    def reset_gen_stats():
+        for name in list(reg.stats()):
+            if name.startswith("generation."):
+                reg.get_stat(name).reset()
+
     for pool, decode, prefill, tp, step, kern in combos:
         # per-series snapshot: reset generation.* so each
         # (pool, decode, prefill, tp, step, kernel) combo's stats land
         # apart
-        for name in list(reg.stats()):
-            if name.startswith("generation."):
-                reg.get_stat(name).reset()
+        reset_gen_stats()
         for b in batches:
             for ctx in contexts:
                 # pool sized to fit the cell w/o preemption noise
@@ -943,6 +1020,67 @@ def main():
             "" if kern is None else
             ("/kernel" if kern else "/ref"))
         stats_by_series[series] = reg.stats_snapshot("generation.")
+
+    if args.kv_quant != "off":
+        # KV precision A/B on device pools (fused decode — the
+        # CPU-forced fast path, so the bytes numbers are the device
+        # story): bf16 vs int8 cells at the SAME page count; the
+        # capacity headline is the per-cell
+        # resident_seqs_at_fixed_budget arithmetic
+        kv_menu = {"bf16": ("bfloat16",), "int8": ("int8",),
+                   "both": ("bfloat16", "int8")}[args.kv_quant]
+        for dt in kv_menu:
+            reset_gen_stats()
+            for b in batches:
+                for ctx in contexts:
+                    pages = ((ctx + args.new_tokens)
+                             // args.page_size + 2) * b
+                    grid.append(bench_cell(
+                        model, b, ctx, args.new_tokens, pages,
+                        args.page_size, "device", "fused", "full",
+                        args.chunk_tokens, kv_dtype=dt))
+            stats_by_series[f"device/fused/kvq-{dt}"] = \
+                reg.stats_snapshot("generation.")
+        if "int8" in kv_menu:
+            # the quality gate as a bench artifact: drift + agreement
+            # vs the fp32 oracle on the seeded workload — the contract
+            # the lossy cells ship under travels WITH their numbers
+            from paddle_tpu.generation.quality import kv_quality_report
+
+            ctx0 = min(contexts)
+            pages = ((ctx0 + args.new_tokens)
+                     // args.page_size + 2) * max(batches)
+            mk = lambda **kw: g.GenerationConfig(  # noqa: E731
+                max_decode_slots=max(batches), num_pages=pages,
+                page_size=args.page_size, kv_backend="device", **kw)
+            grid.append({
+                "cell": "kv_quality",
+                "kv_quant_dtype": "int8",
+                **kv_quality_report(model, mk(), mk(kv_dtype="int8"),
+                                    max_new_tokens=args.new_tokens),
+            })
+    if args.quant_collectives:
+        # the quantized-allreduce A/B: every sharded degree reruns the
+        # grid with quantized_collectives=True — pair each /qcol cell
+        # with its fp32-collective sibling from the main grid and read
+        # collective_bytes_per_step (~4x lower) + tokens/s
+        q_step = "ragged" if args.step in ("ragged", "both") else "legacy"
+        q_decode = "ragged" if q_step == "ragged" else "fused"
+        for tp in [t for t in tps if t > 1]:
+            reset_gen_stats()
+            for b in batches:
+                for ctx in contexts:
+                    pages = ((ctx + args.new_tokens)
+                             // args.page_size + 2) * b
+                    grid.append(bench_cell(
+                        model, b, ctx, args.new_tokens, pages,
+                        args.page_size, "device", q_decode, "full",
+                        args.chunk_tokens, tp=tp, step=q_step,
+                        use_kernel=True, quant_collectives=True,
+                        kv_dtype=("int8" if args.kv_quant
+                                  in ("int8", "both") else None)))
+            stats_by_series[f"device/{q_decode}/tp{tp}/qcol"] = \
+                reg.stats_snapshot("generation.")
     if args.prefix != "off":
         # the shared-system-prompt A/B: chunked prefill (warm hits
         # resume mid-prompt through the chunk loop), one cell per
@@ -952,9 +1090,7 @@ def main():
         sys_tokens = max(contexts) * 2
         for pool in pools:
             for mode in modes:
-                for name in list(reg.stats()):
-                    if name.startswith("generation."):
-                        reg.get_stat(name).reset()
+                reset_gen_stats()
                 grid.append(bench_prefix(
                     model, args.prefix_users, sys_tokens, 8,
                     args.new_tokens, args.page_size, pool,
