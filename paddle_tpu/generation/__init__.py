@@ -45,6 +45,7 @@ from .model import TinyCausalLM
 from .sampling import SamplingParams, sample_token, sample_tokens_batch
 from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
                         SequenceState)
+from .speculation import NgramProposer, verify_accept
 
 __all__ = [
     "GenerationEngine", "GenerationConfig", "GenerationHandle",
@@ -58,5 +59,5 @@ __all__ = [
     "decode_batch_menu",
     "chunk_prefill_attention", "chunk_prefill_attention_reference",
     "ragged_paged_attention", "ragged_paged_attention_reference",
-    "DEFAULT_PREFILL_CHUNK_TOKENS",
+    "DEFAULT_PREFILL_CHUNK_TOKENS", "NgramProposer", "verify_accept",
 ]

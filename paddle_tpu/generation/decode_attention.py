@@ -147,9 +147,13 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, page_tables,
                                      layout="token", k_scale=None,
                                      v_scale=None):
     """Pure-jnp RAGGED paged attention: one mixed batch of variable-
-    length query runs — decode rows (1 query) and prefill chunks (many)
-    — packed into ONE token axis, attending through per-sequence page
-    tables (the Ragged Paged Attention serving model, PAPERS.md).
+    length query runs — decode rows (1 query), prefill chunks (many),
+    and SPECULATIVE verify runs (a decode row with len = 1 + k: its
+    committed token plus k drafts, verified with the same per-row
+    causal masking and no new signature — the primitive speculation
+    rides, docs/GENERATION.md "Speculative decoding") — packed into
+    ONE token axis, attending through per-sequence page tables (the
+    Ragged Paged Attention serving model, PAPERS.md).
 
     q: [T, H, D] — the packed query rows of every sequence in the step,
         sequence s owning rows ``[starts[s], starts[s] + lens[s])``.

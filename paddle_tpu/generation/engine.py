@@ -201,6 +201,37 @@ class GenerationConfig:
         longer mutually exclusive.
     tp_axis: the mesh axis name to shard heads over; None = the mesh's
         first axis.  Only meaningful with `mesh`.
+    spec_mode: SPECULATIVE DECODING through the ragged step — "ngram"
+        runs the model-free prompt-lookup proposer
+        (generation/speculation.py): per greedy decode row, the
+        sequence's current n-gram suffix is matched against its own
+        history (prompt + generated tail) and up to `spec_tokens`
+        draft continuations pack into the row's ragged descriptor as
+        ``[start, len = 1 + k, kv_len]`` — the pages bucket stays the
+        ONLY executable axis, so the compile menu is unchanged.  The
+        trace's accept/reject epilogue verifies every draft on device
+        (per-position argmax vs the shifted draft ids) and the host
+        fetches accepted counts + the bonus token in the step's single
+        sync: an accepting row retires accepted + 1 tokens from ONE
+        dispatch.  Rejected drafts rewind through
+        ``PagedKVCache.truncate``.  Greedy speculative decode is
+        TOKEN-IDENTICAL to non-speculative decode — by construction
+        for float pools (the ragged attention's masked-softmax makes
+        a verify row's logits a pure function of its position and
+        visible bytes); int8 pools add one scale-pregrow caveat
+        bounded by the PR 12 quality gate and pinned strict on the
+        reference-model matrix (docs/GENERATION.md "Speculative
+        decoding").  Non-greedy rows,
+        mid-prefill rows, and proposer misses decode exactly as today
+        in the same batch.  "off" / None disables (the tier-1 CPU
+        oracle default).  Requires the ragged step (speculation rides
+        its packed token axis); spec_mode="ngram" with step_mode unset
+        resolves step_mode to "ragged".
+    spec_tokens: draft cap per speculating row (default 4).  A static
+        trace constant — it shapes a [S, k] verify intermediate, never
+        a new executable signature — and the auto step_token_budget
+        grows by max_decode_slots * spec_tokens so a fully speculating
+        batch still leaves the prefill chunk its room.
     prefix_cache: PREFIX CACHING — refcounted copy-on-write page
         sharing across sequences (docs/GENERATION.md "Prefix
         caching").  Full pages of every completed prompt are indexed
@@ -231,7 +262,8 @@ class GenerationConfig:
                  prefill_chunk_tokens=None, step_token_budget=None,
                  mesh=None, tp_axis=None, prefix_cache=None,
                  step_mode=None, prefill_pack=True,
-                 quantized_collectives=False):
+                 quantized_collectives=False, spec_mode=None,
+                 spec_tokens=4):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -308,6 +340,25 @@ class GenerationConfig:
                 "(one mixed-batch executable serves decode AND prefill "
                 f"chunks); decode={decode!r} makes no sense with it")
         self.step_mode = step_mode
+        if spec_mode not in (None, "off", "ngram"):
+            raise ValueError(
+                f"spec_mode must be 'ngram', 'off' or None, got "
+                f"{spec_mode!r}")
+        self.spec_mode = spec_mode or "off"
+        self.spec_tokens = int(spec_tokens)
+        # only meaningful (and only validated) with speculation on: a
+        # templated config carrying spec_tokens=0 alongside an unset
+        # spec_mode naturally means "disabled", not an error
+        if self.spec_mode == "ngram" and self.spec_tokens < 1:
+            raise ValueError(
+                f"spec_tokens must be >= 1 with spec_mode='ngram', "
+                f"got {spec_tokens}")
+        if self.spec_mode == "ngram" and step_mode == "legacy":
+            raise ValueError(
+                "spec_mode='ngram' rides the ragged step's packed "
+                "token axis (a speculating row is a [start, 1+k, "
+                "kv_len] descriptor); step_mode='legacy' has no such "
+                "axis")
         # multi-prompt chunk packing (plan_pack): True fills each step's
         # leftover token room with MORE prompts' chunks (the RPA packing
         # rule — the default); False restores one chunk per step (the
@@ -497,15 +548,25 @@ class GenerationEngine:
         ragged_capable = (backend == "device"
                          and hasattr(model, "ragged_step_fn")
                          and hasattr(model, "decode_params"))
+        spec_on = self.config.spec_mode == "ngram"
         step_mode = self.config.step_mode
         if step_mode is None:
-            step_mode = "ragged" if (on_tpu and ragged_capable) else \
-                "legacy"
+            # spec_mode="ngram" is an explicit opt-out of the eager
+            # oracle anyway: asking for it resolves the auto step mode
+            # to ragged wherever the model supports it (CPU included)
+            step_mode = "ragged" if ((on_tpu or spec_on)
+                                     and ragged_capable) else "legacy"
         if step_mode == "ragged" and not ragged_capable:
             raise ValueError(
                 "step_mode='ragged' needs kv_backend='device' and a "
                 "model implementing ragged_step_fn/decode_params "
                 f"(backend={backend!r}, model={type(model).__name__})")
+        if spec_on and step_mode != "ragged":
+            raise ValueError(
+                "spec_mode='ngram' rides the ragged step's packed "
+                "token axis; this engine resolved to step_mode="
+                f"{step_mode!r} (kv_backend={backend!r}, model="
+                f"{type(model).__name__})")
         self.step_mode = step_mode
         decode = self.config.decode
         if step_mode == "ragged":
@@ -623,11 +684,19 @@ class GenerationEngine:
         self.prefix_cache_enabled = bool(prefix)
         self.scheduler.prefix_cache = self.prefix_cache_enabled
         slots = self.config.max_decode_slots
+        # speculation sizes the auto packed axis for a fully drafting
+        # batch — decode rows carry 1 + spec_tokens rows each — while
+        # the prefill chunk keeps its own room; an explicit budget
+        # instead CLIPS drafts at plan time (speculation is a pure
+        # optimization, it never squeezes a decode or chunk row out)
+        self.spec_tokens = self.config.spec_tokens if spec_on else 0
+        spec_room = slots * self.spec_tokens
         self.step_token_budget = (
             self.config.step_token_budget
             if self.config.step_token_budget is not None
-            else (chunk + slots if chunk
-                  else (slots if step_mode == "ragged" else None)))
+            else (chunk + slots + spec_room if chunk
+                  else (slots + spec_room if step_mode == "ragged"
+                        else None)))
         if step_mode == "ragged":
             # the budget IS the ragged executable's packed token axis:
             # it must hold the full decode batch, plus at least one
@@ -648,7 +717,16 @@ class GenerationEngine:
                 max_tokens=self.step_token_budget,
                 max_seqs=slots + 1, use_kernel=self._use_kernel,
                 mesh=mesh, tp_axis=tp_axis,
-                quant_collectives=self._quant_collectives)
+                quant_collectives=self._quant_collectives,
+                spec_tokens=self.spec_tokens)
+        # the prompt-lookup proposer (None = speculation off): host-
+        # side, model-free, consulted once per greedy decode row per
+        # step by scheduler.plan_spec
+        self._spec = None
+        if spec_on:
+            from .speculation import NgramProposer
+
+            self._spec = NgramProposer()
         self.metrics.set_mesh_devices(self.tp_degree)
         # which attention implementation this engine's step mode
         # dispatches — "pallas" or "jnp-reference", prefixed with the
@@ -661,6 +739,10 @@ class GenerationEngine:
         # carries the allreduces (a requested-but-inert flag reads 0)
         self.metrics.set_kv_quant_dtype(str(self.cache.dtype))
         self.metrics.set_collective_quantized(self._quant_collectives)
+        # the spec_mode build stamp (kernel_path pattern): engine
+        # construction refuses unsupported spec combos, so the stamp
+        # is the truth — "off" in a snapshot MEANS non-speculative
+        self.metrics.set_spec_mode(self.config.spec_mode)
         self._lock = threading.Lock()  # one stepper at a time
         self._closed = False
         self._stop = threading.Event()
@@ -1159,20 +1241,35 @@ class GenerationEngine:
             # only decode rides the ragged dispatch
             self._prefill_admitted(admitted)
         self._reap_deadlines()
-        pack = []  # [(state, n, start)] — reserved, still-alive chunks
+        # plan the prefill-chunk pack FIRST (exactly the room the
+        # spec-off engine would give it), THEN let drafts fill the
+        # genuine leftover: drafts are an optimization, and a prompt's
+        # TTFT is not theirs to spend — under a tight explicit budget
+        # the chunk keeps its full pre-speculation share and the
+        # drafts get the scraps, never the other way around.  Rows
+        # preempted below simply leave their drafts unused.
+        planned = []
         if self.prefill_chunk_tokens:
-            room = self.step_token_budget - \
-                len(self.scheduler.decode_ready())
+            room = (self.step_token_budget
+                    - len(self.scheduler.decode_ready()))
             planned = self.scheduler.plan_pack(
                 self.prefill_chunk_tokens, room=room,
                 max_seqs=(self._ragged.max_seqs
                           if self.config.prefill_pack else 1))
-            for state, n in planned:
-                if state.slot is None or not state.prefilling:
-                    continue  # preempted by an earlier pack reservation
-                start = self._reserve_chunk(state, n)
-                if start is not None:
-                    pack.append((state, n, start))
+        spec_plan = {}
+        if self._spec is not None:
+            spec_plan = self.scheduler.plan_spec(
+                self._spec, self.spec_tokens,
+                room=(self.step_token_budget
+                      - len(self.scheduler.decode_ready())
+                      - sum(n for _, n in planned)))
+        pack = []  # [(state, n, start)] — reserved, still-alive chunks
+        for state, n in planned:
+            if state.slot is None or not state.prefilling:
+                continue  # preempted by an earlier pack reservation
+            start = self._reserve_chunk(state, n)
+            if start is not None:
+                pack.append((state, n, start))
         decoding = self.scheduler.decode_ready()
         if decoding:
             decoding = self._ensure_step_capacity()
@@ -1188,55 +1285,86 @@ class GenerationEngine:
             return 0
         with StepTimer() as timer:
             with RecordEvent("generation::ragged_step"):
-                advanced, sampled = self._dispatch_ragged(decoding, pack)
+                advanced, sampled = self._dispatch_ragged(
+                    decoding, pack, spec_plan)
         if sampled:
             self.metrics.observe_step(sampled, timer.seconds)
         self._drain_kv_bytes()
         self._observe_occupancy()
         return advanced
 
-    def _dispatch_ragged(self, decoding, pack):
-        """Pack, dispatch, sample: rows [0, B) are the decode batch
-        (slot order, one new token each), then each packed chunk's rows
-        consecutively; descriptor i covers decode sequence i (len 1),
-        descriptor B + j the pack's j-th chunk.  Returns ``(advanced,
-        sampled)``."""
+    def _dispatch_ragged(self, decoding, pack, spec_plan=None):
+        """Pack, dispatch, sample: the decode batch's spans first (slot
+        order — each sequence's committed token, followed by its draft
+        tokens when it speculates this step), then each packed chunk's
+        rows consecutively; descriptor i covers decode sequence i
+        (len = 1 + drafts), descriptor B + j the pack's j-th chunk.
+        Returns ``(advanced, sampled)`` — `sampled` counts TOKENS
+        emitted (a speculating row retires accepted + 1 per step)."""
         b = len(decoding)
         seq_ids, d_tokens, positions = self._reserve_decode_rows(decoding)
-        tokens = list(d_tokens)
-        desc_ids = list(seq_ids)
+        # speculation: EXTEND a drafting row's reservation past its
+        # guaranteed decode token.  The capacity check only vouched for
+        # one token per row, so an extension that finds no page simply
+        # drops that row's drafts — speculation never preempts a
+        # sequence and never fails a request over pages
+        spec_rows = {}
+        if spec_plan:
+            for i, s in enumerate(decoding):
+                drafts = spec_plan.get(s.seq_id)
+                if not drafts:
+                    continue
+                try:
+                    self.cache.reserve(s.seq_id, len(drafts))
+                except OutOfPagesError:
+                    continue
+                if self.prefix_cache_enabled:
+                    # the draft span's COW guard, mirroring the decode
+                    # rows' in _reserve_decode_rows (reserve just
+                    # privatized any shared tail page)
+                    self.cache.check_span_writable(
+                        s.seq_id, int(positions[i]) + 1, len(drafts))
+                spec_rows[i] = drafts
+        tokens = []
+        desc_ids = []
+        spans = []     # descriptor j's (first position, row count)
+        for i, s in enumerate(decoding):
+            drafts = spec_rows.get(i, ())
+            tokens.append(int(d_tokens[i]))
+            tokens += drafts
+            spans.append((int(positions[i]), 1 + len(drafts)))
+            desc_ids.append(s.seq_id)
         for state, n, start in pack:
             # COW-safe donation chain for each chunk span, mirroring the
             # decode rows' guard in _reserve_decode_rows
             self.cache.check_span_writable(state.seq_id, start, n)
             tokens += state.tokens[start:start + n]
+            spans.append((start, n))
             desc_ids.append(state.seq_id)
         # kv_lens straight off the cache: a decode row's length already
-        # includes its reserved token, each chunk's its whole span —
-        # and pt row i IS descriptor i's table, so the scatter targets
-        # below index it directly (one table walk per step, not two)
+        # includes its reserved token(s) — drafts included — each
+        # chunk's its whole span; and pt row j IS descriptor j's table,
+        # so the scatter targets below index it directly (one table
+        # walk per step, not two)
         pt, kv_lens = self.cache.gather_block_tables(desc_ids)
-        c_total = sum(n for _, n, _ in pack)
-        t_real = b + c_total
-        pos_all = np.zeros((t_real,), np.int32)
-        pages = np.empty((t_real,), np.int32)
-        rows = np.empty((t_real,), np.int32)
+        t_real = len(tokens)
         ps = self.cache.page_size
-        if b:
-            pos_all[:b] = positions
-            pages[:b] = pt[np.arange(b), positions // ps]
-            rows[:b] = positions % ps
-        starts = np.arange(len(desc_ids), dtype=np.int32)
-        lens = np.ones((len(desc_ids),), np.int32)
-        off = b
-        for j, (state, n, start) in enumerate(pack):
-            span = np.arange(start, start + n)
-            pos_all[off:off + n] = span
-            pages[off:off + n] = pt[b + j, span // ps]
-            rows[off:off + n] = span % ps
-            starts[b + j] = off
-            lens[b + j] = n
-            off += n
+        # one vectorized fill for EVERY span shape — len-1 decode rows,
+        # multi-row draft spans, chunk runs: descriptor j owns packed
+        # rows [starts[j], starts[j] + lens[j]) at positions
+        # span_pos0[j] + offset-within-span (O(1) numpy calls whatever
+        # the batch size — the spec-off hot path pays no python loop)
+        lens = np.asarray([n for _, n in spans], np.int32)
+        span_pos0 = np.asarray([start for start, _ in spans], np.int32)
+        starts = np.zeros((len(spans),), np.int32)
+        np.cumsum(lens[:-1], out=starts[1:])
+        pos_all = (np.repeat(span_pos0, lens)
+                   + np.arange(t_real, dtype=np.int32)
+                   - np.repeat(starts, lens)).astype(np.int32)
+        desc_of_row = np.repeat(np.arange(len(spans), dtype=np.int32),
+                                lens)
+        pages = pt[desc_of_row, pos_all // ps]
+        rows = pos_all % ps
         ids_dev, logits_dev = self._ragged.step(
             np.asarray(tokens, np.int32), pos_all, pages, rows, pt,
             starts, lens, kv_lens)
@@ -1258,20 +1386,26 @@ class GenerationEngine:
         # first-token logits).  A mid-prompt chunk-only step fetches
         # NOTHING — zero host syncs, exactly like the legacy
         # unmaterialized chunks.
-        samplers = list(decoding)
-        rows_idx = list(range(b))
-        for state, di in finishing:
-            samplers.append(state)
-            rows_idx.append(di)
-        syncs = 0
-        if samplers:
-            syncs = 1
-            if all(s.request.params.greedy for s in samplers):
-                ids_h = np.asarray(ids_dev)      # the single host sync
-                self._apply_tokens(samplers, ids_h[rows_idx])
-            else:
-                logits_h = np.asarray(logits_dev)
-                self._apply_logits_batch(samplers, logits_h[rows_idx])
+        if self._spec is not None:
+            sampled, syncs = self._apply_ragged_spec(
+                decoding, spec_rows, finishing, ids_dev, logits_dev)
+        else:
+            samplers = list(decoding)
+            rows_idx = list(range(b))
+            for state, di in finishing:
+                samplers.append(state)
+                rows_idx.append(di)
+            syncs = 0
+            if samplers:
+                syncs = 1
+                if all(s.request.params.greedy for s in samplers):
+                    ids_h = np.asarray(ids_dev)  # the single host sync
+                    self._apply_tokens(samplers, ids_h[rows_idx])
+                else:
+                    logits_h = np.asarray(logits_dev)
+                    self._apply_logits_batch(samplers,
+                                             logits_h[rows_idx])
+            sampled = len(samplers)
         self.metrics.observe_decode_step(self._ragged.last_dispatches,
                                          syncs)
         self.metrics.observe_collective_bytes(
@@ -1287,7 +1421,83 @@ class GenerationEngine:
         self.metrics.count_score_blocks(
             self._ragged.last_score_blocks,
             self._ragged.last_score_blocks_untiled)
-        return b + len(pack), len(samplers)
+        return b + len(pack), sampled
+
+    def _apply_ragged_spec(self, decoding, spec_rows, finishing,
+                           ints_dev, aug_dev):
+        """The speculative step's sampling half — still ONE host fetch:
+        the [S, 3] int block (last-row argmax, accepted count, bonus)
+        for an all-greedy step, the [S, V + 3] augmented logits when
+        any sampler is stochastic.  Then per descriptor exactly one of:
+        accepted drafts + bonus (speculating rows), the last-row argmax
+        (plain greedy rows and finishing greedy chunks), or batched
+        host sampling from the logits columns (stochastic rows).
+        Returns ``(tokens_emitted, syncs)``."""
+        b = len(decoding)
+        samplers = [(s, i) for i, s in enumerate(decoding)]
+        samplers += list(finishing)
+        if not samplers:
+            return 0, 0
+        vocab = int(self.model.vocab_size)
+        if all(s.request.params.greedy for s, _ in samplers):
+            ints = np.asarray(ints_dev)          # the single host sync
+            logits_h = None
+        else:
+            aug = np.asarray(aug_dev)            # the single host sync
+            logits_h = aug[:, :vocab]
+            # the appended int columns are exact in f32 (ids < vocab,
+            # accepted <= spec_tokens — both far under 2**24)
+            ints = aug[:, vocab:].astype(np.int64)
+        ids_col, acc_col, bonus_col = ints[:, 0], ints[:, 1], ints[:, 2]
+        emitted = 0
+        stoch = []   # (state, descriptor): one batched host sample
+        for s, di in samplers:
+            if not s.request.params.greedy:
+                stoch.append((s, di))
+                continue
+            drafts = spec_rows.get(di) if di < b else None
+            if drafts:
+                emitted += self._apply_spec_row(
+                    s, drafts, int(acc_col[di]), int(bonus_col[di]))
+            elif s.n_generated >= s.request.max_new_tokens:
+                self._finish(s, "length")
+            else:
+                self._apply_token(s, int(ids_col[di]))
+                emitted += 1
+        if stoch:
+            self._apply_logits_batch([s for s, _ in stoch],
+                                     logits_h[[di for _, di in stoch]])
+            emitted += len(stoch)
+        return emitted, 1
+
+    def _apply_spec_row(self, state, drafts, accepted, bonus):
+        """Retire one speculating row's verified tokens.  The cache is
+        truncated FIRST — the rejected draft tail leaves before any
+        token is streamed, so a stop/length finish inside the apply
+        loop (which frees the pages wholesale) can never race a
+        rewind, and a surviving row holds exactly len(tokens) - 1
+        resident positions, the decode invariant.  The accepted drafts
+        and the bonus token then stream one at a time through the
+        NORMAL per-token gate (_apply_token) — stop tokens, multi-
+        token stop sequences, and max_new_tokens clip the emission at
+        exactly the token the non-speculative engine would have
+        stopped at, so speculation can never stream past a stop.
+        Returns tokens emitted."""
+        accepted = max(0, min(int(accepted), len(drafts)))
+        rewound = len(drafts) - accepted
+        if rewound:
+            self.cache.truncate(
+                state.seq_id,
+                self.cache.seq_len(state.seq_id) - rewound)
+        self.metrics.count_spec(len(drafts), accepted, rewound)
+        emitted = 0
+        for tok in list(drafts[:accepted]) + [int(bonus)]:
+            if state.slot is None:
+                break   # a stop/length finish retired the row mid-run
+            before = state.n_generated
+            self._apply_token(state, int(tok))
+            emitted += state.n_generated - before
+        return emitted
 
     def run_until_idle(self, max_steps=100000):
         """Drive step() until queue+slots drain (tests/benchmarks)."""
@@ -1748,11 +1958,33 @@ class GenerationEngine:
         self._apply_token(state, token)
 
     def _apply_token(self, state, token):
-        """Stream one already-sampled token and retire on stop/length."""
+        """Stream one already-sampled token and retire on stop/length.
+
+        Stop conditions are checked BEFORE the token is appended or
+        streamed: single stop tokens as always, and multi-token
+        SamplingParams.stop_sequences by suffix-matching the generated
+        stream — a token that would COMPLETE a stop sequence is
+        clipped exactly like a single stop token (the sequence's
+        earlier tokens were necessarily already streamed; only the
+        completing one can be withheld).  Every engine path — eager,
+        fused, ragged, and the speculative accept loop — emits tokens
+        through this one gate, so speculation can never stream past a
+        stop the non-speculative oracle would have honored."""
         req = state.request
         if token in req.stop_tokens:
             self._finish(state, "stop")
             return
+        window = req.params.max_stop_len
+        if window:
+            gen_len = state.n_generated
+            take = min(gen_len, window - 1)
+            tail = (state.tokens[len(state.tokens) - take:] if take
+                    else []) + [token]
+            for seq in req.params.stop_sequences:
+                if len(tail) >= len(seq) \
+                        and tuple(tail[len(tail) - len(seq):]) == seq:
+                    self._finish(state, "stop")
+                    return
         state.tokens.append(token)
         state.n_generated += 1
         state.handle._push_token(token)

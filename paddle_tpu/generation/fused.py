@@ -506,7 +506,7 @@ class RaggedStep:
 
     def __init__(self, model, cache, metrics, max_tokens, max_seqs,
                  use_kernel=False, mesh=None, tp_axis=None,
-                 quant_collectives=False):
+                 quant_collectives=False, spec_tokens=0):
         import jax
 
         self._jax = jax
@@ -514,6 +514,12 @@ class RaggedStep:
         self._num_layers = int(cache.num_layers)
         self.max_tokens = int(max_tokens)
         self.max_seqs = int(max_seqs)
+        # speculative decoding: > 0 compiles the accept/reject epilogue
+        # into the ONE executable (model.ragged_step_fn spec_tokens) —
+        # the outputs become (ints [S, 3], logits_aug [S, V + 3]); the
+        # signature axis stays the pages bucket alone, so the compile
+        # menu is EXACTLY the non-speculative step's
+        self.spec_tokens = int(spec_tokens)
         if self.max_tokens < 1 or self.max_seqs < 1:
             raise ValueError("max_tokens and max_seqs must be >= 1")
         self._mesh = mesh
@@ -535,6 +541,10 @@ class RaggedStep:
             step_kw["kv_quant"] = True
         if self._quant_collectives:
             step_kw["quant_collectives"] = True
+        if self.spec_tokens:
+            # only spec-aware models see the kwarg: the plain ragged
+            # protocol keeps working unchanged for models without it
+            step_kw["spec_tokens"] = self.spec_tokens
         fn = model.ragged_step_fn(
             cache.page_size, cache.num_pages, use_kernel=use_kernel,
             pool_layout=cache.pool_layout, **step_kw)
@@ -605,8 +615,10 @@ class RaggedStep:
         (sentinel page, position 0), the descriptor axis to `max_seqs`
         with len-0 descriptors, and the page-table axis to its pages
         bucket — then runs the ONE donated dispatch.  Returns
-        ``(ids [S], logits [S, V])`` UNMATERIALIZED: the caller fetches
-        at most one of them (its single host sync)."""
+        ``(ids [S], logits [S, V])`` UNMATERIALIZED — or, with
+        spec_tokens, ``(ints [S, 3], logits_aug [S, V + 3])`` carrying
+        the accept/bonus columns (model.ragged_step_fn) — the caller
+        fetches at most one of them (its single host sync)."""
         t_real = len(tokens)
         s_real = len(starts)
         if t_real > self.max_tokens:

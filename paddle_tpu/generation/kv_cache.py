@@ -325,6 +325,74 @@ class PagedKVCache:
         self._lens[seq_id] = start + new_tokens
         return start
 
+    def truncate(self, seq_id, new_len):
+        """REWIND `seq_id` to exactly `new_len` resident tokens — the
+        speculative-decoding rejection primitive (engine._apply_spec:
+        rejected draft tokens leave the cache through here), usable by
+        any caller that over-reserved.  Whole tail pages past the new
+        length return to the allocator (host bookkeeping only; the
+        device side needs no dispatch — a dropped page's bytes are
+        unreachable once no table maps it, and page reuse re-grounds
+        them through the normal donation-chain writes).  Rows of the
+        retained tail page past `new_len` become stale: they are
+        masked out of every attention read (kv_len gates visibility)
+        and fully overwritten when their position is next reserved, so
+        they can never influence a value.
+
+        Typed and loud, all-or-nothing:
+
+        - UnknownSequenceError for a never-allocated or freed seq_id;
+        - ValueError on GROWTH (``new_len > seq_len``) — growing goes
+          through reserve, which owns capacity/COW/eviction;
+        - ValueError when the rewind would touch an adopted/shared
+          prefix run: a dropped page that other sequences or the
+          prefix index still alias, or a clip landing MID-PAGE inside
+          a shared page.  Rewinding into shared content would hand
+          this sequence future writes over bytes other readers alias —
+          the engine only ever rewinds spans it just privately
+          reserved, so this firing means a caller bug.
+
+        Quantized pools: released pages get their scale rows
+        requantize-RESET immediately (the same zeroing page reuse
+        performs, done eagerly so a freed page's grid state never
+        outlives its content); the retained tail page keeps its grid —
+        its scale is an abs-max over a superset of the live rows,
+        which dequantizes them exactly as before the rewind.
+
+        Returns the number of pages freed."""
+        table = self._table(seq_id)
+        new_len = int(new_len)
+        cur = self._lens[seq_id]
+        if new_len < 0 or new_len > cur:
+            raise ValueError(
+                f"truncate({seq_id!r}) to {new_len} tokens, but "
+                f"{cur} are resident — truncate only rewinds (growth "
+                f"goes through reserve)")
+        if new_len == cur:
+            return 0
+        keep = math.ceil(new_len / self.page_size)
+        dropped = table[keep:]
+        for page in dropped:
+            if self._page_shared(page):
+                raise ValueError(
+                    f"truncate({seq_id!r}) to {new_len} would release "
+                    f"shared page {page} (aliased or prefix-indexed) — "
+                    f"rewinding into an adopted/shared prefix run is "
+                    f"not supported")
+        if new_len % self.page_size and self._page_shared(
+                table[keep - 1]):
+            raise ValueError(
+                f"truncate({seq_id!r}) to {new_len} lands mid-page in "
+                f"shared page {table[keep - 1]} — rewinding into an "
+                f"adopted/shared prefix run is not supported")
+        del table[keep:]
+        self._lens[seq_id] = new_len
+        for page in reversed(dropped):   # reversed: LIFO warm reuse
+            self._decref(page)
+            if self.quantized:
+                self._reset_page_scale(page)
+        return len(dropped)
+
     # ------------------------ prefix caching ------------------------
     def _tick(self):
         self._clock += 1

@@ -154,6 +154,33 @@ Metric names:
                                       reads 0, so a silent fp32
                                       fallback is a stats fact
                                       (mirrors kernel_path)
+- ``generation.spec_mode``            gauge (string): the speculative-
+                                      decoding proposer the engine
+                                      runs ("off" / "ngram"), stamped
+                                      at engine build like kernel_path
+                                      — a silent fallback to
+                                      non-speculative decode is a
+                                      stats fact, never an inference
+                                      from rates
+- ``generation.spec_proposed_tokens``  draft tokens the proposer packed
+                                      into ragged verify rows
+- ``generation.spec_accepted_tokens``  drafts the on-device accept
+                                      epilogue verified (each one a
+                                      token retired WITHOUT its own
+                                      dispatch)
+- ``generation.spec_acceptance_rate``  gauge: cumulative accepted /
+                                      proposed (0..1)
+- ``generation.spec_rewind_tokens``   rejected drafts rewound out of
+                                      the KV cache (truncate) — the
+                                      wasted-work counter the
+                                      overhead-bound gen_bench cell
+                                      watches
+- ``generation.spec_draft_rows``      speculative VERIFY rows
+                                      dispatched (one per drafting
+                                      sequence per step) — the
+                                      denominator of the true mean
+                                      accepted length,
+                                      accepted / draft_rows
 - ``generation.mesh_devices``         gauge: tensor-parallel degree of
                                       the engine's mesh (1 unsharded)
 - ``generation.collective_bytes_per_step``  gauge: estimated on-wire
@@ -202,6 +229,12 @@ PAGE_UTILIZATION_PCT = PREFIX + "page_utilization_pct"
 KERNEL_PATH = PREFIX + "kernel_path"
 STEP_SCORE_BLOCKS = PREFIX + "step_score_blocks"
 STEP_SCORE_BLOCKS_UNTILED = PREFIX + "step_score_blocks_untiled"
+SPEC_MODE = PREFIX + "spec_mode"
+SPEC_PROPOSED_TOKENS = PREFIX + "spec_proposed_tokens"
+SPEC_ACCEPTED_TOKENS = PREFIX + "spec_accepted_tokens"
+SPEC_ACCEPTANCE_RATE = PREFIX + "spec_acceptance_rate"
+SPEC_REWIND_TOKENS = PREFIX + "spec_rewind_tokens"
+SPEC_DRAFT_ROWS = PREFIX + "spec_draft_rows"
 MESH_DEVICES = PREFIX + "mesh_devices"
 COLLECTIVE_BYTES_PER_STEP = PREFIX + "collective_bytes_per_step"
 KV_QUANT_DTYPE = PREFIX + "kv_quant_dtype"
@@ -228,6 +261,10 @@ class GenerationMetrics:
         # this engine's cumulative warm fraction, not a fleet mix)
         self._prefix_hit_cum = 0
         self._prefix_lookup_cum = 0
+        # speculative-decoding acceptance accumulators (per-engine,
+        # like the prefix hit rate)
+        self._spec_proposed_cum = 0
+        self._spec_accepted_cum = 0
 
     def _stat(self, name):
         return self._reg.get_stat(name)
@@ -384,6 +421,38 @@ class GenerationMetrics:
         engine build like kernel_path, so an fp32 fallback is visible
         in every snapshot."""
         self._stat(COLLECTIVE_QUANTIZED).set(1 if active else 0)
+
+    def set_spec_mode(self, mode):
+        """Gauge (string): the speculative-decoding proposer this
+        engine dispatches ("off" / "ngram"), stamped once at engine
+        build — the kernel_path pattern.  Touches every spec counter
+        too, so the schema is complete from the first snapshot:
+        spec_acceptance_rate == 0 is a statement, not a gap."""
+        self._stat(SPEC_MODE).set(str(mode))
+        self._stat(SPEC_PROPOSED_TOKENS)
+        self._stat(SPEC_ACCEPTED_TOKENS)
+        self._stat(SPEC_REWIND_TOKENS)
+        self._stat(SPEC_DRAFT_ROWS)
+        self._stat(SPEC_ACCEPTANCE_RATE).set(0.0)
+
+    def count_spec(self, proposed, accepted, rewound):
+        """One speculative row's verify outcome: `proposed` drafts
+        packed, `accepted` verified, `rewound` truncated back out of
+        the cache.  Maintains the cumulative acceptance-rate gauge and
+        the draft-row count (the mean-accepted-length denominator)."""
+        if proposed:
+            self._stat(SPEC_PROPOSED_TOKENS).increase(int(proposed))
+            self._stat(SPEC_DRAFT_ROWS).increase()
+        if accepted:
+            self._stat(SPEC_ACCEPTED_TOKENS).increase(int(accepted))
+        if rewound:
+            self._stat(SPEC_REWIND_TOKENS).increase(int(rewound))
+        self._spec_proposed_cum += int(proposed)
+        self._spec_accepted_cum += int(accepted)
+        if self._spec_proposed_cum:
+            self._stat(SPEC_ACCEPTANCE_RATE).set(
+                round(self._spec_accepted_cum / self._spec_proposed_cum,
+                      3))
 
     def set_mesh_devices(self, n):
         """Gauge: the engine's tensor-parallel degree (mesh axis size;

@@ -538,7 +538,8 @@ class TinyCausalLM:
     # -------------------------- ragged step ---------------------------
     def ragged_step_fn(self, page_size, num_pages, use_kernel=False,
                        pool_layout="token", mesh=None, tp_axis=None,
-                       kv_quant=False, quant_collectives=False):
+                       kv_quant=False, quant_collectives=False,
+                       spec_tokens=0):
         """Build the PURE mixed-batch RAGGED step function the engine's
         one-dispatch-per-step path jits (fused.RaggedStep)::
 
@@ -577,7 +578,34 @@ class TinyCausalLM:
         contract — scale arrays after the pools
         (``..., k_pools, v_pools, k_scales, v_scales``), quantized
         in-trace writes, in-kernel dequant; and the two row-sharded
-        matmuls through the quantized ring when asked."""
+        matmuls through the quantized ring when asked.
+
+        spec_tokens > 0 grows the SPECULATIVE accept/reject epilogue
+        (generation/speculation.py): a speculating greedy row packs as
+        an ordinary ``len = 1 + k`` descriptor (its committed token
+        followed by k draft tokens — the attention math is untouched;
+        a verify row IS a chunk-shaped row), and the epilogue gathers
+        each descriptor's rows start..start+k plus the S sample rows
+        BEFORE the head matmul (its head cost is O(S * k), never
+        O(T)), takes their per-row argmax, counts each descriptor's
+        accepted draft prefix (verify_accept: row start+j's argmax vs
+        the shifted draft id at row start+j+1), and takes the bonus
+        token at the first unaccepted row.  The
+        two unmaterialized outputs become
+
+            ints [S, 3] int32     — (last-row argmax id, accepted
+                                     count, bonus id): the all-greedy
+                                     single fetch
+            logits_aug [S, V + 3] — the last-row logits with the same
+                                     three columns appended as floats
+                                     (ids are exact in f32 far past
+                                     any practical vocab): the mixed-
+                                     batch single fetch
+
+        so the host still syncs at most ONE array per step whatever
+        the sampling mix.  spec_tokens shapes a [S, k] intermediate
+        only — the compile menu stays one executable per pages bucket,
+        exactly as without speculation."""
         from ..parallel.sharding_annotations import (constrain,
                                                      kv_pool_spec,
                                                      kv_scale_spec)
@@ -653,6 +681,46 @@ class TinyCausalLM:
             # descriptor owns (padding descriptors read row 0 — garbage
             # the engine never fetches a token from)
             sample_rows = jnp.clip(starts + lens - 1, 0, t - 1)
+            if spec_tokens:
+                from .speculation import verify_accept
+
+                # the verify epilogue needs argmax at each
+                # descriptor's rows start..start+k (row start+j
+                # predicts the token drafted at row start+j+1) plus
+                # the S sample-row logits — gather those S*(k+2) rows
+                # BEFORE the head matmul, so the epilogue's head cost
+                # is O(S * k), never O(T) (chunk rows past the window
+                # and inert padding can't be read by it anyway)
+                s_n = starts.shape[0]
+                kk = int(spec_tokens)
+                vrows = jnp.clip(
+                    starts[:, None]
+                    + jnp.arange(kk + 1, dtype=jnp.int32)[None, :],
+                    0, t - 1)                            # [S, k + 1]
+                gathered = jnp.concatenate(
+                    [x[vrows.reshape(-1)], x[sample_rows]], axis=0)
+                heads = (_layer_norm(gathered, params["ln_f_s"],
+                                     params["ln_f_b"])
+                         @ params["head"])
+                amax_rows = jnp.argmax(
+                    heads[:s_n * (kk + 1)],
+                    axis=-1).astype(jnp.int32).reshape(s_n, kk + 1)
+                logits = heads[s_n * (kk + 1):]          # [S, V]
+                ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                accepted, bonus = verify_accept(
+                    amax_rows, tokens, starts, lens, kk, np_mod=jnp)
+                ints = jnp.stack([ids, accepted, bonus],
+                                 axis=1)                     # [S, 3]
+                # one fetchable array per sampling mix: ints for the
+                # all-greedy step, logits with the int columns appended
+                # for a mixed batch — either way ONE host sync
+                aug = jnp.concatenate(
+                    [logits, ints.astype(logits.dtype)], axis=1)
+                ints = constrain(ints, mesh)
+                aug = constrain(aug, mesh)
+                if kv_quant:
+                    return (ints, aug), k_out, v_out, ks_out, vs_out
+                return (ints, aug), k_out, v_out
             xs = x[sample_rows]                              # [S, d]
             logits = (_layer_norm(xs, params["ln_f_s"],
                                   params["ln_f_b"]) @ params["head"])

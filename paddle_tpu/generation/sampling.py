@@ -20,11 +20,24 @@ class SamplingParams:
         probability >= top_p (nucleus; None/1.0 disables).
     seed: per-request RNG seed (None draws one from the global RNG —
         still recorded on the params so a run can be replayed).
+    stop_sequences: MULTI-TOKEN stop conditions — an iterable of token
+        id sequences.  The engine suffix-matches the GENERATED stream
+        at every sampled token: when appending a token would complete
+        a stop sequence, that final token is clipped and the request
+        finishes with reason "stop" (a one-token sequence behaves
+        exactly like a stop_tokens entry; the sequence's earlier
+        tokens were necessarily already streamed — only the completing
+        token can be withheld).  The speculative accept path applies
+        accepted drafts through the same per-token gate, so
+        speculation can never stream past a stop the non-speculative
+        engine would have honored (docs/GENERATION.md).
     """
 
-    __slots__ = ("temperature", "top_k", "top_p", "seed")
+    __slots__ = ("temperature", "top_k", "top_p", "seed",
+                 "stop_sequences", "max_stop_len")
 
-    def __init__(self, temperature=0.0, top_k=None, top_p=None, seed=None):
+    def __init__(self, temperature=0.0, top_k=None, top_p=None, seed=None,
+                 stop_sequences=()):
         self.temperature = float(temperature)
         if self.temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
@@ -34,6 +47,14 @@ class SamplingParams:
         self.top_p = None if top_p is None else float(top_p)
         if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        self.stop_sequences = tuple(
+            tuple(int(t) for t in s) for s in stop_sequences)
+        if any(not s for s in self.stop_sequences):
+            raise ValueError("stop_sequences entries must be non-empty "
+                             "token id sequences")
+        # the suffix-match window the engine keeps per sampled token
+        self.max_stop_len = max((len(s) for s in self.stop_sequences),
+                                default=0)
         if seed is None:
             seed = int(np.random.default_rng().integers(0, 2**31 - 1))
         self.seed = int(seed)

@@ -195,6 +195,49 @@ class ContinuousBatchingScheduler:
                 left -= n
         return pack
 
+    def plan_spec(self, proposer, spec_tokens, room=None):
+        """Draft plan for one SPECULATIVE ragged step: ask the
+        prompt-lookup proposer for up to `spec_tokens` draft
+        continuations per GREEDY decode-ready sequence, slot order
+        (the packed-axis order, so the room clip is deterministic).
+        Returns ``{seq_id: [draft ids]}`` — rows absent from the plan
+        decode exactly as today.
+
+        Three clips keep speculation a pure optimization:
+
+        - stochastic rows never speculate (the accept rule compares
+          argmax against argmax; a sampled token has no draft to
+          verify against);
+        - a row drafts at most ``remaining_budget - 1`` tokens — the
+          step emits accepted + 1 tokens and the final sampled token
+          is never cache-resident, so drafting past the request's
+          max_new_tokens would reserve positions the model can never
+          legally hold;
+        - `room` (the packed token axis's leftover after the one-token
+          decode rows) bounds the TOTAL drafts FIFO, so speculation
+          can never push a decode row or the step's prefill-chunk row
+          out of the fixed axis."""
+        plan = {}
+        left = None if room is None else int(room)
+        for state in self.decode_ready():
+            if left is not None and left <= 0:
+                break
+            if not state.request.params.greedy:
+                continue
+            remaining = state.request.max_new_tokens - state.n_generated
+            k = min(int(spec_tokens), remaining - 1)
+            if left is not None:
+                k = min(k, left)
+            if k <= 0:
+                continue
+            drafts = proposer.propose(state.tokens, k)
+            if not drafts:
+                continue
+            plan[state.seq_id] = drafts
+            if left is not None:
+                left -= len(drafts)
+        return plan
+
     def plan_step(self, chunk_tokens, max_chunk=None):
         """The single-chunk view of plan_pack (the oldest mid-prefill
         sequence's next chunk, clipped to `max_chunk`), as

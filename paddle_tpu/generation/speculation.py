@@ -1,0 +1,158 @@
+"""Speculative decoding through the ragged step: the prompt-lookup
+proposer and the accept-rule math.
+
+Decode throughput on the ragged path is bounded by one token per
+dispatch per sequence.  Speculation makes a dispatch RETIRE more than
+one token: a model-free PROPOSER guesses up to k draft continuations
+per greedy row, the row packs as an ordinary ``[start, len=1+k,
+kv_len]`` ragged descriptor (the exact primitive Ragged Paged Attention
+already has — a chunk-shaped row with per-row-causal masking, no new
+executable signature), and the trace's epilogue verifies every draft
+in the SAME dispatch: compare the per-position argmax against the
+shifted draft ids, count the accepted prefix, and emit the bonus token
+(docs/GENERATION.md "Speculative decoding").
+
+Exactness is by construction, not by luck: the ragged attention's
+masked-softmax semantics make row r's output a pure function of
+(token, position, pool bytes visible to r) — independent of how the
+step was packed — so the verify row at position p computes BITWISE the
+logits a non-speculative decode row at p would, and a draft is only
+ever emitted when the model's own argmax equals it.  Greedy
+speculative decode is therefore token-identical to non-speculative
+decode for float pools; int8 pools add one caveat — a rejected
+draft's write can pre-grow a page's abs-max scale before the rewind,
+a half-LSB-class regrounding bounded by the PR 12 quality gate and
+pinned strict on the deterministic reference-model matrix
+(docs/GENERATION.md "Speculative decoding").  Rejected drafts rewind
+through ``PagedKVCache.truncate``.
+
+Two pieces live here, ONE home for the contract both sides share:
+
+- :class:`NgramProposer` — prompt lookup (the PLD scheme): match the
+  sequence's current n-gram suffix against its OWN history (prompt +
+  generated tail) and propose the continuation after the most recent
+  earlier occurrence.  Free wins on repetition-shaped traffic (code,
+  RAG, multi-turn chat re-sends); a miss costs one empty list.
+- :func:`verify_accept` — the accept rule, numpy/jnp twins: the model
+  epilogue runs it in-trace (``np_mod=jnp``) and tests replay it
+  host-side on fetched argmax rows, so the two can never drift.
+"""
+import numpy as np
+
+
+class NgramProposer:
+    """Model-free prompt-lookup proposer (PLD): propose the historical
+    continuation of the sequence's current n-gram suffix.
+
+    For n-gram sizes ``max_ngram`` down to ``min_ngram``, take the last
+    n tokens of the history, find the MOST RECENT earlier occurrence of
+    that n-gram, and propose the up-to-k tokens that followed it.
+    Longer suffixes are tried first (more context, higher acceptance);
+    the most recent occurrence wins ties (recency tracks the local
+    repetition structure speculation feeds on).  Returns ``[]`` on a
+    miss — the row then decodes exactly as today.
+
+    Pure host-side work on python ints: the proposer runs once per
+    greedy decode row per step, over histories the scheduler already
+    holds (the engine's token lists — already python ints); no device
+    work, no model weights.  `max_lookback` bounds the scan to the
+    most recent window of the history, so per-row proposer cost is
+    O(max_lookback * max_ngram) whatever the context length — the
+    repetition speculation feeds on is LOCAL (loops, code idiom,
+    recent copies), and the overhead-bound workload must not pay a
+    full-history rescan per token.
+    """
+
+    def __init__(self, max_ngram=3, min_ngram=1, max_lookback=512):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_lookback = int(max_lookback)
+        if not 1 <= self.min_ngram <= self.max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]")
+        if self.max_lookback <= self.max_ngram:
+            raise ValueError(
+                f"max_lookback={max_lookback} must exceed "
+                f"max_ngram={max_ngram}")
+
+    def propose(self, tokens, k):
+        """Up to `k` draft token ids continuing `tokens` (a list of
+        ints, prompt + generated so far), or ``[]`` when no suffix
+        match exists in the lookback window."""
+        k = int(k)
+        if k <= 0:
+            return []
+        n = len(tokens)
+        win = (tokens if n <= self.max_lookback
+               else tokens[n - self.max_lookback:])
+        m = len(win)
+        for g in range(self.max_ngram, self.min_ngram - 1, -1):
+            if m <= g:
+                continue
+            suffix = list(win[-g:])
+            last = suffix[-1]
+            # most recent occurrence strictly before the suffix itself
+            # (i <= m - g - 1, so at least one continuation token
+            # always exists after a match); the scalar pre-check on
+            # the n-gram's last token rejects almost every candidate
+            # position without allocating a slice
+            for i in range(m - g - 1, -1, -1):
+                if win[i + g - 1] == last and win[i:i + g] == suffix:
+                    return [int(t) for t in win[i + g:i + g + k]]
+        return []
+
+
+def verify_accept(amax_rows, tokens, starts, lens, spec_tokens,
+                  np_mod=None):
+    """The accept rule over one packed step, vectorized for the trace.
+
+    amax_rows: [S, spec_tokens + 1] int32 — per-DESCRIPTOR argmax of
+        rows ``start .. start + spec_tokens`` of the packed axis (row
+        start+j's argmax predicts the token at global position
+        qpos(start+j) + 1).  The trace gathers exactly this window
+        before its head matmul — the verify epilogue never needs
+        logits for chunk rows past the window or inert padding, so
+        the head cost is O(S * (k + 1)), not O(T).
+    tokens: [T] int32 — the packed token axis (descriptor s's row
+        start+j carries, for j >= 1, its j-th DRAFT token).
+    starts/lens: [S] int32 descriptors (lens = 1 + k for a speculating
+        row; chunk descriptors produce values the engine ignores).
+    spec_tokens: the static draft cap k_max (a python int — the trace
+        is compiled per pages bucket only; k_max shapes a [S, k_max]
+        intermediate, never a new executable axis).
+
+    Returns ``(accepted [S], bonus [S])`` int32: `accepted` is the
+    count of leading drafts whose predecessor-row argmax equals them
+    (``amax_rows[s, j] == tokens[start+j+1]`` for j = 0..), `bonus`
+    the model's own next token after the accepted prefix —
+    ``amax_rows[s, accepted]``, always a row the descriptor owns
+    (accepted <= len - 1 <= spec_tokens).  Every speculative step
+    emits accepted + 1 tokens, so a full rejection still advances one
+    token exactly like a non-speculative step.
+
+    numpy and jnp twins: ``np_mod=jnp`` runs the same expressions
+    in-trace (the model epilogue), numpy replays them host-side in
+    tests — one home for the rule, zero drift.
+    """
+    m = np_mod if np_mod is not None else np
+    kk = int(spec_tokens)
+    amax_rows = m.asarray(amax_rows, m.int32)
+    tokens = m.asarray(tokens, m.int32)
+    starts = m.asarray(starts, m.int32)
+    lens = m.asarray(lens, m.int32)
+    t = tokens.shape[0]
+    offs = m.arange(kk, dtype=m.int32)[None, :]                # [1, K]
+    nxt = m.clip(starts[:, None] + offs + 1, 0, t - 1)
+    valid = offs < (lens - 1)[:, None]
+    match = valid & (amax_rows[:, :kk] == tokens[nxt])
+    # leading-match count: cumprod zeroes everything after the first
+    # mismatch, so the sum counts exactly the accepted prefix
+    accepted = m.sum(m.cumprod(match.astype(m.int32), axis=1),
+                     axis=1).astype(m.int32)
+    bonus = m.take_along_axis(amax_rows, accepted[:, None],
+                              axis=1)[:, 0]
+    return accepted, bonus
+
+
+__all__ = ["NgramProposer", "verify_accept"]

@@ -430,9 +430,11 @@ def ragged_paged_attention_kernel(q, k_pool, v_pool, page_tables, starts,
                                   layout="token", q_block=None,
                                   mesh=None, tp_axis=None, k_scale=None,
                                   v_scale=None):
-    """q: [T, H, D] — the step's PACKED query rows (decode rows and the
-    prefill chunks in one ragged token axis; rows owned by no
-    descriptor come back 0).  k_pool/v_pool: one layer's pool, the
+    """q: [T, H, D] — the step's PACKED query rows (decode rows, the
+    prefill chunks, and speculative verify runs — a decode row with
+    len = 1 + k drafts is just a chunk-shaped descriptor to this
+    kernel — in one ragged token axis; rows owned by no descriptor
+    come back 0).  k_pool/v_pool: one layer's pool, the
     chunks' and the decode tokens' K/V already scattered —
     [P, page_size, H, D] (layout="token") or [H, P, page_size, D]
     (layout="kernel").  page_tables: [S, max_pages] int32 (pad with 0).

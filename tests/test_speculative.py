@@ -1,0 +1,619 @@
+"""Speculative decoding through the ragged step: prompt-lookup
+proposer, k-token verify in one dispatch, on-device accept.
+
+The spec path (generation/speculation.py + the ragged trace's
+accept/reject epilogue + engine._apply_spec_row + kv_cache.truncate):
+a greedy decode row packs its committed token plus up to k draft
+tokens as an ordinary ``[start, len=1+k, kv_len]`` ragged descriptor,
+the SAME dispatch verifies every draft (per-position argmax vs the
+shifted draft ids), and the host fetches accepted counts + the bonus
+token in the step's single sync.  Rejected drafts rewind through the
+NEW typed ``truncate(seq_id, new_len)`` primitive.
+
+Acceptance oracles (all CPU, conftest forces the backend):
+
+1. TOKEN IDENTITY BY CONSTRUCTION: greedy speculative decode ==
+   non-speculative decode == the sequential full-recompute oracle —
+   across eager-oracle vs ragged, kernel-vs-reference (interpret),
+   both pool layouts, int8 pools, prefix warm starts, forced
+   preemption mid-speculation, and the forced 4-device CPU mesh;
+   mixed batches keep stochastic rows decoding normally beside
+   speculating greedy rows.
+2. COMPILE MENU UNCHANGED: the pages bucket stays the ONLY executable
+   axis — spec compile count == non-spec on the same traffic.
+3. ONE DISPATCH, <= 1 HOST SYNC per step, spec_acceptance_rate > 0 on
+   these (heavily self-repeating) greedy streams, and strictly FEWER
+   engine steps than non-speculative decode for the same tokens.
+4. truncate() hardening: typed UnknownSequenceError, loud ValueError
+   on growth or rewinding into an adopted/shared prefix run, and the
+   refcount-leak invariant (drain + flush == all-free) across both
+   pool layouts x int8 x the 4-dev CPU mesh.
+5. Multi-token stop sequences clip at stream time on EVERY path, and
+   the speculative accept loop can never stream past a stop the
+   non-speculative oracle would have honored.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.generation.kv_cache import UnknownSequenceError
+from paddle_tpu.generation.speculation import NgramProposer, verify_accept
+from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402 cross-module memo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # the ragged/chunked/fused suites' signature: the process-wide
+    # greedy oracle memo (gen_oracle) is shared across files
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _engine(model, *, spec="ngram", slots=4, pages=64, page_size=4,
+            chunk=3, **kw):
+    cfg = gen.GenerationConfig(max_decode_slots=slots, num_pages=pages,
+                               page_size=page_size,
+                               prefill_chunk_tokens=chunk,
+                               kv_backend="device", step_mode="ragged",
+                               spec_mode=spec, **kw)
+    return gen.GenerationEngine(model, cfg, start=False)
+
+
+def _run(model, spec, prompts, n=16, sampling=None, **kw):
+    eng = _engine(model, spec=spec, **kw)
+    hs = []
+    for i, p in enumerate(prompts):
+        s = sampling(i) if sampling else None
+        hs.append(eng.submit(p, max_new_tokens=n, sampling=s))
+    eng.run_until_idle()
+    out = [h.result(timeout=5).token_ids for h in hs]
+    snap = eng.metrics.snapshot()
+    util = eng.cache.utilization()
+    eng.shutdown()
+    return out, snap, util
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+# --------------------------- proposer unit -------------------------------
+
+
+def test_ngram_proposer_prompt_lookup():
+    p = NgramProposer(max_ngram=3, min_ngram=1)
+    # suffix [5, 6] recurs earlier; propose its continuation
+    assert p.propose([5, 6, 9, 1, 5, 6], 3) == [9, 1, 5]
+    # the MOST RECENT earlier occurrence wins
+    assert p.propose([5, 6, 1, 5, 6, 2, 5, 6], 2) == [2, 5]
+    # longest n-gram first: [1, 5, 6] beats the shorter [5, 6] match
+    assert p.propose([1, 5, 6, 7, 5, 6, 8, 1, 5, 6], 1) == [7]
+    # the continuation clips at the history's end (the most recent
+    # occurrence of an all-same run sits one short of the suffix)
+    assert p.propose([4, 4, 4, 4, 4], 2) == [4]
+    # miss -> empty (no repetition at all)
+    assert p.propose([1, 2, 3, 4, 5], 4) == []
+    assert p.propose([1, 2], 0) == []
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramProposer(max_ngram=2, min_ngram=3)
+
+
+def _amax_window(amax, starts, k):
+    """[S, k+1] per-descriptor argmax window (rows start..start+k) —
+    how the trace hands full-axis argmax values to verify_accept."""
+    t = len(amax)
+    rows = np.clip(np.asarray(starts)[:, None]
+                   + np.arange(k + 1)[None, :], 0, t - 1)
+    return np.asarray(amax)[rows]
+
+
+def test_verify_accept_host_twin():
+    """The accept rule on hand-built rows: the numpy twin of the exact
+    expressions the trace epilogue runs."""
+    # packed axis: desc 0 = decode+3 drafts at rows 0..3, desc 1 =
+    # plain decode row 4, desc 2 = padding
+    tokens = np.array([10, 20, 30, 40, 5, 0, 0, 0], np.int32)
+    amax = np.array([20, 30, 7, 9, 11, 0, 0, 0], np.int32)
+    starts = np.array([0, 4, 0], np.int32)
+    lens = np.array([4, 1, 0], np.int32)
+    acc, bonus = verify_accept(_amax_window(amax, starts, 3), tokens,
+                               starts, lens, 3)
+    # drafts 20, 30 match their predecessor rows' argmax; 40 != 7
+    assert acc.tolist() == [2, 0, 0]
+    # bonus = argmax at the first unaccepted row (start + accepted)
+    assert bonus[0] == amax[2] and bonus[1] == amax[4]
+    # full accept: bonus comes from the LAST row
+    amax2 = np.array([20, 30, 40, 9, 11, 0, 0, 0], np.int32)
+    acc2, bonus2 = verify_accept(_amax_window(amax2, starts, 3), tokens,
+                                 starts, lens, 3)
+    assert acc2[0] == 3 and bonus2[0] == 9
+    # a non-leading match never counts (cumprod zeroes the tail)
+    amax3 = np.array([99, 30, 40, 9, 11, 0, 0, 0], np.int32)
+    acc3, _ = verify_accept(_amax_window(amax3, starts, 3), tokens,
+                            starts, lens, 3)
+    assert acc3[0] == 0
+
+
+# ----------------------- token identity oracles --------------------------
+
+
+@pytest.mark.parametrize("chunk", [0, 2, 3])
+def test_spec_greedy_token_identical_to_oracle(model, chunk):
+    """THE exactness claim: greedy speculative decode reproduces the
+    sequential full-recompute oracle token for token — chunked and
+    decode-only ragged modes alike — with real acceptance observed."""
+    out, snap, util = _run(model, "ngram", PROMPTS, n=16, chunk=chunk)
+    for toks, p in zip(out, PROMPTS):
+        assert toks == _ref(model, p, 16)
+    assert snap["generation.spec_accepted_tokens"] > 0
+    assert snap["generation.spec_acceptance_rate"] > 0
+    assert util == 0.0
+    assert snap["generation.decode_dispatches_per_step"] == 1
+    assert snap["generation.decode_host_syncs_per_step"] <= 1
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+@pytest.mark.parametrize("use_kernel", [False, True])
+def test_spec_kernel_and_layouts_identical(model, layout, use_kernel):
+    """Kernel-vs-reference (interpret on CPU) x both pool layouts: the
+    verify rows are chunk-shaped descriptors to the ragged kernel, so
+    the whole matrix stays token-identical."""
+    out, snap, _ = _run(model, "ngram", PROMPTS, n=12,
+                        pool_layout=layout, use_kernel=use_kernel)
+    base, _, _ = _run(model, None, PROMPTS, n=12, pool_layout=layout,
+                      use_kernel=use_kernel)
+    assert out == base
+    for toks, p in zip(out, PROMPTS):
+        assert toks == _ref(model, p, 12)
+    assert snap["generation.spec_accepted_tokens"] > 0
+
+
+def test_spec_int8_pools_token_identical(model):
+    """int8 pools: spec-vs-nonspec token identity at the same storage
+    precision, reference and interpret-kernel paths alike, rejected
+    drafts rewound through the quantized truncate.  PINNED on this
+    deterministic (model, prompts) matrix rather than guaranteed by
+    construction: a rejected draft can pre-grow a page scale before
+    the rewind (the half-LSB regrounding the quality gate bounds —
+    docs/GENERATION.md "Speculative decoding"), so if this ever fails
+    after an intentional model/prompt change, re-pin the cell rather
+    than hunting a phantom engine bug."""
+    for uk in (False, True):
+        out, snap, util = _run(model, "ngram", PROMPTS, n=14,
+                               kv_dtype="int8", use_kernel=uk)
+        base, _, _ = _run(model, None, PROMPTS, n=14, kv_dtype="int8",
+                          use_kernel=uk)
+        assert out == base
+        assert snap["generation.spec_accepted_tokens"] > 0
+        assert snap["generation.spec_rewind_tokens"] > 0
+        assert util == 0.0
+
+
+def test_spec_bf16_pools_token_identical(model):
+    import jax.numpy as jnp
+
+    out, snap, _ = _run(model, "ngram", PROMPTS, n=14,
+                        kv_dtype=jnp.bfloat16)
+    base, _, _ = _run(model, None, PROMPTS, n=14, kv_dtype=jnp.bfloat16)
+    assert out == base
+    assert snap["generation.spec_accepted_tokens"] > 0
+
+
+def test_spec_mixed_batch_stochastic_beside_speculating(model):
+    """Stochastic rows decode normally (host-sampled from the augmented
+    logits fetch) BESIDE speculating greedy rows — identical streams,
+    still <= 1 host sync."""
+    def sampling(i):
+        return (gen.SamplingParams(temperature=0.9, top_k=10, top_p=0.9,
+                                   seed=41 + i) if i % 2
+                else gen.SamplingParams())
+
+    out, snap, _ = _run(model, "ngram", PROMPTS, n=12, sampling=sampling)
+    base, _, _ = _run(model, None, PROMPTS, n=12, sampling=sampling)
+    assert out == base
+    assert snap["generation.spec_accepted_tokens"] > 0
+    assert snap["generation.decode_host_syncs_per_step"] <= 1
+
+
+def test_spec_forced_preemption_mid_speculation(model):
+    """A pool sized to thrash: victims are preempted while the batch
+    speculates, re-prefill, and every token still matches — and the
+    drained pool leaks nothing despite per-step truncates."""
+    out, snap, util = _run(model, "ngram", PROMPTS, n=14, pages=9,
+                           chunk=2)
+    for toks, p in zip(out, PROMPTS):
+        assert toks == _ref(model, p, 14)
+    assert snap["generation.preempted_total"] > 0
+    assert snap["generation.spec_accepted_tokens"] > 0
+    assert util == 0.0
+
+
+def test_spec_prefix_cache_warm_identical(model):
+    """Prefix-cache warm starts compose: warm == cold == non-spec, and
+    the speculative rewind never touches an adopted run (truncate's
+    shared-page guard would fire loudly if it did)."""
+    system = [3, 1, 4, 1, 5, 9, 2, 6]
+
+    def run(spec, prefix_on):
+        eng = _engine(model, spec=spec, prefix_cache=prefix_on)
+        outs, hits = [], []
+        for sfx in ([7, 7], [5, 5]):
+            h = eng.submit(system + sfx, max_new_tokens=10)
+            eng.run_until_idle()
+            outs.append(h.result(timeout=5).token_ids)
+            hits.append(h.prefix_hit_tokens)
+        eng.shutdown()
+        return outs, hits
+
+    warm, warm_hits = run("ngram", True)
+    cold, _ = run("ngram", False)
+    base, _ = run(None, False)
+    assert warm == cold == base
+    assert warm_hits[1] >= 8
+
+
+def test_spec_mesh_4dev_token_identical():
+    """The forced 4-device CPU mesh: speculation through the sharded
+    one-GSPMD-dispatch step — token-identical to the unsharded
+    non-speculative engine, per-shard pools at 1/tp, 1 dispatch and
+    <= 1 sync per step."""
+    import jax
+
+    from paddle_tpu.parallel import tp_mesh
+
+    assert len(jax.devices()) >= 4, "conftest forces 8 host devices"
+    mesh_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=4, head_dim=8, seed=3)
+
+    def run(spec, mesh):
+        eng = _engine(mesh_model, spec=spec, mesh=mesh)
+        if mesh is not None:
+            pool = eng.cache.layer_pools(0)[0]
+            shard = next(iter(pool.addressable_shards)).data
+            assert shard.size * 4 == pool.size
+        hs = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+        eng.run_until_idle()
+        out = [h.result(timeout=5).token_ids for h in hs]
+        snap = eng.metrics.snapshot()
+        eng.shutdown()
+        return out, snap
+
+    sharded, snap = run("ngram", tp_mesh(4))
+    single, _ = run(None, None)
+    assert sharded == single
+    assert snap["generation.spec_accepted_tokens"] > 0
+    assert snap["generation.decode_dispatches_per_step"] == 1
+    assert snap["generation.decode_host_syncs_per_step"] <= 1
+    assert snap["generation.mesh_devices"] == 4
+
+
+# ------------------ dispatch/sync/steps acceptance -----------------------
+
+
+def test_spec_one_dispatch_one_sync_every_step(model):
+    """Acceptance: every speculative step is exactly 1 dispatch and
+    <= 1 host sync, whatever the accept outcome."""
+    eng = _engine(model, chunk=2, slots=2)
+    h = eng.submit([1] * 9, max_new_tokens=16)
+    reg = StatRegistry.instance()
+    disp = reg.get_stat(gmetrics.DECODE_DISPATCHES_PER_STEP)
+    sync = reg.get_stat(gmetrics.DECODE_HOST_SYNCS_PER_STEP)
+    while eng.scheduler.active() or eng.scheduler.pending_count():
+        if eng.step():
+            assert disp.get() == 1
+            assert sync.get() <= 1
+    assert h.result(timeout=5).token_ids == _ref(model, [1] * 9, 16)
+    snap = eng.metrics.snapshot()
+    assert snap["generation.spec_acceptance_rate"] > 0
+    eng.shutdown()
+
+
+def test_spec_retires_more_tokens_per_dispatch(model):
+    """The throughput mechanism itself: on these self-repeating greedy
+    streams the speculative engine finishes the same work in strictly
+    FEWER engine steps (each accepted draft is a token that needed no
+    dispatch of its own)."""
+    def steps(spec):
+        out, snap, _ = _run(model, spec, PROMPTS, n=24)
+        return out, snap["generation.steps_total"]
+
+    out_s, steps_s = steps("ngram")
+    out_b, steps_b = steps(None)
+    assert out_s == out_b
+    assert steps_s < steps_b, (steps_s, steps_b)
+
+
+def test_spec_compile_menu_unchanged(model):
+    """The pages bucket stays the ONLY executable axis: the speculative
+    engine compiles exactly as many ragged executables as the
+    non-speculative one on the same traffic (one per pages bucket)."""
+    def compiles(spec):
+        eng = _engine(model, spec=spec, pages=64, page_size=4)
+        hs = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+        eng.run_until_idle()
+        for h in hs:
+            h.result(timeout=5)
+        n = eng._ragged.compile_count
+        assert n == len(eng._ragged.cached_buckets())
+        eng.shutdown()
+        return n
+
+    assert compiles("ngram") == compiles(None)
+
+
+def test_spec_budget_clips_drafts_not_correctness(model):
+    """A tight explicit step_token_budget clips drafts (speculation
+    never squeezes out a decode or chunk row) — correctness and the
+    single dispatch hold; with zero leftover room, speculation simply
+    never proposes."""
+    # budget == slots + 1: decode rows + the guaranteed chunk row fill
+    # the axis; drafts get the scraps or nothing
+    out, snap, _ = _run(model, "ngram", PROMPTS, n=12, slots=4,
+                        step_token_budget=5)
+    for toks, p in zip(out, PROMPTS):
+        assert toks == _ref(model, p, 12)
+    assert snap["generation.decode_dispatches_per_step"] == 1
+    # a lone greedy row with room DOES speculate under the same budget
+    out1, snap1, _ = _run(model, "ngram", [PROMPTS[0]], n=12, slots=4,
+                          step_token_budget=5)
+    assert out1 == [_ref(model, PROMPTS[0], 12)]
+    assert snap1["generation.spec_proposed_tokens"] > 0
+
+
+def test_spec_pool_pressure_drops_drafts_never_preempts(model):
+    """Speculation is a pure optimization: a lone sequence in a pool
+    with no headroom for draft pages decodes through (drafts dropped
+    on OutOfPages) instead of preempting or failing."""
+    p = [1, 2, 3]
+    n = 9
+    # exactly the pages the sequence itself needs: prompt + n tokens,
+    # page_size 4 -> ceil((3 + 9 + 1) / 4) = 4 pages, zero slack
+    out, snap, util = _run(model, "ngram", [p], n=n, pages=4, chunk=0)
+    assert out == [_ref(model, p, n)]
+    assert snap["generation.preempted_total"] == 0
+    assert util == 0.0
+
+
+# --------------------------- metrics schema ------------------------------
+
+
+def test_spec_metrics_schema_complete(model):
+    """spec_mode stamp + all four spec counters are in the FIRST
+    snapshot (before any step), and the books balance after a run:
+    rewound == proposed - accepted."""
+    eng = _engine(model)
+    snap = eng.metrics.snapshot()
+    assert snap["generation.spec_mode"] == "ngram"
+    for key in ("spec_proposed_tokens", "spec_accepted_tokens",
+                "spec_rewind_tokens", "spec_acceptance_rate",
+                "spec_draft_rows"):
+        assert "generation." + key in snap, key
+    hs = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    eng.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    snap = eng.metrics.snapshot()
+    assert snap["generation.spec_rewind_tokens"] == \
+        snap["generation.spec_proposed_tokens"] - \
+        snap["generation.spec_accepted_tokens"]
+    rate = snap["generation.spec_acceptance_rate"]
+    assert 0 < rate <= 1
+    eng.shutdown()
+
+    # non-spec engines stamp "off" — silent fallback is a stats fact
+    leg = gen.GenerationEngine(model, gen.GenerationConfig(), start=False)
+    assert leg.metrics.snapshot()["generation.spec_mode"] == "off"
+    leg.shutdown()
+
+
+def test_spec_config_validation(model):
+    with pytest.raises(ValueError, match="spec_mode"):
+        gen.GenerationConfig(spec_mode="bogus")
+    with pytest.raises(ValueError, match="spec_tokens"):
+        gen.GenerationConfig(spec_mode="ngram", spec_tokens=0)
+    with pytest.raises(ValueError, match="ragged"):
+        gen.GenerationConfig(spec_mode="ngram", step_mode="legacy")
+    with pytest.raises(ValueError, match="ragged"):
+        gen.GenerationEngine(model, gen.GenerationConfig(
+            spec_mode="ngram", kv_backend="host"), start=False)
+    # spec_mode with step_mode unset resolves to ragged even on CPU
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        spec_mode="ngram", kv_backend="device"), start=False)
+    assert eng.step_mode == "ragged" and eng._spec is not None
+    assert eng._ragged.spec_tokens == 4
+    eng.shutdown()
+    # "off" and None are the same non-speculative default
+    eng = _engine(model, spec=None)
+    assert eng._spec is None and eng._ragged.spec_tokens == 0
+    eng.shutdown()
+
+
+# ------------------------- stop sequences --------------------------------
+
+
+def test_stop_sequences_stream_clip(model):
+    """Multi-token stop sequences on the plain (legacy eager oracle)
+    path: the stream ends the moment the generated tail would complete
+    a stop sequence, the completing token clipped like a single stop
+    token; a 1-token sequence behaves exactly like stop_tokens."""
+    free = _ref(model, [1, 2, 3], 16)
+    two = tuple(free[4:6])
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(), start=False)
+    h = eng.submit([1, 2, 3], max_new_tokens=16,
+                   sampling=gen.SamplingParams(stop_sequences=[two]))
+    h1 = eng.submit([1, 2, 3], max_new_tokens=16,
+                    sampling=gen.SamplingParams(
+                        stop_sequences=[(free[2],)]))
+    eng.run_until_idle()
+    res = h.result(timeout=5)
+    assert res.finish_reason == "stop"
+    assert res.token_ids == free[:5]     # ...free[4], free[5] clipped
+    res1 = h1.result(timeout=5)
+    assert res1.finish_reason == "stop" and res1.token_ids == free[:2]
+    eng.shutdown()
+    with pytest.raises(ValueError, match="non-empty"):
+        gen.SamplingParams(stop_sequences=[()])
+
+
+def test_stop_sequences_spec_never_streams_past_stop(model):
+    """The speculative accept loop applies drafts through the same
+    per-token gate: a stop sequence completing MID-accepted-run clips
+    the stream exactly where the non-speculative engine does."""
+    free = _ref(model, [1, 2, 3], 20)
+    stop = tuple(free[5:7])
+
+    def run(spec):
+        eng = _engine(model, spec=spec)
+        h = eng.submit([1, 2, 3], max_new_tokens=20,
+                       sampling=gen.SamplingParams(stop_sequences=[stop]))
+        eng.run_until_idle()
+        r = h.result(timeout=5)
+        util = eng.cache.utilization()
+        eng.shutdown()
+        return r.token_ids, r.finish_reason, util
+
+    toks_s, reason_s, util = run("ngram")
+    toks_b, reason_b, _ = run(None)
+    assert (toks_s, reason_s) == (toks_b, reason_b)
+    assert reason_s == "stop" and toks_s == free[:6]
+    assert util == 0.0   # the stop-finish freed the over-reserved row
+
+
+# --------------------------- truncate() ----------------------------------
+
+
+def _cache(layout="token", dtype=np.float32, mesh=None, backend="device"):
+    if backend == "host":
+        return gen.PagedKVCache(2, 2, 8, num_pages=16, page_size=4,
+                                dtype=dtype)
+    return gen.DeviceKVPool(2, 2, 8, num_pages=16, page_size=4,
+                            dtype=dtype, pool_layout=layout, mesh=mesh)
+
+
+def test_truncate_typed_errors():
+    cache = _cache(backend="host")
+    with pytest.raises(UnknownSequenceError):
+        cache.truncate("nope", 0)
+    cache.allocate("a")
+    cache.reserve("a", 10)
+    with pytest.raises(ValueError, match="only rewinds"):
+        cache.truncate("a", 11)
+    with pytest.raises(ValueError, match="only rewinds"):
+        cache.truncate("a", -1)
+    assert cache.truncate("a", 10) == 0          # no-op rewind
+    assert cache.truncate("a", 5) == 1           # page 2 of 3 freed
+    assert cache.seq_len("a") == 5
+    assert len(cache.page_table("a")) == 2
+    assert cache.truncate("a", 0) == 2
+    assert cache.page_table("a") == ()
+    cache.free("a")
+    assert cache.num_free_pages == cache.num_pages
+
+
+def test_truncate_shared_prefix_guard():
+    """Rewinding into an adopted/shared prefix run is a LOUD error —
+    both a shared page being dropped and a mid-page clip inside a
+    shared page."""
+    cache = _cache(backend="host")
+    rng = np.random.default_rng(0)
+    cache.allocate("w")
+    k = rng.standard_normal((2, 8, 2, 8)).astype(np.float32)
+    cache.append_prefill("w", k, -k)
+    tokens = list(range(100, 108))
+    cache.register_prefix("w", tokens)           # 2 full pages indexed
+    # dropping an indexed page: loud
+    with pytest.raises(ValueError, match="shared"):
+        cache.truncate("w", 2)
+    # a reader aliasing the run: mid-page clip inside it is loud too
+    pages, matched = cache.match_prefix(tokens + [1])
+    cache.allocate("r")
+    cache.adopt_prefix("r", pages, matched)
+    with pytest.raises(ValueError, match="shared"):
+        cache.truncate("r", 3)
+    # page-aligned rewind that only DROPS the reader's private tail is
+    # fine: reserve a private span past the adoption, then rewind it
+    cache.reserve("r", 9 - matched)              # grows a private page
+    assert cache.truncate("r", 8) == 1
+    cache.free("r")
+    cache.free("w")
+    assert cache.flush_prefix_cache() > 0
+    assert cache.num_free_pages == cache.num_pages
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+@pytest.mark.parametrize("dtype", [np.float32, "int8"])
+def test_truncate_refcount_drain_all_layouts(layout, dtype):
+    """The refcount-leak regression: reserve / truncate / free churn
+    across both pool layouts x int8 leaves the pool ALL-FREE after
+    drain + flush; int8 scale rows of released pages reset."""
+    cache = _cache(layout=layout, dtype=np.dtype(dtype))
+    for sid in ("a", "b", "c"):
+        cache.allocate(sid)
+        cache.reserve(sid, 11)
+        cache.truncate(sid, 6)
+        cache.reserve(sid, 3)
+        cache.truncate(sid, 1)
+    for sid in ("a", "b", "c"):
+        cache.free(sid)
+    cache.flush_prefix_cache()
+    assert cache.num_free_pages == cache.num_pages
+    if np.dtype(dtype) == np.int8:
+        # released pages carry a zeroed grid again
+        assert np.all(cache.k_scale == 0.0)
+        assert np.all(cache.v_scale == 0.0)
+
+
+def test_truncate_refcount_drain_mesh():
+    """The same invariant on the forced 4-dev CPU mesh (head-sharded
+    pools; bookkeeping is host-global so truncate is dispatch-free)."""
+    import jax
+
+    from paddle_tpu.parallel import tp_mesh
+
+    assert len(jax.devices()) >= 4
+    cache = gen.DeviceKVPool(2, 4, 8, num_pages=16, page_size=4,
+                             mesh=tp_mesh(4))
+    cache.allocate("a")
+    cache.reserve("a", 10)
+    assert cache.truncate("a", 3) == 2
+    cache.free("a")
+    cache.flush_prefix_cache()
+    assert cache.num_free_pages == cache.num_pages
+
+
+def test_truncate_retained_rows_survive(model):
+    """Truncate only forgets: retained positions read back bitwise, and
+    re-reserving the rewound span writes fresh content exactly like a
+    never-speculated sequence (host backend, direct byte check)."""
+    cache = gen.PagedKVCache(1, 2, 8, num_pages=8, page_size=4)
+    rng = np.random.default_rng(1)
+    cache.allocate("s")
+    k = rng.standard_normal((1, 10, 2, 8)).astype(np.float32)
+    cache.append_prefill("s", k, -k)
+    before_k, before_v = cache.gather_prefix("s", 0, 6)
+    cache.truncate("s", 6)
+    after_k, after_v = cache.gather_prefix("s", 0, 6)
+    np.testing.assert_array_equal(np.asarray(before_k),
+                                  np.asarray(after_k))
+    np.testing.assert_array_equal(np.asarray(before_v),
+                                  np.asarray(after_v))
+    # the rewound span rewrites cleanly
+    k2 = rng.standard_normal((1, 4, 2, 8)).astype(np.float32)
+    start = cache.reserve("s", 4)
+    assert start == 6
+    cache._write_span("s", start, k2, -k2)
+    got_k, _ = cache.gather_prefix("s", 0, 10)
+    np.testing.assert_array_equal(np.asarray(got_k)[6:], k2[0])
+    cache.free("s")
+    assert cache.num_free_pages == cache.num_pages

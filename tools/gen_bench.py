@@ -770,6 +770,111 @@ def bench_drain_migration(model, transport, live, sys_tokens, new_tokens,
     }
 
 
+def bench_spec(model, batch, context, new_tokens, page_size, spec_mode,
+               spec_tokens, workload):
+    """One SPECULATIVE-decoding A/B cell: the ragged engine with
+    spec_mode off vs "ngram" (prompt-lookup proposer, k-token verify
+    in the one ragged dispatch, on-device accept).
+
+    Two workload shapes bound the story from both sides:
+
+    - "repeat": code/RAG-shaped prompts — a short random pattern tiled
+      to the context length, so the token history is dense with n-gram
+      recurrences and prompt lookup HITS (the free-win cell);
+    - "random": the plain rng workload of the main grid, where lookup
+      mostly misses — the overhead-bound cell (the spec axis is wider
+      and every miss is a proposer scan; the acceptance criterion is
+      "no regression worse than ~10%", not a win).
+
+    Reports steady-state tokens/s, acceptance rate, mean accepted
+    drafts per verify row (accepted / spec_draft_rows), rewind tokens,
+    and dispatches/step (must stay 1 — speculation may never add a
+    dispatch)."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.generation import metrics as gmetrics
+    from paddle_tpu.profiler.monitor import StatRegistry
+
+    rng = np.random.default_rng(7000 + batch)
+    if workload == "repeat":
+        prompts = []
+        for _ in range(batch):
+            base = rng.integers(0, model.vocab_size, 8).tolist()
+            reps = -(-context // len(base))
+            prompts.append((base * reps)[:context])
+    else:
+        prompts = [rng.integers(0, model.vocab_size, context).tolist()
+                   for _ in range(batch)]
+    pages = ((context + new_tokens + spec_tokens)
+             // page_size + 2) * batch
+    eng = g.GenerationEngine(
+        model,
+        g.GenerationConfig(max_decode_slots=batch, num_pages=pages,
+                           page_size=page_size, queue_depth=batch * 2,
+                           kv_backend="device", step_mode="ragged",
+                           spec_mode=spec_mode,
+                           spec_tokens=spec_tokens),
+        start=False)
+
+    def run_once():
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=new_tokens)
+                   for p in prompts]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        return dt, [h.result(timeout=1) for h in handles]
+
+    warmup_s, _ = run_once()
+    reg = StatRegistry.instance()
+    stats = {name: reg.get_stat(name) for name in (
+        gmetrics.STEPS_TOTAL, gmetrics.SPEC_PROPOSED_TOKENS,
+        gmetrics.SPEC_ACCEPTED_TOKENS, gmetrics.SPEC_REWIND_TOKENS,
+        gmetrics.SPEC_DRAFT_ROWS,
+        gmetrics.DECODE_COMPILES_TOTAL, gmetrics.PREFILL_COMPILES_TOTAL)}
+    before = {name: s.get() for name, s in stats.items()}
+    dt, results = run_once()
+    delta = {name: int(s.get() - before[name])
+             for name, s in stats.items()}
+    generated = sum(len(r.token_ids) for r in results)
+    steps = delta[gmetrics.STEPS_TOTAL]
+    proposed = delta[gmetrics.SPEC_PROPOSED_TOKENS]
+    accepted = delta[gmetrics.SPEC_ACCEPTED_TOKENS]
+    snap = eng.metrics.snapshot()
+    cell = {
+        "cell": "spec",
+        "workload": workload,
+        "spec_mode": spec_mode or "off",
+        "spec_tokens": spec_tokens,
+        "batch": batch,
+        "context": context,
+        "new_tokens": new_tokens,
+        "warmup_s": round(warmup_s, 4),
+        "elapsed_s": round(dt, 4),
+        "generated": int(generated),
+        "tokens_per_s": round(generated / dt, 1) if dt > 0 else None,
+        "steps": steps,
+        "tokens_per_step": round(generated / steps, 3) if steps else None,
+        "spec_proposed": proposed,
+        "spec_accepted": accepted,
+        "spec_rewind": delta[gmetrics.SPEC_REWIND_TOKENS],
+        "acceptance_rate": (round(accepted / proposed, 3)
+                            if proposed else None),
+        # mean accepted drafts per VERIFY ROW (one row per drafting
+        # sequence per step — the true mean accepted length; the
+        # per-dispatch bonus token is excluded)
+        "mean_accepted_len": (
+            round(accepted / delta[gmetrics.SPEC_DRAFT_ROWS], 3)
+            if delta[gmetrics.SPEC_DRAFT_ROWS] else None),
+        "dispatches_per_step":
+            snap["generation.decode_dispatches_per_step"],
+        "host_syncs_per_step":
+            snap["generation.decode_host_syncs_per_step"],
+        "measured_compiles": delta[gmetrics.DECODE_COMPILES_TOTAL]
+            + delta[gmetrics.PREFILL_COMPILES_TOTAL],
+    }
+    eng.shutdown()
+    return cell
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="1,4,8")
@@ -874,6 +979,21 @@ def main():
                          "emits a kv_quality cell (max-logit drift + "
                          "greedy-token agreement vs the fp32 oracle — "
                          "the quality gate the lossy path ships under)")
+    ap.add_argument("--spec", choices=("off", "ngram", "both"),
+                    default="off",
+                    help="speculative-decoding A/B on the ragged step: "
+                         "spec_mode off vs 'ngram' (prompt-lookup "
+                         "proposer, k-token verify in ONE dispatch, "
+                         "on-device accept) over a repetition-heavy "
+                         "workload (tiled code-like prompts, where "
+                         "lookup hits) AND the plain rng workload (the "
+                         "overhead-bound cell) — tokens/s, acceptance "
+                         "rate, mean tokens/step, rewind tokens, "
+                         "dispatches/step (still 1) per cell")
+    ap.add_argument("--spec-tokens", type=int, default=3,
+                    help="draft cap per speculating row for --spec "
+                         "(3 measured best on CPU, where the packed "
+                         "axis is real FLOPs; sweep upward on TPU)")
     ap.add_argument("--quant-collectives", action="store_true",
                     help="EQuARX-style quantized-allreduce A/B: every "
                          "SHARDED (tp > 1) combo runs an extra cell "
@@ -1081,6 +1201,21 @@ def main():
                                   in ("int8", "both") else None)))
             stats_by_series[f"device/{q_decode}/tp{tp}/qcol"] = \
                 reg.stats_snapshot("generation.")
+    if args.spec != "off":
+        # the speculative-decoding A/B: ragged engine, off vs ngram,
+        # repeat-heavy (prompt lookup hits) and random (overhead-bound)
+        spec_modes = ((None, "ngram") if args.spec == "both"
+                      else ("ngram",))
+        sb = max(batches)
+        for workload in ("repeat", "random"):
+            for mode in spec_modes:
+                reset_gen_stats()
+                grid.append(bench_spec(
+                    model, sb, min(contexts), args.new_tokens,
+                    args.page_size, mode, args.spec_tokens, workload))
+                stats_by_series[
+                    f"device/spec-{mode or 'off'}/{workload}"] = \
+                    reg.stats_snapshot("generation.")
     if args.prefix != "off":
         # the shared-system-prompt A/B: chunked prefill (warm hits
         # resume mid-prompt through the chunk loop), one cell per
@@ -1138,6 +1273,7 @@ def main():
         "prefills": list(prefills),
         "tp_degrees": list(tps),
         "step": args.step,
+        "spec": args.spec,
         "chunk_tokens": args.chunk_tokens,
         "prefix": args.prefix,
         "replicas": args.replicas,

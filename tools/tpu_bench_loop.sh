@@ -66,13 +66,13 @@ for i in $(seq 1 "$MAX"); do
     # cells): a timeout kill here drops the WHOLE gen artifact
     # (mesh/prefill numbers included), so the cap tracks the scenario
     # count and a kill at least says so
-    timeout 4500 python tools/gen_bench.py --pool both --decode both \
+    timeout 5100 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
       --step both --fleet-transport both \
-      --kv-quant both --quant-collectives \
+      --kv-quant both --quant-collectives --spec both \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + kv-quant + quant-collectives A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + kv-quant + quant-collectives + spec A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
