@@ -405,11 +405,17 @@ class GenerationHandle:
         # (0 = cold, None = not admitted yet): the per-request warm/cold
         # signal the serving tier (and future SLO routing) reads
         self.prefix_hit_tokens = None
+        # authoritative delivered-token count: every fleet remigration
+        # reads it as the replay-skip FLOOR, so no race in transport
+        # ledger bookkeeping can ever replay a token this handle
+        # already streamed (docs/SERVING.md "Failure model")
+        self.n_streamed = 0
 
     # --- engine side ---
     def _push_token(self, token):
         if self.first_token_s is None:
             self.first_token_s = time.monotonic()
+        self.n_streamed += 1
         self._events.put(int(token))
 
     def _finish(self, result):
@@ -744,6 +750,16 @@ class GenerationEngine:
         # is the truth — "off" in a snapshot MEANS non-speculative
         self.metrics.set_spec_mode(self.config.spec_mode)
         self._lock = threading.Lock()  # one stepper at a time
+        # monotone step-progress stamp: bumped every COMPLETED step()
+        # call, with `in_step` flagging the window where a step HOLDS
+        # the lock (a long jit compile inside a step is progress, not
+        # a wedge).  A subprocess replica's heartbeat carries both, so
+        # a wedged engine — step loop BLOCKED on the lock, heartbeat
+        # thread alive — shows as work-without-progress-outside-a-step,
+        # the fleet wedge watchdog's signal (docs/SERVING.md "Failure
+        # model")
+        self._step_seq = 0
+        self._in_step = False
         self._closed = False
         self._stop = threading.Event()
         self._thread = None
@@ -952,24 +968,58 @@ class GenerationEngine:
                     snap.get("v_scale"))
             except (OutOfPagesError, ValueError):
                 return False
-            req = GenerationRequest(
-                snap["prompt"], handle, snap["sampling"],
-                max_new_tokens=snap["max_new_tokens"],
-                stop_tokens=snap["stop_tokens"],
-                deadline=snap.get("deadline"))
-            state = SequenceState(self.scheduler.next_seq_id(), req)
-            self.cache.allocate(state.seq_id)
-            self.cache.adopt_imported(state.seq_id, pages,
-                                      snap["cache_len"])
-            state.tokens = list(snap["tokens"])
-            state.n_generated = int(snap["n_generated"])
-            state.preemptions = int(snap["preemptions"])
-            state.rng = snap["rng"]
-            state.prefilling = False
-            state.prefill_pos = int(snap["cache_len"])
-            self.scheduler.place_imported(state)
+            seq_id = None
+            attached = False
+            try:
+                req = GenerationRequest(
+                    snap["prompt"], handle, snap["sampling"],
+                    max_new_tokens=snap["max_new_tokens"],
+                    stop_tokens=snap["stop_tokens"],
+                    deadline=snap.get("deadline"))
+                state = SequenceState(self.scheduler.next_seq_id(), req)
+                seq_id = state.seq_id
+                self.cache.allocate(seq_id)
+                self.cache.adopt_imported(seq_id, pages,
+                                          snap["cache_len"])
+                attached = True
+                state.tokens = list(snap["tokens"])
+                state.n_generated = int(snap["n_generated"])
+                state.preemptions = int(snap["preemptions"])
+                state.rng = snap["rng"]
+                state.prefilling = False
+                state.prefill_pos = int(snap["cache_len"])
+                self.scheduler.place_imported(state)
+            except Exception:   # noqa: BLE001 — a poisoned snapshot or
+                # a failure mid-install (crash-injection territory)
+                # must not leak the imported pages or strand a
+                # half-built resident: give everything back and refuse
+                # typed (False → the caller's cold-resubmit ladder)
+                self._recover_failed_import(seq_id, attached, pages)
+                return False
             self.metrics.count_request()
             return True
+
+    def _recover_failed_import(self, seq_id, attached, pages):
+        """Roll back a mid-flight import_sequence failure so the pool
+        stays consistent: free the sequence when its table holds the
+        pages, otherwise route the orphaned (refcount-1, ownerless)
+        pages through a throwaway adopter so the free list gets every
+        byte back — drain + flush == all-free must survive a crash at
+        ANY point of the install."""
+        try:
+            if attached and seq_id is not None:
+                self.cache.free(seq_id)
+                return
+            if seq_id is not None and self.cache.has(seq_id):
+                self.cache.free(seq_id)
+            if pages:
+                rid = ("__import_recovery__", id(pages))
+                self.cache.allocate(rid)
+                self.cache.adopt_imported(
+                    rid, pages, len(pages) * self.cache.page_size)
+                self.cache.free(rid)
+        except Exception:   # noqa: BLE001 — recovery is best-effort;
+            pass            # never mask the refusal with a new error
 
     def drain_work(self, migrate=True, live=True, timeout=60.0):
         """The drain state machine BOTH transport halves run
@@ -1078,13 +1128,33 @@ class GenerationEngine:
                 return 0
 
     # --------------------------- stepping ---------------------------
+    @property
+    def step_seq(self):
+        """Completed-step counter — the wedge watchdog's progress
+        stamp (frozen ⇔ the step loop is blocked or idle)."""
+        return self._step_seq
+
+    @property
+    def in_step(self):
+        """True while a step HOLDS the step lock (doing real work —
+        possibly a long first-shape compile).  False + frozen
+        step_seq + pending work ⇔ the step loop cannot even ENTER a
+        step: the wedge signature."""
+        return self._in_step
+
     def step(self):
         """One scheduler step: admit+prefill, then one decode step for
         every active sequence.  Returns the number of sequences that
         advanced (0 == idle).  Thread-safe; the background worker uses
         exactly this."""
         with self._lock:
-            return self._step_locked()
+            self._in_step = True
+            try:
+                out = self._step_locked()
+            finally:
+                self._in_step = False
+        self._step_seq += 1
+        return out
 
     def _step_locked(self):
         from ..profiler import RecordEvent

@@ -19,12 +19,14 @@ Quick start::
     outputs = fut.result()          # or engine.infer(...) to block
     engine.shutdown()
 """
-from .admission import (AdmissionQueue, DeadlineExceededError, Request,
+from .admission import (AdmissionQueue, DeadlineExceededError,
+                        ReplicaTimeoutError, Request,
                         RequestTooLargeError, ServerBusyError, ServingError)
 from .batcher import DynamicBatcher
 from .bucketing import CompiledModelCache, ShapeBucketer
 from .engine import ServingConfig, ServingEngine, create_serving_engine
-from .fleet import FleetConfig, FleetMetrics, FleetRouter, ReplicaSpec
+from .fleet import (CircuitBreaker, FleetConfig, FleetMetrics,
+                    FleetRouter, ReplicaSpec)
 from .metrics import LatencyReservoir, ServingMetrics
 
 __all__ = [
@@ -33,6 +35,7 @@ __all__ = [
     "ShapeBucketer", "CompiledModelCache",
     "ServingMetrics", "LatencyReservoir",
     "FleetRouter", "FleetConfig", "FleetMetrics", "ReplicaSpec",
+    "CircuitBreaker",
     "ServingError", "ServerBusyError", "DeadlineExceededError",
-    "RequestTooLargeError",
+    "RequestTooLargeError", "ReplicaTimeoutError",
 ]

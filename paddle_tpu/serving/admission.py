@@ -38,6 +38,15 @@ class RequestTooLargeError(ServingError):
     can never be scheduled and is rejected at submit."""
 
 
+class ReplicaTimeoutError(ServingError, TimeoutError):
+    """A cross-replica RPC exceeded its bounded deadline (the peer is
+    hung, wedged, or the channel is poisoned — NOT a client deadline,
+    which is DeadlineExceededError).  Idempotent ops retry with backoff
+    under a bounded attempt budget; non-idempotent ops fail fast into
+    the fleet's remigration ladder.  Subclasses TimeoutError so generic
+    timeout handlers catch it."""
+
+
 class Request:
     """One in-flight inference request."""
 

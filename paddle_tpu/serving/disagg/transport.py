@@ -32,6 +32,7 @@ Docs: docs/SERVING.md "Disaggregated fleet" (contract + RPC schema).
 """
 import itertools
 import os
+import random
 import socket
 import subprocess
 import sys
@@ -42,17 +43,55 @@ from ...generation.engine import (GenerationEngine, GenerationResult)
 from ...generation.metrics import GenerationMetrics
 from ...generation.scheduler import GenerationRequest
 from ...profiler.monitor import StatRegistry
-from ..admission import ServingError
+from ..admission import ReplicaTimeoutError, ServingError
 from .rpc import ChannelClosed, recv_frame, send_frame
 
 HEARTBEAT_S = 0.25
 
+# ops a timed-out caller may safely re-issue: they read state or
+# re-assert idempotent state, so a lost REPLY cannot double-apply.
+# submit / import_seq / import_prefix / evacuate are NOT here — a lost
+# reply may mean the op landed, and re-issuing would double-run it;
+# they fail fast into the fleet's remigration ladder instead.
+RETRYABLE_OPS = frozenset({"stats", "load", "export_prefix",
+                           "flush_prefix", "reset_stats", "ping"})
 
-def build_transport(spec, kind, start=True):
-    """Transport factory: ``"inproc"`` or ``"proc"``."""
+
+class RpcPolicy:
+    """Bounded-RPC knobs for one SubprocTransport: every `_call` gets
+    a deadline (`timeout_s` — there is NO unbounded default), and
+    idempotent ops retry up to `retries` total attempts with
+    exponential backoff + seeded jitter (`backoff_s` base).  The
+    FleetRouter builds one from FleetConfig.rpc_* per replica."""
+
+    __slots__ = ("timeout_s", "retries", "backoff_s", "seed")
+
+    def __init__(self, timeout_s=15.0, retries=3, backoff_s=0.05,
+                 seed=0):
+        if float(timeout_s) <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        if int(retries) < 1:
+            raise ValueError(f"retries must be >= 1, got {retries}")
+        if float(backoff_s) < 0:
+            raise ValueError(f"backoff_s must be >= 0, got {backoff_s}")
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.seed = seed
+
+
+def build_transport(spec, kind, start=True, rpc=None, fault_plan=None):
+    """Transport factory: ``"inproc"`` or ``"proc"``.  `rpc` is an
+    RpcPolicy (proc only); `fault_plan` a serving.disagg.faults
+    FaultPlan wrapping the frame codec — chaos tests/drills only, and
+    only meaningful where there IS a wire."""
     if kind == "proc":
-        return SubprocTransport(spec)
+        return SubprocTransport(spec, rpc=rpc, fault_plan=fault_plan)
     if kind == "inproc":
+        if fault_plan is not None:
+            raise ValueError(
+                "fault injection wraps the RPC frame codec; an inproc "
+                "replica has no wire to fault — use transport='proc'")
         return InprocTransport(spec, start=start)
     raise ValueError(f"transport must be 'inproc' or 'proc', got {kind!r}")
 
@@ -74,6 +113,7 @@ class InprocTransport:
         if self.engine.prefix_cache_enabled:
             self.engine.cache.enable_prefix_deltas()
         self.on_death = None   # inproc replicas share our fate
+        self.timeout_total = 0   # schema parity: no RPC, no timeouts
 
     # ------------------------- liveness -----------------------------
     def alive(self):
@@ -162,9 +202,8 @@ class SubprocTransport:
 
     kind = "proc"
     BUILD_TIMEOUT_S = 180.0
-    RPC_TIMEOUT_S = 60.0
 
-    def __init__(self, spec):
+    def __init__(self, spec, rpc=None, fault_plan=None):
         cfg = spec.config
         if cfg is not None and getattr(cfg, "mesh", None) is not None:
             raise ValueError(
@@ -176,6 +215,10 @@ class SubprocTransport:
         self.registry = None       # stats live in the child
         self.engine = None         # no direct-object path
         self.on_death = None       # fleet sets: callback(transport)
+        self.rpc = rpc or RpcPolicy()
+        self._faults = fault_plan  # chaos: wraps the codec parent-side
+        self._jitter = random.Random((spec.name, self.rpc.seed).__repr__())
+        self.timeout_total = 0     # RPC deadline misses (drill report)
         parent, child = socket.socketpair()
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -197,6 +240,13 @@ class SubprocTransport:
         self._load = {"queue_depth": 0, "active": 0, "pages_in_use": 0,
                       "num_pages": 1, "idle": True}
         self._last_hb = time.monotonic()
+        # wedge-watchdog inputs: the heartbeat's engine-step progress
+        # stamp (seq frozen + load busy == alive-but-stalled) and how
+        # long the child has reported itself idle (orphan sweep)
+        self._progress_seq = None
+        self._progress_at = time.monotonic()
+        self._in_step = False
+        self._idle_since = None
         self._dead = threading.Event()
         self._closing = False
         self._death_handled = False
@@ -226,12 +276,37 @@ class SubprocTransport:
         # longer than heartbeat_dead_after must not read as a stale
         # replica the reaper kills at the first submit
         self._last_hb = time.monotonic()
+        self._progress_at = self._last_hb
 
     # ------------------------- wire pump ----------------------------
+    def _send(self, msg):
+        """One (possibly fault-injected) frame write."""
+        if self._faults is None:
+            send_frame(self._sock, msg, self._wlock)
+        else:
+            self._faults.on_send(self, msg)
+
+    def _send_stall(self, stall_s):
+        """Chaos: ask the worker to WEDGE its engine (a thread holds
+        the step lock for `stall_s`) while its heartbeat thread keeps
+        beating — the alive-but-stalled failure the wedge watchdog
+        exists for.  Rid-less fire-and-forget, written with the plain
+        codec so a stall rule cannot recurse into the fault plan."""
+        try:
+            send_frame(self._sock,
+                       {"op": "chaos_stall", "stall_s": float(stall_s)},
+                       self._wlock)
+        except OSError:
+            pass
+
     def _read_loop(self):
         try:
             while True:
-                self._dispatch(recv_frame(self._sock))
+                if self._faults is None:
+                    self._dispatch(recv_frame(self._sock))
+                else:
+                    for frame in self._faults.on_recv(self):
+                        self._dispatch(frame)
         except (ChannelClosed, OSError, EOFError, ValueError):
             pass
         except Exception:   # noqa: BLE001 — a poisoned frame is a dead
@@ -250,8 +325,21 @@ class SubprocTransport:
             return
         kind = frame.get("ev")
         if kind == "hb":
-            self._last_hb = time.monotonic()
-            self._load = frame.get("load", self._load)
+            now = time.monotonic()
+            self._last_hb = now
+            load = frame.get("load", self._load)
+            self._load = load
+            idle = bool(load.get("idle", True))
+            seq = frame.get("seq")
+            # the wedge watchdog's progress stamp: the clock re-arms
+            # whenever the engine completed a step since the last beat
+            # OR the replica is idle (no work ⇒ no progress owed)
+            if idle or seq is None or seq != self._progress_seq:
+                self._progress_seq = seq
+                self._progress_at = now
+            self._in_step = bool(frame.get("in_step", False))
+            self._idle_since = ((self._idle_since or now) if idle
+                                else None)
             deltas = frame.get("deltas")
             if deltas:
                 with self._lock:
@@ -262,10 +350,30 @@ class SubprocTransport:
             entry = self._inflight.get(sid)
         if entry is None:
             return   # stream already resolved/migrated elsewhere
+        entry["last_event"] = time.monotonic()
         handle = entry["handle"]
         if kind == "token":
-            entry["emitted"] += 1
-            handle._push_token(frame["t"])
+            # ordered stream protocol: events carry a per-stream index
+            # so a duplicated frame is dropped and a lost frame leaves
+            # a HOLE, not a mis-ordered stream — the client only ever
+            # sees an exact prefix, backfilled from the authoritative
+            # result at completion
+            n = frame.get("n")
+            if n is None:
+                entry["next"] += 1
+                entry["emitted"] = entry["base"] + entry["next"]
+                handle._push_token(frame["t"])
+            elif n == entry["next"]:
+                entry["next"] += 1
+                handle._push_token(frame["t"])
+                ahead = entry["ahead"]
+                while entry["next"] in ahead:
+                    handle._push_token(ahead.pop(entry["next"]))
+                    entry["next"] += 1
+                entry["emitted"] = entry["base"] + entry["next"]
+            elif n > entry["next"]:
+                entry["ahead"][n] = frame["t"]
+            # n < next: a duplicated frame — already delivered, drop
         elif kind == "done":
             with self._lock:
                 self._inflight.pop(sid, None)
@@ -274,6 +382,11 @@ class SubprocTransport:
                                            0) is None:
                 handle.prefix_hit_tokens = hit
             r = frame["result"]
+            # backfill any tokens whose event frames were lost: the
+            # result's token_ids are authoritative, and the client has
+            # exactly the base+next prefix so far
+            for t in r["token_ids"][entry["base"] + entry["next"]:]:
+                handle._push_token(t)
             handle._finish(GenerationResult(
                 r["token_ids"], r["finish_reason"], r["prompt_len"],
                 r["preemptions"]))
@@ -302,9 +415,16 @@ class SubprocTransport:
             self.on_death(self)
 
     def _call(self, msg, timeout=None):
+        """One RPC round-trip under a BOUNDED deadline — `timeout=None`
+        means the transport's RpcPolicy default, never unbounded.  A
+        missed deadline raises the typed ReplicaTimeoutError; callers
+        that can re-issue safely go through _call_idempotent, everyone
+        else fails fast into the fleet's remigration ladder."""
         if self._dead.is_set():
             raise ServingError(
                 f"replica {self.name!r} process is dead")
+        timeout = (self.rpc.timeout_s if timeout is None
+                   else float(timeout))
         rid = next(self._ids)
         ev = threading.Event()
         slot = {}
@@ -313,22 +433,40 @@ class SubprocTransport:
         msg = dict(msg)
         msg["rid"] = rid
         try:
-            send_frame(self._sock, msg, self._wlock)
+            self._send(msg)
         except OSError as e:
             with self._lock:
                 self._rpc_waits.pop(rid, None)
             raise ServingError(
                 f"replica {self.name!r} channel write failed") from e
-        if not ev.wait(self.RPC_TIMEOUT_S if timeout is None
-                       else float(timeout)):
+        if not ev.wait(timeout):
             with self._lock:
                 self._rpc_waits.pop(rid, None)
-            raise ServingError(
+            self.timeout_total += 1
+            raise ReplicaTimeoutError(
                 f"RPC {msg.get('op')!r} to replica {self.name!r} "
-                f"timed out")
+                f"exceeded its {timeout:.1f}s deadline")
         if "error" in slot:
             raise slot["error"]
         return slot.get("ok")
+
+    def _call_idempotent(self, msg, timeout=None):
+        """Retry an idempotent op (RETRYABLE_OPS) on deadline misses:
+        exponential backoff + seeded jitter under the policy's bounded
+        attempt budget.  A dead channel never retries — dead is dead."""
+        op = msg.get("op")
+        assert op in RETRYABLE_OPS, f"op {op!r} is not idempotent"
+        last = None
+        for attempt in range(self.rpc.retries):
+            try:
+                return self._call(msg, timeout)
+            except ReplicaTimeoutError as e:
+                last = e
+                if attempt + 1 < self.rpc.retries \
+                        and not self._dead.is_set():
+                    time.sleep(self.rpc.backoff_s * (2 ** attempt)
+                               * (1.0 + 0.25 * self._jitter.random()))
+        raise last
 
     # ------------------------- liveness -----------------------------
     def alive(self):
@@ -339,9 +477,55 @@ class SubprocTransport:
 
     def kill(self):
         """Hard-kill the worker process (crash-injection for tests and
-        drills): SIGKILL, no cleanup — the reader thread's EOF is the
-        detection path under test."""
+        drills, and the watchdog's wedge-kill): SIGKILL, no cleanup —
+        the reader thread's EOF is the detection path under test."""
         self._proc.kill()
+
+    def wedged(self, after_s, hard_after_s=None):
+        """True when the replica is alive-but-STALLED: it reports work
+        (engine not idle) but its heartbeat progress stamp hasn't
+        advanced — the heartbeat thread outliving a wedged engine
+        loop, the one failure socket EOF and stale heartbeats both
+        miss.  Two clocks:
+
+        - SOFT (`after_s`): fires only while the engine is NOT inside
+          a step — the step loop cannot even take its own lock (the
+          classic stall).  An engine mid-step is doing real work: a
+          10 s first-shape jit compile must never read as a wedge.
+        - HARD (`hard_after_s`, default 10x soft): fires regardless —
+          a step that holds the lock without completing for THIS long
+          is hung inside the dispatch, not compiling.
+
+        The router's watchdog kills and remigrates either case
+        exactly like a crash."""
+        if self._dead.is_set():
+            return False
+        if bool(self._load.get("idle", True)):
+            return False
+        frozen = time.monotonic() - self._progress_at
+        if hard_after_s is None:
+            hard_after_s = 10.0 * float(after_s)
+        if frozen > float(hard_after_s):
+            return True
+        return frozen > float(after_s) and not self._in_step
+
+    def take_orphans(self, grace_s):
+        """In-flight ledger entries the child has silently forgotten:
+        the worker has reported itself idle (no queue, no live slots)
+        for over `grace_s` while these streams still wait — a lost
+        completion event (dropped/corrupted `done` frame).  Pops and
+        returns them for remigration: seeded sampling replays the
+        identical stream and the relay skips the delivered prefix."""
+        now = time.monotonic()
+        if self._dead.is_set() or self._idle_since is None \
+                or now - self._idle_since < float(grace_s):
+            return []
+        out = []
+        with self._lock:
+            for sid, entry in list(self._inflight.items()):
+                if now - entry["last_event"] > float(grace_s):
+                    out.append(self._inflight.pop(sid))
+        return out
 
     # ----------------------- introspection --------------------------
     def describe(self):
@@ -354,7 +538,7 @@ class SubprocTransport:
     def stats(self):
         if self._dead.is_set():
             return {}
-        return self._call({"op": "stats"})
+        return self._call_idempotent({"op": "stats"})
 
     # -------------------------- serving -----------------------------
     def submit(self, prompt, kwargs, handle):
@@ -367,6 +551,8 @@ class SubprocTransport:
             "kwargs": dict(kwargs),
             "handle": handle,
             "emitted": 0,
+            "base": 0, "next": 0, "ahead": {},
+            "last_event": time.monotonic(),
             "deadline": (None if timeout_ms is None else
                          time.monotonic() + float(timeout_ms) / 1e3),
         }
@@ -398,17 +584,22 @@ class SubprocTransport:
         return out
 
     def export_prefix(self, tokens):
-        return self._call({"op": "export_prefix",
-                           "tokens": [int(t) for t in tokens]})
+        # idempotent read: a lost reply just re-exports the same run
+        return self._call_idempotent({"op": "export_prefix",
+                                      "tokens": [int(t) for t in tokens]})
 
     def import_prefix(self, payload):
+        # NOT retried: a lost reply may mean the pages landed; the
+        # import is an optimization and a duplicate would only free
+        # itself, but re-shipping multi-MB payloads on a timeout is
+        # the wrong trade — fail fast, the cold ladder covers it
         return self._call({"op": "import_prefix", "payload": payload})
 
     def flush_prefix(self):
-        return self._call({"op": "flush_prefix"})
+        return self._call_idempotent({"op": "flush_prefix"})
 
     def reset_stats(self):
-        return self._call({"op": "reset_stats"})
+        return self._call_idempotent({"op": "reset_stats"})
 
     # ----------------------- drain / migration ----------------------
     def import_sequence(self, snap):
@@ -423,6 +614,8 @@ class SubprocTransport:
                        "timeout_ms": None},
             "handle": handle,
             "emitted": int(snap["n_generated"]),
+            "base": int(snap["n_generated"]), "next": 0, "ahead": {},
+            "last_event": time.monotonic(),
             "deadline": snap.get("deadline"),
         }
         with self._lock:
@@ -440,10 +633,13 @@ class SubprocTransport:
         return ok
 
     def drain(self, migrate=True, live=True, timeout=60.0):
+        # the ONE op with its own longer budget — the drain may wait
+        # `timeout` for residents to finish — still explicit and
+        # bounded, never None
         out = self._call(
             {"op": "evacuate", "migrate": bool(migrate),
              "live": bool(live), "timeout": float(timeout)},
-            timeout=float(timeout) + self.RPC_TIMEOUT_S)
+            timeout=float(timeout) + self.rpc.timeout_s)
         cold, live_snaps = [], []
         with self._lock:
             for item in out["cold"]:
@@ -471,7 +667,8 @@ class SubprocTransport:
         if self._dead.is_set():
             return True
         try:
-            load = self._call({"op": "load"}, timeout=10.0)
+            load = self._call_idempotent(
+                {"op": "load"}, timeout=min(10.0, self.rpc.timeout_s))
         except ServingError:
             return True
         self._load = load
@@ -484,11 +681,18 @@ class SubprocTransport:
 
     def stop(self):
         self._closing = True
+        clean = False
         if not self._dead.is_set():
             try:
-                self._call({"op": "shutdown"}, timeout=10.0)
+                self._call({"op": "shutdown"},
+                           timeout=min(10.0, self.rpc.timeout_s))
+                clean = True
             except ServingError:
                 pass
+        if not clean:
+            # dead or unresponsive (wedged engine, poisoned channel):
+            # don't wait out a corpse's grace period — reap it now
+            self._proc.kill()
         try:
             self._proc.wait(timeout=10.0)
         except subprocess.TimeoutExpired:
@@ -501,4 +705,4 @@ class SubprocTransport:
 
 
 __all__ = ["InprocTransport", "SubprocTransport", "build_transport",
-           "HEARTBEAT_S"]
+           "RpcPolicy", "RETRYABLE_OPS", "HEARTBEAT_S"]

@@ -27,7 +27,7 @@ class _StreamHandle:
     client's GenerationHandle from these frames."""
 
     __slots__ = ("sid", "_sock", "_wlock", "submitted_s",
-                 "first_token_s", "prefix_hit_tokens", "_done")
+                 "first_token_s", "prefix_hit_tokens", "_done", "_n")
 
     def __init__(self, sid, sock, wlock):
         self.sid = sid
@@ -37,6 +37,8 @@ class _StreamHandle:
         self.first_token_s = None
         self.prefix_hit_tokens = None
         self._done = False
+        self._n = 0   # per-stream event index: the parent dedups
+        # duplicated frames and detects holes from dropped ones
 
     def _send(self, obj):
         from .rpc import send_frame
@@ -49,7 +51,10 @@ class _StreamHandle:
     def _push_token(self, token):
         if self.first_token_s is None:
             self.first_token_s = time.monotonic()
-        self._send({"ev": "token", "sid": self.sid, "t": int(token)})
+        n = self._n
+        self._n += 1
+        self._send({"ev": "token", "sid": self.sid, "t": int(token),
+                    "n": n})
 
     def _finish(self, result):
         if self._done:
@@ -104,8 +109,15 @@ class _Worker:
         while not self._stop_hb.wait(interval):
             try:
                 deltas = self.engine.cache.take_prefix_deltas()
+                # "seq" is the engine's step-progress stamp: this
+                # thread deliberately shares NO lock with the step
+                # loop, so a wedged engine keeps heartbeating a FROZEN
+                # seq while reporting work — exactly the signature the
+                # parent's wedge watchdog kills on
                 send_frame(self.sock,
                            {"ev": "hb", "load": self.engine.load_info(),
+                            "seq": self.engine.step_seq,
+                            "in_step": self.engine.in_step,
                             "deltas": deltas}, self.wlock)
             except OSError:
                 return
@@ -173,6 +185,24 @@ class _Worker:
     def op_ping(self, frame):
         return True
 
+    def op_chaos_stall(self, frame):
+        """Chaos-injection hook (serving/disagg/faults.py "stall"):
+        WEDGE the engine — a daemon thread holds the step lock for
+        `stall_s` — while this serve loop and the heartbeat thread
+        keep running.  The replica looks alive (fresh heartbeats, RPC
+        replies) but makes no decode progress: the failure mode only
+        the parent's wedge watchdog can catch."""
+        stall_s = float(frame.get("stall_s", 30.0))
+        lock = self.engine._lock
+
+        def hold():
+            with lock:
+                time.sleep(stall_s)
+
+        threading.Thread(target=hold, name="chaos-stall",
+                         daemon=True).start()
+        return True
+
     def op_shutdown(self, frame):
         self._stop_hb.set()
         if self.engine is not None:
@@ -196,7 +226,13 @@ class _Worker:
             rid = frame.get("rid")
             op = frame.get("op")
             try:
-                result = getattr(self, f"op_{op}")(frame)
+                handler = getattr(self, f"op_{op}", None)
+                if handler is None:
+                    # a frame that decoded but names no op (garbage
+                    # that survived unpickling) must answer typed, not
+                    # crash the worker on an AttributeError
+                    raise ServingError(f"unknown op {op!r}")
+                result = handler(frame)
                 reply = {"resp": rid, "ok": result}
             except Exception as e:   # noqa: BLE001 — typed errors ride
                 reply = {"resp": rid, "error": e}   # the wire back

@@ -84,10 +84,10 @@ from ..generation.engine import GenerationHandle
 from ..generation.sampling import SamplingParams
 from ..generation.scheduler import GenerationRequest
 from ..profiler.monitor import StatRegistry
-from .admission import (RequestTooLargeError, ServerBusyError,
-                        ServingError)
+from .admission import (ReplicaTimeoutError, RequestTooLargeError,
+                        ServerBusyError, ServingError)
 from .disagg.page_service import FleetPrefixIndex
-from .disagg.transport import build_transport
+from .disagg.transport import HEARTBEAT_S, RpcPolicy, build_transport
 
 PREFIX = "fleet."
 
@@ -109,6 +109,15 @@ LIVE_MIGRATED_TOTAL = PREFIX + "live_migrated_total"
 MIGRATED_REPLAY_TOKENS = PREFIX + "migrated_replay_tokens"
 PAGE_ADOPTIONS = PREFIX + "page_adoptions"
 PAGES_ADOPTED = PREFIX + "pages_adopted"
+# chaos-hardening tier (ISSUE 15): per-replica circuit breakers,
+# bounded-RPC deadline misses, wedge watchdog kills, orphaned-stream
+# remigration, and exponential respawn backoff
+BREAKER_OPEN_TOTAL = PREFIX + "breaker_open_total"
+BREAKER_STATE = PREFIX + "breaker_state"
+REPLICA_TIMEOUT_TOTAL = PREFIX + "replica_timeout_total"
+WEDGE_KILL_TOTAL = PREFIX + "wedge_kill_total"
+ORPHAN_REMIGRATED_TOTAL = PREFIX + "orphan_remigrated_total"
+RESPAWN_BACKOFF_S = PREFIX + "respawn_backoff_s"
 
 
 class FleetMetrics:
@@ -129,7 +138,10 @@ class FleetMetrics:
                      PREFIX_ROUTED_MISSED, REPLICA_QUEUE_DEPTH,
                      REPLICA_HEARTBEAT_AGE, REPLICA_DEAD_TOTAL,
                      LIVE_MIGRATED_TOTAL, MIGRATED_REPLAY_TOKENS,
-                     PAGE_ADOPTIONS, PAGES_ADOPTED):
+                     PAGE_ADOPTIONS, PAGES_ADOPTED,
+                     BREAKER_OPEN_TOTAL, BREAKER_STATE,
+                     REPLICA_TIMEOUT_TOTAL, WEDGE_KILL_TOTAL,
+                     ORPHAN_REMIGRATED_TOTAL, RESPAWN_BACKOFF_S):
             self._reg.get_stat(name)
 
     def _stat(self, name):
@@ -175,6 +187,37 @@ class FleetMetrics:
         if pages:
             self._stat(PAGES_ADOPTED).increase(int(pages))
 
+    def count_breaker_open(self):
+        """A circuit breaker tripped open: `breaker_threshold`
+        consecutive transport faults took the replica out of every
+        routing gate."""
+        self._stat(BREAKER_OPEN_TOTAL).increase()
+
+    def count_replica_timeout(self):
+        """One bounded RPC missed its deadline (ReplicaTimeoutError)."""
+        self._stat(REPLICA_TIMEOUT_TOTAL).increase()
+
+    def count_wedge_kill(self):
+        """The wedge watchdog killed an alive-but-stalled replica."""
+        self._stat(WEDGE_KILL_TOTAL).increase()
+
+    def count_orphan_remigrated(self):
+        """A stream whose completion event was lost (idle worker,
+        lingering ledger entry) was remigrated by the orphan sweep."""
+        self._stat(ORPHAN_REMIGRATED_TOTAL).increase()
+
+    def set_breaker_state(self, name, score):
+        """0 = closed, 1 = half-open, 2 = open; bare gauge = max."""
+        self._stat(f"{BREAKER_STATE}.{name}").set(int(score))
+
+    def set_max_breaker_state(self, score):
+        self._stat(BREAKER_STATE).set(int(score))
+
+    def set_respawn_backoff(self, name, backoff_s):
+        self._stat(f"{RESPAWN_BACKOFF_S}.{name}").set(
+            round(float(backoff_s), 3))
+        self._stat(RESPAWN_BACKOFF_S).set(round(float(backoff_s), 3))
+
     def set_heartbeat_age(self, name, age):
         self._stat(f"{REPLICA_HEARTBEAT_AGE}.{name}").set(
             round(float(age), 3))
@@ -191,6 +234,113 @@ class FleetMetrics:
     def snapshot(self):
         return {k: v for k, v in self._reg.stats().items()
                 if k.startswith(PREFIX)}
+
+
+class CircuitBreaker:
+    """Per-replica consecutive-failure circuit breaker.
+
+    States::
+
+        closed ──(threshold consecutive transport FAULTS)──> open
+        open ──(cooldown elapsed AND a fresh heartbeat)──> half-open
+        half-open ──(probe success)──> closed
+        half-open ──(probe failure)──> open (cooldown re-arms)
+
+    A FAULT is a transport failure — an RPC deadline miss, a dead
+    channel — never an admission-load rejection (`ServerBusyError` is
+    back-pressure, not breakage: it feeds the load score, not the
+    breaker).  While open the replica leaves EVERY routing gate; the
+    half-open probe rides heartbeat recovery (the replica proved it is
+    alive again) and admits exactly one request, whose outcome decides
+    the state.  Thread-safe: router threads, transport reader threads,
+    and the watchdog all touch it."""
+
+    STATE_SCORE = {"closed": 0, "half-open": 1, "open": 2}
+
+    def __init__(self, threshold=3, cooldown_s=1.0, on_open=None):
+        if int(threshold) < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        self.failures = 0
+        self._opened_at = 0.0
+        self._probe = False
+        self._on_open = on_open
+        self._lock = threading.Lock()
+
+    @property
+    def score(self):
+        """The gauge encoding: 0 closed, 1 half-open, 2 open."""
+        return self.STATE_SCORE[self.state]
+
+    def _half_open_ready(self, hb_age, hb_fresh_s):
+        return (time.monotonic() - self._opened_at >= self.cooldown_s
+                and float(hb_age) <= float(hb_fresh_s))
+
+    def routable(self, hb_age=0.0, hb_fresh_s=1.0):
+        """Read-only gate for candidate filtering: could a request be
+        admitted here right now?  Never claims the half-open probe —
+        that happens in admit(), at the moment of actual submission."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                return self._half_open_ready(hb_age, hb_fresh_s)
+            return not self._probe
+
+    def admit(self, hb_age=0.0, hb_fresh_s=1.0):
+        """The submission-time gate: like routable(), but an open
+        breaker whose cooldown elapsed under a fresh heartbeat
+        transitions to half-open HERE, and the caller claims the one
+        probe slot — record_success/record_failure/record_busy MUST
+        follow, or the probe slot stays taken."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if not self._half_open_ready(hb_age, hb_fresh_s):
+                    return False
+                self.state = "half-open"
+                self._probe = False
+            if self._probe:
+                return False
+            self._probe = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self.failures = 0
+            self._probe = False
+            self.state = "closed"
+
+    def record_busy(self):
+        """Admission-load rejection: releases a claimed probe without
+        counting a fault — a busy replica is healthy."""
+        with self._lock:
+            self._probe = False
+
+    def record_failure(self):
+        with self._lock:
+            self.failures += 1
+            self._probe = False
+            if self.state == "half-open" \
+                    or self.failures >= self.threshold:
+                reopened = self.state != "open"
+                self.state = "open"
+                self._opened_at = time.monotonic()
+            else:
+                return
+        if reopened and self._on_open is not None:
+            self._on_open()
+
+    def reset(self):
+        """Administrative reset (restart() rebuilds the replica — its
+        fault history died with the old process)."""
+        with self._lock:
+            self.state = "closed"
+            self.failures = 0
+            self._probe = False
 
 
 class ReplicaSpec:
@@ -252,8 +402,12 @@ class _MigrationRelay:
 
     def client_and_delivered(self):
         """(client handle, stream tokens the client has received) — the
-        skip count a SECOND migration of the same request needs."""
-        return self._client, max(self._skip0, self._pushed)
+        skip count a SECOND migration of the same request needs.  The
+        client's own n_streamed counter is the FLOOR: whatever the
+        relay bookkeeping says, a replay must never re-push a token
+        the client already has."""
+        return self._client, max(self._skip0, self._pushed,
+                                 getattr(self._client, "n_streamed", 0))
 
     def _push_token(self, token):
         if self.first_token_s is None:
@@ -287,13 +441,24 @@ class _Replica:
     _TTFT_LOAD_CAP = 4.0     # a slow replica weighs at most like this
     # many queued requests: bounded back-pressure, never starvation
 
-    def __init__(self, spec, start, transport_kind, on_death=None):
+    def __init__(self, spec, start, transport_kind, on_death=None,
+                 rpc=None, fault_plan=None, breaker=None):
         self.spec = spec
         self.kind = transport_kind
         self.state = "stopped"
         self.transport = None
         self._describe = None
         self._on_death = on_death
+        self._rpc = rpc
+        self._fault_plan = fault_plan
+        # the chaos-hardening state the router keeps PER replica: a
+        # consecutive-failure circuit breaker and the respawn-backoff
+        # clocks (consecutive quick deaths ⇒ exponential restart
+        # backoff, capped into a crash-loop refusal)
+        self.breaker = breaker or CircuitBreaker()
+        self.respawns = 0
+        self.built_at = 0.0
+        self.died_at = None
         # measured time-to-first-token EWMA (seconds; None = no sample
         # yet).  Updated from handle done-callbacks, which fire on
         # engine worker threads — the float swap is a benign last-
@@ -317,13 +482,17 @@ class _Replica:
 
     def build(self, start):
         self.transport = build_transport(self.spec, self.kind,
-                                         start=start)
+                                         start=start, rpc=self._rpc,
+                                         fault_plan=self._fault_plan)
         self.transport.on_death = self._on_death
         self._describe = self.transport.describe()
         self.state = "serving"
+        self.built_at = time.monotonic()
+        self.died_at = None
         # a rebuilt replica is a new process in spirit: its latency
-        # history died with the old engine
+        # and fault history died with the old engine
         self.ttft_ewma = None
+        self.breaker.reset()
 
     @property
     def name(self):
@@ -419,12 +588,54 @@ class FleetConfig:
     page_service: fleet-level prefix index + point-to-point page
         transfer (True, the default under routing="affinity"); False
         keeps the stable-hash prefix guess only.
+
+    Chaos-hardening knobs (docs/SERVING.md "Failure model"):
+
+    rpc_timeout_s / rpc_retries / rpc_backoff_s: the bounded-RPC
+        policy every subprocess replica's transport runs — a default
+        deadline on EVERY `_call` (never unbounded), with idempotent
+        ops retrying up to `rpc_retries` total attempts under
+        exponential backoff (+ seeded jitter) from `rpc_backoff_s`.
+    breaker_threshold / breaker_cooldown_s: per-replica circuit
+        breaker — `threshold` CONSECUTIVE transport faults (timeouts,
+        dead channels; never ServerBusyError) open it, taking the
+        replica out of every routing gate; after `cooldown_s` a fresh
+        heartbeat earns a single half-open probe request.
+    wedge_after_s / wedge_hard_after_s: an alive-but-STALLED replica
+        (heartbeats flow, the engine's step-progress stamp is frozen
+        while it reports work) is killed and remigrated like a crash.
+        The soft clock fires after `wedge_after_s` only when the
+        engine is NOT inside a step (the step loop cannot take its
+        own lock — a true wedge); an engine mid-step (a long jit
+        compile is legitimate work) gets the hard ceiling
+        `wedge_hard_after_s` (None = 10x the soft clock).
+    orphan_grace_s: a stream whose worker reports idle for this long
+        while its ledger entry lingers (lost completion event) is
+        remigrated by the watchdog's orphan sweep.
+    respawn_backoff_s / respawn_backoff_cap_s / max_respawns /
+    respawn_reset_s: `restart()` of a replica that died within
+        `respawn_reset_s` of its build waits an exponential backoff
+        (base * 2^(n-1), capped at the cap); after `max_respawns`
+        consecutive quick deaths restart refuses typed (crash loop) —
+        `reset_respawn(name)` is the operator override.
+    fault_plans: {replica_name: serving.disagg.faults.FaultPlan} —
+        deterministic chaos injection on the replica's RPC codec
+        (proc transports only; tests/drills, never production).
+    watchdog_interval_s: background watchdog sweep period for fleets
+        with subprocess replicas (None = auto from the thresholds).
     """
 
     def __init__(self, routing="affinity", affinity_block_tokens=None,
                  start=True, seed=None, transport=None,
                  live_migration=True, heartbeat_dead_after=10.0,
-                 page_service=True):
+                 page_service=True, rpc_timeout_s=15.0, rpc_retries=3,
+                 rpc_backoff_s=0.05, breaker_threshold=3,
+                 breaker_cooldown_s=1.0, wedge_after_s=10.0,
+                 wedge_hard_after_s=None,
+                 orphan_grace_s=5.0, respawn_backoff_s=0.5,
+                 respawn_backoff_cap_s=30.0, max_respawns=5,
+                 respawn_reset_s=30.0, fault_plans=None,
+                 watchdog_interval_s=None):
         if routing not in ("affinity", "random"):
             raise ValueError(
                 f"routing must be 'affinity' or 'random', got {routing!r}")
@@ -447,6 +658,50 @@ class FleetConfig:
         self.live_migration = bool(live_migration)
         self.heartbeat_dead_after = float(heartbeat_dead_after)
         self.page_service = bool(page_service)
+        # RpcPolicy validates timeout/retries/backoff on construction
+        # — fail HERE, not at the first replica build
+        RpcPolicy(rpc_timeout_s, rpc_retries, rpc_backoff_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.rpc_retries = int(rpc_retries)
+        self.rpc_backoff_s = float(rpc_backoff_s)
+        if int(breaker_threshold) < 1:
+            raise ValueError(f"breaker_threshold must be >= 1, got "
+                             f"{breaker_threshold}")
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        for knob, val in (("wedge_after_s", wedge_after_s),
+                          ("orphan_grace_s", orphan_grace_s),
+                          ("respawn_backoff_cap_s",
+                           respawn_backoff_cap_s),
+                          ("respawn_reset_s", respawn_reset_s)):
+            if float(val) <= 0:
+                raise ValueError(f"{knob} must be > 0, got {val}")
+        self.wedge_after_s = float(wedge_after_s)
+        if wedge_hard_after_s is not None \
+                and float(wedge_hard_after_s) <= 0:
+            raise ValueError(f"wedge_hard_after_s must be > 0 or None "
+                             f"(auto 10x), got {wedge_hard_after_s}")
+        self.wedge_hard_after_s = (None if wedge_hard_after_s is None
+                                   else float(wedge_hard_after_s))
+        self.orphan_grace_s = float(orphan_grace_s)
+        if float(respawn_backoff_s) < 0:
+            raise ValueError(f"respawn_backoff_s must be >= 0, got "
+                             f"{respawn_backoff_s}")
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.respawn_backoff_cap_s = float(respawn_backoff_cap_s)
+        if int(max_respawns) < 1:
+            raise ValueError(
+                f"max_respawns must be >= 1, got {max_respawns}")
+        self.max_respawns = int(max_respawns)
+        self.respawn_reset_s = float(respawn_reset_s)
+        self.fault_plans = dict(fault_plans) if fault_plans else None
+        if watchdog_interval_s is not None \
+                and float(watchdog_interval_s) <= 0:
+            raise ValueError(f"watchdog_interval_s must be > 0 or None, "
+                             f"got {watchdog_interval_s}")
+        self.watchdog_interval_s = (
+            None if watchdog_interval_s is None
+            else float(watchdog_interval_s))
 
 
 class FleetRouter:
@@ -462,11 +717,22 @@ class FleetRouter:
         self.config = config or FleetConfig()
         self.metrics = metrics or FleetMetrics()
         self._page_index = FleetPrefixIndex()
+        cfg = self.config
+        if cfg.fault_plans:
+            unknown = set(cfg.fault_plans) - set(names)
+            if unknown:
+                raise ValueError(
+                    f"fault_plans name unknown replicas: {sorted(unknown)}")
+        rpc = RpcPolicy(cfg.rpc_timeout_s, cfg.rpc_retries,
+                        cfg.rpc_backoff_s, seed=cfg.seed or 0)
         self._replicas = {
             s.name: _Replica(
-                s, self.config.start,
-                self.config.transport or s.transport,
-                on_death=self._on_transport_death)
+                s, cfg.start, cfg.transport or s.transport,
+                on_death=self._on_transport_death, rpc=rpc,
+                fault_plan=(cfg.fault_plans or {}).get(s.name),
+                breaker=CircuitBreaker(
+                    cfg.breaker_threshold, cfg.breaker_cooldown_s,
+                    on_open=self._on_breaker_open))
             for s in specs}
         block = self.config.affinity_block_tokens
         if block is None:
@@ -477,6 +743,26 @@ class FleetRouter:
         self._rng = np.random.default_rng(self.config.seed)
         self._lock = threading.Lock()
         self._closed = False
+        # a heartbeat this recent counts as "recovered" for the
+        # breaker's half-open probe (inproc ages are 0 — always fresh)
+        self._hb_fresh_s = max(1.0, 4 * HEARTBEAT_S)
+        self._watchdog_gate = threading.Lock()   # one sweep at a time
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread = None
+        if any(r.kind == "proc" for r in self._replicas.values()):
+            # stale-heartbeat reaping, wedge kills, and the orphan
+            # sweep cannot depend on traffic arriving: a fleet with
+            # process replicas runs a background watchdog
+            interval = cfg.watchdog_interval_s
+            if interval is None:
+                interval = max(0.05, min(cfg.heartbeat_dead_after,
+                                         cfg.wedge_after_s,
+                                         cfg.orphan_grace_s) / 4)
+            self._watchdog_interval = float(interval)
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="fleet-watchdog",
+                daemon=True)
+            self._watchdog_thread.start()
 
     # --------------------------- routing ----------------------------
     def _prefix_key(self, prompt):
@@ -530,20 +816,65 @@ class FleetRouter:
                 best = hit
         return best
 
-    def _reap_stale_heartbeats(self):
-        """Declare replicas whose heartbeat aged past the threshold
-        dead (a HUNG process; a crashed one is caught instantly by the
-        reader's socket EOF) and remigrate their in-flight ledgers.
-        Called outside the routing lock; inproc replicas never age."""
-        stale = [r for r in self._replicas.values()
-                 if r.state == "serving" and r.transport.alive()
-                 and r.transport.heartbeat_age()
-                 > self.config.heartbeat_dead_after]
-        for rep in stale:
-            kill = getattr(rep.transport, "kill", None)
-            if kill is not None:
-                kill()
-            self._handle_death(rep.transport)
+    def _watchdog_loop(self):
+        while not self._watchdog_stop.wait(self._watchdog_interval):
+            try:
+                self._watchdog()
+            except Exception:   # noqa: BLE001 — a watchdog sweep must
+                pass            # never die; the next tick retries
+
+    def _watchdog(self):
+        """One robustness sweep — runs on every submit, every
+        stats_snapshot, and the background watchdog thread (fleets
+        with process replicas); reentrancy-guarded and called OUTSIDE
+        the routing lock.  Three hunts, all ending in the same death/
+        remigration path so streams never hang:
+
+        1. STALE HEARTBEAT: no beat for `heartbeat_dead_after` — a
+           hung process (a crashed one is caught instantly by socket
+           EOF) — kill + remigrate.
+        2. WEDGE: heartbeats flow but the engine's step-progress stamp
+           is frozen while the replica reports work (`wedge_after_s`)
+           — the heartbeat thread outliving a wedged engine loop —
+           kill + remigrate, counted in fleet.wedge_kill_total.
+        3. ORPHANS: the worker reports idle while ledger entries
+           linger past `orphan_grace_s` (a lost completion event) —
+           remigrate just those streams (the replica stays up)."""
+        if not self._watchdog_gate.acquire(blocking=False):
+            return
+        try:
+            cfg = self.config
+            for rep in list(self._replicas.values()):
+                if rep.state != "serving":
+                    continue
+                t = rep.transport
+                if not t.alive():
+                    continue   # the death path is already running
+                if t.heartbeat_age() > cfg.heartbeat_dead_after:
+                    self._kill_replica(rep)
+                    continue
+                wedged = getattr(t, "wedged", None)
+                if wedged is not None and wedged(cfg.wedge_after_s,
+                                                 cfg.wedge_hard_after_s):
+                    self.metrics.count_wedge_kill()
+                    self._kill_replica(rep)
+                    continue
+                orphans = getattr(t, "take_orphans", None)
+                if orphans is not None:
+                    for entry in orphans(cfg.orphan_grace_s):
+                        self.metrics.count_orphan_remigrated()
+                        self._remigrate_entry(entry, exclude=None)
+        finally:
+            self._watchdog_gate.release()
+
+    def _kill_replica(self, rep):
+        kill = getattr(rep.transport, "kill", None)
+        if kill is not None:
+            kill()
+        self._handle_death(rep.transport)
+
+    def _on_breaker_open(self):
+        self.metrics.count_breaker_open()
 
     def _ladder(self, session, key, candidates, holder=None):
         """The ordered (rung, replica) preference list.  Position 0 is
@@ -608,17 +939,22 @@ class FleetRouter:
                           exclude=None):
         """Run the ladder, count the rung that actually placed the
         request, and return (handle, replica).  Raises ServerBusyError
-        (shed — every candidate's gate closed) or RequestTooLargeError
-        (no candidate could EVER hold it) synchronously."""
+        (shed — every candidate's gate closed, admission OR breaker)
+        or RequestTooLargeError (no candidate could EVER hold it)
+        synchronously.  The routing LOCK covers only the bookkeeping
+        (candidates, index lookup, ladder, session pins); RPCs —
+        page-adoption transfers and the submits themselves — run
+        OUTSIDE it, so one slow replica can never serialize fleet
+        admission."""
         prompt = list(prompt)
-        self._reap_stale_heartbeats()
+        self._watchdog()
         with self._lock:
             if self._closed:
                 raise ServingError("fleet router is shut down")
-            candidates = [r for r in self._candidates(
+            fit = [r for r in self._candidates(
                 len(prompt), kwargs.get("max_new_tokens"))
                 if exclude is None or r.name != exclude]
-            if not candidates:
+            if not fit:
                 if any(r.accepting for r in self._replicas.values()
                        if exclude is None or r.name != exclude):
                     raise RequestTooLargeError(
@@ -626,6 +962,15 @@ class FleetRouter:
                         f"prompt (+{kwargs.get('max_new_tokens')} new)")
                 raise ServingError(
                     "no accepting replica (fleet drained or shut down)")
+            candidates = [r for r in fit if r.breaker.routable(
+                r.transport.heartbeat_age(), self._hb_fresh_s)]
+            if not candidates:
+                # capacity exists but every breaker is open: typed
+                # shed, same as every admission gate closed
+                self.metrics.count_shed()
+                raise ServerBusyError(
+                    f"fleet saturated: every routable replica's "
+                    f"circuit breaker is open ({len(fit)} candidates)")
             key = self._prefix_key(prompt)
             lookup = None
             if self.config.routing == "affinity" \
@@ -634,65 +979,93 @@ class FleetRouter:
                 lookup = self._index_lookup(prompt)
             prefs = self._ladder(session, key, candidates,
                                  holder=lookup[0] if lookup else None)
-            last_busy = None
-            adoption_tried = False
-            for i, (rung, rep) in enumerate(prefs):
-                if not adoption_tried:
-                    # hit-elsewhere: the fleet index says a DIFFERENT
-                    # replica holds this prompt's warm pages — move the
-                    # bytes point-to-point so this replica adopts a run
-                    # it never prefilled, BEFORE admission matches
-                    adoption_tried = self._maybe_adopt_pages(
-                        prompt, rep, lookup)
-                try:
-                    rep.transport.submit(prompt, kwargs, handle)
-                except ServerBusyError as e:
-                    last_busy = e
-                    continue
-                except (RequestTooLargeError, ServingError):
-                    continue   # per-replica edge the pre-filter missed,
-                    # or a transport that died under the submit
-                if i == 0:
-                    self.metrics.count_routed(rung)
-                else:
-                    self.metrics.count_spill()
-                if rung == "prefix" and i == 0:
-                    client = (handle.client_and_delivered()[0]
-                              if isinstance(handle, _MigrationRelay)
-                              else handle)
-                    # hook the confirmation ONLY when this submission
-                    # is the one whose admission will stamp the handle
-                    # (stamp still None), and at most once per client —
-                    # a drain-migrated request re-routed by prefix must
-                    # not fire a second callback against the ORIGINAL
-                    # replica's stamp and double-count a bet the new
-                    # replica never won.  (A started worker can admit
-                    # and stamp between submit and this check; that
-                    # rare race under-counts one confirmation, never
-                    # mis-attributes one.)
-                    if client.prefix_hit_tokens is None and not getattr(
-                            client, "_prefix_confirm_hooked", False):
-                        client._prefix_confirm_hooked = True
-                        client.add_done_callback(self._confirm_prefix)
-                if session is not None:
+        last_busy = None
+        adoption_tried = False
+        for i, (rung, rep) in enumerate(prefs):
+            # submission-time breaker gate: claims the one half-open
+            # probe slot; a breaker that OPENED since the ladder was
+            # built skips the replica
+            if not rep.breaker.admit(rep.transport.heartbeat_age(),
+                                     self._hb_fresh_s):
+                continue
+            if not adoption_tried:
+                # hit-elsewhere: the fleet index says a DIFFERENT
+                # replica holds this prompt's warm pages — move the
+                # bytes point-to-point so this replica adopts a run
+                # it never prefilled, BEFORE admission matches
+                adoption_tried = self._maybe_adopt_pages(
+                    prompt, rep, lookup)
+            try:
+                rep.transport.submit(prompt, kwargs, handle)
+            except ServerBusyError as e:
+                last_busy = e
+                rep.breaker.record_busy()   # load, not breakage
+                continue
+            except RequestTooLargeError:
+                rep.breaker.record_busy()   # capacity edge, not a fault
+                continue
+            except ReplicaTimeoutError:
+                # the submit RPC missed its bounded deadline: fail
+                # fast down the ladder (the ledger entry was popped;
+                # if the op actually landed child-side, its stream
+                # frames find no entry and drop harmlessly)
+                self.metrics.count_replica_timeout()
+                rep.breaker.record_failure()
+                continue
+            except ServingError:
+                rep.breaker.record_failure()
+                continue   # dead channel / transport fault
+            except BaseException:
+                # an UNTYPED exception out of the transport (a child-
+                # side bug rides the reply wire verbatim) is still a
+                # breaker fault — without this, a claimed half-open
+                # probe slot would leak and unroute the replica
+                # forever.  Re-raise: bugs must stay loud.
+                rep.breaker.record_failure()
+                raise
+            rep.breaker.record_success()
+            if i == 0:
+                self.metrics.count_routed(rung)
+            else:
+                self.metrics.count_spill()
+            if rung == "prefix" and i == 0:
+                client = (handle.client_and_delivered()[0]
+                          if isinstance(handle, _MigrationRelay)
+                          else handle)
+                # hook the confirmation ONLY when this submission
+                # is the one whose admission will stamp the handle
+                # (stamp still None), and at most once per client —
+                # a drain-migrated request re-routed by prefix must
+                # not fire a second callback against the ORIGINAL
+                # replica's stamp and double-count a bet the new
+                # replica never won.  (A started worker can admit
+                # and stamp between submit and this check; that
+                # rare race under-counts one confirmation, never
+                # mis-attributes one.)
+                if client.prefix_hit_tokens is None and not getattr(
+                        client, "_prefix_confirm_hooked", False):
+                    client._prefix_confirm_hooked = True
+                    client.add_done_callback(self._confirm_prefix)
+            if session is not None:
+                with self._lock:
                     self._sessions[session] = rep.name
-                # latency measurement: every plainly-submitted request
-                # feeds the serving replica's TTFT EWMA at completion.
-                # Migration relays are skipped — their first_token_s
-                # clock spans two replicas and would smear the signal.
-                if not isinstance(handle, _MigrationRelay) and \
-                        not getattr(handle, "_ttft_hooked", False):
-                    handle._ttft_hooked = True
-                    handle.add_done_callback(rep.observe_ttft)
-                self.metrics.set_replica_queue_depth(rep.name,
-                                                     rep.queue_depth())
-                return handle, rep
-            # every candidate's admission gate is closed: fleet-level
-            # load shed — the ONLY place shed_total increments
-            self.metrics.count_shed()
-            raise ServerBusyError(
-                f"fleet saturated: all {len(prefs)} routable replicas "
-                f"rejected admission") from last_busy
+            # latency measurement: every plainly-submitted request
+            # feeds the serving replica's TTFT EWMA at completion.
+            # Migration relays are skipped — their first_token_s
+            # clock spans two replicas and would smear the signal.
+            if not isinstance(handle, _MigrationRelay) and \
+                    not getattr(handle, "_ttft_hooked", False):
+                handle._ttft_hooked = True
+                handle.add_done_callback(rep.observe_ttft)
+            self.metrics.set_replica_queue_depth(rep.name,
+                                                 rep.queue_depth())
+            return handle, rep
+        # every candidate's admission gate is closed: fleet-level
+        # load shed — the ONLY place shed_total increments
+        self.metrics.count_shed()
+        raise ServerBusyError(
+            f"fleet saturated: all {len(prefs)} routable replicas "
+            f"rejected admission") from last_busy
 
     # --------------------------- client API -------------------------
     def submit(self, prompt, max_new_tokens=None, sampling=None,
@@ -779,6 +1152,8 @@ class FleetRouter:
         self.metrics.count_migrated(len(cold) + len(live_snaps))
         self._page_index.drop_replica(name)
         rep.state = "stopped"
+        rep.respawns = 0   # a clean drain is not a crash: restart
+        # owes no backoff
 
     def _migrate_live(self, snap, exclude):
         """Place one exported resident on a sibling that RESUMES its
@@ -790,13 +1165,19 @@ class FleetRouter:
             cands = sorted(
                 (r for r in self._replicas.values()
                  if r.accepting and r.name != exclude
-                 and r.can_fit(len(snap["tokens"]), remaining)),
+                 and r.can_fit(len(snap["tokens"]), remaining)
+                 and r.breaker.routable(r.transport.heartbeat_age(),
+                                        self._hb_fresh_s)),
                 key=lambda r: r.load())
         for rep in cands:
             try:
                 if rep.transport.import_sequence(snap):
                     self.metrics.count_live_migrated()
                     return
+            except ReplicaTimeoutError:
+                self.metrics.count_replica_timeout()
+                rep.breaker.record_failure()
+                continue
             except ServingError:
                 continue
         # cold fallback: seeded sampling replays the identical stream,
@@ -818,6 +1199,11 @@ class FleetRouter:
             client, delivered = handle.client_and_delivered()
         else:
             client, delivered = handle, int(emitted)
+        # the client's own delivered counter is the replay-skip FLOOR:
+        # no ledger race (a token dispatched while the death path
+        # snapshots the entry) can make a resubmit re-stream a token
+        # the client already received
+        delivered = max(delivered, getattr(client, "n_streamed", 0))
         engine_handle = (_MigrationRelay(client, delivered)
                          if delivered else client)
         self.metrics.count_replay_tokens(delivered)
@@ -845,38 +1231,56 @@ class FleetRouter:
         when a transfer was attempted (success or not — one attempt
         per request), False when not applicable.
 
-        Runs under the routing lock, so a transfer (two RPCs carrying
-        the run's page bytes) briefly serializes admission — fine at
-        this scale; asynchronous adoption (ship after routing, warm
-        the NEXT request instead) is flagged ROADMAP residue for
-        multi-MB production runs."""
+        The byte transfer runs OUTSIDE the routing lock (the ROADMAP
+        carried item): the two RPCs are bounded (RpcPolicy deadlines)
+        and serialize nothing — a hung or dead holder degrades TYPED
+        to the cold-prefill ladder (the request still routes, it just
+        prefills its own prefix) instead of stalling fleet admission
+        behind the transfer.  Only the index bookkeeping touches the
+        lock, briefly."""
         if lookup is None:
             return False
         holder_name, _depth, chain = lookup
-        if holder_name == rep.name \
-                or rep.name in self._page_index.holders_of(chain):
-            return False
-        src = self._replicas.get(holder_name)
-        if src is None or src.state != "serving" \
-                or not src.transport.alive():
-            return False
-        if src._describe["page_size"] != rep._describe["page_size"]:
-            # pages only move between layout-compatible pools; the
-            # importer would reject the payload anyway, so skip the
-            # export round-trip entirely
-            return False
+        with self._lock:
+            if holder_name == rep.name \
+                    or rep.name in self._page_index.holders_of(chain):
+                return False
+            src = self._replicas.get(holder_name)
+            if src is None or src.state != "serving" \
+                    or not src.transport.alive():
+                return False
+            if src._describe["page_size"] != rep._describe["page_size"]:
+                # pages only move between layout-compatible pools; the
+                # importer would reject the payload anyway, so skip the
+                # export round-trip entirely
+                return False
         try:
             payload = src.transport.export_prefix(prompt)
-            if not payload:
-                return True   # evicted since the last delta pull
+        except ReplicaTimeoutError:
+            # bounded-deadline miss: the HOLDER is in trouble, the
+            # request is not — degrade to the cold-prefill ladder and
+            # let the holder's breaker bookkeeping decide its fate
+            self.metrics.count_replica_timeout()
+            src.breaker.record_failure()
+            return True
+        except ServingError:
+            return True
+        if not payload:
+            return True   # evicted since the last delta pull
+        try:
             added = rep.transport.import_prefix(payload)
+        except ReplicaTimeoutError:
+            self.metrics.count_replica_timeout()
+            rep.breaker.record_failure()
+            return True
         except ServingError:
             return True
         if added:
             self.metrics.count_page_adoption(added)
             # eager index update (the importer's own delta confirms on
             # the next pull): back-to-back requests must not re-ship
-            self._page_index.apply(rep.name, [("add", chain)])
+            with self._lock:
+                self._page_index.apply(rep.name, [("add", chain)])
         return True
 
     def _handle_death(self, transport):
@@ -891,10 +1295,20 @@ class FleetRouter:
                     if r.transport is transport), None)
         if rep is None:
             return
+        now = time.monotonic()
         with self._lock:
             if rep.state != "serving":
                 return
             rep.state = "dead"
+            rep.died_at = now
+            # respawn-backoff bookkeeping, counted ONCE per death: a
+            # replica dying within respawn_reset_s of its build is
+            # crash-looping — the streak drives restart()'s
+            # exponential backoff and the crash-loop cap.  A death
+            # after a LONG healthy run resets the streak entirely:
+            # it owes no backoff (the documented contract).
+            quick = now - rep.built_at < self.config.respawn_reset_s
+            rep.respawns = rep.respawns + 1 if quick else 0
             for sess in [s for s, n in self._sessions.items()
                          if n == rep.name]:
                 del self._sessions[sess]
@@ -915,6 +1329,9 @@ class FleetRouter:
             client, delivered = handle.client_and_delivered()
         else:
             client, delivered = handle, int(entry["emitted"])
+        # same floor as _migrate: the client's n_streamed wins over
+        # any stale ledger count
+        delivered = max(delivered, getattr(client, "n_streamed", 0))
         engine_handle = (_MigrationRelay(client, delivered)
                          if delivered else client)
         self.metrics.count_replay_tokens(delivered)
@@ -933,13 +1350,24 @@ class FleetRouter:
         if migrated:
             self.metrics.count_migrated()
 
-    def restart(self, name):
+    def restart(self, name, wait=True):
         """Bring a drained (or dead) replica back: a FRESH engine from
         its spec — new pools, empty prefix index, empty queue, and for
         subprocess replicas a new OS process.  Prefix-affinity bets
         against the old index self-correct through the confirmation
         loop (first request misses, seeds, re-warms) AND through the
-        fleet index, which forgot the old replica at drain/death."""
+        fleet index, which forgot the old replica at drain/death.
+
+        CRASH-LOOP discipline: a replica that DIED within
+        `respawn_reset_s` of its build owes an exponential respawn
+        backoff (`respawn_backoff_s * 2^(streak-1)`, capped at
+        `respawn_backoff_cap_s`) measured from its death — `wait=True`
+        (default) sleeps it off, `wait=False` raises the typed
+        ServingError with the remaining seconds so an external
+        supervisor can reschedule.  A streak past `max_respawns`
+        refuses to respawn at all (typed) until `reset_respawn(name)`:
+        a crash-looping replica must not spin the fleet.  Clean drains
+        owe nothing."""
         with self._lock:
             rep = self._replicas.get(name)
             if rep is None:
@@ -947,9 +1375,50 @@ class FleetRouter:
             if rep.state not in ("stopped", "dead"):
                 raise ServingError(
                     f"replica {name!r} is {rep.state}; drain it first")
+            backoff = 0.0
+            if rep.state == "dead" and rep.respawns:
+                if rep.respawns > self.config.max_respawns:
+                    self.metrics.set_respawn_backoff(
+                        name, self.config.respawn_backoff_cap_s)
+                    raise ServingError(
+                        f"replica {name!r} is crash-looping "
+                        f"({rep.respawns} quick deaths > max_respawns="
+                        f"{self.config.max_respawns}); fix the cause "
+                        f"and reset_respawn({name!r}) to override")
+                backoff = min(
+                    self.config.respawn_backoff_cap_s,
+                    self.config.respawn_backoff_s
+                    * 2 ** (rep.respawns - 1))
+            self.metrics.set_respawn_backoff(name, backoff)
+            remaining = 0.0
+            if backoff and rep.died_at is not None:
+                remaining = rep.died_at + backoff - time.monotonic()
+            if remaining > 0 and not wait:
+                raise ServingError(
+                    f"replica {name!r} owes {remaining:.2f}s of "
+                    f"respawn backoff (streak {rep.respawns}); retry "
+                    f"then, or restart(wait=True)")
+        if remaining > 0:
+            time.sleep(remaining)
+        with self._lock:
+            if rep.state not in ("stopped", "dead"):
+                raise ServingError(
+                    f"replica {name!r} became {rep.state} during the "
+                    f"respawn backoff")
             if rep.state == "dead":
                 rep.transport.stop()   # reap the corpse
             rep.build(self.config.start)
+
+    def reset_respawn(self, name):
+        """Operator override: clear `name`'s crash-loop streak (and
+        its breaker) so the next restart() owes no backoff."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            rep.respawns = 0
+            rep.breaker.reset()
+        self.metrics.set_respawn_backoff(name, 0.0)
 
     # --------------------------- lifecycle --------------------------
     def run_until_idle(self, max_steps=100000):
@@ -981,23 +1450,28 @@ class FleetRouter:
         queue-depth gauges, and the heartbeat-age liveness gauges
         (schema-complete from the first snapshot: 0.0 for inproc
         transports, whose liveness is this process's)."""
-        self._reap_stale_heartbeats()
+        self._watchdog()
         with self._lock:
             self._pull_prefix_deltas()
         replicas = {}
         depths = []
         ages = []
+        breaker_scores = []
         for name, rep in self._replicas.items():
             if rep.state in ("stopped", "dead"):
                 # a stopped replica queues nothing: zero its gauges so
                 # a dashboard never shows pre-drain depth on a dead slot
                 self.metrics.set_replica_queue_depth(name, 0)
                 self.metrics.set_heartbeat_age(name, 0.0)
+                self.metrics.set_breaker_state(name, 0)
                 replicas[name] = {"state": rep.state}
                 continue
             age = rep.transport.heartbeat_age()
             ages.append(age)
             self.metrics.set_heartbeat_age(name, age)
+            score = rep.breaker.score
+            breaker_scores.append(score)
+            self.metrics.set_breaker_state(name, score)
             depth = rep.queue_depth()
             depths.append(depth)
             self.metrics.set_replica_queue_depth(name, depth)
@@ -1015,11 +1489,17 @@ class FleetRouter:
                 "ttft_ewma_s": (None if rep.ttft_ewma is None
                                 else round(rep.ttft_ewma, 4)),
                 "heartbeat_age_s": round(age, 3),
+                "breaker": rep.breaker.state,
+                "respawns": rep.respawns,
+                "rpc_timeouts": getattr(rep.transport,
+                                        "timeout_total", 0),
                 "generation": stats.get("generation", {}),
                 "cache": stats.get("cache", {}),
             }
         self.metrics.set_max_queue_depth(max(depths, default=0))
         self.metrics.set_max_heartbeat_age(max(ages, default=0.0))
+        self.metrics.set_max_breaker_state(max(breaker_scores,
+                                               default=0))
         return {"fleet": self.metrics.snapshot(),
                 "prefix_index_chains": self._page_index.chains_held(),
                 "replicas": replicas}
@@ -1030,6 +1510,9 @@ class FleetRouter:
             if self._closed:
                 return
             self._closed = True
+        self._watchdog_stop.set()
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=5.0)
         for rep in self._replicas.values():
             if rep.state != "stopped":
                 rep.transport.stop()
@@ -1045,4 +1528,5 @@ class FleetRouter:
 
 __all__ = [
     "FleetRouter", "FleetConfig", "FleetMetrics", "ReplicaSpec",
+    "CircuitBreaker",
 ]

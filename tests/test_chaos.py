@@ -1,0 +1,963 @@
+"""Chaos-hardened fleet (ISSUE 15): deterministic fault injection,
+bounded RPC with retry/backoff, per-replica circuit breakers, and the
+wedge watchdog.
+
+Acceptance oracles:
+
+1. NO HANG: under a seeded fault schedule (every fault kind, every
+   named injection point) every client handle resolves — tokens or a
+   typed ServingError — inside a global watchdog; surviving streams
+   are token-identical to the fault-free oracle; drained fleets leak
+   zero pages (tests the serving/disagg/chaos.py drill directly).
+2. WEDGE WATCHDOG: a stalled-but-heartbeating replica (the engine
+   loop wedged, the heartbeat thread alive) is detected, killed, and
+   its in-flight work remigrated exactly like a crash.
+3. BOUNDED RPC: every `_call` carries a deadline (ReplicaTimeoutError,
+   never an unbounded wait); idempotent ops retry with backoff under
+   a bounded attempt budget, non-idempotent ops fail fast.
+4. CIRCUIT BREAKER: consecutive transport faults open it (the replica
+   leaves every routing gate, all-open sheds typed), heartbeat
+   recovery earns a single half-open probe, restart() backs off
+   exponentially and refuses a crash loop.
+
+The unit half runs in-process (socketpairs and bare transports — no
+worker processes); the soak half reuses the dist_capability subprocess
+probe and skips fast where fd-inheriting subprocesses are unavailable.
+"""
+import itertools
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation.engine import GenerationHandle
+from paddle_tpu.parallel import tp_mesh
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.admission import (ReplicaTimeoutError,
+                                          ServerBusyError, ServingError)
+from paddle_tpu.serving.disagg.chaos import (chaos_drill,
+                                             full_matrix_plans,
+                                             kill_stall_plans)
+from paddle_tpu.serving.disagg.faults import (FaultInjected, FaultPlan,
+                                              FaultRule)
+from paddle_tpu.serving.disagg.rpc import recv_frame, send_frame
+from paddle_tpu.serving.disagg.transport import (RETRYABLE_OPS,
+                                                 RpcPolicy,
+                                                 SubprocTransport,
+                                                 build_transport)
+from paddle_tpu.serving.fleet import (CircuitBreaker, FleetConfig,
+                                      FleetRouter, ReplicaSpec)
+
+from dist_capability import (SUBPROC_SKIP_REASON,  # noqa: E402
+                             subprocess_replicas_available)
+from gen_oracle import greedy_oracle as _ref  # noqa: E402
+
+needs_subproc = pytest.mark.skipif(
+    not subprocess_replicas_available(), reason=SUBPROC_SKIP_REASON)
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]   # 3 full pages @ ps=4
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # same signature as the disagg/fleet/prefix suites: the
+    # process-wide greedy_oracle memo shares reference streams
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _cfg(**kw):
+    base = dict(max_decode_slots=4, num_pages=64, page_size=4,
+                prefix_cache=True)
+    base.update(kw)
+    return gen.GenerationConfig(**base)
+
+
+def _fleet(model, n=2, transport="inproc", cfgs=None, start=False,
+           **fleet_kw):
+    cfgs = cfgs or [_cfg() for _ in range(n)]
+    specs = [ReplicaSpec(f"x{i}", model, c, transport=transport)
+             for i, c in enumerate(cfgs)]
+    return FleetRouter(specs, FleetConfig(start=start, seed=0,
+                                          **fleet_kw))
+
+
+def _stat(name):
+    return StatRegistry.instance().get_stat(name).get()
+
+
+class _Shell:
+    """The minimal transport surface FaultPlan.on_send/on_recv touch."""
+
+    def __init__(self, sock):
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self.killed = 0
+        self.stalls = []
+
+    def kill(self):
+        self.killed += 1
+
+    def _send_stall(self, stall_s):
+        self.stalls.append(stall_s)
+
+
+def _bare_transport(sock, rpc=None, faults=None, reader=False):
+    """A SubprocTransport shell over a raw socketpair — the RPC wait/
+    retry/dispatch machinery without any worker process behind it."""
+    t = SubprocTransport.__new__(SubprocTransport)
+    t.name = "bare"
+    t.registry = None
+    t.engine = None
+    t.on_death = None
+    t.rpc = rpc or RpcPolicy(timeout_s=0.2, retries=3, backoff_s=0.01)
+    t._faults = faults
+    t._jitter = random.Random(0)
+    t.timeout_total = 0
+    t._sock = sock
+    t._wlock = threading.Lock()
+    t._lock = threading.Lock()
+    t._ids = itertools.count(1)
+    t._rpc_waits = {}
+    t._inflight = {}
+    t._deltas = []
+    t._load = {"queue_depth": 0, "active": 0, "pages_in_use": 0,
+               "num_pages": 1, "idle": True}
+    t._last_hb = time.monotonic()
+    t._progress_seq = None
+    t._progress_at = time.monotonic()
+    t._in_step = False
+    t._idle_since = None
+    t._dead = threading.Event()
+    t._closing = False
+    t._death_handled = False
+    if reader:
+        threading.Thread(target=t._read_loop, daemon=True).start()
+    return t
+
+
+# ---------------------------- typed errors -------------------------------
+
+
+def test_replica_timeout_error_is_typed():
+    """The new RPC-deadline error is a ServingError (the fleet's
+    remigration ladder catches it) AND a TimeoutError (generic timeout
+    handlers see it), distinct from the client-deadline error."""
+    assert issubclass(ReplicaTimeoutError, ServingError)
+    assert issubclass(ReplicaTimeoutError, TimeoutError)
+    from paddle_tpu.serving.admission import DeadlineExceededError
+    assert not issubclass(ReplicaTimeoutError, DeadlineExceededError)
+
+
+def test_rpc_policy_validation():
+    with pytest.raises(ValueError, match="timeout_s"):
+        RpcPolicy(timeout_s=0)
+    with pytest.raises(ValueError, match="retries"):
+        RpcPolicy(retries=0)
+    with pytest.raises(ValueError, match="backoff_s"):
+        RpcPolicy(backoff_s=-1)
+    assert "submit" not in RETRYABLE_OPS
+    assert "import_seq" not in RETRYABLE_OPS
+    assert {"stats", "load", "export_prefix"} <= RETRYABLE_OPS
+
+
+# ---------------------------- fault plans --------------------------------
+
+
+def test_fault_rule_validation():
+    with pytest.raises(ValueError, match="kind"):
+        FaultRule("submit", "meteor")
+    with pytest.raises(ValueError, match="direction"):
+        FaultRule("submit", "drop", direction="sideways")
+    with pytest.raises(ValueError, match="count"):
+        FaultRule("submit", "drop", count=0)
+
+
+def test_fault_rule_deterministic_window():
+    """A rule fires on exactly its [after, after+count) matching
+    frames, counting ONLY frames that match its point/direction."""
+    rule = FaultRule("submit", "drop", direction="send", after=1,
+                     count=2)
+    rng = random.Random(0)
+    fires = [rule._matches("send", "submit", rng) for _ in range(5)]
+    assert fires == [False, True, True, False, False]
+    # non-matching frames do not advance the window
+    rule2 = FaultRule("submit", "drop", after=1)
+    assert rule2._matches("send", "stats", rng) is False
+    assert rule2._matches("send", "submit", rng) is False   # 0th
+    assert rule2._matches("send", "submit", rng) is True    # 1st
+
+
+def test_fault_plan_seeded_prob_reproducible():
+    """Probabilistic rules draw from the plan's seeded RNG: two plans
+    with the same seed fire on the same frames."""
+    def run(seed):
+        plan = FaultPlan([FaultRule("any", "drop", prob=0.5)],
+                         seed=seed)
+        return [bool(plan._take("send", "submit")) for _ in range(20)]
+
+    assert run(3) == run(3)
+    assert run(3) != run(4)
+
+
+def test_fault_plan_disarmed_passthrough():
+    """A disarmed plan matches nothing and counts nothing — the drill
+    warms its fleet up before the schedule starts ticking."""
+    plan = FaultPlan([FaultRule("submit", "drop", after=0, count=99)],
+                     armed=False)
+    assert plan._take("send", "submit") == []
+    plan.arm()
+    assert len(plan._take("send", "submit")) == 1
+    assert plan.fired_kinds() == ["drop"]
+
+
+def test_faulty_send_drop_dup_delay():
+    """Send-side drop (peer sees nothing), dup (peer sees it twice),
+    delay (the write is held) through the real codec."""
+    a, b = socket.socketpair()
+    b.settimeout(2.0)
+    shell = _Shell(a)
+    plan = FaultPlan([FaultRule("submit", "drop", after=0),
+                      FaultRule("stats", "dup", after=0),
+                      FaultRule("load", "delay", after=0,
+                                delay_s=0.15)])
+    plan.on_send(shell, {"op": "submit", "rid": 1})   # dropped
+    plan.on_send(shell, {"op": "stats", "rid": 2})    # duplicated
+    t0 = time.monotonic()
+    plan.on_send(shell, {"op": "load", "rid": 3})     # delayed
+    assert time.monotonic() - t0 >= 0.15
+    got = [recv_frame(b) for _ in range(3)]
+    assert [g["op"] for g in got] == ["stats", "stats", "load"]
+    a.close()
+    b.close()
+
+
+def test_faulty_send_corrupt_and_truncate_poison_peer():
+    """Corrupt: the peer's unpickle dies (a crashed worker — EOF is
+    the detection).  Truncate: the peer blocks mid-frame, the torn-
+    write wedge that only RPC deadlines catch."""
+    a, b = socket.socketpair()
+    b.settimeout(1.0)
+    shell = _Shell(a)
+    plan = FaultPlan([FaultRule("submit", "corrupt", after=0)], seed=1)
+    plan.on_send(shell, {"op": "submit", "rid": 1, "payload": [1] * 64})
+    with pytest.raises(Exception):   # noqa: B017 — any unpickle error
+        recv_frame(b)
+    a2, b2 = socket.socketpair()
+    b2.settimeout(0.3)
+    shell2 = _Shell(a2)
+    plan2 = FaultPlan([FaultRule("submit", "truncate", after=0)])
+    plan2.on_send(shell2, {"op": "submit", "rid": 1,
+                           "payload": [2] * 64})
+    with pytest.raises(socket.timeout):   # blocked mid-frame forever
+        recv_frame(b2)
+    for s in (a, a2, b2):
+        s.close()
+
+
+def test_faulty_recv_drop_dup_corrupt_kill_stall():
+    """Recv-side faults through on_recv: drop returns no frames, dup
+    returns two, corrupt raises the typed poison, kill/stall call the
+    transport hooks."""
+    a, b = socket.socketpair()
+    shell = _Shell(b)
+    plan = FaultPlan([FaultRule("token", "drop", after=0),
+                      FaultRule("token", "dup", after=1),
+                      FaultRule("done", "kill", after=0),
+                      FaultRule("hb", "stall", after=0, stall_s=7.5),
+                      FaultRule("resp", "corrupt", after=0)])
+    frames = [{"ev": "token", "sid": 1, "t": 5, "n": 0},
+              {"ev": "token", "sid": 1, "t": 6, "n": 1},
+              {"ev": "done", "sid": 1, "result": {}},
+              {"ev": "hb", "load": {}},
+              {"resp": 9, "ok": True}]
+    for f in frames:
+        send_frame(a, f)
+    assert plan.on_recv(shell) == []                      # dropped
+    assert [f["t"] for f in plan.on_recv(shell)] == [6, 6]  # dup
+    assert plan.on_recv(shell)[0]["ev"] == "done"         # + kill
+    assert shell.killed == 1
+    assert plan.on_recv(shell)[0]["ev"] == "hb"           # + stall
+    assert shell.stalls == [7.5]
+    with pytest.raises(FaultInjected):
+        plan.on_recv(shell)
+    a.close()
+    b.close()
+
+
+def test_full_matrix_plans_cover_kinds_and_spare_is_safe():
+    """The drill's default schedule names every kind, and the spare
+    replica carries no fatal rules (survivors need a home)."""
+    plans = full_matrix_plans(5, ["a", "b", "c"])
+    from paddle_tpu.serving.disagg.faults import FATAL_KINDS
+    all_kinds = {r.kind for p in plans.values() for r in p.rules}
+    assert all_kinds == {"drop", "delay", "dup", "corrupt",
+                         "truncate", "kill", "stall"}
+    assert not any(r.kind in FATAL_KINDS for r in plans["a"].rules)
+    with pytest.raises(ValueError, match="2 replicas"):
+        full_matrix_plans(0, ["solo"])
+    # seeded: same seed, same schedule
+    again = full_matrix_plans(5, ["a", "b", "c"])
+    assert [(r.point, r.kind, r.after) for p in plans.values()
+            for r in p.rules] == \
+        [(r.point, r.kind, r.after) for p in again.values()
+         for r in p.rules]
+
+
+# --------------------------- bounded RPC ---------------------------------
+
+
+def test_call_default_deadline_bounded():
+    """_call with timeout=None uses the POLICY deadline — never
+    unbounded — and a miss is the typed ReplicaTimeoutError."""
+    a, b = socket.socketpair()
+    t = _bare_transport(a, rpc=RpcPolicy(timeout_s=0.15, retries=1))
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaTimeoutError, match="deadline"):
+        t._call({"op": "stats"})
+    assert 0.1 < time.monotonic() - t0 < 2.0
+    assert t.timeout_total == 1
+    assert t._rpc_waits == {}   # the wait slot was reclaimed
+    a.close()
+    b.close()
+
+
+def test_idempotent_retry_succeeds_on_late_attempt():
+    """An idempotent op retries under the bounded attempt budget with
+    backoff; the peer answering only the 3rd attempt still succeeds."""
+    a, b = socket.socketpair()
+    t = _bare_transport(a, rpc=RpcPolicy(timeout_s=0.15, retries=3,
+                                         backoff_s=0.01), reader=True)
+    seen = []
+
+    def peer():
+        while len(seen) < 3:
+            frame = recv_frame(b)
+            seen.append(frame)
+            if len(seen) == 3:
+                send_frame(b, {"resp": frame["rid"], "ok": {"n": 42}})
+
+    th = threading.Thread(target=peer, daemon=True)
+    th.start()
+    assert t._call_idempotent({"op": "stats"}) == {"n": 42}
+    assert len(seen) == 3 and t.timeout_total == 2
+    a.close()
+    b.close()
+
+
+def test_non_idempotent_fails_fast_single_attempt():
+    """submit/import_seq never retry: one attempt, one typed error —
+    the remigration ladder owns recovery (a lost reply may mean the op
+    LANDED; re-issuing would double-run it)."""
+    a, b = socket.socketpair()
+    b.settimeout(1.0)
+    t = _bare_transport(a, rpc=RpcPolicy(timeout_s=0.1, retries=3,
+                                         backoff_s=0.01))
+    with pytest.raises(ReplicaTimeoutError):
+        t._call({"op": "submit", "sid": 1, "prompt": [], "kwargs": {}})
+    assert recv_frame(b)["op"] == "submit"
+    b.settimeout(0.2)
+    with pytest.raises(socket.timeout):   # no second attempt on wire
+        recv_frame(b)
+    with pytest.raises(AssertionError):   # and the API refuses it
+        t._call_idempotent({"op": "submit"})
+    a.close()
+    b.close()
+
+
+def test_every_drain_call_site_is_bounded():
+    """Satellite audit regression: no `_call` site may pass an
+    unbounded deadline — drain's longer budget is explicit, shutdown
+    is clamped, and the module never waits on `ev.wait()` bare."""
+    import inspect
+
+    from paddle_tpu.serving.disagg import transport as tr
+    src = inspect.getsource(tr)
+    assert "ev.wait()" not in src
+    # drain opts into timeout + policy — the one allowed longer budget
+    assert "float(timeout) + self.rpc.timeout_s" in src
+
+
+# ------------------------ ordered stream protocol ------------------------
+
+
+def _entry(handle, base=0):
+    return {"prompt": [1], "kwargs": {}, "handle": handle,
+            "emitted": base, "base": base, "next": 0, "ahead": {},
+            "last_event": time.monotonic(), "deadline": None}
+
+
+def test_stream_protocol_dedup_reorder_and_backfill():
+    """Token events carry per-stream indexes: duplicated frames are
+    dropped, an early frame is HELD until its predecessors arrive, and
+    a lost frame is backfilled from the authoritative result at done —
+    the client always sees the exact token sequence, in order."""
+    a, _b = socket.socketpair()
+    t = _bare_transport(a)
+    h = GenerationHandle()
+    t._inflight[7] = _entry(h)
+    t._dispatch({"ev": "token", "sid": 7, "t": 10, "n": 0})
+    t._dispatch({"ev": "token", "sid": 7, "t": 10, "n": 0})   # dup
+    t._dispatch({"ev": "token", "sid": 7, "t": 12, "n": 2})   # early
+    assert t._inflight[7]["next"] == 1   # 12 held, not delivered
+    t._dispatch({"ev": "token", "sid": 7, "t": 11, "n": 1})   # fills
+    assert t._inflight[7]["next"] == 3   # 11 then buffered 12 flushed
+    # token n=3 LOST; done backfills it from the result
+    t._dispatch({"ev": "done", "sid": 7, "prefix_hit": None,
+                 "result": {"token_ids": [10, 11, 12, 13],
+                            "finish_reason": "length",
+                            "prompt_len": 1, "preemptions": 0}})
+    assert h.result(timeout=1).token_ids == [10, 11, 12, 13]
+    assert list(h.tokens(timeout=1)) == [10, 11, 12, 13]
+    assert h.n_streamed == 4
+    a.close()
+    _b.close()
+
+
+def test_stream_backfill_respects_migration_base():
+    """An import-seated stream (live migration) backfills only PAST
+    its base: the client already holds the pre-migration prefix."""
+    a, _b = socket.socketpair()
+    t = _bare_transport(a)
+    h = GenerationHandle()
+    for tok in (20, 21, 22):
+        h._push_token(tok)   # streamed before the migration
+    t._inflight[3] = _entry(h, base=3)
+    t._dispatch({"ev": "token", "sid": 3, "t": 23, "n": 0})
+    t._dispatch({"ev": "done", "sid": 3, "prefix_hit": None,
+                 "result": {"token_ids": [20, 21, 22, 23, 24],
+                            "finish_reason": "length",
+                            "prompt_len": 1, "preemptions": 0}})
+    assert list(h.tokens(timeout=1)) == [20, 21, 22, 23, 24]
+    assert h.n_streamed == 5   # nothing re-pushed, one backfilled
+    a.close()
+    _b.close()
+
+
+# ------------------------- wedge / orphan logic --------------------------
+
+
+def test_wedged_soft_and_hard_clocks():
+    """Soft clock: busy + frozen progress + NOT inside a step.  An
+    engine mid-step (long jit compile) is protected until the hard
+    ceiling."""
+    a, _b = socket.socketpair()
+    t = _bare_transport(a)
+    t._load = dict(t._load, idle=False)
+    t._progress_at = time.monotonic() - 3.0
+    assert t.wedged(2.0)
+    assert not t.wedged(5.0)            # not frozen long enough
+    t._in_step = True
+    assert not t.wedged(2.0)            # compiling is progress
+    assert t.wedged(2.0, hard_after_s=2.5)   # ... up to the ceiling
+    t._in_step = False
+    t._load = dict(t._load, idle=True)
+    assert not t.wedged(0.1)            # idle is never wedged
+    t._dead.set()
+    assert not t.wedged(0.1)
+    a.close()
+    _b.close()
+
+
+def test_take_orphans_requires_idle_worker_and_stale_entry():
+    """The orphan sweep only claims entries when the worker has
+    reported idle past the grace AND the entry saw no event for the
+    grace — a busy worker or a fresh submit is never stolen."""
+    a, _b = socket.socketpair()
+    t = _bare_transport(a)
+    h = GenerationHandle()
+    entry = _entry(h)
+    entry["last_event"] = time.monotonic() - 5.0
+    t._inflight[1] = entry
+    assert t.take_orphans(2.0) == []        # worker not idle
+    t._idle_since = time.monotonic() - 3.0
+    fresh = _entry(GenerationHandle())      # just submitted
+    t._inflight[2] = fresh
+    orphans = t.take_orphans(2.0)
+    assert orphans == [entry]               # stale one only
+    assert list(t._inflight) == [2]
+    a.close()
+    _b.close()
+
+
+# --------------------------- circuit breaker -----------------------------
+
+
+def test_circuit_breaker_state_machine():
+    opened = []
+    b = CircuitBreaker(threshold=2, cooldown_s=0.05,
+                       on_open=lambda: opened.append(1))
+    assert b.state == "closed" and b.routable() and b.admit()
+    b.record_failure()
+    assert b.state == "closed"          # below threshold
+    b.record_failure()
+    assert b.state == "open" and opened == [1]
+    assert not b.routable(hb_age=0.0)   # cooldown not elapsed
+    time.sleep(0.06)
+    assert not b.routable(hb_age=99.0)  # no heartbeat recovery
+    assert b.routable(hb_age=0.0)
+    assert b.admit(hb_age=0.0)          # claims THE half-open probe
+    assert b.state == "half-open"
+    assert not b.admit(hb_age=0.0)      # second probe refused
+    b.record_failure()                  # probe failed -> reopen
+    assert b.state == "open" and opened == [1, 1]
+    time.sleep(0.06)
+    assert b.admit(hb_age=0.0)
+    b.record_success()
+    assert b.state == "closed" and b.failures == 0
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+
+
+def test_breaker_busy_releases_probe_without_fault():
+    """ServerBusyError is back-pressure, not breakage: it releases a
+    claimed half-open probe and never counts toward the threshold."""
+    b = CircuitBreaker(threshold=2, cooldown_s=0.0)
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "open"
+    assert b.admit(hb_age=0.0)
+    b.record_busy()                     # busy probe: state unchanged,
+    assert b.state == "half-open"       # slot released
+    assert b.admit(hb_age=0.0)
+    b.record_success()
+    assert b.state == "closed"
+    for _ in range(100):
+        b.record_busy()
+    assert b.state == "closed" and b.failures == 0
+
+
+def test_breaker_gates_routing_and_all_open_sheds_typed(model):
+    """An open breaker takes its replica out of every routing gate;
+    every breaker open is the typed fleet shed; a healthy sibling
+    keeps serving."""
+    fl = _fleet(model, breaker_threshold=1, breaker_cooldown_s=30.0)
+    victim = fl._replicas["x0"]
+    victim.breaker.record_failure()
+    assert victim.breaker.state == "open"
+    h = fl.submit(SYSTEM, max_new_tokens=2)
+    fl.run_until_idle()
+    h.result(timeout=5)
+    snap = fl.stats_snapshot()
+    assert snap["replicas"]["x0"]["generation"] \
+        .get("generation.requests_total", 0) == 0
+    assert snap["replicas"]["x0"]["breaker"] == "open"
+    assert snap["fleet"][fleet_mod.BREAKER_STATE + ".x0"] == 2
+    fl._replicas["x1"].breaker.record_failure()
+    with pytest.raises(ServerBusyError, match="circuit breaker"):
+        fl.submit(SYSTEM, max_new_tokens=2)
+    assert _stat(fleet_mod.SHED_TOTAL) == 1
+    assert _stat(fleet_mod.BREAKER_OPEN_TOTAL) == 2
+    fl.shutdown()
+
+
+def test_breaker_half_open_probe_recovers_inproc(model):
+    """After the cooldown (inproc heartbeats are always fresh) ONE
+    probe request flows; its success closes the breaker for good."""
+    fl = _fleet(model, n=1, breaker_threshold=1,
+                breaker_cooldown_s=0.02)
+    rep = fl._replicas["x0"]
+    rep.breaker.record_failure()
+    assert rep.breaker.state == "open"
+    with pytest.raises(ServerBusyError):
+        fl.submit(SYSTEM, max_new_tokens=2)   # still cooling down
+    time.sleep(0.03)
+    h = fl.submit(SYSTEM, max_new_tokens=2)   # the half-open probe
+    assert rep.breaker.state == "closed"      # submit ack == success
+    fl.run_until_idle()
+    assert h.result(timeout=5).token_ids == _ref(model, SYSTEM, 2)
+    fl.shutdown()
+
+
+# ------------------------ respawn backoff / crash loop -------------------
+
+
+def test_restart_backoff_exponential_cap_and_crash_loop(model):
+    fl = _fleet(model, respawn_backoff_s=0.05, respawn_backoff_cap_s=0.2,
+                max_respawns=3, respawn_reset_s=1000.0)
+    rep = fl._replicas["x0"]
+
+    def die():
+        rep.state = "serving"
+        fl._handle_death(rep.transport)
+        assert rep.state == "dead"
+
+    die()
+    assert rep.respawns == 1
+    rep.died_at = time.monotonic()   # backoff measured from death
+    with pytest.raises(ServingError, match="backoff"):
+        fl.restart("x0", wait=False)
+    t0 = time.monotonic()
+    fl.restart("x0", wait=True)      # sleeps the ~0.05s remainder
+    assert time.monotonic() - t0 >= 0.02
+    assert rep.state == "serving"
+    # streak grows the backoff exponentially, capped
+    die()
+    assert rep.respawns == 2
+    assert _stat(fleet_mod.REPLICA_DEAD_TOTAL) == 2
+    fl.restart("x0", wait=True)
+    die()
+    die_backoff = min(0.2, 0.05 * 2 ** 2)
+    fl.restart("x0", wait=True)
+    assert _stat(fleet_mod.RESPAWN_BACKOFF_S + ".x0") == die_backoff
+    die()
+    with pytest.raises(ServingError, match="crash-looping"):
+        fl.restart("x0")             # respawns=4 > max_respawns=3
+    assert rep.state == "dead"
+    fl.reset_respawn("x0")
+    assert rep.respawns == 0
+    fl.restart("x0", wait=True)
+    assert rep.state == "serving"
+    fl.shutdown()
+
+
+def test_clean_drain_owes_no_backoff(model):
+    fl = _fleet(model, respawn_backoff_s=60.0)
+    fl._replicas["x0"].respawns = 2   # residue from earlier crashes
+    fl.drain("x0")
+    assert fl._replicas["x0"].respawns == 0
+    t0 = time.monotonic()
+    fl.restart("x0", wait=True)       # instant: no backoff owed
+    assert time.monotonic() - t0 < 1.0
+    fl.shutdown()
+
+
+# ----------------------------- config / metrics --------------------------
+
+
+def test_fleet_config_validation_new_knobs():
+    with pytest.raises(ValueError, match="timeout_s"):
+        FleetConfig(rpc_timeout_s=0)
+    with pytest.raises(ValueError, match="retries"):
+        FleetConfig(rpc_retries=0)
+    with pytest.raises(ValueError, match="breaker_threshold"):
+        FleetConfig(breaker_threshold=0)
+    with pytest.raises(ValueError, match="wedge_after_s"):
+        FleetConfig(wedge_after_s=0)
+    with pytest.raises(ValueError, match="wedge_hard_after_s"):
+        FleetConfig(wedge_hard_after_s=-1)
+    with pytest.raises(ValueError, match="max_respawns"):
+        FleetConfig(max_respawns=0)
+    with pytest.raises(ValueError, match="watchdog_interval_s"):
+        FleetConfig(watchdog_interval_s=0)
+    assert FleetConfig(wedge_hard_after_s=None).wedge_hard_after_s \
+        is None
+
+
+def test_fault_plan_config_plumbing(model):
+    """fault_plans must name known replicas and require the proc
+    transport — an inproc replica has no wire to fault."""
+    with pytest.raises(ValueError, match="unknown replicas"):
+        _fleet(model, fault_plans={"ghost": FaultPlan([])})
+    with pytest.raises(ValueError, match="no wire"):
+        _fleet(model, fault_plans={"x0": FaultPlan([])})
+    with pytest.raises(ValueError, match="no wire"):
+        build_transport(ReplicaSpec("i", model, _cfg()), "inproc",
+                        fault_plan=FaultPlan([]))
+
+
+def test_robustness_metrics_schema_complete_and_zeroed_inproc(model):
+    """The new fleet.* keys are all present from the FIRST snapshot,
+    zeroed for an all-inproc fleet (no RPC, no faults)."""
+    fl = _fleet(model)
+    snap = fl.stats_snapshot()
+    fsnap = snap["fleet"]
+    for key in (fleet_mod.BREAKER_OPEN_TOTAL, fleet_mod.BREAKER_STATE,
+                fleet_mod.REPLICA_TIMEOUT_TOTAL,
+                fleet_mod.WEDGE_KILL_TOTAL,
+                fleet_mod.ORPHAN_REMIGRATED_TOTAL,
+                fleet_mod.RESPAWN_BACKOFF_S):
+        assert key in fsnap, key
+        assert fsnap[key] == 0
+    for name in ("x0", "x1"):
+        rep = snap["replicas"][name]
+        assert rep["breaker"] == "closed"
+        assert rep["respawns"] == 0
+        assert rep["rpc_timeouts"] == 0
+    fl.shutdown()
+
+
+# ---------------------- adoption outside the lock ------------------------
+
+
+def test_adoption_runs_outside_routing_lock_and_degrades_typed(model):
+    """The satellite: the page-transfer RPCs run OUTSIDE the routing
+    lock, and a timed-out holder degrades the request to the
+    cold-prefill ladder — typed, counted, admission never stalled."""
+    fl = _fleet(model)
+    h1 = fl.submit(SYSTEM + [7], max_new_tokens=4)
+    fl.run_until_idle()
+    h1.result(timeout=5)
+    counts = {n: r.get("generation", {})
+              .get("generation.requests_total", 0)
+              for n, r in fl.stats_snapshot()["replicas"].items()}
+    holder = max(counts, key=counts.get)
+    other = next(n for n in fl._replicas if n != holder)
+    lock_held = []
+
+    def boom(tokens):
+        lock_held.append(fl._lock.locked())
+        raise ReplicaTimeoutError("export deadline (chaos)")
+
+    fl._replicas[holder].transport.export_prefix = boom
+    fl._sessions["pin"] = other
+    h2 = fl.submit(SYSTEM + [9, 9], max_new_tokens=4, session="pin")
+    fl.run_until_idle()
+    assert h2.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [9, 9], 4)
+    assert lock_held == [False]   # byte transfer outside the lock
+    assert h2.prefix_hit_tokens == 0          # served cold, not hung
+    assert _stat(fleet_mod.REPLICA_TIMEOUT_TOTAL) == 1
+    assert _stat(fleet_mod.PAGE_ADOPTIONS) == 0
+    assert fl._replicas[holder].breaker.failures == 1
+    fl.shutdown()
+
+
+# ------------------- crash-during-import consistency ---------------------
+
+
+@pytest.mark.parametrize("seam", ["adopt", "place"])
+@pytest.mark.parametrize("layout,kv_dtype", [
+    ("token", None), ("token", "int8"), ("kernel", "int8")])
+def test_import_failure_leaves_pools_consistent(model, layout,
+                                                kv_dtype, seam):
+    """Satellite: a failure injected mid-`import_sequence` (the
+    surviving half of a crash-during-import) leaves the importer
+    refusing TYPED (False -> cold ladder) with ZERO leaked pages and
+    the engine still able to adopt for real — across layouts x int8,
+    whether the install died BEFORE the pages attached to a sequence
+    (`adopt`) or after (`place`)."""
+    kw = dict(kv_backend="device", pool_layout=layout)
+    if kv_dtype:
+        kw["kv_dtype"] = kv_dtype
+    a = gen.GenerationEngine(model, _cfg(**kw), start=False)
+    h = a.submit(SYSTEM + [7, 7], max_new_tokens=8)
+    for _ in range(4):
+        a.step()
+    _, live = a.evacuate_for_migration()
+    snap = live[0]
+    b = gen.GenerationEngine(model, _cfg(**kw), start=False)
+    target = (b.cache if seam == "adopt" else b.scheduler)
+    attr = "adopt_imported" if seam == "adopt" else "place_imported"
+    orig = getattr(target, attr)
+    calls = []
+
+    def boom(*args, **kwargs):
+        # fail the FIRST install only: the recovery rollback (which
+        # reuses cache plumbing) must run clean, exactly as it would
+        # when the fault was a poisoned snapshot, not a dead pool
+        if not calls:
+            calls.append(1)
+            raise RuntimeError("chaos: killed mid-install")
+        return orig(*args, **kwargs)
+
+    setattr(target, attr, boom)
+    assert b.import_sequence(dict(snap)) is False
+    assert calls and b.cache.pages_in_use == 0   # nothing leaked
+    setattr(target, attr, orig)
+    # the pool was not poisoned: the real import adopts and RESUMES
+    assert b.import_sequence(snap) is True
+    b.run_until_idle()
+    assert h.result(timeout=5).token_ids == \
+        _ref(model, SYSTEM + [7, 7], 8)
+    b.cache.flush_prefix_cache()
+    assert b.cache.pages_in_use == 0
+    a.shutdown()
+    b.shutdown()
+
+
+def test_import_failure_consistent_on_mesh():
+    """The 4-dev CPU mesh cell of the same satellite: the donated
+    sharded import path rolls back cleanly too."""
+    model4 = gen.TinyCausalLM(vocab_size=32, num_layers=2, num_heads=4,
+                              head_dim=8, seed=5)
+    mesh = tp_mesh(4)
+    kw = dict(kv_backend="device", mesh=mesh)
+    a = gen.GenerationEngine(model4, _cfg(**kw), start=False)
+    h = a.submit(SYSTEM + [2], max_new_tokens=6)
+    for _ in range(4):
+        a.step()
+    _, live = a.evacuate_for_migration()
+    snap = live[0]
+    b = gen.GenerationEngine(model4, _cfg(**kw), start=False)
+    orig = b.cache.adopt_imported
+    calls = []
+
+    def boom(*args, **kwargs):
+        if not calls:
+            calls.append(1)
+            raise RuntimeError("chaos: killed mid-install")
+        return orig(*args, **kwargs)
+
+    b.cache.adopt_imported = boom
+    assert b.import_sequence(dict(snap)) is False
+    assert b.cache.pages_in_use == 0
+    b.cache.adopt_imported = orig
+    assert b.import_sequence(snap) is True
+    b.run_until_idle()
+    assert h.result(timeout=5).token_ids == \
+        _ref(model4, SYSTEM + [2], 6)
+    a.shutdown()
+    b.shutdown()
+
+
+# --------------------------- chaos soak drills ---------------------------
+
+
+@needs_subproc
+def test_chaos_drill_full_matrix_deterministic(model):
+    """THE acceptance soak: the seeded full kind x point matrix over a
+    3-replica subprocess fleet — no stream hangs, survivors are
+    token-identical to the fault-free oracle, zero pages leak.  The
+    assertions live INSIDE chaos_drill; the report's fired log proves
+    the schedule actually exercised the faults."""
+    report = chaos_drill(model, seed=11, n_replicas=3, n_requests=8,
+                         new_tokens=8, watchdog_s=120.0,
+                         restart_dead=True)
+    assert report["hung"] == 0
+    assert report["leaked_pages"] == 0
+    assert report["resolved_ok"] + report["resolved_typed_error"] == 8
+    assert report["token_identical"] == report["resolved_ok"]
+    fired = {k for kinds in report["faults_fired"].values()
+             for k in kinds}
+    assert fired   # the schedule genuinely ran faults into the fleet
+
+
+@needs_subproc
+def test_chaos_drill_kill_and_stall_schedule(model):
+    """The gen_bench --chaos schedule: a mid-stream SIGKILL plus a
+    stalled-but-heartbeating engine.  The wedge watchdog converts the
+    stall into a death (wedge_kill_total), remigration keeps every
+    stream intact, and the books balance."""
+    plans = kill_stall_plans(7, ["c0", "c1", "c2"])
+    report = chaos_drill(model, seed=7, n_replicas=3, n_requests=6,
+                         new_tokens=8, plans=plans, watchdog_s=120.0)
+    assert report["hung"] == 0 and report["leaked_pages"] == 0
+    assert report["resolved_ok"] + report["resolved_typed_error"] == 6
+    assert report["token_identical"] == report["resolved_ok"]
+    assert report["wedge_kill_total"] >= 1       # the stall was CAUGHT
+    assert report["replica_dead_total"] >= 1
+    assert "stall" in {k for ks in report["faults_fired"].values()
+                       for k in ks}
+
+
+@needs_subproc
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_chaos_drill_int8_pools(model, layout):
+    """Acceptance sweep: the drill holds across both device pool
+    layouts x int8 — scale payloads ride every remigration and the
+    quantized pools leak nothing under kill + stall."""
+    plans = kill_stall_plans(3, ["c0", "c1"])
+    report = chaos_drill(
+        model, seed=3, n_replicas=2, n_requests=4, new_tokens=6,
+        plans=plans, watchdog_s=120.0,
+        engine_kw=dict(kv_backend="device", pool_layout=layout,
+                       kv_dtype="int8"))
+    assert report["hung"] == 0 and report["leaked_pages"] == 0
+    assert report["resolved_ok"] + report["resolved_typed_error"] == 4
+    assert report["token_identical"] == report["resolved_ok"]
+
+
+@needs_subproc
+def test_dropped_done_event_orphan_remigrated(model):
+    """A lost completion event (drop the `done` frame) leaves a
+    lingering ledger entry on an idle worker: the watchdog's orphan
+    sweep remigrates it — the stream resolves token-identical instead
+    of hanging forever."""
+    plan = FaultPlan([FaultRule("done", "drop", direction="recv",
+                                after=0)])
+    report = chaos_drill(model, seed=5, n_replicas=2, n_requests=3,
+                         new_tokens=6, plans={"c1": plan},
+                         watchdog_s=120.0)
+    assert report["hung"] == 0
+    assert report["resolved_ok"] == 3 == report["token_identical"]
+    if "drop" in {k for ks in report["faults_fired"].values()
+                  for k in ks}:
+        assert report["orphan_remigrated_total"] >= 1
+
+
+@needs_subproc
+def test_rpc_timeouts_open_breaker_then_recover(model):
+    """Dropped submit frames time out typed (bounded RPC), consecutive
+    timeouts OPEN the replica's breaker (it leaves the routing gates),
+    and after the schedule drains + cooldown a half-open probe brings
+    it back — no stream ever hangs on the way."""
+    specs = [ReplicaSpec(f"c{i}", model, _cfg()) for i in range(2)]
+    plan = FaultPlan([FaultRule("submit", "drop", direction="send",
+                                after=0, count=2)])
+    fl = FleetRouter(specs, FleetConfig(
+        seed=0, transport="proc", rpc_timeout_s=0.4, rpc_retries=2,
+        breaker_threshold=2, breaker_cooldown_s=0.3,
+        fault_plans={"c1": plan}))
+    try:
+        victim = fl._replicas["c1"]
+        for i in range(2):
+            fl._sessions[f"s{i}"] = "c1"
+            h = fl.submit(SYSTEM + [i], max_new_tokens=4,
+                          session=f"s{i}")
+            # the pinned submit timed out, the ladder placed it on c0
+            assert h.result(timeout=60).token_ids == \
+                _ref(model, SYSTEM + [i], 4)
+        assert victim.breaker.state == "open"
+        assert _stat(fleet_mod.BREAKER_OPEN_TOTAL) == 1
+        assert _stat(fleet_mod.REPLICA_TIMEOUT_TOTAL) >= 2
+        time.sleep(0.4)   # cooldown; heartbeats kept flowing
+        fl._sessions["s9"] = "c1"
+        h = fl.submit(SYSTEM + [9], max_new_tokens=4, session="s9")
+        assert h.result(timeout=60).token_ids == \
+            _ref(model, SYSTEM + [9], 4)
+        assert victim.breaker.state == "closed"   # probe succeeded
+    finally:
+        fl.shutdown()
+
+
+@needs_subproc
+def test_kill_during_export_degrades_adoption_cold(model):
+    """Satellite (crash-during-export): the holder dies the instant
+    the router asks it to export a warm run — the adoption degrades
+    typed, the request completes COLD and token-identical on the
+    chosen replica, and the death is handled like any crash."""
+    specs = [ReplicaSpec(f"c{i}", model, _cfg()) for i in range(2)]
+    plan = FaultPlan([FaultRule("export_prefix", "kill",
+                                direction="send", after=0)])
+    fl = FleetRouter(specs, FleetConfig(seed=0, transport="proc",
+                                        rpc_timeout_s=5.0,
+                                        fault_plans={"c0": plan},
+                                        heartbeat_dead_after=10.0))
+    try:
+        fl._sessions["seed"] = "c0"
+        h1 = fl.submit(SYSTEM + [7], max_new_tokens=4, session="seed")
+        h1.result(timeout=60)
+        # wait for c0's registration deltas to reach the fleet index
+        deadline = time.monotonic() + 15
+        while fl._page_index.lookup(SYSTEM + [9], 4) is None \
+                and time.monotonic() < deadline:
+            fl.stats_snapshot()
+            time.sleep(0.05)
+        assert fl._page_index.lookup(SYSTEM + [9], 4) is not None
+        fl._sessions["pin"] = "c1"
+        h2 = fl.submit(SYSTEM + [9], max_new_tokens=4, session="pin")
+        assert h2.result(timeout=60).token_ids == \
+            _ref(model, SYSTEM + [9], 4)
+        assert h2.prefix_hit_tokens == 0     # cold: the export died
+        assert _stat(fleet_mod.PAGE_ADOPTIONS) == 0
+        deadline = time.monotonic() + 15
+        while fl._replicas["c0"].state != "dead" \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fl._replicas["c0"].state == "dead"
+    finally:
+        fl.shutdown()

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Seeded chaos soak over a subprocess fleet — the CLI half of
+paddle_tpu.serving.disagg.chaos.
+
+Runs the fault schedule (full kind x point matrix by default, or the
+kill+stall schedule with --schedule kill-stall) against a
+process-per-replica fleet and prints the JSON report: resolve/typed/
+hung counts, oracle token identity, leak check, stream-gap
+percentiles, recovery wall, and every fleet.* robustness counter.
+A non-zero exit means an INVARIANT broke (hung stream, diverged
+stream, leaked pages) — this is the command a CI chaos stage runs.
+
+    python tools/chaos_drill.py --seed 7 --replicas 3 --requests 8
+    python tools/chaos_drill.py --schedule kill-stall --kv-dtype int8 \
+        --pool-layout kernel
+
+Docs: docs/SERVING.md "Failure model".
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prompt-tokens", type=int, default=24)
+    ap.add_argument("--new-tokens", type=int, default=10)
+    ap.add_argument("--schedule", choices=("matrix", "kill-stall"),
+                    default="matrix",
+                    help="'matrix' = every fault kind at every named "
+                         "protocol point; 'kill-stall' = the "
+                         "gen_bench --chaos schedule")
+    ap.add_argument("--kv-dtype", choices=("fp32", "bfloat16", "int8"),
+                    default="fp32",
+                    help="replica KV pool precision (int8 exercises "
+                         "the scale-carrying migration payloads under "
+                         "chaos)")
+    ap.add_argument("--pool-layout", choices=("token", "kernel"),
+                    default=None,
+                    help="device-pool layout (implies "
+                         "kv_backend='device')")
+    ap.add_argument("--watchdog-s", type=float, default=120.0,
+                    help="global no-hang budget per stream")
+    ap.add_argument("--restart-dead", action="store_true",
+                    help="restart dead replicas at the end (exercises "
+                         "the respawn-backoff ladder)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from paddle_tpu.generation import TinyCausalLM
+    from paddle_tpu.serving.disagg.chaos import (chaos_drill,
+                                                 full_matrix_plans,
+                                                 kill_stall_plans)
+
+    model = TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                         head_dim=8, seed=3)
+    names = [f"c{i}" for i in range(args.replicas)]
+    plans = (kill_stall_plans(args.seed, names)
+             if args.schedule == "kill-stall"
+             else full_matrix_plans(args.seed, names))
+    engine_kw = {}
+    if args.pool_layout is not None:
+        engine_kw.update(kv_backend="device",
+                         pool_layout=args.pool_layout)
+    if args.kv_dtype != "fp32":
+        engine_kw["kv_dtype"] = args.kv_dtype
+        engine_kw.setdefault("kv_backend", "device")
+    try:
+        report = chaos_drill(
+            model, seed=args.seed, n_replicas=args.replicas,
+            n_requests=args.requests,
+            prompt_tokens=args.prompt_tokens,
+            new_tokens=args.new_tokens, plans=plans,
+            engine_kw=engine_kw or None,
+            watchdog_s=args.watchdog_s,
+            restart_dead=args.restart_dead)
+    except AssertionError as e:
+        print(json.dumps({"drill": "chaos", "schedule": args.schedule,
+                          "invariant_broken": str(e)}))
+        return 1
+    report = {"drill": "chaos", "schedule": args.schedule,
+              "kv_dtype": args.kv_dtype,
+              "pool_layout": args.pool_layout, **report}
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -875,6 +875,32 @@ def bench_spec(model, batch, context, new_tokens, page_size, spec_mode,
     return cell
 
 
+def bench_chaos(model, seed, n_replicas, requests, new_tokens):
+    """The chaos-soak bench cell: a seeded KILL + STALL schedule over
+    a subprocess fleet under concurrent streams (serving/disagg/
+    chaos.py drill) — stream-gap p50/p95 across the faults, recovery
+    wall, breaker trips, wedge kills, replay tokens, and the no-hang/
+    no-leak/token-identity invariants as cell facts.  Environments
+    without fd-inheriting subprocesses emit a skipped cell instead of
+    sinking the whole artifact."""
+    from paddle_tpu.serving.disagg.chaos import (chaos_drill,
+                                                 kill_stall_plans)
+
+    names = [f"c{i}" for i in range(n_replicas)]
+    try:
+        report = chaos_drill(
+            model, seed=seed, n_replicas=n_replicas,
+            n_requests=requests, new_tokens=new_tokens,
+            plans=kill_stall_plans(seed, names), watchdog_s=120.0,
+            restart_dead=True)
+    except AssertionError as e:
+        return {"cell": "chaos", "invariant_broken": str(e)}
+    except Exception as e:   # noqa: BLE001 — a sandbox without
+        # subprocess replicas must not sink the artifact
+        return {"cell": "chaos", "skipped": f"{type(e).__name__}: {e}"}
+    return {"cell": "chaos", "schedule": "kill+stall", **report}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="1,4,8")
@@ -1001,6 +1027,16 @@ def main():
                          "collective_bytes_per_step ~4x lower, "
                          "collective_quantized=1 stamped — paired "
                          "against its fp32-collective sibling")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos-soak cell: a seeded kill+stall fault "
+                         "schedule over a 3-replica subprocess fleet "
+                         "under concurrent streams — stream-gap "
+                         "p50/p95, recovery wall, breaker trips, "
+                         "wedge kills, replay tokens; the cell also "
+                         "asserts the no-hang / token-identity / "
+                         "zero-leak invariants")
+    ap.add_argument("--chaos-seed", type=int, default=7,
+                    help="fault-schedule seed for --chaos")
     ap.add_argument("--long-context", type=int, default=None,
                     help="long-prompt length for the interleave cell "
                          "(default: 8x the largest --contexts entry)")
@@ -1263,6 +1299,12 @@ def main():
                     model, transport, live, sys_tokens,
                     max(32, args.new_tokens), args.page_size,
                     args.chunk_tokens))
+    if args.chaos:
+        # the chaos soak: seeded kill+stall over a subprocess fleet —
+        # the robustness sibling of the drain probe (faults INJECTED,
+        # not administered)
+        grid.append(bench_chaos(model, args.chaos_seed, 3, 8,
+                                max(8, min(16, args.new_tokens))))
     doc = {
         "bench": "generation_decode",
         "platform": jax.devices()[0].platform,
@@ -1278,6 +1320,7 @@ def main():
         "prefix": args.prefix,
         "replicas": args.replicas,
         "fleet_transport": args.fleet_transport,
+        "chaos": bool(args.chaos),
         "grid": grid,
         "stats": stats_by_series,
     }

@@ -63,16 +63,19 @@ for i in $(seq 1 "$MAX"); do
     # budget grew with the prefix + fleet + ragged + disagg A/B cells
     # (--fleet-transport both adds proc-replica fleets — each child
     # process pays its own jax import — plus 4 drain-migration probe
-    # cells): a timeout kill here drops the WHOLE gen artifact
-    # (mesh/prefill numbers included), so the cap tracks the scenario
-    # count and a kill at least says so
-    timeout 5100 python tools/gen_bench.py --pool both --decode both \
+    # cells, plus the --chaos soak cell: a seeded kill+stall schedule
+    # over a 3-replica subprocess fleet reporting stream-gap p50/p95,
+    # recovery wall, breaker trips and replay tokens under the
+    # no-hang/no-leak invariants): a timeout kill here drops the
+    # WHOLE gen artifact (mesh/prefill numbers included), so the cap
+    # tracks the scenario count and a kill at least says so
+    timeout 5700 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
       --step both --fleet-transport both \
-      --kv-quant both --quant-collectives --spec both \
+      --kv-quant both --quant-collectives --spec both --chaos \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + kv-quant + quant-collectives + spec A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + kv-quant + quant-collectives + spec + chaos A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
