@@ -373,7 +373,7 @@ class GenerationResult:
 
     def __init__(self, token_ids, finish_reason, prompt_len, preemptions):
         self.token_ids = list(token_ids)
-        self.finish_reason = finish_reason  # "stop" | "length"
+        self.finish_reason = finish_reason  # "stop"|"length"|"cancelled"
         self.prompt_len = prompt_len
         self.preemptions = preemptions
 
@@ -760,6 +760,16 @@ class GenerationEngine:
         # model")
         self._step_seq = 0
         self._in_step = False
+        # P/D disaggregation seam (serving/disagg): a PREFILL-class
+        # engine parks every sequence the moment its prompt is
+        # consumed — exported as a live-migration snapshot into
+        # _handoff_out instead of decoding here — and `on_handoff` is
+        # notified AFTER the step lock is released (pull model: the
+        # collector drains take_handoffs(), so no router lock is ever
+        # taken under the engine lock)
+        self._handoff = False
+        self.on_handoff = None
+        self._handoff_out = []
         self._closed = False
         self._stop = threading.Event()
         self._thread = None
@@ -893,7 +903,10 @@ class GenerationEngine:
         the way."""
         with self._lock:
             cold = self.scheduler.take_pending()
-            live = []
+            # snaps already parked for P/D handoff but not yet
+            # collected ride the live list unchanged — they hold page
+            # BYTES, not pool pages, so this can never leak
+            live, self._handoff_out = self._handoff_out, []
             for state in self.scheduler.active():
                 if state.request.expired():
                     self.scheduler.retire(state)
@@ -1056,6 +1069,21 @@ class GenerationEngine:
                     time.sleep(0.005)
                 else:
                     self.step()   # stepped mode: the drain drives them
+        # P/D: handoff snaps still parked when the drain ends must
+        # leave with everything else (a prefill engine's residents
+        # land here by construction — they never finish locally)
+        with self._lock:
+            parked, self._handoff_out = self._handoff_out, []
+        for snap in parked:
+            if live:
+                live_snaps.append(snap)
+            else:
+                req = GenerationRequest(
+                    snap["prompt"], snap["future"], snap["sampling"],
+                    max_new_tokens=snap["max_new_tokens"],
+                    stop_tokens=snap["stop_tokens"],
+                    deadline=snap.get("deadline"))
+                cold.append((req, int(snap["n_generated"])))
         self.shutdown()
         return cold, live_snaps
 
@@ -1084,7 +1112,11 @@ class GenerationEngine:
             "active": len(sched.active()),
             "pages_in_use": self.cache.pages_in_use,
             "num_pages": self.cache.num_pages,
-            "idle": not (sched.active() or sched.pending_count()),
+            # parked handoffs are unfinished work: a prefill replica
+            # with uncollected snaps must not read as idle (the orphan
+            # sweep and run_until_idle both key off this)
+            "idle": not (sched.active() or sched.pending_count()
+                         or self._handoff_out),
         }
 
     def export_prefix_pages(self, tokens):
@@ -1127,6 +1159,91 @@ class GenerationEngine:
             except (OutOfPagesError, ValueError):
                 return 0
 
+    # ----------------------- P/D handoff seam -----------------------
+    def enable_handoff(self):
+        """Make this a PREFILL-class engine: every sequence is parked
+        the moment its prompt is consumed (exported exactly like a
+        live migration — page bytes, RNG, counters — into an internal
+        list) instead of decoding here.  The owner drains
+        take_handoffs() and places each snapshot on a decode-class
+        sibling via import_sequence; `on_handoff` (called after each
+        step that parked something, OUTSIDE the step lock) is the
+        wakeup."""
+        self._handoff = True
+
+    def _sweep_handoffs_locked(self):
+        """Park every prefill-complete resident (under the step lock,
+        called at the end of step()).  A state is ready the moment its
+        prefill is done and its first token sampled — n_generated is
+        then the importer's resume base, and the client stream is
+        healed to exactly that prefix by the collector."""
+        parked = False
+        for state in self.scheduler.active():
+            if state.prefilling or state.n_generated < 1:
+                continue
+            if state.request.expired():
+                continue   # the next step's deadline reaper owns it
+            if not self.cache.has(state.seq_id):
+                continue
+            self._handoff_out.append(self._export_sequence(state))
+            parked = True
+        return parked
+
+    def take_handoffs(self):
+        """Drain parked prefill-complete snapshots (each carries the
+        client handle under "future" and page BYTES — pool pages were
+        freed at export, so a parked snap can never leak pages)."""
+        with self._lock:
+            out, self._handoff_out = self._handoff_out, []
+        return out
+
+    def handoffs_pending(self):
+        return bool(self._handoff_out)
+
+    # ---------------------------- cancel ----------------------------
+    def cancel(self, handle):
+        """Cancel the request owned by `handle` wherever it currently
+        lives — admission queue, pending re-prefill line, or a live
+        decode slot (slot and pages freed) — and resolve the handle
+        with ``finish_reason="cancelled"`` and whatever tokens already
+        streamed, so an abandoning client NEVER hangs and never keeps
+        paying for decode it stopped reading.  False when the handle
+        owns nothing here (already finished, or migrated away)."""
+        with self._lock:
+            for state in self.scheduler.active():
+                if state.handle is handle:
+                    self.scheduler.retire(state)
+                    req = state.request
+                    handle._finish(GenerationResult(
+                        state.tokens[len(req.prompt):], "cancelled",
+                        len(req.prompt), state.preemptions))
+                    self.metrics.count_finished()
+                    return True
+            item = self.scheduler.cancel_pending(handle)
+            if item is not None:
+                if isinstance(item, SequenceState):   # preempted
+                    handle._finish(GenerationResult(
+                        item.tokens[len(item.request.prompt):],
+                        "cancelled", len(item.request.prompt),
+                        item.preemptions))
+                else:   # still queued, nothing generated
+                    handle._finish(GenerationResult(
+                        [], "cancelled", len(item.prompt), 0))
+                self.metrics.count_finished()
+                return True
+            for i, snap in enumerate(self._handoff_out):
+                if snap["future"] is handle:
+                    # parked for P/D handoff but not yet collected:
+                    # the snap holds bytes, not pages — drop it
+                    del self._handoff_out[i]
+                    handle._finish(GenerationResult(
+                        snap["tokens"][len(snap["prompt"]):],
+                        "cancelled", len(snap["prompt"]),
+                        snap["preemptions"]))
+                    self.metrics.count_finished()
+                    return True
+        return False
+
     # --------------------------- stepping ---------------------------
     @property
     def step_seq(self):
@@ -1147,13 +1264,20 @@ class GenerationEngine:
         every active sequence.  Returns the number of sequences that
         advanced (0 == idle).  Thread-safe; the background worker uses
         exactly this."""
+        parked = False
         with self._lock:
             self._in_step = True
             try:
                 out = self._step_locked()
             finally:
                 self._in_step = False
+            if self._handoff:
+                parked = self._sweep_handoffs_locked()
         self._step_seq += 1
+        if parked and self.on_handoff is not None:
+            # outside the step lock by design: the notified collector
+            # may take router/transport locks of its own
+            self.on_handoff()
         return out
 
     def _step_locked(self):

@@ -437,6 +437,24 @@ class ContinuousBatchingScheduler:
             out.append((req, 0))
         return out
 
+    def cancel_pending(self, handle):
+        """Remove the not-yet-placed item owned by `handle` (pending
+        re-prefill line or admission queue) WITHOUT resolving it — the
+        engine's cancel path owns the resolution.  Returns the removed
+        item (a preempted SequenceState or a queued GenerationRequest)
+        or None when nothing pending matches (it may be active,
+        finished, or elsewhere).  Preempted SequenceStates freed their
+        pages at preemption, so dropping the entry is the whole
+        cleanup."""
+        for i, item in enumerate(self._pending):
+            owner = item.handle if isinstance(item, SequenceState) \
+                else item.future
+            if owner is handle:
+                del self._pending[i]
+                return item
+        taken = self.queue.remove(lambda r: r.future is handle)
+        return taken[0] if taken else None
+
     def close(self):
         """Reject everything still queued (typed shutdown error)."""
         self.queue.close()

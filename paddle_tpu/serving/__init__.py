@@ -24,6 +24,7 @@ from .admission import (AdmissionQueue, DeadlineExceededError,
                         RequestTooLargeError, ServerBusyError, ServingError)
 from .batcher import DynamicBatcher
 from .bucketing import CompiledModelCache, ShapeBucketer
+from .control import FleetSupervisor, SupervisorConfig
 from .engine import ServingConfig, ServingEngine, create_serving_engine
 from .fleet import (CircuitBreaker, FleetConfig, FleetMetrics,
                     FleetRouter, ReplicaSpec)
@@ -35,7 +36,7 @@ __all__ = [
     "ShapeBucketer", "CompiledModelCache",
     "ServingMetrics", "LatencyReservoir",
     "FleetRouter", "FleetConfig", "FleetMetrics", "ReplicaSpec",
-    "CircuitBreaker",
+    "CircuitBreaker", "FleetSupervisor", "SupervisorConfig",
     "ServingError", "ServerBusyError", "DeadlineExceededError",
     "RequestTooLargeError", "ReplicaTimeoutError",
 ]

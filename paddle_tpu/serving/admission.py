@@ -177,6 +177,20 @@ class AdmissionQueue:
                     return None
                 self._cond.wait(remaining)
 
+    def remove(self, pred):
+        """Pull every queued request matching `pred(req)` WITHOUT
+        resolving its future — the cancel path: the caller owns the
+        resolution (a typed result or error), this only frees the
+        queue slot.  Returns the removed requests in FIFO order."""
+        with self._cond:
+            taken = [r for r in self._dq if pred(r)]
+            if taken:
+                kept = [r for r in self._dq if not pred(r)]
+                self._dq.clear()
+                self._dq.extend(kept)
+                self._gauge()
+            return taken
+
     def close(self):
         """Shut down: wake pollers; every queued request is rejected."""
         with self._cond:

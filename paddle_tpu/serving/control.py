@@ -1,0 +1,231 @@
+"""Self-healing control plane: the FleetSupervisor.
+
+The FleetRouter is deliberately REACTIVE: its watchdog detects death
+(stale heartbeats, wedges, socket EOF), remigrates in-flight work, and
+sends synthetic ping probes so idle breakers earn their half-open
+recovery — but it never *decides* anything.  A dead replica stays dead
+until someone calls ``restart()``; a saturated fleet sheds typed
+errors until someone adds capacity.  Those decisions are POLICY, and
+policy lives here, in a loop an operator can read top to bottom::
+
+    FleetSupervisor.tick()
+        1. RESURRECT   every dead replica gets restart(wait=False);
+                       respawn backoff and the crash-loop cap are the
+                       router's contract and are RESPECTED, not bypassed
+                       — a replica owing backoff is retried next tick,
+                       a crash-looping one is left for the operator
+        2. SCALE UP    sustained pressure (queue depth or TTFT EWMA
+                       over thresholds for `sustain_ticks` consecutive
+                       ticks) spawns a replica from `spec_factory`,
+                       up to FleetConfig.max_replicas
+        3. SCALE DOWN  a sustained idle fleet (every replica idle for
+                       `idle_ticks` consecutive ticks) drains ONE
+                       supervisor-spawned replica per tick, down to
+                       FleetConfig.min_replicas — only its own spawns:
+                       the operator's configured fleet is never shrunk
+
+``tick()`` is synchronous and deterministic (tests drive it directly);
+``start()`` runs it on a background thread every ``interval_s`` — the
+production shape.  All accounting lands in the fleet.* registry:
+supervisor_restart_total, autoscale_spawned/drained, replica_count.
+
+Docs: docs/SERVING.md "Cross-host fleet".
+"""
+import threading
+
+from .admission import ServingError
+
+__all__ = ["FleetSupervisor", "SupervisorConfig"]
+
+
+class SupervisorConfig:
+    """Control-plane policy knobs.
+
+    interval_s: background tick period (start()).
+    scale_up_queue_depth: mean queued requests per serving replica at
+        or above which a tick counts as PRESSURE.
+    scale_up_ttft_s: measured TTFT EWMA (worst serving replica) at or
+        above which a tick counts as pressure (None = queue depth
+        only).
+    sustain_ticks: consecutive pressure ticks before ONE replica is
+        spawned (a single burst must not double the fleet).
+    idle_ticks: consecutive fully-idle ticks before ONE spawned
+        replica is drained.
+    """
+
+    def __init__(self, interval_s=0.25, scale_up_queue_depth=4.0,
+                 scale_up_ttft_s=None, sustain_ticks=3, idle_ticks=8):
+        if float(interval_s) <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.interval_s = float(interval_s)
+        if float(scale_up_queue_depth) <= 0:
+            raise ValueError(f"scale_up_queue_depth must be > 0, got "
+                             f"{scale_up_queue_depth}")
+        self.scale_up_queue_depth = float(scale_up_queue_depth)
+        if scale_up_ttft_s is not None and float(scale_up_ttft_s) <= 0:
+            raise ValueError(f"scale_up_ttft_s must be > 0 or None, "
+                             f"got {scale_up_ttft_s}")
+        self.scale_up_ttft_s = (None if scale_up_ttft_s is None
+                                else float(scale_up_ttft_s))
+        for knob, val in (("sustain_ticks", sustain_ticks),
+                          ("idle_ticks", idle_ticks)):
+            if int(val) < 1:
+                raise ValueError(f"{knob} must be >= 1, got {val}")
+        self.sustain_ticks = int(sustain_ticks)
+        self.idle_ticks = int(idle_ticks)
+
+
+class FleetSupervisor:
+    """The decision loop over one FleetRouter.
+
+    `spec_factory(index) -> ReplicaSpec` builds the spec for the
+    index-th supervisor-spawned replica (None disables autoscaling
+    up — the supervisor still resurrects and drains).  The supervisor
+    only ever REMOVES replicas it spawned itself."""
+
+    def __init__(self, router, spec_factory=None, config=None):
+        self.router = router
+        self.spec_factory = spec_factory
+        self.config = config or SupervisorConfig()
+        self._spawned = []          # names, spawn order (LIFO drain)
+        self._spawn_seq = 0
+        self._pressure_ticks = 0
+        self._idle_ticks = 0
+        self._lock = threading.Lock()   # one tick at a time
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---------------------------- policy ----------------------------
+    def _survey(self):
+        """One read of the fleet: (serving replica count, dead names,
+        mean queue depth per serving replica, worst TTFT EWMA, all
+        idle).  Reads cached transport state only — no RPCs on the
+        policy path."""
+        serving, dead, depths, ewmas, idle = 0, [], [], [], True
+        for rep in list(self.router._replicas.values()):
+            if rep.state == "dead":
+                dead.append(rep.name)
+                continue
+            if rep.state != "serving":
+                continue
+            serving += 1
+            try:
+                info = rep.transport.load_info()
+            except ServingError:
+                continue
+            depths.append(info["queue_depth"])
+            if not info.get("idle", True) or info["queue_depth"]:
+                idle = False
+            if rep.ttft_ewma is not None:
+                ewmas.append(rep.ttft_ewma)
+        mean_depth = (sum(depths) / len(depths)) if depths else 0.0
+        return serving, dead, mean_depth, max(ewmas, default=0.0), idle
+
+    def _resurrect(self, dead):
+        """restart(wait=False) every dead replica, respecting the
+        router's respawn discipline: backoff still owed → retry next
+        tick; crash-loop cap hit → leave it for the operator (the
+        typed error names reset_respawn as the override)."""
+        healed = 0
+        for name in dead:
+            try:
+                self.router.restart(name, wait=False)
+            except (ServingError, KeyError):
+                continue   # backoff owed / crash loop / raced a remove
+            self.router.metrics.count_supervisor_restart()
+            healed += 1
+        return healed
+
+    def _pressure(self, mean_depth, worst_ttft):
+        cfg = self.config
+        if mean_depth >= cfg.scale_up_queue_depth:
+            return True
+        return (cfg.scale_up_ttft_s is not None
+                and worst_ttft >= cfg.scale_up_ttft_s)
+
+    def _scale_up(self, serving):
+        cap = self.router.config.max_replicas
+        if self.spec_factory is None or cap is None or serving >= cap:
+            return False
+        spec = self.spec_factory(self._spawn_seq)
+        try:
+            name = self.router.add_replica(spec)
+        except (ServingError, ValueError):
+            return False
+        self._spawn_seq += 1
+        self._spawned.append(name)
+        self.router.metrics.count_autoscale(up=True)
+        return True
+
+    def _scale_down(self, serving):
+        if not self._spawned \
+                or serving <= self.router.config.min_replicas:
+            return False
+        name = self._spawned.pop()   # LIFO: newest spawn drains first
+        try:
+            self.router.remove_replica(name)
+        except (ServingError, KeyError):
+            return False
+        self.router.metrics.count_autoscale(up=False)
+        return True
+
+    def tick(self):
+        """One deterministic control-plane pass.  Returns a dict of
+        the actions taken — the test/introspection surface."""
+        with self._lock:
+            serving, dead, mean_depth, worst_ttft, idle = self._survey()
+            healed = self._resurrect(dead)
+            spawned = drained = False
+            if self._pressure(mean_depth, worst_ttft):
+                self._pressure_ticks += 1
+                self._idle_ticks = 0
+            elif idle and not dead:
+                self._idle_ticks += 1
+                self._pressure_ticks = 0
+            else:
+                self._pressure_ticks = 0
+                self._idle_ticks = 0
+            if self._pressure_ticks >= self.config.sustain_ticks:
+                spawned = self._scale_up(serving)
+                if spawned:
+                    self._pressure_ticks = 0
+            elif self._idle_ticks >= self.config.idle_ticks:
+                drained = self._scale_down(serving)
+                if drained:
+                    self._idle_ticks = 0
+            return {"healed": healed, "spawned": spawned,
+                    "drained": drained, "serving": serving,
+                    "mean_queue_depth": round(mean_depth, 3),
+                    "worst_ttft_s": round(worst_ttft, 4),
+                    "idle": idle}
+
+    # --------------------------- lifecycle --------------------------
+    def start(self):
+        """Run tick() on a background thread every interval_s."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:   # noqa: BLE001 — the control plane
+                pass            # must outlive any single bad tick
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -7,7 +7,9 @@ fleet"):
   (direct-object engine, the deterministic CPU oracle path) and
   `SubprocTransport` (one OS process per replica, length-prefixed
   pickled RPC over a UNIX socketpair, heartbeat liveness, crash
-  detection) behind one duck-typed contract.
+  detection) behind one duck-typed contract; ``tcp`` adds
+  `TcpTransport` — the same worker dialing back over a real TCP
+  socket, the cross-host rung.
 - ``page_service`` — `FleetPrefixIndex`: fleet-level prefix/page
   bookkeeping (chain-hash → holders), fed by register/evict deltas
   piggybacked on stats/heartbeat; page BYTES move point-to-point via
@@ -19,10 +21,12 @@ the subprocess half: one single-process GenerationEngine per replica —
 no JAX multiprocess collectives anywhere.
 """
 from .page_service import FleetPrefixIndex, page_chain_hashes
+from .tcp import ReplicaListener, TcpConnectError, TcpTransport
 from .transport import (InprocTransport, SubprocTransport,
                         build_transport)
 
 __all__ = [
     "FleetPrefixIndex", "page_chain_hashes",
     "InprocTransport", "SubprocTransport", "build_transport",
+    "TcpTransport", "ReplicaListener", "TcpConnectError",
 ]

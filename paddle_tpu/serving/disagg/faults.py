@@ -5,9 +5,17 @@ SubprocTransport's sends and its reader thread's receives), so every
 chaos scenario — a dropped submit, a duplicated token event, a
 corrupted frame, a worker killed mid-export, an engine that wedges
 while its heartbeat keeps flowing — is a fast, seeded, reproducible
-unit test instead of a flake.  The plan never touches the worker
-process's code path: faults land exactly where real ones do, on the
-wire between the router and the replica.
+unit test instead of a flake.  Faults land exactly where real ones
+do, on the wire between the router and the replica.
+
+Rules with ``side="child"`` run in the WORKER process instead: the
+transport ships them (plus a derived seed) in the build frame, the
+worker builds its own plan and wraps ITS half of the codec — so
+child→parent frame corruption (a token event the worker mangles
+before it ever leaves, a worker that SIGKILLs itself mid-stream) is
+covered too, not just the parent's view.  ``arm()``/``disarm()`` on
+the parent plan re-sync every attached transport's child half over
+the wire.
 
 Fault kinds (``FaultRule.kind``):
 
@@ -58,7 +66,7 @@ import random
 import threading
 import time
 
-from .rpc import _HEADER, recv_frame, send_frame
+from .rpc import _HEADER
 
 KINDS = ("drop", "delay", "dup", "truncate", "corrupt", "kill", "stall")
 DIRECTIONS = ("send", "recv")
@@ -79,19 +87,27 @@ class FaultRule:
     ``after``-th matching frame (then ``count-1`` more).  ``direction``
     restricts matching to "send"/"recv" (None = both — points rarely
     collide across directions anyway).  ``prob`` replaces the
-    deterministic window with a seeded coin flip per match."""
+    deterministic window with a seeded coin flip per match.  ``side``
+    picks the process that applies the rule: "parent" (the transport's
+    codec — default, the historical behavior) or "child" (shipped to
+    the worker, which wraps its own sends/recvs; directions are then
+    relative to the WORKER, so side="child" direction="send" faults
+    the token/done/hb events it emits)."""
 
     __slots__ = ("point", "kind", "direction", "after", "count",
-                 "delay_s", "stall_s", "prob", "_seen")
+                 "delay_s", "stall_s", "prob", "side", "_seen")
 
     def __init__(self, point, kind, direction=None, after=0, count=1,
-                 delay_s=0.05, stall_s=30.0, prob=None):
+                 delay_s=0.05, stall_s=30.0, prob=None, side="parent"):
         if kind not in KINDS:
             raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
         if direction is not None and direction not in DIRECTIONS:
             raise ValueError(
                 f"direction must be 'send', 'recv' or None, got "
                 f"{direction!r}")
+        if side not in ("parent", "child"):
+            raise ValueError(
+                f"side must be 'parent' or 'child', got {side!r}")
         if int(after) < 0 or int(count) < 1:
             raise ValueError(
                 f"need after >= 0 and count >= 1, got after={after} "
@@ -104,6 +120,7 @@ class FaultRule:
         self.delay_s = float(delay_s)
         self.stall_s = float(stall_s)
         self.prob = None if prob is None else float(prob)
+        self.side = side
         self._seen = 0
 
     def _matches(self, direction, point, rng):
@@ -128,28 +145,54 @@ class FaultPlan:
     reader thread both consult it); ``fired`` is the audit log drills
     and tests read back."""
 
-    def __init__(self, rules=(), seed=0, armed=True):
+    def __init__(self, rules=(), seed=0, armed=True, holder="parent"):
         self.rules = list(rules)
+        self.seed = seed
+        self.holder = holder   # which process applies this copy:
+        # "parent" (the transport) or "child" (the worker's shipped
+        # half) — rules tagged for the OTHER side never match here
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.armed = bool(armed)   # a disarmed plan is a pure
         # passthrough and counts nothing: drills build the fleet and
         # pay its compile warmup BEFORE the schedule starts ticking
         self.fired = []   # [{"kind", "point", "direction", "t"}]
+        self._hosts = []  # transports whose workers hold this plan's
+        # child half — arm()/disarm() re-syncs them over the wire
 
     def arm(self):
+        # children first: the parent half must still be disarmed while
+        # the sync frame is in flight, or an armed "any" send rule
+        # could fault the sync itself
+        for host in list(self._hosts):
+            host._sync_child_faults(True)
         self.armed = True
 
     def disarm(self):
         self.armed = False
+        for host in list(self._hosts):
+            host._sync_child_faults(False)
+
+    def child_spec(self):
+        """The worker-shipped half: ``{"rules", "seed", "armed"}`` for
+        this plan's side="child" rules, or None when there are none.
+        The seed is derived so parent and child draws never share a
+        stream."""
+        child = [r for r in self.rules if r.side == "child"]
+        if not child:
+            return None
+        return {"rules": child, "seed": ("child", self.seed),
+                "armed": self.armed}
 
     def _take(self, direction, point):
-        """The rules firing on this frame (usually 0 or 1)."""
+        """The rules firing on this frame (usually 0 or 1).  Rules
+        destined for the other process (side="child" on a parent-held
+        plan) never match here — the worker's own copy applies them."""
         with self._lock:
             if not self.armed:
                 return []
-            hits = [r for r in self.rules
-                    if r._matches(direction, point, self._rng)]
+            hits = [r for r in self.rules if r.side == self.holder
+                    and r._matches(direction, point, self._rng)]
             now = time.monotonic()
             for r in hits:
                 self.fired.append({"kind": r.kind, "point": point,
@@ -165,8 +208,11 @@ class FaultPlan:
 
     def on_send(self, transport, msg):
         """Apply send-direction rules and perform the (possibly
-        faulted) write of `msg` on the transport's socket."""
-        point = msg.get("op", "?")
+        faulted) write of `msg` on the transport's socket.
+        `transport` is any codec host exposing _sock/_wlock/kill()/
+        _send_stall()/_send_plain() — the SubprocTransport parent-side,
+        the worker's fault host child-side."""
+        point = msg.get("op") or msg.get("ev", "?")
         hits = self._take("send", point)
         kinds = {r.kind for r in hits}
         for r in hits:
@@ -205,17 +251,18 @@ class FaultPlan:
             with transport._wlock:
                 transport._sock.sendall(data)
             return
-        send_frame(transport._sock, msg, transport._wlock)
+        transport._send_plain(msg)
         if "dup" in kinds:
-            send_frame(transport._sock, msg, transport._wlock)
+            transport._send_plain(msg)
 
     def on_recv(self, transport):
-        """Read one frame off the transport's socket and return the
-        list of frames to dispatch (0 = dropped, 2 = duplicated).
-        Raises FaultInjected for corrupt/truncate rules — the reader
-        thread's poisoned-channel path."""
-        frame = recv_frame(transport._sock)
-        point = frame.get("ev") or ("resp" if "resp" in frame else "?")
+        """Read one logical frame off the transport's channel and
+        return the list of frames to dispatch (0 = dropped, 2 =
+        duplicated).  Raises FaultInjected for corrupt/truncate rules
+        — the reader thread's poisoned-channel path."""
+        frame = transport._recv_plain()
+        point = frame.get("ev") or ("resp" if "resp" in frame
+                                    else frame.get("op", "?"))
         hits = self._take("recv", point)
         kinds = {r.kind for r in hits}
         for r in hits:

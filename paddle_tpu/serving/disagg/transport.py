@@ -11,24 +11,29 @@ speaks one duck-typed transport contract with two implementations:
   (``python -m paddle_tpu.serving.disagg.worker``) owns a
   single-process GenerationEngine (no JAX multiprocess collectives
   anywhere), and the parent speaks length-prefixed pickled RPC over an
-  inherited UNIX socketpair — submit / stream-token / cancel-by-drain
-  / stats / evacuate / restart, with a periodic heartbeat carrying
-  load + prefix register/evict deltas.  The parent keeps an IN-FLIGHT
-  LEDGER (every submitted-but-unfinished request with its delivered
-  token count): crash detection (socket EOF or a stale heartbeat)
-  marks the replica dead and hands the ledger to the fleet, which
-  remigrates queued work and resolves in-flight streams typed —
-  migrated or shed, never hung.
+  inherited UNIX socketpair — submit / stream-token / cancel / stats /
+  evacuate / restart, with a periodic heartbeat carrying load + prefix
+  register/evict deltas.  The parent keeps an IN-FLIGHT LEDGER (every
+  submitted-but-unfinished request with its delivered token count):
+  crash detection (socket EOF or a stale heartbeat) marks the replica
+  dead and hands the ledger to the fleet, which remigrates queued work
+  and resolves in-flight streams typed — migrated or shed, never hung.
+- ``TcpTransport`` (serving/disagg/tcp.py) — the SAME parent logic
+  over a real TCP connection the spawned worker dials back to
+  (``--connect host:port``), the cross-host path.  Only the channel
+  bring-up differs (``_open_channel`` below is the override seam);
+  frames, ledger, heartbeats, deadlines, faults are all shared.
 
 The transport contract (duck-typed; every method the router calls):
 
     alive() heartbeat_age() describe() load_info() stats()
     submit(prompt, kwargs, handle) drain(migrate, live, timeout)
     import_sequence(snap) export_prefix(tokens) import_prefix(payload)
-    take_prefix_deltas() flush_prefix() reset_stats()
-    idle() pump() stop() take_inflight()
+    take_prefix_deltas() flush_prefix() reset_stats() ping()
+    cancel(handle) take_handoffs() idle() pump() stop() take_inflight()
 
-Docs: docs/SERVING.md "Disaggregated fleet" (contract + RPC schema).
+Docs: docs/SERVING.md "Disaggregated fleet" (contract + RPC schema)
+and "Cross-host fleet" (TCP bring-up, P/D handoff, supervisor).
 """
 import itertools
 import os
@@ -44,17 +49,21 @@ from ...generation.metrics import GenerationMetrics
 from ...generation.scheduler import GenerationRequest
 from ...profiler.monitor import StatRegistry
 from ..admission import ReplicaTimeoutError, ServingError
-from .rpc import ChannelClosed, recv_frame, send_frame
+from .rpc import (ChannelClosed, DEFAULT_CHUNK_BYTES, FrameAssembler,
+                  send_frame)
 
 HEARTBEAT_S = 0.25
 
 # ops a timed-out caller may safely re-issue: they read state or
-# re-assert idempotent state, so a lost REPLY cannot double-apply.
-# submit / import_seq / import_prefix / evacuate are NOT here — a lost
-# reply may mean the op landed, and re-issuing would double-run it;
-# they fail fast into the fleet's remigration ladder instead.
+# re-assert idempotent state, so a lost REPLY cannot double-apply
+# (cancelling an already-cancelled/finished stream is a no-op, so
+# "cancel" qualifies).  submit / import_seq / import_prefix /
+# evacuate are NOT here — a lost reply may mean the op landed, and
+# re-issuing would double-run it; they fail fast into the fleet's
+# remigration ladder instead.
 RETRYABLE_OPS = frozenset({"stats", "load", "export_prefix",
-                           "flush_prefix", "reset_stats", "ping"})
+                           "flush_prefix", "reset_stats", "ping",
+                           "cancel"})
 
 
 class RpcPolicy:
@@ -81,19 +90,24 @@ class RpcPolicy:
 
 
 def build_transport(spec, kind, start=True, rpc=None, fault_plan=None):
-    """Transport factory: ``"inproc"`` or ``"proc"``.  `rpc` is an
-    RpcPolicy (proc only); `fault_plan` a serving.disagg.faults
-    FaultPlan wrapping the frame codec — chaos tests/drills only, and
-    only meaningful where there IS a wire."""
+    """Transport factory: ``"inproc"``, ``"proc"`` or ``"tcp"``.
+    `rpc` is an RpcPolicy (proc/tcp only); `fault_plan` a
+    serving.disagg.faults FaultPlan wrapping the frame codec — chaos
+    tests/drills only, and only meaningful where there IS a wire."""
     if kind == "proc":
         return SubprocTransport(spec, rpc=rpc, fault_plan=fault_plan)
+    if kind == "tcp":
+        from .tcp import TcpTransport   # late: tcp imports this module
+
+        return TcpTransport(spec, rpc=rpc, fault_plan=fault_plan)
     if kind == "inproc":
         if fault_plan is not None:
             raise ValueError(
                 "fault injection wraps the RPC frame codec; an inproc "
                 "replica has no wire to fault — use transport='proc'")
         return InprocTransport(spec, start=start)
-    raise ValueError(f"transport must be 'inproc' or 'proc', got {kind!r}")
+    raise ValueError(
+        f"transport must be 'inproc', 'proc' or 'tcp', got {kind!r}")
 
 
 class InprocTransport:
@@ -105,6 +119,7 @@ class InprocTransport:
 
     def __init__(self, spec, start=True):
         self.name = spec.name
+        self.role = getattr(spec, "role", "mixed")
         self.registry = StatRegistry()
         self.engine = GenerationEngine(
             spec.model, spec.config,
@@ -112,6 +127,12 @@ class InprocTransport:
             start=start)
         if self.engine.prefix_cache_enabled:
             self.engine.cache.enable_prefix_deltas()
+        if self.role == "prefill":
+            # P/D disaggregation: a prefill-class replica parks every
+            # sequence the moment its prompt is consumed; the router
+            # collects the parked snapshots (take_handoffs) and ships
+            # them to a decode-class replica
+            self.engine.enable_handoff()
         self.on_death = None   # inproc replicas share our fate
         self.timeout_total = 0   # schema parity: no RPC, no timeouts
 
@@ -145,6 +166,26 @@ class InprocTransport:
 
     def take_inflight(self):
         return []   # an inproc replica cannot die out from under us
+
+    def ping(self):
+        """Liveness probe — the breaker's half-open recovery signal on
+        an idle fleet.  Raises typed when the engine is gone, exactly
+        like the RPC path."""
+        if self.engine._closed:
+            raise ServingError(
+                f"replica {self.name!r} engine is shut down")
+        return True
+
+    def cancel(self, handle):
+        return self.engine.cancel(handle)
+
+    def take_handoffs(self):
+        """Drain prefill-complete sequence snapshots parked by a
+        prefill-class engine (P/D disaggregation).  Each item is
+        ``{"snap": <import_sequence snapshot with future=handle>,
+        "t": parked-at monotonic stamp}``."""
+        return [{"snap": snap, "t": time.monotonic()}
+                for snap in self.engine.take_handoffs()]
 
     # ------------------------ page service --------------------------
     def take_prefix_deltas(self):
@@ -182,7 +223,8 @@ class InprocTransport:
     # ------------------------- lifecycle ----------------------------
     def idle(self):
         sched = self.engine.scheduler
-        return not (sched.active() or sched.pending_count())
+        return not (sched.active() or sched.pending_count()
+                    or self.engine.handoffs_pending())
 
     def pump(self):
         eng = self.engine
@@ -202,6 +244,14 @@ class SubprocTransport:
 
     kind = "proc"
     BUILD_TIMEOUT_S = 180.0
+    # class-level fallbacks: chaos tests build bare RPC shells via
+    # __new__ (no worker half), and those must stay wire-correct —
+    # chunking off, no handoff poke, assembler made lazily on first
+    # read
+    chunk_bytes = None
+    role = "mixed"
+    on_handoff = None
+    _assembler = None
 
     def __init__(self, spec, rpc=None, fault_plan=None):
         cfg = spec.config
@@ -212,30 +262,34 @@ class SubprocTransport:
                 "INSIDE a replica with InprocTransport, or give the "
                 "subprocess replica an unsharded config)")
         self.name = spec.name
+        self.role = getattr(spec, "role", "mixed")
         self.registry = None       # stats live in the child
         self.engine = None         # no direct-object path
         self.on_death = None       # fleet sets: callback(transport)
+        self.on_handoff = None     # fleet sets: prefill-complete poke
         self.rpc = rpc or RpcPolicy()
         self._faults = fault_plan  # chaos: wraps the codec parent-side
         self._jitter = random.Random((spec.name, self.rpc.seed).__repr__())
         self.timeout_total = 0     # RPC deadline misses (drill report)
-        parent, child = socket.socketpair()
+        # chunked codec: logical frames past this bound ship as
+        # fragment carriers, so a multi-MB page export never blocks
+        # heartbeats/tokens behind one giant sendall (spec override:
+        # tests pin a tiny bound to force chunking on small payloads)
+        self.chunk_bytes = int(getattr(spec, "chunk_bytes", None)
+                               or DEFAULT_CHUNK_BYTES)
+        self._assembler = FrameAssembler()
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))))
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-        self._proc = subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.serving.disagg.worker",
-             str(child.fileno())],
-            pass_fds=(child.fileno(),), env=env)
-        child.close()
-        self._sock = parent
+        self._sock, self._proc = self._open_channel(spec, env)
         self._wlock = threading.Lock()
         self._lock = threading.Lock()   # rpc waits + inflight + deltas
         self._ids = itertools.count(1)  # rids and stream sids alike
         self._rpc_waits = {}            # rid -> (Event, slot dict)
         self._inflight = {}             # sid -> ledger entry
+        self._handoffs = []             # prefill-complete snaps parked
         self._deltas = []
         self._load = {"queue_depth": 0, "active": 0, "pages_in_use": 0,
                       "num_pages": 1, "idle": True}
@@ -259,9 +313,13 @@ class SubprocTransport:
         # A failed build must not leak the worker: the reader thread
         # keeps the parent socket referenced, so without an explicit
         # kill the child would outlive this constructor forever
+        child_faults = (None if fault_plan is None
+                        else fault_plan.child_spec())
         try:
             self._describe = self._call(
-                {"op": "build", "model": spec.model, "config": cfg},
+                {"op": "build", "model": spec.model, "config": cfg,
+                 "role": self.role, "chunk_bytes": self.chunk_bytes,
+                 "faults": child_faults},
                 timeout=self.BUILD_TIMEOUT_S)
         except BaseException:
             self._closing = True
@@ -277,14 +335,64 @@ class SubprocTransport:
         # replica the reaper kills at the first submit
         self._last_hb = time.monotonic()
         self._progress_at = self._last_hb
+        if child_faults is not None:
+            # the worker holds its own (seeded) half of the plan;
+            # arm()/disarm() on the parent plan re-syncs it over the
+            # wire so drills can warm up disarmed, then arm both sides
+            fault_plan._hosts.append(self)
+
+    # ------------------------ channel setup -------------------------
+    def _open_channel(self, spec, env):
+        """Bring up the wire to a freshly spawned worker; returns
+        ``(socket, Popen)``.  Base implementation: inherited UNIX
+        socketpair.  TcpTransport overrides this with listen /
+        spawn-with---connect / accept — everything above the socket
+        (frames, ledger, heartbeats, faults) is shared."""
+        parent, child = socket.socketpair()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.disagg.worker",
+             str(child.fileno())],
+            pass_fds=(child.fileno(),), env=env)
+        child.close()
+        return parent, proc
 
     # ------------------------- wire pump ----------------------------
+    def _send_plain(self, msg):
+        """The un-faulted logical-frame write (chunk-capable) — also
+        the terminal write the fault plan's passthrough path uses, so
+        chunking composes with injected faults."""
+        send_frame(self._sock, msg, self._wlock,
+                   chunk_bytes=self.chunk_bytes)
+
+    def _recv_plain(self):
+        """The un-faulted logical-frame read (fragment-reassembling) —
+        single reader thread per channel, so the assembler needs no
+        lock."""
+        asm = self._assembler
+        if asm is None:
+            asm = self._assembler = FrameAssembler()
+        return asm.recv(self._sock)
+
     def _send(self, msg):
         """One (possibly fault-injected) frame write."""
         if self._faults is None:
-            send_frame(self._sock, msg, self._wlock)
+            self._send_plain(msg)
         else:
             self._faults.on_send(self, msg)
+
+    def _sync_child_faults(self, armed):
+        """Mirror the parent plan's arm/disarm to the worker's child
+        half.  Rid-less fire-and-forget on the PLAIN codec: the frame
+        must not itself be subject to the plan, and write order under
+        _wlock guarantees it lands before any traffic armed after it."""
+        if self._dead.is_set():
+            return
+        try:
+            send_frame(self._sock,
+                       {"op": "chaos_arm", "armed": bool(armed)},
+                       self._wlock)
+        except OSError:
+            pass
 
     def _send_stall(self, stall_s):
         """Chaos: ask the worker to WEDGE its engine (a thread holds
@@ -303,7 +411,7 @@ class SubprocTransport:
         try:
             while True:
                 if self._faults is None:
-                    self._dispatch(recv_frame(self._sock))
+                    self._dispatch(self._recv_plain())
                 else:
                     for frame in self._faults.on_recv(self):
                         self._dispatch(frame)
@@ -390,6 +498,30 @@ class SubprocTransport:
             handle._finish(GenerationResult(
                 r["token_ids"], r["finish_reason"], r["prompt_len"],
                 r["preemptions"]))
+        elif kind == "handoff":
+            # P/D disaggregation: the prefill replica finished this
+            # stream's prompt and shipped the sequence snapshot; the
+            # stream continues on a decode replica.  Park the snap for
+            # the router (take_handoffs) and heal the client stream to
+            # exactly n_generated tokens — the import base — so the
+            # decode side never gaps or dupes
+            with self._lock:
+                self._inflight.pop(sid, None)
+            snap = frame["snap"]
+            n_gen = int(snap["n_generated"])
+            gen = snap["tokens"][len(snap["tokens"]) - n_gen:] \
+                if n_gen else []
+            for t in gen[entry["base"] + entry["next"]:]:
+                handle._push_token(t)
+            snap["future"] = handle
+            with self._lock:
+                self._handoffs.append({"snap": snap,
+                                       "t": time.monotonic()})
+            if self.on_handoff is not None:
+                # poke the router from the reader thread: placement
+                # RPCs target SIBLING replicas, never this channel, so
+                # the reader cannot deadlock on its own socket
+                self.on_handoff()
         elif kind == "error":
             with self._lock:
                 self._inflight.pop(sid, None)
@@ -563,7 +695,17 @@ class SubprocTransport:
                         "prompt": list(prompt), "kwargs": dict(kwargs)})
         except BaseException:
             with self._lock:
-                self._inflight.pop(sid, None)
+                claimed = self._inflight.pop(sid, None) is None
+            if claimed:
+                # The entry is already GONE: the death path snapshotted
+                # the ledger while our reply was in flight (remigration
+                # owns the stream now), or a done/error frame resolved
+                # the handle first.  Ownership left this call either
+                # way — report PLACED, because raising here would send
+                # the router's rung retry after a request the death
+                # path is ALSO resubmitting: two live streams feeding
+                # one handle, every token delivered twice.
+                return handle
             raise
         return handle
 
@@ -576,6 +718,46 @@ class SubprocTransport:
             entries = list(self._inflight.values())
             self._inflight.clear()
         return entries
+
+    def ping(self, timeout=None):
+        """Synthetic liveness probe: one bounded, retried round-trip.
+        The watchdog sends these so an idle fleet's half-open breakers
+        earn their recovery without waiting for real traffic."""
+        if timeout is None:
+            timeout = min(5.0, self.rpc.timeout_s)
+        return bool(self._call_idempotent({"op": "ping"},
+                                          timeout=timeout))
+
+    def cancel(self, handle):
+        """Cancel the in-flight stream owned by `handle`: the worker
+        frees its queue slot and pages and resolves the stream with a
+        ``finish_reason="cancelled"`` done frame (which settles the
+        ledger entry through the normal dispatch path — the client
+        handle NEVER hangs).  False when the stream is unknown here
+        (already finished, migrated away, or replica dead — the death
+        path resolves it instead)."""
+        if self._dead.is_set():
+            return False
+        with self._lock:
+            sid = next((s for s, e in self._inflight.items()
+                        if e["handle"] is handle), None)
+        if sid is None:
+            return False
+        try:
+            return bool(self._call_idempotent({"op": "cancel",
+                                               "sid": sid}))
+        except ServingError:
+            return False
+
+    def take_handoffs(self):
+        """Drain prefill-complete sequence snapshots this replica
+        shipped up (P/D disaggregation).  Each item: ``{"snap": ...,
+        "t": parent-received-at}``; snaps carry page BYTES plus the
+        client handle — parent-side state, so they survive the worker
+        being SIGKILLed right after the handoff frame left."""
+        with self._lock:
+            out, self._handoffs = self._handoffs, []
+        return out
 
     # ------------------------ page service --------------------------
     def take_prefix_deltas(self):
@@ -673,7 +855,7 @@ class SubprocTransport:
             return True
         self._load = load
         with self._lock:
-            busy = bool(self._inflight)
+            busy = bool(self._inflight or self._handoffs)
         return bool(load.get("idle")) and not busy
 
     def pump(self):

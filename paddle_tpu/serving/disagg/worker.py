@@ -1,17 +1,34 @@
-"""Replica worker process — the subprocess half of SubprocTransport.
+"""Replica worker process — the child half of SubprocTransport.
 
-``python -m paddle_tpu.serving.disagg.worker <fd>`` builds ONE
-single-process GenerationEngine from the pickled build spec (first RPC
-frame) and serves the transport RPC contract over the inherited
-socketpair fd: submit streams tokens back as events, evacuate ships
-cold requests and live sequence snapshots for migration, a heartbeat
-thread reports load + prefix register/evict deltas every
-``HEARTBEAT_S``.  The engine steps itself on its background worker
-thread; nothing here touches jax.distributed — a replica is exactly
-the single-process engine the CPU oracle runs, behind a socket.
+Two launch modes, one serve loop:
+
+- ``python -m paddle_tpu.serving.disagg.worker <fd>`` — inherit a
+  UNIX socketpair fd from the parent (same-host SubprocTransport).
+- ``python -m paddle_tpu.serving.disagg.worker --connect host:port``
+  — dial back to the parent's ReplicaListener over TCP
+  (TcpTransport, the cross-host path).
+
+Either way the worker builds ONE single-process GenerationEngine from
+the pickled build spec (first RPC frame) and serves the transport RPC
+contract: submit streams tokens back as events, evacuate ships cold
+requests and live sequence snapshots for migration, cancel frees a
+stream's slot and pages, a heartbeat thread reports load + prefix
+register/evict deltas every ``HEARTBEAT_S``.  A prefill-role worker
+additionally parks each sequence at prompt completion and ships the
+snapshot up as a ``handoff`` event (P/D disaggregation).  The engine
+steps itself on its background worker thread; nothing here touches
+jax.distributed — a replica is exactly the single-process engine the
+CPU oracle runs, behind a socket.
+
+The build frame may carry the CHILD half of a chaos FaultPlan
+(side="child" rules + a derived seed): the worker then wraps its own
+sends/recvs so child→parent frame corruption, self-SIGKILL and
+self-stall are all seeded, reproducible faults too.
 
 Frame schema: docs/SERVING.md "Disaggregated fleet".
 """
+import os
+import signal
 import socket
 import sys
 import threading
@@ -26,13 +43,12 @@ class _StreamHandle:
     frame per transition.  The parent-side transport reassembles the
     client's GenerationHandle from these frames."""
 
-    __slots__ = ("sid", "_sock", "_wlock", "submitted_s",
-                 "first_token_s", "prefix_hit_tokens", "_done", "_n")
+    __slots__ = ("sid", "_send_event", "submitted_s", "first_token_s",
+                 "prefix_hit_tokens", "_done", "_n")
 
-    def __init__(self, sid, sock, wlock):
+    def __init__(self, sid, send_event):
         self.sid = sid
-        self._sock = sock
-        self._wlock = wlock
+        self._send_event = send_event
         self.submitted_s = None
         self.first_token_s = None
         self.prefix_hit_tokens = None
@@ -41,10 +57,8 @@ class _StreamHandle:
         # duplicated frames and detects holes from dropped ones
 
     def _send(self, obj):
-        from .rpc import send_frame
-
         try:
-            send_frame(self._sock, obj, self._wlock)
+            self._send_event(obj)
         except OSError:
             pass   # parent gone; this process is about to die anyway
 
@@ -79,11 +93,56 @@ class _StreamHandle:
 
 class _Worker:
     def __init__(self, sock):
+        from .rpc import FrameAssembler
+
         self.sock = sock
         self.wlock = threading.Lock()
         self.engine = None
         self.registry = None
+        self.chunk_bytes = None   # set by the build frame
+        self.faults = None        # child half of a chaos FaultPlan
+        self.handles = {}         # sid -> live _StreamHandle (cancel)
+        self._hlock = threading.Lock()
+        self._assembler = FrameAssembler()
         self._stop_hb = threading.Event()
+        # fault-host aliases: FaultPlan.on_send/on_recv drive a codec
+        # host through _sock/_wlock/kill/_send_stall/_send_plain —
+        # child-side, that host is the worker itself
+        self._sock = sock
+        self._wlock = self.wlock
+
+    # ------------------------ codec plumbing ------------------------
+    def _send_plain(self, msg):
+        from .rpc import send_frame
+
+        send_frame(self.sock, msg, self.wlock,
+                   chunk_bytes=self.chunk_bytes)
+
+    def _recv_plain(self):
+        return self._assembler.recv(self.sock)
+
+    def send_event(self, obj):
+        """Event-frame write (token/done/error/hb/handoff): the path
+        child-side send faults wrap."""
+        if self.faults is None:
+            self._send_plain(obj)
+        else:
+            self.faults.on_send(self, obj)
+
+    def recv(self):
+        if self.faults is None:
+            return [self._recv_plain()]
+        return self.faults.on_recv(self)
+
+    def kill(self):
+        """Child-side 'kill' fault: this worker SIGKILLs ITSELF — the
+        parent sees exactly what a real crash looks like (socket EOF,
+        no goodbye)."""
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def _send_stall(self, stall_s):
+        """Child-side 'stall' fault: wedge our own engine."""
+        self.op_chaos_stall({"stall_s": stall_s})
 
     # --------------------------- ops --------------------------------
     def op_build(self, frame):
@@ -92,6 +151,14 @@ class _Worker:
         from ...profiler.monitor import StatRegistry
         from .transport import HEARTBEAT_S
 
+        self.chunk_bytes = frame.get("chunk_bytes")
+        fspec = frame.get("faults")
+        if fspec is not None:
+            from .faults import FaultPlan
+
+            self.faults = FaultPlan(fspec["rules"], seed=fspec["seed"],
+                                    armed=fspec["armed"],
+                                    holder="child")
         self.registry = StatRegistry()
         self.engine = GenerationEngine(
             frame["model"], frame["config"],
@@ -99,13 +166,33 @@ class _Worker:
             start=True)
         if self.engine.prefix_cache_enabled:
             self.engine.cache.enable_prefix_deltas()
+        if frame.get("role") == "prefill":
+            # P/D disaggregation: park each sequence at prompt
+            # completion; the engine's step loop notifies us (lock
+            # already released) and we ship the snapshots up as
+            # handoff events for the router to place on decode
+            # replicas
+            self.engine.enable_handoff()
+            self.engine.on_handoff = self._ship_handoffs
         threading.Thread(target=self._heartbeat, args=(HEARTBEAT_S,),
                          name="replica-heartbeat", daemon=True).start()
         return self.engine.describe()
 
-    def _heartbeat(self, interval):
-        from .rpc import send_frame
+    def _ship_handoffs(self):
+        for snap in self.engine.take_handoffs():
+            handle = snap.pop("future")
+            handle._done = True   # stream continues elsewhere; no
+            # late done/error frame may race the handoff
+            payload = dict(snap)
+            with self._hlock:
+                self.handles.pop(handle.sid, None)
+            try:
+                self.send_event({"ev": "handoff", "sid": handle.sid,
+                                 "snap": payload})
+            except OSError:
+                return   # parent gone; nothing to hand off to
 
+    def _heartbeat(self, interval):
         while not self._stop_hb.wait(interval):
             try:
                 deltas = self.engine.cache.take_prefix_deltas()
@@ -114,21 +201,49 @@ class _Worker:
                 # loop, so a wedged engine keeps heartbeating a FROZEN
                 # seq while reporting work — exactly the signature the
                 # parent's wedge watchdog kills on
-                send_frame(self.sock,
-                           {"ev": "hb", "load": self.engine.load_info(),
-                            "seq": self.engine.step_seq,
-                            "in_step": self.engine.in_step,
-                            "deltas": deltas}, self.wlock)
+                self.send_event(
+                    {"ev": "hb", "load": self.engine.load_info(),
+                     "seq": self.engine.step_seq,
+                     "in_step": self.engine.in_step,
+                     "deltas": deltas})
             except OSError:
                 return
             except Exception:   # noqa: BLE001 — a heartbeat must never
                 pass            # kill the worker; the next beat retries
 
+    def _register(self, sid, handle):
+        with self._hlock:
+            # opportunistic prune keeps the map at O(live streams)
+            for old_sid in [s for s, h in self.handles.items()
+                            if h.done()]:
+                del self.handles[old_sid]
+            self.handles[sid] = handle
+
     def op_submit(self, frame):
-        handle = _StreamHandle(frame["sid"], self.sock, self.wlock)
+        sid = frame["sid"]
+        # the wire is at-least-once (dup faults, RPC redelivery): a
+        # sid we already own must NOT start a second stream — the
+        # doubled token events would interleave into the parent's one
+        # ledger entry as a duplicated client stream
+        with self._hlock:
+            live = self.handles.get(sid)
+            if live is not None and not live.done():
+                return True
+        handle = _StreamHandle(sid, self.send_event)
+        self._register(sid, handle)
         self.engine.submit(frame["prompt"], handle=handle,
                            **frame["kwargs"])
         return True
+
+    def op_cancel(self, frame):
+        """Free the stream's queue slot and pages; the engine resolves
+        the handle with finish_reason="cancelled", whose done frame
+        settles the parent's ledger entry — the client never hangs."""
+        with self._hlock:
+            handle = self.handles.pop(frame["sid"], None)
+        if handle is None or handle.done():
+            return False
+        return bool(self.engine.cancel(handle))
 
     def op_load(self, frame):
         return self.engine.load_info()
@@ -166,7 +281,8 @@ class _Worker:
 
     def op_import_seq(self, frame):
         snap = frame["snap"]
-        handle = _StreamHandle(frame["sid"], self.sock, self.wlock)
+        handle = _StreamHandle(frame["sid"], self.send_event)
+        self._register(frame["sid"], handle)
         return bool(self.engine.import_sequence(snap, handle=handle))
 
     def op_export_prefix(self, frame):
@@ -183,6 +299,15 @@ class _Worker:
         return True
 
     def op_ping(self, frame):
+        return True
+
+    def op_chaos_arm(self, frame):
+        """Parent plan arm()/disarm() mirrored to our child half."""
+        if self.faults is not None:
+            if frame.get("armed"):
+                self.faults.armed = True
+            else:
+                self.faults.armed = False
         return True
 
     def op_chaos_stall(self, frame):
@@ -212,51 +337,68 @@ class _Worker:
     # --------------------------- loop -------------------------------
     def serve(self):
         from ..admission import ServingError
-        from .rpc import ChannelClosed, recv_frame, send_frame
+        from .rpc import ChannelClosed
 
         while True:
             try:
-                frame = recv_frame(self.sock)
-            except (ChannelClosed, OSError):
-                # parent died: nothing to stream to — exit cleanly
+                frames = self.recv()
+            except (ChannelClosed, OSError, Exception):  # noqa: B014
+                # parent died, or a poisoned inbound frame (chaos
+                # corrupt/truncate, real damage) desynced the channel:
+                # either way there is nothing left to serve — shut
+                # down cleanly, the parent's EOF detection takes over
                 self._stop_hb.set()
                 if self.engine is not None:
                     self.engine.shutdown()
                 return
-            rid = frame.get("rid")
-            op = frame.get("op")
-            try:
-                handler = getattr(self, f"op_{op}", None)
-                if handler is None:
-                    # a frame that decoded but names no op (garbage
-                    # that survived unpickling) must answer typed, not
-                    # crash the worker on an AttributeError
-                    raise ServingError(f"unknown op {op!r}")
-                result = handler(frame)
-                reply = {"resp": rid, "ok": result}
-            except Exception as e:   # noqa: BLE001 — typed errors ride
-                reply = {"resp": rid, "error": e}   # the wire back
-            try:
-                send_frame(self.sock, reply, self.wlock)
-            except OSError:
-                return   # parent gone
-            except Exception:   # noqa: BLE001 — unpicklable payload:
-                try:            # degrade to a typed, serializable error
-                    send_frame(self.sock,
-                               {"resp": rid, "error": ServingError(
-                                   f"op {op!r} reply not serializable: "
-                                   f"{traceback.format_exc(limit=3)}")},
-                               self.wlock)
-                except OSError:
-                    return
-            if op == "shutdown":
+            stop = False
+            for frame in frames:
+                if self._serve_one(frame, ServingError):
+                    stop = True
+            if stop:
                 return
 
+    def _serve_one(self, frame, serving_error):
+        """Handle one inbound op frame; True means exit the loop."""
+        rid = frame.get("rid")
+        op = frame.get("op")
+        try:
+            handler = getattr(self, f"op_{op}", None)
+            if handler is None:
+                # a frame that decoded but names no op (garbage
+                # that survived unpickling) must answer typed, not
+                # crash the worker on an AttributeError
+                raise serving_error(f"unknown op {op!r}")
+            result = handler(frame)
+            reply = {"resp": rid, "ok": result}
+        except Exception as e:   # noqa: BLE001 — typed errors ride
+            reply = {"resp": rid, "error": e}   # the wire back
+        if rid is not None:
+            try:
+                self._send_plain(reply)
+            except OSError:
+                return True   # parent gone
+            except Exception:   # noqa: BLE001 — unpicklable payload:
+                try:            # degrade to a typed, serializable error
+                    self._send_plain(
+                        {"resp": rid, "error": serving_error(
+                            f"op {op!r} reply not serializable: "
+                            f"{traceback.format_exc(limit=3)}")})
+                except OSError:
+                    return True
+        return op == "shutdown"
 
-def main(fd):
-    sock = socket.socket(fileno=fd)
+
+def main(argv):
+    if argv and argv[0] == "--connect":
+        host, _, port = argv[1].rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+    else:
+        sock = socket.socket(fileno=int(argv[0]))
     _Worker(sock).serve()
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]))
+    main(sys.argv[1:])

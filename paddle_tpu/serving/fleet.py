@@ -118,6 +118,17 @@ REPLICA_TIMEOUT_TOTAL = PREFIX + "replica_timeout_total"
 WEDGE_KILL_TOTAL = PREFIX + "wedge_kill_total"
 ORPHAN_REMIGRATED_TOTAL = PREFIX + "orphan_remigrated_total"
 RESPAWN_BACKOFF_S = PREFIX + "respawn_backoff_s"
+# cross-host fleet tier (ISSUE 17): prefill/decode disaggregation as a
+# routing policy, supervisor liveness probes, and autoscaling
+PD_HANDOFFS = PREFIX + "pd_handoffs"
+PD_HANDOFF_TOKENS = PREFIX + "pd_handoff_tokens"
+PD_HANDOFF_WALL_S = PREFIX + "pd_handoff_wall_s"
+ROUTED_ROLE = PREFIX + "routed_role"
+PING_PROBE_TOTAL = PREFIX + "ping_probe_total"
+SUPERVISOR_RESTART_TOTAL = PREFIX + "supervisor_restart_total"
+AUTOSCALE_SPAWNED = PREFIX + "autoscale_spawned"
+AUTOSCALE_DRAINED = PREFIX + "autoscale_drained"
+REPLICA_COUNT = PREFIX + "replica_count"
 
 
 class FleetMetrics:
@@ -141,7 +152,11 @@ class FleetMetrics:
                      PAGE_ADOPTIONS, PAGES_ADOPTED,
                      BREAKER_OPEN_TOTAL, BREAKER_STATE,
                      REPLICA_TIMEOUT_TOTAL, WEDGE_KILL_TOTAL,
-                     ORPHAN_REMIGRATED_TOTAL, RESPAWN_BACKOFF_S):
+                     ORPHAN_REMIGRATED_TOTAL, RESPAWN_BACKOFF_S,
+                     PD_HANDOFFS, PD_HANDOFF_TOKENS, PD_HANDOFF_WALL_S,
+                     ROUTED_ROLE, PING_PROBE_TOTAL,
+                     SUPERVISOR_RESTART_TOTAL, AUTOSCALE_SPAWNED,
+                     AUTOSCALE_DRAINED, REPLICA_COUNT):
             self._reg.get_stat(name)
 
     def _stat(self, name):
@@ -205,6 +220,38 @@ class FleetMetrics:
         """A stream whose completion event was lost (idle worker,
         lingering ledger entry) was remigrated by the orphan sweep."""
         self._stat(ORPHAN_REMIGRATED_TOTAL).increase()
+
+    def count_pd_handoff(self, tokens, wall_s):
+        """One prefill→decode handoff: a finished prefill's page run
+        shipped to a decode-class sibling.  `tokens` is the cache
+        length that moved; `wall_s` the park-to-placement wall (gauge:
+        the latest handoff's wall, the drain-latency signal)."""
+        self._stat(PD_HANDOFFS).increase()
+        if tokens:
+            self._stat(PD_HANDOFF_TOKENS).increase(int(tokens))
+        self._stat(PD_HANDOFF_WALL_S).set(round(float(wall_s), 4))
+
+    def count_routed_role(self):
+        """A request placed on a replica whose ROLE matched the
+        request class (prefill-heavy → prefill replica, interactive →
+        decode replica) — the segregation signal of the P/D rung."""
+        self._stat(ROUTED_ROLE).increase()
+
+    def count_ping_probe(self):
+        """One synthetic watchdog ping probe sent to earn an idle
+        replica's breaker its half-open recovery."""
+        self._stat(PING_PROBE_TOTAL).increase()
+
+    def count_supervisor_restart(self):
+        """The control plane resurrected a dead/stopped replica."""
+        self._stat(SUPERVISOR_RESTART_TOTAL).increase()
+
+    def count_autoscale(self, up):
+        self._stat(AUTOSCALE_SPAWNED if up
+                   else AUTOSCALE_DRAINED).increase()
+
+    def set_replica_count(self, n):
+        self._stat(REPLICA_COUNT).set(int(n))
 
     def set_breaker_state(self, name, score):
         """0 = closed, 1 = half-open, 2 = open; bare gauge = max."""
@@ -351,22 +398,42 @@ class ReplicaSpec:
     after a drain.
 
     transport: "inproc" (direct-object engine, the deterministic CPU
-        oracle path) or "proc" (one OS process per replica behind the
+        oracle path), "proc" (one OS process per replica behind the
         SubprocTransport RPC boundary — model and config must pickle,
-        mesh configs are rejected; see serving/disagg).  A
-        FleetConfig.transport override applies to every spec."""
+        mesh configs are rejected; see serving/disagg), or "tcp" (the
+        same worker process dialing back over a real TCP socket — the
+        cross-host rung; see serving/disagg/tcp.py).  A
+        FleetConfig.transport override applies to every spec.
+    role: "mixed" (default — prefills and decodes, the classic
+        replica), "prefill" (chews prompts; at prefill completion the
+        router ships the finished page run to a decode-class sibling
+        that streams the rest), or "decode" (preferred target of both
+        the interactive-request rung and prefill handoffs).  Role is a
+        ROUTING PREFERENCE, never a capability wall: any replica can
+        still serve any request when its preferred class is full.
+    host / port: the TCP listener's bind address for transport="tcp"
+        (default 127.0.0.1 / ephemeral); ignored by other kinds."""
 
-    __slots__ = ("name", "model", "config", "transport")
+    __slots__ = ("name", "model", "config", "transport", "role",
+                 "host", "port")
 
-    def __init__(self, name, model, config=None, transport="inproc"):
+    def __init__(self, name, model, config=None, transport="inproc",
+                 role="mixed", host=None, port=None):
         self.name = str(name)
         self.model = model
         self.config = config
-        if transport not in ("inproc", "proc"):
+        if transport not in ("inproc", "proc", "tcp"):
             raise ValueError(
-                f"transport must be 'inproc' or 'proc', got "
+                f"transport must be 'inproc', 'proc' or 'tcp', got "
                 f"{transport!r}")
         self.transport = transport
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'mixed', got "
+                f"{role!r}")
+        self.role = role
+        self.host = host
+        self.port = None if port is None else int(port)
 
 
 class _MigrationRelay:
@@ -499,6 +566,10 @@ class _Replica:
         return self.spec.name
 
     @property
+    def role(self):
+        return getattr(self.spec, "role", "mixed")
+
+    @property
     def accepting(self):
         return self.state == "serving" and self.transport.alive()
 
@@ -574,9 +645,20 @@ class FleetConfig:
     start: start each replica engine's background worker (tests drive
         steps themselves via run_until_idle and pass False).
     seed: the random-routing RNG seed (reproducible A/B benches).
-    transport: override EVERY spec's transport — "inproc", "proc", or
-        None (each ReplicaSpec keeps its own; the gen_bench
+    transport: override EVERY spec's transport — "inproc", "proc",
+        "tcp", or None (each ReplicaSpec keeps its own; the gen_bench
         --fleet-transport A/B flips this one knob).
+    pd_prefill_threshold_tokens: the P/D routing split — a prompt at
+        least this long prefers prefill-class replicas (whose finished
+        runs hand off to decode-class siblings); shorter interactive
+        requests prefer decode-class replicas so a prompt wave never
+        queues ahead of their first token.  Only matters when the
+        fleet has non-mixed roles.
+    min_replicas / max_replicas: the autoscaler's bounds
+        (serving/control.py FleetSupervisor spawns under sustained
+        queue depth / TTFT pressure up to `max_replicas`, drains its
+        own spawns at idle down to `min_replicas`; None max = never
+        scale up beyond the configured specs).
     live_migration: drain/crash migration ships resident sequence
         state to a sibling that RESUMES mid-decode (True, the
         default — migrated_replay_tokens stays 0); False restores the
@@ -635,7 +717,9 @@ class FleetConfig:
                  orphan_grace_s=5.0, respawn_backoff_s=0.5,
                  respawn_backoff_cap_s=30.0, max_respawns=5,
                  respawn_reset_s=30.0, fault_plans=None,
-                 watchdog_interval_s=None):
+                 watchdog_interval_s=None,
+                 pd_prefill_threshold_tokens=64,
+                 min_replicas=1, max_replicas=None):
         if routing not in ("affinity", "random"):
             raise ValueError(
                 f"routing must be 'affinity' or 'random', got {routing!r}")
@@ -650,10 +734,10 @@ class FleetConfig:
             else int(affinity_block_tokens))
         self.start = bool(start)
         self.seed = seed
-        if transport not in (None, "inproc", "proc"):
+        if transport not in (None, "inproc", "proc", "tcp"):
             raise ValueError(
-                f"transport must be 'inproc', 'proc' or None (per-spec), "
-                f"got {transport!r}")
+                f"transport must be 'inproc', 'proc', 'tcp' or None "
+                f"(per-spec), got {transport!r}")
         self.transport = transport
         self.live_migration = bool(live_migration)
         self.heartbeat_dead_after = float(heartbeat_dead_after)
@@ -702,6 +786,22 @@ class FleetConfig:
         self.watchdog_interval_s = (
             None if watchdog_interval_s is None
             else float(watchdog_interval_s))
+        if int(pd_prefill_threshold_tokens) < 1:
+            raise ValueError(
+                f"pd_prefill_threshold_tokens must be >= 1, got "
+                f"{pd_prefill_threshold_tokens}")
+        self.pd_prefill_threshold_tokens = int(pd_prefill_threshold_tokens)
+        if int(min_replicas) < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}")
+        self.min_replicas = int(min_replicas)
+        if max_replicas is not None \
+                and int(max_replicas) < self.min_replicas:
+            raise ValueError(
+                f"max_replicas must be >= min_replicas="
+                f"{self.min_replicas} or None, got {max_replicas}")
+        self.max_replicas = (None if max_replicas is None
+                             else int(max_replicas))
 
 
 class FleetRouter:
@@ -717,6 +817,10 @@ class FleetRouter:
         self.config = config or FleetConfig()
         self.metrics = metrics or FleetMetrics()
         self._page_index = FleetPrefixIndex()
+        # handoff runs awaiting a decode slot: [(item, src_name), ...].
+        # Guarded by self._lock; drained and re-parked by
+        # _collect_handoffs (backpressure instead of cold replay).
+        self._pending_handoffs = []
         cfg = self.config
         if cfg.fault_plans:
             unknown = set(cfg.fault_plans) - set(names)
@@ -749,20 +853,67 @@ class FleetRouter:
         self._watchdog_gate = threading.Lock()   # one sweep at a time
         self._watchdog_stop = threading.Event()
         self._watchdog_thread = None
-        if any(r.kind == "proc" for r in self._replicas.values()):
-            # stale-heartbeat reaping, wedge kills, and the orphan
-            # sweep cannot depend on traffic arriving: a fleet with
-            # process replicas runs a background watchdog
-            interval = cfg.watchdog_interval_s
-            if interval is None:
-                interval = max(0.05, min(cfg.heartbeat_dead_after,
-                                         cfg.wedge_after_s,
-                                         cfg.orphan_grace_s) / 4)
-            self._watchdog_interval = float(interval)
-            self._watchdog_thread = threading.Thread(
-                target=self._watchdog_loop, name="fleet-watchdog",
-                daemon=True)
-            self._watchdog_thread.start()
+        for rep in self._replicas.values():
+            self._wire_handoff(rep)
+        self._ensure_watchdog()
+
+    def _ensure_watchdog(self):
+        """Start the background watchdog when the fleet needs one:
+        process/TCP replicas (stale-heartbeat reaping, wedge kills,
+        orphan sweeps cannot depend on traffic arriving) or started
+        prefill replicas (parked handoffs must drain even when nobody
+        is calling run_until_idle).  Idempotent — add_replica() calls
+        it again when the fleet's composition changes."""
+        if self._watchdog_thread is not None:
+            return
+        cfg = self.config
+        reps = self._replicas.values()
+        if not (any(r.kind in ("proc", "tcp") for r in reps)
+                or (cfg.start and any(r.role == "prefill"
+                                      for r in reps))):
+            return
+        interval = cfg.watchdog_interval_s
+        if interval is None:
+            interval = max(0.05, min(cfg.heartbeat_dead_after,
+                                     cfg.wedge_after_s,
+                                     cfg.orphan_grace_s) / 4)
+        self._watchdog_interval = float(interval)
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog_loop, name="fleet-watchdog",
+            daemon=True)
+        self._watchdog_thread.start()
+
+    def _wire_handoff(self, rep):
+        """Event-driven prefill→decode handoff: a prefill replica's
+        transport (or inproc engine) notifies the router the moment a
+        parked run is ready, so placement latency is not bound to a
+        polling interval.  The watchdog/run_until_idle pulls stay as
+        the backstop (a notification raced with shutdown, a replica
+        rebuilt by restart())."""
+        if rep.role != "prefill":
+            return
+        if not self.config.start:
+            # stepped fleets are single-threaded by contract:
+            # run_until_idle's deterministic pull IS the collector, and
+            # a poke thread here could take a parked snap while the
+            # step loop reads "everything idle" and returns — placing
+            # work into a replica nothing will ever step again
+            return
+        eng = rep.engine
+        if eng is not None:
+            eng.on_handoff = self._poke_handoffs
+        else:
+            rep.transport.on_handoff = self._poke_handoffs
+
+    def _poke_handoffs(self):
+        """Handoff notification entry point.  Placement runs on its
+        own short-lived thread: the notifier is an engine step thread
+        or a transport reader thread, and placement may issue RPCs
+        (sibling imports, page adoption) that must never block either
+        — a reader thread waiting on its OWN channel's RPC reply would
+        deadlock until the deadline."""
+        threading.Thread(target=self._collect_handoffs,
+                         name="fleet-handoff", daemon=True).start()
 
     # --------------------------- routing ----------------------------
     def _prefix_key(self, prompt):
@@ -844,6 +995,7 @@ class FleetRouter:
             return
         try:
             cfg = self.config
+            self._collect_handoffs()
             for rep in list(self._replicas.values()):
                 if rep.state != "serving":
                     continue
@@ -864,8 +1016,90 @@ class FleetRouter:
                     for entry in orphans(cfg.orphan_grace_s):
                         self.metrics.count_orphan_remigrated()
                         self._remigrate_entry(entry, exclude=None)
+                # synthetic PING probe: an IDLE fleet sends no traffic,
+                # so a recovered replica's open breaker would never see
+                # the half-open probe request that closes it.  The
+                # watchdog claims the probe slot itself and spends a
+                # ping on it — success closes the breaker, failure
+                # re-arms the cooldown.
+                if rep.breaker.state != "closed" and rep.breaker.admit(
+                        t.heartbeat_age(), self._hb_fresh_s):
+                    self.metrics.count_ping_probe()
+                    try:
+                        t.ping()
+                    except ServingError:
+                        rep.breaker.record_failure()
+                    else:
+                        rep.breaker.record_success()
         finally:
             self._watchdog_gate.release()
+
+    # --------------------- prefill→decode handoff -------------------
+    # How long a handed-off run waits parked for a decode slot before
+    # the cold-resubmit fallback (which REPLAYS the prefill) is taken.
+    # Parking is free — the snap's pages already left the prefill pool
+    # and live parent-side — so a saturated decode class exerts plain
+    # backpressure instead of burning replayed tokens.
+    HANDOFF_PATIENCE_S = 5.0
+
+    def _collect_handoffs(self):
+        """Drain every prefill replica's parked handoffs and place each
+        finished page run on a decode-class sibling (live import — zero
+        replayed tokens).  A run no sibling can seat RIGHT NOW (decode
+        slots full) re-parks in the pending queue and is retried on
+        every later pass; only past HANDOFF_PATIENCE_S does it fall to
+        the cold seeded resubmit.  Called event-driven (transport/
+        engine handoff notifications), from every watchdog sweep, and
+        from run_until_idle — all paths funnel through the same
+        placement so a handoff can never strand.  Returns the number
+        of runs moved."""
+        if self._closed:
+            return 0
+        with self._lock:
+            pending, self._pending_handoffs = self._pending_handoffs, []
+        for rep in list(self._replicas.values()):
+            if rep.role != "prefill" or rep.state in ("stopped", "dead"):
+                continue
+            take = getattr(rep.transport, "take_handoffs", None)
+            if take is None:
+                continue
+            try:
+                items = take()
+            except ServingError:
+                continue
+            pending.extend((item, rep.name) for item in items)
+        moved = 0
+        parked = []
+        for item, src in pending:
+            if self._place_handoff(item, exclude=src):
+                moved += 1
+            else:
+                parked.append((item, src))
+        if parked:
+            with self._lock:
+                # new arrivals raced in behind us; keep oldest first
+                self._pending_handoffs = parked + self._pending_handoffs
+        return moved
+
+    def _place_handoff(self, item, exclude):
+        """Place ONE handed-off run.  The snap's pages were freed at
+        export (the bytes ride the snap), so the prefill replica's
+        pool is already clear; placement is exactly the live-migration
+        ladder with the decode class preferred.  Returns True when the
+        run found a home (live adoption, or — past the patience
+        window — the cold ladder), False to re-park and retry."""
+        snap = item["snap"]
+        now = time.monotonic()
+        waited = max(0.0, now - item.get("t", now))
+        patient = waited < self.HANDOFF_PATIENCE_S
+        adopted = self._migrate_live(snap, exclude=exclude,
+                                     prefer_role="decode",
+                                     cold_fallback=not patient)
+        if not adopted and patient:
+            return False
+        self.metrics.count_pd_handoff(
+            int(snap.get("cache_len") or 0), waited)
+        return True
 
     def _kill_replica(self, rep):
         kill = getattr(rep.transport, "kill", None)
@@ -936,7 +1170,7 @@ class FleetRouter:
             self.metrics.count_prefix_confirmed(hit > 0)
 
     def _route_and_submit(self, prompt, kwargs, handle, session,
-                          exclude=None):
+                          exclude=None, prefer_role=None):
         """Run the ladder, count the rung that actually placed the
         request, and return (handle, replica).  Raises ServerBusyError
         (shed — every candidate's gate closed, admission OR breaker)
@@ -945,7 +1179,17 @@ class FleetRouter:
         (candidates, index lookup, ladder, session pins); RPCs —
         page-adoption transfers and the submits themselves — run
         OUTSIDE it, so one slow replica can never serialize fleet
-        admission."""
+        admission.
+
+        P/D RUNG (ahead of the affinity ladder): in a fleet with
+        non-mixed roles, a prompt past `pd_prefill_threshold_tokens`
+        prefers the prefill class and anything shorter prefers the
+        decode class (mixed replicas belong to both) — the full
+        session/prefix/load ladder runs WITHIN the preferred class,
+        then the remaining candidates follow load-ordered, so role is
+        a preference and never a hard failure.  `prefer_role`
+        overrides the length split (the handoff fallback pins
+        "decode")."""
         prompt = list(prompt)
         self._watchdog()
         with self._lock:
@@ -977,8 +1221,27 @@ class FleetRouter:
                     and self.config.page_service:
                 self._pull_prefix_deltas()
                 lookup = self._index_lookup(prompt)
-            prefs = self._ladder(session, key, candidates,
-                                 holder=lookup[0] if lookup else None)
+            holder = lookup[0] if lookup else None
+            role_pref = prefer_role
+            if role_pref is None and any(
+                    r.role != "mixed"
+                    for r in self._replicas.values()):
+                role_pref = (
+                    "prefill" if len(prompt) >=
+                    self.config.pd_prefill_threshold_tokens
+                    else "decode")
+            pref_c = ([r for r in candidates
+                       if r.role in (role_pref, "mixed")]
+                      if role_pref is not None else candidates)
+            if role_pref is not None and pref_c:
+                prefs = self._ladder(session, key, pref_c,
+                                     holder=holder)
+                prefs += self._ladder(
+                    None, None,
+                    [r for r in candidates if r not in pref_c])
+            else:
+                prefs = self._ladder(session, key, candidates,
+                                     holder=holder)
         last_busy = None
         adoption_tried = False
         for i, (rung, rep) in enumerate(prefs):
@@ -1028,6 +1291,8 @@ class FleetRouter:
                 self.metrics.count_routed(rung)
             else:
                 self.metrics.count_spill()
+            if role_pref is not None and rep.role == role_pref:
+                self.metrics.count_routed_role()
             if rung == "prefix" and i == 0:
                 client = (handle.client_and_delivered()[0]
                           if isinstance(handle, _MigrationRelay)
@@ -1155,10 +1420,17 @@ class FleetRouter:
         rep.respawns = 0   # a clean drain is not a crash: restart
         # owes no backoff
 
-    def _migrate_live(self, snap, exclude):
+    def _migrate_live(self, snap, exclude, prefer_role=None,
+                      cold_fallback=True):
         """Place one exported resident on a sibling that RESUMES its
         decode (zero replayed tokens); falls back to the cold-resubmit
-        ladder when no sibling can adopt it right now."""
+        ladder when no sibling can adopt it right now.  `prefer_role`
+        (the P/D handoff path passes "decode") stable-partitions the
+        candidates so role-matched (+ mixed) siblings are tried first,
+        least loaded within each class — a preference, never a wall.
+        With `cold_fallback=False` the run is simply reported unplaced
+        (False) so the caller can re-park it instead of paying the
+        replay.  Returns True when a sibling adopted the run live."""
         handle = snap.get("future")
         remaining = max(1, snap["max_new_tokens"] - snap["n_generated"])
         with self._lock:
@@ -1169,17 +1441,22 @@ class FleetRouter:
                  and r.breaker.routable(r.transport.heartbeat_age(),
                                         self._hb_fresh_s)),
                 key=lambda r: r.load())
+        if prefer_role is not None:
+            cands.sort(key=lambda r: r.role not in (prefer_role,
+                                                    "mixed"))
         for rep in cands:
             try:
                 if rep.transport.import_sequence(snap):
                     self.metrics.count_live_migrated()
-                    return
+                    return True
             except ReplicaTimeoutError:
                 self.metrics.count_replica_timeout()
                 rep.breaker.record_failure()
                 continue
             except ServingError:
                 continue
+        if not cold_fallback:
+            return False
         # cold fallback: seeded sampling replays the identical stream,
         # the relay swallows what the client already saw
         req = GenerationRequest(
@@ -1187,9 +1464,11 @@ class FleetRouter:
             max_new_tokens=snap["max_new_tokens"],
             stop_tokens=snap["stop_tokens"],
             deadline=snap.get("deadline"))
-        self._migrate(req, snap["n_generated"], exclude=exclude)
+        self._migrate(req, snap["n_generated"], exclude=exclude,
+                      prefer_role=prefer_role)
+        return True
 
-    def _migrate(self, req, emitted, exclude):
+    def _migrate(self, req, emitted, exclude, prefer_role=None):
         """Cold-resubmit one evacuated request on a sibling, preserving
         the client's handle and stream position.  The skipped replay
         is the live-migration A/B's accounting: every token the relay
@@ -1217,7 +1496,8 @@ class FleetRouter:
                 dict(max_new_tokens=req.max_new_tokens,
                      sampling=req.params,
                      stop_tokens=req.stop_tokens, timeout_ms=timeout_ms),
-                engine_handle, session=None, exclude=exclude)
+                engine_handle, session=None, exclude=exclude,
+                prefer_role=prefer_role)
         except ServingError as e:
             # nowhere to go (typed: busy/too-large/drained) — the
             # client holds the handle, so the error lands there
@@ -1314,6 +1594,19 @@ class FleetRouter:
                 del self._sessions[sess]
         self.metrics.count_replica_dead()
         self._page_index.drop_replica(rep.name)
+        # handoff snaps live PARENT-side (the worker shipped the bytes
+        # before dying), so a prefill replica SIGKILLed mid-handoff
+        # loses nothing: place what already arrived, and anything whose
+        # handoff frame never made it is still in the in-flight ledger
+        # below — cold remigration with replay skip covers it.
+        take = getattr(transport, "take_handoffs", None)
+        if take is not None:
+            for item in take():
+                if not self._place_handoff(item, exclude=rep.name):
+                    # decode class momentarily full: park it — the
+                    # watchdog's collection sweep retries
+                    with self._lock:
+                        self._pending_handoffs.append((item, rep.name))
         for entry in transport.take_inflight():
             self._remigrate_entry(entry, exclude=rep.name)
 
@@ -1408,6 +1701,7 @@ class FleetRouter:
             if rep.state == "dead":
                 rep.transport.stop()   # reap the corpse
             rep.build(self.config.start)
+            self._wire_handoff(rep)
 
     def reset_respawn(self, name):
         """Operator override: clear `name`'s crash-loop streak (and
@@ -1420,6 +1714,63 @@ class FleetRouter:
             rep.breaker.reset()
         self.metrics.set_respawn_backoff(name, 0.0)
 
+    # ------------------------- fleet scaling ------------------------
+    def add_replica(self, spec, start=None):
+        """Register and build ONE new replica at runtime — the
+        autoscaler's scale-up primitive (and an operator's).  The
+        replica is built OUTSIDE the routing lock (a process spawn
+        must never serialize admission) and joins the candidate set
+        the moment it registers; the watchdog starts if the fleet's
+        composition now needs one.  Returns the replica name."""
+        cfg = self.config
+        with self._lock:
+            if self._closed:
+                raise ServingError("fleet router is shut down")
+            if spec.name in self._replicas:
+                raise ValueError(
+                    f"duplicate replica name {spec.name!r}")
+        rpc = RpcPolicy(cfg.rpc_timeout_s, cfg.rpc_retries,
+                        cfg.rpc_backoff_s, seed=cfg.seed or 0)
+        rep = _Replica(
+            spec, cfg.start if start is None else start,
+            cfg.transport or spec.transport,
+            on_death=self._on_transport_death, rpc=rpc,
+            breaker=CircuitBreaker(
+                cfg.breaker_threshold, cfg.breaker_cooldown_s,
+                on_open=self._on_breaker_open))
+        self._wire_handoff(rep)
+        with self._lock:
+            if self._closed or spec.name in self._replicas:
+                rep.transport.stop()   # lost the registration race
+                raise ServingError(
+                    f"cannot register replica {spec.name!r}: fleet "
+                    f"closed or name taken during build")
+            self._replicas[spec.name] = rep
+        self._ensure_watchdog()
+        self.metrics.set_replica_count(
+            sum(1 for r in self._replicas.values()
+                if r.state == "serving"))
+        return rep.name
+
+    def remove_replica(self, name, timeout=30.0):
+        """Drain `name` (unfinished work migrates to siblings) and
+        forget it entirely — the autoscaler's scale-down primitive.
+        A dead replica is reaped instead of drained."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+        if rep.state == "serving":
+            self.drain(name, migrate=True, timeout=timeout)
+        elif rep.state == "dead":
+            rep.transport.stop()
+            rep.state = "stopped"
+        with self._lock:
+            self._replicas.pop(name, None)
+        self.metrics.set_replica_count(
+            sum(1 for r in self._replicas.values()
+                if r.state == "serving"))
+
     # --------------------------- lifecycle --------------------------
     def run_until_idle(self, max_steps=100000):
         """Drive every live replica until queues and slots drain —
@@ -1428,8 +1779,9 @@ class FleetRouter:
         which always step themselves) are simply waited on."""
         steps = 0
         while True:
-            busy = False
-            for rep in self._replicas.values():
+            busy = (bool(self._collect_handoffs())
+                    or bool(self._pending_handoffs))
+            for rep in list(self._replicas.values()):
                 if rep.state in ("stopped", "dead"):
                     continue
                 t = rep.transport
@@ -1457,7 +1809,7 @@ class FleetRouter:
         depths = []
         ages = []
         breaker_scores = []
-        for name, rep in self._replicas.items():
+        for name, rep in list(self._replicas.items()):
             if rep.state in ("stopped", "dead"):
                 # a stopped replica queues nothing: zero its gauges so
                 # a dashboard never shows pre-drain depth on a dead slot
@@ -1483,6 +1835,7 @@ class FleetRouter:
             replicas[name] = {
                 "state": rep.state,
                 "transport": rep.kind,
+                "role": rep.role,
                 "queue_depth": depth,
                 "active": info["active"],
                 "load": round(rep.load(), 3),
@@ -1500,6 +1853,9 @@ class FleetRouter:
         self.metrics.set_max_heartbeat_age(max(ages, default=0.0))
         self.metrics.set_max_breaker_state(max(breaker_scores,
                                                default=0))
+        self.metrics.set_replica_count(
+            sum(1 for r in self._replicas.values()
+                if r.state == "serving"))
         return {"fleet": self.metrics.snapshot(),
                 "prefix_index_chains": self._page_index.chains_held(),
                 "replicas": replicas}

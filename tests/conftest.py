@@ -27,6 +27,11 @@ def pytest_configure(config):
     # are deselectable for quick local iteration: -m "not perf"
     config.addinivalue_line(
         "markers", "perf: perf-rail measurement (deselect with -m 'not perf')")
+    # multi-process soak tests (subprocess fleets under chaos/SIGKILL)
+    # cost tens of seconds each on one core; tier-1 runs -m 'not slow'
+    # and keeps the cheap inproc siblings of every one of them
+    config.addinivalue_line(
+        "markers", "slow: heavyweight soak (deselected by tier-1)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,383 @@
+"""The self-healing control plane: FleetSupervisor (serving/control.py)
+plus the fleet-scaling primitives it drives (add_replica /
+remove_replica) and the watchdog's synthetic ping probes.
+
+The router is mechanism, the supervisor is policy — so the tests
+drive tick() synchronously (deterministic) and reserve the background
+thread for one end-to-end resurrection:
+
+- RESURRECT: dead replicas get restart(wait=False), RESPECTING the
+  router's respawn discipline — backoff owed means retry next tick,
+  a crash-loop streak past max_respawns is left for the operator.
+- SCALE UP: only after `sustain_ticks` CONSECUTIVE pressure ticks,
+  only with a spec_factory, only below max_replicas.
+- SCALE DOWN: only after `idle_ticks` consecutive fully-idle ticks,
+  only the supervisor's OWN spawns (LIFO), never below min_replicas —
+  the operator's configured fleet is never shrunk.
+"""
+import time
+
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.admission import ServingError
+from paddle_tpu.serving.control import FleetSupervisor, SupervisorConfig
+from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                      ReplicaSpec)
+
+from dist_capability import (SUBPROC_SKIP_REASON,  # noqa: E402
+                             subprocess_replicas_available)
+from gen_oracle import greedy_oracle as _ref  # noqa: E402
+
+needs_subproc = pytest.mark.skipif(
+    not subprocess_replicas_available(), reason=SUBPROC_SKIP_REASON)
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _cfg(**kw):
+    base = dict(max_decode_slots=4, num_pages=64, page_size=4,
+                prefix_cache=True)
+    base.update(kw)
+    return gen.GenerationConfig(**base)
+
+
+def _stat(name):
+    return StatRegistry.instance().get_stat(name).get()
+
+
+def _fleet(model, n=1, **kw):
+    specs = [ReplicaSpec(f"r{i}", model, _cfg()) for i in range(n)]
+    base = dict(start=False, seed=0)
+    base.update(kw)
+    return FleetRouter(specs, FleetConfig(**base))
+
+
+# ----------------------------- config ------------------------------------
+
+
+def test_supervisor_config_validates():
+    cfg = SupervisorConfig()
+    assert cfg.sustain_ticks == 3 and cfg.idle_ticks == 8
+    with pytest.raises(ValueError, match="interval_s"):
+        SupervisorConfig(interval_s=0)
+    with pytest.raises(ValueError, match="scale_up_queue_depth"):
+        SupervisorConfig(scale_up_queue_depth=-1)
+    with pytest.raises(ValueError, match="scale_up_ttft_s"):
+        SupervisorConfig(scale_up_ttft_s=0)
+    with pytest.raises(ValueError, match="sustain_ticks"):
+        SupervisorConfig(sustain_ticks=0)
+    with pytest.raises(ValueError, match="idle_ticks"):
+        SupervisorConfig(idle_ticks=0)
+
+
+# -------------------------- fleet scaling API -----------------------------
+
+
+def test_add_remove_replica_router_primitives(model):
+    fl = _fleet(model, n=1)
+    try:
+        with pytest.raises(ValueError, match="duplicate"):
+            fl.add_replica(ReplicaSpec("r0", model, _cfg()))
+        with pytest.raises(KeyError):
+            fl.remove_replica("ghost")
+        name = fl.add_replica(ReplicaSpec("late", model, _cfg()))
+        assert name == "late"
+        # the new replica is immediately routable: saturate r0's
+        # admission so the ladder spills onto it
+        per_before = fl.stats_snapshot()["replicas"]
+        assert "late" in per_before
+        h = fl.submit(SYSTEM, max_new_tokens=4)
+        fl.run_until_idle()
+        assert h.result(timeout=10).token_ids == _ref(model, SYSTEM, 4)
+        fl.remove_replica("late")
+        assert "late" not in fl.stats_snapshot()["replicas"]
+    finally:
+        fl.shutdown()
+
+
+def test_replica_count_gauge_tracks_scaling(model):
+    fl = _fleet(model, n=1)
+    try:
+        fl.stats_snapshot()
+        assert _stat(fleet_mod.REPLICA_COUNT) == 1
+        fl.add_replica(ReplicaSpec("x", model, _cfg()))
+        assert _stat(fleet_mod.REPLICA_COUNT) == 2
+        fl.remove_replica("x")
+        assert _stat(fleet_mod.REPLICA_COUNT) == 1
+    finally:
+        fl.shutdown()
+
+
+# ----------------------------- resurrection -------------------------------
+
+
+def test_tick_resurrects_dead_replica(model):
+    """Deterministic resurrection: mark the replica dead (a clean
+    streak owes no backoff), one tick heals it, and it serves."""
+    fl = _fleet(model, n=1)
+    sup = FleetSupervisor(fl)
+    try:
+        rep = fl._replicas["r0"]
+        rep.transport.stop()
+        rep.state = "dead"
+        rep.died_at = time.monotonic()
+        rep.respawns = 0               # died after a long healthy run
+        out = sup.tick()
+        assert out["healed"] == 1
+        assert rep.state == "serving"
+        assert _stat(fleet_mod.SUPERVISOR_RESTART_TOTAL) == 1
+        h = fl.submit(SYSTEM, max_new_tokens=4)
+        fl.run_until_idle()
+        assert h.result(timeout=10).token_ids == _ref(model, SYSTEM, 4)
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_tick_respects_respawn_backoff(model):
+    """A quick death owes backoff: tick() must NOT bypass it (the
+    wait=False restart raises typed and the supervisor retries next
+    tick) — then heals once the debt is paid."""
+    fl = _fleet(model, n=1, respawn_backoff_s=5.0)
+    sup = FleetSupervisor(fl)
+    try:
+        rep = fl._replicas["r0"]
+        rep.transport.stop()
+        rep.state = "dead"
+        rep.respawns = 1               # quick death: streak of one
+        rep.died_at = time.monotonic()
+        assert sup.tick()["healed"] == 0        # 5s still owed
+        assert rep.state == "dead"
+        rep.died_at = time.monotonic() - 10.0   # debt paid
+        assert sup.tick()["healed"] == 1
+        assert rep.state == "serving"
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_tick_respects_crash_loop_cap(model):
+    """A streak past max_respawns is the operator's problem: the
+    supervisor leaves it dead, and reset_respawn() is the documented
+    override that lets the next tick heal."""
+    fl = _fleet(model, n=1, max_respawns=2, respawn_backoff_s=0.0)
+    sup = FleetSupervisor(fl)
+    try:
+        rep = fl._replicas["r0"]
+        rep.transport.stop()
+        rep.state = "dead"
+        rep.respawns = 3               # > max_respawns: crash loop
+        rep.died_at = time.monotonic()
+        for _ in range(3):
+            assert sup.tick()["healed"] == 0
+        assert rep.state == "dead"
+        fl.reset_respawn("r0")
+        assert sup.tick()["healed"] == 1
+        assert rep.state == "serving"
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+@pytest.mark.slow
+@needs_subproc
+def test_supervisor_resurrects_sigkilled_worker_end_to_end(model):
+    """THE acceptance path: a SIGKILLed subprocess replica comes back
+    with ZERO router calls from this test body — the watchdog detects
+    the death, the supervisor's background loop restarts it, and a
+    fresh submit serves from the resurrected worker."""
+    fl = _fleet(model, n=1, start=True, transport="proc",
+                heartbeat_dead_after=2.0, watchdog_interval_s=0.1,
+                respawn_backoff_s=0.05)
+    sup = FleetSupervisor(fl, config=SupervisorConfig(interval_s=0.1))
+    try:
+        sup.start()
+        h = fl.submit(SYSTEM, max_new_tokens=4)
+        assert h.result(timeout=60).token_ids == _ref(model, SYSTEM, 4)
+        fl._replicas["r0"].transport.kill()
+        deadline = time.monotonic() + 60
+        while fl._replicas["r0"].state != "serving":
+            assert time.monotonic() < deadline, "never resurrected"
+            time.sleep(0.1)
+        # the stat lands just after restart() flips the state — allow
+        # the supervisor thread that instant
+        while _stat(fleet_mod.SUPERVISOR_RESTART_TOTAL) < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        h2 = fl.submit(SYSTEM + [7], max_new_tokens=4)
+        assert h2.result(timeout=60).token_ids == \
+            _ref(model, SYSTEM + [7], 4)
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+# ------------------------------ autoscaler --------------------------------
+
+
+def _pressured_fleet_and_sup(model, sustain=2, **fleet_kw):
+    kw = dict(max_replicas=3, min_replicas=1)
+    kw.update(fleet_kw)
+    fl = _fleet(model, n=1, **kw)
+    sup = FleetSupervisor(
+        fl, spec_factory=lambda i: ReplicaSpec(f"auto{i}", model,
+                                               _cfg()),
+        config=SupervisorConfig(scale_up_queue_depth=0.5,
+                                sustain_ticks=sustain, idle_ticks=2))
+    return fl, sup
+
+
+def test_autoscaler_spawns_only_after_sustained_pressure(model):
+    fl, sup = _pressured_fleet_and_sup(model, sustain=2)
+    try:
+        # start=False + no stepping: submits sit in the queue, so
+        # every tick reads depth >= 0.5 — deterministic pressure
+        hs = [fl.submit(SYSTEM, max_new_tokens=4) for _ in range(4)]
+        first = sup.tick()
+        assert not first["spawned"]        # one pressure tick != sustained
+        second = sup.tick()
+        assert second["spawned"]           # sustained: spawn exactly one
+        assert "auto0" in fl._replicas
+        assert _stat(fleet_mod.AUTOSCALE_SPAWNED) == 1
+        fl.run_until_idle()
+        for h in hs:
+            assert h.result(timeout=10).finish_reason == "length"
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_autoscaler_respects_max_replicas(model):
+    fl, sup = _pressured_fleet_and_sup(model, sustain=1,
+                                       max_replicas=2)
+    try:
+        hs = [fl.submit(SYSTEM, max_new_tokens=4) for _ in range(4)]
+        assert sup.tick()["spawned"]       # 1 -> 2
+        for _ in range(4):                 # at the cap: never a third
+            assert not sup.tick()["spawned"]
+        assert len(fl._replicas) == 2
+        fl.run_until_idle()
+        for h in hs:
+            h.result(timeout=10)
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_autoscaler_drains_only_own_spawns_to_min(model):
+    """After the load passes, sustained idle drains the supervisor's
+    spawn — and ONLY its spawn: the operator's base replica survives
+    unbounded idle ticks."""
+    fl, sup = _pressured_fleet_and_sup(model, sustain=1)
+    try:
+        hs = [fl.submit(SYSTEM, max_new_tokens=4) for _ in range(4)]
+        assert sup.tick()["spawned"]
+        fl.run_until_idle()
+        for h in hs:
+            h.result(timeout=10)
+        drains = [sup.tick()["drained"] for _ in range(3)]
+        assert drains == [False, True, False]   # idle_ticks=2, LIFO
+        assert "auto0" not in fl._replicas
+        assert "r0" in fl._replicas
+        assert _stat(fleet_mod.AUTOSCALE_DRAINED) == 1
+        for _ in range(6):                  # base fleet never shrinks
+            assert not sup.tick()["drained"]
+        assert "r0" in fl._replicas
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_autoscaler_inert_without_spec_factory(model):
+    fl = _fleet(model, n=1, max_replicas=3)
+    sup = FleetSupervisor(fl, config=SupervisorConfig(
+        scale_up_queue_depth=0.5, sustain_ticks=1))
+    try:
+        fl.submit(SYSTEM, max_new_tokens=4)
+        for _ in range(3):
+            assert not sup.tick()["spawned"]
+        assert len(fl._replicas) == 1
+        fl.run_until_idle()
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_supervisor_context_manager_runs_background_loop(model):
+    fl = _fleet(model, n=1)
+    rep = fl._replicas["r0"]
+    rep.transport.stop()
+    rep.state = "dead"
+    rep.died_at = time.monotonic()
+    rep.respawns = 0
+    try:
+        with FleetSupervisor(
+                fl, config=SupervisorConfig(interval_s=0.05)) as sup:
+            sup.start()
+            deadline = time.monotonic() + 10
+            while rep.state != "serving":
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+        assert sup._thread is None         # stop() joined it
+    finally:
+        fl.shutdown()
+
+
+# ----------------------------- ping probes --------------------------------
+
+
+def test_watchdog_ping_probe_recovers_idle_breaker(model):
+    """An OPEN breaker on an IDLE fleet: no client traffic will ever
+    probe the half-open slot, so the watchdog's synthetic ping must —
+    one sweep after the cooldown, the breaker is closed again."""
+    fl = _fleet(model, n=1, start=True, breaker_cooldown_s=0.05,
+                watchdog_interval_s=0.05)
+    try:
+        rep = fl._replicas["r0"]
+        for _ in range(fl.config.breaker_threshold):
+            rep.breaker.record_failure()
+        assert rep.breaker.state == "open"
+        time.sleep(0.1)                    # cooldown elapses
+        deadline = time.monotonic() + 10
+        while rep.breaker.state != "closed":
+            assert time.monotonic() < deadline
+            fl.stats_snapshot()            # drives the watchdog sweep
+            time.sleep(0.05)
+        assert _stat(fleet_mod.PING_PROBE_TOTAL) >= 1
+    finally:
+        fl.shutdown()
+
+
+def test_ping_probe_failure_reopens_breaker(model):
+    """A half-open probe against a replica whose engine is GONE must
+    re-open the breaker (typed failure), not close it."""
+    fl = _fleet(model, n=1, start=True, breaker_cooldown_s=0.05,
+                watchdog_interval_s=0.05)
+    try:
+        rep = fl._replicas["r0"]
+        rep.transport.engine.shutdown()    # ping now raises typed
+        for _ in range(fl.config.breaker_threshold):
+            rep.breaker.record_failure()
+        time.sleep(0.1)
+        fl.stats_snapshot()
+        assert rep.breaker.state == "open"
+    finally:
+        fl.shutdown()

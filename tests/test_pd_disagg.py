@@ -1,0 +1,322 @@
+"""Prefill/decode disaggregation as a ROUTING POLICY, plus the cancel
+transport op (ISSUE 17 rung 2).
+
+The contract under test:
+
+- A prefill-class replica parks every sequence the moment its prompt
+  is consumed (the exact live-migration export: page bytes, RNG,
+  counters); the router collects the parked snapshot and places it on
+  a decode-class sibling via import_sequence with base=n_generated —
+  so the split is ZERO-REPLAY by construction, and the client stream
+  is one exact prefix regardless of which side emitted what.
+- Role is a routing PREFERENCE, never a wall: prompts at or past
+  `pd_prefill_threshold_tokens` prefer the prefill class, shorter
+  ones the decode class, mixed replicas belong to both — and a fleet
+  of all-mixed replicas (the ablation baseline) never hands off.
+- cancel(handle) frees the queue slot and pages wherever the request
+  lives and resolves the client with finish_reason="cancelled" —
+  an abandoning client never hangs and never keeps paying.
+"""
+import time
+
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation.sampling import SamplingParams
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                      ReplicaSpec)
+
+from dist_capability import (SUBPROC_SKIP_REASON,  # noqa: E402
+                             subprocess_replicas_available)
+from gen_oracle import greedy_oracle as _ref  # noqa: E402
+
+needs_subproc = pytest.mark.skipif(
+    not subprocess_replicas_available(), reason=SUBPROC_SKIP_REASON)
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]   # 12 tokens
+LONG = [SYSTEM + [7, 7], SYSTEM + [1], SYSTEM + [9, 9, 9], SYSTEM + [2]]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _cfg(**kw):
+    base = dict(max_decode_slots=4, num_pages=64, page_size=4,
+                prefix_cache=True)
+    base.update(kw)
+    return gen.GenerationConfig(**kw and base or base)
+
+
+def _stat(name):
+    return StatRegistry.instance().get_stat(name).get()
+
+
+def _split_fleet(model, threshold=8, n_decode=1, **fleet_kw):
+    """One prefill replica + n decode replicas, threshold low enough
+    that every LONG prompt classifies as prefill work."""
+    specs = [ReplicaSpec("pf0", model, _cfg(), role="prefill")]
+    specs += [ReplicaSpec(f"dc{i}", model, _cfg(), role="decode")
+              for i in range(n_decode)]
+    kw = dict(start=True, seed=0,
+              pd_prefill_threshold_tokens=threshold)
+    kw.update(fleet_kw)
+    return FleetRouter(specs, FleetConfig(**kw))
+
+
+def _requests_per_replica(fl):
+    snap = fl.stats_snapshot()
+    return {n: r.get("generation", {}).get(
+                "generation.requests_total", 0)
+            for n, r in snap["replicas"].items()}
+
+
+# --------------------------- the split path ------------------------------
+
+
+def test_split_fleet_token_identity_zero_replay(model):
+    """The headline invariant: split P/D streams are token-identical
+    to the single-engine oracle, every long prompt hands off exactly
+    once, and the import-at-base design replays ZERO tokens."""
+    fl = _split_fleet(model)
+    try:
+        hs = [fl.submit(p, max_new_tokens=8) for p in LONG]
+        for p, h in zip(LONG, hs):
+            r = h.result(timeout=60)
+            assert r.token_ids == _ref(model, p, 8)
+            assert r.finish_reason == "length"
+        assert _stat(fleet_mod.PD_HANDOFFS) == len(LONG)
+        assert _stat(fleet_mod.PD_HANDOFF_TOKENS) >= len(LONG)
+        assert _stat(fleet_mod.PD_HANDOFF_WALL_S) >= 0.0
+        assert _stat(fleet_mod.ROUTED_ROLE) == len(LONG)
+        assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == 0
+        assert _stat(fleet_mod.LIVE_MIGRATED_TOTAL) == len(LONG)
+    finally:
+        fl.shutdown()
+
+
+def test_split_fleet_stochastic_stream_through_handoff(model):
+    """Seeded sampling survives the handoff: the RNG state rides the
+    snapshot, so the decode side continues the SAME stream the
+    prefill side started — identical to one engine end to end."""
+    sp = SamplingParams(temperature=0.8, top_k=6, seed=77)
+    fl = _split_fleet(model)
+    try:
+        h = fl.submit(SYSTEM, max_new_tokens=10, sampling=sp)
+        got = h.result(timeout=60).token_ids
+    finally:
+        fl.shutdown()
+    eng = gen.GenerationEngine(model, _cfg(), start=False)
+    ho = eng.submit(SYSTEM, max_new_tokens=10,
+                    sampling=SamplingParams(temperature=0.8, top_k=6,
+                                            seed=77))
+    eng.run_until_idle()
+    assert got == ho.result(timeout=10).token_ids
+    assert _stat(fleet_mod.PD_HANDOFFS) == 1
+    eng.shutdown()
+
+
+def test_role_threshold_segregates_traffic(model):
+    """Short interactive prompts route to the decode class and stay
+    there; long prompts prefill on the prefill class and hand off.
+    requests_total counts SUBMITTED work, so the split is visible
+    per replica."""
+    fl = _split_fleet(model, threshold=10)
+    try:
+        short = [fl.submit([5, 6], max_new_tokens=4)
+                 for _ in range(3)]
+        longs = [fl.submit(p, max_new_tokens=4) for p in LONG[:2]]
+        for h, p in zip(short, [[5, 6]] * 3):
+            assert h.result(timeout=60).token_ids == _ref(model, p, 4)
+        for h, p in zip(longs, LONG[:2]):
+            assert h.result(timeout=60).token_ids == _ref(model, p, 4)
+        per = _requests_per_replica(fl)
+        assert per["pf0"] == 2          # only the long prompts
+        # the decode replica ran the 3 short prompts PLUS the 2
+        # imported continuations (import_sequence counts a request)
+        assert per["dc0"] == 5
+        assert _stat(fleet_mod.PD_HANDOFFS) == 2
+        # 5 client submits, both classes count; a handoff that falls
+        # to the cold ladder (decode slots momentarily full) counts
+        # its decode-pinned placement too
+        assert _stat(fleet_mod.ROUTED_ROLE) >= 5
+    finally:
+        fl.shutdown()
+
+
+def test_mixed_ablation_never_hands_off(model):
+    """role="mixed" everywhere is the A/B baseline: same prompts,
+    token-identical, zero handoffs, zero role routing — the P/D rung
+    is provably inert without roles."""
+    specs = [ReplicaSpec(f"m{i}", model, _cfg()) for i in range(2)]
+    fl = FleetRouter(specs, FleetConfig(start=True, seed=0,
+                                        pd_prefill_threshold_tokens=8))
+    try:
+        hs = [fl.submit(p, max_new_tokens=8) for p in LONG]
+        for p, h in zip(LONG, hs):
+            assert h.result(timeout=60).token_ids == _ref(model, p, 8)
+        assert _stat(fleet_mod.PD_HANDOFFS) == 0
+        assert _stat(fleet_mod.ROUTED_ROLE) == 0
+    finally:
+        fl.shutdown()
+
+
+def test_stepped_fleet_collects_handoffs_without_threads(model):
+    """The pull model needs no wakeups: a start=False fleet moves
+    parked snapshots through run_until_idle's collection backstop —
+    fully deterministic, single-threaded."""
+    fl = _split_fleet(model, start=False)
+    try:
+        h = fl.submit(SYSTEM, max_new_tokens=6)
+        fl.run_until_idle()
+        assert h.result(timeout=10).token_ids == _ref(model, SYSTEM, 6)
+        assert _stat(fleet_mod.PD_HANDOFFS) == 1
+        assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == 0
+    finally:
+        fl.shutdown()
+
+
+def test_watchdog_backstop_collects_when_poke_disabled(model):
+    """Event wakeups are an optimization, not a correctness
+    dependency: with the prefill engine's on_handoff notification
+    severed, the router watchdog's periodic collection still moves
+    the parked snapshot and the stream completes."""
+    fl = _split_fleet(model, watchdog_interval_s=0.05)
+    try:
+        fl._replicas["pf0"].transport.engine.on_handoff = None
+        h = fl.submit(SYSTEM, max_new_tokens=6)
+        assert h.result(timeout=30).token_ids == _ref(model, SYSTEM, 6)
+        assert _stat(fleet_mod.PD_HANDOFFS) == 1
+    finally:
+        fl.shutdown()
+
+
+def test_prefill_death_after_handoff_loses_nothing(model):
+    """A prefill replica dying right after its snapshots were parked
+    parent-side: _handle_death drains the parked handoffs FIRST, so
+    the streams complete on the decode class with zero replay."""
+    fl = _split_fleet(model, start=False)
+    try:
+        h = fl.submit(SYSTEM, max_new_tokens=8)
+        pf = fl._replicas["pf0"]
+        # park the snapshot inside the prefill engine, then kill the
+        # replica before ANY collection ran
+        eng = pf.transport.engine
+        eng.on_handoff = None
+        for _ in range(50):
+            if eng.handoffs_pending():
+                break
+            eng.step()
+        assert eng.handoffs_pending()
+        pf.state = "dead"
+        for item in pf.transport.take_handoffs():
+            fl._place_handoff(item, exclude="pf0")
+        fl.run_until_idle()
+        assert h.result(timeout=10).token_ids == _ref(model, SYSTEM, 8)
+        assert _stat(fleet_mod.PD_HANDOFFS) == 1
+        assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == 0
+    finally:
+        fl.shutdown()
+
+
+@pytest.mark.slow
+@needs_subproc
+def test_prefill_sigkill_over_proc_streams_complete(model):
+    """The acceptance drill: SIGKILL the prefill replica mid-wave over
+    a real process boundary.  Parent-side parked snapshots and the
+    in-flight ledger together guarantee every stream completes
+    token-identical, and the decode pools leak nothing.  (Replay MAY
+    be nonzero here: a kill can land before the handoff frame left.)"""
+    fl = _split_fleet(model, transport="proc", n_decode=1,
+                      respawn_backoff_s=0.05,
+                      heartbeat_dead_after=2.0,
+                      watchdog_interval_s=0.1)
+    try:
+        hs = [fl.submit(p, max_new_tokens=8) for p in LONG]
+        time.sleep(0.2)
+        fl._replicas["pf0"].transport.kill()
+        for p, h in zip(LONG, hs):
+            assert h.result(timeout=120).token_ids == _ref(model, p, 8)
+        # every page accounted for on the survivor
+        dc = fl._replicas["dc0"].transport
+        dc.flush_prefix()
+        deadline = time.monotonic() + 30
+        while dc.stats()["cache"]["pages_in_use"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+            dc.flush_prefix()
+    finally:
+        fl.shutdown()
+
+
+# ------------------------------ cancel -----------------------------------
+
+
+def test_engine_cancel_active_stream_frees_everything(model):
+    """Cancel a live decode slot: the stream resolves with
+    finish_reason="cancelled" and an exact prefix, the slot frees,
+    and after a flush the pool holds zero pages."""
+    eng = gen.GenerationEngine(model, _cfg(), start=False)
+    h = eng.submit(SYSTEM, max_new_tokens=200)
+    for _ in range(6):
+        eng.step()
+    assert eng.cancel(h) is True
+    r = h.result(timeout=10)
+    assert r.finish_reason == "cancelled"
+    # oracle only as deep as the cancelled stream got — the full
+    # 200-token reference would dwarf the test
+    oracle = _ref(model, SYSTEM, max(1, len(r.token_ids)))
+    assert r.token_ids == oracle[:len(r.token_ids)]
+    assert eng.cancel(h) is False          # idempotent: owns nothing
+    eng.run_until_idle()
+    eng.cache.flush_prefix_cache()
+    assert eng.cache.stats()["pages_in_use"] == 0
+    eng.shutdown()
+
+
+def test_engine_cancel_queued_request_never_hangs(model):
+    """Cancel a request still in the admission queue: zero tokens,
+    typed finish, and the queue slot is actually given back (the
+    follow-up request admits and completes)."""
+    eng = gen.GenerationEngine(model, _cfg(), start=False)
+    victim = eng.submit(SYSTEM, max_new_tokens=8)
+    assert eng.cancel(victim) is True
+    r = victim.result(timeout=10)
+    assert r.finish_reason == "cancelled" and r.token_ids == []
+    survivor = eng.submit(SYSTEM, max_new_tokens=8)
+    eng.run_until_idle()
+    assert survivor.result(timeout=10).token_ids == \
+        _ref(model, SYSTEM, 8)
+    eng.shutdown()
+
+
+def test_inproc_transport_cancel_paths(model):
+    """The transport op the fleet exposes: True exactly when the
+    replica owns the stream, False after it resolved — and a split
+    fleet's prefill-parked stream cancels cleanly too."""
+    specs = [ReplicaSpec("solo", model, _cfg())]
+    fl = FleetRouter(specs, FleetConfig(start=True, seed=0))
+    try:
+        rep = fl._replicas["solo"]
+        h = fl.submit(SYSTEM, max_new_tokens=300)
+        deadline = time.monotonic() + 30
+        while not rep.transport.cancel(h):
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert h.result(timeout=10).finish_reason == "cancelled"
+        assert rep.transport.cancel(h) is False
+    finally:
+        fl.shutdown()

@@ -1,0 +1,374 @@
+"""Cross-host fleet: the TCP transport (serving/disagg/tcp.py) and the
+chunked frame codec (serving/disagg/rpc.py).
+
+Acceptance oracles:
+
+1. WIRE CONTRACT: length-prefixed pickled frames survive partial
+   reads; mid-frame EOF raises the typed ChannelClosed; a payload past
+   chunk_bytes ships as bounded fragment carriers that reassemble
+   exactly, interleave with unrelated frames, and poison the channel
+   typed on an out-of-order fragment.
+2. BRING-UP CONTRACT: ReplicaListener raises the typed
+   TcpConnectError on port-in-use, on a worker that dies before
+   dialing back, and on an accept deadline — never a raw OSError five
+   frames deep.
+3. TOKEN IDENTITY: a TCP fleet produces streams identical to the
+   inproc oracle — greedy and seeded stochastic, through a mid-stream
+   live drain — with the socketpair fleet's entire failure model
+   (ledger remigration, chaos matrix, ping/cancel ops) unchanged over
+   the real socket.
+4. CHILD-SIDE FAULTS: a FaultPlan rule with side="child" ships through
+   the build frame and fires from the WORKER's half of the codec;
+   disarm() syncs the child before any parent state changes.
+"""
+import pickle
+import socket
+import threading
+import time
+import types
+
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation.engine import GenerationHandle
+from paddle_tpu.generation.sampling import SamplingParams
+from paddle_tpu.profiler.monitor import StatRegistry
+from paddle_tpu.serving import fleet as fleet_mod
+from paddle_tpu.serving.disagg.faults import FaultPlan, FaultRule
+from paddle_tpu.serving.disagg.rpc import (_HEADER, ChannelClosed,
+                                           FrameAssembler, recv_frame,
+                                           send_frame)
+from paddle_tpu.serving.disagg.tcp import (ReplicaListener,
+                                           TcpConnectError, TcpTransport)
+from paddle_tpu.serving.disagg.transport import build_transport
+from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                      ReplicaSpec)
+
+from dist_capability import (SUBPROC_SKIP_REASON,  # noqa: E402
+                             subprocess_replicas_available)
+from gen_oracle import greedy_oracle as _ref  # noqa: E402
+
+needs_subproc = pytest.mark.skipif(
+    not subprocess_replicas_available(), reason=SUBPROC_SKIP_REASON)
+
+SYSTEM = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+PROMPTS = [SYSTEM + [7, 7], SYSTEM + [1], SYSTEM + [9, 9, 9]]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fleet_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+def _cfg(**kw):
+    base = dict(max_decode_slots=4, num_pages=64, page_size=4,
+                prefix_cache=True)
+    base.update(kw)
+    return gen.GenerationConfig(**base)
+
+
+def _stat(name):
+    return StatRegistry.instance().get_stat(name).get()
+
+
+def _tcp_pair():
+    """A real loopback TCP connection via the listener under test."""
+    listener = ReplicaListener()
+    client = socket.create_connection(listener.address, timeout=5)
+    server = listener.accept(timeout=5)
+    listener.close()
+    return client, server
+
+
+# ---------------------------- wire contract ------------------------------
+
+
+def test_frames_roundtrip_over_loopback_tcp():
+    client, server = _tcp_pair()
+    try:
+        send_frame(client, {"op": "ping", "rid": 1})
+        assert recv_frame(server) == {"op": "ping", "rid": 1}
+        send_frame(server, {"resp": 1, "ok": True})
+        assert FrameAssembler().recv(client) == {"resp": 1, "ok": True}
+    finally:
+        client.close()
+        server.close()
+
+
+def test_partial_reads_reassemble_one_frame():
+    """TCP delivers arbitrary byte boundaries: a frame dribbled 3
+    bytes at a time still decodes to exactly one object."""
+    client, server = _tcp_pair()
+    try:
+        payload = pickle.dumps({"ev": "token", "t": 42, "n": 0})
+        wire = _HEADER.pack(len(payload)) + payload
+
+        def dribble():
+            for i in range(0, len(wire), 3):
+                client.sendall(wire[i:i + 3])
+                time.sleep(0.001)
+
+        th = threading.Thread(target=dribble, daemon=True)
+        th.start()
+        assert recv_frame(server) == {"ev": "token", "t": 42, "n": 0}
+        th.join(timeout=5)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_midframe_eof_raises_channel_closed():
+    client, server = _tcp_pair()
+    try:
+        payload = pickle.dumps({"op": "stats", "rid": 9})
+        wire = _HEADER.pack(len(payload)) + payload
+        client.sendall(wire[:len(wire) // 2])
+        client.close()
+        with pytest.raises(ChannelClosed):
+            recv_frame(server)
+    finally:
+        server.close()
+
+
+def test_chunked_payload_bounded_frames_and_exact_reassembly():
+    """A payload past chunk_bytes ships as fragment carriers, each a
+    bounded wire frame; the assembler rebuilds the logical frame
+    byte-exact."""
+    a, b = socket.socketpair()
+    try:
+        obj = {"op": "import_seq", "snap": bytes(range(256)) * 40}
+        send_frame(a, obj, chunk_bytes=512)
+        asm = FrameAssembler()
+        carriers = []
+        out = None
+        while out is None:
+            frame = recv_frame(b)
+            carriers.append(frame)
+            out = asm.feed(frame)
+        assert out == obj
+        assert len(carriers) > 1
+        for c in carriers:
+            assert "frag" in c and len(c["data"]) <= 512
+    finally:
+        a.close()
+        b.close()
+
+
+def test_unrelated_frames_interleave_between_fragments():
+    """Heartbeats/tokens written between two fragments of one payload
+    pass straight through the assembler while the payload is still
+    accumulating — the whole point of chunking under one write lock."""
+    a, b = socket.socketpair()
+    try:
+        big = {"op": "export", "blob": b"x" * 4000}
+        send_frame(a, big, chunk_bytes=1024)
+        frames = []
+        try:
+            b.settimeout(0.2)
+            while True:
+                frames.append(recv_frame(b))
+        except (socket.timeout, TimeoutError):
+            pass
+        assert len(frames) >= 2
+        asm = FrameAssembler()
+        # feed fragment 0, then an unrelated heartbeat, then the rest
+        assert asm.feed(frames[0]) is None
+        assert asm.feed({"ev": "hb"}) == {"ev": "hb"}
+        out = None
+        for frame in frames[1:]:
+            out = asm.feed(frame)
+        assert out == big
+    finally:
+        a.close()
+        b.close()
+
+
+def test_out_of_order_fragment_poisons_typed():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"blob": b"y" * 3000}, chunk_bytes=1024)
+        frames = [recv_frame(b) for _ in range(3)]
+        asm = FrameAssembler()
+        with pytest.raises(ValueError, match="out of order"):
+            asm.feed(frames[1])   # fragment 1 before fragment 0
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------- bring-up contract ---------------------------
+
+
+def test_listener_port_in_use_is_typed():
+    squatter = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    squatter.bind(("127.0.0.1", 0))
+    squatter.listen(1)
+    port = squatter.getsockname()[1]
+    try:
+        with pytest.raises(TcpConnectError, match="cannot listen"):
+            ReplicaListener(port=port)
+    finally:
+        squatter.close()
+
+
+def test_accept_detects_dead_worker_and_deadline_typed():
+    listener = ReplicaListener()
+    try:
+        corpse = types.SimpleNamespace(poll=lambda: 1, returncode=1)
+        t0 = time.monotonic()
+        with pytest.raises(TcpConnectError, match="worker exited"):
+            listener.accept(timeout=30.0, proc=corpse)
+        assert time.monotonic() - t0 < 5.0   # fail fast, not the window
+        with pytest.raises(TcpConnectError, match="no dial-back"):
+            listener.accept(timeout=0.3)
+    finally:
+        listener.close()
+
+
+# ------------------------- fleet over a real socket ----------------------
+
+
+@pytest.mark.slow
+@needs_subproc
+def test_tcp_fleet_token_identity_and_live_drain(model):
+    """Greedy + seeded stochastic streams over TCP replicas match the
+    inproc oracle exactly; a mid-stream drain live-migrates over the
+    socket with zero replayed tokens and a bounded wall."""
+    specs = [ReplicaSpec(f"r{i}", model, _cfg()) for i in range(2)]
+    fl = FleetRouter(specs, FleetConfig(start=True, seed=0,
+                                        transport="tcp"))
+    try:
+        hs = [fl.submit(p, max_new_tokens=8) for p in PROMPTS]
+        sp = SamplingParams(temperature=0.9, top_k=8, seed=123)
+        hst = fl.submit(SYSTEM, max_new_tokens=8, sampling=sp)
+        for p, h in zip(PROMPTS, hs):
+            assert h.result(timeout=90).token_ids == _ref(model, p, 8)
+        stoch = hst.result(timeout=90).token_ids
+        eng = gen.GenerationEngine(model, _cfg(), start=False)
+        ho = eng.submit(SYSTEM, max_new_tokens=8,
+                        sampling=SamplingParams(temperature=0.9,
+                                                top_k=8, seed=123))
+        eng.run_until_idle()
+        assert stoch == ho.result(timeout=10).token_ids
+        eng.shutdown()
+        # mid-stream live drain over the socket
+        h = fl.submit(SYSTEM + [2, 2], max_new_tokens=24, session="s")
+        victim = fl.replica_of("s")
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        fl.drain(victim, migrate=True, live=True)
+        drain_wall = time.monotonic() - t0
+        r = h.result(timeout=90)
+        assert r.token_ids == _ref(model, SYSTEM + [2, 2], 24)
+        assert drain_wall < 30.0, f"drain took {drain_wall:.1f}s"
+        assert _stat(fleet_mod.MIGRATED_REPLAY_TOKENS) == 0
+    finally:
+        fl.shutdown()
+
+
+@pytest.mark.slow
+@needs_subproc
+def test_tcp_transport_ping_cancel_and_chunked_submit(model):
+    """The new transport ops over a real socket: ping round-trips,
+    cancel frees the stream (typed 'cancelled' result, never a hang),
+    and a prompt whose frame exceeds a tiny chunk_bytes round-trips
+    fragmented through the live worker."""
+    spec = types.SimpleNamespace(
+        name="chunky", model=model, config=_cfg(num_pages=256),
+        role="mixed", host=None, port=None, chunk_bytes=512)
+    t = build_transport(spec, "tcp", start=True)
+    try:
+        assert t.kind == "tcp"
+        assert t.chunk_bytes == 512
+        assert t.ping() is True
+        prompt = (SYSTEM * 40)[:400]   # pickles well past chunk_bytes
+        h = GenerationHandle()
+        t.submit(prompt, dict(max_new_tokens=4,
+                              sampling=SamplingParams()), h)
+        assert h.result(timeout=120).token_ids == _ref(model, prompt, 4)
+        # cancel mid-stream: slot + pages free, handle resolves typed
+        h2 = GenerationHandle()
+        t.submit(list(SYSTEM), dict(max_new_tokens=200,
+                                    sampling=SamplingParams()), h2)
+        deadline = time.monotonic() + 30
+        while not t.cancel(h2):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        r = h2.result(timeout=30)
+        assert r.finish_reason == "cancelled"
+        assert t.cancel(h2) is False   # already resolved: idempotent no
+        t.flush_prefix()
+        deadline = time.monotonic() + 30
+        while t.stats()["cache"]["pages_in_use"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+            t.flush_prefix()
+    finally:
+        t.stop()
+
+
+@pytest.mark.slow
+@needs_subproc
+def test_tcp_child_side_faults_ship_through_build_frame(model):
+    """side="child" rules wrap the WORKER's half of the codec: a
+    child-side kill rule murders the replica from within (observable
+    as a replica death + remigration), and a disarmed plan fires
+    nothing until arm() syncs the child."""
+    plan = FaultPlan([FaultRule("token", "kill", direction="send",
+                                side="child", after=2)], seed=5)
+    plan.disarm()
+    specs = [ReplicaSpec(f"r{i}", model, _cfg()) for i in range(2)]
+    fl = FleetRouter(specs, FleetConfig(
+        start=True, seed=0, transport="tcp", respawn_backoff_s=0.05,
+        fault_plans={"r1": plan}))
+    try:
+        # disarmed: r1 serves a pinned request and survives
+        fl._sessions["pin"] = "r1"
+        h = fl.submit(SYSTEM, max_new_tokens=6, session="pin")
+        assert h.result(timeout=90).token_ids == _ref(model, SYSTEM, 6)
+        assert fl._replicas["r1"].state == "serving"
+        assert _stat(fleet_mod.REPLICA_DEAD_TOTAL) == 0
+        # armed: the child-side rule kills the worker mid-stream; the
+        # ledger remigrates and the stream completes identically
+        plan.arm()
+        fl._sessions["pin"] = "r1"
+        h2 = fl.submit(SYSTEM + [7], max_new_tokens=8, session="pin")
+        assert h2.result(timeout=90).token_ids == _ref(
+            model, SYSTEM + [7], 8)
+        deadline = time.monotonic() + 30
+        while _stat(fleet_mod.REPLICA_DEAD_TOTAL) < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    finally:
+        fl.shutdown()
+
+
+@pytest.mark.slow
+@needs_subproc
+def test_tcp_full_chaos_matrix_unchanged(model):
+    """THE cross-host acceptance soak: the seeded full kind x point
+    fault matrix — the exact socketpair-fleet schedule — over TCP
+    replicas.  No hangs, survivors token-identical, zero leaked
+    pages."""
+    from paddle_tpu.serving.disagg.chaos import chaos_drill
+    report = chaos_drill(model, seed=11, n_replicas=3, n_requests=6,
+                         new_tokens=8, watchdog_s=120.0,
+                         restart_dead=True,
+                         fleet_kw={"transport": "tcp"})
+    assert report["hung"] == 0
+    assert report["leaked_pages"] == 0
+    assert report["resolved_ok"] + report["resolved_typed_error"] == 6
+    assert report["token_identical"] == report["resolved_ok"]
+    fired = {k for kinds in report["faults_fired"].values()
+             for k in kinds}
+    assert fired

@@ -13,6 +13,7 @@ stream, leaked pages) — this is the command a CI chaos stage runs.
     python tools/chaos_drill.py --seed 7 --replicas 3 --requests 8
     python tools/chaos_drill.py --schedule kill-stall --kv-dtype int8 \
         --pool-layout kernel
+    python tools/chaos_drill.py --transport tcp --seed 11
 
 Docs: docs/SERVING.md "Failure model".
 """
@@ -47,6 +48,11 @@ def main():
                     default=None,
                     help="device-pool layout (implies "
                          "kv_backend='device')")
+    ap.add_argument("--transport", choices=("proc", "tcp"),
+                    default="proc",
+                    help="replica wire: 'proc' = pipe-per-child, "
+                         "'tcp' = loopback sockets with dial-back "
+                         "(the cross-host frame path)")
     ap.add_argument("--watchdog-s", type=float, default=120.0,
                     help="global no-hang budget per stream")
     ap.add_argument("--restart-dead", action="store_true",
@@ -80,6 +86,8 @@ def main():
             prompt_tokens=args.prompt_tokens,
             new_tokens=args.new_tokens, plans=plans,
             engine_kw=engine_kw or None,
+            fleet_kw=({"transport": "tcp"}
+                      if args.transport == "tcp" else None),
             watchdog_s=args.watchdog_s,
             restart_dead=args.restart_dead)
     except AssertionError as e:
@@ -87,6 +95,7 @@ def main():
                           "invariant_broken": str(e)}))
         return 1
     report = {"drill": "chaos", "schedule": args.schedule,
+              "transport": args.transport,
               "kv_dtype": args.kv_dtype,
               "pool_layout": args.pool_layout, **report}
     line = json.dumps(report)

@@ -901,6 +901,105 @@ def bench_chaos(model, seed, n_replicas, requests, new_tokens):
     return {"cell": "chaos", "schedule": "kill+stall", **report}
 
 
+def bench_pd(model, mode, sessions, long_tokens, new_tokens, page_size,
+             chunk_tokens):
+    """The P/D-disaggregation A/B cell: a LONG-prompt prefill wave
+    arriving concurrently with SHORT interactive requests, run once
+    per fleet shape — 'split' (one prefill-class + one decode-class
+    replica: longs prefill on one side, hand off, and decode next to
+    the shorts) vs 'mixed' (two role-less replicas, the ablation
+    baseline where shorts queue behind whatever prefill landed on
+    their replica).  The headline number is the SHORT-request
+    (decode-class) TTFT p95: split keeps the interactive path clear of
+    prefill head-of-line blocking, and the handoff books must show
+    pd_handoffs > 0 with migrated_replay_tokens == 0 (the import
+    resumes at base, never replays)."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.profiler.monitor import StatRegistry
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                          ReplicaSpec)
+
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    short_tokens = 4
+    total = long_tokens + new_tokens
+    pages = (-(-total // page_size) + 2) * (2 * sessions + 2)
+    roles = (("prefill", "decode") if mode == "split"
+             else ("mixed", "mixed"))
+    specs = [
+        ReplicaSpec(
+            f"{role[:2]}{i}", model,
+            g.GenerationConfig(max_decode_slots=4, num_pages=pages,
+                               page_size=page_size,
+                               queue_depth=2 * sessions + 4,
+                               prefix_cache=True,
+                               prefill_chunk_tokens=chunk_tokens),
+            role=role)
+        for i, role in enumerate(roles)]
+    fl = FleetRouter(specs, FleetConfig(
+        start=True, seed=7,
+        pd_prefill_threshold_tokens=max(16, long_tokens // 4)))
+    rng = np.random.default_rng(long_tokens * 13 + sessions)
+    half = model.vocab_size // 2
+
+    def run_wave(lo, hi):
+        longs = [fl.submit(rng.integers(lo, hi, long_tokens).tolist(),
+                           max_new_tokens=new_tokens)
+                 for _ in range(sessions)]
+        shorts = [fl.submit(rng.integers(lo, hi,
+                                         short_tokens).tolist(),
+                            max_new_tokens=new_tokens)
+                  for _ in range(sessions)]
+        for h in longs + shorts:
+            h.result(timeout=300)
+        return longs, shorts
+
+    # warmup from the other vocab half: every per-shape jit is paid
+    # before the timed wave, nothing it prefilled warms the real one
+    run_wave(half, model.vocab_size)
+    for name, rep in fl._replicas.items():
+        rep.transport.flush_prefix()
+        rep.transport.reset_stats()
+        rep.transport.take_prefix_deltas()
+        fl._page_index.drop_replica(name)
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    longs, shorts = run_wave(0, half)
+    snap = fl.stats_snapshot()["fleet"]
+    fl.shutdown()
+
+    def ttft(handles):
+        gaps = sorted(h.first_token_s - h.submitted_s for h in handles)
+        return (round(float(np.percentile(gaps, 50)), 4),
+                round(float(np.percentile(gaps, 95)), 4))
+
+    s50, s95 = ttft(shorts)
+    l50, l95 = ttft(longs)
+    return {
+        "scenario": "pd_disagg",
+        "mode": mode,
+        "replicas": 2,
+        "long_prompts": sessions,
+        "short_prompts": sessions,
+        "long_tokens": long_tokens,
+        "short_tokens": short_tokens,
+        "new_tokens": new_tokens,
+        "decode_class_ttft_p50_s": s50,
+        "decode_class_ttft_p95_s": s95,
+        "long_ttft_p50_s": l50,
+        "long_ttft_p95_s": l95,
+        "pd_handoffs": snap.get("fleet.pd_handoffs", 0),
+        "pd_handoff_tokens": snap.get("fleet.pd_handoff_tokens", 0),
+        "routed_role": snap.get("fleet.routed_role", 0),
+        "replay_tokens": snap.get("fleet.migrated_replay_tokens", 0),
+        "shed_total": snap.get("fleet.shed_total", 0),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", default="1,4,8")
@@ -969,13 +1068,16 @@ def main():
                     help="concurrent sessions in the --replicas "
                          "scenario (each runs 2 turns)")
     ap.add_argument("--fleet-transport",
-                    choices=("inproc", "proc", "both"),
+                    choices=("inproc", "proc", "tcp", "both"),
                     default="inproc",
                     help="replica process boundary A/B for the fleet "
                          "cells: 'inproc' (direct-object engines), "
                          "'proc' (one OS process per replica behind "
-                         "the SubprocTransport RPC boundary), or "
-                         "'both'.  Each transport also emits a "
+                         "the SubprocTransport RPC boundary), 'tcp' "
+                         "(the same worker dialing back over a real "
+                         "TCP socket — the cross-host rung), or "
+                         "'both' (inproc + proc).  Each transport "
+                         "also emits a "
                          "DRAIN-MIGRATION probe cell pair — live "
                          "migration vs cold resubmit — reporting "
                          "stream-gap p95 across the drain, "
@@ -1027,6 +1129,19 @@ def main():
                          "collective_bytes_per_step ~4x lower, "
                          "collective_quantized=1 stamped — paired "
                          "against its fp32-collective sibling")
+    ap.add_argument("--pd", choices=("off", "mixed", "split", "both"),
+                    default="off",
+                    help="prefill/decode disaggregation A/B: a "
+                         "long-prompt prefill wave concurrent with "
+                         "short interactive requests over a 2-replica "
+                         "fleet — 'split' (prefill-class + "
+                         "decode-class, longs hand off at "
+                         "prompt-consumed) vs 'mixed' (role-less "
+                         "ablation baseline), or 'both'.  Reports "
+                         "decode-class (short-request) TTFT p50/p95, "
+                         "pd_handoffs / pd_handoff_tokens, and "
+                         "replay_tokens (must be 0: the import "
+                         "resumes at base)")
     ap.add_argument("--chaos", action="store_true",
                     help="chaos-soak cell: a seeded kill+stall fault "
                          "schedule over a 3-replica subprocess fleet "
@@ -1299,6 +1414,17 @@ def main():
                     model, transport, live, sys_tokens,
                     max(32, args.new_tokens), args.page_size,
                     args.chunk_tokens))
+    if args.pd != "off":
+        # P/D disaggregation A/B: split (prefill + decode classes)
+        # vs mixed (role-less baseline) under the same long-wave +
+        # interactive workload — the decode-class TTFT p95 is the
+        # headline, the handoff books are the proof of mechanism
+        pd_modes = (("mixed", "split") if args.pd == "both"
+                    else (args.pd,))
+        for mode in pd_modes:
+            grid.append(bench_pd(
+                model, mode, args.fleet_sessions, max(contexts),
+                args.new_tokens, args.page_size, args.chunk_tokens))
     if args.chaos:
         # the chaos soak: seeded kill+stall over a subprocess fleet —
         # the robustness sibling of the drain probe (faults INJECTED,
@@ -1320,6 +1446,7 @@ def main():
         "prefix": args.prefix,
         "replicas": args.replicas,
         "fleet_transport": args.fleet_transport,
+        "pd": args.pd,
         "chaos": bool(args.chaos),
         "grid": grid,
         "stats": stats_by_series,

@@ -71,11 +71,11 @@ for i in $(seq 1 "$MAX"); do
     # tracks the scenario count and a kill at least says so
     timeout 5700 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
-      --step both --fleet-transport both \
+      --step both --fleet-transport both --pd both \
       --kv-quant both --quant-collectives --spec both --chaos \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + kv-quant + quant-collectives + spec + chaos A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + pd-disagg + kv-quant + quant-collectives + spec + chaos A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
