@@ -35,17 +35,18 @@ from .decode_attention import (chunk_prefill_attention,
                                ragged_paged_attention_reference)
 from .engine import (DEFAULT_PREFILL_CHUNK_TOKENS, GenerationConfig,
                      GenerationEngine, GenerationHandle, GenerationResult)
-from .fused import (ChunkedPrefillStep, FusedDecodeStep, RaggedStep,
-                    decode_batch_menu)
+from .fused import (ChunkedPrefillStep, FusedDecodeStep,
+                    LoopedRaggedStep, RaggedStep, decode_batch_menu)
 from .kv_cache import (DeviceKVPool, KVQuantMismatchError,
                        OutOfPagesError, PagedKVCache,
                        UnknownSequenceError)
 from .metrics import GenerationMetrics
 from .model import TinyCausalLM
-from .sampling import SamplingParams, sample_token, sample_tokens_batch
+from .sampling import (SampleStream, SamplingParams, sample_token,
+                       sample_tokens_batch, sample_tokens_device)
 from .scheduler import (ContinuousBatchingScheduler, GenerationRequest,
                         SequenceState)
-from .speculation import NgramProposer, verify_accept
+from .speculation import NgramIndex, NgramProposer, verify_accept
 
 __all__ = [
     "GenerationEngine", "GenerationConfig", "GenerationHandle",
@@ -54,10 +55,12 @@ __all__ = [
     "paged_decode_attention", "paged_decode_attention_reference",
     "dense_causal_reference", "ContinuousBatchingScheduler",
     "GenerationRequest", "SequenceState", "SamplingParams", "sample_token",
-    "sample_tokens_batch", "GenerationMetrics", "TinyCausalLM",
+    "sample_tokens_batch", "sample_tokens_device", "SampleStream",
+    "GenerationMetrics", "TinyCausalLM",
     "FusedDecodeStep", "ChunkedPrefillStep", "RaggedStep",
-    "decode_batch_menu",
+    "LoopedRaggedStep", "decode_batch_menu",
     "chunk_prefill_attention", "chunk_prefill_attention_reference",
     "ragged_paged_attention", "ragged_paged_attention_reference",
-    "DEFAULT_PREFILL_CHUNK_TOKENS", "NgramProposer", "verify_accept",
+    "DEFAULT_PREFILL_CHUNK_TOKENS", "NgramProposer", "NgramIndex",
+    "verify_accept",
 ]

@@ -224,7 +224,32 @@ def ragged_paged_attention(q, k_pool, v_pool, page_tables, starts, lens,
     per sequence to a ragged run of rows per descriptor.  `mesh`/
     `tp_axis` run the kernel as a shard_map over the head-sharded mesh
     (the reference path ignores them — GSPMD partitions it on its
-    own)."""
+    own).
+
+    LOOP-BODY SAFE (the host-free decode loop's protocol,
+    model.ragged_loop_fn): both paths are pure functions of their
+    operands with shapes fixed by the operand shapes alone — no host
+    callbacks, no data-dependent output shapes, `use_kernel` resolved
+    at TRACE time — so one call per ``lax.while_loop`` iteration
+    re-reads the carried pools with zero re-trace.  Descriptor
+    VALUES (starts/lens/kv_lens and the page-table rows) are ordinary
+    traced data and may change freely between iterations; only the
+    descriptor COUNT is baked into the executable.  The rank guard
+    below turns a mis-packed loop carry into a named error instead of
+    a shape mismatch deep inside lax."""
+    starts = jnp.asarray(starts)
+    lens = jnp.asarray(lens)
+    kv_lens = jnp.asarray(kv_lens)
+    pt_arr = jnp.asarray(page_tables)
+    if (pt_arr.ndim != 2 or starts.ndim != 1 or lens.ndim != 1
+            or kv_lens.ndim != 1
+            or not (pt_arr.shape[0] == starts.shape[0] == lens.shape[0]
+                    == kv_lens.shape[0])):
+        raise ValueError(
+            f"ragged descriptors must be [S]-shaped with a [S, P] page "
+            f"table: page_tables {pt_arr.shape}, starts {starts.shape}, "
+            f"lens {lens.shape}, kv_lens {kv_lens.shape}")
+    page_tables = pt_arr
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
     if not use_kernel:

@@ -232,6 +232,21 @@ class GenerationConfig:
         a new executable signature — and the auto step_token_budget
         grows by max_decode_slots * spec_tokens so a fully speculating
         batch still leaves the prefill chunk its room.
+    loop_steps: HOST-FREE DECODE LOOP — fuse N ragged decode steps
+        into ONE dispatch with on-device sampling and stop matching
+        (docs/GENERATION.md "Host-free decode loop").  1 (the tier-1
+        CPU oracle default) keeps the per-step path; N > 1 makes a
+        decode-only boundary dispatch fused.LoopedRaggedStep and pay
+        ONE host fetch per N steps instead of per token.  Scheduler
+        joins/admissions happen at loop boundaries, so N is a
+        latency-vs-admission knob — token streams are identical to
+        N = 1 by the oracle suite (tests/test_looped_decode.py).
+        Requires the ragged step; loop_steps > 1 with step_mode unset
+        resolves step_mode to "ragged".  Boundaries that are not
+        decode-only (a prefill chunk is packed, a row's stop config
+        exceeds the loop's static caps, page/position headroom is
+        short) fall back to the single-step dispatch for that
+        boundary.
     prefix_cache: PREFIX CACHING — refcounted copy-on-write page
         sharing across sequences (docs/GENERATION.md "Prefix
         caching").  Full pages of every completed prompt are indexed
@@ -263,7 +278,7 @@ class GenerationConfig:
                  mesh=None, tp_axis=None, prefix_cache=None,
                  step_mode=None, prefill_pack=True,
                  quantized_collectives=False, spec_mode=None,
-                 spec_tokens=4):
+                 spec_tokens=4, loop_steps=1):
         self.max_decode_slots = int(max_decode_slots)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -359,6 +374,16 @@ class GenerationConfig:
                 "token axis (a speculating row is a [start, 1+k, "
                 "kv_len] descriptor); step_mode='legacy' has no such "
                 "axis")
+        self.loop_steps = int(loop_steps)
+        if self.loop_steps < 1:
+            raise ValueError(
+                f"loop_steps must be >= 1 (1 = the per-step path), "
+                f"got {loop_steps}")
+        if self.loop_steps > 1 and step_mode == "legacy":
+            raise ValueError(
+                "loop_steps > 1 is the host-free decode loop over the "
+                "RAGGED step (N fused ragged iterations per dispatch); "
+                "step_mode='legacy' has no such dispatch")
         # multi-prompt chunk packing (plan_pack): True fills each step's
         # leftover token room with MORE prompts' chunks (the RPA packing
         # rule — the default); False restores one chunk per step (the
@@ -555,12 +580,14 @@ class GenerationEngine:
                          and hasattr(model, "ragged_step_fn")
                          and hasattr(model, "decode_params"))
         spec_on = self.config.spec_mode == "ngram"
+        loop_on = self.config.loop_steps > 1
         step_mode = self.config.step_mode
         if step_mode is None:
-            # spec_mode="ngram" is an explicit opt-out of the eager
-            # oracle anyway: asking for it resolves the auto step mode
-            # to ragged wherever the model supports it (CPU included)
-            step_mode = "ragged" if ((on_tpu or spec_on)
+            # spec_mode="ngram" / loop_steps > 1 are explicit opt-outs
+            # of the eager oracle anyway: asking for either resolves
+            # the auto step mode to ragged wherever the model supports
+            # it (CPU included)
+            step_mode = "ragged" if ((on_tpu or spec_on or loop_on)
                                      and ragged_capable) else "legacy"
         if step_mode == "ragged" and not ragged_capable:
             raise ValueError(
@@ -573,6 +600,13 @@ class GenerationEngine:
                 "token axis; this engine resolved to step_mode="
                 f"{step_mode!r} (kv_backend={backend!r}, model="
                 f"{type(model).__name__})")
+        if loop_on and (step_mode != "ragged"
+                        or not hasattr(model, "ragged_loop_fn")):
+            raise ValueError(
+                f"loop_steps={self.config.loop_steps} needs the "
+                "ragged step and a model implementing ragged_loop_fn "
+                f"(step_mode={step_mode!r}, "
+                f"model={type(model).__name__})")
         self.step_mode = step_mode
         decode = self.config.decode
         if step_mode == "ragged":
@@ -725,6 +759,24 @@ class GenerationEngine:
                 mesh=mesh, tp_axis=tp_axis,
                 quant_collectives=self._quant_collectives,
                 spec_tokens=self.spec_tokens)
+        # the host-free decode loop: N fused ragged iterations per
+        # dispatch at decode-only boundaries, ONE host fetch per N
+        # steps — built ALONGSIDE the single-step RaggedStep, which
+        # stays the fallback for non-decode-only boundaries (chunks
+        # packed, stop configs past the static caps, headroom short)
+        self._loop = None
+        if loop_on:
+            # capability was validated up front with the step-mode
+            # resolution, before any executable was built
+            from .fused import LoopedRaggedStep
+
+            self._loop = LoopedRaggedStep(
+                model, self.cache, self.metrics, max_seqs=slots,
+                loop_steps=self.config.loop_steps,
+                use_kernel=self._use_kernel, mesh=mesh, tp_axis=tp_axis,
+                quant_collectives=self._quant_collectives,
+                spec_tokens=self.spec_tokens)
+        self.loop_steps = self.config.loop_steps if loop_on else 1
         # the prompt-lookup proposer (None = speculation off): host-
         # side, model-free, consulted once per greedy decode row per
         # step by scheduler.plan_spec
@@ -749,6 +801,9 @@ class GenerationEngine:
         # construction refuses unsupported spec combos, so the stamp
         # is the truth — "off" in a snapshot MEANS non-speculative
         self.metrics.set_spec_mode(self.config.spec_mode)
+        # the loop_steps build stamp, same pattern: 1 in a snapshot
+        # MEANS the per-step path produced its numbers
+        self.metrics.set_loop_steps(self.loop_steps)
         self._lock = threading.Lock()  # one stepper at a time
         # monotone step-progress stamp: bumped every COMPLETED step()
         # call, with `in_step` flagging the window where a step HOLDS
@@ -1477,6 +1532,23 @@ class GenerationEngine:
             self._drain_kv_bytes()
             self._observe_occupancy()
             return 0
+        # the host-free loop takes DECODE-ONLY boundaries (no chunk in
+        # the pack) whose every row fits the loop's static caps; a page
+        # shortfall inside _dispatch_loop rolls back and falls through
+        # to the single-step dispatch — the loop is an optimization,
+        # never a new failure source
+        if (self._loop is not None and decoding and not pack
+                and self._loop_ready(decoding)):
+            with StepTimer() as timer:
+                with RecordEvent("generation::loop_step"):
+                    looped = self._dispatch_loop(decoding, spec_plan)
+            if looped is not None:
+                advanced, sampled = looped
+                if sampled:
+                    self.metrics.observe_step(sampled, timer.seconds)
+                self._drain_kv_bytes()
+                self._observe_occupancy()
+                return advanced
         with StepTimer() as timer:
             with RecordEvent("generation::ragged_step"):
                 advanced, sampled = self._dispatch_ragged(
@@ -1692,6 +1764,166 @@ class GenerationEngine:
             self._apply_token(state, int(tok))
             emitted += state.n_generated - before
         return emitted
+
+    def _loop_ready(self, decoding):
+        """Row-level eligibility for the host-free loop at this
+        decode-only boundary: every row must fit the loop executable's
+        STATIC stop caps (caps are trace constants — a row past them
+        would silently drop its stop conditions), have a token to
+        generate, and have position headroom for the loop's whole
+        write horizon.  Any misfit row sends the WHOLE boundary down
+        the single-step path — per-row mixing would reintroduce the
+        per-token fetch for the loop rows too, since the step's one
+        fetch is the step's latency floor either way."""
+        lp = self._loop
+        horizon = lp.loop_steps + lp.spec_tokens
+        limit = int(self.model.max_positions) - 1
+        for s in decoding:
+            req = s.request
+            p = req.params
+            if (req.max_new_tokens - s.n_generated < 1
+                    or len(req.stop_tokens) > lp.max_stop_ids
+                    or len(p.stop_sequences) > lp.max_stop_seqs
+                    or p.max_stop_len > lp.max_stop_len
+                    or len(s.tokens) - 1 + horizon > limit):
+                return False
+        return True
+
+    def _dispatch_loop(self, decoding, spec_plan):
+        """One host-free loop dispatch: N ragged decode iterations with
+        on-device sampling and stop matching, ONE host fetch
+        (fused.LoopedRaggedStep).  Reserves the loop's whole write
+        horizon per row up front (N + that row's drafts — the furthest
+        position any iteration can scatter to); a shortfall rolls back
+        every reservation and returns None, and the caller falls
+        through to the single-step dispatch.  After the fetch, each
+        row's pre-gated tokens stream through the NORMAL per-token
+        gate (_apply_token — device and host run the same gate order,
+        so the re-check is a no-op by construction and the one-gate
+        invariant stays literally true), the SampleStream counter
+        advances to the device's value, and survivors truncate back to
+        final_pos — resident == len(tokens) - 1, the decode invariant.
+        Returns ``(descriptors_advanced, tokens_emitted)``."""
+        lp = self._loop
+        n_steps, kk = lp.loop_steps, lp.spec_tokens
+        kd = max(kk, 1)
+        b = len(decoding)
+        drafts = np.zeros((b, kd), np.int32)
+        dlens = np.zeros((b,), np.int32)
+        if spec_plan:
+            for i, s in enumerate(decoding):
+                d = spec_plan.get(s.seq_id)
+                if d:
+                    d = list(d)[:kk]
+                    drafts[i, :len(d)] = d
+                    dlens[i] = len(d)
+        reserved = []   # rollback ledger: (seq_id, pre-reserve length)
+        for i, s in enumerate(decoding):
+            need = n_steps + int(dlens[i])
+            try:
+                p0 = self.cache.reserve(s.seq_id, need)
+            except OutOfPagesError:
+                for sid, back in reserved:
+                    self.cache.truncate(sid, back)
+                return None
+            reserved.append((s.seq_id, p0))
+            if self.prefix_cache_enabled:
+                # the COW guard over the whole horizon, mirroring
+                # _reserve_decode_rows (reserve just privatized any
+                # shared tail page)
+                self.cache.check_span_writable(s.seq_id, p0, need)
+        pt, _ = self.cache.gather_block_tables(
+            [s.seq_id for s in decoding])
+        ms, ns, ls = lp.max_stop_ids, lp.max_stop_seqs, lp.max_stop_len
+        cur_tok = np.asarray([s.tokens[-1] for s in decoding], np.int32)
+        cur_pos = np.asarray([p0 for _, p0 in reserved], np.int32)
+        temps = np.zeros((b,), np.float32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
+        seeds = np.zeros((b,), np.int32)
+        counters = np.zeros((b,), np.int32)
+        remaining = np.zeros((b,), np.int32)
+        stop_ids = np.full((b, ms), -1, np.int32)
+        stop_seqs = np.full((b, ns, ls), -1, np.int32)
+        stop_seq_lens = np.zeros((b, ns), np.int32)
+        tail = np.full((b, ls - 1), -1, np.int32)
+        for i, s in enumerate(decoding):
+            req = s.request
+            p = req.params
+            temps[i] = p.temperature
+            top_ks[i] = p.top_k or 0
+            top_ps[i] = 1.0 if p.top_p is None else p.top_p
+            seeds[i] = np.int32(np.uint32(s.rng.seed))
+            counters[i] = np.int32(np.uint32(s.rng.counter))
+            remaining[i] = req.max_new_tokens - s.n_generated
+            st = list(req.stop_tokens)
+            stop_ids[i, :len(st)] = st
+            for j, sq in enumerate(p.stop_sequences):
+                stop_seqs[i, j, ls - len(sq):] = sq
+                stop_seq_lens[i, j] = len(sq)
+            take = min(s.n_generated, ls - 1)
+            if take:
+                tail[i, ls - 1 - take:] = s.tokens[len(s.tokens) - take:]
+        res = lp.step(cur_tok, cur_pos, pt, temps, top_ks, top_ps,
+                      seeds, counters, remaining, stop_ids, stop_seqs,
+                      stop_seq_lens, tail, drafts, dlens)
+        iters = lp.last_iters
+        sampled = 0
+        wasted = 0
+        writes = 0
+        for i, s in enumerate(decoding):
+            row = res[i]
+            ne = int(row[n_steps + kk])
+            fin = int(row[n_steps + kk + 1])
+            fin_it = int(row[n_steps + kk + 2])
+            final_pos = int(row[n_steps + kk + 3])
+            s.rng.counter = int(row[n_steps + kk + 4]) & 0xFFFFFFFF
+            emitted = [int(t) for t in row[:ne]]
+            if dlens[i]:
+                # the verify rule makes the bonus token differ from
+                # the draft it replaced, so the emitted stream's
+                # common prefix with the drafts IS the accepted count
+                # (undercounts only when a stop clips mid-draft — the
+                # row retires that dispatch anyway)
+                acc = 0
+                for j in range(min(int(dlens[i]), len(emitted))):
+                    if emitted[j] != int(drafts[i, j]):
+                        break
+                    acc += 1
+                self.metrics.count_spec(int(dlens[i]), acc,
+                                        int(dlens[i]) - acc)
+            # iterations this row actually decoded in (its KV writes),
+            # vs iterations it sat finished while the batch ran on
+            active_iters = (fin_it + 1) if fin else iters
+            writes += active_iters + int(dlens[i])
+            if fin:
+                wasted += iters - active_iters
+            # truncate FIRST (the _apply_spec_row ordering): the
+            # reserved-but-unwritten tail leaves before any token
+            # streams, so a finish inside the apply loop (which frees
+            # the pages wholesale) can never race the rewind
+            self.cache.truncate(s.seq_id, final_pos)
+            for tok in emitted:
+                if s.slot is None:
+                    break
+                self._apply_token(s, tok)
+            sampled += len(emitted)
+            if s.slot is not None and fin == 1:
+                # the device withheld the stop-completing token,
+                # exactly like the host gate; finish the row here
+                self._finish(s, "stop")
+        # the in-trace scatters, kept visible in kv_bytes_moved: one
+        # write per active iteration per row, plus iteration 0's draft
+        # rows
+        self.cache.count_fused_append(writes)
+        self.metrics.observe_decode_step(lp.last_dispatches,
+                                         lp.last_syncs)
+        self.metrics.observe_loop(sampled, lp.last_syncs,
+                                  iters < n_steps, wasted)
+        self.metrics.observe_collective_bytes(lp.last_collective_bytes)
+        self.metrics.observe_step_rows(lp.last_rows_useful,
+                                       lp.last_rows_dispatched, 0)
+        return b, sampled
 
     def run_until_idle(self, max_steps=100000):
         """Drive step() until queue+slots drain (tests/benchmarks)."""

@@ -673,3 +673,196 @@ class RaggedStep:
             self._num_layers, t, self._d_model, self._tp,
             quantized=self._quant_collectives)
         return ids, logits
+
+
+class LoopedRaggedStep:
+    """N ragged decode steps in ONE dispatch — the host-free decode
+    loop (model.ragged_loop_fn, docs/GENERATION.md "Host-free decode
+    loop").
+
+    Where RaggedStep pays one dispatch + <= 1 host sync PER TOKEN, this
+    wraps the same ragged core in an in-trace ``lax.while_loop``:
+    on-device sampling (the host sampler's hash-uniform twin), on-device
+    stop-token and stop-sequence matching, per-row done masks with
+    early exit, drafts verified at iteration 0, pools carried through
+    the loop body on the SAME donation chain — and exactly ONE
+    ``[S, N+K+6]`` host fetch per N steps (token ids + done/stop
+    metadata + advanced RNG counters + final positions).
+
+    Decode-only by construction: descriptor s statically owns packed
+    rows ``[s*(1+K), s*(1+K)+len)``, so the token axis is
+    ``max_seqs * (1 + spec_tokens)`` and the compile menu stays ONE
+    executable per pages bucket — the engine falls back to the
+    single-step path whenever the boundary isn't decode-only (prefill
+    planned, a row's stop config exceeds the static caps, or a row is
+    too close to its page/position budget), and admits/joins between
+    loops, which is what makes `loop_steps` a latency-vs-admission
+    knob rather than a correctness concern."""
+
+    def __init__(self, model, cache, metrics, max_seqs, loop_steps,
+                 use_kernel=False, mesh=None, tp_axis=None,
+                 quant_collectives=False, spec_tokens=0,
+                 max_stop_ids=8, max_stop_seqs=4, max_stop_len=8):
+        import jax
+
+        self._jax = jax
+        self._cache = cache
+        self._num_layers = int(cache.num_layers)
+        self.max_seqs = int(max_seqs)
+        self.loop_steps = int(loop_steps)
+        self.spec_tokens = int(spec_tokens)
+        self.max_stop_ids = int(max_stop_ids)
+        self.max_stop_seqs = int(max_stop_seqs)
+        self.max_stop_len = max(int(max_stop_len), 1)
+        if self.max_seqs < 1:
+            raise ValueError("max_seqs must be >= 1")
+        if self.loop_steps < 1:
+            raise ValueError("loop_steps must be >= 1")
+        self._kd = max(self.spec_tokens, 1)
+        self.max_emit = self.loop_steps + self.spec_tokens
+        self._mesh = mesh
+        self._tp = int(mesh.shape[tp_axis]) if mesh is not None else 1
+        self._d_model = int(model.num_heads) * int(model.head_dim)
+        self._quant = bool(getattr(cache, "quantized", False))
+        self._quant_collectives = bool(quant_collectives) and self._tp > 1
+        self._n_groups = 4 if self._quant else 2
+        self._param_leaves, self._param_tree = _shard_params(
+            model, mesh, tp_axis, jax)
+        pages_menu = ShapeBucketer.geometric_menu(cache.num_pages, start=1)
+        self._bucketer = ShapeBucketer(batch_buckets=(1,),
+                                       length_buckets=pages_menu)
+        step_kw = ({"mesh": mesh, "tp_axis": tp_axis}
+                   if mesh is not None else {})
+        if self._quant:
+            step_kw["kv_quant"] = True
+        if self._quant_collectives:
+            step_kw["quant_collectives"] = True
+        fn = model.ragged_loop_fn(
+            cache.page_size, cache.num_pages, use_kernel=use_kernel,
+            pool_layout=cache.pool_layout, spec_tokens=self.spec_tokens,
+            loop_steps=self.loop_steps, max_stop_ids=self.max_stop_ids,
+            max_stop_seqs=self.max_stop_seqs,
+            max_stop_len=self.max_stop_len, **step_kw)
+        # fixed args: (cur_tok, cur_pos, live, page_tables, temps,
+        #              top_ks, top_ps, seeds, counters, remaining,
+        #              stop_ids, stop_seqs, stop_seq_lens, tail,
+        #              drafts, draft_lens); pool state donated after
+        # them, exactly the RaggedStep convention
+        self._n_fixed = 16
+        wrapped = _wrap_donating(
+            self._num_layers, self._param_tree, jax,
+            lambda params, f, *gs: fn(params, *f, *gs),
+            n_fixed=self._n_fixed, n_out=1, n_groups=self._n_groups)
+        self._exec = CompiledModelCache(
+            wrapped, metrics=DecodeCacheMetrics(metrics), aot=True,
+            donate_argnums=_pool_donate_plan(self._num_layers,
+                                             self._n_fixed,
+                                             n_groups=self._n_groups))
+        self.last_dispatches = 0
+        self.last_syncs = 0
+        self.last_iters = 0
+        self.last_rows_useful = 0
+        self.last_rows_dispatched = 0
+        self.last_collective_bytes = 0
+
+    @property
+    def compile_count(self):
+        """Distinct signatures compiled — exactly the pages buckets
+        touched (the loop adds NO signature axis: loop_steps and the
+        stop caps are baked static)."""
+        return self._exec.compile_count
+
+    def cached_buckets(self):
+        return self._exec.cached_buckets()
+
+    def _fixed_structs(self, bucket_p):
+        sds = self._jax.ShapeDtypeStruct
+        i32 = np.dtype(np.int32)
+        f32 = np.dtype(np.float32)
+        s = self.max_seqs
+        ms, ns, ls = self.max_stop_ids, self.max_stop_seqs, \
+            self.max_stop_len
+        return [sds((s,), i32), sds((s,), i32), sds((s,), i32),
+                sds((s, bucket_p), i32), sds((s,), f32), sds((s,), i32),
+                sds((s,), f32), sds((s,), i32), sds((s,), i32),
+                sds((s,), i32), sds((s, ms), i32), sds((s, ns, ls), i32),
+                sds((s, ns), i32), sds((s, ls - 1), i32),
+                sds((s, self._kd), i32), sds((s,), i32)]
+
+    def prewarm(self, pages_cols):
+        """AOT-compile the loop executable for a pages bucket without
+        dispatching (pure ShapeDtypeStructs — RaggedStep.prewarm's
+        contract).  Returns True when this call actually compiled."""
+        bucket_p = self._bucketer.length_bucket(max(int(pages_cols), 1))
+        args = (self._fixed_structs(bucket_p)
+                + _state_structs(self._jax, self._cache, self._mesh,
+                                 self._num_layers, self._quant)
+                + _param_structs(self._jax, self._mesh,
+                                 self._param_leaves))
+        before = self._exec.compile_count
+        self._exec.get(args)
+        return self._exec.compile_count > before
+
+    def step(self, cur_tok, cur_pos, page_tables, temps, top_ks, top_ps,
+             seeds, counters, remaining, stop_ids, stop_seqs,
+             stop_seq_lens, tail, drafts, draft_lens):
+        """Dispatch one N-step loop for ``len(cur_tok)`` live rows.
+
+        All inputs are host arrays at exact sizes; this pads the row
+        axis to `max_seqs` with dead rows (live == 0: zero-length
+        descriptors, sentinel writes, no draws), the page-table axis to
+        its pages bucket, runs the ONE donated dispatch, and fetches
+        the ``[S, N+K+6]`` result in the ONE host sync.  Returns the
+        real rows of that array (see model.ragged_loop_fn for the
+        column layout)."""
+        s_real = len(cur_tok)
+        if s_real > self.max_seqs:
+            raise ValueError(
+                f"{s_real} loop rows > max_seqs={self.max_seqs}")
+        s = self.max_seqs
+        ms, ns, ls = self.max_stop_ids, self.max_stop_seqs, \
+            self.max_stop_len
+
+        def pad1(vals, fill, dtype=np.int32):
+            a = np.full((s,), fill, dtype)
+            a[:s_real] = vals
+            return a
+
+        page_tables = np.asarray(page_tables, np.int32)
+        bucket_p = self._bucketer.length_bucket(
+            max(page_tables.shape[1] if page_tables.size else 1, 1))
+        pt = np.zeros((s, bucket_p), np.int32)
+        if page_tables.size:
+            pt[:s_real, :page_tables.shape[1]] = page_tables
+        live = np.zeros((s,), np.int32)
+        live[:s_real] = 1
+        sids = np.full((s, ms), -1, np.int32)
+        sids[:s_real] = stop_ids
+        sseqs = np.full((s, ns, ls), -1, np.int32)
+        sseqs[:s_real] = stop_seqs
+        slens = np.zeros((s, ns), np.int32)
+        slens[:s_real] = stop_seq_lens
+        tl = np.full((s, ls - 1), -1, np.int32)
+        tl[:s_real] = tail
+        dr = np.zeros((s, self._kd), np.int32)
+        dr[:s_real] = drafts
+        args = [pad1(cur_tok, 0), pad1(cur_pos, 0), live, pt,
+                pad1(temps, 0.0, np.float32), pad1(top_ks, 0),
+                pad1(top_ps, 1.0, np.float32), pad1(seeds, 0),
+                pad1(counters, 0), pad1(remaining, 0), sids, sseqs,
+                slens, tl, dr, pad1(draft_lens, 0),
+                *self._cache.take_pool_state(), *self._param_leaves]
+        out = _dispatch_donating(self._cache, self._exec, args,
+                                 self._num_layers, n_out=1)
+        host = np.asarray(out)                 # the single host sync
+        self.last_dispatches = 1
+        self.last_syncs = 1
+        self.last_iters = int(host[0, -1]) if s else 0
+        self.last_rows_useful = s_real
+        self.last_rows_dispatched = s
+        # two allreduces per layer per ITERATION over the packed axis
+        self.last_collective_bytes = _collective_bytes_estimate(
+            self._num_layers, s * (1 + self.spec_tokens), self._d_model,
+            self._tp, quantized=self._quant_collectives) \
+            * max(self.last_iters, 0)
+        return host[:s_real]

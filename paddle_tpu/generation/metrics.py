@@ -192,6 +192,28 @@ Metric names:
                                       unsharded) — the profile hook the
                                       EQuARX-style quantized-collective
                                       follow-on is measured against
+- ``generation.loop_steps``           gauge: N of the host-free decode
+                                      loop (fused.LoopedRaggedStep) —
+                                      1 means the per-step path,
+                                      stamped at engine build like
+                                      kernel_path, so every snapshot
+                                      says how many decode steps each
+                                      dispatch fused
+- ``generation.decode_host_fetches_per_token``  gauge: cumulative host
+                                      fetches / tokens on the loop
+                                      path — the loop's acceptance
+                                      number (<= 1/N on a decode-only
+                                      batch; the per-step path pays
+                                      ~1)
+- ``generation.loop_early_exits``     loop dispatches that exited
+                                      before iteration N because every
+                                      live row had finished (the
+                                      on-device done-mask early exit)
+- ``generation.loop_wasted_steps``    loop iterations rows sat already-
+                                      finished while the rest of the
+                                      batch kept going — the
+                                      latency-vs-waste cost of big N
+                                      the gen_bench loop A/B watches
 """
 import time
 
@@ -246,6 +268,10 @@ SHARED_PAGES = PREFIX + "shared_pages"
 COW_COPIES = PREFIX + "cow_copies"
 PREFIX_EVICTIONS = PREFIX + "prefix_evictions"
 PREFIX_PAGES_REGISTERED = PREFIX + "prefix_pages_registered"
+LOOP_STEPS = PREFIX + "loop_steps"
+DECODE_HOST_FETCHES_PER_TOKEN = PREFIX + "decode_host_fetches_per_token"
+LOOP_EARLY_EXITS = PREFIX + "loop_early_exits"
+LOOP_WASTED_STEPS = PREFIX + "loop_wasted_steps"
 
 
 class GenerationMetrics:
@@ -265,6 +291,11 @@ class GenerationMetrics:
         # like the prefix hit rate)
         self._spec_proposed_cum = 0
         self._spec_accepted_cum = 0
+        # host-free-loop fetch-rate accumulators (per-engine, same
+        # pattern): the gauge is cumulative fetches / tokens on the
+        # loop path
+        self._loop_fetch_cum = 0
+        self._loop_token_cum = 0
 
     def _stat(self, name):
         return self._reg.get_stat(name)
@@ -434,6 +465,35 @@ class GenerationMetrics:
         self._stat(SPEC_REWIND_TOKENS)
         self._stat(SPEC_DRAFT_ROWS)
         self._stat(SPEC_ACCEPTANCE_RATE).set(0.0)
+
+    def set_loop_steps(self, n):
+        """Gauge: N of the host-free decode loop (1 = the per-step
+        path), stamped once at engine build — the kernel_path pattern.
+        Touches every loop counter too, so the schema is complete from
+        the first snapshot: decode_host_fetches_per_token == 0 on a
+        loop-off engine is a statement, not a gap."""
+        self._stat(LOOP_STEPS).set(int(n))
+        self._stat(LOOP_EARLY_EXITS)
+        self._stat(LOOP_WASTED_STEPS)
+        self._stat(DECODE_HOST_FETCHES_PER_TOKEN).set(0.0)
+
+    def observe_loop(self, tokens, fetches, early_exit, wasted):
+        """One host-free loop dispatch retired: `tokens` emitted across
+        the batch for `fetches` host fetches (1 by construction),
+        `early_exit` when the done masks ended the loop before
+        iteration N, `wasted` the already-finished row-iterations the
+        batch stragglers cost.  Maintains the cumulative
+        fetches-per-token gauge — the loop's <= 1/N acceptance
+        number."""
+        self._loop_fetch_cum += int(fetches)
+        self._loop_token_cum += int(tokens)
+        if self._loop_token_cum:
+            self._stat(DECODE_HOST_FETCHES_PER_TOKEN).set(
+                round(self._loop_fetch_cum / self._loop_token_cum, 4))
+        if early_exit:
+            self._stat(LOOP_EARLY_EXITS).increase()
+        if wasted:
+            self._stat(LOOP_WASTED_STEPS).increase(int(wasted))
 
     def count_spec(self, proposed, accepted, rewound):
         """One speculative row's verify outcome: `proposed` drafts

@@ -536,6 +536,99 @@ class TinyCausalLM:
         return step
 
     # -------------------------- ragged step ---------------------------
+    def _ragged_core_fn(self, use_kernel=False, pool_layout="token",
+                        mesh=None, tp_axis=None, kv_quant=False,
+                        quant_collectives=False):
+        """Build the shared RAGGED LAYER STACK: embed -> L x (scatter
+        K/V into the pools + ragged paged attention + MLP) -> hidden
+        states, over one packed token axis.
+
+        Both ragged entry points run exactly this body —
+        `ragged_step_fn` (one engine step per dispatch) and
+        `ragged_loop_fn` (N steps per dispatch, the host-free decode
+        loop) — so the loop's per-iteration math IS the single-step
+        math: same ops in the same order, the property the
+        N-steps-vs-N-dispatches token-identity oracle rests on.
+
+            core(params, tokens, positions, pages, rows, page_tables,
+                 starts, lens, kv_lens, k_pools, v_pools, k_scales,
+                 v_scales) -> (x [T, d], k_pools', v_pools', ks', vs')
+
+        k_scales/v_scales are None unless kv_quant (ks'/vs' are []
+        then); every array contract matches ragged_step_fn's docstring.
+        """
+        from ..parallel.sharding_annotations import (constrain,
+                                                     kv_pool_spec,
+                                                     kv_scale_spec)
+        from .kv_cache import scatter_pool_update
+        from .quantized_kv import quantized_pool_write
+
+        pool_spec = (kv_pool_spec(pool_layout, tp_axis)
+                     if mesh is not None else None)
+        scale_spec = (kv_scale_spec(tp_axis)
+                      if mesh is not None else None)
+        rowmm = self._row_matmul(mesh, tp_axis, quant_collectives)
+
+        def core(params, tokens, positions, pages, rows, page_tables,
+                 starts, lens, kv_lens, k_pools, v_pools, k_scales,
+                 v_scales):
+            tokens = jnp.asarray(tokens, jnp.int32)
+            positions = jnp.asarray(positions, jnp.int32)
+            pages = jnp.asarray(pages, jnp.int32)
+            rows = jnp.asarray(rows, jnp.int32)
+            pt = jnp.asarray(page_tables, jnp.int32)
+            starts = jnp.asarray(starts, jnp.int32)
+            lens = jnp.asarray(lens, jnp.int32)
+            kv_lens = jnp.asarray(kv_lens, jnp.int32)
+            t = tokens.shape[0]
+            # inert slots embed token 0 at position 0 (in bounds by
+            # construction); their K/V rides the sentinel page and their
+            # attention rows belong to no descriptor (exact zeros)
+            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
+            k_out, v_out, ks_out, vs_out = [], [], [], []
+            for li, blk in enumerate(params["blocks"]):
+                hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
+                q, k, v = self._qkv(blk, hn)
+                q = constrain(q, mesh, None, tp_axis, None)
+                k = constrain(k, mesh, None, tp_axis, None)
+                v = constrain(v, mesh, None, tp_axis, None)
+                ks = vs = None
+                if kv_quant:
+                    kp, ks = quantized_pool_write(
+                        k_pools[li], k_scales[li], pages, rows, k,
+                        pool_layout)
+                    vp, vs = quantized_pool_write(
+                        v_pools[li], v_scales[li], pages, rows, v,
+                        pool_layout)
+                    if scale_spec is not None:
+                        ks = constrain(ks, mesh, *scale_spec)
+                        vs = constrain(vs, mesh, *scale_spec)
+                    ks_out.append(ks)
+                    vs_out.append(vs)
+                else:
+                    kp = scatter_pool_update(
+                        k_pools[li], pages, rows,
+                        k.astype(k_pools[li].dtype), pool_layout)
+                    vp = scatter_pool_update(
+                        v_pools[li], pages, rows,
+                        v.astype(v_pools[li].dtype), pool_layout)
+                if pool_spec is not None:
+                    kp = constrain(kp, mesh, *pool_spec)
+                    vp = constrain(vp, mesh, *pool_spec)
+                k_out.append(kp)
+                v_out.append(vp)
+                attn = decode_attention.ragged_paged_attention(
+                    q, kp, vp, pt, starts, lens, kv_lens,
+                    use_kernel=use_kernel, layout=pool_layout,
+                    mesh=mesh, tp_axis=tp_axis, k_scale=ks, v_scale=vs)
+                x = x + rowmm(attn.reshape(t, self.d_model), blk["wo"])
+                x = x + self._mlp_rowmm(
+                    blk, _layer_norm(x, blk["ln2_s"], blk["ln2_b"]),
+                    rowmm)
+            return x, k_out, v_out, ks_out, vs_out
+
+        return core
+
     def ragged_step_fn(self, page_size, num_pages, use_kernel=False,
                        pool_layout="token", mesh=None, tp_axis=None,
                        kv_quant=False, quant_collectives=False,
@@ -606,17 +699,12 @@ class TinyCausalLM:
         the sampling mix.  spec_tokens shapes a [S, k] intermediate
         only — the compile menu stays one executable per pages bucket,
         exactly as without speculation."""
-        from ..parallel.sharding_annotations import (constrain,
-                                                     kv_pool_spec,
-                                                     kv_scale_spec)
-        from .kv_cache import scatter_pool_update
-        from .quantized_kv import quantized_pool_write
+        from ..parallel.sharding_annotations import constrain
 
-        pool_spec = (kv_pool_spec(pool_layout, tp_axis)
-                     if mesh is not None else None)
-        scale_spec = (kv_scale_spec(tp_axis)
-                      if mesh is not None else None)
-        rowmm = self._row_matmul(mesh, tp_axis, quant_collectives)
+        core = self._ragged_core_fn(
+            use_kernel=use_kernel, pool_layout=pool_layout, mesh=mesh,
+            tp_axis=tp_axis, kv_quant=kv_quant,
+            quant_collectives=quant_collectives)
 
         def step(params, tokens, positions, pages, rows, page_tables,
                  starts, lens, kv_lens, k_pools, v_pools, *rest):
@@ -625,58 +713,13 @@ class TinyCausalLM:
             else:
                 k_scales = v_scales = None
             tokens = jnp.asarray(tokens, jnp.int32)
-            positions = jnp.asarray(positions, jnp.int32)
-            pages = jnp.asarray(pages, jnp.int32)
-            rows = jnp.asarray(rows, jnp.int32)
-            pt = jnp.asarray(page_tables, jnp.int32)
             starts = jnp.asarray(starts, jnp.int32)
             lens = jnp.asarray(lens, jnp.int32)
-            kv_lens = jnp.asarray(kv_lens, jnp.int32)
             t = tokens.shape[0]
-            # inert slots embed token 0 at position 0 (in bounds by
-            # construction); their K/V rides the sentinel page and their
-            # attention rows belong to no descriptor (exact zeros)
-            x = params["tok_emb"][tokens] + params["pos_emb"][positions]
-            k_out, v_out, ks_out, vs_out = [], [], [], []
-            for li, blk in enumerate(params["blocks"]):
-                hn = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
-                q, k, v = self._qkv(blk, hn)
-                q = constrain(q, mesh, None, tp_axis, None)
-                k = constrain(k, mesh, None, tp_axis, None)
-                v = constrain(v, mesh, None, tp_axis, None)
-                ks = vs = None
-                if kv_quant:
-                    kp, ks = quantized_pool_write(
-                        k_pools[li], k_scales[li], pages, rows, k,
-                        pool_layout)
-                    vp, vs = quantized_pool_write(
-                        v_pools[li], v_scales[li], pages, rows, v,
-                        pool_layout)
-                    if scale_spec is not None:
-                        ks = constrain(ks, mesh, *scale_spec)
-                        vs = constrain(vs, mesh, *scale_spec)
-                    ks_out.append(ks)
-                    vs_out.append(vs)
-                else:
-                    kp = scatter_pool_update(
-                        k_pools[li], pages, rows,
-                        k.astype(k_pools[li].dtype), pool_layout)
-                    vp = scatter_pool_update(
-                        v_pools[li], pages, rows,
-                        v.astype(v_pools[li].dtype), pool_layout)
-                if pool_spec is not None:
-                    kp = constrain(kp, mesh, *pool_spec)
-                    vp = constrain(vp, mesh, *pool_spec)
-                k_out.append(kp)
-                v_out.append(vp)
-                attn = decode_attention.ragged_paged_attention(
-                    q, kp, vp, pt, starts, lens, kv_lens,
-                    use_kernel=use_kernel, layout=pool_layout,
-                    mesh=mesh, tp_axis=tp_axis, k_scale=ks, v_scale=vs)
-                x = x + rowmm(attn.reshape(t, self.d_model), blk["wo"])
-                x = x + self._mlp_rowmm(
-                    blk, _layer_norm(x, blk["ln2_s"], blk["ln2_b"]),
-                    rowmm)
+            x, k_out, v_out, ks_out, vs_out = core(
+                params, tokens, positions, pages, rows, page_tables,
+                starts, lens, kv_lens, k_pools, v_pools, k_scales,
+                v_scales)
             # per-descriptor sampling rows: the last packed row each
             # descriptor owns (padding descriptors read row 0 — garbage
             # the engine never fetches a token from)
@@ -734,6 +777,252 @@ class TinyCausalLM:
             return (ids, logits), k_out, v_out
 
         return step
+
+    # ------------------------ host-free decode loop --------------------
+    def ragged_loop_fn(self, page_size, num_pages, use_kernel=False,
+                       pool_layout="token", mesh=None, tp_axis=None,
+                       kv_quant=False, quant_collectives=False,
+                       spec_tokens=0, loop_steps=2, max_stop_ids=8,
+                       max_stop_seqs=4, max_stop_len=8):
+        """Build the HOST-FREE DECODE LOOP function: N ragged decode
+        steps fused into one dispatch (fused.LoopedRaggedStep), with
+        on-device sampling, on-device stop matching, per-row done masks
+        with early exit, and ONE fetchable output for the whole loop
+        (docs/GENERATION.md "Host-free decode loop")::
+
+            fn(params, cur_tok, cur_pos, live, page_tables, temps,
+               top_ks, top_ps, seeds, counters, remaining, stop_ids,
+               stop_seqs, stop_seq_lens, tail, drafts, draft_lens,
+               k_pools, v_pools[, k_scales, v_scales])
+              -> (out [S, N + K + 6] int32, pools'...)
+
+        Decode-only by construction: descriptor s statically owns
+        packed rows ``[s*(1+K), s*(1+K) + len_s)`` (K = spec_tokens),
+        so the packed axis is ``S * (1 + K)`` and `starts` never moves
+        — prefill chunks and admissions happen at LOOP BOUNDARIES
+        (engine._step_ragged), which is what makes N a
+        latency-vs-admission knob rather than a correctness concern.
+
+        Inputs, all length-S unless noted: cur_tok/cur_pos — the last
+        committed token and its position (== resident KV length: its
+        K/V is written by the FIRST iteration, exactly the single-step
+        protocol); live — 1 for occupied slots; temps/top_ks/top_ps/
+        seeds/counters — the per-row sampling menu and SampleStream
+        state (temps == 0 marks a greedy row; stochastic rows consume
+        exactly one hash-uniform draw per live iteration, the SAME key
+        sequence the host sampler consumes); remaining — max_new_tokens
+        minus tokens generated (>= 1 for live rows); stop_ids [S, MS]
+        (pad -1), stop_seqs [S, NS, LS] right-aligned (pad -1) with
+        stop_seq_lens [S, NS], tail [S, LS - 1] — the last generated
+        tokens right-aligned (pad -1), the suffix-match window; drafts
+        [S, max(K, 1)] / draft_lens — ngram drafts verified at
+        ITERATION 0 ONLY (greedy token streams are draft-independent,
+        so drafting only at the boundary is exact vs the
+        draft-every-step N=1 oracle; later iterations overwrite any
+        rejected-draft positions, and the host truncates to final_pos
+        after the fetch).
+
+        Per iteration, the body runs the SHARED ragged core
+        (_ragged_core_fn — the same trace ragged_step_fn runs), then
+        an epilogue that mirrors the engine's host gate order
+        (_apply_token) token for token: verify drafts (verify_accept),
+        sample stochastic rows on device
+        (sampling.sample_tokens_device's math), then for each of the
+        up-to-(K+1) candidate tokens — stop-token membership, stop-
+        sequence suffix match (the completing token is withheld),
+        append (stream + tail shift), length finish (that token IS
+        streamed).  Rows finish with code 1 (stop) or 2 (length); the
+        loop exits early when every live row has finished.
+
+        The single output packs, per row: N + K emitted-token columns,
+        then n_emit, finish code, finish_iter (-1 if unfinished),
+        final_pos (position of the last committed token — the host's
+        truncate target), counter_after, iters_run — token ids +
+        done/stop metadata in ONE [S, N+K+6] host fetch per N steps.
+        Pools (and int8 scales) ride the lax.while_loop carry on the
+        existing donation chain.
+        """
+        import jax.lax as lax
+
+        from ..parallel.sharding_annotations import constrain
+        from . import sampling as _sampling
+        from .speculation import verify_accept
+
+        page_size = int(page_size)
+        num_pages = int(num_pages)
+        n_steps = int(loop_steps)
+        kk = int(spec_tokens)
+        kd = max(kk, 1)
+        ms = int(max_stop_ids)
+        ns = int(max_stop_seqs)
+        ls = max(int(max_stop_len), 1)
+        if n_steps < 1:
+            raise ValueError(f"loop_steps must be >= 1, got {loop_steps}")
+        max_emit = n_steps + kk
+        core = self._ragged_core_fn(
+            use_kernel=use_kernel, pool_layout=pool_layout, mesh=mesh,
+            tp_axis=tp_axis, kv_quant=kv_quant,
+            quant_collectives=quant_collectives)
+        max_pos = self.max_positions
+
+        def fn(params, cur_tok, cur_pos, live, page_tables, temps,
+               top_ks, top_ps, seeds, counters, remaining, stop_ids,
+               stop_seqs, stop_seq_lens, tail, drafts, draft_lens,
+               k_pools, v_pools, *rest):
+            if kv_quant:
+                k_scales, v_scales = rest
+            else:
+                k_scales = v_scales = None
+            cur_tok = jnp.asarray(cur_tok, jnp.int32)
+            cur_pos = jnp.asarray(cur_pos, jnp.int32)
+            live = jnp.asarray(live, jnp.int32)
+            pt = jnp.asarray(page_tables, jnp.int32)
+            temps = jnp.asarray(temps, jnp.float32)
+            top_ks = jnp.asarray(top_ks, jnp.int32)
+            top_ps = jnp.asarray(top_ps, jnp.float32)
+            seeds = jnp.asarray(seeds, jnp.int32)
+            counters = jnp.asarray(counters, jnp.int32)
+            remaining = jnp.asarray(remaining, jnp.int32)
+            stop_ids = jnp.asarray(stop_ids, jnp.int32)
+            stop_seqs = jnp.asarray(stop_seqs, jnp.int32)
+            stop_seq_lens = jnp.asarray(stop_seq_lens, jnp.int32)
+            tail = jnp.asarray(tail, jnp.int32)
+            drafts = jnp.asarray(drafts, jnp.int32)
+            draft_lens = jnp.asarray(draft_lens, jnp.int32)
+            s = cur_tok.shape[0]
+            offs = jnp.arange(1 + kk, dtype=jnp.int32)          # [1+K]
+            starts = jnp.arange(s, dtype=jnp.int32) * (1 + kk)
+            greedy_row = temps <= 0.0
+            row_ix = jnp.arange(s, dtype=jnp.int32)
+
+            def body(carry):
+                (it, cur_tok, cur_pos, finish, finish_iter, n_emit,
+                 remaining, counters, tail, emitted, k_po, v_po, k_sc,
+                 v_sc) = carry
+                act0 = (live > 0) & (finish == 0)
+                # iteration 0 verifies the host's ngram drafts; later
+                # iterations are plain single-token rows (greedy
+                # streams are draft-independent, so this is exact)
+                dlen = jnp.where((it == 0) & act0, draft_lens, 0)
+                len_s = jnp.where(act0, 1 + dlen, 0)
+                valid = offs[None, :] < len_s[:, None]        # [S,1+K]
+                tok_grid = (jnp.concatenate(
+                    [cur_tok[:, None], drafts[:, :kk]], axis=1)
+                    if kk else cur_tok[:, None])
+                pos_grid = cur_pos[:, None] + offs[None, :]
+                tokens_p = jnp.where(valid, tok_grid, 0).reshape(-1)
+                positions_p = jnp.where(
+                    valid, jnp.clip(pos_grid, 0, max_pos - 1),
+                    0).reshape(-1)
+                page_ix = jnp.clip(pos_grid // page_size, 0,
+                                   pt.shape[1] - 1)
+                pages_p = jnp.where(
+                    valid, jnp.take_along_axis(pt, page_ix, axis=1),
+                    num_pages).reshape(-1)
+                rows_p = jnp.where(valid, pos_grid % page_size,
+                                   0).reshape(-1)
+                kv_lens = jnp.where(act0, cur_pos + 1 + dlen, 0)
+                x, k_po, v_po, k_sc, v_sc = core(
+                    params, tokens_p, positions_p, pages_p, rows_p,
+                    pt, starts, len_s, kv_lens, list(k_po), list(v_po),
+                    list(k_sc) if kv_quant else None,
+                    list(v_sc) if kv_quant else None)
+                t = tokens_p.shape[0]
+                # verify window + sample rows through ONE head matmul
+                # (the ragged_step_fn spec-epilogue shape: O(S*K) head
+                # cost, never O(T))
+                sample_rows = jnp.clip(starts + len_s - 1, 0, t - 1)
+                vrows = jnp.clip(starts[:, None] + offs[None, :],
+                                 0, t - 1)                    # [S,1+K]
+                gathered = jnp.concatenate(
+                    [x[vrows.reshape(-1)], x[sample_rows]], axis=0)
+                heads = (_layer_norm(gathered, params["ln_f_s"],
+                                     params["ln_f_b"])
+                         @ params["head"])
+                amax_rows = jnp.argmax(
+                    heads[:s * (1 + kk)],
+                    axis=-1).astype(jnp.int32).reshape(s, 1 + kk)
+                logits = heads[s * (1 + kk):]                 # [S, V]
+                accepted, bonus = verify_accept(
+                    amax_rows, tokens_p, starts, len_s, kk, np_mod=jnp)
+                # on-device sampling: the host sampler's exact f32
+                # formula over the same hash-uniform key sequence;
+                # greedy rows consume no draw
+                sampled, ctr_next = _sampling.sample_tokens_device(
+                    logits, temps, top_ks, top_ps, seeds, counters,
+                    jnp_mod=jnp)
+                counters = jnp.where(act0, ctr_next, counters)
+                final_tok = jnp.where(greedy_row, bonus, sampled)
+                # stream the accepted drafts then the final token
+                # through the engine's exact _apply_token gate order:
+                # stop-id -> stop-seq (token withheld) -> append ->
+                # length (token streamed)
+                for j in range(kk + 1):
+                    tok = (jnp.where(j < accepted, drafts[:, min(j, kd - 1)],
+                                     final_tok)
+                           if kk else final_tok)
+                    emit_ok = act0 & (finish == 0) & (j <= accepted)
+                    hit_id = jnp.any(tok[:, None] == stop_ids, axis=1)
+                    cand = jnp.concatenate([tail, tok[:, None]],
+                                           axis=1)            # [S, LS]
+                    seq_eq = ((stop_seqs == -1)
+                              | (cand[:, None, :] == stop_seqs))
+                    hit_seq = jnp.any(
+                        jnp.all(seq_eq, axis=2) & (stop_seq_lens > 0),
+                        axis=1)
+                    stop_hit = emit_ok & (hit_id | hit_seq)
+                    appended = emit_ok & ~stop_hit
+                    col = jnp.clip(n_emit, 0, max_emit - 1)
+                    old = emitted[row_ix, col]
+                    emitted = emitted.at[row_ix, col].set(
+                        jnp.where(appended, tok, old))
+                    n_emit = n_emit + appended.astype(jnp.int32)
+                    tail = jnp.where(
+                        appended[:, None],
+                        jnp.concatenate([tail[:, 1:], tok[:, None]],
+                                        axis=1), tail)
+                    cur_tok = jnp.where(appended, tok, cur_tok)
+                    cur_pos = jnp.where(appended, cur_pos + 1, cur_pos)
+                    remaining = remaining - appended.astype(jnp.int32)
+                    len_hit = appended & (remaining <= 0)
+                    finish = jnp.where(
+                        stop_hit, 1, jnp.where(len_hit, 2, finish))
+                    done_now = (stop_hit | len_hit) & (finish_iter < 0)
+                    finish_iter = jnp.where(done_now, it, finish_iter)
+                return (it + 1, cur_tok, cur_pos, finish, finish_iter,
+                        n_emit, remaining, counters, tail, emitted,
+                        tuple(k_po), tuple(v_po), tuple(k_sc),
+                        tuple(v_sc))
+
+            def cond(carry):
+                it, finish = carry[0], carry[3]
+                return (it < n_steps) & jnp.any((live > 0)
+                                                & (finish == 0))
+
+            init = (jnp.int32(0), cur_tok, cur_pos,
+                    jnp.zeros((s,), jnp.int32),
+                    jnp.full((s,), -1, jnp.int32),
+                    jnp.zeros((s,), jnp.int32), remaining, counters,
+                    tail, jnp.full((s, max_emit), -1, jnp.int32),
+                    tuple(k_pools), tuple(v_pools),
+                    tuple(k_scales) if kv_quant else (),
+                    tuple(v_scales) if kv_quant else ())
+            (it, cur_tok, cur_pos, finish, finish_iter, n_emit,
+             remaining, counters, tail, emitted, k_po, v_po, k_sc,
+             v_sc) = lax.while_loop(cond, body, init)
+            out = jnp.concatenate(
+                [emitted, n_emit[:, None], finish[:, None],
+                 finish_iter[:, None], cur_pos[:, None],
+                 counters[:, None],
+                 jnp.full((s, 1), 1, jnp.int32) * it], axis=1)
+            # replicated output: ONE host fetch for the whole loop
+            out = constrain(out, mesh)
+            if kv_quant:
+                return out, list(k_po), list(v_po), list(k_sc), \
+                    list(v_sc)
+            return out, list(k_po), list(v_po)
+
+        return fn
 
     # ------------------------ reference decode ------------------------
     def greedy_reference(self, prompt, max_new_tokens, stop_tokens=()):

@@ -1,14 +1,102 @@
-"""Token sampling: greedy, temperature, top-k, top-p.
+"""Token sampling: greedy, temperature, top-k, top-p — host/device twins.
 
-Host-side numpy on one [V] logits row per sequence per step — the
-sampler is never the bottleneck next to a TPU decode dispatch, and numpy
-keeps it deterministic per request: each request carries its own
-``np.random.Generator`` seeded from ``SamplingParams.seed``, so a given
-(model, prompt, params) pair replays the same tokens regardless of which
-other sequences share its batch.  That independence is what lets the
-continuous-batching oracle demand token-identical output.
+The sampler has TWO row-for-row identical implementations: a host-side
+numpy path (`sample_token` / `sample_tokens_batch`) that the per-step
+engine uses, and an in-trace jnp path (`sample_tokens_device`) that the
+host-free decode loop runs on device (docs/GENERATION.md "Host-free
+decode loop").  Identity is by construction, not by luck:
+
+- Randomness is a COUNTER-BASED hash stream, not a stateful generator:
+  each request carries a :class:`SampleStream` ``(seed, counter)`` and
+  draw ``i`` is ``uniform(seed, i)`` — a pure uint32 mix whose integer
+  arithmetic is bit-exact in numpy and jnp.  The stream is two ints, so
+  it pickles into migration snapshots and resumes mid-sequence on any
+  replica, and the device loop can consume N draws in-trace and hand
+  the advanced counter back to the host.
+- The selection math (temperature scale, top-k threshold, softmax,
+  top-p nucleus, CDF inversion) is the SAME float32 formula on both
+  sides.  Reduction order may differ by ULPs between numpy and XLA,
+  which matters only when a draw lands within ULPs of a CDF boundary —
+  a measure-zero event under the 24-bit uniform; the parity suite
+  pins row-for-row identity across the sampling menu with seeded
+  streams.
+
+A given (model, prompt, params) pair replays the same tokens regardless
+of which other sequences share its batch and regardless of which path
+sampled it.  That independence is what lets the continuous-batching
+oracle demand token-identical output.
 """
 import numpy as np
+
+_GOLDEN = 0x9E3779B9          # 2**32 / golden ratio: counter stride
+_U24 = np.float32(1.0 / (1 << 24))
+
+
+def _mix32(x, np_mod=np):
+    """Integer finalizer (splitmix-style avalanche) over uint32 arrays.
+
+    numpy/jnp twin: uint32 multiply/xor/shift wrap identically on both
+    sides, so the stream is BIT-exact between host and device.  Inputs
+    must already be uint32 *arrays* (numpy 2 scalars raise on overflow
+    where arrays wrap).
+    """
+    m = np_mod
+    x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+    x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+    return x ^ (x >> np.uint32(16))
+
+
+def hash_uniform(seed, counter, np_mod=np):
+    """Uniform f32 draws in [0, 1) from (seed, counter) uint32 pairs.
+
+    Pure function of its inputs — draw ``i`` of stream ``s`` is the
+    same number on host and device, which is the entire host/device
+    sampler-parity story.  Uses the top 24 bits of the mixed word so
+    the result is exactly representable in float32.
+    """
+    m = np_mod
+    seed = m.asarray(seed).astype(m.uint32)
+    counter = m.asarray(counter).astype(m.uint32)
+    x = _mix32(seed ^ (counter * np.uint32(_GOLDEN)), m)
+    return (x >> np.uint32(8)).astype(m.float32) * _U24
+
+
+class SampleStream:
+    """Counter-based per-request RNG: two ints, pure draws.
+
+    Replaces ``np.random.Generator`` as the scheduler's per-sequence
+    ``state.rng``.  The (seed, counter) pair pickles into migration
+    snapshots; the device decode loop consumes draws by computing
+    ``hash_uniform(seed, counter + i)`` in-trace and returns the
+    advanced counter in its fetch, which the host stores back here —
+    host and device paths therefore consume the SAME key sequence.
+    """
+
+    __slots__ = ("seed", "counter")
+
+    def __init__(self, seed, counter=0):
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.counter = int(counter) & 0xFFFFFFFF
+
+    def next_uniform(self):
+        # length-1 arrays, not scalars: numpy warns on 0-d uint32
+        # wraparound but wraps arrays silently (the values are
+        # identical either way)
+        u = float(hash_uniform(np.array([self.seed], np.uint32),
+                               np.array([self.counter], np.uint32))[0])
+        self.counter = (self.counter + 1) & 0xFFFFFFFF
+        return u
+
+    # migration snapshots pickle the stream; __slots__ classes get
+    # protocol-2 state for free, but old snapshots may carry a
+    # Generator — import_sequence tolerates both (engine.py)
+    def __repr__(self):
+        return f"SampleStream(seed={self.seed}, counter={self.counter})"
+
+    def __eq__(self, other):
+        return (isinstance(other, SampleStream)
+                and (self.seed, self.counter)
+                == (other.seed, other.counter))
 
 
 class SamplingParams:
@@ -64,7 +152,58 @@ class SamplingParams:
         return self.temperature == 0.0
 
     def make_rng(self):
-        return np.random.default_rng(self.seed)
+        return SampleStream(self.seed)
+
+
+def _nucleus_probs(x, params, np_mod=np):
+    """Masked+renormalized f32 probabilities after temperature, top-k
+    and top-p — the shared host/device selection formula.
+
+    x: [V] float32 logits (already a float32 array).  Every op here has
+    a bit-for-bit twin on the other side except the reductions, whose
+    ULP-level order differences only matter at CDF boundaries.
+    """
+    m = np_mod
+    v = x.shape[0]
+    x = x / np.float32(params.temperature)
+    k = params.top_k
+    if k is not None and k < v:
+        kth = m.sort(x)[v - k]
+        x = m.where(x >= kth, x, -m.inf)
+    e = m.exp(x - m.max(x))
+    p = e / m.sum(e)
+    if params.top_p is not None and params.top_p < 1.0:
+        tp = np.float32(params.top_p)
+        order = m.argsort(-p, kind="stable") if m is np else m.argsort(-p)
+        csum = m.cumsum(p[order])
+        # smallest prefix reaching top_p: ranks whose cumulative sum
+        # strictly before them hasn't yet reached top_p
+        keep_n = m.sum((csum < tp).astype(m.int32)) + 1
+        if m is np:
+            keep = np.zeros(v, bool)
+            keep[order[:int(keep_n)]] = True
+        else:
+            keep = m.zeros(v, bool).at[order].set(m.arange(v) < keep_n)
+        p = m.where(keep, p, np.float32(0.0))
+        p = p / m.sum(p)
+    return p
+
+
+def _invert_cdf(p, u, np_mod=np):
+    """Token index for draw u under probs p: CDF inversion, twinned.
+
+    ``searchsorted(cumsum(p), u, 'right')`` == ``sum(csum <= u)`` —
+    zero-probability tokens own empty intervals so they are never
+    selected; the clip to the last positive-probability index covers
+    the one float edge where the total mass rounds below the draw.
+    """
+    m = np_mod
+    v = p.shape[0]
+    csum = m.cumsum(p)
+    idx = m.sum((csum <= u).astype(m.int32))
+    last = m.max(m.arange(v, dtype=m.int32)
+                 * (p > 0).astype(m.int32))
+    return m.minimum(idx, last)
 
 
 def sample_tokens_batch(logits, params_list, rngs):
@@ -73,12 +212,12 @@ def sample_tokens_batch(logits, params_list, rngs):
     Greedy rows are sampled with ONE vectorized ``argmax(..., axis=-1)``
     over the whole greedy sub-block instead of B separate sample_token
     calls — the host-side per-row loop was decode-step overhead once the
-    device work collapsed to a single dispatch.  Stochastic rows keep
-    their per-request numpy RNGs and go through sample_token unchanged,
-    so every row's token is IDENTICAL to the per-row path: the greedy
-    argmax is over the same float64 view sample_token casts to (an exact,
-    order-preserving cast), and numpy's first-max tie rule is the same
-    either way."""
+    device work collapsed to a single dispatch.  Stochastic rows consume
+    one draw from their per-request :class:`SampleStream` through
+    sample_token, so every row's token is IDENTICAL to the per-row
+    path: the greedy argmax is over the same float64 view sample_token
+    casts to (an exact, order-preserving cast), and numpy's first-max
+    tie rule is the same either way."""
     logits = np.asarray(logits)
     out = [None] * len(params_list)
     greedy_rows = [i for i, p in enumerate(params_list) if p.greedy]
@@ -93,24 +232,75 @@ def sample_tokens_batch(logits, params_list, rngs):
 
 
 def sample_token(logits, params, rng):
-    """One token id from a [V] float logits row."""
-    logits = np.asarray(logits, np.float64).reshape(-1)
+    """One token id from a [V] float logits row.
+
+    `rng` is a :class:`SampleStream`; stochastic rows consume exactly
+    one draw (greedy consumes none).  The stochastic math is float32 —
+    the same formula `sample_tokens_device` runs in-trace.
+    """
     if params.greedy:
-        return int(np.argmax(logits))
-    logits = logits / params.temperature
-    if params.top_k is not None and params.top_k < logits.size:
-        kth = np.partition(logits, -params.top_k)[-params.top_k]
-        logits = np.where(logits >= kth, logits, -np.inf)
-    probs = np.exp(logits - np.max(logits))
-    probs /= probs.sum()
-    if params.top_p is not None and params.top_p < 1.0:
-        order = np.argsort(-probs, kind="stable")
-        csum = np.cumsum(probs[order])
-        # smallest prefix reaching top_p: keep ranks whose cumulative
-        # sum up to and including them hasn't passed top_p before them
-        keep_n = int(np.searchsorted(csum, params.top_p) + 1)
-        mask = np.zeros_like(probs, bool)
-        mask[order[:keep_n]] = True
-        probs = np.where(mask, probs, 0.0)
-        probs /= probs.sum()
-    return int(rng.choice(probs.size, p=probs))
+        return int(np.argmax(np.asarray(logits, np.float64).reshape(-1)))
+    x = np.asarray(logits, np.float32).reshape(-1)
+    p = _nucleus_probs(x, params, np)
+    u = np.float32(rng.next_uniform())
+    return int(_invert_cdf(p, u, np))
+
+
+def sample_tokens_device(logits, temps, top_ks, top_ps, seeds, counters,
+                         jnp_mod=None):
+    """In-trace twin of `sample_tokens_batch` over a [S, V] logits block.
+
+    temps: [S] f32 (0.0 → greedy row); top_ks: [S] int32 (0 → off);
+    top_ps: [S] f32 (1.0 → off); seeds/counters: [S] uint32-valued
+    int32 — the per-request :class:`SampleStream` state.  Returns
+    ``(tokens [S] int32, counters_after [S] int32)``: stochastic rows
+    consume exactly one draw (counter + 1), greedy rows consume none —
+    the SAME key sequence the host path consumes, so a sequence can
+    cross between paths mid-stream and keep its token stream.
+
+    Row-for-row identical to the host sampler by the twinning argument
+    in the module docstring; proven across the greedy/temperature/
+    top-k/top-p menu by the parity suite (tests/test_looped_decode.py).
+    """
+    import jax.numpy as jnp
+    m = jnp_mod if jnp_mod is not None else jnp
+    logits = m.asarray(logits, m.float32)
+    s, v = logits.shape
+    temps = m.asarray(temps, m.float32)
+    greedy = temps <= 0.0
+    # temperature: 1.0 on greedy rows so the stochastic lane stays NaN-free
+    x = logits / m.where(greedy, 1.0, temps)[:, None]
+    # top-k: k <= 0 or k >= V disables (threshold at the smallest value)
+    top_ks = m.asarray(top_ks, m.int32)
+    kidx = m.clip(m.where((top_ks <= 0) | (top_ks >= v), v, top_ks),
+                  1, v)
+    xs = m.sort(x, axis=-1)                                   # [S, V] asc
+    kth = m.take_along_axis(xs, (v - kidx)[:, None], axis=1)  # [S, 1]
+    x = m.where(x >= kth, x, -m.inf)
+    e = m.exp(x - m.max(x, axis=-1, keepdims=True))
+    p = e / m.sum(e, axis=-1, keepdims=True)
+    # top-p nucleus: argsort desc (stable), keep the smallest prefix
+    # whose cumulative mass reaches top_p, renormalize
+    top_ps = m.asarray(top_ps, m.float32)
+    order = m.argsort(-p, axis=-1)                            # [S, V]
+    csum = m.cumsum(m.take_along_axis(p, order, axis=1), axis=-1)
+    tp = m.where(top_ps < 1.0, top_ps, 2.0)[:, None]          # off → keep all
+    keep_n = m.sum((csum < tp).astype(m.int32), axis=-1,
+                   keepdims=True) + 1                         # [S, 1]
+    keep_sorted = m.arange(v)[None, :] < keep_n               # [S, V]
+    keep = m.zeros((s, v), bool)
+    keep = keep.at[m.arange(s)[:, None], order].set(keep_sorted)
+    p = m.where(keep, p, 0.0)
+    p = p / m.sum(p, axis=-1, keepdims=True)
+    # CDF inversion on this row's next stream draw
+    counters = m.asarray(counters, m.int32)
+    u = hash_uniform(m.asarray(seeds, m.int32), counters, m)[:, None]
+    csum2 = m.cumsum(p, axis=-1)
+    idx = m.sum((csum2 <= u).astype(m.int32), axis=-1)
+    last = m.max(m.arange(v, dtype=m.int32)[None, :]
+                 * (p > 0).astype(m.int32), axis=-1)
+    stoch = m.minimum(idx, last)
+    tokens = m.where(greedy, m.argmax(logits, axis=-1).astype(m.int32),
+                     stoch.astype(m.int32))
+    counters_after = m.where(greedy, counters, counters + 1)
+    return tokens, counters_after

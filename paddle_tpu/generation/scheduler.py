@@ -219,6 +219,16 @@ class ContinuousBatchingScheduler:
           out of the fixed axis."""
         plan = {}
         left = None if room is None else int(room)
+        # persistent-index proposers (NgramProposer.propose_for) index
+        # incrementally per sequence; evict finished sequences' indexes
+        # first, then catch each live row's index up to its history.
+        # Duck-typed so any propose(history, k) object still plugs in.
+        propose_for = getattr(proposer, "propose_for", None)
+        if propose_for is not None:
+            live = {s.seq_id for s in self.active()}
+            live.update(s.seq_id for s in self._pending
+                        if isinstance(s, SequenceState))
+            proposer.retain(live)
         for state in self.decode_ready():
             if left is not None and left <= 0:
                 break
@@ -230,7 +240,9 @@ class ContinuousBatchingScheduler:
                 k = min(k, left)
             if k <= 0:
                 continue
-            drafts = proposer.propose(state.tokens, k)
+            drafts = (propose_for(state.seq_id, state.tokens, k)
+                      if propose_for is not None
+                      else proposer.propose(state.tokens, k))
             if not drafts:
                 continue
             plan[state.seq_id] = drafts

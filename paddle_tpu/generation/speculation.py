@@ -40,6 +40,91 @@ Two pieces live here, ONE home for the contract both sides share:
 import numpy as np
 
 
+class NgramIndex:
+    """Incremental per-sequence n-gram index: dict n-gram → its two
+    most recent end positions, maintained in O(max_ngram) per appended
+    token.
+
+    The rescan proposer paid O(max_lookback * max_ngram) per row per
+    step — the ONE host cost that grew with batch, and with the
+    host-free decode loop the proposer runs at loop boundaries where
+    several tokens land at once.  The index replaces the scan with a
+    dict probe: `extend` records, for every n-gram size in
+    [min_ngram, max_ngram], the gram ending at each new token;
+    `lookup` probes the current suffix gram and reads its most recent
+    earlier occurrence straight from the dict.
+
+    Two end positions per gram suffice for exact rescan equivalence:
+    the suffix's own occurrence is always the most recent entry
+    (`last == len(tokens)`), so the candidate is `prev` in that case
+    and `last` otherwise — precisely the rescan's "most recent
+    occurrence strictly before the suffix".  The lookback window is
+    honored at probe time (an occurrence that slid out of the window
+    is rejected, and anything older is older still), so
+    ``index.lookup == rescan`` token-for-token; the equivalence suite
+    fuzzes that claim.
+
+    Histories only append (speculative rewinds truncate KV positions,
+    never the committed token list), so `extend` is a pure catch-up;
+    a shrunken history (defensive) rebuilds from scratch.
+    """
+
+    __slots__ = ("max_ngram", "min_ngram", "max_lookback", "n", "_grams")
+
+    def __init__(self, max_ngram, min_ngram, max_lookback):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_lookback = int(max_lookback)
+        self.n = 0            # tokens indexed so far
+        self._grams = {}      # gram tuple -> (last_end, prev_end)
+
+    def extend(self, tokens):
+        """Index tokens[self.n:] — O(new_tokens * max_ngram)."""
+        n = len(tokens)
+        if n < self.n:
+            self.n = 0
+            self._grams.clear()
+        for t in range(self.n, n):
+            e = t + 1
+            for g in range(self.min_ngram, self.max_ngram + 1):
+                if e < g:
+                    continue
+                gram = tuple(tokens[e - g:e])
+                cur = self._grams.get(gram)
+                self._grams[gram] = (e, cur[0] if cur is not None
+                                     else None)
+        self.n = n
+
+    def lookup(self, tokens, k):
+        """Up to `k` draft ids continuing `tokens` (must be indexed
+        through `extend` first), or ``[]`` on a miss — the rescan
+        proposer's contract, O(max_ngram) dict probes."""
+        k = int(k)
+        n = len(tokens)
+        if k <= 0 or n != self.n:
+            return [] if k <= 0 else self._fresh_lookup(tokens, k)
+        w0 = n - self.max_lookback
+        for g in range(self.max_ngram, self.min_ngram - 1, -1):
+            if n <= g:
+                continue
+            cur = self._grams.get(tuple(tokens[n - g:n]))
+            if cur is None:
+                continue
+            last, prev = cur
+            end = prev if last == n else last
+            if end is None or end - g < w0:
+                # no occurrence before the suffix, or the most recent
+                # one slid out of the lookback window (older ones are
+                # older still) — try a shorter gram
+                continue
+            return [int(t) for t in tokens[end:end + k]]
+        return []
+
+    def _fresh_lookup(self, tokens, k):
+        self.extend(tokens)
+        return self.lookup(tokens, k)
+
+
 class NgramProposer:
     """Model-free prompt-lookup proposer (PLD): propose the historical
     continuation of the sequence's current n-gram suffix.
@@ -75,11 +160,52 @@ class NgramProposer:
             raise ValueError(
                 f"max_lookback={max_lookback} must exceed "
                 f"max_ngram={max_ngram}")
+        self._indexes = {}    # seq_id -> NgramIndex (propose_for)
+
+    def _make_index(self):
+        return NgramIndex(self.max_ngram, self.min_ngram,
+                          self.max_lookback)
 
     def propose(self, tokens, k):
         """Up to `k` draft token ids continuing `tokens` (a list of
         ints, prompt + generated so far), or ``[]`` when no suffix
-        match exists in the lookback window."""
+        match exists in the lookback window.  One-shot: builds a
+        transient index (same cost class as the old rescan); steady
+        callers use :meth:`propose_for`."""
+        k = int(k)
+        if k <= 0:
+            return []
+        idx = self._make_index()
+        idx.extend(tokens)
+        return idx.lookup(tokens, k)
+
+    def propose_for(self, seq_id, tokens, k):
+        """`propose` with a PERSISTENT per-sequence index: catch-up
+        indexes only the tokens appended since the last call —
+        O(new_tokens * max_ngram) instead of a per-step history rescan
+        (the one host cost that grew with batch).  Token-identical to
+        `propose` / the rescan; `retain` evicts finished sequences."""
+        k = int(k)
+        if k <= 0:
+            return []
+        idx = self._indexes.get(seq_id)
+        if idx is None:
+            idx = self._indexes[seq_id] = self._make_index()
+        idx.extend(tokens)
+        return idx.lookup(tokens, k)
+
+    def retain(self, live_seq_ids):
+        """Drop per-sequence indexes for ids not in `live_seq_ids`
+        (finished/failed sequences; ids are engine-unique so a
+        preempted-and-resumed sequence keeps its index)."""
+        live = set(live_seq_ids)
+        for sid in [s for s in self._indexes if s not in live]:
+            del self._indexes[sid]
+
+    def _propose_rescan(self, tokens, k):
+        """The original lookback rescan, kept as the equivalence
+        reference for the index (tests fuzz propose == _propose_rescan
+        over random histories)."""
         k = int(k)
         if k <= 0:
             return []
@@ -155,4 +281,4 @@ def verify_accept(amax_rows, tokens, starts, lens, spec_tokens,
     return accepted, bonus
 
 
-__all__ = ["NgramProposer", "verify_accept"]
+__all__ = ["NgramProposer", "NgramIndex", "verify_accept"]

@@ -1,0 +1,555 @@
+"""Host-free decode loop: N ragged steps fused into ONE dispatch.
+
+The LoopedRaggedStep path (fused.LoopedRaggedStep +
+model.ragged_loop_fn + engine._dispatch_loop): an in-trace
+lax.while_loop runs up to N ragged decode iterations — on-device
+sampling (counter-based RNG), on-device stop-token AND stop-sequence
+matching, per-row done masks with early exit — and the host fetches
+ONE [S, N+K+6] block of token ids + metadata per N steps instead of
+one sync per token.
+
+Acceptance oracles (all CPU, conftest forces the backend):
+
+1. TOKEN IDENTITY vs the N=1 per-step path (and the legacy eager
+   oracle): greedy and seeded stochastic, stop tokens and multi-token
+   stop sequences, forced preemption, ngram speculation inside the
+   loop, int8 pools, both pool layouts, and the forced 4-device CPU
+   mesh.  Identical means identical — token ids AND finish reasons.
+2. SAMPLER PARITY: sample_tokens_device is row-for-row identical to
+   the host sample_tokens_batch across the greedy/temperature/top-k/
+   top-p menu, on the SAME (seed, counter) streams — the in-trace
+   twin consumes the key sequence the host path consumes, so a
+   sequence can cross between paths mid-stream.
+3. DISPATCH ACCOUNTING: a decode-only loop boundary is exactly 1
+   dispatch and 1 host fetch for up to N tokens per row —
+   generation.decode_host_fetches_per_token <= 1/N on a decode-only
+   run, with loop_steps stamped and early-exit/wasted-step counters
+   schema-present from build time.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import generation as gen
+from paddle_tpu.generation import metrics as gmetrics
+from paddle_tpu.generation.decode_attention import ragged_paged_attention
+from paddle_tpu.generation.sampling import (SampleStream, hash_uniform,
+                                            sample_tokens_batch,
+                                            sample_tokens_device)
+from paddle_tpu.generation.speculation import NgramProposer
+from paddle_tpu.profiler.monitor import StatRegistry
+
+from gen_oracle import greedy_oracle as _ref  # noqa: E402 cross-module memo
+
+
+@pytest.fixture(autouse=True)
+def _fresh_generation_stats():
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(gmetrics.PREFIX):
+            reg.get_stat(name).reset()
+    yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # the ragged/chunked suites' signature: the process-wide greedy
+    # oracle memo (gen_oracle) is shared across files
+    return gen.TinyCausalLM(vocab_size=48, num_layers=2, num_heads=2,
+                            head_dim=8, seed=3)
+
+
+PROMPTS = [[1, 2, 3], [7, 5], [9, 9, 9, 4, 2], [11]]
+
+
+def _run(model, loop_steps, *, prompts=None, max_new=10, slots=4,
+         pages=128, page_size=4, chunk=3, sampling_fn=None, stop_fn=None,
+         step_mode="ragged", **kw):
+    """One engine run: [(token_ids, finish_reason)] + a stat snapshot
+    taken before shutdown (the loop gauges are stamped per engine)."""
+    cfg_kw = dict(max_decode_slots=slots, num_pages=pages,
+                  page_size=page_size, prefill_chunk_tokens=chunk,
+                  kv_backend="device", **kw)
+    if step_mode is not None:
+        cfg_kw["step_mode"] = step_mode
+        cfg_kw["loop_steps"] = loop_steps
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(**cfg_kw),
+                               start=False)
+    hs = []
+    for i, p in enumerate(prompts or PROMPTS):
+        s = sampling_fn(i) if sampling_fn else gen.SamplingParams()
+        st = stop_fn(i) if stop_fn else ()
+        hs.append(eng.submit(p, max_new_tokens=max_new, sampling=s,
+                             stop_tokens=st))
+    eng.run_until_idle()
+    out = [(h.result(timeout=5).token_ids, h.result(timeout=5)
+            .finish_reason) for h in hs]
+    reg = StatRegistry.instance()
+    snap = {n: reg.get_stat(n).get() for n in reg.stats()
+            if n.startswith(gmetrics.PREFIX)}
+    assert eng.cache.utilization() == 0.0
+    eng.shutdown()
+    return out, snap
+
+
+# ----------------------- sampler parity (oracle 2) -----------------------
+
+
+def test_hash_uniform_numpy_jnp_bit_exact():
+    """The counter-based RNG is BIT-exact between host and device: the
+    entire parity story reduces to uint32 ops wrapping identically."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    seeds = rng.integers(0, 2**32, size=256, dtype=np.uint32)
+    counters = rng.integers(0, 2**32, size=256, dtype=np.uint32)
+    host = hash_uniform(seeds, counters)
+    dev = np.asarray(hash_uniform(jnp.asarray(seeds.astype(np.int32)),
+                                  jnp.asarray(counters.astype(np.int32)),
+                                  jnp))
+    assert host.dtype == np.float32 and dev.dtype == np.float32
+    assert np.array_equal(host, dev)
+    assert np.all((host >= 0.0) & (host < 1.0))
+
+
+_SAMPLER_MENU = [
+    gen.SamplingParams(),                                      # greedy
+    gen.SamplingParams(temperature=0.7, seed=11),
+    gen.SamplingParams(temperature=1.3, top_k=5, seed=12),
+    gen.SamplingParams(temperature=0.9, top_p=0.8, seed=13),
+    gen.SamplingParams(temperature=1.0, top_k=9, top_p=0.6, seed=14),
+    gen.SamplingParams(temperature=2.5, top_k=1, seed=15),     # k=1
+    gen.SamplingParams(temperature=0.4, top_p=0.999, seed=16),
+]
+
+
+def test_device_sampler_row_parity_menu():
+    """sample_tokens_device == sample_tokens_batch row for row across
+    the greedy/temperature/top-k/top-p menu and many draws — same
+    tokens, same counter advancement (satellite: parity proven, not
+    assumed)."""
+    rng = np.random.default_rng(3)
+    params = _SAMPLER_MENU
+    host_rngs = [SampleStream(p.seed or 0) for p in params]
+    dev_seeds = np.array([p.seed or 0 for p in params], np.int32)
+    dev_counters = np.zeros(len(params), np.int32)
+    for _ in range(24):
+        logits = rng.standard_normal((len(params), 48)) \
+            .astype(np.float32) * 3.0
+        host_tokens = sample_tokens_batch(logits, params, host_rngs)
+        dev_tokens, dev_counters = sample_tokens_device(
+            logits, np.array([p.temperature for p in params], np.float32),
+            np.array([p.top_k or 0 for p in params], np.int32),
+            np.array([p.top_p if p.top_p is not None else 1.0
+                      for p in params], np.float32),
+            dev_seeds, dev_counters)
+        dev_counters = np.asarray(dev_counters)
+        assert [int(t) for t in np.asarray(dev_tokens)] == host_tokens
+        assert [int(c) for c in dev_counters] \
+            == [r.counter for r in host_rngs]
+    # stochastic rows consumed one draw per step, greedy rows none
+    assert host_rngs[0].counter == 0
+    assert all(r.counter == 24 for r in host_rngs[1:])
+
+
+def test_device_sampler_stream_crossing():
+    """A stream sampled host -> device -> host keeps one key sequence:
+    the device returns the advanced counter and the host continues it,
+    identically to a pure-host run."""
+    p = gen.SamplingParams(temperature=0.8, top_k=12, seed=99)
+    rng = np.random.default_rng(5)
+    blocks = [rng.standard_normal((1, 32)).astype(np.float32)
+              for _ in range(9)]
+    pure = SampleStream(99)
+    want = [sample_tokens_batch(b, [p], [pure])[0] for b in blocks]
+    mixed = SampleStream(99)
+    got = []
+    for i, b in enumerate(blocks):
+        if i % 3 == 1:      # every third draw runs in-trace
+            toks, ctr = sample_tokens_device(
+                b, np.array([p.temperature], np.float32),
+                np.array([p.top_k], np.int32), np.array([1.0], np.float32),
+                np.array([p.seed], np.int32),
+                np.array([mixed.counter], np.int32))
+            got.append(int(np.asarray(toks)[0]))
+            mixed.counter = int(np.asarray(ctr)[0]) & 0xFFFFFFFF
+        else:
+            got.append(sample_tokens_batch(b, [p], [mixed])[0])
+    assert got == want and mixed.counter == pure.counter == 9
+
+
+# ------------------- incremental ngram index (satellite) -----------------
+
+
+def test_ngram_index_fuzz_matches_rescan():
+    """The incremental index IS the rescan, token for token: fuzzed
+    over random repetitive histories x (max_ngram, lookback) shapes."""
+    rng = np.random.default_rng(11)
+    for trial in range(40):
+        prop = NgramProposer(max_ngram=int(rng.integers(1, 4)),
+                             min_ngram=1,
+                             max_lookback=int(rng.integers(6, 40)))
+        # small vocab + pasted repeats: collisions and real matches
+        hist = [int(t) for t in rng.integers(0, 5, size=rng.integers(2, 60))]
+        if len(hist) > 8 and rng.random() < 0.7:
+            hist = hist + hist[2:7]
+        for k in (1, 3, 5):
+            assert prop.propose(hist, k) == prop._propose_rescan(hist, k), \
+                (trial, prop.max_ngram, prop.max_lookback, k, hist)
+
+
+def test_ngram_propose_for_catch_up_and_retain():
+    """propose_for's persistent index catches up append-only histories
+    and stays token-identical to the one-shot propose; retain evicts
+    finished sequences (and a shrunken history rebuilds, defensively)."""
+    prop = NgramProposer(max_ngram=3, min_ngram=1, max_lookback=64)
+    rng = np.random.default_rng(13)
+    hist = [int(t) for t in rng.integers(0, 6, size=10)]
+    for _ in range(30):
+        hist.append(int(rng.integers(0, 6)))
+        assert prop.propose_for("s0", hist, 4) == prop.propose(hist, 4)
+    assert "s0" in prop._indexes
+    prop.retain(["s1"])
+    assert "s0" not in prop._indexes
+    # defensive: a shorter history than indexed rebuilds from scratch
+    prop.propose_for("s2", hist, 4)
+    short = hist[:5]
+    assert prop.propose_for("s2", short, 4) == prop.propose(short, 4)
+
+
+# ------------------- loop vs per-step token identity ---------------------
+
+
+@pytest.mark.parametrize("chunk", [2, 3])
+def test_loop_greedy_token_identical(model, chunk):
+    """Oracle 1 (greedy): loop_steps=4 == loop_steps=1 == the eager
+    oracle, across prefill chunk sizes (the loop only ever takes
+    decode-only boundaries; chunk steps still interleave)."""
+    a, _ = _run(model, 4, chunk=chunk, max_new=12)
+    b, _ = _run(model, 1, chunk=chunk, max_new=12)
+    assert a == b
+    for (ids, reason), p in zip(a, PROMPTS):
+        assert ids == _ref(model, p, 12)
+        assert reason == "length"
+
+
+def test_loop_stochastic_mix_identical(model):
+    """Oracle 1 (stochastic): a mixed greedy/temperature/top-k/top-p
+    batch is token-identical at N=4 vs N=1 — the device sampler
+    consumes the same counter-based streams the host sampler does."""
+    def samp(i):
+        if i % 2 == 0:
+            return gen.SamplingParams()
+        return gen.SamplingParams(temperature=0.9, top_k=10, top_p=0.9,
+                                  seed=41 + i)
+
+    a, _ = _run(model, 4, sampling_fn=samp, max_new=12)
+    b, _ = _run(model, 1, sampling_fn=samp, max_new=12)
+    assert a == b
+    assert a[0][0] == _ref(model, PROMPTS[0], 12)   # greedy row unchanged
+
+
+def test_loop_stop_tokens_and_sequences_identical(model):
+    """Oracle 1 (stops): on-device stop-id AND multi-token stop-
+    sequence matching — same clipped streams, same 'stop' reasons,
+    mid-loop early exit included."""
+    base, _ = _run(model, 1, max_new=12)
+
+    def stop_fn(i):
+        seq = base[i][0]
+        return (seq[3],) if i == 0 and len(seq) > 3 else ()
+
+    def samp(i):
+        seq = base[i][0]
+        if i == 1 and len(seq) > 4:
+            # completes mid-loop: the final token must be withheld
+            return gen.SamplingParams(stop_sequences=((seq[3], seq[4]),))
+        return gen.SamplingParams()
+
+    a, snap = _run(model, 4, max_new=12, sampling_fn=samp, stop_fn=stop_fn)
+    b, _ = _run(model, 1, max_new=12, sampling_fn=samp, stop_fn=stop_fn)
+    assert a == b
+    assert a[0][1] == "stop" and a[1][1] == "stop"
+    # the stop id itself is not streamed: clipped at FIRST occurrence
+    assert len(a[0][0]) == base[0][0].index(base[0][0][3])
+    assert snap[gmetrics.LOOP_EARLY_EXITS] >= 1
+
+
+def test_loop_preemption_identical(model):
+    """Oracle 1 (preemption): a pool sized to thrash — the loop's
+    reserve-ahead rolls back on page shortfall and the boundary falls
+    through to the single-step path, which preempts; tokens still
+    match the oracle and the pool drains to empty."""
+    a, _ = _run(model, 4, pages=9, chunk=2, max_new=12)
+    for (ids, _), p in zip(a, PROMPTS):
+        assert ids == _ref(model, p, 12)
+
+
+def test_loop_speculation_identical(model):
+    """Oracle 1 (speculation): ngram drafts verified INSIDE the loop
+    (iteration 0) — token-identical to N=1 spec and to the no-spec
+    legacy oracle, with real acceptances observed."""
+    rep = [[5, 6, 9, 1, 5, 6], [4, 4, 4, 4, 4], [1, 2, 3, 1, 2, 3],
+           [7, 7, 7, 2, 7, 7]]
+    a, snap = _run(model, 4, prompts=rep, spec_mode="ngram", spec_tokens=3)
+    b, _ = _run(model, 1, prompts=rep, spec_mode="ngram", spec_tokens=3)
+    c, _ = _run(model, 1, prompts=rep, step_mode=None, chunk=0)
+    assert a == b
+    assert [t for t, _ in a] == [t for t, _ in c]
+    assert snap[gmetrics.SPEC_PROPOSED_TOKENS] > 0
+    assert snap[gmetrics.SPEC_ACCEPTED_TOKENS] > 0
+
+
+def test_loop_int8_pools_identical(model):
+    """int8 KV pools through the loop: lossy vs fp32, but strictly
+    token-identical between N=4 and N=1 at the same storage."""
+    a, _ = _run(model, 4, kv_dtype="int8", max_new=10)
+    b, _ = _run(model, 1, kv_dtype="int8", max_new=10)
+    assert a == b
+
+
+@pytest.mark.parametrize("layout", ["token", "kernel"])
+def test_loop_pool_layouts_identical(model, layout):
+    """Both DeviceKVPool storage layouts carried through the loop body
+    on the donation chain: token identity vs the oracle."""
+    a, _ = _run(model, 4, pool_layout=layout)
+    for (ids, _), p in zip(a, PROMPTS):
+        assert ids == _ref(model, p, 10)
+
+
+def test_loop_late_join_identical(model):
+    """A fifth prompt joins mid-stream: admissions happen at loop
+    boundaries, and the joined row's stream matches N=1 exactly."""
+    prompts = PROMPTS + [[2, 4, 6, 8]]
+    a, _ = _run(model, 4, prompts=prompts, max_new=8)
+    b, _ = _run(model, 1, prompts=prompts, max_new=8)
+    assert a == b
+
+
+def test_loop_mesh_token_identical():
+    """The loop under a head-sharded 4-device CPU mesh: one GSPMD
+    dispatch per boundary, token-identical to the unsharded N=1 run,
+    with collective traffic accounted per loop iteration."""
+    import jax
+
+    from paddle_tpu.parallel import tp_mesh
+
+    assert len(jax.devices()) >= 4, "conftest forces 8 host devices"
+    mesh_model = gen.TinyCausalLM(vocab_size=48, num_layers=2,
+                                  num_heads=4, head_dim=8, seed=3)
+
+    def samp(i):
+        return (gen.SamplingParams() if i % 2 else
+                gen.SamplingParams(temperature=0.8, top_k=8, seed=11 + i))
+
+    a, snap = _run(mesh_model, 4, mesh=tp_mesh(4), sampling_fn=samp)
+    b, _ = _run(mesh_model, 1, sampling_fn=samp)
+    assert a == b
+    assert snap[gmetrics.MESH_DEVICES] == 4
+    assert snap[gmetrics.COLLECTIVE_BYTES_PER_STEP] > 0
+
+
+def test_loop_max_new_tokens_edges(model):
+    """Budgets below/at/straddling N: rows that cannot take a full loop
+    still finish with the right lengths and reasons at N=4 == N=1."""
+    for max_new in (1, 2, 4, 5):
+        a, _ = _run(model, 4, max_new=max_new)
+        b, _ = _run(model, 1, max_new=max_new)
+        assert a == b, max_new
+        assert all(len(ids) == max_new and r == "length"
+                   for ids, r in a), max_new
+
+
+# ----------------------- dispatch/fetch accounting -----------------------
+
+
+def test_loop_fetch_accounting(model):
+    """Acceptance: a loop boundary is ONE dispatch + ONE host fetch for
+    up to N tokens per row — decode_host_fetches_per_token <= 1/N on a
+    decode-only run, loop_steps stamped, early-exit/wasted counters
+    schema-present from build."""
+    n = 4
+    a, snap = _run(model, n, max_new=12)
+    assert snap[gmetrics.LOOP_STEPS] == n
+    fpt = snap[gmetrics.DECODE_HOST_FETCHES_PER_TOKEN]
+    assert 0 < fpt <= 1.0 / n + 0.05, fpt
+    assert snap[gmetrics.DECODE_DISPATCHES_PER_STEP] == 1
+    assert snap[gmetrics.DECODE_HOST_SYNCS_PER_STEP] <= 1
+    # schema-complete: the loop counters exist even when they are zero
+    assert gmetrics.LOOP_EARLY_EXITS in snap
+    assert gmetrics.LOOP_WASTED_STEPS in snap
+    # the N=1 engine stamps loop_steps=1 and never touches the ratio
+    _, snap1 = _run(model, 1, max_new=12)
+    assert snap1[gmetrics.LOOP_STEPS] == 1
+    assert snap1[gmetrics.DECODE_HOST_FETCHES_PER_TOKEN] == 0.0
+
+
+def test_loop_wasted_steps_accounting(model):
+    """A row finishing mid-loop with no live peers left strands the
+    remaining iterations: wasted steps are counted, not hidden."""
+    base, _ = _run(model, 1, prompts=[PROMPTS[0]], max_new=12)
+
+    def stop_fn(i):
+        return (base[0][0][5],)     # stops at token 6 of 12
+
+    a, snap = _run(model, 4, prompts=[PROMPTS[0]], max_new=12,
+                   stop_fn=stop_fn)
+    b, _ = _run(model, 1, prompts=[PROMPTS[0]], max_new=12,
+                stop_fn=stop_fn)
+    assert a == b and a[0][1] == "stop"
+    assert snap[gmetrics.LOOP_EARLY_EXITS] >= 1
+
+
+def test_loop_prewarm_compiles_without_dispatch(model):
+    """LoopedRaggedStep.prewarm AOT-compiles the pages-bucket loop
+    executable without dispatching; traffic then adds zero compiles."""
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        max_decode_slots=4, num_pages=128, page_size=4,
+        prefill_chunk_tokens=3, kv_backend="device", step_mode="ragged",
+        loop_steps=4), start=False)
+    lp = eng._loop
+    assert lp is not None
+    assert lp.prewarm(2) is True
+    assert lp.prewarm(2) is False          # cached
+    # the longest prompt peaks in the next pages bucket (reserve-ahead
+    # rows span prompt + budget + N positions)
+    assert lp.prewarm(4) is True
+    before = lp.compile_count
+    hs = [eng.submit(p, max_new_tokens=6) for p in PROMPTS]
+    eng.run_until_idle()
+    for h in hs:
+        h.result(timeout=5)
+    assert lp.compile_count == before
+    eng.shutdown()
+
+
+# --------------------------- config policy -------------------------------
+
+
+def test_loop_config_validation(model):
+    with pytest.raises(ValueError, match="loop_steps"):
+        gen.GenerationConfig(loop_steps=0)
+    with pytest.raises(ValueError, match="host-free decode loop"):
+        gen.GenerationConfig(step_mode="legacy", loop_steps=4)
+    # loop_steps > 1 with step_mode unset auto-selects ragged
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        kv_backend="device", loop_steps=4), start=False)
+    assert eng.step_mode == "ragged" and eng._loop is not None
+    assert eng.loop_steps == 4
+    eng.shutdown()
+    # N=1 builds no loop step: the tier-1 per-step path is untouched
+    eng = gen.GenerationEngine(model, gen.GenerationConfig(
+        kv_backend="device", step_mode="ragged"), start=False)
+    assert eng._loop is None and eng.loop_steps == 1
+    eng.shutdown()
+
+    class NoLoop:
+        num_layers, num_heads, head_dim, vocab_size = 1, 1, 4, 8
+
+        def prefill(self, tokens):
+            raise NotImplementedError
+
+        def decode(self, tokens, positions, attend):
+            raise NotImplementedError
+
+        def ragged_step_fn(self, *a, **kw):
+            raise NotImplementedError
+
+        def decode_params(self):
+            raise NotImplementedError
+
+    with pytest.raises(ValueError, match="ragged_loop_fn"):
+        gen.GenerationEngine(NoLoop(), gen.GenerationConfig(
+            step_mode="ragged", kv_backend="device", loop_steps=4),
+            start=False)
+
+
+def test_loop_oversize_stops_fall_back(model):
+    """A request whose stop shapes exceed the loop executable's static
+    caps makes its boundary fall back to the per-step path — correct
+    output, no recompile storm."""
+    lots = tuple(range(100, 112))  # 12 stop ids > max_stop_ids=8,
+    # all outside the vocab so none can fire
+    a, snap = _run(model, 4, prompts=[PROMPTS[0]], max_new=8,
+                   stop_fn=lambda i: lots)
+    b, _ = _run(model, 1, prompts=[PROMPTS[0]], max_new=8,
+                stop_fn=lambda i: lots)
+    assert a == b
+    assert a[0][0] == _ref(model, PROMPTS[0], 8)   # none of them fire
+
+
+# ------------------- gen_bench loop satellite ----------------------------
+
+
+def _load_gen_bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "gen_bench.py")
+    spec = importlib.util.spec_from_file_location("gen_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gen_bench_loop_tokens_per_s_improves_with_n():
+    """The acceptance A/B on the CPU smoke cell: fusing N steps into
+    one dispatch strictly improves decode tokens/s over the per-step
+    baseline (the host round-trip per token IS the CPU bottleneck),
+    at one dispatch per boundary and <= 1/N host fetches per token."""
+    gb = _load_gen_bench()
+    bench_model = gen.TinyCausalLM(vocab_size=64, num_layers=2,
+                                   num_heads=2, head_dim=8,
+                                   max_positions=256, seed=0)
+    cells = {n: gb.bench_loop(bench_model, batch=4, context=8,
+                              new_tokens=48, page_size=4, loop_steps=n)
+             for n in (1, 4)}
+    assert cells[4]["tokens_per_s"] > cells[1]["tokens_per_s"], cells
+    assert cells[4]["dispatches_per_step"] == 1
+    assert 0 < cells[4]["host_fetches_per_token"] <= 1.0 / 4 + 0.05
+    assert cells[1]["host_fetches_per_token"] == 0.0   # never loops
+    # steady state: the measured pass compiles nothing at either N
+    assert all(c["measured_compiles"] == 0 for c in cells.values())
+
+
+@pytest.mark.slow
+def test_gen_bench_loop_ladder_soak():
+    """The full ladder (1, 4, 8) with stochastic sampling and the
+    mid-stream-join TTFT probe: monotone tokens/s, bounded fetch
+    ratio at every N, and a real join TTFT measurement per cell."""
+    gb = _load_gen_bench()
+    bench_model = gen.TinyCausalLM(vocab_size=64, num_layers=2,
+                                   num_heads=2, head_dim=8,
+                                   max_positions=512, seed=0)
+    cells = {n: gb.bench_loop(bench_model, batch=4, context=8,
+                              new_tokens=96, page_size=4, loop_steps=n,
+                              stochastic=True, ttft_probe=True)
+             for n in (1, 4, 8)}
+    assert cells[4]["tokens_per_s"] > cells[1]["tokens_per_s"], cells
+    assert cells[8]["tokens_per_s"] > cells[1]["tokens_per_s"], cells
+    for n in (4, 8):
+        assert 0 < cells[n]["host_fetches_per_token"] <= 1.0 / n + 0.05
+        assert cells[n]["ttft_join_s"] > 0
+        assert cells[n]["dispatches_per_step"] == 1
+
+
+def test_ragged_descriptor_rank_guard():
+    """The loop-body-safe contract: malformed descriptor ranks raise a
+    named error at trace time instead of silently broadcasting."""
+    pool = gen.DeviceKVPool(1, 2, 8, num_pages=8, page_size=4)
+    pool.allocate("A")
+    arr = np.ones((1, 4, 2, 8), np.float32)
+    pool.append_prefill("A", arr, arr)
+    pt, _ = pool.gather_block_tables(["A"])
+    q = np.ones((2, 2, 8), np.float32)
+    k_pool, v_pool = pool.layer_pools(0)
+    with pytest.raises(ValueError, match=r"\[S\]-shaped"):
+        ragged_paged_attention(q, k_pool, v_pool, pt,
+                               np.int32(0),            # scalar start
+                               np.array([1], np.int32),
+                               np.array([4], np.int32))
+    with pytest.raises(ValueError, match=r"\[S\]-shaped"):
+        ragged_paged_attention(q, k_pool, v_pool, pt[0],   # rank-1 table
+                               np.array([0], np.int32),
+                               np.array([1], np.int32),
+                               np.array([4], np.int32))

@@ -875,6 +875,112 @@ def bench_spec(model, batch, context, new_tokens, page_size, spec_mode,
     return cell
 
 
+def bench_loop(model, batch, context, new_tokens, page_size, loop_steps,
+               spec_tokens=0, stochastic=False, ttft_probe=False):
+    """One HOST-FREE DECODE LOOP A/B cell: the ragged engine at
+    loop_steps=N (N ragged iterations fused into ONE dispatch, ONE
+    host fetch per N tokens per row) vs the per-step N=1 baseline.
+
+    The decode-bound cell the loop exists for: short prompts, long
+    generations, so nearly every engine boundary is decode-only and
+    takes the fused loop.  Reports steady-state tokens/s, host
+    fetches per token (<= 1/N is the acceptance floor), dispatches
+    per boundary (must stay 1), early exits and wasted iterations
+    (rows finishing mid-loop), and — with `ttft_probe` — the TTFT of
+    a prompt submitted mid-stream, which can only join at a loop
+    boundary: the join-latency cost the N knob trades against
+    throughput (docs/GENERATION.md "Host-free decode loop")."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.generation import metrics as gmetrics
+    from paddle_tpu.profiler.monitor import StatRegistry
+
+    rng = np.random.default_rng(9000 + batch)
+    prompts = [rng.integers(0, model.vocab_size, context).tolist()
+               for _ in range(batch)]
+    horizon = new_tokens + loop_steps + spec_tokens
+    pages = ((context + horizon) // page_size + 2) * (batch + 1)
+    kw = {}
+    if spec_tokens:
+        kw.update(spec_mode="ngram", spec_tokens=spec_tokens)
+    eng = g.GenerationEngine(
+        model,
+        g.GenerationConfig(max_decode_slots=batch, num_pages=pages,
+                           page_size=page_size, queue_depth=batch * 2,
+                           kv_backend="device", step_mode="ragged",
+                           loop_steps=loop_steps, **kw),
+        start=False)
+    samp = (g.SamplingParams(temperature=0.9, top_k=16, seed=5)
+            if stochastic else None)
+
+    def run_once():
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=new_tokens,
+                              sampling=samp or g.SamplingParams())
+                   for p in prompts]
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        return dt, [h.result(timeout=1) for h in handles]
+
+    warmup_s, _ = run_once()
+    reg = StatRegistry.instance()
+    counters = {name: reg.get_stat(name) for name in (
+        gmetrics.STEPS_TOTAL, gmetrics.LOOP_EARLY_EXITS,
+        gmetrics.LOOP_WASTED_STEPS,
+        gmetrics.DECODE_COMPILES_TOTAL, gmetrics.PREFILL_COMPILES_TOTAL)}
+    before = {name: s.get() for name, s in counters.items()}
+    dt, results = run_once()
+    delta = {name: int(s.get() - before[name])
+             for name, s in counters.items()}
+    ttft_join_s = None
+    if ttft_probe:
+        # a prompt submitted while the batch decodes joins at the next
+        # loop boundary: its TTFT carries up to N-1 steps of wait
+        bg = [eng.submit(p, max_new_tokens=new_tokens)
+              for p in prompts[:max(1, batch - 1)]]
+        while not eng.scheduler.decode_ready():
+            eng.step()
+        probe = eng.submit(prompts[-1][:4], max_new_tokens=4)
+        eng.run_until_idle()
+        for h in bg + [probe]:
+            h.result(timeout=1)
+        ttft_join_s = probe.first_token_s - probe.submitted_s
+    generated = sum(len(r.token_ids) for r in results)
+    steps = delta[gmetrics.STEPS_TOTAL]
+    snap = eng.metrics.snapshot()
+    cell = {
+        "cell": "loop",
+        "loop_steps": loop_steps,
+        "spec_tokens": spec_tokens,
+        "stochastic": bool(stochastic),
+        "batch": batch,
+        "context": context,
+        "new_tokens": new_tokens,
+        "warmup_s": round(warmup_s, 4),
+        "elapsed_s": round(dt, 4),
+        "generated": int(generated),
+        "tokens_per_s": round(generated / dt, 1) if dt > 0 else None,
+        "steps": steps,
+        "tokens_per_step": round(generated / steps, 3) if steps else None,
+        # the acceptance ratio: cumulative host fetches over decode
+        # tokens for THIS engine (stamped 0.0 at build, so the N=1
+        # baseline reports 0.0 — it never takes the loop path)
+        "host_fetches_per_token":
+            snap["generation.decode_host_fetches_per_token"],
+        "loop_early_exits": delta[gmetrics.LOOP_EARLY_EXITS],
+        "loop_wasted_steps": delta[gmetrics.LOOP_WASTED_STEPS],
+        "dispatches_per_step":
+            snap["generation.decode_dispatches_per_step"],
+        "host_syncs_per_step":
+            snap["generation.decode_host_syncs_per_step"],
+        "ttft_join_s": (round(ttft_join_s, 4)
+                        if ttft_join_s is not None else None),
+        "measured_compiles": delta[gmetrics.DECODE_COMPILES_TOTAL]
+            + delta[gmetrics.PREFILL_COMPILES_TOTAL],
+    }
+    eng.shutdown()
+    return cell
+
+
 def bench_chaos(model, seed, n_replicas, requests, new_tokens):
     """The chaos-soak bench cell: a seeded KILL + STALL schedule over
     a subprocess fleet under concurrent streams (serving/disagg/
@@ -1122,6 +1228,21 @@ def main():
                     help="draft cap per speculating row for --spec "
                          "(3 measured best on CPU, where the packed "
                          "axis is real FLOPs; sweep upward on TPU)")
+    ap.add_argument("--loop-steps", default="0",
+                    help="host-free decode loop A/B on the ragged "
+                         "step: comma list of N values (each one cell "
+                         "at loop_steps=N; 1 = the per-step baseline) "
+                         "or 'both' for the 1,4,8 ladder — decode-"
+                         "bound cells reporting tokens/s, host "
+                         "fetches/token (<= 1/N), dispatches/step "
+                         "(still 1), early exits, wasted iterations, "
+                         "and the TTFT of a mid-stream join (which "
+                         "waits for a loop boundary); '0' disables")
+    ap.add_argument("--loop-stochastic", action="store_true",
+                    help="sample the --loop-steps cells at temperature "
+                         "0.9/top-k 16 instead of greedy: the "
+                         "on-device sampler's cost inside the loop "
+                         "vs the host sampler at N=1")
     ap.add_argument("--quant-collectives", action="store_true",
                     help="EQuARX-style quantized-allreduce A/B: every "
                          "SHARDED (tp > 1) combo runs an extra cell "
@@ -1367,6 +1488,20 @@ def main():
                 stats_by_series[
                     f"device/spec-{mode or 'off'}/{workload}"] = \
                     reg.stats_snapshot("generation.")
+    if args.loop_steps != "0":
+        # the host-free decode loop A/B: one decode-bound cell per N,
+        # N=1 as the per-step baseline of the same ragged engine
+        ns = ([1, 4, 8] if args.loop_steps == "both"
+              else sorted({int(x) for x in args.loop_steps.split(",")}))
+        lb = max(batches)
+        for n in ns:
+            reset_gen_stats()
+            grid.append(bench_loop(
+                model, lb, min(contexts), args.new_tokens,
+                args.page_size, n, stochastic=args.loop_stochastic,
+                ttft_probe=True))
+            stats_by_series[f"device/loop-{n}"] = \
+                reg.stats_snapshot("generation.")
     if args.prefix != "off":
         # the shared-system-prompt A/B: chunked prefill (warm hits
         # resume mid-prompt through the chunk loop), one cell per

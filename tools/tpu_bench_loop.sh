@@ -66,16 +66,23 @@ for i in $(seq 1 "$MAX"); do
     # cells, plus the --chaos soak cell: a seeded kill+stall schedule
     # over a 3-replica subprocess fleet reporting stream-gap p50/p95,
     # recovery wall, breaker trips and replay tokens under the
-    # no-hang/no-leak invariants): a timeout kill here drops the
-    # WHOLE gen artifact (mesh/prefill numbers included), so the cap
-    # tracks the scenario count and a kill at least says so
+    # no-hang/no-leak invariants; --loop-steps both lands the
+    # host-free decode loop ladder — N in {1, 4, 8} ragged
+    # iterations fused into ONE dispatch with on-device sampling and
+    # stop matching, reporting tokens/s, host fetches/token <= 1/N,
+    # mid-stream-join TTFT — the first hardware numbers for the
+    # dispatch-overhead story the loop exists for): a timeout kill
+    # here drops the WHOLE gen artifact (mesh/prefill numbers
+    # included), so the cap tracks the scenario count and a kill at
+    # least says so
     timeout 5700 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
       --step both --fleet-transport both --pd both \
       --kv-quant both --quant-collectives --spec both --chaos \
+      --loop-steps both \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + pd-disagg + kv-quant + quant-collectives + spec + chaos A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + pd-disagg + kv-quant + quant-collectives + spec + chaos + decode-loop A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
