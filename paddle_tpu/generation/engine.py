@@ -1154,6 +1154,9 @@ class GenerationEngine:
             "num_pages": cfg.num_pages,
             "max_positions": getattr(self.model, "max_positions", None),
             "default_max_new_tokens": cfg.default_max_new_tokens,
+            # decode-slot ceiling: the denominator of the autoscaler's
+            # decode-class occupancy signal (serving/control.py)
+            "max_decode_slots": cfg.max_decode_slots,
             "pid": os.getpid(),
         }
 
@@ -1186,6 +1189,10 @@ class GenerationEngine:
             pages, matched = self.cache.match_prefix_full(tokens)
             if not pages:
                 return None
+            # every export IS one observed unit of cross-replica
+            # demand (relay and p2p both funnel through here): fold
+            # it into the eviction order so fleet-hot chains survive
+            self.cache.note_fleet_demand(pages)
             out = self.cache.export_pages(pages)
             payload = {"tokens": [int(t) for t in tokens[:matched]],
                        "k": out[0], "v": out[1]}

@@ -60,6 +60,19 @@ def page_chain_hash(prev_hash, page_tokens):
                       int(prev_hash))
 
 
+def compact_prefix_deltas(deltas):
+    """Collapse a register/evict delta log to its NET op per chain —
+    an add followed by a drop (and any longer churn) nets to the LAST
+    op, which is all a consumer's index state can observe.  Shared by
+    the cache's own delta log and the transport's heartbeat
+    accumulator so neither grows O(churn) between drains on week-long
+    uptimes."""
+    last = {}
+    for op, chain in deltas:
+        last[chain] = op
+    return [(op, chain) for chain, op in last.items()]
+
+
 class OutOfPagesError(RuntimeError):
     """The page pool is exhausted: no free page for a required append.
     The scheduler catches this to preempt (or reject) a sequence rather
@@ -113,7 +126,7 @@ class _PrefixNode:
     adopt/free churn of the warm steady state)."""
 
     __slots__ = ("page", "key", "parent", "ident", "children", "last_use",
-                 "queued", "chain")
+                 "queued", "chain", "demand")
 
     def __init__(self, page, key, parent, ident, chain=0):
         self.page = page
@@ -126,6 +139,11 @@ class _PrefixNode:
         # CRC chain hash of the token prefix this node completes — the
         # fleet-level identity register/evict deltas gossip
         self.chain = chain
+        # cross-replica demand: fleet page-service export requests
+        # observed for this node (note_fleet_demand) — folded into the
+        # eviction key so a chain siblings keep adopting outlives a
+        # locally-cold one
+        self.demand = 0
 
 
 class PagedKVCache:
@@ -142,6 +160,12 @@ class PagedKVCache:
     # storage layout of layer_pools() arrays; DeviceKVPool can store the
     # kernel layout instead (see its pool_layout)
     pool_layout = "token"
+
+    # recency-clock ticks one unit of observed cross-replica demand is
+    # worth in the eviction order (note_fleet_demand): a chain the
+    # fleet adopted once outlives a local run untouched for this many
+    # recency events.  Zero disables the fold (pure-LRU ablation).
+    fleet_demand_boost = 256
 
     def __init__(self, num_layers, num_heads, head_dim, num_pages=256,
                  page_size=16, dtype=np.float32):
@@ -189,6 +213,11 @@ class PagedKVCache:
         # step just to swap a list
         self._prefix_deltas = None
         self._delta_lock = threading.Lock()
+        # delta-log growth bound: past _delta_compact_at entries the
+        # log collapses to net ops (compact_prefix_deltas) — an
+        # enabled-but-undrained log stays O(live chains), not O(churn)
+        self._delta_compact_at = 4096
+        self.prefix_delta_compactions = 0
         self._import_seq = 0   # temp seq ids for import_prefix_run
         # incrementally-maintained min-heap of evictable LEAF nodes,
         # entries (last_use_at_push, ident, node): pushed at the exact
@@ -773,6 +802,10 @@ class PagedKVCache:
         if self._prefix_deltas is not None:
             with self._delta_lock:
                 self._prefix_deltas.append((op, node.chain))
+                if len(self._prefix_deltas) > self._delta_compact_at:
+                    self._prefix_deltas = compact_prefix_deltas(
+                        self._prefix_deltas)
+                    self.prefix_delta_compactions += 1
 
     def enable_prefix_deltas(self):
         """Start recording register/evict deltas for take_prefix_deltas
@@ -792,20 +825,43 @@ class PagedKVCache:
             out, self._prefix_deltas = self._prefix_deltas, []
         return out
 
+    def note_fleet_demand(self, pages):
+        """Fold observed cross-replica demand into eviction order: the
+        fleet page service calls this on every export of a warm run
+        (relay or p2p), bumping each exported node's demand count.
+        Demanded chains sort later in the evictable-leaf heap
+        (_evict_key), so a prefix siblings keep adopting outlives
+        locally-cold runs — heap entries are corrected lazily at pop,
+        exactly like a recency touch."""
+        if not self.fleet_demand_boost:
+            return
+        for page in pages:
+            node = self._page_node.get(page)
+            if node is not None:
+                node.demand += 1
+
+    def _evict_key(self, node):
+        """Eviction priority: LRU recency plus the fleet-demand fold —
+        each observed adoption is worth fleet_demand_boost recency
+        ticks, so cross-replica demand ages a chain without freezing
+        it (a truly abandoned chain still drains out once the clock
+        passes its boosted key)."""
+        return node.last_use + node.demand * self.fleet_demand_boost
+
     def _push_evictable(self, node):
-        """Queue an evictable leaf at its current recency.  `queued`
-        dedups: a node holds at most ONE live heap entry, so the warm
-        steady state's adopt/free churn (decref-to-0 per request, the
-        regime that never triggers eviction to drain the heap) cannot
-        grow the heap past the trie size.  Entries are validated (and
-        stale recencies re-queued) lazily at pop, so a node that is
-        touched, re-adopted, or evicted after the push costs one
-        discarded heap entry, never a scan."""
+        """Queue an evictable leaf at its current eviction key.
+        `queued` dedups: a node holds at most ONE live heap entry, so
+        the warm steady state's adopt/free churn (decref-to-0 per
+        request, the regime that never triggers eviction to drain the
+        heap) cannot grow the heap past the trie size.  Entries are
+        validated (and stale keys re-queued) lazily at pop, so a node
+        that is touched, demanded, re-adopted, or evicted after the
+        push costs one discarded heap entry, never a scan."""
         if node.queued:
             return
         node.queued = True
         heapq.heappush(self._evict_heap,
-                       (node.last_use, node.ident, node))
+                       (self._evict_key(node), node.ident, node))
 
     def _evict_prefix(self, n_pages):
         """Evict up to `n_pages` refcount-0 cached pages to the free
@@ -830,14 +886,15 @@ class PagedKVCache:
         heap = self._evict_heap
         freed = 0
         while freed < n_pages and heap:
-            last_use, _, node = heapq.heappop(heap)
+            key, _, node = heapq.heappop(heap)
             node.queued = False   # its one live entry just left the heap
             if self._nodes.get(node.key) is not node or node.children \
                     or self._refs.get(node.page, 1) != 0:
                 continue  # stale entry: evicted, re-adopted, or grew
-            if last_use != node.last_use:
-                # touched since queued: re-queue at its true recency so
-                # a recently-matched run outlives a colder sibling
+            if key != self._evict_key(node):
+                # touched (or fleet-demanded) since queued: re-queue at
+                # its true key so a recently-matched or fleet-hot run
+                # outlives a colder sibling
                 self._push_evictable(node)
                 continue
             self._drop_node(node)

@@ -19,7 +19,12 @@ A chain hash collision can at worst misroute or skip one adoption —
 adoption and admission both re-verify against literal tokens
 (kv_cache.page_chain_hash documents the containment).
 """
-from ...generation.kv_cache import page_chain_hash
+from ...generation.kv_cache import (compact_prefix_deltas,
+                                    page_chain_hash)
+
+# delta-log net-op collapse, re-exported for transport/heartbeat
+# accumulators: an add→drop churn nets to its last op per chain
+compact_deltas = compact_prefix_deltas
 
 
 def page_chain_hashes(tokens, page_size):
@@ -44,6 +49,8 @@ class FleetPrefixIndex:
     def __init__(self):
         self._holders = {}
         self._clock = 0
+        self.compactions = 0       # compact() sweeps that dropped work
+        self.chains_compacted = 0  # dead-holder chains swept, total
 
     def _tick(self):
         self._clock += 1
@@ -90,6 +97,28 @@ class FleetPrefixIndex:
                 best = max(pool, key=lambda n: holders[n])
                 return best, depth * page_size, hashes[depth - 1]
         return None
+
+    def compact(self, live):
+        """Week-long-uptime memory bound: drop every holder entry not
+        in `live` (replica names currently serving) and every chain
+        left with no live holder.  drop_replica already handles clean
+        deaths; this sweep is the belt-and-braces GC the router's
+        watchdog runs so renames, missed death paths, and long
+        add/drop churn can never grow the index without bound.
+        Returns the number of chains dropped."""
+        live = set(live)
+        dropped = 0
+        for chain in list(self._holders):
+            holders = self._holders[chain]
+            for name in [n for n in holders if n not in live]:
+                del holders[name]
+            if not holders:
+                del self._holders[chain]
+                dropped += 1
+        if dropped:
+            self.compactions += 1
+            self.chains_compacted += dropped
+        return dropped
 
     def chains_held(self, name=None):
         """Registered chain count (fleet-wide, or one replica's) — the

@@ -45,6 +45,7 @@ import threading
 import time
 
 from ...generation.engine import (GenerationEngine, GenerationResult)
+from ...generation.kv_cache import compact_prefix_deltas
 from ...generation.metrics import GenerationMetrics
 from ...generation.scheduler import GenerationRequest
 from ...profiler.monitor import StatRegistry
@@ -135,6 +136,7 @@ class InprocTransport:
             self.engine.enable_handoff()
         self.on_death = None   # inproc replicas share our fate
         self.timeout_total = 0   # schema parity: no RPC, no timeouts
+        self._data_server = None   # lazy p2p data listener (ISSUE 20)
 
     # ------------------------- liveness -----------------------------
     def alive(self):
@@ -200,6 +202,31 @@ class InprocTransport:
     def import_prefix(self, payload):
         return self.engine.import_prefix_pages(payload)
 
+    def data_address(self):
+        """The p2p data plane's (host, port) for this replica — a
+        LAZY real TCP listener even in-process, so inproc fleets
+        exercise the exact wire path (frames, codec, deadlines) the
+        cross-host tier ships on."""
+        if self._data_server is None:
+            from .data_plane import PageDataServer
+
+            self._data_server = PageDataServer(
+                self.engine.export_prefix_pages)
+        return self._data_server.address
+
+    def import_prefix_from(self, addr, tokens, timeout_s=15.0,
+                           levels=("raw",)):
+        """P2P adoption: fetch the warm prefix straight off the
+        holder's data port and install it — same contract as the
+        worker's op, returns {"added", "wire_bytes", "raw_bytes"}."""
+        from .data_plane import fetch_prefix_pages
+
+        payload, wire, raw = fetch_prefix_pages(
+            tuple(addr), tokens, timeout_s=timeout_s, levels=levels)
+        added = (0 if payload is None
+                 else self.engine.import_prefix_pages(payload))
+        return {"added": added, "wire_bytes": wire, "raw_bytes": raw}
+
     def flush_prefix(self):
         return self.engine.cache.flush_prefix_cache()
 
@@ -234,6 +261,9 @@ class InprocTransport:
             eng.step()
 
     def stop(self):
+        if self._data_server is not None:
+            self._data_server.stop()
+            self._data_server = None
         self.engine.shutdown()
 
 
@@ -252,6 +282,13 @@ class SubprocTransport:
     role = "mixed"
     on_handoff = None
     _assembler = None
+    _data_addr = None        # p2p data port, learned from heartbeats
+    delta_compactions = 0    # prefix-delta log net-op collapses
+    # accumulated-but-undrained prefix deltas past this bound collapse
+    # to their net op per chain — a router that goes long between
+    # pulls (idle fleet, slow snapshot cadence) stays O(live chains),
+    # not O(churn), over week-long uptimes
+    DELTA_COMPACT_AT = 1024
 
     def __init__(self, spec, rpc=None, fault_plan=None):
         cfg = spec.config
@@ -319,7 +356,8 @@ class SubprocTransport:
             self._describe = self._call(
                 {"op": "build", "model": spec.model, "config": cfg,
                  "role": self.role, "chunk_bytes": self.chunk_bytes,
-                 "faults": child_faults},
+                 "faults": child_faults,
+                 "data_host": getattr(spec, "host", None)},
                 timeout=self.BUILD_TIMEOUT_S)
         except BaseException:
             self._closing = True
@@ -329,6 +367,10 @@ class SubprocTransport:
             except OSError:
                 pass
             raise
+        # the data-port advert rides the build reply (available before
+        # the first heartbeat) and is refreshed by every later beat
+        addr = self._describe.pop("data_address", None)
+        self._data_addr = None if addr is None else tuple(addr)
         # the liveness clock starts AFTER the handshake: the child's
         # heartbeat thread only exists from here, and a build that took
         # longer than heartbeat_dead_after must not read as a stale
@@ -452,6 +494,13 @@ class SubprocTransport:
             if deltas:
                 with self._lock:
                     self._deltas.extend(deltas)
+                    if len(self._deltas) > self.DELTA_COMPACT_AT:
+                        self._deltas = compact_prefix_deltas(
+                            self._deltas)
+                        self.delta_compactions += 1
+            addr = frame.get("data")
+            if addr is not None:
+                self._data_addr = tuple(addr)
             return
         sid = frame.get("sid")
         with self._lock:
@@ -776,6 +825,28 @@ class SubprocTransport:
         # itself, but re-shipping multi-MB payloads on a timeout is
         # the wrong trade — fail fast, the cold ladder covers it
         return self._call({"op": "import_prefix", "payload": payload})
+
+    def data_address(self):
+        """The replica's advertised p2p data port — (host, port), or
+        None until the build reply / first heartbeat delivered it."""
+        return self._data_addr
+
+    def import_prefix_from(self, addr, tokens, timeout_s=None,
+                           levels=("raw",)):
+        """P2P adoption: tell THIS replica to dial the holder's data
+        port and fetch the warm prefix itself — the payload crosses
+        one replica→replica socket and never this control channel.
+        NOT retried, same reasoning as import_prefix; the outer RPC
+        deadline wraps the child's bounded fetch with headroom so a
+        wedged data socket fails typed HERE, not as a parent timeout
+        racing the child's."""
+        inner = self.rpc.timeout_s if timeout_s is None \
+            else float(timeout_s)
+        return self._call(
+            {"op": "import_prefix_from", "addr": tuple(addr),
+             "tokens": [int(t) for t in tokens], "timeout_s": inner,
+             "levels": list(levels)},
+            timeout=inner + 5.0)
 
     def flush_prefix(self):
         return self._call_idempotent({"op": "flush_prefix"})

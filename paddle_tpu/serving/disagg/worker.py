@@ -101,6 +101,7 @@ class _Worker:
         self.registry = None
         self.chunk_bytes = None   # set by the build frame
         self.faults = None        # child half of a chaos FaultPlan
+        self.data_server = None   # p2p page data plane (ISSUE 20)
         self.handles = {}         # sid -> live _StreamHandle (cancel)
         self._hlock = threading.Lock()
         self._assembler = FrameAssembler()
@@ -174,9 +175,20 @@ class _Worker:
             # replicas
             self.engine.enable_handoff()
             self.engine.on_handoff = self._ship_handoffs
+        # the p2p data plane: bind an ephemeral data port siblings
+        # dial DIRECTLY for page bytes (advertised in heartbeats and
+        # the build reply) — the router's socket stays control-only
+        from .data_plane import PageDataServer
+
+        self.data_server = PageDataServer(
+            self.engine.export_prefix_pages,
+            host=frame.get("data_host") or "127.0.0.1",
+            chunk_bytes=self.chunk_bytes)
         threading.Thread(target=self._heartbeat, args=(HEARTBEAT_S,),
                          name="replica-heartbeat", daemon=True).start()
-        return self.engine.describe()
+        out = dict(self.engine.describe())
+        out["data_address"] = self.data_server.address
+        return out
 
     def _ship_handoffs(self):
         for snap in self.engine.take_handoffs():
@@ -205,7 +217,12 @@ class _Worker:
                     {"ev": "hb", "load": self.engine.load_info(),
                      "seq": self.engine.step_seq,
                      "in_step": self.engine.in_step,
-                     "deltas": deltas})
+                     "deltas": deltas,
+                     # data-port advert: the parent learns (and after
+                     # a restart re-learns) where to send siblings
+                     # for this replica's page bytes
+                     "data": (None if self.data_server is None
+                              else self.data_server.address)})
             except OSError:
                 return
             except Exception:   # noqa: BLE001 — a heartbeat must never
@@ -291,6 +308,27 @@ class _Worker:
     def op_import_prefix(self, frame):
         return self.engine.import_prefix_pages(frame["payload"])
 
+    def op_import_prefix_from(self, frame):
+        """P2P adoption: dial the HOLDER's data port directly, fetch
+        + decode the warm prefix, install it locally — the page bytes
+        never touch the router's socket.  The dial runs under this
+        worker's own fault plan (point "fetch_prefix" / "resp"), so
+        the chaos matrix covers the data socket too; a "kill" rule
+        SIGKILLs this worker mid-transfer, exactly like the RPC
+        channel's kill faults.  Typed failures ride the reply wire
+        back and degrade fleet-side to the cold-prefill ladder."""
+        from .data_plane import fetch_prefix_pages
+
+        payload, wire, raw = fetch_prefix_pages(
+            tuple(frame["addr"]), frame["tokens"],
+            timeout_s=float(frame.get("timeout_s", 15.0)),
+            levels=frame.get("levels") or ("raw",),
+            chunk_bytes=self.chunk_bytes, faults=self.faults,
+            kill_cb=self.kill)
+        added = (0 if payload is None
+                 else self.engine.import_prefix_pages(payload))
+        return {"added": added, "wire_bytes": wire, "raw_bytes": raw}
+
     def op_flush_prefix(self, frame):
         return self.engine.cache.flush_prefix_cache()
 
@@ -330,6 +368,8 @@ class _Worker:
 
     def op_shutdown(self, frame):
         self._stop_hb.set()
+        if self.data_server is not None:
+            self.data_server.stop()
         if self.engine is not None:
             self.engine.shutdown()
         return True

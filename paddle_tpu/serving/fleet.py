@@ -86,6 +86,7 @@ from ..generation.scheduler import GenerationRequest
 from ..profiler.monitor import StatRegistry
 from .admission import (ReplicaTimeoutError, RequestTooLargeError,
                         ServerBusyError, ServingError)
+from .disagg import pagecodec
 from .disagg.page_service import FleetPrefixIndex
 from .disagg.transport import HEARTBEAT_S, RpcPolicy, build_transport
 
@@ -129,6 +130,16 @@ SUPERVISOR_RESTART_TOTAL = PREFIX + "supervisor_restart_total"
 AUTOSCALE_SPAWNED = PREFIX + "autoscale_spawned"
 AUTOSCALE_DRAINED = PREFIX + "autoscale_drained"
 REPLICA_COUNT = PREFIX + "replica_count"
+# data-plane tier (ISSUE 20): p2p page transfer, compressed payloads,
+# async adoption.  relay_bytes counts page bytes that crossed the
+# ROUTER's socket (must stay 0 on the p2p path — counter-asserted);
+# p2p wire/raw bytes carry the compression-ratio arithmetic.
+PAGE_RELAY_BYTES = PREFIX + "page_relay_bytes"
+PAGE_P2P_BYTES = PREFIX + "page_p2p_bytes"
+PAGE_RAW_BYTES = PREFIX + "page_raw_bytes"
+PAGE_TRANSFERS_FAILED = PREFIX + "page_transfers_failed"
+PAGE_TRANSFERS_CANCELLED = PREFIX + "page_transfers_cancelled"
+PREFIX_INDEX_COMPACTIONS = PREFIX + "prefix_index_compactions"
 
 
 class FleetMetrics:
@@ -156,7 +167,10 @@ class FleetMetrics:
                      PD_HANDOFFS, PD_HANDOFF_TOKENS, PD_HANDOFF_WALL_S,
                      ROUTED_ROLE, PING_PROBE_TOTAL,
                      SUPERVISOR_RESTART_TOTAL, AUTOSCALE_SPAWNED,
-                     AUTOSCALE_DRAINED, REPLICA_COUNT):
+                     AUTOSCALE_DRAINED, REPLICA_COUNT,
+                     PAGE_RELAY_BYTES, PAGE_P2P_BYTES, PAGE_RAW_BYTES,
+                     PAGE_TRANSFERS_FAILED, PAGE_TRANSFERS_CANCELLED,
+                     PREFIX_INDEX_COMPACTIONS):
             self._reg.get_stat(name)
 
     def _stat(self, name):
@@ -201,6 +215,39 @@ class FleetMetrics:
         self._stat(PAGE_ADOPTIONS).increase()
         if pages:
             self._stat(PAGES_ADOPTED).increase(int(pages))
+
+    def count_page_relay_bytes(self, n):
+        """Page bytes that crossed the ROUTER's socket (relay path).
+        The p2p zero-relay assertion reads this counter."""
+        if n:
+            self._stat(PAGE_RELAY_BYTES).increase(int(n))
+
+    def count_page_p2p_bytes(self, wire, raw):
+        """Page bytes that moved replica→replica on the data socket:
+        `wire` as encoded (post-codec), `raw` what the same transfer
+        would have weighed uncompressed — the compression ratio is
+        raw/wire."""
+        if wire:
+            self._stat(PAGE_P2P_BYTES).increase(int(wire))
+        if raw:
+            self._stat(PAGE_RAW_BYTES).increase(int(raw))
+
+    def count_transfer_failed(self):
+        """One adoption transfer degraded typed to the cold-prefill
+        ladder (holder/importer trouble, codec mismatch, deadline)."""
+        self._stat(PAGE_TRANSFERS_FAILED).increase()
+
+    def count_transfer_cancelled(self):
+        """One queued transfer cancelled before moving bytes: the
+        index no longer wants it (importer already holds the chain,
+        or a party died)."""
+        self._stat(PAGE_TRANSFERS_CANCELLED).increase()
+
+    def count_index_compactions(self, chains):
+        """One prefix-index GC sweep that dropped `chains` chains with
+        no live holder."""
+        if chains:
+            self._stat(PREFIX_INDEX_COMPACTIONS).increase(int(chains))
 
     def count_breaker_open(self):
         """A circuit breaker tripped open: `breaker_threshold`
@@ -671,6 +718,24 @@ class FleetConfig:
         transfer (True, the default under routing="affinity"); False
         keeps the stable-hash prefix guess only.
 
+    Data-plane knobs (ISSUE 20, docs/SERVING.md "Data plane"):
+
+    page_transfer: "p2p" (default — adoption bytes move on a direct
+        replica→replica data socket; the router socket carries ZERO
+        page bytes) or "relay" (the export-through-the-router
+        baseline, also the automatic fallback while a replica's data
+        port is not yet advertised).
+    page_codec: "compressed" (default — pagecodec delta+zlib with
+        per-array raw fallback) or "raw" (passthrough, the A/B
+        baseline).  Applies to the p2p wire; the relay baseline
+        always ships raw.
+    async_adoption: True (default) ships adoption AFTER routing
+        returns — the request prefills cold immediately and arriving
+        pages warm the NEXT request; False restores the synchronous
+        adopt-before-submit path (deterministic tests, ablation).
+    max_inflight_transfers: per-importing-replica bound on concurrent
+        adoption transfers the async scheduler allows (>= 1).
+
     Chaos-hardening knobs (docs/SERVING.md "Failure model"):
 
     rpc_timeout_s / rpc_retries / rpc_backoff_s: the bounded-RPC
@@ -719,7 +784,9 @@ class FleetConfig:
                  respawn_reset_s=30.0, fault_plans=None,
                  watchdog_interval_s=None,
                  pd_prefill_threshold_tokens=64,
-                 min_replicas=1, max_replicas=None):
+                 min_replicas=1, max_replicas=None,
+                 page_transfer="p2p", page_codec="compressed",
+                 async_adoption=True, max_inflight_transfers=2):
         if routing not in ("affinity", "random"):
             raise ValueError(
                 f"routing must be 'affinity' or 'random', got {routing!r}")
@@ -802,6 +869,119 @@ class FleetConfig:
                 f"{self.min_replicas} or None, got {max_replicas}")
         self.max_replicas = (None if max_replicas is None
                              else int(max_replicas))
+        if page_transfer not in ("relay", "p2p"):
+            raise ValueError(
+                f"page_transfer must be 'relay' or 'p2p', got "
+                f"{page_transfer!r}")
+        self.page_transfer = page_transfer
+        if page_codec not in ("raw", "compressed"):
+            raise ValueError(
+                f"page_codec must be 'raw' or 'compressed', got "
+                f"{page_codec!r}")
+        self.page_codec = page_codec
+        self.async_adoption = bool(async_adoption)
+        if int(max_inflight_transfers) < 1:
+            raise ValueError(
+                f"max_inflight_transfers must be >= 1, got "
+                f"{max_inflight_transfers}")
+        self.max_inflight_transfers = int(max_inflight_transfers)
+
+
+class _TransferScheduler:
+    """The async adoption executor (ISSUE 20): a tiny bounded thread
+    pool that moves page bytes AFTER routing returned.  Transfers
+    dedup per (importer, chain) — back-to-back requests for one warm
+    prefix enqueue one transfer — and each importing replica is
+    bounded to `max_inflight` concurrent imports so a popular replica
+    cannot be flooded with payloads.  Execution re-checks the fleet
+    index first and CANCELS transfers nobody wants anymore (the
+    importer registered the chain itself while queued, a party died).
+    Everything runs off the routing path: a slow holder costs cold
+    prefills, never admission latency."""
+
+    WORKERS = 2
+
+    def __init__(self, router, max_inflight=2):
+        self._router = router
+        self._max = int(max_inflight)
+        self._cv = threading.Condition()
+        self._queue = []       # pending transfer dicts, FIFO
+        self._keys = set()     # (importer, chain) queued or in flight
+        self._inflight = {}    # importer name -> live transfer count
+        self._stopped = False
+        self._threads = [
+            threading.Thread(target=self._loop,
+                             name=f"fleet-transfer-{i}", daemon=True)
+            for i in range(self.WORKERS)]
+        for t in self._threads:
+            t.start()
+
+    def request(self, prompt, importer, holder, chain):
+        """Enqueue one adoption transfer; False = duplicate/stopped."""
+        key = (importer, chain)
+        with self._cv:
+            if self._stopped or key in self._keys:
+                return False
+            self._keys.add(key)
+            self._queue.append({"prompt": list(prompt),
+                                "importer": importer,
+                                "holder": holder, "chain": chain})
+            self._cv.notify()
+        return True
+
+    def _next_locked(self):
+        for i, t in enumerate(self._queue):
+            if self._inflight.get(t["importer"], 0) < self._max:
+                return i
+        return None
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while True:
+                    if self._stopped:
+                        return
+                    i = self._next_locked()
+                    if i is not None:
+                        break
+                    self._cv.wait(0.1)
+                t = self._queue.pop(i)
+                self._inflight[t["importer"]] = \
+                    self._inflight.get(t["importer"], 0) + 1
+            try:
+                self._router._execute_transfer(t)
+            except Exception:   # noqa: BLE001 — a transfer is an
+                pass            # optimization; failures are counted
+            finally:            # typed inside _execute_transfer
+                with self._cv:
+                    self._inflight[t["importer"]] -= 1
+                    self._keys.discard((t["importer"], t["chain"]))
+                    self._cv.notify_all()
+
+    def idle(self):
+        with self._cv:
+            return not self._queue \
+                and not any(self._inflight.values())
+
+    def wait_idle(self, timeout=30.0):
+        """Block until queue and in-flight transfers drain (tests and
+        run_until_idle); False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._queue or any(self._inflight.values()):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.1))
+        return True
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            self._queue.clear()
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
 
 
 class FleetRouter:
@@ -847,6 +1027,7 @@ class FleetRouter:
         self._rng = np.random.default_rng(self.config.seed)
         self._lock = threading.Lock()
         self._closed = False
+        self._transfers = None   # lazy async-adoption scheduler
         # a heartbeat this recent counts as "recovered" for the
         # breaker's half-open probe (inproc ages are 0 — always fresh)
         self._hb_fresh_s = max(1.0, 4 * HEARTBEAT_S)
@@ -1031,6 +1212,15 @@ class FleetRouter:
                         rep.breaker.record_failure()
                     else:
                         rep.breaker.record_success()
+            # prefix-index GC: drop holder entries for replicas no
+            # longer serving — belt-and-braces memory bound alongside
+            # the death path's eager drop_replica
+            with self._lock:
+                live = [r.name for r in self._replicas.values()
+                        if r.state == "serving"]
+                dropped = self._page_index.compact(live)
+            if dropped:
+                self.metrics.count_index_compactions(dropped)
         finally:
             self._watchdog_gate.release()
 
@@ -1251,11 +1441,11 @@ class FleetRouter:
             if not rep.breaker.admit(rep.transport.heartbeat_age(),
                                      self._hb_fresh_s):
                 continue
-            if not adoption_tried:
-                # hit-elsewhere: the fleet index says a DIFFERENT
-                # replica holds this prompt's warm pages — move the
-                # bytes point-to-point so this replica adopts a run
-                # it never prefilled, BEFORE admission matches
+            if not adoption_tried and not self.config.async_adoption:
+                # synchronous mode (ablation/deterministic tests):
+                # hit-elsewhere moves the bytes BEFORE admission so
+                # THIS request is served warm — at the cost of the
+                # transfer wall on its critical path
                 adoption_tried = self._maybe_adopt_pages(
                     prompt, rep, lookup)
             try:
@@ -1287,6 +1477,12 @@ class FleetRouter:
                 rep.breaker.record_failure()
                 raise
             rep.breaker.record_success()
+            if self.config.async_adoption:
+                # async adoption (the default): the request is already
+                # admitted and prefills cold RIGHT NOW; the transfer
+                # ships behind it and warms the prefix index for the
+                # NEXT request — routing latency never waits on bytes
+                self._schedule_adoption(prompt, rep, lookup)
             if i == 0:
                 self.metrics.count_routed(rung)
             else:
@@ -1503,37 +1699,142 @@ class FleetRouter:
             # client holds the handle, so the error lands there
             client.set_exception(e)
 
-    def _maybe_adopt_pages(self, prompt, rep, lookup):
-        """The page service's byte-moving half: when the fleet index
-        measured a DIFFERENT replica as holding this prompt's warm
-        prefix run, export it there and import it here so `rep` serves
-        the request warm from a run it never prefilled.  Returns True
-        when a transfer was attempted (success or not — one attempt
-        per request), False when not applicable.
+    def _adoption_viable_locked(self, rep, holder_name, chain):
+        """Preconditions a transfer must (re-)pass under the routing
+        lock: a live, layout-compatible holder that is NOT `rep`, for
+        a chain `rep` does not already hold.  Returns the holder
+        replica or None."""
+        if holder_name == rep.name \
+                or rep.name in self._page_index.holders_of(chain):
+            return None
+        src = self._replicas.get(holder_name)
+        if src is None or src.state != "serving" \
+                or not src.transport.alive():
+            return None
+        if src._describe["page_size"] != rep._describe["page_size"]:
+            # pages only move between layout-compatible pools; the
+            # importer would reject the payload anyway, so skip the
+            # export round-trip entirely
+            return None
+        return src
 
-        The byte transfer runs OUTSIDE the routing lock (the ROADMAP
-        carried item): the two RPCs are bounded (RpcPolicy deadlines)
-        and serialize nothing — a hung or dead holder degrades TYPED
-        to the cold-prefill ladder (the request still routes, it just
-        prefills its own prefix) instead of stalling fleet admission
-        behind the transfer.  Only the index bookkeeping touches the
-        lock, briefly."""
+    def _maybe_adopt_pages(self, prompt, rep, lookup):
+        """SYNCHRONOUS adoption (async_adoption=False): when the fleet
+        index measured a DIFFERENT replica as holding this prompt's
+        warm prefix run, move the bytes NOW so `rep` serves this very
+        request warm.  Returns True when a transfer was attempted
+        (success or not — one attempt per request), False when not
+        applicable.  The byte transfer runs OUTSIDE the routing lock:
+        bounded RPCs, typed degrade to the cold-prefill ladder — a
+        hung holder never stalls fleet admission."""
         if lookup is None:
             return False
         holder_name, _depth, chain = lookup
         with self._lock:
-            if holder_name == rep.name \
-                    or rep.name in self._page_index.holders_of(chain):
+            src = self._adoption_viable_locked(rep, holder_name, chain)
+        if src is None:
+            return False
+        self._adopt_via_wire(prompt, rep, src, chain)
+        return True
+
+    def _schedule_adoption(self, prompt, rep, lookup):
+        """ASYNC adoption (the default): enqueue the transfer on the
+        scheduler and return immediately — the admitted request
+        prefills cold, the arriving pages warm the index for the NEXT
+        request.  Dedup and in-flight bounding live in the scheduler;
+        viability is re-checked at execution time (cancellation)."""
+        if lookup is None:
+            return False
+        holder_name, _depth, chain = lookup
+        with self._lock:
+            if self._closed:
                 return False
-            src = self._replicas.get(holder_name)
-            if src is None or src.state != "serving" \
-                    or not src.transport.alive():
+            if self._adoption_viable_locked(rep, holder_name,
+                                            chain) is None:
                 return False
-            if src._describe["page_size"] != rep._describe["page_size"]:
-                # pages only move between layout-compatible pools; the
-                # importer would reject the payload anyway, so skip the
-                # export round-trip entirely
-                return False
+            if self._transfers is None:
+                self._transfers = _TransferScheduler(
+                    self, self.config.max_inflight_transfers)
+        return self._transfers.request(prompt, rep.name, holder_name,
+                                       chain)
+
+    def _execute_transfer(self, t):
+        """One queued transfer, on a scheduler thread.  Re-checks
+        viability first — the index may have stopped wanting this
+        transfer while it sat queued (the importer prefilled and
+        registered the chain itself, a party died) — and cancels
+        instead of moving dead bytes."""
+        rep = self._replicas.get(t["importer"])
+        with self._lock:
+            if self._closed or rep is None or rep.state != "serving" \
+                    or not rep.transport.alive():
+                self.metrics.count_transfer_cancelled()
+                return
+            src = self._adoption_viable_locked(rep, t["holder"],
+                                               t["chain"])
+            if src is None:
+                self.metrics.count_transfer_cancelled()
+                return
+        self._adopt_via_wire(t["prompt"], rep, src, t["chain"])
+
+    def wait_transfers(self, timeout=30.0):
+        """Block until every queued/in-flight adoption transfer
+        settles (tests, benches, graceful drains).  True when idle."""
+        transfers = self._transfers
+        if transfers is None:
+            return True
+        return transfers.wait_idle(timeout)
+
+    def _adopt_via_wire(self, prompt, rep, src, chain):
+        """Move one warm prefix run from `src` to `rep` — the byte-
+        moving half shared by both adoption modes.  p2p (default):
+        `rep` dials `src`'s advertised data port and the payload
+        crosses ONE replica→replica socket, compressed at the
+        negotiated codec level — zero page bytes on the router
+        socket.  relay (ablation, or a data port not yet advertised):
+        export through the router, counted into page_relay_bytes.
+        Every failure is typed and counted; the request(s) behind it
+        just prefill cold."""
+        levels = (("delta", "raw")
+                  if self.config.page_codec == "compressed"
+                  else ("raw",))
+        if self.config.page_transfer == "p2p":
+            addr_fn = getattr(src.transport, "data_address", None)
+            import_from = getattr(rep.transport, "import_prefix_from",
+                                  None)
+            addr = addr_fn() if addr_fn is not None else None
+            if addr is not None and import_from is not None:
+                try:
+                    res = import_from(addr, prompt,
+                                      timeout_s=self.config.rpc_timeout_s,
+                                      levels=levels)
+                except ReplicaTimeoutError:
+                    # the IMPORTER's RPC missed its deadline — its
+                    # breaker bookkeeping decides its fate; the
+                    # request degrades to the cold-prefill ladder
+                    self.metrics.count_replica_timeout()
+                    rep.breaker.record_failure()
+                    self.metrics.count_transfer_failed()
+                    return
+                except ServingError:
+                    # typed refusal anywhere on the path (dial failed,
+                    # deadline, codec mismatch, holder refused): cold
+                    # ladder, counted
+                    self.metrics.count_transfer_failed()
+                    return
+                added = res.get("added", 0) if isinstance(res, dict) \
+                    else 0
+                if added:
+                    self.metrics.count_page_adoption(added)
+                    self.metrics.count_page_p2p_bytes(
+                        res.get("wire_bytes", 0),
+                        res.get("raw_bytes", 0))
+                    with self._lock:
+                        self._page_index.apply(rep.name,
+                                               [("add", chain)])
+                return
+            # no data port advertised yet (heterogeneous fleet member,
+            # pre-first-heartbeat): fall through to the relay baseline
         try:
             payload = src.transport.export_prefix(prompt)
         except ReplicaTimeoutError:
@@ -1542,26 +1843,31 @@ class FleetRouter:
             # let the holder's breaker bookkeeping decide its fate
             self.metrics.count_replica_timeout()
             src.breaker.record_failure()
-            return True
+            self.metrics.count_transfer_failed()
+            return
         except ServingError:
-            return True
+            self.metrics.count_transfer_failed()
+            return
         if not payload:
-            return True   # evicted since the last delta pull
+            return   # evicted since the last delta pull
+        self.metrics.count_page_relay_bytes(
+            pagecodec.payload_nbytes(payload))
         try:
             added = rep.transport.import_prefix(payload)
         except ReplicaTimeoutError:
             self.metrics.count_replica_timeout()
             rep.breaker.record_failure()
-            return True
+            self.metrics.count_transfer_failed()
+            return
         except ServingError:
-            return True
+            self.metrics.count_transfer_failed()
+            return
         if added:
             self.metrics.count_page_adoption(added)
             # eager index update (the importer's own delta confirms on
             # the next pull): back-to-back requests must not re-ship
             with self._lock:
                 self._page_index.apply(rep.name, [("add", chain)])
-        return True
 
     def _handle_death(self, transport):
         """Crash path: mark the replica dead, count it, forget its
@@ -1780,7 +2086,9 @@ class FleetRouter:
         steps = 0
         while True:
             busy = (bool(self._collect_handoffs())
-                    or bool(self._pending_handoffs))
+                    or bool(self._pending_handoffs)
+                    or not (self._transfers is None
+                            or self._transfers.idle()))
             for rep in list(self._replicas.values()):
                 if rep.state in ("stopped", "dead"):
                     continue
@@ -1858,6 +2166,7 @@ class FleetRouter:
                 if r.state == "serving"))
         return {"fleet": self.metrics.snapshot(),
                 "prefix_index_chains": self._page_index.chains_held(),
+                "prefix_index_compactions": self._page_index.compactions,
                 "replicas": replicas}
 
     def shutdown(self):
@@ -1869,6 +2178,8 @@ class FleetRouter:
         self._watchdog_stop.set()
         if self._watchdog_thread is not None:
             self._watchdog_thread.join(timeout=5.0)
+        if self._transfers is not None:
+            self._transfers.stop()
         for rep in self._replicas.values():
             if rep.state != "stopped":
                 rep.transport.stop()

@@ -702,8 +702,12 @@ def test_robustness_metrics_schema_complete_and_zeroed_inproc(model):
 def test_adoption_runs_outside_routing_lock_and_degrades_typed(model):
     """The satellite: the page-transfer RPCs run OUTSIDE the routing
     lock, and a timed-out holder degrades the request to the
-    cold-prefill ladder — typed, counted, admission never stalled."""
-    fl = _fleet(model)
+    cold-prefill ladder — typed, counted, admission never stalled.
+    Pinned to the relay wire + synchronous adoption: the fault hooks
+    the router-side export_prefix RPC, and the sync path is the one
+    whose transfer could ever sit on the request's critical path (the
+    async scheduler has its own chaos suite in test_data_plane.py)."""
+    fl = _fleet(model, page_transfer="relay", async_adoption=False)
     h1 = fl.submit(SYSTEM + [7], max_new_tokens=4)
     fl.run_until_idle()
     h1.result(timeout=5)
@@ -823,6 +827,10 @@ def test_import_failure_consistent_on_mesh():
 # --------------------------- chaos soak drills ---------------------------
 
 
+@pytest.mark.slow   # the full kind x point matrix over a 3-child
+# subprocess fleet runs ~36s on one core (conftest slow-lane
+# convention); the kill+stall schedule drill below keeps a seeded
+# multi-fault soak in tier-1
 @needs_subproc
 def test_chaos_drill_full_matrix_deterministic(model):
     """THE acceptance soak: the seeded full kind x point matrix over a
@@ -860,6 +868,10 @@ def test_chaos_drill_kill_and_stall_schedule(model):
                        for k in ks}
 
 
+@pytest.mark.slow   # two ~35s subprocess-fleet soaks (conftest
+# slow-lane convention); int8 pool + layout coverage stays in tier-1
+# via test_kv_quant / test_fused_decode, fault coverage via the drills
+# above
 @needs_subproc
 @pytest.mark.parametrize("layout", ["token", "kernel"])
 def test_chaos_drill_int8_pools(model, layout):
@@ -947,7 +959,9 @@ def test_kill_during_export_degrades_adoption_cold(model):
     fl = FleetRouter(specs, FleetConfig(seed=0, transport="proc",
                                         rpc_timeout_s=5.0,
                                         fault_plans={"c0": plan},
-                                        heartbeat_dead_after=10.0))
+                                        heartbeat_dead_after=10.0,
+                                        page_transfer="relay",
+                                        async_adoption=False))
     try:
         fl._sessions["seed"] = "c0"
         h1 = fl.submit(SYSTEM + [7], max_new_tokens=4, session="seed")

@@ -10,7 +10,10 @@ thread for one end-to-end resurrection:
   router's respawn discipline — backoff owed means retry next tick,
   a crash-loop streak past max_respawns is left for the operator.
 - SCALE UP: only after `sustain_ticks` CONSECUTIVE pressure ticks,
-  only with a spec_factory, only below max_replicas.
+  only with a spec_factory, only below max_replicas.  On a role-split
+  fleet pressure is PER CLASS: TTFT EWMA presses the prefill class,
+  decode slot occupancy presses the decode class, queue depth presses
+  both — and the starved class's name reaches the spec_factory.
 - SCALE DOWN: only after `idle_ticks` consecutive fully-idle ticks,
   only the supervisor's OWN spawns (LIFO), never below min_replicas —
   the operator's configured fleet is never shrunk.
@@ -315,6 +318,118 @@ def test_autoscaler_inert_without_spec_factory(model):
         for _ in range(3):
             assert not sup.tick()["spawned"]
         assert len(fl._replicas) == 1
+        fl.run_until_idle()
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_supervisor_config_validates_slot_occupancy():
+    assert SupervisorConfig().scale_up_slot_occupancy is None
+    assert SupervisorConfig(
+        scale_up_slot_occupancy=1.0).scale_up_slot_occupancy == 1.0
+    for bad in (0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="scale_up_slot_occupancy"):
+            SupervisorConfig(scale_up_slot_occupancy=bad)
+
+
+def _split_fleet_and_sup(model, roles=("prefill", "decode"), **sup_kw):
+    """A role-split fleet whose pressure signals the tests FABRICATE
+    (cached load_info + describe state — exactly what _survey reads),
+    plus a role-recording spec_factory."""
+    specs = [ReplicaSpec(f"r{i}", model, _cfg(), role=role)
+             for i, role in enumerate(roles)]
+    fl = FleetRouter(specs, FleetConfig(start=False, seed=0,
+                                        max_replicas=4))
+    spawned_roles = []
+
+    def factory(i, role="mixed"):
+        spawned_roles.append(role)
+        return ReplicaSpec(f"auto{i}", model, _cfg(), role=role)
+
+    kw = dict(scale_up_queue_depth=100.0, sustain_ticks=1)
+    kw.update(sup_kw)
+    sup = FleetSupervisor(fl, spec_factory=factory,
+                          config=SupervisorConfig(**kw))
+    return fl, sup, spawned_roles
+
+
+def test_autoscaler_decode_pressure_spawns_decode_replica(model):
+    """Saturated decode slots press ONLY the decode class: the spawn
+    carries role="decode", and the prefill class stays quiet."""
+    fl, sup, roles = _split_fleet_and_sup(
+        model, scale_up_slot_occupancy=0.9)
+    try:
+        rep = fl._replicas["r1"]          # the decode replica
+        rep._describe = {"max_decode_slots": 4}
+        rep.transport.load_info = lambda: {
+            "queue_depth": 0, "active": 4, "idle": False}
+        report = sup.tick()
+        assert report["pressure"] == {"prefill": False, "decode": True}
+        assert report["spawned"]
+        assert roles == ["decode"]
+        assert fl._replicas["auto0"].role == "decode"
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_autoscaler_ttft_pressure_spawns_prefill_replica(model):
+    """A climbing TTFT EWMA presses ONLY the prefill class — decode
+    capacity would not buy admission latency."""
+    fl, sup, roles = _split_fleet_and_sup(model, scale_up_ttft_s=0.5)
+    try:
+        fl._replicas["r0"].ttft_ewma = 2.0    # the prefill replica
+        report = sup.tick()
+        assert report["pressure"] == {"prefill": True, "decode": False}
+        assert report["spawned"]
+        assert roles == ["prefill"]
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_autoscaler_skewed_load_scales_classes_independently(model):
+    """Acceptance: a skewed prefill-heavy THEN decode-heavy load
+    scales each class independently — each class keeps its own sustain
+    streak, and relieving one class does not bleed into the other."""
+    fl, sup, roles = _split_fleet_and_sup(
+        model, scale_up_ttft_s=0.5, scale_up_slot_occupancy=0.9,
+        sustain_ticks=2)
+    try:
+        pre, dec = fl._replicas["r0"], fl._replicas["r1"]
+        # phase 1: prefill-heavy (TTFT climbs), decode healthy
+        pre.ttft_ewma = 2.0
+        assert not sup.tick()["spawned"]      # streak 1 of 2
+        r = sup.tick()                        # sustained: spawn
+        assert r["spawned"] and roles == ["prefill"]
+        # phase 2: prefill relieved, decode slots saturate — the
+        # decode class starts its OWN streak from zero
+        pre.ttft_ewma = 0.0
+        dec._describe = {"max_decode_slots": 4}
+        dec.transport.load_info = lambda: {
+            "queue_depth": 0, "active": 4, "idle": False}
+        first = sup.tick()
+        assert first["pressure"] == {"prefill": False, "decode": True}
+        assert not first["spawned"]           # decode streak 1 of 2
+        assert sup.tick()["spawned"]
+        assert roles == ["prefill", "decode"]
+        assert _stat(fleet_mod.AUTOSCALE_SPAWNED) == 2
+    finally:
+        sup.stop()
+        fl.shutdown()
+
+
+def test_autoscaler_homogeneous_fleet_keeps_single_mixed_class(model):
+    """No role split -> one "mixed" pressure class (the pre-split
+    single-counter behavior) and plain factory(i) spec factories keep
+    working unchanged."""
+    fl, sup = _pressured_fleet_and_sup(model, sustain=1)
+    try:
+        fl.submit(SYSTEM, max_new_tokens=4)
+        report = sup.tick()
+        assert set(report["pressure"]) == {"mixed"}
+        assert report["spawned"]
         fl.run_until_idle()
     finally:
         sup.stop()

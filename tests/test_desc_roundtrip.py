@@ -12,6 +12,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.static as static
@@ -131,6 +132,9 @@ def test_training_program_roundtrips_bit_equal():
         np.testing.assert_array_equal(l1, l2)
 
 
+@pytest.mark.slow   # fresh-process resnet50: a ~60s-on-one-core soak
+# (conftest slow-lane convention); the lenet roundtrip above keeps the
+# desc-serialization path in tier-1
 def test_resnet50_inference_roundtrip_fresh_process(tmp_path):
     from bench import _build_static_resnet50
 

@@ -8,6 +8,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -57,6 +58,9 @@ def test_dryrun_multichip_subprocess_from_clean_env():
         assert ok, f"leg {leg} failed: {proc.stdout}"
 
 
+@pytest.mark.slow   # subprocess-runs the WHOLE bench.py (~7 min on
+# one core, forced CPU) — a soak by the conftest slow-lane convention;
+# the entry/dryrun contract tests above stay in tier-1
 def test_bench_prints_one_json_line():
     env = dict(os.environ)
     env["PTN_BENCH_FORCE_CPU"] = "1"  # tests never touch the real chip
@@ -72,6 +76,7 @@ def test_bench_prints_one_json_line():
     assert rec["value"] > 0, rec
 
 
+@pytest.mark.slow   # same full-bench.py subprocess soak as above
 def test_bench_survives_poisoned_backend():
     """JAX_PLATFORMS pointing at a nonexistent platform must still yield a
     JSON line (the round-1 rc=1 scenario)."""

@@ -54,6 +54,15 @@ Usage:
         # migrated_replay_tokens (LIVE migration must report 0 vs the
         # cold-resubmit baseline's full replay), and the page-service
         # adoption counters
+    python tools/gen_bench.py --page-transfer both --page-codec both
+        # cross-host DATA-PLANE A/B: one warm-prefix adoption cell per
+        # (relay vs p2p) x (raw vs compressed) combo — wire bytes,
+        # router relay bytes (p2p cells must report 0: pages dial the
+        # holder's data port, the router only books the index), raw
+        # bytes + measured compression ratio (bitwise-lossless delta+
+        # zlib; the synthetic model's KV is near-incompressible, so
+        # the ratio is honest, not a marketing 2x), the async transfer
+        # wall, and the importer's warm TTFT after adoption
     python tools/gen_bench.py --mesh both
         # single-chip vs TENSOR-PARALLEL sharded decode A/B: the same
         # grid run unsharded (tp_degree 1) and over a head-sharded
@@ -770,6 +779,97 @@ def bench_drain_migration(model, transport, live, sys_tokens, new_tokens,
     }
 
 
+def bench_page_transfer(model, transfer, codec, sys_tokens, new_tokens,
+                        page_size, chunk_tokens):
+    """One DATA-PLANE A/B cell: a 2-replica fleet seeds a warm prefix
+    on the holder, then a request lands on the importer and the page
+    transfer ships it over — once per (page_transfer, page_codec)
+    combo.  Reports the wire bytes the transfer actually moved, the
+    ROUTER-RELAY bytes (the p2p acceptance number: must be 0 — pages
+    dial the holder's data port directly, the router only books the
+    index), the raw-byte baseline and the measured compression ratio
+    (raw / wire; the synthetic bench model's int8-grid KV is
+    near-incompressible, so this cell reports the honest ratio for
+    THIS data — the codec's >= 2x capacity on low-entropy pages is
+    pinned by tests/test_data_plane.py), the async transfer wall, and
+    the warm TTFT the importer serves after adoption."""
+    from paddle_tpu import generation as g
+    from paddle_tpu.profiler.monitor import StatRegistry
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                          ReplicaSpec)
+
+    reg = StatRegistry.instance()
+    for name in list(reg.stats()):
+        if name.startswith(fleet_mod.PREFIX):
+            reg.get_stat(name).reset()
+    total = sys_tokens + new_tokens
+    pages = (-(-total // page_size) + 2) * 4
+    specs = [
+        ReplicaSpec(
+            f"r{i}", model,
+            g.GenerationConfig(max_decode_slots=4, num_pages=pages,
+                               page_size=page_size, prefix_cache=True,
+                               prefill_chunk_tokens=chunk_tokens))
+        for i in range(2)]
+    fl = FleetRouter(specs, FleetConfig(start=False, seed=7,
+                                        page_transfer=transfer,
+                                        page_codec=codec))
+    rng = np.random.default_rng(sys_tokens * 11 + 3)
+    system = rng.integers(0, model.vocab_size, sys_tokens).tolist()
+    sfx = rng.integers(0, model.vocab_size, (2, 4)).tolist()
+    # seed the warm prefix on the holder (cold TTFT baseline) — the
+    # first pass also pays every per-shape compile on both replicas
+    fl._sessions["seed"] = "r0"
+    h_cold = fl.submit(system + sfx[0], max_new_tokens=new_tokens,
+                       session="seed")
+    fl.run_until_idle()
+    h_cold.result(timeout=60)
+    fl.stats_snapshot()            # flush prefix deltas into the index
+    # the adoption: a shared-prefix request lands on the importer;
+    # routing returns immediately, the transfer ships asynchronously
+    fl._sessions["imp"] = "r1"
+    t0 = time.perf_counter()
+    h_warm = fl.submit(system + sfx[1], max_new_tokens=new_tokens,
+                       session="imp")
+    transferred = fl.wait_transfers(timeout=60)
+    transfer_wall = time.perf_counter() - t0
+    fl.run_until_idle()
+    h_warm.result(timeout=60)
+    snap = fl.stats_snapshot()["fleet"]
+    fl.shutdown()
+    wire = (snap.get("fleet.page_p2p_bytes", 0)
+            + snap.get("fleet.page_relay_bytes", 0))
+    # the relay path ships the un-encoded payload, so its raw
+    # baseline IS its wire bill (the codec only rides the p2p port)
+    raw = snap.get("fleet.page_raw_bytes", 0) or wire
+    return {
+        "scenario": "page_transfer",
+        "page_transfer": transfer,
+        "page_codec": codec,
+        "sys_tokens": sys_tokens,
+        "new_tokens": new_tokens,
+        "transfer_drained": bool(transferred),
+        "page_adoptions": snap.get("fleet.page_adoptions", 0),
+        "pages_adopted": snap.get("fleet.pages_adopted", 0),
+        "wire_bytes": wire,
+        # the p2p acceptance counter: page payload bytes that crossed
+        # the ROUTER socket (p2p cells must report 0)
+        "router_relay_bytes": snap.get("fleet.page_relay_bytes", 0),
+        "raw_bytes": raw,
+        "compression_ratio": (round(raw / wire, 3) if wire else None),
+        "transfer_wall_s": round(transfer_wall, 4),
+        "cold_ttft_s": round(
+            h_cold.first_token_s - h_cold.submitted_s, 4),
+        "warm_ttft_after_adoption_s": round(
+            h_warm.first_token_s - h_warm.submitted_s, 4),
+        "warm_hit_tokens": h_warm.prefix_hit_tokens or 0,
+        "transfers_failed": snap.get("fleet.page_transfers_failed", 0),
+        "transfers_cancelled":
+            snap.get("fleet.page_transfers_cancelled", 0),
+    }
+
+
 def bench_spec(model, batch, context, new_tokens, page_size, spec_mode,
                spec_tokens, workload):
     """One SPECULATIVE-decoding A/B cell: the ragged engine with
@@ -1189,6 +1289,27 @@ def main():
                          "stream-gap p95 across the drain, "
                          "migrated_replay_tokens (live must report 0) "
                          "and page-service adoption counters")
+    ap.add_argument("--page-transfer",
+                    choices=("off", "relay", "p2p", "both"),
+                    default="off",
+                    help="data-plane A/B: a 2-replica fleet ships one "
+                         "warm prefix to the importer per cell — "
+                         "'relay' (page payloads ride the router "
+                         "socket) vs 'p2p' (the importer dials the "
+                         "holder's data port; router_relay_bytes must "
+                         "report 0), or 'both'.  Each cell reports "
+                         "wire bytes, raw bytes, compression ratio, "
+                         "async transfer wall, and the warm TTFT the "
+                         "importer serves after adoption")
+    ap.add_argument("--page-codec",
+                    choices=("raw", "compressed", "both"),
+                    default="compressed",
+                    help="page payload codec for the --page-transfer "
+                         "cells: 'raw' (byte-exact baseline, wire == "
+                         "raw) vs 'compressed' (per-page delta filter "
+                         "+ zlib, bitwise-lossless, raw fallback per "
+                         "array), or 'both' for the codec A/B pair "
+                         "per transfer mode")
     ap.add_argument("--mesh", default="1",
                     help="tensor-parallel A/B: '1' (unsharded), 'N' "
                          "(head-sharded over every visible device), "
@@ -1549,6 +1670,21 @@ def main():
                     model, transport, live, sys_tokens,
                     max(32, args.new_tokens), args.page_size,
                     args.chunk_tokens))
+    if args.page_transfer != "off":
+        # the data-plane A/B: relay vs p2p wire x raw vs compressed
+        # codec — one adoption cell per combo, router_relay_bytes the
+        # p2p acceptance number (0) and compression_ratio the honest
+        # measured ratio on this model's pages
+        pt_modes = (("relay", "p2p") if args.page_transfer == "both"
+                    else (args.page_transfer,))
+        pc_modes = (("raw", "compressed") if args.page_codec == "both"
+                    else (args.page_codec,))
+        for transfer in pt_modes:
+            for codec in pc_modes:
+                grid.append(bench_page_transfer(
+                    model, transfer, codec, max(contexts),
+                    args.new_tokens, args.page_size,
+                    args.chunk_tokens))
     if args.pd != "off":
         # P/D disaggregation A/B: split (prefill + decode classes)
         # vs mixed (role-less baseline) under the same long-wave +
@@ -1582,6 +1718,8 @@ def main():
         "replicas": args.replicas,
         "fleet_transport": args.fleet_transport,
         "pd": args.pd,
+        "page_transfer": args.page_transfer,
+        "page_codec": args.page_codec,
         "chaos": bool(args.chaos),
         "grid": grid,
         "stats": stats_by_series,

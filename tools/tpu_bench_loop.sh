@@ -71,18 +71,21 @@ for i in $(seq 1 "$MAX"); do
     # iterations fused into ONE dispatch with on-device sampling and
     # stop matching, reporting tokens/s, host fetches/token <= 1/N,
     # mid-stream-join TTFT — the first hardware numbers for the
-    # dispatch-overhead story the loop exists for): a timeout kill
-    # here drops the WHOLE gen artifact (mesh/prefill numbers
-    # included), so the cap tracks the scenario count and a kill at
-    # least says so
+    # dispatch-overhead story the loop exists for; --page-transfer
+    # both --page-codec both adds the 4-cell data-plane A/B: relay vs
+    # p2p wire x raw vs compressed pages, router_relay_bytes == 0 on
+    # the p2p cells and the honest measured compression ratio): a
+    # timeout kill here drops the WHOLE gen artifact (mesh/prefill
+    # numbers included), so the cap tracks the scenario count and a
+    # kill at least says so
     timeout 5700 python tools/gen_bench.py --pool both --decode both \
       --prefill both --mesh both --prefix both --replicas both \
       --step both --fleet-transport both --pd both \
       --kv-quant both --quant-collectives --spec both --chaos \
-      --loop-steps both \
+      --loop-steps both --page-transfer both --page-codec both \
       --out "${OUT%.json}_gen.json" \
       >/dev/null 2>&1 \
-      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + pd-disagg + kv-quant + quant-collectives + spec + chaos + decode-loop A/B) -> ${OUT%.json}_gen.json" \
+      && echo "[tpu-bench-loop] gen bench (pool + decode + prefill + mesh + prefix + fleet + ragged-step + disagg-transport + pd-disagg + kv-quant + quant-collectives + spec + chaos + decode-loop + data-plane A/B) -> ${OUT%.json}_gen.json" \
       || echo "[tpu-bench-loop] gen bench failed/timed out; no gen artifact"
     exit 0
   fi
